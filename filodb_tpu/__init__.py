@@ -1,0 +1,18 @@
+"""FiloDB-TPU: a TPU-native, distributed, Prometheus-compatible time-series database.
+
+A from-scratch rebuild of the capabilities of FiloDB (reference: Scala/JVM,
+/root/reference) designed TPU-first:
+
+- Columnar chunks live as padded dense device arrays ``[series, rows]``;
+  the leaf scan -> window -> aggregate query hot path runs as jitted XLA
+  (and Pallas) kernels using prefix-sum window formulations instead of the
+  reference's per-row iterator loops (reference:
+  query/exec/PeriodicSamplesMapper.scala, query/exec/rangefn/RangeFunction.scala).
+- Sharding maps onto a ``jax.sharding.Mesh`` axis; cross-shard aggregation
+  rides XLA collectives (psum) instead of Akka scatter-gather
+  (reference: coordinator/ActorPlanDispatcher).
+- Host code keeps planning, tag indexing, ingestion bookkeeping, and
+  persistence — mirroring the reference's layer map (SURVEY.md §1).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,12 @@
+"""Columnar codecs: the equivalent of the reference's ``memory/format`` layer.
+
+The reference implements off-heap BinaryVectors with per-row appenders and
+readers (reference: memory/src/main/scala/filodb.memory/format/BinaryVector.scala).
+Here the unit of work is a whole numpy array: encoders take dense arrays and
+produce compact ``bytes``; decoders take ``bytes`` and produce dense arrays
+ready to be stacked into device tensors.  Hot codecs have a C++ fast path
+(filodb_tpu/native) with these numpy implementations as the reference/fallback.
+"""
+
+from filodb_tpu.codecs.wire import WireType  # noqa: F401
+from filodb_tpu.codecs import nibblepack, deltadelta, doublecodec  # noqa: F401

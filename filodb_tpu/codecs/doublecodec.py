@@ -1,0 +1,68 @@
+"""Float64 vector codec.
+
+Strategy mirrors the reference's DoubleVector optimizer (reference:
+memory/src/main/scala/filodb.memory/format/vectors/DoubleVector.scala:14):
+
+- all values integral and line-like  -> route through the DELTA2 long codec
+  (``DELTA2_DOUBLE``), the common case for counters ingested as doubles;
+- constant vectors -> ``CONST_DOUBLE``;
+- otherwise -> Gorilla-style previous-value XOR predictor whose u64 residual
+  stream is NibblePacked (``XOR_DOUBLE``; doc/compression.md "Floating Point
+  Compression" lists XOR as the predictor feeding NibblePack).
+
+NaN is used by ingestion as the "no data" sentinel, exactly like the
+reference's Prometheus schemas; NaNs survive round-trip bit-exactly through
+the XOR path.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from filodb_tpu.codecs import deltadelta, nibblepack
+from filodb_tpu.codecs.wire import WireType
+
+_N = struct.Struct("<I")
+
+
+def encode(values: np.ndarray) -> bytes:
+    v = np.ascontiguousarray(values, dtype=np.float64)
+    n = len(v)
+    if (n and np.isfinite(v).all() and (np.abs(v) < 2**63).all()
+            and not (np.signbit(v) & (v == 0)).any()):  # -0.0 must keep its sign bit
+        as_int = v.astype(np.int64)
+        if (as_int.astype(np.float64) == v).all():
+            inner = deltadelta.encode(as_int)
+            return bytes([WireType.DELTA2_DOUBLE]) + inner
+    if n and np.all(v[0] == v) and not np.isnan(v[0]):
+        return bytes([WireType.CONST_DOUBLE]) + _N.pack(n) + struct.pack("<d", v[0])
+    bits = v.view(np.uint64)
+    prev = np.concatenate([[np.uint64(0)], bits[:-1]])
+    residuals = bits ^ prev
+    return bytes([WireType.XOR_DOUBLE]) + _N.pack(n) + nibblepack.pack(residuals)
+
+
+def decode(buf: bytes) -> np.ndarray:
+    wire = buf[0]
+    if wire == WireType.DELTA2_DOUBLE:
+        return deltadelta.decode(buf[1:]).astype(np.float64)
+    if wire == WireType.CONST_DOUBLE:
+        (n,) = _N.unpack_from(buf, 1)
+        (val,) = struct.unpack_from("<d", buf, 1 + _N.size)
+        return np.full(n, val, dtype=np.float64)
+    if wire != WireType.XOR_DOUBLE:
+        raise ValueError(f"not a double vector: wire type {wire}")
+    (n,) = _N.unpack_from(buf, 1)
+    residuals, _ = nibblepack.unpack(buf, n, 1 + _N.size)
+    # invert the XOR-with-previous chain via cumulative xor
+    bits = np.bitwise_xor.accumulate(residuals)
+    return bits.view(np.float64)
+
+
+def num_values(buf: bytes) -> int:
+    wire = buf[0]
+    if wire == WireType.DELTA2_DOUBLE:
+        return deltadelta.num_values(buf[1:])
+    return _N.unpack_from(buf, 1)[0]

@@ -1,0 +1,4 @@
+"""Core engine: schemas, record format, chunk store APIs, memstore.
+
+Equivalent of the reference's ``core/`` module (SURVEY.md §2.2).
+"""

@@ -1,0 +1,182 @@
+"""Chunk metadata and encoded chunk sets.
+
+Equivalent of the reference's ChunkSetInfo + BinaryVector chunk payloads
+(reference: core/src/main/scala/filodb.core/store/ChunkSetInfo.scala:59,122).
+A ``ChunkSet`` is the frozen, compressed form of one partition's write buffer
+(what gets flushed to the column store); ``ChunkBatch`` is the decoded,
+padded, device-ready SoA form the query kernels consume — the TPU-native
+replacement for per-row VectorDataReader iteration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from filodb_tpu.codecs import deltadelta, doublecodec, histcodec, strcodec
+from filodb_tpu.core.histogram import HistogramBuckets
+from filodb_tpu.core.schemas import ColumnType, Schema
+
+
+def chunk_id(start_time_ms: int, ingestion_seq: int = 0) -> int:
+    """Chunk ids are timestamp-based so they sort by time (reference:
+    ChunkSetInfo chunkID = timestamp-based, store/ChunkSetInfo.scala)."""
+    return (start_time_ms << 12) | (ingestion_seq & 0xFFF)
+
+
+@dataclasses.dataclass
+class ChunkSetInfo:
+    chunk_id: int
+    num_rows: int
+    start_time: int
+    end_time: int
+
+
+@dataclasses.dataclass
+class ChunkSet:
+    """Compressed columns of one chunk of one partition."""
+
+    info: ChunkSetInfo
+    partkey: bytes
+    vectors: list[bytes]  # one encoded blob per data column (col 0 = timestamps)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(len(v) for v in self.vectors)
+
+
+def encode_chunkset(schema: Schema, partkey: bytes, timestamps: np.ndarray,
+                    columns: Sequence, ingestion_seq: int = 0) -> ChunkSet:
+    """Freeze raw append buffers into the smallest encoding per column —
+    the optimize() step of the reference's BinaryAppendableVector
+    (reference: memory/format/BinaryVector.scala optimize,
+    TimeSeriesPartition.encodeOneChunkset TimeSeriesPartition.scala:203-249).
+
+    ``columns`` are the non-timestamp data columns in schema order; histogram
+    columns take ``(HistogramBuckets, int64[rows, buckets])`` tuples.
+    """
+    ts = np.ascontiguousarray(timestamps, dtype=np.int64)
+    n = len(ts)
+    data_cols = schema.data.columns[1:]
+    if len(columns) != len(data_cols):
+        raise ValueError(f"schema {schema.name} expects {len(data_cols)} data columns, "
+                         f"got {len(columns)}")
+    vectors = [deltadelta.encode(ts)]
+    for col, data in zip(data_cols, columns):
+        rows = data[1] if col.ctype == ColumnType.HISTOGRAM else data
+        if len(rows) != n:
+            raise ValueError(f"column {col.name}: {len(rows)} rows != {n} timestamps")
+        if col.ctype == ColumnType.DOUBLE:
+            vectors.append(doublecodec.encode(np.asarray(data, dtype=np.float64)))
+        elif col.ctype in (ColumnType.LONG, ColumnType.TIMESTAMP, ColumnType.INT):
+            vectors.append(deltadelta.encode(np.asarray(data, dtype=np.int64)))
+        elif col.ctype == ColumnType.HISTOGRAM:
+            buckets, hrows = data
+            vectors.append(histcodec.encode(buckets, np.asarray(hrows)))
+        elif col.ctype == ColumnType.STRING:
+            vectors.append(strcodec.encode_utf8(list(data)))
+        else:
+            raise ValueError(f"unsupported column type {col.ctype}")
+    info = ChunkSetInfo(chunk_id(int(ts[0]) if n else 0, ingestion_seq), n,
+                        int(ts[0]) if n else 0, int(ts[-1]) if n else 0)
+    return ChunkSet(info, partkey, vectors)
+
+
+def decode_column(blob: bytes, ctype: ColumnType):
+    if ctype in (ColumnType.TIMESTAMP, ColumnType.LONG, ColumnType.INT):
+        return deltadelta.decode(blob)
+    if ctype == ColumnType.DOUBLE:
+        return doublecodec.decode(blob)
+    if ctype == ColumnType.HISTOGRAM:
+        return histcodec.decode(blob)
+    if ctype == ColumnType.STRING:
+        return strcodec.decode_utf8(blob)
+    raise ValueError(f"unsupported column type {ctype}")
+
+
+def decode_chunkset(schema: Schema, cs: ChunkSet) -> tuple[np.ndarray, list]:
+    ts = deltadelta.decode(cs.vectors[0])
+    cols = [decode_column(blob, col.ctype)
+            for col, blob in zip(schema.data.columns[1:], cs.vectors[1:])]
+    return ts, cols
+
+
+# --------------------------------------------------------------------------
+# Device-ready batches
+# --------------------------------------------------------------------------
+
+TS_PAD = np.iinfo(np.int64).max  # padding timestamp: sorts after everything
+
+
+@dataclasses.dataclass
+class ChunkBatch:
+    """Padded dense SoA over a set of series: the unit the kernels consume.
+
+    ``timestamps[s, r]`` is padded with TS_PAD and ``values`` with NaN past
+    ``row_counts[s]`` so searchsorted/window kernels need no masks beyond the
+    value NaN convention.  ``hist`` columns become [S, R, B] matrices.
+    """
+
+    timestamps: np.ndarray          # [S, R] int64
+    values: np.ndarray              # [S, R] float64 (the designated value column)
+    row_counts: np.ndarray          # [S] int32
+    hist: Optional[np.ndarray] = None       # [S, R, B] float64 when value col is hist
+    bucket_tops: Optional[np.ndarray] = None  # [B]
+    extra_cols: Optional[dict] = None       # name -> [S, R] for multi-column scans
+
+    @property
+    def num_series(self) -> int:
+        return self.timestamps.shape[0]
+
+    @property
+    def max_rows(self) -> int:
+        return self.timestamps.shape[1]
+
+
+def build_batch(series_ts: Sequence[np.ndarray], series_vals: Sequence,
+                pad_to: Optional[int] = None, hist: bool = False,
+                bucket_tops: Optional[np.ndarray] = None,
+                extra_cols: Optional[dict] = None,
+                pad_series_to: Optional[int] = None) -> ChunkBatch:
+    """Stack ragged per-series arrays into a padded [S, R] batch.
+
+    Padding strategy (SURVEY.md §7 "Ragged data"): R = max rows rounded up to
+    ``pad_to`` (a small set of bucket sizes keeps XLA recompiles bounded);
+    timestamps pad with TS_PAD, values with NaN so windowed kernels naturally
+    exclude them.
+    """
+    S = len(series_ts)
+    counts = np.array([len(t) for t in series_ts], dtype=np.int32)
+    R = int(counts.max()) if S else 0
+    if pad_to:
+        R = ((R + pad_to - 1) // pad_to) * pad_to if R else pad_to
+    R = max(R, 1)
+    if pad_series_to:
+        S_pad = max(S, pad_series_to)
+    else:
+        S_pad = max(S, 1)
+    ts = np.full((S_pad, R), TS_PAD, dtype=np.int64)
+    for i, t in enumerate(series_ts):
+        ts[i, :len(t)] = t
+    if hist:
+        B = len(bucket_tops)
+        vals = np.full((S_pad, R, B), np.nan, dtype=np.float64)
+        for i, v in enumerate(series_vals):
+            vals[i, :len(v)] = v
+        return ChunkBatch(ts, np.full((S_pad, R), np.nan), counts_pad(counts, S_pad),
+                          hist=vals, bucket_tops=np.asarray(bucket_tops, dtype=np.float64),
+                          extra_cols=extra_cols)
+    vals = np.full((S_pad, R), np.nan, dtype=np.float64)
+    for i, v in enumerate(series_vals):
+        vals[i, :len(v)] = v
+    return ChunkBatch(ts, vals, counts_pad(counts, S_pad), extra_cols=extra_cols)
+
+
+def counts_pad(counts: np.ndarray, s_pad: int) -> np.ndarray:
+    if len(counts) == s_pad:
+        return counts
+    out = np.zeros(s_pad, dtype=np.int32)
+    out[:len(counts)] = counts
+    return out

@@ -1,0 +1,192 @@
+"""Ingestion record format: the BinaryRecord v2 equivalent.
+
+The reference serializes each sample into an off-heap BinaryRecord inside a
+reusable RecordContainer — the unit that flows over Kafka and into shards,
+carrying the 16-bit schema hash, the partition-key hash and the shard-key
+hash so downstream code never re-parses tags (reference:
+core/src/main/scala/filodb.core/binaryrecord2/RecordBuilder.scala:32,
+RecordSchema.scala:40, RecordContainer.scala:27, doc/binaryrecord-spec.md).
+
+Here a record is a compact binary struct with the same embedded hashes, and a
+``RecordContainer`` is a length-prefixed batch of them.  Hashes use
+blake2b-64 (stable across processes/hosts, unlike Python ``hash``); the
+shard-key hash covers only the shard-key tags so the shard mapper can
+bit-splice it with the partition hash (reference: RecordBuilder.shardKeyHash
+/ partitionKeyHash, RecordBuilder.scala:578+).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import struct
+from typing import Iterator, Mapping, Optional, Sequence
+
+import numpy as np
+
+from filodb_tpu.core.schemas import ColumnType, DatasetOptions, Schema
+
+
+def stable_hash64(data: bytes) -> int:
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "little")
+
+
+def stable_hash32(data: bytes) -> int:
+    return stable_hash64(data) & 0xFFFFFFFF
+
+
+def canonical_partkey(tags: Mapping[str, str]) -> bytes:
+    """Canonical partition-key bytes: sorted tag pairs.  Serves the role of
+    the reference's partKey BinaryRecord (equality + hashing + persistence)."""
+    out = bytearray()
+    for k in sorted(tags):
+        kb, vb = k.encode(), tags[k].encode()
+        out += struct.pack("<HH", len(kb), len(vb)) + kb + vb
+    return bytes(out)
+
+
+def parse_partkey(buf: bytes) -> dict[str, str]:
+    tags: dict[str, str] = {}
+    pos = 0
+    while pos < len(buf):
+        klen, vlen = struct.unpack_from("<HH", buf, pos)
+        pos += 4
+        k = buf[pos:pos + klen].decode(); pos += klen
+        v = buf[pos:pos + vlen].decode(); pos += vlen
+        tags[k] = v
+    return tags
+
+
+def shard_key_hash(tags: Mapping[str, str], options: DatasetOptions) -> int:
+    """32-bit hash over shard-key tag values only, with the reference's
+    metric-suffix stripping (``_bucket``/``_count``/``_sum`` hash like their
+    base metric so they land on the same shards; reference:
+    RecordBuilder.trimShardColumn + shardKeyHash)."""
+    parts = []
+    for col in options.shard_key_columns:
+        v = tags.get(col, "")
+        for suffix in options.ignore_shard_key_column_suffixes.get(col, ()):
+            if v.endswith(suffix):
+                v = v[: -len(suffix)]
+                break
+        parts.append(v)
+    return stable_hash32("\x00".join(parts).encode())
+
+
+def partition_hash(tags: Mapping[str, str], options: Optional[DatasetOptions] = None) -> int:
+    """32-bit hash over the full tag set minus ignored tags (reference:
+    DatasetOptions.ignoreTagsOnPartitionKeyHash, e.g. ``le``)."""
+    ignored = options.ignore_tags_on_partition_key_hash if options else ()
+    filtered = {k: v for k, v in tags.items() if k not in ignored}
+    return stable_hash32(canonical_partkey(filtered))
+
+
+@dataclasses.dataclass
+class IngestRecord:
+    """One decoded sample: schema hash + tags + timestamp + data values.
+
+    ``values`` holds the non-timestamp data columns in schema order; histogram
+    columns hold an encoded BinaryHistogram-equivalent blob (bytes).
+    """
+
+    schema_hash: int
+    tags: dict[str, str]
+    timestamp: int
+    values: tuple
+    shard_hash: int = 0
+    part_hash: int = 0
+
+    def partkey(self) -> bytes:
+        return canonical_partkey(self.tags)
+
+
+class RecordBuilder:
+    """Builds RecordContainers from samples (reference: RecordBuilder.scala:32).
+
+    Not thread-safe; one builder per producer, like the reference's
+    per-thread builders.
+    """
+
+    def __init__(self, schema: Schema, options: DatasetOptions | None = None,
+                 container_size: int = 1024 * 1024):
+        self.schema = schema
+        self.options = options or DatasetOptions()
+        self.container_size = container_size
+        self._containers: list[bytearray] = []
+        self._cur: bytearray = bytearray()
+
+    def add(self, timestamp: int, values: Sequence, tags: Mapping[str, str]) -> None:
+        shash = shard_key_hash(tags, self.options)
+        phash = partition_hash(tags, self.options)
+        rec = _encode_record(self.schema, self.options, timestamp, values, tags,
+                             shash, phash)
+        if len(self._cur) + len(rec) > self.container_size and self._cur:
+            self._flush_container()
+        self._cur += rec
+
+    def _flush_container(self) -> None:
+        self._containers.append(self._cur)
+        self._cur = bytearray()
+
+    def containers(self) -> list[bytes]:
+        """Drain all full+partial containers as wire bytes."""
+        if self._cur:
+            self._flush_container()
+        out = [struct.pack("<I", len(c)) + bytes(c) for c in self._containers]
+        self._containers = []
+        return out
+
+
+def _encode_record(schema: Schema, options: DatasetOptions, timestamp: int,
+                   values: Sequence, tags: Mapping[str, str],
+                   shash: int, phash: int) -> bytes:
+    out = bytearray()
+    out += struct.pack("<HIIq", schema.schema_hash, shash, phash, timestamp)
+    data_cols = schema.data.columns[1:]
+    if len(values) != len(data_cols):
+        raise ValueError(f"expected {len(data_cols)} values, got {len(values)}")
+    for col, v in zip(data_cols, values):
+        if col.ctype == ColumnType.DOUBLE:
+            out += struct.pack("<d", float(v))
+        elif col.ctype in (ColumnType.LONG, ColumnType.TIMESTAMP):
+            out += struct.pack("<q", int(v))
+        elif col.ctype == ColumnType.INT:
+            out += struct.pack("<i", int(v))
+        elif col.ctype == ColumnType.HISTOGRAM:
+            blob = v if isinstance(v, (bytes, bytearray)) else bytes(v)
+            out += struct.pack("<H", len(blob)) + blob
+        elif col.ctype == ColumnType.STRING:
+            blob = v.encode() if isinstance(v, str) else bytes(v)
+            out += struct.pack("<H", len(blob)) + blob
+        else:
+            raise ValueError(f"unsupported column type {col.ctype}")
+    pk = canonical_partkey(tags)
+    out += struct.pack("<H", len(pk)) + pk
+    return bytes(out)
+
+
+def decode_container(buf: bytes, schemas) -> Iterator[IngestRecord]:
+    """Iterate records in one container (reference: RecordContainer.iterate)."""
+    (total,) = struct.unpack_from("<I", buf, 0)
+    pos = 4
+    end = 4 + total
+    while pos < end:
+        schema_hash, shash, phash, ts = struct.unpack_from("<HIIq", buf, pos)
+        pos += 18
+        schema = schemas.by_hash(schema_hash)
+        vals = []
+        for col in schema.data.columns[1:]:
+            if col.ctype == ColumnType.DOUBLE:
+                vals.append(struct.unpack_from("<d", buf, pos)[0]); pos += 8
+            elif col.ctype in (ColumnType.LONG, ColumnType.TIMESTAMP):
+                vals.append(struct.unpack_from("<q", buf, pos)[0]); pos += 8
+            elif col.ctype == ColumnType.INT:
+                vals.append(struct.unpack_from("<i", buf, pos)[0]); pos += 4
+            elif col.ctype in (ColumnType.HISTOGRAM, ColumnType.STRING):
+                (ln,) = struct.unpack_from("<H", buf, pos); pos += 2
+                vals.append(bytes(buf[pos:pos + ln])); pos += ln
+        (pklen,) = struct.unpack_from("<H", buf, pos)
+        pos += 2
+        tags = parse_partkey(buf[pos:pos + pklen])
+        pos += pklen
+        yield IngestRecord(schema_hash, tags, ts, tuple(vals), shash, phash)

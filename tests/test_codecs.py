@@ -1,0 +1,218 @@
+"""Codec round-trip tests (model: the reference's EncodingPropertiesTest /
+RealTimeseriesEncodingTest, memory/src/test — exhaustive round-trips over
+random + realistic data)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.codecs import deltadelta, doublecodec, histcodec, nibblepack, strcodec
+from filodb_tpu.codecs.wire import WireType
+from filodb_tpu.core.histogram import CustomBuckets, GeometricBuckets
+
+rng = np.random.default_rng(42)
+
+
+class TestNibblePack:
+    def test_zigzag_roundtrip(self):
+        v = rng.integers(-(2**62), 2**62, 1000, dtype=np.int64)
+        assert np.array_equal(nibblepack.zigzag_decode(nibblepack.zigzag_encode(v)), v)
+        small = np.array([0, -1, 1, -2, 2], dtype=np.int64)
+        assert np.array_equal(nibblepack.zigzag_encode(small),
+                              np.array([0, 1, 2, 3, 4], dtype=np.uint64))
+
+    def test_zeros(self):
+        v = np.zeros(16, dtype=np.uint64)
+        packed = nibblepack.pack(v)
+        assert len(packed) == 2  # one bitmask byte per group of 8
+        out, _ = nibblepack.unpack(packed, 16)
+        assert np.array_equal(out, v)
+
+    def test_doc_example(self):
+        # doc/compression.md example: two 3-nibble values sharing shift
+        v = np.array([0x0000_0000_0012_3000, 0x0000_0000_0045_6000], dtype=np.uint64)
+        packed = nibblepack.pack(v)
+        # bitmask=0b11, header: trailing=3, nibbles=3 -> (3-1)<<4 | 3 = 0x23
+        assert packed[0] == 0b00000011
+        assert packed[1] == 0x23
+        assert packed[2:5] == bytes([0x23, 0x61, 0x45])
+        out, _ = nibblepack.unpack(packed, 2)
+        assert np.array_equal(out, v)
+
+    @pytest.mark.parametrize("n", [1, 7, 8, 9, 63, 64, 100])
+    def test_random_roundtrip(self, n):
+        for scale in (1, 2**16, 2**40, 2**63):
+            v = rng.integers(0, scale, n, dtype=np.uint64)
+            out, end = nibblepack.unpack(nibblepack.pack(v), n)
+            assert np.array_equal(out, v)
+
+    def test_packed_end(self):
+        v = rng.integers(0, 2**30, 50, dtype=np.uint64)
+        packed = nibblepack.pack(v)
+        assert nibblepack.packed_end(packed, 50) == len(packed)
+
+    def test_sparse(self):
+        v = np.zeros(64, dtype=np.uint64)
+        v[3] = 12345
+        v[40] = 2**50
+        out, _ = nibblepack.unpack(nibblepack.pack(v), 64)
+        assert np.array_equal(out, v)
+
+
+class TestDeltaDelta:
+    def test_regular_timestamps_collapse_to_const(self):
+        ts = np.arange(0, 720 * 10_000, 10_000, dtype=np.int64) + 1_600_000_000_000
+        blob = deltadelta.encode(ts)
+        assert blob[0] == WireType.CONST_LONG
+        assert len(blob) == 21
+        assert np.array_equal(deltadelta.decode(blob), ts)
+
+    def test_jittery_timestamps(self):
+        ts = np.cumsum(rng.integers(9_000, 11_000, 720)).astype(np.int64)
+        blob = deltadelta.encode(ts)
+        assert np.array_equal(deltadelta.decode(blob), ts)
+        assert len(blob) < 8 * 720  # beats raw encoding
+
+    def test_counter(self):
+        v = np.cumsum(rng.integers(0, 100, 500)).astype(np.int64)
+        assert np.array_equal(deltadelta.decode(deltadelta.encode(v)), v)
+
+    def test_negative_and_random(self):
+        v = rng.integers(-(2**40), 2**40, 300, dtype=np.int64)
+        assert np.array_equal(deltadelta.decode(deltadelta.encode(v)), v)
+
+    def test_empty_and_single(self):
+        assert len(deltadelta.decode(deltadelta.encode(np.array([], dtype=np.int64)))) == 0
+        one = np.array([42], dtype=np.int64)
+        assert np.array_equal(deltadelta.decode(deltadelta.encode(one)), one)
+
+    def test_num_values(self):
+        v = np.arange(99, dtype=np.int64)
+        assert deltadelta.num_values(deltadelta.encode(v)) == 99
+
+
+class TestDoubleCodec:
+    def test_integral_doubles_use_delta2(self):
+        v = np.cumsum(rng.integers(0, 50, 400)).astype(np.float64)
+        blob = doublecodec.encode(v)
+        assert blob[0] == WireType.DELTA2_DOUBLE
+        assert np.array_equal(doublecodec.decode(blob), v)
+
+    def test_const(self):
+        v = np.full(100, 3.5)
+        blob = doublecodec.encode(v)
+        assert blob[0] == WireType.CONST_DOUBLE
+        assert np.array_equal(doublecodec.decode(blob), v)
+
+    def test_gauge_roundtrip_bitexact(self):
+        v = rng.normal(100, 15, 500)
+        out = doublecodec.decode(doublecodec.encode(v))
+        assert np.array_equal(out.view(np.uint64), v.view(np.uint64))
+
+    def test_nan_sentinels_survive(self):
+        v = rng.normal(0, 1, 64)
+        v[[3, 17, 50]] = np.nan
+        out = doublecodec.decode(doublecodec.encode(v))
+        assert np.array_equal(np.isnan(out), np.isnan(v))
+        assert np.array_equal(out[~np.isnan(v)], v[~np.isnan(v)])
+
+    def test_num_values(self):
+        v = rng.normal(0, 1, 123)
+        assert doublecodec.num_values(doublecodec.encode(v)) == 123
+
+    def test_compression_on_slowly_varying(self):
+        # Gorilla-style XOR should beat raw on realistic gauges
+        v = 100.0 + np.cumsum(rng.normal(0, 0.01, 1000))
+        v = np.round(v, 2)
+        blob = doublecodec.encode(v)
+        assert len(blob) < 8 * 1000
+
+
+class TestHistCodec:
+    def test_roundtrip_geometric(self):
+        buckets = GeometricBuckets(2.0, 2.0, 16)
+        # cumulative increasing counters per bucket
+        incr = rng.integers(0, 10, (100, 16))
+        rows = np.cumsum(np.cumsum(incr, axis=1), axis=0).astype(np.int64)
+        blob = histcodec.encode(buckets, rows)
+        b2, rows2 = histcodec.decode(blob)
+        assert b2 == buckets
+        assert np.array_equal(rows2, rows)
+        assert histcodec.num_values(blob) == 100
+
+    def test_roundtrip_custom_le(self):
+        buckets = CustomBuckets(np.array([0.5, 1, 2.5, 5, 10, np.inf]))
+        rows = np.cumsum(rng.integers(0, 5, (40, 6)), axis=1).astype(np.int64)
+        rows = np.cumsum(rows, axis=0)
+        b2, rows2 = histcodec.decode(histcodec.encode(buckets, rows))
+        assert b2 == buckets
+        assert np.array_equal(rows2, rows)
+
+    def test_compression_factor(self):
+        # doc/compression.md claims ~50x vs bucket-per-series Prom model for
+        # 64-bucket histograms; assert a strong factor on sparse data (idle
+        # histograms collapse even further)
+        buckets = GeometricBuckets(1.0, 2.0, 64)
+        incr = np.zeros((128, 64), dtype=np.int64)
+        incr[:, 10] = 1
+        rows = np.cumsum(np.cumsum(incr, axis=1), axis=0)
+        blob = histcodec.encode(buckets, rows)
+        prom_model_bytes = 128 * 64 * 16  # ts+value per bucket-series
+        assert prom_model_bytes / len(blob) > 20
+        idle = np.repeat(rows[:1], 128, axis=0)
+        idle_blob = histcodec.encode(buckets, idle)
+        assert prom_model_bytes / len(idle_blob) > 50
+
+    def test_counter_reset_mid_stream(self):
+        buckets = GeometricBuckets(1.0, 2.0, 8)
+        rows = np.cumsum(np.cumsum(rng.integers(0, 4, (20, 8)), axis=1), axis=0)
+        rows[10:] = np.cumsum(np.cumsum(rng.integers(0, 4, (10, 8)), axis=1), axis=0)
+        rows = rows.astype(np.int64)
+        _, rows2 = histcodec.decode(histcodec.encode(buckets, rows))
+        assert np.array_equal(rows2, rows)
+
+
+class TestStrCodec:
+    def test_utf8_dense(self):
+        strs = [b"hello", b"", "wörld".encode(), b"x" * 300]
+        blob = strcodec.encode_utf8(strs)
+        assert strcodec.decode_utf8(blob) == strs
+
+    def test_dict_encoding_kicks_in(self):
+        strs = [b"api", b"web", b"api", b"db"] * 10
+        blob = strcodec.encode_utf8(strs)
+        assert blob[0] == WireType.DICT_UTF8
+        assert strcodec.decode_utf8(blob) == strs
+        dense = strcodec.encode_utf8_dense(strs)
+        assert len(blob) < len(dense)
+
+    @pytest.mark.parametrize("maxv", [1, 3, 15, 255, 65535, 2**31])
+    def test_nbit(self, maxv):
+        v = rng.integers(0, maxv + 1, 101, dtype=np.uint32)
+        out = strcodec.decode_nbit(strcodec.encode_nbit(v))
+        assert np.array_equal(out, v)
+
+
+class TestReviewRegressions:
+    """Regressions from verification/review probes."""
+
+    def test_wrong_wire_type_raises_valueerror(self):
+        blob = doublecodec.encode(np.array([1.5, 2.5]))
+        with pytest.raises(ValueError):
+            deltadelta.decode(blob)
+
+    def test_int64_extremes(self):
+        v = np.array([np.iinfo(np.int64).min, 0, np.iinfo(np.int64).max], dtype=np.int64)
+        assert np.array_equal(deltadelta.decode(deltadelta.encode(v)), v)
+
+    def test_negative_zero_keeps_sign_bit(self):
+        v = np.array([0.0, -0.0, 1.0])
+        out = doublecodec.decode(doublecodec.encode(v))
+        assert np.array_equal(np.signbit(out), np.signbit(v))
+
+    def test_huge_finite_doubles_no_warning(self):
+        import warnings
+        v = np.array([1e300, 2e300])
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            out = doublecodec.decode(doublecodec.encode(v))
+        assert np.array_equal(out, v)

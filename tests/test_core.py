@@ -1,0 +1,206 @@
+"""Schema / record / chunk / histogram model tests."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core import chunk as chunkmod
+from filodb_tpu.core.histogram import CustomBuckets, GeometricBuckets, Histogram, quantile_bulk
+from filodb_tpu.core.record import (RecordBuilder, canonical_partkey, decode_container,
+                                    parse_partkey, partition_hash, shard_key_hash)
+from filodb_tpu.core.schemas import (DEFAULT_SCHEMAS, ColumnType, DatasetOptions, Schemas,
+                                     DEFAULT_SCHEMA_CONFIG)
+
+rng = np.random.default_rng(7)
+
+
+class TestSchemas:
+    def test_default_schemas(self):
+        for name in ("gauge", "untyped", "prom-counter", "prom-histogram", "ds-gauge"):
+            assert DEFAULT_SCHEMAS.get(name) is not None
+        pc = DEFAULT_SCHEMAS["prom-counter"]
+        assert pc.data.columns[0].ctype == ColumnType.TIMESTAMP
+        assert pc.data.column("count").detect_drops
+        assert DEFAULT_SCHEMAS["prom-histogram"].data.column("h").ctype == ColumnType.HISTOGRAM
+
+    def test_hash_lookup(self):
+        g = DEFAULT_SCHEMAS["gauge"]
+        assert DEFAULT_SCHEMAS.by_hash(g.schema_hash) is g
+
+    def test_downsample_schema_links(self):
+        assert DEFAULT_SCHEMAS["gauge"].downsample.data.name == "ds-gauge"
+        assert DEFAULT_SCHEMAS["prom-counter"].downsample is None  # self-downsampling
+
+    def test_first_column_must_be_ts(self):
+        bad = {"bad": {"columns": ["value:double", "timestamp:ts"], "value-column": "value"}}
+        with pytest.raises(ValueError):
+            Schemas.from_config(bad)
+
+
+class TestPartKey:
+    TAGS = {"_metric_": "http_req_total", "_ws_": "demo", "_ns_": "App-0", "instance": "1"}
+
+    def test_canonical_roundtrip(self):
+        pk = canonical_partkey(self.TAGS)
+        assert parse_partkey(pk) == self.TAGS
+        # order-insensitive
+        assert canonical_partkey(dict(reversed(list(self.TAGS.items())))) == pk
+
+    def test_shard_key_hash_ignores_non_shard_tags(self):
+        opts = DatasetOptions()
+        t2 = dict(self.TAGS, instance="2")
+        assert shard_key_hash(self.TAGS, opts) == shard_key_hash(t2, opts)
+
+    def test_metric_suffix_trimming(self):
+        # _bucket/_count/_sum metrics hash with their base metric
+        opts = DatasetOptions()
+        base = dict(self.TAGS, _metric_="latency")
+        bucket = dict(self.TAGS, _metric_="latency_bucket")
+        assert shard_key_hash(base, opts) == shard_key_hash(bucket, opts)
+
+    def test_partition_hash_ignores_le(self):
+        opts = DatasetOptions()
+        with_le = dict(self.TAGS, le="0.5")
+        assert partition_hash(with_le, opts) == partition_hash(self.TAGS, opts)
+        t2 = dict(self.TAGS, instance="2")
+        assert partition_hash(t2, opts) != partition_hash(self.TAGS, opts)
+
+
+class TestRecords:
+    def test_container_roundtrip(self):
+        schema = DEFAULT_SCHEMAS["gauge"]
+        b = RecordBuilder(schema)
+        for i in range(100):
+            b.add(1000 + i * 10, (float(i),), {"_metric_": "m", "_ns_": "ns", "_ws_": "ws",
+                                               "pod": f"p{i % 5}"})
+        recs = []
+        for c in b.containers():
+            recs.extend(decode_container(c, DEFAULT_SCHEMAS))
+        assert len(recs) == 100
+        assert recs[7].timestamp == 1070
+        assert recs[7].values == (7.0,)
+        assert recs[7].tags["pod"] == "p2"
+        assert recs[7].schema_hash == schema.schema_hash
+        assert recs[7].shard_hash == shard_key_hash(recs[7].tags, DatasetOptions())
+
+    def test_container_size_splitting(self):
+        schema = DEFAULT_SCHEMAS["gauge"]
+        b = RecordBuilder(schema, container_size=1024)
+        for i in range(200):
+            b.add(i, (1.0,), {"_metric_": "m", "tag": "v" * 50})
+        cs = b.containers()
+        assert len(cs) > 1
+        total = sum(len(list(decode_container(c, DEFAULT_SCHEMAS))) for c in cs)
+        assert total == 200
+
+    def test_histogram_record(self):
+        schema = DEFAULT_SCHEMAS["prom-histogram"]
+        from filodb_tpu.codecs import histcodec
+        buckets = GeometricBuckets(2.0, 2.0, 8)
+        hist_blob = histcodec.encode(buckets, np.arange(8, dtype=np.int64)[None, :])
+        b = RecordBuilder(schema)
+        b.add(5000, (1.5, 10.0, hist_blob), {"_metric_": "lat"})
+        recs = list(decode_container(b.containers()[0], DEFAULT_SCHEMAS))
+        assert recs[0].values[0] == 1.5
+        _, rows = histcodec.decode(recs[0].values[2])
+        assert np.array_equal(rows[0], np.arange(8))
+
+
+class TestChunks:
+    def test_chunkset_roundtrip_gauge(self):
+        schema = DEFAULT_SCHEMAS["gauge"]
+        ts = np.arange(0, 300 * 10_000, 10_000, dtype=np.int64)
+        vals = rng.normal(50, 10, 300)
+        cs = chunkmod.encode_chunkset(schema, b"pk", ts, [vals])
+        assert cs.info.num_rows == 300
+        assert cs.info.start_time == 0 and cs.info.end_time == ts[-1]
+        ts2, (vals2,) = chunkmod.decode_chunkset(schema, cs)
+        assert np.array_equal(ts2, ts)
+        assert np.array_equal(vals2, vals)
+
+    def test_chunkset_histogram(self):
+        schema = DEFAULT_SCHEMAS["prom-histogram"]
+        buckets = GeometricBuckets(2.0, 2.0, 8)
+        n = 50
+        ts = np.arange(n, dtype=np.int64) * 1000
+        sums = np.cumsum(rng.random(n))
+        counts = np.arange(n, dtype=np.float64)
+        rows = np.cumsum(np.cumsum(rng.integers(0, 3, (n, 8)), axis=1), axis=0)
+        cs = chunkmod.encode_chunkset(schema, b"pk", ts, [sums, counts, (buckets, rows)])
+        ts2, cols = chunkmod.decode_chunkset(schema, cs)
+        assert np.array_equal(cols[0], sums)
+        b2, rows2 = cols[2]
+        assert np.array_equal(rows2, rows)
+
+    def test_build_batch_padding(self):
+        ts_list = [np.arange(5, dtype=np.int64), np.arange(9, dtype=np.int64)]
+        val_list = [np.ones(5), np.ones(9)]
+        batch = chunkmod.build_batch(ts_list, val_list, pad_to=8)
+        assert batch.timestamps.shape == (2, 16)
+        assert batch.timestamps[0, 5] == chunkmod.TS_PAD
+        assert np.isnan(batch.values[0, 5])
+        assert batch.row_counts.tolist() == [5, 9]
+
+    def test_chunk_id_ordering(self):
+        assert chunkmod.chunk_id(1000) < chunkmod.chunk_id(2000)
+        assert chunkmod.chunk_id(1000, 1) > chunkmod.chunk_id(1000, 0)
+
+
+class TestHistogramModel:
+    def test_quantile_interpolation(self):
+        buckets = CustomBuckets(np.array([1.0, 2.0, 4.0, np.inf]))
+        h = Histogram(buckets, np.array([0.0, 10.0, 10.0, 10.0]))
+        # all 10 observations in (1,2] -> median interpolates inside bucket 1
+        assert h.quantile(0.5) == pytest.approx(1.5)
+        # out-of-range q (reference: Histogram.quantile)
+        assert h.quantile(-0.1) == -np.inf
+        assert h.quantile(1.1) == np.inf
+
+    def test_quantile_inf_bucket(self):
+        buckets = CustomBuckets(np.array([1.0, 2.0, np.inf]))
+        h = Histogram(buckets, np.array([0.0, 0.0, 10.0]))
+        # everything in +Inf bucket -> second-to-last bucket top
+        assert h.quantile(0.99) == pytest.approx(2.0)
+
+    def test_quantile_bulk_matches_scalar(self):
+        tops = np.array([0.5, 1, 2.5, 5, 10, np.inf])
+        rows = np.cumsum(rng.integers(0, 5, (30, 6)), axis=1).astype(float)
+        bulk = quantile_bulk(tops, rows, 0.9)
+        buckets = CustomBuckets(tops)
+        for i in range(30):
+            assert bulk[i] == pytest.approx(Histogram(buckets, rows[i]).quantile(0.9), nan_ok=True)
+
+    def test_add_schema_mismatch(self):
+        h1 = Histogram(GeometricBuckets(1, 2, 4), np.ones(4))
+        h2 = Histogram(GeometricBuckets(1, 3, 4), np.ones(4))
+        with pytest.raises(ValueError):
+            h1 + h2
+
+    def test_geometric_1(self):
+        b = GeometricBuckets(2.0, 2.0, 3, starts_at_one=True)
+        assert b.bucket_tops().tolist() == [1.0, 2.0, 4.0, 8.0]
+
+
+class TestReviewRegressions:
+    def test_int_column_negative_values(self):
+        from filodb_tpu.core.schemas import Schemas
+        sc = Schemas.from_config({"ev": {"columns": ["timestamp:ts", "code:int"],
+                                         "value-column": "code"}})
+        s = sc["ev"]
+        ts = np.arange(4, dtype=np.int64)
+        cs = chunkmod.encode_chunkset(s, b"pk", ts, [np.array([-5, 3, -1, 7])])
+        _, (codes,) = chunkmod.decode_chunkset(s, cs)
+        assert codes.tolist() == [-5, 3, -1, 7]
+
+    def test_encode_chunkset_validates_lengths(self):
+        schema = DEFAULT_SCHEMAS["gauge"]
+        ts = np.arange(10, dtype=np.int64)
+        with pytest.raises(ValueError):
+            chunkmod.encode_chunkset(schema, b"pk", ts, [np.ones(6)])
+        with pytest.raises(ValueError):
+            chunkmod.encode_chunkset(schema, b"pk", ts, [])
+
+    def test_quantile_bulk_nan_rows_stay_nan(self):
+        tops = np.array([-1.0, 2.0, np.inf])
+        rows = np.array([[np.nan, np.nan, np.nan], [1.0, 2.0, 3.0]])
+        out = quantile_bulk(tops, rows, 0.5)
+        assert np.isnan(out[0]) and np.isfinite(out[1])
