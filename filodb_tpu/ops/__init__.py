@@ -1,0 +1,21 @@
+"""Device kernel library: windowed range functions + aggregations.
+
+The TPU-native replacement for the reference's per-row hot loops
+(ChunkedWindowIterator + RangeFunction + RowAggregator; reference:
+query/exec/PeriodicSamplesMapper.scala:184-459,
+query/exec/rangefn/RangeFunction.scala, query/exec/aggregator/*).
+
+Everything here is jit-compatible JAX operating on padded dense batches
+``[series, rows]`` with an output step grid ``[T]``:
+
+- window bounds come from vmapped ``searchsorted`` (replacing per-window
+  binarySearch/ceilingIndex);
+- O(1)-per-window functions (sum/count/avg/rate/stddev/changes/...) read
+  prefix-sum differences instead of iterating rows;
+- irregular functions (min/max/quantile/holt_winters/...) gather bounded
+  per-window row tiles and reduce along the tile axis;
+- cross-series grouping is a host-computed segment-id vector + on-device
+  segment reductions (psum-able across mesh shards).
+"""
+
+from filodb_tpu.ops import windows, aggregate  # noqa: F401

@@ -1,0 +1,162 @@
+"""Cross-series aggregation kernels: segment reductions over group ids.
+
+Replaces the reference's RowAggregator map/reduce family (reference:
+query/exec/aggregator/RowAggregator.scala:29,114-141 — Sum/Min/Max/Count/
+Avg/TopBottomK/Quantile/Stdvar/Stddev/CountValues) and the
+``fastReduce`` fixed-window-array path (exec/AggrOverRangeVectors.scala:
+151-277).  Grouping labels hash to segment ids on host
+(:func:`group_ids`); reductions run on device and compose with ``psum``
+over a mesh axis for cross-shard reduce (SURVEY.md §2.7 item 5).
+
+All kernels take ``vals [S, T]`` (series x steps), ``ids [S]`` int32 and a
+static ``num_groups`` and return ``[G, T]`` (or ``[G, k, T]`` for topk).
+NaN entries do not contribute.
+"""
+
+from __future__ import annotations
+
+from typing import Hashable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def group_ids(keys: Sequence[Hashable]) -> tuple[np.ndarray, list]:
+    """Host-side: map per-series grouping keys to dense segment ids.
+
+    Returns (ids [S] int32, unique keys in id order).  The unique keys become
+    the result RangeVectorKeys (reference: by/without grouping in
+    AggregateMapReduce, exec/AggrOverRangeVectors.scala:74-120).
+    """
+    index: dict[Hashable, int] = {}
+    ids = np.empty(len(keys), dtype=np.int32)
+    for i, k in enumerate(keys):
+        ids[i] = index.setdefault(k, len(index))
+    return ids, list(index.keys())
+
+
+def _fin(vals):
+    return jnp.isfinite(vals)
+
+
+def _sum_count(vals, ids, num_groups: int):
+    """(masked sum, finite count) — the shared core of sum/avg/count."""
+    fin = _fin(vals)
+    s = jax.ops.segment_sum(jnp.where(fin, vals, 0.0), ids, num_groups)
+    n = jax.ops.segment_sum(fin.astype(vals.dtype), ids, num_groups)
+    return s, n
+
+
+def seg_sum(vals, ids, num_groups: int):
+    s, n = _sum_count(vals, ids, num_groups)
+    return jnp.where(n > 0, s, jnp.nan)
+
+
+def seg_count(vals, ids, num_groups: int):
+    _, n = _sum_count(vals, ids, num_groups)
+    return jnp.where(n > 0, n, jnp.nan)
+
+
+def seg_min(vals, ids, num_groups: int):
+    m = jax.ops.segment_min(jnp.where(_fin(vals), vals, jnp.inf), ids, num_groups)
+    return jnp.where(jnp.isfinite(m), m, jnp.nan)
+
+
+def seg_max(vals, ids, num_groups: int):
+    m = jax.ops.segment_max(jnp.where(_fin(vals), vals, -jnp.inf), ids, num_groups)
+    return jnp.where(jnp.isfinite(m), m, jnp.nan)
+
+
+def seg_avg(vals, ids, num_groups: int):
+    return seg_mean_count(vals, ids, num_groups)[0]
+
+
+def seg_mean_count(vals, ids, num_groups: int):
+    """(mean, count) pair — the mergeable state the reference's AvgAggregator
+    carries across shards (mean+count columns)."""
+    s, n = _sum_count(vals, ids, num_groups)
+    return jnp.where(n > 0, s / jnp.maximum(n, 1.0), jnp.nan), n
+
+
+def seg_stdvar(vals, ids, num_groups: int):
+    fin = _fin(vals)
+    s1 = jax.ops.segment_sum(jnp.where(fin, vals, 0.0), ids, num_groups)
+    s2 = jax.ops.segment_sum(jnp.where(fin, vals * vals, 0.0), ids, num_groups)
+    n = jax.ops.segment_sum(fin.astype(vals.dtype), ids, num_groups)
+    nsafe = jnp.maximum(n, 1.0)
+    mean = s1 / nsafe
+    var = jnp.maximum(s2 / nsafe - mean * mean, 0.0)
+    return jnp.where(n > 0, var, jnp.nan)
+
+
+def seg_stddev(vals, ids, num_groups: int):
+    return jnp.sqrt(seg_stdvar(vals, ids, num_groups))
+
+
+def seg_topk(vals, ids, num_groups: int, k: int, bottom: bool = False,
+             max_group_size: int | None = None):
+    """Per-group per-step top/bottom-k (reference TopBottomKAggregator).
+
+    Returns (values [G,k,T], series_index [G,k,T] int32; index -1 / NaN value
+    where the group has fewer than k live series at that step).
+
+    Formulation: scatter series into a dense ``[G, M, T]`` cube by
+    position-within-group (computed in-graph via a stable argsort + running
+    count), then a single ``lax.top_k`` over the member axis.  ``M`` defaults
+    to S; pass ``max_group_size`` to shrink the cube when group sizes are
+    known on host.
+    """
+    S, T = vals.shape
+    M = S if max_group_size is None else max_group_size
+    order = jnp.argsort(ids, stable=True)
+    sids = ids[order]
+    arange_s = jnp.arange(S, dtype=jnp.int32)
+    newg = jnp.concatenate([jnp.ones((1,), bool), sids[1:] != sids[:-1]])
+    gstart = jax.lax.cummax(jnp.where(newg, arange_s, 0))
+    pos = arange_s - gstart                      # position within group
+    sentinel = -jnp.inf
+    sign = -1.0 if bottom else 1.0
+    dense = jnp.full((num_groups, M, T), sentinel, vals.dtype)
+    svals = jnp.where(_fin(vals), vals, jnp.nan)[order] * sign
+    dense = dense.at[sids, pos].set(jnp.where(jnp.isnan(svals), sentinel, svals))
+    smap = jnp.full((num_groups, M), -1, jnp.int32).at[sids, pos].set(
+        order.astype(jnp.int32))
+    work = jnp.moveaxis(dense, 1, 2)             # [G, T, M]
+    keff = min(k, M)
+    topv, topm = jax.lax.top_k(work, keff)       # [G, T, keff]
+    if keff < k:  # pad out to the requested k with empty slots
+        pad = ((0, 0), (0, 0), (0, k - keff))
+        topv = jnp.pad(topv, pad, constant_values=-jnp.inf)
+        topm = jnp.pad(topm, pad, constant_values=0)
+    found = jnp.isfinite(topv)
+    topsi = jnp.take_along_axis(smap[:, None, :], topm, axis=2)
+    values = jnp.where(found, topv * sign, jnp.nan)
+    indices = jnp.where(found, topsi, -1)
+    return jnp.moveaxis(values, 1, 2), jnp.moveaxis(indices, 1, 2)  # [G,k,T]
+
+
+def seg_quantile(vals, ids, num_groups: int, q: float):
+    """Exact per-group quantile via a masked [G,S,T] expansion.  The engine
+    enforces the reference's group-by cardinality limit (filodb-defaults
+    ``group-by-cardinality-limit`` = 1000) so G stays bounded; the reference
+    itself approximates with t-digest (QuantileAggregator) — exact here."""
+    S, T = vals.shape
+    mask = ids[None, :] == jnp.arange(num_groups, dtype=ids.dtype)[:, None]  # [G,S]
+    expanded = jnp.where(mask[:, :, None], vals[None, :, :], jnp.nan)
+    return jnp.nanquantile(expanded, q, axis=1)
+
+
+def absent(vals):
+    """1.0 at steps where no series has a value (reference AbsentFunctionMapper)."""
+    any_present = jnp.isfinite(vals).any(axis=0)
+    return jnp.where(any_present, jnp.nan, 1.0)
+
+
+def seg_hist_sum(hist, ids, num_groups: int):
+    """Sum histograms bucket-wise: hist [S,T,B] -> [G,T,B] (reference
+    HistSumAggregator; bucket-schema mismatch handled upstream)."""
+    fin = jnp.isfinite(hist)
+    s = jax.ops.segment_sum(jnp.where(fin, hist, 0.0), ids, num_groups)
+    n = jax.ops.segment_sum(fin.astype(hist.dtype), ids, num_groups)
+    return jnp.where(n > 0, s, jnp.nan)

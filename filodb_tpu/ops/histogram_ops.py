@@ -1,0 +1,84 @@
+"""Histogram device kernels: per-bucket rate, quantile, bucket extraction.
+
+Replaces the reference's histogram range functions and
+HistogramQuantileMapper (reference: rangefn/RangeFunction.scala:376-377 hist
+rate/increase, exec/HistogramQuantileMapper.scala:22, rangefn/
+AggrOverTimeFunctions.scala SumOverTimeChunkedFunctionH).  Histogram batches
+are dense ``[S, R, B]`` cumulative-bucket matrices; all bucket math is
+vectorized over B.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from filodb_tpu.ops import windows as W
+
+
+def _per_bucket(fn, ts, hist, *args):
+    """vmap a scalar-series kernel over the bucket axis: hist [S,R,B]."""
+    vb = jnp.moveaxis(hist, 2, 0)  # [B,S,R]
+    out = jax.vmap(lambda v: fn(ts, v, *args))(vb)  # [B,S,T]
+    return jnp.moveaxis(out, 0, 2)  # [S,T,B]
+
+
+def hist_rate(ts, hist, steps, window):
+    """Per-bucket Prometheus rate with counter correction (reference
+    HistRateFunction)."""
+    return _per_bucket(lambda t, v: W.rate(t, v, steps, window), ts, hist)
+
+
+def hist_increase(ts, hist, steps, window):
+    return _per_bucket(lambda t, v: W.increase(t, v, steps, window), ts, hist)
+
+
+def hist_sum_over_time(ts, hist, steps, window):
+    return _per_bucket(lambda t, v: W.sum_over_time(t, v, steps, window), ts, hist)
+
+
+def hist_last_sample(ts, hist, steps, window):
+    """Last histogram in window (instant selector for hist columns)."""
+    return _per_bucket(lambda t, v: W.last_sample(t, v, steps, window)[0], ts, hist)
+
+
+def hist_quantile(tops, hist, q):
+    """histogram_quantile over dense bucket matrices [..., B] on device.
+
+    Same interpolation contract as core.histogram.quantile_bulk (reference:
+    memory/.../vectors/Histogram.scala:59-76): linear inside the located
+    bucket, second-to-last top for the +Inf bucket, NaN for empty/NaN rows.
+    """
+    B = tops.shape[0]
+    total = hist[..., -1]
+    rank = q * total
+    idx = jnp.sum(hist < rank[..., None], axis=-1)
+    idx = jnp.minimum(idx, B - 1)
+    count_at = jnp.take_along_axis(hist, idx[..., None], axis=-1)[..., 0]
+    below_idx = jnp.maximum(idx - 1, 0)
+    count_below = jnp.where(idx > 0,
+                            jnp.take_along_axis(hist, below_idx[..., None], axis=-1)[..., 0],
+                            0.0)
+    top = tops[idx]
+    bottom = jnp.where(idx > 0, tops[below_idx], 0.0)
+    interp = bottom + (top - bottom) * (rank - count_below) / (count_at - count_below)
+    out = jnp.where(idx == B - 1, tops[B - 2], interp)
+    out = jnp.where((idx == 0) & (tops[0] <= 0), tops[0], out)
+    out = jnp.where(jnp.isnan(total), jnp.nan, out)
+    return jnp.where(q < 0, -jnp.inf, jnp.where(q > 1, jnp.inf, out))
+
+
+def hist_max_quantile(tops, hist, maxes, q):
+    """histogram_max_quantile: clamp to the observed max column (reference
+    hist-max schema handling in MultiSchemaPartitionsExec)."""
+    base = hist_quantile(tops, hist, q)
+    return jnp.where(jnp.isfinite(maxes) & (base > maxes), maxes, base)
+
+
+def hist_bucket(tops, hist, le):
+    """histogram_bucket: extract one bucket as a plain series (reference
+    InstantFunctionId.HistogramBucket)."""
+    match = jnp.isclose(tops, le) | (jnp.isinf(tops) & jnp.isinf(jnp.asarray(le)))
+    idx = jnp.argmax(match)
+    found = match.any()
+    return jnp.where(found, hist[..., idx], jnp.nan)

@@ -1,0 +1,194 @@
+"""Instant (sample-wise) functions and binary operators.
+
+Replaces the reference's InstantFunction family and ScalarOperationMapper
+math (reference: query/exec/rangefn/InstantFunction.scala:81-110,
+query/exec/rangefn/BinaryOperatorFunction.scala).  All are elementwise jnp
+ops over ``[S, T]`` arrays — XLA fuses them into whatever kernel produced
+the input, so they are effectively free on device.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _days_in_month(year, month):
+    thirty_one = (month == 1) | (month == 3) | (month == 5) | (month == 7) | \
+                 (month == 8) | (month == 10) | (month == 12)
+    thirty = (month == 4) | (month == 6) | (month == 9) | (month == 11)
+    leap = ((year % 4 == 0) & (year % 100 != 0)) | (year % 400 == 0)
+    return jnp.where(thirty_one, 31, jnp.where(thirty, 30, jnp.where(leap, 29, 28)))
+
+
+def _civil_from_days(z):
+    """days-since-epoch -> (year, month, day); Howard Hinnant's algorithm."""
+    z = z + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def _ymd(v):
+    secs = v.astype(jnp.int64) if v.dtype != jnp.int64 else v
+    days = jnp.floor_divide(secs, 86400)
+    return _civil_from_days(days)
+
+
+INSTANT_FUNCTIONS = {}
+
+
+def _register(name):
+    def deco(fn):
+        INSTANT_FUNCTIONS[name] = fn
+        return fn
+    return deco
+
+
+@_register("abs")
+def abs_(v):
+    return jnp.abs(v)
+
+
+@_register("ceil")
+def ceil(v):
+    return jnp.ceil(v)
+
+
+@_register("floor")
+def floor(v):
+    return jnp.floor(v)
+
+
+@_register("exp")
+def exp(v):
+    return jnp.exp(v)
+
+
+@_register("ln")
+def ln(v):
+    return jnp.log(v)
+
+
+@_register("log2")
+def log2(v):
+    return jnp.log2(v)
+
+
+@_register("log10")
+def log10(v):
+    return jnp.log10(v)
+
+
+@_register("sqrt")
+def sqrt(v):
+    return jnp.sqrt(v)
+
+
+@_register("round")
+def round_(v, to_nearest=1.0):
+    # Prometheus round(): half away from... actually half rounds up
+    return jnp.floor(v / to_nearest + 0.5) * to_nearest
+
+
+@_register("clamp_max")
+def clamp_max(v, mx):
+    return jnp.minimum(v, mx)
+
+
+@_register("clamp_min")
+def clamp_min(v, mn):
+    return jnp.maximum(v, mn)
+
+
+@_register("sgn")
+def sgn(v):
+    return jnp.sign(v)
+
+
+@_register("year")
+def year(v):
+    y, _, _ = _ymd(jnp.where(jnp.isnan(v), 0.0, v))
+    return jnp.where(jnp.isnan(v), jnp.nan, y.astype(jnp.float64))
+
+
+@_register("month")
+def month(v):
+    _, m, _ = _ymd(jnp.where(jnp.isnan(v), 0.0, v))
+    return jnp.where(jnp.isnan(v), jnp.nan, m.astype(jnp.float64))
+
+
+@_register("day_of_month")
+def day_of_month(v):
+    _, _, d = _ymd(jnp.where(jnp.isnan(v), 0.0, v))
+    return jnp.where(jnp.isnan(v), jnp.nan, d.astype(jnp.float64))
+
+
+@_register("day_of_week")
+def day_of_week(v):
+    secs = jnp.where(jnp.isnan(v), 0.0, v).astype(jnp.int64)
+    days = jnp.floor_divide(secs, 86400)
+    return jnp.where(jnp.isnan(v), jnp.nan, ((days + 4) % 7).astype(jnp.float64))
+
+
+@_register("hour")
+def hour(v):
+    secs = jnp.where(jnp.isnan(v), 0.0, v).astype(jnp.int64)
+    return jnp.where(jnp.isnan(v), jnp.nan, ((secs % 86400) // 3600).astype(jnp.float64))
+
+
+@_register("minute")
+def minute(v):
+    secs = jnp.where(jnp.isnan(v), 0.0, v).astype(jnp.int64)
+    return jnp.where(jnp.isnan(v), jnp.nan, ((secs % 3600) // 60).astype(jnp.float64))
+
+
+@_register("days_in_month")
+def days_in_month(v):
+    y, m, _ = _ymd(jnp.where(jnp.isnan(v), 0.0, v))
+    return jnp.where(jnp.isnan(v), jnp.nan, _days_in_month(y, m).astype(jnp.float64))
+
+
+# --------------------------------------------------------------------------
+# Binary operators (scalar-vector and vector-vector)
+# --------------------------------------------------------------------------
+
+BINARY_OPERATORS = {
+    "ADD": jnp.add,
+    "SUB": jnp.subtract,
+    "MUL": jnp.multiply,
+    "DIV": jnp.divide,
+    "MOD": jnp.mod,
+    "POW": jnp.power,
+}
+
+_COMPARISON = {
+    "EQL": lambda a, b: a == b,
+    "NEQ": lambda a, b: a != b,
+    "GTR": lambda a, b: a > b,
+    "LSS": lambda a, b: a < b,
+    "GTE": lambda a, b: a >= b,
+    "LTE": lambda a, b: a <= b,
+}
+
+
+def apply_binary(op: str, lhs, rhs, bool_mode: bool = False):
+    """PromQL binary operator semantics: comparisons filter (keep lhs value)
+    unless ``bool`` modifier, which yields 0/1 (reference
+    BinaryOperatorFunction)."""
+    if op in BINARY_OPERATORS:
+        return BINARY_OPERATORS[op](lhs, rhs)
+    if op.endswith("_BOOL"):
+        op, bool_mode = op[:-5], True
+    cmp = _COMPARISON[op](lhs, rhs)
+    both = jnp.isfinite(lhs) if jnp.ndim(lhs) else jnp.ones_like(cmp, dtype=bool)
+    if bool_mode:
+        out = jnp.where(cmp, 1.0, 0.0)
+        return jnp.where(jnp.isnan(lhs) | jnp.isnan(rhs), jnp.nan, out)
+    return jnp.where(cmp & both, lhs, jnp.nan)

@@ -1,0 +1,165 @@
+"""Brute-force numpy oracle for windowed range functions.
+
+Independent, per-series, per-window loop implementation of the Prometheus /
+reference semantics (window = (t-w, t]; extrapolatedRate per
+RateFunctions.scala) used to validate the vectorized device kernels —
+mirrors the reference's test strategy of comparing chunked vs sliding vs
+brute force (AggrOverTimeFunctionsSpec)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def window_indices(ts: np.ndarray, t: int, window: int) -> np.ndarray:
+    return np.nonzero((ts > t - window) & (ts <= t))[0]
+
+
+def counter_correct(vals: np.ndarray) -> np.ndarray:
+    out = vals.astype(np.float64).copy()
+    corr = 0.0
+    prev_raw = None
+    for i in range(len(vals)):
+        if prev_raw is not None and vals[i] < prev_raw:
+            corr += prev_raw
+        out[i] = vals[i] + corr
+        prev_raw = vals[i]
+    return out
+
+
+def extrapolated_rate(wstart, wend, ts_w, vals_w, is_counter, is_rate):
+    n = len(ts_w)
+    if n < 2:
+        return np.nan
+    t1, t2 = ts_w[0], ts_w[-1]
+    v1, v2 = vals_w[0], vals_w[-1]
+    dur_start = (t1 - wstart) / 1000.0
+    dur_end = (wend - t2) / 1000.0
+    sampled = (t2 - t1) / 1000.0
+    if sampled <= 0:
+        return np.nan
+    avg_dur = sampled / (n - 1)
+    delta = v2 - v1
+    if is_counter and delta > 0 and v1 >= 0:
+        dur_zero = sampled * (v1 / delta)
+        if dur_zero < dur_start:
+            dur_start = dur_zero
+    thresh = avg_dur * 1.1
+    extrap = sampled
+    extrap += dur_start if dur_start < thresh else avg_dur / 2
+    extrap += dur_end if dur_end < thresh else avg_dur / 2
+    scaled = delta * (extrap / sampled)
+    if is_rate:
+        return scaled / (wend - wstart) * 1000.0
+    return scaled
+
+
+def range_fn(name: str, ts: np.ndarray, vals: np.ndarray, start: int, end: int,
+             step: int, window: int, **params) -> np.ndarray:
+    """Evaluate one range function for one series over the step grid."""
+    steps = np.arange(start, end + 1, step)
+    out = np.full(len(steps), np.nan)
+    corrected = counter_correct(vals) if name in ("rate", "increase", "irate") else vals
+    for j, t in enumerate(steps):
+        w = window_indices(ts, t, window)
+        vw = vals[w]
+        cw = corrected[w]
+        fin = np.isfinite(vw)
+        if name in ("rate", "increase", "delta"):
+            # NaN rows are "no sample": boundaries come from finite samples
+            wf = w[fin]
+            if len(wf) >= 2:
+                out[j] = extrapolated_rate(t - window, t, ts[wf], corrected[wf],
+                                           is_counter=name != "delta",
+                                           is_rate=name == "rate")
+        elif name in ("irate", "idelta"):
+            wf = w[fin]
+            if len(wf) >= 2:
+                dt = (ts[wf][-1] - ts[wf][-2]) / 1000.0
+                dv = corrected[wf][-1] - corrected[wf][-2]
+                out[j] = dv / dt if name == "irate" and dt > 0 else (
+                    dv if name == "idelta" else np.nan)
+        elif name == "sum_over_time":
+            if fin.any():
+                out[j] = np.sum(vw[fin])
+        elif name == "count_over_time":
+            if fin.any():
+                out[j] = fin.sum()
+        elif name == "avg_over_time":
+            if fin.any():
+                out[j] = np.mean(vw[fin])
+        elif name == "min_over_time":
+            if fin.any():
+                out[j] = np.min(vw[fin])
+        elif name == "max_over_time":
+            if fin.any():
+                out[j] = np.max(vw[fin])
+        elif name == "stdvar_over_time":
+            if fin.any():
+                out[j] = np.var(vw[fin])
+        elif name == "stddev_over_time":
+            if fin.any():
+                out[j] = np.std(vw[fin])
+        elif name == "changes":
+            if fin.any():
+                c = 0
+                for i in range(1, len(w)):
+                    a, b = vals[w[i - 1]], vals[w[i]]
+                    if np.isfinite(a) and np.isfinite(b) and a != b:
+                        c += 1
+                out[j] = c
+        elif name == "resets":
+            if fin.any():
+                c = 0
+                for i in range(1, len(w)):
+                    if vals[w[i]] < vals[w[i - 1]]:
+                        c += 1
+                out[j] = c
+        elif name == "last":
+            fi = np.nonzero(fin)[0]
+            if len(fi):
+                out[j] = vw[fi[-1]]
+        elif name == "timestamp":
+            fi = np.nonzero(fin)[0]
+            if len(fi):
+                out[j] = ts[w][fi[-1]] / 1000.0
+        elif name == "quantile_over_time":
+            if fin.any():
+                out[j] = np.quantile(vw[fin], params["q"])
+        elif name == "deriv":
+            if fin.sum() >= 2:
+                x = (ts[w][fin] - t) / 1000.0
+                y = vw[fin]
+                if np.var(x) > 0:
+                    slope = np.cov(x, y, bias=True)[0, 1] / np.var(x)
+                    out[j] = slope
+        elif name == "predict_linear":
+            if fin.sum() >= 2:
+                x = (ts[w][fin] - t) / 1000.0
+                y = vw[fin]
+                if np.var(x) > 0:
+                    slope = np.cov(x, y, bias=True)[0, 1] / np.var(x)
+                    intercept = y.mean() - slope * x.mean()
+                    out[j] = intercept + slope * params["duration_s"]
+        elif name == "z_score":
+            fi = np.nonzero(fin)[0]
+            if len(fi):
+                sd = np.std(vw[fin])
+                out[j] = (vw[fi[-1]] - np.mean(vw[fin])) / sd
+        elif name == "holt_winters":
+            y = vw[fin]
+            if len(y) >= 2:
+                sf, tf = params["sf"], params["tf"]
+                s, b = y[0], y[1] - y[0]
+                for i in range(1, len(y)):
+                    x = sf * y[i] + (1 - sf) * (s + b)
+                    b = tf * (x - s) + (1 - tf) * b
+                    s = x
+                out[j] = s
+        elif name == "mad_over_time":
+            if fin.any():
+                med = np.quantile(vw[fin], 0.5)
+                out[j] = np.quantile(np.abs(vw[fin] - med), 0.5)
+        else:
+            raise ValueError(f"unknown oracle function {name}")
+    return out

@@ -1,0 +1,126 @@
+"""Segment-aggregation kernels vs numpy groupby oracle (model: the
+reference's AggrOverRangeVectorsSpec)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from filodb_tpu.ops import aggregate as agg
+
+rng = np.random.default_rng(31)
+
+S, T, G = 40, 12, 5
+VALS = rng.normal(10, 5, (S, T))
+VALS[rng.random((S, T)) < 0.1] = np.nan
+IDS = rng.integers(0, G, S).astype(np.int32)
+VJ, IJ = jnp.asarray(VALS), jnp.asarray(IDS)
+
+
+def oracle_group(op):
+    out = np.full((G, T), np.nan)
+    for g in range(G):
+        rows = VALS[IDS == g]
+        for t in range(T):
+            col = rows[:, t]
+            col = col[np.isfinite(col)]
+            if len(col):
+                out[g, t] = op(col)
+    return out
+
+
+class TestSegmentAggregators:
+    def test_sum(self):
+        np.testing.assert_allclose(np.asarray(agg.seg_sum(VJ, IJ, G)),
+                                   oracle_group(np.sum), rtol=1e-9, equal_nan=True)
+
+    def test_count(self):
+        np.testing.assert_allclose(np.asarray(agg.seg_count(VJ, IJ, G)),
+                                   oracle_group(len), equal_nan=True)
+
+    def test_min_max(self):
+        np.testing.assert_allclose(np.asarray(agg.seg_min(VJ, IJ, G)),
+                                   oracle_group(np.min), equal_nan=True)
+        np.testing.assert_allclose(np.asarray(agg.seg_max(VJ, IJ, G)),
+                                   oracle_group(np.max), equal_nan=True)
+
+    def test_avg(self):
+        np.testing.assert_allclose(np.asarray(agg.seg_avg(VJ, IJ, G)),
+                                   oracle_group(np.mean), rtol=1e-9, equal_nan=True)
+
+    def test_stdvar_stddev(self):
+        np.testing.assert_allclose(np.asarray(agg.seg_stdvar(VJ, IJ, G)),
+                                   oracle_group(np.var), rtol=1e-6, equal_nan=True)
+        np.testing.assert_allclose(np.asarray(agg.seg_stddev(VJ, IJ, G)),
+                                   oracle_group(np.std), rtol=1e-6, equal_nan=True)
+
+    def test_quantile(self):
+        got = np.asarray(agg.seg_quantile(VJ, IJ, G, 0.75))
+        np.testing.assert_allclose(got, oracle_group(lambda c: np.quantile(c, 0.75)),
+                                   rtol=1e-9, equal_nan=True)
+
+    def test_group_ids(self):
+        keys = [("a",), ("b",), ("a",), ("c",), ("b",)]
+        ids, uniq = agg.group_ids(keys)
+        assert ids.tolist() == [0, 1, 0, 2, 1]
+        assert uniq == [("a",), ("b",), ("c",)]
+
+    def test_single_group(self):
+        ids = np.zeros(S, dtype=np.int32)
+        got = np.asarray(agg.seg_sum(VJ, jnp.asarray(ids), 1))
+        expect = np.nansum(VALS, axis=0)
+        np.testing.assert_allclose(got[0], expect, rtol=1e-9)
+
+
+class TestTopK:
+    def test_topk_values_and_indices(self):
+        k = 3
+        vals, idx = agg.seg_topk(VJ, IJ, G, k)
+        vals, idx = np.asarray(vals), np.asarray(idx)
+        assert vals.shape == (G, k, T) and idx.shape == (G, k, T)
+        for g in range(G):
+            members = np.nonzero(IDS == g)[0]
+            for t in range(T):
+                col = VALS[members, t]
+                fin = np.isfinite(col)
+                expect = np.sort(col[fin])[::-1][:k]
+                got = vals[g, :, t]
+                got = got[np.isfinite(got)]
+                np.testing.assert_allclose(got, expect)
+                # indices point at series holding those values
+                for r, v in enumerate(got):
+                    assert VALS[idx[g, r, t], t] == v
+                    assert IDS[idx[g, r, t]] == g
+
+    def test_bottomk(self):
+        k = 2
+        vals, _ = agg.seg_topk(VJ, IJ, G, k, bottom=True)
+        vals = np.asarray(vals)
+        for g in range(G):
+            col = VALS[IDS == g][:, 0]
+            fin = col[np.isfinite(col)]
+            expect = np.sort(fin)[:k]
+            got = vals[g, :, 0]
+            np.testing.assert_allclose(got[np.isfinite(got)], expect)
+
+    def test_k_larger_than_group(self):
+        ids = np.zeros(3, dtype=np.int32)
+        v = jnp.asarray(rng.normal(0, 1, (3, 2)))
+        vals, idx = agg.seg_topk(v, jnp.asarray(ids), 1, 5)
+        vals, idx = np.asarray(vals), np.asarray(idx)
+        assert np.isnan(vals[0, 3:, :]).all()
+        assert (idx[0, 3:, :] == -1).all()
+
+
+class TestAbsentAndHist:
+    def test_absent(self):
+        v = np.full((3, 4), np.nan)
+        v[1, 2] = 5.0
+        out = np.asarray(agg.absent(jnp.asarray(v)))
+        assert np.isnan(out[2]) and out[0] == 1.0 and out[1] == 1.0
+
+    def test_hist_sum(self):
+        B = 4
+        h = rng.random((S, T, B))
+        ids = IDS
+        got = np.asarray(agg.seg_hist_sum(jnp.asarray(h), IJ, G))
+        for g in range(G):
+            np.testing.assert_allclose(got[g], h[IDS == g].sum(axis=0), rtol=1e-9)

@@ -1,0 +1,218 @@
+"""Windowed range-function kernels vs the brute-force numpy oracle.
+
+Data is irregular (jittered intervals, missing samples, NaNs, counter
+resets, ragged series lengths) to exercise searchsorted bounds, padding and
+correction — the conditions SURVEY.md §7 calls the hard parts."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import oracle
+from filodb_tpu.core.chunk import build_batch
+from filodb_tpu.ops import windows as W
+
+rng = np.random.default_rng(123)
+
+START, END, STEP, WINDOW = 1_000_000, 1_360_000, 15_000, 60_000
+STEPS = np.arange(START, END + 1, STEP)
+
+
+def make_series(n, kind="gauge", with_nans=False, seed=0):
+    r = np.random.default_rng(seed)
+    ts = START - WINDOW + np.sort(r.choice(np.arange(0, END - START + 2 * WINDOW, 1000),
+                                           size=n, replace=False))
+    if kind == "counter":
+        vals = np.cumsum(r.integers(0, 50, n)).astype(np.float64)
+        # inject resets left-to-right so counters stay non-negative
+        for pos in np.sort(r.choice(n, size=max(1, n // 40), replace=False)):
+            vals[pos:] = vals[pos:] - vals[pos] + r.integers(0, 5)
+    else:
+        vals = r.normal(100, 25, n)
+    if with_nans:
+        vals[r.choice(n, size=n // 10, replace=False)] = np.nan
+    return ts.astype(np.int64), vals
+
+
+def batch_of(series):
+    ts_list = [s[0] for s in series]
+    val_list = [s[1] for s in series]
+    return build_batch(ts_list, val_list, pad_to=64)
+
+
+def check(kernel_out, series, fn_name, rtol=1e-9, **params):
+    for i, (ts, vals) in enumerate(series):
+        expect = oracle.range_fn(fn_name, ts, vals, START, END, STEP, WINDOW, **params)
+        got = np.asarray(kernel_out[i])
+        np.testing.assert_allclose(got, expect, rtol=rtol, atol=1e-9, equal_nan=True,
+                                   err_msg=f"series {i} fn {fn_name}")
+
+
+@pytest.fixture(scope="module")
+def gauge_series():
+    return [make_series(n, "gauge", with_nans=(i % 2 == 0), seed=i)
+            for i, n in enumerate([50, 80, 120, 30, 7, 2])]
+
+
+@pytest.fixture(scope="module")
+def counter_series():
+    return [make_series(n, "counter", seed=100 + i) for i, n in enumerate([60, 90, 150, 10, 3])]
+
+
+@pytest.fixture(scope="module")
+def gauge_batch(gauge_series):
+    b = batch_of(gauge_series)
+    return jnp.asarray(b.timestamps), jnp.asarray(b.values)
+
+
+@pytest.fixture(scope="module")
+def counter_batch(counter_series):
+    b = batch_of(counter_series)
+    return jnp.asarray(b.timestamps), jnp.asarray(b.values)
+
+
+STEPS_J = jnp.asarray(STEPS)
+
+
+class TestPrefixPath:
+    def test_sum_over_time(self, gauge_batch, gauge_series):
+        ts, vals = gauge_batch
+        check(W.sum_over_time(ts, vals, STEPS_J, WINDOW), gauge_series, "sum_over_time")
+
+    def test_count_over_time(self, gauge_batch, gauge_series):
+        ts, vals = gauge_batch
+        check(W.count_over_time(ts, vals, STEPS_J, WINDOW), gauge_series, "count_over_time")
+
+    def test_avg_over_time(self, gauge_batch, gauge_series):
+        ts, vals = gauge_batch
+        check(W.avg_over_time(ts, vals, STEPS_J, WINDOW), gauge_series, "avg_over_time")
+
+    def test_stddev_stdvar(self, gauge_batch, gauge_series):
+        ts, vals = gauge_batch
+        check(W.stdvar_over_time(ts, vals, STEPS_J, WINDOW), gauge_series,
+              "stdvar_over_time", rtol=1e-6)
+        check(W.stddev_over_time(ts, vals, STEPS_J, WINDOW), gauge_series,
+              "stddev_over_time", rtol=1e-6)
+
+    def test_changes(self, gauge_batch, gauge_series):
+        ts, vals = gauge_batch
+        check(W.changes_over_time(ts, vals, STEPS_J, WINDOW), gauge_series, "changes")
+
+    def test_resets(self, counter_batch, counter_series):
+        ts, vals = counter_batch
+        check(W.resets_over_time(ts, vals, STEPS_J, WINDOW), counter_series, "resets")
+
+    def test_last_sample(self, gauge_batch, gauge_series):
+        ts, vals = gauge_batch
+        got, _ = W.last_sample(ts, vals, STEPS_J, WINDOW)
+        check(got, gauge_series, "last")
+
+    def test_timestamp(self, gauge_batch, gauge_series):
+        ts, vals = gauge_batch
+        check(W.timestamp_fn(ts, vals, STEPS_J, WINDOW), gauge_series, "timestamp")
+
+    def test_z_score(self, gauge_batch, gauge_series):
+        ts, vals = gauge_batch
+        check(W.z_score(ts, vals, STEPS_J, WINDOW), gauge_series, "z_score", rtol=1e-6)
+
+
+class TestRateFamily:
+    def test_rate(self, counter_batch, counter_series):
+        ts, vals = counter_batch
+        check(W.rate(ts, vals, STEPS_J, WINDOW), counter_series, "rate", rtol=1e-9)
+
+    def test_increase(self, counter_batch, counter_series):
+        ts, vals = counter_batch
+        check(W.increase(ts, vals, STEPS_J, WINDOW), counter_series, "increase")
+
+    def test_delta(self, gauge_batch, gauge_series):
+        # delta applies to gauges without counter correction; NaN samples at
+        # window boundaries must be skipped (finite-sample bounds)
+        ts, vals = gauge_batch
+        check(W.delta_fn(ts, vals, STEPS_J, WINDOW), gauge_series, "delta")
+
+    def test_rate_with_nan_samples(self):
+        # counters with injected NaN gaps: boundary samples must skip NaN
+        series = []
+        for i, n in enumerate([60, 90]):
+            ts, vals = make_series(n, "counter", seed=300 + i)
+            vals[np.random.default_rng(i).choice(n, n // 8, replace=False)] = np.nan
+            series.append((ts, vals))
+        b = batch_of(series)
+        ts, vals = jnp.asarray(b.timestamps), jnp.asarray(b.values)
+        check(W.rate(ts, vals, STEPS_J, WINDOW), series, "rate")
+        check(W.irate(ts, vals, STEPS_J, WINDOW), series, "irate")
+
+    def test_irate_idelta(self, counter_series):
+        b = batch_of(counter_series)
+        ts, vals = jnp.asarray(b.timestamps), jnp.asarray(b.values)
+        check(W.irate(ts, vals, STEPS_J, WINDOW), counter_series, "irate")
+        check(W.idelta(ts, vals, STEPS_J, WINDOW), counter_series, "idelta")
+
+    def test_counter_correction_matches_oracle(self, counter_series):
+        for ts, vals in counter_series:
+            got = np.asarray(W.counter_correct(jnp.asarray(vals[None, :])))[0]
+            np.testing.assert_allclose(got, oracle.counter_correct(vals))
+            assert np.all(np.diff(got) >= 0)  # corrected counters are monotonic
+
+
+WMAX = 128
+
+
+class TestGatherPath:
+    def test_min_max(self, gauge_batch, gauge_series):
+        ts, vals = gauge_batch
+        check(W.min_over_time(ts, vals, STEPS_J, WINDOW, WMAX), gauge_series, "min_over_time")
+        check(W.max_over_time(ts, vals, STEPS_J, WINDOW, WMAX), gauge_series, "max_over_time")
+
+    def test_quantile(self, gauge_batch, gauge_series):
+        ts, vals = gauge_batch
+        got = W.quantile_over_time(ts, vals, STEPS_J, WINDOW, WMAX, 0.9)
+        check(got, gauge_series, "quantile_over_time", rtol=1e-6, q=0.9)
+
+    def test_deriv(self, gauge_batch, gauge_series):
+        ts, vals = gauge_batch
+        check(W.deriv(ts, vals, STEPS_J, WINDOW, WMAX), gauge_series, "deriv", rtol=1e-5)
+
+    def test_predict_linear(self, gauge_batch, gauge_series):
+        ts, vals = gauge_batch
+        got = W.predict_linear(ts, vals, STEPS_J, WINDOW, WMAX, 300.0)
+        check(got, gauge_series, "predict_linear", rtol=1e-5, duration_s=300.0)
+
+    def test_holt_winters(self, gauge_batch, gauge_series):
+        ts, vals = gauge_batch
+        got = W.holt_winters(ts, vals, STEPS_J, WINDOW, WMAX, 0.5, 0.1)
+        check(got, gauge_series, "holt_winters", rtol=1e-6, sf=0.5, tf=0.1)
+
+    def test_mad(self, gauge_batch, gauge_series):
+        ts, vals = gauge_batch
+        got = W.mad_over_time(ts, vals, STEPS_J, WINDOW, WMAX)
+        check(got, gauge_series, "mad_over_time", rtol=1e-6)
+
+
+class TestEdgeCases:
+    def test_empty_series_slot(self):
+        b = build_batch([np.array([], dtype=np.int64)], [np.array([])], pad_to=8)
+        ts, vals = jnp.asarray(b.timestamps), jnp.asarray(b.values)
+        out = W.sum_over_time(ts, vals, STEPS_J, WINDOW)
+        assert np.isnan(np.asarray(out)).all()
+        out = W.rate(ts, vals, STEPS_J, WINDOW)
+        assert np.isnan(np.asarray(out)).all()
+
+    def test_single_sample_rate_is_nan(self):
+        ts = np.array([START + 1000], dtype=np.int64)
+        vals = np.array([5.0])
+        b = build_batch([ts], [vals], pad_to=8)
+        out = np.asarray(W.rate(jnp.asarray(b.timestamps), jnp.asarray(b.values),
+                                STEPS_J, WINDOW))
+        assert np.isnan(out).all()
+
+    def test_window_boundary_exclusive_start(self):
+        # sample exactly at t-window must be excluded; at t included
+        ts = np.array([START - WINDOW, START], dtype=np.int64)
+        vals = np.array([1.0, 2.0])
+        b = build_batch([ts], [vals], pad_to=8)
+        out = np.asarray(W.sum_count_avg(jnp.asarray(b.timestamps),
+                                         jnp.asarray(b.values),
+                                         jnp.asarray([START]), WINDOW)[0])
+        assert out[0, 0] == 2.0  # only the t=START sample
