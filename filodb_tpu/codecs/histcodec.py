@@ -76,3 +76,33 @@ def decode(buf: bytes) -> tuple[HistogramBuckets, np.ndarray]:
 
 def num_values(buf: bytes) -> int:
     return _HDR.unpack_from(buf, 1)[0]
+
+
+# --------------------------------------------------------------------------
+# Single-sample blob: the ingest wire form of one histogram
+# --------------------------------------------------------------------------
+
+def encode_hist_value(buckets: HistogramBuckets, values) -> bytes:
+    """One histogram sample as a self-describing blob — the BinaryHistogram
+    that rides inside ingest records (reference: memory/format/vectors/
+    HistogramVector.scala:34 BinHistogram layout: bucket scheme + packed
+    cumulative counts)."""
+    vals = np.ascontiguousarray(values, dtype=np.int64)
+    out = bytearray([WireType.HIST_BLOB])
+    out += struct.pack("<H", len(vals))
+    out += buckets.serialize()
+    deltas = np.empty_like(vals)
+    if len(vals):
+        deltas[0] = vals[0]
+        deltas[1:] = np.diff(vals)
+    out += nibblepack.pack(nibblepack.zigzag_encode(deltas))
+    return bytes(out)
+
+
+def decode_hist_value(buf: bytes) -> tuple[HistogramBuckets, np.ndarray]:
+    if buf[0] != WireType.HIST_BLOB:
+        raise ValueError(f"not a histogram blob: wire type {buf[0]}")
+    (n,) = struct.unpack_from("<H", buf, 1)
+    buckets, pos = HistogramBuckets.deserialize(buf, 3)
+    deltas, _ = nibblepack.unpack(buf, n, pos)
+    return buckets, np.cumsum(nibblepack.zigzag_decode(deltas))

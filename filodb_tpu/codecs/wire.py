@@ -26,6 +26,7 @@ class WireType(enum.IntEnum):
     CONST_DOUBLE = 19
     # Histograms
     HIST_2D_DELTA = 32  # per-row delta vs previous row, nibble-packed sections
+    HIST_BLOB = 33      # single-sample BinaryHistogram blob (ingest wire form)
     # Strings / tags
     UTF8_DENSE = 48     # offsets + concatenated UTF-8 payload
     DICT_UTF8 = 49      # dictionary-encoded UTF-8

@@ -1,0 +1,115 @@
+"""Per-dataset store/ingestion configuration.
+
+Capability match for the reference's StoreConfig/IngestionConfig parsed from
+per-dataset source config (reference: core/src/main/scala/filodb.core/store/
+IngestionConfig.scala:202 and conf/timeseries-dev-source.conf:28-102).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class StoreConfig:
+    flush_interval_ms: int = 3_600_000        # flush-interval = 1h
+    max_chunks_size: int = 400                # max rows per chunk
+    groups_per_shard: int = 60
+    shard_mem_size: int = 512 * 1024 * 1024   # shard-mem-size budget (bytes)
+    max_buffer_pool_size: int = 10_000
+    disk_ttl_seconds: int = 3 * 24 * 3600
+    demand_paging_enabled: bool = True
+    max_data_per_shard_query: int = 50 * 1024 * 1024
+    evicted_pk_bloom_filter_capacity: int = 5_000_000
+    # TPU additions: padding buckets for device batches (bounded XLA
+    # recompiles — SURVEY.md §7 "Ragged data")
+    batch_row_pad: int = 64
+    batch_series_pad: int = 128
+
+    @staticmethod
+    def from_config(conf: Mapping) -> "StoreConfig":
+        def ms(key: str, default: int) -> int:
+            v = conf.get(key)
+            return parse_duration_ms(v) if v is not None else default
+
+        d = StoreConfig()
+        return StoreConfig(
+            flush_interval_ms=ms("flush-interval", d.flush_interval_ms),
+            max_chunks_size=int(conf.get("max-chunks-size", d.max_chunks_size)),
+            groups_per_shard=int(conf.get("groups-per-shard", d.groups_per_shard)),
+            shard_mem_size=parse_size(conf.get("shard-mem-size", d.shard_mem_size)),
+            max_buffer_pool_size=int(conf.get("max-buffer-pool-size",
+                                              d.max_buffer_pool_size)),
+            disk_ttl_seconds=ms("disk-time-to-live", d.disk_ttl_seconds * 1000) // 1000,
+            demand_paging_enabled=bool(conf.get("demand-paging-enabled",
+                                                d.demand_paging_enabled)),
+            max_data_per_shard_query=parse_size(conf.get("max-data-per-shard-query",
+                                                         d.max_data_per_shard_query)),
+            evicted_pk_bloom_filter_capacity=int(
+                conf.get("evicted-pk-bloom-filter-capacity",
+                         d.evicted_pk_bloom_filter_capacity)),
+            batch_row_pad=int(conf.get("batch-row-pad", d.batch_row_pad)),
+            batch_series_pad=int(conf.get("batch-series-pad", d.batch_series_pad)),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestionConfig:
+    """Binds a dataset to a source (reference: IngestionConfig — dataset,
+    num-shards, min-num-nodes, sourcefactory + sourceconfig)."""
+
+    dataset: str
+    num_shards: int
+    min_num_nodes: int = 1
+    source_factory: Optional[str] = None
+    source_config: Mapping = dataclasses.field(default_factory=dict)
+    store: StoreConfig = dataclasses.field(default_factory=StoreConfig)
+
+    def __post_init__(self):
+        if self.num_shards & (self.num_shards - 1):
+            raise ValueError(f"num_shards {self.num_shards} must be a power of 2")
+
+    @staticmethod
+    def from_config(conf: Mapping) -> "IngestionConfig":
+        src = conf.get("sourceconfig", {})
+        return IngestionConfig(
+            dataset=conf["dataset"],
+            num_shards=int(conf["num-shards"]),
+            min_num_nodes=int(conf.get("min-num-nodes", 1)),
+            source_factory=conf.get("sourcefactory"),
+            source_config=src,
+            store=StoreConfig.from_config(src.get("store", {})),
+        )
+
+
+_UNITS_MS = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000, "d": 86_400_000,
+             "minute": 60_000, "minutes": 60_000, "hour": 3_600_000,
+             "hours": 3_600_000, "day": 86_400_000, "days": 86_400_000,
+             "second": 1000, "seconds": 1000}
+
+
+def parse_duration_ms(v) -> int:
+    """'1 hour' / '5m' / '300ms' / int millis -> millis (HOCON-style)."""
+    if isinstance(v, (int, float)):
+        return int(v)
+    s = str(v).strip()
+    for unit in sorted(_UNITS_MS, key=len, reverse=True):
+        if s.endswith(unit):
+            return int(float(s[: -len(unit)].strip()) * _UNITS_MS[unit])
+    return int(float(s))
+
+
+_SIZE_UNITS = {"kb": 1 << 10, "mb": 1 << 20, "gb": 1 << 30, "k": 1 << 10,
+               "m": 1 << 20, "g": 1 << 30, "b": 1}
+
+
+def parse_size(v) -> int:
+    """'512MB' / '2GB' / int bytes -> bytes."""
+    if isinstance(v, (int, float)):
+        return int(v)
+    s = str(v).strip().lower()
+    for unit in sorted(_SIZE_UNITS, key=len, reverse=True):
+        if s.endswith(unit):
+            return int(float(s[: -len(unit)].strip()) * _SIZE_UNITS[unit])
+    return int(float(s))

@@ -1,0 +1,11 @@
+"""In-memory time-series store: the reference's memstore layer rebuilt
+host-side, feeding device-ready batches to the TPU query kernels
+(reference: core/src/main/scala/filodb.core/memstore/)."""
+
+from filodb_tpu.memstore.index import PartKeyIndex
+from filodb_tpu.memstore.partition import TimeSeriesPartition
+from filodb_tpu.memstore.shard import TimeSeriesShard
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+
+__all__ = ["PartKeyIndex", "TimeSeriesPartition", "TimeSeriesShard",
+           "TimeSeriesMemStore"]

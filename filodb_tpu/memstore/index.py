@@ -1,0 +1,165 @@
+"""Part-key tag index: label -> value -> posting set of partition ids.
+
+Re-scoped inverted index with the feature set the reference gets from
+Lucene (reference: core/src/main/scala/filodb.core/memstore/
+PartKeyLuceneIndex.scala:70 — partIdsFromFilters, partIdsOrderedByEndTime,
+startTimeFromPartIds, labelValues faceting, __startTime__/__endTime__
+fields), deliberately not a Lucene port (SURVEY.md §7 "Deliberately not
+ported").  Postings are Python sets on the ingest path; query-time
+intersection works on sorted numpy arrays so the result feeds straight into
+batch gathers.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+from filodb_tpu.core.filters import (ColumnFilter, Equals, EqualsRegex, In,
+                                     NotEquals, NotEqualsRegex, NotIn)
+
+_NO_END = np.iinfo(np.int64).max
+
+
+class PartKeyIndex:
+    """One index per shard; partition ids are dense ints assigned by the shard."""
+
+    def __init__(self) -> None:
+        self._postings: dict[str, dict[str, set[int]]] = {}
+        self._tags: dict[int, dict[str, str]] = {}
+        self._partkeys: dict[int, bytes] = {}
+        self._start: dict[int, int] = {}
+        self._end: dict[int, int] = {}
+
+    def __len__(self) -> int:
+        return len(self._tags)
+
+    # -- write path ---------------------------------------------------------
+
+    def add_partkey(self, part_id: int, partkey: bytes, tags: dict[str, str],
+                    start_time: int, end_time: int = _NO_END) -> None:
+        self._tags[part_id] = tags
+        self._partkeys[part_id] = partkey
+        self._start[part_id] = start_time
+        self._end[part_id] = end_time
+        for k, v in tags.items():
+            self._postings.setdefault(k, {}).setdefault(v, set()).add(part_id)
+
+    def update_end_time(self, part_id: int, end_time: int) -> None:
+        """Marks a series stopped (reference: updatePartKeyWithEndTime, used
+        by flush step updateIndexWithEndTime and by eviction ordering)."""
+        self._end[part_id] = end_time
+
+    def mark_active(self, part_id: int) -> None:
+        self._end[part_id] = _NO_END
+
+    def remove(self, part_ids: Iterable[int]) -> None:
+        for pid in part_ids:
+            tags = self._tags.pop(pid, None)
+            if tags is None:
+                continue
+            self._partkeys.pop(pid, None)
+            self._start.pop(pid, None)
+            self._end.pop(pid, None)
+            for k, v in tags.items():
+                vals = self._postings.get(k)
+                if vals is None:
+                    continue
+                s = vals.get(v)
+                if s is not None:
+                    s.discard(pid)
+                    if not s:
+                        del vals[v]
+
+    # -- read path ----------------------------------------------------------
+
+    def part_ids_from_filters(self, filters: Sequence[ColumnFilter],
+                              start_time: int = 0,
+                              end_time: int = _NO_END,
+                              limit: Optional[int] = None) -> np.ndarray:
+        """Sorted part ids whose tags match all filters and whose [start,end]
+        life overlaps the query range (reference: partIdsFromFilters +
+        __endTime__ >= start && __startTime__ <= end clauses)."""
+        ids = self._candidate_ids(filters)
+        out = np.fromiter(
+            (pid for pid in ids
+             if self._end.get(pid, _NO_END) >= start_time
+             and self._start.get(pid, 0) <= end_time),
+            dtype=np.int32)
+        out.sort()
+        if limit is not None:
+            out = out[:limit]
+        return out
+
+    def _candidate_ids(self, filters: Sequence[ColumnFilter]) -> set[int]:
+        positive: list[set[int]] = []
+        negative: list[ColumnFilter] = []
+        for f in filters:
+            flt = f.filter
+            vals = self._postings.get(f.column, {})
+            if isinstance(flt, Equals):
+                positive.append(vals.get(flt.value, set()))
+            elif isinstance(flt, In):
+                positive.append(set().union(*(vals.get(v, set()) for v in flt.values)))
+            elif isinstance(flt, EqualsRegex):
+                # faceted regex: match against the label's value dictionary,
+                # not each document — same trick Lucene's RegexpQuery enables
+                positive.append(set().union(
+                    *(s for v, s in vals.items() if flt.matches(v))) if vals else set())
+            else:
+                negative.append(f)
+        if positive:
+            ids = set.intersection(*map(set, positive)) if len(positive) > 1 \
+                else set(positive[0])
+        else:
+            ids = set(self._tags.keys())
+        for f in negative:
+            ids = {pid for pid in ids if f.matches(self._tags[pid])}
+        return ids
+
+    def part_ids_ordered_by_end_time(self, n: int,
+                                     before: int = _NO_END) -> list[int]:
+        """Oldest-ending (stopped-longest-ago) partitions first — the
+        eviction ordering (reference: partIdsOrderedByEndTime,
+        TimeSeriesShard eviction :1308-1401)."""
+        stopped = [(e, pid) for pid, e in self._end.items() if e < before]
+        stopped.sort()
+        return [pid for _, pid in stopped[:n]]
+
+    def start_time(self, part_id: int) -> int:
+        return self._start[part_id]
+
+    def end_time(self, part_id: int) -> int:
+        return self._end[part_id]
+
+    def tags(self, part_id: int) -> dict[str, str]:
+        return self._tags[part_id]
+
+    def partkey(self, part_id: int) -> bytes:
+        return self._partkeys[part_id]
+
+    def label_names(self, filters: Sequence[ColumnFilter] = (),
+                    start_time: int = 0, end_time: int = _NO_END) -> list[str]:
+        if not filters:
+            return sorted(self._postings.keys())
+        names: set[str] = set()
+        for pid in self.part_ids_from_filters(filters, start_time, end_time):
+            names.update(self._tags[int(pid)].keys())
+        return sorted(names)
+
+    def label_values(self, label: str, filters: Sequence[ColumnFilter] = (),
+                     start_time: int = 0, end_time: int = _NO_END,
+                     limit: Optional[int] = None) -> list[str]:
+        """Distinct values of one label (reference: labelValuesEfficient
+        faceting when unfiltered; filtered path scans matching docs)."""
+        if not filters:
+            out = sorted(self._postings.get(label, {}).keys())
+        else:
+            vals: set[str] = set()
+            for pid in self.part_ids_from_filters(filters, start_time, end_time):
+                v = self._tags[int(pid)].get(label)
+                if v is not None:
+                    vals.add(v)
+            out = sorted(vals)
+        return out[:limit] if limit is not None else out

@@ -1,0 +1,141 @@
+"""TimeSeriesMemStore: dataset -> shards facade.
+
+Matches the reference's TimeSeriesMemStore (reference: core/src/main/scala/
+filodb.core/memstore/TimeSeriesMemStore.scala:22): ``setup`` creates shards,
+``ingest`` routes containers to a shard, ``recover_stream`` replays a source
+from checkpoints with per-group watermark skipping, and the query surface
+(lookup/scan/labels) delegates to shards.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+from filodb_tpu.core.filters import ColumnFilter
+from filodb_tpu.core.schemas import Schemas
+from filodb_tpu.core.storeconfig import StoreConfig
+from filodb_tpu.memstore.shard import PartLookupResult, TimeSeriesShard
+from filodb_tpu.store.columnstore import ColumnStore, NullColumnStore
+from filodb_tpu.store.metastore import InMemoryMetaStore, MetaStore
+
+
+class ShardNotSetup(Exception):
+    pass
+
+
+class TimeSeriesMemStore:
+    def __init__(self, column_store: Optional[ColumnStore] = None,
+                 meta_store: Optional[MetaStore] = None):
+        self.store = column_store or NullColumnStore()
+        self.meta = meta_store or InMemoryMetaStore()
+        self._datasets: dict[str, dict[int, TimeSeriesShard]] = {}
+        self._schemas: dict[str, Schemas] = {}
+
+    # ------------------------------------------------------------------ setup
+
+    def setup(self, dataset: str, schemas: Schemas, shard_num: int,
+              config: Optional[StoreConfig] = None) -> TimeSeriesShard:
+        shards = self._datasets.setdefault(dataset, {})
+        if shard_num in shards:
+            raise ValueError(f"shard {shard_num} already set up for {dataset}")
+        shard = TimeSeriesShard(dataset, schemas, shard_num, config,
+                                self.store, self.meta)
+        shards[shard_num] = shard
+        self._schemas[dataset] = schemas
+        return shard
+
+    def get_shard(self, dataset: str, shard_num: int) -> TimeSeriesShard:
+        try:
+            return self._datasets[dataset][shard_num]
+        except KeyError:
+            raise ShardNotSetup(f"{dataset} shard {shard_num} not set up")
+
+    def shards(self, dataset: str) -> list[TimeSeriesShard]:
+        return list(self._datasets.get(dataset, {}).values())
+
+    def active_shards(self, dataset: str) -> list[int]:
+        return sorted(self._datasets.get(dataset, {}).keys())
+
+    # ----------------------------------------------------------------- ingest
+
+    def ingest(self, dataset: str, shard_num: int, container: bytes,
+               offset: int) -> int:
+        return self.get_shard(dataset, shard_num).ingest_container(container, offset)
+
+    def ingest_stream(self, dataset: str, shard_num: int,
+                      stream: Iterable[tuple[int, bytes]],
+                      flush_each: Optional[int] = None) -> int:
+        """Consume an (offset, container) stream, interleaving flushes the
+        way ingestStream interleaves createFlushTasks (reference:
+        TimeSeriesMemStore.scala:106-129)."""
+        shard = self.get_shard(dataset, shard_num)
+        total = 0
+        for i, (offset, container) in enumerate(stream):
+            total += shard.ingest_container(container, offset)
+            if flush_each and (i + 1) % flush_each == 0:
+                shard.flush_all()
+        return total
+
+    def recover_stream(self, dataset: str, shard_num: int,
+                       stream: Iterable[tuple[int, bytes]]) -> int:
+        """Replay from checkpoints: set group watermarks from the meta store,
+        then ingest — below-watermark records skip (reference:
+        recoverStream TimeSeriesMemStore.scala:136-173)."""
+        shard = self.get_shard(dataset, shard_num)
+        cps = self.meta.read_checkpoints(dataset, shard_num)
+        for group, offset in cps.items():
+            shard.group_watermarks[group] = offset
+        total = 0
+        for offset, container in stream:
+            total += shard.ingest_container(container, offset)
+        return total
+
+    def recover_index(self, dataset: str, shard_num: int) -> int:
+        """Rebuild the tag index from persisted partkeys (reference:
+        IndexBootstrapper.scala:12, TimeSeriesShard.recoverIndex)."""
+        from filodb_tpu.core.record import parse_partkey
+        shard = self.get_shard(dataset, shard_num)
+        n = 0
+        for rec in self.store.scan_part_keys(dataset, shard_num):
+            if rec.partkey in shard.part_set:
+                continue
+            pid = shard._next_part_id
+            shard._next_part_id += 1
+            shard.index.add_partkey(pid, rec.partkey, parse_partkey(rec.partkey),
+                                    rec.start_time, rec.end_time)
+            # register in the part set so resumed ingest reuses this part id
+            # instead of creating a duplicate index entry
+            shard.part_set[rec.partkey] = pid
+            n += 1
+        return n
+
+    # ------------------------------------------------------------------ query
+
+    def lookup_partitions(self, dataset: str, shard_num: int,
+                          filters: Sequence[ColumnFilter], start: int,
+                          end: int, limit: Optional[int] = None) -> PartLookupResult:
+        return self.get_shard(dataset, shard_num).lookup_partitions(
+            filters, start, end, limit)
+
+    def label_values(self, dataset: str, label: str,
+                     filters: Sequence[ColumnFilter] = (),
+                     shard_nums: Optional[Sequence[int]] = None,
+                     start: int = 0, end: int = np.iinfo(np.int64).max,
+                     limit: Optional[int] = None) -> list[str]:
+        nums = shard_nums if shard_nums is not None else self.active_shards(dataset)
+        vals: set[str] = set()
+        for sn in nums:
+            vals.update(self.get_shard(dataset, sn).label_values(
+                label, filters, start, end, limit))
+        out = sorted(vals)
+        return out[:limit] if limit is not None else out
+
+    def flush(self, dataset: str, shard_num: Optional[int] = None) -> int:
+        if shard_num is not None:
+            return self.get_shard(dataset, shard_num).flush_all()
+        return sum(s.flush_all() for s in self.shards(dataset))
+
+    def reset(self) -> None:
+        self._datasets.clear()

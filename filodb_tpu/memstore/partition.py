@@ -1,0 +1,213 @@
+"""Per-series partition state: write buffers + frozen chunks.
+
+Equivalent of the reference's TimeSeriesPartition (reference:
+core/src/main/scala/filodb.core/memstore/TimeSeriesPartition.scala:64):
+appends land in pre-allocated write buffers; when full (or at flush
+boundaries) ``switch_buffers`` freezes them into a compressed ``ChunkSet``
+(the encodeOneChunkset step, :203-249); out-of-order samples are dropped
+(:131-134).  Queries read through ``read_range`` which serves decoded dense
+arrays — the device-facing form.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from filodb_tpu.codecs import histcodec
+from filodb_tpu.core.chunk import ChunkSet, decode_chunkset, encode_chunkset
+from filodb_tpu.core.histogram import HistogramBuckets
+from filodb_tpu.core.schemas import ColumnType, Schema
+
+
+class TimeSeriesPartition:
+    __slots__ = ("part_id", "schema", "partkey", "tags", "group",
+                 "chunks", "_decoded", "_buf_ts", "_buf_cols", "_buf_n",
+                 "_capacity", "_hist_buckets", "_seq", "_unflushed",
+                 "out_of_order_dropped")
+
+    def __init__(self, part_id: int, schema: Schema, partkey: bytes,
+                 tags: dict[str, str], group: int, capacity: int = 400):
+        self.part_id = part_id
+        self.schema = schema
+        self.partkey = partkey
+        self.tags = tags
+        self.group = group
+        self.chunks: list[ChunkSet] = []
+        self._decoded: dict[int, tuple] = {}   # chunk_id -> (ts, cols)
+        self._capacity = capacity
+        self._buf_ts = np.empty(capacity, dtype=np.int64)
+        self._buf_cols: list = [self._new_col_buffer(c.ctype)
+                                for c in schema.data.columns[1:]]
+        self._buf_n = 0
+        self._hist_buckets: Optional[HistogramBuckets] = None
+        self._seq = 0
+        self._unflushed: list[ChunkSet] = []
+        self.out_of_order_dropped = 0
+
+    def _new_col_buffer(self, ctype: ColumnType):
+        if ctype == ColumnType.DOUBLE:
+            return np.empty(self._capacity, dtype=np.float64)
+        if ctype in (ColumnType.LONG, ColumnType.TIMESTAMP, ColumnType.INT):
+            return np.empty(self._capacity, dtype=np.int64)
+        return []  # STRING / HISTOGRAM: python list, frozen at encode time
+
+    # -- ingest -------------------------------------------------------------
+
+    def ingest(self, timestamp: int, values: Sequence) -> bool:
+        """Append one sample.  Returns False for out-of-order drops."""
+        if timestamp <= self.latest_timestamp:
+            self.out_of_order_dropped += 1
+            return False
+        # decode histogram blobs first: a bucket-scheme switch mid-stream
+        # freezes the current buffer (reference: AddResponse.
+        # BucketSchemaMismatch forces a new vector, BinaryVector.scala:231-236)
+        decoded = []
+        for col, v in zip(self.schema.data.columns[1:], values):
+            if col.ctype == ColumnType.HISTOGRAM:
+                buckets, counts = histcodec.decode_hist_value(v) \
+                    if isinstance(v, (bytes, bytearray)) else v
+                if self._hist_buckets is not None and self._buf_n > 0 \
+                        and buckets != self._hist_buckets:
+                    self.switch_buffers()
+                self._hist_buckets = buckets
+                decoded.append(np.asarray(counts, dtype=np.int64))
+            else:
+                decoded.append(v)
+        if self._buf_n == self._capacity:
+            self.switch_buffers()
+        i = self._buf_n
+        self._buf_ts[i] = timestamp
+        for buf, col, v in zip(self._buf_cols, self.schema.data.columns[1:], decoded):
+            if col.ctype in (ColumnType.HISTOGRAM, ColumnType.STRING):
+                buf.append(v)
+            else:
+                buf[i] = v
+        self._buf_n = i + 1
+        return True
+
+    @property
+    def latest_timestamp(self) -> int:
+        if self._buf_n:
+            return int(self._buf_ts[self._buf_n - 1])
+        if self.chunks:
+            return self.chunks[-1].info.end_time
+        return -1
+
+    @property
+    def earliest_timestamp(self) -> int:
+        if self.chunks:
+            return self.chunks[0].info.start_time
+        if self._buf_n:
+            return int(self._buf_ts[0])
+        return -1
+
+    @property
+    def num_chunks(self) -> int:
+        return len(self.chunks) + (1 if self._buf_n else 0)
+
+    def switch_buffers(self) -> Optional[ChunkSet]:
+        """Freeze the current write buffer into a compressed ChunkSet
+        (reference: switchBuffers + encodeOneChunkset)."""
+        n = self._buf_n
+        if n == 0:
+            return None
+        cols = []
+        for buf, col in zip(self._buf_cols, self.schema.data.columns[1:]):
+            if col.ctype == ColumnType.HISTOGRAM:
+                cols.append((self._hist_buckets, np.stack(buf[:n])))
+            elif col.ctype == ColumnType.STRING:
+                cols.append(list(buf[:n]))
+            else:
+                cols.append(buf[:n].copy())
+        cs = encode_chunkset(self.schema, self.partkey, self._buf_ts[:n].copy(),
+                             cols, ingestion_seq=self._seq)
+        self._seq += 1
+        self.chunks.append(cs)
+        self._unflushed.append(cs)
+        self._buf_n = 0
+        self._buf_cols = [self._new_col_buffer(c.ctype)
+                          for c in self.schema.data.columns[1:]]
+        return cs
+
+    def make_flush_chunks(self) -> list[ChunkSet]:
+        """Freeze + drain chunks not yet persisted (reference:
+        makeFlushChunks, TimeSeriesPartition.scala:264)."""
+        self.switch_buffers()
+        out, self._unflushed = self._unflushed, []
+        return out
+
+    # -- read ---------------------------------------------------------------
+
+    def _decoded_chunk(self, cs: ChunkSet) -> tuple:
+        got = self._decoded.get(cs.info.chunk_id)
+        if got is None:
+            got = decode_chunkset(self.schema, cs)
+            self._decoded[cs.info.chunk_id] = got
+        return got
+
+    def drop_decoded_cache(self) -> None:
+        self._decoded.clear()
+
+    def read_range(self, start: int, end: int, column_id: Optional[int] = None):
+        """All samples with start <= ts <= end as dense arrays.
+
+        Returns (ts[int64], values) where values is float64 for scalar
+        columns or (HistogramBuckets, int64[rows, buckets]) for histograms.
+        Replaces per-row VectorDataReader iteration with whole-chunk decode +
+        concatenation; the windowing kernels do the range math on device.
+        """
+        cid = self.schema.data.value_column_id if column_id is None else column_id
+        col_idx = cid - 1  # data columns after the timestamp
+        ctype = self.schema.data.columns[cid].ctype
+        ts_parts, val_parts = [], []
+        for cs in self.chunks:
+            if cs.info.end_time < start or cs.info.start_time > end:
+                continue
+            ts, cols = self._decoded_chunk(cs)
+            ts_parts.append(ts)
+            val_parts.append(cols[col_idx])
+        if self._buf_n:
+            t0 = int(self._buf_ts[0])
+            if not (self._buf_ts[self._buf_n - 1] < start or t0 > end):
+                ts_parts.append(self._buf_ts[:self._buf_n].copy())
+                buf = self._buf_cols[col_idx]
+                if ctype == ColumnType.HISTOGRAM:
+                    val_parts.append((self._hist_buckets, np.stack(buf[:self._buf_n])))
+                elif ctype == ColumnType.STRING:
+                    val_parts.append(list(buf[:self._buf_n]))
+                else:
+                    val_parts.append(buf[:self._buf_n].copy())
+        if not ts_parts:
+            empty_ts = np.empty(0, dtype=np.int64)
+            if ctype == ColumnType.HISTOGRAM:
+                return empty_ts, (self._hist_buckets, np.empty((0, 0), dtype=np.int64))
+            return empty_ts, np.empty(0, dtype=np.float64)
+        ts = np.concatenate(ts_parts)
+        if ctype == ColumnType.HISTOGRAM:
+            # widest bucket scheme wins; narrower chunks pad their top bucket
+            # out (cumulative counts -> edge padding preserves totals)
+            buckets = max((p[0] for p in val_parts if p[0] is not None),
+                          key=lambda bk: bk.num_buckets, default=None)
+            rows = [p[1] for p in val_parts]
+            b = buckets.num_buckets if buckets is not None else 0
+            rows = [np.pad(r, ((0, 0), (0, b - r.shape[1])), mode="edge")
+                    if 0 < r.shape[1] < b else r for r in rows]
+            vals = np.concatenate(rows) if rows else np.empty((0, b), dtype=np.int64)
+            mask = (ts >= start) & (ts <= end)
+            return ts[mask], (buckets, vals[mask])
+        if ctype == ColumnType.STRING:
+            mask = (ts >= start) & (ts <= end)
+            flat = [x for p in val_parts for x in p]
+            return ts[mask], [x for x, m in zip(flat, mask) if m]
+        vals = np.concatenate(val_parts).astype(np.float64)
+        mask = (ts >= start) & (ts <= end)
+        return ts[mask], vals[mask]
+
+    def chunk_infos(self):
+        return [cs.info for cs in self.chunks]
+
+    @property
+    def mem_bytes(self) -> int:
+        return sum(cs.nbytes for cs in self.chunks) + self._buf_n * 16

@@ -1,0 +1,344 @@
+"""TimeSeriesShard: per-shard ingestion state machine + scan surface.
+
+The heart of ingestion, matching the reference's TimeSeriesShard
+(reference: core/src/main/scala/filodb.core/memstore/TimeSeriesShard.scala:222):
+
+- partition registry: partkey -> part_id -> TimeSeriesPartition (:243,316)
+- tag index lookups (:255, PartKeyLuceneIndex)
+- flush **groups**: hash(partKey) % groups_per_shard, per-group recovery
+  watermarks that skip already-persisted records (:155-157, :390, :488-522)
+- flush pipeline: freeze buffers -> write chunks -> write dirty partkeys ->
+  index end-time updates -> checkpoint (doFlushSteps :884-974)
+- eviction by oldest end-time + bloom filter of evicted keys (:1308-1401)
+- ``lookup_partitions`` -> PartLookupResult (:1441-1488)
+
+Single-writer discipline: ``ingest`` must be called from one thread per
+shard (the reference's ingestSched); reads take snapshots.  The TPU twist is
+the scan surface: ``scan_batch`` materializes matching partitions into one
+padded device-ready ChunkBatch instead of per-row iterators.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+from filodb_tpu.core.chunk import ChunkBatch, build_batch
+from filodb_tpu.core.filters import ColumnFilter
+from filodb_tpu.core.record import IngestRecord, decode_container
+from filodb_tpu.core.schemas import ColumnType, Schemas
+from filodb_tpu.core.storeconfig import StoreConfig
+from filodb_tpu.memstore.index import PartKeyIndex
+from filodb_tpu.memstore.partition import TimeSeriesPartition
+from filodb_tpu.store.columnstore import ColumnStore, NullColumnStore, PartKeyRecord
+from filodb_tpu.store.metastore import InMemoryMetaStore, MetaStore
+from filodb_tpu.utils.bloom import BloomFilter
+
+
+@dataclasses.dataclass
+class PartLookupResult:
+    """Outcome of an index lookup (reference: PartLookupResult,
+    TimeSeriesShard.scala:1441-1488): in-memory part ids plus partkeys that
+    need on-demand paging from the column store."""
+
+    shard: int
+    part_ids: np.ndarray
+    missing_partkeys: list[bytes]
+    first_schema_hash: Optional[int]
+
+
+@dataclasses.dataclass
+class ShardStats:
+    """Counter bundle (reference: TimeSeriesShardStats, :37-108)."""
+
+    rows_ingested: int = 0
+    rows_skipped: int = 0
+    out_of_order_dropped: int = 0
+    partitions_created: int = 0
+    partitions_evicted: int = 0
+    partitions_purged: int = 0
+    chunks_flushed: int = 0
+    flushes_done: int = 0
+
+
+class TimeSeriesShard:
+    def __init__(self, dataset: str, schemas: Schemas, shard_num: int,
+                 config: Optional[StoreConfig] = None,
+                 column_store: Optional[ColumnStore] = None,
+                 meta_store: Optional[MetaStore] = None):
+        self.dataset = dataset
+        self.schemas = schemas
+        self.shard_num = shard_num
+        self.config = config or StoreConfig()
+        self.store = column_store or NullColumnStore()
+        self.meta = meta_store or InMemoryMetaStore()
+        self.index = PartKeyIndex()
+        self.partitions: dict[int, TimeSeriesPartition] = {}
+        self.part_set: dict[bytes, int] = {}
+        self._next_part_id = 0
+        self.num_groups = self.config.groups_per_shard
+        # per-group recovery watermarks: records at offset <= watermark were
+        # already persisted pre-restart and are skipped during recovery
+        self.group_watermarks = [-1] * self.num_groups
+        self._dirty_partkeys: list[set[int]] = [set() for _ in range(self.num_groups)]
+        self.latest_offset = -1
+        self.evicted_keys = BloomFilter(self.config.evicted_pk_bloom_filter_capacity)
+        self.stats = ShardStats()
+        self.ingest_sched_check = None  # optional thread-name assertion hook
+
+    # ------------------------------------------------------------------ ingest
+
+    def ingest_container(self, container: bytes, offset: int) -> int:
+        return self.ingest(decode_container(container, self.schemas), offset)
+
+    def ingest(self, records: Iterable[IngestRecord], offset: int) -> int:
+        """Ingest a batch of records at a stream offset.  Returns rows added.
+
+        Group watermark skipping mirrors the reference's IngestConsumer
+        (:488-522): during recovery, a record whose flush group checkpointed
+        beyond ``offset`` is already persisted — skip it.
+        """
+        if self.ingest_sched_check is not None:
+            self.ingest_sched_check()
+        n = 0
+        for rec in records:
+            group = rec.part_hash % self.num_groups
+            if offset <= self.group_watermarks[group]:
+                self.stats.rows_skipped += 1
+                continue
+            part = self._get_or_add_partition(rec)
+            if part.ingest(rec.timestamp, rec.values):
+                n += 1
+                self.stats.rows_ingested += 1
+            else:
+                self.stats.out_of_order_dropped += 1
+            if self.index.end_time(part.part_id) != np.iinfo(np.int64).max:
+                self.index.mark_active(part.part_id)
+            self._dirty_partkeys[group].add(part.part_id)
+        self.latest_offset = max(self.latest_offset, offset)
+        return n
+
+    def _get_or_add_partition(self, rec: IngestRecord) -> TimeSeriesPartition:
+        pk = rec.partkey()
+        pid = self.part_set.get(pk)
+        if pid is not None:
+            part = self.partitions.get(pid)
+            if part is not None:
+                return part
+            # index-only entry (recovered or paged-out): re-materialize the
+            # partition under its existing part id, keeping index lifecycle
+            schema = self.schemas.by_hash(rec.schema_hash)
+            part = TimeSeriesPartition(pid, schema, pk, rec.tags,
+                                       rec.part_hash % self.num_groups,
+                                       capacity=self.config.max_chunks_size)
+            self.partitions[pid] = part
+            self.index.mark_active(pid)
+            return part
+        # evicted-key bloom check: a maybe-evicted key re-reads its true
+        # start time from the column store lifecycle (reference :1103-1122)
+        start_time = rec.timestamp
+        schema = self.schemas.by_hash(rec.schema_hash)
+        pid = self._next_part_id
+        self._next_part_id += 1
+        group = rec.part_hash % self.num_groups
+        part = TimeSeriesPartition(pid, schema, pk, rec.tags, group,
+                                   capacity=self.config.max_chunks_size)
+        self.partitions[pid] = part
+        self.part_set[pk] = pid
+        self.index.add_partkey(pid, pk, rec.tags, start_time)
+        self.stats.partitions_created += 1
+        return part
+
+    def create_partition(self, schema_name: str, tags: dict[str, str],
+                         start_time: int) -> TimeSeriesPartition:
+        """Direct partition creation for tests/recovery paths."""
+        from filodb_tpu.core.record import canonical_partkey, partition_hash
+        rec = IngestRecord(self.schemas[schema_name].schema_hash, tags,
+                           start_time, (), 0, partition_hash(tags))
+        return self._get_or_add_partition(rec)
+
+    # ------------------------------------------------------------------ flush
+
+    def flush_group(self, group: int, ingestion_time: Optional[int] = None) -> int:
+        """Flush one group: the doFlushSteps pipeline (reference :884-974).
+        Returns number of chunksets written."""
+        itime = ingestion_time if ingestion_time is not None \
+            else int(time.time() * 1000)
+        chunksets = []
+        for part in self.partitions.values():
+            if part.group == group:
+                chunksets.extend(part.make_flush_chunks())
+        if chunksets:
+            self.store.write_chunks(self.dataset, self.shard_num, chunksets, itime)
+        dirty = self._dirty_partkeys[group]
+        if dirty:
+            recs = [PartKeyRecord(self.index.partkey(pid),
+                                  self.index.start_time(pid),
+                                  self.index.end_time(pid), self.shard_num)
+                    for pid in dirty if pid in self.partitions]
+            self.store.write_part_keys(self.dataset, self.shard_num, recs)
+            self._dirty_partkeys[group] = set()
+        # checkpoint only after chunks+partkeys persisted (reference :949-960)
+        self.meta.write_checkpoint(self.dataset, self.shard_num, group,
+                                   self.latest_offset)
+        self.group_watermarks[group] = max(self.group_watermarks[group],
+                                           self.latest_offset)
+        self.stats.chunks_flushed += len(chunksets)
+        self.stats.flushes_done += 1
+        return len(chunksets)
+
+    def flush_all(self, ingestion_time: Optional[int] = None) -> int:
+        return sum(self.flush_group(g, ingestion_time)
+                   for g in range(self.num_groups))
+
+    # ------------------------------------------------------------- lifecycle
+
+    def evict_partitions(self, n: int) -> int:
+        """Evict up to n longest-stopped partitions (reference :1308-1401).
+        Their data must already be flushed; in-memory state is dropped and
+        the partkey recorded in the evicted bloom filter."""
+        victims = self.index.part_ids_ordered_by_end_time(n)
+        for pid in victims:
+            part = self.partitions.pop(pid, None)
+            if part is None:
+                continue
+            self.part_set.pop(part.partkey, None)
+            self.evicted_keys.add(part.partkey)
+            self.index.remove([pid])
+            self.stats.partitions_evicted += 1
+        return len(victims)
+
+    def purge_expired(self, retention_ms: int, now_ms: int) -> int:
+        """Drop partitions whose data aged out entirely (reference :776-795)."""
+        cutoff = now_ms - retention_ms
+        doomed = [pid for pid, p in self.partitions.items()
+                  if p.latest_timestamp < cutoff]
+        for pid in doomed:
+            part = self.partitions.pop(pid)
+            self.part_set.pop(part.partkey, None)
+            self.index.remove([pid])
+            self.stats.partitions_purged += 1
+        return len(doomed)
+
+    def mark_stopped_series(self, now_ms: int, stale_ms: int) -> int:
+        """Set index end-times for series that stopped ingesting (reference:
+        updateIndexWithEndTime during flush, :1037-1057)."""
+        n = 0
+        for pid, part in self.partitions.items():
+            if part.latest_timestamp < now_ms - stale_ms \
+                    and self.index.end_time(pid) == np.iinfo(np.int64).max:
+                self.index.update_end_time(pid, part.latest_timestamp)
+                n += 1
+        return n
+
+    # ------------------------------------------------------------------ query
+
+    def lookup_partitions(self, filters: Sequence[ColumnFilter],
+                          start_time: int, end_time: int,
+                          limit: Optional[int] = None) -> PartLookupResult:
+        """Index lookup restricted to ONE schema — the first matched, like the
+        reference's MultiSchemaPartitionsExec runtime schema discovery
+        (exec/MultiSchemaPartitionsExec.scala:41-85).  Ids whose partitions
+        are not in memory surface as ``missing_partkeys`` for on-demand
+        paging."""
+        ids = self.index.part_ids_from_filters(filters, start_time, end_time,
+                                               limit)
+        first_schema = None
+        in_mem: list[int] = []
+        missing: list[bytes] = []
+        for i in ids:
+            pid = int(i)
+            part = self.partitions.get(pid)
+            if part is None:
+                missing.append(self.index.partkey(pid))
+                continue
+            if first_schema is None:
+                first_schema = part.schema.schema_hash
+            if part.schema.schema_hash == first_schema:
+                in_mem.append(pid)
+        return PartLookupResult(self.shard_num, np.asarray(in_mem, dtype=np.int32),
+                                missing, first_schema)
+
+    def scan_batch(self, part_ids: Sequence[int], start_time: int, end_time: int,
+                   column_id: Optional[int] = None
+                   ) -> tuple[list[dict], Optional[ChunkBatch]]:
+        """Materialize partitions into one padded ChunkBatch + tag dicts.
+        This is the TPU replacement for scanPartitions/RawDataRangeVector
+        iteration (reference :1490, SelectRawPartitionsExec)."""
+        tags_list, ts_list, val_list = [], [], []
+        hist = None  # locked by the first partition: one value type per batch
+        bucket_tops = None
+        for pid in part_ids:
+            part = self.partitions.get(int(pid))
+            if part is None:
+                continue
+            cid = part.schema.data.value_column_id if column_id is None else column_id
+            ctype = part.schema.data.columns[cid].ctype
+            is_hist = ctype == ColumnType.HISTOGRAM
+            if hist is None:
+                hist = is_hist
+            elif is_hist != hist:
+                continue  # mixed schemas: callers scan one schema at a time
+            ts, vals = part.read_range(start_time, end_time, cid)
+            tags_list.append(part.tags)
+            if is_hist:
+                buckets, rows = vals
+                if buckets is not None:
+                    tops = buckets.bucket_tops()
+                    if bucket_tops is None or len(tops) > len(bucket_tops):
+                        bucket_tops = tops
+                ts_list.append(ts)
+                val_list.append(rows.astype(np.float64))
+            else:
+                ts_list.append(ts)
+                val_list.append(vals)
+        if not tags_list:
+            return [], None
+        if hist:
+            if bucket_tops is None:
+                bucket_tops = np.empty(0, dtype=np.float64)
+            b = len(bucket_tops)
+            val_list = [v if v.shape[1] == b
+                        else np.zeros((0, b)) if v.size == 0
+                        else np.pad(v, ((0, 0), (0, b - v.shape[1])), mode="edge")
+                        if v.shape[1] < b else v[:, :b] for v in val_list]
+            batch = build_batch(ts_list, val_list, pad_to=self.config.batch_row_pad,
+                                hist=True, bucket_tops=bucket_tops,
+                                pad_series_to=_round_up(len(tags_list),
+                                                        self.config.batch_series_pad))
+        else:
+            batch = build_batch(ts_list, val_list, pad_to=self.config.batch_row_pad,
+                                pad_series_to=_round_up(len(tags_list),
+                                                        self.config.batch_series_pad))
+        return tags_list, batch
+
+    # ------------------------------------------------------------- metadata
+
+    def label_values(self, label: str, filters: Sequence[ColumnFilter] = (),
+                     start: int = 0, end: int = np.iinfo(np.int64).max,
+                     limit: Optional[int] = None) -> list[str]:
+        return self.index.label_values(label, filters, start, end, limit)
+
+    def label_names(self, filters: Sequence[ColumnFilter] = (),
+                    start: int = 0, end: int = np.iinfo(np.int64).max) -> list[str]:
+        return self.index.label_names(filters, start, end)
+
+    def part_keys(self, filters: Sequence[ColumnFilter], start: int, end: int,
+                  limit: Optional[int] = None) -> list[dict[str, str]]:
+        ids = self.index.part_ids_from_filters(filters, start, end, limit)
+        return [self.index.tags(int(i)) for i in ids]
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.partitions)
+
+    @property
+    def mem_bytes(self) -> int:
+        return sum(p.mem_bytes for p in self.partitions.values())
+
+
+def _round_up(n: int, to: int) -> int:
+    return ((n + to - 1) // to) * to if to else n

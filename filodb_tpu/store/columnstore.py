@@ -1,0 +1,131 @@
+"""ChunkSink / ColumnStore: where flushed chunks and part keys persist.
+
+Capability match for the reference's ChunkSink/ColumnStore API plus its
+NullColumnStore test double (reference: core/src/main/scala/filodb.core/
+store/ChunkSink.scala:21,116, ColumnStore.scala:59) and the Cassandra table
+model it persists into — chunks by (partkey, chunk_id), an ingestion-time
+index for batch jobs, and partkeys with start/end times per shard
+(reference: cassandra/.../TimeSeriesChunksTable.scala:22,
+IngestionTimeIndexTable.scala:22, PartitionKeysTable.scala:15).  Concrete
+backends: in-memory (tests), local disk (persistence.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Iterator, Optional, Sequence
+
+from filodb_tpu.core.chunk import ChunkSet
+
+
+@dataclasses.dataclass
+class PartKeyRecord:
+    partkey: bytes
+    start_time: int
+    end_time: int
+    shard: int
+
+
+class ColumnStore:
+    """Sink + source of persisted chunks.  All times are epoch millis."""
+
+    def initialize(self, dataset: str, num_shards: int) -> None:
+        pass
+
+    # -- sink (flush path) --------------------------------------------------
+
+    def write_chunks(self, dataset: str, shard: int,
+                     chunksets: Sequence[ChunkSet],
+                     ingestion_time: int = 0) -> int:
+        raise NotImplementedError
+
+    def write_part_keys(self, dataset: str, shard: int,
+                        records: Sequence[PartKeyRecord]) -> int:
+        raise NotImplementedError
+
+    # -- source (ODP / recovery path) ---------------------------------------
+
+    def read_raw_partitions(self, dataset: str, shard: int,
+                            partkeys: Sequence[bytes],
+                            start_time: int, end_time: int
+                            ) -> Iterator[tuple[bytes, list[ChunkSet]]]:
+        raise NotImplementedError
+
+    def scan_part_keys(self, dataset: str, shard: int) -> Iterator[PartKeyRecord]:
+        raise NotImplementedError
+
+    def chunksets_by_ingestion_time(self, dataset: str, shard: int,
+                                    start: int, end: int) -> Iterator[ChunkSet]:
+        """Scan-by-ingestion-time for the batch downsampler (reference:
+        getChunksByIngestionTimeRange)."""
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class NullColumnStore(ColumnStore):
+    """Swallows writes; serves nothing (reference: NullColumnStore,
+    ChunkSink.scala:116).  Used by in-memory-only deployments and tests."""
+
+    def __init__(self) -> None:
+        self.chunksets_written = 0
+        self.partkeys_written = 0
+
+    def write_chunks(self, dataset, shard, chunksets, ingestion_time=0) -> int:
+        n = len(chunksets)
+        self.chunksets_written += n
+        return n
+
+    def write_part_keys(self, dataset, shard, records) -> int:
+        self.partkeys_written += len(records)
+        return len(records)
+
+    def read_raw_partitions(self, dataset, shard, partkeys, start_time, end_time):
+        return iter(())
+
+    def scan_part_keys(self, dataset, shard):
+        return iter(())
+
+    def chunksets_by_ingestion_time(self, dataset, shard, start, end):
+        return iter(())
+
+
+class InMemoryColumnStore(ColumnStore):
+    """Everything in host dicts; the test/recovery double with real reads."""
+
+    def __init__(self) -> None:
+        # (dataset, shard) -> partkey -> list[(ingestion_time, ChunkSet)]
+        self._chunks: dict[tuple, dict[bytes, list]] = {}
+        # (dataset, shard) -> partkey -> PartKeyRecord
+        self._partkeys: dict[tuple, dict[bytes, PartKeyRecord]] = {}
+
+    def write_chunks(self, dataset, shard, chunksets, ingestion_time=0) -> int:
+        store = self._chunks.setdefault((dataset, shard), {})
+        for cs in chunksets:
+            store.setdefault(cs.partkey, []).append((ingestion_time, cs))
+        return len(chunksets)
+
+    def write_part_keys(self, dataset, shard, records) -> int:
+        store = self._partkeys.setdefault((dataset, shard), {})
+        for r in records:
+            store[r.partkey] = r
+        return len(records)
+
+    def read_raw_partitions(self, dataset, shard, partkeys, start_time, end_time):
+        store = self._chunks.get((dataset, shard), {})
+        for pk in partkeys:
+            rows = [cs for _, cs in store.get(pk, ())
+                    if cs.info.end_time >= start_time
+                    and cs.info.start_time <= end_time]
+            if rows:
+                yield pk, sorted(rows, key=lambda c: c.info.chunk_id)
+
+    def scan_part_keys(self, dataset, shard):
+        yield from self._partkeys.get((dataset, shard), {}).values()
+
+    def chunksets_by_ingestion_time(self, dataset, shard, start, end):
+        for rows in self._chunks.get((dataset, shard), {}).values():
+            for itime, cs in rows:
+                if start <= itime <= end:
+                    yield cs

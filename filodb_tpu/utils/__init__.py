@@ -1,0 +1,1 @@
+"""Shared utilities: bloom filter, metrics registry, scheduling helpers."""

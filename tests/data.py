@@ -1,0 +1,69 @@
+"""Shared test data generators — the role of the reference's TestData /
+MachineMetricsData / prom-schema producers (reference:
+core/src/test/scala/filodb.core/TestData.scala, gateway
+TestTimeseriesProducer.scala:25)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from filodb_tpu.codecs import histcodec
+from filodb_tpu.core.histogram import GeometricBuckets
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+
+START_TS = 1_600_000_000_000  # fixed epoch millis base
+
+
+def gauge_tags(i: int, metric: str = "heap_usage") -> dict[str, str]:
+    return {"_metric_": metric, "_ws_": "demo", "_ns_": f"App-{i % 8}",
+            "instance": str(i), "host": f"H{i % 4}"}
+
+
+def gauge_containers(n_series: int = 10, n_samples: int = 100,
+                     start: int = START_TS, step: int = 10_000,
+                     metric: str = "heap_usage", seed: int = 42,
+                     container_size: int = 256 * 1024) -> list[bytes]:
+    """Deterministic gauge samples, one RecordContainer batch."""
+    rng = np.random.default_rng(seed)
+    builder = RecordBuilder(DEFAULT_SCHEMAS["gauge"], DatasetOptions(),
+                            container_size=container_size)
+    vals = 50 + 15 * rng.standard_normal((n_series, n_samples))
+    for t in range(n_samples):
+        for s in range(n_series):
+            builder.add(start + t * step, (float(vals[s, t]),), gauge_tags(s, metric))
+    return builder.containers()
+
+
+def counter_containers(n_series: int = 4, n_samples: int = 100,
+                       start: int = START_TS, step: int = 10_000,
+                       metric: str = "http_requests_total", seed: int = 3,
+                       reset_every: int = 0) -> list[bytes]:
+    rng = np.random.default_rng(seed)
+    builder = RecordBuilder(DEFAULT_SCHEMAS["prom-counter"], DatasetOptions())
+    for s in range(n_series):
+        total = 0.0
+        for t in range(n_samples):
+            total += float(rng.integers(0, 10))
+            if reset_every and t and t % reset_every == 0:
+                total = 0.0
+            builder.add(start + t * step, (total,), gauge_tags(s, metric))
+    return builder.containers()
+
+
+def histogram_containers(n_series: int = 2, n_samples: int = 50,
+                         start: int = START_TS, step: int = 10_000,
+                         metric: str = "req_latency", num_buckets: int = 8,
+                         seed: int = 5) -> list[bytes]:
+    rng = np.random.default_rng(seed)
+    buckets = GeometricBuckets(2.0, 2.0, num_buckets)
+    builder = RecordBuilder(DEFAULT_SCHEMAS["prom-histogram"], DatasetOptions())
+    for s in range(n_series):
+        cum = np.zeros(num_buckets, dtype=np.int64)
+        for t in range(n_samples):
+            cum += np.sort(rng.integers(0, 5, num_buckets))
+            blob = histcodec.encode_hist_value(buckets, np.cumsum(cum))
+            total = int(np.cumsum(cum)[-1])
+            builder.add(start + t * step, (float(total), float(total), blob),
+                        gauge_tags(s, metric))
+    return builder.containers()

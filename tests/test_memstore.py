@@ -1,0 +1,395 @@
+"""Memstore layer tests: index, partition, shard, memstore.
+
+Mirrors the reference's memstore spec patterns — TimeSeriesMemStore with
+NullColumnStore fully in-process, recovery with watermarks, eviction
+(reference: core/src/test/scala/filodb.core/memstore/
+TimeSeriesMemStoreSpec.scala, PartKeyLuceneIndexSpec, SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.filters import (ColumnFilter, Equals, EqualsRegex, In,
+                                     NotEquals)
+from filodb_tpu.core.record import RecordBuilder, decode_container
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+from filodb_tpu.core.storeconfig import (IngestionConfig, StoreConfig,
+                                         parse_duration_ms, parse_size)
+from filodb_tpu.memstore import (PartKeyIndex, TimeSeriesMemStore,
+                                 TimeSeriesPartition, TimeSeriesShard)
+from filodb_tpu.store import InMemoryColumnStore, InMemoryMetaStore
+from filodb_tpu.utils.bloom import BloomFilter
+
+from tests.data import (START_TS, counter_containers, gauge_containers,
+                        gauge_tags, histogram_containers)
+
+MAX = np.iinfo(np.int64).max
+
+
+def eq(k, v):
+    return ColumnFilter(k, Equals(v))
+
+
+class TestPartKeyIndex:
+    def make(self, n=10):
+        idx = PartKeyIndex()
+        for i in range(n):
+            tags = gauge_tags(i)
+            idx.add_partkey(i, str(i).encode(), tags, start_time=1000 + i)
+        return idx
+
+    def test_equals_lookup(self):
+        idx = self.make()
+        ids = idx.part_ids_from_filters([eq("_ns_", "App-0")])
+        assert list(ids) == [0, 8]
+
+    def test_intersection(self):
+        idx = self.make()
+        ids = idx.part_ids_from_filters([eq("_ns_", "App-0"), eq("host", "H0")])
+        assert list(ids) == [0, 8]
+        ids = idx.part_ids_from_filters([eq("_ns_", "App-1"), eq("host", "H0")])
+        assert list(ids) == []
+
+    def test_regex_and_in_and_not(self):
+        idx = self.make()
+        ids = idx.part_ids_from_filters([ColumnFilter("_ns_", EqualsRegex("App-[01]"))])
+        assert list(ids) == [0, 1, 8, 9]
+        ids = idx.part_ids_from_filters([ColumnFilter("instance", In(frozenset({"2", "3"})))])
+        assert list(ids) == [2, 3]
+        ids = idx.part_ids_from_filters([ColumnFilter("_ns_", NotEquals("App-0"))])
+        assert 0 not in ids and 8 not in ids and len(ids) == 8
+
+    def test_time_range_overlap(self):
+        idx = self.make()
+        idx.update_end_time(3, 5000)
+        # query starting after part 3 ended excludes it
+        ids = idx.part_ids_from_filters([], start_time=6000)
+        assert 3 not in ids
+        ids = idx.part_ids_from_filters([], start_time=2000, end_time=MAX)
+        assert 3 in ids
+
+    def test_eviction_order(self):
+        idx = self.make()
+        idx.update_end_time(5, 100)
+        idx.update_end_time(2, 50)
+        assert idx.part_ids_ordered_by_end_time(2) == [2, 5]
+
+    def test_label_values_and_names(self):
+        idx = self.make()
+        assert idx.label_values("host") == ["H0", "H1", "H2", "H3"]
+        assert idx.label_values("host", [eq("_ns_", "App-1")]) == ["H1"]
+        assert "instance" in idx.label_names()
+
+    def test_remove(self):
+        idx = self.make()
+        idx.remove([0, 1])
+        assert len(idx) == 8
+        assert 0 not in idx.part_ids_from_filters([eq("_ns_", "App-0")])
+
+
+class TestPartition:
+    def make(self, capacity=50):
+        schema = DEFAULT_SCHEMAS["gauge"]
+        return TimeSeriesPartition(0, schema, b"pk", {"a": "b"}, group=0,
+                                   capacity=capacity)
+
+    def test_append_and_read(self):
+        p = self.make()
+        for i in range(120):
+            assert p.ingest(1000 + i * 10, (float(i),))
+        assert p.num_chunks == 3  # 50+50+20
+        ts, vals = p.read_range(0, MAX)
+        assert len(ts) == 120
+        np.testing.assert_allclose(vals, np.arange(120, dtype=float))
+
+    def test_out_of_order_dropped(self):
+        p = self.make()
+        p.ingest(1000, (1.0,))
+        assert not p.ingest(1000, (2.0,))
+        assert not p.ingest(999, (3.0,))
+        assert p.out_of_order_dropped == 2
+        ts, _ = p.read_range(0, MAX)
+        assert len(ts) == 1
+
+    def test_range_filter(self):
+        p = self.make(capacity=10)
+        for i in range(40):
+            p.ingest(1000 + i * 10, (float(i),))
+        ts, vals = p.read_range(1100, 1200)
+        assert ts[0] == 1100 and ts[-1] == 1200
+        assert len(ts) == 11
+
+    def test_flush_chunks_drain(self):
+        p = self.make(capacity=10)
+        for i in range(25):
+            p.ingest(1000 + i, (float(i),))
+        flushed = p.make_flush_chunks()
+        assert sum(c.info.num_rows for c in flushed) == 25
+        assert p.make_flush_chunks() == []
+        p.ingest(5000, (1.0,))
+        assert sum(c.info.num_rows for c in p.make_flush_chunks()) == 1
+
+
+class TestShardIngest:
+    def make_shard(self, **kw):
+        cfg = StoreConfig(groups_per_shard=4, max_chunks_size=32,
+                          batch_row_pad=16, batch_series_pad=4)
+        return TimeSeriesShard("ds", DEFAULT_SCHEMAS, 0, cfg, **kw)
+
+    def test_ingest_containers(self):
+        shard = self.make_shard()
+        total = 0
+        for off, c in enumerate(gauge_containers(n_series=6, n_samples=50)):
+            total += shard.ingest_container(c, off)
+        assert total == 300
+        assert shard.num_partitions == 6
+        assert shard.stats.rows_ingested == 300
+
+    def test_lookup_and_scan(self):
+        shard = self.make_shard()
+        for off, c in enumerate(gauge_containers(n_series=6, n_samples=50)):
+            shard.ingest_container(c, off)
+        res = shard.lookup_partitions([eq("_metric_", "heap_usage")], 0, MAX)
+        assert len(res.part_ids) == 6
+        tags, batch = shard.scan_batch(res.part_ids, 0, MAX)
+        assert len(tags) == 6
+        assert batch.num_series == 8  # padded to batch_series_pad multiple
+        assert batch.max_rows >= 50
+        assert int(batch.row_counts[:6].sum()) == 300
+        # padding rows are NaN
+        assert np.all(np.isnan(batch.values[6:]))
+
+    def test_scan_time_window(self):
+        shard = self.make_shard()
+        for off, c in enumerate(gauge_containers(n_series=2, n_samples=100)):
+            shard.ingest_container(c, off)
+        t0 = START_TS + 200_000
+        t1 = START_TS + 400_000
+        _, batch = shard.scan_batch([0, 1], t0, t1)
+        real = batch.timestamps[batch.timestamps != np.iinfo(np.int64).max]
+        assert real.min() >= t0 and real.max() <= t1
+
+    def test_multi_schema(self):
+        shard = self.make_shard()
+        off = 0
+        for c in gauge_containers(n_series=2, n_samples=10):
+            shard.ingest_container(c, off); off += 1
+        for c in counter_containers(n_series=2, n_samples=10):
+            shard.ingest_container(c, off); off += 1
+        for c in histogram_containers(n_series=2, n_samples=10):
+            shard.ingest_container(c, off); off += 1
+        assert shard.num_partitions == 6
+        res = shard.lookup_partitions([eq("_metric_", "req_latency")], 0, MAX)
+        tags, batch = shard.scan_batch(res.part_ids, 0, MAX)
+        assert batch.hist is not None
+        assert batch.hist.shape[2] == 8  # buckets
+
+    def test_mixed_schema_scan_locks_first(self):
+        # a filter matching both gauge and histogram partitions must not
+        # crash: the scan locks to the first schema (reference:
+        # MultiSchemaPartitionsExec.finalizePlan picks one schema)
+        shard = self.make_shard()
+        off = 0
+        for c in gauge_containers(n_series=2, n_samples=10):
+            shard.ingest_container(c, off); off += 1
+        for c in histogram_containers(n_series=2, n_samples=10):
+            shard.ingest_container(c, off); off += 1
+        res = shard.lookup_partitions([eq("_ws_", "demo")], 0, MAX)
+        assert res.first_schema_hash is not None
+        tags, batch = shard.scan_batch(res.part_ids, 0, MAX)
+        assert batch is not None
+        assert len(tags) == len(res.part_ids)
+
+    def test_hist_scan_empty_window(self):
+        # window past the newest sample: matched histogram partitions have
+        # zero rows; the scan must return an empty batch, not crash
+        shard = self.make_shard()
+        for off, c in enumerate(histogram_containers(n_series=2, n_samples=5)):
+            shard.ingest_container(c, off)
+        res = shard.lookup_partitions([eq("_metric_", "req_latency")],
+                                      START_TS + 10**9, START_TS + 2 * 10**9)
+        tags, batch = shard.scan_batch(res.part_ids, START_TS + 10**9,
+                                       START_TS + 2 * 10**9)
+        assert batch is None or int(batch.row_counts.sum()) == 0
+
+    def test_histogram_scan_values(self):
+        shard = self.make_shard()
+        for off, c in enumerate(histogram_containers(n_series=1, n_samples=5)):
+            shard.ingest_container(c, off)
+        res = shard.lookup_partitions([eq("_metric_", "req_latency")], 0, MAX)
+        _, batch = shard.scan_batch(res.part_ids, 0, MAX)
+        h = batch.hist[0, :5]
+        # cumulative bucket counts are non-decreasing across buckets and rows
+        assert np.all(np.diff(h, axis=1) >= 0)
+        assert np.all(np.diff(h, axis=0) >= 0)
+
+
+class TestFlushRecovery:
+    def pipeline(self):
+        store = InMemoryColumnStore()
+        meta = InMemoryMetaStore()
+        cfg = StoreConfig(groups_per_shard=2, max_chunks_size=16)
+        shard = TimeSeriesShard("ds", DEFAULT_SCHEMAS, 0, cfg,
+                                column_store=store, meta_store=meta)
+        return shard, store, meta
+
+    def test_flush_writes_chunks_partkeys_checkpoint(self):
+        shard, store, meta = self.pipeline()
+        for off, c in enumerate(gauge_containers(n_series=4, n_samples=40)):
+            shard.ingest_container(c, off)
+        n = shard.flush_all(ingestion_time=123)
+        assert n > 0
+        pks = list(store.scan_part_keys("ds", 0))
+        assert len(pks) == 4
+        cps = meta.read_checkpoints("ds", 0)
+        assert set(cps.keys()) == {0, 1}
+        assert all(v == shard.latest_offset for v in cps.values())
+        # data round-trips through the store
+        pk = pks[0].partkey
+        got = list(store.read_raw_partitions("ds", 0, [pk], 0, MAX))
+        assert len(got) == 1
+        assert sum(cs.info.num_rows for cs in got[0][1]) == 40
+
+    def test_recovery_skips_persisted_records(self):
+        store = InMemoryColumnStore()
+        meta = InMemoryMetaStore()
+        cfg = StoreConfig(groups_per_shard=2, max_chunks_size=16)
+        ms = TimeSeriesMemStore(store, meta)
+        ms.setup("ds", DEFAULT_SCHEMAS, 0, cfg)
+        containers = gauge_containers(n_series=4, n_samples=30,
+                                      container_size=4096)
+        stream = list(enumerate(containers))
+        for off, c in stream[: len(stream) // 2]:
+            ms.ingest("ds", 0, c, off)
+        ms.get_shard("ds", 0).flush_all()
+        persisted_offset = ms.get_shard("ds", 0).latest_offset
+
+        # "restart": new memstore over the same stores
+        ms2 = TimeSeriesMemStore(store, meta)
+        ms2.setup("ds", DEFAULT_SCHEMAS, 0, cfg)
+        ms2.recover_index("ds", 0)
+        shard2 = ms2.get_shard("ds", 0)
+        assert len(shard2.index) == 4
+        n = ms2.recover_stream("ds", 0, [(off, c) for off, c in stream])
+        # records at offsets <= checkpoint were skipped
+        assert shard2.stats.rows_skipped > 0
+        total = sum(1 for off, c in stream
+                    for _ in decode_container(c, DEFAULT_SCHEMAS))
+        assert n < total
+        # post-recovery data covers only post-checkpoint offsets
+        assert shard2.latest_offset == len(stream) - 1
+
+    def test_eviction(self):
+        shard, store, meta = self.pipeline()
+        for off, c in enumerate(gauge_containers(n_series=6, n_samples=10)):
+            shard.ingest_container(c, off)
+        shard.flush_all()
+        # mark two series stopped long ago
+        evicted_pks = [shard.index.partkey(0), shard.index.partkey(1)]
+        shard.index.update_end_time(0, 100)
+        shard.index.update_end_time(1, 200)
+        assert shard.evict_partitions(2) == 2
+        assert shard.num_partitions == 4
+        assert shard.stats.partitions_evicted == 2
+        # evicted keys are recorded in the bloom filter
+        assert all(pk in shard.evicted_keys for pk in evicted_pks)
+
+    def test_recover_then_reingest_no_duplicates(self):
+        # resumed ingest after index recovery must reuse recovered part ids
+        store = InMemoryColumnStore()
+        meta = InMemoryMetaStore()
+        cfg = StoreConfig(groups_per_shard=2, max_chunks_size=16)
+        ms = TimeSeriesMemStore(store, meta)
+        ms.setup("ds", DEFAULT_SCHEMAS, 0, cfg)
+        for off, c in enumerate(gauge_containers(n_series=4, n_samples=10)):
+            ms.ingest("ds", 0, c, off)
+        ms.flush("ds", 0)
+
+        ms2 = TimeSeriesMemStore(store, meta)
+        ms2.setup("ds", DEFAULT_SCHEMAS, 0, cfg)
+        assert ms2.recover_index("ds", 0) == 4
+        shard2 = ms2.get_shard("ds", 0)
+        # live ingest of the SAME series resumes under recovered part ids
+        late = gauge_containers(n_series=4, n_samples=10,
+                                start=START_TS + 10**7)
+        for off, c in enumerate(late, start=100):
+            ms2.ingest("ds", 0, c, off)
+        assert len(shard2.index) == 4
+        assert shard2.num_partitions == 4
+        assert len(shard2.part_keys([eq("_metric_", "heap_usage")], 0, MAX)) == 4
+
+    def test_purge_expired(self):
+        shard, *_ = self.pipeline()
+        for off, c in enumerate(gauge_containers(n_series=3, n_samples=5)):
+            shard.ingest_container(c, off)
+        now = START_TS + 10**9
+        assert shard.purge_expired(retention_ms=1000, now_ms=now) == 3
+        assert shard.num_partitions == 0
+
+    def test_mark_stopped_series(self):
+        shard, *_ = self.pipeline()
+        for off, c in enumerate(gauge_containers(n_series=2, n_samples=5)):
+            shard.ingest_container(c, off)
+        n = shard.mark_stopped_series(now_ms=START_TS + 10**9, stale_ms=1000)
+        assert n == 2
+        # they become excluded from queries starting after their end
+        ids = shard.index.part_ids_from_filters([], start_time=START_TS + 10**8)
+        assert len(ids) == 0
+
+
+class TestMemStore:
+    def test_multi_shard_label_values(self):
+        ms = TimeSeriesMemStore()
+        cfg = StoreConfig(groups_per_shard=2)
+        ms.setup("ds", DEFAULT_SCHEMAS, 0, cfg)
+        ms.setup("ds", DEFAULT_SCHEMAS, 1, cfg)
+        for off, c in enumerate(gauge_containers(n_series=4, n_samples=5)):
+            ms.ingest("ds", 0, c, off)
+        for off, c in enumerate(gauge_containers(n_series=8, n_samples=5)):
+            ms.ingest("ds", 1, c, off)
+        assert ms.active_shards("ds") == [0, 1]
+        vals = ms.label_values("ds", "instance")
+        assert vals == sorted({str(i) for i in range(8)})
+
+    def test_setup_twice_raises(self):
+        ms = TimeSeriesMemStore()
+        ms.setup("ds", DEFAULT_SCHEMAS, 0)
+        with pytest.raises(ValueError):
+            ms.setup("ds", DEFAULT_SCHEMAS, 0)
+
+
+class TestStoreConfig:
+    def test_parsers(self):
+        assert parse_duration_ms("1 hour") == 3_600_000
+        assert parse_duration_ms("5m") == 300_000
+        assert parse_duration_ms("300ms") == 300
+        assert parse_size("512MB") == 512 * 1024 * 1024
+        assert parse_size(1024) == 1024
+
+    def test_from_config(self):
+        cfg = StoreConfig.from_config({"flush-interval": "2h",
+                                       "max-chunks-size": 100,
+                                       "shard-mem-size": "256MB"})
+        assert cfg.flush_interval_ms == 7_200_000
+        assert cfg.max_chunks_size == 100
+        assert cfg.shard_mem_size == 256 * 1024 * 1024
+
+    def test_ingestion_config_shard_power_of_two(self):
+        with pytest.raises(ValueError):
+            IngestionConfig(dataset="d", num_shards=6)
+        ic = IngestionConfig.from_config(
+            {"dataset": "timeseries", "num-shards": 8,
+             "sourceconfig": {"store": {"flush-interval": "1h"}}})
+        assert ic.num_shards == 8
+
+
+class TestBloom:
+    def test_membership(self):
+        bf = BloomFilter(1000)
+        keys = [f"key-{i}".encode() for i in range(500)]
+        for k in keys:
+            bf.add(k)
+        assert all(k in bf for k in keys)
+        fp = sum(1 for i in range(10_000)
+                 if f"other-{i}".encode() in bf)
+        assert fp < 300  # ~1% target
