@@ -1,0 +1,9 @@
+"""Query engine: logical plans, exec plans, transformers, aggregators
+(reference: query/src/main/scala/filodb/query/ + filodb.query.exec)."""
+
+from filodb_tpu.query.model import (PeriodicBatch, QueryContext, QueryError,
+                                    QueryResult, RawBatch, ScalarResult)
+from filodb_tpu.query.logical import *  # noqa: F401,F403 - plan ADT surface
+
+__all__ = ["PeriodicBatch", "QueryContext", "QueryError", "QueryResult",
+           "RawBatch", "ScalarResult"]

@@ -1,0 +1,372 @@
+"""Batched aggregators: map (shard-local) / reduce (cross-shard) / present.
+
+Replaces the reference's RowAggregator family + fastReduce
+(reference: query/exec/aggregator/RowAggregator.scala:29,114-141,
+exec/AggrOverRangeVectors.scala:151-277).  The map phase runs device
+segment-reductions over [S, T] batches; partial state is a dict of [G, ...]
+arrays mergeable across shards (the analog of the reference's transportable
+aggregate rows); present converts final state to a PeriodicBatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from filodb_tpu.ops import aggregate as segops
+from filodb_tpu.ops.windows import StepRange
+from filodb_tpu.query.logical import AggregationOperator as Op
+from filodb_tpu.query.model import PeriodicBatch, QueryError
+
+
+@dataclasses.dataclass
+class AggPartialBatch:
+    """Mergeable aggregation state: per-group arrays keyed by name."""
+
+    op: Op
+    params: tuple
+    group_keys: list[dict]
+    steps: StepRange
+    state: dict[str, np.ndarray]
+    # series keys for ops whose reduce needs original series (topk/quantile)
+    series_keys: Optional[list[dict]] = None
+
+    @property
+    def num_series(self) -> int:
+        return len(self.group_keys)
+
+
+def grouping_key(tags: dict, by: tuple, without: tuple, metric_col: str = "_metric_"):
+    """The output key of by/without grouping (reference: AggregateMapReduce
+    grouping): plain aggregation collapses to one group; ``without`` keeps
+    the complement (minus the metric name); ``by`` keeps exactly those."""
+    if by:
+        return {k: tags.get(k, "") for k in by if k in tags}
+    if without:
+        drop = set(without) | {metric_col}
+        return {k: v for k, v in tags.items() if k not in drop}
+    return {}
+
+
+def _group(keys: Sequence[dict], by, without, limit: int):
+    gk = [tuple(sorted(grouping_key(t, by, without).items())) for t in keys]
+    ids, uniq = segops.group_ids(gk)
+    if len(uniq) > limit:
+        raise QueryError("", f"group-by cardinality {len(uniq)} exceeds limit {limit}")
+    return ids, [dict(u) for u in uniq]
+
+
+def _padded_ids(ids: np.ndarray, total_series: int, num_groups: int) -> jnp.ndarray:
+    """Pad ids to the padded series axis; padding rows land in a garbage
+    group that is sliced off after the segment reduction."""
+    out = np.full(total_series, num_groups, dtype=np.int32)
+    out[:len(ids)] = ids
+    return jnp.asarray(out)
+
+
+class Aggregator:
+    op: Op
+
+    def map(self, batch: PeriodicBatch, by, without, params, limit) -> AggPartialBatch:
+        raise NotImplementedError
+
+    def reduce(self, partials: list[AggPartialBatch]) -> AggPartialBatch:
+        raise NotImplementedError
+
+    def present(self, partial: AggPartialBatch) -> PeriodicBatch:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# moment-based aggregators share alignment machinery
+# ---------------------------------------------------------------------------
+
+def _align(partials: list[AggPartialBatch], fill: float):
+    """Union group keys; each partial's arrays scatter into union rows."""
+    index: dict[tuple, int] = {}
+    for p in partials:
+        for k in p.group_keys:
+            index.setdefault(tuple(sorted(k.items())), len(index))
+    G = len(index)
+    names = partials[0].state.keys()
+    aligned = {n: [] for n in names}
+    for p in partials:
+        rows = np.array([index[tuple(sorted(k.items()))] for k in p.group_keys],
+                        dtype=np.int64)
+        for n in names:
+            arr = np.asarray(p.state[n])
+            f = -1 if np.issubdtype(arr.dtype, np.integer) else fill
+            out = np.full((G,) + arr.shape[1:], f, dtype=arr.dtype)
+            if len(rows):
+                out[rows] = arr
+            aligned[n].append(out)
+    keys = [dict(k) for k in index.keys()]
+    return keys, aligned
+
+
+def _nansum_stack(arrs: list[np.ndarray]) -> np.ndarray:
+    stack = np.stack(arrs)
+    allnan = np.all(np.isnan(stack), axis=0)
+    s = np.nansum(stack, axis=0)
+    return np.where(allnan, np.nan, s)
+
+
+class MomentAggregator(Aggregator):
+    """sum/count/min/max/avg/stddev/stdvar/group via (sum, sumsq, count,
+    min, max) moments — one implementation, different presenters."""
+
+    def __init__(self, op: Op):
+        self.op = op
+
+    _NEEDS = {
+        Op.SUM: ("sum", "count"), Op.COUNT: ("count",),
+        Op.MIN: ("min",), Op.MAX: ("max",),
+        Op.AVG: ("sum", "count"), Op.GROUP: ("count",),
+        Op.STDDEV: ("sum", "sumsq", "count"),
+        Op.STDVAR: ("sum", "sumsq", "count"),
+    }
+
+    def map(self, batch, by, without, params, limit):
+        ids, keys = _group(batch.keys, by, without, limit)
+        G = len(keys)
+        vals = jnp.asarray(batch.values)
+        pids = _padded_ids(ids, vals.shape[0], G)
+        state = {}
+        needs = self._NEEDS[self.op]
+        if "sum" in needs or "count" in needs:
+            fin = jnp.isfinite(vals)
+            s = jax.ops.segment_sum(jnp.where(fin, vals, 0.0), pids, G + 1)[:G]
+            n = jax.ops.segment_sum(fin.astype(vals.dtype), pids, G + 1)[:G]
+            if "sum" in needs:
+                state["sum"] = np.asarray(s)
+            if "count" in needs:
+                state["count"] = np.asarray(n)
+        if "sumsq" in needs:
+            fin = jnp.isfinite(vals)
+            sq = jax.ops.segment_sum(jnp.where(fin, vals * vals, 0.0), pids,
+                                     G + 1)[:G]
+            state["sumsq"] = np.asarray(sq)
+        if "min" in needs:
+            state["min"] = np.asarray(
+                segops.seg_min(vals, pids, G + 1)[:G])
+        if "max" in needs:
+            state["max"] = np.asarray(
+                segops.seg_max(vals, pids, G + 1)[:G])
+        return AggPartialBatch(self.op, params, keys, batch.steps, state)
+
+    def reduce(self, partials):
+        first = partials[0]
+        keys, aligned = _align(partials, np.nan)
+        state = {}
+        for n, arrs in aligned.items():
+            if n in ("sum", "sumsq"):
+                state[n] = _nansum_stack(arrs)
+            elif n == "count":
+                zeroed = [np.nan_to_num(a, nan=0.0) for a in arrs]
+                state[n] = np.sum(np.stack(zeroed), axis=0)
+            elif n == "min":
+                state[n] = np.nanmin(np.stack(arrs), axis=0)
+            elif n == "max":
+                state[n] = np.nanmax(np.stack(arrs), axis=0)
+        return AggPartialBatch(self.op, first.params, keys, first.steps, state)
+
+    def present(self, p):
+        s = p.state
+        if self.op == Op.SUM:
+            vals = np.where(s["count"] > 0, s["sum"], np.nan)
+        elif self.op == Op.COUNT:
+            vals = np.where(s["count"] > 0, s["count"], np.nan)
+        elif self.op == Op.GROUP:
+            vals = np.where(s["count"] > 0, 1.0, np.nan)
+        elif self.op == Op.MIN:
+            vals = s["min"]
+        elif self.op == Op.MAX:
+            vals = s["max"]
+        elif self.op == Op.AVG:
+            n = s["count"]
+            vals = np.where(n > 0, s["sum"] / np.maximum(n, 1.0), np.nan)
+        else:  # stddev / stdvar
+            n = s["count"]
+            nsafe = np.maximum(n, 1.0)
+            mean = s["sum"] / nsafe
+            var = np.maximum(s["sumsq"] / nsafe - mean * mean, 0.0)
+            if self.op == Op.STDDEV:
+                var = np.sqrt(var)
+            vals = np.where(n > 0, var, np.nan)
+        return PeriodicBatch(p.group_keys, p.steps, vals)
+
+
+class TopBottomKAggregator(Aggregator):
+    """topk/bottomk: map keeps k candidate (value, series) slots per group per
+    step; reduce concatenates candidate slots and re-selects; present emits
+    the original contributing series with NaN at unselected steps
+    (reference: TopBottomKRowAggregator)."""
+
+    def __init__(self, op: Op):
+        self.op = op
+
+    def map(self, batch, by, without, params, limit):
+        k = int(params[0])
+        ids, keys = _group(batch.keys, by, without, limit)
+        G = len(keys)
+        vals = jnp.asarray(batch.values)
+        pids = _padded_ids(ids, vals.shape[0], G)
+        values, sidx = segops.seg_topk(vals, pids, G + 1, k,
+                                       bottom=self.op == Op.BOTTOMK)
+        return AggPartialBatch(self.op, params, keys, batch.steps,
+                               {"values": np.asarray(values[:G]),
+                                "sidx": np.asarray(sidx[:G])},
+                               series_keys=list(batch.keys))
+
+    def reduce(self, partials):
+        k = int(partials[0].params[0])
+        # remap per-partial series indices into a combined series key list
+        all_keys: list[dict] = []
+        offsets = []
+        for p in partials:
+            offsets.append(len(all_keys))
+            all_keys.extend(p.series_keys or [])
+        keys, aligned = _align(partials, np.nan)
+        cands_v, cands_i = [], []
+        for p, off, av, ai in zip(partials, offsets, aligned["values"],
+                                  aligned["sidx"]):
+            sidx = ai.astype(np.int64)
+            remapped = np.where(sidx >= 0, sidx + off, -1)
+            cands_v.append(av)
+            cands_i.append(remapped)
+        V = np.concatenate(cands_v, axis=1)   # [G, sum_k, T]
+        I = np.concatenate(cands_i, axis=1)
+        sign = -1.0 if self.op == Op.BOTTOMK else 1.0
+        work = np.where(np.isfinite(V), V * sign, -np.inf)
+        order = np.argsort(-work, axis=1, kind="stable")[:, :k]   # [G,k,T]
+        top_v = np.take_along_axis(V, order, axis=1)
+        top_i = np.take_along_axis(I, order, axis=1)
+        top_w = np.take_along_axis(work, order, axis=1)
+        top_v = np.where(np.isfinite(top_w), top_v, np.nan)
+        top_i = np.where(np.isfinite(top_w), top_i, -1)
+        return AggPartialBatch(self.op, partials[0].params, keys,
+                               partials[0].steps,
+                               {"values": top_v, "sidx": top_i.astype(np.int32)},
+                               series_keys=all_keys)
+
+    def present(self, p):
+        V, I = p.state["values"], p.state["sidx"].astype(np.int64)
+        skeys = p.series_keys or []
+        G, k, T = V.shape
+        out_keys: list[dict] = []
+        rows: list[np.ndarray] = []
+        import warnings
+        for g in range(G):
+            used = np.unique(I[g])
+            for s in used:
+                if s < 0:
+                    continue
+                row = np.full(T, np.nan)
+                mask = I[g] == s                     # [k, T]
+                sel = np.where(mask, V[g], np.nan)
+                if mask.any():
+                    with warnings.catch_warnings():
+                        warnings.simplefilter("ignore", RuntimeWarning)
+                        row = np.nanmax(sel, axis=0)
+                out_keys.append(skeys[int(s)])
+                rows.append(row)
+        vals = np.stack(rows) if rows else np.empty((0, T))
+        return PeriodicBatch(out_keys, p.steps, vals)
+
+
+class QuantileAggregator(Aggregator):
+    """Exact quantile: map carries per-group member values (padded member
+    axis); reduce concatenates members; present takes nanquantile.  The
+    reference approximates with t-digest (QuantileRowAggregator) — we keep
+    exactness; cardinality limits bound the member axis."""
+
+    op = Op.QUANTILE
+
+    def map(self, batch, by, without, params, limit):
+        ids, keys = _group(batch.keys, by, without, limit)
+        G = len(keys)
+        vals = np.asarray(batch.values)[:len(batch.keys)]
+        T = vals.shape[1]
+        counts = np.bincount(ids, minlength=G) if len(ids) else np.zeros(G, int)
+        M = int(counts.max()) if G else 0
+        dense = np.full((G, max(M, 1), T), np.nan)
+        pos = np.zeros(G, dtype=np.int64)
+        for s, g in enumerate(ids):
+            dense[g, pos[g]] = vals[s]
+            pos[g] += 1
+        return AggPartialBatch(self.op, params, keys, batch.steps,
+                               {"members": dense})
+
+    def reduce(self, partials):
+        keys, aligned = _align(partials, np.nan)
+        members = np.concatenate(aligned["members"], axis=1)
+        return AggPartialBatch(self.op, partials[0].params, keys,
+                               partials[0].steps, {"members": members})
+
+    def present(self, p):
+        q = float(p.params[0])
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            vals = np.nanquantile(p.state["members"], q, axis=1)
+        return PeriodicBatch(p.group_keys, p.steps, vals)
+
+
+class CountValuesAggregator(Aggregator):
+    """count_values("label", v): per-step count of each distinct value
+    (reference: CountValuesRowAggregator).  Host-side — output cardinality
+    is data-dependent."""
+
+    op = Op.COUNT_VALUES
+
+    def map(self, batch, by, without, params, limit):
+        # pass-through of member values, same layout as quantile
+        return QuantileAggregator().map(batch, by, without, params, limit)
+
+    def reduce(self, partials):
+        p = QuantileAggregator().reduce(partials)
+        p.op = self.op
+        return p
+
+    def present(self, p):
+        label = str(p.params[0])
+        members = p.state["members"]            # [G, M, T]
+        G, M, T = members.shape
+        out_keys, rows = [], []
+        for g in range(G):
+            vals = members[g]
+            uniq = np.unique(vals[np.isfinite(vals)])
+            for u in uniq:
+                cnt = np.sum(vals == u, axis=0).astype(float)  # [T]
+                key = dict(p.group_keys[g])
+                key[label] = _fmt_value(float(u))
+                out_keys.append(key)
+                rows.append(np.where(cnt > 0, cnt, np.nan))
+        valsarr = np.stack(rows) if rows else np.empty((0, T))
+        return PeriodicBatch(out_keys, p.steps, valsarr)
+
+
+def _fmt_value(v: float) -> str:
+    return str(int(v)) if v == int(v) else repr(v)
+
+
+_AGGREGATORS = {
+    **{op: (lambda op=op: MomentAggregator(op)) for op in
+       (Op.SUM, Op.COUNT, Op.MIN, Op.MAX, Op.AVG, Op.STDDEV, Op.STDVAR,
+        Op.GROUP)},
+    Op.TOPK: lambda: TopBottomKAggregator(Op.TOPK),
+    Op.BOTTOMK: lambda: TopBottomKAggregator(Op.BOTTOMK),
+    Op.QUANTILE: lambda: QuantileAggregator(),
+    Op.COUNT_VALUES: lambda: CountValuesAggregator(),
+}
+
+
+def aggregator_for(op: Op) -> Aggregator:
+    try:
+        return _AGGREGATORS[op]()
+    except KeyError:
+        raise ValueError(f"unsupported aggregation operator {op}")

@@ -1,0 +1,510 @@
+"""ExecPlan tree: scatter-gather physical plans.
+
+Mirrors the reference's ExecPlan machinery (reference: query/src/main/scala/
+filodb/query/exec/ExecPlan.scala:40,278,337): ``execute`` = do_execute then
+apply transformers then enforce limits; non-leaf plans dispatch children via
+their PlanDispatcher and compose.  The in-process dispatcher is the local
+path; the shard/mesh dispatchers live in filodb_tpu.parallel.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from filodb_tpu.core.filters import ColumnFilter
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.ops import instant as instant_ops
+from filodb_tpu.ops.windows import StepRange
+from filodb_tpu.query.aggregators import AggPartialBatch, aggregator_for
+from filodb_tpu.query.logical import (AggregationOperator, BinaryOperator,
+                                      Cardinality, ScalarFunctionId)
+from filodb_tpu.query.model import (PeriodicBatch, QueryContext, QueryError,
+                                    QueryResult, QueryStats, RawBatch,
+                                    ScalarResult, concat_periodic)
+from filodb_tpu.query.transformers import RangeVectorTransformer, _drop_metric
+
+
+@dataclasses.dataclass
+class ExecContext:
+    """What a plan needs to run locally: the data source + query knobs."""
+
+    memstore: TimeSeriesMemStore
+    query_context: QueryContext = dataclasses.field(default_factory=QueryContext)
+    parallelism: int = 8
+
+
+class PlanDispatcher:
+    """Moves an ExecPlan to where its data lives (reference:
+    PlanDispatcher.scala:20 — ActorPlanDispatcher / InProcessPlanDispatcher).
+    """
+
+    def dispatch(self, plan: "ExecPlan", ctx: ExecContext) -> QueryResult:
+        raise NotImplementedError
+
+
+class InProcessDispatcher(PlanDispatcher):
+    def dispatch(self, plan, ctx):
+        return plan.execute(ctx)
+
+
+IN_PROCESS = InProcessDispatcher()
+
+
+class ExecPlan:
+    def __init__(self, query_context: Optional[QueryContext] = None,
+                 dispatcher: PlanDispatcher = IN_PROCESS):
+        self.query_context = query_context or QueryContext()
+        self.dispatcher = dispatcher
+        self.transformers: list[RangeVectorTransformer] = []
+
+    def add_transformer(self, t: RangeVectorTransformer) -> "ExecPlan":
+        self.transformers.append(t)
+        return self
+
+    @property
+    def children(self) -> Sequence["ExecPlan"]:
+        return ()
+
+    def do_execute(self, ctx: ExecContext) -> list:
+        raise NotImplementedError
+
+    def execute(self, ctx: ExecContext) -> QueryResult:
+        try:
+            batches = self.do_execute(ctx)
+            for t in self.transformers:
+                batches = t.apply(batches, ctx)
+            self._enforce_limits(batches, ctx)
+            stats = self._collect_stats(batches)
+            return QueryResult(self.query_context.query_id, batches, stats)
+        except QueryError:
+            raise
+        except Exception as e:  # noqa: BLE001 - plan failure surfaces as QueryError
+            raise QueryError(self.query_context.query_id,
+                             f"{type(self).__name__}: {e}") from e
+
+    def _enforce_limits(self, batches, ctx):
+        total = 0
+        for b in batches:
+            if isinstance(b, PeriodicBatch):
+                total += len(b.keys) * b.steps.num_steps
+        if total > ctx.query_context.sample_limit:
+            raise QueryError(
+                self.query_context.query_id,
+                f"result samples {total} > limit {ctx.query_context.sample_limit}")
+
+    @staticmethod
+    def _collect_stats(batches) -> QueryStats:
+        st = QueryStats()
+        for b in batches:
+            st.series_scanned += getattr(b, "num_series", 0)
+        return st
+
+    # -- debugging ----------------------------------------------------------
+
+    def print_tree(self, level: int = 0) -> str:
+        """Plan-shape dump used by planner tests (reference:
+        ExecPlan.printTree)."""
+        pad = "-" * level
+        lines = [f"{pad}T~{type(t).__name__}" for t in reversed(self.transformers)]
+        lines.append(f"{pad}E~{type(self).__name__}({self._args_str()})")
+        for c in self.children:
+            lines.append(c.print_tree(level + 1))
+        return "\n".join(lines)
+
+    def _args_str(self) -> str:
+        return ""
+
+
+class LeafExecPlan(ExecPlan):
+    pass
+
+
+class NonLeafExecPlan(ExecPlan):
+    def __init__(self, children: Sequence[ExecPlan],
+                 query_context: Optional[QueryContext] = None,
+                 dispatcher: PlanDispatcher = IN_PROCESS,
+                 parallel_children: bool = True):
+        super().__init__(query_context, dispatcher)
+        self._children = list(children)
+        self.parallel_children = parallel_children
+
+    @property
+    def children(self) -> Sequence[ExecPlan]:
+        return self._children
+
+    def do_execute(self, ctx: ExecContext) -> list:
+        results = self._dispatch_children(ctx)
+        return self.compose(results, ctx)
+
+    def _dispatch_children(self, ctx) -> list[QueryResult]:
+        """Children run via their own dispatchers, concurrently (reference:
+        NonLeafExecPlan.doExecute mapAsync, ExecPlan.scala:370-409)."""
+        kids = self._children
+        if len(kids) <= 1 or not self.parallel_children:
+            return [c.dispatcher.dispatch(c, ctx) for c in kids]
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(len(kids), ctx.parallelism)) as pool:
+            futs = [pool.submit(c.dispatcher.dispatch, c, ctx) for c in kids]
+            return [f.result() for f in futs]
+
+    def compose(self, results: list[QueryResult], ctx) -> list:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Leaves
+# ---------------------------------------------------------------------------
+
+class MultiSchemaPartitionsExec(LeafExecPlan):
+    """Leaf scan: index lookup + device batch materialization (reference:
+    exec/MultiSchemaPartitionsExec.scala:27 + SelectRawPartitionsExec)."""
+
+    def __init__(self, dataset: str, shard: int,
+                 filters: Sequence[ColumnFilter], start_ms: int, end_ms: int,
+                 column: Optional[str] = None,
+                 query_context: Optional[QueryContext] = None,
+                 dispatcher: PlanDispatcher = IN_PROCESS):
+        super().__init__(query_context, dispatcher)
+        self.dataset = dataset
+        self.shard = shard
+        self.filters = list(filters)
+        self.start_ms = start_ms
+        self.end_ms = end_ms
+        self.column = column
+
+    def do_execute(self, ctx: ExecContext) -> list:
+        shard = ctx.memstore.get_shard(self.dataset, self.shard)
+        lookup = shard.lookup_partitions(self.filters, self.start_ms,
+                                         self.end_ms)
+        column_id = None
+        if self.column is not None and lookup.first_schema_hash is not None:
+            schema = shard.schemas.by_hash(lookup.first_schema_hash)
+            column_id = schema.data.column(self.column).id
+        tags, batch = shard.scan_batch(lookup.part_ids, self.start_ms,
+                                       self.end_ms, column_id)
+        return [RawBatch(tags, batch)]
+
+    def _args_str(self) -> str:
+        return f"dataset={self.dataset}, shard={self.shard}, " \
+               f"filters={self.filters}, start={self.start_ms}, end={self.end_ms}"
+
+
+class EmptyResultExec(LeafExecPlan):
+    def do_execute(self, ctx):
+        return []
+
+
+class PartKeysExec(LeafExecPlan):
+    """Metadata: series keys matching filters (reference:
+    exec/MetadataExecPlan.scala PartKeysExec)."""
+
+    def __init__(self, dataset: str, shard: int,
+                 filters: Sequence[ColumnFilter], start_ms: int, end_ms: int,
+                 query_context=None, dispatcher: PlanDispatcher = IN_PROCESS):
+        super().__init__(query_context, dispatcher)
+        self.dataset = dataset
+        self.shard = shard
+        self.filters = list(filters)
+        self.start_ms = start_ms
+        self.end_ms = end_ms
+
+    def do_execute(self, ctx):
+        shard = ctx.memstore.get_shard(self.dataset, self.shard)
+        return [shard.part_keys(self.filters, self.start_ms, self.end_ms)]
+
+
+class LabelValuesExec(LeafExecPlan):
+    def __init__(self, dataset: str, shard: int, label_names: Sequence[str],
+                 filters: Sequence[ColumnFilter], start_ms: int, end_ms: int,
+                 query_context=None, dispatcher: PlanDispatcher = IN_PROCESS):
+        super().__init__(query_context, dispatcher)
+        self.dataset = dataset
+        self.shard = shard
+        self.label_names = list(label_names)
+        self.filters = list(filters)
+        self.start_ms = start_ms
+        self.end_ms = end_ms
+
+    def do_execute(self, ctx):
+        shard = ctx.memstore.get_shard(self.dataset, self.shard)
+        return [{label: shard.label_values(label, self.filters, self.start_ms,
+                                           self.end_ms)
+                 for label in self.label_names}]
+
+
+# ---------------------------------------------------------------------------
+# Scalar leaves
+# ---------------------------------------------------------------------------
+
+class ScalarFixedDoubleExec(LeafExecPlan):
+    def __init__(self, scalar: float, start_ms: int, step_ms: int, end_ms: int,
+                 query_context=None, dispatcher: PlanDispatcher = IN_PROCESS):
+        super().__init__(query_context, dispatcher)
+        self.scalar = scalar
+        self.steps = StepRange(start_ms, end_ms, step_ms)
+
+    def do_execute(self, ctx):
+        return [ScalarResult(self.steps,
+                             np.full(self.steps.num_steps, self.scalar))]
+
+
+class TimeScalarGeneratorExec(LeafExecPlan):
+    """time(), hour(), minute()... as per-step scalars (reference:
+    exec/TimeScalarGeneratorExec.scala:91)."""
+
+    def __init__(self, function: ScalarFunctionId, start_ms: int, step_ms: int,
+                 end_ms: int, query_context=None,
+                 dispatcher: PlanDispatcher = IN_PROCESS):
+        super().__init__(query_context, dispatcher)
+        self.function = function
+        self.steps = StepRange(start_ms, end_ms, step_ms)
+
+    def do_execute(self, ctx):
+        secs = np.asarray(self.steps.timestamps(), dtype=np.float64) / 1000.0
+        if self.function == ScalarFunctionId.TIME:
+            vals = secs
+        else:
+            fn = instant_ops.INSTANT_FUNCTIONS[self.function.value]
+            import jax.numpy as jnp
+            vals = np.asarray(fn(jnp.asarray(secs[None, :] * 1000.0)))[0]
+        return [ScalarResult(self.steps, vals)]
+
+
+# ---------------------------------------------------------------------------
+# Non-leaves
+# ---------------------------------------------------------------------------
+
+class ReduceAggregateExec(NonLeafExecPlan):
+    """Cross-shard (or cross-slice) aggregation reduce (reference:
+    ReduceAggregateExec, AggrOverRangeVectors.scala:19-66)."""
+
+    def __init__(self, children, operator: AggregationOperator,
+                 params: tuple = (), query_context=None,
+                 dispatcher: PlanDispatcher = IN_PROCESS):
+        super().__init__(children, query_context, dispatcher)
+        self.operator = operator
+        self.params = params
+
+    def compose(self, results, ctx):
+        partials = [b for r in results for b in r.batches
+                    if isinstance(b, AggPartialBatch)]
+        if not partials:
+            return []
+        agg = aggregator_for(self.operator)
+        return [agg.reduce(partials)]
+
+    def _args_str(self):
+        return f"operator={self.operator.name}"
+
+
+class DistConcatExec(NonLeafExecPlan):
+    """Concatenate child results (reference: DistConcatExec.scala:12)."""
+
+    def compose(self, results, ctx):
+        return [b for r in results for b in r.batches]
+
+
+class StitchRvsExec(NonLeafExecPlan):
+    """Concat + stitch split series (reference: StitchRvsExec.scala:61)."""
+
+    def compose(self, results, ctx):
+        from filodb_tpu.query.transformers import StitchRvsMapper
+        batches = [b for r in results for b in r.batches]
+        return StitchRvsMapper().apply(batches, ctx)
+
+
+class BinaryJoinExec(NonLeafExecPlan):
+    """Hash join on `on`/`ignoring` labels (reference:
+    BinaryJoinExec.scala:37).  lhs children come first in the children list;
+    ``lhs_count`` splits them."""
+
+    def __init__(self, children, lhs_count: int, operator: BinaryOperator,
+                 cardinality: Cardinality = Cardinality.ONE_TO_ONE,
+                 on: tuple = (), ignoring: tuple = (), include: tuple = (),
+                 query_context=None, dispatcher: PlanDispatcher = IN_PROCESS):
+        super().__init__(children, query_context, dispatcher)
+        self.lhs_count = lhs_count
+        self.operator = operator
+        self.cardinality = cardinality
+        self.on = tuple(on)
+        self.ignoring = tuple(ignoring)
+        self.include = tuple(include)
+
+    def _join_key(self, tags: dict) -> tuple:
+        if self.on:
+            return tuple((k, tags.get(k, "")) for k in sorted(self.on))
+        drop = set(self.ignoring) | {"_metric_", "__name__"}
+        return tuple(sorted((k, v) for k, v in tags.items() if k not in drop))
+
+    def compose(self, results, ctx):
+        lhs_b = concat_periodic([b for r in results[:self.lhs_count]
+                                 for b in r.batches
+                                 if isinstance(b, PeriodicBatch)])
+        rhs_b = concat_periodic([b for r in results[self.lhs_count:]
+                                 for b in r.batches
+                                 if isinstance(b, PeriodicBatch)])
+        if lhs_b is None or rhs_b is None:
+            return []
+        lv, rv = lhs_b.np_values(), rhs_b.np_values()
+        # hash side = the "one" side (reference puts smaller on build side)
+        rkeys: dict[tuple, int] = {}
+        for i, t in enumerate(rhs_b.keys):
+            k = self._join_key(t)
+            if k in rkeys and self.cardinality == Cardinality.ONE_TO_ONE:
+                raise QueryError(self.query_context.query_id,
+                                 "duplicate series on right side of join")
+            rkeys.setdefault(k, i)
+        out_keys, rows = [], []
+        seen: set[tuple] = set()
+        many_on_left = self.cardinality != Cardinality.ONE_TO_MANY
+        for i, t in enumerate(lhs_b.keys):
+            k = self._join_key(t)
+            j = rkeys.get(k)
+            if j is None:
+                continue
+            if self.cardinality == Cardinality.ONE_TO_ONE:
+                if k in seen:
+                    raise QueryError(self.query_context.query_id,
+                                     "duplicate series on left side of join")
+                seen.add(k)
+            res = np.asarray(instant_ops.apply_binary(
+                self.operator.name, lv[i], rv[j], False))
+            key = self._result_key(t, rhs_b.keys[j])
+            out_keys.append(key)
+            rows.append(res)
+        T = lhs_b.steps.num_steps
+        vals = np.stack(rows) if rows else np.empty((0, T))
+        return [PeriodicBatch(out_keys, lhs_b.steps, vals)]
+
+    def _result_key(self, lt: dict, rt: dict) -> dict:
+        if self.operator.is_comparison:
+            return dict(lt)
+        if self.on:
+            key = {k: lt.get(k, "") for k in self.on if k in lt}
+        else:
+            drop = set(self.ignoring) | {"_metric_", "__name__"}
+            key = {k: v for k, v in lt.items() if k not in drop}
+        for k in self.include:
+            if k in rt:
+                key[k] = rt[k]
+        return key
+
+    def _args_str(self):
+        return f"operator={self.operator.name}, on={self.on}, " \
+               f"ignoring={self.ignoring}"
+
+
+class SetOperatorExec(NonLeafExecPlan):
+    """and/or/unless set operators (reference: SetOperatorExec.scala:31)."""
+
+    def __init__(self, children, lhs_count: int, operator: BinaryOperator,
+                 on: tuple = (), ignoring: tuple = (),
+                 query_context=None, dispatcher: PlanDispatcher = IN_PROCESS):
+        super().__init__(children, query_context, dispatcher)
+        self.lhs_count = lhs_count
+        self.operator = operator
+        self.on = tuple(on)
+        self.ignoring = tuple(ignoring)
+
+    def _join_key(self, tags: dict) -> tuple:
+        if self.on:
+            return tuple((k, tags.get(k, "")) for k in sorted(self.on))
+        drop = set(self.ignoring) | {"_metric_", "__name__"}
+        return tuple(sorted((k, v) for k, v in tags.items() if k not in drop))
+
+    def compose(self, results, ctx):
+        lhs_b = concat_periodic([b for r in results[:self.lhs_count]
+                                 for b in r.batches
+                                 if isinstance(b, PeriodicBatch)])
+        rhs_b = concat_periodic([b for r in results[self.lhs_count:]
+                                 for b in r.batches
+                                 if isinstance(b, PeriodicBatch)])
+        op = self.operator
+        if lhs_b is None:
+            if op == BinaryOperator.LOR and rhs_b is not None:
+                return [rhs_b]
+            return []
+        if rhs_b is None:
+            return [] if op == BinaryOperator.LAND else [lhs_b]
+        rset = {self._join_key(t) for t in rhs_b.keys}
+        lv = lhs_b.np_values()
+        if op == BinaryOperator.LAND:
+            idx = [i for i, t in enumerate(lhs_b.keys)
+                   if self._join_key(t) in rset]
+            return [PeriodicBatch([lhs_b.keys[i] for i in idx], lhs_b.steps,
+                                  lv[idx] if idx else np.empty((0, lv.shape[1])))]
+        if op == BinaryOperator.LUNLESS:
+            idx = [i for i, t in enumerate(lhs_b.keys)
+                   if self._join_key(t) not in rset]
+            return [PeriodicBatch([lhs_b.keys[i] for i in idx], lhs_b.steps,
+                                  lv[idx] if idx else np.empty((0, lv.shape[1])))]
+        # or: all of lhs + rhs series whose join key not present on lhs
+        lset = {self._join_key(t) for t in lhs_b.keys}
+        rv = rhs_b.np_values()
+        ridx = [i for i, t in enumerate(rhs_b.keys)
+                if self._join_key(t) not in lset]
+        keys = list(lhs_b.keys) + [rhs_b.keys[i] for i in ridx]
+        vals = np.concatenate([lv[:len(lhs_b.keys)],
+                               rv[ridx] if ridx else np.empty((0, rv.shape[1]))])
+        return [PeriodicBatch(keys, lhs_b.steps, vals)]
+
+
+class ScalarBinaryOperationExec(LeafExecPlan):
+    """Pure scalar arithmetic tree (reference:
+    ScalarBinaryOperationExec.scala)."""
+
+    def __init__(self, operator: BinaryOperator, lhs, rhs,
+                 start_ms: int, step_ms: int, end_ms: int,
+                 query_context=None, dispatcher: PlanDispatcher = IN_PROCESS):
+        super().__init__(query_context, dispatcher)
+        self.operator = operator
+        self.lhs = lhs
+        self.rhs = rhs
+        self.steps = StepRange(start_ms, end_ms, step_ms)
+
+    def _eval(self, side, ctx) -> np.ndarray:
+        if isinstance(side, (int, float)):
+            return np.full(self.steps.num_steps, float(side))
+        res = side.execute(ctx) if isinstance(side, ExecPlan) else None
+        if res is not None:
+            b = res.batches[0]
+            return np.asarray(b.values)
+        raise QueryError("", f"bad scalar operand {side}")
+
+    def do_execute(self, ctx):
+        lv = self._eval(self.lhs, ctx)
+        rv = self._eval(self.rhs, ctx)
+        vals = np.asarray(instant_ops.apply_binary(self.operator.name, lv, rv,
+                                                   False))
+        return [ScalarResult(self.steps, vals)]
+
+
+class LabelValuesDistConcatExec(NonLeafExecPlan):
+    """Merge per-shard label-value maps."""
+
+    def compose(self, results, ctx):
+        merged: dict[str, set] = {}
+        for r in results:
+            for b in r.batches:
+                if isinstance(b, dict):
+                    for label, vals in b.items():
+                        merged.setdefault(label, set()).update(vals)
+        return [{label: sorted(v) for label, v in merged.items()}]
+
+
+class PartKeysDistConcatExec(NonLeafExecPlan):
+    def compose(self, results, ctx):
+        seen = set()
+        out = []
+        for r in results:
+            for b in r.batches:
+                for tags in b:
+                    k = tuple(sorted(tags.items()))
+                    if k not in seen:
+                        seen.add(k)
+                        out.append(tags)
+        return [out]

@@ -1,0 +1,152 @@
+"""Query result model: batched range vectors.
+
+The reference materializes per-series ``RangeVector`` cursors
+(reference: core/src/main/scala/filodb.core/query/RangeVector.scala:271,305,
+SerializedRangeVector).  TPU-native results stay *batched*: one
+``PeriodicBatch`` holds S series x T steps as a dense array, so every
+transformer is an array->array function and serialization is one buffer, not
+S iterators.  ``to_series`` unpacks at the API edge only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from filodb_tpu.core.chunk import ChunkBatch
+from filodb_tpu.ops.windows import StepRange
+
+
+@dataclasses.dataclass
+class QueryContext:
+    """Per-query knobs (reference: core/query/QueryContext.scala:22)."""
+
+    query_id: str = ""
+    submit_time_ms: int = 0
+    sample_limit: int = 1_000_000
+    group_by_cardinality_limit: int = 100_000
+    timeout_ms: int = 30_000
+    spread: Optional[int] = None
+    origin: str = ""
+
+
+@dataclasses.dataclass
+class QueryStats:
+    samples_scanned: int = 0
+    series_scanned: int = 0
+    shards_queried: int = 0
+    dropped_series: int = 0
+
+    def merge(self, other: "QueryStats") -> None:
+        self.samples_scanned += other.samples_scanned
+        self.series_scanned += other.series_scanned
+        self.shards_queried += other.shards_queried
+        self.dropped_series += other.dropped_series
+
+
+class QueryError(Exception):
+    """Query failed (reference: filodb.query.QueryError)."""
+
+    def __init__(self, query_id: str, message: str):
+        super().__init__(message)
+        self.query_id = query_id
+
+
+@dataclasses.dataclass
+class RawBatch:
+    """Leaf-scan output: irregular samples as a padded ChunkBatch + keys."""
+
+    keys: list[dict]
+    batch: Optional[ChunkBatch]
+
+    @property
+    def num_series(self) -> int:
+        return len(self.keys)
+
+
+@dataclasses.dataclass
+class PeriodicBatch:
+    """S series sampled on a regular step grid: values [S, T] (NaN = no
+    sample at that step) or hist [S, T, B].
+
+    ``values`` may carry MORE rows than ``keys`` — the series axis stays
+    padded for stable jit shapes; padding rows are NaN.  Device kernels
+    consume ``values`` as-is; host consumers use :meth:`np_values`, which
+    slices to the real series."""
+
+    keys: list[dict]
+    steps: StepRange
+    values: np.ndarray
+    hist: Optional[np.ndarray] = None
+    bucket_tops: Optional[np.ndarray] = None
+
+    @property
+    def num_series(self) -> int:
+        return len(self.keys)
+
+    def np_values(self) -> np.ndarray:
+        return np.asarray(self.values)[:len(self.keys)]
+
+    def to_series(self) -> list[tuple[dict, np.ndarray, np.ndarray]]:
+        """Unpack to [(tags, step_timestamps, values)] at the API edge."""
+        ts = np.asarray(self.steps.timestamps())
+        vals = self.np_values()
+        return [(self.keys[i], ts, vals[i]) for i in range(len(self.keys))]
+
+
+@dataclasses.dataclass
+class ScalarResult:
+    """A scalar-per-step result (scalar(), time(), fixed scalars)."""
+
+    steps: StepRange
+    values: np.ndarray  # [T]
+
+    @property
+    def num_series(self) -> int:
+        return 1
+
+
+@dataclasses.dataclass
+class QueryResult:
+    """Result of one ExecPlan (reference: filodb.query.QueryResult)."""
+
+    query_id: str
+    batches: list  # RawBatch | PeriodicBatch | ScalarResult | AggPartialBatch
+    stats: QueryStats = dataclasses.field(default_factory=QueryStats)
+
+    @property
+    def num_series(self) -> int:
+        return sum(b.num_series for b in self.batches)
+
+
+def concat_periodic(batches: Sequence[PeriodicBatch]) -> Optional[PeriodicBatch]:
+    """Concatenate PeriodicBatches along the series axis (steps must match)."""
+    batches = [b for b in batches if b is not None and b.num_series > 0]
+    if not batches:
+        return None
+    if len(batches) == 1:
+        return batches[0]
+    first = batches[0]
+    for b in batches[1:]:
+        if b.steps != first.steps:
+            raise ValueError(f"step mismatch: {b.steps} vs {first.steps}")
+    keys = [k for b in batches for k in b.keys]
+    values = np.concatenate([b.np_values()[:len(b.keys)] for b in batches])
+    hist = None
+    tops = first.bucket_tops
+    if first.hist is not None:
+        bmax = max(b.hist.shape[2] for b in batches)
+        hs = []
+        for b in batches:
+            h = np.asarray(b.hist)[:len(b.keys)]
+            if h.shape[2] < bmax:
+                h = np.pad(h, ((0, 0), (0, 0), (0, bmax - h.shape[2])),
+                           mode="edge")
+            hs.append(h)
+            if b.bucket_tops is not None and (tops is None or
+                                              len(b.bucket_tops) > len(tops)):
+                tops = b.bucket_tops
+        hist = np.concatenate(hs)
+    return PeriodicBatch(keys, first.steps, values, hist, tops)

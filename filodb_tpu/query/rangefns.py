@@ -1,0 +1,105 @@
+"""RangeFunctionId -> batched kernel dispatch.
+
+The reference picks a ChunkedRangeFunction per (function, column type)
+(reference: query/exec/rangefn/RangeFunction.scala:233-405 factory).  Here
+each function maps to one batched kernel from :mod:`filodb_tpu.ops.windows`
+/ :mod:`filodb_tpu.ops.histogram_ops`, jit-compiled per shape bucket.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from filodb_tpu.core.chunk import ChunkBatch
+from filodb_tpu.ops import histogram_ops, windows
+from filodb_tpu.ops.windows import StepRange
+from filodb_tpu.query.logical import RangeFunctionId as F
+
+# prefix-path kernels: fn(ts, vals, steps, window) -> [S,T]
+_PREFIX = {
+    F.SUM_OVER_TIME: windows.sum_over_time,
+    F.COUNT_OVER_TIME: windows.count_over_time,
+    F.AVG_OVER_TIME: windows.avg_over_time,
+    F.STDDEV_OVER_TIME: windows.stddev_over_time,
+    F.STDVAR_OVER_TIME: windows.stdvar_over_time,
+    F.CHANGES: windows.changes_over_time,
+    F.RESETS: windows.resets_over_time,
+    F.RATE: windows.rate,
+    F.INCREASE: windows.increase,
+    F.DELTA: windows.delta_fn,
+    F.IRATE: windows.irate,
+    F.IDELTA: windows.idelta,
+    F.TIMESTAMP: windows.timestamp_fn,
+    F.Z_SCORE: windows.z_score,
+}
+
+# gather-path kernels: fn(ts, vals, steps, window, wmax, *args) -> [S,T]
+_GATHER = {
+    F.MIN_OVER_TIME: windows.min_over_time,
+    F.MAX_OVER_TIME: windows.max_over_time,
+    F.QUANTILE_OVER_TIME: windows.quantile_over_time,
+    F.MAD_OVER_TIME: windows.mad_over_time,
+    F.DERIV: windows.deriv,
+    F.PREDICT_LINEAR: windows.predict_linear,
+    F.HOLT_WINTERS: windows.holt_winters,
+}
+
+_HIST = {
+    F.RATE: histogram_ops.hist_rate,
+    F.INCREASE: histogram_ops.hist_increase,
+    F.SUM_OVER_TIME: histogram_ops.hist_sum_over_time,
+    None: histogram_ops.hist_last_sample,
+}
+
+
+@functools.lru_cache(maxsize=256)
+def _jit(fn, static_argnums=()):
+    return jax.jit(fn, static_argnums=static_argnums)
+
+
+def _last_sample_value(ts, vals, steps, window):
+    return windows.last_sample(ts, vals, steps, window)[0]
+
+
+def supported(func: Optional[F], hist: bool) -> bool:
+    if hist:
+        return func in _HIST
+    return func is None or func in _PREFIX or func in _GATHER
+
+
+def apply_range_function(batch: ChunkBatch, steps: StepRange,
+                         window_ms: int, func: Optional[F],
+                         args: tuple = ()) -> np.ndarray:
+    """Run one windowed range function over a whole ChunkBatch.
+
+    ``func=None`` is the plain instant-vector selector: last sample within
+    the lookback window (reference: PeriodicSamplesMapper with no range
+    function uses LastSampleChunkedFunction).  Returns values [S, T], or a
+    hist result [S, T, B] when the batch holds histograms.
+    """
+    step_arr = jnp.asarray(steps.timestamps())
+    ts = jnp.asarray(batch.timestamps)
+    window = jnp.asarray(window_ms, dtype=ts.dtype)
+    if batch.hist is not None:
+        kern = _HIST.get(func)
+        if kern is None:
+            raise ValueError(f"range function {func} not supported on histograms")
+        return _jit(kern)(ts, jnp.asarray(batch.hist), step_arr, window)
+    vals = jnp.asarray(batch.values)
+    if func is None:
+        return _jit(_last_sample_value)(ts, vals, step_arr, window)
+    if func in _PREFIX:
+        return _jit(_PREFIX[func])(ts, vals, step_arr, window)
+    if func in _GATHER:
+        wmax = windows.max_window_rows(ts, step_arr, window)
+        wmax = max(int(np.ceil(wmax / 16)) * 16, 16)  # bucket wmax: bounded recompiles
+        kern = _GATHER[func]
+        extra = tuple(float(a) for a in args)
+        return _jit(kern, static_argnums=tuple(range(4, 5 + len(extra))))(
+            ts, vals, step_arr, window, wmax, *extra)
+    raise ValueError(f"unsupported range function {func}")

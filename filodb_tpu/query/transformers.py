@@ -1,0 +1,435 @@
+"""RangeVectorTransformers: batch -> batch functions applied on top of an
+ExecPlan's own result (reference: query/exec/RangeVectorTransformer.scala:56-430,
+PeriodicSamplesMapper.scala:27, AggrOverRangeVectors.scala:74-122).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from filodb_tpu.core.filters import ColumnFilter
+from filodb_tpu.ops import histogram_ops, instant as instant_ops
+from filodb_tpu.ops.windows import StepRange
+from filodb_tpu.query import rangefns
+from filodb_tpu.query.aggregators import (AggPartialBatch, aggregator_for,
+                                          grouping_key)
+from filodb_tpu.query.logical import (AggregationOperator, InstantFunctionId,
+                                      MiscellaneousFunctionId, RangeFunctionId,
+                                      SortFunctionId)
+from filodb_tpu.query.model import (PeriodicBatch, QueryError, RawBatch,
+                                    ScalarResult)
+
+
+class RangeVectorTransformer:
+    def apply(self, batches: list, ctx) -> list:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+
+@dataclasses.dataclass
+class PeriodicSamplesMapper(RangeVectorTransformer):
+    """Raw irregular samples -> regular-step samples, optionally through a
+    windowed range function (reference: PeriodicSamplesMapper.scala:27).
+    ``offset_ms`` shifts the window into the past while reporting at the
+    query grid."""
+
+    start_ms: int
+    step_ms: int
+    end_ms: int
+    window_ms: Optional[int] = None
+    function: Optional[RangeFunctionId] = None
+    function_args: tuple = ()
+    offset_ms: int = 0
+    stale_ms: int = 300_000  # staleness lookback for instant selectors
+
+    def apply(self, batches, ctx):
+        out = []
+        steps = StepRange(self.start_ms - self.offset_ms,
+                          self.end_ms - self.offset_ms, self.step_ms)
+        report = StepRange(self.start_ms, self.end_ms, self.step_ms)
+        window = self.window_ms if self.window_ms else self.stale_ms
+        for b in batches:
+            if not isinstance(b, RawBatch):
+                raise QueryError("", f"PeriodicSamplesMapper over {type(b).__name__}")
+            if b.batch is None or not b.keys:
+                continue
+            vals = rangefns.apply_range_function(b.batch, steps, window,
+                                                 self.function,
+                                                 self.function_args)
+            vals = np.asarray(vals)
+            if vals.ndim == 3:  # histogram result [S,T,B]
+                out.append(PeriodicBatch(b.keys, report,
+                                         np.full(vals.shape[:2], np.nan),
+                                         hist=vals,
+                                         bucket_tops=np.asarray(
+                                             b.batch.bucket_tops)))
+            else:
+                out.append(PeriodicBatch(b.keys, report, vals))
+        return out
+
+
+@dataclasses.dataclass
+class InstantVectorFunctionMapper(RangeVectorTransformer):
+    function: InstantFunctionId
+    args: tuple = ()
+
+    def apply(self, batches, ctx):
+        fid = self.function
+        out = []
+        for b in batches:
+            if fid == InstantFunctionId.HISTOGRAM_QUANTILE:
+                q = float(_scalar_arg(self.args, 0))
+                vals = np.asarray(histogram_ops.hist_quantile(
+                    jnp.asarray(b.bucket_tops), jnp.asarray(b.hist), q))
+                out.append(PeriodicBatch(b.keys, b.steps, vals))
+            elif fid == InstantFunctionId.HISTOGRAM_MAX_QUANTILE:
+                q = float(_scalar_arg(self.args, 0))
+                vals = np.asarray(histogram_ops.hist_max_quantile(
+                    jnp.asarray(b.bucket_tops), jnp.asarray(b.hist),
+                    jnp.asarray(b.values), q))
+                out.append(PeriodicBatch(b.keys, b.steps, vals))
+            elif fid == InstantFunctionId.HISTOGRAM_BUCKET:
+                le = float(_scalar_arg(self.args, 0))
+                vals = np.asarray(histogram_ops.hist_bucket(
+                    jnp.asarray(b.bucket_tops), jnp.asarray(b.hist), le))
+                out.append(PeriodicBatch(b.keys, b.steps, vals))
+            else:
+                fn = instant_ops.INSTANT_FUNCTIONS[fid.value]
+                args = [np.asarray(_eval_arg(a, b.steps)) for a in self.args]
+                vals = np.asarray(fn(jnp.asarray(b.values), *args))
+                out.append(PeriodicBatch(b.keys, b.steps, vals))
+        return out
+
+
+def _scalar_arg(args, i):
+    a = args[i]
+    if isinstance(a, ScalarResult):
+        return float(np.asarray(a.values).ravel()[0])
+    return float(a)
+
+
+def _eval_arg(a, steps):
+    if isinstance(a, ScalarResult):
+        return np.asarray(a.values)
+    return np.asarray(float(a))
+
+
+_MIRROR = {"GTR": "LSS", "LSS": "GTR", "GTE": "LTE", "LTE": "GTE",
+           "EQL": "EQL", "NEQ": "NEQ"}
+
+
+@dataclasses.dataclass
+class ScalarOperationMapper(RangeVectorTransformer):
+    """vector <op> scalar / scalar <op> vector (reference:
+    ScalarOperationMapper, RangeVectorTransformer.scala:193).  ``operator``
+    is a BinaryOperator enum *name* ("ADD", "GTR", ...)."""
+
+    operator: str
+    scalar: object  # float | ScalarResult
+    scalar_on_lhs: bool = False
+    bool_mode: bool = False
+
+    def apply(self, batches, ctx):
+        sval = (np.asarray(self.scalar.values)
+                if isinstance(self.scalar, ScalarResult)
+                else np.asarray(float(self.scalar)))
+        is_cmp = self.operator in _MIRROR
+        out = []
+        for b in batches:
+            v = b.np_values()
+            if is_cmp and self.scalar_on_lhs and not self.bool_mode:
+                # `s < vec` filters on the VECTOR value: mirror to `vec > s`
+                res = instant_ops.apply_binary(_MIRROR[self.operator],
+                                               jnp.asarray(v), sval, False)
+            elif self.scalar_on_lhs:
+                res = instant_ops.apply_binary(self.operator, sval,
+                                               jnp.asarray(v), self.bool_mode)
+            else:
+                res = instant_ops.apply_binary(self.operator, jnp.asarray(v),
+                                               sval, self.bool_mode)
+            # arithmetic and bool-mode comparisons drop the metric name;
+            # filtering comparisons keep the input series identity
+            keys = b.keys if is_cmp and not self.bool_mode \
+                else _drop_metric(b.keys)
+            out.append(PeriodicBatch(keys, b.steps, np.asarray(res),
+                                     b.hist, b.bucket_tops))
+        return out
+
+
+def _drop_metric(keys: list[dict]) -> list[dict]:
+    return [{k: v for k, v in t.items() if k != "_metric_"} for t in keys]
+
+
+@dataclasses.dataclass
+class AggregateMapReduce(RangeVectorTransformer):
+    """Shard-local map+partial-reduce (reference: AggregateMapReduce,
+    AggrOverRangeVectors.scala:74-120).  Emits AggPartialBatch for the
+    ReduceAggregateExec above."""
+
+    operator: AggregationOperator
+    params: tuple = ()
+    by: tuple = ()
+    without: tuple = ()
+
+    def apply(self, batches, ctx):
+        agg = aggregator_for(self.operator)
+        limit = ctx.query_context.group_by_cardinality_limit
+        parts = [agg.map(b, self.by, self.without, self.params, limit)
+                 for b in batches if isinstance(b, PeriodicBatch) and b.keys]
+        if not parts:
+            return []
+        if len(parts) == 1:
+            return parts
+        return [agg.reduce(parts)]
+
+
+@dataclasses.dataclass
+class AggregatePresenter(RangeVectorTransformer):
+    operator: AggregationOperator
+    params: tuple = ()
+
+    def apply(self, batches, ctx):
+        agg = aggregator_for(self.operator)
+        out = []
+        for b in batches:
+            if isinstance(b, AggPartialBatch):
+                out.append(agg.present(b))
+            else:
+                out.append(b)
+        return out
+
+
+@dataclasses.dataclass
+class MiscellaneousFunctionMapper(RangeVectorTransformer):
+    function: MiscellaneousFunctionId
+    args: tuple = ()
+
+    def apply(self, batches, ctx):
+        fid = self.function
+        out = []
+        for b in batches:
+            if fid == MiscellaneousFunctionId.LABEL_REPLACE:
+                dst, repl, src, regex = self.args[:4]
+                rx = re.compile(regex)
+                keys = []
+                for t in b.keys:
+                    t2 = dict(t)
+                    m = rx.fullmatch(t.get(src, ""))
+                    if m:
+                        val = m.expand(_prom_template(repl))
+                        if val:
+                            t2[dst] = val
+                        else:
+                            t2.pop(dst, None)
+                    keys.append(t2)
+                out.append(dataclasses.replace(b, keys=keys))
+            elif fid == MiscellaneousFunctionId.LABEL_JOIN:
+                dst, sep, *srcs = self.args
+                keys = []
+                for t in b.keys:
+                    t2 = dict(t)
+                    val = sep.join(t.get(s, "") for s in srcs)
+                    if val:
+                        t2[dst] = val
+                    else:
+                        t2.pop(dst, None)
+                    keys.append(t2)
+                out.append(dataclasses.replace(b, keys=keys))
+            elif fid == MiscellaneousFunctionId.HIST_TO_PROM_VECTORS:
+                out.append(_hist_to_prom_series(b))
+            else:
+                raise QueryError("", f"unsupported misc function {fid}")
+        return out
+
+
+def _prom_template(repl: str) -> str:
+    """PromQL $1 -> python regex \\1 template."""
+    return re.sub(r"\$(\d+)", r"\\\1", repl)
+
+
+def _hist_to_prom_series(b: PeriodicBatch) -> PeriodicBatch:
+    """Explode histogram series into per-bucket le-labelled series
+    (reference: HistToPromSeriesMapper, RangeVectorTransformer.scala:409)."""
+    if b.hist is None:
+        return b
+    _, T, B = b.hist.shape
+    S = len(b.keys)  # hist rows beyond the keys are series padding
+    keys, rows = [], []
+    tops = np.asarray(b.bucket_tops)
+    for s in range(S):
+        for j in range(B):
+            t2 = dict(b.keys[s])
+            top = tops[j]
+            t2["le"] = "+Inf" if np.isinf(top) else _fmt(top)
+            keys.append(t2)
+            rows.append(np.asarray(b.hist)[s, :, j])
+    return PeriodicBatch(keys, b.steps, np.stack(rows) if rows
+                         else np.empty((0, T)))
+
+
+def _fmt(v: float) -> str:
+    return str(int(v)) if float(v) == int(v) else repr(float(v))
+
+
+@dataclasses.dataclass
+class SortFunctionMapper(RangeVectorTransformer):
+    function: SortFunctionId
+
+    def apply(self, batches, ctx):
+        out = []
+        desc = self.function == SortFunctionId.SORT_DESC
+        for b in batches:
+            if not isinstance(b, PeriodicBatch) or not b.keys:
+                out.append(b)
+                continue
+            v = b.np_values()[:len(b.keys)]
+            # sort by mean of last-step finite values (reference sorts by
+            # average value like Prometheus's instant sort)
+            with np.errstate(invalid="ignore"):
+                key = np.nanmean(v, axis=1)
+            key = np.where(np.isnan(key), -np.inf if not desc else np.inf, key)
+            order = np.argsort(-key if desc else key, kind="stable")
+            out.append(PeriodicBatch([b.keys[i] for i in order], b.steps,
+                                     v[order],
+                                     None if b.hist is None
+                                     else np.asarray(b.hist)[:len(b.keys)][order],
+                                     b.bucket_tops))
+        return out
+
+
+@dataclasses.dataclass
+class AbsentFunctionMapper(RangeVectorTransformer):
+    """absent(expr): 1 when no series present (reference:
+    AbsentFunctionMapper, RangeVectorTransformer.scala:344)."""
+
+    filters: tuple = ()
+    start_ms: int = 0
+    step_ms: int = 1
+    end_ms: int = 0
+
+    def apply(self, batches, ctx):
+        steps = None
+        present: Optional[np.ndarray] = None
+        for b in batches:
+            if isinstance(b, PeriodicBatch):
+                steps = b.steps
+                fin = np.isfinite(b.np_values()[:len(b.keys)])
+                p = fin.any(axis=0)
+                present = p if present is None else (present | p)
+        if steps is None:
+            steps = StepRange(self.start_ms, self.end_ms, max(self.step_ms, 1))
+            present = np.zeros(steps.num_steps, dtype=bool)
+        vals = np.where(present, np.nan, 1.0)[None, :]
+        key = {f.column: f.filter.value for f in self.filters
+               if type(f.filter).__name__ == "Equals" and f.column != "_metric_"}
+        return [PeriodicBatch([key], steps, vals)]
+
+
+@dataclasses.dataclass
+class HistogramQuantileMapper(RangeVectorTransformer):
+    """quantile over le-labelled bucket-per-series vectors (reference:
+    HistogramQuantileMapper.scala:22).  Groups series by tags-minus-le,
+    sorts buckets by le, interpolates."""
+
+    q: float
+
+    def apply(self, batches, ctx):
+        out = []
+        for b in batches:
+            if not isinstance(b, PeriodicBatch) or not b.keys:
+                continue
+            groups: dict[tuple, list[int]] = {}
+            les: list[float] = []
+            for i, t in enumerate(b.keys):
+                le = t.get("le")
+                if le is None:
+                    continue
+                k = tuple(sorted((kk, vv) for kk, vv in t.items() if kk != "le"))
+                groups.setdefault(k, []).append(i)
+                les.append(float("inf") if le in ("+Inf", "Inf") else float(le))
+            v = b.np_values()
+            keys, rows = [], []
+            for k, idxs in groups.items():
+                idxs = sorted(idxs, key=lambda i: les[i])
+                tops = np.array([les[i] for i in idxs])
+                hist = np.stack([v[i] for i in idxs], axis=-1)[None]  # [1,T,B]
+                res = np.asarray(histogram_ops.hist_quantile(
+                    jnp.asarray(tops), jnp.asarray(hist), self.q))[0]
+                keys.append(dict(k))
+                rows.append(res)
+            if keys:
+                out.append(PeriodicBatch(keys, b.steps, np.stack(rows)))
+        return out
+
+
+@dataclasses.dataclass
+class StitchRvsMapper(RangeVectorTransformer):
+    """Merge same-key series split across time (reference:
+    StitchRvsExec.scala:13,61): NaN slots fill from the other split."""
+
+    def apply(self, batches, ctx):
+        merged: dict[tuple, np.ndarray] = {}
+        steps = None
+        order: list[tuple] = []
+        for b in batches:
+            if not isinstance(b, PeriodicBatch):
+                continue
+            steps = steps or b.steps
+            v = b.np_values()
+            for i, t in enumerate(b.keys):
+                k = tuple(sorted(t.items()))
+                if k in merged:
+                    cur = merged[k]
+                    merged[k] = np.where(np.isnan(cur), v[i], cur)
+                else:
+                    merged[k] = v[i].copy()
+                    order.append(k)
+        if steps is None:
+            return []
+        keys = [dict(k) for k in order]
+        vals = np.stack([merged[k] for k in order]) if order else np.empty((0, steps.num_steps))
+        return [PeriodicBatch(keys, steps, vals)]
+
+
+@dataclasses.dataclass
+class ScalarFunctionMapper(RangeVectorTransformer):
+    """scalar(vector): single-series vector -> per-step scalar (NaN when 0
+    or >1 series) (reference: ScalarFunctionMapper)."""
+
+    def apply(self, batches, ctx):
+        series = [b for b in batches
+                  if isinstance(b, PeriodicBatch) and b.keys]
+        total = sum(b.num_series for b in series)
+        if total == 1:
+            b = series[0]
+            return [ScalarResult(b.steps, b.np_values()[0])]
+        steps = series[0].steps if series else None
+        if steps is None:
+            for b in batches:
+                if hasattr(b, "steps"):
+                    steps = b.steps
+        n = steps.num_steps if steps else 0
+        return [ScalarResult(steps, np.full(n, np.nan))]
+
+
+@dataclasses.dataclass
+class VectorFunctionMapper(RangeVectorTransformer):
+    """vector(scalar): scalar -> one labelless series."""
+
+    def apply(self, batches, ctx):
+        out = []
+        for b in batches:
+            if isinstance(b, ScalarResult):
+                out.append(PeriodicBatch([{}], b.steps,
+                                         np.asarray(b.values)[None, :]))
+            else:
+                out.append(b)
+        return out
