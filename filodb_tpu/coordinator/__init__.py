@@ -1,0 +1,2 @@
+"""Cluster coordination: query planners, shard management, server plumbing
+(reference: coordinator/src/main/scala/filodb.coordinator/)."""

@@ -1,0 +1,268 @@
+"""SingleClusterPlanner: LogicalPlan -> ExecPlan with shard pruning.
+
+Mirrors the reference's planner walk (reference: coordinator/.../queryplanner/
+SingleClusterPlanner.scala:36): shard pruning via shard-key filters + spread
+(:106-136), per-shard MultiSchemaPartitionsExec leaves (:338-361),
+hierarchical aggregation reduce with sqrt grouping at >=16 children
+(:223-258), transformers attached per logical node.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Sequence
+
+from filodb_tpu.core.filters import ColumnFilter, equals_value
+from filodb_tpu.core.record import stable_hash32
+from filodb_tpu.core.schemas import DatasetOptions
+from filodb_tpu.parallel.shardmap import ShardMapper
+from filodb_tpu.query import logical as lp
+from filodb_tpu.query.exec import (BinaryJoinExec, DistConcatExec, ExecPlan,
+                                   IN_PROCESS, LabelValuesDistConcatExec,
+                                   LabelValuesExec, MultiSchemaPartitionsExec,
+                                   PartKeysDistConcatExec, PartKeysExec,
+                                   PlanDispatcher, ReduceAggregateExec,
+                                   ScalarBinaryOperationExec,
+                                   ScalarFixedDoubleExec, SetOperatorExec,
+                                   TimeScalarGeneratorExec)
+from filodb_tpu.query.model import QueryContext
+from filodb_tpu.query.transformers import (AbsentFunctionMapper,
+                                           AggregateMapReduce,
+                                           AggregatePresenter,
+                                           InstantVectorFunctionMapper,
+                                           MiscellaneousFunctionMapper,
+                                           PeriodicSamplesMapper,
+                                           ScalarFunctionMapper,
+                                           ScalarOperationMapper,
+                                           SortFunctionMapper,
+                                           VectorFunctionMapper)
+
+
+class QueryPlanner:
+    """Planner interface (reference: queryplanner/QueryPlanner.scala:16)."""
+
+    def materialize(self, plan: lp.LogicalPlan,
+                    qctx: Optional[QueryContext] = None) -> ExecPlan:
+        raise NotImplementedError
+
+
+class SingleClusterPlanner(QueryPlanner):
+    def __init__(self, dataset: str, shard_mapper: ShardMapper,
+                 options: Optional[DatasetOptions] = None,
+                 spread_default: int = 1,
+                 spread_provider: Optional[Callable[[dict], int]] = None,
+                 dispatcher_for_shard: Optional[
+                     Callable[[int], PlanDispatcher]] = None,
+                 hierarchical_reduce_at: int = 16):
+        self.dataset = dataset
+        self.mapper = shard_mapper
+        self.options = options or DatasetOptions()
+        self.spread_default = spread_default
+        self.spread_provider = spread_provider
+        self.dispatcher_for_shard = dispatcher_for_shard or (lambda s: IN_PROCESS)
+        self.hierarchical_reduce_at = hierarchical_reduce_at
+
+    # -- shard pruning (reference :106-136) ---------------------------------
+
+    def shards_from_filters(self, filters: Sequence[ColumnFilter],
+                            qctx: QueryContext) -> list[int]:
+        shard_cols = self.options.shard_key_columns
+        values = {}
+        for col in shard_cols:
+            v = equals_value(filters, col)
+            if col == self.options.metric_column:
+                v = v if v is not None else equals_value(filters, "_metric_")
+            if v is None:
+                return self._all_shards()
+            values[col] = v
+        spread = qctx.spread if qctx.spread is not None else self.spread_default
+        if self.spread_provider is not None:
+            spread = self.spread_provider(values)
+        shash = self._shard_key_hash(values)
+        shards = [s % self.mapper.num_shards
+                  for s in self.mapper.query_shards(shash, spread)]
+        active = set(self.mapper.active_shards())
+        if active:
+            shards = [s for s in shards if s in active] or shards
+        return sorted(set(shards))
+
+    def _shard_key_hash(self, values: dict) -> int:
+        parts = []
+        for col in self.options.shard_key_columns:
+            v = values.get(col, "")
+            for suffix in self.options.ignore_shard_key_column_suffixes.get(
+                    col, ()):
+                if v.endswith(suffix):
+                    v = v[: -len(suffix)]
+                    break
+            parts.append(v)
+        return stable_hash32("\x00".join(parts).encode())
+
+    def _all_shards(self) -> list[int]:
+        active = self.mapper.active_shards()
+        return active if active else list(range(self.mapper.num_shards))
+
+    # -- materialization ----------------------------------------------------
+
+    def materialize(self, plan, qctx=None) -> ExecPlan:
+        qctx = qctx or QueryContext()
+        return self._walk(plan, qctx)
+
+    def _walk(self, plan, qctx) -> ExecPlan:
+        if isinstance(plan, lp.PeriodicSeries):
+            return self._periodic(plan.raw_series, qctx, plan.start_ms,
+                                  plan.step_ms, plan.end_ms,
+                                  offset=plan.offset_ms or 0)
+        if isinstance(plan, lp.PeriodicSeriesWithWindowing):
+            return self._periodic(plan.series, qctx, plan.start_ms,
+                                  plan.step_ms, plan.end_ms,
+                                  window=plan.window_ms,
+                                  function=plan.function,
+                                  args=plan.function_args,
+                                  offset=plan.offset_ms or 0)
+        if isinstance(plan, lp.Aggregate):
+            return self._aggregate(plan, qctx)
+        if isinstance(plan, lp.BinaryJoin):
+            return self._binary_join(plan, qctx)
+        if isinstance(plan, lp.ScalarVectorBinaryOperation):
+            inner = self._walk(plan.vector, qctx)
+            scalar = self._scalar_operand(plan.scalar_arg, qctx)
+            inner.add_transformer(ScalarOperationMapper(
+                plan.operator.name, scalar, plan.scalar_is_lhs,
+                plan.bool_mode))
+            return inner
+        if isinstance(plan, lp.ApplyInstantFunction):
+            inner = self._walk(plan.vectors, qctx)
+            args = tuple(self._scalar_operand(a, qctx)
+                         if isinstance(a, lp.LogicalPlan) else a
+                         for a in plan.function_args)
+            inner.add_transformer(InstantVectorFunctionMapper(plan.function,
+                                                              args))
+            return inner
+        if isinstance(plan, lp.ApplyMiscellaneousFunction):
+            inner = self._walk(plan.vectors, qctx)
+            inner.add_transformer(MiscellaneousFunctionMapper(
+                plan.function, plan.string_args))
+            return inner
+        if isinstance(plan, lp.ApplySortFunction):
+            inner = self._walk(plan.vectors, qctx)
+            inner.add_transformer(SortFunctionMapper(plan.function))
+            return inner
+        if isinstance(plan, lp.ApplyAbsentFunction):
+            inner = self._walk(plan.vectors, qctx)
+            inner.add_transformer(AbsentFunctionMapper(
+                plan.filters, plan.start_ms, plan.step_ms, plan.end_ms))
+            return inner
+        if isinstance(plan, lp.ScalarVaryingDoublePlan):
+            inner = self._walk(plan.vectors, qctx)
+            inner.add_transformer(ScalarFunctionMapper())
+            return inner
+        if isinstance(plan, lp.ScalarTimeBasedPlan):
+            return TimeScalarGeneratorExec(plan.function, plan.start_ms,
+                                           plan.step_ms, plan.end_ms,
+                                           query_context=qctx)
+        if isinstance(plan, lp.ScalarFixedDoublePlan):
+            return ScalarFixedDoubleExec(plan.scalar, plan.start_ms,
+                                         plan.step_ms, plan.end_ms,
+                                         query_context=qctx)
+        if isinstance(plan, lp.ScalarBinaryOperation):
+            lhs = plan.lhs if isinstance(plan.lhs, (int, float)) \
+                else self._walk(plan.lhs, qctx)
+            rhs = plan.rhs if isinstance(plan.rhs, (int, float)) \
+                else self._walk(plan.rhs, qctx)
+            return ScalarBinaryOperationExec(plan.operator, lhs, rhs,
+                                             plan.start_ms, plan.step_ms,
+                                             plan.end_ms, query_context=qctx)
+        if isinstance(plan, lp.VectorPlan):
+            inner = self._walk(plan.scalars, qctx)
+            inner.add_transformer(VectorFunctionMapper())
+            return inner
+        if isinstance(plan, lp.LabelValues):
+            shards = self._all_shards()
+            children = [LabelValuesExec(self.dataset, s, plan.label_names,
+                                        plan.filters, plan.start_ms,
+                                        plan.end_ms, qctx,
+                                        self.dispatcher_for_shard(s))
+                        for s in shards]
+            return LabelValuesDistConcatExec(children, qctx)
+        if isinstance(plan, lp.SeriesKeysByFilters):
+            shards = self.shards_from_filters(plan.filters, qctx)
+            children = [PartKeysExec(self.dataset, s, plan.filters,
+                                     plan.start_ms, plan.end_ms, qctx,
+                                     self.dispatcher_for_shard(s))
+                        for s in shards]
+            return PartKeysDistConcatExec(children, qctx)
+        raise ValueError(f"cannot materialize {type(plan).__name__}")
+
+    def _scalar_operand(self, plan, qctx):
+        """Scalar argument: plain float for fixed scalars, an ExecPlan
+        evaluated at run time otherwise (reference: FuncArgs/
+        ExecPlanFuncArgs, ExecPlan.scala:287-335)."""
+        if isinstance(plan, (int, float)):
+            return float(plan)
+        if isinstance(plan, lp.ScalarFixedDoublePlan):
+            return plan.scalar
+        return self._walk(plan, qctx)
+
+    def _periodic(self, raw: lp.RawSeries, qctx, start, step, end,
+                  window=None, function=None, args=(), offset=0) -> ExecPlan:
+        shards = self.shards_from_filters(raw.filters, qctx)
+        column = raw.columns[0] if raw.columns else None
+        children = []
+        for s in shards:
+            leaf = MultiSchemaPartitionsExec(
+                self.dataset, s, raw.filters,
+                raw.range_selector.from_ms, raw.range_selector.to_ms,
+                column=column, query_context=qctx,
+                dispatcher=self.dispatcher_for_shard(s))
+            leaf.add_transformer(PeriodicSamplesMapper(
+                start, step, end, window_ms=window, function=function,
+                function_args=args, offset_ms=offset))
+            children.append(leaf)
+        return DistConcatExec(children, qctx)
+
+    def _aggregate(self, plan: lp.Aggregate, qctx) -> ExecPlan:
+        inner = self._walk(plan.vectors, qctx)
+        mapred = AggregateMapReduce(plan.operator, plan.params, plan.by,
+                                    plan.without)
+        if isinstance(inner, DistConcatExec):
+            # push map-reduce into each shard-child; reduce above (reference
+            # :223-258 removes the DistConcat and reduces directly)
+            children = list(inner.children)
+            for c in children:
+                c.add_transformer(mapred)
+            children = self._hierarchical_reduce(children, plan, qctx)
+            root = ReduceAggregateExec(children, plan.operator, plan.params,
+                                       qctx)
+        else:
+            inner.add_transformer(mapred)
+            root = ReduceAggregateExec([inner], plan.operator, plan.params,
+                                       qctx)
+        root.add_transformer(AggregatePresenter(plan.operator, plan.params))
+        return root
+
+    def _hierarchical_reduce(self, children, plan, qctx):
+        """sqrt-group intermediate reduces for wide fan-outs (reference
+        SingleClusterPlanner.scala:244-258)."""
+        if len(children) < self.hierarchical_reduce_at:
+            return children
+        groups = max(int(math.sqrt(len(children))), 1)
+        size = math.ceil(len(children) / groups)
+        return [ReduceAggregateExec(children[i:i + size], plan.operator,
+                                    plan.params, qctx)
+                for i in range(0, len(children), size)]
+
+    def _binary_join(self, plan: lp.BinaryJoin, qctx) -> ExecPlan:
+        lhs = self._walk(plan.lhs, qctx)
+        rhs = self._walk(plan.rhs, qctx)
+        lhs_children = list(lhs.children) if isinstance(lhs, DistConcatExec) \
+            else [lhs]
+        rhs_children = list(rhs.children) if isinstance(rhs, DistConcatExec) \
+            else [rhs]
+        children = lhs_children + rhs_children
+        if plan.operator.is_set_op:
+            return SetOperatorExec(children, len(lhs_children), plan.operator,
+                                   plan.on, plan.ignoring, qctx)
+        return BinaryJoinExec(children, len(lhs_children), plan.operator,
+                              plan.cardinality, plan.on, plan.ignoring,
+                              plan.include, qctx)

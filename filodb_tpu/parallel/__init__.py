@@ -1,0 +1,2 @@
+"""Parallelism & distribution: shard mapping, device-mesh scan/reduce,
+multi-host dispatch (reference: coordinator/ shard layer + SURVEY.md §2.7)."""

@@ -1,0 +1,100 @@
+"""ShardMapper: record -> shard bit-splice, spread fan-out, shard status.
+
+Pure-function port-of-concept of the reference's ShardMapper
+(reference: coordinator/src/main/scala/filodb.coordinator/ShardMapper.scala:
+26-46 — shard = f(shardKeyHash upper bits, partitionHash lower bits, spread);
+queryShards returns the 2^spread shards holding one shard key) plus the
+ShardStatus lifecycle (ShardStatus.scala:54-94).  TPU mapping: a shard is a
+slice of the mesh's data axis; ``coord_for_shard`` is the host/device owner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional, Sequence
+
+
+class ShardStatus(enum.Enum):
+    UNASSIGNED = "Unassigned"
+    ASSIGNED = "Assigned"
+    RECOVERY = "Recovery"
+    ACTIVE = "Active"
+    ERROR = "Error"
+    STOPPED = "Stopped"
+    DOWN = "Down"
+
+    @property
+    def queryable(self) -> bool:
+        return self in (ShardStatus.ACTIVE, ShardStatus.RECOVERY)
+
+
+@dataclasses.dataclass
+class ShardState:
+    status: ShardStatus = ShardStatus.UNASSIGNED
+    node: Optional[str] = None
+    recovery_progress: int = 0  # percent
+
+
+class ShardMapper:
+    def __init__(self, num_shards: int):
+        if num_shards <= 0 or num_shards & (num_shards - 1):
+            raise ValueError(f"num_shards {num_shards} must be a power of 2")
+        self.num_shards = num_shards
+        self._states = [ShardState() for _ in range(num_shards)]
+
+    # -- hashing ------------------------------------------------------------
+
+    def shard_hash_mask(self, spread: int) -> int:
+        return (self.num_shards - 1) & ~((1 << spread) - 1)
+
+    def part_hash_mask(self, spread: int) -> int:
+        return (1 << spread) - 1
+
+    def ingestion_shard(self, shard_key_hash: int, part_hash: int,
+                        spread: int) -> int:
+        """Upper bits from the shard-key hash, lower ``spread`` bits from the
+        partition hash (reference: ShardMapper.ingestionShard)."""
+        return ((shard_key_hash & self.shard_hash_mask(spread))
+                | (part_hash & self.part_hash_mask(spread)))
+
+    def query_shards(self, shard_key_hash: int, spread: int) -> list[int]:
+        """All 2^spread shards that can hold series of one shard key."""
+        base = shard_key_hash & self.shard_hash_mask(spread)
+        return [base | i for i in range(1 << spread)]
+
+    # -- assignment / status ------------------------------------------------
+
+    def register_node(self, shards: Sequence[int], node: str) -> None:
+        for s in shards:
+            self._states[s] = ShardState(ShardStatus.ASSIGNED, node)
+
+    def update_status(self, shard: int, status: ShardStatus,
+                      progress: int = 0) -> None:
+        st = self._states[shard]
+        st.status = status
+        st.recovery_progress = progress
+
+    def unassign(self, shard: int) -> None:
+        self._states[shard] = ShardState()
+
+    def coord_for_shard(self, shard: int) -> Optional[str]:
+        return self._states[shard].node
+
+    def status(self, shard: int) -> ShardStatus:
+        return self._states[shard].status
+
+    def active_shards(self, shards: Optional[Sequence[int]] = None) -> list[int]:
+        rng = range(self.num_shards) if shards is None else shards
+        return [s for s in rng if self._states[s].status.queryable]
+
+    def all_nodes(self) -> set:
+        return {st.node for st in self._states if st.node is not None}
+
+    def shards_for_node(self, node: str) -> list[int]:
+        return [i for i, st in enumerate(self._states) if st.node == node]
+
+    @property
+    def num_assigned(self) -> int:
+        return sum(1 for st in self._states
+                   if st.status != ShardStatus.UNASSIGNED)

@@ -86,37 +86,47 @@ class InstantVectorFunctionMapper(RangeVectorTransformer):
         out = []
         for b in batches:
             if fid == InstantFunctionId.HISTOGRAM_QUANTILE:
-                q = float(_scalar_arg(self.args, 0))
+                q = float(_scalar_arg(self.args, 0, ctx))
                 vals = np.asarray(histogram_ops.hist_quantile(
                     jnp.asarray(b.bucket_tops), jnp.asarray(b.hist), q))
                 out.append(PeriodicBatch(b.keys, b.steps, vals))
             elif fid == InstantFunctionId.HISTOGRAM_MAX_QUANTILE:
-                q = float(_scalar_arg(self.args, 0))
+                q = float(_scalar_arg(self.args, 0, ctx))
                 vals = np.asarray(histogram_ops.hist_max_quantile(
                     jnp.asarray(b.bucket_tops), jnp.asarray(b.hist),
                     jnp.asarray(b.values), q))
                 out.append(PeriodicBatch(b.keys, b.steps, vals))
             elif fid == InstantFunctionId.HISTOGRAM_BUCKET:
-                le = float(_scalar_arg(self.args, 0))
+                le = float(_scalar_arg(self.args, 0, ctx))
                 vals = np.asarray(histogram_ops.hist_bucket(
                     jnp.asarray(b.bucket_tops), jnp.asarray(b.hist), le))
                 out.append(PeriodicBatch(b.keys, b.steps, vals))
             else:
                 fn = instant_ops.INSTANT_FUNCTIONS[fid.value]
-                args = [np.asarray(_eval_arg(a, b.steps)) for a in self.args]
+                args = [np.asarray(_eval_arg(a, b.steps, ctx)) for a in self.args]
                 vals = np.asarray(fn(jnp.asarray(b.values), *args))
                 out.append(PeriodicBatch(b.keys, b.steps, vals))
         return out
 
 
-def _scalar_arg(args, i):
-    a = args[i]
+def _resolve(a, ctx):
+    """Scalar argument: float | ScalarResult | ExecPlan producing a scalar
+    (the reference's ExecPlanFuncArgs evaluated at run time)."""
+    if hasattr(a, "execute") and ctx is not None:  # ExecPlan
+        res = a.execute(ctx)
+        return res.batches[0] if res.batches else ScalarResult(None, np.nan)
+    return a
+
+
+def _scalar_arg(args, i, ctx=None):
+    a = _resolve(args[i], ctx)
     if isinstance(a, ScalarResult):
         return float(np.asarray(a.values).ravel()[0])
     return float(a)
 
 
-def _eval_arg(a, steps):
+def _eval_arg(a, steps, ctx=None):
+    a = _resolve(a, ctx)
     if isinstance(a, ScalarResult):
         return np.asarray(a.values)
     return np.asarray(float(a))
@@ -138,9 +148,10 @@ class ScalarOperationMapper(RangeVectorTransformer):
     bool_mode: bool = False
 
     def apply(self, batches, ctx):
-        sval = (np.asarray(self.scalar.values)
-                if isinstance(self.scalar, ScalarResult)
-                else np.asarray(float(self.scalar)))
+        scalar = _resolve(self.scalar, ctx)
+        sval = (np.asarray(scalar.values)
+                if isinstance(scalar, ScalarResult)
+                else np.asarray(float(scalar)))
         is_cmp = self.operator in _MIRROR
         out = []
         for b in batches:

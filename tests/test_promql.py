@@ -1,0 +1,340 @@
+"""PromQL parser + planner tests (reference: prometheus ParserSpec,
+coordinator SingleClusterPlannerSpec — SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.filters import ColumnFilter, Equals, EqualsRegex, NotEquals
+from filodb_tpu.core.record import partition_hash, shard_key_hash
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+from filodb_tpu.core.storeconfig import StoreConfig
+from filodb_tpu.memstore import TimeSeriesMemStore
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.parallel.shardmap import ShardMapper, ShardStatus
+from filodb_tpu.promql import parse_query, query_range_to_logical_plan
+from filodb_tpu.promql.parser import ParseError, duration_ms, tokenize
+from filodb_tpu.query import logical as lp
+from filodb_tpu.query.exec import ExecContext
+from filodb_tpu.query.model import QueryContext
+from tests import oracle
+from tests.data import START_TS, counter_containers, gauge_containers
+
+S, T, E = 1_000_000, 10_000, 2_000_000  # parse grid
+
+
+def parse(q):
+    return parse_query(q, S, T, E)
+
+
+class TestLexer:
+    def test_durations(self):
+        assert duration_ms("5m") == 300_000
+        assert duration_ms("1h30m") == 5_400_000
+        assert duration_ms("90s") == 90_000
+        assert duration_ms("1d") == 86_400_000
+
+    def test_tokens(self):
+        toks = tokenize('sum(rate(foo{a="b"}[5m]))')
+        assert [t.text for t in toks[:3]] == ["sum", "(", "rate"]
+
+
+class TestSelectors:
+    def test_plain_metric(self):
+        p = parse("http_requests_total")
+        assert isinstance(p, lp.PeriodicSeries)
+        rs = p.raw_series
+        assert ColumnFilter("_metric_", Equals("http_requests_total")) in rs.filters
+        # 5m staleness lookback
+        assert rs.range_selector.from_ms == S - 300_000
+
+    def test_matchers(self):
+        p = parse('foo{job="api", instance!="0", path=~"/v./.*", env!~"dev.*"}')
+        f = p.raw_series.filters
+        assert ColumnFilter("job", Equals("api")) in f
+        assert ColumnFilter("instance", NotEquals("0")) in f
+        assert any(isinstance(x.filter, EqualsRegex) and x.column == "path"
+                   for x in f)
+
+    def test_name_matcher_only(self):
+        p = parse('{__name__="foo"}')
+        assert ColumnFilter("_metric_", Equals("foo")) in p.raw_series.filters
+
+    def test_offset(self):
+        p = parse("foo offset 10m")
+        assert p.offset_ms == 600_000
+        assert p.raw_series.range_selector.to_ms == E - 600_000
+
+    def test_range_needs_function(self):
+        with pytest.raises(ParseError):
+            parse("foo[5m]")
+
+
+class TestFunctions:
+    def test_rate(self):
+        p = parse("rate(foo[5m])")
+        assert isinstance(p, lp.PeriodicSeriesWithWindowing)
+        assert p.function == lp.RangeFunctionId.RATE
+        assert p.window_ms == 300_000
+        assert p.series.range_selector.from_ms == S - 300_000
+
+    def test_quantile_over_time(self):
+        p = parse("quantile_over_time(0.95, foo[10m])")
+        assert p.function == lp.RangeFunctionId.QUANTILE_OVER_TIME
+        assert p.function_args == (0.95,)
+
+    def test_holt_winters_and_predict(self):
+        p = parse("holt_winters(foo[20m], 0.5, 0.1)")
+        assert p.function_args == (0.5, 0.1)
+        p2 = parse("predict_linear(foo[20m], 3600)")
+        assert p2.function_args == (3600.0,)
+
+    def test_instant_functions(self):
+        p = parse("abs(foo)")
+        assert isinstance(p, lp.ApplyInstantFunction)
+        assert p.function == lp.InstantFunctionId.ABS
+        p2 = parse("clamp_max(foo, 10)")
+        assert p2.function_args == (10.0,)
+        p3 = parse("histogram_quantile(0.9, foo)")
+        assert p3.function == lp.InstantFunctionId.HISTOGRAM_QUANTILE
+        assert p3.function_args == (0.9,)
+
+    def test_label_replace(self):
+        p = parse('label_replace(foo, "dst", "$1", "src", "(.*)")')
+        assert isinstance(p, lp.ApplyMiscellaneousFunction)
+        assert p.string_args == ("dst", "$1", "src", "(.*)")
+
+    def test_sort_absent_scalar_vector_time(self):
+        assert isinstance(parse("sort(foo)"), lp.ApplySortFunction)
+        a = parse("absent(foo)")
+        assert isinstance(a, lp.ApplyAbsentFunction)
+        assert ColumnFilter("_metric_", Equals("foo")) in a.filters
+        assert isinstance(parse("scalar(foo)"), lp.ScalarVaryingDoublePlan)
+        v = parse("vector(1)")
+        assert isinstance(v, lp.VectorPlan)
+        t = parse("time()")
+        assert isinstance(t, lp.ScalarTimeBasedPlan)
+
+    def test_last_over_time(self):
+        p = parse("last_over_time(foo[10m])")
+        assert isinstance(p, lp.PeriodicSeries)
+        assert p.raw_series.lookback_ms == 600_000
+
+
+class TestAggregates:
+    def test_sum_by(self):
+        for q in ("sum by (job) (foo)", "sum(foo) by (job)"):
+            p = parse(q)
+            assert isinstance(p, lp.Aggregate)
+            assert p.operator == lp.AggregationOperator.SUM
+            assert p.by == ("job",)
+
+    def test_without(self):
+        p = parse("avg without (instance, host) (foo)")
+        assert p.without == ("instance", "host")
+
+    def test_topk_quantile_count_values(self):
+        p = parse("topk(5, foo)")
+        assert p.operator == lp.AggregationOperator.TOPK
+        assert p.params == (5.0,)
+        p2 = parse("quantile(0.9, foo)")
+        assert p2.params == (0.9,)
+        p3 = parse('count_values("version", foo)')
+        assert p3.params == ("version",)
+
+    def test_nested(self):
+        p = parse("sum(rate(foo[1m])) by (job)")
+        assert isinstance(p, lp.Aggregate)
+        assert isinstance(p.vectors, lp.PeriodicSeriesWithWindowing)
+
+
+class TestBinaryOps:
+    def test_precedence(self):
+        p = parse("foo + bar * 2")
+        assert isinstance(p, lp.BinaryJoin)
+        assert p.operator == lp.BinaryOperator.ADD
+        assert isinstance(p.rhs, lp.ScalarVectorBinaryOperation)
+
+    def test_scalar_scalar(self):
+        p = parse("1 + 2 * 3")
+        assert isinstance(p, lp.ScalarBinaryOperation)
+
+    def test_pow_right_assoc(self):
+        p = parse("2 ^ 3 ^ 2")
+        assert isinstance(p, lp.ScalarBinaryOperation)
+        assert isinstance(p.rhs, lp.ScalarBinaryOperation)
+
+    def test_comparison_bool(self):
+        p = parse("foo > bool 5")
+        assert isinstance(p, lp.ScalarVectorBinaryOperation)
+        assert p.bool_mode
+
+    def test_set_ops_and_matching(self):
+        p = parse("foo and on (job) bar")
+        assert p.operator == lp.BinaryOperator.LAND
+        assert p.on == ("job",)
+        p2 = parse("foo / ignoring (instance) group_left bar")
+        assert p2.ignoring == ("instance",)
+        assert p2.cardinality == lp.Cardinality.MANY_TO_ONE
+
+    def test_unary_minus(self):
+        p = parse("-foo")
+        assert isinstance(p, lp.ScalarVectorBinaryOperation)
+        assert p.scalar_is_lhs
+
+    def test_parse_errors(self):
+        for q in ("foo bar", "sum(", "rate(foo)", "foo{a=}", "and foo"):
+            with pytest.raises(ParseError):
+                parse(q)
+
+
+class TestShardMapper:
+    def test_bit_splice(self):
+        m = ShardMapper(32)
+        spread = 3
+        sk, pk = 0b10110_101, 0b001
+        shard = m.ingestion_shard(sk, pk, spread)
+        assert shard & m.part_hash_mask(spread) == pk & 0b111
+        assert shard & m.shard_hash_mask(spread) == sk & m.shard_hash_mask(spread)
+
+    def test_query_shards_cover_ingestion(self):
+        m = ShardMapper(32)
+        for spread in (0, 2, 5):
+            sk = 0xDEADBEEF
+            shards = m.query_shards(sk, spread)
+            assert len(shards) == 1 << spread
+            for ph in (0, 7, 123, 99999):
+                assert m.ingestion_shard(sk, ph, spread) % 32 in \
+                    [s % 32 for s in shards]
+
+    def test_status_lifecycle(self):
+        m = ShardMapper(4)
+        m.register_node([0, 1], "node-a")
+        m.update_status(0, ShardStatus.ACTIVE)
+        assert m.coord_for_shard(0) == "node-a"
+        assert m.active_shards() == [0]
+        m.unassign(0)
+        assert m.active_shards() == []
+
+
+class TestPlanner:
+    @pytest.fixture(scope="class")
+    def setup(self):
+        ms = TimeSeriesMemStore()
+        cfg = StoreConfig(groups_per_shard=4, max_chunks_size=64,
+                          batch_row_pad=32, batch_series_pad=4)
+        num_shards = 4
+        mapper = ShardMapper(num_shards)
+        mapper.register_node(range(num_shards), "local")
+        for s in range(num_shards):
+            mapper.update_status(s, ShardStatus.ACTIVE)
+            ms.setup("ds", DEFAULT_SCHEMAS, s, cfg)
+        # route records to shards exactly like the gateway would
+        opts = DatasetOptions()
+        spread = 1
+        from filodb_tpu.core.record import decode_container
+        for off, c in enumerate(gauge_containers(n_series=8, n_samples=100) +
+                                counter_containers(n_series=4, n_samples=100)):
+            per_shard = {}
+            for rec in decode_container(c, DEFAULT_SCHEMAS):
+                shard = mapper.ingestion_shard(rec.shard_hash, rec.part_hash,
+                                               spread) % num_shards
+                per_shard.setdefault(shard, []).append(rec)
+            for shard, recs in per_shard.items():
+                ms.get_shard("ds", shard).ingest(recs, off)
+        planner = SingleClusterPlanner("ds", mapper, opts,
+                                       spread_default=spread)
+        return ms, planner
+
+    def q(self, query, start=START_TS + 300_000, end=START_TS + 800_000):
+        return query_range_to_logical_plan(query, start, 10_000, end)
+
+    def test_shard_pruning(self, setup):
+        ms, planner = setup
+        plan = self.q('sum(rate(http_requests_total{_ws_="demo",_ns_="App-0"}[5m]))')
+        ep = planner.materialize(plan)
+        tree = ep.print_tree()
+        # spread=1 -> exactly 2 shard leaves
+        assert tree.count("MultiSchemaPartitionsExec") == 2
+
+    def test_no_pruning_without_shard_key(self, setup):
+        ms, planner = setup
+        ep = planner.materialize(self.q('sum(foo{instance="1"})'))
+        assert ep.print_tree().count("MultiSchemaPartitionsExec") == 4
+
+    def test_end_to_end_sum_rate(self, setup):
+        ms, planner = setup
+        query = 'sum(rate(http_requests_total{_ws_="demo",_ns_="App-0"}[2m]))'
+        start, end = START_TS + 300_000, START_TS + 800_000
+        ep = planner.materialize(self.q(query, start, end))
+        res = ep.execute(ExecContext(ms))
+        assert len(res.batches) == 1
+        got = res.batches[0].np_values()[0]
+        # oracle: all matching series across all shards
+        rows = []
+        for s in range(4):
+            shard = ms.get_shard("ds", s)
+            look = shard.lookup_partitions(
+                [ColumnFilter("_metric_", Equals("http_requests_total")),
+                 ColumnFilter("_ns_", Equals("App-0"))], 0,
+                np.iinfo(np.int64).max)
+            for pid in look.part_ids:
+                part = shard.partitions[int(pid)]
+                ts, vals = part.read_range(0, np.iinfo(np.int64).max)
+                rows.append(oracle.range_fn("rate", ts, vals, start, end,
+                                            10_000, 120_000))
+        expect = np.nansum(np.stack(rows), axis=0)
+        np.testing.assert_allclose(got, expect, rtol=1e-9)
+
+    def test_end_to_end_binary_join(self, setup):
+        ms, planner = setup
+        query = 'heap_usage{_ws_="demo"} - heap_usage{_ws_="demo"}'
+        ep = planner.materialize(self.q(query))
+        res = ep.execute(ExecContext(ms))
+        b = res.batches[0]
+        assert b.num_series == 8
+        v = b.np_values()
+        assert np.nanmax(np.abs(v[np.isfinite(v)])) == 0.0
+
+    def test_end_to_end_scalar_ops(self, setup):
+        ms, planner = setup
+        ep = planner.materialize(self.q('heap_usage * 0 + 3'))
+        res = ep.execute(ExecContext(ms))
+        v = res.batches[0].np_values()
+        assert (v[np.isfinite(v)] == 3.0).all()
+
+    def test_end_to_end_absent(self, setup):
+        ms, planner = setup
+        ep = planner.materialize(self.q('absent(nonexistent_metric)'))
+        res = ep.execute(ExecContext(ms))
+        assert (res.batches[0].np_values() == 1.0).all()
+
+    def test_end_to_end_topk(self, setup):
+        ms, planner = setup
+        ep = planner.materialize(self.q('topk(3, heap_usage{_ws_="demo"})'))
+        res = ep.execute(ExecContext(ms))
+        b = res.batches[0]
+        v = b.np_values()
+        assert 3 <= b.num_series <= 8
+        finite_per_step = np.isfinite(v).sum(axis=0)
+        assert (finite_per_step <= 3).all()
+
+    def test_metadata_plans(self, setup):
+        ms, planner = setup
+        mdplan = lp.SeriesKeysByFilters(
+            (ColumnFilter("_metric_", Equals("heap_usage")),), 0,
+            np.iinfo(np.int64).max)
+        res = planner.materialize(mdplan).execute(ExecContext(ms))
+        assert len(res.batches[0]) == 8
+        lv = lp.LabelValues(("_ns_",), (), 0, np.iinfo(np.int64).max)
+        res2 = planner.materialize(lv).execute(ExecContext(ms))
+        assert len(res2.batches[0]["_ns_"]) == 8
+
+    def test_hierarchical_reduce_shape(self, setup):
+        ms, planner0 = setup
+        mapper = ShardMapper(64)
+        planner = SingleClusterPlanner("ds", mapper, DatasetOptions(),
+                                       spread_default=1)
+        ep = planner.materialize(self.q("sum(foo)"))
+        tree = ep.print_tree()
+        # 64 leaves -> 8 intermediate reduces under the root
+        assert tree.count("ReduceAggregateExec") == 9
