@@ -75,9 +75,13 @@ class SingleClusterPlanner(QueryPlanner):
             if v is None:
                 return self._all_shards()
             values[col] = v
-        spread = qctx.spread if qctx.spread is not None else self.spread_default
+        # per-query spread override wins over the provider (reference:
+        # QueryActor.scala:70-85 — explicit spreadOverride beats the func)
+        spread = self.spread_default
         if self.spread_provider is not None:
             spread = self.spread_provider(values)
+        if qctx.spread is not None:
+            spread = qctx.spread
         shash = self._shard_key_hash(values)
         shards = [s % self.mapper.num_shards
                   for s in self.mapper.query_shards(shash, spread)]
@@ -265,4 +269,4 @@ class SingleClusterPlanner(QueryPlanner):
                                    plan.on, plan.ignoring, qctx)
         return BinaryJoinExec(children, len(lhs_children), plan.operator,
                               plan.cardinality, plan.on, plan.ignoring,
-                              plan.include, qctx)
+                              plan.include, qctx, bool_mode=plan.bool_mode)

@@ -1,0 +1,267 @@
+"""Mesh-sharded distributed query execution: the ICI-collective data plane.
+
+This replaces the reference's cross-node scatter-gather (Akka-dispatched
+ExecPlan subtrees + Kryo results, reference: coordinator/src/main/scala/
+filodb.coordinator/queryplanner/SingleClusterPlanner.scala:223-258 hierarchical
+reduce; query/src/main/scala/filodb/query/exec/PlanDispatcher.scala:29-46)
+with a single SPMD program over a `jax.sharding.Mesh`:
+
+- **shard axis (dp)** — FiloDB shards are laid out along the mesh's ``shard``
+  axis; each device scans+windows its local shards, then the cross-shard
+  aggregation (the reference's ReduceAggregateExec tree) is ONE
+  ``lax.psum`` riding ICI instead of actor messages riding TCP.
+- **step axis (sp)** — the output step grid (time axis) is sharded along the
+  ``step`` axis; this is the long-range-query analog of sequence parallelism:
+  a 1h range over 1M series splits its windows across devices (the
+  reference's time-splitting, SingleClusterPlanner.scala:61-78, without the
+  stitch step because windows are computed from replicated row data).
+
+Data never leaves the device between scan, window, and reduce — the entire
+leaf pipeline (reference hot path, SURVEY.md §3.1) is one jitted SPMD
+program per (function, aggregate) signature.
+
+Multi-host: the same program runs unchanged over a multi-host mesh created
+from ``jax.distributed.initialize`` + ``mesh_utils.create_device_mesh``;
+collectives then ride ICI within a slice and DCN across slices.  The host
+control plane (which process owns which FiloDB shards) is
+:mod:`filodb_tpu.coordinator.cluster`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import mesh_utils
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from filodb_tpu.core.chunk import ChunkBatch, TS_PAD
+from filodb_tpu.ops.windows import StepRange
+from filodb_tpu.query.logical import AggregationOperator as Agg
+from filodb_tpu.query import rangefns
+
+
+def make_mesh(devices: Optional[Sequence] = None,
+              shape: Optional[tuple[int, int]] = None) -> Mesh:
+    """Build a 2D ``(shard, step)`` mesh over the given (default: all) devices.
+
+    ``shape`` defaults to putting everything on the shard axis — the common
+    case for high-cardinality queries — i.e. ``(n, 1)``.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if shape is None:
+        shape = (n, 1)
+    if shape[0] * shape[1] != n:
+        raise ValueError(f"mesh shape {shape} != {n} devices")
+    arr = mesh_utils.create_device_mesh(shape, devices=list(devices))
+    return Mesh(arr, axis_names=("shard", "step"))
+
+
+# --------------------------------------------------------------------------
+# SPMD window+aggregate program
+# --------------------------------------------------------------------------
+
+# aggregations expressible as a psum-able (map, combine, present) triple.
+# map: [N, T] vals -> per-group partial [G, T, C]; combine = psum; present ->
+# [G, T].  Mirrors the reference's RowAggregator map/reduce/present split
+# (query/src/main/scala/filodb/query/exec/aggregator/RowAggregator.scala:29).
+def _seg_sum_count(vals, ids, G):
+    fin = jnp.isfinite(vals)
+    v = jnp.where(fin, vals, 0.0)
+    s = jnp.zeros((G, vals.shape[1]), vals.dtype).at[ids].add(v)
+    c = jnp.zeros((G, vals.shape[1]), vals.dtype).at[ids].add(fin.astype(vals.dtype))
+    return s, c
+
+
+def _seg_minmax(vals, ids, G, big, op):
+    v = jnp.where(jnp.isfinite(vals), vals, big)
+    out = jnp.full((G, vals.shape[1]), big, vals.dtype)
+    out = out.at[ids].min(v) if op == "min" else out.at[ids].max(v)
+    return out
+
+
+_INF = jnp.inf
+
+
+def _agg_map(op: Agg, vals, ids, G):
+    """-> tuple of [G, T] partials, each combinable by a single collective."""
+    if op in (Agg.SUM, Agg.COUNT, Agg.AVG):
+        return _seg_sum_count(vals, ids, G)
+    if op in (Agg.STDDEV, Agg.STDVAR):
+        s, c = _seg_sum_count(vals, ids, G)
+        fin = jnp.isfinite(vals)
+        sq = jnp.where(fin, vals * vals, 0.0)
+        s2 = jnp.zeros((G, vals.shape[1]), vals.dtype).at[ids].add(sq)
+        return s, c, s2
+    if op == Agg.MIN:
+        return (_seg_minmax(vals, ids, G, _INF, "min"),)
+    if op == Agg.MAX:
+        return (_seg_minmax(vals, ids, G, -_INF, "max"),)
+    raise ValueError(f"aggregate {op} has no distributive psum form")
+
+
+_MINMAX_COMBINE = {Agg.MIN: lax.pmin, Agg.MAX: lax.pmax}
+
+
+def _agg_combine(op: Agg, partials, axis: str):
+    if op in _MINMAX_COMBINE:
+        return tuple(_MINMAX_COMBINE[op](p, axis) for p in partials)
+    return tuple(lax.psum(p, axis) for p in partials)
+
+
+def _agg_present(op: Agg, partials):
+    if op == Agg.SUM:
+        s, c = partials
+        return jnp.where(c > 0, s, jnp.nan)
+    if op == Agg.COUNT:
+        s, c = partials
+        return jnp.where(c > 0, c, jnp.nan)
+    if op == Agg.AVG:
+        s, c = partials
+        return jnp.where(c > 0, s / jnp.maximum(c, 1.0), jnp.nan)
+    if op in (Agg.STDDEV, Agg.STDVAR):
+        s, c, s2 = partials
+        mean = s / jnp.maximum(c, 1.0)
+        var = s2 / jnp.maximum(c, 1.0) - mean * mean
+        var = jnp.maximum(var, 0.0)
+        out = var if op == Agg.STDVAR else jnp.sqrt(var)
+        return jnp.where(c > 0, out, jnp.nan)
+    (m,) = partials
+    return jnp.where(jnp.isfinite(m), m, jnp.nan)
+
+
+@functools.lru_cache(maxsize=128)
+def _build_program(mesh_key, range_fn, agg_op: Agg, num_groups: int,
+                   window_ms: int, wmax: int, extra_args: tuple):
+    """Compile the SPMD scan→window→aggregate program for one signature."""
+    mesh = _MESHES[mesh_key]
+
+    kind = rangefns.kernel_kind(range_fn)
+    kernel = rangefns.raw_kernel(range_fn)
+
+    def local(ts, vals, ids, steps):
+        # ts/vals: [Kl*S, R] local shards flattened; steps: [Tl] local steps
+        window = jnp.asarray(window_ms, dtype=ts.dtype)
+        if kind == "last":
+            stepped = kernel(ts, vals, steps, window)
+        elif kind == "prefix":
+            stepped = kernel(ts, vals, steps, window)
+        else:
+            stepped = kernel(ts, vals, steps, window, wmax, *extra_args)
+        partials = _agg_map(agg_op, stepped, ids, num_groups)
+        partials = _agg_combine(agg_op, partials, "shard")
+        return _agg_present(agg_op, partials)   # [G, Tl]
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P("shard", None), P("shard", None), P("shard"), P("step")),
+        out_specs=P(None, "step"),
+    )
+    return jax.jit(fn)
+
+
+# shard_map needs the Mesh object at trace time but lru_cache needs hashable
+# keys; registry keyed by id-like tuple.
+_MESHES: dict = {}
+
+
+def _mesh_key(mesh: Mesh):
+    key = (tuple(d.id for d in mesh.devices.flat), mesh.devices.shape)
+    _MESHES[key] = mesh
+    return key
+
+
+class MeshEngine:
+    """Distributed leaf executor: batches per-shard data onto the mesh and
+    runs the windowed aggregation as one SPMD program.
+
+    The host-side contract mirrors the reference's scatter-gather: callers
+    hand one ChunkBatch per FiloDB shard (`shard_batches`), already padded to
+    a common [S, R]; the engine stacks them to [K, S*? ...] device arrays
+    laid out along the mesh shard axis.
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self._key = _mesh_key(self.mesh)
+
+    @property
+    def num_shard_slices(self) -> int:
+        return self.mesh.devices.shape[0]
+
+    @property
+    def num_step_slices(self) -> int:
+        return self.mesh.devices.shape[1]
+
+    def _place(self, arr: np.ndarray, spec: P):
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+    def stack_shards(self, shard_batches: Sequence[ChunkBatch],
+                     group_ids: Sequence[np.ndarray]):
+        """[K shards of [S_k, R_k]] -> ([K, S, R] ts/vals, [K, S] ids) padded
+        so K divides the shard-axis size and S, R are common."""
+        K = len(shard_batches)
+        kd = self.num_shard_slices
+        Kp = ((K + kd - 1) // kd) * kd if K else kd
+        S = max((b.num_series for b in shard_batches), default=1)
+        R = max((b.max_rows for b in shard_batches), default=1)
+        ts = np.full((Kp, S, R), TS_PAD, dtype=np.int64)
+        vals = np.full((Kp, S, R), np.nan, dtype=np.float64)
+        # group id for padded series: 0 — harmless because their stepped
+        # values are NaN and every _agg_map drops non-finite entries.
+        ids = np.zeros((Kp, S), dtype=np.int32)
+        for k, (b, gid) in enumerate(zip(shard_batches, group_ids)):
+            s, r = b.timestamps.shape
+            ts[k, :s, :r] = b.timestamps
+            vals[k, :s, :r] = b.values
+            ids[k, :len(gid)] = gid
+        return ts, vals, ids
+
+    def pad_steps(self, steps: np.ndarray) -> tuple[np.ndarray, int]:
+        td = self.num_step_slices
+        T = len(steps)
+        Tp = ((T + td - 1) // td) * td
+        if Tp == T:
+            return steps, T
+        # pad with steps far past the data; they produce NaNs and are trimmed.
+        step = steps[-1] - steps[-2] if T > 1 else 1
+        pad = steps[-1] + step * np.arange(1, Tp - T + 1)
+        return np.concatenate([steps, pad]), T
+
+    def window_aggregate(self, shard_batches: Sequence[ChunkBatch],
+                         group_ids: Sequence[np.ndarray], num_groups: int,
+                         srange: StepRange, window_ms: int,
+                         range_fn=None, agg_op: Agg = Agg.SUM,
+                         extra_args: tuple = ()) -> np.ndarray:
+        """Full distributed pipeline -> [num_groups, T] on host."""
+        ts, vals, ids = self.stack_shards(shard_batches, group_ids)
+        K, S, R = ts.shape
+        ts = ts.reshape(K * S, R)
+        vals = vals.reshape(K * S, R)
+        ids = ids.reshape(K * S)
+        steps_np = np.asarray(srange.timestamps(np.int64))
+        steps_np, T = self.pad_steps(steps_np)
+
+        wmax = 0
+        if rangefns.kernel_kind(range_fn) == "gather":
+            wmax = rangefns.bucket_wmax(ts, steps_np, window_ms)
+
+        d_ts = self._place(ts, P("shard", None))
+        d_vals = self._place(vals, P("shard", None))
+        d_ids = self._place(ids, P("shard"))
+        d_steps = self._place(steps_np, P("step"))
+
+        prog = _build_program(self._key, range_fn, agg_op, num_groups,
+                              window_ms, wmax, extra_args)
+        out = prog(d_ts, d_vals, d_ids, d_steps)
+        return np.asarray(out)[:, :T]

@@ -50,7 +50,7 @@ class ParseError(Exception):
 _TOKEN_RE = re.compile(r"""
     (?P<WS>\s+)
   | (?P<DURATION>[0-9]+(?:\.[0-9]+)?(?:ms|s|m|h|d|w|y)(?:[0-9]+(?:ms|s|m|h|d|w|y))*)
-  | (?P<NUMBER>(?:[0-9]+(?:\.[0-9]*)?|\.[0-9]+)(?:[eE][+-]?[0-9]+)?|0x[0-9a-fA-F]+|[Ii]nf|NaN)
+  | (?P<NUMBER>(?:[0-9]+(?:\.[0-9]*)?|\.[0-9]+)(?:[eE][+-]?[0-9]+)?|0x[0-9a-fA-F]+|(?:[Ii]nf|NaN)(?![a-zA-Z0-9_:.]))
   | (?P<STRING>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
   | (?P<OP>=~|!~|!=|==|>=|<=|->|[\[\]{}()+\-*/%^,=<>:@])
   | (?P<IDENT>[a-zA-Z_:][a-zA-Z0-9_:.]*)
@@ -62,7 +62,10 @@ _DUR_PART = re.compile(r"([0-9]+(?:\.[0-9]+)?)(ms|s|m|h|d|w|y)")
 
 
 def duration_ms(text: str) -> int:
-    return int(sum(float(n) * _DUR_MS[u] for n, u in _DUR_PART.findall(text)))
+    parts = _DUR_PART.findall(text)
+    if not parts or "".join(n + u for n, u in parts) != text:
+        raise ParseError(f"invalid duration {text!r}")
+    return int(sum(float(n) * _DUR_MS[u] for n, u in parts))
 
 
 @dataclasses.dataclass
@@ -235,7 +238,8 @@ class Parser:
     def unary(self) -> LogicalPlan:
         if self.at("-") or self.at("+"):
             neg = self.next().text == "-"
-            operand = self.unary()
+            # '^' binds tighter than unary minus (Prometheus: -2^2 == -(2^2))
+            operand = self.expr(_PRECEDENCE["^"])
             if not neg:
                 return operand
             zero = ScalarFixedDoublePlan(0.0, self.start, self.step, self.end)
@@ -361,6 +365,12 @@ class Parser:
     def call(self, name: str) -> LogicalPlan:
         self.next()  # name
         self.expect("(")
+        # zero-arg time functions (hour(), month(), ...) must win over their
+        # one-arg instant-function forms, which share the same names
+        if name in _TIME_FNS and self.at(")"):
+            self.next()
+            return ScalarTimeBasedPlan(ScalarFunctionId(name), self.start,
+                                       self.step, self.end)
         if name in _RANGE_FNS:
             fn = _RANGE_FNS[name]
             # arg layouts: quantile_over_time(q, sel[w]) / holt_winters(sel, sf, tf)
@@ -503,8 +513,7 @@ class Parser:
         t = self.next()
         if t.kind != "STRING":
             raise ParseError(f"expected string, got {t.text!r}")
-        body = t.text[1:-1]
-        return body.encode().decode("unicode_escape")
+        return _unescape(t.text[1:-1])
 
     # -- binary combination -------------------------------------------------
 
@@ -525,13 +534,34 @@ class Parser:
             return ScalarVectorBinaryOperation(op, scalar, vector,
                                                scalar_is_lhs=lhs_scalar,
                                                bool_mode=bool_mode)
-        return BinaryJoin(lhs, op, card, rhs, on, ignoring, include)
+        return BinaryJoin(lhs, op, card, rhs, on, ignoring, include,
+                          bool_mode=bool_mode)
 
 
 def _fold(p: ScalarPlan):
     if isinstance(p, ScalarFixedDoublePlan):
         return p.scalar
     return p
+
+
+_ESC_RE = re.compile(
+    r"\\(u[0-9a-fA-F]{4}|U[0-9a-fA-F]{8}|x[0-9a-fA-F]{2}|[0-7]{1,3}|.)",
+    re.DOTALL)
+_ESC_MAP = {"n": "\n", "t": "\t", "r": "\r", "a": "\a", "b": "\b",
+            "f": "\f", "v": "\v", "\\": "\\", '"': '"', "'": "'"}
+
+
+def _unescape(body: str) -> str:
+    """Decode PromQL string escapes without mangling non-ASCII text (a
+    unicode_escape round-trip would read UTF-8 bytes as latin-1)."""
+    def repl(m: "re.Match[str]") -> str:
+        s = m.group(1)
+        if s[0] in "uUx":
+            return chr(int(s[1:], 16))
+        if s[0] in "01234567":
+            return chr(int(s, 8))
+        return _ESC_MAP.get(s, s)
+    return _ESC_RE.sub(repl, body)
 
 
 def _number(text: str) -> float:

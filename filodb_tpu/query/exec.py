@@ -20,6 +20,7 @@ from filodb_tpu.memstore.memstore import TimeSeriesMemStore
 from filodb_tpu.ops import instant as instant_ops
 from filodb_tpu.ops.windows import StepRange
 from filodb_tpu.query.aggregators import AggPartialBatch, aggregator_for
+from filodb_tpu.query import logical as lp
 from filodb_tpu.query.logical import (AggregationOperator, BinaryOperator,
                                       Cardinality, ScalarFunctionId)
 from filodb_tpu.query.model import (PeriodicBatch, QueryContext, QueryError,
@@ -325,7 +326,8 @@ class BinaryJoinExec(NonLeafExecPlan):
     def __init__(self, children, lhs_count: int, operator: BinaryOperator,
                  cardinality: Cardinality = Cardinality.ONE_TO_ONE,
                  on: tuple = (), ignoring: tuple = (), include: tuple = (),
-                 query_context=None, dispatcher: PlanDispatcher = IN_PROCESS):
+                 query_context=None, dispatcher: PlanDispatcher = IN_PROCESS,
+                 bool_mode: bool = False):
         super().__init__(children, query_context, dispatcher)
         self.lhs_count = lhs_count
         self.operator = operator
@@ -333,6 +335,7 @@ class BinaryJoinExec(NonLeafExecPlan):
         self.on = tuple(on)
         self.ignoring = tuple(ignoring)
         self.include = tuple(include)
+        self.bool_mode = bool_mode
 
     def _join_key(self, tags: dict) -> tuple:
         if self.on:
@@ -372,7 +375,7 @@ class BinaryJoinExec(NonLeafExecPlan):
                                      "duplicate series on left side of join")
                 seen.add(k)
             res = np.asarray(instant_ops.apply_binary(
-                self.operator.name, lv[i], rv[j], False))
+                self.operator.name, lv[i], rv[j], self.bool_mode))
             key = self._result_key(t, rhs_b.keys[j])
             out_keys.append(key)
             rows.append(res)
@@ -382,6 +385,9 @@ class BinaryJoinExec(NonLeafExecPlan):
 
     def _result_key(self, lt: dict, rt: dict) -> dict:
         if self.operator.is_comparison:
+            if self.bool_mode:  # bool comparisons drop the metric name
+                return {k: v for k, v in lt.items()
+                        if k not in ("_metric_", "__name__")}
             return dict(lt)
         if self.on:
             key = {k: lt.get(k, "") for k in self.on if k in lt}
@@ -469,6 +475,18 @@ class ScalarBinaryOperationExec(LeafExecPlan):
     def _eval(self, side, ctx) -> np.ndarray:
         if isinstance(side, (int, float)):
             return np.full(self.steps.num_steps, float(side))
+        if isinstance(side, lp.ScalarBinaryOperation):
+            # nested scalar expression: evaluate inline (reference:
+            # ScalarBinaryOperationExec evaluates nested operands itself)
+            nested = ScalarBinaryOperationExec(
+                side.operator, side.lhs, side.rhs, self.steps.start,
+                self.steps.step, self.steps.end, self.query_context)
+            lv = nested._eval(side.lhs, ctx)
+            rv = nested._eval(side.rhs, ctx)
+            return np.asarray(instant_ops.apply_binary(
+                side.operator.name, lv, rv, False))
+        if isinstance(side, lp.ScalarFixedDoublePlan):
+            return np.full(self.steps.num_steps, float(side.scalar))
         res = side.execute(ctx) if isinstance(side, ExecPlan) else None
         if res is not None:
             b = res.batches[0]

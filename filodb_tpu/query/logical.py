@@ -230,6 +230,7 @@ class BinaryJoin(PeriodicSeriesPlan):
     on: tuple[str, ...] = ()
     ignoring: tuple[str, ...] = ()
     include: tuple[str, ...] = ()
+    bool_mode: bool = False  # comparison returns 0/1 instead of filtering
 
 
 @dataclasses.dataclass(frozen=True)

@@ -103,3 +103,33 @@ def apply_range_function(batch: ChunkBatch, steps: StepRange,
         return _jit(kern, static_argnums=tuple(range(4, 5 + len(extra))))(
             ts, vals, step_arr, window, wmax, *extra)
     raise ValueError(f"unsupported range function {func}")
+
+
+# --------------------------------------------------------------------------
+# Kernel introspection for the mesh engine (parallel/mesh.py), which builds
+# its own SPMD program around the raw kernels rather than calling
+# apply_range_function per shard.
+# --------------------------------------------------------------------------
+
+def kernel_kind(func: Optional[F]) -> str:
+    """'last' | 'prefix' | 'gather' — how the kernel is invoked."""
+    if func is None:
+        return "last"
+    if func in _PREFIX:
+        return "prefix"
+    if func in _GATHER:
+        return "gather"
+    raise ValueError(f"unsupported range function {func}")
+
+
+def raw_kernel(func: Optional[F]):
+    if func is None:
+        return _last_sample_value
+    return _PREFIX.get(func) or _GATHER[func]
+
+
+def bucket_wmax(ts, steps, window) -> int:
+    """Max rows in any window, rounded to a 16-multiple shape bucket."""
+    wmax = windows.max_window_rows(jnp.asarray(ts), jnp.asarray(steps),
+                                   jnp.asarray(window))
+    return max(int(np.ceil(wmax / 16)) * 16, 16)

@@ -83,27 +83,30 @@ class InstantVectorFunctionMapper(RangeVectorTransformer):
 
     def apply(self, batches, ctx):
         fid = self.function
+        # resolve ExecPlan-valued args ONCE, not once per batch (they may be
+        # whole scalar subqueries, reference: ExecPlanFuncArgs)
+        resolved = [_resolve(a, ctx) for a in self.args]
         out = []
         for b in batches:
             if fid == InstantFunctionId.HISTOGRAM_QUANTILE:
-                q = float(_scalar_arg(self.args, 0, ctx))
+                q = float(_scalar_arg(resolved, 0))
                 vals = np.asarray(histogram_ops.hist_quantile(
                     jnp.asarray(b.bucket_tops), jnp.asarray(b.hist), q))
                 out.append(PeriodicBatch(b.keys, b.steps, vals))
             elif fid == InstantFunctionId.HISTOGRAM_MAX_QUANTILE:
-                q = float(_scalar_arg(self.args, 0, ctx))
+                q = float(_scalar_arg(resolved, 0))
                 vals = np.asarray(histogram_ops.hist_max_quantile(
                     jnp.asarray(b.bucket_tops), jnp.asarray(b.hist),
                     jnp.asarray(b.values), q))
                 out.append(PeriodicBatch(b.keys, b.steps, vals))
             elif fid == InstantFunctionId.HISTOGRAM_BUCKET:
-                le = float(_scalar_arg(self.args, 0, ctx))
+                le = float(_scalar_arg(resolved, 0))
                 vals = np.asarray(histogram_ops.hist_bucket(
                     jnp.asarray(b.bucket_tops), jnp.asarray(b.hist), le))
                 out.append(PeriodicBatch(b.keys, b.steps, vals))
             else:
                 fn = instant_ops.INSTANT_FUNCTIONS[fid.value]
-                args = [np.asarray(_eval_arg(a, b.steps, ctx)) for a in self.args]
+                args = [np.asarray(_eval_arg(a, b.steps)) for a in resolved]
                 vals = np.asarray(fn(jnp.asarray(b.values), *args))
                 out.append(PeriodicBatch(b.keys, b.steps, vals))
         return out

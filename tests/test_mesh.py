@@ -1,0 +1,107 @@
+"""Mesh engine (parallel/mesh.py) vs single-device path on the virtual
+8-device CPU mesh — the stand-in for the reference's sbt-multi-jvm cluster
+tests (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.chunk import build_batch
+from filodb_tpu.ops.windows import StepRange
+from filodb_tpu.parallel.mesh import MeshEngine, make_mesh
+from filodb_tpu.query.logical import AggregationOperator as Agg
+from filodb_tpu.query.logical import RangeFunctionId as F
+from filodb_tpu.query.rangefns import apply_range_function
+
+WINDOW = 300_000
+SRANGE = StepRange(1_000_000, 1_450_000, 30_000)
+
+
+def _mk_shards(num_shards=6, series_per_shard=5, rows=120, seed=0):
+    rng = np.random.default_rng(seed)
+    batches, gids = [], []
+    for k in range(num_shards):
+        ts, vs = [], []
+        n = series_per_shard - (k % 2)  # uneven shards
+        for s in range(n):
+            r = rows - rng.integers(0, 30)
+            t = np.sort(rng.integers(700_000, 1_460_000, size=r)).astype(np.int64)
+            t = np.unique(t)
+            v = np.cumsum(rng.random(len(t)) * 10).astype(np.float64)
+            ts.append(t)
+            vs.append(v)
+        batches.append(build_batch(ts, vs))
+        gids.append(np.array([s % 3 for s in range(n)], dtype=np.int32))
+    return batches, gids
+
+
+def _oracle(batches, gids, num_groups, func, agg):
+    """Single-device kernels + numpy group aggregation."""
+    per_shard = []
+    for b, g in zip(batches, gids):
+        stepped = np.asarray(apply_range_function(b, SRANGE, WINDOW, func))
+        per_shard.append((stepped[: len(g)], g))
+    T = SRANGE.num_steps
+    all_vals = np.concatenate([s for s, _ in per_shard], axis=0)
+    all_ids = np.concatenate([g for _, g in per_shard], axis=0)
+    out = np.full((num_groups, T), np.nan)
+    for g in range(num_groups):
+        rows = all_vals[all_ids == g]
+        fin = np.isfinite(rows)
+        any_fin = fin.any(axis=0)
+        if agg == Agg.SUM:
+            v = np.where(fin, rows, 0.0).sum(axis=0)
+        elif agg == Agg.COUNT:
+            v = fin.sum(axis=0).astype(float)
+        elif agg == Agg.AVG:
+            v = np.where(fin, rows, 0.0).sum(axis=0) / np.maximum(fin.sum(axis=0), 1)
+        elif agg == Agg.MIN:
+            v = np.where(fin, rows, np.inf).min(axis=0)
+        elif agg == Agg.MAX:
+            v = np.where(fin, rows, -np.inf).max(axis=0)
+        elif agg == Agg.STDDEV:
+            n = np.maximum(fin.sum(axis=0), 1)
+            m = np.where(fin, rows, 0.0).sum(axis=0) / n
+            v = np.sqrt(np.maximum(
+                np.where(fin, rows**2, 0.0).sum(axis=0) / n - m * m, 0.0))
+        out[g] = np.where(any_fin, v, np.nan)
+    return out
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return MeshEngine(make_mesh(shape=(4, 2)))
+
+
+@pytest.mark.parametrize("agg", [Agg.SUM, Agg.COUNT, Agg.AVG, Agg.MIN,
+                                 Agg.MAX, Agg.STDDEV])
+def test_rate_agg_matches_single_device(engine, agg):
+    batches, gids = _mk_shards()
+    got = engine.window_aggregate(batches, gids, 3, SRANGE, WINDOW,
+                                  range_fn=F.RATE, agg_op=agg)
+    want = _oracle(batches, gids, 3, F.RATE, agg)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12, equal_nan=True)
+
+
+def test_gather_kernel_on_mesh(engine):
+    batches, gids = _mk_shards(seed=7)
+    got = engine.window_aggregate(batches, gids, 3, SRANGE, WINDOW,
+                                  range_fn=F.MAX_OVER_TIME, agg_op=Agg.MAX)
+    want = _oracle(batches, gids, 3, F.MAX_OVER_TIME, Agg.MAX)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12, equal_nan=True)
+
+
+def test_last_sample_selector_on_mesh(engine):
+    batches, gids = _mk_shards(seed=3)
+    got = engine.window_aggregate(batches, gids, 3, SRANGE, WINDOW,
+                                  range_fn=None, agg_op=Agg.SUM)
+    want = _oracle(batches, gids, 3, None, Agg.SUM)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12, equal_nan=True)
+
+
+def test_shard_axis_only_mesh():
+    eng = MeshEngine(make_mesh(shape=(8, 1)))
+    batches, gids = _mk_shards(num_shards=3, seed=11)
+    got = eng.window_aggregate(batches, gids, 3, SRANGE, WINDOW,
+                               range_fn=F.INCREASE, agg_op=Agg.SUM)
+    want = _oracle(batches, gids, 3, F.INCREASE, Agg.SUM)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12, equal_nan=True)

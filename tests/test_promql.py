@@ -338,3 +338,70 @@ class TestPlanner:
         tree = ep.print_tree()
         # 64 leaves -> 8 intermediate reduces under the root
         assert tree.count("ReduceAggregateExec") == 9
+
+
+class TestParserRegressions:
+    """Fixes from code review: lexer prefixes, zero-arg time fns, bool
+    modifier, strict durations, string escapes, unary-vs-pow precedence."""
+
+    def test_metric_name_starting_with_inf(self):
+        p = parse_query("influxdb_up", S, T, E)
+        leaves = lp.leaf_raw_series(p)
+        assert any(f.column == "_metric_" and f.filter.value == "influxdb_up"
+                   for f in leaves[0].filters)
+        p2 = parse_query("rate(inflight_requests[5m])", S, T, E)
+        assert lp.leaf_raw_series(p2)
+
+    def test_inf_nan_literals_still_parse(self):
+        p = parse_query("foo > Inf", S, T, E)
+        assert isinstance(p, lp.ScalarVectorBinaryOperation)
+        p = parse_query("NaN", S, T, E)
+        assert isinstance(p, lp.ScalarFixedDoublePlan)
+
+    def test_zero_arg_time_functions(self):
+        for fn in ("hour", "minute", "month", "year", "day_of_week",
+                   "day_of_month", "days_in_month"):
+            p = parse_query(f"{fn}()", S, T, E)
+            assert isinstance(p, lp.ScalarTimeBasedPlan), fn
+        # one-arg instant form still works
+        p = parse_query("hour(foo)", S, T, E)
+        assert isinstance(p, lp.ApplyInstantFunction)
+
+    def test_bool_modifier_on_vector_vector(self):
+        p = parse_query("foo > bool bar", S, T, E)
+        assert isinstance(p, lp.BinaryJoin)
+        assert p.bool_mode is True
+        p2 = parse_query("foo > bar", S, T, E)
+        assert p2.bool_mode is False
+
+    def test_unitless_duration_rejected(self):
+        with pytest.raises(ParseError):
+            parse_query("rate(foo[30])", S, T, E)
+        with pytest.raises(ParseError):
+            parse_query("foo offset 5", S, T, E)
+
+    def test_non_ascii_string_values(self):
+        p = parse_query('foo{a="café", b="x\\ny", c="\\u00e9"}',
+                        S, T, E)
+        filters = {f.column: f.filter.value for f in
+                   lp.leaf_raw_series(p)[0].filters}
+        assert filters["a"] == "café"
+        assert filters["b"] == "x\ny"
+        assert filters["c"] == "é"
+
+    def test_unary_minus_pow_precedence(self):
+        p = parse_query("-2^2", S, T, E)
+        import filodb_tpu.query.exec as qe
+        from filodb_tpu.query.model import QueryContext
+        ex = qe.ScalarBinaryOperationExec(p.operator, p.lhs, p.rhs,
+                                          S, T, E)
+        vals = ex.do_execute(ExecContext(None, "ds"))[0].values
+        assert float(np.asarray(vals).ravel()[0]) == -4.0
+
+    def test_unary_minus_mul_precedence(self):
+        p = parse_query("-2*3", S, T, E)
+        import filodb_tpu.query.exec as qe
+        ex = qe.ScalarBinaryOperationExec(p.operator, p.lhs, p.rhs,
+                                          S, T, E)
+        vals = ex.do_execute(ExecContext(None, "ds"))[0].values
+        assert float(np.asarray(vals).ravel()[0]) == -6.0
