@@ -1,0 +1,174 @@
+"""North-star benchmark: PromQL samples-scanned/sec on one chip.
+
+Workload: the QueryInMemoryBenchmark-equivalent hot path (reference:
+jmh/src/main/scala/filodb.jmh/QueryInMemoryBenchmark.scala:45-249, scaled to
+the BASELINE.json north-star config) — ``sum by (group)(rate(metric[5m]))``
+over 1M series × 1h of samples: the leaf scan -> windowed rate (with counter
+correction) -> grouped aggregation pipeline as one jitted XLA program.
+
+Protocol (see .claude/skills/verify/SKILL.md gotchas): data is generated
+on-device from a scalar seed; the pipeline runs K statically-known
+iterations, each forced by a ``float(...)`` readback; elapsed time subtracts
+the measured no-op readback RTT.  int32 timestamps / float32 values (TPU
+f64 is emulated).
+
+Baseline: the reference publishes no absolute numbers (BASELINE.md), so
+``vs_baseline`` is measured against a single-core numpy implementation of
+the identical workload (a stand-in for the JVM's per-row iterator path),
+run on a subsample and scaled per-sample.
+
+Prints exactly ONE JSON line on stdout.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+S = int(os.environ.get("FILODB_BENCH_SERIES", 1_000_000))
+R = int(os.environ.get("FILODB_BENCH_ROWS", 60))        # 1h at 1m resolution
+G = int(os.environ.get("FILODB_BENCH_GROUPS", 1_000))   # sum by (group)
+ITERS = int(os.environ.get("FILODB_BENCH_ITERS", 5))
+WINDOW_MS = 300_000                                     # rate(...[5m])
+STEP_MS = 60_000
+SUB = int(os.environ.get("FILODB_BENCH_NUMPY_SERIES", 2_000))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from filodb_tpu.ops import windows
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind})")
+
+    span_ms = R * STEP_MS
+    t0 = 600_000
+    steps_np = np.arange(t0 + WINDOW_MS, t0 + span_ms, STEP_MS, dtype=np.int32)
+    T = len(steps_np)
+
+    def gen_body(seed):
+        """On-device workload gen: jittered 1m-grid counter series."""
+        key = jax.random.PRNGKey(seed)
+        k1, k2 = jax.random.split(key)
+        base = jnp.arange(R, dtype=jnp.int32) * STEP_MS + t0
+        jitter = jax.random.randint(k1, (S, R), 0, 30_000, dtype=jnp.int32)
+        ts = jnp.sort(base[None, :] + jitter, axis=1)
+        incr = jax.random.uniform(k2, (S, R), jnp.float32, 0.0, 10.0)
+        vals = jnp.cumsum(incr, axis=1)
+        return ts, vals
+
+    def pipeline(ts, vals, ids, steps, bump):
+        # bump defeats cross-iteration CSE without changing the math shape
+        window = jnp.asarray(WINDOW_MS, dtype=ts.dtype)
+        stepped = windows.rate(ts, vals + bump, steps, window)     # [S, T]
+        fin = jnp.isfinite(stepped)
+        v = jnp.where(fin, stepped, 0.0)
+        s = jnp.zeros((G, T), stepped.dtype).at[ids].add(v)
+        c = jnp.zeros((G, T), stepped.dtype).at[ids].add(fin.astype(stepped.dtype))
+        return jnp.where(c > 0, s, jnp.nan)
+
+    def build(iters: int):
+        """Jitted: gen + `iters` statically-unrolled pipeline runs, scalar
+        in / scalar out so the axon tunnel re-uploads nothing per call."""
+        def f(seed):
+            ts, vals = gen_body(seed)
+            ids = jnp.arange(S, dtype=jnp.int32) % G
+            steps = jnp.asarray(steps_np)
+            acc = jnp.float32(0.0)
+            for i in range(iters):
+                out = pipeline(ts, vals, ids, steps, jnp.float32(i))
+                acc = acc + out[0, 0] + out[G // 2, T // 2]
+            return acc
+        return jax.jit(f)
+
+    f_base, f_full = build(1), build(1 + ITERS)
+    log("compiling (1 and %d iteration variants)..." % (1 + ITERS))
+    _ = float(f_base(0))
+    _ = float(f_full(0))
+
+    def timed(f, reps=3):
+        best = []
+        for r in range(reps):
+            a = time.perf_counter()
+            _ = float(f(0))
+            best.append(time.perf_counter() - a)
+        return float(np.median(best))
+
+    log("timing...")
+    t_base = timed(f_base)
+    t_full = timed(f_full)
+    elapsed = max(t_full - t_base, 1e-9)   # gen + RTT + readback cancel
+    samples_per_query = S * R
+    tpu_rate = samples_per_query * ITERS / elapsed
+    log(f"device: {tpu_rate:.3e} samples/sec "
+        f"({ITERS} queries in {elapsed:.3f}s; base {t_base:.3f}s, "
+        f"full {t_full:.3f}s)")
+    ids_np = (np.arange(S) % G).astype(np.int32)
+    ts, vals = jax.jit(gen_body)(0)
+
+    # -- numpy single-core proxy baseline on a subsample --------------------
+    sub_ts = np.asarray(jax.device_get(ts[:SUB])).astype(np.int64)
+    sub_vals = np.asarray(jax.device_get(vals[:SUB])).astype(np.float64)
+    a = time.perf_counter()
+    _numpy_rate_sum(sub_ts, sub_vals, ids_np[:SUB], steps_np.astype(np.int64))
+    np_elapsed = time.perf_counter() - a
+    np_rate = SUB * R / np_elapsed
+    log(f"numpy proxy: {np_rate:.3e} samples/sec ({SUB} series, "
+        f"{np_elapsed:.3f}s)")
+
+    print(json.dumps({
+        "metric": "PromQL samples scanned/sec (rate()+sum-by, "
+                  f"{S} series, 1h range)",
+        "value": round(tpu_rate, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(tpu_rate / np_rate, 2),
+    }))
+
+
+def _numpy_rate_sum(ts, vals, ids, steps):
+    """Per-series, per-window iterator implementation — the reference's
+    ChunkedRateFunction shape (binary search + per-window pass), single core."""
+    S_, R_ = ts.shape
+    T_ = len(steps)
+    G_ = ids.max() + 1 if len(ids) else 1
+    out = np.zeros((G_, T_))
+    cnt = np.zeros((G_, T_))
+    for s in range(S_):
+        t_row, v_row = ts[s], vals[s]
+        corr = np.concatenate([[0.0], np.cumsum(np.maximum(
+            v_row[:-1] - v_row[1:], 0.0))])
+        v_adj = v_row + corr
+        for j, st in enumerate(steps):
+            lo = np.searchsorted(t_row, st - WINDOW_MS, side="right")
+            hi = np.searchsorted(t_row, st, side="right")
+            if hi - lo < 2:
+                continue
+            t1, t2 = t_row[lo], t_row[hi - 1]
+            if t2 == t1:
+                continue
+            delta = v_adj[hi - 1] - v_adj[lo]
+            # Prometheus extrapolation
+            n = hi - lo
+            avg_dur = (t2 - t1) / (n - 1)
+            ext_start = min(st - WINDOW_MS + avg_dur / 2, float(t1)) \
+                if t1 - (st - WINDOW_MS) <= avg_dur * 1.1 else t1 - avg_dur / 2
+            ext_end = max(st - avg_dur / 2, float(t2)) \
+                if st - t2 <= avg_dur * 1.1 else t2 + avg_dur / 2
+            rate = delta * ((ext_end - ext_start) / (t2 - t1)) / (WINDOW_MS / 1000.0)
+            g = ids[s]
+            out[g, j] += rate
+            cnt[g, j] += 1
+    return np.where(cnt > 0, out, np.nan)
+
+
+if __name__ == "__main__":
+    main()

@@ -57,9 +57,19 @@ def window_bounds(ts: jnp.ndarray, steps: jnp.ndarray, window) -> tuple[jnp.ndar
     LongBinaryVector.scala:152,162).
     """
     lo = steps - window
-    # method='sort' lowers to a bitonic sort — no While loop in the HLO.
-    # The default 'scan' method emits lax.scan (a While), which the TPU
-    # executes poorly and which wedges the axon tunnel entirely.
+    R, T = ts.shape[1], steps.shape[0]
+    if R * T <= 262_144:
+        # broadcast-compare-reduce: searchsorted(side='right') == count of
+        # ts <= needle.  Pure VPU compare+reduce that XLA fuses without
+        # materializing [S,R,T] — measured 12x faster than the bitonic-sort
+        # lowering at [1M, 60] x 55 on v5e.
+        idx = jnp.int32
+        first = (ts[:, :, None] <= lo[None, None, :]).sum(axis=1, dtype=idx)
+        last = (ts[:, :, None] <= steps[None, None, :]).sum(axis=1, dtype=idx)
+        return first, last
+    # big R*T: bitonic-sort lowering — no While loop in the HLO.  (The
+    # default 'scan' method emits lax.scan, which the TPU executes poorly
+    # and which wedges the axon tunnel entirely.)
     method = "sort"
     first = jax.vmap(lambda row: jnp.searchsorted(row, lo, side="right", method=method))(ts)
     last = jax.vmap(lambda row: jnp.searchsorted(row, steps, side="right", method=method))(ts)
@@ -86,8 +96,24 @@ def _prefix(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.pad(s, ((0, 0), (1, 0)))
 
 
+def _row_select(arr: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """arr [S,R], idx [S,T] in-range -> out[s,t] = arr[s, idx[s,t]].
+
+    Formulated as a one-hot compare + masked reduce over R instead of
+    ``take_along_axis``: TPU per-element gathers measured ~1.35s per [1M,55]
+    pull vs ~90ms for the fused compare-reduce.  Falls back to gather for
+    large R*T where the broadcast would dominate.
+    """
+    R, T = arr.shape[1], idx.shape[1]
+    if R * T <= 262_144:
+        rows = jnp.arange(R, dtype=idx.dtype)
+        oh = rows[None, :, None] == idx[:, None, :]          # [S,R,T]
+        return jnp.where(oh, arr[:, :, None], 0).sum(axis=1)
+    return jnp.take_along_axis(arr, idx, axis=1)
+
+
 def _at(P: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
-    return jnp.take_along_axis(P, idx, axis=1)
+    return _row_select(P, idx)
 
 
 def _range_sum(P: jnp.ndarray, first: jnp.ndarray, last: jnp.ndarray) -> jnp.ndarray:
@@ -96,7 +122,7 @@ def _range_sum(P: jnp.ndarray, first: jnp.ndarray, last: jnp.ndarray) -> jnp.nda
 
 def _gather_rows(arr: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     """Per-series gather: arr [S,R], idx [S,T] (clipped) -> [S,T]."""
-    return jnp.take_along_axis(arr, jnp.clip(idx, 0, arr.shape[1] - 1), axis=1)
+    return _row_select(arr, jnp.clip(idx, 0, arr.shape[1] - 1))
 
 
 # --------------------------------------------------------------------------
