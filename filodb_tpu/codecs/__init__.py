@@ -8,5 +8,15 @@ ready to be stacked into device tensors.  Hot codecs have a C++ fast path
 (filodb_tpu/native) with these numpy implementations as the reference/fallback.
 """
 
+import os
+
 from filodb_tpu.codecs.wire import WireType  # noqa: F401
 from filodb_tpu.codecs import nibblepack, deltadelta, doublecodec  # noqa: F401
+
+if os.environ.get("FILODB_TPU_NATIVE", "1") != "0":
+    try:
+        from filodb_tpu import native as _native_mod
+
+        _native_mod.enable()
+    except Exception:  # no compiler / load failure: numpy paths keep working
+        pass

@@ -26,6 +26,8 @@ from filodb_tpu.codecs.wire import WireType
 
 _HDR = struct.Struct("<Iqq")
 
+_native = None  # set by filodb_tpu.native when the shared lib is importable
+
 
 def encode(values: np.ndarray) -> bytes:
     v = np.ascontiguousarray(values, dtype=np.int64)
@@ -51,6 +53,8 @@ def decode(buf: bytes) -> np.ndarray:
     wire = buf[0]
     if wire not in (WireType.CONST_LONG, WireType.DELTA2):
         raise ValueError(f"not a DELTA2 vector: wire type {wire}")
+    if _native is not None:
+        return _native.dd_decode(buf)
     n, base, slope = _HDR.unpack_from(buf, 1)
     with np.errstate(over="ignore"):
         line = np.int64(base) + np.int64(slope) * np.arange(n, dtype=np.int64)

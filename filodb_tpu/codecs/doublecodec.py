@@ -26,6 +26,8 @@ from filodb_tpu.codecs.wire import WireType
 
 _N = struct.Struct("<I")
 
+_native = None  # set by filodb_tpu.native when the shared lib is importable
+
 
 def encode(values: np.ndarray) -> bytes:
     v = np.ascontiguousarray(values, dtype=np.float64)
@@ -55,6 +57,8 @@ def decode(buf: bytes) -> np.ndarray:
     if wire != WireType.XOR_DOUBLE:
         raise ValueError(f"not a double vector: wire type {wire}")
     (n,) = _N.unpack_from(buf, 1)
+    if _native is not None:
+        return _native.xor_unpack(buf, n, 1 + _N.size)
     residuals, _ = nibblepack.unpack(buf, n, 1 + _N.size)
     # invert the XOR-with-previous chain via cumulative xor
     bits = np.bitwise_xor.accumulate(residuals)

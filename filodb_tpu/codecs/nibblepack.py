@@ -130,6 +130,8 @@ def unpack(buf: bytes, count: int, offset: int = 0) -> tuple[np.ndarray, int]:
 
 def packed_end(buf: bytes, count: int, offset: int = 0) -> int:
     """Return the end offset of a packed run without materializing values."""
+    if _native is not None:
+        return _native.nibble_packed_end(buf, count, offset)
     pos = offset
     mv = memoryview(buf)
     for _ in range((count + 7) // 8):

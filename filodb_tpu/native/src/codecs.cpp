@@ -1,0 +1,265 @@
+// Native codec fast paths for filodb_tpu.
+//
+// Implements the same storage formats as the Python codecs in
+// filodb_tpu/codecs/ (NibblePack groups, DELTA2 sloped-line residuals,
+// XOR-double residual chains) — the TPU-native equivalent of the
+// reference's Unsafe-level hot codecs (reference:
+// memory/src/main/scala/filodb.memory/format/NibblePack.scala:12,
+// format/vectors/DeltaDeltaVector.scala:28, DoubleVector.scala:14).
+// Bound from Python via ctypes (filodb_tpu/native/__init__.py); every
+// function is extern "C" and operates on caller-owned buffers.
+//
+// All decode paths are bounds-checked against buflen and return -1 on
+// overrun so a corrupt chunk can never read out of bounds.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+#if defined(_MSC_VER)
+#include <intrin.h>
+#endif
+
+namespace {
+
+inline int ctz64(uint64_t x) {
+#if defined(_MSC_VER)
+  unsigned long idx;
+  _BitScanForward64(&idx, x);
+  return static_cast<int>(idx);
+#else
+  return __builtin_ctzll(x);
+#endif
+}
+
+inline int clz64(uint64_t x) {
+#if defined(_MSC_VER)
+  unsigned long idx;
+  _BitScanReverse64(&idx, x);
+  return 63 - static_cast<int>(idx);
+#else
+  return __builtin_clzll(x);
+#endif
+}
+
+inline int popcount8(uint8_t x) {
+#if defined(_MSC_VER)
+  return static_cast<int>(__popcnt16(x));
+#else
+  return __builtin_popcount(x);
+#endif
+}
+
+inline uint64_t zigzag_enc(int64_t v) {
+  return (static_cast<uint64_t>(v) << 1) ^ static_cast<uint64_t>(v >> 63);
+}
+
+inline int64_t zigzag_dec(uint64_t u) {
+  return static_cast<int64_t>(u >> 1) ^ -static_cast<int64_t>(u & 1);
+}
+
+// Nibble-stream writer: accumulates nibbles into bytes, low nibble first.
+struct NibbleWriter {
+  uint8_t* out;
+  size_t pos;
+  bool half;     // true => low nibble of out[pos] already written
+  void put(uint8_t nib) {
+    if (!half) {
+      out[pos] = nib;
+      half = true;
+    } else {
+      out[pos] |= static_cast<uint8_t>(nib << 4);
+      ++pos;
+      half = false;
+    }
+  }
+  void flush() {
+    if (half) {
+      ++pos;
+      half = false;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Upper bound on packed size for n values (2 header bytes + 16 nibbles
+// per value, per group of 8).
+size_t np_max_packed(size_t n) {
+  size_t ngroups = (n + 7) / 8;
+  return ngroups * (2 + 8 * 8);
+}
+
+// NibblePack n u64 values into out (which must hold np_max_packed(n)).
+// Returns bytes written.
+long long np_pack(const uint64_t* v, size_t n, uint8_t* out) {
+  size_t ngroups = (n + 7) / 8;
+  size_t opos = 0;
+  for (size_t g = 0; g < ngroups; ++g) {
+    uint64_t group[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+    size_t base = g * 8;
+    size_t lim = (base + 8 <= n) ? 8 : n - base;
+    for (size_t i = 0; i < lim; ++i) group[i] = v[base + i];
+
+    uint8_t bitmask = 0;
+    int tz = 64, lz = 64;
+    for (int i = 0; i < 8; ++i) {
+      if (group[i] != 0) {
+        bitmask |= static_cast<uint8_t>(1u << i);
+        int t = ctz64(group[i]);
+        int l = clz64(group[i]);
+        if (t < tz) tz = t;
+        if (l < lz) lz = l;
+      }
+    }
+    out[opos++] = bitmask;
+    if (bitmask == 0) continue;
+
+    int trailing = tz / 4;
+    int leading = lz / 4;
+    int num_nibbles = 16 - leading - trailing;
+    if (num_nibbles < 1) num_nibbles = 1;
+    out[opos++] = static_cast<uint8_t>((trailing & 0xF) |
+                                       ((num_nibbles - 1) << 4));
+    NibbleWriter w{out, opos, false};
+    for (int i = 0; i < 8; ++i) {
+      if (group[i] == 0) continue;
+      uint64_t shifted = group[i] >> (trailing * 4);
+      for (int k = 0; k < num_nibbles; ++k) {
+        w.put(static_cast<uint8_t>((shifted >> (4 * k)) & 0xF));
+      }
+    }
+    w.flush();
+    opos = w.pos;
+  }
+  return static_cast<long long>(opos);
+}
+
+// Decode count u64 values from buf starting at offset into out.
+// Returns the next offset, or -1 on buffer overrun.
+long long np_unpack(const uint8_t* buf, size_t buflen, size_t offset,
+                    size_t count, uint64_t* out) {
+  size_t pos = offset;
+  size_t ngroups = (count + 7) / 8;
+  size_t emitted = 0;
+  for (size_t g = 0; g < ngroups; ++g) {
+    if (pos >= buflen) return -1;
+    uint8_t bitmask = buf[pos++];
+    if (bitmask == 0) {
+      for (int i = 0; i < 8 && emitted < count; ++i) out[emitted++] = 0;
+      continue;
+    }
+    if (pos >= buflen) return -1;
+    uint8_t hdr = buf[pos++];
+    int trailing = hdr & 0xF;
+    int num_nibbles = (hdr >> 4) + 1;
+    int nnz = popcount8(bitmask);
+    size_t total_nibbles = static_cast<size_t>(num_nibbles) * nnz;
+    size_t nbytes = (total_nibbles + 1) / 2;
+    if (pos + nbytes > buflen) return -1;
+
+    size_t nib_idx = 0;  // index into the nibble stream for this group
+    for (int i = 0; i < 8; ++i) {
+      uint64_t val = 0;
+      if (bitmask & (1u << i)) {
+        for (int k = 0; k < num_nibbles; ++k, ++nib_idx) {
+          uint8_t byte = buf[pos + nib_idx / 2];
+          uint8_t nib = (nib_idx & 1) ? (byte >> 4) : (byte & 0xF);
+          val |= static_cast<uint64_t>(nib) << (4 * k);
+        }
+        val <<= (trailing * 4);
+      }
+      if (emitted < count) out[emitted++] = val;
+    }
+    pos += nbytes;
+  }
+  return static_cast<long long>(pos);
+}
+
+// Walk a packed run without materializing values; returns end offset or -1.
+long long np_packed_end(const uint8_t* buf, size_t buflen, size_t offset,
+                        size_t count) {
+  size_t pos = offset;
+  size_t ngroups = (count + 7) / 8;
+  for (size_t g = 0; g < ngroups; ++g) {
+    if (pos >= buflen) return -1;
+    uint8_t bitmask = buf[pos++];
+    if (bitmask == 0) continue;
+    if (pos >= buflen) return -1;
+    uint8_t hdr = buf[pos++];
+    int num_nibbles = (hdr >> 4) + 1;
+    int nnz = popcount8(bitmask);
+    pos += (static_cast<size_t>(num_nibbles) * nnz + 1) / 2;
+    if (pos > buflen) return -1;
+  }
+  return static_cast<long long>(pos);
+}
+
+// Fused DELTA2 decode.  buf points at the wire-type byte of a
+// CONST_LONG/DELTA2 vector: u8 wire, u32 n, i64 base, i64 slope,
+// [nibble-packed zigzag residuals].  Writes n int64s; returns n or -1.
+// wire_const / wire_delta2 are passed in so the wire-code registry stays
+// single-sourced in Python (filodb_tpu/codecs/wire.py).
+long long dd_decode(const uint8_t* buf, size_t buflen, int wire_const,
+                    int wire_delta2, int64_t* out, size_t out_cap) {
+  if (buflen < 21) return -1;
+  int wire = buf[0];
+  if (wire != wire_const && wire != wire_delta2) return -1;
+  uint32_t n;
+  uint64_t base, slope;
+  std::memcpy(&n, buf + 1, 4);
+  std::memcpy(&base, buf + 5, 8);
+  std::memcpy(&slope, buf + 13, 8);
+  if (n > out_cap) return -1;
+
+  uint64_t pred = base;
+  if (wire == wire_const) {
+    for (uint32_t i = 0; i < n; ++i) {
+      out[i] = static_cast<int64_t>(pred);
+      pred += slope;
+    }
+    return n;
+  }
+  // DELTA2: stream groups of 8 residuals and fuse line + zigzag add.
+  size_t pos = 21;
+  uint32_t emitted = 0;
+  uint64_t resid[8];
+  size_t ngroups = (static_cast<size_t>(n) + 7) / 8;
+  for (size_t g = 0; g < ngroups; ++g) {
+    long long next = np_unpack(buf, buflen, pos, 8, resid);
+    if (next < 0) return -1;
+    pos = static_cast<size_t>(next);
+    for (int i = 0; i < 8 && emitted < n; ++i, ++emitted) {
+      out[emitted] = static_cast<int64_t>(
+          pred + static_cast<uint64_t>(zigzag_dec(resid[i])));
+      pred += slope;
+    }
+  }
+  return n;
+}
+
+// Fused XOR-double decode: nibble-unpack count u64 residuals starting at
+// offset and invert the XOR-with-previous chain in one pass.
+// Returns next offset or -1.
+long long xor_unpack(const uint8_t* buf, size_t buflen, size_t offset,
+                     size_t count, double* out) {
+  size_t pos = offset;
+  size_t ngroups = (count + 7) / 8;
+  size_t emitted = 0;
+  uint64_t acc = 0;
+  uint64_t resid[8];
+  for (size_t g = 0; g < ngroups; ++g) {
+    long long next = np_unpack(buf, buflen, pos, 8, resid);
+    if (next < 0) return -1;
+    pos = static_cast<size_t>(next);
+    for (int i = 0; i < 8 && emitted < count; ++i, ++emitted) {
+      acc ^= resid[i];
+      std::memcpy(&out[emitted], &acc, 8);
+    }
+  }
+  return static_cast<long long>(pos);
+}
+
+}  // extern "C"
