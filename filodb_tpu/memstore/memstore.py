@@ -40,8 +40,15 @@ class TimeSeriesMemStore:
         shards = self._datasets.setdefault(dataset, {})
         if shard_num in shards:
             raise ValueError(f"shard {shard_num} already set up for {dataset}")
-        shard = TimeSeriesShard(dataset, schemas, shard_num, config,
-                                self.store, self.meta)
+        cfg = config or StoreConfig()
+        if cfg.demand_paging_enabled and not isinstance(self.store,
+                                                       NullColumnStore):
+            from filodb_tpu.memstore.odp import OnDemandPagingShard
+            shard = OnDemandPagingShard(dataset, schemas, shard_num, cfg,
+                                        self.store, self.meta)
+        else:
+            shard = TimeSeriesShard(dataset, schemas, shard_num, cfg,
+                                    self.store, self.meta)
         shards[shard_num] = shard
         self._schemas[dataset] = schemas
         return shard

@@ -1,0 +1,275 @@
+"""On-demand paging: serve queries for series whose chunks live only on disk.
+
+Capability match for the reference's OnDemandPagingShard +
+DemandPagedChunkStore (reference: core/src/main/scala/filodb.core/
+memstore/OnDemandPagingShard.scala, DemandPagedChunkStore.scala:34): on
+query, partitions found in the tag index but absent from memory (evicted,
+or index-bootstrapped after restart) have their raw chunks read back from
+the ColumnStore and re-materialized.  Paged partitions are read-only and
+live in a bytes-bounded LRU cache — the stand-in for time-bucketed block
+memory with reclaim-on-demand.  A paged partition always holds its FULL
+persisted history (cache granularity is the partition), so repeated
+queries at different ranges see consistent data.
+
+Also enforces the per-query scanned-data cap over chunks overlapping the
+query range (``StoreConfig.max_data_per_shard_query``; reference
+capDataScannedPerShardCheck).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional, Sequence
+
+import numpy as np
+
+from filodb_tpu.core.filters import ColumnFilter
+from filodb_tpu.core.record import parse_partkey
+from filodb_tpu.memstore.partition import TimeSeriesPartition
+from filodb_tpu.memstore.shard import PartLookupResult, TimeSeriesShard
+from filodb_tpu.store.columnstore import PartKeyRecord
+
+_MAX_TIME = 2**62
+
+
+class QueryLimitExceeded(Exception):
+    """A query would scan more bytes than max_data_per_shard_query allows."""
+
+
+class _PagedPartitions:
+    """Bytes-bounded LRU of read-only re-materialized partitions."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._parts: OrderedDict[int, TimeSeriesPartition] = OrderedDict()
+        self._bytes = 0
+
+    def get(self, part_id: int) -> Optional[TimeSeriesPartition]:
+        part = self._parts.get(part_id)
+        if part is not None:
+            self._parts.move_to_end(part_id)
+        return part
+
+    def put(self, part: TimeSeriesPartition) -> None:
+        old = self._parts.pop(part.part_id, None)
+        if old is not None:
+            self._bytes -= sum(c.nbytes for c in old.chunks)
+        nbytes = sum(c.nbytes for c in part.chunks)
+        self._parts[part.part_id] = part
+        self._bytes += nbytes
+        while self._bytes > self.max_bytes and len(self._parts) > 1:
+            _, evicted = self._parts.popitem(last=False)
+            self._bytes -= sum(c.nbytes for c in evicted.chunks)
+
+    def pop(self, part_id: int) -> None:
+        old = self._parts.pop(part_id, None)
+        if old is not None:
+            self._bytes -= sum(c.nbytes for c in old.chunks)
+
+    def __len__(self) -> int:
+        return len(self._parts)
+
+
+class OnDemandPagingShard(TimeSeriesShard):
+    """TimeSeriesShard that pages missing partitions from the ColumnStore."""
+
+    def __init__(self, *args, page_cache_bytes: int = 256 * 1024 * 1024,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self.paged = _PagedPartitions(page_cache_bytes)
+        self.stats.partitions_paged = 0
+        self.stats.chunks_paged = 0
+
+    # ------------------------------------------------------------ resolution
+
+    def _partition_for_scan(self, part_id: int) -> Optional[TimeSeriesPartition]:
+        part = self.partitions.get(part_id)
+        if part is None:
+            part = self.paged.get(part_id)
+        return part
+
+    def _resolve_partitions(self, part_ids: Sequence[int]
+                            ) -> dict[int, TimeSeriesPartition]:
+        """Resolve every id, paging absent partitions (full history) and
+        backfilling older on-disk chunks of recovery-tail residents."""
+        resident: dict[int, TimeSeriesPartition] = {}
+        missing: list[int] = []
+        for pid in part_ids:
+            pid = int(pid)
+            part = self.partitions.get(pid)
+            if part is not None:
+                # live partition: may hold only its post-recovery tail
+                self._page_older_chunks(part)
+                resident[pid] = part
+                continue
+            part = self.paged.get(pid)
+            if part is None:
+                missing.append(pid)
+            else:
+                resident[pid] = part
+        if missing:
+            self._page_in(missing, resident)
+        return resident
+
+    def _page_older_chunks(self, part: TimeSeriesPartition) -> None:
+        """A live partition re-materialized during recovery holds only rows
+        replayed after the checkpoint; its older chunks stayed on disk
+        (reference: OnDemandPagingShard computes missing chunk time-ranges
+        per partition).  Newer-than-resident chunks cannot exist for a live
+        partition — it is the single writer of its own tail."""
+        earliest = part.earliest_timestamp
+        if earliest < 0:
+            earliest = _MAX_TIME
+        try:
+            idx_start = self.index.start_time(part.part_id)
+        except KeyError:
+            return
+        if idx_start >= earliest:
+            return  # nothing on disk predates memory
+        have = {c.info.chunk_id for c in part.chunks}
+        paged = 0
+        for _pk, chunksets in self.store.read_raw_partitions(
+                self.dataset, self.shard_num, [part.partkey],
+                idx_start, earliest - 1):
+            for cs in chunksets:
+                if cs.info.chunk_id not in have:
+                    part.chunks.append(cs)
+                    paged += 1
+        if paged:
+            part.chunks.sort(key=lambda c: c.info.chunk_id)
+            self.stats.chunks_paged += paged
+
+    def _page_in(self, part_ids: list[int],
+                 resident: dict[int, TimeSeriesPartition]) -> None:
+        """Materialize fully-absent partitions from disk with their whole
+        persisted history, so the cached object serves any time range."""
+        by_pk = {}
+        for pid in part_ids:
+            try:
+                by_pk[self.index.partkey(pid)] = pid
+            except KeyError:
+                continue  # purged from index since lookup: skip gracefully
+        if not by_pk:
+            return
+        for pk, chunksets in self.store.read_raw_partitions(
+                self.dataset, self.shard_num, list(by_pk), 0, _MAX_TIME):
+            pid = by_pk[pk]
+            schema = self._schema_for_chunks(chunksets)
+            part = TimeSeriesPartition(pid, schema, pk, parse_partkey(pk),
+                                       group=pid % self.num_groups)
+            part.chunks = sorted(chunksets, key=lambda c: c.info.chunk_id)
+            # paged chunks are already persisted: nothing to flush
+            part._unflushed = []
+            self.paged.put(part)
+            resident[pid] = part
+            self.stats.partitions_paged += 1
+            self.stats.chunks_paged += len(chunksets)
+
+    def _schema_for_chunks(self, chunksets):
+        """Pick the schema for a paged partition by matching the persisted
+        chunk's column count against the registry; prefer a resident
+        sibling's schema only when the counts agree (multi-schema shards
+        hold different value types side by side)."""
+        ncols = len(chunksets[0].vectors)
+        candidates = [s for s in self.schemas.all
+                      if len(s.data.columns) == ncols]
+        for part in self.partitions.values():
+            if part.schema in candidates or not candidates:
+                return part.schema
+        if candidates:
+            return candidates[0]
+        return self.schemas.all[0]
+
+    # ------------------------------------------------------------ query path
+
+    def scan_batch(self, part_ids: Sequence[int], start_time: int,
+                   end_time: int, column_id: Optional[int] = None):
+        parts = self._resolve_partitions(part_ids)
+        self._cap_data_scanned(parts.values(), start_time, end_time)
+        # base scan resolves via _partition_for_scan → resident + paged cache
+        return super().scan_batch(part_ids, start_time, end_time, column_id)
+
+    def _cap_data_scanned(self, parts, start_time: int, end_time: int) -> None:
+        """Only chunks overlapping the query range count against the cap —
+        a narrow query over a long-retention series must not be rejected
+        for history it will never decode."""
+        total = sum(c.nbytes
+                    for p in parts for c in p.chunks
+                    if c.info.end_time >= start_time
+                    and c.info.start_time <= end_time)
+        cap = self.config.max_data_per_shard_query
+        if total > cap:
+            raise QueryLimitExceeded(
+                f"query would scan {total} bytes on shard {self.shard_num}, "
+                f"cap is {cap} (max-data-per-shard-query)")
+
+    def lookup_partitions(self, filters: Sequence[ColumnFilter],
+                          start_time: int, end_time: int,
+                          limit: Optional[int] = None) -> PartLookupResult:
+        """Unlike the in-memory-only base (which reports non-resident ids as
+        ``missing_partkeys``), every indexed id is servable here — absent
+        partitions page in at scan time."""
+        ids = self.index.part_ids_from_filters(filters, start_time, end_time,
+                                               limit)
+        first_schema = None
+        out: list[int] = []
+        for i in ids:
+            pid = int(i)
+            part = self.partitions.get(pid) or self.paged.get(pid)
+            if part is not None:
+                h = part.schema.schema_hash
+                if first_schema is None:
+                    first_schema = h
+                if h != first_schema:
+                    continue
+            out.append(pid)
+        return PartLookupResult(self.shard_num,
+                                np.asarray(out, dtype=np.int32), [],
+                                first_schema)
+
+    # -------------------------------------------------------------- eviction
+
+    def evict_partitions(self, n: int) -> int:
+        """Unlike the base (in-memory-only) shard, keep index + part-set
+        entries so queries can page evicted series back from disk
+        (reference: Lucene entries survive eviction; evicted partkeys
+        tracked in a bloom filter, TimeSeriesShard.scala:1308-1401)."""
+        # stopped-longest-ago first; ghost ids (already evicted, still
+        # indexed) must not consume the quota
+        stopped = [pid for pid in
+                   self.index.part_ids_ordered_by_end_time(
+                       n + max(len(self.index_only_ids()), 0))
+                   if pid in self.partitions]
+        victims = stopped[:n]
+        if len(victims) < n:
+            # not enough stopped series: fall back to least-recently-written
+            # active partitions (they are safely pageable once flushed)
+            seen = set(victims)
+            active = sorted((p.latest_timestamp, pid)
+                            for pid, p in self.partitions.items()
+                            if pid not in seen)
+            victims += [pid for _, pid in active[:n - len(victims)]]
+        evicted = 0
+        for pid in victims:
+            part = self.partitions.get(pid)
+            if part is None:
+                continue
+            # persist anything not yet flushed — eviction must not lose data
+            pending = part.make_flush_chunks()
+            if pending:
+                self.store.write_chunks(self.dataset, self.shard_num, pending)
+                self.store.write_part_keys(
+                    self.dataset, self.shard_num,
+                    [PartKeyRecord(part.partkey, self.index.start_time(pid),
+                                   self.index.end_time(pid), self.shard_num)])
+            del self.partitions[pid]
+            self.paged.pop(pid)  # stale cached copy (if any) lacks the tail
+            self.evicted_keys.add(part.partkey)
+            self.stats.partitions_evicted += 1
+            evicted += 1
+        return evicted
+
+    def index_only_ids(self) -> list[int]:
+        """Ids present in the index but not resident in memory."""
+        return [pid for pid in self.part_set.values()
+                if pid not in self.partitions]

@@ -262,6 +262,11 @@ class TimeSeriesShard:
         return PartLookupResult(self.shard_num, np.asarray(in_mem, dtype=np.int32),
                                 missing, first_schema)
 
+    def _partition_for_scan(self, part_id: int) -> Optional[TimeSeriesPartition]:
+        """Resolve a part id for scanning.  The ODP shard overrides this to
+        consult its paged-partition cache as well."""
+        return self.partitions.get(part_id)
+
     def scan_batch(self, part_ids: Sequence[int], start_time: int, end_time: int,
                    column_id: Optional[int] = None
                    ) -> tuple[list[dict], Optional[ChunkBatch]]:
@@ -272,7 +277,7 @@ class TimeSeriesShard:
         hist = None  # locked by the first partition: one value type per batch
         bucket_tops = None
         for pid in part_ids:
-            part = self.partitions.get(int(pid))
+            part = self._partition_for_scan(int(pid))
             if part is None:
                 continue
             cid = part.schema.data.value_column_id if column_id is None else column_id

@@ -41,6 +41,8 @@ def _build() -> str | None:
                "-fno-exceptions", "-o", tmp, _SRC]
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
         if proc.returncode != 0:
+            if os.path.exists(tmp):
+                os.remove(tmp)
             return proc.stderr.strip() or "g++ failed"
         os.replace(tmp, _SO)
         return None

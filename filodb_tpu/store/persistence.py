@@ -1,0 +1,231 @@
+"""Durable local-disk persistence: sqlite-backed ColumnStore + MetaStore.
+
+Plays the role of the reference's Cassandra layer with the same table
+model (reference: cassandra/src/main/scala/filodb.cassandra/columnstore/
+TimeSeriesChunksTable.scala:22 — chunks by (partkey, chunkId),
+IngestionTimeIndexTable.scala:22 — scan-by-ingestion-time for batch jobs,
+PartitionKeysTable.scala:15 — partkeys with start/end per shard,
+metastore/CheckpointTable.scala:17 — checkpoints per (dataset, shard,
+group)).  sqlite3 is the embedded stand-in for CQL: one database file per
+store, WAL mode so concurrent readers never block the single writer —
+mirroring FiloDB's single-writer-per-shard discipline
+(SURVEY.md §2.7 item 4).
+
+Chunk vectors are stored as one blob per chunkset: u16 vector count, then
+(u32 length, bytes) per encoded vector.  The encoded vectors themselves
+are the wire-compatible codec outputs (filodb_tpu/codecs), so a chunk
+read back from disk decodes through the exact same native fast paths.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import struct
+import threading
+from typing import Iterator, Sequence
+
+from filodb_tpu.core.chunk import ChunkSet, ChunkSetInfo
+from filodb_tpu.store.columnstore import ColumnStore, PartKeyRecord
+from filodb_tpu.store.metastore import MetaStore
+
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+
+
+def pack_vectors(vectors: Sequence[bytes]) -> bytes:
+    out = bytearray(_U16.pack(len(vectors)))
+    for v in vectors:
+        out += _U32.pack(len(v))
+        out += v
+    return bytes(out)
+
+
+def unpack_vectors(blob: bytes) -> list[bytes]:
+    (n,) = _U16.unpack_from(blob, 0)
+    pos = _U16.size
+    vectors = []
+    for _ in range(n):
+        (ln,) = _U32.unpack_from(blob, pos)
+        pos += _U32.size
+        vectors.append(blob[pos:pos + ln])
+        pos += ln
+    return vectors
+
+
+class _SqliteBase:
+    """Shared connection handling: one connection per thread, WAL mode."""
+
+    def __init__(self, path: str):
+        self.path = path
+        if path != ":memory:":
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._local = threading.local()
+        self._ddl_done = False
+        self._ddl_lock = threading.Lock()
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self.path, timeout=30.0)
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA synchronous=NORMAL")
+            self._local.conn = conn
+        with self._ddl_lock:
+            if not self._ddl_done:
+                self._ddl(conn)
+                self._ddl_done = True
+        return conn
+
+    def _ddl(self, conn: sqlite3.Connection) -> None:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+
+class DiskColumnStore(_SqliteBase, ColumnStore):
+    """ColumnStore over a local sqlite database file."""
+
+    def _ddl(self, conn) -> None:
+        conn.executescript("""
+        CREATE TABLE IF NOT EXISTS chunks (
+            dataset TEXT NOT NULL, shard INTEGER NOT NULL,
+            partkey BLOB NOT NULL, chunk_id INTEGER NOT NULL,
+            num_rows INTEGER NOT NULL,
+            start_time INTEGER NOT NULL, end_time INTEGER NOT NULL,
+            ingestion_time INTEGER NOT NULL DEFAULT 0,
+            vectors BLOB NOT NULL,
+            PRIMARY KEY (dataset, shard, partkey, chunk_id)
+        ) WITHOUT ROWID;
+        CREATE INDEX IF NOT EXISTS chunks_by_itime
+            ON chunks (dataset, shard, ingestion_time);
+        CREATE TABLE IF NOT EXISTS partkeys (
+            dataset TEXT NOT NULL, shard INTEGER NOT NULL,
+            partkey BLOB NOT NULL,
+            start_time INTEGER NOT NULL, end_time INTEGER NOT NULL,
+            PRIMARY KEY (dataset, shard, partkey)
+        ) WITHOUT ROWID;
+        """)
+        conn.commit()
+
+    # ------------------------------------------------------------------ sink
+
+    def write_chunks(self, dataset, shard, chunksets, ingestion_time=0) -> int:
+        conn = self._conn()
+        conn.executemany(
+            "INSERT OR REPLACE INTO chunks VALUES (?,?,?,?,?,?,?,?,?)",
+            [(dataset, shard, cs.partkey, cs.info.chunk_id, cs.info.num_rows,
+              cs.info.start_time, cs.info.end_time, ingestion_time,
+              pack_vectors(cs.vectors)) for cs in chunksets])
+        conn.commit()
+        return len(chunksets)
+
+    def write_part_keys(self, dataset, shard, records) -> int:
+        conn = self._conn()
+        conn.executemany(
+            "INSERT OR REPLACE INTO partkeys VALUES (?,?,?,?,?)",
+            [(dataset, shard, r.partkey, r.start_time, r.end_time)
+             for r in records])
+        conn.commit()
+        return len(records)
+
+    # ---------------------------------------------------------------- source
+
+    def read_raw_partitions(self, dataset, shard, partkeys, start_time,
+                            end_time) -> Iterator[tuple[bytes, list[ChunkSet]]]:
+        conn = self._conn()
+        for pk in partkeys:
+            rows = conn.execute(
+                "SELECT chunk_id, num_rows, start_time, end_time, vectors "
+                "FROM chunks WHERE dataset=? AND shard=? AND partkey=? "
+                "AND end_time>=? AND start_time<=? ORDER BY chunk_id",
+                (dataset, shard, pk, start_time, end_time)).fetchall()
+            if rows:
+                yield pk, [ChunkSet(ChunkSetInfo(cid, nr, st, et), pk,
+                                    unpack_vectors(blob))
+                           for cid, nr, st, et, blob in rows]
+
+    def scan_part_keys(self, dataset, shard) -> Iterator[PartKeyRecord]:
+        conn = self._conn()
+        for pk, st, et in conn.execute(
+                "SELECT partkey, start_time, end_time FROM partkeys "
+                "WHERE dataset=? AND shard=?", (dataset, shard)):
+            yield PartKeyRecord(pk, st, et, shard)
+
+    def chunksets_by_ingestion_time(self, dataset, shard, start, end
+                                    ) -> Iterator[ChunkSet]:
+        conn = self._conn()
+        for pk, cid, nr, st, et, blob in conn.execute(
+                "SELECT partkey, chunk_id, num_rows, start_time, end_time, "
+                "vectors FROM chunks WHERE dataset=? AND shard=? "
+                "AND ingestion_time BETWEEN ? AND ? ORDER BY partkey, chunk_id",
+                (dataset, shard, start, end)):
+            yield ChunkSet(ChunkSetInfo(cid, nr, st, et), pk,
+                           unpack_vectors(blob))
+
+    # ----------------------------------------------------------------- admin
+
+    def num_chunks(self, dataset: str, shard: int) -> int:
+        return self._conn().execute(
+            "SELECT COUNT(*) FROM chunks WHERE dataset=? AND shard=?",
+            (dataset, shard)).fetchone()[0]
+
+    def delete_part_keys(self, dataset: str, shard: int,
+                         partkeys: Sequence[bytes]) -> int:
+        """Cardinality-buster path (reference: PerShardCardinalityBuster)."""
+        conn = self._conn()
+        cur = conn.executemany(
+            "DELETE FROM partkeys WHERE dataset=? AND shard=? AND partkey=?",
+            [(dataset, shard, pk) for pk in partkeys])
+        conn.executemany(
+            "DELETE FROM chunks WHERE dataset=? AND shard=? AND partkey=?",
+            [(dataset, shard, pk) for pk in partkeys])
+        conn.commit()
+        return cur.rowcount
+
+
+class DiskMetaStore(_SqliteBase, MetaStore):
+    """MetaStore (checkpoints + dataset metadata) over sqlite."""
+
+    def _ddl(self, conn) -> None:
+        conn.executescript("""
+        CREATE TABLE IF NOT EXISTS checkpoints (
+            dataset TEXT NOT NULL, shard INTEGER NOT NULL,
+            grp INTEGER NOT NULL, offset INTEGER NOT NULL,
+            PRIMARY KEY (dataset, shard, grp)
+        ) WITHOUT ROWID;
+        CREATE TABLE IF NOT EXISTS datasets (
+            name TEXT PRIMARY KEY, config TEXT NOT NULL
+        );
+        """)
+        conn.commit()
+
+    def write_checkpoint(self, dataset, shard, group, offset) -> None:
+        conn = self._conn()
+        conn.execute("INSERT OR REPLACE INTO checkpoints VALUES (?,?,?,?)",
+                     (dataset, shard, group, offset))
+        conn.commit()
+
+    def read_checkpoints(self, dataset, shard) -> dict[int, int]:
+        return dict(self._conn().execute(
+            "SELECT grp, offset FROM checkpoints WHERE dataset=? AND shard=?",
+            (dataset, shard)))
+
+    def write_dataset(self, name: str, config: str) -> None:
+        conn = self._conn()
+        conn.execute("INSERT OR REPLACE INTO datasets VALUES (?,?)",
+                     (name, config))
+        conn.commit()
+
+    def read_dataset(self, name: str) -> str | None:
+        row = self._conn().execute(
+            "SELECT config FROM datasets WHERE name=?", (name,)).fetchone()
+        return row[0] if row else None
+
+    def list_datasets(self) -> list[str]:
+        return [r[0] for r in self._conn().execute(
+            "SELECT name FROM datasets ORDER BY name")]
