@@ -41,6 +41,8 @@ class ChunkSet:
     info: ChunkSetInfo
     partkey: bytes
     vectors: list[bytes]  # one encoded blob per data column (col 0 = timestamps)
+    schema_hash: int = 0  # 16-bit schema id, persisted so readers (ODP,
+    #                       batch downsampler) recover the exact schema
 
     @property
     def nbytes(self) -> int:
@@ -81,7 +83,7 @@ def encode_chunkset(schema: Schema, partkey: bytes, timestamps: np.ndarray,
             raise ValueError(f"unsupported column type {col.ctype}")
     info = ChunkSetInfo(chunk_id(int(ts[0]) if n else 0, ingestion_seq), n,
                         int(ts[0]) if n else 0, int(ts[-1]) if n else 0)
-    return ChunkSet(info, partkey, vectors)
+    return ChunkSet(info, partkey, vectors, schema_hash=schema.schema_hash)
 
 
 def decode_column(blob: bytes, ctype: ColumnType):

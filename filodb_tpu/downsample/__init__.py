@@ -1,0 +1,10 @@
+"""Multi-resolution downsampling (reference: core/downsample/* and the
+spark-jobs offline downsampler; SURVEY.md §2.2, §2.6, §3.5)."""
+
+from filodb_tpu.downsample.chunkdown import (  # noqa: F401
+    ChunkDownsampler, parse_downsampler, parse_period_marker)
+from filodb_tpu.downsample.sharddown import (  # noqa: F401
+    DEFAULT_RESOLUTIONS_MS, DownsamplePublisher, MemoryDownsamplePublisher,
+    ShardDownsampler)
+from filodb_tpu.downsample.dsstore import (  # noqa: F401
+    BatchDownsampler, DownsampledTimeSeriesStore, ds_dataset_name)

@@ -1,0 +1,153 @@
+"""Downsampled dataset serving + batch downsampler job.
+
+Capability match for:
+- DownsampledTimeSeriesStore/Shard — read-only store serving downsampled
+  data per resolution, index recovered from persisted partkeys
+  (reference: core/src/main/scala/filodb.core/downsample/
+  DownsampledTimeSeriesStore.scala:21, DownsampledTimeSeriesShard.scala:40).
+- The offline Spark downsampler — batch job that reads raw chunks by
+  ingestion time, applies the schema's ChunkDownsamplers, and writes
+  downsample chunks to the downsample dataset (reference: spark-jobs/
+  .../DownsamplerMain.scala:43, BatchDownsampler.scala:36, SURVEY.md §3.5).
+  Spark's executor parallelism maps to per-(shard × time-split) work items
+  that are embarrassingly parallel on host CPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from filodb_tpu.core.schemas import Schema, Schemas
+from filodb_tpu.core.storeconfig import StoreConfig
+from filodb_tpu.downsample.sharddown import (DEFAULT_RESOLUTIONS_MS,
+                                             MemoryDownsamplePublisher,
+                                             ShardDownsampler)
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.store.columnstore import ColumnStore
+from filodb_tpu.store.metastore import MetaStore
+
+
+def ds_dataset_name(dataset: str, resolution_ms: int) -> str:
+    """Downsample dataset naming, e.g. prom_ds_60000 (reference: downsample
+    datasets <ds>_ds_<res> convention)."""
+    return f"{dataset}_ds_{resolution_ms}"
+
+
+class DownsampledTimeSeriesStore:
+    """Serves downsampled datasets, one memstore dataset per resolution.
+
+    Read path is identical to the raw store (same shard/scan surface), so
+    planners can route long-range queries here transparently (reference:
+    DownsampledTimeSeriesStore is a read-only TimeSeriesStore)."""
+
+    def __init__(self, raw_dataset: str,
+                 column_store: Optional[ColumnStore] = None,
+                 meta_store: Optional[MetaStore] = None,
+                 resolutions_ms: Sequence[int] = DEFAULT_RESOLUTIONS_MS):
+        self.raw_dataset = raw_dataset
+        self.resolutions = tuple(sorted(resolutions_ms))
+        self.memstore = TimeSeriesMemStore(column_store, meta_store)
+
+    def setup(self, schemas: Schemas, shard_num: int,
+              config: Optional[StoreConfig] = None) -> None:
+        for res in self.resolutions:
+            self.memstore.setup(ds_dataset_name(self.raw_dataset, res),
+                                schemas, shard_num, config)
+
+    def best_resolution(self, step_ms: int) -> int:
+        """Coarsest resolution that still gives >=1 sample per step."""
+        best = self.resolutions[0]
+        for res in self.resolutions:
+            if res <= step_ms:
+                best = res
+        return best
+
+    def shard(self, resolution_ms: int, shard_num: int):
+        return self.memstore.get_shard(
+            ds_dataset_name(self.raw_dataset, resolution_ms), shard_num)
+
+    def ingest_from_publisher(self, publisher: MemoryDownsamplePublisher,
+                              offset: int = 0) -> int:
+        """Drain published downsample containers into the serving store
+        (the in-process stand-in for the Kafka downsample topics)."""
+        total = 0
+        for res in self.resolutions:
+            for shard_num, container in publisher.drain(res):
+                total += self.memstore.ingest(
+                    ds_dataset_name(self.raw_dataset, res), shard_num,
+                    container, offset)
+        return total
+
+    def recover(self, shard_num: int) -> int:
+        """Index + data recovery for every resolution dataset."""
+        n = 0
+        for res in self.resolutions:
+            n += self.memstore.recover_index(
+                ds_dataset_name(self.raw_dataset, res), shard_num)
+        return n
+
+
+class BatchDownsampler:
+    """Offline batch job: raw chunks -> downsample datasets (reference:
+    spark-jobs BatchDownsampler.downsampleBatch)."""
+
+    def __init__(self, raw_dataset: str, schemas: Schemas,
+                 column_store: ColumnStore,
+                 resolutions_ms: Sequence[int] = DEFAULT_RESOLUTIONS_MS,
+                 config: Optional[StoreConfig] = None):
+        self.raw_dataset = raw_dataset
+        self.schemas = schemas
+        self.store = column_store
+        self.resolutions = tuple(resolutions_ms)
+        self.config = config or StoreConfig()
+
+    def run_shard(self, shard_num: int, ingestion_start: int,
+                  ingestion_end: int) -> dict[int, int]:
+        """Downsample one shard's raw chunks in [ingestion_start,
+        ingestion_end] (one Spark work item; reference:
+        Downsampler.run RDD over shard × time splits).
+
+        Returns {resolution: chunksets_written}."""
+        from filodb_tpu.core.record import parse_partkey
+
+        publisher = MemoryDownsamplePublisher()
+        samplers: dict[int, ShardDownsampler] = {}
+        by_schema: dict[int, list] = {}
+        for cs in self.store.chunksets_by_ingestion_time(
+                self.raw_dataset, shard_num, ingestion_start, ingestion_end):
+            schema = self._schema_for(cs)
+            if schema is None or schema.downsample is None:
+                continue
+            tags = parse_partkey(cs.partkey)
+            by_schema.setdefault(schema.schema_hash, []).append((tags, cs))
+            if schema.schema_hash not in samplers:
+                samplers[schema.schema_hash] = ShardDownsampler(
+                    self.raw_dataset, shard_num, schema, publisher,
+                    self.resolutions)
+        for h, pairs in by_schema.items():
+            samplers[h].downsample_chunksets(pairs)
+
+        # re-ingest published records into per-resolution shards and flush
+        # their chunks to the downsample datasets
+        written: dict[int, int] = {}
+        for res in self.resolutions:
+            ds_name = ds_dataset_name(self.raw_dataset, res)
+            mem = TimeSeriesMemStore(self.store)
+            mem.setup(ds_name, self.schemas, shard_num, self.config)
+            for sh, container in publisher.drain(res):
+                mem.ingest(ds_name, sh, container, offset=0)
+            written[res] = mem.get_shard(ds_name, shard_num).flush_all(
+                ingestion_time=ingestion_end)
+        return written
+
+    def _schema_for(self, cs) -> Optional[Schema]:
+        if cs.schema_hash:
+            try:
+                return self.schemas.by_hash(cs.schema_hash)
+            except KeyError:
+                return None
+        ncols = len(cs.vectors)
+        for s in self.schemas.all:
+            if len(s.data.columns) == ncols and s.downsample is not None:
+                return s
+        return None

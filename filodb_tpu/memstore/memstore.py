@@ -112,6 +112,7 @@ class TimeSeriesMemStore:
             shard._next_part_id += 1
             shard.index.add_partkey(pid, rec.partkey, parse_partkey(rec.partkey),
                                     rec.start_time, rec.end_time)
+            shard.part_schema_hash[pid] = rec.schema_hash
             # register in the part set so resumed ingest reuses this part id
             # instead of creating a duplicate index entry
             shard.part_set[rec.partkey] = pid

@@ -18,6 +18,8 @@ capDataScannedPerShardCheck).
 
 from __future__ import annotations
 
+import threading
+import time
 from collections import OrderedDict
 from typing import Optional, Sequence
 
@@ -77,29 +79,37 @@ class OnDemandPagingShard(TimeSeriesShard):
                  **kwargs):
         super().__init__(*args, **kwargs)
         self.paged = _PagedPartitions(page_cache_bytes)
+        # partitions pinned by an in-flight scan on THIS thread: strong
+        # references so mid-query LRU eviction cannot drop them from results
+        self._pinned = threading.local()
         self.stats.partitions_paged = 0
         self.stats.chunks_paged = 0
 
     # ------------------------------------------------------------ resolution
 
     def _partition_for_scan(self, part_id: int) -> Optional[TimeSeriesPartition]:
+        pinned = getattr(self._pinned, "parts", None)
+        if pinned is not None:
+            part = pinned.get(part_id)
+            if part is not None:
+                return part
         part = self.partitions.get(part_id)
         if part is None:
             part = self.paged.get(part_id)
         return part
 
-    def _resolve_partitions(self, part_ids: Sequence[int]
-                            ) -> dict[int, TimeSeriesPartition]:
+    def _resolve_partitions(self, part_ids: Sequence[int], start_time: int,
+                            end_time: int) -> dict[int, TimeSeriesPartition]:
         """Resolve every id, paging absent partitions (full history) and
-        backfilling older on-disk chunks of recovery-tail residents."""
+        backfilling older on-disk chunks of recovery-tail residents.  The
+        scanned-bytes cap is enforced BEFORE any vector leaves the store
+        (reference: capDataScannedPerShardCheck runs before paging)."""
         resident: dict[int, TimeSeriesPartition] = {}
         missing: list[int] = []
         for pid in part_ids:
             pid = int(pid)
             part = self.partitions.get(pid)
             if part is not None:
-                # live partition: may hold only its post-recovery tail
-                self._page_older_chunks(part)
                 resident[pid] = part
                 continue
             part = self.paged.get(pid)
@@ -107,6 +117,12 @@ class OnDemandPagingShard(TimeSeriesShard):
                 missing.append(pid)
             else:
                 resident[pid] = part
+        self._cap_data_scanned(resident.values(), missing, start_time,
+                               end_time)
+        for part in list(resident.values()):
+            if part.part_id in self.partitions:
+                # live partition: may hold only its post-recovery tail
+                self._page_older_chunks(part)
         if missing:
             self._page_in(missing, resident)
         return resident
@@ -166,10 +182,15 @@ class OnDemandPagingShard(TimeSeriesShard):
             self.stats.chunks_paged += len(chunksets)
 
     def _schema_for_chunks(self, chunksets):
-        """Pick the schema for a paged partition by matching the persisted
-        chunk's column count against the registry; prefer a resident
-        sibling's schema only when the counts agree (multi-schema shards
-        hold different value types side by side)."""
+        """The persisted schema hash identifies the exact schema; fall back
+        to column-count matching for chunks written before hashes were
+        stored."""
+        h = chunksets[0].schema_hash
+        if h:
+            try:
+                return self.schemas.by_hash(h)
+            except KeyError:
+                pass
         ncols = len(chunksets[0].vectors)
         candidates = [s for s in self.schemas.all
                       if len(s.data.columns) == ncols]
@@ -184,20 +205,37 @@ class OnDemandPagingShard(TimeSeriesShard):
 
     def scan_batch(self, part_ids: Sequence[int], start_time: int,
                    end_time: int, column_id: Optional[int] = None):
-        parts = self._resolve_partitions(part_ids)
-        self._cap_data_scanned(parts.values(), start_time, end_time)
-        # base scan resolves via _partition_for_scan → resident + paged cache
-        return super().scan_batch(part_ids, start_time, end_time, column_id)
+        parts = self._resolve_partitions(part_ids, start_time, end_time)
+        # pin resolved partitions for the duration of the scan: later
+        # page-ins must not LRU-evict earlier ones out of this query
+        self._pinned.parts = parts
+        try:
+            return super().scan_batch(part_ids, start_time, end_time,
+                                      column_id)
+        finally:
+            self._pinned.parts = None
 
-    def _cap_data_scanned(self, parts, start_time: int, end_time: int) -> None:
+    def _cap_data_scanned(self, resident_parts, missing_ids: Sequence[int],
+                          start_time: int, end_time: int) -> None:
         """Only chunks overlapping the query range count against the cap —
         a narrow query over a long-retention series must not be rejected
-        for history it will never decode."""
+        for history it will never decode.  Absent partitions are costed
+        from store metadata before their vectors are read."""
         total = sum(c.nbytes
-                    for p in parts for c in p.chunks
+                    for p in resident_parts for c in p.chunks
                     if c.info.end_time >= start_time
                     and c.info.start_time <= end_time)
         cap = self.config.max_data_per_shard_query
+        if missing_ids and total <= cap:
+            pks = []
+            for pid in missing_ids:
+                try:
+                    pks.append(self.index.partkey(pid))
+                except KeyError:
+                    continue
+            if pks:
+                total += self.store.scan_bytes(self.dataset, self.shard_num,
+                                               pks, start_time, end_time)
         if total > cap:
             raise QueryLimitExceeded(
                 f"query would scan {total} bytes on shard {self.shard_num}, "
@@ -218,10 +256,14 @@ class OnDemandPagingShard(TimeSeriesShard):
             part = self.partitions.get(pid) or self.paged.get(pid)
             if part is not None:
                 h = part.schema.schema_hash
+            else:
+                # absent id: schema hash tracked at create/recover time
+                h = self.part_schema_hash.get(pid)
+            if h is not None:
                 if first_schema is None:
                     first_schema = h
                 if h != first_schema:
-                    continue
+                    continue  # one schema per lookup, like the base class
             out.append(pid)
         return PartLookupResult(self.shard_num,
                                 np.asarray(out, dtype=np.int32), [],
@@ -250,18 +292,27 @@ class OnDemandPagingShard(TimeSeriesShard):
                             if pid not in seen)
             victims += [pid for _, pid in active[:n - len(victims)]]
         evicted = 0
+        itime = int(time.time() * 1000)
         for pid in victims:
             part = self.partitions.get(pid)
             if part is None:
                 continue
-            # persist anything not yet flushed — eviction must not lose data
+            # persist anything not yet flushed — eviction must not lose data,
+            # must stay visible to ingestion-time scans (batch downsampler),
+            # and must still feed the streaming downsampler
             pending = part.make_flush_chunks()
             if pending:
-                self.store.write_chunks(self.dataset, self.shard_num, pending)
+                self.store.write_chunks(self.dataset, self.shard_num, pending,
+                                        ingestion_time=itime)
                 self.store.write_part_keys(
                     self.dataset, self.shard_num,
                     [PartKeyRecord(part.partkey, self.index.start_time(pid),
-                                   self.index.end_time(pid), self.shard_num)])
+                                   self.index.end_time(pid), self.shard_num,
+                                   part.schema.schema_hash)])
+                if self.downsample_publisher is not None:
+                    self._downsampler_for(
+                        part.schema.schema_hash).downsample_chunksets(
+                        [(part.tags, cs) for cs in pending])
             del self.partitions[pid]
             self.paged.pop(pid)  # stale cached copy (if any) lacks the tail
             self.evicted_keys.add(part.partkey)

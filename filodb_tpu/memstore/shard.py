@@ -78,6 +78,10 @@ class TimeSeriesShard:
         self.index = PartKeyIndex()
         self.partitions: dict[int, TimeSeriesPartition] = {}
         self.part_set: dict[bytes, int] = {}
+        # part id -> 16-bit schema hash; covers index-only (evicted /
+        # recovered) entries so lookups can stay schema-consistent without
+        # materializing the partition
+        self.part_schema_hash: dict[int, int] = {}
         self._next_part_id = 0
         self.num_groups = self.config.groups_per_shard
         # per-group recovery watermarks: records at offset <= watermark were
@@ -88,6 +92,16 @@ class TimeSeriesShard:
         self.evicted_keys = BloomFilter(self.config.evicted_pk_bloom_filter_capacity)
         self.stats = ShardStats()
         self.ingest_sched_check = None  # optional thread-name assertion hook
+        # flush-time downsampling (reference: ShardDownsampler invoked from
+        # doFlushSteps :915-917); set via enable_downsampling()
+        self.downsample_publisher = None
+        self.downsample_resolutions: tuple[int, ...] = ()
+        self._downsamplers: dict[int, object] = {}
+
+    def enable_downsampling(self, publisher, resolutions_ms) -> None:
+        self.downsample_publisher = publisher
+        self.downsample_resolutions = tuple(resolutions_ms)
+        self._downsamplers = {}
 
     # ------------------------------------------------------------------ ingest
 
@@ -148,6 +162,7 @@ class TimeSeriesShard:
                                    capacity=self.config.max_chunks_size)
         self.partitions[pid] = part
         self.part_set[pk] = pid
+        self.part_schema_hash[pid] = rec.schema_hash
         self.index.add_partkey(pid, pk, rec.tags, start_time)
         self.stats.partitions_created += 1
         return part
@@ -168,16 +183,24 @@ class TimeSeriesShard:
         itime = ingestion_time if ingestion_time is not None \
             else int(time.time() * 1000)
         chunksets = []
+        ds_pairs: dict[int, list] = {}  # schema_hash -> [(tags, chunkset)]
         for part in self.partitions.values():
             if part.group == group:
-                chunksets.extend(part.make_flush_chunks())
+                fresh = part.make_flush_chunks()
+                chunksets.extend(fresh)
+                if self.downsample_publisher is not None and fresh:
+                    ds_pairs.setdefault(part.schema.schema_hash, []).extend(
+                        (part.tags, cs) for cs in fresh)
         if chunksets:
             self.store.write_chunks(self.dataset, self.shard_num, chunksets, itime)
+        for shash, pairs in ds_pairs.items():
+            self._downsampler_for(shash).downsample_chunksets(pairs)
         dirty = self._dirty_partkeys[group]
         if dirty:
             recs = [PartKeyRecord(self.index.partkey(pid),
                                   self.index.start_time(pid),
-                                  self.index.end_time(pid), self.shard_num)
+                                  self.index.end_time(pid), self.shard_num,
+                                  self.partitions[pid].schema.schema_hash)
                     for pid in dirty if pid in self.partitions]
             self.store.write_part_keys(self.dataset, self.shard_num, recs)
             self._dirty_partkeys[group] = set()
@@ -189,6 +212,17 @@ class TimeSeriesShard:
         self.stats.chunks_flushed += len(chunksets)
         self.stats.flushes_done += 1
         return len(chunksets)
+
+    def _downsampler_for(self, schema_hash: int):
+        ds = self._downsamplers.get(schema_hash)
+        if ds is None:
+            from filodb_tpu.downsample.sharddown import ShardDownsampler
+            ds = ShardDownsampler(self.dataset, self.shard_num,
+                                  self.schemas.by_hash(schema_hash),
+                                  self.downsample_publisher,
+                                  self.downsample_resolutions)
+            self._downsamplers[schema_hash] = ds
+        return ds
 
     def flush_all(self, ingestion_time: Optional[int] = None) -> int:
         return sum(self.flush_group(g, ingestion_time)

@@ -24,6 +24,7 @@ class PartKeyRecord:
     start_time: int
     end_time: int
     shard: int
+    schema_hash: int = 0  # 16-bit schema id so readers recover exact schemas
 
 
 class ColumnStore:
@@ -53,6 +54,18 @@ class ColumnStore:
 
     def scan_part_keys(self, dataset: str, shard: int) -> Iterator[PartKeyRecord]:
         raise NotImplementedError
+
+    def scan_bytes(self, dataset: str, shard: int, partkeys: Sequence[bytes],
+                   start_time: int, end_time: int) -> int:
+        """Encoded bytes of chunks overlapping [start_time, end_time] for the
+        given partkeys, WITHOUT reading the vectors — lets the ODP path
+        enforce max-data-per-shard-query before paying the page-in cost
+        (reference: capDataScannedPerShardCheck runs before paging)."""
+        total = 0
+        for _pk, chunks in self.read_raw_partitions(dataset, shard, partkeys,
+                                                    start_time, end_time):
+            total += sum(cs.nbytes for cs in chunks)
+        return total
 
     def chunksets_by_ingestion_time(self, dataset: str, shard: int,
                                     start: int, end: int) -> Iterator[ChunkSet]:

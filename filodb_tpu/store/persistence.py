@@ -71,10 +71,11 @@ class _SqliteBase:
             conn.execute("PRAGMA journal_mode=WAL")
             conn.execute("PRAGMA synchronous=NORMAL")
             self._local.conn = conn
-        with self._ddl_lock:
-            if not self._ddl_done:
-                self._ddl(conn)
-                self._ddl_done = True
+        if not self._ddl_done:  # double-checked: lock only until DDL runs
+            with self._ddl_lock:
+                if not self._ddl_done:
+                    self._ddl(conn)
+                    self._ddl_done = True
         return conn
 
     def _ddl(self, conn: sqlite3.Connection) -> None:
@@ -98,6 +99,7 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
             num_rows INTEGER NOT NULL,
             start_time INTEGER NOT NULL, end_time INTEGER NOT NULL,
             ingestion_time INTEGER NOT NULL DEFAULT 0,
+            schema_hash INTEGER NOT NULL DEFAULT 0,
             vectors BLOB NOT NULL,
             PRIMARY KEY (dataset, shard, partkey, chunk_id)
         ) WITHOUT ROWID;
@@ -107,6 +109,7 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
             dataset TEXT NOT NULL, shard INTEGER NOT NULL,
             partkey BLOB NOT NULL,
             start_time INTEGER NOT NULL, end_time INTEGER NOT NULL,
+            schema_hash INTEGER NOT NULL DEFAULT 0,
             PRIMARY KEY (dataset, shard, partkey)
         ) WITHOUT ROWID;
         """)
@@ -117,19 +120,19 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
     def write_chunks(self, dataset, shard, chunksets, ingestion_time=0) -> int:
         conn = self._conn()
         conn.executemany(
-            "INSERT OR REPLACE INTO chunks VALUES (?,?,?,?,?,?,?,?,?)",
+            "INSERT OR REPLACE INTO chunks VALUES (?,?,?,?,?,?,?,?,?,?)",
             [(dataset, shard, cs.partkey, cs.info.chunk_id, cs.info.num_rows,
               cs.info.start_time, cs.info.end_time, ingestion_time,
-              pack_vectors(cs.vectors)) for cs in chunksets])
+              cs.schema_hash, pack_vectors(cs.vectors)) for cs in chunksets])
         conn.commit()
         return len(chunksets)
 
     def write_part_keys(self, dataset, shard, records) -> int:
         conn = self._conn()
         conn.executemany(
-            "INSERT OR REPLACE INTO partkeys VALUES (?,?,?,?,?)",
-            [(dataset, shard, r.partkey, r.start_time, r.end_time)
-             for r in records])
+            "INSERT OR REPLACE INTO partkeys VALUES (?,?,?,?,?,?)",
+            [(dataset, shard, r.partkey, r.start_time, r.end_time,
+              r.schema_hash) for r in records])
         conn.commit()
         return len(records)
 
@@ -140,32 +143,47 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
         conn = self._conn()
         for pk in partkeys:
             rows = conn.execute(
-                "SELECT chunk_id, num_rows, start_time, end_time, vectors "
+                "SELECT chunk_id, num_rows, start_time, end_time, "
+                "schema_hash, vectors "
                 "FROM chunks WHERE dataset=? AND shard=? AND partkey=? "
                 "AND end_time>=? AND start_time<=? ORDER BY chunk_id",
                 (dataset, shard, pk, start_time, end_time)).fetchall()
             if rows:
                 yield pk, [ChunkSet(ChunkSetInfo(cid, nr, st, et), pk,
-                                    unpack_vectors(blob))
-                           for cid, nr, st, et, blob in rows]
+                                    unpack_vectors(blob), schema_hash=sh)
+                           for cid, nr, st, et, sh, blob in rows]
 
     def scan_part_keys(self, dataset, shard) -> Iterator[PartKeyRecord]:
         conn = self._conn()
-        for pk, st, et in conn.execute(
-                "SELECT partkey, start_time, end_time FROM partkeys "
-                "WHERE dataset=? AND shard=?", (dataset, shard)):
-            yield PartKeyRecord(pk, st, et, shard)
+        for pk, st, et, sh in conn.execute(
+                "SELECT partkey, start_time, end_time, schema_hash "
+                "FROM partkeys WHERE dataset=? AND shard=?", (dataset, shard)):
+            yield PartKeyRecord(pk, st, et, shard, schema_hash=sh)
 
     def chunksets_by_ingestion_time(self, dataset, shard, start, end
                                     ) -> Iterator[ChunkSet]:
         conn = self._conn()
-        for pk, cid, nr, st, et, blob in conn.execute(
+        for pk, cid, nr, st, et, sh, blob in conn.execute(
                 "SELECT partkey, chunk_id, num_rows, start_time, end_time, "
-                "vectors FROM chunks WHERE dataset=? AND shard=? "
+                "schema_hash, vectors FROM chunks WHERE dataset=? AND shard=? "
                 "AND ingestion_time BETWEEN ? AND ? ORDER BY partkey, chunk_id",
                 (dataset, shard, start, end)):
             yield ChunkSet(ChunkSetInfo(cid, nr, st, et), pk,
-                           unpack_vectors(blob))
+                           unpack_vectors(blob), schema_hash=sh)
+
+    def scan_bytes(self, dataset, shard, partkeys, start_time, end_time) -> int:
+        """Metadata-only byte estimate: no vector blobs leave sqlite.
+        LENGTH(vectors) is O(1) on a blob column."""
+        conn = self._conn()
+        total = 0
+        for pk in partkeys:
+            row = conn.execute(
+                "SELECT COALESCE(SUM(LENGTH(vectors)),0) FROM chunks "
+                "WHERE dataset=? AND shard=? AND partkey=? "
+                "AND end_time>=? AND start_time<=?",
+                (dataset, shard, pk, start_time, end_time)).fetchone()
+            total += row[0]
+        return total
 
     # ----------------------------------------------------------------- admin
 

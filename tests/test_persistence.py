@@ -310,3 +310,42 @@ class TestOnDemandPaging:
         assert shard.evict_partitions(2) == 2
         assert shard.evict_partitions(2) == 2
         assert shard.num_partitions == len(truth) - 4
+
+
+    def test_small_page_cache_does_not_drop_series(self, tmp_path):
+        """Regression: partitions paged during one scan must all survive it
+        even when their combined bytes exceed the page cache."""
+        disk, shard, truth = self._setup(tmp_path)
+        shard.evict_partitions(len(truth))
+        shard.paged.max_bytes = 1  # pathological: cache holds ~one partition
+        f = [ColumnFilter("__name__", Equals("heap_usage"))]
+        res = shard.lookup_partitions(f, 0, 2**62)
+        tags_list, batch = shard.scan_batch(res.part_ids, 0, 2**62)
+        assert len(tags_list) == len(truth)
+
+    def test_evict_pending_data_feeds_downsampler_and_itime(self, tmp_path):
+        """Regression: unflushed rows persisted during eviction must carry a
+        real ingestion time and flow through the streaming downsampler."""
+        from filodb_tpu.downsample import MemoryDownsamplePublisher
+        disk, shard, truth = self._setup(tmp_path)
+        pub = MemoryDownsamplePublisher()
+        shard.enable_downsampling(pub, (60_000,))
+        # add fresh unflushed rows to one series
+        schema = DEFAULT_SCHEMAS["gauge"]
+        b = RecordBuilder(schema)
+        last = int(max(ts[-1] for ts, _ in truth.values()))
+        b.add(last + 60_000, [7.0],
+              {"__name__": "heap_usage", "job": "app", "instance": "i0",
+               "_ws_": "demo", "_ns_": "ns"})
+        for c in b.containers():
+            shard.ingest_container(c, offset=99)
+        before = disk.num_chunks("prom", 0)
+        shard.evict_partitions(len(truth))
+        assert disk.num_chunks("prom", 0) > before
+        assert sum(len(v) for v in pub.published.values()) > 0
+        # the eviction-persisted chunk is visible to ingestion-time scans
+        import time as _t
+        now = int(_t.time() * 1000)
+        got = list(disk.chunksets_by_ingestion_time(
+            "prom", 0, now - 3_600_000, now + 3_600_000))
+        assert len(got) >= 1
