@@ -1,0 +1,325 @@
+"""Cluster shard management: assignment strategy, ShardManager, events.
+
+Capability match for the reference's coordination layer (reference:
+coordinator/src/main/scala/filodb.coordinator/ShardManager.scala:28 —
+add/remove nodes, SetupDataset, start/stop shard commands, reassignment
+rate limit, ShardEvent pub-sub; ShardAssignmentStrategy.scala:9,36 —
+DefaultShardAssignmentStrategy spreads shards evenly and is idempotent;
+ShardStatus.scala:54-94 lifecycle).  The reference runs this inside an
+Akka cluster-singleton actor; here it is a plain thread-safe state
+machine the server main drives — membership events arrive from the
+transport layer (HTTP control plane / process manager), and subscribers
+receive ShardEvents synchronously.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+from filodb_tpu.parallel.shardmap import ShardMapper, ShardStatus
+
+
+# ---------------------------------------------------------------------------
+# Shard events (reference: ShardEvent hierarchy in ShardStatus.scala)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardEvent:
+    dataset: str
+    shard: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardAssignmentStarted(ShardEvent):
+    node: str
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestionStarted(ShardEvent):
+    node: str
+
+
+@dataclasses.dataclass(frozen=True)
+class RecoveryInProgress(ShardEvent):
+    node: str
+    progress_pct: int
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestionStopped(ShardEvent):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestionError(ShardEvent):
+    error: str
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardDown(ShardEvent):
+    node: Optional[str]
+
+
+_EVENT_STATUS = {
+    ShardAssignmentStarted: ShardStatus.ASSIGNED,
+    IngestionStarted: ShardStatus.ACTIVE,
+    RecoveryInProgress: ShardStatus.RECOVERY,
+    IngestionStopped: ShardStatus.STOPPED,
+    IngestionError: ShardStatus.ERROR,
+    ShardDown: ShardStatus.DOWN,
+}
+
+
+# ---------------------------------------------------------------------------
+# Assignment strategy
+# ---------------------------------------------------------------------------
+
+
+class ShardAssignmentStrategy:
+    def shard_assignments(self, node: str, dataset: str, mapper: ShardMapper,
+                          min_num_nodes: int) -> list[int]:
+        raise NotImplementedError
+
+
+class DefaultShardAssignmentStrategy(ShardAssignmentStrategy):
+    """Spread shards evenly: each node gets ceil(num_shards/min_num_nodes)
+    at most, preferring unassigned shards; idempotent — a node that already
+    holds its quota gets the same recommendation back (reference:
+    DefaultShardAssignmentStrategy.scala:36)."""
+
+    def shard_assignments(self, node, dataset, mapper, min_num_nodes) -> list[int]:
+        quota = -(-mapper.num_shards // max(min_num_nodes, 1))  # ceil
+        have = mapper.shards_for_node(node)
+        if len(have) >= quota:
+            return have
+        unassigned = [s for s in range(mapper.num_shards)
+                      if mapper.coord_for_shard(s) is None]
+        return have + unassigned[:quota - len(have)]
+
+
+# ---------------------------------------------------------------------------
+# Dataset registration
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class DatasetInfo:
+    name: str
+    num_shards: int
+    min_num_nodes: int
+    mapper: ShardMapper
+
+
+# ---------------------------------------------------------------------------
+# ShardManager
+# ---------------------------------------------------------------------------
+
+
+class ShardManager:
+    """Shard assignment state machine + event hub (reference:
+    ShardManager.scala:28).  Thread-safe; all mutation under one lock."""
+
+    def __init__(self, strategy: Optional[ShardAssignmentStrategy] = None,
+                 reassignment_min_interval_ms: int = 0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.strategy = strategy or DefaultShardAssignmentStrategy()
+        self.reassignment_min_interval_ms = reassignment_min_interval_ms
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._datasets: dict[str, DatasetInfo] = {}
+        self._nodes: list[str] = []  # deterministic join order
+        self._subscribers: list[Callable[[ShardEvent], None]] = []
+        # (dataset, shard) -> monotonic ms of last reassignment, for the
+        # rate limit (reference: shard-manager.reassignment-min-interval)
+        self._last_reassign: dict[tuple[str, int], float] = {}
+
+    # ----------------------------------------------------------- membership
+
+    def add_node(self, node: str) -> dict[str, list[int]]:
+        """Member-up: assign shards for every dataset (reference:
+        addMember).  Returns dataset -> shards assigned to this node."""
+        with self._lock:
+            if node not in self._nodes:
+                self._nodes.append(node)
+            out = {}
+            for info in self._datasets.values():
+                out[info.name] = self._assign(node, info)
+            return out
+
+    def remove_node(self, node: str) -> dict[str, list[int]]:
+        """Member-down: mark its shards Down, then try to reassign them to
+        surviving nodes under the rate limit (reference: removeMember +
+        reassignment)."""
+        with self._lock:
+            if node in self._nodes:
+                self._nodes.remove(node)
+            freed: dict[str, list[int]] = {}
+            for info in self._datasets.values():
+                shards = info.mapper.shards_for_node(node)
+                for s in shards:
+                    info.mapper.unassign(s)
+                    info.mapper.update_status(s, ShardStatus.DOWN)
+                    self._publish(ShardDown(info.name, s, node))
+                freed[info.name] = shards
+            # reassign freed shards across survivors
+            for ds, shards in freed.items():
+                self._reassign(self._datasets[ds], shards)
+            return freed
+
+    @property
+    def nodes(self) -> list[str]:
+        with self._lock:
+            return list(self._nodes)
+
+    # -------------------------------------------------------------- datasets
+
+    def setup_dataset(self, name: str, num_shards: int,
+                      min_num_nodes: int) -> DatasetInfo:
+        """SetupDataset: register and assign across current nodes
+        (reference: NodeClusterActor.SetupDataset -> ShardManager)."""
+        with self._lock:
+            if name in self._datasets:
+                return self._datasets[name]
+            info = DatasetInfo(name, num_shards, min_num_nodes,
+                               ShardMapper(num_shards))
+            self._datasets[name] = info
+            for node in self._nodes:
+                self._assign(node, info)
+            return info
+
+    def mapper(self, dataset: str) -> ShardMapper:
+        return self._datasets[dataset].mapper
+
+    def datasets(self) -> list[str]:
+        with self._lock:
+            return list(self._datasets)
+
+    # ------------------------------------------------------ start/stop admin
+
+    def start_shards(self, dataset: str, shards: Sequence[int],
+                     node: str) -> list[int]:
+        """Operator StartShards command (reference: ShardManager
+        startShards)."""
+        with self._lock:
+            info = self._datasets[dataset]
+            started = []
+            for s in shards:
+                if info.mapper.coord_for_shard(s) is None:
+                    info.mapper.register_node([s], node)
+                    self._publish(ShardAssignmentStarted(dataset, s, node))
+                    started.append(s)
+            return started
+
+    def stop_shards(self, dataset: str, shards: Sequence[int]) -> list[int]:
+        with self._lock:
+            info = self._datasets[dataset]
+            stopped = []
+            for s in shards:
+                if info.mapper.coord_for_shard(s) is not None:
+                    info.mapper.update_status(s, ShardStatus.STOPPED)
+                    self._publish(IngestionStopped(dataset, s))
+                    stopped.append(s)
+            return stopped
+
+    # ------------------------------------------------------------ event hub
+
+    def subscribe(self, fn: Callable[[ShardEvent], None]) -> None:
+        with self._lock:
+            self._subscribers.append(fn)
+
+    def publish_event(self, event: ShardEvent) -> None:
+        """Ingestion coordinators report progress through here; the mapper
+        status tracks the event (reference: ShardManager.updateFromExternal
+        + StatusActor relay)."""
+        with self._lock:
+            info = self._datasets.get(event.dataset)
+            if info is not None:
+                status = _EVENT_STATUS.get(type(event))
+                if status is not None:
+                    progress = getattr(event, "progress_pct", 0)
+                    info.mapper.update_status(event.shard, status, progress)
+            self._publish(event)
+
+    def _publish(self, event: ShardEvent) -> None:
+        for fn in list(self._subscribers):
+            fn(event)
+
+    # ------------------------------------------------------------ internals
+
+    def _assign(self, node: str, info: DatasetInfo) -> list[int]:
+        shards = self.strategy.shard_assignments(node, info.name, info.mapper,
+                                                 info.min_num_nodes)
+        fresh = [s for s in shards if info.mapper.coord_for_shard(s) != node]
+        if fresh:
+            info.mapper.register_node(fresh, node)
+            for s in fresh:
+                self._publish(ShardAssignmentStarted(info.name, s, node))
+        return info.mapper.shards_for_node(node)
+
+    def _reassign(self, info: DatasetInfo, shards: Sequence[int]) -> list[int]:
+        """Move freed shards to surviving nodes, at most once per shard per
+        rate-limit interval."""
+        if not self._nodes:
+            return []
+        now_ms = self._clock() * 1000.0
+        moved = []
+        for s in shards:
+            key = (info.name, s)
+            last = self._last_reassign.get(key)
+            if last is not None and \
+                    now_ms - last < self.reassignment_min_interval_ms:
+                continue  # too soon; stays Down until next membership event
+            # least-loaded surviving node
+            node = min(self._nodes,
+                       key=lambda n: len(info.mapper.shards_for_node(n)))
+            info.mapper.register_node([s], node)
+            self._last_reassign[key] = now_ms
+            self._publish(ShardAssignmentStarted(info.name, s, node))
+            moved.append(s)
+        return moved
+
+
+# ---------------------------------------------------------------------------
+# Failure detection
+# ---------------------------------------------------------------------------
+
+
+class FailureDetector:
+    """Heartbeat-timeout failure detector driving ShardManager.remove_node
+    (reference: Akka Cluster failure detector + NodeLifecycleStrategy —
+    down nodes have their shards reassigned)."""
+
+    def __init__(self, manager: ShardManager, timeout_ms: int = 10_000,
+                 clock: Callable[[], float] = time.monotonic):
+        self.manager = manager
+        self.timeout_ms = timeout_ms
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._last_seen: dict[str, float] = {}
+
+    def heartbeat(self, node: str) -> None:
+        with self._lock:
+            first = node not in self._last_seen
+            self._last_seen[node] = self._clock()
+        if first:
+            self.manager.add_node(node)
+
+    def check(self) -> list[str]:
+        """Sweep for dead nodes; returns the nodes declared down."""
+        now = self._clock()
+        with self._lock:
+            dead = [n for n, t in self._last_seen.items()
+                    if (now - t) * 1000.0 > self.timeout_ms]
+            for n in dead:
+                del self._last_seen[n]
+        for n in dead:
+            self.manager.remove_node(n)
+        return dead
+
+    def alive(self) -> list[str]:
+        with self._lock:
+            return sorted(self._last_seen)

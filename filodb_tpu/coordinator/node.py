@@ -1,0 +1,210 @@
+"""Per-node coordination: ingestion lifecycle + dataset wiring.
+
+Capability match for the reference's per-node actors (reference:
+coordinator/src/main/scala/filodb.coordinator/NodeCoordinatorActor.scala:47
+— creates per-dataset ingestion/query handlers; IngestionActor.scala:57 —
+resync to assigned shards (:113-167), startIngestion = memStore.setup +
+recoverIndex + checkpoint read -> recovery with progress events (:293) ->
+normalIngestion (:236), stop/teardown).  Actors become plain objects +
+one ingestion thread per shard; shard events flow to the ShardManager's
+event hub instead of an Akka event stream.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Callable, Optional, Sequence
+
+from filodb_tpu.coordinator.cluster import (IngestionError, IngestionStarted,
+                                            IngestionStopped,
+                                            RecoveryInProgress, ShardEvent)
+from filodb_tpu.core.schemas import Schemas
+from filodb_tpu.core.storeconfig import StoreConfig
+from filodb_tpu.ingest.stream import IngestionStreamFactory
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+
+
+class IngestionCoordinator:
+    """Drives one dataset's shard ingestion on this node (reference:
+    IngestionActor)."""
+
+    def __init__(self, node: str, dataset: str, schemas: Schemas,
+                 memstore: TimeSeriesMemStore,
+                 stream_factory: IngestionStreamFactory,
+                 config: Optional[StoreConfig] = None,
+                 event_sink: Optional[Callable[[ShardEvent], None]] = None,
+                 recovery_report_interval: int = 10):
+        self.node = node
+        self.dataset = dataset
+        self.schemas = schemas
+        self.memstore = memstore
+        self.stream_factory = stream_factory
+        self.config = config
+        self.event_sink = event_sink or (lambda e: None)
+        self.recovery_report_interval = recovery_report_interval
+        self._threads: dict[int, threading.Thread] = {}
+        self._stops: dict[int, threading.Event] = {}
+        self._streams: dict[int, object] = {}  # live stream per shard for teardown
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def resync(self, assigned_shards: Sequence[int]) -> None:
+        """Reconcile running shards with the assignment (reference:
+        IngestionActor.resync :113-167): start missing, stop extras."""
+        with self._lock:
+            running = set(self._threads)
+        target = set(assigned_shards)
+        for s in sorted(target - running):
+            self.start_ingestion(s)
+        for s in sorted(running - target):
+            self.stop_ingestion(s)
+
+    def start_ingestion(self, shard: int, blocking: bool = False) -> None:
+        """setup -> recover index -> checkpointed recovery -> normal
+        ingestion (reference: startIngestion :170, doRecovery :293)."""
+        stop = threading.Event()
+        with self._lock:
+            if shard in self._threads:
+                return
+            self._stops[shard] = stop
+            if blocking:
+                self._threads[shard] = threading.current_thread()
+            else:
+                t = threading.Thread(target=self._run_shard,
+                                     args=(shard, stop),
+                                     name=f"ingest-{self.dataset}-{shard}",
+                                     daemon=True)
+                self._threads[shard] = t
+        if blocking:
+            self._run_shard(shard, stop)
+        else:
+            t.start()
+
+    def stop_ingestion(self, shard: int) -> None:
+        with self._lock:
+            stop = self._stops.get(shard)
+            t = self._threads.get(shard)
+            stream = self._streams.get(shard)
+        if stop is not None:
+            stop.set()
+        if stream is not None:
+            stream.teardown()  # wake a consumer blocked on an empty queue
+        if t is not None and t is not threading.current_thread() \
+                and t.is_alive():
+            t.join(timeout=5.0)
+        self._cleanup(shard)
+
+    def _cleanup(self, shard: int) -> None:
+        with self._lock:
+            self._threads.pop(shard, None)
+            self._stops.pop(shard, None)
+            self._streams.pop(shard, None)
+
+    def stop_all(self) -> None:
+        with self._lock:
+            shards = list(self._threads)
+        for s in shards:
+            self.stop_ingestion(s)
+
+    def running_shards(self) -> list[int]:
+        with self._lock:
+            return sorted(s for s, t in self._threads.items() if t.is_alive())
+
+    # ------------------------------------------------------------- internals
+
+    def _run_shard(self, shard: int, stop: threading.Event) -> None:
+        try:
+            try:
+                self.memstore.setup(self.dataset, self.schemas, shard,
+                                    self.config)
+            except ValueError:
+                pass  # already set up (restart of ingestion only)
+            self.memstore.recover_index(self.dataset, shard)
+
+            # checkpointed recovery: replay from the earliest checkpoint;
+            # per-group watermarks skip already-persisted records
+            resume_from, highest = self.memstore.prepare_recovery(
+                self.dataset, shard)
+            stream = self.stream_factory.create(self.dataset, shard,
+                                                offset=resume_from)
+            with self._lock:
+                self._streams[shard] = stream
+            sh = self.memstore.get_shard(self.dataset, shard)
+
+            recovering = resume_from is not None
+            if recovering:
+                self.event_sink(RecoveryInProgress(self.dataset, shard,
+                                                   self.node, 0))
+            else:
+                self.event_sink(IngestionStarted(self.dataset, shard,
+                                                 self.node))
+            n_since_report = 0
+            for offset, container in stream.get():
+                if stop.is_set():
+                    self.event_sink(IngestionStopped(self.dataset, shard))
+                    return
+                sh.ingest_container(container, offset)
+                if recovering:
+                    n_since_report += 1
+                    if offset >= highest:
+                        recovering = False
+                        self.event_sink(IngestionStarted(self.dataset, shard,
+                                                         self.node))
+                    elif n_since_report >= self.recovery_report_interval:
+                        n_since_report = 0
+                        lo = resume_from or 0
+                        span = max(highest - lo, 1)
+                        pct = min(int(100 * (offset - lo) / span), 99)
+                        self.event_sink(RecoveryInProgress(
+                            self.dataset, shard, self.node, pct))
+            if recovering:
+                # drained before reaching the last checkpoint (short replay)
+                self.event_sink(IngestionStarted(self.dataset, shard,
+                                                 self.node))
+            if stop.is_set():
+                # stream drained in response to a stop/teardown: the shard
+                # really is stopped.  A finite source draining on its own
+                # (CSV load) leaves the shard ACTIVE and queryable.
+                self.event_sink(IngestionStopped(self.dataset, shard))
+        except Exception as e:  # noqa: BLE001 — report, don't kill the node
+            traceback.print_exc()
+            self.event_sink(IngestionError(self.dataset, shard, str(e)))
+        finally:
+            self._cleanup(shard)
+
+    def flush_loop(self, shard: int, stop: threading.Event,
+                   interval_s: float) -> None:
+        """Optional periodic flush driver (reference: time-boundary flush
+        scheduling, TimeSeriesShard.scala:804-846)."""
+        while not stop.wait(interval_s):
+            self.memstore.flush(self.dataset, shard)
+
+
+class NodeCoordinator:
+    """Per-node entry point: one IngestionCoordinator per dataset plus the
+    query surface (reference: NodeCoordinatorActor creating
+    IngestionActor + QueryActor per dataset)."""
+
+    def __init__(self, node: str, memstore: TimeSeriesMemStore):
+        self.node = node
+        self.memstore = memstore
+        self.ingestion: dict[str, IngestionCoordinator] = {}
+        self.planners: dict[str, object] = {}
+
+    def setup_dataset(self, dataset: str, schemas: Schemas,
+                      stream_factory: IngestionStreamFactory,
+                      config: Optional[StoreConfig] = None,
+                      event_sink=None) -> IngestionCoordinator:
+        ic = IngestionCoordinator(self.node, dataset, schemas, self.memstore,
+                                  stream_factory, config, event_sink)
+        self.ingestion[dataset] = ic
+        return ic
+
+    def resync(self, dataset: str, assigned_shards: Sequence[int]) -> None:
+        self.ingestion[dataset].resync(assigned_shards)
+
+    def shutdown(self) -> None:
+        for ic in self.ingestion.values():
+            ic.stop_all()

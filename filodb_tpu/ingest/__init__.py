@@ -1,0 +1,5 @@
+"""Ingest edge: stream abstraction + sources (reference: kafka/, gateway/)."""
+
+from filodb_tpu.ingest.stream import (  # noqa: F401
+    IngestionStream, IngestionStreamFactory, ListStream, ListStreamFactory,
+    QueueStream, QueueStreamFactory, register_source_factory, source_factory)

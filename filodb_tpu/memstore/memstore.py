@@ -85,15 +85,28 @@ class TimeSeriesMemStore:
                 shard.flush_all()
         return total
 
+    def prepare_recovery(self, dataset: str, shard_num: int
+                         ) -> tuple[Optional[int], int]:
+        """Set group watermarks from persisted checkpoints and return
+        (resume_offset, highest_checkpoint); resume_offset is None when no
+        checkpoints exist (reference: IngestionActor.scala:193-217 reads
+        checkpoints, TimeSeriesMemStore.recoverStream applies them)."""
+        shard = self.get_shard(dataset, shard_num)
+        cps = self.meta.read_checkpoints(dataset, shard_num)
+        for group, offset in cps.items():
+            shard.group_watermarks[group] = max(
+                shard.group_watermarks[group], offset)
+        if not cps:
+            return None, -1
+        return min(cps.values()) + 1, max(cps.values())
+
     def recover_stream(self, dataset: str, shard_num: int,
                        stream: Iterable[tuple[int, bytes]]) -> int:
         """Replay from checkpoints: set group watermarks from the meta store,
         then ingest — below-watermark records skip (reference:
         recoverStream TimeSeriesMemStore.scala:136-173)."""
         shard = self.get_shard(dataset, shard_num)
-        cps = self.meta.read_checkpoints(dataset, shard_num)
-        for group, offset in cps.items():
-            shard.group_watermarks[group] = offset
+        self.prepare_recovery(dataset, shard_num)
         total = 0
         for offset, container in stream:
             total += shard.ingest_container(container, offset)

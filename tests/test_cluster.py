@@ -1,0 +1,297 @@
+"""Cluster coordination: assignment strategy, ShardManager state machine,
+failure detection + reassignment, ingestion coordinator lifecycle.
+
+Mirrors the reference's coordinator unit-test strategy (reference:
+coordinator/src/test/.../ShardManagerSpec.scala,
+ShardAssignmentStrategySpec, IngestionStreamSpec — single-process specs
+with deterministic sources instead of a real cluster).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.cluster import (DefaultShardAssignmentStrategy,
+                                            FailureDetector,
+                                            IngestionStarted,
+                                            RecoveryInProgress, ShardDown,
+                                            ShardManager,
+                                            ShardAssignmentStarted)
+from filodb_tpu.coordinator.node import IngestionCoordinator, NodeCoordinator
+from filodb_tpu.core.filters import ColumnFilter, Equals
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+from filodb_tpu.core.storeconfig import StoreConfig
+from filodb_tpu.ingest.stream import (ListStreamFactory, QueueStreamFactory,
+                                      source_factory)
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.parallel.shardmap import ShardStatus
+
+BASE = 1_700_000_000_000
+
+
+class TestAssignmentStrategy:
+    def test_even_spread(self):
+        mgr = ShardManager()
+        mgr.setup_dataset("ds", 8, min_num_nodes=2)
+        a = mgr.add_node("node-a")
+        b = mgr.add_node("node-b")
+        assert len(a["ds"]) == 4 and len(b["ds"]) == 4
+        assert set(a["ds"]) | set(b["ds"]) == set(range(8))
+
+    def test_idempotent(self):
+        mgr = ShardManager()
+        mgr.setup_dataset("ds", 8, min_num_nodes=2)
+        first = mgr.add_node("node-a")["ds"]
+        again = mgr.add_node("node-a")["ds"]
+        assert first == again
+
+    def test_nodes_beyond_min_take_leftovers_only(self):
+        mgr = ShardManager()
+        mgr.setup_dataset("ds", 8, min_num_nodes=2)
+        mgr.add_node("a")
+        mgr.add_node("b")
+        c = mgr.add_node("c")
+        assert c["ds"] == []  # all shards taken
+
+    def test_dataset_after_nodes(self):
+        mgr = ShardManager()
+        mgr.add_node("a")
+        mgr.add_node("b")
+        mgr.setup_dataset("ds", 4, min_num_nodes=2)
+        m = mgr.mapper("ds")
+        assert m.num_assigned == 4
+        assert len(m.shards_for_node("a")) == 2
+
+
+class TestShardManagerEvents:
+    def test_status_lifecycle(self):
+        events = []
+        mgr = ShardManager()
+        mgr.subscribe(events.append)
+        mgr.setup_dataset("ds", 4, min_num_nodes=1)
+        mgr.add_node("a")
+        m = mgr.mapper("ds")
+        assert m.status(0) == ShardStatus.ASSIGNED
+        mgr.publish_event(RecoveryInProgress("ds", 0, "a", 42))
+        assert m.status(0) == ShardStatus.RECOVERY
+        assert m._states[0].recovery_progress == 42
+        mgr.publish_event(IngestionStarted("ds", 0, "a"))
+        assert m.status(0) == ShardStatus.ACTIVE
+        assert any(isinstance(e, ShardAssignmentStarted) for e in events)
+
+    def test_remove_node_reassigns(self):
+        mgr = ShardManager()
+        mgr.setup_dataset("ds", 4, min_num_nodes=2)
+        mgr.add_node("a")
+        mgr.add_node("b")
+        freed = mgr.remove_node("a")
+        assert len(freed["ds"]) == 2
+        m = mgr.mapper("ds")
+        # survivors picked up the freed shards
+        assert len(m.shards_for_node("b")) == 4
+        assert m.num_assigned == 4
+
+    def test_reassignment_rate_limit(self):
+        clock = [0.0]
+        mgr = ShardManager(reassignment_min_interval_ms=60_000,
+                           clock=lambda: clock[0])
+        mgr.setup_dataset("ds", 2, min_num_nodes=2)
+        mgr.add_node("a")
+        mgr.add_node("b")
+        mgr.remove_node("a")          # reassigns a's shard to b (first move)
+        m = mgr.mapper("ds")
+        assert m.num_assigned == 2
+        mgr.add_node("a2")
+        # kill b immediately: its original shard moves (never moved before),
+        # but the shard already moved once stays Down under the rate limit
+        mgr.remove_node("b")
+        down = [s for s in range(2) if m.status(s) == ShardStatus.DOWN]
+        assert len(down) == 1
+        clock[0] += 120.0             # advance past the interval
+        mgr.remove_node("a2")         # membership event triggers reassign
+        # (a2's shards freed; still one node? none left -> stays down)
+        # bring a node back and confirm the rate limit has expired
+        mgr.add_node("c")
+        assert len(m.shards_for_node("c")) >= 1
+
+    def test_stop_start_shards(self):
+        mgr = ShardManager()
+        mgr.setup_dataset("ds", 4, min_num_nodes=1)
+        mgr.add_node("a")
+        assert mgr.stop_shards("ds", [1]) == [1]
+        assert mgr.mapper("ds").status(1) == ShardStatus.STOPPED
+
+
+class TestFailureDetector:
+    def test_timeout_declares_down_and_reassigns(self):
+        clock = [100.0]
+        mgr = ShardManager(clock=lambda: clock[0])
+        mgr.setup_dataset("ds", 4, min_num_nodes=2)
+        fd = FailureDetector(mgr, timeout_ms=5_000, clock=lambda: clock[0])
+        fd.heartbeat("a")
+        fd.heartbeat("b")
+        assert mgr.mapper("ds").num_assigned == 4
+        clock[0] += 3.0
+        fd.heartbeat("b")  # a goes silent
+        clock[0] += 3.0
+        dead = fd.check()
+        assert dead == ["a"]
+        assert fd.alive() == ["b"]
+        m = mgr.mapper("ds")
+        assert len(m.shards_for_node("b")) == 4  # took over a's shards
+
+
+def _containers(metric="up", n_series=3, n_rows=120, shards=(0,)):
+    """Builds per-shard container lists."""
+    rng = np.random.default_rng(0)
+    out = {s: [] for s in shards}
+    for s in shards:
+        b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], container_size=4096)
+        for i in range(n_series):
+            tags = {"__name__": metric, "instance": f"s{s}i{i}",
+                    "_ws_": "w", "_ns_": "n"}
+            ts = BASE + np.cumsum(rng.integers(5_000, 15_000, n_rows))
+            for t, v in zip(ts, rng.random(n_rows)):
+                b.add(int(t), [float(v)], tags)
+        out[s] = list(enumerate(b.containers()))
+    return out
+
+
+class TestIngestionCoordinator:
+    def test_start_ingests_finite_stream(self):
+        data = _containers(shards=(0, 1))
+        store = TimeSeriesMemStore()
+        events = []
+        ic = IngestionCoordinator("node-a", "prom", DEFAULT_SCHEMAS, store,
+                                  ListStreamFactory(data),
+                                  event_sink=events.append)
+        ic.start_ingestion(0, blocking=True)
+        ic.start_ingestion(1, blocking=True)
+        for s in (0, 1):
+            sh = store.get_shard("prom", s)
+            assert sh.stats.rows_ingested == 3 * 120
+        assert any(isinstance(e, IngestionStarted) for e in events)
+
+    def test_recovery_reports_progress_and_skips(self):
+        data = _containers(n_rows=200)
+        store = TimeSeriesMemStore()
+        # phase 1: ingest + flush + checkpoint
+        ic = IngestionCoordinator("n", "prom", DEFAULT_SCHEMAS, store,
+                                  ListStreamFactory(data))
+        ic.start_ingestion(0, blocking=True)
+        store.get_shard("prom", 0).flush_all()
+        rows_before = store.get_shard("prom", 0).stats.rows_ingested
+
+        # stagger one flush group's checkpoint to an earlier offset so the
+        # source resumes early and per-group watermarks do the fine skipping
+        # pick a group that actually holds a series (flush checkpoints
+        # every group, including empty ones)
+        g0 = next(iter(store.get_shard("prom", 0).partitions.values())).group
+        store.meta.write_checkpoint("prom", 0, g0, 0)
+
+        # phase 2: simulate restart (fresh memstore sharing meta+colstore)
+        store2 = TimeSeriesMemStore(store.store, store.meta)
+        events = []
+        ic2 = IngestionCoordinator("n", "prom", DEFAULT_SCHEMAS, store2,
+                                   ListStreamFactory(data),
+                                   event_sink=events.append,
+                                   recovery_report_interval=1)
+        ic2.start_ingestion(0, blocking=True)
+        sh = store2.get_shard("prom", 0)
+        # group g0 replays (its checkpoint was early); the other groups'
+        # records in the replayed range skip via their watermarks
+        assert sh.stats.rows_ingested > 0
+        assert sh.stats.rows_skipped > 0
+        assert any(isinstance(e, IngestionStarted) for e in events)
+
+    def test_resync_starts_and_stops(self):
+        factory = QueueStreamFactory()
+        store = TimeSeriesMemStore()
+        ic = IngestionCoordinator("n", "prom", DEFAULT_SCHEMAS, store,
+                                  factory)
+        ic.resync([0, 1])
+        time.sleep(0.05)
+        assert ic.running_shards() == [0, 1]
+        # push live data through the queue edge
+        b = RecordBuilder(DEFAULT_SCHEMAS["gauge"])
+        b.add(BASE + 1000, [1.0], {"__name__": "up", "instance": "x",
+                                   "_ws_": "w", "_ns_": "n"})
+        for c in b.containers():
+            factory.stream_for("prom", 0).push(c)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if store.get_shard("prom", 0).stats.rows_ingested == 1:
+                break
+            time.sleep(0.01)
+        assert store.get_shard("prom", 0).stats.rows_ingested == 1
+        ic.resync([1])  # shard 0 unassigned
+        assert ic.running_shards() == [1]
+        ic.stop_all()
+        assert ic.running_shards() == []
+
+    def test_node_coordinator_wiring(self):
+        data = _containers()
+        store = TimeSeriesMemStore()
+        nc = NodeCoordinator("n", store)
+        nc.setup_dataset("prom", DEFAULT_SCHEMAS, ListStreamFactory(data))
+        nc.resync("prom", [0])
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            try:
+                if store.get_shard("prom", 0).stats.rows_ingested == 3 * 120:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.01)
+        assert store.get_shard("prom", 0).stats.rows_ingested == 3 * 120
+        nc.shutdown()
+
+
+def test_source_factory_registry():
+    f = source_factory("queue")
+    assert isinstance(f, QueueStreamFactory)
+    with pytest.raises(ValueError):
+        source_factory("nope")
+
+
+def test_drained_finite_stream_stays_queryable():
+    """Regression: a CSV-style load that drains must leave the shard
+    ACTIVE (queryable), not STOPPED."""
+    mgr = ShardManager()
+    mgr.setup_dataset("prom", 1, min_num_nodes=1)
+    mgr.add_node("n")
+    data = _containers()
+    store = TimeSeriesMemStore()
+    ic = IngestionCoordinator("n", "prom", DEFAULT_SCHEMAS, store,
+                              ListStreamFactory(data),
+                              event_sink=mgr.publish_event)
+    ic.start_ingestion(0, blocking=True)
+    assert mgr.mapper("prom").status(0) == ShardStatus.ACTIVE
+    assert mgr.mapper("prom").active_shards() == [0]
+
+def test_queue_offsets_resume_above_checkpoints():
+    """Regression: after a restart the live queue's offsets must start
+    above the recovery checkpoints or watermarks drop new records."""
+    factory = QueueStreamFactory()
+    store = TimeSeriesMemStore()
+    store.setup("prom", DEFAULT_SCHEMAS, 0)
+    # simulate prior checkpoints at offset 57
+    for g in range(store.get_shard("prom", 0).num_groups):
+        store.meta.write_checkpoint("prom", 0, g, 57)
+    ic = IngestionCoordinator("n", "prom", DEFAULT_SCHEMAS, store, factory)
+    ic.resync([0])
+    time.sleep(0.05)
+    b = RecordBuilder(DEFAULT_SCHEMAS["gauge"])
+    b.add(BASE + 5, [2.0], {"__name__": "up", "instance": "z",
+                            "_ws_": "w", "_ns_": "n"})
+    off = factory.stream_for("prom", 0).push(b.containers()[0])
+    assert off >= 58  # numbering fast-forwarded past the checkpoint
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if store.get_shard("prom", 0).stats.rows_ingested == 1:
+            break
+        time.sleep(0.01)
+    assert store.get_shard("prom", 0).stats.rows_ingested == 1
+    ic.stop_all()
