@@ -83,14 +83,25 @@ class IngestionCoordinator:
             t.start()
 
     def stop_ingestion(self, shard: int) -> None:
+        import time as _time
         with self._lock:
             stop = self._stops.get(shard)
             t = self._threads.get(shard)
-            stream = self._streams.get(shard)
         if stop is not None:
             stop.set()
+        # the stream registers shortly after thread start; wait for it so
+        # teardown can wake a consumer blocked on an empty queue (otherwise
+        # a zombie consumer would keep draining the shared stream)
+        deadline = _time.monotonic() + 2.0
+        stream = None
+        while _time.monotonic() < deadline:
+            with self._lock:
+                stream = self._streams.get(shard)
+            if stream is not None or t is None or not t.is_alive():
+                break
+            _time.sleep(0.01)
         if stream is not None:
-            stream.teardown()  # wake a consumer blocked on an empty queue
+            stream.teardown()
         if t is not None and t is not threading.current_thread() \
                 and t.is_alive():
             t.join(timeout=5.0)
@@ -131,6 +142,9 @@ class IngestionCoordinator:
                                                 offset=resume_from)
             with self._lock:
                 self._streams[shard] = stream
+            if stop.is_set():  # stopped between start and stream creation
+                self.event_sink(IngestionStopped(self.dataset, shard))
+                return
             sh = self.memstore.get_shard(self.dataset, shard)
 
             recovering = resume_from is not None
@@ -142,10 +156,13 @@ class IngestionCoordinator:
                                                  self.node))
             n_since_report = 0
             for offset, container in stream.get():
+                # ingest BEFORE checking stop: a dequeued element is not
+                # redelivered by the queue edge, so discarding it on
+                # shutdown would lose the record
+                sh.ingest_container(container, offset)
                 if stop.is_set():
                     self.event_sink(IngestionStopped(self.dataset, shard))
                     return
-                sh.ingest_container(container, offset)
                 if recovering:
                     n_since_report += 1
                     if offset >= highest:

@@ -1,0 +1,4 @@
+"""HTTP API (reference: http/ module)."""
+
+from filodb_tpu.http.model import to_prom_matrix, to_prom_vector  # noqa: F401
+from filodb_tpu.http.server import DatasetBinding, FiloHttpServer  # noqa: F401

@@ -1,0 +1,126 @@
+"""Prometheus HTTP API data model: results -> Prometheus JSON.
+
+Capability match for the reference's PrometheusModel (reference:
+prometheus/src/main/scala/filodb/prometheus/query/PrometheusModel.scala:12
+— QueryResult -> matrix/vector JSON; histogram -> bucket series) and the
+PromQueryResponse shapes (query/.../PromQueryResponse.scala).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from filodb_tpu.query.model import (PeriodicBatch, QueryResult, RawBatch,
+                                    ScalarResult)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus value formatting: shortest repr, NaN as \"NaN\"."""
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def public_tags(tags: dict, metric_column: str = "_metric_") -> dict:
+    """Internal metric column -> Prometheus ``__name__`` on the way out
+    (reference: PrometheusModel metric-name conversion)."""
+    if metric_column in tags:
+        out = {k: v for k, v in tags.items() if k != metric_column}
+        out["__name__"] = tags[metric_column]
+        return out
+    return dict(tags)
+
+
+def _matrix_entry(tags: dict, ts_ms: np.ndarray, vals: np.ndarray,
+                  metric_column: str = "_metric_") -> Optional[dict]:
+    fin = ~np.isnan(vals)
+    if not fin.any():
+        return None
+    return {"metric": public_tags(tags, metric_column),
+            "values": [[ts_ms[i] / 1000.0, _fmt(float(vals[i]))]
+                       for i in np.flatnonzero(fin)]}
+
+
+def to_prom_matrix(result: QueryResult,
+                   metric_column: str = "_metric_") -> dict:
+    """Range-query response (resultType=matrix)."""
+    out = []
+    for b in result.batches:
+        if isinstance(b, PeriodicBatch):
+            for tags, ts, vals in b.to_series():
+                e = _matrix_entry(tags, ts, vals, metric_column)
+                if e is not None:
+                    out.append(e)
+        elif isinstance(b, ScalarResult):
+            ts = np.asarray(b.steps.timestamps())
+            e = _matrix_entry({}, ts, np.asarray(b.values))
+            if e is not None:
+                out.append(e)
+        elif isinstance(b, RawBatch) and b.batch is not None:
+            for i, tags in enumerate(b.keys):
+                n = int(b.batch.row_counts[i])
+                e = _matrix_entry(tags,
+                                  np.asarray(b.batch.timestamps[i][:n]),
+                                  np.asarray(b.batch.values[i][:n]))
+                if e is not None:
+                    out.append(e)
+    return {"status": "success",
+            "data": {"resultType": "matrix", "result": out}}
+
+
+def to_prom_vector(result: QueryResult, time_ms: int,
+                   metric_column: str = "_metric_") -> dict:
+    """Instant-query response (resultType=vector): last value at/before
+    the evaluation timestamp."""
+    out = []
+    for b in result.batches:
+        if isinstance(b, PeriodicBatch):
+            for tags, ts, vals in b.to_series():
+                fin = np.flatnonzero(~np.isnan(vals) & (ts <= time_ms))
+                if len(fin):
+                    i = fin[-1]
+                    out.append({"metric": public_tags(tags, metric_column),
+                                "value": [time_ms / 1000.0,
+                                          _fmt(float(vals[i]))]})
+        elif isinstance(b, ScalarResult):
+            vals = np.asarray(b.values)
+            if len(vals):
+                return {"status": "success",
+                        "data": {"resultType": "scalar",
+                                 "value": [time_ms / 1000.0,
+                                           _fmt(float(vals[-1]))]}}
+    return {"status": "success",
+            "data": {"resultType": "vector", "result": out}}
+
+
+def error_response(error_type: str, message: str) -> dict:
+    return {"status": "error", "errorType": error_type, "error": message}
+
+
+# ---------------------------------------------------------------------------
+# Parameter parsing (Prometheus API conventions)
+# ---------------------------------------------------------------------------
+
+_DUR_UNITS = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
+              "d": 86_400_000, "w": 7 * 86_400_000, "y": 365 * 86_400_000}
+
+
+def parse_time_ms(v: str) -> int:
+    """Unix seconds (possibly fractional) -> epoch millis."""
+    return int(float(v) * 1000)
+
+
+def parse_duration_ms(v: str) -> int:
+    """'15s' / '1m' / '250ms' / plain seconds -> millis."""
+    s = v.strip()
+    for unit in ("ms", "y", "w", "d", "h", "m", "s"):
+        if s.endswith(unit):
+            return int(float(s[:-len(unit)]) * _DUR_UNITS[unit])
+    return int(float(s) * 1000)

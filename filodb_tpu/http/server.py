@@ -1,0 +1,269 @@
+"""HTTP API server: Prometheus-compatible query routes + cluster admin.
+
+Capability match for the reference's HTTP layer (reference:
+http/src/main/scala/filodb/http/FiloHttpServer.scala:22 combining
+PrometheusApiRoute.scala:24-60 — /promql/<ds>/api/v1/query_range|query:
+parse -> LogicalPlan2Query ask -> Prom JSON; ClusterApiRoute.scala:14 —
+/api/v1/cluster status/startshards/stopshards; HealthRoute.scala:13 —
+__health returning shard statuses).  stdlib ThreadingHTTPServer replaces
+akka-http; the planner/memstore stand in for the coordinator ask.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from filodb_tpu.coordinator.planner import QueryPlanner
+from filodb_tpu.http.model import (error_response, parse_duration_ms,
+                                   parse_time_ms, to_prom_matrix,
+                                   to_prom_vector)
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.promql.parser import (ParseError,
+                                      query_range_to_logical_plan,
+                                      query_to_logical_plan)
+from filodb_tpu.query.exec import ExecContext
+from filodb_tpu.query.model import QueryContext, QueryError
+
+
+@dataclass
+class DatasetBinding:
+    """Everything the HTTP layer needs to serve one dataset."""
+
+    dataset: str
+    memstore: TimeSeriesMemStore
+    planner: QueryPlanner
+    metric_column: str = "_metric_"  # DatasetOptions.metric_column
+
+
+@dataclass
+class FiloHttpServer:
+    """Route table + server lifecycle (reference: FiloHttpServer.start)."""
+
+    port: int = 0  # 0 = ephemeral
+    host: str = "127.0.0.1"
+    shard_manager: Optional[object] = None  # coordinator.cluster.ShardManager
+    datasets: dict = field(default_factory=dict)
+    _httpd: Optional[ThreadingHTTPServer] = None
+    _thread: Optional[threading.Thread] = None
+
+    def bind_dataset(self, binding: DatasetBinding) -> None:
+        self.datasets[binding.dataset] = binding
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> int:
+        """Start serving; returns the bound port."""
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # silence stdlib logging
+                pass
+
+            def do_GET(self):
+                server._handle(self, "GET")
+
+            def do_POST(self):
+                server._handle(self, "POST")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="filo-http", daemon=True)
+        self._thread.start()
+        return self.port
+
+    def shutdown(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    # --------------------------------------------------------------- routing
+
+    def _handle(self, req: BaseHTTPRequestHandler, method: str) -> None:
+        try:
+            parsed = urllib.parse.urlparse(req.path)
+            multi = urllib.parse.parse_qs(parsed.query)
+            if method == "POST":
+                ln = int(req.headers.get("Content-Length") or 0)
+                if ln:
+                    body = req.rfile.read(ln).decode()
+                    ctype = req.headers.get("Content-Type", "")
+                    if "json" in ctype:
+                        for k, v in json.loads(body).items():
+                            multi.setdefault(k, []).append(v)
+                    else:
+                        for k, v in urllib.parse.parse_qs(body).items():
+                            multi.setdefault(k, []).extend(v)
+            params = {k: v[0] for k, v in multi.items()}
+            code, payload = self._route(parsed.path, params, multi)
+        except QueryError as e:
+            code, payload = 400, error_response("bad_data", str(e))
+        except (ParseError, ValueError, KeyError) as e:
+            code, payload = 400, error_response("bad_data", str(e))
+        except Exception as e:  # noqa: BLE001
+            code, payload = 500, error_response("internal", str(e))
+        data = json.dumps(payload).encode()
+        req.send_response(code)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(data)))
+        req.end_headers()
+        req.wfile.write(data)
+
+    def _route(self, path: str, params: dict,
+               multi: Optional[dict] = None) -> tuple[int, dict]:
+        multi = multi if multi is not None else {k: [v] for k, v in params.items()}
+        parts = [p for p in path.split("/") if p]
+        if path == "/__health":
+            return self._health()
+        if len(parts) >= 4 and parts[0] == "promql" and parts[2] == "api":
+            ds = parts[1]
+            binding = self.datasets.get(ds)
+            if binding is None:
+                return 404, error_response("bad_data", f"unknown dataset {ds}")
+            endpoint = parts[4] if len(parts) > 4 else ""
+            if endpoint == "query_range":
+                return self._query_range(binding, params)
+            if endpoint == "query":
+                return self._query_instant(binding, params)
+            if endpoint == "labels":
+                return self._labels(binding, params)
+            if endpoint == "label" and len(parts) >= 7 and parts[6] == "values":
+                return self._label_values(binding, parts[5], params)
+            if endpoint == "series":
+                return self._series(binding, params, multi)
+        if len(parts) >= 3 and parts[0] == "api" and parts[2] == "cluster":
+            return self._cluster(parts[3:], params)
+        return 404, error_response("bad_data", f"unknown route {path}")
+
+    # ---------------------------------------------------------- query routes
+
+    def _query_range(self, b: DatasetBinding, p: dict) -> tuple[int, dict]:
+        query = p["query"]
+        start = parse_time_ms(p["start"])
+        end = parse_time_ms(p["end"])
+        step = parse_duration_ms(p.get("step", "15s"))
+        plan = query_range_to_logical_plan(query, start, step, end)
+        result = self._exec(b, plan)
+        return 200, to_prom_matrix(result, b.metric_column)
+
+    def _query_instant(self, b: DatasetBinding, p: dict) -> tuple[int, dict]:
+        import time as _time
+        query = p["query"]
+        # Prometheus default: evaluate at current server time when omitted
+        time_ms = parse_time_ms(p["time"]) if "time" in p \
+            else int(_time.time() * 1000)
+        plan = query_to_logical_plan(query, time_ms)
+        result = self._exec(b, plan)
+        return 200, to_prom_vector(result, time_ms, b.metric_column)
+
+    def _exec(self, b: DatasetBinding, plan):
+        qctx = QueryContext()
+        ep = b.planner.materialize(plan, qctx)
+        return ep.execute(ExecContext(b.memstore, qctx))
+
+    # ------------------------------------------------------- metadata routes
+
+    def _time_range(self, p: dict) -> tuple[int, int]:
+        start = parse_time_ms(p["start"]) if "start" in p else 0
+        end = parse_time_ms(p["end"]) if "end" in p else np.iinfo(np.int64).max
+        return start, end
+
+    def _labels(self, b: DatasetBinding, p: dict) -> tuple[int, dict]:
+        start, end = self._time_range(p)
+        names: set[str] = set()
+        for sh in b.memstore.shards(b.dataset):
+            names.update(sh.label_names(start=start, end=end))
+        return 200, {"status": "success", "data": sorted(names)}
+
+    def _label_values(self, b: DatasetBinding, label: str,
+                      p: dict) -> tuple[int, dict]:
+        start, end = self._time_range(p)
+        vals = b.memstore.label_values(b.dataset, label, start=start, end=end)
+        return 200, {"status": "success", "data": vals}
+
+    def _series(self, b: DatasetBinding, p: dict,
+                multi: dict) -> tuple[int, dict]:
+        from filodb_tpu.core.record import parse_partkey
+        from filodb_tpu.http.model import public_tags
+        from filodb_tpu.promql.parser import parse_selector
+        start, end = self._time_range(p)
+        matches = multi.get("match[]") or multi.get("match") or []
+        if not matches:
+            return 400, error_response("bad_data", "match[] required")
+        seen: set[tuple] = set()
+        out = []
+        for match in matches:  # union over all selectors (Prometheus API)
+            filters = parse_selector(match)
+            for sh in b.memstore.shards(b.dataset):
+                res = sh.lookup_partitions(filters, start, end)
+                for pid in res.part_ids:
+                    part = sh._partition_for_scan(int(pid))
+                    tags = part.tags if part is not None \
+                        else parse_partkey(sh.index.partkey(int(pid)))
+                    key = tuple(sorted(tags.items()))
+                    if key not in seen:
+                        seen.add(key)
+                        out.append(public_tags(tags, b.metric_column))
+                # evicted/on-disk series surface as missing partkeys on
+                # the in-memory-only shard
+                for pk in res.missing_partkeys:
+                    tags = parse_partkey(pk)
+                    key = tuple(sorted(tags.items()))
+                    if key not in seen:
+                        seen.add(key)
+                        out.append(public_tags(tags, b.metric_column))
+        return 200, {"status": "success", "data": out}
+
+    # --------------------------------------------------------- admin routes
+
+    def _health(self) -> tuple[int, dict]:
+        """Shard statuses per dataset (reference: HealthRoute returning
+        ShardStatus list)."""
+        out = {}
+        if self.shard_manager is not None:
+            for ds in self.shard_manager.datasets():
+                m = self.shard_manager.mapper(ds)
+                out[ds] = [{"shard": s, "status": m.status(s).value,
+                            "node": m.coord_for_shard(s)}
+                           for s in range(m.num_shards)]
+        else:
+            for ds, b in self.datasets.items():
+                out[ds] = [{"shard": sh.shard_num, "status": "Active",
+                            "node": "local"}
+                           for sh in b.memstore.shards(ds)]
+        healthy = all(st["status"] in ("Active", "Recovery", "Assigned")
+                      for sts in out.values() for st in sts) if out else True
+        return (200 if healthy else 503), {"healthy": healthy, "shards": out}
+
+    def _cluster(self, parts: list[str], params: dict) -> tuple[int, dict]:
+        """/api/v1/cluster/<ds>/status|startshards|stopshards (reference:
+        ClusterApiRoute)."""
+        if self.shard_manager is None:
+            return 404, error_response("bad_data", "no cluster manager")
+        if not parts:
+            return 200, {"status": "success",
+                         "data": self.shard_manager.datasets()}
+        ds = parts[0]
+        action = parts[1] if len(parts) > 1 else "status"
+        m = self.shard_manager.mapper(ds)
+        if action == "status":
+            return 200, {"status": "success",
+                         "data": [{"shard": s, "status": m.status(s).value,
+                                   "node": m.coord_for_shard(s)}
+                                  for s in range(m.num_shards)]}
+        shards = [int(s) for s in str(params.get("shards", "")).split(",") if s]
+        if action == "startshards":
+            done = self.shard_manager.start_shards(ds, shards,
+                                                   params["node"])
+            return 200, {"status": "success", "data": done}
+        if action == "stopshards":
+            done = self.shard_manager.stop_shards(ds, shards)
+            return 200, {"status": "success", "data": done}
+        return 404, error_response("bad_data", f"unknown action {action}")

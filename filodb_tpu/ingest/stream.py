@@ -77,10 +77,12 @@ class QueueStream(IngestionStream):
         self._lock = threading.Lock()
 
     def push(self, container: bytes) -> int:
+        # assign AND enqueue under the lock: out-of-order offsets would turn
+        # into silent data loss at the checkpoint/watermark layer
         with self._lock:
             off = self._next_offset
             self._next_offset += 1
-        self._q.put((off, container))
+            self._q.put((off, container))
         return off
 
     def ensure_offset(self, offset: int) -> None:

@@ -595,3 +595,14 @@ def query_to_logical_plan(query: str, time_ms: int) -> LogicalPlan:
 def query_range_to_logical_plan(query: str, start_ms: int, step_ms: int,
                                 end_ms: int) -> LogicalPlan:
     return parse_query(query, start_ms, step_ms, end_ms)
+
+
+def parse_selector(text: str) -> tuple[ColumnFilter, ...]:
+    """A bare series selector (e.g. ``up{job="api"}``) -> column filters;
+    the /api/v1/series match[] parameter (reference:
+    Parser.metadataQueryToLogicalPlan)."""
+    p = Parser(tokenize(text), 0, 1000, 0)
+    sel = p.selector()
+    if p.peek() is not None:
+        raise ParseError(f"unexpected trailing tokens in selector {text!r}")
+    return sel.filters()

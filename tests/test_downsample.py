@@ -169,7 +169,7 @@ class TestFlushTimeDownsampling:
 
         ds_shard = ds.shard(RES, 0)
         res = ds_shard.lookup_partitions(
-            [ColumnFilter("__name__", Equals("disk_io"))], 0, 2**62)
+            [ColumnFilter("_metric_", Equals("disk_io"))], 0, 2**62)
         tags_list, batch = ds_shard.scan_batch(res.part_ids, 0, 2**62)
         assert len(tags_list) == len(truth)
         # ds-gauge value column is avg (value-column of ds-gauge);
@@ -212,7 +212,7 @@ class TestFlushTimeDownsampling:
         ds.ingest_from_publisher(pub)
         ds_shard = ds.shard(RES, 0)
         res = ds_shard.lookup_partitions(
-            [ColumnFilter("__name__", Equals("reqs_total"))], 0, 2**62)
+            [ColumnFilter("_metric_", Equals("reqs_total"))], 0, 2**62)
         _, batch = ds_shard.scan_batch(res.part_ids, 0, 2**62)
         n_rows = int(np.asarray(batch.row_counts)[0])
         lasts = np.asarray(batch.values)[0][:n_rows]
@@ -244,7 +244,7 @@ class TestBatchDownsampler:
         ds_shard = ds_mem.setup(name, schemas, 0)
         assert ds_mem.recover_index(name, 0) == len(truth)
         res = ds_shard.lookup_partitions(
-            [ColumnFilter("__name__", Equals("disk_io"))], 0, 2**62)
+            [ColumnFilter("_metric_", Equals("disk_io"))], 0, 2**62)
         tags_list, batch = ds_shard.scan_batch(res.part_ids, 0, 2**62)
         assert len(tags_list) == len(truth)
         by_inst = {t["instance"]: i for i, t in enumerate(tags_list)}

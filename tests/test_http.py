@@ -1,0 +1,209 @@
+"""HTTP API: Prometheus-compatible routes against a live threaded server.
+
+Mirrors the reference's HTTP route specs (reference:
+http/src/test/.../PrometheusApiRouteSpec.scala — parse -> plan -> execute
+-> Prometheus JSON; HealthRoute / ClusterApiRoute specs).
+"""
+
+import json
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.cluster import ShardManager
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.core.record import RecordBuilder, decode_container
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+from filodb_tpu.http.model import parse_duration_ms, parse_time_ms
+from filodb_tpu.http.server import DatasetBinding, FiloHttpServer
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.parallel.shardmap import ShardMapper, ShardStatus
+
+BASE = 1_700_000_000_000
+STEP = 10_000
+
+
+def _get(port, path, **params):
+    qs = urllib.parse.urlencode(params)
+    url = f"http://127.0.0.1:{port}{path}" + (f"?{qs}" if qs else "")
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _post(port, path, **params):
+    data = urllib.parse.urlencode(params).encode()
+    url = f"http://127.0.0.1:{port}{path}"
+    req = urllib.request.Request(url, data=data, method="POST")
+    req.add_header("Content-Type", "application/x-www-form-urlencoded")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture(scope="module")
+def server():
+    num_shards = 4
+    mapper = ShardMapper(num_shards)
+    mapper.register_node(range(num_shards), "local")
+    ms = TimeSeriesMemStore()
+    for s in range(num_shards):
+        mapper.update_status(s, ShardStatus.ACTIVE)
+        ms.setup("prom", DEFAULT_SCHEMAS, s)
+    rng = np.random.default_rng(0)
+    builder = RecordBuilder(DEFAULT_SCHEMAS["prom-counter"])
+    for i in range(6):
+        tags = {"__name__": "http_requests_total", "job": "api",
+                "instance": f"i{i}", "_ws_": "demo", "_ns_": "App-0"}
+        ts = BASE + np.arange(200) * STEP
+        vals = np.cumsum(rng.random(200) * 5)
+        for t, v in zip(ts, vals):
+            builder.add(int(t), [float(v)], tags)
+    spread = 1
+    for off, c in enumerate(builder.containers()):
+        per_shard = {}
+        for rec in decode_container(c, DEFAULT_SCHEMAS):
+            shard = mapper.ingestion_shard(rec.shard_hash, rec.part_hash,
+                                           spread) % num_shards
+            per_shard.setdefault(shard, []).append(rec)
+        for shard, recs in per_shard.items():
+            ms.get_shard("prom", shard).ingest(recs, off)
+
+    mgr = ShardManager()
+    mgr.setup_dataset("prom", num_shards, min_num_nodes=1)
+    mgr.add_node("local")
+
+    planner = SingleClusterPlanner("prom", mapper, DatasetOptions(),
+                                   spread_default=spread)
+    srv = FiloHttpServer(shard_manager=mgr)
+    srv.bind_dataset(DatasetBinding("prom", ms, planner))
+    port = srv.start()
+    yield port
+    srv.shutdown()
+
+
+class TestQueryRange:
+    def test_matrix_result(self, server):
+        code, body = _get(server, "/promql/prom/api/v1/query_range",
+                          query='sum(rate(http_requests_total{_ws_="demo",_ns_="App-0"}[2m]))',
+                          start=(BASE + 600_000) / 1000,
+                          end=(BASE + 1_200_000) / 1000, step="30s")
+        assert code == 200
+        assert body["status"] == "success"
+        assert body["data"]["resultType"] == "matrix"
+        result = body["data"]["result"]
+        assert len(result) == 1  # sum() -> one series
+        values = result[0]["values"]
+        assert len(values) > 10
+        ts0, v0 = values[0]
+        assert float(v0) > 0  # positive rate of a counter
+        # timestamps are unix seconds on the step grid
+        assert abs(ts0 * 1000 - round(ts0 * 1000)) < 1e-6
+
+    def test_raw_selector(self, server):
+        code, body = _get(server, "/promql/prom/api/v1/query_range",
+                          query='http_requests_total{job="api"}',
+                          start=(BASE + 300_000) / 1000,
+                          end=(BASE + 900_000) / 1000, step="10s")
+        assert code == 200
+        assert len(body["data"]["result"]) == 6
+        metrics = {r["metric"]["instance"] for r in body["data"]["result"]}
+        assert metrics == {f"i{i}" for i in range(6)}
+
+    def test_post_form(self, server):
+        code, body = _post(server, "/promql/prom/api/v1/query_range",
+                           query='count(http_requests_total)',
+                           start=(BASE + 600_000) / 1000,
+                           end=(BASE + 700_000) / 1000, step="30s")
+        assert code == 200
+        vals = body["data"]["result"][0]["values"]
+        assert all(v == "6" for _, v in vals)
+
+    def test_parse_error_is_400(self, server):
+        code, body = _get(server, "/promql/prom/api/v1/query_range",
+                          query='sum(rate(', start="1", end="2", step="15s")
+        assert code == 400
+        assert body["status"] == "error"
+
+    def test_unknown_dataset_404(self, server):
+        code, body = _get(server, "/promql/nope/api/v1/query_range",
+                          query="up", start="1", end="2")
+        assert code == 404
+
+
+class TestInstantQuery:
+    def test_vector_result(self, server):
+        code, body = _get(server, "/promql/prom/api/v1/query",
+                          query='http_requests_total{instance="i0"}',
+                          time=(BASE + 900_000) / 1000)
+        assert code == 200
+        assert body["data"]["resultType"] == "vector"
+        assert len(body["data"]["result"]) == 1
+        t, v = body["data"]["result"][0]["value"]
+        assert t == (BASE + 900_000) / 1000
+        assert float(v) > 0
+
+    def test_scalar(self, server):
+        code, body = _get(server, "/promql/prom/api/v1/query",
+                          query="scalar(count(http_requests_total))",
+                          time=(BASE + 900_000) / 1000)
+        assert code == 200
+        assert body["data"]["resultType"] == "scalar"
+        assert body["data"]["value"][1] == "6"
+
+
+class TestMetadata:
+    def test_labels(self, server):
+        code, body = _get(server, "/promql/prom/api/v1/labels")
+        assert code == 200
+        assert "job" in body["data"] and "instance" in body["data"]
+
+    def test_label_values(self, server):
+        code, body = _get(server, "/promql/prom/api/v1/label/instance/values")
+        assert code == 200
+        assert body["data"] == [f"i{i}" for i in range(6)]
+
+    def test_series(self, server):
+        code, body = _get(server, "/promql/prom/api/v1/series",
+                          **{"match[]": 'http_requests_total{instance=~"i[01]"}'})
+        assert code == 200
+        insts = sorted(s["instance"] for s in body["data"])
+        assert insts == ["i0", "i1"]
+
+
+class TestAdmin:
+    def test_health(self, server):
+        code, body = _get(server, "/__health")
+        assert code == 200
+        assert body["healthy"] is True
+        statuses = {s["status"] for s in body["shards"]["prom"]}
+        assert statuses <= {"Active", "Assigned", "Recovery"}
+
+    def test_cluster_status(self, server):
+        code, body = _get(server, "/api/v1/cluster/prom/status")
+        assert code == 200
+        assert len(body["data"]) == 4
+        assert all(s["node"] == "local" for s in body["data"])
+
+    def test_stop_start_shards(self, server):
+        code, body = _post(server, "/api/v1/cluster/prom/stopshards",
+                           shards="3")
+        assert code == 200 and body["data"] == [3]
+        code, body = _get(server, "/api/v1/cluster/prom/status")
+        assert body["data"][3]["status"] == "Stopped"
+
+
+def test_param_parsing():
+    assert parse_time_ms("1700000000") == 1_700_000_000_000
+    assert parse_time_ms("1700000000.5") == 1_700_000_000_500
+    assert parse_duration_ms("15s") == 15_000
+    assert parse_duration_ms("1m") == 60_000
+    assert parse_duration_ms("250ms") == 250
+    assert parse_duration_ms("2h") == 7_200_000
+    assert parse_duration_ms("30") == 30_000

@@ -174,7 +174,7 @@ class TestRecovery:
 
         # queries work via ODP paging of the persisted chunks
         res = shard2.lookup_partitions(
-            [ColumnFilter("__name__", Equals("heap_usage"))], 0, 2**62)
+            [ColumnFilter("_metric_", Equals("heap_usage"))], 0, 2**62)
         assert len(res.part_ids) == len(truth)
         tags_list, batch = shard2.scan_batch(res.part_ids, 0, 2**62)
         assert len(tags_list) == len(truth)
@@ -211,7 +211,7 @@ class TestRecovery:
         assert replayed > 0  # the unflushed tail was re-ingested
         # no duplicates: per-series row count equals the source
         res = shard2.lookup_partitions(
-            [ColumnFilter("__name__", Equals("heap_usage"))], 0, 2**62)
+            [ColumnFilter("_metric_", Equals("heap_usage"))], 0, 2**62)
         tags_list, batch = shard2.scan_batch(res.part_ids, 0, 2**62)
         counts = np.asarray(batch.row_counts)[:len(tags_list)]
         for i, t in enumerate(tags_list):
@@ -238,7 +238,7 @@ class TestOnDemandPaging:
         assert n_evicted == 3
         assert shard.num_partitions == len(truth) - 3
         res = shard.lookup_partitions(
-            [ColumnFilter("__name__", Equals("heap_usage"))], 0, 2**62)
+            [ColumnFilter("_metric_", Equals("heap_usage"))], 0, 2**62)
         assert len(res.part_ids) == len(truth)  # index kept evicted entries
         tags_list, batch = shard.scan_batch(res.part_ids, 0, 2**62)
         assert len(tags_list) == len(truth)
@@ -253,7 +253,7 @@ class TestOnDemandPaging:
         disk, shard, truth = self._setup(tmp_path)
         shard.evict_partitions(2)
         res = shard.lookup_partitions(
-            [ColumnFilter("__name__", Equals("heap_usage"))], 0, 2**62)
+            [ColumnFilter("_metric_", Equals("heap_usage"))], 0, 2**62)
         shard.scan_batch(res.part_ids, 0, 2**62)
         paged_once = shard.stats.partitions_paged
         shard.scan_batch(res.part_ids, 0, 2**62)
@@ -280,7 +280,7 @@ class TestOnDemandPaging:
         disk, shard, truth = self._setup(tmp_path,
                                          max_data_per_shard_query=16)
         res = shard.lookup_partitions(
-            [ColumnFilter("__name__", Equals("heap_usage"))], 0, 2**62)
+            [ColumnFilter("_metric_", Equals("heap_usage"))], 0, 2**62)
         with pytest.raises(QueryLimitExceeded):
             shard.scan_batch(res.part_ids, 0, 2**62)
 
@@ -292,7 +292,7 @@ class TestOnDemandPaging:
         shard.evict_partitions(len(truth))
         some_ts = truth["i0"][0]
         narrow_end = int(some_ts[50])
-        f = [ColumnFilter("__name__", Equals("heap_usage"))]
+        f = [ColumnFilter("_metric_", Equals("heap_usage"))]
         res = shard.lookup_partitions(f, 0, narrow_end)
         shard.scan_batch(res.part_ids, 0, narrow_end)
         # now the wide query: every series must return all rows
@@ -318,7 +318,7 @@ class TestOnDemandPaging:
         disk, shard, truth = self._setup(tmp_path)
         shard.evict_partitions(len(truth))
         shard.paged.max_bytes = 1  # pathological: cache holds ~one partition
-        f = [ColumnFilter("__name__", Equals("heap_usage"))]
+        f = [ColumnFilter("_metric_", Equals("heap_usage"))]
         res = shard.lookup_partitions(f, 0, 2**62)
         tags_list, batch = shard.scan_batch(res.part_ids, 0, 2**62)
         assert len(tags_list) == len(truth)
