@@ -142,9 +142,12 @@ class IngestionCoordinator:
                                                 offset=resume_from)
             with self._lock:
                 self._streams[shard] = stream
-            if stop.is_set():  # stopped between start and stream creation
-                self.event_sink(IngestionStopped(self.dataset, shard))
-                return
+            if stop.is_set():
+                # stopped between start and stream registration: ensure a
+                # sentinel exists (close is idempotent-until-delivered),
+                # then fall through to the loop so it gets consumed —
+                # never leave a stale sentinel for the next consumer
+                stream.teardown()
             sh = self.memstore.get_shard(self.dataset, shard)
 
             recovering = resume_from is not None
@@ -155,14 +158,13 @@ class IngestionCoordinator:
                 self.event_sink(IngestionStarted(self.dataset, shard,
                                                  self.node))
             n_since_report = 0
+            # the loop runs until the stream ends: a finite source drains,
+            # a live queue delivers the teardown sentinel.  No early exit —
+            # dequeued elements are always ingested (at-least-once) and the
+            # sentinel is always consumed (no stale sentinel for the next
+            # consumer of a shared stream).
             for offset, container in stream.get():
-                # ingest BEFORE checking stop: a dequeued element is not
-                # redelivered by the queue edge, so discarding it on
-                # shutdown would lose the record
                 sh.ingest_container(container, offset)
-                if stop.is_set():
-                    self.event_sink(IngestionStopped(self.dataset, shard))
-                    return
                 if recovering:
                     n_since_report += 1
                     if offset >= highest:

@@ -114,22 +114,16 @@ class RecordBuilder:
         self.container_size = container_size
         self._containers: list[bytearray] = []
         self._cur: bytearray = bytearray()
-        self._norm_memo: tuple = (None, None)  # (tags object, normalized)
 
     def add(self, timestamp: int, values: Sequence, tags: Mapping[str, str]) -> None:
         # normalize the Prometheus __name__ label to the dataset's metric
         # column (reference: gateway InputRecord conversion writes the
-        # metric into DatasetOptions.metricColumn); memoized on the tags
-        # object since producers loop one tags dict per series
+        # metric into DatasetOptions.metricColumn)
         mcol = self.options.metric_column
         if mcol != "__name__" and "__name__" in tags:
-            if self._norm_memo[0] is tags:
-                tags = self._norm_memo[1]
-            else:
-                norm = dict(tags)
-                norm[mcol] = norm.pop("__name__")
-                self._norm_memo = (tags, norm)
-                tags = norm
+            norm = dict(tags)
+            norm[mcol] = norm.pop("__name__")
+            tags = norm
         shash = shard_key_hash(tags, self.options)
         phash = partition_hash(tags, self.options)
         rec = _encode_record(self.schema, self.options, timestamp, values, tags,

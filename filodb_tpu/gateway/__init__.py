@@ -1,0 +1,7 @@
+"""Metrics gateway: Influx line-protocol edge, sharding publisher, load
+generators (reference: gateway/ module)."""
+
+from filodb_tpu.gateway.influx import InfluxRecord, parse_line, parse_lines  # noqa: F401
+from filodb_tpu.gateway.producer import (  # noqa: F401
+    TestTimeseriesProducer, csv_stream_elements, series_tags)
+from filodb_tpu.gateway.server import GatewayServer, ShardingPublisher  # noqa: F401

@@ -1,0 +1,192 @@
+"""Influx line protocol parser -> input records.
+
+Capability match for the reference's gateway conversion layer (reference:
+gateway/src/main/scala/filodb/gateway/conversion/
+InfluxProtocolParser.scala:65, InfluxRecord.scala — parse
+``measurement,tag=v,... field=1.0,... <ts>`` lines; single-field records
+map to the gauge/counter prom schemas, ``sum``/``count``/bucket fields
+map to histograms; InputRecord.scala:15 defines the conversion target).
+
+Escapes per the Influx spec: ``\\,`` ``\\ `` ``\\=`` in identifiers/tags,
+``\\"`` in string field values.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Mapping, Optional
+
+
+class InfluxParseError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class InfluxRecord:
+    """One parsed line (reference: InfluxPromSingleRecord /
+    InfluxHistogramRecord)."""
+
+    measurement: str
+    tags: dict[str, str]
+    fields: dict[str, float]
+    timestamp_ms: int
+
+    def kind(self) -> str:
+        """gauge | histogram — histogram when bucket-style fields present
+        (reference: InfluxProtocolParser.record: histogram chosen when
+        fields are sum/count/+Inf/le buckets)."""
+        names = set(self.fields)
+        if "sum" in names and "count" in names and len(names) > 2:
+            return "histogram"
+        return "gauge"
+
+
+def _split_escaped(text: str, sep: str) -> list[str]:
+    """Split on sep, honoring backslash escapes."""
+    out, cur, i = [], [], 0
+    while i < len(text):
+        c = text[i]
+        if c == "\\" and i + 1 < len(text):
+            cur.append(text[i + 1])
+            i += 2
+            continue
+        if c == sep:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(c)
+        i += 1
+    out.append("".join(cur))
+    return out
+
+
+def _find_unescaped(text: str, ch: str, start: int = 0) -> int:
+    i = start
+    while i < len(text):
+        if text[i] == "\\":
+            i += 2
+            continue
+        if text[i] == ch:
+            return i
+        i += 1
+    return -1
+
+
+def _find_outside_quotes(text: str, ch: str) -> int:
+    """First unescaped ``ch`` that is not inside a double-quoted string
+    (field values may contain spaces/commas in quotes)."""
+    i = 0
+    in_quotes = False
+    while i < len(text):
+        c = text[i]
+        if c == "\\":
+            i += 2
+            continue
+        if c == '"':
+            in_quotes = not in_quotes
+        elif c == ch and not in_quotes:
+            return i
+        i += 1
+    return -1
+
+
+def _split_outside_quotes(text: str, sep: str) -> list[str]:
+    out, cur, i = [], [], 0
+    in_quotes = False
+    while i < len(text):
+        c = text[i]
+        if c == "\\" and i + 1 < len(text):
+            cur.append(text[i:i + 2])
+            i += 2
+            continue
+        if c == '"':
+            in_quotes = not in_quotes
+            cur.append(c)
+        elif c == sep and not in_quotes:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(c)
+        i += 1
+    out.append("".join(cur))
+    return out
+
+
+def parse_line(line: str) -> Optional[InfluxRecord]:
+    """Parse one line; returns None for blank/comment lines."""
+    line = line.strip()
+    if not line or line.startswith("#"):
+        return None
+    # measurement[,tags] <space> fields [<space> timestamp]
+    sp1 = _find_unescaped(line, " ")
+    if sp1 < 0:
+        raise InfluxParseError(f"no fields in line: {line!r}")
+    head = line[:sp1]
+    rest = line[sp1 + 1:]
+    sp2 = _find_outside_quotes(rest, " ")
+    if sp2 < 0:
+        fields_part, ts_part = rest, None
+    else:
+        fields_part, ts_part = rest[:sp2], rest[sp2 + 1:].strip()
+
+    head_parts = _split_escaped(head, ",")
+    measurement = head_parts[0]
+    if not measurement:
+        raise InfluxParseError(f"empty measurement: {line!r}")
+    tags: dict[str, str] = {}
+    for kv in head_parts[1:]:
+        eq = kv.find("=")
+        if eq <= 0:
+            raise InfluxParseError(f"bad tag {kv!r} in line: {line!r}")
+        tags[kv[:eq]] = kv[eq + 1:]
+
+    fields: dict[str, float] = {}
+    for kv in _split_outside_quotes(fields_part, ","):
+        eq = kv.find("=")
+        if eq <= 0:
+            raise InfluxParseError(f"bad field {kv!r} in line: {line!r}")
+        name, raw = kv[:eq], kv[eq + 1:]
+        if raw.endswith(("i", "u")) and raw[:-1].lstrip("-").isdigit():
+            fields[name] = float(raw[:-1])  # integer field
+        elif raw.startswith('"') and raw.endswith('"'):
+            continue  # string fields don't map to samples
+        elif raw in ("t", "T", "true", "True"):
+            fields[name] = 1.0
+        elif raw in ("f", "F", "false", "False"):
+            fields[name] = 0.0
+        else:
+            try:
+                fields[name] = float(raw)
+            except ValueError as e:
+                raise InfluxParseError(
+                    f"bad field value {raw!r} in line: {line!r}") from e
+    if not fields:
+        raise InfluxParseError(f"no numeric fields in line: {line!r}")
+
+    if ts_part:
+        ts_ms = int(ts_part) // 1_000_000  # Influx default is nanoseconds
+    else:
+        import time
+        ts_ms = int(time.time() * 1000)
+    return InfluxRecord(measurement, tags, fields, ts_ms)
+
+
+def parse_lines(text: str) -> Iterator[InfluxRecord]:
+    for line in text.splitlines():
+        rec = parse_line(line)
+        if rec is not None:
+            yield rec
+
+
+def to_prom_samples(rec: InfluxRecord,
+                    default_tags: Optional[Mapping[str, str]] = None
+                    ) -> Iterator[tuple[str, dict, float]]:
+    """InfluxRecord -> (metric_name, tags, value) gauge samples
+    (reference: InfluxPromSingleRecord naming: measurement_field, plain
+    measurement for the 'value' field)."""
+    base = dict(default_tags or {})
+    base.update(rec.tags)
+    for fname, fval in rec.fields.items():
+        metric = rec.measurement if fname == "value" \
+            else f"{rec.measurement}_{fname}"
+        yield metric, base, fval

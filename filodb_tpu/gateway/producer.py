@@ -1,0 +1,145 @@
+"""Test time-series load generators.
+
+Capability match for the reference's TestTimeseriesProducer (reference:
+gateway/src/main/scala/filodb/timeseries/TestTimeseriesProducer.scala:25
+— generates prom-schema gauge/counter/histogram load with the canonical
+tag structure: metric + _ws_/_ns_ shard keys, dc/partition/host/instance
+spread tags) and the CSV ingestion source (reference:
+coordinator/.../sources/CsvStream.scala:16).
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from filodb_tpu.core.histogram import GeometricBuckets
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import Schemas
+from filodb_tpu.codecs import histcodec
+from filodb_tpu.ingest.stream import ListStreamFactory, StreamElement
+
+
+def series_tags(metric: str, i: int, ws: str = "demo",
+                app_groups: int = 8) -> dict[str, str]:
+    """The reference's tag shape: dc/partition/host/instance cycle at
+    different rates so cardinality multiplies (reference:
+    TestTimeseriesProducer.tagsForInstance)."""
+    return {"__name__": metric, "_ws_": ws, "_ns_": f"App-{i % app_groups}",
+            "dc": f"DC{i % 2}", "partition": f"partition-{i % 4}",
+            "host": f"H{i % 10}", "instance": f"Instance-{i}"}
+
+
+class TestTimeseriesProducer:
+    """Deterministic prom-schema load generator."""
+
+    __test__ = False  # not a pytest class, despite the reference's name
+
+    def __init__(self, schemas: Schemas, seed: int = 0,
+                 start_ms: int = 1_700_000_000_000, interval_ms: int = 10_000):
+        self.schemas = schemas
+        self.rng = np.random.default_rng(seed)
+        self.start_ms = start_ms
+        self.interval_ms = interval_ms
+
+    def gauge_containers(self, metric: str = "heap_usage", n_series: int = 100,
+                         n_samples: int = 100,
+                         container_size: int = 1024 * 1024) -> list[bytes]:
+        b = RecordBuilder(self.schemas["gauge"], container_size=container_size)
+        for i in range(n_series):
+            tags = series_tags(metric, i)
+            vals = 50 + 15 * np.sin(np.arange(n_samples) / 10 + i) \
+                + self.rng.random(n_samples)
+            for k in range(n_samples):
+                b.add(self.start_ms + k * self.interval_ms,
+                      [float(vals[k])], tags)
+        return b.containers()
+
+    def counter_containers(self, metric: str = "requests_total",
+                           n_series: int = 100, n_samples: int = 100,
+                           container_size: int = 1024 * 1024) -> list[bytes]:
+        b = RecordBuilder(self.schemas["prom-counter"],
+                          container_size=container_size)
+        for i in range(n_series):
+            tags = series_tags(metric, i)
+            vals = np.cumsum(self.rng.random(n_samples) * 10)
+            for k in range(n_samples):
+                b.add(self.start_ms + k * self.interval_ms,
+                      [float(vals[k])], tags)
+        return b.containers()
+
+    def histogram_containers(self, metric: str = "request_latency",
+                             n_series: int = 20, n_samples: int = 50,
+                             num_buckets: int = 8,
+                             container_size: int = 1024 * 1024) -> list[bytes]:
+        b = RecordBuilder(self.schemas["prom-histogram"],
+                          container_size=container_size)
+        buckets = GeometricBuckets(2.0, 2.0, num_buckets)
+        for i in range(n_series):
+            tags = series_tags(metric, i)
+            counts = np.zeros(num_buckets, dtype=np.int64)
+            total = 0.0
+            for k in range(n_samples):
+                inc = self.rng.integers(0, 10, num_buckets)
+                counts = counts + np.cumsum(inc)  # cumulative LE buckets
+                total += float(inc.sum() * 1.5)
+                blob = histcodec.encode_hist_value(buckets, counts)
+                b.add(self.start_ms + k * self.interval_ms,
+                      [total, float(counts[-1]), blob], tags)
+        return b.containers()
+
+    def influx_lines(self, metric: str = "cpu_usage", n_series: int = 10,
+                     n_samples: int = 20) -> list[str]:
+        """Influx line-protocol rendering of a gauge load (for gateway
+        tests)."""
+        lines = []
+        for i in range(n_series):
+            tags = series_tags(metric, i)
+            name = tags.pop("__name__")
+            tag_str = ",".join(f"{k}={v}" for k, v in sorted(tags.items()))
+            for k in range(n_samples):
+                ts_ns = (self.start_ms + k * self.interval_ms) * 1_000_000
+                val = 50 + i + k * 0.5
+                lines.append(f"{name},{tag_str} value={val} {ts_ns}")
+        return lines
+
+
+# ---------------------------------------------------------------------------
+# CSV ingestion source
+# ---------------------------------------------------------------------------
+
+
+def csv_stream_elements(text: str, schemas: Schemas, schema_name: str,
+                        tag_columns: Sequence[str],
+                        timestamp_column: str = "timestamp",
+                        value_columns: Optional[Sequence[str]] = None,
+                        container_size: int = 64 * 1024
+                        ) -> list[StreamElement]:
+    """CSV -> (offset, container) stream elements (reference: CsvStream —
+    deterministic source used by cluster recovery specs).
+
+    Columns: ``timestamp_column`` (epoch ms), ``value_columns`` (defaults
+    to the schema's data columns), everything in ``tag_columns`` becomes a
+    tag."""
+    schema = schemas[schema_name]
+    if value_columns is None:
+        value_columns = [c.name for c in schema.data.columns[1:]]
+    builder = RecordBuilder(schema, container_size=container_size)
+    reader = csv.DictReader(io.StringIO(text))
+    for row in reader:
+        tags = {t: row[t] for t in tag_columns if row.get(t)}
+        values = [float(row[v]) for v in value_columns]
+        builder.add(int(row[timestamp_column]), values, tags)
+    return list(enumerate(builder.containers()))
+
+
+def csv_source_factory(path: str, schemas: Schemas, schema_name: str,
+                       tag_columns: Sequence[str],
+                       shard: int = 0, **kwargs) -> ListStreamFactory:
+    with open(path) as f:
+        elements = csv_stream_elements(f.read(), schemas, schema_name,
+                                       tag_columns, **kwargs)
+    return ListStreamFactory({shard: elements})

@@ -1,0 +1,140 @@
+"""Gateway: TCP Influx line-protocol edge -> sharded record containers.
+
+Capability match for the reference's GatewayServer (reference:
+gateway/src/main/scala/filodb/gateway/GatewayServer.scala:58 — Netty TCP
+server accepting Influx line protocol, converting to RecordBuilder
+containers, computing the target shard with ShardMapper + spread, and
+publishing per-shard to Kafka).  The stdlib socketserver replaces Netty;
+the QueueStreamFactory (or any per-shard publish function) replaces the
+Kafka producer.
+"""
+
+from __future__ import annotations
+
+import socketserver
+import threading
+from typing import Callable, Mapping, Optional
+
+from filodb_tpu.core.record import RecordBuilder, decode_container
+from filodb_tpu.core.schemas import DatasetOptions, Schema
+from filodb_tpu.gateway.influx import InfluxParseError, parse_line
+from filodb_tpu.parallel.shardmap import ShardMapper
+
+
+class ShardingPublisher:
+    """Routes samples to shards exactly like the reference gateway:
+    RecordBuilder per shard, shard = ShardMapper bit-splice of
+    (shardKeyHash, partHash, spread)."""
+
+    def __init__(self, schema: Schema, mapper: ShardMapper,
+                 publish: Callable[[int, bytes], None], spread: int = 1,
+                 options: Optional[DatasetOptions] = None,
+                 container_size: int = 64 * 1024):
+        self.schema = schema
+        self.mapper = mapper
+        self.publish = publish  # (shard, container) -> ()
+        self.spread = spread
+        self.options = options or DatasetOptions()
+        self.container_size = container_size
+        self._builders: dict[int, RecordBuilder] = {}
+        self._lock = threading.Lock()
+        self.samples_in = 0
+        self.parse_errors = 0
+
+    def _shard_of(self, tags: Mapping[str, str]) -> int:
+        from filodb_tpu.core.record import partition_hash, shard_key_hash
+        shash = shard_key_hash(tags, self.options)
+        phash = partition_hash(tags, self.options)
+        return self.mapper.ingestion_shard(shash, phash, self.spread) \
+            % self.mapper.num_shards
+
+    def add_sample(self, metric: str, tags: Mapping[str, str],
+                   timestamp_ms: int, value: float) -> int:
+        """Returns the shard the sample routed to."""
+        full = dict(tags)
+        full["__name__"] = metric
+        with self._lock:
+            # normalize through a throwaway dict to compute the shard on
+            # the same tags the builder will encode
+            shard = None
+            builder = None
+            # builder.add normalizes __name__ -> metric column itself
+            probe = dict(full)
+            probe[self.options.metric_column] = probe.pop("__name__")
+            shard = self._shard_of(probe)
+            builder = self._builders.get(shard)
+            if builder is None:
+                builder = self._builders[shard] = RecordBuilder(
+                    self.schema, self.options, self.container_size)
+            builder.add(timestamp_ms, [value], full)
+            self.samples_in += 1
+        return shard
+
+    def ingest_influx_line(self, line: str) -> int:
+        """Parse one line and route its samples.  Returns samples added."""
+        from filodb_tpu.gateway.influx import to_prom_samples
+        try:
+            rec = parse_line(line)
+        except InfluxParseError:
+            self.parse_errors += 1
+            return 0
+        if rec is None:
+            return 0
+        n = 0
+        for metric, tags, value in to_prom_samples(rec):
+            self.add_sample(metric, tags, rec.timestamp_ms, value)
+            n += 1
+        return n
+
+    def flush(self) -> int:
+        """Publish all pending containers; returns containers published."""
+        with self._lock:
+            builders = dict(self._builders)
+        n = 0
+        for shard, b in builders.items():
+            for c in b.containers():
+                self.publish(shard, c)
+                n += 1
+        return n
+
+
+class GatewayServer:
+    """TCP server speaking Influx line protocol, one line per record
+    (reference: GatewayServer Netty pipeline)."""
+
+    def __init__(self, publisher: ShardingPublisher, host: str = "127.0.0.1",
+                 port: int = 0, flush_every: int = 128):
+        self.publisher = publisher
+        self.host = host
+        self.port = port
+        self.flush_every = flush_every
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        gw = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                n = 0
+                for raw in self.rfile:
+                    gw.publisher.ingest_influx_line(
+                        raw.decode("utf-8", "replace"))
+                    n += 1
+                    if n % gw.flush_every == 0:
+                        gw.publisher.flush()
+                gw.publisher.flush()
+
+        socketserver.ThreadingTCPServer.allow_reuse_address = True
+        self._server = socketserver.ThreadingTCPServer(
+            (self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="gateway", daemon=True)
+        self._thread.start()
+        return self.port
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()

@@ -97,7 +97,10 @@ class FiloHttpServer:
                     ctype = req.headers.get("Content-Type", "")
                     if "json" in ctype:
                         for k, v in json.loads(body).items():
-                            multi.setdefault(k, []).append(v)
+                            if isinstance(v, list):
+                                multi.setdefault(k, []).extend(v)
+                            else:
+                                multi.setdefault(k, []).append(v)
                     else:
                         for k, v in urllib.parse.parse_qs(body).items():
                             multi.setdefault(k, []).extend(v)

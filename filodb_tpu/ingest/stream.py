@@ -71,10 +71,14 @@ class QueueStream(IngestionStream):
 
     _SENTINEL = (None, None)
 
-    def __init__(self, maxsize: int = 10_000, start_offset: int = 0):
+    def __init__(self, maxsize: int = 0, start_offset: int = 0):
+        # unbounded by default: push must never block while holding the
+        # offset lock (a bounded queue + stopped consumer would deadlock
+        # ensure_offset/other producers against a blocked put)
         self._q: queue.Queue = queue.Queue(maxsize)
         self._next_offset = start_offset
         self._lock = threading.Lock()
+        self._close_pending = False
 
     def push(self, container: bytes) -> int:
         # assign AND enqueue under the lock: out-of-order offsets would turn
@@ -93,12 +97,21 @@ class QueueStream(IngestionStream):
             self._next_offset = max(self._next_offset, offset)
 
     def close(self) -> None:
-        self._q.put(self._SENTINEL)
+        """Wake the current consumer.  Idempotent until delivered: closing
+        twice before a consumer sees the sentinel enqueues it once, so a
+        restarted consumer never dies on a stale sentinel."""
+        with self._lock:
+            if self._close_pending:
+                return
+            self._close_pending = True
+            self._q.put(self._SENTINEL)
 
     def get(self) -> Iterator[StreamElement]:
         while True:
             item = self._q.get()
             if item == self._SENTINEL:
+                with self._lock:
+                    self._close_pending = False
                 return
             yield item
 

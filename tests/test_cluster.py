@@ -295,3 +295,34 @@ def test_queue_offsets_resume_above_checkpoints():
         time.sleep(0.01)
     assert store.get_shard("prom", 0).stats.rows_ingested == 1
     ic.stop_all()
+
+
+def test_restart_after_stop_with_pending_items():
+    """Regression: stop with queued items leaves no stale sentinel; a
+    restarted consumer ingests the backlog and keeps running."""
+    factory = QueueStreamFactory()
+    store = TimeSeriesMemStore()
+    ic = IngestionCoordinator("n", "prom", DEFAULT_SCHEMAS, store, factory)
+    ic.resync([0])
+    time.sleep(0.05)
+    b = RecordBuilder(DEFAULT_SCHEMAS["gauge"])
+    b.add(BASE + 1000, [1.0], {"__name__": "up", "instance": "x",
+                               "_ws_": "w", "_ns_": "n"})
+    cont = b.containers()[0]
+    factory.stream_for("prom", 0).push(cont)
+    ic.stop_ingestion(0)
+    assert ic.running_shards() == []
+    # backlog arrives while stopped
+    b2 = RecordBuilder(DEFAULT_SCHEMAS["gauge"])
+    b2.add(BASE + 2000, [2.0], {"__name__": "up", "instance": "x",
+                                "_ws_": "w", "_ns_": "n"})
+    factory.stream_for("prom", 0).push(b2.containers()[0])
+    ic.start_ingestion(0)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if store.get_shard("prom", 0).stats.rows_ingested >= 2:
+            break
+        time.sleep(0.01)
+    assert store.get_shard("prom", 0).stats.rows_ingested == 2
+    assert ic.running_shards() == [0]  # still alive, not killed by sentinel
+    ic.stop_all()

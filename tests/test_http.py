@@ -197,6 +197,15 @@ class TestAdmin:
         assert code == 200 and body["data"] == [3]
         code, body = _get(server, "/api/v1/cluster/prom/status")
         assert body["data"][3]["status"] == "Stopped"
+        # startshards requires an unassigned shard: stopped keeps its node,
+        # so this is a no-op returning []
+        code, body = _post(server, "/api/v1/cluster/prom/startshards",
+                           shards="3", node="local")
+        assert code == 200 and body["data"] == []
+        # missing node param on startshards is a 400, not a 500
+        code, body = _post(server, "/api/v1/cluster/prom/startshards",
+                           shards="3")
+        assert code == 400
 
 
 def test_param_parsing():
