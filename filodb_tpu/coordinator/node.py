@@ -105,6 +105,11 @@ class IngestionCoordinator:
         if t is not None and t is not threading.current_thread() \
                 and t.is_alive():
             t.join(timeout=5.0)
+            if t.is_alive():
+                # still draining a large backlog: leave it tracked so a
+                # restart cannot spawn a second consumer on the same
+                # stream; the thread's own finally runs _cleanup on exit
+                return
         self._cleanup(shard)
 
     def _cleanup(self, shard: int) -> None:

@@ -42,12 +42,13 @@ class InfluxRecord:
 
 
 def _split_escaped(text: str, sep: str) -> list[str]:
-    """Split on sep, honoring backslash escapes."""
+    """Split on unescaped sep, PRESERVING escape sequences in the pieces
+    (so later splits on '=' still see which ones were escaped)."""
     out, cur, i = [], [], 0
     while i < len(text):
         c = text[i]
         if c == "\\" and i + 1 < len(text):
-            cur.append(text[i + 1])
+            cur.append(text[i:i + 2])
             i += 2
             continue
         if c == sep:
@@ -58,6 +59,18 @@ def _split_escaped(text: str, sep: str) -> list[str]:
         i += 1
     out.append("".join(cur))
     return out
+
+
+def _unescape(text: str) -> str:
+    out, i = [], 0
+    while i < len(text):
+        if text[i] == "\\" and i + 1 < len(text):
+            out.append(text[i + 1])
+            i += 2
+        else:
+            out.append(text[i])
+            i += 1
+    return "".join(out)
 
 
 def _find_unescaped(text: str, ch: str, start: int = 0) -> int:
@@ -130,22 +143,22 @@ def parse_line(line: str) -> Optional[InfluxRecord]:
         fields_part, ts_part = rest[:sp2], rest[sp2 + 1:].strip()
 
     head_parts = _split_escaped(head, ",")
-    measurement = head_parts[0]
+    measurement = _unescape(head_parts[0])
     if not measurement:
         raise InfluxParseError(f"empty measurement: {line!r}")
     tags: dict[str, str] = {}
     for kv in head_parts[1:]:
-        eq = kv.find("=")
+        eq = _find_unescaped(kv, "=")  # escaped '=' stays in the key
         if eq <= 0:
             raise InfluxParseError(f"bad tag {kv!r} in line: {line!r}")
-        tags[kv[:eq]] = kv[eq + 1:]
+        tags[_unescape(kv[:eq])] = _unescape(kv[eq + 1:])
 
     fields: dict[str, float] = {}
     for kv in _split_outside_quotes(fields_part, ","):
-        eq = kv.find("=")
+        eq = _find_unescaped(kv, "=")
         if eq <= 0:
             raise InfluxParseError(f"bad field {kv!r} in line: {line!r}")
-        name, raw = kv[:eq], kv[eq + 1:]
+        name, raw = _unescape(kv[:eq]), kv[eq + 1:]
         if raw.endswith(("i", "u")) and raw[:-1].lstrip("-").isdigit():
             fields[name] = float(raw[:-1])  # integer field
         elif raw.startswith('"') and raw.endswith('"'):

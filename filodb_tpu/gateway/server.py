@@ -51,22 +51,17 @@ class ShardingPublisher:
     def add_sample(self, metric: str, tags: Mapping[str, str],
                    timestamp_ms: int, value: float) -> int:
         """Returns the shard the sample routed to."""
-        full = dict(tags)
-        full["__name__"] = metric
+        # normalize once: the builder skips its own __name__ rewrite when
+        # the metric column is already present
+        norm = dict(tags)
+        norm[self.options.metric_column] = metric
         with self._lock:
-            # normalize through a throwaway dict to compute the shard on
-            # the same tags the builder will encode
-            shard = None
-            builder = None
-            # builder.add normalizes __name__ -> metric column itself
-            probe = dict(full)
-            probe[self.options.metric_column] = probe.pop("__name__")
-            shard = self._shard_of(probe)
+            shard = self._shard_of(norm)
             builder = self._builders.get(shard)
             if builder is None:
                 builder = self._builders[shard] = RecordBuilder(
                     self.schema, self.options, self.container_size)
-            builder.add(timestamp_ms, [value], full)
+            builder.add(timestamp_ms, [value], norm)
             self.samples_in += 1
         return shard
 
@@ -87,14 +82,16 @@ class ShardingPublisher:
         return n
 
     def flush(self) -> int:
-        """Publish all pending containers; returns containers published."""
+        """Publish all pending containers; returns containers published.
+        Drains builders under the lock — RecordBuilder is not thread-safe
+        and concurrent add_sample/flush would otherwise lose containers."""
         with self._lock:
-            builders = dict(self._builders)
+            drained = [(shard, c) for shard, b in self._builders.items()
+                       for c in b.containers()]
         n = 0
-        for shard, b in builders.items():
-            for c in b.containers():
-                self.publish(shard, c)
-                n += 1
+        for shard, c in drained:
+            self.publish(shard, c)
+            n += 1
         return n
 
 
@@ -125,9 +122,10 @@ class GatewayServer:
                         gw.publisher.flush()
                 gw.publisher.flush()
 
-        socketserver.ThreadingTCPServer.allow_reuse_address = True
-        self._server = socketserver.ThreadingTCPServer(
-            (self.host, self.port), Handler)
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True  # scoped here, not on the stdlib class
+
+        self._server = _Server((self.host, self.port), Handler)
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         name="gateway", daemon=True)

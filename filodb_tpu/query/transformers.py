@@ -390,27 +390,40 @@ class StitchRvsMapper(RangeVectorTransformer):
     StitchRvsExec.scala:13,61): NaN slots fill from the other split."""
 
     def apply(self, batches, ctx):
+        """Children may cover different sub-ranges of one step grid (time
+        splits, raw-vs-downsample routing, HA failover segments): merge
+        onto the UNION grid, each child's values placed by step offset."""
+        pbs = [b for b in batches if isinstance(b, PeriodicBatch)]
+        if not pbs:
+            return []
+        step = pbs[0].steps.step
+        for b in pbs:
+            if b.steps.step != step:
+                raise ValueError(
+                    f"cannot stitch mismatched steps {b.steps.step} != {step}")
+        start = min(b.steps.start for b in pbs)
+        end = max(b.steps.end for b in pbs)
+        union = StepRange(start, end, step)
+        n = union.num_steps
         merged: dict[tuple, np.ndarray] = {}
-        steps = None
         order: list[tuple] = []
-        for b in batches:
-            if not isinstance(b, PeriodicBatch):
-                continue
-            steps = steps or b.steps
+        for b in pbs:
             v = b.np_values()
+            off = (b.steps.start - start) // step
+            m = b.steps.num_steps
             for i, t in enumerate(b.keys):
                 k = tuple(sorted(t.items()))
-                if k in merged:
-                    cur = merged[k]
-                    merged[k] = np.where(np.isnan(cur), v[i], cur)
-                else:
-                    merged[k] = v[i].copy()
+                cur = merged.get(k)
+                if cur is None:
+                    cur = np.full(n, np.nan)
+                    merged[k] = cur
                     order.append(k)
-        if steps is None:
-            return []
+                seg = cur[off:off + m]
+                cur[off:off + m] = np.where(np.isnan(seg), v[i], seg)
         keys = [dict(k) for k in order]
-        vals = np.stack([merged[k] for k in order]) if order else np.empty((0, steps.num_steps))
-        return [PeriodicBatch(keys, steps, vals)]
+        vals = np.stack([merged[k] for k in order]) if order \
+            else np.empty((0, n))
+        return [PeriodicBatch(keys, union, vals)]
 
 
 @dataclasses.dataclass

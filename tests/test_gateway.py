@@ -44,6 +44,10 @@ class TestInfluxParser:
         assert r.measurement == "my,metric"
         assert r.tags == {"tag one": "va=lue"}
 
+    def test_escaped_equals_in_tag_key(self):
+        r = parse_line(r"m,a\=b=c value=1 1700000000000000000")
+        assert r.tags == {"a=b": "c"}
+
     def test_bool_and_string_fields(self):
         r = parse_line('up,host=a ok=true,msg="hello world",v=2 1700000000000000000')
         assert r.fields == {"ok": 1.0, "v": 2.0}  # strings skipped
