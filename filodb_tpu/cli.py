@@ -1,0 +1,234 @@
+"""Command-line interface.
+
+Capability match for the reference's CliMain (reference:
+cli/src/main/scala/filodb.cli/CliMain.scala:65-96 — commands: init,
+create, importcsv, list, promql queries, labelValues,
+timeseriesMetadata, and the debug decoders promFilterToPartKeyBR /
+partKeyBrAsString / decodeChunkInfo / decodeVector).
+
+Query commands talk to a running server over HTTP; import/debug commands
+run locally.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.parse
+import urllib.request
+
+
+def _http_get(server: str, path: str, params: dict | None = None) -> dict:
+    """GET returning the server's JSON even for 4xx/5xx responses (the
+    error body carries the message the user needs)."""
+    qs = urllib.parse.urlencode({k: v for k, v in (params or {}).items()
+                                 if v is not None})
+    url = f"{server.rstrip('/')}{path}" + (f"?{qs}" if qs else "")
+    try:
+        with urllib.request.urlopen(url, timeout=60) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return json.loads(e.read())
+        except Exception:  # non-JSON error body
+            return {"status": "error", "errorType": "http",
+                    "error": f"HTTP {e.code}"}
+
+
+def cmd_query(args) -> int:
+    path = f"/promql/{args.dataset}/api/v1/query_range"
+    body = _http_get(args.server, path,
+                     {"query": args.promql, "start": args.start,
+                      "end": args.end, "step": args.step})
+    print(json.dumps(body, indent=2))
+    return 0 if body.get("status") == "success" else 1
+
+
+def cmd_instant_query(args) -> int:
+    path = f"/promql/{args.dataset}/api/v1/query"
+    body = _http_get(args.server, path,
+                     {"query": args.promql, "time": args.time})
+    print(json.dumps(body, indent=2))
+    return 0 if body.get("status") == "success" else 1
+
+
+def cmd_labelvalues(args) -> int:
+    path = f"/promql/{args.dataset}/api/v1/label/{args.label}/values"
+    body = _http_get(args.server, path)
+    for v in body.get("data", []):
+        print(v)
+    return 0
+
+
+def cmd_timeseries_metadata(args) -> int:
+    path = f"/promql/{args.dataset}/api/v1/series"
+    body = _http_get(args.server, path, {"match[]": args.match})
+    if body.get("status") != "success":
+        print(json.dumps(body, indent=2))
+        return 1
+    print(json.dumps(body.get("data", []), indent=2))
+    return 0
+
+
+def cmd_status(args) -> int:
+    body = _http_get(args.server, f"/api/v1/cluster/{args.dataset}/status")
+    print(json.dumps(body.get("data", []), indent=2))
+    return 0
+
+
+def cmd_list(args) -> int:
+    from filodb_tpu.store.persistence import DiskMetaStore
+    meta = DiskMetaStore(f"{args.data_dir}/meta.db")
+    for name in meta.list_datasets():
+        print(name)
+    return 0
+
+
+def cmd_create(args) -> int:
+    from filodb_tpu.store.persistence import DiskMetaStore
+    meta = DiskMetaStore(f"{args.data_dir}/meta.db")
+    conf = {"name": args.dataset, "num-shards": args.num_shards,
+            "schema": args.schema}
+    meta.write_dataset(args.dataset, json.dumps(conf))
+    print(f"created dataset {args.dataset}")
+    return 0
+
+
+def cmd_importcsv(args) -> int:
+    """Load a CSV into a local disk store (offline bulk import)."""
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+    from filodb_tpu.gateway.producer import csv_stream_elements
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.store.persistence import DiskColumnStore, DiskMetaStore
+
+    colstore = DiskColumnStore(f"{args.data_dir}/chunks.db")
+    metastore = DiskMetaStore(f"{args.data_dir}/meta.db")
+    ms = TimeSeriesMemStore(colstore, metastore)
+    ms.setup(args.dataset, DEFAULT_SCHEMAS, args.shard)
+    with open(args.file) as f:
+        elements = csv_stream_elements(
+            f.read(), DEFAULT_SCHEMAS, args.schema,
+            tag_columns=args.tag_columns.split(","),
+            timestamp_column=args.timestamp_column)
+    n = 0
+    for off, c in elements:
+        n += ms.ingest(args.dataset, args.shard, c, offset=off)
+    ms.get_shard(args.dataset, args.shard).flush_all()
+    print(f"imported {n} rows into {args.dataset} shard {args.shard}")
+    return 0
+
+
+def cmd_partkey(args) -> int:
+    """Debug: render a hex partkey as tags (reference: partKeyBrAsString)."""
+    from filodb_tpu.core.record import parse_partkey
+    print(json.dumps(parse_partkey(bytes.fromhex(args.hex))))
+    return 0
+
+
+def cmd_make_partkey(args) -> int:
+    """Debug: tags JSON -> canonical partkey hex (reference:
+    promFilterToPartKeyBR)."""
+    from filodb_tpu.core.record import canonical_partkey
+    print(canonical_partkey(json.loads(args.tags)).hex())
+    return 0
+
+
+def cmd_decode_vector(args) -> int:
+    """Debug: decode a hex-encoded vector blob (reference: decodeVector)."""
+    from filodb_tpu.codecs import deltadelta, doublecodec
+    from filodb_tpu.codecs.wire import WireType
+    blob = bytes.fromhex(args.hex)
+    wire = blob[0]
+    if wire in (WireType.CONST_LONG, WireType.DELTA2):
+        vals = deltadelta.decode(blob)
+    else:
+        vals = doublecodec.decode(blob)
+    print(f"wire_type={wire} n={len(vals)}")
+    print(list(vals[:args.limit]))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="filodb-tpu",
+                                description="FiloDB-TPU command line")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def server_args(sp):
+        sp.add_argument("--server", default="http://127.0.0.1:8080")
+        sp.add_argument("--dataset", default="prom")
+
+    q = sub.add_parser("query", help="PromQL range query")
+    server_args(q)
+    q.add_argument("promql")
+    q.add_argument("--start", required=True, help="unix seconds")
+    q.add_argument("--end", required=True, help="unix seconds")
+    q.add_argument("--step", default="15s")
+    q.set_defaults(fn=cmd_query)
+
+    qi = sub.add_parser("instant-query", help="PromQL instant query")
+    server_args(qi)
+    qi.add_argument("promql")
+    qi.add_argument("--time", default=None, help="unix seconds")
+    qi.set_defaults(fn=cmd_instant_query)
+
+    lv = sub.add_parser("labelvalues", help="values of one label")
+    server_args(lv)
+    lv.add_argument("label")
+    lv.set_defaults(fn=cmd_labelvalues)
+
+    md = sub.add_parser("timeseries-metadata",
+                        help="series matching a selector")
+    server_args(md)
+    md.add_argument("match")
+    md.set_defaults(fn=cmd_timeseries_metadata)
+
+    st = sub.add_parser("status", help="shard statuses")
+    server_args(st)
+    st.set_defaults(fn=cmd_status)
+
+    ls = sub.add_parser("list", help="list datasets in a local store")
+    ls.add_argument("--data-dir", required=True)
+    ls.set_defaults(fn=cmd_list)
+
+    cr = sub.add_parser("create", help="register a dataset in a local store")
+    cr.add_argument("--data-dir", required=True)
+    cr.add_argument("--dataset", required=True)
+    cr.add_argument("--num-shards", type=int, default=4)
+    cr.add_argument("--schema", default="gauge")
+    cr.set_defaults(fn=cmd_create)
+
+    ic = sub.add_parser("importcsv", help="bulk import a CSV file")
+    ic.add_argument("--data-dir", required=True)
+    ic.add_argument("--dataset", required=True)
+    ic.add_argument("--file", required=True)
+    ic.add_argument("--schema", default="gauge")
+    ic.add_argument("--tag-columns", required=True,
+                    help="comma-separated tag column names")
+    ic.add_argument("--timestamp-column", default="timestamp")
+    ic.add_argument("--shard", type=int, default=0)
+    ic.set_defaults(fn=cmd_importcsv)
+
+    pk = sub.add_parser("partkey", help="decode a hex partkey")
+    pk.add_argument("hex")
+    pk.set_defaults(fn=cmd_partkey)
+
+    mpk = sub.add_parser("make-partkey", help="tags JSON -> partkey hex")
+    mpk.add_argument("tags")
+    mpk.set_defaults(fn=cmd_make_partkey)
+
+    dv = sub.add_parser("decode-vector", help="decode a hex vector blob")
+    dv.add_argument("hex")
+    dv.add_argument("--limit", type=int, default=20)
+    dv.set_defaults(fn=cmd_decode_vector)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -87,6 +87,21 @@ class FiloHttpServer:
     # --------------------------------------------------------------- routing
 
     def _handle(self, req: BaseHTTPRequestHandler, method: str) -> None:
+        if req.path.split("?")[0] == "/metrics":
+            # plain-text route handled entirely outside the JSON error
+            # epilogue: a mid-write disconnect must not trigger a second
+            # send_response on the same socket
+            try:
+                from filodb_tpu.utils.observability import REGISTRY
+                text = REGISTRY.expose_text().encode()
+                req.send_response(200)
+                req.send_header("Content-Type", "text/plain; version=0.0.4")
+                req.send_header("Content-Length", str(len(text)))
+                req.end_headers()
+                req.wfile.write(text)
+            except Exception:  # noqa: BLE001 — socket already unusable
+                pass
+            return
         try:
             parsed = urllib.parse.urlparse(req.path)
             multi = urllib.parse.parse_qs(parsed.query)

@@ -1,0 +1,166 @@
+"""Standalone server: wire every subsystem into one process.
+
+Capability match for the reference's FiloServer main (reference:
+standalone/src/main/scala/filodb.standalone/FiloServer.scala:39,91 —
+coordinatorActor -> metaStore.initialize -> cluster bootstrap -> cluster
+singleton/shard assignment -> HTTP server -> SimpleProfiler.launch),
+driven by a JSON config instead of HOCON:
+
+    {
+      "node": "node-0",
+      "data-dir": "/var/filodb",          # omit for in-memory only
+      "http-port": 8080,
+      "gateway-port": 8009,               # omit to disable the Influx edge
+      "profiler": false,
+      "datasets": [{
+        "name": "prom", "num-shards": 4, "min-num-nodes": 1,
+        "schema": "gauge", "spread": 1,
+        "store": {"flush-interval": "1h", "groups-per-shard": 8}
+      }]
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import sys
+import threading
+from typing import Optional
+
+from filodb_tpu.coordinator.cluster import FailureDetector, ShardManager
+from filodb_tpu.coordinator.node import NodeCoordinator
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+from filodb_tpu.core.storeconfig import StoreConfig
+from filodb_tpu.gateway.server import GatewayServer, ShardingPublisher
+from filodb_tpu.http.server import DatasetBinding, FiloHttpServer
+from filodb_tpu.ingest.stream import QueueStreamFactory
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.utils.observability import REGISTRY, SimpleProfiler
+
+
+class FiloServer:
+    """One node: stores + shard manager + ingestion + HTTP (+ gateway)."""
+
+    def __init__(self, config: dict):
+        self.config = config
+        self.node = config.get("node", "node-0")
+        data_dir = config.get("data-dir")
+        if data_dir:
+            from filodb_tpu.store.persistence import (DiskColumnStore,
+                                                      DiskMetaStore)
+            self.colstore = DiskColumnStore(f"{data_dir}/chunks.db")
+            self.metastore = DiskMetaStore(f"{data_dir}/meta.db")
+        else:
+            from filodb_tpu.store.columnstore import NullColumnStore
+            from filodb_tpu.store.metastore import InMemoryMetaStore
+            self.colstore = NullColumnStore()
+            self.metastore = InMemoryMetaStore()
+        self.memstore = TimeSeriesMemStore(self.colstore, self.metastore)
+        self.manager = ShardManager()
+        self.failure_detector = FailureDetector(self.manager)
+        self.coordinator = NodeCoordinator(self.node, self.memstore)
+        self.stream_factory = QueueStreamFactory()
+        self.http = FiloHttpServer(port=config.get("http-port", 0),
+                                   shard_manager=self.manager)
+        self.gateways: list[GatewayServer] = []
+        self.profiler: Optional[SimpleProfiler] = None
+        self._started = threading.Event()
+
+    def start(self) -> int:
+        """Bring the node up; returns the HTTP port."""
+        self.metastore.initialize()
+        self.failure_detector.heartbeat(self.node)
+        up = REGISTRY.gauge("filodb_node_up")
+        up.set(1.0, node=self.node)
+
+        for ds_conf in self.config.get("datasets", []):
+            self._setup_dataset(ds_conf)
+
+        port = self.http.start()
+        if self.config.get("profiler"):
+            self.profiler = SimpleProfiler()
+            self.profiler.start()
+        self._started.set()
+        return port
+
+    def _setup_dataset(self, ds_conf: dict) -> None:
+        name = ds_conf["name"]
+        num_shards = int(ds_conf.get("num-shards", 4))
+        spread = int(ds_conf.get("spread", 1))
+        store_cfg = StoreConfig.from_config(ds_conf.get("store", {}))
+        if hasattr(self.metastore, "write_dataset"):
+            self.metastore.write_dataset(name, json.dumps(ds_conf))
+
+        self.manager.setup_dataset(name, num_shards,
+                                   int(ds_conf.get("min-num-nodes", 1)))
+        ic = self.coordinator.setup_dataset(
+            name, DEFAULT_SCHEMAS, self.stream_factory, store_cfg,
+            event_sink=self.manager.publish_event)
+        shards = self.manager.mapper(name).shards_for_node(self.node)
+        ic.resync(shards)
+
+        mapper = self.manager.mapper(name)
+        planner = SingleClusterPlanner(name, mapper, DatasetOptions(),
+                                       spread_default=spread)
+        self.http.bind_dataset(DatasetBinding(name, self.memstore, planner))
+
+        gw_port = ds_conf.get("gateway-port",
+                              self.config.get("gateway-port"))
+        if gw_port is not None:
+            schema = DEFAULT_SCHEMAS[ds_conf.get("schema", "gauge")]
+            pub = ShardingPublisher(
+                schema, mapper,
+                lambda s, c, _n=name: self.stream_factory.stream_for(
+                    _n, s).push(c),
+                spread=spread)
+            gw = GatewayServer(pub, port=int(gw_port))
+            gw.start()
+            self.gateways.append(gw)
+
+    def flush_all(self) -> int:
+        n = 0
+        for ds in self.manager.datasets():
+            for sh in self.memstore.shards(ds):
+                n += sh.flush_all()
+        return n
+
+    def shutdown(self) -> None:
+        for gw in self.gateways:
+            gw.shutdown()
+        self.coordinator.shutdown()
+        self.http.shutdown()
+        if self.profiler is not None:
+            self.profiler.stop()
+        self.colstore.shutdown()
+        self.metastore.shutdown()
+
+
+def main(argv=None) -> int:
+    # epoch-ms timestamps need int64 end to end; on CPU hosts x64 must be
+    # enabled explicitly (TPU kernels rebase to int32 offsets internally)
+    import jax
+    jax.config.update("jax_enable_x64", True)
+
+    args = argv if argv is not None else sys.argv[1:]
+    if not args:
+        print("usage: python -m filodb_tpu.standalone <config.json>",
+              file=sys.stderr)
+        return 2
+    with open(args[0]) as f:
+        config = json.load(f)
+    server = FiloServer(config)
+    port = server.start()
+    print(f"FiloDB-TPU node {server.node} up: http={port} "
+          f"datasets={server.manager.datasets()}")
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

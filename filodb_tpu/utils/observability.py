@@ -1,0 +1,348 @@
+"""Observability: metrics registry, tracing spans, sampling profiler.
+
+Capability match for the reference's Kamon-based instrumentation
+(reference: coordinator/.../KamonLogger.scala:146 metric/span log
+reporters; Kamon.spanBuilder use throughout ExecPlan.execute
+ExecPlan.scala:99-126 and flush TimeSeriesShard.scala:888-891;
+core/.../Perftools.scala:53 timing spans; standalone/.../
+SimpleProfiler.java sampling profiler launched at server start).
+
+Everything is stdlib: counters/gauges/histograms with Prometheus text
+exposition (replacing Kamon's embedded Prometheus server), thread-local
+span stacks with a pluggable reporter, and a sys._current_frames-based
+sampling profiler."""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Mapping, Optional, Sequence
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+
+_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0)
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name, self.help = name, help_
+        self._values: dict[tuple, float] = collections.defaultdict(float)
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] += amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def expose(self) -> list[str]:
+        with self._lock:  # concurrent inc() may insert new label sets
+            items = sorted(self._values.items())
+        out = [f"# TYPE {self.name} counter"]
+        for key, v in items:
+            out.append(f"{self.name}{_fmt_labels(key)} {_fmt_val(v)}")
+        return out
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str = ""):
+        self.name, self.help = name, help_
+        self._values: dict[tuple, float] = {}
+        self._fns: dict[tuple, Callable[[], float]] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[tuple(sorted(labels.items()))] = value
+
+    def set_fn(self, fn: Callable[[], float], **labels) -> None:
+        """Lazily-sampled gauge (e.g. memory usage at scrape time)."""
+        with self._lock:
+            self._fns[tuple(sorted(labels.items()))] = fn
+
+    def value(self, **labels) -> float:
+        key = tuple(sorted(labels.items()))
+        if key in self._fns:
+            return float(self._fns[key]())
+        return self._values.get(key, 0.0)
+
+    def expose(self) -> list[str]:
+        out = [f"# TYPE {self.name} gauge"]
+        with self._lock:
+            items = list(self._values.items()) + \
+                [(k, fn()) for k, fn in self._fns.items()]
+        for key, v in sorted(items):
+            out.append(f"{self.name}{_fmt_labels(key)} {_fmt_val(v)}")
+        return out
+
+
+class Histogram:
+    """Cumulative-bucket histogram (seconds by convention)."""
+
+    def __init__(self, name: str, help_: str = "",
+                 buckets: Sequence[float] = _BUCKETS):
+        self.name, self.help = name, help_
+        self.buckets = tuple(buckets)
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = collections.defaultdict(float)
+        self._totals: dict[tuple, int] = collections.defaultdict(int)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[key] += value
+            self._totals[key] += 1
+
+    def expose(self) -> list[str]:
+        with self._lock:  # concurrent observe() may insert new label sets
+            counts = {k: list(v) for k, v in self._counts.items()}
+            sums = dict(self._sums)
+            totals = dict(self._totals)
+        out = [f"# TYPE {self.name} histogram"]
+        for key in sorted(counts):
+            for i, b in enumerate(self.buckets):
+                lk = key + (("le", repr(b)),)
+                out.append(f"{self.name}_bucket{_fmt_labels(lk)} "
+                           f"{counts[key][i]}")
+            lk = key + (("le", "+Inf"),)
+            out.append(f"{self.name}_bucket{_fmt_labels(lk)} {totals[key]}")
+            out.append(f"{self.name}_sum{_fmt_labels(key)} "
+                       f"{_fmt_val(sums[key])}")
+            out.append(f"{self.name}_count{_fmt_labels(key)} {totals[key]}")
+        return out
+
+
+def _escape_label(v) -> str:
+    """Prometheus exposition escaping: backslash, quote, newline."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _fmt_labels(key: tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def _fmt_val(v: float) -> str:
+    return str(int(v)) if v == int(v) else repr(v)
+
+
+class MetricsRegistry:
+    """Process-wide named metrics + Prometheus text exposition (replaces
+    Kamon's metric registry + embedded Prometheus reporter)."""
+
+    def __init__(self) -> None:
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get(name, lambda: Counter(name, help_), Counter)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get(name, lambda: Gauge(name, help_), Gauge)
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Sequence[float] = _BUCKETS) -> Histogram:
+        return self._get(name, lambda: Histogram(name, help_, buckets),
+                         Histogram)
+
+    def _get(self, name, ctor, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = ctor()
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name} already registered as "
+                                f"{type(m).__name__}")
+            return m
+
+    def expose_text(self) -> str:
+        """Prometheus text format for a /metrics endpoint."""
+        lines = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# Tracing spans
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SpanRecord:
+    name: str
+    start_s: float
+    duration_s: float
+    tags: dict
+    parent: Optional[str]
+    error: Optional[str] = None
+
+
+class Tracer:
+    """Thread-local span stack + pluggable reporters (replaces Kamon
+    span propagation via Kamon.runWithSpan)."""
+
+    def __init__(self) -> None:
+        self._local = threading.local()
+        self._reporters: list[Callable[[SpanRecord], None]] = []
+        self._lock = threading.Lock()
+
+    def add_reporter(self, fn: Callable[[SpanRecord], None]) -> None:
+        with self._lock:
+            self._reporters.append(fn)
+
+    def clear_reporters(self) -> None:
+        with self._lock:
+            self._reporters = []
+
+    def current_span(self) -> Optional[str]:
+        stack = getattr(self._local, "stack", None)
+        return stack[-1] if stack else None
+
+    def span(self, name: str, **tags):
+        return _Span(self, name, tags)
+
+    def _report(self, rec: SpanRecord) -> None:
+        with self._lock:
+            reporters = list(self._reporters)
+        for fn in reporters:
+            try:
+                fn(rec)
+            except Exception:  # noqa: BLE001 — reporters must not break work
+                traceback.print_exc()
+
+
+class _Span:
+    def __init__(self, tracer: Tracer, name: str, tags: dict):
+        self.tracer = tracer
+        self.name = name
+        self.tags = tags
+        self._t0 = 0.0
+
+    def __enter__(self):
+        stack = getattr(self.tracer._local, "stack", None)
+        if stack is None:
+            stack = self.tracer._local.stack = []
+        self.parent = stack[-1] if stack else None
+        stack.append(self.name)
+        self._t0 = time.perf_counter()
+        return self
+
+    def tag(self, **tags):
+        self.tags.update(tags)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self._t0
+        self.tracer._local.stack.pop()
+        self.tracer._report(SpanRecord(
+            self.name, time.time() - dur, dur, dict(self.tags), self.parent,
+            error=repr(exc) if exc is not None else None))
+        return False
+
+
+TRACER = Tracer()
+
+
+def span_log_reporter(log: Callable[[str], None] = print,
+                      min_duration_s: float = 0.0):
+    """Span -> log line reporter (reference: KamonSpanLogReporter)."""
+
+    def report(rec: SpanRecord) -> None:
+        if rec.duration_s >= min_duration_s:
+            tags = " ".join(f"{k}={v}" for k, v in rec.tags.items())
+            err = f" ERROR={rec.error}" if rec.error else ""
+            log(f"span {rec.name} {rec.duration_s * 1000:.2f}ms "
+                f"parent={rec.parent} {tags}{err}")
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Sampling profiler
+# ---------------------------------------------------------------------------
+
+
+class SimpleProfiler:
+    """Background stack-sampling profiler (reference:
+    standalone/src/main/java/filodb/standalone/SimpleProfiler.java —
+    samples thread stacks periodically, aggregates hottest frames, and
+    reports every interval)."""
+
+    def __init__(self, sample_interval_s: float = 0.01,
+                 report_interval_s: float = 60.0,
+                 top_k: int = 20,
+                 report_fn: Optional[Callable[[str], None]] = None):
+        self.sample_interval_s = sample_interval_s
+        self.report_interval_s = report_interval_s
+        self.top_k = top_k
+        self.report_fn = report_fn or print
+        self._counts: collections.Counter = collections.Counter()
+        self._samples = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, name="profiler",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        own = threading.get_ident()
+        next_report = time.monotonic() + self.report_interval_s
+        while not self._stop.wait(self.sample_interval_s):
+            frames = sys._current_frames()
+            with self._lock:
+                self._samples += 1
+                for tid, frame in frames.items():
+                    if tid == own:
+                        continue
+                    code = frame.f_code
+                    self._counts[(code.co_filename, code.co_name)] += 1
+            if time.monotonic() >= next_report:
+                self.report_fn(self.report())
+                next_report = time.monotonic() + self.report_interval_s
+
+    def report(self) -> str:
+        with self._lock:
+            total = self._samples or 1
+            top = self._counts.most_common(self.top_k)
+        lines = [f"profiler: {self._samples} samples"]
+        for (fname, func), n in top:
+            short = fname.rsplit("/", 1)[-1]
+            lines.append(f"  {100.0 * n / total:5.1f}% {short}:{func}")
+        return "\n".join(lines)
+
+    def snapshot(self) -> Mapping:
+        with self._lock:
+            return dict(self._counts)
