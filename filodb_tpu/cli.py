@@ -34,6 +34,9 @@ def _http_get(server: str, path: str, params: dict | None = None) -> dict:
         except Exception:  # non-JSON error body
             return {"status": "error", "errorType": "http",
                     "error": f"HTTP {e.code}"}
+    except urllib.error.URLError as e:  # connection refused, DNS, timeout
+        return {"status": "error", "errorType": "connection",
+                "error": f"cannot reach {server}: {e.reason}"}
 
 
 def cmd_query(args) -> int:
@@ -56,6 +59,9 @@ def cmd_instant_query(args) -> int:
 def cmd_labelvalues(args) -> int:
     path = f"/promql/{args.dataset}/api/v1/label/{args.label}/values"
     body = _http_get(args.server, path)
+    if body.get("status") != "success":
+        print(json.dumps(body, indent=2))
+        return 1
     for v in body.get("data", []):
         print(v)
     return 0
@@ -73,6 +79,9 @@ def cmd_timeseries_metadata(args) -> int:
 
 def cmd_status(args) -> int:
     body = _http_get(args.server, f"/api/v1/cluster/{args.dataset}/status")
+    if body.get("status") != "success":
+        print(json.dumps(body, indent=2))
+        return 1
     print(json.dumps(body.get("data", []), indent=2))
     return 0
 

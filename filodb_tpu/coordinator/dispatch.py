@@ -1,0 +1,87 @@
+"""Cross-node plan dispatch over HTTP.
+
+Capability match for the reference's ActorPlanDispatcher (reference:
+exec/PlanDispatcher.scala:29-46 — Akka ask of a Kryo-serialized ExecPlan
+to the shard's owning node; remote QueryActor executes and replies with
+a QueryResult; SURVEY.md §3.1 'PROCESS BOUNDARY').  Here the transport
+is HTTP POST /execplan with the JSON wire format
+(filodb_tpu/query/wire.py); the receiving node executes against its own
+memstore and returns the serialized result.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Callable, Optional
+
+from filodb_tpu.query.exec import ExecContext, PlanDispatcher
+from filodb_tpu.query.model import QueryError, QueryResult
+from filodb_tpu.query.wire import (deserialize_plan, deserialize_result,
+                                   serialize_plan, serialize_result)
+
+
+class HttpPlanDispatcher(PlanDispatcher):
+    """Ships a leaf plan to ``endpoint`` and returns its result."""
+
+    def __init__(self, endpoint: str, timeout_s: float = 60.0):
+        self.endpoint = endpoint.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def dispatch(self, plan, ctx: ExecContext) -> QueryResult:
+        body = json.dumps(serialize_plan(plan)).encode()
+        req = urllib.request.Request(
+            f"{self.endpoint}/execplan", data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                payload = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                err = json.loads(e.read()).get("error", "")
+            except Exception:
+                err = f"HTTP {e.code}"
+            raise QueryError(plan.query_context.query_id,
+                             f"remote dispatch to {self.endpoint} failed: "
+                             f"{err}") from e
+        return deserialize_result(payload)
+
+    def __repr__(self) -> str:
+        return f"HttpPlanDispatcher({self.endpoint})"
+
+
+def execplan_handler(memstore) -> Callable[[dict], dict]:
+    """Server side: wire dict -> execute locally -> wire result.
+    Transformers run here too (shard-local map/window work stays on the
+    data node, as in the reference's remote QueryActor)."""
+
+    def handle(payload: dict) -> dict:
+        plan = deserialize_plan(payload)
+        ctx = ExecContext(memstore, plan.query_context)
+        result = plan.execute(ctx)
+        return serialize_result(result)
+
+    return handle
+
+
+def dispatcher_factory(mapper, endpoints: dict[str, str],
+                       local_node: Optional[str] = None
+                       ) -> Callable[[int], PlanDispatcher]:
+    """shard -> dispatcher, from the ShardMapper's owner and a node ->
+    endpoint map (the plug for SingleClusterPlanner.dispatcher_for_shard).
+    Shards owned by ``local_node`` (or by unknown nodes) execute
+    in-process."""
+    from filodb_tpu.query.exec import IN_PROCESS
+
+    cache: dict[str, HttpPlanDispatcher] = {}
+
+    def for_shard(shard: int) -> PlanDispatcher:
+        node = mapper.coord_for_shard(shard)
+        if node is None or node == local_node or node not in endpoints:
+            return IN_PROCESS
+        d = cache.get(node)
+        if d is None:
+            d = cache[node] = HttpPlanDispatcher(endpoints[node])
+        return d
+
+    return for_shard

@@ -53,7 +53,9 @@ class SingleClusterPlanner(QueryPlanner):
                  spread_provider: Optional[Callable[[dict], int]] = None,
                  dispatcher_for_shard: Optional[
                      Callable[[int], PlanDispatcher]] = None,
-                 hierarchical_reduce_at: int = 16):
+                 hierarchical_reduce_at: int = 16,
+                 min_time_range_for_split_ms: Optional[int] = None,
+                 split_size_ms: Optional[int] = None):
         self.dataset = dataset
         self.mapper = shard_mapper
         self.options = options or DatasetOptions()
@@ -61,6 +63,10 @@ class SingleClusterPlanner(QueryPlanner):
         self.spread_provider = spread_provider
         self.dispatcher_for_shard = dispatcher_for_shard or (lambda s: IN_PROCESS)
         self.hierarchical_reduce_at = hierarchical_reduce_at
+        # time splitting (reference: SingleClusterPlanner.scala:61-104 —
+        # long queries split into sub-ranges and stitched)
+        self.min_time_range_for_split_ms = min_time_range_for_split_ms
+        self.split_size_ms = split_size_ms or min_time_range_for_split_ms
 
     # -- shard pruning (reference :106-136) ---------------------------------
 
@@ -110,7 +116,41 @@ class SingleClusterPlanner(QueryPlanner):
 
     def materialize(self, plan, qctx=None) -> ExecPlan:
         qctx = qctx or QueryContext()
+        split = self._maybe_time_split(plan, qctx)
+        if split is not None:
+            return split
         return self._walk(plan, qctx)
+
+    def _maybe_time_split(self, plan, qctx) -> Optional[ExecPlan]:
+        """Split a long periodic query into sequential step-aligned
+        sub-ranges and stitch (reference: time-splitting
+        SingleClusterPlanner.scala:61-104 +
+        SplitLocalPartitionDistConcatExec; sub-plans run sequentially —
+        parallel_children=False — to bound peak memory)."""
+        if self.min_time_range_for_split_ms is None:
+            return None
+        if not isinstance(plan, lp.PeriodicSeriesPlan):
+            return None
+        try:
+            start, step, end = lp.time_range(plan)
+        except ValueError:
+            return None
+        if end - start < self.min_time_range_for_split_ms:
+            return None
+        from filodb_tpu.coordinator.planners import copy_with_time_range
+        from filodb_tpu.query.exec import StitchRvsExec
+        steps_per_split = max(self.split_size_ms // step, 1)
+        children = []
+        t = start
+        while t <= end:
+            sub_end = min(t + (steps_per_split - 1) * step, end)
+            children.append(self._walk(
+                copy_with_time_range(plan, t, sub_end), qctx))
+            t = sub_end + step
+        if len(children) == 1:
+            return children[0]
+        # sequential sub-plans, like the reference's split path
+        return StitchRvsExec(children, qctx, parallel_children=False)
 
     def _walk(self, plan, qctx) -> ExecPlan:
         if isinstance(plan, lp.PeriodicSeries):

@@ -89,18 +89,24 @@ class FiloHttpServer:
     def _handle(self, req: BaseHTTPRequestHandler, method: str) -> None:
         if req.path.split("?")[0] == "/metrics":
             # plain-text route handled entirely outside the JSON error
-            # epilogue: a mid-write disconnect must not trigger a second
-            # send_response on the same socket
+            # epilogue; generation errors become a 500, write errors on a
+            # dead socket are swallowed (no second send_response)
             try:
                 from filodb_tpu.utils.observability import REGISTRY
-                text = REGISTRY.expose_text().encode()
-                req.send_response(200)
+                code, text = 200, REGISTRY.expose_text().encode()
+            except Exception as e:  # noqa: BLE001 — bad reporter/gauge fn
+                code, text = 500, f"metrics exposition failed: {e}\n".encode()
+            try:
+                req.send_response(code)
                 req.send_header("Content-Type", "text/plain; version=0.0.4")
                 req.send_header("Content-Length", str(len(text)))
                 req.end_headers()
                 req.wfile.write(text)
             except Exception:  # noqa: BLE001 — socket already unusable
                 pass
+            return
+        if req.path.split("?")[0] == "/execplan" and method == "POST":
+            self._handle_execplan(req)
             return
         try:
             parsed = urllib.parse.urlparse(req.path)
@@ -133,6 +139,34 @@ class FiloHttpServer:
         req.send_header("Content-Length", str(len(data)))
         req.end_headers()
         req.wfile.write(data)
+
+    def _handle_execplan(self, req: BaseHTTPRequestHandler) -> None:
+        """Cross-node dispatch receiver (reference: remote QueryActor
+        executing a serialized ExecPlan, QueryActor.scala:220)."""
+        try:
+            ln = int(req.headers.get("Content-Length") or 0)
+            payload = json.loads(req.rfile.read(ln))
+            binding = self.datasets.get(payload.get("dataset"))
+            if binding is None:
+                code, out = 404, error_response(
+                    "bad_data", f"unknown dataset {payload.get('dataset')}")
+            else:
+                from filodb_tpu.coordinator.dispatch import execplan_handler
+                out = execplan_handler(binding.memstore)(payload)
+                code = 200
+        except QueryError as e:
+            code, out = 400, error_response("bad_data", str(e))
+        except Exception as e:  # noqa: BLE001
+            code, out = 500, error_response("internal", str(e))
+        data = json.dumps(out).encode()
+        try:
+            req.send_response(code)
+            req.send_header("Content-Type", "application/json")
+            req.send_header("Content-Length", str(len(data)))
+            req.end_headers()
+            req.wfile.write(data)
+        except Exception:  # noqa: BLE001 — client went away
+            pass
 
     def _route(self, path: str, params: dict,
                multi: Optional[dict] = None) -> tuple[int, dict]:

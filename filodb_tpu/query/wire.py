@@ -1,0 +1,266 @@
+"""Wire format for cross-node query dispatch.
+
+Capability match for the reference's Kryo-serialized plan/result
+transport (reference: coordinator/.../client/Serializer.scala:165,
+FiloKryoSerializers.scala — ExecPlan subtrees travel to the node owning
+the shard, QueryResult(SerializedRangeVector) travels back;
+PlanDispatcher.scala:29-46).  JSON envelopes with base64-npy arrays
+replace Kryo: leaf scan plans and their transformer stacks are rebuilt
+from a class registry on the receiving node; batches round-trip
+losslessly (ndarray bit-exact via .npy bytes).
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import enum
+import io
+from typing import Optional
+
+import numpy as np
+
+from filodb_tpu.core import filters as flt
+from filodb_tpu.ops.windows import StepRange
+from filodb_tpu.query import transformers as tf
+from filodb_tpu.query.aggregators import AggPartialBatch
+from filodb_tpu.query.exec import MultiSchemaPartitionsExec
+from filodb_tpu.query.logical import (AggregationOperator, InstantFunctionId,
+                                      MiscellaneousFunctionId,
+                                      RangeFunctionId, SortFunctionId,
+                                      VectorFunctionId, BinaryOperator)
+from filodb_tpu.query.model import (PeriodicBatch, QueryContext, QueryResult,
+                                    QueryStats, RawBatch, ScalarResult)
+
+
+class WireError(ValueError):
+    """Plan/result not expressible on the wire (e.g. exec-plan scalar
+    args inside a transformer — the reference serializes those too; here
+    they must be resolved before dispatch)."""
+
+
+# ---------------------------------------------------------------------------
+# ndarray <-> base64 .npy
+# ---------------------------------------------------------------------------
+
+
+def _enc_array(a: Optional[np.ndarray]):
+    if a is None:
+        return None
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(a), allow_pickle=False)
+    return base64.b64encode(buf.getvalue()).decode()
+
+
+def _dec_array(s) -> Optional[np.ndarray]:
+    if s is None:
+        return None
+    return np.load(io.BytesIO(base64.b64decode(s)), allow_pickle=False)
+
+
+# ---------------------------------------------------------------------------
+# Filters
+# ---------------------------------------------------------------------------
+
+_FILTERS = {c.__name__: c for c in
+            (flt.Equals, flt.NotEquals, flt.EqualsRegex, flt.NotEqualsRegex,
+             getattr(flt, "In", None)) if c is not None}
+
+
+def _enc_filter(f: flt.ColumnFilter) -> dict:
+    inner = f.filter
+    d = {"column": f.column, "kind": type(inner).__name__}
+    for field in dataclasses.fields(inner):
+        v = getattr(inner, field.name)
+        d[field.name] = sorted(v) if isinstance(v, (set, frozenset)) else v
+    return d
+
+
+def _dec_filter(d: dict) -> flt.ColumnFilter:
+    cls = _FILTERS.get(d["kind"])
+    if cls is None:
+        raise WireError(f"unknown filter kind {d['kind']}")
+    kwargs = {f.name: d[f.name] for f in dataclasses.fields(cls)}
+    if "values" in kwargs and isinstance(kwargs["values"], list):
+        kwargs["values"] = frozenset(kwargs["values"])
+    return flt.ColumnFilter(d["column"], cls(**kwargs))
+
+
+# ---------------------------------------------------------------------------
+# Transformers (generic dataclass serde over a registry)
+# ---------------------------------------------------------------------------
+
+_TRANSFORMERS = {c.__name__: c for c in (
+    tf.PeriodicSamplesMapper, tf.InstantVectorFunctionMapper,
+    tf.ScalarOperationMapper, tf.AggregateMapReduce, tf.AggregatePresenter,
+    tf.MiscellaneousFunctionMapper, tf.SortFunctionMapper,
+    tf.AbsentFunctionMapper, tf.HistogramQuantileMapper, tf.StitchRvsMapper,
+    tf.VectorFunctionMapper)}
+
+_ENUMS = {c.__name__: c for c in (
+    AggregationOperator, RangeFunctionId, InstantFunctionId,
+    MiscellaneousFunctionId, SortFunctionId, VectorFunctionId,
+    BinaryOperator)}
+
+
+def _enc_value(v):
+    if isinstance(v, enum.Enum):
+        return {"__enum__": type(v).__name__, "name": v.name}
+    if isinstance(v, (tuple, list)):
+        return {"__seq__": [_enc_value(x) for x in v]}
+    if isinstance(v, flt.ColumnFilter):
+        return {"__filter__": _enc_filter(v)}
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    raise WireError(f"cannot serialize transformer field value {v!r}")
+
+
+def _dec_value(v):
+    if isinstance(v, dict):
+        if "__enum__" in v:
+            return _ENUMS[v["__enum__"]][v["name"]]
+        if "__seq__" in v:
+            return tuple(_dec_value(x) for x in v["__seq__"])
+        if "__filter__" in v:
+            return _dec_filter(v["__filter__"])
+    return v
+
+
+def _enc_transformer(t) -> dict:
+    name = type(t).__name__
+    if name not in _TRANSFORMERS:
+        raise WireError(f"transformer {name} is not wire-serializable")
+    d = {"type": name}
+    for field in dataclasses.fields(t):
+        d[field.name] = _enc_value(getattr(t, field.name))
+    return d
+
+
+def _dec_transformer(d: dict):
+    cls = _TRANSFORMERS[d["type"]]
+    kwargs = {f.name: _dec_value(d[f.name])
+              for f in dataclasses.fields(cls) if f.name in d}
+    return cls(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Leaf plans
+# ---------------------------------------------------------------------------
+
+
+def serialize_plan(plan: MultiSchemaPartitionsExec) -> dict:
+    """Leaf scan + transformer stack -> wire dict.  Only leaves travel:
+    the scatter-gather tree's non-leaf composition always runs on the
+    query entry node, exactly like the reference (SURVEY.md §3.1)."""
+    if not isinstance(plan, MultiSchemaPartitionsExec):
+        raise WireError(f"only leaf scans dispatch remotely, "
+                        f"got {type(plan).__name__}")
+    return {
+        "type": "MultiSchemaPartitionsExec",
+        "dataset": plan.dataset,
+        "shard": plan.shard,
+        "filters": [_enc_filter(f) for f in plan.filters],
+        "start_ms": plan.start_ms,
+        "end_ms": plan.end_ms,
+        "column": plan.column,
+        "transformers": [_enc_transformer(t) for t in plan.transformers],
+        "query_id": plan.query_context.query_id,
+        "sample_limit": plan.query_context.sample_limit,
+    }
+
+
+def deserialize_plan(d: dict) -> MultiSchemaPartitionsExec:
+    if d.get("type") != "MultiSchemaPartitionsExec":
+        raise WireError(f"unknown plan type {d.get('type')}")
+    qctx = QueryContext(query_id=d.get("query_id", ""),
+                        sample_limit=d.get("sample_limit", 1_000_000))
+    plan = MultiSchemaPartitionsExec(
+        d["dataset"], d["shard"], [_dec_filter(f) for f in d["filters"]],
+        d["start_ms"], d["end_ms"], d.get("column"), qctx)
+    for t in d.get("transformers", ()):
+        plan.add_transformer(_dec_transformer(t))
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# Results
+# ---------------------------------------------------------------------------
+
+
+def _enc_steps(s: StepRange) -> list:
+    return [s.start, s.end, s.step]
+
+
+def _dec_steps(v) -> StepRange:
+    return StepRange(*v)
+
+
+def serialize_result(result: QueryResult) -> dict:
+    batches = []
+    for b in result.batches:
+        if isinstance(b, PeriodicBatch):
+            batches.append({
+                "type": "PeriodicBatch", "keys": b.keys,
+                "steps": _enc_steps(b.steps),
+                "values": _enc_array(b.values),
+                "hist": _enc_array(b.hist),
+                "bucket_tops": _enc_array(b.bucket_tops)})
+        elif isinstance(b, AggPartialBatch):
+            batches.append({
+                "type": "AggPartialBatch", "op": b.op.name,
+                "params": list(b.params), "group_keys": b.group_keys,
+                "steps": _enc_steps(b.steps),
+                "state": {k: _enc_array(v) for k, v in b.state.items()},
+                "series_keys": b.series_keys})
+        elif isinstance(b, ScalarResult):
+            batches.append({"type": "ScalarResult",
+                            "steps": _enc_steps(b.steps),
+                            "values": _enc_array(b.values)})
+        elif isinstance(b, RawBatch):
+            cb = b.batch
+            batches.append({
+                "type": "RawBatch", "keys": b.keys,
+                "timestamps": _enc_array(cb.timestamps if cb else None),
+                "values": _enc_array(cb.values if cb else None),
+                "row_counts": _enc_array(cb.row_counts if cb else None),
+                "hist": _enc_array(cb.hist if cb else None),
+                "bucket_tops": _enc_array(cb.bucket_tops if cb else None)})
+        else:
+            raise WireError(f"cannot serialize batch {type(b).__name__}")
+    return {"query_id": result.query_id, "batches": batches,
+            "stats": {"series_scanned": result.stats.series_scanned}}
+
+
+def deserialize_result(d: dict) -> QueryResult:
+    batches = []
+    for b in d.get("batches", ()):
+        kind = b["type"]
+        if kind == "PeriodicBatch":
+            batches.append(PeriodicBatch(
+                b["keys"], _dec_steps(b["steps"]), _dec_array(b["values"]),
+                hist=_dec_array(b.get("hist")),
+                bucket_tops=_dec_array(b.get("bucket_tops"))))
+        elif kind == "AggPartialBatch":
+            batches.append(AggPartialBatch(
+                AggregationOperator[b["op"]], tuple(b["params"]),
+                b["group_keys"], _dec_steps(b["steps"]),
+                {k: _dec_array(v) for k, v in b["state"].items()},
+                series_keys=b.get("series_keys")))
+        elif kind == "ScalarResult":
+            batches.append(ScalarResult(_dec_steps(b["steps"]),
+                                        _dec_array(b["values"])))
+        elif kind == "RawBatch":
+            from filodb_tpu.core.chunk import ChunkBatch
+            ts = _dec_array(b.get("timestamps"))
+            cb = None
+            if ts is not None:
+                cb = ChunkBatch(ts, _dec_array(b["values"]),
+                                _dec_array(b["row_counts"]),
+                                hist=_dec_array(b.get("hist")),
+                                bucket_tops=_dec_array(b.get("bucket_tops")))
+            batches.append(RawBatch(b["keys"], cb))
+        else:
+            raise WireError(f"unknown batch type {kind}")
+    stats = QueryStats(series_scanned=d.get("stats", {})
+                       .get("series_scanned", 0))
+    return QueryResult(d.get("query_id", ""), batches, stats)

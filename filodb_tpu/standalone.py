@@ -66,6 +66,7 @@ class FiloServer:
                                    shard_manager=self.manager)
         self.gateways: list[GatewayServer] = []
         self.profiler: Optional[SimpleProfiler] = None
+        self._global_gateway_claimed = False
         self._started = threading.Event()
 
     def start(self) -> int:
@@ -102,12 +103,25 @@ class FiloServer:
         ic.resync(shards)
 
         mapper = self.manager.mapper(name)
+        # peers: node -> http endpoint; shards owned by peers dispatch
+        # remotely (reference: ActorPlanDispatcher per shard owner)
+        peers = self.config.get("peers", {})
+        disp = None
+        if peers:
+            from filodb_tpu.coordinator.dispatch import dispatcher_factory
+            disp = dispatcher_factory(mapper, peers, local_node=self.node)
         planner = SingleClusterPlanner(name, mapper, DatasetOptions(),
-                                       spread_default=spread)
+                                       spread_default=spread,
+                                       dispatcher_for_shard=disp)
         self.http.bind_dataset(DatasetBinding(name, self.memstore, planner))
 
-        gw_port = ds_conf.get("gateway-port",
-                              self.config.get("gateway-port"))
+        gw_port = ds_conf.get("gateway-port")
+        if gw_port is None and not self._global_gateway_claimed:
+            # the top-level port can serve exactly one dataset; additional
+            # datasets need their own gateway-port
+            gw_port = self.config.get("gateway-port")
+            if gw_port is not None:
+                self._global_gateway_claimed = True
         if gw_port is not None:
             schema = DEFAULT_SCHEMAS[ds_conf.get("schema", "gauge")]
             pub = ShardingPublisher(

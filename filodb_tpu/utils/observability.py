@@ -139,6 +139,11 @@ def _fmt_labels(key: tuple) -> str:
 
 
 def _fmt_val(v: float) -> str:
+    import math
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
     return str(int(v)) if v == int(v) else repr(v)
 
 

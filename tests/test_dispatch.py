@@ -1,0 +1,194 @@
+"""Cross-node query dispatch: wire serde + HTTP scatter-gather.
+
+Mirrors the reference's serialization round-trip spec and multi-node
+query behavior (reference: coordinator/src/test/.../client/
+SerializationSpec.scala; multi-jvm cluster query specs) with two real
+in-process nodes connected over HTTP sockets."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.dispatch import (HttpPlanDispatcher,
+                                             dispatcher_factory)
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.core.filters import ColumnFilter, Equals, EqualsRegex
+from filodb_tpu.core.record import RecordBuilder, decode_container
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+from filodb_tpu.http.server import DatasetBinding, FiloHttpServer
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.ops.windows import StepRange
+from filodb_tpu.parallel.shardmap import ShardMapper, ShardStatus
+from filodb_tpu.promql.parser import query_range_to_logical_plan
+from filodb_tpu.query import wire
+from filodb_tpu.query.exec import ExecContext, MultiSchemaPartitionsExec
+from filodb_tpu.query.logical import AggregationOperator, RangeFunctionId
+from filodb_tpu.query.model import (PeriodicBatch, QueryContext, QueryResult,
+                                    RawBatch)
+from filodb_tpu.query.transformers import (AggregateMapReduce,
+                                           PeriodicSamplesMapper)
+
+BASE = 1_700_000_000_000
+STEP = 10_000
+
+
+class TestWireSerde:
+    def test_plan_roundtrip(self):
+        plan = MultiSchemaPartitionsExec(
+            "prom", 3,
+            [ColumnFilter("_metric_", Equals("m")),
+             ColumnFilter("host", EqualsRegex("h.*"))],
+            BASE, BASE + 600_000, column="count")
+        plan.add_transformer(PeriodicSamplesMapper(
+            BASE, STEP, BASE + 600_000, window_ms=300_000,
+            function=RangeFunctionId.RATE))
+        plan.add_transformer(AggregateMapReduce(
+            AggregationOperator.SUM, by=("job",)))
+        d = wire.serialize_plan(plan)
+        import json
+        d = json.loads(json.dumps(d))  # must survive real JSON
+        plan2 = wire.deserialize_plan(d)
+        assert plan2.dataset == "prom" and plan2.shard == 3
+        assert plan2.column == "count"
+        assert plan2.filters[1].filter.pattern == "h.*"
+        assert isinstance(plan2.transformers[0], PeriodicSamplesMapper)
+        assert plan2.transformers[0].function == RangeFunctionId.RATE
+        assert plan2.transformers[1].by == ("job",)
+
+    def test_result_roundtrip_bit_exact(self):
+        rng = np.random.default_rng(0)
+        vals = rng.random((3, 10))
+        vals[0, 2] = np.nan
+        b = PeriodicBatch([{"a": "1"}, {"a": "2"}, {"a": "3"}],
+                          StepRange(BASE, BASE + 9 * STEP, STEP), vals)
+        res = QueryResult("q1", [b])
+        import json
+        d = json.loads(json.dumps(wire.serialize_result(res)))
+        res2 = wire.deserialize_result(d)
+        b2 = res2.batches[0]
+        np.testing.assert_array_equal(
+            np.asarray(b2.values).view(np.uint64),
+            vals.view(np.uint64))  # bit-exact incl. NaN
+        assert b2.keys == b.keys
+        assert b2.steps == b.steps
+
+    def test_rawbatch_roundtrip(self):
+        from filodb_tpu.core.chunk import build_batch
+        ts = [np.sort(np.random.default_rng(0).integers(0, 10**6, 20))
+              .astype(np.int64) for _ in range(2)]
+        vs = [np.random.default_rng(1).random(20) for _ in range(2)]
+        batch = build_batch(ts, vs)
+        res = QueryResult("q", [RawBatch([{"i": "0"}, {"i": "1"}], batch)])
+        res2 = wire.deserialize_result(wire.serialize_result(res))
+        b2 = res2.batches[0].batch
+        np.testing.assert_array_equal(np.asarray(b2.timestamps),
+                                      np.asarray(batch.timestamps))
+        np.testing.assert_array_equal(np.asarray(b2.row_counts),
+                                      np.asarray(batch.row_counts))
+
+    def test_unserializable_plan_raises(self):
+        from filodb_tpu.query.exec import EmptyResultExec
+        with pytest.raises(wire.WireError):
+            wire.serialize_plan(EmptyResultExec())
+
+
+def _two_node_cluster():
+    """Two memstores, each owning half the shards; node-b is served over a
+    live HTTP socket and node-a's planner dispatches there."""
+    num_shards = 4
+    mapper = ShardMapper(num_shards)
+
+    # route records first so node assignment can split the two shards the
+    # shard key actually fans out to (spread=1 -> exactly 2 shards)
+    rng = np.random.default_rng(5)
+    b = RecordBuilder(DEFAULT_SCHEMAS["prom-counter"])
+    n_series = 8
+    for i in range(n_series):
+        tags = {"__name__": "dist_total", "instance": f"i{i}",
+                "_ws_": "demo", "_ns_": "App-0"}
+        ts = BASE + np.arange(300) * STEP
+        vals = np.cumsum(rng.random(300))
+        for t, v in zip(ts, vals):
+            b.add(int(t), [float(v)], tags)
+    by_shard = {}
+    for off, c in enumerate(b.containers()):
+        for rec in decode_container(c, DEFAULT_SCHEMAS):
+            shard = mapper.ingestion_shard(rec.shard_hash, rec.part_hash, 1) \
+                % num_shards
+            by_shard.setdefault(shard, []).append((off, rec))
+    used = sorted(by_shard)
+    assert len(used) == 2, used
+    shards_a = [used[0]] + [s for s in range(num_shards) if s not in used]
+    shards_b = [used[1]]
+    mapper.register_node(shards_a, "node-a")
+    mapper.register_node(shards_b, "node-b")
+    for s in range(num_shards):
+        mapper.update_status(s, ShardStatus.ACTIVE)
+
+    stores = {"node-a": TimeSeriesMemStore(), "node-b": TimeSeriesMemStore()}
+    for ms in stores.values():
+        for s in range(num_shards):
+            ms.setup("prom", DEFAULT_SCHEMAS, s)
+    placed = {"node-a": 0, "node-b": 0}
+    for shard, recs in by_shard.items():
+        node = mapper.coord_for_shard(shard)
+        for off, rec in recs:
+            stores[node].get_shard("prom", shard).ingest([rec], off)
+            placed[node] += 1
+    assert placed["node-a"] and placed["node-b"], placed
+
+    srv_b = FiloHttpServer()
+    planner_b = SingleClusterPlanner("prom", mapper, DatasetOptions(),
+                                     spread_default=1)
+    srv_b.bind_dataset(DatasetBinding("prom", stores["node-b"], planner_b))
+    port_b = srv_b.start()
+
+    endpoints = {"node-b": f"http://127.0.0.1:{port_b}"}
+    disp = dispatcher_factory(mapper, endpoints, local_node="node-a")
+    planner_a = SingleClusterPlanner("prom", mapper, DatasetOptions(),
+                                     spread_default=1,
+                                     dispatcher_for_shard=disp)
+    return stores, mapper, planner_a, srv_b
+
+
+class TestCrossNodeDispatch:
+    def test_scatter_gather_across_nodes(self):
+        stores, mapper, planner_a, srv_b = _two_node_cluster()
+        try:
+            plan = query_range_to_logical_plan(
+                'sum(rate(dist_total{_ws_="demo",_ns_="App-0"}[2m]))',
+                BASE + 600_000, STEP, BASE + 1_200_000)
+            ep = planner_a.materialize(plan)
+            tree = ep.print_tree()
+            res = ep.execute(ExecContext(stores["node-a"], QueryContext()))
+            assert res.num_series == 1
+            vals = np.asarray(res.batches[0].np_values())[0]
+            assert np.isfinite(vals).all()
+            # the result must cover ALL series incl. node-b's: a raw
+            # selector through the same dispatchers returns every series
+            raw_plan = query_range_to_logical_plan(
+                'dist_total{_ws_="demo",_ns_="App-0"}',
+                BASE + 600_000, STEP, BASE + 1_200_000)
+            raw_ep = planner_a.materialize(raw_plan)
+            raw_res = raw_ep.execute(ExecContext(stores["node-a"],
+                                                 QueryContext()))
+            assert raw_res.num_series == 8
+        finally:
+            srv_b.shutdown()
+
+    def test_remote_error_surfaces_as_query_error(self):
+        from filodb_tpu.query.model import QueryError
+        d = HttpPlanDispatcher("http://127.0.0.1:9")  # nothing listening
+        plan = MultiSchemaPartitionsExec("prom", 0, [], 0, 1)
+        with pytest.raises((QueryError, OSError)):
+            d.dispatch(plan, ExecContext(TimeSeriesMemStore(),
+                                         QueryContext()))
+
+    def test_dispatcher_factory_local_vs_remote(self):
+        from filodb_tpu.query.exec import IN_PROCESS
+        mapper = ShardMapper(4)
+        mapper.register_node([0, 1], "a")
+        mapper.register_node([2, 3], "b")
+        f = dispatcher_factory(mapper, {"b": "http://x:1"}, local_node="a")
+        assert f(0) is IN_PROCESS
+        assert isinstance(f(2), HttpPlanDispatcher)
+        assert f(2) is f(3)  # cached per node
