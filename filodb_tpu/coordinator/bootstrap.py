@@ -1,0 +1,120 @@
+"""Cluster bootstrap: seed discovery + join.
+
+Capability match for the reference's akka-bootstrapper (reference:
+akka-bootstrapper/src/main/scala/.../AkkaBootstrapper.scala:31 —
+bootstrap() discovers seeds then joins the cluster;
+ExplicitListClusterSeedDiscovery.scala:18 and
+DnsSrvClusterSeedDiscovery.scala:12 strategies).  Discovery yields peer
+HTTP endpoints; joining = heartbeating the local node into the
+FailureDetector and probing peers' /__health so live peers register too.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import urllib.request
+from typing import Optional, Sequence
+
+from filodb_tpu.coordinator.cluster import FailureDetector
+
+
+class SeedDiscovery:
+    def discover(self) -> list[str]:
+        """Returns peer endpoints, e.g. ['http://host:8080', ...]."""
+        raise NotImplementedError
+
+
+class ExplicitListSeedDiscovery(SeedDiscovery):
+    """Static seed list (reference: ExplicitListClusterSeedDiscovery)."""
+
+    def __init__(self, seeds: Sequence[str]):
+        self.seeds = list(seeds)
+
+    def discover(self) -> list[str]:
+        return list(self.seeds)
+
+
+class DnsSeedDiscovery(SeedDiscovery):
+    """Resolve one DNS name to its A records (headless-service style;
+    reference: DnsSrvClusterSeedDiscovery — SRV lookups need a resolver
+    lib, A-record fan-out covers the k8s headless-service case)."""
+
+    def __init__(self, hostname: str, port: int, scheme: str = "http"):
+        self.hostname = hostname
+        self.port = port
+        self.scheme = scheme
+
+    def discover(self) -> list[str]:
+        try:
+            infos = socket.getaddrinfo(self.hostname, self.port,
+                                       type=socket.SOCK_STREAM)
+        except socket.gaierror:
+            return []
+        addrs = sorted({i[4][0] for i in infos})
+        return [f"{self.scheme}://{a}:{self.port}" for a in addrs]
+
+
+class ClusterBootstrap:
+    """Join protocol: register self, probe discovered peers, keep
+    heartbeating them while they answer /__health (reference:
+    AkkaBootstrapper.bootstrap + Akka gossip keeping membership fresh)."""
+
+    def __init__(self, node: str, detector: FailureDetector,
+                 discovery: SeedDiscovery, probe_timeout_s: float = 5.0):
+        self.node = node
+        self.detector = detector
+        self.discovery = discovery
+        self.probe_timeout_s = probe_timeout_s
+        self.peers: dict[str, str] = {}  # node name -> endpoint
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def probe(self, endpoint: str) -> Optional[str]:
+        """Health-check a peer; returns its node name if alive."""
+        try:
+            with urllib.request.urlopen(f"{endpoint}/__health",
+                                        timeout=self.probe_timeout_s) as r:
+                body = json.loads(r.read())
+        except Exception:  # noqa: BLE001 — dead peer is a normal outcome
+            return None
+        # prefer the explicit node name; fall back to shard-status owners.
+        # NEVER invent a name (an endpoint-as-name would register a phantom
+        # node the shard manager could assign work to)
+        if body.get("node"):
+            return body["node"]
+        for statuses in body.get("shards", {}).values():
+            for st in statuses:
+                if st.get("node"):
+                    return st["node"]
+        return None
+
+    def bootstrap(self) -> list[str]:
+        """One discovery+join round; returns peers found alive."""
+        self.detector.heartbeat(self.node)
+        alive = []
+        for endpoint in self.discovery.discover():
+            name = self.probe(endpoint)
+            if name is not None and name != self.node:
+                self.peers[name] = endpoint
+                self.detector.heartbeat(name)
+                alive.append(name)
+        return alive
+
+    def start_background(self, interval_s: float = 5.0) -> None:
+        """Keep membership fresh: re-probe peers and sweep the failure
+        detector on an interval."""
+        def loop():
+            while not self._stop.wait(interval_s):
+                self.bootstrap()
+                self.detector.check()
+        self._thread = threading.Thread(target=loop, name="bootstrap",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

@@ -77,11 +77,29 @@ def dispatcher_factory(mapper, endpoints: dict[str, str],
 
     def for_shard(shard: int) -> PlanDispatcher:
         node = mapper.coord_for_shard(shard)
-        if node is None or node == local_node or node not in endpoints:
+        if node is None or node == local_node:
             return IN_PROCESS
+        endpoint = endpoints.get(node)
+        if endpoint is None:
+            # a remote-owned shard with no known endpoint must FAIL the
+            # query, not silently scan an empty local store
+            return _UnroutableDispatcher(shard, node)
         d = cache.get(node)
         if d is None:
-            d = cache[node] = HttpPlanDispatcher(endpoints[node])
+            d = cache[node] = HttpPlanDispatcher(endpoint)
         return d
 
     return for_shard
+
+
+class _UnroutableDispatcher(PlanDispatcher):
+    def __init__(self, shard: int, node: str):
+        self.shard = shard
+        self.node = node
+
+    def dispatch(self, plan, ctx) -> QueryResult:
+        raise QueryError(
+            plan.query_context.query_id,
+            f"shard {self.shard} is owned by node {self.node!r} but no "
+            f"endpoint is configured for it — refusing to return partial "
+            f"results")

@@ -78,7 +78,15 @@ class IngestionCoordinator:
                                      daemon=True)
                 self._threads[shard] = t
         if blocking:
-            self._run_shard(shard, stop)
+            # adopt the shard's ingest-thread identity for the duration so
+            # the single-writer assertions hold in blocking mode too
+            cur = threading.current_thread()
+            old_name = cur.name
+            cur.name = f"ingest-{self.dataset}-{shard}"
+            try:
+                self._run_shard(shard, stop)
+            finally:
+                cur.name = old_name
         else:
             t.start()
 
@@ -154,6 +162,13 @@ class IngestionCoordinator:
                 # never leave a stale sentinel for the next consumer
                 stream.teardown()
             sh = self.memstore.get_shard(self.dataset, shard)
+            # single-writer-per-shard tripwire (reference: FiloSchedulers
+            # assertThreadName on the ingest scheduler); installed always —
+            # the check itself no-ops unless assertions are enabled, and
+            # installing unconditionally avoids order dependence on when
+            # enable_assertions() is called
+            from filodb_tpu.utils.schedulers import ingest_check_for
+            sh.ingest_sched_check = ingest_check_for(self.dataset, shard)
 
             recovering = resume_from is not None
             if recovering:

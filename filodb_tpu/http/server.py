@@ -48,6 +48,7 @@ class FiloHttpServer:
 
     port: int = 0  # 0 = ephemeral
     host: str = "127.0.0.1"
+    node_name: Optional[str] = None  # reported in /__health for bootstrap
     shard_manager: Optional[object] = None  # coordinator.cluster.ShardManager
     datasets: dict = field(default_factory=dict)
     _httpd: Optional[ThreadingHTTPServer] = None
@@ -292,7 +293,10 @@ class FiloHttpServer:
                            for sh in b.memstore.shards(ds)]
         healthy = all(st["status"] in ("Active", "Recovery", "Assigned")
                       for sts in out.values() for st in sts) if out else True
-        return (200 if healthy else 503), {"healthy": healthy, "shards": out}
+        body = {"healthy": healthy, "shards": out}
+        if self.node_name:
+            body["node"] = self.node_name
+        return (200 if healthy else 503), body
 
     def _cluster(self, parts: list[str], params: dict) -> tuple[int, dict]:
         """/api/v1/cluster/<ds>/status|startshards|stopshards (reference:

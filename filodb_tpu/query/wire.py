@@ -24,7 +24,8 @@ from filodb_tpu.core import filters as flt
 from filodb_tpu.ops.windows import StepRange
 from filodb_tpu.query import transformers as tf
 from filodb_tpu.query.aggregators import AggPartialBatch
-from filodb_tpu.query.exec import MultiSchemaPartitionsExec
+from filodb_tpu.query.exec import (LabelValuesExec,
+                                   MultiSchemaPartitionsExec, PartKeysExec)
 from filodb_tpu.query.logical import (AggregationOperator, InstantFunctionId,
                                       MiscellaneousFunctionId,
                                       RangeFunctionId, SortFunctionId,
@@ -148,35 +149,63 @@ def _dec_transformer(d: dict):
 # ---------------------------------------------------------------------------
 
 
-def serialize_plan(plan: MultiSchemaPartitionsExec) -> dict:
-    """Leaf scan + transformer stack -> wire dict.  Only leaves travel:
+def _enc_qctx(qctx: QueryContext) -> dict:
+    """Full QueryContext travels: limits set by the caller must be
+    enforced on the data node where the work actually runs."""
+    return {f.name: getattr(qctx, f.name)
+            for f in dataclasses.fields(QueryContext)}
+
+
+def _dec_qctx(d: dict) -> QueryContext:
+    known = {f.name for f in dataclasses.fields(QueryContext)}
+    return QueryContext(**{k: v for k, v in d.items() if k in known})
+
+
+def serialize_plan(plan) -> dict:
+    """Leaf plan + transformer stack -> wire dict.  Only leaves travel:
     the scatter-gather tree's non-leaf composition always runs on the
     query entry node, exactly like the reference (SURVEY.md §3.1)."""
-    if not isinstance(plan, MultiSchemaPartitionsExec):
-        raise WireError(f"only leaf scans dispatch remotely, "
+    if not isinstance(plan, (MultiSchemaPartitionsExec, PartKeysExec,
+                             LabelValuesExec)):
+        raise WireError(f"only leaf plans dispatch remotely, "
                         f"got {type(plan).__name__}")
-    return {
-        "type": "MultiSchemaPartitionsExec",
+    base = {
         "dataset": plan.dataset,
         "shard": plan.shard,
         "filters": [_enc_filter(f) for f in plan.filters],
         "start_ms": plan.start_ms,
         "end_ms": plan.end_ms,
-        "column": plan.column,
         "transformers": [_enc_transformer(t) for t in plan.transformers],
-        "query_id": plan.query_context.query_id,
-        "sample_limit": plan.query_context.sample_limit,
+        "qctx": _enc_qctx(plan.query_context),
     }
+    if isinstance(plan, MultiSchemaPartitionsExec):
+        return {**base, "type": "MultiSchemaPartitionsExec",
+                "column": plan.column}
+    if isinstance(plan, PartKeysExec):
+        return {**base, "type": "PartKeysExec"}
+    return {**base, "type": "LabelValuesExec",
+            "label_names": list(plan.label_names)}
 
 
-def deserialize_plan(d: dict) -> MultiSchemaPartitionsExec:
-    if d.get("type") != "MultiSchemaPartitionsExec":
-        raise WireError(f"unknown plan type {d.get('type')}")
-    qctx = QueryContext(query_id=d.get("query_id", ""),
-                        sample_limit=d.get("sample_limit", 1_000_000))
-    plan = MultiSchemaPartitionsExec(
-        d["dataset"], d["shard"], [_dec_filter(f) for f in d["filters"]],
-        d["start_ms"], d["end_ms"], d.get("column"), qctx)
+def deserialize_plan(d: dict):
+    kind = d.get("type")
+    qctx = _dec_qctx(d.get("qctx", {})) if "qctx" in d else QueryContext(
+        query_id=d.get("query_id", ""),
+        sample_limit=d.get("sample_limit", 1_000_000))
+    filters = [_dec_filter(f) for f in d["filters"]]
+    if kind == "MultiSchemaPartitionsExec":
+        plan = MultiSchemaPartitionsExec(
+            d["dataset"], d["shard"], filters, d["start_ms"], d["end_ms"],
+            d.get("column"), qctx)
+    elif kind == "PartKeysExec":
+        plan = PartKeysExec(d["dataset"], d["shard"], filters,
+                            d["start_ms"], d["end_ms"], qctx)
+    elif kind == "LabelValuesExec":
+        plan = LabelValuesExec(d["dataset"], d["shard"],
+                               d.get("label_names", []), filters,
+                               d["start_ms"], d["end_ms"], qctx)
+    else:
+        raise WireError(f"unknown plan type {kind}")
     for t in d.get("transformers", ()):
         plan.add_transformer(_dec_transformer(t))
     return plan
@@ -225,6 +254,10 @@ def serialize_result(result: QueryResult) -> dict:
                 "row_counts": _enc_array(cb.row_counts if cb else None),
                 "hist": _enc_array(cb.hist if cb else None),
                 "bucket_tops": _enc_array(cb.bucket_tops if cb else None)})
+        elif isinstance(b, (list, dict)):
+            # metadata leaves (PartKeysExec/LabelValuesExec) emit plain
+            # JSON-able structures
+            batches.append({"type": "Json", "data": b})
         else:
             raise WireError(f"cannot serialize batch {type(b).__name__}")
     return {"query_id": result.query_id, "batches": batches,
@@ -249,6 +282,8 @@ def deserialize_result(d: dict) -> QueryResult:
         elif kind == "ScalarResult":
             batches.append(ScalarResult(_dec_steps(b["steps"]),
                                         _dec_array(b["values"])))
+        elif kind == "Json":
+            batches.append(b["data"])
         elif kind == "RawBatch":
             from filodb_tpu.core.chunk import ChunkBatch
             ts = _dec_array(b.get("timestamps"))

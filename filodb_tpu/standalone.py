@@ -63,6 +63,7 @@ class FiloServer:
         self.coordinator = NodeCoordinator(self.node, self.memstore)
         self.stream_factory = QueueStreamFactory()
         self.http = FiloHttpServer(port=config.get("http-port", 0),
+                                   node_name=self.node,
                                    shard_manager=self.manager)
         self.gateways: list[GatewayServer] = []
         self.profiler: Optional[SimpleProfiler] = None

@@ -71,6 +71,21 @@ class ColumnStore:
                                     start: int, end: int) -> Iterator[ChunkSet]:
         """Scan-by-ingestion-time for the batch downsampler (reference:
         getChunksByIngestionTimeRange)."""
+        for _itime, cs in self.chunksets_with_ingestion_time(dataset, shard,
+                                                             start, end):
+            yield cs
+
+    def chunksets_with_ingestion_time(self, dataset: str, shard: int,
+                                      start: int, end: int
+                                      ) -> Iterator[tuple[int, ChunkSet]]:
+        """Like chunksets_by_ingestion_time but yields (ingestion_time,
+        chunkset) so copies preserve the timeline (ChunkCopier)."""
+        raise NotImplementedError
+
+    def delete_part_keys(self, dataset: str, shard: int,
+                         partkeys: Sequence[bytes]) -> int:
+        """Delete partkeys and their chunks (reference:
+        PerShardCardinalityBuster)."""
         raise NotImplementedError
 
     def shutdown(self) -> None:
@@ -100,8 +115,11 @@ class NullColumnStore(ColumnStore):
     def scan_part_keys(self, dataset, shard):
         return iter(())
 
-    def chunksets_by_ingestion_time(self, dataset, shard, start, end):
+    def chunksets_with_ingestion_time(self, dataset, shard, start, end):
         return iter(())
+
+    def delete_part_keys(self, dataset, shard, partkeys) -> int:
+        return 0
 
 
 class InMemoryColumnStore(ColumnStore):
@@ -137,8 +155,18 @@ class InMemoryColumnStore(ColumnStore):
     def scan_part_keys(self, dataset, shard):
         yield from self._partkeys.get((dataset, shard), {}).values()
 
-    def chunksets_by_ingestion_time(self, dataset, shard, start, end):
+    def chunksets_with_ingestion_time(self, dataset, shard, start, end):
         for rows in self._chunks.get((dataset, shard), {}).values():
             for itime, cs in rows:
                 if start <= itime <= end:
-                    yield cs
+                    yield itime, cs
+
+    def delete_part_keys(self, dataset, shard, partkeys) -> int:
+        pk_store = self._partkeys.get((dataset, shard), {})
+        ch_store = self._chunks.get((dataset, shard), {})
+        n = 0
+        for pk in partkeys:
+            if pk_store.pop(pk, None) is not None:
+                n += 1
+            ch_store.pop(pk, None)
+        return n

@@ -160,16 +160,17 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
                 "FROM partkeys WHERE dataset=? AND shard=?", (dataset, shard)):
             yield PartKeyRecord(pk, st, et, shard, schema_hash=sh)
 
-    def chunksets_by_ingestion_time(self, dataset, shard, start, end
-                                    ) -> Iterator[ChunkSet]:
+    def chunksets_with_ingestion_time(self, dataset, shard, start, end
+                                      ) -> Iterator[tuple[int, ChunkSet]]:
         conn = self._conn()
-        for pk, cid, nr, st, et, sh, blob in conn.execute(
+        for pk, cid, nr, st, et, itime, sh, blob in conn.execute(
                 "SELECT partkey, chunk_id, num_rows, start_time, end_time, "
-                "schema_hash, vectors FROM chunks WHERE dataset=? AND shard=? "
+                "ingestion_time, schema_hash, vectors FROM chunks "
+                "WHERE dataset=? AND shard=? "
                 "AND ingestion_time BETWEEN ? AND ? ORDER BY partkey, chunk_id",
                 (dataset, shard, start, end)):
-            yield ChunkSet(ChunkSetInfo(cid, nr, st, et), pk,
-                           unpack_vectors(blob), schema_hash=sh)
+            yield itime, ChunkSet(ChunkSetInfo(cid, nr, st, et), pk,
+                                  unpack_vectors(blob), schema_hash=sh)
 
     def scan_bytes(self, dataset, shard, partkeys, start_time, end_time) -> int:
         """Metadata-only byte estimate: no vector blobs leave sqlite.

@@ -1,0 +1,61 @@
+"""Thread-discipline assertions.
+
+Capability match for the reference's FiloSchedulers (reference:
+core/src/main/scala/filodb.core/FiloSchedulers.scala —
+assertThreadName gated by ``filodb.scheduler.enable-assertions``, used
+pervasively to catch work running on the wrong scheduler, e.g.
+TimeSeriesShard.scala:532,757 asserting the ingest thread and
+ExecPlan.scala:109,124 asserting the query pool).  The single-writer-
+per-shard discipline (SURVEY.md §2.7 item 4) is enforced the same way:
+cheap no-ops in production, hard failures in tests/debug runs.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+INGEST_PREFIX = "ingest-"
+QUERY_PREFIX = "query-"
+
+_enabled = os.environ.get("FILODB_TPU_ASSERT_THREADS", "0") != "0"
+
+
+def enable_assertions(on: bool = True) -> None:
+    global _enabled
+    _enabled = on
+
+
+def assertions_enabled() -> bool:
+    return _enabled
+
+
+class WrongThreadError(AssertionError):
+    pass
+
+
+def assert_thread_name(prefix: str) -> None:
+    """Fail if the current thread's name doesn't carry the expected
+    prefix (reference: FiloSchedulers.assertThreadName)."""
+    if not _enabled:
+        return
+    name = threading.current_thread().name
+    if not name.startswith(prefix):
+        raise WrongThreadError(
+            f"expected a {prefix!r}* thread, but running on {name!r}")
+
+
+def ingest_check_for(dataset: str, shard: int):
+    """The hook installed as TimeSeriesShard.ingest_sched_check: ingest
+    must only run on that shard's dedicated ingest thread."""
+    expected = f"{INGEST_PREFIX}{dataset}-{shard}"
+
+    def check() -> None:
+        if not _enabled:
+            return
+        name = threading.current_thread().name
+        if name != expected:
+            raise WrongThreadError(
+                f"shard {dataset}/{shard} ingest ran on thread {name!r}, "
+                f"expected {expected!r} (single-writer-per-shard)")
+    return check

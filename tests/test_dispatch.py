@@ -192,3 +192,38 @@ class TestCrossNodeDispatch:
         assert f(0) is IN_PROCESS
         assert isinstance(f(2), HttpPlanDispatcher)
         assert f(2) is f(3)  # cached per node
+
+
+def test_unknown_owner_fails_not_partial():
+    """Regression: a remote-owned shard with no endpoint must fail the
+    query instead of silently scanning an empty local store."""
+    from filodb_tpu.query.model import QueryError
+    mapper = ShardMapper(2)
+    mapper.register_node([0], "a")
+    mapper.register_node([1], "node-unknown")
+    f = dispatcher_factory(mapper, {}, local_node="a")
+    d = f(1)
+    plan = MultiSchemaPartitionsExec("prom", 1, [], 0, 1)
+    with pytest.raises(QueryError, match="no.*endpoint"):
+        d.dispatch(plan, ExecContext(TimeSeriesMemStore(), QueryContext()))
+
+
+def test_metadata_plan_dispatches_over_wire():
+    from filodb_tpu.query.exec import LabelValuesExec, PartKeysExec
+    lv = LabelValuesExec("prom", 0, ["job"],
+                         [ColumnFilter("_metric_", Equals("m"))], 0, 100)
+    d = wire.deserialize_plan(wire.serialize_plan(lv))
+    assert isinstance(d, LabelValuesExec) and d.label_names == ["job"]
+    pk = PartKeysExec("prom", 1, [], 0, 100)
+    d2 = wire.deserialize_plan(wire.serialize_plan(pk))
+    assert isinstance(d2, PartKeysExec) and d2.shard == 1
+
+
+def test_query_context_limits_travel():
+    plan = MultiSchemaPartitionsExec(
+        "prom", 0, [], 0, 1,
+        query_context=QueryContext(group_by_cardinality_limit=7,
+                                   timeout_ms=1234))
+    d = wire.deserialize_plan(wire.serialize_plan(plan))
+    assert d.query_context.group_by_cardinality_limit == 7
+    assert d.query_context.timeout_ms == 1234

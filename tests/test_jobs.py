@@ -1,0 +1,251 @@
+"""Offline jobs, cluster bootstrap, thread-discipline assertions.
+
+Mirrors the reference's spark-jobs specs (ChunkCopier/cardbuster/
+DSIndexJob), akka-bootstrapper specs, and the FiloSchedulers assertion
+behavior."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.bootstrap import (ClusterBootstrap,
+                                              DnsSeedDiscovery,
+                                              ExplicitListSeedDiscovery)
+from filodb_tpu.coordinator.cluster import FailureDetector, ShardManager
+from filodb_tpu.coordinator.node import IngestionCoordinator
+from filodb_tpu.core.filters import ColumnFilter, Equals, EqualsRegex
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+from filodb_tpu.downsample.dsstore import ds_dataset_name
+from filodb_tpu.ingest.stream import ListStreamFactory
+from filodb_tpu.jobs import (ChunkCopier, DSIndexJob,
+                             PerShardCardinalityBuster)
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.store.persistence import DiskColumnStore
+from filodb_tpu.utils import schedulers
+
+BASE = 1_700_000_000_000
+
+
+def _seed_store(tmp_path, n_series=6, name="c.db"):
+    disk = DiskColumnStore(str(tmp_path / name))
+    ms = TimeSeriesMemStore(disk)
+    ms.setup("prom", DEFAULT_SCHEMAS, 0)
+    rng = np.random.default_rng(0)
+    b = RecordBuilder(DEFAULT_SCHEMAS["gauge"])
+    for i in range(n_series):
+        tags = {"__name__": "job_metric", "instance": f"i{i}",
+                "group": "even" if i % 2 == 0 else "odd",
+                "_ws_": "w", "_ns_": "n"}
+        ts = BASE + np.cumsum(rng.integers(5_000, 15_000, 100))
+        for t, v in zip(ts, rng.random(100)):
+            b.add(int(t), [float(v)], tags)
+    for off, c in enumerate(b.containers()):
+        ms.ingest("prom", 0, c, offset=off)
+    ms.get_shard("prom", 0).flush_all(ingestion_time=500)
+    return disk, ms
+
+
+class TestChunkCopier:
+    def test_copies_chunks_and_partkeys(self, tmp_path):
+        src, _ = _seed_store(tmp_path)
+        dst = DiskColumnStore(str(tmp_path / "target.db"))
+        copier = ChunkCopier(src, dst, "prom")
+        copied = copier.run([0], 0, 1000)
+        assert copied[0] == src.num_chunks("prom", 0)
+        assert dst.num_chunks("prom", 0) == src.num_chunks("prom", 0)
+        # partkeys traveled too: target index recovery works
+        ms2 = TimeSeriesMemStore(dst)
+        ms2.setup("prom", DEFAULT_SCHEMAS, 0)
+        assert ms2.recover_index("prom", 0) == 6
+        res = ms2.get_shard("prom", 0).lookup_partitions(
+            [ColumnFilter("_metric_", Equals("job_metric"))], 0, 2**62)
+        tags_list, batch = ms2.get_shard("prom", 0).scan_batch(
+            res.part_ids, 0, 2**62)
+        assert len(tags_list) == 6
+
+    def test_time_range_respected(self, tmp_path):
+        src, _ = _seed_store(tmp_path)
+        dst = DiskColumnStore(str(tmp_path / "t2.db"))
+        copied = ChunkCopier(src, dst, "prom").run([0], 600, 1000)
+        assert copied[0] == 0  # flushed at ingestion_time=500, outside range
+
+
+class TestCardinalityBuster:
+    def test_dry_run_counts_without_deleting(self, tmp_path):
+        disk, _ = _seed_store(tmp_path)
+        buster = PerShardCardinalityBuster(disk, "prom")
+        n = buster.bust_shard(0, [ColumnFilter("group", Equals("even"))],
+                              dry_run=True)
+        assert n == 3
+        assert len(list(disk.scan_part_keys("prom", 0))) == 6
+
+    def test_bust_deletes_matching(self, tmp_path):
+        disk, _ = _seed_store(tmp_path)
+        before_chunks = disk.num_chunks("prom", 0)
+        buster = PerShardCardinalityBuster(disk, "prom")
+        n = buster.bust_shard(0, [ColumnFilter("group", Equals("odd"))],
+                              dry_run=False)
+        assert n == 3
+        remaining = [r for r in disk.scan_part_keys("prom", 0)]
+        assert len(remaining) == 3
+        assert disk.num_chunks("prom", 0) < before_chunks
+
+    def test_regex_filters(self, tmp_path):
+        disk, _ = _seed_store(tmp_path)
+        buster = PerShardCardinalityBuster(disk, "prom")
+        n = buster.bust_shard(0, [ColumnFilter("instance",
+                                               EqualsRegex("i[01]"))])
+        assert n == 2
+
+
+class TestDSIndexJob:
+    def test_migrates_partkeys_to_ds_datasets(self, tmp_path):
+        disk, _ = _seed_store(tmp_path)
+        job = DSIndexJob(disk, "prom", resolutions_ms=(60_000, 3_600_000))
+        moved = job.run([0])
+        assert moved[0] == 6
+        for res_ms in (60_000, 3_600_000):
+            name = ds_dataset_name("prom", res_ms)
+            assert len(list(disk.scan_part_keys(name, 0))) == 6
+
+
+class TestBootstrap:
+    def test_explicit_seed_join(self):
+        from filodb_tpu.http.server import FiloHttpServer
+        # a live peer node exposing /__health
+        mgr_peer = ShardManager()
+        mgr_peer.setup_dataset("prom", 2, 1)
+        mgr_peer.add_node("peer-1")
+        peer_http = FiloHttpServer(shard_manager=mgr_peer)
+        port = peer_http.start()
+        try:
+            mgr = ShardManager()
+            fd = FailureDetector(mgr)
+            boot = ClusterBootstrap(
+                "node-0", fd,
+                ExplicitListSeedDiscovery([f"http://127.0.0.1:{port}",
+                                           "http://127.0.0.1:9"]))
+            alive = boot.bootstrap()
+            assert alive == ["peer-1"]
+            assert set(fd.alive()) == {"node-0", "peer-1"}
+            assert "peer-1" in boot.peers
+        finally:
+            peer_http.shutdown()
+
+    def test_dns_discovery_localhost(self):
+        d = DnsSeedDiscovery("localhost", 1234)
+        endpoints = d.discover()
+        assert any("127.0.0.1:1234" in e for e in endpoints)
+        assert DnsSeedDiscovery("no-such-host-xyz.invalid", 1).discover() == []
+
+
+class TestThreadAssertions:
+    def test_assert_thread_name(self):
+        schedulers.enable_assertions(True)
+        try:
+            with pytest.raises(schedulers.WrongThreadError):
+                schedulers.assert_thread_name("ingest-")
+            ok = []
+            t = threading.Thread(
+                target=lambda: ok.append(
+                    schedulers.assert_thread_name("ingest-") or True),
+                name="ingest-prom-0")
+            t.start(); t.join()
+            assert ok == [True]
+        finally:
+            schedulers.enable_assertions(False)
+
+    def test_ingest_on_wrong_thread_trips(self):
+        schedulers.enable_assertions(True)
+        try:
+            data = {0: []}
+            b = RecordBuilder(DEFAULT_SCHEMAS["gauge"])
+            b.add(BASE + 1, [1.0], {"__name__": "x", "_ws_": "w", "_ns_": "n"})
+            data[0] = list(enumerate(b.containers()))
+            ms = TimeSeriesMemStore()
+            ic = IngestionCoordinator("n", "prom", DEFAULT_SCHEMAS, ms,
+                                      ListStreamFactory(data))
+            ic.start_ingestion(0, blocking=True)  # adopts the ingest name
+            sh = ms.get_shard("prom", 0)
+            assert sh.stats.rows_ingested == 1
+            # direct ingest from this (wrong) thread trips the tripwire
+            with pytest.raises(schedulers.WrongThreadError):
+                sh.ingest_container(b.containers()[0] if b.containers()
+                                    else data[0][0][1], offset=99)
+        finally:
+            schedulers.enable_assertions(False)
+
+    def test_threaded_ingestion_passes_assertions(self):
+        schedulers.enable_assertions(True)
+        try:
+            b = RecordBuilder(DEFAULT_SCHEMAS["gauge"])
+            for i in range(10):
+                b.add(BASE + 1000 * (i + 1), [float(i)],
+                      {"__name__": "y", "_ws_": "w", "_ns_": "n"})
+            data = {0: list(enumerate(b.containers()))}
+            ms = TimeSeriesMemStore()
+            ic = IngestionCoordinator("n", "prom", DEFAULT_SCHEMAS, ms,
+                                      ListStreamFactory(data))
+            ic.start_ingestion(0)  # real named thread
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                try:
+                    if ms.get_shard("prom", 0).stats.rows_ingested == 10:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.01)
+            assert ms.get_shard("prom", 0).stats.rows_ingested == 10
+            ic.stop_all()
+        finally:
+            schedulers.enable_assertions(False)
+
+
+def test_copier_preserves_ingestion_times(tmp_path):
+    """Regression: copied chunks keep their source ingestion times so
+    incremental repair runs don't double-copy or miss ranges."""
+    src_store = DiskColumnStore(str(tmp_path / "s.db"))
+    ms = TimeSeriesMemStore(src_store)
+    ms.setup("prom", DEFAULT_SCHEMAS, 0)
+    rng = np.random.default_rng(0)
+    b = RecordBuilder(DEFAULT_SCHEMAS["gauge"])
+    tags = {"__name__": "m", "instance": "a", "_ws_": "w", "_ns_": "n"}
+    ts = BASE + np.cumsum(rng.integers(5_000, 15_000, 100))
+    for t, v in zip(ts, rng.random(100)):
+        b.add(int(t), [float(v)], tags)
+    for off, c in enumerate(b.containers()):
+        ms.ingest("prom", 0, c, offset=off)
+    ms.get_shard("prom", 0).flush_all(ingestion_time=100)
+    # second batch at a later ingestion time
+    b2 = RecordBuilder(DEFAULT_SCHEMAS["gauge"])
+    t2 = int(ts[-1]) + np.cumsum(rng.integers(5_000, 15_000, 50))
+    for t, v in zip(t2, rng.random(50)):
+        b2.add(int(t), [float(v)], tags)
+    for off, c in enumerate(b2.containers()):
+        ms.ingest("prom", 0, c, offset=100 + off)
+    ms.get_shard("prom", 0).flush_all(ingestion_time=200)
+
+    dst = DiskColumnStore(str(tmp_path / "d.db"))
+    ChunkCopier(src_store, dst, "prom").run([0], 0, 1000)
+    # the target's ingestion-time scan distinguishes the two batches
+    early = list(dst.chunksets_by_ingestion_time("prom", 0, 0, 150))
+    late = list(dst.chunksets_by_ingestion_time("prom", 0, 151, 300))
+    assert len(early) >= 1 and len(late) >= 1
+    src_early = list(src_store.chunksets_by_ingestion_time("prom", 0, 0, 150))
+    assert len(early) == len(src_early)
+
+
+def test_buster_works_on_in_memory_store():
+    from filodb_tpu.store.columnstore import InMemoryColumnStore
+    from filodb_tpu.store.columnstore import PartKeyRecord
+    from filodb_tpu.core.record import canonical_partkey
+    store = InMemoryColumnStore()
+    pk = canonical_partkey({"_metric_": "m", "kill": "yes"})
+    store.write_part_keys("ds", 0, [PartKeyRecord(pk, 0, 1, 0)])
+    buster = PerShardCardinalityBuster(store, "ds")
+    assert buster.bust_shard(0, [ColumnFilter("kill", Equals("yes"))],
+                             dry_run=False) == 1
+    assert list(store.scan_part_keys("ds", 0)) == []
