@@ -1,0 +1,54 @@
+"""Codec encode/decode throughput, native vs numpy paths.
+
+Reference analog: jmh/.../EncodingBenchmark.scala:23,
+BasicFiloBenchmark.scala:22, IntSumReadBenchmark.scala:30."""
+
+import sys
+import pathlib
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np  # noqa: E402
+
+from benches.common import emit, log, timed  # noqa: E402
+
+from filodb_tpu import native  # noqa: E402
+from filodb_tpu.codecs import deltadelta, doublecodec  # noqa: E402
+
+N = 100_000
+BASE = 1_700_000_000_000
+
+
+def main():
+    rng = np.random.default_rng(0)
+    ts = (BASE + np.cumsum(rng.integers(9_000, 11_000, N))).astype(np.int64)
+    gauge = rng.normal(50, 10, N)
+
+    dd_blob = deltadelta.encode(ts)
+    dbl_blob = doublecodec.encode(gauge)
+    log(f"delta2: {len(dd_blob)}B for {N} ts "
+        f"({8 * N / len(dd_blob):.1f}x), xor: {len(dbl_blob)}B "
+        f"({8 * N / len(dbl_blob):.1f}x)")
+    emit("delta2 compression ratio", 8 * N / len(dd_blob), "x")
+    emit("xor-double compression ratio", 8 * N / len(dbl_blob), "x")
+
+    t_enc = timed(lambda: deltadelta.encode(ts))
+    emit("delta2 encode", N / t_enc, "samples/sec")
+
+    have_native = native.enable()
+    if have_native:
+        t = timed(lambda: deltadelta.decode(dd_blob))
+        emit("delta2 decode (native)", N / t, "samples/sec")
+        t = timed(lambda: doublecodec.decode(dbl_blob))
+        emit("xor-double decode (native)", N / t, "samples/sec")
+
+    native.disable()
+    small = deltadelta.encode(ts[:5_000])
+    t = timed(lambda: deltadelta.decode(small))
+    emit("delta2 decode (numpy fallback)", 5_000 / t, "samples/sec")
+    if have_native:
+        native.enable()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""Histogram ingest + quantile query throughput.
+
+Reference analog: jmh/.../HistogramIngestBenchmark.scala:29,
+HistogramQueryBenchmark.scala:36."""
+
+import sys
+import pathlib
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np  # noqa: E402
+
+from benches.common import emit, force_cpu_x64, timed  # noqa: E402
+
+force_cpu_x64()
+
+from filodb_tpu.core.filters import ColumnFilter, Equals  # noqa: E402
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS  # noqa: E402
+from filodb_tpu.gateway.producer import TestTimeseriesProducer  # noqa: E402
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore  # noqa: E402
+from filodb_tpu.ops import histogram_ops  # noqa: E402
+
+N_SERIES = 50
+N_SAMPLES = 200
+
+
+def main():
+    producer = TestTimeseriesProducer(DEFAULT_SCHEMAS)
+    containers = producer.histogram_containers(
+        n_series=N_SERIES, n_samples=N_SAMPLES, num_buckets=16)
+    total = N_SERIES * N_SAMPLES
+
+    def ingest():
+        ms = TimeSeriesMemStore()
+        ms.setup("hist", DEFAULT_SCHEMAS, 0)
+        for off, c in enumerate(containers):
+            ms.ingest("hist", 0, c, offset=off)
+        return ms
+
+    t_ing = timed(ingest)
+    emit("histogram ingest throughput", total / t_ing, "records/sec")
+
+    ms = ingest()
+    sh = ms.get_shard("hist", 0)
+    res = sh.lookup_partitions(
+        [ColumnFilter("_metric_", Equals("request_latency"))], 0, 2**62)
+
+    def scan_quantile():
+        tags, batch = sh.scan_batch(res.part_ids, 0, 2**62)
+        q = histogram_ops.hist_quantile(np.asarray(batch.bucket_tops),
+                                   np.asarray(batch.hist), 0.95)
+        return np.asarray(q)
+
+    scan_quantile()  # warm jit if any
+    t_q = timed(scan_quantile)
+    emit("histogram scan+p95 quantile", total / t_q, "hist samples/sec")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Ingestion throughput: record build + container decode + shard ingest.
+
+Reference analog: jmh/src/main/scala/filodb.jmh/IngestionBenchmark.scala:28
+(BinaryRecord build + shard ingest records/sec) and the ingest hot loop
+SURVEY.md §3.2."""
+
+import sys
+import pathlib
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np  # noqa: E402
+
+from benches.common import emit, force_cpu_x64, log, timed  # noqa: E402
+
+force_cpu_x64()
+
+from filodb_tpu.core.record import RecordBuilder, decode_container  # noqa: E402
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS  # noqa: E402
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore  # noqa: E402
+
+N_SERIES = 200
+N_ROWS = 500
+BASE = 1_700_000_000_000
+
+
+def main():
+    rng = np.random.default_rng(0)
+    tag_sets = [{"__name__": "bench_metric", "instance": f"i{i}",
+                 "host": f"h{i % 10}", "_ws_": "w", "_ns_": "n"}
+                for i in range(N_SERIES)]
+    ts_cols = [BASE + np.cumsum(rng.integers(5_000, 15_000, N_ROWS))
+               for _ in range(N_SERIES)]
+    val_cols = [rng.random(N_ROWS) for _ in range(N_SERIES)]
+    total = N_SERIES * N_ROWS
+
+    def build():
+        b = RecordBuilder(DEFAULT_SCHEMAS["gauge"])
+        for tags, ts, vals in zip(tag_sets, ts_cols, val_cols):
+            for t, v in zip(ts, vals):
+                b.add(int(t), [float(v)], tags)
+        return b.containers()
+
+    t_build = timed(lambda: build())
+    emit("record build throughput", total / t_build, "records/sec")
+
+    containers = build()
+
+    def decode():
+        n = 0
+        for c in containers:
+            for _ in decode_container(c, DEFAULT_SCHEMAS):
+                n += 1
+        return n
+
+    t_dec = timed(decode)
+    emit("container decode throughput", total / t_dec, "records/sec")
+
+    def ingest():
+        ms = TimeSeriesMemStore()
+        ms.setup("bench", DEFAULT_SCHEMAS, 0)
+        for off, c in enumerate(containers):
+            ms.ingest("bench", 0, c, offset=off)
+        return ms
+
+    t_ing = timed(ingest)
+    emit("shard ingest throughput (incl. decode+index)", total / t_ing,
+         "records/sec")
+
+    ms = ingest()
+    sh = ms.get_shard("bench", 0)
+    t_flush = timed(lambda: sh.flush_all())  # first rep does the real work
+    log(f"ingested {sh.stats.rows_ingested} rows; flush {t_flush * 1e3:.1f}ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Part-key tag index ops: add / filter lookup / label values.
+
+Reference analog: jmh/.../PartKeyIndexBenchmark.scala:20 (Lucene index
+ops/sec)."""
+
+import sys
+import pathlib
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np  # noqa: E402
+
+from benches.common import emit, timed  # noqa: E402
+
+from filodb_tpu.core.filters import ColumnFilter, Equals, EqualsRegex  # noqa: E402
+from filodb_tpu.core.record import canonical_partkey  # noqa: E402
+from filodb_tpu.memstore.index import PartKeyIndex  # noqa: E402
+
+N = 50_000
+
+
+def main():
+    tag_sets = [{"_metric_": f"metric_{i % 100}", "instance": f"i{i}",
+                 "host": f"h{i % 500}", "_ws_": "w", "_ns_": f"ns{i % 8}"}
+                for i in range(N)]
+    pks = [canonical_partkey(t) for t in tag_sets]
+
+    def build():
+        idx = PartKeyIndex()
+        for pid, (pk, tags) in enumerate(zip(pks, tag_sets)):
+            idx.add_partkey(pid, pk, tags, start_time=pid)
+        return idx
+
+    t_add = timed(build)
+    emit("index add_partkey", N / t_add, "keys/sec")
+
+    idx = build()
+    eq = [ColumnFilter("_metric_", Equals("metric_42"))]
+    t_eq = timed(lambda: idx.part_ids_from_filters(eq, 0, 2**62), reps=5)
+    n_eq = len(idx.part_ids_from_filters(eq, 0, 2**62))
+    emit("index equals lookup", 1.0 / t_eq, "lookups/sec", matched=n_eq)
+
+    rx = [ColumnFilter("host", EqualsRegex("h1.?"))]
+    t_rx = timed(lambda: idx.part_ids_from_filters(rx, 0, 2**62), reps=5)
+    emit("index regex lookup", 1.0 / t_rx, "lookups/sec")
+
+    t_lv = timed(lambda: idx.label_values("host", (), 0, 2**62), reps=5)
+    emit("index label_values", 1.0 / t_lv, "ops/sec")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""High-cardinality query: many series, few samples each, through the
+full engine (parse -> plan -> scan -> window -> aggregate).
+
+Reference analog: jmh/.../QueryHiCardInMemoryBenchmark.scala:34 and
+QueryAndIngestBenchmark.scala:38 (concurrent ingest+query)."""
+
+import sys
+import pathlib
+import threading
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np  # noqa: E402
+
+from benches.common import emit, force_cpu_x64, log, timed  # noqa: E402
+
+force_cpu_x64()
+
+from filodb_tpu.coordinator.planner import SingleClusterPlanner  # noqa: E402
+from filodb_tpu.core.record import RecordBuilder, decode_container  # noqa: E402
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions  # noqa: E402
+from filodb_tpu.core.storeconfig import StoreConfig  # noqa: E402
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore  # noqa: E402
+from filodb_tpu.parallel.shardmap import ShardMapper, ShardStatus  # noqa: E402
+from filodb_tpu.promql.parser import query_range_to_logical_plan  # noqa: E402
+from filodb_tpu.query.exec import ExecContext  # noqa: E402
+from filodb_tpu.query.model import QueryContext  # noqa: E402
+
+BASE = 1_700_000_000_000
+N_SERIES = 5_000
+N_ROWS = 60
+STEP = 10_000
+
+
+def main():
+    mapper = ShardMapper(4)
+    mapper.register_node(range(4), "local")
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(batch_series_pad=1024)
+    for s in range(4):
+        mapper.update_status(s, ShardStatus.ACTIVE)
+        ms.setup("bench", DEFAULT_SCHEMAS, s, cfg)
+    rng = np.random.default_rng(0)
+    b = RecordBuilder(DEFAULT_SCHEMAS["prom-counter"], container_size=4 << 20)
+    for i in range(N_SERIES):
+        tags = {"__name__": "hc_total", "instance": f"i{i}",
+                "_ws_": "w", "_ns_": "n"}
+        ts = BASE + np.arange(N_ROWS) * STEP
+        vals = np.cumsum(rng.random(N_ROWS))
+        for t, v in zip(ts, vals):
+            b.add(int(t), [float(v)], tags)
+    for off, c in enumerate(b.containers()):
+        per = {}
+        for rec in decode_container(c, DEFAULT_SCHEMAS):
+            sh = mapper.ingestion_shard(rec.shard_hash, rec.part_hash, 2) % 4
+            per.setdefault(sh, []).append(rec)
+        for sh, recs in per.items():
+            ms.get_shard("bench", sh).ingest(recs, off)
+    log(f"{N_SERIES} series x {N_ROWS} rows across 4 shards")
+
+    planner = SingleClusterPlanner("bench", mapper, DatasetOptions(),
+                                   spread_default=2)
+    plan = query_range_to_logical_plan(
+        'sum(rate(hc_total{_ws_="w",_ns_="n"}[2m]))',
+        BASE + 200_000, STEP, BASE + 550_000)
+    qctx = QueryContext(sample_limit=10_000_000)
+    ep = planner.materialize(plan, qctx)
+
+    def run_query():
+        return ep.execute(ExecContext(ms, qctx))
+
+    run_query()  # warm compile caches
+    t_q = timed(run_query)
+    emit("hi-cardinality query samples scanned/sec",
+         N_SERIES * N_ROWS / t_q, "samples/sec", series=N_SERIES)
+
+    # concurrent ingest + query (QueryAndIngestBenchmark shape)
+    stop = threading.Event()
+    ingested = [0]
+
+    def ingest_loop():
+        off = 10_000
+        while not stop.is_set():
+            bb = RecordBuilder(DEFAULT_SCHEMAS["prom-counter"])
+            t0 = BASE + (off * 7919) % 10**9
+            bb.add(t0 + 10**9, [1.0],
+                   {"__name__": "live_total", "instance": f"x{off}",
+                    "_ws_": "w", "_ns_": "n"})
+            for c in bb.containers():
+                ms.ingest("bench", 0, c, offset=off)
+            ingested[0] += 1
+            off += 1
+
+    th = threading.Thread(target=ingest_loop, name="ingest-bench-0",
+                          daemon=True)
+    th.start()
+    t_q2 = timed(run_query)
+    stop.set()
+    th.join(timeout=2)
+    emit("query under concurrent ingest", N_SERIES * N_ROWS / t_q2,
+         "samples/sec", ingests_during=ingested[0])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""Run every bench as a subprocess; aggregate their JSON lines.
+
+Reference analog: the jmh runner (README.md:878-897)."""
+
+import pathlib
+import subprocess
+import sys
+
+HERE = pathlib.Path(__file__).resolve().parent
+
+
+def main() -> int:
+    ok = True
+    for bench in sorted(HERE.glob("bench_*.py")):
+        print(f"=== {bench.name} ===", file=sys.stderr, flush=True)
+        proc = subprocess.run([sys.executable, str(bench)], timeout=600)
+        ok = ok and proc.returncode == 0
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
