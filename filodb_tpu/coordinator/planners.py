@@ -448,11 +448,18 @@ class ShardKeyRegexPlanner(QueryPlanner):
                 return keys
         return None
 
-    def _replace_keys(self, plan, concrete: dict):
+    def _replace_keys(self, plan, concrete: dict, regex: dict):
         if isinstance(plan, lp.RawSeries):
+            # only rewrite the filters that actually carried THE expanded
+            # regex: a leaf that pins a shard-key column with a plain Equals
+            # (e.g. the other side of a binary join), or one that carries a
+            # DIFFERENT regex on the same column, must keep its own selector
             new_filters = tuple(
                 ColumnFilter(f.column, Equals(concrete[f.column]))
-                if f.column in concrete else f
+                if f.column in concrete
+                and isinstance(f.filter, EqualsRegex)
+                and f.filter.pattern == regex.get(f.column)
+                else f
                 for f in plan.filters)
             return dataclasses.replace(plan, filters=new_filters)
         if not dataclasses.is_dataclass(plan):
@@ -461,7 +468,7 @@ class ShardKeyRegexPlanner(QueryPlanner):
         for f in dataclasses.fields(plan):
             v = getattr(plan, f.name)
             if isinstance(v, lp.LogicalPlan):
-                updates[f.name] = self._replace_keys(v, concrete)
+                updates[f.name] = self._replace_keys(v, concrete, regex)
         return dataclasses.replace(plan, **updates) if updates else plan
 
     def materialize(self, plan, qctx=None) -> ExecPlan:
@@ -472,8 +479,9 @@ class ShardKeyRegexPlanner(QueryPlanner):
         concretes = self.matcher(regex)
         if not concretes:
             return EmptyResultExec(qctx)
-        children = [self.inner.materialize(self._replace_keys(plan, c), qctx)
-                    for c in concretes]
+        children = [
+            self.inner.materialize(self._replace_keys(plan, c, regex), qctx)
+            for c in concretes]
         if len(children) == 1:
             return children[0]
         if isinstance(plan, lp.Aggregate):

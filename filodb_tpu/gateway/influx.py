@@ -177,7 +177,11 @@ def parse_line(line: str) -> Optional[InfluxRecord]:
         raise InfluxParseError(f"no numeric fields in line: {line!r}")
 
     if ts_part:
-        ts_ms = int(ts_part) // 1_000_000  # Influx default is nanoseconds
+        try:
+            ts_ms = int(ts_part) // 1_000_000  # Influx default is nanoseconds
+        except ValueError as e:
+            raise InfluxParseError(
+                f"bad timestamp {ts_part!r} in line: {line!r}") from e
     else:
         import time
         ts_ms = int(time.time() * 1000)

@@ -118,11 +118,20 @@ class FiloHttpServer:
                     body = req.rfile.read(ln).decode()
                     ctype = req.headers.get("Content-Type", "")
                     if "json" in ctype:
-                        for k, v in json.loads(body).items():
+                        decoded = json.loads(body)
+                        if not isinstance(decoded, dict):
+                            raise ValueError(
+                                "request body must be a JSON object")
+                        # JSON numbers arrive as int/float; route handlers
+                        # expect query-string semantics (everything str)
+                        for k, v in decoded.items():
                             if isinstance(v, list):
-                                multi.setdefault(k, []).extend(v)
+                                multi.setdefault(k, []).extend(
+                                    x if isinstance(x, str) else str(x)
+                                    for x in v)
                             else:
-                                multi.setdefault(k, []).append(v)
+                                multi.setdefault(k, []).append(
+                                    v if isinstance(v, str) else str(v))
                     else:
                         for k, v in urllib.parse.parse_qs(body).items():
                             multi.setdefault(k, []).extend(v)
@@ -135,11 +144,14 @@ class FiloHttpServer:
         except Exception as e:  # noqa: BLE001
             code, payload = 500, error_response("internal", str(e))
         data = json.dumps(payload).encode()
-        req.send_response(code)
-        req.send_header("Content-Type", "application/json")
-        req.send_header("Content-Length", str(len(data)))
-        req.end_headers()
-        req.wfile.write(data)
+        try:
+            req.send_response(code)
+            req.send_header("Content-Type", "application/json")
+            req.send_header("Content-Length", str(len(data)))
+            req.end_headers()
+            req.wfile.write(data)
+        except Exception:  # noqa: BLE001 — client disconnected mid-response
+            pass
 
     def _handle_execplan(self, req: BaseHTTPRequestHandler) -> None:
         """Cross-node dispatch receiver (reference: remote QueryActor

@@ -39,37 +39,48 @@ class QueryLimitExceeded(Exception):
 
 
 class _PagedPartitions:
-    """Bytes-bounded LRU of read-only re-materialized partitions."""
+    """Bytes-bounded LRU of read-only re-materialized partitions (int keys)
+    and backfill chunk lists for live partitions (``("bf", pid)`` keys).
+
+    All methods take an internal lock: ODP shards are queried concurrently
+    from HTTP handler threads, so the OrderedDict reorder + byte accounting
+    must not interleave."""
 
     def __init__(self, max_bytes: int):
         self.max_bytes = max_bytes
-        self._parts: OrderedDict[int, TimeSeriesPartition] = OrderedDict()
+        self._entries: OrderedDict = OrderedDict()   # key -> (value, nbytes)
         self._bytes = 0
+        self._lock = threading.Lock()
 
-    def get(self, part_id: int) -> Optional[TimeSeriesPartition]:
-        part = self._parts.get(part_id)
-        if part is not None:
-            self._parts.move_to_end(part_id)
-        return part
+    def get(self, key):
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                return None
+            self._entries.move_to_end(key)
+            return ent[0]
 
-    def put(self, part: TimeSeriesPartition) -> None:
-        old = self._parts.pop(part.part_id, None)
-        if old is not None:
-            self._bytes -= sum(c.nbytes for c in old.chunks)
-        nbytes = sum(c.nbytes for c in part.chunks)
-        self._parts[part.part_id] = part
-        self._bytes += nbytes
-        while self._bytes > self.max_bytes and len(self._parts) > 1:
-            _, evicted = self._parts.popitem(last=False)
-            self._bytes -= sum(c.nbytes for c in evicted.chunks)
+    def put(self, key, value, nbytes: int) -> None:
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[key] = (value, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                _, (_ev, nb) = self._entries.popitem(last=False)
+                self._bytes -= nb
 
-    def pop(self, part_id: int) -> None:
-        old = self._parts.pop(part_id, None)
-        if old is not None:
-            self._bytes -= sum(c.nbytes for c in old.chunks)
+    def pop(self, key) -> None:
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
 
     def __len__(self) -> int:
-        return len(self._parts)
+        """Number of cached whole partitions (backfill entries excluded)."""
+        with self._lock:
+            return sum(1 for k in self._entries if isinstance(k, int))
 
 
 class OnDemandPagingShard(TimeSeriesShard):
@@ -79,6 +90,9 @@ class OnDemandPagingShard(TimeSeriesShard):
                  **kwargs):
         super().__init__(*args, **kwargs)
         self.paged = _PagedPartitions(page_cache_bytes)
+        # serializes page-in / backfill store reads across query threads so
+        # concurrent misses for the same partition don't duplicate work
+        self._odp_lock = threading.Lock()
         # partitions pinned by an in-flight scan on THIS thread: strong
         # references so mid-query LRU eviction cannot drop them from results
         self._pinned = threading.local()
@@ -119,67 +133,98 @@ class OnDemandPagingShard(TimeSeriesShard):
                 resident[pid] = part
         self._cap_data_scanned(resident.values(), missing, start_time,
                                end_time)
-        for part in list(resident.values()):
-            if part.part_id in self.partitions:
+        for pid, part in list(resident.items()):
+            if pid in self.partitions:
                 # live partition: may hold only its post-recovery tail
-                self._page_older_chunks(part)
+                resident[pid] = self._with_backfill(part)
         if missing:
             self._page_in(missing, resident)
         return resident
 
-    def _page_older_chunks(self, part: TimeSeriesPartition) -> None:
+    def _with_backfill(self, part: TimeSeriesPartition) -> TimeSeriesPartition:
         """A live partition re-materialized during recovery holds only rows
         replayed after the checkpoint; its older chunks stayed on disk
         (reference: OnDemandPagingShard computes missing chunk time-ranges
         per partition).  Newer-than-resident chunks cannot exist for a live
-        partition — it is the single writer of its own tail."""
+        partition — it is the single writer of its own tail.
+
+        The live partition is NEVER mutated from the query thread: the
+        ingest thread is its single writer.  Instead the older chunks are
+        cached in the paged LRU and the scan gets a read-only snapshot
+        object whose chunk list is a fresh ``older + live`` copy."""
         earliest = part.earliest_timestamp
         if earliest < 0:
             earliest = _MAX_TIME
         try:
             idx_start = self.index.start_time(part.part_id)
         except KeyError:
-            return
+            return part
         if idx_start >= earliest:
-            return  # nothing on disk predates memory
-        have = {c.info.chunk_id for c in part.chunks}
-        paged = 0
-        for _pk, chunksets in self.store.read_raw_partitions(
-                self.dataset, self.shard_num, [part.partkey],
-                idx_start, earliest - 1):
-            for cs in chunksets:
-                if cs.info.chunk_id not in have:
-                    part.chunks.append(cs)
-                    paged += 1
-        if paged:
-            part.chunks.sort(key=lambda c: c.info.chunk_id)
-            self.stats.chunks_paged += paged
+            return part  # nothing on disk predates memory
+        key = ("bf", part.part_id)
+        older = self.paged.get(key)
+        if older is None:
+            with self._odp_lock:
+                older = self.paged.get(key)
+                if older is None:
+                    have = {c.info.chunk_id for c in list(part.chunks)}
+                    older = []
+                    for _pk, chunksets in self.store.read_raw_partitions(
+                            self.dataset, self.shard_num, [part.partkey],
+                            idx_start, earliest - 1):
+                        older.extend(cs for cs in chunksets
+                                     if cs.info.chunk_id not in have)
+                    older.sort(key=lambda c: c.info.chunk_id)
+                    # cache only while this exact partition object is still
+                    # live: a concurrent eviction + re-ingest reuses the pid
+                    # and the old list would hide the chunks flushed at
+                    # eviction time
+                    if self.partitions.get(part.part_id) is part:
+                        self.paged.put(key, older,
+                                       sum(c.nbytes for c in older))
+                    self.stats.chunks_paged += len(older)
+        if not older:
+            return part
+        snap = TimeSeriesPartition.__new__(TimeSeriesPartition)
+        for slot in TimeSeriesPartition.__slots__:
+            setattr(snap, slot, getattr(part, slot))
+        snap.chunks = older + part.chunks   # fresh list; live one untouched
+        snap._unflushed = []
+        return snap
 
     def _page_in(self, part_ids: list[int],
                  resident: dict[int, TimeSeriesPartition]) -> None:
         """Materialize fully-absent partitions from disk with their whole
         persisted history, so the cached object serves any time range."""
-        by_pk = {}
-        for pid in part_ids:
-            try:
-                by_pk[self.index.partkey(pid)] = pid
-            except KeyError:
-                continue  # purged from index since lookup: skip gracefully
-        if not by_pk:
-            return
-        for pk, chunksets in self.store.read_raw_partitions(
-                self.dataset, self.shard_num, list(by_pk), 0, _MAX_TIME):
-            pid = by_pk[pk]
-            schema = self._schema_for_chunks(chunksets)
-            part = TimeSeriesPartition(pid, schema, pk, parse_partkey(pk),
-                                       group=pid % self.num_groups)
-            part.chunks = sorted(chunksets, key=lambda c: c.info.chunk_id)
-            # paged chunks are already persisted: nothing to flush
-            part._unflushed = []
-            self.paged.put(part)
-            resident[pid] = part
-            self.stats.partitions_paged += 1
-            self.stats.chunks_paged += len(chunksets)
+        with self._odp_lock:
+            by_pk = {}
+            for pid in part_ids:
+                # another query thread may have paged it in while this one
+                # waited on the lock
+                part = self.paged.get(pid)
+                if part is not None:
+                    resident[pid] = part
+                    continue
+                try:
+                    by_pk[self.index.partkey(pid)] = pid
+                except KeyError:
+                    continue  # purged from index since lookup: skip gracefully
+            if not by_pk:
+                return
+            for pk, chunksets in self.store.read_raw_partitions(
+                    self.dataset, self.shard_num, list(by_pk), 0, _MAX_TIME):
+                pid = by_pk[pk]
+                schema = self._schema_for_chunks(chunksets)
+                part = TimeSeriesPartition(pid, schema, pk, parse_partkey(pk),
+                                           group=pid % self.num_groups)
+                part.chunks = sorted(chunksets, key=lambda c: c.info.chunk_id)
+                # paged chunks are already persisted: nothing to flush
+                part._unflushed = []
+                self.paged.put(pid, part,
+                               sum(c.nbytes for c in part.chunks))
+                resident[pid] = part
+                self.stats.partitions_paged += 1
+                self.stats.chunks_paged += len(chunksets)
 
     def _schema_for_chunks(self, chunksets):
         """The persisted schema hash identifies the exact schema; fall back
@@ -313,8 +358,13 @@ class OnDemandPagingShard(TimeSeriesShard):
                     self._downsampler_for(
                         part.schema.schema_hash).downsample_chunksets(
                         [(part.tags, cs) for cs in pending])
-            del self.partitions[pid]
-            self.paged.pop(pid)  # stale cached copy (if any) lacks the tail
+            # under _odp_lock so an in-flight backfill compute for this pid
+            # finishes (and its live-partition identity check then fails)
+            # before the stale entries are dropped
+            with self._odp_lock:
+                del self.partitions[pid]
+                self.paged.pop(pid)          # cached copy lacks the tail
+                self.paged.pop(("bf", pid))  # list is live-part relative
             self.evicted_keys.add(part.partkey)
             self.stats.partitions_evicted += 1
             evicted += 1

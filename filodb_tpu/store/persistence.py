@@ -53,6 +53,68 @@ def unpack_vectors(blob: bytes) -> list[bytes]:
     return vectors
 
 
+class _EagerCursor:
+    """Pre-fetched cursor: rows are materialized while the connection lock
+    is held, so no live sqlite cursor ever escapes the serialized section."""
+
+    def __init__(self, rows: list, lastrowid, rowcount: int):
+        self._rows = rows
+        self._pos = 0
+        self.lastrowid = lastrowid
+        self.rowcount = rowcount
+
+    def fetchall(self) -> list:
+        rows = self._rows[self._pos:]
+        self._rows, self._pos = [], 0
+        return rows
+
+    def fetchone(self):
+        if self._pos >= len(self._rows):
+            return None
+        row = self._rows[self._pos]
+        self._pos += 1
+        return row
+
+    def __iter__(self):
+        while self._pos < len(self._rows):
+            row = self._rows[self._pos]
+            self._pos += 1
+            yield row
+
+
+class _SerializedConn:
+    """One sqlite connection shared by every thread, one operation at a
+    time.  Used for ':memory:' stores, where per-thread connections would
+    each get their own private empty database."""
+
+    def __init__(self, conn: sqlite3.Connection):
+        self._conn = conn
+        self._lock = threading.RLock()
+
+    def execute(self, sql: str, params: Sequence = ()) -> _EagerCursor:
+        with self._lock:
+            cur = self._conn.execute(sql, params)
+            rows = cur.fetchall() if cur.description else []
+            return _EagerCursor(rows, cur.lastrowid, cur.rowcount)
+
+    def executemany(self, sql: str, seq) -> _EagerCursor:
+        with self._lock:
+            cur = self._conn.executemany(sql, list(seq))
+            return _EagerCursor([], cur.lastrowid, cur.rowcount)
+
+    def executescript(self, script: str) -> None:
+        with self._lock:
+            self._conn.executescript(script)
+
+    def commit(self) -> None:
+        with self._lock:
+            self._conn.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
 class _SqliteBase:
     """Shared connection handling: one connection per thread, WAL mode."""
 
@@ -64,7 +126,23 @@ class _SqliteBase:
         self._ddl_done = False
         self._ddl_lock = threading.Lock()
 
-    def _conn(self) -> sqlite3.Connection:
+    def _conn(self):
+        if self.path == ":memory:":
+            # plain :memory: is a fresh empty database PER CONNECTION, so a
+            # second thread would see "no such table".  Every thread shares
+            # ONE connection instead, serialized op-by-op (shared-cache URIs
+            # were rejected: their table locks raise SQLITE_LOCKED, which
+            # the busy timeout does not retry).
+            with self._ddl_lock:
+                conn = getattr(self, "_mem_conn", None)
+                if conn is None:
+                    conn = _SerializedConn(sqlite3.connect(
+                        ":memory:", check_same_thread=False))
+                    self._mem_conn = conn
+                if not self._ddl_done:
+                    self._ddl(conn)
+                    self._ddl_done = True
+            return conn
         conn = getattr(self._local, "conn", None)
         if conn is None:
             conn = sqlite3.connect(self.path, timeout=30.0)
@@ -82,6 +160,11 @@ class _SqliteBase:
         raise NotImplementedError
 
     def shutdown(self) -> None:
+        mem = getattr(self, "_mem_conn", None)
+        if mem is not None:
+            mem.close()
+            self._mem_conn = None
+            self._ddl_done = False  # a later use gets a fresh empty db
         conn = getattr(self._local, "conn", None)
         if conn is not None:
             conn.close()

@@ -68,6 +68,8 @@ class TestInfluxParser:
             parse_line("m val=abc 123")
         with pytest.raises(InfluxParseError):
             parse_line('m msg="only-string" 123')
+        with pytest.raises(InfluxParseError):
+            parse_line("cpu value=1 12x3")  # malformed trailing timestamp
 
     def test_parse_lines_stream(self):
         text = "cpu value=1 1000000\n\n# c\nmem value=2 2000000\n"

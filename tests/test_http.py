@@ -125,6 +125,36 @@ class TestQueryRange:
         vals = body["data"]["result"][0]["values"]
         assert all(v == "6" for _, v in vals)
 
+    def test_post_json_numeric_params(self, server):
+        """JSON bodies may carry numbers; they must behave like their
+        query-string (string) equivalents."""
+        url = (f"http://127.0.0.1:{server}"
+               "/promql/prom/api/v1/query_range")
+        req = urllib.request.Request(
+            url, method="POST",
+            data=json.dumps({"query": "count(http_requests_total)",
+                             "start": (BASE + 600_000) / 1000,
+                             "end": (BASE + 700_000) / 1000,
+                             "step": 30}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            body = json.loads(resp.read())
+        assert body["status"] == "success"
+        vals = body["data"]["result"][0]["values"]
+        assert all(v == "6" for _, v in vals)
+
+    def test_post_json_array_is_400(self, server):
+        """A JSON array body is a client error, not a 500."""
+        url = (f"http://127.0.0.1:{server}"
+               "/promql/prom/api/v1/query_range")
+        req = urllib.request.Request(
+            url, method="POST", data=json.dumps([1, 2]).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 400
+        assert json.loads(ei.value.read())["errorType"] == "bad_data"
+
     def test_parse_error_is_400(self, server):
         code, body = _get(server, "/promql/prom/api/v1/query_range",
                           query='sum(rate(', start="1", end="2", step="15s")

@@ -143,6 +143,27 @@ class TestDiskMetaStore:
         assert meta.list_datasets() == ["prom"]
         assert meta.read_dataset("nope") is None
 
+    def test_memory_store_shared_across_threads(self):
+        """Regression: a ':memory:' store must serve every thread from ONE
+        database (plain :memory: sqlite is per-connection-private)."""
+        import threading
+
+        meta = DiskMetaStore(":memory:")
+        meta.write_checkpoint("ds", 0, 1, 42)
+        got: dict = {}
+
+        def worker():
+            try:
+                got["cp"] = meta.read_checkpoints("ds", 0)
+            except Exception as e:  # noqa: BLE001
+                got["err"] = e
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert "err" not in got, got
+        assert got["cp"] == {1: 42}
+
 
 class TestRecovery:
     def test_restart_recovers_index_and_skips_persisted(self, tmp_path):
@@ -283,6 +304,66 @@ class TestOnDemandPaging:
             [ColumnFilter("_metric_", Equals("heap_usage"))], 0, 2**62)
         with pytest.raises(QueryLimitExceeded):
             shard.scan_batch(res.part_ids, 0, 2**62)
+
+    def test_concurrent_scans_thread_safe(self, tmp_path):
+        """ODP shards are queried from concurrent HTTP handler threads:
+        paging + the LRU must tolerate parallel scans (regression for the
+        unlocked _PagedPartitions / in-place chunk-list mutation)."""
+        import threading
+
+        disk, shard, truth = self._setup(tmp_path)
+        shard.evict_partitions(3)
+        f = [ColumnFilter("_metric_", Equals("heap_usage"))]
+        res = shard.lookup_partitions(f, 0, 2**62)
+        errs: list = []
+
+        def worker():
+            try:
+                for _ in range(5):
+                    tags_list, batch = shard.scan_batch(res.part_ids, 0, 2**62)
+                    assert len(tags_list) == len(truth)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+
+    def test_backfill_snapshots_leave_live_partition_untouched(self, tmp_path):
+        """Older on-disk chunks of a recovery-tail resident are served via a
+        read-only snapshot; the live partition (single-writer: the ingest
+        thread) must never be mutated from the query path."""
+        disk = DiskColumnStore(str(tmp_path / "c.db"))
+        meta = DiskMetaStore(str(tmp_path / "m.db"))
+        containers, truth = _builder_data(n_series=4, n_rows=200,
+                                          container_size=8192)
+        cfg = StoreConfig(groups_per_shard=2)
+        store = TimeSeriesMemStore(disk, meta)
+        store.setup("prom", DEFAULT_SCHEMAS, 0, cfg)
+        half = max(len(containers) // 2, 1)
+        for off in range(half):
+            store.ingest("prom", 0, containers[off], offset=off)
+        store.get_shard("prom", 0).flush_all()
+
+        store2 = TimeSeriesMemStore(disk, meta)
+        shard2 = store2.setup("prom", DEFAULT_SCHEMAS, 0, cfg)
+        store2.recover_index("prom", 0)
+        store2.recover_stream(
+            "prom", 0, [(off, c) for off, c in enumerate(containers)])
+        chunk_counts = {pid: len(p.chunks)
+                        for pid, p in shard2.partitions.items()}
+        f = [ColumnFilter("_metric_", Equals("heap_usage"))]
+        res = shard2.lookup_partitions(f, 0, 2**62)
+        for _ in range(2):  # second scan exercises the cached backfill
+            tags_list, batch = shard2.scan_batch(res.part_ids, 0, 2**62)
+            counts = np.asarray(batch.row_counts)[:len(tags_list)]
+            for i, t in enumerate(tags_list):
+                assert counts[i] == len(truth[t["instance"]][0]), t
+        for pid, p in shard2.partitions.items():
+            assert len(p.chunks) == chunk_counts[pid]  # not mutated
 
 
     def test_narrow_then_wide_query_sees_full_history(self, tmp_path):

@@ -287,6 +287,43 @@ class TestShardKeyRegexPlanner:
             BASE + 600_000, BASE + 900_000))
         assert "DistConcatExec" in ep.print_tree()
 
+    def test_plain_equals_leaf_keeps_its_selector(self):
+        """Regression: a join leaf that pins a shard-key column with a
+        plain Equals must not be overwritten by the regex expansion."""
+        ms, inner = _mk_cluster()
+        skr = ShardKeyRegexPlanner(inner, self._matcher)
+        plan = _q(
+            'sum(rate(m_total{_ws_="demo",_ns_=~"App-0|App-1"}[5m])) '
+            '+ sum(rate(m_total{_ws_="demo",_ns_="App-9"}[5m]))',
+            BASE + 600_000, BASE + 900_000)
+        rewritten = skr._replace_keys(plan, {"_ns_": "App-1"},
+                                      {"_ns_": "App-0|App-1"})
+        selectors = []
+        for filters in lp.raw_series_filters(rewritten):
+            for f in filters:
+                if f.column == "_ns_":
+                    selectors.append(f.filter.value)
+        assert sorted(selectors) == ["App-1", "App-9"]
+
+    def test_distinct_regex_leaf_not_clobbered(self):
+        """A sibling leaf carrying a DIFFERENT regex on the same shard-key
+        column keeps its own regex (it is not the one being expanded)."""
+        ms, inner = _mk_cluster()
+        skr = ShardKeyRegexPlanner(inner, self._matcher)
+        plan = _q(
+            'sum(rate(m_total{_ws_="demo",_ns_=~"App-0|App-1"}[5m])) '
+            '+ sum(rate(m_total{_ws_="demo",_ns_=~"App-5|App-6"}[5m]))',
+            BASE + 600_000, BASE + 900_000)
+        rewritten = skr._replace_keys(plan, {"_ns_": "App-0"},
+                                      {"_ns_": "App-0|App-1"})
+        from filodb_tpu.core.filters import EqualsRegex
+        kept_regex = [f.filter.pattern
+                      for filters in lp.raw_series_filters(rewritten)
+                      for f in filters
+                      if f.column == "_ns_"
+                      and isinstance(f.filter, EqualsRegex)]
+        assert kept_regex == ["App-5|App-6"]
+
 
 class TestLogicalPlanToPromql:
     CASES = [
