@@ -3,14 +3,18 @@
 Workload: the QueryInMemoryBenchmark-equivalent hot path (reference:
 jmh/src/main/scala/filodb.jmh/QueryInMemoryBenchmark.scala:45-249, scaled to
 the BASELINE.json north-star config) — ``sum by (group)(rate(metric[5m]))``
-over 1M series × 1h of samples: the leaf scan -> windowed rate (with counter
-correction) -> grouped aggregation pipeline as one jitted XLA program.
+over 1M series × 1h of samples, running the aligned-grid leaf kernel
+(filodb_tpu/ops/grid.py): counter correction + windowed Prometheus rate +
+grouped sum fused into one Pallas kernel.  This is the kernel the
+device-resident serving path dispatches to when the layout invariant
+holds; end-to-end served throughput is benchmarked separately in
+benches/.
 
 Protocol (see .claude/skills/verify/SKILL.md gotchas): data is generated
 on-device from a scalar seed; the pipeline runs K statically-known
 iterations, each forced by a ``float(...)`` readback; elapsed time subtracts
-the measured no-op readback RTT.  int32 timestamps / float32 values (TPU
-f64 is emulated).
+the measured 1-iteration variant so generation + RTT + readback cancel.
+int32 timestamps / float32 values (TPU f64 is emulated).
 
 Baseline: the reference publishes no absolute numbers (BASELINE.md), so
 ``vs_baseline`` is measured against a single-core numpy implementation of
@@ -32,60 +36,63 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-S = int(os.environ.get("FILODB_BENCH_SERIES", 1_000_000))
-R = int(os.environ.get("FILODB_BENCH_ROWS", 60))        # 1h at 1m resolution
 G = int(os.environ.get("FILODB_BENCH_GROUPS", 1_000))   # sum by (group)
+PER = int(os.environ.get("FILODB_BENCH_PER_GROUP", 1_000))
+S = G * PER                                             # real series
+NB = int(os.environ.get("FILODB_BENCH_ROWS", 60))       # 1h at 1m resolution
 ITERS = int(os.environ.get("FILODB_BENCH_ITERS", 5))
 WINDOW_MS = 300_000                                     # rate(...[5m])
 STEP_MS = 60_000
 SUB = int(os.environ.get("FILODB_BENCH_NUMPY_SERIES", 2_000))
+GL = 1_024                                              # lanes per group
+T0 = 600_000
 
 
 def main():
     import jax
     import jax.numpy as jnp
 
-    from filodb_tpu.ops import windows
+    from filodb_tpu.ops.grid import GridQuery, rate_grid_grouped
 
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind})")
 
-    span_ms = R * STEP_MS
-    t0 = 600_000
-    steps_np = np.arange(t0 + WINDOW_MS, t0 + span_ms, STEP_MS, dtype=np.int32)
+    B = ((NB + 7) // 8) * 8                 # sublane-pad the bucket axis
+    S_pad = G * GL
+    steps_np = np.arange(T0 + WINDOW_MS, T0 + NB * STEP_MS, STEP_MS,
+                        dtype=np.int32)
     T = len(steps_np)
+    K = WINDOW_MS // STEP_MS
+    q = GridQuery(nsteps=T, kbuckets=K, gstep_ms=STEP_MS, is_rate=True)
 
     def gen_body(seed):
-        """On-device workload gen: jittered 1m-grid counter series."""
+        """On-device aligned-grid gen ([B, S] time-major): row c holds
+        the sample with ts in (T0+(c-1)*step, T0+c*step] (jittered 1m
+        scrapes)."""
         key = jax.random.PRNGKey(seed)
         k1, k2 = jax.random.split(key)
-        base = jnp.arange(R, dtype=jnp.int32) * STEP_MS + t0
-        jitter = jax.random.randint(k1, (S, R), 0, 30_000, dtype=jnp.int32)
-        ts = jnp.sort(base[None, :] + jitter, axis=1)
-        incr = jax.random.uniform(k2, (S, R), jnp.float32, 0.0, 10.0)
-        vals = jnp.cumsum(incr, axis=1)
-        return ts, vals
+        base = (jnp.arange(B, dtype=jnp.int32) * STEP_MS
+                + T0 - STEP_MS + 1)[:, None]
+        jitter = jax.random.randint(k1, (B, S_pad), 0, 30_000, jnp.int32)
+        ts = base + jitter
+        incr = jax.random.uniform(k2, (B, S_pad), jnp.float32, 0.0, 10.0)
+        vals = jnp.cumsum(incr, axis=0)
+        lane = jnp.arange(S_pad, dtype=jnp.int32) % GL
+        mask = ((jnp.arange(B) < NB)[:, None]) & ((lane < PER)[None, :])
+        # kernel contract: row 0 = first bucket of the first window
+        return ts[1:], jnp.where(mask, vals, jnp.nan)[1:]
 
-    def pipeline(ts, vals, ids, steps, bump):
-        # bump defeats cross-iteration CSE without changing the math shape
-        window = jnp.asarray(WINDOW_MS, dtype=ts.dtype)
-        stepped = windows.rate(ts, vals + bump, steps, window)     # [S, T]
-        fin = jnp.isfinite(stepped)
-        v = jnp.where(fin, stepped, 0.0)
-        s = jnp.zeros((G, T), stepped.dtype).at[ids].add(v)
-        c = jnp.zeros((G, T), stepped.dtype).at[ids].add(fin.astype(stepped.dtype))
-        return jnp.where(c > 0, s, jnp.nan)
+    def pipeline(ts, vals, bump):
+        s, c = rate_grid_grouped(ts, vals + bump, int(steps_np[0]), q,
+                                 group_lanes=GL)
+        return jnp.where(c > 0, s, jnp.nan)      # [G, T]
 
     def build(iters: int):
-        """Jitted: gen + `iters` statically-unrolled pipeline runs, scalar
-        in / scalar out so the axon tunnel re-uploads nothing per call."""
         def f(seed):
             ts, vals = gen_body(seed)
-            ids = jnp.arange(S, dtype=jnp.int32) % G
-            steps = jnp.asarray(steps_np)
             acc = jnp.float32(0.0)
             for i in range(iters):
-                out = pipeline(ts, vals, ids, steps, jnp.float32(i))
+                out = pipeline(ts, vals, jnp.float32(i))
                 acc = acc + out[0, 0] + out[G // 2, T // 2]
             return acc
         return jax.jit(f)
@@ -97,7 +104,7 @@ def main():
 
     def timed(f, reps=3):
         best = []
-        for r in range(reps):
+        for _ in range(reps):
             a = time.perf_counter()
             _ = float(f(0))
             best.append(time.perf_counter() - a)
@@ -106,23 +113,25 @@ def main():
     log("timing...")
     t_base = timed(f_base)
     t_full = timed(f_full)
-    elapsed = max(t_full - t_base, 1e-9)   # gen + RTT + readback cancel
-    samples_per_query = S * R
+    elapsed = max(t_full - t_base, 1e-9)
+    # row 0 is clipped to meet the kernel row contract: NB-1 real buckets
+    samples_per_query = S * (NB - 1)
     tpu_rate = samples_per_query * ITERS / elapsed
     log(f"device: {tpu_rate:.3e} samples/sec "
         f"({ITERS} queries in {elapsed:.3f}s; base {t_base:.3f}s, "
         f"full {t_full:.3f}s)")
-    ids_np = (np.arange(S) % G).astype(np.int32)
-    ts, vals = jax.jit(gen_body)(0)
 
     # -- numpy single-core proxy baseline on a subsample --------------------
-    sub_ts = np.asarray(jax.device_get(ts[:SUB])).astype(np.int64)
-    sub_vals = np.asarray(jax.device_get(vals[:SUB])).astype(np.float64)
+    ts, vals = jax.jit(gen_body)(0)
+    nsub = min(SUB, PER)               # stay inside group 0's real lanes
+    sub_ts = np.asarray(jax.device_get(ts[:, :nsub])).astype(np.int64).T
+    sub_vals = np.asarray(jax.device_get(vals[:, :nsub])).astype(np.float64).T
+    ids_np = np.zeros(nsub, dtype=np.int32)
     a = time.perf_counter()
-    _numpy_rate_sum(sub_ts, sub_vals, ids_np[:SUB], steps_np.astype(np.int64))
+    _numpy_rate_sum(sub_ts, sub_vals, ids_np, steps_np.astype(np.int64))
     np_elapsed = time.perf_counter() - a
-    np_rate = SUB * R / np_elapsed
-    log(f"numpy proxy: {np_rate:.3e} samples/sec ({SUB} series, "
+    np_rate = nsub * (NB - 1) / np_elapsed
+    log(f"numpy proxy: {np_rate:.3e} samples/sec ({nsub} series, "
         f"{np_elapsed:.3f}s)")
 
     print(json.dumps({
@@ -144,6 +153,10 @@ def _numpy_rate_sum(ts, vals, ids, steps):
     cnt = np.zeros((G_, T_))
     for s in range(S_):
         t_row, v_row = ts[s], vals[s]
+        fin = np.isfinite(v_row)
+        t_row, v_row = t_row[fin], v_row[fin]
+        if len(t_row) < 2:
+            continue
         corr = np.concatenate([[0.0], np.cumsum(np.maximum(
             v_row[:-1] - v_row[1:], 0.0))])
         v_adj = v_row + corr
@@ -156,7 +169,6 @@ def _numpy_rate_sum(ts, vals, ids, steps):
             if t2 == t1:
                 continue
             delta = v_adj[hi - 1] - v_adj[lo]
-            # Prometheus extrapolation
             n = hi - lo
             avg_dur = (t2 - t1) / (n - 1)
             ext_start = min(st - WINDOW_MS + avg_dur / 2, float(t1)) \

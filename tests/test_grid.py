@@ -1,0 +1,171 @@
+"""Aligned-grid leaf kernels vs the general windows implementation.
+
+The grid layout invariant ([B, S] time-major: row c holds the sample
+with ts in (t0+(c-1)*gstep, t0+c*gstep]) makes rate windows static
+slices; these
+tests prove the fast path is semantically identical to
+filodb_tpu.ops.windows.rate/increase (which the oracle-backed
+tests/test_windows.py already validates against the reference's
+RateFunctions semantics).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from filodb_tpu.ops import windows
+from filodb_tpu.ops.grid import (GridQuery, rate_grid, rate_grid_grouped,
+                                 rate_grid_ref, supports_grid)
+
+
+def _clip(ts, vals):
+    """Apply the kernel layout contract: row 0 = first bucket of the
+    first window (drop the pre-window bucket the generator emits)."""
+    return ts[1:], vals[1:]
+
+STEP = 60_000
+T0 = 600_000
+B = 40          # bucket columns
+K = 5           # window = 5 buckets
+
+
+def _aligned_data(n_series=64, seed=0, gap_frac=0.15, reset_frac=0.05):
+    """[B, S] grid honoring the layout invariant, with NaN gaps and
+    counter resets."""
+    rng = np.random.default_rng(seed)
+    base = (np.arange(B, dtype=np.int64) * STEP + T0 - STEP + 1)[:, None]
+    jitter = rng.integers(0, STEP - 1, size=(B, n_series))
+    ts = (base + jitter).astype(np.int64)
+    incr = rng.random((B, n_series)) * 10.0
+    vals = np.cumsum(incr, axis=0)
+    resets = rng.random((B, n_series)) < reset_frac
+    # a reset drops the counter back near zero from that row on
+    for s in range(n_series):
+        for c in np.where(resets[:, s])[0]:
+            vals[c:, s] -= vals[c, s] * 0.9
+    vals = vals.astype(np.float64)
+    gaps = rng.random((B, n_series)) < gap_frac
+    vals[gaps] = np.nan
+    return jnp.asarray(ts), jnp.asarray(vals)
+
+
+def _steps(n=None):
+    first = T0 + K * STEP
+    last = T0 + (B - 1) * STEP
+    s = np.arange(first, last + 1, STEP, dtype=np.int64)
+    return jnp.asarray(s if n is None else s[:n])
+
+
+class TestGridRef:
+    """Portable reference implementation vs windows.rate (exact)."""
+
+    @pytest.mark.parametrize("is_rate", [True, False])
+    def test_matches_windows(self, is_rate):
+        ts, vals = _aligned_data()
+        steps = _steps()
+        q = GridQuery(nsteps=len(steps), kbuckets=K, gstep_ms=STEP,
+                      is_rate=is_rate)
+        cts, cvals = _clip(ts, vals)
+        got = np.asarray(rate_grid_ref(cts.astype(jnp.int64),
+                                       cvals.astype(jnp.float32),
+                                       int(steps[0]), q))
+        fn = windows.rate if is_rate else windows.increase
+        want = np.asarray(fn(cts.T, cvals.T.astype(jnp.float32), steps,
+                             jnp.asarray(K * STEP, jnp.int64))).T
+        assert (np.isfinite(got) == np.isfinite(want)).all()
+        both = np.isfinite(got) & np.isfinite(want)
+        np.testing.assert_allclose(got[both], want[both], rtol=2e-5)
+
+    def test_all_nan_series(self):
+        ts, vals = _aligned_data(n_series=8)
+        vals = vals.at[:, 3].set(jnp.nan)
+        steps = _steps()
+        q = GridQuery(len(steps), K, STEP, True)
+        cts, cvals = _clip(ts, vals)
+        got = np.asarray(rate_grid_ref(cts, cvals.astype(jnp.float32),
+                                       int(steps[0]), q))
+        assert np.isnan(got[:, 3]).all()
+
+    def test_single_sample_windows_are_nan(self):
+        """n < 2 in a window -> no rate (reference: extrapolatedRate
+        requires two samples)."""
+        ts, vals = _aligned_data(n_series=4, gap_frac=0.0)
+        # first window covers cols 1..K; keep only col K finite in series 0
+        vals = vals.at[1:K, 0].set(jnp.nan)
+        steps = _steps()
+        q = GridQuery(len(steps), K, STEP, True)
+        cts, cvals = _clip(ts, vals)
+        got = np.asarray(rate_grid_ref(cts, cvals.astype(jnp.float32),
+                                       int(steps[0]), q))
+        assert np.isnan(got[0, 0])
+
+    def test_supports_grid(self):
+        assert supports_grid(300_000, 60_000, 60_000)
+        assert not supports_grid(300_000, 30_000, 60_000)   # step != gstep
+        assert not supports_grid(290_000, 60_000, 60_000)   # non-multiple
+
+    def test_auto_falls_back_off_tpu(self):
+        ts, vals = _clip(*_aligned_data(n_series=16))
+        steps = _steps()
+        q = GridQuery(len(steps), K, STEP, True)
+        from filodb_tpu.ops.grid import rate_grid_auto
+        got = np.asarray(rate_grid_auto(ts, vals.astype(jnp.float32),
+                                        int(steps[0]), q))
+        want = np.asarray(rate_grid_ref(ts, vals.astype(jnp.float32),
+                                        int(steps[0]), q))
+        np.testing.assert_array_equal(np.isfinite(got), np.isfinite(want))
+
+    def test_shape_validation(self):
+        ts, vals = _clip(*_aligned_data(n_series=16))
+        ts = ts.astype(jnp.int32)
+        vals = vals.astype(jnp.float32)
+        steps = _steps()
+        q = GridQuery(len(steps), K, STEP, True)
+        with pytest.raises(ValueError, match="multiple of lanes"):
+            rate_grid(ts, vals, int(steps[0]), q, lanes=1024)
+        with pytest.raises(ValueError, match="group count"):
+            rate_grid_grouped(ts, vals, int(steps[0]), q, group_lanes=16)
+        with pytest.raises(ValueError, match="rows"):
+            rate_grid(ts[:3], vals[:3], int(steps[0]), q, lanes=16,
+                      interpret=True)
+
+
+class TestGridPallasInterpret:
+    """Pallas kernels in interpreter mode (no TPU needed) vs the
+    portable reference."""
+
+    def _data128(self):
+        ts, vals = _clip(*_aligned_data(n_series=128))
+        return ts.astype(jnp.int32), vals.astype(jnp.float32)
+
+    def test_series_kernel(self):
+        ts, vals = self._data128()
+        steps = _steps()
+        q = GridQuery(len(steps), K, STEP, True)
+        want = np.asarray(rate_grid_ref(ts, vals, int(steps[0]), q))
+        got = np.asarray(rate_grid(ts, vals, int(steps[0]), q,
+                                   lanes=128, interpret=True))
+        assert (np.isfinite(got) == np.isfinite(want)).all()
+        both = np.isfinite(got)
+        # the in-kernel log-step scan associates the correction cumsum
+        # differently from jnp.cumsum: f32 round-off only
+        np.testing.assert_allclose(got[both], want[both], rtol=5e-5,
+                                   atol=1e-6)
+
+    def test_grouped_kernel(self):
+        ts, vals = self._data128()
+        # 8 groups x 16 lanes
+        steps = _steps()
+        q = GridQuery(len(steps), K, STEP, True)
+        s, c = rate_grid_grouped(ts, vals, int(steps[0]), q,
+                                 group_lanes=16, interpret=True)
+        r = np.asarray(rate_grid_ref(ts, vals, int(steps[0]), q))
+        s, c = np.asarray(s), np.asarray(c)
+        for g in range(8):
+            rg = r[:, g * 16:(g + 1) * 16]
+            ok = np.isfinite(rg)
+            np.testing.assert_allclose(s[g], np.where(ok, rg, 0).sum(axis=1),
+                                       rtol=1e-5, atol=1e-5)
+            np.testing.assert_array_equal(c[g], ok.sum(axis=1))
