@@ -26,6 +26,10 @@ class StoreConfig:
     # recompiles — SURVEY.md §7 "Ragged data")
     batch_row_pad: int = 64
     batch_series_pad: int = 128
+    # device-resident chunk store (HBM arena, reclaim-on-demand — the
+    # BlockManager equivalent, reference: memory/BlockManager.scala:142)
+    device_cache_bytes: int = 2 * 1024 * 1024 * 1024
+    grid_step_ms: Optional[int] = None   # bucket width; None = detect
 
     @staticmethod
     def from_config(conf: Mapping) -> "StoreConfig":
@@ -51,6 +55,10 @@ class StoreConfig:
                          d.evicted_pk_bloom_filter_capacity)),
             batch_row_pad=int(conf.get("batch-row-pad", d.batch_row_pad)),
             batch_series_pad=int(conf.get("batch-series-pad", d.batch_series_pad)),
+            device_cache_bytes=parse_size(conf.get("device-cache-size",
+                                                   d.device_cache_bytes)),
+            grid_step_ms=(parse_duration_ms(conf["grid-step"])
+                          if "grid-step" in conf else None),
         )
 
 

@@ -1,0 +1,357 @@
+"""Device-resident chunk store: the HBM arena the serving path reads from.
+
+The reference serves queries from off-heap block memory with
+reclaim-on-demand eviction (reference: memory/src/main/scala/filodb.memory/
+BlockManager.scala:142 PageAlignedBlockManager, Block.scala:90; eviction
+callbacks into TimeSeriesShard.scala:279-301).  The TPU equivalent keeps
+frozen chunk data **on device** as time-bucketed grids so queries read HBM
+directly instead of re-uploading numpy per query:
+
+- Per (shard, schema, column) a :class:`DeviceGridCache` assigns each
+  partition a fixed lane and materializes time **blocks** — device arrays
+  ``[BLOCK_BUCKETS, lanes]`` (ts-relative int32 + float32 values) covering
+  ``BLOCK_BUCKETS`` consecutive buckets of width ``gstep``.
+- Blocks are built once from the partitions' frozen chunks (host decode ->
+  one ``device_put``) and then serve every later query from HBM; a repeat
+  query performs **zero** host->device chunk transfer.
+- Blocks are evicted oldest-first when the arena exceeds its byte budget
+  (``StoreConfig.device_cache_bytes``) — reclaim-on-demand in time order,
+  like the reference's time-ordered block lists.
+- Chunk freezes invalidate overlapping blocks (the shard wires
+  ``partition.on_freeze`` to :meth:`note_freeze`); the mutable write-buffer
+  tail is served through a version-tagged tail block rebuilt only when new
+  data arrived.
+
+The grid layout contract matches :mod:`filodb_tpu.ops.grid`: row ``c``
+holds the (single) sample with ``ts in (epoch0+(c-1)*gstep, epoch0+c*gstep]``.
+Partitions whose samples violate the one-per-bucket invariant disable the
+grid for this cache generation; queries then fall back to the general
+:mod:`filodb_tpu.ops.windows` path, so the fast path is never wrong, only
+absent.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from filodb_tpu.ops.grid import GridQuery, rate_grid_auto, supports_grid
+from filodb_tpu.query.logical import RangeFunctionId as F
+
+BLOCK_BUCKETS = 128
+_LANE_PAD = 128
+_I32_SPAN = 2**31 - 2
+
+
+class _Block:
+    """One resident time block: device arrays [BLOCK_BUCKETS, lanes]."""
+
+    __slots__ = ("ts", "vals", "lanes", "nbytes", "last_used")
+
+    def __init__(self, ts, vals, lanes: int, seq: int):
+        self.ts = ts
+        self.vals = vals
+        self.lanes = lanes
+        self.nbytes = int(ts.size * 4 + vals.size * 4)
+        self.last_used = seq
+
+
+class DeviceGridCache:
+    """Per-(shard, schema, value-column) device grid with eviction."""
+
+    def __init__(self, shard, schema_hash: int, column_id: int,
+                 budget_bytes: int, gstep_ms: Optional[int] = None):
+        self._shard = shard
+        self.schema_hash = schema_hash
+        self.column_id = column_id
+        self.budget = budget_bytes
+        self.gstep = gstep_ms          # None until detected
+        self.epoch0: Optional[int] = None
+        self.lane_of: dict[int, int] = {}
+        self._next_lane = 0
+        self.blocks: dict[int, _Block] = {}
+        self._tails: dict[int, tuple[int, _Block]] = {}  # bi -> (ver, blk)
+        self.version = 0               # bumped on invalidating freezes
+        self.disabled_until_version = -1
+        self._disable_count = 0        # exponential re-try backoff
+        self._disk_floor: Optional[tuple[int, int]] = None  # (ver, floor_ms)
+        self._seq = 0
+        self._lock = threading.Lock()
+        # stats
+        self.builds = 0
+        self.hits = 0
+        self.evictions = 0
+
+    # ------------------------------------------------------------ bookkeeping
+
+    @property
+    def bytes_resident(self) -> int:
+        n = sum(b.nbytes for b in self.blocks.values())
+        n += sum(blk.nbytes for _v, blk in self._tails.values())
+        return n
+
+    def note_freeze(self, cs) -> None:
+        """A chunk froze: blocks overlapping it are stale (a lagging series
+        back-filled an old bucket), and the tail moved.  (The shard bumps
+        its ``ingest_epoch`` — our tail version — separately.)"""
+        with self._lock:
+            self._tails.clear()
+            if self.gstep is None or self.epoch0 is None:
+                return
+            lo_block = (cs.info.start_time - self.epoch0) // (
+                self.gstep * BLOCK_BUCKETS)
+            stale = [bi for bi in self.blocks if bi >= lo_block]
+            for bi in stale:
+                del self.blocks[bi]
+            if stale:
+                self.version += 1
+
+    _STD_STEPS = (1_000, 2_000, 5_000, 10_000, 15_000, 30_000, 60_000,
+                  120_000, 300_000, 600_000, 900_000, 1_800_000, 3_600_000)
+
+    def _detect_gstep(self, part) -> Optional[int]:
+        """Median inter-sample delta snapped to the nearest standard scrape
+        interval (jitter skews the raw median; the block build verifies the
+        one-sample-per-bucket invariant regardless)."""
+        ts, _ = part.read_range(0, 2**62, self.column_id)
+        if len(ts) < 3:
+            return None
+        deltas = np.diff(ts)
+        deltas = deltas[deltas > 0]
+        if len(deltas) == 0:
+            return None
+        med = float(np.median(deltas))
+        best = min(self._STD_STEPS, key=lambda c: abs(c - med))
+        if abs(best - med) <= 0.5 * best:
+            return best
+        return int(med)
+
+    def _disable(self) -> None:
+        """Turn the fast path off; retries back off exponentially so a
+        shard whose frozen history permanently violates the layout
+        invariant doesn't re-stage a full block on every query."""
+        self._disable_count += 1
+        backoff = 2 ** min(self._disable_count, 16)
+        self.disabled_until_version = self._shard.ingest_epoch + backoff
+        self.blocks.clear()
+        self._tails.clear()
+
+    # ---------------------------------------------------------------- serving
+
+    def scan_rate(self, part_ids: Sequence[int], func: F, steps0: int,
+                  nsteps: int, step_ms: int, window_ms: int):
+        """Serve ``rate``/``increase`` on the query step grid from device-
+        resident blocks.  Returns values ``[S_req, T]`` (numpy) or None when
+        the fast path cannot serve this query (caller falls back)."""
+        if func not in (F.RATE, F.INCREASE):
+            return None
+        with self._lock:
+            return self._scan_rate_locked(list(map(int, part_ids)), func,
+                                          steps0, nsteps, step_ms, window_ms)
+
+    def _scan_rate_locked(self, part_ids, func, steps0, nsteps, step_ms,
+                          window_ms):
+        shard = self._shard
+        parts = []
+        for pid in part_ids:
+            part = shard.partitions.get(pid)
+            if part is None:
+                return None                    # evicted/paged: fall back
+            if part.schema.schema_hash != self.schema_hash:
+                return None                    # mixed-schema id list
+            parts.append(part)
+        if not parts:
+            return None
+        if self.disabled_until_version >= self._shard.ingest_epoch:
+            return None
+        if self.gstep is None:
+            g = self._shard.config.grid_step_ms or self._detect_gstep(parts[0])
+            if not g or g <= 0:
+                self._disable()                # don't re-detect every query
+                return None
+            self.gstep = g
+        g = self.gstep
+        if not supports_grid(window_ms, step_ms, g):
+            return None
+        if self.epoch0 is None:
+            first = min(p.earliest_timestamp for p in parts
+                        if p.earliest_timestamp >= 0)
+            self.epoch0 = (first // g) * g
+        if (steps0 - self.epoch0) % g != 0:
+            return None                        # windows don't land on edges
+        K = window_ms // g
+        # first window ends at steps0 and covers buckets [c0, c0+K-1]
+        c0 = (steps0 - self.epoch0) // g - K + 1
+        c_last = c0 + (nsteps - 1) + K - 1     # inclusive
+        if c0 < 0:
+            return None
+        if hasattr(shard, "paged"):
+            # ODP shard: residents may hold only their post-recovery tail,
+            # with older chunks on disk; the grid would serve NaN there
+            lo_ms = self.epoch0 + (c0 - 1) * g
+            if lo_ms < self._disk_floor_ms(parts):
+                return None
+        if (c_last + 1) * g > _I32_SPAN:
+            return None                        # int32-relative overflow
+        new_lane = False
+        for p in parts:
+            if p.part_id not in self.lane_of:
+                self.lane_of[p.part_id] = self._next_lane
+                self._next_lane += 1
+                new_lane = True
+        lanes = max(_LANE_PAD,
+                    -(-self._next_lane // _LANE_PAD) * _LANE_PAD)
+        if new_lane and any(b.lanes != lanes for b in self.blocks.values()):
+            self.blocks.clear()                # widths must match to concat
+            self._tails.clear()
+        frozen_hi = self._frozen_high()
+        bi_lo = c0 // BLOCK_BUCKETS
+        bi_hi = c_last // BLOCK_BUCKETS
+        segments = []
+        self._seq += 1
+        for bi in range(bi_lo, bi_hi + 1):
+            blk = self._block_for(bi, lanes, frozen_hi)
+            if blk is None:
+                return None                    # invariant violated
+            blk.last_used = self._seq
+            segments.append(blk)
+        self._evict(keep=set(range(bi_lo, bi_hi + 1)))
+
+        import jax.numpy as jnp
+        from jax import lax
+
+        if len(segments) == 1:
+            ts_all, val_all = segments[0].ts, segments[0].vals
+        else:
+            ts_all = jnp.concatenate([b.ts for b in segments], axis=0)
+            val_all = jnp.concatenate([b.vals for b in segments], axis=0)
+        row0 = c0 - bi_lo * BLOCK_BUCKETS
+        nrows = c_last - c0 + 1
+        ts_sl = lax.dynamic_slice_in_dim(ts_all, row0, nrows, axis=0)
+        val_sl = lax.dynamic_slice_in_dim(val_all, row0, nrows, axis=0)
+        q = GridQuery(nsteps=nsteps, kbuckets=K, gstep_ms=g,
+                      is_rate=(func == F.RATE))
+        lane_mult = 1024 if ts_sl.shape[1] % 1024 == 0 else _LANE_PAD
+        out = rate_grid_auto(ts_sl, val_sl, steps0 - self.epoch0, q,
+                             lanes=lane_mult)            # [T, lanes]
+        self.hits += 1
+        out_np = np.asarray(out)
+        lanes_req = [self.lane_of[pid] for pid in part_ids]
+        return out_np[:, lanes_req].T                     # [S_req, T]
+
+    # ---------------------------------------------------------------- blocks
+
+    def _disk_floor_ms(self, parts) -> int:
+        """Highest timestamp below which some requested partition's data
+        lives only in the column store (recovery tail / re-ingested after
+        eviction).  Cached per shard ingest epoch."""
+        epoch = self._shard.ingest_epoch
+        if self._disk_floor is not None and self._disk_floor[0] == epoch:
+            return self._disk_floor[1]
+        floor = -(2**62)
+        index = self._shard.index
+        for part in parts:
+            earliest = part.earliest_timestamp
+            if earliest < 0:
+                continue
+            try:
+                idx_start = index.start_time(part.part_id)
+            except KeyError:
+                continue
+            if idx_start < earliest:
+                floor = max(floor, earliest)
+        self._disk_floor = (epoch, floor)
+        return floor
+
+    def _frozen_high(self) -> int:
+        """Highest bucket (exclusive) fully covered by frozen chunks: the
+        earliest write-buffer row across lanes bounds it."""
+        lo = None
+        for pid in self.lane_of:
+            part = self._shard.partitions.get(pid)
+            if part is None:
+                continue
+            if part._buf_n:
+                t = int(part._buf_ts[0])
+                lo = t if lo is None or t < lo else lo
+        if lo is None:
+            return 2**62
+        # bucket containing lo is NOT fully frozen
+        return (lo - self.epoch0 + self.gstep - 1) // self.gstep - 1
+
+    def _block_for(self, bi: int, lanes: int, frozen_hi: int):
+        blk = self.blocks.get(bi)
+        if blk is not None and blk.lanes == lanes:
+            return blk
+        b_lo = bi * BLOCK_BUCKETS          # first bucket index of the block
+        b_hi = b_lo + BLOCK_BUCKETS - 1
+        if b_hi > frozen_hi:
+            # tail block: includes mutable write-buffer rows; cache under
+            # the shard's ingest epoch so repeat queries skip the rebuild
+            epoch = self._shard.ingest_epoch
+            got = self._tails.get(bi)
+            if got is not None and got[0] == epoch and got[1].lanes == lanes:
+                return got[1]
+            blk = self._build(bi, lanes)
+            if blk is not None:
+                self._tails[bi] = (epoch, blk)
+                while len(self._tails) > 8:      # bound lagging-replay spans
+                    self._tails.pop(next(iter(self._tails)))
+            return blk
+        blk = self._build(bi, lanes)
+        if blk is not None:
+            self.blocks[bi] = blk
+            self.version += 1
+        return blk
+
+    def _val_dtype(self):
+        """f32 on TPU (matching the Pallas kernels); f64 on CPU backends so
+        the portable reference path keeps full double precision."""
+        import jax
+
+        if jax.default_backend() in ("tpu", "axon"):
+            return np.float32
+        return np.float64 if jax.config.jax_enable_x64 else np.float32
+
+    def _build(self, bi: int, lanes: int):
+        """Host staging + one upload for block ``bi``."""
+        import jax
+
+        g = self.gstep
+        # block bi holds buckets [bi*BB, bi*BB+BB-1]; bucket c covers
+        # (epoch0+(c-1)*g, epoch0+c*g]
+        b_lo_ms = self.epoch0 + (bi * BLOCK_BUCKETS - 1) * g  # left edge excl
+        b_hi_ms = b_lo_ms + BLOCK_BUCKETS * g                 # right edge incl
+        ts_stage = np.zeros((BLOCK_BUCKETS, lanes), np.int32)
+        val_stage = np.full((BLOCK_BUCKETS, lanes), np.nan, self._val_dtype())
+        for pid, lane in self.lane_of.items():
+            part = self._shard.partitions.get(pid)
+            if part is None:
+                continue
+            ts, vals = part.read_range(b_lo_ms + 1, b_hi_ms, self.column_id)
+            if len(ts) == 0:
+                continue
+            if not isinstance(vals, np.ndarray):
+                self._disable()                 # string/hist column
+                return None
+            buckets = (ts - self.epoch0 + g - 1) // g - bi * BLOCK_BUCKETS
+            if len(np.unique(buckets)) != len(buckets):
+                self._disable()                 # >1 sample per bucket
+                return None
+            ts_stage[buckets, lane] = (ts - self.epoch0).astype(np.int32)
+            val_stage[buckets, lane] = vals
+        self.builds += 1
+        return _Block(jax.device_put(ts_stage), jax.device_put(val_stage),
+                      lanes, self._seq)
+
+    def _evict(self, keep: set) -> None:
+        """Oldest-first reclaim under the byte budget (the reference's
+        reclaim-on-demand over time-ordered block lists)."""
+        while self.bytes_resident > self.budget and len(self.blocks) > 1:
+            victims = [bi for bi in sorted(self.blocks) if bi not in keep]
+            if not victims:
+                break
+            del self.blocks[victims[0]]
+            self.evictions += 1

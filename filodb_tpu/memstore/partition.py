@@ -25,7 +25,7 @@ class TimeSeriesPartition:
     __slots__ = ("part_id", "schema", "partkey", "tags", "group",
                  "chunks", "_decoded", "_buf_ts", "_buf_cols", "_buf_n",
                  "_capacity", "_hist_buckets", "_seq", "_unflushed",
-                 "out_of_order_dropped")
+                 "out_of_order_dropped", "on_freeze")
 
     def __init__(self, part_id: int, schema: Schema, partkey: bytes,
                  tags: dict[str, str], group: int, capacity: int = 400):
@@ -45,6 +45,8 @@ class TimeSeriesPartition:
         self._seq = 0
         self._unflushed: list[ChunkSet] = []
         self.out_of_order_dropped = 0
+        # shard hook observing chunk freezes (device grid invalidation)
+        self.on_freeze = None
 
     def _new_col_buffer(self, ctype: ColumnType):
         if ctype == ColumnType.DOUBLE:
@@ -129,6 +131,8 @@ class TimeSeriesPartition:
         self._buf_n = 0
         self._buf_cols = [self._new_col_buffer(c.ctype)
                           for c in self.schema.data.columns[1:]]
+        if self.on_freeze is not None:
+            self.on_freeze(cs)
         return cs
 
     def make_flush_chunks(self) -> list[ChunkSet]:

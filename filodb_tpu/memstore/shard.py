@@ -92,6 +92,12 @@ class TimeSeriesShard:
         self.evicted_keys = BloomFilter(self.config.evicted_pk_bloom_filter_capacity)
         self.stats = ShardStats()
         self.ingest_sched_check = None  # optional thread-name assertion hook
+        # device-resident chunk grids (HBM arena; memstore/devicestore.py),
+        # one per (schema, value column); created lazily on first grid scan
+        self.device_caches: dict = {}
+        # monotone counter observed by the device caches' tail versioning:
+        # bumped whenever new rows or chunks could change query results
+        self.ingest_epoch = 0
         # flush-time downsampling (reference: ShardDownsampler invoked from
         # doFlushSteps :915-917); set via enable_downsampling()
         self.downsample_publisher = None
@@ -133,6 +139,8 @@ class TimeSeriesShard:
                 self.index.mark_active(part.part_id)
             self._dirty_partkeys[group].add(part.part_id)
         self.latest_offset = max(self.latest_offset, offset)
+        if n:
+            self.ingest_epoch += 1
         return n
 
     def _get_or_add_partition(self, rec: IngestRecord) -> TimeSeriesPartition:
@@ -148,6 +156,7 @@ class TimeSeriesShard:
             part = TimeSeriesPartition(pid, schema, pk, rec.tags,
                                        rec.part_hash % self.num_groups,
                                        capacity=self.config.max_chunks_size)
+            part.on_freeze = self._on_chunk_freeze
             self.partitions[pid] = part
             self.index.mark_active(pid)
             return part
@@ -160,6 +169,7 @@ class TimeSeriesShard:
         group = rec.part_hash % self.num_groups
         part = TimeSeriesPartition(pid, schema, pk, rec.tags, group,
                                    capacity=self.config.max_chunks_size)
+        part.on_freeze = self._on_chunk_freeze
         self.partitions[pid] = part
         self.part_set[pk] = pid
         self.part_schema_hash[pid] = rec.schema_hash
@@ -300,6 +310,55 @@ class TimeSeriesShard:
         """Resolve a part id for scanning.  The ODP shard overrides this to
         consult its paged-partition cache as well."""
         return self.partitions.get(part_id)
+
+    # --------------------------------------------------- device-resident scan
+
+    def _on_chunk_freeze(self, cs) -> None:
+        self.ingest_epoch += 1
+        for (shash, _cid), cache in self.device_caches.items():
+            if shash == cs.schema_hash or cs.schema_hash == 0:
+                cache.note_freeze(cs)
+
+    def device_cache(self, schema_hash: int, column_id: int):
+        cache = self.device_caches.get((schema_hash, column_id))
+        if cache is None:
+            from filodb_tpu.memstore.devicestore import DeviceGridCache
+            cache = DeviceGridCache(self, schema_hash, column_id,
+                                    self.config.device_cache_bytes,
+                                    self.config.grid_step_ms)
+            self.device_caches[(schema_hash, column_id)] = cache
+        return cache
+
+    def scan_grid(self, part_ids: Sequence[int], func, steps0: int,
+                  nsteps: int, step_ms: int, window_ms: int,
+                  column_id: Optional[int] = None):
+        """Serve a windowed range function directly from the device-resident
+        grid (memstore/devicestore.py).  Returns ``(tags_list, vals[S, T])``
+        or None when the fast path cannot serve this query — the caller then
+        uses :meth:`scan_batch` + the general kernels.  This is the serving
+        seam the reference places at block memory (queries read encoded
+        chunks straight from BlockManager memory, never re-copying them)."""
+        ids = [int(p) for p in part_ids]
+        if not ids:
+            return None
+        first = self.partitions.get(ids[0])
+        if first is None:
+            return None
+        cid = first.schema.data.value_column_id if column_id is None \
+            else column_id
+        if first.schema.data.columns[cid].ctype != ColumnType.DOUBLE:
+            return None
+        cache = self.device_cache(first.schema.schema_hash, cid)
+        vals = cache.scan_rate(ids, func, steps0, nsteps, step_ms, window_ms)
+        if vals is None:
+            return None
+        tags_list = []
+        for pid in ids:
+            part = self.partitions.get(pid)
+            if part is None:
+                return None   # concurrently evicted mid-query: fall back
+            tags_list.append(part.tags)
+        return tags_list, vals
 
     def scan_batch(self, part_ids: Sequence[int], start_time: int, end_time: int,
                    column_id: Optional[int] = None

@@ -68,11 +68,25 @@ class GridQuery(NamedTuple):
 
 def _correct_and_mask(ts, vals, roll):
     """Counter correction (prefix formulation of the reference's
-    CorrectionMeta threading) + finite mask, on a [B, L] tile."""
+    CorrectionMeta threading) + finite mask, on a [B, L] tile.
+
+    A reset must be detected against the previous *finite* sample — a
+    missed scrape leaves a NaN bucket, and comparing against NaN would
+    silently skip the correction (the dense general path has no holes).
+    The previous finite value is a log-step forward-fill scan."""
     nb = ts.shape[0]
     fin = jnp.isfinite(vals)
     row = jax.lax.broadcasted_iota(jnp.int32, vals.shape, 0)
-    prev = roll(vals, 1)
+    # forward fill: ffill[r] = last finite value at row <= r
+    fv, fm = vals, fin
+    sh = 1
+    while sh < nb:
+        shifted_v, shifted_m = roll(fv, sh), roll(fm, sh)
+        in_range = row >= sh
+        fv = jnp.where(fm, fv, jnp.where(in_range, shifted_v, fv))
+        fm = fm | (in_range & shifted_m)
+        sh *= 2
+    prev = roll(fv, 1)                         # last finite at row <= r-1
     prev = jnp.where(row == 0, vals, prev)
     drop = jnp.where(vals < prev, prev, 0.0)   # NaN compares are False
     acc = drop
@@ -89,18 +103,19 @@ def _window_stats(ts, fin, vcorr, q: GridQuery):
     sublane slices: window t covers rows [t, t+K-1]."""
     ns = ts.shape[1]
     T = q.nsteps
+    dt = vcorr.dtype
     sl = lambda x, d: jax.lax.slice(x, (d, 0), (d + T, ns))
     shape = (T, ns)
-    nf = jnp.zeros(shape, jnp.float32)
+    nf = jnp.zeros(shape, dt)
     t2 = jnp.full(shape, _IBIG, ts.dtype)
-    v2 = jnp.full(shape, jnp.nan, jnp.float32)
+    v2 = jnp.full(shape, jnp.nan, dt)
     for d in range(q.kbuckets):            # forward: last finite wins
         fd = sl(fin, d)
-        nf = nf + fd.astype(jnp.float32)
+        nf = nf + fd.astype(dt)
         t2 = jnp.where(fd, sl(ts, d), t2)
         v2 = jnp.where(fd, sl(vcorr, d), v2)
     t1 = jnp.full(shape, _IBIG, ts.dtype)
-    v1 = jnp.full(shape, jnp.nan, jnp.float32)
+    v1 = jnp.full(shape, jnp.nan, dt)
     for d in range(q.kbuckets - 1, -1, -1):  # reverse: first finite wins
         fd = sl(fin, d)
         t1 = jnp.where(fd, sl(ts, d), t1)
@@ -112,12 +127,13 @@ def _extrapolate(nf, t1, t2, v1, v2, steps0, q: GridQuery):
     """Prometheus extrapolatedRate on [T, L] tiles (reference:
     RateFunctions.scala:37-80; same math as windows._extrapolated)."""
     ns = nf.shape[1]
+    dt = v1.dtype
     window = q.kbuckets * q.gstep_ms
     tcol = jax.lax.broadcasted_iota(jnp.int32, (q.nsteps, ns), 0)
-    hi = (steps0 + tcol * jnp.int32(q.gstep_ms)).astype(jnp.float32)
-    lo = hi - jnp.float32(window)
-    t1f = t1.astype(jnp.float32)
-    t2f = t2.astype(jnp.float32)
+    hi = (steps0 + tcol * jnp.int32(q.gstep_ms)).astype(dt)
+    lo = hi - jnp.asarray(window, dt)
+    t1f = t1.astype(dt)
+    t2f = t2.astype(dt)
     dur_start = (t1f - lo) / 1000.0
     dur_end = (hi - t2f) / 1000.0
     sampled = (t2f - t1f) / 1000.0
@@ -131,7 +147,7 @@ def _extrapolate(nf, t1, t2, v1, v2, steps0, q: GridQuery):
               + jnp.where(dur_end < thresh, dur_end, avg_dur / 2.0))
     scaled = delta * extrap / jnp.where(sampled == 0, 1.0, sampled)
     if q.is_rate:
-        scaled = scaled / (jnp.float32(window) / 1000.0)
+        scaled = scaled / (jnp.asarray(window, dt) / 1000.0)
     return jnp.where((nf >= 2) & (sampled > 0), scaled, jnp.nan)
 
 
@@ -244,7 +260,7 @@ def rate_grid_ref(ts, vals, steps0: int, q: GridQuery):
     """Same semantics as :func:`rate_grid`, in portable jnp."""
     def roll(x, s):
         return jnp.concatenate([x[-s:], x[:-s]], axis=0)
-    fin, vcorr = _correct_and_mask(ts, vals.astype(jnp.float32), roll)
+    fin, vcorr = _correct_and_mask(ts, vals, roll)
     nf, t1, t2, v1, v2 = _window_stats(ts, fin, vcorr, q)
     return _extrapolate(nf, t1, t2, v1, v2, jnp.int32(steps0), q)
 

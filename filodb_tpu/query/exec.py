@@ -185,9 +185,36 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         if self.column is not None and lookup.first_schema_hash is not None:
             schema = shard.schemas.by_hash(lookup.first_schema_hash)
             column_id = schema.data.column(self.column).id
+        served = self._try_device_grid(shard, lookup.part_ids, column_id)
+        if served is not None:
+            return served
         tags, batch = shard.scan_batch(lookup.part_ids, self.start_ms,
                                        self.end_ms, column_id)
         return [RawBatch(tags, batch)]
+
+    def _try_device_grid(self, shard, part_ids, column_id):
+        """Serve leaf + PeriodicSamplesMapper straight from the shard's
+        device-resident grid (memstore/devicestore.py) when the first
+        transformer is an eligible windowed rate/increase.  Emits the
+        already-stepped PeriodicBatch; the mapper passes it through."""
+        from filodb_tpu.query.transformers import PeriodicSamplesMapper
+        if not self.transformers or len(part_ids) == 0:
+            return None
+        mapper = self.transformers[0]
+        if not isinstance(mapper, PeriodicSamplesMapper):
+            return None
+        if mapper.window_ms is None or mapper.function is None:
+            return None
+        steps = StepRange(mapper.start_ms - mapper.offset_ms,
+                          mapper.end_ms - mapper.offset_ms, mapper.step_ms)
+        got = shard.scan_grid(part_ids, mapper.function, steps.start,
+                              steps.num_steps, steps.step, mapper.window_ms,
+                              column_id)
+        if got is None:
+            return None
+        tags, vals = got
+        report = StepRange(mapper.start_ms, mapper.end_ms, mapper.step_ms)
+        return [PeriodicBatch(tags, report, vals)]
 
     def _args_str(self) -> str:
         return f"dataset={self.dataset}, shard={self.shard}, " \

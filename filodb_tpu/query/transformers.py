@@ -57,6 +57,11 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
         report = StepRange(self.start_ms, self.end_ms, self.step_ms)
         window = self.window_ms if self.window_ms else self.stale_ms
         for b in batches:
+            if isinstance(b, PeriodicBatch):
+                # the leaf already stepped this batch from the device grid
+                # (exec.MultiSchemaPartitionsExec._try_device_grid)
+                out.append(b)
+                continue
             if not isinstance(b, RawBatch):
                 raise QueryError("", f"PeriodicSamplesMapper over {type(b).__name__}")
             if b.batch is None or not b.keys:

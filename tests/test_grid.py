@@ -71,8 +71,20 @@ class TestGridRef:
         got = np.asarray(rate_grid_ref(cts.astype(jnp.int64),
                                        cvals.astype(jnp.float32),
                                        int(steps[0]), q))
+        # the general path sees DENSE samples (read_range drops gaps);
+        # compact each series and pad trailing rows like scan_batch does
+        tsn, vn = np.asarray(cts), np.asarray(cvals)
+        S = tsn.shape[1]
+        dense_ts = np.full((S, tsn.shape[0]), 2**60, np.int64)
+        dense_v = np.full((S, tsn.shape[0]), np.nan)
+        for s in range(S):
+            keep = np.isfinite(vn[:, s])
+            k = keep.sum()
+            dense_ts[s, :k] = tsn[keep, s]
+            dense_v[s, :k] = vn[keep, s]
         fn = windows.rate if is_rate else windows.increase
-        want = np.asarray(fn(cts.T, cvals.T.astype(jnp.float32), steps,
+        want = np.asarray(fn(jnp.asarray(dense_ts),
+                             jnp.asarray(dense_v, dtype=jnp.float32), steps,
                              jnp.asarray(K * STEP, jnp.int64))).T
         assert (np.isfinite(got) == np.isfinite(want)).all()
         both = np.isfinite(got) & np.isfinite(want)
@@ -100,6 +112,34 @@ class TestGridRef:
         got = np.asarray(rate_grid_ref(cts, cvals.astype(jnp.float32),
                                        int(steps[0]), q))
         assert np.isnan(got[0, 0])
+
+    def test_reset_after_gap_matches_dense_path(self):
+        """A counter reset right after a missed scrape: the grid holds a
+        NaN hole where the dense general path holds adjacent samples; the
+        correction must still fire (regression: prev-compare against NaN
+        silently skipped it)."""
+        n = 16
+        base = (np.arange(B, dtype=np.int64) * STEP + T0 - STEP + 1)[:, None]
+        ts = (base + 10_000 + np.zeros((B, n), np.int64))
+        vals = np.cumsum(np.full((B, n), 7.0), axis=0)
+        vals[10:, :] -= vals[10, 0] - 1.0          # reset at row 10
+        vals[9, :] = np.nan                        # missed scrape before it
+        tsj = jnp.asarray(ts)
+        vj = jnp.asarray(vals)
+        steps = _steps()
+        q = GridQuery(len(steps), K, STEP, True)
+        cts, cvals = _clip(tsj, vj)
+        got = np.asarray(rate_grid_ref(cts, cvals.astype(jnp.float32),
+                                       int(steps[0]), q))
+        # dense oracle: drop the NaN row entirely (what read_range yields)
+        keep = ~np.isnan(vals[:, 0])
+        dts = jnp.asarray(ts[keep][1:].T)
+        dvals = jnp.asarray(vals[keep][1:].T)
+        want = np.asarray(windows.rate(dts, dvals, steps,
+                                       jnp.asarray(K * STEP, jnp.int64))).T
+        both = np.isfinite(got) & np.isfinite(want)
+        assert both.any()
+        np.testing.assert_allclose(got[both], want[both], rtol=2e-5)
 
     def test_supports_grid(self):
         assert supports_grid(300_000, 60_000, 60_000)
