@@ -77,14 +77,16 @@ def _correct_and_mask(ts, vals, roll):
     nb = ts.shape[0]
     fin = jnp.isfinite(vals)
     row = jax.lax.broadcasted_iota(jnp.int32, vals.shape, 0)
-    # forward fill: ffill[r] = last finite value at row <= r
-    fv, fm = vals, fin
+    # forward fill: ffill[r] = last finite value at row <= r.  The mask
+    # scans as int32 — Mosaic's dynamic_rotate has no i1 lowering
+    # ("Rotate with non-32-bit data"), so never roll a bool tile.
+    fv, fm = vals, fin.astype(jnp.int32)
     sh = 1
     while sh < nb:
         shifted_v, shifted_m = roll(fv, sh), roll(fm, sh)
         in_range = row >= sh
-        fv = jnp.where(fm, fv, jnp.where(in_range, shifted_v, fv))
-        fm = fm | (in_range & shifted_m)
+        fv = jnp.where(fm > 0, fv, jnp.where(in_range, shifted_v, fv))
+        fm = fm | jnp.where(in_range, shifted_m, 0)
         sh *= 2
     prev = roll(fv, 1)                         # last finite at row <= r-1
     prev = jnp.where(row == 0, vals, prev)
