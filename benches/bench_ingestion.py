@@ -34,15 +34,25 @@ def main():
     val_cols = [rng.random(N_ROWS) for _ in range(N_SERIES)]
     total = N_SERIES * N_ROWS
 
-    def build():
+    def build_perrow():
         b = RecordBuilder(DEFAULT_SCHEMAS["gauge"])
         for tags, ts, vals in zip(tag_sets, ts_cols, val_cols):
             for t, v in zip(ts, vals):
                 b.add(int(t), [float(v)], tags)
         return b.containers()
 
+    def build():
+        b = RecordBuilder(DEFAULT_SCHEMAS["gauge"])
+        for tags, ts, vals in zip(tag_sets, ts_cols, val_cols):
+            b.add_series(ts, [vals], tags)
+        return b.containers()
+
+    t_build = timed(lambda: build_perrow())
+    emit("record build throughput (per-row)", total / t_build, "records/sec")
     t_build = timed(lambda: build())
-    emit("record build throughput", total / t_build, "records/sec")
+    emit("record build throughput (add_series)", total / t_build,
+         "records/sec")
+    assert build() == build_perrow(), "add_series diverged from per-row build"
 
     containers = build()
 
@@ -66,6 +76,17 @@ def main():
     t_ing = timed(ingest)
     emit("shard ingest throughput (incl. decode+index)", total / t_ing,
          "records/sec")
+
+    def ingest_pipelined():
+        ms = TimeSeriesMemStore()
+        ms.setup("bench", DEFAULT_SCHEMAS, 0)
+        ms.ingest_stream("bench", 0, enumerate(containers),
+                         flush_interval_ms=600_000, flush_parallelism=2)
+        return ms
+
+    t_pipe = timed(ingest_pipelined)
+    emit("stream ingest w/ pipelined time-boundary flushes",
+         total / t_pipe, "records/sec")
 
     ms = ingest()
     sh = ms.get_shard("bench", 0)

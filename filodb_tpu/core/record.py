@@ -132,6 +132,76 @@ class RecordBuilder:
             self._flush_container()
         self._cur += rec
 
+    def add_series(self, timestamps: Sequence, columns: Sequence[Sequence],
+                   tags: Mapping[str, str]) -> int:
+        """Vectorized add of one series' samples: hashes and the partkey
+        are computed once, and all records are encoded with a numpy
+        structured array in one pass.  Producers naturally hold
+        per-series batches (reference: RecordBuilder reuse across a
+        container, RecordBuilder.scala:32; the gateway's InputRecords
+        carry one series each).  Falls back to per-row :meth:`add` for
+        histogram/string schemas.  Returns records added."""
+        n = len(timestamps)
+        if n == 0:
+            return 0
+        data_cols = self.schema.data.columns[1:]
+        if len(columns) != len(data_cols):
+            raise ValueError(f"expected {len(data_cols)} columns, "
+                             f"got {len(columns)}")
+        if any(c.ctype not in (ColumnType.DOUBLE, ColumnType.LONG,
+                               ColumnType.TIMESTAMP, ColumnType.INT)
+               for c in data_cols):
+            for i, t in enumerate(timestamps):
+                self.add(int(t), [col[i] for col in columns], tags)
+            return n
+        mcol = self.options.metric_column
+        if mcol != "__name__" and "__name__" in tags:
+            norm = dict(tags)
+            norm[mcol] = norm.pop("__name__")
+            tags = norm
+        shash = shard_key_hash(tags, self.options)
+        phash = partition_hash(tags, self.options)
+        pk = canonical_partkey(tags)
+        fields = [("schema", "<u2"), ("shash", "<u4"), ("phash", "<u4"),
+                  ("ts", "<i8")]
+        for ci, col in enumerate(data_cols):
+            if col.ctype == ColumnType.DOUBLE:
+                fields.append((f"c{ci}", "<f8"))
+            elif col.ctype == ColumnType.INT:
+                fields.append((f"c{ci}", "<i4"))
+            else:
+                fields.append((f"c{ci}", "<i8"))
+        fields.append(("pklen", "<u2"))
+        if pk:
+            fields.append(("pk", f"V{len(pk)}"))
+        rec = np.zeros(n, dtype=np.dtype(fields))
+        rec["schema"] = self.schema.schema_hash
+        rec["shash"] = shash
+        rec["phash"] = phash
+        rec["ts"] = np.asarray(timestamps, dtype=np.int64)
+        for ci, col in enumerate(data_cols):
+            arr = np.asarray(columns[ci])
+            rec[f"c{ci}"] = arr.astype(np.float64) \
+                if col.ctype == ColumnType.DOUBLE else arr.astype(np.int64) \
+                if col.ctype != ColumnType.INT else arr.astype(np.int32)
+        rec["pklen"] = len(pk)
+        if pk:
+            rec["pk"] = np.frombuffer(pk, dtype=np.uint8).view(f"V{len(pk)}")
+        blob = rec.tobytes()
+        rec_size = rec.dtype.itemsize
+        per = max((self.container_size - len(self._cur)) // rec_size, 0)
+        pos = 0
+        while pos < n:
+            if per == 0:
+                if self._cur:
+                    self._flush_container()
+                per = max(self.container_size // rec_size, 1)
+            take = min(per, n - pos)
+            self._cur += blob[pos * rec_size:(pos + take) * rec_size]
+            pos += take
+            per = (self.container_size - len(self._cur)) // rec_size
+        return n
+
     def _flush_container(self) -> None:
         self._containers.append(self._cur)
         self._cur = bytearray()

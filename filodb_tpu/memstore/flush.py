@@ -1,0 +1,136 @@
+"""Pipelined flush scheduling: time boundaries + a dedicated executor.
+
+The reference never flushes on the ingest thread: ``createFlushTasks``
+(ingest thread) detects per-group time-boundary crossings and snapshots
+buffers; ``doFlushSteps`` encodes and writes on a separate flush
+scheduler with ``flush-task-parallelism`` workers (reference:
+core/src/main/scala/filodb.core/memstore/TimeSeriesShard.scala:804-846,
+TimeSeriesMemStore.scala:106-129).  This module is that split for the
+TPU build: :class:`FlushScheduler` watches the shard's newest sample
+timestamp, and when group *g*'s staggered boundary is crossed it runs
+``shard.prepare_flush_group(g)`` inline (O(1) buffer detaches) and
+submits ``shard.run_flush_task`` (encode + IO) to a thread pool.
+
+Group boundaries are staggered across the flush interval — group g
+flushes at phase ``g/G`` of each interval — so flush load spreads evenly
+instead of spiking (reference :804-846).  Tasks for one group are
+chained so they execute in submission order (checkpoint monotonicity);
+different groups flush in parallel.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Optional
+
+
+class FlushScheduler:
+    """Drives pipelined flushes for one shard.
+
+    ``note_ingested()`` is called from the ingest thread after each
+    container; it is O(1) when no boundary was crossed.  ``close()``
+    drains all in-flight flush tasks.
+    """
+
+    def __init__(self, shard, flush_interval_ms: Optional[int] = None,
+                 parallelism: int = 2):
+        self.shard = shard
+        self.interval = flush_interval_ms or shard.config.flush_interval_ms
+        if self.interval <= 0:
+            raise ValueError("flush interval must be positive")
+        self.parallelism = parallelism
+        self._exec = ThreadPoolExecutor(
+            max_workers=parallelism,
+            thread_name_prefix=f"flush-{shard.dataset}-{shard.shard_num}")
+        ngroups = shard.num_groups
+        # group g's boundary phase within each interval
+        self._phase = [g * self.interval // ngroups for g in range(ngroups)]
+        self._next_boundary: list[Optional[int]] = [None] * ngroups
+        self._chains: dict[int, Future] = {}
+        self._lock = threading.Lock()
+        self.flushes_submitted = 0
+        self._closed = False
+
+    def _boundary_after(self, t: int, group: int) -> int:
+        ph = self._phase[group]
+        return ((t - ph) // self.interval + 1) * self.interval + ph
+
+    def note_ingested(self) -> int:
+        """Check boundary crossings against the shard's newest sample
+        timestamp; prepare + submit any due groups.  Returns the number
+        of flush tasks submitted."""
+        t = self.shard.latest_ingest_ts
+        if t < 0:
+            return 0
+        submitted = 0
+        for g in range(self.shard.num_groups):
+            nb = self._next_boundary[g]
+            if nb is None:
+                # first sight of data: schedule the next boundary
+                self._next_boundary[g] = self._boundary_after(t, g)
+                continue
+            if t >= nb:
+                self._next_boundary[g] = self._boundary_after(t, g)
+                self._submit(g)
+                submitted += 1
+        return submitted
+
+    def flush_now(self, group: Optional[int] = None) -> None:
+        """Force a flush of one group (or all) through the pipeline."""
+        groups = range(self.shard.num_groups) if group is None else (group,)
+        for g in groups:
+            self._submit(g)
+
+    def _submit(self, group: int) -> Future:
+        task = self.shard.prepare_flush_group(group)
+
+        def run(_prev: Optional[Future]) -> int:
+            return self.shard.run_flush_task(task)
+
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("FlushScheduler is closed")
+            prev = self._chains.get(group)
+            if prev is None:
+                fut = self._exec.submit(run, None)
+            else:
+                # chain: group tasks run in submission order even when the
+                # pool has spare workers (checkpoint monotonicity)
+                fut: Future = Future()
+
+                def after(p, _task=task, _fut=fut):
+                    try:
+                        _fut.set_result(self.shard.run_flush_task(_task))
+                    except BaseException as e:  # surface via the future
+                        _fut.set_exception(e)
+
+                prev.add_done_callback(
+                    lambda p: self._exec.submit(after, p))
+            self._chains[group] = fut
+            self.flushes_submitted += 1
+        return fut
+
+    def drain(self) -> None:
+        """Block until all submitted flush tasks completed."""
+        while True:
+            with self._lock:
+                futs = list(self._chains.values())
+            for f in futs:
+                f.result()
+            with self._lock:
+                if all(f.done() for f in self._chains.values()):
+                    return
+
+    def close(self, flush_remaining: bool = True) -> None:
+        """Drain, optionally flush whatever is still buffered, shut down.
+        The executor is shut down even when a flush task failed — the
+        task's exception still propagates to the caller."""
+        try:
+            if flush_remaining:
+                self.flush_now()
+            self.drain()
+        finally:
+            with self._lock:
+                self._closed = True
+            self._exec.shutdown(wait=True)

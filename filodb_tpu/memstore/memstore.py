@@ -73,12 +73,34 @@ class TimeSeriesMemStore:
 
     def ingest_stream(self, dataset: str, shard_num: int,
                       stream: Iterable[tuple[int, bytes]],
-                      flush_each: Optional[int] = None) -> int:
+                      flush_each: Optional[int] = None,
+                      flush_interval_ms: Optional[int] = None,
+                      flush_parallelism: int = 2) -> int:
         """Consume an (offset, container) stream, interleaving flushes the
         way ingestStream interleaves createFlushTasks (reference:
-        TimeSeriesMemStore.scala:106-129)."""
+        TimeSeriesMemStore.scala:106-129).
+
+        Two flush modes:
+        - ``flush_each=N``: synchronous flush every N containers (simple,
+          test-friendly).
+        - ``flush_interval_ms``: the reference's production mode — per-group
+          time-boundary scheduling with encode+IO pipelined onto a
+          dedicated flush executor (memstore/flush.py), so ingestion never
+          stalls behind a flush (reference TimeSeriesShard.scala:804-846).
+        """
         shard = self.get_shard(dataset, shard_num)
         total = 0
+        if flush_interval_ms is not None:
+            from filodb_tpu.memstore.flush import FlushScheduler
+            sched = FlushScheduler(shard, flush_interval_ms,
+                                   flush_parallelism)
+            try:
+                for offset, container in stream:
+                    total += shard.ingest_container(container, offset)
+                    sched.note_ingested()
+            finally:
+                sched.close(flush_remaining=True)
+            return total
         for i, (offset, container) in enumerate(stream):
             total += shard.ingest_container(container, offset)
             if flush_each and (i + 1) % flush_each == 0:

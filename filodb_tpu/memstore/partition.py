@@ -11,7 +11,8 @@ arrays — the device-facing form.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import threading
+from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
 
@@ -21,10 +22,24 @@ from filodb_tpu.core.histogram import HistogramBuckets
 from filodb_tpu.core.schemas import ColumnType, Schema
 
 
+class PendingBuffer(NamedTuple):
+    """A detached-but-not-yet-encoded write buffer.  ``freeze_raw`` (the
+    ingest thread's half of a flush) produces these in O(1); the flush
+    executor encodes them into ChunkSets later (reference: prepareFlushGroup
+    switchBuffers on the ingest thread, encode in doFlushSteps on the flush
+    scheduler — TimeSeriesShard.scala:756-774, 884-974)."""
+
+    ts: np.ndarray
+    cols: list
+    hist_buckets: Optional[HistogramBuckets]
+    seq: int
+
+
 class TimeSeriesPartition:
     __slots__ = ("part_id", "schema", "partkey", "tags", "group",
                  "chunks", "_decoded", "_buf_ts", "_buf_cols", "_buf_n",
                  "_capacity", "_hist_buckets", "_seq", "_unflushed",
+                 "_pending", "_lock", "_encode_lock",
                  "out_of_order_dropped", "on_freeze")
 
     def __init__(self, part_id: int, schema: Schema, partkey: bytes,
@@ -44,6 +59,14 @@ class TimeSeriesPartition:
         self._hist_buckets: Optional[HistogramBuckets] = None
         self._seq = 0
         self._unflushed: list[ChunkSet] = []
+        # raw frozen buffers awaiting encode (pipelined flush); guarded by
+        # _lock together with chunks/_unflushed so flush-executor encodes
+        # never interleave badly with ingest freezes or query reads
+        self._pending: list[PendingBuffer] = []
+        self._lock = threading.Lock()
+        # serializes whole drain_pending runs (ingest thread's buffer-full
+        # encode vs a flush-executor encode of the same partition)
+        self._encode_lock = threading.Lock()
         self.out_of_order_dropped = 0
         # shard hook observing chunk freezes (device grid invalidation)
         self.on_freeze = None
@@ -89,57 +112,147 @@ class TimeSeriesPartition:
         self._buf_n = i + 1
         return True
 
+    def ingest_block(self, ts: np.ndarray, cols: Sequence[np.ndarray]
+                     ) -> tuple[int, int]:
+        """Append a block of samples for scalar-column schemas (the C++
+        columnar decode path).  Vectorized out-of-order drop: a sample
+        survives iff it exceeds every timestamp before it in (chunks +
+        block) — identical to per-record ``ingest`` because dropped
+        samples never advance the high-water mark.  Returns
+        (rows_added, rows_dropped)."""
+        n = len(ts)
+        if n == 0:
+            return 0, 0
+        running = np.maximum.accumulate(
+            np.concatenate(([self.latest_timestamp], ts)))[:-1]
+        keep = ts > running
+        kept = int(keep.sum())
+        dropped = n - kept
+        self.out_of_order_dropped += dropped
+        if kept == 0:
+            return 0, dropped
+        if kept != n:
+            ts = ts[keep]
+            cols = [c[keep] for c in cols]
+        i = 0
+        while i < kept:
+            if self._buf_n == self._capacity:
+                self.switch_buffers()
+            take = min(self._capacity - self._buf_n, kept - i)
+            j = self._buf_n
+            self._buf_ts[j:j + take] = ts[i:i + take]
+            for buf, arr in zip(self._buf_cols, cols):
+                buf[j:j + take] = arr[i:i + take]
+            self._buf_n = j + take
+            i += take
+        return kept, dropped
+
     @property
     def latest_timestamp(self) -> int:
-        if self._buf_n:
-            return int(self._buf_ts[self._buf_n - 1])
-        if self.chunks:
-            return self.chunks[-1].info.end_time
-        return -1
+        with self._lock:
+            if self._buf_n:
+                return int(self._buf_ts[self._buf_n - 1])
+            if self._pending:
+                return int(self._pending[-1].ts[-1])
+            if self.chunks:
+                return self.chunks[-1].info.end_time
+            return -1
 
     @property
     def earliest_timestamp(self) -> int:
-        if self.chunks:
-            return self.chunks[0].info.start_time
-        if self._buf_n:
-            return int(self._buf_ts[0])
-        return -1
+        with self._lock:
+            if self.chunks:
+                return self.chunks[0].info.start_time
+            if self._pending:
+                return int(self._pending[0].ts[0])
+            if self._buf_n:
+                return int(self._buf_ts[0])
+            return -1
 
     @property
     def num_chunks(self) -> int:
-        return len(self.chunks) + (1 if self._buf_n else 0)
+        return len(self.chunks) + len(self._pending) + (1 if self._buf_n else 0)
+
+    def freeze_raw(self) -> bool:
+        """Detach the current write buffer as a PendingBuffer in O(1) —
+        the ingest-thread half of a pipelined flush (reference:
+        prepareFlushGroup/switchBuffers, TimeSeriesShard.scala:756-774).
+        Encoding happens later in :meth:`drain_pending` on the flush
+        executor.  Returns True if anything froze."""
+        with self._lock:
+            n = self._buf_n
+            if n == 0:
+                return False
+            cols = [buf[:n] for buf in self._buf_cols]
+            self._pending.append(PendingBuffer(self._buf_ts[:n], cols,
+                                               self._hist_buckets, self._seq))
+            self._seq += 1
+            self._buf_n = 0
+            self._buf_ts = np.empty(self._capacity, dtype=np.int64)
+            self._buf_cols = [self._new_col_buffer(c.ctype)
+                              for c in self.schema.data.columns[1:]]
+        return True
+
+    def drain_pending(self) -> list[ChunkSet]:
+        """Encode all pending buffers into ChunkSets, in seq order.  Safe
+        from the flush executor: encoding runs outside the lock; the
+        append-to-chunks + unpend step is atomic under the lock so query
+        reads never see a sample twice or not at all."""
+        out: list[ChunkSet] = []
+        with self._encode_lock:
+            out.extend(self._drain_pending_locked())
+        return out
+
+    def _drain_pending_locked(self) -> list[ChunkSet]:
+        out: list[ChunkSet] = []
+        while True:
+            with self._lock:
+                if not self._pending:
+                    break
+                pb = self._pending[0]
+            cols = []
+            for buf, col in zip(pb.cols, self.schema.data.columns[1:]):
+                if col.ctype == ColumnType.HISTOGRAM:
+                    cols.append((pb.hist_buckets, np.stack(list(buf))))
+                elif col.ctype == ColumnType.STRING:
+                    cols.append(list(buf))
+                else:
+                    cols.append(np.asarray(buf))
+            cs = encode_chunkset(self.schema, self.partkey, pb.ts, cols,
+                                 ingestion_seq=pb.seq)
+            with self._lock:
+                self.chunks.append(cs)
+                self._unflushed.append(cs)
+                self._pending.pop(0)
+            if self.on_freeze is not None:
+                self.on_freeze(cs)
+            out.append(cs)
+        return out
 
     def switch_buffers(self) -> Optional[ChunkSet]:
         """Freeze the current write buffer into a compressed ChunkSet
-        (reference: switchBuffers + encodeOneChunkset)."""
-        n = self._buf_n
-        if n == 0:
-            return None
-        cols = []
-        for buf, col in zip(self._buf_cols, self.schema.data.columns[1:]):
-            if col.ctype == ColumnType.HISTOGRAM:
-                cols.append((self._hist_buckets, np.stack(buf[:n])))
-            elif col.ctype == ColumnType.STRING:
-                cols.append(list(buf[:n]))
-            else:
-                cols.append(buf[:n].copy())
-        cs = encode_chunkset(self.schema, self.partkey, self._buf_ts[:n].copy(),
-                             cols, ingestion_seq=self._seq)
-        self._seq += 1
-        self.chunks.append(cs)
-        self._unflushed.append(cs)
-        self._buf_n = 0
-        self._buf_cols = [self._new_col_buffer(c.ctype)
-                          for c in self.schema.data.columns[1:]]
-        if self.on_freeze is not None:
-            self.on_freeze(cs)
-        return cs
+        (reference: switchBuffers + encodeOneChunkset).  Synchronous:
+        freeze + encode in one call."""
+        had = self.freeze_raw()
+        encoded = self.drain_pending()
+        return encoded[-1] if had and encoded else None
 
     def make_flush_chunks(self) -> list[ChunkSet]:
         """Freeze + drain chunks not yet persisted (reference:
-        makeFlushChunks, TimeSeriesPartition.scala:264)."""
-        self.switch_buffers()
-        out, self._unflushed = self._unflushed, []
+        makeFlushChunks, TimeSeriesPartition.scala:264).  Single-thread
+        use (ingest thread / batch jobs); the pipelined flush executor
+        calls :meth:`collect_flush_chunks` instead, which does NOT
+        freeze — the ingest thread already froze at prepare time."""
+        self.freeze_raw()
+        return self.collect_flush_chunks()
+
+    def collect_flush_chunks(self) -> list[ChunkSet]:
+        """Encode already-frozen pending buffers and drain the unflushed
+        list.  Never touches the live write buffer, so it is safe from
+        the flush executor while the ingest thread keeps appending."""
+        self.drain_pending()
+        with self._lock:
+            out, self._unflushed = self._unflushed, []
         return out
 
     # -- read ---------------------------------------------------------------
@@ -165,24 +278,45 @@ class TimeSeriesPartition:
         cid = self.schema.data.value_column_id if column_id is None else column_id
         col_idx = cid - 1  # data columns after the timestamp
         ctype = self.schema.data.columns[cid].ctype
+        # one locked snapshot of chunks + pending + write-buffer tail:
+        # freeze_raw moves the buffer into pending under the same lock, so
+        # a concurrent reader sees each sample in exactly one of the three
+        with self._lock:
+            chunks_snap = list(self.chunks)
+            pending_snap = list(self._pending)
+            buf_n = self._buf_n
+            buf_ts = self._buf_ts
+            buf_cols = self._buf_cols
+            buf_hist = self._hist_buckets
         ts_parts, val_parts = [], []
-        for cs in self.chunks:
+        for cs in chunks_snap:
             if cs.info.end_time < start or cs.info.start_time > end:
                 continue
             ts, cols = self._decoded_chunk(cs)
             ts_parts.append(ts)
             val_parts.append(cols[col_idx])
-        if self._buf_n:
-            t0 = int(self._buf_ts[0])
-            if not (self._buf_ts[self._buf_n - 1] < start or t0 > end):
-                ts_parts.append(self._buf_ts[:self._buf_n].copy())
-                buf = self._buf_cols[col_idx]
+        for pb in pending_snap:
+            if int(pb.ts[-1]) < start or int(pb.ts[0]) > end:
+                continue
+            ts_parts.append(np.asarray(pb.ts))
+            buf = pb.cols[col_idx]
+            if ctype == ColumnType.HISTOGRAM:
+                val_parts.append((pb.hist_buckets, np.stack(list(buf))))
+            elif ctype == ColumnType.STRING:
+                val_parts.append(list(buf))
+            else:
+                val_parts.append(np.asarray(buf, dtype=np.float64))
+        if buf_n:
+            t0 = int(buf_ts[0])
+            if not (buf_ts[buf_n - 1] < start or t0 > end):
+                ts_parts.append(buf_ts[:buf_n].copy())
+                buf = buf_cols[col_idx]
                 if ctype == ColumnType.HISTOGRAM:
-                    val_parts.append((self._hist_buckets, np.stack(buf[:self._buf_n])))
+                    val_parts.append((buf_hist, np.stack(buf[:buf_n])))
                 elif ctype == ColumnType.STRING:
-                    val_parts.append(list(buf[:self._buf_n]))
+                    val_parts.append(list(buf[:buf_n]))
                 else:
-                    val_parts.append(buf[:self._buf_n].copy())
+                    val_parts.append(buf[:buf_n].copy())
         if not ts_parts:
             empty_ts = np.empty(0, dtype=np.int64)
             if ctype == ColumnType.HISTOGRAM:
@@ -214,4 +348,6 @@ class TimeSeriesPartition:
 
     @property
     def mem_bytes(self) -> int:
-        return sum(cs.nbytes for cs in self.chunks) + self._buf_n * 16
+        return (sum(cs.nbytes for cs in self.chunks)
+                + sum(len(pb.ts) * 16 for pb in self._pending)
+                + self._buf_n * 16)

@@ -51,6 +51,18 @@ class PartLookupResult:
 
 
 @dataclasses.dataclass
+class FlushTask:
+    """Snapshot handed from the ingest thread to the flush executor
+    (reference: FlushGroup, TimeSeriesShard.scala:110-160)."""
+
+    group: int
+    parts: list
+    dirty: set
+    offset: int
+    ingestion_time: int
+
+
+@dataclasses.dataclass
 class ShardStats:
     """Counter bundle (reference: TimeSeriesShardStats, :37-108)."""
 
@@ -89,6 +101,9 @@ class TimeSeriesShard:
         self.group_watermarks = [-1] * self.num_groups
         self._dirty_partkeys: list[set[int]] = [set() for _ in range(self.num_groups)]
         self.latest_offset = -1
+        # newest sample timestamp seen: drives time-boundary flush
+        # scheduling (reference: createFlushTasks time boundaries :804-846)
+        self.latest_ingest_ts = -1
         self.evicted_keys = BloomFilter(self.config.evicted_pk_bloom_filter_capacity)
         self.stats = ShardStats()
         self.ingest_sched_check = None  # optional thread-name assertion hook
@@ -112,7 +127,73 @@ class TimeSeriesShard:
     # ------------------------------------------------------------------ ingest
 
     def ingest_container(self, container: bytes, offset: int) -> int:
+        fast = self._ingest_container_fast(container, offset)
+        if fast is not None:
+            return fast
         return self.ingest(decode_container(container, self.schemas), offset)
+
+    def _ingest_container_fast(self, container: bytes, offset: int
+                               ) -> Optional[int]:
+        """Columnar ingest: C++ container decode + per-series batch append
+        (native/ingestfast.py).  Returns None when this container can't
+        take the fast path (histogram/string columns, mixed schemas, no
+        compiler) — the caller then runs the per-record path.  Semantics
+        match :meth:`ingest` exactly; tests/test_memstore.py proves
+        equivalence on out-of-order and watermark-skip data."""
+        from filodb_tpu.native import ingestfast
+
+        dec = ingestfast.decode(container, self.schemas)
+        if dec is None:
+            return None
+        if self.ingest_sched_check is not None:
+            self.ingest_sched_check()
+        if dec.num_records == 0:
+            self.latest_offset = max(self.latest_offset, offset)
+            return 0
+        schema = self.schemas.by_hash(dec.schema_hash)
+        ts, cols, uniq_idx = dec.ts, dec.cols, dec.uniq_idx
+        groups_r = (dec.part_hashes % np.uint32(self.num_groups)).astype(
+            np.int64)
+        # recovery watermark skip (reference IngestConsumer :488-522);
+        # steady state short-circuits on max(watermarks) < offset
+        if offset <= max(self.group_watermarks):
+            keep = offset > np.asarray(self.group_watermarks)[groups_r]
+            skipped = int((~keep).sum())
+            if skipped:
+                self.stats.rows_skipped += skipped
+                ts, uniq_idx = ts[keep], uniq_idx[keep]
+                cols = [c[keep] for c in cols]
+        n_uniq = len(dec.partkeys)
+        order = np.argsort(uniq_idx, kind="stable")
+        ts_s = ts[order]
+        cols_s = [c[order] for c in cols]
+        counts = np.bincount(uniq_idx, minlength=n_uniq)
+        starts = np.concatenate(([0], np.cumsum(counts)))
+        added_total = 0
+        maxint = np.iinfo(np.int64).max
+        for u in range(n_uniq):
+            s0, s1 = int(starts[u]), int(starts[u + 1])
+            if s0 == s1:
+                continue  # every record of this series was watermark-skipped
+            first = int(dec.uniq_first[u])
+            part = self._get_or_add_partition_pk(
+                dec.partkeys[u], schema, int(dec.part_hashes[first]),
+                int(ts_s[s0]))
+            added, dropped = part.ingest_block(
+                ts_s[s0:s1], [c[s0:s1] for c in cols_s])
+            added_total += added
+            self.stats.rows_ingested += added
+            self.stats.out_of_order_dropped += dropped
+            if self.index.end_time(part.part_id) != maxint:
+                self.index.mark_active(part.part_id)
+            self._dirty_partkeys[int(groups_r[first])].add(part.part_id)
+        if len(ts):
+            self.latest_ingest_ts = max(self.latest_ingest_ts,
+                                        int(ts.max()))
+        self.latest_offset = max(self.latest_offset, offset)
+        if added_total:
+            self.ingest_epoch += 1
+        return added_total
 
     def ingest(self, records: Iterable[IngestRecord], offset: int) -> int:
         """Ingest a batch of records at a stream offset.  Returns rows added.
@@ -138,13 +219,25 @@ class TimeSeriesShard:
             if self.index.end_time(part.part_id) != np.iinfo(np.int64).max:
                 self.index.mark_active(part.part_id)
             self._dirty_partkeys[group].add(part.part_id)
+            if rec.timestamp > self.latest_ingest_ts:
+                self.latest_ingest_ts = rec.timestamp
         self.latest_offset = max(self.latest_offset, offset)
         if n:
             self.ingest_epoch += 1
         return n
 
     def _get_or_add_partition(self, rec: IngestRecord) -> TimeSeriesPartition:
-        pk = rec.partkey()
+        return self._get_or_add_partition_pk(
+            rec.partkey(), self.schemas.by_hash(rec.schema_hash),
+            rec.part_hash, rec.timestamp, tags=rec.tags)
+
+    def _get_or_add_partition_pk(self, pk: bytes, schema, part_hash: int,
+                                 timestamp: int, tags: Optional[dict] = None
+                                 ) -> TimeSeriesPartition:
+        """Partition registry lookup/creation keyed by raw partkey bytes;
+        tags are parsed lazily so the columnar fast path never builds a
+        tag dict for known series (reference: partSet O(1) lookup by
+        ingest record, TimeSeriesShard.scala:1091)."""
         pid = self.part_set.get(pk)
         if pid is not None:
             part = self.partitions.get(pid)
@@ -152,9 +245,11 @@ class TimeSeriesShard:
                 return part
             # index-only entry (recovered or paged-out): re-materialize the
             # partition under its existing part id, keeping index lifecycle
-            schema = self.schemas.by_hash(rec.schema_hash)
-            part = TimeSeriesPartition(pid, schema, pk, rec.tags,
-                                       rec.part_hash % self.num_groups,
+            from filodb_tpu.core.record import parse_partkey
+            part = TimeSeriesPartition(pid, schema, pk,
+                                       tags if tags is not None
+                                       else parse_partkey(pk),
+                                       part_hash % self.num_groups,
                                        capacity=self.config.max_chunks_size)
             part.on_freeze = self._on_chunk_freeze
             self.partitions[pid] = part
@@ -162,18 +257,20 @@ class TimeSeriesShard:
             return part
         # evicted-key bloom check: a maybe-evicted key re-reads its true
         # start time from the column store lifecycle (reference :1103-1122)
-        start_time = rec.timestamp
-        schema = self.schemas.by_hash(rec.schema_hash)
+        from filodb_tpu.core.record import parse_partkey
+        if tags is None:
+            tags = parse_partkey(pk)
+        start_time = timestamp
         pid = self._next_part_id
         self._next_part_id += 1
-        group = rec.part_hash % self.num_groups
-        part = TimeSeriesPartition(pid, schema, pk, rec.tags, group,
+        group = part_hash % self.num_groups
+        part = TimeSeriesPartition(pid, schema, pk, tags, group,
                                    capacity=self.config.max_chunks_size)
         part.on_freeze = self._on_chunk_freeze
         self.partitions[pid] = part
         self.part_set[pk] = pid
-        self.part_schema_hash[pid] = rec.schema_hash
-        self.index.add_partkey(pid, pk, rec.tags, start_time)
+        self.part_schema_hash[pid] = schema.schema_hash
+        self.index.add_partkey(pid, pk, tags, start_time)
         self.stats.partitions_created += 1
         return part
 
@@ -187,41 +284,69 @@ class TimeSeriesShard:
 
     # ------------------------------------------------------------------ flush
 
-    def flush_group(self, group: int, ingestion_time: Optional[int] = None) -> int:
-        """Flush one group: the doFlushSteps pipeline (reference :884-974).
-        Returns number of chunksets written."""
+    def prepare_flush_group(self, group: int,
+                            ingestion_time: Optional[int] = None
+                            ) -> "FlushTask":
+        """Ingest-thread half of a pipelined flush: O(partitions-in-group)
+        buffer detaches plus state snapshots; no encoding, no IO
+        (reference: prepareFlushGroup, TimeSeriesShard.scala:756-774).
+        The returned task runs on a flush executor via
+        :meth:`run_flush_task`; tasks for the SAME group must run in
+        submission order (the scheduler serializes per group)."""
         itime = ingestion_time if ingestion_time is not None \
             else int(time.time() * 1000)
-        chunksets = []
-        ds_pairs: dict[int, list] = {}  # schema_hash -> [(tags, chunkset)]
-        for part in self.partitions.values():
-            if part.group == group:
-                fresh = part.make_flush_chunks()
+        parts = [p for p in self.partitions.values() if p.group == group]
+        for part in parts:
+            part.freeze_raw()
+        dirty, self._dirty_partkeys[group] = self._dirty_partkeys[group], set()
+        return FlushTask(group=group, parts=parts, dirty=dirty,
+                         offset=self.latest_offset, ingestion_time=itime)
+
+    def run_flush_task(self, task: "FlushTask") -> int:
+        """Flush-executor half: encode pending buffers (frozen at prepare
+        time — never the live write buffer), write chunks, downsample,
+        persist partkeys, checkpoint (the doFlushSteps pipeline,
+        reference :884-974).  Returns chunksets written.  On failure the
+        dirty partkeys are re-queued so a later flush persists them."""
+        try:
+            chunksets = []
+            ds_pairs: dict[int, list] = {}  # schema_hash -> [(tags, cs)]
+            for part in task.parts:
+                fresh = part.collect_flush_chunks()
                 chunksets.extend(fresh)
                 if self.downsample_publisher is not None and fresh:
                     ds_pairs.setdefault(part.schema.schema_hash, []).extend(
                         (part.tags, cs) for cs in fresh)
-        if chunksets:
-            self.store.write_chunks(self.dataset, self.shard_num, chunksets, itime)
-        for shash, pairs in ds_pairs.items():
-            self._downsampler_for(shash).downsample_chunksets(pairs)
-        dirty = self._dirty_partkeys[group]
-        if dirty:
-            recs = [PartKeyRecord(self.index.partkey(pid),
-                                  self.index.start_time(pid),
-                                  self.index.end_time(pid), self.shard_num,
-                                  self.partitions[pid].schema.schema_hash)
-                    for pid in dirty if pid in self.partitions]
-            self.store.write_part_keys(self.dataset, self.shard_num, recs)
-            self._dirty_partkeys[group] = set()
+            if chunksets:
+                self.store.write_chunks(self.dataset, self.shard_num,
+                                        chunksets, task.ingestion_time)
+            for shash, pairs in ds_pairs.items():
+                self._downsampler_for(shash).downsample_chunksets(pairs)
+            if task.dirty:
+                recs = [PartKeyRecord(self.index.partkey(pid),
+                                      self.index.start_time(pid),
+                                      self.index.end_time(pid),
+                                      self.shard_num,
+                                      self.partitions[pid].schema.schema_hash)
+                        for pid in task.dirty if pid in self.partitions]
+                self.store.write_part_keys(self.dataset, self.shard_num, recs)
+        except BaseException:
+            # partkeys not persisted: merge them back for the next flush
+            self._dirty_partkeys[task.group] |= task.dirty
+            raise
         # checkpoint only after chunks+partkeys persisted (reference :949-960)
-        self.meta.write_checkpoint(self.dataset, self.shard_num, group,
-                                   self.latest_offset)
-        self.group_watermarks[group] = max(self.group_watermarks[group],
-                                           self.latest_offset)
+        self.meta.write_checkpoint(self.dataset, self.shard_num, task.group,
+                                   task.offset)
+        self.group_watermarks[task.group] = max(
+            self.group_watermarks[task.group], task.offset)
         self.stats.chunks_flushed += len(chunksets)
         self.stats.flushes_done += 1
         return len(chunksets)
+
+    def flush_group(self, group: int, ingestion_time: Optional[int] = None) -> int:
+        """Synchronous flush of one group (prepare + run inline)."""
+        return self.run_flush_task(self.prepare_flush_group(group,
+                                                            ingestion_time))
 
     def _downsampler_for(self, schema_hash: int):
         ds = self._downsamplers.get(schema_hash)

@@ -15,6 +15,8 @@
 #include <cstddef>
 #include <cstdint>
 #include <cstring>
+#include <string_view>
+#include <unordered_map>
 
 #if defined(_MSC_VER)
 #include <intrin.h>
@@ -260,6 +262,113 @@ long long xor_unpack(const uint8_t* buf, size_t buflen, size_t offset,
     }
   }
   return static_cast<long long>(pos);
+}
+
+// ---------------------------------------------------------------------------
+// Columnar container decode: the ingest fast path.
+//
+// Parses one RecordContainer (filodb_tpu/core/record.py wire layout:
+// u32 total, then records of [u16 schema_hash, u32 shard_hash,
+// u32 part_hash, i64 ts, data cols..., u16 pklen, pk bytes]) straight
+// into columnar arrays, deduplicating partition keys with a hash map so
+// Python touches one object per *series*, not per record.  Ingest-side
+// equivalent of the reference's zero-copy RecordContainer.iterate over
+// off-heap BinaryRecords (reference: core/src/main/scala/filodb.core/
+// binaryrecord2/RecordContainer.scala:27, TimeSeriesShard.scala:488-522).
+//
+// Schema table: per schema, its 16-bit hash, data-column count, and
+// column type codes (1 = f64 bit pattern into the i64 cell, 2 = i64,
+// 3 = i32 widened) flattened as sch_types[si * max_cols + ci].
+// Histogram/string columns are unsupported (-2): those containers take
+// the Python path.  Every record must carry the same schema hash (-3
+// otherwise — mixed containers fall back too).  Returns the record
+// count, or a negative error code: -1 malformed, -2 unsupported column,
+// -3 mixed/unknown schema, -4 capacity exceeded.
+long long cd_decode(const uint8_t* buf, size_t buflen,
+                    const uint16_t* sch_hashes, const uint8_t* sch_ncols,
+                    const uint8_t* sch_types, size_t max_cols,
+                    size_t n_schemas, size_t cap, int64_t* ts_out,
+                    int64_t* vals_out, uint32_t* shard_out,
+                    uint32_t* part_out, int32_t* uniq_out,
+                    int64_t* pk_off, int64_t* pk_len, int64_t* uniq_first,
+                    long long* n_uniq_out, int32_t* schema_hash_out) {
+  if (buflen < 4) return -1;
+  uint32_t total;
+  std::memcpy(&total, buf, 4);
+  size_t end = 4 + static_cast<size_t>(total);
+  if (end > buflen) return -1;
+
+  // resolve the (single) schema from the first record
+  if (end < 4 + 18) return total == 0 ? 0 : -1;
+  uint16_t schema_hash;
+  std::memcpy(&schema_hash, buf + 4, 2);
+  size_t si = n_schemas;
+  for (size_t i = 0; i < n_schemas; ++i)
+    if (sch_hashes[i] == schema_hash) { si = i; break; }
+  if (si == n_schemas) return -3;
+  const size_t ncols = sch_ncols[si];
+  const uint8_t* types = sch_types + si * max_cols;
+  for (size_t c = 0; c < ncols; ++c)
+    if (types[c] < 1 || types[c] > 3) return -2;
+
+  std::unordered_map<std::string_view, int32_t> pk_map;
+  pk_map.reserve(256);
+  size_t pos = 4;
+  long long n = 0, n_uniq = 0;
+  while (pos < end) {
+    if (pos + 18 > end) return -1;
+    if (static_cast<size_t>(n) >= cap) return -4;
+    uint16_t sh;
+    std::memcpy(&sh, buf + pos, 2);
+    if (sh != schema_hash) return -3;
+    std::memcpy(&shard_out[n], buf + pos + 2, 4);
+    std::memcpy(&part_out[n], buf + pos + 6, 4);
+    std::memcpy(&ts_out[n], buf + pos + 10, 8);
+    pos += 18;
+    int64_t* row = vals_out + static_cast<size_t>(n) * max_cols;
+    for (size_t c = 0; c < ncols; ++c) {
+      switch (types[c]) {
+        case 1:  // f64: keep the bit pattern; Python views as float64
+        case 2:  // i64
+          if (pos + 8 > end) return -1;
+          std::memcpy(&row[c], buf + pos, 8);
+          pos += 8;
+          break;
+        case 3: {  // i32 widened
+          if (pos + 4 > end) return -1;
+          int32_t v;
+          std::memcpy(&v, buf + pos, 4);
+          row[c] = v;
+          pos += 4;
+          break;
+        }
+      }
+    }
+    if (pos + 2 > end) return -1;
+    uint16_t pklen;
+    std::memcpy(&pklen, buf + pos, 2);
+    pos += 2;
+    if (pos + pklen > end) return -1;
+    std::string_view key(reinterpret_cast<const char*>(buf + pos), pklen);
+    auto it = pk_map.find(key);
+    int32_t uid;
+    if (it == pk_map.end()) {
+      uid = static_cast<int32_t>(n_uniq);
+      pk_map.emplace(key, uid);
+      pk_off[n_uniq] = static_cast<int64_t>(pos);
+      pk_len[n_uniq] = pklen;
+      uniq_first[n_uniq] = n;
+      ++n_uniq;
+    } else {
+      uid = it->second;
+    }
+    uniq_out[n] = uid;
+    pos += pklen;
+    ++n;
+  }
+  *n_uniq_out = n_uniq;
+  *schema_hash_out = static_cast<int32_t>(schema_hash);
+  return n;
 }
 
 }  // extern "C"
