@@ -1,0 +1,515 @@
+"""Kafka-capability ingest transport: a partitioned, offset-faithful
+message broker + client + per-shard ingestion streams.
+
+Capability match for the reference's kafka/ module (reference:
+kafka/src/main/scala/filodb.kafka/KafkaIngestionStream.scala:24-63 — one
+consumer per shard = one topic partition, messages are RecordContainer
+bytes, offsets are the checkpointable positions;
+KafkaDownsamplePublisher.scala:17 — downsample output re-published to
+per-resolution topics).  The broker speaks a compact length-prefixed
+binary protocol over TCP and keeps one append-only log per (topic,
+partition), optionally durable on disk, so recovery genuinely replays
+from broker offsets after a process restart — the property the
+reference's Kafka integration exists to provide.
+
+Wire protocol (all little-endian):
+
+    request  := u32 frame_len, u8 cmd, payload
+    response := u32 frame_len, u8 status (0=ok), payload
+    str      := u16 len, utf-8 bytes
+    blob     := u32 len, bytes
+
+    PRODUCE (1): str topic, u32 partition, blob message -> i64 offset
+    FETCH   (2): str topic, u32 partition, i64 offset, u32 max_bytes,
+                 u32 wait_ms -> u32 count, count * (i64 offset, blob)
+    END     (3): str topic, u32 partition -> i64 log_end_offset
+    CREATE  (4): str topic, u32 n_partitions -> u32 n_partitions
+    META    (5): str topic -> u32 n_partitions (0 = unknown topic)
+
+This is intentionally not the Kafka wire protocol (no client library may
+be installed in this environment); it is the same *capability*:
+partitioned durable logs addressed by monotonic offsets with long-poll
+consumption.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Iterator, Optional
+
+from filodb_tpu.ingest.stream import (IngestionStream, IngestionStreamFactory,
+                                      StreamElement, register_source_factory)
+
+CMD_PRODUCE = 1
+CMD_FETCH = 2
+CMD_END = 3
+CMD_CREATE = 4
+CMD_META = 5
+
+STATUS_OK = 0
+STATUS_ERR = 1
+
+_MAX_FRAME = 64 * 1024 * 1024
+
+
+class BrokerError(RuntimeError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# server-side log
+# ---------------------------------------------------------------------------
+
+class PartitionLog:
+    """One (topic, partition) append-only log.  Offsets are dense from 0.
+    With ``path`` set, every record is appended to disk as
+    ``u32 len + bytes`` and recovered on restart (the Kafka durability
+    contract checkpoints rely on)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self._messages: list[bytes] = []
+        self._cond = threading.Condition()
+        self._path = path
+        self._file = None
+        if path is not None:
+            if os.path.exists(path):
+                self._recover(path)
+            self._file = open(path, "ab")
+
+    def _recover(self, path: str) -> None:
+        with open(path, "rb") as f:
+            data = f.read()
+        pos = 0
+        while pos + 4 <= len(data):
+            (ln,) = struct.unpack_from("<I", data, pos)
+            if pos + 4 + ln > len(data):
+                break  # torn tail write: drop it (Kafka truncates too)
+            self._messages.append(data[pos + 4:pos + 4 + ln])
+            pos += 4 + ln
+
+    def append(self, message: bytes) -> int:
+        with self._cond:
+            off = len(self._messages)
+            if self._file is not None:
+                self._file.write(struct.pack("<I", len(message)) + message)
+                self._file.flush()
+            self._messages.append(message)
+            self._cond.notify_all()
+            return off
+
+    def end_offset(self) -> int:
+        with self._cond:
+            return len(self._messages)
+
+    def fetch(self, offset: int, max_bytes: int,
+              wait_ms: int) -> list[tuple[int, bytes]]:
+        deadline = time.monotonic() + wait_ms / 1000.0
+        with self._cond:
+            while offset >= len(self._messages):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._cond.wait(remaining)
+            out = []
+            total = 0
+            off = max(offset, 0)
+            while off < len(self._messages):
+                m = self._messages[off]
+                if out and total + len(m) > max_bytes:
+                    break
+                out.append((off, m))
+                total += len(m)
+                off += 1
+            return out
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+class _BrokerState:
+    def __init__(self, data_dir: Optional[str] = None):
+        self.data_dir = data_dir
+        self.topics: dict[str, list[PartitionLog]] = {}
+        self.lock = threading.Lock()
+        if data_dir is not None:
+            os.makedirs(data_dir, exist_ok=True)
+            self._recover_topics()
+
+    def _recover_topics(self) -> None:
+        by_topic: dict[str, int] = {}
+        for name in os.listdir(self.data_dir):
+            if not name.endswith(".log") or "-p" not in name:
+                continue
+            base = name[:-4]
+            topic, _, pstr = base.rpartition("-p")
+            try:
+                p = int(pstr)
+            except ValueError:
+                continue
+            by_topic[topic] = max(by_topic.get(topic, 0), p + 1)
+        for topic, nparts in by_topic.items():
+            self.create(topic, nparts)
+
+    def _log_path(self, topic: str, partition: int) -> Optional[str]:
+        if self.data_dir is None:
+            return None
+        return os.path.join(self.data_dir, f"{topic}-p{partition}.log")
+
+    def create(self, topic: str, n_partitions: int) -> int:
+        if n_partitions <= 0 or n_partitions > 4096:
+            raise BrokerError(f"bad partition count {n_partitions}")
+        with self.lock:
+            logs = self.topics.get(topic)
+            if logs is None:
+                self.topics[topic] = [
+                    PartitionLog(self._log_path(topic, p))
+                    for p in range(n_partitions)]
+            elif len(logs) < n_partitions:
+                logs.extend(PartitionLog(self._log_path(topic, p))
+                            for p in range(len(logs), n_partitions))
+            return len(self.topics[topic])
+
+    def log(self, topic: str, partition: int) -> PartitionLog:
+        with self.lock:
+            logs = self.topics.get(topic)
+            if logs is None or partition >= len(logs) or partition < 0:
+                raise BrokerError(f"unknown {topic}[{partition}]")
+            return logs[partition]
+
+    def close(self) -> None:
+        with self.lock:
+            for logs in self.topics.values():
+                for lg in logs:
+                    lg.close()
+
+
+# ---------------------------------------------------------------------------
+# framing helpers
+# ---------------------------------------------------------------------------
+
+def _recv_exact(sock, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        b = sock.recv(n - got)
+        if not b:
+            raise ConnectionError("peer closed")
+        chunks.append(b)
+        got += len(b)
+    return b"".join(chunks)
+
+
+def _read_frame(sock) -> bytes:
+    (ln,) = struct.unpack("<I", _recv_exact(sock, 4))
+    if ln > _MAX_FRAME:
+        raise BrokerError(f"frame too large: {ln}")
+    return _recv_exact(sock, ln)
+
+
+def _write_frame(sock, payload: bytes) -> None:
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def _pack_str(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack("<H", len(b)) + b
+
+
+def _unpack_str(buf: bytes, pos: int) -> tuple[str, int]:
+    (ln,) = struct.unpack_from("<H", buf, pos)
+    pos += 2
+    return buf[pos:pos + ln].decode(), pos + ln
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        state: _BrokerState = self.server.state  # type: ignore[attr-defined]
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                frame = _read_frame(sock)
+                try:
+                    resp = self._dispatch(state, frame)
+                    _write_frame(sock, bytes([STATUS_OK]) + resp)
+                except BrokerError as e:
+                    _write_frame(sock, bytes([STATUS_ERR]) + str(e).encode())
+        except (ConnectionError, OSError):
+            return
+
+    def _dispatch(self, state: _BrokerState, frame: bytes) -> bytes:
+        if not frame:
+            raise BrokerError("empty frame")
+        cmd = frame[0]
+        pos = 1
+        if cmd == CMD_PRODUCE:
+            topic, pos = _unpack_str(frame, pos)
+            (partition,) = struct.unpack_from("<I", frame, pos)
+            pos += 4
+            (mlen,) = struct.unpack_from("<I", frame, pos)
+            pos += 4
+            message = frame[pos:pos + mlen]
+            if len(message) != mlen:
+                raise BrokerError("truncated message")
+            off = state.log(topic, partition).append(message)
+            return struct.pack("<q", off)
+        if cmd == CMD_FETCH:
+            topic, pos = _unpack_str(frame, pos)
+            partition, = struct.unpack_from("<I", frame, pos); pos += 4
+            offset, = struct.unpack_from("<q", frame, pos); pos += 8
+            max_bytes, = struct.unpack_from("<I", frame, pos); pos += 4
+            wait_ms, = struct.unpack_from("<I", frame, pos); pos += 4
+            batch = state.log(topic, partition).fetch(
+                offset, min(max_bytes, _MAX_FRAME // 2), min(wait_ms, 30_000))
+            out = [struct.pack("<I", len(batch))]
+            for off, m in batch:
+                out.append(struct.pack("<qI", off, len(m)))
+                out.append(m)
+            return b"".join(out)
+        if cmd == CMD_END:
+            topic, pos = _unpack_str(frame, pos)
+            (partition,) = struct.unpack_from("<I", frame, pos)
+            return struct.pack("<q", state.log(topic, partition).end_offset())
+        if cmd == CMD_CREATE:
+            topic, pos = _unpack_str(frame, pos)
+            (nparts,) = struct.unpack_from("<I", frame, pos)
+            return struct.pack("<I", state.create(topic, nparts))
+        if cmd == CMD_META:
+            topic, pos = _unpack_str(frame, pos)
+            with state.lock:
+                logs = state.topics.get(topic)
+            return struct.pack("<I", 0 if logs is None else len(logs))
+        raise BrokerError(f"unknown command {cmd}")
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class BrokerServer:
+    """Standalone broker process core: ``start()`` returns the bound port."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 data_dir: Optional[str] = None):
+        self.state = _BrokerState(data_dir)
+        self._srv = _TCPServer((host, port), _Handler)
+        self._srv.state = self.state  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._srv.server_address[1]
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        name="broker", daemon=True)
+        self._thread.start()
+        return self.port
+
+    def shutdown(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        self.state.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class BrokerClient:
+    """Blocking client; safe for use from multiple threads (one in-flight
+    request at a time, like a single Kafka connection)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 9092,
+                 timeout_s: float = 35.0):
+        self.host, self.port = host, port
+        self._timeout = timeout_s
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection((self.host, self.port),
+                                         timeout=self._timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def _call(self, payload: bytes) -> bytes:
+        with self._lock:
+            try:
+                sock = self._connect()
+                _write_frame(sock, payload)
+                resp = _read_frame(sock)
+            except (ConnectionError, OSError):
+                # one transparent reconnect (broker restarts are normal)
+                self.close()
+                sock = self._connect()
+                _write_frame(sock, payload)
+                resp = _read_frame(sock)
+        if not resp or resp[0] != STATUS_OK:
+            raise BrokerError(resp[1:].decode(errors="replace")
+                              if len(resp) > 1 else "broker error")
+        return resp[1:]
+
+    def create_topic(self, topic: str, n_partitions: int) -> int:
+        out = self._call(bytes([CMD_CREATE]) + _pack_str(topic)
+                         + struct.pack("<I", n_partitions))
+        return struct.unpack("<I", out)[0]
+
+    def num_partitions(self, topic: str) -> int:
+        out = self._call(bytes([CMD_META]) + _pack_str(topic))
+        return struct.unpack("<I", out)[0]
+
+    def produce(self, topic: str, partition: int, message: bytes) -> int:
+        out = self._call(bytes([CMD_PRODUCE]) + _pack_str(topic)
+                         + struct.pack("<I", partition)
+                         + struct.pack("<I", len(message)) + message)
+        return struct.unpack("<q", out)[0]
+
+    def end_offset(self, topic: str, partition: int) -> int:
+        out = self._call(bytes([CMD_END]) + _pack_str(topic)
+                         + struct.pack("<I", partition))
+        return struct.unpack("<q", out)[0]
+
+    def fetch(self, topic: str, partition: int, offset: int,
+              max_bytes: int = 4 * 1024 * 1024,
+              wait_ms: int = 100) -> list[tuple[int, bytes]]:
+        out = self._call(bytes([CMD_FETCH]) + _pack_str(topic)
+                         + struct.pack("<IqII", partition, offset,
+                                       max_bytes, wait_ms))
+        (count,) = struct.unpack_from("<I", out, 0)
+        pos = 4
+        batch = []
+        for _ in range(count):
+            off, mlen = struct.unpack_from("<qI", out, pos)
+            pos += 12
+            batch.append((off, out[pos:pos + mlen]))
+            pos += mlen
+        return batch
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+
+# ---------------------------------------------------------------------------
+# ingestion stream + producer + downsample publisher
+# ---------------------------------------------------------------------------
+
+class BrokerIngestionStream(IngestionStream):
+    """One shard's consumer: shard N reads topic partition N from
+    ``offset`` onward, long-polling; ``teardown()`` ends the iterator
+    (reference: KafkaIngestionStream.scala:24-63 — Consumer assigned to
+    TopicPartition(shard), seek(offset))."""
+
+    def __init__(self, client: BrokerClient, topic: str, shard: int,
+                 offset: int = 0, poll_wait_ms: int = 200,
+                 stop_at_end: bool = False):
+        self._client = client
+        self._topic = topic
+        self._shard = shard
+        self._offset = max(offset, 0)
+        self._wait = poll_wait_ms
+        self._stop_at_end = stop_at_end
+        self._stopped = threading.Event()
+
+    def get(self) -> Iterator[StreamElement]:
+        while not self._stopped.is_set():
+            batch = self._client.fetch(self._topic, self._shard,
+                                       self._offset, wait_ms=self._wait)
+            if not batch:
+                if self._stop_at_end:
+                    return
+                continue
+            for off, message in batch:
+                self._offset = off + 1
+                yield off, message
+        return
+
+    def teardown(self) -> None:
+        self._stopped.set()
+
+
+class BrokerIngestionStreamFactory(IngestionStreamFactory):
+    """``sourcefactory: "broker"`` — config gives host/port/topic; topic
+    defaults to the dataset name, partitions = shards (reference:
+    KafkaIngestionStream.Factory + sourceconfig topic mapping)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 9092,
+                 topic: Optional[str] = None, poll_wait_ms: int = 200,
+                 stop_at_end: bool = False):
+        self.host, self.port = host, port
+        self.topic = topic
+        self.poll_wait_ms = poll_wait_ms
+        self.stop_at_end = stop_at_end
+
+    def create(self, dataset: str, shard: int,
+               offset: Optional[int] = None) -> BrokerIngestionStream:
+        client = BrokerClient(self.host, self.port)
+        return BrokerIngestionStream(client, self.topic or dataset, shard,
+                                     offset or 0, self.poll_wait_ms,
+                                     self.stop_at_end)
+
+
+class BrokerProducer:
+    """Shard-addressed container producer (the gateway's publish side)."""
+
+    def __init__(self, client: BrokerClient, topic: str,
+                 num_shards: Optional[int] = None):
+        self._client = client
+        self.topic = topic
+        if num_shards is not None:
+            client.create_topic(topic, num_shards)
+
+    def publish(self, shard: int, container: bytes) -> int:
+        return self._client.produce(self.topic, shard, container)
+
+
+class BrokerDownsamplePublisher:
+    """Flush-time downsample records go to per-resolution topics
+    ``<dataset>-ds-<resolution_ms>`` with partition = shard (reference:
+    KafkaDownsamplePublisher.scala:17).  Implements the
+    DownsamplePublisher protocol (downsample/sharddown.py)."""
+
+    def __init__(self, client: BrokerClient, dataset: str,
+                 resolutions_ms, num_shards: int):
+        self._client = client
+        self.dataset = dataset
+        self.topics = {int(res): f"{dataset}-ds-{int(res)}"
+                       for res in resolutions_ms}
+        for t in self.topics.values():
+            client.create_topic(t, num_shards)
+
+    def topic_for(self, resolution_ms: int) -> str:
+        return self.topics[int(resolution_ms)]
+
+    def publish(self, resolution_ms: int, shard: int, containers) -> None:
+        topic = self.topics[int(resolution_ms)]
+        for c in containers:
+            self._client.produce(topic, shard, bytes(c))
+
+
+def _broker_factory(**kwargs) -> BrokerIngestionStreamFactory:
+    return BrokerIngestionStreamFactory(**kwargs)
+
+
+register_source_factory("broker", _broker_factory)
+register_source_factory("kafka", _broker_factory)  # capability alias

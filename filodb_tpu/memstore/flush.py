@@ -83,6 +83,12 @@ class FlushScheduler:
             self._submit(g)
 
     def _submit(self, group: int) -> Future:
+        # closed check BEFORE prepare: prepare irreversibly detaches
+        # buffers and the dirty-partkey set, which would be dropped if we
+        # prepared first and then refused the submit
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("FlushScheduler is closed")
         task = self.shard.prepare_flush_group(group)
 
         def run(_prev: Optional[Future]) -> int:
@@ -90,6 +96,9 @@ class FlushScheduler:
 
         with self._lock:
             if self._closed:
+                # closed between check and prepare: run inline so the
+                # snapshot is never lost
+                self.shard.run_flush_task(task)
                 raise RuntimeError("FlushScheduler is closed")
             prev = self._chains.get(group)
             if prev is None:

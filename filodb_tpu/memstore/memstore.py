@@ -88,6 +88,8 @@ class TimeSeriesMemStore:
           dedicated flush executor (memstore/flush.py), so ingestion never
           stalls behind a flush (reference TimeSeriesShard.scala:804-846).
         """
+        if flush_each is not None and flush_interval_ms is not None:
+            raise ValueError("pass flush_each OR flush_interval_ms, not both")
         shard = self.get_shard(dataset, shard_num)
         total = 0
         if flush_interval_ms is not None:

@@ -81,7 +81,14 @@ class TimeSeriesPartition:
     # -- ingest -------------------------------------------------------------
 
     def ingest(self, timestamp: int, values: Sequence) -> bool:
-        """Append one sample.  Returns False for out-of-order drops."""
+        """Append one sample.  Returns False for out-of-order drops.
+
+        All buffer mutation happens under ``_lock`` so an off-thread
+        flush (``flush_now``/admin ``flush_all``) freezing this buffer
+        concurrently cannot interleave with a half-written row; encoding
+        of anything frozen here is deferred until after the lock drops
+        (lock order: never hold ``_lock`` while taking ``_encode_lock``).
+        """
         if timestamp <= self.latest_timestamp:
             self.out_of_order_dropped += 1
             return False
@@ -89,27 +96,35 @@ class TimeSeriesPartition:
         # freezes the current buffer (reference: AddResponse.
         # BucketSchemaMismatch forces a new vector, BinaryVector.scala:231-236)
         decoded = []
+        new_buckets = None
         for col, v in zip(self.schema.data.columns[1:], values):
             if col.ctype == ColumnType.HISTOGRAM:
                 buckets, counts = histcodec.decode_hist_value(v) \
                     if isinstance(v, (bytes, bytearray)) else v
-                if self._hist_buckets is not None and self._buf_n > 0 \
-                        and buckets != self._hist_buckets:
-                    self.switch_buffers()
-                self._hist_buckets = buckets
+                new_buckets = buckets
                 decoded.append(np.asarray(counts, dtype=np.int64))
             else:
                 decoded.append(v)
-        if self._buf_n == self._capacity:
-            self.switch_buffers()
-        i = self._buf_n
-        self._buf_ts[i] = timestamp
-        for buf, col, v in zip(self._buf_cols, self.schema.data.columns[1:], decoded):
-            if col.ctype in (ColumnType.HISTOGRAM, ColumnType.STRING):
-                buf.append(v)
-            else:
-                buf[i] = v
-        self._buf_n = i + 1
+        froze = False
+        with self._lock:
+            if new_buckets is not None:
+                if self._hist_buckets is not None and self._buf_n > 0 \
+                        and new_buckets != self._hist_buckets:
+                    froze = self._freeze_raw_locked() or froze
+                self._hist_buckets = new_buckets
+            if self._buf_n == self._capacity:
+                froze = self._freeze_raw_locked() or froze
+            i = self._buf_n
+            self._buf_ts[i] = timestamp
+            for buf, col, v in zip(self._buf_cols,
+                                   self.schema.data.columns[1:], decoded):
+                if col.ctype in (ColumnType.HISTOGRAM, ColumnType.STRING):
+                    buf.append(v)
+                else:
+                    buf[i] = v
+            self._buf_n = i + 1
+        if froze:
+            self.drain_pending()
         return True
 
     def ingest_block(self, ts: np.ndarray, cols: Sequence[np.ndarray]
@@ -123,28 +138,41 @@ class TimeSeriesPartition:
         n = len(ts)
         if n == 0:
             return 0, 0
-        running = np.maximum.accumulate(
-            np.concatenate(([self.latest_timestamp], ts)))[:-1]
-        keep = ts > running
-        kept = int(keep.sum())
-        dropped = n - kept
-        self.out_of_order_dropped += dropped
-        if kept == 0:
-            return 0, dropped
-        if kept != n:
-            ts = ts[keep]
-            cols = [c[keep] for c in cols]
-        i = 0
-        while i < kept:
-            if self._buf_n == self._capacity:
-                self.switch_buffers()
-            take = min(self._capacity - self._buf_n, kept - i)
-            j = self._buf_n
-            self._buf_ts[j:j + take] = ts[i:i + take]
-            for buf, arr in zip(self._buf_cols, cols):
-                buf[j:j + take] = arr[i:i + take]
-            self._buf_n = j + take
-            i += take
+        froze = False
+        with self._lock:
+            # high-water mark inline (the property would re-take _lock)
+            if self._buf_n:
+                lt = int(self._buf_ts[self._buf_n - 1])
+            elif self._pending:
+                lt = int(self._pending[-1].ts[-1])
+            elif self.chunks:
+                lt = self.chunks[-1].info.end_time
+            else:
+                lt = -1
+            running = np.maximum.accumulate(np.concatenate(([lt], ts)))[:-1]
+            keep = ts > running
+            kept = int(keep.sum())
+            dropped = n - kept
+            self.out_of_order_dropped += dropped
+            if kept == 0:
+                return 0, dropped
+            if kept != n:
+                ts = ts[keep]
+                cols = [c[keep] for c in cols]
+            i = 0
+            while i < kept:
+                if self._buf_n == self._capacity:
+                    froze = self._freeze_raw_locked() or froze
+                take = min(self._capacity - self._buf_n, kept - i)
+                j = self._buf_n
+                self._buf_ts[j:j + take] = ts[i:i + take]
+                for buf, arr in zip(self._buf_cols, cols):
+                    buf[j:j + take] = arr[i:i + take]
+                self._buf_n = j + take
+                i += take
+        if froze:
+            # encode outside _lock (lock order: _encode_lock then _lock)
+            self.drain_pending()
         return kept, dropped
 
     @property
@@ -180,17 +208,20 @@ class TimeSeriesPartition:
         Encoding happens later in :meth:`drain_pending` on the flush
         executor.  Returns True if anything froze."""
         with self._lock:
-            n = self._buf_n
-            if n == 0:
-                return False
-            cols = [buf[:n] for buf in self._buf_cols]
-            self._pending.append(PendingBuffer(self._buf_ts[:n], cols,
-                                               self._hist_buckets, self._seq))
-            self._seq += 1
-            self._buf_n = 0
-            self._buf_ts = np.empty(self._capacity, dtype=np.int64)
-            self._buf_cols = [self._new_col_buffer(c.ctype)
-                              for c in self.schema.data.columns[1:]]
+            return self._freeze_raw_locked()
+
+    def _freeze_raw_locked(self) -> bool:
+        n = self._buf_n
+        if n == 0:
+            return False
+        cols = [buf[:n] for buf in self._buf_cols]
+        self._pending.append(PendingBuffer(self._buf_ts[:n], cols,
+                                           self._hist_buckets, self._seq))
+        self._seq += 1
+        self._buf_n = 0
+        self._buf_ts = np.empty(self._capacity, dtype=np.int64)
+        self._buf_cols = [self._new_col_buffer(c.ctype)
+                          for c in self.schema.data.columns[1:]]
         return True
 
     def drain_pending(self) -> list[ChunkSet]:
@@ -254,6 +285,13 @@ class TimeSeriesPartition:
         with self._lock:
             out, self._unflushed = self._unflushed, []
         return out
+
+    def requeue_unflushed(self, chunksets: Sequence[ChunkSet]) -> None:
+        """Put collected-but-not-persisted chunksets back at the head of
+        the unflushed list (a failed store write must not lose them —
+        the next flush retries; writes are idempotent by chunk id)."""
+        with self._lock:
+            self._unflushed = list(chunksets) + self._unflushed
 
     # -- read ---------------------------------------------------------------
 

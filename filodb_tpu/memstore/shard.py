@@ -21,6 +21,7 @@ padded device-ready ChunkBatch instead of per-row iterators.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Iterable, Optional, Sequence
 
@@ -28,7 +29,8 @@ import numpy as np
 
 from filodb_tpu.core.chunk import ChunkBatch, build_batch
 from filodb_tpu.core.filters import ColumnFilter
-from filodb_tpu.core.record import IngestRecord, decode_container
+from filodb_tpu.core.record import (IngestRecord, decode_container,
+                                    parse_partkey)
 from filodb_tpu.core.schemas import ColumnType, Schemas
 from filodb_tpu.core.storeconfig import StoreConfig
 from filodb_tpu.memstore.index import PartKeyIndex
@@ -100,6 +102,9 @@ class TimeSeriesShard:
         # already persisted pre-restart and are skipped during recovery
         self.group_watermarks = [-1] * self.num_groups
         self._dirty_partkeys: list[set[int]] = [set() for _ in range(self.num_groups)]
+        # guards the dirty-set swap (flush prepare), merge-back (failed
+        # flush), and ingest-side adds against each other
+        self._dirty_lock = threading.Lock()
         self.latest_offset = -1
         # newest sample timestamp seen: drives time-boundary flush
         # scheduling (reference: createFlushTasks time boundaries :804-846)
@@ -186,7 +191,8 @@ class TimeSeriesShard:
             self.stats.out_of_order_dropped += dropped
             if self.index.end_time(part.part_id) != maxint:
                 self.index.mark_active(part.part_id)
-            self._dirty_partkeys[int(groups_r[first])].add(part.part_id)
+            with self._dirty_lock:
+                self._dirty_partkeys[int(groups_r[first])].add(part.part_id)
         if len(ts):
             self.latest_ingest_ts = max(self.latest_ingest_ts,
                                         int(ts.max()))
@@ -218,7 +224,8 @@ class TimeSeriesShard:
                 self.stats.out_of_order_dropped += 1
             if self.index.end_time(part.part_id) != np.iinfo(np.int64).max:
                 self.index.mark_active(part.part_id)
-            self._dirty_partkeys[group].add(part.part_id)
+            with self._dirty_lock:
+                self._dirty_partkeys[group].add(part.part_id)
             if rec.timestamp > self.latest_ingest_ts:
                 self.latest_ingest_ts = rec.timestamp
         self.latest_offset = max(self.latest_offset, offset)
@@ -245,7 +252,6 @@ class TimeSeriesShard:
                 return part
             # index-only entry (recovered or paged-out): re-materialize the
             # partition under its existing part id, keeping index lifecycle
-            from filodb_tpu.core.record import parse_partkey
             part = TimeSeriesPartition(pid, schema, pk,
                                        tags if tags is not None
                                        else parse_partkey(pk),
@@ -257,7 +263,6 @@ class TimeSeriesShard:
             return part
         # evicted-key bloom check: a maybe-evicted key re-reads its true
         # start time from the column store lifecycle (reference :1103-1122)
-        from filodb_tpu.core.record import parse_partkey
         if tags is None:
             tags = parse_partkey(pk)
         start_time = timestamp
@@ -298,7 +303,9 @@ class TimeSeriesShard:
         parts = [p for p in self.partitions.values() if p.group == group]
         for part in parts:
             part.freeze_raw()
-        dirty, self._dirty_partkeys[group] = self._dirty_partkeys[group], set()
+        with self._dirty_lock:
+            dirty = self._dirty_partkeys[group]
+            self._dirty_partkeys[group] = set()
         return FlushTask(group=group, parts=parts, dirty=dirty,
                          offset=self.latest_offset, ingestion_time=itime)
 
@@ -308,11 +315,14 @@ class TimeSeriesShard:
         persist partkeys, checkpoint (the doFlushSteps pipeline,
         reference :884-974).  Returns chunksets written.  On failure the
         dirty partkeys are re-queued so a later flush persists them."""
+        collected: list[tuple] = []  # (part, its fresh chunksets)
         try:
             chunksets = []
             ds_pairs: dict[int, list] = {}  # schema_hash -> [(tags, cs)]
             for part in task.parts:
                 fresh = part.collect_flush_chunks()
+                if fresh:
+                    collected.append((part, fresh))
                 chunksets.extend(fresh)
                 if self.downsample_publisher is not None and fresh:
                     ds_pairs.setdefault(part.schema.schema_hash, []).extend(
@@ -331,8 +341,13 @@ class TimeSeriesShard:
                         for pid in task.dirty if pid in self.partitions]
                 self.store.write_part_keys(self.dataset, self.shard_num, recs)
         except BaseException:
-            # partkeys not persisted: merge them back for the next flush
-            self._dirty_partkeys[task.group] |= task.dirty
+            # nothing persisted for sure: requeue both the chunksets and
+            # the dirty partkeys so the next flush retries them (store
+            # writes are idempotent by chunk id / partkey upsert)
+            for part, fresh in collected:
+                part.requeue_unflushed(fresh)
+            with self._dirty_lock:
+                self._dirty_partkeys[task.group] |= task.dirty
             raise
         # checkpoint only after chunks+partkeys persisted (reference :949-960)
         self.meta.write_checkpoint(self.dataset, self.shard_num, task.group,

@@ -11,10 +11,16 @@ driven by a JSON config instead of HOCON:
       "data-dir": "/var/filodb",          # omit for in-memory only
       "http-port": 8080,
       "gateway-port": 8009,               # omit to disable the Influx edge
+      "broker": {"port": 9092, "data-dir": "/var/filodb/broker"},
+                                          # embedded message broker (omit
+                                          # to use an external one / none)
       "profiler": false,
       "datasets": [{
         "name": "prom", "num-shards": 4, "min-num-nodes": 1,
         "schema": "gauge", "spread": 1,
+        "source": {"factory": "kafka", "host": "127.0.0.1",
+                   "port": 9092, "topic": "prom"},
+                                          # omit for the in-proc queue
         "store": {"flush-interval": "1h", "groups-per-shard": 8}
       }]
     }
@@ -66,12 +72,20 @@ class FiloServer:
                                    node_name=self.node,
                                    shard_manager=self.manager)
         self.gateways: list[GatewayServer] = []
+        self.broker = None  # embedded BrokerServer when configured
         self.profiler: Optional[SimpleProfiler] = None
         self._global_gateway_claimed = False
         self._started = threading.Event()
 
     def start(self) -> int:
         """Bring the node up; returns the HTTP port."""
+        broker_conf = self.config.get("broker")
+        if broker_conf is not None:
+            from filodb_tpu.ingest.broker import BrokerServer
+            self.broker = BrokerServer(
+                port=int(broker_conf.get("port", 0)),
+                data_dir=broker_conf.get("data-dir"))
+            self.broker.start()
         self.metastore.initialize()
         self.failure_detector.heartbeat(self.node)
         up = REGISTRY.gauge("filodb_node_up")
@@ -95,10 +109,33 @@ class FiloServer:
         if hasattr(self.metastore, "write_dataset"):
             self.metastore.write_dataset(name, json.dumps(ds_conf))
 
+        # per-dataset source: "broker"/"kafka" reads topic partitions from
+        # a message broker (reference: sourcefactory =
+        # KafkaIngestionStreamFactory); default is the in-proc queue
+        source_conf = dict(ds_conf.get("source", {}))
+        factory_name = source_conf.pop("factory", None)
+        broker_producer = None
+        if factory_name in ("broker", "kafka"):
+            from filodb_tpu.ingest.broker import (BrokerClient,
+                                                  BrokerIngestionStreamFactory,
+                                                  BrokerProducer)
+            if self.broker is not None:
+                source_conf.setdefault("port", self.broker.port)
+            ds_factory = BrokerIngestionStreamFactory(
+                topic=source_conf.pop("topic", name), **source_conf)
+            client = BrokerClient(ds_factory.host, ds_factory.port)
+            broker_producer = BrokerProducer(client, ds_factory.topic or name,
+                                             num_shards)
+        elif factory_name is not None:
+            from filodb_tpu.ingest.stream import source_factory
+            ds_factory = source_factory(factory_name, **source_conf)
+        else:
+            ds_factory = self.stream_factory
+
         self.manager.setup_dataset(name, num_shards,
                                    int(ds_conf.get("min-num-nodes", 1)))
         ic = self.coordinator.setup_dataset(
-            name, DEFAULT_SCHEMAS, self.stream_factory, store_cfg,
+            name, DEFAULT_SCHEMAS, ds_factory, store_cfg,
             event_sink=self.manager.publish_event)
         shards = self.manager.mapper(name).shards_for_node(self.node)
         ic.resync(shards)
@@ -125,11 +162,12 @@ class FiloServer:
                 self._global_gateway_claimed = True
         if gw_port is not None:
             schema = DEFAULT_SCHEMAS[ds_conf.get("schema", "gauge")]
-            pub = ShardingPublisher(
-                schema, mapper,
-                lambda s, c, _n=name: self.stream_factory.stream_for(
-                    _n, s).push(c),
-                spread=spread)
+            if broker_producer is not None:
+                publish = broker_producer.publish
+            else:
+                publish = lambda s, c, _n=name: self.stream_factory.stream_for(  # noqa: E731
+                    _n, s).push(c)
+            pub = ShardingPublisher(schema, mapper, publish, spread=spread)
             gw = GatewayServer(pub, port=int(gw_port))
             gw.start()
             self.gateways.append(gw)
@@ -146,6 +184,8 @@ class FiloServer:
             gw.shutdown()
         self.coordinator.shutdown()
         self.http.shutdown()
+        if self.broker is not None:
+            self.broker.shutdown()
         if self.profiler is not None:
             self.profiler.stop()
         self.colstore.shutdown()

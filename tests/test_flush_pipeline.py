@@ -168,6 +168,42 @@ class TestFlushFailure:
         sh.flush_group(part.group)
         assert ms.meta.read_checkpoints("ds", 0)
 
+    def test_failed_write_chunks_requeues_chunksets(self):
+        """A transient chunk-write failure must not lose chunksets: the
+        retry flush persists them (idempotent by chunk id)."""
+        written = []
+
+        class FlakyStore:
+            def __init__(self):
+                self.fail = True
+
+            def write_chunks(self, ds, shard, chunksets, itime):
+                if self.fail:
+                    raise RuntimeError("transient")
+                written.extend(chunksets)
+
+            def write_part_keys(self, ds, shard, recs):
+                pass
+
+        from filodb_tpu.core.schemas import DEFAULT_SCHEMAS as S
+        ms = TimeSeriesMemStore()
+        ms.setup("ds", S, 0)
+        sh = ms.get_shard("ds", 0)
+        store = FlakyStore()
+        sh.store = store
+        tags = {"__name__": "m", "i": "0", "_ws_": "w", "_ns_": "n"}
+        for off, c in enumerate(_container(
+                [BASE + i * 1000 for i in range(8)], list(range(8)), tags)):
+            sh.ingest_container(c, off)
+        part = next(iter(sh.partitions.values()))
+        with pytest.raises(RuntimeError):
+            sh.flush_group(part.group)
+        assert not written
+        store.fail = False
+        n = sh.flush_group(part.group)
+        assert n == 1 and len(written) == 1
+        assert written[0].info.num_rows == 8
+
     def test_scheduler_close_shuts_down_after_task_failure(self):
         ms, sh = _setup()
         tags = {"__name__": "m", "i": "0", "_ws_": "w", "_ns_": "n"}
