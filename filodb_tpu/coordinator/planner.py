@@ -236,6 +236,19 @@ class SingleClusterPlanner(QueryPlanner):
                                      self.dispatcher_for_shard(s))
                         for s in shards]
             return PartKeysDistConcatExec(children, qctx)
+        if isinstance(plan, lp.RawSeries):
+            # bare raw selector (remote read / RawSeries API): per-shard
+            # leaf scans with no periodic mapper, concatenated (reference:
+            # SelectRawPartitionsExec without transformers)
+            shards = self.shards_from_filters(plan.filters, qctx)
+            column = plan.columns[0] if plan.columns else None
+            children = [MultiSchemaPartitionsExec(
+                self.dataset, s, plan.filters,
+                plan.range_selector.from_ms, plan.range_selector.to_ms,
+                column=column, query_context=qctx,
+                dispatcher=self.dispatcher_for_shard(s))
+                for s in shards]
+            return DistConcatExec(children, qctx)
         raise ValueError(f"cannot materialize {type(plan).__name__}")
 
     def _scalar_operand(self, plan, qctx):

@@ -1,0 +1,259 @@
+"""Prometheus remote-storage protocol: protobuf wire codec + /read logic.
+
+Capability match for the reference's remote-read endpoint (reference:
+prometheus/src/main/proto/remote-storage.proto — the wire contract;
+prometheus/src/main/scala/filodb/prometheus/query/PrometheusModel.scala:12
+ReadRequest/ReadResponse conversions; http/.../PrometheusApiRoute.scala:38-60
+`/promql/<ds>/api/v1/read` route).  The reference ships 6.9k lines of
+protoc-generated Java; the schema is five tiny messages, so here the
+wire codec is hand-rolled (~100 lines) against the same .proto:
+
+    Sample{1:double value, 2:int64 timestamp_ms}
+    LabelPair{1:string name, 2:string value}
+    TimeSeries{1:rep LabelPair, 2:rep Sample}
+    ReadRequest{1:rep Query} / ReadResponse{1:rep QueryResult}
+    Query{1:int64 start, 2:int64 end, 3:rep LabelMatcher}
+    LabelMatcher{1:enum type(EQ/NEQ/RE/NRE), 2:name, 3:value}
+    QueryResult{1:rep TimeSeries}
+    WriteRequest{1:rep TimeSeries}
+
+Payloads are snappy-block-compressed (filodb_tpu/utils/snappy.py), as
+Prometheus remote read/write requires.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Iterator, Sequence
+
+MATCH_EQUAL = 0
+MATCH_NOT_EQUAL = 1
+MATCH_REGEX = 2
+MATCH_NOT_REGEX = 3
+
+
+# ---------------------------------------------------------------------------
+# protobuf wire primitives
+# ---------------------------------------------------------------------------
+
+from filodb_tpu.utils.leb128 import decode as _read_uvarint
+from filodb_tpu.utils.leb128 import encode as _uvarint
+
+
+def _zig64(n: int) -> int:
+    return n & 0xFFFFFFFFFFFFFFFF  # int64 as two's-complement varint
+
+
+def _as_int64(u: int) -> int:
+    return u - (1 << 64) if u >= 1 << 63 else u
+
+
+def _field(tag: int, wire: int) -> bytes:
+    return _uvarint((tag << 3) | wire)
+
+
+def _len_field(tag: int, payload: bytes) -> bytes:
+    return _field(tag, 2) + _uvarint(len(payload)) + payload
+
+
+def _iter_fields(buf: bytes) -> Iterator[tuple[int, int, object]]:
+    """Yield (tag, wire_type, value); value is int for varint/fixed,
+    bytes for length-delimited."""
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_uvarint(buf, pos)
+        tag, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_uvarint(buf, pos)
+            yield tag, wire, val
+        elif wire == 1:
+            if pos + 8 > len(buf):
+                raise ValueError("truncated fixed64")
+            yield tag, wire, int.from_bytes(buf[pos:pos + 8], "little")
+            pos += 8
+        elif wire == 2:
+            ln, pos = _read_uvarint(buf, pos)
+            if pos + ln > len(buf):
+                raise ValueError("truncated bytes field")
+            yield tag, wire, buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            if pos + 4 > len(buf):
+                raise ValueError("truncated fixed32")
+            yield tag, wire, int.from_bytes(buf[pos:pos + 4], "little")
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+
+
+# ---------------------------------------------------------------------------
+# messages
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class LabelMatcher:
+    type: int
+    name: str
+    value: str
+
+
+@dataclasses.dataclass
+class RemoteQuery:
+    start_ms: int
+    end_ms: int
+    matchers: list[LabelMatcher]
+
+
+def decode_read_request(buf: bytes) -> list[RemoteQuery]:
+    queries = []
+    for tag, wire, val in _iter_fields(buf):
+        if tag == 1 and wire == 2:
+            queries.append(_decode_query(val))
+    return queries
+
+
+def _decode_query(buf: bytes) -> RemoteQuery:
+    start = end = 0
+    matchers: list[LabelMatcher] = []
+    for tag, wire, val in _iter_fields(buf):
+        if tag == 1 and wire == 0:
+            start = _as_int64(val)
+        elif tag == 2 and wire == 0:
+            end = _as_int64(val)
+        elif tag == 3 and wire == 2:
+            matchers.append(_decode_matcher(val))
+    return RemoteQuery(start, end, matchers)
+
+
+def _decode_matcher(buf: bytes) -> LabelMatcher:
+    mtype = MATCH_EQUAL
+    name = value = ""
+    for tag, wire, val in _iter_fields(buf):
+        if tag == 1 and wire == 0:
+            mtype = val
+        elif tag == 2 and wire == 2:
+            name = val.decode()
+        elif tag == 3 and wire == 2:
+            value = val.decode()
+    return LabelMatcher(mtype, name, value)
+
+
+def encode_read_request(queries: Sequence[RemoteQuery]) -> bytes:
+    out = bytearray()
+    for q in queries:
+        body = bytearray()
+        body += _field(1, 0) + _uvarint(_zig64(q.start_ms))
+        body += _field(2, 0) + _uvarint(_zig64(q.end_ms))
+        for m in q.matchers:
+            mb = bytearray()
+            if m.type:
+                mb += _field(1, 0) + _uvarint(m.type)
+            mb += _len_field(2, m.name.encode())
+            mb += _len_field(3, m.value.encode())
+            body += _len_field(3, bytes(mb))
+        out += _len_field(1, bytes(body))
+    return bytes(out)
+
+
+def encode_time_series(labels: dict, ts, vals) -> bytes:
+    body = bytearray()
+    for k in sorted(labels):
+        pair = _len_field(1, k.encode()) + _len_field(2, str(labels[k]).encode())
+        body += _len_field(1, pair)
+    for t, v in zip(ts, vals):
+        sample = (_field(1, 1) + struct.pack("<d", float(v))
+                  + _field(2, 0) + _uvarint(_zig64(int(t))))
+        body += _len_field(2, sample)
+    return bytes(body)
+
+
+def encode_read_response(per_query_series: Sequence[Sequence[bytes]]) -> bytes:
+    """per_query_series[i] = encoded TimeSeries blobs for request query i."""
+    out = bytearray()
+    for series_list in per_query_series:
+        qr = bytearray()
+        for ts_blob in series_list:
+            qr += _len_field(1, ts_blob)
+        out += _len_field(1, bytes(qr))
+    return bytes(out)
+
+
+def decode_read_response(buf: bytes) -> list[list[tuple[dict, list, list]]]:
+    """Inverse of encode_read_response: [[(labels, ts, vals), ...], ...].
+    Used by tests and by PromQlRemoteExec-style clients."""
+    results = []
+    for tag, wire, val in _iter_fields(buf):
+        if tag == 1 and wire == 2:
+            series = []
+            for t2, w2, v2 in _iter_fields(val):
+                if t2 == 1 and w2 == 2:
+                    series.append(_decode_time_series(v2))
+            results.append(series)
+    return results
+
+
+def _decode_time_series(buf: bytes) -> tuple[dict, list, list]:
+    labels: dict[str, str] = {}
+    ts: list[int] = []
+    vals: list[float] = []
+    for tag, wire, val in _iter_fields(buf):
+        if tag == 1 and wire == 2:
+            name = value = ""
+            for t2, w2, v2 in _iter_fields(val):
+                if t2 == 1 and w2 == 2:
+                    name = v2.decode()
+                elif t2 == 2 and w2 == 2:
+                    value = v2.decode()
+            labels[name] = value
+        elif tag == 2 and wire == 2:
+            v = 0.0
+            t = 0
+            for t2, w2, v2 in _iter_fields(val):
+                if t2 == 1 and w2 == 1:
+                    v = struct.unpack("<d", v2.to_bytes(8, "little"))[0]
+                elif t2 == 2 and w2 == 0:
+                    t = _as_int64(v2)
+            ts.append(t)
+            vals.append(v)
+    return labels, ts, vals
+
+
+def decode_write_request(buf: bytes) -> list[tuple[dict, list, list]]:
+    """WriteRequest -> [(labels, ts_list, val_list)] (remote-write edge)."""
+    out = []
+    for tag, wire, val in _iter_fields(buf):
+        if tag == 1 and wire == 2:
+            out.append(_decode_time_series(val))
+    return out
+
+
+def encode_write_request(series: Sequence[tuple[dict, Sequence, Sequence]]
+                         ) -> bytes:
+    out = bytearray()
+    for labels, ts, vals in series:
+        out += _len_field(1, encode_time_series(labels, ts, vals))
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# matcher -> ColumnFilter conversion
+# ---------------------------------------------------------------------------
+
+def matchers_to_filters(matchers: Sequence[LabelMatcher],
+                        metric_column: str = "_metric_"):
+    """Remote-read matchers to the engine's ColumnFilters; ``__name__``
+    maps onto the dataset's metric column (reference: PrometheusModel
+    conversions)."""
+    from filodb_tpu.core.filters import (ColumnFilter, Equals, EqualsRegex,
+                                         NotEquals, NotEqualsRegex)
+    out = []
+    ctor = {MATCH_EQUAL: Equals, MATCH_NOT_EQUAL: NotEquals,
+            MATCH_REGEX: EqualsRegex, MATCH_NOT_REGEX: NotEqualsRegex}
+    for m in matchers:
+        col = metric_column if m.name == "__name__" else m.name
+        c = ctor.get(m.type)
+        if c is None:
+            raise ValueError(f"unknown matcher type {m.type}")
+        out.append(ColumnFilter(col, c(m.value)))
+    return out

@@ -40,6 +40,9 @@ class DatasetBinding:
     memstore: TimeSeriesMemStore
     planner: QueryPlanner
     metric_column: str = "_metric_"  # DatasetOptions.metric_column
+    # remote-write ingest hook: (labels, ts_list, val_list) -> None; when
+    # None the /api/v1/write endpoint 400s for this dataset
+    write_router: Optional[object] = None
 
 
 @dataclass
@@ -108,6 +111,11 @@ class FiloHttpServer:
             return
         if req.path.split("?")[0] == "/execplan" and method == "POST":
             self._handle_execplan(req)
+            return
+        bare = req.path.split("?")[0]
+        if method == "POST" and (bare.endswith("/api/v1/read")
+                                 or bare.endswith("/api/v1/write")):
+            self._handle_remote(req, bare)
             return
         try:
             parsed = urllib.parse.urlparse(req.path)
@@ -180,6 +188,97 @@ class FiloHttpServer:
             req.wfile.write(data)
         except Exception:  # noqa: BLE001 — client went away
             pass
+
+    def _handle_remote(self, req: BaseHTTPRequestHandler, path: str) -> None:
+        """Prometheus remote-storage endpoints: snappy'd protobuf over
+        POST (reference: PrometheusApiRoute.scala:38-60 `/read` +
+        remote-storage.proto wire contract).  `/write` additionally
+        accepts remote-write as an ingest edge into the bound memstore."""
+        from filodb_tpu.utils import snappy
+
+        try:
+            parts = [p for p in path.split("/") if p]
+            ds = parts[1] if len(parts) >= 2 and parts[0] == "promql" else ""
+            binding = self.datasets.get(ds)
+            if binding is None:
+                code, body, ctype = 404, json.dumps(error_response(
+                    "bad_data", f"unknown dataset {ds}")).encode(), \
+                    "application/json"
+            else:
+                ln = int(req.headers.get("Content-Length") or 0)
+                raw = snappy.decompress(req.rfile.read(ln))
+                if path.endswith("/read"):
+                    body = snappy.compress(self._remote_read(binding, raw))
+                    code, ctype = 200, "application/x-protobuf"
+                else:
+                    n = self._remote_write(binding, raw)
+                    body, ctype = json.dumps(
+                        {"status": "success", "samples": n}).encode(), \
+                        "application/json"
+                    code = 200
+        except (QueryError, ValueError, KeyError) as e:
+            code, ctype = 400, "application/json"
+            body = json.dumps(error_response("bad_data", str(e))).encode()
+        except Exception as e:  # noqa: BLE001
+            code, ctype = 500, "application/json"
+            body = json.dumps(error_response("internal", str(e))).encode()
+        try:
+            req.send_response(code)
+            req.send_header("Content-Type", ctype)
+            if ctype == "application/x-protobuf":
+                req.send_header("Content-Encoding", "snappy")
+            req.send_header("Content-Length", str(len(body)))
+            req.end_headers()
+            req.wfile.write(body)
+        except Exception:  # noqa: BLE001 — client went away
+            pass
+
+    def _remote_read(self, b: DatasetBinding, raw: bytes) -> bytes:
+        """Execute each remote query as a RawSeries plan; stream raw
+        samples back as prompb TimeSeries."""
+        from filodb_tpu.http import remote as pb
+        from filodb_tpu.http.model import public_tags
+        from filodb_tpu.query.logical import IntervalSelector, RawSeries
+        from filodb_tpu.query.model import RawBatch
+
+        queries = pb.decode_read_request(raw)
+        per_query: list[list[bytes]] = []
+        for q in queries:
+            filters = pb.matchers_to_filters(q.matchers, b.metric_column)
+            plan = RawSeries(IntervalSelector(q.start_ms, q.end_ms),
+                             tuple(filters))
+            result = self._exec(b, plan)
+            series: list[bytes] = []
+            for batch in result.batches:
+                if not isinstance(batch, RawBatch) or batch.batch is None:
+                    continue
+                for i, tags in enumerate(batch.keys):
+                    n = int(batch.batch.row_counts[i])
+                    ts = batch.batch.timestamps[i][:n]
+                    vals = batch.batch.values[i][:n]
+                    # clamp to the query range (lookback may widen scans)
+                    mask = (ts >= q.start_ms) & (ts <= q.end_ms)
+                    if not mask.any():
+                        continue
+                    series.append(pb.encode_time_series(
+                        public_tags(tags, b.metric_column),
+                        ts[mask], vals[mask]))
+            per_query.append(series)
+        return pb.encode_read_response(per_query)
+
+    def _remote_write(self, b: DatasetBinding, raw: bytes) -> int:
+        """Remote-write edge: decode WriteRequest and ingest into the
+        bound memstore's shards via the gateway sharding rules."""
+        from filodb_tpu.http import remote as pb
+
+        if b.write_router is None:
+            raise QueryError("remote write not enabled for this dataset")
+        series = pb.decode_write_request(raw)
+        n = 0
+        for labels, ts, vals in series:
+            b.write_router(labels, ts, vals)
+            n += len(ts)
+        return n
 
     def _route(self, path: str, params: dict,
                multi: Optional[dict] = None) -> tuple[int, dict]:

@@ -243,7 +243,11 @@ class _Handler(socketserver.BaseRequestHandler):
                 try:
                     resp = self._dispatch(state, frame)
                     _write_frame(sock, bytes([STATUS_OK]) + resp)
-                except BrokerError as e:
+                except (BrokerError, struct.error, IndexError,
+                        UnicodeDecodeError, ValueError) as e:
+                    # malformed frames get an error response, not a dead
+                    # connection (the client would otherwise stall until
+                    # timeout and re-send the same bad frame forever)
                     _write_frame(sock, bytes([STATUS_ERR]) + str(e).encode())
         except (ConnectionError, OSError):
             return

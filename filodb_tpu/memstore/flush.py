@@ -94,12 +94,18 @@ class FlushScheduler:
         def run(_prev: Optional[Future]) -> int:
             return self.shard.run_flush_task(task)
 
+        run_inline = False
         with self._lock:
             if self._closed:
-                # closed between check and prepare: run inline so the
-                # snapshot is never lost
-                self.shard.run_flush_task(task)
-                raise RuntimeError("FlushScheduler is closed")
+                run_inline = True
+        if run_inline:
+            # closed between check and prepare: run inline (outside the
+            # lock) so the irreversible snapshot is never lost; the flush
+            # succeeded, so report it as such
+            fut: Future = Future()
+            fut.set_result(self.shard.run_flush_task(task))
+            return fut
+        with self._lock:
             prev = self._chains.get(group)
             if prev is None:
                 fut = self._exec.submit(run, None)

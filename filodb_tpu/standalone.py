@@ -151,7 +151,24 @@ class FiloServer:
         planner = SingleClusterPlanner(name, mapper, DatasetOptions(),
                                        spread_default=spread,
                                        dispatcher_for_shard=disp)
-        self.http.bind_dataset(DatasetBinding(name, self.memstore, planner))
+        schema = DEFAULT_SCHEMAS[ds_conf.get("schema", "gauge")]
+        if broker_producer is not None:
+            publish = broker_producer.publish
+        else:
+            publish = lambda s, c, _n=name: self.stream_factory.stream_for(  # noqa: E731
+                _n, s).push(c)
+        # Prometheus remote-write edge shares the gateway sharding rules
+        wpub = ShardingPublisher(schema, mapper, publish, spread=spread)
+
+        def write_router(labels, ts, vals, _pub=wpub):
+            metric = labels.get("__name__", "")
+            tags = {k: v for k, v in labels.items() if k != "__name__"}
+            for t, v in zip(ts, vals):
+                _pub.add_sample(metric, tags, int(t), float(v))
+            _pub.flush()
+
+        self.http.bind_dataset(DatasetBinding(name, self.memstore, planner,
+                                              write_router=write_router))
 
         gw_port = ds_conf.get("gateway-port")
         if gw_port is None and not self._global_gateway_claimed:
@@ -161,12 +178,6 @@ class FiloServer:
             if gw_port is not None:
                 self._global_gateway_claimed = True
         if gw_port is not None:
-            schema = DEFAULT_SCHEMAS[ds_conf.get("schema", "gauge")]
-            if broker_producer is not None:
-                publish = broker_producer.publish
-            else:
-                publish = lambda s, c, _n=name: self.stream_factory.stream_for(  # noqa: E731
-                    _n, s).push(c)
             pub = ShardingPublisher(schema, mapper, publish, spread=spread)
             gw = GatewayServer(pub, port=int(gw_port))
             gw.start()

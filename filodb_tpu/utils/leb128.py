@@ -1,0 +1,34 @@
+"""Unsigned LEB128 varints — shared by the snappy block codec and the
+protobuf wire codec (both formats use the same base-128 encoding)."""
+
+from __future__ import annotations
+
+
+def encode(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def decode(buf: bytes, pos: int, max_shift: int = 70) -> tuple[int, int]:
+    """Returns (value, next_pos).  ``max_shift`` bounds the encoding at
+    10 bytes (enough for any uint64)."""
+    val = 0
+    shift = 0
+    while True:
+        if pos >= len(buf):
+            raise ValueError("truncated varint")
+        b = buf[pos]
+        pos += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, pos
+        shift += 7
+        if shift > max_shift:
+            raise ValueError("varint too long")

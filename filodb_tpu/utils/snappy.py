@@ -1,0 +1,148 @@
+"""Pure-Python snappy block format (compress + decompress).
+
+The Prometheus remote-read/write protocol frames its protobuf payloads
+with raw snappy block compression (no framing format).  No snappy
+module may be installed in this environment, so this is a from-scratch
+implementation of the block format spec
+(github.com/google/snappy/blob/main/format_description.txt):
+
+- preamble: varint uncompressed length
+- elements: tag byte, low 2 bits select the type
+    00 literal  (len-1 in tag>>2; 60..63 mean 1..4 extra length bytes)
+    01 copy     (len = 4 + ((tag>>2) & 7), offset = ((tag>>5) << 8) | byte)
+    10 copy     (len = (tag>>2) + 1, offset = 2-byte LE)
+    11 copy     (len = (tag>>2) + 1, offset = 4-byte LE)
+
+The compressor is a greedy single-pass matcher with a 4-byte hash table
+(the same shape as the C implementation's fast path, minus tuning); it
+round-trips with the reference decompressor and compresses repetitive
+label sets well — exact output bytes differ from C snappy, which is fine:
+the format, not the compressor, is the contract.
+"""
+
+from __future__ import annotations
+
+from filodb_tpu.utils.leb128 import decode as _uvarint_decode
+from filodb_tpu.utils.leb128 import encode as _uvarint_encode
+
+
+def decompress(buf: bytes) -> bytes:
+    """Decompress one snappy block."""
+    want, pos = _uvarint_decode(buf, 0)
+    if want > 1 << 32:
+        raise ValueError("declared length too large")
+    out = bytearray()
+    n = len(buf)
+    while pos < n:
+        tag = buf[pos]
+        pos += 1
+        typ = tag & 3
+        if typ == 0:  # literal
+            ln = tag >> 2
+            if ln >= 60:
+                extra = ln - 59
+                if pos + extra > n:
+                    raise ValueError("truncated literal length")
+                ln = int.from_bytes(buf[pos:pos + extra], "little")
+                pos += extra
+            ln += 1
+            if pos + ln > n:
+                raise ValueError("truncated literal")
+            out += buf[pos:pos + ln]
+            pos += ln
+            continue
+        if typ == 1:
+            if pos >= n:
+                raise ValueError("truncated copy1")
+            ln = 4 + ((tag >> 2) & 0x7)
+            off = ((tag >> 5) << 8) | buf[pos]
+            pos += 1
+        elif typ == 2:
+            if pos + 2 > n:
+                raise ValueError("truncated copy2")
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(buf[pos:pos + 2], "little")
+            pos += 2
+        else:
+            if pos + 4 > n:
+                raise ValueError("truncated copy4")
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(buf[pos:pos + 4], "little")
+            pos += 4
+        if off == 0 or off > len(out):
+            raise ValueError("copy offset out of range")
+        start = len(out) - off
+        if ln <= off:
+            # non-overlapping: one slice copy
+            out += out[start:start + ln]
+        else:
+            # overlapping copy == repeat the off-byte pattern (RLE-style)
+            pattern = bytes(out[start:start + off])
+            out += (pattern * (ln // off + 1))[:ln]
+    if len(out) != want:
+        raise ValueError(f"length mismatch: got {len(out)}, want {want}")
+    return bytes(out)
+
+
+def _emit_literal(out: bytearray, data: memoryview, start: int, end: int) -> None:
+    ln = end - start
+    if ln == 0:
+        return
+    ln1 = ln - 1
+    if ln1 < 60:
+        out.append(ln1 << 2)
+    else:
+        nbytes = (ln1.bit_length() + 7) // 8
+        out.append((59 + nbytes) << 2)
+        out += ln1.to_bytes(nbytes, "little")
+    out += data[start:end]
+
+
+def _emit_copy(out: bytearray, off: int, ln: int) -> None:
+    # prefer copy2 (covers len<=64, off<=65535); chunk longer matches
+    while ln >= 68:
+        out.append((63 << 2) | 2)
+        out += off.to_bytes(2, "little")
+        ln -= 64
+    if ln > 64:
+        out.append((59 << 2) | 2)   # 60-byte copy, leave >=4 remainder
+        out += off.to_bytes(2, "little")
+        ln -= 60
+    if 4 <= ln <= 11 and off < 2048:
+        out.append(((off >> 8) << 5) | ((ln - 4) << 2) | 1)
+        out.append(off & 0xFF)
+    else:
+        out.append(((ln - 1) << 2) | 2)
+        out += off.to_bytes(2, "little")
+
+
+def compress(data: bytes) -> bytes:
+    """Compress one snappy block (greedy 4-byte hash matcher)."""
+    n = len(data)
+    out = bytearray(_uvarint_encode(n))
+    if n < 4:
+        _emit_literal(out, memoryview(data), 0, n)
+        return bytes(out)
+    mv = memoryview(data)
+    table: dict[bytes, int] = {}
+    lit_start = 0
+    i = 0
+    limit = n - 4
+    while i <= limit:
+        key = bytes(mv[i:i + 4])
+        cand = table.get(key)
+        table[key] = i
+        if cand is not None and i - cand <= 0xFFFF:
+            # extend the match
+            ln = 4
+            max_ln = n - i
+            while ln < max_ln and data[cand + ln] == data[i + ln]:
+                ln += 1
+            _emit_literal(out, mv, lit_start, i)
+            _emit_copy(out, i - cand, ln)
+            i += ln
+            lit_start = i
+        else:
+            i += 1
+    _emit_literal(out, mv, lit_start, n)
+    return bytes(out)

@@ -1,0 +1,211 @@
+"""Prometheus remote-storage protocol: snappy block codec, prompb wire
+codec, and the /api/v1/read + /api/v1/write HTTP endpoints.
+
+Reference being matched: prometheus/src/main/proto/remote-storage.proto
+(wire contract), PrometheusModel.scala:12 conversions,
+PrometheusApiRoute.scala:38-60 /read route.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.http import remote as pb
+from filodb_tpu.utils import snappy
+
+BASE = 1_700_000_000_000
+
+
+class TestSnappy:
+    @pytest.mark.parametrize("data", [
+        b"", b"a", b"abc", b"hello world " * 100,
+        bytes(range(256)) * 40, b"\x00" * 10_000,
+        b"abcd" * 3 + b"xyz",
+    ])
+    def test_roundtrip(self, data):
+        comp = snappy.compress(data)
+        assert snappy.decompress(comp) == data
+
+    def test_random_roundtrip(self):
+        rng = np.random.default_rng(0)
+        for _ in range(20):
+            n = int(rng.integers(0, 5000))
+            # mix of random and repetitive content
+            data = bytes(rng.integers(0, 4, n, dtype=np.uint8))
+            comp = snappy.compress(data)
+            assert snappy.decompress(comp) == data
+
+    def test_compresses_repetitive_data(self):
+        data = (b'{"__name__":"http_requests_total","job":"api"}' * 200)
+        comp = snappy.compress(data)
+        assert len(comp) < len(data) // 4
+
+    def test_decompress_reference_vectors(self):
+        # hand-built snappy streams: literal + copy elements
+        # "abcdabcd": literal "abcd" then copy2(off=4, len=4)
+        stream = bytes([8]) + bytes([3 << 2]) + b"abcd" \
+            + bytes([(3 << 2) | 2]) + (4).to_bytes(2, "little")
+        assert snappy.decompress(stream) == b"abcdabcd"
+        # RLE via overlapping copy: literal "a" + copy1(off=1, len=7)
+        stream = bytes([8]) + bytes([0]) + b"a" \
+            + bytes([((0) << 5) | ((7 - 4) << 2) | 1, 1])
+        assert snappy.decompress(stream) == b"a" * 8
+
+    def test_corrupt_inputs_raise(self):
+        with pytest.raises(ValueError):
+            snappy.decompress(b"")
+        with pytest.raises(ValueError):
+            snappy.decompress(bytes([10, 3 << 2]) + b"ab")  # short literal
+        with pytest.raises(ValueError):  # copy before any output
+            snappy.decompress(bytes([4, (3 << 2) | 2]) +
+                              (9).to_bytes(2, "little"))
+
+
+class TestPromPb:
+    def test_read_request_roundtrip(self):
+        q = pb.RemoteQuery(BASE, BASE + 60_000, [
+            pb.LabelMatcher(pb.MATCH_EQUAL, "__name__", "up"),
+            pb.LabelMatcher(pb.MATCH_REGEX, "job", "api|web"),
+            pb.LabelMatcher(pb.MATCH_NOT_EQUAL, "env", "dev"),
+        ])
+        buf = pb.encode_read_request([q])
+        back = pb.decode_read_request(buf)
+        assert len(back) == 1
+        assert back[0].start_ms == BASE and back[0].end_ms == BASE + 60_000
+        assert [(m.type, m.name, m.value) for m in back[0].matchers] == \
+            [(0, "__name__", "up"), (2, "job", "api|web"),
+             (1, "env", "dev")]
+
+    def test_time_series_roundtrip(self):
+        labels = {"__name__": "up", "job": "api"}
+        ts = [BASE, BASE + 1000, BASE + 2000]
+        vals = [1.0, 0.0, 1.5]
+        blob = pb.encode_time_series(labels, ts, vals)
+        resp = pb.encode_read_response([[blob]])
+        back = pb.decode_read_response(resp)
+        assert len(back) == 1 and len(back[0]) == 1
+        lb, t2, v2 = back[0][0]
+        assert lb == labels and t2 == ts and v2 == vals
+
+    def test_negative_timestamp_int64(self):
+        blob = pb.encode_time_series({}, [-5], [2.0])
+        resp = pb.encode_read_response([[blob]])
+        _, t2, v2 = pb.decode_read_response(resp)[0][0]
+        assert t2 == [-5] and v2 == [2.0]
+
+    def test_write_request_roundtrip(self):
+        series = [({"__name__": "m", "i": "0"}, [BASE], [3.5]),
+                  ({"__name__": "m", "i": "1"}, [BASE, BASE + 500],
+                   [1.0, 2.0])]
+        buf = pb.encode_write_request(series)
+        back = pb.decode_write_request(buf)
+        assert [(lb, list(t), list(v)) for lb, t, v in back] == \
+            [(lb, list(t), list(v)) for lb, t, v in series]
+
+    def test_matchers_to_filters(self):
+        fs = pb.matchers_to_filters([
+            pb.LabelMatcher(pb.MATCH_EQUAL, "__name__", "up"),
+            pb.LabelMatcher(pb.MATCH_NOT_REGEX, "job", "a.*")],
+            metric_column="_metric_")
+        assert fs[0].column == "_metric_"
+        assert fs[0].matches({"_metric_": "up"})
+        assert not fs[1].matches({"job": "abc"})
+        assert fs[1].matches({"job": "zzz"})
+
+
+@pytest.fixture(scope="module")
+def server():
+    from filodb_tpu.standalone import FiloServer
+    config = {
+        "node": "rr-node",
+        "datasets": [{"name": "prom", "num-shards": 2, "schema": "gauge",
+                      "spread": 1, "store": {"groups-per-shard": 2}}],
+    }
+    srv = FiloServer(config)
+    port = srv.start()
+    # ingest directly via the write_router-backed remote-write endpoint
+    yield srv, port
+    srv.shutdown()
+
+
+def _post(port, path, payload: bytes):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=payload,
+        headers={"Content-Type": "application/x-protobuf",
+                 "Content-Encoding": "snappy"})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class TestRemoteEndpoints:
+    def test_write_then_read(self, server):
+        srv, port = server
+        series = []
+        for i in range(4):
+            labels = {"__name__": "rr_metric", "inst": f"i{i}",
+                      "_ws_": "w", "_ns_": "n"}
+            ts = [BASE + k * 10_000 for k in range(30)]
+            vals = [float(i * 100 + k) for k in range(30)]
+            series.append((labels, ts, vals))
+        code, body = _post(port, "/promql/prom/api/v1/write",
+                           snappy.compress(pb.encode_write_request(series)))
+        assert code == 200, body
+        assert json.loads(body)["samples"] == 120
+
+        # ingestion is async through the stream; wait for arrival
+        import time
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            rows = sum(sh.stats.rows_ingested
+                       for sh in srv.memstore.shards("prom"))
+            if rows >= 120:
+                break
+            time.sleep(0.05)
+        assert rows == 120
+
+        q = pb.RemoteQuery(BASE, BASE + 300_000, [
+            pb.LabelMatcher(pb.MATCH_EQUAL, "__name__", "rr_metric"),
+            pb.LabelMatcher(pb.MATCH_EQUAL, "_ws_", "w"),
+            pb.LabelMatcher(pb.MATCH_EQUAL, "_ns_", "n")])
+        code, body = _post(port, "/promql/prom/api/v1/read",
+                           snappy.compress(pb.encode_read_request([q])))
+        assert code == 200, body
+        results = pb.decode_read_response(snappy.decompress(body))
+        assert len(results) == 1
+        got = {lb["inst"]: (t, v) for lb, t, v in results[0]}
+        assert set(got) == {f"i{i}" for i in range(4)}
+        for labels, ts, vals in series:
+            t2, v2 = got[labels["inst"]]
+            assert t2 == ts and v2 == vals
+        # labels carry __name__, not the internal metric column
+        assert all(lb.get("__name__") == "rr_metric"
+                   for lb, _, _ in results[0])
+
+    def test_read_regex_and_range_clamp(self, server):
+        srv, port = server
+        q = pb.RemoteQuery(BASE + 100_000, BASE + 150_000, [
+            pb.LabelMatcher(pb.MATCH_EQUAL, "__name__", "rr_metric"),
+            pb.LabelMatcher(pb.MATCH_REGEX, "inst", "i[01]")])
+        code, body = _post(port, "/promql/prom/api/v1/read",
+                           snappy.compress(pb.encode_read_request([q])))
+        assert code == 200
+        results = pb.decode_read_response(snappy.decompress(body))
+        assert {lb["inst"] for lb, _, _ in results[0]} == {"i0", "i1"}
+        for _, ts, _ in results[0]:
+            assert all(BASE + 100_000 <= t <= BASE + 150_000 for t in ts)
+
+    def test_unknown_dataset_404(self, server):
+        _, port = server
+        code, _ = _post(port, "/promql/nope/api/v1/read",
+                        snappy.compress(pb.encode_read_request([])))
+        assert code == 404
+
+    def test_garbage_payload_400(self, server):
+        _, port = server
+        code, _ = _post(port, "/promql/prom/api/v1/read", b"\xff\xfe")
+        assert code == 400
