@@ -31,6 +31,11 @@ from filodb_tpu.promql.parser import (ParseError,
 from filodb_tpu.query.exec import ExecContext
 from filodb_tpu.query.model import QueryContext, QueryError
 
+# remote-storage body limits (unauthenticated endpoints; snappy copy
+# elements amplify ~21x, so both sides are bounded)
+_MAX_REMOTE_COMPRESSED = 16 * 1024 * 1024
+_MAX_REMOTE_UNCOMPRESSED = 128 * 1024 * 1024
+
 
 @dataclass
 class DatasetBinding:
@@ -206,7 +211,12 @@ class FiloHttpServer:
                     "application/json"
             else:
                 ln = int(req.headers.get("Content-Length") or 0)
-                raw = snappy.decompress(req.rfile.read(ln))
+                if ln > _MAX_REMOTE_COMPRESSED:
+                    raise QueryError(
+                        f"request body {ln} bytes exceeds limit "
+                        f"{_MAX_REMOTE_COMPRESSED}")
+                raw = snappy.decompress(req.rfile.read(ln),
+                                        max_len=_MAX_REMOTE_UNCOMPRESSED)
                 if path.endswith("/read"):
                     body = snappy.compress(self._remote_read(binding, raw))
                     code, ctype = 200, "application/x-protobuf"

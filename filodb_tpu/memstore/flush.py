@@ -94,35 +94,38 @@ class FlushScheduler:
         def run(_prev: Optional[Future]) -> int:
             return self.shard.run_flush_task(task)
 
-        run_inline = False
         with self._lock:
-            if self._closed:
-                run_inline = True
-        if run_inline:
-            # closed between check and prepare: run inline (outside the
-            # lock) so the irreversible snapshot is never lost; the flush
-            # succeeded, so report it as such
-            fut: Future = Future()
-            fut.set_result(self.shard.run_flush_task(task))
-            return fut
+            if not self._closed:
+                try:
+                    prev = self._chains.get(group)
+                    if prev is None:
+                        fut = self._exec.submit(run, None)
+                    else:
+                        # chain: group tasks run in submission order even
+                        # when the pool has spare workers (checkpoint
+                        # monotonicity)
+                        fut: Future = Future()
+
+                        def after(p, _task=task, _fut=fut):
+                            try:
+                                _fut.set_result(
+                                    self.shard.run_flush_task(_task))
+                            except BaseException as e:  # via the future
+                                _fut.set_exception(e)
+
+                        prev.add_done_callback(
+                            lambda p: self._exec.submit(after, p))
+                    self._chains[group] = fut
+                    self.flushes_submitted += 1
+                    return fut
+                except RuntimeError:
+                    pass  # executor shut down between check and submit
+        # closed (or shut down) after prepare irreversibly detached the
+        # buffers: run inline, outside the lock, so the snapshot is never
+        # lost; the flush succeeded, so report it as such
+        fut = Future()
+        fut.set_result(self.shard.run_flush_task(task))
         with self._lock:
-            prev = self._chains.get(group)
-            if prev is None:
-                fut = self._exec.submit(run, None)
-            else:
-                # chain: group tasks run in submission order even when the
-                # pool has spare workers (checkpoint monotonicity)
-                fut: Future = Future()
-
-                def after(p, _task=task, _fut=fut):
-                    try:
-                        _fut.set_result(self.shard.run_flush_task(_task))
-                    except BaseException as e:  # surface via the future
-                        _fut.set_exception(e)
-
-                prev.add_done_callback(
-                    lambda p: self._exec.submit(after, p))
-            self._chains[group] = fut
             self.flushes_submitted += 1
         return fut
 

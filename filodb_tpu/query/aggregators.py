@@ -278,37 +278,101 @@ class TopBottomKAggregator(Aggregator):
         return PeriodicBatch(out_keys, p.steps, vals)
 
 
+def _dense_members_map(op, batch, by, without, params, limit,
+                       grouped=None):
+    """Per-group dense member matrix [G, M, T] (exact-path partial).
+    ``grouped`` lets callers pass precomputed (ids, keys, vals, M)."""
+    if grouped is None:
+        ids, keys = _group(batch.keys, by, without, limit)
+        vals = np.asarray(batch.values)[:len(batch.keys)]
+        counts = np.bincount(ids, minlength=len(keys)) if len(ids) \
+            else np.zeros(len(keys), int)
+        M = int(counts.max()) if len(keys) else 0
+    else:
+        ids, keys, vals, M = grouped
+    G = len(keys)
+    T = vals.shape[1]
+    dense = np.full((G, max(M, 1), T), np.nan)
+    pos = np.zeros(G, dtype=np.int64)
+    for s, g in enumerate(ids):
+        dense[g, pos[g]] = vals[s]
+        pos[g] += 1
+    return AggPartialBatch(op, params, keys, batch.steps, {"members": dense})
+
+
 class QuantileAggregator(Aggregator):
-    """Exact quantile: map carries per-group member values (padded member
-    axis); reduce concatenates members; present takes nanquantile.  The
-    reference approximates with t-digest (QuantileRowAggregator) — we keep
-    exactness; cardinality limits bound the member axis."""
+    """Quantile with bounded memory: small groups stay exact (dense member
+    matrix + nanquantile); past ``exact_members`` members per group the
+    partial switches to a mergeable t-digest sketch, O(G*T*C) no matter
+    the cardinality (reference: QuantileRowAggregator's TDigest partials,
+    exec/aggregator/RowAggregator.scala).  Reduce handles mixed partials
+    by sketching the exact side."""
 
     op = Op.QUANTILE
+    exact_members = 128       # per-group member budget before sketching
+    compression = 128
 
     def map(self, batch, by, without, params, limit):
+        from filodb_tpu.query import tdigest
+
         ids, keys = _group(batch.keys, by, without, limit)
         G = len(keys)
         vals = np.asarray(batch.values)[:len(batch.keys)]
-        T = vals.shape[1]
-        counts = np.bincount(ids, minlength=G) if len(ids) else np.zeros(G, int)
+        counts = np.bincount(ids, minlength=G) if len(ids) \
+            else np.zeros(G, int)
         M = int(counts.max()) if G else 0
-        dense = np.full((G, max(M, 1), T), np.nan)
-        pos = np.zeros(G, dtype=np.int64)
-        for s, g in enumerate(ids):
-            dense[g, pos[g]] = vals[s]
-            pos[g] += 1
+        if M <= self.exact_members:
+            return _dense_members_map(self.op, batch, by, without, params,
+                                      limit, grouped=(ids, keys, vals, M))
+        d = tdigest.from_values(vals, np.asarray(ids), G, self.compression)
         return AggPartialBatch(self.op, params, keys, batch.steps,
-                               {"members": dense})
+                               {"td_means": d.means, "td_weights": d.weights})
+
+    @staticmethod
+    def _is_digest(p) -> bool:
+        return "td_means" in p.state
+
+    def _to_digest_state(self, p) -> dict:
+        from filodb_tpu.query import tdigest
+
+        if self._is_digest(p):
+            return p.state
+        d = tdigest.from_members(p.state["members"], self.compression)
+        return {"td_means": d.means, "td_weights": d.weights}
 
     def reduce(self, partials):
-        keys, aligned = _align(partials, np.nan)
-        members = np.concatenate(aligned["members"], axis=1)
+        from filodb_tpu.query import tdigest
+
+        if not any(self._is_digest(p) for p in partials):
+            total = sum(p.state["members"].shape[1] for p in partials)
+            if total <= self.exact_members:
+                keys, aligned = _align(partials, np.nan)
+                members = np.concatenate(aligned["members"], axis=1)
+                return AggPartialBatch(self.op, partials[0].params, keys,
+                                       partials[0].steps,
+                                       {"members": members})
+        # sketch path: convert any exact partials, then cell-wise merge
+        norm = [AggPartialBatch(p.op, p.params, p.group_keys, p.steps,
+                                self._to_digest_state(p))
+                for p in partials]
+        keys, aligned = _align(norm, np.nan)
+        acc = tdigest.TDigest(aligned["td_means"][0],
+                              np.nan_to_num(aligned["td_weights"][0]))
+        for m, w in zip(aligned["td_means"][1:], aligned["td_weights"][1:]):
+            acc = tdigest.merge(acc, tdigest.TDigest(m, np.nan_to_num(w)))
         return AggPartialBatch(self.op, partials[0].params, keys,
-                               partials[0].steps, {"members": members})
+                               partials[0].steps,
+                               {"td_means": acc.means,
+                                "td_weights": acc.weights})
 
     def present(self, p):
         q = float(p.params[0])
+        if self._is_digest(p):
+            from filodb_tpu.query import tdigest
+            vals = tdigest.quantile(
+                tdigest.TDigest(p.state["td_means"], p.state["td_weights"]),
+                q)
+            return PeriodicBatch(p.group_keys, p.steps, vals)
         import warnings
         with warnings.catch_warnings():
             warnings.simplefilter("ignore", RuntimeWarning)
@@ -324,13 +388,15 @@ class CountValuesAggregator(Aggregator):
     op = Op.COUNT_VALUES
 
     def map(self, batch, by, without, params, limit):
-        # pass-through of member values, same layout as quantile
-        return QuantileAggregator().map(batch, by, without, params, limit)
+        # pass-through of member values: count_values needs exact values,
+        # so it keeps the dense layout regardless of cardinality
+        return _dense_members_map(self.op, batch, by, without, params, limit)
 
     def reduce(self, partials):
-        p = QuantileAggregator().reduce(partials)
-        p.op = self.op
-        return p
+        keys, aligned = _align(partials, np.nan)
+        members = np.concatenate(aligned["members"], axis=1)
+        return AggPartialBatch(self.op, partials[0].params, keys,
+                               partials[0].steps, {"members": members})
 
     def present(self, p):
         label = str(p.params[0])

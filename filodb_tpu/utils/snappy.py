@@ -26,10 +26,12 @@ from filodb_tpu.utils.leb128 import decode as _uvarint_decode
 from filodb_tpu.utils.leb128 import encode as _uvarint_encode
 
 
-def decompress(buf: bytes) -> bytes:
-    """Decompress one snappy block."""
+def decompress(buf: bytes, max_len: int = 1 << 32) -> bytes:
+    """Decompress one snappy block.  ``max_len`` bounds the declared
+    uncompressed size (copy elements amplify ~21x, so callers handling
+    untrusted input must cap this)."""
     want, pos = _uvarint_decode(buf, 0)
-    if want > 1 << 32:
+    if want > max_len:
         raise ValueError("declared length too large")
     out = bytearray()
     n = len(buf)

@@ -1,0 +1,142 @@
+"""t-digest sketch quantiles: accuracy, mergeability, bounded memory,
+and the QuantileAggregator exact/sketch switchover.
+
+Reference: exec/aggregator/RowAggregator.scala QuantileRowAggregator
+(TDigest partials bounding memory at high cardinality).
+"""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.query import tdigest
+from filodb_tpu.query.aggregators import QuantileAggregator, aggregator_for
+from filodb_tpu.query.model import PeriodicBatch, StepRange
+
+BASE = 1_700_000_000_000
+
+
+def _batch(vals, keys=None):
+    S, T = vals.shape
+    keys = keys or [{"inst": f"i{s}", "g": f"g{s % 2}"} for s in range(S)]
+    return PeriodicBatch(keys, StepRange(BASE, 60_000, T), vals)
+
+
+class TestTDigestCore:
+    @pytest.mark.parametrize("q", [0.01, 0.25, 0.5, 0.9, 0.99])
+    @pytest.mark.parametrize("dist", ["uniform", "normal", "lognormal"])
+    def test_accuracy_vs_exact(self, q, dist):
+        rng = np.random.default_rng(42)
+        n = 20_000
+        if dist == "uniform":
+            data = rng.uniform(0, 100, n)
+        elif dist == "normal":
+            data = rng.normal(50, 10, n)
+        else:
+            data = rng.lognormal(1.0, 1.0, n)
+        vals = data.reshape(n, 1)                  # n series, 1 step
+        d = tdigest.from_values(vals, np.zeros(n, dtype=np.int64), 1,
+                                compression=128)
+        got = float(tdigest.quantile(d, q)[0, 0])
+        want = float(np.quantile(data, q))
+        spread = np.quantile(data, 0.95) - np.quantile(data, 0.05)
+        assert abs(got - want) <= 0.05 * spread + 1e-9, \
+            f"{dist} q={q}: got {got}, want {want}"
+
+    def test_merge_matches_single_build(self):
+        rng = np.random.default_rng(0)
+        a = rng.normal(0, 1, (500, 3))
+        b = rng.normal(0, 1, (500, 3))
+        ids_a = np.zeros(500, dtype=np.int64)
+        d_all = tdigest.from_values(np.concatenate([a, b]),
+                                    np.zeros(1000, dtype=np.int64), 1)
+        d_m = tdigest.merge(tdigest.from_values(a, ids_a, 1),
+                            tdigest.from_values(b, ids_a, 1))
+        for q in (0.1, 0.5, 0.9):
+            np.testing.assert_allclose(tdigest.quantile(d_m, q),
+                                       tdigest.quantile(d_all, q),
+                                       atol=0.15)
+
+    def test_memory_bounded(self):
+        S = 50_000
+        rng = np.random.default_rng(1)
+        vals = rng.random((S, 4))
+        ids = rng.integers(0, 10, S)
+        d = tdigest.from_values(vals, ids, 10, compression=128)
+        # O(G*T*C): 10*4*64 floats *2 arrays = 40KB, NOT O(S*T)
+        assert d.nbytes < 100_000
+        q = tdigest.quantile(d, 0.5)
+        assert q.shape == (10, 4)
+        assert np.isfinite(q).all()
+        assert np.all((q > 0.4) & (q < 0.6))       # median of U(0,1)
+
+    def test_nan_and_empty_cells(self):
+        vals = np.array([[1.0, np.nan], [3.0, np.nan]])
+        d = tdigest.from_values(vals, np.zeros(2, dtype=np.int64), 2)
+        q = tdigest.quantile(d, 0.5)
+        assert np.isfinite(q[0, 0])
+        assert np.isnan(q[0, 1])                   # no samples at step 1
+        assert np.isnan(q[1]).all()                # group 1 empty
+
+    def test_exact_small_inputs(self):
+        """With few values, digest quantiles hit exact order statistics."""
+        vals = np.array([[10.0], [20.0], [30.0]])
+        d = tdigest.from_values(vals, np.zeros(3, dtype=np.int64), 1)
+        assert float(tdigest.quantile(d, 0.0)[0, 0]) == 10.0
+        assert float(tdigest.quantile(d, 1.0)[0, 0]) == 30.0
+        assert abs(float(tdigest.quantile(d, 0.5)[0, 0]) - 20.0) < 1e-9
+
+    def test_from_members_roundtrip(self):
+        members = np.array([[[1.0, 2.0], [3.0, np.nan]]])  # [1, 2, 2]
+        d = tdigest.from_members(members)
+        q = tdigest.quantile(d, 0.5)
+        assert abs(q[0, 0] - 2.0) < 1.1             # median of {1,3}
+        assert abs(q[0, 1] - 2.0) < 1e-9            # single value 2.0
+
+
+class TestQuantileAggregatorSwitch:
+    def test_small_stays_exact(self):
+        agg = aggregator_for(QuantileAggregator.op)
+        vals = np.arange(12.0).reshape(4, 3)
+        p = agg.map(_batch(vals), ("g",), (), (0.5,), 1000)
+        assert "members" in p.state
+        out = agg.present(agg.reduce([p]))
+        assert out.values.shape == (2, 3)
+        # exact median of {0,6} rows etc.
+        np.testing.assert_allclose(out.values[0], [3.0, 4.0, 5.0])
+
+    def test_large_switches_to_sketch(self):
+        agg = QuantileAggregator()
+        rng = np.random.default_rng(2)
+        S = 2_000
+        vals = rng.random((S, 2))
+        keys = [{"inst": f"i{s}"} for s in range(S)]
+        p = agg.map(_batch(vals, keys), (), (), (0.9,), 10_000)
+        assert "td_means" in p.state
+        assert p.state["td_means"].nbytes < 10_000  # 1 group * 2 steps * 64
+        out = agg.present(agg.reduce([p]))
+        np.testing.assert_allclose(out.values, 0.9, atol=0.03)
+
+    def test_mixed_exact_and_sketch_reduce(self):
+        agg = QuantileAggregator()
+        rng = np.random.default_rng(3)
+        small = rng.random((10, 2))
+        big = rng.random((2_000, 2))
+        p1 = agg.map(_batch(small, [{"inst": f"a{s}"} for s in range(10)]),
+                     (), (), (0.5,), 10_000)
+        p2 = agg.map(_batch(big, [{"inst": f"b{s}"} for s in range(2_000)]),
+                     (), (), (0.5,), 10_000)
+        assert "members" in p1.state and "td_means" in p2.state
+        out = agg.present(agg.reduce([p1, p2]))
+        np.testing.assert_allclose(out.values, 0.5, atol=0.03)
+
+    def test_sketch_accuracy_through_full_pipeline(self):
+        """Exact vs sketch on the same data: within t-digest tolerance."""
+        agg = QuantileAggregator()
+        rng = np.random.default_rng(4)
+        S = 1_000
+        vals = rng.normal(100, 15, (S, 3))
+        keys = [{"inst": f"i{s}"} for s in range(S)]
+        p = agg.map(_batch(vals, keys), (), (), (0.95,), 10_000)
+        out = agg.present(agg.reduce([p]))
+        want = np.quantile(vals, 0.95, axis=0)
+        np.testing.assert_allclose(out.values[0], want, rtol=0.02)
