@@ -48,6 +48,15 @@ class DatasetBinding:
     # remote-write ingest hook: (labels, ts_list, val_list) -> None; when
     # None the /api/v1/write endpoint 400s for this dataset
     write_router: Optional[object] = None
+    # query admission/scheduling (query/scheduler.py): when set, queries
+    # run on its bounded worker pool instead of the HTTP handler thread
+    # (reference: QueryActor's priority mailbox + query scheduler)
+    scheduler: Optional[object] = None
+    # SEPARATE pool for dispatched leaf ExecPlans: coordinator queries
+    # block on remote leaves, so sharing one pool across nodes would
+    # deadlock under load (all workers waiting on leaves queued behind
+    # them).  Leaf plans never re-dispatch, so this pool cannot cycle.
+    leaf_scheduler: Optional[object] = None
 
 
 @dataclass
@@ -151,7 +160,12 @@ class FiloHttpServer:
             params = {k: v[0] for k, v in multi.items()}
             code, payload = self._route(parsed.path, params, multi)
         except QueryError as e:
-            code, payload = 400, error_response("bad_data", str(e))
+            from filodb_tpu.query.scheduler import QueryRejected
+            if isinstance(e, QueryRejected):
+                # admission control: overloaded, not a bad request
+                code, payload = 503, error_response("unavailable", str(e))
+            else:
+                code, payload = 400, error_response("bad_data", str(e))
         except (ParseError, ValueError, KeyError) as e:
             code, payload = 400, error_response("bad_data", str(e))
         except Exception as e:  # noqa: BLE001
@@ -178,10 +192,27 @@ class FiloHttpServer:
                     "bad_data", f"unknown dataset {payload.get('dataset')}")
             else:
                 from filodb_tpu.coordinator.dispatch import execplan_handler
-                out = execplan_handler(binding.memstore)(payload)
+                handler = execplan_handler(binding.memstore)
+                if binding.leaf_scheduler is not None:
+                    # leaf execution queues with the ORIGINAL query's
+                    # submit time and deadline (carried in the plan's
+                    # query context) so cross-node priority and
+                    # overdue-drop hold (reference: the remote
+                    # QueryActor's mailbox orders by submitTime)
+                    qctx = payload.get("qctx", {})
+                    out = binding.leaf_scheduler.execute(
+                        lambda: handler(payload),
+                        submit_time_ms=qctx.get("submit_time_ms") or None,
+                        timeout_ms=qctx.get("timeout_ms") or 30_000)
+                else:
+                    out = handler(payload)
                 code = 200
         except QueryError as e:
-            code, out = 400, error_response("bad_data", str(e))
+            from filodb_tpu.query.scheduler import QueryRejected
+            if isinstance(e, QueryRejected):
+                code, out = 503, error_response("unavailable", str(e))
+            else:
+                code, out = 400, error_response("bad_data", str(e))
         except Exception as e:  # noqa: BLE001
             code, out = 500, error_response("internal", str(e))
         data = json.dumps(out).encode()
@@ -213,8 +244,8 @@ class FiloHttpServer:
                 ln = int(req.headers.get("Content-Length") or 0)
                 if ln > _MAX_REMOTE_COMPRESSED:
                     raise QueryError(
-                        f"request body {ln} bytes exceeds limit "
-                        f"{_MAX_REMOTE_COMPRESSED}")
+                        "", f"request body {ln} bytes exceeds limit "
+                            f"{_MAX_REMOTE_COMPRESSED}")
                 raw = snappy.decompress(req.rfile.read(ln),
                                         max_len=_MAX_REMOTE_UNCOMPRESSED)
                 if path.endswith("/read"):
@@ -338,9 +369,17 @@ class FiloHttpServer:
         return 200, to_prom_vector(result, time_ms, b.metric_column)
 
     def _exec(self, b: DatasetBinding, plan):
-        qctx = QueryContext()
-        ep = b.planner.materialize(plan, qctx)
-        return ep.execute(ExecContext(b.memstore, qctx))
+        import time as _time
+        qctx = QueryContext(submit_time_ms=int(_time.time() * 1000))
+
+        def run():
+            ep = b.planner.materialize(plan, qctx)
+            return ep.execute(ExecContext(b.memstore, qctx))
+
+        if b.scheduler is not None:
+            return b.scheduler.execute(run, qctx.submit_time_ms,
+                                       qctx.timeout_ms)
+        return run()
 
     # ------------------------------------------------------- metadata routes
 

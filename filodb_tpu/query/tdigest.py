@@ -44,9 +44,10 @@ class TDigest:
 
 
 def _k_scale(q: np.ndarray, compression: int) -> np.ndarray:
+    """k1 scale spanning the FULL [0, compression] range: asin(2q-1)
+    covers [-pi/2, pi/2], i.e. a span of pi, so the factor is C/pi."""
     q = np.clip(q, 0.0, 1.0)
-    return compression / (2.0 * np.pi) * (np.arcsin(2.0 * q - 1.0)
-                                          + np.pi / 2.0)
+    return compression / np.pi * (np.arcsin(2.0 * q - 1.0) + np.pi / 2.0)
 
 
 def _compress(means: np.ndarray, weights: np.ndarray,

@@ -73,6 +73,7 @@ class FiloServer:
                                    shard_manager=self.manager)
         self.gateways: list[GatewayServer] = []
         self.broker = None  # embedded BrokerServer when configured
+        self.query_schedulers: dict[str, object] = {}
         self.profiler: Optional[SimpleProfiler] = None
         self._global_gateway_claimed = False
         self._started = threading.Event()
@@ -167,8 +168,27 @@ class FiloServer:
                 _pub.add_sample(metric, tags, int(t), float(v))
             _pub.flush()
 
+        # bounded query scheduler per dataset (reference: QueryActor's
+        # priority mailbox + dedicated query pool)
+        from filodb_tpu.query.scheduler import QueryScheduler
+        qconf = ds_conf.get("query", {})
+        qsched = QueryScheduler(
+            num_workers=int(qconf.get("workers", 4)),
+            max_queued=int(qconf.get("max-queued", 256)),
+            name=f"query-{name}")
+        # dispatched leaf plans get their own pool: coordinator queries
+        # block on remote leaves, so a shared pool would deadlock
+        leaf_sched = QueryScheduler(
+            num_workers=int(qconf.get("leaf-workers",
+                                      qconf.get("workers", 4))),
+            max_queued=int(qconf.get("max-queued", 256)),
+            name=f"leaf-{name}")
+        self.query_schedulers[name] = qsched
+        self.query_schedulers[f"{name}/leaf"] = leaf_sched
         self.http.bind_dataset(DatasetBinding(name, self.memstore, planner,
-                                              write_router=write_router))
+                                              write_router=write_router,
+                                              scheduler=qsched,
+                                              leaf_scheduler=leaf_sched))
 
         gw_port = ds_conf.get("gateway-port")
         if gw_port is None and not self._global_gateway_claimed:
@@ -195,6 +215,8 @@ class FiloServer:
             gw.shutdown()
         self.coordinator.shutdown()
         self.http.shutdown()
+        for qs in self.query_schedulers.values():
+            qs.shutdown()
         if self.broker is not None:
             self.broker.shutdown()
         if self.profiler is not None:

@@ -1,0 +1,199 @@
+"""Query admission/scheduling (reference: QueryActor.scala:28-40
+priority mailbox by submitTime, :112-131 dedicated query scheduler)."""
+
+import threading
+import time
+
+import pytest
+
+from filodb_tpu.query.model import QueryError
+from filodb_tpu.query.scheduler import QueryRejected, QueryScheduler
+
+
+def _mk(**kw):
+    kw.setdefault("num_workers", 1)
+    kw.setdefault("max_queued", 8)
+    return QueryScheduler(**kw)
+
+
+class TestScheduling:
+    def test_executes_and_returns(self):
+        s = _mk()
+        try:
+            assert s.execute(lambda: 41 + 1) == 42
+        finally:
+            s.shutdown()
+
+    def test_oldest_submit_time_runs_first(self):
+        s = _mk()
+        try:
+            gate = threading.Event()
+            started = threading.Event()
+            order = []
+            # occupy the single worker so submissions queue up
+            blocker = s.submit(lambda: started.set() or gate.wait(5))
+            started.wait(5)
+            futs = []
+            for st, tag in ((3000, "newest"), (1000, "oldest"),
+                            (2000, "middle")):
+                futs.append(s.submit(
+                    lambda t=tag: order.append(t) or t, submit_time_ms=st))
+            gate.set()
+            for f in futs:
+                f.result(timeout=5)
+            blocker.result(timeout=5)
+            assert order == ["oldest", "middle", "newest"]
+        finally:
+            s.shutdown()
+
+    def test_equal_submit_time_is_fifo(self):
+        s = _mk()
+        try:
+            gate = threading.Event()
+            started = threading.Event()
+            order = []
+            s.submit(lambda: started.set() or gate.wait(5))
+            started.wait(5)
+            futs = [s.submit(lambda i=i: order.append(i), submit_time_ms=7)
+                    for i in range(5)]
+            gate.set()
+            for f in futs:
+                f.result(timeout=5)
+            assert order == [0, 1, 2, 3, 4]
+        finally:
+            s.shutdown()
+
+
+class TestAdmission:
+    def test_full_queue_rejects(self):
+        s = _mk(max_queued=2)
+        try:
+            gate = threading.Event()
+            started = threading.Event()
+
+            def blocker():
+                started.set()
+                gate.wait(5)
+
+            s.submit(blocker)
+            started.wait(5)                    # worker busy for sure
+            s.submit(lambda: 1)                # queued
+            s.submit(lambda: 2)                # queued (full now)
+            with pytest.raises(QueryRejected):
+                s.submit(lambda: 3)
+            gate.set()
+        finally:
+            s.shutdown()
+
+    def test_overdue_queued_query_fails_without_running(self):
+        s = _mk()
+        try:
+            gate = threading.Event()
+            started = threading.Event()
+            ran = []
+            s.submit(lambda: started.set() or gate.wait(5))
+            started.wait(5)
+            fut = s.submit(lambda: ran.append(1), timeout_ms=30)
+            time.sleep(0.1)                    # let it go overdue in queue
+            gate.set()
+            with pytest.raises(QueryError, match="in queue"):
+                fut.result(timeout=5)
+            assert not ran
+        finally:
+            s.shutdown()
+
+    def test_execute_timeout(self):
+        s = _mk()
+        try:
+            with pytest.raises(QueryError, match="timed out"):
+                s.execute(lambda: time.sleep(2), timeout_ms=100)
+        finally:
+            s.shutdown()
+
+    def test_shutdown_fails_queued_and_rejects_new(self):
+        s = _mk()
+        gate = threading.Event()
+        s.submit(lambda: gate.wait(5))
+        queued = s.submit(lambda: 1)
+        gate.set()
+        s.shutdown(wait=False)
+        with pytest.raises(QueryRejected):
+            s.submit(lambda: 2)
+        with pytest.raises((QueryRejected, Exception)):
+            queued.result(timeout=5)
+
+    def test_worker_exception_propagates(self):
+        s = _mk()
+        try:
+            def boom():
+                raise RuntimeError("kernel error")
+            with pytest.raises(RuntimeError, match="kernel error"):
+                s.execute(boom)
+            # scheduler still healthy afterwards
+            assert s.execute(lambda: 7) == 7
+        finally:
+            s.shutdown()
+
+
+class TestHttpIntegration:
+    def test_server_routes_queries_through_scheduler(self):
+        import json
+        import urllib.request
+
+        from filodb_tpu.standalone import FiloServer
+
+        srv = FiloServer({"node": "qs", "datasets": [
+            {"name": "prom", "num-shards": 1, "schema": "gauge",
+             "query": {"workers": 2, "max-queued": 4}}]})
+        port = srv.start()
+        try:
+            sched = srv.query_schedulers["prom"]
+            before = None
+            import urllib.parse
+            qs = urllib.parse.urlencode({
+                "query": "up", "start": 1_700_000_000,
+                "end": 1_700_000_060, "step": "15s"})
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/promql/prom/api/v1/"
+                f"query_range?{qs}", timeout=30).read())
+            assert body["status"] == "success"
+            from filodb_tpu.utils.observability import REGISTRY
+            done = REGISTRY.counter("filodb_queries_executed_total")
+            assert done.value(scheduler="query-prom") >= 1
+        finally:
+            srv.shutdown()
+
+    def test_overload_returns_503(self):
+        import urllib.error
+        import urllib.parse
+        import urllib.request
+
+        from filodb_tpu.standalone import FiloServer
+
+        srv = FiloServer({"node": "qs2", "datasets": [
+            {"name": "prom", "num-shards": 1, "schema": "gauge",
+             "query": {"workers": 1, "max-queued": 1}}]})
+        port = srv.start()
+        try:
+            sched = srv.query_schedulers["prom"]
+            gate = threading.Event()
+            started = threading.Event()
+
+            def blocker():
+                started.set()
+                gate.wait(10)
+
+            sched.submit(blocker)                 # occupy the worker
+            started.wait(5)
+            sched.submit(lambda: 1)               # fill the queue
+            qs = urllib.parse.urlencode({
+                "query": "up", "start": 1_700_000_000,
+                "end": 1_700_000_060, "step": "15s"})
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/promql/prom/api/v1/"
+                    f"query_range?{qs}", timeout=30)
+            assert exc.value.code == 503
+            gate.set()
+        finally:
+            srv.shutdown()
