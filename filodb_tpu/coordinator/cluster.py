@@ -323,3 +323,240 @@ class FailureDetector:
     def alive(self) -> list[str]:
         with self._lock:
             return sorted(self._last_seen)
+
+    def fresh_nodes(self) -> list[str]:
+        """Nodes whose heartbeat is within the timeout — a READ-ONLY
+        liveness view (``check`` both reads and acts); used for leader
+        computation so non-leaders never mutate membership."""
+        now = self._clock()
+        with self._lock:
+            return sorted(n for n, t in self._last_seen.items()
+                          if (now - t) * 1000.0 <= self.timeout_ms)
+
+
+# ---------------------------------------------------------------------------
+# Cross-node status propagation
+# ---------------------------------------------------------------------------
+
+
+class StatusPoller:
+    """Propagates cluster state between nodes by polling peer
+    ``/__health`` endpoints — the stand-in for the reference's cluster
+    singleton + gossip (NodeClusterActor is a cluster singleton whose
+    ShardMapper snapshots are pushed to every node; StatusActor relays
+    shard events to it).
+
+    Leadership is DYNAMIC: the lowest node name among the local node and
+    the peers with fresh heartbeats acts as the singleton.  Only the
+    acting leader runs failure detection and reassignment (one decider —
+    no split-brain reassignment races); non-leaders adopt the leader's
+    assignment view wholesale from its health payload.  If the leader
+    dies, its heartbeat goes stale everywhere, the next-lowest live node
+    becomes the acting leader, declares it down, and reassigns.
+
+    Per-shard LIVENESS is per-node ground truth regardless of role: each
+    node reports the shards its ingestion coordinator actually runs, and
+    owners not running an assigned shard show as ASSIGNED (not ACTIVE),
+    keeping queries off dead shards.  Operator STOPPED/DOWN statuses are
+    sticky — gossip never resurrects them (stop-command propagation to
+    the owning node's coordinator goes through the admin HTTP surface).
+
+    A successful poll (even a 503 "unhealthy" one) heartbeats the peer
+    into the FailureDetector.  The ``on_assignment_change`` hook
+    (typically IngestionCoordinator.resync) runs on a dedicated thread —
+    a slow resync (stop_ingestion joins) must never stall polling past
+    the failure-detector timeout.
+
+    Note: ClusterBootstrap (coordinator/bootstrap.py) also probes
+    ``/__health``, but only for seed discovery at join time; this poller
+    owns the steady-state gossip.  Run one or the other's background
+    loop, not both.
+    """
+
+    def __init__(self, manager: ShardManager, failure_detector: FailureDetector,
+                 peers: dict[str, str], local_node: str,
+                 interval_s: float = 2.0, timeout_s: float = 2.0,
+                 on_assignment_change: Optional[Callable[[], None]] = None):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.manager = manager
+        self.detector = failure_detector
+        self.peers = dict(peers)
+        self.local_node = local_node
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self.on_assignment_change = on_assignment_change
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, min(len(self.peers), 8)),
+            thread_name_prefix="status-poll")
+        # async hook runner: coalesces bursts into one pending resync
+        self._change_pending = threading.Event()
+        self._hook_thread: Optional[threading.Thread] = None
+
+    @property
+    def leader(self) -> str:
+        """The acting singleton: lowest name among self + fresh peers."""
+        fresh = set(self.detector.fresh_nodes())
+        candidates = [self.local_node] + [p for p in self.peers
+                                          if p in fresh]
+        return min(candidates)
+
+    def _fetch_health(self, endpoint: str):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(f"{endpoint}/__health",
+                                        timeout=self.timeout_s) as r:
+                return _json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            # a 503 "unhealthy" answer is still a live peer — its own
+            # view may lag the leader's; the body still carries the
+            # running-shards ground truth
+            try:
+                return _json.loads(e.read())
+            except Exception:  # noqa: BLE001
+                return None
+        except Exception:  # noqa: BLE001 — unreachable peer: no beat
+            return None
+
+    def poll_once(self) -> list[str]:
+        """One sweep: poll peers concurrently, adopt the acting leader's
+        assignment view, apply liveness; the acting leader additionally
+        runs failure detection + reassignment.  Returns nodes this sweep
+        declared down (always [] on non-leaders)."""
+        # the local node is trivially alive: never let its own heartbeat
+        # lapse into a self-down declaration
+        self.detector.heartbeat(self.local_node)
+        targets = [(p, ep) for p, ep in self.peers.items()
+                   if p != self.local_node]
+        bodies = list(self._pool.map(
+            lambda t: (t[0], self._fetch_health(t[1])), targets))             if targets else []
+        changed = False
+        for peer, body in bodies:
+            if body is None:
+                continue
+            self.detector.heartbeat(peer)
+            leader = self.leader
+            if peer == leader and leader != self.local_node:
+                changed |= self._adopt_leader_view(body)
+            self._apply_liveness(peer, body)
+        down: list[str] = []
+        if self.leader == self.local_node:
+            # one decider: only the acting leader mutates membership
+            down = self.detector.check()
+        if down or changed:
+            self._signal_change()
+        return down
+
+    def _signal_change(self) -> None:
+        if self.on_assignment_change is None:
+            return
+        self._change_pending.set()
+        if self._hook_thread is None or not self._hook_thread.is_alive():
+            self._run_hook_async()
+
+    def _run_hook_async(self) -> None:
+        def run():
+            import traceback as _tb
+            while self._change_pending.is_set() and not self._stop.is_set():
+                self._change_pending.clear()
+                try:
+                    self.on_assignment_change()
+                except Exception:  # noqa: BLE001 — report, keep gossiping
+                    _tb.print_exc()
+
+        self._hook_thread = threading.Thread(target=run,
+                                             name="assignment-change",
+                                             daemon=True)
+        self._hook_thread.start()
+
+    def _adopt_leader_view(self, body: dict) -> bool:
+        """Replace local shard OWNERSHIP with the leader's (reference:
+        every node caches the singleton's ShardMapper snapshots).
+        Returns True when any assignment changed."""
+        changed = False
+        with self.manager._lock:  # mapper mutation under the manager lock
+            for ds, shards in (body.get("shards") or {}).items():
+                if ds not in self.manager.datasets():
+                    continue
+                mapper = self.manager.mapper(ds)
+                for st in shards:
+                    shard = int(st.get("shard", -1))
+                    if not 0 <= shard < mapper.num_shards:
+                        continue
+                    node = st.get("node")
+                    if mapper.coord_for_shard(shard) == node:
+                        continue
+                    changed = True
+                    if node is None:
+                        mapper.unassign(shard)
+                    else:
+                        mapper.register_node([shard], node)
+                    try:
+                        mapper.update_status(shard,
+                                             ShardStatus(st.get("status")))
+                    except ValueError:
+                        pass
+        return changed
+
+    def _apply_liveness(self, peer: str, body: dict) -> None:
+        """Peer-reported running shards are ground truth for liveness of
+        the shards WE think the peer owns; assignment is not touched and
+        operator STOPPED/DOWN statuses are never overwritten."""
+        running = body.get("running") or {}
+        peer_status: dict[tuple[str, int], str] = {}
+        for ds, shards in (body.get("shards") or {}).items():
+            for st in shards:
+                peer_status[(ds, int(st.get("shard", -1)))] = st.get("status")
+        with self.manager._lock:
+            for ds in self.manager.datasets():
+                mapper = self.manager.mapper(ds)
+                live = {int(s) for s in running[ds]} if ds in running                     else None
+                for shard in range(mapper.num_shards):
+                    if mapper.coord_for_shard(shard) != peer:
+                        continue
+                    cur = mapper.status(shard)
+                    if cur in (ShardStatus.STOPPED, ShardStatus.DOWN):
+                        continue  # operator/leader intent is sticky
+                    if live is None:
+                        # no running info: fall back to the peer's own
+                        # reported status
+                        try:
+                            mapper.update_status(shard, ShardStatus(
+                                peer_status.get((ds, shard))))
+                        except ValueError:
+                            pass
+                        continue
+                    if shard in live:
+                        # peer runs it; honor its RECOVERY sub-state
+                        rep = peer_status.get((ds, shard))
+                        status = ShardStatus.RECOVERY                             if rep == ShardStatus.RECOVERY.value                             else ShardStatus.ACTIVE
+                        mapper.update_status(shard, status)
+                    else:
+                        mapper.update_status(shard, ShardStatus.ASSIGNED)
+
+    def start(self) -> None:
+        def loop():
+            import traceback as _tb
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.poll_once()
+                except Exception:  # noqa: BLE001 — keep polling, loudly
+                    _tb.print_exc()
+
+        self._thread = threading.Thread(target=loop, name="status-poller",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._change_pending.clear()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self._hook_thread is not None:
+            self._hook_thread.join(timeout=5)
+        self._pool.shutdown(wait=False)

@@ -139,6 +139,7 @@ class IngestionCoordinator:
     # ------------------------------------------------------------- internals
 
     def _run_shard(self, shard: int, stop: threading.Event) -> None:
+        flush_sched = None
         try:
             try:
                 self.memstore.setup(self.dataset, self.schemas, shard,
@@ -177,6 +178,15 @@ class IngestionCoordinator:
             else:
                 self.event_sink(IngestionStarted(self.dataset, shard,
                                                  self.node))
+            # pipelined time-boundary flushes ride the ingest loop
+            # (reference: ingestStream interleaves createFlushTasks,
+            # TimeSeriesMemStore.scala:106-129); encode+IO run on the
+            # flush executor, never this thread
+            from filodb_tpu.memstore.flush import FlushScheduler
+            if sh.config.flush_interval_ms > 0:
+                flush_sched = FlushScheduler(
+                    sh, sh.config.flush_interval_ms,
+                    parallelism=sh.config.flush_task_parallelism)
             n_since_report = 0
             # the loop runs until the stream ends: a finite source drains,
             # a live queue delivers the teardown sentinel.  No early exit —
@@ -185,6 +195,8 @@ class IngestionCoordinator:
             # consumer of a shared stream).
             for offset, container in stream.get():
                 sh.ingest_container(container, offset)
+                if flush_sched is not None:
+                    flush_sched.note_ingested()
                 if recovering:
                     n_since_report += 1
                     if offset >= highest:
@@ -211,6 +223,15 @@ class IngestionCoordinator:
             traceback.print_exc()
             self.event_sink(IngestionError(self.dataset, shard, str(e)))
         finally:
+            if flush_sched is not None:
+                try:
+                    # drain in-flight flush tasks only; buffered rows stay
+                    # queryable and flush on the next boundary or via the
+                    # explicit flush surface (matches the reference: stop
+                    # does not force a flush)
+                    flush_sched.close(flush_remaining=False)
+                except Exception:  # noqa: BLE001 — never mask the cause
+                    traceback.print_exc()
             self._cleanup(shard)
 
     def flush_loop(self, shard: int, stop: threading.Event,

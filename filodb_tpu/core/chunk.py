@@ -153,7 +153,14 @@ def build_batch(series_ts: Sequence[np.ndarray], series_vals: Sequence,
     counts = np.array([len(t) for t in series_ts], dtype=np.int32)
     R = int(counts.max()) if S else 0
     if pad_to:
-        R = ((R + pad_to - 1) // pad_to) * pad_to if R else pad_to
+        if R <= pad_to:
+            R = pad_to
+        else:
+            # geometric buckets above the base pad: row counts that grow
+            # with live ingest would otherwise mint a fresh XLA compile
+            # every pad_to rows; powers of two keep the shape set
+            # logarithmic (SURVEY.md §7 ragged-data strategy)
+            R = pad_to * (1 << int(np.ceil(np.log2(R / pad_to))))
     R = max(R, 1)
     if pad_series_to:
         S_pad = max(S, pad_series_to)

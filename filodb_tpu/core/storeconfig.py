@@ -14,6 +14,7 @@ from typing import Mapping, Optional
 @dataclasses.dataclass(frozen=True)
 class StoreConfig:
     flush_interval_ms: int = 3_600_000        # flush-interval = 1h
+    flush_task_parallelism: int = 2           # flush executor workers
     max_chunks_size: int = 400                # max rows per chunk
     groups_per_shard: int = 60
     shard_mem_size: int = 512 * 1024 * 1024   # shard-mem-size budget (bytes)
@@ -40,6 +41,8 @@ class StoreConfig:
         d = StoreConfig()
         return StoreConfig(
             flush_interval_ms=ms("flush-interval", d.flush_interval_ms),
+            flush_task_parallelism=int(conf.get("flush-task-parallelism",
+                                                d.flush_task_parallelism)),
             max_chunks_size=int(conf.get("max-chunks-size", d.max_chunks_size)),
             groups_per_shard=int(conf.get("groups-per-shard", d.groups_per_shard)),
             shard_mem_size=parse_size(conf.get("shard-mem-size", d.shard_mem_size)),

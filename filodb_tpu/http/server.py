@@ -67,6 +67,9 @@ class FiloHttpServer:
     host: str = "127.0.0.1"
     node_name: Optional[str] = None  # reported in /__health for bootstrap
     shard_manager: Optional[object] = None  # coordinator.cluster.ShardManager
+    # dataset -> list of shards this node is actively ingesting; reported
+    # in /__health as ground truth for peer status gossip (StatusPoller)
+    running_shards: Optional[object] = None
     datasets: dict = field(default_factory=dict)
     _httpd: Optional[ThreadingHTTPServer] = None
     _thread: Optional[threading.Thread] = None
@@ -454,6 +457,10 @@ class FiloHttpServer:
         healthy = all(st["status"] in ("Active", "Recovery", "Assigned")
                       for sts in out.values() for st in sts) if out else True
         body = {"healthy": healthy, "shards": out}
+        if self.running_shards is not None:
+            body["running"] = {ds: self.running_shards(ds) for ds in out} \
+                if out else {ds: self.running_shards(ds)
+                             for ds in self.datasets}
         if self.node_name:
             body["node"] = self.node_name
         return (200 if healthy else 503), body

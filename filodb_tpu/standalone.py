@@ -34,7 +34,8 @@ import sys
 import threading
 from typing import Optional
 
-from filodb_tpu.coordinator.cluster import FailureDetector, ShardManager
+from filodb_tpu.coordinator.cluster import (FailureDetector, ShardManager,
+                                            StatusPoller)
 from filodb_tpu.coordinator.node import NodeCoordinator
 from filodb_tpu.coordinator.planner import SingleClusterPlanner
 from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
@@ -70,13 +71,19 @@ class FiloServer:
         self.stream_factory = QueueStreamFactory()
         self.http = FiloHttpServer(port=config.get("http-port", 0),
                                    node_name=self.node,
-                                   shard_manager=self.manager)
+                                   shard_manager=self.manager,
+                                   running_shards=self._running_shards)
         self.gateways: list[GatewayServer] = []
         self.broker = None  # embedded BrokerServer when configured
         self.query_schedulers: dict[str, object] = {}
+        self.status_poller: Optional[StatusPoller] = None
         self.profiler: Optional[SimpleProfiler] = None
         self._global_gateway_claimed = False
         self._started = threading.Event()
+
+    def _running_shards(self, dataset: str) -> list[int]:
+        ic = self.coordinator.ingestion.get(dataset)
+        return ic.running_shards() if ic is not None else []
 
     def start(self) -> int:
         """Bring the node up; returns the HTTP port."""
@@ -96,6 +103,22 @@ class FiloServer:
             self._setup_dataset(ds_conf)
 
         port = self.http.start()
+        peers = self.config.get("peers", {})
+        if peers:
+            # cross-node status gossip + automatic failover (reference:
+            # StatusActor/ShardMapper snapshots + Akka failure detector)
+            def resync_all():
+                for ds in self.manager.datasets():
+                    shards = self.manager.mapper(ds).shards_for_node(
+                        self.node)
+                    self.coordinator.resync(ds, shards)
+
+            self.status_poller = StatusPoller(
+                self.manager, self.failure_detector, peers, self.node,
+                interval_s=float(self.config.get(
+                    "status-poll-interval-s", 2.0)),
+                on_assignment_change=resync_all)
+            self.status_poller.start()
         if self.config.get("profiler"):
             self.profiler = SimpleProfiler()
             self.profiler.start()
@@ -211,6 +234,8 @@ class FiloServer:
         return n
 
     def shutdown(self) -> None:
+        if self.status_poller is not None:
+            self.status_poller.stop()
         for gw in self.gateways:
             gw.shutdown()
         self.coordinator.shutdown()

@@ -1,0 +1,7 @@
+"""Stress harness: concurrent ingest+query soaks and failover drills
+(capability match for the reference's stress/ module, reference:
+stress/src/main/scala/filodb.stress/*.scala — IngestionStress,
+InMemoryQueryStress, StreamingStress — and the standalone multi-jvm
+failover specs).  Run ``python -m stress.run_all`` from the repo root;
+each runner prints JSON metric lines and exits nonzero on any
+correctness failure."""

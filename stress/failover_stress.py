@@ -1,0 +1,220 @@
+"""Two-node kill/failover drill with live ingest and queries.
+
+Reference intent being ported: standalone/src/multi-jvm
+ClusterSingletonFailoverSpec + IngestionAndRecoverySpec — two nodes
+share a dataset's shards; one node is killed; the failure detector
+declares it down, the shard manager reassigns its shards to the
+survivor, which replays them from the (durable) ingest transport; the
+query surface returns to full-coverage answers.
+
+Topology: one durable broker; node A owns shards 0-1, node B owns 2-3;
+A's planner dispatches B's shards over HTTP.  The driver plays the
+membership/gossip role the reference delegates to Akka Cluster: it
+heartbeats B into A's failure detector while B lives, stops when B is
+killed, and resyncs A after reassignment.
+
+Usage: python -m stress.failover_stress [--seconds 30] [--series 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+
+from stress.common import emit, force_cpu_x64, log
+
+BASE = 1_700_000_000_000
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=30.0)
+    ap.add_argument("--series", type=int, default=64)
+    args = ap.parse_args(argv)
+
+    force_cpu_x64()
+    import tempfile
+
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+    from filodb_tpu.ingest.broker import BrokerClient, BrokerProducer, \
+        BrokerServer
+    from filodb_tpu.standalone import FiloServer
+
+    num_shards = 4
+    broker = BrokerServer(data_dir=tempfile.mkdtemp(prefix="stress-broker-"))
+    broker.start()
+    client = BrokerClient(port=broker.port)
+    producer = BrokerProducer(client, "prom", num_shards)
+
+    spread = 2  # one shard key fans out over 2^2 = all 4 shards
+
+    import socket as _socket
+
+    def free_port() -> int:
+        with _socket.socket() as sk:
+            sk.bind(("127.0.0.1", 0))
+            return sk.getsockname()[1]
+
+    # fixed ports, as a real deployment's config would have
+    port_a, port_b = free_port(), free_port()
+
+    def node_config(name, my_port, peer_name, peer_port):
+        return {
+            "node": name,
+            "http-port": my_port,
+            "status-poll-interval-s": 0.5,
+            "datasets": [{"name": "prom", "num-shards": num_shards,
+                          "min-num-nodes": 2, "schema": "gauge",
+                          "spread": spread,
+                          "source": {"factory": "kafka",
+                                     "port": broker.port},
+                          "store": {"groups-per-shard": 2,
+                                    "flush-interval": "10s"}}],
+            "peers": {peer_name: f"http://127.0.0.1:{peer_port}"},
+        }
+
+    srv_b = FiloServer(node_config("node-b", port_b, "node-a", port_a))
+    srv_b.start()
+    srv_a = FiloServer(node_config("node-a", port_a, "node-b", port_b))
+    srv_a.start()
+
+    # NO driver choreography: node-a is the leader (lowest name), each
+    # node's StatusPoller gossips /__health — B adopts A's assignment
+    # view and resyncs itself; A learns B is alive and assigns it
+    # shards.  Wait for the views to converge on their own.
+    srv_a.failure_detector.timeout_ms = 2_000
+    srv_a.status_poller.interval_s = 0.5
+    srv_b.status_poller.interval_s = 0.5
+    mapper_a = srv_a.manager.mapper("prom")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        shards_a = mapper_a.shards_for_node("node-a")
+        shards_b = mapper_a.shards_for_node("node-b")
+        if sorted(shards_a + shards_b) == list(range(num_shards)) \
+                and sorted(srv_b.coordinator.ingestion["prom"]
+                           .running_shards()) == sorted(shards_b) \
+                and shards_b:
+            break
+        time.sleep(0.3)
+    assert sorted(shards_a + shards_b) == list(range(num_shards)), \
+        (shards_a, shards_b)
+    log(f"converged: node-a owns {shards_a}, node-b owns {shards_b}")
+
+    # continuous per-shard production to the durable broker
+    produced = [0]
+    stop = threading.Event()
+
+    from filodb_tpu.core.record import partition_hash, shard_key_hash
+    from filodb_tpu.core.schemas import DatasetOptions
+    opts = DatasetOptions()
+    tags_of = {}
+    route = {}
+    for s in range(args.series):
+        tags = {"_metric_": "fm", "inst": f"i{s}", "_ws_": "w", "_ns_": "n"}
+        tags_of[s] = tags
+        # the gateway's routing rule: bit-splice of shard-key and
+        # partition hashes under the spread
+        route[s] = mapper_a.ingestion_shard(
+            shard_key_hash(tags, opts), partition_hash(tags, opts),
+            spread) % num_shards
+    assert len(set(route.values())) == num_shards, \
+        f"series only landed on shards {set(route.values())}"
+
+    def produce():
+        tick = 0
+        while not stop.is_set():
+            for s in range(args.series):
+                b = RecordBuilder(DEFAULT_SCHEMAS["gauge"],
+                                  container_size=64 * 1024)
+                b.add_series([BASE + tick * 1000],
+                             [[float(s + tick)]], tags_of[s])
+                for c in b.containers():
+                    producer.publish(route[s], c)
+            produced[0] += args.series
+            tick += 1
+            time.sleep(0.2)
+
+    # the step grid must intersect the 5-min staleness window of the
+    # produced samples (which walk forward from BASE one second per tick)
+    qs = urllib.parse.urlencode({
+        "query": 'count(fm{_ws_="w",_ns_="n"})',
+        "start": BASE / 1000,
+        "end": (BASE + 600_000) / 1000, "step": "15s"})
+    url = f"http://127.0.0.1:{port_a}/promql/prom/api/v1/query_range?{qs}"
+
+    def full_count():
+        """count over all shards via node A; None on failure."""
+        try:
+            body = json.loads(urllib.request.urlopen(url, timeout=30).read())
+            if body.get("status") != "success" or not body["data"]["result"]:
+                return None
+            return max(int(float(v)) for _, v in
+                       body["data"]["result"][0]["values"])
+        except Exception:  # noqa: BLE001
+            return None
+
+    pt = threading.Thread(target=produce, daemon=True)
+    pt.start()
+
+    # phase 1: both nodes up; queries must see all series
+    deadline = time.time() + args.seconds / 3
+    ok_before = 0
+    while time.time() < deadline:
+        if full_count() == args.series:
+            ok_before += 1
+        time.sleep(0.3)
+    assert ok_before > 0, "no successful full-coverage query before failover"
+    log(f"phase 1: {ok_before} full-coverage queries with both nodes up")
+
+    # phase 2: KILL node B; keep producing
+    t_kill = time.time()
+    srv_b.shutdown()
+    log("node-b killed")
+    # A's StatusPoller stops hearing from B -> failure detector declares
+    # it down -> shards reassigned -> on_assignment_change resyncs A ->
+    # A replays B's shards from the durable broker.  No driver help.
+    recovered_at = None
+    deadline = time.time() + max(args.seconds, 90)
+    while time.time() < deadline:
+        if full_count() == args.series:
+            recovered_at = time.time()
+            break
+        time.sleep(0.3)
+    assert recovered_at is not None, "never recovered full coverage"
+    gap = recovered_at - t_kill
+    owned = srv_a.manager.mapper("prom").shards_for_node("node-a")
+    assert sorted(owned) == list(range(num_shards)), owned
+    log(f"phase 2: full coverage restored {gap:.1f}s after kill; "
+        f"node-a now owns {owned}")
+
+    # phase 3: keep going; verify sustained correctness post-failover
+    ok_after = 0
+    deadline = time.time() + args.seconds / 3
+    while time.time() < deadline:
+        if full_count() == args.series:
+            ok_after += 1
+        time.sleep(0.3)
+    stop.set()
+    pt.join(timeout=10)
+    assert ok_after > 0, "no successful queries after failover"
+
+    emit("failover recovery gap", gap, "seconds",
+         shards_taken_over=len([s for s in owned if s in shards_b]))
+    emit("failover queries ok (before/after)", ok_before + ok_after,
+         "queries", before=ok_before, after=ok_after)
+    emit("failover rows produced", produced[0], "rows")
+    srv_a.shutdown()
+    broker.shutdown()
+    log("PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,206 @@
+"""Concurrent ingest + query soak on one node at realistic cardinality.
+
+Reference intent being ported: stress/IngestionStress.scala (sustained
+concurrent writes, then read back and compare every cell),
+InMemoryQueryStress.scala (many concurrent PromQL queries), and
+jmh/QueryAndIngestBenchmark.scala:38 (queries while ingest continues).
+
+One FiloServer, N producer threads pushing containers into the per-shard
+queue streams, M query threads hammering the HTTP PromQL surface with a
+query mix (raw count, sum(rate), quantile, label_values).  At the end:
+drain, then verify per-series sample counts and values exactly match
+what was produced — queries racing ingest/flush must never corrupt data.
+
+Usage: python -m stress.ingest_query_stress [--seconds 20]
+       [--series 2000] [--shards 4] [--query-threads 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+
+from stress.common import Latencies, emit, force_cpu_x64, log
+
+BASE = 1_700_000_000_000
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=20.0)
+    ap.add_argument("--series", type=int, default=2_000)
+    ap.add_argument("--shards", type=int, default=4)
+    ap.add_argument("--query-threads", type=int, default=4)
+    ap.add_argument("--producer-threads", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    force_cpu_x64()
+    from filodb_tpu.core.record import RecordBuilder, partition_hash, \
+        shard_key_hash
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+    from filodb_tpu.standalone import FiloServer
+
+    srv = FiloServer({
+        "node": "stress-0",
+        "datasets": [{"name": "prom", "num-shards": args.shards,
+                      "schema": "gauge", "spread": 1,
+                      "query": {"workers": 4, "max-queued": 512},
+                      "store": {"groups-per-shard": 4,
+                                "flush-interval": "5s"}}],
+    })
+    port = srv.start()
+    opts = DatasetOptions()
+    mapper = srv.manager.mapper("prom")
+    schema = DEFAULT_SCHEMAS["gauge"]
+
+    # per-series routing + bookkeeping
+    tags_of = {}
+    shard_of = {}
+    for s in range(args.series):
+        tags = {"_metric_": "stress_metric", "inst": f"i{s}",
+                "job": f"j{s % 23}", "_ws_": "w", "_ns_": "n"}
+        tags_of[s] = tags
+        shard_of[s] = mapper.ingestion_shard(
+            shard_key_hash(tags, opts), partition_hash(tags, opts),
+            1) % args.shards
+    produced = np.zeros(args.series, dtype=np.int64)
+    stop = threading.Event()
+    errors: list[str] = []
+
+    def producer(worker: int):
+        """Each worker owns a slice of series and appends batches of
+        rows walking forward in time."""
+        mine = [s for s in range(args.series)
+                if s % args.producer_threads == worker]
+        tick = 0
+        rows_per_batch = 5
+        while not stop.is_set():
+            by_shard: dict[int, RecordBuilder] = {}
+            for s in mine:
+                b = by_shard.get(shard_of[s])
+                if b is None:
+                    b = by_shard[shard_of[s]] = RecordBuilder(
+                        schema, opts, container_size=256 * 1024)
+                t0 = BASE + tick * rows_per_batch * 1000
+                ts = [t0 + r * 1000 for r in range(rows_per_batch)]
+                vals = [float(s) + 0.001 * (tick * rows_per_batch + r)
+                        for r in range(rows_per_batch)]
+                b.add_series(ts, [vals], tags_of[s])
+                produced[s] += rows_per_batch
+            for shard, b in by_shard.items():
+                for c in b.containers():
+                    srv.stream_factory.stream_for("prom", shard).push(c)
+            tick += 1
+            time.sleep(0.01)
+
+    QUERIES = [
+        'count(stress_metric{_ws_="w",_ns_="n"})',
+        'sum(rate(stress_metric{_ws_="w",_ns_="n"}[1m]))',
+        'quantile(0.9, stress_metric{_ws_="w",_ns_="n"})',
+        'sum by (job)(stress_metric{_ws_="w",_ns_="n"})',
+    ]
+    qcount = [0]
+    lat = Latencies()
+
+    def querier(worker: int):
+        i = worker
+        while not stop.is_set():
+            q = QUERIES[i % len(QUERIES)]
+            i += 1
+            now_ms = BASE + int((time.time() - t_start) * 1000) + 60_000
+            qs = urllib.parse.urlencode({
+                "query": q, "start": (now_ms - 120_000) / 1000,
+                "end": now_ms / 1000, "step": "5s"})
+            done = lat.time()
+            try:
+                body = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/promql/prom/api/v1/"
+                    f"query_range?{qs}", timeout=30).read())
+                if body.get("status") != "success":
+                    errors.append(f"query status {body}")
+                    return
+                qcount[0] += 1
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"{q}: {e!r}")
+                return
+            finally:
+                done()
+
+    t_start = time.time()
+    producers = [threading.Thread(target=producer, args=(w,), daemon=True)
+                 for w in range(args.producer_threads)]
+    queriers = [threading.Thread(target=querier, args=(w,), daemon=True)
+                for w in range(args.query_threads)]
+    for t in producers + queriers:
+        t.start()
+    time.sleep(args.seconds)
+    stop.set()
+    for t in producers + queriers:
+        t.join(timeout=30)
+    elapsed = time.time() - t_start
+
+    # drain: every produced row must arrive
+    total_produced = int(produced.sum())
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        ingested = sum(sh.stats.rows_ingested
+                       for sh in srv.memstore.shards("prom"))
+        if ingested >= total_produced:
+            break
+        time.sleep(0.1)
+    ok = True
+    if ingested != total_produced:
+        log(f"FAIL: ingested {ingested} != produced {total_produced}")
+        ok = False
+
+    # cell-exact spot check (IngestionStress "compare every cell" intent):
+    # verify 50 random series' full contents
+    rng = np.random.default_rng(0)
+    check = rng.choice(args.series, size=min(50, args.series), replace=False)
+    for s in check:
+        sh = srv.memstore.get_shard("prom", shard_of[int(s)])
+        pids = [pid for pid, p in sh.partitions.items()
+                if p.tags.get("inst") == f"i{s}"]
+        if len(pids) != 1:
+            log(f"FAIL: series i{s}: {len(pids)} partitions")
+            ok = False
+            continue
+        ts, vals = sh.partitions[pids[0]].read_range(
+            0, np.iinfo(np.int64).max)
+        n = int(produced[int(s)])
+        if len(ts) != n:
+            log(f"FAIL: series i{s}: {len(ts)} rows != produced {n}")
+            ok = False
+            continue
+        want = float(s) + 0.001 * np.arange(n)
+        if not np.allclose(vals, want, atol=1e-9):
+            log(f"FAIL: series i{s}: value mismatch")
+            ok = False
+    if errors:
+        log(f"FAIL: {len(errors)} query errors; first: {errors[0]}")
+        ok = False
+
+    flushes = sum(sh.stats.flushes_done for sh in srv.memstore.shards("prom"))
+    emit("stress ingest throughput", total_produced / elapsed, "rows/sec",
+         series=args.series, shards=args.shards, seconds=round(elapsed, 1))
+    emit("stress queries completed", qcount[0], "queries",
+         qps=round(qcount[0] / elapsed, 1))
+    emit("stress query p50 latency", lat.pct(0.50) * 1000, "ms")
+    emit("stress query p99 latency", lat.pct(0.99) * 1000, "ms",
+         note="includes first-shape XLA compiles")
+    emit("stress query errors", len(errors), "errors")
+    emit("stress verified series cells", len(check), "series",
+         flushes_during=flushes)
+    srv.shutdown()
+    log("PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,31 @@
+"""Run every stress drill as a subprocess; fail if any fails.
+
+Usage: python -m stress.run_all [--seconds 30]
+Reference analog: running the stress/ apps (stress/src/main/scala)."""
+
+import argparse
+import pathlib
+import subprocess
+import sys
+
+HERE = pathlib.Path(__file__).resolve().parent
+
+RUNNERS = ["stress.ingest_query_stress", "stress.failover_stress"]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=30.0)
+    args = ap.parse_args(argv)
+    ok = True
+    for mod in RUNNERS:
+        print(f"=== {mod} ===", file=sys.stderr, flush=True)
+        proc = subprocess.run(
+            [sys.executable, "-m", mod, "--seconds", str(args.seconds)],
+            cwd=str(HERE.parent), timeout=900)
+        ok = ok and proc.returncode == 0
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

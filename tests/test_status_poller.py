@@ -1,0 +1,138 @@
+"""StatusPoller: dynamic leadership, leader-only reassignment, sticky
+operator statuses (reference: cluster singleton + ShardMapper snapshot
+gossip; Akka failure detector)."""
+
+import threading
+import time
+
+import pytest
+
+from filodb_tpu.coordinator.cluster import (FailureDetector, ShardManager,
+                                            StatusPoller)
+from filodb_tpu.parallel.shardmap import ShardStatus
+
+
+def _mk(local, peers, timeout_ms=1_000):
+    clock = {"t": 0.0}
+    mgr = ShardManager()
+    det = FailureDetector(mgr, timeout_ms=timeout_ms,
+                          clock=lambda: clock["t"])
+    poller = StatusPoller(mgr, det, peers, local, timeout_s=0.2)
+    return mgr, det, poller, clock
+
+
+class TestLeadership:
+    def test_lowest_fresh_node_leads(self):
+        mgr, det, poller, clock = _mk("node-b", {"node-a": "http://x"})
+        det.heartbeat("node-a")
+        assert poller.leader == "node-a"
+        # node-a's heartbeat goes stale: node-b takes over
+        clock["t"] += 2.0
+        assert poller.leader == "node-b"
+        poller.stop()
+
+    def test_only_leader_declares_down(self):
+        # non-leader with a live leader never runs check()
+        mgr, det, poller, clock = _mk(
+            "node-b", {"node-a": "http://127.0.0.1:1",
+                       "node-c": "http://127.0.0.1:1"})
+        mgr.setup_dataset("ds", 4, min_num_nodes=2)
+        det.heartbeat("node-a")
+        det.heartbeat("node-c")
+        clock["t"] += 0.5
+        # node-c would be stale at 1.5 with timeout 1.0...
+        clock["t"] += 1.0
+        # node-a is ALSO stale now, so node-b becomes acting leader and
+        # may declare both; rewind node-a's freshness first
+        det.heartbeat("node-a")
+        assert poller.leader == "node-a"
+        down = poller.poll_once()   # peers unreachable, but a is fresh
+        assert down == []           # non-leader: no down declarations
+        assert "node-c" in det.alive()
+        poller.stop()
+
+    def test_leader_failover_reassigns(self):
+        mgr, det, poller, clock = _mk("node-b",
+                                      {"node-a": "http://127.0.0.1:1"})
+        mgr.setup_dataset("ds", 4, min_num_nodes=2)
+        det.heartbeat("node-b")
+        det.heartbeat("node-a")
+        # consistent view: a owns its shards
+        assert set(mgr.mapper("ds").shards_for_node("node-a")) \
+            | set(mgr.mapper("ds").shards_for_node("node-b")) \
+            == {0, 1, 2, 3}
+        clock["t"] += 2.0           # node-a dies (heartbeat stale)
+        det.heartbeat("node-b")     # we are alive
+        assert poller.leader == "node-b"
+        down = poller.poll_once()
+        assert down == ["node-a"]
+        assert sorted(mgr.mapper("ds").shards_for_node("node-b")) \
+            == [0, 1, 2, 3]
+        poller.stop()
+
+
+class TestStickyStatuses:
+    def test_stopped_not_resurrected_by_liveness(self):
+        mgr, det, poller, clock = _mk("node-a", {"node-b": "http://x"})
+        mgr.setup_dataset("ds", 2, min_num_nodes=2)
+        det.heartbeat("node-b")
+        m = mgr.mapper("ds")
+        shards_b = m.shards_for_node("node-b")
+        assert shards_b
+        target = shards_b[0]
+        m.update_status(target, ShardStatus.STOPPED)
+        # peer reports the shard as running: STOPPED must stick
+        poller._apply_liveness("node-b", {
+            "running": {"ds": [target]},
+            "shards": {"ds": [{"shard": target, "status": "Active",
+                               "node": "node-b"}]}})
+        assert m.status(target) == ShardStatus.STOPPED
+        poller.stop()
+
+    def test_not_running_demotes_to_assigned(self):
+        mgr, det, poller, clock = _mk("node-a", {"node-b": "http://x"})
+        mgr.setup_dataset("ds", 2, min_num_nodes=2)
+        det.heartbeat("node-b")
+        m = mgr.mapper("ds")
+        target = m.shards_for_node("node-b")[0]
+        m.update_status(target, ShardStatus.ACTIVE)
+        poller._apply_liveness("node-b", {"running": {"ds": []},
+                                          "shards": {"ds": []}})
+        assert m.status(target) == ShardStatus.ASSIGNED
+        poller.stop()
+
+    def test_recovery_substate_honored(self):
+        mgr, det, poller, clock = _mk("node-a", {"node-b": "http://x"})
+        mgr.setup_dataset("ds", 2, min_num_nodes=2)
+        det.heartbeat("node-b")
+        m = mgr.mapper("ds")
+        target = m.shards_for_node("node-b")[0]
+        poller._apply_liveness("node-b", {
+            "running": {"ds": [target]},
+            "shards": {"ds": [{"shard": target, "status": "Recovery",
+                               "node": "node-b"}]}})
+        assert m.status(target) == ShardStatus.RECOVERY
+        poller.stop()
+
+
+class TestAdoption:
+    def test_non_leader_adopts_leader_assignment(self):
+        mgr, det, poller, clock = _mk("node-b", {"node-a": "http://x"})
+        mgr.setup_dataset("ds", 4, min_num_nodes=2)
+        # local (wrong) view: node-b owns 0,1
+        det.heartbeat("node-b")
+        m = mgr.mapper("ds")
+        assert m.shards_for_node("node-b") == [0, 1]
+        leader_view = {"shards": {"ds": [
+            {"shard": 0, "status": "Active", "node": "node-a"},
+            {"shard": 1, "status": "Active", "node": "node-a"},
+            {"shard": 2, "status": "Assigned", "node": "node-b"},
+            {"shard": 3, "status": "Assigned", "node": "node-b"},
+        ]}}
+        changed = poller._adopt_leader_view(leader_view)
+        assert changed
+        assert m.shards_for_node("node-a") == [0, 1]
+        assert m.shards_for_node("node-b") == [2, 3]
+        # idempotent
+        assert not poller._adopt_leader_view(leader_view)
+        poller.stop()

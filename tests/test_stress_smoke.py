@@ -1,0 +1,27 @@
+"""Smoke runs of the stress drills (short durations) so the harness
+itself stays green; full soaks run via ``python -m stress.run_all``.
+
+Reference analog: the stress/ apps are run manually; the multi-jvm
+failover specs run in CI (ClusterSingletonFailoverSpec)."""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.mark.parametrize("mod,extra", [
+    ("stress.ingest_query_stress", ["--seconds", "6", "--series", "200",
+                                    "--query-threads", "2"]),
+    ("stress.failover_stress", ["--seconds", "12", "--series", "32"]),
+])
+def test_stress_runner(mod, extra):
+    proc = subprocess.run(
+        [sys.executable, "-m", mod, *extra], cwd=str(REPO),
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, \
+        f"{mod} failed:\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-2000:]}"
+    assert '"metric"' in proc.stdout
