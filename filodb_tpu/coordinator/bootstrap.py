@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import socket
 import threading
+import urllib.parse
 import urllib.request
 from typing import Optional, Sequence
 
@@ -54,6 +55,194 @@ class DnsSeedDiscovery(SeedDiscovery):
             return []
         addrs = sorted({i[4][0] for i in infos})
         return [f"{self.scheme}://{a}:{self.port}" for a in addrs]
+
+
+class DnsSrvSeedDiscovery(SeedDiscovery):
+    """True DNS SRV discovery (reference:
+    DnsSrvClusterSeedDiscovery.scala:12 — resolves
+    ``_service._proto.domain`` SRV records to host:port seeds).
+
+    No resolver library may be installed here, so this speaks the DNS
+    wire format directly over UDP (RFC 1035/2782): one SRV query to the
+    configured resolver, answers sorted by (priority, -weight), targets
+    resolved to addresses via getaddrinfo."""
+
+    def __init__(self, srv_name: str, scheme: str = "http",
+                 resolver: Optional[tuple[str, int]] = None,
+                 timeout_s: float = 3.0):
+        self.srv_name = srv_name.rstrip(".")
+        self.scheme = scheme
+        self.resolver = resolver or self._system_resolver()
+        self.timeout_s = timeout_s
+
+    @staticmethod
+    def _system_resolver() -> tuple[str, int]:
+        try:
+            with open("/etc/resolv.conf") as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) >= 2 and parts[0] == "nameserver":
+                        return parts[1], 53
+        except OSError:
+            pass
+        return "127.0.0.1", 53
+
+    def _build_query(self, qid: int) -> bytes:
+        out = bytearray()
+        out += qid.to_bytes(2, "big")
+        out += (0x0100).to_bytes(2, "big")      # RD=1
+        out += (1).to_bytes(2, "big")           # QDCOUNT
+        out += (0).to_bytes(6, "big")           # AN/NS/AR
+        for label in self.srv_name.split("."):
+            lb = label.encode()
+            out += bytes([len(lb)]) + lb
+        out += b"\x00"
+        out += (33).to_bytes(2, "big")          # QTYPE=SRV
+        out += (1).to_bytes(2, "big")           # QCLASS=IN
+        return bytes(out)
+
+    @staticmethod
+    def _read_name(buf: bytes, pos: int) -> tuple[str, int]:
+        """Parse a (possibly compressed) DNS name; returns (name, next)."""
+        labels = []
+        jumped = False
+        nxt = pos
+        hops = 0
+        while True:
+            if pos >= len(buf):
+                raise ValueError("truncated name")
+            ln = buf[pos]
+            if ln & 0xC0 == 0xC0:               # compression pointer
+                if pos + 2 > len(buf):
+                    raise ValueError("truncated pointer")
+                if not jumped:
+                    nxt = pos + 2
+                pos = ((ln & 0x3F) << 8) | buf[pos + 1]
+                jumped = True
+                hops += 1
+                if hops > 32:
+                    raise ValueError("compression loop")
+                continue
+            pos += 1
+            if ln == 0:
+                break
+            labels.append(buf[pos:pos + ln].decode("ascii",
+                                                   errors="replace"))
+            pos += ln
+        return ".".join(labels), (nxt if jumped else pos)
+
+    def _parse_srv_answers(self, buf: bytes) -> list[tuple[int, int, int, str]]:
+        if len(buf) < 12:
+            raise ValueError("short DNS response")
+        qd = int.from_bytes(buf[4:6], "big")
+        an = int.from_bytes(buf[6:8], "big")
+        pos = 12
+        for _ in range(qd):                     # skip question section
+            _, pos = self._read_name(buf, pos)
+            pos += 4
+        out = []
+        for _ in range(an):
+            _, pos = self._read_name(buf, pos)
+            rtype = int.from_bytes(buf[pos:pos + 2], "big")
+            rdlen = int.from_bytes(buf[pos + 8:pos + 10], "big")
+            rdata = buf[pos + 10:pos + 10 + rdlen]
+            pos += 10 + rdlen
+            if rtype != 33 or len(rdata) < 7:
+                continue
+            prio = int.from_bytes(rdata[0:2], "big")
+            weight = int.from_bytes(rdata[2:4], "big")
+            port = int.from_bytes(rdata[4:6], "big")
+            # target name may use compression into the full message
+            target, _ = self._read_name(buf, pos - rdlen + 6)
+            out.append((prio, weight, port, target))
+        return out
+
+    def discover(self) -> list[str]:
+        import os
+        qid = int.from_bytes(os.urandom(2), "big")
+        query = self._build_query(qid)
+        try:
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as sk:
+                sk.settimeout(self.timeout_s)
+                sk.sendto(query, self.resolver)
+                resp, _ = sk.recvfrom(4096)
+        except OSError:
+            return []
+        if len(resp) < 2 or resp[:2] != query[:2]:
+            return []
+        try:
+            answers = self._parse_srv_answers(resp)
+        except ValueError:
+            return []
+        answers.sort(key=lambda a: (a[0], -a[1]))
+        seeds = []
+        for _, _, port, target in answers:
+            try:
+                infos = socket.getaddrinfo(target, port,
+                                           type=socket.SOCK_STREAM)
+                addrs = sorted({i[4][0] for i in infos})
+            except socket.gaierror:
+                addrs = [target]
+            seeds.extend(f"{self.scheme}://{a}:{port}" for a in addrs)
+        return seeds
+
+
+class ConsulSeedDiscovery(SeedDiscovery):
+    """Consul health-API discovery (reference: ConsulClusterSeedDiscovery
+    + ConsulClient.scala): GET
+    ``/v1/health/service/<name>?passing=1`` and turn each passing
+    instance's (Service.Address|Node.Address, Service.Port) into a seed
+    endpoint."""
+
+    def __init__(self, service: str, consul_url: str = "http://127.0.0.1:8500",
+                 scheme: str = "http", timeout_s: float = 3.0):
+        self.service = service
+        self.consul_url = consul_url.rstrip("/")
+        self.scheme = scheme
+        self.timeout_s = timeout_s
+
+    def discover(self) -> list[str]:
+        url = (f"{self.consul_url}/v1/health/service/"
+               f"{urllib.parse.quote(self.service)}?passing=1")
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
+                entries = json.loads(r.read())
+        except Exception:  # noqa: BLE001 — consul down: no seeds
+            return []
+        seeds = []
+        for e in entries if isinstance(entries, list) else []:
+            svc = e.get("Service") or {}
+            node = e.get("Node") or {}
+            addr = svc.get("Address") or node.get("Address")
+            port = svc.get("Port")
+            if addr and port:
+                seeds.append(f"{self.scheme}://{addr}:{port}")
+        return seeds
+
+
+def seed_discovery_from_config(conf: dict) -> SeedDiscovery:
+    """Config-driven strategy pick (reference: the bootstrapper's
+    ``discovery-mechanism`` setting)."""
+    kind = conf.get("mechanism", "explicit")
+    if kind == "explicit":
+        return ExplicitListSeedDiscovery(conf.get("seeds", []))
+    if kind == "dns-a":
+        return DnsSeedDiscovery(conf["hostname"], int(conf["port"]),
+                                conf.get("scheme", "http"))
+    if kind == "dns-srv":
+        resolver = None
+        if conf.get("resolver"):
+            host, _, port = conf["resolver"].partition(":")
+            resolver = (host, int(port or 53))
+        return DnsSrvSeedDiscovery(conf["srv-name"],
+                                   conf.get("scheme", "http"),
+                                   resolver=resolver)
+    if kind == "consul":
+        return ConsulSeedDiscovery(conf["service"],
+                                   conf.get("consul-url",
+                                            "http://127.0.0.1:8500"),
+                                   conf.get("scheme", "http"))
+    raise ValueError(f"unknown discovery mechanism {kind!r}")
 
 
 class ClusterBootstrap:

@@ -376,7 +376,8 @@ class StatusPoller:
     def __init__(self, manager: ShardManager, failure_detector: FailureDetector,
                  peers: dict[str, str], local_node: str,
                  interval_s: float = 2.0, timeout_s: float = 2.0,
-                 on_assignment_change: Optional[Callable[[], None]] = None):
+                 on_assignment_change: Optional[Callable[[], None]] = None,
+                 local_running: Optional[Callable[[str], list]] = None):
         from concurrent.futures import ThreadPoolExecutor
 
         self.manager = manager
@@ -386,14 +387,24 @@ class StatusPoller:
         self.interval_s = interval_s
         self.timeout_s = timeout_s
         self.on_assignment_change = on_assignment_change
+        # dataset -> shards the LOCAL coordinator actually runs; when set,
+        # every sweep self-heals: an assigned-but-not-running local shard
+        # (its ingest thread died) triggers the assignment-change hook,
+        # whose resync restarts it
+        self.local_running = local_running
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, min(len(self.peers), 8)),
             thread_name_prefix="status-poll")
-        # async hook runner: coalesces bursts into one pending resync
+        # async hook runner: coalesces bursts into one pending resync.
+        # _hook_alive flips only under _hook_lock, atomically with the
+        # final pending check, so a signal can never land between "thread
+        # decided to exit" and "thread observed dead" and get dropped
         self._change_pending = threading.Event()
         self._hook_thread: Optional[threading.Thread] = None
+        self._hook_lock = threading.Lock()
+        self._hook_alive = False
 
     @property
     def leader(self) -> str:
@@ -448,31 +459,47 @@ class StatusPoller:
         if self.leader == self.local_node:
             # one decider: only the acting leader mutates membership
             down = self.detector.check()
-        if down or changed:
+        if down or changed or self._local_needs_heal():
             self._signal_change()
         return down
+
+    def _local_needs_heal(self) -> bool:
+        """True when a locally-assigned shard is not actually running
+        (its ingest thread died) — the resync hook restarts it; without
+        this the shard would stay ASSIGNED (unqueryable) forever."""
+        if self.local_running is None:
+            return False
+        for ds in self.manager.datasets():
+            assigned = set(self.manager.mapper(ds).shards_for_node(
+                self.local_node))
+            if assigned - set(self.local_running(ds)):
+                return True
+        return False
 
     def _signal_change(self) -> None:
         if self.on_assignment_change is None:
             return
-        self._change_pending.set()
-        if self._hook_thread is None or not self._hook_thread.is_alive():
-            self._run_hook_async()
+        with self._hook_lock:
+            self._change_pending.set()
+            if not self._hook_alive:
+                self._hook_alive = True
+                self._hook_thread = threading.Thread(
+                    target=self._run_hook, name="assignment-change",
+                    daemon=True)
+                self._hook_thread.start()
 
-    def _run_hook_async(self) -> None:
-        def run():
-            import traceback as _tb
-            while self._change_pending.is_set() and not self._stop.is_set():
+    def _run_hook(self) -> None:
+        import traceback as _tb
+        while True:
+            with self._hook_lock:
+                if self._stop.is_set() or not self._change_pending.is_set():
+                    self._hook_alive = False
+                    return
                 self._change_pending.clear()
-                try:
-                    self.on_assignment_change()
-                except Exception:  # noqa: BLE001 — report, keep gossiping
-                    _tb.print_exc()
-
-        self._hook_thread = threading.Thread(target=run,
-                                             name="assignment-change",
-                                             daemon=True)
-        self._hook_thread.start()
+            try:
+                self.on_assignment_change()
+            except Exception:  # noqa: BLE001 — report, keep gossiping
+                _tb.print_exc()
 
     def _adopt_leader_view(self, body: dict) -> bool:
         """Replace local shard OWNERSHIP with the leader's (reference:

@@ -458,9 +458,8 @@ class FiloHttpServer:
                       for sts in out.values() for st in sts) if out else True
         body = {"healthy": healthy, "shards": out}
         if self.running_shards is not None:
-            body["running"] = {ds: self.running_shards(ds) for ds in out} \
-                if out else {ds: self.running_shards(ds)
-                             for ds in self.datasets}
+            body["running"] = {ds: self.running_shards(ds)
+                               for ds in (out or self.datasets)}
         if self.node_name:
             body["node"] = self.node_name
         return (200 if healthy else 503), body

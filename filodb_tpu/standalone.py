@@ -117,7 +117,8 @@ class FiloServer:
                 self.manager, self.failure_detector, peers, self.node,
                 interval_s=float(self.config.get(
                     "status-poll-interval-s", 2.0)),
-                on_assignment_change=resync_all)
+                on_assignment_change=resync_all,
+                local_running=self._running_shards)
             self.status_poller.start()
         if self.config.get("profiler"):
             self.profiler = SimpleProfiler()

@@ -103,8 +103,12 @@ def main(argv=None) -> int:
                 and shards_b:
             break
         time.sleep(0.3)
-    assert sorted(shards_a + shards_b) == list(range(num_shards)), \
-        (shards_a, shards_b)
+    assert sorted(shards_a + shards_b) == list(range(num_shards)) \
+        and shards_b \
+        and sorted(srv_b.coordinator.ingestion["prom"].running_shards()) \
+        == sorted(shards_b), \
+        f"never converged: a={shards_a} b={shards_b} " \
+        f"b_running={srv_b.coordinator.ingestion['prom'].running_shards()}"
     log(f"converged: node-a owns {shards_a}, node-b owns {shards_b}")
 
     # continuous per-shard production to the durable broker

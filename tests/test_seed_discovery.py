@@ -1,0 +1,169 @@
+"""DNS-SRV and Consul seed discovery against protocol-faithful fakes
+(reference: akka-bootstrapper DnsSrvClusterSeedDiscovery.scala:12,
+ConsulClusterSeedDiscovery + ConsulClient.scala)."""
+
+import json
+import socket
+import struct
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from filodb_tpu.coordinator.bootstrap import (ConsulSeedDiscovery,
+                                              DnsSrvSeedDiscovery,
+                                              ExplicitListSeedDiscovery,
+                                              seed_discovery_from_config)
+
+
+def _name(n: str) -> bytes:
+    out = bytearray()
+    for label in n.rstrip(".").split("."):
+        out += bytes([len(label)]) + label.encode()
+    return bytes(out) + b"\x00"
+
+
+class FakeDnsServer:
+    """One-shot UDP DNS server answering SRV queries for a fixed zone."""
+
+    def __init__(self, records):
+        # records: list of (priority, weight, port, target)
+        self.records = records
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(("127.0.0.1", 0))
+        self.addr = self.sock.getsockname()
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _serve(self):
+        try:
+            query, client = self.sock.recvfrom(4096)
+        except OSError:
+            return
+        qid = query[:2]
+        # parse question name to echo it
+        pos = 12
+        while query[pos] != 0:
+            pos += 1 + query[pos]
+        qname = query[12:pos + 1]
+        qtail = query[pos + 1:pos + 5]
+        resp = bytearray()
+        resp += qid + (0x8180).to_bytes(2, "big")      # QR=1 RD RA
+        resp += (1).to_bytes(2, "big")                  # QD
+        resp += len(self.records).to_bytes(2, "big")    # AN
+        resp += (0).to_bytes(4, "big")
+        resp += qname + qtail
+        for prio, weight, port, target in self.records:
+            resp += b"\xc0\x0c"                         # ptr to question
+            resp += (33).to_bytes(2, "big")             # SRV
+            resp += (1).to_bytes(2, "big")              # IN
+            resp += (60).to_bytes(4, "big")             # TTL
+            rdata = struct.pack(">HHH", prio, weight, port) + _name(target)
+            resp += len(rdata).to_bytes(2, "big") + rdata
+        self.sock.sendto(bytes(resp), client)
+
+    def close(self):
+        self.sock.close()
+
+
+class TestDnsSrv:
+    def test_srv_discovery(self):
+        dns = FakeDnsServer([(10, 50, 8080, "localhost"),
+                             (20, 10, 9090, "localhost")])
+        try:
+            d = DnsSrvSeedDiscovery("_filodb._tcp.test.local",
+                                    resolver=dns.addr, timeout_s=3)
+            seeds = d.discover()
+        finally:
+            dns.close()
+        # priority order, targets resolved to A records
+        assert seeds == ["http://127.0.0.1:8080", "http://127.0.0.1:9090"]
+
+    def test_priority_weight_ordering(self):
+        dns = FakeDnsServer([(20, 1, 9002, "localhost"),
+                             (10, 1, 9001, "localhost"),
+                             (10, 99, 9000, "localhost")])
+        try:
+            d = DnsSrvSeedDiscovery("_f._tcp.x", resolver=dns.addr)
+            seeds = d.discover()
+        finally:
+            dns.close()
+        ports = [int(s.rsplit(":", 1)[1]) for s in seeds]
+        assert ports == [9000, 9001, 9002]  # prio asc, weight desc
+
+    def test_no_resolver_returns_empty(self):
+        d = DnsSrvSeedDiscovery("_f._tcp.x", resolver=("127.0.0.1", 1),
+                                timeout_s=0.3)
+        assert d.discover() == []
+
+    def test_name_compression_roundtrip(self):
+        buf = b"\x03foo\x03bar\x00" + b"\xc0\x00"
+        name, nxt = DnsSrvSeedDiscovery._read_name(buf, 9)
+        assert name == "foo.bar" and nxt == 11
+
+
+class _ConsulHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        if self.path.startswith("/v1/health/service/filodb"):
+            assert "passing=1" in self.path
+            body = json.dumps([
+                {"Node": {"Address": "10.0.0.1"},
+                 "Service": {"Address": "10.0.0.1", "Port": 8080}},
+                {"Node": {"Address": "10.0.0.2"},
+                 "Service": {"Address": "", "Port": 8081}},
+            ]).encode()
+            self.send_response(200)
+        else:
+            body = b"[]"
+            self.send_response(404)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class TestConsul:
+    def test_consul_discovery(self):
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), _ConsulHandler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            d = ConsulSeedDiscovery(
+                "filodb", f"http://127.0.0.1:{srv.server_address[1]}")
+            seeds = d.discover()
+        finally:
+            srv.shutdown()
+            srv.server_close()
+        # service address preferred; node address as fallback
+        assert seeds == ["http://10.0.0.1:8080", "http://10.0.0.2:8081"]
+
+    def test_consul_down_returns_empty(self):
+        d = ConsulSeedDiscovery("filodb", "http://127.0.0.1:1",
+                                timeout_s=0.3)
+        assert d.discover() == []
+
+
+class TestConfigFactory:
+    def test_explicit(self):
+        d = seed_discovery_from_config({"mechanism": "explicit",
+                                        "seeds": ["http://a:1"]})
+        assert isinstance(d, ExplicitListSeedDiscovery)
+        assert d.discover() == ["http://a:1"]
+
+    def test_dns_srv(self):
+        d = seed_discovery_from_config({"mechanism": "dns-srv",
+                                        "srv-name": "_f._tcp.x",
+                                        "resolver": "127.0.0.1:5353"})
+        assert isinstance(d, DnsSrvSeedDiscovery)
+        assert d.resolver == ("127.0.0.1", 5353)
+
+    def test_consul(self):
+        d = seed_discovery_from_config({"mechanism": "consul",
+                                        "service": "filodb"})
+        assert isinstance(d, ConsulSeedDiscovery)
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            seed_discovery_from_config({"mechanism": "zk"})
