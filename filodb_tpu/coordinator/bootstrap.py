@@ -157,6 +157,26 @@ class DnsSrvSeedDiscovery(SeedDiscovery):
             out.append((prio, weight, port, target))
         return out
 
+    def _query_tcp(self, query: bytes) -> Optional[bytes]:
+        """RFC 1035 TCP fallback: 2-byte length-prefixed framing."""
+        try:
+            with socket.create_connection(self.resolver,
+                                          timeout=self.timeout_s) as sk:
+                sk.sendall(len(query).to_bytes(2, "big") + query)
+                hdr = sk.recv(2)
+                if len(hdr) < 2:
+                    return None
+                want = int.from_bytes(hdr, "big")
+                buf = b""
+                while len(buf) < want:
+                    chunk = sk.recv(want - len(buf))
+                    if not chunk:
+                        return None
+                    buf += chunk
+                return buf
+        except OSError:
+            return None
+
     def discover(self) -> list[str]:
         import os
         qid = int.from_bytes(os.urandom(2), "big")
@@ -168,6 +188,10 @@ class DnsSrvSeedDiscovery(SeedDiscovery):
                 resp, _ = sk.recvfrom(4096)
         except OSError:
             return []
+        if len(resp) >= 3 and resp[2] & 0x02:
+            # TC bit: the resolver truncated a large SRV answer at the
+            # classic UDP limit — retry over TCP for the full response
+            resp = self._query_tcp(query) or b""
         if len(resp) < 2 or resp[:2] != query[:2]:
             return []
         try:

@@ -470,8 +470,13 @@ class StatusPoller:
         if self.local_running is None:
             return False
         for ds in self.manager.datasets():
-            assigned = set(self.manager.mapper(ds).shards_for_node(
-                self.local_node))
+            mapper = self.manager.mapper(ds)
+            assigned = {
+                s for s in mapper.shards_for_node(self.local_node)
+                # operator-STOPPED / leader-DOWN shards are intentionally
+                # not running — healing them would defeat stop_shards
+                if mapper.status(s) not in (ShardStatus.STOPPED,
+                                            ShardStatus.DOWN)}
             if assigned - set(self.local_running(ds)):
                 return True
         return False

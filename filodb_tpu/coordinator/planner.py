@@ -55,7 +55,8 @@ class SingleClusterPlanner(QueryPlanner):
                      Callable[[int], PlanDispatcher]] = None,
                  hierarchical_reduce_at: int = 16,
                  min_time_range_for_split_ms: Optional[int] = None,
-                 split_size_ms: Optional[int] = None):
+                 split_size_ms: Optional[int] = None,
+                 mesh_engine_provider: Optional[Callable[[], object]] = None):
         self.dataset = dataset
         self.mapper = shard_mapper
         self.options = options or DatasetOptions()
@@ -67,6 +68,11 @@ class SingleClusterPlanner(QueryPlanner):
         # long queries split into sub-ranges and stitched)
         self.min_time_range_for_split_ms = min_time_range_for_split_ms
         self.split_size_ms = split_size_ms or min_time_range_for_split_ms
+        # ICI-collective serving path: when set, a distributive aggregate
+        # over local shards fuses into ONE SPMD mesh program
+        # (parallel/meshexec.py) instead of per-shard children + host
+        # reduce; remote shards keep HTTP dispatch alongside
+        self.mesh_engine_provider = mesh_engine_provider
 
     # -- shard pruning (reference :106-136) ---------------------------------
 
@@ -279,6 +285,9 @@ class SingleClusterPlanner(QueryPlanner):
         return DistConcatExec(children, qctx)
 
     def _aggregate(self, plan: lp.Aggregate, qctx) -> ExecPlan:
+        fused = self._maybe_mesh_aggregate(plan, qctx)
+        if fused is not None:
+            return fused
         inner = self._walk(plan.vectors, qctx)
         mapred = AggregateMapReduce(plan.operator, plan.params, plan.by,
                                     plan.without)
@@ -295,6 +304,66 @@ class SingleClusterPlanner(QueryPlanner):
             inner.add_transformer(mapred)
             root = ReduceAggregateExec([inner], plan.operator, plan.params,
                                        qctx)
+        root.add_transformer(AggregatePresenter(plan.operator, plan.params))
+        return root
+
+    def _maybe_mesh_aggregate(self, plan: lp.Aggregate, qctx
+                              ) -> Optional[ExecPlan]:
+        """Fuse ``agg(range_fn(selector[w]))`` over the LOCAL shards into
+        one SPMD mesh program with psum reduce (parallel/meshexec.py);
+        remote shards stay HTTP-dispatched children of the same
+        ReduceAggregateExec.  Applies only when a mesh engine is
+        configured and the shape is the distributive hot path."""
+        if self.mesh_engine_provider is None:
+            return None
+        from filodb_tpu.parallel.meshexec import (MeshAggregateExec,
+                                                  mesh_supported)
+        inner = plan.vectors
+        if isinstance(inner, lp.PeriodicSeriesWithWindowing):
+            raw, window, function = inner.series, inner.window_ms, \
+                inner.function
+            args = inner.function_args
+        elif isinstance(inner, lp.PeriodicSeries):
+            raw, window, function, args = inner.raw_series, None, None, ()
+        else:
+            return None
+        if not isinstance(raw, lp.RawSeries) or raw.columns:
+            return None
+        if not mesh_supported(plan.operator, function, plan.params):
+            return None
+        shards = self.shards_from_filters(raw.filters, qctx)
+        local = [s for s in shards
+                 if self.dispatcher_for_shard(s) is IN_PROCESS]
+        remote = [s for s in shards if s not in local]
+        if len(local) < 2:
+            return None   # nothing to fuse; per-shard path is simpler
+        engine = self.mesh_engine_provider()
+        mesh_child = MeshAggregateExec(
+            self.dataset, local, raw.filters,
+            raw.range_selector.from_ms, raw.range_selector.to_ms,
+            inner.start_ms, inner.step_ms, inner.end_ms, plan.operator,
+            window_ms=window, function=function, function_args=args,
+            offset_ms=inner.offset_ms or 0, by=plan.by,
+            without=plan.without, query_context=qctx, engine=engine)
+        mapred = AggregateMapReduce(plan.operator, plan.params, plan.by,
+                                    plan.without)
+        remote_children: list[ExecPlan] = []
+        for s in remote:
+            leaf = MultiSchemaPartitionsExec(
+                self.dataset, s, raw.filters,
+                raw.range_selector.from_ms, raw.range_selector.to_ms,
+                query_context=qctx, dispatcher=self.dispatcher_for_shard(s))
+            leaf.add_transformer(PeriodicSamplesMapper(
+                inner.start_ms, inner.step_ms, inner.end_ms,
+                window_ms=window, function=function, function_args=args,
+                offset_ms=inner.offset_ms or 0))
+            leaf.add_transformer(mapred)
+            remote_children.append(leaf)
+        # same bounded fan-in the per-shard path gets (reference :244-258)
+        remote_children = self._hierarchical_reduce(remote_children, plan,
+                                                    qctx)
+        root = ReduceAggregateExec([mesh_child] + remote_children,
+                                   plan.operator, plan.params, qctx)
         root.add_transformer(AggregatePresenter(plan.operator, plan.params))
         return root
 

@@ -140,10 +140,38 @@ def _agg_present(op: Agg, partials):
     return jnp.where(jnp.isfinite(m), m, jnp.nan)
 
 
+def partial_state_names(op: Agg) -> tuple[str, ...]:
+    """Names of the raw partials each op's mesh program outputs (the
+    ``_agg_map`` tuple order)."""
+    if op in (Agg.SUM, Agg.COUNT, Agg.AVG):
+        return ("sum", "count")
+    if op in (Agg.STDDEV, Agg.STDVAR):
+        return ("sum", "count", "sumsq")
+    if op == Agg.MIN:
+        return ("min",)
+    if op == Agg.MAX:
+        return ("max",)
+    raise ValueError(f"aggregate {op} has no distributive psum form")
+
+
+def exported_state_names(op: Agg) -> tuple[str, ...]:
+    """Subset of :func:`partial_state_names` the host aggregators expect
+    in an AggPartialBatch (query/aggregators.py MomentAggregator._NEEDS).
+    Exporting EXACTLY these keys matters: ``_align`` requires every
+    partial in a reduce — mesh or remote — to carry the same state names."""
+    if op == Agg.COUNT:
+        return ("count",)
+    return partial_state_names(op)
+
+
 @functools.lru_cache(maxsize=128)
 def _build_program(mesh_key, range_fn, agg_op: Agg, num_groups: int,
-                   window_ms: int, wmax: int, extra_args: tuple):
-    """Compile the SPMD scan→window→aggregate program for one signature."""
+                   window_ms: int, wmax: int, extra_args: tuple,
+                   present: bool = True):
+    """Compile the SPMD scan→window→aggregate program for one signature.
+    ``present=False`` returns the psum-combined partial tuple instead of
+    the presented values — the form a cross-NODE ReduceAggregateExec can
+    merge with remote shards' partials."""
     mesh = _MESHES[mesh_key]
 
     kind = rangefns.kernel_kind(range_fn)
@@ -160,12 +188,16 @@ def _build_program(mesh_key, range_fn, agg_op: Agg, num_groups: int,
             stepped = kernel(ts, vals, steps, window, wmax, *extra_args)
         partials = _agg_map(agg_op, stepped, ids, num_groups)
         partials = _agg_combine(agg_op, partials, "shard")
-        return _agg_present(agg_op, partials)   # [G, Tl]
+        if present:
+            return _agg_present(agg_op, partials)   # [G, Tl]
+        return partials                              # tuple of [G, Tl]
 
+    out_spec = P(None, "step")
     fn = shard_map(
         local, mesh=mesh,
         in_specs=(P("shard", None), P("shard", None), P("shard"), P("step")),
-        out_specs=P(None, "step"),
+        out_specs=out_spec if present
+        else tuple([out_spec] * len(partial_state_names(agg_op))),
     )
     return jax.jit(fn)
 
@@ -238,12 +270,11 @@ class MeshEngine:
         pad = steps[-1] + step * np.arange(1, Tp - T + 1)
         return np.concatenate([steps, pad]), T
 
-    def window_aggregate(self, shard_batches: Sequence[ChunkBatch],
-                         group_ids: Sequence[np.ndarray], num_groups: int,
-                         srange: StepRange, window_ms: int,
-                         range_fn=None, agg_op: Agg = Agg.SUM,
-                         extra_args: tuple = ()) -> np.ndarray:
-        """Full distributed pipeline -> [num_groups, T] on host."""
+    def _prepare(self, shard_batches, group_ids, srange: StepRange,
+                 window_ms: int, range_fn):
+        """Shared input prep: stack + flatten shards, pad steps, derive
+        wmax, place onto the mesh.  Returns (d_ts, d_vals, d_ids,
+        d_steps, wmax, T)."""
         ts, vals, ids = self.stack_shards(shard_batches, group_ids)
         K, S, R = ts.shape
         ts = ts.reshape(K * S, R)
@@ -251,17 +282,62 @@ class MeshEngine:
         ids = ids.reshape(K * S)
         steps_np = np.asarray(srange.timestamps(np.int64))
         steps_np, T = self.pad_steps(steps_np)
-
         wmax = 0
         if rangefns.kernel_kind(range_fn) == "gather":
             wmax = rangefns.bucket_wmax(ts, steps_np, window_ms)
+        return (self._place(ts, P("shard", None)),
+                self._place(vals, P("shard", None)),
+                self._place(ids, P("shard")),
+                self._place(steps_np, P("step")), wmax, T)
 
-        d_ts = self._place(ts, P("shard", None))
-        d_vals = self._place(vals, P("shard", None))
-        d_ids = self._place(ids, P("shard"))
-        d_steps = self._place(steps_np, P("step"))
-
+    def window_aggregate(self, shard_batches: Sequence[ChunkBatch],
+                         group_ids: Sequence[np.ndarray], num_groups: int,
+                         srange: StepRange, window_ms: int,
+                         range_fn=None, agg_op: Agg = Agg.SUM,
+                         extra_args: tuple = ()) -> np.ndarray:
+        """Full distributed pipeline -> [num_groups, T] on host."""
+        d_ts, d_vals, d_ids, d_steps, wmax, T = self._prepare(
+            shard_batches, group_ids, srange, window_ms, range_fn)
         prog = _build_program(self._key, range_fn, agg_op, num_groups,
                               window_ms, wmax, extra_args)
         out = prog(d_ts, d_vals, d_ids, d_steps)
         return np.asarray(out)[:, :T]
+
+    def window_aggregate_partials(self, shard_batches, group_ids,
+                                  num_groups: int, srange: StepRange,
+                                  window_ms: int, range_fn=None,
+                                  agg_op: Agg = Agg.SUM,
+                                  extra_args: tuple = ()) -> dict:
+        """Like :meth:`window_aggregate` but returns the MERGEABLE partial
+        state dict ({"sum": [G,T], "count": [G,T]}, ...) instead of the
+        presented values — the form the host-side ReduceAggregateExec
+        merges with partials from remote (HTTP-dispatched) shards."""
+        d_ts, d_vals, d_ids, d_steps, wmax, T = self._prepare(
+            shard_batches, group_ids, srange, window_ms, range_fn)
+        prog = _build_program(self._key, range_fn, agg_op, num_groups,
+                              window_ms, wmax, extra_args, present=False)
+        outs = prog(d_ts, d_vals, d_ids, d_steps)
+        names = partial_state_names(agg_op)
+        export = set(exported_state_names(agg_op))
+        state = {}
+        for name, arr in zip(names, outs):
+            if name not in export:
+                continue
+            a = np.asarray(arr)[:, :T]
+            if name in ("min", "max"):
+                # the device kernels use +/-inf fill for empty cells; host
+                # reduce (np.nanmin/nanmax) expects NaN
+                a = np.where(np.isfinite(a), a, np.nan)
+            state[name] = a
+        return state
+
+
+_DEFAULT_ENGINE: Optional["MeshEngine"] = None
+
+
+def default_engine() -> "MeshEngine":
+    """Process-wide engine over all visible devices (shard axis)."""
+    global _DEFAULT_ENGINE
+    if _DEFAULT_ENGINE is None:
+        _DEFAULT_ENGINE = MeshEngine(make_mesh())
+    return _DEFAULT_ENGINE

@@ -1,0 +1,124 @@
+"""MeshAggregateExec: the planner's ICI-collective serving path.
+
+Fuses every LOCAL shard's leaf pipeline of an aggregate query —
+scan -> window -> per-shard aggregate -> cross-shard reduce — into ONE
+SPMD mesh program (parallel/mesh.py), replacing N per-shard ExecPlan
+children + host-side reduce with device collectives riding ICI
+(reference: the scatter-gather tree of SingleClusterPlanner.scala:223-258
++ ReduceAggregateExec, collapsed into lax.psum/pmin/pmax).
+
+The node emits the same mergeable AggPartialBatch the per-shard path
+produces, so it composes under ReduceAggregateExec next to REMOTE
+shards' HTTP-dispatched partials — one cluster query can mix both data
+planes, exactly like the reference mixes local and remote children.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from filodb_tpu.core.filters import ColumnFilter
+from filodb_tpu.ops.windows import StepRange
+from filodb_tpu.query import rangefns
+from filodb_tpu.query.aggregators import AggPartialBatch, grouping_key
+from filodb_tpu.query.exec import ExecContext, ExecPlan
+from filodb_tpu.query.logical import (AggregationOperator, RangeFunctionId)
+from filodb_tpu.query.model import QueryContext
+
+# aggregates with a distributive psum/pmin/pmax form (mesh.partial_state_names)
+MESH_OPS = (AggregationOperator.SUM, AggregationOperator.COUNT,
+            AggregationOperator.AVG, AggregationOperator.MIN,
+            AggregationOperator.MAX, AggregationOperator.STDDEV,
+            AggregationOperator.STDVAR)
+
+
+def mesh_supported(operator: AggregationOperator,
+                   function: Optional[RangeFunctionId],
+                   params: tuple) -> bool:
+    return (operator in MESH_OPS and not params
+            and rangefns.supported(function, hist=False))
+
+
+class MeshAggregateExec(ExecPlan):
+    """All local shards of one windowed aggregate as one mesh program."""
+
+    def __init__(self, dataset: str, shards: Sequence[int],
+                 filters: Sequence[ColumnFilter], scan_start_ms: int,
+                 scan_end_ms: int, start_ms: int, step_ms: int, end_ms: int,
+                 operator: AggregationOperator,
+                 window_ms: Optional[int] = None,
+                 function: Optional[RangeFunctionId] = None,
+                 function_args: tuple = (), offset_ms: int = 0,
+                 by: tuple = (), without: tuple = (),
+                 stale_ms: int = 300_000,
+                 query_context: Optional[QueryContext] = None,
+                 engine=None):
+        super().__init__(query_context)
+        self.dataset = dataset
+        self.shards = list(shards)
+        self.filters = list(filters)
+        self.scan_start_ms = scan_start_ms
+        self.scan_end_ms = scan_end_ms
+        self.start_ms = start_ms
+        self.step_ms = step_ms
+        self.end_ms = end_ms
+        self.operator = operator
+        self.window_ms = window_ms
+        self.function = function
+        self.function_args = tuple(function_args)
+        self.offset_ms = offset_ms
+        self.by = tuple(by)
+        self.without = tuple(without)
+        self.stale_ms = stale_ms
+        self._engine = engine
+
+    def _args_str(self):
+        return (f"dataset={self.dataset}, shards={self.shards}, "
+                f"op={self.operator.name}, fn="
+                f"{self.function.name if self.function else None}")
+
+    def do_execute(self, ctx: ExecContext) -> list:
+        from filodb_tpu.parallel import mesh as meshmod
+
+        engine = self._engine or meshmod.default_engine()
+        steps = StepRange(self.start_ms - self.offset_ms,
+                          self.end_ms - self.offset_ms, self.step_ms)
+        window = self.window_ms if self.window_ms else self.stale_ms
+        union: dict[tuple, int] = {}
+        shard_batches = []
+        group_ids = []
+        for shard_num in self.shards:
+            shard = ctx.memstore.get_shard(self.dataset, shard_num)
+            lookup = shard.lookup_partitions(self.filters,
+                                             self.scan_start_ms,
+                                             self.scan_end_ms)
+            if len(lookup.part_ids) == 0:
+                continue
+            tags_list, batch = shard.scan_batch(
+                lookup.part_ids, self.scan_start_ms, self.scan_end_ms)
+            if batch is None or batch.hist is not None:
+                continue
+            gids = np.empty(len(tags_list), dtype=np.int32)
+            for i, tags in enumerate(tags_list):
+                key = tuple(sorted(grouping_key(tags, self.by,
+                                                self.without).items()))
+                gids[i] = union.setdefault(key, len(union))
+            shard_batches.append(batch)
+            group_ids.append(gids)
+        if not shard_batches:
+            return []
+        limit = ctx.query_context.group_by_cardinality_limit
+        if len(union) > limit:
+            from filodb_tpu.query.model import QueryError
+            raise QueryError(self.query_context.query_id,
+                             f"group-by cardinality {len(union)} exceeds "
+                             f"limit {limit}")
+        state = engine.window_aggregate_partials(
+            shard_batches, group_ids, max(len(union), 1), steps, window,
+            range_fn=self.function, agg_op=self.operator,
+            extra_args=self.function_args)
+        report = StepRange(self.start_ms, self.end_ms, self.step_ms)
+        keys = [dict(k) for k in union]
+        return [AggPartialBatch(self.operator, (), keys, report, state)]

@@ -81,6 +81,14 @@ class FiloServer:
         self._global_gateway_claimed = False
         self._started = threading.Event()
 
+    @staticmethod
+    def _device_count() -> int:
+        try:
+            import jax
+            return jax.local_device_count()
+        except Exception:  # noqa: BLE001 — no backend: host-only serving
+            return 1
+
     def _running_shards(self, dataset: str) -> list[int]:
         ic = self.coordinator.ingestion.get(dataset)
         return ic.running_shards() if ic is not None else []
@@ -108,9 +116,12 @@ class FiloServer:
             # cross-node status gossip + automatic failover (reference:
             # StatusActor/ShardMapper snapshots + Akka failure detector)
             def resync_all():
+                from filodb_tpu.parallel.shardmap import ShardStatus
                 for ds in self.manager.datasets():
-                    shards = self.manager.mapper(ds).shards_for_node(
-                        self.node)
+                    m = self.manager.mapper(ds)
+                    shards = [s for s in m.shards_for_node(self.node)
+                              if m.status(s) not in (ShardStatus.STOPPED,
+                                                     ShardStatus.DOWN)]
                     self.coordinator.resync(ds, shards)
 
             self.status_poller = StatusPoller(
@@ -173,9 +184,18 @@ class FiloServer:
         if peers:
             from filodb_tpu.coordinator.dispatch import dispatcher_factory
             disp = dispatcher_factory(mapper, peers, local_node=self.node)
+        # ICI-collective serving: fuse local multi-shard aggregates into
+        # one SPMD mesh program.  Auto-on when >1 device is visible
+        # (multi-chip); override per dataset with "mesh": true/false.
+        mesh_conf = ds_conf.get("mesh")
+        mesh_provider = None
+        if mesh_conf or (mesh_conf is None and self._device_count() > 1):
+            from filodb_tpu.parallel.mesh import default_engine
+            mesh_provider = default_engine
         planner = SingleClusterPlanner(name, mapper, DatasetOptions(),
                                        spread_default=spread,
-                                       dispatcher_for_shard=disp)
+                                       dispatcher_for_shard=disp,
+                                       mesh_engine_provider=mesh_provider)
         schema = DEFAULT_SCHEMAS[ds_conf.get("schema", "gauge")]
         if broker_producer is not None:
             publish = broker_producer.publish

@@ -1,0 +1,163 @@
+"""MeshAggregateExec: the fused ICI-collective serving path must be
+observably identical to the per-shard scatter-gather path (reference
+semantics: SingleClusterPlanner.scala:223-258 reduce tree == one psum).
+
+Runs on the 8-device virtual CPU mesh from tests/conftest.py.
+"""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.core.record import RecordBuilder, partition_hash, \
+    shard_key_hash
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.parallel.mesh import MeshEngine, make_mesh
+from filodb_tpu.parallel.shardmap import ShardMapper
+from filodb_tpu.promql.parser import query_range_to_logical_plan
+from filodb_tpu.query.exec import ExecContext, IN_PROCESS
+from filodb_tpu.query.model import QueryContext
+
+BASE = 1_700_000_000_000
+NUM_SHARDS = 4
+N_SERIES = 24
+N_ROWS = 120
+STEP = 10_000
+
+
+@pytest.fixture(scope="module")
+def loaded():
+    ms = TimeSeriesMemStore()
+    opts = DatasetOptions()
+    mapper = ShardMapper(NUM_SHARDS)
+    for s in range(NUM_SHARDS):
+        ms.setup("prom", DEFAULT_SCHEMAS, s)
+    rng = np.random.default_rng(11)
+    for i in range(N_SERIES):
+        tags = {"_metric_": "mm", "inst": f"i{i}", "grp": f"g{i % 3}",
+                "_ws_": "w", "_ns_": "n"}
+        shard = mapper.ingestion_shard(shard_key_hash(tags, opts),
+                                       partition_hash(tags, opts),
+                                       2) % NUM_SHARDS
+        b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], opts,
+                          container_size=1 << 20)
+        ts = BASE + np.arange(N_ROWS) * STEP
+        vals = np.cumsum(rng.random(N_ROWS))
+        b.add_series(ts.tolist(), [vals.tolist()], tags)
+        for off, c in enumerate(b.containers()):
+            ms.get_shard("prom", shard).ingest_container(c, off)
+    return ms, mapper
+
+
+def _planner(mapper, mesh=False, dispatcher_for_shard=None):
+    provider = None
+    if mesh:
+        engine = MeshEngine(make_mesh())
+        provider = lambda: engine  # noqa: E731
+    return SingleClusterPlanner("prom", mapper, DatasetOptions(),
+                                spread_default=2,
+                                dispatcher_for_shard=dispatcher_for_shard,
+                                mesh_engine_provider=provider)
+
+
+def _run(planner, ms, promql, start, end, step=30_000):
+    plan = query_range_to_logical_plan(promql, start, step, end)
+    ep = planner.materialize(plan, QueryContext())
+    result = ep.execute(ExecContext(ms, QueryContext()))
+    out = {}
+    for b in result.batches:
+        for tags, ts, vals in b.to_series():
+            out[tuple(sorted(tags.items()))] = (np.asarray(ts),
+                                                np.asarray(vals))
+    return out
+
+
+QUERIES = [
+    'sum(rate(mm{_ws_="w",_ns_="n"}[2m]))',
+    'count(mm{_ws_="w",_ns_="n"})',
+    'avg by (grp)(mm{_ws_="w",_ns_="n"})',
+    'max(rate(mm{_ws_="w",_ns_="n"}[2m]))',
+    'min by (grp)(mm{_ws_="w",_ns_="n"})',
+    'stddev(mm{_ws_="w",_ns_="n"})',
+    'sum by (grp)(increase(mm{_ws_="w",_ns_="n"}[2m]))',
+]
+
+
+class TestMeshPathEquivalence:
+    @pytest.mark.parametrize("promql", QUERIES)
+    def test_matches_per_shard_path(self, loaded, promql):
+        ms, mapper = loaded
+        start = BASE + 300_000
+        end = BASE + 900_000
+        plain = _run(_planner(mapper), ms, promql, start, end)
+        fused = _run(_planner(mapper, mesh=True), ms, promql, start, end)
+        assert set(fused) == set(plain)
+        for k in plain:
+            np.testing.assert_array_equal(fused[k][0], plain[k][0])
+            np.testing.assert_allclose(fused[k][1], plain[k][1],
+                                       rtol=1e-9, atol=1e-9,
+                                       equal_nan=True, err_msg=str(k))
+
+    def test_plan_shape_uses_mesh_node(self, loaded):
+        ms, mapper = loaded
+        planner = _planner(mapper, mesh=True)
+        plan = query_range_to_logical_plan(
+            'sum(rate(mm{_ws_="w",_ns_="n"}[2m]))',
+            BASE + 300_000, 30_000, BASE + 900_000)
+        tree = planner.materialize(plan, QueryContext()).print_tree()
+        assert "MeshAggregateExec" in tree
+        assert "MultiSchemaPartitionsExec" not in tree  # all shards local
+
+    @pytest.mark.parametrize("promql", [
+        QUERIES[0],                           # sum(rate(...))
+        'count(mm{_ws_="w",_ns_="n"})',       # COUNT exports only "count"
+        'stddev(mm{_ws_="w",_ns_="n"})',
+        'max by (grp)(mm{_ws_="w",_ns_="n"})',
+    ])
+    def test_mixed_local_remote(self, loaded, promql):
+        """Shards behind a non-in-process dispatcher stay per-shard
+        children; their partials merge with the mesh partial — the state
+        keys must line up for every operator."""
+        ms, mapper = loaded
+
+        class LoopbackDispatcher:
+            """Not IN_PROCESS identity-wise, but executes locally."""
+
+            def dispatch(self, plan, ctx):
+                return plan.execute(ctx)
+
+        lb = LoopbackDispatcher()
+
+        def disp(shard):
+            return lb if shard == 3 else IN_PROCESS
+
+        plain = _run(_planner(mapper), ms, promql,
+                     BASE + 300_000, BASE + 900_000)
+        mixed_planner = _planner(mapper, mesh=True,
+                                 dispatcher_for_shard=disp)
+        plan = query_range_to_logical_plan(
+            promql, BASE + 300_000, 30_000, BASE + 900_000)
+        ep = mixed_planner.materialize(plan, QueryContext())
+        tree = ep.print_tree()
+        assert "MeshAggregateExec" in tree
+        assert "MultiSchemaPartitionsExec" in tree  # the remote shard
+        result = ep.execute(ExecContext(ms, QueryContext()))
+        out = {}
+        for b in result.batches:
+            for tags, ts, vals in b.to_series():
+                out[tuple(sorted(tags.items()))] = np.asarray(vals)
+        assert set(out) == set(plain)
+        for k in plain:
+            np.testing.assert_allclose(out[k], plain[k][1],
+                                       rtol=1e-9, equal_nan=True)
+
+    def test_single_local_shard_stays_per_shard(self, loaded):
+        ms, mapper = loaded
+        planner = _planner(mapper, mesh=True)
+        planner.spread_default = 0  # one shard per shard key
+        plan = query_range_to_logical_plan(
+            'sum(mm{_ws_="w",_ns_="n"})', BASE + 300_000, 30_000,
+            BASE + 600_000)
+        tree = planner.materialize(plan, QueryContext()).print_tree()
+        assert "MeshAggregateExec" not in tree
