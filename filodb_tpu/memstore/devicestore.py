@@ -45,6 +45,61 @@ _LANE_PAD = 128
 _I32_SPAN = 2**31 - 2
 
 
+_ONEHOT_MAX_G = 2048  # one-hot matmul reduce beyond this costs too much VMEM
+
+
+def _grouped_reduce_impl(stepped, garr, num_groups, op):
+    """Device-side segment reduce of the grid kernel's [T, lanes] output:
+    only [G, T] partials ever cross the host link.  ``garr`` maps lane ->
+    group (num_groups = drop bucket for unrequested/padding lanes).
+
+    For sum/count at modest G the reduce is a one-hot matmul so it runs
+    on the MXU — TPU scatter-adds (segment_sum) serialize and dominate
+    the served latency otherwise."""
+    import jax
+    import jax.numpy as jnp
+
+    from filodb_tpu.ops import aggregate as segops
+
+    v = stepped.T                                # [lanes, T]
+    G = num_groups
+    if op in ("sum", "avg", "count"):
+        fin = jnp.isfinite(v)
+        vz = jnp.where(fin, v, 0.0)
+        fz = fin.astype(v.dtype)
+        if G + 1 <= _ONEHOT_MAX_G:
+            onehot = (garr[:, None] ==
+                      jnp.arange(G, dtype=garr.dtype)[None, :]
+                      ).astype(v.dtype)          # [lanes, G]
+            # HIGHEST precision: the TPU default truncates f32 matmul
+            # inputs to bf16, which would make fused sums diverge from
+            # the host segment-sum path by up to ~0.4%
+            hp = jax.lax.Precision.HIGHEST
+            s = jnp.matmul(onehot.T, vz, precision=hp)   # MXU: [G, T]
+            c = jnp.matmul(onehot.T, fz, precision=hp)
+        else:
+            s = jax.ops.segment_sum(vz, garr, G + 1)[:G]
+            c = jax.ops.segment_sum(fz, garr, G + 1)[:G]
+        return jnp.stack([s, c])                 # one readback downstream
+    if op == "min":
+        return segops.seg_min(v, garr, G + 1)[:G]
+    if op == "max":
+        return segops.seg_max(v, garr, G + 1)[:G]
+    raise ValueError(f"unsupported grouped op {op}")
+
+
+_grouped_reduce_jit = None
+
+
+def _grouped_reduce(stepped, garr, num_groups: int, op: str):
+    global _grouped_reduce_jit
+    if _grouped_reduce_jit is None:
+        import jax
+        _grouped_reduce_jit = jax.jit(
+            _grouped_reduce_impl, static_argnames=("num_groups", "op"))
+    return _grouped_reduce_jit(stepped, garr, num_groups, op)
+
+
 class _Block:
     """One resident time block: device arrays [BLOCK_BUCKETS, lanes]."""
 
@@ -151,8 +206,56 @@ class DeviceGridCache:
             return self._scan_rate_locked(list(map(int, part_ids)), func,
                                           steps0, nsteps, step_ms, window_ms)
 
+    def scan_rate_grouped(self, part_ids: Sequence[int], func: F,
+                          steps0: int, nsteps: int, step_ms: int,
+                          window_ms: int, group_ids: Sequence[int],
+                          num_groups: int, op: str = "sum"):
+        """Fused serve of ``agg by (g)(rate(...))``: the grid kernel's
+        [T, lanes] output is segment-reduced ON DEVICE, so only the tiny
+        [G, T] partials cross the host link (the full per-series matrix
+        readback + re-upload otherwise dominates served latency on a
+        tunnel-attached device).  Returns the mergeable partial state
+        dict ({"sum","count"} / {"min"} / {"max"}) or None to fall back."""
+        if func not in (F.RATE, F.INCREASE):
+            return None
+        with self._lock:
+            ids = list(map(int, part_ids))
+            got = self._stepped_device(ids, func, steps0, nsteps, step_ms,
+                                       window_ms)
+            if got is None:
+                return None
+            stepped, lanes = got
+            garr = np.full(lanes, num_groups, dtype=np.int32)
+            lane_idx = np.fromiter((self.lane_of[p] for p in ids),
+                                   dtype=np.int64, count=len(ids))
+            garr[lane_idx] = np.asarray(group_ids, dtype=np.int32)
+        import jax.numpy as jnp
+        out = _grouped_reduce(stepped, jnp.asarray(garr), num_groups, op)
+        if op in ("sum", "avg", "count"):
+            # ONE host readback of the stacked [2, G, T]: each blocked
+            # transfer pays the tunnel round-trip
+            both = np.asarray(out, dtype=np.float64)
+            if op == "count":
+                return {"count": both[1]}
+            return {"sum": both[0], "count": both[1]}
+        return {op: np.asarray(out, dtype=np.float64)}
+
     def _scan_rate_locked(self, part_ids, func, steps0, nsteps, step_ms,
                           window_ms):
+        got = self._stepped_device(part_ids, func, steps0, nsteps, step_ms,
+                                   window_ms)
+        if got is None:
+            return None
+        stepped, _lanes = got
+        out_np = np.asarray(stepped)
+        lanes_req = [self.lane_of[pid] for pid in part_ids]
+        return out_np[:, lanes_req].T                     # [S_req, T]
+
+    def _stepped_device(self, part_ids, func, steps0, nsteps, step_ms,
+                        window_ms):
+        """Shared grid pipeline: block assembly + fused kernel; returns
+        the ON-DEVICE stepped [T, lanes] array (no readback) + lane
+        count, or None to fall back."""
         shard = self._shard
         parts = []
         for pid in part_ids:
@@ -237,9 +340,7 @@ class DeviceGridCache:
         out = rate_grid_auto(ts_sl, val_sl, steps0 - self.epoch0, q,
                              lanes=lane_mult)            # [T, lanes]
         self.hits += 1
-        out_np = np.asarray(out)
-        lanes_req = [self.lane_of[pid] for pid in part_ids]
-        return out_np[:, lanes_req].T                     # [S_req, T]
+        return out, int(ts_sl.shape[1])
 
     # ---------------------------------------------------------------- blocks
 

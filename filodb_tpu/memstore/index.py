@@ -31,6 +31,9 @@ class PartKeyIndex:
         self._partkeys: dict[int, bytes] = {}
         self._start: dict[int, int] = {}
         self._end: dict[int, int] = {}
+        # monotone mutation counter: lookup caches key on it so repeated
+        # dashboard filters skip the postings walk until the index changes
+        self.version = 0
 
     def __len__(self) -> int:
         return len(self._tags)
@@ -39,6 +42,7 @@ class PartKeyIndex:
 
     def add_partkey(self, part_id: int, partkey: bytes, tags: dict[str, str],
                     start_time: int, end_time: int = _NO_END) -> None:
+        self.version += 1
         self._tags[part_id] = tags
         self._partkeys[part_id] = partkey
         self._start[part_id] = start_time
@@ -49,12 +53,17 @@ class PartKeyIndex:
     def update_end_time(self, part_id: int, end_time: int) -> None:
         """Marks a series stopped (reference: updatePartKeyWithEndTime, used
         by flush step updateIndexWithEndTime and by eviction ordering)."""
+        if self._end.get(part_id) != end_time:
+            self.version += 1
         self._end[part_id] = end_time
 
     def mark_active(self, part_id: int) -> None:
+        if self._end.get(part_id) != _NO_END:
+            self.version += 1
         self._end[part_id] = _NO_END
 
     def remove(self, part_ids: Iterable[int]) -> None:
+        self.version += 1
         for pid in part_ids:
             tags = self._tags.pop(pid, None)
             if tags is None:

@@ -90,6 +90,7 @@ class TimeSeriesShard:
         self.store = column_store or NullColumnStore()
         self.meta = meta_store or InMemoryMetaStore()
         self.index = PartKeyIndex()
+        self._lookup_cache: dict = {}
         self.partitions: dict[int, TimeSeriesPartition] = {}
         self.part_set: dict[bytes, int] = {}
         # part id -> 16-bit schema hash; covers index-only (evicted /
@@ -427,7 +428,27 @@ class TimeSeriesShard:
         reference's MultiSchemaPartitionsExec runtime schema discovery
         (exec/MultiSchemaPartitionsExec.scala:41-85).  Ids whose partitions
         are not in memory surface as ``missing_partkeys`` for on-demand
-        paging."""
+        paging.
+
+        Repeated dashboard lookups are cached keyed on (filters, range,
+        index version): at 100k+ series the postings walk dominates served
+        query latency otherwise."""
+        # len(partitions) covers re-materialization of index-only entries
+        # (which may not bump the index version); eviction bumps it.
+        key = (tuple(filters), start_time, end_time, limit,
+               self.index.version, len(self.partitions))
+        cached = self._lookup_cache.get(key)
+        if cached is not None:
+            return cached
+        result = self._lookup_partitions_uncached(filters, start_time,
+                                                  end_time, limit)
+        if len(self._lookup_cache) > 64:
+            self._lookup_cache.clear()
+        self._lookup_cache[key] = result
+        return result
+
+    def _lookup_partitions_uncached(self, filters, start_time, end_time,
+                                    limit) -> PartLookupResult:
         ids = self.index.part_ids_from_filters(filters, start_time, end_time,
                                                limit)
         first_schema = None
@@ -469,15 +490,11 @@ class TimeSeriesShard:
             self.device_caches[(schema_hash, column_id)] = cache
         return cache
 
-    def scan_grid(self, part_ids: Sequence[int], func, steps0: int,
-                  nsteps: int, step_ms: int, window_ms: int,
-                  column_id: Optional[int] = None):
-        """Serve a windowed range function directly from the device-resident
-        grid (memstore/devicestore.py).  Returns ``(tags_list, vals[S, T])``
-        or None when the fast path cannot serve this query — the caller then
-        uses :meth:`scan_batch` + the general kernels.  This is the serving
-        seam the reference places at block memory (queries read encoded
-        chunks straight from BlockManager memory, never re-copying them)."""
+    def _grid_cache_for(self, part_ids: Sequence[int],
+                        column_id: Optional[int]):
+        """Shared grid-eligibility preamble: resolve the value column off
+        the first partition, require a DOUBLE column, fetch the cache.
+        Returns (cache, ids) or None to fall back."""
         ids = [int(p) for p in part_ids]
         if not ids:
             return None
@@ -488,7 +505,21 @@ class TimeSeriesShard:
             else column_id
         if first.schema.data.columns[cid].ctype != ColumnType.DOUBLE:
             return None
-        cache = self.device_cache(first.schema.schema_hash, cid)
+        return self.device_cache(first.schema.schema_hash, cid), ids
+
+    def scan_grid(self, part_ids: Sequence[int], func, steps0: int,
+                  nsteps: int, step_ms: int, window_ms: int,
+                  column_id: Optional[int] = None):
+        """Serve a windowed range function directly from the device-resident
+        grid (memstore/devicestore.py).  Returns ``(tags_list, vals[S, T])``
+        or None when the fast path cannot serve this query — the caller then
+        uses :meth:`scan_batch` + the general kernels.  This is the serving
+        seam the reference places at block memory (queries read encoded
+        chunks straight from BlockManager memory, never re-copying them)."""
+        got = self._grid_cache_for(part_ids, column_id)
+        if got is None:
+            return None
+        cache, ids = got
         vals = cache.scan_rate(ids, func, steps0, nsteps, step_ms, window_ms)
         if vals is None:
             return None
@@ -499,6 +530,21 @@ class TimeSeriesShard:
                 return None   # concurrently evicted mid-query: fall back
             tags_list.append(part.tags)
         return tags_list, vals
+
+    def scan_grid_grouped(self, part_ids: Sequence[int], func, steps0: int,
+                          nsteps: int, step_ms: int, window_ms: int,
+                          group_ids: Sequence[int], num_groups: int,
+                          op: str, column_id: Optional[int] = None):
+        """Fused ``agg by (g)(rate(...))`` from the device grid: the
+        aggregation happens on device, so only [G, T] partials come back
+        (see DeviceGridCache.scan_rate_grouped).  Returns the mergeable
+        state dict or None to fall back."""
+        got = self._grid_cache_for(part_ids, column_id)
+        if got is None:
+            return None
+        cache, ids = got
+        return cache.scan_rate_grouped(ids, func, steps0, nsteps, step_ms,
+                                       window_ms, group_ids, num_groups, op)
 
     def scan_batch(self, part_ids: Sequence[int], start_time: int, end_time: int,
                    column_id: Optional[int] = None

@@ -44,8 +44,15 @@ class StepRange(NamedTuple):
     def num_steps(self) -> int:
         return (self.end - self.start) // self.step + 1
 
-    def timestamps(self, dtype=jnp.int64) -> jnp.ndarray:
-        return (jnp.arange(self.num_steps, dtype=dtype) * self.step + self.start)
+    def timestamps(self, dtype=None):
+        """Host-side epoch-ms step grid as numpy int64.  Always numpy:
+        epoch milliseconds overflow int32, and with jax_enable_x64 off a
+        jnp array would silently truncate (device consumers rebase to
+        small offsets before upload)."""
+        import numpy as _np
+        out = (_np.arange(self.num_steps, dtype=_np.int64) * self.step
+               + _np.int64(self.start))
+        return out if dtype is None else out.astype(dtype)
 
 
 def window_bounds(ts: jnp.ndarray, steps: jnp.ndarray, window) -> tuple[jnp.ndarray, jnp.ndarray]:

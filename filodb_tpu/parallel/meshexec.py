@@ -89,6 +89,7 @@ class MeshAggregateExec(ExecPlan):
         union: dict[tuple, int] = {}
         shard_batches = []
         group_ids = []
+        host_partials: list = []
         for shard_num in self.shards:
             shard = ctx.memstore.get_shard(self.dataset, shard_num)
             lookup = shard.lookup_partitions(self.filters,
@@ -98,7 +99,14 @@ class MeshAggregateExec(ExecPlan):
                 continue
             tags_list, batch = shard.scan_batch(
                 lookup.part_ids, self.scan_start_ms, self.scan_end_ms)
-            if batch is None or batch.hist is not None:
+            if batch is None:
+                continue                    # genuinely empty range
+            if batch.hist is not None:
+                # mesh program is scalar-only: this shard's histogram
+                # data must NOT be dropped — run the per-shard host path
+                # and merge its partial with the mesh partial below
+                host_partials.extend(self._host_shard_partial(ctx,
+                                                              shard_num))
                 continue
             gids = np.empty(len(tags_list), dtype=np.int32)
             for i, tags in enumerate(tags_list):
@@ -107,7 +115,7 @@ class MeshAggregateExec(ExecPlan):
                 gids[i] = union.setdefault(key, len(union))
             shard_batches.append(batch)
             group_ids.append(gids)
-        if not shard_batches:
+        if not shard_batches and not host_partials:
             return []
         limit = ctx.query_context.group_by_cardinality_limit
         if len(union) > limit:
@@ -115,10 +123,32 @@ class MeshAggregateExec(ExecPlan):
             raise QueryError(self.query_context.query_id,
                              f"group-by cardinality {len(union)} exceeds "
                              f"limit {limit}")
-        state = engine.window_aggregate_partials(
-            shard_batches, group_ids, max(len(union), 1), steps, window,
-            range_fn=self.function, agg_op=self.operator,
-            extra_args=self.function_args)
-        report = StepRange(self.start_ms, self.end_ms, self.step_ms)
-        keys = [dict(k) for k in union]
-        return [AggPartialBatch(self.operator, (), keys, report, state)]
+        out: list = list(host_partials)
+        if shard_batches:
+            state = engine.window_aggregate_partials(
+                shard_batches, group_ids, max(len(union), 1), steps,
+                window, range_fn=self.function, agg_op=self.operator,
+                extra_args=self.function_args)
+            report = StepRange(self.start_ms, self.end_ms, self.step_ms)
+            keys = [dict(k) for k in union]
+            out.append(AggPartialBatch(self.operator, (), keys, report,
+                                       state))
+        return out
+
+    def _host_shard_partial(self, ctx: ExecContext, shard_num: int) -> list:
+        """Per-shard host pipeline for data the mesh program can't take
+        (histogram value columns): leaf scan + PeriodicSamplesMapper +
+        AggregateMapReduce, exactly the non-mesh plan shape."""
+        from filodb_tpu.query.exec import MultiSchemaPartitionsExec
+        from filodb_tpu.query.transformers import (AggregateMapReduce,
+                                                   PeriodicSamplesMapper)
+        leaf = MultiSchemaPartitionsExec(
+            self.dataset, shard_num, self.filters, self.scan_start_ms,
+            self.scan_end_ms, query_context=self.query_context)
+        leaf.add_transformer(PeriodicSamplesMapper(
+            self.start_ms, self.step_ms, self.end_ms,
+            window_ms=self.window_ms, function=self.function,
+            function_args=self.function_args, offset_ms=self.offset_ms))
+        leaf.add_transformer(AggregateMapReduce(
+            self.operator, (), self.by, self.without))
+        return list(leaf.execute(ctx).batches)

@@ -94,6 +94,15 @@ class ShardMapper:
     def shards_for_node(self, node: str) -> list[int]:
         return [i for i, st in enumerate(self._states) if st.node == node]
 
+    def runnable_shards_for_node(self, node: str) -> list[int]:
+        """Shards this node should actually be ingesting: assigned to it
+        and not held in an operator STOPPED / leader DOWN state (the one
+        place this exclusion policy lives — resync and self-heal both
+        consult it)."""
+        return [i for i, st in enumerate(self._states)
+                if st.node == node and st.status not in
+                (ShardStatus.STOPPED, ShardStatus.DOWN)]
+
     @property
     def num_assigned(self) -> int:
         return sum(1 for st in self._states

@@ -192,12 +192,19 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                                        self.end_ms, column_id)
         return [RawBatch(tags, batch)]
 
+    _GRID_AGG_OPS = {"SUM": "sum", "COUNT": "count", "AVG": "avg",
+                     "MIN": "min", "MAX": "max"}
+
     def _try_device_grid(self, shard, part_ids, column_id):
         """Serve leaf + PeriodicSamplesMapper straight from the shard's
         device-resident grid (memstore/devicestore.py) when the first
         transformer is an eligible windowed rate/increase.  Emits the
-        already-stepped PeriodicBatch; the mapper passes it through."""
-        from filodb_tpu.query.transformers import PeriodicSamplesMapper
+        already-stepped PeriodicBatch; the mapper passes it through.
+        When an AggregateMapReduce follows the mapper, the aggregation
+        is fused ON DEVICE too: only [G, T] partials cross the host
+        link, which dominates served latency on tunnel-attached TPUs."""
+        from filodb_tpu.query.transformers import (AggregateMapReduce,
+                                                   PeriodicSamplesMapper)
         if not self.transformers or len(part_ids) == 0:
             return None
         mapper = self.transformers[0]
@@ -207,14 +214,50 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             return None
         steps = StepRange(mapper.start_ms - mapper.offset_ms,
                           mapper.end_ms - mapper.offset_ms, mapper.step_ms)
+        report = StepRange(mapper.start_ms, mapper.end_ms, mapper.step_ms)
+        mapred = self.transformers[1] if len(self.transformers) > 1 else None
+        if isinstance(mapred, AggregateMapReduce) and not mapred.params \
+                and mapred.operator.name in self._GRID_AGG_OPS:
+            served = self._try_grid_aggregated(shard, part_ids, column_id,
+                                               mapper, mapred, steps, report)
+            if served is not None:
+                return served
         got = shard.scan_grid(part_ids, mapper.function, steps.start,
                               steps.num_steps, steps.step, mapper.window_ms,
                               column_id)
         if got is None:
             return None
         tags, vals = got
-        report = StepRange(mapper.start_ms, mapper.end_ms, mapper.step_ms)
         return [PeriodicBatch(tags, report, vals)]
+
+    def _try_grid_aggregated(self, shard, part_ids, column_id, mapper,
+                             mapred, steps, report):
+        from filodb_tpu.query.aggregators import (AggPartialBatch,
+                                                  grouping_key)
+        union: dict[tuple, int] = {}
+        if not mapred.by and not mapred.without:
+            # global aggregate: one group, skip the per-series key walk
+            union[()] = 0
+            gids = [0] * len(part_ids)
+            if any(shard.partitions.get(int(p)) is None for p in part_ids):
+                return None
+        else:
+            gids = []
+            for pid in part_ids:
+                part = shard.partitions.get(int(pid))
+                if part is None:
+                    return None
+                key = tuple(sorted(grouping_key(part.tags, mapred.by,
+                                                mapred.without).items()))
+                gids.append(union.setdefault(key, len(union)))
+        state = shard.scan_grid_grouped(
+            part_ids, mapper.function, steps.start, steps.num_steps,
+            steps.step, mapper.window_ms, gids, max(len(union), 1),
+            self._GRID_AGG_OPS[mapred.operator.name], column_id)
+        if state is None:
+            return None
+        return [AggPartialBatch(mapred.operator, (),
+                                [dict(k) for k in union], report, state)]
 
     def _args_str(self) -> str:
         return f"dataset={self.dataset}, shard={self.shard}, " \

@@ -57,8 +57,9 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
         report = StepRange(self.start_ms, self.end_ms, self.step_ms)
         window = self.window_ms if self.window_ms else self.stale_ms
         for b in batches:
-            if isinstance(b, PeriodicBatch):
-                # the leaf already stepped this batch from the device grid
+            if isinstance(b, (PeriodicBatch, AggPartialBatch)):
+                # the leaf already stepped (or even aggregated) this batch
+                # from the device grid
                 # (exec.MultiSchemaPartitionsExec._try_device_grid)
                 out.append(b)
                 continue
@@ -203,6 +204,15 @@ class AggregateMapReduce(RangeVectorTransformer):
         limit = ctx.query_context.group_by_cardinality_limit
         parts = [agg.map(b, self.by, self.without, self.params, limit)
                  for b in batches if isinstance(b, PeriodicBatch) and b.keys]
+        # device-grid leaves may emit already-aggregated partials
+        # (exec._try_grid_aggregated); merge them rather than re-mapping
+        pre = [b for b in batches if isinstance(b, AggPartialBatch)]
+        for p in pre:
+            if len(p.group_keys) > limit:
+                raise QueryError(
+                    "", f"group-by cardinality {len(p.group_keys)} "
+                        f"exceeds limit {limit}")
+        parts = pre + parts
         if not parts:
             return []
         if len(parts) == 1:

@@ -116,12 +116,9 @@ class FiloServer:
             # cross-node status gossip + automatic failover (reference:
             # StatusActor/ShardMapper snapshots + Akka failure detector)
             def resync_all():
-                from filodb_tpu.parallel.shardmap import ShardStatus
                 for ds in self.manager.datasets():
-                    m = self.manager.mapper(ds)
-                    shards = [s for s in m.shards_for_node(self.node)
-                              if m.status(s) not in (ShardStatus.STOPPED,
-                                                     ShardStatus.DOWN)]
+                    shards = self.manager.mapper(
+                        ds).runnable_shards_for_node(self.node)
                     self.coordinator.resync(ds, shards)
 
             self.status_poller = StatusPoller(

@@ -200,3 +200,107 @@ class TestEndToEndGridServing:
         v1 = r1.batches[0].values
         v2 = r2.batches[0].values
         np.testing.assert_allclose(v1[np.isfinite(v1)], v2[np.isfinite(v2)])
+
+
+class TestGridAggregatedServing:
+    """Fused agg-on-device serving (scan_rate_grouped): only [G, T]
+    partials cross the host link; results must match the per-series
+    grid path + host aggregation exactly."""
+
+    @pytest.mark.parametrize("op,agg_name", [
+        ("sum", "SUM"), ("count", "COUNT"), ("avg", "AVG"),
+        ("min", "MIN"), ("max", "MAX")])
+    def test_exec_fused_agg_matches_host_agg(self, op, agg_name):
+        from filodb_tpu.query.aggregators import AggPartialBatch
+        from filodb_tpu.query.exec import (ExecContext,
+                                           MultiSchemaPartitionsExec,
+                                           ReduceAggregateExec)
+        from filodb_tpu.query.logical import AggregationOperator
+        from filodb_tpu.query.model import QueryContext
+        from filodb_tpu.query.transformers import (AggregateMapReduce,
+                                                   AggregatePresenter,
+                                                   PeriodicSamplesMapper)
+
+        ms, shard, _ = _mk_shard(n_series=10)
+        steps0, nsteps = _steps(50)
+        end = steps0 + (nsteps - 1) * STEP
+        operator = AggregationOperator[agg_name]
+
+        def run(grouped: bool):
+            leaf = MultiSchemaPartitionsExec(
+                "prom", 0, [ColumnFilter("_metric_", Equals("req_total"))],
+                steps0 - WINDOW, end)
+            leaf.add_transformer(PeriodicSamplesMapper(
+                start_ms=steps0, step_ms=STEP, end_ms=end,
+                window_ms=WINDOW, function=F.RATE))
+            if grouped:
+                leaf.add_transformer(AggregateMapReduce(
+                    operator, by=("instance",)))
+            root = ReduceAggregateExec([leaf], operator) if grouped \
+                else None
+            if grouped:
+                root.add_transformer(AggregatePresenter(operator))
+                return root.execute(ExecContext(ms, QueryContext()))
+            return leaf.execute(ExecContext(ms, QueryContext()))
+
+        result = run(True)
+        cache = next(iter(shard.device_caches.values()))
+        assert cache.hits >= 1
+        got = {}
+        for b in result.batches:
+            for tags, ts, vals in b.to_series():
+                got[tags["instance"]] = np.asarray(vals)
+        # oracle: per-series grid path, aggregated on host per instance
+        raw = run(False)
+        want = {}
+        pb = raw.batches[0]
+        for tags, ts, vals in pb.to_series():
+            want[tags["instance"]] = np.asarray(vals)
+        assert set(got) == set(want)
+        for k in want:
+            # by (instance): each group has ONE member, so every op
+            # reduces to the member itself (count -> 1 where finite)
+            w = want[k]
+            if agg_name == "COUNT":
+                w = np.where(np.isfinite(w), 1.0, np.nan)
+            np.testing.assert_allclose(got[k], w, rtol=1e-5,
+                                       equal_nan=True)
+
+    def test_fused_global_sum_matches(self):
+        from filodb_tpu.query.exec import (ExecContext,
+                                           MultiSchemaPartitionsExec,
+                                           ReduceAggregateExec)
+        from filodb_tpu.query.logical import AggregationOperator
+        from filodb_tpu.query.model import QueryContext
+        from filodb_tpu.query.transformers import (AggregateMapReduce,
+                                                   AggregatePresenter,
+                                                   PeriodicSamplesMapper)
+
+        ms, shard, _ = _mk_shard(n_series=8)
+        steps0, nsteps = _steps(50)
+        end = steps0 + (nsteps - 1) * STEP
+
+        def mk(with_grid: bool):
+            leaf = MultiSchemaPartitionsExec(
+                "prom", 0, [ColumnFilter("_metric_", Equals("req_total"))],
+                steps0 - WINDOW, end)
+            leaf.add_transformer(PeriodicSamplesMapper(
+                start_ms=steps0, step_ms=STEP, end_ms=end,
+                window_ms=WINDOW, function=F.RATE))
+            leaf.add_transformer(AggregateMapReduce(
+                AggregationOperator.SUM))
+            root = ReduceAggregateExec([leaf], AggregationOperator.SUM)
+            root.add_transformer(AggregatePresenter(AggregationOperator.SUM))
+            return root
+
+        fused = mk(True).execute(ExecContext(ms, QueryContext()))
+        cache = next(iter(shard.device_caches.values()))
+        assert cache.hits >= 1
+        # disable the grid -> host fallback oracle
+        cache.disabled_until_version = shard.ingest_epoch + 10**9
+        plain = mk(False).execute(ExecContext(ms, QueryContext()))
+        vf = np.asarray(fused.batches[0].values[0])
+        vp = np.asarray(plain.batches[0].values[0])
+        fin = np.isfinite(vp)
+        assert (np.isfinite(vf) == fin).all()
+        np.testing.assert_allclose(vf[fin], vp[fin], rtol=1e-4)

@@ -152,6 +152,31 @@ class TestMeshPathEquivalence:
             np.testing.assert_allclose(out[k], plain[k][1],
                                        rtol=1e-9, equal_nan=True)
 
+    def test_histogram_shards_fall_back_to_host_path(self, loaded):
+        """The mesh program is scalar-only; shards holding histogram data
+        must be served by the per-shard host path, never dropped."""
+        from tests.data import histogram_containers
+
+        ms2 = TimeSeriesMemStore()
+        mapper = ShardMapper(NUM_SHARDS)
+        for s in range(NUM_SHARDS):
+            ms2.setup("prom", DEFAULT_SCHEMAS, s)
+        # histogram series spread over 2+ shards
+        for shard_num in (0, 1, 2):
+            for off, c in enumerate(histogram_containers(
+                    n_series=2, n_samples=40, metric="hq",
+                    seed=shard_num)):
+                ms2.get_shard("prom", shard_num).ingest_container(c, off)
+        promql = 'sum(rate(hq{_ws_="demo",_ns_="App-0"}[2m]))'
+        from tests.data import START_TS
+        start, end = START_TS + 200_000, START_TS + 390_000
+        plain = _run(_planner(mapper), ms2, promql, start, end)
+        fused = _run(_planner(mapper, mesh=True), ms2, promql, start, end)
+        assert set(fused) == set(plain) and plain, "hist data dropped"
+        for k in plain:
+            np.testing.assert_allclose(fused[k][1], plain[k][1],
+                                       rtol=1e-6, equal_nan=True)
+
     def test_single_local_shard_stays_per_shard(self, loaded):
         ms, mapper = loaded
         planner = _planner(mapper, mesh=True)
