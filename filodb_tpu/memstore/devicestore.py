@@ -44,6 +44,17 @@ BLOCK_BUCKETS = 128
 _LANE_PAD = 128
 _I32_SPAN = 2**31 - 2
 
+# range functions the aligned grid can serve, mapped to the fused
+# kernel op (ops/grid.py GridQuery.op); None = the bare instant
+# selector's staleness lookback (last sample in the window)
+_GRID_OPS = {
+    F.RATE: "rate", F.INCREASE: "increase",
+    F.SUM_OVER_TIME: "sum", F.COUNT_OVER_TIME: "count",
+    F.AVG_OVER_TIME: "avg", F.MIN_OVER_TIME: "min",
+    F.MAX_OVER_TIME: "max", F.LAST_OVER_TIME: "last",
+    None: "last",
+}
+
 
 _ONEHOT_MAX_G = 2048  # one-hot matmul reduce beyond this costs too much VMEM
 
@@ -197,10 +208,12 @@ class DeviceGridCache:
 
     def scan_rate(self, part_ids: Sequence[int], func: F, steps0: int,
                   nsteps: int, step_ms: int, window_ms: int):
-        """Serve ``rate``/``increase`` on the query step grid from device-
-        resident blocks.  Returns values ``[S_req, T]`` (numpy) or None when
-        the fast path cannot serve this query (caller falls back)."""
-        if func not in (F.RATE, F.INCREASE):
+        """Serve any _GRID_OPS window function (rate/increase, the
+        *_over_time family, the bare instant selector's last-sample scan)
+        on the query step grid from device-resident blocks.  Returns
+        values ``[S_req, T]`` (numpy) or None when the fast path cannot
+        serve this query (caller falls back)."""
+        if func not in _GRID_OPS:
             return None
         with self._lock:
             return self._scan_rate_locked(list(map(int, part_ids)), func,
@@ -210,13 +223,15 @@ class DeviceGridCache:
                           steps0: int, nsteps: int, step_ms: int,
                           window_ms: int, group_ids: Sequence[int],
                           num_groups: int, op: str = "sum"):
-        """Fused serve of ``agg by (g)(rate(...))``: the grid kernel's
+        """Fused serve of ``agg by (g)(<grid window fn>(...))``: any
+        _GRID_OPS window function under a distributive aggregate; the
+        grid kernel's
         [T, lanes] output is segment-reduced ON DEVICE, so only the tiny
         [G, T] partials cross the host link (the full per-series matrix
         readback + re-upload otherwise dominates served latency on a
         tunnel-attached device).  Returns the mergeable partial state
         dict ({"sum","count"} / {"min"} / {"max"}) or None to fall back."""
-        if func not in (F.RATE, F.INCREASE):
+        if func not in _GRID_OPS:
             return None
         with self._lock:
             ids = list(map(int, part_ids))
@@ -335,7 +350,7 @@ class DeviceGridCache:
         ts_sl = lax.dynamic_slice_in_dim(ts_all, row0, nrows, axis=0)
         val_sl = lax.dynamic_slice_in_dim(val_all, row0, nrows, axis=0)
         q = GridQuery(nsteps=nsteps, kbuckets=K, gstep_ms=g,
-                      is_rate=(func == F.RATE))
+                      is_rate=(func == F.RATE), op=_GRID_OPS[func])
         lane_mult = 1024 if ts_sl.shape[1] % 1024 == 0 else _LANE_PAD
         out = rate_grid_auto(ts_sl, val_sl, steps0 - self.epoch0, q,
                              lanes=lane_mult)            # [T, lanes]

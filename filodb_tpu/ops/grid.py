@@ -58,12 +58,23 @@ _IBIG = 2**30
 
 
 class GridQuery(NamedTuple):
-    """Static kernel configuration for one (shape, query-grid) signature."""
+    """Static kernel configuration for one (shape, query-grid) signature.
+
+    ``op`` selects the fused window function:
+      "rate" / "increase"  — counter correction + Prometheus extrapolation
+      "sum" / "count" / "avg" / "min" / "max"
+                           — the *_over_time family (no correction)
+      "last"               — last_over_time / the instant-selector
+                             staleness lookback
+    ``is_rate`` is kept for backward compatibility with callers that
+    predate ``op``; it is honored only when op is "rate"/"increase".
+    """
 
     nsteps: int       # T output steps
     kbuckets: int     # K = window // gstep buckets per window
     gstep_ms: int     # bucket width == query step
-    is_rate: bool     # rate() vs increase()
+    is_rate: bool = True   # rate() vs increase() (when op is rate-like)
+    op: str = "rate"
 
 
 def _correct_and_mask(ts, vals, roll):
@@ -148,12 +159,58 @@ def _extrapolate(nf, t1, t2, v1, v2, steps0, q: GridQuery):
     extrap = (sampled + jnp.where(dur_start < thresh, dur_start, avg_dur / 2.0)
               + jnp.where(dur_end < thresh, dur_end, avg_dur / 2.0))
     scaled = delta * extrap / jnp.where(sampled == 0, 1.0, sampled)
-    if q.is_rate:
+    # rate divides by window seconds; increase does not.  op is
+    # authoritative ("increase" must never divide); is_rate only
+    # disambiguates legacy callers that left op at its "rate" default.
+    if q.op == "rate" and q.is_rate:
         scaled = scaled / (jnp.asarray(window, dt) / 1000.0)
     return jnp.where((nf >= 2) & (sampled > 0), scaled, jnp.nan)
 
 
+def _agg_block(ts, vals, q: GridQuery):
+    """The *_over_time family on the aligned grid: no correction, no
+    forward fill — K static sublane slices accumulate directly
+    (reference: AggrOverTimeFunctions.scala sum/count/avg/min/max/last)."""
+    ns = ts.shape[1]
+    T = q.nsteps
+    dt = vals.dtype
+    fin = jnp.isfinite(vals)
+    sl = lambda x, d: jax.lax.slice(x, (d, 0), (d + T, ns))
+    shape = (T, ns)
+    if q.op == "last":
+        v2 = jnp.full(shape, jnp.nan, dt)
+        for d in range(q.kbuckets):          # forward: last finite wins
+            fd = sl(fin, d)
+            v2 = jnp.where(fd, sl(vals, d), v2)
+        return v2
+    s = jnp.zeros(shape, dt)
+    c = jnp.zeros(shape, dt)
+    mn = jnp.full(shape, jnp.inf, dt)
+    mx = jnp.full(shape, -jnp.inf, dt)
+    for d in range(q.kbuckets):
+        fd = sl(fin, d)
+        vd = sl(vals, d)
+        c = c + fd.astype(dt)
+        if q.op in ("sum", "avg"):
+            s = s + jnp.where(fd, vd, 0.0)
+        elif q.op == "min":
+            mn = jnp.minimum(mn, jnp.where(fd, vd, jnp.inf))
+        elif q.op == "max":
+            mx = jnp.maximum(mx, jnp.where(fd, vd, -jnp.inf))
+    if q.op == "count":
+        return jnp.where(c > 0, c, jnp.nan)
+    if q.op == "avg":
+        return jnp.where(c > 0, s / jnp.maximum(c, 1.0), jnp.nan)
+    if q.op == "min":
+        return jnp.where(jnp.isfinite(mn), mn, jnp.nan)
+    if q.op == "max":
+        return jnp.where(jnp.isfinite(mx), mx, jnp.nan)
+    return jnp.where(c > 0, s, jnp.nan)   # sum
+
+
 def _rate_block(ts, vals, steps0, q: GridQuery):
+    if q.op not in ("rate", "increase"):
+        return _agg_block(ts, vals, q)
     roll = lambda x, s: pltpu.roll(x, s, axis=0)
     fin, vcorr = _correct_and_mask(ts, vals, roll)
     nf, t1, t2, v1, v2 = _window_stats(ts, fin, vcorr, q)
@@ -260,6 +317,8 @@ def rate_grid_grouped(ts, vals, steps0, q: GridQuery,
 
 def rate_grid_ref(ts, vals, steps0: int, q: GridQuery):
     """Same semantics as :func:`rate_grid`, in portable jnp."""
+    if q.op not in ("rate", "increase"):
+        return _agg_block(ts, vals, q)
     def roll(x, s):
         return jnp.concatenate([x[-s:], x[:-s]], axis=0)
     fin, vcorr = _correct_and_mask(ts, vals, roll)
@@ -274,7 +333,16 @@ def rate_grid_auto(ts, vals, steps0, q: GridQuery, lanes: int = 1024):
     return rate_grid_ref(ts, vals, int(steps0), q)
 
 
+MAX_K_BUCKETS = 64  # kernel passes unroll over K; cap the compile cost
+
+
 def supports_grid(window_ms: int, step_ms: int, gstep_ms: int) -> bool:
-    """Host-side check: can the aligned fast path serve this query?"""
+    """Host-side check: can the aligned fast path serve this query?
+    K = window/gstep is capped — the kernels unroll K static slice
+    passes, and an uncapped K (e.g. a 5-minute staleness lookback over a
+    1-second scrape cadence -> K=300) would pay a huge one-off compile
+    on the most interactive query shape.  Beyond the cap the general
+    path serves."""
     return (step_ms == gstep_ms and window_ms > 0
-            and window_ms % gstep_ms == 0)
+            and window_ms % gstep_ms == 0
+            and window_ms // gstep_ms <= MAX_K_BUCKETS)

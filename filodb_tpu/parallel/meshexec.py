@@ -85,7 +85,8 @@ class MeshAggregateExec(ExecPlan):
         engine = self._engine or meshmod.default_engine()
         steps = StepRange(self.start_ms - self.offset_ms,
                           self.end_ms - self.offset_ms, self.step_ms)
-        window = self.window_ms if self.window_ms else self.stale_ms
+        from filodb_tpu.query.transformers import effective_window_ms
+        window = effective_window_ms(self.window_ms, self.stale_ms)
         union: dict[tuple, int] = {}
         shard_batches = []
         group_ids = []

@@ -210,8 +210,11 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         mapper = self.transformers[0]
         if not isinstance(mapper, PeriodicSamplesMapper):
             return None
-        if mapper.window_ms is None or mapper.function is None:
-            return None
+        if (mapper.window_ms is None) != (mapper.function is None):
+            return None   # half-specified windowing: general path decides
+        # bare instant selector: the staleness lookback is a
+        # last-sample-in-window scan the grid serves directly
+        window_ms = mapper.effective_window_ms
         steps = StepRange(mapper.start_ms - mapper.offset_ms,
                           mapper.end_ms - mapper.offset_ms, mapper.step_ms)
         report = StepRange(mapper.start_ms, mapper.end_ms, mapper.step_ms)
@@ -219,11 +222,12 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         if isinstance(mapred, AggregateMapReduce) and not mapred.params \
                 and mapred.operator.name in self._GRID_AGG_OPS:
             served = self._try_grid_aggregated(shard, part_ids, column_id,
-                                               mapper, mapred, steps, report)
+                                               mapper, mapred, steps, report,
+                                               window_ms)
             if served is not None:
                 return served
         got = shard.scan_grid(part_ids, mapper.function, steps.start,
-                              steps.num_steps, steps.step, mapper.window_ms,
+                              steps.num_steps, steps.step, window_ms,
                               column_id)
         if got is None:
             return None
@@ -231,7 +235,7 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         return [PeriodicBatch(tags, report, vals)]
 
     def _try_grid_aggregated(self, shard, part_ids, column_id, mapper,
-                             mapred, steps, report):
+                             mapred, steps, report, window_ms):
         from filodb_tpu.query.aggregators import (AggPartialBatch,
                                                   grouping_key)
         union: dict[tuple, int] = {}
@@ -252,7 +256,7 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                 gids.append(union.setdefault(key, len(union)))
         state = shard.scan_grid_grouped(
             part_ids, mapper.function, steps.start, steps.num_steps,
-            steps.step, mapper.window_ms, gids, max(len(union), 1),
+            steps.step, window_ms, gids, max(len(union), 1),
             self._GRID_AGG_OPS[mapred.operator.name], column_id)
         if state is None:
             return None

@@ -21,6 +21,10 @@ from filodb_tpu.ops.windows import StepRange
 from filodb_tpu.query.logical import RangeFunctionId as F
 
 # prefix-path kernels: fn(ts, vals, steps, window) -> [S,T]
+def _last_sample_value(ts, vals, steps, window):
+    return windows.last_sample(ts, vals, steps, window)[0]
+
+
 _PREFIX = {
     F.SUM_OVER_TIME: windows.sum_over_time,
     F.COUNT_OVER_TIME: windows.count_over_time,
@@ -36,6 +40,9 @@ _PREFIX = {
     F.IDELTA: windows.idelta,
     F.TIMESTAMP: windows.timestamp_fn,
     F.Z_SCORE: windows.z_score,
+    # last_over_time == the instant selector's last-sample scan with an
+    # explicit window (reference: LastSampleChunkedFunctionD)
+    F.LAST_OVER_TIME: _last_sample_value,
 }
 
 # gather-path kernels: fn(ts, vals, steps, window, wmax, *args) -> [S,T]
@@ -60,10 +67,6 @@ _HIST = {
 @functools.lru_cache(maxsize=256)
 def _jit(fn, static_argnums=()):
     return jax.jit(fn, static_argnums=static_argnums)
-
-
-def _last_sample_value(ts, vals, steps, window):
-    return windows.last_sample(ts, vals, steps, window)[0]
 
 
 def supported(func: Optional[F], hist: bool) -> bool:

@@ -34,6 +34,14 @@ class RangeVectorTransformer:
         return type(self).__name__
 
 
+def effective_window_ms(window_ms, stale_ms: int = 300_000) -> int:
+    """The lookback actually scanned: the explicit range-function window,
+    or the staleness lookback for bare instant selectors.  The single
+    home of this substitution — the general path, the grid fast path,
+    and the mesh path must all agree on it."""
+    return window_ms if window_ms else stale_ms
+
+
 @dataclasses.dataclass
 class PeriodicSamplesMapper(RangeVectorTransformer):
     """Raw irregular samples -> regular-step samples, optionally through a
@@ -50,12 +58,16 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
     offset_ms: int = 0
     stale_ms: int = 300_000  # staleness lookback for instant selectors
 
+    @property
+    def effective_window_ms(self) -> int:
+        return effective_window_ms(self.window_ms, self.stale_ms)
+
     def apply(self, batches, ctx):
         out = []
         steps = StepRange(self.start_ms - self.offset_ms,
                           self.end_ms - self.offset_ms, self.step_ms)
         report = StepRange(self.start_ms, self.end_ms, self.step_ms)
-        window = self.window_ms if self.window_ms else self.stale_ms
+        window = self.effective_window_ms
         for b in batches:
             if isinstance(b, (PeriodicBatch, AggPartialBatch)):
                 # the leaf already stepped (or even aggregated) this batch

@@ -130,7 +130,8 @@ class TestDeviceGrid:
                                STEP // 2, WINDOW) is None
         assert shard.scan_grid(res.part_ids, F.RATE, steps0 + 7, nsteps,
                                STEP, WINDOW) is None
-        assert shard.scan_grid(res.part_ids, F.SUM_OVER_TIME, steps0, nsteps,
+        # deriv has no aligned-grid kernel: stays on the general path
+        assert shard.scan_grid(res.part_ids, F.DERIV, steps0, nsteps,
                                STEP, WINDOW) is None
 
     def test_irregular_series_disables_grid(self):
@@ -299,6 +300,116 @@ class TestGridAggregatedServing:
         # disable the grid -> host fallback oracle
         cache.disabled_until_version = shard.ingest_epoch + 10**9
         plain = mk(False).execute(ExecContext(ms, QueryContext()))
+        vf = np.asarray(fused.batches[0].values[0])
+        vp = np.asarray(plain.batches[0].values[0])
+        fin = np.isfinite(vp)
+        assert (np.isfinite(vf) == fin).all()
+        np.testing.assert_allclose(vf[fin], vp[fin], rtol=1e-4)
+
+
+class TestGridOverTimeServing:
+    """The widened grid fast path (_over_time family + bare instant
+    selectors) vs the general fallback, through the exec plan."""
+
+    @pytest.mark.parametrize("func", [F.SUM_OVER_TIME, F.COUNT_OVER_TIME,
+                                      F.AVG_OVER_TIME, F.MIN_OVER_TIME,
+                                      F.MAX_OVER_TIME, F.LAST_OVER_TIME])
+    def test_over_time_matches_fallback(self, func):
+        from filodb_tpu.query.exec import (ExecContext,
+                                           MultiSchemaPartitionsExec)
+        from filodb_tpu.query.model import QueryContext
+        from filodb_tpu.query.transformers import PeriodicSamplesMapper
+
+        ms, shard, _ = _mk_shard()
+        steps0, nsteps = _steps(50)
+        end = steps0 + (nsteps - 1) * STEP
+
+        def run():
+            leaf = MultiSchemaPartitionsExec(
+                "prom", 0, [ColumnFilter("_metric_", Equals("req_total"))],
+                steps0 - WINDOW, end)
+            leaf.add_transformer(PeriodicSamplesMapper(
+                start_ms=steps0, step_ms=STEP, end_ms=end,
+                window_ms=WINDOW, function=func))
+            return leaf.execute(ExecContext(ms, QueryContext()))
+
+        served = run()
+        cache = next(iter(shard.device_caches.values()))
+        assert cache.hits >= 1, f"{func} not served from the grid"
+        cache.disabled_until_version = shard.ingest_epoch + 10**9
+        fallback = run()
+        for bs, bf in zip(served.batches, fallback.batches):
+            vs, vf = np.asarray(bs.values), np.asarray(bf.values)
+            vs = vs[:len(bs.keys)]
+            vf = vf[:len(bf.keys)]
+            assert (np.isfinite(vs) == np.isfinite(vf)).all(), func
+            both = np.isfinite(vs)
+            np.testing.assert_allclose(vs[both], vf[both], rtol=1e-4,
+                                       err_msg=str(func))
+
+    def test_instant_selector_served_from_grid(self):
+        """A bare selector (no window/function) uses the staleness
+        lookback; the grid serves it as a last-sample scan."""
+        from filodb_tpu.query.exec import (ExecContext,
+                                           MultiSchemaPartitionsExec)
+        from filodb_tpu.query.model import QueryContext
+        from filodb_tpu.query.transformers import PeriodicSamplesMapper
+
+        ms, shard, _ = _mk_shard()
+        steps0, nsteps = _steps(50)
+        end = steps0 + (nsteps - 1) * STEP
+
+        def run():
+            leaf = MultiSchemaPartitionsExec(
+                "prom", 0, [ColumnFilter("_metric_", Equals("req_total"))],
+                steps0 - 300_000, end)
+            leaf.add_transformer(PeriodicSamplesMapper(
+                start_ms=steps0, step_ms=STEP, end_ms=end))
+            return leaf.execute(ExecContext(ms, QueryContext()))
+
+        served = run()
+        cache = next(iter(shard.device_caches.values()))
+        assert cache.hits >= 1, "instant selector not grid-served"
+        cache.disabled_until_version = shard.ingest_epoch + 10**9
+        fallback = run()
+        vs = np.asarray(served.batches[0].values)[:6]
+        vf = np.asarray(fallback.batches[0].values)[:6]
+        assert (np.isfinite(vs) == np.isfinite(vf)).all()
+        both = np.isfinite(vs)
+        np.testing.assert_allclose(vs[both], vf[both], rtol=1e-4)
+
+    def test_fused_agg_over_time(self):
+        """sum(sum_over_time(...)) fuses the aggregate on device too."""
+        from filodb_tpu.query.exec import (ExecContext,
+                                           MultiSchemaPartitionsExec,
+                                           ReduceAggregateExec)
+        from filodb_tpu.query.logical import AggregationOperator
+        from filodb_tpu.query.model import QueryContext
+        from filodb_tpu.query.transformers import (AggregateMapReduce,
+                                                   AggregatePresenter,
+                                                   PeriodicSamplesMapper)
+
+        ms, shard, _ = _mk_shard(n_series=8)
+        steps0, nsteps = _steps(50)
+        end = steps0 + (nsteps - 1) * STEP
+
+        def mk():
+            leaf = MultiSchemaPartitionsExec(
+                "prom", 0, [ColumnFilter("_metric_", Equals("req_total"))],
+                steps0 - WINDOW, end)
+            leaf.add_transformer(PeriodicSamplesMapper(
+                start_ms=steps0, step_ms=STEP, end_ms=end,
+                window_ms=WINDOW, function=F.SUM_OVER_TIME))
+            leaf.add_transformer(AggregateMapReduce(AggregationOperator.SUM))
+            root = ReduceAggregateExec([leaf], AggregationOperator.SUM)
+            root.add_transformer(AggregatePresenter(AggregationOperator.SUM))
+            return root
+
+        fused = mk().execute(ExecContext(ms, QueryContext()))
+        cache = next(iter(shard.device_caches.values()))
+        assert cache.hits >= 1
+        cache.disabled_until_version = shard.ingest_epoch + 10**9
+        plain = mk().execute(ExecContext(ms, QueryContext()))
         vf = np.asarray(fused.batches[0].values[0])
         vp = np.asarray(plain.batches[0].values[0])
         fin = np.isfinite(vp)

@@ -209,3 +209,87 @@ class TestGridPallasInterpret:
             np.testing.assert_allclose(s[g], np.where(ok, rg, 0).sum(axis=1),
                                        rtol=1e-5, atol=1e-5)
             np.testing.assert_array_equal(c[g], ok.sum(axis=1))
+
+
+class TestGridAggOps:
+    """The *_over_time family + instant-selector 'last' on the aligned
+    grid vs the general windows kernels (exact semantics match)."""
+
+    @pytest.mark.parametrize("op,wfn", [
+        ("sum", "sum_over_time"), ("count", "count_over_time"),
+        ("avg", "avg_over_time"), ("last", "last_sample")])
+    def test_matches_windows(self, op, wfn):
+        ts, vals = _aligned_data()
+        steps = _steps()
+        q = GridQuery(nsteps=len(steps), kbuckets=K, gstep_ms=STEP, op=op)
+        cts, cvals = _clip(ts, vals)
+        got = np.asarray(rate_grid_ref(cts.astype(jnp.int64),
+                                       cvals.astype(jnp.float64),
+                                       int(steps[0]), q))
+        tsn, vn = np.asarray(cts), np.asarray(cvals)
+        S = tsn.shape[1]
+        dense_ts = np.full((S, tsn.shape[0]), 2**60, np.int64)
+        dense_v = np.full((S, tsn.shape[0]), np.nan)
+        for s in range(S):
+            keep = np.isfinite(vn[:, s])
+            k = keep.sum()
+            dense_ts[s, :k] = tsn[keep, s]
+            dense_v[s, :k] = vn[keep, s]
+        fn = getattr(windows, wfn)
+        want = np.asarray(fn(jnp.asarray(dense_ts),
+                             jnp.asarray(dense_v), steps,
+                             jnp.asarray(K * STEP, jnp.int64)))
+        if want.ndim == 3:          # last_sample returns (value, ts) pair
+            want = want[0]
+        want = want.T
+        assert (np.isfinite(got) == np.isfinite(want)).all(), op
+        both = np.isfinite(got) & np.isfinite(want)
+        np.testing.assert_allclose(got[both], want[both], rtol=1e-12)
+
+    @pytest.mark.parametrize("op,wfn", [
+        ("min", "min_over_time"), ("max", "max_over_time")])
+    def test_minmax_matches_windows(self, op, wfn):
+        ts, vals = _aligned_data()
+        steps = _steps()
+        q = GridQuery(nsteps=len(steps), kbuckets=K, gstep_ms=STEP, op=op)
+        cts, cvals = _clip(ts, vals)
+        got = np.asarray(rate_grid_ref(cts.astype(jnp.int64),
+                                       cvals.astype(jnp.float64),
+                                       int(steps[0]), q))
+        tsn, vn = np.asarray(cts), np.asarray(cvals)
+        S = tsn.shape[1]
+        dense_ts = np.full((S, tsn.shape[0]), 2**60, np.int64)
+        dense_v = np.full((S, tsn.shape[0]), np.nan)
+        for s in range(S):
+            keep = np.isfinite(vn[:, s])
+            k = keep.sum()
+            dense_ts[s, :k] = tsn[keep, s]
+            dense_v[s, :k] = vn[keep, s]
+        from filodb_tpu.query import rangefns as rf
+        wmax = rf.bucket_wmax(dense_ts, np.asarray(steps), K * STEP)
+        fn = getattr(windows, wfn)
+        want = np.asarray(fn(jnp.asarray(dense_ts), jnp.asarray(dense_v),
+                             steps, jnp.asarray(K * STEP, jnp.int64),
+                             wmax)).T
+        assert (np.isfinite(got) == np.isfinite(want)).all(), op
+        both = np.isfinite(got) & np.isfinite(want)
+        np.testing.assert_allclose(got[both], want[both], rtol=1e-12)
+
+    @pytest.mark.parametrize("op", ["sum", "count", "avg", "min", "max",
+                                    "last"])
+    def test_pallas_interpret_matches_ref(self, op):
+        from filodb_tpu.ops.grid import rate_grid
+        ts, vals = _aligned_data(n_series=128)
+        steps = _steps()
+        q = GridQuery(nsteps=len(steps), kbuckets=K, gstep_ms=STEP, op=op)
+        cts, cvals = _clip(ts, vals)
+        ref = np.asarray(rate_grid_ref(cts.astype(jnp.int32),
+                                       cvals.astype(jnp.float32),
+                                       int(steps[0]), q))
+        pal = np.asarray(rate_grid(cts.astype(jnp.int32),
+                                   cvals.astype(jnp.float32),
+                                   jnp.int32(int(steps[0])), q, lanes=128,
+                                   interpret=True))
+        assert (np.isfinite(ref) == np.isfinite(pal)).all()
+        both = np.isfinite(ref)
+        np.testing.assert_allclose(pal[both], ref[both], rtol=1e-6)
