@@ -415,3 +415,62 @@ class TestGridOverTimeServing:
         fin = np.isfinite(vp)
         assert (np.isfinite(vf) == fin).all()
         np.testing.assert_allclose(vf[fin], vp[fin], rtol=1e-4)
+
+
+class TestDownsampledGridServing:
+    """Downsampled datasets are aligned by construction (period-end
+    timestamps at exact resolution multiples), so the grid fast path
+    serves long-range queries routed to them (reference intent:
+    DownsampledTimeSeriesShard serving from block memory)."""
+
+    def test_ds_dataset_served_from_grid(self):
+        from filodb_tpu.downsample.sharddown import MemoryDownsamplePublisher
+        from filodb_tpu.downsample.dsstore import (DownsampledTimeSeriesStore,
+                                                   ds_dataset_name)
+        from filodb_tpu.query.exec import (ExecContext,
+                                           MultiSchemaPartitionsExec)
+        from filodb_tpu.query.model import QueryContext
+        from filodb_tpu.query.transformers import PeriodicSamplesMapper
+
+        RES = 60_000
+        pub = MemoryDownsamplePublisher()
+        _, shard2, _ = _mk_shard(n_series=5, n_rows=120,
+                                 jitter_max=5_000, flush=False)
+        shard2.enable_downsampling(pub, (RES,))
+        shard2.flush_all()   # emits downsample records to the publisher
+
+        ds = DownsampledTimeSeriesStore("prom", resolutions_ms=(RES,))
+        ds.setup(DEFAULT_SCHEMAS, 0)
+        assert ds.ingest_from_publisher(pub) > 0
+        ds_shard = ds.shard(RES, 0)
+        ds_shard.flush_all()   # freeze so the grid builds from chunks
+
+        # query at the resolution step: avg_over_time over the ds series
+        lookup = ds_shard.lookup_partitions(
+            [ColumnFilter("_metric_", Equals("req_total"))], 0, 2**62)
+        assert len(lookup.part_ids) == 5
+        t_lo = min(p.earliest_timestamp
+                   for p in ds_shard.partitions.values())
+        steps0 = ((t_lo // RES) + 6) * RES
+        end = steps0 + 30 * RES
+
+        def run():
+            leaf = MultiSchemaPartitionsExec(
+                ds_dataset_name("prom", RES), 0,
+                [ColumnFilter("_metric_", Equals("req_total"))],
+                steps0 - 5 * RES, end)
+            leaf.add_transformer(PeriodicSamplesMapper(
+                start_ms=steps0, step_ms=RES, end_ms=end,
+                window_ms=5 * RES, function=F.AVG_OVER_TIME))
+            return leaf.execute(ExecContext(ds.memstore, QueryContext()))
+
+        served = run()
+        cache = next(iter(ds_shard.device_caches.values()))
+        assert cache.hits >= 1, "ds dataset not served from the grid"
+        cache.disabled_until_version = ds_shard.ingest_epoch + 10**9
+        fallback = run()
+        vs = np.asarray(served.batches[0].values)[:5]
+        vf = np.asarray(fallback.batches[0].values)[:5]
+        assert (np.isfinite(vs) == np.isfinite(vf)).all()
+        both = np.isfinite(vs)
+        np.testing.assert_allclose(vs[both], vf[both], rtol=1e-4)
