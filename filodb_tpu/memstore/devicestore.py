@@ -112,16 +112,33 @@ def _grouped_reduce(stepped, garr, num_groups: int, op: str):
 
 
 class _Block:
-    """One resident time block: device arrays [BLOCK_BUCKETS, lanes]."""
+    """One resident time block: device arrays [BLOCK_BUCKETS, lanes].
 
-    __slots__ = ("ts", "vals", "lanes", "nbytes", "last_used")
+    ``fmin/fmax/fcnt`` (host numpy, per lane) record the filled-bucket
+    range so queries can prove the dense-lane contract (ops/grid.py
+    GridQuery.dense) without touching device data: a lane is
+    *contiguous* iff fcnt == fmax - fmin + 1, dense over local rows
+    [a, b] iff contiguous and fmin <= a <= b <= fmax, and empty over
+    [a, b] iff fcnt == 0 or fmax < a or fmin > b."""
 
-    def __init__(self, ts, vals, lanes: int, seq: int):
+    __slots__ = ("ts", "vals", "lanes", "nbytes", "last_used",
+                 "fmin", "fmax", "fcnt")
+
+    def __init__(self, ts, vals, lanes: int, seq: int, fill_stats):
         self.ts = ts
         self.vals = vals
         self.lanes = lanes
         self.nbytes = int(ts.size * 4 + vals.size * 4)
         self.last_used = seq
+        self.fmin, self.fmax, self.fcnt = fill_stats
+
+    def dense_or_empty(self, a: int, b: int):
+        """Per-lane (dense, empty) bool masks: lane is provably dense
+        over local rows [a, b] / provably empty there."""
+        contiguous = self.fcnt == self.fmax - self.fmin + 1
+        dense = contiguous & (self.fmin <= a) & (self.fmax >= b)
+        empty = (self.fcnt == 0) | (self.fmax < a) | (self.fmin > b)
+        return dense, empty
 
 
 class DeviceGridCache:
@@ -148,6 +165,7 @@ class DeviceGridCache:
         # stats
         self.builds = 0
         self.hits = 0
+        self.dense_hits = 0
         self.evictions = 0
 
     # ------------------------------------------------------------ bookkeeping
@@ -349,8 +367,28 @@ class DeviceGridCache:
         nrows = c_last - c0 + 1
         ts_sl = lax.dynamic_slice_in_dim(ts_all, row0, nrows, axis=0)
         val_sl = lax.dynamic_slice_in_dim(val_all, row0, nrows, axis=0)
+        # prove the dense-lane contract from per-block fill ranges: a
+        # lane must be dense in EVERY covered block segment, or empty in
+        # every one (a series that starts/stops mid-range is neither).
+        # Only the REQUESTED lanes matter — per-lane outputs are
+        # independent, and unrequested lanes are sliced away / mapped to
+        # the drop bucket downstream.
+        req = np.fromiter((self.lane_of[p.part_id] for p in parts),
+                          dtype=np.int64, count=len(parts))
+        all_dense = np.ones(len(req), bool)
+        all_empty = np.ones(len(req), bool)
+        for off, blk in zip(range(bi_lo, bi_hi + 1), segments):
+            a = max(c0 - off * BLOCK_BUCKETS, 0)
+            b = min(c_last - off * BLOCK_BUCKETS, BLOCK_BUCKETS - 1)
+            d, e = blk.dense_or_empty(a, b)
+            all_dense &= d[req]
+            all_empty &= e[req]
+        dense = bool((all_dense | all_empty).all())
+        if dense:
+            self.dense_hits += 1
         q = GridQuery(nsteps=nsteps, kbuckets=K, gstep_ms=g,
-                      is_rate=(func == F.RATE), op=_GRID_OPS[func])
+                      is_rate=(func == F.RATE), op=_GRID_OPS[func],
+                      dense=dense)
         lane_mult = 1024 if ts_sl.shape[1] % 1024 == 0 else _LANE_PAD
         out = rate_grid_auto(ts_sl, val_sl, steps0 - self.epoch0, q,
                              lanes=lane_mult)            # [T, lanes]
@@ -459,8 +497,13 @@ class DeviceGridCache:
             ts_stage[buckets, lane] = (ts - self.epoch0).astype(np.int32)
             val_stage[buckets, lane] = vals
         self.builds += 1
+        fin = np.isfinite(val_stage)
+        fcnt = fin.sum(axis=0).astype(np.int32)
+        fmin = fin.argmax(axis=0).astype(np.int32)
+        fmax = (BLOCK_BUCKETS - 1 - fin[::-1].argmax(axis=0)).astype(np.int32)
+        fmax[fcnt == 0] = -1
         return _Block(jax.device_put(ts_stage), jax.device_put(val_stage),
-                      lanes, self._seq)
+                      lanes, self._seq, (fmin, fmax, fcnt))
 
     def _evict(self, keep: set) -> None:
         """Oldest-first reclaim under the byte budget (the reference's

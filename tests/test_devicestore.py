@@ -134,6 +134,45 @@ class TestDeviceGrid:
         assert shard.scan_grid(res.part_ids, F.DERIV, steps0, nsteps,
                                STEP, WINDOW) is None
 
+    def test_dense_contract_detected(self):
+        """Regular scrapes with no holes: the store proves the
+        dense-lane contract from per-block fill ranges and dispatches
+        the dense kernel (GridQuery.dense)."""
+        ms, shard, _ = _mk_shard()
+        res = _lookup(shard)
+        steps0, nsteps = _steps(50)
+        got = shard.scan_grid(res.part_ids, F.RATE, steps0, nsteps, STEP,
+                              WINDOW)
+        assert got is not None
+        cache = next(iter(shard.device_caches.values()))
+        assert cache.dense_hits == cache.hits > 0
+
+    def test_gappy_series_uses_general_kernel(self):
+        """A series with a missed scrape mid-range breaks the contract:
+        the grid still serves (one-per-bucket holds) but via the general
+        kernel, and the result still matches the dense shard's shape."""
+        ms, shard, _ = _mk_shard(n_series=4, n_rows=50)
+        b = RecordBuilder(DEFAULT_SCHEMAS["prom-counter"])
+        tags = {"__name__": "req_total", "instance": "gappy", "_ws_": "w",
+                "_ns_": "n"}
+        for c in range(0, 50, 2):              # every other bucket
+            b.add(T0 + (c - 1) * STEP + 10, [float(c)], tags)
+        for off, c in enumerate(b.containers()):
+            shard.ingest(decode_container(c, DEFAULT_SCHEMAS), 700 + off)
+        shard.flush_all()
+        res = _lookup(shard)
+        steps0, nsteps = _steps(50)
+        got = shard.scan_grid(res.part_ids, F.RATE, steps0, nsteps, STEP,
+                              WINDOW)
+        assert got is not None
+        cache = next(iter(shard.device_caches.values()))
+        assert cache.hits > 0 and cache.dense_hits == 0
+        # the gappy lane still produces finite rates (2+ samples/window)
+        tags_out, vals = got
+        gi = next(i for i, t in enumerate(tags_out)
+                  if t.get("instance") == "gappy")
+        assert np.isfinite(vals[gi]).any()
+
     def test_irregular_series_disables_grid(self):
         # two samples in one bucket violate the layout invariant
         ms, shard, _ = _mk_shard(n_series=2, n_rows=20)

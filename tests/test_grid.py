@@ -293,3 +293,96 @@ class TestGridAggOps:
         assert (np.isfinite(ref) == np.isfinite(pal)).all()
         both = np.isfinite(ref)
         np.testing.assert_allclose(pal[both], ref[both], rtol=1e-6)
+
+
+def _dense_data(n_series=128, n_empty=16, seed=3, reset_frac=0.05):
+    """Data satisfying the dense-lane contract: every lane fully finite
+    over all rows, except the last ``n_empty`` lanes which are all-NaN
+    (the device store's padding / unrequested lanes)."""
+    ts, vals = _aligned_data(n_series=n_series, seed=seed, gap_frac=0.0,
+                             reset_frac=reset_frac)
+    vals = vals.at[:, n_series - n_empty:].set(jnp.nan)
+    return _clip(ts, vals)
+
+
+class TestGridDense:
+    """The dense fast path (GridQuery.dense) vs the general kernel on
+    contract-conforming data: results must be identical — the dense
+    kernel is an algebraic simplification, not an approximation."""
+
+    ALL_OPS = ["rate", "increase", "sum", "count", "avg", "min", "max",
+               "last"]
+
+    @pytest.mark.parametrize("op", ALL_OPS)
+    def test_ref_dense_equals_general(self, op):
+        cts, cvals = _dense_data()
+        steps = _steps()
+        qd = GridQuery(nsteps=len(steps), kbuckets=K, gstep_ms=STEP,
+                       op=op, is_rate=(op == "rate"), dense=True)
+        qg = qd._replace(dense=False)
+        dense = np.asarray(rate_grid_ref(cts.astype(jnp.int64),
+                                         cvals.astype(jnp.float64),
+                                         int(steps[0]), qd))
+        general = np.asarray(rate_grid_ref(cts.astype(jnp.int64),
+                                           cvals.astype(jnp.float64),
+                                           int(steps[0]), qg))
+        assert (np.isfinite(dense) == np.isfinite(general)).all(), op
+        both = np.isfinite(dense)
+        np.testing.assert_allclose(dense[both], general[both], rtol=1e-12)
+
+    @pytest.mark.parametrize("op", ALL_OPS)
+    def test_pallas_interpret_dense(self, op):
+        cts, cvals = _dense_data()
+        steps = _steps()
+        q = GridQuery(nsteps=len(steps), kbuckets=K, gstep_ms=STEP,
+                      op=op, is_rate=(op == "rate"), dense=True)
+        ref = np.asarray(rate_grid_ref(cts.astype(jnp.int32),
+                                       cvals.astype(jnp.float32),
+                                       int(steps[0]), q))
+        pal = np.asarray(rate_grid(cts.astype(jnp.int32),
+                                   cvals.astype(jnp.float32),
+                                   jnp.int32(int(steps[0])), q, lanes=128,
+                                   interpret=True))
+        assert (np.isfinite(ref) == np.isfinite(pal)).all(), op
+        both = np.isfinite(ref)
+        np.testing.assert_allclose(pal[both], ref[both], rtol=5e-5,
+                                   atol=1e-6)
+
+    def test_grouped_dense(self):
+        cts, cvals = _dense_data()
+        steps = _steps()
+        q = GridQuery(nsteps=len(steps), kbuckets=K, gstep_ms=STEP,
+                      dense=True)
+        s, c = rate_grid_grouped(cts.astype(jnp.int32),
+                                 cvals.astype(jnp.float32),
+                                 int(steps[0]), q, group_lanes=16,
+                                 interpret=True)
+        r = np.asarray(rate_grid_ref(cts.astype(jnp.int32),
+                                     cvals.astype(jnp.float32),
+                                     int(steps[0]), q._replace(dense=False)))
+        s, c = np.asarray(s), np.asarray(c)
+        for g in range(8):
+            rg = r[:, g * 16:(g + 1) * 16]
+            ok = np.isfinite(rg)
+            np.testing.assert_allclose(s[g], np.where(ok, rg, 0).sum(axis=1),
+                                       rtol=1e-5, atol=1e-5)
+            np.testing.assert_array_equal(c[g], ok.sum(axis=1))
+
+    def test_counter_reset_still_corrected(self):
+        """Dense data with a reset mid-range: the dense correction must
+        fire exactly like the general one."""
+        n = 16
+        base = (np.arange(B, dtype=np.int64) * STEP + T0 - STEP + 1)[:, None]
+        ts = base + 10_000 + np.zeros((B, n), np.int64)
+        vals = np.cumsum(np.full((B, n), 7.0), axis=0)
+        vals[20:, :] -= vals[20, 0] - 1.0          # reset at row 20
+        cts, cvals = _clip(jnp.asarray(ts), jnp.asarray(vals))
+        steps = _steps()
+        qd = GridQuery(len(steps), K, STEP, True, dense=True)
+        dense = np.asarray(rate_grid_ref(cts, cvals, int(steps[0]), qd))
+        general = np.asarray(rate_grid_ref(cts, cvals, int(steps[0]),
+                                           qd._replace(dense=False)))
+        both = np.isfinite(dense) & np.isfinite(general)
+        assert both.any()
+        np.testing.assert_allclose(dense[both], general[both], rtol=1e-12)
+        assert (np.isfinite(dense) == np.isfinite(general)).all()
