@@ -55,6 +55,10 @@ _GRID_OPS = {
     None: "last",
 }
 
+# the subset defined on first-class histogram columns (per-bucket
+# semantics; matches the host path in query/rangefns.py _HIST_FNS)
+_HIST_GRID_FNS = {F.RATE, F.INCREASE, F.SUM_OVER_TIME, None}
+
 
 _ONEHOT_MAX_G = 2048  # one-hot matmul reduce beyond this costs too much VMEM
 
@@ -145,12 +149,20 @@ class DeviceGridCache:
     """Per-(shard, schema, value-column) device grid with eviction."""
 
     def __init__(self, shard, schema_hash: int, column_id: int,
-                 budget_bytes: int, gstep_ms: Optional[int] = None):
+                 budget_bytes: int, gstep_ms: Optional[int] = None,
+                 hist: bool = False):
         self._shard = shard
         self.schema_hash = schema_hash
         self.column_id = column_id
         self.budget = budget_bytes
         self.gstep = gstep_ms          # None until detected
+        # histogram columns: each partition slot spans ``hb`` device
+        # columns (one per cumulative bucket); the SAME scalar kernel
+        # then computes per-bucket rates (the reference's per-bucket
+        # HistRateFunction semantics, rangefn/RangeFunction.scala:376)
+        self.hist = hist
+        self.hb: Optional[int] = None          # bucket lanes per slot
+        self.bucket_tops: Optional[np.ndarray] = None
         self.epoch0: Optional[int] = None
         self.lane_of: dict[int, int] = {}
         self._next_lane = 0
@@ -229,9 +241,12 @@ class DeviceGridCache:
         """Serve any _GRID_OPS window function (rate/increase, the
         *_over_time family, the bare instant selector's last-sample scan)
         on the query step grid from device-resident blocks.  Returns
-        values ``[S_req, T]`` (numpy) or None when the fast path cannot
+        values ``[S_req, T]`` (``[S_req, T, hb]`` per-bucket for
+        histogram columns) as numpy, or None when the fast path cannot
         serve this query (caller falls back)."""
         if func not in _GRID_OPS:
+            return None
+        if self.hist and func not in _HIST_GRID_FNS:
             return None
         with self._lock:
             return self._scan_rate_locked(list(map(int, part_ids)), func,
@@ -251,19 +266,38 @@ class DeviceGridCache:
         dict ({"sum","count"} / {"min"} / {"max"}) or None to fall back."""
         if func not in _GRID_OPS:
             return None
+        if self.hist and (func not in _HIST_GRID_FNS or op != "sum"):
+            return None
         with self._lock:
             ids = list(map(int, part_ids))
             got = self._stepped_device(ids, func, steps0, nsteps, step_ms,
                                        window_ms)
             if got is None:
                 return None
-            stepped, lanes = got
-            garr = np.full(lanes, num_groups, dtype=np.int32)
+            stepped, ncols = got
+            stride = self.hb if self.hist else 1
+            garr = np.full(ncols, num_groups * stride, dtype=np.int32)
             lane_idx = np.fromiter((self.lane_of[p] for p in ids),
                                    dtype=np.int64, count=len(ids))
-            garr[lane_idx] = np.asarray(group_ids, dtype=np.int32)
+            gid_arr = np.asarray(group_ids, dtype=np.int32)
+            if stride == 1:
+                garr[lane_idx] = gid_arr
+            else:
+                # slot s, bucket j -> group g*hb + j: the segment reduce
+                # sums each bucket independently (bucket-wise hist sum)
+                cols = (lane_idx[:, None] * stride
+                        + np.arange(stride)[None, :])
+                garr[cols] = gid_arr[:, None] * stride + np.arange(stride)
         import jax.numpy as jnp
-        out = _grouped_reduce(stepped, jnp.asarray(garr), num_groups, op)
+        out = _grouped_reduce(stepped, jnp.asarray(garr),
+                              num_groups * stride, op)
+        if self.hist:
+            both = np.asarray(out, dtype=np.float64)    # [2, G*hb, T]
+            G, hb, T = num_groups, stride, both.shape[-1]
+            hist_sum = both[0].reshape(G, hb, T).transpose(0, 2, 1)
+            count = both[1].reshape(G, hb, T)[:, -1, :]  # total bucket
+            return {"hist_sum": hist_sum, "count": count,
+                    "bucket_tops": np.asarray(self.bucket_tops)}
         if op in ("sum", "avg", "count"):
             # ONE host readback of the stacked [2, G, T]: each blocked
             # transfer pays the tunnel round-trip
@@ -279,9 +313,12 @@ class DeviceGridCache:
                                    window_ms)
         if got is None:
             return None
-        stepped, _lanes = got
+        stepped, _ncols = got
         out_np = np.asarray(stepped)
-        lanes_req = [self.lane_of[pid] for pid in part_ids]
+        lanes_req = np.array([self.lane_of[pid] for pid in part_ids])
+        if self.hist:
+            cols = lanes_req[:, None] * self.hb + np.arange(self.hb)[None, :]
+            return out_np[:, cols].transpose(1, 0, 2)     # [S_req, T, hb]
         return out_np[:, lanes_req].T                     # [S_req, T]
 
     def _stepped_device(self, part_ids, func, steps0, nsteps, step_ms,
@@ -311,6 +348,19 @@ class DeviceGridCache:
         g = self.gstep
         if not supports_grid(window_ms, step_ms, g):
             return None
+        if self.hist and self.hb is None:
+            # probe a narrow leading slice for the bucket scheme — a
+            # full-history read_range would decode (and memoize) every
+            # chunk of the partition while holding the cache lock
+            e0 = parts[0].earliest_timestamp
+            _pts, pvals = parts[0].read_range(e0, e0 + 64 * g,
+                                              self.column_id)
+            buckets = pvals[0] if isinstance(pvals, tuple) else None
+            if buckets is None or buckets.num_buckets == 0:
+                self._disable()
+                return None
+            self.hb = int(buckets.num_buckets)
+            self.bucket_tops = np.asarray(buckets.bucket_tops(), np.float64)
         if self.epoch0 is None:
             first = min(p.earliest_timestamp for p in parts
                         if p.earliest_timestamp >= 0)
@@ -375,6 +425,9 @@ class DeviceGridCache:
         # the drop bucket downstream.
         req = np.fromiter((self.lane_of[p.part_id] for p in parts),
                           dtype=np.int64, count=len(parts))
+        if self.hist:
+            req = (req[:, None] * self.hb
+                   + np.arange(self.hb)[None, :]).ravel()
         all_dense = np.ones(len(req), bool)
         all_empty = np.ones(len(req), bool)
         for off, blk in zip(range(bi_lo, bi_hi + 1), segments):
@@ -474,12 +527,14 @@ class DeviceGridCache:
         import jax
 
         g = self.gstep
+        stride = self.hb if self.hist else 1
         # block bi holds buckets [bi*BB, bi*BB+BB-1]; bucket c covers
         # (epoch0+(c-1)*g, epoch0+c*g]
         b_lo_ms = self.epoch0 + (bi * BLOCK_BUCKETS - 1) * g  # left edge excl
         b_hi_ms = b_lo_ms + BLOCK_BUCKETS * g                 # right edge incl
-        ts_stage = np.zeros((BLOCK_BUCKETS, lanes), np.int32)
-        val_stage = np.full((BLOCK_BUCKETS, lanes), np.nan, self._val_dtype())
+        ts_stage = np.zeros((BLOCK_BUCKETS, lanes * stride), np.int32)
+        val_stage = np.full((BLOCK_BUCKETS, lanes * stride), np.nan,
+                            self._val_dtype())
         for pid, lane in self.lane_of.items():
             part = self._shard.partitions.get(pid)
             if part is None:
@@ -487,15 +542,33 @@ class DeviceGridCache:
             ts, vals = part.read_range(b_lo_ms + 1, b_hi_ms, self.column_id)
             if len(ts) == 0:
                 continue
-            if not isinstance(vals, np.ndarray):
-                self._disable()                 # string/hist column
+            if self.hist:
+                hbk, rows = vals
+                if rows.size == 0:
+                    continue
+                if rows.shape[1] > self.hb:
+                    self._disable()             # bucket scheme widened
+                    return None
+                arr = rows.astype(self._val_dtype())
+                if arr.shape[1] < self.hb:
+                    # narrower cumulative hist: top bucket IS the total,
+                    # edge-pad (same convention as scan_batch)
+                    arr = np.pad(arr, ((0, 0), (0, self.hb - arr.shape[1])),
+                                 mode="edge")
+            elif not isinstance(vals, np.ndarray):
+                self._disable()                 # string column
                 return None
+            else:
+                arr = vals
             buckets = (ts - self.epoch0 + g - 1) // g - bi * BLOCK_BUCKETS
             if len(np.unique(buckets)) != len(buckets):
                 self._disable()                 # >1 sample per bucket
                 return None
-            ts_stage[buckets, lane] = (ts - self.epoch0).astype(np.int32)
-            val_stage[buckets, lane] = vals
+            col0 = lane * stride
+            ts_stage[buckets, col0:col0 + stride] = \
+                (ts - self.epoch0).astype(np.int32)[:, None]
+            val_stage[buckets, col0:col0 + stride] = \
+                arr if self.hist else arr[:, None]
         self.builds += 1
         fin = np.isfinite(val_stage)
         fcnt = fin.sum(axis=0).astype(np.int32)

@@ -480,21 +480,22 @@ class TimeSeriesShard:
             if shash == cs.schema_hash or cs.schema_hash == 0:
                 cache.note_freeze(cs)
 
-    def device_cache(self, schema_hash: int, column_id: int):
+    def device_cache(self, schema_hash: int, column_id: int,
+                     hist: bool = False):
         cache = self.device_caches.get((schema_hash, column_id))
         if cache is None:
             from filodb_tpu.memstore.devicestore import DeviceGridCache
             cache = DeviceGridCache(self, schema_hash, column_id,
                                     self.config.device_cache_bytes,
-                                    self.config.grid_step_ms)
+                                    self.config.grid_step_ms, hist=hist)
             self.device_caches[(schema_hash, column_id)] = cache
         return cache
 
     def _grid_cache_for(self, part_ids: Sequence[int],
                         column_id: Optional[int]):
         """Shared grid-eligibility preamble: resolve the value column off
-        the first partition, require a DOUBLE column, fetch the cache.
-        Returns (cache, ids) or None to fall back."""
+        the first partition, require a DOUBLE or HISTOGRAM column, fetch
+        the cache.  Returns (cache, ids) or None to fall back."""
         ids = [int(p) for p in part_ids]
         if not ids:
             return None
@@ -503,17 +504,21 @@ class TimeSeriesShard:
             return None
         cid = first.schema.data.value_column_id if column_id is None \
             else column_id
-        if first.schema.data.columns[cid].ctype != ColumnType.DOUBLE:
+        ctype = first.schema.data.columns[cid].ctype
+        if ctype not in (ColumnType.DOUBLE, ColumnType.HISTOGRAM):
             return None
-        return self.device_cache(first.schema.schema_hash, cid), ids
+        return self.device_cache(first.schema.schema_hash, cid,
+                                 hist=(ctype == ColumnType.HISTOGRAM)), ids
 
     def scan_grid(self, part_ids: Sequence[int], func, steps0: int,
                   nsteps: int, step_ms: int, window_ms: int,
                   column_id: Optional[int] = None):
         """Serve a windowed range function directly from the device-resident
-        grid (memstore/devicestore.py).  Returns ``(tags_list, vals[S, T])``
-        or None when the fast path cannot serve this query — the caller then
-        uses :meth:`scan_batch` + the general kernels.  This is the serving
+        grid (memstore/devicestore.py).  Returns ``(tags_list, vals,
+        bucket_tops)`` — vals ``[S, T]`` for scalar columns, ``[S, T, hb]``
+        per-bucket (with bucket_tops set) for histogram columns — or None
+        when the fast path cannot serve this query; the caller then uses
+        :meth:`scan_batch` + the general kernels.  This is the serving
         seam the reference places at block memory (queries read encoded
         chunks straight from BlockManager memory, never re-copying them)."""
         got = self._grid_cache_for(part_ids, column_id)
@@ -529,7 +534,7 @@ class TimeSeriesShard:
             if part is None:
                 return None   # concurrently evicted mid-query: fall back
             tags_list.append(part.tags)
-        return tags_list, vals
+        return tags_list, vals, cache.bucket_tops
 
     def scan_grid_grouped(self, part_ids: Sequence[int], func, steps0: int,
                           nsteps: int, step_ms: int, window_ms: int,

@@ -34,6 +34,8 @@ class AggPartialBatch:
     state: dict[str, np.ndarray]
     # series keys for ops whose reduce needs original series (topk/quantile)
     series_keys: Optional[list[dict]] = None
+    # bucket tops when the state carries histogram sums ("hist_sum")
+    bucket_tops: Optional[np.ndarray] = None
 
     @property
     def num_series(self) -> int:
@@ -131,6 +133,8 @@ class MomentAggregator(Aggregator):
     }
 
     def map(self, batch, by, without, params, limit):
+        if batch.hist is not None:
+            return self._map_hist(batch, by, without, params, limit)
         ids, keys = _group(batch.keys, by, without, limit)
         G = len(keys)
         vals = jnp.asarray(batch.values)
@@ -158,12 +162,58 @@ class MomentAggregator(Aggregator):
                 segops.seg_max(vals, pids, G + 1)[:G])
         return AggPartialBatch(self.op, params, keys, batch.steps, state)
 
+    def _map_hist(self, batch, by, without, params, limit):
+        """Bucket-wise histogram sum (reference: exec/aggregator/
+        RowAggregator.scala HistSumRowAggregator).  Only sum is defined
+        over first-class histogram series."""
+        if self.op != Op.SUM:
+            raise QueryError(
+                "", f"{self.op.name.lower()}() over histogram series is not "
+                    "supported (only sum; use hist_to_prom_vectors for "
+                    "per-bucket series)")
+        ids, keys = _group(batch.keys, by, without, limit)
+        G = len(keys)
+        h = jnp.asarray(np.asarray(batch.hist)[:len(batch.keys)])
+        idsj = jnp.asarray(ids.astype(np.int32))
+        fin = jnp.isfinite(h[..., -1])                   # [S, T]
+        hs = jax.ops.segment_sum(jnp.where(fin[..., None], h, 0.0), idsj, G)
+        n = jax.ops.segment_sum(fin.astype(h.dtype), idsj, G)
+        state = {"hist_sum": np.asarray(hs), "count": np.asarray(n)}
+        return AggPartialBatch(self.op, params, keys, batch.steps, state,
+                               bucket_tops=np.asarray(batch.bucket_tops))
+
+    @staticmethod
+    def _align_hist_widths(partials):
+        """Edge-pad cumulative bucket matrices to the widest scheme (the
+        same convention as scan_batch / merge_batches): a narrower
+        histogram's top bucket already holds the total count."""
+        hists = [p for p in partials if "hist_sum" in p.state]
+        if not hists:
+            return None
+        if len(hists) != len(partials):
+            raise QueryError("", "cannot reduce histogram and scalar "
+                                 "aggregates together (mixed schemas)")
+        widest = max(hists, key=lambda p: p.state["hist_sum"].shape[-1])
+        bmax = widest.state["hist_sum"].shape[-1]
+        for i, p in enumerate(partials):
+            h = np.asarray(p.state["hist_sum"])
+            if h.shape[-1] < bmax:
+                padded = np.pad(
+                    h, [(0, 0)] * (h.ndim - 1) + [(0, bmax - h.shape[-1])],
+                    mode="edge")
+                # copy-on-write: the input partial stays self-consistent
+                # (its own hist_sum width must keep matching bucket_tops)
+                partials[i] = dataclasses.replace(
+                    p, state={**p.state, "hist_sum": padded})
+        return widest.bucket_tops
+
     def reduce(self, partials):
         first = partials[0]
+        tops = self._align_hist_widths(partials)
         keys, aligned = _align(partials, np.nan)
         state = {}
         for n, arrs in aligned.items():
-            if n in ("sum", "sumsq"):
+            if n in ("sum", "sumsq", "hist_sum"):
                 state[n] = _nansum_stack(arrs)
             elif n == "count":
                 zeroed = [np.nan_to_num(a, nan=0.0) for a in arrs]
@@ -172,10 +222,17 @@ class MomentAggregator(Aggregator):
                 state[n] = np.nanmin(np.stack(arrs), axis=0)
             elif n == "max":
                 state[n] = np.nanmax(np.stack(arrs), axis=0)
-        return AggPartialBatch(self.op, first.params, keys, first.steps, state)
+        return AggPartialBatch(self.op, first.params, keys, first.steps, state,
+                               bucket_tops=tops)
 
     def present(self, p):
         s = p.state
+        if "hist_sum" in s:
+            n = np.asarray(s["count"])
+            hist = np.where(n[..., None] > 0, s["hist_sum"], np.nan)
+            return PeriodicBatch(p.group_keys, p.steps,
+                                 np.full(n.shape, np.nan), hist=hist,
+                                 bucket_tops=p.bucket_tops)
         if self.op == Op.SUM:
             vals = np.where(s["count"] > 0, s["sum"], np.nan)
         elif self.op == Op.COUNT:

@@ -231,7 +231,11 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                               column_id)
         if got is None:
             return None
-        tags, vals = got
+        tags, vals, tops = got
+        if vals.ndim == 3:      # histogram column: per-bucket [S, T, hb]
+            return [PeriodicBatch(tags, report,
+                                  np.full(vals.shape[:2], np.nan),
+                                  hist=vals, bucket_tops=tops)]
         return [PeriodicBatch(tags, report, vals)]
 
     def _try_grid_aggregated(self, shard, part_ids, column_id, mapper,
@@ -260,8 +264,10 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             self._GRID_AGG_OPS[mapred.operator.name], column_id)
         if state is None:
             return None
+        tops = state.pop("bucket_tops", None)
         return [AggPartialBatch(mapred.operator, (),
-                                [dict(k) for k in union], report, state)]
+                                [dict(k) for k in union], report, state,
+                                bucket_tops=tops)]
 
     def _args_str(self) -> str:
         return f"dataset={self.dataset}, shard={self.shard}, " \

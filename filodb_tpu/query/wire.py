@@ -240,7 +240,8 @@ def serialize_result(result: QueryResult) -> dict:
                 "params": list(b.params), "group_keys": b.group_keys,
                 "steps": _enc_steps(b.steps),
                 "state": {k: _enc_array(v) for k, v in b.state.items()},
-                "series_keys": b.series_keys})
+                "series_keys": b.series_keys,
+                "bucket_tops": _enc_array(b.bucket_tops)})
         elif isinstance(b, ScalarResult):
             batches.append({"type": "ScalarResult",
                             "steps": _enc_steps(b.steps),
@@ -278,7 +279,8 @@ def deserialize_result(d: dict) -> QueryResult:
                 AggregationOperator[b["op"]], tuple(b["params"]),
                 b["group_keys"], _dec_steps(b["steps"]),
                 {k: _dec_array(v) for k, v in b["state"].items()},
-                series_keys=b.get("series_keys")))
+                series_keys=b.get("series_keys"),
+                bucket_tops=_dec_array(b.get("bucket_tops"))))
         elif kind == "ScalarResult":
             batches.append(ScalarResult(_dec_steps(b["steps"]),
                                         _dec_array(b["values"])))

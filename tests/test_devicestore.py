@@ -73,7 +73,7 @@ class TestDeviceGrid:
         got = shard.scan_grid(res.part_ids, F.RATE, steps0, nsteps, STEP,
                               WINDOW)
         assert got is not None, "grid path should serve this query"
-        tags, vals = got
+        tags, vals, _tops = got
         # general path oracle
         t2, batch = shard.scan_batch(res.part_ids, steps0 - WINDOW,
                                      steps0 + (nsteps - 1) * STEP)
@@ -168,7 +168,7 @@ class TestDeviceGrid:
         cache = next(iter(shard.device_caches.values()))
         assert cache.hits > 0 and cache.dense_hits == 0
         # the gappy lane still produces finite rates (2+ samples/window)
-        tags_out, vals = got
+        tags_out, vals, _tops = got
         gi = next(i for i, t in enumerate(tags_out)
                   if t.get("instance") == "gappy")
         assert np.isfinite(vals[gi]).any()
@@ -344,6 +344,108 @@ class TestGridAggregatedServing:
         fin = np.isfinite(vp)
         assert (np.isfinite(vf) == fin).all()
         np.testing.assert_allclose(vf[fin], vp[fin], rtol=1e-4)
+
+
+class TestHistGridServing:
+    """First-class histogram columns on the device grid: each partition
+    slot spans hb bucket lanes; the scalar kernel computes per-bucket
+    rates (reference: per-bucket HistRateFunction + HistSumRowAggregator
+    fused on device)."""
+
+    HSTEP = 10_000
+    HWINDOW = 50_000
+    HK = 5
+
+    def _mk_hist_shard(self, n_series=3, n_rows=60):
+        from tests.data import START_TS, histogram_containers
+        ms = TimeSeriesMemStore()
+        shard = ms.setup("prom", DEFAULT_SCHEMAS, 0, StoreConfig())
+        for off, c in enumerate(histogram_containers(
+                n_series=n_series, n_samples=n_rows)):
+            shard.ingest(decode_container(c, DEFAULT_SCHEMAS), off)
+        shard.flush_all()
+        return ms, shard, START_TS
+
+    def test_hist_rate_matches_host_kernel(self):
+        from filodb_tpu.ops.windows import StepRange
+        from filodb_tpu.query import rangefns
+
+        ms, shard, t0 = self._mk_hist_shard()
+        res = shard.lookup_partitions(
+            [ColumnFilter("_metric_", Equals("req_latency"))], 0, 2**62)
+        steps0 = t0 + (self.HK - 1) * self.HSTEP
+        nsteps = 40
+        got = shard.scan_grid(res.part_ids, F.RATE, steps0, nsteps,
+                              self.HSTEP, self.HWINDOW)
+        assert got is not None, "hist grid should serve this query"
+        tags, vals, tops = got
+        assert vals.ndim == 3 and vals.shape[2] == len(tops)
+        cache = next(iter(shard.device_caches.values()))
+        assert cache.hist and cache.hits > 0 and cache.dense_hits > 0
+        # oracle: scan_batch + the host per-bucket kernel
+        end = steps0 + (nsteps - 1) * self.HSTEP
+        t2, batch = shard.scan_batch(res.part_ids, steps0 - self.HWINDOW,
+                                     end)
+        sr = StepRange(steps0, end, self.HSTEP)
+        want = np.asarray(rangefns.apply_range_function(
+            batch, sr, self.HWINDOW, F.RATE))[:len(tags)]
+        got_v = np.asarray(vals)
+        assert (np.isfinite(got_v) == np.isfinite(want)).all()
+        fin = np.isfinite(want)
+        np.testing.assert_allclose(got_v[fin], want[fin], rtol=1e-4)
+
+    def test_fused_hist_sum_quantile_matches_host(self):
+        """sum(rate(latency[w])) + histogram_quantile fully on the grid
+        (BASELINE config 2) vs the disabled-grid host oracle."""
+        from filodb_tpu.query.exec import (ExecContext,
+                                           MultiSchemaPartitionsExec,
+                                           ReduceAggregateExec)
+        from filodb_tpu.query.logical import (AggregationOperator,
+                                              InstantFunctionId)
+        from filodb_tpu.query.model import QueryContext
+        from filodb_tpu.query.transformers import (
+            AggregateMapReduce, AggregatePresenter,
+            InstantVectorFunctionMapper, PeriodicSamplesMapper)
+
+        ms, shard, t0 = self._mk_hist_shard()
+        steps0 = t0 + (self.HK - 1) * self.HSTEP
+        nsteps = 40
+        end = steps0 + (nsteps - 1) * self.HSTEP
+
+        def mk():
+            leaf = MultiSchemaPartitionsExec(
+                "prom", 0, [ColumnFilter("_metric_", Equals("req_latency"))],
+                steps0 - self.HWINDOW, end)
+            leaf.add_transformer(PeriodicSamplesMapper(
+                start_ms=steps0, step_ms=self.HSTEP, end_ms=end,
+                window_ms=self.HWINDOW, function=F.RATE))
+            leaf.add_transformer(AggregateMapReduce(AggregationOperator.SUM))
+            root = ReduceAggregateExec([leaf], AggregationOperator.SUM)
+            root.add_transformer(AggregatePresenter(AggregationOperator.SUM))
+            root.add_transformer(InstantVectorFunctionMapper(
+                InstantFunctionId.HISTOGRAM_QUANTILE, (0.9,)))
+            return root
+
+        fused = mk().execute(ExecContext(ms, QueryContext()))
+        cache = next(iter(shard.device_caches.values()))
+        assert cache.hist and cache.hits >= 1
+        cache.disabled_until_version = shard.ingest_epoch + 10**9
+        plain = mk().execute(ExecContext(ms, QueryContext()))
+        vf = np.asarray(fused.batches[0].np_values()[0])
+        vp = np.asarray(plain.batches[0].np_values()[0])
+        fin = np.isfinite(vp)
+        assert fin.any()
+        assert (np.isfinite(vf) == fin).all()
+        np.testing.assert_allclose(vf[fin], vp[fin], rtol=1e-4)
+
+    def test_hist_unsupported_fn_falls_back(self):
+        ms, shard, t0 = self._mk_hist_shard()
+        res = shard.lookup_partitions(
+            [ColumnFilter("_metric_", Equals("req_latency"))], 0, 2**62)
+        steps0 = t0 + (self.HK - 1) * self.HSTEP
+        # min_over_time has no histogram semantics: grid must decline
+        assert shard.scan_grid(res.part_ids, F.MIN_OVER_TIME, steps0, 10,
+                               self.HSTEP, self.HWINDOW) is None
 
 
 class TestGridOverTimeServing:

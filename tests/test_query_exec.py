@@ -351,6 +351,76 @@ class TestTransformers:
         assert np.isfinite(v).any()
         assert (v[np.isfinite(v)] >= 0).all()
 
+    def test_sum_over_histograms_bucketwise(self, ctx):
+        """sum(rate(hist)) aggregates bucket-wise (reference:
+        HistSumRowAggregator) and histogram_quantile applies on top —
+        the BASELINE config-2 query shape."""
+        from filodb_tpu.ops import histogram_ops
+        from filodb_tpu.query.aggregators import AggPartialBatch
+        from filodb_tpu.query.logical import AggregationOperator
+        from filodb_tpu.query.transformers import AggregateMapReduce, AggregatePresenter
+        import jax.numpy as jnp
+
+        # oracle: per-series hist rates, summed on host, then quantile
+        per = self.periodic(ctx, metric="req_latency", fn=RangeFunctionId.RATE)
+        rb = per.execute(ctx).batches[0]
+        S = len(rb.keys)
+        h = np.asarray(rb.hist)[:S]                       # [S, T, B]
+        fin = np.isfinite(h[..., -1])
+        want_hist = np.where(fin[..., None], h, 0.0).sum(axis=0)
+        want_hist = np.where(fin.any(axis=0)[..., None], want_hist, np.nan)
+        want_q = np.asarray(histogram_ops.hist_quantile(
+            jnp.asarray(rb.bucket_tops), jnp.asarray(want_hist[None]), 0.99))[0]
+
+        p = self.periodic(ctx, metric="req_latency", fn=RangeFunctionId.RATE)
+        p.add_transformer(AggregateMapReduce(AggregationOperator.SUM))
+        p.add_transformer(AggregatePresenter(AggregationOperator.SUM))
+        p.add_transformer(InstantVectorFunctionMapper(
+            InstantFunctionId.HISTOGRAM_QUANTILE, (0.99,)))
+        res = p.execute(ctx)
+        b = res.batches[0]
+        got = b.np_values()[0]
+        assert (np.isfinite(got) == np.isfinite(want_q)).all()
+        both = np.isfinite(got)
+        assert both.any()
+        np.testing.assert_allclose(got[both], want_q[both], rtol=1e-6)
+
+    def test_hist_sum_reduce_pads_bucket_widths(self, ctx):
+        """Cross-shard reduce of histogram sums with different bucket
+        schemes: narrower cumulative matrices edge-pad to the widest."""
+        from filodb_tpu.ops.windows import StepRange
+        from filodb_tpu.query.aggregators import (AggPartialBatch,
+                                                  MomentAggregator)
+        from filodb_tpu.query.logical import AggregationOperator
+
+        steps = StepRange(0, 60_000, 60_000)
+        agg = MomentAggregator(AggregationOperator.SUM)
+        wide = AggPartialBatch(
+            AggregationOperator.SUM, (), [{}], steps,
+            {"hist_sum": np.ones((1, 2, 4)), "count": np.ones((1, 2))},
+            bucket_tops=np.array([0.1, 0.5, 1.0, np.inf]))
+        narrow = AggPartialBatch(
+            AggregationOperator.SUM, (), [{}], steps,
+            {"hist_sum": np.full((1, 2, 2), 2.0), "count": np.ones((1, 2))},
+            bucket_tops=np.array([0.1, np.inf]))
+        out = agg.reduce([wide, narrow])
+        assert out.state["hist_sum"].shape == (1, 2, 4)
+        # narrow's top bucket (total=2) edge-pads across the widened tail
+        np.testing.assert_allclose(out.state["hist_sum"][0, 0], [3, 3, 3, 3])
+        np.testing.assert_allclose(out.bucket_tops, [0.1, 0.5, 1.0, np.inf])
+        pres = agg.present(out)
+        assert pres.hist.shape == (1, 2, 4)
+
+    def test_min_over_histograms_rejected(self, ctx):
+        from filodb_tpu.query.logical import AggregationOperator
+        from filodb_tpu.query.transformers import AggregateMapReduce
+        from filodb_tpu.query.model import QueryError
+
+        p = self.periodic(ctx, metric="req_latency", fn=RangeFunctionId.RATE)
+        p.add_transformer(AggregateMapReduce(AggregationOperator.MIN))
+        with pytest.raises(QueryError, match="histogram"):
+            p.execute(ctx)
+
     def test_hist_to_prom_and_bucket_quantile(self, ctx):
         p = self.periodic(ctx, metric="req_latency",
                           fn=RangeFunctionId.SUM_OVER_TIME)
