@@ -1,0 +1,69 @@
+"""Batch downsampler rollup throughput (BASELINE config 4).
+
+The offline raw -> 1m -> 15m -> 1h rollup the reference runs as a Spark
+job (reference: spark-jobs/.../DownsamplerMain.scala:43 ->
+BatchDownsampler.downsampleBatch): pages raw chunks from the column
+store, applies the per-schema ChunkDownsamplers, writes downsample
+datasets back.  Here the same kernels run under the in-repo batch
+driver over (shard x ingestion-time) splits."""
+
+import sys
+import pathlib
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np  # noqa: E402
+
+from benches.common import emit, force_cpu_x64, log, timed  # noqa: E402
+
+force_cpu_x64()
+
+from filodb_tpu.core.record import RecordBuilder  # noqa: E402
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions  # noqa: E402
+from filodb_tpu.downsample import BatchDownsampler  # noqa: E402
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore  # noqa: E402
+from filodb_tpu.store.persistence import (DiskColumnStore,  # noqa: E402
+                                          DiskMetaStore)
+
+N_SERIES = 200
+N_ROWS = 720             # 1h of 5s scrapes per series
+T0 = 1_600_000_000_000
+STEP = 5_000
+RESOLUTIONS = (60_000, 900_000, 3_600_000)   # 1m / 15m / 1h
+
+
+def main():
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as tmp:
+        disk = DiskColumnStore(str(pathlib.Path(tmp) / "c.db"))
+        meta = DiskMetaStore(str(pathlib.Path(tmp) / "m.db"))
+        store = TimeSeriesMemStore(disk, meta)
+        store.setup("prom", DEFAULT_SCHEMAS, 0)
+        b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], DatasetOptions())
+        ts = T0 + np.arange(N_ROWS, dtype=np.int64) * STEP
+        for i in range(N_SERIES):
+            tags = {"_metric_": "disk_io", "instance": f"i{i}",
+                    "_ws_": "w", "_ns_": "n"}
+            b.add_series(ts, [rng.random(N_ROWS) * 100], tags)
+        for off, c in enumerate(b.containers()):
+            store.ingest("prom", 0, c, offset=off)
+        store.get_shard("prom", 0).flush_all(ingestion_time=1000)
+        total = N_SERIES * N_ROWS
+        log(f"{total} raw samples flushed; rolling up to "
+            f"{[r // 60000 for r in RESOLUTIONS]} min resolutions")
+
+        def rollup():
+            job = BatchDownsampler("prom", DEFAULT_SCHEMAS, disk,
+                                   resolutions_ms=RESOLUTIONS)
+            written = job.run_shard(0, 0, 2**62)
+            assert all(written[r] > 0 for r in RESOLUTIONS)
+            return written
+
+        t = timed(rollup, reps=3)
+        emit("batch downsampler rollup (raw->1m/15m/1h)", total / t,
+             "raw samples/sec")
+
+
+if __name__ == "__main__":
+    main()

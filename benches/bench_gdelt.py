@@ -1,0 +1,91 @@
+"""Multi-column event scan + topK throughput (BASELINE config 5).
+
+The GDELT-style workload the reference served through its (dormant)
+Spark DataSource: a wide event schema, column-selected scan over every
+partition, and topK ranking by a chosen numeric column (reference:
+doc/FiloDB_GDELT.snb "top actors" analysis; SURVEY §2.6 maps the
+capability onto the multi-schema columnar core)."""
+
+import sys
+import pathlib
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np  # noqa: E402
+
+from benches.common import emit, force_cpu_x64, log, timed  # noqa: E402
+
+force_cpu_x64()
+
+from filodb_tpu.core.filters import ColumnFilter, Equals  # noqa: E402
+from filodb_tpu.core.record import RecordBuilder, decode_container  # noqa: E402
+from filodb_tpu.core.schemas import DatasetOptions, Schemas  # noqa: E402
+from filodb_tpu.core.storeconfig import StoreConfig  # noqa: E402
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore  # noqa: E402
+from filodb_tpu.query.exec import (ExecContext,  # noqa: E402
+                                   MultiSchemaPartitionsExec,
+                                   ReduceAggregateExec)
+from filodb_tpu.query.logical import (AggregationOperator,  # noqa: E402
+                                      RangeFunctionId)
+from filodb_tpu.query.model import QueryContext  # noqa: E402
+from filodb_tpu.query.transformers import (AggregateMapReduce,  # noqa: E402
+                                           AggregatePresenter,
+                                           PeriodicSamplesMapper)
+
+SCHEMAS = Schemas.from_config({
+    "gdelt-event": {
+        "columns": ["timestamp:ts", "avg_tone:double", "num_mentions:double",
+                    "num_articles:double"],
+        "value-column": "avg_tone",
+        "downsamplers": [],
+    },
+})
+
+N_ACTORS = 1_000
+N_EVENTS = 200           # events per actor
+T0 = 1_600_000_000_000
+STEP = 3_600_000         # hourly events
+WINDOW = N_EVENTS * STEP
+STEPS0 = T0 + (N_EVENTS - 1) * STEP + 1
+
+
+def main():
+    rng = np.random.default_rng(0)
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("gdelt", SCHEMAS, 0, StoreConfig())
+    b = RecordBuilder(SCHEMAS["gdelt-event"], DatasetOptions())
+    ts = T0 + np.arange(N_EVENTS, dtype=np.int64) * STEP
+    for ai in range(N_ACTORS):
+        tags = {"_metric_": "events", "actor": f"A{ai:04d}", "_ws_": "g",
+                "_ns_": "news"}
+        b.add_series(ts, [rng.normal(0, 3, N_EVENTS),
+                          rng.integers(1, 50, N_EVENTS).astype(float),
+                          rng.integers(1, 20, N_EVENTS).astype(float)], tags)
+    for off, c in enumerate(b.containers()):
+        shard.ingest(decode_container(c, SCHEMAS), off)
+    shard.flush_all()
+    total = N_ACTORS * N_EVENTS
+    log(f"{total} events across {N_ACTORS} actors ingested")
+
+    def topk_query():
+        leaf = MultiSchemaPartitionsExec(
+            "gdelt", 0, [ColumnFilter("_metric_", Equals("events"))],
+            T0, STEPS0, column="num_mentions")
+        leaf.add_transformer(PeriodicSamplesMapper(
+            start_ms=STEPS0, step_ms=STEP, end_ms=STEPS0,
+            window_ms=WINDOW, function=RangeFunctionId.SUM_OVER_TIME))
+        leaf.add_transformer(AggregateMapReduce(
+            AggregationOperator.TOPK, params=(10,)))
+        root = ReduceAggregateExec([leaf], AggregationOperator.TOPK, (10,))
+        root.add_transformer(AggregatePresenter(
+            AggregationOperator.TOPK, (10,)))
+        res = root.execute(ExecContext(ms, QueryContext()))
+        return res
+
+    topk_query()     # warm jit
+    t = timed(topk_query)
+    emit("gdelt multi-column scan + top10", total / t, "events/sec")
+
+
+if __name__ == "__main__":
+    main()

@@ -38,7 +38,7 @@ def main():
     import jax.numpy as jnp
 
     from filodb_tpu.ops import histogram_ops
-    from filodb_tpu.ops.grid import GridQuery, rate_grid_grouped
+    from filodb_tpu.ops.grid import GridQuery
 
     on_tpu = jax.default_backend() in ("tpu", "axon")
     # CPU shape must stay large enough that the timed full-minus-base

@@ -31,6 +31,10 @@ class StoreConfig:
     # BlockManager equivalent, reference: memory/BlockManager.scala:142)
     device_cache_bytes: int = 2 * 1024 * 1024 * 1024
     grid_step_ms: Optional[int] = None   # bucket width; None = detect
+    # proactive reclaim target: flush tasks trim each device cache to
+    # (1-frac) of budget off the query path (reference: BlockManager
+    # ensureHeadroomPercentAvailable headroom task)
+    device_headroom_frac: float = 0.1
 
     @staticmethod
     def from_config(conf: Mapping) -> "StoreConfig":
@@ -62,6 +66,8 @@ class StoreConfig:
                                                    d.device_cache_bytes)),
             grid_step_ms=(parse_duration_ms(conf["grid-step"])
                           if "grid-step" in conf else None),
+            device_headroom_frac=float(
+                conf.get("device-headroom-frac", d.device_headroom_frac)),
         )
 
 

@@ -233,6 +233,11 @@ class DeviceGridCache:
         self.disabled_until_version = self._shard.ingest_epoch + backoff
         self.blocks.clear()
         self._tails.clear()
+        # re-probe the bucket scheme on the next attempt: a widened
+        # histogram (16 -> 20 buckets) must not disable the fast path
+        # forever once the narrow chunks age out
+        self.hb = None
+        self.bucket_tops = None
 
     # ---------------------------------------------------------------- serving
 
@@ -578,12 +583,27 @@ class DeviceGridCache:
         return _Block(jax.device_put(ts_stage), jax.device_put(val_stage),
                       lanes, self._seq, (fmin, fmax, fcnt))
 
-    def _evict(self, keep: set) -> None:
-        """Oldest-first reclaim under the byte budget (the reference's
-        reclaim-on-demand over time-ordered block lists)."""
-        while self.bytes_resident > self.budget and len(self.blocks) > 1:
+    def _reclaim(self, target_bytes: int, keep: set) -> int:
+        """Oldest-first reclaim down to ``target_bytes`` (the reference's
+        reclaim-on-demand over time-ordered block lists).  Caller holds
+        the lock.  Returns bytes freed."""
+        freed = 0
+        while self.bytes_resident > target_bytes and len(self.blocks) > 1:
             victims = [bi for bi in sorted(self.blocks) if bi not in keep]
             if not victims:
                 break
+            freed += self.blocks[victims[0]].nbytes
             del self.blocks[victims[0]]
             self.evictions += 1
+        return freed
+
+    def _evict(self, keep: set) -> None:
+        self._reclaim(self.budget, keep)
+
+    def ensure_headroom(self, frac: float) -> int:
+        """Proactive reclaim down to ``(1-frac)`` of the budget, run OFF
+        the query path (the shard calls it from flush tasks) so queries
+        rarely pay inline eviction — the reference's background headroom
+        task (BlockManager.scala ensureHeadroomPercentAvailable :142)."""
+        with self._lock:
+            return self._reclaim(int(self.budget * (1.0 - frac)), set())

@@ -357,6 +357,13 @@ class TimeSeriesShard:
             self.group_watermarks[task.group], task.offset)
         self.stats.chunks_flushed += len(chunksets)
         self.stats.flushes_done += 1
+        # proactive HBM reclaim off the query path: trim device caches
+        # to (1-headroom) of budget while we're already on the flush
+        # executor (the reference's background headroom task)
+        frac = self.config.device_headroom_frac
+        if frac > 0:
+            for cache in list(self.device_caches.values()):
+                cache.ensure_headroom(frac)
         return len(chunksets)
 
     def flush_group(self, group: int, ingestion_time: Optional[int] = None) -> int:

@@ -134,6 +134,27 @@ class TestDeviceGrid:
         assert shard.scan_grid(res.part_ids, F.DERIV, steps0, nsteps,
                                STEP, WINDOW) is None
 
+    def test_flush_headroom_trims_below_budget(self):
+        """The flush task proactively reclaims device blocks down to
+        (1-headroom) of budget, so queries rarely pay inline eviction
+        (reference: BlockManager ensureHeadroomPercentAvailable)."""
+        ms, shard, _ = _mk_shard(n_rows=300, device_cache_bytes=300_000,
+                                 device_headroom_frac=0.5)
+        res = _lookup(shard)
+        steps0, nsteps = _steps(300)
+        got = shard.scan_grid(res.part_ids, F.RATE, steps0, nsteps, STEP,
+                              WINDOW)
+        assert got is not None
+        cache = next(iter(shard.device_caches.values()))
+        resident_before = cache.bytes_resident
+        assert resident_before > 0
+        freed = cache.ensure_headroom(shard.config.device_headroom_frac)
+        assert freed > 0
+        assert cache.bytes_resident <= 300_000 * 0.5 + 1
+        # the flush path drives it automatically
+        shard.flush_all()
+        assert cache.bytes_resident <= 300_000 * 0.5 + 1
+
     def test_dense_contract_detected(self):
         """Regular scrapes with no holes: the store proves the
         dense-lane contract from per-block fill ranges and dispatches

@@ -1,0 +1,152 @@
+"""Multi-column event scans + topK — the GDELT use case.
+
+The reference's dormant spark/ DataSource existed for wide event tables
+(GDELT notebook, reference: doc/FiloDB_GDELT.snb; SURVEY §2.6 maps the
+capability onto the multi-schema columnar core).  These tests prove the
+core serves it natively: a wide event schema (several numeric columns +
+a string column), per-column selected scans, group-by aggregation over
+a chosen column, and topK ranking — all through the same ExecPlan
+machinery the Prometheus path uses.
+"""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.filters import ColumnFilter, Equals
+from filodb_tpu.core.record import RecordBuilder, decode_container
+from filodb_tpu.core.schemas import DatasetOptions, Schemas
+from filodb_tpu.core.storeconfig import StoreConfig
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.query.exec import (ExecContext, MultiSchemaPartitionsExec,
+                                   ReduceAggregateExec)
+from filodb_tpu.query.logical import (AggregationOperator, RangeFunctionId)
+from filodb_tpu.query.model import QueryContext
+from filodb_tpu.query.transformers import (AggregateMapReduce,
+                                           AggregatePresenter,
+                                           PeriodicSamplesMapper)
+
+GDELT_SCHEMAS = Schemas.from_config({
+    "gdelt-event": {
+        "columns": ["timestamp:ts", "avg_tone:double", "num_mentions:double",
+                    "num_articles:double", "event_code:string"],
+        "value-column": "avg_tone",
+        "downsamplers": [],
+    },
+})
+
+T0 = 1_600_000_000_000
+DAY = 86_400_000
+N_DAYS = 30
+ACTORS = ["USA", "CHN", "RUS", "DEU", "FRA", "GBR", "IND", "BRA"]
+
+
+def _mk_store(seed=0):
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("gdelt", GDELT_SCHEMAS, 0, StoreConfig())
+    rng = np.random.default_rng(seed)
+    b = RecordBuilder(GDELT_SCHEMAS["gdelt-event"], DatasetOptions())
+    truth = {}
+    for ai, actor in enumerate(ACTORS):
+        tags = {"_metric_": "events", "actor": actor, "_ws_": "g",
+                "_ns_": "news"}
+        ts = T0 + np.arange(N_DAYS, dtype=np.int64) * DAY
+        tone = rng.normal(0, 3, N_DAYS)
+        mentions = rng.integers(1, 50, N_DAYS).astype(float) * (ai + 1)
+        articles = rng.integers(1, 20, N_DAYS).astype(float)
+        codes = [f"{rng.integers(10, 20):03d}" for _ in range(N_DAYS)]
+        truth[actor] = (ts, tone, mentions, articles, codes)
+        for i in range(N_DAYS):
+            b.add(int(ts[i]), [tone[i], mentions[i], articles[i], codes[i]],
+                  tags)
+    for off, c in enumerate(b.containers()):
+        shard.ingest(decode_container(c, GDELT_SCHEMAS), off)
+    shard.flush_all()
+    return ms, shard, truth
+
+
+WINDOW = N_DAYS * DAY   # one window covering the whole month
+STEPS0 = T0 + (N_DAYS - 1) * DAY + 1
+
+
+def _leaf(column, fn=RangeFunctionId.SUM_OVER_TIME):
+    leaf = MultiSchemaPartitionsExec(
+        "gdelt", 0, [ColumnFilter("_metric_", Equals("events"))],
+        T0, STEPS0, column=column)
+    leaf.add_transformer(PeriodicSamplesMapper(
+        start_ms=STEPS0, step_ms=DAY, end_ms=STEPS0,
+        window_ms=WINDOW, function=fn))
+    return leaf
+
+
+class TestGdeltScans:
+    def test_column_selected_scan(self):
+        """Selecting a non-default column scans that column's chunks."""
+        ms, shard, truth = _mk_store()
+        leaf = _leaf("num_mentions")
+        res = leaf.execute(ExecContext(ms, QueryContext()))
+        got = {b_tags["actor"]: float(vals[0])
+               for b in res.batches
+               for b_tags, _ts, vals in b.to_series()}
+        want = {a: truth[a][2].sum() for a in ACTORS}
+        assert set(got) == set(ACTORS)
+        for a in ACTORS:
+            np.testing.assert_allclose(got[a], want[a], rtol=1e-9)
+
+    def test_group_sum_over_column(self):
+        """sum by ()(sum_over_time(num_articles[30d])) — full-table
+        aggregate over a selected column."""
+        ms, shard, truth = _mk_store()
+        leaf = _leaf("num_articles")
+        leaf.add_transformer(AggregateMapReduce(AggregationOperator.SUM))
+        root = ReduceAggregateExec([leaf], AggregationOperator.SUM)
+        root.add_transformer(AggregatePresenter(AggregationOperator.SUM))
+        res = root.execute(ExecContext(ms, QueryContext()))
+        got = float(res.batches[0].np_values()[0][0])
+        want = sum(truth[a][3].sum() for a in ACTORS)
+        np.testing.assert_allclose(got, want, rtol=1e-9)
+
+    def test_topk_actors_by_mentions(self):
+        """topk(3, sum_over_time(num_mentions[30d])) — the GDELT
+        notebook's 'top actors' analysis (reference: doc/FiloDB_GDELT.snb)."""
+        ms, shard, truth = _mk_store()
+        leaf = _leaf("num_mentions")
+        leaf.add_transformer(AggregateMapReduce(
+            AggregationOperator.TOPK, params=(3,)))
+        root = ReduceAggregateExec([leaf], AggregationOperator.TOPK, (3,))
+        root.add_transformer(AggregatePresenter(
+            AggregationOperator.TOPK, (3,)))
+        res = root.execute(ExecContext(ms, QueryContext()))
+        got = {}
+        for b in res.batches:
+            for tags, _ts, vals in b.to_series():
+                v = np.asarray(vals)
+                if np.isfinite(v).any():
+                    got[tags["actor"]] = float(v[np.isfinite(v)][0])
+        totals = {a: truth[a][2].sum() for a in ACTORS}
+        want_top = sorted(totals, key=totals.get, reverse=True)[:3]
+        assert set(got) == set(want_top)
+        for a in want_top:
+            np.testing.assert_allclose(got[a], totals[a], rtol=1e-9)
+
+    def test_string_column_roundtrip(self):
+        """The string column (dict-encoded) survives freeze + scan."""
+        ms, shard, truth = _mk_store()
+        res = shard.lookup_partitions(
+            [ColumnFilter("actor", Equals("USA"))], 0, 2**62)
+        assert len(res.part_ids) == 1
+        part = shard.partitions[int(res.part_ids[0])]
+        cid = part.schema.data.column("event_code").id
+        ts, codes = part.read_range(0, 2**62, cid)
+        # strings read back as UTF-8 bytes (ZeroCopyUTF8String contract)
+        decoded = [c.decode() if isinstance(c, bytes) else c for c in codes]
+        assert decoded == truth["USA"][4]
+        assert len(ts) == N_DAYS
+
+    def test_value_column_default_is_avg_tone(self):
+        ms, shard, truth = _mk_store()
+        leaf = _leaf(None)
+        res = leaf.execute(ExecContext(ms, QueryContext()))
+        got = {t["actor"]: float(v[0]) for b in res.batches
+               for t, _ts, v in b.to_series()}
+        for a in ACTORS:
+            np.testing.assert_allclose(got[a], truth[a][1].sum(), rtol=1e-9)
