@@ -248,14 +248,20 @@ class DeviceGridCache:
         on the query step grid from device-resident blocks.  Returns
         values ``[S_req, T]`` (``[S_req, T, hb]`` per-bucket for
         histogram columns) as numpy, or None when the fast path cannot
-        serve this query (caller falls back)."""
+        serve this query (caller falls back).  Histogram results come
+        paired with the bucket tops snapshotted under the same lock (a
+        concurrent _disable may null ``self.bucket_tops``)."""
         if func not in _GRID_OPS:
             return None
         if self.hist and func not in _HIST_GRID_FNS:
             return None
         with self._lock:
-            return self._scan_rate_locked(list(map(int, part_ids)), func,
+            vals = self._scan_rate_locked(list(map(int, part_ids)), func,
                                           steps0, nsteps, step_ms, window_ms)
+            if vals is None:
+                return None
+            tops = np.asarray(self.bucket_tops) if self.hist else None
+        return vals, tops
 
     def scan_rate_grouped(self, part_ids: Sequence[int], func: F,
                           steps0: int, nsteps: int, step_ms: int,
@@ -281,6 +287,7 @@ class DeviceGridCache:
                 return None
             stepped, ncols = got
             stride = self.hb if self.hist else 1
+            tops = np.asarray(self.bucket_tops) if self.hist else None
             garr = np.full(ncols, num_groups * stride, dtype=np.int32)
             lane_idx = np.fromiter((self.lane_of[p] for p in ids),
                                    dtype=np.int64, count=len(ids))
@@ -302,7 +309,7 @@ class DeviceGridCache:
             hist_sum = both[0].reshape(G, hb, T).transpose(0, 2, 1)
             count = both[1].reshape(G, hb, T)[:, -1, :]  # total bucket
             return {"hist_sum": hist_sum, "count": count,
-                    "bucket_tops": np.asarray(self.bucket_tops)}
+                    "bucket_tops": tops}
         if op in ("sum", "avg", "count"):
             # ONE host readback of the stacked [2, G, T]: each blocked
             # transfer pays the tunnel round-trip
@@ -351,7 +358,7 @@ class DeviceGridCache:
                 return None
             self.gstep = g
         g = self.gstep
-        if not supports_grid(window_ms, step_ms, g):
+        if not supports_grid(window_ms, step_ms, g, nsteps):
             return None
         if self.hist and self.hb is None:
             # probe a narrow leading slice for the bucket scheme — a
@@ -373,9 +380,11 @@ class DeviceGridCache:
         if (steps0 - self.epoch0) % g != 0:
             return None                        # windows don't land on edges
         K = window_ms // g
-        # first window ends at steps0 and covers buckets [c0, c0+K-1]
+        stride_r = step_ms // g                # query step in buckets
+        # first window ends at steps0 and covers buckets [c0, c0+K-1];
+        # window t starts stride_r buckets after window t-1
         c0 = (steps0 - self.epoch0) // g - K + 1
-        c_last = c0 + (nsteps - 1) + K - 1     # inclusive
+        c_last = c0 + (nsteps - 1) * stride_r + K - 1     # inclusive
         if c0 < 0:
             return None
         if hasattr(shard, "paged"):
@@ -446,8 +455,11 @@ class DeviceGridCache:
             self.dense_hits += 1
         q = GridQuery(nsteps=nsteps, kbuckets=K, gstep_ms=g,
                       is_rate=(func == F.RATE), op=_GRID_OPS[func],
-                      dense=dense)
-        lane_mult = 1024 if ts_sl.shape[1] % 1024 == 0 else _LANE_PAD
+                      dense=dense, stride=stride_r)
+        # tall strided slices read more input rows per tile: keep the
+        # VMEM footprint bounded by narrowing the lane tile
+        lane_mult = 1024 if (ts_sl.shape[1] % 1024 == 0
+                             and ts_sl.shape[0] <= 256) else _LANE_PAD
         out = rate_grid_auto(ts_sl, val_sl, steps0 - self.epoch0, q,
                              lanes=lane_mult)            # [T, lanes]
         self.hits += 1

@@ -532,16 +532,18 @@ class TimeSeriesShard:
         if got is None:
             return None
         cache, ids = got
-        vals = cache.scan_rate(ids, func, steps0, nsteps, step_ms, window_ms)
-        if vals is None:
+        served = cache.scan_rate(ids, func, steps0, nsteps, step_ms,
+                                 window_ms)
+        if served is None:
             return None
+        vals, tops = served
         tags_list = []
         for pid in ids:
             part = self.partitions.get(pid)
             if part is None:
                 return None   # concurrently evicted mid-query: fall back
             tags_list.append(part.tags)
-        return tags_list, vals, cache.bucket_tops
+        return tags_list, vals, tops
 
     def scan_grid_grouped(self, part_ids: Sequence[int], func, steps0: int,
                           nsteps: int, step_ms: int, window_ms: int,

@@ -57,6 +57,32 @@ from jax.experimental.pallas import tpu as pltpu
 _IBIG = 2**30
 
 
+def _win_slicer(q: "GridQuery", ns: int):
+    """Window-indexed slice: row d of window t is input row t*stride+d,
+    so slicing at offset d with row-stride q.stride yields the [T, ns]
+    tile of every window's d-th row — static slices, no gathers.
+
+    Only the portable reference path takes stride here: Mosaic cannot
+    lower a strided sublane slice (vector.extract_strided_slice requires
+    stride 1), so the Pallas wrappers run the stride-1 fine grid and
+    subsample OUTSIDE the kernel (see _fine_query)."""
+    T = q.nsteps
+    if q.stride == 1:
+        return lambda x, d: jax.lax.slice(x, (d, 0), (d + T, ns))
+    return lambda x, d: jax.lax.slice(
+        x, (d, 0), (d + (T - 1) * q.stride + 1, ns), (q.stride, 1))
+
+
+def _fine_query(q: "GridQuery") -> "GridQuery":
+    """The stride-1 query computing every bucket-edge window of q's
+    range: q's window t is fine window t*stride."""
+    return q._replace(nsteps=(q.nsteps - 1) * q.stride + 1, stride=1)
+
+
+def _rows_needed(q: "GridQuery") -> int:
+    return (q.nsteps - 1) * q.stride + q.kbuckets
+
+
 class GridQuery(NamedTuple):
     """Static kernel configuration for one (shape, query-grid) signature.
 
@@ -70,8 +96,8 @@ class GridQuery(NamedTuple):
     predate ``op``; it is honored only when op is "rate"/"increase".
 
     ``dense`` asserts the **dense-lane contract**: over the used rows
-    ``[0, nsteps + kbuckets - 1)`` every lane is either finite in ALL
-    rows or finite in NONE (rows beyond the used range are
+    ``[0, (nsteps-1)*stride + kbuckets)`` every lane is either finite in
+    ALL rows or finite in NONE (rows beyond the used range are
     unconstrained).  Regular scrapes with no missed samples — the
     dominant production shape and the QueryInMemoryBenchmark shape —
     satisfy it.  The kernel then skips the NaN-hole forward-fill and
@@ -84,10 +110,16 @@ class GridQuery(NamedTuple):
 
     nsteps: int       # T output steps
     kbuckets: int     # K = window // gstep buckets per window
-    gstep_ms: int     # bucket width == query step
+    gstep_ms: int     # bucket width (== query step when stride == 1)
     is_rate: bool = True   # rate() vs increase() (when op is rate-like)
     op: str = "rate"
     dense: bool = False
+    # query step = stride * gstep: window t covers input rows
+    # [t*stride, t*stride + K - 1].  Dashboards commonly query with a
+    # coarser step than the scrape cadence (step 5m over 1m data);
+    # strided static slices keep those on the fast path without
+    # computing the skipped windows.
+    stride: int = 1
 
 
 def _correct_and_mask(ts, vals, roll):
@@ -145,9 +177,8 @@ def _window_stats_dense(ts, vals, vcorr, q: GridQuery):
     first/last are static slices and the finite count is ``K`` exactly
     (0 for empty lanes)."""
     ns = ts.shape[1]
-    T = q.nsteps
     dt = vcorr.dtype
-    sl = lambda x, d: jax.lax.slice(x, (d, 0), (d + T, ns))
+    sl = _win_slicer(q, ns)
     live = jnp.isfinite(sl(vals, 0))
     nf = jnp.asarray(q.kbuckets, dt) * live.astype(dt)
     return nf, sl(ts, 0), sl(ts, q.kbuckets - 1), sl(vcorr, 0), \
@@ -157,11 +188,11 @@ def _window_stats_dense(ts, vals, vcorr, q: GridQuery):
 def _window_stats(ts, fin, vcorr, q: GridQuery):
     """First/last finite sample (ts and corrected value) + finite count
     per window, via K forward/backward select passes over static
-    sublane slices: window t covers rows [t, t+K-1]."""
+    sublane slices: window t covers rows [t*stride, t*stride+K-1]."""
     ns = ts.shape[1]
     T = q.nsteps
     dt = vcorr.dtype
-    sl = lambda x, d: jax.lax.slice(x, (d, 0), (d + T, ns))
+    sl = _win_slicer(q, ns)
     shape = (T, ns)
     nf = jnp.zeros(shape, dt)
     t2 = jnp.full(shape, _IBIG, ts.dtype)
@@ -187,7 +218,7 @@ def _extrapolate(nf, t1, t2, v1, v2, steps0, q: GridQuery):
     dt = v1.dtype
     window = q.kbuckets * q.gstep_ms
     tcol = jax.lax.broadcasted_iota(jnp.int32, (q.nsteps, ns), 0)
-    hi = (steps0 + tcol * jnp.int32(q.gstep_ms)).astype(dt)
+    hi = (steps0 + tcol * jnp.int32(q.gstep_ms * q.stride)).astype(dt)
     lo = hi - jnp.asarray(window, dt)
     t1f = t1.astype(dt)
     t2f = t2.astype(dt)
@@ -217,9 +248,8 @@ def _agg_block_dense(ts, vals, q: GridQuery):
     NaN in empty lanes propagates through the accumulation and the
     single ``live`` mask finishes the job."""
     ns = ts.shape[1]
-    T = q.nsteps
     dt = vals.dtype
-    sl = lambda x, d: jax.lax.slice(x, (d, 0), (d + T, ns))
+    sl = _win_slicer(q, ns)
     if q.op == "last":
         return sl(vals, q.kbuckets - 1)
     live = jnp.isfinite(sl(vals, 0))
@@ -248,7 +278,7 @@ def _agg_block(ts, vals, q: GridQuery):
     T = q.nsteps
     dt = vals.dtype
     fin = jnp.isfinite(vals)
-    sl = lambda x, d: jax.lax.slice(x, (d, 0), (d + T, ns))
+    sl = _win_slicer(q, ns)
     shape = (T, ns)
     if q.op == "last":
         v2 = jnp.full(shape, jnp.nan, dt)
@@ -324,9 +354,15 @@ def rate_grid(ts, vals, steps0, q: GridQuery, lanes: int = 1024,
     if ns % lanes != 0 or ns == 0:
         raise ValueError(f"series count {ns} must be a non-zero multiple of "
                          f"lanes={lanes} (pad with NaN columns)")
-    if nb < q.nsteps + q.kbuckets - 1:
-        raise ValueError(f"grid has {nb} rows; need nsteps+K-1 = "
-                         f"{q.nsteps + q.kbuckets - 1}")
+    if nb < _rows_needed(q):
+        raise ValueError(f"grid has {nb} rows; need (nsteps-1)*stride+K = "
+                         f"{_rows_needed(q)}")
+    if q.stride > 1:
+        # Mosaic cannot lower strided sublane slices: run the stride-1
+        # fine grid and subsample the output at the XLA level (the
+        # extra windows cost VPU time but stay on the fast path)
+        fine = rate_grid(ts, vals, steps0, _fine_query(q), lanes, interpret)
+        return fine[::q.stride]
     kern = functools.partial(_series_kernel, q=q)
     return pl.pallas_call(
         kern,
@@ -363,9 +399,13 @@ def rate_grid_grouped(ts, vals, steps0, q: GridQuery,
             f"group count a non-zero multiple of {_GPS}; got "
             f"{ngroups} x {group_lanes} (pad groups with NaN columns and "
             f"the group list to a multiple of {_GPS})")
-    if nb < q.nsteps + q.kbuckets - 1:
-        raise ValueError(f"grid has {nb} rows; need nsteps+K-1 = "
-                         f"{q.nsteps + q.kbuckets - 1}")
+    if nb < _rows_needed(q):
+        raise ValueError(f"grid has {nb} rows; need (nsteps-1)*stride+K = "
+                         f"{_rows_needed(q)}")
+    if q.stride > 1:
+        s, c = rate_grid_grouped(ts, vals, steps0, _fine_query(q),
+                                 group_lanes, interpret)
+        return s[:, ::q.stride], c[:, ::q.stride]
     kern = functools.partial(_grouped_kernel, q=q)
     s, c = pl.pallas_call(
         kern,
@@ -414,16 +454,26 @@ def rate_grid_auto(ts, vals, steps0, q: GridQuery, lanes: int = 1024):
     return rate_grid_ref(ts, vals, int(steps0), q)
 
 
-MAX_K_BUCKETS = 64  # kernel passes unroll over K; cap the compile cost
+MAX_K_BUCKETS = 64   # kernel passes unroll over K; cap the compile cost
+MAX_GRID_ROWS = 1024  # input rows per query: VMEM tile height bound
 
 
-def supports_grid(window_ms: int, step_ms: int, gstep_ms: int) -> bool:
+def supports_grid(window_ms: int, step_ms: int, gstep_ms: int,
+                  nsteps: int = 1) -> bool:
     """Host-side check: can the aligned fast path serve this query?
-    K = window/gstep is capped — the kernels unroll K static slice
-    passes, and an uncapped K (e.g. a 5-minute staleness lookback over a
-    1-second scrape cadence -> K=300) would pay a huge one-off compile
-    on the most interactive query shape.  Beyond the cap the general
-    path serves."""
-    return (step_ms == gstep_ms and window_ms > 0
-            and window_ms % gstep_ms == 0
-            and window_ms // gstep_ms <= MAX_K_BUCKETS)
+    The query step may be any multiple of the bucket width (stride
+    serving — dashboards commonly step coarser than the scrape
+    cadence).  K = window/gstep is capped — the kernels unroll K static
+    slice passes, and an uncapped K (e.g. a 5-minute staleness lookback
+    over a 1-second scrape cadence -> K=300) would pay a huge one-off
+    compile on the most interactive query shape.  Total input rows are
+    capped by the VMEM tile height.  Beyond the caps the general path
+    serves."""
+    if not (window_ms > 0 and gstep_ms > 0 and step_ms > 0
+            and step_ms % gstep_ms == 0 and window_ms % gstep_ms == 0
+            and window_ms // gstep_ms <= MAX_K_BUCKETS):
+        return False
+    if jax.default_backend() not in ("tpu", "axon"):
+        return True     # portable reference path: no VMEM tile bound
+    stride = step_ms // gstep_ms
+    return (nsteps - 1) * stride + window_ms // gstep_ms <= MAX_GRID_ROWS

@@ -194,6 +194,36 @@ class TestDeviceGrid:
                   if t.get("instance") == "gappy")
         assert np.isfinite(vals[gi]).any()
 
+    def test_coarser_step_served_with_stride(self):
+        """A dashboard step of 2x the scrape cadence stays on the grid
+        (stride serving) and matches the general scan path."""
+        from filodb_tpu.ops.windows import StepRange
+        from filodb_tpu.query import rangefns
+
+        ms, shard, _ = _mk_shard()
+        res = _lookup(shard)
+        steps0, nsteps_full = _steps(50)
+        step2 = 2 * STEP
+        nsteps = (nsteps_full + 1) // 2
+        got = shard.scan_grid(res.part_ids, F.RATE, steps0, nsteps, step2,
+                              WINDOW)
+        assert got is not None, "strided grid should serve step=2*gstep"
+        tags, vals, _tops = got
+        assert vals.shape[1] == nsteps
+        cache = next(iter(shard.device_caches.values()))
+        assert cache.hits > 0
+        # oracle: general scan path on the same coarse step grid
+        end = steps0 + (nsteps - 1) * step2
+        t2, batch = shard.scan_batch(res.part_ids, steps0 - WINDOW, end)
+        sr = StepRange(steps0, end, step2)
+        want = np.asarray(rangefns.apply_range_function(
+            batch, sr, WINDOW, F.RATE))[:len(tags)]
+        got_v = np.asarray(vals)
+        assert (np.isfinite(got_v) == np.isfinite(want)).all()
+        fin = np.isfinite(want)
+        assert fin.any()
+        np.testing.assert_allclose(got_v[fin], want[fin], rtol=1e-4)
+
     def test_irregular_series_disables_grid(self):
         # two samples in one bucket violate the layout invariant
         ms, shard, _ = _mk_shard(n_series=2, n_rows=20)

@@ -368,6 +368,58 @@ class TestGridDense:
                                        rtol=1e-5, atol=1e-5)
             np.testing.assert_array_equal(c[g], ok.sum(axis=1))
 
+    def test_strided_matches_unstrided_subsample(self):
+        """stride=r output == every r-th step of the stride-1 output —
+        the coarser dashboard step is a pure subsample of the windows."""
+        cts, cvals = _dense_data()
+        for r in (2, 3):
+            full_steps = _steps()
+            sub_steps = np.asarray(full_steps)[::r]
+            q1 = GridQuery(nsteps=len(full_steps), kbuckets=K, gstep_ms=STEP)
+            qr = GridQuery(nsteps=len(sub_steps), kbuckets=K, gstep_ms=STEP,
+                           stride=r)
+            full = np.asarray(rate_grid_ref(cts, cvals.astype(jnp.float64),
+                                            int(full_steps[0]), q1))
+            strided = np.asarray(rate_grid_ref(cts, cvals.astype(jnp.float64),
+                                               int(sub_steps[0]), qr))
+            want = full[::r]
+            assert strided.shape == want.shape
+            both = np.isfinite(want)
+            assert (np.isfinite(strided) == both).all(), r
+            np.testing.assert_allclose(strided[both], want[both], rtol=1e-12)
+
+    @pytest.mark.parametrize("op", ["rate", "sum", "min", "last"])
+    @pytest.mark.parametrize("dense", [False, True])
+    def test_strided_pallas_interpret(self, op, dense):
+        cts, cvals = _dense_data() if dense \
+            else _clip(*_aligned_data(n_series=128))
+        r = 2
+        sub_steps = np.asarray(_steps())[::r]
+        q = GridQuery(nsteps=len(sub_steps), kbuckets=K, gstep_ms=STEP,
+                      op=op, is_rate=(op == "rate"), dense=dense, stride=r)
+        ref = np.asarray(rate_grid_ref(cts.astype(jnp.int32),
+                                       cvals.astype(jnp.float32),
+                                       int(sub_steps[0]), q))
+        pal = np.asarray(rate_grid(cts.astype(jnp.int32),
+                                   cvals.astype(jnp.float32),
+                                   jnp.int32(int(sub_steps[0])), q,
+                                   lanes=128, interpret=True))
+        assert (np.isfinite(ref) == np.isfinite(pal)).all(), (op, dense)
+        both = np.isfinite(ref)
+        np.testing.assert_allclose(pal[both], ref[both], rtol=5e-5,
+                                   atol=1e-6)
+
+    def test_supports_grid_stride_and_row_caps(self, monkeypatch):
+        assert supports_grid(300_000, 120_000, 60_000)    # step = 2 buckets
+        assert not supports_grid(300_000, 90_000, 60_000)  # non-multiple
+        # the row cap is a VMEM tile bound: TPU backends only
+        import filodb_tpu.ops.grid as gridmod
+        monkeypatch.setattr(gridmod.jax, "default_backend", lambda: "tpu")
+        assert supports_grid(300_000, 60_000, 60_000, nsteps=1000)
+        assert not supports_grid(300_000, 600_000, 60_000, nsteps=1000)
+        monkeypatch.setattr(gridmod.jax, "default_backend", lambda: "cpu")
+        assert supports_grid(300_000, 600_000, 60_000, nsteps=1000)
+
     def test_counter_reset_still_corrected(self):
         """Dense data with a reset mid-range: the dense correction must
         fire exactly like the general one."""

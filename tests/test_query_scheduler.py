@@ -153,9 +153,13 @@ class TestHttpIntegration:
             qs = urllib.parse.urlencode({
                 "query": "up", "start": 1_700_000_000,
                 "end": 1_700_000_060, "step": "15s"})
-            body = json.loads(urllib.request.urlopen(
-                f"http://127.0.0.1:{port}/promql/prom/api/v1/"
-                f"query_range?{qs}", timeout=30).read())
+            try:
+                body = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/promql/prom/api/v1/"
+                    f"query_range?{qs}", timeout=30).read())
+            except urllib.error.HTTPError as e:
+                raise AssertionError(
+                    f"HTTP {e.code}: {e.read().decode()[:500]}") from e
             assert body["status"] == "success"
             from filodb_tpu.utils.observability import REGISTRY
             done = REGISTRY.counter("filodb_queries_executed_total")
