@@ -33,11 +33,11 @@ absent.
 from __future__ import annotations
 
 import threading
-from typing import Optional, Sequence
+from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
 
-from filodb_tpu.ops.grid import GridQuery, rate_grid_auto, supports_grid
+from filodb_tpu.ops.grid import GridQuery, supports_grid
 from filodb_tpu.query.logical import RangeFunctionId as F
 
 BLOCK_BUCKETS = 128
@@ -103,16 +103,75 @@ def _grouped_reduce_impl(stepped, garr, num_groups, op):
     raise ValueError(f"unsupported grouped op {op}")
 
 
-_grouped_reduce_jit = None
+_FUSED_PROGS: dict = {}
 
 
-def _grouped_reduce(stepped, garr, num_groups: int, op: str):
-    global _grouped_reduce_jit
-    if _grouped_reduce_jit is None:
-        import jax
-        _grouped_reduce_jit = jax.jit(
-            _grouped_reduce_impl, static_argnames=("num_groups", "op"))
-    return _grouped_reduce_jit(stepped, garr, num_groups, op)
+def _fused_progs():
+    """The two one-dispatch query programs, jitted lazily.  A sync-mode
+    tunnel pays a round-trip per dispatched XLA program, so the whole
+    serving pipeline — block concat, row slice, grid kernel, segment
+    reduce — must be ONE program: splitting it into eager slices + two
+    jit calls costs 4-6 round-trips per query (measured: 160 -> ~60 ms
+    at 20k series)."""
+    if _FUSED_PROGS:
+        return _FUSED_PROGS
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from filodb_tpu.ops.grid import rate_grid_auto
+
+    def _sliced(ts_parts, val_parts, row0, nrows):
+        ts_all = ts_parts[0] if len(ts_parts) == 1 \
+            else jnp.concatenate(list(ts_parts), axis=0)
+        val_all = val_parts[0] if len(val_parts) == 1 \
+            else jnp.concatenate(list(val_parts), axis=0)
+        return (lax.dynamic_slice_in_dim(ts_all, row0, nrows, axis=0),
+                lax.dynamic_slice_in_dim(val_all, row0, nrows, axis=0))
+
+    @functools.partial(jax.jit,
+                       static_argnames=("q", "lanes", "nrows"))
+    def series_prog(ts_parts, val_parts, row0, steps0, *, q, lanes, nrows):
+        ts_sl, val_sl = _sliced(ts_parts, val_parts, row0, nrows)
+        return rate_grid_auto(ts_sl, val_sl, steps0, q, lanes)
+
+    @functools.partial(jax.jit,
+                       static_argnames=("q", "lanes", "nrows",
+                                        "num_groups", "op"))
+    def grouped_prog(ts_parts, val_parts, row0, steps0, garr, *, q, lanes,
+                     nrows, num_groups, op):
+        ts_sl, val_sl = _sliced(ts_parts, val_parts, row0, nrows)
+        stepped = rate_grid_auto(ts_sl, val_sl, steps0, q, lanes)
+        return _grouped_reduce_impl(stepped, garr, num_groups, op)
+
+    _FUSED_PROGS["series"] = series_prog
+    _FUSED_PROGS["grouped"] = grouped_prog
+    return _FUSED_PROGS
+
+
+class _GridPlan(NamedTuple):
+    """Everything needed to dispatch one fused serving program."""
+
+    ts_parts: tuple       # device arrays, one per covered block
+    val_parts: tuple
+    row0: int             # first slice row in the concatenated blocks
+    steps0_rel: int       # first window end, epoch-relative ms
+    q: "GridQuery"
+    lane_mult: int
+    nrows: int
+    ncols: int
+    lane_idx: np.ndarray  # requested pid -> lane slot, in request order
+
+
+def _ids_fingerprint(part_ids) -> int:
+    """Cheap content check guarding the id()-keyed prep cache against
+    address reuse: length + a 16-point sample of the ids."""
+    n = len(part_ids)
+    step = max(1, n // 16)
+    return n * 1_000_003 + int(sum(int(part_ids[i])
+                                   for i in range(0, n, step)))
 
 
 class _Block:
@@ -172,6 +231,7 @@ class DeviceGridCache:
         self.disabled_until_version = -1
         self._disable_count = 0        # exponential re-try backoff
         self._disk_floor: Optional[tuple[int, int]] = None  # (ver, floor_ms)
+        self._preps: dict[int, dict] = {}   # id(part_ids) -> prep
         self._seq = 0
         self._lock = threading.Lock()
         # stats
@@ -256,8 +316,8 @@ class DeviceGridCache:
         if self.hist and func not in _HIST_GRID_FNS:
             return None
         with self._lock:
-            vals = self._scan_rate_locked(list(map(int, part_ids)), func,
-                                          steps0, nsteps, step_ms, window_ms)
+            vals = self._scan_rate_locked(part_ids, func, steps0, nsteps,
+                                          step_ms, window_ms)
             if vals is None:
                 return None
             tops = np.asarray(self.bucket_tops) if self.hist else None
@@ -280,17 +340,14 @@ class DeviceGridCache:
         if self.hist and (func not in _HIST_GRID_FNS or op != "sum"):
             return None
         with self._lock:
-            ids = list(map(int, part_ids))
-            got = self._stepped_device(ids, func, steps0, nsteps, step_ms,
-                                       window_ms)
-            if got is None:
+            plan = self._plan_locked(part_ids, func, steps0, nsteps,
+                                     step_ms, window_ms)
+            if plan is None:
                 return None
-            stepped, ncols = got
             stride = self.hb if self.hist else 1
             tops = np.asarray(self.bucket_tops) if self.hist else None
-            garr = np.full(ncols, num_groups * stride, dtype=np.int32)
-            lane_idx = np.fromiter((self.lane_of[p] for p in ids),
-                                   dtype=np.int64, count=len(ids))
+            garr = np.full(plan.ncols, num_groups * stride, dtype=np.int32)
+            lane_idx = plan.lane_idx
             gid_arr = np.asarray(group_ids, dtype=np.int32)
             if stride == 1:
                 garr[lane_idx] = gid_arr
@@ -300,9 +357,10 @@ class DeviceGridCache:
                 cols = (lane_idx[:, None] * stride
                         + np.arange(stride)[None, :])
                 garr[cols] = gid_arr[:, None] * stride + np.arange(stride)
-        import jax.numpy as jnp
-        out = _grouped_reduce(stepped, jnp.asarray(garr),
-                              num_groups * stride, op)
+        out = _fused_progs()["grouped"](
+            plan.ts_parts, plan.val_parts, plan.row0, plan.steps0_rel,
+            garr, q=plan.q, lanes=plan.lane_mult, nrows=plan.nrows,
+            num_groups=num_groups * stride, op=op)
         if self.hist:
             both = np.asarray(out, dtype=np.float64)    # [2, G*hb, T]
             G, hb, T = num_groups, stride, both.shape[-1]
@@ -321,38 +379,80 @@ class DeviceGridCache:
 
     def _scan_rate_locked(self, part_ids, func, steps0, nsteps, step_ms,
                           window_ms):
-        got = self._stepped_device(part_ids, func, steps0, nsteps, step_ms,
-                                   window_ms)
-        if got is None:
+        plan = self._plan_locked(part_ids, func, steps0, nsteps, step_ms,
+                                 window_ms)
+        if plan is None:
             return None
-        stepped, _ncols = got
+        stepped = _fused_progs()["series"](
+            plan.ts_parts, plan.val_parts, plan.row0, plan.steps0_rel,
+            q=plan.q, lanes=plan.lane_mult, nrows=plan.nrows)
         out_np = np.asarray(stepped)
-        lanes_req = np.array([self.lane_of[pid] for pid in part_ids])
+        lanes_req = plan.lane_idx
         if self.hist:
             cols = lanes_req[:, None] * self.hb + np.arange(self.hb)[None, :]
             return out_np[:, cols].transpose(1, 0, 2)     # [S_req, T, hb]
         return out_np[:, lanes_req].T                     # [S_req, T]
 
-    def _stepped_device(self, part_ids, func, steps0, nsteps, step_ms,
-                        window_ms):
-        """Shared grid pipeline: block assembly + fused kernel; returns
-        the ON-DEVICE stepped [T, lanes] array (no readback) + lane
-        count, or None to fall back."""
+    def _prep_for(self, part_ids):
+        """Memoized resolution of one lookup result: validate every pid
+        (present + matching schema), assign lanes, and build the lane
+        index.  Keyed on the lookup cache's array identity and the
+        shard's partition removal epoch — repeated dashboard queries
+        skip the 20k-dict walk entirely (it otherwise dominates
+        host-side serving time at high cardinality)."""
         shard = self._shard
-        parts = []
-        for pid in part_ids:
+        n = len(part_ids)
+        if n == 0:
+            return None
+        key = id(part_ids)
+        fp = _ids_fingerprint(part_ids)
+        prep = self._preps.get(key)
+        if (prep is not None and prep["epoch"] == shard.removal_epoch
+                and prep["fp"] == fp and prep["obj"] is part_ids):
+            return prep
+        # snapshot the epoch BEFORE the walk: an eviction racing the
+        # validation must leave the prep stamped stale, not fresh
+        epoch = shard.removal_epoch
+        ids = [int(p) for p in part_ids]
+        for pid in ids:
             part = shard.partitions.get(pid)
             if part is None:
                 return None                    # evicted/paged: fall back
             if part.schema.schema_hash != self.schema_hash:
                 return None                    # mixed-schema id list
-            parts.append(part)
-        if not parts:
+            if pid not in self.lane_of:
+                self.lane_of[pid] = self._next_lane
+                self._next_lane += 1
+        lane_idx = np.fromiter((self.lane_of[pid] for pid in ids),
+                               dtype=np.int64, count=n)
+        # "obj" holds a STRONG reference to the keyed array: id() stays
+        # unambiguous for the entry's lifetime (no address reuse)
+        prep = {"epoch": epoch, "fp": fp, "obj": part_ids, "ids": ids,
+                "lane_idx": lane_idx}
+        if len(self._preps) > 16:
+            self._preps.clear()
+        self._preps[key] = prep
+        return prep
+
+    def _plan_locked(self, part_ids, func, steps0, nsteps, step_ms,
+                     window_ms):
+        """Shared grid preamble: eligibility checks, block assembly, and
+        the dense-contract proof.  Returns a :class:`_GridPlan` (device
+        block refs + kernel config — NO device dispatch happens here; the
+        caller runs ONE fused program) or None to fall back."""
+        shard = self._shard
+        if self.disabled_until_version >= shard.ingest_epoch:
             return None
-        if self.disabled_until_version >= self._shard.ingest_epoch:
+        if len(part_ids) == 0:
+            return None
+        # ALL eligibility checks run before _prep_for assigns lanes —
+        # an ineligible query must not widen the lane count (that would
+        # clear every resident block on the next eligible query)
+        first = shard.partitions.get(int(part_ids[0]))
+        if first is None or first.schema.schema_hash != self.schema_hash:
             return None
         if self.gstep is None:
-            g = self._shard.config.grid_step_ms or self._detect_gstep(parts[0])
+            g = shard.config.grid_step_ms or self._detect_gstep(first)
             if not g or g <= 0:
                 self._disable()                # don't re-detect every query
                 return None
@@ -364,9 +464,8 @@ class DeviceGridCache:
             # probe a narrow leading slice for the bucket scheme — a
             # full-history read_range would decode (and memoize) every
             # chunk of the partition while holding the cache lock
-            e0 = parts[0].earliest_timestamp
-            _pts, pvals = parts[0].read_range(e0, e0 + 64 * g,
-                                              self.column_id)
+            e0 = first.earliest_timestamp
+            _pts, pvals = first.read_range(e0, e0 + 64 * g, self.column_id)
             buckets = pvals[0] if isinstance(pvals, tuple) else None
             if buckets is None or buckets.num_buckets == 0:
                 self._disable()
@@ -374,9 +473,12 @@ class DeviceGridCache:
             self.hb = int(buckets.num_buckets)
             self.bucket_tops = np.asarray(buckets.bucket_tops(), np.float64)
         if self.epoch0 is None:
-            first = min(p.earliest_timestamp for p in parts
-                        if p.earliest_timestamp >= 0)
-            self.epoch0 = (first // g) * g
+            earliest = [shard.partitions[int(pid)].earliest_timestamp
+                        for pid in part_ids if int(pid) in shard.partitions]
+            first_ts = min((t for t in earliest if t >= 0), default=-1)
+            if first_ts < 0:
+                return None
+            self.epoch0 = (first_ts // g) * g
         if (steps0 - self.epoch0) % g != 0:
             return None                        # windows don't land on edges
         K = window_ms // g
@@ -387,23 +489,24 @@ class DeviceGridCache:
         c_last = c0 + (nsteps - 1) * stride_r + K - 1     # inclusive
         if c0 < 0:
             return None
+        if (c_last + 1) * g > _I32_SPAN:
+            return None                        # int32-relative overflow
+        prep = self._prep_for(part_ids)
+        if prep is None:
+            return None
+        ids = prep["ids"]
         if hasattr(shard, "paged"):
             # ODP shard: residents may hold only their post-recovery tail,
             # with older chunks on disk; the grid would serve NaN there
+            parts = [shard.partitions.get(pid) for pid in ids]
+            if any(p is None for p in parts):
+                return None
             lo_ms = self.epoch0 + (c0 - 1) * g
             if lo_ms < self._disk_floor_ms(parts):
                 return None
-        if (c_last + 1) * g > _I32_SPAN:
-            return None                        # int32-relative overflow
-        new_lane = False
-        for p in parts:
-            if p.part_id not in self.lane_of:
-                self.lane_of[p.part_id] = self._next_lane
-                self._next_lane += 1
-                new_lane = True
         lanes = max(_LANE_PAD,
                     -(-self._next_lane // _LANE_PAD) * _LANE_PAD)
-        if new_lane and any(b.lanes != lanes for b in self.blocks.values()):
+        if any(b.lanes != lanes for b in self.blocks.values()):
             self.blocks.clear()                # widths must match to concat
             self._tails.clear()
         frozen_hi = self._frozen_high()
@@ -419,26 +522,16 @@ class DeviceGridCache:
             segments.append(blk)
         self._evict(keep=set(range(bi_lo, bi_hi + 1)))
 
-        import jax.numpy as jnp
-        from jax import lax
-
-        if len(segments) == 1:
-            ts_all, val_all = segments[0].ts, segments[0].vals
-        else:
-            ts_all = jnp.concatenate([b.ts for b in segments], axis=0)
-            val_all = jnp.concatenate([b.vals for b in segments], axis=0)
         row0 = c0 - bi_lo * BLOCK_BUCKETS
         nrows = c_last - c0 + 1
-        ts_sl = lax.dynamic_slice_in_dim(ts_all, row0, nrows, axis=0)
-        val_sl = lax.dynamic_slice_in_dim(val_all, row0, nrows, axis=0)
+        ncols = segments[0].ts.shape[1]
         # prove the dense-lane contract from per-block fill ranges: a
         # lane must be dense in EVERY covered block segment, or empty in
         # every one (a series that starts/stops mid-range is neither).
         # Only the REQUESTED lanes matter — per-lane outputs are
         # independent, and unrequested lanes are sliced away / mapped to
         # the drop bucket downstream.
-        req = np.fromiter((self.lane_of[p.part_id] for p in parts),
-                          dtype=np.int64, count=len(parts))
+        req = prep["lane_idx"]
         if self.hist:
             req = (req[:, None] * self.hb
                    + np.arange(self.hb)[None, :]).ravel()
@@ -458,12 +551,13 @@ class DeviceGridCache:
                       dense=dense, stride=stride_r)
         # tall strided slices read more input rows per tile: keep the
         # VMEM footprint bounded by narrowing the lane tile
-        lane_mult = 1024 if (ts_sl.shape[1] % 1024 == 0
-                             and ts_sl.shape[0] <= 256) else _LANE_PAD
-        out = rate_grid_auto(ts_sl, val_sl, steps0 - self.epoch0, q,
-                             lanes=lane_mult)            # [T, lanes]
+        lane_mult = 1024 if (ncols % 1024 == 0 and nrows <= 256) \
+            else _LANE_PAD
         self.hits += 1
-        return out, int(ts_sl.shape[1])
+        return _GridPlan(tuple(b.ts for b in segments),
+                         tuple(b.vals for b in segments), row0,
+                         steps0 - self.epoch0, q, lane_mult, nrows, ncols,
+                         prep["lane_idx"])
 
     # ---------------------------------------------------------------- blocks
 
@@ -491,7 +585,9 @@ class DeviceGridCache:
 
     def _frozen_high(self) -> int:
         """Highest bucket (exclusive) fully covered by frozen chunks: the
-        earliest write-buffer row across lanes bounds it."""
+        earliest write-buffer row across THIS cache's lanes bounds it —
+        an unrelated metric's laggy buffer must not demote this cache's
+        recent blocks to per-epoch-rebuilt tail blocks."""
         lo = None
         for pid in self.lane_of:
             part = self._shard.partitions.get(pid)

@@ -91,6 +91,11 @@ class TimeSeriesShard:
         self.meta = meta_store or InMemoryMetaStore()
         self.index = PartKeyIndex()
         self._lookup_cache: dict = {}
+        # bumped whenever a partition leaves the in-memory map (evict /
+        # purge): lets the device grid cache skip re-validating every
+        # requested pid per query (20k dict walks otherwise dominate
+        # host-side serving time at high cardinality)
+        self.removal_epoch = 0
         self.partitions: dict[int, TimeSeriesPartition] = {}
         self.part_set: dict[bytes, int] = {}
         # part id -> 16-bit schema hash; covers index-only (evicted /
@@ -397,6 +402,7 @@ class TimeSeriesShard:
             part = self.partitions.pop(pid, None)
             if part is None:
                 continue
+            self.removal_epoch += 1
             self.part_set.pop(part.partkey, None)
             self.evicted_keys.add(part.partkey)
             self.index.remove([pid])
@@ -410,6 +416,7 @@ class TimeSeriesShard:
                   if p.latest_timestamp < cutoff]
         for pid in doomed:
             part = self.partitions.pop(pid)
+            self.removal_epoch += 1
             self.part_set.pop(part.partkey, None)
             self.index.remove([pid])
             self.stats.partitions_purged += 1
@@ -502,11 +509,13 @@ class TimeSeriesShard:
                         column_id: Optional[int]):
         """Shared grid-eligibility preamble: resolve the value column off
         the first partition, require a DOUBLE or HISTOGRAM column, fetch
-        the cache.  Returns (cache, ids) or None to fall back."""
-        ids = [int(p) for p in part_ids]
-        if not ids:
+        the cache.  The ORIGINAL ``part_ids`` object is handed to the
+        cache (not a fresh int list): the cache memoizes its per-lookup
+        prep on that object's identity, which is only sound because the
+        shard's lookup cache keeps the array alive and stable."""
+        if len(part_ids) == 0:
             return None
-        first = self.partitions.get(ids[0])
+        first = self.partitions.get(int(part_ids[0]))
         if first is None:
             return None
         cid = first.schema.data.value_column_id if column_id is None \
@@ -515,7 +524,8 @@ class TimeSeriesShard:
         if ctype not in (ColumnType.DOUBLE, ColumnType.HISTOGRAM):
             return None
         return self.device_cache(first.schema.schema_hash, cid,
-                                 hist=(ctype == ColumnType.HISTOGRAM)), ids
+                                 hist=(ctype == ColumnType.HISTOGRAM)), \
+            part_ids
 
     def scan_grid(self, part_ids: Sequence[int], func, steps0: int,
                   nsteps: int, step_ms: int, window_ms: int,
@@ -539,7 +549,7 @@ class TimeSeriesShard:
         vals, tops = served
         tags_list = []
         for pid in ids:
-            part = self.partitions.get(pid)
+            part = self.partitions.get(int(pid))
             if part is None:
                 return None   # concurrently evicted mid-query: fall back
             tags_list.append(part.tags)

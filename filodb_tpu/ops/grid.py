@@ -448,14 +448,19 @@ def rate_grid_ref(ts, vals, steps0: int, q: GridQuery):
 
 
 def rate_grid_auto(ts, vals, steps0, q: GridQuery, lanes: int = 1024):
-    """Pallas on TPU backends, portable reference elsewhere."""
+    """Pallas on TPU backends, portable reference elsewhere.  ``steps0``
+    may be a traced scalar (this runs under the serving path's fused
+    jit program)."""
     if jax.default_backend() in ("tpu", "axon") and ts.shape[1] % lanes == 0:
         return rate_grid(ts, vals, steps0, q, lanes)
-    return rate_grid_ref(ts, vals, int(steps0), q)
+    return rate_grid_ref(ts, vals, steps0, q)
 
 
 MAX_K_BUCKETS = 64   # kernel passes unroll over K; cap the compile cost
-MAX_GRID_ROWS = 1024  # input rows per query: VMEM tile height bound
+MAX_GRID_ROWS = 1024  # input rows per query: VMEM tile height bound (TPU)
+# any backend: bounds blocks staged/assembled per query (a coarse step
+# over a fine cadence can otherwise span millions of buckets)
+MAX_GRID_SPAN_ROWS = 16_384
 
 
 def supports_grid(window_ms: int, step_ms: int, gstep_ms: int,
@@ -473,7 +478,10 @@ def supports_grid(window_ms: int, step_ms: int, gstep_ms: int,
             and step_ms % gstep_ms == 0 and window_ms % gstep_ms == 0
             and window_ms // gstep_ms <= MAX_K_BUCKETS):
         return False
+    stride = step_ms // gstep_ms
+    rows = (nsteps - 1) * stride + window_ms // gstep_ms
+    if rows > MAX_GRID_SPAN_ROWS:
+        return False    # block-assembly bound, any backend
     if jax.default_backend() not in ("tpu", "axon"):
         return True     # portable reference path: no VMEM tile bound
-    stride = step_ms // gstep_ms
-    return (nsteps - 1) * stride + window_ms // gstep_ms <= MAX_GRID_ROWS
+    return rows <= MAX_GRID_ROWS

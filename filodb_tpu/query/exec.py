@@ -245,10 +245,9 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         union: dict[tuple, int] = {}
         if not mapred.by and not mapred.without:
             # global aggregate: one group, skip the per-series key walk
+            # (missing partitions are detected by the cache's plan walk)
             union[()] = 0
             gids = [0] * len(part_ids)
-            if any(shard.partitions.get(int(p)) is None for p in part_ids):
-                return None
         else:
             gids = []
             for pid in part_ids:

@@ -419,6 +419,9 @@ class TestGridDense:
         assert not supports_grid(300_000, 600_000, 60_000, nsteps=1000)
         monkeypatch.setattr(gridmod.jax, "default_backend", lambda: "cpu")
         assert supports_grid(300_000, 600_000, 60_000, nsteps=1000)
+        # the span cap holds on ANY backend: a 1h step over 1s cadence
+        # would stage >1M buckets of blocks per query
+        assert not supports_grid(60_000, 3_600_000, 1_000, nsteps=336)
 
     def test_counter_reset_still_corrected(self):
         """Dense data with a reset mid-range: the dense correction must
