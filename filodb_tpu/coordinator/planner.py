@@ -38,6 +38,26 @@ from filodb_tpu.query.transformers import (AbsentFunctionMapper,
                                            VectorFunctionMapper)
 
 
+def spread_provider_from_config(assignments, default: int):
+    """Config-driven per-shard-key spread overrides (reference:
+    filodb-defaults.conf ``spread-assignment`` applied via
+    QueryActor.scala:70-85 applySpreadProvider): each entry maps
+    concrete shard-key values to a spread; the first rule whose keys all
+    match the query's shard-key filter values wins, else the default.
+    Returns a callable usable as SingleClusterPlanner.spread_provider."""
+    rules = [({str(k): str(v) for k, v in a.get("keys", {}).items()},
+              int(a["spread"])) for a in assignments]
+
+    def provider(values: dict) -> int:
+        for keys, sp in rules:
+            if keys and all(values.get(k) == v for k, v in keys.items()):
+                return sp
+        return default
+
+    return provider
+
+
+
 class QueryPlanner:
     """Planner interface (reference: queryplanner/QueryPlanner.scala:16)."""
 
@@ -242,6 +262,15 @@ class SingleClusterPlanner(QueryPlanner):
                                      self.dispatcher_for_shard(s))
                         for s in shards]
             return PartKeysDistConcatExec(children, qctx)
+        if isinstance(plan, lp.RawChunkMeta):
+            from filodb_tpu.query.exec import SelectChunkInfosExec
+            shards = self.shards_from_filters(plan.filters, qctx)
+            children = [SelectChunkInfosExec(self.dataset, s, plan.filters,
+                                             plan.start_ms, plan.end_ms,
+                                             qctx,
+                                             self.dispatcher_for_shard(s))
+                        for s in shards]
+            return DistConcatExec(children, qctx)
         if isinstance(plan, lp.RawSeries):
             # bare raw selector (remote read / RawSeries API): per-shard
             # leaf scans with no periodic mapper, concatenated (reference:

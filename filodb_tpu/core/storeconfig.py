@@ -35,6 +35,9 @@ class StoreConfig:
     # (1-frac) of budget off the query path (reference: BlockManager
     # ensureHeadroomPercentAvailable headroom task)
     device_headroom_frac: float = 0.1
+    # tag subset selecting series created as TracingTimeSeriesPartition
+    # (reference: `trace-filters` config -> TimeSeriesPartition.scala:451)
+    trace_filters: Optional[Mapping] = None
 
     @staticmethod
     def from_config(conf: Mapping) -> "StoreConfig":
@@ -68,6 +71,7 @@ class StoreConfig:
                           if "grid-step" in conf else None),
             device_headroom_frac=float(
                 conf.get("device-headroom-frac", d.device_headroom_frac)),
+            trace_filters=conf.get("trace-filters"),
         )
 
 

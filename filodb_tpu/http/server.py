@@ -348,7 +348,30 @@ class FiloHttpServer:
                 return self._series(binding, params, multi)
         if len(parts) >= 3 and parts[0] == "api" and parts[2] == "cluster":
             return self._cluster(parts[3:], params)
+        if len(parts) == 3 and parts[0] == "admin" \
+                and parts[1] == "chunkmeta":
+            return self._chunkmeta(parts[2], params)
         return 404, error_response("bad_data", f"unknown route {path}")
+
+    def _chunkmeta(self, ds: str, p: dict) -> tuple[int, dict]:
+        """Chunk-level metadata for matching series (reference: the
+        RawChunkMeta logical plan + CLI decodeChunkInfo debugging)."""
+        from filodb_tpu.promql.parser import parse_selector
+        from filodb_tpu.query.logical import RawChunkMeta
+
+        binding = self.datasets.get(ds)
+        if binding is None:
+            return 404, error_response("bad_data", f"unknown dataset {ds}")
+        if "match[]" not in p:
+            return 400, error_response("bad_data", "match[] required")
+        filters = parse_selector(p["match[]"])
+        start = parse_time_ms(p.get("start", "0"))
+        end = parse_time_ms(p.get("end", str(2**62 // 1000)))
+        plan = RawChunkMeta(filters=tuple(filters), start_ms=start,
+                            end_ms=end)
+        result = self._exec(binding, plan)
+        data = [row for b in result.batches for row in b]
+        return 200, {"status": "success", "data": data}
 
     # ---------------------------------------------------------- query routes
 

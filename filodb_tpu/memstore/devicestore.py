@@ -491,19 +491,20 @@ class DeviceGridCache:
             return None
         if (c_last + 1) * g > _I32_SPAN:
             return None                        # int32-relative overflow
-        prep = self._prep_for(part_ids)
-        if prep is None:
-            return None
-        ids = prep["ids"]
         if hasattr(shard, "paged"):
             # ODP shard: residents may hold only their post-recovery tail,
-            # with older chunks on disk; the grid would serve NaN there
-            parts = [shard.partitions.get(pid) for pid in ids]
+            # with older chunks on disk; the grid would serve NaN there.
+            # This runs BEFORE _prep_for so a rejected query cannot
+            # widen the lane count (see the invariant above).
+            parts = [shard.partitions.get(int(pid)) for pid in part_ids]
             if any(p is None for p in parts):
                 return None
             lo_ms = self.epoch0 + (c0 - 1) * g
             if lo_ms < self._disk_floor_ms(parts):
                 return None
+        prep = self._prep_for(part_ids)
+        if prep is None:
+            return None
         lanes = max(_LANE_PAD,
                     -(-self._next_lane // _LANE_PAD) * _LANE_PAD)
         if any(b.lanes != lanes for b in self.blocks.values()):

@@ -363,6 +363,7 @@ class OnDemandPagingShard(TimeSeriesShard):
             # before the stale entries are dropped
             with self._odp_lock:
                 del self.partitions[pid]
+                self.removal_epoch += 1      # invalidates grid prep caches
                 self.paged.pop(pid)          # cached copy lacks the tail
                 self.paged.pop(("bf", pid))  # list is live-part relative
             self.evicted_keys.add(part.partkey)

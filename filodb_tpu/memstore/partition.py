@@ -11,6 +11,7 @@ arrays — the device-facing form.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import NamedTuple, Optional, Sequence
 
@@ -389,3 +390,46 @@ class TimeSeriesPartition:
         return (sum(cs.nbytes for cs in self.chunks)
                 + sum(len(pb.ts) * 16 for pb in self._pending)
                 + self._buf_n * 16)
+
+
+class TracingTimeSeriesPartition(TimeSeriesPartition):
+    """Debug variant logging every ingested sample and every chunk
+    freeze for one traced series (reference: TimeSeriesPartition.scala:451
+    TracingTimeSeriesPartition, enabled per-partkey by the shard's
+    StoreConfig.trace_filters).  Overrides the hot methods — the normal
+    partition pays nothing for the feature."""
+
+    __slots__ = ()
+
+    def ingest(self, timestamp, values):
+        ok = super().ingest(timestamp, values)
+        logging.getLogger("filodb.trace").info(
+            "TRACE ingest part=%d tags=%s ts=%d values=%s accepted=%s",
+            self.part_id, self.tags, timestamp, list(values), ok)
+        return ok
+
+    def ingest_block(self, ts, cols):
+        """The fast columnar path (C++ container decode) must trace too
+        — it is the path production ingestion actually takes."""
+        added, dropped = super().ingest_block(ts, cols)
+        log = logging.getLogger("filodb.trace")
+        for i in range(len(ts)):
+            log.info("TRACE ingest part=%d tags=%s ts=%d values=%s",
+                     self.part_id, self.tags, int(ts[i]),
+                     [c[i] for c in cols])
+        if dropped:
+            log.info("TRACE ingest part=%d dropped=%d out-of-order rows",
+                     self.part_id, dropped)
+        return added, dropped
+
+    def _log_freeze(self, chunksets):
+        log = logging.getLogger("filodb.trace")
+        for cs in chunksets:
+            log.info("TRACE freeze part=%d chunk_id=%d rows=%d [%d, %d] %dB",
+                     self.part_id, cs.info.chunk_id, cs.info.num_rows,
+                     cs.info.start_time, cs.info.end_time, cs.nbytes)
+
+    def drain_pending(self):
+        out = super().drain_pending()
+        self._log_freeze(out)
+        return out

@@ -258,11 +258,10 @@ class TimeSeriesShard:
                 return part
             # index-only entry (recovered or paged-out): re-materialize the
             # partition under its existing part id, keeping index lifecycle
-            part = TimeSeriesPartition(pid, schema, pk,
-                                       tags if tags is not None
-                                       else parse_partkey(pk),
-                                       part_hash % self.num_groups,
-                                       capacity=self.config.max_chunks_size)
+            rtags = tags if tags is not None else parse_partkey(pk)
+            part = self._partition_cls(rtags)(
+                pid, schema, pk, rtags, part_hash % self.num_groups,
+                capacity=self.config.max_chunks_size)
             part.on_freeze = self._on_chunk_freeze
             self.partitions[pid] = part
             self.index.mark_active(pid)
@@ -275,8 +274,9 @@ class TimeSeriesShard:
         pid = self._next_part_id
         self._next_part_id += 1
         group = part_hash % self.num_groups
-        part = TimeSeriesPartition(pid, schema, pk, tags, group,
-                                   capacity=self.config.max_chunks_size)
+        part = self._partition_cls(tags)(
+            pid, schema, pk, tags, group,
+            capacity=self.config.max_chunks_size)
         part.on_freeze = self._on_chunk_freeze
         self.partitions[pid] = part
         self.part_set[pk] = pid
@@ -284,6 +284,17 @@ class TimeSeriesShard:
         self.index.add_partkey(pid, pk, tags, start_time)
         self.stats.partitions_created += 1
         return part
+
+    def _partition_cls(self, tags: dict[str, str]):
+        """TracingTimeSeriesPartition for series matching the
+        `trace-filters` tag subset (reference: TimeSeriesPartition.scala:451
+        TracingTimeSeriesPartition); the normal class otherwise."""
+        tf = self.config.trace_filters
+        if tf and all(tags.get(k) == str(v) for k, v in tf.items()):
+            from filodb_tpu.memstore.partition import \
+                TracingTimeSeriesPartition
+            return TracingTimeSeriesPartition
+        return TimeSeriesPartition
 
     def create_partition(self, schema_name: str, tags: dict[str, str],
                          start_time: int) -> TimeSeriesPartition:

@@ -297,6 +297,53 @@ class PartKeysExec(LeafExecPlan):
         return [shard.part_keys(self.filters, self.start_ms, self.end_ms)]
 
 
+class SelectChunkInfosExec(LeafExecPlan):
+    """Chunk-level metadata for matching partitions (reference:
+    exec/SelectChunkInfosExec.scala): per series, the frozen chunks'
+    id/rows/time-range/encoded-bytes plus the write-buffer row count —
+    the observability surface for retention and compression debugging."""
+
+    def __init__(self, dataset: str, shard: int,
+                 filters: Sequence[ColumnFilter], start_ms: int, end_ms: int,
+                 query_context=None, dispatcher: PlanDispatcher = IN_PROCESS):
+        super().__init__(query_context, dispatcher)
+        self.dataset = dataset
+        self.shard = shard
+        self.filters = list(filters)
+        self.start_ms = start_ms
+        self.end_ms = end_ms
+
+    def do_execute(self, ctx):
+        shard = ctx.memstore.get_shard(self.dataset, self.shard)
+        lookup = shard.lookup_partitions(self.filters, self.start_ms,
+                                         self.end_ms)
+        out = []
+        for pid in lookup.part_ids:
+            part = shard.partitions.get(int(pid))
+            if part is None:
+                continue
+            chunks = []
+            for cs in part.chunks:
+                info = cs.info
+                if info.end_time < self.start_ms or \
+                        info.start_time > self.end_ms:
+                    continue
+                chunks.append({
+                    "chunk_id": int(info.chunk_id),
+                    "num_rows": int(info.num_rows),
+                    "start_time": int(info.start_time),
+                    "end_time": int(info.end_time),
+                    "bytes": int(cs.nbytes)})
+            out.append({"tags": part.tags, "shard": self.shard,
+                        "buffer_rows": int(part._buf_n),
+                        "chunks": chunks})
+        return [out]
+
+    def _args_str(self) -> str:
+        return f"dataset={self.dataset}, shard={self.shard}, " \
+               f"filters={self.filters}"
+
+
 class LabelValuesExec(LeafExecPlan):
     def __init__(self, dataset: str, shard: int, label_names: Sequence[str],
                  filters: Sequence[ColumnFilter], start_ms: int, end_ms: int,

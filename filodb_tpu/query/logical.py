@@ -335,6 +335,19 @@ class SeriesKeysByFilters(MetadataQueryPlan):
     end_ms: int
 
 
+@dataclasses.dataclass(frozen=True)
+class RawChunkMeta(MetadataQueryPlan):
+    """Chunk-level metadata for matching series — the debugging /
+    capacity-planning query (reference: LogicalPlan.scala RawChunkMeta +
+    exec/SelectChunkInfosExec).  Chunks here store all columns together
+    (one ChunkSet), so unlike the reference there is no per-column
+    variant."""
+
+    filters: tuple[ColumnFilter, ...]
+    start_ms: int
+    end_ms: int
+
+
 # ---------------------------------------------------------------------------
 # Tree utilities (reference: LogicalPlanUtils / LogicalPlan object helpers)
 # ---------------------------------------------------------------------------

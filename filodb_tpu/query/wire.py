@@ -25,7 +25,8 @@ from filodb_tpu.ops.windows import StepRange
 from filodb_tpu.query import transformers as tf
 from filodb_tpu.query.aggregators import AggPartialBatch
 from filodb_tpu.query.exec import (LabelValuesExec,
-                                   MultiSchemaPartitionsExec, PartKeysExec)
+                                   MultiSchemaPartitionsExec, PartKeysExec,
+                                   SelectChunkInfosExec)
 from filodb_tpu.query.logical import (AggregationOperator, InstantFunctionId,
                                       MiscellaneousFunctionId,
                                       RangeFunctionId, SortFunctionId,
@@ -166,7 +167,7 @@ def serialize_plan(plan) -> dict:
     the scatter-gather tree's non-leaf composition always runs on the
     query entry node, exactly like the reference (SURVEY.md §3.1)."""
     if not isinstance(plan, (MultiSchemaPartitionsExec, PartKeysExec,
-                             LabelValuesExec)):
+                             LabelValuesExec, SelectChunkInfosExec)):
         raise WireError(f"only leaf plans dispatch remotely, "
                         f"got {type(plan).__name__}")
     base = {
@@ -183,6 +184,8 @@ def serialize_plan(plan) -> dict:
                 "column": plan.column}
     if isinstance(plan, PartKeysExec):
         return {**base, "type": "PartKeysExec"}
+    if isinstance(plan, SelectChunkInfosExec):
+        return {**base, "type": "SelectChunkInfosExec"}
     return {**base, "type": "LabelValuesExec",
             "label_names": list(plan.label_names)}
 
@@ -200,6 +203,9 @@ def deserialize_plan(d: dict):
     elif kind == "PartKeysExec":
         plan = PartKeysExec(d["dataset"], d["shard"], filters,
                             d["start_ms"], d["end_ms"], qctx)
+    elif kind == "SelectChunkInfosExec":
+        plan = SelectChunkInfosExec(d["dataset"], d["shard"], filters,
+                                    d["start_ms"], d["end_ms"], qctx)
     elif kind == "LabelValuesExec":
         plan = LabelValuesExec(d["dataset"], d["shard"],
                                d.get("label_names", []), filters,

@@ -189,8 +189,18 @@ class FiloServer:
         if mesh_conf or (mesh_conf is None and self._device_count() > 1):
             from filodb_tpu.parallel.mesh import default_engine
             mesh_provider = default_engine
+        # per-shard-key spread overrides (reference: filodb-defaults
+        # `spread-assignment`): "spread-assignment":
+        #   [{"keys": {"_ws_": "demo", "_ns_": "App-0"}, "spread": 3}]
+        spread_provider = None
+        if ds_conf.get("spread-assignment"):
+            from filodb_tpu.coordinator.planner import \
+                spread_provider_from_config
+            spread_provider = spread_provider_from_config(
+                ds_conf["spread-assignment"], spread)
         planner = SingleClusterPlanner(name, mapper, DatasetOptions(),
                                        spread_default=spread,
+                                       spread_provider=spread_provider,
                                        dispatcher_for_shard=disp,
                                        mesh_engine_provider=mesh_provider)
         schema = DEFAULT_SCHEMAS[ds_conf.get("schema", "gauge")]

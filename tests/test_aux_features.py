@@ -1,0 +1,182 @@
+"""Chunk-metadata queries, per-key spread assignment, traced partitions.
+
+Reference parity for three auxiliary surfaces: RawChunkMeta /
+SelectChunkInfosExec (reference: LogicalPlan.scala RawChunkMeta,
+exec/SelectChunkInfosExec.scala), config-driven spread-assignment
+(filodb-defaults.conf spread-assignment + QueryActor.scala:70-85), and
+TracingTimeSeriesPartition (TimeSeriesPartition.scala:451).
+"""
+
+import logging
+
+import numpy as np
+
+from filodb_tpu.core.filters import ColumnFilter, Equals
+from filodb_tpu.core.record import RecordBuilder, decode_container
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+from filodb_tpu.core.storeconfig import StoreConfig
+from filodb_tpu.coordinator.planner import (SingleClusterPlanner,
+                                            spread_provider_from_config)
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.parallel.shardmap import ShardMapper
+from filodb_tpu.query import logical as lp
+from filodb_tpu.query.exec import ExecContext
+from filodb_tpu.query.model import QueryContext
+
+T0 = 1_600_000_000_000
+STEP = 10_000
+
+
+def _mk(n_series=3, n_rows=120, cfg=None):
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("ds", DEFAULT_SCHEMAS, 0, cfg or StoreConfig())
+    b = RecordBuilder(DEFAULT_SCHEMAS["gauge"])
+    for i in range(n_series):
+        tags = {"__name__": "m", "inst": f"i{i}", "_ws_": "w", "_ns_": "n"}
+        for r in range(n_rows):
+            b.add(T0 + r * STEP, [float(r + i)], tags)
+    for off, c in enumerate(b.containers()):
+        shard.ingest(decode_container(c, DEFAULT_SCHEMAS), off)
+    shard.flush_all()
+    return ms, shard
+
+
+class TestRawChunkMeta:
+    def test_chunk_infos_served_via_planner(self):
+        ms, shard = _mk()
+        mapper = ShardMapper(1)
+        mapper.register_node([0], "local")
+        planner = SingleClusterPlanner("ds", mapper, DatasetOptions(),
+                                       spread_default=0)
+        plan = lp.RawChunkMeta(
+            filters=(ColumnFilter("_metric_", Equals("m")),),
+            start_ms=0, end_ms=2**62)
+        ep = planner.materialize(plan, QueryContext())
+        assert "SelectChunkInfosExec" in ep.print_tree()
+        res = ep.execute(ExecContext(ms))
+        rows = [r for b in res.batches for r in b]
+        assert len(rows) == 3
+        for row in rows:
+            assert row["tags"]["inst"].startswith("i")
+            assert row["chunks"], "flushed series must expose chunks"
+            part = next(p for p in shard.partitions.values()
+                        if p.tags == row["tags"])
+            want = part.chunk_infos()
+            got = row["chunks"]
+            assert [c["chunk_id"] for c in got] == \
+                [w.chunk_id for w in want]
+            assert [c["num_rows"] for c in got] == \
+                [w.num_rows for w in want]
+            assert all(c["bytes"] > 0 for c in got)
+            assert sum(c["num_rows"] for c in got) \
+                + row["buffer_rows"] == 120
+
+    def test_time_range_filters_chunks(self):
+        ms, shard = _mk()
+        mapper = ShardMapper(1)
+        mapper.register_node([0], "local")
+        planner = SingleClusterPlanner("ds", mapper, DatasetOptions(),
+                                       spread_default=0)
+        part = next(iter(shard.partitions.values()))
+        first = part.chunk_infos()[0]
+        plan = lp.RawChunkMeta(
+            filters=(ColumnFilter("_metric_", Equals("m")),),
+            start_ms=first.start_time, end_ms=first.end_time)
+        res = planner.materialize(plan, QueryContext()).execute(
+            ExecContext(ms))
+        rows = [r for b in res.batches for r in b]
+        for row in rows:
+            assert len(row["chunks"]) == 1
+
+
+class TestChunkMetaHttp:
+    def test_admin_chunkmeta_route(self):
+        import json
+        import urllib.request
+
+        from filodb_tpu.coordinator.cluster import ShardManager
+        from filodb_tpu.http.server import DatasetBinding, FiloHttpServer
+
+        ms, shard = _mk()
+        mapper = ShardMapper(1)
+        mapper.register_node([0], "local")
+        mgr = ShardManager()
+        mgr.setup_dataset("ds", 1, min_num_nodes=1)
+        mgr.add_node("local")
+        planner = SingleClusterPlanner("ds", mapper, DatasetOptions(),
+                                       spread_default=0)
+        srv = FiloHttpServer(shard_manager=mgr)
+        srv.bind_dataset(DatasetBinding("ds", ms, planner))
+        port = srv.start()
+        try:
+            url = (f"http://127.0.0.1:{port}/admin/chunkmeta/ds"
+                   f"?match%5B%5D=m%7Binst%3D%22i0%22%7D")
+            body = json.loads(urllib.request.urlopen(url, timeout=15).read())
+            assert body["status"] == "success"
+            assert len(body["data"]) == 1
+            row = body["data"][0]
+            assert row["tags"]["inst"] == "i0" and row["chunks"]
+        finally:
+            srv.shutdown()
+
+    def test_chunkinfo_plan_wire_roundtrip(self):
+        from filodb_tpu.query.exec import SelectChunkInfosExec
+        from filodb_tpu.query.wire import deserialize_plan, serialize_plan
+
+        plan = SelectChunkInfosExec(
+            "ds", 0, [ColumnFilter("_metric_", Equals("m"))], 0, 10**15,
+            QueryContext())
+        d = serialize_plan(plan)
+        back = deserialize_plan(d)
+        assert isinstance(back, SelectChunkInfosExec)
+        assert back.filters == plan.filters and back.shard == 0
+
+
+class TestSpreadAssignment:
+    def test_provider_from_config(self):
+        prov = spread_provider_from_config(
+            [{"keys": {"_ws_": "demo", "_ns_": "App-0"}, "spread": 3},
+             {"keys": {"_ws_": "demo"}, "spread": 2}], default=1)
+        assert prov({"_ws_": "demo", "_ns_": "App-0"}) == 3
+        assert prov({"_ws_": "demo", "_ns_": "other"}) == 2
+        assert prov({"_ws_": "prod", "_ns_": "App-0"}) == 1
+        assert prov({}) == 1
+
+    def test_planner_uses_override_spread(self):
+        mapper = ShardMapper(8)
+        mapper.register_node(range(8), "local")
+        prov = spread_provider_from_config(
+            [{"keys": {"_ws_": "demo"}, "spread": 2}], default=0)
+        planner = SingleClusterPlanner("ds", mapper, DatasetOptions(),
+                                       spread_default=0,
+                                       spread_provider=prov)
+        filters = [ColumnFilter("_metric_", Equals("m")),
+                   ColumnFilter("_ws_", Equals("demo")),
+                   ColumnFilter("_ns_", Equals("n"))]
+        shards = planner.shards_from_filters(filters, QueryContext())
+        assert len(shards) == 4          # 2^2 of 8
+        other = [ColumnFilter("_metric_", Equals("m")),
+                 ColumnFilter("_ws_", Equals("prod")),
+                 ColumnFilter("_ns_", Equals("n"))]
+        assert len(planner.shards_from_filters(other, QueryContext())) == 1
+
+
+class TestTracingPartition:
+    def test_trace_filters_select_tracing_class(self, caplog):
+        from filodb_tpu.memstore.partition import TracingTimeSeriesPartition
+        cfg = StoreConfig(trace_filters={"inst": "i1"})
+        with caplog.at_level(logging.INFO, logger="filodb.trace"):
+            ms, shard = _mk(cfg=cfg)
+        traced = [p for p in shard.partitions.values()
+                  if isinstance(p, TracingTimeSeriesPartition)]
+        assert len(traced) == 1 and traced[0].tags["inst"] == "i1"
+        ingests = [r for r in caplog.records if "TRACE ingest" in r.message]
+        freezes = [r for r in caplog.records if "TRACE freeze" in r.message]
+        assert len(ingests) == 120
+        assert freezes, "flush_all must log the traced freeze"
+
+    def test_no_filters_no_tracing(self):
+        from filodb_tpu.memstore.partition import TracingTimeSeriesPartition
+        ms, shard = _mk()
+        assert not any(isinstance(p, TracingTimeSeriesPartition)
+                       for p in shard.partitions.values())
