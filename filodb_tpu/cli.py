@@ -56,6 +56,16 @@ def cmd_instant_query(args) -> int:
     return 0 if body.get("status") == "success" else 1
 
 
+def cmd_chunkmeta(args) -> int:
+    """Chunk-level metadata for matching series (reference:
+    CliMain.scala decodeChunkInfo debugging; served by the RawChunkMeta
+    plan behind /admin/chunkmeta)."""
+    path = f"/admin/chunkmeta/{args.dataset}"
+    body = _http_get(args.server, path, {"match[]": args.match})
+    print(json.dumps(body, indent=2))
+    return 0 if body.get("status") == "success" else 1
+
+
 def cmd_labelvalues(args) -> int:
     path = f"/promql/{args.dataset}/api/v1/label/{args.label}/values"
     body = _http_get(args.server, path)
@@ -195,6 +205,12 @@ def build_parser() -> argparse.ArgumentParser:
     st = sub.add_parser("status", help="shard statuses")
     server_args(st)
     st.set_defaults(fn=cmd_status)
+
+    cm = sub.add_parser("chunkmeta",
+                        help="chunk-level metadata for matching series")
+    server_args(cm)
+    cm.add_argument("match", help="PromQL selector, e.g. 'm{inst=\"i0\"}'")
+    cm.set_defaults(fn=cmd_chunkmeta)
 
     ls = sub.add_parser("list", help="list datasets in a local store")
     ls.add_argument("--data-dir", required=True)

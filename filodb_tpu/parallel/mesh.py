@@ -341,3 +341,25 @@ def default_engine() -> "MeshEngine":
     if _DEFAULT_ENGINE is None:
         _DEFAULT_ENGINE = MeshEngine(make_mesh())
     return _DEFAULT_ENGINE
+
+
+def init_multihost(coordinator_address: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None) -> "MeshEngine":
+    """Join a multi-host JAX runtime and build the global mesh engine —
+    the DCN+ICI analog of the reference's NCCL/MPI-style scale-out
+    (SURVEY.md §2.8 TPU-native equivalent).
+
+    Wraps ``jax.distributed.initialize`` (args fall back to the standard
+    JAX env vars / cloud auto-detection); afterwards ``jax.devices()``
+    spans EVERY host, so :func:`default_engine`'s mesh covers the full
+    pod — one SPMD serving program whose psum rides ICI within a slice
+    and DCN across slices.  Each host's coordinator
+    (:mod:`filodb_tpu.coordinator.cluster`) still owns shard assignment;
+    call this once at process start, before any other jax use."""
+    global _DEFAULT_ENGINE
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _DEFAULT_ENGINE = MeshEngine(make_mesh())
+    return _DEFAULT_ENGINE

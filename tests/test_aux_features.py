@@ -132,6 +132,38 @@ class TestChunkMetaHttp:
         assert back.filters == plan.filters and back.shard == 0
 
 
+class TestCliChunkMeta:
+    def test_cli_chunkmeta_against_live_server(self, capsys):
+        import json
+
+        from filodb_tpu import cli
+        from filodb_tpu.coordinator.cluster import ShardManager
+        from filodb_tpu.http.server import DatasetBinding, FiloHttpServer
+
+        ms, shard = _mk()
+        mapper = ShardMapper(1)
+        mapper.register_node([0], "local")
+        mgr = ShardManager()
+        mgr.setup_dataset("ds", 1, min_num_nodes=1)
+        mgr.add_node("local")
+        planner = SingleClusterPlanner("ds", mapper, DatasetOptions(),
+                                       spread_default=0)
+        srv = FiloHttpServer(shard_manager=mgr)
+        srv.bind_dataset(DatasetBinding("ds", ms, planner))
+        port = srv.start()
+        try:
+            rc = cli.main(["chunkmeta", "--server",
+                           f"http://127.0.0.1:{port}", "--dataset", "ds",
+                           'm{inst="i1"}'])
+            assert rc == 0
+            body = json.loads(capsys.readouterr().out)
+            assert body["status"] == "success"
+            assert len(body["data"]) == 1
+            assert body["data"][0]["tags"]["inst"] == "i1"
+        finally:
+            srv.shutdown()
+
+
 class TestSpreadAssignment:
     def test_provider_from_config(self):
         prov = spread_provider_from_config(

@@ -105,3 +105,36 @@ def test_shard_axis_only_mesh():
                                range_fn=F.INCREASE, agg_op=Agg.SUM)
     want = _oracle(batches, gids, 3, F.INCREASE, Agg.SUM)
     np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12, equal_nan=True)
+
+
+def test_init_multihost_single_process():
+    """init_multihost joins a (1-process) distributed runtime and builds
+    the global mesh engine — run in a subprocess because
+    jax.distributed.initialize binds a coordination service for the
+    process's lifetime."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    with socket.socket() as s:      # pick a free port, avoid collisions
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    code = textwrap.dedent(f"""
+        import os
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from filodb_tpu.parallel import mesh as m
+        eng = m.init_multihost(coordinator_address="127.0.0.1:{port}",
+                               num_processes=1, process_id=0)
+        assert len(jax.devices()) == 8
+        assert eng.mesh.devices.size == 8
+        print("OK")
+    """)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=180, cwd=repo_root)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "OK" in proc.stdout
