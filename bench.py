@@ -120,7 +120,7 @@ def main():
     _ = float(f_base(0))
     _ = float(f_full(0))
 
-    def timed(f, reps=3):
+    def timed(f, reps=5):
         best = []
         for _ in range(reps):
             a = time.perf_counter()

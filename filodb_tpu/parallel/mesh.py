@@ -358,6 +358,13 @@ def init_multihost(coordinator_address: Optional[str] = None,
     (:mod:`filodb_tpu.coordinator.cluster`) still owns shard assignment;
     call this once at process start, before any other jax use."""
     global _DEFAULT_ENGINE
+    if _DEFAULT_ENGINE is not None:
+        # fail fast with a clear message: jax.distributed.initialize
+        # would raise an opaque error after any jax computation, and a
+        # caller swallowing it would silently keep the single-host mesh
+        raise RuntimeError(
+            "init_multihost must run before the mesh engine is first "
+            "used (a query already built the single-host engine)")
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
