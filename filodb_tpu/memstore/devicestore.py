@@ -37,7 +37,7 @@ from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
 
-from filodb_tpu.ops.grid import GridQuery, supports_grid
+from filodb_tpu.ops.grid import GridQuery, max_k_for, supports_grid
 from filodb_tpu.query.logical import RangeFunctionId as F
 
 BLOCK_BUCKETS = 128
@@ -232,6 +232,10 @@ class DeviceGridCache:
         self._disable_count = 0        # exponential re-try backoff
         self._disk_floor: Optional[tuple[int, int]] = None  # (ver, floor_ms)
         self._preps: dict[int, dict] = {}   # id(part_ids) -> prep
+        # large-K shapes that failed the dense proof: deny until data
+        # changes, so a refreshing dashboard doesn't re-pay speculative
+        # block staging every cycle
+        self._bigk_deny: dict[tuple, tuple] = {}
         self._seq = 0
         self._lock = threading.Lock()
         # stats
@@ -458,8 +462,14 @@ class DeviceGridCache:
                 return None
             self.gstep = g
         g = self.gstep
-        if not supports_grid(window_ms, step_ms, g, nsteps):
+        # optimistic K cap: K-free ops may take large windows IF the
+        # dense proof below succeeds (checked again once dense is known)
+        if not supports_grid(window_ms, step_ms, g, nsteps,
+                             max_k=max_k_for(_GRID_OPS[func], dense=True)):
             return None
+        if self._bigk_deny.get((func, window_ms, step_ms)) == \
+                (self.version, shard.ingest_epoch):
+            return None     # dense proof failed for this shape; data unchanged
         if self.hist and self.hb is None:
             # probe a narrow leading slice for the bucket scheme — a
             # full-history read_range would decode (and memoize) every
@@ -545,6 +555,14 @@ class DeviceGridCache:
             all_dense &= d[req]
             all_empty &= e[req]
         dense = bool((all_dense | all_empty).all())
+        if K > max_k_for(_GRID_OPS[func], dense):
+            # large window needs the proven-dense K-free path: deny this
+            # shape until the data changes (version/epoch bump)
+            self._bigk_deny[(func, window_ms, step_ms)] = \
+                (self.version, shard.ingest_epoch)
+            if len(self._bigk_deny) > 64:
+                self._bigk_deny.clear()
+            return None
         if dense:
             self.dense_hits += 1
         q = GridQuery(nsteps=nsteps, kbuckets=K, gstep_ms=g,

@@ -456,27 +456,40 @@ def rate_grid_auto(ts, vals, steps0, q: GridQuery, lanes: int = 1024):
     return rate_grid_ref(ts, vals, steps0, q)
 
 
-MAX_K_BUCKETS = 64   # kernel passes unroll over K; cap the compile cost
+MAX_K_BUCKETS = 64   # K-unrolled kernel passes; caps the compile cost
 MAX_GRID_ROWS = 1024  # input rows per query: VMEM tile height bound (TPU)
 # any backend: bounds blocks staged/assembled per query (a coarse step
 # over a fine cadence can otherwise span millions of buckets)
 MAX_GRID_SPAN_ROWS = 16_384
 
+# ops whose DENSE kernel is K-free (rate/increase: window stats are two
+# static slices; last: one slice; count: a constant) — for these a
+# proven-dense query may use any K up to the row bound, which keeps
+# high-frequency data (5m window over 1s scrapes -> K=300) on the fast
+# path.  sum/avg/min/max accumulate K slices even when dense, so they
+# keep the unroll cap.
+K_FREE_DENSE_OPS = frozenset(("rate", "increase", "last", "count"))
+
+
+def max_k_for(op: str, dense: bool) -> int:
+    return MAX_GRID_ROWS if dense and op in K_FREE_DENSE_OPS \
+        else MAX_K_BUCKETS
+
 
 def supports_grid(window_ms: int, step_ms: int, gstep_ms: int,
-                  nsteps: int = 1) -> bool:
+                  nsteps: int = 1, max_k: int = MAX_K_BUCKETS) -> bool:
     """Host-side check: can the aligned fast path serve this query?
     The query step may be any multiple of the bucket width (stride
     serving — dashboards commonly step coarser than the scrape
-    cadence).  K = window/gstep is capped — the kernels unroll K static
-    slice passes, and an uncapped K (e.g. a 5-minute staleness lookback
-    over a 1-second scrape cadence -> K=300) would pay a huge one-off
-    compile on the most interactive query shape.  Total input rows are
-    capped by the VMEM tile height.  Beyond the caps the general path
-    serves."""
+    cadence).  ``max_k`` caps K = window/gstep — pass
+    ``max_k_for(op, dense)`` to allow large windows for the K-free
+    dense ops; the general kernels unroll K static slice passes, so an
+    uncapped K there would pay a huge one-off compile on the most
+    interactive query shape.  Total input rows are capped by the VMEM
+    tile height.  Beyond the caps the general path serves."""
     if not (window_ms > 0 and gstep_ms > 0 and step_ms > 0
             and step_ms % gstep_ms == 0 and window_ms % gstep_ms == 0
-            and window_ms // gstep_ms <= MAX_K_BUCKETS):
+            and window_ms // gstep_ms <= max_k):
         return False
     stride = step_ms // gstep_ms
     rows = (nsteps - 1) * stride + window_ms // gstep_ms

@@ -333,6 +333,7 @@ class MeshEngine:
 
 
 _DEFAULT_ENGINE: Optional["MeshEngine"] = None
+_MULTIHOST_INITIALIZED = False
 
 
 def default_engine() -> "MeshEngine":
@@ -357,7 +358,9 @@ def init_multihost(coordinator_address: Optional[str] = None,
     and DCN across slices.  Each host's coordinator
     (:mod:`filodb_tpu.coordinator.cluster`) still owns shard assignment;
     call this once at process start, before any other jax use."""
-    global _DEFAULT_ENGINE
+    global _DEFAULT_ENGINE, _MULTIHOST_INITIALIZED
+    if _MULTIHOST_INITIALIZED:
+        return _DEFAULT_ENGINE          # idempotent re-init
     if _DEFAULT_ENGINE is not None:
         # fail fast with a clear message: jax.distributed.initialize
         # would raise an opaque error after any jax computation, and a
@@ -369,4 +372,5 @@ def init_multihost(coordinator_address: Optional[str] = None,
                                num_processes=num_processes,
                                process_id=process_id)
     _DEFAULT_ENGINE = MeshEngine(make_mesh())
+    _MULTIHOST_INITIALIZED = True
     return _DEFAULT_ENGINE

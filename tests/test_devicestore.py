@@ -224,6 +224,60 @@ class TestDeviceGrid:
         assert fin.any()
         np.testing.assert_allclose(got_v[fin], want[fin], rtol=1e-4)
 
+    def test_large_window_served_when_dense(self):
+        """K-free dense ops (rate) take windows beyond MAX_K_BUCKETS —
+        a 2-hour lookback over 1m scrapes (K=120) stays on the fast
+        path when the dense contract is proven."""
+        from filodb_tpu.ops.windows import StepRange
+        from filodb_tpu.query import rangefns
+
+        ms, shard, _ = _mk_shard(n_rows=200)
+        res = _lookup(shard)
+        big_w = 120 * STEP                     # K = 120 > 64
+        steps0 = T0 + 120 * STEP
+        nsteps = 40
+        got = shard.scan_grid(res.part_ids, F.RATE, steps0, nsteps, STEP,
+                              big_w)
+        assert got is not None, "dense large-K rate should serve"
+        cache = next(iter(shard.device_caches.values()))
+        assert cache.dense_hits > 0
+        tags, vals, _tops = got
+        end = steps0 + (nsteps - 1) * STEP
+        t2, batch = shard.scan_batch(res.part_ids, steps0 - big_w, end)
+        want = np.asarray(rangefns.apply_range_function(
+            batch, StepRange(steps0, end, STEP), big_w,
+            F.RATE))[:len(tags)]
+        got_v = np.asarray(vals)
+        assert (np.isfinite(got_v) == np.isfinite(want)).all()
+        fin = np.isfinite(want)
+        np.testing.assert_allclose(got_v[fin], want[fin], rtol=1e-4)
+        # sum_over_time accumulates K slices even when dense: capped
+        assert shard.scan_grid(res.part_ids, F.SUM_OVER_TIME, steps0,
+                               nsteps, STEP, big_w) is None
+
+    def test_large_window_gappy_falls_back(self):
+        ms, shard, _ = _mk_shard(n_series=4, n_rows=200)
+        b = RecordBuilder(DEFAULT_SCHEMAS["prom-counter"])
+        tags = {"__name__": "req_total", "instance": "gappy", "_ws_": "w",
+                "_ns_": "n"}
+        for c in range(0, 200, 2):
+            b.add(T0 + (c - 1) * STEP + 10, [float(c)], tags)
+        for off, c in enumerate(b.containers()):
+            shard.ingest(decode_container(c, DEFAULT_SCHEMAS), 900 + off)
+        shard.flush_all()
+        res = _lookup(shard)
+        assert shard.scan_grid(res.part_ids, F.RATE, T0 + 120 * STEP, 40,
+                               STEP, 120 * STEP) is None
+        # the failed dense proof is memoized: the repeat attempt is
+        # denied up-front (no speculative block staging), and new data
+        # (epoch bump) re-enables the attempt
+        cache = next(iter(shard.device_caches.values()))
+        builds0 = cache.builds
+        assert shard.scan_grid(res.part_ids, F.RATE, T0 + 120 * STEP, 40,
+                               STEP, 120 * STEP) is None
+        assert cache.builds == builds0
+        assert (F.RATE, 120 * STEP, STEP) in cache._bigk_deny
+
     def test_irregular_series_disables_grid(self):
         # two samples in one bucket violate the layout invariant
         ms, shard, _ = _mk_shard(n_series=2, n_rows=20)
