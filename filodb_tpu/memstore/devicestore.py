@@ -37,7 +37,8 @@ from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
 
-from filodb_tpu.ops.grid import GridQuery, max_k_for, supports_grid
+from filodb_tpu.ops.grid import (DENSE_ONLY_OPS, GridQuery, max_k_for,
+                                 supports_grid)
 from filodb_tpu.query.logical import RangeFunctionId as F
 
 BLOCK_BUCKETS = 128
@@ -52,6 +53,9 @@ _GRID_OPS = {
     F.SUM_OVER_TIME: "sum", F.COUNT_OVER_TIME: "count",
     F.AVG_OVER_TIME: "avg", F.MIN_OVER_TIME: "min",
     F.MAX_OVER_TIME: "max", F.LAST_OVER_TIME: "last",
+    F.STDDEV_OVER_TIME: "stddev", F.STDVAR_OVER_TIME: "stdvar",
+    F.CHANGES: "changes", F.RESETS: "resets",
+    F.IRATE: "irate", F.IDELTA: "idelta",
     None: "last",
 }
 
@@ -555,9 +559,12 @@ class DeviceGridCache:
             all_dense &= d[req]
             all_empty &= e[req]
         dense = bool((all_dense | all_empty).all())
-        if K > max_k_for(_GRID_OPS[func], dense):
-            # large window needs the proven-dense K-free path: deny this
-            # shape until the data changes (version/epoch bump)
+        if (_GRID_OPS[func] in DENSE_ONLY_OPS and not dense) \
+                or K > max_k_for(_GRID_OPS[func], dense):
+            # adjacency ops need every row present; large windows need
+            # the proven-dense K-free path.  Either way, memoize the
+            # denial so a refreshing dashboard doesn't re-stage blocks
+            # every cycle; the data changing (version/epoch) retries.
             self._bigk_deny[(func, window_ms, step_ms)] = \
                 (self.version, shard.ingest_epoch)
             if len(self._bigk_deny) > 64:

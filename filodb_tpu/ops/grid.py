@@ -242,6 +242,33 @@ def _extrapolate(nf, t1, t2, v1, v2, steps0, q: GridQuery):
     return jnp.where((nf >= 2) & (sampled > 0), scaled, jnp.nan)
 
 
+def _instant_pair_block(ts, vals, q: GridQuery):
+    """irate/idelta under the dense contract: the window's last two
+    samples ARE its last two rows (reference: IRateFunction /
+    windows._instant_pair).  K-free — two static slices.  The counter
+    correction between ADJACENT samples collapses to the pair itself:
+    vcorr2 - vcorr1 = v2 - v1 + (v1 if v2 < v1 else 0) = v2 on a reset,
+    so no prefix scan is needed."""
+    if not q.dense:
+        raise ValueError(f"grid op {q.op} requires the dense contract")
+    ns = ts.shape[1]
+    dt = vals.dtype
+    K = q.kbuckets
+    sl = _win_slicer(q, ns)
+    if K < 2:
+        return jnp.full(((q.nsteps), ns), jnp.nan, dt)
+    v2, v1 = sl(vals, K - 1), sl(vals, K - 2)
+    t2, t1 = sl(ts, K - 1), sl(ts, K - 2)
+    live = jnp.isfinite(v2)
+    delta = v2 - v1
+    if q.op == "irate":
+        delta = jnp.where(v2 < v1, v2, delta)   # adjacent-pair reset
+    dt_s = (t2 - t1).astype(dt) / 1000.0
+    if q.op == "idelta":
+        return jnp.where(live, delta, jnp.nan)
+    return jnp.where(live & (dt_s > 0), delta / dt_s, jnp.nan)
+
+
 def _agg_block_dense(ts, vals, q: GridQuery):
     """The *_over_time family under the dense-lane contract: live lanes
     have a sample in every row, so the per-slice finite masks vanish —
@@ -255,6 +282,17 @@ def _agg_block_dense(ts, vals, q: GridQuery):
     live = jnp.isfinite(sl(vals, 0))
     if q.op == "count":
         return jnp.where(live, jnp.asarray(q.kbuckets, dt), jnp.nan)
+    if q.op in ("changes", "resets"):
+        # consecutive-row pairs fully inside the window (the reference's
+        # pair semantics: windows.changes_over_time / resets_over_time)
+        c = jnp.zeros(live.shape, dt)
+        prev = sl(vals, 0)
+        for d in range(1, q.kbuckets):
+            cur = sl(vals, d)
+            c = c + ((cur != prev) if q.op == "changes"
+                     else (cur < prev)).astype(dt)
+            prev = cur
+        return jnp.where(live, c, jnp.nan)
     if q.op in ("sum", "avg"):
         s = sl(vals, 0)
         for d in range(1, q.kbuckets):
@@ -272,8 +310,10 @@ def _agg_block(ts, vals, q: GridQuery):
     """The *_over_time family on the aligned grid: no correction, no
     forward fill — K static sublane slices accumulate directly
     (reference: AggrOverTimeFunctions.scala sum/count/avg/min/max/last)."""
-    if q.dense:
+    if q.dense and q.op not in ("stddev", "stdvar"):
         return _agg_block_dense(ts, vals, q)
+    if q.op in DENSE_ONLY_OPS:
+        raise ValueError(f"grid op {q.op} requires the dense contract")
     ns = ts.shape[1]
     T = q.nsteps
     dt = vals.dtype
@@ -286,6 +326,29 @@ def _agg_block(ts, vals, q: GridQuery):
             fd = sl(fin, d)
             v2 = jnp.where(fd, sl(vals, d), v2)
         return v2
+    if q.op in ("stddev", "stdvar"):
+        # moments centered on the per-lane grand mean, exactly like
+        # windows.stdvar_stddev (the centering defeats the E[x^2]-E[x]^2
+        # cancellation; variance itself is center-invariant).  In f32 the
+        # device and host paths agree to ~1e-4 relative (summation-order
+        # rounding) — exact in the f64 reference comparison.
+        nall = jnp.maximum(fin.sum(axis=0, keepdims=True), 1).astype(dt)
+        center = jnp.where(fin, vals, 0.0).sum(axis=0, keepdims=True) / nall
+        x = vals - center
+        s1 = jnp.zeros(shape, dt)
+        s2 = jnp.zeros(shape, dt)
+        n = jnp.zeros(shape, dt)
+        for d in range(q.kbuckets):
+            fd = sl(fin, d)
+            xd = sl(x, d)
+            n = n + fd.astype(dt)
+            s1 = s1 + jnp.where(fd, xd, 0.0)
+            s2 = s2 + jnp.where(fd, xd * xd, 0.0)
+        nsafe = jnp.maximum(n, 1.0)
+        mean = s1 / nsafe
+        var = jnp.maximum(s2 / nsafe - mean * mean, 0.0)
+        var = jnp.where(n > 0, var, jnp.nan)
+        return jnp.sqrt(var) if q.op == "stddev" else var
     s = jnp.zeros(shape, dt)
     c = jnp.zeros(shape, dt)
     mn = jnp.full(shape, jnp.inf, dt)
@@ -312,6 +375,8 @@ def _agg_block(ts, vals, q: GridQuery):
 
 
 def _rate_block(ts, vals, steps0, q: GridQuery):
+    if q.op in ("irate", "idelta"):
+        return _instant_pair_block(ts, vals, q)
     if q.op not in ("rate", "increase"):
         return _agg_block(ts, vals, q)
     roll = lambda x, s: pltpu.roll(x, s, axis=0)
@@ -434,10 +499,12 @@ def rate_grid_grouped(ts, vals, steps0, q: GridQuery,
 
 def rate_grid_ref(ts, vals, steps0: int, q: GridQuery):
     """Same semantics as :func:`rate_grid`, in portable jnp."""
-    if q.op not in ("rate", "increase"):
-        return _agg_block(ts, vals, q)
     def roll(x, s):
         return jnp.concatenate([x[-s:], x[:-s]], axis=0)
+    if q.op in ("irate", "idelta"):
+        return _instant_pair_block(ts, vals, q)
+    if q.op not in ("rate", "increase"):
+        return _agg_block(ts, vals, q)
     if q.dense:
         vcorr = _correct_dense(vals, roll)
         stats = _window_stats_dense(ts, vals, vcorr, q)
@@ -463,12 +530,19 @@ MAX_GRID_ROWS = 1024  # input rows per query: VMEM tile height bound (TPU)
 MAX_GRID_SPAN_ROWS = 16_384
 
 # ops whose DENSE kernel is K-free (rate/increase: window stats are two
-# static slices; last: one slice; count: a constant) — for these a
-# proven-dense query may use any K up to the row bound, which keeps
-# high-frequency data (5m window over 1s scrapes -> K=300) on the fast
-# path.  sum/avg/min/max accumulate K slices even when dense, so they
-# keep the unroll cap.
-K_FREE_DENSE_OPS = frozenset(("rate", "increase", "last", "count"))
+# static slices; last: one slice; count: a constant; irate/idelta: the
+# window's last two rows) — for these a proven-dense query may use any
+# K up to the row bound, which keeps high-frequency data (5m window
+# over 1s scrapes -> K=300) on the fast path.  sum/avg/min/max/stddev/
+# changes/... accumulate K slices even when dense, so they keep the
+# unroll cap.
+K_FREE_DENSE_OPS = frozenset(("rate", "increase", "last", "count",
+                              "irate", "idelta"))
+
+# ops defined only through consecutive-sample adjacency: on the grid a
+# NaN hole breaks adjacency, so these serve from the grid ONLY under
+# the proven dense contract (the general scan path serves otherwise)
+DENSE_ONLY_OPS = frozenset(("changes", "resets", "irate", "idelta"))
 
 
 def max_k_for(op: str, dense: bool) -> int:

@@ -255,6 +255,51 @@ class TestDeviceGrid:
         assert shard.scan_grid(res.part_ids, F.SUM_OVER_TIME, steps0,
                                nsteps, STEP, big_w) is None
 
+    @pytest.mark.parametrize("func,wfn", [
+        (F.STDDEV_OVER_TIME, "stddev_over_time"),
+        (F.IRATE, "irate"), (F.CHANGES, "changes_over_time")])
+    def test_extended_ops_served_from_grid(self, func, wfn):
+        from filodb_tpu.ops.windows import StepRange
+        from filodb_tpu.query import rangefns
+
+        ms, shard, _ = _mk_shard()
+        res = _lookup(shard)
+        steps0, nsteps = _steps(50)
+        got = shard.scan_grid(res.part_ids, func, steps0, nsteps, STEP,
+                              WINDOW)
+        assert got is not None, f"{func} should serve from the grid"
+        tags, vals, _tops = got
+        end = steps0 + (nsteps - 1) * STEP
+        t2, batch = shard.scan_batch(res.part_ids, steps0 - WINDOW, end)
+        want = np.asarray(rangefns.apply_range_function(
+            batch, StepRange(steps0, end, STEP), WINDOW, func))[:len(tags)]
+        got_v = np.asarray(vals)
+        assert (np.isfinite(got_v) == np.isfinite(want)).all(), func
+        fin = np.isfinite(want)
+        assert fin.any()
+        np.testing.assert_allclose(got_v[fin], want[fin], rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_adjacency_ops_gappy_fall_back(self):
+        ms, shard, _ = _mk_shard(n_series=4, n_rows=50)
+        b = RecordBuilder(DEFAULT_SCHEMAS["prom-counter"])
+        tags = {"__name__": "req_total", "instance": "gappy", "_ws_": "w",
+                "_ns_": "n"}
+        for c in range(0, 50, 2):
+            b.add(T0 + (c - 1) * STEP + 10, [float(c)], tags)
+        for off, c in enumerate(b.containers()):
+            shard.ingest(decode_container(c, DEFAULT_SCHEMAS), 800 + off)
+        shard.flush_all()
+        res = _lookup(shard)
+        steps0, nsteps = _steps(50)
+        # adjacency ops decline on gappy data; stddev (masked) still serves
+        assert shard.scan_grid(res.part_ids, F.CHANGES, steps0, nsteps,
+                               STEP, WINDOW) is None
+        assert shard.scan_grid(res.part_ids, F.IRATE, steps0, nsteps,
+                               STEP, WINDOW) is None
+        assert shard.scan_grid(res.part_ids, F.STDDEV_OVER_TIME, steps0,
+                               nsteps, STEP, WINDOW) is not None
+
     def test_large_window_gappy_falls_back(self):
         ms, shard, _ = _mk_shard(n_series=4, n_rows=200)
         b = RecordBuilder(DEFAULT_SCHEMAS["prom-counter"])

@@ -305,6 +305,98 @@ def _dense_data(n_series=128, n_empty=16, seed=3, reset_frac=0.05):
     return _clip(ts, vals)
 
 
+class TestGridMomentOps:
+    """stddev/stdvar on the grid vs the general windows kernels (both
+    use grand-mean-centered moments, so results match tightly)."""
+
+    @pytest.mark.parametrize("op,wfn", [
+        ("stdvar", "stdvar_over_time"), ("stddev", "stddev_over_time")])
+    @pytest.mark.parametrize("gap_frac", [0.0, 0.15])
+    def test_matches_windows(self, op, wfn, gap_frac):
+        ts, vals = _aligned_data(gap_frac=gap_frac)
+        steps = _steps()
+        q = GridQuery(nsteps=len(steps), kbuckets=K, gstep_ms=STEP, op=op)
+        cts, cvals = _clip(ts, vals)
+        got = np.asarray(rate_grid_ref(cts.astype(jnp.int64),
+                                       cvals.astype(jnp.float64),
+                                       int(steps[0]), q))
+        tsn, vn = np.asarray(cts), np.asarray(cvals)
+        S = tsn.shape[1]
+        dense_ts = np.full((S, tsn.shape[0]), 2**60, np.int64)
+        dense_v = np.full((S, tsn.shape[0]), np.nan)
+        for s in range(S):
+            keep = np.isfinite(vn[:, s])
+            k = keep.sum()
+            dense_ts[s, :k] = tsn[keep, s]
+            dense_v[s, :k] = vn[keep, s]
+        fn = getattr(windows, wfn)
+        want = np.asarray(fn(jnp.asarray(dense_ts), jnp.asarray(dense_v),
+                             steps, jnp.asarray(K * STEP, jnp.int64))).T
+        assert (np.isfinite(got) == np.isfinite(want)).all(), op
+        both = np.isfinite(got) & np.isfinite(want)
+        # summation order differs (K-slice loop vs prefix scans); near-
+        # zero variances amplify the rounding through sqrt -> atol
+        np.testing.assert_allclose(got[both], want[both], rtol=1e-7,
+                                   atol=1e-5)
+
+
+class TestGridDenseOnlyOps:
+    """changes/resets/irate/idelta: consecutive-sample adjacency ops —
+    grid-served only under the dense contract; exact vs windows."""
+
+    @pytest.mark.parametrize("op,wfn", [
+        ("changes", "changes_over_time"), ("resets", "resets_over_time"),
+        ("irate", "irate"), ("idelta", "idelta")])
+    def test_dense_matches_windows(self, op, wfn):
+        cts, cvals = _dense_data(reset_frac=0.1)
+        steps = _steps()
+        q = GridQuery(nsteps=len(steps), kbuckets=K, gstep_ms=STEP, op=op,
+                      dense=True)
+        got = np.asarray(rate_grid_ref(cts.astype(jnp.int64),
+                                       cvals.astype(jnp.float64),
+                                       int(steps[0]), q))
+        # live lanes are fully dense: compaction is the identity there
+        tsn, vn = np.asarray(cts), np.asarray(cvals)
+        fn = getattr(windows, wfn)
+        want = np.asarray(fn(jnp.asarray(tsn.T), jnp.asarray(vn.T), steps,
+                             jnp.asarray(K * STEP, jnp.int64))).T
+        live = np.isfinite(vn).any(axis=0)
+        got_l, want_l = got[:, live], want[:, live]
+        assert (np.isfinite(got_l) == np.isfinite(want_l)).all(), op
+        both = np.isfinite(got_l)
+        np.testing.assert_allclose(got_l[both], want_l[both], rtol=1e-9)
+        # empty lanes come back NaN
+        assert np.isnan(got[:, ~live]).all()
+
+    @pytest.mark.parametrize("op", ["changes", "resets", "irate", "idelta"])
+    def test_general_mode_rejected(self, op):
+        cts, cvals = _dense_data()
+        steps = _steps()
+        q = GridQuery(nsteps=len(steps), kbuckets=K, gstep_ms=STEP, op=op,
+                      dense=False)
+        with pytest.raises(ValueError, match="dense"):
+            rate_grid_ref(cts, cvals.astype(jnp.float64), int(steps[0]), q)
+
+    @pytest.mark.parametrize("op", ["changes", "resets", "irate", "idelta",
+                                    "stddev", "stdvar"])
+    def test_pallas_interpret(self, op):
+        cts, cvals = _dense_data(reset_frac=0.1)
+        steps = _steps()
+        q = GridQuery(nsteps=len(steps), kbuckets=K, gstep_ms=STEP, op=op,
+                      dense=(op not in ("stddev", "stdvar")))
+        ref = np.asarray(rate_grid_ref(cts.astype(jnp.int32),
+                                       cvals.astype(jnp.float32),
+                                       int(steps[0]), q))
+        pal = np.asarray(rate_grid(cts.astype(jnp.int32),
+                                   cvals.astype(jnp.float32),
+                                   jnp.int32(int(steps[0])), q, lanes=128,
+                                   interpret=True))
+        assert (np.isfinite(ref) == np.isfinite(pal)).all(), op
+        both = np.isfinite(ref)
+        np.testing.assert_allclose(pal[both], ref[both], rtol=1e-4,
+                                   atol=1e-5)
+
+
 class TestGridDense:
     """The dense fast path (GridQuery.dense) vs the general kernel on
     contract-conforming data: results must be identical — the dense
