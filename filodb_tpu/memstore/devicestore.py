@@ -56,8 +56,13 @@ _GRID_OPS = {
     F.STDDEV_OVER_TIME: "stddev", F.STDVAR_OVER_TIME: "stdvar",
     F.CHANGES: "changes", F.RESETS: "resets",
     F.IRATE: "irate", F.IDELTA: "idelta",
+    F.DERIV: "deriv", F.PREDICT_LINEAR: "predict_linear",
+    F.Z_SCORE: "zscore",
     None: "last",
 }
+
+# grid ops taking one scalar function argument (GridQuery.farg)
+_ARG_OPS = {"predict_linear"}
 
 # the subset defined on first-class histogram columns (per-bucket
 # semantics; matches the host path in query/rangefns.py _HIST_FNS)
@@ -310,7 +315,8 @@ class DeviceGridCache:
     # ---------------------------------------------------------------- serving
 
     def scan_rate(self, part_ids: Sequence[int], func: F, steps0: int,
-                  nsteps: int, step_ms: int, window_ms: int):
+                  nsteps: int, step_ms: int, window_ms: int,
+                  fargs: tuple = ()):
         """Serve any _GRID_OPS window function (rate/increase, the
         *_over_time family, the bare instant selector's last-sample scan)
         on the query step grid from device-resident blocks.  Returns
@@ -323,9 +329,11 @@ class DeviceGridCache:
             return None
         if self.hist and func not in _HIST_GRID_FNS:
             return None
+        if bool(fargs) != (_GRID_OPS[func] in _ARG_OPS):
+            return None        # unexpected / missing function argument
         with self._lock:
             vals = self._scan_rate_locked(part_ids, func, steps0, nsteps,
-                                          step_ms, window_ms)
+                                          step_ms, window_ms, fargs)
             if vals is None:
                 return None
             tops = np.asarray(self.bucket_tops) if self.hist else None
@@ -334,7 +342,8 @@ class DeviceGridCache:
     def scan_rate_grouped(self, part_ids: Sequence[int], func: F,
                           steps0: int, nsteps: int, step_ms: int,
                           window_ms: int, group_ids: Sequence[int],
-                          num_groups: int, op: str = "sum"):
+                          num_groups: int, op: str = "sum",
+                          fargs: tuple = ()):
         """Fused serve of ``agg by (g)(<grid window fn>(...))``: any
         _GRID_OPS window function under a distributive aggregate; the
         grid kernel's
@@ -347,9 +356,11 @@ class DeviceGridCache:
             return None
         if self.hist and (func not in _HIST_GRID_FNS or op != "sum"):
             return None
+        if bool(fargs) != (_GRID_OPS[func] in _ARG_OPS):
+            return None        # unexpected / missing function argument
         with self._lock:
             plan = self._plan_locked(part_ids, func, steps0, nsteps,
-                                     step_ms, window_ms)
+                                     step_ms, window_ms, fargs)
             if plan is None:
                 return None
             stride = self.hb if self.hist else 1
@@ -386,9 +397,9 @@ class DeviceGridCache:
         return {op: np.asarray(out, dtype=np.float64)}
 
     def _scan_rate_locked(self, part_ids, func, steps0, nsteps, step_ms,
-                          window_ms):
+                          window_ms, fargs=()):
         plan = self._plan_locked(part_ids, func, steps0, nsteps, step_ms,
-                                 window_ms)
+                                 window_ms, fargs)
         if plan is None:
             return None
         stepped = _fused_progs()["series"](
@@ -443,7 +454,7 @@ class DeviceGridCache:
         return prep
 
     def _plan_locked(self, part_ids, func, steps0, nsteps, step_ms,
-                     window_ms):
+                     window_ms, fargs=()):
         """Shared grid preamble: eligibility checks, block assembly, and
         the dense-contract proof.  Returns a :class:`_GridPlan` (device
         block refs + kernel config — NO device dispatch happens here; the
@@ -471,7 +482,8 @@ class DeviceGridCache:
         if not supports_grid(window_ms, step_ms, g, nsteps,
                              max_k=max_k_for(_GRID_OPS[func], dense=True)):
             return None
-        if self._bigk_deny.get((func, window_ms, step_ms)) == \
+        deny_key = (func, window_ms, step_ms, _ids_fingerprint(part_ids))
+        if self._bigk_deny.get(deny_key) == \
                 (self.version, shard.ingest_epoch):
             return None     # dense proof failed for this shape; data unchanged
         if self.hist and self.hb is None:
@@ -565,8 +577,10 @@ class DeviceGridCache:
             # the proven-dense K-free path.  Either way, memoize the
             # denial so a refreshing dashboard doesn't re-stage blocks
             # every cycle; the data changing (version/epoch) retries.
-            self._bigk_deny[(func, window_ms, step_ms)] = \
-                (self.version, shard.ingest_epoch)
+            # The key includes the request fingerprint: a gappy series
+            # set must not disable the fast path for a dense one that
+            # happens to share the query shape.
+            self._bigk_deny[deny_key] = (self.version, shard.ingest_epoch)
             if len(self._bigk_deny) > 64:
                 self._bigk_deny.clear()
             return None
@@ -574,7 +588,8 @@ class DeviceGridCache:
             self.dense_hits += 1
         q = GridQuery(nsteps=nsteps, kbuckets=K, gstep_ms=g,
                       is_rate=(func == F.RATE), op=_GRID_OPS[func],
-                      dense=dense, stride=stride_r)
+                      dense=dense, stride=stride_r,
+                      farg=float(fargs[0]) if fargs else 0.0)
         # tall strided slices read more input rows per tile: keep the
         # VMEM footprint bounded by narrowing the lane tile
         lane_mult = 1024 if (ncols % 1024 == 0 and nrows <= 256) \

@@ -540,7 +540,7 @@ class TimeSeriesShard:
 
     def scan_grid(self, part_ids: Sequence[int], func, steps0: int,
                   nsteps: int, step_ms: int, window_ms: int,
-                  column_id: Optional[int] = None):
+                  column_id: Optional[int] = None, fargs: tuple = ()):
         """Serve a windowed range function directly from the device-resident
         grid (memstore/devicestore.py).  Returns ``(tags_list, vals,
         bucket_tops)`` — vals ``[S, T]`` for scalar columns, ``[S, T, hb]``
@@ -554,7 +554,7 @@ class TimeSeriesShard:
             return None
         cache, ids = got
         served = cache.scan_rate(ids, func, steps0, nsteps, step_ms,
-                                 window_ms)
+                                 window_ms, fargs)
         if served is None:
             return None
         vals, tops = served
@@ -569,7 +569,8 @@ class TimeSeriesShard:
     def scan_grid_grouped(self, part_ids: Sequence[int], func, steps0: int,
                           nsteps: int, step_ms: int, window_ms: int,
                           group_ids: Sequence[int], num_groups: int,
-                          op: str, column_id: Optional[int] = None):
+                          op: str, column_id: Optional[int] = None,
+                          fargs: tuple = ()):
         """Fused ``agg by (g)(rate(...))`` from the device grid: the
         aggregation happens on device, so only [G, T] partials come back
         (see DeviceGridCache.scan_rate_grouped).  Returns the mergeable
@@ -579,7 +580,8 @@ class TimeSeriesShard:
             return None
         cache, ids = got
         return cache.scan_rate_grouped(ids, func, steps0, nsteps, step_ms,
-                                       window_ms, group_ids, num_groups, op)
+                                       window_ms, group_ids, num_groups, op,
+                                       fargs)
 
     def scan_batch(self, part_ids: Sequence[int], start_time: int, end_time: int,
                    column_id: Optional[int] = None

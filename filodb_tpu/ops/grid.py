@@ -114,6 +114,10 @@ class GridQuery(NamedTuple):
     is_rate: bool = True   # rate() vs increase() (when op is rate-like)
     op: str = "rate"
     dense: bool = False
+    # scalar function argument (predict_linear's horizon seconds);
+    # static, so each distinct value compiles its own kernel — dashboards
+    # use a handful of fixed horizons
+    farg: float = 0.0
     # query step = stride * gstep: window t covers input rows
     # [t*stride, t*stride + K - 1].  Dashboards commonly query with a
     # coarser step than the scrape cadence (step 5m over 1m data);
@@ -327,26 +331,7 @@ def _agg_block(ts, vals, q: GridQuery):
             v2 = jnp.where(fd, sl(vals, d), v2)
         return v2
     if q.op in ("stddev", "stdvar"):
-        # moments centered on the per-lane grand mean, exactly like
-        # windows.stdvar_stddev (the centering defeats the E[x^2]-E[x]^2
-        # cancellation; variance itself is center-invariant).  In f32 the
-        # device and host paths agree to ~1e-4 relative (summation-order
-        # rounding) — exact in the f64 reference comparison.
-        nall = jnp.maximum(fin.sum(axis=0, keepdims=True), 1).astype(dt)
-        center = jnp.where(fin, vals, 0.0).sum(axis=0, keepdims=True) / nall
-        x = vals - center
-        s1 = jnp.zeros(shape, dt)
-        s2 = jnp.zeros(shape, dt)
-        n = jnp.zeros(shape, dt)
-        for d in range(q.kbuckets):
-            fd = sl(fin, d)
-            xd = sl(x, d)
-            n = n + fd.astype(dt)
-            s1 = s1 + jnp.where(fd, xd, 0.0)
-            s2 = s2 + jnp.where(fd, xd * xd, 0.0)
-        nsafe = jnp.maximum(n, 1.0)
-        mean = s1 / nsafe
-        var = jnp.maximum(s2 / nsafe - mean * mean, 0.0)
+        n, _mean, var = _masked_moments(vals, fin, sl, q.kbuckets, dt)
         var = jnp.where(n > 0, var, jnp.nan)
         return jnp.sqrt(var) if q.op == "stddev" else var
     s = jnp.zeros(shape, dt)
@@ -374,9 +359,102 @@ def _agg_block(ts, vals, q: GridQuery):
     return jnp.where(c > 0, s, jnp.nan)   # sum
 
 
+def _linreg_block(ts, vals, steps0, q: GridQuery):
+    """Least-squares slope/forecast over each window (reference:
+    windows._linreg / Prometheus linearRegression with interceptTime =
+    the range end).  x is seconds relative to the window end, recentered
+    by +W/2 during accumulation so the f32 var/cov differences don't
+    cancel catastrophically (the slope is shift-invariant)."""
+    ns = ts.shape[1]
+    dt = vals.dtype
+    K = q.kbuckets
+    sl = _win_slicer(q, ns)
+    fin = jnp.isfinite(vals)
+    tcol = jax.lax.broadcasted_iota(jnp.int32, (q.nsteps, ns), 0)
+    hi = (steps0 + tcol * jnp.int32(q.gstep_ms * q.stride)).astype(dt)
+    w_s = q.kbuckets * q.gstep_ms / 1000.0
+    shift = jnp.asarray(w_s / 2.0, dt)
+    n = jnp.zeros(hi.shape, dt)
+    sx = jnp.zeros(hi.shape, dt)
+    sy = jnp.zeros(hi.shape, dt)
+    sxx = jnp.zeros(hi.shape, dt)
+    sxy = jnp.zeros(hi.shape, dt)
+    for d in range(K):
+        fd = sl(fin, d)
+        x = (sl(ts, d).astype(dt) - hi) / 1000.0 + shift
+        y = sl(vals, d)
+        fdt = fd.astype(dt)
+        x = jnp.where(fd, x, 0.0)
+        y = jnp.where(fd, y, 0.0)
+        n = n + fdt
+        sx = sx + x
+        sy = sy + y
+        sxx = sxx + x * x
+        sxy = sxy + x * y
+    nsafe = jnp.maximum(n, 1.0)
+    cov = sxy - sx * sy / nsafe
+    var = sxx - sx * sx / nsafe
+    slope = cov / jnp.where(var == 0, 1.0, var)
+    ok = (n >= 2) & (var > 0)
+    if q.op == "deriv":
+        return jnp.where(ok, slope, jnp.nan)
+    # intercept at x=0 of the ORIGINAL axis (window end): undo the shift
+    intercept = sy / nsafe - slope * (sx / nsafe - shift)
+    out = intercept + slope * jnp.asarray(q.farg, dt)
+    return jnp.where(ok, out, jnp.nan)
+
+
+def _masked_moments(vals, fin, sl, K, dt):
+    """Per-window (n, mean, var), centered on the per-lane grand mean
+    exactly like windows.stdvar_stddev (the centering defeats the
+    E[x^2]-E[x]^2 cancellation; variance itself is center-invariant).
+    In f32 the device and host paths agree to ~1e-4 relative
+    (summation-order rounding) — exact in the f64 reference."""
+    nall = jnp.maximum(fin.sum(axis=0, keepdims=True), 1).astype(dt)
+    center = jnp.where(fin, vals, 0.0).sum(axis=0, keepdims=True) / nall
+    x = vals - center
+    s1 = None
+    s2 = None
+    n = None
+    for d in range(K):
+        fd = sl(fin, d)
+        xd = jnp.where(fd, sl(x, d), 0.0)
+        fdt = fd.astype(dt)
+        s1 = xd if s1 is None else s1 + xd
+        s2 = xd * xd if s2 is None else s2 + xd * xd
+        n = fdt if n is None else n + fdt
+    nsafe = jnp.maximum(n, 1.0)
+    mean_x = s1 / nsafe
+    var = jnp.maximum(s2 / nsafe - mean_x * mean_x, 0.0)
+    return n, center + mean_x, var   # mean: [1,ns]+[T,ns] broadcasts
+
+
+def _zscore_block(ts, vals, q: GridQuery):
+    """(last - mean) / stddev over the window (reference ZScoreChunked /
+    windows.z_score, incl. the sd == 0 / n < 2 -> NaN rules)."""
+    ns = ts.shape[1]
+    dt = vals.dtype
+    K = q.kbuckets
+    sl = _win_slicer(q, ns)
+    fin = jnp.isfinite(vals)
+    n, mean, var = _masked_moments(vals, fin, sl, K, dt)
+    sd = jnp.sqrt(var)
+    lastv = None
+    for d in range(K):
+        fd = sl(fin, d)
+        vd = sl(vals, d)
+        lastv = jnp.where(fd, vd, jnp.nan if lastv is None else lastv)
+    out = (lastv - mean) / jnp.where(sd == 0, 1.0, sd)
+    return jnp.where((n >= 2) & (sd > 0), out, jnp.nan)
+
+
 def _rate_block(ts, vals, steps0, q: GridQuery):
     if q.op in ("irate", "idelta"):
         return _instant_pair_block(ts, vals, q)
+    if q.op in ("deriv", "predict_linear"):
+        return _linreg_block(ts, vals, steps0, q)
+    if q.op == "zscore":
+        return _zscore_block(ts, vals, q)
     if q.op not in ("rate", "increase"):
         return _agg_block(ts, vals, q)
     roll = lambda x, s: pltpu.roll(x, s, axis=0)
@@ -503,6 +581,10 @@ def rate_grid_ref(ts, vals, steps0: int, q: GridQuery):
         return jnp.concatenate([x[-s:], x[:-s]], axis=0)
     if q.op in ("irate", "idelta"):
         return _instant_pair_block(ts, vals, q)
+    if q.op in ("deriv", "predict_linear"):
+        return _linreg_block(ts, vals, jnp.int32(steps0), q)
+    if q.op == "zscore":
+        return _zscore_block(ts, vals, q)
     if q.op not in ("rate", "increase"):
         return _agg_block(ts, vals, q)
     if q.dense:

@@ -430,8 +430,10 @@ def z_score(ts, vals, steps, window):
     turn it into spurious +/-inf."""
     lastv, _ = last_sample(ts, vals, steps, window)
     _, sd = stdvar_stddev(ts, vals, steps, window)
-    _, _, mean = sum_count_avg(ts, vals, steps, window)
-    return jnp.where(sd == 0, jnp.nan, (lastv - mean) / sd)
+    _, n, mean = sum_count_avg(ts, vals, steps, window)
+    # n < 2 implies sd is exactly 0 mathematically; prefix-sum rounding
+    # can leave sd ~ 1e-9 and emit finite garbage without this guard
+    return jnp.where((sd == 0) | ~(n >= 2), jnp.nan, (lastv - mean) / sd)
 
 
 def holt_winters(ts, vals, steps, window, wmax: int, sf: float, tf: float):

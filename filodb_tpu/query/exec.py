@@ -228,7 +228,7 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                 return served
         got = shard.scan_grid(part_ids, mapper.function, steps.start,
                               steps.num_steps, steps.step, window_ms,
-                              column_id)
+                              column_id, fargs=tuple(mapper.function_args))
         if got is None:
             return None
         tags, vals, tops = got
@@ -260,7 +260,8 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         state = shard.scan_grid_grouped(
             part_ids, mapper.function, steps.start, steps.num_steps,
             steps.step, window_ms, gids, max(len(union), 1),
-            self._GRID_AGG_OPS[mapred.operator.name], column_id)
+            self._GRID_AGG_OPS[mapred.operator.name], column_id,
+            fargs=tuple(mapper.function_args))
         if state is None:
             return None
         tops = state.pop("bucket_tops", None)

@@ -130,9 +130,11 @@ class TestDeviceGrid:
                                STEP // 2, WINDOW) is None
         assert shard.scan_grid(res.part_ids, F.RATE, steps0 + 7, nsteps,
                                STEP, WINDOW) is None
-        # deriv has no aligned-grid kernel: stays on the general path
-        assert shard.scan_grid(res.part_ids, F.DERIV, steps0, nsteps,
-                               STEP, WINDOW) is None
+        # holt_winters has no aligned-grid kernel: stays on the general
+        # path (its per-window recurrence is inherently sequential)
+        assert shard.scan_grid(res.part_ids, F.HOLT_WINTERS, steps0,
+                               nsteps, STEP, WINDOW,
+                               fargs=(0.3, 0.1)) is None
 
     def test_flush_headroom_trims_below_budget(self):
         """The flush task proactively reclaims device blocks down to
@@ -255,9 +257,36 @@ class TestDeviceGrid:
         assert shard.scan_grid(res.part_ids, F.SUM_OVER_TIME, steps0,
                                nsteps, STEP, big_w) is None
 
+    def test_predict_linear_served_with_arg(self):
+        """predict_linear carries its horizon through GridQuery.farg."""
+        from filodb_tpu.ops.windows import StepRange
+        from filodb_tpu.query import rangefns
+
+        ms, shard, _ = _mk_shard()
+        res = _lookup(shard)
+        steps0, nsteps = _steps(50)
+        got = shard.scan_grid(res.part_ids, F.PREDICT_LINEAR, steps0,
+                              nsteps, STEP, WINDOW, fargs=(600.0,))
+        assert got is not None
+        tags, vals, _tops = got
+        end = steps0 + (nsteps - 1) * STEP
+        t2, batch = shard.scan_batch(res.part_ids, steps0 - WINDOW, end)
+        want = np.asarray(rangefns.apply_range_function(
+            batch, StepRange(steps0, end, STEP), WINDOW, F.PREDICT_LINEAR,
+            (600.0,)))[:len(tags)]
+        got_v = np.asarray(vals)
+        fin = np.isfinite(want)
+        assert fin.any()
+        assert (np.isfinite(got_v) == fin).all()
+        np.testing.assert_allclose(got_v[fin], want[fin], rtol=1e-4)
+        # missing the required arg: fall back, never mis-serve
+        assert shard.scan_grid(res.part_ids, F.PREDICT_LINEAR, steps0,
+                               nsteps, STEP, WINDOW) is None
+
     @pytest.mark.parametrize("func,wfn", [
         (F.STDDEV_OVER_TIME, "stddev_over_time"),
-        (F.IRATE, "irate"), (F.CHANGES, "changes_over_time")])
+        (F.IRATE, "irate"), (F.CHANGES, "changes_over_time"),
+        (F.DERIV, "deriv"), (F.Z_SCORE, "z_score")])
     def test_extended_ops_served_from_grid(self, func, wfn):
         from filodb_tpu.ops.windows import StepRange
         from filodb_tpu.query import rangefns
@@ -321,7 +350,8 @@ class TestDeviceGrid:
         assert shard.scan_grid(res.part_ids, F.RATE, T0 + 120 * STEP, 40,
                                STEP, 120 * STEP) is None
         assert cache.builds == builds0
-        assert (F.RATE, 120 * STEP, STEP) in cache._bigk_deny
+        assert any(k[:3] == (F.RATE, 120 * STEP, STEP)
+                   for k in cache._bigk_deny)
 
     def test_irregular_series_disables_grid(self):
         # two samples in one bucket violate the layout invariant
