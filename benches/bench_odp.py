@@ -1,0 +1,100 @@
+"""On-demand-paging query throughput (reference analog:
+jmh/.../QueryOnDemandBenchmark.scala:34 — queries over data that must be
+paged back from the column store).
+
+Data is ingested, flushed to the sqlite-backed column store, then a
+FRESH memstore recovers only the partkey index (partitions index-only,
+no chunks in memory).  The first query pages every partition's chunks
+in through the ODP read path; the repeat query serves from the page
+cache."""
+
+import sys
+import pathlib
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np  # noqa: E402
+
+from benches.common import emit, force_cpu_x64, log, timed  # noqa: E402
+
+force_cpu_x64()
+
+from filodb_tpu.core.filters import ColumnFilter, Equals  # noqa: E402
+from filodb_tpu.core.record import RecordBuilder, decode_container  # noqa: E402
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions  # noqa: E402
+from filodb_tpu.core.storeconfig import StoreConfig  # noqa: E402
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore  # noqa: E402
+from filodb_tpu.ops.windows import StepRange  # noqa: E402
+from filodb_tpu.query import rangefns  # noqa: E402
+from filodb_tpu.query.logical import RangeFunctionId  # noqa: E402
+from filodb_tpu.store.persistence import (DiskColumnStore,  # noqa: E402
+                                          DiskMetaStore)
+
+N_SERIES = 2_000
+N_ROWS = 300
+T0 = 1_700_000_000_000
+STEP = 10_000
+WINDOW = 60_000
+
+
+def main():
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as tmp:
+        disk = DiskColumnStore(str(pathlib.Path(tmp) / "c.db"))
+        meta = DiskMetaStore(str(pathlib.Path(tmp) / "m.db"))
+        store = TimeSeriesMemStore(disk, meta)
+        store.setup("prom", DEFAULT_SCHEMAS, 0, StoreConfig())
+        b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], DatasetOptions(),
+                          container_size=4 << 20)
+        ts = T0 + np.arange(N_ROWS, dtype=np.int64) * STEP
+        for i in range(N_SERIES):
+            b.add_series(ts, [np.cumsum(rng.random(N_ROWS))],
+                         {"_metric_": "odp_metric", "inst": f"i{i}",
+                          "_ws_": "w", "_ns_": "n"})
+        sh = store.get_shard("prom", 0)
+        for off, c in enumerate(b.containers()):
+            sh.ingest_container(c, off)
+        sh.flush_all(ingestion_time=1000)
+        total = N_SERIES * N_ROWS
+        log(f"{total} samples persisted; fresh store pages them back")
+
+        # fresh store: index-only partitions, chunks on disk
+        cold = TimeSeriesMemStore(disk, meta)
+        cold.setup("prom", DEFAULT_SCHEMAS, 0, StoreConfig())
+        assert cold.recover_index("prom", 0) == N_SERIES
+        shard = cold.get_shard("prom", 0)
+        filters = [ColumnFilter("_metric_", Equals("odp_metric"))]
+        steps0 = T0 + WINDOW
+        end = T0 + (N_ROWS - 1) * STEP
+        sr = StepRange(steps0, end, STEP)
+
+        def scan():
+            res = shard.lookup_partitions(filters, 0, 2**62)
+            tags, batch = shard.scan_batch(
+                list(res.part_ids) + res.missing_partkeys, 0, 2**62)
+            return tags, batch
+
+        import time
+        a = time.perf_counter()
+        tags, batch = scan()
+        t_cold = time.perf_counter() - a
+        assert len(tags) == N_SERIES
+        assert shard.stats.partitions_paged >= N_SERIES
+        emit("ODP cold scan (pages chunks from disk)", total / t_cold,
+             "samples/sec", paged=int(shard.stats.partitions_paged))
+        t_warm = timed(scan)
+        emit("ODP warm scan (page cache)", total / t_warm, "samples/sec")
+        # full query incl. the windowed kernel, for end-to-end context
+        def query():
+            tags, batch = scan()
+            return np.asarray(rangefns.apply_range_function(
+                batch, sr, WINDOW, RangeFunctionId.RATE))
+        query()
+        t_q = timed(query)
+        emit("ODP warm query incl. rate kernel (CPU)", total / t_q,
+             "samples/sec")
+
+
+if __name__ == "__main__":
+    main()

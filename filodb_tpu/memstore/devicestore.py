@@ -582,7 +582,9 @@ class DeviceGridCache:
             # happens to share the query shape.
             self._bigk_deny[deny_key] = (self.version, shard.ingest_epoch)
             if len(self._bigk_deny) > 64:
-                self._bigk_deny.clear()
+                # evict oldest (insertion order) — clearing all would
+                # thrash every memoized denial once >64 shapes exist
+                self._bigk_deny.pop(next(iter(self._bigk_deny)))
             return None
         if dense:
             self.dense_hits += 1
