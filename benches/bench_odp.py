@@ -21,7 +21,7 @@ from benches.common import emit, force_cpu_x64, log, timed  # noqa: E402
 force_cpu_x64()
 
 from filodb_tpu.core.filters import ColumnFilter, Equals  # noqa: E402
-from filodb_tpu.core.record import RecordBuilder, decode_container  # noqa: E402
+from filodb_tpu.core.record import RecordBuilder  # noqa: E402
 from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions  # noqa: E402
 from filodb_tpu.core.storeconfig import StoreConfig  # noqa: E402
 from filodb_tpu.memstore.memstore import TimeSeriesMemStore  # noqa: E402

@@ -580,10 +580,11 @@ class DeviceGridCache:
             # The key includes the request fingerprint: a gappy series
             # set must not disable the fast path for a dense one that
             # happens to share the query shape.
+            # LRU-on-write: re-denied hot shapes move to the back so the
+            # overflow eviction below drops a stale one-off, not them
+            self._bigk_deny.pop(deny_key, None)
             self._bigk_deny[deny_key] = (self.version, shard.ingest_epoch)
             if len(self._bigk_deny) > 64:
-                # evict oldest (insertion order) — clearing all would
-                # thrash every memoized denial once >64 shapes exist
                 self._bigk_deny.pop(next(iter(self._bigk_deny)))
             return None
         if dense:
@@ -674,7 +675,8 @@ class DeviceGridCache:
         the portable reference path keeps full double precision."""
         import jax
 
-        if jax.default_backend() in ("tpu", "axon"):
+        from filodb_tpu.ops.grid import on_tpu_backend
+        if on_tpu_backend():
             return np.float32
         return np.float64 if jax.config.jax_enable_x64 else np.float32
 

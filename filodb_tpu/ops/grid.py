@@ -57,6 +57,13 @@ from jax.experimental.pallas import tpu as pltpu
 _IBIG = 2**30
 
 
+def on_tpu_backend() -> bool:
+    """One shared predicate for every formulation switch: the one-hot /
+    Pallas paths exist for TPU-class backends; anything else takes the
+    portable gathers."""
+    return jax.default_backend() in ("tpu", "axon")
+
+
 def _win_slicer(q: "GridQuery", ns: int):
     """Window-indexed slice: row d of window t is input row t*stride+d,
     so slicing at offset d with row-stride q.stride yields the [T, ns]
@@ -600,7 +607,7 @@ def rate_grid_auto(ts, vals, steps0, q: GridQuery, lanes: int = 1024):
     """Pallas on TPU backends, portable reference elsewhere.  ``steps0``
     may be a traced scalar (this runs under the serving path's fused
     jit program)."""
-    if jax.default_backend() in ("tpu", "axon") and ts.shape[1] % lanes == 0:
+    if on_tpu_backend() and ts.shape[1] % lanes == 0:
         return rate_grid(ts, vals, steps0, q, lanes)
     return rate_grid_ref(ts, vals, steps0, q)
 
@@ -651,6 +658,6 @@ def supports_grid(window_ms: int, step_ms: int, gstep_ms: int,
     rows = (nsteps - 1) * stride + window_ms // gstep_ms
     if rows > MAX_GRID_SPAN_ROWS:
         return False    # block-assembly bound, any backend
-    if jax.default_backend() not in ("tpu", "axon"):
+    if not on_tpu_backend():
         return True     # portable reference path: no VMEM tile bound
     return rows <= MAX_GRID_ROWS

@@ -65,19 +65,22 @@ def window_bounds(ts: jnp.ndarray, steps: jnp.ndarray, window) -> tuple[jnp.ndar
     """
     lo = steps - window
     R, T = ts.shape[1], steps.shape[0]
-    if R * T <= 262_144:
+    from filodb_tpu.ops.grid import on_tpu_backend
+    on_tpu = on_tpu_backend()
+    if R * T <= 262_144 and on_tpu:
         # broadcast-compare-reduce: searchsorted(side='right') == count of
         # ts <= needle.  Pure VPU compare+reduce that XLA fuses without
         # materializing [S,R,T] — measured 12x faster than the bitonic-sort
-        # lowering at [1M, 60] x 55 on v5e.
+        # lowering at [1M, 60] x 55 on v5e.  (XLA:CPU does materialize the
+        # broadcast, so CPU always takes the searchsorted route below.)
         idx = jnp.int32
         first = (ts[:, :, None] <= lo[None, None, :]).sum(axis=1, dtype=idx)
         last = (ts[:, :, None] <= steps[None, None, :]).sum(axis=1, dtype=idx)
         return first, last
-    # big R*T: bitonic-sort lowering — no While loop in the HLO.  (The
-    # default 'scan' method emits lax.scan, which the TPU executes poorly
-    # and which wedges the axon tunnel entirely.)
-    method = "sort"
+    # bitonic-sort lowering on TPU — no While loop in the HLO (the 'scan'
+    # method emits lax.scan, which the TPU executes poorly and which
+    # wedges the axon tunnel entirely); CPU takes the default lowering.
+    method = "sort" if on_tpu else "scan"
     first = jax.vmap(lambda row: jnp.searchsorted(row, lo, side="right", method=method))(ts)
     last = jax.vmap(lambda row: jnp.searchsorted(row, steps, side="right", method=method))(ts)
     return first, last
@@ -109,10 +112,13 @@ def _row_select(arr: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     Formulated as a one-hot compare + masked reduce over R instead of
     ``take_along_axis``: TPU per-element gathers measured ~1.35s per [1M,55]
     pull vs ~90ms for the fused compare-reduce.  Falls back to gather for
-    large R*T where the broadcast would dominate.
+    large R*T where the broadcast would dominate — and ALWAYS on non-TPU
+    backends, where XLA:CPU materializes the [S,R,T] broadcast (measured
+    ~100x slower than its native gathers).
     """
     R, T = arr.shape[1], idx.shape[1]
-    if R * T <= 262_144:
+    from filodb_tpu.ops.grid import on_tpu_backend
+    if R * T <= 262_144 and on_tpu_backend():
         rows = jnp.arange(R, dtype=idx.dtype)
         oh = rows[None, :, None] == idx[:, None, :]          # [S,R,T]
         return jnp.where(oh, arr[:, :, None], 0).sum(axis=1)
