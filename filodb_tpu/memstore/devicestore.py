@@ -58,11 +58,19 @@ _GRID_OPS = {
     F.IRATE: "irate", F.IDELTA: "idelta",
     F.DERIV: "deriv", F.PREDICT_LINEAR: "predict_linear",
     F.Z_SCORE: "zscore",
+    F.QUANTILE_OVER_TIME: "quantile", F.MAD_OVER_TIME: "mad",
+    F.DELTA: "delta", F.TIMESTAMP: "timestamp",
     None: "last",
 }
 
+# timestamp() outputs epoch-relative seconds from the kernel (int32 grid
+# timestamps); the serving path re-bases to absolute and excludes the op
+# from the fused grouped reduce (summing absolute timestamps would need
+# a count-scaled re-base)
+_REBASE_OPS = {"timestamp"}
+
 # grid ops taking one scalar function argument (GridQuery.farg)
-_ARG_OPS = {"predict_linear"}
+_ARG_OPS = {"predict_linear", "quantile"}
 
 # the subset defined on first-class histogram columns (per-bucket
 # semantics; matches the host path in query/rangefns.py _HIST_FNS)
@@ -356,6 +364,8 @@ class DeviceGridCache:
             return None
         if self.hist and (func not in _HIST_GRID_FNS or op != "sum"):
             return None
+        if _GRID_OPS[func] in _REBASE_OPS:
+            return None        # re-based ops skip the fused reduce
         if bool(fargs) != (_GRID_OPS[func] in _ARG_OPS):
             return None        # unexpected / missing function argument
         with self._lock:
@@ -410,7 +420,18 @@ class DeviceGridCache:
         if self.hist:
             cols = lanes_req[:, None] * self.hb + np.arange(self.hb)[None, :]
             return out_np[:, cols].transpose(1, 0, 2)     # [S_req, T, hb]
-        return out_np[:, lanes_req].T                     # [S_req, T]
+        out = out_np[:, lanes_req].T                      # [S_req, T]
+        if plan.q.op in _REBASE_OPS:
+            # absolute window-end seconds, re-based in f64 on only the
+            # requested lanes (the kernel emits window-relative seconds
+            # so f32 stays exact)
+            q = plan.q
+            abs_s = (self.epoch0 + plan.steps0_rel
+                     + np.arange(q.nsteps, dtype=np.int64)
+                     * q.gstep_ms * q.stride) / 1000.0
+            out = out.astype(np.float64) + np.where(
+                np.isfinite(out), abs_s[None, :], 0.0)
+        return out
 
     def _prep_for(self, part_ids):
         """Memoized resolution of one lookup result: validate every pid

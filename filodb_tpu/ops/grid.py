@@ -238,9 +238,10 @@ def _extrapolate(nf, t1, t2, v1, v2, steps0, q: GridQuery):
     sampled = (t2f - t1f) / 1000.0
     avg_dur = sampled / jnp.maximum(nf - 1.0, 1.0)
     delta = v2 - v1
-    dur_zero = sampled * v1 / jnp.where(delta == 0, 1.0, delta)
-    clamp = (delta > 0) & (v1 >= 0) & (dur_zero < dur_start)
-    dur_start = jnp.where(clamp, dur_zero, dur_start)
+    if q.op != "delta":    # counter zero-point clamp (rate/increase only)
+        dur_zero = sampled * v1 / jnp.where(delta == 0, 1.0, delta)
+        clamp = (delta > 0) & (v1 >= 0) & (dur_zero < dur_start)
+        dur_start = jnp.where(clamp, dur_zero, dur_start)
     thresh = avg_dur * 1.1
     extrap = (sampled + jnp.where(dur_start < thresh, dur_start, avg_dur / 2.0)
               + jnp.where(dur_end < thresh, dur_end, avg_dur / 2.0))
@@ -455,13 +456,111 @@ def _zscore_block(ts, vals, q: GridQuery):
     return jnp.where((n >= 2) & (sd > 0), out, jnp.nan)
 
 
+def _batcher_pairs(K: int) -> list:
+    """Batcher odd-even mergesort compare-exchange pairs for K inputs —
+    a data-independent sorting network generated at trace time."""
+    pairs = []
+    p = 1
+    while p < K:
+        k = p
+        while k >= 1:
+            for j in range(k % p, K - k, 2 * k):
+                for i in range(0, min(k, K - j - k)):
+                    if (i + j) // (2 * p) == (i + j + k) // (2 * p):
+                        pairs.append((i + j, i + j + k))
+            k //= 2
+        p *= 2
+    return pairs
+
+
+def _sort_tiles(tiles: list) -> list:
+    out = list(tiles)
+    for a, b in _batcher_pairs(len(out)):
+        lo = jnp.minimum(out[a], out[b])
+        hi = jnp.maximum(out[a], out[b])
+        out[a], out[b] = lo, hi
+    return out
+
+
+def _interp_rank(sorted_tiles: list, phi: float):
+    """Linear-interpolated quantile over K sorted tiles: rank indices
+    are STATIC for a static (phi, K) — two tile reads, no gathers.
+    Matches jnp.nanquantile's linear method at n == K."""
+    import math
+    K = len(sorted_tiles)
+    if not math.isfinite(phi):
+        return jnp.full_like(sorted_tiles[0], jnp.nan)
+    r = min(max(phi, 0.0), 1.0) * (K - 1)
+    lo_i, hi_i = int(math.floor(r)), int(math.ceil(r))
+    frac = r - lo_i
+    if lo_i == hi_i:
+        return sorted_tiles[lo_i]
+    return sorted_tiles[lo_i] * (1.0 - frac) + sorted_tiles[hi_i] * frac
+
+
+def _sort_ops_block(ts, vals, q: GridQuery):
+    """quantile_over_time / mad_over_time under the dense contract via a
+    compile-time sorting network over the K window tiles (reference:
+    QuantileOverTimeChunkedFunction / MedianAbsoluteDeviationOverTime)."""
+    if not q.dense:
+        raise ValueError(f"grid op {q.op} requires the dense contract")
+    ns = ts.shape[1]
+    K = q.kbuckets
+    sl = _win_slicer(q, ns)
+    tiles = [sl(vals, d) for d in range(K)]
+    live = jnp.isfinite(tiles[0])
+    s = _sort_tiles(tiles)
+    if q.op == "quantile":
+        out = _interp_rank(s, q.farg)
+    else:                                     # mad
+        med = _interp_rank(s, 0.5)
+        dev = [jnp.abs(t - med) for t in tiles]
+        out = _interp_rank(_sort_tiles(dev), 0.5)
+    return jnp.where(live, out, jnp.nan)
+
+
+
+def _timestamp_block(ts, vals, steps0, q: GridQuery):
+    """timestamp() emitting seconds RELATIVE to each window's end: the
+    magnitudes stay within the window span, exact in f32 (epoch-relative
+    ms near the int32 limit would lose ~0.13 s to f32 rounding).  The
+    serving path re-bases to absolute seconds in f64 on the host."""
+    ns = ts.shape[1]
+    dt = vals.dtype
+    sl = _win_slicer(q, ns)
+    fin = jnp.isfinite(vals)
+    tcol = jax.lax.broadcasted_iota(jnp.int32, (q.nsteps, ns), 0)
+    hi = steps0 + tcol * jnp.int32(q.gstep_ms * q.stride)
+    if q.dense:
+        live = jnp.isfinite(sl(vals, 0))
+        rel = sl(ts, q.kbuckets - 1) - hi
+        return jnp.where(live, rel.astype(dt) / 1000.0, jnp.nan)
+    sel = jnp.full((q.nsteps, ns), _IBIG, ts.dtype)
+    for d in range(q.kbuckets):              # forward: last finite wins
+        fd = sl(fin, d)
+        sel = jnp.where(fd, sl(ts, d), sel)
+    return jnp.where(sel != _IBIG, (sel - hi).astype(dt) / 1000.0, jnp.nan)
+
+
 def _rate_block(ts, vals, steps0, q: GridQuery):
     if q.op in ("irate", "idelta"):
         return _instant_pair_block(ts, vals, q)
+    if q.op in ("quantile", "mad"):
+        return _sort_ops_block(ts, vals, q)
+    if q.op == "timestamp":
+        return _timestamp_block(ts, vals, steps0, q)
     if q.op in ("deriv", "predict_linear"):
         return _linreg_block(ts, vals, steps0, q)
     if q.op == "zscore":
         return _zscore_block(ts, vals, q)
+    if q.op == "delta":
+        # gauge delta: extrapolated like rate but with NO counter
+        # correction and NO zero-point clamp (reference delta_fn)
+        if q.dense:
+            stats = _window_stats_dense(ts, vals, vals, q)
+        else:
+            stats = _window_stats(ts, jnp.isfinite(vals), vals, q)
+        return _extrapolate(*stats, steps0, q)
     if q.op not in ("rate", "increase"):
         return _agg_block(ts, vals, q)
     roll = lambda x, s: pltpu.roll(x, s, axis=0)
@@ -588,10 +687,20 @@ def rate_grid_ref(ts, vals, steps0: int, q: GridQuery):
         return jnp.concatenate([x[-s:], x[:-s]], axis=0)
     if q.op in ("irate", "idelta"):
         return _instant_pair_block(ts, vals, q)
+    if q.op in ("quantile", "mad"):
+        return _sort_ops_block(ts, vals, q)
+    if q.op == "timestamp":
+        return _timestamp_block(ts, vals, jnp.int32(steps0), q)
     if q.op in ("deriv", "predict_linear"):
         return _linreg_block(ts, vals, jnp.int32(steps0), q)
     if q.op == "zscore":
         return _zscore_block(ts, vals, q)
+    if q.op == "delta":
+        if q.dense:
+            stats = _window_stats_dense(ts, vals, vals, q)
+        else:
+            stats = _window_stats(ts, jnp.isfinite(vals), vals, q)
+        return _extrapolate(*stats, jnp.int32(steps0), q)
     if q.op not in ("rate", "increase"):
         return _agg_block(ts, vals, q)
     if q.dense:
@@ -626,15 +735,23 @@ MAX_GRID_SPAN_ROWS = 16_384
 # changes/... accumulate K slices even when dense, so they keep the
 # unroll cap.
 K_FREE_DENSE_OPS = frozenset(("rate", "increase", "last", "count",
-                              "irate", "idelta"))
+                              "irate", "idelta", "delta", "timestamp"))
 
-# ops defined only through consecutive-sample adjacency: on the grid a
-# NaN hole breaks adjacency, so these serve from the grid ONLY under
-# the proven dense contract (the general scan path serves otherwise)
-DENSE_ONLY_OPS = frozenset(("changes", "resets", "irate", "idelta"))
+# ops defined only through consecutive-sample adjacency — or, for the
+# sort-based ops, requiring every window slot occupied (NaN poisons a
+# min/max sorting network): grid-served ONLY under the proven dense
+# contract (the general scan path serves otherwise)
+DENSE_ONLY_OPS = frozenset(("changes", "resets", "irate", "idelta",
+                            "quantile", "mad"))
+
+# sort-based ops run a Batcher network of O(K log^2 K) compare-exchanges
+# over [T, L] tiles; cap K so compile time and VPU work stay sane
+SORT_OPS_MAX_K = 32
 
 
 def max_k_for(op: str, dense: bool) -> int:
+    if op in ("quantile", "mad"):
+        return SORT_OPS_MAX_K
     return MAX_GRID_ROWS if dense and op in K_FREE_DENSE_OPS \
         else MAX_K_BUCKETS
 

@@ -235,7 +235,13 @@ def last_sample(ts, vals, steps, window):
 
 def timestamp_fn(ts, vals, steps, window):
     """PromQL timestamp(): seconds of the last sample (reference
-    rangefn/RangeFunction.scala:544 TimestampChunkedFunction)."""
+    rangefn/RangeFunction.scala:544 TimestampChunkedFunction).
+
+    Precision note: this general path casts absolute epoch seconds to
+    the value dtype — f32 on accelerators, which quantizes to ~128 s
+    near current epochs.  The device-grid serving path is exact (the
+    kernel emits window-relative seconds and the host re-bases in f64);
+    only this fallback carries the rounding."""
     _, t = last_sample(ts, vals, steps, window)
     return jnp.where(t < 0, jnp.nan, t.astype(vals.dtype) / 1000.0)
 

@@ -286,7 +286,8 @@ class TestDeviceGrid:
     @pytest.mark.parametrize("func,wfn", [
         (F.STDDEV_OVER_TIME, "stddev_over_time"),
         (F.IRATE, "irate"), (F.CHANGES, "changes_over_time"),
-        (F.DERIV, "deriv"), (F.Z_SCORE, "z_score")])
+        (F.DERIV, "deriv"), (F.Z_SCORE, "z_score"),
+        (F.DELTA, "delta_fn"), (F.TIMESTAMP, "timestamp_fn")])
     def test_extended_ops_served_from_grid(self, func, wfn):
         from filodb_tpu.ops.windows import StepRange
         from filodb_tpu.query import rangefns
@@ -308,6 +309,32 @@ class TestDeviceGrid:
         assert fin.any()
         np.testing.assert_allclose(got_v[fin], want[fin], rtol=1e-4,
                                    atol=1e-6)
+
+    def test_quantile_and_mad_served_from_grid(self):
+        """Sort-network ops serve dense data from the grid; the quantile
+        rides GridQuery.farg."""
+        from filodb_tpu.ops.windows import StepRange
+        from filodb_tpu.query import rangefns
+
+        ms, shard, _ = _mk_shard()
+        res = _lookup(shard)
+        steps0, nsteps = _steps(50)
+        for func, fargs in ((F.QUANTILE_OVER_TIME, (0.9,)),
+                            (F.MAD_OVER_TIME, ())):
+            got = shard.scan_grid(res.part_ids, func, steps0, nsteps, STEP,
+                                  WINDOW, fargs=fargs)
+            assert got is not None, func
+            tags, vals, _tops = got
+            end = steps0 + (nsteps - 1) * STEP
+            t2, batch = shard.scan_batch(res.part_ids, steps0 - WINDOW, end)
+            want = np.asarray(rangefns.apply_range_function(
+                batch, StepRange(steps0, end, STEP), WINDOW, func,
+                fargs))[:len(tags)]
+            got_v = np.asarray(vals)
+            fin = np.isfinite(want)
+            assert fin.any()
+            assert (np.isfinite(got_v) == fin).all(), func
+            np.testing.assert_allclose(got_v[fin], want[fin], rtol=1e-4)
 
     def test_adjacency_ops_gappy_fall_back(self):
         ms, shard, _ = _mk_shard(n_series=4, n_rows=50)

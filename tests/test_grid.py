@@ -463,7 +463,104 @@ class TestGridDenseOnlyOps:
         # empty lanes come back NaN
         assert np.isnan(got[:, ~live]).all()
 
-    @pytest.mark.parametrize("op", ["changes", "resets", "irate", "idelta"])
+    @pytest.mark.parametrize("gap_frac,dense", [(0.0, True), (0.15, False)])
+    def test_delta_matches_windows(self, gap_frac, dense):
+        ts, vals = _aligned_data(gap_frac=gap_frac)
+        steps = _steps()
+        q = GridQuery(nsteps=len(steps), kbuckets=K, gstep_ms=STEP,
+                      op="delta", dense=dense)
+        cts, cvals = _clip(ts, vals)
+        got = np.asarray(rate_grid_ref(cts.astype(jnp.int64),
+                                       cvals.astype(jnp.float64),
+                                       int(steps[0]), q))
+        dense_ts, dense_v = _compact(cts, cvals)
+        want = np.asarray(windows.delta_fn(
+            jnp.asarray(dense_ts), jnp.asarray(dense_v), steps,
+            jnp.asarray(K * STEP, jnp.int64))).T
+        assert (np.isfinite(got) == np.isfinite(want)).all()
+        both = np.isfinite(got) & np.isfinite(want)
+        np.testing.assert_allclose(got[both], want[both], rtol=1e-9)
+
+    @pytest.mark.parametrize("gap_frac,dense", [(0.0, True), (0.15, False)])
+    def test_timestamp_matches_windows(self, gap_frac, dense):
+        ts, vals = _aligned_data(gap_frac=gap_frac)
+        steps = _steps()
+        q = GridQuery(nsteps=len(steps), kbuckets=K, gstep_ms=STEP,
+                      op="timestamp", dense=dense)
+        cts, cvals = _clip(ts, vals)
+        got = np.asarray(rate_grid_ref(cts.astype(jnp.int64),
+                                       cvals.astype(jnp.float64),
+                                       int(steps[0]), q))
+        dense_ts, dense_v = _compact(cts, cvals)
+        want = np.asarray(windows.timestamp_fn(
+            jnp.asarray(dense_ts), jnp.asarray(dense_v), steps,
+            jnp.asarray(K * STEP, jnp.int64))).T
+        assert (np.isfinite(got) == np.isfinite(want)).all()
+        both = np.isfinite(got) & np.isfinite(want)
+        # the kernel emits WINDOW-relative seconds (f32-exact); the
+        # serving layer re-bases in f64 — re-base here the same way
+        abs_got = got + (np.asarray(steps, dtype=np.float64)
+                         / 1000.0)[:, None]
+        np.testing.assert_allclose(abs_got[both], want[both], rtol=1e-12)
+
+    @pytest.mark.parametrize("phi", [0.0, 0.25, 0.5, 0.9, 1.0])
+    def test_quantile_matches_windows(self, phi):
+        from filodb_tpu.query import rangefns as rf
+        cts, cvals = _dense_data()
+        steps = _steps()
+        q = GridQuery(nsteps=len(steps), kbuckets=K, gstep_ms=STEP,
+                      op="quantile", dense=True, farg=phi)
+        got = np.asarray(rate_grid_ref(cts.astype(jnp.int64),
+                                       cvals.astype(jnp.float64),
+                                       int(steps[0]), q))
+        dense_ts, dense_v = _compact(cts, cvals)
+        wmax = rf.bucket_wmax(dense_ts, np.asarray(steps), K * STEP)
+        want = np.asarray(windows.quantile_over_time(
+            jnp.asarray(dense_ts), jnp.asarray(dense_v), steps,
+            jnp.asarray(K * STEP, jnp.int64), wmax, phi)).T
+        live = np.isfinite(np.asarray(cvals)).any(axis=0)
+        assert (np.isfinite(got) == np.isfinite(want))[:, live].all()
+        both = np.isfinite(got) & np.isfinite(want)
+        np.testing.assert_allclose(got[both], want[both], rtol=1e-9)
+
+    def test_mad_matches_windows(self):
+        from filodb_tpu.query import rangefns as rf
+        cts, cvals = _dense_data()
+        steps = _steps()
+        q = GridQuery(nsteps=len(steps), kbuckets=K, gstep_ms=STEP,
+                      op="mad", dense=True)
+        got = np.asarray(rate_grid_ref(cts.astype(jnp.int64),
+                                       cvals.astype(jnp.float64),
+                                       int(steps[0]), q))
+        dense_ts, dense_v = _compact(cts, cvals)
+        wmax = rf.bucket_wmax(dense_ts, np.asarray(steps), K * STEP)
+        want = np.asarray(windows.mad_over_time(
+            jnp.asarray(dense_ts), jnp.asarray(dense_v), steps,
+            jnp.asarray(K * STEP, jnp.int64), wmax)).T
+        live = np.isfinite(np.asarray(cvals)).any(axis=0)
+        assert (np.isfinite(got) == np.isfinite(want))[:, live].all()
+        both = np.isfinite(got) & np.isfinite(want)
+        np.testing.assert_allclose(got[both], want[both], rtol=1e-9)
+
+    @pytest.mark.parametrize("op", ["quantile", "mad"])
+    def test_sort_ops_pallas_interpret(self, op):
+        cts, cvals = _dense_data()
+        steps = _steps()
+        q = GridQuery(nsteps=len(steps), kbuckets=K, gstep_ms=STEP, op=op,
+                      dense=True, farg=0.9)
+        ref = np.asarray(rate_grid_ref(cts.astype(jnp.int32),
+                                       cvals.astype(jnp.float32),
+                                       int(steps[0]), q))
+        pal = np.asarray(rate_grid(cts.astype(jnp.int32),
+                                   cvals.astype(jnp.float32),
+                                   jnp.int32(int(steps[0])), q, lanes=128,
+                                   interpret=True))
+        assert (np.isfinite(ref) == np.isfinite(pal)).all(), op
+        both = np.isfinite(ref)
+        np.testing.assert_allclose(pal[both], ref[both], rtol=1e-5)
+
+    @pytest.mark.parametrize("op", ["changes", "resets", "irate", "idelta",
+                                    "quantile", "mad"])
     def test_general_mode_rejected(self, op):
         cts, cvals = _dense_data()
         steps = _steps()
