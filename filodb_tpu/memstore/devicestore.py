@@ -60,6 +60,7 @@ _GRID_OPS = {
     F.Z_SCORE: "zscore",
     F.QUANTILE_OVER_TIME: "quantile", F.MAD_OVER_TIME: "mad",
     F.DELTA: "delta", F.TIMESTAMP: "timestamp",
+    F.HOLT_WINTERS: "holt_winters",
     None: "last",
 }
 
@@ -69,8 +70,9 @@ _GRID_OPS = {
 # a count-scaled re-base)
 _REBASE_OPS = {"timestamp"}
 
-# grid ops taking one scalar function argument (GridQuery.farg)
-_ARG_OPS = {"predict_linear", "quantile"}
+# grid ops taking scalar function arguments: op -> arity
+# (GridQuery.farg / farg2)
+_ARG_OPS = {"predict_linear": 1, "quantile": 1, "holt_winters": 2}
 
 # the subset defined on first-class histogram columns (per-bucket
 # semantics; matches the host path in query/rangefns.py _HIST_FNS)
@@ -337,7 +339,7 @@ class DeviceGridCache:
             return None
         if self.hist and func not in _HIST_GRID_FNS:
             return None
-        if bool(fargs) != (_GRID_OPS[func] in _ARG_OPS):
+        if len(fargs) != _ARG_OPS.get(_GRID_OPS[func], 0):
             return None        # unexpected / missing function argument
         with self._lock:
             vals = self._scan_rate_locked(part_ids, func, steps0, nsteps,
@@ -366,7 +368,7 @@ class DeviceGridCache:
             return None
         if _GRID_OPS[func] in _REBASE_OPS:
             return None        # re-based ops skip the fused reduce
-        if bool(fargs) != (_GRID_OPS[func] in _ARG_OPS):
+        if len(fargs) != _ARG_OPS.get(_GRID_OPS[func], 0):
             return None        # unexpected / missing function argument
         with self._lock:
             plan = self._plan_locked(part_ids, func, steps0, nsteps,
@@ -613,7 +615,8 @@ class DeviceGridCache:
         q = GridQuery(nsteps=nsteps, kbuckets=K, gstep_ms=g,
                       is_rate=(func == F.RATE), op=_GRID_OPS[func],
                       dense=dense, stride=stride_r,
-                      farg=float(fargs[0]) if fargs else 0.0)
+                      farg=float(fargs[0]) if fargs else 0.0,
+                      farg2=float(fargs[1]) if len(fargs) > 1 else 0.0)
         # tall strided slices read more input rows per tile: keep the
         # VMEM footprint bounded by narrowing the lane tile
         lane_mult = 1024 if (ncols % 1024 == 0 and nrows <= 256) \

@@ -121,10 +121,11 @@ class GridQuery(NamedTuple):
     is_rate: bool = True   # rate() vs increase() (when op is rate-like)
     op: str = "rate"
     dense: bool = False
-    # scalar function argument (predict_linear's horizon seconds);
-    # static, so each distinct value compiles its own kernel — dashboards
-    # use a handful of fixed horizons
+    # scalar function arguments (predict_linear's horizon seconds;
+    # holt_winters' smoothing factors); static, so each distinct value
+    # compiles its own kernel — dashboards use a handful of fixed values
     farg: float = 0.0
+    farg2: float = 0.0
     # query step = stride * gstep: window t covers input rows
     # [t*stride, t*stride + K - 1].  Dashboards commonly query with a
     # coarser step than the scrape cadence (step 5m over 1m data);
@@ -520,6 +521,35 @@ def _sort_ops_block(ts, vals, q: GridQuery):
 
 
 
+
+def _holt_winters_block(ts, vals, q: GridQuery):
+    """Double exponential smoothing under the dense contract: level
+    seeds from the window's first row, trend from the first pair, then
+    a K-step unrolled recurrence over the window tiles (reference
+    HoltWintersFunction; identical math to windows.holt_winters with
+    every sample present)."""
+    if not q.dense:
+        raise ValueError(f"grid op {q.op} requires the dense contract")
+    ns = ts.shape[1]
+    dt = vals.dtype
+    K = q.kbuckets
+    sl = _win_slicer(q, ns)
+    if K < 2:
+        return jnp.full((q.nsteps, ns), jnp.nan, dt)
+    sf = jnp.asarray(q.farg, dt)
+    tf = jnp.asarray(q.farg2, dt)
+    s = sl(vals, 0)
+    live = jnp.isfinite(s)
+    b = jnp.zeros_like(s)
+    for i in range(1, K):
+        y = sl(vals, i)
+        b_eff = (y - s) if i == 1 else b
+        xn = sf * y + (1.0 - sf) * (s + b_eff)
+        b = tf * (xn - s) + (1.0 - tf) * b_eff
+        s = xn
+    return jnp.where(live, s, jnp.nan)
+
+
 def _timestamp_block(ts, vals, steps0, q: GridQuery):
     """timestamp() emitting seconds RELATIVE to each window's end: the
     magnitudes stay within the window span, exact in f32 (epoch-relative
@@ -547,6 +577,8 @@ def _rate_block(ts, vals, steps0, q: GridQuery):
         return _instant_pair_block(ts, vals, q)
     if q.op in ("quantile", "mad"):
         return _sort_ops_block(ts, vals, q)
+    if q.op == "holt_winters":
+        return _holt_winters_block(ts, vals, q)
     if q.op == "timestamp":
         return _timestamp_block(ts, vals, steps0, q)
     if q.op in ("deriv", "predict_linear"):
@@ -689,6 +721,8 @@ def rate_grid_ref(ts, vals, steps0: int, q: GridQuery):
         return _instant_pair_block(ts, vals, q)
     if q.op in ("quantile", "mad"):
         return _sort_ops_block(ts, vals, q)
+    if q.op == "holt_winters":
+        return _holt_winters_block(ts, vals, q)
     if q.op == "timestamp":
         return _timestamp_block(ts, vals, jnp.int32(steps0), q)
     if q.op in ("deriv", "predict_linear"):
@@ -737,12 +771,14 @@ MAX_GRID_SPAN_ROWS = 16_384
 K_FREE_DENSE_OPS = frozenset(("rate", "increase", "last", "count",
                               "irate", "idelta", "delta", "timestamp"))
 
-# ops defined only through consecutive-sample adjacency — or, for the
-# sort-based ops, requiring every window slot occupied (NaN poisons a
-# min/max sorting network): grid-served ONLY under the proven dense
-# contract (the general scan path serves otherwise)
+# ops grid-served ONLY under the proven dense contract (the general
+# scan path serves otherwise): consecutive-sample adjacency ops
+# (changes/resets/irate/idelta), sort-based ops where NaN poisons a
+# min/max sorting network (quantile/mad), and recurrence ops whose
+# reference semantics SKIP NaN samples — the unrolled kernel is only
+# equivalent when every window slot is filled (holt_winters)
 DENSE_ONLY_OPS = frozenset(("changes", "resets", "irate", "idelta",
-                            "quantile", "mad"))
+                            "quantile", "mad", "holt_winters"))
 
 # sort-based ops run a Batcher network of O(K log^2 K) compare-exchanges
 # over [T, L] tiles; cap K so compile time and VPU work stay sane

@@ -130,11 +130,9 @@ class TestDeviceGrid:
                                STEP // 2, WINDOW) is None
         assert shard.scan_grid(res.part_ids, F.RATE, steps0 + 7, nsteps,
                                STEP, WINDOW) is None
-        # holt_winters has no aligned-grid kernel: stays on the general
-        # path (its per-window recurrence is inherently sequential)
+        # argument-arity mismatch must decline, never mis-serve
         assert shard.scan_grid(res.part_ids, F.HOLT_WINTERS, steps0,
-                               nsteps, STEP, WINDOW,
-                               fargs=(0.3, 0.1)) is None
+                               nsteps, STEP, WINDOW, fargs=(0.3,)) is None
 
     def test_flush_headroom_trims_below_budget(self):
         """The flush task proactively reclaims device blocks down to
@@ -320,7 +318,8 @@ class TestDeviceGrid:
         res = _lookup(shard)
         steps0, nsteps = _steps(50)
         for func, fargs in ((F.QUANTILE_OVER_TIME, (0.9,)),
-                            (F.MAD_OVER_TIME, ())):
+                            (F.MAD_OVER_TIME, ()),
+                            (F.HOLT_WINTERS, (0.3, 0.1))):
             got = shard.scan_grid(res.part_ids, func, steps0, nsteps, STEP,
                                   WINDOW, fargs=fargs)
             assert got is not None, func

@@ -542,12 +542,31 @@ class TestGridDenseOnlyOps:
         both = np.isfinite(got) & np.isfinite(want)
         np.testing.assert_allclose(got[both], want[both], rtol=1e-9)
 
-    @pytest.mark.parametrize("op", ["quantile", "mad"])
+    def test_holt_winters_matches_windows(self):
+        from filodb_tpu.query import rangefns as rf
+        cts, cvals = _dense_data()
+        steps = _steps()
+        q = GridQuery(nsteps=len(steps), kbuckets=K, gstep_ms=STEP,
+                      op="holt_winters", dense=True, farg=0.3, farg2=0.1)
+        got = np.asarray(rate_grid_ref(cts.astype(jnp.int64),
+                                       cvals.astype(jnp.float64),
+                                       int(steps[0]), q))
+        dense_ts, dense_v = _compact(cts, cvals)
+        wmax = rf.bucket_wmax(dense_ts, np.asarray(steps), K * STEP)
+        want = np.asarray(windows.holt_winters(
+            jnp.asarray(dense_ts), jnp.asarray(dense_v), steps,
+            jnp.asarray(K * STEP, jnp.int64), wmax, 0.3, 0.1)).T
+        live = np.isfinite(np.asarray(cvals)).any(axis=0)
+        assert (np.isfinite(got) == np.isfinite(want))[:, live].all()
+        both = np.isfinite(got) & np.isfinite(want)
+        np.testing.assert_allclose(got[both], want[both], rtol=1e-9)
+
+    @pytest.mark.parametrize("op", ["quantile", "mad", "holt_winters"])
     def test_sort_ops_pallas_interpret(self, op):
         cts, cvals = _dense_data()
         steps = _steps()
         q = GridQuery(nsteps=len(steps), kbuckets=K, gstep_ms=STEP, op=op,
-                      dense=True, farg=0.9)
+                      dense=True, farg=0.9, farg2=0.1)
         ref = np.asarray(rate_grid_ref(cts.astype(jnp.int32),
                                        cvals.astype(jnp.float32),
                                        int(steps[0]), q))
@@ -560,7 +579,7 @@ class TestGridDenseOnlyOps:
         np.testing.assert_allclose(pal[both], ref[both], rtol=1e-5)
 
     @pytest.mark.parametrize("op", ["changes", "resets", "irate", "idelta",
-                                    "quantile", "mad"])
+                                    "quantile", "mad", "holt_winters"])
     def test_general_mode_rejected(self, op):
         cts, cvals = _dense_data()
         steps = _steps()
