@@ -277,8 +277,10 @@ def _instant_pair_block(ts, vals, q: GridQuery):
     if q.op == "irate":
         delta = jnp.where(v2 < v1, v2, delta)   # adjacent-pair reset
     dt_s = (t2 - t1).astype(dt) / 1000.0
+    # the reference's shared instant-pair semantics drop a zero
+    # sampledInterval for idelta and irate alike (ADVICE r2)
     if q.op == "idelta":
-        return jnp.where(live, delta, jnp.nan)
+        return jnp.where(live & (dt_s > 0), delta, jnp.nan)
     return jnp.where(live & (dt_s > 0), delta / dt_s, jnp.nan)
 
 
@@ -489,9 +491,16 @@ def _interp_rank(sorted_tiles: list, phi: float):
     Matches jnp.nanquantile's linear method at n == K."""
     import math
     K = len(sorted_tiles)
-    if not math.isfinite(phi):
+    if math.isnan(phi):
         return jnp.full_like(sorted_tiles[0], jnp.nan)
-    r = min(max(phi, 0.0), 1.0) * (K - 1)
+    # Prometheus returns +Inf/-Inf for out-of-range phi (±Inf included)
+    # rather than clamping (reference QuantileOverTimeFunction); mask to
+    # live lanes happens in the caller
+    if phi > 1.0:
+        return jnp.full_like(sorted_tiles[0], jnp.inf)
+    if phi < 0.0:
+        return jnp.full_like(sorted_tiles[0], -jnp.inf)
+    r = phi * (K - 1)
     lo_i, hi_i = int(math.floor(r)), int(math.ceil(r))
     frac = r - lo_i
     if lo_i == hi_i:

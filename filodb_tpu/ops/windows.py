@@ -341,8 +341,10 @@ def irate(ts, vals, steps, window):
 
 
 def idelta(ts, vals, steps, window):
+    # zero sampledInterval drops the pair, same as irate (the
+    # reference's shared instant-pair guard; ADVICE r2)
     v1, v2, dt, valid = _instant_pair(ts, vals, steps, window, correct=False)
-    return jnp.where(valid, v2 - v1, jnp.nan)
+    return jnp.where(valid & (dt > 0), v2 - v1, jnp.nan)
 
 
 # --------------------------------------------------------------------------
@@ -393,6 +395,13 @@ def _nan_reduce(vw, op, identity):
 
 def quantile_over_time(ts, vals, steps, window, wmax: int, q: float):
     vw, _ = gather_windows(ts, vals, steps, window, wmax)
+    if q > 1.0 or q < 0.0:
+        # Prometheus returns ±Inf for out-of-range phi on windows that
+        # have samples (reference QuantileOverTimeFunction), where
+        # jnp.nanquantile would silently clamp; gather_windows pads
+        # only with NaN, so presence = any non-NaN (±Inf samples count)
+        live = (~jnp.isnan(vw)).any(axis=-1)
+        return jnp.where(live, jnp.inf if q > 1.0 else -jnp.inf, jnp.nan)
     out = jnp.nanquantile(vw, q, axis=-1)
     return out
 

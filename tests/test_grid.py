@@ -744,3 +744,56 @@ class TestGridDense:
         assert both.any()
         np.testing.assert_allclose(dense[both], general[both], rtol=1e-12)
         assert (np.isfinite(dense) == np.isfinite(general)).all()
+
+
+class TestAdviceParityFixes:
+    """Round-2 ADVICE findings: out-of-range quantile phi and idelta
+    zero-interval semantics must agree across grid and windows paths."""
+
+    @pytest.mark.parametrize("phi", [1.5, -0.5])
+    def test_quantile_out_of_range_phi(self, phi):
+        from filodb_tpu.query import rangefns as rf
+        cts, cvals = _dense_data()
+        steps = _steps()
+        q = GridQuery(nsteps=len(steps), kbuckets=K, gstep_ms=STEP,
+                      op="quantile", dense=True, farg=phi)
+        got = np.asarray(rate_grid_ref(cts.astype(jnp.int64),
+                                       cvals.astype(jnp.float64),
+                                       int(steps[0]), q))
+        expect = np.inf if phi > 1.0 else -np.inf
+        live = np.isfinite(np.asarray(cvals)).any(axis=0)
+        assert (got[:, live] == expect).all()
+        assert np.isnan(got[:, ~live]).all()
+        # windows fallback: same ±Inf on live windows, NaN on empty
+        tsn, vn = np.asarray(cts), np.asarray(cvals)
+        S = tsn.shape[1]
+        dense_ts = np.full((S, tsn.shape[0]), 2**60, np.int64)
+        dense_v = np.full((S, tsn.shape[0]), np.nan)
+        for s in range(S):
+            fin = np.isfinite(vn[:, s])
+            dense_ts[s, :fin.sum()] = tsn[fin, s]
+            dense_v[s, :fin.sum()] = vn[fin, s]
+        wmax = rf.bucket_wmax(dense_ts, np.asarray(steps), K * STEP)
+        want = np.asarray(windows.quantile_over_time(
+            jnp.asarray(dense_ts), jnp.asarray(dense_v), steps,
+            jnp.asarray(K * STEP, jnp.int64), wmax, phi)).T
+        assert (want[:, live] == expect).all()
+        assert np.isnan(want[:, ~live]).all()
+
+    def test_idelta_zero_interval_dropped(self):
+        """Two adjacent rows with IDENTICAL timestamps (possible on the
+        public rate_grid_ref API): idelta must drop the pair like irate
+        does, matching the reference's shared instant-pair guard."""
+        n = 8
+        base = (np.arange(B, dtype=np.int64) * STEP + T0 - STEP + 1)[:, None]
+        ts = base + 10_000 + np.zeros((B, n), np.int64)
+        ts[-1, :] = ts[-2, :]                      # dt == 0 at the pair
+        vals = np.cumsum(np.full((B, n), 3.0), axis=0)
+        cts, cvals = _clip(jnp.asarray(ts), jnp.asarray(vals))
+        steps = _steps()
+        q = GridQuery(len(steps), K, STEP, op="idelta", dense=True)
+        out = np.asarray(rate_grid_ref(cts, cvals.astype(jnp.float64),
+                                       int(steps[0]), q))
+        # the final window's instant pair has dt==0 -> NaN there
+        assert np.isnan(out[-1, :]).all()
+        assert np.isfinite(out[:-1, :]).all()
