@@ -226,6 +226,20 @@ DEFAULT_SCHEMA_CONFIG: dict[str, dict] = {
         "value-column": "avg",
         "downsamplers": [],
     },
+    # histogram with an extra max column: queries pair the hist kernel
+    # with the max plane so histogram_max_quantile can cap the top bucket
+    # (reference: SelectRawPartitionsExec.histMaxColumn + the hist-max
+    # test schemas; rewrites in query/dsrewrite.py)
+    "prom-hist-max": {
+        "columns": ["timestamp:ts", "sum:double:detectDrops=true",
+                    "count:double:detectDrops=true", "max:double",
+                    "h:hist:counter=true"],
+        "value-column": "h",
+        "downsamplers": ["tTime(0)", "dLast(1)", "dLast(2)", "dMax(3)",
+                         "hLast(4)"],
+        "downsample-period-marker": "counter(2)",
+        "downsample-schema": "prom-hist-max",
+    },
 }
 
 DEFAULT_SCHEMAS = Schemas.from_config(DEFAULT_SCHEMA_CONFIG)

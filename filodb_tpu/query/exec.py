@@ -181,16 +181,162 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         shard = ctx.memstore.get_shard(self.dataset, self.shard)
         lookup = shard.lookup_partitions(self.filters, self.start_ms,
                                          self.end_ms)
-        column_id = None
-        if self.column is not None and lookup.first_schema_hash is not None:
+        schema = None
+        if lookup.first_schema_hash is not None:
             schema = shard.schemas.by_hash(lookup.first_schema_hash)
+        column_id = None
+        if self.column is not None and schema is not None:
             column_id = schema.data.column(self.column).id
+        elif schema is not None:
+            # schema-driven rewrites AFTER discovery, BEFORE scanning
+            # (reference: MultiSchemaPartitionsExec.finalizePlan :41-85)
+            served = self._try_schema_rewrite(shard, lookup.part_ids, schema)
+            if served is not None:
+                return served
         served = self._try_device_grid(shard, lookup.part_ids, column_id)
         if served is not None:
             return served
         tags, batch = shard.scan_batch(lookup.part_ids, self.start_ms,
                                        self.end_ms, column_id)
         return [RawBatch(tags, batch)]
+
+    # -- downsample-gauge & hist-max schema rewrites ------------------------
+
+    def _first_mapper(self):
+        from filodb_tpu.query.transformers import PeriodicSamplesMapper
+        if not self.transformers:
+            return None
+        mapper = self.transformers[0]
+        if not isinstance(mapper, PeriodicSamplesMapper):
+            return None
+        if not mapper.well_formed:
+            return None
+        return mapper
+
+    def _try_schema_rewrite(self, shard, part_ids, schema):
+        """ds-gauge column selection + range-function swap, and hist+max
+        column pairing (see filodb_tpu.query.dsrewrite).  Returns leaf
+        batches (already stepped — the mapper passes them through) or
+        None when no rewrite applies."""
+        from filodb_tpu.query import dsrewrite
+        mapper = self._first_mapper()
+        if mapper is None or len(part_ids) == 0:
+            return None
+        if dsrewrite.is_ds_gauge(schema.data):
+            return self._execute_ds_gauge(shard, part_ids, schema, mapper)
+        if dsrewrite.hist_max_column(schema.data) is not None:
+            return self._execute_hist_max(shard, part_ids, schema, mapper)
+        return None
+
+    def _scan_stepped(self, shard, part_ids, steps, window_ms, func, cid,
+                      fargs=()):
+        """One column read + windowed range function, grid-served when
+        possible: returns (tags, values, bucket_tops) with values
+        [len(tags), T] ([len(tags), T, hb] for hist columns)."""
+        from filodb_tpu.query import rangefns
+        got = shard.scan_grid(part_ids, func, steps.start, steps.num_steps,
+                              steps.step, window_ms, cid, fargs=fargs)
+        if got is not None:
+            return got
+        tags, batch = shard.scan_batch(part_ids, self.start_ms, self.end_ms,
+                                       cid)
+        if batch is None or not tags:
+            return None
+        vals = np.asarray(rangefns.apply_range_function(
+            batch, steps, window_ms, func, fargs))
+        tops = np.asarray(batch.bucket_tops) if batch.hist is not None \
+            else None
+        # scan_batch pads the series axis; trim to the real tag rows so
+        # paired two-column reads stay row-aligned
+        return tags, vals[:len(tags)], tops
+
+    @staticmethod
+    def _align_pair(got_a, got_b):
+        """Row-align two independently scanned planes by series tags.
+        One plane can be grid-served ([n, T] exact) while the other
+        fell back to scan_batch, and a partition evicted between the
+        two scans can drop a row from one side only — intersect on the
+        tag identity so series are never cross-paired."""
+        tags_a, va, tops_a = got_a
+        tags_b, vb, _ = got_b
+        if tags_a == tags_b:
+            return tags_a, va, vb, tops_a
+        def key(t):
+            return tuple(sorted(t.items()))
+        idx_b = {key(t): i for i, t in enumerate(tags_b)}
+        keep_a, keep_b, tags = [], [], []
+        for i, t in enumerate(tags_a):
+            j = idx_b.get(key(t))
+            if j is not None:
+                keep_a.append(i)
+                keep_b.append(j)
+                tags.append(t)
+        if not tags:
+            return None
+        return tags, np.asarray(va)[keep_a], np.asarray(vb)[keep_b], tops_a
+
+    def _execute_ds_gauge(self, shard, part_ids, schema, mapper):
+        from filodb_tpu.query import dsrewrite
+        from filodb_tpu.query.logical import RangeFunctionId as F
+        rw = dsrewrite.ds_gauge_rewrite(mapper.function)
+        if rw is None:
+            return None        # default avg column is already correct
+        cols, func = rw
+        steps, report = mapper.step_ranges()
+        window = mapper.effective_window_ms
+        if func is not None:
+            cid = schema.data.column(cols[0]).id
+            got = self._scan_stepped(shard, part_ids, steps, window, func,
+                                     cid, tuple(mapper.function_args))
+            if got is None:
+                return []
+            tags, vals, _ = got
+            return [PeriodicBatch(tags, report, vals)]
+        # AvgWithSumAndCountOverTime: sum(period sums) / sum(period counts)
+        sum_cid = schema.data.column("sum").id
+        cnt_cid = schema.data.column("count").id
+        got_s = self._scan_stepped(shard, part_ids, steps, window,
+                                   F.SUM_OVER_TIME, sum_cid)
+        got_c = self._scan_stepped(shard, part_ids, steps, window,
+                                   F.SUM_OVER_TIME, cnt_cid)
+        if got_s is None or got_c is None:
+            return []
+        pair = self._align_pair(got_s, got_c)
+        if pair is None:
+            return []
+        tags, sums, counts, _ = pair
+        with np.errstate(invalid="ignore", divide="ignore"):
+            vals = np.where(counts > 0, sums / counts, np.nan)
+        return [PeriodicBatch(tags, report, vals)]
+
+    def _execute_hist_max(self, shard, part_ids, schema, mapper):
+        """Histogram schema with a max column: pair the hist kernel with
+        the max column so histogram_max_quantile sees both planes
+        (reference: histMaxRangeFunction — None -> LastSampleHistMax,
+        sum_over_time -> SumAndMaxOverTime)."""
+        from filodb_tpu.query import dsrewrite
+        from filodb_tpu.query.logical import RangeFunctionId as F
+        if mapper.function not in (None, F.SUM_OVER_TIME):
+            return None        # rate/increase etc: hist column only
+        steps, report = mapper.step_ranges()
+        window = mapper.effective_window_ms
+        hist_cid = schema.data.value_column_id
+        max_cid = dsrewrite.hist_max_column(schema.data)
+        max_func = None if mapper.function is None else F.MAX_OVER_TIME
+        got_h = self._scan_stepped(shard, part_ids, steps, window,
+                                   mapper.function, hist_cid)
+        if got_h is None:
+            return []
+        got_m = self._scan_stepped(shard, part_ids, steps, window,
+                                   max_func, max_cid)
+        if got_m is None:
+            return []
+        pair = self._align_pair(got_h, got_m)
+        if pair is None:
+            return []
+        tags, hvals, mvals, tops = pair
+        return [PeriodicBatch(tags, report, mvals, hist=hvals,
+                              bucket_tops=tops)]
 
     _GRID_AGG_OPS = {"SUM": "sum", "COUNT": "count", "AVG": "avg",
                      "MIN": "min", "MAX": "max"}
@@ -210,14 +356,12 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         mapper = self.transformers[0]
         if not isinstance(mapper, PeriodicSamplesMapper):
             return None
-        if (mapper.window_ms is None) != (mapper.function is None):
+        if not mapper.well_formed:
             return None   # half-specified windowing: general path decides
         # bare instant selector: the staleness lookback is a
         # last-sample-in-window scan the grid serves directly
         window_ms = mapper.effective_window_ms
-        steps = StepRange(mapper.start_ms - mapper.offset_ms,
-                          mapper.end_ms - mapper.offset_ms, mapper.step_ms)
-        report = StepRange(mapper.start_ms, mapper.end_ms, mapper.step_ms)
+        steps, report = mapper.step_ranges()
         mapred = self.transformers[1] if len(self.transformers) > 1 else None
         if isinstance(mapred, AggregateMapReduce) and not mapred.params \
                 and mapred.operator.name in self._GRID_AGG_OPS:

@@ -62,11 +62,25 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
     def effective_window_ms(self) -> int:
         return effective_window_ms(self.window_ms, self.stale_ms)
 
-    def apply(self, batches, ctx):
-        out = []
+    @property
+    def well_formed(self) -> bool:
+        """False for half-specified windowing (window without function or
+        vice versa) — fast paths must decline and let apply() decide."""
+        return (self.window_ms is None) == (self.function is None)
+
+    def step_ranges(self) -> tuple[StepRange, StepRange]:
+        """(compute steps, report steps): ``offset`` shifts the scanned
+        windows into the past while results are reported at the query
+        grid.  The single home of this math — apply(), the device-grid
+        fast path, and the schema-rewrite path all call it."""
         steps = StepRange(self.start_ms - self.offset_ms,
                           self.end_ms - self.offset_ms, self.step_ms)
         report = StepRange(self.start_ms, self.end_ms, self.step_ms)
+        return steps, report
+
+    def apply(self, batches, ctx):
+        out = []
+        steps, report = self.step_ranges()
         window = self.effective_window_ms
         for b in batches:
             if isinstance(b, (PeriodicBatch, AggPartialBatch)):

@@ -67,3 +67,26 @@ def histogram_containers(n_series: int = 2, n_samples: int = 50,
             builder.add(start + t * step, (float(total), float(total), blob),
                         gauge_tags(s, metric))
     return builder.containers()
+
+
+def hist_max_containers(n_series: int = 2, n_samples: int = 50,
+                        start: int = START_TS, step: int = 10_000,
+                        metric: str = "lat_hmax", num_buckets: int = 8,
+                        seed: int = 9) -> list[bytes]:
+    """prom-hist-max records: hist column + observed-max double column
+    (reference: hist-max test schemas, SelectRawPartitionsExec.histMaxColumn).
+    """
+    rng = np.random.default_rng(seed)
+    buckets = GeometricBuckets(2.0, 2.0, num_buckets)
+    builder = RecordBuilder(DEFAULT_SCHEMAS["prom-hist-max"], DatasetOptions())
+    for s in range(n_series):
+        cum = np.zeros(num_buckets, dtype=np.int64)
+        for t in range(n_samples):
+            cum += np.sort(rng.integers(0, 5, num_buckets))
+            blob = histcodec.encode_hist_value(buckets, np.cumsum(cum))
+            total = int(np.cumsum(cum)[-1])
+            mx = float(rng.uniform(1.0, 2.0 ** num_buckets))
+            builder.add(start + t * step,
+                        (float(total), float(total), mx, blob),
+                        gauge_tags(s, metric))
+    return builder.containers()

@@ -264,3 +264,98 @@ def test_best_resolution():
     assert ds.best_resolution(60_000) == 60_000
     assert ds.best_resolution(3_600_000) == 3_600_000
     assert ds.best_resolution(10**9) == 3_600_000
+
+
+class TestDownsampleQueryRewrites:
+    """Query-side downsample-schema rewrites (reference:
+    MultiSchemaPartitionsExec.scala:41-85, RangeFunction.scala:238-267):
+    min/max/sum/count/avg_over_time over a ds-gauge dataset must read the
+    matching aggregate COLUMN, not the avg column, and therefore match a
+    brute-force oracle over the RAW samples exactly (windows aligned to
+    period boundaries)."""
+
+    W = 5  # window periods
+
+    @pytest.fixture(scope="class")
+    def served_store(self):
+        schemas, containers, truth = _ingest_gauge(n_series=3, n_rows=600,
+                                                   res_span=30)
+        store = TimeSeriesMemStore()
+        shard = store.setup("prom", schemas, 0)
+        pub = MemoryDownsamplePublisher()
+        shard.enable_downsampling(pub, (RES,))
+        for off, c in enumerate(containers):
+            store.ingest("prom", 0, c, offset=off)
+        shard.flush_all()
+        ds = DownsampledTimeSeriesStore("prom", resolutions_ms=(RES,))
+        ds.setup(schemas, 0)
+        ds.ingest_from_publisher(pub)
+        return ds, truth
+
+    def _run(self, ds, promql, start, step, end):
+        from filodb_tpu.coordinator.planner import SingleClusterPlanner
+        from filodb_tpu.core.schemas import DatasetOptions
+        from filodb_tpu.parallel.shardmap import ShardMapper
+        from filodb_tpu.promql.parser import query_range_to_logical_plan
+        from filodb_tpu.query.exec import ExecContext
+        from filodb_tpu.query.model import QueryContext
+        name = ds_dataset_name("prom", RES)
+        planner = SingleClusterPlanner(name, ShardMapper(1), DatasetOptions(),
+                                       spread_default=0)
+        plan = query_range_to_logical_plan(promql, start, step, end)
+        ep = planner.materialize(plan, QueryContext(sample_limit=10**9))
+        res = ep.execute(ExecContext(ds.memstore))
+        out = {}
+        for b in res.batches:
+            vals = np.asarray(b.values)
+            for i, tags in enumerate(b.keys):
+                out[tags["instance"]] = (np.asarray(b.steps.timestamps()),
+                                         vals[i])
+        return out
+
+    def _oracle(self, ts, vals, step_ts, fn):
+        w = self.W * RES
+        out = np.full(len(step_ts), np.nan)
+        for j, t in enumerate(step_ts):
+            m = (ts > t - w) & (ts <= t)
+            if m.any():
+                out[j] = fn(vals[m])
+        return out
+
+    @pytest.mark.parametrize("func,orc", [
+        ("min_over_time", np.min), ("max_over_time", np.max),
+        ("sum_over_time", np.sum), ("count_over_time", len),
+        ("avg_over_time", np.mean)])
+    def test_matches_raw_oracle(self, func, orc, served_store):
+        ds, truth = served_store
+        # steps on period boundaries so ds periods tile the windows
+        start = ((BASE // RES) + self.W + 1) * RES
+        end = ((BASE // RES) + 25) * RES
+        out = self._run(
+            ds, f'{func}(disk_io{{_ws_="w",_ns_="n"}}[{self.W}m])',
+            start, RES, end)
+        assert set(out) == set(truth)
+        for inst, (ts, vals) in truth.items():
+            got_ts, got = out[inst]
+            want = self._oracle(ts, vals, got_ts, orc)
+            both = np.isfinite(got) & np.isfinite(want)
+            assert (np.isfinite(got) == np.isfinite(want)).all()
+            np.testing.assert_allclose(got[both], want[both], rtol=1e-10)
+
+    def test_instant_selector_serves_avg(self, served_store):
+        ds, truth = served_store
+        start = ((BASE // RES) + self.W + 1) * RES
+        end = ((BASE // RES) + 25) * RES
+        out = self._run(ds, 'disk_io{_ws_="w",_ns_="n"}', start, RES, end)
+        # last sample within lookback = the latest period's AVG
+        for inst, (ts, vals) in truth.items():
+            got_ts, got = out[inst]
+            for j, t in enumerate(got_ts):
+                pids = _oracle_periods(ts)
+                elig = pids[(ts <= t) & (ts > t - 300_000)]
+                if len(elig) == 0:
+                    assert np.isnan(got[j])
+                    continue
+                p = elig[-1]
+                np.testing.assert_allclose(got[j], vals[pids == p].mean(),
+                                           rtol=1e-10)

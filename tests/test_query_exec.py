@@ -520,3 +520,96 @@ class TestMetadataExec:
         assert "DistConcatExec" in tree
         assert "MultiSchemaPartitionsExec" in tree
         assert "PeriodicSamplesMapper" in tree
+
+
+class TestHistMaxSchema:
+    """Histogram schema with a max column: the leaf pairs the hist kernel
+    with the max plane (reference: histMaxRangeFunction — None ->
+    LastSampleHistMax, sum_over_time -> SumAndMaxOverTime;
+    SelectRawPartitionsExec.scala:52-63)."""
+
+    @pytest.fixture(scope="class")
+    def hm_store(self):
+        from tests.data import hist_max_containers
+        store = TimeSeriesMemStore()
+        store.setup("hm", DEFAULT_SCHEMAS, 0)
+        for off, c in enumerate(hist_max_containers(n_series=2,
+                                                    n_samples=60)):
+            store.ingest("hm", 0, c, off)
+        return store
+
+    def _raw(self, hm_store):
+        sh = hm_store.get_shard("hm", 0)
+        look = sh.lookup_partitions([eq("_metric_", "lat_hmax")], 0, MAX)
+        out = {}
+        for pid in look.part_ids:
+            p = sh.partitions[int(pid)]
+            ts, (buckets, rows) = p.read_range(0, MAX, 4)
+            _, mx = p.read_range(0, MAX, 3)
+            out[p.tags["instance"]] = (np.asarray(ts), np.asarray(rows),
+                                       np.asarray(mx))
+        return out
+
+    def test_sum_over_time_pairs_hist_and_max(self, hm_store):
+        raw = self._raw(hm_store)
+        start, end, w = START_TS + 300_000, START_TS + 590_000, 300_000
+        leaf = MultiSchemaPartitionsExec("hm", 0, [eq("_metric_", "lat_hmax")],
+                                         start - w, end)
+        leaf.add_transformer(PeriodicSamplesMapper(
+            start, STEP, end, window_ms=w,
+            function=RangeFunctionId.SUM_OVER_TIME))
+        res = leaf.execute(ExecContext(hm_store))
+        (b,) = res.batches
+        assert b.hist is not None
+        steps = np.asarray(b.steps.timestamps())
+        for i, tags in enumerate(b.keys):
+            ts, rows, mx = raw[tags["instance"]]
+            for j, t in enumerate(steps):
+                m = (ts > t - w) & (ts <= t)
+                np.testing.assert_allclose(np.asarray(b.hist)[i, j],
+                                           rows[m].sum(axis=0), rtol=1e-6)
+                # values plane = max_over_time of the max column
+                assert np.asarray(b.values)[i, j] == mx[m].max()
+
+    def test_instant_selector_pairs_last_hist_and_last_max(self, hm_store):
+        raw = self._raw(hm_store)
+        start = end = START_TS + 590_000
+        leaf = MultiSchemaPartitionsExec("hm", 0, [eq("_metric_", "lat_hmax")],
+                                         start - 300_000, end)
+        leaf.add_transformer(PeriodicSamplesMapper(start, STEP, end))
+        res = leaf.execute(ExecContext(hm_store))
+        (b,) = res.batches
+        for i, tags in enumerate(b.keys):
+            ts, rows, mx = raw[tags["instance"]]
+            sel = ts <= start
+            np.testing.assert_allclose(np.asarray(b.hist)[i, 0],
+                                       rows[sel][-1], rtol=1e-6)
+            assert np.asarray(b.values)[i, 0] == mx[sel][-1]
+
+    def test_histogram_max_quantile_end_to_end(self, hm_store):
+        from filodb_tpu.ops import histogram_ops
+        import jax.numpy as jnp
+        start, end, w = START_TS + 300_000, START_TS + 590_000, 300_000
+        leaf = MultiSchemaPartitionsExec("hm", 0, [eq("_metric_", "lat_hmax")],
+                                         start - w, end)
+        leaf.add_transformer(PeriodicSamplesMapper(
+            start, STEP, end, window_ms=w,
+            function=RangeFunctionId.SUM_OVER_TIME))
+        leaf.add_transformer(InstantVectorFunctionMapper(
+            InstantFunctionId.HISTOGRAM_MAX_QUANTILE, (0.9,)))
+        res = leaf.execute(ExecContext(hm_store))
+        (b,) = res.batches
+        got = np.asarray(b.values)
+        assert np.isfinite(got).all()
+        # oracle: hist_max_quantile over the paired planes
+        leaf2 = MultiSchemaPartitionsExec("hm", 0,
+                                          [eq("_metric_", "lat_hmax")],
+                                          start - w, end)
+        leaf2.add_transformer(PeriodicSamplesMapper(
+            start, STEP, end, window_ms=w,
+            function=RangeFunctionId.SUM_OVER_TIME))
+        (b2,) = leaf2.execute(ExecContext(hm_store)).batches
+        want = np.asarray(histogram_ops.hist_max_quantile(
+            jnp.asarray(b2.bucket_tops), jnp.asarray(b2.hist),
+            jnp.asarray(b2.values), 0.9))
+        np.testing.assert_allclose(got, want, rtol=1e-6)
