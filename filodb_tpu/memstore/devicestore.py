@@ -37,7 +37,8 @@ from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
 
-from filodb_tpu.ops.grid import (DENSE_ONLY_OPS, GridQuery, max_k_for,
+from filodb_tpu.ops.grid import (DENSE_ONLY_OPS, PHASE_OPS, TS_FREE_OPS,
+                                 GridQuery, max_k_for, phase_eligible,
                                  supports_grid)
 from filodb_tpu.query.logical import RangeFunctionId as F
 
@@ -142,27 +143,30 @@ def _fused_progs():
 
     from filodb_tpu.ops.grid import rate_grid_auto
 
-    def _sliced(ts_parts, val_parts, row0, nrows):
-        ts_all = ts_parts[0] if len(ts_parts) == 1 \
-            else jnp.concatenate(list(ts_parts), axis=0)
-        val_all = val_parts[0] if len(val_parts) == 1 \
-            else jnp.concatenate(list(val_parts), axis=0)
-        return (lax.dynamic_slice_in_dim(ts_all, row0, nrows, axis=0),
-                lax.dynamic_slice_in_dim(val_all, row0, nrows, axis=0))
+    def _sliced(parts, row0, nrows):
+        if not parts:
+            return None    # phase mode: no ts plane in the program
+        all_ = parts[0] if len(parts) == 1 \
+            else jnp.concatenate(list(parts), axis=0)
+        return lax.dynamic_slice_in_dim(all_, row0, nrows, axis=0)
 
     @functools.partial(jax.jit,
                        static_argnames=("q", "lanes", "nrows"))
-    def series_prog(ts_parts, val_parts, row0, steps0, *, q, lanes, nrows):
-        ts_sl, val_sl = _sliced(ts_parts, val_parts, row0, nrows)
-        return rate_grid_auto(ts_sl, val_sl, steps0, q, lanes)
+    def series_prog(ts_parts, val_parts, row0, steps0, phase=None, *,
+                    q, lanes, nrows):
+        ts_sl = _sliced(ts_parts, row0, nrows)
+        val_sl = _sliced(val_parts, row0, nrows)
+        return rate_grid_auto(ts_sl, val_sl, steps0, q, lanes, phase=phase)
 
     @functools.partial(jax.jit,
                        static_argnames=("q", "lanes", "nrows",
                                         "num_groups", "op"))
-    def grouped_prog(ts_parts, val_parts, row0, steps0, garr, *, q, lanes,
-                     nrows, num_groups, op):
-        ts_sl, val_sl = _sliced(ts_parts, val_parts, row0, nrows)
-        stepped = rate_grid_auto(ts_sl, val_sl, steps0, q, lanes)
+    def grouped_prog(ts_parts, val_parts, row0, steps0, garr, phase=None,
+                     *, q, lanes, nrows, num_groups, op):
+        ts_sl = _sliced(ts_parts, row0, nrows)
+        val_sl = _sliced(val_parts, row0, nrows)
+        stepped = rate_grid_auto(ts_sl, val_sl, steps0, q, lanes,
+                                 phase=phase)
         return _grouped_reduce_impl(stepped, garr, num_groups, op)
 
     _FUSED_PROGS["series"] = series_prog
@@ -173,7 +177,8 @@ def _fused_progs():
 class _GridPlan(NamedTuple):
     """Everything needed to dispatch one fused serving program."""
 
-    ts_parts: tuple       # device arrays, one per covered block
+    ts_parts: tuple       # device arrays, one per covered block; () when
+                          # the program needs no ts plane (phase mode)
     val_parts: tuple
     row0: int             # first slice row in the concatenated blocks
     steps0_rel: int       # first window end, epoch-relative ms
@@ -182,6 +187,7 @@ class _GridPlan(NamedTuple):
     nrows: int
     ncols: int
     lane_idx: np.ndarray  # requested pid -> lane slot, in request order
+    phase: object = None  # [ncols] int32 device array (uniform-phase mode)
 
 
 def _ids_fingerprint(part_ids) -> int:
@@ -207,18 +213,27 @@ class _Block:
     GridQuery.dense) without touching device data: a lane is
     *contiguous* iff fcnt == fmax - fmin + 1, dense over local rows
     [a, b] iff contiguous and fmin <= a <= b <= fmax, and empty over
-    [a, b] iff fcnt == 0 or fmax < a or fmin > b."""
+    [a, b] iff fcnt == 0 or fmax < a or fmin > b.
+
+    ``pmin/pmax`` (host numpy, per lane) record the within-bucket scrape
+    offset range (``ts - bucket_start``, in (0, gstep]) of the lane's
+    filled cells: a lane with ``pmin == pmax`` in every covered block is
+    UNIFORM-PHASE and rate/increase/delta queries reconstruct its
+    timestamps from one phase scalar — the ts plane is never streamed
+    (ops/grid.py PHASE_OPS)."""
 
     __slots__ = ("ts", "vals", "lanes", "nbytes", "last_used",
-                 "fmin", "fmax", "fcnt")
+                 "fmin", "fmax", "fcnt", "pmin", "pmax")
 
-    def __init__(self, ts, vals, lanes: int, seq: int, fill_stats):
+    def __init__(self, ts, vals, lanes: int, seq: int, fill_stats,
+                 phase_stats):
         self.ts = ts
         self.vals = vals
         self.lanes = lanes
         self.nbytes = int(ts.size * 4 + vals.size * 4)
         self.last_used = seq
         self.fmin, self.fmax, self.fcnt = fill_stats
+        self.pmin, self.pmax = phase_stats
 
     def dense_or_empty(self, a: int, b: int):
         """Per-lane (dense, empty) bool masks: lane is provably dense
@@ -261,6 +276,10 @@ class DeviceGridCache:
         # changes, so a refreshing dashboard doesn't re-pay speculative
         # block staging every cycle
         self._bigk_deny: dict[tuple, tuple] = {}
+        # (bi_lo, bi_hi, version) -> (host phases, device phases): the
+        # uniform-phase vector for the frozen block range (see
+        # _phase_device); stale keys never match, single-entry by design
+        self._phase_memo: dict[tuple, tuple] = {}
         self._seq = 0
         self._lock = threading.Lock()
         # stats
@@ -396,8 +415,8 @@ class DeviceGridCache:
                 garr[cols] = gid_arr[:, None] * stride + np.arange(stride)
         out = _fused_progs()["grouped"](
             plan.ts_parts, plan.val_parts, plan.row0, plan.steps0_rel,
-            garr, q=plan.q, lanes=plan.lane_mult, nrows=plan.nrows,
-            num_groups=num_groups * stride, op=op)
+            garr, plan.phase, q=plan.q, lanes=plan.lane_mult,
+            nrows=plan.nrows, num_groups=num_groups * stride, op=op)
         if self.hist:
             both = np.asarray(out, dtype=np.float64)    # [2, G*hb, T]
             G, hb, T = num_groups, stride, both.shape[-1]
@@ -422,7 +441,7 @@ class DeviceGridCache:
             return None
         stepped = _fused_progs()["series"](
             plan.ts_parts, plan.val_parts, plan.row0, plan.steps0_rel,
-            q=plan.q, lanes=plan.lane_mult, nrows=plan.nrows)
+            plan.phase, q=plan.q, lanes=plan.lane_mult, nrows=plan.nrows)
         out_np = np.asarray(stepped)
         lanes_req = plan.lane_idx
         if self.hist:
@@ -595,6 +614,19 @@ class DeviceGridCache:
         if self.hist:
             req = (req[:, None] * self.hb
                    + np.arange(self.hb)[None, :]).ravel()
+        op = _GRID_OPS[func]
+        # phase proof piggybacks on the dense walk: every requested lane
+        # must be uniform-phase within each covered block AND carry the
+        # SAME phase across blocks.  Tail blocks are excluded (their
+        # contents change per ingest epoch; the memoized device phase
+        # vector below would churn) — queries touching the tail keep the
+        # ts-streaming kernels.  Final eligibility is grid.phase_eligible
+        # on the built query (adds dense + K>=2); this is the cheap
+        # pre-filter for the proof walk.
+        want_phase = op in PHASE_OPS and K >= 2 and \
+            bi_hi * BLOCK_BUCKETS + BLOCK_BUCKETS - 1 <= frozen_hi
+        ph_req = np.full(len(req), -1, np.int64)
+        ph_ok = want_phase
         all_dense = np.ones(len(req), bool)
         all_empty = np.ones(len(req), bool)
         for off, blk in zip(range(bi_lo, bi_hi + 1), segments):
@@ -603,9 +635,18 @@ class DeviceGridCache:
             d, e = blk.dense_or_empty(a, b)
             all_dense &= d[req]
             all_empty &= e[req]
+            if ph_ok:
+                nonempty = ~e[req]
+                uniform = blk.pmin[req] == blk.pmax[req]
+                bph = blk.pmin[req].astype(np.int64)
+                conflict = nonempty & (ph_req >= 0) & (ph_req != bph)
+                if (nonempty & ~uniform).any() or conflict.any():
+                    ph_ok = False
+                else:
+                    ph_req = np.where(nonempty & (ph_req < 0), bph, ph_req)
         dense = bool((all_dense | all_empty).all())
-        if (_GRID_OPS[func] in DENSE_ONLY_OPS and not dense) \
-                or K > max_k_for(_GRID_OPS[func], dense):
+        if (op in DENSE_ONLY_OPS and not dense) \
+                or K > max_k_for(op, dense):
             # adjacency ops need every row present; large windows need
             # the proven-dense K-free path.  Either way, memoize the
             # denial so a refreshing dashboard doesn't re-stage blocks
@@ -623,19 +664,52 @@ class DeviceGridCache:
         if dense:
             self.dense_hits += 1
         q = GridQuery(nsteps=nsteps, kbuckets=K, gstep_ms=g,
-                      is_rate=(func == F.RATE), op=_GRID_OPS[func],
+                      is_rate=(func == F.RATE), op=op,
                       dense=dense, stride=stride_r,
                       farg=float(fargs[0]) if fargs else 0.0,
                       farg2=float(fargs[1]) if len(fargs) > 1 else 0.0)
+        phase_dev = None
+        if ph_ok and phase_eligible(q):
+            phase_dev = self._phase_device(ph_req, req, ncols,
+                                           (bi_lo, bi_hi, self.version))
         # tall strided slices read more input rows per tile: keep the
         # VMEM footprint bounded by narrowing the lane tile
         lane_mult = 1024 if (ncols % 1024 == 0 and nrows <= 256) \
             else _LANE_PAD
         self.hits += 1
-        return _GridPlan(tuple(b.ts for b in segments),
+        # phase mode and ts-free ops need no ts plane in the program
+        ts_parts = () if (phase_dev is not None or op in TS_FREE_OPS) \
+            else tuple(b.ts for b in segments)
+        return _GridPlan(ts_parts,
                          tuple(b.vals for b in segments), row0,
                          steps0 - self.epoch0, q, lane_mult, nrows, ncols,
-                         prep["lane_idx"])
+                         prep["lane_idx"], phase_dev)
+
+    def _phase_device(self, ph_req, req, ncols: int, key) -> object:
+        """Device [ncols] phase vector for the uniform-phase kernels,
+        memoized per (block range, cache version) — re-uploading ~4 B/
+        lane per query would cost more than it saves on a tunnel link.
+        Unrequested lanes get phase 1; their outputs are sliced away or
+        segment-dropped downstream, so any value is safe."""
+        import jax
+        phases = np.where(ph_req > 0, ph_req, 1).astype(np.int32)
+        memo = self._phase_memo.get(key)
+        if memo is not None and memo[0].shape[0] == ncols:
+            host, dev = memo
+            if np.array_equal(host[req], phases):
+                return dev
+            # different id-lists over the same blocks accumulate into
+            # one merged vector so alternating dashboards don't ping-
+            # pong uploads
+            ph_cols = host.copy()
+            ph_cols[req] = phases
+        else:
+            ph_cols = np.ones(ncols, np.int32)
+            ph_cols[req] = phases
+        dev = jax.device_put(ph_cols)
+        self._phase_memo.clear()
+        self._phase_memo[key] = (ph_cols, dev)
+        return dev
 
     # ---------------------------------------------------------------- blocks
 
@@ -767,8 +841,16 @@ class DeviceGridCache:
         fmin = fin.argmax(axis=0).astype(np.int32)
         fmax = (BLOCK_BUCKETS - 1 - fin[::-1].argmax(axis=0)).astype(np.int32)
         fmax[fcnt == 0] = -1
+        # per-lane within-bucket offset range over the filled cells:
+        # cell (local row r, lane) holds ts_rel in ((c-1)*g, c*g] for
+        # global bucket c = bi*BB + r, so phase = ts_rel - (c-1)*g
+        cstart = ((np.arange(BLOCK_BUCKETS, dtype=np.int64)
+                   + bi * BLOCK_BUCKETS - 1) * g)[:, None]
+        ph = ts_stage.astype(np.int64) - cstart
+        pmin = np.where(fin, ph, 2**31).min(axis=0).astype(np.int32)
+        pmax = np.where(fin, ph, -1).max(axis=0).astype(np.int32)
         return _Block(jax.device_put(ts_stage), jax.device_put(val_stage),
-                      lanes, self._seq, (fmin, fmax, fcnt))
+                      lanes, self._seq, (fmin, fmax, fcnt), (pmin, pmax))
 
     def _reclaim(self, target_bytes: int, keep: set) -> int:
         """Oldest-first reclaim down to ``target_bytes`` (the reference's

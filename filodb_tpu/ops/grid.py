@@ -183,12 +183,122 @@ def _correct_dense(vals, roll):
     return _apply_reset_correction(vals, roll(vals, 1), row, roll)
 
 
+# above this row count the [B, B] triangular matmul's O(B^2) work and
+# VMEM footprint overtake the O(B log B) roll-scan it replaces
+_MXU_CORR_MAX_ROWS = 256
+
+
+def _correct_dense_mxu(vals):
+    """Dense counter correction with the prefix sum on the MXU: the
+    cumulative drop is a lower-triangular ones-matmul over the per-row
+    drops, replacing the log2(B) VPU roll-scan (measured +13% on the
+    headline kernel; the [B, B] triangle is generated in-register).
+    Row 0 has no previous sample — its (bogus, rolled-from-last-row)
+    drop is excluded by zeroing the triangle's first column instead of
+    masking the drop tile, saving an iota+where pass."""
+    nb = vals.shape[0]
+    prev = pltpu.roll(vals, 1, axis=0)
+    drop = jnp.where(vals < prev, prev, 0.0)   # never NaN: prev or 0.0
+    r1 = jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 0)
+    r2 = jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 1)
+    tri = ((r2 <= r1) & (r2 > 0)).astype(jnp.float32)
+    acc = jax.lax.dot(tri, drop, precision=jax.lax.Precision.HIGHEST,
+                      preferred_element_type=jnp.float32)
+    return vals + acc
+
+
+def _correct_dense_auto(vals, roll):
+    """MXU prefix for short blocks; the roll-scan for tall ones (the
+    K-free dense path admits up to MAX_GRID_ROWS=1024 rows, where the
+    [B, B] matmul would do ~100x the arithmetic)."""
+    if vals.shape[0] <= _MXU_CORR_MAX_ROWS:
+        return _correct_dense_mxu(vals)
+    return _correct_dense(vals, roll)
+
+
+# ops with a dense+uniform-phase kernel: the ts plane is never streamed;
+# per-lane scrape phase (one row) reconstructs the extrapolation geometry
+PHASE_OPS = frozenset(("rate", "increase", "delta"))
+
+
+def _phase_block(phase_row, vals, q: GridQuery, roll, mxu: bool):
+    """rate/increase/delta under dense + UNIFORM-PHASE: every live lane
+    is scraped at a constant offset ``phase in (0, gstep]`` within its
+    bucket, so ``t1 - window_start == phase`` and ``window_end - t2 ==
+    gstep - phase`` are per-lane constants and ``sampled == (K-1)*gstep``
+    exactly.  The reference extrapolation (RateFunctions.scala:37-80)
+    then collapses:
+
+    - ``avg_dur == gstep`` and both boundary gaps are < 1.1*gstep, so
+      the threshold selects are always-true and vanish;
+    - the counter zero-point clamp's divide cancels against the final
+      ``delta *`` multiply: ``delta * (sampled*v1/delta * scale) ==
+      sampled*v1*scale`` — the kernel is divide-free;
+    - ``delta > 0`` is implied by ``v1 >= 0 & sampled*v1 < phase*delta``
+      (phase > 0), dropping a compare.
+
+    Liveness is row-0-derived (dense), so masks and the grouped count
+    are [1, ns] rows, not [T, ns] tiles."""
+    out, live_row = _phase_block_raw(phase_row, vals, q, roll, mxu)
+    return jnp.where(live_row, out, jnp.nan)
+
+
+def _phase_block_raw(phase_row, vals, q: GridQuery, roll, mxu: bool):
+    """Unmasked phase-mode compute: returns ``(out [T, ns], live_row
+    [1, ns])`` so grouped callers can mask-to-zero without a second
+    [T, ns] pass.  ``out`` is finite wherever ``live_row`` holds (dense:
+    K >= 2 samples, strictly increasing ts => sampled > 0)."""
+    ns = vals.shape[1]
+    dt = vals.dtype
+    sl = _win_slicer(q, ns)
+    K, g = q.kbuckets, q.gstep_ms
+    live_row = jnp.isfinite(vals[0:1, :])
+    if q.op == "delta":
+        vcorr = vals
+    else:
+        vcorr = _correct_dense_auto(vals, roll) if mxu \
+            else _correct_dense(vals, roll)
+    v1, v2 = sl(vcorr, 0), sl(vcorr, K - 1)
+    delta = v2 - v1
+    sampled = jnp.asarray((K - 1) * g * 1e-3, dt)
+    if q.op == "delta":
+        # no zero-clamp for gauges: extrap == sampled + gstep == K*gstep
+        return delta * jnp.asarray(K / (K - 1), dt), live_row
+    phase_s = phase_row.astype(dt) * jnp.asarray(1e-3, dt)       # [1, ns]
+    g_s = jnp.asarray(g * 1e-3, dt)
+    is_rate = q.op == "rate" and q.is_rate
+    scale = jnp.asarray(1e3 / (K * g), dt) / sampled if is_rate \
+        else jnp.asarray(1.0, dt) / sampled
+    end_sc = (sampled + g_s - phase_s) * scale                   # [1, ns]
+    sv1 = sampled * v1
+    pd = phase_s * delta
+    clamp = (sv1 < pd) & (v1 >= 0)
+    start_num = jnp.where(clamp, sv1, pd)      # == delta * start_dur
+    return delta * end_sc + start_num * scale, live_row
+
+
+def phase_eligible(q: GridQuery) -> bool:
+    """Can this query use the uniform-phase kernels (given a proven
+    phase vector)?  K >= 2: the collapsed extrapolation divides by
+    (K-1); the ts path's nf>=2 guard yields NaN for K=1, so routing
+    K=1 there keeps semantics.  The device store must use THIS
+    predicate when deciding to drop the ts plane from a plan — the
+    kernel wrappers fall back to ts mode under the same condition.
+    stride > 1 runs the stride-1 fine query inside the wrappers, so
+    eligibility doesn't depend on it."""
+    return q.dense and q.op in PHASE_OPS and q.kbuckets >= 2
+
+
+def _phase_mode(q: GridQuery, phase) -> bool:
+    return phase is not None and phase_eligible(q)
+
+
 def _window_stats_dense(ts, vals, vcorr, q: GridQuery):
     """Window stats under the dense-lane contract: window ``t`` covers
     rows ``[t, t+K-1]`` and a live lane has a sample in every row, so
     first/last are static slices and the finite count is ``K`` exactly
     (0 for empty lanes)."""
-    ns = ts.shape[1]
+    ns = vals.shape[1]
     dt = vcorr.dtype
     sl = _win_slicer(q, ns)
     live = jnp.isfinite(sl(vals, 0))
@@ -201,7 +311,7 @@ def _window_stats(ts, fin, vcorr, q: GridQuery):
     """First/last finite sample (ts and corrected value) + finite count
     per window, via K forward/backward select passes over static
     sublane slices: window t covers rows [t*stride, t*stride+K-1]."""
-    ns = ts.shape[1]
+    ns = vcorr.shape[1]
     T = q.nsteps
     dt = vcorr.dtype
     sl = _win_slicer(q, ns)
@@ -264,7 +374,7 @@ def _instant_pair_block(ts, vals, q: GridQuery):
     so no prefix scan is needed."""
     if not q.dense:
         raise ValueError(f"grid op {q.op} requires the dense contract")
-    ns = ts.shape[1]
+    ns = vals.shape[1]
     dt = vals.dtype
     K = q.kbuckets
     sl = _win_slicer(q, ns)
@@ -289,7 +399,7 @@ def _agg_block_dense(ts, vals, q: GridQuery):
     have a sample in every row, so the per-slice finite masks vanish —
     NaN in empty lanes propagates through the accumulation and the
     single ``live`` mask finishes the job."""
-    ns = ts.shape[1]
+    ns = vals.shape[1]
     dt = vals.dtype
     sl = _win_slicer(q, ns)
     if q.op == "last":
@@ -329,7 +439,7 @@ def _agg_block(ts, vals, q: GridQuery):
         return _agg_block_dense(ts, vals, q)
     if q.op in DENSE_ONLY_OPS:
         raise ValueError(f"grid op {q.op} requires the dense contract")
-    ns = ts.shape[1]
+    ns = vals.shape[1]
     T = q.nsteps
     dt = vals.dtype
     fin = jnp.isfinite(vals)
@@ -376,7 +486,7 @@ def _linreg_block(ts, vals, steps0, q: GridQuery):
     the range end).  x is seconds relative to the window end, recentered
     by +W/2 during accumulation so the f32 var/cov differences don't
     cancel catastrophically (the slope is shift-invariant)."""
-    ns = ts.shape[1]
+    ns = vals.shape[1]
     dt = vals.dtype
     K = q.kbuckets
     sl = _win_slicer(q, ns)
@@ -443,7 +553,7 @@ def _masked_moments(vals, fin, sl, K, dt):
 def _zscore_block(ts, vals, q: GridQuery):
     """(last - mean) / stddev over the window (reference ZScoreChunked /
     windows.z_score, incl. the sd == 0 / n < 2 -> NaN rules)."""
-    ns = ts.shape[1]
+    ns = vals.shape[1]
     dt = vals.dtype
     K = q.kbuckets
     sl = _win_slicer(q, ns)
@@ -514,7 +624,7 @@ def _sort_ops_block(ts, vals, q: GridQuery):
     QuantileOverTimeChunkedFunction / MedianAbsoluteDeviationOverTime)."""
     if not q.dense:
         raise ValueError(f"grid op {q.op} requires the dense contract")
-    ns = ts.shape[1]
+    ns = vals.shape[1]
     K = q.kbuckets
     sl = _win_slicer(q, ns)
     tiles = [sl(vals, d) for d in range(K)]
@@ -539,7 +649,7 @@ def _holt_winters_block(ts, vals, q: GridQuery):
     every sample present)."""
     if not q.dense:
         raise ValueError(f"grid op {q.op} requires the dense contract")
-    ns = ts.shape[1]
+    ns = vals.shape[1]
     dt = vals.dtype
     K = q.kbuckets
     sl = _win_slicer(q, ns)
@@ -564,7 +674,7 @@ def _timestamp_block(ts, vals, steps0, q: GridQuery):
     magnitudes stay within the window span, exact in f32 (epoch-relative
     ms near the int32 limit would lose ~0.13 s to f32 rounding).  The
     serving path re-bases to absolute seconds in f64 on the host."""
-    ns = ts.shape[1]
+    ns = vals.shape[1]
     dt = vals.dtype
     sl = _win_slicer(q, ns)
     fin = jnp.isfinite(vals)
@@ -606,7 +716,9 @@ def _rate_block(ts, vals, steps0, q: GridQuery):
         return _agg_block(ts, vals, q)
     roll = lambda x, s: pltpu.roll(x, s, axis=0)
     if q.dense:
-        vcorr = _correct_dense(vals, roll)
+        # _rate_block only runs inside Pallas TPU kernels (the portable
+        # dispatch lives in rate_grid_ref), so the MXU prefix is safe
+        vcorr = _correct_dense_auto(vals, roll)
         stats = _window_stats_dense(ts, vals, vcorr, q)
     else:
         fin, vcorr = _correct_and_mask(ts, vals, roll)
@@ -614,8 +726,28 @@ def _rate_block(ts, vals, steps0, q: GridQuery):
     return _extrapolate(*stats, steps0, q)
 
 
+# ops whose kernels never read the ts plane (window membership is the
+# bucket index; the math uses values only): for these the Pallas wrappers
+# do not stream ts at all — half the HBM traffic of a two-plane op
+TS_FREE_OPS = frozenset(("quantile", "mad", "holt_winters", "zscore",
+                         "last", "sum", "count", "avg", "min", "max",
+                         "changes", "resets", "stddev", "stdvar"))
+
+
 def _series_kernel(s0_ref, ts_ref, vals_ref, out_ref, *, q: GridQuery):
     out_ref[:] = _rate_block(ts_ref[:], vals_ref[:], s0_ref[0], q)
+
+
+def _series_kernel_free(s0_ref, vals_ref, out_ref, *, q: GridQuery):
+    out_ref[:] = _rate_block(None, vals_ref[:], s0_ref[0], q)
+
+
+def _series_kernel_phase(s0_ref, ph_ref, vals_ref, out_ref, *,
+                         q: GridQuery):
+    roll = lambda x, s: pltpu.roll(x, s, axis=0)
+    out, live_row = _phase_block_raw(ph_ref[0:1, :], vals_ref[:], q, roll,
+                                     mxu=True)
+    out_ref[:] = jnp.where(live_row, out, jnp.nan)
 
 
 def _grouped_kernel(s0_ref, ts_ref, vals_ref, sum_ref, cnt_ref, *,
@@ -627,20 +759,68 @@ def _grouped_kernel(s0_ref, ts_ref, vals_ref, sum_ref, cnt_ref, *,
     cnt_ref[gi, :] = jnp.sum(ok.astype(jnp.float32), axis=1)
 
 
+def _grouped_kernel_free(s0_ref, vals_ref, sum_ref, cnt_ref, *,
+                         q: GridQuery):
+    gi = pl.program_id(1)
+    r = _rate_block(None, vals_ref[:], s0_ref[0], q)
+    ok = jnp.isfinite(r)
+    sum_ref[gi, :] = jnp.sum(jnp.where(ok, r, 0.0), axis=1)
+    cnt_ref[gi, :] = jnp.sum(ok.astype(jnp.float32), axis=1)
+
+
+def _grouped_kernel_phase(s0_ref, ph_ref, vals_ref, sum_ref, cnt_ref, *,
+                          q: GridQuery):
+    """Grouped phase kernel: liveness is the [1, ns] row (dense), so the
+    per-window finite count is nlive — a constant row — and the sum mask
+    is a broadcast, not a [T, ns] isfinite pass."""
+    gi = pl.program_id(1)
+    roll = lambda x, s: pltpu.roll(x, s, axis=0)
+    out, live_row = _phase_block_raw(ph_ref[0:1, :], vals_ref[:], q, roll,
+                                     mxu=True)
+    sum_ref[gi, :] = jnp.sum(jnp.where(live_row, out, 0.0), axis=1)
+    nlive = jnp.sum(live_row.astype(jnp.float32))
+    cnt_ref[gi, :] = jnp.full((q.nsteps,), nlive, jnp.float32)
+
+
 def _smem():
     return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
+def _mode_for(q: GridQuery, phase) -> str:
+    """Input-plane mode: 'free' ops stream only values; 'phase' streams
+    values + one phase row; 'ts' streams both planes."""
+    if q.op in TS_FREE_OPS:
+        return "free"
+    if _phase_mode(q, phase):
+        return "phase"
+    return "ts"
+
+
+def _phase8(phase):
+    """Phase as an [8, S] tile: Mosaic DMAs sublane-multiples; 8 rows of
+    int32 per 1024-lane block is 32 KB — noise next to the vals plane."""
+    ph = jnp.asarray(phase, jnp.int32)
+    if ph.ndim == 1:
+        ph = ph[None, :]
+    return jnp.broadcast_to(ph[0:1, :], (8, ph.shape[-1]))
+
+
 @functools.partial(jax.jit, static_argnames=("q", "lanes", "interpret"))
 def rate_grid(ts, vals, steps0, q: GridQuery, lanes: int = 1024,
-              interpret: bool = False):
-    """Per-series rate/increase over an aligned grid: [B, S] -> [T, S].
+              interpret: bool = False, phase=None):
+    """Per-series windowed function over an aligned grid: [B, S] -> [T, S].
 
     ``steps0`` is a traced scalar (int32): differing query starts reuse
     one compiled kernel.  Row 0 must be the first bucket of the first
     window (see module docstring).
+
+    ``phase`` ([S] int32, per-lane within-bucket scrape offset in
+    (0, gstep]) activates the uniform-phase kernels for PHASE_OPS under
+    the dense contract: the ts plane is not streamed at all.  For
+    TS_FREE_OPS the ts plane is never streamed; ``ts`` may be None in
+    both cases.
     """
-    nb, ns = ts.shape
+    nb, ns = vals.shape
     if ns % lanes != 0 or ns == 0:
         raise ValueError(f"series count {ns} must be a non-zero multiple of "
                          f"lanes={lanes} (pad with NaN columns)")
@@ -651,22 +831,30 @@ def rate_grid(ts, vals, steps0, q: GridQuery, lanes: int = 1024,
         # Mosaic cannot lower strided sublane slices: run the stride-1
         # fine grid and subsample the output at the XLA level (the
         # extra windows cost VPU time but stay on the fast path)
-        fine = rate_grid(ts, vals, steps0, _fine_query(q), lanes, interpret)
+        fine = rate_grid(ts, vals, steps0, _fine_query(q), lanes, interpret,
+                         phase)
         return fine[::q.stride]
-    kern = functools.partial(_series_kernel, q=q)
+    mode = _mode_for(q, phase)
+    vspec = pl.BlockSpec((nb, lanes), lambda i: (0, i),
+                         memory_space=pltpu.VMEM)
+    if mode == "free":
+        kern, extra, especs = _series_kernel_free, (), ()
+    elif mode == "phase":
+        kern = _series_kernel_phase
+        extra = (_phase8(phase),)
+        especs = (pl.BlockSpec((8, lanes), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),)
+    else:
+        kern, extra, especs = _series_kernel, (ts,), (vspec,)
     return pl.pallas_call(
-        kern,
+        functools.partial(kern, q=q),
         interpret=interpret,
         out_shape=jax.ShapeDtypeStruct((q.nsteps, ns), jnp.float32),
         grid=(ns // lanes,),
-        in_specs=[_smem(),
-                  pl.BlockSpec((nb, lanes), lambda i: (0, i),
-                               memory_space=pltpu.VMEM),
-                  pl.BlockSpec((nb, lanes), lambda i: (0, i),
-                               memory_space=pltpu.VMEM)],
+        in_specs=[_smem(), *especs, vspec],
         out_specs=pl.BlockSpec((q.nsteps, lanes), lambda i: (0, i),
                                memory_space=pltpu.VMEM),
-    )(jnp.asarray([steps0], jnp.int32), ts, vals)
+    )(jnp.asarray([steps0], jnp.int32), *extra, vals)
 
 
 _GPS = 8  # groups per output block (output sublane granularity)
@@ -674,14 +862,16 @@ _GPS = 8  # groups per output block (output sublane granularity)
 
 @functools.partial(jax.jit, static_argnames=("q", "group_lanes", "interpret"))
 def rate_grid_grouped(ts, vals, steps0, q: GridQuery,
-                      group_lanes: int = 1024, interpret: bool = False):
+                      group_lanes: int = 1024, interpret: bool = False,
+                      phase=None):
     """Fused ``sum by (group)(rate(...))``: [B, S] -> (sum, count) [G, T].
 
     Series are pre-sorted by group and padded so group g occupies
     columns [g*group_lanes, (g+1)*group_lanes); G must be a multiple
-    of 8 (host pads; padded groups come back with count 0).
+    of 8 (host pads; padded groups come back with count 0).  ``phase``
+    as in :func:`rate_grid`.
     """
-    nb, ns = ts.shape
+    nb, ns = vals.shape
     ngroups = ns // group_lanes
     if ns % group_lanes != 0 or ngroups == 0 or ngroups % _GPS != 0:
         raise ValueError(
@@ -694,27 +884,34 @@ def rate_grid_grouped(ts, vals, steps0, q: GridQuery,
                          f"{_rows_needed(q)}")
     if q.stride > 1:
         s, c = rate_grid_grouped(ts, vals, steps0, _fine_query(q),
-                                 group_lanes, interpret)
+                                 group_lanes, interpret, phase)
         return s[:, ::q.stride], c[:, ::q.stride]
-    kern = functools.partial(_grouped_kernel, q=q)
+    mode = _mode_for(q, phase)
+    vspec = pl.BlockSpec((nb, group_lanes),
+                         lambda i, gi: (0, i * _GPS + gi),
+                         memory_space=pltpu.VMEM)
+    if mode == "free":
+        kern, extra, especs = _grouped_kernel_free, (), ()
+    elif mode == "phase":
+        kern = _grouped_kernel_phase
+        extra = (_phase8(phase),)
+        especs = (pl.BlockSpec((8, group_lanes),
+                               lambda i, gi: (0, i * _GPS + gi),
+                               memory_space=pltpu.VMEM),)
+    else:
+        kern, extra, especs = _grouped_kernel, (ts,), (vspec,)
     s, c = pl.pallas_call(
-        kern,
+        functools.partial(kern, q=q),
         interpret=interpret,
         out_shape=(jax.ShapeDtypeStruct((ngroups, q.nsteps), jnp.float32),
                    jax.ShapeDtypeStruct((ngroups, q.nsteps), jnp.float32)),
         grid=(ngroups // _GPS, _GPS),
-        in_specs=[_smem(),
-                  pl.BlockSpec((nb, group_lanes),
-                               lambda i, gi: (0, i * _GPS + gi),
-                               memory_space=pltpu.VMEM),
-                  pl.BlockSpec((nb, group_lanes),
-                               lambda i, gi: (0, i * _GPS + gi),
-                               memory_space=pltpu.VMEM)],
+        in_specs=[_smem(), *especs, vspec],
         out_specs=(pl.BlockSpec((_GPS, q.nsteps), lambda i, gi: (i, 0),
                                 memory_space=pltpu.VMEM),
                    pl.BlockSpec((_GPS, q.nsteps), lambda i, gi: (i, 0),
                                 memory_space=pltpu.VMEM)),
-    )(jnp.asarray([steps0], jnp.int32), ts, vals)
+    )(jnp.asarray([steps0], jnp.int32), *extra, vals)
     return s, c
 
 
@@ -722,10 +919,18 @@ def rate_grid_grouped(ts, vals, steps0, q: GridQuery,
 # Pure-XLA reference implementation (CPU fallback + test oracle)
 # ---------------------------------------------------------------------------
 
-def rate_grid_ref(ts, vals, steps0: int, q: GridQuery):
-    """Same semantics as :func:`rate_grid`, in portable jnp."""
+def rate_grid_ref(ts, vals, steps0: int, q: GridQuery, phase=None):
+    """Same semantics as :func:`rate_grid`, in portable jnp.  ``phase``
+    activates the collapsed uniform-phase formulation (used as the CPU
+    serving path and as the oracle for the phase kernels); ``ts`` may
+    then be None."""
     def roll(x, s):
         return jnp.concatenate([x[-s:], x[:-s]], axis=0)
+    if _phase_mode(q, phase):
+        ph = jnp.asarray(phase, jnp.int32)
+        if ph.ndim == 1:
+            ph = ph[None, :]
+        return _phase_block(ph[0:1, :], vals, q, roll, mxu=False)
     if q.op in ("irate", "idelta"):
         return _instant_pair_block(ts, vals, q)
     if q.op in ("quantile", "mad"):
@@ -755,13 +960,14 @@ def rate_grid_ref(ts, vals, steps0: int, q: GridQuery):
     return _extrapolate(*stats, jnp.int32(steps0), q)
 
 
-def rate_grid_auto(ts, vals, steps0, q: GridQuery, lanes: int = 1024):
+def rate_grid_auto(ts, vals, steps0, q: GridQuery, lanes: int = 1024,
+                   phase=None):
     """Pallas on TPU backends, portable reference elsewhere.  ``steps0``
     may be a traced scalar (this runs under the serving path's fused
     jit program)."""
-    if on_tpu_backend() and ts.shape[1] % lanes == 0:
-        return rate_grid(ts, vals, steps0, q, lanes)
-    return rate_grid_ref(ts, vals, steps0, q)
+    if on_tpu_backend() and vals.shape[1] % lanes == 0:
+        return rate_grid(ts, vals, steps0, q, lanes, phase=phase)
+    return rate_grid_ref(ts, vals, steps0, q, phase=phase)
 
 
 MAX_K_BUCKETS = 64   # K-unrolled kernel passes; caps the compile cost

@@ -821,3 +821,101 @@ class TestDownsampledGridServing:
         assert (np.isfinite(vs) == np.isfinite(vf)).all()
         both = np.isfinite(vs)
         np.testing.assert_allclose(vs[both], vf[both], rtol=1e-4)
+
+
+class TestUniformPhaseServing:
+    """Uniform-phase serving: per-lane constant scrape offsets let the
+    grid drop the ts plane (ops/grid.py PHASE_OPS).  The proof must
+    activate on fixed-cadence data, produce results identical to the
+    general path, and stay OFF for per-sample-jittered data."""
+
+    def _mk_uniform(self, n_series=6, n_rows=50, seed=3):
+        ms = TimeSeriesMemStore()
+        shard = ms.setup("prom", DEFAULT_SCHEMAS, 0, StoreConfig())
+        rng = np.random.default_rng(seed)
+        b = RecordBuilder(DEFAULT_SCHEMAS["prom-counter"])
+        truth = {}
+        phases = rng.integers(1, STEP, n_series)
+        for i in range(n_series):
+            tags = {"__name__": "req_total", "instance": f"i{i}",
+                    "_ws_": "w", "_ns_": "n"}
+            base = T0 + np.arange(n_rows, dtype=np.int64) * STEP - STEP
+            ts = base + phases[i]          # constant per-series phase
+            vals = np.cumsum(rng.random(n_rows) * 5)
+            if i == 1:
+                vals[n_rows // 2:] -= vals[n_rows // 2] * 0.9  # reset
+            truth[f"i{i}"] = (ts, vals)
+            for t, v in zip(ts, vals):
+                b.add(int(t), [float(v)], tags)
+        for off, c in enumerate(b.containers()):
+            shard.ingest(decode_container(c, DEFAULT_SCHEMAS), off)
+        shard.flush_all()
+        return ms, shard, truth
+
+    def _oracle_rate(self, shard, part_ids, steps0, nsteps):
+        from filodb_tpu.ops.windows import StepRange
+        from filodb_tpu.query import rangefns
+        t2, batch = shard.scan_batch(part_ids, steps0 - WINDOW,
+                                     steps0 + (nsteps - 1) * STEP)
+        sr = StepRange(steps0, steps0 + (nsteps - 1) * STEP, STEP)
+        want = np.asarray(rangefns.apply_range_function(
+            batch, sr, WINDOW, F.RATE))
+        return t2, want[:len(t2)]
+
+    def test_phase_serving_matches_general(self):
+        ms, shard, truth = self._mk_uniform()
+        res = _lookup(shard)
+        steps0, nsteps = _steps(50)
+        got = shard.scan_grid(res.part_ids, F.RATE, steps0, nsteps, STEP,
+                              WINDOW)
+        assert got is not None
+        tags, vals, _ = got
+        cache = next(iter(shard.device_caches.values()))
+        assert cache._phase_memo, "uniform-phase proof should activate"
+        t2, want = self._oracle_rate(shard, res.part_ids, steps0, nsteps)
+        by_inst = {t["instance"]: i for i, t in enumerate(t2)}
+        for i, tg in enumerate(tags):
+            w = want[by_inst[tg["instance"]]]
+            both = np.isfinite(vals[i]) & np.isfinite(w)
+            assert (np.isfinite(vals[i]) == np.isfinite(w)).all()
+            np.testing.assert_allclose(vals[i][both], w[both], rtol=2e-5)
+
+    def test_phase_proof_rejects_jitter(self):
+        ms, shard, truth = _mk_shard(jitter_max=30_000)
+        res = _lookup(shard)
+        steps0, nsteps = _steps(50)
+        got = shard.scan_grid(res.part_ids, F.RATE, steps0, nsteps, STEP,
+                              WINDOW)
+        assert got is not None          # ts path still serves
+        cache = next(iter(shard.device_caches.values()))
+        assert not cache._phase_memo, "jittered data must not prove phase"
+
+    def test_phase_memo_reused_on_repeat(self):
+        import jax
+        ms, shard, truth = self._mk_uniform()
+        res = _lookup(shard)
+        steps0, nsteps = _steps(50)
+        shard.scan_grid(res.part_ids, F.RATE, steps0, nsteps, STEP, WINDOW)
+        cache = next(iter(shard.device_caches.values()))
+        assert cache._phase_memo
+        (key, (host, dev)) = next(iter(cache._phase_memo.items()))
+        shard.scan_grid(res.part_ids, F.RATE, steps0, nsteps, STEP, WINDOW)
+        (key2, (host2, dev2)) = next(iter(cache._phase_memo.items()))
+        assert key2 == key and dev2 is dev, "repeat must not re-upload"
+
+    def test_grouped_phase_serving_matches(self):
+        ms, shard, truth = self._mk_uniform(n_series=8)
+        res = _lookup(shard)
+        steps0, nsteps = _steps(50)
+        gids = [0, 1] * 4
+        state = shard.scan_grid_grouped(res.part_ids, F.RATE, steps0,
+                                        nsteps, STEP, WINDOW, gids, 2,
+                                        "sum")
+        assert state is not None
+        t2, want = self._oracle_rate(shard, res.part_ids, steps0, nsteps)
+        by_inst = {t["instance"]: i for i, t in enumerate(t2)}
+        order = [by_inst[f"i{i}"] for i in range(8)]
+        for g in range(2):
+            rows = want[[order[i] for i in range(8) if gids[i] == g]]
+            exp = np.nansum(np.where(np.isfinite(rows), rows, 0.0), axis=0)
+            np.testing.assert_allclose(state["sum"][g], exp, rtol=2e-5)

@@ -797,3 +797,126 @@ class TestAdviceParityFixes:
         # the final window's instant pair has dt==0 -> NaN there
         assert np.isnan(out[-1, :]).all()
         assert np.isfinite(out[:-1, :]).all()
+
+
+def _phase_data(n_series=128, n_empty=16, seed=11, reset_frac=0.08):
+    """Dense data with UNIFORM per-lane phase: every live lane scraped at
+    a constant offset within its bucket (the reference producer's shape —
+    TestTimeseriesProducer.scala:128 emits exact-cadence timestamps)."""
+    rng = np.random.default_rng(seed)
+    phase = rng.integers(1, STEP, n_series).astype(np.int64)
+    base = (np.arange(B, dtype=np.int64) * STEP + T0 - STEP)[:, None]
+    ts = base + phase[None, :]
+    incr = rng.random((B, n_series)) * 10.0
+    vals = np.cumsum(incr, axis=0)
+    resets = rng.random((B, n_series)) < reset_frac
+    for s in range(n_series):
+        for c in np.where(resets[:, s])[0]:
+            vals[c:, s] -= vals[c, s] * 0.9
+    vals[:, n_series - n_empty:] = np.nan
+    cts, cvals = _clip(jnp.asarray(ts), jnp.asarray(vals))
+    return cts, cvals, jnp.asarray(phase, jnp.int32)
+
+
+class TestPhaseMode:
+    """Uniform-phase kernels: the ts plane is replaced by one per-lane
+    phase row; results must match the ts-streaming dense path exactly."""
+
+    @pytest.mark.parametrize("op", ["rate", "increase", "delta"])
+    def test_ref_phase_matches_ref_ts(self, op):
+        from filodb_tpu.ops.grid import rate_grid_ref
+        cts, cvals, phase = _phase_data()
+        steps = _steps()
+        q = GridQuery(len(steps), K, STEP, op == "rate", op=op, dense=True)
+        want = np.asarray(rate_grid_ref(cts, cvals.astype(jnp.float64),
+                                        int(steps[0]), q))
+        got = np.asarray(rate_grid_ref(None, cvals.astype(jnp.float64),
+                                       int(steps[0]), q, phase=phase))
+        assert (np.isfinite(got) == np.isfinite(want)).all()
+        both = np.isfinite(got) & np.isfinite(want)
+        np.testing.assert_allclose(got[both], want[both], rtol=1e-12)
+
+    @pytest.mark.parametrize("op", ["rate", "increase", "delta"])
+    def test_pallas_interpret_phase(self, op):
+        from filodb_tpu.ops.grid import rate_grid, rate_grid_ref
+        cts, cvals, phase = _phase_data()
+        steps = _steps()
+        q = GridQuery(len(steps), K, STEP, op == "rate", op=op, dense=True)
+        want = np.asarray(rate_grid_ref(None, cvals, int(steps[0]), q,
+                                        phase=phase))
+        got = np.asarray(rate_grid(None, cvals.astype(jnp.float32),
+                                   int(steps[0]), q, lanes=128,
+                                   interpret=True, phase=phase))
+        both = np.isfinite(got) & np.isfinite(want)
+        assert (np.isfinite(got) == np.isfinite(want)).all()
+        np.testing.assert_allclose(got[both], want[both], rtol=2e-5)
+
+    def test_pallas_interpret_phase_grouped(self):
+        from filodb_tpu.ops.grid import rate_grid_grouped, rate_grid_ref
+        cts, cvals, phase = _phase_data(n_series=128, n_empty=24)
+        steps = _steps()
+        q = GridQuery(len(steps), K, STEP, True, dense=True)
+        # 8 groups x 16 lanes
+        s, c = rate_grid_grouped(None, cvals.astype(jnp.float32),
+                                 int(steps[0]), q, group_lanes=16,
+                                 interpret=True, phase=phase)
+        per = np.asarray(rate_grid_ref(None, cvals, int(steps[0]), q,
+                                       phase=phase))   # [T, S]
+        for g in range(8):
+            seg = per[:, g*16:(g+1)*16]
+            want_s = np.nansum(np.where(np.isfinite(seg), seg, 0.0), axis=1)
+            want_c = np.isfinite(seg).sum(axis=1)
+            np.testing.assert_allclose(np.asarray(s)[g], want_s, rtol=2e-5)
+            np.testing.assert_array_equal(np.asarray(c)[g], want_c)
+
+    def test_phase_mode_requires_dense(self):
+        from filodb_tpu.ops.grid import _phase_mode
+        q = GridQuery(10, K, STEP, True, dense=False)
+        assert not _phase_mode(q, jnp.zeros(8, jnp.int32))
+        assert _phase_mode(q._replace(dense=True), jnp.zeros(8, jnp.int32))
+        assert not _phase_mode(q._replace(dense=True), None)
+        assert not _phase_mode(q._replace(dense=True, op="sum"),
+                               jnp.zeros(8, jnp.int32))
+
+    def test_phase_strided_matches_subsample(self):
+        from filodb_tpu.ops.grid import rate_grid_ref
+        cts, cvals, phase = _phase_data()
+        steps = _steps()
+        ns_c = (len(steps) + 1) // 2
+        qs = GridQuery(ns_c, K, STEP, True, dense=True, stride=2)
+        q1 = GridQuery(len(steps), K, STEP, True, dense=True)
+        got = np.asarray(rate_grid_ref(None, cvals, int(steps[0]), qs,
+                                       phase=phase))
+        fine = np.asarray(rate_grid_ref(None, cvals, int(steps[0]), q1,
+                                        phase=phase))
+        np.testing.assert_allclose(got, fine[::2], rtol=1e-12)
+
+
+class TestTsFreeOps:
+    """TS_FREE_OPS stream no ts plane: ts=None must work and match."""
+
+    @pytest.mark.parametrize("op", ["sum", "min", "max", "count", "avg",
+                                    "last", "stddev"])
+    @pytest.mark.parametrize("dense", [True, False])
+    def test_ts_none_matches(self, op, dense):
+        from filodb_tpu.ops.grid import rate_grid, rate_grid_ref
+        if dense:
+            cts, cvals = _dense_data()
+        else:
+            ts, vals = _aligned_data()
+            cts, cvals = _clip(ts, vals)
+        steps = _steps()
+        q = GridQuery(len(steps), K, STEP, op=op, dense=dense)
+        want = np.asarray(rate_grid_ref(cts, cvals, int(steps[0]), q))
+        got_ref = np.asarray(rate_grid_ref(None, cvals, int(steps[0]), q))
+        np.testing.assert_array_equal(got_ref, want)
+        got_pl = np.asarray(rate_grid(None, cvals.astype(jnp.float32),
+                                      int(steps[0]), q, lanes=64,
+                                      interpret=True))
+        both = np.isfinite(got_pl) & np.isfinite(want)
+        assert (np.isfinite(got_pl) == np.isfinite(want)).all()
+        # stddev in f32 is ~1e-4 relative and near-zero variances see
+        # absolute cancellation noise (see grid._masked_moments)
+        np.testing.assert_allclose(got_pl[both], want[both],
+                                   rtol=1e-3 if op == "stddev" else 1e-4,
+                                   atol=1e-2 if op == "stddev" else 0)
