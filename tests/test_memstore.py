@@ -393,3 +393,33 @@ class TestBloom:
         fp = sum(1 for i in range(10_000)
                  if f"other-{i}".encode() in bf)
         assert fp < 300  # ~1% target
+
+
+class TestIndexRegexCorpusSoundness:
+    """The joined-corpus regex trick must fall back to per-value
+    matching for patterns that can span corpus lines or capture."""
+
+    def test_newline_spanning_pattern(self):
+        from filodb_tpu.core.filters import (ColumnFilter, Equals,
+                                             EqualsRegex, NotEqualsRegex)
+        from filodb_tpu.memstore.index import PartKeyIndex
+        idx = PartKeyIndex()
+        idx.add_partkey(0, b"", {"host": "ha", "m": "x"}, 0)
+        idx.add_partkey(1, b"", {"host": "hb", "m": "x"}, 0)
+        r = idx.part_ids_from_filters(
+            [ColumnFilter("host", EqualsRegex("h[\\s\\S]*"))], 0, 2**62)
+        assert list(r) == [0, 1]
+        r = idx.part_ids_from_filters(
+            [ColumnFilter("m", Equals("x")),
+             ColumnFilter("host", NotEqualsRegex("h[\\s\\S]*"))], 0, 2**62)
+        assert list(r) == []
+
+    def test_capture_group_pattern(self):
+        from filodb_tpu.core.filters import ColumnFilter, EqualsRegex
+        from filodb_tpu.memstore.index import PartKeyIndex
+        idx = PartKeyIndex()
+        idx.add_partkey(0, b"", {"host": "ha"}, 0)
+        idx.add_partkey(1, b"", {"host": "hb"}, 0)
+        r = idx.part_ids_from_filters(
+            [ColumnFilter("host", EqualsRegex("h(a|z)"))], 0, 2**62)
+        assert list(r) == [0]
