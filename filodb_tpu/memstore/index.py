@@ -44,6 +44,7 @@ semantics hold uniformly.
 from __future__ import annotations
 
 import re
+import threading
 from typing import Iterable, Optional, Sequence
 
 import numpy as np
@@ -87,6 +88,18 @@ class _Posting:
             self.arr = merged
             self.pending.clear()
         return self.arr
+
+
+# constructs whose line-wise corpus behavior DIFFERS from per-value
+# fullmatch: absolute anchors only succeed at the corpus's own ends
+# (missing matches on interior lines) and lookarounds can observe the
+# joining newlines (spurious matches the value-dictionary guard can't
+# catch, because they return real values for the wrong reason)
+_CORPUS_UNSAFE = ("\\A", "\\Z", "\\z", "(?=", "(?!", "(?<")
+
+
+def _corpus_unsafe(pattern: str) -> bool:
+    return any(tok in pattern for tok in _CORPUS_UNSAFE)
 
 
 class _Label:
@@ -150,8 +163,8 @@ class _Label:
             else:
                 self._corpus = (self.vgen, "\n".join(vals), vals)
         _, joined, vals = self._corpus
-        if joined == "" and len(vals) > 1:
-            out = [v for v in vals if flt.matches(v)]       # newline vals
+        if (joined == "" and len(vals) > 1) or _corpus_unsafe(flt.pattern):
+            out = [v for v in vals if flt.matches(v)]
         else:
             try:
                 rx = re.compile(rf"(?m)^(?:{flt.pattern})$")
@@ -186,6 +199,12 @@ class PartKeyIndex:
         self._alive = np.zeros(1024, bool)
         self._max_pid = -1
         self._removed = 0
+        # ONE lock serializes writers with the lazy structures reads
+        # materialize (posting pending-merges, code-array growth, memo
+        # fills): reads MUTATE shared state in this design, unlike the
+        # copy-on-read set postings it replaced, so the single-writer /
+        # many-reader shard discipline alone is not enough
+        self._lock = threading.Lock()
         # monotone mutation counter: lookup caches key on it so repeated
         # dashboard filters skip the postings walk until the index changes
         self.version = 0
@@ -209,6 +228,12 @@ class PartKeyIndex:
 
     def add_partkey(self, part_id: int, partkey: bytes, tags: dict[str, str],
                     start_time: int, end_time: int = _NO_END) -> None:
+        with self._lock:
+            self._add_partkey_locked(part_id, partkey, tags, start_time,
+                                     end_time)
+
+    def _add_partkey_locked(self, part_id, partkey, tags, start_time,
+                            end_time):
         self.version += 1
         self._grow(part_id)
         self._tags[part_id] = tags
@@ -238,6 +263,10 @@ class PartKeyIndex:
         self._end_arr[part_id] = _NO_END
 
     def remove(self, part_ids: Iterable[int]) -> None:
+        with self._lock:
+            self._remove_locked(part_ids)
+
+    def _remove_locked(self, part_ids) -> None:
         self.version += 1
         for pid in part_ids:
             tags = self._tags.pop(pid, None)
@@ -340,6 +369,9 @@ class PartKeyIndex:
         if memo is not None and memo[0] == lab.gen:
             return memo[1]
         out = self._union(f.column, lab.matching_values(flt))
+        if out.flags.writeable:        # same fail-loudly guard as postings
+            out = out.copy()
+            out.setflags(write=False)
         if len(lab._union_memo) > 64:
             lab._union_memo.clear()
         lab._union_memo[flt.pattern] = (lab.gen, out)
@@ -425,7 +457,8 @@ class PartKeyIndex:
         """Sorted part ids whose tags match all filters and whose [start,end]
         life overlaps the query range (reference: partIdsFromFilters +
         __endTime__ >= start && __startTime__ <= end clauses)."""
-        ids = self._candidate_ids(filters)
+        with self._lock:
+            ids = self._candidate_ids(filters)
         if len(ids):
             # .take with a pre-cast int64 index is ~2x a plain fancy
             # index here; this pair of gathers bounds wide lookups

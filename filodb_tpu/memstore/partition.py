@@ -128,17 +128,23 @@ class TimeSeriesPartition:
             self.drain_pending()
         return True
 
-    def ingest_block(self, ts: np.ndarray, cols: Sequence[np.ndarray]
+    def ingest_block(self, ts: np.ndarray, cols: Sequence
                      ) -> tuple[int, int]:
-        """Append a block of samples for scalar-column schemas (the C++
-        columnar decode path).  Vectorized out-of-order drop: a sample
-        survives iff it exceeds every timestamp before it in (chunks +
-        block) — identical to per-record ``ingest`` because dropped
-        samples never advance the high-water mark.  Returns
-        (rows_added, rows_dropped)."""
+        """Append a block of samples (the C++ columnar decode path).
+        Scalar columns are numpy arrays; a histogram column is a
+        ``(HistogramBuckets, int64[rows, nb])`` pair covering the whole
+        block under ONE scheme (the shard splits mixed runs).
+        Vectorized out-of-order drop: a sample survives iff it exceeds
+        every timestamp before it in (chunks + block) — identical to
+        per-record ``ingest`` because dropped samples never advance the
+        high-water mark.  Returns (rows_added, rows_dropped)."""
         n = len(ts)
         if n == 0:
             return 0, 0
+        new_buckets = None
+        for c in cols:
+            if isinstance(c, tuple):
+                new_buckets = c[0]
         froze = False
         with self._lock:
             # high-water mark inline (the property would re-take _lock)
@@ -159,7 +165,18 @@ class TimeSeriesPartition:
                 return 0, dropped
             if kept != n:
                 ts = ts[keep]
-                cols = [c[keep] for c in cols]
+                cols = [(c[0], c[1][keep]) if isinstance(c, tuple)
+                        else c[keep] for c in cols]
+            # bucket-scheme switch freezes the current buffer, same as
+            # the per-record path (reference: BucketSchemaMismatch).
+            # This runs AFTER the out-of-order drop: a fully-dropped
+            # block must not freeze anything or move the scheme, exactly
+            # like per-record ingest() returns before scheme handling.
+            if new_buckets is not None:
+                if self._hist_buckets is not None and self._buf_n > 0 \
+                        and new_buckets != self._hist_buckets:
+                    froze = self._freeze_raw_locked() or froze
+                self._hist_buckets = new_buckets
             i = 0
             while i < kept:
                 if self._buf_n == self._capacity:
@@ -168,7 +185,15 @@ class TimeSeriesPartition:
                 j = self._buf_n
                 self._buf_ts[j:j + take] = ts[i:i + take]
                 for buf, arr in zip(self._buf_cols, cols):
-                    buf[j:j + take] = arr[i:i + take]
+                    if isinstance(arr, tuple):
+                        # hist buffer is a list of per-row count arrays;
+                        # list slice assignment extends it in place.
+                        # .copy() bounds retention to the buffered rows —
+                        # views would pin the whole container matrix
+                        # until this buffer freezes
+                        buf[j:j + take] = list(arr[1][i:i + take].copy())
+                    else:
+                        buf[j:j + take] = arr[i:i + take]
                 self._buf_n = j + take
                 i += take
         if froze:

@@ -146,11 +146,15 @@ class TimeSeriesShard:
     def _ingest_container_fast(self, container: bytes, offset: int
                                ) -> Optional[int]:
         """Columnar ingest: C++ container decode + per-series batch append
-        (native/ingestfast.py).  Returns None when this container can't
-        take the fast path (histogram/string columns, mixed schemas, no
-        compiler) — the caller then runs the per-record path.  Semantics
-        match :meth:`ingest` exactly; tests/test_memstore.py proves
-        equivalence on out-of-order and watermark-skip data."""
+        (native/ingestfast.py).  Histogram columns arrive blob-expanded
+        (HistColumn) and batch-append when a series' rows share one
+        bucket scheme and width — the rare mixed-scheme run falls back
+        to per-record ingest for just that series.  Returns None when
+        this container can't take the fast path (string columns, mixed
+        schemas, no compiler) — the caller then runs the per-record
+        path.  Semantics match :meth:`ingest` exactly;
+        tests/test_memstore.py proves equivalence on out-of-order and
+        watermark-skip data."""
         from filodb_tpu.native import ingestfast
 
         dec = ingestfast.decode(container, self.schemas)
@@ -190,8 +194,8 @@ class TimeSeriesShard:
             part = self._get_or_add_partition_pk(
                 dec.partkeys[u], schema, int(dec.part_hashes[first]),
                 int(ts_s[s0]))
-            added, dropped = part.ingest_block(
-                ts_s[s0:s1], [c[s0:s1] for c in cols_s])
+            added, dropped = self._ingest_series_block(
+                part, ts_s[s0:s1], [c[s0:s1] for c in cols_s])
             added_total += added
             self.stats.rows_ingested += added
             self.stats.out_of_order_dropped += dropped
@@ -206,6 +210,44 @@ class TimeSeriesShard:
         if added_total:
             self.ingest_epoch += 1
         return added_total
+
+    @staticmethod
+    def _ingest_series_block(part, ts: np.ndarray, cols: list
+                             ) -> tuple[int, int]:
+        """Batch-append one series' rows.  HistColumn entries become
+        (bucket scheme, counts matrix) pairs when the run is uniform
+        (one scheme, one width — the overwhelmingly common case);
+        otherwise the run ingests per record so bucket-scheme-switch
+        semantics (buffer freeze) match the slow path exactly."""
+        from filodb_tpu.native.ingestfast import HistColumn
+        block_cols: list = []
+        uniform = True
+        for c in cols:
+            if not isinstance(c, HistColumn):
+                block_cols.append(c)
+                continue
+            if len(c.schemes) > 1 and \
+                    (c.scheme_idx != c.scheme_idx[0]).any():
+                uniform = False
+                break
+            nb0 = int(c.nbuckets[0])
+            if (c.nbuckets != nb0).any():
+                uniform = False
+                break
+            block_cols.append((c.schemes[int(c.scheme_idx[0])],
+                               c.counts[:, :nb0]))
+        if uniform:
+            return part.ingest_block(ts, block_cols)
+        added = dropped = 0
+        for i in range(len(ts)):
+            row = [(c.schemes[int(c.scheme_idx[i])],
+                    c.counts[i, :int(c.nbuckets[i])])
+                   if isinstance(c, HistColumn) else c[i] for c in cols]
+            if part.ingest(int(ts[i]), row):
+                added += 1
+            else:
+                dropped += 1
+        return added, dropped
 
     def ingest(self, records: Iterable[IngestRecord], offset: int) -> int:
         """Ingest a batch of records at a stream offset.  Returns rows added.

@@ -278,12 +278,14 @@ long long xor_unpack(const uint8_t* buf, size_t buflen, size_t offset,
 //
 // Schema table: per schema, its 16-bit hash, data-column count, and
 // column type codes (1 = f64 bit pattern into the i64 cell, 2 = i64,
-// 3 = i32 widened) flattened as sch_types[si * max_cols + ci].
-// Histogram/string columns are unsupported (-2): those containers take
-// the Python path.  Every record must carry the same schema hash (-3
-// otherwise — mixed containers fall back too).  Returns the record
-// count, or a negative error code: -1 malformed, -2 unsupported column,
-// -3 mixed/unknown schema, -4 capacity exceeded.
+// 3 = i32 widened, 4 = histogram blob: the cell receives the blob's
+// ABSOLUTE byte offset; hist_col_decode below expands the blobs)
+// flattened as sch_types[si * max_cols + ci].  String columns are
+// unsupported (-2): those containers take the Python path.  Every
+// record must carry the same schema hash (-3 otherwise — mixed
+// containers fall back too).  Returns the record count, or a negative
+// error code: -1 malformed, -2 unsupported column, -3 mixed/unknown
+// schema, -4 capacity exceeded.
 long long cd_decode(const uint8_t* buf, size_t buflen,
                     const uint16_t* sch_hashes, const uint8_t* sch_ncols,
                     const uint8_t* sch_types, size_t max_cols,
@@ -309,7 +311,7 @@ long long cd_decode(const uint8_t* buf, size_t buflen,
   const size_t ncols = sch_ncols[si];
   const uint8_t* types = sch_types + si * max_cols;
   for (size_t c = 0; c < ncols; ++c)
-    if (types[c] < 1 || types[c] > 3) return -2;
+    if (types[c] < 1 || types[c] > 4) return -2;
 
   std::unordered_map<std::string_view, int32_t> pk_map;
   pk_map.reserve(256);
@@ -342,6 +344,16 @@ long long cd_decode(const uint8_t* buf, size_t buflen,
           pos += 4;
           break;
         }
+        case 4: {  // histogram blob: u16 len + bytes; record the offset
+          if (pos + 2 > end) return -1;
+          uint16_t blen;
+          std::memcpy(&blen, buf + pos, 2);
+          pos += 2;
+          if (pos + blen > end) return -1;
+          row[c] = static_cast<int64_t>(pos);
+          pos += blen;
+          break;
+        }
       }
     }
     if (pos + 2 > end) return -1;
@@ -369,6 +381,94 @@ long long cd_decode(const uint8_t* buf, size_t buflen,
   *n_uniq_out = n_uniq;
   *schema_hash_out = static_cast<int32_t>(schema_hash);
   return n;
+}
+
+// ---------------------------------------------------------------------------
+// Histogram column expansion: decode every record's BinaryHistogram blob
+// (filodb_tpu/codecs/histcodec.py encode_hist_value layout: u8 wire_hist,
+// u16 n_buckets, bucket scheme [geometric: u8 id + 19 B | custom: u8 id
+// + u16 cn + 8*cn B], nibble-packed zigzag deltas) into a dense
+// [n, hb_cap] cumulative-counts matrix in one native pass, deduplicating
+// bucket schemes by their serialized bytes.  The ingest-side answer to
+// the reference's per-record BinHistogram parse (reference:
+// memory/format/vectors/HistogramVector.scala:34; the jmh analog is
+// HistogramIngestBenchmark.scala:29).
+//
+// blob_off comes from cd_decode's type-4 cells (each u16 length prefix
+// precedes the blob, so the bound is re-read here).  Returns n, or -1
+// malformed, -2 wrong wire/scheme, -4 a blob exceeds hb_cap, -5 scheme
+// capacity exceeded.
+long long hist_col_decode(const uint8_t* buf, size_t buflen,
+                          const int64_t* blob_off, size_t n,
+                          int wire_hist, int scheme_geo, int scheme_custom,
+                          size_t hb_cap, int64_t* counts_out,
+                          int32_t* nb_out, int32_t* scheme_idx,
+                          int64_t* uscheme_off, int64_t* uscheme_len,
+                          size_t cap_schemes, long long* n_schemes_out) {
+  std::unordered_map<std::string_view, int32_t> smap;
+  smap.reserve(4);
+  long long ns = 0;
+  uint64_t tmp[8];
+  for (size_t i = 0; i < n; ++i) {
+    size_t pos = static_cast<size_t>(blob_off[i]);
+    if (pos < 2 || pos + 3 > buflen) return -1;
+    uint16_t blen;
+    std::memcpy(&blen, buf + pos - 2, 2);
+    size_t bend = pos + blen;
+    if (bend > buflen || pos + 3 > bend) return -1;
+    if (buf[pos] != wire_hist) return -2;
+    uint16_t nv;
+    std::memcpy(&nv, buf + pos + 1, 2);
+    if (nv > hb_cap) return -4;
+    size_t spos = pos + 3;
+    if (spos >= bend) return -1;
+    size_t slen;
+    int sid = buf[spos];
+    if (sid == scheme_geo) {
+      slen = 20;
+    } else if (sid == scheme_custom) {
+      if (spos + 3 > bend) return -1;
+      uint16_t cn;
+      std::memcpy(&cn, buf + spos + 1, 2);
+      slen = 3 + static_cast<size_t>(cn) * 8;
+    } else {
+      return -2;
+    }
+    if (spos + slen > bend) return -1;
+    std::string_view sv(reinterpret_cast<const char*>(buf + spos), slen);
+    auto it = smap.find(sv);
+    int32_t suid;
+    if (it == smap.end()) {
+      if (static_cast<size_t>(ns) >= cap_schemes) return -5;
+      suid = static_cast<int32_t>(ns);
+      smap.emplace(sv, suid);
+      uscheme_off[ns] = static_cast<int64_t>(spos);
+      uscheme_len[ns] = static_cast<int64_t>(slen);
+      ++ns;
+    } else {
+      suid = it->second;
+    }
+    scheme_idx[i] = suid;
+    nb_out[i] = nv;
+    // nibble-unpack the zigzag deltas group-wise and fuse the cumsum
+    int64_t* row = counts_out + i * hb_cap;
+    size_t dpos = spos + slen;
+    int64_t acc = 0;
+    uint32_t emitted = 0;
+    size_t ngroups = (static_cast<size_t>(nv) + 7) / 8;
+    for (size_t g = 0; g < ngroups; ++g) {
+      long long next = np_unpack(buf, bend, dpos, 8, tmp);
+      if (next < 0) return -1;
+      dpos = static_cast<size_t>(next);
+      for (int k = 0; k < 8 && emitted < nv; ++k, ++emitted) {
+        acc += zigzag_dec(tmp[k]);
+        row[emitted] = acc;
+      }
+    }
+    for (size_t k = nv; k < hb_cap; ++k) row[k] = acc;  // edge-pad
+  }
+  *n_schemes_out = ns;
+  return static_cast<long long>(n);
 }
 
 }  // extern "C"

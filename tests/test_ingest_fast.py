@@ -94,15 +94,79 @@ def test_fast_watermark_skip_matches():
     assert results[0] == results[1]
 
 
-def test_fast_declines_histogram_schema():
+def _hist_snapshot(shard):
+    out = {}
+    for pk, pid in shard.part_set.items():
+        part = shard.partitions.get(pid)
+        ts, (buckets, rows) = part.read_range(0, np.iinfo(np.int64).max, 3)
+        out[pk] = (ts.tolist(), rows.tolist(),
+                   buckets.bucket_tops().tolist() if buckets else None,
+                   part.out_of_order_dropped)
+    return out
+
+
+def test_fast_histogram_matches_slow():
+    """Histogram containers take the fast path (VERDICT r2 weak #3) and
+    must be observably identical to the per-record blob-decode path."""
     from tests.data import histogram_containers
-    containers = histogram_containers()
-    ms = TimeSeriesMemStore()
-    ms.setup("ds", DEFAULT_SCHEMAS, 0)
-    sh = ms.get_shard("ds", 0)
-    assert sh._ingest_container_fast(containers[0], 0) is None
-    # and the public entry still ingests via the Python path
-    assert sh.ingest_container(containers[0], 0) > 0
+    containers = histogram_containers(n_series=3, n_samples=40)
+    snaps = []
+    for fast in (True, False):
+        ms = TimeSeriesMemStore()
+        ms.setup("ds", DEFAULT_SCHEMAS, 0)
+        sh = ms.get_shard("ds", 0)
+        for off, c in enumerate(containers):
+            if fast:
+                got = sh._ingest_container_fast(c, off)
+                assert got is not None, "hist fast path declined"
+            else:
+                sh.ingest(decode_container(c, sh.schemas), off)
+        snaps.append((sh.stats.rows_ingested, _hist_snapshot(sh)))
+    assert snaps[0] == snaps[1]
+
+
+def test_fast_histogram_scheme_switch_matches():
+    """A bucket-scheme widening mid-stream must freeze buffers exactly
+    like the per-record path (BucketSchemaMismatch semantics)."""
+    from filodb_tpu.codecs import histcodec
+    from filodb_tpu.core.histogram import GeometricBuckets
+    b = RecordBuilder(DEFAULT_SCHEMAS["prom-histogram"],
+                      container_size=1 << 20)
+    tags = {"__name__": "lat", "_ws_": "w", "_ns_": "n"}
+    for i in range(30):
+        nb = 8 if i < 15 else 12             # widen mid-stream
+        buckets = GeometricBuckets(2.0, 2.0, nb)
+        cum = np.arange(1, nb + 1, dtype=np.int64) * (i + 1)
+        blob = histcodec.encode_hist_value(buckets, cum)
+        b.add(BASE + i * 1000, (float(cum[-1]), float(cum[-1]), blob), tags)
+    containers = b.containers()
+    # a separate, UNIFORM container holding a third scheme with only
+    # out-of-order rows: the block path must drop every row without
+    # freezing buffers or moving the scheme (matching per-record
+    # ingest, which drops before any scheme handling)
+    b2 = RecordBuilder(DEFAULT_SCHEMAS["prom-histogram"],
+                       container_size=1 << 20)
+    b3 = GeometricBuckets(2.0, 2.0, 16)
+    for i in range(5):
+        cum3 = np.arange(1, 17, dtype=np.int64) * (i + 1)
+        b2.add(BASE - 10_000 + i * 1000,
+               (float(cum3[-1]), float(cum3[-1]),
+                histcodec.encode_hist_value(b3, cum3)), tags)
+    containers += b2.containers()
+    snaps = []
+    for fast in (True, False):
+        ms = TimeSeriesMemStore()
+        ms.setup("ds", DEFAULT_SCHEMAS, 0)
+        sh = ms.get_shard("ds", 0)
+        for off, c in enumerate(containers):
+            if fast:
+                assert sh._ingest_container_fast(c, off) is not None
+            else:
+                sh.ingest(decode_container(c, sh.schemas), off)
+        part = next(iter(sh.partitions.values()))
+        snaps.append((sh.stats.rows_ingested, len(part.chunks),
+                      _hist_snapshot(sh)))
+    assert snaps[0] == snaps[1]
 
 
 def test_fast_counter_schema_matches():
