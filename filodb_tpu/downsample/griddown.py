@@ -1,0 +1,275 @@
+"""Vectorized (device-capable) batch downsampling: the grid fast path.
+
+SURVEY §7 step 9: "downsampler = same kernels driven by a batch driver".
+Raw samples on a regular scrape cadence lay out as the SAME time-major
+bucket grid the serving path uses (ops/grid.py layout invariant: row c
+holds the sample with ``ts in ((c-1)*g, c*g]``); a downsample period at
+resolution ``res = K * g`` then covers exactly K consecutive rows, and
+every per-period aggregate (dMin/dMax/dSum/dCount/dAvg/dLast) collapses
+to a reshape ``[B, S] -> [P, K, S]`` + one axis-1 reduction — no
+per-period loops, one jit dispatch for all series and all aggregates
+(reference analog: spark-jobs BatchDownsampler.downsampleBatch applying
+ChunkDownsamplers chunk-by-chunk, BatchDownsampler.scala:36; VERDICT r2
+weak #6 / do-this #6).
+
+Series that violate the one-sample-per-bucket invariant, counter series
+containing resets (the counter period marker splits periods mid-bucket),
+histogram columns, and re-downsampling aggregates (dAvgSc/dAvgAc) fall
+back to the per-series host path in chunkdown.py — the fast path is
+never wrong, only absent.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import numpy as np
+
+from filodb_tpu.downsample.chunkdown import (CounterPeriodMarker, DAvg,
+                                             DCount, DLast, DMax, DMin, DSum,
+                                             TTime)
+
+_STD_STEPS = (1_000, 2_000, 5_000, 10_000, 15_000, 30_000, 60_000,
+              120_000, 300_000, 600_000, 900_000, 1_800_000, 3_600_000)
+
+# downsampler classes the grid path serves (others -> host fallback)
+_GRID_DOWNSAMPLERS = (TTime, DMin, DMax, DSum, DCount, DAvg, DLast)
+
+
+def grid_supported(downsamplers: Sequence) -> bool:
+    return all(isinstance(d, _GRID_DOWNSAMPLERS) for d in downsamplers)
+
+
+def detect_gstep(ts_list: Sequence[np.ndarray]) -> Optional[int]:
+    """Scrape cadence across a batch: median inter-sample delta snapped
+    to the nearest standard interval (same policy as the serving grid,
+    memstore/devicestore.py _detect_gstep)."""
+    deltas = [np.diff(ts) for ts in ts_list if len(ts) >= 3]
+    if not deltas:
+        return None
+    d = np.concatenate(deltas)
+    d = d[d > 0]
+    if len(d) == 0:
+        return None
+    med = float(np.median(d))
+    best = min(_STD_STEPS, key=lambda c: abs(c - med))
+    if abs(best - med) <= 0.5 * best:
+        return best
+    return int(med) if med >= 1 else None
+
+
+class StagedGrid:
+    """One [B, S] staging of a batch of series, shared by every
+    resolution whose K divides the alignment."""
+
+    def __init__(self, g: int, c_start: int, vals: list[np.ndarray],
+                 present: np.ndarray, eligible: np.ndarray,
+                 has_reset: np.ndarray):
+        self.g = g
+        self.c_start = c_start          # global bucket index of row 0
+        self.vals = vals                # per data column, [B, S]
+        self.present = present          # bool [B, S]: a sample occupies
+        self.eligible = eligible        # bool [S]: one-per-bucket held
+        self.has_reset = has_reset      # bool [S]: any value drop
+
+    @property
+    def nrows(self) -> int:
+        return self.vals[0].shape[0]
+
+
+def stage_grid(ts_list: Sequence[np.ndarray], cols_list: Sequence[Sequence],
+               g: int, k_align: int, dtype=np.float64,
+               reset_col: Optional[int] = None) -> Optional[StagedGrid]:
+    """Scatter a batch of series into the bucket grid.  ``k_align``
+    aligns row 0 so every resolution's periods tile whole rows
+    (c_start = lcm-of-K boundary + 1).  ``reset_col`` is the data-column
+    index the counter period marker watches for drops (None for time
+    markers).  Returns None when nothing can be staged (no scalar
+    columns / empty batch)."""
+    S = len(ts_list)
+    if S == 0 or g <= 0:
+        return None
+    ncols = len(cols_list[0])
+    for cols in cols_list:
+        for c in cols:
+            if not isinstance(c, np.ndarray):
+                return None                    # histogram/string: host path
+    c_min = None
+    c_max = None
+    buckets_list = []
+    for ts in ts_list:
+        if len(ts) == 0:
+            buckets_list.append(np.empty(0, np.int64))
+            continue
+        b = (ts + g - 1) // g                  # bucket c: ts in ((c-1)g, cg]
+        buckets_list.append(b)
+        c_min = int(b[0]) if c_min is None else min(c_min, int(b[0]))
+        c_max = int(b[-1]) if c_max is None else max(c_max, int(b[-1]))
+    if c_min is None:
+        return None
+    # align row 0 to a period boundary for every resolution
+    c_start = ((c_min - 1) // k_align) * k_align + 1
+    B = (-(-(c_max - c_start + 1) // k_align)) * k_align
+    if B <= 0 or B * S > 64_000_000:           # batch-size guard (~0.5 GB)
+        return None
+    vals = [np.full((B, S), np.nan, dtype) for _ in range(ncols)]
+    present = np.zeros((B, S), bool)
+    eligible = np.ones(S, bool)
+    has_reset = np.zeros(S, bool)
+    for s, (b, cols) in enumerate(zip(buckets_list, cols_list)):
+        if len(b) == 0:
+            continue
+        rows = b - c_start
+        if rows[0] < 0 or (np.diff(b) <= 0).any():
+            eligible[s] = False                # >1 sample per bucket / OOO
+            continue
+        if reset_col is not None and len(cols[reset_col]) > 1:
+            with np.errstate(invalid="ignore"):
+                if (np.diff(cols[reset_col]) < 0).any():
+                    has_reset[s] = True
+        present[rows, s] = True                # NaN-valued samples still
+        for ci in range(ncols):                # open their period (host
+            vals[ci][rows, s] = cols[ci]       # semantics)
+    return StagedGrid(g, c_start, vals, present, eligible, has_reset)
+
+
+@functools.lru_cache(maxsize=1)
+def _jax():
+    import jax
+    import jax.numpy as jnp
+    return jax, jnp
+
+
+def _period_reduce_impl(vals, P: int, K: int):
+    """[B, S] -> per-period aggregates [P, S]: one reshape, one pass per
+    aggregate, all fused under jit (XLA keeps the [P, K, S] view
+    virtual).  Runs on whatever the default backend is — the TPU under
+    the batch driver, CPU in tests."""
+    _, jnp = _jax()
+    S = vals.shape[1]
+    v = vals.reshape(P, K, S)
+    fin = jnp.isfinite(v)
+    cnt = fin.sum(axis=1).astype(vals.dtype)
+    vsum = jnp.where(fin, v, 0.0).sum(axis=1)
+    vmin = jnp.where(fin, v, jnp.inf).min(axis=1)
+    vmax = jnp.where(fin, v, -jnp.inf).max(axis=1)
+    live = cnt > 0
+    # last finite row per period: highest finite k index
+    kidx = jnp.arange(K, dtype=jnp.int32)[None, :, None]
+    last_k = jnp.where(fin, kidx, -1).max(axis=1)          # [P, S]
+    lastv = jnp.take_along_axis(v, jnp.maximum(last_k, 0)[:, None, :],
+                                axis=1)[:, 0, :]
+    nan = jnp.nan
+    return {
+        "cnt": cnt,
+        "sum": jnp.where(live, vsum, nan),
+        "min": jnp.where(live, vmin, nan),
+        "max": jnp.where(live, vmax, nan),
+        "avg": jnp.where(live, vsum / jnp.maximum(cnt, 1.0), nan),
+        "last": jnp.where(live, lastv, nan),
+    }
+
+
+def _period_reduce_np(vals: np.ndarray, P: int, K: int
+                      ) -> dict[str, np.ndarray]:
+    """Numpy twin of _period_reduce_impl: full float64, used whenever
+    the jax backend would silently downcast (x64 off, e.g. the default
+    TPU runtime) — PERSISTED downsample data must not lose precision
+    relative to the per-series host path."""
+    S = vals.shape[1]
+    v = vals.reshape(P, K, S)
+    fin = np.isfinite(v)
+    cnt = fin.sum(axis=1).astype(vals.dtype)
+    vsum = np.where(fin, v, 0.0).sum(axis=1)
+    vmin = np.where(fin, v, np.inf).min(axis=1)
+    vmax = np.where(fin, v, -np.inf).max(axis=1)
+    live = cnt > 0
+    kidx = np.arange(K, dtype=np.int32)[None, :, None]
+    last_k = np.where(fin, kidx, -1).max(axis=1)
+    lastv = np.take_along_axis(v, np.maximum(last_k, 0)[:, None, :],
+                               axis=1)[:, 0, :]
+    nan = np.nan
+    return {
+        "cnt": cnt,
+        "sum": np.where(live, vsum, nan),
+        "min": np.where(live, vmin, nan),
+        "max": np.where(live, vmax, nan),
+        "avg": np.where(live, vsum / np.maximum(cnt, 1.0), nan),
+        "last": np.where(live, lastv, nan),
+    }
+
+
+_REDUCE_CACHE: dict = {}
+
+
+def period_reduce(vals: np.ndarray, P: int, K: int) -> dict[str, np.ndarray]:
+    """Returns host numpy [P, S] aggregate planes.  Uses the jitted jax
+    kernel only when it preserves the input precision (x64 enabled or
+    f32 input); otherwise the float64 numpy twin — identical math,
+    proven by tests/test_downsample.py equivalence."""
+    jax, jnp = _jax()
+    if vals.dtype == np.float64 and not jax.config.jax_enable_x64:
+        return _period_reduce_np(vals, P, K)
+    key = "fn"
+    fn = _REDUCE_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(_period_reduce_impl, static_argnums=(1, 2))
+        _REDUCE_CACHE[key] = fn
+    out = fn(jnp.asarray(vals), P, K)
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def grid_outputs(staged: StagedGrid, res: int, downsamplers: Sequence,
+                 marker) -> Optional[tuple[np.ndarray, list, np.ndarray,
+                                           np.ndarray]]:
+    """Compute every requested downsampler over one resolution from the
+    staged grid.  Returns (serve_mask [S], per-downsampler [P, S] output
+    planes, period_end stamps [P], period-live [P, S]) or None when this
+    resolution doesn't tile the grid."""
+    g = staged.g
+    if res % g != 0:
+        return None
+    K = res // g
+    B = staged.nrows
+    if B % K != 0:
+        return None
+    P = B // K
+    serve = staged.eligible.copy()
+    if isinstance(marker, CounterPeriodMarker):
+        # reset splits create mid-bucket periods: host path handles them
+        serve &= ~staged.has_reset
+    if not serve.any():
+        return None
+    # column -> reduced planes, computed lazily per distinct column
+    reduced: dict[int, dict[str, np.ndarray]] = {}
+
+    def planes(ci: int) -> dict[str, np.ndarray]:
+        got = reduced.get(ci)
+        if got is None:
+            got = reduced[ci] = period_reduce(staged.vals[ci], P, K)
+        return got
+
+    period_ends = (staged.c_start - 1 + (np.arange(P) + 1) * K) * g
+    plive = staged.present.reshape(P, K, -1).any(axis=1)    # [P, S]
+    outs = []
+    for d in downsamplers:
+        if isinstance(d, TTime):
+            outs.append(None)                 # stamps come from period_ends
+            continue
+        pl = planes(d.col_id - 1)
+        if isinstance(d, DMin):
+            outs.append(pl["min"])
+        elif isinstance(d, DMax):
+            outs.append(pl["max"])
+        elif isinstance(d, DSum):
+            outs.append(pl["sum"])
+        elif isinstance(d, DCount):
+            outs.append(pl["cnt"])
+        elif isinstance(d, DAvg):
+            outs.append(pl["avg"])
+        elif isinstance(d, DLast):
+            outs.append(pl["last"])
+        else:
+            return None
+    return serve, outs, period_ends, plive

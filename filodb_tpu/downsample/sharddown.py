@@ -21,6 +21,7 @@ import numpy as np
 from filodb_tpu.core.chunk import ChunkSet, decode_chunkset
 from filodb_tpu.core.record import RecordBuilder
 from filodb_tpu.core.schemas import ColumnType, Schema
+from filodb_tpu.downsample import griddown
 from filodb_tpu.downsample.chunkdown import (parse_downsampler,
                                              parse_period_marker)
 
@@ -106,15 +107,65 @@ class ShardDownsampler:
                     cols.append(np.concatenate(vals))
             decoded.append((tags, ts, cols))
 
+        staged = self._try_stage_grid(decoded)
         emitted = 0
         for res in self.resolutions:
             builder = RecordBuilder(self.ds_schema)
-            for tags, ts, cols in decoded:
+            served = None
+            if staged is not None:
+                got = griddown.grid_outputs(staged, res, self.downsamplers,
+                                            self.marker)
+                if got is not None:
+                    served, outs, pends, plive = got
+                    emitted += self._emit_grid(builder, decoded, served,
+                                               outs, pends, plive)
+            for si, (tags, ts, cols) in enumerate(decoded):
+                if served is not None and served[si]:
+                    continue
                 emitted += self._emit(builder, tags, ts, cols, res)
             containers = builder.containers()
             if containers:
                 self.publisher.publish(res, self.shard, containers)
         return emitted
+
+    def _try_stage_grid(self, decoded):
+        """Stage the whole batch as a [B, S] bucket grid when every
+        downsampler and resolution is grid-servable (griddown.py — the
+        serving kernels driven as a batch downsampler, SURVEY §7)."""
+        import math
+        if not griddown.grid_supported(self.downsamplers):
+            return None
+        g = griddown.detect_gstep([ts for _, ts, _ in decoded])
+        if not g or any(res % g != 0 for res in self.resolutions):
+            return None
+        ks = [res // g for res in self.resolutions]
+        k_align = math.lcm(*ks)
+        if k_align > 4096:
+            return None
+        from filodb_tpu.downsample.chunkdown import CounterPeriodMarker
+        reset_col = self.marker.col_id - 1 \
+            if isinstance(self.marker, CounterPeriodMarker) else None
+        return griddown.stage_grid([ts for _, ts, _ in decoded],
+                                   [cols for _, _, cols in decoded],
+                                   g, k_align, reset_col=reset_col)
+
+    def _emit_grid(self, builder: RecordBuilder, decoded, served, outs,
+                   period_ends, plive) -> int:
+        """Vectorized emission: one add_series per served series, only
+        the periods that contain samples (host-path parity)."""
+        n = 0
+        for si, (tags, _ts, _cols) in enumerate(decoded):
+            if not served[si]:
+                continue
+            pm = plive[:, si]
+            if not pm.any():
+                continue
+            pe = period_ends[pm]
+            cols = [out[pm, si] for out in outs if out is not None]
+            builder.add_series(pe.tolist(), [c.tolist() for c in cols],
+                               tags)
+            n += len(pe)
+        return n
 
     def _emit(self, builder: RecordBuilder, tags: dict, ts: np.ndarray,
               cols: Sequence, resolution_ms: int) -> int:

@@ -252,15 +252,19 @@ class PartKeyIndex:
 
     def update_end_time(self, part_id: int, end_time: int) -> None:
         """Marks a series stopped (reference: updatePartKeyWithEndTime, used
-        by flush step updateIndexWithEndTime and by eviction ordering)."""
-        if self._end_arr[part_id] != end_time:
-            self.version += 1
-        self._end_arr[part_id] = end_time
+        by flush step updateIndexWithEndTime and by eviction ordering).
+        Locked: a concurrent add_partkey _grow would otherwise strand
+        this write in the superseded array."""
+        with self._lock:
+            if self._end_arr[part_id] != end_time:
+                self.version += 1
+            self._end_arr[part_id] = end_time
 
     def mark_active(self, part_id: int) -> None:
-        if self._end_arr[part_id] != _NO_END:
-            self.version += 1
-        self._end_arr[part_id] = _NO_END
+        with self._lock:
+            if self._end_arr[part_id] != _NO_END:
+                self.version += 1
+            self._end_arr[part_id] = _NO_END
 
     def remove(self, part_ids: Iterable[int]) -> None:
         with self._lock:

@@ -439,9 +439,11 @@ class TracingTimeSeriesPartition(TimeSeriesPartition):
         added, dropped = super().ingest_block(ts, cols)
         log = logging.getLogger("filodb.trace")
         for i in range(len(ts)):
+            # histogram columns arrive as (buckets, matrix) pairs
+            row = [c[1][i].tolist() if isinstance(c, tuple) else c[i]
+                   for c in cols]
             log.info("TRACE ingest part=%d tags=%s ts=%d values=%s",
-                     self.part_id, self.tags, int(ts[i]),
-                     [c[i] for c in cols])
+                     self.part_id, self.tags, int(ts[i]), row)
         if dropped:
             log.info("TRACE ingest part=%d dropped=%d out-of-order rows",
                      self.part_id, dropped)

@@ -240,8 +240,10 @@ class TimeSeriesShard:
             return part.ingest_block(ts, block_cols)
         added = dropped = 0
         for i in range(len(ts)):
+            # .copy(): a buffered row view would pin the whole container
+            # counts matrix until the buffer freezes
             row = [(c.schemes[int(c.scheme_idx[i])],
-                    c.counts[i, :int(c.nbuckets[i])])
+                    c.counts[i, :int(c.nbuckets[i])].copy())
                    if isinstance(c, HistColumn) else c[i] for c in cols]
             if part.ingest(int(ts[i]), row):
                 added += 1

@@ -359,3 +359,130 @@ class TestDownsampleQueryRewrites:
                 p = elig[-1]
                 np.testing.assert_allclose(got[j], vals[pids == p].mean(),
                                            rtol=1e-10)
+
+
+class TestGridDownsamplePath:
+    """The vectorized grid downsampler (downsample/griddown.py) must be
+    byte-identical to the per-series host path on regular-cadence data,
+    and must hand reset/irregular series back to the host path."""
+
+    STEP = 5_000
+    RESOLUTIONS = (60_000, 900_000)
+
+    def _mk(self, schema_name, make_vals, n_series=6, n_rows=360,
+            irregular=(), gaps=True):
+        schemas = DEFAULT_SCHEMAS
+        builder = RecordBuilder(schemas[schema_name])
+        rng = np.random.default_rng(5)
+        t0 = 1_700_000_000_000
+        for s in range(n_series):
+            tags = {"__name__": "m", "inst": f"i{s}", "_ws_": "w",
+                    "_ns_": "n"}
+            ts = t0 + np.arange(n_rows, dtype=np.int64) * self.STEP \
+                + (s * 13) % self.STEP + 1
+            if s in irregular:
+                # two samples in one bucket: grid must refuse this lane
+                ts = np.sort(np.concatenate([ts, ts[:5] + 1]))
+            keep = np.ones(len(ts), bool)
+            if gaps and s % 2 == 0:
+                keep[rng.random(len(ts)) < 0.1] = False   # missed scrapes
+            vals = make_vals(rng, len(ts), s)
+            for t, v in zip(ts[keep], vals[keep]):
+                builder.add(int(t), [float(v)], tags)
+        return schemas, builder.containers()
+
+    def _run(self, schemas, containers, schema_name, force_host):
+        from filodb_tpu.core.record import decode_container
+        from filodb_tpu.downsample import griddown
+        import unittest.mock as mock
+        store = TimeSeriesMemStore()
+        shard = store.setup("prom", schemas, 0)
+        pub = MemoryDownsamplePublisher()
+        shard.enable_downsampling(pub, self.RESOLUTIONS)
+        ctx = mock.patch.object(griddown, "grid_supported",
+                                lambda d: False) if force_host \
+            else mock.patch.object(griddown, "detect_gstep",
+                                   griddown.detect_gstep)
+        with ctx:
+            for off, c in enumerate(containers):
+                store.ingest("prom", 0, c, offset=off)
+            shard.flush_all()
+        out = {}
+        for res in self.RESOLUTIONS:
+            recs = []
+            for sh, cont in pub.drain(res):
+                for r in decode_container(cont, schemas):
+                    key = (res, r.tags.get("inst"))
+                    recs.append((key, r.timestamp,
+                                 tuple(np.round(np.asarray(
+                                     r.values, np.float64), 9))))
+            recs.sort()
+            out[res] = recs
+        return out
+
+    def test_gauge_grid_matches_host(self):
+        schemas, containers = self._mk(
+            "gauge", lambda rng, n, s: rng.normal(50, 10, n),
+            irregular=(3,))
+        grid = self._run(schemas, containers, "gauge", force_host=False)
+        host = self._run(schemas, containers, "gauge", force_host=True)
+        assert grid == host
+        assert any(len(v) for v in grid.values())
+
+    def test_counter_grid_matches_host_with_resets(self):
+        def mk(rng, n, s):
+            v = np.cumsum(rng.random(n) * 3)
+            if s in (1, 4):                   # resets -> host fallback
+                v[n // 2:] -= v[n // 2] * 0.95
+            return v
+        schemas, containers = self._mk("prom-counter", mk)
+        grid = self._run(schemas, containers, "prom-counter",
+                         force_host=False)
+        host = self._run(schemas, containers, "prom-counter",
+                         force_host=True)
+        assert grid == host
+
+
+def test_grid_downsample_nan_samples_match_host():
+    """NaN-valued samples (staleness markers) must produce identical
+    downsample records on the grid and host paths, at full precision
+    even when jax x64 is off (the numpy f64 twin)."""
+    import math
+    import unittest.mock as mock
+
+    from filodb_tpu.core.record import decode_container
+    from filodb_tpu.downsample import griddown
+
+    def run(force_host):
+        store = TimeSeriesMemStore()
+        shard = store.setup("prom", DEFAULT_SCHEMAS, 0)
+        pub = MemoryDownsamplePublisher()
+        shard.enable_downsampling(pub, (60_000,))
+        b = RecordBuilder(DEFAULT_SCHEMAS["gauge"])
+        t0 = 1_700_000_000_000
+        tags = {"__name__": "m", "_ws_": "w", "_ns_": "n"}
+        vals = [5.0, float("nan"), 7.0] + [float("nan")] * 3
+        for i, v in enumerate(vals * 20):
+            b.add(t0 + i * 5_000 + 1, [v], tags)
+        ctx = mock.patch.object(griddown, "grid_supported",
+                                lambda d: False) if force_host \
+            else mock.patch.object(griddown, "detect_gstep",
+                                   griddown.detect_gstep)
+        with ctx:
+            for off, c in enumerate(b.containers()):
+                store.ingest("prom", 0, c, offset=off)
+            shard.flush_all()
+        out = []
+        for sh, cont in pub.drain(60_000):
+            for r in decode_container(cont, DEFAULT_SCHEMAS):
+                out.append((r.timestamp,
+                            tuple(np.asarray(r.values, np.float64))))
+        out.sort()
+        return out
+
+    g, h = run(False), run(True)
+    assert len(g) == len(h) and len(g) > 0
+    for (tg, vg), (th, vh) in zip(g, h):
+        assert tg == th
+        for x, y in zip(vg, vh):
+            assert (math.isnan(x) and math.isnan(y)) or x == y, (vg, vh)
