@@ -188,6 +188,47 @@ class _GridPlan(NamedTuple):
     ncols: int
     lane_idx: np.ndarray  # requested pid -> lane slot, in request order
     phase: object = None  # [ncols] int32 device array (uniform-phase mode)
+    segs: tuple = ()      # the covered _Block objects (mesh staging)
+
+
+class MeshShardPlan(NamedTuple):
+    """One shard's device-resident contribution to a mesh grid query."""
+
+    ts: object            # [nrows, ncols] int32, on this shard's device
+    vals: object          # [nrows, ncols] f32/f64, same device
+    phase: object         # [ncols] int32 device array or None
+    garr: np.ndarray      # [ncols] int32 lane -> group (num_groups=drop)
+    q: "GridQuery"
+    steps0_rel: int
+    ncols: int
+    device: object
+
+
+_MESH_STAGE_FN = None
+
+
+def _mesh_stage(ts_parts: tuple, val_parts: tuple, row0: int, nrows: int):
+    """Device-side block concat + row slice for the mesh path: inputs
+    are committed to the shard's device, so the outputs stay there (a
+    pure HBM->HBM copy, no host transfer).  Jitted per shape."""
+    global _MESH_STAGE_FN
+    if _MESH_STAGE_FN is None:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        @functools.partial(jax.jit, static_argnames=("nrows",))
+        def stage(ts_parts, val_parts, row0, *, nrows):
+            ts_all = ts_parts[0] if len(ts_parts) == 1 \
+                else jnp.concatenate(list(ts_parts), axis=0)
+            val_all = val_parts[0] if len(val_parts) == 1 \
+                else jnp.concatenate(list(val_parts), axis=0)
+            return (lax.dynamic_slice_in_dim(ts_all, row0, nrows, axis=0),
+                    lax.dynamic_slice_in_dim(val_all, row0, nrows, axis=0))
+        _MESH_STAGE_FN = stage
+    return _MESH_STAGE_FN(ts_parts, val_parts, row0, nrows=nrows)
 
 
 def _ids_fingerprint(part_ids) -> int:
@@ -280,6 +321,9 @@ class DeviceGridCache:
         # uniform-phase vector for the frozen block range (see
         # _phase_device); stale keys never match, single-entry by design
         self._phase_memo: dict[tuple, tuple] = {}
+        # mesh staging memo: (row0, nrows) -> (parts identity, staged
+        # ts, staged vals) — see mesh_plan
+        self._mesh_stage_memo: dict[tuple, tuple] = {}
         self._seq = 0
         self._lock = threading.Lock()
         # stats
@@ -432,6 +476,52 @@ class DeviceGridCache:
                 return {"count": both[1]}
             return {"sum": both[0], "count": both[1]}
         return {op: np.asarray(out, dtype=np.float64)}
+
+    def mesh_plan(self, part_ids: Sequence[int], func: F, steps0: int,
+                  nsteps: int, step_ms: int, window_ms: int,
+                  group_ids: Sequence[int], num_groups: int,
+                  fargs: tuple = ()):
+        """Plan + device-RESIDENT staging for the SPMD mesh serving path
+        (parallel/meshgrid.py): the composition of the device grid with
+        the shard-axis mesh (VERDICT r2 #1).  Returns a MeshShardPlan
+        whose staged arrays live on this shard's pinned device — the
+        mesh program reads them in place, zero per-query host upload —
+        or None to fall back to the host-batch mesh path.
+
+        Staging (block concat + row slice) runs once per (range,
+        version) and is memoized by block identity, so a repeat
+        dashboard query performs no device work here at all."""
+        if self.hist or func not in _GRID_OPS:
+            return None
+        op = _GRID_OPS[func]
+        if op in _REBASE_OPS or len(fargs) != _ARG_OPS.get(op, 0):
+            return None
+        with self._lock:
+            plan = self._plan_locked(part_ids, func, steps0, nsteps,
+                                     step_ms, window_ms, fargs)
+            if plan is None or not plan.segs:
+                return None
+            key = (plan.row0, plan.nrows)
+            parts_id = tuple(id(b) for b in plan.segs)
+            memo = self._mesh_stage_memo.get(key)
+            if memo is not None and memo[0] == parts_id:
+                _, ts_st, val_st, segs_ref = memo
+            else:
+                ts_st, val_st = _mesh_stage(
+                    tuple(b.ts for b in plan.segs),
+                    tuple(b.vals for b in plan.segs),
+                    plan.row0, nrows=plan.nrows)
+                if len(self._mesh_stage_memo) > 4:
+                    self._mesh_stage_memo.clear()
+                # hold the block refs: id() stays unambiguous while the
+                # memo entry lives
+                self._mesh_stage_memo[key] = (parts_id, ts_st, val_st,
+                                              plan.segs)
+            garr = np.full(plan.ncols, num_groups, dtype=np.int32)
+            garr[plan.lane_idx] = np.asarray(group_ids, dtype=np.int32)
+            return MeshShardPlan(ts_st, val_st, plan.phase, garr, plan.q,
+                                 plan.steps0_rel, plan.ncols,
+                                 self._shard.grid_device)
 
     def _scan_rate_locked(self, part_ids, func, steps0, nsteps, step_ms,
                           window_ms, fargs=()):
@@ -683,7 +773,7 @@ class DeviceGridCache:
         return _GridPlan(ts_parts,
                          tuple(b.vals for b in segments), row0,
                          steps0 - self.epoch0, q, lane_mult, nrows, ncols,
-                         prep["lane_idx"], phase_dev)
+                         prep["lane_idx"], phase_dev, tuple(segments))
 
     def _phase_device(self, ph_req, req, ncols: int, key) -> object:
         """Device [ncols] phase vector for the uniform-phase kernels,
@@ -706,7 +796,7 @@ class DeviceGridCache:
         else:
             ph_cols = np.ones(ncols, np.int32)
             ph_cols[req] = phases
-        dev = jax.device_put(ph_cols)
+        dev = jax.device_put(ph_cols, self._shard.grid_device)
         self._phase_memo.clear()
         self._phase_memo[key] = (ph_cols, dev)
         return dev
@@ -849,7 +939,9 @@ class DeviceGridCache:
         ph = ts_stage.astype(np.int64) - cstart
         pmin = np.where(fin, ph, 2**31).min(axis=0).astype(np.int32)
         pmax = np.where(fin, ph, -1).max(axis=0).astype(np.int32)
-        return _Block(jax.device_put(ts_stage), jax.device_put(val_stage),
+        dev = self._shard.grid_device      # mesh-pinned; None = default
+        return _Block(jax.device_put(ts_stage, dev),
+                      jax.device_put(val_stage, dev),
                       lanes, self._seq, (fmin, fmax, fcnt), (pmin, pmax))
 
     def _reclaim(self, target_bytes: int, keep: set) -> int:

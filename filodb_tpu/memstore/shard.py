@@ -121,6 +121,11 @@ class TimeSeriesShard:
         # device-resident chunk grids (HBM arena; memstore/devicestore.py),
         # one per (schema, value column); created lazily on first grid scan
         self.device_caches: dict = {}
+        # mesh placement: when set (a jax Device), this shard's grid
+        # blocks live on THAT device so the SPMD mesh serving path
+        # (parallel/meshgrid.py) reads them in place — the multi-device
+        # analog of BlockManager-resident serving
+        self.grid_device = None
         # monotone counter observed by the device caches' tail versioning:
         # bumped whenever new rows or chunks could change query results
         self.ingest_epoch = 0
@@ -626,6 +631,19 @@ class TimeSeriesShard:
         return cache.scan_rate_grouped(ids, func, steps0, nsteps, step_ms,
                                        window_ms, group_ids, num_groups, op,
                                        fargs)
+
+    def mesh_grid_plan(self, part_ids: Sequence[int], func, steps0: int,
+                       nsteps: int, step_ms: int, window_ms: int,
+                       group_ids: Sequence[int], num_groups: int,
+                       fargs: tuple = ()):
+        """Device-resident staging for the SPMD mesh serving path
+        (devicestore.mesh_plan); None -> host-batch mesh fallback."""
+        got = self._grid_cache_for(part_ids, None)
+        if got is None:
+            return None
+        cache, ids = got
+        return cache.mesh_plan(ids, func, steps0, nsteps, step_ms,
+                               window_ms, group_ids, num_groups, fargs)
 
     def scan_batch(self, part_ids: Sequence[int], start_time: int, end_time: int,
                    column_id: Optional[int] = None
