@@ -506,7 +506,11 @@ class PartKeyIndex:
     def label_names(self, filters: Sequence[ColumnFilter] = (),
                     start_time: int = 0, end_time: int = _NO_END) -> list[str]:
         if not filters:
-            return sorted(k for k, lab in self._labels.items() if lab.vcount)
+            # writers mutate _labels / vcount under _lock; snapshot under
+            # it so a concurrent add_partkey can't resize mid-iteration
+            with self._lock:
+                return sorted(k for k, lab in list(self._labels.items())
+                              if lab.vcount)
         names: set[str] = set()
         for pid in self.part_ids_from_filters(filters, start_time, end_time):
             names.update(self._tags[int(pid)].keys())
@@ -518,8 +522,9 @@ class PartKeyIndex:
         """Distinct values of one label (reference: labelValuesEfficient
         faceting when unfiltered; filtered path scans matching docs)."""
         if not filters:
-            lab = self._labels.get(label)
-            out = sorted(lab.vcount.keys()) if lab is not None else []
+            with self._lock:
+                lab = self._labels.get(label)
+                out = sorted(lab.vcount.keys()) if lab is not None else []
         else:
             vals: set[str] = set()
             for pid in self.part_ids_from_filters(filters, start_time, end_time):

@@ -634,8 +634,7 @@ class TimeSeriesShard:
 
     def mesh_grid_plan(self, part_ids: Sequence[int], func, steps0: int,
                        nsteps: int, step_ms: int, window_ms: int,
-                       group_ids: Sequence[int], num_groups: int,
-                       fargs: tuple = ()):
+                       group_ids: Sequence[int], fargs: tuple = ()):
         """Device-resident staging for the SPMD mesh serving path
         (devicestore.mesh_plan); None -> host-batch mesh fallback."""
         got = self._grid_cache_for(part_ids, None)
@@ -643,7 +642,25 @@ class TimeSeriesShard:
             return None
         cache, ids = got
         return cache.mesh_plan(ids, func, steps0, nsteps, step_ms,
-                               window_ms, group_ids, num_groups, fargs)
+                               window_ms, group_ids, fargs)
+
+    def pin_grid_device(self, device) -> None:
+        """Pin this shard's grid blocks to a mesh device so the SPMD
+        serving path (parallel/meshgrid.py) reads them in place — the
+        multi-device analog of BlockManager-resident serving.  Re-pins
+        invalidate resident blocks (they live on the old device); the
+        common single-device -> mesh transition, where blocks already
+        sit on the backend default device, keeps them."""
+        if device is self.grid_device:
+            return
+        prev = self.grid_device
+        self.grid_device = device
+        if prev is None:
+            import jax
+            if device is jax.devices()[0]:
+                return          # unpinned blocks already live there
+        for cache in list(self.device_caches.values()):
+            cache.note_repin()
 
     def scan_batch(self, part_ids: Sequence[int], start_time: int, end_time: int,
                    column_id: Optional[int] = None
