@@ -375,7 +375,8 @@ class SingleClusterPlanner(QueryPlanner):
             inner.start_ms, inner.step_ms, inner.end_ms, plan.operator,
             window_ms=window, function=function, function_args=args,
             offset_ms=inner.offset_ms or 0, by=plan.by,
-            without=plan.without, query_context=qctx, engine=engine)
+            without=plan.without, params=plan.params, query_context=qctx,
+            engine=engine)
         # remote shards: the ordinary per-shard construction (_periodic
         # builds leaf+mapper exactly as the non-mesh path would)
         mapred = AggregateMapReduce(plan.operator, plan.params, plan.by,

@@ -1,0 +1,92 @@
+"""Device-side (jax) t-digest construction for mesh quantile partials.
+
+The host t-digest (query/tdigest.py) is the batched numpy form the
+aggregation layer merges and presents; this module is its jax twin so
+the SPMD mesh program can SKETCH ON DEVICE: each device digests its
+local shards' windowed values ([S, T] -> [G, T, C] centroids), the
+digests ride one all_gather, and a final on-device compress folds the
+per-device sketches — only O(G*T*C) crosses the host link no matter the
+series cardinality (reference: QuantileRowAggregator's TDigest partial
+rows, query/src/main/scala/filodb/query/exec/aggregator/
+RowAggregator.scala:114-141).
+
+Same k1 scale function and binning as the numpy implementation, so
+device-built digests merge losslessly with host-built ones in
+QuantileAggregator.reduce.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def compress(means, weights, compression: int):
+    """Compress [..., N] centroid sets to C slots (jax twin of
+    tdigest._compress).  NaN means / zero weights are empty slots."""
+    import jax.numpy as jnp
+
+    order = jnp.argsort(means, axis=-1)            # NaNs sort last
+    m = jnp.take_along_axis(means, order, axis=-1)
+    w = jnp.take_along_axis(weights, order, axis=-1)
+    w = jnp.where(jnp.isfinite(m), w, 0.0)
+    total = w.sum(axis=-1, keepdims=True)
+    cumw = jnp.cumsum(w, axis=-1)
+    qmid = jnp.where(total > 0,
+                     (cumw - w / 2.0) / jnp.maximum(total, 1e-300), 0.0)
+    q = jnp.clip(qmid, 0.0, 1.0)
+    kval = compression / np.pi * (jnp.arcsin(2.0 * q - 1.0) + np.pi / 2.0)
+    kidx = jnp.clip(kval.astype(jnp.int32), 0, compression - 1)
+    lead = means.shape[:-1]
+    out_shape = (*lead, compression)
+    # scatter-add centroids into their k-bins, all cells at once
+    idx = tuple(jnp.arange(n).reshape(
+        *([1] * i), n, *([1] * (len(lead) - i)))
+        for i, n in enumerate(lead))
+    wm = w * jnp.where(jnp.isfinite(m), m, 0.0)
+    w_out = jnp.zeros(out_shape, w.dtype).at[(*idx, kidx)].add(w)
+    wm_out = jnp.zeros(out_shape, w.dtype).at[(*idx, kidx)].add(wm)
+    m_out = jnp.where(w_out > 0, wm_out / jnp.maximum(w_out, 1e-300),
+                      jnp.nan)
+    return m_out, w_out
+
+
+def digest_from_series(vals, ids, num_groups: int, compression: int):
+    """Per-(group, step) digests from windowed series values on device.
+
+    ``vals`` [S, T] (NaN = no sample), ``ids`` [S] group per series
+    (out-of-range ids land in a dropped spare group).  Processes series
+    in slabs of C under ``lax.scan`` so peak memory is O(G*T*2C)
+    regardless of S (jax twin of tdigest.from_values, which documents
+    the same slab invariant).  Returns (means, weights) [G, T, C]."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    S, T = vals.shape
+    G1 = num_groups + 1                            # + drop group
+    C = compression
+    slab = C
+    nslab = max(-(-S // slab), 1)
+    Sp = nslab * slab
+    vpad = jnp.pad(vals, ((0, Sp - S), (0, 0)), constant_values=jnp.nan)
+    ipad = jnp.clip(jnp.pad(ids, (0, Sp - S),
+                            constant_values=num_groups), 0, num_groups)
+    vs = vpad.reshape(nslab, slab, T)
+    gs = ipad.reshape(nslab, slab)
+    m0 = jnp.full((G1, T, C), jnp.nan, vals.dtype)
+    w0 = jnp.zeros((G1, T, C), vals.dtype)
+    jj = jnp.arange(slab)
+
+    def body(carry, xs):
+        m, w = carry
+        sv, sid = xs                               # [slab, T], [slab]
+        # series j of the slab owns member slot j of its group
+        mem_m = jnp.full((G1, T, slab), jnp.nan,
+                         vals.dtype).at[sid, :, jj].set(sv)
+        mem_w = jnp.zeros((G1, T, slab), vals.dtype).at[sid, :, jj].set(
+            jnp.isfinite(sv).astype(vals.dtype))
+        m2, w2 = compress(jnp.concatenate([m, mem_m], axis=-1),
+                          jnp.concatenate([w, mem_w], axis=-1), C)
+        return (m2, w2), None
+
+    (m, w), _ = lax.scan(body, (m0, w0), (vs, gs))
+    return m[:num_groups], w[:num_groups]

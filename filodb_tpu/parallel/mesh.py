@@ -95,7 +95,7 @@ _INF = jnp.inf
 
 def _agg_map(op: Agg, vals, ids, G):
     """-> tuple of [G, T] partials, each combinable by a single collective."""
-    if op in (Agg.SUM, Agg.COUNT, Agg.AVG):
+    if op in (Agg.SUM, Agg.COUNT, Agg.AVG, Agg.GROUP):
         return _seg_sum_count(vals, ids, G)
     if op in (Agg.STDDEV, Agg.STDVAR):
         s, c = _seg_sum_count(vals, ids, G)
@@ -129,6 +129,9 @@ def _agg_present(op: Agg, partials):
     if op == Agg.AVG:
         s, c = partials
         return jnp.where(c > 0, s / jnp.maximum(c, 1.0), jnp.nan)
+    if op == Agg.GROUP:
+        _s, c = partials
+        return jnp.where(c > 0, 1.0, jnp.nan)
     if op in (Agg.STDDEV, Agg.STDVAR):
         s, c, s2 = partials
         mean = s / jnp.maximum(c, 1.0)
@@ -143,7 +146,7 @@ def _agg_present(op: Agg, partials):
 def partial_state_names(op: Agg) -> tuple[str, ...]:
     """Names of the raw partials each op's mesh program outputs (the
     ``_agg_map`` tuple order)."""
-    if op in (Agg.SUM, Agg.COUNT, Agg.AVG):
+    if op in (Agg.SUM, Agg.COUNT, Agg.AVG, Agg.GROUP):
         return ("sum", "count")
     if op in (Agg.STDDEV, Agg.STDVAR):
         return ("sum", "count", "sumsq")
@@ -159,7 +162,7 @@ def exported_state_names(op: Agg) -> tuple[str, ...]:
     in an AggPartialBatch (query/aggregators.py MomentAggregator._NEEDS).
     Exporting EXACTLY these keys matters: ``_align`` requires every
     partial in a reduce — mesh or remote — to carry the same state names."""
-    if op == Agg.COUNT:
+    if op in (Agg.COUNT, Agg.GROUP):
         return ("count",)
     return partial_state_names(op)
 
@@ -202,6 +205,155 @@ def _build_program(mesh_key, range_fn, agg_op: Agg, num_groups: int,
     return jax.jit(fn)
 
 
+def _shard_map_unchecked(fn, **kw):
+    """shard_map whose outputs are replicated by construction (an
+    all_gather + identical local math) — the static replication checker
+    can't infer that, so disable it where the kwarg exists."""
+    try:
+        return shard_map(fn, check_vma=False, **kw)
+    except TypeError:                                    # older jax
+        return shard_map(fn, **kw)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_topk_program(mesh_key, range_fn, num_groups: int, window_ms: int,
+                        wmax: int, extra_args: tuple, k: int, bottom: bool):
+    """topk/bottomk as a mesh partial: each device keeps k candidate
+    (value, global series index) slots per group per step from ITS
+    shards, the candidates ride one all_gather over the shard axis, and
+    every device re-selects the global top-k — the k-heap merge of the
+    reference's TopBottomKRowAggregator
+    (query/exec/aggregator/RowAggregator.scala:114-141), done as
+    lax.top_k over the gathered candidate axis."""
+    mesh = _MESHES[mesh_key]
+    nsh = mesh.devices.shape[0]
+    kind = rangefns.kernel_kind(range_fn)
+    kernel = rangefns.raw_kernel(range_fn)
+    G = num_groups
+
+    from filodb_tpu.ops import aggregate as segops
+
+    def local(ts, vals, ids, steps):
+        window = jnp.asarray(window_ms, dtype=ts.dtype)
+        if kind in ("last", "prefix"):
+            stepped = kernel(ts, vals, steps, window)
+        else:
+            stepped = kernel(ts, vals, steps, window, wmax, *extra_args)
+        rows_local = stepped.shape[0]
+        off = (lax.axis_index("shard") * rows_local).astype(jnp.int32)
+        v, si = segops.seg_topk(stepped, ids, G + 1, k, bottom=bottom)
+        v, si = v[:G], si[:G]
+        si = jnp.where(si >= 0, si + off, -1)
+        allv = lax.all_gather(v, "shard")          # [nsh, G, k, Tl]
+        alli = lax.all_gather(si, "shard")
+        Tl = stepped.shape[1]
+        V = jnp.moveaxis(allv, 0, 1).reshape(G, nsh * k, Tl)
+        I = jnp.moveaxis(alli, 0, 1).reshape(G, nsh * k, Tl)
+        sign = -1.0 if bottom else 1.0
+        work = jnp.where(jnp.isfinite(V), V * sign, -jnp.inf)
+        topv, topc = lax.top_k(jnp.moveaxis(work, 1, 2), k)  # [G, Tl, k]
+        found = jnp.isfinite(topv)
+        topi = jnp.take_along_axis(jnp.moveaxis(I, 1, 2), topc, axis=2)
+        values = jnp.moveaxis(jnp.where(found, topv * sign, jnp.nan), 1, 2)
+        sidx = jnp.moveaxis(jnp.where(found, topi, -1), 1, 2)
+        return values, sidx                        # [G, k, Tl] each
+
+    fn = _shard_map_unchecked(
+        local, mesh=mesh,
+        in_specs=(P("shard", None), P("shard", None), P("shard"), P("step")),
+        out_specs=(P(None, None, "step"), P(None, None, "step")))
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_quantile_program(mesh_key, range_fn, num_groups: int,
+                            window_ms: int, wmax: int, extra_args: tuple,
+                            compression: int):
+    """quantile as a mesh partial: every device SKETCHES its local
+    shards' windowed values into per-(group, step) t-digests on device,
+    the [G, T, C] digests ride one all_gather, and a final on-device
+    compress folds them — only the merged sketch crosses the host link
+    (the reference's TDigest partial rows, RowAggregator.scala:114-141,
+    over ICI instead of Kryo)."""
+    mesh = _MESHES[mesh_key]
+    kind = rangefns.kernel_kind(range_fn)
+    kernel = rangefns.raw_kernel(range_fn)
+    G, C = num_groups, compression
+
+    from filodb_tpu.ops import tdigest_device as tdd
+
+    def local(ts, vals, ids, steps):
+        window = jnp.asarray(window_ms, dtype=ts.dtype)
+        if kind in ("last", "prefix"):
+            stepped = kernel(ts, vals, steps, window)
+        else:
+            stepped = kernel(ts, vals, steps, window, wmax, *extra_args)
+        m, w = tdd.digest_from_series(stepped, ids, G, C)   # [G, Tl, C]
+        allm = lax.all_gather(m, "shard")          # [nsh, G, Tl, C]
+        allw = lax.all_gather(w, "shard")
+        nsh, _, Tl, _ = allm.shape
+        M = jnp.moveaxis(allm, 0, 2).reshape(G, Tl, nsh * C)
+        W = jnp.moveaxis(allw, 0, 2).reshape(G, Tl, nsh * C)
+        return tdd.compress(M, W, C)               # [G, Tl, C] each
+
+    fn = _shard_map_unchecked(
+        local, mesh=mesh,
+        in_specs=(P("shard", None), P("shard", None), P("shard"), P("step")),
+        out_specs=(P(None, "step", None), P(None, "step", None)))
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_values_program(mesh_key, range_fn, window_ms: int, wmax: int,
+                          extra_args: tuple):
+    """scan+window only, stepped values stay row-sharded: the mesh leaf
+    for aggregates whose output cardinality is data-dependent
+    (count_values) — the host maps the readback into member partials."""
+    mesh = _MESHES[mesh_key]
+    kind = rangefns.kernel_kind(range_fn)
+    kernel = rangefns.raw_kernel(range_fn)
+
+    def local(ts, vals, steps):
+        window = jnp.asarray(window_ms, dtype=ts.dtype)
+        if kind in ("last", "prefix"):
+            return kernel(ts, vals, steps, window)
+        return kernel(ts, vals, steps, window, wmax, *extra_args)
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P("shard", None), P("shard", None), P("step")),
+        out_specs=P("shard", "step"))
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_hist_program(mesh_key, range_fn, num_groups: int,
+                        window_ms: int):
+    """First-class histogram columns IN the mesh program: the per-bucket
+    window kernel runs over [rows, R, B] locally, bucket-wise group sums
+    and the live-row count psum over the shard axis (the reference's
+    HistSumRowAggregator reduce, bucket lanes riding ICI)."""
+    mesh = _MESHES[mesh_key]
+    kernel = rangefns.hist_kernel(range_fn)
+    G = num_groups
+
+    def local(ts, hist, ids, steps):
+        window = jnp.asarray(window_ms, dtype=ts.dtype)
+        stepped = kernel(ts, hist, steps, window)   # [rows, Tl, B]
+        fin = jnp.isfinite(stepped[..., -1])        # live iff top bucket
+        hs = jax.ops.segment_sum(
+            jnp.where(fin[..., None], stepped, 0.0), ids, G + 1)[:G]
+        n = jax.ops.segment_sum(fin.astype(stepped.dtype), ids, G + 1)[:G]
+        return lax.psum(hs, "shard"), lax.psum(n, "shard")
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P("shard", None), P("shard", None, None), P("shard"),
+                  P("step")),
+        out_specs=(P(None, "step", None), P(None, "step")))
+    return jax.jit(fn)
+
+
 # shard_map needs the Mesh object at trace time but lru_cache needs hashable
 # keys; registry keyed by id-like tuple.
 _MESHES: dict = {}
@@ -239,23 +391,39 @@ class MeshEngine:
         return jax.device_put(arr, NamedSharding(self.mesh, spec))
 
     def stack_shards(self, shard_batches: Sequence[ChunkBatch],
-                     group_ids: Sequence[np.ndarray]):
+                     group_ids: Sequence[np.ndarray], hist: bool = False):
         """[K shards of [S_k, R_k]] -> ([K, S, R] ts/vals, [K, S] ids) padded
-        so K divides the shard-axis size and S, R are common."""
+        so K divides the shard-axis size and S, R are common.  With
+        ``hist=True`` the value plane is the per-bucket matrix
+        [K, S, R, B] instead (narrower cumulative schemes edge-pad to
+        the widest B: the top bucket IS the total, the
+        _align_hist_widths convention)."""
         K = len(shard_batches)
         kd = self.num_shard_slices
         Kp = ((K + kd - 1) // kd) * kd if K else kd
         S = max((b.num_series for b in shard_batches), default=1)
         R = max((b.max_rows for b in shard_batches), default=1)
         ts = np.full((Kp, S, R), TS_PAD, dtype=np.int64)
-        vals = np.full((Kp, S, R), np.nan, dtype=np.float64)
+        if hist:
+            B = max((b.hist.shape[2] for b in shard_batches), default=1)
+            vals = np.full((Kp, S, R, B), np.nan, dtype=np.float64)
+        else:
+            vals = np.full((Kp, S, R), np.nan, dtype=np.float64)
         # group id for padded series: 0 — harmless because their stepped
         # values are NaN and every _agg_map drops non-finite entries.
         ids = np.zeros((Kp, S), dtype=np.int32)
         for k, (b, gid) in enumerate(zip(shard_batches, group_ids)):
             s, r = b.timestamps.shape
             ts[k, :s, :r] = b.timestamps
-            vals[k, :s, :r] = b.values
+            if hist:
+                h = b.hist
+                if h.shape[2] < vals.shape[3]:
+                    h = np.pad(h, ((0, 0), (0, 0),
+                                   (0, vals.shape[3] - h.shape[2])),
+                               mode="edge")
+                vals[k, :s, :r] = h
+            else:
+                vals[k, :s, :r] = b.values
             ids[k, :len(gid)] = gid
         return ts, vals, ids
 
@@ -274,7 +442,8 @@ class MeshEngine:
                  window_ms: int, range_fn):
         """Shared input prep: stack + flatten shards, pad steps, derive
         wmax, place onto the mesh.  Returns (d_ts, d_vals, d_ids,
-        d_steps, wmax, T)."""
+        d_steps, wmax, T, (Kp, S)) — the layout tuple lets callers map
+        flattened global row index k*S+s back to (shard, series)."""
         ts, vals, ids = self.stack_shards(shard_batches, group_ids)
         K, S, R = ts.shape
         ts = ts.reshape(K * S, R)
@@ -288,7 +457,7 @@ class MeshEngine:
         return (self._place(ts, P("shard", None)),
                 self._place(vals, P("shard", None)),
                 self._place(ids, P("shard")),
-                self._place(steps_np, P("step")), wmax, T)
+                self._place(steps_np, P("step")), wmax, T, (K, S))
 
     def window_aggregate(self, shard_batches: Sequence[ChunkBatch],
                          group_ids: Sequence[np.ndarray], num_groups: int,
@@ -296,7 +465,7 @@ class MeshEngine:
                          range_fn=None, agg_op: Agg = Agg.SUM,
                          extra_args: tuple = ()) -> np.ndarray:
         """Full distributed pipeline -> [num_groups, T] on host."""
-        d_ts, d_vals, d_ids, d_steps, wmax, T = self._prepare(
+        d_ts, d_vals, d_ids, d_steps, wmax, T, _ = self._prepare(
             shard_batches, group_ids, srange, window_ms, range_fn)
         prog = _build_program(self._key, range_fn, agg_op, num_groups,
                               window_ms, wmax, extra_args)
@@ -312,7 +481,7 @@ class MeshEngine:
         state dict ({"sum": [G,T], "count": [G,T]}, ...) instead of the
         presented values — the form the host-side ReduceAggregateExec
         merges with partials from remote (HTTP-dispatched) shards."""
-        d_ts, d_vals, d_ids, d_steps, wmax, T = self._prepare(
+        d_ts, d_vals, d_ids, d_steps, wmax, T, _ = self._prepare(
             shard_batches, group_ids, srange, window_ms, range_fn)
         prog = _build_program(self._key, range_fn, agg_op, num_groups,
                               window_ms, wmax, extra_args, present=False)
@@ -330,6 +499,80 @@ class MeshEngine:
                 a = np.where(np.isfinite(a), a, np.nan)
             state[name] = a
         return state
+
+
+    def window_topk_partials(self, shard_batches, group_ids,
+                             num_groups: int, srange: StepRange,
+                             window_ms: int, k: int, bottom: bool,
+                             range_fn=None, extra_args: tuple = ()):
+        """topk/bottomk mesh partial: (values [G,k,T], sidx [G,k,T]
+        int32 global row index, layout (Kp, S)) — sidx indexes the
+        flattened (shard, series) grid the caller maps to series keys."""
+        d_ts, d_vals, d_ids, d_steps, wmax, T, layout = self._prepare(
+            shard_batches, group_ids, srange, window_ms, range_fn)
+        prog = _build_topk_program(self._key, range_fn, num_groups,
+                                   window_ms, wmax, extra_args, int(k),
+                                   bool(bottom))
+        v, si = prog(d_ts, d_vals, d_ids, d_steps)
+        return (np.asarray(v)[..., :T],
+                np.asarray(si).astype(np.int32)[..., :T], layout)
+
+    def window_quantile_partials(self, shard_batches, group_ids,
+                                 num_groups: int, srange: StepRange,
+                                 window_ms: int, range_fn=None,
+                                 extra_args: tuple = (),
+                                 compression: int = 128):
+        """quantile mesh partial: merged t-digests (means, weights)
+        [G, T, C] — the state QuantileAggregator.reduce merges with
+        host/remote digest or exact-member partials."""
+        d_ts, d_vals, d_ids, d_steps, wmax, T, _ = self._prepare(
+            shard_batches, group_ids, srange, window_ms, range_fn)
+        prog = _build_quantile_program(self._key, range_fn, num_groups,
+                                       window_ms, wmax, extra_args,
+                                       compression)
+        m, w = prog(d_ts, d_vals, d_ids, d_steps)
+        return np.asarray(m)[:, :T], np.asarray(w)[:, :T]
+
+    def window_values(self, shard_batches, srange: StepRange,
+                      window_ms: int, range_fn=None,
+                      extra_args: tuple = ()):
+        """scan+window on the mesh, stepped values read back [rows, T]
+        (count_values: output cardinality is data-dependent, the host
+        builds the member partial).  Returns (stepped, layout)."""
+        zeros = [np.zeros(b.num_series, np.int32) for b in shard_batches]
+        d_ts, d_vals, _ids, d_steps, wmax, T, layout = self._prepare(
+            shard_batches, zeros, srange, window_ms, range_fn)
+        prog = _build_values_program(self._key, range_fn, window_ms,
+                                     wmax, extra_args)
+        out = prog(d_ts, d_vals, d_steps)
+        return np.asarray(out)[:, :T], layout
+
+    def window_hist_partials(self, shard_batches, group_ids,
+                             num_groups: int, srange: StepRange,
+                             window_ms: int, range_fn=None):
+        """First-class histogram sum as a mesh partial: per-bucket
+        window kernel + bucket-wise group psum.  Returns the
+        MomentAggregator hist state ({"hist_sum": [G, T, B],
+        "count": [G, T]}) and the widest bucket_tops."""
+        tops = max((b.bucket_tops for b in shard_batches
+                    if b.bucket_tops is not None),
+                   key=len, default=None)
+        ts, hist, ids = self.stack_shards(shard_batches, group_ids,
+                                          hist=True)
+        Kp, S, R, B = hist.shape
+        steps_np = np.asarray(srange.timestamps(np.int64))
+        steps_np, T = self.pad_steps(steps_np)
+        d_ts = self._place(ts.reshape(Kp * S, R), P("shard", None))
+        d_hist = self._place(hist.reshape(Kp * S, R, B),
+                             P("shard", None, None))
+        d_ids = self._place(ids.reshape(Kp * S), P("shard"))
+        d_steps = self._place(steps_np, P("step"))
+        prog = _build_hist_program(self._key, range_fn, num_groups,
+                                   window_ms)
+        hs, n = prog(d_ts, d_hist, d_ids, d_steps)
+        return ({"hist_sum": np.asarray(hs)[:, :T],
+                 "count": np.asarray(n)[:, :T]},
+                np.asarray(tops) if tops is not None else None)
 
 
 _DEFAULT_ENGINE: Optional["MeshEngine"] = None

@@ -31,14 +31,27 @@ from filodb_tpu.query.model import QueryContext
 MESH_OPS = (AggregationOperator.SUM, AggregationOperator.COUNT,
             AggregationOperator.AVG, AggregationOperator.MIN,
             AggregationOperator.MAX, AggregationOperator.STDDEV,
-            AggregationOperator.STDVAR)
+            AggregationOperator.STDVAR, AggregationOperator.GROUP)
+# aggregates with a non-psum mesh partial: k-heap merge (topk/bottomk),
+# t-digest merge (quantile), member pass-through (count_values) — the
+# full RowAggregator family (reference RowAggregator.scala:114-141)
+_K_OPS = (AggregationOperator.TOPK, AggregationOperator.BOTTOMK)
+_MEMBER_OPS = (AggregationOperator.QUANTILE,
+               AggregationOperator.COUNT_VALUES)
 
 
 def mesh_supported(operator: AggregationOperator,
                    function: Optional[RangeFunctionId],
                    params: tuple) -> bool:
-    return (operator in MESH_OPS and not params
-            and rangefns.supported(function, hist=False))
+    if operator in _K_OPS:
+        ok = (len(params) == 1
+              and float(params[0]) == int(float(params[0]))
+              and int(float(params[0])) >= 1)
+    elif operator in _MEMBER_OPS:
+        ok = len(params) == 1
+    else:
+        ok = operator in MESH_OPS and not params
+    return ok and rangefns.supported(function, hist=False)
 
 
 class MeshAggregateExec(ExecPlan):
@@ -52,7 +65,7 @@ class MeshAggregateExec(ExecPlan):
                  function: Optional[RangeFunctionId] = None,
                  function_args: tuple = (), offset_ms: int = 0,
                  by: tuple = (), without: tuple = (),
-                 stale_ms: int = 300_000,
+                 params: tuple = (), stale_ms: int = 300_000,
                  query_context: Optional[QueryContext] = None,
                  engine=None):
         super().__init__(query_context)
@@ -71,6 +84,7 @@ class MeshAggregateExec(ExecPlan):
         self.offset_ms = offset_ms
         self.by = tuple(by)
         self.without = tuple(without)
+        self.params = tuple(params)
         self.stale_ms = stale_ms
         self._engine = engine
 
@@ -144,25 +158,33 @@ class MeshAggregateExec(ExecPlan):
                 if state is not None:
                     keys = [dict(k) for k in
                             list(union)[:num_grid_groups]]
-                    out.append(AggPartialBatch(self.operator, (), keys,
+                    out.append(AggPartialBatch(self.operator,
+                                               self.params, keys,
                                                report, state))
                     served = set(id(e) for e in planned)
                     host_entries = [e for e in entries
                                     if id(e) not in served]
 
         # -- phase 2: host-batch mesh path for the remaining shards
+        Agg = AggregationOperator
+        hist_in_mesh = (self.operator is Agg.SUM and not self.params
+                        and not self.function_args
+                        and rangefns.supported(self.function, hist=True))
         shard_batches = []
         group_ids = []
+        tags_lists = []
+        hist_batches = []
+        hist_gids = []
         host_partials: list = []
         for shard, shard_num, lookup in host_entries:
             tags_list, batch = shard.scan_batch(
                 lookup.part_ids, self.scan_start_ms, self.scan_end_ms)
             if batch is None:
                 continue                    # genuinely empty range
-            if batch.hist is not None:
-                # mesh program is scalar-only: this shard's histogram
-                # data must NOT be dropped — run the per-shard host path
-                # and merge its partial with the mesh partial below
+            if batch.hist is not None and not hist_in_mesh:
+                # histogram data under a shape the hist mesh program
+                # can't take must NOT be dropped — run the per-shard
+                # host path and merge its partial below
                 host_partials.extend(self._host_shard_partial(ctx,
                                                               shard_num))
                 continue
@@ -171,22 +193,101 @@ class MeshAggregateExec(ExecPlan):
                 key = tuple(sorted(grouping_key(tags, self.by,
                                                 self.without).items()))
                 gids[i] = union.setdefault(key, len(union))
-            shard_batches.append(batch)
-            group_ids.append(gids)
-        if not out and not shard_batches and not host_partials:
+            if batch.hist is not None:
+                hist_batches.append(batch)
+                hist_gids.append(gids)
+            else:
+                shard_batches.append(batch)
+                group_ids.append(gids)
+                tags_lists.append(tags_list)
+        if not out and not shard_batches and not hist_batches \
+                and not host_partials:
             return []
         if len(union) > limit:
             self._cardinality_error(ctx, len(union))
         out.extend(host_partials)
+        keys = [dict(k) for k in union]
+        G = max(len(union), 1)
+        if hist_batches:
+            state, tops = engine.window_hist_partials(
+                hist_batches, hist_gids, G, steps, window,
+                range_fn=self.function)
+            out.append(AggPartialBatch(self.operator, self.params, keys,
+                                       report, state, bucket_tops=tops))
         if shard_batches:
-            state = engine.window_aggregate_partials(
-                shard_batches, group_ids, max(len(union), 1), steps,
-                window, range_fn=self.function, agg_op=self.operator,
-                extra_args=self.function_args)
-            keys = [dict(k) for k in union]
-            out.append(AggPartialBatch(self.operator, (), keys, report,
-                                       state))
+            if self.operator in _K_OPS:
+                out.append(self._topk_partial(
+                    engine, shard_batches, group_ids, tags_lists, keys,
+                    steps, report, window))
+            elif self.operator is Agg.QUANTILE:
+                m, w = engine.window_quantile_partials(
+                    shard_batches, group_ids, G, steps, window,
+                    range_fn=self.function,
+                    extra_args=self.function_args)
+                out.append(AggPartialBatch(
+                    self.operator, self.params, keys, report,
+                    {"td_means": m, "td_weights": w}))
+            elif self.operator is Agg.COUNT_VALUES:
+                out.append(self._count_values_partial(
+                    engine, shard_batches, group_ids, tags_lists, keys,
+                    steps, report, window))
+            else:
+                state = engine.window_aggregate_partials(
+                    shard_batches, group_ids, G, steps, window,
+                    range_fn=self.function, agg_op=self.operator,
+                    extra_args=self.function_args)
+                out.append(AggPartialBatch(self.operator, self.params,
+                                           keys, report, state))
         return out
+
+    def _topk_partial(self, engine, shard_batches, group_ids, tags_lists,
+                      keys, steps, report, window) -> AggPartialBatch:
+        """topk/bottomk via the mesh k-heap program; sidx comes back as
+        global (shard, series) row indices which map onto the flattened
+        series-key list the reducer/presenter resolve against."""
+        from filodb_tpu.query.logical import AggregationOperator as Agg
+        k = int(float(self.params[0]))
+        v, si, (Kp, S) = engine.window_topk_partials(
+            shard_batches, group_ids, max(len(keys), 1), steps, window,
+            k, bottom=self.operator is Agg.BOTTOMK,
+            range_fn=self.function, extra_args=self.function_args)
+        series_keys: list[dict] = []
+        for kk in range(Kp):
+            tl = tags_lists[kk] if kk < len(tags_lists) else []
+            series_keys.extend(tl)
+            series_keys.extend({} for _ in range(S - len(tl)))
+        return AggPartialBatch(self.operator, self.params, keys, report,
+                               {"values": v, "sidx": si},
+                               series_keys=series_keys)
+
+    def _count_values_partial(self, engine, shard_batches, group_ids,
+                              tags_lists, keys, steps, report,
+                              window) -> AggPartialBatch:
+        """count_values: scan+window on the mesh, member matrix on host
+        (output cardinality is data-dependent — the reference's
+        CountValuesRowAggregator also passes exact values through)."""
+        stepped, (Kp, S) = engine.window_values(
+            shard_batches, steps, window, range_fn=self.function,
+            extra_args=self.function_args)
+        rows, ids = [], []
+        for kk, (tl, gid) in enumerate(zip(tags_lists, group_ids)):
+            for s in range(len(tl)):
+                rows.append(kk * S + s)
+            ids.extend(gid[:len(tl)])
+        vals = stepped[rows]                        # [S_real, T]
+        ids = np.asarray(ids, dtype=np.int64)
+        G = max(len(keys), 1)
+        T = vals.shape[1] if vals.size else len(report.timestamps())
+        counts = np.bincount(ids, minlength=G) if len(ids) \
+            else np.zeros(G, int)
+        M = int(counts.max()) if len(counts) else 0
+        dense = np.full((G, max(M, 1), T), np.nan)
+        pos = np.zeros(G, dtype=np.int64)
+        for s, g in enumerate(ids):
+            dense[g, pos[g]] = vals[s]
+            pos[g] += 1
+        return AggPartialBatch(self.operator, self.params, keys, report,
+                               {"members": dense})
 
     def _cardinality_error(self, ctx, n: int):
         from filodb_tpu.query.model import QueryError
@@ -229,5 +330,5 @@ class MeshAggregateExec(ExecPlan):
             window_ms=self.window_ms, function=self.function,
             function_args=self.function_args, offset_ms=self.offset_ms))
         leaf.add_transformer(AggregateMapReduce(
-            self.operator, (), self.by, self.without))
+            self.operator, self.params, self.by, self.without))
         return list(leaf.execute(ctx).batches)

@@ -131,6 +131,12 @@ def raw_kernel(func: Optional[F]):
     return _PREFIX.get(func) or _GATHER[func]
 
 
+def hist_kernel(func: Optional[F]):
+    """Per-bucket window kernel for first-class histogram columns
+    ([S, R] ts + [S, R, B] buckets -> [S, T, B])."""
+    return _HIST[func]
+
+
 def bucket_wmax(ts, steps, window) -> int:
     """Max rows in any window, rounded to a 16-multiple shape bucket."""
     wmax = windows.max_window_rows(jnp.asarray(ts), jnp.asarray(steps),

@@ -81,6 +81,18 @@ QUERIES = [
     'min by (grp)(mm{_ws_="w",_ns_="n"})',
     'stddev(mm{_ws_="w",_ns_="n"})',
     'sum by (grp)(increase(mm{_ws_="w",_ns_="n"}[2m]))',
+    'group(mm{_ws_="w",_ns_="n"})',
+    'group by (grp)(mm{_ws_="w",_ns_="n"})',
+]
+
+# the non-psum RowAggregator family: k-heap merge, member pass-through
+FAMILY_QUERIES = [
+    'topk(2, rate(mm{_ws_="w",_ns_="n"}[2m]))',
+    'topk(3, mm{_ws_="w",_ns_="n"})',
+    'bottomk(2, mm{_ws_="w",_ns_="n"})',
+    'topk by (grp) (2, mm{_ws_="w",_ns_="n"})',
+    'count_values("v", mm{_ws_="w",_ns_="n"})',
+    'count_values by (grp) ("v", mm{_ws_="w",_ns_="n"})',
 ]
 
 
@@ -114,6 +126,11 @@ class TestMeshPathEquivalence:
         'count(mm{_ws_="w",_ns_="n"})',       # COUNT exports only "count"
         'stddev(mm{_ws_="w",_ns_="n"})',
         'max by (grp)(mm{_ws_="w",_ns_="n"})',
+        'group(mm{_ws_="w",_ns_="n"})',
+        # non-psum family: mesh partial must merge with the remote
+        # shard's host-mapped partial (k-heap / member union)
+        'topk by (grp) (2, mm{_ws_="w",_ns_="n"})',
+        'count_values("v", mm{_ws_="w",_ns_="n"})',
     ])
     def test_mixed_local_remote(self, loaded, promql):
         """Shards behind a non-in-process dispatcher stay per-shard
@@ -151,6 +168,122 @@ class TestMeshPathEquivalence:
         for k in plain:
             np.testing.assert_allclose(out[k], plain[k][1],
                                        rtol=1e-9, equal_nan=True)
+
+    @pytest.mark.parametrize("promql", FAMILY_QUERIES)
+    def test_family_matches_per_shard_path(self, loaded, promql):
+        """topk/bottomk/count_values mesh partials must be observably
+        identical to the per-shard path (k-heap merge / exact member
+        pass-through are lossless)."""
+        ms, mapper = loaded
+        start = BASE + 300_000
+        end = BASE + 900_000
+        plain = _run(_planner(mapper), ms, promql, start, end)
+        fused = _run(_planner(mapper, mesh=True), ms, promql, start, end)
+        assert set(fused) == set(plain) and plain
+        for k in plain:
+            np.testing.assert_allclose(fused[k][1], plain[k][1],
+                                       rtol=1e-9, atol=1e-12,
+                                       equal_nan=True, err_msg=str(k))
+
+    def test_family_plan_uses_mesh_node(self, loaded):
+        ms, mapper = loaded
+        planner = _planner(mapper, mesh=True)
+        for promql in (FAMILY_QUERIES[0], FAMILY_QUERIES[4],
+                       'quantile(0.9, mm{_ws_="w",_ns_="n"})'):
+            plan = query_range_to_logical_plan(
+                promql, BASE + 300_000, 30_000, BASE + 900_000)
+            tree = planner.materialize(plan, QueryContext()).print_tree()
+            assert "MeshAggregateExec" in tree, promql
+
+    def test_quantile_digest_close_to_exact(self, loaded):
+        """The mesh quantile partial is a t-digest sketch; the per-shard
+        path is exact at this cardinality.  The estimates must agree to
+        sketch accuracy and carry identical shape/keys."""
+        ms, mapper = loaded
+        start, end = BASE + 300_000, BASE + 900_000
+        for promql in ('quantile(0.9, mm{_ws_="w",_ns_="n"})',
+                       'quantile by (grp) (0.5, mm{_ws_="w",_ns_="n"})'):
+            plain = _run(_planner(mapper), ms, promql, start, end)
+            fused = _run(_planner(mapper, mesh=True), ms, promql,
+                         start, end)
+            assert set(fused) == set(plain) and plain, promql
+            for k in plain:
+                pv, fv = plain[k][1], fused[k][1]
+                assert (np.isfinite(pv) == np.isfinite(fv)).all(), k
+                fin = np.isfinite(pv)
+                np.testing.assert_allclose(fv[fin], pv[fin], rtol=0.08,
+                                           err_msg=f"{promql} {k}")
+
+    def test_histogram_served_in_mesh_program(self, loaded):
+        """First-class histogram sum runs IN the mesh program (bucket
+        lanes + psum), identical to the per-shard host path."""
+        from tests.data import histogram_containers
+
+        ms2 = TimeSeriesMemStore()
+        mapper = ShardMapper(NUM_SHARDS)
+        for s in range(NUM_SHARDS):
+            ms2.setup("prom", DEFAULT_SCHEMAS, s)
+        for shard_num in (0, 1, 2):
+            for off, c in enumerate(histogram_containers(
+                    n_series=2, n_samples=40, metric="hq",
+                    seed=shard_num)):
+                ms2.get_shard("prom", shard_num).ingest_container(c, off)
+        from tests.data import START_TS
+        start, end = START_TS + 200_000, START_TS + 390_000
+        for promql in ('sum(rate(hq{_ws_="demo",_ns_="App-0"}[2m]))',
+                       'sum(increase(hq{_ws_="demo",_ns_="App-0"}[2m]))',
+                       'sum(hq{_ws_="demo",_ns_="App-0"})'):
+            plain = _run(_planner(mapper), ms2, promql, start, end)
+            fused = _run(_planner(mapper, mesh=True), ms2, promql,
+                         start, end)
+            assert set(fused) == set(plain) and plain, promql
+            for k in plain:
+                np.testing.assert_allclose(fused[k][1], plain[k][1],
+                                           rtol=1e-6, equal_nan=True,
+                                           err_msg=f"{promql} {k}")
+
+    def test_parameterized_op_over_histogram_falls_back_with_params(self):
+        """topk over a histogram metric can't run in the hist mesh
+        program (SUM-only); the per-shard fallback must carry the
+        aggregation params (k) instead of dropping them."""
+        from tests.data import START_TS, histogram_containers
+
+        ms2 = TimeSeriesMemStore()
+        mapper = ShardMapper(NUM_SHARDS)
+        for s in range(NUM_SHARDS):
+            ms2.setup("prom", DEFAULT_SCHEMAS, s)
+        for shard_num in (0, 1):
+            for off, c in enumerate(histogram_containers(
+                    n_series=2, n_samples=40, metric="hp",
+                    seed=shard_num)):
+                ms2.get_shard("prom", shard_num).ingest_container(c, off)
+        promql = 'topk(1, sum_over_time(hp{_ws_="demo",_ns_="App-0"}[1m]))'
+        start, end = START_TS + 200_000, START_TS + 390_000
+        plain = _run(_planner(mapper), ms2, promql, start, end)
+        fused = _run(_planner(mapper, mesh=True), ms2, promql, start, end)
+        assert set(fused) == set(plain)
+
+    def test_group_present_program(self, loaded):
+        """window_aggregate (present=True) must present GROUP as
+        1-where-live, consistent with the partials path."""
+        from filodb_tpu.core.chunk import build_batch
+        from filodb_tpu.ops.windows import StepRange
+        from filodb_tpu.query.logical import AggregationOperator as Agg
+
+        rng = np.random.default_rng(3)
+        ts = [np.arange(30, dtype=np.int64) * 10_000 + 5_000
+              for _ in range(4)]
+        vs = [np.cumsum(rng.random(30)) for _ in range(4)]
+        batches = [build_batch(ts[:2], vs[:2]), build_batch(ts[2:], vs[2:])]
+        gids = [np.array([0, 1], np.int32), np.array([0, 1], np.int32)]
+        engine = MeshEngine(make_mesh())
+        out = engine.window_aggregate(
+            batches, gids, num_groups=2,
+            srange=StepRange(100_000, 280_000, 30_000),
+            window_ms=300_000, range_fn=None, agg_op=Agg.GROUP)
+        assert out.shape[0] == 2
+        assert np.all(out[np.isfinite(out)] == 1.0)
+        assert np.isfinite(out).any()
 
     def test_histogram_shards_fall_back_to_host_path(self, loaded):
         """The mesh program is scalar-only; shards holding histogram data
