@@ -30,7 +30,33 @@ def main():
         f"({8 * N / len(dd_blob):.1f}x), xor: {len(dbl_blob)}B "
         f"({8 * N / len(dbl_blob):.1f}x)")
     emit("delta2 compression ratio", 8 * N / len(dd_blob), "x")
-    emit("xor-double compression ratio", 8 * N / len(dbl_blob), "x")
+    emit("xor-double compression ratio (iid noise)",
+         8 * N / len(dbl_blob), "x")
+
+    # realistic gauge streams (the Gorilla paper's production shape:
+    # ~half the samples repeat, moves are small and quantized) — the
+    # bit-level Gorilla/XOR selector must land >=2x here
+    steps = rng.choice([0.0, 0.0, 0.0, 0.5, -0.5, 1.0, -1.0, 0.25],
+                       size=N,
+                       p=[.3, .15, .1, .12, .12, .08, .08, .05])
+    walk = 100.0 + np.cumsum(steps)
+    walk_blob = doublecodec.encode(walk)
+    emit("double compression ratio (gauge walk)",
+         8 * N / len(walk_blob), "x")
+    flat = np.repeat(rng.normal(40, 5, 600),
+                     rng.integers(100, 250, 600))[:N] + 0.125
+    flat_blob = doublecodec.encode(flat)
+    emit("double compression ratio (flat gauge)",
+         8 * N / len(flat_blob), "x")
+    t = timed(lambda: doublecodec.encode(walk))
+    emit("double encode (gauge walk)", N / t, "samples/sec")
+    t = timed(lambda: doublecodec.decode(walk_blob))
+    emit("double decode (gauge walk)", N / t, "samples/sec")
+    from filodb_tpu.codecs.wire import WireType
+    assert flat_blob[0] == WireType.GORILLA_DOUBLE, \
+        "selector regressed: flat gauge no longer picks GORILLA_DOUBLE"
+    t = timed(lambda: doublecodec.decode(flat_blob))
+    emit("gorilla decode (flat gauge)", N / t, "samples/sec")
 
     t_enc = timed(lambda: deltadelta.encode(ts))
     emit("delta2 encode", N / t_enc, "samples/sec")

@@ -6,13 +6,30 @@ memory/src/main/scala/filodb.memory/format/vectors/DoubleVector.scala:14):
 - all values integral and line-like  -> route through the DELTA2 long codec
   (``DELTA2_DOUBLE``), the common case for counters ingested as doubles;
 - constant vectors -> ``CONST_DOUBLE``;
-- otherwise -> Gorilla-style previous-value XOR predictor whose u64 residual
-  stream is NibblePacked (``XOR_DOUBLE``; doc/compression.md "Floating Point
-  Compression" lists XOR as the predictor feeding NibblePack).
+- otherwise -> previous-value XOR predictor, residuals stored as the
+  SMALLER of two forms: bit-level Gorilla windows (``GORILLA_DOUBLE``)
+  or NibblePack (``XOR_DOUBLE``; doc/compression.md "Floating Point
+  Compression").
+
+``GORILLA_DOUBLE`` keeps Gorilla's information layout — 1 bit for a
+repeat, leading-zero count + significant length + significant bits
+otherwise (the reference's time-series paper lineage) — but in a
+STRUCTURE-OF-ARRAYS stream instead of one sequential bit tape:
+
+    [n u32][nnz u32][zero-bitmap ceil(n/8)]
+    [12-bit headers: clz(6) | siglen-1(6), one per nonzero]
+    [concatenated significant bits, LSB-first]
+
+Splitting control/header/payload planes makes BOTH encode and decode
+fully vectorizable (numpy today, a trivial TPU/pallas port tomorrow) —
+the classic Gorilla tape forces bit-serial decode.  On realistic gauge
+streams (repeats + slowly-moving mantissas) this lands the same >=2x
+the sequential format gets; on adversarial IID noise the NibblePack
+fallback wins and is chosen by size.
 
 NaN is used by ingestion as the "no data" sentinel, exactly like the
-reference's Prometheus schemas; NaNs survive round-trip bit-exactly through
-the XOR path.
+reference's Prometheus schemas; NaNs survive round-trip bit-exactly
+through the XOR paths.
 """
 
 from __future__ import annotations
@@ -27,6 +44,105 @@ from filodb_tpu.codecs.wire import WireType
 _N = struct.Struct("<I")
 
 _native = None  # set by filodb_tpu.native when the shared lib is importable
+
+_U64_1 = np.uint64(1)
+
+
+def _clz64(x: np.ndarray) -> np.ndarray:
+    """Vectorized count-leading-zeros for u64 (x > 0)."""
+    n = np.zeros(x.shape, np.uint64)
+    x = x.copy()
+    for shift in (32, 16, 8, 4, 2, 1):
+        s = np.uint64(shift)
+        hi = (x >> s) != 0
+        x = np.where(hi, x >> s, x)
+        n += np.where(hi, s, 0).astype(np.uint64)
+    return np.uint64(63) - n          # n ended as floor(log2(x))
+
+
+def _gorilla_plan(residuals: np.ndarray):
+    """Cheap per-value window analysis: (nz, clz, ctz, lens, nbytes).
+    The encoded size is closed-form from the windows alone, so the
+    encode selector can pick a winner WITHOUT materializing the (much
+    more expensive) bitstream of the loser."""
+    n = len(residuals)
+    nz = residuals != 0
+    nnz = int(nz.sum())
+    if nnz == 0:
+        nbytes = 2 * _N.size + (n + 7) // 8
+        return nz, None, None, None, nbytes
+    r = residuals[nz]
+    clz = _clz64(r)
+    lsb = _clz64(r & (~r + _U64_1))              # 63 - trailing_zeros
+    ctz = np.uint64(63) - lsb
+    lens = np.uint64(64) - clz - ctz             # significant bits, >= 1
+    total = int(lens.astype(np.int64).sum())
+    nbytes = (2 * _N.size + (n + 7) // 8 + (nnz * 12 + 7) // 8
+              + (total + 7) // 8)
+    return nz, clz, ctz, lens, nbytes
+
+
+def _gorilla_pack(residuals: np.ndarray, plan=None) -> bytes:
+    n = len(residuals)
+    nz, clz, ctz, lens, _ = plan if plan is not None \
+        else _gorilla_plan(residuals)
+    bitmap = np.packbits(nz, bitorder="little").tobytes()
+    if clz is None:
+        return _N.pack(n) + _N.pack(0) + bitmap
+    nnz = len(clz)
+    sig = residuals[nz] >> ctz
+    # 12-bit headers: clz(6) | len-1(6), fixed width -> one packbits
+    hdr = (clz << np.uint64(6)) | (lens - _U64_1)
+    hdr_bits = ((hdr[:, None] >> np.arange(12, dtype=np.uint64)) &
+                _U64_1).astype(np.uint8)
+    headers = np.packbits(hdr_bits.ravel(), bitorder="little").tobytes()
+    # significant-bit stream, LSB-first within each value
+    lens_i = lens.astype(np.int64)
+    offs = np.zeros(nnz, np.int64)
+    np.cumsum(lens_i[:-1], out=offs[1:] if nnz > 1 else offs[:0])
+    total = int(lens_i.sum())
+    pos = np.arange(total, dtype=np.int64) - np.repeat(offs, lens_i)
+    bits = ((np.repeat(sig, lens_i) >> pos.astype(np.uint64)) &
+            _U64_1).astype(np.uint8)
+    payload = np.packbits(bits, bitorder="little").tobytes()
+    return _N.pack(n) + _N.pack(nnz) + bitmap + headers + payload
+
+
+def _gorilla_unpack(buf, offset: int) -> np.ndarray:
+    (n,) = _N.unpack_from(buf, offset)
+    (nnz,) = _N.unpack_from(buf, offset + _N.size)
+    o = offset + 2 * _N.size
+    bm_bytes = (n + 7) // 8
+    nz = np.unpackbits(np.frombuffer(buf, np.uint8, bm_bytes, o),
+                       bitorder="little")[:n].astype(bool)
+    o += bm_bytes
+    residuals = np.zeros(n, np.uint64)
+    if nnz:
+        hdr_bytes = (nnz * 12 + 7) // 8
+        hbits = np.unpackbits(
+            np.frombuffer(buf, np.uint8, hdr_bytes, o),
+            bitorder="little")[:nnz * 12].astype(np.uint64)
+        hdr = (hbits.reshape(nnz, 12)
+               << np.arange(12, dtype=np.uint64)).sum(axis=1)
+        o += hdr_bytes
+        clz = hdr >> np.uint64(6)
+        lens = (hdr & np.uint64(63)) + _U64_1
+        ctz = np.uint64(64) - clz - lens
+        lens_i = lens.astype(np.int64)
+        total = int(lens_i.sum())
+        sig_bytes = (total + 7) // 8
+        sbits = np.unpackbits(
+            np.frombuffer(buf, np.uint8, sig_bytes, o),
+            bitorder="little")[:total].astype(np.uint64)
+        offs = np.zeros(nnz, np.int64)
+        np.cumsum(lens_i[:-1], out=offs[1:] if nnz > 1 else offs[:0])
+        pos = (np.arange(total, dtype=np.int64)
+               - np.repeat(offs, lens_i)).astype(np.uint64)
+        weighted = sbits << pos
+        sig = np.add.reduceat(weighted, offs)
+        residuals[nz] = sig << ctz
+    bits = np.bitwise_xor.accumulate(residuals)
+    return bits.view(np.float64)
 
 
 def encode(values: np.ndarray) -> bytes:
@@ -43,7 +159,12 @@ def encode(values: np.ndarray) -> bytes:
     bits = v.view(np.uint64)
     prev = np.concatenate([[np.uint64(0)], bits[:-1]])
     residuals = bits ^ prev
-    return bytes([WireType.XOR_DOUBLE]) + _N.pack(n) + nibblepack.pack(residuals)
+    packed = nibblepack.pack(residuals)
+    plan = _gorilla_plan(residuals)
+    if plan[-1] <= len(packed) + _N.size:
+        return bytes([WireType.GORILLA_DOUBLE]) \
+            + _gorilla_pack(residuals, plan)
+    return bytes([WireType.XOR_DOUBLE]) + _N.pack(n) + packed
 
 
 def decode(buf: bytes) -> np.ndarray:
@@ -54,6 +175,8 @@ def decode(buf: bytes) -> np.ndarray:
         (n,) = _N.unpack_from(buf, 1)
         (val,) = struct.unpack_from("<d", buf, 1 + _N.size)
         return np.full(n, val, dtype=np.float64)
+    if wire == WireType.GORILLA_DOUBLE:
+        return _gorilla_unpack(buf, 1)
     if wire != WireType.XOR_DOUBLE:
         raise ValueError(f"not a double vector: wire type {wire}")
     (n,) = _N.unpack_from(buf, 1)

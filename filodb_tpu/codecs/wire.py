@@ -24,6 +24,7 @@ class WireType(enum.IntEnum):
     XOR_DOUBLE = 17     # previous-value XOR predictor + nibble-packed residuals
     RAW_DOUBLE = 18     # uncompressed little-endian float64
     CONST_DOUBLE = 19
+    GORILLA_DOUBLE = 20  # XOR predictor + bit-level Gorilla windows (SoA)
     # Histograms
     HIST_2D_DELTA = 32  # per-row delta vs previous row, nibble-packed sections
     HIST_BLOB = 33      # single-sample BinaryHistogram blob (ingest wire form)
