@@ -91,8 +91,10 @@ from filodb_tpu.memstore.memstore import TimeSeriesMemStore  # noqa: E402
 from filodb_tpu.store.persistence import (DiskColumnStore,  # noqa: E402
                                           DiskMetaStore)
 
-N_SERIES = 200
-N_ROWS = 720             # 1h of 5s scrapes per series
+N_SERIES = 500
+N_ROWS = 4320            # 6h of 5s scrapes: the reference downsampler's
+#                          typical batch window (userTimeOverride 6h
+#                          batches, DownsamplerMain.scala)
 T0 = 1_600_000_000_000
 STEP = 5_000
 RESOLUTIONS = (60_000, 900_000, 3_600_000)   # 1m / 15m / 1h

@@ -27,6 +27,15 @@ from filodb_tpu.codecs.wire import WireType
 _HDR = struct.Struct("<Iqq")
 
 _native = None  # set by filodb_tpu.native when the shared lib is importable
+_native_enc = None  # batch-encode hook (flush/downsample hot loop)
+
+
+def encode_batch(arrays) -> list[bytes]:
+    """Encode many int64 vectors; ONE native call when available (the
+    per-vector Python overhead dominates small downsample chunks)."""
+    if _native_enc is not None:
+        return _native_enc.ll_encode_batch(arrays)
+    return [encode(a) for a in arrays]
 
 
 def encode(values: np.ndarray) -> bytes:

@@ -48,16 +48,22 @@ _native = None  # set by filodb_tpu.native when the shared lib is importable
 _U64_1 = np.uint64(1)
 
 
-def _clz64(x: np.ndarray) -> np.ndarray:
-    """Vectorized count-leading-zeros for u64 (x > 0)."""
-    n = np.zeros(x.shape, np.uint64)
-    x = x.copy()
-    for shift in (32, 16, 8, 4, 2, 1):
-        s = np.uint64(shift)
-        hi = (x >> s) != 0
-        x = np.where(hi, x >> s, x)
-        n += np.where(hi, s, 0).astype(np.uint64)
-    return np.uint64(63) - n          # n ended as floor(log2(x))
+def encode_batch(arrays) -> list[bytes]:
+    """Encode many float64 vectors with the full selector; ONE native
+    call when available (the flush/downsample hot loop)."""
+    if _native is not None and hasattr(_native, "dbl_encode_batch"):
+        return _native.dbl_encode_batch(arrays)
+    return [encode(np.asarray(a, dtype=np.float64)) for a in arrays]
+
+
+def _bit_length64(x: np.ndarray) -> np.ndarray:
+    """Vectorized exact bit length of u64 (0 -> 0): frexp on the 32-bit
+    halves (each exact in f64) — one pass instead of a shift cascade."""
+    hi = (x >> np.uint64(32)).astype(np.float64)
+    lo = (x & np.uint64(0xFFFFFFFF)).astype(np.float64)
+    _, ehi = np.frexp(hi)
+    _, elo = np.frexp(lo)
+    return np.where(hi > 0, ehi + 32, elo).astype(np.uint64)
 
 
 def _gorilla_plan(residuals: np.ndarray):
@@ -72,10 +78,10 @@ def _gorilla_plan(residuals: np.ndarray):
         nbytes = 2 * _N.size + (n + 7) // 8
         return nz, None, None, None, nbytes
     r = residuals[nz]
-    clz = _clz64(r)
-    lsb = _clz64(r & (~r + _U64_1))              # 63 - trailing_zeros
-    ctz = np.uint64(63) - lsb
-    lens = np.uint64(64) - clz - ctz             # significant bits, >= 1
+    bl = _bit_length64(r)
+    clz = np.uint64(64) - bl
+    ctz = _bit_length64(r & (~r + _U64_1)) - _U64_1  # lowest set bit idx
+    lens = bl - ctz                              # significant bits, >= 1
     total = int(lens.astype(np.int64).sum())
     nbytes = (2 * _N.size + (n + 7) // 8 + (nnz * 12 + 7) // 8
               + (total + 7) // 8)

@@ -59,31 +59,122 @@ def encode_chunkset(schema: Schema, partkey: bytes, timestamps: np.ndarray,
     ``columns`` are the non-timestamp data columns in schema order; histogram
     columns take ``(HistogramBuckets, int64[rows, buckets])`` tuples.
     """
-    ts = np.ascontiguousarray(timestamps, dtype=np.int64)
-    n = len(ts)
+    return encode_chunksets_batch(
+        schema, [(partkey, timestamps, columns, ingestion_seq)])[0]
+
+
+def encode_chunksets_batch(schema: Schema, items: Sequence[tuple]
+                           ) -> list[ChunkSet]:
+    """Encode MANY chunksets with two native batch-encode calls total
+    (one per numeric family) — the offline downsampler's write side,
+    where per-chunkset call overhead dominates small rollup chunks
+    (reference: BatchDownsampler.downsampleBatch re-encode loop).
+
+    ``items``: (partkey, timestamps, columns, ingestion_seq) tuples with
+    the same column contract as :func:`encode_chunkset`."""
     data_cols = schema.data.columns[1:]
-    if len(columns) != len(data_cols):
-        raise ValueError(f"schema {schema.name} expects {len(data_cols)} data columns, "
-                         f"got {len(columns)}")
-    vectors = [deltadelta.encode(ts)]
-    for col, data in zip(data_cols, columns):
-        rows = data[1] if col.ctype == ColumnType.HISTOGRAM else data
-        if len(rows) != n:
-            raise ValueError(f"column {col.name}: {len(rows)} rows != {n} timestamps")
-        if col.ctype == ColumnType.DOUBLE:
-            vectors.append(doublecodec.encode(np.asarray(data, dtype=np.float64)))
-        elif col.ctype in (ColumnType.LONG, ColumnType.TIMESTAMP, ColumnType.INT):
-            vectors.append(deltadelta.encode(np.asarray(data, dtype=np.int64)))
-        elif col.ctype == ColumnType.HISTOGRAM:
-            buckets, hrows = data
-            vectors.append(histcodec.encode(buckets, np.asarray(hrows)))
-        elif col.ctype == ColumnType.STRING:
-            vectors.append(strcodec.encode_utf8(list(data)))
-        else:
-            raise ValueError(f"unsupported column type {col.ctype}")
-    info = ChunkSetInfo(chunk_id(int(ts[0]) if n else 0, ingestion_seq), n,
-                        int(ts[0]) if n else 0, int(ts[-1]) if n else 0)
-    return ChunkSet(info, partkey, vectors, schema_hash=schema.schema_hash)
+    ll_arrays, dbl_arrays = [], []
+    plans = []          # per item: list of ("ll"/"dbl"/"done", idx/blob)
+    items = [(pk, np.ascontiguousarray(ts, dtype=np.int64), cols, seq)
+             for pk, ts, cols, seq in items]
+    for partkey, ts, columns, seq in items:
+        n = len(ts)
+        if len(columns) != len(data_cols):
+            raise ValueError(
+                f"schema {schema.name} expects {len(data_cols)} data "
+                f"columns, got {len(columns)}")
+        plan = [("ll", len(ll_arrays))]
+        ll_arrays.append(ts)
+        for col, data in zip(data_cols, columns):
+            rows = data[1] if col.ctype == ColumnType.HISTOGRAM else data
+            if len(rows) != n:
+                raise ValueError(f"column {col.name}: {len(rows)} rows "
+                                 f"!= {n} timestamps")
+            if col.ctype == ColumnType.DOUBLE:
+                plan.append(("dbl", len(dbl_arrays)))
+                dbl_arrays.append(np.asarray(data, dtype=np.float64))
+            elif col.ctype in (ColumnType.LONG, ColumnType.TIMESTAMP,
+                               ColumnType.INT):
+                plan.append(("ll", len(ll_arrays)))
+                ll_arrays.append(np.asarray(data, dtype=np.int64))
+            elif col.ctype == ColumnType.HISTOGRAM:
+                buckets, hrows = data
+                plan.append(("done",
+                             histcodec.encode(buckets, np.asarray(hrows))))
+            elif col.ctype == ColumnType.STRING:
+                plan.append(("done", strcodec.encode_utf8(list(data))))
+            else:
+                raise ValueError(f"unsupported column type {col.ctype}")
+        plans.append(plan)
+    ll_blobs = deltadelta.encode_batch(ll_arrays)
+    dbl_blobs = doublecodec.encode_batch(dbl_arrays) if dbl_arrays else []
+    out = []
+    for (partkey, ts, _columns, seq), plan in zip(items, plans):
+        vectors = [ll_blobs[p[1]] if p[0] == "ll"
+                   else dbl_blobs[p[1]] if p[0] == "dbl" else p[1]
+                   for p in plan]
+        n = len(ts)
+        t0 = int(ts[0]) if n else 0
+        info = ChunkSetInfo(chunk_id(t0, seq), n, t0,
+                            int(ts[-1]) if n else 0)
+        out.append(ChunkSet(info, partkey, vectors,
+                            schema_hash=schema.schema_hash))
+    return out
+
+
+def decode_partitions_batch(schema: Schema, groups: Sequence[Sequence[ChunkSet]]
+                            ) -> list[tuple[np.ndarray, list]]:
+    """Decode partitions of chunk-ordered ChunkSets, returning ONE
+    contiguous (ts, cols) per partition.  Blobs are batched COLUMN-major
+    into the native decoder, so each partition's chunks land in adjacent
+    output spans and the cross-chunk concatenation is a zero-copy view —
+    the batch downsampler's read side (reference: BatchDownsampler
+    chunkset iteration, spark-jobs BatchDownsampler.scala:36)."""
+    from filodb_tpu import native
+    nb = native.batch_decoder()
+    numeric = (ColumnType.TIMESTAMP, ColumnType.LONG, ColumnType.INT,
+               ColumnType.DOUBLE)
+    if nb is None or any(c.ctype not in numeric
+                         for c in schema.data.columns[1:]):
+        out = []
+        for css in groups:
+            parts = [decode_chunkset(schema, cs) for cs in css]
+            ts = np.concatenate([p[0] for p in parts]) if parts \
+                else np.empty(0, np.int64)
+            cols = []
+            for ci in range(len(schema.data.columns) - 1):
+                vals = [p[1][ci] for p in parts]
+                if vals and isinstance(vals[0], tuple):
+                    cols.append((vals[0][0],
+                                 np.concatenate([v[1] for v in vals])))
+                elif vals and isinstance(vals[0], list):
+                    cols.append(sum(vals, []))
+                else:
+                    cols.append(np.concatenate(vals) if vals
+                                else np.empty(0))
+            out.append((ts, cols))
+        return out
+    data_cols = schema.data.columns[1:]
+    counts = [cs.info.num_rows for css in groups for cs in css]
+    spans = np.zeros(len(groups) + 1, np.int64)
+    np.cumsum([sum(cs.info.num_rows for cs in css) for css in groups],
+              out=spans[1:])
+
+    def column(j: int, dbl: bool):
+        blobs = [cs.vectors[j] for css in groups for cs in css]
+        flat = (nb.dbl_decode_batch if dbl
+                else nb.ll_decode_batch)(blobs, counts)
+        base = flat[0].base if flat else None  # one buffer; spans view it
+        if base is None:
+            return [np.empty(0) for _ in groups]
+        whole = base[:spans[-1]]
+        return [whole[spans[i]:spans[i + 1]] for i in range(len(groups))]
+
+    ts_views = column(0, dbl=False)
+    col_views = [column(j, dbl=(col.ctype == ColumnType.DOUBLE))
+                 for j, col in enumerate(data_cols, start=1)]
+    return [(ts_views[g], [cv[g] for cv in col_views])
+            for g in range(len(groups))]
 
 
 def decode_column(blob: bytes, ctype: ColumnType):

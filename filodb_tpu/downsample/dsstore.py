@@ -107,38 +107,73 @@ class BatchDownsampler:
         ingestion_end] (one Spark work item; reference:
         Downsampler.run RDD over shard × time splits).
 
+        Direct chunk-build path: downsampled series arrays are encoded
+        into ChunkSets and written to the per-resolution datasets in ONE
+        store call each — the reference's Spark BatchDownsampler writes
+        chunksets straight to Cassandra (DownsamplerMain.scala:43,
+        BatchDownsampler.downsampleBatch), never re-ingesting through a
+        memstore, and the batch encode rides the native codec path.
+
         Returns {resolution: chunksets_written}."""
         from filodb_tpu.core.record import parse_partkey
 
-        publisher = MemoryDownsamplePublisher()
         samplers: dict[int, ShardDownsampler] = {}
         by_schema: dict[int, list] = {}
-        for cs in self.store.chunksets_by_ingestion_time(
+        tags_memo: dict[bytes, dict] = {}    # partkey parses once, not
+        for cs in self.store.chunksets_by_ingestion_time(  # per chunk
                 self.raw_dataset, shard_num, ingestion_start, ingestion_end):
             schema = self._schema_for(cs)
             if schema is None or schema.downsample is None:
                 continue
-            tags = parse_partkey(cs.partkey)
+            tags = tags_memo.get(cs.partkey)
+            if tags is None:
+                tags = tags_memo[cs.partkey] = parse_partkey(cs.partkey)
             by_schema.setdefault(schema.schema_hash, []).append((tags, cs))
             if schema.schema_hash not in samplers:
+                # publisher=None: the batch job builds chunksets
+                # directly (downsample_arrays), it never publishes
                 samplers[schema.schema_hash] = ShardDownsampler(
-                    self.raw_dataset, shard_num, schema, publisher,
+                    self.raw_dataset, shard_num, schema, None,
                     self.resolutions)
-        for h, pairs in by_schema.items():
-            samplers[h].downsample_chunksets(pairs)
 
-        # re-ingest published records into per-resolution shards and flush
-        # their chunks to the downsample datasets
+        prepared = {h: samplers[h].prepare_arrays(pairs)
+                    for h, pairs in by_schema.items()}
         written: dict[int, int] = {}
+        with self.store.deferred_commits():
+            self._write_resolutions(shard_num, ingestion_end, by_schema,
+                                    samplers, prepared, written)
+        return written
+
+    def _write_resolutions(self, shard_num, ingestion_end, by_schema,
+                           samplers, prepared, written) -> None:
+        from filodb_tpu.core.chunk import encode_chunksets_batch
+        from filodb_tpu.core.record import canonical_partkey
+        from filodb_tpu.store.columnstore import PartKeyRecord
         for res in self.resolutions:
             ds_name = ds_dataset_name(self.raw_dataset, res)
-            mem = TimeSeriesMemStore(self.store)
-            mem.setup(ds_name, self.schemas, shard_num, self.config)
-            for sh, container in publisher.drain(res):
-                mem.ingest(ds_name, sh, container, offset=0)
-            written[res] = mem.get_shard(ds_name, shard_num).flush_all(
-                ingestion_time=ingestion_end)
-        return written
+            chunksets = []
+            pkrecs = []
+            for h in by_schema:
+                sampler = samplers[h]
+                if not sampler.enabled:
+                    continue
+                ds_schema = sampler.ds_schema
+                items = []
+                for tags, ts_arr, cols in sampler.downsample_arrays(
+                        prepared[h], res):
+                    pk = canonical_partkey(tags)
+                    items.append((pk, ts_arr, cols, 0))
+                    pkrecs.append(PartKeyRecord(
+                        pk, int(ts_arr[0]), int(ts_arr[-1]), shard_num,
+                        ds_schema.schema_hash))
+                chunksets.extend(encode_chunksets_batch(ds_schema, items))
+            if chunksets:
+                self.store.write_chunks(ds_name, shard_num, chunksets,
+                                        ingestion_end)
+                # widen, don't replace: a later ingestion window must
+                # not narrow the partkey's visible time range
+                self.store.merge_part_keys(ds_name, shard_num, pkrecs)
+            written[res] = len(chunksets)
 
     def _schema_for(self, cs) -> Optional[Schema]:
         if cs.schema_hash:

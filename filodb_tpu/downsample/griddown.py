@@ -72,6 +72,10 @@ class StagedGrid:
         self.present = present          # bool [B, S]: a sample occupies
         self.eligible = eligible        # bool [S]: one-per-bucket held
         self.has_reset = has_reset      # bool [S]: any value drop
+        # (col, K) -> reduced planes: coarser resolutions CASCADE from a
+        # finer one's planes instead of re-reducing the raw grid (the
+        # 1m -> 15m -> 1h rollup ladder costs ~one reduce, not three)
+        self.planes_cache: dict[tuple[int, int], dict] = {}
 
     @property
     def nrows(self) -> int:
@@ -117,6 +121,8 @@ def stage_grid(ts_list: Sequence[np.ndarray], cols_list: Sequence[Sequence],
     present = np.zeros((B, S), bool)
     eligible = np.ones(S, bool)
     has_reset = np.zeros(S, bool)
+    # per-series eligibility walk, then ONE scatter across the batch
+    rows_parts, scol_parts, col_parts = [], [], [[] for _ in range(ncols)]
     for s, (b, cols) in enumerate(zip(buckets_list, cols_list)):
         if len(b) == 0:
             continue
@@ -128,9 +134,17 @@ def stage_grid(ts_list: Sequence[np.ndarray], cols_list: Sequence[Sequence],
             with np.errstate(invalid="ignore"):
                 if (np.diff(cols[reset_col]) < 0).any():
                     has_reset[s] = True
-        present[rows, s] = True                # NaN-valued samples still
+        rows_parts.append(rows)
+        scol_parts.append(np.full(len(rows), s, np.int64))
+        for ci in range(ncols):
+            col_parts[ci].append(cols[ci])
+    if rows_parts:
+        rows_cat = np.concatenate(rows_parts)
+        scol_cat = np.concatenate(scol_parts)
+        present[rows_cat, scol_cat] = True     # NaN-valued samples still
         for ci in range(ncols):                # open their period (host
-            vals[ci][rows, s] = cols[ci]       # semantics)
+            vals[ci][rows_cat, scol_cat] = \
+                np.concatenate(col_parts[ci])  # semantics)
     return StagedGrid(g, c_start, vals, present, eligible, has_reset)
 
 
@@ -200,6 +214,42 @@ def _period_reduce_np(vals: np.ndarray, P: int, K: int
     }
 
 
+def _cascade_planes(pl: dict[str, np.ndarray], Pc: int, Kr: int
+                    ) -> dict[str, np.ndarray]:
+    """Derive coarse-period planes from fine-period planes: Kr fine
+    periods tile one coarse period.  min/max/count/last are EXACT
+    (order-insensitive); sum (and avg, re-derived from sum/count —
+    never avg-of-avgs) re-associates the floating-point summation tree,
+    so it can differ from a direct reduce in the low bits — within the
+    tolerance the downsample equivalence tests assert, not bit-identity."""
+    import warnings
+
+    def rs(a):
+        return a.reshape(Pc, Kr, -1)
+
+    cnt = rs(pl["cnt"]).sum(axis=1)
+    live = cnt > 0
+    nan = np.nan
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        vsum = np.nansum(rs(pl["sum"]), axis=1)
+        vmin = np.nanmin(rs(pl["min"]), axis=1)
+        vmax = np.nanmax(rs(pl["max"]), axis=1)
+    lf = rs(pl["last"])
+    kidx = np.arange(Kr, dtype=np.int32)[None, :, None]
+    last_k = np.where(np.isfinite(lf), kidx, -1).max(axis=1)
+    lastv = np.take_along_axis(lf, np.maximum(last_k, 0)[:, None, :],
+                               axis=1)[:, 0, :]
+    return {
+        "cnt": cnt,
+        "sum": np.where(live, vsum, nan),
+        "min": np.where(live, vmin, nan),
+        "max": np.where(live, vmax, nan),
+        "avg": np.where(live, vsum / np.maximum(cnt, 1.0), nan),
+        "last": np.where(live & (last_k >= 0), lastv, nan),
+    }
+
+
 _REDUCE_CACHE: dict = {}
 
 
@@ -241,13 +291,22 @@ def grid_outputs(staged: StagedGrid, res: int, downsamplers: Sequence,
         serve &= ~staged.has_reset
     if not serve.any():
         return None
-    # column -> reduced planes, computed lazily per distinct column
-    reduced: dict[int, dict[str, np.ndarray]] = {}
+    # column -> reduced planes: cascade from the finest already-reduced
+    # resolution whose K divides this one, else reduce the raw grid
+    cache = staged.planes_cache
 
     def planes(ci: int) -> dict[str, np.ndarray]:
-        got = reduced.get(ci)
-        if got is None:
-            got = reduced[ci] = period_reduce(staged.vals[ci], P, K)
+        got = cache.get((ci, K))
+        if got is not None:
+            return got
+        fine = [kf for (cj, kf) in cache
+                if cj == ci and kf < K and K % kf == 0]
+        if fine:
+            kf = max(fine)
+            got = _cascade_planes(cache[(ci, kf)], P, K // kf)
+        else:
+            got = period_reduce(staged.vals[ci], P, K)
+        cache[(ci, K)] = got
         return got
 
     period_ends = (staged.c_start - 1 + (np.arange(P) + 1) * K) * g

@@ -85,28 +85,7 @@ class ShardDownsampler:
         ONE record, not conflicting partials.  Returns records emitted."""
         if not self.enabled or not chunksets:
             return 0
-        # group by partition, decode once, concatenate in chunk-id order
-        by_pk: dict[bytes, list] = {}
-        for tags, cs in chunksets:
-            by_pk.setdefault(cs.partkey, [tags, []])[1].append(cs)
-        decoded = []
-        for pk, (tags, css) in by_pk.items():
-            css.sort(key=lambda c: c.info.chunk_id)
-            parts = [decode_chunkset(self.schema, cs) for cs in css]
-            ts = np.concatenate([p[0] for p in parts])
-            ncols = len(parts[0][1])
-            cols = []
-            for ci in range(ncols):
-                vals = [p[1][ci] for p in parts]
-                if isinstance(vals[0], tuple):  # histogram (buckets, rows)
-                    cols.append((vals[0][0],
-                                 np.concatenate([v[1] for v in vals])))
-                elif isinstance(vals[0], list):  # string column
-                    cols.append(sum(vals, []))
-                else:
-                    cols.append(np.concatenate(vals))
-            decoded.append((tags, ts, cols))
-
+        decoded = self._decode_concat(chunksets)
         staged = self._try_stage_grid(decoded)
         emitted = 0
         for res in self.resolutions:
@@ -127,6 +106,89 @@ class ShardDownsampler:
             if containers:
                 self.publisher.publish(res, self.shard, containers)
         return emitted
+
+    def _decode_concat(self, chunksets):
+        """Group (tags, chunkset) pairs by partition, decode once, and
+        concatenate in chunk-id order so a period spanning a mid-flush
+        chunk boundary yields ONE record, not conflicting partials."""
+        from filodb_tpu.core.chunk import decode_partitions_batch
+        by_pk: dict[bytes, list] = {}
+        for tags, cs in chunksets:
+            by_pk.setdefault(cs.partkey, [tags, []])[1].append(cs)
+        groups = []
+        for _pk, (_tags, css) in by_pk.items():
+            css.sort(key=lambda c: c.info.chunk_id)
+            groups.append(css)
+        parts = decode_partitions_batch(self.schema, groups)
+        return [(tags, ts, cols)
+                for (_pk, (tags, _css)), (ts, cols)
+                in zip(by_pk.items(), parts)]
+
+    def prepare_arrays(self, chunksets):
+        """Decode + grid-stage ONCE for use across every resolution
+        (the batch job re-uses one decode for the whole resolution
+        ladder).  Returns an opaque handle for :meth:`downsample_arrays`
+        or None when there is nothing to do."""
+        if not self.enabled or not chunksets:
+            return None
+        decoded = self._decode_concat(chunksets)
+        return decoded, self._try_stage_grid(decoded)
+
+    def downsample_arrays(self, prepared, resolution_ms: int):
+        """Batch-job form of :meth:`downsample_chunksets`: returns
+        per-series arrays ``(tags, ts [P] int64, cols)`` instead of
+        building records — the direct chunk-build path of the offline
+        downsampler (reference: the Spark BatchDownsampler writes
+        chunksets straight to the store, DownsamplerMain.scala:43,
+        never re-ingesting through a memstore).  ``cols`` entries are
+        float arrays, or (buckets, rows) for histogram outputs, in
+        downsample-schema column order (time column first)."""
+        if prepared is None:
+            return []
+        decoded, staged = prepared
+        served = None
+        results = []
+        if staged is not None:
+            got = griddown.grid_outputs(staged, resolution_ms,
+                                        self.downsamplers, self.marker)
+            if got is not None:
+                served, outs, pends, plive = got
+                # ONE host readback per plane: per-series fancy-indexing
+                # on device arrays would dispatch a jax op per series
+                outs = [np.asarray(o) if o is not None else None
+                        for o in outs]
+                pends = np.asarray(pends)
+                plive = np.asarray(plive)
+                for si, (tags, _ts, _cols) in enumerate(decoded):
+                    if not served[si]:
+                        continue
+                    pm = plive[:, si]
+                    if not pm.any():
+                        continue
+                    pe = pends[pm].astype(np.int64)
+                    cols = [out[pm, si] for out in outs if out is not None]
+                    results.append((tags, pe, cols))
+        for si, (tags, ts, cols) in enumerate(decoded):
+            if served is not None and served[si]:
+                continue
+            if len(ts) == 0:
+                continue
+            bounds, ends = self.marker.periods(ts, cols, resolution_ms)
+            if len(ends) == 0:
+                continue
+            outputs = [d.downsample(ts, cols, bounds, ends)
+                       for d in self.downsamplers]
+            t_col = None
+            val_cols = []
+            for d, out in zip(self.downsamplers, outputs):
+                if d.is_time:
+                    t_col = np.asarray(out, dtype=np.int64)
+                else:
+                    val_cols.append(out)
+            if t_col is None:
+                t_col = np.asarray(ends, dtype=np.int64)
+            results.append((tags, t_col, val_cols))
+        return results
 
     def _try_stage_grid(self, decoded):
         """Stage the whole batch as a [B, S] bucket grid when every

@@ -88,6 +88,14 @@ def _bind(lib):
     lib.xor_unpack.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
                                ctypes.c_size_t, ctypes.c_size_t,
                                ctypes.c_void_p]
+    for fn in (lib.ll_encode_batch, lib.dbl_encode_batch):
+        fn.restype = ctypes.c_longlong
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
+                       ctypes.c_void_p, ctypes.c_longlong, ctypes.c_void_p]
+    for fn in (lib.ll_decode_batch, lib.dbl_decode_batch):
+        fn.restype = ctypes.c_longlong
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
+                       ctypes.c_void_p, ctypes.c_void_p]
     return lib
 
 
@@ -150,7 +158,8 @@ class _DeltaDeltaNative:
 
 
 class _XorNative:
-    """Adapter for doublecodec's ``_native`` hook: fused XOR-chain decode."""
+    """Adapter for doublecodec's ``_native`` hook: fused XOR-chain decode
+    + batch double encode (the flush/downsample hot loop)."""
 
     def __init__(self, lib):
         self._lib = lib
@@ -162,6 +171,75 @@ class _XorNative:
         if nxt < 0:
             raise ValueError("corrupt XOR double vector")
         return out[:count]
+
+    def dbl_encode_batch(self, arrays) -> list[bytes]:
+        return _encode_batch(self._lib.dbl_encode_batch, arrays,
+                             np.float64)
+
+
+class _LLEncodeNative:
+    """Adapter for deltadelta's batch-encode hook."""
+
+    def __init__(self, lib):
+        self._lib = lib
+
+    def ll_encode_batch(self, arrays) -> list[bytes]:
+        return _encode_batch(self._lib.ll_encode_batch, arrays,
+                             np.int64)
+
+
+class _BatchDecodeNative:
+    """Adapter for chunk.py's batch column decode: one native call per
+    numeric family over many blobs (ODP page-in / batch downsampler)."""
+
+    def __init__(self, lib):
+        self._lib = lib
+
+    def _decode(self, fn, blobs, counts, dtype):
+        nvec = len(blobs)
+        offs = np.zeros(nvec + 1, dtype=np.int64)
+        np.cumsum([len(b) for b in blobs], out=offs[1:])
+        buf = np.frombuffer(b"".join(blobs), dtype=np.uint8) \
+            if offs[-1] else np.empty(0, np.uint8)
+        out_offs = np.zeros(nvec + 1, dtype=np.int64)
+        np.cumsum(counts, out=out_offs[1:])
+        out = np.empty(max(int(out_offs[-1]), 1), dtype=dtype)
+        got = fn(buf.ctypes.data if len(buf) else None, offs.ctypes.data,
+                 nvec, out.ctypes.data, out_offs.ctypes.data)
+        if got < 0:
+            raise ValueError("corrupt vector in batch decode")
+        return [out[out_offs[i]:out_offs[i + 1]] for i in range(nvec)]
+
+    def ll_decode_batch(self, blobs, counts) -> list[np.ndarray]:
+        return self._decode(self._lib.ll_decode_batch, blobs, counts,
+                            np.int64)
+
+    def dbl_decode_batch(self, blobs, counts) -> list[np.ndarray]:
+        return self._decode(self._lib.dbl_decode_batch, blobs, counts,
+                            np.float64)
+
+
+def _encode_batch(fn, arrays, dtype) -> list[bytes]:
+    nvec = len(arrays)
+    if nvec == 0:
+        return []
+    lens = np.array([len(a) for a in arrays], dtype=np.int64)
+    starts = np.zeros(nvec + 1, dtype=np.int64)
+    np.cumsum(lens, out=starts[1:])
+    flat = np.ascontiguousarray(
+        np.concatenate([np.asarray(a, dtype).ravel() for a in arrays])
+        if starts[-1] else np.empty(0, dtype))
+    # per-vector worst case: nested headers (<=26B) + the nibblepack
+    # bound ((n+7)//8 groups * 66B), closed-form — no per-vector FFI
+    cap = int((26 + ((lens + 7) // 8) * 66).sum())
+    out = np.empty(max(cap, 1), dtype=np.uint8)
+    offs = np.empty(nvec + 1, dtype=np.int64)
+    total = fn(flat.ctypes.data if len(flat) else None, starts.ctypes.data,
+               nvec, out.ctypes.data, len(out), offs.ctypes.data)
+    if total < 0:
+        raise ValueError("native batch encode overflow")
+    buf = out[:total].tobytes()
+    return [buf[offs[i]:offs[i + 1]] for i in range(nvec)]
 
 
 def enable() -> bool:
@@ -175,7 +253,10 @@ def enable() -> bool:
     nibblepack._native = _NibbleNative(lib)
     deltadelta._native = _DeltaDeltaNative(lib, int(WireType.CONST_LONG),
                                            int(WireType.DELTA2))
+    deltadelta._native_enc = _LLEncodeNative(lib)
     doublecodec._native = _XorNative(lib)
+    global _batch_dec
+    _batch_dec = _BatchDecodeNative(lib)
     return True
 
 
@@ -184,7 +265,20 @@ def disable() -> None:
 
     nibblepack._native = None
     deltadelta._native = None
+    deltadelta._native_enc = None
     doublecodec._native = None
+    global _batch_dec
+    _batch_dec = None
+
+
+_batch_dec = None
+
+
+def batch_decoder():
+    """The batch column-decode adapter, or None when native is off.
+    Looked up lazily by core/chunk.py — enable() runs during the codecs
+    package import, when core.chunk cannot be imported yet."""
+    return _batch_dec
 
 
 def is_enabled() -> bool:

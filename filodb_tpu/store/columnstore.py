@@ -44,6 +44,35 @@ class ColumnStore:
                         records: Sequence[PartKeyRecord]) -> int:
         raise NotImplementedError
 
+    def merge_part_keys(self, dataset: str, shard: int,
+                        records: Sequence["PartKeyRecord"]) -> int:
+        """Upsert partkeys WIDENING the stored lifetime (min start, max
+        end) instead of replacing it — the batch downsampler writes one
+        ingestion window at a time, and a later window must not narrow a
+        partkey's visible range (write_part_keys replaces, which is
+        right for the memstore flush path that recomputes full
+        lifetimes).  Default: read-modify-write via scan_part_keys."""
+        existing = {r.partkey: r for r in self.scan_part_keys(dataset,
+                                                              shard)}
+        merged = []
+        for r in records:
+            old = existing.get(r.partkey)
+            if old is not None:
+                r = PartKeyRecord(r.partkey,
+                                  min(old.start_time, r.start_time),
+                                  max(old.end_time, r.end_time),
+                                  r.shard, r.schema_hash)
+            merged.append(r)
+        return self.write_part_keys(dataset, shard, merged)
+
+    def deferred_commits(self):
+        """Context manager batching the durability point of the write
+        calls inside it into ONE commit at exit (the batch downsampler's
+        many small per-resolution writes).  Default: no-op — stores
+        whose writes are already atomic per call need nothing."""
+        import contextlib
+        return contextlib.nullcontext()
+
     # -- source (ODP / recovery path) ---------------------------------------
 
     def read_raw_partitions(self, dataset: str, shard: int,

@@ -207,7 +207,7 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
             [(dataset, shard, cs.partkey, cs.info.chunk_id, cs.info.num_rows,
               cs.info.start_time, cs.info.end_time, ingestion_time,
               cs.schema_hash, pack_vectors(cs.vectors)) for cs in chunksets])
-        conn.commit()
+        self._commit(conn)
         return len(chunksets)
 
     def write_part_keys(self, dataset, shard, records) -> int:
@@ -216,8 +216,46 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
             "INSERT OR REPLACE INTO partkeys VALUES (?,?,?,?,?,?)",
             [(dataset, shard, r.partkey, r.start_time, r.end_time,
               r.schema_hash) for r in records])
-        conn.commit()
+        self._commit(conn)
         return len(records)
+
+    def merge_part_keys(self, dataset, shard, records) -> int:
+        conn = self._conn()
+        conn.executemany(
+            "INSERT INTO partkeys VALUES (?,?,?,?,?,?) "
+            "ON CONFLICT(dataset, shard, partkey) DO UPDATE SET "
+            "start_time=MIN(start_time, excluded.start_time), "
+            "end_time=MAX(end_time, excluded.end_time), "
+            "schema_hash=excluded.schema_hash",
+            [(dataset, shard, r.partkey, r.start_time, r.end_time,
+              r.schema_hash) for r in records])
+        self._commit(conn)
+        return len(records)
+
+    def _commit(self, conn) -> None:
+        if not getattr(self._local, "defer_commits", False):
+            conn.commit()
+
+    def deferred_commits(self):
+        """One durability point for a batch of write calls (thread-local:
+        the flag never leaks to other threads' connections)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._local.defer_commits = True
+            try:
+                yield
+            except BaseException:
+                # the batch failed mid-way: roll the partial writes
+                # back — ONE durability point means all-or-nothing
+                self._local.defer_commits = False
+                self._conn().rollback()
+                raise
+            else:
+                self._local.defer_commits = False
+                self._conn().commit()
+        return ctx()
 
     # ---------------------------------------------------------------- source
 

@@ -258,6 +258,68 @@ class TestBatchDownsampler:
                 np.testing.assert_allclose(got_avg[j], vals[pids == p].mean())
 
 
+    def test_successive_windows_widen_partkey_lifetime(self, tmp_path):
+        """Two batch runs over DIFFERENT ingestion windows: the second
+        must widen the downsample partkey's time range, never narrow it
+        (merge_part_keys vs the replacing write_part_keys)."""
+        disk = DiskColumnStore(str(tmp_path / "c.db"))
+        meta = DiskMetaStore(str(tmp_path / "m.db"))
+        schemas, containers, truth = _ingest_gauge(n_series=2, n_rows=400)
+        store = TimeSeriesMemStore(disk, meta)
+        store.setup("prom", schemas, 0)
+        for off, c in enumerate(containers):
+            store.ingest("prom", 0, c, offset=off)
+        store.get_shard("prom", 0).flush_all(ingestion_time=1000)
+        # second batch of later data for the same series, later itime
+        ts0 = max(int(ts[-1]) for ts, _ in truth.values()) + RES
+        from filodb_tpu.core.record import RecordBuilder
+        from filodb_tpu.core.schemas import DatasetOptions
+        b = RecordBuilder(schemas["gauge"], DatasetOptions())
+        rng = np.random.default_rng(3)
+        for inst in truth:
+            tags = {"__name__": "disk_io", "job": "app", "instance": inst,
+                    "_ws_": "w", "_ns_": "n"}
+            later = ts0 + np.arange(200, dtype=np.int64) * 10_000
+            b.add_series(later, [rng.random(200)], tags)
+        for off, c in enumerate(b.containers()):
+            store.ingest("prom", 0, c, offset=100 + off)
+        store.get_shard("prom", 0).flush_all(ingestion_time=2000)
+
+        job = BatchDownsampler("prom", schemas, disk,
+                               resolutions_ms=(RES,))
+        job.run_shard(0, 0, 1500)          # first window only
+        name = ds_dataset_name("prom", RES)
+        first = {r.partkey: (r.start_time, r.end_time)
+                 for r in disk.scan_part_keys(name, 0)}
+        assert first
+        job.run_shard(0, 1500, 2**62)      # second window
+        merged = {r.partkey: (r.start_time, r.end_time)
+                  for r in disk.scan_part_keys(name, 0)}
+        for pk, (s0, e0) in first.items():
+            s1, e1 = merged[pk]
+            assert s1 <= s0, "later window narrowed partkey start"
+            assert e1 > e0, "later window did not extend partkey end"
+
+
+def test_batch_decode_rejects_count_mismatch():
+    """A blob whose header count disagrees with the expected row count
+    must error, never serve uninitialized memory."""
+    from filodb_tpu import native
+    from filodb_tpu.codecs import deltadelta, doublecodec
+
+    if not native.enable():
+        pytest.skip("native library unavailable")
+    nb = native.batch_decoder()
+    short_ll = deltadelta.encode(np.arange(5, dtype=np.int64))
+    with pytest.raises(ValueError):
+        nb.ll_decode_batch([short_ll], [8])
+    for blob in (doublecodec.encode(np.random.default_rng(0).normal(0, 1, 5)),
+                 doublecodec.encode(np.full(5, 3.5)),
+                 doublecodec.encode(np.arange(5, dtype=np.float64))):
+        with pytest.raises(ValueError):
+            nb.dbl_decode_batch([blob], [8])
+
+
 def test_best_resolution():
     ds = DownsampledTimeSeriesStore("prom", resolutions_ms=(60_000, 3_600_000))
     assert ds.best_resolution(30_000) == 60_000
