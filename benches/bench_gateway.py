@@ -1,0 +1,98 @@
+"""Gateway Influx-protocol parse + ingest throughput.
+
+Reference analog: jmh/src/main/scala/filodb.jmh/GatewayBenchmark.scala:19
+(influxToRecords / promToRecords over a canned 2000-series payload).
+Measures the batch parser (C-level splits + series-prefix memoization),
+the per-line parser it falls back to, and the full parse -> shard ->
+RecordBuilder ingest path.
+"""
+
+import sys
+import pathlib
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from benches.common import emit, log, timed  # noqa: E402
+
+N_SERIES = 2_000
+N_BATCHES = 10
+BASE_NS = 1_700_000_000_000_000_000
+
+
+def payload(batch: int) -> str:
+    lines = []
+    ts = BASE_NS + batch * 10_000_000_000
+    for i in range(N_SERIES):
+        lines.append(
+            f"node_cpu_seconds,host=h{i % 200},core=c{i % 16},"
+            f"dc=dc{i % 4},_ws_=demo,_ns_=App-{i % 8} "
+            f"value={i * 0.25 + batch} {ts + i}")
+    return "\n".join(lines)
+
+
+def main():
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+    from filodb_tpu.gateway.influx import (parse_batch_columns, parse_line,
+                                           parse_lines_fast)
+    from filodb_tpu.gateway.server import ShardingPublisher
+    from filodb_tpu.parallel.shardmap import ShardMapper
+
+    batches = [payload(b) for b in range(N_BATCHES)]
+    total = N_SERIES * N_BATCHES
+
+    def run_cols():
+        for text in batches:
+            assert parse_batch_columns(text) is not None
+
+    t = timed(run_cols)
+    emit("influx columnar batch parse (cold)", total / t, "lines/sec")
+
+    # steady-state scrape: the same series set arrives every interval;
+    # the head resolution short-circuits on a byte compare
+    bmemo: dict = {}
+    parse_batch_columns(batches[0], bmemo)
+
+    def run_cols_steady():
+        for text in batches:
+            assert parse_batch_columns(text, bmemo) is not None
+
+    t = timed(run_cols_steady)
+    emit("influx columnar batch parse (steady-state)", total / t,
+         "lines/sec")
+
+    # record-building parser with a warm prefix memo
+    memo: dict = {}
+    parse_lines_fast(batches[0], memo)
+
+    def run_fast():
+        for text in batches:
+            parse_lines_fast(text, memo)
+
+    t = timed(run_fast)
+    emit("influx parse to records (warm memo)", total / t, "lines/sec")
+
+    def run_slow():
+        for line in batches[0].splitlines():
+            parse_line(line)
+
+    t = timed(run_slow)
+    emit("influx per-line parse", N_SERIES / t, "lines/sec")
+
+    # full ingest: parse -> shard route -> RecordBuilder
+    pub = ShardingPublisher(DEFAULT_SCHEMAS["gauge"], ShardMapper(32),
+                            publish=lambda shard, container: None,
+                            spread=3)
+
+    def run_ingest():
+        for text in batches:
+            pub.ingest_influx_batch(text)
+        pub.flush()
+
+    t = timed(run_ingest)
+    emit("gateway ingest (parse+route+build)", total / t, "samples/sec")
+    log(f"parse_errors={pub.parse_errors}")
+    assert pub.parse_errors == 0
+
+
+if __name__ == "__main__":
+    main()

@@ -195,15 +195,287 @@ def parse_lines(text: str) -> Iterator[InfluxRecord]:
             yield rec
 
 
+_TRUE = ("t", "T", "true", "True")
+_FALSE = ("f", "F", "false", "False")
+
+
+_HASH_POWS = None
+
+
+def _hash_pows():
+    """Two independent 64-bit positional weight tables for the head
+    dedup hash (128 bits total: a silent collision would mislabel
+    series, so one 64-bit stream is not enough)."""
+    global _HASH_POWS
+    if _HASH_POWS is None:
+        import numpy as np
+        n = 4096                 # max supported head length
+        with np.errstate(over="ignore"):
+            p1 = np.ones(n, np.uint64)
+            p2 = np.ones(n, np.uint64)
+            for i in range(1, n):
+                p1[i] = p1[i - 1] * np.uint64(0x9E3779B97F4A7C15)
+                p2[i] = p2[i - 1] * np.uint64(0xC2B2AE3D27D4EB4F)
+        _HASH_POWS = (p1, p2)
+    return _HASH_POWS
+
+
+def parse_batch_columns(text: str, batch_memo: Optional[dict] = None):
+    """COLUMNAR batch parse: the whole payload is processed as ONE byte
+    array — line/space/equals positions by flatnonzero, the value and
+    timestamp tokens extracted with one boolean mask and parsed by
+    numpy's C float/int parser, and the repeated ``measurement,tags``
+    heads deduplicated by a 128-bit positional reduceat hash so
+    per-series work is paid once per batch, not once per line
+    (reference throughput anchor: InfluxProtocolParser.scala:65 parses
+    bytes in place; jmh GatewayBenchmark.scala:19).
+
+    Serves the common gateway shape: no escapes/quotes/comments, one
+    ``name=<float>`` field plus timestamp per line.  Returns ``(heads,
+    inverse, fnames, finv, values, ts_ms)`` — unique head strings,
+    per-line head index, unique field names, per-line field index,
+    float values, int64 epoch-ms stamps — or None when the batch needs
+    the general parser (the columnar path is never wrong, only absent).
+
+    ``batch_memo`` (caller-owned dict) short-circuits the head dedup
+    when consecutive batches carry the SAME series set in the same
+    order — the steady scrape shape — via one byte-compare of the
+    concatenated head regions.
+    """
+    import numpy as np
+    if "\\" in text or '"' in text or "#" in text:
+        return None
+    if not text.endswith("\n"):
+        text += "\n"
+    data = text.encode("utf-8")
+    a = np.frombuffer(data, np.uint8)
+    nl = np.flatnonzero(a == 10)
+    starts = np.empty(len(nl), np.int64)
+    starts[0] = 0
+    starts[1:] = nl[:-1] + 1
+    ends = nl.copy()
+    ends -= (a[np.maximum(ends - 1, 0)] == 13)     # \r\n endings
+    keep = ends > starts
+    starts, ends = starts[keep], ends[keep]
+    N = len(starts)
+    if N == 0:
+        return None
+    if (a[starts] == 32).any() or (a[ends - 1] == 32).any():
+        return None                                # needs strip: fallback
+    L = len(a)
+    sp = np.flatnonzero(a == 32)
+    i1 = np.searchsorted(sp, starts)
+    if i1[-1] >= len(sp):
+        return None
+    sp1 = sp[np.minimum(i1, len(sp) - 1)]
+    if (i1 >= len(sp)).any() or (sp1 >= ends).any():
+        return None                                # a line without fields
+    i2 = i1 + 1
+    sp2 = sp[np.minimum(i2, len(sp) - 1)]
+    if not ((i2 < len(sp)) & (sp2 < ends)).all():
+        return None                                # missing timestamps
+    i3 = i2 + 1
+    sp3 = sp[np.minimum(i3, len(sp) - 1)]
+    if ((i3 < len(sp)) & (sp3 < ends)).any():
+        return None                                # extra spaces
+    eqs = np.flatnonzero(a == 61)
+    if len(eqs) == 0:
+        return None                                # no fields anywhere
+    j1 = np.searchsorted(eqs, sp1)
+    eq1 = eqs[np.minimum(j1, len(eqs) - 1)]
+    if (j1 >= len(eqs)).any() or (eq1 >= sp2).any() \
+            or (eq1 == sp1 + 1).any():
+        return None                                # field without '='
+    j2 = j1 + 1
+    eq2 = eqs[np.minimum(j2, len(eqs) - 1)]
+    if ((j2 < len(eqs)) & (eq2 < sp2)).any():
+        return None                                # '=' in field value
+    commas = np.flatnonzero(a == 44)
+    if len(commas):
+        c1 = np.searchsorted(commas, sp1)
+        cc = commas[np.minimum(c1, len(commas) - 1)]
+        if ((c1 < len(commas)) & (cc < sp2)).any():
+            return None                            # multi-field line
+
+    def range_index(lo, lens):
+        """Flat index array covering per-line [lo_i, lo_i + len_i)."""
+        offs = np.zeros(len(lens), np.int64)
+        np.cumsum(lens[:-1], out=offs[1:] if len(lens) > 1 else offs[:0])
+        total = int(lens.sum())
+        idx = np.arange(total, dtype=np.int64) + np.repeat(lo - offs,
+                                                           lens)
+        return idx, offs
+
+    try:
+        # value + ts tokens: include the trailing \r/\n byte as the
+        # whitespace separator bytes.split() needs
+        end_incl = np.minimum(ends + 1, L)
+        idx, _ = range_index(eq1 + 1, end_incl - (eq1 + 1))
+        vt = bytes(a[idx]).split()
+        if len(vt) != 2 * N:
+            return None
+        values = np.array(vt[0::2], dtype=np.float64)
+        ts_ns = np.array(vt[1::2], dtype=np.int64)
+    except (ValueError, OverflowError):
+        return None                    # int/bool/string fields, bad ts
+    ts_ms = ts_ns // 1_000_000
+    # field names: include each line's '=' as the separator
+    idx, _ = range_index(sp1 + 1, eq1 + 1 - (sp1 + 1))
+    fn_tokens = bytes(a[idx]).split(b"=")[:-1]
+    if len(fn_tokens) != N:
+        return None
+    ufn_b, finv = np.unique(np.array(fn_tokens), return_inverse=True)
+    ufn = [f.decode("utf-8") for f in ufn_b]
+
+    # head dedup: 128-bit positional hash per line, reduceat-summed;
+    # the two 64-bit streams ride a complex128 through np.unique (the
+    # float conversion keeps ~52 bits per stream — ample dedup entropy)
+    hlen = sp1 - starts
+    if int(hlen.max()) >= len(_hash_pows()[0]):
+        return None
+    hidx, hoffs = range_index(starts, hlen)
+    hb8 = a[hidx]
+    if batch_memo is not None:
+        prev = batch_memo.get("heads_sig")
+        if prev is not None and len(prev[0]) == len(hb8) \
+                and np.array_equal(prev[1], hlen) \
+                and bytes(hb8) == prev[0]:
+            heads, inverse = prev[2], prev[3]
+            return (heads, inverse, ufn, finv, values, ts_ms)
+    rel = np.arange(len(hidx), dtype=np.int64) - np.repeat(hoffs, hlen)
+    hb = hb8.astype(np.uint64)
+    p1, p2 = _hash_pows()
+    with np.errstate(over="ignore"):
+        h1 = np.add.reduceat(hb * p1[rel], hoffs)
+        h2 = np.add.reduceat(hb * p2[rel], hoffs) ^ hlen.astype(np.uint64)
+    hkey = h1.astype(np.float64) + 1j * h2.astype(np.float64)
+    _, first_idx, inverse = np.unique(hkey, return_index=True,
+                                      return_inverse=True)
+    heads = [data[starts[i]:sp1[i]].decode("utf-8") for i in first_idx]
+    inverse = inverse.ravel()
+    if batch_memo is not None:
+        batch_memo["heads_sig"] = (bytes(hb8), hlen.copy(), heads,
+                                   inverse)
+    return (heads, inverse, ufn, finv, values, ts_ms)
+
+
+def parse_head(head: str) -> tuple[str, dict[str, str]]:
+    """``measurement,tag=v,...`` (no escapes) -> (measurement, tags)."""
+    parts = head.split(",")
+    measurement = parts[0]
+    if not measurement:
+        raise InfluxParseError(f"empty measurement: {head!r}")
+    tags: dict[str, str] = {}
+    for kv in parts[1:]:
+        k, eq, v = kv.partition("=")
+        if not k or not eq:
+            raise InfluxParseError(f"bad tag {kv!r} in head: {head!r}")
+        tags[k] = v
+    return measurement, tags
+
+
+def parse_lines_fast(text: str, head_memo: Optional[dict] = None,
+                     _columns_checked: bool = False) -> list[InfluxRecord]:
+    """Batch parser for the gateway ingest hot path (reference:
+    InfluxProtocolParser.scala:65 parses bytes in place per line; the
+    python analog gets its speed from C-level ``str`` splits plus HEAD
+    MEMOIZATION — in scrape traffic the ``measurement,tags`` prefix of a
+    series repeats every interval, so its tag-dict is built once, not
+    per line).  Lines containing escapes, quotes, or comments take the
+    per-character :func:`parse_line` path — the fast path is never
+    wrong, only absent.
+
+    ``head_memo`` lets a long-lived caller (the gateway server) carry
+    the prefix cache across batches."""
+    memo: dict = {} if head_memo is None else head_memo
+    # _columns_checked: the caller already ran parse_batch_columns on
+    # this payload and got None — skip the redundant O(payload) scan
+    cols = None if _columns_checked else parse_batch_columns(text)
+    if cols is not None:
+        uheads, inv, ufn, finv, values, ts_ms = cols
+        parsed = []
+        for h in uheads:
+            got = memo.get(h)
+            if got is None:
+                if len(memo) > 200_000:
+                    memo.clear()
+                got = memo[h] = parse_head(h)
+            parsed.append(got)
+        return [InfluxRecord(parsed[hi][0], dict(parsed[hi][1]),
+                             {ufn[fi]: float(v)}, int(t))
+                for hi, fi, v, t in zip(inv, finv, values, ts_ms)]
+    recs: list[InfluxRecord] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or "\\" in line or '"' in line or line[0] == "#":
+            rec = parse_line(line)
+            if rec is not None:
+                recs.append(rec)
+            continue
+        sp = line.find(" ")
+        if sp < 0:
+            raise InfluxParseError(f"no fields in line: {line!r}")
+        head = line[:sp]
+        got = memo.get(head)
+        if got is None:
+            if len(memo) > 200_000:      # bound churn from label floods
+                memo.clear()
+            got = memo[head] = parse_head(head)
+        measurement, tags = got
+        rest = line[sp + 1:]
+        sp2 = rest.find(" ")
+        if sp2 < 0:
+            fields_part, ts_part = rest, None
+        else:
+            fields_part, ts_part = rest[:sp2], rest[sp2 + 1:]
+        fields: dict[str, float] = {}
+        for kv in fields_part.split(","):
+            name, eq, raw = kv.partition("=")
+            if not name or not eq:
+                raise InfluxParseError(
+                    f"bad field {kv!r} in line: {line!r}")
+            if raw.endswith(("i", "u")) and raw[:-1].lstrip("-").isdigit():
+                fields[name] = float(raw[:-1])
+            elif raw in _TRUE:
+                fields[name] = 1.0
+            elif raw in _FALSE:
+                fields[name] = 0.0
+            else:
+                try:
+                    fields[name] = float(raw)
+                except ValueError as e:
+                    raise InfluxParseError(
+                        f"bad field value {raw!r} in line: {line!r}") from e
+        if not fields:
+            raise InfluxParseError(f"no numeric fields in line: {line!r}")
+        if ts_part:
+            try:
+                ts_ms = int(ts_part) // 1_000_000
+            except ValueError as e:
+                raise InfluxParseError(
+                    f"bad timestamp {ts_part!r} in line: {line!r}") from e
+        else:
+            import time
+            ts_ms = int(time.time() * 1000)
+        # copy the memoized tag dict: records are mutable and outlive
+        # the batch; the memo must stay pristine
+        recs.append(InfluxRecord(measurement, dict(tags), fields, ts_ms))
+    return recs
+
+
+def prom_metric_name(measurement: str, fname: str) -> str:
+    """Influx field -> Prometheus metric naming (reference:
+    InfluxPromSingleRecord: measurement_field, plain measurement for
+    the 'value' field).  Shared by the per-record and columnar ingest
+    paths so the rule cannot drift between them."""
+    return measurement if fname == "value" else f"{measurement}_{fname}"
+
+
 def to_prom_samples(rec: InfluxRecord,
                     default_tags: Optional[Mapping[str, str]] = None
                     ) -> Iterator[tuple[str, dict, float]]:
-    """InfluxRecord -> (metric_name, tags, value) gauge samples
-    (reference: InfluxPromSingleRecord naming: measurement_field, plain
-    measurement for the 'value' field)."""
+    """InfluxRecord -> (metric_name, tags, value) gauge samples."""
     base = dict(default_tags or {})
     base.update(rec.tags)
     for fname, fval in rec.fields.items():
-        metric = rec.measurement if fname == "value" \
-            else f"{rec.measurement}_{fname}"
-        yield metric, base, fval
+        yield prom_metric_name(rec.measurement, fname), base, fval

@@ -71,6 +71,96 @@ class TestInfluxParser:
         with pytest.raises(InfluxParseError):
             parse_line("cpu value=1 12x3")  # malformed trailing timestamp
 
+    def test_fast_paths_match_general_parser(self):
+        """parse_lines_fast (columnar or loop) must be observably
+        identical to the per-line parser on every shape it serves, and
+        must ROUTE (not break) on shapes it doesn't."""
+        from filodb_tpu.gateway.influx import parse_lines_fast
+
+        cases = [
+            # columnar-eligible: single field, trailing ts, repeats
+            "\n".join(f"cpu,host=h{i % 3},dc=east usage={i * 0.5} "
+                      f"17000000000000000{i:02d}" for i in range(40)),
+            # mixed field names + negative/exponent values
+            ("m,a=1 value=-1.5e-3 1700000000000000000\n"
+             "m,a=1 other=2.25 1700000000000001000\n"
+             "m2 value=7 1700000000000002000"),
+            # loop path: multi-field, int/bool suffixes, blank/comment
+            ("mem used=10,free=20.5,cached=3i 1700000000000000000\n"
+             "\n# comment\n"
+             "up,host=a ok=true,bad=f 1700000000000000000"),
+            # slow path: escapes and quoted strings
+            (r"my\,metric,tag\ one=va\=lue value=1 1700000000000000000"
+             + "\n"
+             + 'up,host=a ok=true,msg="x y",v=2 1700000000000000000'),
+            # missing timestamp (time.time fallback: compare fields only)
+        ]
+        for text in cases:
+            slow = list(parse_lines(text))
+            fast = parse_lines_fast(text)
+            assert len(fast) == len(slow), text
+            for a, b in zip(fast, slow):
+                assert a.measurement == b.measurement
+                assert a.tags == b.tags
+                assert a.fields == b.fields
+                assert a.timestamp_ms == b.timestamp_ms
+
+    def test_columnar_parse_shapes(self):
+        from filodb_tpu.gateway.influx import parse_batch_columns
+
+        text = ("cpu,host=a value=1.5 1700000000000000000\n"
+                "cpu,host=b value=2.5 1700000000001000000\n"
+                "cpu,host=a value=3.5 1700000000002000000\n")
+        heads, inv, ufn, finv, vals, ts = parse_batch_columns(text)
+        assert len(heads) == 2 and list(vals) == [1.5, 2.5, 3.5]
+        assert heads[inv[0]] == heads[inv[2]] == "cpu,host=a"
+        assert list(ts) == [1700000000000, 1700000000001,
+                            1700000000002]
+        # ineligible shapes -> None (never wrong, only absent)
+        for bad in ("cpu value=1",                      # no timestamp
+                    "cpu a=1,b=2 123",                  # multi-field
+                    "cpu value=3i 123",                 # int suffix
+                    'cpu msg="x" 123',                  # quoted
+                    r"c\,pu value=1 123",               # escape
+                    "# only a comment"):
+            assert parse_batch_columns(bad) is None, bad
+
+    def test_columnar_batch_memo_detects_change(self):
+        """The steady-state head memo must only short-circuit on a
+        byte-identical head region — a changed series set re-resolves."""
+        from filodb_tpu.gateway.influx import parse_batch_columns
+
+        memo: dict = {}
+        t1 = ("cpu,host=a value=1 100000000\n"
+              "cpu,host=b value=2 100000000\n")
+        h1, inv1, *_ = parse_batch_columns(t1, memo)
+        h2, inv2, *_ = parse_batch_columns(
+            t1.replace("value=1", "value=9"), memo)
+        assert h2 == h1 and list(inv2) == list(inv1)   # memo hit
+        t2 = ("cpu,host=a value=1 100000000\n"
+              "cpu,host=c value=2 100000000\n")
+        h3, inv3, *_ = parse_batch_columns(t2, memo)
+        assert "cpu,host=c" in h3                      # re-resolved
+
+    def test_columnar_ingest_bad_head_skips_only_its_lines(self):
+        """A malformed head mid-batch must drop only ITS lines (counted
+        as parse errors); every other series still lands — matching the
+        per-line ingest semantics."""
+        from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+        from filodb_tpu.gateway.server import ShardingPublisher
+
+        published = []
+        pub = ShardingPublisher(DEFAULT_SCHEMAS["gauge"], ShardMapper(4),
+                                publish=lambda s, c: published.append(c))
+        good = "\n".join(f"cpu,host=h{i} value={i} 17000000000000000{i:02d}"
+                         for i in range(10))
+        batch = good + "\n,bad=x value=99 1700000000000000000"
+        n = pub.ingest_influx_batch(batch)
+        assert n == 10
+        assert pub.parse_errors == 1
+        assert pub.samples_in == 10
+        assert pub.flush() > 0
+
     def test_parse_lines_stream(self):
         text = "cpu value=1 1000000\n\n# c\nmem value=2 2000000\n"
         recs = list(parse_lines(text))
