@@ -226,6 +226,72 @@ class StaticFailureProvider(FailureProvider):
                 if f.end_ms >= start_ms and f.start_ms <= end_ms]
 
 
+class MetadataRemoteExec(ExecPlan):
+    """Metadata from a remote replica's Prometheus-compatible API —
+    label values / series keys when the local window is failed or the
+    partition is remote (reference:
+    query/src/main/scala/filodb/query/exec/MetadataRemoteExec.scala:15).
+    Emits the SAME batch shapes as the local LabelValuesExec /
+    PartKeysExec leaves, so the metadata DistConcat mergers compose
+    local and remote children transparently."""
+
+    def __init__(self, endpoint: str, dataset: str, mode: str,
+                 start_ms: int, end_ms: int,
+                 label_names: Sequence[str] = (),
+                 filters: Sequence = (),
+                 query_context: Optional[QueryContext] = None,
+                 timeout_s: float = 30.0):
+        super().__init__(query_context)
+        assert mode in ("labelvalues", "series")
+        self.endpoint = endpoint.rstrip("/")
+        self.dataset = dataset
+        self.mode = mode
+        self.start_ms = start_ms
+        self.end_ms = end_ms
+        self.label_names = list(label_names)
+        self.filters = list(filters)
+        self.timeout_s = timeout_s
+
+    def _args_str(self) -> str:
+        what = self.label_names if self.mode == "labelvalues" \
+            else self.filters
+        return f"endpoint={self.endpoint}, mode={self.mode}, {what}"
+
+    def _get(self, path: str, qs: dict) -> list:
+        import json
+        import urllib.parse
+        import urllib.request
+
+        url = (f"{self.endpoint}/promql/{self.dataset}/api/v1/{path}"
+               f"?{urllib.parse.urlencode(qs, doseq=True)}")
+        with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+            body = json.loads(resp.read())
+        if body.get("status") != "success":
+            raise RuntimeError(f"remote metadata query failed: {body}")
+        return body.get("data", [])
+
+    def do_execute(self, ctx) -> list:
+        import urllib.parse
+
+        times = {"start": self.start_ms / 1000.0,
+                 "end": self.end_ms / 1000.0}
+        if self.mode == "labelvalues":
+            if self.filters:
+                # filters restrict the matched series (Prometheus
+                # match[] on /label/<l>/values) — dropping them would
+                # silently widen the failover answer
+                times["match[]"] = _filters_to_promql(self.filters)
+            out = {}
+            for label in self.label_names:
+                data = self._get(
+                    f"label/{urllib.parse.quote(label)}/values", times)
+                out[label] = list(data)
+            return [out]
+        sel = _filters_to_promql(self.filters)
+        data = self._get("series", {"match[]": sel, **times})
+        return [[dict(m) for m in data]]
+
+
 class HighAvailabilityPlanner(QueryPlanner):
     """Routes step sub-ranges overlapping local failures to a remote
     replica via PromQL-over-HTTP, stitching local + remote results
@@ -244,6 +310,23 @@ class HighAvailabilityPlanner(QueryPlanner):
     def materialize(self, plan: lp.LogicalPlan,
                     qctx: Optional[QueryContext] = None) -> ExecPlan:
         qctx = qctx or QueryContext()
+        if isinstance(plan, (lp.LabelValues, lp.SeriesKeysByFilters)):
+            # metadata over a failed local window routes to the replica
+            # wholesale (reference: MetadataRemoteExec.scala:15 — no
+            # time-splitting/stitch for metadata results)
+            if self.failures.get_failures(self.dataset, plan.start_ms,
+                                          plan.end_ms):
+                if isinstance(plan, lp.LabelValues):
+                    return MetadataRemoteExec(
+                        self.remote_endpoint, self.dataset, "labelvalues",
+                        plan.start_ms, plan.end_ms,
+                        label_names=plan.label_names,
+                        filters=plan.filters, query_context=qctx)
+                return MetadataRemoteExec(
+                    self.remote_endpoint, self.dataset, "series",
+                    plan.start_ms, plan.end_ms, filters=plan.filters,
+                    query_context=qctx)
+            return self.local.materialize(plan, qctx)
         if not isinstance(plan, lp.PeriodicSeriesPlan):
             return self.local.materialize(plan, qctx)
         start, step, end = lp.time_range(plan)
@@ -358,6 +441,8 @@ class MultiPartitionPlanner(QueryPlanner):
     def materialize(self, plan: lp.LogicalPlan,
                     qctx: Optional[QueryContext] = None) -> ExecPlan:
         qctx = qctx or QueryContext()
+        if isinstance(plan, (lp.LabelValues, lp.SeriesKeysByFilters)):
+            return self._materialize_metadata(plan, qctx)
         if not isinstance(plan, lp.PeriodicSeriesPlan):
             return self.local.materialize(plan, qctx)
         start, step, end = lp.time_range(plan)
@@ -393,6 +478,44 @@ class MultiPartitionPlanner(QueryPlanner):
         if len(children) == 1:
             return children[0]
         return StitchRvsExec(children, qctx)
+
+    def _materialize_metadata(self, plan, qctx) -> ExecPlan:
+        """Metadata fans out to EVERY partition — label values and
+        series keys are unions, not time-splits (reference:
+        MultiPartitionPlanner.scala materializeMetadataQueryPlan +
+        MetadataRemoteExec.scala:15)."""
+        from filodb_tpu.query.exec import (LabelValuesDistConcatExec,
+                                           PartKeysDistConcatExec)
+        filters = {f.column: f.filter.value for f in plan.filters
+                   if isinstance(f.filter, Equals)}
+        parts = self.locations.get_partitions(filters, plan.start_ms,
+                                              plan.end_ms)
+        if not parts:
+            return EmptyResultExec(qctx)
+        children: list[ExecPlan] = []
+        seen: set[str] = set()
+        for p in parts:
+            if p.partition_name in seen:
+                continue                 # one union child per partition
+            seen.add(p.partition_name)
+            if p.partition_name == self.local_partition:
+                children.append(self.local.materialize(plan, qctx))
+            elif isinstance(plan, lp.LabelValues):
+                children.append(MetadataRemoteExec(
+                    p.endpoint, self.dataset, "labelvalues",
+                    plan.start_ms, plan.end_ms,
+                    label_names=plan.label_names, filters=plan.filters,
+                    query_context=qctx))
+            else:
+                children.append(MetadataRemoteExec(
+                    p.endpoint, self.dataset, "series",
+                    plan.start_ms, plan.end_ms, filters=plan.filters,
+                    query_context=qctx))
+        if len(children) == 1:
+            return children[0]
+        merger = LabelValuesDistConcatExec if isinstance(
+            plan, lp.LabelValues) else PartKeysDistConcatExec
+        return merger(children, qctx)
 
 
 # ---------------------------------------------------------------------------

@@ -343,7 +343,7 @@ class FiloHttpServer:
             if endpoint == "labels":
                 return self._labels(binding, params)
             if endpoint == "label" and len(parts) >= 7 and parts[6] == "values":
-                return self._label_values(binding, parts[5], params)
+                return self._label_values(binding, parts[5], params, multi)
             if endpoint == "series":
                 return self._series(binding, params, multi)
         if len(parts) >= 3 and parts[0] == "api" and parts[2] == "cluster":
@@ -421,9 +421,23 @@ class FiloHttpServer:
             names.update(sh.label_names(start=start, end=end))
         return 200, {"status": "success", "data": sorted(names)}
 
-    def _label_values(self, b: DatasetBinding, label: str,
-                      p: dict) -> tuple[int, dict]:
+    def _label_values(self, b: DatasetBinding, label: str, p: dict,
+                      multi: Optional[dict] = None) -> tuple[int, dict]:
         start, end = self._time_range(p)
+        matches = (multi or {}).get("match[]") or \
+            (multi or {}).get("match") or []
+        if matches:
+            # Prometheus API: match[] restricts the series the values
+            # come from (union over selectors); the remote metadata
+            # exec relies on this for filtered failover routing
+            from filodb_tpu.promql.parser import parse_selector
+            vals: set = set()
+            for match in matches:
+                filters = parse_selector(match)
+                for sh in b.memstore.shards(b.dataset):
+                    vals.update(sh.label_values(label, filters, start,
+                                                end))
+            return 200, {"status": "success", "data": sorted(vals)}
         vals = b.memstore.label_values(b.dataset, label, start=start, end=end)
         return 200, {"status": "success", "data": vals}
 

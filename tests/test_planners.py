@@ -187,6 +187,93 @@ class TestHighAvailabilityPlanner:
         assert np.isfinite(inside).any()
 
 
+class TestMetadataRemoteExec:
+    """Remote metadata routing (reference: MetadataRemoteExec.scala:15)."""
+
+    def test_ha_routes_metadata_to_replica_on_failure(self, remote_server):
+        from filodb_tpu.core.filters import ColumnFilter, Equals
+        from filodb_tpu.query import logical as lp
+
+        endpoint, remote_ms = remote_server
+        _ms, local = _mk_cluster()
+        failures = StaticFailureProvider([
+            FailureTimeRange(BASE, BASE + 2_000_000)])
+        ha = HighAvailabilityPlanner("prom", local, failures, endpoint)
+        # label values route remote and return the replica's values
+        plan = lp.LabelValues(("instance",), (), BASE, BASE + 1_000_000)
+        ep = ha.materialize(plan, QueryContext())
+        assert "MetadataRemoteExec" in ep.print_tree()
+        res = ep.execute(ExecContext(_ms, QueryContext()))
+        vals = res.batches[0]["instance"]
+        assert sorted(vals) == [f"i{i}" for i in range(4)]
+        # series keys route remote too
+        plan = lp.SeriesKeysByFilters(
+            (ColumnFilter("_metric_", Equals("m_total")),),
+            BASE, BASE + 1_000_000)
+        ep = ha.materialize(plan, QueryContext())
+        assert "MetadataRemoteExec" in ep.print_tree()
+        res = ep.execute(ExecContext(_ms, QueryContext()))
+        keys = res.batches[0]
+        assert len(keys) == 4
+        assert {k.get("instance") for k in keys} == {f"i{i}"
+                                                     for i in range(4)}
+
+    def test_ha_filtered_labelvalues_keeps_filters_remotely(
+            self, remote_server):
+        """A filtered LabelValues routed to the replica must carry its
+        filters as match[] — never silently widen the answer."""
+        from filodb_tpu.core.filters import ColumnFilter, Equals
+        from filodb_tpu.query import logical as lp
+
+        endpoint, _ = remote_server
+        _ms, local = _mk_cluster()
+        failures = StaticFailureProvider([
+            FailureTimeRange(BASE, BASE + 2_000_000)])
+        ha = HighAvailabilityPlanner("prom", local, failures, endpoint)
+        plan = lp.LabelValues(
+            ("instance",),
+            (ColumnFilter("instance", Equals("i1")),),
+            BASE, BASE + 1_000_000)
+        ep = ha.materialize(plan, QueryContext())
+        assert "MetadataRemoteExec" in ep.print_tree()
+        res = ep.execute(ExecContext(_ms, QueryContext()))
+        assert res.batches[0]["instance"] == ["i1"]
+
+    def test_ha_metadata_stays_local_without_failures(self, remote_server):
+        from filodb_tpu.query import logical as lp
+
+        endpoint, _ = remote_server
+        ms, local = _mk_cluster()
+        ha = HighAvailabilityPlanner("prom", local,
+                                     StaticFailureProvider([]), endpoint)
+        plan = lp.LabelValues(("instance",), (), BASE, BASE + 1_000_000)
+        ep = ha.materialize(plan, QueryContext())
+        assert "MetadataRemoteExec" not in ep.print_tree()
+        res = ep.execute(ExecContext(ms, QueryContext()))
+        assert sorted(res.batches[0]["instance"]) == \
+            [f"i{i}" for i in range(4)]
+
+    def test_multipartition_metadata_fans_out_and_unions(
+            self, remote_server):
+        from filodb_tpu.query import logical as lp
+
+        endpoint, _remote_ms = remote_server
+        # local cluster with a DIFFERENT metric so the union is visible
+        ms, local = _mk_cluster(metric="local_only_total", n_series=2)
+        locs = StaticPartitionLocations([
+            PartitionAssignment("remote-dc", endpoint, 0, 2**62),
+            PartitionAssignment("local", "", 0, 2**62)])
+        mp = MultiPartitionPlanner("prom", "local", local, locs)
+        plan = lp.LabelValues(("_metric_",), (), BASE, BASE + 2_000_000)
+        ep = mp.materialize(plan, QueryContext())
+        tree = ep.print_tree()
+        assert "MetadataRemoteExec" in tree
+        assert "LabelValuesDistConcatExec" in tree
+        res = ep.execute(ExecContext(ms, QueryContext()))
+        got = set(res.batches[0]["_metric_"])
+        assert {"m_total", "local_only_total"} <= got
+
+
 class TestMultiPartitionPlanner:
     def test_local_only(self):
         ms, local = _mk_cluster()
