@@ -51,7 +51,10 @@ class RecoveryInProgress(ShardEvent):
 
 @dataclasses.dataclass(frozen=True)
 class IngestionStopped(ShardEvent):
-    pass
+    # the node whose LOCAL ingestion stopped; None = operator/leader
+    # stop (publish_event uses this to tell a handoff tail — ownership
+    # already moved elsewhere — from a real stop of the current owner)
+    node: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -239,6 +242,17 @@ class ShardManager:
             info = self._datasets.get(event.dataset)
             if info is not None:
                 status = _EVENT_STATUS.get(type(event))
+                if isinstance(event, IngestionStopped) \
+                        and event.node is not None \
+                        and info.mapper.coord_for_shard(event.shard) \
+                        != event.node:
+                    # handoff tail: this node stopped its local ingest
+                    # because ownership MOVED — the new owner's
+                    # lifecycle governs the status now; marking STOPPED
+                    # here would stick (gossip never resurrects
+                    # operator stops) and blind this node's queries to
+                    # the shard forever
+                    status = None
                 if status is not None:
                     progress = getattr(event, "progress_pct", 0)
                     info.mapper.update_status(event.shard, status, progress)

@@ -63,7 +63,15 @@ class IngestionCoordinator:
 
     def start_ingestion(self, shard: int, blocking: bool = False) -> None:
         """setup -> recover index -> checkpointed recovery -> normal
-        ingestion (reference: startIngestion :170, doRecovery :293)."""
+        ingestion (reference: startIngestion :170, doRecovery :293).
+
+        The memstore SETUP runs synchronously here, before the ingest
+        thread spawns: a query dispatched right after assignment must
+        find the shard registered (empty, possibly still recovering) —
+        never race an async setup into 'shard not set up' failures."""
+        if not self.memstore.has_shard(self.dataset, shard):
+            self.memstore.setup(self.dataset, self.schemas, shard,
+                                self.config)
         stop = threading.Event()
         with self._lock:
             if shard in self._threads:
@@ -141,11 +149,7 @@ class IngestionCoordinator:
     def _run_shard(self, shard: int, stop: threading.Event) -> None:
         flush_sched = None
         try:
-            try:
-                self.memstore.setup(self.dataset, self.schemas, shard,
-                                    self.config)
-            except ValueError:
-                pass  # already set up (restart of ingestion only)
+            # setup already ran synchronously in start_ingestion
             self.memstore.recover_index(self.dataset, shard)
 
             # checkpointed recovery: replay from the earliest checkpoint;
@@ -218,7 +222,8 @@ class IngestionCoordinator:
                 # stream drained in response to a stop/teardown: the shard
                 # really is stopped.  A finite source draining on its own
                 # (CSV load) leaves the shard ACTIVE and queryable.
-                self.event_sink(IngestionStopped(self.dataset, shard))
+                self.event_sink(IngestionStopped(self.dataset, shard,
+                                                 node=self.node))
         except Exception as e:  # noqa: BLE001 — report, don't kill the node
             traceback.print_exc()
             self.event_sink(IngestionError(self.dataset, shard, str(e)))

@@ -53,6 +53,9 @@ class TimeSeriesMemStore:
         self._schemas[dataset] = schemas
         return shard
 
+    def has_shard(self, dataset: str, shard_num: int) -> bool:
+        return shard_num in self._datasets.get(dataset, ())
+
     def get_shard(self, dataset: str, shard_num: int) -> TimeSeriesShard:
         try:
             return self._datasets[dataset][shard_num]

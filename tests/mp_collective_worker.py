@@ -1,0 +1,97 @@
+"""Worker for the REAL 2-process distribution test: joins a
+jax.distributed CPU runtime and runs the mesh serving program
+(scan -> window -> psum over the shard axis) with its OWN shard's data;
+the collective rides Gloo across actual OS processes — the CPU stand-in
+for the reference's forked-JVM cluster specs (reference:
+coordinator/src/multi-jvm/.../ClusterRecoverySpec.scala) and for ICI/DCN
+collectives on a real TPU pod.
+
+Usage: python mp_collective_worker.py <process_id> <coordinator_addr>
+Prints "RESULT OK <checksum>" on success.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)       # exactly ONE local device/process
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    addr = sys.argv[2]
+    jax.distributed.initialize(coordinator_address=addr, num_processes=2,
+                               process_id=pid)
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from filodb_tpu.core.chunk import build_batch
+    from filodb_tpu.ops.windows import StepRange
+    from filodb_tpu.parallel import mesh as meshmod
+    from filodb_tpu.query import rangefns
+    from filodb_tpu.query.logical import AggregationOperator as Agg
+    from filodb_tpu.query.logical import RangeFunctionId as F
+
+    assert len(jax.devices()) == 2, jax.devices()
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 1),
+                axis_names=("shard", "step"))
+    key = meshmod._mesh_key(mesh)
+
+    # BOTH processes generate BOTH shards deterministically (shared
+    # seeds) so the oracle and static kernel config agree; each feeds
+    # only ITS OWN shard into the mesh program.
+    base = 1_700_000_000_000
+    S, R = 4, 60
+    batches = []
+    for shard in range(2):
+        rng = np.random.default_rng(100 + shard)
+        ts = [base + np.arange(R, dtype=np.int64) * 10_000
+              for _ in range(S)]
+        vs = [np.cumsum(rng.random(R)) for _ in range(S)]
+        batches.append(build_batch(ts, vs))
+    srange = StepRange(base + 120_000, base + 500_000, 30_000)
+    steps_np = np.asarray(srange.timestamps(np.int64))
+    window_ms = 120_000
+
+    ts_all = np.concatenate([b.timestamps for b in batches])   # [2S, R]
+    vals_all = np.concatenate([b.values for b in batches])
+    ids_all = np.zeros(2 * S, np.int32)                        # one group
+    wmax = 0                                                   # prefix fn
+
+    def dist(local_rows, global_rows, spec):
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_process_local_data(
+            sharding, np.ascontiguousarray(local_rows), global_rows)
+
+    mine = batches[pid]
+    d_ts = dist(mine.timestamps, (2 * S, R), P("shard", None))
+    d_vals = dist(mine.values, (2 * S, R), P("shard", None))
+    d_ids = dist(ids_all[pid * S:(pid + 1) * S], (2 * S,), P("shard"))
+    d_steps = dist(steps_np, steps_np.shape, P("step"))
+
+    prog = meshmod._build_program(key, F.RATE, Agg.SUM, 1, window_ms,
+                                  wmax, ())
+    out = np.asarray(prog(d_ts, d_vals, d_ids, d_steps))       # [1, T]
+
+    # oracle: host kernels over BOTH shards, summed
+    expected = np.zeros(len(steps_np))
+    for b in batches:
+        stepped = np.asarray(rangefns.apply_range_function(
+            b, srange, window_ms, F.RATE))
+        expected += np.nansum(stepped, axis=0)
+    fin = np.isfinite(out[0])
+    assert fin.any(), "no finite outputs"
+    assert np.allclose(out[0][fin], expected[fin], rtol=1e-9), \
+        (out[0][:5], expected[:5])
+    print(f"RESULT OK {float(np.nansum(out)):.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

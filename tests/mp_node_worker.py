@@ -1,0 +1,103 @@
+"""Worker for the 2-process control-plane test: one FiloServer node in
+its OWN OS process.  Joins the peer via status gossip, waits for shard
+assignment convergence, ingests the deterministic series that route to
+ITS shards, then reports READY and serves until killed — the process
+analog of one forked JVM in the reference's multi-jvm cluster specs
+(reference: standalone/src/multi-jvm/.../ClusterSingletonFailoverSpec).
+
+Usage: python mp_node_worker.py <name> <my_port> <peer_name> <peer_port>
+"""
+
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=2")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+NUM_SHARDS = 4
+N_SERIES = 16
+BASE = 1_700_000_000_000
+
+
+def main() -> None:
+    name, my_port, peer_name, peer_port = sys.argv[1:5]
+    from filodb_tpu.core.record import (RecordBuilder, decode_container,
+                                        partition_hash, shard_key_hash)
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+    from filodb_tpu.standalone import FiloServer
+
+    spread = 2
+    srv = FiloServer({
+        "node": name,
+        "http-port": int(my_port),
+        "status-poll-interval-s": 0.3,
+        "datasets": [{"name": "prom", "num-shards": NUM_SHARDS,
+                      "min-num-nodes": 2, "schema": "gauge",
+                      "spread": spread}],
+        "peers": {peer_name: f"http://127.0.0.1:{peer_port}"},
+    })
+    srv.start()
+    mapper = srv.manager.mapper("prom")
+    deadline = time.time() + 90
+    owned: list = []
+    while time.time() < deadline:
+        owned = sorted(mapper.shards_for_node(name))
+        other = sorted(mapper.shards_for_node(peer_name))
+        running = sorted(
+            srv.coordinator.ingestion["prom"].running_shards())
+        active = sorted(mapper.active_shards())
+        # readiness needs BOTH planes converged: assignment (who owns
+        # what) AND status gossip (every shard ACTIVE in THIS node's
+        # view — the planner serves only active shards)
+        if owned and other and sorted(owned + other) == \
+                list(range(NUM_SHARDS)) and running == owned \
+                and active == list(range(NUM_SHARDS)):
+            break
+        time.sleep(0.2)
+    else:
+        print(f"NEVER_CONVERGED owned={owned} "
+              f"active={sorted(mapper.active_shards())}", flush=True)
+        sys.exit(2)
+
+    # shared deterministic series set; ingest only those routed to
+    # shards THIS node owns
+    opts = DatasetOptions()
+    ms = srv.coordinator.ingestion["prom"].memstore
+    import numpy as np
+    b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], opts)
+    for i in range(N_SERIES):
+        tags = {"_metric_": "mpm", "inst": f"i{i}", "_ws_": "w",
+                "_ns_": "n"}
+        shard = mapper.ingestion_shard(
+            shard_key_hash(tags, opts), partition_hash(tags, opts),
+            spread) % NUM_SHARDS
+        if shard not in owned:
+            continue
+        ts = BASE + np.arange(40, dtype=np.int64) * 10_000
+        b.add_series(ts, [np.cumsum(np.ones(40))], tags)
+    for off, c in enumerate(b.containers()):
+        per: dict = {}
+        for rec in decode_container(c, DEFAULT_SCHEMAS):
+            sh = mapper.ingestion_shard(rec.shard_hash, rec.part_hash,
+                                        spread) % NUM_SHARDS
+            per.setdefault(sh, []).append(rec)
+        for sh, recs in per.items():
+            ms.get_shard("prom", sh).ingest(recs, off)
+
+    print(f"READY {','.join(map(str, owned))}", flush=True)
+    while True:
+        time.sleep(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -89,6 +89,27 @@ class TestStickyStatuses:
         assert m.status(target) == ShardStatus.STOPPED
         poller.stop()
 
+    def test_handoff_stop_does_not_mark_new_owner_stopped(self):
+        """A node stopping its LOCAL ingestion because ownership moved
+        must not record sticky STOPPED against the new owner — that
+        would blind this node's queries to the shard forever (found by
+        the 2-process cluster test: the non-leader served partial
+        results after the initial shard split)."""
+        from filodb_tpu.coordinator.cluster import IngestionStopped
+
+        mgr, det, poller, clock = _mk("node-b", {"node-a": "http://x"})
+        mgr.setup_dataset("ds", 2, min_num_nodes=2)
+        m = mgr.mapper("ds")
+        m.register_node([0], "node-a")       # ownership moved to a
+        m.update_status(0, ShardStatus.ACTIVE)
+        # node-b's ingest thread for shard 0 drains and reports stop
+        mgr.publish_event(IngestionStopped("ds", 0, node="node-b"))
+        assert m.status(0) == ShardStatus.ACTIVE   # untouched
+        # but a stop from the CURRENT owner is a real stop
+        mgr.publish_event(IngestionStopped("ds", 0, node="node-a"))
+        assert m.status(0) == ShardStatus.STOPPED
+        poller.stop()
+
     def test_not_running_demotes_to_assigned(self):
         mgr, det, poller, clock = _mk("node-a", {"node-b": "http://x"})
         mgr.setup_dataset("ds", 2, min_num_nodes=2)
