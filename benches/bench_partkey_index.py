@@ -27,18 +27,49 @@ def main():
                 for i in range(N)]
     pks = [canonical_partkey(t) for t in tag_sets]
 
-    def build():
-        idx = PartKeyIndex()
+    def build(auto_apply=True):
+        idx = PartKeyIndex(auto_apply=auto_apply)
         for pid, (pk, tags) in enumerate(zip(pks, tag_sets)):
             idx.add_partkey(pid, pk, tags, start_time=pid)
         return idx
 
-    t_add = timed(build, reps=1)
-    emit("index add_partkey", N / t_add, "keys/sec", keys=N)
+    # label writes are deferred off the ingest path (the reference pays
+    # them on a background Lucene flush thread): measure the INGEST-
+    # THREAD cost and the off-thread apply separately, plus the legacy
+    # combined walltime (applier racing the add loop on one core)
+    idx0 = None
+
+    def build_deferred():
+        nonlocal idx0
+        idx0 = build(auto_apply=False)
+
+    t_ing = timed(build_deferred, reps=1)
+    emit("index add_partkey (ingest-thread)", N / t_ing, "keys/sec",
+         keys=N)
+    t_apply = timed(idx0.apply_pending, reps=1)
+    emit("index label apply (off-thread)", N / max(t_apply, 1e-9),
+         "keys/sec", keys=N)
+    del idx0                      # ~1M-series: release before lookups
+    combined = None
+
+    def build_combined():
+        nonlocal combined
+        combined = build()
+
+    t_add = timed(build_combined, reps=1)
+    emit("index add_partkey (combined single-core)", N / t_add,
+         "keys/sec", keys=N)
+    # settle its applier backlog NOW: a still-draining daemon thread
+    # would otherwise contend with the lookup timings below
+    combined.apply_pending()
+    del combined
 
     # COLD dashboard lookup: fresh index, first filter ever (pays the
-    # posting materialization) — the reference bar is Lucene's cold seek
+    # posting materialization) — the reference bar is Lucene's cold seek.
+    # Pending label writes are drained first: steady-state serving keeps
+    # the applier caught up, so cold = materialization, not backlog.
     idx = build()
+    idx.apply_pending()
     eq = [ColumnFilter("_metric_", Equals("metric_42"))]
     t_cold = timed(lambda: idx.part_ids_from_filters(eq, 0, 2**62), reps=1)
     emit("index cold equals lookup", t_cold * 1000, "ms", keys=N)

@@ -149,6 +149,30 @@ class _Label:
             self.ensure(pid + 1)
         self.codes[pid] = self.code_of[v]
 
+    def add_many(self, pairs: list[tuple[str, int]]) -> None:
+        """Batched :meth:`add` (the deferred-apply path): one ensure,
+        one vectorized code scatter, Counter-merged value counts."""
+        from collections import Counter
+        by_val = self.by_val
+        code_of = self.code_of
+        self.ensure(max(pid for _v, pid in pairs) + 1)
+        code_list: list[int] = []
+        for v, pid in pairs:
+            p = by_val.get(v)
+            if p is None:
+                p = by_val[v] = _Posting()
+                code_of[v] = self.vgen
+                self.vgen += 1
+            p.pending.append(pid)
+            code_list.append(code_of[v])
+        self.codes[np.fromiter((pid for _v, pid in pairs), np.int64,
+                               len(pairs))] = \
+            np.asarray(code_list, np.int32)
+        vcount = self.vcount
+        for v, c in Counter(v for v, _pid in pairs).items():
+            vcount[v] = vcount.get(v, 0) + c
+        self.gen += len(pairs)
+
     def matching_values(self, flt) -> list[str]:
         """Values of this label matching a regex filter, via one pass of
         the compiled pattern over the newline-joined value corpus;
@@ -189,7 +213,10 @@ class _Label:
 class PartKeyIndex:
     """One index per shard; partition ids are dense ints assigned by the shard."""
 
-    def __init__(self) -> None:
+    def __init__(self, auto_apply: bool = True) -> None:
+        # auto_apply=False suppresses the background applier (bulk
+        # loads / benches that drain explicitly via apply_pending)
+        self._auto_apply = auto_apply
         self._labels: dict[str, _Label] = {}
         self._tags: dict[int, dict[str, str]] = {}
         self._partkeys: dict[int, bytes] = {}
@@ -208,6 +235,14 @@ class PartKeyIndex:
         # monotone mutation counter: lookup caches key on it so repeated
         # dashboard filters skip the postings walk until the index changes
         self.version = 0
+        # DEFERRED label writes (reference: PartKeyLuceneIndex.scala:151
+        # — documents land on a background Lucene flush thread, not the
+        # ingest path): add_partkey records only the O(1) lifetime state
+        # and queues the posting/value-code work; an applier thread (or
+        # the next lookup) drains it under the same lock
+        self._pending_adds: list[tuple[int, dict]] = []
+        self._pending_cv = threading.Condition(self._lock)
+        self._applier_alive = False
 
     def __len__(self) -> int:
         return len(self._tags)
@@ -228,27 +263,82 @@ class PartKeyIndex:
 
     def add_partkey(self, part_id: int, partkey: bytes, tags: dict[str, str],
                     start_time: int, end_time: int = _NO_END) -> None:
+        """INGEST-THREAD cost is O(1): lifetime arrays + tag/partkey maps
+        are written immediately (the ingest path reads them right back);
+        the per-label posting/value-code writes — the expensive part —
+        are queued for the applier thread / next lookup."""
         with self._lock:
-            self._add_partkey_locked(part_id, partkey, tags, start_time,
-                                     end_time)
+            self.version += 1
+            self._grow(part_id)
+            self._tags[part_id] = tags
+            self._partkeys[part_id] = partkey
+            self._start_arr[part_id] = start_time
+            self._end_arr[part_id] = end_time
+            self._alive[part_id] = True
+            if part_id > self._max_pid:
+                self._max_pid = part_id
+            self._pending_adds.append((part_id, tags))
+            n = len(self._pending_adds)
+            if n > 256 and not self._applier_alive and self._auto_apply:
+                # spawn lazily past a real backlog so short-lived test
+                # indexes never pay a thread; exits again when idle
+                self._applier_alive = True
+                threading.Thread(target=self._applier_loop,
+                                 name="pkindex-applier",
+                                 daemon=True).start()
+            if n & 1023 == 0:          # amortize the notify cost
+                self._pending_cv.notify()
 
-    def _add_partkey_locked(self, part_id, partkey, tags, start_time,
-                            end_time):
-        self.version += 1
-        self._grow(part_id)
-        self._tags[part_id] = tags
-        self._partkeys[part_id] = partkey
-        self._start_arr[part_id] = start_time
-        self._end_arr[part_id] = end_time
-        self._alive[part_id] = True
-        if part_id > self._max_pid:
-            self._max_pid = part_id
+    def _apply_chunk_locked(self, chunk) -> None:
         labels = self._labels
-        for k, v in tags.items():
+        tags_map = self._tags
+        per_label: dict[str, list] = {}
+        for pid, tags in chunk:
+            if tags_map.get(pid) is not tags:
+                continue       # removed/replaced before its labels landed
+            for k, v in tags.items():
+                lst = per_label.get(k)
+                if lst is None:
+                    lst = per_label[k] = []
+                lst.append((v, pid))
+        for k, pairs in per_label.items():
             lab = labels.get(k)
             if lab is None:
                 lab = labels[k] = _Label()
-            lab.add(v, part_id)
+            lab.add_many(pairs)
+
+    def _drain_pending_locked(self) -> None:
+        """Apply EVERY queued label write; caller holds the lock.  Every
+        posting/label read path runs this first, so lookups always see
+        the full index regardless of applier progress."""
+        if self._pending_adds:
+            chunk = self._pending_adds
+            self._pending_adds = []
+            self._apply_chunk_locked(chunk)
+
+    def apply_pending(self) -> None:
+        """Drain queued label writes now (flush-executor hook; tests)."""
+        with self._lock:
+            self._drain_pending_locked()
+
+    def _applier_loop(self) -> None:
+        """Background writer (the Lucene flush-thread analog): drains in
+        bounded chunks so a 1M-series burst never starves the ingest
+        thread on the lock; exits after sustained idleness."""
+        idle = 0
+        while True:
+            with self._pending_cv:
+                if not self._pending_adds:
+                    if not self._pending_cv.wait(timeout=5.0):
+                        idle += 1
+                        if idle >= 6:          # ~30s idle: retire
+                            self._applier_alive = False
+                            return
+                        continue
+                idle = 0
+                chunk = self._pending_adds[:8192]
+                del self._pending_adds[:8192]
+                self._apply_chunk_locked(chunk)
 
     def update_end_time(self, part_id: int, end_time: int) -> None:
         """Marks a series stopped (reference: updatePartKeyWithEndTime, used
@@ -271,6 +361,11 @@ class PartKeyIndex:
             self._remove_locked(part_ids)
 
     def _remove_locked(self, part_ids) -> None:
+        # settle queued label writes first: a pending add for a pid we
+        # are about to remove would otherwise land AFTER the removal
+        # (ghost postings), and _compact rebuilding from _tags would
+        # double-apply whatever is still queued
+        self._drain_pending_locked()
         self.version += 1
         for pid in part_ids:
             tags = self._tags.pop(pid, None)
@@ -462,6 +557,7 @@ class PartKeyIndex:
         life overlaps the query range (reference: partIdsFromFilters +
         __endTime__ >= start && __startTime__ <= end clauses)."""
         with self._lock:
+            self._drain_pending_locked()
             ids = self._candidate_ids(filters)
         if len(ids):
             # .take with a pre-cast int64 index is ~2x a plain fancy
@@ -509,6 +605,7 @@ class PartKeyIndex:
             # writers mutate _labels / vcount under _lock; snapshot under
             # it so a concurrent add_partkey can't resize mid-iteration
             with self._lock:
+                self._drain_pending_locked()
                 return sorted(k for k, lab in list(self._labels.items())
                               if lab.vcount)
         names: set[str] = set()
@@ -523,6 +620,7 @@ class PartKeyIndex:
         faceting when unfiltered; filtered path scans matching docs)."""
         if not filters:
             with self._lock:
+                self._drain_pending_locked()
                 lab = self._labels.get(label)
                 out = sorted(lab.vcount.keys()) if lab is not None else []
         else:

@@ -395,6 +395,66 @@ class TestBloom:
         assert fp < 300  # ~1% target
 
 
+class TestDeferredLabelWrites:
+    """add_partkey queues label/posting writes off the ingest path
+    (reference: PartKeyLuceneIndex's background flush thread); lookups
+    drain first, so deferral must never be observable."""
+
+    def test_lookup_sees_adds_before_applier_runs(self):
+        idx = PartKeyIndex(auto_apply=False)
+        for i in range(50):
+            idx.add_partkey(i, str(i).encode(), gauge_tags(i),
+                            start_time=1000 + i)
+        assert idx._pending_adds            # still queued
+        ids = idx.part_ids_from_filters([eq("_ns_", "App-0")])
+        assert list(ids) == [0, 8, 16, 24, 32, 40, 48]
+        assert not idx._pending_adds        # lookup drained them
+
+    def test_lifetime_reads_visible_immediately(self):
+        idx = PartKeyIndex(auto_apply=False)
+        idx.add_partkey(7, b"7", gauge_tags(7), start_time=123)
+        # the ingest thread reads these right back, pre-drain
+        assert idx.start_time(7) == 123
+        idx.mark_active(7)
+        idx.update_end_time(7, 999)
+        assert idx.end_time(7) == 999
+        assert idx.partkey(7) == b"7"
+
+    def test_remove_racing_pending_add_leaves_no_ghost(self):
+        idx = PartKeyIndex(auto_apply=False)
+        for i in range(20):
+            idx.add_partkey(i, str(i).encode(), gauge_tags(i),
+                            start_time=i)
+        idx.remove([0, 8])                 # labels still queued
+        ids = idx.part_ids_from_filters([eq("_ns_", "App-0")])
+        assert list(ids) == [16]
+        vals = idx.label_values("instance")
+        assert "0" not in vals and "8" not in vals
+
+    def test_label_surfaces_drain(self):
+        idx = PartKeyIndex(auto_apply=False)
+        for i in range(10):
+            idx.add_partkey(i, str(i).encode(), gauge_tags(i),
+                            start_time=i)
+        assert "instance" in idx.label_names()
+        assert idx.label_values("instance") == sorted(
+            str(i) for i in range(10))
+
+    def test_background_applier_converges(self):
+        import time
+
+        idx = PartKeyIndex()               # auto_apply on
+        for i in range(2000):              # past the spawn threshold
+            idx.add_partkey(i, str(i).encode(), gauge_tags(i),
+                            start_time=i)
+        deadline = time.time() + 10
+        while time.time() < deadline and idx._pending_adds:
+            time.sleep(0.05)
+        # whether the applier finished or the lookup drains the tail:
+        ids = idx.part_ids_from_filters([eq("_ws_", "demo")])
+        assert len(ids) == 2000
+
+
 class TestIndexRegexCorpusSoundness:
     """The joined-corpus regex trick must fall back to per-value
     matching for patterns that can span corpus lines or capture."""
