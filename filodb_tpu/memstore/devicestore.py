@@ -264,10 +264,10 @@ class _Block:
     (ops/grid.py PHASE_OPS)."""
 
     __slots__ = ("ts", "vals", "lanes", "nbytes", "last_used",
-                 "fmin", "fmax", "fcnt", "pmin", "pmax")
+                 "fmin", "fmax", "fcnt", "pmin", "pmax", "staged_hi")
 
     def __init__(self, ts, vals, lanes: int, seq: int, fill_stats,
-                 phase_stats):
+                 phase_stats, staged_hi: int):
         self.ts = ts
         self.vals = vals
         self.lanes = lanes
@@ -275,6 +275,10 @@ class _Block:
         self.last_used = seq
         self.fmin, self.fmax, self.fcnt = fill_stats
         self.pmin, self.pmax = phase_stats
+        # lanes < staged_hi were populated at build time; a lane at or
+        # beyond it belongs to a partition that joined later and is NOT
+        # represented in this block (it must rebuild, never serve NaN)
+        self.staged_hi = staged_hi
 
     def dense_or_empty(self, a: int, b: int):
         """Per-lane (dense, empty) bool masks: lane is provably dense
@@ -324,6 +328,11 @@ class DeviceGridCache:
         # mesh staging memo: (row0, nrows) -> (parts identity, staged
         # ts, staged vals) — see mesh_plan
         self._mesh_stage_memo: dict[tuple, tuple] = {}
+        # full-plan memo: a repeat dashboard query re-pays the dense/
+        # phase proof walk (~40ms at 20k lanes) without it.  Keys carry
+        # every invalidation axis (cache version, ingest epoch, removal
+        # epoch, id-list fingerprint); cleared on freeze/repin/reclaim
+        self._plan_memo: dict[tuple, "_GridPlan"] = {}
         self._seq = 0
         self._lock = threading.Lock()
         # stats
@@ -350,6 +359,7 @@ class DeviceGridCache:
             self._tails.clear()
             self._phase_memo.clear()
             self._mesh_stage_memo.clear()
+            self._plan_memo.clear()
             self.version += 1
 
     def note_freeze(self, cs) -> None:
@@ -358,6 +368,7 @@ class DeviceGridCache:
         its ``ingest_epoch`` — our tail version — separately.)"""
         with self._lock:
             self._tails.clear()
+            self._plan_memo.clear()       # tail plans reference old epoch
             if self.gstep is None or self.epoch0 is None:
                 return
             lo_block = (cs.info.start_time - self.epoch0) // (
@@ -397,6 +408,7 @@ class DeviceGridCache:
         self.disabled_until_version = self._shard.ingest_epoch + backoff
         self.blocks.clear()
         self._tails.clear()
+        self._plan_memo.clear()            # plans pin the dropped blocks
         # re-probe the bucket scheme on the next attempt: a widened
         # histogram (16 -> 20 buckets) must not disable the fast path
         # forever once the narrow chunks age out
@@ -589,7 +601,7 @@ class DeviceGridCache:
         epoch = shard.removal_epoch
         ids = [int(p) for p in part_ids]
         for pid in ids:
-            part = shard.partitions.get(pid)
+            part = shard.grid_partition(pid)
             if part is None:
                 return None                    # evicted/paged: fall back
             if part.schema.schema_hash != self.schema_hash:
@@ -622,7 +634,7 @@ class DeviceGridCache:
         # ALL eligibility checks run before _prep_for assigns lanes —
         # an ineligible query must not widen the lane count (that would
         # clear every resident block on the next eligible query)
-        first = shard.partitions.get(int(part_ids[0]))
+        first = shard.grid_partition(int(part_ids[0]))
         if first is None or first.schema.schema_hash != self.schema_hash:
             return None
         if self.gstep is None:
@@ -642,6 +654,15 @@ class DeviceGridCache:
         if self._bigk_deny.get(deny_key) == \
                 (self.version, shard.ingest_epoch):
             return None     # dense proof failed for this shape; data unchanged
+        pkey = (func, steps0, nsteps, step_ms, window_ms, fargs, ids_fp,
+                self.version, shard.ingest_epoch, shard.removal_epoch)
+        cached = self._plan_memo.get(pkey)
+        if cached is not None:
+            self._seq += 1
+            for blk in cached.segs:
+                blk.last_used = self._seq
+            self.hits += 1
+            return cached
         if self.hist and self.hb is None:
             # probe a narrow leading slice for the bucket scheme — a
             # full-history read_range would decode (and memoize) every
@@ -655,7 +676,7 @@ class DeviceGridCache:
             self.hb = int(buckets.num_buckets)
             self.bucket_tops = np.asarray(buckets.bucket_tops(), np.float64)
         if self.epoch0 is None:
-            parts0 = (shard.partitions.get(int(pid)) for pid in part_ids)
+            parts0 = (shard.grid_partition(int(pid)) for pid in part_ids)
             earliest = [p.earliest_timestamp for p in parts0 if p is not None]
             first_ts = min((t for t in earliest if t >= 0), default=-1)
             if first_ts < 0:
@@ -678,7 +699,7 @@ class DeviceGridCache:
             # with older chunks on disk; the grid would serve NaN there.
             # This runs BEFORE _prep_for so a rejected query cannot
             # widen the lane count (see the invariant above).
-            parts = [shard.partitions.get(int(pid)) for pid in part_ids]
+            parts = [shard.grid_partition(int(pid)) for pid in part_ids]
             if any(p is None for p in parts):
                 return None
             lo_ms = self.epoch0 + (c0 - 1) * g
@@ -692,13 +713,19 @@ class DeviceGridCache:
         if any(b.lanes != lanes for b in self.blocks.values()):
             self.blocks.clear()                # widths must match to concat
             self._tails.clear()
+            self._plan_memo.clear()            # plans pin old-width blocks
         frozen_hi = self._frozen_high()
         bi_lo = c0 // BLOCK_BUCKETS
         bi_hi = c_last // BLOCK_BUCKETS
+        # a block built BEFORE some requested partition got its lane has
+        # that lane unstaged (all-NaN): it would pass the dense proof as
+        # "empty" and silently serve NaN for a series that has data —
+        # any such block must rebuild with the current lane roster
+        need_hi = int(prep["lane_idx"].max()) + 1
         segments = []
         self._seq += 1
         for bi in range(bi_lo, bi_hi + 1):
-            blk = self._block_for(bi, lanes, frozen_hi)
+            blk = self._block_for(bi, lanes, frozen_hi, need_hi)
             if blk is None:
                 return None                    # invariant violated
             blk.last_used = self._seq
@@ -784,10 +811,14 @@ class DeviceGridCache:
         # phase mode and ts-free ops need no ts plane in the program
         ts_parts = () if (phase_dev is not None or op in TS_FREE_OPS) \
             else tuple(b.ts for b in segments)
-        return _GridPlan(ts_parts,
+        plan = _GridPlan(ts_parts,
                          tuple(b.vals for b in segments), row0,
                          steps0 - self.epoch0, q, lane_mult, nrows, ncols,
                          prep["lane_idx"], phase_dev, tuple(segments))
+        if len(self._plan_memo) > 8:
+            self._plan_memo.clear()
+        self._plan_memo[pkey] = plan
+        return plan
 
     def _phase_device(self, ph_req, req, ncols: int, key) -> object:
         """Device [ncols] phase vector for the uniform-phase kernels,
@@ -846,7 +877,7 @@ class DeviceGridCache:
         recent blocks to per-epoch-rebuilt tail blocks."""
         lo = None
         for pid in self.lane_of:
-            part = self._shard.partitions.get(pid)
+            part = self._shard.grid_partition(pid)
             if part is None:
                 continue
             if part._buf_n:
@@ -857,9 +888,11 @@ class DeviceGridCache:
         # bucket containing lo is NOT fully frozen
         return (lo - self.epoch0 + self.gstep - 1) // self.gstep - 1
 
-    def _block_for(self, bi: int, lanes: int, frozen_hi: int):
+    def _block_for(self, bi: int, lanes: int, frozen_hi: int,
+                   need_hi: int):
         blk = self.blocks.get(bi)
-        if blk is not None and blk.lanes == lanes:
+        if blk is not None and blk.lanes == lanes \
+                and blk.staged_hi >= need_hi:
             return blk
         b_lo = bi * BLOCK_BUCKETS          # first bucket index of the block
         b_hi = b_lo + BLOCK_BUCKETS - 1
@@ -868,7 +901,9 @@ class DeviceGridCache:
             # the shard's ingest epoch so repeat queries skip the rebuild
             epoch = self._shard.ingest_epoch
             got = self._tails.get(bi)
-            if got is not None and got[0] == epoch and got[1].lanes == lanes:
+            if got is not None and got[0] == epoch \
+                    and got[1].lanes == lanes \
+                    and got[1].staged_hi >= need_hi:
                 return got[1]
             blk = self._build(bi, lanes)
             if blk is not None:
@@ -906,7 +941,7 @@ class DeviceGridCache:
         val_stage = np.full((BLOCK_BUCKETS, lanes * stride), np.nan,
                             self._val_dtype())
         for pid, lane in self.lane_of.items():
-            part = self._shard.partitions.get(pid)
+            part = self._shard.grid_partition(pid)
             if part is None:
                 continue
             ts, vals = part.read_range(b_lo_ms + 1, b_hi_ms, self.column_id)
@@ -956,7 +991,8 @@ class DeviceGridCache:
         dev = self._shard.grid_device      # mesh-pinned; None = default
         return _Block(jax.device_put(ts_stage, dev),
                       jax.device_put(val_stage, dev),
-                      lanes, self._seq, (fmin, fmax, fcnt), (pmin, pmax))
+                      lanes, self._seq, (fmin, fmax, fcnt), (pmin, pmax),
+                      staged_hi=self._next_lane)
 
     def _reclaim(self, target_bytes: int, keep: set) -> int:
         """Oldest-first reclaim down to ``target_bytes`` (the reference's
@@ -970,6 +1006,10 @@ class DeviceGridCache:
             freed += self.blocks[victims[0]].nbytes
             del self.blocks[victims[0]]
             self.evictions += 1
+        if freed:
+            # memoized plans hold strong block refs: drop them so the
+            # reclaim actually releases HBM
+            self._plan_memo.clear()
         return freed
 
     def _evict(self, keep: set) -> None:

@@ -46,11 +46,17 @@ class _PagedPartitions:
     from HTTP handler threads, so the OrderedDict reorder + byte accounting
     must not interleave."""
 
-    def __init__(self, max_bytes: int):
+    def __init__(self, max_bytes: int, on_evict=None):
         self.max_bytes = max_bytes
         self._entries: OrderedDict = OrderedDict()   # key -> (value, nbytes)
         self._bytes = 0
         self._lock = threading.Lock()
+        # called AFTER put releases the lock when LRU pressure dropped an
+        # entry (deadlock-safe; implementations must not assume mutual
+        # exclusion with concurrent put/get) — the ODP shard bumps its
+        # removal epoch so grid plan memos referencing the evicted
+        # partition revalidate
+        self._on_evict = on_evict
 
     def get(self, key):
         with self._lock:
@@ -67,9 +73,13 @@ class _PagedPartitions:
                 self._bytes -= old[1]
             self._entries[key] = (value, nbytes)
             self._bytes += nbytes
+            evicted = False
             while self._bytes > self.max_bytes and len(self._entries) > 1:
                 _, (_ev, nb) = self._entries.popitem(last=False)
                 self._bytes -= nb
+                evicted = True
+        if evicted and self._on_evict is not None:
+            self._on_evict()
 
     def pop(self, key) -> None:
         with self._lock:
@@ -89,7 +99,8 @@ class OnDemandPagingShard(TimeSeriesShard):
     def __init__(self, *args, page_cache_bytes: int = 256 * 1024 * 1024,
                  **kwargs):
         super().__init__(*args, **kwargs)
-        self.paged = _PagedPartitions(page_cache_bytes)
+        self.paged = _PagedPartitions(page_cache_bytes,
+                                      on_evict=self._on_page_evict)
         # serializes page-in / backfill store reads across query threads so
         # concurrent misses for the same partition don't duplicate work
         self._odp_lock = threading.Lock()
@@ -99,6 +110,9 @@ class OnDemandPagingShard(TimeSeriesShard):
         self.stats.partitions_paged = 0
         self.stats.chunks_paged = 0
 
+    def _on_page_evict(self) -> None:
+        self.removal_epoch += 1
+
     # ------------------------------------------------------------ resolution
 
     def _partition_for_scan(self, part_id: int) -> Optional[TimeSeriesPartition]:
@@ -107,6 +121,20 @@ class OnDemandPagingShard(TimeSeriesShard):
             part = pinned.get(part_id)
             if part is not None:
                 return part
+        part = self.partitions.get(part_id)
+        if part is None:
+            part = self.paged.get(part_id)
+        return part
+
+    def grid_partition(self, part_id: int) -> Optional[TimeSeriesPartition]:
+        """PAGED partitions serve the device grid too: once a dashboard
+        pages history in, its chunks register as grid blocks and repeat
+        hits serve at device speed (reference:
+        DemandPagedChunkStore.scala:34 pages into block memory).  Paged
+        partitions hold their FULL persisted history, so the grid's
+        disk-floor proof passes naturally; page-cache eviction bumps the
+        shard's removal epoch, invalidating grid plans that referenced
+        the evicted partition."""
         part = self.partitions.get(part_id)
         if part is None:
             part = self.paged.get(part_id)

@@ -546,6 +546,15 @@ class TimeSeriesShard:
         consult its paged-partition cache as well."""
         return self.partitions.get(part_id)
 
+    def grid_partition(self, part_id: int) -> Optional[TimeSeriesPartition]:
+        """Resolve a part id for the DEVICE GRID (devicestore.py block
+        builds and plan validation).  The ODP shard overrides this to
+        serve PAGED partitions too — paged-in history registers as grid
+        blocks, so a repeat dashboard hit over evicted ranges serves at
+        device speed (reference: DemandPagedChunkStore.scala:34 pages
+        straight into block memory and serves identically)."""
+        return self.partitions.get(part_id)
+
     # --------------------------------------------------- device-resident scan
 
     def _on_chunk_freeze(self, cs) -> None:
@@ -575,7 +584,7 @@ class TimeSeriesShard:
         shard's lookup cache keeps the array alive and stable."""
         if len(part_ids) == 0:
             return None
-        first = self.partitions.get(int(part_ids[0]))
+        first = self.grid_partition(int(part_ids[0]))
         if first is None:
             return None
         cid = first.schema.data.value_column_id if column_id is None \
@@ -609,7 +618,7 @@ class TimeSeriesShard:
         vals, tops = served
         tags_list = []
         for pid in ids:
-            part = self.partitions.get(int(pid))
+            part = self.grid_partition(int(pid))
             if part is None:
                 return None   # concurrently evicted mid-query: fall back
             tags_list.append(part.tags)

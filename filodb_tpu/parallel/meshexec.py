@@ -307,7 +307,7 @@ class MeshAggregateExec(ExecPlan):
             gids[:] = union.setdefault((), len(union))
             return gids
         for i, pid in enumerate(part_ids):
-            part = shard.partitions.get(int(pid))
+            part = shard.grid_partition(int(pid))
             if part is None:
                 return None
             key = tuple(sorted(grouping_key(part.tags, self.by,

@@ -395,7 +395,7 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         else:
             gids = []
             for pid in part_ids:
-                part = shard.partitions.get(int(pid))
+                part = shard.grid_partition(int(pid))
                 if part is None:
                     return None
                 key = tuple(sorted(grouping_key(part.tags, mapred.by,

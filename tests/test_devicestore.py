@@ -63,6 +63,55 @@ def _steps(n_rows):
 
 
 class TestDeviceGrid:
+    def test_late_lane_partitions_rebuild_blocks(self):
+        """A partition that gets its lane AFTER blocks were built (a
+        second metric of the same schema, or a just-paged-in series)
+        must trigger a block rebuild — its unstaged lanes would
+        otherwise pass the dense proof as 'empty' and silently serve
+        all-NaN for real data."""
+        from filodb_tpu.core.filters import ColumnFilter, Equals
+        from filodb_tpu.ops.windows import StepRange
+        from filodb_tpu.query import rangefns
+
+        ms = TimeSeriesMemStore()
+        shard = ms.setup("prom", DEFAULT_SCHEMAS, 0, StoreConfig())
+        rng = np.random.default_rng(3)
+        b = RecordBuilder(DEFAULT_SCHEMAS["prom-counter"])
+        for metric in ("m_a", "m_b"):
+            for i in range(3):
+                tags = {"__name__": metric, "instance": f"i{i}",
+                        "_ws_": "w", "_ns_": "n"}
+                base = T0 + np.arange(50, dtype=np.int64) * STEP - STEP + 1
+                vals = np.cumsum(rng.random(50) * 5)
+                for t, v in zip(base, vals):
+                    b.add(int(t), [float(v)], tags)
+        for off, c in enumerate(b.containers()):
+            shard.ingest(decode_container(c, DEFAULT_SCHEMAS), off)
+        shard.flush_all()
+        steps0, nsteps = _steps(50)
+        # metric A builds the blocks with only ITS lanes staged
+        res_a = shard.lookup_partitions(
+            [ColumnFilter("_metric_", Equals("m_a"))], 0, 2**62)
+        got_a = shard.scan_grid(res_a.part_ids, F.RATE, steps0, nsteps,
+                                STEP, WINDOW)
+        assert got_a is not None
+        # metric B gets lanes AFTER the build: must serve real values
+        res_b = shard.lookup_partitions(
+            [ColumnFilter("_metric_", Equals("m_b"))], 0, 2**62)
+        got_b = shard.scan_grid(res_b.part_ids, F.RATE, steps0, nsteps,
+                                STEP, WINDOW)
+        assert got_b is not None
+        _tags, vals_b, _ = got_b
+        assert np.isfinite(vals_b).any(), \
+            "late-lane metric served all-NaN from stale blocks"
+        t2, batch = shard.scan_batch(res_b.part_ids, steps0 - WINDOW,
+                                     steps0 + (nsteps - 1) * STEP)
+        sr = StepRange(steps0, steps0 + (nsteps - 1) * STEP, STEP)
+        oracle = np.asarray(rangefns.apply_range_function(
+            batch, sr, WINDOW, F.RATE))
+        np.testing.assert_allclose(vals_b, oracle[:len(vals_b)],
+                                   rtol=1e-6, equal_nan=True)
+
     def test_matches_scan_batch_path(self):
         from filodb_tpu.ops.windows import StepRange
         from filodb_tpu.query import rangefns

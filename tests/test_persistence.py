@@ -297,6 +297,102 @@ class TestOnDemandPaging:
             next(pk for pk, pid in shard.part_set.items()
                  if pid == before["i0"])] == before["i0"]
 
+    def test_paged_partitions_serve_device_grid(self, tmp_path):
+        """Once a dashboard pages evicted history in, repeat hits must
+        serve from the DEVICE GRID (reference: DemandPagedChunkStore
+        pages straight into block memory and serves identically)."""
+        from filodb_tpu.ops.windows import StepRange
+        from filodb_tpu.query import rangefns
+        from filodb_tpu.query.logical import RangeFunctionId as F
+
+        disk = DiskColumnStore(str(tmp_path / "c.db"))
+        meta = DiskMetaStore(str(tmp_path / "m.db"))
+        store = TimeSeriesMemStore(disk, meta)
+        shard = store.setup("prom", DEFAULT_SCHEMAS, 0,
+                            StoreConfig(groups_per_shard=2))
+        step = 10_000
+        t0 = 1_700_000_000_000
+        n_rows = 120
+        schema = DEFAULT_SCHEMAS["gauge"]
+        builder = RecordBuilder(schema)
+        rng = np.random.default_rng(5)
+        for s in range(6):
+            tags = {"__name__": "pg", "job": "app", "instance": f"i{s}",
+                    "_ws_": "demo", "_ns_": "ns"}
+            ts = t0 + np.arange(n_rows, dtype=np.int64) * step
+            vals = np.cumsum(rng.random(n_rows))
+            for t, v in zip(ts, vals):
+                builder.add(int(t), [float(v)], tags)
+        for off, c in enumerate(builder.containers()):
+            shard.ingest_container(c, off)
+        shard.flush_all()
+        shard.evict_partitions(6)
+        assert shard.num_partitions == 0
+
+        flt = [ColumnFilter("_metric_", Equals("pg"))]
+        res = shard.lookup_partitions(flt, 0, 2**62)
+        assert len(res.part_ids) == 6
+        # first hit: pages chunks back from the column store
+        tags_list, batch = shard.scan_batch(res.part_ids, 0, 2**62)
+        assert shard.stats.partitions_paged == 6
+        # repeat hit: the grid must serve the PAGED partitions
+        steps0 = t0 + 120_000
+        nsteps = 40
+        got = shard.scan_grid(res.part_ids, F.RATE, steps0, nsteps,
+                              step, 120_000)
+        assert got is not None, "grid did not serve paged partitions"
+        gtags, vals, _tops = got
+        sr = StepRange(steps0, steps0 + (nsteps - 1) * step, step)
+        oracle = np.asarray(rangefns.apply_range_function(
+            batch, sr, 120_000, F.RATE))
+        order = {t["instance"]: i for i, t in enumerate(tags_list)}
+        for i, t in enumerate(gtags):
+            j = order[t["instance"]]
+            np.testing.assert_allclose(vals[i], oracle[j], rtol=1e-9,
+                                       equal_nan=True)
+
+    def test_page_evict_invalidates_grid_plan(self, tmp_path):
+        """LRU pressure dropping a paged partition must invalidate grid
+        plans that referenced it — a repeat query falls back (and
+        re-pages), never serves stale/empty lanes."""
+        from filodb_tpu.query.logical import RangeFunctionId as F
+
+        disk = DiskColumnStore(str(tmp_path / "c.db"))
+        meta = DiskMetaStore(str(tmp_path / "m.db"))
+        store = TimeSeriesMemStore(disk, meta)
+        shard = store.setup("prom", DEFAULT_SCHEMAS, 0,
+                            StoreConfig(groups_per_shard=2))
+        step = 10_000
+        t0 = 1_700_000_000_000
+        builder = RecordBuilder(DEFAULT_SCHEMAS["gauge"])
+        for s in range(4):
+            tags = {"__name__": "pe", "job": "app", "instance": f"i{s}",
+                    "_ws_": "demo", "_ns_": "ns"}
+            for r in range(100):
+                builder.add(t0 + r * step, [float(s * 100 + r)], tags)
+        for off, c in enumerate(builder.containers()):
+            shard.ingest_container(c, off)
+        shard.flush_all()
+        shard.evict_partitions(4)
+        flt = [ColumnFilter("_metric_", Equals("pe"))]
+        res = shard.lookup_partitions(flt, 0, 2**62)
+        shard.scan_batch(res.part_ids, 0, 2**62)     # page everything in
+        epoch_before = shard.removal_epoch
+        got = shard.scan_grid(res.part_ids, F.RATE, t0 + 120_000, 20,
+                              step, 120_000)
+        assert got is not None
+        # simulate LRU pressure: shrink the cache and add an entry
+        shard.paged.max_bytes = 1
+        shard.paged.put(999_999, object(), 10)       # forces eviction
+        assert shard.removal_epoch > epoch_before
+        got2 = shard.scan_grid(res.part_ids, F.RATE, t0 + 120_000, 20,
+                               step, 120_000)
+        if got2 is not None:
+            # re-validated and re-served (e.g. repaged): must be correct
+            _t2, v2, _ = got2
+            _t1, v1, _ = got
+            np.testing.assert_allclose(v2, v1, rtol=1e-9, equal_nan=True)
+
     def test_query_data_cap(self, tmp_path):
         disk, shard, truth = self._setup(tmp_path,
                                          max_data_per_shard_query=16)
