@@ -103,10 +103,14 @@ RESOLUTIONS = (60_000, 900_000, 3_600_000)   # 1m / 15m / 1h
 def main():
     rng = np.random.default_rng(0)
     with tempfile.TemporaryDirectory() as tmp:
+        from filodb_tpu.core.storeconfig import StoreConfig
         disk = DiskColumnStore(str(pathlib.Path(tmp) / "c.db"))
         meta = DiskMetaStore(str(pathlib.Path(tmp) / "m.db"))
         store = TimeSeriesMemStore(disk, meta)
-        store.setup("prom", DEFAULT_SCHEMAS, 0)
+        # hourly raw chunks (720 rows at 5s cadence), the reference's
+        # flush-interval chunk geometry
+        store.setup("prom", DEFAULT_SCHEMAS, 0,
+                    StoreConfig(max_chunks_size=720))
         b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], DatasetOptions())
         ts = T0 + np.arange(N_ROWS, dtype=np.int64) * STEP
         for i in range(N_SERIES):

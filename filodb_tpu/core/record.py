@@ -159,9 +159,22 @@ class RecordBuilder:
             norm = dict(tags)
             norm[mcol] = norm.pop("__name__")
             tags = norm
-        shash = shard_key_hash(tags, self.options)
-        phash = partition_hash(tags, self.options)
-        pk = canonical_partkey(tags)
+        return self.add_series_hashed(
+            timestamps, columns, shard_key_hash(tags, self.options),
+            partition_hash(tags, self.options), canonical_partkey(tags))
+
+    def add_series_hashed(self, timestamps: Sequence,
+                          columns: Sequence[Sequence], shash: int,
+                          phash: int, pk: bytes) -> int:
+        """:meth:`add_series` with the per-series hashes/partkey already
+        computed — the gateway's columnar ingest memoizes them per
+        series across batches, so recomputing them per call would be
+        a third of its cost.  Numeric schemas only (the caller already
+        normalized tags into ``pk``)."""
+        n = len(timestamps)
+        if n == 0:
+            return 0
+        data_cols = self.schema.data.columns[1:]
         fields = [("schema", "<u2"), ("shash", "<u4"), ("phash", "<u4"),
                   ("ts", "<i8")]
         for ci, col in enumerate(data_cols):

@@ -121,6 +121,27 @@ def stage_grid(ts_list: Sequence[np.ndarray], cols_list: Sequence[Sequence],
     present = np.zeros((B, S), bool)
     eligible = np.ones(S, bool)
     has_reset = np.zeros(S, bool)
+    # FAST PATH: every series on the identical timestamp vector (the
+    # scrape-aligned common case) — one row-slice assignment replaces
+    # the flat 2-D scatter and the per-series eligibility walk runs once
+    b0 = buckets_list[0]
+    if len(b0) and all(b is b0 or np.array_equal(b, b0)
+                       for b in buckets_list):
+        rows0 = b0 - c_start
+        if rows0[0] >= 0 and not (np.diff(b0) <= 0).any():
+            if reset_col is not None:
+                for s, cols in enumerate(cols_list):
+                    if len(cols[reset_col]) > 1:
+                        with np.errstate(invalid="ignore"):
+                            if (np.diff(cols[reset_col]) < 0).any():
+                                has_reset[s] = True
+            present[rows0, :] = True
+            for ci in range(ncols):
+                stacked = np.stack([cols[ci] for cols in cols_list],
+                                   axis=1)              # [n, S]
+                vals[ci][rows0, :] = stacked
+            return StagedGrid(g, c_start, vals, present, eligible,
+                              has_reset)
     # per-series eligibility walk, then ONE scatter across the batch
     rows_parts, scol_parts, col_parts = [], [], [[] for _ in range(ncols)]
     for s, (b, cols) in enumerate(zip(buckets_list, cols_list)):

@@ -159,14 +159,23 @@ class ShardDownsampler:
                         for o in outs]
                 pends = np.asarray(pends)
                 plive = np.asarray(plive)
+                pe_all = pends.astype(np.int64)
                 for si, (tags, _ts, _cols) in enumerate(decoded):
                     if not served[si]:
                         continue
                     pm = plive[:, si]
-                    if not pm.any():
+                    if pm.all():
+                        # fully-live series (the aligned common case):
+                        # column views, no mask scan/copy per column
+                        pe = pe_all
+                        cols = [out[:, si] for out in outs
+                                if out is not None]
+                    elif pm.any():
+                        pe = pends[pm].astype(np.int64)
+                        cols = [out[pm, si] for out in outs
+                                if out is not None]
+                    else:
                         continue
-                    pe = pends[pm].astype(np.int64)
-                    cols = [out[pm, si] for out in outs if out is not None]
                     results.append((tags, pe, cols))
         for si, (tags, ts, cols) in enumerate(decoded):
             if served is not None and served[si]:

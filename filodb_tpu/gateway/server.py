@@ -150,18 +150,28 @@ class ShardingPublisher:
                 metric = prom_metric_name(measurement, fname)
                 norm = dict(tags)
                 norm[self.options.metric_column] = metric
-                got = self._series_memo[key] = (self._shard_of(norm),
-                                                norm)
+                from filodb_tpu.core.record import (canonical_partkey,
+                                                    partition_hash,
+                                                    shard_key_hash)
+                # memoize shard AND the per-series hashes/partkey: the
+                # record build then skips recomputing them every batch
+                shash = shard_key_hash(norm, self.options)
+                phash = partition_hash(norm, self.options)
+                shard = self.mapper.ingestion_shard(
+                    shash, phash, self.spread) % self.mapper.num_shards
+                got = self._series_memo[key] = (
+                    shard, shash, phash, canonical_partkey(norm))
             groups.append((got, rows))
         self.parse_errors += bad
         n = 0
         with self._lock:
-            for (shard, norm), rows in groups:
+            for (shard, shash, phash, pk), rows in groups:
                 builder = self._builders.get(shard)
                 if builder is None:
                     builder = self._builders[shard] = RecordBuilder(
                         self.schema, self.options, self.container_size)
-                builder.add_series(ts_ms[rows], [values[rows]], norm)
+                builder.add_series_hashed(ts_ms[rows], [values[rows]],
+                                          shash, phash, pk)
                 n += len(rows)
             self.samples_in += n
         return n
