@@ -83,6 +83,28 @@ _HIST_GRID_FNS = {F.RATE, F.INCREASE, F.SUM_OVER_TIME, None}
 _ONEHOT_MAX_G = 2048  # one-hot matmul reduce beyond this costs too much VMEM
 
 
+def hist_slot_garr(garr: np.ndarray, lane_idx: np.ndarray,
+                   gid_arr: np.ndarray, hb: int) -> None:
+    """Fill ``garr`` in place with the histogram group-slot layout:
+    series slot s, bucket j -> group slot gid*hb + j, so a plain
+    segment reduce sums each bucket lane independently (the bucket-wise
+    hist sum).  ONE definition — the single-device fused path and the
+    mesh staging must never drift on this layout."""
+    cols = lane_idx[:, None] * hb + np.arange(hb)[None, :]
+    garr[cols] = gid_arr[:, None] * hb + np.arange(hb)
+
+
+def hist_state_from_planes(both: np.ndarray, num_groups: int, hb: int,
+                           tops) -> dict:
+    """[2, G*hb, T] sum+count planes -> the MomentAggregator hist state
+    ({"hist_sum": [G, T, hb], "count": [G, T] from the total bucket},
+    plus bucket_tops).  Shared by the single-device and mesh paths."""
+    G, T = num_groups, both.shape[-1]
+    hist_sum = both[0].reshape(G, hb, T).transpose(0, 2, 1)
+    count = both[1].reshape(G, hb, T)[:, -1, :]
+    return {"hist_sum": hist_sum, "count": count, "bucket_tops": tops}
+
+
 def _grouped_reduce_impl(stepped, garr, num_groups, op):
     """Device-side segment reduce of the grid kernel's [T, lanes] output:
     only [G, T] partials ever cross the host link.  ``garr`` maps lane ->
@@ -197,11 +219,14 @@ class MeshShardPlan(NamedTuple):
     ts: object            # [nrows, ncols] int32, on this shard's device
     vals: object          # [nrows, ncols] f32/f64, same device
     phase: object         # [ncols] int32 device array or None
-    garr: np.ndarray      # [ncols] int32 lane -> group (num_groups=drop)
+    garr: np.ndarray      # [ncols] int32 col -> group slot (-1 = drop;
+    #                       hist: slot = gid*hb + bucket)
     q: "GridQuery"
     steps0_rel: int
     ncols: int
     device: object
+    hb: int = 0           # bucket lanes per series (0 = scalar column)
+    bucket_tops: object = None     # [hb] np array (hist only)
 
 
 _MESH_STAGE_FN = None
@@ -476,22 +501,14 @@ class DeviceGridCache:
             if stride == 1:
                 garr[lane_idx] = gid_arr
             else:
-                # slot s, bucket j -> group g*hb + j: the segment reduce
-                # sums each bucket independently (bucket-wise hist sum)
-                cols = (lane_idx[:, None] * stride
-                        + np.arange(stride)[None, :])
-                garr[cols] = gid_arr[:, None] * stride + np.arange(stride)
+                hist_slot_garr(garr, lane_idx, gid_arr, stride)
         out = _fused_progs()["grouped"](
             plan.ts_parts, plan.val_parts, plan.row0, plan.steps0_rel,
             garr, plan.phase, q=plan.q, lanes=plan.lane_mult,
             nrows=plan.nrows, num_groups=num_groups * stride, op=op)
         if self.hist:
             both = np.asarray(out, dtype=np.float64)    # [2, G*hb, T]
-            G, hb, T = num_groups, stride, both.shape[-1]
-            hist_sum = both[0].reshape(G, hb, T).transpose(0, 2, 1)
-            count = both[1].reshape(G, hb, T)[:, -1, :]  # total bucket
-            return {"hist_sum": hist_sum, "count": count,
-                    "bucket_tops": tops}
+            return hist_state_from_planes(both, num_groups, stride, tops)
         if op in ("sum", "avg", "count"):
             # ONE host readback of the stacked [2, G, T]: each blocked
             # transfer pays the tunnel round-trip
@@ -514,7 +531,9 @@ class DeviceGridCache:
         Staging (block concat + row slice) runs once per (range,
         version) and is memoized by block identity, so a repeat
         dashboard query performs no device work here at all."""
-        if self.hist or func not in _GRID_OPS:
+        if func not in _GRID_OPS:
+            return None
+        if self.hist and func not in _HIST_GRID_FNS:
             return None
         op = _GRID_OPS[func]
         if op in _REBASE_OPS or len(fargs) != _ARG_OPS.get(op, 0):
@@ -544,10 +563,18 @@ class DeviceGridCache:
             # query's drop bucket (num_groups isn't final until every
             # shard's group ids are assigned)
             garr = np.full(plan.ncols, -1, dtype=np.int32)
-            garr[plan.lane_idx] = np.asarray(group_ids, dtype=np.int32)
+            gid_arr = np.asarray(group_ids, dtype=np.int32)
+            if self.hist:
+                hb = self.hb
+                hist_slot_garr(garr, plan.lane_idx, gid_arr, hb)
+                tops = np.asarray(self.bucket_tops)
+            else:
+                garr[plan.lane_idx] = gid_arr
+                hb, tops = 0, None
             return MeshShardPlan(ts_st, val_st, plan.phase, garr, plan.q,
                                  plan.steps0_rel, plan.ncols,
-                                 self._shard.grid_device)
+                                 self._shard.grid_device, hb=hb,
+                                 bucket_tops=tops)
 
     def _scan_rate_locked(self, part_ids, func, steps0, nsteps, step_ms,
                           window_ms, fargs=()):

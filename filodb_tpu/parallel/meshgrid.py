@@ -181,10 +181,16 @@ def _compose(plans: Sequence, operator: Agg):
         return None
     q0 = plans[0].q
     nrows = plans[0].ts.shape[0]
-    # one program serves every shard: query shapes must agree, and the
-    # dense/phase specialization is the MEET across shards
+    hb0 = plans[0].hb
+    if hb0 and operator is not Agg.SUM:
+        return None        # only sum is defined over histogram series
+    # one program serves every shard: query shapes must agree, the
+    # histogram bucket scheme must match (differing widths cannot share
+    # one garr layout), and dense/phase is the MEET across shards
     for p in plans:
-        if p.ts.shape[0] != nrows:
+        if p.ts.shape[0] != nrows or p.hb != hb0:
+            return None
+        if hb0 and not np.array_equal(p.bucket_tops, plans[0].bucket_tops):
             return None
         if p.q._replace(dense=False) != q0._replace(dense=False):
             return None
@@ -237,6 +243,10 @@ def serve_grid_mesh(engine, plans: Sequence, num_groups: int,
     q, mode = composed
     op = GRID_MESH_OPS[operator]
     nrows = plans[0].ts.shape[0]
+    # histogram plans: hb bucket lanes per series slot; group slots are
+    # gid*hb + bucket, so the program reduces num_groups*hb segments
+    stride = plans[0].hb or 1
+    groups_total = num_groups * stride
     mesh = engine.mesh
     devices = list(mesh.devices.flat)
     ndev = len(devices)
@@ -246,7 +256,7 @@ def serve_grid_mesh(engine, plans: Sequence, num_groups: int,
     lmax = max(-(-max(p.ncols for p in plans) // _LANE_PAD) * _LANE_PAD,
                _LANE_PAD)
 
-    memo_key = (engine._key, q, mode, num_groups, op, nrows, lmax, ksub,
+    memo_key = (engine._key, q, mode, groups_total, op, nrows, lmax, ksub,
                 tuple((d, id(p.ts), id(p.vals),
                        id(p.phase) if p.phase is not None else 0,
                        p.steps0_rel, _garr_fp(p.garr))
@@ -278,8 +288,8 @@ def serve_grid_mesh(engine, plans: Sequence, num_groups: int,
                 s0_k.append(int(p.steps0_rel))
                 # -1 marks unrequested lanes (devicestore.mesh_plan);
                 # rewrite to THIS query's drop bucket
-                g = np.full(lmax, num_groups, np.int32)
-                g[:len(p.garr)] = np.where(p.garr < 0, num_groups,
+                g = np.full(lmax, groups_total, np.int32)
+                g[:len(p.garr)] = np.where(p.garr < 0, groups_total,
                                            p.garr)
                 g_k.append(g)
             while len(ts_k) < ksub:                # filler shard slices
@@ -291,7 +301,7 @@ def serve_grid_mesh(engine, plans: Sequence, num_groups: int,
                     ph_k.append(jax.device_put(np.ones(lmax, np.int32),
                                                dev))
                 s0_k.append(0)
-                g_k.append(np.full(lmax, num_groups, np.int32))
+                g_k.append(np.full(lmax, groups_total, np.int32))
             ts_pieces.append(jnp.stack(ts_k))
             val_pieces.append(jnp.stack(val_k))
             if mode == "phase":
@@ -322,9 +332,15 @@ def serve_grid_mesh(engine, plans: Sequence, num_groups: int,
                      nbytes)
 
     prog = _grid_mesh_program(engine._key, q, mode, ksub, nrows, lmax,
-                              num_groups, op)
+                              groups_total, op)
     out = prog(g_ts, g_vals, g_ph, g_s0, g_garr)
     STATS["serves"] += 1
+    if stride > 1:
+        # histogram: [2, G*hb, T] -> the MomentAggregator hist state
+        from filodb_tpu.memstore.devicestore import hist_state_from_planes
+        both = np.asarray(out, dtype=np.float64)
+        return hist_state_from_planes(both, num_groups, stride,
+                                      np.asarray(plans[0].bucket_tops))
     if op in ("sum", "avg", "count"):
         both = np.asarray(out, dtype=np.float64)       # [2, G, T]
         if op == "count":

@@ -210,6 +210,35 @@ class TestResidentGridMesh:
         fused = _run(_planner(mapper, engine), ms, promql, START, END)
         _assert_equiv(fused, plain)
 
+    def test_histogram_shards_serve_resident(self):
+        """First-class histogram sums run in the RESIDENT grid x mesh
+        program (bucket lanes + psum over group*bucket slots),
+        identical to the per-shard path."""
+        from tests.data import START_TS, histogram_containers
+
+        ms2 = TimeSeriesMemStore()
+        mapper = ShardMapper(4)
+        for s in range(4):
+            ms2.setup("prom", DEFAULT_SCHEMAS, s)
+        for shard_num in (0, 1, 2):
+            for off, c in enumerate(histogram_containers(
+                    n_series=2, n_samples=60, metric="hgm",
+                    seed=shard_num)):
+                ms2.get_shard("prom", shard_num).ingest_container(c, off)
+        engine = MeshEngine(make_mesh())
+        # start past the bare selector's 5m staleness lookback so the
+        # resident plan's first window lands inside the staged grid
+        start, end = START_TS + 320_000, START_TS + 500_000
+        for promql in ('sum(rate(hgm{_ws_="demo",_ns_="App-0"}[2m]))',
+                       'sum(hgm{_ws_="demo",_ns_="App-0"})'):
+            plain = _run(_planner(mapper), ms2, promql, start, end)
+            before = meshgrid.STATS["serves"]
+            fused = _run(_planner(mapper, engine), ms2, promql,
+                         start, end)
+            assert meshgrid.STATS["serves"] > before, \
+                f"hist query fell off the resident path: {promql}"
+            _assert_equiv(fused, plain)
+
     def test_repin_invalidates_and_rebuilds(self):
         """Blocks built for a single-device planner (default device)
         survive pinning to device 0 but rebuild when re-pinned
