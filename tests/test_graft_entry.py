@@ -1,0 +1,95 @@
+"""Driver-entry robustness: the CPU dryrun must NEVER initialize a backend
+in the calling process, and bench.py's probe must turn a hung/dead TPU
+backend into a fast explicit failure (round-4 VERDICT weak #1 / next #1).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dryrun_never_inits_backend_in_parent():
+    """Simulate the driver environment: jax imported but NO backend
+    initialized (sitecustomize may have registered a dead TPU plugin).
+    dryrun_multichip must complete via the CPU-forced subprocess without
+    ever touching jax.devices()/default_backend() in the parent — during
+    a tunnel outage that call is a hang, not an exception."""
+    code = textwrap.dedent("""
+        import sys
+        import jax
+        from jax._src import xla_bridge
+        assert not xla_bridge._backends, "backend already initialized"
+        def _boom(*a, **k):
+            raise SystemExit("FAIL: parent tried to initialize a backend")
+        jax.devices = _boom
+        jax.default_backend = _boom
+        xla_bridge.backends = _boom
+        import __graft_entry__
+        __graft_entry__.dryrun_multichip(8)
+        print("DRYRUN_OK")
+    """)
+    env = dict(os.environ)
+    # the grandchild re-forces cpu itself; the parent must not rely on this
+    env.pop("JAX_PLATFORMS", None)
+    # keep the in-code watchdog BELOW this test's own subprocess timeout so
+    # a wedge fails through the watchdog (clean RuntimeError), not an
+    # orphaning outer kill
+    env["FILODB_DRYRUN_TIMEOUT_S"] = "300"
+    res = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                         capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert "DRYRUN_OK" in res.stdout
+
+
+def test_dryrun_inline_when_cpu_backend_initialized(monkeypatch):
+    """Under the conftest's initialized 8-device CPU backend the dryrun must
+    take the inline path — a subprocess re-exec here means the private-attr
+    probe (jax._src.xla_bridge._default_backend) broke, e.g. on a jax
+    upgrade, and every CI caller silently pays a ~30s re-exec."""
+    import jax
+
+    import __graft_entry__
+
+    assert len(jax.devices()) >= 8  # conftest initialized the CPU mesh
+
+    def _no_subprocess(*a, **k):
+        raise AssertionError("dryrun re-execed instead of running inline")
+
+    monkeypatch.setattr(subprocess, "run", _no_subprocess)
+    __graft_entry__.dryrun_multichip(8)
+
+
+def test_bench_probe_reports_init_error(monkeypatch):
+    import jax
+
+    import bench
+
+    def _raise():
+        raise RuntimeError("no backend for you")
+
+    monkeypatch.setattr(jax, "devices", _raise)
+    err = bench._probe_backend(30)
+    assert err is not None and "no backend for you" in err
+
+
+def test_bench_probe_times_out_on_hang(monkeypatch):
+    import jax
+
+    import bench
+
+    monkeypatch.setattr(jax, "devices", lambda: time.sleep(20))
+    a = time.perf_counter()
+    err = bench._probe_backend(1)
+    took = time.perf_counter() - a
+    assert err is not None and "timed out" in err
+    assert took < 10, took
+
+
+def test_bench_probe_passes_on_live_backend():
+    import bench
+
+    assert bench._probe_backend(60) is None
