@@ -69,13 +69,25 @@ class IngestionCoordinator:
         thread spawns: a query dispatched right after assignment must
         find the shard registered (empty, possibly still recovering) —
         never race an async setup into 'shard not set up' failures."""
-        if not self.memstore.has_shard(self.dataset, shard):
-            self.memstore.setup(self.dataset, self.schemas, shard,
-                                self.config)
         stop = threading.Event()
         with self._lock:
             if shard in self._threads:
                 return
+            # has_shard+setup under the lock: two concurrent starts for the
+            # same shard would otherwise both pass the check and the loser
+            # raise ValueError out of setup (round-4 ADVICE). The except
+            # keeps repeat starts idempotent even against setups from
+            # OUTSIDE this ingester (tests / manual admin calls).
+            if not self.memstore.has_shard(self.dataset, shard):
+                try:
+                    self.memstore.setup(self.dataset, self.schemas, shard,
+                                        self.config)
+                except ValueError:
+                    # tolerated ONLY as the already-set-up race (setups
+                    # from outside this ingester); a genuine setup
+                    # failure must not register a dead ingest thread
+                    if not self.memstore.has_shard(self.dataset, shard):
+                        raise
             self._stops[shard] = stop
             if blocking:
                 self._threads[shard] = threading.current_thread()

@@ -351,8 +351,20 @@ def parse_batch_columns(text: str, batch_memo: Optional[dict] = None):
     hkey = h1.astype(np.float64) + 1j * h2.astype(np.float64)
     _, first_idx, inverse = np.unique(hkey, return_index=True,
                                       return_inverse=True)
-    heads = [data[starts[i]:sp1[i]].decode("utf-8") for i in first_idx]
     inverse = inverse.ravel()
+    # hash-collision guard: the complex128 key keeps ~52 usable bits per
+    # stream, so verify every line's head BYTES against its group
+    # representative — a collision must fall back to the per-line parser,
+    # never silently merge two series (round-4 ADVICE).  Vectorized via a
+    # zero-padded [N, max_head_len] byte matrix; cost is one extra pass
+    # over the head bytes.
+    rep = first_idx[inverse]
+    maxh = int(hlen.max())
+    hm = np.zeros((N, maxh), np.uint8)
+    hm[np.repeat(np.arange(N, dtype=np.int64), hlen), rel] = hb8
+    if (hlen != hlen[rep]).any() or (hm != hm[rep]).any():
+        return None
+    heads = [data[starts[i]:sp1[i]].decode("utf-8") for i in first_idx]
     if batch_memo is not None:
         batch_memo["heads_sig"] = (bytes(hb8), hlen.copy(), heads,
                                    inverse)

@@ -111,7 +111,11 @@ class OnDemandPagingShard(TimeSeriesShard):
         self.stats.chunks_paged = 0
 
     def _on_page_evict(self) -> None:
-        self.removal_epoch += 1
+        # called after the page-cache lock is released; concurrent evictions
+        # from multiple query threads must not lose an increment (a lost
+        # bump would leave a grid prep stamped "current" despite an
+        # eviction it never observed)
+        self.bump_removal_epoch()
 
     # ------------------------------------------------------------ resolution
 
@@ -391,7 +395,7 @@ class OnDemandPagingShard(TimeSeriesShard):
             # before the stale entries are dropped
             with self._odp_lock:
                 del self.partitions[pid]
-                self.removal_epoch += 1      # invalidates grid prep caches
+                self.bump_removal_epoch()    # invalidates grid prep caches
                 self.paged.pop(pid)          # cached copy lacks the tail
                 self.paged.pop(("bf", pid))  # list is live-part relative
             self.evicted_keys.add(part.partkey)

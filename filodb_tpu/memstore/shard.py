@@ -96,6 +96,10 @@ class TimeSeriesShard:
         # requested pid per query (20k dict walks otherwise dominate
         # host-side serving time at high cardinality)
         self.removal_epoch = 0
+        # serializes removal_epoch bumps: evictions fire from ingest,
+        # housekeeping, AND (on ODP shards) query threads concurrently; a
+        # lost read-modify-write would leave stale grid preps "current"
+        self._epoch_lock = threading.Lock()
         self.partitions: dict[int, TimeSeriesPartition] = {}
         self.part_set: dict[bytes, int] = {}
         # part id -> 16-bit schema hash; covers index-only (evicted /
@@ -453,6 +457,11 @@ class TimeSeriesShard:
 
     # ------------------------------------------------------------- lifecycle
 
+    def bump_removal_epoch(self) -> None:
+        """Atomic removal-epoch increment; see ``_epoch_lock``."""
+        with self._epoch_lock:
+            self.removal_epoch += 1
+
     def evict_partitions(self, n: int) -> int:
         """Evict up to n longest-stopped partitions (reference :1308-1401).
         Their data must already be flushed; in-memory state is dropped and
@@ -462,7 +471,7 @@ class TimeSeriesShard:
             part = self.partitions.pop(pid, None)
             if part is None:
                 continue
-            self.removal_epoch += 1
+            self.bump_removal_epoch()
             self.part_set.pop(part.partkey, None)
             self.evicted_keys.add(part.partkey)
             self.index.remove([pid])
@@ -476,7 +485,7 @@ class TimeSeriesShard:
                   if p.latest_timestamp < cutoff]
         for pid in doomed:
             part = self.partitions.pop(pid)
-            self.removal_epoch += 1
+            self.bump_removal_epoch()
             self.part_set.pop(part.partkey, None)
             self.index.remove([pid])
             self.stats.partitions_purged += 1

@@ -142,6 +142,31 @@ class TestInfluxParser:
         h3, inv3, *_ = parse_batch_columns(t2, memo)
         assert "cpu,host=c" in h3                      # re-resolved
 
+    def test_columnar_head_hash_collision_falls_back(self, monkeypatch):
+        """Regression (round-4 ADVICE): two DIFFERENT heads whose 128-bit
+        positional hashes collide must never be silently merged — the
+        byte-verification pass detects the collision and the batch falls
+        back to the per-line parser, which stays correct."""
+        import numpy as np
+
+        from filodb_tpu.gateway import influx
+
+        # degenerate weight tables: hash = byte sum, so permuted heads
+        # ("cpu,host=ab" vs "cpu,host=ba") collide in BOTH streams
+        n = 4096
+        monkeypatch.setattr(influx, "_HASH_POWS",
+                            (np.ones(n, np.uint64), np.ones(n, np.uint64)))
+        text = ("cpu,host=ab value=1.5 100000000\n"
+                "cpu,host=ba value=2.5 100000000\n")
+        assert influx.parse_batch_columns(text) is None
+        recs = influx.parse_lines_fast(text)
+        assert {r.tags["host"] for r in recs} == {"ab", "ba"}
+        # equal heads under the degenerate hash still parse columnar
+        ok = ("cpu,host=ab value=1.5 100000000\n"
+              "cpu,host=ab value=2.5 200000000\n")
+        got = influx.parse_batch_columns(ok)
+        assert got is not None and got[0] == ["cpu,host=ab"]
+
     def test_columnar_ingest_bad_head_skips_only_its_lines(self):
         """A malformed head mid-batch must drop only ITS lines (counted
         as parse errors); every other series still lands — matching the
