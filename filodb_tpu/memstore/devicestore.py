@@ -120,10 +120,13 @@ def _grouped_reduce_impl(stepped, garr, num_groups, op):
 
     v = stepped.T                                # [lanes, T]
     G = num_groups
-    if op in ("sum", "avg", "count"):
+    if op in ("sum", "avg", "count", "moments"):
         fin = jnp.isfinite(v)
         vz = jnp.where(fin, v, 0.0)
         fz = fin.astype(v.dtype)
+        planes = [vz, fz]
+        if op == "moments":                      # stddev/stdvar partials
+            planes.append(vz * vz)
         if G + 1 <= _ONEHOT_MAX_G:
             onehot = (garr[:, None] ==
                       jnp.arange(G, dtype=garr.dtype)[None, :]
@@ -132,12 +135,12 @@ def _grouped_reduce_impl(stepped, garr, num_groups, op):
             # inputs to bf16, which would make fused sums diverge from
             # the host segment-sum path by up to ~0.4%
             hp = jax.lax.Precision.HIGHEST
-            s = jnp.matmul(onehot.T, vz, precision=hp)   # MXU: [G, T]
-            c = jnp.matmul(onehot.T, fz, precision=hp)
+            outs = [jnp.matmul(onehot.T, p, precision=hp)  # MXU: [G, T]
+                    for p in planes]
         else:
-            s = jax.ops.segment_sum(vz, garr, G + 1)[:G]
-            c = jax.ops.segment_sum(fz, garr, G + 1)[:G]
-        return jnp.stack([s, c])                 # one readback downstream
+            outs = [jax.ops.segment_sum(p, garr, G + 1)[:G]
+                    for p in planes]
+        return jnp.stack(outs)                   # one readback downstream
     if op == "min":
         return segops.seg_min(v, garr, G + 1)[:G]
     if op == "max":
@@ -227,6 +230,10 @@ class MeshShardPlan(NamedTuple):
     device: object
     hb: int = 0           # bucket lanes per series (0 = scalar column)
     bucket_tops: object = None     # [hb] np array (hist only)
+    col_pids: object = None        # [ncols] int64 partition id per lane
+    #                                (-1 = unassigned); lets the k-slot
+    #                                mesh path resolve selected lanes back
+    #                                to series tags (scalar columns only)
 
 
 _MESH_STAGE_FN = None
@@ -509,12 +516,15 @@ class DeviceGridCache:
         if self.hist:
             both = np.asarray(out, dtype=np.float64)    # [2, G*hb, T]
             return hist_state_from_planes(both, num_groups, stride, tops)
-        if op in ("sum", "avg", "count"):
-            # ONE host readback of the stacked [2, G, T]: each blocked
+        if op in ("sum", "avg", "count", "moments"):
+            # ONE host readback of the stacked [2|3, G, T]: each blocked
             # transfer pays the tunnel round-trip
             both = np.asarray(out, dtype=np.float64)
             if op == "count":
                 return {"count": both[1]}
+            if op == "moments":
+                return {"sum": both[0], "count": both[1],
+                        "sumsq": both[2]}
             return {"sum": both[0], "count": both[1]}
         return {op: np.asarray(out, dtype=np.float64)}
 
@@ -564,6 +574,7 @@ class DeviceGridCache:
             # shard's group ids are assigned)
             garr = np.full(plan.ncols, -1, dtype=np.int32)
             gid_arr = np.asarray(group_ids, dtype=np.int32)
+            col_pids = None
             if self.hist:
                 hb = self.hb
                 hist_slot_garr(garr, plan.lane_idx, gid_arr, hb)
@@ -571,10 +582,13 @@ class DeviceGridCache:
             else:
                 garr[plan.lane_idx] = gid_arr
                 hb, tops = 0, None
+                col_pids = np.full(plan.ncols, -1, dtype=np.int64)
+                col_pids[plan.lane_idx] = np.asarray(part_ids,
+                                                     dtype=np.int64)
             return MeshShardPlan(ts_st, val_st, plan.phase, garr, plan.q,
                                  plan.steps0_rel, plan.ncols,
                                  self._shard.grid_device, hb=hb,
-                                 bucket_tops=tops)
+                                 bucket_tops=tops, col_pids=col_pids)
 
     def _scan_rate_locked(self, part_ids, func, steps0, nsteps, step_ms,
                           window_ms, fargs=()):
@@ -970,7 +984,13 @@ class DeviceGridCache:
         for pid, lane in self.lane_of.items():
             part = self._shard.grid_partition(pid)
             if part is None:
-                continue
+                # A laned partition with no resolvable data (ODP page-evicted
+                # or concurrently purged mid-build) must FAIL the build, not
+                # stage an all-NaN lane: the block cache is keyed only by
+                # (bucket, lanes, staged_hi) and page-in does not invalidate
+                # blocks, so a cached NaN lane would silently serve "empty"
+                # for history that exists on disk (round-4 ADVICE, medium).
+                return None
             ts, vals = part.read_range(b_lo_ms + 1, b_hi_ms, self.column_id)
             if len(ts) == 0:
                 continue

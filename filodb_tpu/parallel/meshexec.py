@@ -107,7 +107,7 @@ class MeshAggregateExec(ExecPlan):
         out: list = []
         devices = list(engine.mesh.devices.flat)
 
-        grid_eligible = self.operator in meshgrid.GRID_MESH_OPS
+        grid_eligible = self.operator in meshgrid.GRID_MESH_ALL_OPS
         entries = []                       # (shard, shard_num, lookup)
         for shard_num in self.shards:
             shard = ctx.memstore.get_shard(self.dataset, shard_num)
@@ -155,14 +155,20 @@ class MeshAggregateExec(ExecPlan):
                 num_grid_groups = len(union)
                 state = meshgrid.serve_grid_mesh(engine, plans,
                                                  num_grid_groups,
-                                                 self.operator)
+                                                 self.operator,
+                                                 params=self.params)
                 if state is not None:
                     keys = [dict(k) for k in
                             list(union)[:num_grid_groups]]
                     tops = state.pop("bucket_tops", None)
+                    series_keys = None
+                    if "_slots" in state:
+                        series_keys = self._resolve_k_lanes(
+                            state, plans, planned)
                     out.append(AggPartialBatch(self.operator,
                                                self.params, keys,
                                                report, state,
+                                               series_keys=series_keys,
                                                bucket_tops=tops))
                     served = set(id(e) for e in planned)
                     host_entries = [e for e in entries
@@ -291,6 +297,53 @@ class MeshAggregateExec(ExecPlan):
             pos[g] += 1
         return AggPartialBatch(self.operator, self.params, keys, report,
                                {"members": dense})
+
+    def _resolve_k_lanes(self, state: dict, plans, planned) -> list[dict]:
+        """Map the resident k-slot program's GLOBAL lane indices back to
+        series tags: sidx value g decodes to (mesh slot g // lmax, lane
+        g % lmax); the slot's MeshShardPlan carries the lane -> partition
+        id map (col_pids), and the slot's shard resolves tags.  The state
+        is rewritten in place to compact indices into the returned
+        series-key list (the AggPartialBatch contract the host k-path
+        uses).  Unresolvable lanes (partition concurrently evicted) are
+        DROPPED (sidx -1) — the same thing the host path's present does
+        with its padding slots."""
+        slots = state.pop("_slots")
+        lmax = state.pop("_lmax")
+        sidx = state["sidx"]
+        uniq = np.unique(sidx[sidx >= 0])
+        series_keys: list[dict] = []
+        remap = {}
+        for g in uniq.tolist():
+            slot, lane = divmod(int(g), lmax)
+            tags = None
+            pi = slots[slot] if slot < len(slots) else -1
+            if pi >= 0:
+                plan = plans[pi]
+                shard = planned[pi][0]
+                if plan.col_pids is not None and lane < len(plan.col_pids):
+                    pid = int(plan.col_pids[lane])
+                    if pid >= 0:
+                        part = shard.grid_partition(pid)
+                        if part is not None:
+                            tags = part.tags
+            if tags is None:
+                remap[g] = -1
+                continue
+            remap[g] = len(series_keys)
+            series_keys.append(tags)
+        if len(remap):
+            lut = np.full(int(uniq.max()) + 2, -1, np.int64)
+            for g, i in remap.items():
+                lut[g] = i
+            state["sidx"] = np.where(sidx >= 0, lut[np.maximum(sidx, 0)],
+                                     -1).astype(np.int32)
+        else:
+            state["sidx"] = sidx.astype(np.int32)
+        # a dropped lane must not occupy a k-slot in a downstream reduce
+        state["values"] = np.where(state["sidx"] >= 0, state["values"],
+                                   np.nan)
+        return series_keys
 
     def _cardinality_error(self, ctx, n: int):
         from filodb_tpu.query.model import QueryError

@@ -338,8 +338,11 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         return [PeriodicBatch(tags, report, mvals, hist=hvals,
                               bucket_tops=tops)]
 
-    _GRID_AGG_OPS = {"SUM": "sum", "COUNT": "count", "AVG": "avg",
-                     "MIN": "min", "MAX": "max"}
+    # derived from the mesh table so the single-device fused path and the
+    # grid x mesh path can never diverge on which ops are fused
+    from filodb_tpu.parallel.meshgrid import GRID_MESH_OPS as _MESH_OPS
+    _GRID_AGG_OPS = {op.name: v for op, v in _MESH_OPS.items()}
+    del _MESH_OPS
 
     def _try_device_grid(self, shard, part_ids, column_id):
         """Serve leaf + PeriodicSamplesMapper straight from the shard's

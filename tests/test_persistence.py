@@ -393,6 +393,60 @@ class TestOnDemandPaging:
             _t1, v1, _ = got
             np.testing.assert_allclose(v2, v1, rtol=1e-9, equal_nan=True)
 
+    def test_evicted_lane_fails_block_build(self, tmp_path):
+        """Regression (round-4 ADVICE, medium): a grid block built while a
+        laned partition is page-evicted must FAIL the build and fall back —
+        never cache an all-NaN lane that serves 'provably empty' for
+        history that exists on disk once the partition pages back in."""
+        from filodb_tpu.query.logical import RangeFunctionId as F
+
+        disk = DiskColumnStore(str(tmp_path / "c.db"))
+        meta = DiskMetaStore(str(tmp_path / "m.db"))
+        store = TimeSeriesMemStore(disk, meta)
+        shard = store.setup("prom", DEFAULT_SCHEMAS, 0,
+                            StoreConfig(groups_per_shard=2))
+        step = 10_000
+        t0 = 1_700_000_000_000
+        builder = RecordBuilder(DEFAULT_SCHEMAS["gauge"])
+        for s in range(4):
+            tags = {"__name__": "nl", "job": "app", "instance": f"i{s}",
+                    "_ws_": "demo", "_ns_": "ns"}
+            for r in range(100):
+                builder.add(t0 + r * step, [float(s * 100 + r)], tags)
+        for off, c in enumerate(builder.containers()):
+            shard.ingest_container(c, off)
+        shard.flush_all()
+        shard.evict_partitions(4)
+        flt = [ColumnFilter("_metric_", Equals("nl"))]
+        res = shard.lookup_partitions(flt, 0, 2**62)
+        shard.scan_batch(res.part_ids, 0, 2**62)       # page everything in
+        got = shard.scan_grid(res.part_ids, F.RATE, t0 + 120_000, 20,
+                              step, 120_000)
+        assert got is not None
+        cache = next(iter(shard.device_caches.values()))
+        assert cache.blocks, "grid serve left no resident blocks"
+        bi, blk = next(iter(cache.blocks.items()))
+        victim = int(res.part_ids[-1])
+        assert victim in cache.lane_of
+        shard.paged.pop(victim)                        # LRU drop, mid-flight
+        shard.bump_removal_epoch()
+        # rebuilding the block with the lane unmaterializable must fail …
+        assert cache._build(bi, blk.lanes) is None
+        # … and after re-paging, serving must still be correct end-to-end
+        cache.blocks.clear()
+        cache._tails.clear()
+        res2 = shard.lookup_partitions(flt, 0, 2**62)
+        shard.scan_batch(res2.part_ids, 0, 2**62)      # re-page victim
+        got2 = shard.scan_grid(res2.part_ids, F.RATE, t0 + 120_000, 20,
+                               step, 120_000)
+        if got2 is not None:
+            t1, v1, _ = got
+            t2, v2, _ = got2
+            o1 = {t["instance"]: v1[i] for i, t in enumerate(t1)}
+            for i, t in enumerate(t2):
+                np.testing.assert_allclose(v2[i], o1[t["instance"]],
+                                           rtol=1e-9, equal_nan=True)
+
     def test_query_data_cap(self, tmp_path):
         disk, shard, truth = self._setup(tmp_path,
                                          max_data_per_shard_query=16)
