@@ -981,16 +981,23 @@ class DeviceGridCache:
         ts_stage = np.zeros((BLOCK_BUCKETS, lanes * stride), np.int32)
         val_stage = np.full((BLOCK_BUCKETS, lanes * stride), np.nan,
                             self._val_dtype())
-        for pid, lane in self.lane_of.items():
+        for pid, lane in list(self.lane_of.items()):
             part = self._shard.grid_partition(pid)
             if part is None:
-                # A laned partition with no resolvable data (ODP page-evicted
-                # or concurrently purged mid-build) must FAIL the build, not
-                # stage an all-NaN lane: the block cache is keyed only by
-                # (bucket, lanes, staged_hi) and page-in does not invalidate
-                # blocks, so a cached NaN lane would silently serve "empty"
-                # for history that exists on disk (round-4 ADVICE, medium).
-                return None
+                # A laned partition with no resolvable data (ODP
+                # page-evicted, or evicted/purged from memory) must not
+                # stay laned: the block cache is keyed only by (bucket,
+                # lanes, staged_hi) and page-in does not invalidate
+                # blocks, so a cached NaN lane would silently serve
+                # "empty" for history that exists on disk (round-4
+                # ADVICE, medium).  PRUNE the lane instead of failing
+                # the build (a permanent eviction would otherwise wedge
+                # every future build): if the partition ever
+                # re-materializes, _prep_for assigns it a FRESH lane >=
+                # every cached block's staged_hi, which forces a rebuild
+                # — the stale NaN lane can never serve that pid again.
+                del self.lane_of[pid]
+                continue
             ts, vals = part.read_range(b_lo_ms + 1, b_hi_ms, self.column_id)
             if len(ts) == 0:
                 continue

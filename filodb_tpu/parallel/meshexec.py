@@ -272,31 +272,24 @@ class MeshAggregateExec(ExecPlan):
     def _count_values_partial(self, engine, shard_batches, group_ids,
                               tags_lists, keys, steps, report,
                               window) -> AggPartialBatch:
-        """count_values: scan+window on the mesh, member matrix on host
-        (output cardinality is data-dependent — the reference's
-        CountValuesRowAggregator also passes exact values through)."""
+        """count_values: scan+window on the mesh, vectorized
+        (value, group, step) counting on host — exact values pass
+        through like the reference's CountValuesRowAggregator, without
+        a per-series loop or a dense member cube."""
+        from filodb_tpu.query.aggregators import count_values_state
         stepped, (Kp, S) = engine.window_values(
             shard_batches, steps, window, range_fn=self.function,
             extra_args=self.function_args)
-        rows, ids = [], []
-        for kk, (tl, gid) in enumerate(zip(tags_lists, group_ids)):
-            for s in range(len(tl)):
-                rows.append(kk * S + s)
-            ids.extend(gid[:len(tl)])
-        vals = stepped[rows]                        # [S_real, T]
-        ids = np.asarray(ids, dtype=np.int64)
-        G = max(len(keys), 1)
-        T = vals.shape[1] if vals.size else len(report.timestamps())
-        counts = np.bincount(ids, minlength=G) if len(ids) \
-            else np.zeros(G, int)
-        M = int(counts.max()) if len(counts) else 0
-        dense = np.full((G, max(M, 1), T), np.nan)
-        pos = np.zeros(G, dtype=np.int64)
-        for s, g in enumerate(ids):
-            dense[g, pos[g]] = vals[s]
-            pos[g] += 1
+        rows = np.concatenate(
+            [np.arange(len(tl), dtype=np.int64) + kk * S
+             for kk, tl in enumerate(tags_lists)]) \
+            if tags_lists else np.empty(0, np.int64)
+        ids = np.concatenate(
+            [gid[:len(tl)] for tl, gid in zip(tags_lists, group_ids)]) \
+            if tags_lists else np.empty(0, np.int64)
+        state = count_values_state(stepped[rows], ids, max(len(keys), 1))
         return AggPartialBatch(self.operator, self.params, keys, report,
-                               {"members": dense})
+                               state)
 
     def _resolve_k_lanes(self, state: dict, plans, planned) -> list[dict]:
         """Map the resident k-slot program's GLOBAL lane indices back to

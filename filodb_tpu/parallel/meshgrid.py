@@ -299,7 +299,6 @@ def _grid_mesh_values_program(mesh_key, q, mode: str, ksub: int,
     builds the (value, group, step) counts (output cardinality is
     data-dependent, like the reference's CountValuesRowAggregator)."""
     import jax
-    import jax.numpy as jnp  # noqa: F401 — jitted leaf below
     from jax.sharding import PartitionSpec as P
 
     from filodb_tpu.parallel.mesh import _MESHES

@@ -488,9 +488,13 @@ class CountValuesAggregator(Aggregator):
     op = Op.COUNT_VALUES
 
     def map(self, batch, by, without, params, limit):
-        # pass-through of member values: count_values needs exact values,
-        # so it keeps the dense layout regardless of cardinality
-        return _dense_members_map(self.op, batch, by, without, params, limit)
+        # exact values pass through as the COUNTED form: one np.unique +
+        # bincount over the [S, T] matrix, no per-series loop and no
+        # dense [G, M, T] member cube at high cardinality
+        ids, keys = _group(batch.keys, by, without, limit)
+        vals = np.asarray(batch.values)[:len(batch.keys)]
+        state = count_values_state(vals, ids, len(keys))
+        return AggPartialBatch(self.op, params, keys, batch.steps, state)
 
     @staticmethod
     def _is_cv(p) -> bool:

@@ -393,11 +393,13 @@ class TestOnDemandPaging:
             _t1, v1, _ = got
             np.testing.assert_allclose(v2, v1, rtol=1e-9, equal_nan=True)
 
-    def test_evicted_lane_fails_block_build(self, tmp_path):
+    def test_evicted_lane_pruned_from_block_build(self, tmp_path):
         """Regression (round-4 ADVICE, medium): a grid block built while a
-        laned partition is page-evicted must FAIL the build and fall back —
-        never cache an all-NaN lane that serves 'provably empty' for
-        history that exists on disk once the partition pages back in."""
+        laned partition is page-evicted must PRUNE that lane — never cache
+        an all-NaN lane still mapped to the partition (it would serve
+        'provably empty' for history that exists on disk once the
+        partition pages back in; a re-paged partition instead gets a
+        fresh lane, forcing a rebuild)."""
         from filodb_tpu.query.logical import RangeFunctionId as F
 
         disk = DiskColumnStore(str(tmp_path / "c.db"))
@@ -428,17 +430,23 @@ class TestOnDemandPaging:
         bi, blk = next(iter(cache.blocks.items()))
         victim = int(res.part_ids[-1])
         assert victim in cache.lane_of
+        old_lane = cache.lane_of[victim]
         shard.paged.pop(victim)                        # LRU drop, mid-flight
         shard.bump_removal_epoch()
-        # rebuilding the block with the lane unmaterializable must fail …
-        assert cache._build(bi, blk.lanes) is None
-        # … and after re-paging, serving must still be correct end-to-end
+        # rebuilding with the lane unmaterializable must PRUNE it (a
+        # permanent eviction must not wedge future builds) …
+        assert cache._build(bi, blk.lanes) is not None
+        assert victim not in cache.lane_of
+        # … a re-appearing partition gets a FRESH lane, so the stale NaN
+        # lane can never serve it, and end-to-end results stay correct
         cache.blocks.clear()
         cache._tails.clear()
         res2 = shard.lookup_partitions(flt, 0, 2**62)
         shard.scan_batch(res2.part_ids, 0, 2**62)      # re-page victim
         got2 = shard.scan_grid(res2.part_ids, F.RATE, t0 + 120_000, 20,
                                step, 120_000)
+        if victim in cache.lane_of:        # re-laned: must be a new slot
+            assert cache.lane_of[victim] > old_lane
         if got2 is not None:
             t1, v1, _ = got
             t2, v2, _ = got2
