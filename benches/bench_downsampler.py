@@ -37,6 +37,13 @@ def kernel_main():
     import json
     import time
 
+    import bench
+    err = bench._probe_backend(
+        int(os.environ.get("FILODB_BENCH_PROBE_TIMEOUT_S", "120")))
+    if err is not None:
+        print(json.dumps({"error": f"backend unavailable: {err}"}))
+        os._exit(3)      # a dead TPU tunnel hangs init; exit fast instead
+
     import jax
     import jax.numpy as jnp
 

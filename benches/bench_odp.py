@@ -29,6 +29,13 @@ def grid_stage_main():
     import json
     import time
 
+    import bench
+    err = bench._probe_backend(
+        int(os.environ.get("FILODB_BENCH_PROBE_TIMEOUT_S", "120")))
+    if err is not None:
+        print(json.dumps({"error": f"backend unavailable: {err}"}))
+        os._exit(3)      # a dead TPU tunnel hangs init; exit fast instead
+
     import jax
 
     from filodb_tpu.core.filters import ColumnFilter, Equals

@@ -9,7 +9,10 @@ memory/src/main/scala/filodb.memory/format/vectors/DoubleVector.scala:14):
 - otherwise -> previous-value XOR predictor, residuals stored as the
   SMALLER of two forms: bit-level Gorilla windows (``GORILLA_DOUBLE``)
   or NibblePack (``XOR_DOUBLE``; doc/compression.md "Floating Point
-  Compression").
+  Compression") — unless neither saves >=10% over raw, in which case
+  ``RAW_DOUBLE`` wins: incompressible (IID-noise) data decodes with one
+  memcpy instead of a bit-stream walk (the batch downsampler's read
+  side is decode-bound on such data).
 
 ``GORILLA_DOUBLE`` keeps Gorilla's information layout — 1 bit for a
 repeat, leading-zero count + significant length + significant bits
@@ -54,6 +57,15 @@ def encode_batch(arrays) -> list[bytes]:
     if _native is not None and hasattr(_native, "dbl_encode_batch"):
         return _native.dbl_encode_batch(arrays)
     return [encode(np.asarray(a, dtype=np.float64)) for a in arrays]
+
+
+def encode_batch_2d(arr2d: np.ndarray) -> list[bytes]:
+    """Encode every row of a [nvec, n] float64 matrix (the columnar
+    downsample write path): the contiguous layout skips the per-vector
+    gather of :func:`encode_batch`."""
+    if _native is not None and hasattr(_native, "dbl_encode_batch_2d"):
+        return _native.dbl_encode_batch_2d(arr2d)
+    return [encode(row) for row in np.asarray(arr2d, dtype=np.float64)]
 
 
 def _bit_length64(x: np.ndarray) -> np.ndarray:
@@ -167,6 +179,16 @@ def encode(values: np.ndarray) -> bytes:
     residuals = bits ^ prev
     packed = nibblepack.pack(residuals)
     plan = _gorilla_plan(residuals)
+    best = min(plan[-1], len(packed) + _N.size)
+    # compression must pay for itself: on incompressible data (IID
+    # noise) the bit-packed forms land within a few % of raw while
+    # decoding orders of magnitude slower (bit streams vs one memcpy) —
+    # take RAW unless the winner saves >=10%.  Integer rule, mirrored
+    # exactly by the native encoder (codecs.cpp dbl_encode_one) so the
+    # byte-pairing tests hold.
+    raw_bytes = _N.size + 8 * n
+    if best * 10 > raw_bytes * 9:
+        return bytes([WireType.RAW_DOUBLE]) + _N.pack(n) + v.tobytes()
     if plan[-1] <= len(packed) + _N.size:
         return bytes([WireType.GORILLA_DOUBLE]) \
             + _gorilla_pack(residuals, plan)
@@ -183,6 +205,9 @@ def decode(buf: bytes) -> np.ndarray:
         return np.full(n, val, dtype=np.float64)
     if wire == WireType.GORILLA_DOUBLE:
         return _gorilla_unpack(buf, 1)
+    if wire == WireType.RAW_DOUBLE:
+        (n,) = _N.unpack_from(buf, 1)
+        return np.frombuffer(buf, np.float64, n, 1 + _N.size).copy()
     if wire != WireType.XOR_DOUBLE:
         raise ValueError(f"not a double vector: wire type {wire}")
     (n,) = _N.unpack_from(buf, 1)

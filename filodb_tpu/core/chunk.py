@@ -74,6 +74,17 @@ def encode_chunksets_batch(schema: Schema, items: Sequence[tuple]
     the same column contract as :func:`encode_chunkset`."""
     data_cols = schema.data.columns[1:]
     ll_arrays, dbl_arrays = [], []
+    # identical ll arrays (the grid downsampler hands EVERY series the
+    # same period-end timestamp object) encode once and share the blob
+    ll_index: dict[int, int] = {}
+
+    def ll_slot(arr) -> int:
+        i = ll_index.get(id(arr))
+        if i is None:
+            i = ll_index[id(arr)] = len(ll_arrays)
+            ll_arrays.append(arr)
+        return i
+
     plans = []          # per item: list of ("ll"/"dbl"/"done", idx/blob)
     items = [(pk, np.ascontiguousarray(ts, dtype=np.int64), cols, seq)
              for pk, ts, cols, seq in items]
@@ -83,8 +94,7 @@ def encode_chunksets_batch(schema: Schema, items: Sequence[tuple]
             raise ValueError(
                 f"schema {schema.name} expects {len(data_cols)} data "
                 f"columns, got {len(columns)}")
-        plan = [("ll", len(ll_arrays))]
-        ll_arrays.append(ts)
+        plan = [("ll", ll_slot(ts))]
         for col, data in zip(data_cols, columns):
             rows = data[1] if col.ctype == ColumnType.HISTOGRAM else data
             if len(rows) != n:
@@ -95,8 +105,8 @@ def encode_chunksets_batch(schema: Schema, items: Sequence[tuple]
                 dbl_arrays.append(np.asarray(data, dtype=np.float64))
             elif col.ctype in (ColumnType.LONG, ColumnType.TIMESTAMP,
                                ColumnType.INT):
-                plan.append(("ll", len(ll_arrays)))
-                ll_arrays.append(np.asarray(data, dtype=np.int64))
+                plan.append(("ll", ll_slot(np.asarray(data,
+                                                      dtype=np.int64))))
             elif col.ctype == ColumnType.HISTOGRAM:
                 buckets, hrows = data
                 plan.append(("done",

@@ -146,9 +146,23 @@ class BatchDownsampler:
 
     def _write_resolutions(self, shard_num, ingestion_end, by_schema,
                            samplers, prepared, written) -> None:
-        from filodb_tpu.core.chunk import encode_chunksets_batch
+        from filodb_tpu.codecs import deltadelta, doublecodec
+        from filodb_tpu.core.chunk import (ChunkSet, ChunkSetInfo,
+                                           chunk_id,
+                                           encode_chunksets_batch)
         from filodb_tpu.core.record import canonical_partkey
+        from filodb_tpu.core.schemas import ColumnType
         from filodb_tpu.store.columnstore import PartKeyRecord
+        # one canonical partkey per series, not per series x resolution
+        # (the tags dicts are shared across the resolution ladder)
+        pk_memo: dict[int, bytes] = {}
+
+        def pk_for(tags: dict) -> bytes:
+            pk = pk_memo.get(id(tags))
+            if pk is None:
+                pk = pk_memo[id(tags)] = canonical_partkey(tags)
+            return pk
+
         for res in self.resolutions:
             ds_name = ds_dataset_name(self.raw_dataset, res)
             chunksets = []
@@ -158,10 +172,40 @@ class BatchDownsampler:
                 if not sampler.enabled:
                     continue
                 ds_schema = sampler.ds_schema
+                all_dbl = all(c.ctype == ColumnType.DOUBLE
+                              for c in ds_schema.data.columns[1:])
+                planar = sampler.downsample_planes(prepared[h], res) \
+                    if all_dbl else None
+                if planar is not None:
+                    # columnar fast path (the aligned common case): the
+                    # shared period-end vector encodes ONCE, each value
+                    # plane encodes as one contiguous [S, P] native call,
+                    # and no per-series array slicing happens at all
+                    tags_list, pe, planes, per_series = planar
+                    if tags_list:
+                        ts_blob = deltadelta.encode_batch([pe])[0]
+                        col_blobs = [doublecodec.encode_batch_2d(pl.T)
+                                     for pl in planes]
+                        t0, t1 = int(pe[0]), int(pe[-1])
+                        cid = chunk_id(t0, 0)
+                        P = len(pe)
+                        for i, tags in enumerate(tags_list):
+                            pk = pk_for(tags)
+                            vectors = [ts_blob] + [cb[i]
+                                                   for cb in col_blobs]
+                            chunksets.append(ChunkSet(
+                                ChunkSetInfo(cid, P, t0, t1), pk,
+                                vectors,
+                                schema_hash=ds_schema.schema_hash))
+                            pkrecs.append(PartKeyRecord(
+                                pk, t0, t1, shard_num,
+                                ds_schema.schema_hash))
+                else:
+                    per_series = sampler.downsample_arrays(
+                        prepared[h], res)
                 items = []
-                for tags, ts_arr, cols in sampler.downsample_arrays(
-                        prepared[h], res):
-                    pk = canonical_partkey(tags)
+                for tags, ts_arr, cols in per_series:
+                    pk = pk_for(tags)
                     items.append((pk, ts_arr, cols, 0))
                     pkrecs.append(PartKeyRecord(
                         pk, int(ts_arr[0]), int(ts_arr[-1]), shard_num,

@@ -134,6 +134,91 @@ class ShardDownsampler:
         decoded = self._decode_concat(chunksets)
         return decoded, self._try_stage_grid(decoded)
 
+    def downsample_planes(self, prepared, resolution_ms: int):
+        """COLUMNAR batch-job output for the grid-served, fully-live
+        series (the aligned common case): one shared period-end vector
+        plus per-column [P, S_f] planes, ready for the contiguous 2D
+        batch encode — no per-series slicing at all.  Returns
+        (tags_list, pe [P] int64, planes, leftovers) where ``leftovers``
+        are the per-series (tags, ts, cols) tuples for partially-live or
+        unserved series (same contract as :meth:`downsample_arrays`), or
+        None when this resolution can't be served from the grid (the
+        caller falls back to :meth:`downsample_arrays`)."""
+        if prepared is None:
+            return None
+        decoded, staged = prepared
+        if staged is None:
+            return None
+        got = griddown.grid_outputs(staged, resolution_ms,
+                                    self.downsamplers, self.marker)
+        if got is None:
+            return None
+        served, outs, pends, plive = got
+        outs = [np.asarray(o) if o is not None else None for o in outs]
+        pends = np.asarray(pends)
+        plive = np.asarray(plive)
+        # the k_align row padding leaves dead periods at the grid's head
+        # and tail; "fully live" is judged (and planes emitted) over the
+        # live span only, or alignment pads would push EVERY series to
+        # the per-series path
+        row_any = plive.any(axis=1)                    # [P]
+        if row_any.any():
+            a = int(np.argmax(row_any))
+            b = int(len(row_any) - np.argmax(row_any[::-1]))
+        else:
+            a = b = 0
+        core = slice(a, b)
+        full = served & plive[core].all(axis=0)        # [S]
+        sidx = np.flatnonzero(full)
+        tags_list = [decoded[int(i)][0] for i in sidx]
+        planes = [out[core][:, sidx] for out in outs if out is not None]
+        pe = pends[core].astype(np.int64)
+        leftovers = []
+        pe_cache: dict[bytes, np.ndarray] = {}
+        for si, (tags, ts, cols) in enumerate(decoded):
+            if full[si]:
+                continue
+            if served[si]:
+                pm = plive[:, si]
+                if not pm.any():
+                    continue
+                key = pm.tobytes()
+                pe_s = pe_cache.get(key)
+                if pe_s is None:
+                    pe_s = pe_cache[key] = pends[pm].astype(np.int64)
+                leftovers.append((tags, pe_s,
+                                  [out[pm, si] for out in outs
+                                   if out is not None]))
+                continue
+            got = self._series_downsample(tags, ts, cols, resolution_ms)
+            if got is not None:
+                leftovers.append(got)
+        return tags_list, pe, planes, leftovers
+
+    def _series_downsample(self, tags: dict, ts: np.ndarray, cols,
+                           resolution_ms: int):
+        """Per-series host downsample: (tags, t_col, val_cols) or None
+        when the series contributes no periods.  Shared by the planar
+        leftovers and the downsample_arrays fallback — the period-marker
+        semantics must never diverge between the two paths."""
+        if len(ts) == 0:
+            return None
+        bounds, ends = self.marker.periods(ts, cols, resolution_ms)
+        if len(ends) == 0:
+            return None
+        outputs = [d.downsample(ts, cols, bounds, ends)
+                   for d in self.downsamplers]
+        t_col = None
+        val_cols = []
+        for d, out in zip(self.downsamplers, outputs):
+            if d.is_time:
+                t_col = np.asarray(out, dtype=np.int64)
+            else:
+                val_cols.append(out)
+        if t_col is None:
+            t_col = np.asarray(ends, dtype=np.int64)
+        return tags, t_col, val_cols
+
     def downsample_arrays(self, prepared, resolution_ms: int):
         """Batch-job form of :meth:`downsample_chunksets`: returns
         per-series arrays ``(tags, ts [P] int64, cols)`` instead of
@@ -180,23 +265,9 @@ class ShardDownsampler:
         for si, (tags, ts, cols) in enumerate(decoded):
             if served is not None and served[si]:
                 continue
-            if len(ts) == 0:
-                continue
-            bounds, ends = self.marker.periods(ts, cols, resolution_ms)
-            if len(ends) == 0:
-                continue
-            outputs = [d.downsample(ts, cols, bounds, ends)
-                       for d in self.downsamplers]
-            t_col = None
-            val_cols = []
-            for d, out in zip(self.downsamplers, outputs):
-                if d.is_time:
-                    t_col = np.asarray(out, dtype=np.int64)
-                else:
-                    val_cols.append(out)
-            if t_col is None:
-                t_col = np.asarray(ends, dtype=np.int64)
-            results.append((tags, t_col, val_cols))
+            got = self._series_downsample(tags, ts, cols, resolution_ms)
+            if got is not None:
+                results.append(got)
         return results
 
     def _try_stage_grid(self, decoded):

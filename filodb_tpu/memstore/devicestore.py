@@ -981,6 +981,7 @@ class DeviceGridCache:
         ts_stage = np.zeros((BLOCK_BUCKETS, lanes * stride), np.int32)
         val_stage = np.full((BLOCK_BUCKETS, lanes * stride), np.nan,
                             self._val_dtype())
+        dropped_lane = False
         for pid, lane in list(self.lane_of.items()):
             part = self._shard.grid_partition(pid)
             if part is None:
@@ -990,13 +991,16 @@ class DeviceGridCache:
                 # lanes, staged_hi) and page-in does not invalidate
                 # blocks, so a cached NaN lane would silently serve
                 # "empty" for history that exists on disk (round-4
-                # ADVICE, medium).  PRUNE the lane instead of failing
-                # the build (a permanent eviction would otherwise wedge
-                # every future build): if the partition ever
-                # re-materializes, _prep_for assigns it a FRESH lane >=
-                # every cached block's staged_hi, which forces a rebuild
-                # — the stale NaN lane can never serve that pid again.
+                # ADVICE, medium).  PRUNE the lane — a re-materialized
+                # partition then gets a FRESH lane >= every cached
+                # block's staged_hi, forcing a rebuild — AND fail THIS
+                # build: an in-flight query whose pre-eviction prep
+                # still maps the pid to this lane must fall back to the
+                # host path, not read a cached NaN lane.  The next
+                # build succeeds (the lane is gone), so a permanent
+                # eviction cannot wedge future builds.
                 del self.lane_of[pid]
+                dropped_lane = True
                 continue
             ts, vals = part.read_range(b_lo_ms + 1, b_hi_ms, self.column_id)
             if len(ts) == 0:
@@ -1028,6 +1032,8 @@ class DeviceGridCache:
                 (ts - self.epoch0).astype(np.int32)[:, None]
             val_stage[buckets, col0:col0 + stride] = \
                 arr if self.hist else arr[:, None]
+        if dropped_lane:
+            return None
         self.builds += 1
         fin = np.isfinite(val_stage)
         fcnt = fin.sum(axis=0).astype(np.int32)

@@ -22,6 +22,8 @@ from filodb_tpu.core.chunk import ChunkSet, decode_chunkset, encode_chunkset
 from filodb_tpu.core.histogram import HistogramBuckets
 from filodb_tpu.core.schemas import ColumnType, Schema
 
+_EMPTY_I64 = np.empty(0, dtype=np.int64)
+
 
 class PendingBuffer(NamedTuple):
     """A detached-but-not-yet-encoded write buffer.  ``freeze_raw`` (the
@@ -53,9 +55,11 @@ class TimeSeriesPartition:
         self.chunks: list[ChunkSet] = []
         self._decoded: dict[int, tuple] = {}   # chunk_id -> (ts, cols)
         self._capacity = capacity
-        self._buf_ts = np.empty(capacity, dtype=np.int64)
-        self._buf_cols: list = [self._new_col_buffer(c.ctype)
-                                for c in schema.data.columns[1:]]
+        # write buffers allocate lazily on first ingest: paged-in /
+        # snapshot partitions never ingest, and the ODP cold path
+        # constructs thousands of them per query
+        self._buf_ts = _EMPTY_I64
+        self._buf_cols: Optional[list] = None
         self._buf_n = 0
         self._hist_buckets: Optional[HistogramBuckets] = None
         self._seq = 0
@@ -78,6 +82,11 @@ class TimeSeriesPartition:
         if ctype in (ColumnType.LONG, ColumnType.TIMESTAMP, ColumnType.INT):
             return np.empty(self._capacity, dtype=np.int64)
         return []  # STRING / HISTOGRAM: python list, frozen at encode time
+
+    def _alloc_buffers_locked(self) -> None:
+        self._buf_ts = np.empty(self._capacity, dtype=np.int64)
+        self._buf_cols = [self._new_col_buffer(c.ctype)
+                          for c in self.schema.data.columns[1:]]
 
     # -- ingest -------------------------------------------------------------
 
@@ -108,6 +117,8 @@ class TimeSeriesPartition:
                 decoded.append(v)
         froze = False
         with self._lock:
+            if self._buf_cols is None:
+                self._alloc_buffers_locked()
             if new_buckets is not None:
                 if self._hist_buckets is not None and self._buf_n > 0 \
                         and new_buckets != self._hist_buckets:
@@ -177,6 +188,8 @@ class TimeSeriesPartition:
                         and new_buckets != self._hist_buckets:
                     froze = self._freeze_raw_locked() or froze
                 self._hist_buckets = new_buckets
+            if self._buf_cols is None:
+                self._alloc_buffers_locked()
             i = 0
             while i < kept:
                 if self._buf_n == self._capacity:
@@ -245,9 +258,7 @@ class TimeSeriesPartition:
                                            self._hist_buckets, self._seq))
         self._seq += 1
         self._buf_n = 0
-        self._buf_ts = np.empty(self._capacity, dtype=np.int64)
-        self._buf_cols = [self._new_col_buffer(c.ctype)
-                          for c in self.schema.data.columns[1:]]
+        self._alloc_buffers_locked()
         return True
 
     def drain_pending(self) -> list[ChunkSet]:
@@ -386,7 +397,8 @@ class TimeSeriesPartition:
             if ctype == ColumnType.HISTOGRAM:
                 return empty_ts, (self._hist_buckets, np.empty((0, 0), dtype=np.int64))
             return empty_ts, np.empty(0, dtype=np.float64)
-        ts = np.concatenate(ts_parts)
+        ts = ts_parts[0] if len(ts_parts) == 1 \
+            else np.concatenate(ts_parts)
         if ctype == ColumnType.HISTOGRAM:
             # widest bucket scheme wins; narrower chunks pad their top bucket
             # out (cumulative counts -> edge padding preserves totals)
@@ -403,7 +415,16 @@ class TimeSeriesPartition:
             mask = (ts >= start) & (ts <= end)
             flat = [x for p in val_parts for x in p]
             return ts[mask], [x for x, m in zip(flat, mask) if m]
-        vals = np.concatenate(val_parts).astype(np.float64)
+        vals = (val_parts[0] if len(val_parts) == 1
+                else np.concatenate(val_parts)).astype(np.float64,
+                                                       copy=False)
+        # whole span inside the query range (the ODP cold path / full
+        # dashboard scan): skip the mask pass — the returned arrays may
+        # then VIEW the decoded-chunk cache, so callers must treat
+        # read_range output as read-only (they all copy into batches,
+        # grids, or encoders)
+        if int(ts[0]) >= start and int(ts[-1]) <= end:
+            return ts, vals
         mask = (ts >= start) & (ts <= end)
         return ts[mask], vals[mask]
 

@@ -145,12 +145,12 @@ class _DeltaDeltaNative:
     def dd_decode(self, buf) -> np.ndarray:
         from filodb_tpu.codecs import deltadelta
 
-        b = bytes(buf)
+        b = np.frombuffer(buf, dtype=np.uint8)   # zero-copy over any buffer
         if len(b) < 1 + deltadelta._HDR.size:
             raise ValueError("DELTA2 buffer too short")
         n = deltadelta._HDR.unpack_from(b, 1)[0]
         out = np.empty(max(n, 1), dtype=np.int64)
-        got = self._lib.dd_decode(b, len(b), self._wc, self._wd,
+        got = self._lib.dd_decode(b.ctypes.data, len(b), self._wc, self._wd,
                                   out.ctypes.data, len(out))
         if got < 0:
             raise ValueError("corrupt DELTA2 vector")
@@ -165,9 +165,10 @@ class _XorNative:
         self._lib = lib
 
     def xor_unpack(self, buf, count: int, offset: int) -> np.ndarray:
-        b = bytes(buf)
+        b = np.frombuffer(buf, dtype=np.uint8)   # zero-copy over any buffer
         out = np.empty(max(count, 1), dtype=np.float64)
-        nxt = self._lib.xor_unpack(b, len(b), offset, count, out.ctypes.data)
+        nxt = self._lib.xor_unpack(b.ctypes.data, len(b), offset, count,
+                                   out.ctypes.data)
         if nxt < 0:
             raise ValueError("corrupt XOR double vector")
         return out[:count]
@@ -175,6 +176,13 @@ class _XorNative:
     def dbl_encode_batch(self, arrays) -> list[bytes]:
         return _encode_batch(self._lib.dbl_encode_batch, arrays,
                              np.float64)
+
+    def dbl_encode_batch_2d(self, arr2d) -> list[bytes]:
+        """Encode every ROW of a [nvec, n] float64 matrix — the columnar
+        downsample write path: the data is already contiguous, so the
+        per-vector concat of the list form is skipped entirely."""
+        return _encode_batch_2d(self._lib.dbl_encode_batch, arr2d,
+                                np.float64)
 
 
 class _LLEncodeNative:
@@ -217,6 +225,24 @@ class _BatchDecodeNative:
     def dbl_decode_batch(self, blobs, counts) -> list[np.ndarray]:
         return self._decode(self._lib.dbl_decode_batch, blobs, counts,
                             np.float64)
+
+
+def _encode_batch_2d(fn, arr2d, dtype) -> list[bytes]:
+    arr2d = np.ascontiguousarray(arr2d, dtype)
+    nvec, n = arr2d.shape
+    if nvec == 0:
+        return []
+    starts = np.arange(nvec + 1, dtype=np.int64) * n
+    per = 26 + ((n + 7) // 8) * 66          # same bound as _encode_batch
+    cap = int(nvec * per)
+    out = np.empty(max(cap, 1), dtype=np.uint8)
+    offs = np.empty(nvec + 1, dtype=np.int64)
+    total = fn(arr2d.ctypes.data, starts.ctypes.data, nvec,
+               out.ctypes.data, len(out), offs.ctypes.data)
+    if total < 0:
+        raise ValueError("native batch encode overflow")
+    buf = out[:total].tobytes()
+    return [buf[offs[i]:offs[i + 1]] for i in range(nvec)]
 
 
 def _encode_batch(fn, arrays, dtype) -> list[bytes]:

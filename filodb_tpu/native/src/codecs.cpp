@@ -483,8 +483,8 @@ long long hist_col_decode(const uint8_t* buf, size_t buflen,
 // dominant cost of small downsample chunks.
 //
 // Wire constants mirror filodb_tpu/codecs/wire.py (DELTA2=1,
-// CONST_LONG=2, DELTA2_DOUBLE=16, XOR_DOUBLE=17, CONST_DOUBLE=19,
-// GORILLA_DOUBLE=20); the byte-identity tests against the Python
+// CONST_LONG=2, DELTA2_DOUBLE=16, XOR_DOUBLE=17, RAW_DOUBLE=18,
+// CONST_DOUBLE=19, GORILLA_DOUBLE=20); the byte-identity tests against the Python
 // encoders guard the pairing.
 
 namespace {
@@ -493,6 +493,7 @@ constexpr uint8_t kWireDelta2 = 1;
 constexpr uint8_t kWireConstLong = 2;
 constexpr uint8_t kWireDelta2Double = 16;
 constexpr uint8_t kWireXorDouble = 17;
+constexpr uint8_t kWireRawDouble = 18;
 constexpr uint8_t kWireConstDouble = 19;
 constexpr uint8_t kWireGorillaDouble = 20;
 
@@ -626,6 +627,21 @@ long long dbl_encode_one(const double* v, size_t n, uint8_t* out,
   size_t gorilla_bytes = 8 + (n + 7) / 8 + (nnz * 12 + 7) / 8
                          + (sig_total + 7) / 8;
   long long packed = np_pack(scratch, n, packbuf);
+  // compression must pay for itself: unless the best bit-packed form
+  // saves >=10% over raw, emit RAW_DOUBLE (one memcpy to decode).
+  // Integer rule identical to the Python encoder (doublecodec.encode).
+  size_t best = gorilla_bytes < static_cast<size_t>(packed) + 4
+                    ? gorilla_bytes
+                    : static_cast<size_t>(packed) + 4;
+  size_t raw_bytes = 4 + 8 * n;
+  if (best * 10 > raw_bytes * 9) {
+    size_t total = 5 + 8 * n;
+    if (cap < total) return -1;
+    out[0] = kWireRawDouble;
+    put_u32(out + 1, static_cast<uint32_t>(n));
+    std::memcpy(out + 5, v, 8 * n);
+    return static_cast<long long>(total);
+  }
   if (gorilla_bytes <= static_cast<size_t>(packed) + 4) {
     size_t total = 1 + gorilla_bytes;
     if (cap < total) return -1;
@@ -719,6 +735,12 @@ long long dbl_decode_batch(const uint8_t* buf, const int64_t* offs,
       std::memcpy(&nn, b + 1, 4);
       if (nn != n) return -1;
       if (xor_unpack(b, blen, 5, n, o) < 0) return -1;
+    } else if (wire == kWireRawDouble) {
+      uint32_t nn;
+      if (blen < 5 + 8 * n) return -1;
+      std::memcpy(&nn, b + 1, 4);
+      if (nn != n) return -1;
+      std::memcpy(o, b + 5, 8 * n);
     } else if (wire == kWireGorillaDouble) {
       if (blen < 9) return -1;
       uint32_t nn, nnz;

@@ -41,14 +41,19 @@ def pack_vectors(vectors: Sequence[bytes]) -> bytes:
     return bytes(out)
 
 
-def unpack_vectors(blob: bytes) -> list[bytes]:
+def unpack_vectors(blob: bytes) -> list:
+    """Zero-copy split: memoryview slices over the row blob (the batch
+    downsampler unpacks thousands of rows per run; byte-slice copies of
+    every vector were a measurable share of its budget).  All decode
+    paths accept any buffer object."""
     (n,) = _U16.unpack_from(blob, 0)
     pos = _U16.size
+    mv = memoryview(blob)
     vectors = []
     for _ in range(n):
         (ln,) = _U32.unpack_from(blob, pos)
         pos += _U32.size
-        vectors.append(blob[pos:pos + ln])
+        vectors.append(mv[pos:pos + ln])
         pos += ln
     return vectors
 
@@ -261,18 +266,30 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
 
     def read_raw_partitions(self, dataset, shard, partkeys, start_time,
                             end_time) -> Iterator[tuple[bytes, list[ChunkSet]]]:
+        """Yields (partkey, chunk-ordered chunksets) in the CALLER's key
+        order.  Reads are batched with chunked IN lists — the ODP cold
+        path pages thousands of partitions per query, and one sqlite
+        round-trip per partkey dominated its page-in time."""
         conn = self._conn()
+        partkeys = list(partkeys)
+        by_pk: dict[bytes, list] = {}
+        for i in range(0, len(partkeys), 500):
+            batch = partkeys[i:i + 500]
+            ph = ",".join("?" * len(batch))
+            for pk, cid, nr, st, et, sh, blob in conn.execute(
+                    "SELECT partkey, chunk_id, num_rows, start_time, "
+                    "end_time, schema_hash, vectors FROM chunks "
+                    f"WHERE dataset=? AND shard=? AND partkey IN ({ph}) "
+                    "AND end_time>=? AND start_time<=? "
+                    "ORDER BY partkey, chunk_id",
+                    (dataset, shard, *batch, start_time, end_time)):
+                by_pk.setdefault(pk, []).append(
+                    ChunkSet(ChunkSetInfo(cid, nr, st, et), pk,
+                             unpack_vectors(blob), schema_hash=sh))
         for pk in partkeys:
-            rows = conn.execute(
-                "SELECT chunk_id, num_rows, start_time, end_time, "
-                "schema_hash, vectors "
-                "FROM chunks WHERE dataset=? AND shard=? AND partkey=? "
-                "AND end_time>=? AND start_time<=? ORDER BY chunk_id",
-                (dataset, shard, pk, start_time, end_time)).fetchall()
-            if rows:
-                yield pk, [ChunkSet(ChunkSetInfo(cid, nr, st, et), pk,
-                                    unpack_vectors(blob), schema_hash=sh)
-                           for cid, nr, st, et, sh, blob in rows]
+            css = by_pk.get(pk)
+            if css:
+                yield pk, css
 
     def scan_part_keys(self, dataset, shard) -> Iterator[PartKeyRecord]:
         conn = self._conn()
@@ -295,15 +312,20 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
 
     def scan_bytes(self, dataset, shard, partkeys, start_time, end_time) -> int:
         """Metadata-only byte estimate: no vector blobs leave sqlite.
-        LENGTH(vectors) is O(1) on a blob column."""
+        LENGTH(vectors) is O(1) on a blob column; keys are batched with
+        chunked IN lists (the ODP cap check costs one pass, not one
+        round-trip per partition)."""
         conn = self._conn()
+        partkeys = list(partkeys)
         total = 0
-        for pk in partkeys:
+        for i in range(0, len(partkeys), 500):
+            batch = partkeys[i:i + 500]
+            ph = ",".join("?" * len(batch))
             row = conn.execute(
                 "SELECT COALESCE(SUM(LENGTH(vectors)),0) FROM chunks "
-                "WHERE dataset=? AND shard=? AND partkey=? "
+                f"WHERE dataset=? AND shard=? AND partkey IN ({ph}) "
                 "AND end_time>=? AND start_time<=?",
-                (dataset, shard, pk, start_time, end_time)).fetchone()
+                (dataset, shard, *batch, start_time, end_time)).fetchone()
             total += row[0]
         return total
 

@@ -258,6 +258,51 @@ class TestBatchDownsampler:
                 np.testing.assert_allclose(got_avg[j], vals[pids == p].mean())
 
 
+    def test_planar_path_taken_and_equivalent(self, tmp_path):
+        """Aligned full-live data must take the COLUMNAR write path
+        (downsample_planes) and produce byte-equal aggregates to the
+        per-series downsample_arrays fallback for every resolution in
+        the ladder."""
+        from filodb_tpu.core.record import (RecordBuilder, parse_partkey)
+        from filodb_tpu.core.schemas import DatasetOptions
+
+        disk = DiskColumnStore(str(tmp_path / "c.db"))
+        meta = DiskMetaStore(str(tmp_path / "m.db"))
+        store = TimeSeriesMemStore(disk, meta)
+        store.setup("prom", DEFAULT_SCHEMAS, 0,
+                    StoreConfig(max_chunks_size=720))
+        b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], DatasetOptions())
+        rng = np.random.default_rng(9)
+        n_rows, step = 720, 5_000
+        ts = BASE + np.arange(n_rows, dtype=np.int64) * step
+        for i in range(7):
+            tags = {"_metric_": "pl", "instance": f"i{i}",
+                    "_ws_": "w", "_ns_": "n"}
+            b.add_series(ts, [rng.random(n_rows) * 10], tags)
+        for off, c in enumerate(b.containers()):
+            store.ingest("prom", 0, c, offset=off)
+        store.get_shard("prom", 0).flush_all(ingestion_time=1000)
+
+        pairs = [(parse_partkey(cs.partkey), cs) for cs in
+                 disk.chunksets_by_ingestion_time("prom", 0, 0, 2**62)]
+        samp = ShardDownsampler("prom", 0, DEFAULT_SCHEMAS["gauge"],
+                                None, (RES, 900_000))
+        prepared = samp.prepare_arrays(pairs)
+        for res in (RES, 900_000):
+            planar = samp.downsample_planes(prepared, res)
+            assert planar is not None, res
+            tags_list, pe, planes, leftovers = planar
+            assert len(tags_list) == 7 and not leftovers, res
+            per = samp.downsample_arrays(prepared, res)
+            by_inst = {t["instance"]: (t2, cols)
+                       for t, t2, cols in per}
+            for i, tags in enumerate(tags_list):
+                t_ref, cols_ref = by_inst[tags["instance"]]
+                np.testing.assert_array_equal(pe, t_ref)
+                for ci, plane in enumerate(planes):
+                    np.testing.assert_array_equal(plane[:, i],
+                                                  cols_ref[ci], err_msg=str((res, ci)))
+
     def test_successive_windows_widen_partkey_lifetime(self, tmp_path):
         """Two batch runs over DIFFERENT ingestion windows: the second
         must widen the downsample partkey's time range, never narrow it

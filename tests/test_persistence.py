@@ -433,10 +433,14 @@ class TestOnDemandPaging:
         old_lane = cache.lane_of[victim]
         shard.paged.pop(victim)                        # LRU drop, mid-flight
         shard.bump_removal_epoch()
-        # rebuilding with the lane unmaterializable must PRUNE it (a
-        # permanent eviction must not wedge future builds) …
-        assert cache._build(bi, blk.lanes) is not None
+        # rebuilding with the lane unmaterializable must PRUNE it AND
+        # fail THIS build (an in-flight pre-eviction prep must fall
+        # back, never read a cached NaN lane) …
+        assert cache._build(bi, blk.lanes) is None
         assert victim not in cache.lane_of
+        # … while the NEXT build succeeds — a permanent eviction cannot
+        # wedge future builds
+        assert cache._build(bi, blk.lanes) is not None
         # … a re-appearing partition gets a FRESH lane, so the stale NaN
         # lane can never serve it, and end-to-end results stay correct
         cache.blocks.clear()
