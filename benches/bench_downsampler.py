@@ -41,7 +41,10 @@ def kernel_main():
     err = bench._probe_backend(
         int(os.environ.get("FILODB_BENCH_PROBE_TIMEOUT_S", "120")))
     if err is not None:
-        print(json.dumps({"error": f"backend unavailable: {err}"}))
+        # flush before os._exit: piped stdout is block-buffered and
+        # os._exit skips interpreter cleanup
+        print(json.dumps({"error": f"backend unavailable: {err}"}),
+              flush=True)
         os._exit(3)      # a dead TPU tunnel hangs init; exit fast instead
 
     import jax

@@ -100,6 +100,32 @@ class IngestRecord:
         return canonical_partkey(self.tags)
 
 
+_REC_DTYPE_CACHE: dict = {}
+
+
+def record_dtype(schema: Schema, pklen: int) -> "np.dtype":
+    """The numpy structured dtype of one wire record for (schema, pklen)
+    — cached: dtype construction is a surprising share of small
+    per-series batch encodes."""
+    key = (schema.schema_hash, pklen)
+    dt = _REC_DTYPE_CACHE.get(key)
+    if dt is None:
+        fields = [("schema", "<u2"), ("shash", "<u4"), ("phash", "<u4"),
+                  ("ts", "<i8")]
+        for ci, col in enumerate(schema.data.columns[1:]):
+            if col.ctype == ColumnType.DOUBLE:
+                fields.append((f"c{ci}", "<f8"))
+            elif col.ctype == ColumnType.INT:
+                fields.append((f"c{ci}", "<i4"))
+            else:
+                fields.append((f"c{ci}", "<i8"))
+        fields.append(("pklen", "<u2"))
+        if pklen:
+            fields.append(("pk", f"V{pklen}"))
+        dt = _REC_DTYPE_CACHE[key] = np.dtype(fields)
+    return dt
+
+
 class RecordBuilder:
     """Builds RecordContainers from samples (reference: RecordBuilder.scala:32).
 
@@ -174,34 +200,26 @@ class RecordBuilder:
         n = len(timestamps)
         if n == 0:
             return 0
-        data_cols = self.schema.data.columns[1:]
-        fields = [("schema", "<u2"), ("shash", "<u4"), ("phash", "<u4"),
-                  ("ts", "<i8")]
-        for ci, col in enumerate(data_cols):
-            if col.ctype == ColumnType.DOUBLE:
-                fields.append((f"c{ci}", "<f8"))
-            elif col.ctype == ColumnType.INT:
-                fields.append((f"c{ci}", "<i4"))
-            else:
-                fields.append((f"c{ci}", "<i8"))
-        fields.append(("pklen", "<u2"))
-        if pk:
-            fields.append(("pk", f"V{len(pk)}"))
-        rec = np.zeros(n, dtype=np.dtype(fields))
+        rec = np.zeros(n, dtype=record_dtype(self.schema, len(pk)))
         rec["schema"] = self.schema.schema_hash
         rec["shash"] = shash
         rec["phash"] = phash
         rec["ts"] = np.asarray(timestamps, dtype=np.int64)
-        for ci, col in enumerate(data_cols):
+        self._fill_value_cols(rec, columns)
+        rec["pklen"] = len(pk)
+        if pk:
+            rec["pk"] = np.frombuffer(pk, dtype=np.uint8).view(f"V{len(pk)}")
+        self._append_records(rec.tobytes(), rec.dtype.itemsize, n)
+        return n
+
+    def _fill_value_cols(self, rec: np.ndarray, columns) -> None:
+        for ci, col in enumerate(self.schema.data.columns[1:]):
             arr = np.asarray(columns[ci])
             rec[f"c{ci}"] = arr.astype(np.float64) \
                 if col.ctype == ColumnType.DOUBLE else arr.astype(np.int64) \
                 if col.ctype != ColumnType.INT else arr.astype(np.int32)
-        rec["pklen"] = len(pk)
-        if pk:
-            rec["pk"] = np.frombuffer(pk, dtype=np.uint8).view(f"V{len(pk)}")
-        blob = rec.tobytes()
-        rec_size = rec.dtype.itemsize
+
+    def _append_records(self, blob: bytes, rec_size: int, n: int) -> None:
         per = max((self.container_size - len(self._cur)) // rec_size, 0)
         pos = 0
         while pos < n:
@@ -213,7 +231,6 @@ class RecordBuilder:
             self._cur += blob[pos * rec_size:(pos + take) * rec_size]
             pos += take
             per = (self.container_size - len(self._cur)) // rec_size
-        return n
 
     def _flush_container(self) -> None:
         self._containers.append(self._cur)

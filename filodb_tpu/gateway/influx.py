@@ -249,6 +249,20 @@ def parse_batch_columns(text: str, batch_memo: Optional[dict] = None):
         text += "\n"
     data = text.encode("utf-8")
     a = np.frombuffer(data, np.uint8)
+    # native fast scan: ONE C pass yields per-line spans + parsed
+    # values/timestamps; head dedup + memoization stay up here
+    from filodb_tpu import native as _native_mod
+    nparse = _native_mod.influx_parser()
+    if nparse is not None:
+        got = nparse.parse(data)
+        if got is nparse.INVALID:
+            return None
+        starts, sp1, eq1, values, ts_ns = got
+        N = len(starts)
+        if N == 0:
+            return None
+        return _resolve_heads(a, data, starts, sp1, eq1, values,
+                              ts_ns // 1_000_000, batch_memo)
     nl = np.flatnonzero(a == 10)
     starts = np.empty(len(nl), np.int64)
     starts[0] = 0
@@ -297,28 +311,78 @@ def parse_batch_columns(text: str, batch_memo: Optional[dict] = None):
         if ((c1 < len(commas)) & (cc < sp2)).any():
             return None                            # multi-field line
 
-    def range_index(lo, lens):
-        """Flat index array covering per-line [lo_i, lo_i + len_i)."""
-        offs = np.zeros(len(lens), np.int64)
-        np.cumsum(lens[:-1], out=offs[1:] if len(lens) > 1 else offs[:0])
-        total = int(lens.sum())
-        idx = np.arange(total, dtype=np.int64) + np.repeat(lo - offs,
-                                                           lens)
-        return idx, offs
-
     try:
-        # value + ts tokens: include the trailing \r/\n byte as the
-        # whitespace separator bytes.split() needs
-        end_incl = np.minimum(ends + 1, L)
-        idx, _ = range_index(eq1 + 1, end_incl - (eq1 + 1))
+        # value tokens [eq1+1, sp2]: include the space at sp2 as the
+        # separator bytes.split() needs
+        idx, _ = range_index(eq1 + 1, sp2 + 1 - (eq1 + 1))
         vt = bytes(a[idx]).split()
-        if len(vt) != 2 * N:
+        if len(vt) != N:
             return None
-        values = np.array(vt[0::2], dtype=np.float64)
-        ts_ns = np.array(vt[1::2], dtype=np.int64)
+        values = np.array(vt, dtype=np.float64)
     except (ValueError, OverflowError):
-        return None                    # int/bool/string fields, bad ts
-    ts_ms = ts_ns // 1_000_000
+        return None                    # int/bool/string fields
+    # timestamps [sp2+1, ends): pure digits -> vectorized base-10 parse
+    # (no per-line bytes objects); signs/garbage fall back to the
+    # general parser
+    tlen = ends - sp2 - 1
+    if (tlen <= 0).any() or int(tlen.max()) > 19:
+        return None
+    TL = int(tlen.max())
+    tidx, toffs = range_index(sp2 + 1, tlen)
+    digits = a[tidx].astype(np.int64) - 48
+    if ((digits < 0) | (digits > 9)).any():
+        return None
+    rel = np.arange(len(tidx), dtype=np.int64) - np.repeat(toffs, tlen)
+    mat = np.zeros((N, TL), np.int64)
+    mat[np.repeat(np.arange(N, dtype=np.int64), tlen),
+        rel + np.repeat(TL - tlen, tlen)] = digits  # right-aligned
+    if TL <= 10:
+        ts_ns = mat @ (10 ** np.arange(TL - 1, -1, -1, dtype=np.int64))
+    else:
+        lo = mat[:, -10:] @ (10 ** np.arange(9, -1, -1, dtype=np.int64))
+        hi = mat[:, :-10] @ (10 ** np.arange(TL - 11, -1, -1,
+                                             dtype=np.int64))
+        # 19-digit values can exceed int64: combine in uint64 (exact to
+        # ~1.8e19) and reject anything past int64 range
+        u = hi.astype(np.uint64) * np.uint64(10 ** 10) \
+            + lo.astype(np.uint64)
+        if (u > np.uint64(2**63 - 1)).any():
+            return None
+        ts_ns = u.astype(np.int64)
+    return _resolve_heads(a, data, starts, sp1, eq1, values,
+                          ts_ns // 1_000_000, batch_memo)
+
+
+def range_index(lo, lens):
+    """Flat index array covering per-line [lo_i, lo_i + len_i)."""
+    import numpy as np
+    offs = np.zeros(len(lens), np.int64)
+    np.cumsum(lens[:-1], out=offs[1:] if len(lens) > 1 else offs[:0])
+    total = int(lens.sum())
+    idx = np.arange(total, dtype=np.int64) + np.repeat(lo - offs, lens)
+    return idx, offs
+
+
+def _resolve_heads(a, data, starts, sp1, eq1, values, ts_ms, batch_memo):
+    """Shared tail of the columnar parse: steady-state memo check, field
+    names, and the verified head dedup over already-located line spans
+    (fed by either the native C scan or the numpy scan)."""
+    import numpy as np
+    N = len(starts)
+    # steady-state memo: ONE byte-compare of the concatenated
+    # [head, field-name] regions (everything before each line's '=')
+    # short-circuits head dedup AND field-name resolution — the scrape
+    # shape re-sends the same series/field layout every interval, only
+    # values and timestamps move
+    slen = eq1 - starts
+    if batch_memo is not None:
+        prev = batch_memo.get("line_sig")
+        if prev is not None and np.array_equal(prev[1], slen):
+            sidx, _ = range_index(starts, slen)
+            sb8 = a[sidx]
+            if len(prev[0]) == len(sb8) and bytes(sb8) == prev[0]:
+                heads, inverse, ufn, finv = prev[2:]
+                return (heads, inverse, ufn, finv, values, ts_ms)
     # field names: include each line's '=' as the separator
     idx, _ = range_index(sp1 + 1, eq1 + 1 - (sp1 + 1))
     fn_tokens = bytes(a[idx]).split(b"=")[:-1]
@@ -335,13 +399,6 @@ def parse_batch_columns(text: str, batch_memo: Optional[dict] = None):
         return None
     hidx, hoffs = range_index(starts, hlen)
     hb8 = a[hidx]
-    if batch_memo is not None:
-        prev = batch_memo.get("heads_sig")
-        if prev is not None and len(prev[0]) == len(hb8) \
-                and np.array_equal(prev[1], hlen) \
-                and bytes(hb8) == prev[0]:
-            heads, inverse = prev[2], prev[3]
-            return (heads, inverse, ufn, finv, values, ts_ms)
     rel = np.arange(len(hidx), dtype=np.int64) - np.repeat(hoffs, hlen)
     hb = hb8.astype(np.uint64)
     p1, p2 = _hash_pows()
@@ -366,8 +423,9 @@ def parse_batch_columns(text: str, batch_memo: Optional[dict] = None):
         return None
     heads = [data[starts[i]:sp1[i]].decode("utf-8") for i in first_idx]
     if batch_memo is not None:
-        batch_memo["heads_sig"] = (bytes(hb8), hlen.copy(), heads,
-                                   inverse)
+        sidx, _ = range_index(starts, slen)
+        batch_memo["line_sig"] = (bytes(a[sidx]), slen.copy(), heads,
+                                  inverse, ufn, finv)
     return (heads, inverse, ufn, finv, values, ts_ms)
 
 

@@ -118,60 +118,158 @@ class ShardingPublisher:
     def _ingest_columns(self, cols) -> int:
         import numpy as np
 
+        from filodb_tpu.core.record import record_dtype
+        from filodb_tpu.core.schemas import ColumnType
         from filodb_tpu.gateway.influx import parse_head, prom_metric_name
         uheads, inv, ufn, finv, values, ts_ms = cols
+        # steady-state: the parser's memo returns the SAME inv/finv
+        # objects while the series/field layout is byte-identical, so the
+        # whole group resolution + record layout is replayable as a plan
+        plan = getattr(self, "_group_plan", None)
+        if plan is not None and plan["key"] == (id(inv), id(finv),
+                                                len(inv)):
+            return self._ingest_planned(plan, values, ts_ms)
         if not hasattr(self, "_series_memo"):
             self._series_memo = {}
         combo = inv.astype(np.int64) * len(ufn) + finv
         order = np.argsort(combo, kind="stable")
         sc = combo[order]
-        starts = np.flatnonzero(
+        gstarts = np.flatnonzero(
             np.concatenate([[True], sc[1:] != sc[:-1]]))
-        ends = np.append(starts[1:], len(order))
+        gends = np.append(gstarts[1:], len(order))
+        ngroups = len(gstarts)
         # resolve EVERY group's series memo first: a malformed head
         # mid-batch must skip only its own lines (counted as parse
         # errors), never abort after some groups already landed
-        groups = []
+        shard_g = np.empty(ngroups, np.int64)
+        shash_g = np.empty(ngroups, np.uint32)
+        phash_g = np.empty(ngroups, np.uint32)
+        pk_g: list = [b""] * ngroups
+        good = np.ones(ngroups, bool)
         bad = 0
-        for s, e in zip(starts, ends):
-            rows = order[s:e]
-            head = uheads[int(inv[rows[0]])]
-            fname = ufn[int(finv[rows[0]])]
-            key = (head, fname)
+        for gi in range(ngroups):
+            r0 = int(order[gstarts[gi]])
+            key = (uheads[int(inv[r0])], ufn[int(finv[r0])])
             got = self._series_memo.get(key)
             if got is None:
                 try:
-                    measurement, tags = parse_head(head)
+                    measurement, tags = parse_head(key[0])
                 except InfluxParseError:
-                    bad += len(rows)
+                    good[gi] = False
+                    bad += int(gends[gi] - gstarts[gi])
                     continue
                 if len(self._series_memo) > 200_000:
                     self._series_memo.clear()
-                metric = prom_metric_name(measurement, fname)
+                metric = prom_metric_name(measurement, key[1])
                 norm = dict(tags)
                 norm[self.options.metric_column] = metric
                 from filodb_tpu.core.record import (canonical_partkey,
                                                     partition_hash,
                                                     shard_key_hash)
                 # memoize shard AND the per-series hashes/partkey: the
-                # record build then skips recomputing them every batch
+                # batch record build gathers them, never recomputes
                 shash = shard_key_hash(norm, self.options)
                 phash = partition_hash(norm, self.options)
                 shard = self.mapper.ingestion_shard(
                     shash, phash, self.spread) % self.mapper.num_shards
                 got = self._series_memo[key] = (
                     shard, shash, phash, canonical_partkey(norm))
-            groups.append((got, rows))
-        self.parse_errors += bad
+            shard_g[gi], shash_g[gi], phash_g[gi], pk_g[gi] = got
+        data_cols = self.schema.data.columns[1:]
+        if len(data_cols) != 1 or data_cols[0].ctype != ColumnType.DOUBLE:
+            # general schemas take the per-series path
+            self.parse_errors += bad
+            return self._ingest_groups_per_series(
+                order, gstarts, gends, good, shard_g, shash_g, phash_g,
+                pk_g, values, ts_ms)
+        # -- ONE structured-array build for the whole batch, sliced per
+        # shard: per-row fields GATHER from the per-series arrays (the
+        # per-series RecordBuilder call was the e2e bottleneck at 1e6
+        # samples/s; reference: GatewayServer's container reuse,
+        # GatewayServer.scala:58).  Everything except the per-batch
+        # timestamp/value patch is captured in a PLAN, memoized on the
+        # parser's memo-identity (see _ingest_planned).
+        counts = gends - gstarts
+        srow = np.repeat(np.arange(ngroups), counts)   # series per pos
+        keep = good[srow]
+        rows = order[keep]
+        sidx = srow[keep]
+        pklen_g = np.fromiter((len(p) for p in pk_g), np.int64, ngroups)
+        row_pl = pklen_g[sidx]
+        pls = []
+        for pl in np.unique(row_pl):
+            sel = row_pl == pl
+            rsel, ssel = rows[sel], sidx[sel]
+            # shard-major so each shard's records slice contiguously
+            bysh = np.argsort(shard_g[ssel], kind="stable")
+            rsel, ssel = rsel[bysh], ssel[bysh]
+            dt = record_dtype(self.schema, int(pl))
+            proto = np.zeros(len(rsel), dt)
+            proto["schema"] = self.schema.schema_hash
+            proto["shash"] = shash_g[ssel]
+            proto["phash"] = phash_g[ssel]
+            proto["pklen"] = pl
+            if pl:
+                uniq_s, pinv = np.unique(ssel, return_inverse=True)
+                pkm = np.frombuffer(
+                    b"".join(pk_g[int(u)] for u in uniq_s),
+                    np.uint8).reshape(len(uniq_s), int(pl))
+                proto["pk"] = pkm.view(f"V{int(pl)}")[:, 0][pinv]
+            sh = shard_g[ssel]
+            seg = np.flatnonzero(np.concatenate(
+                [[True], sh[1:] != sh[:-1]]))
+            seg_end = np.append(seg[1:], len(sh))
+            segs = [(int(sh[a0]), int(a0), int(b0))
+                    for a0, b0 in zip(seg, seg_end)]
+            pls.append({"proto": proto, "rsel": rsel, "segs": segs})
+        plan = {"key": (id(inv), id(finv), len(inv)),
+                "refs": (inv, finv), "pls": pls, "bad": bad}
+        self._group_plan = plan
+        return self._ingest_planned(plan, values, ts_ms)
+
+    def _ingest_planned(self, plan, values, ts_ms) -> int:
+        """Execute a cached batch-build plan: copy each pre-filled record
+        prototype (hashes, partkeys, shard layout baked in), patch
+        timestamps + values, and append contiguous per-shard slices —
+        the steady-state scrape path costs ~8 numpy ops per batch."""
+        self.parse_errors += plan["bad"]
         n = 0
         with self._lock:
-            for (shard, shash, phash, pk), rows in groups:
+            for p in plan["pls"]:
+                rec = p["proto"].copy()
+                rec["ts"] = ts_ms[p["rsel"]]
+                rec["c0"] = values[p["rsel"]]
+                blob = rec.tobytes()
+                isz = rec.dtype.itemsize
+                for shard, a0, b0 in p["segs"]:
+                    builder = self._builders.get(shard)
+                    if builder is None:
+                        builder = self._builders[shard] = RecordBuilder(
+                            self.schema, self.options,
+                            self.container_size)
+                    builder._append_records(blob[a0 * isz:b0 * isz],
+                                            isz, b0 - a0)
+                n += len(p["rsel"])
+            self.samples_in += n
+        return n
+
+    def _ingest_groups_per_series(self, order, gstarts, gends, good,
+                                  shard_g, shash_g, phash_g, pk_g,
+                                  values, ts_ms) -> int:
+        n = 0
+        with self._lock:
+            for gi in range(len(gstarts)):
+                if not good[gi]:
+                    continue
+                rows = order[gstarts[gi]:gends[gi]]
+                shard = int(shard_g[gi])
                 builder = self._builders.get(shard)
                 if builder is None:
                     builder = self._builders[shard] = RecordBuilder(
                         self.schema, self.options, self.container_size)
-                builder.add_series_hashed(ts_ms[rows], [values[rows]],
-                                          shash, phash, pk)
+                builder.add_series_hashed(
+                    ts_ms[rows], [values[rows]], int(shash_g[gi]),
+                    int(phash_g[gi]), pk_g[gi])
                 n += len(rows)
             self.samples_in += n
         return n

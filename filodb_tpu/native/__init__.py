@@ -96,6 +96,11 @@ def _bind(lib):
         fn.restype = ctypes.c_longlong
         fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
                        ctypes.c_void_p, ctypes.c_void_p]
+    lib.influx_parse_batch.restype = ctypes.c_longlong
+    lib.influx_parse_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p]
     return lib
 
 
@@ -227,6 +232,35 @@ class _BatchDecodeNative:
                             np.float64)
 
 
+class _InfluxNative:
+    """Adapter for influx.py's ``_native_parse`` hook: one C pass scans
+    the payload into per-line spans + parsed values/timestamps."""
+
+    INVALID = "invalid"    # sentinel: batch needs the general parser
+
+    def __init__(self, lib):
+        self._lib = lib
+
+    def parse(self, data: bytes):
+        a = np.frombuffer(data, np.uint8)
+        maxn = int(np.count_nonzero(a == 10))
+        if maxn == 0:
+            return self.INVALID
+        starts = np.empty(maxn, np.int64)
+        sp1 = np.empty(maxn, np.int64)
+        eq1 = np.empty(maxn, np.int64)
+        values = np.empty(maxn, np.float64)
+        ts_ns = np.empty(maxn, np.int64)
+        got = self._lib.influx_parse_batch(
+            a.ctypes.data, len(a), maxn, starts.ctypes.data,
+            sp1.ctypes.data, eq1.ctypes.data, values.ctypes.data,
+            ts_ns.ctypes.data)
+        if got < 0:
+            return self.INVALID
+        n = int(got)
+        return (starts[:n], sp1[:n], eq1[:n], values[:n], ts_ns[:n])
+
+
 def _encode_batch_2d(fn, arr2d, dtype) -> list[bytes]:
     arr2d = np.ascontiguousarray(arr2d, dtype)
     nvec, n = arr2d.shape
@@ -281,8 +315,9 @@ def enable() -> bool:
                                            int(WireType.DELTA2))
     deltadelta._native_enc = _LLEncodeNative(lib)
     doublecodec._native = _XorNative(lib)
-    global _batch_dec
+    global _batch_dec, _influx_parse
     _batch_dec = _BatchDecodeNative(lib)
+    _influx_parse = _InfluxNative(lib)
     return True
 
 
@@ -293,11 +328,13 @@ def disable() -> None:
     deltadelta._native = None
     deltadelta._native_enc = None
     doublecodec._native = None
-    global _batch_dec
+    global _batch_dec, _influx_parse
     _batch_dec = None
+    _influx_parse = None
 
 
 _batch_dec = None
+_influx_parse = None
 
 
 def batch_decoder():
@@ -305,6 +342,13 @@ def batch_decoder():
     Looked up lazily by core/chunk.py — enable() runs during the codecs
     package import, when core.chunk cannot be imported yet."""
     return _batch_dec
+
+
+def influx_parser():
+    """The influx batch-scan adapter, or None when native is off.
+    Looked up lazily by gateway/influx.py (same reason as
+    :func:`batch_decoder`)."""
+    return _influx_parse
 
 
 def is_enabled() -> bool:

@@ -15,6 +15,7 @@
 #include <cmath>
 #include <cstddef>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <string_view>
 #include <unordered_map>
@@ -828,6 +829,81 @@ long long dbl_encode_batch(const double* vals, const int64_t* starts,
     blob_offs[k + 1] = pos;
   }
   return pos;
+}
+
+// Influx line-protocol batch scan: one pass over the payload finds each
+// line's head span, field '=', float value, and integer ns timestamp
+// (the gateway's columnar hot path; the Python layer keeps head dedup +
+// memoization).  Caller pre-rejects escapes/quotes/comments and
+// guarantees a trailing '\n'.  Writes per-line starts/sp1/eq1 offsets,
+// values, and timestamps; returns the line count, or -1 when ANY line
+// needs the general parser (the fast path is never wrong, only absent).
+long long influx_parse_batch(const uint8_t* buf, int64_t n,
+                             int64_t max_lines, int64_t* starts,
+                             int64_t* sp1, int64_t* eq1, double* values,
+                             long long* ts_ns) {
+  int64_t nl = 0;
+  int64_t i = 0;
+  while (i < n) {
+    const uint8_t* p =
+        static_cast<const uint8_t*>(memchr(buf + i, '\n', n - i));
+    if (!p) break;
+    int64_t j = p - buf;
+    int64_t end = j;
+    if (end > i && buf[end - 1] == '\r') --end;
+    if (end == i) { i = j + 1; continue; }           // blank line
+    if (nl >= max_lines) return -1;
+    if (buf[i] == ' ' || buf[end - 1] == ' ') return -1;
+    const uint8_t* s1 =
+        static_cast<const uint8_t*>(memchr(buf + i, ' ', end - i));
+    if (!s1) return -1;                              // no fields
+    int64_t a1 = s1 - buf;
+    const uint8_t* s2 = static_cast<const uint8_t*>(
+        memchr(buf + a1 + 1, ' ', end - a1 - 1));
+    if (!s2) return -1;                              // no timestamp
+    int64_t a2 = s2 - buf;
+    if (memchr(buf + a2 + 1, ' ', end - a2 - 1)) return -1;
+    const uint8_t* e1 = static_cast<const uint8_t*>(
+        memchr(buf + a1 + 1, '=', a2 - a1 - 1));
+    if (!e1) return -1;                              // field without '='
+    int64_t b1 = e1 - buf;
+    if (b1 == a1 + 1) return -1;                     // empty field name
+    if (memchr(buf + b1 + 1, '=', a2 - b1 - 1)) return -1;
+    if (memchr(buf + a1 + 1, ',', a2 - a1 - 1)) return -1;  // multi-field
+    if (b1 + 1 >= a2) return -1;                     // empty value
+    // strtod is laxer than Python float(): it accepts C99 hex floats
+    // and "nan(...)" forms.  Reject those up front so acceptance never
+    // depends on whether the native library is loaded ("the fast path
+    // is never wrong, only absent").
+    {
+      int64_t v0 = b1 + 1;
+      if (buf[v0] == '+' || buf[v0] == '-') ++v0;
+      if (v0 + 1 < a2 && buf[v0] == '0' &&
+          (buf[v0 + 1] == 'x' || buf[v0 + 1] == 'X'))
+        return -1;
+      if (memchr(buf + b1 + 1, '(', a2 - b1 - 1)) return -1;
+    }
+    char* endp = nullptr;
+    double v = strtod(reinterpret_cast<const char*>(buf) + b1 + 1, &endp);
+    if (endp != reinterpret_cast<const char*>(buf) + a2)
+      return -1;          // int/bool/string field value
+    if (a2 + 1 >= end || end - (a2 + 1) > 19) return -1;
+    unsigned long long t = 0;
+    for (int64_t k = a2 + 1; k < end; ++k) {
+      uint8_t c = buf[k];
+      if (c < '0' || c > '9') return -1;             // sign/garbage ts
+      t = t * 10ULL + (c - '0');
+    }
+    if (t > 9223372036854775807ULL) return -1;
+    starts[nl] = i;
+    sp1[nl] = a1;
+    eq1[nl] = b1;
+    values[nl] = v;
+    ts_ns[nl] = static_cast<long long>(t);
+    ++nl;
+    i = j + 1;
+  }
+  return nl;
 }
 
 }  // extern "C"

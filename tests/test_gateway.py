@@ -231,6 +231,43 @@ class TestShardingPublisher:
                     total += 1
         assert total == n_series
 
+    def test_batch_plan_path_matches_per_line_ingest(self):
+        """Repeat columnar batches take the memoized PLAN path (second
+        batch onward); the decoded records must be identical to per-line
+        ingestion of the same payload — hashes, partkeys, shards,
+        timestamps, values."""
+        def batch(b):
+            lines = []
+            for i in range(60):
+                lines.append(
+                    f"cpu,host=h{i % 7},_ws_=demo,_ns_=App-{i % 3} "
+                    f"value={i * 0.5 + b} {1_700_000_000_000_000_000 + b * 10**9 + i}")
+            return "\n".join(lines)
+
+        def collect(ingest):
+            mapper = ShardMapper(8)
+            got = {}
+            pub = ShardingPublisher(
+                DEFAULT_SCHEMAS["gauge"], mapper,
+                lambda s, c: got.setdefault(s, []).append(c), spread=2)
+            for b in range(3):
+                ingest(pub, batch(b))
+            pub.flush()
+            recs = {}
+            for shard, cs in got.items():
+                for c in cs:
+                    for r in decode_container(c, DEFAULT_SCHEMAS):
+                        recs[(shard, r.partkey(), r.timestamp)] = (
+                            r.shard_hash, r.part_hash, r.values)
+            return recs
+
+        fast = collect(lambda p, t: p.ingest_influx_batch(t))
+        slow = collect(lambda p, t: [p.ingest_influx_line(ln + "\n")
+                                     for ln in t.splitlines()])
+        assert fast.keys() == slow.keys() and fast
+        for k, (sh, ph, vals) in slow.items():
+            assert fast[k] == (sh, ph, vals), k
+
     def test_influx_line_ingest(self):
         mapper = ShardMapper(4)
         factory = QueueStreamFactory()
