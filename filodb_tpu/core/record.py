@@ -209,7 +209,7 @@ class RecordBuilder:
         rec["pklen"] = len(pk)
         if pk:
             rec["pk"] = np.frombuffer(pk, dtype=np.uint8).view(f"V{len(pk)}")
-        self._append_records(rec.tobytes(), rec.dtype.itemsize, n)
+        self.append_encoded(rec.tobytes(), rec.dtype.itemsize, n)
         return n
 
     def _fill_value_cols(self, rec: np.ndarray, columns) -> None:
@@ -219,7 +219,12 @@ class RecordBuilder:
                 if col.ctype == ColumnType.DOUBLE else arr.astype(np.int64) \
                 if col.ctype != ColumnType.INT else arr.astype(np.int32)
 
-    def _append_records(self, blob: bytes, rec_size: int, n: int) -> None:
+    def append_encoded(self, blob: bytes, rec_size: int, n: int) -> None:
+        """Append ``n`` pre-encoded fixed-size wire records (built with
+        :func:`record_dtype`) across container boundaries.  This is the
+        PUBLIC seam for callers that batch-encode records themselves
+        (the gateway's planned ingest) — container framing and size
+        policy stay in this class."""
         per = max((self.container_size - len(self._cur)) // rec_size, 0)
         pos = 0
         while pos < n:

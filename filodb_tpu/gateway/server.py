@@ -247,7 +247,7 @@ class ShardingPublisher:
                         builder = self._builders[shard] = RecordBuilder(
                             self.schema, self.options,
                             self.container_size)
-                    builder._append_records(blob[a0 * isz:b0 * isz],
+                    builder.append_encoded(blob[a0 * isz:b0 * isz],
                                             isz, b0 - a0)
                 n += len(p["rsel"])
             self.samples_in += n

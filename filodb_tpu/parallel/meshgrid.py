@@ -381,22 +381,27 @@ def _compose(plans: Sequence, operator: Agg):
     return q, mode
 
 
-def _assign_devices(plans: Sequence, devices: list) -> list[list]:
+def _assign_devices(plans: Sequence, devices: list,
+                    local: Optional[set] = None) -> list[list]:
     """Group plans by the mesh device their staged arrays live on (the
     residency contract); plans without a recognized pin spread round-
-    robin onto the least-loaded devices (device_put then copies them)."""
+    robin onto the least-loaded devices (device_put then copies them).
+    ``local`` restricts spill targets to THIS process's addressable
+    devices — a plan can never land on a device its process cannot
+    stage to."""
     index = {d: i for i, d in enumerate(devices)}
+    targets = [i for i, d in enumerate(devices)
+               if local is None or d in local]
     by_dev: list[list] = [[] for _ in devices]
     spill = []
     for p in plans:
         i = index.get(p.device) if p.device is not None else None
-        if i is None:
+        if i is None or i not in targets:
             spill.append(p)
         else:
             by_dev[i].append(p)
     for p in spill:
-        by_dev[min(range(len(devices)),
-                   key=lambda d: len(by_dev[d]))].append(p)
+        by_dev[min(targets, key=lambda d: len(by_dev[d]))].append(p)
     return by_dev
 
 
@@ -428,11 +433,50 @@ def serve_grid_mesh(engine, plans: Sequence, num_groups: int,
     mesh = engine.mesh
     devices = list(mesh.devices.flat)
     ndev = len(devices)
-    by_dev = _assign_devices(plans, devices)
+    # multi-host: this process stages pieces ONLY for its addressable
+    # devices; every participating process runs the SAME serve call and
+    # jax assembles the global arrays from per-process shards (the
+    # multi-controller contract of make_array_from_single_device_arrays).
+    # The composition (q/mode/lmax/ksub/groups) must agree across
+    # processes — the coordinator guarantees symmetric shard layouts,
+    # like the reference's shard assignment does for its cluster specs.
+    proc = jax.process_index()
+    multiproc = any(d.process_index != proc for d in devices)
+    if multiproc and op in ("values", "topk", "bottomk"):
+        # count_values reads back a SHARDED stepped matrix (not
+        # addressable across processes) and the k-slot result carries
+        # lane->series references a remote process cannot resolve to
+        # tags — the host-batch path + coordinator wire merge handles
+        # both across nodes
+        STATS["fallbacks"] += 1
+        return None
+    local = {d for d in devices if d.process_index == proc} \
+        if multiproc else None
+    if multiproc and not local:
+        # this process owns none of the mesh's devices: it cannot stage
+        # resident pieces — graceful fallback, not a crash
+        STATS["fallbacks"] += 1
+        return None
+    by_dev = _assign_devices(plans, devices, local)
     ksub = max(1, max(len(lst) for lst in by_dev))
     Kp = ksub * ndev
     lmax = max(-(-max(p.ncols for p in plans) // _LANE_PAD) * _LANE_PAD,
                _LANE_PAD)
+    if multiproc:
+        # fail LOUDLY (not hang) if the composition disagrees across
+        # processes: every process entering the resident path does one
+        # tiny host allgather of its derived shape.  Symmetric shard
+        # layouts (the coordinator's contract) make this a no-op check;
+        # an asymmetric layout otherwise surfaces as a distributed hang
+        # inside XLA with no diagnostic.
+        from jax.experimental import multihost_utils
+        mine = np.array([ksub, lmax, groups_total, nrows], np.int64)
+        allv = np.asarray(multihost_utils.process_allgather(mine))
+        if not (allv == mine[None, :]).all():
+            raise RuntimeError(
+                "serve_grid_mesh: asymmetric multi-host composition "
+                f"(ksub/lmax/groups/nrows per process: {allv.tolist()}) "
+                "— shard layouts must be symmetric across processes")
 
     # op-INDEPENDENT key: the assembled residents serve every aggregator
     # family, so a dashboard switching sum -> topk re-uses the assembly
@@ -454,6 +498,8 @@ def serve_grid_mesh(engine, plans: Sequence, num_groups: int,
         ts_pieces, val_pieces, ph_pieces, s0_pieces, g_pieces = \
             [], [], [], [], []
         for d, dev in enumerate(devices):
+            if multiproc and dev.process_index != proc:
+                continue          # that process stages its own pieces
             ts_k, val_k, ph_k, s0_k, g_k = [], [], [], [], []
             for p in by_dev[d]:
                 ts_d = jax.device_put(p.ts, dev)
