@@ -31,6 +31,12 @@ class StoreConfig:
     # BlockManager equivalent, reference: memory/BlockManager.scala:142)
     device_cache_bytes: int = 2 * 1024 * 1024 * 1024
     grid_step_ms: Optional[int] = None   # bucket width; None = detect
+    # keep grid blocks compressed in HBM (XOR-class value planes +
+    # elided uniform-phase ts planes), decoded on device inside the
+    # serving program; compression is taken per block only when it
+    # saves >=25% (reference: compressed BinaryVectors served in place
+    # from block memory, doc/compression.md)
+    device_cache_compress: bool = True
     # proactive reclaim target: flush tasks trim each device cache to
     # (1-frac) of budget off the query path (reference: BlockManager
     # ensureHeadroomPercentAvailable headroom task)
@@ -56,7 +62,7 @@ class StoreConfig:
             max_buffer_pool_size=int(conf.get("max-buffer-pool-size",
                                               d.max_buffer_pool_size)),
             disk_ttl_seconds=ms("disk-time-to-live", d.disk_ttl_seconds * 1000) // 1000,
-            demand_paging_enabled=bool(conf.get("demand-paging-enabled",
+            demand_paging_enabled=parse_bool(conf.get("demand-paging-enabled",
                                                 d.demand_paging_enabled)),
             max_data_per_shard_query=parse_size(conf.get("max-data-per-shard-query",
                                                          d.max_data_per_shard_query)),
@@ -69,6 +75,9 @@ class StoreConfig:
                                                    d.device_cache_bytes)),
             grid_step_ms=(parse_duration_ms(conf["grid-step"])
                           if "grid-step" in conf else None),
+            device_cache_compress=parse_bool(
+                conf.get("device-cache-compress",
+                         d.device_cache_compress)),
             device_headroom_frac=float(
                 conf.get("device-headroom-frac", d.device_headroom_frac)),
             trace_filters=conf.get("trace-filters"),
@@ -108,6 +117,20 @@ _UNITS_MS = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000, "d": 86_400_000,
              "minute": 60_000, "minutes": 60_000, "hour": 3_600_000,
              "hours": 3_600_000, "day": 86_400_000, "days": 86_400_000,
              "second": 1000, "seconds": 1000}
+
+
+def parse_bool(v) -> bool:
+    """Config booleans arrive as real bools or as strings from config
+    files; bool('false') == True would silently defeat every string-
+    valued kill switch."""
+    if isinstance(v, str):
+        lv = v.strip().lower()
+        if lv in ("true", "yes", "on", "1"):
+            return True
+        if lv in ("false", "no", "off", "0"):
+            return False
+        raise ValueError(f"not a boolean config value: {v!r}")
+    return bool(v)
 
 
 def parse_duration_ms(v) -> int:
