@@ -151,30 +151,39 @@ def main():
         total = N_SERIES * N_ROWS
         log(f"{total} samples persisted; fresh store pages them back")
 
-        # fresh store: index-only partitions, chunks on disk
-        cold = TimeSeriesMemStore(disk, meta)
-        cold.setup("prom", DEFAULT_SCHEMAS, 0, StoreConfig())
-        assert cold.recover_index("prom", 0) == N_SERIES
-        shard = cold.get_shard("prom", 0)
         filters = [ColumnFilter("_metric_", Equals("odp_metric"))]
         steps0 = T0 + WINDOW
         end = T0 + (N_ROWS - 1) * STEP
         sr = StepRange(steps0, end, STEP)
+        import time
+
+        # cold: median over FRESH index-only stores (every rep pages the
+        # whole working set from disk; the shared 1-core host is noisy,
+        # so a single shot under- or over-states by 3-5x)
+        shard = None
+        colds = []
+        for _ in range(5):
+            cold = TimeSeriesMemStore(disk, meta)
+            cold.setup("prom", DEFAULT_SCHEMAS, 0, StoreConfig())
+            assert cold.recover_index("prom", 0) == N_SERIES
+            shard = cold.get_shard("prom", 0)
+            a = time.perf_counter()
+            res = shard.lookup_partitions(filters, 0, 2**62)
+            tags, batch = shard.scan_batch(
+                list(res.part_ids) + res.missing_partkeys, 0, 2**62)
+            colds.append(time.perf_counter() - a)
+            assert len(tags) == N_SERIES
+            assert shard.stats.partitions_paged >= N_SERIES
+        t_cold = float(np.median(colds))
+        emit("ODP cold scan (pages chunks from disk)", total / t_cold,
+             "samples/sec", paged=int(shard.stats.partitions_paged),
+             best=round(total / min(colds)))
 
         def scan():
             res = shard.lookup_partitions(filters, 0, 2**62)
             tags, batch = shard.scan_batch(
                 list(res.part_ids) + res.missing_partkeys, 0, 2**62)
             return tags, batch
-
-        import time
-        a = time.perf_counter()
-        tags, batch = scan()
-        t_cold = time.perf_counter() - a
-        assert len(tags) == N_SERIES
-        assert shard.stats.partitions_paged >= N_SERIES
-        emit("ODP cold scan (pages chunks from disk)", total / t_cold,
-             "samples/sec", paged=int(shard.stats.partitions_paged))
         t_warm = timed(scan)
         emit("ODP warm scan (page cache)", total / t_warm, "samples/sec")
         # full query incl. the windowed kernel, for end-to-end context

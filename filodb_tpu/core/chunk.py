@@ -220,6 +220,10 @@ class ChunkBatch:
     ``timestamps[s, r]`` is padded with TS_PAD and ``values`` with NaN past
     ``row_counts[s]`` so searchsorted/window kernels need no masks beyond the
     value NaN convention.  ``hist`` columns become [S, R, B] matrices.
+
+    Arrays are READ-ONLY by convention: scan paths may hand out views of
+    shared decoded caches (partition read_range output, the fused ODP cold
+    batch), so consumers must never mutate a batch in place.
     """
 
     timestamps: np.ndarray          # [S, R] int64
@@ -238,6 +242,48 @@ class ChunkBatch:
         return self.timestamps.shape[1]
 
 
+def pad_rows(max_rows: int, pad_to: Optional[int]) -> int:
+    """The padded row dimension R for a batch whose longest series has
+    ``max_rows`` rows: rounded up to ``pad_to``, then geometric buckets
+    above it — row counts that grow with live ingest would otherwise
+    mint a fresh XLA compile every pad_to rows; powers of two keep the
+    shape set logarithmic (SURVEY.md §7 ragged-data strategy).  Every
+    batch-building path MUST use this one rule: shape-keyed memos and
+    XLA compile caches assume cold/warm/generic batches of the same
+    data agree on R."""
+    R = max_rows
+    if pad_to:
+        if R <= pad_to:
+            R = pad_to
+        else:
+            R = pad_to * (1 << int(np.ceil(np.log2(R / pad_to))))
+    return max(R, 1)
+
+
+def fill_batch_pads(ts2d: np.ndarray, val2d: np.ndarray,
+                    cnts: np.ndarray, S: int) -> bool:
+    """Write TS_PAD / NaN into every PADDING cell of an [S_pad, R]
+    batch whose data cells are written separately — the shared tail of
+    the flat-assembly paths (the ODP fused decode-into and bulk scan).
+    One copy of the fill/geometry logic keeps every batch-building path
+    agreeing on pad semantics (see :func:`pad_rows`).  Returns True
+    when the first S row counts are uniform — data may then be placed
+    with one reshaped block copy instead of a mask scatter."""
+    S_pad, R = ts2d.shape
+    counts = cnts[:S]
+    r0 = int(counts[0]) if S else 0
+    if S and bool((counts == r0).all()):
+        ts2d[:, r0:] = TS_PAD
+        val2d[:, r0:] = np.nan
+        ts2d[S:, :r0] = TS_PAD
+        val2d[S:, :r0] = np.nan
+        return True
+    padmask = np.arange(R)[None, :] >= cnts[:, None]
+    ts2d[padmask] = TS_PAD
+    val2d[padmask] = np.nan
+    return False
+
+
 def build_batch(series_ts: Sequence[np.ndarray], series_vals: Sequence,
                 pad_to: Optional[int] = None, hist: bool = False,
                 bucket_tops: Optional[np.ndarray] = None,
@@ -246,23 +292,13 @@ def build_batch(series_ts: Sequence[np.ndarray], series_vals: Sequence,
     """Stack ragged per-series arrays into a padded [S, R] batch.
 
     Padding strategy (SURVEY.md §7 "Ragged data"): R = max rows rounded up to
-    ``pad_to`` (a small set of bucket sizes keeps XLA recompiles bounded);
-    timestamps pad with TS_PAD, values with NaN so windowed kernels naturally
-    exclude them.
+    ``pad_to`` via :func:`pad_rows` (a small set of bucket sizes keeps XLA
+    recompiles bounded); timestamps pad with TS_PAD, values with NaN so
+    windowed kernels naturally exclude them.
     """
     S = len(series_ts)
     counts = np.array([len(t) for t in series_ts], dtype=np.int32)
-    R = int(counts.max()) if S else 0
-    if pad_to:
-        if R <= pad_to:
-            R = pad_to
-        else:
-            # geometric buckets above the base pad: row counts that grow
-            # with live ingest would otherwise mint a fresh XLA compile
-            # every pad_to rows; powers of two keep the shape set
-            # logarithmic (SURVEY.md §7 ragged-data strategy)
-            R = pad_to * (1 << int(np.ceil(np.log2(R / pad_to))))
-    R = max(R, 1)
+    R = pad_rows(int(counts.max()) if S else 0, pad_to)
     if pad_series_to:
         S_pad = max(S, pad_series_to)
     else:

@@ -1192,8 +1192,14 @@ class DeviceGridCache:
         dev = self._shard.grid_device      # mesh-pinned; None = default
         # compressed residents (VERDICT r4 #4): drop the ts plane when
         # every lane is uniform-phase (reconstructed on device), and
-        # keep the value plane in XOR-class form when it pays
-        uniform = bool(((pmin == pmax) | (fcnt == 0)).all())
+        # keep the value plane in XOR-class form when it pays.  BOTH
+        # forms honor the device-cache-compress kill switch — the flag
+        # documents itself as covering ts-plane elision too
+        # (storeconfig.py), and an operator reverting a reconstruction
+        # bug must actually get decoded planes back
+        do_compress = compress and self._shard.config.device_cache_compress
+        uniform = do_compress \
+            and bool(((pmin == pmax) | (fcnt == 0)).all())
         nbytes = 0
         ts_desc = None
         if uniform:
@@ -1206,9 +1212,7 @@ class DeviceGridCache:
         else:
             ts_dev = jax.device_put(ts_stage, dev)
             nbytes += ts_stage.nbytes
-        packed = _xor_pack_vals(val_stage) \
-            if compress and self._shard.config.device_cache_compress \
-            else None
+        packed = _xor_pack_vals(val_stage) if do_compress else None
         if packed is not None:
             host_packed, packed_bytes = packed
             vals_dev = {k: jax.device_put(v, dev)

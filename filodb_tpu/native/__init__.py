@@ -96,6 +96,11 @@ def _bind(lib):
         fn.restype = ctypes.c_longlong
         fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
                        ctypes.c_void_p, ctypes.c_void_p]
+    lib.page_decode_column.restype = ctypes.c_longlong
+    lib.page_decode_column.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
+        ctypes.c_longlong, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p]
     lib.influx_parse_batch.restype = ctypes.c_longlong
     lib.influx_parse_batch.argtypes = [
         ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong,
@@ -230,6 +235,71 @@ class _BatchDecodeNative:
     def dbl_decode_batch(self, blobs, counts) -> list[np.ndarray]:
         return self._decode(self._lib.dbl_decode_batch, blobs, counts,
                             np.float64)
+
+    def _frame_buf(self, blobs):
+        nrows = len(blobs)
+        offs = np.zeros(nrows + 1, dtype=np.int64)
+        np.cumsum([len(b) for b in blobs], out=offs[1:])
+        buf = np.frombuffer(b"".join(blobs), dtype=np.uint8) \
+            if offs[-1] else np.empty(0, np.uint8)
+        return buf, offs
+
+    def page_decode(self, blobs, counts, cols):
+        """Decode columns of FRAMED ColumnStore row blobs (pack_vectors
+        layout) — the ODP bulk page-in: one C pass per column over the
+        whole row set, no per-row unpack.  ``cols``: (column_index,
+        is_double) pairs; column 0 is the timestamp vector.  Returns one
+        flat array per requested column (int64 or float64, rows adjacent
+        in blob order), or None if any framing/vector is corrupt (the
+        caller falls back to the per-chunk path, which raises usefully).
+        """
+        nrows = len(blobs)
+        buf, offs = self._frame_buf(blobs)
+        cnts = np.ascontiguousarray(counts, dtype=np.int64)
+        starts = np.zeros(nrows, dtype=np.int64)
+        np.cumsum(cnts[:-1], out=starts[1:])
+        total = int(cnts.sum())
+        outs = []
+        for col, dbl in cols:
+            out = np.empty(max(total, 1),
+                           dtype=np.float64 if dbl else np.int64)
+            got = self._lib.page_decode_column(
+                buf.ctypes.data if len(buf) else None, offs.ctypes.data,
+                nrows, int(col), 1 if dbl else 0, out.ctypes.data,
+                starts.ctypes.data, cnts.ctypes.data)
+            if got < 0:
+                return None
+            outs.append(out[:total])
+        return outs
+
+    def page_decode_into(self, blobs, counts, specs, out_starts) -> bool:
+        """Decode framed row blobs DIRECTLY into caller-allocated
+        arrays: row k writes counts[k] values at flat index
+        out_starts[k] of each spec's output.  ``specs``: (column_index,
+        is_double, out_array) with out_array C-contiguous and of the
+        matching dtype — the ODP cold path points these at the padded
+        [S, R] query batch so decode IS the batch assembly.  False on
+        corrupt input (outputs then hold partial garbage; callers must
+        discard them and fall back)."""
+        nrows = len(blobs)
+        buf, offs = self._frame_buf(blobs)
+        cnts = np.ascontiguousarray(counts, dtype=np.int64)
+        starts = np.ascontiguousarray(out_starts, dtype=np.int64)
+        for col, dbl, out in specs:
+            # raw-pointer writes: a dtype/layout mismatch would corrupt
+            # the heap, so this must raise even under python -O
+            want = np.float64 if dbl else np.int64
+            if not out.flags.c_contiguous or out.dtype != want:
+                raise ValueError(
+                    f"page_decode_into output for column {col} must be "
+                    f"C-contiguous {want.__name__}")
+            got = self._lib.page_decode_column(
+                buf.ctypes.data if len(buf) else None, offs.ctypes.data,
+                nrows, int(col), 1 if dbl else 0, out.ctypes.data,
+                starts.ctypes.data, cnts.ctypes.data)
+            if got < 0:
+                return False
+        return True
 
 
 class _InfluxNative:

@@ -18,6 +18,13 @@ from typing import Iterable, Iterator, Optional, Sequence
 from filodb_tpu.core.chunk import ChunkSet
 
 
+class ScanBytesExceeded(Exception):
+    """A capped raw-row read crossed its byte budget (the ODP bulk
+    page-in streams the cap INSIDE the chunk read instead of paying a
+    separate metadata pre-pass; the caller decides whether the precise
+    range-overlap accounting still permits the query)."""
+
+
 @dataclasses.dataclass
 class PartKeyRecord:
     partkey: bytes
@@ -80,6 +87,16 @@ class ColumnStore:
                             start_time: int, end_time: int
                             ) -> Iterator[tuple[bytes, list[ChunkSet]]]:
         raise NotImplementedError
+
+    def read_raw_rows(self, dataset: str, shard: int,
+                      partkeys: Sequence[bytes], start_time: int,
+                      end_time: int,
+                      byte_cap: int | None = None) -> Optional[list[tuple]]:
+        """Raw FRAMED chunk rows for the ODP bulk page-in (see
+        persistence.DiskColumnStore.read_raw_rows for the row layout and
+        cap contract).  None = unsupported; callers fall back to the
+        per-partition :meth:`read_raw_partitions` path."""
+        return None
 
     def scan_part_keys(self, dataset: str, shard: int) -> Iterator[PartKeyRecord]:
         raise NotImplementedError

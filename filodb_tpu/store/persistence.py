@@ -80,6 +80,11 @@ class _EagerCursor:
         self._pos += 1
         return row
 
+    def fetchmany(self, size: int = 1) -> list:
+        rows = self._rows[self._pos:self._pos + size]
+        self._pos += len(rows)
+        return rows
+
     def __iter__(self):
         while self._pos < len(self._rows):
             row = self._rows[self._pos]
@@ -130,6 +135,7 @@ class _SqliteBase:
         self._local = threading.local()
         self._ddl_done = False
         self._ddl_lock = threading.Lock()
+        self._in_batch_size = None  # resolved from the sqlite var limit
 
     def _conn(self):
         if self.path == ":memory:":
@@ -153,6 +159,9 @@ class _SqliteBase:
             conn = sqlite3.connect(self.path, timeout=30.0)
             conn.execute("PRAGMA journal_mode=WAL")
             conn.execute("PRAGMA synchronous=NORMAL")
+            # blob reads via mmap skip one kernel->user copy (the ODP
+            # bulk page-in pulls megabytes of chunk blobs per query)
+            conn.execute("PRAGMA mmap_size=1073741824")
             self._local.conn = conn
         if not self._ddl_done:  # double-checked: lock only until DDL runs
             with self._ddl_lock:
@@ -264,6 +273,84 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
 
     # ---------------------------------------------------------------- source
 
+    def _in_batch(self, conn) -> int:
+        """Largest usable IN-list size (sqlite's host-variable limit
+        minus the fixed params).  One statement per ~32k keys instead of
+        one per 500 — the ODP bulk page-in reads thousands of partkeys
+        per query and per-statement overhead was measurable."""
+        got = self._in_batch_size
+        if got is None:
+            try:
+                inner = conn._conn if isinstance(conn, _SerializedConn) \
+                    else conn
+                got = max(inner.getlimit(
+                    sqlite3.SQLITE_LIMIT_VARIABLE_NUMBER) - 8, 500)
+            except Exception:
+                got = 500
+            self._in_batch_size = got
+        return got
+
+    def read_raw_rows(self, dataset, shard, partkeys, start_time,
+                      end_time, byte_cap: int | None = None) -> list[tuple]:
+        """Raw chunk rows (partkey, chunk_id, num_rows, start_time,
+        end_time, schema_hash, framed-vectors blob) for a partkey set,
+        ordered by (partkey, chunk_id), with NO blob unpacking — the ODP
+        bulk page-in hands the framed blobs straight to the native
+        page decoder (one C pass for the whole set).
+
+        ``byte_cap``: stream-enforced blob-byte budget; crossing it
+        raises :class:`ScanBytesExceeded` (bounded overshoot of one
+        fetch batch).  Folding the cap into the read replaces the ODP
+        path's separate LENGTH() metadata pre-pass.
+
+        ``partkeys=None`` scans the WHOLE (dataset, shard) in primary
+        key order — no per-key binding or b-tree point lookups.  The ODP
+        path picks this when paging in most of a shard (the cold-
+        dashboard shape); callers skip rows they did not ask for."""
+        from filodb_tpu.store.columnstore import ScanBytesExceeded
+
+        conn = self._conn()
+        rows: list[tuple] = []
+        seen = 0
+        if partkeys is None:
+            batches = [None]
+        else:
+            partkeys = list(partkeys)
+            lim = self._in_batch(conn)
+            batches = [partkeys[i:i + lim]
+                       for i in range(0, len(partkeys), lim)]
+        for batch in batches:
+            if batch is None:
+                cur = conn.execute(
+                    "SELECT partkey, chunk_id, num_rows, start_time, "
+                    "end_time, schema_hash, vectors FROM chunks "
+                    "WHERE dataset=? AND shard=? "
+                    "AND end_time>=? AND start_time<=? "
+                    "ORDER BY partkey, chunk_id",
+                    (dataset, shard, start_time, end_time))
+            else:
+                ph = ",".join("?" * len(batch))
+                cur = conn.execute(
+                    "SELECT partkey, chunk_id, num_rows, start_time, "
+                    "end_time, schema_hash, vectors FROM chunks "
+                    f"WHERE dataset=? AND shard=? AND partkey IN ({ph}) "
+                    "AND end_time>=? AND start_time<=? "
+                    "ORDER BY partkey, chunk_id",
+                    (dataset, shard, *batch, start_time, end_time))
+            if byte_cap is None:
+                rows.extend(cur.fetchall())
+                continue
+            while True:
+                got = cur.fetchmany(512)
+                if not got:
+                    break
+                seen += sum(len(r[6]) for r in got)
+                if seen > byte_cap:
+                    raise ScanBytesExceeded(
+                        f"raw-row read exceeded {byte_cap} bytes")
+                rows.extend(got)
+        return rows
+
     def read_raw_partitions(self, dataset, shard, partkeys, start_time,
                             end_time) -> Iterator[tuple[bytes, list[ChunkSet]]]:
         """Yields (partkey, chunk-ordered chunksets) in the CALLER's key
@@ -273,8 +360,9 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
         conn = self._conn()
         partkeys = list(partkeys)
         by_pk: dict[bytes, list] = {}
-        for i in range(0, len(partkeys), 500):
-            batch = partkeys[i:i + 500]
+        lim = self._in_batch(conn)
+        for i in range(0, len(partkeys), lim):
+            batch = partkeys[i:i + lim]
             ph = ",".join("?" * len(batch))
             for pk, cid, nr, st, et, sh, blob in conn.execute(
                     "SELECT partkey, chunk_id, num_rows, start_time, "
@@ -318,8 +406,9 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
         conn = self._conn()
         partkeys = list(partkeys)
         total = 0
-        for i in range(0, len(partkeys), 500):
-            batch = partkeys[i:i + 500]
+        lim = self._in_batch(conn)
+        for i in range(0, len(partkeys), lim):
+            batch = partkeys[i:i + lim]
             ph = ",".join("?" * len(batch))
             row = conn.execute(
                 "SELECT COALESCE(SUM(LENGTH(vectors)),0) FROM chunks "

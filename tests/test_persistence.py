@@ -592,3 +592,218 @@ class TestOnDemandPaging:
         got = list(disk.chunksets_by_ingestion_time(
             "prom", 0, now - 3_600_000, now + 3_600_000))
         assert len(got) >= 1
+
+
+class TestBulkPageIn:
+    """The vectorized ODP cold path (bulk sqlite read + native framed
+    decode + fused batch assembly, VERDICT r4 missing #4) must be
+    bit-identical to the per-partition generic path in every shape:
+    pure-cold fused, range-trimmed, ragged, multi-chunk, and repeats."""
+
+    def _fresh(self, tmp_path, n_series=24, rows_of=None, name="c"):
+        """Ingest ragged per-series data, flush, and return a FRESH
+        index-only store (pure cold) plus the ground truth."""
+        disk = DiskColumnStore(str(tmp_path / f"{name}.db"))
+        meta = DiskMetaStore(str(tmp_path / f"{name}m.db"))
+        store = TimeSeriesMemStore(disk, meta)
+        cfg = StoreConfig(max_chunks_size=120)   # multi-chunk partitions
+        store.setup("prom", DEFAULT_SCHEMAS, 0, cfg)
+        schema = DEFAULT_SCHEMAS["gauge"]
+        builder = RecordBuilder(schema, container_size=1 << 20)
+        rng = np.random.default_rng(7)
+        truth = {}
+        for s in range(n_series):
+            n = rows_of(s) if rows_of else 150 + 17 * (s % 9)
+            tags = {"__name__": "bulk_metric", "job": "app",
+                    "instance": f"i{s}", "_ws_": "demo", "_ns_": "ns"}
+            ts = BASE + np.cumsum(rng.integers(9_000, 11_000, n))
+            vals = np.cumsum(rng.random(n))
+            truth[f"i{s}"] = (ts.astype(np.int64), vals.copy())
+            for t, v in zip(ts, vals):
+                builder.add(int(t), [float(v)], tags)
+        sh = store.get_shard("prom", 0)
+        for off, c in enumerate(builder.containers()):
+            sh.ingest_container(c, off)
+        sh.flush_all(ingestion_time=1000)
+        cold = TimeSeriesMemStore(disk, meta)
+        cold.setup("prom", DEFAULT_SCHEMAS, 0, cfg)
+        assert cold.recover_index("prom", 0) == n_series
+        return cold.get_shard("prom", 0), truth
+
+    @staticmethod
+    def _scan(shard, start=0, end=2**62):
+        res = shard.lookup_partitions(
+            [ColumnFilter("_metric_", Equals("bulk_metric"))], 0, 2**62)
+        ids = list(res.part_ids) + res.missing_partkeys
+        return shard.scan_batch(ids, start, end)
+
+    @staticmethod
+    def _rows_by_inst(tags, batch):
+        out = {}
+        for i, t in enumerate(tags):
+            c = int(batch.row_counts[i])
+            out[t["instance"]] = (
+                np.asarray(batch.timestamps[i][:c]),
+                np.asarray(batch.values[i][:c]))
+        return out
+
+    def _compare(self, tmp_path, start=0, end=2**62, rows_of=None):
+        from filodb_tpu import native
+        shard, truth = self._fresh(tmp_path, rows_of=rows_of, name="a")
+        tags, batch = self._scan(shard, start, end)
+        got = self._rows_by_inst(tags, batch)
+        # generic oracle: same data, native batch decoder disabled
+        shard2, _ = self._fresh(tmp_path, rows_of=rows_of, name="b")
+        saved = native._batch_dec
+        native._batch_dec = None
+        try:
+            tags2, batch2 = self._scan(shard2, start, end)
+        finally:
+            native._batch_dec = saved
+        want = self._rows_by_inst(tags2, batch2)
+        assert set(got) == set(want) == set(truth)
+        for inst in want:
+            np.testing.assert_array_equal(got[inst][0], want[inst][0])
+            np.testing.assert_array_equal(got[inst][1], want[inst][1])
+        return shard, got, truth
+
+    def test_pure_cold_fused_matches_generic(self, tmp_path):
+        shard, got, truth = self._compare(tmp_path)
+        assert shard.stats.partitions_paged == len(truth)
+        for inst, (ts, vals) in truth.items():
+            np.testing.assert_array_equal(got[inst][0], ts)
+            np.testing.assert_allclose(got[inst][1], vals)
+
+    def test_range_trimmed_cold_matches_generic(self, tmp_path):
+        # a window strictly inside the data defeats the fused path and
+        # exercises the vectorized global-mask trim
+        start = BASE + 400_000
+        end = BASE + 1_300_000
+        shard, got, truth = self._compare(tmp_path, start, end)
+        for inst, (ts, vals) in truth.items():
+            m = (ts >= start) & (ts <= end)
+            np.testing.assert_array_equal(got[inst][0], ts[m])
+            np.testing.assert_allclose(got[inst][1], vals[m])
+
+    def test_uniform_rows_fused(self, tmp_path):
+        # equal row counts take the reshape/no-mask branch
+        shard, got, truth = self._compare(tmp_path, rows_of=lambda s: 200)
+        for inst, (ts, vals) in truth.items():
+            np.testing.assert_array_equal(got[inst][0], ts)
+
+    def test_warm_repeat_serves_from_cache(self, tmp_path):
+        shard, truth = self._fresh(tmp_path)
+        t1, b1 = self._scan(shard)
+        paged = shard.stats.partitions_paged
+        t2, b2 = self._scan(shard)
+        assert shard.stats.partitions_paged == paged   # no re-page
+        r1, r2 = self._rows_by_inst(t1, b1), self._rows_by_inst(t2, b2)
+        for inst in r1:
+            np.testing.assert_array_equal(r1[inst][0], r2[inst][0])
+            np.testing.assert_array_equal(r1[inst][1], r2[inst][1])
+
+    def test_duplicate_ids_fall_back_consistently(self, tmp_path):
+        shard, truth = self._fresh(tmp_path)
+        res = shard.lookup_partitions(
+            [ColumnFilter("_metric_", Equals("bulk_metric"))], 0, 2**62)
+        ids = list(res.part_ids)
+        dup = ids + ids[:3]
+        tags, batch = shard.scan_batch(dup, 0, 2**62)
+        assert len(tags) == len(dup)
+        # the duplicated series' rows must appear twice, identically
+        first = {t["instance"]: i for i, t in enumerate(tags[:len(ids)])}
+        for k, t in enumerate(tags[len(ids):]):
+            i = first[t["instance"]]
+            np.testing.assert_array_equal(
+                np.asarray(batch.timestamps[len(ids) + k]),
+                np.asarray(batch.timestamps[i]))
+
+    def test_page_decode_matches_unpack(self, tmp_path):
+        """Native framed-row decode == Python unpack + per-chunk decode."""
+        from filodb_tpu import native
+        from filodb_tpu.core.chunk import decode_chunkset
+        nb = native.batch_decoder()
+        if nb is None:
+            pytest.skip("native disabled")
+        schema = DEFAULT_SCHEMAS["gauge"]
+        rng = np.random.default_rng(3)
+        blobs, counts, want_ts, want_v = [], [], [], []
+        for s in range(17):
+            n = 30 + 11 * s
+            ts = BASE + np.cumsum(rng.integers(1_000, 2_000, n))
+            vals = np.cumsum(rng.random(n))
+            cs = encode_chunkset(schema, b"pk%d" % s,
+                                 ts.astype(np.int64), [vals])
+            blobs.append(pack_vectors(cs.vectors))
+            counts.append(n)
+            dts, dcols = decode_chunkset(schema, cs)
+            want_ts.append(dts)
+            want_v.append(dcols[0])
+        flats = nb.page_decode(blobs, counts, [(0, False), (1, True)])
+        assert flats is not None
+        np.testing.assert_array_equal(flats[0], np.concatenate(want_ts))
+        np.testing.assert_array_equal(flats[1], np.concatenate(want_v))
+        # placed decode into a padded [S, R] matrix
+        R = max(counts) + 5
+        ts2d = np.empty((len(blobs), R), dtype=np.int64)
+        v2d = np.empty((len(blobs), R), dtype=np.float64)
+        starts = np.arange(len(blobs), dtype=np.int64) * R
+        ok = nb.page_decode_into(blobs, counts,
+                                 [(0, False, ts2d), (1, True, v2d)], starts)
+        assert ok
+        for i, n in enumerate(counts):
+            np.testing.assert_array_equal(ts2d[i, :n], want_ts[i])
+            np.testing.assert_array_equal(v2d[i, :n], want_v[i])
+
+    def test_corrupt_framing_falls_back(self, tmp_path):
+        from filodb_tpu import native
+        nb = native.batch_decoder()
+        if nb is None:
+            pytest.skip("native disabled")
+        assert nb.page_decode([b"\x01"], [10], [(0, False)]) is None
+        out = np.empty((1, 16), dtype=np.int64)
+        assert not nb.page_decode_into(
+            [b"\xff\xff"], [10], [(0, False, out)],
+            np.zeros(1, dtype=np.int64))
+
+    def test_full_scan_ignores_unselected_schema_rows(self, tmp_path):
+        """The full-shard range scan over-returns rows of partitions the
+        query never asked for; a foreign-schema row that sorts FIRST
+        must not disable the bulk path (its schema hash is not the
+        reference hash — regression for h0-from-rows[0])."""
+        disk = DiskColumnStore(str(tmp_path / "f.db"))
+        meta = DiskMetaStore(str(tmp_path / "fm.db"))
+        store = TimeSeriesMemStore(disk, meta)
+        cfg = StoreConfig()
+        store.setup("prom", DEFAULT_SCHEMAS, 0, cfg)
+        schema = DEFAULT_SCHEMAS["gauge"]
+        builder = RecordBuilder(schema, container_size=1 << 20)
+        rng = np.random.default_rng(11)
+        n_series, n = 300, 40    # >256 so the full-scan heuristic fires
+        for s in range(n_series):
+            ts = BASE + np.cumsum(rng.integers(9_000, 11_000, n))
+            for t, v in zip(ts, np.cumsum(rng.random(n))):
+                builder.add(int(t), [float(v)],
+                            {"__name__": "fs_metric", "job": "app",
+                             "instance": f"i{s}", "_ws_": "demo",
+                             "_ns_": "ns"})
+        sh = store.get_shard("prom", 0)
+        for off, c in enumerate(builder.containers()):
+            sh.ingest_container(c, off)
+        sh.flush_all(ingestion_time=1000)
+        # foreign-schema chunk whose partkey sorts before every real one
+        cs, _, _ = _mk_chunkset(pk=b"\x00\x00early", n=10)
+        cs.schema_hash = 0xBEEF
+        disk.write_chunks("prom", 0, [cs], ingestion_time=1000)
+        cold = TimeSeriesMemStore(disk, meta)
+        cold.setup("prom", DEFAULT_SCHEMAS, 0, cfg)
+        assert cold.recover_index("prom", 0) == n_series
+        shard = cold.get_shard("prom", 0)
+        res = shard.lookup_partitions(
+            [ColumnFilter("_metric_", Equals("fs_metric"))], 0, 2**62)
+        tags, batch = shard.scan_batch(list(res.part_ids), 0, 2**62)
+        assert len(tags) == n_series
+        # the bulk path served it (not the per-partition fallback)
+        assert shard.stats.partitions_paged == n_series
+        assert not np.isnan(
+            np.asarray(batch.values[0][:int(batch.row_counts[0])])).any()
