@@ -60,7 +60,12 @@ def grid_stage_main():
         store = TimeSeriesMemStore(disk, meta)
         cfg = StoreConfig(grid_step_ms=step, max_chunks_size=n_rows,
                           max_data_per_shard_query=1 << 30,
-                          device_cache_bytes=2 << 30)
+                          device_cache_bytes=2 << 30,
+                          # the 102400-series x 300-row working set is
+                          # ~600 MB with decoded planes accounted; the
+                          # grid can only build from paged history that
+                          # is still IN the page cache
+                          page_cache_bytes=2 << 30)
         sh = store.setup("prom", DEFAULT_SCHEMAS, 0, cfg)
         b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], DatasetOptions(),
                           container_size=8 << 20)

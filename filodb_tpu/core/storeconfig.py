@@ -30,6 +30,12 @@ class StoreConfig:
     # device-resident chunk store (HBM arena, reclaim-on-demand — the
     # BlockManager equivalent, reference: memory/BlockManager.scala:142)
     device_cache_bytes: int = 2 * 1024 * 1024 * 1024
+    # host page cache for demand-paged partitions (decoded bytes are
+    # accounted too); must cover the cold-dashboard working set or the
+    # device grid cannot build from paged history (reference: ODP pages
+    # into block memory whose size is config-driven,
+    # DemandPagedChunkStore.scala:34 + num-block-pages)
+    page_cache_bytes: int = 256 * 1024 * 1024
     grid_step_ms: Optional[int] = None   # bucket width; None = detect
     # keep grid blocks compressed in HBM (XOR-class value planes +
     # elided uniform-phase ts planes), decoded on device inside the
@@ -73,6 +79,8 @@ class StoreConfig:
             batch_series_pad=int(conf.get("batch-series-pad", d.batch_series_pad)),
             device_cache_bytes=parse_size(conf.get("device-cache-size",
                                                    d.device_cache_bytes)),
+            page_cache_bytes=parse_size(conf.get("page-cache-size",
+                                                 d.page_cache_bytes)),
             grid_step_ms=(parse_duration_ms(conf["grid-step"])
                           if "grid-step" in conf else None),
             device_cache_compress=parse_bool(

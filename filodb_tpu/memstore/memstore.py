@@ -157,6 +157,11 @@ class TimeSeriesMemStore:
             # instead of creating a duplicate index entry
             shard.part_set[rec.partkey] = pid
             n += 1
+        # bootstrap completes the index BEFORE the shard serves (reference:
+        # IndexBootstrapper.scala:12 refreshes the Lucene reader after the
+        # bulk add) — without this the first lookup pays the whole deferred
+        # label backlog inside its own latency
+        shard.index.apply_pending()
         return n
 
     # ------------------------------------------------------------------ query

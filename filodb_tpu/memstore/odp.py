@@ -19,6 +19,7 @@ capDataScannedPerShardCheck).
 from __future__ import annotations
 
 import dataclasses
+import logging
 import struct
 import threading
 import time
@@ -38,6 +39,8 @@ from filodb_tpu.memstore.shard import (PartLookupResult, TimeSeriesShard,
 from filodb_tpu.store.columnstore import PartKeyRecord, ScanBytesExceeded
 
 _MAX_TIME = 2**62
+
+_LOG = logging.getLogger("filodb.odp")
 _EMPTY_I64 = np.empty(0, dtype=np.int64)
 _U16 = struct.Struct("<H")
 
@@ -104,6 +107,18 @@ class _PagedPartitions:
         self._entries: OrderedDict = OrderedDict()   # key -> (value, nbytes)
         self._bytes = 0
         self._lock = threading.Lock()
+        # invalidation generations: pop() stamps the key with a bumped
+        # gen under the lock, and a deferred put_many carrying gen_guard
+        # drops exactly the items whose key was popped SINCE the guard
+        # was captured — so an evict's pop and a late publish's insert
+        # are safe in EITHER order (pop-then-insert would otherwise
+        # resurrect a stale partition missing chunks flushed at
+        # eviction), while unrelated evictions don't cancel a
+        # cold-dashboard publish wholesale.  _pop_floor bounds the stamp
+        # map: below it, guarded puts drop everything (rare overflow)
+        self.gen = 0
+        self._pop_gen: dict = {}
+        self._pop_floor = 0
         # called AFTER put releases the lock when LRU pressure dropped an
         # entry (deadlock-safe; implementations must not assume mutual
         # exclusion with concurrent put/get) — the ODP shard bumps its
@@ -150,10 +165,22 @@ class _PagedPartitions:
                 if k in self._entries:
                     move(k)
 
-    def put_many(self, items: Sequence[tuple]) -> None:
+    def put_many(self, items: Sequence[tuple],
+                 gen_guard: Optional[int] = None) -> None:
         """Batch put of (key, value, nbytes): ONE lock acquisition for a
-        bulk page-in (thousands of partitions per cold dashboard)."""
+        bulk page-in (thousands of partitions per cold dashboard).  With
+        ``gen_guard``, items whose key was pop()ed since the guard was
+        captured are dropped (deferred publishes must not resurrect
+        explicitly-invalidated partitions; the rest of the batch still
+        lands)."""
         with self._lock:
+            if gen_guard is not None:
+                if gen_guard < self._pop_floor:
+                    return          # stamp map overflowed: conservative
+                pg = self._pop_gen
+                if pg:
+                    items = [it for it in items
+                             if pg.get(it[0], 0) <= gen_guard]
             for key, value, nbytes in items:
                 old = self._entries.pop(key, None)
                 if old is not None:
@@ -170,6 +197,11 @@ class _PagedPartitions:
 
     def pop(self, key) -> None:
         with self._lock:
+            self.gen += 1
+            self._pop_gen[key] = self.gen   # cancels in-flight publish
+            if len(self._pop_gen) > 65536:  # bound the stamp map
+                self._pop_floor = self.gen
+                self._pop_gen.clear()
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old[1]
@@ -183,9 +215,11 @@ class _PagedPartitions:
 class OnDemandPagingShard(TimeSeriesShard):
     """TimeSeriesShard that pages missing partitions from the ColumnStore."""
 
-    def __init__(self, *args, page_cache_bytes: int = 256 * 1024 * 1024,
+    def __init__(self, *args, page_cache_bytes: Optional[int] = None,
                  **kwargs):
         super().__init__(*args, **kwargs)
+        if page_cache_bytes is None:
+            page_cache_bytes = self.config.page_cache_bytes
         self.paged = _PagedPartitions(page_cache_bytes,
                                       on_evict=self._on_page_evict)
         # serializes page-in / backfill store reads across query threads so
@@ -194,8 +228,41 @@ class OnDemandPagingShard(TimeSeriesShard):
         # partitions pinned by an in-flight scan on THIS thread: strong
         # references so mid-query LRU eviction cannot drop them from results
         self._pinned = threading.local()
+        # in-flight deferred page-cache publishes (fused cold scans hand
+        # the query its batch first and materialize skeletons for the
+        # cache on this side thread — reference:
+        # DemandPagedChunkStore.scala:34 pages into block memory via
+        # futures too); queries that MISS the cache join these first so
+        # a publish-in-progress never causes a redundant re-page
+        self._mat_tasks: list[threading.Thread] = []
         self.stats.partitions_paged = 0
         self.stats.chunks_paged = 0
+        self.stats.page_publish_errors = 0
+
+    def _join_materialize(self) -> None:
+        # peek-join-remove (NOT pop-then-join): a task must stay visible
+        # to concurrent threads until its publish has actually landed,
+        # or a third thread could classify a miss mid-publish and
+        # duplicate the whole store read
+        while True:
+            try:
+                t = self._mat_tasks[-1]
+            except IndexError:
+                return
+            t.join()
+            try:
+                self._mat_tasks.remove(t)
+            except ValueError:
+                pass       # another joiner removed it after its join
+
+    def _paged_or_join(self, part_id: int) -> Optional[TimeSeriesPartition]:
+        """Page-cache read that joins an in-flight deferred publish on a
+        miss (shared by every per-pid resolution path)."""
+        part = self.paged.get(part_id)
+        if part is None and self._mat_tasks:
+            self._join_materialize()
+            part = self.paged.get(part_id)
+        return part
 
     def _on_page_evict(self) -> None:
         # called after the page-cache lock is released; concurrent evictions
@@ -214,7 +281,7 @@ class OnDemandPagingShard(TimeSeriesShard):
                 return part
         part = self.partitions.get(part_id)
         if part is None:
-            part = self.paged.get(part_id)
+            part = self._paged_or_join(part_id)
         return part
 
     def grid_partition(self, part_id: int) -> Optional[TimeSeriesPartition]:
@@ -228,7 +295,7 @@ class OnDemandPagingShard(TimeSeriesShard):
         the evicted partition."""
         part = self.partitions.get(part_id)
         if part is None:
-            part = self.paged.get(part_id)
+            part = self._paged_or_join(part_id)
         return part
 
     def _resolve_partitions(self, part_ids: Sequence[int], start_time: int,
@@ -337,6 +404,11 @@ class OnDemandPagingShard(TimeSeriesShard):
         if nb is None:
             return None
         with self._odp_lock:
+            # a publish deferred by the PREVIOUS lock holder must land
+            # before this query classifies hits/misses, or it would
+            # re-read the whole set from the store (publishes don't take
+            # _odp_lock, so joining under it cannot deadlock)
+            self._join_materialize()
             built: dict[int, TimeSeriesPartition] = {}
             by_pk: dict[bytes, int] = {}
             for pid in part_ids:
@@ -486,10 +558,47 @@ class OnDemandPagingShard(TimeSeriesShard):
                             e += 1
                     return ts2d[x, lo:hi], colviews
 
-                self._materialize_paged(sel, groups, schema,
-                                        dec_row_bytes, idx_of, views,
-                                        built)
-                tags_list = [built[pid].tags for pid in order]
+                # the triggering query needs only tags + the decoded
+                # batch; skeleton construction + LRU publish (the other
+                # ~40% of the cold budget) runs on a side thread.  Stats
+                # count NOW so callers see the page-in they just caused.
+                tags_of = self.index.tags
+                tags_list: list = [None] * len(order)
+                for pid, si, _sj, _c in groups:
+                    try:
+                        tags = tags_of(pid)
+                    except KeyError:
+                        tags = parse_partkey(sel[si][0])
+                    tags_list[idx_of[pid]] = tags
+                self.stats.partitions_paged += len(groups)
+                self.stats.chunks_paged += len(sel)
+                # pop()s since this point cancel the publish (gen_guard)
+                gen0 = self.paged.gen
+
+                def publish():
+                    # lock-free: everything touched (page-cache, index
+                    # tag reads) locks internally, so joiners holding
+                    # _odp_lock cannot deadlock on this thread
+                    try:
+                        self._materialize_paged(sel, groups, schema,
+                                                dec_row_bytes, idx_of,
+                                                views, {},
+                                                count_stats=False,
+                                                gen_guard=gen0,
+                                                tags_by_x=tags_list)
+                    except Exception:
+                        # the triggering query already succeeded; a
+                        # failed publish only loses cache warmth — but
+                        # must be visible, not silent
+                        self.stats.page_publish_errors += 1
+                        _LOG.exception("deferred page-cache publish "
+                                       "failed (shard %s)",
+                                       self.shard_num)
+
+                t = threading.Thread(target=publish, name="odp-publish",
+                                     daemon=True)
+                t.start()   # started BEFORE it is joinable via the list
+                self._mat_tasks.append(t)
                 return built, tags_list, ChunkBatch(ts2d, val2d, cnts)
             # ---- flat decode: fills decoded caches only
             cols = [(0, False)] + [
@@ -510,23 +619,35 @@ class OnDemandPagingShard(TimeSeriesShard):
             return built, None, None
 
     def _materialize_paged(self, sel, groups, schema, dec_row_bytes,
-                           idx_of, views, built) -> None:
+                           idx_of, views, built,
+                           count_stats: bool = True,
+                           gen_guard: Optional[int] = None,
+                           tags_by_x: Optional[list] = None) -> None:
         """Shared construction tail of the bulk page-in (ONE copy for
         the fused and flat branches): read-only partition skeletons,
         lazily-framed PagedChunkSets, decoded caches filled from the
         ``views(k, series_index, run, nr)`` callback, LRU publish and
-        stats.  Caller holds ``_odp_lock`` and strong refs in ``built``
-        (LRU pressure here may evict entries from the cache but never
-        from the in-flight query)."""
+        stats.  Runs either under ``_odp_lock`` (flat branch) or on the
+        deferred publish thread WITHOUT it (every structure it touches
+        locks internally); strong refs stay in ``built`` (LRU pressure
+        here may evict entries from the cache but never from the
+        in-flight query)."""
         tags_of = self.index.tags
         items = []
         for pid, si, sj, _c in groups:
             pk = sel[si][0]
             x = idx_of[pid] if idx_of is not None else 0
-            try:
-                tags = tags_of(pid)
-            except KeyError:
-                tags = parse_partkey(pk)
+            if tags_by_x is not None:
+                # the fused branch already resolved these for the query
+                # response; reuse them so the cached partition and the
+                # response can never diverge (and the publish thread
+                # skips a second full index pass)
+                tags = tags_by_x[x]
+            else:
+                try:
+                    tags = tags_of(pid)
+                except KeyError:
+                    tags = parse_partkey(pk)
             # write buffers are lazy, so the plain constructor costs
             # only the attribute sets — no skeleton shortcut needed
             part = TimeSeriesPartition(pid, schema, pk, tags,
@@ -551,9 +672,10 @@ class OnDemandPagingShard(TimeSeriesShard):
             part._decoded = decoded
             items.append((pid, part, nbytes))
             built[pid] = part
-        self.paged.put_many(items)
-        self.stats.partitions_paged += len(items)
-        self.stats.chunks_paged += len(sel)
+        self.paged.put_many(items, gen_guard=gen_guard)
+        if count_stats:
+            self.stats.partitions_paged += len(items)
+            self.stats.chunks_paged += len(sel)
 
     def _page_in(self, part_ids: list[int],
                  resident: dict[int, TimeSeriesPartition]) -> None:
@@ -564,6 +686,7 @@ class OnDemandPagingShard(TimeSeriesShard):
             resident.update(got[0])
             return
         with self._odp_lock:
+            self._join_materialize()   # see _page_in_bulk
             by_pk = {}
             for pid in part_ids:
                 # another query thread may have paged it in while this one

@@ -280,6 +280,83 @@ class TestOnDemandPaging:
         shard.scan_batch(res.part_ids, 0, 2**62)
         assert shard.stats.partitions_paged == paged_once  # cache hit
 
+    def test_deferred_publish_lands_in_page_cache(self, tmp_path):
+        """The fused cold scan returns its batch BEFORE partition
+        skeletons publish to the page cache (side thread); the very next
+        query must join that publish and hit the cache — never re-page
+        (reference: DemandPagedChunkStore pages via futures, but a
+        paged-in chunk is immediately servable)."""
+        disk, shard, truth = self._setup(tmp_path)
+        shard.evict_partitions(len(truth))
+        res = shard.lookup_partitions(
+            [ColumnFilter("_metric_", Equals("heap_usage"))], 0, 2**62)
+        ids = list(res.part_ids) + res.missing_partkeys
+        tags_list, _ = shard.scan_batch(ids, 0, 2**62)
+        assert len(tags_list) == len(truth)
+        # stats count eagerly, with the triggering query
+        assert shard.stats.partitions_paged == len(truth)
+        shard.scan_batch(ids, 0, 2**62)
+        assert shard.stats.partitions_paged == len(truth)  # cache hit
+        assert len(shard.paged) == len(truth)              # published
+
+    def test_pop_cancels_deferred_publish(self, tmp_path):
+        """pop() and a gen-guarded put_many are safe in EITHER order: an
+        evict's invalidation must never be overwritten by a deferred
+        publish built from a pre-eviction disk read."""
+        from filodb_tpu.memstore.odp import _PagedPartitions
+        cache = _PagedPartitions(1 << 20)
+        g = cache.gen
+        cache.pop(1)                 # invalidation after guard capture
+        cache.put_many([(1, "x", 10), (2, "z", 10)], gen_guard=g)
+        assert cache.get(1) is None  # dropped: stale snapshot of 1 ...
+        assert cache.get(2) == "z"   # ... but unrelated keys still land
+        cache.put_many([(1, "y", 10)], gen_guard=cache.gen)
+        assert cache.get(1) == "y"   # fresh guard: lands
+        # pre-capture pops don't cancel
+        cache.pop(3)
+        g2 = cache.gen
+        cache.put_many([(3, "w", 10)], gen_guard=g2)
+        assert cache.get(3) == "w"
+
+    def test_failed_publish_is_counted_not_silent(self, tmp_path,
+                                                  monkeypatch):
+        from filodb_tpu import native
+        if native.batch_decoder() is None:
+            pytest.skip("native disabled")   # publish exists only fused
+        disk, shard, truth = self._setup(tmp_path)
+        shard.evict_partitions(len(truth))
+        monkeypatch.setattr(
+            shard, "_materialize_paged",
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
+        res = shard.lookup_partitions(
+            [ColumnFilter("_metric_", Equals("heap_usage"))], 0, 2**62)
+        ids = list(res.part_ids) + res.missing_partkeys
+        tags_list, _ = shard.scan_batch(ids, 0, 2**62)
+        assert len(tags_list) == len(truth)   # the query itself succeeds
+        shard._join_materialize()
+        assert shard.stats.page_publish_errors == 1
+
+    def test_page_cache_bytes_config(self, tmp_path):
+        disk, shard, truth = self._setup(tmp_path,
+                                         page_cache_bytes=7 << 20)
+        assert shard.paged.max_bytes == 7 << 20
+
+    def test_undersized_page_cache_still_scans(self, tmp_path):
+        """A page cache too small for the working set must still serve
+        scans correctly (the triggering query holds its own refs); only
+        cache reuse is lost."""
+        disk, shard, truth = self._setup(tmp_path, page_cache_bytes=1)
+        shard.evict_partitions(len(truth))
+        res = shard.lookup_partitions(
+            [ColumnFilter("_metric_", Equals("heap_usage"))], 0, 2**62)
+        ids = list(res.part_ids) + res.missing_partkeys
+        tags_list, batch = shard.scan_batch(ids, 0, 2**62)
+        by_inst = {t["instance"]: i for i, t in enumerate(tags_list)}
+        for inst, (ts, vals) in truth.items():
+            i = by_inst[inst]
+            np.testing.assert_array_equal(
+                np.asarray(batch.timestamps)[i][:len(ts)], ts)
+
     def test_reingest_after_evict_reuses_part_id(self, tmp_path):
         disk, shard, truth = self._setup(tmp_path)
         before = {t: pid for pid, t in
