@@ -366,9 +366,24 @@ def range_index(lo, lens):
 def _resolve_heads(a, data, starts, sp1, eq1, values, ts_ms, batch_memo):
     """Shared tail of the columnar parse: steady-state memo check, field
     names, and the verified head dedup over already-located line spans
-    (fed by either the native C scan or the numpy scan)."""
+    (fed by either the native C scan or the numpy scan).  The byte
+    gathers / positional hashes / representative verify each take one C
+    pass when the native library is loaded (gather_ranges /
+    head_hash128 / verify_heads); the numpy formulations below are the
+    bit-identical fallback."""
     import numpy as np
+    from filodb_tpu import native as _native_mod
+    npr = _native_mod.influx_parser()
     N = len(starts)
+
+    def _gather(lo, hi):
+        if npr is not None:
+            got = npr.gather(a, lo, hi)
+            if got is not None:
+                return got
+        idx, _ = range_index(lo, hi - lo)
+        return a[idx]
+
     # steady-state memo: ONE byte-compare of the concatenated
     # [head, field-name] regions (everything before each line's '=')
     # short-circuits head dedup AND field-name resolution — the scrape
@@ -378,33 +393,40 @@ def _resolve_heads(a, data, starts, sp1, eq1, values, ts_ms, batch_memo):
     if batch_memo is not None:
         prev = batch_memo.get("line_sig")
         if prev is not None and np.array_equal(prev[1], slen):
-            sidx, _ = range_index(starts, slen)
-            sb8 = a[sidx]
-            if len(prev[0]) == len(sb8) and bytes(sb8) == prev[0]:
+            sb8 = _gather(starts, eq1)
+            if np.array_equal(sb8, prev[0]):
                 heads, inverse, ufn, finv = prev[2:]
                 return (heads, inverse, ufn, finv, values, ts_ms)
     # field names: include each line's '=' as the separator
-    idx, _ = range_index(sp1 + 1, eq1 + 1 - (sp1 + 1))
-    fn_tokens = bytes(a[idx]).split(b"=")[:-1]
+    fn_tokens = bytes(_gather(sp1 + 1, eq1 + 1)).split(b"=")[:-1]
     if len(fn_tokens) != N:
         return None
     ufn_b, finv = np.unique(np.array(fn_tokens), return_inverse=True)
     ufn = [f.decode("utf-8") for f in ufn_b]
 
-    # head dedup: 128-bit positional hash per line, reduceat-summed;
-    # the two 64-bit streams ride a complex128 through np.unique (the
-    # float conversion keeps ~52 bits per stream — ample dedup entropy)
+    # head dedup: 128-bit positional hash per line; the two 64-bit
+    # streams ride a complex128 through np.unique (the float conversion
+    # keeps ~52 bits per stream — ample dedup entropy)
     hlen = sp1 - starts
-    if int(hlen.max()) >= len(_hash_pows()[0]):
-        return None
-    hidx, hoffs = range_index(starts, hlen)
-    hb8 = a[hidx]
-    rel = np.arange(len(hidx), dtype=np.int64) - np.repeat(hoffs, hlen)
-    hb = hb8.astype(np.uint64)
     p1, p2 = _hash_pows()
-    with np.errstate(over="ignore"):
-        h1 = np.add.reduceat(hb * p1[rel], hoffs)
-        h2 = np.add.reduceat(hb * p2[rel], hoffs) ^ hlen.astype(np.uint64)
+    if int(hlen.max()) >= len(p1):
+        return None
+    np_head = None          # (hb8, rel) cached for the numpy fallbacks
+    got = npr.head_hashes(a, starts, sp1, p1, p2) if npr is not None \
+        else None
+    if got is not None:
+        h1, h2 = got
+    else:
+        hidx, hoffs = range_index(starts, hlen)
+        hb8 = a[hidx]
+        rel = np.arange(len(hidx), dtype=np.int64) - np.repeat(hoffs,
+                                                               hlen)
+        np_head = (hb8, rel)
+        hb = hb8.astype(np.uint64)
+        with np.errstate(over="ignore"):
+            h1 = np.add.reduceat(hb * p1[rel], hoffs)
+            h2 = np.add.reduceat(hb * p2[rel], hoffs) \
+                ^ hlen.astype(np.uint64)
     hkey = h1.astype(np.float64) + 1j * h2.astype(np.float64)
     _, first_idx, inverse = np.unique(hkey, return_index=True,
                                       return_inverse=True)
@@ -412,20 +434,27 @@ def _resolve_heads(a, data, starts, sp1, eq1, values, ts_ms, batch_memo):
     # hash-collision guard: the complex128 key keeps ~52 usable bits per
     # stream, so verify every line's head BYTES against its group
     # representative — a collision must fall back to the per-line parser,
-    # never silently merge two series (round-4 ADVICE).  Vectorized via a
-    # zero-padded [N, max_head_len] byte matrix; cost is one extra pass
-    # over the head bytes.
+    # never silently merge two series (round-4 ADVICE).
     rep = first_idx[inverse]
-    maxh = int(hlen.max())
-    hm = np.zeros((N, maxh), np.uint8)
-    hm[np.repeat(np.arange(N, dtype=np.int64), hlen), rel] = hb8
-    if (hlen != hlen[rep]).any() or (hm != hm[rep]).any():
+    okv = npr.verify(a, starts, sp1, rep) if npr is not None else None
+    if okv is None:
+        maxh = int(hlen.max())
+        if np_head is not None:
+            hb8, rel = np_head
+        else:
+            hidx, hoffs = range_index(starts, hlen)
+            hb8 = a[hidx]
+            rel = np.arange(len(hidx), dtype=np.int64) \
+                - np.repeat(hoffs, hlen)
+        hm = np.zeros((N, maxh), np.uint8)
+        hm[np.repeat(np.arange(N, dtype=np.int64), hlen), rel] = hb8
+        okv = not ((hlen != hlen[rep]).any() or (hm != hm[rep]).any())
+    if not okv:
         return None
     heads = [data[starts[i]:sp1[i]].decode("utf-8") for i in first_idx]
     if batch_memo is not None:
-        sidx, _ = range_index(starts, slen)
-        batch_memo["line_sig"] = (bytes(a[sidx]), slen.copy(), heads,
-                                  inverse, ufn, finv)
+        batch_memo["line_sig"] = (_gather(starts, eq1), slen.copy(),
+                                  heads, inverse, ufn, finv)
     return (heads, inverse, ufn, finv, values, ts_ms)
 
 

@@ -985,4 +985,62 @@ long long influx_parse_batch(const uint8_t* buf, int64_t n,
   return nl;
 }
 
+// Concatenate per-line [starts[k], ends[k]) byte ranges into `out`
+// (caller sizes it as sum(ends-starts)).  Replaces the numpy
+// arange+repeat flat-index gather on the gateway parse hot path.
+long long gather_ranges(const uint8_t* buf, const int64_t* starts,
+                        const int64_t* ends, int64_t n, uint8_t* out) {
+  int64_t pos = 0;
+  for (int64_t k = 0; k < n; ++k) {
+    int64_t len = ends[k] - starts[k];
+    if (len < 0) return -1;
+    memcpy(out + pos, buf + starts[k], len);
+    pos += len;
+  }
+  return pos;
+}
+
+// Per-line 2x64-bit positional head hashes (same formulation as the
+// numpy reduceat path in gateway/influx.py: sum(byte * pow[rel]) per
+// stream, stream 2 xor'd with the head length).  pow tables are
+// caller-provided so Python and C stay bit-identical.
+long long head_hash128(const uint8_t* buf, const int64_t* starts,
+                       const int64_t* ends, int64_t n,
+                       const uint64_t* p1, const uint64_t* p2,
+                       int64_t npow, uint64_t* h1, uint64_t* h2) {
+  for (int64_t k = 0; k < n; ++k) {
+    int64_t len = ends[k] - starts[k];
+    if (len < 0 || len >= npow) return -1;
+    const uint8_t* p = buf + starts[k];
+    uint64_t a = 0, b = 0;
+    for (int64_t r = 0; r < len; ++r) {
+      uint64_t c = p[r];
+      a += c * p1[r];
+      b += c * p2[r];
+    }
+    h1[k] = a;
+    h2[k] = b ^ static_cast<uint64_t>(len);
+  }
+  return n;
+}
+
+// Hash-collision guard: every line's head bytes must equal its group
+// representative's (rep[k] indexes into the same line arrays).
+// Returns 1 when all match, 0 on any mismatch (caller falls back to
+// the per-line parser), -1 on malformed spans.
+long long verify_heads(const uint8_t* buf, const int64_t* starts,
+                       const int64_t* ends, const int64_t* rep,
+                       int64_t n) {
+  for (int64_t k = 0; k < n; ++k) {
+    int64_t len = ends[k] - starts[k];
+    int64_t rk = rep[k];
+    if (len < 0 || rk < 0 || rk >= n) return -1;
+    if (ends[rk] - starts[rk] != len) return 0;
+    if (memcmp(buf + starts[k], buf + starts[rk],
+               static_cast<size_t>(len)) != 0)
+      return 0;
+  }
+  return 1;
+}
+
 }  // extern "C"

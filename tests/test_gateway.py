@@ -167,6 +167,35 @@ class TestInfluxParser:
         got = influx.parse_batch_columns(ok)
         assert got is not None and got[0] == ["cpu,host=ab"]
 
+    def test_columnar_native_and_numpy_heads_equivalent(self, monkeypatch):
+        """The C head helpers (gather_ranges / head_hash128 /
+        verify_heads) must resolve bit-identically to the numpy
+        formulation — heads, inverse, field names, memo behavior."""
+        import numpy as np
+
+        from filodb_tpu import native
+        from filodb_tpu.gateway.influx import parse_batch_columns
+
+        if native.influx_parser() is None:
+            pytest.skip("native disabled")
+        texts = ["\n".join(
+            f"m{i % 3},host=h{i % 17},dc=d{i % 2} "
+            f"value={i * 0.5 + b} {100000000 + i * 1000}"
+            for i in range(200)) + "\n" for b in range(3)]
+        memo_n: dict = {}
+        got_native = [parse_batch_columns(t, memo_n) for t in texts]
+        monkeypatch.setattr(native, "influx_parser", lambda: None)
+        memo_p: dict = {}
+        got_numpy = [parse_batch_columns(t, memo_p) for t in texts]
+        for gn, gp in zip(got_native, got_numpy):
+            assert gn is not None and gp is not None
+            assert gn[0] == gp[0]                      # heads
+            assert np.array_equal(gn[1], gp[1])        # inverse
+            assert gn[2] == gp[2]                      # field names
+            assert np.array_equal(gn[3], gp[3])
+            assert np.array_equal(gn[4], gp[4])        # values
+            assert np.array_equal(gn[5], gp[5])        # timestamps
+
     def test_columnar_ingest_bad_head_skips_only_its_lines(self):
         """A malformed head mid-batch must drop only ITS lines (counted
         as parse errors); every other series still lands — matching the
