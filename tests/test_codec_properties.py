@@ -76,6 +76,55 @@ def test_double_roundtrip_and_wire_parity(seed):
 
 
 @pytest.mark.parametrize("seed", SEEDS)
+def test_histogram_roundtrip(seed):
+    from filodb_tpu.codecs import histcodec
+    from filodb_tpu.core.histogram import CustomBuckets, GeometricBuckets
+
+    rng = np.random.default_rng(2000 + seed)
+    nrows = int(rng.integers(1, 160))
+    nb = int(rng.integers(2, 64))
+    schemes = [GeometricBuckets(float(rng.uniform(0.5, 4)),
+                                float(rng.uniform(1.5, 3)), nb),
+               CustomBuckets(np.sort(np.concatenate(
+                   [rng.uniform(0.1, 1e4, nb - 1), [np.inf]])))]
+    for buckets in schemes:
+        incr = rng.integers(0, 20, (nrows, nb))
+        rows = np.cumsum(np.cumsum(incr, axis=1), axis=0).astype(np.int64)
+        if nrows > 4 and rng.random() < 0.5:
+            cut = nrows // 2          # counter reset mid-stream
+            rows[cut:] = np.cumsum(np.cumsum(
+                rng.integers(0, 20, (nrows - cut, nb)), axis=1),
+                axis=0)
+        b2, rows2 = histcodec.decode(histcodec.encode(buckets, rows))
+        assert b2 == buckets
+        np.testing.assert_array_equal(rows2, rows, err_msg=f"seed={seed}")
+        assert histcodec.num_values(histcodec.encode(buckets, rows)) \
+            == nrows
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_string_and_nbit_roundtrip(seed):
+    from filodb_tpu.codecs import strcodec
+
+    rng = np.random.default_rng(3000 + seed)
+    n = int(rng.integers(1, 400))
+    # utf8 / dict form: low- and high-cardinality mixes, empty strings,
+    # multi-byte codepoints
+    alphabet = ["", "a", "pod-1", "νερό", "x" * 50,
+                *(f"inst-{i}" for i in range(8))]
+    strings = [alphabet[i] for i in rng.integers(0, len(alphabet), n)]
+    blob = strcodec.encode_utf8(strings)
+    got = [s.decode("utf-8") for s in strcodec.decode_utf8(blob)]
+    assert got == strings, f"seed={seed}"
+    # nbit ints across width classes
+    for maxv in (1, 15, 255, 4095, 2**20):
+        vals = rng.integers(0, maxv + 1, n).astype(np.int64)
+        got_v = strcodec.decode_nbit(strcodec.encode_nbit(vals))
+        np.testing.assert_array_equal(got_v[:n], vals,
+                                      err_msg=f"maxv={maxv} seed={seed}")
+
+
+@pytest.mark.parametrize("seed", SEEDS)
 def test_longlong_roundtrip_and_wire_parity(seed):
     rng = np.random.default_rng(1000 + seed)
     n = int(rng.integers(1, 700))
