@@ -1,0 +1,89 @@
+"""Generative PromQL render/parse round-trip sweep.
+
+`logical_plan_to_promql` is load-bearing for distribution: HA failover
+and federation re-render plans and ship them to replicas
+(coordinator/planners.py), so every renderable construct must parse
+back to an equivalent plan.  The fixed list in test_planners.py covers
+known shapes; this sweep composes random expressions from a grammar of
+supported constructs (selectors with all four matcher types, range and
+instant functions, grouped aggregations, topk/quantile, arithmetic and
+comparison binaries, scalar operands) and asserts the render fixpoint —
+render(parse(render(parse(q)))) == render(parse(q)) — plus preserved
+plan type and time range.
+
+Reference analog: coordinator/src/test/.../queryplanner/
+LogicalPlanParserSpec.scala (render/parse round-trip assertions).
+"""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.planners import logical_plan_to_promql
+from filodb_tpu.promql.parser import parse_query
+from filodb_tpu.query import logical as lp
+
+BASE = 1_700_000_000_000
+STEP = 10_000
+HOUR = 3_600_000
+
+RANGE_FNS = ["rate", "increase", "avg_over_time", "max_over_time",
+             "min_over_time", "sum_over_time", "count_over_time",
+             "last_over_time", "delta", "deriv"]
+INSTANT_FNS = ["abs", "ceil", "floor", "exp", "ln", "sqrt"]
+AGGS = ["sum", "min", "max", "avg", "count", "stddev", "stdvar"]
+WINDOWS = ["1m", "2m", "5m"]
+BIN_OPS = ["+", "-", "*", "/", ">", "<", ">=", "<=", "=="]
+MATCHERS = [('job', '=', '"api"'), ('job', '!=', '"web"'),
+            ('inst', '=~', '"i.*"'), ('inst', '!~', '"x[0-9]+"')]
+
+
+def _selector(rng):
+    name = rng.choice(["http_req_total", "mem_bytes", "up"])
+    k = int(rng.integers(0, 3))
+    if not k:
+        return name
+    picks = rng.choice(len(MATCHERS), size=k, replace=False)
+    ms = ",".join(f"{MATCHERS[i][0]}{MATCHERS[i][1]}{MATCHERS[i][2]}"
+                  for i in sorted(picks))
+    return f"{name}{{{ms}}}"
+
+
+def _vector(rng, depth):
+    roll = rng.random()
+    if depth <= 0 or roll < 0.25:
+        fn = rng.choice(RANGE_FNS)
+        return f"{fn}({_selector(rng)}[{rng.choice(WINDOWS)}])"
+    if roll < 0.45:
+        return f"{rng.choice(INSTANT_FNS)}({_vector(rng, depth - 1)})"
+    if roll < 0.75:
+        op = rng.choice(AGGS)
+        inner = _vector(rng, depth - 1)
+        grp = rng.random()
+        if grp < 0.33:
+            return f"{op}({inner}) by (g)"
+        if grp < 0.5:
+            return f"{op}({inner}) without (inst)"
+        return f"{op}({inner})"
+    if roll < 0.85:
+        return f"topk(3, {_vector(rng, depth - 1)})"
+    if roll < 0.9:
+        return f"quantile(0.9, {_vector(rng, depth - 1)})"
+    op = rng.choice(BIN_OPS)
+    lhs = _vector(rng, depth - 1)
+    rhs = str(round(float(rng.uniform(0.5, 9)), 2)) \
+        if rng.random() < 0.5 else _vector(rng, depth - 1)
+    return f"({lhs}) {op} ({rhs})"
+
+
+@pytest.mark.parametrize("seed", range(16))
+def test_generated_roundtrip(seed):
+    rng = np.random.default_rng(seed)
+    start, end = BASE, BASE + HOUR
+    for _ in range(8):
+        query = _vector(rng, depth=int(rng.integers(1, 4)))
+        plan = parse_query(query, start, STEP, end)
+        rendered = logical_plan_to_promql(plan)
+        plan2 = parse_query(rendered, start, STEP, end)
+        assert type(plan2) is type(plan), query
+        assert logical_plan_to_promql(plan2) == rendered, query
+        assert lp.time_range(plan2) == lp.time_range(plan), query
