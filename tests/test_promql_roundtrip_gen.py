@@ -75,6 +75,47 @@ def _vector(rng, depth):
     return f"({lhs}) {op} ({rhs})"
 
 
+@pytest.mark.parametrize("seed", range(8))
+def test_generated_leaf_plans_survive_wire(seed):
+    """Every leaf ExecPlan a planner would dispatch for a generated
+    query must survive serialize -> real JSON -> deserialize ->
+    serialize unchanged (the HTTP wire-dispatch path,
+    client/SerializationSpec analog)."""
+    import json
+
+    from filodb_tpu.coordinator.planner import SingleClusterPlanner
+    from filodb_tpu.core.schemas import DatasetOptions
+    from filodb_tpu.parallel.shardmap import ShardMapper, ShardStatus
+    from filodb_tpu.query import wire
+
+    mapper = ShardMapper(2)
+    mapper.register_node(range(2), "local")
+    for s in range(2):
+        mapper.update_status(s, ShardStatus.ACTIVE)
+    planner = SingleClusterPlanner("prom", mapper, DatasetOptions(),
+                                   spread_default=0)
+    rng = np.random.default_rng(100 + seed)
+    checked = 0
+    for _ in range(6):
+        query = _vector(rng, depth=int(rng.integers(1, 3)))
+        ep = planner.materialize(
+            parse_query(query, BASE, STEP, BASE + HOUR))
+        stack = [ep]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children)
+            if not node.children:       # leaf: what HTTP dispatch ships
+                try:
+                    d = wire.serialize_plan(node)
+                except wire.WireError:
+                    continue            # intentionally local-only plans
+                d2 = json.loads(json.dumps(d))
+                node2 = wire.deserialize_plan(d2)
+                assert wire.serialize_plan(node2) == d, query
+                checked += 1
+    assert checked > 0
+
+
 @pytest.mark.parametrize("seed", range(16))
 def test_generated_roundtrip(seed):
     rng = np.random.default_rng(seed)
