@@ -232,13 +232,18 @@ def main():
         nthreads = cpp_baseline.hw_threads()
         cpp_baseline.rate_sum(sub_ts[:64], sub_vals[:64], ids_np[:64], 1,
                               steps64, WINDOW_MS)       # warm (page-in)
-        a = time.perf_counter()
-        cpp_out = cpp_baseline.rate_sum(sub_ts, sub_vals, ids_np, 1,
-                                        steps64, WINDOW_MS)
-        np_elapsed = time.perf_counter() - a
+        # best-of-3: this shared 1-core host swings >10x with co-tenant
+        # load, and a slow baseline shot INFLATES vs_baseline — take the
+        # least-contended run as the honest proxy of the machine
+        np_elapsed = float("inf")
+        for _ in range(3):
+            a = time.perf_counter()
+            cpp_out = cpp_baseline.rate_sum(sub_ts, sub_vals, ids_np, 1,
+                                            steps64, WINDOW_MS)
+            np_elapsed = min(np_elapsed, time.perf_counter() - a)
         np_rate = nsub * (NB - 1) / np_elapsed
         log(f"C++ baseline ({nthreads} threads): {np_rate:.3e} samples/sec "
-            f"({nsub} series, {np_elapsed:.3f}s)")
+            f"({nsub} series, best {np_elapsed:.3f}s of 3)")
         # cross-check vs the numpy oracle on a slice so the baseline can
         # never silently drift from the measured semantics
         ora = _numpy_rate_sum(sub_ts[:256], sub_vals[:256], ids_np[:256],
