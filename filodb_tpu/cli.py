@@ -138,6 +138,22 @@ def cmd_importcsv(args) -> int:
     return 0
 
 
+def cmd_verify_chunks(args) -> int:
+    """Offline integrity scan: recompute every persisted chunk's CRC32C
+    against its stored checksum (and with --deep, decode every vector)
+    and report per-shard pass/fail counts (doc/integrity.md).  Exits 1
+    when any chunk fails."""
+    from filodb_tpu.integrity.scan import verify_chunks
+    from filodb_tpu.store.persistence import DiskColumnStore
+
+    store = DiskColumnStore(f"{args.data_dir}/chunks.db")
+    shards = [int(s) for s in args.shards.split(",")] if args.shards \
+        else None
+    report = verify_chunks(store, args.dataset, shards, deep=args.deep)
+    print(json.dumps(report, indent=2))
+    return 1 if report["total_failed"] else 0
+
+
 def cmd_partkey(args) -> int:
     """Debug: render a hex partkey as tags (reference: partKeyBrAsString)."""
     from filodb_tpu.core.record import parse_partkey
@@ -233,6 +249,17 @@ def build_parser() -> argparse.ArgumentParser:
     ic.add_argument("--timestamp-column", default="timestamp")
     ic.add_argument("--shard", type=int, default=0)
     ic.set_defaults(fn=cmd_importcsv)
+
+    vc = sub.add_parser("verify-chunks",
+                        help="offline checksum/decode scan of a "
+                             "dataset's persisted chunks")
+    vc.add_argument("--data-dir", required=True)
+    vc.add_argument("--dataset", required=True)
+    vc.add_argument("--shards", default=None,
+                    help="comma-separated shard list (default: all)")
+    vc.add_argument("--deep", action="store_true",
+                    help="also decode every vector, not just checksums")
+    vc.set_defaults(fn=cmd_verify_chunks)
 
     pk = sub.add_parser("partkey", help="decode a hex partkey")
     pk.add_argument("hex")

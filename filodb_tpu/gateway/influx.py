@@ -408,6 +408,13 @@ def _resolve_heads(a, data, starts, sp1, eq1, values, ts_ms, batch_memo):
     # streams ride a complex128 through np.unique (the float conversion
     # keeps ~52 bits per stream — ample dedup entropy)
     hlen = sp1 - starts
+    # reject zero-length heads (a line starting with its separator)
+    # BEFORE hashing: np.add.reduceat returns the NEXT segment's element
+    # (not 0) for an empty segment, so the numpy fallback would diverge
+    # from the C head_hash128 (ADVICE r5 finding 3) — and an empty
+    # measurement is malformed anyway (the per-line parser rejects it)
+    if not len(hlen) or int(hlen.min()) <= 0:
+        return None
     p1, p2 = _hash_pows()
     if int(hlen.max()) >= len(p1):
         return None

@@ -48,6 +48,21 @@ def _matrix_entry(tags: dict, ts_ms: np.ndarray, vals: np.ndarray,
                        for i in np.flatnonzero(fin)]}
 
 
+def _attach_warnings(resp: dict, result: QueryResult) -> dict:
+    """Prometheus-style ``warnings`` for partial results: quarantined
+    (corrupt) chunks were excluded from the scan — the caller gets real
+    data plus a loud flag, never wrong values and never silence.  The
+    HTTP server mirrors this as an X-FiloDB-Partial-Data header."""
+    n = result.stats.corrupt_chunks_excluded
+    if n:
+        resp["warnings"] = [
+            f"partial data: {n} corrupt chunk(s) quarantined and "
+            f"excluded from results (see /admin/integrity)"]
+        from filodb_tpu.utils.observability import integrity_metrics
+        integrity_metrics()["partial_queries"].inc()
+    return resp
+
+
 def to_prom_matrix(result: QueryResult,
                    metric_column: str = "_metric_") -> dict:
     """Range-query response (resultType=matrix)."""
@@ -71,8 +86,9 @@ def to_prom_matrix(result: QueryResult,
                                   np.asarray(b.batch.values[i][:n]))
                 if e is not None:
                     out.append(e)
-    return {"status": "success",
-            "data": {"resultType": "matrix", "result": out}}
+    return _attach_warnings(
+        {"status": "success",
+         "data": {"resultType": "matrix", "result": out}}, result)
 
 
 def to_prom_vector(result: QueryResult, time_ms: int,
@@ -92,12 +108,14 @@ def to_prom_vector(result: QueryResult, time_ms: int,
         elif isinstance(b, ScalarResult):
             vals = np.asarray(b.values)
             if len(vals):
-                return {"status": "success",
-                        "data": {"resultType": "scalar",
-                                 "value": [time_ms / 1000.0,
-                                           _fmt(float(vals[-1]))]}}
-    return {"status": "success",
-            "data": {"resultType": "vector", "result": out}}
+                return _attach_warnings(
+                    {"status": "success",
+                     "data": {"resultType": "scalar",
+                              "value": [time_ms / 1000.0,
+                                        _fmt(float(vals[-1]))]}}, result)
+    return _attach_warnings(
+        {"status": "success",
+         "data": {"resultType": "vector", "result": out}}, result)
 
 
 def error_response(error_type: str, message: str) -> dict:

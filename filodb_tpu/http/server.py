@@ -177,6 +177,10 @@ class FiloHttpServer:
         try:
             req.send_response(code)
             req.send_header("Content-Type", "application/json")
+            if isinstance(payload, dict) and payload.get("warnings"):
+                # partial-data flag as a header too, so load balancers /
+                # caches can act on it without parsing the body
+                req.send_header("X-FiloDB-Partial-Data", "true")
             req.send_header("Content-Length", str(len(data)))
             req.end_headers()
             req.wfile.write(data)
@@ -351,7 +355,44 @@ class FiloHttpServer:
         if len(parts) == 3 and parts[0] == "admin" \
                 and parts[1] == "chunkmeta":
             return self._chunkmeta(parts[2], params)
+        if len(parts) == 2 and parts[0] == "admin" \
+                and parts[1] == "integrity":
+            return self._integrity()
         return 404, error_response("bad_data", f"unknown route {path}")
+
+    def _integrity(self) -> tuple[int, dict]:
+        """Operational view of the data-integrity subsystem: global
+        counters, the quarantine registry, and per-shard corruption /
+        invariant state (doc/integrity.md)."""
+        from filodb_tpu.integrity import QUARANTINE
+        from filodb_tpu.utils.observability import integrity_metrics
+        m = integrity_metrics()
+        shards: dict = {}
+        for ds, b in self.datasets.items():
+            rows = []
+            for sh in b.memstore.shards(ds):
+                st = sh.stats
+                paged = getattr(sh, "paged", None)
+                row = {"shard": sh.shard_num,
+                       "chunks_corrupt": st.chunks_corrupt,
+                       "chunks_quarantined": st.chunks_quarantined,
+                       "page_decode_corrupt":
+                           getattr(st, "page_decode_corrupt", 0),
+                       "integrity_failed": sh.integrity_failed}
+                if paged is not None:
+                    try:
+                        paged.check_invariants()
+                        row["paged_cache_invariants"] = "ok"
+                    except Exception as e:  # noqa: BLE001 — report, not raise
+                        row["paged_cache_invariants"] = str(e)
+                rows.append(row)
+            shards[ds] = rows
+        return 200, {"status": "success", "data": {
+            "counters": {name: metric.total()
+                         for name, metric in m.items()},
+            "quarantine": QUARANTINE.summary(),
+            "quarantined": QUARANTINE.items(),
+            "shards": shards}}
 
     def _chunkmeta(self, ds: str, p: dict) -> tuple[int, dict]:
         """Chunk-level metadata for matching series (reference: the

@@ -28,8 +28,10 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from filodb_tpu import integrity
 from filodb_tpu.core.chunk import (ChunkBatch, ChunkSet, ChunkSetInfo,
                                    counts_pad, fill_batch_pads, pad_rows)
+from filodb_tpu.integrity import IntegrityInvariantError
 from filodb_tpu.core.filters import ColumnFilter
 from filodb_tpu.core.record import parse_partkey
 from filodb_tpu.core.schemas import ColumnType
@@ -205,6 +207,32 @@ class _PagedPartitions:
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old[1]
+            if self._bytes < 0:   # O(1) reclaim-bookkeeping tripwire
+                raise IntegrityInvariantError(
+                    f"paged LRU byte accounting went negative "
+                    f"({self._bytes}) popping {key!r}")
+
+    def current_gen(self) -> int:
+        """The invalidation generation read UNDER the lock — guard
+        capture for a deferred publish must not race a concurrent
+        pop()'s bump (ADVICE r5 finding 2: an unlocked read relied on
+        every pop() caller holding the shard's _odp_lock)."""
+        with self._lock:
+            return self.gen
+
+    def check_invariants(self) -> None:
+        """Hard reclaim-bookkeeping check: tracked bytes must equal the
+        sum over live entries.  Raises IntegrityInvariantError on drift
+        — callers fail the shard rather than serve stale buffers (the
+        reference's reclaim meta-size check kills the process,
+        TimeSeriesShard.scala:279-301)."""
+        with self._lock:
+            actual = sum(nb for _v, nb in self._entries.values())
+            if actual != self._bytes or self._bytes < 0:
+                raise IntegrityInvariantError(
+                    f"paged LRU byte accounting drift: tracked="
+                    f"{self._bytes} actual={actual} "
+                    f"entries={len(self._entries)}")
 
     def __len__(self) -> int:
         """Number of cached whole partitions (backfill entries excluded)."""
@@ -238,6 +266,9 @@ class OnDemandPagingShard(TimeSeriesShard):
         self.stats.partitions_paged = 0
         self.stats.chunks_paged = 0
         self.stats.page_publish_errors = 0
+        # bulk page-decode calls that hit a corrupt-input sentinel and
+        # fell back to the per-chunk path (which diagnoses + quarantines)
+        self.stats.page_decode_corrupt = 0
 
     def _join_materialize(self) -> None:
         # peek-join-remove (NOT pop-then-join): a task must stay visible
@@ -441,10 +472,15 @@ class OnDemandPagingShard(TimeSeriesShard):
             # instead of binding thousands of point lookups
             full = len(by_pk) > 256 \
                 and 2 * len(by_pk) >= len(self.part_set)
+            # defer_verify: the native decoder CRC-checks every selected
+            # row span on the join it builds anyway (crcs= below), so
+            # the store skips its own checksum pass — rows the full
+            # scan over-returns are never verified OR decoded
             rows = self.store.read_raw_rows(self.dataset, self.shard_num,
                                             None if full else list(by_pk),
                                             0, _MAX_TIME,
-                                            byte_cap=byte_cap)
+                                            byte_cap=byte_cap,
+                                            defer_verify=True)
             if rows is None:
                 return None          # store has no bulk read
             # group by partkey runs, skipping rows the full scan
@@ -494,6 +530,13 @@ class OnDemandPagingShard(TimeSeriesShard):
                 return None          # hist/string columns: generic path
             row_counts = [r[2] for r in sel]
             blobs = [r[6] for r in sel]
+            # stored checksums ride along (deferred store verification:
+            # the decode calls below verify these on their own join);
+            # honor the global verify switch here too — the store was
+            # told to defer, so this is where OFF must actually mean off
+            import operator
+            crcs = list(map(operator.itemgetter(7), sel)) \
+                if len(sel[0]) > 7 and integrity.verify_enabled() else None
             dec_row_bytes = 8 * len(schema.data.columns)
             # ---- fused: decode straight into the query's padded batch
             fcid = None
@@ -532,13 +575,20 @@ class OnDemandPagingShard(TimeSeriesShard):
                          for jj, c in enumerate(data_cols, start=1)
                          if jj != fcid]
                 eflats = None
+                # crcs on the FIRST call only: one verify per row set
                 if nb.page_decode_into(blobs, row_counts,
                                        [(0, False, ts2d),
-                                        (fcid, True, val2d)], out_starts):
+                                        (fcid, True, val2d)], out_starts,
+                                       crcs=crcs):
                     eflats = nb.page_decode(blobs, row_counts, extra) \
                         if extra else []
                 if eflats is None:
-                    return None      # corrupt: path that raises decodes
+                    # corrupt-input sentinel (checksum or decode): count
+                    # it, then the generic path re-reads store-verified
+                    # rows, re-decodes per chunk, diagnoses, quarantines
+                    self.stats.page_decode_corrupt += 1
+                    return None
+                self._count_verified(len(sel), crcs)
                 cnts = counts_pad(counts.astype(np.int32), S_pad)
                 fill_batch_pads(ts2d, val2d, cnts, S)
                 epref = np.concatenate(
@@ -572,8 +622,10 @@ class OnDemandPagingShard(TimeSeriesShard):
                     tags_list[idx_of[pid]] = tags
                 self.stats.partitions_paged += len(groups)
                 self.stats.chunks_paged += len(sel)
-                # pop()s since this point cancel the publish (gen_guard)
-                gen0 = self.paged.gen
+                # pop()s since this point cancel the publish (gen_guard);
+                # read under the cache lock so a concurrent pop cannot
+                # slip between the read and the guard capture
+                gen0 = self.paged.current_gen()
 
                 def publish():
                     # lock-free: everything touched (page-cache, index
@@ -604,9 +656,14 @@ class OnDemandPagingShard(TimeSeriesShard):
             cols = [(0, False)] + [
                 (j, c.ctype == ColumnType.DOUBLE)
                 for j, c in enumerate(data_cols, start=1)]
-            flats = nb.page_decode(blobs, row_counts, cols)
+            flats = nb.page_decode(blobs, row_counts, cols, crcs=crcs)
             if flats is None:
-                return None          # corrupt somewhere: path that raises
+                # corrupt-input sentinel (checksum or decode): count +
+                # fall back (the generic store-verified per-chunk path
+                # diagnoses and quarantines the culprit)
+                self.stats.page_decode_corrupt += 1
+                return None
+            self._count_verified(len(sel), crcs)
             oo = np.concatenate(([0], np.cumsum(row_counts))).tolist()
             ts_flat, val_flats = flats[0], flats[1:]
 
@@ -652,10 +709,11 @@ class OnDemandPagingShard(TimeSeriesShard):
             # only the attribute sets — no skeleton shortcut needed
             part = TimeSeriesPartition(pid, schema, pk, tags,
                                        group=pid % self.num_groups)
+            part.on_corrupt = self.note_corrupt_chunk
             chunks, decoded, nbytes = [], {}, 0
             run = 0
             for k in range(si, sj):
-                _pk, cidk, nr, st, et, shh, blob = sel[k]
+                _pk, cidk, nr, st, et, shh, blob = sel[k][:7]
                 (nvec,) = _U16.unpack_from(blob, 0)
                 raw_nb = len(blob) - 2 - 4 * nvec
                 chunks.append(PagedChunkSet(
@@ -713,6 +771,7 @@ class OnDemandPagingShard(TimeSeriesShard):
                     tags = parse_partkey(pk)
                 part = TimeSeriesPartition(pid, schema, pk, tags,
                                            group=pid % self.num_groups)
+                part.on_corrupt = self.note_corrupt_chunk
                 part.chunks = sorted(chunksets, key=lambda c: c.info.chunk_id)
                 # paged chunks are already persisted: nothing to flush
                 part._unflushed = []
@@ -775,6 +834,7 @@ class OnDemandPagingShard(TimeSeriesShard):
         from filodb_tpu import native
         if native.batch_decoder() is None:
             return None
+        self._check_integrity()
         live = self.partitions
         paged = self.paged.snapshot()
         parts: dict[int, TimeSeriesPartition] = {}
@@ -844,16 +904,20 @@ class OnDemandPagingShard(TimeSeriesShard):
         ts_parts, val_parts = [], []
         counts = np.zeros(len(order), dtype=np.int64)
         lo_info, hi_info = _MAX_TIME, -_MAX_TIME
+        q = integrity.QUARANTINE
         for i, pid in enumerate(order):
             part = parts[pid]
             if part.schema.schema_hash != h0:
                 return None
+            q_ids = q.chunk_ids(part.partkey) if q else ()
             c = 0
             for cs in part.chunks:
                 info = cs.info
                 if info.end_time < start_time \
                         or info.start_time > end_time:
                     continue
+                if q_ids and info.chunk_id in q_ids:
+                    continue   # quarantined: serve partial, never corrupt
                 got = part._decoded.get(info.chunk_id)
                 if got is None:
                     return None   # mixed schema within partition etc.
@@ -915,27 +979,44 @@ class OnDemandPagingShard(TimeSeriesShard):
         read_range becomes pure concatenation (reference:
         DemandPagedChunkStore.scala:34 pages straight into block memory;
         VERDICT r4 missing #4 — the cold ODP path paid a per-chunk
-        Python decode per partition)."""
+        Python decode per partition).  Quarantined chunks are excluded;
+        a corrupt chunk discovered here is diagnosed per chunk,
+        quarantined, and the rest still decode."""
         from filodb_tpu.core.chunk import decode_partitions_batch
         groups, owners = [], []
         schema = None
+        q = integrity.QUARANTINE
         for part in parts:
             if schema is None:
                 schema = part.schema
             elif part.schema.schema_hash != schema.schema_hash:
                 return                     # mixed schemas: per-chunk path
             decoded = part._decoded
+            q_ids = q.chunk_ids(part.partkey) if q else ()
             for cs in part.chunks:
                 if cs.info.end_time < start_time \
                         or cs.info.start_time > end_time \
                         or cs.info.chunk_id in decoded:
                     continue
+                if q_ids and cs.info.chunk_id in q_ids:
+                    continue
                 groups.append([cs])
                 owners.append((part, cs.info.chunk_id))
         if not groups or schema is None:
             return
-        for (part, cid), decoded in zip(
-                owners, decode_partitions_batch(schema, groups)):
+        try:
+            decoded_all = decode_partitions_batch(schema, groups)
+        except (ValueError, IndexError, struct.error):
+            # ONE corrupt chunk fails the whole batch decode: redo per
+            # chunk so the culprit gets its structured diagnosis +
+            # quarantine while every healthy chunk still fills its cache
+            for (part, _cid), (cs,) in zip(owners, groups):
+                try:
+                    part._decoded_chunk(cs)
+                except integrity.CorruptVectorError as err:
+                    part._note_corrupt(err)
+            return
+        for (part, cid), decoded in zip(owners, decoded_all):
             part._decoded[cid] = decoded
 
     def _cap_data_scanned(self, resident_parts, missing_ids: Sequence[int],
@@ -970,6 +1051,7 @@ class OnDemandPagingShard(TimeSeriesShard):
         """Unlike the in-memory-only base (which reports non-resident ids as
         ``missing_partkeys``), every indexed id is servable here — absent
         partitions page in at scan time."""
+        self._check_integrity()
         ids = self.index.part_ids_from_filters(filters, start_time, end_time,
                                                limit)
         first_schema = None
@@ -1048,10 +1130,43 @@ class OnDemandPagingShard(TimeSeriesShard):
                 self.bump_removal_epoch()    # invalidates grid prep caches
                 self.paged.pop(pid)          # cached copy lacks the tail
                 self.paged.pop(("bf", pid))  # list is live-part relative
+                # hard reclaim invariant (still under _odp_lock, so no
+                # legitimate re-page-in can land): a popped entry that is
+                # STILL cached means a publish resurrected stale buffers
+                # past the gen guard — fail the shard, don't serve it
+                for key in (pid, ("bf", pid)):
+                    if self.paged.get(key) is not None:
+                        self._fail_integrity(
+                            f"evicted entry {key!r} resurrected in the "
+                            f"page cache during eviction")
             self.evicted_keys.add(part.partkey)
             self.stats.partitions_evicted += 1
             evicted += 1
+        if evicted:
+            # full byte-accounting audit once per eviction batch (O(cache
+            # entries), off the query path)
+            try:
+                self.paged.check_invariants()
+            except IntegrityInvariantError as e:
+                self._fail_integrity(str(e))
         return evicted
+
+    @staticmethod
+    def _count_verified(n: int, crcs) -> None:
+        """Bulk decode succeeded with deferred checksum verification:
+        credit the verified-chunks counter (the store skipped its pass)."""
+        if crcs is not None and n:
+            from filodb_tpu.utils.observability import integrity_metrics
+            integrity_metrics()["chunks_verified"].inc(n)
+
+    def _fail_integrity(self, detail: str) -> None:
+        """Record the broken invariant, count it, and fail the shard:
+        every subsequent scan raises instead of serving stale buffers."""
+        self.integrity_failed = detail
+        integrity.note_invariant_failure(self.dataset, self.shard_num,
+                                         detail)
+        raise IntegrityInvariantError(
+            f"shard {self.shard_num} failed integrity: {detail}")
 
     def index_only_ids(self) -> list[int]:
         """Ids present in the index but not resident in memory."""

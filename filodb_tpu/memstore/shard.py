@@ -76,6 +76,11 @@ class ShardStats:
     partitions_purged: int = 0
     chunks_flushed: int = 0
     flushes_done: int = 0
+    # integrity subsystem (filodb_tpu/integrity): decode/checksum
+    # corruption detected while serving this shard, and how many of
+    # those chunks entered quarantine here
+    chunks_corrupt: int = 0
+    chunks_quarantined: int = 0
 
 
 class TimeSeriesShard:
@@ -121,6 +126,13 @@ class TimeSeriesShard:
         self.latest_ingest_ts = -1
         self.evicted_keys = BloomFilter(self.config.evicted_pk_bloom_filter_capacity)
         self.stats = ShardStats()
+        # set when an eviction/reclaim bookkeeping invariant broke: the
+        # shard FAILS further scans rather than serve stale buffers
+        # (the reference kills the process on its reclaim meta check)
+        self.integrity_failed: Optional[str] = None
+        # store-level corruption detections route back here by identity
+        from filodb_tpu import integrity
+        integrity.register_shard(self)
         self.ingest_sched_check = None  # optional thread-name assertion hook
         # device-resident chunk grids (HBM arena; memstore/devicestore.py),
         # one per (schema, value column); created lazily on first grid scan
@@ -316,6 +328,7 @@ class TimeSeriesShard:
                 pid, schema, pk, rtags, part_hash % self.num_groups,
                 capacity=self.config.max_chunks_size)
             part.on_freeze = self._on_chunk_freeze
+            part.on_corrupt = self.note_corrupt_chunk
             self.partitions[pid] = part
             self.index.mark_active(pid)
             return part
@@ -331,6 +344,7 @@ class TimeSeriesShard:
             pid, schema, pk, tags, group,
             capacity=self.config.max_chunks_size)
         part.on_freeze = self._on_chunk_freeze
+        part.on_corrupt = self.note_corrupt_chunk
         self.partitions[pid] = part
         self.part_set[pk] = pid
         self.part_schema_hash[pid] = schema.schema_hash
@@ -461,6 +475,27 @@ class TimeSeriesShard:
         """Atomic removal-epoch increment; see ``_epoch_lock``."""
         with self._epoch_lock:
             self.removal_epoch += 1
+
+    def note_corrupt_chunk(self, err, newly_quarantined: bool) -> None:
+        """Partition/store hook: a chunk of this shard failed checksum
+        or decode (already quarantined + logged by the integrity
+        funnel); keep the per-shard tally the tentpole asks for."""
+        self.stats.chunks_corrupt += 1
+        if newly_quarantined:
+            self.stats.chunks_quarantined += 1
+            # grid plans staged from this chunk must revalidate, so the
+            # DEVICE serving path excludes the quarantined chunk exactly
+            # like the host path's read_range does
+            self.bump_removal_epoch()
+
+    def _check_integrity(self) -> None:
+        """Hard tripwire: once eviction/reclaim bookkeeping is known
+        broken, refuse to serve (stale buffers are worse than errors)."""
+        if self.integrity_failed is not None:
+            from filodb_tpu.integrity import IntegrityInvariantError
+            raise IntegrityInvariantError(
+                f"shard {self.shard_num} failed integrity: "
+                f"{self.integrity_failed}")
 
     def evict_partitions(self, n: int) -> int:
         """Evict up to n longest-stopped partitions (reference :1308-1401).
@@ -686,6 +721,7 @@ class TimeSeriesShard:
         """Materialize partitions into one padded ChunkBatch + tag dicts.
         This is the TPU replacement for scanPartitions/RawDataRangeVector
         iteration (reference :1490, SelectRawPartitionsExec)."""
+        self._check_integrity()
         tags_list, ts_list, val_list = [], [], []
         hist = None  # locked by the first partition: one value type per batch
         bucket_tops = None

@@ -108,17 +108,31 @@ def _bind(lib):
         ctypes.c_void_p, ctypes.c_void_p]
     lib.gather_ranges.restype = ctypes.c_longlong
     lib.gather_ranges.argtypes = [
-        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-        ctypes.c_longlong, ctypes.c_void_p]
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_void_p]
     lib.head_hash128.restype = ctypes.c_longlong
     lib.head_hash128.argtypes = [
-        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-        ctypes.c_longlong, ctypes.c_void_p, ctypes.c_void_p,
-        ctypes.c_longlong, ctypes.c_void_p, ctypes.c_void_p]
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_void_p,
+        ctypes.c_void_p]
     lib.verify_heads.restype = ctypes.c_longlong
     lib.verify_heads.argtypes = [
-        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-        ctypes.c_void_p, ctypes.c_longlong]
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong]
+    # c_char_p: bytes pass zero-copy with no numpy wrapper — the store
+    # verifies one blob per chunk row on the ODP page-in hot path
+    lib.crc32c_buf.restype = ctypes.c_uint32
+    lib.crc32c_buf.argtypes = [ctypes.c_char_p, ctypes.c_longlong,
+                               ctypes.c_uint32]
+    lib.crc32c_verify_batch.restype = ctypes.c_longlong
+    lib.crc32c_verify_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_void_p,
+        ctypes.c_longlong, ctypes.c_void_p, ctypes.c_void_p]
+    lib.crc32c_verify_spans.restype = ctypes.c_longlong
+    lib.crc32c_verify_spans.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
+        ctypes.c_void_p, ctypes.c_void_p]
     return lib
 
 
@@ -257,17 +271,37 @@ class _BatchDecodeNative:
             if offs[-1] else np.empty(0, np.uint8)
         return buf, offs
 
-    def page_decode(self, blobs, counts, cols):
+    def _verify_spans(self, buf, offs, nrows, crcs) -> bool:
+        """CRC32C-verify every row span of an already-joined frame
+        buffer against its stored checksum (integrity subsystem,
+        deferred-verify contract: the store skipped verification
+        because this decode pass rides the same join).  crc 0 = legacy
+        unchecksummed row, passes.  False on any mismatch — callers
+        return their corrupt sentinel and the generic (store-verified)
+        path takes over."""
+        exp = np.ascontiguousarray(crcs, dtype=np.uint32)
+        ok = np.empty(max(nrows, 1), dtype=np.uint8)
+        bad = self._lib.crc32c_verify_spans(
+            buf.ctypes.data if len(buf) else None, offs.ctypes.data,
+            nrows, exp.ctypes.data, ok.ctypes.data)
+        return bad == 0
+
+    def page_decode(self, blobs, counts, cols, crcs=None):
         """Decode columns of FRAMED ColumnStore row blobs (pack_vectors
         layout) — the ODP bulk page-in: one C pass per column over the
         whole row set, no per-row unpack.  ``cols``: (column_index,
-        is_double) pairs; column 0 is the timestamp vector.  Returns one
-        flat array per requested column (int64 or float64, rows adjacent
-        in blob order), or None if any framing/vector is corrupt (the
-        caller falls back to the per-chunk path, which raises usefully).
-        """
+        is_double) pairs; column 0 is the timestamp vector.  With
+        ``crcs``, every row blob is first CRC32C-verified against its
+        stored checksum on this call's own join (deferred store
+        verification).  Returns one flat array per requested column
+        (int64 or float64, rows adjacent in blob order), or None if any
+        checksum/framing/vector is corrupt (the caller falls back to
+        the per-chunk path, which raises usefully)."""
         nrows = len(blobs)
         buf, offs = self._frame_buf(blobs)
+        if crcs is not None and not self._verify_spans(buf, offs, nrows,
+                                                       crcs):
+            return None
         cnts = np.ascontiguousarray(counts, dtype=np.int64)
         starts = np.zeros(nrows, dtype=np.int64)
         np.cumsum(cnts[:-1], out=starts[1:])
@@ -285,17 +319,23 @@ class _BatchDecodeNative:
             outs.append(out[:total])
         return outs
 
-    def page_decode_into(self, blobs, counts, specs, out_starts) -> bool:
+    def page_decode_into(self, blobs, counts, specs, out_starts,
+                         crcs=None) -> bool:
         """Decode framed row blobs DIRECTLY into caller-allocated
         arrays: row k writes counts[k] values at flat index
         out_starts[k] of each spec's output.  ``specs``: (column_index,
         is_double, out_array) with out_array C-contiguous and of the
         matching dtype — the ODP cold path points these at the padded
-        [S, R] query batch so decode IS the batch assembly.  False on
+        [S, R] query batch so decode IS the batch assembly.  With
+        ``crcs``, rows are CRC32C-verified on this call's join BEFORE
+        any decode writes (deferred store verification).  False on
         corrupt input (outputs then hold partial garbage; callers must
         discard them and fall back)."""
         nrows = len(blobs)
         buf, offs = self._frame_buf(blobs)
+        if crcs is not None and not self._verify_spans(buf, offs, nrows,
+                                                       crcs):
+            return False
         cnts = np.ascontiguousarray(counts, dtype=np.int64)
         starts = np.ascontiguousarray(out_starts, dtype=np.int64)
         for col, dbl, out in specs:
@@ -346,7 +386,9 @@ class _InfluxNative:
     def gather(self, a: np.ndarray, starts: np.ndarray,
                ends: np.ndarray) -> "np.ndarray | None":
         """Concatenated a[starts[k]:ends[k]] bytes in ONE C pass
-        (replaces the numpy arange+repeat flat-index gather)."""
+        (replaces the numpy arange+repeat flat-index gather).  The C
+        side bounds-checks every span against len(a) and returns -1 on
+        a malformed one."""
         starts = np.ascontiguousarray(starts, np.int64)
         ends = np.ascontiguousarray(ends, np.int64)
         lens = ends - starts
@@ -354,7 +396,8 @@ class _InfluxNative:
             return None          # malformed span: match the C guard
         total = int(lens.sum())
         out = np.empty(total, np.uint8)
-        got = self._lib.gather_ranges(a.ctypes.data, starts.ctypes.data,
+        got = self._lib.gather_ranges(a.ctypes.data, len(a),
+                                      starts.ctypes.data,
                                       ends.ctypes.data, len(starts),
                                       out.ctypes.data)
         return out if got == total else None
@@ -369,8 +412,8 @@ class _InfluxNative:
         h1 = np.empty(n, np.uint64)
         h2 = np.empty(n, np.uint64)
         got = self._lib.head_hash128(
-            a.ctypes.data, starts.ctypes.data, ends.ctypes.data, n,
-            p1.ctypes.data, p2.ctypes.data, len(p1),
+            a.ctypes.data, len(a), starts.ctypes.data, ends.ctypes.data,
+            n, p1.ctypes.data, p2.ctypes.data, len(p1),
             h1.ctypes.data, h2.ctypes.data)
         return (h1, h2) if got == n else None
 
@@ -381,7 +424,8 @@ class _InfluxNative:
         starts = np.ascontiguousarray(starts, np.int64)
         ends = np.ascontiguousarray(ends, np.int64)
         rep = np.ascontiguousarray(rep, np.int64)
-        got = self._lib.verify_heads(a.ctypes.data, starts.ctypes.data,
+        got = self._lib.verify_heads(a.ctypes.data, len(a),
+                                     starts.ctypes.data,
                                      ends.ctypes.data, rep.ctypes.data,
                                      len(starts))
         if got < 0:
@@ -477,6 +521,41 @@ def influx_parser():
     Looked up lazily by gateway/influx.py (same reason as
     :func:`batch_decoder`)."""
     return _influx_parse
+
+
+def crc32c(buf, seed: int = 0) -> "int | None":
+    """CRC32C of a buffer via the C kernel, or None when the library is
+    unavailable (the integrity layer then uses its bit-identical Python
+    fallback).  Deliberately independent of :func:`enable`: checksums
+    must not change value because the codec hooks were toggled."""
+    lib = _load()
+    if lib is None:
+        return None
+    if not isinstance(buf, bytes):
+        buf = bytes(buf)
+    return int(lib.crc32c_buf(buf, len(buf), seed & 0xFFFFFFFF))
+
+
+def crc32c_verify(blobs, expected) -> "tuple[int, np.ndarray] | None":
+    """Batch CRC32C verify: ONE C call over a pointer array of blobs
+    against the per-blob expected checksums (integrity.chunk_crc's
+    never-zero mapping applied).  Returns (mismatch_count, ok bool
+    array), or None when the native library is unavailable.  This is
+    the ODP page-in read-back verifier: no join/copy of the blob bytes,
+    and the C side interleaves three crc32 instruction streams — the
+    naive per-blob formulation cost ~30% of a cold ODP scan, this one
+    ~2% (BASELINE.md)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(blobs)
+    ptrs = (ctypes.c_char_p * n)(*blobs)
+    lens = np.array(list(map(len, blobs)), dtype=np.int64)
+    exp = np.ascontiguousarray(expected, dtype=np.uint32)
+    ok = np.empty(max(n, 1), dtype=np.uint8)
+    bad = lib.crc32c_verify_batch(ptrs, lens.ctypes.data, n,
+                                  exp.ctypes.data, ok.ctypes.data)
+    return int(bad), ok[:n].astype(bool)
 
 
 def is_enabled() -> bool:

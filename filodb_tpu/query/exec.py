@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import dataclasses
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
@@ -36,6 +37,20 @@ class ExecContext:
     memstore: TimeSeriesMemStore
     query_context: QueryContext = dataclasses.field(default_factory=QueryContext)
     parallelism: int = 8
+    # quarantined-chunk exclusions noted by leaf scans anywhere in the
+    # plan tree (children run concurrently but share this ctx); the root
+    # folds the total into QueryStats so the API layer can emit a
+    # partial-data warning
+    _corrupt_excluded: int = 0
+    _corrupt_lock: object = dataclasses.field(
+        default_factory=threading.Lock, repr=False)
+
+    def note_corrupt_excluded(self, n: int) -> None:
+        with self._corrupt_lock:
+            self._corrupt_excluded += n
+
+    def corrupt_excluded(self) -> int:
+        return self._corrupt_excluded
 
 
 class PlanDispatcher:
@@ -80,6 +95,10 @@ class ExecPlan:
                 batches = t.apply(batches, ctx)
             self._enforce_limits(batches, ctx)
             stats = self._collect_stats(batches)
+            # quarantined-chunk exclusions accumulate on the shared ctx;
+            # the outermost plan returns last, so its result carries the
+            # whole tree's total for the partial-data warning
+            stats.corrupt_chunks_excluded = ctx.corrupt_excluded()
             return QueryResult(self.query_context.query_id, batches, stats)
         except QueryError:
             raise
@@ -181,6 +200,14 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         shard = ctx.memstore.get_shard(self.dataset, self.shard)
         lookup = shard.lookup_partitions(self.filters, self.start_ms,
                                          self.end_ms)
+        try:
+            return self._do_scan(ctx, shard, lookup)
+        finally:
+            # AFTER the scan, so corruption detected by this very query
+            # already counts toward its own partial-data warning
+            self._note_quarantined(ctx, shard, lookup.part_ids)
+
+    def _do_scan(self, ctx: ExecContext, shard, lookup) -> list:
         schema = None
         if lookup.first_schema_hash is not None:
             schema = shard.schemas.by_hash(lookup.first_schema_hash)
@@ -199,6 +226,26 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         tags, batch = shard.scan_batch(lookup.part_ids, self.start_ms,
                                        self.end_ms, column_id)
         return [RawBatch(tags, batch)]
+
+    def _note_quarantined(self, ctx: ExecContext, shard, part_ids) -> None:
+        """Partial-data tripwire: quarantined chunks among the scanned
+        series AND overlapping this query's time range mean the result
+        excludes data — now and on every re-query (quarantine persists
+        until cleared).  A corrupt chunk outside the window excluded
+        nothing from THIS result, so it must not flag it.  O(1) when
+        the quarantine is empty, the overwhelmingly common case."""
+        from filodb_tpu.integrity import QUARANTINE
+        if not QUARANTINE:
+            return
+        pks = []
+        for pid in part_ids:
+            try:
+                pks.append(shard.index.partkey(int(pid)))
+            except KeyError:
+                continue
+        n = QUARANTINE.count_overlapping(pks, self.start_ms, self.end_ms)
+        if n:
+            ctx.note_corrupt_excluded(n)
 
     # -- downsample-gauge & hist-max schema rewrites ------------------------
 

@@ -38,12 +38,17 @@ class QueryStats:
     series_scanned: int = 0
     shards_queried: int = 0
     dropped_series: int = 0
+    # quarantined (corrupt) chunks overlapping the scanned series: the
+    # result is PARTIAL and the API layers surface a warning
+    # (filodb_tpu/integrity quarantine exclusion)
+    corrupt_chunks_excluded: int = 0
 
     def merge(self, other: "QueryStats") -> None:
         self.samples_scanned += other.samples_scanned
         self.series_scanned += other.series_scanned
         self.shards_queried += other.shards_queried
         self.dropped_series += other.dropped_series
+        self.corrupt_chunks_excluded += other.corrupt_chunks_excluded
 
 
 class QueryError(Exception):

@@ -91,11 +91,14 @@ class ColumnStore:
     def read_raw_rows(self, dataset: str, shard: int,
                       partkeys: Sequence[bytes], start_time: int,
                       end_time: int,
-                      byte_cap: int | None = None) -> Optional[list[tuple]]:
+                      byte_cap: int | None = None,
+                      defer_verify: bool = False) -> Optional[list[tuple]]:
         """Raw FRAMED chunk rows for the ODP bulk page-in (see
-        persistence.DiskColumnStore.read_raw_rows for the row layout and
-        cap contract).  None = unsupported; callers fall back to the
-        per-partition :meth:`read_raw_partitions` path."""
+        persistence.DiskColumnStore.read_raw_rows for the row layout,
+        cap and integrity contracts; rows may carry a trailing stored
+        crc that callers index positionally or ignore).  None =
+        unsupported; callers fall back to the per-partition
+        :meth:`read_raw_partitions` path."""
         return None
 
     def scan_part_keys(self, dataset: str, shard: int) -> Iterator[PartKeyRecord]:

@@ -15,6 +15,14 @@ Chunk vectors are stored as one blob per chunkset: u16 vector count, then
 (u32 length, bytes) per encoded vector.  The encoded vectors themselves
 are the wire-compatible codec outputs (filodb_tpu/codecs), so a chunk
 read back from disk decodes through the exact same native fast paths.
+
+Integrity: every chunk row carries the CRC32C of its framed blob
+(``crc`` column), computed at write (flush/downsample) time and
+re-verified on every read-back (ODP page-in, backfill, batch
+downsampler).  A mismatching row is quarantined
+(filodb_tpu/integrity/) and DROPPED from the result — readers serve
+partial data with a warning, never bytes that fail their checksum.
+Rows with ``crc=0`` predate checksums and skip verification.
 """
 
 from __future__ import annotations
@@ -25,7 +33,9 @@ import struct
 import threading
 from typing import Iterator, Sequence
 
+from filodb_tpu import integrity
 from filodb_tpu.core.chunk import ChunkSet, ChunkSetInfo
+from filodb_tpu.integrity import CorruptVectorError
 from filodb_tpu.store.columnstore import ColumnStore, PartKeyRecord
 from filodb_tpu.store.metastore import MetaStore
 
@@ -198,6 +208,7 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
             ingestion_time INTEGER NOT NULL DEFAULT 0,
             schema_hash INTEGER NOT NULL DEFAULT 0,
             vectors BLOB NOT NULL,
+            crc INTEGER NOT NULL DEFAULT 0,
             PRIMARY KEY (dataset, shard, partkey, chunk_id)
         ) WITHOUT ROWID;
         CREATE INDEX IF NOT EXISTS chunks_by_itime
@@ -210,17 +221,30 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
             PRIMARY KEY (dataset, shard, partkey)
         ) WITHOUT ROWID;
         """)
+        try:  # migrate pre-checksum databases in place (crc=0 skips verify)
+            conn.execute(
+                "ALTER TABLE chunks ADD COLUMN crc INTEGER NOT NULL DEFAULT 0")
+        except sqlite3.OperationalError:
+            pass  # column already exists (fresh DDL above, or migrated)
         conn.commit()
 
     # ------------------------------------------------------------------ sink
 
     def write_chunks(self, dataset, shard, chunksets, ingestion_time=0) -> int:
         conn = self._conn()
+        rows = []
+        for cs in chunksets:
+            # checksum at encode/flush time: the blob is in cache right
+            # after packing, so the CRC pass is effectively free here
+            # compared to recomputing it at read time forever after
+            blob = pack_vectors(cs.vectors)
+            rows.append((dataset, shard, cs.partkey, cs.info.chunk_id,
+                         cs.info.num_rows, cs.info.start_time,
+                         cs.info.end_time, ingestion_time, cs.schema_hash,
+                         blob, integrity.chunk_crc(blob)))
         conn.executemany(
-            "INSERT OR REPLACE INTO chunks VALUES (?,?,?,?,?,?,?,?,?,?)",
-            [(dataset, shard, cs.partkey, cs.info.chunk_id, cs.info.num_rows,
-              cs.info.start_time, cs.info.end_time, ingestion_time,
-              cs.schema_hash, pack_vectors(cs.vectors)) for cs in chunksets])
+            "INSERT OR REPLACE INTO chunks VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+            rows)
         self._commit(conn)
         return len(chunksets)
 
@@ -290,13 +314,95 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
             self._in_batch_size = got
         return got
 
+    def _verify_rows(self, dataset, shard, rows: list) -> list[tuple]:
+        """Checksum-verify 8-tuple rows (…, vectors BLOB, stored crc)
+        from sqlite; returns the surviving rows UNSLICED (consumers
+        read positionally and ignore the trailing crc).  A mismatch
+        quarantines the chunk and DROPS the row (the reader serves
+        partial data, never unverified bytes); already-quarantined
+        chunks are excluded the same way on every re-read.
+
+        Hot path: ONE batched native CRC pass over the joined blobs —
+        the per-row formulation cost ~30% of an ODP cold scan, this one
+        costs <3% (BASELINE.md)."""
+        quarantine = integrity.QUARANTINE
+        if quarantine:
+            rows = [r for r in rows
+                    if not quarantine.is_quarantined(r[0], r[1])]
+        if not rows or not integrity.verify_enabled():
+            return rows
+        import operator
+
+        from filodb_tpu import native
+        exps = list(map(operator.itemgetter(7), rows))   # C-speed map
+        got = None
+        if min(exps):                        # crc=0 legacy rows: slow path
+            got = native.crc32c_verify(list(map(operator.itemgetter(6),
+                                                rows)), exps)
+        if got is None:
+            return self._verify_rows_slow(dataset, shard, rows)
+        bad, ok = got
+        from filodb_tpu.utils.observability import integrity_metrics
+        integrity_metrics()["chunks_verified"].inc(len(rows))
+        if not bad:
+            return rows
+        out = []
+        for i, r in enumerate(rows):
+            if ok[i]:
+                out.append(r)
+            else:
+                integrity.report_corrupt(CorruptVectorError(
+                    f"chunk checksum mismatch on read-back "
+                    f"(stored={r[7]:#010x})", partkey=r[0], chunk_id=r[1],
+                    dataset=dataset, shard=shard, blob=r[6],
+                    kind="checksum", start_time=r[3], end_time=r[4]))
+        return out
+
+    def _verify_rows_slow(self, dataset, shard, rows: list) -> list[tuple]:
+        """Per-row verify: the no-native fallback, and the path for row
+        sets containing legacy crc=0 (unverifiable) rows."""
+        out: list[tuple] = []
+        crc_fn = integrity.chunk_crc
+        verified = 0
+        for r in rows:
+            crc = r[7]
+            if crc:
+                verified += 1
+                if crc_fn(r[6]) != crc:
+                    integrity.report_corrupt(CorruptVectorError(
+                        f"chunk checksum mismatch on read-back "
+                        f"(stored={crc:#010x})", partkey=r[0],
+                        chunk_id=r[1], dataset=dataset, shard=shard,
+                        blob=r[6], kind="checksum", start_time=r[3],
+                        end_time=r[4]))
+                    continue
+            out.append(r)
+        if verified:
+            from filodb_tpu.utils.observability import integrity_metrics
+            integrity_metrics()["chunks_verified"].inc(verified)
+        return out
+
+    def _filter_quarantined(self, rows: list) -> list:
+        """Drop quarantined rows only (the deferred-verify path: the
+        native bulk decoder checksums the blobs on its own join)."""
+        quarantine = integrity.QUARANTINE
+        if not quarantine:
+            return rows
+        return [r for r in rows
+                if not quarantine.is_quarantined(r[0], r[1])]
+
     def read_raw_rows(self, dataset, shard, partkeys, start_time,
-                      end_time, byte_cap: int | None = None) -> list[tuple]:
+                      end_time, byte_cap: int | None = None,
+                      defer_verify: bool = False) -> list[tuple]:
         """Raw chunk rows (partkey, chunk_id, num_rows, start_time,
-        end_time, schema_hash, framed-vectors blob) for a partkey set,
-        ordered by (partkey, chunk_id), with NO blob unpacking — the ODP
-        bulk page-in hands the framed blobs straight to the native
-        page decoder (one C pass for the whole set).
+        end_time, schema_hash, framed-vectors blob, stored crc) for a
+        partkey set, ordered by (partkey, chunk_id), with NO blob
+        unpacking — the ODP bulk page-in hands the framed blobs straight
+        to the native page decoder (one C pass for the whole set).
+        Every blob is checksum-verified against its stored CRC32C;
+        corrupt and quarantined rows are dropped (see
+        :meth:`_verify_rows`); consumers index positionally and may
+        ignore the trailing crc.
 
         ``byte_cap``: stream-enforced blob-byte budget; crossing it
         raises :class:`ScanBytesExceeded` (bounded overshoot of one
@@ -306,9 +412,17 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
         ``partkeys=None`` scans the WHOLE (dataset, shard) in primary
         key order — no per-key binding or b-tree point lookups.  The ODP
         path picks this when paging in most of a shard (the cold-
-        dashboard shape); callers skip rows they did not ask for."""
+        dashboard shape); callers skip rows they did not ask for.
+
+        ``defer_verify=True``: skip the checksum pass (quarantined rows
+        are still dropped) — ONLY for callers that verify the stored
+        crc themselves before trusting a blob, i.e. the ODP bulk
+        page-in, whose native decoder checksums every span on the join
+        it already builds (native page_decode ``crcs=``)."""
         from filodb_tpu.store.columnstore import ScanBytesExceeded
 
+        check = self._filter_quarantined if defer_verify else \
+            (lambda rows: self._verify_rows(dataset, shard, rows))
         conn = self._conn()
         rows: list[tuple] = []
         seen = 0
@@ -323,7 +437,7 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
             if batch is None:
                 cur = conn.execute(
                     "SELECT partkey, chunk_id, num_rows, start_time, "
-                    "end_time, schema_hash, vectors FROM chunks "
+                    "end_time, schema_hash, vectors, crc FROM chunks "
                     "WHERE dataset=? AND shard=? "
                     "AND end_time>=? AND start_time<=? "
                     "ORDER BY partkey, chunk_id",
@@ -332,13 +446,13 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
                 ph = ",".join("?" * len(batch))
                 cur = conn.execute(
                     "SELECT partkey, chunk_id, num_rows, start_time, "
-                    "end_time, schema_hash, vectors FROM chunks "
+                    "end_time, schema_hash, vectors, crc FROM chunks "
                     f"WHERE dataset=? AND shard=? AND partkey IN ({ph}) "
                     "AND end_time>=? AND start_time<=? "
                     "ORDER BY partkey, chunk_id",
                     (dataset, shard, *batch, start_time, end_time))
             if byte_cap is None:
-                rows.extend(cur.fetchall())
+                rows.extend(check(cur.fetchall()))
                 continue
             while True:
                 got = cur.fetchmany(512)
@@ -348,7 +462,7 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
                 if seen > byte_cap:
                     raise ScanBytesExceeded(
                         f"raw-row read exceeded {byte_cap} bytes")
-                rows.extend(got)
+                rows.extend(check(got))
         return rows
 
     def read_raw_partitions(self, dataset, shard, partkeys, start_time,
@@ -364,16 +478,30 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
         for i in range(0, len(partkeys), lim):
             batch = partkeys[i:i + lim]
             ph = ",".join("?" * len(batch))
-            for pk, cid, nr, st, et, sh, blob in conn.execute(
-                    "SELECT partkey, chunk_id, num_rows, start_time, "
-                    "end_time, schema_hash, vectors FROM chunks "
-                    f"WHERE dataset=? AND shard=? AND partkey IN ({ph}) "
-                    "AND end_time>=? AND start_time<=? "
-                    "ORDER BY partkey, chunk_id",
-                    (dataset, shard, *batch, start_time, end_time)):
+            rows = conn.execute(
+                "SELECT partkey, chunk_id, num_rows, start_time, "
+                "end_time, schema_hash, vectors, crc FROM chunks "
+                f"WHERE dataset=? AND shard=? AND partkey IN ({ph}) "
+                "AND end_time>=? AND start_time<=? "
+                "ORDER BY partkey, chunk_id",
+                (dataset, shard, *batch, start_time, end_time)).fetchall()
+            for pk, cid, nr, st, et, sh, blob, _crc in \
+                    self._verify_rows(dataset, shard, rows):
+                try:
+                    vectors = unpack_vectors(blob)
+                except Exception as e:  # noqa: BLE001 — corrupt framing
+                    # a checksum-evading corruption (e.g. bit rot after
+                    # the CRC was recomputed) must quarantine, not crash
+                    # the whole page-in
+                    integrity.report_corrupt(CorruptVectorError(
+                        f"bad chunk framing: {e}", partkey=pk,
+                        chunk_id=cid, dataset=dataset, shard=shard,
+                        blob=blob, kind="decode", start_time=st,
+                        end_time=et))
+                    continue
                 by_pk.setdefault(pk, []).append(
                     ChunkSet(ChunkSetInfo(cid, nr, st, et), pk,
-                             unpack_vectors(blob), schema_hash=sh))
+                             vectors, schema_hash=sh))
         for pk in partkeys:
             css = by_pk.get(pk)
             if css:
@@ -389,14 +517,24 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
     def chunksets_with_ingestion_time(self, dataset, shard, start, end
                                       ) -> Iterator[tuple[int, ChunkSet]]:
         conn = self._conn()
-        for pk, cid, nr, st, et, itime, sh, blob in conn.execute(
-                "SELECT partkey, chunk_id, num_rows, start_time, end_time, "
-                "ingestion_time, schema_hash, vectors FROM chunks "
-                "WHERE dataset=? AND shard=? "
-                "AND ingestion_time BETWEEN ? AND ? ORDER BY partkey, chunk_id",
-                (dataset, shard, start, end)):
-            yield itime, ChunkSet(ChunkSetInfo(cid, nr, st, et), pk,
-                                  unpack_vectors(blob), schema_hash=sh)
+        # columns arranged so blob/crc sit at the indexes _verify_rows
+        # reads (6/7); itime rides behind at 8 — rows verify in
+        # fetchmany-sized batches through the same batched native CRC
+        # pass as every other read path, streaming the batch job
+        cur = conn.execute(
+            "SELECT partkey, chunk_id, num_rows, start_time, end_time, "
+            "schema_hash, vectors, crc, ingestion_time FROM chunks "
+            "WHERE dataset=? AND shard=? "
+            "AND ingestion_time BETWEEN ? AND ? ORDER BY partkey, chunk_id",
+            (dataset, shard, start, end))
+        while True:
+            got = cur.fetchmany(512)
+            if not got:
+                return
+            for pk, cid, nr, st, et, sh, blob, _crc, itime in \
+                    self._verify_rows(dataset, shard, got):
+                yield itime, ChunkSet(ChunkSetInfo(cid, nr, st, et), pk,
+                                      unpack_vectors(blob), schema_hash=sh)
 
     def scan_bytes(self, dataset, shard, partkeys, start_time, end_time) -> int:
         """Metadata-only byte estimate: no vector blobs leave sqlite.
@@ -424,6 +562,24 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
         return self._conn().execute(
             "SELECT COUNT(*) FROM chunks WHERE dataset=? AND shard=?",
             (dataset, shard)).fetchone()[0]
+
+    def list_shards(self, dataset: str) -> list[int]:
+        """Shards holding chunks for a dataset (offline verify scan)."""
+        return [int(r[0]) for r in self._conn().execute(
+            "SELECT DISTINCT shard FROM chunks WHERE dataset=? "
+            "ORDER BY shard", (dataset,))]
+
+    def scan_chunk_rows(self, dataset: str, shard: int
+                        ) -> Iterator[tuple[bytes, int, bytes, int]]:
+        """Every persisted (partkey, chunk_id, framed blob, stored crc)
+        of one shard, UNVERIFIED — the raw feed for the offline
+        ``verify-chunks`` scanner (integrity/scan.py), which must see
+        corrupt rows rather than have them dropped."""
+        for pk, cid, blob, crc in self._conn().execute(
+                "SELECT partkey, chunk_id, vectors, crc FROM chunks "
+                "WHERE dataset=? AND shard=? ORDER BY partkey, chunk_id",
+                (dataset, shard)):
+            yield pk, int(cid), blob, int(crc)
 
     def delete_part_keys(self, dataset: str, shard: int,
                          partkeys: Sequence[bytes]) -> int:

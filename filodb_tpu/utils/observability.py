@@ -43,6 +43,11 @@ class Counter:
     def value(self, **labels) -> float:
         return self._values.get(tuple(sorted(labels.items())), 0.0)
 
+    def total(self) -> float:
+        """Sum across every label set (admin summaries)."""
+        with self._lock:
+            return sum(self._values.values())
+
     def expose(self) -> list[str]:
         with self._lock:  # concurrent inc() may insert new label sets
             items = sorted(self._values.items())
@@ -73,6 +78,12 @@ class Gauge:
         if key in self._fns:
             return float(self._fns[key]())
         return self._values.get(key, 0.0)
+
+    def total(self) -> float:
+        """Sum across every label set (admin summaries)."""
+        with self._lock:
+            return sum(self._values.values()) + \
+                sum(fn() for fn in self._fns.values())
 
     def expose(self) -> list[str]:
         out = [f"# TYPE {self.name} gauge"]
@@ -187,6 +198,33 @@ class MetricsRegistry:
 
 
 REGISTRY = MetricsRegistry()
+
+
+def integrity_metrics() -> dict:
+    """Canonical integrity counters (filodb_tpu/integrity): one place
+    defines the metric names so the corruption funnel, the /metrics
+    exposition, and /admin/integrity can never drift apart.  Labels:
+    ``dataset``/``shard`` when the detection site knows them."""
+    return {
+        "checksum_failures": REGISTRY.counter(
+            "filodb_integrity_checksum_failures_total",
+            "chunk blobs whose stored CRC32C did not match on read-back"),
+        "decode_failures": REGISTRY.counter(
+            "filodb_integrity_decode_failures_total",
+            "chunk vectors whose native/numpy decode hit a -1 sentinel"),
+        "chunks_verified": REGISTRY.counter(
+            "filodb_integrity_chunks_verified_total",
+            "chunk blobs checksum-verified on page-in/read-back"),
+        "chunks_quarantined": REGISTRY.gauge(
+            "filodb_integrity_quarantined_chunks",
+            "chunks currently excluded from serving by the quarantine"),
+        "invariant_failures": REGISTRY.counter(
+            "filodb_integrity_invariant_failures_total",
+            "eviction/reclaim bookkeeping invariant violations"),
+        "partial_queries": REGISTRY.counter(
+            "filodb_integrity_partial_query_results_total",
+            "queries answered with a partial-data warning"),
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,492 @@
+"""Data-integrity subsystem: checksums, tripwires, quarantine, faults.
+
+End-to-end fault injection (filodb_tpu/integrity/faultinject.py): flip
+bytes in chunks persisted in the sqlite ColumnStore and in staged
+(in-memory frozen) chunk vectors, then prove the system gets LOUD and
+CONTAINED — structured CorruptVectorError diagnosis with part-key +
+chunk-id context, quarantine exclusion on re-query, partial-data
+warnings on the query path, integrity counters — and that an
+uncorrupted run trips none of it.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu import integrity, native
+from filodb_tpu.core.filters import ColumnFilter, Equals
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+from filodb_tpu.core.storeconfig import StoreConfig
+from filodb_tpu.integrity import (QUARANTINE, CorruptVectorError,
+                                  IntegrityInvariantError, chunk_crc,
+                                  crc32c_py)
+from filodb_tpu.integrity.faultinject import FaultInjector
+from filodb_tpu.integrity.scan import verify_chunks
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.store.persistence import DiskColumnStore, DiskMetaStore
+from filodb_tpu.utils.observability import integrity_metrics
+
+T0 = 1_700_000_000_000
+STEP = 10_000
+N_SERIES = 6
+N_ROWS = 40
+FILTERS = [ColumnFilter("_metric_", Equals("im"))]
+
+
+@pytest.fixture(autouse=True)
+def _clean_quarantine():
+    QUARANTINE.clear()
+    yield
+    QUARANTINE.clear()
+
+
+def _metric_totals() -> dict:
+    return {k: m.total() for k, m in integrity_metrics().items()}
+
+
+def _build_persisted(tmp_path, n_series=N_SERIES, n_rows=N_ROWS):
+    """Ingest + flush a small gauge dataset into a disk store."""
+    disk = DiskColumnStore(str(tmp_path / "chunks.db"))
+    meta = DiskMetaStore(str(tmp_path / "meta.db"))
+    ms = TimeSeriesMemStore(disk, meta)
+    sh = ms.setup("prom", DEFAULT_SCHEMAS, 0, StoreConfig())
+    b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], DatasetOptions())
+    ts = T0 + np.arange(n_rows, dtype=np.int64) * STEP
+    rng = np.random.default_rng(1)
+    for i in range(n_series):
+        b.add_series(ts, [rng.random(n_rows) + i],
+                     {"_metric_": "im", "inst": f"i{i}",
+                      "_ws_": "w", "_ns_": "n"})
+    for off, c in enumerate(b.containers()):
+        sh.ingest_container(c, off)
+    sh.flush_all(ingestion_time=1000)
+    return disk, meta, ms, sh
+
+
+def _cold_shard(disk, meta):
+    """Fresh memstore over the same disk store: index-only partitions,
+    every chunk pages in through the ODP read path."""
+    cold = TimeSeriesMemStore(disk, meta)
+    cold.setup("prom", DEFAULT_SCHEMAS, 0, StoreConfig())
+    assert cold.recover_index("prom", 0) == N_SERIES
+    return cold, cold.get_shard("prom", 0)
+
+
+def _scan(shard):
+    res = shard.lookup_partitions(FILTERS, 0, 2**62)
+    return shard.scan_batch(res.part_ids, 0, 2**62)
+
+
+# ---------------------------------------------------------------------------
+# CRC32C
+# ---------------------------------------------------------------------------
+
+
+class TestCrc32c:
+    def test_known_vector(self):
+        # the standard CRC32C check value
+        assert crc32c_py(b"123456789") == 0xE3069283
+
+    def test_native_matches_python(self):
+        if native.crc32c(b"") is None:
+            pytest.skip("native library unavailable")
+        for data in (b"", b"a", b"123456789", bytes(range(256)) * 33,
+                     b"\x00" * 1000):
+            assert native.crc32c(data) == crc32c_py(data), data[:16]
+
+    def test_chunk_crc_never_zero(self):
+        assert chunk_crc(b"") != 0  # 0 is the no-checksum marker
+
+
+# ---------------------------------------------------------------------------
+# Fault injector determinism
+# ---------------------------------------------------------------------------
+
+
+def test_faultinject_deterministic(tmp_path):
+    disk, meta, ms, sh = _build_persisted(tmp_path)
+    a = FaultInjector(42).corrupt_stored_chunk(disk, "prom", 0,
+                                               mode="flip")
+    # a second injector with the same seed picks the same victim
+    b = FaultInjector(42)
+    rows_pk, rows_cid = a
+    assert (b.rng.random(), FaultInjector(42).rng.random()) == \
+        (FaultInjector(42).rng.random(),) * 2
+    assert FaultInjector(42).flip_byte(b"abcdef") == \
+        FaultInjector(42).flip_byte(b"abcdef")
+    assert isinstance(rows_pk, bytes) and isinstance(rows_cid, int)
+
+
+# ---------------------------------------------------------------------------
+# Checksum tripwire on ODP page-in (flipped byte in a stored chunk)
+# ---------------------------------------------------------------------------
+
+
+def test_checksum_flip_detected_and_quarantined(tmp_path):
+    disk, meta, ms, sh = _build_persisted(tmp_path)
+    pk, cid = FaultInjector(3).corrupt_stored_chunk(disk, "prom", 0,
+                                                    mode="flip")
+    before = _metric_totals()
+    cold, shard = _cold_shard(disk, meta)
+    tags, batch = _scan(shard)
+    # the corrupt chunk is dropped at the store read: 5 of 6 series serve
+    assert len(tags) == N_SERIES - 1
+    assert QUARANTINE.is_quarantined(pk, cid)
+    after = _metric_totals()
+    assert after["checksum_failures"] - before["checksum_failures"] == 1
+    assert after["chunks_verified"] > before["chunks_verified"]
+    # quarantine detail carries the forensic context
+    (item,) = [d for d in QUARANTINE.items() if d["chunk_id"] == cid]
+    assert item["partkey"] == pk.hex()
+    assert "checksum" in item["reason"]
+    # re-query: exclusion via quarantine, NOT a second checksum failure
+    tags2, _ = _scan(shard)
+    assert len(tags2) == N_SERIES - 1
+    assert _metric_totals()["checksum_failures"] == \
+        after["checksum_failures"]
+
+
+def test_corrupt_stored_crc_only(tmp_path):
+    """Corrupting just the stored checksum (data intact) still trips the
+    verify — the pair is the integrity unit, either half failing is
+    loud."""
+    disk, meta, ms, sh = _build_persisted(tmp_path)
+    pk, cid = FaultInjector(5).corrupt_stored_chunk(disk, "prom", 0,
+                                                    mode="crc")
+    cold, shard = _cold_shard(disk, meta)
+    tags, _ = _scan(shard)
+    assert len(tags) == N_SERIES - 1
+    assert QUARANTINE.is_quarantined(pk, cid)
+
+
+# ---------------------------------------------------------------------------
+# Decode tripwire (corruption that EVADES the checksum)
+# ---------------------------------------------------------------------------
+
+
+def test_fixed_crc_truncation_hits_decode_tripwire(tmp_path):
+    """fix_crc=True recomputes the checksum over the corrupted blob, so
+    the CRC verify passes and the decode/framing tripwires must catch
+    it — the defense-in-depth layer."""
+    disk, meta, ms, sh = _build_persisted(tmp_path)
+    pk, cid = FaultInjector(11).corrupt_stored_chunk(
+        disk, "prom", 0, mode="truncate", fix_crc=True)
+    before = _metric_totals()
+    cold, shard = _cold_shard(disk, meta)
+    tags, batch = _scan(shard)
+    # healthy series still serve; the corrupt chunk's rows never reach
+    # the result (its series may still appear, with zero rows)
+    assert len(tags) >= N_SERIES - 1
+    assert int(np.asarray(batch.row_counts)[:len(tags)].sum()) == \
+        (N_SERIES - 1) * N_ROWS
+    assert QUARANTINE.is_quarantined(pk, cid)
+    after = _metric_totals()
+    assert after["checksum_failures"] == before["checksum_failures"]
+    assert after["decode_failures"] > before["decode_failures"]
+    # the bulk page-decode sentinel was counted, not silently discarded
+    assert shard.stats.page_decode_corrupt >= 1
+
+
+# ---------------------------------------------------------------------------
+# Staging (in-memory frozen chunk) corruption: structured error
+# ---------------------------------------------------------------------------
+
+
+def test_staged_corruption_structured_error():
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prom", DEFAULT_SCHEMAS, 0)
+    part = sh.create_partition("gauge", {"_metric_": "im", "inst": "i0",
+                                         "_ws_": "w", "_ns_": "n"}, T0)
+    for k in range(20):
+        part.ingest(T0 + k * STEP, (float(k),))
+    part.switch_buffers()
+    cid = FaultInjector(7).corrupt_staged_chunk(part, chunk_index=0,
+                                                mode="wire")
+    with pytest.raises(CorruptVectorError) as ei:
+        part._decoded_chunk(part.chunks[0])
+    msg = str(ei.value)
+    # the structured diagnosis: part-key AND chunk id in the message
+    assert part.partkey.hex()[:32] in msg
+    assert str(cid) in msg
+    assert "partkey=" in msg and "chunk_id=" in msg
+    assert ei.value.window is not None          # bounded hexdump window
+    # the serving path skips it: quarantine + shard stats, not an error
+    ts, vals = part.read_range(0, 2**62)
+    assert len(ts) == 0
+    assert QUARANTINE.is_quarantined(part.partkey, cid)
+    assert sh.stats.chunks_corrupt >= 1
+    assert sh.stats.chunks_quarantined == 1
+    # a healthy sibling partition is untouched
+    part2 = sh.create_partition("gauge", {"_metric_": "im", "inst": "i1",
+                                          "_ws_": "w", "_ns_": "n"}, T0)
+    for k in range(20):
+        part2.ingest(T0 + k * STEP, (float(k),))
+    part2.switch_buffers()
+    ts2, _ = part2.read_range(0, 2**62)
+    assert len(ts2) == 20
+
+
+def test_staged_flip_caught_by_decode_or_serves_cleanly():
+    """A random single-bit flip in an encoded vector either breaks the
+    decode (-> structured error path) or decodes to different values —
+    the checksum layer exists precisely because decode alone cannot
+    catch everything.  Either way: NO crash, no silent 'missing data'
+    page miss."""
+    ms = TimeSeriesMemStore()
+    sh = ms.setup("prom", DEFAULT_SCHEMAS, 0)
+    part = sh.create_partition("gauge", {"_metric_": "im", "inst": "i0",
+                                         "_ws_": "w", "_ns_": "n"}, T0)
+    for k in range(50):
+        part.ingest(T0 + k * STEP, (float(k) * 0.7,))
+    part.switch_buffers()
+    FaultInjector(13).corrupt_staged_chunk(part, chunk_index=0)
+    ts, vals = part.read_range(0, 2**62)   # must not raise
+    assert len(ts) in (0, 50)
+
+
+# ---------------------------------------------------------------------------
+# Eviction/reclaim invariants: fail the shard, never serve stale buffers
+# ---------------------------------------------------------------------------
+
+
+def test_paged_lru_invariant_check():
+    from filodb_tpu.memstore.odp import _PagedPartitions
+    p = _PagedPartitions(1 << 20)
+    p.put(1, "x", 100)
+    p.put(2, "y", 200)
+    p.check_invariants()               # clean: no raise
+    p._bytes += 7                      # simulate accounting drift
+    with pytest.raises(IntegrityInvariantError):
+        p.check_invariants()
+
+
+def test_eviction_invariant_failure_fails_shard(tmp_path):
+    disk, meta, ms, sh = _build_persisted(tmp_path)
+    cold, shard = _cold_shard(disk, meta)
+    _scan(shard)                       # page everything in
+    assert len(shard.paged) == N_SERIES
+    # re-materialize one partition as live so there is something to evict
+    rec_tags = {"_metric_": "im", "inst": "i0", "_ws_": "w", "_ns_": "n"}
+    part = shard.create_partition("gauge", rec_tags, T0)
+    part.ingest(T0 + N_ROWS * STEP, (1.0,))
+    shard.paged._bytes += 13           # corrupt the reclaim bookkeeping
+    with pytest.raises(IntegrityInvariantError):
+        shard.evict_partitions(1)
+    assert shard.integrity_failed is not None
+    # the shard now refuses to serve rather than risk stale buffers
+    with pytest.raises(IntegrityInvariantError):
+        _scan(shard)
+    with pytest.raises(IntegrityInvariantError):
+        shard.lookup_partitions(FILTERS, 0, 2**62)
+
+
+# ---------------------------------------------------------------------------
+# Query path: partial-data warning + /admin/integrity + re-query exclusion
+# ---------------------------------------------------------------------------
+
+
+def _http_get(port, path, **params):
+    import urllib.parse
+    qs = urllib.parse.urlencode(params)
+    url = f"http://127.0.0.1:{port}{path}" + (f"?{qs}" if qs else "")
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, dict(resp.headers), json.loads(resp.read())
+
+
+def test_query_partial_data_warning_and_admin_endpoint(tmp_path):
+    from filodb_tpu.coordinator.planner import SingleClusterPlanner
+    from filodb_tpu.http.server import DatasetBinding, FiloHttpServer
+    from filodb_tpu.parallel.shardmap import ShardMapper, ShardStatus
+
+    disk, meta, ms, sh = _build_persisted(tmp_path)
+    pk, cid = FaultInjector(3).corrupt_stored_chunk(disk, "prom", 0,
+                                                    mode="flip")
+    cold, shard = _cold_shard(disk, meta)
+    mapper = ShardMapper(1)
+    mapper.register_node([0], "local")
+    mapper.update_status(0, ShardStatus.ACTIVE)
+    planner = SingleClusterPlanner("prom", mapper, DatasetOptions(),
+                                   spread_default=0)
+    srv = FiloHttpServer()
+    srv.bind_dataset(DatasetBinding("prom", cold, planner))
+    port = srv.start()
+    try:
+        args = {"query": "im", "start": T0 // 1000,
+                "end": (T0 + (N_ROWS - 1) * STEP) // 1000, "step": "10s"}
+        status, headers, body = _http_get(
+            port, "/promql/prom/api/v1/query_range", **args)
+        assert status == 200 and body["status"] == "success"
+        # the first query detects + already warns: partial, not silence
+        assert any("corrupt" in w for w in body.get("warnings", ())), body
+        assert headers.get("X-FiloDB-Partial-Data") == "true"
+        assert len(body["data"]["result"]) == N_SERIES - 1
+        # re-query: quarantine exclusion, warning persists
+        status, headers, body = _http_get(
+            port, "/promql/prom/api/v1/query_range", **args)
+        assert any("corrupt" in w for w in body.get("warnings", ()))
+        assert headers.get("X-FiloDB-Partial-Data") == "true"
+        # the integrity counters are visible via /admin/integrity
+        status, _h, admin = _http_get(port, "/admin/integrity")
+        assert status == 200
+        data = admin["data"]
+        assert data["counters"]["checksum_failures"] >= 1
+        assert data["quarantine"]["quarantined_chunks"] >= 1
+        assert any(d["chunk_id"] == cid for d in data["quarantined"])
+        (row,) = data["shards"]["prom"]
+        assert row["shard"] == 0
+        assert row["paged_cache_invariants"] == "ok"
+        assert row["integrity_failed"] is None
+    finally:
+        srv.shutdown()
+
+
+def test_checksum_detection_reaches_shard_stats(tmp_path):
+    """Store-level detections must reach the owning shard's stats (the
+    tentpole's 'counted in shard stats'), not just global counters."""
+    disk, meta, ms, sh = _build_persisted(tmp_path)
+    pk, cid = FaultInjector(3).corrupt_stored_chunk(disk, "prom", 0,
+                                                    mode="flip")
+    cold, shard = _cold_shard(disk, meta)
+    epoch0 = shard.removal_epoch
+    _scan(shard)
+    assert shard.stats.chunks_corrupt >= 1
+    assert shard.stats.chunks_quarantined == 1
+    # grid plans staged from the chunk must revalidate too
+    assert shard.removal_epoch > epoch0
+
+
+def test_verify_switch_actually_disables_bulk_path(tmp_path):
+    """FILODB_INTEGRITY_VERIFY=0 / set_verify(False) must disable the
+    deferred decoder-side verification too — the A/B overhead
+    measurement depends on the OFF arm being genuinely off."""
+    disk, meta, ms, sh = _build_persisted(tmp_path)
+    pk, cid = FaultInjector(3).corrupt_stored_chunk(disk, "prom", 0,
+                                                    mode="flip")
+    integrity.set_verify(False)
+    try:
+        cold, shard = _cold_shard(disk, meta)
+        tags, _ = _scan(shard)
+        # verification off: the corrupt chunk sails through undetected
+        assert shard.stats.page_decode_corrupt == 0
+        assert not QUARANTINE
+    finally:
+        integrity.set_verify(True)
+
+
+def test_partial_warning_scoped_to_query_time_range(tmp_path):
+    """A quarantined chunk OUTSIDE the queried window excluded nothing
+    from the result — it must not flag that query as partial."""
+    disk, meta, ms, sh = _build_persisted(tmp_path)
+    pk, cid = FaultInjector(3).corrupt_stored_chunk(disk, "prom", 0,
+                                                    mode="flip")
+    cold, shard = _cold_shard(disk, meta)
+    _scan(shard)                       # detect + quarantine (with range)
+    assert QUARANTINE.is_quarantined(pk, cid)
+    from filodb_tpu.query.exec import ExecContext, MultiSchemaPartitionsExec
+    far = T0 + 10 * 24 * 3600 * 1000   # window far past all data
+    plan = MultiSchemaPartitionsExec("prom", 0, FILTERS, far,
+                                     far + 60_000)
+    res = plan.execute(ExecContext(cold))
+    assert res.stats.corrupt_chunks_excluded == 0
+    # ...but a window overlapping the chunk IS flagged
+    plan = MultiSchemaPartitionsExec("prom", 0, FILTERS, 0, 2**61)
+    res = plan.execute(ExecContext(cold))
+    assert res.stats.corrupt_chunks_excluded == 1
+
+
+def test_clean_run_trips_nothing(tmp_path):
+    """The zero-false-positive guarantee: a full ingest -> flush ->
+    cold page-in -> query cycle with NO injected fault must not bump a
+    single failure counter or quarantine anything."""
+    before = _metric_totals()
+    disk, meta, ms, sh = _build_persisted(tmp_path)
+    cold, shard = _cold_shard(disk, meta)
+    tags, batch = _scan(shard)
+    assert len(tags) == N_SERIES
+    assert not QUARANTINE
+    after = _metric_totals()
+    for key in ("checksum_failures", "decode_failures",
+                "invariant_failures", "partial_queries"):
+        assert after[key] == before[key], key
+    assert after["chunks_verified"] > before["chunks_verified"]
+    assert shard.stats.chunks_corrupt == 0
+    assert shard.stats.page_decode_corrupt == 0
+
+
+# ---------------------------------------------------------------------------
+# Offline verify-chunks scan + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_verify_chunks_reports_counts(tmp_path):
+    disk, meta, ms, sh = _build_persisted(tmp_path)
+    report = verify_chunks(disk, "prom", deep=True)
+    assert report["shards"][0]["chunks"] == N_SERIES
+    assert report["shards"][0]["passed"] == N_SERIES
+    assert report["total_failed"] == 0
+    pk, cid = FaultInjector(9).corrupt_stored_chunk(disk, "prom", 0,
+                                                    mode="flip")
+    report = verify_chunks(disk, "prom", deep=False)
+    assert report["total_failed"] == 1
+    assert report["shards"][0]["failed"] == 1
+    assert report["shards"][0]["passed"] == N_SERIES - 1
+    (failure,) = report["shards"][0]["failures"]
+    assert "checksum" in failure and str(cid) in failure
+
+
+def test_verify_chunks_cli(tmp_path, capsys):
+    from filodb_tpu import cli
+    _build_persisted(tmp_path)
+    rc = cli.main(["verify-chunks", "--data-dir", str(tmp_path),
+                   "--dataset", "prom"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0 and report["total_failed"] == 0
+    disk = DiskColumnStore(str(tmp_path / "chunks.db"))
+    FaultInjector(9).corrupt_stored_chunk(disk, "prom", 0, mode="flip")
+    rc = cli.main(["verify-chunks", "--data-dir", str(tmp_path),
+                   "--dataset", "prom", "--deep"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1 and report["total_failed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Satellites: native span-helper bounds + influx zero-length heads
+# ---------------------------------------------------------------------------
+
+
+def test_native_span_helper_bounds():
+    if not native.enable():
+        pytest.skip("native library unavailable")
+    npr = native.influx_parser()
+    a = np.frombuffer(b"abcdef", np.uint8)
+    # out-of-bounds spans return the -1 sentinel (None), never read OOB
+    assert npr.gather(a, np.array([0]), np.array([99])) is None
+    assert npr.gather(a, np.array([-1]), np.array([3])) is None
+    assert npr.gather(a, np.array([0, 3]), np.array([3, 6])) is not None
+    p1 = np.arange(1, 65, dtype=np.uint64)
+    p2 = np.arange(2, 66, dtype=np.uint64)
+    assert npr.head_hashes(a, np.array([-1]), np.array([2]), p1, p2) is None
+    assert npr.head_hashes(a, np.array([3]), np.array([7]), p1, p2) is None
+    assert npr.head_hashes(a, np.array([0]), np.array([6]), p1, p2) \
+        is not None
+    assert npr.verify(a, np.array([0]), np.array([99]),
+                      np.array([0])) is None
+    assert npr.verify(a, np.array([0, 0]), np.array([3, 3]),
+                      np.array([0, 0])) is True
+
+
+def test_influx_zero_length_head_falls_back():
+    from filodb_tpu.gateway.influx import parse_batch_columns, parse_lines_fast
+    good = "m,t=a v=1.5 1700000000000000000\n"
+    assert parse_batch_columns(good * 3) is not None
+    # a line whose head is empty (leading space) must reject the batch:
+    # np.add.reduceat would diverge from the C head_hash128 on a
+    # zero-length segment (ADVICE r5 finding 3)
+    bad = good + " x=1.5 1700000000000000000\n"
+    assert parse_batch_columns(bad) is None
+    # the per-line fallback still parses the healthy lines
+    recs = parse_lines_fast(good * 2)
+    assert len(recs) == 2 and recs[0].measurement == "m"

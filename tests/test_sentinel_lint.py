@@ -1,0 +1,187 @@
+"""Lint: native decode -1 sentinels must never be silently discarded.
+
+Every native decode entry point reports corruption through an in-band
+sentinel (-1 / None / False) instead of raising.  ISSUE 1's tentpole
+turns those sentinels into structured CorruptVectorError diagnoses —
+this AST lint keeps FUTURE call-sites honest: a call whose sentinel
+return is discarded (bare expression statement) or assigned but never
+compared/branched on in the same function fails the build, unless the
+line carries an explicit ``# sentinel-ok: <reason>`` suppression.
+
+Two classes of call-site are linted:
+- raw ctypes calls (``self._lib.<fn>`` / ``lib.<fn>``) to functions
+  whose C return is a -1 sentinel;
+- adapter-protocol methods that RETURN sentinels instead of raising
+  (``nb.page_decode`` -> None, ``npr.gather`` -> None, ...).
+"""
+
+import ast
+import pathlib
+
+ROOT = pathlib.Path(__file__).resolve().parents[1] / "filodb_tpu"
+
+# raw C functions with a -1 (or negative) corruption/overflow sentinel
+RAW_SENTINEL_FNS = {
+    "np_unpack", "np_packed_end", "dd_decode", "xor_unpack",
+    "ll_encode_batch", "dbl_encode_batch", "ll_decode_batch",
+    "dbl_decode_batch", "page_decode_column", "influx_parse_batch",
+    "gather_ranges", "head_hash128", "verify_heads",
+}
+# adapter methods returning None/False/INVALID sentinels; keyed by the
+# receiver names they are conventionally bound to (keeps generic names
+# like `gather` from matching unrelated code)
+ADAPTER_SENTINEL_FNS = {
+    "page_decode": {"nb"},
+    "page_decode_into": {"nb"},
+    "gather": {"npr"},
+    "head_hashes": {"npr"},
+    "verify": {"npr"},
+    "parse": {"npr", "nparse"},
+}
+
+
+def _receiver_name(func: ast.expr):
+    """For a Call func like a.b.c(...), the names involved."""
+    if not isinstance(func, ast.Attribute):
+        return None, None
+    attr = func.attr
+    v = func.value
+    if isinstance(v, ast.Name):
+        return attr, v.id
+    if isinstance(v, ast.Attribute):
+        return attr, v.attr
+    return attr, None
+
+
+def _is_sentinel_call(node: ast.Call):
+    attr, recv = _receiver_name(node.func)
+    if attr is None:
+        return False
+    if attr in RAW_SENTINEL_FNS and recv in ("_lib", "lib"):
+        return True
+    if attr in ADAPTER_SENTINEL_FNS and recv in ADAPTER_SENTINEL_FNS[attr]:
+        return True
+    return False
+
+
+def _names_in(node) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _guard_names(func_node) -> set:
+    """Names used anywhere in the function inside a comparison, boolean
+    test, or branch condition — i.e. names whose value IS checked."""
+    used = set()
+    for n in ast.walk(func_node):
+        if isinstance(n, ast.Compare):
+            used |= _names_in(n)
+        elif isinstance(n, (ast.If, ast.While, ast.IfExp)):
+            used |= _names_in(n.test)
+        elif isinstance(n, ast.Assert):
+            used |= _names_in(n.test)
+        elif isinstance(n, ast.BoolOp):
+            used |= _names_in(n)
+        elif isinstance(n, ast.UnaryOp) and isinstance(n.op, ast.Not):
+            used |= _names_in(n)
+    return used
+
+
+def _check_function(func_node, src_lines, path, violations):
+    guards = _guard_names(func_node)
+    for stmt in ast.walk(func_node):
+        if not isinstance(stmt, ast.stmt):
+            continue
+        calls = [n for n in ast.walk(stmt)
+                 if isinstance(n, ast.Call) and _is_sentinel_call(n)]
+        # only handle calls whose NEAREST enclosing statement is stmt
+        # (avoid double-reporting through nested statements)
+        for call in calls:
+            inner = [s for s in ast.walk(stmt)
+                     if isinstance(s, ast.stmt) and s is not stmt
+                     and call in ast.walk(s)]
+            if inner:
+                continue
+            line = src_lines[call.lineno - 1]
+            if "# sentinel-ok" in line:
+                continue
+            where = f"{path.relative_to(ROOT.parent)}:{call.lineno}"
+            attr, _ = _receiver_name(call.func)
+            if isinstance(stmt, (ast.If, ast.While)) and \
+                    call in ast.walk(stmt.test):
+                continue                      # branched on directly
+            if isinstance(stmt, (ast.Raise, ast.Assert)):
+                continue                      # raising with it
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                names = set()
+                for t in targets:
+                    names |= _names_in(t)
+                if names & guards:
+                    continue                  # assigned, then checked
+                violations.append(
+                    f"{where}: result of {attr}() assigned to "
+                    f"{sorted(names)} but never compared/branched on in "
+                    f"this function — a -1 sentinel would be silently "
+                    f"discarded")
+                continue
+            if isinstance(stmt, ast.Return) and isinstance(
+                    stmt.value, (ast.IfExp, ast.Compare, ast.BoolOp)):
+                continue                      # returns a checked form
+            violations.append(
+                f"{where}: result of {attr}() is discarded without "
+                f"raising or counting (bare use); check the sentinel or "
+                f"annotate '# sentinel-ok: <reason>'")
+
+
+def test_native_decode_sentinels_are_checked():
+    violations = []
+    for path in sorted(ROOT.rglob("*.py")):
+        src = path.read_text()
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:  # pragma: no cover - broken file
+            violations.append(f"{path}: unparseable: {e}")
+            continue
+        src_lines = src.splitlines()
+        funcs = [n for n in ast.walk(tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fn in funcs:
+            _check_function(fn, src_lines, path, violations)
+    assert not violations, \
+        "native decode sentinel discarded at:\n  " + "\n  ".join(violations)
+
+
+def test_lint_catches_a_discarded_sentinel():
+    """The lint itself must actually fire on the bad pattern."""
+    bad = (
+        "def f(self, buf):\n"
+        "    self._lib.dd_decode(buf, 1, 2, 3, None, 0)\n"
+    )
+    violations = []
+    tree = ast.parse(bad)
+    _check_function(tree.body[0], bad.splitlines(),
+                    ROOT / "fake.py", violations)
+    assert len(violations) == 1
+    bad2 = (
+        "def f(self, buf):\n"
+        "    got = self._lib.dd_decode(buf, 1, 2, 3, None, 0)\n"
+        "    return got\n"
+    )
+    violations = []
+    tree = ast.parse(bad2)
+    _check_function(tree.body[0], bad2.splitlines(),
+                    ROOT / "fake.py", violations)
+    assert len(violations) == 1
+    good = (
+        "def f(self, buf):\n"
+        "    got = self._lib.dd_decode(buf, 1, 2, 3, None, 0)\n"
+        "    if got < 0:\n"
+        "        raise ValueError('corrupt')\n"
+        "    return got\n"
+    )
+    violations = []
+    tree = ast.parse(good)
+    _check_function(tree.body[0], good.splitlines(),
+                    ROOT / "fake.py", violations)
+    assert violations == []
