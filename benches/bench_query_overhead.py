@@ -1,0 +1,100 @@
+"""Tracing/metrics overhead bench: full query path, host-side.
+
+Measures the end-to-end latency of a planner->ExecPlan->JSON query loop
+(the path ISSUE 2's tracing instrumented: spans on every plan node,
+per-stage stats accumulation, request histogram).  The acceptance bar
+is <= 3% median overhead vs the untraced seed — record before/after in
+BASELINE.md.
+
+Env: FILODB_OVH_SERIES (default 512), FILODB_OVH_ITERS (default 60).
+"""
+
+import os
+import statistics
+import sys
+import pathlib
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np  # noqa: E402
+
+from benches.common import emit, force_cpu_x64, log  # noqa: E402
+
+force_cpu_x64()
+
+from filodb_tpu.core.record import RecordBuilder, decode_container  # noqa: E402
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions  # noqa: E402
+from filodb_tpu.coordinator.planner import SingleClusterPlanner  # noqa: E402
+from filodb_tpu.http.model import to_prom_matrix  # noqa: E402
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore  # noqa: E402
+from filodb_tpu.parallel.shardmap import ShardMapper, ShardStatus  # noqa: E402
+from filodb_tpu.promql.parser import query_range_to_logical_plan  # noqa: E402
+from filodb_tpu.query.exec import ExecContext  # noqa: E402
+from filodb_tpu.query.model import QueryContext  # noqa: E402
+
+N_SERIES = int(os.environ.get("FILODB_OVH_SERIES", 512))
+ITERS = int(os.environ.get("FILODB_OVH_ITERS", 60))
+BASE = 1_700_000_000_000
+STEP = 10_000
+N_ROWS = 360
+
+
+def main():
+    num_shards = 4
+    mapper = ShardMapper(num_shards)
+    mapper.register_node(range(num_shards), "local")
+    ms = TimeSeriesMemStore()
+    for s in range(num_shards):
+        mapper.update_status(s, ShardStatus.ACTIVE)
+        ms.setup("prom", DEFAULT_SCHEMAS, s)
+    rng = np.random.default_rng(0)
+    b = RecordBuilder(DEFAULT_SCHEMAS["prom-counter"], DatasetOptions(),
+                      container_size=4 << 20)
+    ts = BASE + np.arange(N_ROWS, dtype=np.int64) * STEP
+    log(f"ingesting {N_SERIES} series x {N_ROWS} rows...")
+    for i in range(N_SERIES):
+        vals = np.cumsum(rng.random(N_ROWS))
+        b.add_series(ts, [vals], {"__name__": "ovh_total",
+                                  "instance": f"i{i}", "_ws_": "demo",
+                                  "_ns_": "App-0"})
+    spread = 2
+    for off, c in enumerate(b.containers()):
+        per_shard = {}
+        for rec in decode_container(c, DEFAULT_SCHEMAS):
+            shard = mapper.ingestion_shard(rec.shard_hash, rec.part_hash,
+                                           spread) % num_shards
+            per_shard.setdefault(shard, []).append(rec)
+        for shard, recs in per_shard.items():
+            ms.get_shard("prom", shard).ingest(recs, off)
+
+    planner = SingleClusterPlanner("prom", mapper, DatasetOptions(),
+                                   spread_default=spread)
+    query = 'sum(rate(ovh_total{_ws_="demo",_ns_="App-0"}[2m]))'
+    start, end = BASE + 600_000, BASE + 3_000_000
+
+    def once():
+        lp = query_range_to_logical_plan(query, start, STEP, end)
+        qctx = QueryContext(submit_time_ms=int(time.time() * 1000))
+        ep = planner.materialize(lp, qctx)
+        res = ep.execute(ExecContext(ms, qctx))
+        return to_prom_matrix(res)
+
+    body = once()  # warm compile/caches
+    assert body["data"]["result"], "query returned nothing"
+    lat = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        once()
+        lat.append(time.perf_counter() - t0)
+    med = statistics.median(lat)
+    p90 = sorted(lat)[int(0.9 * len(lat))]
+    samples = N_SERIES * (end - start) // STEP
+    log(f"median {med * 1e3:.2f} ms  p90 {p90 * 1e3:.2f} ms  "
+        f"({samples / med / 1e6:.1f}M samples/s)")
+    emit("query_overhead_median", med * 1e3, "ms",
+         p90_ms=round(p90 * 1e3, 3), iters=ITERS, series=N_SERIES)
+
+
+if __name__ == "__main__":
+    main()

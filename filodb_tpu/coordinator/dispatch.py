@@ -12,6 +12,7 @@ memstore and returns the serialized result.
 from __future__ import annotations
 
 import json
+import time
 import urllib.request
 from typing import Callable, Optional
 
@@ -19,47 +20,106 @@ from filodb_tpu.query.exec import ExecContext, PlanDispatcher
 from filodb_tpu.query.model import QueryError, QueryResult
 from filodb_tpu.query.wire import (deserialize_plan, deserialize_result,
                                    serialize_plan, serialize_result)
+from filodb_tpu.utils.observability import TRACER
+
+TRACE_HEADER = "X-FiloDB-Trace-Id"
+PARENT_SPAN_HEADER = "X-FiloDB-Parent-Span"
 
 
 class HttpPlanDispatcher(PlanDispatcher):
-    """Ships a leaf plan to ``endpoint`` and returns its result."""
+    """Ships a leaf plan to ``endpoint`` and returns its result.
+
+    Trace context crosses the process boundary twice over: the
+    ``trace_id`` rides the execplan wire dict (QueryContext field) AND
+    the HTTP headers; the data node returns its spans with the result
+    so the coordinator's TraceStore holds ONE stitched tree."""
 
     def __init__(self, endpoint: str, timeout_s: float = 60.0):
         self.endpoint = endpoint.rstrip("/")
         self.timeout_s = timeout_s
 
     def dispatch(self, plan, ctx: ExecContext) -> QueryResult:
-        body = json.dumps(serialize_plan(plan)).encode()
-        req = urllib.request.Request(
-            f"{self.endpoint}/execplan", data=body, method="POST",
-            headers={"Content-Type": "application/json"})
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-                payload = json.loads(resp.read())
-        except urllib.error.HTTPError as e:
+        tid = plan.query_context.trace_id or ctx.query_context.trace_id \
+            or TRACER.current_trace_id()
+        if tid and not plan.query_context.trace_id:
+            plan.query_context.trace_id = tid
+        with TRACER.span("dispatch.http", endpoint=self.endpoint,
+                         plan=type(plan).__name__,
+                         shard=getattr(plan, "shard", "")) as sp:
+            t0 = time.perf_counter()
+            body = json.dumps(serialize_plan(plan)).encode()
+            ser_s = time.perf_counter() - t0
+            headers = {"Content-Type": "application/json"}
+            if tid:
+                headers[TRACE_HEADER] = tid
+                headers[PARENT_SPAN_HEADER] = sp.span_id
+            req = urllib.request.Request(
+                f"{self.endpoint}/execplan", data=body, method="POST",
+                headers=headers)
             try:
-                err = json.loads(e.read()).get("error", "")
-            except Exception:
-                err = f"HTTP {e.code}"
-            raise QueryError(plan.query_context.query_id,
-                             f"remote dispatch to {self.endpoint} failed: "
-                             f"{err}") from e
-        return deserialize_result(payload)
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout_s) as resp:
+                    payload = json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                try:
+                    err = json.loads(e.read()).get("error", "")
+                except Exception:
+                    err = f"HTTP {e.code}"
+                raise QueryError(plan.query_context.query_id,
+                                 f"remote dispatch to {self.endpoint} "
+                                 f"failed: {err}") from e
+            t1 = time.perf_counter()
+            spans = payload.get("spans") if isinstance(payload, dict) else None
+            if tid and spans:
+                try:
+                    from filodb_tpu.utils.forensics import TRACE_STORE
+                    TRACE_STORE.ingest_remote(tid, spans)
+                except Exception:  # noqa: BLE001 — stitching is best-effort
+                    pass
+            result = deserialize_result(payload)
+            ctx.note_timing("serialize",
+                            ser_s + (time.perf_counter() - t1))
+            # remote stats fold into the coordinator's accounting exactly
+            # like local leaves noting into the shared ctx
+            ctx.absorb_stats(result.stats)
+            return result
 
     def __repr__(self) -> str:
         return f"HttpPlanDispatcher({self.endpoint})"
 
 
-def execplan_handler(memstore) -> Callable[[dict], dict]:
+def execplan_handler(memstore) -> Callable[..., dict]:
     """Server side: wire dict -> execute locally -> wire result.
     Transformers run here too (shard-local map/window work stays on the
-    data node, as in the reference's remote QueryActor)."""
+    data node, as in the reference's remote QueryActor).  The originating
+    query's trace context (wire field, or the HTTP headers passed as
+    ``trace_parent``) is attached so this node's spans join the tree;
+    they are shipped back under the ``spans`` key of the response."""
 
-    def handle(payload: dict) -> dict:
+    def handle(payload: dict,
+               trace_parent: Optional[tuple] = None) -> dict:
         plan = deserialize_plan(payload)
+        tid = plan.query_context.trace_id or \
+            (trace_parent[0] if trace_parent else None)
+        # parent ONLY onto the caller's span id: any span still open on
+        # this node (e.g. the leaf scheduler's run span enclosing this
+        # handler) closes after the response's span list is built, so
+        # parenting under it would orphan the whole remote subtree on
+        # the coordinator in a real multi-process deployment
+        parent_sid = trace_parent[1] if trace_parent else None
         ctx = ExecContext(memstore, plan.query_context)
-        result = plan.execute(ctx)
-        return serialize_result(result)
+        if not tid:
+            return serialize_result(plan.execute(ctx))
+        from filodb_tpu.utils.forensics import TRACE_STORE, span_to_dict
+        with TRACER.attach((tid, parent_sid)):
+            result = plan.execute(ctx)
+        out = serialize_result(result)
+        try:
+            out["spans"] = [span_to_dict(r)
+                            for r in TRACE_STORE.spans_for(tid)]
+        except Exception:  # noqa: BLE001 — span return is best-effort
+            pass
+        return out
 
     return handle
 

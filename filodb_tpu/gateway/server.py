@@ -13,12 +13,16 @@ from __future__ import annotations
 
 import socketserver
 import threading
+import time
 from typing import Callable, Mapping, Optional
 
 from filodb_tpu.core.record import RecordBuilder, decode_container
 from filodb_tpu.core.schemas import DatasetOptions, Schema
 from filodb_tpu.gateway.influx import InfluxParseError, parse_line
 from filodb_tpu.parallel.shardmap import ShardMapper
+from filodb_tpu.utils.observability import TRACER, ingest_metrics
+
+_METRICS = ingest_metrics()
 
 
 class ShardingPublisher:
@@ -67,6 +71,16 @@ class ShardingPublisher:
 
     def ingest_influx_line(self, line: str) -> int:
         """Parse one line and route its samples.  Returns samples added."""
+        err0 = self.parse_errors
+        n = self._ingest_line(line)
+        if self.parse_errors > err0:
+            _METRICS["parse_errors"].inc(self.parse_errors - err0)
+        if n:
+            _METRICS["samples"].inc(n)
+        return n
+
+    def _ingest_line(self, line: str) -> int:
+        """Uncounted per-line path (the batch wrapper counts by delta)."""
         from filodb_tpu.gateway.influx import to_prom_samples
         try:
             rec = parse_line(line)
@@ -82,6 +96,24 @@ class ShardingPublisher:
         return n
 
     def ingest_influx_batch(self, text: str) -> int:
+        """Instrumented batch-ingest entry (ISSUE 2): one span + the
+        filodb_ingest_* family per wire batch; parse errors anywhere in
+        the fallback chain count by delta."""
+        t0 = time.perf_counter()
+        err0 = self.parse_errors
+        try:
+            with TRACER.span("gateway.ingest_batch"):
+                n = self._ingest_batch(text)
+        finally:
+            _METRICS["batch_seconds"].observe(time.perf_counter() - t0)
+            errs = self.parse_errors - err0
+            if errs > 0:
+                _METRICS["parse_errors"].inc(errs)
+        if n:
+            _METRICS["samples"].inc(n)
+        return n
+
+    def _ingest_batch(self, text: str) -> int:
         """Batch ingest: the COLUMNAR path groups the payload's lines by
         (series head, field), resolves shard + normalized tags once per
         series from a cross-batch memo, and lands each group through ONE
@@ -106,7 +138,7 @@ class ShardingPublisher:
         except InfluxParseError:
             # a bad line poisons the whole fast batch: fall back to
             # per-line ingestion so good lines still land
-            return sum(self.ingest_influx_line(ln)
+            return sum(self._ingest_line(ln)
                        for ln in text.splitlines())
         n = 0
         for rec in recs:

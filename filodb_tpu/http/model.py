@@ -122,6 +122,27 @@ def error_response(error_type: str, message: str) -> dict:
     return {"status": "error", "errorType": error_type, "error": message}
 
 
+def stats_payload(stats, trace_id: str = "") -> dict:
+    """``stats=true`` response block (Prometheus-compatible placement:
+    ``data.stats.timings`` / ``data.stats.samples``).  Timings are the
+    per-stage wall-time buckets in seconds (plan/queue/scan/decode/
+    device_compute/serialize/total); samples are the scan-volume
+    counters merged up the exec tree, remote shards included."""
+    return {
+        "timings": {k: round(float(v), 6)
+                    for k, v in sorted(stats.timings.items())},
+        "samples": {
+            "samplesScanned": int(stats.samples_scanned),
+            "seriesScanned": int(stats.series_scanned),
+            "chunksScanned": int(stats.chunks_scanned),
+            "bytesScanned": int(stats.bytes_scanned),
+            "pagesIn": int(stats.pages_in),
+            "corruptChunksExcluded": int(stats.corrupt_chunks_excluded),
+        },
+        "traceId": trace_id,
+    }
+
+
 # ---------------------------------------------------------------------------
 # Parameter parsing (Prometheus API conventions)
 # ---------------------------------------------------------------------------

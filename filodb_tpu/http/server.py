@@ -11,8 +11,10 @@ akka-http; the planner/memstore stand in for the coordinator ask.
 
 from __future__ import annotations
 
+import functools
 import json
 import threading
+import time
 import urllib.parse
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -22,19 +24,46 @@ import numpy as np
 
 from filodb_tpu.coordinator.planner import QueryPlanner
 from filodb_tpu.http.model import (error_response, parse_duration_ms,
-                                   parse_time_ms, to_prom_matrix,
-                                   to_prom_vector)
+                                   parse_time_ms, stats_payload,
+                                   to_prom_matrix, to_prom_vector)
 from filodb_tpu.memstore.memstore import TimeSeriesMemStore
 from filodb_tpu.promql.parser import (ParseError,
                                       query_range_to_logical_plan,
                                       query_to_logical_plan)
 from filodb_tpu.query.exec import ExecContext
 from filodb_tpu.query.model import QueryContext, QueryError
+from filodb_tpu.utils.observability import TRACER, query_metrics
 
 # remote-storage body limits (unauthenticated endpoints; snappy copy
 # elements amplify ~21x, so both sides are bounded)
 _MAX_REMOTE_COMPRESSED = 16 * 1024 * 1024
 _MAX_REMOTE_UNCOMPRESSED = 128 * 1024 * 1024
+
+_METRICS = query_metrics()
+
+
+def _timed(endpoint: str):
+    """Route-handler latency decorator: EVERY ``_route`` handler must
+    wear one so no endpoint is dark (lint-enforced by
+    tests/test_sentinel_lint.py::test_route_handlers_record_latency)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *a, **kw):
+            t0 = time.perf_counter()
+            code = "error"
+            try:
+                out = fn(self, *a, **kw)
+                code = str(out[0]) if isinstance(out, tuple) else "200"
+                return out
+            finally:
+                _METRICS["request_seconds"].observe(
+                    time.perf_counter() - t0, endpoint=endpoint)
+                _METRICS["requests"].inc(endpoint=endpoint, code=code)
+        wrapper._timed_endpoint = endpoint
+        return wrapper
+
+    return deco
 
 
 @dataclass
@@ -181,6 +210,14 @@ class FiloHttpServer:
                 # partial-data flag as a header too, so load balancers /
                 # caches can act on it without parsing the body
                 req.send_header("X-FiloDB-Partial-Data", "true")
+            trace_id = None
+            if isinstance(payload, dict) \
+                    and isinstance(payload.get("data"), dict) \
+                    and isinstance(payload["data"].get("stats"), dict):
+                trace_id = payload["data"]["stats"].get("traceId")
+            if trace_id:
+                # lets the client jump straight to /admin/traces/<id>
+                req.send_header("X-FiloDB-Trace-Id", str(trace_id))
             req.send_header("Content-Length", str(len(data)))
             req.end_headers()
             req.wfile.write(data)
@@ -190,9 +227,17 @@ class FiloHttpServer:
     def _handle_execplan(self, req: BaseHTTPRequestHandler) -> None:
         """Cross-node dispatch receiver (reference: remote QueryActor
         executing a serialized ExecPlan, QueryActor.scala:220)."""
+        t0 = time.perf_counter()
         try:
+            from filodb_tpu.coordinator.dispatch import (PARENT_SPAN_HEADER,
+                                                         TRACE_HEADER)
             ln = int(req.headers.get("Content-Length") or 0)
             payload = json.loads(req.rfile.read(ln))
+            # trace context propagates via headers AND the execplan-wire
+            # qctx field; the handler prefers the wire field
+            tp = (req.headers.get(TRACE_HEADER),
+                  req.headers.get(PARENT_SPAN_HEADER))
+            tp = tp if tp[0] else None
             binding = self.datasets.get(payload.get("dataset"))
             if binding is None:
                 code, out = 404, error_response(
@@ -205,14 +250,21 @@ class FiloHttpServer:
                     # submit time and deadline (carried in the plan's
                     # query context) so cross-node priority and
                     # overdue-drop hold (reference: the remote
-                    # QueryActor's mailbox orders by submitTime)
+                    # QueryActor's mailbox orders by submitTime).
+                    # Attach the caller's trace BEFORE submit so the
+                    # scheduler's capture() sees it and this node's
+                    # queue-wait/run spans join the stitched tree.
                     qctx = payload.get("qctx", {})
-                    out = binding.leaf_scheduler.execute(
-                        lambda: handler(payload),
-                        submit_time_ms=qctx.get("submit_time_ms") or None,
-                        timeout_ms=qctx.get("timeout_ms") or 30_000)
+                    wire_tid = qctx.get("trace_id") or None
+                    token = (tp[0], tp[1]) if tp else (wire_tid, None)
+                    with TRACER.attach(token):
+                        out = binding.leaf_scheduler.execute(
+                            lambda: handler(payload, tp),
+                            submit_time_ms=qctx.get("submit_time_ms")
+                            or None,
+                            timeout_ms=qctx.get("timeout_ms") or 30_000)
                 else:
-                    out = handler(payload)
+                    out = handler(payload, tp)
                 code = 200
         except QueryError as e:
             from filodb_tpu.query.scheduler import QueryRejected
@@ -222,6 +274,7 @@ class FiloHttpServer:
                 code, out = 400, error_response("bad_data", str(e))
         except Exception as e:  # noqa: BLE001
             code, out = 500, error_response("internal", str(e))
+        _METRICS["execplan_seconds"].observe(time.perf_counter() - t0)
         data = json.dumps(out).encode()
         try:
             req.send_response(code)
@@ -295,7 +348,7 @@ class FiloHttpServer:
             filters = pb.matchers_to_filters(q.matchers, b.metric_column)
             plan = RawSeries(IntervalSelector(q.start_ms, q.end_ms),
                              tuple(filters))
-            result = self._exec(b, plan)
+            result, _tid = self._exec(b, plan, query="remote_read")
             series: list[bytes] = []
             for batch in result.batches:
                 if not isinstance(batch, RawBatch) or batch.batch is None:
@@ -358,8 +411,53 @@ class FiloHttpServer:
         if len(parts) == 2 and parts[0] == "admin" \
                 and parts[1] == "integrity":
             return self._integrity()
+        if len(parts) == 2 and parts[0] == "admin" \
+                and parts[1] == "slowlog":
+            return self._slowlog(params)
+        if len(parts) == 3 and parts[0] == "admin" and parts[1] == "traces":
+            return self._traces(parts[2])
+        if len(parts) == 2 and parts[0] == "debug" \
+                and parts[1] == "profilez":
+            return self._profilez(params)
         return 404, error_response("bad_data", f"unknown route {path}")
 
+    # ------------------------------------------------------ query forensics
+
+    @_timed("slowlog")
+    def _slowlog(self, p: dict) -> tuple[int, dict]:
+        """Recent completed queries over the slow threshold, newest
+        first, each with its full stitched span tree (doc/observability.md)."""
+        from filodb_tpu.utils.forensics import TRACE_STORE
+        limit = max(1, min(int(p.get("limit", 50)), 1000))
+        entries = TRACE_STORE.slowlog()[-limit:][::-1]
+        return 200, {"status": "success", "data": {
+            "threshold_s": TRACE_STORE.slow_threshold_s,
+            "entries": entries}}
+
+    @_timed("traces")
+    def _traces(self, trace_id: str) -> tuple[int, dict]:
+        """One recent trace as a span tree (remote shards' spans are
+        stitched in by the dispatch layer)."""
+        from filodb_tpu.utils.forensics import TRACE_STORE
+        tree = TRACE_STORE.tree(trace_id)
+        if not tree:
+            return 404, error_response("bad_data",
+                                       f"unknown trace {trace_id}")
+        return 200, {"status": "success",
+                     "data": {"traceId": trace_id, "spans": tree}}
+
+    @_timed("profilez")
+    def _profilez(self, p: dict) -> tuple[int, dict]:
+        """On-demand sampling profile: blocks this handler thread for
+        ``seconds`` (bounded, single-flight) and returns hot frames."""
+        from filodb_tpu.utils import forensics
+        try:
+            data = forensics.profile(seconds=float(p.get("seconds", 2.0)))
+        except forensics.ProfilerBusy as e:
+            return 503, error_response("unavailable", str(e))
+        return 200, {"status": "success", "data": data}
+
+    @_timed("integrity")
     def _integrity(self) -> tuple[int, dict]:
         """Operational view of the data-integrity subsystem: global
         counters, the quarantine registry, and per-shard corruption /
@@ -394,6 +492,7 @@ class FiloHttpServer:
             "quarantined": QUARANTINE.items(),
             "shards": shards}}
 
+    @_timed("chunkmeta")
     def _chunkmeta(self, ds: str, p: dict) -> tuple[int, dict]:
         """Chunk-level metadata for matching series (reference: the
         RawChunkMeta logical plan + CLI decodeChunkInfo debugging)."""
@@ -410,21 +509,39 @@ class FiloHttpServer:
         end = parse_time_ms(p.get("end", str(2**62 // 1000)))
         plan = RawChunkMeta(filters=tuple(filters), start_ms=start,
                             end_ms=end)
-        result = self._exec(binding, plan)
+        result, _tid = self._exec(binding, plan, query=p["match[]"])
         data = [row for b in result.batches for row in b]
         return 200, {"status": "success", "data": data}
 
     # ---------------------------------------------------------- query routes
 
+    @staticmethod
+    def _stats_wanted(p: dict) -> bool:
+        return str(p.get("stats", "")).lower() in ("true", "1", "all")
+
+    def _finish_query(self, result, trace_id: str, body: dict, p: dict,
+                      ser_s: float) -> dict:
+        """Attach data.stats (Prometheus stats=true shape) to a query
+        response and round off the serialize bucket."""
+        if self._stats_wanted(p):
+            result.stats.add_timing("serialize", ser_s)
+            body["data"]["stats"] = stats_payload(result.stats, trace_id)
+        return body
+
+    @_timed("query_range")
     def _query_range(self, b: DatasetBinding, p: dict) -> tuple[int, dict]:
         query = p["query"]
         start = parse_time_ms(p["start"])
         end = parse_time_ms(p["end"])
         step = parse_duration_ms(p.get("step", "15s"))
         plan = query_range_to_logical_plan(query, start, step, end)
-        result = self._exec(b, plan)
-        return 200, to_prom_matrix(result, b.metric_column)
+        result, trace_id = self._exec(b, plan, query=query)
+        t0 = time.perf_counter()
+        body = to_prom_matrix(result, b.metric_column)
+        return 200, self._finish_query(result, trace_id, body, p,
+                                       time.perf_counter() - t0)
 
+    @_timed("query")
     def _query_instant(self, b: DatasetBinding, p: dict) -> tuple[int, dict]:
         import time as _time
         query = p["query"]
@@ -432,21 +549,64 @@ class FiloHttpServer:
         time_ms = parse_time_ms(p["time"]) if "time" in p \
             else int(_time.time() * 1000)
         plan = query_to_logical_plan(query, time_ms)
-        result = self._exec(b, plan)
-        return 200, to_prom_vector(result, time_ms, b.metric_column)
+        result, trace_id = self._exec(b, plan, query=query)
+        t0 = time.perf_counter()
+        body = to_prom_vector(result, time_ms, b.metric_column)
+        return 200, self._finish_query(result, trace_id, body, p,
+                                       time.perf_counter() - t0)
 
-    def _exec(self, b: DatasetBinding, plan):
+    def _exec(self, b: DatasetBinding, plan, query: str = ""):
+        """Plan + execute with a fresh per-query trace: mints the
+        trace_id every downstream span (and remote dispatch) joins,
+        splits plan/queue wall-time into the stats buckets, and feeds
+        the slow-query log on completion.  Returns (result, trace_id)."""
         import time as _time
-        qctx = QueryContext(submit_time_ms=int(_time.time() * 1000))
+        from filodb_tpu.utils.forensics import TRACE_STORE
+        qctx = QueryContext(submit_time_ms=int(_time.time() * 1000),
+                            trace_id=TRACER.new_trace_id())
+        t0 = _time.perf_counter()
 
         def run():
-            ep = b.planner.materialize(plan, qctx)
-            return ep.execute(ExecContext(b.memstore, qctx))
+            t_run = _time.perf_counter()
+            # parent onto wherever this runs: the scheduler worker's
+            # span when queued, the root "query" span when inline
+            tok = TRACER.capture()
+            if tok[0] is None:
+                tok = (qctx.trace_id, None)
+            with TRACER.attach(tok):
+                with TRACER.span("query.execute", dataset=b.dataset,
+                                 query=query):
+                    t_plan = _time.perf_counter()
+                    with TRACER.span("query.plan"):
+                        ep = b.planner.materialize(plan, qctx)
+                    plan_s = _time.perf_counter() - t_plan
+                    res = ep.execute(ExecContext(b.memstore, qctx))
+            res.stats.add_timing("plan", plan_s)
+            res.stats.add_timing("queue", t_run - t0)
+            return res
 
-        if b.scheduler is not None:
-            return b.scheduler.execute(run, qctx.submit_time_ms,
-                                       qctx.timeout_ms)
-        return run()
+        try:
+            # ONE root span per query on the entry thread: the
+            # scheduler's queue-wait/run spans and the exec tree all
+            # parent under it, so /admin/traces shows a single tree
+            with TRACER.attach((qctx.trace_id, None)), \
+                    TRACER.span("query", dataset=b.dataset, query=query):
+                if b.scheduler is not None:
+                    result = b.scheduler.execute(run, qctx.submit_time_ms,
+                                                 qctx.timeout_ms)
+                else:
+                    result = run()
+        except BaseException as e:
+            TRACE_STORE.note_complete(qctx.trace_id,
+                                      _time.perf_counter() - t0,
+                                      query=query, dataset=b.dataset,
+                                      error=repr(e))
+            raise
+        total_s = _time.perf_counter() - t0
+        result.stats.timings.setdefault("total", total_s)
+        TRACE_STORE.note_complete(qctx.trace_id, total_s, query=query,
+                                  dataset=b.dataset)
+        return result, qctx.trace_id
 
     # ------------------------------------------------------- metadata routes
 
@@ -455,6 +615,7 @@ class FiloHttpServer:
         end = parse_time_ms(p["end"]) if "end" in p else np.iinfo(np.int64).max
         return start, end
 
+    @_timed("labels")
     def _labels(self, b: DatasetBinding, p: dict) -> tuple[int, dict]:
         start, end = self._time_range(p)
         names: set[str] = set()
@@ -462,6 +623,7 @@ class FiloHttpServer:
             names.update(sh.label_names(start=start, end=end))
         return 200, {"status": "success", "data": sorted(names)}
 
+    @_timed("label_values")
     def _label_values(self, b: DatasetBinding, label: str, p: dict,
                       multi: Optional[dict] = None) -> tuple[int, dict]:
         start, end = self._time_range(p)
@@ -482,6 +644,7 @@ class FiloHttpServer:
         vals = b.memstore.label_values(b.dataset, label, start=start, end=end)
         return 200, {"status": "success", "data": vals}
 
+    @_timed("series")
     def _series(self, b: DatasetBinding, p: dict,
                 multi: dict) -> tuple[int, dict]:
         from filodb_tpu.core.record import parse_partkey
@@ -517,6 +680,7 @@ class FiloHttpServer:
 
     # --------------------------------------------------------- admin routes
 
+    @_timed("health")
     def _health(self) -> tuple[int, dict]:
         """Shard statuses per dataset (reference: HealthRoute returning
         ShardStatus list)."""
@@ -542,6 +706,7 @@ class FiloHttpServer:
             body["node"] = self.node_name
         return (200 if healthy else 503), body
 
+    @_timed("cluster")
     def _cluster(self, parts: list[str], params: dict) -> tuple[int, dict]:
         """/api/v1/cluster/<ds>/status|startshards|stopshards (reference:
         ClusterApiRoute)."""

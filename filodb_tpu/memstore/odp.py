@@ -18,6 +18,7 @@ capDataScannedPerShardCheck).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 import struct
@@ -52,6 +53,46 @@ _NUMERIC = (ColumnType.TIMESTAMP, ColumnType.LONG, ColumnType.INT,
 
 class QueryLimitExceeded(Exception):
     """A query would scan more bytes than max_data_per_shard_query allows."""
+
+
+def _active_ctx():
+    """The ExecContext of the scan on THIS thread (None off the query
+    path, e.g. the deferred publish thread).  Lazy import: exec.py
+    imports the memstore package at module load."""
+    from filodb_tpu.query.exec import active_exec_ctx
+    return active_exec_ctx()
+
+
+_ODP_METRICS = None
+
+
+def _odp_m() -> dict:
+    """The filodb_odp_* metric objects, resolved ONCE — page-ins must
+    not serialize on the registry lock for pure lookups."""
+    global _ODP_METRICS
+    if _ODP_METRICS is None:
+        from filodb_tpu.utils.observability import odp_metrics
+        _ODP_METRICS = odp_metrics()
+    return _ODP_METRICS
+
+
+@contextlib.contextmanager
+def _pagein_timed(shard, kind: str):
+    """Span + filodb_odp_* latency + per-query decode-stage attribution
+    around a page-in (reference: Kamon spans around ODP,
+    OnDemandPagingShard.scala)."""
+    from filodb_tpu.utils.observability import TRACER
+    t0 = time.perf_counter()
+    try:
+        with TRACER.span("odp.pagein", dataset=shard.dataset,
+                         shard=shard.shard_num, kind=kind):
+            yield
+    finally:
+        dt = time.perf_counter() - t0
+        _odp_m()["pagein_seconds"].observe(dt, dataset=shard.dataset)
+        ctx = _active_ctx()
+        if ctx is not None:
+            ctx.note_timing("decode", dt)
 
 
 class _LazyVectors:
@@ -295,6 +336,21 @@ class OnDemandPagingShard(TimeSeriesShard):
             part = self.paged.get(part_id)
         return part
 
+    def _note_paged(self, nparts: int, nchunks: int) -> None:
+        """Page-in accounting in ONE place: shard stats, the
+        filodb_odp_* counters, and the active query's pages-in/chunks
+        resource counters (absent on the deferred publish thread)."""
+        m = _odp_m()
+        if nparts:
+            self.stats.partitions_paged += nparts
+            m["partitions"].inc(nparts, dataset=self.dataset)
+        if nchunks:
+            self.stats.chunks_paged += nchunks
+            m["chunks"].inc(nchunks, dataset=self.dataset)
+        ctx = _active_ctx()
+        if ctx is not None:
+            ctx.note_counts(chunks=nchunks, pages=nparts)
+
     def _on_page_evict(self) -> None:
         # called after the page-cache lock is released; concurrent evictions
         # from multiple query threads must not lose an increment (a lost
@@ -399,7 +455,7 @@ class OnDemandPagingShard(TimeSeriesShard):
                     if self.partitions.get(part.part_id) is part:
                         self.paged.put(key, older,
                                        sum(c.nbytes for c in older))
-                    self.stats.chunks_paged += len(older)
+                    self._note_paged(0, len(older))
         if not older:
             return part
         snap = TimeSeriesPartition.__new__(TimeSeriesPartition)
@@ -620,8 +676,7 @@ class OnDemandPagingShard(TimeSeriesShard):
                     except KeyError:
                         tags = parse_partkey(sel[si][0])
                     tags_list[idx_of[pid]] = tags
-                self.stats.partitions_paged += len(groups)
-                self.stats.chunks_paged += len(sel)
+                self._note_paged(len(groups), len(sel))
                 # pop()s since this point cancel the publish (gen_guard);
                 # read under the cache lock so a concurrent pop cannot
                 # slip between the read and the guard capture
@@ -732,13 +787,17 @@ class OnDemandPagingShard(TimeSeriesShard):
             built[pid] = part
         self.paged.put_many(items, gen_guard=gen_guard)
         if count_stats:
-            self.stats.partitions_paged += len(items)
-            self.stats.chunks_paged += len(sel)
+            self._note_paged(len(items), len(sel))
 
     def _page_in(self, part_ids: list[int],
                  resident: dict[int, TimeSeriesPartition]) -> None:
         """Materialize fully-absent partitions from disk with their whole
         persisted history, so the cached object serves any time range."""
+        with _pagein_timed(self, "generic"):
+            self._page_in_inner(part_ids, resident)
+
+    def _page_in_inner(self, part_ids: list[int],
+                       resident: dict[int, TimeSeriesPartition]) -> None:
         got = self._page_in_bulk(part_ids)
         if got is not None:
             resident.update(got[0])
@@ -780,8 +839,7 @@ class OnDemandPagingShard(TimeSeriesShard):
                     nbytes += cs.nbytes
                 self.paged.put(pid, part, nbytes)
                 resident[pid] = part
-                self.stats.partitions_paged += 1
-                self.stats.chunks_paged += len(chunksets)
+                self._note_paged(1, len(chunksets))
 
     def _schema_for_chunks(self, chunksets):
         """The persisted schema hash identifies the exact schema; fall back
@@ -869,18 +927,19 @@ class OnDemandPagingShard(TimeSeriesShard):
             # decode pass and serve the query directly
             fuse = None if parts else (ids, start_time, end_time,
                                        column_id)
-            try:
-                got = self._page_in_bulk(
-                    missing, byte_cap=cap - resident_bytes, fuse=fuse)
-            except ScanBytesExceeded:
-                # full-history bytes crossed the budget; only chunks
-                # overlapping the range count, so do the precise
-                # metadata check (raises when genuinely over), then
-                # retry uncapped — falling back to the generic path
-                # would read the same multi-MB row set a third time
-                self._cap_data_scanned(parts.values(), missing,
-                                       start_time, end_time)
-                got = self._page_in_bulk(missing, fuse=fuse)
+            with _pagein_timed(self, "bulk"):
+                try:
+                    got = self._page_in_bulk(
+                        missing, byte_cap=cap - resident_bytes, fuse=fuse)
+                except ScanBytesExceeded:
+                    # full-history bytes crossed the budget; only chunks
+                    # overlapping the range count, so do the precise
+                    # metadata check (raises when genuinely over), then
+                    # retry uncapped — falling back to the generic path
+                    # would read the same multi-MB row set a third time
+                    self._cap_data_scanned(parts.values(), missing,
+                                           start_time, end_time)
+                    got = self._page_in_bulk(missing, fuse=fuse)
             if got is None:
                 return None
             built, ftags, fbatch = got
@@ -1004,20 +1063,26 @@ class OnDemandPagingShard(TimeSeriesShard):
                 owners.append((part, cs.info.chunk_id))
         if not groups or schema is None:
             return
+        t0 = time.perf_counter()
         try:
-            decoded_all = decode_partitions_batch(schema, groups)
-        except (ValueError, IndexError, struct.error):
-            # ONE corrupt chunk fails the whole batch decode: redo per
-            # chunk so the culprit gets its structured diagnosis +
-            # quarantine while every healthy chunk still fills its cache
-            for (part, _cid), (cs,) in zip(owners, groups):
-                try:
-                    part._decoded_chunk(cs)
-                except integrity.CorruptVectorError as err:
-                    part._note_corrupt(err)
-            return
-        for (part, cid), decoded in zip(owners, decoded_all):
-            part._decoded[cid] = decoded
+            try:
+                decoded_all = decode_partitions_batch(schema, groups)
+            except (ValueError, IndexError, struct.error):
+                # ONE corrupt chunk fails the whole batch decode: redo per
+                # chunk so the culprit gets its structured diagnosis +
+                # quarantine while every healthy chunk still fills its cache
+                for (part, _cid), (cs,) in zip(owners, groups):
+                    try:
+                        part._decoded_chunk(cs)
+                    except integrity.CorruptVectorError as err:
+                        part._note_corrupt(err)
+                return
+            for (part, cid), decoded in zip(owners, decoded_all):
+                part._decoded[cid] = decoded
+        finally:
+            ctx = _active_ctx()
+            if ctx is not None:
+                ctx.note_timing("decode", time.perf_counter() - t0)
 
     def _cap_data_scanned(self, resident_parts, missing_ids: Sequence[int],
                           start_time: int, end_time: int) -> None:

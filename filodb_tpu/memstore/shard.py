@@ -39,6 +39,17 @@ from filodb_tpu.store.columnstore import ColumnStore, NullColumnStore, PartKeyRe
 from filodb_tpu.store.metastore import InMemoryMetaStore, MetaStore
 from filodb_tpu.utils.bloom import BloomFilter
 
+_FLUSH_METRICS = None
+
+
+def _flush_m() -> dict:
+    """The filodb_flush_* metric objects, resolved once per process."""
+    global _FLUSH_METRICS
+    if _FLUSH_METRICS is None:
+        from filodb_tpu.utils.observability import flush_metrics
+        _FLUSH_METRICS = flush_metrics()
+    return _FLUSH_METRICS
+
 
 @dataclasses.dataclass
 class PartLookupResult:
@@ -398,7 +409,28 @@ class TimeSeriesShard:
         time — never the live write buffer), write chunks, downsample,
         persist partkeys, checkpoint (the doFlushSteps pipeline,
         reference :884-974).  Returns chunksets written.  On failure the
-        dirty partkeys are re-queued so a later flush persists them."""
+        dirty partkeys are re-queued so a later flush persists them.
+
+        Instrumented per ISSUE 2 (reference: Kamon spans around flush,
+        TimeSeriesShard.scala:888-891): one span + the filodb_flush_*
+        metrics per task; failures count before re-raising."""
+        from filodb_tpu.utils.observability import TRACER
+        m = _flush_m()
+        t0 = time.perf_counter()
+        try:
+            with TRACER.span("memstore.flush", dataset=self.dataset,
+                             shard=self.shard_num, group=task.group):
+                n = self._run_flush_task(task)
+        except BaseException:
+            m["failures"].inc(dataset=self.dataset)
+            raise
+        finally:
+            m["flush_seconds"].observe(time.perf_counter() - t0,
+                                       dataset=self.dataset)
+        m["chunks"].inc(n, dataset=self.dataset)
+        return n
+
+    def _run_flush_task(self, task: "FlushTask") -> int:
         collected: list[tuple] = []  # (part, its fresh chunksets)
         try:
             chunksets = []

@@ -12,6 +12,7 @@ from __future__ import annotations
 import concurrent.futures
 import dataclasses
 import threading
+import time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -28,6 +29,16 @@ from filodb_tpu.query.model import (PeriodicBatch, QueryContext, QueryError,
                                     QueryResult, QueryStats, RawBatch,
                                     ScalarResult, concat_periodic)
 from filodb_tpu.query.transformers import RangeVectorTransformer, _drop_metric
+from filodb_tpu.utils.observability import TRACER
+
+# the ExecContext of the scan running on THIS thread: lower layers that
+# have no ctx parameter (ODP page-in, predecode) attribute their stage
+# timings / page-in counters to the active query through it
+_ACTIVE = threading.local()
+
+
+def active_exec_ctx() -> Optional["ExecContext"]:
+    return getattr(_ACTIVE, "ctx", None)
 
 
 @dataclasses.dataclass
@@ -44,6 +55,13 @@ class ExecContext:
     _corrupt_excluded: int = 0
     _corrupt_lock: object = dataclasses.field(
         default_factory=threading.Lock, repr=False)
+    # per-stage wall-time + scan-volume accounting (ISSUE 2): leaves and
+    # the ODP/device layers note into the shared ctx; remote dispatch
+    # absorbs the data node's totals; the root folds the accumulated
+    # numbers into its QueryResult's stats (same pattern as
+    # corrupt_chunks_excluded — the outermost plan returns last)
+    _timings: dict = dataclasses.field(default_factory=dict, repr=False)
+    _counters: dict = dataclasses.field(default_factory=dict, repr=False)
 
     def note_corrupt_excluded(self, n: int) -> None:
         with self._corrupt_lock:
@@ -51,6 +69,45 @@ class ExecContext:
 
     def corrupt_excluded(self) -> int:
         return self._corrupt_excluded
+
+    def note_timing(self, stage: str, seconds: float) -> None:
+        with self._corrupt_lock:
+            self._timings[stage] = self._timings.get(stage, 0.0) + seconds
+
+    def note_counts(self, samples: int = 0, chunks: int = 0,
+                    bytes_: int = 0, pages: int = 0) -> None:
+        with self._corrupt_lock:
+            c = self._counters
+            if samples:
+                c["samples"] = c.get("samples", 0) + samples
+            if chunks:
+                c["chunks"] = c.get("chunks", 0) + chunks
+            if bytes_:
+                c["bytes"] = c.get("bytes", 0) + bytes_
+            if pages:
+                c["pages"] = c.get("pages", 0) + pages
+
+    def absorb_stats(self, stats: QueryStats) -> None:
+        """Fold a REMOTE child's stats into this query's accounting
+        (local children share the ctx and need no absorb)."""
+        self.note_counts(samples=stats.samples_scanned,
+                         chunks=stats.chunks_scanned,
+                         bytes_=stats.bytes_scanned, pages=stats.pages_in)
+        if stats.corrupt_chunks_excluded:
+            self.note_corrupt_excluded(stats.corrupt_chunks_excluded)
+        for k, v in stats.timings.items():
+            self.note_timing(k, v)
+
+    def fold_into(self, stats: QueryStats) -> None:
+        """Write the accumulated per-stage totals into an outgoing
+        QueryResult's stats (overwrite: the ctx holds running totals)."""
+        with self._corrupt_lock:
+            stats.timings = dict(self._timings)
+            c = self._counters
+            stats.samples_scanned = c.get("samples", 0)
+            stats.chunks_scanned = c.get("chunks", 0)
+            stats.bytes_scanned = c.get("bytes", 0)
+            stats.pages_in = c.get("pages", 0)
 
 
 class PlanDispatcher:
@@ -64,7 +121,9 @@ class PlanDispatcher:
 
 class InProcessDispatcher(PlanDispatcher):
     def dispatch(self, plan, ctx):
-        return plan.execute(ctx)
+        with TRACER.span("dispatch.inprocess",
+                         plan=type(plan).__name__):
+            return plan.execute(ctx)
 
 
 IN_PROCESS = InProcessDispatcher()
@@ -89,17 +148,31 @@ class ExecPlan:
         raise NotImplementedError
 
     def execute(self, ctx: ExecContext) -> QueryResult:
+        # one span per plan node (reference: Kamon.spanBuilder in
+        # ExecPlan.execute, ExecPlan.scala:99-126); tags carry the plan
+        # type and, for data leaves, dataset/shard.  Span machinery
+        # never raises into the query path — reporter failures are
+        # swallowed by the tracer
+        tags = {"plan": type(self).__name__}
+        ds = getattr(self, "dataset", None)
+        if ds is not None:
+            tags["dataset"] = ds
+            tags["shard"] = getattr(self, "shard", "")
         try:
-            batches = self.do_execute(ctx)
-            for t in self.transformers:
-                batches = t.apply(batches, ctx)
-            self._enforce_limits(batches, ctx)
-            stats = self._collect_stats(batches)
-            # quarantined-chunk exclusions accumulate on the shared ctx;
-            # the outermost plan returns last, so its result carries the
-            # whole tree's total for the partial-data warning
-            stats.corrupt_chunks_excluded = ctx.corrupt_excluded()
-            return QueryResult(self.query_context.query_id, batches, stats)
+            with TRACER.span("execplan.execute", **tags):
+                batches = self.do_execute(ctx)
+                for t in self.transformers:
+                    batches = t.apply(batches, ctx)
+                self._enforce_limits(batches, ctx)
+                stats = self._collect_stats(batches)
+                # quarantined-chunk exclusions accumulate on the shared
+                # ctx; the outermost plan returns last, so its result
+                # carries the whole tree's total for the partial-data
+                # warning.  Stage timings/counters fold the same way.
+                stats.corrupt_chunks_excluded = ctx.corrupt_excluded()
+                ctx.fold_into(stats)
+                return QueryResult(self.query_context.query_id, batches,
+                                   stats)
         except QueryError:
             raise
         except Exception as e:  # noqa: BLE001 - plan failure surfaces as QueryError
@@ -162,13 +235,21 @@ class NonLeafExecPlan(ExecPlan):
 
     def _dispatch_children(self, ctx) -> list[QueryResult]:
         """Children run via their own dispatchers, concurrently (reference:
-        NonLeafExecPlan.doExecute mapAsync, ExecPlan.scala:370-409)."""
+        NonLeafExecPlan.doExecute mapAsync, ExecPlan.scala:370-409).
+        The trace context is captured here and re-attached on the pool
+        threads so child spans parent onto this plan's span."""
         kids = self._children
         if len(kids) <= 1 or not self.parallel_children:
             return [c.dispatcher.dispatch(c, ctx) for c in kids]
+        token = TRACER.capture()
+
+        def run(c):
+            with TRACER.attach(token):
+                return c.dispatcher.dispatch(c, ctx)
+
         with concurrent.futures.ThreadPoolExecutor(
                 max_workers=min(len(kids), ctx.parallelism)) as pool:
-            futs = [pool.submit(c.dispatcher.dispatch, c, ctx) for c in kids]
+            futs = [pool.submit(run, c) for c in kids]
             return [f.result() for f in futs]
 
     def compose(self, results: list[QueryResult], ctx) -> list:
@@ -197,15 +278,57 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         self.column = column
 
     def do_execute(self, ctx: ExecContext) -> list:
-        shard = ctx.memstore.get_shard(self.dataset, self.shard)
-        lookup = shard.lookup_partitions(self.filters, self.start_ms,
-                                         self.end_ms)
+        # the leaf owns the "scan" stage bucket; lower layers without a
+        # ctx parameter (ODP page-in, predecode) attribute theirs
+        # through the active-ctx thread-local installed here
+        t0 = time.perf_counter()
+        prev = getattr(_ACTIVE, "ctx", None)
+        _ACTIVE.ctx = ctx
         try:
-            return self._do_scan(ctx, shard, lookup)
+            shard = ctx.memstore.get_shard(self.dataset, self.shard)
+            lookup = shard.lookup_partitions(self.filters, self.start_ms,
+                                             self.end_ms)
+            try:
+                batches = self._do_scan(ctx, shard, lookup)
+                self._note_batch_counts(ctx, batches)
+                return batches
+            finally:
+                # AFTER the scan, so corruption detected by this very
+                # query already counts toward its own partial-data warning
+                self._note_quarantined(ctx, shard, lookup.part_ids)
         finally:
-            # AFTER the scan, so corruption detected by this very query
-            # already counts toward its own partial-data warning
-            self._note_quarantined(ctx, shard, lookup.part_ids)
+            _ACTIVE.ctx = prev
+            ctx.note_timing("scan", time.perf_counter() - t0)
+
+    @staticmethod
+    def _note_batch_counts(ctx: ExecContext, batches) -> None:
+        """Scan-volume accounting from what the leaf actually returned."""
+        samples = nbytes = 0
+        for b in batches:
+            if isinstance(b, PeriodicBatch):
+                samples += len(b.keys) * b.steps.num_steps
+                nbytes += getattr(b.values, "nbytes", 0)
+            elif isinstance(b, RawBatch) and b.batch is not None:
+                samples += int(np.asarray(b.batch.row_counts).sum())
+                nbytes += getattr(b.batch.values, "nbytes", 0)
+            elif isinstance(b, AggPartialBatch):
+                for v in b.state.values():
+                    nbytes += getattr(v, "nbytes", 0)
+        if samples or nbytes:
+            ctx.note_counts(samples=samples, bytes_=nbytes)
+
+    @staticmethod
+    def _grid_timed(fn, *args, **kw):
+        """Run a device-grid serving call, attributing its wall time to
+        the active query's device_compute stage bucket."""
+        ctx = active_exec_ctx()
+        if ctx is None:
+            return fn(*args, **kw)
+        t0 = time.perf_counter()
+        try:
+            return fn(*args, **kw)
+        finally:
+            ctx.note_timing("device_compute", time.perf_counter() - t0)
 
     def _do_scan(self, ctx: ExecContext, shard, lookup) -> list:
         schema = None
@@ -281,8 +404,9 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         possible: returns (tags, values, bucket_tops) with values
         [len(tags), T] ([len(tags), T, hb] for hist columns)."""
         from filodb_tpu.query import rangefns
-        got = shard.scan_grid(part_ids, func, steps.start, steps.num_steps,
-                              steps.step, window_ms, cid, fargs=fargs)
+        got = self._grid_timed(shard.scan_grid, part_ids, func, steps.start,
+                               steps.num_steps, steps.step, window_ms, cid,
+                               fargs=fargs)
         if got is not None:
             return got
         tags, batch = shard.scan_batch(part_ids, self.start_ms, self.end_ms,
@@ -420,9 +544,10 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                                                window_ms)
             if served is not None:
                 return served
-        got = shard.scan_grid(part_ids, mapper.function, steps.start,
-                              steps.num_steps, steps.step, window_ms,
-                              column_id, fargs=tuple(mapper.function_args))
+        got = self._grid_timed(shard.scan_grid, part_ids, mapper.function,
+                               steps.start, steps.num_steps, steps.step,
+                               window_ms, column_id,
+                               fargs=tuple(mapper.function_args))
         if got is None:
             return None
         tags, vals, tops = got
@@ -451,13 +576,19 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                 key = tuple(sorted(grouping_key(part.tags, mapred.by,
                                                 mapred.without).items()))
                 gids.append(union.setdefault(key, len(union)))
-        state = shard.scan_grid_grouped(
-            part_ids, mapper.function, steps.start, steps.num_steps,
-            steps.step, window_ms, gids, max(len(union), 1),
-            self._GRID_AGG_OPS[mapred.operator.name], column_id,
-            fargs=tuple(mapper.function_args))
+        state = self._grid_timed(
+            shard.scan_grid_grouped, part_ids, mapper.function, steps.start,
+            steps.num_steps, steps.step, window_ms, gids,
+            max(len(union), 1), self._GRID_AGG_OPS[mapred.operator.name],
+            column_id, fargs=tuple(mapper.function_args))
         if state is None:
             return None
+        # the fused path never materializes per-series batches, so the
+        # scanned volume is accounted here: S series x T steps of
+        # windowed input went through the device program
+        ctx = active_exec_ctx()
+        if ctx is not None:
+            ctx.note_counts(samples=len(part_ids) * steps.num_steps)
         tops = state.pop("bucket_tops", None)
         return [AggPartialBatch(mapred.operator, (),
                                 [dict(k) for k in union], report, state,

@@ -30,6 +30,10 @@ class QueryContext:
     timeout_ms: int = 30_000
     spread: Optional[int] = None
     origin: str = ""
+    # end-to-end trace id, minted at the HTTP/planner entry point and
+    # propagated across remote dispatch (header + execplan-wire field)
+    # so scatter-gather fan-out stitches into one span tree
+    trace_id: str = ""
 
 
 @dataclasses.dataclass
@@ -42,6 +46,15 @@ class QueryStats:
     # result is PARTIAL and the API layers surface a warning
     # (filodb_tpu/integrity quarantine exclusion)
     corrupt_chunks_excluded: int = 0
+    # per-query resource accounting (ISSUE 2): scan-volume counters and
+    # per-stage wall-time buckets (seconds, keys: plan/queue/scan/
+    # decode/device_compute/serialize).  Accumulated on the shared
+    # ExecContext, folded up the exec tree like corrupt_chunks_excluded,
+    # and returned under data.stats when stats=true
+    chunks_scanned: int = 0
+    bytes_scanned: int = 0
+    pages_in: int = 0
+    timings: dict = dataclasses.field(default_factory=dict)
 
     def merge(self, other: "QueryStats") -> None:
         self.samples_scanned += other.samples_scanned
@@ -49,6 +62,14 @@ class QueryStats:
         self.shards_queried += other.shards_queried
         self.dropped_series += other.dropped_series
         self.corrupt_chunks_excluded += other.corrupt_chunks_excluded
+        self.chunks_scanned += other.chunks_scanned
+        self.bytes_scanned += other.bytes_scanned
+        self.pages_in += other.pages_in
+        for k, v in other.timings.items():
+            self.timings[k] = self.timings.get(k, 0.0) + v
+
+    def add_timing(self, stage: str, seconds: float) -> None:
+        self.timings[stage] = self.timings.get(stage, 0.0) + seconds
 
 
 class QueryError(Exception):

@@ -21,9 +21,11 @@ import itertools
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Callable, Optional
 
 from filodb_tpu.query.model import QueryError
+from filodb_tpu.utils.observability import TRACER
 
 
 class QueryRejected(QueryError):
@@ -53,11 +55,16 @@ class QueryScheduler:
         reg = registry
         if reg is None:
             from filodb_tpu.utils.observability import REGISTRY as reg
+        # saturation visibility (ISSUE 2 satellite): queue depth is a
+        # live gauge backed by queue_depth(), rejections (full/shutdown)
+        # count per reason — both visible on /metrics before overload
+        # becomes timeouts
         self._m_depth = reg.gauge("filodb_query_queue_depth")
         self._m_done = reg.counter("filodb_queries_executed_total")
         self._m_rejected = reg.counter("filodb_queries_rejected_total")
         self._m_timed_out = reg.counter("filodb_queries_queue_timeout_total")
         self._m_wait = reg.histogram("filodb_query_queue_wait_seconds")
+        self._m_run = reg.histogram("filodb_query_run_seconds")
         self._m_depth.set_fn(self.queue_depth, scheduler=name)
 
     # ------------------------------------------------------------- submit
@@ -69,8 +76,11 @@ class QueryScheduler:
         :class:`QueryRejected` when the queue is full."""
         st = submit_time_ms if submit_time_ms else int(time.time() * 1000)
         fut: Future = Future()
+        # trace context captured HERE travels to the worker thread so
+        # the queue-wait/run-time split stitches into the query's tree
+        token = TRACER.capture()
         entry = (st, next(self._counter), time.monotonic(), timeout_ms,
-                 fn, fut)
+                 token, fn, fut)
         with self._lock:
             if self._shutdown:
                 self._m_rejected.inc(scheduler=self.name, reason="shutdown")
@@ -90,7 +100,10 @@ class QueryScheduler:
         fut = self.submit(fn, submit_time_ms, timeout_ms)
         try:
             return fut.result(timeout=timeout_ms / 1000.0)
-        except TimeoutError:
+        except _FutureTimeout:
+            # pre-3.11 concurrent.futures.TimeoutError is NOT the
+            # builtin TimeoutError; catching the builtin missed it and
+            # leaked the raw future timeout to the HTTP layer
             fut.cancel()
             raise QueryError("", f"query timed out after {timeout_ms}ms")
 
@@ -107,10 +120,16 @@ class QueryScheduler:
                     self._work.wait()
                 if self._shutdown and not self._heap:
                     return
-                _, _, enq_mono, timeout_ms, fn, fut = heapq.heappop(
+                _, _, enq_mono, timeout_ms, token, fn, fut = heapq.heappop(
                     self._heap)
             waited = time.monotonic() - enq_mono
             self._m_wait.observe(waited)
+            if token[0] is not None:
+                # synthetic span: the wait happened in the queue, not on
+                # any thread — report it parented on the submitter's span
+                TRACER.record("scheduler.queue_wait", waited,
+                              trace_id=token[0], parent_id=token[1],
+                              scheduler=self.name)
             if waited * 1000.0 > timeout_ms:
                 # dead work: the client already timed out (reference
                 # QueryActor discards overdue queries).  The future may
@@ -129,14 +148,23 @@ class QueryScheduler:
                 continue
             if not fut.set_running_or_notify_cancel():
                 continue  # cancelled while queued
+            t_run = time.monotonic()
             try:
-                fut.set_result(fn())
+                with TRACER.attach(token), \
+                        TRACER.span("scheduler.run", scheduler=self.name):
+                    out = fn()
+                fut.set_result(out)
             except BaseException as e:  # noqa: BLE001 — surface via future
                 fut.set_exception(e)
             finally:
+                self._m_run.observe(time.monotonic() - t_run)
                 self._m_done.inc(scheduler=self.name)
 
     def shutdown(self, wait: bool = True) -> None:
+        # deregister the depth callback: the global gauge must not keep
+        # this scheduler (heap, queued closures) alive or keep exporting
+        # a row for a dead instance
+        self._m_depth.remove(scheduler=self.name)
         with self._lock:
             self._shutdown = True
             # fail whatever is still queued

@@ -267,8 +267,13 @@ def serialize_result(result: QueryResult) -> dict:
             batches.append({"type": "Json", "data": b})
         else:
             raise WireError(f"cannot serialize batch {type(b).__name__}")
-    return {"query_id": result.query_id, "batches": batches,
-            "stats": {"series_scanned": result.stats.series_scanned}}
+    st = result.stats
+    # FULL stats travel (ISSUE 2): per-stage timings and scan-volume
+    # counters merge up the coordinator's exec tree like local ones
+    stats = {f.name: getattr(st, f.name)
+             for f in dataclasses.fields(QueryStats) if f.name != "timings"}
+    stats["timings"] = {k: float(v) for k, v in st.timings.items()}
+    return {"query_id": result.query_id, "batches": batches, "stats": stats}
 
 
 def deserialize_result(d: dict) -> QueryResult:
@@ -304,6 +309,7 @@ def deserialize_result(d: dict) -> QueryResult:
             batches.append(RawBatch(b["keys"], cb))
         else:
             raise WireError(f"unknown batch type {kind}")
-    stats = QueryStats(series_scanned=d.get("stats", {})
-                       .get("series_scanned", 0))
+    known = {f.name for f in dataclasses.fields(QueryStats)}
+    stats = QueryStats(**{k: v for k, v in d.get("stats", {}).items()
+                          if k in known})
     return QueryResult(d.get("query_id", ""), batches, stats)

@@ -106,6 +106,12 @@ class FiloServer:
         self.failure_detector.heartbeat(self.node)
         up = REGISTRY.gauge("filodb_node_up")
         up.set(1.0, node=self.node)
+        # slow-query forensics threshold (seconds); completed queries
+        # slower than this keep their span tree in /admin/slowlog
+        thr = self.config.get("slow-query-threshold-s")
+        if thr is not None:
+            from filodb_tpu.utils.forensics import TRACE_STORE
+            TRACE_STORE.slow_threshold_s = float(thr)
 
         for ds_conf in self.config.get("datasets", []):
             self._setup_dataset(ds_conf)

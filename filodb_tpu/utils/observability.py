@@ -14,8 +14,11 @@ sampling profiler."""
 
 from __future__ import annotations
 
+import bisect
 import collections
+import contextlib
 import dataclasses
+import random
 import sys
 import threading
 import time
@@ -79,17 +82,32 @@ class Gauge:
             return float(self._fns[key]())
         return self._values.get(key, 0.0)
 
-    def total(self) -> float:
-        """Sum across every label set (admin summaries)."""
+    def remove(self, **labels) -> None:
+        """Drop one label set (both value and set_fn).  Components that
+        register bound-method callbacks MUST call this on shutdown or
+        the registry keeps them (and everything they capture) alive and
+        keeps exporting rows for dead instances."""
+        key = tuple(sorted(labels.items()))
         with self._lock:
-            return sum(self._values.values()) + \
-                sum(fn() for fn in self._fns.values())
+            self._fns.pop(key, None)
+            self._values.pop(key, None)
+
+    def total(self) -> float:
+        """Sum across every label set (admin summaries).  ``set_fn``
+        callbacks run OUTSIDE the gauge lock: a callback that touches
+        this same gauge (or blocks on something that does) must not
+        deadlock the scrape."""
+        with self._lock:
+            vals = sum(self._values.values())
+            fns = list(self._fns.values())
+        return vals + sum(fn() for fn in fns)
 
     def expose(self) -> list[str]:
         out = [f"# TYPE {self.name} gauge"]
-        with self._lock:
-            items = list(self._values.items()) + \
-                [(k, fn()) for k, fn in self._fns.items()]
+        with self._lock:  # snapshot under the lock, call fns outside it
+            vals = list(self._values.items())
+            fns = list(self._fns.items())
+        items = vals + [(k, fn()) for k, fn in fns]
         for key, v in sorted(items):
             out.append(f"{self.name}{_fmt_labels(key)} {_fmt_val(v)}")
         return out
@@ -101,7 +119,10 @@ class Histogram:
     def __init__(self, name: str, help_: str = "",
                  buckets: Sequence[float] = _BUCKETS):
         self.name, self.help = name, help_
-        self.buckets = tuple(buckets)
+        self.buckets = tuple(sorted(buckets))
+        # per-bucket RAW counts (one extra slot for > last bucket);
+        # observe() is on every hot path, so it does ONE bisect + ONE
+        # increment — the cumulative le-counts are computed at expose()
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = collections.defaultdict(float)
         self._totals: dict[tuple, int] = collections.defaultdict(int)
@@ -109,11 +130,14 @@ class Histogram:
 
     def observe(self, value: float, **labels) -> None:
         key = tuple(sorted(labels.items()))
+        # first bucket b with value <= b (buckets are sorted ascending);
+        # len(buckets) = the +Inf overflow slot
+        i = bisect.bisect_left(self.buckets, value)
         with self._lock:
-            counts = self._counts.setdefault(key, [0] * len(self.buckets))
-            for i, b in enumerate(self.buckets):
-                if value <= b:
-                    counts[i] += 1
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = self._counts[key] = [0] * (len(self.buckets) + 1)
+            counts[i] += 1
             self._sums[key] += value
             self._totals[key] += 1
 
@@ -124,10 +148,11 @@ class Histogram:
             totals = dict(self._totals)
         out = [f"# TYPE {self.name} histogram"]
         for key in sorted(counts):
+            cum = 0
             for i, b in enumerate(self.buckets):
+                cum += counts[key][i]
                 lk = key + (("le", repr(b)),)
-                out.append(f"{self.name}_bucket{_fmt_labels(lk)} "
-                           f"{counts[key][i]}")
+                out.append(f"{self.name}_bucket{_fmt_labels(lk)} {cum}")
             lk = key + (("le", "+Inf"),)
             out.append(f"{self.name}_bucket{_fmt_labels(lk)} {totals[key]}")
             out.append(f"{self.name}_sum{_fmt_labels(key)} "
@@ -227,9 +252,80 @@ def integrity_metrics() -> dict:
     }
 
 
+def query_metrics() -> dict:
+    """Canonical query-pipeline metrics (ISSUE 2): one place defines the
+    names so the HTTP layer, scheduler, and docs can never drift."""
+    return {
+        "request_seconds": REGISTRY.histogram(
+            "filodb_query_request_seconds",
+            "HTTP route handler latency by endpoint"),
+        "requests": REGISTRY.counter(
+            "filodb_query_requests_total",
+            "HTTP requests by endpoint and status code"),
+        "run_seconds": REGISTRY.histogram(
+            "filodb_query_run_seconds",
+            "query execution time on a scheduler worker (excl. queue)"),
+        "slow_queries": REGISTRY.counter(
+            "filodb_query_slow_total",
+            "completed queries over the slow-query threshold"),
+        "execplan_seconds": REGISTRY.histogram(
+            "filodb_query_execplan_remote_seconds",
+            "remote /execplan leaf execution latency"),
+    }
+
+
+def ingest_metrics() -> dict:
+    """Canonical gateway-ingest metrics."""
+    return {
+        "samples": REGISTRY.counter(
+            "filodb_ingest_samples_total",
+            "samples accepted by the gateway sharding publisher"),
+        "parse_errors": REGISTRY.counter(
+            "filodb_ingest_parse_errors_total",
+            "malformed influx lines rejected by the gateway"),
+        "batch_seconds": REGISTRY.histogram(
+            "filodb_ingest_batch_seconds",
+            "gateway batch ingest latency (parse -> route -> build)"),
+    }
+
+
+def flush_metrics() -> dict:
+    """Canonical memstore-flush metrics."""
+    return {
+        "flush_seconds": REGISTRY.histogram(
+            "filodb_flush_seconds",
+            "run_flush_task latency (encode + IO + checkpoint)"),
+        "chunks": REGISTRY.counter(
+            "filodb_flush_chunks_total", "chunksets written by flushes"),
+        "failures": REGISTRY.counter(
+            "filodb_flush_failures_total",
+            "flush tasks that raised (work requeued)"),
+    }
+
+
+def odp_metrics() -> dict:
+    """Canonical on-demand-paging metrics."""
+    return {
+        "pagein_seconds": REGISTRY.histogram(
+            "filodb_odp_pagein_seconds",
+            "page-in latency (store read + decode + materialize)"),
+        "partitions": REGISTRY.counter(
+            "filodb_odp_partitions_paged_total",
+            "partitions re-materialized from the column store"),
+        "chunks": REGISTRY.counter(
+            "filodb_odp_chunks_paged_total",
+            "chunks read back from the column store"),
+    }
+
+
 # ---------------------------------------------------------------------------
 # Tracing spans
 # ---------------------------------------------------------------------------
+
+
+def _new_id() -> str:
+    """64-bit random hex id (span/trace ids on the wire)."""
+    return f"{random.getrandbits(64):016x}"
 
 
 @dataclasses.dataclass
@@ -238,13 +334,25 @@ class SpanRecord:
     start_s: float
     duration_s: float
     tags: dict
-    parent: Optional[str]
+    parent: Optional[str]          # parent span NAME (log reporters)
     error: Optional[str] = None
+    # trace stitching (ISSUE 2): ids travel across threads and nodes so
+    # a scatter-gather fan-out reassembles into one tree
+    trace_id: Optional[str] = None
+    span_id: str = ""
+    parent_id: Optional[str] = None
 
 
 class Tracer:
     """Thread-local span stack + pluggable reporters (replaces Kamon
-    span propagation via Kamon.runWithSpan)."""
+    span propagation via Kamon.runWithSpan).
+
+    Each thread carries a trace context: a ``trace_id`` minted at the
+    query entry point plus the current span's id.  ``capture()`` /
+    ``attach()`` move that context across thread pools (scheduler
+    workers, scatter-gather child dispatch), and the dispatch layer
+    moves it across processes via an HTTP header + execplan-wire field.
+    """
 
     def __init__(self) -> None:
         self._local = threading.local()
@@ -255,16 +363,69 @@ class Tracer:
         with self._lock:
             self._reporters.append(fn)
 
+    def remove_reporter(self, fn: Callable[[SpanRecord], None]) -> None:
+        with self._lock:
+            self._reporters = [r for r in self._reporters if r is not fn]
+
     def clear_reporters(self) -> None:
         with self._lock:
             self._reporters = []
 
     def current_span(self) -> Optional[str]:
         stack = getattr(self._local, "stack", None)
-        return stack[-1] if stack else None
+        return stack[-1][0] if stack else None
+
+    def current_span_id(self) -> Optional[str]:
+        stack = getattr(self._local, "stack", None)
+        if stack:
+            return stack[-1][1]
+        return getattr(self._local, "parent_hint", None)
+
+    def current_trace_id(self) -> Optional[str]:
+        return getattr(self._local, "trace_id", None)
+
+    @staticmethod
+    def new_trace_id() -> str:
+        return _new_id()
+
+    def capture(self) -> tuple:
+        """(trace_id, span_id) token for cross-thread propagation."""
+        return self.current_trace_id(), self.current_span_id()
+
+    @contextlib.contextmanager
+    def attach(self, token):
+        """Install a captured trace context on this thread: spans opened
+        inside parent onto ``token``'s span id and carry its trace id.
+        The span stack is swapped for a FRESH one — the context is
+        foreign, so an unrelated span already open on this thread (e.g.
+        a scheduler worker's own span) must not capture the parentage."""
+        tid, sid = token if token else (None, None)
+        old_tid = getattr(self._local, "trace_id", None)
+        old_hint = getattr(self._local, "parent_hint", None)
+        old_stack = getattr(self._local, "stack", None)
+        self._local.trace_id = tid
+        self._local.parent_hint = sid
+        self._local.stack = []
+        try:
+            yield
+        finally:
+            self._local.trace_id = old_tid
+            self._local.parent_hint = old_hint
+            self._local.stack = old_stack
 
     def span(self, name: str, **tags):
         return _Span(self, name, tags)
+
+    def record(self, name: str, duration_s: float,
+               trace_id: Optional[str] = None,
+               parent_id: Optional[str] = None, **tags) -> SpanRecord:
+        """Report a synthetic span that did not run on this thread
+        (queue wait measured by a worker, a remote node's spans)."""
+        rec = SpanRecord(name, time.time() - duration_s, duration_s,
+                         tags, None, trace_id=trace_id, span_id=_new_id(),
+                         parent_id=parent_id)
+        self._report(rec)
+        return rec
 
     def _report(self, rec: SpanRecord) -> None:
         with self._lock:
@@ -281,14 +442,20 @@ class _Span:
         self.tracer = tracer
         self.name = name
         self.tags = tags
+        self.span_id = _new_id()
         self._t0 = 0.0
 
     def __enter__(self):
-        stack = getattr(self.tracer._local, "stack", None)
+        local = self.tracer._local
+        stack = getattr(local, "stack", None)
         if stack is None:
-            stack = self.tracer._local.stack = []
-        self.parent = stack[-1] if stack else None
-        stack.append(self.name)
+            stack = local.stack = []
+        if stack:
+            self.parent, self.parent_id = stack[-1]
+        else:
+            self.parent = None
+            self.parent_id = getattr(local, "parent_hint", None)
+        stack.append((self.name, self.span_id))
         self._t0 = time.perf_counter()
         return self
 
@@ -298,10 +465,15 @@ class _Span:
 
     def __exit__(self, exc_type, exc, tb):
         dur = time.perf_counter() - self._t0
-        self.tracer._local.stack.pop()
+        try:  # spans must NEVER raise into the instrumented path
+            self.tracer._local.stack.pop()
+        except (AttributeError, IndexError):
+            pass
         self.tracer._report(SpanRecord(
             self.name, time.time() - dur, dur, dict(self.tags), self.parent,
-            error=repr(exc) if exc is not None else None))
+            error=repr(exc) if exc is not None else None,
+            trace_id=self.tracer.current_trace_id(),
+            span_id=self.span_id, parent_id=self.parent_id))
         return False
 
 

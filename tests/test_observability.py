@@ -1,0 +1,281 @@
+"""Metrics primitives, exposition-format correctness, trace forensics.
+
+ISSUE 2 satellites: Prometheus text-exposition grammar + histogram
+invariants, the Gauge set_fn-under-lock deadlock regression, scheduler
+saturation metrics, and the TraceStore/slow-log/profiler units."""
+
+import re
+import threading
+import time
+
+import pytest
+
+from filodb_tpu.utils.forensics import (TraceStore, profile, span_from_dict,
+                                        span_to_dict)
+from filodb_tpu.utils.observability import (REGISTRY, MetricsRegistry,
+                                            SpanRecord, Tracer)
+
+# ---------------------------------------------------------------------------
+# Exposition-format grammar (satellite: line-by-line correctness)
+# ---------------------------------------------------------------------------
+
+_COMMENT_RE = re.compile(
+    r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|histogram)$")
+_METRIC_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})? "
+    r"(?P<value>NaN|[+-]Inf|-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:\\.|[^"\\])*)"')
+
+
+def _assert_exposition_valid(text: str) -> None:
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            assert _COMMENT_RE.match(line), f"bad comment line: {line!r}"
+            continue
+        m = _METRIC_RE.match(line)
+        assert m, f"line does not match exposition grammar: {line!r}"
+        labels = m.group("labels")
+        if labels is not None:
+            # every byte of the label block must be consumed by
+            # well-formed name="escaped-value" pairs
+            rebuilt = ",".join(f'{k}="{v}"'
+                               for k, v in _LABEL_RE.findall(labels))
+            assert rebuilt == labels, f"malformed labels in: {line!r}"
+
+
+class TestExposition:
+    def test_label_escaping(self):
+        reg = MetricsRegistry()
+        c = reg.counter("esc_total")
+        c.inc(path='with"quote', other="back\\slash", nl="a\nb")
+        text = reg.expose_text()
+        _assert_exposition_valid(text)
+        assert '\\"' in text and "\\\\" in text and "\\n" in text
+        # no RAW newline inside any metric line
+        for line in text.splitlines():
+            assert "\n" not in line
+
+    def test_full_registry_parses(self):
+        # the PROCESS registry: whatever every subsystem registered must
+        # come out grammatically valid, line by line
+        REGISTRY.counter("exp_probe_total").inc(dataset="p", weird='q"x')
+        REGISTRY.histogram("exp_probe_seconds").observe(0.2, lane="a\\b")
+        _assert_exposition_valid(REGISTRY.expose_text())
+
+    def test_histogram_invariants(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+        for v in (0.001, 0.01, 0.05, 0.1, 0.5, 2.0, 100.0):
+            h.observe(v, op="x")
+        lines = reg.expose_text().splitlines()
+        buckets = {}
+        count = total_sum = None
+        for ln in lines:
+            m = _METRIC_RE.match(ln)
+            if not m:
+                continue
+            if m.group("name") == "lat_seconds_bucket":
+                le = dict(_LABEL_RE.findall(m.group("labels")))["le"]
+                buckets[le] = float(m.group("value"))
+            elif m.group("name") == "lat_seconds_count":
+                count = float(m.group("value"))
+            elif m.group("name") == "lat_seconds_sum":
+                total_sum = float(m.group("value"))
+        # le="b" means value <= b: boundary observations fall IN bucket
+        assert buckets["0.01"] == 2          # 0.001, 0.01
+        assert buckets["0.1"] == 4           # + 0.05, 0.1
+        assert buckets["1.0"] == 5           # + 0.5
+        assert buckets["+Inf"] == 7
+        # cumulative monotone + count == +Inf bucket
+        seq = [buckets["0.01"], buckets["0.1"], buckets["1.0"],
+               buckets["+Inf"]]
+        assert seq == sorted(seq)
+        assert count == buckets["+Inf"] == 7
+        assert total_sum == pytest.approx(sum(
+            (0.001, 0.01, 0.05, 0.1, 0.5, 2.0, 100.0)))
+
+    def test_histogram_unsorted_buckets_normalized(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("uns_seconds", buckets=(1.0, 0.1, 0.01))
+        assert h.buckets == (0.01, 0.1, 1.0)
+        h.observe(0.05)
+        assert h._counts[()][1] == 1  # bisect lands in the 0.1 bucket
+
+
+class TestGaugeLock:
+    def test_set_fn_touching_gauge_does_not_deadlock(self):
+        """Regression (satellite 1): expose()/total() used to call the
+        registered set_fn callbacks while holding the gauge lock, so a
+        callback touching the same gauge deadlocked the scrape."""
+        reg = MetricsRegistry()
+        g = reg.gauge("self_referential")
+
+        def cb():
+            g.set(5.0, which="side_effect")  # takes the gauge lock
+            return 7.0
+
+        g.set_fn(cb, which="cb")
+        out = []
+
+        def scrape():
+            out.append(g.expose())
+            out.append(g.total())
+
+        t = threading.Thread(target=scrape, daemon=True)
+        t.start()
+        t.join(timeout=5)
+        assert not t.is_alive(), \
+            "gauge scrape deadlocked calling its own set_fn"
+        assert out[1] == 7.0 + 5.0
+
+
+class TestSchedulerSaturationMetrics:
+    def test_queue_depth_gauge_and_rejection_counter(self):
+        from filodb_tpu.query.scheduler import QueryRejected, QueryScheduler
+        s = QueryScheduler(num_workers=1, max_queued=2, name="satsched")
+        try:
+            gate = threading.Event()
+            started = threading.Event()
+            s.submit(lambda: started.set() or gate.wait(5))
+            started.wait(5)
+            s.submit(lambda: 1)
+            s.submit(lambda: 2)
+            depth = REGISTRY.gauge("filodb_query_queue_depth")
+            assert depth.value(scheduler="satsched") == 2
+            rej = REGISTRY.counter("filodb_queries_rejected_total")
+            before = rej.value(scheduler="satsched", reason="full")
+            with pytest.raises(QueryRejected):
+                s.submit(lambda: 3)
+            assert rej.value(scheduler="satsched",
+                             reason="full") == before + 1
+            gate.set()
+        finally:
+            s.shutdown()
+        # shutdown must deregister the depth callback: no row for a
+        # dead scheduler, no bound method keeping it alive
+        text = "\n".join(REGISTRY.gauge("filodb_query_queue_depth")
+                         .expose())
+        assert 'scheduler="satsched"' not in text
+
+
+# ---------------------------------------------------------------------------
+# Trace forensics
+# ---------------------------------------------------------------------------
+
+
+class TestTraceStore:
+    def _traced(self, store, fn):
+        tracer = Tracer()
+        tracer.add_reporter(store.report)
+        tid = tracer.new_trace_id()
+        with tracer.attach((tid, None)):
+            fn(tracer)
+        return tid
+
+    def test_tree_nesting_and_untraced_spans_skipped(self):
+        store = TraceStore()
+
+        def work(tracer):
+            with tracer.span("root", dataset="p"):
+                with tracer.span("child"):
+                    pass
+                with tracer.span("child2"):
+                    pass
+
+        tid = self._traced(store, work)
+        # spans with no trace id never enter the store
+        store.report(SpanRecord("orphan", 0, 0.1, {}, None))
+        tree = store.tree(tid)
+        assert len(tree) == 1 and tree[0]["name"] == "root"
+        kids = [c["name"] for c in tree[0]["children"]]
+        assert kids == ["child", "child2"]
+        assert tid not in ("", None) and store.tree("nope") == []
+
+    def test_slowlog_threshold(self):
+        store = TraceStore(slow_threshold_s=0.5)
+        tid = self._traced(
+            store, lambda tr: tr.span("q").__enter__().__exit__(
+                None, None, None))
+        store.note_complete(tid, 0.1, query="fast")
+        assert store.slowlog() == []
+        store.note_complete(tid, 0.9, query="slow", dataset="prom")
+        log = store.slowlog()
+        assert len(log) == 1
+        assert log[0]["query"] == "slow"
+        assert log[0]["trace_id"] == tid
+        assert log[0]["tree"] and log[0]["tree"][0]["name"] == "q"
+
+    def test_ingest_remote_dedups_and_stitches(self):
+        store = TraceStore()
+        tid = "feedfeedfeedfeed"
+        local = SpanRecord("dispatch.http", 0, 1.0, {}, None,
+                           trace_id=tid, span_id="aaa")
+        store.report(local)
+        remote = [{"name": "execplan.execute", "start_s": 0.1,
+                   "duration_s": 0.5, "tags": {"shard": "1"},
+                   "trace_id": tid, "span_id": "bbb", "parent_id": "aaa"}]
+        store.ingest_remote(tid, remote)
+        store.ingest_remote(tid, remote)  # a second leaf returns it again
+        spans = store.spans_for(tid)
+        assert [r.span_id for r in spans] == ["aaa", "bbb"]
+        tree = store.tree(tid)
+        assert tree[0]["name"] == "dispatch.http"
+        assert tree[0]["children"][0]["name"] == "execplan.execute"
+
+    def test_bounded_traces(self):
+        store = TraceStore(max_traces=4)
+        for i in range(10):
+            store.report(SpanRecord("s", 0, 0.1, {}, None,
+                                    trace_id=f"t{i}", span_id=f"id{i}"))
+        assert len(store.trace_ids()) == 4
+        assert store.trace_ids()[-1] == "t9"
+
+    def test_span_dict_roundtrip(self):
+        rec = SpanRecord("n", 1.0, 2.0, {"a": 1}, None, error="E",
+                         trace_id="t", span_id="s", parent_id="p")
+        back = span_from_dict(span_to_dict(rec))
+        assert back.name == "n" and back.trace_id == "t"
+        assert back.span_id == "s" and back.parent_id == "p"
+        assert back.error == "E" and back.tags == {"a": "1"}
+
+
+def test_profile_returns_hot_frames():
+    stop = threading.Event()
+
+    def burn():
+        while not stop.is_set():
+            sum(i * i for i in range(500))
+
+    t = threading.Thread(target=burn, daemon=True)
+    t.start()
+    try:
+        out = profile(seconds=0.15, sample_interval_s=0.002)
+    finally:
+        stop.set()
+        t.join(1)
+    assert out["samples"] >= 1
+    assert out["frames"] and {"file", "function", "samples", "pct"} <= \
+        set(out["frames"][0])
+
+
+def test_tracer_ids_and_attach():
+    tracer = Tracer()
+    recs = []
+    tracer.add_reporter(recs.append)
+    tid = tracer.new_trace_id()
+    with tracer.attach((tid, "parenthint")):
+        with tracer.span("outer"):
+            token = tracer.capture()
+            with tracer.span("inner"):
+                pass
+    assert [r.name for r in recs] == ["inner", "outer"]
+    inner, outer = recs
+    assert outer.trace_id == inner.trace_id == tid
+    assert outer.parent_id == "parenthint"  # hint parents the root span
+    assert inner.parent_id == outer.span_id
+    assert token == (tid, outer.span_id)
+    # outside the attach the thread is clean again
+    assert tracer.current_trace_id() is None

@@ -152,6 +152,85 @@ def test_native_decode_sentinels_are_checked():
         "native decode sentinel discarded at:\n  " + "\n  ".join(violations)
 
 
+# ---------------------------------------------------------------------------
+# HTTP route-handler latency lint (ISSUE 2): every handler the server's
+# _route dispatches to must wear the @_timed decorator, so no endpoint
+# added later can be dark on the request histogram.
+# ---------------------------------------------------------------------------
+
+
+def _route_handlers(tree):
+    """(class node, handler method names called as ``return self._x(...)``
+    inside FiloHttpServer._route)."""
+    for cls in ast.walk(tree):
+        if not (isinstance(cls, ast.ClassDef)
+                and cls.name == "FiloHttpServer"):
+            continue
+        for fn in cls.body:
+            if isinstance(fn, ast.FunctionDef) and fn.name == "_route":
+                names = set()
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Return) or node.value is None:
+                        continue
+                    for c in ast.walk(node.value):
+                        if isinstance(c, ast.Call) \
+                                and isinstance(c.func, ast.Attribute) \
+                                and isinstance(c.func.value, ast.Name) \
+                                and c.func.value.id == "self":
+                            names.add(c.func.attr)
+                return cls, names
+    return None, set()
+
+
+def _untimed_handlers(src: str) -> list:
+    tree = ast.parse(src)
+    cls, names = _route_handlers(tree)
+    if cls is None:
+        return ["FiloHttpServer._route not found"]
+    bad = []
+    for fn in cls.body:
+        if not (isinstance(fn, ast.FunctionDef) and fn.name in names):
+            continue
+        decorated = False
+        for d in fn.decorator_list:
+            target = d.func if isinstance(d, ast.Call) else d
+            if isinstance(target, ast.Name) and target.id == "_timed":
+                decorated = True
+        if not decorated:
+            bad.append(f"{fn.name} (line {fn.lineno}): dispatched from "
+                       f"_route but not decorated with @_timed — its "
+                       f"latency never reaches the request histogram")
+    return bad
+
+
+def test_route_handlers_record_latency():
+    src = (ROOT / "http" / "server.py").read_text()
+    bad = _untimed_handlers(src)
+    assert not bad, "dark HTTP endpoints:\n  " + "\n  ".join(bad)
+
+
+def test_route_lint_catches_dark_endpoint():
+    """The route lint must actually fire on an undecorated handler."""
+    fake = (
+        "class FiloHttpServer:\n"
+        "    def _route(self, path, params, multi=None):\n"
+        "        return self._dark(params)\n"
+        "    def _dark(self, p):\n"
+        "        return 200, {}\n"
+    )
+    bad = _untimed_handlers(fake)
+    assert len(bad) == 1 and "_dark" in bad[0]
+    timed = (
+        "class FiloHttpServer:\n"
+        "    def _route(self, path, params, multi=None):\n"
+        "        return self._lit(params)\n"
+        "    @_timed('lit')\n"
+        "    def _lit(self, p):\n"
+        "        return 200, {}\n"
+    )
+    assert _untimed_handlers(timed) == []
+
+
 def test_lint_catches_a_discarded_sentinel():
     """The lint itself must actually fire on the bad pattern."""
     bad = (

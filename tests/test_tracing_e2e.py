@@ -1,0 +1,259 @@
+"""End-to-end query tracing across a 2-node (in-process) cluster.
+
+ISSUE 2 acceptance: a query_range over HTTP with stats=true returns
+per-stage timings, and /admin/traces/<trace_id> on the coordinator
+shows ONE stitched span tree including the remote shard's spans
+(propagated via the X-FiloDB-Trace-Id header + execplan-wire field)."""
+
+import json
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.dispatch import (PARENT_SPAN_HEADER,
+                                             TRACE_HEADER,
+                                             dispatcher_factory)
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.core.record import RecordBuilder, decode_container
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+from filodb_tpu.http.server import DatasetBinding, FiloHttpServer
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.parallel.shardmap import ShardMapper, ShardStatus
+from filodb_tpu.query.scheduler import QueryScheduler
+from filodb_tpu.utils.forensics import TRACE_STORE
+
+BASE = 1_700_000_000_000
+STEP = 10_000
+
+
+def _get(port, path, **params):
+    qs = urllib.parse.urlencode(params)
+    url = f"http://127.0.0.1:{port}{path}" + (f"?{qs}" if qs else "")
+    try:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    """Two memstores, half the shards each; BOTH nodes serve HTTP and
+    node-a (the coordinator) dispatches node-b's shards over the wire.
+    node-a runs a query scheduler, node-b a leaf scheduler, so trace
+    context must survive both thread-pool handoffs."""
+    num_shards = 4
+    mapper = ShardMapper(num_shards)
+    rng = np.random.default_rng(5)
+    b = RecordBuilder(DEFAULT_SCHEMAS["prom-counter"])
+    for i in range(8):
+        tags = {"__name__": "trace_total", "instance": f"i{i}",
+                "_ws_": "demo", "_ns_": "App-0"}
+        ts = BASE + np.arange(300) * STEP
+        vals = np.cumsum(rng.random(300))
+        for t, v in zip(ts, vals):
+            b.add(int(t), [float(v)], tags)
+    by_shard = {}
+    for off, c in enumerate(b.containers()):
+        for rec in decode_container(c, DEFAULT_SCHEMAS):
+            shard = mapper.ingestion_shard(rec.shard_hash, rec.part_hash, 1) \
+                % num_shards
+            by_shard.setdefault(shard, []).append((off, rec))
+    used = sorted(by_shard)
+    assert len(used) == 2
+    shards_a = [used[0]] + [s for s in range(num_shards) if s not in used]
+    shards_b = [used[1]]
+    mapper.register_node(shards_a, "node-a")
+    mapper.register_node(shards_b, "node-b")
+    for s in range(num_shards):
+        mapper.update_status(s, ShardStatus.ACTIVE)
+
+    stores = {"node-a": TimeSeriesMemStore(), "node-b": TimeSeriesMemStore()}
+    for ms in stores.values():
+        for s in range(num_shards):
+            ms.setup("prom", DEFAULT_SCHEMAS, s)
+    for shard, recs in by_shard.items():
+        node = mapper.coord_for_shard(shard)
+        for off, rec in recs:
+            stores[node].get_shard("prom", shard).ingest([rec], off)
+
+    srv_b = FiloHttpServer()
+    planner_b = SingleClusterPlanner("prom", mapper, DatasetOptions(),
+                                     spread_default=1)
+    leaf_sched = QueryScheduler(num_workers=2, name="e2e-leaf")
+    srv_b.bind_dataset(DatasetBinding("prom", stores["node-b"], planner_b,
+                                      leaf_scheduler=leaf_sched))
+    port_b = srv_b.start()
+
+    endpoints = {"node-b": f"http://127.0.0.1:{port_b}"}
+    disp = dispatcher_factory(mapper, endpoints, local_node="node-a")
+    planner_a = SingleClusterPlanner("prom", mapper, DatasetOptions(),
+                                     spread_default=1,
+                                     dispatcher_for_shard=disp)
+    srv_a = FiloHttpServer()
+    qsched = QueryScheduler(num_workers=2, name="e2e-query")
+    srv_a.bind_dataset(DatasetBinding("prom", stores["node-a"], planner_a,
+                                      scheduler=qsched))
+    port_a = srv_a.start()
+    yield {"port_a": port_a, "port_b": port_b,
+           "remote_shard": shards_b[0], "endpoints": endpoints}
+    srv_a.shutdown()
+    srv_b.shutdown()
+    qsched.shutdown()
+    leaf_sched.shutdown()
+
+
+def _query_range(cluster, **extra):
+    params = dict(
+        query='sum(rate(trace_total{_ws_="demo",_ns_="App-0"}[2m]))',
+        start=(BASE + 600_000) / 1000, end=(BASE + 1_200_000) / 1000,
+        step="30s", **extra)
+    return _get(cluster["port_a"], "/promql/prom/api/v1/query_range",
+                **params)
+
+
+def _flatten(nodes, out=None):
+    out = [] if out is None else out
+    for n in nodes:
+        out.append(n)
+        _flatten(n["children"], out)
+    return out
+
+
+class TestStatsResponse:
+    def test_stats_true_shape(self, cluster):
+        code, body, headers = _query_range(cluster, stats="true")
+        assert code == 200 and body["status"] == "success"
+        assert len(body["data"]["result"]) == 1
+        stats = body["data"]["stats"]
+        timings = stats["timings"]
+        for key in ("plan", "queue", "scan", "total"):
+            assert key in timings, f"missing stage bucket {key}: {timings}"
+        assert timings["total"] >= timings["plan"] >= 0.0
+        samples = stats["samples"]
+        # 8 series x 300 rows scanned somewhere across the two nodes
+        assert samples["samplesScanned"] > 0
+        assert samples["bytesScanned"] > 0
+        assert stats["traceId"]
+        assert headers.get("X-FiloDB-Trace-Id") == stats["traceId"]
+
+    def test_no_stats_by_default(self, cluster):
+        code, body, headers = _query_range(cluster)
+        assert code == 200
+        assert "stats" not in body["data"]
+        assert "X-FiloDB-Trace-Id" not in headers
+
+    def test_instant_query_stats(self, cluster):
+        code, body, _ = _get(
+            cluster["port_a"], "/promql/prom/api/v1/query",
+            query='count(trace_total{_ws_="demo",_ns_="App-0"})',
+            time=(BASE + 900_000) / 1000, stats="true")
+        assert code == 200
+        assert "timings" in body["data"]["stats"]
+
+
+class TestStitchedTrace:
+    def test_remote_spans_joined_into_one_tree(self, cluster):
+        code, body, _ = _query_range(cluster, stats="true")
+        assert code == 200
+        tid = body["data"]["stats"]["traceId"]
+        code, tbody, _ = _get(cluster["port_a"], f"/admin/traces/{tid}")
+        assert code == 200
+        roots = tbody["data"]["spans"]
+        assert len(roots) == 1, \
+            f"expected ONE stitched tree, got roots " \
+            f"{[r['name'] for r in roots]}"
+        assert roots[0]["name"] == "query"
+        flat = _flatten(roots)
+        names = [n["name"] for n in flat]
+        assert "query.execute" in names
+        assert "query.plan" in names
+        assert "scheduler.queue_wait" in names  # node-a's scheduler
+        # the remote dispatch span exists and the remote shard's
+        # execplan span hangs UNDER it (correct parentage across the
+        # process boundary), tagged with the remote shard id
+        http_nodes = [n for n in flat if n["name"] == "dispatch.http"]
+        assert http_nodes, names
+        remote_kids = _flatten(http_nodes[0]["children"])
+        remote_exec = [n for n in remote_kids
+                       if n["name"] == "execplan.execute"]
+        assert remote_exec, \
+            "remote shard's spans were not stitched under dispatch.http"
+        assert any(n["tags"].get("shard") == str(cluster["remote_shard"])
+                   for n in remote_exec)
+        # the DATA NODE's leaf-scheduler queue-wait/run split must join
+        # the tree too (trace attached before submit on the remote side)
+        remote_names = {n["name"] for n in remote_kids}
+        assert "scheduler.run" in remote_names, remote_names
+        assert "scheduler.queue_wait" in remote_names, remote_names
+
+    def test_unknown_trace_404(self, cluster):
+        code, body, _ = _get(cluster["port_a"], "/admin/traces/deadbeef00")
+        assert code == 404
+
+    def test_execplan_response_carries_spans(self, cluster):
+        """The wire half of stitching: a data node returns its spans for
+        the originating trace with the /execplan response."""
+        from filodb_tpu.query.exec import MultiSchemaPartitionsExec
+        from filodb_tpu.query import wire
+        from filodb_tpu.core.filters import ColumnFilter, Equals
+        plan = MultiSchemaPartitionsExec(
+            "prom", cluster["remote_shard"],
+            [ColumnFilter("_metric_", Equals("trace_total"))],
+            BASE, BASE + 600_000)
+        payload = wire.serialize_plan(plan)
+        tid = "e2e0wire0trace00"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{cluster['port_b']}/execplan",
+            data=json.dumps(payload).encode(), method="POST",
+            headers={"Content-Type": "application/json",
+                     TRACE_HEADER: tid, PARENT_SPAN_HEADER: "c0ffee"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        spans = out.get("spans")
+        assert spans, "execplan response is missing its spans"
+        assert all(s["trace_id"] == tid for s in spans)
+        roots = [s for s in spans if s["parent_id"] == "c0ffee"]
+        assert roots, "remote root span must parent onto the header span"
+        # full stats travel on the wire too
+        assert "timings" in out["stats"]
+        assert out["stats"]["timings"].get("scan", 0) > 0
+
+
+class TestForensicsEndpoints:
+    def test_slowlog_captures_query(self, cluster):
+        old = TRACE_STORE.slow_threshold_s
+        TRACE_STORE.slow_threshold_s = 0.0
+        try:
+            code, body, _ = _query_range(cluster, stats="true")
+            tid = body["data"]["stats"]["traceId"]
+            code, slog, _ = _get(cluster["port_a"], "/admin/slowlog")
+            assert code == 200
+            entries = slog["data"]["entries"]
+            mine = [e for e in entries if e["trace_id"] == tid]
+            assert mine, "completed query missing from the slow log"
+            assert mine[0]["query"].startswith("sum(rate(trace_total")
+            assert mine[0]["duration_s"] > 0
+            assert mine[0]["tree"], "slow-log entry lost its span tree"
+        finally:
+            TRACE_STORE.slow_threshold_s = old
+
+    def test_profilez(self, cluster):
+        code, body, _ = _get(cluster["port_a"], "/debug/profilez",
+                             seconds="0.05")
+        assert code == 200
+        assert body["data"]["samples"] >= 0
+        assert "frames" in body["data"]
+
+    def test_metrics_expose_query_families(self, cluster):
+        _query_range(cluster)
+        url = f"http://127.0.0.1:{cluster['port_a']}/metrics"
+        text = urllib.request.urlopen(url, timeout=10).read().decode()
+        assert "filodb_query_request_seconds" in text
+        assert 'endpoint="query_range"' in text
+        assert "filodb_query_queue_depth" in text
+        url_b = f"http://127.0.0.1:{cluster['port_b']}/metrics"
+        text_b = urllib.request.urlopen(url_b, timeout=10).read().decode()
+        assert "filodb_query_execplan_remote_seconds" in text_b
