@@ -138,6 +138,11 @@ def stats_payload(stats, trace_id: str = "") -> dict:
             "bytesScanned": int(stats.bytes_scanned),
             "pagesIn": int(stats.pages_in),
             "corruptChunksExcluded": int(stats.corrupt_chunks_excluded),
+            # device-grid HBM reads under device_compute, by resident
+            # format — shows whether compressed residents serve traffic
+            "hbmReadBytes": {k: int(v)
+                             for k, v in sorted(
+                                 stats.hbm_read_bytes.items())},
         },
         "traceId": trace_id,
     }

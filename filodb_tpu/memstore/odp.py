@@ -302,8 +302,10 @@ class OnDemandPagingShard(TimeSeriesShard):
         # cache on this side thread — reference:
         # DemandPagedChunkStore.scala:34 pages into block memory via
         # futures too); queries that MISS the cache join these first so
-        # a publish-in-progress never causes a redundant re-page
-        self._mat_tasks: list[threading.Thread] = []
+        # a publish-in-progress never causes a redundant re-page.  Each
+        # entry is (thread, frozenset of pids the publish will land) so
+        # per-pid misses join ONLY publishes that could contain them.
+        self._mat_tasks: list[tuple[threading.Thread, frozenset]] = []
         self.stats.partitions_paged = 0
         self.stats.chunks_paged = 0
         self.stats.page_publish_errors = 0
@@ -311,28 +313,35 @@ class OnDemandPagingShard(TimeSeriesShard):
         # fell back to the per-chunk path (which diagnoses + quarantines)
         self.stats.page_decode_corrupt = 0
 
-    def _join_materialize(self) -> None:
+    def _join_materialize(self, part_id: Optional[int] = None) -> None:
         # peek-join-remove (NOT pop-then-join): a task must stay visible
         # to concurrent threads until its publish has actually landed,
         # or a third thread could classify a miss mid-publish and
-        # duplicate the whole store read
+        # duplicate the whole store read.  With ``part_id``, only joins
+        # publishes whose pid set could contain it — a cache-miss
+        # reader must not block behind an unrelated cold dashboard's
+        # thousand-partition page-in (ADVICE r5 #4); the argless form
+        # (bulk classification under _odp_lock) still joins everything.
         while True:
-            try:
-                t = self._mat_tasks[-1]
-            except IndexError:
+            tasks = [e for e in self._mat_tasks
+                     if part_id is None or part_id in e[1]]
+            if not tasks:
                 return
-            t.join()
+            entry = tasks[-1]
+            entry[0].join()
             try:
-                self._mat_tasks.remove(t)
+                self._mat_tasks.remove(entry)
             except ValueError:
                 pass       # another joiner removed it after its join
 
     def _paged_or_join(self, part_id: int) -> Optional[TimeSeriesPartition]:
         """Page-cache read that joins an in-flight deferred publish on a
-        miss (shared by every per-pid resolution path)."""
+        miss (shared by every per-pid resolution path).  Joins ONLY
+        publishes tracking this pid, so an unrelated publish-in-progress
+        never serializes this reader behind it."""
         part = self.paged.get(part_id)
         if part is None and self._mat_tasks:
-            self._join_materialize()
+            self._join_materialize(part_id)
             part = self.paged.get(part_id)
         return part
 
@@ -705,7 +714,8 @@ class OnDemandPagingShard(TimeSeriesShard):
                 t = threading.Thread(target=publish, name="odp-publish",
                                      daemon=True)
                 t.start()   # started BEFORE it is joinable via the list
-                self._mat_tasks.append(t)
+                self._mat_tasks.append(
+                    (t, frozenset(g[0] for g in groups)))
                 return built, tags_list, ChunkBatch(ts2d, val2d, cnts)
             # ---- flat decode: fills decoded caches only
             cols = [(0, False)] + [

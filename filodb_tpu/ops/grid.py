@@ -216,6 +216,38 @@ def _correct_dense_auto(vals, roll):
     return _correct_dense(vals, roll)
 
 
+def _corr_v1_delta_banded(vals, q: GridQuery, roll):
+    """Corrected window-start values and window deltas via ONE banded
+    lower-triangular matmul — the MXU correction-prefix trick extended
+    so ``vcorr`` is never materialized:
+
+        v1[t]    = vals[t]       + sum_{0 < c <= t}       drop[c]
+        delta[t] = vals[t+K-1] - vals[t] + sum_{t < c <= t+K-1} drop[c]
+
+    Both prefix/band sums are rows of a [2T, B] 0/1 matrix applied to
+    the [B, L] drop plane in one ``dot``, replacing the [B, B]
+    triangular matmul + two sublane slices + subtract.  With 2T < B
+    (the K-heavy dashboard shape: long windows, few steps) this is
+    strictly less MXU work AND two fewer [B, L] VMEM passes; the
+    caller keeps the [B, B] formulation otherwise."""
+    nb = vals.shape[0]
+    T, K = q.nsteps, q.kbuckets
+    prev = roll(vals, 1)
+    drop = jnp.where(vals < prev, prev, 0.0)   # row 0 excluded by c > 0
+    r = jax.lax.broadcasted_iota(jnp.int32, (2 * T, nb), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (2 * T, nb), 1)
+    t = jnp.where(r < T, r, r - T)
+    lo = jnp.where(r < T, 0, t)                # c > lo
+    hi = jnp.where(r < T, t, t + K - 1)        # c <= hi
+    m = ((c > lo) & (c <= hi)).astype(jnp.float32)
+    acc = jax.lax.dot(m, drop, precision=jax.lax.Precision.HIGHEST,
+                      preferred_element_type=jnp.float32)
+    sl = _win_slicer(q, vals.shape[1])
+    v1 = sl(vals, 0) + acc[:T]
+    delta = (sl(vals, K - 1) - sl(vals, 0)) + acc[T:]
+    return v1, delta
+
+
 # ops with a dense+uniform-phase kernel: the ts plane is never streamed;
 # per-lane scrape phase (one row) reconstructs the extrapolation geometry
 PHASE_OPS = frozenset(("rate", "increase", "delta"))
@@ -254,12 +286,18 @@ def _phase_block_raw(phase_row, vals, q: GridQuery, roll, mxu: bool):
     K, g = q.kbuckets, q.gstep_ms
     live_row = jnp.isfinite(vals[0:1, :])
     if q.op == "delta":
-        vcorr = vals
+        v1 = sl(vals, 0)
+        delta = sl(vals, K - 1) - v1
+    elif mxu and q.stride == 1 and vals.shape[0] <= _MXU_CORR_MAX_ROWS \
+            and 2 * q.nsteps < vals.shape[0]:
+        # K-heavy shape: the banded formulation does less MXU work than
+        # the [B, B] prefix and skips materializing vcorr entirely
+        v1, delta = _corr_v1_delta_banded(vals, q, roll)
     else:
         vcorr = _correct_dense_auto(vals, roll) if mxu \
             else _correct_dense(vals, roll)
-    v1, v2 = sl(vcorr, 0), sl(vcorr, K - 1)
-    delta = v2 - v1
+        v1 = sl(vcorr, 0)
+        delta = sl(vcorr, K - 1) - v1
     sampled = jnp.asarray((K - 1) * g * 1e-3, dt)
     if q.op == "delta":
         # no zero-clamp for gauges: extrap == sampled + gstep == K*gstep
@@ -913,6 +951,250 @@ def rate_grid_grouped(ts, vals, steps0, q: GridQuery,
                                 memory_space=pltpu.VMEM)),
     )(jnp.asarray([steps0], jnp.int32), *extra, vals)
     return s, c
+
+
+# ---------------------------------------------------------------------------
+# Compressed-resident kernels: on-device XOR-class decode fused into the
+# grid compute, so one compiled program reads the ~2.5 B/sample packed
+# planes from HBM instead of the 4 B/sample decoded plane (reference:
+# serving compressed BinaryVectors in place, BlockManager.scala:142).
+# Input layout contract: codecs/xorgrid.py (class sub-planes p8/p16/raw
+# + [8, n] meta tiles: row 0 shift, row 1 first-value bits, row 2 phase).
+# Everything runs in PACKED lane order — callers compose their existing
+# host-side lane indirections with the pack's ``inv`` map; the device
+# never gathers.
+# ---------------------------------------------------------------------------
+
+
+def _decode_packed(p_ref, m_ref):
+    """In-VMEM XOR-class decode of one packed [B, L] tile to f32:
+    widen -> per-lane shift -> log2(B) prefix-XOR roll scan -> XOR the
+    first-row bits -> bitcast.  Raw (f32) tiles take the same path with
+    shift 0, so every class decodes through one code shape."""
+    p = p_ref[:]
+    if p.dtype == jnp.float32:
+        u = jax.lax.bitcast_convert_type(p, jnp.uint32)
+    else:
+        u = p.astype(jnp.uint32)
+    z = m_ref[0:1, :].astype(jnp.uint32)
+    u = u << z
+    nb = u.shape[0]
+    row = jax.lax.broadcasted_iota(jnp.int32, u.shape, 0)
+    sh = 1
+    while sh < nb:
+        u = jnp.where(row >= sh, u ^ pltpu.roll(u, sh, axis=0), u)
+        sh *= 2
+    first = jax.lax.bitcast_convert_type(m_ref[1:2, :], jnp.uint32)
+    return jax.lax.bitcast_convert_type(u ^ first, jnp.float32)
+
+
+def _decode_rows(p_ref, m_ref, q: GridQuery, row0: int):
+    """Decode the full packed block (the prefix-XOR scan must start at
+    block row 0) and take the query's row span as a STATIC sublane
+    slice — ``row0`` is compile-time, which is what lets the slice land
+    at arbitrary (non-8-aligned) offsets under Mosaic."""
+    vals = _decode_packed(p_ref, m_ref)
+    need = _rows_needed(q)
+    return jax.lax.slice(vals, (row0, 0), (row0 + need, vals.shape[1]))
+
+
+def _series_kernel_packed(s0_ref, m_ref, p_ref, out_ref, *, q: GridQuery,
+                          row0: int, use_phase: bool):
+    vals = _decode_rows(p_ref, m_ref, q, row0)
+    if use_phase:
+        roll = lambda x, s: pltpu.roll(x, s, axis=0)
+        out, live_row = _phase_block_raw(m_ref[2:3, :], vals, q, roll,
+                                         mxu=True)
+        out_ref[:] = jnp.where(live_row, out, jnp.nan)
+    else:
+        out_ref[:] = _rate_block(None, vals, s0_ref[0], q)
+
+
+def _grouped_kernel_packed(s0_ref, m_ref, p_ref, sum_ref, cnt_ref, *,
+                           q: GridQuery, row0: int, use_phase: bool):
+    gi = pl.program_id(1)
+    vals = _decode_rows(p_ref, m_ref, q, row0)
+    if use_phase:
+        roll = lambda x, s: pltpu.roll(x, s, axis=0)
+        out, live_row = _phase_block_raw(m_ref[2:3, :], vals, q, roll,
+                                         mxu=True)
+        sum_ref[gi, :] = jnp.sum(jnp.where(live_row, out, 0.0), axis=1)
+        nlive = jnp.sum(live_row.astype(jnp.float32))
+        cnt_ref[gi, :] = jnp.full((q.nsteps,), nlive, jnp.float32)
+    else:
+        r = _rate_block(None, vals, s0_ref[0], q)
+        ok = jnp.isfinite(r)
+        sum_ref[gi, :] = jnp.sum(jnp.where(ok, r, 0.0), axis=1)
+        cnt_ref[gi, :] = jnp.sum(ok.astype(jnp.float32), axis=1)
+
+
+def _packed_planes(packed: dict):
+    """(packed plane, meta tile) pairs in packed (class) order, empty
+    planes skipped."""
+    out = []
+    for key, mkey in (("p8", "m8"), ("p16", "m16"), ("p32", "m32"),
+                      ("raw", "mraw")):
+        p = packed.get(key)
+        if p is None or p.shape[1] == 0:
+            continue
+        m = packed.get(mkey)
+        if m is None:
+            raise ValueError(f"packed plane {key} has no meta tile "
+                             f"{mkey} (f64 packs carry no meta; the "
+                             f"fused kernels are f32-only)")
+        out.append((p, m))
+    return out
+
+
+def packed_width(packed: dict) -> int:
+    """Total packed lane count (sum of class-plane widths, pads
+    included) — the lane dimension of the fused kernels' output."""
+    return sum(p.shape[1] for p, _m in _packed_planes(packed))
+
+
+def _packed_check(packed: dict, q: GridQuery, row0: int, use_phase: bool):
+    if use_phase:
+        if not phase_eligible(q):
+            raise ValueError(f"op {q.op} not phase-eligible (dense="
+                             f"{q.dense}, K={q.kbuckets})")
+    elif q.op not in TS_FREE_OPS:
+        raise ValueError(f"packed kernels serve TS_FREE or phase-mode "
+                         f"ops only; {q.op} needs a ts plane")
+    for p, _m in _packed_planes(packed):
+        if p.shape[0] < row0 + _rows_needed(q):
+            raise ValueError(
+                f"packed block has {p.shape[0]} rows; query needs rows "
+                f"[{row0}, {row0 + _rows_needed(q)})")
+
+
+def _plane_lane_tile(n: int) -> int:
+    """Lane-tile width for one class plane: packed planes halve (p16)
+    or quarter (p8) the bytes per lane, so coarser 1024-lane tiles keep
+    DMA sizes up; odd tails fall back to one whole-plane block (Mosaic
+    masks sub-128 lane dims)."""
+    if n % 1024 == 0:
+        return 1024
+    if n % 128 == 0:
+        return 128
+    return n
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("q", "row0", "interpret", "use_phase"))
+def rate_grid_packed(packed: dict, steps0, q: GridQuery, row0: int = 0,
+                     interpret: bool = False, use_phase: bool = False):
+    """Per-series windowed function over XOR-class packed residents:
+    packed planes -> [T, packed_width] stepped values in PACKED lane
+    order (map back through the pack's ``inv``).
+
+    One pallas_call per class plane (uniform dtype per call); decode
+    runs in VMEM, so HBM sees only the packed bytes.  ``row0`` is the
+    first query row within the block and is STATIC — the decode scan
+    must cover the whole block anyway, and a static offset keeps the
+    window slices on Mosaic's fast path (one compiled kernel per
+    (T, K, row0) signature; dashboards cycle row0 through at most
+    BLOCK_BUCKETS values).  ``use_phase`` activates the uniform-phase
+    kernels reading meta row 2; otherwise only TS_FREE ops are legal.
+    """
+    _packed_check(packed, q, row0, use_phase)
+    if q.stride > 1:
+        fine = rate_grid_packed(packed, steps0, _fine_query(q), row0,
+                                interpret, use_phase)
+        return fine[::q.stride]
+    s0 = jnp.asarray([steps0], jnp.int32)
+    outs = []
+    for p, m in _packed_planes(packed):
+        nb, n = p.shape
+        lt = _plane_lane_tile(n)
+        outs.append(pl.pallas_call(
+            functools.partial(_series_kernel_packed, q=q, row0=row0,
+                              use_phase=use_phase),
+            interpret=interpret,
+            out_shape=jax.ShapeDtypeStruct((q.nsteps, n), jnp.float32),
+            grid=(n // lt,),
+            in_specs=[_smem(),
+                      pl.BlockSpec((8, lt), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((nb, lt), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((q.nsteps, lt), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM),
+        )(s0, m, p))
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("q", "group_lanes", "row0", "interpret",
+                                    "use_phase"))
+def rate_grid_grouped_packed(packed: dict, steps0, q: GridQuery,
+                             group_lanes: int = 1024, row0: int = 0,
+                             interpret: bool = False,
+                             use_phase: bool = True):
+    """Fully fused ``sum by (group)(rate(...))`` over packed residents:
+    packed planes -> (sum, count) [G, T], decode + window + grouped
+    reduce in one kernel per class plane.
+
+    Requires the GROUP-ALIGNED pack contract: every class plane's lane
+    count is a multiple of ``group_lanes``, no group's lanes straddle a
+    class boundary, and the pack carries NO alignment-pad lanes (the
+    north-star layout packs whole groups via ``min_width``, so a
+    uniform workload keeps its group order; mixed-class or padded
+    layouts must use :func:`rate_grid_packed` + a segment reduce that
+    drops pads through the group map).  Groups come back in
+    packed-plane order.
+    """
+    _packed_check(packed, q, row0, use_phase)
+    inv = packed.get("inv")
+    if inv is not None and packed_width(packed) != inv.shape[0]:
+        # a zero pad lane decodes to a constant finite 0.0 series: with
+        # no group map to drop it, it would count as a live series in
+        # its group (+1 count, skewed avg) — reject rather than corrupt
+        raise ValueError(
+            f"pack carries {packed_width(packed) - inv.shape[0]} "
+            f"alignment-pad lanes; the fused grouped kernel has no "
+            f"group map to drop them — use rate_grid_packed + a "
+            f"segment reduce, or a min_width single-class pack")
+    if q.stride > 1:
+        s, c = rate_grid_grouped_packed(packed, steps0, _fine_query(q),
+                                        group_lanes, row0, interpret,
+                                        use_phase)
+        return s[:, ::q.stride], c[:, ::q.stride]
+    s0 = jnp.asarray([steps0], jnp.int32)
+    sums, cnts = [], []
+    for p, m in _packed_planes(packed):
+        nb, n = p.shape
+        ng = n // group_lanes
+        if n % group_lanes != 0 or ng == 0 or ng % _GPS != 0:
+            raise ValueError(
+                f"packed plane width {n} must be (groups x "
+                f"{group_lanes}) with the group count a multiple of "
+                f"{_GPS} — use the group-aligned pack layout")
+        s, c = pl.pallas_call(
+            functools.partial(_grouped_kernel_packed, q=q, row0=row0,
+                              use_phase=use_phase),
+            interpret=interpret,
+            out_shape=(jax.ShapeDtypeStruct((ng, q.nsteps), jnp.float32),
+                       jax.ShapeDtypeStruct((ng, q.nsteps), jnp.float32)),
+            grid=(ng // _GPS, _GPS),
+            in_specs=[_smem(),
+                      pl.BlockSpec((8, group_lanes),
+                                   lambda i, gi: (0, i * _GPS + gi),
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((nb, group_lanes),
+                                   lambda i, gi: (0, i * _GPS + gi),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=(pl.BlockSpec((_GPS, q.nsteps),
+                                    lambda i, gi: (i, 0),
+                                    memory_space=pltpu.VMEM),
+                       pl.BlockSpec((_GPS, q.nsteps),
+                                    lambda i, gi: (i, 0),
+                                    memory_space=pltpu.VMEM)),
+        )(s0, m, p)
+        sums.append(s)
+        cnts.append(c)
+    if len(sums) == 1:
+        return sums[0], cnts[0]
+    return jnp.concatenate(sums, axis=0), jnp.concatenate(cnts, axis=0)
 
 
 # ---------------------------------------------------------------------------

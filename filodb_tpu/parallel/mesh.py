@@ -208,11 +208,28 @@ def _build_program(mesh_key, range_fn, agg_op: Agg, num_groups: int,
 def _shard_map_unchecked(fn, **kw):
     """shard_map whose outputs are replicated by construction (an
     all_gather + identical local math) — the static replication checker
-    can't infer that, so disable it where the kwarg exists."""
+    can't infer that, so disable it under whichever kwarg this jax
+    spells it (check_vma on newer releases, check_rep before that; on
+    versions accepting both, BOTH must be off or the remaining checker
+    still rejects the uninferable replication)."""
+    import inspect
+    names = set()
     try:
-        return shard_map(fn, check_vma=False, **kw)
-    except TypeError:                                    # older jax
-        return shard_map(fn, **kw)
+        names = set(inspect.signature(shard_map).parameters)
+    except (TypeError, ValueError):          # builtins without signatures
+        pass
+    flags = {k: False for k in ("check_vma", "check_rep") if k in names}
+    if flags:
+        try:
+            return shard_map(fn, **flags, **kw)
+        except TypeError:
+            pass
+    for k in ("check_vma", "check_rep"):
+        try:
+            return shard_map(fn, **{k: False}, **kw)
+        except TypeError:
+            continue
+    return shard_map(fn, **kw)
 
 
 @functools.lru_cache(maxsize=64)

@@ -11,6 +11,13 @@ The node emits the same mergeable AggPartialBatch the per-shard path
 produces, so it composes under ReduceAggregateExec next to REMOTE
 shards' HTTP-dispatched partials — one cluster query can mix both data
 planes, exactly like the reference mixes local and remote children.
+
+Compressed residents (ISSUE 3): the GRID_MESH_ALL_OPS family serves
+from XOR-class packed blocks without a decode-then-requery round trip —
+``shard.mesh_grid_plan`` stages the decoded value plane ON DEVICE once
+(memoized; repeat queries perform zero host decode and zero re-upload),
+and uniform-phase plans never stage a ts plane at all (the SPMD program
+ships a 1-row dummy; see parallel/meshgrid.py and doc/kernel.md §2).
 """
 
 from __future__ import annotations

@@ -107,10 +107,6 @@ def _grid_mesh_program(mesh_key, q, mode: str, ksub: int, nrows: int,
     import jax
     import jax.numpy as jnp
     from jax import lax
-    try:
-        from jax import shard_map
-    except ImportError:                                  # older jax
-        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     from filodb_tpu.memstore.devicestore import _grouped_reduce_impl
@@ -152,12 +148,10 @@ def _grid_mesh_program(mesh_key, q, mode: str, ksub: int, nrows: int,
     kw = dict(mesh=mesh, in_specs=in_specs,
               out_specs=P(None, None, None) if psum_planes
               else P(None, None))
-    try:
-        # Pallas kernels' ShapeDtypeStruct outputs carry no vma; the
-        # newer shard_map's varying-across-mesh check rejects them
-        fn = shard_map(local, check_vma=False, **kw)
-    except TypeError:                                    # older jax
-        fn = shard_map(local, **kw)
+    # Pallas kernels' ShapeDtypeStruct outputs carry no vma; the newer
+    # shard_map's varying-across-mesh check rejects them — route through
+    # the version-spelling-aware unchecked wrapper
+    fn = _shard_map_unchecked(local, **kw)
     return jax.jit(fn)
 
 
@@ -354,7 +348,7 @@ def _compose(plans: Sequence, operator: Agg):
     if op is None or not plans:
         return None
     q0 = plans[0].q
-    nrows = plans[0].ts.shape[0]
+    nrows = plans[0].vals.shape[0]
     hb0 = plans[0].hb
     if hb0 and operator is not Agg.SUM:
         return None        # only sum is defined over histogram series
@@ -362,7 +356,7 @@ def _compose(plans: Sequence, operator: Agg):
     # histogram bucket scheme must match (differing widths cannot share
     # one garr layout), and dense/phase is the MEET across shards
     for p in plans:
-        if p.ts.shape[0] != nrows or p.hb != hb0:
+        if p.vals.shape[0] != nrows or p.hb != hb0:
             return None
         if hb0 and not np.array_equal(p.bucket_tops, plans[0].bucket_tops):
             return None
@@ -378,6 +372,11 @@ def _compose(plans: Sequence, operator: Agg):
     mode = "phase" if (phase_eligible(q)
                        and all(p.phase is not None for p in plans)) \
         else "ts"
+    if mode == "ts" and any(p.ts is None for p in plans):
+        # a uniform-phase shard staged NO ts plane (ISSUE 3); if the
+        # composition meets down to ts mode it cannot serve — fall back
+        # rather than feed the program a fabricated geometry
+        return None
     return q, mode
 
 
@@ -425,7 +424,11 @@ def serve_grid_mesh(engine, plans: Sequence, num_groups: int,
         return None
     q, mode = composed
     op = GRID_MESH_ALL_OPS[operator]
-    nrows = plans[0].ts.shape[0]
+    nrows = plans[0].vals.shape[0]
+    # phase mode serves WITHOUT a ts plane (uniform-phase shards never
+    # stage one): the program's ts input collapses to a 1-row dummy, so
+    # assembly ships half the resident bytes of the ts-streaming form
+    ts_rows = 1 if mode == "phase" else nrows
     # histogram plans: hb bucket lanes per series slot; group slots are
     # gid*hb + bucket, so the program reduces num_groups*hb segments
     stride = plans[0].hb or 1
@@ -502,9 +505,14 @@ def serve_grid_mesh(engine, plans: Sequence, num_groups: int,
                 continue          # that process stages its own pieces
             ts_k, val_k, ph_k, s0_k, g_k = [], [], [], [], []
             for p in by_dev[d]:
-                ts_d = jax.device_put(p.ts, dev)
+                if mode == "phase":
+                    # no shard staged a ts plane; ship the 1-row dummy
+                    ts_k.append(jax.device_put(
+                        np.zeros((1, lmax), np.int32), dev))
+                else:
+                    ts_d = jax.device_put(p.ts, dev)
+                    ts_k.append(_pad_piece(ts_d, lmax, 0))
                 val_d = jax.device_put(p.vals, dev)
-                ts_k.append(_pad_piece(ts_d, lmax, 0))
                 val_k.append(_pad_piece(val_d, lmax, np.nan))
                 if mode == "phase":
                     ph = jax.device_put(p.phase, dev)
@@ -520,7 +528,7 @@ def serve_grid_mesh(engine, plans: Sequence, num_groups: int,
                 g_k.append(g)
             while len(ts_k) < ksub:                # filler shard slices
                 ts_k.append(jax.device_put(
-                    np.zeros((nrows, lmax), np.int32), dev))
+                    np.zeros((ts_rows, lmax), np.int32), dev))
                 val_k.append(jax.device_put(
                     np.full((nrows, lmax), np.nan, vdt), dev))
                 if mode == "phase":
@@ -546,7 +554,7 @@ def serve_grid_mesh(engine, plans: Sequence, num_groups: int,
             return jax.make_array_from_single_device_arrays(
                 shape, sharding, pieces)
 
-        g_ts = assemble(ts_pieces, (nrows, lmax))
+        g_ts = assemble(ts_pieces, (ts_rows, lmax))
         g_vals = assemble(val_pieces, (nrows, lmax))
         g_ph = assemble(ph_pieces, (lmax,))
         g_s0 = assemble(s0_pieces, ())

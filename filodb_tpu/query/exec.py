@@ -75,7 +75,8 @@ class ExecContext:
             self._timings[stage] = self._timings.get(stage, 0.0) + seconds
 
     def note_counts(self, samples: int = 0, chunks: int = 0,
-                    bytes_: int = 0, pages: int = 0) -> None:
+                    bytes_: int = 0, pages: int = 0,
+                    hbm_dense: int = 0, hbm_compressed: int = 0) -> None:
         with self._corrupt_lock:
             c = self._counters
             if samples:
@@ -86,13 +87,21 @@ class ExecContext:
                 c["bytes"] = c.get("bytes", 0) + bytes_
             if pages:
                 c["pages"] = c.get("pages", 0) + pages
+            if hbm_dense:
+                c["hbm_dense"] = c.get("hbm_dense", 0) + hbm_dense
+            if hbm_compressed:
+                c["hbm_compressed"] = c.get("hbm_compressed", 0) \
+                    + hbm_compressed
 
     def absorb_stats(self, stats: QueryStats) -> None:
         """Fold a REMOTE child's stats into this query's accounting
         (local children share the ctx and need no absorb)."""
         self.note_counts(samples=stats.samples_scanned,
                          chunks=stats.chunks_scanned,
-                         bytes_=stats.bytes_scanned, pages=stats.pages_in)
+                         bytes_=stats.bytes_scanned, pages=stats.pages_in,
+                         hbm_dense=stats.hbm_read_bytes.get("dense", 0),
+                         hbm_compressed=stats.hbm_read_bytes.get(
+                             "compressed", 0))
         if stats.corrupt_chunks_excluded:
             self.note_corrupt_excluded(stats.corrupt_chunks_excluded)
         for k, v in stats.timings.items():
@@ -108,6 +117,10 @@ class ExecContext:
             stats.chunks_scanned = c.get("chunks", 0)
             stats.bytes_scanned = c.get("bytes", 0)
             stats.pages_in = c.get("pages", 0)
+            stats.hbm_read_bytes = {
+                k: c[ck] for k, ck in (("dense", "hbm_dense"),
+                                       ("compressed", "hbm_compressed"))
+                if c.get(ck)}
 
 
 class PlanDispatcher:

@@ -55,6 +55,11 @@ class QueryStats:
     bytes_scanned: int = 0
     pages_in: int = 0
     timings: dict = dataclasses.field(default_factory=dict)
+    # device-grid HBM bytes read under the device_compute stage, split
+    # by resident format (keys "dense"/"compressed") — makes the format
+    # actually serving traffic observable (ISSUE 3; the compressed
+    # resident reads ~2.5 B/sample vs 4 for decoded planes)
+    hbm_read_bytes: dict = dataclasses.field(default_factory=dict)
 
     def merge(self, other: "QueryStats") -> None:
         self.samples_scanned += other.samples_scanned
@@ -67,6 +72,8 @@ class QueryStats:
         self.pages_in += other.pages_in
         for k, v in other.timings.items():
             self.timings[k] = self.timings.get(k, 0.0) + v
+        for k, v in other.hbm_read_bytes.items():
+            self.hbm_read_bytes[k] = self.hbm_read_bytes.get(k, 0) + v
 
     def add_timing(self, stage: str, seconds: float) -> None:
         self.timings[stage] = self.timings.get(stage, 0.0) + seconds

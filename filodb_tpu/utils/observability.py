@@ -271,6 +271,10 @@ def query_metrics() -> dict:
         "execplan_seconds": REGISTRY.histogram(
             "filodb_query_execplan_remote_seconds",
             "remote /execplan leaf execution latency"),
+        "hbm_read_bytes": REGISTRY.counter(
+            "filodb_query_hbm_read_bytes_total",
+            "device-grid HBM bytes read serving queries, by resident "
+            "format (label format=dense|compressed)"),
     }
 
 

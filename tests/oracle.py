@@ -145,7 +145,11 @@ def range_fn(name: str, ts: np.ndarray, vals: np.ndarray, start: int, end: int,
             fi = np.nonzero(fin)[0]
             if len(fi):
                 sd = np.std(vw[fin])
-                out[j] = (vw[fi[-1]] - np.mean(vw[fin])) / sd
+                # sd == 0 (constant window) divides 0/0 -> NaN, which IS
+                # the reference semantics; silence the RuntimeWarning the
+                # scalar divide would otherwise emit on every suite run
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    out[j] = (vw[fi[-1]] - np.mean(vw[fin])) / sd
         elif name == "holt_winters":
             y = vw[fin]
             if len(y) >= 2:

@@ -1050,3 +1050,136 @@ class TestCompressedResidents:
         builds = cache.builds
         self._serve_all(shard, 96)
         assert cache.builds == builds, "repeat query rebuilt blocks"
+
+
+class TestFusedPackedServing:
+    """ISSUE 3 tentpole: eligible queries over a compressed resident run
+    the FUSED packed kernels (XOR-class decode inside the grid kernel,
+    interpret mode on CPU CI) and must match the decoded-plane path —
+    bit-identical for free ops, to f32 rounding for the MXU rate chain.
+    Also covers the hbm_read_bytes accounting satellite."""
+
+    @pytest.fixture()
+    def f32_interpret(self, monkeypatch):
+        from filodb_tpu.memstore import devicestore
+        monkeypatch.setattr(devicestore, "_PACKED_INTERPRET", True)
+        monkeypatch.setattr(devicestore, "_PACKED_BROKEN", False)
+        monkeypatch.setattr(devicestore.DeviceGridCache, "_val_dtype",
+                            lambda self: np.float32)
+        return devicestore
+
+    def _counter_shard(self, compress: bool, n_rows=96, n_series=8):
+        ms = TimeSeriesMemStore()
+        shard = ms.setup("prom", DEFAULT_SCHEMAS, 0,
+                         StoreConfig(device_cache_compress=compress))
+        rng = np.random.default_rng(7)
+        b = RecordBuilder(DEFAULT_SCHEMAS["prom-counter"])
+        for i in range(n_series):
+            tags = {"__name__": "c_total", "instance": f"i{i}",
+                    "_ws_": "w", "_ns_": "n"}
+            ph = int(rng.integers(1, STEP))
+            ts = T0 + np.arange(n_rows, dtype=np.int64) * STEP - STEP + ph
+            vals = (2 ** 23 + 128 * np.cumsum(
+                rng.integers(1, 8, n_rows))).astype(np.float64)
+            b.add_series(ts, [vals], tags)
+        for off, c in enumerate(b.containers()):
+            shard.ingest(decode_container(c, DEFAULT_SCHEMAS), off)
+        shard.flush_all()
+        return ms, shard
+
+    def _scan(self, shard, fn, n_rows=96):
+        res = shard.lookup_partitions(
+            [ColumnFilter("_metric_", Equals("c_total"))], 0, 2**62)
+        steps0 = T0 + (K + 1) * STEP
+        nsteps = n_rows - K - 2
+        got = shard.scan_grid(res.part_ids, fn, steps0, nsteps, STEP,
+                              WINDOW)
+        assert got is not None, fn
+        tags_l, vals, _ = got
+        order = np.argsort([t["instance"] for t in tags_l])
+        return np.asarray(vals)[order]
+
+    def test_fused_packed_dispatch_and_equivalence(self, f32_interpret):
+        devicestore = f32_interpret
+        _ms1, comp = self._counter_shard(True)
+        _ms2, plain = self._counter_shard(False)
+        for fn, exact in ((F.SUM_OVER_TIME, True), (F.MAX_OVER_TIME, True),
+                          (None, True), (F.RATE, False)):
+            got_c = self._scan(comp, fn)
+            got_p = self._scan(plain, fn)
+            if exact:
+                np.testing.assert_array_equal(got_c, got_p,
+                                              err_msg=str(fn))
+            else:
+                # MXU correction formulation vs the CPU roll-scan ref
+                fin = np.isfinite(got_p)
+                assert (np.isfinite(got_c) == fin).all()
+                np.testing.assert_allclose(got_c[fin], got_p[fin],
+                                           rtol=1e-6)
+        cache = next(iter(comp.device_caches.values()))
+        plan = next(iter(cache._plan_memo.values()))
+        assert plan.packed is not None, \
+            "compressed single-block query did not take the fused path"
+        assert not devicestore._PACKED_BROKEN
+        assert plan.hbm_comp > 0 and plan.hbm_dense == 0
+
+    def test_fused_grouped_matches_decoded(self, f32_interpret):
+        _ms1, comp = self._counter_shard(True)
+        _ms2, plain = self._counter_shard(False)
+        gids = [0, 1] * 4
+        outs = []
+        for shard in (comp, plain):
+            res = shard.lookup_partitions(
+                [ColumnFilter("_metric_", Equals("c_total"))], 0, 2**62)
+            steps0 = T0 + (K + 1) * STEP
+            st = shard.scan_grid_grouped(res.part_ids, F.RATE, steps0,
+                                         96 - K - 2, STEP, WINDOW, gids,
+                                         2, "sum")
+            assert st is not None
+            outs.append(st)
+        np.testing.assert_allclose(outs[0]["sum"], outs[1]["sum"],
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(outs[0]["count"], outs[1]["count"])
+
+    def test_hbm_read_bytes_reach_query_stats(self, f32_interpret):
+        from filodb_tpu.query import exec as qexec
+        from filodb_tpu.query.model import QueryStats
+        _ms, shard = self._counter_shard(True)
+        ctx = qexec.ExecContext(memstore=None)
+        qexec._ACTIVE.ctx = ctx
+        try:
+            self._scan(shard, F.SUM_OVER_TIME)
+        finally:
+            qexec._ACTIVE.ctx = None
+        stats = QueryStats()
+        ctx.fold_into(stats)
+        assert stats.hbm_read_bytes.get("compressed", 0) > 0
+        assert "dense" not in stats.hbm_read_bytes
+        # and the counter family is registered under filodb_query_*
+        from filodb_tpu.utils.observability import query_metrics
+        m = query_metrics()["hbm_read_bytes"]
+        assert m is not None
+
+    def test_broken_breaker_falls_back(self, f32_interpret, monkeypatch):
+        """A failing fused dispatch must trip the breaker and serve
+        through the XLA decode path, not error the query."""
+        devicestore = f32_interpret
+        _ms, shard = self._counter_shard(True)
+
+        calls = []
+
+        def boom(*a, **k):
+            calls.append(1)
+            raise RuntimeError("mosaic rejected the kernel")
+        devicestore._fused_progs()       # ensure progs exist, then break
+        monkeypatch.setitem(devicestore._FUSED_PROGS, "series_packed",
+                            boom)
+        out = self._scan(shard, F.SUM_OVER_TIME)
+        assert np.isfinite(out).any()
+        assert devicestore._PACKED_BROKEN
+        assert len(calls) == 1
+        # memoized plans keep .packed set; the tripped breaker must
+        # short-circuit instead of re-attempting the failing build
+        out2 = self._scan(shard, F.SUM_OVER_TIME)
+        assert np.isfinite(out2).any()
+        assert len(calls) == 1, "breaker re-dispatched the broken kernel"

@@ -333,3 +333,111 @@ class TestResidentGridMesh:
         engine = MeshEngine(make_mesh())
         fused = _run(_planner(mapper, engine), ms, QUERIES[0], START, END)
         _assert_equiv(fused, plain)
+
+
+class TestCompressedResidentMesh:
+    """ISSUE 3: the mesh path over COMPRESSED residents — blocks stay
+    packed in HBM, uniform-phase plans never stage a ts plane, and the
+    dashboard-refresh contract (memo hit, zero host decode, zero
+    re-upload, zero block rebuilds) holds for the compressed form."""
+
+    def _load_counters(self, num_shards=NUM_SHARDS, n_series=N_SERIES):
+        """Integer-valued counters (XOR-compressible) on an exact 10s
+        cadence with a per-series constant phase — compresses AND proves
+        uniform-phase, so plans take the no-ts-plane mesh form."""
+        ms = TimeSeriesMemStore()
+        opts = DatasetOptions()
+        mapper = ShardMapper(num_shards)
+        for s in range(num_shards):
+            ms.setup("prom", DEFAULT_SCHEMAS, s)
+        rng = np.random.default_rng(23)
+        for i in range(n_series):
+            tags = {"_metric_": "cc", "inst": f"i{i}",
+                    "grp": f"g{i % 3}", "_ws_": "w", "_ns_": "n"}
+            shard = mapper.ingestion_shard(shard_key_hash(tags, opts),
+                                           partition_hash(tags, opts),
+                                           2) % num_shards
+            b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], opts,
+                              container_size=1 << 20)
+            ph = int(rng.integers(1, STEP))
+            ts = BASE + np.arange(N_ROWS) * STEP - STEP + ph
+            vals = (1_000_000
+                    + np.cumsum(rng.integers(-500, 500, N_ROWS))
+                    ).astype(np.float64)
+            b.add_series(ts.tolist(), [vals.tolist()], tags)
+            for off, c in enumerate(b.containers()):
+                ms.get_shard("prom", shard).ingest_container(c, off)
+        for s in range(num_shards):
+            ms.get_shard("prom", s).flush_all()
+        return ms, mapper
+
+    def test_compressed_resident_repeat_memo_and_zero_rebuild(
+            self, monkeypatch):
+        ms, mapper = self._load_counters()
+        engine = MeshEngine(make_mesh())
+        planner = _planner(mapper, engine)
+        promql = 'sum by (grp)(rate(cc{_ws_="w",_ns_="n"}[2m]))'
+        plain = _run(_planner(mapper), ms, promql, START, END)
+        first = _run(planner, ms, promql, START, END)
+        _assert_equiv(first, plain)
+        # the residents must actually BE compressed and uniform-phase
+        comp_blocks = ts_elided = 0
+        builds = 0
+        for s in range(NUM_SHARDS):
+            shard = ms.get_shard("prom", s)
+            for cache in shard.device_caches.values():
+                builds += cache.builds
+                for blk in cache.blocks.values():
+                    comp_blocks += isinstance(blk.vals, dict)
+                    ts_elided += blk.ts is None
+        assert comp_blocks > 0, "counter data did not pack"
+        assert ts_elided > 0, "uniform-phase ts plane was not elided"
+        before = dict(meshgrid.STATS)
+        uploads = []
+        real_put = jax.device_put
+
+        def spy(x, *a, **kw):
+            if isinstance(x, np.ndarray):
+                uploads.append(x.nbytes)
+            return real_put(x, *a, **kw)
+
+        monkeypatch.setattr(jax, "device_put", spy)
+        second = _run(planner, ms, promql, START, END)
+        monkeypatch.undo()
+        _assert_equiv(second, first)
+        # repeat query: assembly memo hit, no host decode (no rebuild),
+        # no re-upload — the compressed analog of the dense contract
+        assert meshgrid.STATS["memo_hits"] > before["memo_hits"], \
+            "repeat compressed query re-assembled the mesh inputs"
+        assert uploads == [], \
+            f"repeat compressed query uploaded {sum(uploads)} bytes"
+        builds2 = sum(c.builds for s in range(NUM_SHARDS)
+                      for c in ms.get_shard("prom", s)
+                      .device_caches.values())
+        assert builds2 == builds, "repeat query re-decoded host chunks"
+
+    def test_phase_plans_stage_no_ts_plane(self):
+        """Uniform-phase mesh plans carry ts=None — the staged resident
+        is the value plane only (half the HBM of the ts-streaming
+        form), and the SPMD program ships a 1-row dummy instead."""
+        ms, mapper = self._load_counters()
+        devices = list(make_mesh().devices.flat)
+        plans = []
+        for s in range(NUM_SHARDS):
+            shard = ms.get_shard("prom", s)
+            shard.pin_grid_device(devices[s % len(devices)])
+            res = shard.lookup_partitions([], 0, 2**62)
+            ids = res.part_ids
+            if len(ids) == 0:
+                continue
+            from filodb_tpu.query.logical import RangeFunctionId as F
+            plan = shard.mesh_grid_plan(
+                ids, F.RATE, BASE + 300_000, 10, 30_000, 120_000,
+                list(range(len(ids))))
+            if plan is not None:
+                plans.append(plan)
+        assert plans, "no shard produced a mesh plan"
+        for p in plans:
+            assert p.phase is not None
+            assert p.ts is None, "phase-mode plan staged a ts plane"
+            assert p.vals.shape[0] > 0

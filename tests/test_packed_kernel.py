@@ -1,0 +1,260 @@
+"""Compressed-resident equivalence: the fused on-device XOR-class
+decode (ops/grid.py rate_grid_packed / rate_grid_grouped_packed) must be
+bit-identical to the CPU codec decode (codecs/xorgrid.py unpack_vals)
+and agree with the decoded-plane kernels across the layout's edge cases
+— NaN payloads, constant runs, sign flips, partial final tiles, mixed
+classes, promote/pad alignment.  Pallas runs in interpret mode so the
+whole sweep executes in CPU CI (ISSUE 3 satellite)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from filodb_tpu.codecs.xorgrid import (LANE_BLOCK, UNPADDED_MAX, pack_vals,
+                                       unpack_vals)
+from filodb_tpu.ops.grid import (GridQuery, packed_width, rate_grid_grouped,
+                                 rate_grid_grouped_packed, rate_grid_packed,
+                                 rate_grid_ref)
+
+STEP = 60_000
+
+
+def _counters(rng, B, L, dtype=np.float32):
+    """Integer-valued counters with a pinned f32 exponent: residuals
+    provably fit 16 bits (see bench.py gen_packed)."""
+    start = (2 ** 23 + 128 * rng.integers(0, 2 ** 15, L)).astype(dtype)
+    inc = 128 * rng.integers(1, 8, (B, L))
+    return (start[None, :] + np.cumsum(inc, axis=0)).astype(dtype)
+
+
+def _edge_plane(rng, B, L):
+    """A plane stressing every classification edge case at once."""
+    v = np.empty((B, L), np.float32)
+    n = L // 8
+    v[:, :n] = 5.0                                      # constant run
+    v[:, n:2 * n] = np.where(np.arange(B)[:, None] % 2 == 0,
+                             1.5, -1.5)                 # sign flips
+    # NaN payload bits must survive decode bit-for-bit
+    pay = np.frombuffer(np.uint32(0x7fc01dea).tobytes(),
+                        dtype=np.float32)[0]
+    v[:, 2 * n:3 * n] = pay
+    v[:, 3 * n:4 * n] = np.nan                          # all-NaN lanes
+    v[:, 4 * n:5 * n] = _counters(rng, B, n)            # narrow class
+    v[:, 5 * n:6 * n] = rng.random((B, n)) * 100        # incompressible
+    v[:, 6 * n:7 * n] = _counters(rng, B, n)
+    # partial fill: leading + trailing NaN around a counter run
+    v[:, 7 * n:] = _counters(rng, B, L - 7 * n)
+    v[:B // 4, 7 * n:] = np.nan
+    v[-B // 4:, 7 * n:] = np.nan
+    return v
+
+
+class TestPackRoundtrip:
+    @pytest.mark.parametrize("seed", range(4))
+    @pytest.mark.parametrize("L", [256, 137, 129, 1024])
+    def test_edge_cases_bit_identical(self, seed, L):
+        """Seeded sweep: whatever mix of classes/pads/promotions the
+        aligner picks, the CPU decode reproduces the input bits."""
+        rng = np.random.default_rng(seed)
+        v = _edge_plane(rng, 64, L)
+        pk = pack_vals(v)
+        if pk is None:
+            pytest.skip("mix did not pay at this width")
+        out = unpack_vals(pk)
+        np.testing.assert_array_equal(out.view(np.uint32),
+                                      v.view(np.uint32))
+
+    def test_f64_roundtrip_bit_identical(self):
+        rng = np.random.default_rng(9)
+        v = (1_000_000 + np.cumsum(rng.integers(-500, 500, (128, 192)),
+                                   axis=0)).astype(np.float64)
+        v[:, :40] = np.nan
+        pk = pack_vals(v)
+        assert pk is not None
+        np.testing.assert_array_equal(unpack_vals(pk).view(np.uint64),
+                                      v.view(np.uint64))
+
+    def test_partial_final_tile_stays_unpadded(self):
+        """A narrow class plane (< LANE_BLOCK) may skip alignment; the
+        decode must still be exact and the footprint must not balloon."""
+        rng = np.random.default_rng(2)
+        v = np.full((128, 128), np.nan, np.float32)
+        v[:, :6] = (rng.random((128, 6)).astype(np.float32) + 1) * 100
+        pk = pack_vals(v)
+        assert pk is not None
+        assert pk.planes["raw"].shape[1] == 6          # unpadded tail
+        np.testing.assert_array_equal(unpack_vals(pk).view(np.uint32),
+                                      v.view(np.uint32))
+
+    def test_alignment_invariant(self):
+        """Every class plane is lane-block aligned OR narrow enough for
+        a whole-plane kernel block (the encode-side guarantee the fused
+        kernels rely on)."""
+        for seed in range(6):
+            rng = np.random.default_rng(seed)
+            v = _edge_plane(rng, 64, 512)
+            pk = pack_vals(v)
+            if pk is None:
+                continue
+            for key in ("p8", "p16", "raw"):
+                p = pk.planes.get(key)
+                if p is None:
+                    continue
+                n = p.shape[1]
+                assert n % LANE_BLOCK == 0 or n <= UNPADDED_MAX, (key, n)
+
+    def test_min_width_forces_single_identity_plane(self):
+        """The bench's group-contiguity contract: class-16-guaranteed
+        counters with min_width=16 pack as ONE p16 plane in identity
+        lane order."""
+        rng = np.random.default_rng(3)
+        L = 512
+        v = _counters(rng, 59, L)
+        v[:, 100:140] = np.nan                    # padding lanes
+        pk = pack_vals(v, min_width=16)
+        assert pk.planes["p16"].shape[1] == L
+        assert pk.planes["raw"].shape[1] == 0
+        assert (pk.inv == np.arange(L)).all()
+        np.testing.assert_array_equal(unpack_vals(pk).view(np.uint32),
+                                      v.view(np.uint32))
+
+
+def _pack_dev(v, phase=None, **kw):
+    pk = pack_vals(v, phase=phase, **kw)
+    assert pk is not None
+    np.testing.assert_array_equal(unpack_vals(pk).view(np.uint32),
+                                  v.view(np.uint32))
+    return pk, {k: jnp.asarray(a) for k, a in pk.planes.items()}
+
+
+class TestFusedKernelEquivalence:
+    """rate_grid_packed / rate_grid_grouped_packed in interpret mode vs
+    the decoded-plane oracle kernels."""
+
+    @pytest.mark.parametrize("row0", [0, 3, 9])
+    def test_phase_rate_matches_ref(self, row0):
+        rng = np.random.default_rng(11)
+        B, L = 64, 512
+        v = _counters(rng, B, L)
+        v[:, 200:230] = np.nan
+        phase = rng.integers(1, STEP, L).astype(np.int32)
+        pk, dev = _pack_dev(v, phase=phase)
+        T, K = 20, 5
+        q = GridQuery(nsteps=T, kbuckets=K, gstep_ms=STEP, is_rate=True,
+                      dense=True)
+        out = np.asarray(rate_grid_packed(dev, 0, q, row0=row0,
+                                          interpret=True,
+                                          use_phase=True))[:, pk.inv]
+        ref = np.asarray(rate_grid_ref(
+            None, jnp.asarray(v[row0:row0 + T + K - 1]), 0, q,
+            phase=phase))
+        fin = np.isfinite(ref)
+        assert (np.isfinite(out) == fin).all()
+        np.testing.assert_allclose(out[fin], ref[fin], rtol=2e-5)
+
+    @pytest.mark.parametrize("op", ["sum", "max", "count", "last"])
+    def test_free_ops_match_ref(self, op):
+        """TS_FREE ops over a MIXED-class pack (p8 + p16 + raw planes),
+        including the non-dense general path with NaN holes."""
+        rng = np.random.default_rng(12)
+        B, L = 64, 512
+        v = _edge_plane(rng, B, L)
+        pk, dev = _pack_dev(v)
+        T, K = 12, 4
+        q = GridQuery(nsteps=T, kbuckets=K, gstep_ms=STEP, op=op,
+                      is_rate=False, dense=False)
+        out = np.asarray(rate_grid_packed(dev, 0, q, row0=2,
+                                          interpret=True))[:, pk.inv]
+        ref = np.asarray(rate_grid_ref(None,
+                                       jnp.asarray(v[2:2 + T + K - 1]),
+                                       0, q))
+        fin = np.isfinite(ref)
+        assert (np.isfinite(out) == fin).all()
+        np.testing.assert_allclose(out[fin], ref[fin], rtol=1e-6)
+
+    def test_grouped_packed_matches_grouped(self):
+        """The fully fused grouped kernel (the north-star variant) vs
+        the decoded-plane grouped phase kernel: identical partials."""
+        rng = np.random.default_rng(13)
+        B, L, GL = 59, 1024, 128
+        v = _counters(rng, B, L)
+        v[:, 500:520] = np.nan
+        phase = rng.integers(1, STEP, L).astype(np.int32)
+        pk, dev = _pack_dev(v, phase=phase, min_width=16)
+        assert (pk.inv == np.arange(L)).all()
+        T, K = 20, 5
+        q = GridQuery(nsteps=T, kbuckets=K, gstep_ms=STEP, is_rate=True,
+                      dense=True)
+        s_pk, c_pk = rate_grid_grouped_packed(dev, 0, q, group_lanes=GL,
+                                              interpret=True)
+        s_ph, c_ph = rate_grid_grouped(None, jnp.asarray(v), 0, q,
+                                       group_lanes=GL, interpret=True,
+                                       phase=phase)
+        np.testing.assert_array_equal(np.asarray(c_pk), np.asarray(c_ph))
+        np.testing.assert_allclose(np.asarray(s_pk), np.asarray(s_ph),
+                                   rtol=1e-6)
+
+    def test_packed_width_and_validation(self):
+        rng = np.random.default_rng(14)
+        v = _counters(rng, 64, 256)
+        pk, dev = _pack_dev(v, min_width=16)
+        assert packed_width(dev) == 256
+        q = GridQuery(nsteps=8, kbuckets=4, gstep_ms=STEP, dense=True)
+        with pytest.raises(ValueError, match="rows"):
+            rate_grid_packed(dev, 0, q, row0=60, interpret=True,
+                             use_phase=True)
+        qbad = GridQuery(nsteps=8, kbuckets=4, gstep_ms=STEP, op="rate",
+                         dense=True)
+        with pytest.raises(ValueError, match="ts plane"):
+            rate_grid_packed(dev, 0, qbad, interpret=True,
+                             use_phase=False)
+
+    def test_grouped_packed_rejects_padded_packs(self):
+        """Alignment-pad lanes decode to finite 0.0 series; with no
+        group map to drop them the fused grouped kernel would count
+        them as live — it must refuse such packs."""
+        rng = np.random.default_rng(16)
+        B, L = 64, 896
+        v = _counters(rng, B, L)
+        pk = pack_vals(v, min_width=16)
+        # append 128 zero pad lanes to the class plane exactly as the
+        # aligner would (zero residuals, zero meta -> constant 0.0)
+        planes = dict(pk.planes)
+        planes["p16"] = np.pad(planes["p16"], ((0, 0), (0, 128)))
+        planes["m16"] = np.pad(planes["m16"], ((0, 0), (0, 128)))
+        planes["z16"] = np.pad(planes["z16"], (0, 128))
+        planes["first"] = np.pad(planes["first"], (0, 128))
+        dev = {k: jnp.asarray(a) for k, a in planes.items()}
+        assert packed_width(dev) == L + 128 > dev["inv"].shape[0]
+        q = GridQuery(nsteps=8, kbuckets=4, gstep_ms=STEP, dense=True)
+        with pytest.raises(ValueError, match="pad lanes"):
+            rate_grid_grouped_packed(dev, 0, q, group_lanes=128,
+                                     interpret=True)
+
+    def test_banded_mxu_correction_matches_ref(self):
+        """K-heavy phase shape (2T < rows) takes the banded one-matmul
+        correction+delta path; the reference (roll-scan) oracle pins
+        its semantics, counter resets included."""
+        rng = np.random.default_rng(15)
+        B, L = 64, 256
+        v = _counters(rng, B, L)
+        # inject counter resets: drop back near the exponent floor
+        for lane in range(0, L, 7):
+            r = int(rng.integers(5, B - 5))
+            v[r:, lane] = v[r:, lane] - v[r, lane] + 2 ** 23
+        phase = rng.integers(1, STEP, L).astype(np.int32)
+        pk, dev = _pack_dev(v, phase=phase, min_width=16)
+        T, K = 8, 40                        # 2T=16 < 47 rows needed
+        q = GridQuery(nsteps=T, kbuckets=K, gstep_ms=STEP, is_rate=True,
+                      dense=True)
+        out = np.asarray(rate_grid_packed(dev, 0, q, row0=0,
+                                          interpret=True,
+                                          use_phase=True))[:, pk.inv]
+        ref = np.asarray(rate_grid_ref(None,
+                                       jnp.asarray(v[:T + K - 1]), 0, q,
+                                       phase=phase))
+        fin = np.isfinite(ref)
+        assert (np.isfinite(out) == fin).all()
+        np.testing.assert_allclose(out[fin], ref[fin], rtol=2e-5)

@@ -264,3 +264,65 @@ def test_lint_catches_a_discarded_sentinel():
     _check_function(tree.body[0], good.splitlines(),
                     ROOT / "fake.py", violations)
     assert violations == []
+
+
+# ---------------------------------------------------------------------------
+# Kernel interpret-coverage lint (ISSUE 3): every jitted Pallas kernel
+# entry point in filodb_tpu/ops/ (identified by its ``interpret``
+# parameter — the convention every pallas wrapper follows) must have an
+# interpret-mode test referencing it, so CPU CI exercises the kernel
+# body even though Mosaic only compiles on TPU.  A new kernel without
+# an interpret test fails the build here.
+# ---------------------------------------------------------------------------
+
+TESTS_DIR = pathlib.Path(__file__).resolve().parent
+
+
+def _kernel_entry_points(ops_dir=None):
+    """Top-level public functions in ops/*.py taking ``interpret``."""
+    ops_dir = ops_dir or (ROOT / "ops")
+    out = []
+    for path in sorted(ops_dir.glob("*.py")):
+        tree = ast.parse(path.read_text())
+        for fn in tree.body:
+            if not isinstance(fn, ast.FunctionDef) \
+                    or fn.name.startswith("_"):
+                continue
+            args = fn.args
+            names = [a.arg for a in args.args + args.kwonlyargs]
+            if "interpret" in names:
+                out.append((path.name, fn.name))
+    return out
+
+
+def _uncovered_kernels(entry_points, test_sources):
+    """Entry points with no test file that BOTH calls them and runs in
+    interpret mode."""
+    missing = []
+    for fname, fn in entry_points:
+        covered = any(fn + "(" in src and "interpret=True" in src
+                      for src in test_sources)
+        if not covered:
+            missing.append(f"{fname}:{fn} has no interpret-mode test "
+                           f"(call it with interpret=True in tests/)")
+    return missing
+
+
+def test_ops_kernel_entry_points_have_interpret_tests():
+    eps = _kernel_entry_points()
+    assert eps, "no kernel entry points found — lint wiring broken?"
+    srcs = [p.read_text() for p in TESTS_DIR.glob("test_*.py")]
+    missing = _uncovered_kernels(eps, srcs)
+    assert not missing, \
+        "kernels without interpret coverage:\n  " + "\n  ".join(missing)
+
+
+def test_interpret_lint_catches_uncovered_kernel():
+    """The lint must actually fire on an uncovered entry point."""
+    missing = _uncovered_kernels([("fake.py", "totally_new_kernel")],
+                                 ["x = 1"])
+    assert len(missing) == 1 and "totally_new_kernel" in missing[0]
+    covered = _uncovered_kernels(
+        [("fake.py", "totally_new_kernel")],
+        ["out = totally_new_kernel(a, interpret=True)"])
+    assert covered == []
