@@ -111,6 +111,9 @@ class ShardingPublisher:
                 _METRICS["parse_errors"].inc(errs)
         if n:
             _METRICS["samples"].inc(n)
+        from filodb_tpu.utils.devicewatch import FLIGHT
+        FLIGHT.record("ingest.batch", samples=n, parse_errors=errs,
+                      seconds=round(time.perf_counter() - t0, 6))
         return n
 
     def _ingest_batch(self, text: str) -> int:

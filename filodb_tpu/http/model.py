@@ -143,6 +143,9 @@ def stats_payload(stats, trace_id: str = "") -> dict:
             "hbmReadBytes": {k: int(v)
                              for k, v in sorted(
                                  stats.hbm_read_bytes.items())},
+            # net ledger-tracked HBM residency change this query caused
+            # (devicewatch: blocks committed minus freed; 0 when warm)
+            "hbmResidentDeltaBytes": int(stats.hbm_resident_delta_bytes),
         },
         "traceId": trace_id,
     }

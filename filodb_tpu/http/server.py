@@ -414,6 +414,15 @@ class FiloHttpServer:
         if len(parts) == 2 and parts[0] == "admin" \
                 and parts[1] == "slowlog":
             return self._slowlog(params)
+        if len(parts) == 2 and parts[0] == "admin" \
+                and parts[1] == "device":
+            return self._device()
+        if len(parts) == 2 and parts[0] == "admin" \
+                and parts[1] == "flightrecorder":
+            return self._flightrecorder(params)
+        if len(parts) == 2 and parts[0] == "admin" \
+                and parts[1] == "config":
+            return self._config(params)
         if len(parts) == 3 and parts[0] == "admin" and parts[1] == "traces":
             return self._traces(parts[2])
         if len(parts) == 2 and parts[0] == "debug" \
@@ -456,6 +465,92 @@ class FiloHttpServer:
         except forensics.ProfilerBusy as e:
             return 503, error_response("unavailable", str(e))
         return 200, {"status": "success", "data": data}
+
+    # ------------------------------------------------- device observability
+
+    @_timed("device")
+    def _device(self) -> tuple[int, dict]:
+        """Device-resource view (ISSUE 4): the HBM residency ledger tree
+        (per-owner/format byte totals, watermarks), per-dataset arena
+        budgets (device grid caches + ODP page caches), the per-device
+        reconciliation vs ``memory_stats()``, and the JIT compile table
+        with recompile-storm state (doc/observability.md)."""
+        from filodb_tpu.utils import devicewatch
+        data = devicewatch.device_summary()
+        arenas: dict = {}
+        for ds, b in self.datasets.items():
+            rows = []
+            for sh in b.memstore.shards(ds):
+                for _key, cache in sorted(
+                        getattr(sh, "device_caches", {}).items()):
+                    rows.append({
+                        "shard": sh.shard_num, "arena": "device-grid",
+                        "owner": cache.owner, "budget": cache.budget,
+                        "bytes_resident": cache.bytes_resident,
+                        "blocks": len(cache.blocks),
+                        "builds": cache.builds, "hits": cache.hits,
+                        "evictions": cache.evictions})
+                paged = getattr(sh, "paged", None)
+                if paged is not None:
+                    rows.append({
+                        "shard": sh.shard_num, "arena": "odp-page-cache",
+                        "owner": getattr(sh, "_ledger_owner", ""),
+                        "budget": paged.max_bytes,
+                        "bytes_resident": paged._bytes,
+                        "partitions": len(paged)})
+            arenas[ds] = rows
+        data["arenas"] = arenas
+        return 200, {"status": "success", "data": data}
+
+    @_timed("flightrecorder")
+    def _flightrecorder(self, p: dict) -> tuple[int, dict]:
+        """The black box on demand: recent structured events (ingest
+        batches, flushes, evictions, compiles, page-ins, breaker trips,
+        query start/end), oldest first.  ``limit`` / ``kind`` filter."""
+        from filodb_tpu.utils.devicewatch import FLIGHT
+        limit = max(1, min(int(p.get("limit", 500)), 10_000))
+        events = FLIGHT.events(limit=limit, kind=p.get("kind"))
+        return 200, {"status": "success", "data": {
+            "capacity": FLIGHT.capacity, "events": events}}
+
+    @_timed("config")
+    def _config(self, p: dict) -> tuple[int, dict]:
+        """Effective configuration dump + runtime-adjustable
+        observability knobs.  POST (or params) with
+        ``slow-query-threshold-s`` / ``jit-storm-shapes`` /
+        ``jit-storm-window-s`` / ``flight-recorder-size`` applies the
+        new value immediately (no restart); the response always shows
+        the effective values after any change."""
+        import dataclasses as _dc
+        from filodb_tpu.utils import devicewatch
+        from filodb_tpu.utils.forensics import TRACE_STORE
+        if "slow-query-threshold-s" in p:
+            thr = float(p["slow-query-threshold-s"])
+            if thr <= 0:
+                return 400, error_response(
+                    "bad_data", "slow-query-threshold-s must be > 0")
+            TRACE_STORE.slow_threshold_s = thr
+        devicewatch.COMPILE_WATCH.configure(
+            storm_shapes=p.get("jit-storm-shapes"),
+            storm_window_s=p.get("jit-storm-window-s"))
+        if "flight-recorder-size" in p:
+            devicewatch.FLIGHT.resize(int(p["flight-recorder-size"]))
+        stores: dict = {}
+        for ds, b in self.datasets.items():
+            shards = b.memstore.shards(ds)
+            if shards:
+                stores[ds] = _dc.asdict(shards[0].config)
+        return 200, {"status": "success", "data": {
+            "datasets": stores,
+            "observability": {
+                "slow-query-threshold-s": TRACE_STORE.slow_threshold_s,
+                "jit-storm-shapes":
+                    devicewatch.COMPILE_WATCH.storm_shapes,
+                "jit-storm-window-s":
+                    devicewatch.COMPILE_WATCH.storm_window_s,
+                "flight-recorder-size": devicewatch.FLIGHT.capacity,
+                "devicewatch-enabled": devicewatch.enabled(),
+            }}}
 
     @_timed("integrity")
     def _integrity(self) -> tuple[int, dict]:
@@ -575,16 +670,24 @@ class FiloHttpServer:
                 tok = (qctx.trace_id, None)
             with TRACER.attach(tok):
                 with TRACER.span("query.execute", dataset=b.dataset,
-                                 query=query):
+                                 query=query) as sp:
                     t_plan = _time.perf_counter()
                     with TRACER.span("query.plan"):
                         ep = b.planner.materialize(plan, qctx)
                     plan_s = _time.perf_counter() - t_plan
                     res = ep.execute(ExecContext(b.memstore, qctx))
+                    if res.stats.hbm_resident_delta_bytes:
+                        # devicewatch: residency this query committed /
+                        # released, visible on the stitched trace too
+                        sp.tag(hbm_delta_bytes=res.stats
+                               .hbm_resident_delta_bytes)
             res.stats.add_timing("plan", plan_s)
             res.stats.add_timing("queue", t_run - t0)
             return res
 
+        from filodb_tpu.utils.devicewatch import FLIGHT
+        FLIGHT.record("query.start", trace_id=qctx.trace_id,
+                      dataset=b.dataset, query=query[:200])
         try:
             # ONE root span per query on the entry thread: the
             # scheduler's queue-wait/run spans and the exec tree all
@@ -597,6 +700,9 @@ class FiloHttpServer:
                 else:
                     result = run()
         except BaseException as e:
+            FLIGHT.record("query.end", trace_id=qctx.trace_id,
+                          dataset=b.dataset, error=repr(e)[:200],
+                          seconds=round(_time.perf_counter() - t0, 6))
             TRACE_STORE.note_complete(qctx.trace_id,
                                       _time.perf_counter() - t0,
                                       query=query, dataset=b.dataset,
@@ -604,6 +710,8 @@ class FiloHttpServer:
             raise
         total_s = _time.perf_counter() - t0
         result.stats.timings.setdefault("total", total_s)
+        FLIGHT.record("query.end", trace_id=qctx.trace_id,
+                      dataset=b.dataset, seconds=round(total_s, 6))
         TRACE_STORE.note_complete(qctx.trace_id, total_s, query=query,
                                   dataset=b.dataset)
         return result, qctx.trace_id

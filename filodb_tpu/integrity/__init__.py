@@ -270,3 +270,9 @@ def note_invariant_failure(dataset: str, shard: int, detail: str) -> None:
                                                   shard=str(shard))
     _LOG.critical("integrity invariant failed: dataset=%s shard=%s %s",
                   dataset, shard, detail)
+    # the black box hits the ground: an integrity failure fails the
+    # shard, so the events leading up to it are the postmortem
+    from filodb_tpu.utils.devicewatch import FLIGHT
+    FLIGHT.record("integrity.fail", dataset=dataset, shard=shard,
+                  detail=detail[:200])
+    FLIGHT.dump_to_log(f"integrity failure {dataset}/{shard}")

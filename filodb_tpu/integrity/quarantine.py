@@ -54,7 +54,12 @@ class QuarantineRegistry:
                     "detail": detail, "at_ms": int(time.time() * 1000)})
             else:
                 self._dropped_details += 1
-            return True
+        # a quarantined chunk is an eviction from the serving set:
+        # attribute it on the devicewatch eviction counter + flight ring
+        from filodb_tpu.utils.devicewatch import LEDGER
+        LEDGER.note_eviction(f"quarantine:{dataset}/{shard}",
+                             "integrity_quarantine")
+        return True
 
     def is_quarantined(self, partkey: bytes, chunk_id: int) -> bool:
         with self._lock:

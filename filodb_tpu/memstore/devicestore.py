@@ -47,6 +47,8 @@ from filodb_tpu.ops.grid import (DENSE_ONLY_OPS, PHASE_OPS, TS_FREE_OPS,
                                  GridQuery, max_k_for, on_tpu_backend,
                                  phase_eligible, supports_grid)
 from filodb_tpu.query.logical import RangeFunctionId as F
+from filodb_tpu.utils import devicewatch
+from filodb_tpu.utils.devicewatch import FLIGHT, LEDGER
 
 BLOCK_BUCKETS = 128
 _LANE_PAD = 128
@@ -231,7 +233,6 @@ def _fused_progs():
         return _FUSED_PROGS
     import functools
 
-    import jax
     import jax.numpy as jnp
     from jax import lax
 
@@ -245,7 +246,7 @@ def _fused_progs():
             else jnp.concatenate(segs, axis=0)
         return lax.dynamic_slice_in_dim(all_, row0, nrows, axis=0)
 
-    @functools.partial(jax.jit,
+    @functools.partial(devicewatch.jit, program="devicestore.series",
                        static_argnames=("q", "lanes", "nrows"))
     def series_prog(ts_parts, val_parts, row0, steps0, phase=None, *,
                     q, lanes, nrows):
@@ -253,7 +254,7 @@ def _fused_progs():
         val_sl = _sliced(val_parts, row0, nrows, _seg_vals_device)
         return rate_grid_auto(ts_sl, val_sl, steps0, q, lanes, phase=phase)
 
-    @functools.partial(jax.jit,
+    @functools.partial(devicewatch.jit, program="devicestore.grouped",
                        static_argnames=("q", "lanes", "nrows",
                                         "num_groups", "op"))
     def grouped_prog(ts_parts, val_parts, row0, steps0, garr, phase=None,
@@ -269,7 +270,8 @@ def _fused_progs():
     # packed ~2.5 B/sample planes — no decoded plane is ever written.
     # row0 is static (the kernel's window slices need compile-time
     # sublane offsets); outputs are in PACKED lane order.
-    @functools.partial(jax.jit,
+    @functools.partial(devicewatch.jit,
+                       program="devicestore.series_packed",
                        static_argnames=("q", "row0", "use_phase",
                                         "interpret"))
     def series_prog_packed(packed, steps0, *, q, row0, use_phase,
@@ -277,7 +279,8 @@ def _fused_progs():
         return rate_grid_packed(packed, steps0, q, row0=row0,
                                 interpret=interpret, use_phase=use_phase)
 
-    @functools.partial(jax.jit,
+    @functools.partial(devicewatch.jit,
+                       program="devicestore.grouped_packed",
                        static_argnames=("q", "row0", "use_phase",
                                         "num_groups", "op", "interpret"))
     def grouped_prog_packed(packed, steps0, garr, *, q, row0, use_phase,
@@ -307,9 +310,11 @@ def _run_packed(dispatch):
         return None
     try:
         return dispatch()
-    except Exception:
+    except Exception as e:
         import logging
         _PACKED_BROKEN = True
+        FLIGHT.record("breaker.trip", breaker="packed_kernel",
+                      error=repr(e)[:200])
         logging.getLogger(__name__).exception(
             "fused packed grid kernel failed; falling back to the XLA "
             "decode path for this process")
@@ -411,11 +416,12 @@ def _mesh_stage(ts_parts, val_parts: tuple, row0: int, nrows: int):
     if _MESH_STAGE_FN is None:
         import functools
 
-        import jax
         import jax.numpy as jnp
         from jax import lax
 
-        @functools.partial(jax.jit, static_argnames=("nrows",))
+        @functools.partial(devicewatch.jit,
+                           program="devicestore.mesh_stage",
+                           static_argnames=("nrows",))
         def stage(ts_parts, val_parts, row0, *, nrows):
             val_segs = [_seg_vals_device(s) for s in val_parts]
             val_all = val_segs[0] if len(val_segs) == 1 \
@@ -519,6 +525,10 @@ class DeviceGridCache:
         self.schema_hash = schema_hash
         self.column_id = column_id
         self.budget = budget_bytes
+        # HBM-ledger owner tag for every resident byte this cache
+        # commits (devicewatch: filodb_device_hbm_bytes{owner,format})
+        self.owner = (f"grid:{getattr(shard, 'dataset', '?')}/"
+                      f"{getattr(shard, 'shard_num', '?')}:c{column_id}")
         self.gstep = gstep_ms          # None until detected
         # histogram columns: each partition slot spans ``hb`` device
         # columns (one per cumulative bucket); the SAME scalar kernel
@@ -575,6 +585,10 @@ class DeviceGridCache:
         old device — drop them so they rebuild in place on the new one
         (shard.pin_grid_device)."""
         with self._lock:
+            n = len(self.blocks) + len(self._tails)
+            if n:
+                LEDGER.note_eviction(self.owner, "epoch_purge", n=n,
+                                     nbytes=self.bytes_resident)
             self.blocks.clear()
             self._tails.clear()
             self._phase_memo.clear()
@@ -594,9 +608,12 @@ class DeviceGridCache:
             lo_block = (cs.info.start_time - self.epoch0) // (
                 self.gstep * BLOCK_BUCKETS)
             stale = [bi for bi in self.blocks if bi >= lo_block]
+            nbytes = sum(self.blocks[bi].nbytes for bi in stale)
             for bi in stale:
                 del self.blocks[bi]
             if stale:
+                LEDGER.note_eviction(self.owner, "epoch_purge",
+                                     n=len(stale), nbytes=nbytes)
                 self.version += 1
 
     _STD_STEPS = (1_000, 2_000, 5_000, 10_000, 15_000, 30_000, 60_000,
@@ -626,6 +643,10 @@ class DeviceGridCache:
         self._disable_count += 1
         backoff = 2 ** min(self._disable_count, 16)
         self.disabled_until_version = self._shard.ingest_epoch + backoff
+        n = len(self.blocks) + len(self._tails)
+        if n:
+            LEDGER.note_eviction(self.owner, "epoch_purge", n=n,
+                                 nbytes=self.bytes_resident)
         self.blocks.clear()
         self._tails.clear()
         self._plan_memo.clear()            # plans pin the dropped blocks
@@ -772,6 +793,10 @@ class DeviceGridCache:
                     else tuple(b.ts_seg for b in plan.segs),
                     tuple(b.vals for b in plan.segs),
                     plan.row0, nrows=plan.nrows)
+                # the staged planes are HBM residents held by the memo:
+                # they belong on the ledger like any committed block
+                LEDGER.track(ts_st, owner=self.owner, fmt="mesh-staged")
+                LEDGER.track(val_st, owner=self.owner, fmt="mesh-staged")
                 if len(self._mesh_stage_memo) > 4:
                     self._mesh_stage_memo.clear()
                 # hold the block refs: id() stays unambiguous while the
@@ -1126,7 +1151,6 @@ class DeviceGridCache:
         lane per query would cost more than it saves on a tunnel link.
         Unrequested lanes get phase 1; their outputs are sliced away or
         segment-dropped downstream, so any value is safe."""
-        import jax
         phases = np.where(ph_req > 0, ph_req, 1).astype(np.int32)
         memo = self._phase_memo.get(key)
         if memo is not None and memo[0].shape[0] == ncols:
@@ -1141,7 +1165,8 @@ class DeviceGridCache:
         else:
             ph_cols = np.ones(ncols, np.int32)
             ph_cols[req] = phases
-        dev = jax.device_put(ph_cols, self._shard.grid_device)
+        dev = LEDGER.device_put(ph_cols, self._shard.grid_device,
+                                owner=self.owner, fmt="scratch")
         self._phase_memo.clear()
         self._phase_memo[key] = (ph_cols, dev)
         return dev
@@ -1231,8 +1256,6 @@ class DeviceGridCache:
 
     def _build(self, bi: int, lanes: int, compress: bool = True):
         """Host staging + one upload for block ``bi``."""
-        import jax
-
         g = self.gstep
         stride = self.hb if self.hist else 1
         # block bi holds buckets [bi*BB, bi*BB+BB-1]; bucket c covers
@@ -1328,22 +1351,27 @@ class DeviceGridCache:
             phase = np.where(fcnt > 0, pmin, 1).astype(np.int32)
             ts_desc = {"base": int((bi * BLOCK_BUCKETS - 1) * g),
                        "g": int(g),
-                       "phase": jax.device_put(phase, dev)}
+                       "phase": LEDGER.device_put(phase, dev,
+                                                  owner=self.owner,
+                                                  fmt="compressed")}
             nbytes += phase.nbytes
         else:
-            ts_dev = jax.device_put(ts_stage, dev)
+            ts_dev = LEDGER.device_put(ts_stage, dev, owner=self.owner,
+                                       fmt="dense")
             nbytes += ts_stage.nbytes
         from filodb_tpu.codecs import xorgrid
         packed = xorgrid.pack_vals(val_stage, phase=phase) \
             if do_compress else None
         pack_inv = None
         if packed is not None:
-            vals_dev = {k: jax.device_put(v, dev)
+            vals_dev = {k: LEDGER.device_put(v, dev, owner=self.owner,
+                                             fmt="compressed")
                         for k, v in packed.planes.items()}
             pack_inv = packed.inv
             nbytes += packed.nbytes
         else:
-            vals_dev = jax.device_put(val_stage, dev)
+            vals_dev = LEDGER.device_put(val_stage, dev, owner=self.owner,
+                                         fmt="dense")
             nbytes += val_stage.nbytes
         return _Block(ts_dev, vals_dev,
                       lanes, self._seq, (fmin, fmax, fcnt), (pmin, pmax),
@@ -1356,6 +1384,7 @@ class DeviceGridCache:
         reclaim-on-demand over time-ordered block lists).  Caller holds
         the lock.  Returns bytes freed."""
         freed = 0
+        evicted = 0
         while self.bytes_resident > target_bytes and len(self.blocks) > 1:
             victims = [bi for bi in sorted(self.blocks) if bi not in keep]
             if not victims:
@@ -1363,6 +1392,10 @@ class DeviceGridCache:
             freed += self.blocks[victims[0]].nbytes
             del self.blocks[victims[0]]
             self.evictions += 1
+            evicted += 1
+        if evicted:
+            LEDGER.note_eviction(self.owner, "budget_overflow", n=evicted,
+                                 nbytes=freed)
         if freed:
             # memoized plans hold strong block refs: drop them so the
             # reclaim actually releases HBM

@@ -291,6 +291,15 @@ class OnDemandPagingShard(TimeSeriesShard):
             page_cache_bytes = self.config.page_cache_bytes
         self.paged = _PagedPartitions(page_cache_bytes,
                                       on_evict=self._on_page_evict)
+        # devicewatch ledger: the page cache is a budgeted resident
+        # arena like the HBM grids — register it as a sampled pool so
+        # /admin/device and filodb_device_hbm_bytes show who holds it
+        from filodb_tpu.utils.devicewatch import LEDGER
+        self._ledger_owner = f"odp:{self.dataset}/{self.shard_num}"
+        paged = self.paged
+        LEDGER.register_pool(self._ledger_owner,
+                             lambda: paged._bytes,
+                             lambda: paged.max_bytes)
         # serializes page-in / backfill store reads across query threads so
         # concurrent misses for the same partition don't duplicate work
         self._odp_lock = threading.Lock()
@@ -359,6 +368,11 @@ class OnDemandPagingShard(TimeSeriesShard):
         ctx = _active_ctx()
         if ctx is not None:
             ctx.note_counts(chunks=nchunks, pages=nparts)
+        if nparts or nchunks:
+            from filodb_tpu.utils.devicewatch import FLIGHT
+            FLIGHT.record("odp.pagein", dataset=self.dataset,
+                          shard=self.shard_num, partitions=nparts,
+                          chunks=nchunks)
 
     def _on_page_evict(self) -> None:
         # called after the page-cache lock is released; concurrent evictions
@@ -366,6 +380,8 @@ class OnDemandPagingShard(TimeSeriesShard):
         # bump would leave a grid prep stamped "current" despite an
         # eviction it never observed)
         self.bump_removal_epoch()
+        from filodb_tpu.utils.devicewatch import LEDGER
+        LEDGER.note_eviction(self._ledger_owner, "budget_overflow")
 
     # ------------------------------------------------------------ resolution
 
@@ -1205,6 +1221,9 @@ class OnDemandPagingShard(TimeSeriesShard):
                 self.bump_removal_epoch()    # invalidates grid prep caches
                 self.paged.pop(pid)          # cached copy lacks the tail
                 self.paged.pop(("bf", pid))  # list is live-part relative
+                from filodb_tpu.utils.devicewatch import LEDGER
+                LEDGER.note_eviction(self._ledger_owner, "epoch_purge",
+                                     n=2)
                 # hard reclaim invariant (still under _odp_lock, so no
                 # legitimate re-page-in can land): a popped entry that is
                 # STILL cached means a publish resurrected stale buffers

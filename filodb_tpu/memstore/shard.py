@@ -428,6 +428,10 @@ class TimeSeriesShard:
             m["flush_seconds"].observe(time.perf_counter() - t0,
                                        dataset=self.dataset)
         m["chunks"].inc(n, dataset=self.dataset)
+        from filodb_tpu.utils.devicewatch import FLIGHT
+        FLIGHT.record("flush", dataset=self.dataset, shard=self.shard_num,
+                      group=task.group, chunks=n,
+                      seconds=round(time.perf_counter() - t0, 6))
         return n
 
     def _run_flush_task(self, task: "FlushTask") -> int:

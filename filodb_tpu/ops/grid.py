@@ -54,6 +54,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from filodb_tpu.utils import devicewatch
+
 _IBIG = 2**30
 
 
@@ -843,7 +845,8 @@ def _phase8(phase):
     return jnp.broadcast_to(ph[0:1, :], (8, ph.shape[-1]))
 
 
-@functools.partial(jax.jit, static_argnames=("q", "lanes", "interpret"))
+@functools.partial(devicewatch.jit, program="grid.rate_grid",
+                   static_argnames=("q", "lanes", "interpret"))
 def rate_grid(ts, vals, steps0, q: GridQuery, lanes: int = 1024,
               interpret: bool = False, phase=None):
     """Per-series windowed function over an aligned grid: [B, S] -> [T, S].
@@ -898,7 +901,8 @@ def rate_grid(ts, vals, steps0, q: GridQuery, lanes: int = 1024,
 _GPS = 8  # groups per output block (output sublane granularity)
 
 
-@functools.partial(jax.jit, static_argnames=("q", "group_lanes", "interpret"))
+@functools.partial(devicewatch.jit, program="grid.rate_grid_grouped",
+                   static_argnames=("q", "group_lanes", "interpret"))
 def rate_grid_grouped(ts, vals, steps0, q: GridQuery,
                       group_lanes: int = 1024, interpret: bool = False,
                       phase=None):
@@ -1079,7 +1083,7 @@ def _plane_lane_tile(n: int) -> int:
     return n
 
 
-@functools.partial(jax.jit,
+@functools.partial(devicewatch.jit, program="grid.rate_grid_packed",
                    static_argnames=("q", "row0", "interpret", "use_phase"))
 def rate_grid_packed(packed: dict, steps0, q: GridQuery, row0: int = 0,
                      interpret: bool = False, use_phase: bool = False):
@@ -1123,7 +1127,8 @@ def rate_grid_packed(packed: dict, steps0, q: GridQuery, row0: int = 0,
     return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
 
 
-@functools.partial(jax.jit,
+@functools.partial(devicewatch.jit,
+                   program="grid.rate_grid_grouped_packed",
                    static_argnames=("q", "group_lanes", "row0", "interpret",
                                     "use_phase"))
 def rate_grid_grouped_packed(packed: dict, steps0, q: GridQuery,

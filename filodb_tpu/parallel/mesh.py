@@ -43,6 +43,9 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from filodb_tpu.utils import devicewatch
+from filodb_tpu.utils.devicewatch import LEDGER
+
 from filodb_tpu.core.chunk import ChunkBatch, TS_PAD
 from filodb_tpu.ops.windows import StepRange
 from filodb_tpu.query.logical import AggregationOperator as Agg
@@ -202,7 +205,7 @@ def _build_program(mesh_key, range_fn, agg_op: Agg, num_groups: int,
         out_specs=out_spec if present
         else tuple([out_spec] * len(partial_state_names(agg_op))),
     )
-    return jax.jit(fn)
+    return devicewatch.jit(fn, program="mesh.agg")
 
 
 def _shard_map_unchecked(fn, **kw):
@@ -279,7 +282,7 @@ def _build_topk_program(mesh_key, range_fn, num_groups: int, window_ms: int,
         local, mesh=mesh,
         in_specs=(P("shard", None), P("shard", None), P("shard"), P("step")),
         out_specs=(P(None, None, "step"), P(None, None, "step")))
-    return jax.jit(fn)
+    return devicewatch.jit(fn, program="mesh.topk")
 
 
 @functools.lru_cache(maxsize=64)
@@ -317,7 +320,7 @@ def _build_quantile_program(mesh_key, range_fn, num_groups: int,
         local, mesh=mesh,
         in_specs=(P("shard", None), P("shard", None), P("shard"), P("step")),
         out_specs=(P(None, "step", None), P(None, "step", None)))
-    return jax.jit(fn)
+    return devicewatch.jit(fn, program="mesh.quantile")
 
 
 @functools.lru_cache(maxsize=64)
@@ -340,7 +343,7 @@ def _build_values_program(mesh_key, range_fn, window_ms: int, wmax: int,
         local, mesh=mesh,
         in_specs=(P("shard", None), P("shard", None), P("step")),
         out_specs=P("shard", "step"))
-    return jax.jit(fn)
+    return devicewatch.jit(fn, program="mesh.values")
 
 
 @functools.lru_cache(maxsize=64)
@@ -368,7 +371,7 @@ def _build_hist_program(mesh_key, range_fn, num_groups: int,
         in_specs=(P("shard", None), P("shard", None, None), P("shard"),
                   P("step")),
         out_specs=(P(None, "step", None), P(None, "step")))
-    return jax.jit(fn)
+    return devicewatch.jit(fn, program="mesh.hist")
 
 
 # shard_map needs the Mesh object at trace time but lru_cache needs hashable
@@ -405,7 +408,9 @@ class MeshEngine:
         return self.mesh.devices.shape[1]
 
     def _place(self, arr: np.ndarray, spec: P):
-        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+        # scratch: per-query host batches staged for one SPMD dispatch
+        return LEDGER.device_put(arr, NamedSharding(self.mesh, spec),
+                                 owner="mesh:batch", fmt="scratch")
 
     def stack_shards(self, shard_batches: Sequence[ChunkBatch],
                      group_ids: Sequence[np.ndarray], hist: bool = False):

@@ -164,6 +164,13 @@ class MeshAggregateExec(ExecPlan):
                                                  num_grid_groups,
                                                  self.operator,
                                                  params=self.params)
+                # flight recorder: whether the resident SPMD path served
+                # (or demoted to host-batch) is the first question of
+                # any mesh-latency postmortem
+                from filodb_tpu.utils.devicewatch import FLIGHT
+                FLIGHT.record("mesh.serve", dataset=self.dataset,
+                              shards=len(plans), groups=num_grid_groups,
+                              resident=state is not None)
                 if state is not None:
                     keys = [dict(k) for k in
                             list(union)[:num_grid_groups]]

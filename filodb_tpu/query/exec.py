@@ -76,7 +76,8 @@ class ExecContext:
 
     def note_counts(self, samples: int = 0, chunks: int = 0,
                     bytes_: int = 0, pages: int = 0,
-                    hbm_dense: int = 0, hbm_compressed: int = 0) -> None:
+                    hbm_dense: int = 0, hbm_compressed: int = 0,
+                    hbm_delta: int = 0) -> None:
         with self._corrupt_lock:
             c = self._counters
             if samples:
@@ -92,6 +93,10 @@ class ExecContext:
             if hbm_compressed:
                 c["hbm_compressed"] = c.get("hbm_compressed", 0) \
                     + hbm_compressed
+            if hbm_delta:
+                # signed: the devicewatch ledger credits commits and
+                # debits frees caused while this query was active
+                c["hbm_delta"] = c.get("hbm_delta", 0) + hbm_delta
 
     def absorb_stats(self, stats: QueryStats) -> None:
         """Fold a REMOTE child's stats into this query's accounting
@@ -101,7 +106,8 @@ class ExecContext:
                          bytes_=stats.bytes_scanned, pages=stats.pages_in,
                          hbm_dense=stats.hbm_read_bytes.get("dense", 0),
                          hbm_compressed=stats.hbm_read_bytes.get(
-                             "compressed", 0))
+                             "compressed", 0),
+                         hbm_delta=stats.hbm_resident_delta_bytes)
         if stats.corrupt_chunks_excluded:
             self.note_corrupt_excluded(stats.corrupt_chunks_excluded)
         for k, v in stats.timings.items():
@@ -121,6 +127,7 @@ class ExecContext:
                 k: c[ck] for k, ck in (("dense", "hbm_dense"),
                                        ("compressed", "hbm_compressed"))
                 if c.get(ck)}
+            stats.hbm_resident_delta_bytes = c.get("hbm_delta", 0)
 
 
 class PlanDispatcher:

@@ -60,6 +60,10 @@ class QueryStats:
     # actually serving traffic observable (ISSUE 3; the compressed
     # resident reads ~2.5 B/sample vs 4 for decoded planes)
     hbm_read_bytes: dict = dataclasses.field(default_factory=dict)
+    # net change in ledger-tracked HBM residency this query caused
+    # (ISSUE 4: blocks committed minus blocks evicted/freed while the
+    # query's ExecContext was active); 0 for a fully warm query
+    hbm_resident_delta_bytes: int = 0
 
     def merge(self, other: "QueryStats") -> None:
         self.samples_scanned += other.samples_scanned
@@ -74,6 +78,7 @@ class QueryStats:
             self.timings[k] = self.timings.get(k, 0.0) + v
         for k, v in other.hbm_read_bytes.items():
             self.hbm_read_bytes[k] = self.hbm_read_bytes.get(k, 0) + v
+        self.hbm_resident_delta_bytes += other.hbm_resident_delta_bytes
 
     def add_timing(self, stage: str, seconds: float) -> None:
         self.timings[stage] = self.timings.get(stage, 0.0) + seconds

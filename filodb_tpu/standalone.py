@@ -107,11 +107,19 @@ class FiloServer:
         up = REGISTRY.gauge("filodb_node_up")
         up.set(1.0, node=self.node)
         # slow-query forensics threshold (seconds); completed queries
-        # slower than this keep their span tree in /admin/slowlog
+        # slower than this keep their span tree in /admin/slowlog.
+        # Runtime-adjustable afterwards via POST /admin/config.
         thr = self.config.get("slow-query-threshold-s")
         if thr is not None:
             from filodb_tpu.utils.forensics import TRACE_STORE
             TRACE_STORE.slow_threshold_s = float(thr)
+        # device-resource observability (ISSUE 4): storm-detector tuning
+        # + flight-recorder sizing from the "devicewatch" config block,
+        # and the crash hooks that dump the black box on an unhandled
+        # exception shutdown
+        from filodb_tpu.utils import devicewatch
+        devicewatch.configure(self.config.get("devicewatch"))
+        devicewatch.install_crash_hooks()
 
         for ds_conf in self.config.get("datasets", []):
             self._setup_dataset(ds_conf)

@@ -116,6 +116,16 @@ class TraceStore:
         entry = {"trace_id": trace_id, "query": query, "dataset": dataset,
                  "duration_s": duration_s, "when_s": time.time(),
                  "error": error, "tree": self.tree(trace_id)}
+        try:
+            # a slow query DURING a recompile storm is usually slow
+            # BECAUSE of it: flag the programs so the operator reading
+            # /admin/slowlog doesn't chase the wrong stage (ISSUE 4)
+            from filodb_tpu.utils.devicewatch import COMPILE_WATCH
+            storms = COMPILE_WATCH.active_storms()
+            if storms:
+                entry["recompile_storms"] = storms
+        except Exception:  # noqa: BLE001 — forensics never fails a query
+            pass
         with self._lock:
             self._slowlog.append(entry)
 

@@ -323,6 +323,78 @@ def odp_metrics() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Process-level metrics (ISSUE 4 satellite): node dashboards read RSS /
+# FDs / threads / uptime / GC pressure from the SAME /metrics endpoint,
+# no separate node exporter required.  All gauges are set_fn-sampled at
+# scrape time; /proc reads are linux-only and degrade to 0 elsewhere.
+# ---------------------------------------------------------------------------
+
+_PROCESS_START_S = time.time()
+_PAGE_SIZE = 4096
+try:
+    import os as _os
+    _PAGE_SIZE = _os.sysconf("SC_PAGE_SIZE")
+except (ImportError, ValueError, OSError):  # pragma: no cover - non-posix
+    pass
+
+
+def _rss_bytes() -> float:
+    try:
+        with open("/proc/self/statm") as f:
+            return float(f.read().split()[1]) * _PAGE_SIZE
+    except OSError:  # pragma: no cover - non-linux
+        try:
+            import resource
+            return float(resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss) * 1024.0
+        except Exception:  # noqa: BLE001
+            return 0.0
+
+
+def _open_fds() -> float:
+    try:
+        import os
+        return float(len(os.listdir("/proc/self/fd")))
+    except OSError:  # pragma: no cover - non-linux
+        return 0.0
+
+
+def process_metrics() -> dict:
+    """Canonical ``filodb_process_*`` family: RSS, open FDs, thread
+    count, start time / uptime, and per-generation GC collections.
+    Registered once at import so every /metrics scrape carries them."""
+    import gc
+
+    rss = REGISTRY.gauge("filodb_process_resident_memory_bytes",
+                         "resident set size of this process")
+    rss.set_fn(_rss_bytes)
+    fds = REGISTRY.gauge("filodb_process_open_fds",
+                         "open file descriptors")
+    fds.set_fn(_open_fds)
+    threads = REGISTRY.gauge("filodb_process_threads",
+                             "live python threads")
+    threads.set_fn(lambda: float(threading.active_count()))
+    start = REGISTRY.gauge("filodb_process_start_time_seconds",
+                           "unix time the process started")
+    start.set(_PROCESS_START_S)
+    uptime = REGISTRY.gauge("filodb_process_uptime_seconds",
+                            "seconds since process start")
+    uptime.set_fn(lambda: time.time() - _PROCESS_START_S)
+    gens = REGISTRY.gauge("filodb_process_gc_collections",
+                          "garbage collections per generation")
+    for gen in range(3):
+        gens.set_fn(
+            (lambda g: lambda: float(gc.get_stats()[g]["collections"]))(
+                gen), generation=str(gen))
+    return {"rss": rss, "open_fds": fds, "threads": threads,
+            "start_time": start, "uptime": uptime,
+            "gc_collections": gens}
+
+
+process_metrics()
+
+
+# ---------------------------------------------------------------------------
 # Tracing spans
 # ---------------------------------------------------------------------------
 
