@@ -12,6 +12,11 @@ recorder) toggled OFF vs ON; the bench EXITS NONZERO when the
 instrumentation overhead exceeds the 3% budget (with a 0.5 ms absolute
 floor so host-noise on a fast loop cannot trip CI spuriously).
 
+ISSUE 5 guard: a third leg runs the loop through the workload
+admission path (deadline mint -> cost estimate -> admit permit ->
+calibration observe) vs without it, under the SAME 3% / 0.5 ms budget —
+overload defense must be free when there is no overload.
+
 Env: FILODB_OVH_SERIES (default 512), FILODB_OVH_ITERS (default 60).
 """
 
@@ -123,6 +128,54 @@ def main():
          p90_on_ms=round(p90_on * 1e3, 3))
     if overhead > 0.03 and (med_on - med_off) > 5e-4:
         log(f"FAIL: devicewatch overhead {overhead * 100:.2f}% exceeds "
+            f"the 3% budget")
+        return 1
+
+    # admission-control guard (ISSUE 5): the same loop routed through
+    # the workload front door — deadline mint, index-priced cost
+    # estimate, admit permit, calibration observe on release — vs the
+    # bare loop.  Budget large enough that nothing is shed: this
+    # measures the DECISION cost, not the shedding.
+    from filodb_tpu.workload import deadline as wdl
+    from filodb_tpu.workload.admission import AdmissionController
+    from filodb_tpu.workload.cost import CostModel
+    ctrl = AdmissionController(CostModel(), dataset="bench",
+                               max_inflight_cost=1e12, workers=1)
+
+    def once_admitted():
+        lp = query_range_to_logical_plan(query, start, STEP, end)
+        qctx = wdl.mint(QueryContext(
+            submit_time_ms=int(time.time() * 1000)))
+        ep = planner.materialize(lp, qctx)
+        cost = ctrl.cost_model.estimate(ep, ms)
+        with ctrl.admit(qctx, cost):
+            res = ep.execute(ExecContext(ms, qctx))
+        return to_prom_matrix(res)
+
+    # INTERLEAVED A/B: alternate bare and admitted iterations so host
+    # drift (thermal, GC, page cache) hits both legs equally — the
+    # ~25us decision cost would otherwise drown in between-leg noise
+    once()
+    once_admitted()
+    lat_base, lat_adm = [], []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        once()
+        lat_base.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        once_admitted()
+        lat_adm.append(time.perf_counter() - t0)
+    med_base = statistics.median(lat_base)
+    med_adm = statistics.median(lat_adm)
+    p90_adm = sorted(lat_adm)[int(0.9 * len(lat_adm))]
+    adm_overhead = (med_adm - med_base) / med_base
+    log(f"admission off {med_base * 1e3:.2f} ms  "
+        f"on {med_adm * 1e3:.2f} ms  overhead {adm_overhead * 100:+.2f}%")
+    emit("admission_overhead_median", adm_overhead * 100, "%",
+         off_ms=round(med_base * 1e3, 3), on_ms=round(med_adm * 1e3, 3),
+         p90_on_ms=round(p90_adm * 1e3, 3))
+    if adm_overhead > 0.03 and (med_adm - med_base) > 5e-4:
+        log(f"FAIL: admission overhead {adm_overhead * 100:.2f}% exceeds "
             f"the 3% budget")
         return 1
     return 0

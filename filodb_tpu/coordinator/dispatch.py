@@ -11,19 +11,48 @@ memstore and returns the serialized result.
 
 from __future__ import annotations
 
+import collections
+import concurrent.futures
 import json
+import threading
 import time
 import urllib.request
 from typing import Callable, Optional
 
 from filodb_tpu.query.exec import ExecContext, PlanDispatcher
-from filodb_tpu.query.model import QueryError, QueryResult
+from filodb_tpu.query.model import QueryError, QueryResult, ShardUnavailable
 from filodb_tpu.query.wire import (deserialize_plan, deserialize_result,
                                    serialize_plan, serialize_result)
 from filodb_tpu.utils.observability import TRACER
+from filodb_tpu.workload import deadline as dl
 
 TRACE_HEADER = "X-FiloDB-Trace-Id"
 PARENT_SPAN_HEADER = "X-FiloDB-Parent-Span"
+
+_WM = None
+
+
+def _wm() -> dict:
+    """The filodb_dispatch_* metric objects, resolved once per process
+    (no registry-lock lookups on the dispatch hot path)."""
+    global _WM
+    if _WM is None:
+        from filodb_tpu.utils.observability import workload_metrics
+        _WM = workload_metrics()
+    return _WM
+
+
+_HEDGE_POOL: Optional[concurrent.futures.ThreadPoolExecutor] = None
+_HEDGE_POOL_LOCK = threading.Lock()
+
+
+def _hedge_pool() -> concurrent.futures.ThreadPoolExecutor:
+    global _HEDGE_POOL
+    with _HEDGE_POOL_LOCK:
+        if _HEDGE_POOL is None:
+            _HEDGE_POOL = concurrent.futures.ThreadPoolExecutor(
+                max_workers=16, thread_name_prefix="dispatch-hedge")
+        return _HEDGE_POOL
 
 
 class HttpPlanDispatcher(PlanDispatcher):
@@ -32,11 +61,161 @@ class HttpPlanDispatcher(PlanDispatcher):
     Trace context crosses the process boundary twice over: the
     ``trace_id`` rides the execplan wire dict (QueryContext field) AND
     the HTTP headers; the data node returns its spans with the result
-    so the coordinator's TraceStore holds ONE stitched tree."""
+    so the coordinator's TraceStore holds ONE stitched tree.
 
-    def __init__(self, endpoint: str, timeout_s: float = 60.0):
+    Workload hardening (ISSUE 5):
+
+    - every attempt's socket timeout is ``min(timeout_s cap, remaining
+      deadline budget)`` — never a fixed constant (satellite #1 fix);
+    - CONNECTION-level failures (refused/reset/DNS/socket timeout)
+      retry up to ``max_retries`` times with exponential backoff, budget
+      permitting; an HTTP response is never retried (the server spoke —
+      re-asking multiplies load exactly when it must not);
+    - with ``hedge=True`` a tail-slow first attempt triggers ONE hedged
+      duplicate once it exceeds the dispatcher's observed p99 latency
+      (read-only /execplan work is idempotent); first success wins;
+    - a dispatch that exhausts retries raises :class:`ShardUnavailable`
+      so scatter-gather can degrade to a warned partial result when the
+      query allows it."""
+
+    def __init__(self, endpoint: str, timeout_s: float = 60.0,
+                 max_retries: int = 2, backoff_s: float = 0.05,
+                 hedge: bool = False, hedge_min_s: float = 0.05,
+                 hedge_warmup: int = 16):
         self.endpoint = endpoint.rstrip("/")
         self.timeout_s = timeout_s
+        self.max_retries = max(int(max_retries), 0)
+        self.backoff_s = float(backoff_s)
+        self.hedge = bool(hedge)
+        self.hedge_min_s = float(hedge_min_s)
+        self.hedge_warmup = max(int(hedge_warmup), 1)
+        # recent successful-attempt latencies -> p99 hedge trigger
+        self._lat: collections.deque = collections.deque(maxlen=128)
+        self._lat_lock = threading.Lock()
+
+    # -------------------------------------------------------------- transport
+
+    def _note_latency(self, seconds: float) -> None:
+        with self._lat_lock:
+            self._lat.append(seconds)
+
+    def hedge_delay_s(self) -> Optional[float]:
+        """p99 of recent attempt latencies (floored at ``hedge_min_s``);
+        None until ``hedge_warmup`` samples exist — hedging stays off
+        until the trigger is data-driven."""
+        with self._lat_lock:
+            lat = sorted(self._lat)
+        if len(lat) < self.hedge_warmup:
+            return None
+        return max(lat[min(int(0.99 * len(lat)), len(lat) - 1)],
+                   self.hedge_min_s)
+
+    def _send_once(self, body: bytes, headers: dict,
+                   deadline_timeout_s: float) -> dict:
+        req = urllib.request.Request(
+            f"{self.endpoint}/execplan", data=body, method="POST",
+            headers=headers)
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req,
+                                    timeout=deadline_timeout_s) as resp:
+            payload = json.loads(resp.read())
+        self._note_latency(time.perf_counter() - t0)
+        return payload
+
+    def _send_hedged(self, make_body, headers: dict,
+                     deadline_timeout_s: float) -> dict:
+        """First attempt with a p99-armed hedge: when the primary is
+        still in flight past the hedge delay, launch ONE duplicate and
+        take whichever answers first.  The WHOLE hedged attempt —
+        hedge-delay wait included — stays inside ``deadline_timeout_s``
+        so a tail-latency storm cannot pin dispatch threads past the
+        deadline they exist to enforce."""
+        t_start = time.perf_counter()
+        delay = self.hedge_delay_s()
+        if delay is None or delay >= deadline_timeout_s:
+            return self._send_once(make_body(), headers,
+                                   deadline_timeout_s)
+        pool = _hedge_pool()
+        first = pool.submit(self._send_once, make_body(), headers,
+                            deadline_timeout_s)
+        try:
+            return first.result(timeout=delay)
+        except concurrent.futures.TimeoutError:
+            pass  # tail-slow: hedge below
+        m = _wm()
+        m["dispatch_hedged"].inc(endpoint=self.endpoint)
+        # fresh body: the wire budget_ms re-encodes from what is left NOW
+        second = pool.submit(self._send_once, make_body(), headers,
+                             deadline_timeout_s)
+        pending = {first: "first", second: "second"}
+        last_err: Optional[BaseException] = None
+        while pending:
+            budget_left = deadline_timeout_s \
+                - (time.perf_counter() - t_start)
+            if budget_left <= 0:
+                break
+            done, _ = concurrent.futures.wait(
+                set(pending), timeout=budget_left,
+                return_when=concurrent.futures.FIRST_COMPLETED)
+            if not done:
+                break
+            for fut in done:
+                tag = pending.pop(fut)
+                err = fut.exception()
+                if err is None:
+                    if tag == "second":
+                        m["dispatch_hedge_wins"].inc(
+                            endpoint=self.endpoint)
+                    return fut.result()
+                last_err = err
+        raise last_err if last_err is not None else TimeoutError(
+            f"hedged dispatch to {self.endpoint} timed out")
+
+    def _request(self, plan, make_body, headers: dict) -> dict:
+        """Deadline-capped attempt loop: bounded retry-with-backoff on
+        connection errors, optional p99 hedging on the first attempt.
+        ``make_body`` re-serializes the plan PER ATTEMPT: the wire's
+        relative ``budget_ms`` must reflect what is left NOW, not what
+        was left before a failed attempt burned part of it — a stale
+        body would let the data node re-anchor budget the coordinator
+        already spent."""
+        qctx = plan.query_context
+        last_err: Optional[BaseException] = None
+        for attempt in range(self.max_retries + 1):
+            rem = dl.remaining_ms(qctx)
+            if rem is not None and rem <= 0:
+                if last_err is None:
+                    raise dl.DeadlineExceeded(
+                        qctx.query_id,
+                        f"deadline exhausted before dispatch to "
+                        f"{self.endpoint}")
+                break  # budget gone mid-retry: report the transport error
+            deadline_timeout_s = dl.budget_timeout_s(qctx, self.timeout_s)
+            try:
+                if attempt == 0 and self.hedge:
+                    return self._send_hedged(make_body, headers,
+                                             deadline_timeout_s)
+                return self._send_once(make_body(), headers,
+                                       deadline_timeout_s)
+            except urllib.error.HTTPError:
+                raise  # the server answered: never retry (load-safe)
+            except (urllib.error.URLError, OSError) as e:
+                last_err = e
+                if attempt < self.max_retries:
+                    _wm()["dispatch_retries"].inc(endpoint=self.endpoint)
+                    pause = self.backoff_s * (2 ** attempt)
+                    rem = dl.remaining_ms(qctx)
+                    if rem is not None:
+                        pause = min(pause, max(rem / 1000.0, 0.0))
+                    if pause > 0:
+                        time.sleep(pause)
+        _wm()["dispatch_failures"].inc(endpoint=self.endpoint)
+        raise ShardUnavailable(
+            qctx.query_id,
+            f"remote dispatch to {self.endpoint} failed after "
+            f"{self.max_retries + 1} attempt(s): {last_err}") from last_err
+
+    # --------------------------------------------------------------- dispatch
 
     def dispatch(self, plan, ctx: ExecContext) -> QueryResult:
         tid = plan.query_context.trace_id or ctx.query_context.trace_id \
@@ -46,25 +225,36 @@ class HttpPlanDispatcher(PlanDispatcher):
         with TRACER.span("dispatch.http", endpoint=self.endpoint,
                          plan=type(plan).__name__,
                          shard=getattr(plan, "shard", "")) as sp:
-            t0 = time.perf_counter()
-            body = json.dumps(serialize_plan(plan)).encode()
-            ser_s = time.perf_counter() - t0
+            # serialized per attempt (see _request): the wire budget_ms
+            # is encoded at build time; all builds land in the
+            # serialize timing bucket
+            ser_box = [0.0]
+
+            def make_body():
+                t0 = time.perf_counter()
+                body = json.dumps(serialize_plan(plan)).encode()
+                ser_box[0] += time.perf_counter() - t0
+                return body
+
             headers = {"Content-Type": "application/json"}
             if tid:
                 headers[TRACE_HEADER] = tid
                 headers[PARENT_SPAN_HEADER] = sp.span_id
-            req = urllib.request.Request(
-                f"{self.endpoint}/execplan", data=body, method="POST",
-                headers=headers)
             try:
-                with urllib.request.urlopen(req,
-                                            timeout=self.timeout_s) as resp:
-                    payload = json.loads(resp.read())
+                payload = self._request(plan, make_body, headers)
             except urllib.error.HTTPError as e:
                 try:
                     err = json.loads(e.read()).get("error", "")
                 except Exception:
                     err = f"HTTP {e.code}"
+                if e.code == 503:
+                    # the data node REFUSED the work (overload / budget
+                    # too small to finish): transport-class failure, so
+                    # allow_partial_results can degrade it
+                    raise ShardUnavailable(
+                        plan.query_context.query_id,
+                        f"remote dispatch to {self.endpoint} refused: "
+                        f"{err}") from e
                 raise QueryError(plan.query_context.query_id,
                                  f"remote dispatch to {self.endpoint} "
                                  f"failed: {err}") from e
@@ -78,7 +268,7 @@ class HttpPlanDispatcher(PlanDispatcher):
                     pass
             result = deserialize_result(payload)
             ctx.note_timing("serialize",
-                            ser_s + (time.perf_counter() - t1))
+                            ser_box[0] + (time.perf_counter() - t1))
             # remote stats fold into the coordinator's accounting exactly
             # like local leaves noting into the shared ctx
             ctx.absorb_stats(result.stats)
@@ -125,14 +315,24 @@ def execplan_handler(memstore) -> Callable[..., dict]:
 
 
 def dispatcher_factory(mapper, endpoints: dict[str, str],
-                       local_node: Optional[str] = None
+                       local_node: Optional[str] = None,
+                       dispatch_config: Optional[dict] = None
                        ) -> Callable[[int], PlanDispatcher]:
     """shard -> dispatcher, from the ShardMapper's owner and a node ->
     endpoint map (the plug for SingleClusterPlanner.dispatcher_for_shard).
     Shards owned by ``local_node`` (or by unknown nodes) execute
-    in-process."""
+    in-process.  ``dispatch_config`` (the standalone ``workload.
+    dispatch`` block) tunes the timeout cap / retries / hedging of the
+    HTTP dispatchers it builds."""
     from filodb_tpu.query.exec import IN_PROCESS
 
+    cfg = dispatch_config or {}
+    kwargs = dict(
+        timeout_s=float(cfg.get("timeout-cap-s", 60.0)),
+        max_retries=int(cfg.get("retries", 2)),
+        backoff_s=float(cfg.get("backoff-s", 0.05)),
+        hedge=bool(cfg.get("hedge", False)),
+        hedge_min_s=float(cfg.get("hedge-min-s", 0.05)))
     cache: dict[str, HttpPlanDispatcher] = {}
 
     def for_shard(shard: int) -> PlanDispatcher:
@@ -142,11 +342,12 @@ def dispatcher_factory(mapper, endpoints: dict[str, str],
         endpoint = endpoints.get(node)
         if endpoint is None:
             # a remote-owned shard with no known endpoint must FAIL the
-            # query, not silently scan an empty local store
+            # query (or degrade to a warned partial result when the
+            # query opts in), never silently scan an empty local store
             return _UnroutableDispatcher(shard, node)
         d = cache.get(node)
         if d is None:
-            d = cache[node] = HttpPlanDispatcher(endpoint)
+            d = cache[node] = HttpPlanDispatcher(endpoint, **kwargs)
         return d
 
     return for_shard
@@ -158,8 +359,8 @@ class _UnroutableDispatcher(PlanDispatcher):
         self.node = node
 
     def dispatch(self, plan, ctx) -> QueryResult:
-        raise QueryError(
+        raise ShardUnavailable(
             plan.query_context.query_id,
             f"shard {self.shard} is owned by node {self.node!r} but no "
-            f"endpoint is configured for it — refusing to return partial "
-            f"results")
+            f"endpoint is configured for it — refusing to serve it from "
+            f"the local store")

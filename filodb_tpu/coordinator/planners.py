@@ -173,7 +173,13 @@ class PromQlRemoteExec(ExecPlan):
             "step": f"{self.step_ms}ms",
         })
         url = f"{self.endpoint}/promql/{self.dataset}/api/v1/query_range?{qs}"
-        with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+        # the remote hop gets min(configured cap, remaining deadline
+        # budget) — never a fixed timeout (workload/deadline.py)
+        from filodb_tpu.workload import deadline as dl
+        deadline_timeout_s = dl.budget_timeout_s(self.query_context,
+                                                 self.timeout_s)
+        with urllib.request.urlopen(url,
+                                    timeout=deadline_timeout_s) as resp:
             body = json.loads(resp.read())
         if body.get("status") != "success":
             raise RuntimeError(f"remote query failed: {body}")
@@ -264,7 +270,11 @@ class MetadataRemoteExec(ExecPlan):
 
         url = (f"{self.endpoint}/promql/{self.dataset}/api/v1/{path}"
                f"?{urllib.parse.urlencode(qs, doseq=True)}")
-        with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+        from filodb_tpu.workload import deadline as dl
+        deadline_timeout_s = dl.budget_timeout_s(self.query_context,
+                                                 self.timeout_s)
+        with urllib.request.urlopen(url,
+                                    timeout=deadline_timeout_s) as resp:
             body = json.loads(resp.read())
         if body.get("status") != "success":
             raise RuntimeError(f"remote metadata query failed: {body}")

@@ -33,13 +33,19 @@ class ShardingPublisher:
     def __init__(self, schema: Schema, mapper: ShardMapper,
                  publish: Callable[[int, bytes], None], spread: int = 1,
                  options: Optional[DatasetOptions] = None,
-                 container_size: int = 64 * 1024):
+                 container_size: int = 64 * 1024,
+                 quota: Optional[object] = None):
         self.schema = schema
         self.mapper = mapper
         self.publish = publish  # (shard, container) -> ()
         self.spread = spread
         self.options = options or DatasetOptions()
         self.container_size = container_size
+        # cardinality-quota edge shed (workload/quota.py SeriesQuota):
+        # a series-memo MISS for an over-quota tenant drops that series'
+        # samples HERE, before any container build — advisory only, the
+        # shard-side check at part-id assignment stays authoritative
+        self.quota = quota
         self._builders: dict[int, RecordBuilder] = {}
         self._lock = threading.Lock()
         self.samples_in = 0
@@ -182,6 +188,7 @@ class ShardingPublisher:
         pk_g: list = [b""] * ngroups
         good = np.ones(ngroups, bool)
         bad = 0
+        qdrop = 0
         for gi in range(ngroups):
             r0 = int(order[gstarts[gi]])
             key = (uheads[int(inv[r0])], ufn[int(finv[r0])])
@@ -198,6 +205,15 @@ class ShardingPublisher:
                 metric = prom_metric_name(measurement, key[1])
                 norm = dict(tags)
                 norm[self.options.metric_column] = metric
+                if self.quota is not None and self.quota.over_limit(norm):
+                    # memo miss ~= possibly-new series: an over-quota
+                    # tenant's samples shed at the edge, NOT memoized —
+                    # the tenant may free quota and come back under
+                    good[gi] = False
+                    n_rows = int(gends[gi] - gstarts[gi])
+                    qdrop += n_rows
+                    self.quota.note_dropped_samples(norm, n_rows)
+                    continue
                 from filodb_tpu.core.record import (canonical_partkey,
                                                     partition_hash,
                                                     shard_key_hash)
@@ -259,7 +275,11 @@ class ShardingPublisher:
             pls.append({"proto": proto, "rsel": rsel, "segs": segs})
         plan = {"key": (id(inv), id(finv), len(inv)),
                 "refs": (inv, finv), "pls": pls, "bad": bad}
-        self._group_plan = plan
+        if not qdrop:
+            # quota-shed groups must NOT bake into a replayable plan:
+            # the tenant can drop back under quota, and replay would
+            # keep silently excluding (and would mis-count the drop)
+            self._group_plan = plan
         return self._ingest_planned(plan, values, ts_ms)
 
     def _ingest_planned(self, plan, values, ts_ms) -> int:

@@ -50,16 +50,28 @@ def _matrix_entry(tags: dict, ts_ms: np.ndarray, vals: np.ndarray,
 
 def _attach_warnings(resp: dict, result: QueryResult) -> dict:
     """Prometheus-style ``warnings`` for partial results: quarantined
-    (corrupt) chunks were excluded from the scan — the caller gets real
-    data plus a loud flag, never wrong values and never silence.  The
-    HTTP server mirrors this as an X-FiloDB-Partial-Data header."""
+    (corrupt) chunks were excluded from the scan, or a shard's node was
+    unreachable and the query opted into ``allow_partial_results`` —
+    the caller gets real data plus a loud flag, never wrong values and
+    never silence.  The HTTP server mirrors this as an
+    X-FiloDB-Partial-Data header."""
+    warnings = []
     n = result.stats.corrupt_chunks_excluded
     if n:
-        resp["warnings"] = [
+        warnings.append(
             f"partial data: {n} corrupt chunk(s) quarantined and "
-            f"excluded from results (see /admin/integrity)"]
+            f"excluded from results (see /admin/integrity)")
         from filodb_tpu.utils.observability import integrity_metrics
         integrity_metrics()["partial_queries"].inc()
+    down = result.stats.shards_down
+    if down:
+        warnings.append(
+            f"partial data: {down} shard(s) unreachable; their series "
+            f"are missing from results (allow_partial_results)")
+        from filodb_tpu.utils.observability import workload_metrics
+        workload_metrics()["partial_shards"].inc()
+    if warnings:
+        resp["warnings"] = warnings
     return resp
 
 
@@ -138,6 +150,9 @@ def stats_payload(stats, trace_id: str = "") -> dict:
             "bytesScanned": int(stats.bytes_scanned),
             "pagesIn": int(stats.pages_in),
             "corruptChunksExcluded": int(stats.corrupt_chunks_excluded),
+            # shards degraded to empty results under
+            # allow_partial_results (workload subsystem)
+            "shardsDown": int(stats.shards_down),
             # device-grid HBM reads under device_compute, by resident
             # format — shows whether compressed residents serve traffic
             "hbmReadBytes": {k: int(v)

@@ -11,8 +11,10 @@ akka-http; the planner/memstore stand in for the coordinator ask.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import json
+import math
 import threading
 import time
 import urllib.parse
@@ -31,8 +33,11 @@ from filodb_tpu.promql.parser import (ParseError,
                                       query_range_to_logical_plan,
                                       query_to_logical_plan)
 from filodb_tpu.query.exec import ExecContext
-from filodb_tpu.query.model import QueryContext, QueryError
-from filodb_tpu.utils.observability import TRACER, query_metrics
+from filodb_tpu.query.model import (QueryContext, QueryError,
+                                    ShardUnavailable)
+from filodb_tpu.utils.observability import (TRACER, query_metrics,
+                                            workload_metrics)
+from filodb_tpu.workload import deadline as wdl
 
 # remote-storage body limits (unauthenticated endpoints; snappy copy
 # elements amplify ~21x, so both sides are bounded)
@@ -40,6 +45,7 @@ _MAX_REMOTE_COMPRESSED = 16 * 1024 * 1024
 _MAX_REMOTE_UNCOMPRESSED = 128 * 1024 * 1024
 
 _METRICS = query_metrics()
+_WORKLOAD_M = workload_metrics()
 
 
 def _timed(endpoint: str):
@@ -86,6 +92,12 @@ class DatasetBinding:
     # deadlock under load (all workers waiting on leaves queued behind
     # them).  Leaf plans never re-dispatch, so this pool cannot cycle.
     leaf_scheduler: Optional[object] = None
+    # workload management (ISSUE 5, filodb_tpu/workload): cost-based
+    # admission controller in front of the scheduler (None = admit all)
+    # and the dataset's active-series cardinality quota (admin views +
+    # runtime config; enforcement lives on the shards/gateway)
+    admission: Optional[object] = None
+    quota: Optional[object] = None
 
 
 @dataclass
@@ -99,6 +111,9 @@ class FiloHttpServer:
     # dataset -> list of shards this node is actively ingesting; reported
     # in /__health as ground truth for peer status gossip (StatusPoller)
     running_shards: Optional[object] = None
+    # a remote /execplan arriving with less deadline budget than this
+    # cannot plausibly finish — refuse it outright (workload/deadline.py)
+    min_remote_budget_ms: int = wdl.MIN_REMOTE_BUDGET_MS
     datasets: dict = field(default_factory=dict)
     _httpd: Optional[ThreadingHTTPServer] = None
     _thread: Optional[threading.Thread] = None
@@ -163,6 +178,7 @@ class FiloHttpServer:
                                  or bare.endswith("/api/v1/write")):
             self._handle_remote(req, bare)
             return
+        retry_after = None
         try:
             parsed = urllib.parse.urlparse(req.path)
             multi = urllib.parse.parse_qs(parsed.query)
@@ -193,9 +209,24 @@ class FiloHttpServer:
             code, payload = self._route(parsed.path, params, multi)
         except QueryError as e:
             from filodb_tpu.query.scheduler import QueryRejected
-            if isinstance(e, QueryRejected):
-                # admission control: overloaded, not a bad request
+            from filodb_tpu.workload.admission import AdmissionRejected
+            if isinstance(e, AdmissionRejected):
+                # shed by admission control: 429 + a Retry-After hint
+                # derived from the estimated drain time, so well-behaved
+                # clients back off instead of hammering
+                code, payload = 429, error_response("throttled", str(e))
+                retry_after = e.retry_after_s
+            elif isinstance(e, QueryRejected):
+                # queue-level rejection: overloaded, not a bad request
                 code, payload = 503, error_response("unavailable", str(e))
+            elif isinstance(e, ShardUnavailable):
+                # a shard's node is down/unreachable (and the query did
+                # not opt into partial results): service, not client
+                code, payload = 503, error_response("unavailable", str(e))
+            elif isinstance(e, wdl.DeadlineExceeded):
+                # budget ran out mid-execution: an overload/timeout
+                # outcome (503), never a malformed request (400)
+                code, payload = 503, error_response("timeout", str(e))
             else:
                 code, payload = 400, error_response("bad_data", str(e))
         except (ParseError, ValueError, KeyError) as e:
@@ -206,6 +237,9 @@ class FiloHttpServer:
         try:
             req.send_response(code)
             req.send_header("Content-Type", "application/json")
+            if retry_after is not None:
+                req.send_header("Retry-After",
+                                str(int(math.ceil(retry_after))))
             if isinstance(payload, dict) and payload.get("warnings"):
                 # partial-data flag as a header too, so load balancers /
                 # caches can act on it without parsing the body
@@ -239,9 +273,24 @@ class FiloHttpServer:
                   req.headers.get(PARENT_SPAN_HEADER))
             tp = tp if tp[0] else None
             binding = self.datasets.get(payload.get("dataset"))
+            qctx = payload.get("qctx") or {}
+            # deadline propagation (ISSUE 5): the wire carries the
+            # REMAINING budget; work that cannot plausibly finish in
+            # what is left is refused here, before any execution — the
+            # coordinator treats the refusal as a transport failure so
+            # allow_partial_results can degrade it
+            budget_ms = qctx.get("budget_ms")
             if binding is None:
                 code, out = 404, error_response(
                     "bad_data", f"unknown dataset {payload.get('dataset')}")
+            elif budget_ms is not None \
+                    and budget_ms < self.min_remote_budget_ms:
+                _WORKLOAD_M["deadline_refused"].inc()
+                code, out = 503, error_response(
+                    "unavailable",
+                    f"refusing /execplan work with {budget_ms}ms deadline "
+                    f"budget left (node minimum "
+                    f"{self.min_remote_budget_ms}ms)")
             else:
                 from filodb_tpu.coordinator.dispatch import execplan_handler
                 handler = execplan_handler(binding.memstore)
@@ -254,15 +303,23 @@ class FiloHttpServer:
                     # Attach the caller's trace BEFORE submit so the
                     # scheduler's capture() sees it and this node's
                     # queue-wait/run spans join the stitched tree.
-                    qctx = payload.get("qctx", {})
                     wire_tid = qctx.get("trace_id") or None
                     token = (tp[0], tp[1]) if tp else (wire_tid, None)
+                    timeout_ms = qctx.get("timeout_ms") or 30_000
+                    deadline_ms = None
+                    if budget_ms is not None:
+                        # re-anchor the budget on THIS node's clock:
+                        # both the scheduler's dequeue drop and the
+                        # execution tripwire enforce it locally
+                        timeout_ms = min(timeout_ms, budget_ms)
+                        deadline_ms = int(time.time() * 1000) + budget_ms
                     with TRACER.attach(token):
                         out = binding.leaf_scheduler.execute(
                             lambda: handler(payload, tp),
                             submit_time_ms=qctx.get("submit_time_ms")
                             or None,
-                            timeout_ms=qctx.get("timeout_ms") or 30_000)
+                            timeout_ms=timeout_ms,
+                            deadline_ms=deadline_ms)
                 else:
                     out = handler(payload, tp)
                 code = 200
@@ -423,6 +480,9 @@ class FiloHttpServer:
         if len(parts) == 2 and parts[0] == "admin" \
                 and parts[1] == "config":
             return self._config(params)
+        if len(parts) == 2 and parts[0] == "admin" \
+                and parts[1] == "workload":
+            return self._workload()
         if len(parts) == 3 and parts[0] == "admin" and parts[1] == "traces":
             return self._traces(parts[2])
         if len(parts) == 2 and parts[0] == "debug" \
@@ -535,13 +595,53 @@ class FiloHttpServer:
             storm_window_s=p.get("jit-storm-window-s"))
         if "flight-recorder-size" in p:
             devicewatch.FLIGHT.resize(int(p["flight-recorder-size"]))
+        # workload knobs (ISSUE 5): admission budgets + quota limits are
+        # runtime-adjustable across every bound dataset — overload
+        # response must not require a restart
+        if any(k in p for k in ("admission-max-inflight-cost",
+                                "admission-tenant-max-concurrent",
+                                "admission-enabled")):
+            enabled = None
+            if "admission-enabled" in p:
+                enabled = str(p["admission-enabled"]).lower() in ("true",
+                                                                  "1")
+            for b in self.datasets.values():
+                if b.admission is not None:
+                    b.admission.configure(
+                        max_inflight_cost=p.get(
+                            "admission-max-inflight-cost"),
+                        tenant_max_concurrent=p.get(
+                            "admission-tenant-max-concurrent"),
+                        enabled=enabled)
+        if "quota-default-max-series" in p:
+            for b in self.datasets.values():
+                if b.quota is not None:
+                    b.quota.configure(
+                        default_limit=int(p["quota-default-max-series"]))
+        if "min-remote-budget-ms" in p:
+            self.min_remote_budget_ms = int(p["min-remote-budget-ms"])
         stores: dict = {}
         for ds, b in self.datasets.items():
             shards = b.memstore.shards(ds)
             if shards:
                 stores[ds] = _dc.asdict(shards[0].config)
+        workload: dict = {}
+        for ds, b in self.datasets.items():
+            row: dict = {}
+            if b.admission is not None:
+                snap = b.admission.snapshot()
+                row["admission"] = {k: snap[k] for k in (
+                    "enabled", "max_inflight_cost", "priority_shares",
+                    "tenant_max_concurrent", "tenant_max_inflight_cost")}
+            if b.quota is not None:
+                qs = b.quota.snapshot()
+                row["quota"] = {k: qs[k] for k in (
+                    "tenant_label", "default_limit", "overrides")}
+            workload[ds] = row
         return 200, {"status": "success", "data": {
             "datasets": stores,
+            "workload": {"min-remote-budget-ms": self.min_remote_budget_ms,
+                         "datasets": workload},
             "observability": {
                 "slow-query-threshold-s": TRACE_STORE.slow_threshold_s,
                 "jit-storm-shapes":
@@ -551,6 +651,28 @@ class FiloHttpServer:
                 "flight-recorder-size": devicewatch.FLIGHT.capacity,
                 "devicewatch-enabled": devicewatch.enabled(),
             }}}
+
+    @_timed("workload")
+    def _workload(self) -> tuple[int, dict]:
+        """Operational view of the workload-management subsystem
+        (ISSUE 5): per-dataset admission state (inflight cost, tenant
+        budgets, calibration), cardinality-quota occupancy, and the
+        query schedulers' depth (doc/workload.md)."""
+        out: dict = {}
+        for ds, b in self.datasets.items():
+            row: dict = {}
+            if b.admission is not None:
+                row["admission"] = b.admission.snapshot()
+            if b.quota is not None:
+                row["quota"] = b.quota.snapshot()
+            if b.scheduler is not None:
+                row["queue_depth"] = b.scheduler.queue_depth()
+            if b.leaf_scheduler is not None:
+                row["leaf_queue_depth"] = b.leaf_scheduler.queue_depth()
+            out[ds] = row
+        return 200, {"status": "success", "data": {
+            "min_remote_budget_ms": self.min_remote_budget_ms,
+            "datasets": out}}
 
     @_timed("integrity")
     def _integrity(self) -> tuple[int, dict]:
@@ -630,7 +752,7 @@ class FiloHttpServer:
         end = parse_time_ms(p["end"])
         step = parse_duration_ms(p.get("step", "15s"))
         plan = query_range_to_logical_plan(query, start, step, end)
-        result, trace_id = self._exec(b, plan, query=query)
+        result, trace_id = self._exec(b, plan, query=query, params=p)
         t0 = time.perf_counter()
         body = to_prom_matrix(result, b.metric_column)
         return 200, self._finish_query(result, trace_id, body, p,
@@ -644,46 +766,64 @@ class FiloHttpServer:
         time_ms = parse_time_ms(p["time"]) if "time" in p \
             else int(_time.time() * 1000)
         plan = query_to_logical_plan(query, time_ms)
-        result, trace_id = self._exec(b, plan, query=query)
+        result, trace_id = self._exec(b, plan, query=query, params=p)
         t0 = time.perf_counter()
         body = to_prom_vector(result, time_ms, b.metric_column)
         return 200, self._finish_query(result, trace_id, body, p,
                                        time.perf_counter() - t0)
 
-    def _exec(self, b: DatasetBinding, plan, query: str = ""):
-        """Plan + execute with a fresh per-query trace: mints the
-        trace_id every downstream span (and remote dispatch) joins,
+    @staticmethod
+    def _query_context(p: dict) -> QueryContext:
+        """Per-query context from request params: timeout (caps the
+        end-to-end deadline budget), tenant/priority admission identity,
+        and the partial-results opt-in.  The absolute deadline is minted
+        HERE — every downstream wait, dispatch, and remote hop only ever
+        decrements it (workload/deadline.py)."""
+        import time as _time
+        timeout_ms = parse_duration_ms(p["timeout"]) if "timeout" in p \
+            else 30_000
+        qctx = QueryContext(
+            submit_time_ms=int(_time.time() * 1000),
+            trace_id=TRACER.new_trace_id(),
+            timeout_ms=timeout_ms,
+            tenant=str(p.get("tenant", "")),
+            priority=str(p.get("priority", "default")),
+            allow_partial_results=str(
+                p.get("allow_partial_results", "")).lower()
+            in ("true", "1"))
+        return wdl.mint(qctx)
+
+    def _admit(self, b: DatasetBinding, ep, qctx: QueryContext):
+        """The admission front door: every query handler reaches
+        execution through ``_exec`` -> ``_admit`` (lint-enforced by
+        tests/test_sentinel_lint.py::test_query_handlers_route_through_
+        admission).  Estimates the plan's cost from the part-key index
+        and asks the controller for a permit; sheds with
+        AdmissionRejected (HTTP 429 + Retry-After) instead of queueing
+        work that would rot."""
+        if b.admission is None or not b.admission.enabled:
+            # the runtime kill switch (admission-enabled=false) must
+            # remove the COST MODEL from the hot path too — disabling
+            # admission during an incident is exactly when a
+            # misbehaving estimator must stop being consulted
+            return contextlib.nullcontext()
+        cost = b.admission.cost_model.estimate(ep, b.memstore)
+        return b.admission.admit(qctx, cost)
+
+    def _exec(self, b: DatasetBinding, plan, query: str = "",
+              params: Optional[dict] = None):
+        """Plan + admit + execute with a fresh per-query trace: mints
+        the trace_id every downstream span (and remote dispatch) joins,
         splits plan/queue wall-time into the stats buckets, and feeds
-        the slow-query log on completion.  Returns (result, trace_id)."""
+        the slow-query log on completion.  Returns (result, trace_id).
+
+        Planning happens on the ENTRY thread so the admission
+        controller can price the materialized plan before any queueing;
+        only execution rides the scheduler pool."""
         import time as _time
         from filodb_tpu.utils.forensics import TRACE_STORE
-        qctx = QueryContext(submit_time_ms=int(_time.time() * 1000),
-                            trace_id=TRACER.new_trace_id())
+        qctx = self._query_context(params or {})
         t0 = _time.perf_counter()
-
-        def run():
-            t_run = _time.perf_counter()
-            # parent onto wherever this runs: the scheduler worker's
-            # span when queued, the root "query" span when inline
-            tok = TRACER.capture()
-            if tok[0] is None:
-                tok = (qctx.trace_id, None)
-            with TRACER.attach(tok):
-                with TRACER.span("query.execute", dataset=b.dataset,
-                                 query=query) as sp:
-                    t_plan = _time.perf_counter()
-                    with TRACER.span("query.plan"):
-                        ep = b.planner.materialize(plan, qctx)
-                    plan_s = _time.perf_counter() - t_plan
-                    res = ep.execute(ExecContext(b.memstore, qctx))
-                    if res.stats.hbm_resident_delta_bytes:
-                        # devicewatch: residency this query committed /
-                        # released, visible on the stitched trace too
-                        sp.tag(hbm_delta_bytes=res.stats
-                               .hbm_resident_delta_bytes)
-            res.stats.add_timing("plan", plan_s)
-            res.stats.add_timing("queue", t_run - t0)
-            return res
 
         from filodb_tpu.utils.devicewatch import FLIGHT
         FLIGHT.record("query.start", trace_id=qctx.trace_id,
@@ -694,11 +834,47 @@ class FiloHttpServer:
             # parent under it, so /admin/traces shows a single tree
             with TRACER.attach((qctx.trace_id, None)), \
                     TRACER.span("query", dataset=b.dataset, query=query):
-                if b.scheduler is not None:
-                    result = b.scheduler.execute(run, qctx.submit_time_ms,
-                                                 qctx.timeout_ms)
-                else:
-                    result = run()
+                t_plan = _time.perf_counter()
+                with TRACER.span("query.plan"):
+                    ep = b.planner.materialize(plan, qctx)
+                plan_s = _time.perf_counter() - t_plan
+                if not qctx.tenant:
+                    from filodb_tpu.workload.admission import plan_tenant
+                    qctx.tenant = plan_tenant(ep)
+
+                def run():
+                    t_run = _time.perf_counter()
+                    # parent onto wherever this runs: the scheduler
+                    # worker's span when queued, the root span inline
+                    tok = TRACER.capture()
+                    if tok[0] is None:
+                        tok = (qctx.trace_id, None)
+                    with TRACER.attach(tok):
+                        with TRACER.span("query.execute",
+                                         dataset=b.dataset,
+                                         query=query) as sp:
+                            res = ep.execute(ExecContext(b.memstore, qctx))
+                            if res.stats.hbm_resident_delta_bytes:
+                                # devicewatch: residency this query
+                                # committed/released, on the trace too
+                                sp.tag(hbm_delta_bytes=res.stats
+                                       .hbm_resident_delta_bytes)
+                    res.stats.add_timing("plan", plan_s)
+                    # queue = scheduler wait ONLY (t_submit is stamped
+                    # right before submission below): planning and
+                    # admission run on the entry thread and must not
+                    # inflate this bucket, or sum(buckets) > total
+                    res.stats.add_timing("queue", t_run - t_submit)
+                    return res
+
+                with self._admit(b, ep, qctx):
+                    t_submit = _time.perf_counter()
+                    if b.scheduler is not None:
+                        result = b.scheduler.execute(
+                            run, qctx.submit_time_ms, qctx.timeout_ms,
+                            deadline_ms=qctx.deadline_ms)
+                    else:
+                        result = run()
         except BaseException as e:
             FLIGHT.record("query.end", trace_id=qctx.trace_id,
                           dataset=b.dataset, error=repr(e)[:200],

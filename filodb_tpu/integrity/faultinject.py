@@ -1,4 +1,4 @@
-"""Deterministic fault injection for integrity testing.
+"""Deterministic fault injection for integrity + workload testing.
 
 The reference proves its corruption handling with unit-level byte
 surgery; this harness does it end-to-end and deterministically from a
@@ -7,11 +7,22 @@ their frames, corrupt their stored checksums, or flip bytes in a live
 partition's frozen (HBM-staging) chunk vectors.  Used by
 tests/test_integrity.py; also handy from a REPL against a throwaway
 store copy.  NEVER point it at data you care about.
+
+ISSUE 5 adds :class:`FlakyTcpProxy` — a deterministic CONNECTION-fault
+injector for the dispatch retry/hedge path: a TCP proxy in front of a
+real data node whose per-connection behavior follows an explicit plan
+(refuse / stall / pass), so tests/test_workload.py can prove bounded
+retry-with-backoff and p99-triggered hedging without flaky sleeps.
 """
 
 from __future__ import annotations
 
+import collections
 import random
+import socket
+import socketserver
+import threading
+import time
 from typing import Optional
 
 from filodb_tpu.integrity import chunk_crc
@@ -130,3 +141,124 @@ class FaultInjector:
         # corruption is actually exercised on the next read
         partition._decoded.pop(cs.info.chunk_id, None)
         return int(cs.info.chunk_id)
+
+
+# ---------------------------------------------------------------------------
+# Connection faults (ISSUE 5: dispatch retry / hedge testing)
+# ---------------------------------------------------------------------------
+
+
+class FlakyTcpProxy:
+    """TCP proxy with a deterministic per-connection fault plan.
+
+    Sits between an HttpPlanDispatcher and a real data node.  Each
+    accepted connection pops the next mode from the plan (default
+    ``pass``):
+
+    - ``refuse``: close immediately — the client sees a reset /
+      RemoteDisconnected, the retryable connection-error class;
+    - ``stall``: sleep ``stall_s`` BEFORE forwarding — a tail-slow
+      backend, the hedge trigger;
+    - ``pass``: forward transparently.
+
+    A seeded ``failure_rate`` can inject random refusals
+    reproducibly; explicit plans (``fail_next``/``stall_next``) make
+    assertions exact."""
+
+    def __init__(self, backend_port: int, backend_host: str = "127.0.0.1",
+                 stall_s: float = 0.5, failure_rate: float = 0.0,
+                 seed: int = 0):
+        self.backend = (backend_host, backend_port)
+        self.stall_s = stall_s
+        self.failure_rate = failure_rate
+        self.rng = random.Random(seed)
+        self.port = 0
+        self.connections = 0
+        self.refused = 0
+        self.stalled = 0
+        self._plan: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+
+    def fail_next(self, n: int = 1) -> None:
+        with self._lock:
+            self._plan.extend(["refuse"] * n)
+
+    def stall_next(self, n: int = 1) -> None:
+        with self._lock:
+            self._plan.extend(["stall"] * n)
+
+    def _next_mode(self) -> str:
+        with self._lock:
+            self.connections += 1
+            if self._plan:
+                return self._plan.popleft()
+            if self.failure_rate and self.rng.random() < self.failure_rate:
+                return "refuse"
+            return "pass"
+
+    def start(self) -> int:
+        proxy = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                mode = proxy._next_mode()
+                if mode == "refuse":
+                    with proxy._lock:
+                        proxy.refused += 1
+                    try:  # RST, not FIN: an unambiguous connection error
+                        self.request.setsockopt(
+                            socket.SOL_SOCKET, socket.SO_LINGER,
+                            b"\x01\x00\x00\x00\x00\x00\x00\x00")
+                    except OSError:
+                        pass
+                    return
+                if mode == "stall":
+                    with proxy._lock:
+                        proxy.stalled += 1
+                    time.sleep(proxy.stall_s)
+                try:
+                    upstream = socket.create_connection(proxy.backend,
+                                                        timeout=10)
+                except OSError:
+                    return
+                try:
+                    t = threading.Thread(
+                        target=proxy._pump,
+                        args=(self.request, upstream), daemon=True)
+                    t.start()
+                    proxy._pump(upstream, self.request)
+                    t.join(timeout=10)
+                finally:
+                    upstream.close()
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever,
+                         name="flaky-proxy", daemon=True).start()
+        return self.port
+
+    @staticmethod
+    def _pump(src: socket.socket, dst: socket.socket) -> None:
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            try:
+                dst.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()

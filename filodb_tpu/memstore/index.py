@@ -613,6 +613,24 @@ class PartKeyIndex:
             names.update(self._tags[int(pid)].keys())
         return sorted(names)
 
+    def active_series_count(self) -> int:
+        """Series currently alive in this index (the cardinality the
+        quota subsystem caps; reference: CardinalityManager reading
+        counts off the part-key index)."""
+        return len(self._tags)
+
+    def value_counts(self, label: str) -> dict[str, int]:
+        """Alive-series count per value of one label, O(values): the
+        per-value refcounts ARE the active cardinality breakdown — the
+        workload quota's ground truth (workload/quota.py
+        refresh_from_index), no document walk."""
+        with self._lock:
+            self._drain_pending_locked()
+            lab = self._labels.get(label)
+            if lab is None:
+                return {}
+            return {v: n for v, n in lab.vcount.items() if n > 0}
+
     def label_values(self, label: str, filters: Sequence[ColumnFilter] = (),
                      start_time: int = 0, end_time: int = _NO_END,
                      limit: Optional[int] = None) -> list[str]:

@@ -38,6 +38,7 @@ from filodb_tpu.memstore.partition import TimeSeriesPartition
 from filodb_tpu.store.columnstore import ColumnStore, NullColumnStore, PartKeyRecord
 from filodb_tpu.store.metastore import InMemoryMetaStore, MetaStore
 from filodb_tpu.utils.bloom import BloomFilter
+from filodb_tpu.workload.quota import SeriesQuotaExceeded
 
 _FLUSH_METRICS = None
 
@@ -92,6 +93,11 @@ class ShardStats:
     # those chunks entered quarantine here
     chunks_corrupt: int = 0
     chunks_quarantined: int = 0
+    # workload subsystem (filodb_tpu/workload): new series rejected
+    # because their tenant hit its active-series quota, and the rows
+    # those rejections dropped
+    series_quota_rejected: int = 0
+    rows_quota_dropped: int = 0
 
 
 class TimeSeriesShard:
@@ -161,6 +167,11 @@ class TimeSeriesShard:
         self.downsample_publisher = None
         self.downsample_resolutions: tuple[int, ...] = ()
         self._downsamplers: dict[int, object] = {}
+        # active-series cardinality quota (workload/quota.py): consulted
+        # right before a NEW part id is assigned; an over-quota tenant's
+        # new series is rejected (rows dropped + counted) while existing
+        # series keep ingesting (reference: CardinalityManager/QuotaSource)
+        self.series_quota = None
 
     def enable_downsampling(self, publisher, resolutions_ms) -> None:
         self.downsample_publisher = publisher
@@ -223,9 +234,17 @@ class TimeSeriesShard:
             if s0 == s1:
                 continue  # every record of this series was watermark-skipped
             first = int(dec.uniq_first[u])
-            part = self._get_or_add_partition_pk(
-                dec.partkeys[u], schema, int(dec.part_hashes[first]),
-                int(ts_s[s0]))
+            try:
+                part = self._get_or_add_partition_pk(
+                    dec.partkeys[u], schema, int(dec.part_hashes[first]),
+                    int(ts_s[s0]))
+            except SeriesQuotaExceeded:
+                # over-quota NEW series: its rows drop, the rest of the
+                # container keeps ingesting (existing series unaffected)
+                self.stats.rows_quota_dropped += s1 - s0
+                self.series_quota.note_dropped_samples(
+                    parse_partkey(dec.partkeys[u]), s1 - s0)
+                continue
             added, dropped = self._ingest_series_block(
                 part, ts_s[s0:s1], [c[s0:s1] for c in cols_s])
             added_total += added
@@ -298,7 +317,12 @@ class TimeSeriesShard:
             if offset <= self.group_watermarks[group]:
                 self.stats.rows_skipped += 1
                 continue
-            part = self._get_or_add_partition(rec)
+            try:
+                part = self._get_or_add_partition(rec)
+            except SeriesQuotaExceeded:
+                self.stats.rows_quota_dropped += 1
+                self.series_quota.note_dropped_samples(rec.tags)
+                continue
             if part.ingest(rec.timestamp, rec.values):
                 n += 1
                 self.stats.rows_ingested += 1
@@ -347,6 +371,14 @@ class TimeSeriesShard:
         # start time from the column store lifecycle (reference :1103-1122)
         if tags is None:
             tags = parse_partkey(pk)
+        if self.series_quota is not None \
+                and not self.series_quota.allow_new_series(
+                    tags, shard=self.shard_num):
+            self.stats.series_quota_rejected += 1
+            tenant = self.series_quota.tenant_of(tags)
+            raise SeriesQuotaExceeded(
+                tenant, self.series_quota.active(tenant),
+                self.series_quota.limit_for(tenant) or 0)
         start_time = timestamp
         pid = self._next_part_id
         self._next_part_id += 1
@@ -546,6 +578,8 @@ class TimeSeriesShard:
             self.part_set.pop(part.partkey, None)
             self.evicted_keys.add(part.partkey)
             self.index.remove([pid])
+            if self.series_quota is not None:
+                self.series_quota.note_removed(part.tags)
             self.stats.partitions_evicted += 1
         return len(victims)
 
@@ -559,6 +593,8 @@ class TimeSeriesShard:
             self.bump_removal_epoch()
             self.part_set.pop(part.partkey, None)
             self.index.remove([pid])
+            if self.series_quota is not None:
+                self.series_quota.note_removed(part.tags)
             self.stats.partitions_purged += 1
         return len(doomed)
 

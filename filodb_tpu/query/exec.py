@@ -27,7 +27,8 @@ from filodb_tpu.query.logical import (AggregationOperator, BinaryOperator,
                                       Cardinality, ScalarFunctionId)
 from filodb_tpu.query.model import (PeriodicBatch, QueryContext, QueryError,
                                     QueryResult, QueryStats, RawBatch,
-                                    ScalarResult, concat_periodic)
+                                    ScalarResult, ShardUnavailable,
+                                    concat_periodic)
 from filodb_tpu.query.transformers import RangeVectorTransformer, _drop_metric
 from filodb_tpu.utils.observability import TRACER
 
@@ -63,12 +64,21 @@ class ExecContext:
     _timings: dict = dataclasses.field(default_factory=dict, repr=False)
     _counters: dict = dataclasses.field(default_factory=dict, repr=False)
 
+    # shards degraded to empty results because their dispatch failed and
+    # the query allows partial results (ISSUE 5); folds into
+    # QueryStats.shards_down for the partial-data warning + header
+    _shards_down: int = 0
+
     def note_corrupt_excluded(self, n: int) -> None:
         with self._corrupt_lock:
             self._corrupt_excluded += n
 
     def corrupt_excluded(self) -> int:
         return self._corrupt_excluded
+
+    def note_shard_down(self, n: int = 1) -> None:
+        with self._corrupt_lock:
+            self._shards_down += n
 
     def note_timing(self, stage: str, seconds: float) -> None:
         with self._corrupt_lock:
@@ -110,6 +120,8 @@ class ExecContext:
                          hbm_delta=stats.hbm_resident_delta_bytes)
         if stats.corrupt_chunks_excluded:
             self.note_corrupt_excluded(stats.corrupt_chunks_excluded)
+        if stats.shards_down:
+            self.note_shard_down(stats.shards_down)
         for k, v in stats.timings.items():
             self.note_timing(k, v)
 
@@ -128,6 +140,7 @@ class ExecContext:
                                        ("compressed", "hbm_compressed"))
                 if c.get(ck)}
             stats.hbm_resident_delta_bytes = c.get("hbm_delta", 0)
+            stats.shards_down = self._shards_down
 
 
 class PlanDispatcher:
@@ -173,6 +186,16 @@ class ExecPlan:
         # type and, for data leaves, dataset/shard.  Span machinery
         # never raises into the query path — reporter failures are
         # swallowed by the tracer
+        # deadline tripwire (ISSUE 5): one clock read per plan node so a
+        # deep scatter-gather stops burning workers the moment its
+        # end-to-end budget is gone (reference: queryTimeoutMillis
+        # checked inside ExecPlan execution).  DeadlineExceeded is a
+        # QueryError subclass the HTTP layer maps to 503, not 400 — a
+        # timed-out query is an overload outcome, not a client bug.
+        qctx = self.query_context
+        if qctx.deadline_ms:
+            from filodb_tpu.workload import deadline as dl
+            dl.check(qctx, where=type(self).__name__)
         tags = {"plan": type(self).__name__}
         ds = getattr(self, "dataset", None)
         if ds is not None:
@@ -257,15 +280,37 @@ class NonLeafExecPlan(ExecPlan):
         """Children run via their own dispatchers, concurrently (reference:
         NonLeafExecPlan.doExecute mapAsync, ExecPlan.scala:370-409).
         The trace context is captured here and re-attached on the pool
-        threads so child spans parent onto this plan's span."""
+        threads so child spans parent onto this plan's span.
+
+        A child whose dispatch fails at the TRANSPORT level
+        (ShardUnavailable: shard's node down / unroutable) degrades to
+        an empty result when the query set ``allow_partial_results`` —
+        the root result then carries ``stats.shards_down`` and the API
+        layer emits a Prometheus warning + the X-FiloDB-Partial-Data
+        header (ISSUE 5; reference: PartialResults semantics)."""
         kids = self._children
+
+        def one(c):
+            try:
+                return c.dispatcher.dispatch(c, ctx)
+            except ShardUnavailable as e:
+                if not ctx.query_context.allow_partial_results:
+                    raise
+                ctx.note_shard_down()
+                TRACER.record("dispatch.shard_down", 0.0,
+                              trace_id=ctx.query_context.trace_id or None,
+                              shard=str(getattr(c, "shard", "")),
+                              error=str(e)[:200])
+                return QueryResult(c.query_context.query_id, [],
+                                   QueryStats())
+
         if len(kids) <= 1 or not self.parallel_children:
-            return [c.dispatcher.dispatch(c, ctx) for c in kids]
+            return [one(c) for c in kids]
         token = TRACER.capture()
 
         def run(c):
             with TRACER.attach(token):
-                return c.dispatcher.dispatch(c, ctx)
+                return one(c)
 
         with concurrent.futures.ThreadPoolExecutor(
                 max_workers=min(len(kids), ctx.parallelism)) as pool:

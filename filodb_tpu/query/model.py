@@ -34,6 +34,18 @@ class QueryContext:
     # propagated across remote dispatch (header + execplan-wire field)
     # so scatter-gather fan-out stitches into one span tree
     trace_id: str = ""
+    # workload management (ISSUE 5, filodb_tpu/workload):
+    # - deadline_ms: ABSOLUTE epoch-ms deadline minted at the HTTP entry
+    #   (submit_time + timeout); 0 = no deadline.  Travels the wire as a
+    #   RELATIVE budget (wall clocks differ between nodes) and caps
+    #   every downstream wait/dispatch timeout
+    # - tenant/priority: admission-control identity + class
+    # - allow_partial_results: a down shard degrades to a warned partial
+    #   result instead of failing the whole scatter-gather
+    deadline_ms: int = 0
+    tenant: str = ""
+    priority: str = "default"
+    allow_partial_results: bool = False
 
 
 @dataclasses.dataclass
@@ -64,6 +76,10 @@ class QueryStats:
     # (ISSUE 4: blocks committed minus blocks evicted/freed while the
     # query's ExecContext was active); 0 for a fully warm query
     hbm_resident_delta_bytes: int = 0
+    # shards whose dispatch failed but were degraded to an empty result
+    # because the query set allow_partial_results (ISSUE 5): the result
+    # is PARTIAL and the API layers surface a warning + header
+    shards_down: int = 0
 
     def merge(self, other: "QueryStats") -> None:
         self.samples_scanned += other.samples_scanned
@@ -79,6 +95,7 @@ class QueryStats:
         for k, v in other.hbm_read_bytes.items():
             self.hbm_read_bytes[k] = self.hbm_read_bytes.get(k, 0) + v
         self.hbm_resident_delta_bytes += other.hbm_resident_delta_bytes
+        self.shards_down += other.shards_down
 
     def add_timing(self, stage: str, seconds: float) -> None:
         self.timings[stage] = self.timings.get(stage, 0.0) + seconds
@@ -90,6 +107,14 @@ class QueryError(Exception):
     def __init__(self, query_id: str, message: str):
         super().__init__(message)
         self.query_id = query_id
+
+
+class ShardUnavailable(QueryError):
+    """A shard's dispatch failed at the TRANSPORT level (connection
+    refused/reset/timed out after retries, or no endpoint configured) —
+    distinct from a semantic QueryError so scatter-gather can degrade
+    to a warned partial result when ``allow_partial_results`` is set
+    (ISSUE 5; reference: PartialResults support in QueryResult)."""
 
 
 @dataclasses.dataclass

@@ -65,22 +65,33 @@ class QueryScheduler:
         self._m_timed_out = reg.counter("filodb_queries_queue_timeout_total")
         self._m_wait = reg.histogram("filodb_query_queue_wait_seconds")
         self._m_run = reg.histogram("filodb_query_run_seconds")
+        # deadline-expired-in-queue drops (ISSUE 5 satellite): dead work
+        # is discarded at dequeue, never executed
+        self._m_expired = reg.counter("filodb_query_sched_expired_total")
         self._m_depth.set_fn(self.queue_depth, scheduler=name)
 
     # ------------------------------------------------------------- submit
 
     def submit(self, fn: Callable, submit_time_ms: Optional[int] = None,
-               timeout_ms: int = 30_000) -> Future:
+               timeout_ms: int = 30_000,
+               deadline_ms: Optional[int] = None) -> Future:
         """Enqueue a query; earliest ``submit_time_ms`` runs first
         (reference: priority mailbox by submitTime).  Raises
-        :class:`QueryRejected` when the queue is full."""
+        :class:`QueryRejected` when the queue is full.
+
+        ``deadline_ms`` is the query's ABSOLUTE wall-clock deadline
+        (epoch ms, workload/deadline.py): a query that sat in the queue
+        past it is dropped at dequeue instead of executed.  It is NOT
+        derived from ``submit_time_ms`` — callers use submit time as a
+        pure priority key (cross-node it is the ORIGIN's clock), so only
+        an explicit deadline is trusted against this node's clock."""
         st = submit_time_ms if submit_time_ms else int(time.time() * 1000)
         fut: Future = Future()
         # trace context captured HERE travels to the worker thread so
         # the queue-wait/run-time split stitches into the query's tree
         token = TRACER.capture()
         entry = (st, next(self._counter), time.monotonic(), timeout_ms,
-                 token, fn, fut)
+                 deadline_ms, token, fn, fut)
         with self._lock:
             if self._shutdown:
                 self._m_rejected.inc(scheduler=self.name, reason="shutdown")
@@ -94,10 +105,11 @@ class QueryScheduler:
         return fut
 
     def execute(self, fn: Callable, submit_time_ms: Optional[int] = None,
-                timeout_ms: int = 30_000):
+                timeout_ms: int = 30_000,
+                deadline_ms: Optional[int] = None):
         """Submit and wait — the synchronous API the HTTP layer uses.
         The timeout covers queue wait + execution."""
-        fut = self.submit(fn, submit_time_ms, timeout_ms)
+        fut = self.submit(fn, submit_time_ms, timeout_ms, deadline_ms)
         try:
             return fut.result(timeout=timeout_ms / 1000.0)
         except _FutureTimeout:
@@ -120,8 +132,8 @@ class QueryScheduler:
                     self._work.wait()
                 if self._shutdown and not self._heap:
                     return
-                _, _, enq_mono, timeout_ms, token, fn, fut = heapq.heappop(
-                    self._heap)
+                (_, _, enq_mono, timeout_ms, deadline_ms, token, fn,
+                 fut) = heapq.heappop(self._heap)
             waited = time.monotonic() - enq_mono
             self._m_wait.observe(waited)
             if token[0] is not None:
@@ -130,6 +142,21 @@ class QueryScheduler:
                 TRACER.record("scheduler.queue_wait", waited,
                               trace_id=token[0], parent_id=token[1],
                               scheduler=self.name)
+            if deadline_ms and time.time() * 1000.0 > deadline_ms:
+                # ISSUE 5 satellite: the submit-time deadline expired
+                # while queued — the caller (local client or upstream
+                # coordinator hop) stopped waiting; executing would be
+                # pure dead work.  Dropped with QueryRejected, counted.
+                self._m_expired.inc(scheduler=self.name)
+                if not fut.cancelled():
+                    try:
+                        fut.set_exception(QueryRejected(
+                            "", f"query deadline expired after "
+                                f"{int(waited * 1000)}ms in queue; "
+                                f"dropped without executing"))
+                    except Exception:  # lost the race to a cancel
+                        pass
+                continue
             if waited * 1000.0 > timeout_ms:
                 # dead work: the client already timed out (reference
                 # QueryActor discards overdue queries).  The future may

@@ -152,14 +152,32 @@ def _dec_transformer(d: dict):
 
 def _enc_qctx(qctx: QueryContext) -> dict:
     """Full QueryContext travels: limits set by the caller must be
-    enforced on the data node where the work actually runs."""
-    return {f.name: getattr(qctx, f.name)
-            for f in dataclasses.fields(QueryContext)}
+    enforced on the data node where the work actually runs.
+
+    The deadline crosses as a RELATIVE ``budget_ms`` (remaining at
+    serialization time), never the absolute ``deadline_ms`` — wall
+    clocks differ between nodes, and re-anchoring the remaining budget
+    against the receiver's clock is what makes the budget measurably
+    SHRINK at every hop (ISSUE 5 deadline propagation)."""
+    d = {f.name: getattr(qctx, f.name)
+         for f in dataclasses.fields(QueryContext)}
+    if qctx.deadline_ms:
+        import time as _time
+        d["budget_ms"] = max(
+            qctx.deadline_ms - int(_time.time() * 1000), 0)
+    d.pop("deadline_ms", None)
+    return d
 
 
 def _dec_qctx(d: dict) -> QueryContext:
     known = {f.name for f in dataclasses.fields(QueryContext)}
-    return QueryContext(**{k: v for k, v in d.items() if k in known})
+    qctx = QueryContext(**{k: v for k, v in d.items()
+                           if k in known and k != "deadline_ms"})
+    budget = d.get("budget_ms")
+    if budget is not None:
+        import time as _time
+        qctx.deadline_ms = int(_time.time() * 1000) + int(budget)
+    return qctx
 
 
 def serialize_plan(plan) -> dict:

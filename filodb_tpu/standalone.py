@@ -15,13 +15,28 @@ driven by a JSON config instead of HOCON:
                                           # embedded message broker (omit
                                           # to use an external one / none)
       "profiler": false,
+      "workload": {"min-remote-budget-ms": 5},
+                                          # node-wide workload knobs
       "datasets": [{
         "name": "prom", "num-shards": 4, "min-num-nodes": 1,
         "schema": "gauge", "spread": 1,
         "source": {"factory": "kafka", "host": "127.0.0.1",
                    "port": 9092, "topic": "prom"},
                                           # omit for the in-proc queue
-        "store": {"flush-interval": "1h", "groups-per-shard": 8}
+        "store": {"flush-interval": "1h", "groups-per-shard": 8},
+        "workload": {                     # ISSUE 5 (doc/workload.md);
+                                          # every knob has a default —
+                                          # the block is optional
+          "admission": {"max-inflight-cost": 10000,
+                        "tenant-max-concurrent": 32,
+                        "priority-shares": {"low": 0.5, "default": 0.8,
+                                            "high": 1.0}},
+          "quota": {"tenant-label": "_ns_",
+                    "default-max-series": 1000000,
+                    "overrides": {"App-9": 1000}},
+          "dispatch": {"timeout-cap-s": 60, "retries": 2,
+                       "hedge": false}
+        }
       }]
     }
 """
@@ -76,6 +91,7 @@ class FiloServer:
         self.gateways: list[GatewayServer] = []
         self.broker = None  # embedded BrokerServer when configured
         self.query_schedulers: dict[str, object] = {}
+        self.admission_controllers: dict[str, object] = {}
         self.status_poller: Optional[StatusPoller] = None
         self.profiler: Optional[SimpleProfiler] = None
         self._global_gateway_claimed = False
@@ -120,6 +136,13 @@ class FiloServer:
         from filodb_tpu.utils import devicewatch
         devicewatch.configure(self.config.get("devicewatch"))
         devicewatch.install_crash_hooks()
+        # node-wide workload knob: the /execplan refusal floor guards
+        # ONE HTTP server, so it lives at the config top level (a
+        # per-dataset spelling would silently be last-bound-wins)
+        wl_top = self.config.get("workload", {})
+        if "min-remote-budget-ms" in wl_top:
+            self.http.min_remote_budget_ms = int(
+                wl_top["min-remote-budget-ms"])
 
         for ds_conf in self.config.get("datasets", []):
             self._setup_dataset(ds_conf)
@@ -188,13 +211,18 @@ class FiloServer:
         ic.resync(shards)
 
         mapper = self.manager.mapper(name)
+        # workload management (ISSUE 5): admission + quota + dispatch
+        # tuning from the per-dataset "workload" block
+        wl_conf = dict(ds_conf.get("workload", {}))
         # peers: node -> http endpoint; shards owned by peers dispatch
         # remotely (reference: ActorPlanDispatcher per shard owner)
         peers = self.config.get("peers", {})
         disp = None
         if peers:
             from filodb_tpu.coordinator.dispatch import dispatcher_factory
-            disp = dispatcher_factory(mapper, peers, local_node=self.node)
+            disp = dispatcher_factory(mapper, peers, local_node=self.node,
+                                      dispatch_config=wl_conf.get(
+                                          "dispatch"))
         # ICI-collective serving: fuse local multi-shard aggregates into
         # one SPMD mesh program.  Auto-on when >1 device is visible
         # (multi-chip); override per dataset with "mesh": true/false.
@@ -250,10 +278,49 @@ class FiloServer:
             name=f"leaf-{name}")
         self.query_schedulers[name] = qsched
         self.query_schedulers[f"{name}/leaf"] = leaf_sched
+        # cost-based admission in front of the scheduler (ISSUE 5):
+        # present by default — a node with no overload defense is the
+        # failure mode this subsystem exists to close; "admission":
+        # {"enabled": false} opts out
+        adm_conf = dict(wl_conf.get("admission", {}))
+        admission = None
+        if adm_conf.get("enabled", True):
+            from filodb_tpu.workload.admission import AdmissionController
+            from filodb_tpu.workload.cost import CostModel
+            admission = AdmissionController(
+                CostModel(),
+                dataset=name,
+                max_inflight_cost=float(
+                    adm_conf.get("max-inflight-cost", 10_000.0)),
+                priority_shares=adm_conf.get("priority-shares"),
+                tenant_max_concurrent=int(
+                    adm_conf.get("tenant-max-concurrent", 32)),
+                tenant_max_inflight_cost=adm_conf.get(
+                    "tenant-max-cost"),
+                workers=int(qconf.get("workers", 4)))
+            self.admission_controllers[name] = admission
+        # active-series cardinality quota, shared by every local shard
+        # of this dataset and the gateway edge (workload/quota.py)
+        quota = None
+        q_conf = wl_conf.get("quota")
+        if q_conf:
+            from filodb_tpu.workload.quota import SeriesQuota
+            quota = SeriesQuota(
+                dataset=name,
+                tenant_label=q_conf.get("tenant-label", "_ns_"),
+                default_limit=q_conf.get("default-max-series"),
+                overrides=q_conf.get("overrides"))
+            for sh in self.memstore.shards(name):
+                sh.series_quota = quota
+            quota.refresh_from_index(
+                *(sh.index for sh in self.memstore.shards(name)))
+            wpub.quota = quota
         self.http.bind_dataset(DatasetBinding(name, self.memstore, planner,
                                               write_router=write_router,
                                               scheduler=qsched,
-                                              leaf_scheduler=leaf_sched))
+                                              leaf_scheduler=leaf_sched,
+                                              admission=admission,
+                                              quota=quota))
 
         gw_port = ds_conf.get("gateway-port")
         if gw_port is None and not self._global_gateway_claimed:
@@ -263,7 +330,8 @@ class FiloServer:
             if gw_port is not None:
                 self._global_gateway_claimed = True
         if gw_port is not None:
-            pub = ShardingPublisher(schema, mapper, publish, spread=spread)
+            pub = ShardingPublisher(schema, mapper, publish, spread=spread,
+                                    quota=quota)
             gw = GatewayServer(pub, port=int(gw_port))
             gw.start()
             self.gateways.append(gw)
@@ -284,6 +352,8 @@ class FiloServer:
         self.http.shutdown()
         for qs in self.query_schedulers.values():
             qs.shutdown()
+        for ac in self.admission_controllers.values():
+            ac.shutdown()
         if self.broker is not None:
             self.broker.shutdown()
         if self.profiler is not None:

@@ -307,6 +307,65 @@ def flush_metrics() -> dict:
     }
 
 
+def workload_metrics() -> dict:
+    """Canonical workload-management metrics (ISSUE 5): admission,
+    cardinality quotas, deadline enforcement, and dispatch retry/hedge —
+    one place defines the names so the controller, the shards, the
+    gateway edge, and doc/workload.md can never drift."""
+    return {
+        "admitted": REGISTRY.counter(
+            "filodb_admission_admitted_total",
+            "queries admitted, by dataset and priority class"),
+        "rejected": REGISTRY.counter(
+            "filodb_admission_rejected_total",
+            "queries shed with 429, by dataset/priority/reason "
+            "(expired|deadline|overload|tenant_concurrency|tenant_cost)"),
+        "inflight_cost": REGISTRY.gauge(
+            "filodb_admission_inflight_cost",
+            "estimated cost units currently admitted and running"),
+        "estimated_cost": REGISTRY.histogram(
+            "filodb_admission_estimated_cost_units",
+            "pre-execution cost estimate per query (series-chunk units)",
+            buckets=(1, 10, 100, 1_000, 10_000, 100_000, 1_000_000)),
+        "sched_expired": REGISTRY.counter(
+            "filodb_query_sched_expired_total",
+            "queries dropped at dequeue because their deadline expired "
+            "while queued (never executed)"),
+        "deadline_refused": REGISTRY.counter(
+            "filodb_query_deadline_refused_total",
+            "remote /execplan work refused because the remaining budget "
+            "could not cover it"),
+        "partial_shards": REGISTRY.counter(
+            "filodb_query_partial_shard_results_total",
+            "queries answered partially because >=1 shard was down "
+            "(allow_partial_results)"),
+        "dispatch_retries": REGISTRY.counter(
+            "filodb_dispatch_retries_total",
+            "remote dispatch attempts retried after connection errors"),
+        "dispatch_hedged": REGISTRY.counter(
+            "filodb_dispatch_hedged_total",
+            "remote dispatches that launched a hedged second request"),
+        "dispatch_hedge_wins": REGISTRY.counter(
+            "filodb_dispatch_hedge_wins_total",
+            "hedged dispatches where the SECOND request answered first"),
+        "dispatch_failures": REGISTRY.counter(
+            "filodb_dispatch_failures_total",
+            "remote dispatches that failed after exhausting retries"),
+        "quota_active": REGISTRY.gauge(
+            "filodb_quota_active_series",
+            "active (alive-in-index) series per dataset/tenant"),
+        "quota_limit": REGISTRY.gauge(
+            "filodb_quota_limit_series",
+            "configured active-series limit per dataset/tenant"),
+        "quota_rejected": REGISTRY.counter(
+            "filodb_quota_rejected_series_total",
+            "new series rejected because their tenant is over quota"),
+        "quota_dropped_samples": REGISTRY.counter(
+            "filodb_quota_dropped_samples_total",
+            "samples dropped (edge or shard) for over-quota new series"),
+    }
+
+
 def odp_metrics() -> dict:
     """Canonical on-demand-paging metrics."""
     return {

@@ -1,0 +1,23 @@
+"""Workload management: cost-based admission, tenant quotas, deadlines.
+
+ISSUE 5's tentpole — the overload defenses a multi-tenant serving node
+needs before scale-out pays off (see doc/workload.md):
+
+- :mod:`filodb_tpu.workload.cost` — pre-execution cost estimates per
+  ExecPlan, calibrated online from observed query wall time;
+- :mod:`filodb_tpu.workload.admission` — per-tenant / per-priority
+  budgets in front of the query scheduler; sheds with 429 + Retry-After;
+- :mod:`filodb_tpu.workload.deadline` — one wall-clock budget minted at
+  the HTTP entry, decremented at every hop, capping every dispatch
+  timeout, refusing dead work;
+- :mod:`filodb_tpu.workload.quota` — active-series cardinality quotas
+  per tenant, enforced at series creation and shed at the gateway edge.
+"""
+
+from filodb_tpu.workload.admission import (AdmissionController,  # noqa: F401
+                                           AdmissionRejected)
+from filodb_tpu.workload.cost import CostModel  # noqa: F401
+from filodb_tpu.workload.deadline import (DeadlineExceeded,  # noqa: F401
+                                          MIN_REMOTE_BUDGET_MS)
+from filodb_tpu.workload.quota import (SeriesQuota,  # noqa: F401
+                                       SeriesQuotaExceeded)

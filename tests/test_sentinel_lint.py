@@ -388,3 +388,189 @@ def test_device_put_lint_catches_raw_call():
     ok = ("from filodb_tpu.utils.devicewatch import LEDGER\n"
           "x = LEDGER.device_put(a, d, owner='o', fmt='dense')\n")
     assert _raw_device_put_calls(ok, "fake.py") == []
+
+
+# ---------------------------------------------------------------------------
+# Admission-routing lint (ISSUE 5): every HTTP query handler must reach
+# execution through the admission controller.  Concretely: inside
+# FiloHttpServer, ONLY ``_exec`` may materialize a plan (handlers call
+# self._exec, which prices + admits before scheduling), and ``_exec``
+# itself must call ``self._admit``.  A future handler that plans or
+# executes directly would bypass the overload defense — it fails here.
+# ---------------------------------------------------------------------------
+
+
+def _admission_violations(src: str) -> list:
+    tree = ast.parse(src)
+    out = []
+    for cls in ast.walk(tree):
+        if not (isinstance(cls, ast.ClassDef)
+                and cls.name == "FiloHttpServer"):
+            continue
+        exec_has_admit = False
+        for fn in cls.body:
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                if node.func.attr == "materialize" and fn.name != "_exec":
+                    out.append(
+                        f"{fn.name} (line {node.lineno}): materializes a "
+                        f"plan outside _exec — queries must route through "
+                        f"self._exec so admission control prices and "
+                        f"admits them")
+                if fn.name == "_exec" and node.func.attr == "_admit" \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == "self":
+                    exec_has_admit = True
+        if not exec_has_admit:
+            out.append("_exec does not call self._admit — the admission "
+                       "front door is disconnected")
+        return out
+    return ["FiloHttpServer not found"]
+
+
+def test_query_handlers_route_through_admission():
+    src = (ROOT / "http" / "server.py").read_text()
+    bad = _admission_violations(src)
+    assert not bad, "admission bypass:\n  " + "\n  ".join(bad)
+
+
+def test_admission_lint_catches_bypass():
+    """The admission lint must fire on a handler that plans directly
+    and on an _exec with no admission call."""
+    bypass = (
+        "class FiloHttpServer:\n"
+        "    def _exec(self, b, plan):\n"
+        "        with self._admit(b, plan, q):\n"
+        "            pass\n"
+        "    def _sneaky(self, b, p):\n"
+        "        ep = b.planner.materialize(p, q)\n"
+        "        return 200, {}\n"
+    )
+    bad = _admission_violations(bypass)
+    assert len(bad) == 1 and "_sneaky" in bad[0]
+    no_admit = (
+        "class FiloHttpServer:\n"
+        "    def _exec(self, b, plan):\n"
+        "        ep = b.planner.materialize(plan, q)\n"
+        "        return ep.execute(ctx)\n"
+    )
+    bad = _admission_violations(no_admit)
+    assert len(bad) == 1 and "_admit" in bad[0]
+    ok = (
+        "class FiloHttpServer:\n"
+        "    def _exec(self, b, plan):\n"
+        "        ep = b.planner.materialize(plan, q)\n"
+        "        with self._admit(b, ep, q):\n"
+        "            return ep.execute(ctx)\n"
+    )
+    assert _admission_violations(ok) == []
+
+
+# ---------------------------------------------------------------------------
+# Deadline-threading lint (ISSUE 5): every remote dispatch call site
+# must thread the query's deadline.  Two tiers:
+# - EVERY ``urlopen`` under filodb_tpu/ must pass an explicit
+#   ``timeout=`` (an unbounded socket can pin a worker forever);
+# - inside dispatcher/exec classes (class name ending in Dispatcher or
+#   Exec — the remote QUERY call sites), the timeout expression must
+#   reference the remaining deadline budget (a name mentioning
+#   deadline/remaining/budget), not a fixed constant.
+# ---------------------------------------------------------------------------
+
+_DEADLINE_NAMES = ("deadline", "remaining", "budget")
+
+
+def _deadline_violations(src: str, relpath: str) -> list:
+    tree = ast.parse(src)
+    out = []
+
+    def names_in(expr) -> set:
+        got = set()
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name):
+                got.add(n.id)
+            elif isinstance(n, ast.Attribute):
+                got.add(n.attr)
+        return got
+
+    def check_call(node, in_dispatch_class):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, (ast.Attribute, ast.Name))):
+            return
+        fname = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else node.func.id
+        if fname != "urlopen":
+            return
+        timeout_kw = next((k for k in node.keywords
+                           if k.arg == "timeout"), None)
+        if timeout_kw is None:
+            out.append(f"{relpath}:{node.lineno}: urlopen without "
+                       f"timeout= — an unbounded socket can pin a "
+                       f"worker forever")
+            return
+        if in_dispatch_class:
+            refs = {n.lower() for n in names_in(timeout_kw.value)}
+            if not any(dn in r for dn in _DEADLINE_NAMES for r in refs):
+                out.append(
+                    f"{relpath}:{node.lineno}: remote dispatch urlopen "
+                    f"whose timeout does not thread the deadline — "
+                    f"derive it from the remaining budget "
+                    f"(workload/deadline.py budget_timeout_s)")
+
+    dispatch_nodes = set()
+    for cls in ast.walk(tree):
+        if isinstance(cls, ast.ClassDef) and (
+                cls.name.endswith("Dispatcher")
+                or cls.name.endswith("Exec")):
+            for n in ast.walk(cls):
+                dispatch_nodes.add(id(n))
+    for node in ast.walk(tree):
+        check_call(node, id(node) in dispatch_nodes)
+    return out
+
+
+def test_remote_dispatch_threads_deadline():
+    violations = []
+    for path in sorted(ROOT.rglob("*.py")):
+        rel = str(path.relative_to(ROOT))
+        violations.extend(_deadline_violations(path.read_text(), rel))
+    assert not violations, \
+        "unthreaded deadlines:\n  " + "\n  ".join(violations)
+
+
+def test_deadline_lint_catches_fixed_timeout():
+    """The deadline lint must fire on a fixed dispatch timeout and on
+    a missing timeout, and accept a budget-derived one."""
+    fixed = (
+        "import urllib.request\n"
+        "class MyPlanDispatcher:\n"
+        "    def dispatch(self):\n"
+        "        urllib.request.urlopen(req, timeout=60.0)\n"
+    )
+    bad = _deadline_violations(fixed, "fake.py")
+    assert len(bad) == 1 and "thread the deadline" in bad[0]
+    missing = (
+        "import urllib.request\n"
+        "def poll():\n"
+        "    urllib.request.urlopen(url)\n"
+    )
+    bad = _deadline_violations(missing, "fake.py")
+    assert len(bad) == 1 and "without" in bad[0]
+    ok = (
+        "import urllib.request\n"
+        "class MyPlanDispatcher:\n"
+        "    def dispatch(self):\n"
+        "        deadline_timeout_s = dl.budget_timeout_s(q, 60.0)\n"
+        "        urllib.request.urlopen(req, timeout=deadline_timeout_s)\n"
+    )
+    assert _deadline_violations(ok, "fake.py") == []
+    plain_ok = (
+        "import urllib.request\n"
+        "def poll():\n"
+        "    urllib.request.urlopen(url, timeout=5)\n"
+    )
+    assert _deadline_violations(plain_ok, "fake.py") == []

@@ -1,0 +1,752 @@
+"""Workload-management subsystem (ISSUE 5): cost model calibration,
+admission shed under synthetic overload (429 + Retry-After, bounded
+high-priority latency), deadline enforcement in the scheduler, tenant
+cardinality quotas on ingest, and dispatch retry/hedge behavior under
+faultinject-driven connection failures."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.query.model import (QueryContext, QueryResult, QueryStats,
+                                    ShardUnavailable)
+from filodb_tpu.query.scheduler import QueryRejected, QueryScheduler
+from filodb_tpu.utils.observability import REGISTRY
+from filodb_tpu.workload import deadline as wdl
+from filodb_tpu.workload.admission import (AdmissionController,
+                                           AdmissionRejected, plan_tenant)
+from filodb_tpu.workload.cost import CostModel
+from filodb_tpu.workload.quota import SeriesQuota
+
+BASE = 1_700_000_000_000
+STEP = 10_000
+
+
+def _qctx(timeout_ms=30_000, tenant="", priority="default",
+          deadline_in_ms=None):
+    q = QueryContext(submit_time_ms=int(time.time() * 1000),
+                     timeout_ms=timeout_ms, tenant=tenant,
+                     priority=priority)
+    if deadline_in_ms is not None:
+        q.deadline_ms = int(time.time() * 1000) + deadline_in_ms
+    else:
+        wdl.mint(q)
+    return q
+
+
+# ---------------------------------------------------------------------------
+# Cost model
+# ---------------------------------------------------------------------------
+
+
+def _ingested_store(n_series=32, num_shards=4, spread=2):
+    from filodb_tpu.core.record import RecordBuilder, decode_container
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.parallel.shardmap import ShardMapper, ShardStatus
+    mapper = ShardMapper(num_shards)
+    mapper.register_node(range(num_shards), "local")
+    ms = TimeSeriesMemStore()
+    for s in range(num_shards):
+        mapper.update_status(s, ShardStatus.ACTIVE)
+        ms.setup("prom", DEFAULT_SCHEMAS, s)
+    rng = np.random.default_rng(7)
+    b = RecordBuilder(DEFAULT_SCHEMAS["prom-counter"], DatasetOptions())
+    ts = BASE + np.arange(120, dtype=np.int64) * STEP
+    for i in range(n_series):
+        b.add_series(ts, [np.cumsum(rng.random(120))],
+                     {"__name__": "wl_total", "instance": f"i{i}",
+                      "_ws_": "demo", "_ns_": "App-0"})
+    for off, c in enumerate(b.containers()):
+        per = {}
+        for rec in decode_container(c, DEFAULT_SCHEMAS):
+            sh = mapper.ingestion_shard(rec.shard_hash, rec.part_hash,
+                                        spread) % num_shards
+            per.setdefault(sh, []).append(rec)
+        for sh, recs in per.items():
+            ms.get_shard("prom", sh).ingest(recs, off)
+    return ms, mapper
+
+
+def _plan(ms, mapper, query, start, end, spread=2):
+    from filodb_tpu.coordinator.planner import SingleClusterPlanner
+    from filodb_tpu.core.schemas import DatasetOptions
+    from filodb_tpu.promql.parser import query_range_to_logical_plan
+    planner = SingleClusterPlanner("prom", mapper, DatasetOptions(),
+                                   spread_default=spread)
+    lp = query_range_to_logical_plan(query, start, STEP, end)
+    return planner.materialize(lp, QueryContext())
+
+
+class TestCostModel:
+    def test_monotone_in_time_range(self):
+        ms, mapper = _ingested_store()
+        cm = CostModel()
+        q = 'sum(rate(wl_total{_ws_="demo",_ns_="App-0"}[2m]))'
+        short = cm.estimate(_plan(ms, mapper, q, BASE, BASE + 600_000), ms)
+        long = cm.estimate(_plan(ms, mapper, q, BASE, BASE + 6_000_000), ms)
+        assert long > short > 0
+
+    def test_monotone_in_series_hits(self):
+        ms, mapper = _ingested_store(n_series=8)
+        ms2, mapper2 = _ingested_store(n_series=64)
+        cm = CostModel()
+        q = 'sum(rate(wl_total{_ws_="demo",_ns_="App-0"}[2m]))'
+        few = cm.estimate(
+            _plan(ms, mapper, q, BASE, BASE + 600_000), ms)
+        many = cm.estimate(
+            _plan(ms2, mapper2, q, BASE, BASE + 600_000), ms2)
+        assert many > few
+
+    def test_heavier_ops_cost_more(self):
+        ms, mapper = _ingested_store()
+        cm = CostModel()
+        plain = cm.estimate(_plan(
+            ms, mapper, 'rate(wl_total{_ws_="demo",_ns_="App-0"}[2m])',
+            BASE, BASE + 600_000), ms)
+        heavy = cm.estimate(_plan(
+            ms, mapper,
+            'quantile_over_time(0.99, '
+            'wl_total{_ws_="demo",_ns_="App-0"}[2m])',
+            BASE, BASE + 600_000), ms)
+        assert heavy > plain
+
+    def test_calibration_tracks_observed_throughput(self):
+        cm = CostModel(sec_per_unit=1e-4)
+        # estimate is linear in cost (monotone by construction)
+        assert cm.estimate_seconds(200) > cm.estimate_seconds(100)
+        # observe consistently FASTER execution: predictions drop
+        # monotonically toward the observed rate
+        before = cm.estimate_seconds(1000)
+        preds = []
+        for _ in range(10):
+            cm.observe(cost=1000, seconds=0.001)  # 1e-6 s/unit
+            preds.append(cm.estimate_seconds(1000))
+        assert preds[0] <= before
+        assert all(a >= b for a, b in zip(preds, preds[1:]))
+        assert preds[-1] == pytest.approx(0.001, rel=0.5)
+        # and SLOWER observations push it back up
+        cm.observe(cost=1000, seconds=1.0)
+        assert cm.estimate_seconds(1000) > preds[-1]
+
+    def test_calibration_upward_moves_are_rate_limited(self):
+        """One compile-inflated cold-start sample must not wedge
+        admission: shed queries never observe, so an overshoot past the
+        shed threshold could never self-correct."""
+        cm = CostModel(sec_per_unit=1e-5)
+        cm.observe(cost=1, seconds=10.0)  # 1e6x the prior (jit compile)
+        assert cm.estimate_seconds(1) <= 1e-5 * 4 + 1e-12
+        # a genuinely slow node still converges upward, geometrically
+        for _ in range(10):
+            cm.observe(cost=1, seconds=10.0)
+        assert cm.estimate_seconds(1) > 1e-3
+
+    def test_remote_leaf_inherits_mean_of_resolved(self):
+        ms, mapper = _ingested_store(num_shards=4)
+        cm = CostModel()
+        plan = _plan(ms, mapper,
+                     'sum(rate(wl_total{_ws_="demo",_ns_="App-0"}[2m]))',
+                     BASE, BASE + 600_000)
+        full = cm.estimate(plan, ms)
+        # without a memstore no leaf resolves: the default prior kicks
+        # in and the estimate stays positive (never free)
+        blind = cm.estimate(plan, None)
+        assert blind >= 1.0 and full >= 1.0
+
+
+class TestDeadline:
+    def test_mint_and_remaining(self):
+        q = QueryContext(submit_time_ms=int(time.time() * 1000),
+                         timeout_ms=5_000)
+        wdl.mint(q)
+        rem = wdl.remaining_ms(q)
+        assert 0 < rem <= 5_000
+        assert not wdl.expired(q)
+        assert wdl.remaining_ms(QueryContext()) is None
+
+    def test_budget_caps_timeout(self):
+        q = _qctx(deadline_in_ms=200)
+        assert wdl.budget_timeout_s(q, 60.0) <= 0.2
+        # no deadline: the cap rules
+        assert wdl.budget_timeout_s(QueryContext(), 60.0) == 60.0
+        # expired: fail-fast floor, not urllib's 0=forever
+        q2 = _qctx(deadline_in_ms=-50)
+        assert 0 < wdl.budget_timeout_s(q2, 60.0) <= 0.01
+
+    def test_check_raises_when_expired(self):
+        with pytest.raises(wdl.DeadlineExceeded):
+            wdl.check(_qctx(deadline_in_ms=-10))
+        wdl.check(_qctx(deadline_in_ms=10_000))  # plenty left: no raise
+
+    def test_wire_budget_shrinks_across_serialization(self):
+        from filodb_tpu.query import wire
+        from filodb_tpu.query.exec import MultiSchemaPartitionsExec
+        qctx = _qctx(deadline_in_ms=1_000)
+        plan = MultiSchemaPartitionsExec("prom", 0, [], 0, 1,
+                                         query_context=qctx)
+        p1 = wire.serialize_plan(plan)
+        assert 0 < p1["qctx"]["budget_ms"] <= 1_000
+        assert "deadline_ms" not in p1["qctx"]
+        time.sleep(0.06)
+        p2 = wire.serialize_plan(plan)
+        assert p2["qctx"]["budget_ms"] < p1["qctx"]["budget_ms"]
+        # decode re-anchors on the local clock
+        d = wire.deserialize_plan(p2)
+        rem = wdl.remaining_ms(d.query_context)
+        assert 0 < rem <= p2["qctx"]["budget_ms"] + 1
+
+    def test_expired_plan_refuses_to_execute(self):
+        from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+        from filodb_tpu.query.exec import EmptyResultExec, ExecContext
+        from filodb_tpu.query.model import QueryError
+        qctx = _qctx(deadline_in_ms=-5)
+        plan = EmptyResultExec(query_context=qctx)
+        with pytest.raises(QueryError, match="deadline"):
+            plan.execute(ExecContext(TimeSeriesMemStore(), qctx))
+
+
+# ---------------------------------------------------------------------------
+# Admission controller (unit)
+# ---------------------------------------------------------------------------
+
+
+class TestAdmission:
+    def _ctrl(self, **kw):
+        kw.setdefault("max_inflight_cost", 10.0)
+        kw.setdefault("workers", 1)
+        return AdmissionController(CostModel(sec_per_unit=1e-6), **kw)
+
+    def test_admits_and_releases(self):
+        c = self._ctrl()
+        with c.admit(_qctx(), 5.0):
+            assert c.snapshot()["inflight_cost"] == 5.0
+        assert c.snapshot()["inflight_cost"] == 0.0
+
+    def test_overload_sheds_with_retry_after(self):
+        c = self._ctrl()
+        with c.admit(_qctx(), 8.0):
+            with pytest.raises(AdmissionRejected) as exc:
+                c.admit(_qctx(), 8.0)
+            assert exc.value.reason == "overload"
+            assert exc.value.retry_after_s >= 1.0
+
+    def test_priority_headroom(self):
+        """default saturates at its 80% share; high still admits."""
+        c = self._ctrl()
+        with c.admit(_qctx(priority="default"), 7.0):
+            with pytest.raises(AdmissionRejected):
+                c.admit(_qctx(priority="default"), 2.0)  # 7+2 > 8
+            with c.admit(_qctx(priority="high"), 2.0):   # 7+2 <= 10
+                pass
+            with pytest.raises(AdmissionRejected):
+                c.admit(_qctx(priority="low"), 1.0)      # 7+1 > 5
+
+    def test_expired_rejected_before_queueing(self):
+        c = self._ctrl()
+        with pytest.raises(AdmissionRejected) as exc:
+            c.admit(_qctx(deadline_in_ms=-10), 1.0)
+        assert exc.value.reason == "expired"
+
+    def test_queue_delay_exceeding_deadline_sheds(self):
+        # calibrate slow: 1 unit = 1s at 1 worker
+        c = AdmissionController(CostModel(sec_per_unit=1.0),
+                                max_inflight_cost=1000.0, workers=1)
+        with c.admit(_qctx(), 5.0):  # ~5s of work in flight
+            with pytest.raises(AdmissionRejected) as exc:
+                c.admit(_qctx(deadline_in_ms=500), 1.0)
+            assert exc.value.reason == "deadline"
+
+    def test_tenant_concurrency_cap(self):
+        c = self._ctrl(tenant_max_concurrent=1)
+        with c.admit(_qctx(tenant="t1"), 1.0):
+            with pytest.raises(AdmissionRejected) as exc:
+                c.admit(_qctx(tenant="t1"), 1.0)
+            assert exc.value.reason == "tenant_concurrency"
+            with c.admit(_qctx(tenant="t2"), 1.0):  # other tenants fine
+                pass
+
+    def test_tenant_cost_budget(self):
+        c = self._ctrl(max_inflight_cost=100.0,
+                       tenant_max_inflight_cost=3.0)
+        with c.admit(_qctx(tenant="t1"), 3.0):
+            with pytest.raises(AdmissionRejected) as exc:
+                c.admit(_qctx(tenant="t1"), 1.0)
+            assert exc.value.reason == "tenant_cost"
+
+    def test_disabled_admits_everything(self):
+        c = self._ctrl(enabled=False)
+        with c.admit(_qctx(deadline_in_ms=-10), 1e9):
+            pass
+
+    def test_partial_priority_shares_merge_over_defaults(self):
+        """A config naming only one class must not strip the others —
+        every unlabelled query lands in 'default'."""
+        c = AdmissionController(CostModel(), max_inflight_cost=10.0,
+                                priority_shares={"high": 1.0}, workers=1)
+        with c.admit(_qctx(priority="default"), 1.0):  # no KeyError
+            pass
+        assert c.priority_shares["default"] == 0.8
+        # unknown classes fall back to the default class's share
+        with c.admit(_qctx(priority="mystery"), 1.0):
+            pass
+
+    def test_runtime_configure(self):
+        c = self._ctrl()
+        c.configure(max_inflight_cost=1.0)
+        with pytest.raises(AdmissionRejected):
+            c.admit(_qctx(), 2.0)
+        c.configure(max_inflight_cost=100.0)
+        with c.admit(_qctx(), 2.0):
+            pass
+
+    def test_plan_tenant_from_filters(self):
+        ms, mapper = _ingested_store()
+        ep = _plan(ms, mapper,
+                   'sum(rate(wl_total{_ws_="demo",_ns_="App-0"}[2m]))',
+                   BASE, BASE + 600_000)
+        assert plan_tenant(ep) == "demo/App-0"
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: expired-at-dequeue drop (satellite bugfix)
+# ---------------------------------------------------------------------------
+
+
+class TestSchedulerDeadline:
+    def test_expired_deadline_dropped_at_dequeue(self):
+        s = QueryScheduler(num_workers=1, max_queued=8, name="wl-exp")
+        expired = REGISTRY.counter("filodb_query_sched_expired_total")
+        before = expired.value(scheduler="wl-exp")
+        try:
+            gate = threading.Event()
+            started = threading.Event()
+            ran = []
+            s.submit(lambda: started.set() or gate.wait(5))
+            started.wait(5)
+            fut = s.submit(lambda: ran.append(1),
+                           deadline_ms=int(time.time() * 1000) + 20)
+            time.sleep(0.1)  # deadline passes while queued
+            gate.set()
+            with pytest.raises(QueryRejected, match="deadline expired"):
+                fut.result(timeout=5)
+            assert not ran, "expired query must NEVER execute"
+            assert expired.value(scheduler="wl-exp") == before + 1
+        finally:
+            s.shutdown()
+
+    def test_live_deadline_executes(self):
+        s = QueryScheduler(num_workers=1, max_queued=8, name="wl-live")
+        try:
+            assert s.execute(lambda: 7,
+                             deadline_ms=int(time.time() * 1000)
+                             + 10_000) == 7
+        finally:
+            s.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Cardinality quotas on ingest
+# ---------------------------------------------------------------------------
+
+
+class TestSeriesQuota:
+    def _shard(self, quota):
+        from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+        from filodb_tpu.memstore.shard import TimeSeriesShard
+        sh = TimeSeriesShard("prom", DEFAULT_SCHEMAS, 0)
+        sh.series_quota = quota
+        return sh
+
+    def _ingest_one(self, sh, ns, instance, ts=BASE):
+        from filodb_tpu.core.record import IngestRecord, partition_hash
+        from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+        tags = {"_metric_": "q_total", "_ws_": "demo", "_ns_": ns,
+                "instance": instance}
+        rec = IngestRecord(DEFAULT_SCHEMAS["gauge"].schema_hash, tags, ts,
+                           (1.0,), 0, partition_hash(tags))
+        return sh.ingest([rec], offset=sh.latest_offset + 1)
+
+    def test_over_quota_new_series_rejected(self):
+        quota = SeriesQuota(dataset="prom", default_limit=2)
+        sh = self._shard(quota)
+        rejected = REGISTRY.counter("filodb_quota_rejected_series_total")
+        before = rejected.value(dataset="prom", tenant="App-7")
+        assert self._ingest_one(sh, "App-7", "a") == 1
+        assert self._ingest_one(sh, "App-7", "b") == 1
+        # third NEW series is over quota: rows dropped, counted
+        assert self._ingest_one(sh, "App-7", "c") == 0
+        assert sh.stats.series_quota_rejected == 1
+        assert sh.stats.rows_quota_dropped == 1
+        assert rejected.value(dataset="prom", tenant="App-7") == before + 1
+        # EXISTING series keep ingesting
+        assert self._ingest_one(sh, "App-7", "a", ts=BASE + 60_000) == 1
+        # other tenants are unaffected
+        assert self._ingest_one(sh, "App-8", "a") == 1
+        assert quota.active("App-7") == 2
+
+    def test_override_beats_default(self):
+        quota = SeriesQuota(dataset="prom", default_limit=100,
+                            overrides={"Bomb": 1})
+        sh = self._shard(quota)
+        assert self._ingest_one(sh, "Bomb", "a") == 1
+        assert self._ingest_one(sh, "Bomb", "b") == 0
+
+    def test_purge_frees_quota(self):
+        quota = SeriesQuota(dataset="prom", default_limit=1)
+        sh = self._shard(quota)
+        assert self._ingest_one(sh, "App-7", "a") == 1
+        assert self._ingest_one(sh, "App-7", "b") == 0
+        # age the series out entirely; quota frees with the index slot
+        sh.purge_expired(retention_ms=1, now_ms=BASE + 3_600_000)
+        assert quota.active("App-7") == 0
+        assert self._ingest_one(sh, "App-7", "b", ts=BASE + 60_000) == 1
+
+    def test_refresh_from_index(self):
+        quota = SeriesQuota(dataset="prom", default_limit=100)
+        sh = self._shard(None)  # unmetered ingest
+        for i in range(5):
+            self._ingest_one(sh, "App-1", f"i{i}")
+        for i in range(3):
+            self._ingest_one(sh, "App-2", f"i{i}")
+        quota.refresh_from_index(sh.index)
+        assert quota.active("App-1") == 5
+        assert quota.active("App-2") == 3
+
+    def test_gateway_edge_sheds_over_quota_tenant(self):
+        from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+        from filodb_tpu.gateway.server import ShardingPublisher
+        from filodb_tpu.parallel.shardmap import ShardMapper
+        quota = SeriesQuota(dataset="prom", tenant_label="_ns_",
+                            default_limit=0, overrides={"Ok": 100})
+        published = []
+        pub = ShardingPublisher(DEFAULT_SCHEMAS["gauge"], ShardMapper(4),
+                                lambda s, c: published.append((s, c)),
+                                quota=quota)
+        dropped = REGISTRY.counter("filodb_quota_dropped_samples_total")
+        before = dropped.value(dataset="prom", tenant="Bomb")
+        lines = "\n".join(
+            [f"m,_ws_=demo,_ns_=Bomb,i=i{k} v=1 1700000000000000000"
+             for k in range(4)]
+            + [f"m,_ws_=demo,_ns_=Ok,i=i{k} v=1 1700000000000000000"
+               for k in range(4)]) + "\n"
+        n = pub.ingest_influx_batch(lines)
+        assert n == 4  # only the under-quota tenant's samples landed
+        assert dropped.value(dataset="prom", tenant="Bomb") == before + 4
+        # quota freed later: the series are NOT poisoned by a memo
+        quota.configure(default_limit=100)
+        assert pub.ingest_influx_batch(lines) == 8
+
+
+# ---------------------------------------------------------------------------
+# HTTP overload e2e: shed with 429, bounded high-priority latency
+# ---------------------------------------------------------------------------
+
+
+class _SleepPlan:
+    """Fake ExecPlan: burns wall time, returns an empty result."""
+
+    def __init__(self, qctx, sleep_s):
+        self.query_context = qctx
+        self.transformers = []
+        self.children = ()
+        self._sleep_s = sleep_s
+
+    def execute(self, ctx):
+        time.sleep(self._sleep_s)
+        return QueryResult(self.query_context.query_id, [], QueryStats())
+
+
+class _SleepPlanner:
+    def __init__(self, sleep_s):
+        self.sleep_s = sleep_s
+
+    def materialize(self, lp, qctx):
+        return _SleepPlan(qctx, self.sleep_s)
+
+
+def _get(port, path, **params):
+    qs = urllib.parse.urlencode(params)
+    url = f"http://127.0.0.1:{port}{path}" + (f"?{qs}" if qs else "")
+    try:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+@pytest.fixture()
+def overload_server():
+    """One dataset whose every query sleeps 150ms, 2 workers, a global
+    admission budget of 4 cost units (each query costs 1): capacity is
+    ~13 qps, the test offers 4x that concurrently."""
+    from filodb_tpu.http.server import DatasetBinding, FiloHttpServer
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+    ctrl = AdmissionController(CostModel(sec_per_unit=0.15),
+                               dataset="ovl", max_inflight_cost=4.0,
+                               priority_shares={"low": 0.25,
+                                                "default": 0.5,
+                                                "high": 1.0},
+                               workers=2)
+    sched = QueryScheduler(num_workers=2, max_queued=64, name="ovl")
+    srv = FiloHttpServer()
+    srv.bind_dataset(DatasetBinding(
+        "ovl", TimeSeriesMemStore(), _SleepPlanner(0.15),
+        scheduler=sched, admission=ctrl))
+    port = srv.start()
+    yield port, ctrl
+    srv.shutdown()
+    sched.shutdown()
+    ctrl.shutdown()
+
+
+class TestOverloadShed(object):
+    QS = {"query": "up", "start": 1_700_000_000, "end": 1_700_000_060,
+          "step": "15s"}
+
+    def test_excess_load_sheds_429_high_priority_stays_bounded(
+            self, overload_server):
+        port, ctrl = overload_server
+        results = []
+        lock = threading.Lock()
+
+        def fire(priority, n):
+            for _ in range(n):
+                t0 = time.perf_counter()
+                code, body, headers = _get(
+                    port, "/promql/ovl/api/v1/query_range",
+                    priority=priority, **self.QS)
+                with lock:
+                    results.append((priority, code,
+                                    time.perf_counter() - t0, headers))
+
+        # 16 concurrent default-priority clients (4x the cost budget),
+        # plus 2 high-priority clients issuing 2 queries each (2
+        # concurrent highs always fit the reserved headroom: 2 default
+        # ceiling + 2 high <= the 4-unit global budget)
+        threads = [threading.Thread(target=fire, args=("default", 2))
+                   for _ in range(16)]
+        threads += [threading.Thread(target=fire, args=("high", 2))
+                    for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        default = [r for r in results if r[0] == "default"]
+        high = [r for r in results if r[0] == "high"]
+        shed = [r for r in default if r[1] == 429]
+        ok_default = [r for r in default if r[1] == 200]
+        assert shed, "4x overload produced no 429 sheds"
+        assert ok_default, "admission must not shed EVERYTHING"
+        # every shed reply carries a Retry-After hint
+        for _p, _c, _lat, headers in shed:
+            assert int(headers["Retry-After"]) >= 1
+        # shed queries answer fast — never queued to rot
+        assert max(lat for _p, _c, lat, _h in shed) < 2.0
+        # high priority: all answered, p50 bounded (reserved headroom
+        # above the default-class ceiling keeps them flowing)
+        assert all(c == 200 for _p, c, _l, _h in high), high
+        lats = sorted(lat for _p, _c, lat, _h in high)
+        assert lats[len(lats) // 2] < 2.0, f"high-priority p50 {lats}"
+
+    def test_expired_deadline_is_shed_not_executed(self, overload_server):
+        port, _ctrl = overload_server
+        done = REGISTRY.counter("filodb_queries_executed_total")
+        before = done.value(scheduler="ovl")
+        code, body, headers = _get(
+            port, "/promql/ovl/api/v1/query_range",
+            timeout="1ms", **self.QS)
+        assert code == 429
+        assert body["errorType"] == "throttled"
+        assert "Retry-After" in headers
+        assert done.value(scheduler="ovl") == before
+
+    def test_admin_workload_view(self, overload_server):
+        port, _ctrl = overload_server
+        code, body, _ = _get(port, "/admin/workload")
+        assert code == 200
+        row = body["data"]["datasets"]["ovl"]
+        assert "admission" in row and row["queue_depth"] >= 0
+        assert row["admission"]["max_inflight_cost"] == 4.0
+
+    def test_runtime_config_adjusts_admission(self, overload_server):
+        port, ctrl = overload_server
+        code, body, _ = _get(port, "/admin/config",
+                             **{"admission-max-inflight-cost": "2.5"})
+        assert code == 200
+        assert ctrl.max_inflight_cost == 2.5
+        wl = body["data"]["workload"]["datasets"]["ovl"]
+        assert wl["admission"]["max_inflight_cost"] == 2.5
+
+
+# ---------------------------------------------------------------------------
+# Dispatch retry / hedge under injected connection faults
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def data_node():
+    """A real single-node /execplan backend with a little data."""
+    from filodb_tpu.coordinator.planner import SingleClusterPlanner
+    from filodb_tpu.core.schemas import DatasetOptions
+    from filodb_tpu.http.server import DatasetBinding, FiloHttpServer
+    ms, mapper = _ingested_store(n_series=8, num_shards=1, spread=0)
+    srv = FiloHttpServer()
+    planner = SingleClusterPlanner("prom", mapper, DatasetOptions(),
+                                   spread_default=0)
+    srv.bind_dataset(DatasetBinding("prom", ms, planner))
+    port = srv.start()
+    yield {"port": port, "ms": ms}
+    srv.shutdown()
+
+
+def _leaf_plan(deadline_in_ms=None):
+    from filodb_tpu.core.filters import ColumnFilter, Equals
+    from filodb_tpu.query.exec import MultiSchemaPartitionsExec
+    qctx = QueryContext(submit_time_ms=int(time.time() * 1000))
+    if deadline_in_ms is not None:
+        qctx.deadline_ms = int(time.time() * 1000) + deadline_in_ms
+    return MultiSchemaPartitionsExec(
+        "prom", 0, [ColumnFilter("_metric_", Equals("wl_total"))],
+        BASE, BASE + 600_000, query_context=qctx)
+
+
+def _exec_ctx(ms):
+    from filodb_tpu.query.exec import ExecContext
+    return ExecContext(ms, QueryContext())
+
+
+class TestDispatchRetryHedge:
+    def test_connection_fault_is_retried(self, data_node):
+        from filodb_tpu.coordinator.dispatch import HttpPlanDispatcher
+        from filodb_tpu.integrity.faultinject import FlakyTcpProxy
+        proxy = FlakyTcpProxy(data_node["port"])
+        port = proxy.start()
+        retries = REGISTRY.counter("filodb_dispatch_retries_total")
+        try:
+            d = HttpPlanDispatcher(f"http://127.0.0.1:{port}",
+                                   max_retries=2, backoff_s=0.01)
+            before = retries.value(endpoint=d.endpoint)
+            proxy.fail_next(1)
+            result = d.dispatch(_leaf_plan(), _exec_ctx(data_node["ms"]))
+            assert result.num_series > 0
+            assert proxy.connections == 2  # refused once, then retried
+            assert retries.value(endpoint=d.endpoint) == before + 1
+        finally:
+            proxy.shutdown()
+
+    def test_exhausted_retries_raise_shard_unavailable(self, data_node):
+        from filodb_tpu.coordinator.dispatch import HttpPlanDispatcher
+        from filodb_tpu.integrity.faultinject import FlakyTcpProxy
+        proxy = FlakyTcpProxy(data_node["port"])
+        port = proxy.start()
+        try:
+            d = HttpPlanDispatcher(f"http://127.0.0.1:{port}",
+                                   max_retries=1, backoff_s=0.01)
+            proxy.fail_next(5)
+            with pytest.raises(ShardUnavailable):
+                d.dispatch(_leaf_plan(), _exec_ctx(data_node["ms"]))
+            assert proxy.connections == 2  # 1 + 1 retry, bounded
+        finally:
+            proxy.shutdown()
+
+    def test_deadline_caps_dispatch_timeout(self, data_node):
+        """Satellite #1: the fixed 60s dispatch timeout is gone — a
+        stalled backend costs at most the remaining budget."""
+        from filodb_tpu.coordinator.dispatch import HttpPlanDispatcher
+        from filodb_tpu.integrity.faultinject import FlakyTcpProxy
+        proxy = FlakyTcpProxy(data_node["port"], stall_s=5.0)
+        port = proxy.start()
+        try:
+            d = HttpPlanDispatcher(f"http://127.0.0.1:{port}",
+                                   timeout_s=60.0, max_retries=0)
+            proxy.stall_next(1)
+            t0 = time.perf_counter()
+            with pytest.raises(ShardUnavailable):
+                d.dispatch(_leaf_plan(deadline_in_ms=300),
+                           _exec_ctx(data_node["ms"]))
+            assert time.perf_counter() - t0 < 2.0, \
+                "dispatch waited past the deadline budget"
+        finally:
+            proxy.shutdown()
+
+    def test_hedged_request_beats_tail_stall(self, data_node):
+        from filodb_tpu.coordinator.dispatch import HttpPlanDispatcher
+        from filodb_tpu.integrity.faultinject import FlakyTcpProxy
+        proxy = FlakyTcpProxy(data_node["port"], stall_s=2.0)
+        port = proxy.start()
+        hedged = REGISTRY.counter("filodb_dispatch_hedged_total")
+        wins = REGISTRY.counter("filodb_dispatch_hedge_wins_total")
+        try:
+            d = HttpPlanDispatcher(f"http://127.0.0.1:{port}",
+                                   max_retries=0, hedge=True,
+                                   hedge_min_s=0.05, hedge_warmup=4)
+            ms = data_node["ms"]
+            for _ in range(4):  # warm the p99 window
+                d.dispatch(_leaf_plan(), _exec_ctx(ms))
+            assert d.hedge_delay_s() is not None
+            b_h = hedged.value(endpoint=d.endpoint)
+            b_w = wins.value(endpoint=d.endpoint)
+            proxy.stall_next(1)  # primary stalls 2s; hedge passes
+            t0 = time.perf_counter()
+            result = d.dispatch(_leaf_plan(), _exec_ctx(ms))
+            elapsed = time.perf_counter() - t0
+            assert result.num_series > 0
+            assert elapsed < 1.5, \
+                f"hedge did not beat the {proxy.stall_s}s stall: {elapsed}"
+            assert hedged.value(endpoint=d.endpoint) == b_h + 1
+            assert wins.value(endpoint=d.endpoint) == b_w + 1
+        finally:
+            proxy.shutdown()
+
+    def test_retry_reserializes_the_wire_budget(self, data_node):
+        """A retried attempt must rebuild the body so its relative
+        budget_ms reflects what is left NOW — a stale body would let
+        the data node re-anchor budget the coordinator already spent."""
+        from filodb_tpu.coordinator.dispatch import HttpPlanDispatcher
+        from filodb_tpu.integrity.faultinject import FlakyTcpProxy
+        from filodb_tpu.query import wire
+        proxy = FlakyTcpProxy(data_node["port"])
+        port = proxy.start()
+        try:
+            d = HttpPlanDispatcher(f"http://127.0.0.1:{port}",
+                                   max_retries=2, backoff_s=0.05)
+            plan = _leaf_plan(deadline_in_ms=10_000)
+            budgets = []
+
+            def make_body():
+                payload = wire.serialize_plan(plan)
+                budgets.append(payload["qctx"]["budget_ms"])
+                return json.dumps(payload).encode()
+
+            proxy.fail_next(1)
+            d._request(plan, make_body, {"Content-Type":
+                                         "application/json"})
+            assert len(budgets) == 2, "retry must re-serialize the body"
+            assert budgets[1] < budgets[0], \
+                "the retried attempt's wire budget must have shrunk"
+        finally:
+            proxy.shutdown()
+
+    def test_http_error_is_not_retried(self, data_node):
+        """A served error response must never multiply load."""
+        from filodb_tpu.coordinator.dispatch import HttpPlanDispatcher
+        from filodb_tpu.integrity.faultinject import FlakyTcpProxy
+        proxy = FlakyTcpProxy(data_node["port"])
+        port = proxy.start()
+        try:
+            d = HttpPlanDispatcher(f"http://127.0.0.1:{port}",
+                                   max_retries=3, backoff_s=0.01)
+            plan = _leaf_plan()
+            plan.dataset = "nope"  # 404 from the data node
+            from filodb_tpu.query.model import QueryError
+            with pytest.raises(QueryError):
+                d.dispatch(plan, _exec_ctx(data_node["ms"]))
+            assert proxy.connections == 1, "HTTP errors must not retry"
+        finally:
+            proxy.shutdown()

@@ -1,0 +1,256 @@
+"""2-node e2e: deadline propagation across the /execplan hop and
+partial-results degradation when a data node is down (ISSUE 5).
+
+The remaining wall-clock budget travels the wire as ``budget_ms``
+(shrinking at every hop), the data node refuses work that cannot finish
+in the budget left, and a scatter-gather whose remote node is dead
+degrades to a warned partial result (X-FiloDB-Partial-Data) when the
+query opts in — and fails loudly when it does not."""
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.dispatch import (HttpPlanDispatcher,
+                                             dispatcher_factory)
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.core.record import RecordBuilder, decode_container
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+from filodb_tpu.http.server import DatasetBinding, FiloHttpServer
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.parallel.shardmap import ShardMapper, ShardStatus
+from filodb_tpu.query.model import QueryContext, ShardUnavailable
+from filodb_tpu.query.scheduler import QueryScheduler
+from filodb_tpu.utils.observability import REGISTRY
+
+BASE = 1_700_000_000_000
+STEP = 10_000
+
+
+def _get(port, path, **params):
+    qs = urllib.parse.urlencode(params)
+    url = f"http://127.0.0.1:{port}{path}" + (f"?{qs}" if qs else "")
+    try:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    """node-a coordinates; node-b owns one data shard over HTTP.  A
+    second coordinator (port_a_dead) routes node-b's shard at a DEAD
+    endpoint for the degradation tests."""
+    num_shards = 4
+    mapper = ShardMapper(num_shards)
+    rng = np.random.default_rng(11)
+    b = RecordBuilder(DEFAULT_SCHEMAS["prom-counter"])
+    for i in range(8):
+        tags = {"__name__": "wl2_total", "instance": f"i{i}",
+                "_ws_": "demo", "_ns_": "App-0"}
+        ts = BASE + np.arange(300) * STEP
+        vals = np.cumsum(rng.random(300))
+        for t, v in zip(ts, vals):
+            b.add(int(t), [float(v)], tags)
+    by_shard = {}
+    for off, c in enumerate(b.containers()):
+        for rec in decode_container(c, DEFAULT_SCHEMAS):
+            shard = mapper.ingestion_shard(rec.shard_hash, rec.part_hash, 1) \
+                % num_shards
+            by_shard.setdefault(shard, []).append((off, rec))
+    used = sorted(by_shard)
+    assert len(used) == 2
+    shards_a = [used[0]] + [s for s in range(num_shards) if s not in used]
+    shards_b = [used[1]]
+    mapper.register_node(shards_a, "node-a")
+    mapper.register_node(shards_b, "node-b")
+    for s in range(num_shards):
+        mapper.update_status(s, ShardStatus.ACTIVE)
+
+    stores = {"node-a": TimeSeriesMemStore(), "node-b": TimeSeriesMemStore()}
+    for ms in stores.values():
+        for s in range(num_shards):
+            ms.setup("prom", DEFAULT_SCHEMAS, s)
+    for shard, recs in by_shard.items():
+        node = mapper.coord_for_shard(shard)
+        for off, rec in recs:
+            stores[node].get_shard("prom", shard).ingest([rec], off)
+
+    srv_b = FiloHttpServer()
+    planner_b = SingleClusterPlanner("prom", mapper, DatasetOptions(),
+                                     spread_default=1)
+    leaf_sched = QueryScheduler(num_workers=2, name="wl2-leaf")
+    srv_b.bind_dataset(DatasetBinding("prom", stores["node-b"], planner_b,
+                                      leaf_scheduler=leaf_sched))
+    port_b = srv_b.start()
+
+    endpoints = {"node-b": f"http://127.0.0.1:{port_b}"}
+    disp = dispatcher_factory(mapper, endpoints, local_node="node-a",
+                              dispatch_config={"retries": 1,
+                                               "backoff-s": 0.01})
+    planner_a = SingleClusterPlanner("prom", mapper, DatasetOptions(),
+                                     spread_default=1,
+                                     dispatcher_for_shard=disp)
+    srv_a = FiloHttpServer()
+    qsched = QueryScheduler(num_workers=2, name="wl2-query")
+    srv_a.bind_dataset(DatasetBinding("prom", stores["node-a"], planner_a,
+                                      scheduler=qsched))
+    port_a = srv_a.start()
+
+    # coordinator with node-b's shard routed at a dead port (nothing
+    # listens on it): the degradation / fail-loudly pair
+    dead_disp = dispatcher_factory(
+        mapper, {"node-b": "http://127.0.0.1:1"}, local_node="node-a",
+        dispatch_config={"retries": 1, "backoff-s": 0.01})
+    planner_dead = SingleClusterPlanner("prom", mapper, DatasetOptions(),
+                                        spread_default=1,
+                                        dispatcher_for_shard=dead_disp)
+    srv_a_dead = FiloHttpServer()
+    srv_a_dead.bind_dataset(DatasetBinding("prom", stores["node-a"],
+                                           planner_dead))
+    port_a_dead = srv_a_dead.start()
+
+    yield {"port_a": port_a, "port_b": port_b, "port_a_dead": port_a_dead,
+           "remote_shard": shards_b[0], "local_shard": shards_a[0],
+           "stores": stores, "srv_b": srv_b}
+    srv_a.shutdown()
+    srv_a_dead.shutdown()
+    srv_b.shutdown()
+    qsched.shutdown()
+    leaf_sched.shutdown()
+
+
+QUERY = 'sum(rate(wl2_total{_ws_="demo",_ns_="App-0"}[2m]))'
+
+
+def _query_range(port, **extra):
+    return _get(port, "/promql/prom/api/v1/query_range",
+                query=QUERY, start=(BASE + 600_000) / 1000,
+                end=(BASE + 1_200_000) / 1000, step="30s", **extra)
+
+
+def _leaf_payload(cluster, budget_ms):
+    """An /execplan wire dict for the REMOTE shard carrying an explicit
+    remaining budget."""
+    from filodb_tpu.core.filters import ColumnFilter, Equals
+    from filodb_tpu.query import wire
+    from filodb_tpu.query.exec import MultiSchemaPartitionsExec
+    plan = MultiSchemaPartitionsExec(
+        "prom", cluster["remote_shard"],
+        [ColumnFilter("_metric_", Equals("wl2_total"))],
+        BASE, BASE + 600_000)
+    payload = wire.serialize_plan(plan)
+    payload["qctx"]["budget_ms"] = budget_ms
+    return payload
+
+
+def _post_execplan(port, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/execplan",
+        data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestDeadlinePropagation:
+    def test_deadline_spans_nodes_end_to_end(self, cluster):
+        """A deadlined query fans out over both nodes and succeeds
+        while budget remains."""
+        code, body, _ = _query_range(cluster["port_a"], timeout="10s",
+                                     stats="true")
+        assert code == 200 and body["status"] == "success"
+        assert len(body["data"]["result"]) == 1
+        assert body["data"]["stats"]["samples"]["shardsDown"] == 0
+
+    def test_remote_budget_smaller_than_minted(self, cluster):
+        """The hop consumes budget: what the data node would receive is
+        strictly less than what the entry minted."""
+        from filodb_tpu.query import wire
+        from filodb_tpu.workload import deadline as wdl
+        qctx = wdl.mint(QueryContext(
+            submit_time_ms=int(time.time() * 1000), timeout_ms=5_000))
+        time.sleep(0.05)  # planning/queueing happens here in real life
+        enc = wire._enc_qctx(qctx)
+        assert enc["budget_ms"] < 5_000
+        assert enc["budget_ms"] > 0
+
+    def test_remote_refuses_sub_budget_work(self, cluster):
+        refused = REGISTRY.counter("filodb_query_deadline_refused_total")
+        before = refused.value()
+        code, out = _post_execplan(cluster["port_b"],
+                                   _leaf_payload(cluster, budget_ms=1))
+        assert code == 503
+        assert "refusing" in out["error"]
+        assert refused.value() == before + 1
+        # ample budget: the same work executes fine
+        code, out = _post_execplan(cluster["port_b"],
+                                   _leaf_payload(cluster, budget_ms=20_000))
+        assert code == 200 and out["batches"]
+
+    def test_dispatcher_surfaces_refusal_as_shard_unavailable(self, cluster):
+        from filodb_tpu.core.filters import ColumnFilter, Equals
+        from filodb_tpu.query.exec import ExecContext, \
+            MultiSchemaPartitionsExec
+        qctx = QueryContext(submit_time_ms=int(time.time() * 1000))
+        qctx.deadline_ms = int(time.time() * 1000) + 3  # ~nothing left
+        plan = MultiSchemaPartitionsExec(
+            "prom", cluster["remote_shard"],
+            [ColumnFilter("_metric_", Equals("wl2_total"))],
+            BASE, BASE + 600_000, query_context=qctx)
+        d = HttpPlanDispatcher(f"http://127.0.0.1:{cluster['port_b']}",
+                               max_retries=0)
+        with pytest.raises(Exception) as exc:
+            d.dispatch(plan, ExecContext(cluster["stores"]["node-a"],
+                                         qctx))
+        # either the node refused (503 -> ShardUnavailable) or the
+        # budget died in flight (DeadlineExceeded/timeout) — never a
+        # silent 60s hang, never execution
+        from filodb_tpu.query.model import QueryError
+        assert isinstance(exc.value, (ShardUnavailable, QueryError,
+                                      OSError))
+
+    def test_min_budget_runtime_adjustable(self, cluster):
+        code, body, _ = _get(cluster["port_b"], "/admin/config",
+                             **{"min-remote-budget-ms": "50"})
+        assert code == 200
+        assert body["data"]["workload"]["min-remote-budget-ms"] == 50
+        try:
+            code, out = _post_execplan(cluster["port_b"],
+                                       _leaf_payload(cluster,
+                                                     budget_ms=20))
+            assert code == 503  # under the raised floor
+        finally:
+            _get(cluster["port_b"], "/admin/config",
+                 **{"min-remote-budget-ms": "5"})
+
+
+class TestPartialResults:
+    def test_down_node_degrades_with_warning_and_header(self, cluster):
+        partial = REGISTRY.counter(
+            "filodb_query_partial_shard_results_total")
+        before = partial.value()
+        code, body, headers = _query_range(
+            cluster["port_a_dead"], allow_partial_results="true",
+            stats="true")
+        assert code == 200 and body["status"] == "success"
+        assert body["data"]["result"], \
+            "local shard's data must still be served"
+        assert any("unreachable" in w for w in body["warnings"])
+        assert headers.get("X-FiloDB-Partial-Data") == "true"
+        assert body["data"]["stats"]["samples"]["shardsDown"] == 1
+        assert partial.value() == before + 1
+
+    def test_without_opt_in_fails_loudly(self, cluster):
+        code, body, _ = _query_range(cluster["port_a_dead"])
+        assert code == 503
+        assert body["status"] == "error"
