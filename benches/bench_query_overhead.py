@@ -178,6 +178,49 @@ def main():
         log(f"FAIL: admission overhead {adm_overhead * 100:.2f}% exceeds "
             f"the 3% budget")
         return 1
+
+    # data-plane observability guard (ISSUE 6): the same query loop
+    # with the background data-plane services running hot — watermark
+    # sampling (which takes shard/meta reads and refreshes the tenant
+    # cardinality gauges each pass) and a self-scrape loop parsing the
+    # full exposition into a gateway publisher — vs without them.  The
+    # ingest-side churn notes are O(1) and off the query path; what
+    # could tax serving is the samplers' lock traffic and CPU, so the
+    # bench runs them far faster than production defaults (20 Hz / 10 Hz
+    # vs one sample per 10 s) and still demands the ≤3% / 0.5 ms budget.
+    from filodb_tpu.gateway.selfscrape import SelfScraper
+    from filodb_tpu.gateway.server import ShardingPublisher
+    from filodb_tpu.memstore.watermarks import (WatermarkLedger,
+                                                WatermarkSampler)
+    once()
+    med_off2, p90_off2 = measure()
+    ledger = WatermarkLedger(stall_window_s=3600.0, node="bench")
+    ledger.watch("prom", ms, mapper=mapper,
+                 end_offset_fn=lambda s: 10_000)
+    sampler = WatermarkSampler(ledger, interval_s=0.05)
+    pub = ShardingPublisher(DEFAULT_SCHEMAS["gauge"], ShardMapper(1),
+                            lambda s, c: None, spread=0)
+    scraper = SelfScraper(pub, interval_s=0.1,
+                          default_tags={"_ws_": "filodb", "_ns_": "bench"})
+    sampler.start()
+    scraper.start()
+    try:
+        once()
+        med_on2, p90_on2 = measure()
+    finally:
+        sampler.stop()
+        scraper.stop()
+    dp_overhead = (med_on2 - med_off2) / med_off2
+    log(f"dataplane off {med_off2 * 1e3:.2f} ms  "
+        f"on {med_on2 * 1e3:.2f} ms  overhead {dp_overhead * 100:+.2f}%")
+    emit("dataplane_overhead_median", dp_overhead * 100, "%",
+         off_ms=round(med_off2 * 1e3, 3), on_ms=round(med_on2 * 1e3, 3),
+         p90_off_ms=round(p90_off2 * 1e3, 3),
+         p90_on_ms=round(p90_on2 * 1e3, 3))
+    if dp_overhead > 0.03 and (med_on2 - med_off2) > 5e-4:
+        log(f"FAIL: data-plane instrumentation overhead "
+            f"{dp_overhead * 100:.2f}% exceeds the 3% budget")
+        return 1
     return 0
 
 

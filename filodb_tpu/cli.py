@@ -87,6 +87,48 @@ def cmd_timeseries_metadata(args) -> int:
     return 0
 
 
+def cmd_cardinality_report(args) -> int:
+    """Cardinality explorer (ISSUE 6): per-shard top-k label names x
+    values by active-series count, tenant breakdown, churn rates — the
+    online answer to the reference's offline cardinality-busting jobs
+    (served by /admin/cardinality)."""
+    body = _http_get(args.server, "/admin/cardinality",
+                     {"dataset": args.dataset, "topk": args.topk,
+                      "shard": args.shard})
+    if body.get("status") != "success":
+        print(json.dumps(body, indent=2))
+        return 1
+    data = body["data"]
+    if args.json:
+        print(json.dumps(data, indent=2))
+        return 0
+    print(f"dataset {data['dataset']}: "
+          f"{data['total_active_series']} active series, "
+          f"tenant label {data['tenant_label']!r}")
+    for tenant, n in sorted(data.get("tenants", {}).items(),
+                            key=lambda kv: -kv[1]):
+        print(f"  tenant {tenant or '(untagged)'}: {n}")
+    for row in data.get("shards", []):
+        ch = row.get("churn", {})
+        print(f"shard {row['shard']}: {row['active_series']} series, "
+              f"{row['labels']} labels "
+              f"(+{ch.get('created_total', 0)}/-{ch.get('removed_total', 0)}"
+              f" churned, {ch.get('create_rate_per_s', 0)}/s create)")
+        for lab in row.get("top_labels", []):
+            print(f"  {lab['label']}: {lab['values']} values / "
+                  f"{lab['series']} series")
+            for v in lab.get("top_values", [])[:args.topk]:
+                print(f"    {v['value']!r}: {v['series']}")
+    return 0
+
+
+def cmd_shards(args) -> int:
+    """Ingest watermark / shard-health tree (served by /admin/shards)."""
+    body = _http_get(args.server, "/admin/shards")
+    print(json.dumps(body, indent=2))
+    return 0 if body.get("status") == "success" else 1
+
+
 def cmd_status(args) -> int:
     body = _http_get(args.server, f"/api/v1/cluster/{args.dataset}/status")
     if body.get("status") != "success":
@@ -221,6 +263,22 @@ def build_parser() -> argparse.ArgumentParser:
     st = sub.add_parser("status", help="shard statuses")
     server_args(st)
     st.set_defaults(fn=cmd_status)
+
+    cd = sub.add_parser("cardinality-report",
+                        help="top-k label/value cardinality + tenant "
+                             "breakdown + churn per shard")
+    server_args(cd)
+    cd.add_argument("--topk", type=int, default=10)
+    cd.add_argument("--shard", type=int, default=None)
+    cd.add_argument("--json", action="store_true",
+                    help="raw JSON instead of the text summary")
+    cd.set_defaults(fn=cmd_cardinality_report)
+
+    sh = sub.add_parser("shards",
+                        help="ingest watermark chain / lag / shard "
+                             "health tree")
+    server_args(sh)
+    sh.set_defaults(fn=cmd_shards)
 
     cm = sub.add_parser("chunkmeta",
                         help="chunk-level metadata for matching series")

@@ -188,7 +188,7 @@ class ShardManager:
             if name in self._datasets:
                 return self._datasets[name]
             info = DatasetInfo(name, num_shards, min_num_nodes,
-                               ShardMapper(num_shards))
+                               ShardMapper(num_shards, dataset=name))
             self._datasets[name] = info
             for node in self._nodes:
                 self._assign(node, info)

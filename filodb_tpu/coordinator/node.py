@@ -203,6 +203,9 @@ class IngestionCoordinator:
                 flush_sched = FlushScheduler(
                     sh, sh.config.flush_interval_ms,
                     parallelism=sh.config.flush_task_parallelism)
+                # expose the live pipeline to the watermark ledger
+                # (/admin/shards flush-queue depth/age, ISSUE 6)
+                sh.flush_scheduler = flush_sched
             n_since_report = 0
             # the loop runs until the stream ends: a finite source drains,
             # a live queue delivers the teardown sentinel.  No early exit —
@@ -249,6 +252,8 @@ class IngestionCoordinator:
                     flush_sched.close(flush_remaining=False)
                 except Exception:  # noqa: BLE001 — never mask the cause
                     traceback.print_exc()
+                finally:
+                    flush_sched.shard.flush_scheduler = None
             self._cleanup(shard)
 
     def flush_loop(self, shard: int, stop: threading.Event,

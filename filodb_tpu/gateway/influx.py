@@ -198,6 +198,31 @@ def parse_lines(text: str) -> Iterator[InfluxRecord]:
 _TRUE = ("t", "T", "true", "True")
 _FALSE = ("f", "F", "false", "False")
 
+# shared bound for the gateway's per-series memos (head parse, series
+# routing); one module-level constant so tests can shrink it
+HEAD_MEMO_MAX = 200_000
+
+
+def evict_memo_half(memo: dict) -> None:
+    """Drop the least-recently-used ~half of a memo dict.
+
+    The old behavior (``memo.clear()`` on overflow) meant one label
+    flood wiped every steady series' cached head parse at once — the
+    next batch re-parsed the WHOLE fleet's heads in one stampede.
+    Every memo HIT re-inserts its entry (``pop`` + set at the call
+    sites), so dict order is recency order, not insertion order: a
+    flood of one-shot heads sits in the old half and is what gets
+    dropped, while the steady fleet — touched every batch — survives.
+
+    Concurrency-tolerant: gateway connection threads share these memos
+    without a lock, so the key snapshot is ONE ``list(memo)`` (atomic
+    under the GIL — never the incremental iteration that raises
+    RuntimeError on a concurrent insert) and deletes use ``pop`` with a
+    default (a key another thread already evicted is not an error)."""
+    keys = list(memo)
+    for k in keys[:len(keys) // 2]:
+        memo.pop(k, None)
+
 
 _HASH_POWS = None
 
@@ -501,11 +526,14 @@ def parse_lines_fast(text: str, head_memo: Optional[dict] = None,
         uheads, inv, ufn, finv, values, ts_ms = cols
         parsed = []
         for h in uheads:
-            got = memo.get(h)
+            # pop + re-insert on hit: keeps dict order = recency order,
+            # so overflow eviction drops flood garbage, not the fleet
+            got = memo.pop(h, None)
             if got is None:
-                if len(memo) > 200_000:
-                    memo.clear()
-                got = memo[h] = parse_head(h)
+                if len(memo) >= HEAD_MEMO_MAX:
+                    evict_memo_half(memo)
+                got = parse_head(h)
+            memo[h] = got
             parsed.append(got)
         return [InfluxRecord(parsed[hi][0], dict(parsed[hi][1]),
                              {ufn[fi]: float(v)}, int(t))
@@ -522,11 +550,12 @@ def parse_lines_fast(text: str, head_memo: Optional[dict] = None,
         if sp < 0:
             raise InfluxParseError(f"no fields in line: {line!r}")
         head = line[:sp]
-        got = memo.get(head)
+        got = memo.pop(head, None)  # pop+set on hit: recency order
         if got is None:
-            if len(memo) > 200_000:      # bound churn from label floods
-                memo.clear()
-            got = memo[head] = parse_head(head)
+            if len(memo) >= HEAD_MEMO_MAX:  # bound churn from label floods
+                evict_memo_half(memo)
+            got = parse_head(head)
+        memo[head] = got
         measurement, tags = got
         rest = line[sp + 1:]
         sp2 = rest.find(" ")

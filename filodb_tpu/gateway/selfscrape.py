@@ -1,0 +1,157 @@
+"""Self-telemetry: the node scrapes its own /metrics into a dataset.
+
+The third pillar of the data-plane observability layer (ISSUE 6): every
+``interval_s`` the node parses its own Prometheus exposition
+(``REGISTRY.expose_text`` — byte-identical to what ``GET /metrics``
+serves) and publishes each sample through the EXISTING gateway ingest
+path (``ShardingPublisher.add_sample`` -> record containers -> the
+dataset's ingest stream), landing in a Prometheus-schema dataset
+(default ``_system``).  Operators then ask node-health questions in
+plain PromQL through the normal query path::
+
+    rate(filodb_selfscrape_samples_total{_ws_="filodb"}[1m])
+    filodb_ingest_lag_rows{dataset="prom"}
+
+This is the dogfooding substrate recording rules (ROADMAP 3) and HA
+health routing (ROADMAP 4) will evaluate against — a queryable stream
+of the node's own metrics, not just a scrape endpoint.
+
+The parser handles the exposition grammar our registry emits (and
+Prometheus' escaping rules: ``\\\\``, ``\\"``, ``\\n`` in label
+values); non-finite samples are skipped (a NaN/Inf gauge has no sample
+representation worth storing).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Callable, Iterator, Mapping, Optional
+
+_METRICS = None
+
+
+def _m() -> dict:
+    global _METRICS
+    if _METRICS is None:
+        from filodb_tpu.utils.observability import selfscrape_metrics
+        _METRICS = selfscrape_metrics()
+    return _METRICS
+
+
+def _parse_value(tok: str) -> float:
+    if tok == "+Inf":
+        return math.inf
+    if tok == "-Inf":
+        return -math.inf
+    return float(tok)  # float() accepts "NaN"
+
+
+def _parse_labels(text: str) -> dict[str, str]:
+    """``k="v",k2="v2"`` with Prometheus escaping inside the quotes."""
+    labels: dict[str, str] = {}
+    i = 0
+    n = len(text)
+    while i < n:
+        eq = text.index("=", i)
+        key = text[i:eq].strip().lstrip(",").strip()
+        i = eq + 1
+        if i >= n or text[i] != '"':
+            raise ValueError(f"unquoted label value in {text!r}")
+        i += 1
+        out = []
+        while i < n:
+            c = text[i]
+            if c == "\\" and i + 1 < n:
+                nxt = text[i + 1]
+                out.append({"n": "\n"}.get(nxt, nxt))
+                i += 2
+                continue
+            if c == '"':
+                break
+            out.append(c)
+            i += 1
+        labels[key] = "".join(out)
+        i += 1  # past the closing quote
+    return labels
+
+
+def parse_exposition(text: str) -> Iterator[tuple[str, dict, float]]:
+    """Prometheus text exposition -> ``(name, labels, value)`` samples.
+    Comment/TYPE/HELP lines are skipped; malformed lines raise (the
+    scraper counts and drops the pass — our own exposition is tested
+    against the grammar, so a parse failure is a bug worth seeing)."""
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        brace = line.find("{")
+        sp = line.find(" ")
+        if 0 <= brace < sp or (brace >= 0 and sp < 0):
+            close = line.rindex("}")
+            name = line[:brace]
+            labels = _parse_labels(line[brace + 1:close]) \
+                if close > brace + 1 else {}
+            rest = line[close + 1:].strip()
+        else:
+            name = line[:sp]
+            labels = {}
+            rest = line[sp + 1:].strip()
+        value = _parse_value(rest.split()[0])
+        yield name, labels, value
+
+
+class SelfScraper:
+    """Background scrape loop: exposition -> gateway publisher.
+
+    ``default_tags`` ride every sample (shard-key columns so PromQL can
+    select the node's telemetry: ``_ws_="filodb"``, ``_ns_=<node>``,
+    ``instance=<node>`` by convention); exposition labels win on
+    collision so metric semantics (e.g. ``dataset=``) survive."""
+
+    def __init__(self, publisher, interval_s: float = 10.0,
+                 expose_fn: Optional[Callable[[], str]] = None,
+                 default_tags: Optional[Mapping[str, str]] = None):
+        if expose_fn is None:
+            from filodb_tpu.utils.observability import REGISTRY
+            expose_fn = REGISTRY.expose_text
+        from filodb_tpu.utils.observability import PeriodicThread
+        self.publisher = publisher
+        self.interval_s = float(interval_s)
+        self.expose_fn = expose_fn
+        self.default_tags = dict(default_tags or {})
+        self._loop = PeriodicThread(self.scrape_once, self.interval_s,
+                                    "self-scrape")
+
+    def scrape_once(self) -> int:
+        """One pass: parse the exposition, publish every finite sample
+        at 'now', flush the containers.  Returns samples published."""
+        m = _m()
+        t0 = time.perf_counter()
+        now_ms = int(time.time() * 1000)
+        n = 0
+        try:
+            text = self.expose_fn()
+            for name, labels, value in parse_exposition(text):
+                if not math.isfinite(value):
+                    continue
+                tags = dict(self.default_tags)
+                tags.update(labels)
+                self.publisher.add_sample(name, tags, now_ms, value)
+                n += 1
+            self.publisher.flush()
+        except Exception:  # noqa: BLE001 — telemetry never kills the node
+            m["errors"].inc()
+            raise
+        finally:
+            m["duration"].set(time.perf_counter() - t0)
+        m["scrapes"].inc()
+        if n:
+            m["samples"].inc(n)
+        return n
+
+    def start(self) -> None:
+        self._loop.start()
+
+    def stop(self) -> None:
+        self._loop.stop()

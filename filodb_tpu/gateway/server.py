@@ -161,6 +161,7 @@ class ShardingPublisher:
 
         from filodb_tpu.core.record import record_dtype
         from filodb_tpu.core.schemas import ColumnType
+        from filodb_tpu.gateway import influx as influx_mod
         from filodb_tpu.gateway.influx import parse_head, prom_metric_name
         uheads, inv, ufn, finv, values, ts_ms = cols
         # steady-state: the parser's memo returns the SAME inv/finv
@@ -192,7 +193,8 @@ class ShardingPublisher:
         for gi in range(ngroups):
             r0 = int(order[gstarts[gi]])
             key = (uheads[int(inv[r0])], ufn[int(finv[r0])])
-            got = self._series_memo.get(key)
+            # pop + re-insert below keeps memo order = recency order
+            got = self._series_memo.pop(key, None)
             if got is None:
                 try:
                     measurement, tags = parse_head(key[0])
@@ -200,8 +202,11 @@ class ShardingPublisher:
                     good[gi] = False
                     bad += int(gends[gi] - gstarts[gi])
                     continue
-                if len(self._series_memo) > 200_000:
-                    self._series_memo.clear()
+                if len(self._series_memo) >= influx_mod.HEAD_MEMO_MAX:
+                    # evict the LRU half, never the whole memo: a label
+                    # flood must not force a full re-resolve stampede
+                    # of the steady fleet (ISSUE 6 satellite)
+                    influx_mod.evict_memo_half(self._series_memo)
                 metric = prom_metric_name(measurement, key[1])
                 norm = dict(tags)
                 norm[self.options.metric_column] = metric
@@ -223,8 +228,8 @@ class ShardingPublisher:
                 phash = partition_hash(norm, self.options)
                 shard = self.mapper.ingestion_shard(
                     shash, phash, self.spread) % self.mapper.num_shards
-                got = self._series_memo[key] = (
-                    shard, shash, phash, canonical_partkey(norm))
+                got = (shard, shash, phash, canonical_partkey(norm))
+            self._series_memo[key] = got
             shard_g[gi], shash_g[gi], phash_g[gi], pk_g[gi] = got
         data_cols = self.schema.data.columns[1:]
         if len(data_cols) != 1 or data_cols[0].ctype != ColumnType.DOUBLE:

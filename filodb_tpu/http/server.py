@@ -114,9 +114,14 @@ class FiloHttpServer:
     # a remote /execplan arriving with less deadline budget than this
     # cannot plausibly finish — refuse it outright (workload/deadline.py)
     min_remote_budget_ms: int = wdl.MIN_REMOTE_BUDGET_MS
+    # ingest watermark ledger backing /admin/shards (ISSUE 6); the
+    # standalone server installs a configured one (broker end offsets,
+    # stall window), bare servers get a lazy default over their bindings
+    watermarks: Optional[object] = None
     datasets: dict = field(default_factory=dict)
     _httpd: Optional[ThreadingHTTPServer] = None
     _thread: Optional[threading.Thread] = None
+    _wm_lock: threading.Lock = field(default_factory=threading.Lock)
 
     def bind_dataset(self, binding: DatasetBinding) -> None:
         self.datasets[binding.dataset] = binding
@@ -483,6 +488,12 @@ class FiloHttpServer:
         if len(parts) == 2 and parts[0] == "admin" \
                 and parts[1] == "workload":
             return self._workload()
+        if len(parts) == 2 and parts[0] == "admin" \
+                and parts[1] == "cardinality":
+            return self._cardinality(params)
+        if len(parts) == 2 and parts[0] == "admin" \
+                and parts[1] == "shards":
+            return self._shards(params)
         if len(parts) == 3 and parts[0] == "admin" and parts[1] == "traces":
             return self._traces(parts[2])
         if len(parts) == 2 and parts[0] == "debug" \
@@ -620,6 +631,14 @@ class FiloHttpServer:
                         default_limit=int(p["quota-default-max-series"]))
         if "min-remote-budget-ms" in p:
             self.min_remote_budget_ms = int(p["min-remote-budget-ms"])
+        # data-plane knob (ISSUE 6): how long a lagging shard's ingested
+        # offset may sit still before an ingest.stall event fires
+        if "ingest-stall-window-s" in p:
+            window = float(p["ingest-stall-window-s"])
+            if window <= 0:
+                return 400, error_response(
+                    "bad_data", "ingest-stall-window-s must be > 0")
+            self._ensure_watermarks().stall_window_s = window
         stores: dict = {}
         for ds, b in self.datasets.items():
             shards = b.memstore.shards(ds)
@@ -642,6 +661,10 @@ class FiloHttpServer:
             "datasets": stores,
             "workload": {"min-remote-budget-ms": self.min_remote_budget_ms,
                          "datasets": workload},
+            "dataplane": {
+                "ingest-stall-window-s":
+                    self._ensure_watermarks().stall_window_s,
+            },
             "observability": {
                 "slow-query-threshold-s": TRACE_STORE.slow_threshold_s,
                 "jit-storm-shapes":
@@ -673,6 +696,69 @@ class FiloHttpServer:
         return 200, {"status": "success", "data": {
             "min_remote_budget_ms": self.min_remote_budget_ms,
             "datasets": out}}
+
+    # ------------------------------------------------- data-plane routes
+
+    @_timed("cardinality")
+    def _cardinality(self, p: dict) -> tuple[int, dict]:
+        """The cardinality explorer (ISSUE 6): per-shard top-k label
+        names x values by active-series count, per-tenant breakdown,
+        and churn rates — every number derived from one atomic index
+        snapshot per shard, so totals reconcile exactly with a full
+        index walk even under concurrent create/evict/purge
+        (doc/observability.md)."""
+        from filodb_tpu.memstore.cardinality import build_report
+        ds = p.get("dataset")
+        if ds is None and len(self.datasets) == 1:
+            ds = next(iter(self.datasets))
+        binding = self.datasets.get(ds)
+        if binding is None:
+            return 404, error_response("bad_data",
+                                       f"unknown dataset {ds}")
+        topk = max(1, min(int(p.get("topk", 10)), 100))
+        shard_num = int(p["shard"]) if "shard" in p else None
+        tenant_label = binding.quota.tenant_label \
+            if binding.quota is not None else "_ns_"
+        report = build_report(ds, binding.memstore.shards(ds), topk=topk,
+                              tenant_label=tenant_label,
+                              shard_num=shard_num)
+        return 200, {"status": "success", "data": report}
+
+    @_timed("shards")
+    def _shards(self, p: dict) -> tuple[int, dict]:
+        """The ingest-plane health tree (ISSUE 6): per-shard watermark
+        chain (broker_end -> ingested -> flushed -> checkpoint), lag in
+        rows/seconds, flush-queue depth/age, mapper status + recovery
+        progress, and stall flags.  Sampling here also advances stall
+        detection, so polling the endpoint IS monitoring."""
+        return 200, {"status": "success",
+                     "data": self._ensure_watermarks().sample()}
+
+    def _ensure_watermarks(self):
+        """Lazy default ledger over the bound datasets (bare servers in
+        tests); the standalone server installs a configured one before
+        start().  Locked: two concurrent first requests must not each
+        build a ledger and silently discard one's stall state."""
+        with self._wm_lock:
+            if self.watermarks is None:
+                from filodb_tpu.memstore.watermarks import WatermarkLedger
+                self.watermarks = WatermarkLedger(node=self.node_name or "")
+            # sync datasets bound AFTER the ledger was built — without
+            # touching already-configured watches (the standalone ledger
+            # carries broker end-offset sources a re-watch would lose)
+            wm = self.watermarks
+            watched = set(wm.watching())
+            for ds, b in self.datasets.items():
+                if ds in watched:
+                    continue
+                mapper = None
+                if self.shard_manager is not None:
+                    try:
+                        mapper = self.shard_manager.mapper(ds)
+                    except KeyError:
+                        mapper = None
+                wm.watch(ds, b.memstore, mapper=mapper)
+            return wm
 
     @_timed("integrity")
     def _integrity(self) -> tuple[int, dict]:

@@ -96,6 +96,12 @@ class QueueStream(IngestionStream):
         with self._lock:
             self._next_offset = max(self._next_offset, offset)
 
+    def end_offset(self) -> int:
+        """The next offset to be assigned — the broker ``end_offset``
+        analog the watermark ledger reads for lag (ISSUE 6)."""
+        with self._lock:
+            return self._next_offset
+
     def close(self) -> None:
         """Wake the current consumer.  Idempotent until delivered: closing
         twice before a consumer sees the sentinel enqueues it once, so a

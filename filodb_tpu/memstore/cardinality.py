@@ -1,0 +1,259 @@
+"""Cardinality explorer: who owns the series, and how fast they churn.
+
+The reference answers "which label is blowing up my index" with offline
+cardinality-busting jobs walking the Lucene index (reference:
+spark-jobs cardinality busting; CardinalityManager reading counts off
+PartKeyLuceneIndex).  Here the part-key index already maintains
+per-value alive refcounts (:meth:`PartKeyIndex.cardinality_snapshot`),
+so the explorer is an O(values) read, not a document walk:
+
+- :class:`CardinalityTracker` rides each shard: churn counters + EWMA
+  creation/removal rates noted at part-id assignment and evict/purge,
+  plus ``set_fn``-sampled active-series/label gauges
+  (``filodb_index_cardinality_*`` / ``filodb_index_churn_*``);
+- :func:`build_report` assembles the ``/admin/cardinality`` payload —
+  per-shard top-k label names x values by active-series count and the
+  per-tenant breakdown — from one atomic index snapshot per shard, so
+  the report's totals reconcile exactly with a full index walk even
+  under concurrent create/evict/purge (asserted in
+  tests/test_dataplane.py, the PR 9 ledger-style guarantee).
+
+The per-tenant breakdown follows SeriesQuota semantics
+(workload/quota.py): tenant = value of the tenant label (default
+``_ns_``), series lacking the label pool under ``""``.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+import weakref
+from typing import Optional, Sequence
+
+_METRICS = None
+
+
+def _m() -> dict:
+    global _METRICS
+    if _METRICS is None:
+        from filodb_tpu.utils.observability import index_metrics
+        _METRICS = index_metrics()
+    return _METRICS
+
+
+class Ewma:
+    """Exponentially-decayed event-rate estimator (events/second).
+
+    ``note(n)`` adds n events; ``rate()`` reads the decayed rate.  For a
+    steady stream of r events/s the estimate converges to r with a half
+    life of ``halflife_s`` — the cheap, lock-tiny churn signal the
+    explorer exports (a counter alone cannot alert on "creation rate
+    spiked" without server-side rate())."""
+
+    __slots__ = ("_tau", "_rate", "_t", "_lock")
+
+    def __init__(self, halflife_s: float = 60.0):
+        self._tau = max(halflife_s, 1e-3) / math.log(2)
+        self._rate = 0.0
+        self._t = time.monotonic()
+        self._lock = threading.Lock()
+
+    def _decay_locked(self, now: float) -> None:
+        dt = now - self._t
+        if dt > 0:
+            self._rate *= math.exp(-dt / self._tau)
+            self._t = now
+
+    def note(self, n: float = 1.0, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._decay_locked(now)
+            self._rate += n / self._tau
+
+    def rate(self, now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._decay_locked(now)
+            return self._rate
+
+
+class CardinalityTracker:
+    """Per-shard churn accounting + cardinality gauges.
+
+    The shard calls :meth:`note_created` right after assigning a NEW
+    part id and :meth:`note_removed` on evict/purge; both are O(1)
+    (one counter inc + one EWMA note).  Gauges are ``set_fn``-sampled
+    at scrape time through a weakref to the index, so a dropped shard
+    stops exporting instead of pinning the index alive."""
+
+    def __init__(self, dataset: str, shard_num: int,
+                 churn_halflife_s: float = 60.0):
+        self.dataset = dataset
+        self.shard_num = shard_num
+        self.created_total = 0
+        self.removed_total = 0
+        self.create_ewma = Ewma(churn_halflife_s)
+        self.remove_ewma = Ewma(churn_halflife_s)
+        self._index_ref = None
+        m = _m()
+        labels = {"dataset": dataset, "shard": shard_num}
+        m["create_rate"].set_fn(self.create_ewma.rate, **labels)
+        m["remove_rate"].set_fn(self.remove_ewma.rate, **labels)
+
+    def attach_index(self, index) -> None:
+        """Register the set_fn cardinality gauges against this index."""
+        self._index_ref = weakref.ref(index)
+        ref = self._index_ref
+        m = _m()
+        labels = {"dataset": self.dataset, "shard": self.shard_num}
+        m["active_series"].set_fn(
+            lambda: float(idx.active_series_count())
+            if (idx := ref()) is not None else 0.0, **labels)
+        m["labels"].set_fn(
+            lambda: float(len(idx.label_names()))
+            if (idx := ref()) is not None else 0.0, **labels)
+
+    def close(self) -> None:
+        """Deregister every gauge this tracker owns (the Gauge.remove
+        contract: set_fn registrants must remove on teardown or the
+        registry exports dead-instance rows and pins the callbacks
+        forever).  Driven by TimeSeriesMemStore.reset(); a tracker
+        recreated under the same (dataset, shard) key simply replaces
+        these registrations, so double-close/re-register is safe."""
+        m = _m()
+        labels = {"dataset": self.dataset, "shard": self.shard_num}
+        for name in ("create_rate", "remove_rate", "active_series",
+                     "labels"):
+            m[name].remove(**labels)
+
+    # ------------------------------------------------------------- churn
+
+    def note_created(self, n: int = 1) -> None:
+        self.created_total += n
+        self.create_ewma.note(n)
+        _m()["created"].inc(n, dataset=self.dataset, shard=self.shard_num)
+
+    def note_removed(self, reason: str, n: int = 1) -> None:
+        self.removed_total += n
+        self.remove_ewma.note(n)
+        _m()["removed"].inc(n, dataset=self.dataset, shard=self.shard_num,
+                            reason=reason)
+
+    def churn(self) -> dict:
+        return {
+            "created_total": self.created_total,
+            "removed_total": self.removed_total,
+            "create_rate_per_s": round(self.create_ewma.rate(), 6),
+            "remove_rate_per_s": round(self.remove_ewma.rate(), 6),
+        }
+
+
+# tenants whose gauge rows are currently exported, per dataset: a
+# tenant whose series all evicted must have its row REMOVED, or
+# /metrics keeps reporting its last nonzero count forever
+_EXPORTED_TENANTS: dict[str, set] = {}
+_EXPORT_LOCK = threading.Lock()
+
+
+def _set_tenant_gauges(dataset: str, merged: dict[str, int]) -> None:
+    # set + stale-compute + remove all under ONE lock: the watermark
+    # sampler and an inline /admin/cardinality refresh run concurrently,
+    # and an unsynchronized interleaving could remove a row the other
+    # pass just set — or record an exported-set that never contained a
+    # now-dead tenant, leaking its last count forever
+    gauge = _m()["tenant_series"]
+    with _EXPORT_LOCK:
+        for tenant, n in merged.items():
+            gauge.set(n, dataset=dataset, tenant=tenant)
+        stale = _EXPORTED_TENANTS.get(dataset, set()) - set(merged)
+        _EXPORTED_TENANTS[dataset] = set(merged)
+        for tenant in stale:
+            gauge.remove(dataset=dataset, tenant=tenant)
+
+
+def sample_tenant_gauges(dataset: str, shards: Sequence,
+                         tenant_label: str = "_ns_") -> dict[str, int]:
+    """Refresh ``filodb_index_cardinality_tenant_series`` from the
+    shards' per-value refcounts and return the merged per-tenant counts
+    (the watermark sampler drives this periodically; /admin/cardinality
+    recomputes inline).  Uses ``value_counts(tenant_label)`` — O(tenant
+    values) under the index lock — NOT the full snapshot: a sampler
+    pass must never copy a million-series index's whole label map."""
+    merged: dict[str, int] = {}
+    for sh in shards:
+        vc = sh.index.value_counts(tenant_label)
+        for tenant, n in vc.items():
+            merged[tenant] = merged.get(tenant, 0) + n
+        untagged = sh.index.active_series_count() - sum(vc.values())
+        if untagged > 0:
+            merged[""] = merged.get("", 0) + untagged
+    _set_tenant_gauges(dataset, merged)
+    return merged
+
+
+def _shard_report(sh, topk: int, tenant_label: str) -> tuple[dict, dict]:
+    """One shard's explorer row + its per-tenant counts, all derived
+    from a SINGLE atomic index snapshot (mutual consistency under
+    concurrent churn)."""
+    active, labels = sh.index.cardinality_snapshot()
+    rows = []
+    for name, vc in labels.items():
+        rows.append({"label": name, "values": len(vc),
+                     "series": sum(vc.values())})
+    # labels ranked by distinct-value count — the axis that blows up an
+    # index — then by covered series
+    rows.sort(key=lambda r: (-r["values"], -r["series"], r["label"]))
+    top_labels = []
+    for r in rows[:topk]:
+        vc = labels[r["label"]]
+        top_values = sorted(vc.items(), key=lambda kv: (-kv[1], kv[0]))
+        r["top_values"] = [{"value": v, "series": n}
+                           for v, n in top_values[:topk]]
+        top_labels.append(r)
+    tvc = labels.get(tenant_label, {})
+    tenants = dict(tvc)
+    untagged = active - sum(tvc.values())
+    if untagged > 0:
+        tenants[""] = tenants.get("", 0) + untagged
+    tracker = getattr(sh, "cardinality", None)
+    row = {
+        "shard": sh.shard_num,
+        "active_series": active,
+        "labels": len(labels),
+        "top_labels": top_labels,
+        "tenants": tenants,
+        "churn": tracker.churn() if tracker is not None else {},
+    }
+    return row, tenants
+
+
+def build_report(dataset: str, shards: Sequence, topk: int = 10,
+                 tenant_label: str = "_ns_",
+                 shard_num: Optional[int] = None) -> dict:
+    """The ``/admin/cardinality`` payload (also the ``cardinality-report``
+    CLI verb's body).  ``total_active_series`` is the sum of per-shard
+    atomic snapshots; per-tenant counts merge the same snapshots, so
+    ``sum(tenants.values()) == total_active_series`` holds exactly."""
+    rows = []
+    tenants: dict[str, int] = {}
+    for sh in shards:
+        if shard_num is not None and sh.shard_num != shard_num:
+            continue
+        row, sh_tenants = _shard_report(sh, topk, tenant_label)
+        rows.append(row)
+        for t, n in sh_tenants.items():
+            tenants[t] = tenants.get(t, 0) + n
+    if shard_num is None:
+        # refresh the tenant gauges from the SAME numbers the report
+        # shows — but only for FULL reports: a shard-filtered view must
+        # not clobber the fleet-wide counts on /metrics
+        _set_tenant_gauges(dataset, tenants)
+    return {
+        "dataset": dataset,
+        "tenant_label": tenant_label,
+        "topk": topk,
+        "total_active_series": sum(r["active_series"] for r in rows),
+        "tenants": tenants,
+        "shards": rows,
+    }

@@ -21,8 +21,19 @@ different groups flush in parallel.
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Optional
+
+_METRICS = None
+
+
+def _m() -> dict:
+    global _METRICS
+    if _METRICS is None:
+        from filodb_tpu.utils.observability import flush_metrics
+        _METRICS = flush_metrics()
+    return _METRICS
 
 
 class FlushScheduler:
@@ -31,6 +42,12 @@ class FlushScheduler:
     ``note_ingested()`` is called from the ingest thread after each
     container; it is O(1) when no boundary was crossed.  ``close()``
     drains all in-flight flush tasks.
+
+    Observability (ISSUE 6 satellite): per-group pending-task depth and
+    last-flush age are tracked here and exported as
+    ``filodb_flush_queue_depth`` / ``filodb_flush_last_age_seconds``
+    (set_fn-sampled; deregistered on close so dead schedulers leave no
+    rows) plus a per-group :meth:`snapshot` for ``/admin/shards``.
     """
 
     def __init__(self, shard, flush_interval_ms: Optional[int] = None,
@@ -51,6 +68,60 @@ class FlushScheduler:
         self._lock = threading.Lock()
         self.flushes_submitted = 0
         self._closed = False
+        # submitted-but-not-completed per group + completion stamps
+        self._pending = [0] * ngroups
+        self._last_done: list[Optional[float]] = [None] * ngroups
+        self._started_s = time.monotonic()
+        self._labels = {"dataset": shard.dataset, "shard": shard.shard_num}
+        m = _m()
+        m["queue_depth"].set_fn(lambda: float(self.queue_depth()),
+                                **self._labels)
+        m["last_age"].set_fn(self.last_flush_age_s, **self._labels)
+
+    # ------------------------------------------------------- observability
+
+    def queue_depth(self) -> int:
+        """Flush tasks submitted but not yet completed, all groups."""
+        with self._lock:
+            return sum(self._pending)
+
+    def last_flush_age_s(self) -> float:
+        """Seconds since the most recent completed flush on ANY group
+        (age since scheduler start when nothing completed yet)."""
+        with self._lock:
+            done = [t for t in self._last_done if t is not None]
+            anchor = max(done) if done else self._started_s
+        return max(0.0, time.monotonic() - anchor)
+
+    def snapshot(self) -> dict:
+        """Per-group pipeline state for the /admin/shards health tree."""
+        now = time.monotonic()
+        with self._lock:
+            groups = [
+                {"group": g, "pending": self._pending[g],
+                 "last_flush_age_s":
+                     round(now - self._last_done[g], 3)
+                     if self._last_done[g] is not None else None}
+                for g in range(self.shard.num_groups)]
+            submitted = self.flushes_submitted
+            pending = sum(self._pending)
+        return {"pending": pending, "flushes_submitted": submitted,
+                "groups": groups}
+
+    def _track(self, group: int, fut: Future) -> None:
+        """Count a submitted task until its future resolves.  Caller
+        must NOT hold ``_lock``: a fast (or inline) future runs the done
+        callback synchronously from ``add_done_callback``, which takes
+        the lock again."""
+        with self._lock:
+            self._pending[group] += 1
+
+        def done(_f, _g=group):
+            with self._lock:
+                self._pending[_g] -= 1
+                self._last_done[_g] = time.monotonic()
+
+        fut.add_done_callback(done)
 
     def _boundary_after(self, t: int, group: int) -> int:
         ph = self._phase[group]
@@ -94,6 +165,7 @@ class FlushScheduler:
         def run(_prev: Optional[Future]) -> int:
             return self.shard.run_flush_task(task)
 
+        fut: Optional[Future] = None
         with self._lock:
             if not self._closed:
                 try:
@@ -104,7 +176,7 @@ class FlushScheduler:
                         # chain: group tasks run in submission order even
                         # when the pool has spare workers (checkpoint
                         # monotonicity)
-                        fut: Future = Future()
+                        fut = Future()
 
                         def after(p, _task=task, _fut=fut):
                             try:
@@ -117,9 +189,11 @@ class FlushScheduler:
                             lambda p: self._exec.submit(after, p))
                     self._chains[group] = fut
                     self.flushes_submitted += 1
-                    return fut
                 except RuntimeError:
-                    pass  # executor shut down between check and submit
+                    fut = None  # executor shut down between check and submit
+        if fut is not None:
+            self._track(group, fut)
+            return fut
         # closed (or shut down) after prepare irreversibly detached the
         # buffers: run inline, outside the lock, so the snapshot is never
         # lost; the flush succeeded, so report it as such
@@ -127,6 +201,7 @@ class FlushScheduler:
         fut.set_result(self.shard.run_flush_task(task))
         with self._lock:
             self.flushes_submitted += 1
+        self._track(group, fut)
         return fut
 
     def drain(self) -> None:
@@ -152,3 +227,8 @@ class FlushScheduler:
             with self._lock:
                 self._closed = True
             self._exec.shutdown(wait=True)
+            # deregister the sampled gauges: a retired scheduler must not
+            # keep exporting rows (or keep the shard alive via set_fn)
+            m = _m()
+            m["queue_depth"].remove(**self._labels)
+            m["last_age"].remove(**self._labels)

@@ -619,6 +619,23 @@ class PartKeyIndex:
         counts off the part-key index)."""
         return len(self._tags)
 
+    def cardinality_snapshot(self) -> tuple[int, dict[str, dict[str, int]]]:
+        """``(active_series, {label: {value: alive_count}})`` taken in
+        ONE lock acquisition (pending label writes drained first), so
+        every number in the snapshot is mutually consistent even while
+        concurrent create/evict/purge churn the index — the
+        reconciliation guarantee /admin/cardinality is built on
+        (reference: the offline cardinality-buster jobs walk the Lucene
+        index; here the per-value alive refcounts ARE that walk)."""
+        with self._lock:
+            self._drain_pending_locked()
+            labels = {}
+            for k, lab in self._labels.items():
+                d = {v: n for v, n in lab.vcount.items() if n > 0}
+                if d:
+                    labels[k] = d
+            return len(self._tags), labels
+
     def value_counts(self, label: str) -> dict[str, int]:
         """Alive-series count per value of one label, O(values): the
         per-value refcounts ARE the active cardinality breakdown — the

@@ -99,12 +99,16 @@ class TimeSeriesMemStore:
             from filodb_tpu.memstore.flush import FlushScheduler
             sched = FlushScheduler(shard, flush_interval_ms,
                                    flush_parallelism)
+            shard.flush_scheduler = sched
             try:
                 for offset, container in stream:
                     total += shard.ingest_container(container, offset)
                     sched.note_ingested()
             finally:
-                sched.close(flush_remaining=True)
+                try:
+                    sched.close(flush_remaining=True)
+                finally:
+                    shard.flush_scheduler = None
             return total
         for i, (offset, container) in enumerate(stream):
             total += shard.ingest_container(container, offset)
@@ -191,4 +195,7 @@ class TimeSeriesMemStore:
         return sum(s.flush_all() for s in self.shards(dataset))
 
     def reset(self) -> None:
+        for shards in self._datasets.values():
+            for sh in shards.values():
+                sh.cardinality.close()
         self._datasets.clear()

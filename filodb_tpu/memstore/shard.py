@@ -172,6 +172,16 @@ class TimeSeriesShard:
         # new series is rejected (rows dropped + counted) while existing
         # series keep ingesting (reference: CardinalityManager/QuotaSource)
         self.series_quota = None
+        # data-plane cardinality explorer (ISSUE 6, memstore/cardinality):
+        # O(1) churn notes at part-id assignment and evict/purge, plus
+        # set_fn-sampled active-series gauges off this shard's index
+        from filodb_tpu.memstore.cardinality import CardinalityTracker
+        self.cardinality = CardinalityTracker(dataset, shard_num)
+        self.cardinality.attach_index(self.index)
+        # the FlushScheduler currently driving this shard (node.py /
+        # ingest_stream attach it) so the watermark ledger can surface
+        # flush-queue depth/age in /admin/shards
+        self.flush_scheduler = None
 
     def enable_downsampling(self, publisher, resolutions_ms) -> None:
         self.downsample_publisher = publisher
@@ -393,6 +403,7 @@ class TimeSeriesShard:
         self.part_schema_hash[pid] = schema.schema_hash
         self.index.add_partkey(pid, pk, tags, start_time)
         self.stats.partitions_created += 1
+        self.cardinality.note_created()
         return part
 
     def _partition_cls(self, tags: dict[str, str]):
@@ -581,6 +592,7 @@ class TimeSeriesShard:
             if self.series_quota is not None:
                 self.series_quota.note_removed(part.tags)
             self.stats.partitions_evicted += 1
+            self.cardinality.note_removed("evict")
         return len(victims)
 
     def purge_expired(self, retention_ms: int, now_ms: int) -> int:
@@ -596,6 +608,7 @@ class TimeSeriesShard:
             if self.series_quota is not None:
                 self.series_quota.note_removed(part.tags)
             self.stats.partitions_purged += 1
+            self.cardinality.note_removed("purge")
         return len(doomed)
 
     def mark_stopped_series(self, now_ms: int, stale_ms: int) -> int:

@@ -1,0 +1,236 @@
+"""Ingest watermark ledger: how far behind is each shard, exactly.
+
+The reference's first operational question — "is ingestion keeping up"
+— is answered by per-shard offsets and per-group recovery watermarks
+(reference: TimeSeriesShard group watermarks :155-157, checkpoint reads
+IngestionActor.scala:193-217, ShardHealthStats).  All of those already
+exist here (broker ``end_offset``, ``shard.latest_offset``,
+``shard.group_watermarks``, persisted checkpoints) but were dark.  The
+:class:`WatermarkLedger` samples them into one monotone chain per
+shard::
+
+    broker_end >= ingested >= flushed(group min) >= checkpoint
+
+exported as ``filodb_ingest_watermark_offset{stage=}`` plus lag gauges
+in rows AND seconds, joined with the FlushScheduler's queue depth/age
+and the ShardMapper's status/recovery progress into the
+``/admin/shards`` health tree.  A shard whose row lag is nonzero while
+its ingested offset makes no progress for ``stall_window_s`` raises an
+``ingest.stall`` flight-recorder event + ``filodb_ingest_stalls_total``
+once per episode (re-armed on progress) — the alertable form of "the
+consumer wedged".
+
+One ledger per server (NOT process-wide): in-process multi-node tests
+run several nodes whose (dataset, shard) keys collide; the ``node``
+label keeps their gauge rows apart.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from filodb_tpu.utils.observability import PeriodicThread
+
+_METRICS = None
+
+_STAGES = ("broker_end", "ingested", "flushed", "checkpoint")
+
+
+def _m() -> dict:
+    global _METRICS
+    if _METRICS is None:
+        from filodb_tpu.utils.observability import watermark_metrics
+        _METRICS = watermark_metrics()
+    return _METRICS
+
+
+class _Watch:
+    __slots__ = ("memstore", "mapper", "end_offset_fn")
+
+    def __init__(self, memstore, mapper, end_offset_fn):
+        self.memstore = memstore
+        self.mapper = mapper
+        self.end_offset_fn = end_offset_fn
+
+
+class WatermarkLedger:
+    """Samples every watched dataset's shards into the health tree.
+
+    ``sample()`` is driven by the standalone sampler thread AND by each
+    ``/admin/shards`` request, so the endpoint always shows live
+    numbers; stall detection state advances on every call."""
+
+    def __init__(self, stall_window_s: float = 30.0, node: str = ""):
+        self.stall_window_s = float(stall_window_s)
+        self.node = node
+        self._watches: dict[str, _Watch] = {}
+        # (dataset, shard) -> stall state
+        self._stall: dict[tuple, dict] = {}
+        self._lock = threading.Lock()
+
+    def watch(self, dataset: str, memstore, mapper=None,
+              end_offset_fn: Optional[Callable[[int], int]] = None) -> None:
+        """Track a dataset: shards are enumerated FRESH on every sample
+        (dynamic shard starts/stops need no re-registration).
+        ``end_offset_fn(shard)`` returns the broker head for that
+        shard's partition; None = no broker stage (in-proc sources)."""
+        with self._lock:
+            self._watches[dataset] = _Watch(memstore, mapper, end_offset_fn)
+
+    def unwatch(self, dataset: str) -> None:
+        with self._lock:
+            self._watches.pop(dataset, None)
+
+    def watching(self) -> list[str]:
+        """Datasets currently tracked (the HTTP layer syncs late-bound
+        datasets into its lazy default ledger without clobbering
+        configured watches)."""
+        with self._lock:
+            return list(self._watches)
+
+    # --------------------------------------------------------------- sample
+
+    def _flush_row(self, sh) -> Optional[dict]:
+        sched = getattr(sh, "flush_scheduler", None)
+        if sched is None:
+            return None
+        try:
+            return sched.snapshot()
+        except Exception:  # noqa: BLE001 — scheduler mid-close
+            return None
+
+    def _checkpoint(self, dataset: str, sh) -> Optional[int]:
+        try:
+            cps = sh.meta.read_checkpoints(dataset, sh.shard_num)
+        except Exception:  # noqa: BLE001 — meta store shut down
+            return None
+        return min(cps.values()) if cps else -1
+
+    def _note_stall(self, dataset: str, shard: int, ingested: int,
+                    lag_rows: int, now: float) -> bool:
+        """Advance the per-shard stall machine; returns True while the
+        shard counts as stalled.  One counter bump + flight event per
+        episode — progress re-arms it.  The whole step runs under the
+        ledger lock: the background sampler and inline /admin/shards
+        requests sample concurrently, and an unsynchronized fired-check
+        would double-count the episode boundary."""
+        key = (dataset, shard)
+        with self._lock:
+            st = self._stall.get(key)
+            if lag_rows <= 0:
+                self._stall.pop(key, None)
+                return False
+            if st is None or st["offset"] != ingested:
+                self._stall[key] = {"offset": ingested, "since": now,
+                                    "fired": False}
+                return False
+            if now - st["since"] < self.stall_window_s:
+                return False
+            fire = not st["fired"]
+            st["fired"] = True
+            since = st["since"]
+        if fire:
+            _m()["stalls"].inc(dataset=dataset, shard=shard, node=self.node)
+            from filodb_tpu.utils.devicewatch import FLIGHT
+            FLIGHT.record("ingest.stall", dataset=dataset, shard=shard,
+                          node=self.node, lag_rows=lag_rows,
+                          stalled_for_s=round(now - since, 3))
+        return True
+
+    def _shard_row(self, dataset: str, sh, watch: _Watch,
+                   now_mono: float, now_ms: int) -> dict:
+        m = _m()
+        labels = {"dataset": dataset, "shard": sh.shard_num,
+                  "node": self.node}
+        ingested = sh.latest_offset
+        flushed = min(sh.group_watermarks) if sh.group_watermarks else -1
+        checkpoint = self._checkpoint(dataset, sh)
+        broker_end = None
+        if watch.end_offset_fn is not None:
+            try:
+                broker_end = int(watch.end_offset_fn(sh.shard_num))
+            except Exception:  # noqa: BLE001 — broker unreachable
+                broker_end = None
+        # end_offset is the NEXT offset to be written; latest_offset the
+        # last one ingested — lag is whatever sits between them
+        lag_rows = max(0, broker_end - 1 - ingested) \
+            if broker_end is not None else 0
+        lag_seconds = 0.0
+        if lag_rows > 0 and sh.latest_ingest_ts >= 0:
+            lag_seconds = max(0.0, (now_ms - sh.latest_ingest_ts) / 1000.0)
+        stalled = self._note_stall(dataset, sh.shard_num, ingested,
+                                   lag_rows, now_mono)
+        watermarks = {"ingested": ingested, "flushed": flushed,
+                      "groups": list(sh.group_watermarks)}
+        if broker_end is not None:
+            watermarks["broker_end"] = broker_end
+        if checkpoint is not None:
+            watermarks["checkpoint"] = checkpoint
+        for stage in _STAGES:
+            if stage in watermarks:
+                m["offset"].set(watermarks[stage], stage=stage, **labels)
+        m["lag_rows"].set(lag_rows, **labels)
+        m["lag_seconds"].set(lag_seconds, **labels)
+        row = {"shard": sh.shard_num,
+               "watermarks": watermarks,
+               "lag": {"rows": lag_rows, "seconds": round(lag_seconds, 3)},
+               "stalled": stalled,
+               "rows_ingested": sh.stats.rows_ingested,
+               "latest_ingest_ts": sh.latest_ingest_ts}
+        flush = self._flush_row(sh)
+        if flush is not None:
+            row["flush"] = flush
+        if watch.mapper is not None:
+            st = watch.mapper.state(sh.shard_num)
+            row["status"] = st.status.value
+            row["queryable"] = st.status.queryable
+            row["owner"] = st.node
+            row["recovery_progress"] = st.recovery_progress
+        return row
+
+    def sample(self) -> dict:
+        """One pass over every watched dataset: refresh the gauges,
+        advance stall detection, return the /admin/shards tree."""
+        from filodb_tpu.memstore.cardinality import sample_tenant_gauges
+        with self._lock:
+            watches = dict(self._watches)
+        now_mono = time.monotonic()
+        now_ms = int(time.time() * 1000)
+        datasets: dict = {}
+        for ds, watch in watches.items():
+            shards = watch.memstore.shards(ds)
+            rows = [self._shard_row(ds, sh, watch, now_mono, now_ms)
+                    for sh in shards]
+            rows.sort(key=lambda r: r["shard"])
+            # the tenant cardinality gauges ride the sampling cadence
+            tenant_label = next(
+                (sh.series_quota.tenant_label for sh in shards
+                 if getattr(sh, "series_quota", None) is not None),
+                "_ns_")
+            try:
+                sample_tenant_gauges(ds, shards, tenant_label)
+            except Exception:  # noqa: BLE001 — sampling never breaks serving
+                pass
+            datasets[ds] = {
+                "shards": rows,
+                "totals": {
+                    "lag_rows": sum(r["lag"]["rows"] for r in rows),
+                    "stalled": sum(1 for r in rows if r["stalled"]),
+                    "queryable": sum(1 for r in rows
+                                     if r.get("queryable", True)),
+                },
+            }
+        return {"node": self.node, "stall_window_s": self.stall_window_s,
+                "sampled_at_ms": now_ms, "datasets": datasets}
+
+
+class WatermarkSampler(PeriodicThread):
+    """Background driver: ``ledger.sample()`` every ``interval_s`` so
+    lag gauges and stall events exist without anyone polling
+    /admin/shards (the alertable path)."""
+
+    def __init__(self, ledger: WatermarkLedger, interval_s: float = 10.0):
+        super().__init__(ledger.sample, interval_s, "watermark-sampler")
+        self.ledger = ledger

@@ -29,6 +29,25 @@ class ShardStatus(enum.Enum):
         return self in (ShardStatus.ACTIVE, ShardStatus.RECOVERY)
 
 
+# stable numeric codes for the filodb_shard_status_code gauge (dashboards
+# need an orderable value; enum order here is the lifecycle order)
+_STATUS_CODE = {
+    ShardStatus.UNASSIGNED: 0, ShardStatus.ASSIGNED: 1,
+    ShardStatus.RECOVERY: 2, ShardStatus.ACTIVE: 3, ShardStatus.ERROR: 4,
+    ShardStatus.STOPPED: 5, ShardStatus.DOWN: 6,
+}
+
+_HEALTH_METRICS = None
+
+
+def _health_m() -> dict:
+    global _HEALTH_METRICS
+    if _HEALTH_METRICS is None:
+        from filodb_tpu.utils.observability import shard_health_metrics
+        _HEALTH_METRICS = shard_health_metrics()
+    return _HEALTH_METRICS
+
+
 @dataclasses.dataclass
 class ShardState:
     status: ShardStatus = ShardStatus.UNASSIGNED
@@ -37,10 +56,14 @@ class ShardState:
 
 
 class ShardMapper:
-    def __init__(self, num_shards: int):
+    def __init__(self, num_shards: int, dataset: str = ""):
         if num_shards <= 0 or num_shards & (num_shards - 1):
             raise ValueError(f"num_shards {num_shards} must be a power of 2")
         self.num_shards = num_shards
+        # named mappers (cluster-managed) emit shard-health metrics and
+        # flight events on status changes; anonymous ones (benches,
+        # ad-hoc tests) stay silent
+        self.dataset = dataset
         self._states = [ShardState() for _ in range(num_shards)]
 
     # -- hashing ------------------------------------------------------------
@@ -67,22 +90,54 @@ class ShardMapper:
 
     def register_node(self, shards: Sequence[int], node: str) -> None:
         for s in shards:
+            prev = self._states[s].status
             self._states[s] = ShardState(ShardStatus.ASSIGNED, node)
+            self._note_status(s, prev, ShardStatus.ASSIGNED, 0)
 
     def update_status(self, shard: int, status: ShardStatus,
                       progress: int = 0) -> None:
         st = self._states[shard]
+        prev, prev_progress = st.status, st.recovery_progress
         st.status = status
         st.recovery_progress = progress
+        if prev is not status or prev_progress != progress:
+            self._note_status(shard, prev, status, progress)
 
     def unassign(self, shard: int) -> None:
+        prev = self._states[shard].status
         self._states[shard] = ShardState()
+        self._note_status(shard, prev, ShardStatus.UNASSIGNED, 0)
+
+    def _note_status(self, shard: int, prev: ShardStatus,
+                     status: ShardStatus, progress: int) -> None:
+        """Shard-health emission (ISSUE 6): gauge + transition counter +
+        flight event, ONLY on real changes (the status poller re-applies
+        identical statuses every sweep — those must not spam the ring).
+        Anonymous mappers (no dataset name) skip it entirely."""
+        if not self.dataset:
+            return
+        m = _health_m()
+        m["status_code"].set(_STATUS_CODE[status], dataset=self.dataset,
+                             shard=shard)
+        m["recovery_progress"].set(progress, dataset=self.dataset,
+                                   shard=shard)
+        if prev is not status:
+            m["transitions"].inc(dataset=self.dataset, status=status.value)
+            from filodb_tpu.utils.devicewatch import FLIGHT
+            FLIGHT.record("shard.status", dataset=self.dataset, shard=shard,
+                          status=status.value, prev=prev.value,
+                          progress=progress)
 
     def coord_for_shard(self, shard: int) -> Optional[str]:
         return self._states[shard].node
 
     def status(self, shard: int) -> ShardStatus:
         return self._states[shard].status
+
+    def state(self, shard: int) -> ShardState:
+        """The full per-shard state row (status + owner + recovery
+        progress) for health/watermark views."""
+        return self._states[shard]
 
     def active_shards(self, shards: Optional[Sequence[int]] = None) -> list[int]:
         rng = range(self.num_shards) if shards is None else shards

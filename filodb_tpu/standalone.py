@@ -17,6 +17,12 @@ driven by a JSON config instead of HOCON:
       "profiler": false,
       "workload": {"min-remote-budget-ms": 5},
                                           # node-wide workload knobs
+      "dataplane": {                      # ISSUE 6 (doc/observability.md)
+        "watermark-sample-interval-s": 10,
+        "ingest-stall-window-s": 30,
+        "self-scrape": {"enabled": false, "interval-s": 10,
+                        "dataset": "_system", "num-shards": 1}
+      },
       "datasets": [{
         "name": "prom", "num-shards": 4, "min-num-nodes": 1,
         "schema": "gauge", "spread": 1,
@@ -94,6 +100,13 @@ class FiloServer:
         self.admission_controllers: dict[str, object] = {}
         self.status_poller: Optional[StatusPoller] = None
         self.profiler: Optional[SimpleProfiler] = None
+        # data-plane observability (ISSUE 6): watermark ledger + sampler
+        # + optional self-telemetry scraper; the remote-write publishers
+        # per dataset double as the self-scrape ingest edge
+        self.watermarks = None
+        self.watermark_sampler = None
+        self.selfscraper = None
+        self.write_publishers: dict[str, ShardingPublisher] = {}
         self._global_gateway_claimed = False
         self._started = threading.Event()
 
@@ -143,9 +156,50 @@ class FiloServer:
         if "min-remote-budget-ms" in wl_top:
             self.http.min_remote_budget_ms = int(
                 wl_top["min-remote-budget-ms"])
+        # data-plane observability (ISSUE 6, doc/observability.md):
+        # the watermark ledger exists BEFORE datasets so _setup_dataset
+        # can watch each one with its broker/queue end-offset source
+        from filodb_tpu.memstore.watermarks import (WatermarkLedger,
+                                                    WatermarkSampler)
+        dp = self.config.get("dataplane", {})
+        self.watermarks = WatermarkLedger(
+            stall_window_s=float(dp.get("ingest-stall-window-s", 30.0)),
+            node=self.node)
+        self.http.watermarks = self.watermarks
 
         for ds_conf in self.config.get("datasets", []):
             self._setup_dataset(ds_conf)
+
+        # self-telemetry (ISSUE 6 pillar 3): scrape this node's own
+        # exposition into a Prometheus-schema dataset through the normal
+        # gateway ingest path, so node health is PromQL-queryable
+        ss = dp.get("self-scrape") or {}
+        if ss.get("enabled"):
+            sys_ds = ss.get("dataset", "_system")
+            if sys_ds not in self.manager.datasets():
+                # the synthesized dataset never claims the node's global
+                # Influx gateway port — that edge belongs to user data
+                claimed = self._global_gateway_claimed
+                self._global_gateway_claimed = True
+                try:
+                    self._setup_dataset({
+                        "name": sys_ds,
+                        "num-shards": int(ss.get("num-shards", 1)),
+                        "min-num-nodes": 1, "schema": "gauge", "spread": 0,
+                        "store": ss.get("store", {})})
+                finally:
+                    self._global_gateway_claimed = claimed
+            from filodb_tpu.gateway.selfscrape import SelfScraper
+            self.selfscraper = SelfScraper(
+                self.write_publishers[sys_ds],
+                interval_s=float(ss.get("interval-s", 10.0)),
+                default_tags={"_ws_": "filodb", "_ns_": self.node,
+                              "instance": self.node})
+            self.selfscraper.start()
+        self.watermark_sampler = WatermarkSampler(
+            self.watermarks,
+            interval_s=float(dp.get("watermark-sample-interval-s", 10.0)))
+        self.watermark_sampler.start()
 
         port = self.http.start()
         peers = self.config.get("peers", {})
@@ -252,7 +306,24 @@ class FiloServer:
             publish = lambda s, c, _n=name: self.stream_factory.stream_for(  # noqa: E731
                 _n, s).push(c)
         # Prometheus remote-write edge shares the gateway sharding rules
+        # (and doubles as the self-telemetry ingest edge, ISSUE 6)
         wpub = ShardingPublisher(schema, mapper, publish, spread=spread)
+        self.write_publishers[name] = wpub
+        # watermark ledger source: the broker head when this dataset
+        # consumes from a broker, the in-proc queue head otherwise
+        if self.watermarks is not None:
+            if broker_producer is not None:
+                end_fn = (lambda shard, _c=client,
+                          _t=ds_factory.topic or name:
+                          _c.end_offset(_t, shard))
+            elif ds_factory is self.stream_factory:
+                end_fn = (lambda shard, _n=name:
+                          self.stream_factory.stream_for(
+                              _n, shard).end_offset())
+            else:
+                end_fn = None
+            self.watermarks.watch(name, self.memstore, mapper=mapper,
+                                  end_offset_fn=end_fn)
 
         def write_router(labels, ts, vals, _pub=wpub):
             metric = labels.get("__name__", "")
@@ -344,6 +415,10 @@ class FiloServer:
         return n
 
     def shutdown(self) -> None:
+        if self.watermark_sampler is not None:
+            self.watermark_sampler.stop()
+        if self.selfscraper is not None:
+            self.selfscraper.stop()
         if self.status_poller is not None:
             self.status_poller.stop()
         for gw in self.gateways:

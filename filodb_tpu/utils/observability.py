@@ -304,6 +304,106 @@ def flush_metrics() -> dict:
         "failures": REGISTRY.counter(
             "filodb_flush_failures_total",
             "flush tasks that raised (work requeued)"),
+        # ISSUE 6 satellite: the pipeline's backlog was never observable
+        "queue_depth": REGISTRY.gauge(
+            "filodb_flush_queue_depth",
+            "flush tasks submitted but not yet completed, per shard"),
+        "last_age": REGISTRY.gauge(
+            "filodb_flush_last_age_seconds",
+            "seconds since the most recent completed flush on any group "
+            "of the shard (since scheduler start when none completed)"),
+    }
+
+
+def index_metrics() -> dict:
+    """Canonical part-key-index cardinality metrics (ISSUE 6): active
+    series, per-tenant occupancy, and series churn — one place defines
+    the names so the tracker, /admin/cardinality, and
+    doc/observability.md can never drift."""
+    return {
+        "active_series": REGISTRY.gauge(
+            "filodb_index_cardinality_active_series",
+            "series currently alive in the part-key index, per shard"),
+        "labels": REGISTRY.gauge(
+            "filodb_index_cardinality_labels",
+            "distinct label names carried by alive series, per shard"),
+        "tenant_series": REGISTRY.gauge(
+            "filodb_index_cardinality_tenant_series",
+            "alive series per tenant (tenant-label value; untagged "
+            "series pool under the empty tenant)"),
+        "created": REGISTRY.counter(
+            "filodb_index_churn_created_total",
+            "new series assigned a part id, per shard"),
+        "removed": REGISTRY.counter(
+            "filodb_index_churn_removed_total",
+            "series removed from the index, per shard and reason "
+            "(evict | purge)"),
+        "create_rate": REGISTRY.gauge(
+            "filodb_index_churn_create_rate_per_s",
+            "exponentially-decayed series-creation rate, per shard"),
+        "remove_rate": REGISTRY.gauge(
+            "filodb_index_churn_remove_rate_per_s",
+            "exponentially-decayed series-removal rate, per shard"),
+    }
+
+
+def watermark_metrics() -> dict:
+    """Canonical ingest-watermark metrics (ISSUE 6): the per-shard
+    monotone offset chain broker_end -> ingested -> flushed ->
+    checkpoint, its lag in rows and seconds, and stall detection."""
+    return {
+        "offset": REGISTRY.gauge(
+            "filodb_ingest_watermark_offset",
+            "per-shard ingest watermark chain by stage "
+            "(broker_end | ingested | flushed | checkpoint)"),
+        "lag_rows": REGISTRY.gauge(
+            "filodb_ingest_lag_rows",
+            "records the broker holds that this shard has not ingested"),
+        "lag_seconds": REGISTRY.gauge(
+            "filodb_ingest_lag_seconds",
+            "seconds since the shard's newest ingested sample, while "
+            "row lag is nonzero (0 when caught up)"),
+        "stalls": REGISTRY.counter(
+            "filodb_ingest_stalls_total",
+            "stall episodes: a lagging shard whose ingested offset made "
+            "no progress for the stall window"),
+    }
+
+
+def shard_health_metrics() -> dict:
+    """Canonical shard-status metrics (ISSUE 6): numeric status code,
+    recovery progress, and transition counts, emitted by
+    ShardMapper.update_status on every real change."""
+    return {
+        "status_code": REGISTRY.gauge(
+            "filodb_shard_status_code",
+            "shard status as a code: 0=Unassigned 1=Assigned 2=Recovery "
+            "3=Active 4=Error 5=Stopped 6=Down"),
+        "recovery_progress": REGISTRY.gauge(
+            "filodb_shard_recovery_progress",
+            "recovery replay progress percent (0 outside recovery)"),
+        "transitions": REGISTRY.counter(
+            "filodb_shard_status_transitions_total",
+            "status transitions by dataset and new status"),
+    }
+
+
+def selfscrape_metrics() -> dict:
+    """Canonical self-telemetry metrics (ISSUE 6): the node scraping its
+    own /metrics exposition into the ``_system`` dataset."""
+    return {
+        "scrapes": REGISTRY.counter(
+            "filodb_selfscrape_scrapes_total",
+            "self-scrape passes over the node's own exposition"),
+        "samples": REGISTRY.counter(
+            "filodb_selfscrape_samples_total",
+            "samples published into the self-telemetry dataset"),
+        "errors": REGISTRY.counter(
+            "filodb_selfscrape_errors_total",
+            "self-scrape passes that raised (skipped, never fatal)"),
+        "duration": REGISTRY.gauge(
+            "filodb_selfscrape_last_scrape_seconds",
+            "wall time of the most recent self-scrape pass"),
     }
 
 
@@ -451,6 +551,42 @@ def process_metrics() -> dict:
 
 
 process_metrics()
+
+
+class PeriodicThread:
+    """Daemon loop calling ``fn`` every ``interval_s`` until stopped;
+    exceptions print and the loop continues (the shared harness for
+    background samplers — watermark sampling, self-scrape — so the
+    stop/join/backoff behavior lives in one place)."""
+
+    def __init__(self, fn: Callable[[], object], interval_s: float,
+                 name: str):
+        self.fn = fn
+        self.interval_s = float(interval_s)
+        self.name = name
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.fn()
+                except Exception:  # noqa: BLE001 — keep looping, loudly
+                    traceback.print_exc()
+
+        self._thread = threading.Thread(target=loop, name=self.name,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
 
 
 # ---------------------------------------------------------------------------

@@ -260,3 +260,119 @@ class TestConcurrentIngestQuery:
         assert not errors, errors
         ts, vals = part_holder["p"].read_range(0, MAX)
         assert len(ts) == 400
+
+
+class TestSchedulerObservability:
+    """ISSUE 6 satellite: per-group last-flush age + pending-queue depth
+    were never observable, and drain()/close(flush_remaining=...)
+    ordering under in-flight flushes had no coverage."""
+
+    def _slow_shard(self, delay_s=0.15):
+        ms, sh = _setup()
+        tags = {"__name__": "m", "i": "0", "_ws_": "w", "_ns_": "n"}
+        for off, c in enumerate(_container(
+                [BASE + i * 1000 for i in range(10)],
+                list(range(10)), tags)):
+            sh.ingest_container(c, off)
+        orig = sh.store.write_chunks
+        order = []
+        started = threading.Event()
+
+        def slow_write(ds, shard, chunksets, itime):
+            import time
+            started.set()
+            time.sleep(delay_s)
+            order.append([cs.info.num_rows for cs in chunksets])
+            return orig(ds, shard, chunksets, itime)
+
+        sh.store.write_chunks = slow_write
+        return ms, sh, order, started
+
+    def test_queue_depth_and_age_visible_during_inflight(self):
+        import time
+        from filodb_tpu.utils.observability import REGISTRY
+        ms, sh, order, started = self._slow_shard()
+        sched = FlushScheduler(sh, flush_interval_ms=60_000, parallelism=1)
+        sh.flush_scheduler = sched
+        group = next(iter(sh.partitions.values())).group
+        assert sched.queue_depth() == 0
+        age0 = sched.last_flush_age_s()
+        assert age0 >= 0.0
+        sched.flush_now(group)
+        # in-flight: depth nonzero, exported via the gauge too
+        assert sched.queue_depth() == 1
+        depth = REGISTRY.gauge("filodb_flush_queue_depth")
+        assert depth.value(dataset="ds", shard=0) == 1
+        snap = sched.snapshot()
+        assert snap["pending"] == 1
+        assert snap["groups"][group]["pending"] == 1
+        assert snap["groups"][group]["last_flush_age_s"] is None
+        sched.drain()
+        assert sched.queue_depth() == 0
+        snap = sched.snapshot()
+        assert snap["groups"][group]["pending"] == 0
+        assert snap["groups"][group]["last_flush_age_s"] is not None
+        assert sched.last_flush_age_s() < 1.0
+        sched.close(flush_remaining=False)
+        # gauges deregistered: no dead-instance rows after close
+        assert depth.value(dataset="ds", shard=0) == 0.0
+        assert "filodb_flush_queue_depth" not in "".join(
+            line for line in depth.expose() if 'dataset="ds"' in line)
+
+    def test_same_group_tasks_run_in_submission_order_inflight(self):
+        """Two back-to-back submits for ONE group while the first is
+        still executing must run in submission order (checkpoint
+        monotonicity) even with spare pool workers."""
+        ms, sh, order, started = self._slow_shard(delay_s=0.1)
+        sched = FlushScheduler(sh, flush_interval_ms=60_000, parallelism=2)
+        group = next(iter(sh.partitions.values())).group
+        tags = {"__name__": "m", "i": "0", "_ws_": "w", "_ns_": "n"}
+        sched.flush_now(group)              # 10 rows in flight
+        assert started.wait(5.0)  # task 1 collected its chunks already
+        for off, c in enumerate(_container(
+                [BASE + 50_000 + i * 1000 for i in range(5)],
+                [1.0] * 5, tags), start=100):
+            sh.ingest_container(c, off)
+        sched.flush_now(group)              # 5 more rows, must run second
+        assert sched.queue_depth() == 2
+        sched.drain()
+        assert order == [[10], [5]]
+        assert sched.queue_depth() == 0
+        sched.close(flush_remaining=False)
+
+    def test_close_flush_remaining_false_drains_but_keeps_buffered(self):
+        ms, sh, order, started = self._slow_shard(delay_s=0.05)
+        sched = FlushScheduler(sh, flush_interval_ms=60_000)
+        group = next(iter(sh.partitions.values())).group
+        sched.flush_now(group)
+        tags = {"__name__": "m", "i": "0", "_ws_": "w", "_ns_": "n"}
+        for off, c in enumerate(_container([BASE + 99_000], [7.0], tags),
+                                start=200):
+            sh.ingest_container(c, off)
+        sched.close(flush_remaining=False)
+        # the in-flight task completed...
+        assert order == [[10]]
+        assert sched.queue_depth() == 0
+        # ...but the row ingested after it stayed buffered (stop does
+        # not force a flush) and is still queryable
+        part = next(iter(sh.partitions.values()))
+        assert part._buf_n > 0
+        ts, vals = part.read_range(0, MAX)
+        assert len(ts) == 11
+
+    def test_close_flush_remaining_true_flushes_inflight_and_buffered(self):
+        ms, sh, order, started = self._slow_shard(delay_s=0.05)
+        sched = FlushScheduler(sh, flush_interval_ms=60_000)
+        group = next(iter(sh.partitions.values())).group
+        sched.flush_now(group)
+        tags = {"__name__": "m", "i": "0", "_ws_": "w", "_ns_": "n"}
+        for off, c in enumerate(_container([BASE + 99_000], [7.0], tags),
+                                start=200):
+            sh.ingest_container(c, off)
+        sched.close(flush_remaining=True)
+        # both the in-flight task and the late row flushed, in order
+        flat = [n for batch in order for n in batch]
+        assert sum(flat) == 11 and flat[0] == 10
+        assert sched.queue_depth() == 0
+        for p in sh.partitions.values():
+            assert p._buf_n == 0

@@ -574,3 +574,91 @@ def test_deadline_lint_catches_fixed_timeout():
         "    urllib.request.urlopen(url, timeout=5)\n"
     )
     assert _deadline_violations(plain_ok, "fake.py") == []
+
+
+# ---------------------------------------------------------------------------
+# Metric/doc drift lint (ISSUE 6): every `filodb_*` metric family
+# registered anywhere under filodb_tpu/ must appear in
+# doc/observability.md's metric table.  A name is documented when it
+# appears verbatim, OR when a family row (`filodb_<fam>_*`) covers its
+# prefix AND the remaining suffix appears in the doc (the table's
+# shorthand: family column + per-metric suffixes).  Metrics that creep
+# in undocumented — the drift PRs 6-10 accumulated — fail the build.
+# ---------------------------------------------------------------------------
+
+_METRIC_CTORS = {"counter", "gauge", "histogram"}
+DOC_OBS = ROOT.parent / "doc" / "observability.md"
+
+
+def _registered_metric_names(root=None) -> set:
+    """Every string-literal filodb_* name passed to a registry
+    counter()/gauge()/histogram() call under filodb_tpu/."""
+    root = root or ROOT
+    names = set()
+    for path in sorted(root.rglob("*.py")):
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_CTORS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            name = node.args[0].value
+            if name.startswith("filodb_"):
+                names.add(name)
+    return names
+
+
+def _undocumented_metrics(names, doc_text: str) -> list:
+    doc_lines = doc_text.splitlines()
+    missing = []
+    for name in sorted(names):
+        if name in doc_text:
+            continue
+        parts = name.split("_")
+        covered = False
+        # try every family split: filodb_query_* + "request_seconds",
+        # filodb_query_request_* + "seconds", ... — the suffix must sit
+        # on the SAME line (table row) as the family pattern, or a
+        # suffix shared with another family would mask the drift
+        for i in range(2, len(parts)):
+            fam = "_".join(parts[:i]) + "_*"
+            suffix = "_".join(parts[i:])
+            if any(fam in line and suffix in line for line in doc_lines):
+                covered = True
+                break
+        if not covered:
+            missing.append(
+                f"{name}: not in doc/observability.md's metric table — "
+                f"add the full name, or list its suffix on a "
+                f"`filodb_<family>_*` row")
+    return missing
+
+
+def test_metric_families_are_documented():
+    names = _registered_metric_names()
+    assert names, "no registered filodb_* metrics found — lint broken?"
+    missing = _undocumented_metrics(names, DOC_OBS.read_text())
+    assert not missing, \
+        "undocumented metrics:\n  " + "\n  ".join(missing)
+
+
+def test_metric_doc_lint_catches_drift():
+    """The doc lint must fire on an undocumented name and accept both
+    documented spellings."""
+    doc = ("| `filodb_query_*` | `request_seconds`, `requests_total` |\n"
+           "`filodb_node_up` is set at startup.\n")
+    assert _undocumented_metrics({"filodb_query_request_seconds"}, doc) == []
+    assert _undocumented_metrics({"filodb_node_up"}, doc) == []
+    bad = _undocumented_metrics({"filodb_query_brand_new_total"}, doc)
+    assert len(bad) == 1 and "filodb_query_brand_new_total" in bad[0]
+    bad = _undocumented_metrics({"filodb_sneaky_family_total"}, doc)
+    assert len(bad) == 1
+    # a suffix documented under a DIFFERENT family's row must not cover
+    # this family (same-line rule)
+    doc2 = ("| `filodb_flush_*` | `failures_total` |\n"
+            "| `filodb_odp_*` | `pagein_seconds` |\n")
+    bad = _undocumented_metrics({"filodb_odp_failures_total"}, doc2)
+    assert len(bad) == 1 and "filodb_odp_failures_total" in bad[0]
