@@ -60,6 +60,7 @@ class IngestionStopped(ShardEvent):
 @dataclasses.dataclass(frozen=True)
 class IngestionError(ShardEvent):
     error: str
+    node: Optional[str] = None  # the replica that failed (ISSUE 7)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -89,19 +90,31 @@ class ShardAssignmentStrategy:
 
 
 class DefaultShardAssignmentStrategy(ShardAssignmentStrategy):
-    """Spread shards evenly: each node gets ceil(num_shards/min_num_nodes)
-    at most, preferring unassigned shards; idempotent — a node that already
-    holds its quota gets the same recommendation back (reference:
-    DefaultShardAssignmentStrategy.scala:36)."""
+    """Spread shard REPLICAS evenly: each node gets at most
+    ceil(num_shards * rf / min_num_nodes), preferring the shards with
+    the fewest live replicas (empty groups fill before degraded ones);
+    a node never holds two copies of one shard.  Idempotent — a node
+    that already holds its quota gets the same recommendation back
+    (reference: DefaultShardAssignmentStrategy.scala:36)."""
 
     def shard_assignments(self, node, dataset, mapper, min_num_nodes) -> list[int]:
-        quota = -(-mapper.num_shards // max(min_num_nodes, 1))  # ceil
+        rf = mapper.replication_factor
+        quota = -(-mapper.num_shards * rf // max(min_num_nodes, 1))  # ceil
         have = mapper.shards_for_node(node)
         if len(have) >= quota:
             return have
-        unassigned = [s for s in range(mapper.num_shards)
-                      if mapper.coord_for_shard(s) is None]
-        return have + unassigned[:quota - len(have)]
+        # shards still short of rf live replicas that this node does not
+        # already hold a copy of, emptiest groups first (stable by id);
+        # one live_replicas snapshot per shard keeps the filter, the
+        # membership check, and the sort key consistent (and O(1) each)
+        live = {s: mapper.live_replicas(s)
+                for s in range(mapper.num_shards)}
+        need = sorted(
+            (s for s in range(mapper.num_shards)
+             if len(live[s]) < rf
+             and all(r.node != node for r in live[s])),
+            key=lambda s: (len(live[s]), s))
+        return have + need[:quota - len(have)]
 
 
 # ---------------------------------------------------------------------------
@@ -115,6 +128,9 @@ class DatasetInfo:
     num_shards: int
     min_num_nodes: int
     mapper: ShardMapper
+    replication_factor: int = 1
+    # once-per-transition state for the degraded-placement warning
+    degraded: bool = False
 
 
 # ---------------------------------------------------------------------------
@@ -151,24 +167,37 @@ class ShardManager:
             out = {}
             for info in self._datasets.values():
                 out[info.name] = self._assign(node, info)
+                self._warn_if_degraded(info)
             return out
 
     def remove_node(self, node: str) -> dict[str, list[int]]:
-        """Member-down: mark its shards Down, then try to reassign them to
-        surviving nodes under the rate limit (reference: removeMember +
-        reassignment)."""
+        """Member-down: demote the node's replicas to Down — publishing
+        ``ShardDown`` per affected REPLICA so subscribers and the
+        named-mapper health metrics see every lost copy.  A group that
+        keeps >=1 live replica serves from the survivor and is NOT
+        re-placed (replica stickiness: the dead copy waits to rejoin
+        from its checkpoint; the degraded group is warned loudly);
+        only FULLY-dead groups are reassigned to surviving nodes, under
+        the rate limit, to restore availability (reference:
+        removeMember + reassignment)."""
         with self._lock:
             if node in self._nodes:
                 self._nodes.remove(node)
             freed: dict[str, list[int]] = {}
             for info in self._datasets.values():
-                shards = info.mapper.shards_for_node(node)
+                # EVERY replica the node holds demotes (Error included —
+                # shards_for_node only lists live copies)
+                shards = [s for s in range(info.num_shards)
+                          if info.mapper.state(s).replica(node)
+                          is not None]
                 for s in shards:
-                    info.mapper.unassign(s)
-                    info.mapper.update_status(s, ShardStatus.DOWN)
+                    # per-replica demotion: the transition counter and
+                    # replica gauge emit through the named-mapper path
+                    info.mapper.update_status(s, ShardStatus.DOWN,
+                                              node=node)
                     self._publish(ShardDown(info.name, s, node))
                 freed[info.name] = shards
-            # reassign freed shards across survivors
+            # restore rf across survivors
             for ds, shards in freed.items():
                 self._reassign(self._datasets[ds], shards)
             return freed
@@ -181,17 +210,24 @@ class ShardManager:
     # -------------------------------------------------------------- datasets
 
     def setup_dataset(self, name: str, num_shards: int,
-                      min_num_nodes: int) -> DatasetInfo:
+                      min_num_nodes: int,
+                      replication_factor: int = 1) -> DatasetInfo:
         """SetupDataset: register and assign across current nodes
-        (reference: NodeClusterActor.SetupDataset -> ShardManager)."""
+        (reference: NodeClusterActor.SetupDataset -> ShardManager).
+        ``replication_factor`` > 1 places each shard on that many
+        DISTINCT nodes (ISSUE 7)."""
         with self._lock:
             if name in self._datasets:
                 return self._datasets[name]
             info = DatasetInfo(name, num_shards, min_num_nodes,
-                               ShardMapper(num_shards, dataset=name))
+                               ShardMapper(
+                                   num_shards, dataset=name,
+                                   replication_factor=replication_factor),
+                               replication_factor=replication_factor)
             self._datasets[name] = info
             for node in self._nodes:
                 self._assign(node, info)
+            self._warn_if_degraded(info)
             return info
 
     def mapper(self, dataset: str) -> ShardMapper:
@@ -211,10 +247,14 @@ class ShardManager:
             info = self._datasets[dataset]
             started = []
             for s in shards:
-                if info.mapper.coord_for_shard(s) is None:
-                    info.mapper.register_node([s], node)
-                    self._publish(ShardAssignmentStarted(dataset, s, node))
-                    started.append(s)
+                live = info.mapper.live_replicas(s)
+                if any(r.node == node for r in live):
+                    continue  # already holds a live copy
+                if live and len(live) >= info.replication_factor:
+                    continue  # group already at full strength
+                info.mapper.register_node([s], node)
+                self._publish(ShardAssignmentStarted(dataset, s, node))
+                started.append(s)
             return started
 
     def stop_shards(self, dataset: str, shards: Sequence[int]) -> list[int]:
@@ -222,8 +262,12 @@ class ShardManager:
             info = self._datasets[dataset]
             stopped = []
             for s in shards:
-                if info.mapper.coord_for_shard(s) is not None:
-                    info.mapper.update_status(s, ShardStatus.STOPPED)
+                if info.mapper.replicas(s):
+                    # operator stop applies to EVERY replica: the whole
+                    # group stops serving, not just the primary copy
+                    for r in list(info.mapper.replicas(s)):
+                        info.mapper.update_status(s, ShardStatus.STOPPED,
+                                                  node=r.node)
                     self._publish(IngestionStopped(dataset, s))
                     stopped.append(s)
             return stopped
@@ -236,18 +280,20 @@ class ShardManager:
 
     def publish_event(self, event: ShardEvent) -> None:
         """Ingestion coordinators report progress through here; the mapper
-        status tracks the event (reference: ShardManager.updateFromExternal
-        + StatusActor relay)."""
+        status tracks the event against the REPORTING NODE's replica
+        (reference: ShardManager.updateFromExternal + StatusActor
+        relay)."""
         with self._lock:
             info = self._datasets.get(event.dataset)
             if info is not None:
                 status = _EVENT_STATUS.get(type(event))
+                node = getattr(event, "node", None)
                 if isinstance(event, IngestionStopped) \
                         and event.node is not None \
-                        and info.mapper.coord_for_shard(event.shard) \
-                        != event.node:
+                        and info.mapper.state(event.shard).replica(
+                            event.node) is None:
                     # handoff tail: this node stopped its local ingest
-                    # because ownership MOVED — the new owner's
+                    # because ownership MOVED — the new holder's
                     # lifecycle governs the status now; marking STOPPED
                     # here would stick (gossip never resurrects
                     # operator stops) and blind this node's queries to
@@ -255,7 +301,8 @@ class ShardManager:
                     status = None
                 if status is not None:
                     progress = getattr(event, "progress_pct", 0)
-                    info.mapper.update_status(event.shard, status, progress)
+                    info.mapper.update_status(event.shard, status, progress,
+                                              node=node)
             self._publish(event)
 
     def _publish(self, event: ShardEvent) -> None:
@@ -267,7 +314,9 @@ class ShardManager:
     def _assign(self, node: str, info: DatasetInfo) -> list[int]:
         shards = self.strategy.shard_assignments(node, info.name, info.mapper,
                                                  info.min_num_nodes)
-        fresh = [s for s in shards if info.mapper.coord_for_shard(s) != node]
+        fresh = [s for s in shards
+                 if all(r.node != node
+                        for r in info.mapper.live_replicas(s))]
         if fresh:
             info.mapper.register_node(fresh, node)
             for s in fresh:
@@ -275,26 +324,64 @@ class ShardManager:
         return info.mapper.shards_for_node(node)
 
     def _reassign(self, info: DatasetInfo, shards: Sequence[int]) -> list[int]:
-        """Move freed shards to surviving nodes, at most once per shard per
-        rate-limit interval."""
+        """Restore AVAILABILITY for fully-dead groups from the surviving
+        nodes, at most once per shard per rate-limit interval.  A group
+        that keeps >= 1 live replica is NOT reassigned: the survivor
+        serves, and the dead copy stays sticky so the node can rejoin
+        and replay from its own checkpoint instead of the cluster
+        re-moving the whole shard on every blip (replica stickiness —
+        the degraded group is warned loudly below).  A node never
+        receives a shard it already holds a live copy of."""
         if not self._nodes:
+            # losing the LAST node is the worst placement transition of
+            # all — it must still fire the degraded warning
+            self._warn_if_degraded(info)
             return []
         now_ms = self._clock() * 1000.0
         moved = []
         for s in shards:
+            if info.mapper.live_replicas(s):
+                continue  # a surviving replica still covers the shard
             key = (info.name, s)
             last = self._last_reassign.get(key)
             if last is not None and \
                     now_ms - last < self.reassignment_min_interval_ms:
                 continue  # too soon; stays Down until next membership event
-            # least-loaded surviving node
+            # least-loaded surviving node (the group is fully dead per
+            # the guard above, so every survivor is a legal holder)
             node = min(self._nodes,
                        key=lambda n: len(info.mapper.shards_for_node(n)))
             info.mapper.register_node([s], node)
             self._last_reassign[key] = now_ms
             self._publish(ShardAssignmentStarted(info.name, s, node))
             moved.append(s)
+        self._warn_if_degraded(info)
         return moved
+
+    def _warn_if_degraded(self, info: DatasetInfo) -> None:
+        """LOUD once-per-transition warning when placement cannot reach
+        the replication factor (rf > live nodes, or groups left short
+        after a failure) — a degraded group has less failure headroom
+        than the operator configured."""
+        short = [s for s in range(info.num_shards)
+                 if len(info.mapper.live_replicas(s))
+                 < info.replication_factor]
+        was = info.degraded
+        degraded = bool(short)
+        info.degraded = degraded
+        if degraded and not was:
+            import logging
+            logging.getLogger(__name__).warning(
+                "dataset %s: %d/%d shard groups below replication-factor "
+                "%d (nodes=%d) — degraded placement, reduced failure "
+                "headroom (first short shards: %s)",
+                info.name, len(short), info.num_shards,
+                info.replication_factor, len(self._nodes), short[:8])
+            from filodb_tpu.utils.devicewatch import FLIGHT
+            FLIGHT.record("shard.degraded_placement", dataset=info.name,
+                          short_groups=len(short),
+                          replication_factor=info.replication_factor,
+                          nodes=len(self._nodes))
 
 
 # ---------------------------------------------------------------------------
@@ -391,7 +478,9 @@ class StatusPoller:
                  peers: dict[str, str], local_node: str,
                  interval_s: float = 2.0, timeout_s: float = 2.0,
                  on_assignment_change: Optional[Callable[[], None]] = None,
-                 local_running: Optional[Callable[[str], list]] = None):
+                 local_running: Optional[Callable[[str], list]] = None,
+                 local_watermarks: Optional[
+                     Callable[[str], dict]] = None):
         from concurrent.futures import ThreadPoolExecutor
 
         self.manager = manager
@@ -406,6 +495,10 @@ class StatusPoller:
         # (its ingest thread died) triggers the assignment-change hook,
         # whose resync restarts it
         self.local_running = local_running
+        # dataset -> {shard: ingested offset} for the LOCAL node; folded
+        # into the mapper's replica watermarks each sweep so group_head
+        # (the recovery-promotion gate, ISSUE 7) sees this node too
+        self.local_watermarks = local_watermarks
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._pool = ThreadPoolExecutor(
@@ -456,6 +549,7 @@ class StatusPoller:
         # the local node is trivially alive: never let its own heartbeat
         # lapse into a self-down declaration
         self.detector.heartbeat(self.local_node)
+        self._note_local_watermarks()
         targets = [(p, ep) for p, ep in self.peers.items()
                    if p != self.local_node]
         bodies = list(self._pool.map(
@@ -476,6 +570,25 @@ class StatusPoller:
         if down or changed or self._local_needs_heal():
             self._signal_change()
         return down
+
+    def _note_local_watermarks(self) -> None:
+        """Fold the local node's ingested offsets into its replica rows
+        so ``group_head`` reflects this node without a network hop."""
+        if self.local_watermarks is None:
+            return
+        for ds in self.manager.datasets():
+            mapper = self.manager.mapper(ds)
+            try:
+                wms = self.local_watermarks(ds) or {}
+            except Exception:  # noqa: BLE001 — store mid-shutdown
+                continue
+            with self.manager._lock:  # mapper mutation under the
+                # manager lock: a concurrent register_node/set_replicas
+                # replaces the replica list, and a watermark written to
+                # a discarded ReplicaState would be silently lost
+                for shard, offset in wms.items():
+                    mapper.note_watermark(int(shard), self.local_node,
+                                          int(offset))
 
     def _local_needs_heal(self) -> bool:
         """True when a locally-assigned shard is not actually running
@@ -516,9 +629,10 @@ class StatusPoller:
                 _tb.print_exc()
 
     def _adopt_leader_view(self, body: dict) -> bool:
-        """Replace local shard OWNERSHIP with the leader's (reference:
-        every node caches the singleton's ShardMapper snapshots).
-        Returns True when any assignment changed."""
+        """Replace local shard OWNERSHIP (the full replica group) with
+        the leader's (reference: every node caches the singleton's
+        ShardMapper snapshots).  Returns True when any membership
+        changed."""
         changed = False
         with self.manager._lock:  # mapper mutation under the manager lock
             for ds, shards in (body.get("shards") or {}).items():
@@ -529,56 +643,86 @@ class StatusPoller:
                     shard = int(st.get("shard", -1))
                     if not 0 <= shard < mapper.num_shards:
                         continue
-                    node = st.get("node")
-                    if mapper.coord_for_shard(shard) == node:
-                        continue
-                    changed = True
-                    if node is None:
-                        mapper.unassign(shard)
-                    else:
-                        mapper.register_node([shard], node)
-                    try:
-                        mapper.update_status(shard,
-                                             ShardStatus(st.get("status")))
-                    except ValueError:
-                        pass
+                    rows = st.get("replicas")
+                    if rows is None:
+                        # legacy single-copy payload shape
+                        node = st.get("node")
+                        rows = [] if node is None else [
+                            {"node": node, "status": st.get("status")}]
+                    changed |= mapper.set_replicas(shard, rows)
         return changed
 
     def _apply_liveness(self, peer: str, body: dict) -> None:
         """Peer-reported running shards are ground truth for liveness of
-        the shards WE think the peer owns; assignment is not touched and
-        operator STOPPED/DOWN statuses are never overwritten."""
+        the REPLICAS we think the peer holds; membership is not touched
+        and operator STOPPED/DOWN statuses are never overwritten.  The
+        peer's per-shard ingested offsets feed its replica watermarks
+        (the group-head promotion gate, ISSUE 7)."""
         running = body.get("running") or {}
+        watermarks = body.get("watermarks") or {}
         peer_status: dict[tuple[str, int], str] = {}
+        peer_progress: dict[tuple[str, int], int] = {}
         for ds, shards in (body.get("shards") or {}).items():
             for st in shards:
-                peer_status[(ds, int(st.get("shard", -1)))] = st.get("status")
+                shard = int(st.get("shard", -1))
+                status = st.get("status")
+                for rep in st.get("replicas") or ():
+                    if rep.get("node") == peer:
+                        status = rep.get("status")
+                        peer_progress[(ds, shard)] = \
+                            int(rep.get("progress") or 0)
+                peer_status[(ds, shard)] = status
         with self.manager._lock:
             for ds in self.manager.datasets():
                 mapper = self.manager.mapper(ds)
-                live = {int(s) for s in running[ds]} if ds in running                     else None
+                live = {int(s) for s in running[ds]} if ds in running \
+                    else None
+                ds_wms = watermarks.get(ds) or {}
                 for shard in range(mapper.num_shards):
-                    if mapper.coord_for_shard(shard) != peer:
+                    rep = mapper.state(shard).replica(peer)
+                    if rep is None:
                         continue
-                    cur = mapper.status(shard)
-                    if cur in (ShardStatus.STOPPED, ShardStatus.DOWN):
+                    if str(shard) in ds_wms or shard in ds_wms:
+                        off = ds_wms.get(str(shard), ds_wms.get(shard))
+                        mapper.note_watermark(shard, peer, int(off))
+                    if rep.status in (ShardStatus.STOPPED, ShardStatus.DOWN):
                         continue  # operator/leader intent is sticky
                     if live is None:
                         # no running info: fall back to the peer's own
-                        # reported status
+                        # reported status + progress (defaulting
+                        # progress would wipe a recovering replica's
+                        # percentage to 0 on every sweep)
                         try:
-                            mapper.update_status(shard, ShardStatus(
-                                peer_status.get((ds, shard))))
+                            mapper.update_status(
+                                shard,
+                                ShardStatus(peer_status.get((ds, shard))),
+                                progress=peer_progress.get(
+                                    (ds, shard), rep.recovery_progress),
+                                node=peer)
                         except ValueError:
                             pass
                         continue
+
                     if shard in live:
-                        # peer runs it; honor its RECOVERY sub-state
-                        rep = peer_status.get((ds, shard))
-                        status = ShardStatus.RECOVERY                             if rep == ShardStatus.RECOVERY.value                             else ShardStatus.ACTIVE
-                        mapper.update_status(shard, status)
+                        # peer runs it; honor its RECOVERY sub-state.
+                        # Progress comes from the peer's OWN gossiped
+                        # row when present — the owner's recovery
+                        # events never reach this node's ShardManager,
+                        # and register_node reset the local copy to 0
+                        # at rejoin, so the local value shows a replica
+                        # stuck at 0% for the whole replay
+                        reported = peer_status.get((ds, shard))
+                        status = ShardStatus.RECOVERY \
+                            if reported == ShardStatus.RECOVERY.value \
+                            else ShardStatus.ACTIVE
+                        keep = peer_progress.get(
+                            (ds, shard), rep.recovery_progress) \
+                            if status is ShardStatus.RECOVERY else 0
+                        mapper.update_status(shard, status, progress=keep,
+                                             node=peer)
                     else:
-                        mapper.update_status(shard, ShardStatus.ASSIGNED)
+                        mapper.update_status(shard, ShardStatus.ASSIGNED,
+                                             node=peer)
 
     def start(self) -> None:
         def loop():

@@ -81,7 +81,7 @@ class HttpPlanDispatcher(PlanDispatcher):
     def __init__(self, endpoint: str, timeout_s: float = 60.0,
                  max_retries: int = 2, backoff_s: float = 0.05,
                  hedge: bool = False, hedge_min_s: float = 0.05,
-                 hedge_warmup: int = 16):
+                 hedge_warmup: int = 16, hedge_alternate=None):
         self.endpoint = endpoint.rstrip("/")
         self.timeout_s = timeout_s
         self.max_retries = max(int(max_retries), 0)
@@ -89,6 +89,10 @@ class HttpPlanDispatcher(PlanDispatcher):
         self.hedge = bool(hedge)
         self.hedge_min_s = float(hedge_min_s)
         self.hedge_warmup = max(int(hedge_warmup), 1)
+        # replica retarget hook (ISSUE 7): plan -> alternate ENDPOINT for
+        # the hedged duplicate, chosen through ReplicaSet.pick (never an
+        # ad-hoc list); None = hedge against the same endpoint (rf=1)
+        self.hedge_alternate = hedge_alternate
         # recent successful-attempt latencies -> p99 hedge trigger
         self._lat: collections.deque = collections.deque(maxlen=128)
         self._lat_lock = threading.Lock()
@@ -110,26 +114,37 @@ class HttpPlanDispatcher(PlanDispatcher):
         return max(lat[min(int(0.99 * len(lat)), len(lat) - 1)],
                    self.hedge_min_s)
 
+    def observed_p50_s(self) -> Optional[float]:
+        """Median observed attempt latency — the calibrated-latency leg
+        of ReplicaSet.pick's ordering (None until samples exist)."""
+        with self._lat_lock:
+            lat = sorted(self._lat)
+        return lat[len(lat) // 2] if lat else None
+
     def _send_once(self, body: bytes, headers: dict,
-                   deadline_timeout_s: float) -> dict:
+                   deadline_timeout_s: float,
+                   endpoint: Optional[str] = None) -> dict:
         req = urllib.request.Request(
-            f"{self.endpoint}/execplan", data=body, method="POST",
-            headers=headers)
+            f"{endpoint or self.endpoint}/execplan", data=body,
+            method="POST", headers=headers)
         t0 = time.perf_counter()
         with urllib.request.urlopen(req,
                                     timeout=deadline_timeout_s) as resp:
             payload = json.loads(resp.read())
-        self._note_latency(time.perf_counter() - t0)
+        if endpoint is None:
+            self._note_latency(time.perf_counter() - t0)
         return payload
 
-    def _send_hedged(self, make_body, headers: dict,
+    def _send_hedged(self, plan, make_body, headers: dict,
                      deadline_timeout_s: float) -> dict:
         """First attempt with a p99-armed hedge: when the primary is
         still in flight past the hedge delay, launch ONE duplicate and
-        take whichever answers first.  The WHOLE hedged attempt —
-        hedge-delay wait included — stays inside ``deadline_timeout_s``
-        so a tail-latency storm cannot pin dispatch threads past the
-        deadline they exist to enforce."""
+        take whichever answers first.  With replicas, the duplicate
+        retargets a DIFFERENT replica via the ``hedge_alternate`` hook
+        (ReplicaSet.pick) — a wedged node cannot slow both requests.
+        The WHOLE hedged attempt — hedge-delay wait included — stays
+        inside ``deadline_timeout_s`` so a tail-latency storm cannot
+        pin dispatch threads past the deadline they exist to enforce."""
         t_start = time.perf_counter()
         delay = self.hedge_delay_s()
         if delay is None or delay >= deadline_timeout_s:
@@ -144,9 +159,16 @@ class HttpPlanDispatcher(PlanDispatcher):
             pass  # tail-slow: hedge below
         m = _wm()
         m["dispatch_hedged"].inc(endpoint=self.endpoint)
+        alt = self.hedge_alternate(plan) \
+            if self.hedge_alternate is not None else None
+        # retarget telemetry (counter + flight event) is emitted by the
+        # hedge_alternate hook itself, where node NAMES are known — the
+        # flight event's from/to domain must match _note_handoff's
+        if alt is not None and alt.rstrip("/") == self.endpoint:
+            alt = None
         # fresh body: the wire budget_ms re-encodes from what is left NOW
         second = pool.submit(self._send_once, make_body(), headers,
-                             deadline_timeout_s)
+                             deadline_timeout_s, alt)
         pending = {first: "first", second: "second"}
         last_err: Optional[BaseException] = None
         while pending:
@@ -193,7 +215,7 @@ class HttpPlanDispatcher(PlanDispatcher):
             deadline_timeout_s = dl.budget_timeout_s(qctx, self.timeout_s)
             try:
                 if attempt == 0 and self.hedge:
-                    return self._send_hedged(make_body, headers,
+                    return self._send_hedged(plan, make_body, headers,
                                              deadline_timeout_s)
                 return self._send_once(make_body(), headers,
                                        deadline_timeout_s)
@@ -251,10 +273,12 @@ class HttpPlanDispatcher(PlanDispatcher):
                     # the data node REFUSED the work (overload / budget
                     # too small to finish): transport-class failure, so
                     # allow_partial_results can degrade it
-                    raise ShardUnavailable(
+                    su = ShardUnavailable(
                         plan.query_context.query_id,
                         f"remote dispatch to {self.endpoint} refused: "
-                        f"{err}") from e
+                        f"{err}")
+                    su.reason = "refused"
+                    raise su from e
                 raise QueryError(plan.query_context.query_id,
                                  f"remote dispatch to {self.endpoint} "
                                  f"failed: {err}") from e
@@ -314,16 +338,101 @@ def execplan_handler(memstore) -> Callable[..., dict]:
     return handle
 
 
+class ReplicaDispatcher(PlanDispatcher):
+    """Failover router for one shard's replica group (ISSUE 7).
+
+    Tries replicas in ReplicaSet.pick order; a TRANSPORT-level failure
+    (``ShardUnavailable``: connect refused / retries exhausted / remote
+    503 budget refusal) fails over to the next replica while deadline
+    budget remains.  Only when the WHOLE group is exhausted does
+    ``ShardUnavailable`` escape — the partial-results opt-in then
+    degrades it exactly as before.  Every failover lands in the flight
+    recorder (``dispatch.failover``) and
+    ``filodb_dispatch_failover_total{reason=}``."""
+
+    def __init__(self, dataset: str, shard: int, replica_set,
+                 dispatcher_for_node: Callable[[int, str],
+                                               Optional[PlanDispatcher]]):
+        self.dataset = dataset
+        self.shard = shard
+        self.replica_set = replica_set
+        self.dispatcher_for_node = dispatcher_for_node
+
+    def dispatch(self, plan, ctx: ExecContext) -> QueryResult:
+        order = self.replica_set.pick(self.shard)
+        if not order:
+            raise ShardUnavailable(
+                plan.query_context.query_id,
+                f"shard {self.shard} of {self.dataset} has no routable "
+                f"replica (group down)")
+        last_err: Optional[BaseException] = None
+        for i, node in enumerate(order):
+            if i > 0:
+                rem = dl.remaining_ms(plan.query_context)
+                if rem is not None and rem <= 0:
+                    break  # budget gone: report the transport error
+            # already-tried replicas are off limits for the hedge
+            # retarget too (hedge_alternate_for reads this): a hedged
+            # duplicate aimed at the replica that JUST failed would
+            # nullify the hedge during the exact episode it exists for
+            plan.replica_exclude = order[:i]
+            d = self.dispatcher_for_node(self.shard, node)
+            if d is None:
+                last_err = ShardUnavailable(
+                    plan.query_context.query_id,
+                    f"shard {self.shard} replica on node {node!r} has no "
+                    f"endpoint configured — refusing to serve it from "
+                    f"the local store")
+                last_err.reason = "no_endpoint"
+                if i + 1 < len(order):
+                    self._note_handoff(plan, node, order[i + 1],
+                                       "no_endpoint", str(last_err))
+                continue
+            try:
+                return d.dispatch(plan, ctx)
+            except ShardUnavailable as e:
+                last_err = e
+                if i + 1 < len(order):
+                    # the raise site tagged the failure class — never
+                    # substring-match the message (urllib's "[Errno
+                    # 111] Connection refused" reads as a work refusal)
+                    self._note_handoff(plan, node, order[i + 1], e.reason,
+                                       str(e))
+        raise last_err if last_err is not None else ShardUnavailable(
+            plan.query_context.query_id,
+            f"shard {self.shard} of {self.dataset}: deadline exhausted "
+            f"before any replica answered")
+
+    def _note_handoff(self, plan, from_node: str, to_node: str,
+                      reason: str, error: str) -> None:
+        """Telemetry only — both nodes were already selected by pick();
+        named to stay clear of the routing lint's site hints."""
+        _wm()["dispatch_failover"].inc(reason=reason)
+        from filodb_tpu.utils.devicewatch import FLIGHT
+        FLIGHT.record("dispatch.failover", dataset=self.dataset,
+                      shard=self.shard, from_node=from_node,
+                      to_node=to_node, reason=reason,
+                      trace_id=plan.query_context.trace_id or "",
+                      error=error[:200])
+
+    def __repr__(self) -> str:
+        return f"ReplicaDispatcher({self.dataset}/{self.shard})"
+
+
 def dispatcher_factory(mapper, endpoints: dict[str, str],
                        local_node: Optional[str] = None,
                        dispatch_config: Optional[dict] = None
                        ) -> Callable[[int], PlanDispatcher]:
-    """shard -> dispatcher, from the ShardMapper's owner and a node ->
-    endpoint map (the plug for SingleClusterPlanner.dispatcher_for_shard).
-    Shards owned by ``local_node`` (or by unknown nodes) execute
-    in-process.  ``dispatch_config`` (the standalone ``workload.
-    dispatch`` block) tunes the timeout cap / retries / hedging of the
-    HTTP dispatchers it builds."""
+    """shard -> dispatcher, from the ShardMapper's replica groups and a
+    node -> endpoint map (the plug for
+    SingleClusterPlanner.dispatcher_for_shard).  Single-copy shards keep
+    the legacy shapes (IN_PROCESS / per-endpoint HttpPlanDispatcher);
+    replicated shards route through a :class:`ReplicaDispatcher` whose
+    candidate order — primary, failover, hedge retarget — always comes
+    from ``ReplicaSet.pick``.  ``dispatch_config`` (the standalone
+    ``workload.dispatch`` block) tunes the timeout cap / retries /
+    hedging of the HTTP dispatchers it builds."""
+    from filodb_tpu.coordinator.replicas import ReplicaSet
     from filodb_tpu.query.exec import IN_PROCESS
 
     cfg = dispatch_config or {}
@@ -335,19 +444,93 @@ def dispatcher_factory(mapper, endpoints: dict[str, str],
         hedge_min_s=float(cfg.get("hedge-min-s", 0.05)))
     cache: dict[str, HttpPlanDispatcher] = {}
 
+    def latency_fn(node: str) -> Optional[float]:
+        d = cache.get(node)
+        return d.observed_p50_s() if d is not None else None
+
+    replica_set = ReplicaSet(
+        mapper, local_node=local_node, latency_fn=latency_fn,
+        lag_tolerance_rows=int(cfg.get("lag-tolerance-rows", 256)))
+
+    def hedge_alternate_for(plan, this_node: str) -> Optional[str]:
+        """Endpoint for the hedged duplicate: the healthiest replica
+        OTHER than the one already in flight AND the ones the failover
+        loop already burned (plan.replica_exclude) — still via
+        ReplicaSet.pick; None keeps same-endpoint hedging (rf=1)."""
+        shard = getattr(plan, "shard", None)
+        if shard is None:
+            return None
+        exclude = [this_node] + list(
+            getattr(plan, "replica_exclude", ()))
+        # walk down ReplicaSet.pick order past unusable candidates —
+        # the local replica (serves in-process, not via a hedge POST)
+        # and nodes with no configured endpoint — instead of degrading
+        # to a same-endpoint hedge while a healthy remote peer idles
+        # (mirrors the failover loop's no_endpoint continue)
+        while True:
+            node = replica_set.alternate(shard, exclude=exclude)
+            if node is None or node == this_node:
+                return None
+            ep = endpoints.get(node)
+            if node == local_node or ep is None:
+                exclude = exclude + [node]
+                continue
+            this_ep = endpoints.get(this_node)
+            if this_ep is not None \
+                    and ep.rstrip("/") == this_ep.rstrip("/"):
+                # two node names resolving to ONE endpoint
+                # (misconfiguration): a "retarget" there is the same
+                # wire target _send_hedged would discard — keep walking
+                # for a genuinely different replica instead of emitting
+                # ghost retarget telemetry for a hedge that never moves
+                exclude = exclude + [node]
+                continue
+            # telemetry lives HERE, where node names are known: the
+            # dispatch.failover event's from/to domain must match
+            # ReplicaDispatcher._note_handoff (node names, not URLs)
+            _wm()["dispatch_failover"].inc(reason="hedge_retarget")
+            from filodb_tpu.utils.devicewatch import FLIGHT
+            FLIGHT.record("dispatch.failover",
+                          dataset=getattr(plan, "dataset", "") or "",
+                          shard=shard, from_node=this_node, to_node=node,
+                          reason="hedge_retarget",
+                          trace_id=plan.query_context.trace_id or "")
+            # normalized like HttpPlanDispatcher.__init__ — a trailing
+            # slash would build "//execplan", missing the exact route
+            return ep.rstrip("/")
+
+    def http_for(node: str) -> Optional[HttpPlanDispatcher]:
+        endpoint = endpoints.get(node)
+        if endpoint is None:
+            return None
+        d = cache.get(node)
+        if d is None:
+            d = cache[node] = HttpPlanDispatcher(
+                endpoint,
+                hedge_alternate=lambda plan, _n=node:
+                    hedge_alternate_for(plan, _n),
+                **kwargs)
+        return d
+
+    def for_node(shard: int, node: str) -> Optional[PlanDispatcher]:
+        if node == local_node:
+            return IN_PROCESS
+        return http_for(node)
+
     def for_shard(shard: int) -> PlanDispatcher:
+        replicas = mapper.replicas(shard)
+        if len(replicas) > 1:
+            return ReplicaDispatcher(mapper.dataset, shard, replica_set,
+                                     for_node)
         node = mapper.coord_for_shard(shard)
         if node is None or node == local_node:
             return IN_PROCESS
-        endpoint = endpoints.get(node)
-        if endpoint is None:
+        d = http_for(node)
+        if d is None:
             # a remote-owned shard with no known endpoint must FAIL the
             # query (or degrade to a warned partial result when the
             # query opts in), never silently scan an empty local store
             return _UnroutableDispatcher(shard, node)
-        d = cache.get(node)
-        if d is None:
-            d = cache[node] = HttpPlanDispatcher(endpoint, **kwargs)
         return d
 
     return for_shard
@@ -359,8 +542,10 @@ class _UnroutableDispatcher(PlanDispatcher):
         self.node = node
 
     def dispatch(self, plan, ctx) -> QueryResult:
-        raise ShardUnavailable(
+        su = ShardUnavailable(
             plan.query_context.query_id,
             f"shard {self.shard} is owned by node {self.node!r} but no "
             f"endpoint is configured for it — refusing to serve it from "
             f"the local store")
+        su.reason = "no_endpoint"
+        raise su

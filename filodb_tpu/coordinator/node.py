@@ -34,7 +34,8 @@ class IngestionCoordinator:
                  stream_factory: IngestionStreamFactory,
                  config: Optional[StoreConfig] = None,
                  event_sink: Optional[Callable[[ShardEvent], None]] = None,
-                 recovery_report_interval: int = 10):
+                 recovery_report_interval: int = 10,
+                 group_head_fn: Optional[Callable[[int], int]] = None):
         self.node = node
         self.dataset = dataset
         self.schemas = schemas
@@ -43,6 +44,13 @@ class IngestionCoordinator:
         self.config = config
         self.event_sink = event_sink or (lambda e: None)
         self.recovery_report_interval = recovery_report_interval
+        # replica-group promotion gate (ISSUE 7): shard -> the group's
+        # gossiped ingest head.  A recovering replica stays RECOVERY
+        # until its own offset reaches max(local checkpoint head, group
+        # head) — so a rejoining node is not promoted to Active while a
+        # caught-up peer is still measurably ahead.  None = rf=1
+        # behavior (local checkpoint head only).
+        self.group_head_fn = group_head_fn
         self._threads: dict[int, threading.Thread] = {}
         self._stops: dict[int, threading.Event] = {}
         self._streams: dict[int, object] = {}  # live stream per shard for teardown
@@ -207,6 +215,12 @@ class IngestionCoordinator:
                 # (/admin/shards flush-queue depth/age, ISSUE 6)
                 sh.flush_scheduler = flush_sched
             n_since_report = 0
+            # the group head only advances on the ~2 s gossip sweeps, so
+            # the promotion target is refreshed on the report cadence
+            # below — recomputing it per replayed record would put a
+            # replica scan + max() in the bulk catch-up hot loop
+            target = self._promotion_target(shard, highest) \
+                if recovering else 0
             # the loop runs until the stream ends: a finite source drains,
             # a live queue delivers the teardown sentinel.  No early exit —
             # dequeued elements are always ingested (at-least-once) and the
@@ -218,14 +232,18 @@ class IngestionCoordinator:
                     flush_sched.note_ingested()
                 if recovering:
                     n_since_report += 1
-                    if offset >= highest:
+                    report_due = (n_since_report
+                                  >= self.recovery_report_interval)
+                    if report_due:
+                        n_since_report = 0
+                        target = self._promotion_target(shard, highest)
+                    if offset >= target:
                         recovering = False
                         self.event_sink(IngestionStarted(self.dataset, shard,
                                                          self.node))
-                    elif n_since_report >= self.recovery_report_interval:
-                        n_since_report = 0
+                    elif report_due:
                         lo = resume_from or 0
-                        span = max(highest - lo, 1)
+                        span = max(target - lo, 1)
                         pct = min(int(100 * (offset - lo) / span), 99)
                         self.event_sink(RecoveryInProgress(
                             self.dataset, shard, self.node, pct))
@@ -241,7 +259,8 @@ class IngestionCoordinator:
                                                  node=self.node))
         except Exception as e:  # noqa: BLE001 — report, don't kill the node
             traceback.print_exc()
-            self.event_sink(IngestionError(self.dataset, shard, str(e)))
+            self.event_sink(IngestionError(self.dataset, shard, str(e),
+                                           node=self.node))
         finally:
             if flush_sched is not None:
                 try:
@@ -255,6 +274,17 @@ class IngestionCoordinator:
                 finally:
                     flush_sched.shard.flush_scheduler = None
             self._cleanup(shard)
+
+    def _promotion_target(self, shard: int, highest: int) -> int:
+        """The offset a recovering replica must reach before promotion:
+        the local checkpoint head, raised to the replica group's
+        gossiped head when one is known (ISSUE 7)."""
+        if self.group_head_fn is None:
+            return highest
+        try:
+            return max(highest, int(self.group_head_fn(shard)))
+        except Exception:  # noqa: BLE001 — gossip mid-shutdown
+            return highest
 
     def flush_loop(self, shard: int, stop: threading.Event,
                    interval_s: float) -> None:
@@ -278,9 +308,11 @@ class NodeCoordinator:
     def setup_dataset(self, dataset: str, schemas: Schemas,
                       stream_factory: IngestionStreamFactory,
                       config: Optional[StoreConfig] = None,
-                      event_sink=None) -> IngestionCoordinator:
+                      event_sink=None,
+                      group_head_fn=None) -> IngestionCoordinator:
         ic = IngestionCoordinator(self.node, dataset, schemas, self.memstore,
-                                  stream_factory, config, event_sink)
+                                  stream_factory, config, event_sink,
+                                  group_head_fn=group_head_fn)
         self.ingestion[dataset] = ic
         return ic
 

@@ -1,0 +1,111 @@
+"""ReplicaSet: THE replica routing policy, in one place.
+
+Every dispatcher site that targets, retargets, hedges, or fails over a
+leaf plan selects its replica through :meth:`ReplicaSet.pick` — never an
+ad-hoc node list (lint-enforced by tests/test_sentinel_lint.py::
+test_replica_routing_goes_through_pick).  Mirrors the reference's
+ActiveShardMapper routing (reference: ShardMapper.activeShard +
+HighAvailabilityPlanner's healthy-replica preference) generalized to
+replica groups (ISSUE 7).
+
+Ordering, healthiest first:
+
+1. **status** — ``Active`` replicas serve; ``Recovery`` replicas are
+   queryable ONLY when the group has no Active peer (a recovering copy
+   is complete up to its watermark but behind the head — serving it
+   while a caught-up peer exists would silently return stale windows);
+   when nothing is queryable yet (cluster start), non-Down replicas
+   serve best-effort, matching the single-copy planner's behavior.
+2. **watermark lag** — gossiped ``group_head - replica watermark``,
+   bucketed by ``lag_tolerance_rows`` so a few in-flight rows of jitter
+   between healthy peers never flaps routing.
+3. **latency** — the local node ranks first (no network hop), then
+   PR 10's calibrated per-endpoint dispatch latency (observed p50).
+4. node name, for a stable total order.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+from filodb_tpu.parallel.shardmap import ShardMapper, ShardStatus
+
+
+class ReplicaSet:
+    """Routing view over one dataset's ShardMapper replica groups."""
+
+    def __init__(self, mapper: ShardMapper,
+                 local_node: Optional[str] = None,
+                 latency_fn: Optional[Callable[[str], Optional[float]]] = None,
+                 lag_tolerance_rows: int = 256):
+        self.mapper = mapper
+        self.local_node = local_node
+        self.latency_fn = latency_fn
+        self.lag_tolerance_rows = max(int(lag_tolerance_rows), 1)
+
+    def _latency_s(self, node: str) -> float:
+        if node == self.local_node:
+            return 0.0
+        if self.latency_fn is not None:
+            lat = self.latency_fn(node)
+            if lat is not None:
+                return float(lat)
+        return float("inf")  # uncalibrated remote: after calibrated ones
+
+    def pick(self, shard: int, exclude: Sequence[str] = ()) -> list[str]:
+        """Ordered candidate nodes for one leaf dispatch, healthiest
+        first.  ``exclude`` removes already-tried (failover) or
+        already-targeted (hedge retarget) replicas.  Empty = no replica
+        may serve — the caller degrades or fails loudly.
+
+        The Recovery gate is evaluated over the WHOLE group, not the
+        post-exclude pool: while ANY Active peer exists (even one that
+        is excluded, slow, or not-yet-demoted dead), a mid-replay
+        Recovery copy must not serve — it would silently answer with
+        windows missing everything between its replay watermark and
+        the head.  Failing loudly for the short detection window beats
+        silently-wrong results."""
+        excluded = set(exclude)
+        group = self.mapper.replicas(shard)
+        reps = [r for r in group if r.node not in excluded]
+        active = [r for r in reps if r.status is ShardStatus.ACTIVE]
+        if active:
+            pool = active
+        elif any(r.status is ShardStatus.ACTIVE for r in group):
+            return []   # the Active peers are excluded/unreachable:
+            #             never silently fall back to a stale copy
+        else:
+            # no Active peer anywhere: Recovery serves; if nothing is
+            # queryable at all, any non-terminal copy is the best
+            # effort.  STOPPED is terminal here too: an operator-
+            # stopped replica's ingest is halted (the fanout refuses to
+            # deliver to it), so serving it would return silently stale
+            # data with no partial-results warning
+            pool = [r for r in reps if r.status is ShardStatus.RECOVERY] \
+                or [r for r in reps
+                    if r.status not in (ShardStatus.DOWN,
+                                        ShardStatus.ERROR,
+                                        ShardStatus.STOPPED)]
+        head = self.mapper.group_head(shard)
+
+        def key(r):
+            if head < 0:
+                lag_bucket = 0          # nobody gossips: all equal
+            elif r.watermark < 0:
+                # UNKNOWN watermark while peers are known: rank worst
+                # in its status tier — a possibly-diverged copy must
+                # not tie with the group head and win on latency
+                lag_bucket = float("inf")
+            else:
+                lag_bucket = max(head - r.watermark, 0) \
+                    // self.lag_tolerance_rows
+            return (lag_bucket, self._latency_s(r.node), r.node)
+
+        return [r.node for r in sorted(pool, key=key)]
+
+    def alternate(self, shard: int,
+                  exclude: Sequence[str] = ()) -> Optional[str]:
+        """The healthiest replica OTHER than ``exclude`` — the hedge
+        retarget and next-failover choice, still through pick()."""
+        order = self.pick(shard, exclude=exclude)
+        return order[0] if order else None

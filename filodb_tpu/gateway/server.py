@@ -11,6 +11,7 @@ Kafka producer.
 
 from __future__ import annotations
 
+import queue
 import socketserver
 import threading
 import time
@@ -19,7 +20,7 @@ from typing import Callable, Mapping, Optional
 from filodb_tpu.core.record import RecordBuilder, decode_container
 from filodb_tpu.core.schemas import DatasetOptions, Schema
 from filodb_tpu.gateway.influx import InfluxParseError, parse_line
-from filodb_tpu.parallel.shardmap import ShardMapper
+from filodb_tpu.parallel.shardmap import ShardMapper, ShardStatus
 from filodb_tpu.utils.observability import TRACER, ingest_metrics
 
 _METRICS = ingest_metrics()
@@ -346,6 +347,276 @@ class ShardingPublisher:
             self.publish(shard, c)
             n += 1
         return n
+
+
+class _FailureEpisodes:
+    """The one failure-telemetry shape for every dual-write delivery
+    path (sync local, lane worker, lane overflow, missing transport).
+    Counter inc per container (total loss must be measurable); flight
+    event once per node EPISODE, re-armed by the next successful
+    delivery — a wedged peer under heavy ingest (thousands of
+    containers/s) must not evict every other diagnostic from the
+    bounded flight ring during exactly the incident the recorder
+    exists for.  Owned per :class:`ReplicaFanout`, NOT module-global:
+    in-process multi-node clusters run one fanout per server for the
+    same dataset, and shared state would let server A's episode
+    suppress server B's first event (the per-server-state lesson of
+    PR 11's WatermarkLedger)."""
+
+    def __init__(self, dataset: str):
+        self.dataset = dataset
+        self._failing: set = set()
+        self._lock = threading.Lock()
+
+    def fail(self, node: str, shard: int, error: str) -> None:
+        _METRICS["replica_publish_failures"].inc(dataset=self.dataset,
+                                                 node=node)
+        with self._lock:
+            first = node not in self._failing
+            if first:
+                self._failing.add(node)
+        if first:
+            from filodb_tpu.utils.devicewatch import FLIGHT
+            FLIGHT.record("ingest.replica_publish_failed",
+                          dataset=self.dataset, shard=shard, node=node,
+                          error=error[:200])
+
+    def ok(self, node: str) -> None:
+        """A successful delivery ends the node's failure episode — the
+        NEXT failure flight-records again."""
+        with self._lock:
+            self._failing.discard(node)
+
+
+_LANE_STOP = object()
+
+
+class _ReplicaLane:
+    """One PEER's asynchronous delivery lane: a bounded queue drained
+    by a daemon worker.  A wedged peer fills its own lane and starts
+    dropping (counted, flight-recorded) — it can never stall the
+    gateway publish path or the other replicas' deliveries."""
+
+    def __init__(self, dataset: str, node: str,
+                 push: Callable[[int, bytes], None], max_queued: int,
+                 episodes: _FailureEpisodes):
+        self.dataset = dataset
+        self.node = node
+        self.push = push
+        self.episodes = episodes
+        self._stopped = False
+        self._q: "queue.Queue" = queue.Queue(maxsize=max_queued)
+        self._thread = threading.Thread(
+            target=self._run, name=f"replica-push-{dataset}-{node}",
+            daemon=True)
+        self._thread.start()
+
+    def enqueue(self, shard: int, container: bytes) -> bool:
+        try:
+            self._q.put_nowait((shard, container))
+            return True
+        except queue.Full:
+            self.episodes.fail(self.node, shard,
+                               "delivery queue full (peer wedged or "
+                               "unreachable)")
+            return False
+
+    def _run(self) -> None:
+        while not self._stopped:
+            try:
+                item = self._q.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            if item is _LANE_STOP or self._stopped:
+                self._q.task_done()
+                break
+            shard, container = item
+            try:
+                self.push(shard, container)
+                _METRICS["replica_publishes"].inc(dataset=self.dataset,
+                                                  node=self.node)
+                self.episodes.ok(self.node)
+            except Exception as e:  # noqa: BLE001 — this replica lags
+                self.episodes.fail(self.node, shard, str(e))
+            finally:
+                self._q.task_done()
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """Best-effort wait for the lane to empty (tests/shutdown)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._q.unfinished_tasks == 0:
+                return True
+            time.sleep(0.005)
+        return False
+
+    def close(self) -> None:
+        """Stop the worker NOW; still-queued containers are dropped.
+        A node being shut down must not keep delivering to peers from
+        beyond the grave — callers that want a flush first call
+        :meth:`drain` before closing."""
+        self._stopped = True
+        try:
+            self._q.put_nowait(_LANE_STOP)
+        except queue.Full:
+            pass  # worker notices _stopped within its 250 ms poll
+        self._thread.join(timeout=2.0)
+
+
+class ReplicaFanout:
+    """Dual-write publish hook (ISSUE 7): delivers each container to
+    EVERY replica of its shard.
+
+    Plugs in as a ShardingPublisher ``publish`` callable.  The replica
+    set comes from the mapper at publish time (a membership change
+    reroutes the very next container), and each replica node maps to
+    its own transport — the local in-proc queue for this node
+    (synchronous: local ingest stays in lockstep with the gateway), an
+    HTTP container push (``/ingest/<ds>/<shard>``) for peers, delivered
+    through per-peer ASYNC lanes (:class:`_ReplicaLane`) so one
+    slow/wedged peer can neither stall the gateway nor the other
+    replicas.  A failed or overflowed per-replica delivery is counted
+    and flight-recorded; the lagging replica is visibly behind in its
+    recovery watermarks (PR 11 ledger chain).  Queue-transport
+    replication is best-effort per replica — the broker transport is
+    the durable replicated log.
+
+    Broker-backed datasets do NOT need this: the shared partition log
+    IS the replicated stream (one produce, every replica consumes at
+    its own offset) — exactly the reference's Kafka model."""
+
+    def __init__(self, dataset: str, mapper: ShardMapper,
+                 publish_for_node: Mapping[str, Callable[[int, bytes], None]],
+                 local_node: Optional[str] = None,
+                 max_queued_per_peer: int = 1024):
+        self.dataset = dataset
+        self.mapper = mapper
+        self.publish_for_node = dict(publish_for_node)
+        self.local_node = local_node
+        self.max_queued_per_peer = max_queued_per_peer
+        self._closed = False
+        self._episodes = _FailureEpisodes(dataset)
+        # shards currently dropping because every copy is terminal —
+        # gates the once-per-episode flight event
+        self._dropping_shards: set = set()
+        self._lanes: dict[str, _ReplicaLane] = {}
+        self._lane_lock = threading.Lock()
+
+    def _lane(self, node: str) -> Optional[_ReplicaLane]:
+        with self._lane_lock:
+            if self._closed:
+                return None
+            lane = self._lanes.get(node)
+            if lane is None:
+                lane = self._lanes[node] = _ReplicaLane(
+                    self.dataset, node, self.publish_for_node[node],
+                    self.max_queued_per_peer, self._episodes)
+            return lane
+
+    def __call__(self, shard: int, container: bytes) -> int:
+        """Publish to every LIVE replica; returns deliveries that
+        succeeded synchronously or were accepted into a peer lane.
+        Terminal Down/Error copies are skipped — a permanently-dead
+        peer must not pin a full lane and burn a connect attempt +
+        failure event per container forever; it rejoins via checkpoint
+        replay (broker) or accepts its divergence (queue transport,
+        doc/ha.md)."""
+        if self._closed:
+            return 0
+        # STOPPED joins Down/Error here: an operator-stopped replica's
+        # ingestion consumer is not running (runnable_shards_for_node),
+        # so delivering to it would buffer containers into an unbounded
+        # queue nothing drains until OOM
+        skip = (ShardStatus.DOWN, ShardStatus.ERROR, ShardStatus.STOPPED)
+        nodes = [r.node for r in self.mapper.replicas(shard)
+                 if r.status not in skip]
+        if not nodes:
+            if self.local_node is not None \
+                    and not self.mapper.replicas(shard):
+                # shard not assigned ANYWHERE yet (startup): keep data
+                # flowing locally.  An assigned group that is all-
+                # terminal is NOT rerouted here — buffering into a
+                # queue no local consumer drains would grow unboundedly
+                # and the copies rejoin from their own checkpoints,
+                # never from this queue
+                nodes = [self.local_node]
+            else:
+                # EVERY assigned copy is terminal: the container is
+                # dropped.  One counter inc per container (total loss
+                # must be measurable), one flight event per episode
+                # (heavy ingest must not flood the ring)
+                _METRICS["replica_publish_failures"].inc(
+                    dataset=self.dataset, node="(all-terminal)")
+                if shard not in self._dropping_shards:
+                    self._dropping_shards.add(shard)
+                    from filodb_tpu.utils.devicewatch import FLIGHT
+                    FLIGHT.record("ingest.replica_publish_failed",
+                                  dataset=self.dataset, shard=shard,
+                                  node="(all-terminal)",
+                                  error="every replica is Down/Error/"
+                                        "Stopped — containers dropped")
+                return 0
+        self._dropping_shards.discard(shard)
+        delivered = 0
+        for node in nodes:
+            pub = self.publish_for_node.get(node)
+            if pub is None:
+                self._episodes.fail(node, shard,
+                                    "no transport configured for "
+                                    "this replica's node")
+                continue
+            if node == self.local_node:
+                try:
+                    pub(shard, container)
+                    delivered += 1
+                    _METRICS["replica_publishes"].inc(
+                        dataset=self.dataset, node=node)
+                    self._episodes.ok(node)
+                except Exception as e:  # noqa: BLE001 — local queue gone
+                    self._episodes.fail(node, shard, str(e))
+            else:
+                lane = self._lane(node)
+                if lane is not None and lane.enqueue(shard, container):
+                    delivered += 1
+        return delivered
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """Wait for every peer lane to empty (tests/shutdown)."""
+        with self._lane_lock:
+            lanes = list(self._lanes.values())
+        return all(lane.drain(timeout_s) for lane in lanes)
+
+    def close(self) -> None:
+        """Stop every peer lane (undelivered containers are dropped)
+        and refuse further publishes.  Wired into FiloServer.shutdown —
+        without it a 'killed' in-process node's lanes would keep
+        POSTing buffered containers to surviving peers."""
+        with self._lane_lock:
+            self._closed = True
+            lanes = list(self._lanes.values())
+            self._lanes.clear()
+        for lane in lanes:
+            lane.close()
+
+
+def http_container_push(endpoint: str, dataset: str,
+                        timeout_s: float = 5.0
+                        ) -> Callable[[int, bytes], None]:
+    """A per-node publish callable shipping containers to a peer's
+    ``POST /ingest/<dataset>/<shard>`` edge (the queue-transport leg of
+    the dual-write fanout; broker transports never need it)."""
+    import urllib.request
+    base = endpoint.rstrip("/")
+
+    def push(shard: int, container: bytes) -> None:
+        req = urllib.request.Request(
+            f"{base}/ingest/{dataset}/{shard}", data=container,
+            method="POST",
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(req, timeout=timeout_s):
+            pass
+
+    return push
 
 
 class GatewayServer:

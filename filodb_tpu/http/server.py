@@ -118,6 +118,11 @@ class FiloHttpServer:
     # standalone server installs a configured one (broker end offsets,
     # stall window), bare servers get a lazy default over their bindings
     watermarks: Optional[object] = None
+    # replica dual-write receiver (ISSUE 7): (dataset, shard, container)
+    # -> offset, backing POST /ingest/<ds>/<shard> for queue-transport
+    # replication; None = the route 404s (broker transports do not
+    # need it — the shared partition log is the replicated stream)
+    ingest_sink: Optional[object] = None
     datasets: dict = field(default_factory=dict)
     _httpd: Optional[ThreadingHTTPServer] = None
     _thread: Optional[threading.Thread] = None
@@ -177,6 +182,9 @@ class FiloHttpServer:
             return
         if req.path.split("?")[0] == "/execplan" and method == "POST":
             self._handle_execplan(req)
+            return
+        if req.path.split("?")[0].startswith("/ingest/") and method == "POST":
+            self._handle_ingest_push(req)
             return
         bare = req.path.split("?")[0]
         if method == "POST" and (bare.endswith("/api/v1/read")
@@ -337,6 +345,42 @@ class FiloHttpServer:
         except Exception as e:  # noqa: BLE001
             code, out = 500, error_response("internal", str(e))
         _METRICS["execplan_seconds"].observe(time.perf_counter() - t0)
+        data = json.dumps(out).encode()
+        try:
+            req.send_response(code)
+            req.send_header("Content-Type", "application/json")
+            req.send_header("Content-Length", str(len(data)))
+            req.end_headers()
+            req.wfile.write(data)
+        except Exception:  # noqa: BLE001 — client went away
+            pass
+
+    def _handle_ingest_push(self, req: BaseHTTPRequestHandler) -> None:
+        """Replica dual-write receiver (ISSUE 7): a peer gateway POSTs a
+        raw record container for one shard; it lands on this node's
+        ingest stream exactly like a locally-published one."""
+        t0 = time.perf_counter()
+        try:
+            parts = [p for p in req.path.split("?")[0].split("/") if p]
+            ln = int(req.headers.get("Content-Length") or 0)
+            body = req.rfile.read(ln) if ln else b""
+            if self.ingest_sink is None or len(parts) != 3:
+                code, out = 404, error_response(
+                    "bad_data", "container-push ingest not enabled here")
+            elif not body:
+                code, out = 400, error_response("bad_data",
+                                                "empty container")
+            else:
+                offset = self.ingest_sink(parts[1], int(parts[2]), body)
+                code, out = 200, {"status": "success",
+                                  "offset": offset}
+        except (ValueError, KeyError) as e:
+            code, out = 400, error_response("bad_data", str(e))
+        except Exception as e:  # noqa: BLE001
+            code, out = 500, error_response("internal", str(e))
+        _METRICS["request_seconds"].observe(time.perf_counter() - t0,
+                                            endpoint="ingest_push")
+        _METRICS["requests"].inc(endpoint="ingest_push", code=str(code))
         data = json.dumps(out).encode()
         try:
             req.send_response(code)
@@ -1053,14 +1097,28 @@ class FiloHttpServer:
     @_timed("health")
     def _health(self) -> tuple[int, dict]:
         """Shard statuses per dataset (reference: HealthRoute returning
-        ShardStatus list)."""
+        ShardStatus list).  Each row carries the full replica group
+        (ISSUE 7) — the status poller gossips membership, per-replica
+        status, and ingest watermarks from this payload."""
         out = {}
         if self.shard_manager is not None:
             for ds in self.shard_manager.datasets():
                 m = self.shard_manager.mapper(ds)
-                out[ds] = [{"shard": s, "status": m.status(s).value,
-                            "node": m.coord_for_shard(s)}
-                           for s in range(m.num_shards)]
+                # SERVING view at the shard level (best replica): one
+                # dead copy of an otherwise fully-served shard must not
+                # flip healthy:false and let a load balancer drain a
+                # cluster that serves 100% of the data.  Per-replica
+                # truth rides in the "replicas" rows, which is what the
+                # gossip consumers read on replicated payloads.
+                out[ds] = [
+                    {"shard": s, "status": m.best_status(s).value,
+                     "node": m.coord_for_shard(s),
+                     "replicas": [
+                         {"node": r.node, "status": r.status.value,
+                          "progress": r.recovery_progress,
+                          "watermark": r.watermark}
+                         for r in m.replicas(s)]}
+                    for s in range(m.num_shards)]
         else:
             for ds, b in self.datasets.items():
                 out[ds] = [{"shard": sh.shard_num, "status": "Active",
@@ -1072,6 +1130,17 @@ class FiloHttpServer:
         if self.running_shards is not None:
             body["running"] = {ds: self.running_shards(ds)
                                for ds in (out or self.datasets)}
+        # per-shard ingested offsets: the peer-side source for replica
+        # watermarks (group head = max across the group)
+        wms: dict = {}
+        for ds, b in self.datasets.items():
+            try:
+                wms[ds] = {sh.shard_num: sh.latest_offset
+                           for sh in b.memstore.shards(ds)}
+            except Exception:  # noqa: BLE001 — store mid-shutdown
+                continue
+        if wms:
+            body["watermarks"] = wms
         if self.node_name:
             body["node"] = self.node_name
         return (200 if healthy else 503), body
@@ -1089,10 +1158,24 @@ class FiloHttpServer:
         action = parts[1] if len(parts) > 1 else "status"
         m = self.shard_manager.mapper(ds)
         if action == "status":
-            return 200, {"status": "success",
-                         "data": [{"shard": s, "status": m.status(s).value,
-                                   "node": m.coord_for_shard(s)}
-                                  for s in range(m.num_shards)]}
+            # SERVING view (ISSUE 7): a shard with any queryable
+            # replica reports that status — a dead primary must not
+            # show a served shard as down; the replicas list carries
+            # each copy's own truth
+            rows = []
+            for s in range(m.num_shards):
+                st = m.state(s)
+                best = st.best_status
+                serving = st.serving_replica()
+                rows.append({
+                    "shard": s, "status": best.value,
+                    "node": serving.node if serving is not None
+                    else st.node,
+                    "replicas": [{"node": r.node,
+                                  "status": r.status.value,
+                                  "watermark": r.watermark}
+                                 for r in st.replicas]})
+            return 200, {"status": "success", "data": rows}
         shards = [int(s) for s in str(params.get("shards", "")).split(",") if s]
         if action == "startshards":
             done = self.shard_manager.start_shards(ds, shards,

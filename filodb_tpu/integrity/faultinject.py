@@ -177,6 +177,7 @@ class FlakyTcpProxy:
         self.refused = 0
         self.stalled = 0
         self._plan: collections.deque = collections.deque()
+        self._blackhole = False
         self._lock = threading.Lock()
         self._server: Optional[socketserver.ThreadingTCPServer] = None
 
@@ -191,11 +192,24 @@ class FlakyTcpProxy:
     def _next_mode(self) -> str:
         with self._lock:
             self.connections += 1
+            if self._blackhole:
+                # node-level partition (ISSUE 7): EVERY connection dies
+                # until heal() — an explicit plan cannot override it
+                return "refuse"
             if self._plan:
                 return self._plan.popleft()
             if self.failure_rate and self.rng.random() < self.failure_rate:
                 return "refuse"
             return "pass"
+
+    # ---- persistent node-level modes (ISSUE 7 chaos controller) ----
+
+    def blackhole(self, on: bool = True) -> None:
+        """Partition this endpoint: refuse every connection until
+        ``blackhole(False)`` — unlike the per-connection plan, this is
+        a STATE, so in-flight reconnects/retries keep failing."""
+        with self._lock:
+            self._blackhole = bool(on)
 
     def start(self) -> int:
         proxy = self
@@ -262,3 +276,94 @@ class FlakyTcpProxy:
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Node-level chaos (ISSUE 7: replica-group HA testing)
+# ---------------------------------------------------------------------------
+
+
+class NodeChaosController:
+    """Deterministic node-level faults for in-process multi-node
+    clusters (ISSUE 7): kill a node mid-query/mid-ingest, partition it
+    from its peers, stall its connections, and later restart it.
+
+    Each node registers a ``kill_fn`` (hard process-death stand-in — an
+    abrupt FiloServer teardown with NO graceful flush beyond in-flight
+    tasks, so the checkpoint stays behind the head exactly like a real
+    crash) plus optionally a :class:`FlakyTcpProxy` fronting its HTTP
+    endpoint, which lets partitions and stalls hit both peer gossip and
+    dispatch traffic without taking the node down.  Everything is
+    explicit and synchronous — a failing chaos test reproduces exactly
+    (the FaultInjector contract)."""
+
+    def __init__(self):
+        self._nodes: dict[str, dict] = {}
+        self.events: list[tuple[str, str]] = []  # (action, node), ordered
+
+    def register(self, name: str, kill_fn=None,
+                 proxy: Optional[FlakyTcpProxy] = None) -> None:
+        self._nodes[name] = {"kill": kill_fn, "proxy": proxy,
+                             "killed": False}
+
+    def _note(self, action: str, node: str) -> None:
+        self.events.append((action, node))
+        from filodb_tpu.utils.devicewatch import FLIGHT
+        FLIGHT.record("chaos." + action, node=node)
+
+    def kill(self, name: str) -> None:
+        """Hard-stop the node: its HTTP endpoint dies (peers see
+        connection failures, heartbeats lapse), its ingest consumers
+        stop, nothing graceful beyond in-flight work."""
+        ent = self._nodes[name]
+        if ent["killed"]:
+            return
+        ent["killed"] = True
+        if ent["proxy"] is not None:
+            ent["proxy"].blackhole(True)
+        if ent["kill"] is not None:
+            ent["kill"]()
+        self._note("kill", name)
+
+    def partition(self, name: str) -> None:
+        """Cut the node off from its peers (proxy blackhole) while the
+        node itself keeps running — the classic asymmetric partition."""
+        proxy = self._nodes[name]["proxy"]
+        if proxy is None:
+            raise ValueError(f"node {name} has no chaos proxy")
+        proxy.blackhole(True)
+        self._note("partition", name)
+
+    def stall(self, name: str, n: int = 1,
+              stall_s: Optional[float] = None) -> None:
+        """Stall the node's next ``n`` connections (tail-latency/wedge
+        injection for hedging + failover paths)."""
+        proxy = self._nodes[name]["proxy"]
+        if proxy is None:
+            raise ValueError(f"node {name} has no chaos proxy")
+        if stall_s is not None:
+            proxy.stall_s = float(stall_s)
+        proxy.stall_next(n)
+        self._note("stall", name)
+
+    def heal(self, name: str) -> None:
+        """Lift a partition (kills need :meth:`restart`)."""
+        proxy = self._nodes[name]["proxy"]
+        if proxy is not None:
+            proxy.blackhole(False)
+        self._note("heal", name)
+
+    def restart(self, name: str, start_fn) -> object:
+        """Mark the node live again and run ``start_fn`` (typically
+        builds a fresh FiloServer over the same data-dir, re-registering
+        its kill hook); returns start_fn's result."""
+        ent = self._nodes[name]
+        if ent["proxy"] is not None:
+            ent["proxy"].blackhole(False)
+        ent["killed"] = False
+        out = start_fn()
+        self._note("restart", name)
+        return out
+
+    def killed(self, name: str) -> bool:
+        return self._nodes[name]["killed"]

@@ -184,10 +184,30 @@ class WatermarkLedger:
             row["flush"] = flush
         if watch.mapper is not None:
             st = watch.mapper.state(sh.shard_num)
-            row["status"] = st.status.value
-            row["queryable"] = st.status.queryable
-            row["owner"] = st.node
-            row["recovery_progress"] = st.recovery_progress
+            # the SERVING view, matching what query routing does: a
+            # shard with any queryable replica reports that (best)
+            # status — a dead primary must not show a served shard as
+            # down (the per-replica rows below carry each copy's truth)
+            serving = st.serving_replica()
+            best = st.best_status
+            row["status"] = best.value
+            row["queryable"] = best.queryable
+            row["owner"] = serving.node if serving is not None else st.node
+            row["recovery_progress"] = serving.recovery_progress \
+                if serving is not None else st.recovery_progress
+            if st.replicas:
+                # per-replica divergence view (ISSUE 7): each copy's
+                # node, status, and watermark lag behind the group head
+                # — a lagging replica is visibly behind, never silently
+                # wrong
+                head = watch.mapper.group_head(sh.shard_num)
+                row["replicas"] = [
+                    {"node": r.node, "status": r.status.value,
+                     "recovery_progress": r.recovery_progress,
+                     "watermark": r.watermark,
+                     "lag_rows": max(head - r.watermark, 0)
+                     if head >= 0 and r.watermark >= 0 else None}
+                    for r in st.replicas]
         return row
 
     def sample(self) -> dict:

@@ -6,6 +6,15 @@ Pure-function port-of-concept of the reference's ShardMapper
 queryShards returns the 2^spread shards holding one shard key) plus the
 ShardStatus lifecycle (ShardStatus.scala:54-94).  TPU mapping: a shard is a
 slice of the mesh's data axis; ``coord_for_shard`` is the host/device owner.
+
+Replica groups (ISSUE 7): each shard is held by up to
+``replication_factor`` DISTINCT nodes; :class:`ReplicaState` tracks
+per-replica status, recovery progress, and ingest watermark (the
+gossiped ``latest_offset``, feeding the group head that gates recovery
+promotion and the failover router's lag ordering).  The legacy
+single-copy surface (``coord_for_shard`` / ``status`` / ``state``)
+reads the shard's PRIMARY (first) replica, so ``replication_factor=1``
+behaves exactly as before.
 """
 
 from __future__ import annotations
@@ -49,17 +58,83 @@ def _health_m() -> dict:
 
 
 @dataclasses.dataclass
-class ShardState:
-    status: ShardStatus = ShardStatus.UNASSIGNED
-    node: Optional[str] = None
+class ReplicaState:
+    """One node's copy of one shard."""
+
+    node: str
+    status: ShardStatus = ShardStatus.ASSIGNED
     recovery_progress: int = 0  # percent
+    # last gossiped ingested offset (-1 = unknown); feeds group_head()
+    watermark: int = -1
+
+
+class ShardState:
+    """Per-shard replica group.  The legacy single-copy attributes
+    (``status`` / ``node`` / ``recovery_progress``) read the PRIMARY
+    (first) replica so rf=1 callers see exactly the old shape."""
+
+    __slots__ = ("replicas",)
+
+    def __init__(self, status: ShardStatus = ShardStatus.UNASSIGNED,
+                 node: Optional[str] = None, recovery_progress: int = 0):
+        self.replicas: list[ReplicaState] = []
+        if node is not None:
+            self.replicas.append(ReplicaState(node, status,
+                                              recovery_progress))
+
+    def replica(self, node: str) -> Optional[ReplicaState]:
+        for r in self.replicas:
+            if r.node == node:
+                return r
+        return None
+
+    # -- legacy single-copy view (primary replica) --------------------------
+
+    @property
+    def status(self) -> ShardStatus:
+        return self.replicas[0].status if self.replicas \
+            else ShardStatus.UNASSIGNED
+
+    @property
+    def node(self) -> Optional[str]:
+        return self.replicas[0].node if self.replicas else None
+
+    @property
+    def recovery_progress(self) -> int:
+        return self.replicas[0].recovery_progress if self.replicas else 0
+
+    @property
+    def best_status(self) -> ShardStatus:
+        """The most-servable status across replicas: a shard with ANY
+        Active replica serves normally even while a peer recovers."""
+        best = ShardStatus.UNASSIGNED
+        rank = {ShardStatus.ACTIVE: 6, ShardStatus.RECOVERY: 5,
+                ShardStatus.ASSIGNED: 4, ShardStatus.STOPPED: 3,
+                ShardStatus.ERROR: 2, ShardStatus.DOWN: 1,
+                ShardStatus.UNASSIGNED: 0}
+        for r in self.replicas:
+            if rank[r.status] > rank[best]:
+                best = r.status
+        return best
+
+    def serving_replica(self) -> Optional[ReplicaState]:
+        """The replica holding the best (serving) status — THE
+        definition every operator surface (/admin/shards,
+        /api/v1/cluster status) reports, so the views cannot drift."""
+        best = self.best_status
+        return next((r for r in self.replicas if r.status is best), None)
 
 
 class ShardMapper:
-    def __init__(self, num_shards: int, dataset: str = ""):
+    def __init__(self, num_shards: int, dataset: str = "",
+                 replication_factor: int = 1):
         if num_shards <= 0 or num_shards & (num_shards - 1):
             raise ValueError(f"num_shards {num_shards} must be a power of 2")
+        if replication_factor < 1:
+            raise ValueError(
+                f"replication_factor {replication_factor} must be >= 1")
         self.num_shards = num_shards
+        self.replication_factor = replication_factor
         # named mappers (cluster-managed) emit shard-health metrics and
         # flight events on status changes; anonymous ones (benches,
         # ad-hoc tests) stay silent
@@ -89,24 +164,187 @@ class ShardMapper:
     # -- assignment / status ------------------------------------------------
 
     def register_node(self, shards: Sequence[int], node: str) -> None:
+        """Add ``node`` as a replica of each shard (refreshing it to
+        ASSIGNED when already present).  With ``replication_factor=1``
+        the replica set is REPLACED — the legacy single-owner move
+        semantics (leader-view adoption, reassignment).  With rf>1 a
+        full group replaces its least-healthy non-live replica (the
+        failover reassignment path) and never holds the same node
+        twice."""
         for s in shards:
-            prev = self._states[s].status
-            self._states[s] = ShardState(ShardStatus.ASSIGNED, node)
-            self._note_status(s, prev, ShardStatus.ASSIGNED, 0)
+            st = self._states[s]
+            prev = st.status
+            rep = st.replica(node)
+            if rep is not None:
+                r_prev = rep.status
+                rep.status = ShardStatus.ASSIGNED
+                rep.recovery_progress = 0
+                if r_prev in (ShardStatus.DOWN, ShardStatus.ERROR):
+                    # rejoin: the node restarted and replays from its
+                    # checkpoint — its pre-crash watermark is stale and
+                    # max-only note_watermark would pin it forever,
+                    # hiding the replay regression from lag views
+                    rep.watermark = -1
+                self._note_replica(s, node, r_prev, ShardStatus.ASSIGNED, 0)
+            elif self.replication_factor == 1:
+                for old in st.replicas:  # displaced: gauge row removed
+                    self._note_replica(s, old.node, old.status,
+                                       ShardStatus.UNASSIGNED, 0)
+                st.replicas = [ReplicaState(node)]
+                self._note_replica(s, node, ShardStatus.UNASSIGNED,
+                                   ShardStatus.ASSIGNED, 0)
+            else:
+                if len(st.replicas) >= self.replication_factor:
+                    # replace a dead copy; refuse to displace live ones
+                    dead = [i for i, r in enumerate(st.replicas)
+                            if r.status in (ShardStatus.DOWN,
+                                            ShardStatus.ERROR)]
+                    if not dead:
+                        continue
+                    old = st.replicas[dead[0]]
+                    self._note_replica(s, old.node, old.status,
+                                       ShardStatus.UNASSIGNED, 0)
+                    # copy-swap, never in-place: /health and the
+                    # watermark ledger iterate st.replicas WITHOUT the
+                    # manager lock and must always see a complete group
+                    reps = list(st.replicas)
+                    reps[dead[0]] = ReplicaState(node)
+                    st.replicas = reps
+                else:
+                    st.replicas = st.replicas + [ReplicaState(node)]
+                self._note_replica(s, node, ShardStatus.UNASSIGNED,
+                                   ShardStatus.ASSIGNED, 0)
+            self._note_status(s, prev, st.status, st.recovery_progress)
 
     def update_status(self, shard: int, status: ShardStatus,
-                      progress: int = 0) -> None:
+                      progress: int = 0, node: Optional[str] = None) -> None:
+        """Update ONE replica's status: the replica owned by ``node``
+        when given (ignored if that node holds no copy), else the
+        primary replica (the only one at rf=1)."""
         st = self._states[shard]
-        prev, prev_progress = st.status, st.recovery_progress
-        st.status = status
-        st.recovery_progress = progress
-        if prev is not status or prev_progress != progress:
-            self._note_status(shard, prev, status, progress)
+        rep = st.replica(node) if node is not None \
+            else (st.replicas[0] if st.replicas else None)
+        if rep is None:
+            return
+        prev_shard, prev_progress_shard = st.status, st.recovery_progress
+        r_prev, r_prev_progress = rep.status, rep.recovery_progress
+        rep.status = status
+        rep.recovery_progress = progress
+        if r_prev is not status or r_prev_progress != progress:
+            self._note_replica(shard, rep.node, r_prev, status, progress)
+        if prev_shard is not st.status \
+                or prev_progress_shard != st.recovery_progress:
+            self._note_status(shard, prev_shard, st.status,
+                              st.recovery_progress)
 
-    def unassign(self, shard: int) -> None:
-        prev = self._states[shard].status
-        self._states[shard] = ShardState()
-        self._note_status(shard, prev, ShardStatus.UNASSIGNED, 0)
+    def set_replicas(self, shard: int, rows: Sequence[dict]) -> bool:
+        """Adopt a leader-snapshot replica group wholesale (gossip:
+        every node caches the singleton's ShardMapper snapshots).
+        ``rows``: ``[{"node", "status", "progress", "watermark"}]``.
+        Membership is replaced; replicas this node already tracked keep
+        their LOCAL status (per-replica liveness is per-node ground
+        truth), newly-learned replicas take the leader's status.
+        Returns True when membership changed."""
+        st = self._states[shard]
+        # shard-level prev BEFORE any mutation: kept replicas are
+        # updated in place below, so reading st.status afterwards would
+        # compare the new primary status with itself and never fire the
+        # shard-level transition (gauge + flight event) on adoption
+        prev = st.status
+        want = [r for r in rows if r.get("node")]
+        want_nodes = [r["node"] for r in want]
+        have_nodes = [r.node for r in st.replicas]
+        changed = set(want_nodes) != set(have_nodes)
+        keep = {r.node: r for r in st.replicas if r.node in want_nodes}
+        terminal = (ShardStatus.DOWN, ShardStatus.STOPPED)
+        new_reps: list[ReplicaState] = []
+        for row in want:
+            node = row["node"]
+            rep = keep.get(node)
+            if rep is None:
+                try:
+                    status = ShardStatus(row.get("status"))
+                except ValueError:
+                    status = ShardStatus.ASSIGNED
+                rep = ReplicaState(node, status,
+                                   int(row.get("progress") or 0),
+                                   int(row.get("watermark", -1)))
+                self._note_replica(shard, node, ShardStatus.UNASSIGNED,
+                                   status, rep.recovery_progress)
+            else:
+                rep.watermark = max(rep.watermark,
+                                    int(row.get("watermark", -1)))
+                try:
+                    leader_status = ShardStatus(row.get("status"))
+                except ValueError:
+                    leader_status = None
+                if leader_status is not None and \
+                        (leader_status in terminal) \
+                        != (rep.status in terminal):
+                    # leader INTENT (demotion to Down/Stopped, or the
+                    # resurrection of a rejoined node) crosses the
+                    # down boundary and must propagate to followers —
+                    # keeping the local stale Active would route every
+                    # query at a dead replica forever.  WITHIN live
+                    # states (Active/Recovery/Assigned) the local
+                    # liveness view of the peer stays authoritative.
+                    r_prev = rep.status
+                    rep.status = leader_status
+                    rep.recovery_progress = int(row.get("progress") or 0)
+                    # boundary crossing also RESETS the watermark to
+                    # the leader's view: a resurrected node replays
+                    # from its checkpoint, and max-merging would pin
+                    # its pre-crash offset forever
+                    rep.watermark = int(row.get("watermark", -1))
+                    self._note_replica(shard, node, r_prev, leader_status,
+                                       rep.recovery_progress)
+            new_reps.append(rep)
+        for rep in st.replicas:
+            if rep.node not in want_nodes:
+                self._note_replica(shard, rep.node, rep.status,
+                                   ShardStatus.UNASSIGNED, 0)
+        st.replicas = new_reps
+        if prev is not st.status:
+            self._note_status(shard, prev, st.status, st.recovery_progress)
+        else:
+            # newly-learned replicas were noted BEFORE the swap, when
+            # best_status couldn't see them yet — refresh after it can
+            self._refresh_shard_gauge(shard)
+        return changed
+
+    def note_watermark(self, shard: int, node: str, offset: int) -> None:
+        """Record a replica's gossiped ingested offset (silent: the
+        watermark ledger owns the metric surface for offsets)."""
+        rep = self._states[shard].replica(node)
+        if rep is not None:
+            rep.watermark = max(rep.watermark, int(offset))
+
+    def group_head(self, shard: int) -> int:
+        """The replica group's ingest head: the max gossiped watermark
+        across the group (-1 when nothing is known).  A recovering
+        replica is promoted only once its own offset reaches this."""
+        wms = [r.watermark for r in self._states[shard].replicas]
+        return max(wms) if wms else -1
+
+    def unassign(self, shard: int, node: Optional[str] = None) -> None:
+        """Drop a replica (``node`` given) or the whole group."""
+        st = self._states[shard]
+        prev = st.status
+        if node is not None:
+            rep = st.replica(node)
+            if rep is None:
+                return
+            # copy-swap (unlocked readers iterate st.replicas)
+            st.replicas = [r for r in st.replicas if r is not rep]
+            self._note_replica(shard, node, rep.status,
+                               ShardStatus.UNASSIGNED, 0)
+        else:
+            for r in st.replicas:
+                self._note_replica(shard, r.node, r.status,
+                                   ShardStatus.UNASSIGNED, 0)
+            st.replicas = []
+        if prev is not st.status:
+            self._note_status(shard, prev, st.status, st.recovery_progress)
 
     def _note_status(self, shard: int, prev: ShardStatus,
                      status: ShardStatus, progress: int) -> None:
@@ -117,46 +355,112 @@ class ShardMapper:
         if not self.dataset:
             return
         m = _health_m()
-        m["status_code"].set(_STATUS_CODE[status], dataset=self.dataset,
-                             shard=shard)
+        self._refresh_shard_gauge(shard)
         m["recovery_progress"].set(progress, dataset=self.dataset,
                                    shard=shard)
         if prev is not status:
-            m["transitions"].inc(dataset=self.dataset, status=status.value)
+            # the transition COUNTER is owned by the per-replica path
+            # (_note_replica) — at rf=1 replica transitions == shard
+            # transitions, and at rf>1 every lost/recovered copy counts
             from filodb_tpu.utils.devicewatch import FLIGHT
             FLIGHT.record("shard.status", dataset=self.dataset, shard=shard,
                           status=status.value, prev=prev.value,
                           progress=progress)
 
+    def _refresh_shard_gauge(self, shard: int) -> None:
+        """filodb_shard_status_code reports the SERVING view (best
+        replica), matching /admin/shards, /api/v1/cluster and /__health
+        — a dead primary with a surviving Active peer must not page
+        'shard down' for a fully-served shard.  Refreshed after every
+        replica transition, since any copy's change can move the best."""
+        if not self.dataset:
+            return
+        _health_m()["status_code"].set(
+            _STATUS_CODE[self._states[shard].best_status],
+            dataset=self.dataset, shard=shard)
+
+    def _note_replica(self, shard: int, node: str, prev: ShardStatus,
+                      status: ShardStatus, progress: int) -> None:
+        """Per-replica health emission (ISSUE 7): the replica-status
+        gauge row is keyed by node so operators can see ONE copy down
+        while the shard gauge (serving view) stays green.  rf=1 named
+        mappers emit both rows — the replica row is the per-copy truth,
+        the shard row the serving view."""
+        if not self.dataset:
+            return
+        m = _health_m()
+        self._refresh_shard_gauge(shard)
+        if status is ShardStatus.UNASSIGNED:
+            m["replica_status_code"].remove(dataset=self.dataset,
+                                            shard=shard, node=node)
+        else:
+            m["replica_status_code"].set(_STATUS_CODE[status],
+                                         dataset=self.dataset, shard=shard,
+                                         node=node)
+        if prev is not status:
+            m["transitions"].inc(dataset=self.dataset, status=status.value)
+            from filodb_tpu.utils.devicewatch import FLIGHT
+            FLIGHT.record("shard.replica", dataset=self.dataset, shard=shard,
+                          node=node, status=status.value, prev=prev.value,
+                          progress=progress)
+
     def coord_for_shard(self, shard: int) -> Optional[str]:
         return self._states[shard].node
+
+    def replicas(self, shard: int) -> list[ReplicaState]:
+        """The shard's replica group (live view; do not mutate)."""
+        return self._states[shard].replicas
+
+    def replica_nodes(self, shard: int) -> list[str]:
+        return [r.node for r in self._states[shard].replicas]
+
+    def live_replicas(self, shard: int) -> list[ReplicaState]:
+        """Replicas not in a terminal Down/Error state — the copies the
+        assignment strategy counts toward the replication factor."""
+        return [r for r in self._states[shard].replicas
+                if r.status not in (ShardStatus.DOWN, ShardStatus.ERROR)]
 
     def status(self, shard: int) -> ShardStatus:
         return self._states[shard].status
 
+    def best_status(self, shard: int) -> ShardStatus:
+        return self._states[shard].best_status
+
     def state(self, shard: int) -> ShardState:
         """The full per-shard state row (status + owner + recovery
-        progress) for health/watermark views."""
+        progress + replicas) for health/watermark views."""
         return self._states[shard]
 
     def active_shards(self, shards: Optional[Sequence[int]] = None) -> list[int]:
+        """Shards with at least one queryable replica."""
         rng = range(self.num_shards) if shards is None else shards
-        return [s for s in rng if self._states[s].status.queryable]
+        return [s for s in rng if self._states[s].best_status.queryable]
 
     def all_nodes(self) -> set:
-        return {st.node for st in self._states if st.node is not None}
+        return {r.node for st in self._states for r in st.replicas}
 
     def shards_for_node(self, node: str) -> list[int]:
-        return [i for i, st in enumerate(self._states) if st.node == node]
+        """Shards where ``node`` holds a LIVE (non-Down/Error) replica
+        — the same liveness rule as ``live_replicas``, so the
+        assignment strategy's ``have`` and ``need`` sides can never
+        disagree about one copy."""
+        dead = (ShardStatus.DOWN, ShardStatus.ERROR)
+        return [i for i, st in enumerate(self._states)
+                if any(r.node == node and r.status not in dead
+                       for r in st.replicas)]
 
     def runnable_shards_for_node(self, node: str) -> list[int]:
-        """Shards this node should actually be ingesting: assigned to it
-        and not held in an operator STOPPED / leader DOWN state (the one
-        place this exclusion policy lives — resync and self-heal both
-        consult it)."""
-        return [i for i, st in enumerate(self._states)
-                if st.node == node and st.status not in
-                (ShardStatus.STOPPED, ShardStatus.DOWN)]
+        """Shards this node should actually be ingesting: its replica
+        exists and is not held in an operator STOPPED / leader DOWN
+        state (the one place this exclusion policy lives — resync and
+        self-heal both consult it)."""
+        out = []
+        for i, st in enumerate(self._states):
+            rep = st.replica(node)
+            if rep is not None and rep.status not in (ShardStatus.STOPPED,
+                                                      ShardStatus.DOWN):
+                out.append(i)
+        return out
 
     @property
     def num_assigned(self) -> int:

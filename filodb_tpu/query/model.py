@@ -114,7 +114,16 @@ class ShardUnavailable(QueryError):
     refused/reset/timed out after retries, or no endpoint configured) —
     distinct from a semantic QueryError so scatter-gather can degrade
     to a warned partial result when ``allow_partial_results`` is set
-    (ISSUE 5; reference: PartialResults support in QueryResult)."""
+    (ISSUE 5; reference: PartialResults support in QueryResult).
+
+    ``reason`` (ISSUE 7) tags the failure class for failover telemetry
+    — "refused" (the node answered 503: overload/budget refusal),
+    "unreachable" (connection-level, the default), "no_endpoint" —
+    set at the raise site; substring-matching the message would
+    misread urllib's "[Errno 111] Connection refused" as a work
+    refusal."""
+
+    reason: str = "unreachable"
 
 
 @dataclasses.dataclass

@@ -26,6 +26,8 @@ driven by a JSON config instead of HOCON:
       "datasets": [{
         "name": "prom", "num-shards": 4, "min-num-nodes": 1,
         "schema": "gauge", "spread": 1,
+        "replication-factor": 1,          # ISSUE 7 (doc/ha.md): >1 puts
+                                          # each shard on that many nodes
         "source": {"factory": "kafka", "host": "127.0.0.1",
                    "port": 9092, "topic": "prom"},
                                           # omit for the in-proc queue
@@ -86,8 +88,13 @@ class FiloServer:
             self.colstore = NullColumnStore()
             self.metastore = InMemoryMetaStore()
         self.memstore = TimeSeriesMemStore(self.colstore, self.metastore)
-        self.manager = ShardManager()
-        self.failure_detector = FailureDetector(self.manager)
+        self.manager = ShardManager(
+            reassignment_min_interval_ms=int(
+                config.get("reassignment-min-interval-ms", 0)))
+        self.failure_detector = FailureDetector(
+            self.manager,
+            timeout_ms=int(config.get("failure-detector-timeout-ms",
+                                      10_000)))
         self.coordinator = NodeCoordinator(self.node, self.memstore)
         self.stream_factory = QueueStreamFactory()
         self.http = FiloHttpServer(port=config.get("http-port", 0),
@@ -108,7 +115,60 @@ class FiloServer:
         self.selfscraper = None
         self.write_publishers: dict[str, ShardingPublisher] = {}
         self._global_gateway_claimed = False
+        # datasets fed by the in-proc queue: the only legal targets of
+        # the replica container-push edge (POST /ingest, ISSUE 7)
+        self._queue_push_datasets: set = set()
+        # dual-write fanouts, retained so shutdown can stop their peer
+        # delivery lanes (a dead node must not keep POSTing to peers)
+        self._replica_fanouts: list = []
+        # (dataset, shard) -> first legal push offset (above persisted
+        # checkpoints), resolved once per shard on first peer push
+        self._push_offset_floor: dict = {}
+        self.http.ingest_sink = self._ingest_push
         self._started = threading.Event()
+
+    def _ingest_push(self, dataset: str, shard: int,
+                     container: bytes) -> int:
+        """Receiver side of the replica dual-write fanout: a peer's
+        container lands on this node's in-proc ingest queue.  The
+        stream's offset numbering is fast-forwarded past this node's
+        persisted checkpoints FIRST — a push landing before the
+        restarted consumer's own ``create(offset=resume_from)`` would
+        otherwise be numbered below the recovery watermark and silently
+        skipped as already-persisted."""
+        if dataset not in self._queue_push_datasets:
+            raise ValueError(
+                f"dataset {dataset!r} does not accept container pushes "
+                f"(broker-sourced or unknown)")
+        num_shards = self.manager.mapper(dataset).num_shards
+        if not 0 <= shard < num_shards:
+            # out-of-range pushes would ACK into a consumerless queue
+            # (silent loss + unbounded memory).  A valid shard this
+            # node does not CURRENTLY hold is accepted on purpose —
+            # membership gossip may lag the sender's view, and the
+            # queue is drained once the replica assignment lands.
+            raise ValueError(
+                f"shard {shard} out of range for {dataset!r} "
+                f"({num_shards} shards)")
+        stream = self.stream_factory.stream_for(dataset, shard)
+        key = (dataset, shard)
+        floor = self._push_offset_floor.get(key)
+        if floor is None:
+            try:
+                cps = self.metastore.read_checkpoints(dataset, shard)
+            except Exception:  # noqa: BLE001 — meta store not ready
+                # transient failure: use 0 for THIS push but do not
+                # cache it — a cached 0 would defeat the fast-forward
+                # forever even after the metastore becomes readable
+                cps = None
+            if cps is None:
+                floor = 0
+            else:
+                floor = self._push_offset_floor[key] = \
+                    (max(cps.values()) + 1) if cps else 0
+        if floor:
+            stream.ensure_offset(floor)
+        return stream.push(container)
 
     @staticmethod
     def _device_count() -> int:
@@ -212,12 +272,17 @@ class FiloServer:
                         ds).runnable_shards_for_node(self.node)
                     self.coordinator.resync(ds, shards)
 
+            def local_watermarks(ds: str) -> dict:
+                return {sh.shard_num: sh.latest_offset
+                        for sh in self.memstore.shards(ds)}
+
             self.status_poller = StatusPoller(
                 self.manager, self.failure_detector, peers, self.node,
                 interval_s=float(self.config.get(
                     "status-poll-interval-s", 2.0)),
                 on_assignment_change=resync_all,
-                local_running=self._running_shards)
+                local_running=self._running_shards,
+                local_watermarks=local_watermarks)
             self.status_poller.start()
         if self.config.get("profiler"):
             self.profiler = SimpleProfiler()
@@ -256,15 +321,28 @@ class FiloServer:
         else:
             ds_factory = self.stream_factory
 
+        rf = int(ds_conf.get("replication-factor", 1))
         self.manager.setup_dataset(name, num_shards,
-                                   int(ds_conf.get("min-num-nodes", 1)))
+                                   int(ds_conf.get("min-num-nodes", 1)),
+                                   replication_factor=rf)
+        mapper = self.manager.mapper(name)
+        source_is_broker = factory_name in ("broker", "kafka")
         ic = self.coordinator.setup_dataset(
             name, DEFAULT_SCHEMAS, ds_factory, store_cfg,
-            event_sink=self.manager.publish_event)
-        shards = self.manager.mapper(name).shards_for_node(self.node)
+            event_sink=self.manager.publish_event,
+            # recovery promotion gate (ISSUE 7): a rejoining replica is
+            # promoted only once it reaches the group's gossiped head.
+            # BROKER sources only: replicas share one partition log, so
+            # their offsets are comparable.  Queue-transport replicas
+            # number their own independent queues (deliveries dropped
+            # while a node was down leave a permanent gap), so gating
+            # on a peer's offset would wedge a rejoined node in
+            # Recovery forever — they promote at the local checkpoint
+            # head instead (best-effort transport, doc/ha.md).
+            group_head_fn=(lambda shard, _m=mapper: _m.group_head(shard))
+            if rf > 1 and source_is_broker else None)
+        shards = mapper.runnable_shards_for_node(self.node)
         ic.resync(shards)
-
-        mapper = self.manager.mapper(name)
         # workload management (ISSUE 5): admission + quota + dispatch
         # tuning from the per-dataset "workload" block
         wl_conf = dict(ds_conf.get("workload", {}))
@@ -300,9 +378,30 @@ class FiloServer:
                                        dispatcher_for_shard=disp,
                                        mesh_engine_provider=mesh_provider)
         schema = DEFAULT_SCHEMAS[ds_conf.get("schema", "gauge")]
+        peers_conf = self.config.get("peers", {})
         if broker_producer is not None:
+            # the broker's shared partition log IS the replicated
+            # stream: one produce, every replica consumes at its own
+            # offset (reference: Kafka replicated ingest)
             publish = broker_producer.publish
+        elif rf > 1 and peers_conf:
+            # queue transport + replicas: dual-write each container to
+            # every replica — local queue for this node, the peers'
+            # POST /ingest container edge for the rest (ISSUE 7)
+            from filodb_tpu.gateway.server import (ReplicaFanout,
+                                                   http_container_push)
+            self._queue_push_datasets.add(name)
+            per_node = {self.node:
+                        (lambda s, c, _n=name:
+                         self.stream_factory.stream_for(_n, s).push(c))}
+            for peer, endpoint in peers_conf.items():
+                if peer != self.node:
+                    per_node[peer] = http_container_push(endpoint, name)
+            publish = ReplicaFanout(name, mapper, per_node,
+                                    local_node=self.node)
+            self._replica_fanouts.append(publish)
         else:
+            self._queue_push_datasets.add(name)
             publish = lambda s, c, _n=name: self.stream_factory.stream_for(  # noqa: E731
                 _n, s).push(c)
         # Prometheus remote-write edge shares the gateway sharding rules
@@ -423,6 +522,8 @@ class FiloServer:
             self.status_poller.stop()
         for gw in self.gateways:
             gw.shutdown()
+        for fanout in self._replica_fanouts:
+            fanout.close()
         self.coordinator.shutdown()
         self.http.shutdown()
         for qs in self.query_schedulers.values():

@@ -290,6 +290,13 @@ def ingest_metrics() -> dict:
         "batch_seconds": REGISTRY.histogram(
             "filodb_ingest_batch_seconds",
             "gateway batch ingest latency (parse -> route -> build)"),
+        "replica_publishes": REGISTRY.counter(
+            "filodb_ingest_replica_publishes_total",
+            "containers delivered per replica by the dual-write fanout"),
+        "replica_publish_failures": REGISTRY.counter(
+            "filodb_ingest_replica_publish_failures_total",
+            "per-replica container deliveries that failed (the replica "
+            "lags and must catch up from its checkpoint/broker)"),
     }
 
 
@@ -385,6 +392,10 @@ def shard_health_metrics() -> dict:
         "transitions": REGISTRY.counter(
             "filodb_shard_status_transitions_total",
             "status transitions by dataset and new status"),
+        "replica_status_code": REGISTRY.gauge(
+            "filodb_shard_replica_status_code",
+            "per-REPLICA shard status code (same encoding as "
+            "filodb_shard_status_code), keyed by holding node"),
     }
 
 
@@ -451,6 +462,10 @@ def workload_metrics() -> dict:
         "dispatch_failures": REGISTRY.counter(
             "filodb_dispatch_failures_total",
             "remote dispatches that failed after exhausting retries"),
+        "dispatch_failover": REGISTRY.counter(
+            "filodb_dispatch_failover_total",
+            "leaf dispatches retargeted at another replica, by reason "
+            "(refused|unreachable|no_endpoint|hedge_retarget)"),
         "quota_active": REGISTRY.gauge(
             "filodb_quota_active_series",
             "active (alive-in-index) series per dataset/tenant"),

@@ -20,6 +20,14 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+try:
+    # jax >= 0.4.26 ships CPU cross-process collectives behind this
+    # switch (default "none"): without it the compiled psum dies with
+    # "Multiprocess computations aren't implemented on the CPU
+    # backend".  Must be set BEFORE jax.distributed.initialize.
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:  # noqa: BLE001 — older jax: collectives built in
+    pass
 
 
 def main() -> None:

@@ -662,3 +662,99 @@ def test_metric_doc_lint_catches_drift():
             "| `filodb_odp_*` | `pagein_seconds` |\n")
     bad = _undocumented_metrics({"filodb_odp_failures_total"}, doc2)
     assert len(bad) == 1 and "filodb_odp_failures_total" in bad[0]
+
+
+# ---------------------------------------------------------------------------
+# Replica-routing lint (ISSUE 7): every dispatcher site that targets,
+# retargets, hedges, or fails over a leaf selects its replica through
+# the SINGLE ReplicaSet.pick()/alternate() routing helper
+# (coordinator/replicas.py).  Ad-hoc node lists inside dispatcher
+# classes — enumerating mapper replicas and ordering them locally —
+# fork the routing policy and rot independently.
+# ---------------------------------------------------------------------------
+
+_REPLICA_ENUMERATORS = {"replicas", "replica_nodes", "live_replicas"}
+_ROUTING_FN_HINTS = ("failover", "retarget", "hedge_alternate")
+_ROUTING_HELPERS = {"pick", "alternate"}
+
+
+def _replica_routing_violations(src: str, relpath: str) -> list:
+    if relpath.endswith("coordinator/replicas.py"):
+        return []            # the policy's one home
+    tree = ast.parse(src)
+    out = []
+
+    def called_attrs(node) -> set:
+        got = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute):
+                got.add(n.func.attr)
+        return got
+
+    for cls in ast.walk(tree):
+        if not (isinstance(cls, ast.ClassDef)
+                and cls.name.endswith("Dispatcher")):
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            bad = called_attrs(fn) & _REPLICA_ENUMERATORS
+            if bad:
+                out.append(
+                    f"{relpath}:{fn.lineno}: {cls.name}.{fn.name} "
+                    f"enumerates replicas ad hoc ({sorted(bad)}) — "
+                    f"dispatchers must select through ReplicaSet.pick()")
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not any(h in fn.name for h in _ROUTING_FN_HINTS):
+            continue
+        if not (called_attrs(fn) & _ROUTING_HELPERS):
+            out.append(
+                f"{relpath}:{fn.lineno}: routing site {fn.name}() does "
+                f"not go through ReplicaSet.pick()/alternate()")
+    return out
+
+
+def test_replica_routing_goes_through_pick():
+    violations = []
+    for path in sorted(ROOT.rglob("*.py")):
+        rel = str(path.relative_to(ROOT))
+        violations.extend(
+            _replica_routing_violations(path.read_text(), rel))
+    assert not violations, \
+        "ad-hoc replica routing:\n  " + "\n  ".join(violations)
+
+
+def test_replica_routing_lint_catches_ad_hoc_lists():
+    """The routing lint must fire on a dispatcher enumerating replicas
+    itself and on a pick-less failover helper, and accept the
+    pick-routed shapes."""
+    bad_enum = (
+        "class MyPlanDispatcher:\n"
+        "    def dispatch(self, plan, ctx):\n"
+        "        node = self.mapper.replica_nodes(plan.shard)[0]\n"
+        "        return node\n"
+    )
+    got = _replica_routing_violations(bad_enum, "fake.py")
+    assert len(got) == 1 and "ReplicaSet.pick" in got[0]
+    bad_failover = (
+        "def failover_target(shard, nodes):\n"
+        "    return sorted(nodes)[0]\n"
+    )
+    got = _replica_routing_violations(bad_failover, "fake.py")
+    assert len(got) == 1 and "failover_target" in got[0]
+    ok = (
+        "class MyPlanDispatcher:\n"
+        "    def dispatch(self, plan, ctx):\n"
+        "        for node in self.replica_set.pick(self.shard):\n"
+        "            return node\n"
+        "def hedge_alternate_for(plan, this_node):\n"
+        "    return rs.alternate(plan.shard, exclude=[this_node])\n"
+    )
+    assert _replica_routing_violations(ok, "fake.py") == []
+    # and the policy home itself is exempt
+    assert _replica_routing_violations(
+        bad_enum, "coordinator/replicas.py") == []
