@@ -1,0 +1,24 @@
+"""filolint: whole-repo static analysis for filodb_tpu.
+
+An AST-walking lint engine (engine.py) with a rule registry, per-rule
+severity, justification-required suppressions, text/JSON reporting, and
+a CLI (``python -m filodb_tpu.analysis`` / the ``lint`` CLI verb).
+
+Rule modules register themselves on import:
+
+- locks.py      — lock-discipline, blocking-under-lock
+- lifecycle.py  — resource-lifecycle
+- sentinels.py  — the eight migrated legacy sentinel lints
+
+See doc/analysis.md for the catalog, the ``# guarded-by:`` annotation
+syntax, the suppression policy, and how to add a rule.
+"""
+
+from .engine import (  # noqa: F401
+    META_RULES, RULES, Finding, Module, Project, Rule, rule,
+    load_modules, run_paths, run_project, run_source, unsuppressed,
+)
+from . import lifecycle, locks, sentinels  # noqa: F401,E402 — register rules
+from .report import (  # noqa: F401
+    render_json, render_rule_list, render_text, summarize,
+)

@@ -1,0 +1,62 @@
+"""filolint CLI: ``python -m filodb_tpu.analysis [paths] [--json]``.
+
+Exit status 0 means zero unsuppressed findings; 1 means at least one
+(CI gates on this — tests/test_analysis.py runs it over the whole
+tree).  Also reachable as ``python -m filodb_tpu.cli lint``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+from . import (RULES, Project, load_modules, render_json,
+               render_rule_list, render_text, run_project, unsuppressed)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m filodb_tpu.analysis",
+        description="filolint: whole-repo static analysis "
+                    "(doc/analysis.md)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files or directories (default: the filodb_tpu "
+                        "package)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report on stdout")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule subset (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="include suppressed findings in the text report")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        print(render_rule_list())
+        return 0
+    paths = args.paths or [pathlib.Path(__file__).resolve().parents[1]]
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)} "
+                  f"(see --list-rules)", file=sys.stderr)
+            return 2
+    modules, root = load_modules(paths)
+    findings = run_project(Project(modules, root), rules)
+    if args.json:
+        print(render_json(findings, files=len(modules)))
+    else:
+        print(render_text(findings, files=len(modules),
+                          show_suppressed=args.show_suppressed))
+    return 1 if unsuppressed(findings) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

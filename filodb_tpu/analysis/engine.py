@@ -1,0 +1,345 @@
+"""filolint engine: rule registry, suppression discipline, runner.
+
+The engine walks Python sources, hands each module (or the whole
+project) to registered rules, and folds the resulting findings through
+ONE suppression mechanism:
+
+    x = do_risky_thing()  # filolint: disable=<rule>[,<rule>] — <reason>
+
+- the reason is mandatory: a ``disable`` with no justification is
+  itself an error (``suppression-syntax``);
+- a ``disable`` naming a rule that does not fire on that line is
+  itself an error (``stale-suppression``) — suppressions cannot rot
+  silently;
+- the two meta rules above cannot be suppressed.
+
+Rules come in two scopes:
+
+- ``module``: ``fn(module) -> iterable[Finding]`` — sees one file;
+- ``project``: ``fn(project) -> iterable[Finding]`` — sees every file
+  plus the repo's tests/ sources and doc/observability.md (the
+  cross-file lints: interpret coverage, metric-doc drift).
+
+Register with the :func:`rule` decorator; see doc/analysis.md for the
+catalog and for how to add a rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import pathlib
+import re
+import tokenize
+from typing import Callable, Iterable, Optional
+
+# meta rules the engine itself owns (not suppressible, not in RULES)
+STALE_SUPPRESSION = "stale-suppression"
+SUPPRESSION_SYNTAX = "suppression-syntax"
+META_RULES = (STALE_SUPPRESSION, SUPPRESSION_SYNTAX)
+
+# the suppression-comment grammar, matched against real COMMENT tokens
+# only (a docstring showing the syntax is not a directive); the reason
+# separator may be an em dash, --, or a colon, and the reason is required
+_SUPPRESS_RE = re.compile(
+    r"^#\s*filolint:\s*disable=([A-Za-z0-9_,\- ]+?)"
+    r"(?:\s*(?:—|--|:)\s*(.*))?$")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One diagnostic: where, which rule, why it matters."""
+    rule: str
+    path: str            # project-relative posix path
+    line: int            # 1-based
+    message: str
+    severity: str = "error"
+    suppressed: bool = False
+    suppress_reason: str = ""
+
+    def where(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    fn: Callable
+    scope: str           # "module" | "project"
+    severity: str
+    doc: str
+
+
+RULES: dict[str, Rule] = {}
+
+
+def rule(name: str, *, scope: str = "module", severity: str = "error",
+         doc: str = ""):
+    """Register a lint rule under ``name`` (kebab-case)."""
+    assert scope in ("module", "project"), scope
+
+    def deco(fn):
+        if name in RULES:
+            raise ValueError(f"duplicate rule {name!r}")
+        RULES[name] = Rule(name, fn, scope, severity, doc or fn.__doc__)
+        return fn
+    return deco
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int
+    rule: str
+    reason: str
+    used: bool = False
+
+
+class Module:
+    """One parsed source file plus its suppression comments."""
+
+    def __init__(self, rel: str, src: str, path: Optional[pathlib.Path] = None):
+        self.rel = rel
+        self.path = path
+        self.src = src
+        self.lines = src.splitlines()
+        self._tree: Optional[ast.AST] = None
+        self._nodes: Optional[list] = None
+        self.parse_error: Optional[SyntaxError] = None
+        self.suppressions: list[Suppression] = []
+        self.bad_suppressions: list[tuple[int, str]] = []  # (line, problem)
+        self._scan_suppressions()
+
+    @property
+    def tree(self) -> Optional[ast.AST]:
+        if self._tree is None and self.parse_error is None:
+            try:
+                self._tree = ast.parse(self.src)
+            except SyntaxError as e:
+                self.parse_error = e
+        return self._tree
+
+    @property
+    def nodes(self) -> list:
+        """Flat ``ast.walk`` of the tree, computed once — rules iterate
+        this instead of re-walking per rule (the engine's 10s full-tree
+        budget is mostly AST traversal)."""
+        if self._nodes is None:
+            t = self.tree
+            self._nodes = [] if t is None else list(ast.walk(t))
+        return self._nodes
+
+    def _comments(self) -> list[tuple[int, str]]:
+        """(line, text) of real comment tokens (strings excluded)."""
+        if "filolint" not in self.src:
+            return []          # skip tokenizing the common case
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(self.src).readline)
+            return [(t.start[0], t.string) for t in toks
+                    if t.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return []
+
+    def _scan_suppressions(self) -> None:
+        for i, text in self._comments():
+            if "filolint:" not in text:
+                continue
+            m = _SUPPRESS_RE.match(text)
+            if m is None:
+                self.bad_suppressions.append(
+                    (i, "unparseable filolint comment — expected "
+                        "'# filolint: disable=<rule> — <reason>'"))
+                continue
+            names = [n.strip() for n in m.group(1).split(",") if n.strip()]
+            reason = (m.group(2) or "").strip()
+            if not reason:
+                self.bad_suppressions.append(
+                    (i, "suppression without a justification — append "
+                        "'— <non-empty reason>'"))
+                # still record the rules so the original finding stays
+                # VISIBLE (an unjustified disable must not hide it)
+                continue
+            for n in names:
+                if n in META_RULES:
+                    self.bad_suppressions.append(
+                        (i, f"rule {n!r} cannot be suppressed"))
+                elif n not in RULES:
+                    self.bad_suppressions.append(
+                        (i, f"unknown rule {n!r} in disable "
+                            f"(see --list-rules)"))
+                else:
+                    self.suppressions.append(Suppression(i, n, reason))
+
+    def suppression_for(self, rule_name: str, line: int) -> Optional[Suppression]:
+        for s in self.suppressions:
+            if s.rule == rule_name and s.line == line:
+                return s
+        return None
+
+
+class Project:
+    """The whole analysis target: modules + cross-file context."""
+
+    def __init__(self, modules: list[Module], root: Optional[pathlib.Path] = None,
+                 test_sources: Optional[list[str]] = None,
+                 doc_text: Optional[str] = None):
+        self.modules = modules
+        self.root = root
+        self._test_sources = test_sources
+        self._doc_text = doc_text
+
+    @property
+    def test_sources(self) -> list[str]:
+        """tests/*.py contents (interpret-coverage needs them)."""
+        if self._test_sources is None:
+            out = []
+            if self.root is not None:
+                for p in sorted((self.root / "tests").glob("test_*.py")):
+                    out.append(p.read_text())
+            self._test_sources = out
+        return self._test_sources
+
+    @property
+    def doc_text(self) -> str:
+        """doc/observability.md (metric-doc drift needs it)."""
+        if self._doc_text is None:
+            p = (self.root / "doc" / "observability.md") if self.root else None
+            self._doc_text = p.read_text() if p is not None and p.exists() \
+                else ""
+        return self._doc_text
+
+
+def _find_repo_root(path: pathlib.Path) -> pathlib.Path:
+    """Nearest ancestor holding the filodb_tpu package (so rel paths in
+    reports look like filodb_tpu/memstore/shard.py)."""
+    p = path if path.is_dir() else path.parent
+    for cand in (p, *p.parents):
+        if (cand / "filodb_tpu" / "__init__.py").exists():
+            return cand
+    return p
+
+
+def load_modules(paths: Iterable[pathlib.Path | str]) -> tuple[list[Module], pathlib.Path]:
+    files: list[pathlib.Path] = []
+    root: Optional[pathlib.Path] = None
+    for raw in paths:
+        p = pathlib.Path(raw).resolve()
+        if root is None:
+            root = _find_repo_root(p)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        else:
+            files.append(p)
+    assert root is not None, "no paths given"
+    # dedupe: overlapping args (a dir + a file inside it) must not load
+    # a module twice — the duplicate's suppressions would never be
+    # marked used and report as falsely stale
+    seen: set = set()
+    files = [f for f in files if not (f in seen or seen.add(f))]
+    modules = []
+    for f in files:
+        try:
+            rel = f.relative_to(root).as_posix()
+        except ValueError:
+            rel = f.name
+        modules.append(Module(rel, f.read_text(), f))
+    return modules, root
+
+
+def _select(rules: Optional[Iterable[str]]) -> list[Rule]:
+    if rules is None:
+        return list(RULES.values())
+    out = []
+    for n in rules:
+        if n not in RULES:
+            raise KeyError(f"unknown rule {n!r}; have {sorted(RULES)}")
+        out.append(RULES[n])
+    return out
+
+
+def run_project(project: Project,
+                rules: Optional[Iterable[str]] = None) -> list[Finding]:
+    """Run rules, apply suppressions, append the meta findings.
+
+    Returns EVERY finding; suppressed ones carry suppressed=True.
+    """
+    selected = _select(rules)
+    findings: list[Finding] = []
+    by_rel = {m.rel: m for m in project.modules}
+    for m in project.modules:
+        if m.tree is None:
+            findings.append(Finding(
+                SUPPRESSION_SYNTAX, m.rel,
+                m.parse_error.lineno or 1 if m.parse_error else 1,
+                f"unparseable module: {m.parse_error}"))
+            continue
+        for r in selected:
+            if r.scope != "module":
+                continue
+            for f in r.fn(m):
+                f.severity = r.severity
+                findings.append(f)
+    for r in selected:
+        if r.scope != "project":
+            continue
+        for f in r.fn(project):
+            f.severity = r.severity
+            findings.append(f)
+
+    # fold suppressions: a finding is suppressed by a justified disable
+    # of its rule on its own line
+    for f in findings:
+        m = by_rel.get(f.path)
+        if m is None:
+            continue
+        s = m.suppression_for(f.rule, f.line)
+        if s is not None:
+            s.used = True
+            f.suppressed = True
+            f.suppress_reason = s.reason
+
+    # meta findings: stale + malformed suppressions.  A suppression is
+    # only stale relative to rules that actually RAN — a --rules subset
+    # must not condemn the other rules' suppressions.
+    selected_names = {r.name for r in selected}
+    for m in project.modules:
+        for s in m.suppressions:
+            if s.rule not in selected_names:
+                continue
+            if not s.used:
+                findings.append(Finding(
+                    STALE_SUPPRESSION, m.rel, s.line,
+                    f"suppression for {s.rule!r} never fires on this "
+                    f"line — delete it (stale suppressions hide future "
+                    f"regressions)"))
+        for line, problem in m.bad_suppressions:
+            findings.append(Finding(SUPPRESSION_SYNTAX, m.rel, line,
+                                    problem))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def run_paths(paths: Iterable[pathlib.Path | str],
+              rules: Optional[Iterable[str]] = None,
+              test_sources: Optional[list[str]] = None,
+              doc_text: Optional[str] = None) -> list[Finding]:
+    modules, root = load_modules(paths)
+    return run_project(Project(modules, root, test_sources, doc_text),
+                       rules)
+
+
+def run_source(src: str, rules: Optional[Iterable[str]] = None,
+               rel: str = "fake.py",
+               test_sources: Optional[list[str]] = None,
+               doc_text: str = "") -> list[Finding]:
+    """Lint one in-memory source string (rule self-tests)."""
+    m = Module(rel, src)
+    return run_project(Project([m], None, test_sources or [], doc_text),
+                       rules)
+
+
+def unsuppressed(findings: Iterable[Finding]) -> list[Finding]:
+    return [f for f in findings if not f.suppressed]

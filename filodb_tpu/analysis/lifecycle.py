@@ -1,0 +1,101 @@
+"""Resource-lifecycle analysis.
+
+``resource-lifecycle``: a class that registers a long-lived callback or
+thread must own a reachable release path — the Gauge.remove contract
+(doc/observability.md) that needed a manual review fix in three
+consecutive PRs (QueryScheduler, FlushScheduler, CardinalityTracker).
+
+Checked registrations (inside class methods; module-scope registrations
+are process-lifetime by convention — filodb_process_*, the devicewatch
+module gauges — and are exempt):
+
+- ``<gauge>.set_fn(...)``: the registry holds the callback (and every
+  object it captures) alive and keeps exporting rows for dead
+  instances; the class must call ``.remove(...)`` somewhere.
+- ``PeriodicThread(...)``: the class must call ``.stop()`` / ``.close()``
+  / ``.cancel()`` / ``.shutdown()`` somewhere.
+- ``weakref.finalize(...)``: the class must either ``.detach()`` the
+  finalizer or own a release-shaped method (close/stop/deregister/
+  untrack/...) that unwinds the registration.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .engine import Finding, rule
+
+_THREAD_RELEASES = {"stop", "close", "cancel", "shutdown"}
+_RELEASEY_METHOD_RE = re.compile(
+    r"close|stop|shutdown|deregister|unregister|detach|untrack|remove"
+    r"|reset|clear|teardown", re.I)
+
+
+def _attr_calls(cls) -> list:
+    out = []
+    for n in ast.walk(cls):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            out.append(n)
+    return out
+
+
+@rule("resource-lifecycle",
+      doc="registrations without a release path in the same class")
+def resource_lifecycle(module):
+    findings = []
+    for cls in module.nodes:
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        calls = _attr_calls(cls)
+        called_attrs = {c.func.attr for c in calls}
+        has_remove = "remove" in called_attrs
+        has_thread_stop = bool(called_attrs & _THREAD_RELEASES)
+        has_detach = "detach" in called_attrs
+        releasey_method = any(
+            isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and _RELEASEY_METHOD_RE.search(m.name)
+            for m in cls.body)
+
+        for call in calls:
+            attr = call.func.attr
+            if attr == "register_pool" \
+                    and "deregister_pool" not in called_attrs:
+                findings.append(Finding(
+                    "resource-lifecycle", module.rel, call.lineno,
+                    f"{cls.name} registers a devicewatch pool (a gauge "
+                    f"set_fn under the hood) but never calls "
+                    f"deregister_pool — the ledger samples and exports "
+                    f"this instance forever"))
+            elif attr == "set_fn" and not has_remove:
+                findings.append(Finding(
+                    "resource-lifecycle", module.rel, call.lineno,
+                    f"{cls.name} registers a gauge set_fn callback but "
+                    f"never calls .remove(...): the registry keeps this "
+                    f"instance alive and exports rows for it forever — "
+                    f"add a close/shutdown that removes the label set "
+                    f"(Gauge.remove contract, doc/observability.md)"))
+            elif attr == "finalize" and isinstance(call.func.value,
+                                                   ast.Name) \
+                    and call.func.value.id == "weakref" \
+                    and not (has_detach or releasey_method):
+                findings.append(Finding(
+                    "resource-lifecycle", module.rel, call.lineno,
+                    f"{cls.name} arms a weakref.finalize but has no "
+                    f"release path (.detach() or a close/deregister-"
+                    f"shaped method) — the finalizer and its captures "
+                    f"outlive every explicit teardown"))
+        for n in ast.walk(cls):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            tname = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            if tname == "PeriodicThread" and not has_thread_stop:
+                findings.append(Finding(
+                    "resource-lifecycle", module.rel, n.lineno,
+                    f"{cls.name} starts a PeriodicThread but never "
+                    f"calls .stop()/.close() on anything — the daemon "
+                    f"loop (and this instance) runs until process "
+                    f"exit"))
+    return findings

@@ -1,0 +1,560 @@
+"""Lock-discipline analyses.
+
+Two rules built on one held-lock AST walk:
+
+- ``lock-discipline``: instance attributes protected by a lock (declared
+  with a ``# guarded-by: _lock`` comment on the attribute's assignment
+  line, or inferred when two or more methods write the attribute under
+  the same ``with self._lock:``) must not be touched outside that lock.
+  This is the PR 11/12 review-bug class made structural: mapper
+  mutations outside the manager lock (``_note_local_watermarks``),
+  tenant-gauge rows mutated off the export lock
+  (``_set_tenant_gauges``), stall-machine state racing the sampler.
+
+- ``blocking-under-lock``: no blocking call — network I/O
+  (``urlopen``/peer POST), ``Future.result``/``Thread.join`` waits,
+  ``sleep``, subprocess spawns, host→device transfers — may execute
+  while a lock is held, directly or through a same-module helper (the
+  call graph is propagated to a fixpoint within the module).  This is
+  the ReplicaFanout wedge lesson: one blocking peer POST under a held
+  lock converted one slow node into a cluster-wide ingest stall.
+
+Annotations:
+
+- ``self._attr = ...  # guarded-by: _lock`` declares ``_attr`` guarded
+  (reads AND writes outside the lock are flagged);
+- ``def _sweep_locked(self):  # holds-lock: _lock`` declares the caller
+  holds the lock — the body is analyzed as if inside ``with``.
+
+Nested ``def``/``lambda`` bodies run LATER, not under the enclosing
+``with`` — the walker resets the held set for them (a ``set_fn``
+callback registered under a lock does not hold it when sampled).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from .engine import Finding, rule
+
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
+_HOLDS_LOCK_RE = re.compile(r"#\s*holds-lock:\s*([A-Za-z_][\w.]*)")
+_ATTR_ASSIGN_RE = re.compile(r"self\.([A-Za-z_]\w*)\s*(?::[^=]+)?=[^=]")
+
+# container-mutation method names: receiver is being written, not read
+_MUTATORS = {
+    "append", "appendleft", "add", "clear", "discard", "extend",
+    "insert", "pop", "popitem", "popleft", "remove", "setdefault",
+    "update",
+}
+
+_SUBPROCESS_FNS = {"run", "call", "check_call", "check_output", "Popen"}
+
+
+def _is_lockish(name: Optional[str]) -> bool:
+    return bool(name) and ("lock" in name.lower()
+                           or name.endswith(("_cv", "_cond"))
+                           or name in ("cv", "cond"))
+
+
+def _lock_key(expr) -> Optional[str]:
+    """Canonical key for a with-item context expression that looks like
+    a lock: ``self._lock``, ``_EXPORT_LOCK``, ``cls._lock``..."""
+    if isinstance(expr, ast.Name):
+        return expr.id if _is_lockish(expr.id) else None
+    if isinstance(expr, ast.Attribute):
+        if not _is_lockish(expr.attr):
+            return None
+        if isinstance(expr.value, ast.Name):
+            return f"{expr.value.id}.{expr.attr}"
+        return f"?.{expr.attr}"
+    return None
+
+
+def _terminal(name_or_attr) -> Optional[str]:
+    if isinstance(name_or_attr, ast.Name):
+        return name_or_attr.id
+    if isinstance(name_or_attr, ast.Attribute):
+        return name_or_attr.attr
+    return None
+
+
+def _key_matches(guard: str, held: frozenset) -> bool:
+    """Does the held set satisfy guard ``_lock`` / ``self._lock``?
+    Matched on the full key or the terminal lock name, so the
+    annotation can spell either form."""
+    term = guard.rsplit(".", 1)[-1]
+    for h in held:
+        if h == guard or h == f"self.{guard}" or h.rsplit(".", 1)[-1] == term:
+            return True
+    return False
+
+
+class _Access:
+    __slots__ = ("attr", "kind", "line", "method", "held")
+
+    def __init__(self, attr, kind, line, method, held):
+        self.attr, self.kind, self.line = attr, kind, line
+        self.method, self.held = method, held
+
+
+class _LockWalker:
+    """Statement walker threading the set of held lock keys; invokes
+    ``on_call(call, held)`` for every Call and ``on_access`` for every
+    ``self.<attr>`` touch (lock-discipline only sets the latter)."""
+
+    def __init__(self, on_call=None, on_access=None):
+        self.on_call = on_call
+        self.on_access = on_access
+        self._method = ""
+
+    def walk_method(self, fn, initial_held=frozenset()):
+        self._method = fn.name
+        self._stmts(fn.body, frozenset(initial_held))
+
+    # ------------------------------------------------------------ statements
+
+    def _stmts(self, body, held):
+        for st in body:
+            self._stmt(st, held)
+
+    def _stmt(self, st, held):
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            new = set(held)
+            for item in st.items:
+                self._expr(item.context_expr, held)
+                k = _lock_key(item.context_expr)
+                if k is not None:
+                    new.add(k)
+                if item.optional_vars is not None:
+                    self._writes(item.optional_vars, held)
+            self._stmts(st.body, frozenset(new))
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in st.decorator_list:
+                self._expr(d, held)
+            # the body runs when CALLED, not here: no lock is held
+            self._stmts(st.body, frozenset())
+        elif isinstance(st, ast.ClassDef):
+            self._stmts(st.body, held)
+        elif isinstance(st, (ast.If, ast.While)):
+            self._expr(st.test, held)
+            self._stmts(st.body, held)
+            self._stmts(st.orelse, held)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self._writes(st.target, held)
+            self._expr(st.iter, held)
+            self._stmts(st.body, held)
+            self._stmts(st.orelse, held)
+        elif isinstance(st, ast.Try):
+            self._stmts(st.body, held)
+            for h in st.handlers:
+                self._stmts(h.body, held)
+            self._stmts(st.orelse, held)
+            self._stmts(st.finalbody, held)
+        elif isinstance(st, ast.Assign):
+            for t in st.targets:
+                self._writes(t, held)
+            self._expr(st.value, held)
+        elif isinstance(st, ast.AugAssign):
+            self._writes(st.target, held)
+            self._expr(st.value, held)
+        elif isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._writes(st.target, held)
+                self._expr(st.value, held)
+        elif isinstance(st, ast.Delete):
+            for t in st.targets:
+                self._writes(t, held)
+        elif isinstance(st, ast.Match):
+            # match_case is neither stmt nor expr — walk it explicitly
+            # or everything inside a match block goes dark
+            self._expr(st.subject, held)
+            for case in st.cases:
+                if case.guard is not None:
+                    self._expr(case.guard, held)
+                self._stmts(case.body, held)
+        else:
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self._expr(child, held)
+                elif isinstance(child, ast.stmt):
+                    self._stmt(child, held)
+
+    # ----------------------------------------------------------- expressions
+
+    def _writes(self, target, held):
+        """Record write accesses for an assignment/del/loop target."""
+        if isinstance(target, ast.Attribute):
+            self._note(target, "w", held)
+            # deep target like self.a.b = x also READS self.a
+            self._expr(target.value, held)
+        elif isinstance(target, ast.Subscript):
+            base = target.value
+            if isinstance(base, ast.Attribute):
+                self._note(base, "w", held)     # self._d[k] = v mutates _d
+            self._expr(base, held)
+            self._expr(target.slice, held)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._writes(e, held)
+        elif isinstance(target, ast.Starred):
+            self._writes(target.value, held)
+        elif isinstance(target, ast.Name):
+            pass
+        else:
+            self._expr(target, held)
+
+    def _expr(self, node, held):
+        if node is None:
+            return
+        if isinstance(node, ast.Lambda):
+            # runs later, without the lock
+            self._expr(node.body, frozenset())
+            return
+        if isinstance(node, ast.Call):
+            if self.on_call is not None:
+                self.on_call(node, held, self._method)
+            # a mutator method call writes its receiver: self._d.pop(k)
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _MUTATORS \
+                    and isinstance(f.value, ast.Attribute):
+                self._note(f.value, "w", held)
+        if isinstance(node, ast.Attribute):
+            self._note(node, "r", held)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, held)
+            elif isinstance(child, ast.comprehension):
+                self._writes(child.target, held)
+                self._expr(child.iter, held)
+                for c in child.ifs:
+                    self._expr(c, held)
+
+    def _note(self, attr_node, kind, held):
+        if self.on_access is None:
+            return
+        if isinstance(attr_node.value, ast.Name) \
+                and attr_node.value.id == "self":
+            self.on_access(_Access(attr_node.attr, kind, attr_node.lineno,
+                                   self._method, held))
+
+
+def _method_held(fn, lines) -> frozenset:
+    """Locks declared held on entry via ``# holds-lock:`` on the def line."""
+    line = lines[fn.lineno - 1] if fn.lineno - 1 < len(lines) else ""
+    m = _HOLDS_LOCK_RE.search(line)
+    return frozenset({m.group(1)}) if m else frozenset()
+
+
+def _class_lock_keys(cls) -> frozenset:
+    """Every lock key this class takes with ``with``."""
+    keys = set()
+    for n in ast.walk(cls):
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                k = _lock_key(item.context_expr)
+                if k is not None:
+                    keys.add(k)
+    return frozenset(keys)
+
+
+def _lock_aliases(cls) -> dict:
+    """``self._cv = threading.Condition(self._lock)`` shares the
+    underlying lock: holding the condition IS holding the lock."""
+    out = {}
+    for n in ast.walk(cls):
+        if not (isinstance(n, ast.Assign)
+                and isinstance(n.value, ast.Call)):
+            continue
+        if _terminal(n.value.func) != "Condition" or not n.value.args:
+            continue
+        src = _lock_key(n.value.args[0])
+        if src is None:
+            continue
+        for t in n.targets:
+            tk = _lock_key(t)
+            if tk is not None:
+                out[tk] = src
+    return out
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+_CTOR_METHODS = {"__init__", "__new__", "__init_subclass__"}
+_INFER_MIN_METHODS = 2   # locked-writer methods needed to infer a guard
+
+
+def _class_annotations(cls, lines) -> tuple[dict, list]:
+    """{attr: lock} from ``# guarded-by:`` comments inside the class,
+    plus (line, text) of annotations that bound to nothing — a typo'd
+    annotation must not silently disarm the race detector."""
+    end = getattr(cls, "end_lineno", None) or max(
+        (getattr(n, "end_lineno", cls.lineno) or cls.lineno
+         for n in ast.walk(cls)), default=cls.lineno)
+    out, dangling = {}, []
+    for i in range(cls.lineno - 1, min(end, len(lines))):
+        line = lines[i]
+        g = _GUARDED_BY_RE.search(line)
+        if g is None:
+            continue
+        a = _ATTR_ASSIGN_RE.search(line)
+        if a is not None:
+            out[a.group(1)] = g.group(1)
+        else:
+            dangling.append((i + 1, g.group(1)))
+    return out, dangling
+
+
+@rule("lock-discipline", doc="guarded attributes touched outside their lock")
+def lock_discipline(module):
+    findings = []
+    for cls in module.nodes:
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        annotated, dangling = _class_annotations(cls, module.lines)
+        for line, lock in dangling:
+            findings.append(Finding(
+                "lock-discipline", module.rel, line,
+                f"'# guarded-by: {lock}' does not sit on a recognizable "
+                f"'self.<attr> = ...' line — the annotation binds to "
+                f"nothing and guards nothing"))
+        class_locks = _class_lock_keys(cls)
+        aliases = _lock_aliases(cls)
+        accesses: list[_Access] = []
+        walker = _LockWalker(on_access=accesses.append)
+        for fn in cls.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                held = _method_held(fn, module.lines)
+                if fn.name.endswith("_locked"):
+                    # repo convention: a ``*_locked`` method documents
+                    # that its caller already holds the class's lock
+                    held = held | class_locks
+                walker.walk_method(fn, held)
+        if aliases:
+            for a in accesses:
+                a.held = frozenset(aliases.get(k, k) for k in a.held)
+
+        # annotated attrs: any touch outside the declared lock is flagged
+        seen = set()
+        for a in accesses:
+            lock = annotated.get(a.attr)
+            if lock is None or a.method in _CTOR_METHODS:
+                continue
+            if _key_matches(lock, a.held):
+                continue
+            key = (a.line, a.attr)
+            if key in seen:
+                continue
+            seen.add(key)
+            verb = "written" if a.kind == "w" else "read"
+            findings.append(Finding(
+                "lock-discipline", module.rel, a.line,
+                f"{cls.name}.{a.attr} is declared '# guarded-by: {lock}' "
+                f"but {verb} here without holding it (method "
+                f"{a.method}); take the lock, or mark the method "
+                f"'# holds-lock: {lock}' if every caller already holds "
+                f"it"))
+
+        # inferred guards: >= N methods write the attr under one lock ->
+        # a write outside that lock anywhere else in the class is the
+        # PR 11/12 race shape
+        by_attr: dict[str, dict[str, set]] = {}
+        for a in accesses:
+            if a.kind != "w" or a.attr in annotated \
+                    or _is_lockish(a.attr):
+                continue
+            for lock in a.held:
+                by_attr.setdefault(a.attr, {}).setdefault(
+                    lock, set()).add(a.method)
+        for a in accesses:
+            if a.kind != "w" or a.attr in annotated \
+                    or a.method in _CTOR_METHODS or _is_lockish(a.attr):
+                continue
+            for lock, methods in by_attr.get(a.attr, {}).items():
+                locked_elsewhere = methods - {a.method}
+                if len(methods) < _INFER_MIN_METHODS \
+                        or not locked_elsewhere:
+                    continue
+                if _key_matches(lock, a.held):
+                    continue
+                key = (a.line, a.attr)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(Finding(
+                    "lock-discipline", module.rel, a.line,
+                    f"{cls.name}.{a.attr} is written under {lock} in "
+                    f"{sorted(methods)} but this write (method "
+                    f"{a.method}) does not hold it — the unguarded-"
+                    f"mutation race PRs 11/12 kept refixing; take the "
+                    f"lock or annotate the attribute '# guarded-by:'"))
+                break
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+
+
+def _call_names(call) -> tuple[Optional[str], Optional[str]]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id, None
+    if isinstance(f, ast.Attribute):
+        return f.attr, _terminal(f.value)
+    return None, None
+
+
+def direct_blocking(call) -> Optional[str]:
+    """Why this call blocks, or None.  The vocabulary of the ReplicaFanout
+    / gauge-scrape incidents: network, waits, sleeps, spawns, device
+    transfers."""
+    name, recv = _call_names(call)
+    if name is None:
+        return None
+    if name == "urlopen":
+        return "urlopen() does network I/O"
+    if name == "sleep" and recv in (None, "time"):
+        return "sleep() parks the thread"
+    if name in _SUBPROCESS_FNS and recv == "subprocess":
+        return f"subprocess.{name}() spawns a process"
+    if name == "Popen":
+        return "Popen() spawns a process"
+    if name == "communicate":
+        return "communicate() waits on a subprocess"
+    if name == "http_container_push":
+        return "http_container_push() POSTs to a peer"
+    if name == "result" and not call.args:
+        return "Future.result() waits on another worker"
+    if name == "join" and not call.args \
+            and all(k.arg == "timeout" for k in call.keywords):
+        return "join() waits on another thread"
+    if name == "get" and any(k.arg in ("timeout", "block")
+                             for k in call.keywords):
+        return "blocking queue get()"
+    if name == "block_until_ready":
+        return "block_until_ready() waits on the device"
+    if name == "device_put":
+        return "device_put() is a host->device transfer (may compile)"
+    return None
+
+
+def _blocking_table(tree) -> dict:
+    """{(class_or_'', fn_name): (reason, chain)} fixpoint over the
+    module's call graph so a lock-holding call into a local helper that
+    blocks two hops down is still caught."""
+    funcs: dict[tuple, ast.AST] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs[("", node.name)] = node
+        elif isinstance(node, ast.ClassDef):
+            for m in node.body:
+                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    funcs[(node.name, m.name)] = m
+
+    def own_calls(fn):
+        """Call nodes of fn's body excluding nested function bodies."""
+        stack = list(fn.body)
+        out = []
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.Call):
+                out.append(n)
+            for c in ast.iter_child_nodes(n):
+                if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    continue
+                stack.append(c)
+        return out
+
+    blocking: dict[tuple, tuple] = {}
+    edges: dict[tuple, set] = {k: set() for k in funcs}
+    for (cname, fname), fn in funcs.items():
+        key = (cname, fname)
+        for n in own_calls(fn):
+            if key not in blocking:
+                why = direct_blocking(n)
+                if why is not None:
+                    blocking[key] = (why, fname)
+            f = n.func
+            if isinstance(f, ast.Name) and ("", f.id) in funcs:
+                edges[key].add(("", f.id))
+            elif isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id == "self" and (cname, f.attr) in funcs:
+                edges[key].add((cname, f.attr))
+
+    changed = True
+    while changed:
+        changed = False
+        for key, callees in edges.items():
+            if key in blocking:
+                continue
+            for c in callees:
+                if c in blocking:
+                    why, chain = blocking[c]
+                    blocking[key] = (why, f"{key[1]} -> {chain}")
+                    changed = True
+                    break
+    return blocking
+
+
+@rule("blocking-under-lock",
+      doc="blocking calls executed while a lock is held")
+def blocking_under_lock(module):
+    findings = []
+    table = _blocking_table(module.tree)
+    seen = set()
+
+    def check(call, held, method, cls_name):
+        if not held:
+            return
+        why = direct_blocking(call)
+        chain = None
+        if why is None:
+            f = call.func
+            key = None
+            if isinstance(f, ast.Name):
+                key = ("", f.id)
+            elif isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id == "self":
+                key = (cls_name, f.attr)
+            if key in table:
+                why, chain = table[key]
+        if why is None:
+            return
+        if call.lineno in seen:
+            return
+        seen.add(call.lineno)
+        locks = ", ".join(sorted(held))
+        via = f" (via {chain})" if chain and chain != method else ""
+        findings.append(Finding(
+            "blocking-under-lock", module.rel, call.lineno,
+            f"{why}{via} while holding {locks} — one slow peer/device "
+            f"turns every thread contending this lock into a convoy "
+            f"(the ReplicaFanout ingest-stall shape); move the call "
+            f"outside the critical section"))
+
+    def walk_container(body, cls_name):
+        for fn in body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                w = _LockWalker(on_call=lambda c, h, m, _cn=cls_name:
+                                check(c, h, m, _cn))
+                # held starts empty even for # holds-lock / *_locked
+                # methods: blocking is attributed to the statement that
+                # lexically TAKES the lock (the propagated call graph
+                # already reaches these helpers from there), so each
+                # convoy is reported once, not once per call-chain hop
+                w.walk_method(fn, frozenset())
+
+    walk_container(module.tree.body, "")
+    for node in module.tree.body:
+        if isinstance(node, ast.ClassDef):
+            walk_container(node.body, node.name)
+    return findings

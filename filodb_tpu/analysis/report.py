@@ -1,0 +1,57 @@
+"""Finding reporters: text for humans, JSON for CI annotation."""
+
+from __future__ import annotations
+
+import collections
+import json
+from typing import Iterable
+
+from .engine import RULES, Finding
+
+
+def summarize(findings: Iterable[Finding], files: int = 0) -> dict:
+    findings = list(findings)
+    open_ = [f for f in findings if not f.suppressed]
+    per_rule = collections.Counter(f.rule for f in open_)
+    return {
+        "files": files,
+        "rules": len(RULES),
+        "findings": len(open_),
+        "suppressed": sum(1 for f in findings if f.suppressed),
+        "by_rule": dict(sorted(per_rule.items())),
+    }
+
+
+def render_json(findings: Iterable[Finding], files: int = 0) -> str:
+    findings = list(findings)
+    return json.dumps({
+        "findings": [f.to_dict() for f in findings],
+        "summary": summarize(findings, files),
+    }, indent=2)
+
+
+def render_text(findings: Iterable[Finding], files: int = 0,
+                show_suppressed: bool = False) -> str:
+    findings = list(findings)
+    lines = []
+    for f in findings:
+        if f.suppressed and not show_suppressed:
+            continue
+        tag = "suppressed" if f.suppressed else f.severity
+        lines.append(f"{f.where()}: {tag}[{f.rule}] {f.message}")
+        if f.suppressed and f.suppress_reason:
+            lines.append(f"    reason: {f.suppress_reason}")
+    s = summarize(findings, files)
+    lines.append(f"filolint: {s['findings']} finding(s), "
+                 f"{s['suppressed']} suppressed, {files} file(s), "
+                 f"{s['rules']} rule(s)")
+    return "\n".join(lines)
+
+
+def render_rule_list() -> str:
+    lines = []
+    for name in sorted(RULES):
+        r = RULES[name]
+        doc = (r.doc or "").strip().splitlines()[0] if r.doc else ""
+        lines.append(f"{name:24s} {r.scope:8s} {r.severity:8s} {doc}")
+    return "\n".join(lines)

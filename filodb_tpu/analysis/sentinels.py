@@ -1,0 +1,494 @@
+"""The eight legacy sentinel lints, as registry rules.
+
+These grew one per PR inside tests/test_sentinel_lint.py (760 lines of
+ad-hoc AST walking); they now live in the engine so there is ONE
+framework, ONE suppression mechanism (``# filolint: disable=`` replaces
+the old ``# sentinel-ok:``), and ONE report.  The migration is
+behavior-preserving: tests/test_sentinel_lint.py keeps the original
+catch-tests, run through these rules.
+
+Rules: decode-sentinel, timed-handler, interpret-coverage,
+device-put-ledger, admission-routing, deadline-threading, metric-doc,
+replica-routing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .engine import Finding, rule
+
+# ---------------------------------------------------------------------------
+# decode-sentinel (PR 6): native decode -1/None sentinels must be checked
+# ---------------------------------------------------------------------------
+
+RAW_SENTINEL_FNS = {
+    "np_unpack", "np_packed_end", "dd_decode", "xor_unpack",
+    "ll_encode_batch", "dbl_encode_batch", "ll_decode_batch",
+    "dbl_decode_batch", "page_decode_column", "influx_parse_batch",
+    "gather_ranges", "head_hash128", "verify_heads",
+}
+ADAPTER_SENTINEL_FNS = {
+    "page_decode": {"nb"},
+    "page_decode_into": {"nb"},
+    "gather": {"npr"},
+    "head_hashes": {"npr"},
+    "verify": {"npr"},
+    "parse": {"npr", "nparse"},
+}
+
+
+def _receiver_name(func) -> tuple[Optional[str], Optional[str]]:
+    if not isinstance(func, ast.Attribute):
+        return None, None
+    attr, v = func.attr, func.value
+    if isinstance(v, ast.Name):
+        return attr, v.id
+    if isinstance(v, ast.Attribute):
+        return attr, v.attr
+    return attr, None
+
+
+def _is_sentinel_call(node: ast.Call) -> bool:
+    attr, recv = _receiver_name(node.func)
+    if attr is None:
+        return False
+    if attr in RAW_SENTINEL_FNS and recv in ("_lib", "lib"):
+        return True
+    return attr in ADAPTER_SENTINEL_FNS and recv in ADAPTER_SENTINEL_FNS[attr]
+
+
+def _names_in(node) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _guard_names(func_node) -> set:
+    """Names whose value IS checked somewhere in the function."""
+    used = set()
+    for n in ast.walk(func_node):
+        if isinstance(n, ast.Compare):
+            used |= _names_in(n)
+        elif isinstance(n, (ast.If, ast.While, ast.IfExp)):
+            used |= _names_in(n.test)
+        elif isinstance(n, ast.Assert):
+            used |= _names_in(n.test)
+        elif isinstance(n, ast.BoolOp):
+            used |= _names_in(n)
+        elif isinstance(n, ast.UnaryOp) and isinstance(n.op, ast.Not):
+            used |= _names_in(n)
+    return used
+
+
+def _own_sentinel_calls(stmt) -> list:
+    """Sentinel calls whose NEAREST enclosing statement is ``stmt`` —
+    calls inside this statement's expression subtrees only (child
+    statements report their own)."""
+    out = []
+    stack = [c for c in ast.iter_child_nodes(stmt)
+             if isinstance(c, ast.expr)]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Call) and _is_sentinel_call(n):
+            out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _check_sentinel_stmt(stmt, guards, rel, findings) -> None:
+    for call in _own_sentinel_calls(stmt):
+        if callable(guards):
+            guards = guards()  # lazy: most functions have no sentinel calls
+        attr, _ = _receiver_name(call.func)
+        if isinstance(stmt, (ast.If, ast.While)) \
+                and any(call is t or call in ast.walk(t)
+                        for t in [stmt.test]):
+            continue           # branched on directly
+        if isinstance(stmt, (ast.Raise, ast.Assert)):
+            continue           # raising with it
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            names = set()
+            for t in targets:
+                names |= _names_in(t)
+            if names & guards:
+                continue       # assigned, then checked
+            findings.append(Finding(
+                "decode-sentinel", rel, call.lineno,
+                f"result of {attr}() assigned to {sorted(names)} "
+                f"but never compared/branched on in this function "
+                f"— a -1 sentinel would be silently discarded"))
+            continue
+        if isinstance(stmt, ast.Return) and isinstance(
+                stmt.value, (ast.IfExp, ast.Compare, ast.BoolOp)):
+            continue           # returns a checked form
+        findings.append(Finding(
+            "decode-sentinel", rel, call.lineno,
+            f"result of {attr}() is discarded without raising or "
+            f"counting (bare use); check the sentinel"))
+    for child in ast.iter_child_nodes(stmt):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue           # nested functions checked on their own
+        if isinstance(child, ast.stmt):
+            _check_sentinel_stmt(child, guards, rel, findings)
+        elif isinstance(child, ast.excepthandler):
+            for s in child.body:
+                _check_sentinel_stmt(s, guards, rel, findings)
+
+
+def _check_sentinel_function(func_node, rel, findings) -> None:
+    guards_cache: list = []
+
+    def guards():
+        if not guards_cache:
+            guards_cache.append(_guard_names(func_node))
+        return guards_cache[0]
+
+    for stmt in func_node.body:
+        _check_sentinel_stmt(stmt, guards, rel, findings)
+
+
+@rule("decode-sentinel",
+      doc="native decode -1 sentinels silently discarded")
+def decode_sentinel(module):
+    findings: list[Finding] = []
+    for fn in module.nodes:
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _check_sentinel_function(fn, module.rel, findings)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# timed-handler (PR 7): every _route-dispatched handler wears @_timed
+# ---------------------------------------------------------------------------
+
+
+def _route_handlers(tree, nodes=None):
+    for cls in (nodes if nodes is not None else ast.walk(tree)):
+        if not (isinstance(cls, ast.ClassDef)
+                and cls.name == "FiloHttpServer"):
+            continue
+        for fn in cls.body:
+            if isinstance(fn, ast.FunctionDef) and fn.name == "_route":
+                names = set()
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Return) \
+                            or node.value is None:
+                        continue
+                    for c in ast.walk(node.value):
+                        if isinstance(c, ast.Call) \
+                                and isinstance(c.func, ast.Attribute) \
+                                and isinstance(c.func.value, ast.Name) \
+                                and c.func.value.id == "self":
+                            names.add(c.func.attr)
+                return cls, names
+    return None, set()
+
+
+@rule("timed-handler",
+      doc="HTTP handlers dispatched from _route without @_timed")
+def timed_handler(module):
+    cls, names = _route_handlers(module.tree, module.nodes)
+    if cls is None:
+        return []
+    findings = []
+    for fn in cls.body:
+        if not (isinstance(fn, ast.FunctionDef) and fn.name in names):
+            continue
+        decorated = False
+        for d in fn.decorator_list:
+            target = d.func if isinstance(d, ast.Call) else d
+            if isinstance(target, ast.Name) and target.id == "_timed":
+                decorated = True
+        if not decorated:
+            findings.append(Finding(
+                "timed-handler", module.rel, fn.lineno,
+                f"{fn.name} is dispatched from _route but not decorated "
+                f"with @_timed — its latency never reaches the request "
+                f"histogram"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# admission-routing (PR 10): only _exec materializes; _exec must admit
+# ---------------------------------------------------------------------------
+
+
+@rule("admission-routing",
+      doc="query handlers bypassing the admission controller")
+def admission_routing(module):
+    findings = []
+    for cls in module.nodes:
+        if not (isinstance(cls, ast.ClassDef)
+                and cls.name == "FiloHttpServer"):
+            continue
+        exec_has_admit = False
+        exec_line = cls.lineno
+        for fn in cls.body:
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            if fn.name == "_exec":
+                exec_line = fn.lineno
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                if node.func.attr == "materialize" and fn.name != "_exec":
+                    findings.append(Finding(
+                        "admission-routing", module.rel, node.lineno,
+                        f"{fn.name} materializes a plan outside _exec — "
+                        f"queries must route through self._exec so "
+                        f"admission control prices and admits them"))
+                if fn.name == "_exec" and node.func.attr == "_admit" \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == "self":
+                    exec_has_admit = True
+        if not exec_has_admit:
+            findings.append(Finding(
+                "admission-routing", module.rel, exec_line,
+                "_exec does not call self._admit — the admission front "
+                "door is disconnected"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# deadline-threading (PR 10): urlopen bounded; dispatch timeouts derive
+# from the remaining deadline budget
+# ---------------------------------------------------------------------------
+
+_DEADLINE_NAMES = ("deadline", "remaining", "budget")
+
+
+@rule("deadline-threading",
+      doc="remote dispatch that does not thread the deadline")
+def deadline_threading(module):
+    findings = []
+
+    def names_in(expr) -> set:
+        got = set()
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name):
+                got.add(n.id)
+            elif isinstance(n, ast.Attribute):
+                got.add(n.attr)
+        return got
+
+    dispatch_nodes = set()
+    for cls in module.nodes:
+        if isinstance(cls, ast.ClassDef) and (
+                cls.name.endswith("Dispatcher")
+                or cls.name.endswith("Exec")):
+            for n in ast.walk(cls):
+                dispatch_nodes.add(id(n))
+
+    for node in module.nodes:
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, (ast.Attribute, ast.Name))):
+            continue
+        fname = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else node.func.id
+        if fname != "urlopen":
+            continue
+        timeout_kw = next((k for k in node.keywords
+                           if k.arg == "timeout"), None)
+        if timeout_kw is None:
+            findings.append(Finding(
+                "deadline-threading", module.rel, node.lineno,
+                "urlopen without timeout= — an unbounded socket can pin "
+                "a worker forever"))
+            continue
+        if id(node) in dispatch_nodes:
+            refs = {n.lower() for n in names_in(timeout_kw.value)}
+            if not any(dn in r for dn in _DEADLINE_NAMES for r in refs):
+                findings.append(Finding(
+                    "deadline-threading", module.rel, node.lineno,
+                    "remote dispatch urlopen whose timeout does not "
+                    "thread the deadline — derive it from the remaining "
+                    "budget (workload/deadline.py budget_timeout_s)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# device-put-ledger (PR 9): raw jax.device_put is invisible to the ledger
+# ---------------------------------------------------------------------------
+
+DEVICE_PUT_ALLOWLIST = ("utils/devicewatch.py",)
+
+
+@rule("device-put-ledger",
+      doc="raw jax.device_put not routed through the HBM ledger")
+def device_put_ledger(module):
+    if module.rel.endswith(DEVICE_PUT_ALLOWLIST):
+        return []
+    imported = set()
+    for node in module.nodes:
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.split(".")[0] == "jax":
+            for alias in node.names:
+                if alias.name == "device_put":
+                    imported.add(alias.asname or alias.name)
+    findings = []
+    for node in module.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        raw = (isinstance(f, ast.Attribute) and f.attr == "device_put"
+               and isinstance(f.value, ast.Name) and f.value.id == "jax") \
+            or (isinstance(f, ast.Name) and f.id in imported)
+        if raw:
+            findings.append(Finding(
+                "device-put-ledger", module.rel, node.lineno,
+                "raw jax.device_put — route it through devicewatch "
+                "LEDGER.device_put(..., owner=..., fmt=...) so the "
+                "bytes are attributed on the HBM residency ledger"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# replica-routing (PR 12): replica selection only via ReplicaSet.pick
+# ---------------------------------------------------------------------------
+
+_REPLICA_ENUMERATORS = {"replicas", "replica_nodes", "live_replicas"}
+_ROUTING_FN_HINTS = ("failover", "retarget", "hedge_alternate")
+_ROUTING_HELPERS = {"pick", "alternate"}
+
+
+@rule("replica-routing",
+      doc="ad-hoc replica selection outside ReplicaSet.pick")
+def replica_routing(module):
+    if module.rel.endswith("coordinator/replicas.py"):
+        return []              # the policy's one home
+
+    def called_attrs(node) -> set:
+        got = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute):
+                got.add(n.func.attr)
+        return got
+
+    findings = []
+    for cls in module.nodes:
+        if not (isinstance(cls, ast.ClassDef)
+                and cls.name.endswith("Dispatcher")):
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            bad = called_attrs(fn) & _REPLICA_ENUMERATORS
+            if bad:
+                findings.append(Finding(
+                    "replica-routing", module.rel, fn.lineno,
+                    f"{cls.name}.{fn.name} enumerates replicas ad hoc "
+                    f"({sorted(bad)}) — dispatchers must select through "
+                    f"ReplicaSet.pick()"))
+    for fn in module.nodes:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not any(h in fn.name for h in _ROUTING_FN_HINTS):
+            continue
+        if not (called_attrs(fn) & _ROUTING_HELPERS):
+            findings.append(Finding(
+                "replica-routing", module.rel, fn.lineno,
+                f"routing site {fn.name}() does not go through "
+                f"ReplicaSet.pick()/alternate()"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# interpret-coverage (PR 8, project scope): every ops/ kernel entry
+# point with an ``interpret`` param needs an interpret=True test
+# ---------------------------------------------------------------------------
+
+
+def kernel_entry_points(project) -> list[tuple[str, str, int]]:
+    """(rel, fn name, line) of public ops/ functions taking interpret."""
+    out = []
+    for m in project.modules:
+        if "/ops/" not in f"/{m.rel}" or m.tree is None:
+            continue
+        for fn in m.tree.body:
+            if not isinstance(fn, ast.FunctionDef) \
+                    or fn.name.startswith("_"):
+                continue
+            names = [a.arg for a in fn.args.args + fn.args.kwonlyargs]
+            if "interpret" in names:
+                out.append((m.rel, fn.name, fn.lineno))
+    return out
+
+
+@rule("interpret-coverage", scope="project",
+      doc="Pallas kernel entry points with no interpret-mode test")
+def interpret_coverage(project):
+    findings = []
+    srcs = project.test_sources
+    for rel, fn, line in kernel_entry_points(project):
+        covered = any(fn + "(" in src and "interpret=True" in src
+                      for src in srcs)
+        if not covered:
+            findings.append(Finding(
+                "interpret-coverage", rel, line,
+                f"{fn} has no interpret-mode test (call it with "
+                f"interpret=True in tests/) — CPU CI never exercises "
+                f"the kernel body"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# metric-doc (PR 11, project scope): every registered filodb_* family
+# appears in doc/observability.md's metric table
+# ---------------------------------------------------------------------------
+
+_METRIC_CTORS = {"counter", "gauge", "histogram"}
+
+
+def registered_metric_names(project) -> dict[str, tuple[str, int]]:
+    """{metric name: (rel, first registration line)}."""
+    names: dict[str, tuple[str, int]] = {}
+    for m in project.modules:
+        if m.tree is None:
+            continue
+        for node in m.nodes:
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_CTORS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            name = node.args[0].value
+            if name.startswith("filodb_") and name not in names:
+                names[name] = (m.rel, node.lineno)
+    return names
+
+
+def metric_documented(name: str, doc_text: str, doc_lines) -> bool:
+    if name in doc_text:
+        return True
+    parts = name.split("_")
+    for i in range(2, len(parts)):
+        fam = "_".join(parts[:i]) + "_*"
+        suffix = "_".join(parts[i:])
+        # same-line (table-row) matching: a suffix shared with another
+        # family must not mask the drift
+        if any(fam in line and suffix in line for line in doc_lines):
+            return True
+    return False
+
+
+@rule("metric-doc", scope="project",
+      doc="registered filodb_* metrics missing from doc/observability.md")
+def metric_doc(project):
+    doc_text = project.doc_text
+    doc_lines = doc_text.splitlines()
+    findings = []
+    for name, (rel, line) in sorted(registered_metric_names(project).items()):
+        if not metric_documented(name, doc_text, doc_lines):
+            findings.append(Finding(
+                "metric-doc", rel, line,
+                f"{name}: not in doc/observability.md's metric table — "
+                f"add the full name, or list its suffix on a "
+                f"`filodb_<family>_*` row"))
+    return findings

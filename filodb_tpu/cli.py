@@ -196,6 +196,16 @@ def cmd_verify_chunks(args) -> int:
     return 1 if report["total_failed"] else 0
 
 
+def cmd_lint(args) -> int:
+    """filolint static analysis (doc/analysis.md): lock-discipline race
+    detection, blocking-under-lock, resource lifecycle, and the eight
+    migrated sentinel lints over the whole tree.  Exit 0 = zero
+    unsuppressed findings.  Every argument passes straight through to
+    ``python -m filodb_tpu.analysis`` — one parser, no drift."""
+    from filodb_tpu.analysis.__main__ import main as lint_main
+    return lint_main(args.args)
+
+
 def cmd_partkey(args) -> int:
     """Debug: render a hex partkey as tags (reference: partKeyBrAsString)."""
     from filodb_tpu.core.record import parse_partkey
@@ -319,6 +329,16 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also decode every vector, not just checksums")
     vc.set_defaults(fn=cmd_verify_chunks)
 
+    lt = sub.add_parser("lint", add_help=False,
+                        help="filolint static analysis: lock-discipline "
+                             "races, blocking-under-lock, resource "
+                             "lifecycle + the sentinel lints")
+    lt.add_argument("args", nargs=argparse.REMAINDER,
+                    help="passed through to python -m filodb_tpu.analysis "
+                         "(--json, --rules, --list-rules, "
+                         "--show-suppressed, paths)")
+    lt.set_defaults(fn=cmd_lint)
+
     pk = sub.add_parser("partkey", help="decode a hex partkey")
     pk.add_argument("hex")
     pk.set_defaults(fn=cmd_partkey)
@@ -336,6 +356,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "lint":
+        # hand the rest straight to the filolint parser BEFORE argparse:
+        # an option-first spelling (`lint --json`) would otherwise be
+        # matched against the main parser instead of the REMAINDER
+        from filodb_tpu.analysis.__main__ import main as lint_main
+        return lint_main(argv[1:])
     args = build_parser().parse_args(argv)
     return args.fn(args)
 

@@ -508,10 +508,10 @@ class StatusPoller:
         # _hook_alive flips only under _hook_lock, atomically with the
         # final pending check, so a signal can never land between "thread
         # decided to exit" and "thread observed dead" and get dropped
-        self._change_pending = threading.Event()
+        self._change_pending = threading.Event()  # guarded-by: _hook_lock
         self._hook_thread: Optional[threading.Thread] = None
         self._hook_lock = threading.Lock()
-        self._hook_alive = False
+        self._hook_alive = False  # guarded-by: _hook_lock
 
     @property
     def leader(self) -> str:
@@ -739,7 +739,12 @@ class StatusPoller:
 
     def stop(self) -> None:
         self._stop.set()
-        self._change_pending.clear()
+        # pending/alive mutate only under _hook_lock (the invariant the
+        # class header documents); the unlocked clear here could race a
+        # concurrent _signal_change's locked set.  The lock is released
+        # before the joins below — _run_hook needs it to exit.
+        with self._hook_lock:
+            self._change_pending.clear()
         if self._thread is not None:
             self._thread.join(timeout=5)
         if self._hook_thread is not None:

@@ -636,7 +636,7 @@ class DeviceGridCache:
             return best
         return int(med)
 
-    def _disable(self) -> None:
+    def _disable(self) -> None:  # holds-lock: _lock
         """Turn the fast path off; retries back off exponentially so a
         shard whose frozen history permanently violates the layout
         invariant doesn't re-stage a full block on every query."""
@@ -676,8 +676,9 @@ class DeviceGridCache:
         if len(fargs) != _ARG_OPS.get(_GRID_OPS[func], 0):
             return None        # unexpected / missing function argument
         with self._lock:
-            vals = self._scan_rate_locked(part_ids, func, steps0, nsteps,
-                                          step_ms, window_ms, fargs)
+            vals = self._scan_rate_locked(  # filolint: disable=blocking-under-lock — staging under the grid lock is the design: one query stages the block, contenders reuse it instead of duplicating the HBM upload; the breaker bounds pathological re-staging
+                part_ids, func, steps0, nsteps,
+                step_ms, window_ms, fargs)
             if vals is None:
                 return None
             tops = np.asarray(self.bucket_tops) if self.hist else None
@@ -705,8 +706,9 @@ class DeviceGridCache:
         if len(fargs) != _ARG_OPS.get(_GRID_OPS[func], 0):
             return None        # unexpected / missing function argument
         with self._lock:
-            plan = self._plan_locked(part_ids, func, steps0, nsteps,
-                                     step_ms, window_ms, fargs)
+            plan = self._plan_locked(  # filolint: disable=blocking-under-lock — staging under the grid lock is the design: one query stages the block, contenders reuse it instead of duplicating the HBM upload; the breaker bounds pathological re-staging
+                part_ids, func, steps0, nsteps,
+                step_ms, window_ms, fargs)
             if plan is None:
                 return None
             stride = self.hb if self.hist else 1
@@ -774,8 +776,9 @@ class DeviceGridCache:
         if op in _REBASE_OPS or len(fargs) != _ARG_OPS.get(op, 0):
             return None
         with self._lock:
-            plan = self._plan_locked(part_ids, func, steps0, nsteps,
-                                     step_ms, window_ms, fargs)
+            plan = self._plan_locked(  # filolint: disable=blocking-under-lock — staging under the grid lock is the design: one query stages the block, contenders reuse it instead of duplicating the HBM upload; the breaker bounds pathological re-staging
+                part_ids, func, steps0, nsteps,
+                step_ms, window_ms, fargs)
             if plan is None or not plan.segs:
                 return None
             _note_hbm(plan)
@@ -1213,7 +1216,8 @@ class DeviceGridCache:
         # bucket containing lo is NOT fully frozen
         return (lo - self.epoch0 + self.gstep - 1) // self.gstep - 1
 
-    def _block_for(self, bi: int, lanes: int, frozen_hi: int,
+    def _block_for(self, bi: int, lanes: int,  # holds-lock: _lock
+                   frozen_hi: int,
                    need_hi: int):
         blk = self.blocks.get(bi)
         if blk is not None and blk.lanes == lanes \
@@ -1379,7 +1383,8 @@ class DeviceGridCache:
                       nbytes=nbytes, width=val_stage.shape[1],
                       pack_inv=pack_inv)
 
-    def _reclaim(self, target_bytes: int, keep: set) -> int:
+    def _reclaim(self, target_bytes: int,  # holds-lock: _lock
+                 keep: set) -> int:
         """Oldest-first reclaim down to ``target_bytes`` (the reference's
         reclaim-on-demand over time-ordered block lists).  Caller holds
         the lock.  Returns bytes freed."""

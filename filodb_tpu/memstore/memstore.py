@@ -197,5 +197,5 @@ class TimeSeriesMemStore:
     def reset(self) -> None:
         for shards in self._datasets.values():
             for sh in shards.values():
-                sh.cardinality.close()
+                sh.close()
         self._datasets.clear()

@@ -322,6 +322,14 @@ class OnDemandPagingShard(TimeSeriesShard):
         # fell back to the per-chunk path (which diagnoses + quarantines)
         self.stats.page_decode_corrupt = 0
 
+    def close(self) -> None:
+        """The page-cache pool registration is a set_fn gauge holding
+        this shard's paged-LRU alive — deregister it on teardown (the
+        leak the resource-lifecycle lint exists to catch)."""
+        from filodb_tpu.utils.devicewatch import LEDGER
+        LEDGER.deregister_pool(self._ledger_owner)
+        super().close()
+
     def _join_materialize(self, part_id: Optional[int] = None) -> None:
         # peek-join-remove (NOT pop-then-join): a task must stay visible
         # to concurrent threads until its publish has actually landed,
@@ -520,7 +528,7 @@ class OnDemandPagingShard(TimeSeriesShard):
             # before this query classifies hits/misses, or it would
             # re-read the whole set from the store (publishes don't take
             # _odp_lock, so joining under it cannot deadlock)
-            self._join_materialize()
+            self._join_materialize()  # filolint: disable=blocking-under-lock — deliberate: deferred publishes never take _odp_lock, so joining under it cannot deadlock, and classification must not race a landing publish (ADVICE r5 #4)
             built: dict[int, TimeSeriesPartition] = {}
             by_pk: dict[bytes, int] = {}
             for pid in part_ids:
@@ -829,7 +837,7 @@ class OnDemandPagingShard(TimeSeriesShard):
             resident.update(got[0])
             return
         with self._odp_lock:
-            self._join_materialize()   # see _page_in_bulk
+            self._join_materialize()  # filolint: disable=blocking-under-lock — see _page_in_bulk: publishes never take _odp_lock; join-under-lock is the no-duplicate-page-in invariant
             by_pk = {}
             for pid in part_ids:
                 # another query thread may have paged it in while this one

@@ -188,6 +188,14 @@ class TimeSeriesShard:
         self.downsample_resolutions = tuple(resolutions_ms)
         self._downsamplers = {}
 
+    def close(self) -> None:
+        """Release registry-held callbacks (Gauge.remove contract):
+        everything this shard registered against process-wide state must
+        be unwound or the registry keeps the shard alive and keeps
+        exporting rows for it.  Subclasses extend (ODP deregisters its
+        page-cache pool)."""
+        self.cardinality.close()
+
     # ------------------------------------------------------------------ ingest
 
     def ingest_container(self, container: bytes, offset: int) -> int:

@@ -65,9 +65,12 @@ class WatermarkLedger:
     def __init__(self, stall_window_s: float = 30.0, node: str = ""):
         self.stall_window_s = float(stall_window_s)
         self.node = node
-        self._watches: dict[str, _Watch] = {}
-        # (dataset, shard) -> stall state
-        self._stall: dict[tuple, dict] = {}
+        self._watches: dict[str, _Watch] = {}  # guarded-by: _lock
+        # (dataset, shard) -> stall state; the stall machine advances
+        # under the ledger lock or concurrent sampler + /admin/shards
+        # passes double-count an episode boundary (PR 11 review fix,
+        # now lint-enforced)
+        self._stall: dict[tuple, dict] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def watch(self, dataset: str, memstore, mapper=None,

@@ -47,7 +47,7 @@ def _load():
     with _lock:
         if _lib is not None or _build_error is not None:
             return _lib
-        err = _build()
+        err = _build()  # filolint: disable=blocking-under-lock — single-flight native build: the first caller compiles once per process; contenders must wait for the artifact, not race the compiler
         if err is not None:
             _build_error = err
             return None

@@ -143,7 +143,7 @@ class _SqliteBase:
         if path != ":memory:":
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._local = threading.local()
-        self._ddl_done = False
+        self._ddl_done = False  # guarded-by: _ddl_lock
         self._ddl_lock = threading.Lock()
         self._in_batch_size = None  # resolved from the sqlite var limit
 
@@ -173,7 +173,7 @@ class _SqliteBase:
             # bulk page-in pulls megabytes of chunk blobs per query)
             conn.execute("PRAGMA mmap_size=1073741824")
             self._local.conn = conn
-        if not self._ddl_done:  # double-checked: lock only until DDL runs
+        if not self._ddl_done:  # filolint: disable=lock-discipline — double-checked locking: the racy read only skips the lock on the hot path; the write side re-checks under _ddl_lock
             with self._ddl_lock:
                 if not self._ddl_done:
                     self._ddl(conn)
@@ -184,11 +184,16 @@ class _SqliteBase:
         raise NotImplementedError
 
     def shutdown(self) -> None:
-        mem = getattr(self, "_mem_conn", None)
-        if mem is not None:
-            mem.close()
-            self._mem_conn = None
-            self._ddl_done = False  # a later use gets a fresh empty db
+        # teardown under _ddl_lock: an unlocked reset here could
+        # interleave with a concurrent _conn()'s locked create path and
+        # leave a fresh connection marked DDL-less (the lock-discipline
+        # lint now holds this to the same rule as _conn)
+        with self._ddl_lock:
+            mem = getattr(self, "_mem_conn", None)
+            if mem is not None:
+                mem.close()
+                self._mem_conn = None
+                self._ddl_done = False  # a later use gets a fresh empty db
         conn = getattr(self._local, "conn", None)
         if conn is not None:
             conn.close()

@@ -244,7 +244,7 @@ class HbmLedger:
         pool shows in the ledger tree and as
         ``filodb_device_hbm_bytes{owner=<name>,format=<fmt>}``."""
         with self._lock:
-            self._pools[name] = (bytes_fn, budget_fn)
+            self._pools[name] = (bytes_fn, budget_fn, fmt)
         device_metrics()["hbm_bytes"].set_fn(
             lambda: float(self._pool_bytes(name)), owner=name, format=fmt)
 
@@ -252,8 +252,11 @@ class HbmLedger:
         with self._lock:
             pool = self._pools.pop(name, None)
         if pool is not None:
+            # remove under the fmt the pool REGISTERED with — a
+            # hardcoded label here leaked the set_fn (and its captured
+            # instance) for every non-default fmt
             device_metrics()["hbm_bytes"].remove(owner=name,
-                                                 format="odp-page-cache")
+                                                 format=pool[2])
 
     def _pool_bytes(self, name: str) -> int:
         pool = self._pools.get(name)
@@ -301,7 +304,7 @@ class HbmLedger:
         with self._lock:
             names = list(self._pools.items())
         out = {}
-        for name, (bytes_fn, budget_fn) in names:
+        for name, (bytes_fn, budget_fn, _fmt) in names:
             row = {"bytes": 0}
             try:
                 row["bytes"] = int(bytes_fn())

@@ -1,0 +1,559 @@
+"""filolint engine + the three semantic analyses (ISSUE 8).
+
+Covers:
+
+- engine mechanics: justification-required suppressions, stale-
+  suppression detection, unknown rules, meta-rule unsuppressibility;
+- a generalized positive/negative fixture over ALL rules (the old
+  per-lint ``*_lint_catches_*`` pattern, one table) including the
+  seeded PR 11/12 bug shapes (blocking peer POST under a held lock,
+  tenant-gauge mutation off the export lock, stall-machine state);
+- lock-discipline specifics: ``# guarded-by:`` / ``# holds-lock:``
+  annotations, the ``*_locked`` naming convention, Condition aliasing,
+  deferred (lambda / nested def) bodies;
+- the tier-1 gate: zero unsuppressed findings over filodb_tpu/ under a
+  10s wall-clock budget, ``--json`` output shaped for CI, nonzero exit
+  on a violation, and the delete-any-suppression / re-introduce-the-
+  fixed-bug regressions the acceptance criteria name.
+"""
+
+import json
+import pathlib
+import time
+
+import pytest
+
+import filodb_tpu.analysis as A
+from filodb_tpu.analysis.__main__ import main as lint_main
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+PKG = REPO / "filodb_tpu"
+
+
+def _fake(src, rules, rel="filodb_tpu/fake.py", **kw):
+    return A.unsuppressed(A.run_source(src, rules=rules, rel=rel, **kw))
+
+
+# ---------------------------------------------------------------------------
+# engine: suppression discipline
+# ---------------------------------------------------------------------------
+
+_BAD_SENTINEL = (
+    "def f(self, buf):\n"
+    "    self._lib.dd_decode(buf, 1, 2, 3, None, 0){}\n"
+)
+
+
+def test_suppression_needs_matching_rule_and_reason():
+    # justified suppression of the right rule: silent
+    src = _BAD_SENTINEL.format(
+        "  # filolint: disable=decode-sentinel — synthetic input")
+    fs = A.run_source(src, rules=["decode-sentinel"])
+    assert A.unsuppressed(fs) == []
+    sup = [f for f in fs if f.suppressed]
+    assert len(sup) == 1 and sup[0].suppress_reason == "synthetic input"
+
+
+def test_suppression_without_reason_is_an_error():
+    src = _BAD_SENTINEL.format("  # filolint: disable=decode-sentinel")
+    got = _fake(src, ["decode-sentinel"])
+    rules = {f.rule for f in got}
+    # the original finding stays visible AND the bare disable is flagged
+    assert "decode-sentinel" in rules
+    assert A.engine.SUPPRESSION_SYNTAX in rules
+
+
+def test_stale_suppression_is_an_error():
+    src = ("x = 1  # filolint: disable=decode-sentinel — nothing actually "
+           "fires here\n")
+    got = _fake(src, ["decode-sentinel"])
+    assert len(got) == 1 and got[0].rule == A.engine.STALE_SUPPRESSION
+    assert "never fires" in got[0].message
+
+
+def test_stale_only_relative_to_selected_rules():
+    """A --rules subset must not condemn other rules' suppressions."""
+    src = ("x = 1  # filolint: disable=decode-sentinel — pending\n")
+    got = _fake(src, ["timed-handler"])      # decode-sentinel did not run
+    assert got == []
+
+
+def test_unknown_rule_in_disable_is_an_error():
+    src = "x = 1  # filolint: disable=no-such-rule — whatever\n"
+    got = _fake(src, ["decode-sentinel"])
+    assert len(got) == 1 and "unknown rule" in got[0].message
+
+
+def test_meta_rules_cannot_be_suppressed():
+    src = ("x = 1  # filolint: disable=stale-suppression — nice try\n")
+    got = _fake(src, ["decode-sentinel"])
+    assert any("cannot be suppressed" in f.message for f in got)
+
+
+def test_multi_rule_disable_comment():
+    src = (
+        "import urllib.request\n"
+        "class C:\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            urllib.request.urlopen(u)  "
+        "# filolint: disable=blocking-under-lock,deadline-threading "
+        "— test double: both rules fire on this line by design\n"
+    )
+    fs = A.run_source(src, rules=["blocking-under-lock",
+                                  "deadline-threading"])
+    assert A.unsuppressed(fs) == []
+    assert sum(1 for f in fs if f.suppressed) == 2
+
+
+def test_unparseable_module_is_reported():
+    got = _fake("def broken(:\n", ["decode-sentinel"])
+    assert len(got) == 1 and "unparseable" in got[0].message
+
+
+def test_docstring_mention_is_not_a_directive():
+    src = '"""Docs may show # filolint: disable=decode-sentinel — x."""\n'
+    assert _fake(src, ["decode-sentinel"]) == []
+
+
+# ---------------------------------------------------------------------------
+# one table of positive/negative snippets for every rule (the old
+# *_lint_catches_* pattern, generalized)
+# ---------------------------------------------------------------------------
+
+RULE_CASES = [
+    ("decode-sentinel",
+     "def f(self, buf):\n    self._lib.dd_decode(buf, 1)\n",
+     "def f(self, buf):\n    got = self._lib.dd_decode(buf, 1)\n"
+     "    if got < 0:\n        raise ValueError\n",
+     "sentinel", {}),
+    ("timed-handler",
+     "class FiloHttpServer:\n"
+     "    def _route(self, p, q):\n        return self._dark(q)\n"
+     "    def _dark(self, q):\n        return 200, {}\n",
+     "class FiloHttpServer:\n"
+     "    def _route(self, p, q):\n        return self._lit(q)\n"
+     "    @_timed('lit')\n"
+     "    def _lit(self, q):\n        return 200, {}\n",
+     "histogram", {}),
+    ("interpret-coverage",
+     "def new_kernel(x, interpret=False):\n    return x\n",
+     "def new_kernel(x, interpret=False):\n    return x\n",
+     "interpret", {"rel": "filodb_tpu/ops/fake.py",
+                   "good_kw": {"test_sources":
+                               ["y = new_kernel(a, interpret=True)"]},
+                   "bad_kw": {"test_sources": ["z = 1"]}}),
+    ("device-put-ledger",
+     "import jax\nx = jax.device_put(a, d)\n",
+     "from filodb_tpu.utils.devicewatch import LEDGER\n"
+     "x = LEDGER.device_put(a, d, owner='o', fmt='dense')\n",
+     "ledger", {}),
+    ("admission-routing",
+     "class FiloHttpServer:\n"
+     "    def _exec(self, b, plan):\n"
+     "        ep = b.planner.materialize(plan, q)\n"
+     "        return ep.execute(ctx)\n",
+     "class FiloHttpServer:\n"
+     "    def _exec(self, b, plan):\n"
+     "        ep = b.planner.materialize(plan, q)\n"
+     "        with self._admit(b, ep, q):\n"
+     "            return ep.execute(ctx)\n",
+     "_admit", {}),
+    ("deadline-threading",
+     "import urllib.request\n"
+     "class MyPlanDispatcher:\n"
+     "    def dispatch(self):\n"
+     "        urllib.request.urlopen(req, timeout=60.0)\n",
+     "import urllib.request\n"
+     "class MyPlanDispatcher:\n"
+     "    def dispatch(self):\n"
+     "        remaining_s = deadline.budget_timeout_s(q, 60.0)\n"
+     "        urllib.request.urlopen(req, timeout=remaining_s)\n",
+     "deadline", {}),
+    ("metric-doc",
+     "m = REG.counter('filodb_brand_new_total', 'h')\n",
+     "m = REG.counter('filodb_query_request_seconds', 'h')\n",
+     "observability.md",
+     {"good_kw": {"doc_text": "| `filodb_query_*` | `request_seconds` |"},
+      "bad_kw": {"doc_text": "| `filodb_query_*` | `request_seconds` |"}}),
+    ("replica-routing",
+     "class MyPlanDispatcher:\n"
+     "    def dispatch(self, plan, ctx):\n"
+     "        return self.mapper.replica_nodes(plan.shard)[0]\n",
+     "class MyPlanDispatcher:\n"
+     "    def dispatch(self, plan, ctx):\n"
+     "        return self.replica_set.pick(plan.shard)[0]\n",
+     "ReplicaSet.pick", {}),
+    # --- the three NEW analyses, seeded with the PR 11/12 bug shapes ---
+    ("lock-discipline",
+     # the _set_tenant_gauges shape: rows mutated off the export lock
+     "class TenantGauges:\n"
+     "    def __init__(self):\n"
+     "        self._rows = {}\n"
+     "    def sample(self):\n"
+     "        with _EXPORT_LOCK:\n"
+     "            self._rows['a'] = 1\n"
+     "    def report(self):\n"
+     "        with _EXPORT_LOCK:\n"
+     "            self._rows.pop('a', None)\n"
+     "    def clobber(self):\n"
+     "        self._rows.clear()\n",
+     "class TenantGauges:\n"
+     "    def __init__(self):\n"
+     "        self._rows = {}\n"
+     "    def sample(self):\n"
+     "        with _EXPORT_LOCK:\n"
+     "            self._rows['a'] = 1\n"
+     "    def report(self):\n"
+     "        with _EXPORT_LOCK:\n"
+     "            self._rows.pop('a', None)\n"
+     "    def clobber(self):\n"
+     "        with _EXPORT_LOCK:\n"
+     "            self._rows.clear()\n",
+     "does not hold it", {}),
+    ("blocking-under-lock",
+     # the ReplicaFanout wedge: a blocking peer POST inside the lock
+     "import urllib.request\n"
+     "class ReplicaFanout:\n"
+     "    def publish(self, container):\n"
+     "        with self._lock:\n"
+     "            urllib.request.urlopen(req, timeout=self.timeout_s)\n",
+     "import urllib.request\n"
+     "class ReplicaFanout:\n"
+     "    def publish(self, container):\n"
+     "        with self._lock:\n"
+     "            lanes = list(self._lanes)\n"
+     "        urllib.request.urlopen(req, timeout=self.timeout_s)\n",
+     "convoy", {}),
+    ("resource-lifecycle",
+     "class T:\n"
+     "    def start(self):\n"
+     "        g = registry.gauge('x')\n"
+     "        g.set_fn(self._sample, shard=1)\n",
+     "class T:\n"
+     "    def start(self):\n"
+     "        g = registry.gauge('x')\n"
+     "        g.set_fn(self._sample, shard=1)\n"
+     "    def close(self):\n"
+     "        registry.gauge('x').remove(shard=1)\n",
+     "Gauge.remove", {}),
+]
+
+
+@pytest.mark.parametrize(
+    "rule,bad,good,match,extra",
+    RULE_CASES, ids=[c[0] for c in RULE_CASES])
+def test_rule_fires_on_bad_and_accepts_good(rule, bad, good, match, extra):
+    rel = extra.get("rel", "filodb_tpu/fake.py")
+    got = _fake(bad, [rule], rel=rel, **extra.get("bad_kw", {}))
+    assert got, f"{rule}: did not fire on the bad shape"
+    assert all(f.rule == rule for f in got)
+    assert any(match in f.message for f in got), \
+        f"{rule}: message lacks {match!r}: {got[0].message}"
+    assert _fake(good, [rule], rel=rel, **extra.get("good_kw", {})) == [], \
+        f"{rule}: false positive on the good shape"
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline specifics
+# ---------------------------------------------------------------------------
+
+
+def test_guarded_by_annotation_flags_reads_and_writes():
+    src = (
+        "class StallMachine:\n"
+        "    def __init__(self):\n"
+        "        self._stall = {}  # guarded-by: _lock\n"
+        "    def sample(self):\n"
+        "        with self._lock:\n"
+        "            self._stall['k'] = 1\n"
+        "    def peek(self):\n"
+        "        return self._stall.get('k')\n"
+    )
+    got = _fake(src, ["lock-discipline"])
+    assert len(got) == 1 and "read here without holding" in got[0].message
+    fixed = src.replace(
+        "        return self._stall.get('k')\n",
+        "        with self._lock:\n"
+        "            return self._stall.get('k')\n")
+    assert _fake(fixed, ["lock-discipline"]) == []
+
+
+def test_dangling_guarded_by_annotation_is_an_error():
+    """A guarded-by comment that binds to no attribute assignment must
+    fail loudly, not silently disarm the race detector."""
+    src = (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        pass  # guarded-by: _lock\n"
+    )
+    got = _fake(src, ["lock-discipline"])
+    assert len(got) == 1 and "binds to nothing" in got[0].message
+
+
+def test_holds_lock_annotation_and_locked_suffix():
+    src = (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._m = {}  # guarded-by: _lock\n"
+        "    def a(self):\n"
+        "        with self._lock:\n"
+        "            self._apply_locked()\n"
+        "    def _apply_locked(self):\n"
+        "        self._m['x'] = 1\n"
+        "    def _sweep(self):  # holds-lock: _lock\n"
+        "        self._m.clear()\n"
+    )
+    assert _fake(src, ["lock-discipline"]) == []
+
+
+def test_condition_aliases_its_lock():
+    src = (
+        "import threading\n"
+        "class Q:\n"
+        "    def __init__(self):\n"
+        "        self._pending = []  # guarded-by: _lock\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cv = threading.Condition(self._lock)\n"
+        "    def put(self, x):\n"
+        "        with self._cv:\n"
+        "            self._pending.append(x)\n"
+        "    def drain(self):\n"
+        "        with self._lock:\n"
+        "            self._pending.clear()\n"
+    )
+    assert _fake(src, ["lock-discipline"]) == []
+
+
+def test_deferred_bodies_do_not_inherit_the_lock():
+    """A lambda/def registered under a lock runs later WITHOUT it —
+    the walker must not treat its body as locked (a blocking call in a
+    set_fn callback registered under a lock is fine)."""
+    src = (
+        "import urllib.request\n"
+        "class C:\n"
+        "    def start(self):\n"
+        "        with self._lock:\n"
+        "            self._cb = lambda: urllib.request.urlopen(u)\n"
+    )
+    assert _fake(src, ["blocking-under-lock"]) == []
+
+
+def test_blocking_propagates_through_local_helpers():
+    src = (
+        "import time\n"
+        "class C:\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            self._hop1()\n"
+        "    def _hop1(self):\n"
+        "        self._hop2()\n"
+        "    def _hop2(self):\n"
+        "        time.sleep(1)\n"
+    )
+    got = _fake(src, ["blocking-under-lock"])
+    assert len(got) == 1
+    assert "via _hop1 -> _hop2" in got[0].message
+
+
+def test_future_result_and_thread_join_under_lock():
+    src = (
+        "class C:\n"
+        "    def a(self, fut, t):\n"
+        "        with self._lock:\n"
+        "            x = fut.result(timeout=5)\n"
+        "            t.join()\n"
+        "    def b(self, parts):\n"
+        "        with self._lock:\n"
+        "            return ','.join(parts)\n"     # str.join: not blocking
+    )
+    got = _fake(src, ["blocking-under-lock"])
+    assert len(got) == 2
+
+
+def test_lifecycle_periodic_thread_and_finalize_and_pool():
+    thread_bad = (
+        "class S:\n"
+        "    def start(self):\n"
+        "        self._loop = PeriodicThread(self.tick, 5.0)\n"
+    )
+    got = _fake(thread_bad, ["resource-lifecycle"])
+    assert len(got) == 1 and "PeriodicThread" in got[0].message
+    thread_good = thread_bad + (
+        "    def close(self):\n"
+        "        self._loop.stop()\n")
+    assert _fake(thread_good, ["resource-lifecycle"]) == []
+
+    fin_bad = (
+        "import weakref\n"
+        "class L:\n"
+        "    def track(self, arr):\n"
+        "        weakref.finalize(arr, self._cb, 1)\n"
+    )
+    got = _fake(fin_bad, ["resource-lifecycle"])
+    assert len(got) == 1 and "finalize" in got[0].message
+    fin_good = fin_bad + (
+        "    def untrack(self, key):\n"
+        "        self._fins.pop(key, None)\n")
+    assert _fake(fin_good, ["resource-lifecycle"]) == []
+
+    pool_bad = (
+        "class Sh:\n"
+        "    def start(self):\n"
+        "        LEDGER.register_pool('o', lambda: 0)\n"
+    )
+    got = _fake(pool_bad, ["resource-lifecycle"])
+    assert len(got) == 1 and "deregister_pool" in got[0].message
+    pool_good = pool_bad + (
+        "    def close(self):\n"
+        "        LEDGER.deregister_pool('o')\n")
+    assert _fake(pool_good, ["resource-lifecycle"]) == []
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: whole-tree run, budget, JSON, exit codes
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tree_findings():
+    t0 = time.monotonic()
+    findings = A.run_paths([PKG])
+    elapsed = time.monotonic() - t0
+    return findings, elapsed
+
+
+def test_full_tree_zero_unsuppressed_under_budget(tree_findings):
+    findings, elapsed = tree_findings
+    bad = A.unsuppressed(findings)
+    assert not bad, "unsuppressed findings:\n  " + "\n  ".join(
+        f"{f.where()}: [{f.rule}] {f.message}" for f in bad)
+    # every suppression that exists is justified (non-empty reason)
+    for f in findings:
+        if f.suppressed:
+            assert f.suppress_reason.strip()
+    assert elapsed <= 10.0, \
+        f"filolint full-tree run took {elapsed:.1f}s (budget 10s)"
+
+
+def test_cli_json_output_for_ci(capsys):
+    rc = lint_main([str(PKG), "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    doc = json.loads(out)
+    assert doc["summary"]["findings"] == 0
+    assert doc["summary"]["files"] >= 100
+    assert doc["summary"]["suppressed"] >= 1
+    for f in doc["findings"]:
+        assert {"rule", "path", "line", "message", "severity",
+                "suppressed", "suppress_reason"} <= set(f)
+
+
+def test_cli_nonzero_on_violation(tmp_path, capsys):
+    bad = tmp_path / "wedge.py"
+    bad.write_text(
+        "import urllib.request\n"
+        "class ReplicaFanout:\n"
+        "    def publish(self, c):\n"
+        "        with self._lock:\n"
+        "            urllib.request.urlopen(req, timeout=5)\n")
+    assert lint_main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "blocking-under-lock" in out
+
+
+def test_overlapping_paths_do_not_double_load(capsys):
+    """A dir + a file inside it must not load the module twice — the
+    duplicate's suppressions would report as falsely stale."""
+    target = PKG / "native" / "baseline.py"   # carries a suppression
+    rc = lint_main([str(PKG / "native"), str(target), "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0, doc["summary"]
+    assert doc["summary"]["findings"] == 0
+
+
+def test_match_statement_bodies_are_walked():
+    src = (
+        "import time\n"
+        "class C:\n"
+        "    def f(self, x):\n"
+        "        with self._lock:\n"
+        "            match x:\n"
+        "                case 1:\n"
+        "                    time.sleep(5)\n"
+    )
+    got = _fake(src, ["blocking-under-lock"])
+    assert len(got) == 1 and "sleep" in got[0].message
+
+
+def test_cli_lint_verb_passes_through(capsys):
+    from filodb_tpu.cli import main as cli_main
+    rc = cli_main(["lint", str(PKG / "analysis"), "--show-suppressed",
+                   "--rules", "decode-sentinel"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "filolint:" in out
+
+
+def test_cli_list_rules(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for name in ("lock-discipline", "blocking-under-lock",
+                 "resource-lifecycle", "decode-sentinel", "metric-doc"):
+        assert name in out
+
+
+def test_deleting_any_suppression_makes_it_fail(tree_findings):
+    """Acceptance: deleting any ONE suppression comment flips the tree
+    run nonzero — i.e. every suppression in the tree covers a finding
+    that would otherwise fire right there."""
+    findings, _ = tree_findings
+    suppressed = [f for f in findings if f.suppressed]
+    assert suppressed, "expected at least one justified suppression"
+    for f in suppressed:
+        path = REPO / f.path
+        lines = path.read_text().splitlines(keepends=True)
+        ln = lines[f.line - 1]
+        assert "# filolint:" in ln, (f.path, f.line)
+        lines[f.line - 1] = ln[:ln.index("# filolint:")].rstrip() + "\n"
+        got = _fake("".join(lines), [f.rule], rel=f.path)
+        assert any(g.rule == f.rule and g.line == f.line for g in got), \
+            f"stripping the suppression at {f.where()} did not re-fire " \
+            f"{f.rule}"
+
+
+def test_reintroducing_fixed_races_fails_the_build():
+    """Acceptance: the exact bug shapes this PR fixed fail the build if
+    they come back."""
+    # 1. StatusPoller.stop clearing _change_pending off _hook_lock
+    src = (REPO / "filodb_tpu/coordinator/cluster.py").read_text()
+    locked = ("        with self._hook_lock:\n"
+              "            self._change_pending.clear()\n")
+    assert locked in src
+    regressed = src.replace(
+        locked, "        self._change_pending.clear()\n")
+    got = _fake(regressed, ["lock-discipline"],
+                rel="filodb_tpu/coordinator/cluster.py")
+    assert any("_change_pending" in g.message for g in got)
+    assert _fake(src, ["lock-discipline"],
+                 rel="filodb_tpu/coordinator/cluster.py") == []
+
+    # 2. the ODP page-cache pool losing its deregistration path
+    src = (REPO / "filodb_tpu/memstore/odp.py").read_text()
+    dereg = "LEDGER.deregister_pool(self._ledger_owner)"
+    assert dereg in src
+    regressed = src.replace(dereg, "pass")
+    got = _fake(regressed, ["resource-lifecycle"],
+                rel="filodb_tpu/memstore/odp.py")
+    assert any("deregister_pool" in g.message for g in got)
+
+    # 3. _SqliteBase.shutdown resetting DDL state off _ddl_lock
+    src = (REPO / "filodb_tpu/store/persistence.py").read_text()
+    assert "self._ddl_done = False  # guarded-by: _ddl_lock" in src
+    regressed = src.replace(
+        "        with self._ddl_lock:\n"
+        "            mem = getattr(self, \"_mem_conn\", None)",
+        "        if True:\n"
+        "            mem = getattr(self, \"_mem_conn\", None)")
+    got = _fake(regressed, ["lock-discipline"],
+                rel="filodb_tpu/store/persistence.py")
+    assert any("_ddl_done" in g.message for g in got)

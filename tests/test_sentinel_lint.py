@@ -1,216 +1,104 @@
-"""Lint: native decode -1 sentinels must never be silently discarded.
+"""The eight sentinel lints, now thin wrappers over the filolint engine.
 
-Every native decode entry point reports corruption through an in-band
-sentinel (-1 / None / False) instead of raising.  ISSUE 1's tentpole
-turns those sentinels into structured CorruptVectorError diagnoses —
-this AST lint keeps FUTURE call-sites honest: a call whose sentinel
-return is discarded (bare expression statement) or assigned but never
-compared/branched on in the same function fails the build, unless the
-line carries an explicit ``# sentinel-ok: <reason>`` suppression.
+Until ISSUE 8 these were 760 lines of ad-hoc AST walking accumulated
+one lint per PR; the walking moved to ``filodb_tpu/analysis/``
+(doc/analysis.md) and this file keeps two things per lint:
 
-Two classes of call-site are linted:
-- raw ctypes calls (``self._lib.<fn>`` / ``lib.<fn>``) to functions
-  whose C return is a -1 sentinel;
-- adapter-protocol methods that RETURN sentinels instead of raising
-  (``nb.page_decode`` -> None, ``npr.gather`` -> None, ...).
+- the full-tree / target-file assertion (the build gate), now phrased
+  as "the engine reports zero unsuppressed findings for this rule";
+- the original ``*_lint_catches_*`` tests on synthetic snippets, which
+  prove the MIGRATION IS BEHAVIOR-PRESERVING: every bad shape the old
+  lints caught still fails, every good shape still passes.
+
+The three NEW semantic analyses (lock-discipline, blocking-under-lock,
+resource-lifecycle) and the engine itself are covered in
+tests/test_analysis.py.
 """
 
-import ast
 import pathlib
+
+import filodb_tpu.analysis as A
 
 ROOT = pathlib.Path(__file__).resolve().parents[1] / "filodb_tpu"
 
-# raw C functions with a -1 (or negative) corruption/overflow sentinel
-RAW_SENTINEL_FNS = {
-    "np_unpack", "np_packed_end", "dd_decode", "xor_unpack",
-    "ll_encode_batch", "dbl_encode_batch", "ll_decode_batch",
-    "dbl_decode_batch", "page_decode_column", "influx_parse_batch",
-    "gather_ranges", "head_hash128", "verify_heads",
-}
-# adapter methods returning None/False/INVALID sentinels; keyed by the
-# receiver names they are conventionally bound to (keeps generic names
-# like `gather` from matching unrelated code)
-ADAPTER_SENTINEL_FNS = {
-    "page_decode": {"nb"},
-    "page_decode_into": {"nb"},
-    "gather": {"npr"},
-    "head_hashes": {"npr"},
-    "verify": {"npr"},
-    "parse": {"npr", "nparse"},
-}
+
+def _tree(rules):
+    """Unsuppressed findings for a rule subset over the whole package."""
+    return A.unsuppressed(A.run_paths([ROOT], rules=rules))
 
 
-def _receiver_name(func: ast.expr):
-    """For a Call func like a.b.c(...), the names involved."""
-    if not isinstance(func, ast.Attribute):
-        return None, None
-    attr = func.attr
-    v = func.value
-    if isinstance(v, ast.Name):
-        return attr, v.id
-    if isinstance(v, ast.Attribute):
-        return attr, v.attr
-    return attr, None
+def _fake(src, rules, rel="filodb_tpu/fake.py", **kw):
+    """Engine run over one synthetic module (catch-tests)."""
+    return A.unsuppressed(A.run_source(src, rules=rules, rel=rel, **kw))
 
 
-def _is_sentinel_call(node: ast.Call):
-    attr, recv = _receiver_name(node.func)
-    if attr is None:
-        return False
-    if attr in RAW_SENTINEL_FNS and recv in ("_lib", "lib"):
-        return True
-    if attr in ADAPTER_SENTINEL_FNS and recv in ADAPTER_SENTINEL_FNS[attr]:
-        return True
-    return False
+def _fmt(findings):
+    return "\n  ".join(f"{f.where()}: {f.message}" for f in findings)
 
 
-def _names_in(node) -> set:
-    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
-
-
-def _guard_names(func_node) -> set:
-    """Names used anywhere in the function inside a comparison, boolean
-    test, or branch condition — i.e. names whose value IS checked."""
-    used = set()
-    for n in ast.walk(func_node):
-        if isinstance(n, ast.Compare):
-            used |= _names_in(n)
-        elif isinstance(n, (ast.If, ast.While, ast.IfExp)):
-            used |= _names_in(n.test)
-        elif isinstance(n, ast.Assert):
-            used |= _names_in(n.test)
-        elif isinstance(n, ast.BoolOp):
-            used |= _names_in(n)
-        elif isinstance(n, ast.UnaryOp) and isinstance(n.op, ast.Not):
-            used |= _names_in(n)
-    return used
-
-
-def _check_function(func_node, src_lines, path, violations):
-    guards = _guard_names(func_node)
-    for stmt in ast.walk(func_node):
-        if not isinstance(stmt, ast.stmt):
-            continue
-        calls = [n for n in ast.walk(stmt)
-                 if isinstance(n, ast.Call) and _is_sentinel_call(n)]
-        # only handle calls whose NEAREST enclosing statement is stmt
-        # (avoid double-reporting through nested statements)
-        for call in calls:
-            inner = [s for s in ast.walk(stmt)
-                     if isinstance(s, ast.stmt) and s is not stmt
-                     and call in ast.walk(s)]
-            if inner:
-                continue
-            line = src_lines[call.lineno - 1]
-            if "# sentinel-ok" in line:
-                continue
-            where = f"{path.relative_to(ROOT.parent)}:{call.lineno}"
-            attr, _ = _receiver_name(call.func)
-            if isinstance(stmt, (ast.If, ast.While)) and \
-                    call in ast.walk(stmt.test):
-                continue                      # branched on directly
-            if isinstance(stmt, (ast.Raise, ast.Assert)):
-                continue                      # raising with it
-            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
-                targets = stmt.targets if isinstance(stmt, ast.Assign) \
-                    else [stmt.target]
-                names = set()
-                for t in targets:
-                    names |= _names_in(t)
-                if names & guards:
-                    continue                  # assigned, then checked
-                violations.append(
-                    f"{where}: result of {attr}() assigned to "
-                    f"{sorted(names)} but never compared/branched on in "
-                    f"this function — a -1 sentinel would be silently "
-                    f"discarded")
-                continue
-            if isinstance(stmt, ast.Return) and isinstance(
-                    stmt.value, (ast.IfExp, ast.Compare, ast.BoolOp)):
-                continue                      # returns a checked form
-            violations.append(
-                f"{where}: result of {attr}() is discarded without "
-                f"raising or counting (bare use); check the sentinel or "
-                f"annotate '# sentinel-ok: <reason>'")
+# ---------------------------------------------------------------------------
+# decode-sentinel (ISSUE 1)
+# ---------------------------------------------------------------------------
 
 
 def test_native_decode_sentinels_are_checked():
-    violations = []
-    for path in sorted(ROOT.rglob("*.py")):
-        src = path.read_text()
-        try:
-            tree = ast.parse(src)
-        except SyntaxError as e:  # pragma: no cover - broken file
-            violations.append(f"{path}: unparseable: {e}")
-            continue
-        src_lines = src.splitlines()
-        funcs = [n for n in ast.walk(tree)
-                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
-        for fn in funcs:
-            _check_function(fn, src_lines, path, violations)
-    assert not violations, \
-        "native decode sentinel discarded at:\n  " + "\n  ".join(violations)
+    bad = _tree(["decode-sentinel"])
+    assert not bad, "native decode sentinel discarded at:\n  " + _fmt(bad)
+
+
+def test_lint_catches_a_discarded_sentinel():
+    """The lint must actually fire on the bad patterns (bare discard,
+    assigned-but-unchecked) and accept the checked form."""
+    bad = (
+        "def f(self, buf):\n"
+        "    self._lib.dd_decode(buf, 1, 2, 3, None, 0)\n"
+    )
+    got = _fake(bad, ["decode-sentinel"])
+    assert len(got) == 1 and "discarded" in got[0].message
+    bad2 = (
+        "def f(self, buf):\n"
+        "    got = self._lib.dd_decode(buf, 1, 2, 3, None, 0)\n"
+        "    return got\n"
+    )
+    got = _fake(bad2, ["decode-sentinel"])
+    assert len(got) == 1 and "never compared" in got[0].message
+    good = (
+        "def f(self, buf):\n"
+        "    got = self._lib.dd_decode(buf, 1, 2, 3, None, 0)\n"
+        "    if got < 0:\n"
+        "        raise ValueError('corrupt')\n"
+        "    return got\n"
+    )
+    assert _fake(good, ["decode-sentinel"]) == []
+
+
+def test_sentinel_suppression_is_engine_syntax():
+    """# filolint: disable replaces the legacy # sentinel-ok comment —
+    one suppression mechanism for every rule."""
+    src = (
+        "def f(self, buf):\n"
+        "    self._lib.dd_decode(buf, 1, 2, 3, None, 0)  "
+        "# filolint: disable=decode-sentinel — bench-only decode, "
+        "corruption impossible on the synthetic input\n"
+    )
+    fs = A.run_source(src, rules=["decode-sentinel"])
+    assert A.unsuppressed(fs) == []
+    assert any(f.suppressed for f in fs)
 
 
 # ---------------------------------------------------------------------------
-# HTTP route-handler latency lint (ISSUE 2): every handler the server's
-# _route dispatches to must wear the @_timed decorator, so no endpoint
-# added later can be dark on the request histogram.
+# timed-handler (ISSUE 2)
 # ---------------------------------------------------------------------------
-
-
-def _route_handlers(tree):
-    """(class node, handler method names called as ``return self._x(...)``
-    inside FiloHttpServer._route)."""
-    for cls in ast.walk(tree):
-        if not (isinstance(cls, ast.ClassDef)
-                and cls.name == "FiloHttpServer"):
-            continue
-        for fn in cls.body:
-            if isinstance(fn, ast.FunctionDef) and fn.name == "_route":
-                names = set()
-                for node in ast.walk(fn):
-                    if not isinstance(node, ast.Return) or node.value is None:
-                        continue
-                    for c in ast.walk(node.value):
-                        if isinstance(c, ast.Call) \
-                                and isinstance(c.func, ast.Attribute) \
-                                and isinstance(c.func.value, ast.Name) \
-                                and c.func.value.id == "self":
-                            names.add(c.func.attr)
-                return cls, names
-    return None, set()
-
-
-def _untimed_handlers(src: str) -> list:
-    tree = ast.parse(src)
-    cls, names = _route_handlers(tree)
-    if cls is None:
-        return ["FiloHttpServer._route not found"]
-    bad = []
-    for fn in cls.body:
-        if not (isinstance(fn, ast.FunctionDef) and fn.name in names):
-            continue
-        decorated = False
-        for d in fn.decorator_list:
-            target = d.func if isinstance(d, ast.Call) else d
-            if isinstance(target, ast.Name) and target.id == "_timed":
-                decorated = True
-        if not decorated:
-            bad.append(f"{fn.name} (line {fn.lineno}): dispatched from "
-                       f"_route but not decorated with @_timed — its "
-                       f"latency never reaches the request histogram")
-    return bad
 
 
 def test_route_handlers_record_latency():
     src = (ROOT / "http" / "server.py").read_text()
-    bad = _untimed_handlers(src)
-    assert not bad, "dark HTTP endpoints:\n  " + "\n  ".join(bad)
+    assert "class FiloHttpServer" in src       # lint wiring intact
+    bad = _tree(["timed-handler"])
+    assert not bad, "dark HTTP endpoints:\n  " + _fmt(bad)
 
 
 def test_route_lint_catches_dark_endpoint():
-    """The route lint must actually fire on an undecorated handler."""
     fake = (
         "class FiloHttpServer:\n"
         "    def _route(self, path, params, multi=None):\n"
@@ -218,8 +106,8 @@ def test_route_lint_catches_dark_endpoint():
         "    def _dark(self, p):\n"
         "        return 200, {}\n"
     )
-    bad = _untimed_handlers(fake)
-    assert len(bad) == 1 and "_dark" in bad[0]
+    got = _fake(fake, ["timed-handler"])
+    assert len(got) == 1 and "_dark" in got[0].message
     timed = (
         "class FiloHttpServer:\n"
         "    def _route(self, path, params, multi=None):\n"
@@ -228,219 +116,72 @@ def test_route_lint_catches_dark_endpoint():
         "    def _lit(self, p):\n"
         "        return 200, {}\n"
     )
-    assert _untimed_handlers(timed) == []
-
-
-def test_lint_catches_a_discarded_sentinel():
-    """The lint itself must actually fire on the bad pattern."""
-    bad = (
-        "def f(self, buf):\n"
-        "    self._lib.dd_decode(buf, 1, 2, 3, None, 0)\n"
-    )
-    violations = []
-    tree = ast.parse(bad)
-    _check_function(tree.body[0], bad.splitlines(),
-                    ROOT / "fake.py", violations)
-    assert len(violations) == 1
-    bad2 = (
-        "def f(self, buf):\n"
-        "    got = self._lib.dd_decode(buf, 1, 2, 3, None, 0)\n"
-        "    return got\n"
-    )
-    violations = []
-    tree = ast.parse(bad2)
-    _check_function(tree.body[0], bad2.splitlines(),
-                    ROOT / "fake.py", violations)
-    assert len(violations) == 1
-    good = (
-        "def f(self, buf):\n"
-        "    got = self._lib.dd_decode(buf, 1, 2, 3, None, 0)\n"
-        "    if got < 0:\n"
-        "        raise ValueError('corrupt')\n"
-        "    return got\n"
-    )
-    violations = []
-    tree = ast.parse(good)
-    _check_function(tree.body[0], good.splitlines(),
-                    ROOT / "fake.py", violations)
-    assert violations == []
+    assert _fake(timed, ["timed-handler"]) == []
 
 
 # ---------------------------------------------------------------------------
-# Kernel interpret-coverage lint (ISSUE 3): every jitted Pallas kernel
-# entry point in filodb_tpu/ops/ (identified by its ``interpret``
-# parameter — the convention every pallas wrapper follows) must have an
-# interpret-mode test referencing it, so CPU CI exercises the kernel
-# body even though Mosaic only compiles on TPU.  A new kernel without
-# an interpret test fails the build here.
+# interpret-coverage (ISSUE 3)
 # ---------------------------------------------------------------------------
-
-TESTS_DIR = pathlib.Path(__file__).resolve().parent
-
-
-def _kernel_entry_points(ops_dir=None):
-    """Top-level public functions in ops/*.py taking ``interpret``."""
-    ops_dir = ops_dir or (ROOT / "ops")
-    out = []
-    for path in sorted(ops_dir.glob("*.py")):
-        tree = ast.parse(path.read_text())
-        for fn in tree.body:
-            if not isinstance(fn, ast.FunctionDef) \
-                    or fn.name.startswith("_"):
-                continue
-            args = fn.args
-            names = [a.arg for a in args.args + args.kwonlyargs]
-            if "interpret" in names:
-                out.append((path.name, fn.name))
-    return out
-
-
-def _uncovered_kernels(entry_points, test_sources):
-    """Entry points with no test file that BOTH calls them and runs in
-    interpret mode."""
-    missing = []
-    for fname, fn in entry_points:
-        covered = any(fn + "(" in src and "interpret=True" in src
-                      for src in test_sources)
-        if not covered:
-            missing.append(f"{fname}:{fn} has no interpret-mode test "
-                           f"(call it with interpret=True in tests/)")
-    return missing
 
 
 def test_ops_kernel_entry_points_have_interpret_tests():
-    eps = _kernel_entry_points()
-    assert eps, "no kernel entry points found — lint wiring broken?"
-    srcs = [p.read_text() for p in TESTS_DIR.glob("test_*.py")]
-    missing = _uncovered_kernels(eps, srcs)
-    assert not missing, \
-        "kernels without interpret coverage:\n  " + "\n  ".join(missing)
+    modules, root = A.load_modules([ROOT])
+    project = A.Project(modules, root)
+    from filodb_tpu.analysis.sentinels import kernel_entry_points
+    assert kernel_entry_points(project), \
+        "no kernel entry points found — lint wiring broken?"
+    bad = _tree(["interpret-coverage"])
+    assert not bad, \
+        "kernels without interpret coverage:\n  " + _fmt(bad)
 
 
 def test_interpret_lint_catches_uncovered_kernel():
-    """The lint must actually fire on an uncovered entry point."""
-    missing = _uncovered_kernels([("fake.py", "totally_new_kernel")],
-                                 ["x = 1"])
-    assert len(missing) == 1 and "totally_new_kernel" in missing[0]
-    covered = _uncovered_kernels(
-        [("fake.py", "totally_new_kernel")],
-        ["out = totally_new_kernel(a, interpret=True)"])
+    src = "def totally_new_kernel(x, interpret=False):\n    return x\n"
+    got = _fake(src, ["interpret-coverage"],
+                rel="filodb_tpu/ops/fake.py", test_sources=["x = 1"])
+    assert len(got) == 1 and "totally_new_kernel" in got[0].message
+    covered = _fake(
+        src, ["interpret-coverage"], rel="filodb_tpu/ops/fake.py",
+        test_sources=["out = totally_new_kernel(a, interpret=True)"])
     assert covered == []
 
 
 # ---------------------------------------------------------------------------
-# HBM-ledger lint (ISSUE 4): every ``jax.device_put`` under filodb_tpu/
-# must route through the devicewatch residency ledger
-# (LEDGER.device_put / a local wrapper built on it), so every byte that
-# lands on the accelerator is attributed to an owner — a raw call would
-# be invisible to /admin/device and break the reconciliation invariant.
-# The wrapper module itself is the only allowed raw call site.
+# device-put-ledger (ISSUE 4)
 # ---------------------------------------------------------------------------
-
-DEVICE_PUT_ALLOWLIST = {"utils/devicewatch.py"}
-
-
-def _raw_device_put_calls(src: str, relpath: str) -> list:
-    """Raw ``jax.device_put(...)`` (or bare ``device_put(...)`` imported
-    from jax) call sites in one module."""
-    tree = ast.parse(src)
-    # names `device_put` was imported under (from jax import device_put)
-    imported = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module \
-                and node.module.split(".")[0] == "jax":
-            for alias in node.names:
-                if alias.name == "device_put":
-                    imported.add(alias.asname or alias.name)
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        raw = (isinstance(f, ast.Attribute) and f.attr == "device_put"
-               and isinstance(f.value, ast.Name) and f.value.id == "jax") \
-            or (isinstance(f, ast.Name) and f.id in imported)
-        if raw:
-            out.append(f"{relpath}:{node.lineno}: raw jax.device_put — "
-                       f"route it through devicewatch LEDGER.device_put"
-                       f"(..., owner=..., fmt=...) so the bytes are "
-                       f"attributed on the HBM residency ledger")
-    return out
 
 
 def test_device_put_routes_through_ledger():
-    violations = []
-    for path in sorted(ROOT.rglob("*.py")):
-        rel = str(path.relative_to(ROOT))
-        if rel in DEVICE_PUT_ALLOWLIST:
-            continue
-        violations.extend(_raw_device_put_calls(path.read_text(), rel))
-    assert not violations, \
-        "unledgered device_put at:\n  " + "\n  ".join(violations)
+    bad = _tree(["device-put-ledger"])
+    assert not bad, "unledgered device_put at:\n  " + _fmt(bad)
 
 
 def test_device_put_lint_catches_raw_call():
-    """The ledger lint must actually fire on both raw spellings."""
     attr = "import jax\nx = jax.device_put(a, d)\n"
-    assert len(_raw_device_put_calls(attr, "fake.py")) == 1
+    assert len(_fake(attr, ["device-put-ledger"])) == 1
     bare = "from jax import device_put\nx = device_put(a, d)\n"
-    assert len(_raw_device_put_calls(bare, "fake.py")) == 1
+    assert len(_fake(bare, ["device-put-ledger"])) == 1
     ok = ("from filodb_tpu.utils.devicewatch import LEDGER\n"
           "x = LEDGER.device_put(a, d, owner='o', fmt='dense')\n")
-    assert _raw_device_put_calls(ok, "fake.py") == []
+    assert _fake(ok, ["device-put-ledger"]) == []
+    # the wrapper module itself is the one allowed raw call site
+    assert _fake(attr, ["device-put-ledger"],
+                 rel="filodb_tpu/utils/devicewatch.py") == []
 
 
 # ---------------------------------------------------------------------------
-# Admission-routing lint (ISSUE 5): every HTTP query handler must reach
-# execution through the admission controller.  Concretely: inside
-# FiloHttpServer, ONLY ``_exec`` may materialize a plan (handlers call
-# self._exec, which prices + admits before scheduling), and ``_exec``
-# itself must call ``self._admit``.  A future handler that plans or
-# executes directly would bypass the overload defense — it fails here.
+# admission-routing (ISSUE 5)
 # ---------------------------------------------------------------------------
-
-
-def _admission_violations(src: str) -> list:
-    tree = ast.parse(src)
-    out = []
-    for cls in ast.walk(tree):
-        if not (isinstance(cls, ast.ClassDef)
-                and cls.name == "FiloHttpServer"):
-            continue
-        exec_has_admit = False
-        for fn in cls.body:
-            if not isinstance(fn, ast.FunctionDef):
-                continue
-            for node in ast.walk(fn):
-                if not (isinstance(node, ast.Call)
-                        and isinstance(node.func, ast.Attribute)):
-                    continue
-                if node.func.attr == "materialize" and fn.name != "_exec":
-                    out.append(
-                        f"{fn.name} (line {node.lineno}): materializes a "
-                        f"plan outside _exec — queries must route through "
-                        f"self._exec so admission control prices and "
-                        f"admits them")
-                if fn.name == "_exec" and node.func.attr == "_admit" \
-                        and isinstance(node.func.value, ast.Name) \
-                        and node.func.value.id == "self":
-                    exec_has_admit = True
-        if not exec_has_admit:
-            out.append("_exec does not call self._admit — the admission "
-                       "front door is disconnected")
-        return out
-    return ["FiloHttpServer not found"]
 
 
 def test_query_handlers_route_through_admission():
     src = (ROOT / "http" / "server.py").read_text()
-    bad = _admission_violations(src)
-    assert not bad, "admission bypass:\n  " + "\n  ".join(bad)
+    assert "class FiloHttpServer" in src       # lint wiring intact
+    bad = _tree(["admission-routing"])
+    assert not bad, "admission bypass:\n  " + _fmt(bad)
 
 
 def test_admission_lint_catches_bypass():
-    """The admission lint must fire on a handler that plans directly
-    and on an _exec with no admission call."""
     bypass = (
         "class FiloHttpServer:\n"
         "    def _exec(self, b, plan):\n"
@@ -450,16 +191,16 @@ def test_admission_lint_catches_bypass():
         "        ep = b.planner.materialize(p, q)\n"
         "        return 200, {}\n"
     )
-    bad = _admission_violations(bypass)
-    assert len(bad) == 1 and "_sneaky" in bad[0]
+    got = _fake(bypass, ["admission-routing"])
+    assert len(got) == 1 and "_sneaky" in got[0].message
     no_admit = (
         "class FiloHttpServer:\n"
         "    def _exec(self, b, plan):\n"
         "        ep = b.planner.materialize(plan, q)\n"
         "        return ep.execute(ctx)\n"
     )
-    bad = _admission_violations(no_admit)
-    assert len(bad) == 1 and "_admit" in bad[0]
+    got = _fake(no_admit, ["admission-routing"])
+    assert len(got) == 1 and "_admit" in got[0].message
     ok = (
         "class FiloHttpServer:\n"
         "    def _exec(self, b, plan):\n"
@@ -467,99 +208,35 @@ def test_admission_lint_catches_bypass():
         "        with self._admit(b, ep, q):\n"
         "            return ep.execute(ctx)\n"
     )
-    assert _admission_violations(ok) == []
+    assert _fake(ok, ["admission-routing"]) == []
 
 
 # ---------------------------------------------------------------------------
-# Deadline-threading lint (ISSUE 5): every remote dispatch call site
-# must thread the query's deadline.  Two tiers:
-# - EVERY ``urlopen`` under filodb_tpu/ must pass an explicit
-#   ``timeout=`` (an unbounded socket can pin a worker forever);
-# - inside dispatcher/exec classes (class name ending in Dispatcher or
-#   Exec — the remote QUERY call sites), the timeout expression must
-#   reference the remaining deadline budget (a name mentioning
-#   deadline/remaining/budget), not a fixed constant.
+# deadline-threading (ISSUE 5)
 # ---------------------------------------------------------------------------
-
-_DEADLINE_NAMES = ("deadline", "remaining", "budget")
-
-
-def _deadline_violations(src: str, relpath: str) -> list:
-    tree = ast.parse(src)
-    out = []
-
-    def names_in(expr) -> set:
-        got = set()
-        for n in ast.walk(expr):
-            if isinstance(n, ast.Name):
-                got.add(n.id)
-            elif isinstance(n, ast.Attribute):
-                got.add(n.attr)
-        return got
-
-    def check_call(node, in_dispatch_class):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, (ast.Attribute, ast.Name))):
-            return
-        fname = node.func.attr if isinstance(node.func, ast.Attribute) \
-            else node.func.id
-        if fname != "urlopen":
-            return
-        timeout_kw = next((k for k in node.keywords
-                           if k.arg == "timeout"), None)
-        if timeout_kw is None:
-            out.append(f"{relpath}:{node.lineno}: urlopen without "
-                       f"timeout= — an unbounded socket can pin a "
-                       f"worker forever")
-            return
-        if in_dispatch_class:
-            refs = {n.lower() for n in names_in(timeout_kw.value)}
-            if not any(dn in r for dn in _DEADLINE_NAMES for r in refs):
-                out.append(
-                    f"{relpath}:{node.lineno}: remote dispatch urlopen "
-                    f"whose timeout does not thread the deadline — "
-                    f"derive it from the remaining budget "
-                    f"(workload/deadline.py budget_timeout_s)")
-
-    dispatch_nodes = set()
-    for cls in ast.walk(tree):
-        if isinstance(cls, ast.ClassDef) and (
-                cls.name.endswith("Dispatcher")
-                or cls.name.endswith("Exec")):
-            for n in ast.walk(cls):
-                dispatch_nodes.add(id(n))
-    for node in ast.walk(tree):
-        check_call(node, id(node) in dispatch_nodes)
-    return out
 
 
 def test_remote_dispatch_threads_deadline():
-    violations = []
-    for path in sorted(ROOT.rglob("*.py")):
-        rel = str(path.relative_to(ROOT))
-        violations.extend(_deadline_violations(path.read_text(), rel))
-    assert not violations, \
-        "unthreaded deadlines:\n  " + "\n  ".join(violations)
+    bad = _tree(["deadline-threading"])
+    assert not bad, "unthreaded deadlines:\n  " + _fmt(bad)
 
 
 def test_deadline_lint_catches_fixed_timeout():
-    """The deadline lint must fire on a fixed dispatch timeout and on
-    a missing timeout, and accept a budget-derived one."""
     fixed = (
         "import urllib.request\n"
         "class MyPlanDispatcher:\n"
         "    def dispatch(self):\n"
         "        urllib.request.urlopen(req, timeout=60.0)\n"
     )
-    bad = _deadline_violations(fixed, "fake.py")
-    assert len(bad) == 1 and "thread the deadline" in bad[0]
+    got = _fake(fixed, ["deadline-threading"])
+    assert len(got) == 1 and "thread the deadline" in got[0].message
     missing = (
         "import urllib.request\n"
         "def poll():\n"
         "    urllib.request.urlopen(url)\n"
     )
-    bad = _deadline_violations(missing, "fake.py")
-    assert len(bad) == 1 and "without" in bad[0]
+    got = _fake(missing, ["deadline-threading"])
+    assert len(got) == 1 and "without" in got[0].message
     ok = (
         "import urllib.request\n"
         "class MyPlanDispatcher:\n"
@@ -567,185 +244,76 @@ def test_deadline_lint_catches_fixed_timeout():
         "        deadline_timeout_s = dl.budget_timeout_s(q, 60.0)\n"
         "        urllib.request.urlopen(req, timeout=deadline_timeout_s)\n"
     )
-    assert _deadline_violations(ok, "fake.py") == []
+    assert _fake(ok, ["deadline-threading"]) == []
     plain_ok = (
         "import urllib.request\n"
         "def poll():\n"
         "    urllib.request.urlopen(url, timeout=5)\n"
     )
-    assert _deadline_violations(plain_ok, "fake.py") == []
+    assert _fake(plain_ok, ["deadline-threading"]) == []
 
 
 # ---------------------------------------------------------------------------
-# Metric/doc drift lint (ISSUE 6): every `filodb_*` metric family
-# registered anywhere under filodb_tpu/ must appear in
-# doc/observability.md's metric table.  A name is documented when it
-# appears verbatim, OR when a family row (`filodb_<fam>_*`) covers its
-# prefix AND the remaining suffix appears in the doc (the table's
-# shorthand: family column + per-metric suffixes).  Metrics that creep
-# in undocumented — the drift PRs 6-10 accumulated — fail the build.
+# metric-doc (ISSUE 6)
 # ---------------------------------------------------------------------------
-
-_METRIC_CTORS = {"counter", "gauge", "histogram"}
-DOC_OBS = ROOT.parent / "doc" / "observability.md"
-
-
-def _registered_metric_names(root=None) -> set:
-    """Every string-literal filodb_* name passed to a registry
-    counter()/gauge()/histogram() call under filodb_tpu/."""
-    root = root or ROOT
-    names = set()
-    for path in sorted(root.rglob("*.py")):
-        tree = ast.parse(path.read_text())
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in _METRIC_CTORS
-                    and node.args
-                    and isinstance(node.args[0], ast.Constant)
-                    and isinstance(node.args[0].value, str)):
-                continue
-            name = node.args[0].value
-            if name.startswith("filodb_"):
-                names.add(name)
-    return names
-
-
-def _undocumented_metrics(names, doc_text: str) -> list:
-    doc_lines = doc_text.splitlines()
-    missing = []
-    for name in sorted(names):
-        if name in doc_text:
-            continue
-        parts = name.split("_")
-        covered = False
-        # try every family split: filodb_query_* + "request_seconds",
-        # filodb_query_request_* + "seconds", ... — the suffix must sit
-        # on the SAME line (table row) as the family pattern, or a
-        # suffix shared with another family would mask the drift
-        for i in range(2, len(parts)):
-            fam = "_".join(parts[:i]) + "_*"
-            suffix = "_".join(parts[i:])
-            if any(fam in line and suffix in line for line in doc_lines):
-                covered = True
-                break
-        if not covered:
-            missing.append(
-                f"{name}: not in doc/observability.md's metric table — "
-                f"add the full name, or list its suffix on a "
-                f"`filodb_<family>_*` row")
-    return missing
 
 
 def test_metric_families_are_documented():
-    names = _registered_metric_names()
-    assert names, "no registered filodb_* metrics found — lint broken?"
-    missing = _undocumented_metrics(names, DOC_OBS.read_text())
-    assert not missing, \
-        "undocumented metrics:\n  " + "\n  ".join(missing)
+    modules, root = A.load_modules([ROOT])
+    project = A.Project(modules, root)
+    from filodb_tpu.analysis.sentinels import registered_metric_names
+    assert registered_metric_names(project), \
+        "no registered filodb_* metrics found — lint broken?"
+    bad = _tree(["metric-doc"])
+    assert not bad, "undocumented metrics:\n  " + _fmt(bad)
 
 
 def test_metric_doc_lint_catches_drift():
-    """The doc lint must fire on an undocumented name and accept both
-    documented spellings."""
     doc = ("| `filodb_query_*` | `request_seconds`, `requests_total` |\n"
            "`filodb_node_up` is set at startup.\n")
-    assert _undocumented_metrics({"filodb_query_request_seconds"}, doc) == []
-    assert _undocumented_metrics({"filodb_node_up"}, doc) == []
-    bad = _undocumented_metrics({"filodb_query_brand_new_total"}, doc)
-    assert len(bad) == 1 and "filodb_query_brand_new_total" in bad[0]
-    bad = _undocumented_metrics({"filodb_sneaky_family_total"}, doc)
-    assert len(bad) == 1
+
+    def check(name, doc_text):
+        src = f"m = REG.counter({name!r}, 'h')\n"
+        return _fake(src, ["metric-doc"], doc_text=doc_text)
+
+    assert check("filodb_query_request_seconds", doc) == []
+    assert check("filodb_node_up", doc) == []
+    bad = check("filodb_query_brand_new_total", doc)
+    assert len(bad) == 1 and "filodb_query_brand_new_total" in bad[0].message
+    assert len(check("filodb_sneaky_family_total", doc)) == 1
     # a suffix documented under a DIFFERENT family's row must not cover
     # this family (same-line rule)
     doc2 = ("| `filodb_flush_*` | `failures_total` |\n"
             "| `filodb_odp_*` | `pagein_seconds` |\n")
-    bad = _undocumented_metrics({"filodb_odp_failures_total"}, doc2)
-    assert len(bad) == 1 and "filodb_odp_failures_total" in bad[0]
+    bad = check("filodb_odp_failures_total", doc2)
+    assert len(bad) == 1 and "filodb_odp_failures_total" in bad[0].message
 
 
 # ---------------------------------------------------------------------------
-# Replica-routing lint (ISSUE 7): every dispatcher site that targets,
-# retargets, hedges, or fails over a leaf selects its replica through
-# the SINGLE ReplicaSet.pick()/alternate() routing helper
-# (coordinator/replicas.py).  Ad-hoc node lists inside dispatcher
-# classes — enumerating mapper replicas and ordering them locally —
-# fork the routing policy and rot independently.
+# replica-routing (ISSUE 7)
 # ---------------------------------------------------------------------------
-
-_REPLICA_ENUMERATORS = {"replicas", "replica_nodes", "live_replicas"}
-_ROUTING_FN_HINTS = ("failover", "retarget", "hedge_alternate")
-_ROUTING_HELPERS = {"pick", "alternate"}
-
-
-def _replica_routing_violations(src: str, relpath: str) -> list:
-    if relpath.endswith("coordinator/replicas.py"):
-        return []            # the policy's one home
-    tree = ast.parse(src)
-    out = []
-
-    def called_attrs(node) -> set:
-        got = set()
-        for n in ast.walk(node):
-            if isinstance(n, ast.Call) \
-                    and isinstance(n.func, ast.Attribute):
-                got.add(n.func.attr)
-        return got
-
-    for cls in ast.walk(tree):
-        if not (isinstance(cls, ast.ClassDef)
-                and cls.name.endswith("Dispatcher")):
-            continue
-        for fn in cls.body:
-            if not isinstance(fn, (ast.FunctionDef,
-                                   ast.AsyncFunctionDef)):
-                continue
-            bad = called_attrs(fn) & _REPLICA_ENUMERATORS
-            if bad:
-                out.append(
-                    f"{relpath}:{fn.lineno}: {cls.name}.{fn.name} "
-                    f"enumerates replicas ad hoc ({sorted(bad)}) — "
-                    f"dispatchers must select through ReplicaSet.pick()")
-    for fn in ast.walk(tree):
-        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if not any(h in fn.name for h in _ROUTING_FN_HINTS):
-            continue
-        if not (called_attrs(fn) & _ROUTING_HELPERS):
-            out.append(
-                f"{relpath}:{fn.lineno}: routing site {fn.name}() does "
-                f"not go through ReplicaSet.pick()/alternate()")
-    return out
 
 
 def test_replica_routing_goes_through_pick():
-    violations = []
-    for path in sorted(ROOT.rglob("*.py")):
-        rel = str(path.relative_to(ROOT))
-        violations.extend(
-            _replica_routing_violations(path.read_text(), rel))
-    assert not violations, \
-        "ad-hoc replica routing:\n  " + "\n  ".join(violations)
+    bad = _tree(["replica-routing"])
+    assert not bad, "ad-hoc replica routing:\n  " + _fmt(bad)
 
 
 def test_replica_routing_lint_catches_ad_hoc_lists():
-    """The routing lint must fire on a dispatcher enumerating replicas
-    itself and on a pick-less failover helper, and accept the
-    pick-routed shapes."""
     bad_enum = (
         "class MyPlanDispatcher:\n"
         "    def dispatch(self, plan, ctx):\n"
         "        node = self.mapper.replica_nodes(plan.shard)[0]\n"
         "        return node\n"
     )
-    got = _replica_routing_violations(bad_enum, "fake.py")
-    assert len(got) == 1 and "ReplicaSet.pick" in got[0]
+    got = _fake(bad_enum, ["replica-routing"])
+    assert len(got) == 1 and "ReplicaSet.pick" in got[0].message
     bad_failover = (
         "def failover_target(shard, nodes):\n"
         "    return sorted(nodes)[0]\n"
     )
-    got = _replica_routing_violations(bad_failover, "fake.py")
-    assert len(got) == 1 and "failover_target" in got[0]
+    got = _fake(bad_failover, ["replica-routing"])
+    assert len(got) == 1 and "failover_target" in got[0].message
     ok = (
         "class MyPlanDispatcher:\n"
         "    def dispatch(self, plan, ctx):\n"
@@ -754,7 +322,7 @@ def test_replica_routing_lint_catches_ad_hoc_lists():
         "def hedge_alternate_for(plan, this_node):\n"
         "    return rs.alternate(plan.shard, exclude=[this_node])\n"
     )
-    assert _replica_routing_violations(ok, "fake.py") == []
+    assert _fake(ok, ["replica-routing"]) == []
     # and the policy home itself is exempt
-    assert _replica_routing_violations(
-        bad_enum, "coordinator/replicas.py") == []
+    assert _fake(bad_enum, ["replica-routing"],
+                 rel="filodb_tpu/coordinator/replicas.py") == []
