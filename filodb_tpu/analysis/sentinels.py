@@ -9,7 +9,7 @@ catch-tests, run through these rules.
 
 Rules: decode-sentinel, timed-handler, interpret-coverage,
 device-put-ledger, admission-routing, deadline-threading, metric-doc,
-replica-routing.
+replica-routing, evaluator-workload.
 """
 
 from __future__ import annotations
@@ -306,6 +306,66 @@ def deadline_threading(module):
                     "remote dispatch urlopen whose timeout does not "
                     "thread the deadline — derive it from the remaining "
                     "budget (workload/deadline.py budget_timeout_s)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# evaluator-workload (PR 14): every internal evaluator that issues
+# queries — a class that both mints a QueryContext and materializes a
+# plan — must declare an explicit workload priority class and thread a
+# deadline (the PR 10 deadline-threading discipline generalized beyond
+# dispatchers: background evaluators share the serving fabric with user
+# traffic and must be admission-schedulable, never ambient-priority)
+# ---------------------------------------------------------------------------
+
+
+@rule("evaluator-workload",
+      doc="query-issuing evaluators without an explicit priority class "
+          "or deadline")
+def evaluator_workload(module):
+    findings = []
+    for cls in module.nodes:
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        materialize_line = None
+        minted_line = None
+        has_priority = False
+        has_deadline = False
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            attr = f.attr if isinstance(f, ast.Attribute) else \
+                f.id if isinstance(f, ast.Name) else None
+            if attr == "materialize" and materialize_line is None:
+                materialize_line = node.lineno
+            if attr == "mint":
+                has_deadline = True
+            if attr == "QueryContext":
+                kws = {k.arg for k in node.keywords if k.arg is not None}
+                if kws and minted_line is None:
+                    # a keyword-built context MINTS query identity; the
+                    # bare QueryContext() library fallbacks do not
+                    minted_line = node.lineno
+                if "priority" in kws:
+                    has_priority = True
+                if "deadline_ms" in kws:
+                    has_deadline = True
+        if materialize_line is None or minted_line is None:
+            continue
+        if not has_priority:
+            findings.append(Finding(
+                "evaluator-workload", module.rel, minted_line,
+                f"{cls.name} mints a QueryContext and materializes "
+                f"plans but never sets an explicit priority= class — "
+                f"background evaluators must declare their workload "
+                f"class (workload/admission.py priority shares)"))
+        if not has_deadline:
+            findings.append(Finding(
+                "evaluator-workload", module.rel, minted_line,
+                f"{cls.name} issues queries without a deadline — mint "
+                f"one (workload.deadline.mint) or set deadline_ms so "
+                f"admission and the scheduler can bound its work"))
     return findings
 
 

@@ -196,6 +196,49 @@ def cmd_verify_chunks(args) -> int:
     return 1 if report["total_failed"] else 0
 
 
+def cmd_rules_check(args) -> int:
+    """promtool-style offline rule validation (doc/rules.md): every
+    expr through the real PromQL parser, duplicate rule/group names,
+    bad ``for:``/interval durations, unknown fields.  ``--builtin``
+    additionally checks the shipped self-monitoring pack.  Exit 0 =
+    every file valid; 1 = findings (all printed, not just the first);
+    2 = nothing to check."""
+    import json as _json
+
+    from filodb_tpu.rules.config import validate_rule_config
+
+    targets: list[tuple[str, dict]] = []
+    failed = False
+    for path in args.files:
+        try:
+            with open(path) as f:
+                targets.append((path, _json.load(f)))
+        except (OSError, _json.JSONDecodeError) as e:
+            print(f"{path}: FAILED: {e}")
+            failed = True
+    if args.builtin:
+        from filodb_tpu.rules.selfmon import selfmon_pack
+        targets.append(("builtin:self-monitoring", selfmon_pack()))
+    if not targets and not failed:
+        print("rules-check: no rule files given (pass paths and/or "
+              "--builtin)", file=sys.stderr)
+        return 2
+    for source, config in targets:
+        errors = validate_rule_config(config, source=source)
+        if errors:
+            failed = True
+            print(f"{source}: FAILED ({len(errors)} problem(s))")
+            for e in errors:
+                print(f"  {e}")
+        else:
+            groups = config.get("groups") or []
+            nrules = sum(len(g.get("rules") or []) for g in groups
+                         if isinstance(g, dict))
+            print(f"{source}: OK ({len(groups)} group(s), "
+                  f"{nrules} rule(s))")
+    return 1 if failed else 0
+
+
 def cmd_lint(args) -> int:
     """filolint static analysis (doc/analysis.md): lock-discipline race
     detection, blocking-under-lock, resource lifecycle, and the eight
@@ -317,6 +360,16 @@ def build_parser() -> argparse.ArgumentParser:
     ic.add_argument("--timestamp-column", default="timestamp")
     ic.add_argument("--shard", type=int, default=0)
     ic.set_defaults(fn=cmd_importcsv)
+
+    rc = sub.add_parser("rules-check",
+                        help="validate rule files offline (promtool "
+                             "check rules analog)")
+    rc.add_argument("files", nargs="*",
+                    help="JSON rule files ({\"groups\": [...]})")
+    rc.add_argument("--builtin", action="store_true",
+                    help="also validate the shipped self-monitoring "
+                         "pack")
+    rc.set_defaults(fn=cmd_rules_check)
 
     vc = sub.add_parser("verify-chunks",
                         help="offline checksum/decode scan of a "

@@ -123,6 +123,10 @@ class FiloHttpServer:
     # replication; None = the route 404s (broker transports do not
     # need it — the shared partition log is the replicated stream)
     ingest_sink: Optional[object] = None
+    # the rule engine (ISSUE 9, filodb_tpu/rules): backs /api/v1/rules,
+    # /api/v1/alerts, and /admin/rules; None = empty payloads (a node
+    # with no rules configured still answers the Prometheus API shape)
+    rules: Optional[object] = None
     datasets: dict = field(default_factory=dict)
     _httpd: Optional[ThreadingHTTPServer] = None
     _thread: Optional[threading.Thread] = None
@@ -509,8 +513,17 @@ class FiloHttpServer:
                 return self._label_values(binding, parts[5], params, multi)
             if endpoint == "series":
                 return self._series(binding, params, multi)
+        if len(parts) == 3 and parts[0] == "api" and parts[1] == "v1" \
+                and parts[2] == "rules":
+            return self._rules_api()
+        if len(parts) == 3 and parts[0] == "api" and parts[1] == "v1" \
+                and parts[2] == "alerts":
+            return self._alerts_api()
         if len(parts) >= 3 and parts[0] == "api" and parts[2] == "cluster":
             return self._cluster(parts[3:], params)
+        if len(parts) == 2 and parts[0] == "admin" \
+                and parts[1] == "rules":
+            return self._admin_rules()
         if len(parts) == 3 and parts[0] == "admin" \
                 and parts[1] == "chunkmeta":
             return self._chunkmeta(parts[2], params)
@@ -544,6 +557,33 @@ class FiloHttpServer:
                 and parts[1] == "profilez":
             return self._profilez(params)
         return 404, error_response("bad_data", f"unknown route {path}")
+
+    # ------------------------------------------------------- rule engine
+
+    @_timed("rules_api")
+    def _rules_api(self) -> tuple[int, dict]:
+        """Prometheus ``/api/v1/rules``: every group's rules with their
+        rendered exprs, health, and live alert instances (doc/rules.md)."""
+        data = self.rules.rules_payload() if self.rules is not None \
+            else {"groups": []}
+        return 200, {"status": "success", "data": data}
+
+    @_timed("alerts_api")
+    def _alerts_api(self) -> tuple[int, dict]:
+        """Prometheus ``/api/v1/alerts``: live pending/firing alerts."""
+        data = self.rules.alerts_payload() if self.rules is not None \
+            else {"alerts": []}
+        return 200, {"status": "success", "data": data}
+
+    @_timed("admin_rules")
+    def _admin_rules(self) -> tuple[int, dict]:
+        """The rule engine's live operational state: per-group eval
+        timing/miss counts, per-rule health, incremental-window
+        residency, and the notifier queue (doc/rules.md)."""
+        if self.rules is None:
+            return 404, error_response("bad_data",
+                                       "no rule engine on this node")
+        return 200, {"status": "success", "data": self.rules.admin_state()}
 
     # ------------------------------------------------------ query forensics
 

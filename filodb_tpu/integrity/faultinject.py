@@ -302,9 +302,17 @@ class NodeChaosController:
         self.events: list[tuple[str, str]] = []  # (action, node), ordered
 
     def register(self, name: str, kill_fn=None,
-                 proxy: Optional[FlakyTcpProxy] = None) -> None:
+                 proxy: Optional[FlakyTcpProxy] = None,
+                 stall_ingest_fn=None, resume_ingest_fn=None) -> None:
+        """``stall_ingest_fn``/``resume_ingest_fn`` (ISSUE 9) wedge and
+        un-wedge the node's ingest consumers while the node itself
+        keeps serving — the fault class the self-monitoring rule pack
+        must detect end to end (ingest stall -> watermark ledger ->
+        self-scrape -> alert)."""
         self._nodes[name] = {"kill": kill_fn, "proxy": proxy,
-                             "killed": False}
+                             "killed": False,
+                             "stall_ingest": stall_ingest_fn,
+                             "resume_ingest": resume_ingest_fn}
 
     def _note(self, action: str, node: str) -> None:
         self.events.append((action, node))
@@ -345,6 +353,24 @@ class NodeChaosController:
             proxy.stall_s = float(stall_s)
         proxy.stall_next(n)
         self._note("stall", name)
+
+    def stall_ingest(self, name: str) -> None:
+        """Wedge the node's ingest consumers (producers keep queueing,
+        so lag grows and the watermark stall machine eventually fires)."""
+        fn = self._nodes[name]["stall_ingest"]
+        if fn is None:
+            raise ValueError(f"node {name} has no ingest-stall hook")
+        fn()
+        self._note("stall_ingest", name)
+
+    def resume_ingest(self, name: str) -> None:
+        """Un-wedge a stalled node's ingest consumers; the backlog
+        drains and lag returns to zero."""
+        fn = self._nodes[name]["resume_ingest"]
+        if fn is None:
+            raise ValueError(f"node {name} has no ingest-resume hook")
+        fn()
+        self._note("resume_ingest", name)
 
     def heal(self, name: str) -> None:
         """Lift a partition (kills need :meth:`restart`)."""

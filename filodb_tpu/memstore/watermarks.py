@@ -71,6 +71,12 @@ class WatermarkLedger:
         # passes double-count an episode boundary (PR 11 review fix,
         # now lint-enforced)
         self._stall: dict[tuple, dict] = {}  # guarded-by: _lock
+        # (dataset, shard) label sets this ledger has exported gauge
+        # rows for — close() removes them (the PR 11 stale-row lesson:
+        # a dead server's `stalled=1` row would otherwise sit in the
+        # process registry forever, and the self-monitoring rule pack
+        # ALERTS on that gauge)
+        self._emitted: set = set()  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def watch(self, dataset: str, memstore, mapper=None,
@@ -85,6 +91,31 @@ class WatermarkLedger:
     def unwatch(self, dataset: str) -> None:
         with self._lock:
             self._watches.pop(dataset, None)
+            gone = [k for k in self._emitted if k[0] == dataset]
+            for k in gone:
+                self._emitted.discard(k)
+        for _ds, shard in gone:
+            self._remove_rows(dataset, shard)
+
+    def _remove_rows(self, dataset: str, shard: int) -> None:
+        m = _m()
+        labels = {"dataset": dataset, "shard": shard, "node": self.node}
+        for stage in _STAGES:
+            m["offset"].remove(stage=stage, **labels)
+        m["lag_rows"].remove(**labels)
+        m["lag_seconds"].remove(**labels)
+        m["stalled"].remove(**labels)
+
+    def close(self) -> None:
+        """Drop every gauge row this ledger exported.  A shut-down
+        node's per-shard rows — especially a lingering ``stalled=1`` —
+        must not keep feeding scrapes (and the alerting rules watching
+        them) forever."""
+        with self._lock:
+            emitted, self._emitted = self._emitted, set()
+            self._watches.clear()
+        for dataset, shard in emitted:
+            self._remove_rows(dataset, shard)
 
     def watching(self) -> list[str]:
         """Datasets currently tracked (the HTTP layer syncs late-bound
@@ -174,8 +205,16 @@ class WatermarkLedger:
         for stage in _STAGES:
             if stage in watermarks:
                 m["offset"].set(watermarks[stage], stage=stage, **labels)
+        with self._lock:
+            self._emitted.add((dataset, sh.shard_num))
         m["lag_rows"].set(lag_rows, **labels)
         m["lag_seconds"].set(lag_seconds, **labels)
+        # level-based stall flag (ISSUE 9): the stalls_total counter's
+        # label set is BORN at 1 (created by the first episode), so
+        # increase() over a scrape of it can never see the 0->1 edge —
+        # alerting rules need this 0/1 gauge, which exists from the
+        # first sample and clears when ingest resumes
+        m["stalled"].set(1.0 if stalled else 0.0, **labels)
         row = {"shard": sh.shard_num,
                "watermarks": watermarks,
                "lag": {"rows": lag_rows, "seconds": round(lag_seconds, 3)},

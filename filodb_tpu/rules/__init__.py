@@ -1,0 +1,23 @@
+"""Continuous rule evaluation: recording rules, alerting rules, and the
+self-monitoring pack (ROADMAP item 3a, doc/rules.md).
+
+- :mod:`filodb_tpu.rules.config` — rule-file model + promtool-style
+  offline validation (the ``rules-check`` CLI verb);
+- :mod:`filodb_tpu.rules.incremental` — per-rule window state that
+  consumes only newly-arrived samples yet stays bit-equal to a cold
+  full-range evaluation;
+- :mod:`filodb_tpu.rules.engine` — group scheduling, the alert state
+  machine, write-back through the gateway publisher, and the
+  ``/api/v1/rules`` / ``/api/v1/alerts`` / ``/admin/rules`` payloads;
+- :mod:`filodb_tpu.rules.notifier` — webhook delivery with bounded
+  retry/backoff;
+- :mod:`filodb_tpu.rules.selfmon` — the shipped self-monitoring rule
+  pack over the ``_system`` dataset.
+"""
+
+from filodb_tpu.rules.config import (RuleConfigError, RuleDef, RuleGroup,
+                                     parse_rule_config, validate_rule_config)
+from filodb_tpu.rules.engine import RuleEngine
+
+__all__ = ["RuleConfigError", "RuleDef", "RuleGroup", "RuleEngine",
+           "parse_rule_config", "validate_rule_config"]

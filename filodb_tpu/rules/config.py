@@ -1,0 +1,266 @@
+"""Rule-file model + promtool-style offline validation.
+
+Capability match for Prometheus rule files (prometheus/docs/
+configuration/recording_rules.md) in the repo's JSON config dialect::
+
+    {
+      "groups": [{
+        "name": "node-health",
+        "interval": "15s",              # evaluation cadence
+        "dataset": "_system",           # dataset the exprs query (and
+                                        # recorded series write back to)
+        "rules": [
+          {"record": "node:ingest_lag:max",
+           "expr": "max(filodb_ingest_lag_rows)",
+           "labels": {"source": "rules"}},
+          {"alert": "FiloIngestStalled",
+           "expr": "increase(filodb_ingest_stalls_total[2m]) > 0",
+           "for": "30s",
+           "labels": {"severity": "page"},
+           "annotations": {"summary": "shard stalled ({{ $value }})"}}
+        ]
+      }]
+    }
+
+``validate_rule_config`` is the promtool ``check rules`` analog the
+``rules-check`` CLI verb runs: every expr goes through the real PromQL
+parser, group/rule names must be unique, ``for:``/``interval`` must be
+valid durations, and unknown fields are errors (a typo'd ``fro:`` must
+not silently disable an alert hold).  Exprs are additionally rendered
+through :func:`logical_plan_to_promql` when possible — the canonical
+form ``/api/v1/rules`` exposes, protected by the generative round-trip
+sweep (tests/test_promql_roundtrip_gen.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from typing import Optional
+
+from filodb_tpu.promql.parser import ParseError, duration_ms, parse_query
+
+# any fixed range works for validation parses: exprs are re-anchored at
+# every evaluation timestamp
+_VALIDATE_BASE_MS = 1_700_000_000_000
+_VALIDATE_STEP_MS = 15_000
+
+_METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+_GROUP_FIELDS = {"name", "interval", "dataset", "timeout", "rules"}
+_RULE_FIELDS = {"record", "alert", "expr", "for", "labels", "annotations"}
+
+
+class RuleConfigError(ValueError):
+    """The rule config failed validation; ``errors`` lists every
+    problem (promtool reports all findings, not just the first)."""
+
+    def __init__(self, errors: list):
+        super().__init__("; ".join(errors))
+        self.errors = list(errors)
+
+
+@dataclasses.dataclass
+class RuleDef:
+    """One recording or alerting rule."""
+
+    name: str
+    expr: str
+    kind: str                       # "recording" | "alerting"
+    labels: dict = dataclasses.field(default_factory=dict)
+    annotations: dict = dataclasses.field(default_factory=dict)
+    for_ms: int = 0                 # alerting only: pending hold
+    rendered: str = ""              # canonical renderer form (API view)
+
+
+@dataclasses.dataclass
+class RuleGroup:
+    """A named group: one evaluation cadence, rules run in order."""
+
+    name: str
+    interval_ms: int
+    rules: list
+    dataset: str = ""               # "" = the engine's default dataset
+    timeout_ms: int = 0             # 0 = min(interval, 30s)
+    source: str = ""                # file/origin, for the API view
+
+
+def _duration(value, field: str, errors: list, where: str) -> int:
+    """Accept PromQL duration strings ("30s", "1h30m") or bare numbers
+    (seconds); collect an error and return 0 on anything else."""
+    try:
+        if isinstance(value, bool):
+            raise ValueError(value)
+        if isinstance(value, (int, float)):
+            if value < 0:
+                raise ValueError(value)
+            return int(value * 1000)
+        return duration_ms(str(value))
+    except (ParseError, ValueError, TypeError):
+        errors.append(f"{where}: bad {field} duration {value!r}")
+        return 0
+
+
+def _render(expr: str) -> str:
+    """Canonical renderer form, falling back to the source text for
+    parseable-but-unrenderable constructs (the API must still show
+    SOMETHING; the round-trip sweep keeps the renderable set honest)."""
+    from filodb_tpu.coordinator.planners import logical_plan_to_promql
+    try:
+        plan = parse_query(expr, _VALIDATE_BASE_MS, _VALIDATE_STEP_MS,
+                           _VALIDATE_BASE_MS)
+        return logical_plan_to_promql(plan)
+    except (ParseError, ValueError):
+        return expr
+
+
+def _parse_rule(raw: dict, where: str, errors: list,
+                seen_names: set) -> Optional[RuleDef]:
+    if not isinstance(raw, dict):
+        errors.append(f"{where}: rule must be an object, got "
+                      f"{type(raw).__name__}")
+        return None
+    unknown = set(raw) - _RULE_FIELDS
+    if unknown:
+        errors.append(f"{where}: unknown field(s) {sorted(unknown)}")
+    has_record = "record" in raw
+    has_alert = "alert" in raw
+    if has_record == has_alert:
+        errors.append(f"{where}: exactly one of 'record'/'alert' required")
+        return None
+    raw_name = raw.get("record") if has_record else raw.get("alert")
+    kind = "recording" if has_record else "alerting"
+    if not isinstance(raw_name, str):
+        # str(None) would mint a rule literally named "None" that
+        # passes the metric-name regex — a typo'd `"record": null`
+        # must fail, not record a series called None
+        errors.append(f"{where}: '{'record' if has_record else 'alert'}'"
+                      f" must be a string, got {type(raw_name).__name__}")
+        return None
+    name = raw_name
+    if has_record and not _METRIC_NAME_RE.match(name):
+        errors.append(f"{where}: invalid recorded metric name {name!r}")
+    if has_alert and not name:
+        errors.append(f"{where}: empty alert name")
+    if (kind, name) in seen_names:
+        errors.append(f"{where}: duplicate {kind} rule name {name!r} "
+                      f"in this group")
+    seen_names.add((kind, name))
+    expr = raw.get("expr")
+    if not isinstance(expr, str) or not expr.strip():
+        errors.append(f"{where}: missing expr")
+        expr = ""
+    else:
+        try:
+            parse_query(expr, _VALIDATE_BASE_MS, _VALIDATE_STEP_MS,
+                        _VALIDATE_BASE_MS)
+        except ParseError as e:
+            errors.append(f"{where}: expr does not parse: {e}")
+    for_ms = 0
+    if "for" in raw:
+        if has_record:
+            errors.append(f"{where}: 'for' is only valid on alerting rules")
+        else:
+            for_ms = _duration(raw["for"], "for", errors, where)
+    if has_record and raw.get("annotations"):
+        errors.append(f"{where}: 'annotations' is only valid on alerting "
+                      f"rules")
+    labels = raw.get("labels") or {}
+    annotations = raw.get("annotations") or {}
+    for field, mapping in (("labels", labels), ("annotations", annotations)):
+        if not isinstance(mapping, dict):
+            errors.append(f"{where}: {field} must be an object")
+            mapping = {}
+        for k in mapping:
+            if not _LABEL_NAME_RE.match(str(k)):
+                errors.append(f"{where}: invalid {field} name {k!r}")
+    return RuleDef(name=name, expr=expr, kind=kind,
+                   labels={str(k): str(v) for k, v in dict(labels).items()},
+                   annotations={str(k): str(v)
+                                for k, v in dict(annotations).items()},
+                   for_ms=for_ms, rendered=_render(expr) if expr else "")
+
+
+def parse_rule_config(config: dict,
+                      source: str = "") -> tuple[list, list]:
+    """Parse a rule config dict -> ``(groups, errors)``.  Every problem
+    is collected (not fail-fast); callers that need hard failure use
+    :func:`load_rule_config`."""
+    errors: list[str] = []
+    groups: list[RuleGroup] = []
+    if not isinstance(config, dict):
+        return [], [f"{source or 'config'}: rule config must be an object"]
+    unknown = set(config) - {"groups"}
+    if unknown:
+        errors.append(f"{source or 'config'}: unknown top-level field(s) "
+                      f"{sorted(unknown)}")
+    raw_groups = config.get("groups")
+    if not isinstance(raw_groups, list):
+        errors.append(f"{source or 'config'}: 'groups' must be a list")
+        raw_groups = []
+    seen_groups: set[str] = set()
+    for gi, raw in enumerate(raw_groups):
+        gwhere = f"{source + ': ' if source else ''}groups[{gi}]"
+        if not isinstance(raw, dict):
+            errors.append(f"{gwhere}: group must be an object")
+            continue
+        unknown = set(raw) - _GROUP_FIELDS
+        if unknown:
+            errors.append(f"{gwhere}: unknown field(s) {sorted(unknown)}")
+        name = str(raw.get("name") or "")
+        if not name:
+            errors.append(f"{gwhere}: missing group name")
+        if name in seen_groups:
+            errors.append(f"{gwhere}: duplicate group name {name!r}")
+        seen_groups.add(name)
+        interval_ms = _duration(raw.get("interval", "1m"), "interval",
+                                errors, gwhere)
+        if interval_ms <= 0:
+            errors.append(f"{gwhere}: interval must be > 0")
+        timeout_ms = 0
+        if "timeout" in raw:
+            timeout_ms = _duration(raw["timeout"], "timeout", errors,
+                                   gwhere)
+        raw_rules = raw.get("rules")
+        if not isinstance(raw_rules, list) or not raw_rules:
+            errors.append(f"{gwhere}: 'rules' must be a non-empty list")
+            raw_rules = []
+        rules: list[RuleDef] = []
+        seen_names: set = set()
+        for ri, rr in enumerate(raw_rules):
+            r = _parse_rule(rr, f"{gwhere}.rules[{ri}]", errors, seen_names)
+            if r is not None:
+                rules.append(r)
+        groups.append(RuleGroup(name=name, interval_ms=max(interval_ms, 1),
+                                rules=rules,
+                                dataset=str(raw.get("dataset") or ""),
+                                timeout_ms=timeout_ms, source=source))
+    return groups, errors
+
+
+def validate_rule_config(config: dict, source: str = "") -> list:
+    """Errors only (the ``rules-check`` CLI verb)."""
+    _groups, errors = parse_rule_config(config, source)
+    return errors
+
+
+def load_rule_config(config: dict, source: str = "") -> list:
+    """Parse or raise :class:`RuleConfigError` — the standalone server's
+    loading path: a node must refuse to start on a broken rule file
+    rather than silently run a subset."""
+    groups, errors = parse_rule_config(config, source)
+    if errors:
+        raise RuleConfigError(errors)
+    return groups
+
+
+def load_rule_file(path: str) -> list:
+    """Load + validate one JSON rule file."""
+    with open(path) as f:
+        try:
+            config = json.load(f)
+        except json.JSONDecodeError as e:
+            raise RuleConfigError([f"{path}: not valid JSON: {e}"]) from e
+    return load_rule_config(config, source=path)

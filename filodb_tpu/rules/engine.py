@@ -1,0 +1,591 @@
+"""The rule engine: continuous recording & alerting rule evaluation.
+
+Capability match for Prometheus' rules manager (prometheus/rules/
+manager.go — groups on independent tickers, recording rules appending
+to storage, alerting rules running the inactive -> pending -> firing ->
+resolved state machine with ``ALERTS``/``ALERTS_FOR_STATE`` synthetic
+series) built on this repo's serving fabric:
+
+- every evaluation goes through the NORMAL query path — planner ->
+  admission -> scheduler — under the dedicated low-priority ``rules``
+  workload class with a per-evaluation deadline, so a pathological
+  rule group saturates at its admission share and can never starve
+  user traffic (workload/admission.py);
+- recorded series write back through the dataset's existing
+  ``ShardingPublisher``, so they are sharded, replicated (PR 12), and
+  queryable like any ingested series;
+- recording rules over bare windowed functions keep incremental window
+  state (:mod:`filodb_tpu.rules.incremental`) — each tick consumes
+  only newly-arrived samples, bit-equal to a cold full-range pass;
+- the engine is itself observable: ``filodb_rule_*`` metrics, a span
+  tree per group pass, flight events on firing/resolve, and the
+  ``/api/v1/rules`` / ``/api/v1/alerts`` / ``/admin/rules`` payloads.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import datetime
+import re
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from filodb_tpu.promql.parser import query_to_logical_plan
+from filodb_tpu.query.logical import IntervalSelector, RawSeries
+from filodb_tpu.query.model import (PeriodicBatch, QueryContext, QueryError,
+                                    RawBatch)
+from filodb_tpu.rules.config import RuleDef, RuleGroup
+from filodb_tpu.rules.incremental import WindowState, window_spec
+from filodb_tpu.utils.observability import (TRACER, PeriodicThread,
+                                            rule_metrics)
+from filodb_tpu.workload import deadline as wdl
+
+# the engine's admission identity: a dedicated low-priority class (its
+# share lives in workload/admission.py DEFAULT_PRIORITY_SHARES) and a
+# reserved tenant so rule traffic is attributable in /admin/workload
+RULE_PRIORITY = "rules"
+RULE_TENANT = "_rules"
+
+# synthetic series names (Prometheus: rules/alerting.go)
+ALERTS_METRIC = "ALERTS"
+ALERTS_FOR_STATE_METRIC = "ALERTS_FOR_STATE"
+
+_TEMPLATE_RE = re.compile(r"\{\{\s*\$(value|labels\.([a-zA-Z_][\w]*))\s*\}\}")
+
+
+def _iso(ms: int) -> str:
+    return datetime.datetime.fromtimestamp(
+        ms / 1000.0, tz=datetime.timezone.utc).isoformat()
+
+
+def render_template(text: str, labels: dict, value: float) -> str:
+    """Minimal Prometheus annotation templating: ``{{ $value }}`` and
+    ``{{ $labels.<name> }}``."""
+    import math
+
+    def repl(m: "re.Match[str]") -> str:
+        if m.group(1) == "value":
+            # int() on inf raises — and an alert value CAN be inf
+            # (a zero-denominator rate ratio), exactly when the
+            # annotation matters most
+            if math.isfinite(value) and value == int(value):
+                return str(int(value))
+            return repr(value)
+        return str(labels.get(m.group(2), ""))
+    return _TEMPLATE_RE.sub(repl, text)
+
+
+class RuleEvaluator:
+    """Issues one rule expression's queries through the normal serving
+    path: planner -> admission (``rules`` priority class, per-eval
+    deadline) -> scheduler.  One evaluator per dataset binding."""
+
+    def __init__(self, binding):
+        self.binding = binding       # http.server.DatasetBinding shape
+
+    def _qctx(self, timeout_ms: int) -> QueryContext:
+        qctx = QueryContext(
+            submit_time_ms=int(time.time() * 1000),
+            trace_id=TRACER.current_trace_id() or TRACER.new_trace_id(),
+            timeout_ms=int(timeout_ms),
+            tenant=RULE_TENANT,
+            priority=RULE_PRIORITY)
+        return wdl.mint(qctx)
+
+    def _admit(self, ep, qctx: QueryContext):
+        adm = getattr(self.binding, "admission", None)
+        if adm is None or not adm.enabled:
+            return contextlib.nullcontext()
+        cost = adm.cost_model.estimate(ep, self.binding.memstore)
+        return adm.admit(qctx, cost)
+
+    def run_plan(self, plan, timeout_ms: int):
+        """Materialize + admit + execute one logical plan; the rule
+        engine's only doorway to data."""
+        from filodb_tpu.query.exec import ExecContext
+        qctx = self._qctx(timeout_ms)
+        with TRACER.span("rules.query", dataset=self.binding.dataset):
+            ep = self.binding.planner.materialize(plan, qctx)
+
+            def run():
+                tok = TRACER.capture()
+                if tok[0] is None:
+                    tok = (qctx.trace_id, None)
+                with TRACER.attach(tok):
+                    return ep.execute(
+                        ExecContext(self.binding.memstore, qctx))
+
+            with self._admit(ep, qctx):
+                if self.binding.scheduler is not None:
+                    return self.binding.scheduler.execute(
+                        run, qctx.submit_time_ms, qctx.timeout_ms,
+                        deadline_ms=qctx.deadline_ms)
+                return run()
+
+    def instant_vector(self, expr: str, eval_ms: int,
+                       timeout_ms: int) -> list[tuple[dict, float]]:
+        """Evaluate ``expr`` at one instant -> ``[(tags, value)]`` (the
+        numeric core of ``to_prom_vector``; tags still carry the
+        internal metric column)."""
+        plan = query_to_logical_plan(expr, eval_ms)
+        result = self.run_plan(plan, timeout_ms)
+        out: list[tuple[dict, float]] = []
+        for b in result.batches:
+            if not isinstance(b, PeriodicBatch):
+                continue
+            for tags, ts, vals in b.to_series():
+                fin = np.flatnonzero(~np.isnan(vals) & (ts <= eval_ms))
+                if len(fin):
+                    out.append((tags, float(vals[fin[-1]])))
+        return out
+
+    def raw_series(self, filters: tuple, start_ms: int,
+                   end_ms: int, timeout_ms: int) -> list:
+        """Raw samples clamped to ``[start, end]`` -> ``[(tags, ts,
+        vals)]`` — the incremental window state's delta fetch."""
+        plan = RawSeries(IntervalSelector(int(start_ms), int(end_ms)),
+                         tuple(filters))
+        result = self.run_plan(plan, timeout_ms)
+        out = []
+        for b in result.batches:
+            if not isinstance(b, RawBatch) or b.batch is None:
+                continue
+            for i, tags in enumerate(b.keys):
+                n = int(b.batch.row_counts[i])
+                out.append((tags, np.asarray(b.batch.timestamps[i][:n]),
+                            np.asarray(b.batch.values[i][:n])))
+        return out
+
+
+@dataclasses.dataclass
+class AlertInstance:
+    """One active alert (rule x label set)."""
+
+    labels: dict                    # includes alertname + rule labels
+    annotations: dict               # templated at activation
+    state: str                      # pending | firing | resolved
+    active_at_ms: int
+    value: float = 0.0
+    resolved_at_ms: int = 0
+
+    def payload(self) -> dict:
+        return {"labels": dict(self.labels),
+                "annotations": dict(self.annotations),
+                "state": self.state,
+                "activeAt": _iso(self.active_at_ms),
+                "value": str(self.value)}
+
+
+@dataclasses.dataclass
+class _RuleState:
+    """Per-rule runtime bookkeeping the API views read."""
+
+    rule: RuleDef
+    health: str = "unknown"         # ok | err | unknown
+    last_error: str = ""
+    last_duration_s: float = 0.0
+    last_eval_ms: int = 0
+    incremental: Optional[WindowState] = None
+    incr_seen: int = 0              # samples_consumed already counted
+    # alerting: key -> AlertInstance (pending/firing, plus resolved
+    # instances retained for the API until _RESOLVED_RETENTION_MS)
+    alerts: dict = dataclasses.field(default_factory=dict)
+    # recording: output series written last tick (stale-series fence)
+    out_series: set = dataclasses.field(default_factory=set)
+
+
+class _GroupState:
+    def __init__(self, group: RuleGroup, evaluator: RuleEvaluator,
+                 publisher):
+        self.group = group
+        self.evaluator = evaluator
+        self.publisher = publisher
+        self.rules = [_RuleState(r) for r in group.rules]
+        self.loop: Optional[PeriodicThread] = None
+        self.last_start_s: Optional[float] = None
+        self.last_duration_s = 0.0
+        self.evals = 0
+        self.missed = 0
+        self.timeout_ms = group.timeout_ms or min(group.interval_ms,
+                                                  30_000)
+
+
+_RESOLVED_RETENTION_MS = 15 * 60_000
+
+
+class RuleEngine:
+    """Owns every rule group: scheduling, evaluation, state, payloads.
+
+    ``binding_for(dataset)`` resolves a dataset to its serving binding
+    (planner/memstore/scheduler/admission); ``publisher_for(dataset)``
+    to its gateway write publisher.  Groups naming no dataset evaluate
+    against ``default_dataset``.
+    """
+
+    def __init__(self, groups: list, binding_for, publisher_for,
+                 default_dataset: str = "", notifier=None,
+                 node: str = "", incremental: bool = True):
+        self._m = rule_metrics()
+        self.node = node
+        self.notifier = notifier
+        self.incremental = incremental
+        self._lock = threading.Lock()
+        # the group LIST is fixed at construction; _lock guards the
+        # mutable per-group/per-rule state inside it (alerts, timings)
+        self._groups: list[_GroupState] = []
+        self._started = False
+        for g in groups:
+            ds = g.dataset or default_dataset
+            binding = binding_for(ds)
+            publisher = publisher_for(ds)
+            if binding is None:
+                raise ValueError(
+                    f"rule group {g.name!r} targets unknown dataset "
+                    f"{ds!r}")
+            g = dataclasses.replace(g, dataset=ds)
+            gs = _GroupState(g, RuleEvaluator(binding), publisher)
+            if incremental:
+                for rs in gs.rules:
+                    if rs.rule.kind != "recording":
+                        continue
+                    spec = self._window_spec(rs.rule)
+                    if spec is not None:
+                        rs.incremental = WindowState(spec)
+            self._groups.append(gs)
+
+    @staticmethod
+    def _window_spec(rule: RuleDef):
+        from filodb_tpu.promql.parser import ParseError
+        try:
+            base = 1_700_000_000_000
+            return window_spec(query_to_logical_plan(rule.expr, base))
+        except (ParseError, ValueError):
+            return None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+            for gs in self._groups:
+                gs.loop = PeriodicThread(
+                    lambda _gs=gs: self._tick(_gs),
+                    gs.group.interval_ms / 1000.0,
+                    f"rules-{gs.group.name}")
+                gs.loop.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            loops = [gs.loop for gs in self._groups if gs.loop is not None]
+            self._started = False
+        for loop in loops:
+            loop.stop()
+        if self.notifier is not None:
+            self.notifier.close()
+        for gs in self._groups:
+            self._m["alerts_active"].remove(group=gs.group.name,
+                                            state="pending")
+            self._m["alerts_active"].remove(group=gs.group.name,
+                                            state="firing")
+            self._m["incr_series"].remove(group=gs.group.name)
+            self._m["lag"].remove(group=gs.group.name)
+            self._m["last_eval"].remove(group=gs.group.name)
+
+    # ----------------------------------------------------------- evaluation
+
+    def run_group_once(self, name: str,
+                       eval_ms: Optional[int] = None) -> None:
+        """Evaluate one group synchronously (tests, warm-up)."""
+        for gs in self._groups:
+            if gs.group.name == name:
+                self._tick(gs, eval_ms=eval_ms)
+                return
+        raise KeyError(f"unknown rule group {name!r}")
+
+    def _tick(self, gs: _GroupState, eval_ms: Optional[int] = None) -> None:
+        t0 = time.perf_counter()
+        now_s = time.time()
+        gname = gs.group.name
+        interval_s = gs.group.interval_ms / 1000.0
+        if gs.last_start_s is not None:
+            gap = now_s - gs.last_start_s
+            overrun = max(0, int(round(gap / interval_s)) - 1)
+            if overrun:
+                self._m["missed"].inc(overrun, group=gname)
+                gs.missed += overrun
+            self._m["lag"].set(max(0.0, gap - interval_s), group=gname)
+        gs.last_start_s = now_s
+        eval_ms = eval_ms if eval_ms is not None else int(now_s * 1000)
+        trace_id = TRACER.new_trace_id()
+        failed = False
+        with TRACER.attach((trace_id, None)), \
+                TRACER.span("rules.group", group=gname,
+                            dataset=gs.group.dataset):
+            for rs in gs.rules:
+                rt0 = time.perf_counter()
+                try:
+                    with TRACER.span("rules.eval", rule=rs.rule.name,
+                                     kind=rs.rule.kind):
+                        if rs.rule.kind == "recording":
+                            self._eval_recording(gs, rs, eval_ms)
+                        else:
+                            self._eval_alerting(gs, rs, eval_ms)
+                    rs.health, rs.last_error = "ok", ""
+                except Exception as e:  # noqa: BLE001 — one bad rule must
+                    # not block the rest of the group
+                    failed = True
+                    rs.health, rs.last_error = "err", str(e)
+                    if rs.incremental is not None:
+                        # a failed fetch may have holes: next tick is cold
+                        rs.incremental.reset()
+                finally:
+                    rs.last_duration_s = time.perf_counter() - rt0
+                    rs.last_eval_ms = eval_ms
+            if gs.publisher is not None:
+                gs.publisher.flush()
+        dur = time.perf_counter() - t0
+        with self._lock:
+            gs.last_duration_s = dur
+            gs.evals += 1
+        self._m["eval_seconds"].observe(dur, group=gname)
+        self._m["evals"].inc(group=gname,
+                             outcome="failed" if failed else "ok")
+        self._m["last_eval"].set(eval_ms / 1000.0, group=gname)
+
+    # --------------------------------------------------------- recording
+
+    @staticmethod
+    def _output_labels(tags: dict, rule: RuleDef) -> dict:
+        """Query-output tags -> the recorded series' labels: drop the
+        metric name (Prometheus semantics for recorded outputs), apply
+        the rule's label overrides."""
+        out = {k: v for k, v in tags.items()
+               if k not in ("_metric_", "__name__")}
+        out.update(rule.labels)
+        return out
+
+    def _eval_recording(self, gs: _GroupState, rs: _RuleState,
+                        eval_ms: int) -> None:
+        rule = rs.rule
+        if rs.incremental is not None:
+            series = rs.incremental.tick(
+                eval_ms,
+                lambda filters, s, e: gs.evaluator.raw_series(
+                    filters, s, e, gs.timeout_ms))
+            self._m["incr_samples"].inc(
+                rs.incremental.samples_consumed - rs.incr_seen,
+                group=gs.group.name)
+            rs.incr_seen = rs.incremental.samples_consumed
+            self._m["incr_series"].set(rs.incremental.resident_series,
+                                       group=gs.group.name)
+        else:
+            series = gs.evaluator.instant_vector(rule.expr, eval_ms,
+                                                 gs.timeout_ms)
+        written: set = set()
+        n = 0
+        for tags, value in series:
+            out = self._output_labels(tags, rule)
+            key = tuple(sorted(out.items()))
+            if key in written:
+                # two input series collapsing onto one output label set
+                # is a conflict Prometheus rejects; first writer wins
+                continue
+            written.add(key)
+            if gs.publisher is not None:
+                gs.publisher.add_sample(rule.name, out, eval_ms, value)
+                n += 1
+        # stale-series fence (the PR 11 tenant-gauge lesson): an output
+        # series absent this tick gets NO sample — never a re-exported
+        # last value — and its bookkeeping is dropped with it
+        gone = rs.out_series - written
+        if gone:
+            self._m["stale"].inc(len(gone), group=gs.group.name)
+        rs.out_series = written
+        if n:
+            self._m["samples"].inc(n, group=gs.group.name)
+
+    # ---------------------------------------------------------- alerting
+
+    def _eval_alerting(self, gs: _GroupState, rs: _RuleState,
+                       eval_ms: int) -> None:
+        rule = rs.rule
+        series = gs.evaluator.instant_vector(rule.expr, eval_ms,
+                                             gs.timeout_ms)
+        current: dict[tuple, tuple[dict, float]] = {}
+        for tags, value in series:
+            labels = {k: v for k, v in tags.items()
+                      if k not in ("_metric_", "__name__")}
+            labels.update(rule.labels)
+            labels["alertname"] = rule.name
+            current[tuple(sorted(labels.items()))] = (labels, value)
+
+        with self._lock:
+            alerts = rs.alerts
+            for key, (labels, value) in current.items():
+                inst = alerts.get(key)
+                if inst is None or inst.state == "resolved":
+                    state = "pending" if rule.for_ms else "firing"
+                    inst = alerts[key] = AlertInstance(
+                        labels=labels,
+                        annotations={k: render_template(v, labels, value)
+                                     for k, v in rule.annotations.items()},
+                        state=state, active_at_ms=eval_ms, value=value)
+                    self._transition(gs, rule, inst, state)
+                    continue
+                inst.value = value
+                if inst.state == "pending" \
+                        and eval_ms - inst.active_at_ms >= rule.for_ms:
+                    inst.state = "firing"
+                    inst.annotations = {
+                        k: render_template(v, labels, value)
+                        for k, v in rule.annotations.items()}
+                    self._transition(gs, rule, inst, "firing")
+            for key in list(alerts):
+                inst = alerts[key]
+                if key in current:
+                    continue
+                if inst.state == "pending":
+                    # never fired: silently back to inactive
+                    del alerts[key]
+                    self._m["transitions"].inc(group=gs.group.name,
+                                               state="inactive")
+                elif inst.state == "firing":
+                    inst.state = "resolved"
+                    inst.resolved_at_ms = eval_ms
+                    self._transition(gs, rule, inst, "resolved")
+                elif eval_ms - inst.resolved_at_ms \
+                        > _RESOLVED_RETENTION_MS:
+                    del alerts[key]
+            pending = sum(1 for a in alerts.values()
+                          if a.state == "pending")
+            firing = sum(1 for a in alerts.values()
+                         if a.state == "firing")
+            live = [a for a in alerts.values()
+                    if a.state in ("pending", "firing")]
+        self._m["alerts_active"].set(pending, group=gs.group.name,
+                                     state="pending")
+        self._m["alerts_active"].set(firing, group=gs.group.name,
+                                     state="firing")
+        # ALERTS / ALERTS_FOR_STATE synthetic series ride the same
+        # write-back path as recorded series (queryable, replicated)
+        if gs.publisher is not None and live:
+            n = 0
+            for inst in live:
+                tags = dict(inst.labels)
+                tags["alertstate"] = inst.state
+                gs.publisher.add_sample(ALERTS_METRIC, tags, eval_ms, 1.0)
+                gs.publisher.add_sample(ALERTS_FOR_STATE_METRIC,
+                                        dict(inst.labels), eval_ms,
+                                        inst.active_at_ms / 1000.0)
+                n += 2
+            self._m["samples"].inc(n, group=gs.group.name)
+
+    def _transition(self, gs: _GroupState, rule: RuleDef,
+                    inst: AlertInstance, state: str) -> None:
+        self._m["transitions"].inc(group=gs.group.name, state=state)
+        from filodb_tpu.utils.devicewatch import FLIGHT
+        FLIGHT.record("rules.alert", alertname=rule.name, state=state,
+                      group=gs.group.name, node=self.node,
+                      value=inst.value)
+        # Prometheus notifies on firing and resolution; pending is an
+        # internal hold state
+        if self.notifier is not None and state in ("firing", "resolved"):
+            payload = inst.payload()
+            payload["status"] = "firing" if state == "firing" \
+                else "resolved"
+            payload["startsAt"] = _iso(inst.active_at_ms)
+            payload["endsAt"] = _iso(inst.resolved_at_ms) \
+                if inst.resolved_at_ms else ""
+            self.notifier.notify(payload)
+
+    # -------------------------------------------------------------- views
+
+    def rules_payload(self) -> dict:
+        """``GET /api/v1/rules`` (Prometheus RulesAPI shape)."""
+        groups = []
+        with self._lock:
+            for gs in self._groups:
+                rows = []
+                for rs in gs.rules:
+                    r = rs.rule
+                    row = {"name": r.name,
+                           "query": r.rendered or r.expr,
+                           "health": rs.health,
+                           "lastError": rs.last_error,
+                           "evaluationTime": round(rs.last_duration_s, 6),
+                           "lastEvaluation": _iso(rs.last_eval_ms)
+                           if rs.last_eval_ms else "",
+                           "labels": dict(r.labels),
+                           "type": r.kind}
+                    if r.kind == "alerting":
+                        live = [a for a in rs.alerts.values()
+                                if a.state in ("pending", "firing")]
+                        row["duration"] = r.for_ms / 1000.0
+                        row["annotations"] = dict(r.annotations)
+                        row["state"] = ("firing" if any(
+                            a.state == "firing" for a in live)
+                            else "pending" if live else "inactive")
+                        row["alerts"] = [a.payload() for a in live]
+                    rows.append(row)
+                groups.append({"name": gs.group.name,
+                               "file": gs.group.source,
+                               "dataset": gs.group.dataset,
+                               "interval": gs.group.interval_ms / 1000.0,
+                               "rules": rows})
+        return {"groups": groups}
+
+    def alerts_payload(self) -> dict:
+        """``GET /api/v1/alerts``: every live alert instance."""
+        out = []
+        with self._lock:
+            for gs in self._groups:
+                for rs in gs.rules:
+                    out.extend(a.payload() for a in rs.alerts.values()
+                               if a.state in ("pending", "firing"))
+        return {"alerts": out}
+
+    def admin_state(self) -> dict:
+        """``GET /admin/rules``: the engine's live operational state."""
+        groups = []
+        with self._lock:
+            for gs in self._groups:
+                incr = [{"rule": rs.rule.name,
+                         "series": rs.incremental.resident_series,
+                         "samples": rs.incremental.resident_samples,
+                         "fetched_through_ms":
+                             rs.incremental.fetched_through_ms}
+                        for rs in gs.rules if rs.incremental is not None]
+                groups.append({
+                    "name": gs.group.name,
+                    "dataset": gs.group.dataset,
+                    "interval_s": gs.group.interval_ms / 1000.0,
+                    "timeout_ms": gs.timeout_ms,
+                    "evals": gs.evals,
+                    "missed": gs.missed,
+                    "last_duration_s": round(gs.last_duration_s, 6),
+                    "rules": [{"name": rs.rule.name,
+                               "kind": rs.rule.kind,
+                               "health": rs.health,
+                               "lastError": rs.last_error,
+                               "alerts": {
+                                   s: n for s in ("pending", "firing",
+                                                  "resolved")
+                                   if (n := sum(
+                                       1 for x in rs.alerts.values()
+                                       if x.state == s))},
+                               "outputSeries": len(rs.out_series)}
+                              for rs in gs.rules],
+                    "incremental": incr})
+        state = {"priority_class": RULE_PRIORITY, "tenant": RULE_TENANT,
+                 "groups": groups}
+        if self.notifier is not None:
+            state["notifier"] = {"url": self.notifier.url,
+                                 "queue_depth":
+                                     self.notifier.queue_depth()}
+        return state

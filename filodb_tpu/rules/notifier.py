@@ -1,0 +1,139 @@
+"""Webhook alert notifier: bounded queue, bounded retry, flight-recorded.
+
+Capability match for Prometheus' notifier (prometheus/notifier/
+notifier.go — a queue drained by a sender with capacity shedding),
+scoped to one webhook endpoint.  Transitions enqueue an
+Alertmanager-shaped payload; a daemon worker POSTs each with bounded
+retry + exponential backoff.  A wedged receiver fills the queue and
+further sends are DROPPED (counted, flight-recorded) — alert delivery
+must never stall rule evaluation, the same isolation discipline as the
+replica delivery lanes (gateway/server.py).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import urllib.request
+from typing import Callable, Optional
+
+_STOP = object()
+
+
+def _metrics():
+    from filodb_tpu.utils.observability import rule_metrics
+    return rule_metrics()
+
+
+class WebhookNotifier:
+    """POSTs alert transition payloads to one webhook URL.
+
+    ``send_fn`` overrides the HTTP POST for tests (called with the
+    JSON-encoded body; raising marks the attempt failed).
+    """
+
+    def __init__(self, url: str, timeout_s: float = 5.0, retries: int = 3,
+                 backoff_s: float = 0.25, max_queued: int = 256,
+                 send_fn: Optional[Callable[[bytes], None]] = None):
+        self.url = url
+        self.timeout_s = float(timeout_s)
+        self.retries = max(int(retries), 0)
+        self.backoff_s = float(backoff_s)
+        self.send_fn = send_fn
+        self._q: "queue.Queue" = queue.Queue(maxsize=max_queued)
+        self._m = _metrics()
+        self._stopped = False
+        self._thread = threading.Thread(target=self._run,
+                                        name="rule-notifier", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- enqueue
+
+    def notify(self, payload: dict) -> bool:
+        """Queue one transition for delivery; False = dropped (full)."""
+        try:
+            self._q.put_nowait(payload)
+            return True
+        except queue.Full:
+            self._m["notifications"].inc(outcome="dropped")
+            from filodb_tpu.utils.devicewatch import FLIGHT
+            FLIGHT.record("rules.notify_dropped",
+                          alertname=payload.get("labels", {})
+                          .get("alertname", ""),
+                          status=payload.get("status", ""))
+            return False
+
+    # -------------------------------------------------------------- worker
+
+    def _post(self, body: bytes) -> None:
+        if self.send_fn is not None:
+            self.send_fn(body)
+            return
+        req = urllib.request.Request(
+            self.url, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s):
+            pass
+
+    def _run(self) -> None:
+        from filodb_tpu.utils.devicewatch import FLIGHT
+        while True:
+            try:
+                item = self._q.get(timeout=0.25)
+            except queue.Empty:
+                if self._stopped:
+                    return
+                continue
+            if item is _STOP:
+                self._q.task_done()
+                return
+            body = json.dumps([item]).encode()
+            alertname = item.get("labels", {}).get("alertname", "")
+            err = ""
+            attempts = 0
+            delivered = False
+            for attempt in range(self.retries + 1):
+                attempts = attempt + 1
+                try:
+                    self._post(body)
+                    delivered = True
+                    break
+                except Exception as e:  # noqa: BLE001 — retry, then give up
+                    err = str(e)
+                    if attempt < self.retries:
+                        self._m["notify_retries"].inc()
+                        time.sleep(self.backoff_s * (2 ** attempt))
+            self._m["notifications"].inc(
+                outcome="delivered" if delivered else "failed")
+            # every send is flight-recorded: alert delivery is exactly
+            # the traffic an operator replays after an incident
+            FLIGHT.record("rules.notify", alertname=alertname,
+                          status=item.get("status", ""),
+                          outcome="delivered" if delivered else "failed",
+                          attempts=attempts,
+                          **({"error": err[:200]} if err else {}))
+            self._q.task_done()
+
+    # ----------------------------------------------------------- lifecycle
+
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        """Best-effort wait until the queue empties (tests/shutdown)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._q.unfinished_tasks == 0:
+                return True
+            time.sleep(0.005)
+        return False
+
+    def queue_depth(self) -> int:
+        return self._q.qsize()
+
+    def close(self) -> None:
+        self._stopped = True
+        try:
+            self._q.put_nowait(_STOP)
+        except queue.Full:
+            pass  # worker notices _stopped within its poll interval
+        self._thread.join(timeout=2.0)

@@ -1,0 +1,92 @@
+"""The shipped self-monitoring rule pack over the ``_system`` dataset.
+
+PR 11's self-scrape turned the node's own ``/metrics`` exposition into
+a queryable Prometheus-schema dataset; this pack turns that inert
+telemetry into the node's own alerting substrate.  Loaded by the
+standalone server whenever self-scrape is enabled (``rules.
+self-monitoring`` config block opts out / tunes cadence), validated by
+``rules-check --builtin`` in tier-1.
+
+Every expr reads the ``filodb_*`` families the self-scraper publishes
+(doc/observability.md) — the alerts cover the four operational
+failure classes PRs 6-12 made visible but nothing acted on:
+
+- **ingest stalls** — a lagging shard whose ingested offset stopped
+  moving (`filodb_ingest_stalls_total`, watermark ledger);
+- **recompile storms** — a program minting distinct XLA shapes fast
+  enough to wedge serving (`filodb_jit_recompile_storms_total`);
+- **replica publish failures** — the dual-write fanout dropping a
+  peer's containers (`filodb_ingest_replica_publish_failures_total`);
+- **integrity quarantines** — corrupt chunks excluded from serving
+  (`filodb_integrity_quarantined_chunks`).
+"""
+
+from __future__ import annotations
+
+GROUP_NAME = "filodb-self-monitoring"
+
+
+def selfmon_pack(interval: str = "15s", for_: str = "30s",
+                 dataset: str = "_system", window: str = "2m") -> dict:
+    """The pack as a rule config dict (``parse_rule_config`` input).
+    ``interval``/``for_``/``window`` are tunable so fast test cadences
+    and production defaults share one definition."""
+    return {"groups": [{
+        "name": GROUP_NAME,
+        "interval": interval,
+        "dataset": dataset,
+        "rules": [
+            # recorded convenience series dashboards read directly
+            {"record": "node:ingest_lag_rows:sum",
+             "expr": "sum(filodb_ingest_lag_rows)",
+             "labels": {"source": "selfmon"}},
+            {"record": "node:selfscrape_samples:rate1m",
+             "expr": "rate(filodb_selfscrape_samples_total[1m])",
+             "labels": {"source": "selfmon"}},
+            {"alert": "FiloIngestStalled",
+             # the LEVEL gauge, not increase(stalls_total): the
+             # counter's label set is born at 1 (first episode creates
+             # it), so a scrape of it never shows the 0->1 edge
+             "expr": "filodb_ingest_stalled > 0",
+             "for": for_,
+             "labels": {"severity": "page", "source": "selfmon"},
+             "annotations": {
+                 "summary": "ingest stalled on dataset "
+                            "{{ $labels.dataset }} shard "
+                            "{{ $labels.shard }}",
+                 "description": "a lagging shard's ingested offset made "
+                                "no progress for the stall window "
+                                "({{ $value }} episodes)"}},
+            {"alert": "FiloRecompileStorm",
+             "expr": "increase("
+                     f"filodb_jit_recompile_storms_total[{window}]) > 0",
+             "for": for_,
+             "labels": {"severity": "warn", "source": "selfmon"},
+             "annotations": {
+                 "summary": "recompile storm on program "
+                            "{{ $labels.program }}",
+                 "description": "a program compiled enough distinct "
+                                "shapes to wedge serving; check "
+                                "/admin/device"}},
+            {"alert": "FiloReplicaPublishFailing",
+             "expr": "increase("
+                     "filodb_ingest_replica_publish_failures_total"
+                     f"[{window}]) > 0",
+             "for": for_,
+             "labels": {"severity": "page", "source": "selfmon"},
+             "annotations": {
+                 "summary": "replica deliveries failing toward "
+                            "{{ $labels.node }}",
+                 "description": "the dual-write fanout is dropping "
+                                "containers ({{ $value }}); the "
+                                "replica lags until it recovers"}},
+            {"alert": "FiloChunksQuarantined",
+             "expr": "filodb_integrity_quarantined_chunks > 0",
+             "for": for_,
+             "labels": {"severity": "warn", "source": "selfmon"},
+             "annotations": {
+                 "summary": "{{ $value }} corrupt chunks quarantined",
+                 "description": "queries over the affected series are "
+                                "partial; see /admin/integrity"}},
+        ],
+    }]}

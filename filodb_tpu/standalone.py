@@ -23,6 +23,17 @@ driven by a JSON config instead of HOCON:
         "self-scrape": {"enabled": false, "interval-s": 10,
                         "dataset": "_system", "num-shards": 1}
       },
+      "rules": {                          # ISSUE 9 (doc/rules.md)
+        "groups": [...],                  # inline rule groups
+        "files": ["/etc/filodb/rules.json"],
+        "notifier": {"url": "http://alertmanager:9093/api/v2/alerts",
+                     "timeout-s": 5, "retries": 3, "backoff-s": 0.25},
+        "self-monitoring": {"enabled": true, "interval": "15s",
+                            "for": "30s"}
+                                          # the shipped pack over the
+                                          # _system dataset; defaults on
+                                          # whenever self-scrape is on
+      },
       "datasets": [{
         "name": "prom", "num-shards": 4, "min-num-nodes": 1,
         "schema": "gauge", "spread": 1,
@@ -113,6 +124,10 @@ class FiloServer:
         self.watermarks = None
         self.watermark_sampler = None
         self.selfscraper = None
+        # rule engine (ISSUE 9): continuous recording/alerting rules
+        # evaluated through the normal query path (doc/rules.md)
+        self.rule_engine = None
+        self.rule_notifier = None
         self.write_publishers: dict[str, ShardingPublisher] = {}
         self._global_gateway_claimed = False
         # datasets fed by the in-proc queue: the only legal targets of
@@ -261,6 +276,8 @@ class FiloServer:
             interval_s=float(dp.get("watermark-sample-interval-s", 10.0)))
         self.watermark_sampler.start()
 
+        self._setup_rules(ss)
+
         port = self.http.start()
         peers = self.config.get("peers", {})
         if peers:
@@ -289,6 +306,54 @@ class FiloServer:
             self.profiler.start()
         self._started.set()
         return port
+
+    def _setup_rules(self, selfscrape_conf: dict) -> None:
+        """Rule engine (ISSUE 9, doc/rules.md): inline groups + rule
+        files + the shipped self-monitoring pack (on whenever
+        self-scrape is on).  A broken rule config refuses startup —
+        silently running a subset of the configured rules is worse
+        than not starting."""
+        rules_conf = self.config.get("rules") or {}
+        from filodb_tpu.rules.config import (load_rule_config,
+                                             load_rule_file)
+        groups: list = []
+        if rules_conf.get("groups"):
+            groups.extend(load_rule_config(
+                {"groups": rules_conf["groups"]}, source="config"))
+        for path in rules_conf.get("files", []):
+            groups.extend(load_rule_file(path))
+        sm = rules_conf.get("self-monitoring") or {}
+        if selfscrape_conf.get("enabled") and sm.get("enabled", True):
+            from filodb_tpu.rules.selfmon import selfmon_pack
+            groups.extend(load_rule_config(
+                selfmon_pack(
+                    interval=str(sm.get("interval", "15s")),
+                    for_=str(sm.get("for", "30s")),
+                    dataset=selfscrape_conf.get("dataset", "_system"),
+                    window=str(sm.get("window", "2m"))),
+                source="builtin:self-monitoring"))
+        if not groups:
+            return
+        nconf = rules_conf.get("notifier") or {}
+        if nconf.get("url"):
+            from filodb_tpu.rules.notifier import WebhookNotifier
+            self.rule_notifier = WebhookNotifier(
+                nconf["url"],
+                timeout_s=float(nconf.get("timeout-s", 5.0)),
+                retries=int(nconf.get("retries", 3)),
+                backoff_s=float(nconf.get("backoff-s", 0.25)))
+        from filodb_tpu.rules.engine import RuleEngine
+        ds_names = [d["name"] for d in self.config.get("datasets", [])]
+        self.rule_engine = RuleEngine(
+            groups,
+            binding_for=self.http.datasets.get,
+            publisher_for=self.write_publishers.get,
+            default_dataset=ds_names[0] if ds_names else "",
+            notifier=self.rule_notifier,
+            node=self.node,
+            incremental=bool(rules_conf.get("incremental", True)))
+        self.http.rules = self.rule_engine
+        self.rule_engine.start()
 
     def _setup_dataset(self, ds_conf: dict) -> None:
         name = ds_conf["name"]
@@ -514,6 +579,10 @@ class FiloServer:
         return n
 
     def shutdown(self) -> None:
+        if self.rule_engine is not None:
+            # stops the group loops AND closes the notifier — a dead
+            # node must not keep evaluating or POSTing webhooks
+            self.rule_engine.stop()
         if self.watermark_sampler is not None:
             self.watermark_sampler.stop()
         if self.selfscraper is not None:
@@ -526,6 +595,13 @@ class FiloServer:
             fanout.close()
         self.coordinator.shutdown()
         self.http.shutdown()
+        if self.watermarks is not None:
+            # drop this node's exported watermark/stall gauge rows — a
+            # dead node's stalled=1 must not feed alerting rules
+            # forever.  AFTER http.shutdown(): a late /admin/shards
+            # request would otherwise re-watch the emptied ledger and
+            # resurrect the just-removed rows permanently
+            self.watermarks.close()
         for qs in self.query_schedulers.values():
             qs.shutdown()
         for ac in self.admission_controllers.values():

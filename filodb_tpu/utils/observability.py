@@ -374,6 +374,11 @@ def watermark_metrics() -> dict:
             "filodb_ingest_stalls_total",
             "stall episodes: a lagging shard whose ingested offset made "
             "no progress for the stall window"),
+        "stalled": REGISTRY.gauge(
+            "filodb_ingest_stalled",
+            "1 while the shard counts as stalled, else 0 — the level "
+            "the self-monitoring alert rules watch (a counter's label "
+            "set is born at 1, invisible to increase())"),
     }
 
 
@@ -478,6 +483,62 @@ def workload_metrics() -> dict:
         "quota_dropped_samples": REGISTRY.counter(
             "filodb_quota_dropped_samples_total",
             "samples dropped (edge or shard) for over-quota new series"),
+    }
+
+
+def rule_metrics() -> dict:
+    """Canonical rule-engine metrics (ISSUE 9, filodb_tpu/rules): group
+    evaluation health, write-back volume, alert state transitions,
+    notifier outcomes, and incremental-window residency — one place
+    defines the names so the engine, /admin/rules, and doc/rules.md can
+    never drift."""
+    return {
+        "eval_seconds": REGISTRY.histogram(
+            "filodb_rule_eval_seconds",
+            "wall time of one rule-group evaluation pass, per group"),
+        "evals": REGISTRY.counter(
+            "filodb_rule_evals_total",
+            "rule evaluations by group and outcome (ok | failed)"),
+        "missed": REGISTRY.counter(
+            "filodb_rule_evals_missed_total",
+            "scheduled group evaluations skipped because the previous "
+            "pass overran the interval"),
+        "lag": REGISTRY.gauge(
+            "filodb_rule_eval_lag_seconds",
+            "how far the group's last pass started behind its cadence"),
+        "last_eval": REGISTRY.gauge(
+            "filodb_rule_last_eval_timestamp_seconds",
+            "unix time of the group's most recent evaluation pass"),
+        "samples": REGISTRY.counter(
+            "filodb_rule_samples_written_total",
+            "recorded/ALERTS samples written back through the gateway "
+            "publisher, per group"),
+        "stale": REGISTRY.counter(
+            "filodb_rule_series_stale_total",
+            "recording-rule output series that vanished between "
+            "evaluations (export stopped, state dropped)"),
+        "transitions": REGISTRY.counter(
+            "filodb_rule_alert_transitions_total",
+            "alert state transitions by group and new state "
+            "(pending | firing | resolved | inactive)"),
+        "alerts_active": REGISTRY.gauge(
+            "filodb_rule_alerts",
+            "alert instances currently held, by group and state"),
+        "notifications": REGISTRY.counter(
+            "filodb_rule_notifications_total",
+            "webhook notifier sends by outcome "
+            "(delivered | failed | dropped)"),
+        "notify_retries": REGISTRY.counter(
+            "filodb_rule_notification_retries_total",
+            "webhook delivery attempts retried after an error"),
+        "incr_samples": REGISTRY.counter(
+            "filodb_rule_incremental_samples_total",
+            "newly-arrived samples consumed by incremental window "
+            "state (vs re-scanning the full range), per group"),
+        "incr_series": REGISTRY.gauge(
+            "filodb_rule_incremental_series",
+            "input series currently resident in incremental window "
+            "state, per group"),
     }
 
 

@@ -46,7 +46,12 @@ from filodb_tpu.query.scheduler import QueryRejected
 from filodb_tpu.workload import deadline as dl
 from filodb_tpu.workload.cost import CostModel
 
-DEFAULT_PRIORITY_SHARES = {"low": 0.5, "default": 0.8, "high": 1.0}
+DEFAULT_PRIORITY_SHARES = {"low": 0.5, "default": 0.8, "high": 1.0,
+                           # the rule engine's dedicated class (ISSUE 9):
+                           # BELOW "low", so a pathological rule group
+                           # saturates at 40% of the budget and can
+                           # never starve interactive traffic
+                           "rules": 0.4}
 
 
 class AdmissionRejected(QueryRejected):

@@ -176,6 +176,22 @@ RULE_CASES = [
      "observability.md",
      {"good_kw": {"doc_text": "| `filodb_query_*` | `request_seconds` |"},
       "bad_kw": {"doc_text": "| `filodb_query_*` | `request_seconds` |"}}),
+    ("evaluator-workload",
+     # a background evaluator minting query identity without a
+     # workload class or deadline — invisible ambient-priority load
+     "class BackgroundEvaluator:\n"
+     "    def tick(self):\n"
+     "        qctx = QueryContext(submit_time_ms=1)\n"
+     "        ep = self.planner.materialize(plan, qctx)\n"
+     "        return ep.execute(ctx)\n",
+     "from filodb_tpu.workload import deadline as wdl\n"
+     "class BackgroundEvaluator:\n"
+     "    def tick(self):\n"
+     "        qctx = wdl.mint(QueryContext(submit_time_ms=1,\n"
+     "                                     priority='rules'))\n"
+     "        ep = self.planner.materialize(plan, qctx)\n"
+     "        return ep.execute(ctx)\n",
+     "priority", {}),
     ("replica-routing",
      "class MyPlanDispatcher:\n"
      "    def dispatch(self, plan, ctx):\n"

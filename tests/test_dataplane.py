@@ -261,6 +261,47 @@ class TestWatermarkLedger:
         assert stalls.value(dataset="wm2", shard=0, node="n1") \
             == before + 2
 
+    def test_close_removes_exported_gauge_rows(self):
+        """ISSUE 9 regression: a dead server's ledger rows — above all
+        a lingering ``filodb_ingest_stalled=1`` — must leave the
+        process registry on close, or the self-monitoring alert rules
+        scraping it fire on a node that no longer exists."""
+        ms = TimeSeriesMemStore()
+        ms.setup("wmclose", DEFAULT_SCHEMAS, 0)
+        _ingest_rows(ms.get_shard("wmclose", 0), 5)
+        wm = WatermarkLedger(stall_window_s=0.01, node="nx")
+        wm.watch("wmclose", ms, end_offset_fn=lambda s: 20)
+        wm.sample()
+        time.sleep(0.02)
+        wm.sample()
+        stalled = REGISTRY.gauge("filodb_ingest_stalled")
+        assert stalled.value(dataset="wmclose", shard=0, node="nx") == 1
+
+        def gauge_rows(dataset):
+            # the LEDGER's gauge family only (the memstore's own
+            # cardinality gauges have their own close path)
+            return [ln for ln in REGISTRY.expose_text().splitlines()
+                    if f'dataset="{dataset}"' in ln
+                    and ln.startswith("filodb_ingest_")
+                    and not ln.startswith(
+                        "filodb_ingest_stalls_total")]
+
+        assert gauge_rows("wmclose")
+        wm.close()
+        # every GAUGE row is gone (the cumulative stalls_total counter
+        # stays — counters are history, gauges are state)
+        assert gauge_rows("wmclose") == []
+        # unwatch alone drops that dataset's rows too
+        ms2 = TimeSeriesMemStore()
+        ms2.setup("wmun", DEFAULT_SCHEMAS, 0)
+        _ingest_rows(ms2.get_shard("wmun", 0), 5)
+        wm2 = WatermarkLedger(node="ny")
+        wm2.watch("wmun", ms2, end_offset_fn=lambda s: 20)
+        wm2.sample()
+        assert gauge_rows("wmun")
+        wm2.unwatch("wmun")
+        assert gauge_rows("wmun") == []
+
     def test_caught_up_shard_never_stalls(self):
         ms = TimeSeriesMemStore()
         ms.setup("wm3", DEFAULT_SCHEMAS, 0)

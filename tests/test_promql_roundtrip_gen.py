@@ -31,7 +31,7 @@ RANGE_FNS = ["rate", "increase", "avg_over_time", "max_over_time",
              "last_over_time", "delta", "deriv"]
 INSTANT_FNS = ["abs", "ceil", "floor", "exp", "ln", "sqrt"]
 AGGS = ["sum", "min", "max", "avg", "count", "stddev", "stdvar"]
-WINDOWS = ["1m", "2m", "5m"]
+WINDOWS = ["1m", "2m", "5m", "90s", "1h", "1m30s"]
 BIN_OPS = ["+", "-", "*", "/", ">", "<", ">=", "<=", "=="]
 MATCHERS = [('job', '=', '"api"'), ('job', '!=', '"web"'),
             ('inst', '=~', '"i.*"'), ('inst', '!~', '"x[0-9]+"')]
@@ -58,6 +58,10 @@ def _vector(rng, depth):
     if roll < 0.75:
         op = rng.choice(AGGS)
         inner = _vector(rng, depth - 1)
+        if rng.random() < 0.25:
+            # nested aggregations with independent grouping clauses —
+            # the shape /api/v1/rules must render (ISSUE 9)
+            inner = f"{rng.choice(AGGS)}({inner}) without (inst)"
         grp = rng.random()
         if grp < 0.33:
             return f"{op}({inner}) by (g)"
@@ -114,6 +118,44 @@ def test_generated_leaf_plans_survive_wire(seed):
                 assert wire.serialize_plan(node2) == d, query
                 checked += 1
     assert checked > 0
+
+
+# the expression shapes the rules API serves (ISSUE 9): every rule's
+# expr is exposed through logical_plan_to_promql on /api/v1/rules, so
+# these exact forms — nested aggregations, by/without clauses,
+# composite durations, threshold comparisons — must hold the render
+# fixpoint the sweep asserts
+RULE_API_EXPRS = [
+    "sum by (dataset) (rate(filodb_ingest_samples_total[90s]))",
+    "max by (g) (sum(rate(http_req_total[5m])) without (inst))",
+    "sum(avg(max_over_time(mem_bytes[1h30m])) by (g))",
+    "avg without (inst) (increase(http_req_total[1h]))",
+    "sum by (dataset, shard) (delta(mem_bytes[2m30s]))",
+    "(sum(rate(http_req_total[2m])) by (g)) > (3.5)",
+    "quantile(0.99, sum(rate(http_req_total[5m])) by (g))",
+    "count(count(up) by (inst)) by (g)",
+]
+
+
+def _selfmon_exprs():
+    from filodb_tpu.rules.selfmon import selfmon_pack
+    return [r["expr"] for g in selfmon_pack()["groups"]
+            for r in g["rules"]]
+
+
+@pytest.mark.parametrize("query",
+                         RULE_API_EXPRS + _selfmon_exprs())
+def test_rule_api_expr_shapes_roundtrip(query):
+    """The renderer the rules API depends on: render(parse(q)) must be
+    a fixpoint with preserved plan type and time range for every shape
+    a rule file can carry."""
+    start, end = BASE, BASE + HOUR
+    plan = parse_query(query, start, STEP, end)
+    rendered = logical_plan_to_promql(plan)
+    plan2 = parse_query(rendered, start, STEP, end)
+    assert type(plan2) is type(plan), query
+    assert logical_plan_to_promql(plan2) == rendered, query
+    assert lp.time_range(plan2) == lp.time_range(plan), query
 
 
 @pytest.mark.parametrize("seed", range(16))

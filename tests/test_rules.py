@@ -1,0 +1,691 @@
+"""Rule engine (ISSUE 9): config validation, the ``rules-check`` CLI
+verb, recording-rule write-back + stale-series discipline, the alert
+state machine, the webhook notifier's bounded retry, admission under
+the dedicated ``rules`` priority class, and the generative
+incremental-window sweep proving warm state bit-equal to a cold
+full-range evaluation AND to the normal query path."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from filodb_tpu.cli import main as cli_main
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.core.record import RecordBuilder, decode_container
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.parallel.shardmap import ShardMapper, ShardStatus
+from filodb_tpu.rules.config import (RuleConfigError, load_rule_config,
+                                     parse_rule_config,
+                                     validate_rule_config)
+from filodb_tpu.rules.engine import (RuleEngine, RuleEvaluator,
+                                     render_template)
+from filodb_tpu.rules.incremental import WindowState, window_spec
+from filodb_tpu.rules.notifier import WebhookNotifier
+from filodb_tpu.rules.selfmon import selfmon_pack
+
+BASE = 1_700_000_000_000
+
+
+# ---------------------------------------------------------------------------
+# shared in-process harness: memstore + planner + binding-shaped object
+# ---------------------------------------------------------------------------
+
+
+class _Binding:
+    def __init__(self, dataset, memstore, planner, scheduler=None,
+                 admission=None):
+        self.dataset = dataset
+        self.memstore = memstore
+        self.planner = planner
+        self.scheduler = scheduler
+        self.admission = admission
+
+
+class _CapturePublisher:
+    """Collects write-backs as (metric, tags, ts, value)."""
+
+    def __init__(self):
+        self.samples = []
+        self.flushes = 0
+
+    def add_sample(self, metric, tags, ts, value):
+        self.samples.append((metric, dict(tags), int(ts), float(value)))
+
+    def flush(self):
+        self.flushes += 1
+        return 0
+
+    def of(self, metric):
+        return [s for s in self.samples if s[0] == metric]
+
+
+def _harness(num_shards=2, spread=1):
+    mapper = ShardMapper(num_shards)
+    mapper.register_node(range(num_shards), "local")
+    ms = TimeSeriesMemStore()
+    for s in range(num_shards):
+        mapper.update_status(s, ShardStatus.ACTIVE)
+        ms.setup("prom", DEFAULT_SCHEMAS, s)
+    planner = SingleClusterPlanner("prom", mapper, DatasetOptions(),
+                                   spread_default=spread)
+    return mapper, ms, _Binding("prom", ms, planner)
+
+
+def _ingest(mapper, ms, metric, series_vals, ts, offset=0, spread=1):
+    """series_vals: {tags_key: np.ndarray} aligned with ts."""
+    b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], DatasetOptions(),
+                      container_size=1 << 20)
+    for tags, vals in series_vals:
+        full = dict(tags)
+        full["__name__"] = metric
+        b.add_series(np.asarray(ts, dtype=np.int64),
+                     [np.asarray(vals, dtype=np.float64)], full)
+    n = mapper.num_shards
+    for off, c in enumerate(b.containers()):
+        per = {}
+        for rec in decode_container(c, DEFAULT_SCHEMAS):
+            sh = mapper.ingestion_shard(rec.shard_hash, rec.part_hash,
+                                        spread) % n
+            per.setdefault(sh, []).append(rec)
+        for sh, recs in per.items():
+            ms.get_shard("prom", sh).ingest(recs, offset + off)
+
+
+def _engine(binding, pub, groups_cfg, **kw):
+    groups, errs = parse_rule_config(groups_cfg)
+    assert not errs, errs
+    return RuleEngine(groups, binding_for=lambda d: binding,
+                      publisher_for=lambda d: pub,
+                      default_dataset="prom", **kw)
+
+
+# ---------------------------------------------------------------------------
+# config validation + rules-check CLI
+# ---------------------------------------------------------------------------
+
+
+class TestRuleConfig:
+    def test_valid_config_parses(self):
+        groups, errs = parse_rule_config({"groups": [{
+            "name": "g", "interval": "30s", "dataset": "prom",
+            "rules": [
+                {"record": "a:b:c", "expr": "sum(rate(m[5m]))"},
+                {"alert": "A", "expr": "up == 0", "for": "1m30s",
+                 "labels": {"sev": "page"},
+                 "annotations": {"summary": "down"}}]}]})
+        assert errs == []
+        g = groups[0]
+        assert g.interval_ms == 30_000
+        assert g.rules[0].kind == "recording"
+        assert g.rules[1].for_ms == 90_000
+        # exprs are canonicalized through the renderer for the API
+        assert g.rules[0].rendered == "sum(rate(m[5m]))"
+
+    @pytest.mark.parametrize("cfg,needle", [
+        ({"groups": [{"name": "g", "interval": "15s",
+                      "rules": [{"record": "r", "expr": "rate(m[5m"}]}]},
+         "does not parse"),
+        ({"groups": [{"name": "g", "interval": "15s",
+                      "rules": [{"record": "bad name", "expr": "m"}]}]},
+         "invalid recorded metric name"),
+        ({"groups": [{"name": "g", "interval": "nope",
+                      "rules": [{"record": "r", "expr": "m"}]}]},
+         "bad interval"),
+        ({"groups": [{"name": "g", "interval": "15s",
+                      "rules": [{"alert": "A", "expr": "m",
+                                 "for": "-3x"}]}]},
+         "bad for"),
+        ({"groups": [{"name": "g", "interval": "15s",
+                      "rules": [{"record": "r", "expr": "m",
+                                 "fro": "1m"}]}]},
+         "unknown field"),
+        ({"groups": [{"name": "g", "interval": "15s", "wat": 1,
+                      "rules": [{"record": "r", "expr": "m"}]}]},
+         "unknown field"),
+        ({"groups": [{"name": "g", "interval": "15s",
+                      "rules": [{"record": "r", "expr": "m"},
+                                {"record": "r", "expr": "m"}]}]},
+         "duplicate recording rule"),
+        ({"groups": [{"name": "g", "interval": "15s",
+                      "rules": [{"record": "r", "expr": "m"}]},
+                     {"name": "g", "interval": "15s",
+                      "rules": [{"record": "r2", "expr": "m"}]}]},
+         "duplicate group name"),
+        ({"groups": [{"name": "g", "interval": "15s",
+                      "rules": [{"record": "r", "alert": "a",
+                                 "expr": "m"}]}]},
+         "exactly one of"),
+        ({"groups": [{"name": "g", "interval": "15s",
+                      "rules": [{"record": "r", "expr": "m",
+                                 "for": "1m"}]}]},
+         "only valid on alerting"),
+        # a JSON null name must not stringify into a rule named "None"
+        ({"groups": [{"name": "g", "interval": "15s",
+                      "rules": [{"record": None, "expr": "m"}]}]},
+         "must be a string"),
+        ({"groups": [{"name": "g", "interval": "15s",
+                      "rules": [{"alert": None, "expr": "m"}]}]},
+         "must be a string"),
+    ])
+    def test_invalid_configs_are_errors(self, cfg, needle):
+        errs = validate_rule_config(cfg)
+        assert any(needle in e for e in errs), (needle, errs)
+
+    def test_all_errors_collected_not_failfast(self):
+        errs = validate_rule_config({"groups": [{
+            "name": "g", "interval": "bad",
+            "rules": [{"record": "x y", "expr": "("},
+                      {"alert": "", "expr": "m"}]}]})
+        assert len(errs) >= 3
+
+    def test_load_raises_on_errors(self):
+        with pytest.raises(RuleConfigError) as ei:
+            load_rule_config({"groups": [{"name": "g",
+                                          "interval": "15s",
+                                          "rules": []}]})
+        assert ei.value.errors
+
+    def test_builtin_selfmon_pack_is_valid(self):
+        assert validate_rule_config(selfmon_pack()) == []
+
+
+class TestRulesCheckCli:
+    def test_ok_and_bad_files(self, tmp_path, capsys):
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps(selfmon_pack()))
+        assert cli_main(["rules-check", str(good)]) == 0
+        assert "OK" in capsys.readouterr().out
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"groups": [{
+            "name": "g", "interval": "15s",
+            "rules": [{"record": "r", "expr": "rate(m["}]}]}))
+        assert cli_main(["rules-check", str(bad)]) == 1
+        out = capsys.readouterr().out
+        assert "FAILED" in out and "does not parse" in out
+
+    def test_builtin_flag_and_empty_invocation(self, capsys):
+        assert cli_main(["rules-check", "--builtin"]) == 0
+        assert "builtin:self-monitoring: OK" in capsys.readouterr().out
+        assert cli_main(["rules-check"]) == 2
+
+    def test_unreadable_file_fails(self, tmp_path):
+        assert cli_main(["rules-check",
+                         str(tmp_path / "missing.json")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# recording rules: write-back, labels, stale-series discipline
+# ---------------------------------------------------------------------------
+
+
+class TestRecordingRules:
+    def test_write_back_labels_and_flush(self):
+        mapper, ms, binding = _harness()
+        ts = BASE + np.arange(30, dtype=np.int64) * 10_000
+        _ingest(mapper, ms, "m_total",
+                [({"inst": f"i{i}", "_ws_": "w", "_ns_": "n"},
+                  np.cumsum(np.ones(30)) * (i + 1)) for i in range(3)],
+                ts)
+        pub = _CapturePublisher()
+        eng = _engine(binding, pub, {"groups": [{
+            "name": "g", "interval": "10s", "rules": [
+                {"record": "job:m:rate", "expr": "rate(m_total[2m])",
+                 "labels": {"source": "rules"}}]}]})
+        eng.run_group_once("g", eval_ms=BASE + 200_000)
+        rows = pub.of("job:m:rate")
+        assert len(rows) == 3 and pub.flushes == 1
+        for _m, tags, t, v in rows:
+            # metric name dropped, overrides applied, inputs preserved
+            assert "__name__" not in tags and "_metric_" not in tags
+            assert tags["source"] == "rules" and tags["inst"].startswith("i")
+            assert t == BASE + 200_000 and v > 0
+
+    def test_vanished_series_stops_exporting_and_drops_state(self):
+        """The stale-series regression (PR 11 tenant-gauge lesson): an
+        output series absent this eval gets NO sample — never a
+        re-exported last value — and its window state dies with it."""
+        mapper, ms, binding = _harness()
+        ts = BASE + np.arange(12, dtype=np.int64) * 1000
+        _ingest(mapper, ms, "g1",
+                [({"inst": "a"}, np.ones(12)),
+                 ({"inst": "b"}, 2 * np.ones(12))], ts)
+        pub = _CapturePublisher()
+        eng = _engine(binding, pub, {"groups": [{
+            "name": "g", "interval": "5s", "rules": [
+                {"record": "out:sum",
+                 "expr": "sum_over_time(g1[5s])"}]}]})
+        t1 = BASE + 12_000
+        eng.run_group_once("g", eval_ms=t1)
+        assert len(pub.of("out:sum")) == 2
+        rs = eng._groups[0].rules[0]
+        assert rs.incremental is not None  # the windowed shape is
+        # incremental, so this regression covers that path too
+        # only series a keeps receiving data
+        ts2 = BASE + (13 + np.arange(10, dtype=np.int64)) * 1000
+        _ingest(mapper, ms, "g1", [({"inst": "a"}, np.ones(10))], ts2,
+                offset=50)
+        pub.samples.clear()
+        t2 = BASE + 23_000   # b's samples all aged out of the 5s window
+        eng.run_group_once("g", eval_ms=t2)
+        rows = pub.of("out:sum")
+        assert len(rows) == 1 and rows[0][1]["inst"] == "a"
+        # b's buffered state is gone, not retained forever
+        assert rs.incremental.resident_series == 1
+        from filodb_tpu.utils.observability import REGISTRY
+        assert REGISTRY.counter(
+            "filodb_rule_series_stale_total").value(group="g") >= 1
+
+    def test_full_path_used_for_unsupported_shapes(self):
+        mapper, ms, binding = _harness()
+        ts = BASE + np.arange(20, dtype=np.int64) * 1000
+        _ingest(mapper, ms, "m_total",
+                [({"inst": "a"}, np.cumsum(np.ones(20)))], ts)
+        pub = _CapturePublisher()
+        eng = _engine(binding, pub, {"groups": [{
+            "name": "g", "interval": "10s", "rules": [
+                {"record": "out:agg",
+                 "expr": "sum(rate(m_total[10s]))"}]}]})
+        rs = eng._groups[0].rules[0]
+        assert rs.incremental is None  # aggregation -> full evaluation
+        eng.run_group_once("g", eval_ms=BASE + 20_000)
+        assert len(pub.of("out:agg")) == 1
+
+    def test_failed_rule_marks_health_and_resets_state(self):
+        mapper, ms, binding = _harness()
+        pub = _CapturePublisher()
+        eng = _engine(binding, pub, {"groups": [{
+            "name": "g", "interval": "10s", "rules": [
+                {"record": "out:r", "expr": "rate(m_total[1m])"}]}]})
+        rs = eng._groups[0].rules[0]
+        rs.incremental.series["fake"] = object()
+        orig = binding.planner.materialize
+        binding.planner.materialize = lambda *a, **k: (_ for _ in ()) \
+            .throw(RuntimeError("boom"))
+        try:
+            eng.run_group_once("g", eval_ms=BASE + 60_000)
+        finally:
+            binding.planner.materialize = orig
+        assert rs.health == "err" and "boom" in rs.last_error
+        # a failed eval may have holes: state is cold again
+        assert rs.incremental.fetched_through_ms is None
+        assert rs.incremental.resident_series == 0
+
+
+# ---------------------------------------------------------------------------
+# alert state machine + notifier
+# ---------------------------------------------------------------------------
+
+
+class TestAlertStateMachine:
+    def _eng(self, for_="10s", notifier=None):
+        mapper, ms, binding = _harness()
+        pub = _CapturePublisher()
+        eng = _engine(binding, pub, {"groups": [{
+            "name": "g", "interval": "5s", "rules": [
+                {"alert": "Hot", "expr": "gauge_x > 5", "for": for_,
+                 "labels": {"sev": "page"},
+                 "annotations": {
+                     "summary": "x={{ $value }} on {{ $labels.inst }}"
+                 }}]}]}, notifier=notifier)
+        return mapper, ms, pub, eng
+
+    def test_full_lifecycle(self):
+        sent = []
+        notifier = WebhookNotifier("http://unused", send_fn=lambda b:
+                                   sent.extend(json.loads(b)))
+        try:
+            mapper, ms, pub, eng = self._eng(notifier=notifier)
+            ts = BASE + np.arange(10, dtype=np.int64) * 1000
+            _ingest(mapper, ms, "gauge_x",
+                    [({"inst": "a"}, 9 * np.ones(10))], ts)
+            t1 = BASE + 10_000
+            eng.run_group_once("g", eval_ms=t1)          # -> pending
+            rs = eng._groups[0].rules[0]
+            (inst,) = rs.alerts.values()
+            assert inst.state == "pending"
+            assert inst.active_at_ms == t1
+            assert pub.of("ALERTS")[0][1]["alertstate"] == "pending"
+            assert pub.of("ALERTS_FOR_STATE")[0][3] == t1 / 1000.0
+            assert inst.annotations["summary"] == "x=9 on a"
+            # still failing past the hold -> firing
+            _ingest(mapper, ms, "gauge_x",
+                    [({"inst": "a"}, 9 * np.ones(10))],
+                    BASE + (11 + np.arange(10, dtype=np.int64)) * 1000,
+                    offset=30)
+            t2 = t1 + 11_000
+            eng.run_group_once("g", eval_ms=t2)          # -> firing
+            assert inst.state == "firing"
+            assert eng.rules_payload()["groups"][0]["rules"][0][
+                "state"] == "firing"
+            assert eng.alerts_payload()["alerts"][0]["state"] == "firing"
+            # series clears (value drops under threshold) -> resolved
+            _ingest(mapper, ms, "gauge_x",
+                    [({"inst": "a"}, np.ones(5))],
+                    t2 + 1000 + np.arange(5, dtype=np.int64) * 1000,
+                    offset=60)
+            t3 = t2 + 7_000
+            eng.run_group_once("g", eval_ms=t3)
+            assert inst.state == "resolved"
+            assert inst.resolved_at_ms == t3
+            # exactly one delivery per notifying transition
+            notifier.drain()
+            statuses = [p["status"] for p in sent]
+            assert statuses == ["firing", "resolved"]
+            assert sent[0]["labels"]["alertname"] == "Hot"
+            assert sent[0]["labels"]["sev"] == "page"
+        finally:
+            notifier.close()
+
+    def test_pending_that_clears_goes_inactive_silently(self):
+        sent = []
+        notifier = WebhookNotifier("http://unused",
+                                   send_fn=lambda b: sent.append(b))
+        try:
+            mapper, ms, pub, eng = self._eng(notifier=notifier)
+            ts = BASE + np.arange(5, dtype=np.int64) * 1000
+            _ingest(mapper, ms, "gauge_x",
+                    [({"inst": "a"}, 9 * np.ones(5))], ts)
+            eng.run_group_once("g", eval_ms=BASE + 5_000)
+            rs = eng._groups[0].rules[0]
+            assert len(rs.alerts) == 1
+            # past the 5m lookback with no fresh samples: vector empty
+            eng.run_group_once("g", eval_ms=BASE + 400_000)
+            assert rs.alerts == {}
+            notifier.drain()
+            assert sent == []    # pending never notifies
+        finally:
+            notifier.close()
+
+    def test_for_zero_fires_immediately(self):
+        mapper, ms, pub, eng = self._eng(for_="0s")
+        ts = BASE + np.arange(5, dtype=np.int64) * 1000
+        _ingest(mapper, ms, "gauge_x", [({"inst": "a"}, 9 * np.ones(5))],
+                ts)
+        eng.run_group_once("g", eval_ms=BASE + 5_000)
+        (inst,) = eng._groups[0].rules[0].alerts.values()
+        assert inst.state == "firing"
+
+
+class TestNotifier:
+    def test_bounded_retry_then_delivered(self):
+        calls = []
+
+        def flaky(body):
+            calls.append(body)
+            if len(calls) < 3:
+                raise OSError("conn refused")
+
+        n = WebhookNotifier("http://x", retries=3, backoff_s=0.001,
+                            send_fn=flaky)
+        try:
+            assert n.notify({"status": "firing", "labels": {}})
+            assert n.drain()
+            assert len(calls) == 3     # 2 failures + 1 success
+        finally:
+            n.close()
+
+    def test_gives_up_after_bounded_retries(self):
+        calls = []
+
+        def dead(body):
+            calls.append(body)
+            raise OSError("nope")
+
+        from filodb_tpu.utils.observability import REGISTRY
+        failed0 = REGISTRY.counter(
+            "filodb_rule_notifications_total").value(outcome="failed")
+        n = WebhookNotifier("http://x", retries=2, backoff_s=0.001,
+                            send_fn=dead)
+        try:
+            n.notify({"status": "firing", "labels": {}})
+            assert n.drain()
+            assert len(calls) == 3     # 1 + 2 retries, then give up
+            assert REGISTRY.counter(
+                "filodb_rule_notifications_total").value(
+                outcome="failed") == failed0 + 1
+        finally:
+            n.close()
+
+    def test_full_queue_drops_counted(self):
+        import threading
+        gate = threading.Event()
+        n = WebhookNotifier("http://x", max_queued=1,
+                            send_fn=lambda b: gate.wait(5))
+        try:
+            from filodb_tpu.utils.observability import REGISTRY
+            drop0 = REGISTRY.counter(
+                "filodb_rule_notifications_total").value(
+                outcome="dropped")
+            n.notify({"status": "firing", "labels": {}})
+            time.sleep(0.05)           # worker picks up the first
+            n.notify({"status": "firing", "labels": {}})
+            dropped = not n.notify({"status": "firing", "labels": {}})
+            gate.set()
+            assert dropped
+            assert REGISTRY.counter(
+                "filodb_rule_notifications_total").value(
+                outcome="dropped") == drop0 + 1
+        finally:
+            gate.set()
+            n.close()
+
+    def test_template_rendering(self):
+        out = render_template("v={{ $value }} i={{ $labels.inst }} "
+                              "x={{ $labels.missing }}",
+                              {"inst": "i0"}, 2.5)
+        assert out == "v=2.5 i=i0 x="
+        # a non-finite value (zero-denominator rate ratio) must render,
+        # not raise OverflowError and kill the rule's evaluation
+        assert render_template("{{ $value }}", {}, float("inf")) == "inf"
+        assert render_template("{{ $value }}", {}, 3.0) == "3"
+
+
+# ---------------------------------------------------------------------------
+# workload integration: the dedicated low-priority rules class
+# ---------------------------------------------------------------------------
+
+
+class TestRuleWorkloadClass:
+    def test_rules_priority_has_its_own_share(self):
+        from filodb_tpu.workload.admission import DEFAULT_PRIORITY_SHARES
+        assert DEFAULT_PRIORITY_SHARES["rules"] < \
+            DEFAULT_PRIORITY_SHARES["low"]
+
+    def test_saturated_admission_sheds_rule_eval_not_engine(self):
+        from filodb_tpu.workload.admission import AdmissionController
+        from filodb_tpu.workload.cost import CostModel
+        mapper, ms, binding = _harness()
+        ts = BASE + np.arange(30, dtype=np.int64) * 1000
+        _ingest(mapper, ms, "m_total",
+                [({"inst": f"i{i}"}, np.cumsum(np.ones(30)))
+                 for i in range(4)], ts)
+        ctrl = AdmissionController(CostModel(), dataset="prom",
+                                   max_inflight_cost=100.0)
+        binding.admission = ctrl
+        # eat the rules class's entire 40% share with a fake inflight
+        ctrl._inflight_cost = 99.0
+        pub = _CapturePublisher()
+        eng = _engine(binding, pub, {"groups": [{
+            "name": "g", "interval": "10s", "rules": [
+                {"record": "out:r", "expr": "rate(m_total[10s])"}]}]})
+        eng.run_group_once("g", eval_ms=BASE + 30_000)
+        rs = eng._groups[0].rules[0]
+        assert rs.health == "err"
+        assert "shed by admission control" in rs.last_error
+        from filodb_tpu.utils.observability import REGISTRY
+        assert REGISTRY.counter(
+            "filodb_admission_rejected_total").value(
+            dataset="prom", priority="rules", reason="overload") >= 1
+        # headroom restored -> the engine recovers on the next tick
+        ctrl._inflight_cost = 0.0
+        eng.run_group_once("g", eval_ms=BASE + 30_000)
+        assert rs.health == "ok" and pub.of("out:r")
+
+    def test_evaluator_mints_deadline_and_priority(self):
+        _mapper, _ms, binding = _harness()
+        ev = RuleEvaluator(binding)
+        qctx = ev._qctx(12_000)
+        assert qctx.priority == "rules" and qctx.tenant == "_rules"
+        assert qctx.deadline_ms > 0
+        assert qctx.deadline_ms - qctx.submit_time_ms == 12_000
+
+
+# ---------------------------------------------------------------------------
+# incremental window state: the generative bit-equality sweep
+# ---------------------------------------------------------------------------
+
+_SWEEP_FNS = ["rate", "increase", "sum_over_time", "count_over_time",
+              "avg_over_time", "max_over_time", "min_over_time",
+              "delta", "last_over_time"]
+
+
+class TestIncrementalWindows:
+    def test_window_spec_recognition(self):
+        from filodb_tpu.promql.parser import query_to_logical_plan
+        ok = window_spec(query_to_logical_plan("rate(m[5m])", BASE))
+        assert ok is not None and ok.window_ms == 300_000
+        for expr in ("sum(rate(m[5m]))", "m", "rate(m[5m] offset 1m)",
+                     "rate(m[5m]) > 0"):
+            assert window_spec(
+                query_to_logical_plan(expr, BASE)) is None, expr
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_generative_bit_equality(self, seed):
+        """Warm incremental state after N random ingest/tick rounds is
+        BIT-equal to (a) a cold full-range evaluation of the same state
+        machine and (b) the normal query path's answer for the same
+        expr at the same instant."""
+        rng = np.random.default_rng(seed)
+        mapper, ms, binding = _harness()
+        ev = RuleEvaluator(binding)
+        fn = _SWEEP_FNS[seed % len(_SWEEP_FNS)]
+        window_s = int(rng.integers(5, 30))
+        expr = f"{fn}(gen_m[{window_s}s])"
+        from filodb_tpu.promql.parser import query_to_logical_plan
+        spec = window_spec(query_to_logical_plan(expr, BASE))
+        assert spec is not None
+        warm = WindowState(spec)
+        series = [{"inst": f"i{i}"} for i in range(3)]
+        now = BASE
+        offset = 0
+        fetch = lambda f, s, e: ev.raw_series(f, s, e, 30_000)  # noqa: E731
+        for _round in range(5):
+            # ingest a random in-order slab for a random subset
+            step = int(rng.integers(200, 1500))
+            count = int(rng.integers(1, 15))
+            ts = now + np.arange(count, dtype=np.int64) * step
+            batch = []
+            for tags in series:
+                if rng.random() < 0.8:
+                    batch.append((tags,
+                                  np.cumsum(rng.random(count)) * 10))
+            if batch:
+                _ingest(mapper, ms, "gen_m", batch, ts, offset=offset)
+                offset += 10
+            now = int(ts[-1] + rng.integers(0, 2000))
+            got_warm = {tuple(sorted(t.items())): v
+                        for t, v in warm.tick(now, fetch)}
+            cold = WindowState(spec)
+            got_cold = {tuple(sorted(t.items())): v
+                        for t, v in cold.tick(now, fetch)}
+            direct = {}
+            for tags, v in ev.instant_vector(expr, now, 30_000):
+                direct[tuple(sorted(tags.items()))] = v
+            assert set(got_warm) == set(got_cold) == set(direct), \
+                (expr, _round)
+            for k, v in got_warm.items():
+                assert np.float64(v).tobytes() \
+                    == np.float64(got_cold[k]).tobytes(), (expr, _round)
+                assert np.float64(v).tobytes() \
+                    == np.float64(direct[k]).tobytes(), (expr, _round)
+
+    def test_each_tick_consumes_only_new_samples(self):
+        mapper, ms, binding = _harness()
+        ev = RuleEvaluator(binding)
+        from filodb_tpu.promql.parser import query_to_logical_plan
+        spec = window_spec(
+            query_to_logical_plan("sum_over_time(inc_m[60s])", BASE))
+        state = WindowState(spec)
+        ts = BASE + np.arange(50, dtype=np.int64) * 1000
+        _ingest(mapper, ms, "inc_m", [({"inst": "a"}, np.ones(50))], ts)
+        fetch = lambda f, s, e: ev.raw_series(f, s, e, 30_000)  # noqa: E731
+        state.tick(BASE + 50_000, fetch)
+        assert state.samples_consumed == 50
+        _ingest(mapper, ms, "inc_m", [({"inst": "a"}, np.ones(5))],
+                BASE + (51 + np.arange(5, dtype=np.int64)) * 1000,
+                offset=10)
+        state.tick(BASE + 56_000, fetch)
+        # the 50 already-buffered samples were NOT re-consumed
+        assert state.samples_consumed == 55
+        # eviction keeps the state bounded to ~the window
+        state.tick(BASE + 300_000, fetch)
+        assert state.resident_samples == 0
+
+
+# ---------------------------------------------------------------------------
+# HTTP API payloads over a live server
+# ---------------------------------------------------------------------------
+
+
+class TestRulesHttpApi:
+    def test_rules_alerts_admin_routes(self):
+        import urllib.request
+        from filodb_tpu.http.server import FiloHttpServer
+        mapper, ms, binding = _harness()
+        ts = BASE + np.arange(10, dtype=np.int64) * 1000
+        _ingest(mapper, ms, "gauge_x", [({"inst": "a"}, 9 * np.ones(10))],
+                ts)
+        pub = _CapturePublisher()
+        eng = _engine(binding, pub, {"groups": [{
+            "name": "api-g", "interval": "5s", "rules": [
+                {"record": "out:r", "expr": "sum_over_time(gauge_x[10s])"},
+                {"alert": "Hot", "expr": "gauge_x > 5", "for": "0s"}]}]})
+        eng.run_group_once("api-g", eval_ms=BASE + 10_000)
+        srv = FiloHttpServer(port=0)
+        srv.rules = eng
+        port = srv.start()
+        try:
+            def get(path):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                    return json.loads(r.read())
+            body = get("/api/v1/rules")
+            g = body["data"]["groups"][0]
+            assert g["name"] == "api-g"
+            kinds = {r["name"]: r for r in g["rules"]}
+            assert kinds["out:r"]["type"] == "recording"
+            assert kinds["out:r"]["health"] == "ok"
+            # the expr is served in its RENDERED canonical form
+            assert kinds["out:r"]["query"] == "sum_over_time(gauge_x[10s])"
+            assert kinds["Hot"]["state"] == "firing"
+            assert kinds["Hot"]["alerts"][0]["labels"]["alertname"] == "Hot"
+            body = get("/api/v1/alerts")
+            assert body["data"]["alerts"][0]["state"] == "firing"
+            body = get("/admin/rules")
+            row = body["data"]["groups"][0]
+            assert row["evals"] == 1 and row["missed"] == 0
+            assert row["incremental"][0]["rule"] == "out:r"
+            assert body["data"]["priority_class"] == "rules"
+        finally:
+            srv.shutdown()
+
+    def test_routes_empty_without_engine(self):
+        import urllib.error
+        import urllib.request
+        from filodb_tpu.http.server import FiloHttpServer
+        srv = FiloHttpServer(port=0)
+        port = srv.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/api/v1/rules",
+                    timeout=10) as r:
+                assert json.loads(r.read())["data"] == {"groups": []}
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/admin/rules", timeout=10)
+            assert ei.value.code == 404
+        finally:
+            srv.shutdown()
