@@ -6,19 +6,28 @@ a CLI (``python -m filodb_tpu.analysis`` / the ``lint`` CLI verb).
 
 Rule modules register themselves on import:
 
-- locks.py      — lock-discipline, blocking-under-lock
+- locks.py      — lock-discipline, blocking-under-lock (whole-program)
+- lockorder.py  — lock-order-cycle, lock-order-inversion (deadlocks)
+- device.py     — host-sync, host-sync-annotation, recompile-hazard,
+                  vmem-budget (the jit/Pallas device discipline)
 - lifecycle.py  — resource-lifecycle
-- sentinels.py  — the eight migrated legacy sentinel lints
+- sentinels.py  — the migrated legacy sentinel lints
 
-See doc/analysis.md for the catalog, the ``# guarded-by:`` annotation
-syntax, the suppression policy, and how to add a rule.
+callgraph.py builds the cross-module call graph the whole-program
+analyses share (once per run, via the Project.shared cache).
+
+See doc/analysis.md for the catalog, the ``# guarded-by:`` /
+``# lock-order:`` / ``# host-sync-ok:`` annotation syntax, the
+suppression policy, and how to add a rule.
 """
 
 from .engine import (  # noqa: F401
     META_RULES, RULES, Finding, Module, Project, Rule, rule,
-    load_modules, run_paths, run_project, run_source, unsuppressed,
+    load_modules, run_paths, run_project, run_source, run_sources,
+    unsuppressed,
 )
-from . import lifecycle, locks, sentinels  # noqa: F401,E402 — register rules
+from . import callgraph  # noqa: F401,E402 — whole-program call graph
+from . import device, lifecycle, lockorder, locks, sentinels  # noqa: F401,E402 — register rules
 from .report import (  # noqa: F401
-    render_json, render_rule_list, render_text, summarize,
+    render_github, render_json, render_rule_list, render_text, summarize,
 )

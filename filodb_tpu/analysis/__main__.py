@@ -1,18 +1,30 @@
-"""filolint CLI: ``python -m filodb_tpu.analysis [paths] [--json]``.
+"""filolint CLI: ``python -m filodb_tpu.analysis [paths] [options]``.
 
-Exit status 0 means zero unsuppressed findings; 1 means at least one
-(CI gates on this — tests/test_analysis.py runs it over the whole
-tree).  Also reachable as ``python -m filodb_tpu.cli lint``.
+Exit codes (also documented in doc/analysis.md):
+
+- ``0`` — zero unsuppressed findings (CI gates on this);
+- ``1`` — at least one unsuppressed finding;
+- ``2`` — usage error: unknown rule name, or a ``--changed`` ref git
+  cannot diff against.
+
+``--changed <ref>`` reports only findings in files the working tree
+changed vs ``ref`` — but the ANALYSIS still runs over the whole
+package, so cross-module results (blocking chains, lock order, jit
+tables) and stale-suppression verdicts are identical to a full run,
+just filtered.  Also reachable as ``python -m filodb_tpu.cli lint``
+(argv passes straight through — no hand-mirrored flags to drop).
 """
 
 from __future__ import annotations
 
 import argparse
 import pathlib
+import subprocess
 import sys
 
-from . import (RULES, Project, load_modules, render_json,
-               render_rule_list, render_text, run_project, unsuppressed)
+from . import (RULES, Project, device, load_modules, render_github,
+               render_json, render_rule_list, render_text, run_project,
+               unsuppressed)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -24,14 +36,63 @@ def build_parser() -> argparse.ArgumentParser:
                    help="files or directories (default: the filodb_tpu "
                         "package)")
     p.add_argument("--json", action="store_true",
-                   help="machine-readable report on stdout")
+                   help="machine-readable report (same as --format=json)")
+    p.add_argument("--format", choices=("text", "json", "github"),
+                   default=None,
+                   help="report format; 'github' prints ::error "
+                        "workflow annotations for CI logs")
+    p.add_argument("--changed", metavar="REF", default=None,
+                   help="report only findings in files changed vs REF "
+                        "(git diff + untracked); the analysis itself "
+                        "still sees the whole package")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule subset (default: all)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
     p.add_argument("--show-suppressed", action="store_true",
                    help="include suppressed findings in the text report")
+    p.add_argument("--vmem-budget-mib", type=float, default=None,
+                   help="vmem-budget rule budget in MiB (default 16, "
+                        "the per-core VMEM size)")
     return p
+
+
+def _changed_rels(root: pathlib.Path, ref: str):
+    """Paths changed vs ``ref`` (diff + untracked), RELATIVE TO
+    ``root`` so they compare against Finding.path — git reports diff
+    names relative to its toplevel, which need not be the package
+    root (monorepo layouts), so rebase through ``--show-prefix``.
+    Returns None when git cannot answer (bad ref, not a repo)."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--"],
+            cwd=root, capture_output=True, text=True)
+        if diff.returncode != 0:
+            print(f"--changed: git diff vs {ref!r} failed: "
+                  f"{diff.stderr.strip()}", file=sys.stderr)
+            return None
+        prefix = subprocess.run(
+            ["git", "rev-parse", "--show-prefix"],
+            cwd=root, capture_output=True, text=True).stdout.strip()
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True)
+    except FileNotFoundError:
+        print("--changed: git not available", file=sys.stderr)
+        return None
+    names = set()
+    for n in diff.stdout.splitlines():
+        # toplevel-relative -> root-relative; changes outside the
+        # package root's subtree are not lintable here
+        if prefix:
+            if n.startswith(prefix):
+                names.add(n[len(prefix):])
+        else:
+            names.add(n)
+    if untracked.returncode == 0:
+        # ls-files --others is cwd-relative, and cwd is already root
+        names |= set(untracked.stdout.splitlines())
+    return {n for n in names if n.endswith(".py")}
 
 
 def main(argv=None) -> int:
@@ -39,6 +100,9 @@ def main(argv=None) -> int:
     if args.list_rules:
         print(render_rule_list())
         return 0
+    fmt = args.format or ("json" if args.json else "text")
+    if args.vmem_budget_mib is not None:
+        device.VMEM_BUDGET_BYTES = int(args.vmem_budget_mib * 2 ** 20)
     paths = args.paths or [pathlib.Path(__file__).resolve().parents[1]]
     rules = None
     if args.rules:
@@ -50,10 +114,22 @@ def main(argv=None) -> int:
             return 2
     modules, root = load_modules(paths)
     findings = run_project(Project(modules, root), rules)
-    if args.json:
-        print(render_json(findings, files=len(modules)))
+    files = len(modules)
+    if args.changed is not None:
+        changed = _changed_rels(root, args.changed)
+        if changed is None:
+            return 2
+        # whole-program analysis, changed-subset REPORT: findings (and
+        # the stale-suppression meta verdicts, which are computed from
+        # the full run exactly like a --rules subset) filter by path
+        findings = [f for f in findings if f.path in changed]
+        files = len({m.rel for m in modules} & changed)
+    if fmt == "json":
+        print(render_json(findings, files=files))
+    elif fmt == "github":
+        print(render_github(findings, files=files))
     else:
-        print(render_text(findings, files=len(modules),
+        print(render_text(findings, files=files,
                           show_suppressed=args.show_suppressed))
     return 1 if unsuppressed(findings) else 0
 

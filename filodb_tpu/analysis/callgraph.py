@@ -1,0 +1,285 @@
+"""Whole-program call graph (ISSUE 10 tentpole pillar 1).
+
+PR 13's deepest analysis — blocking-under-lock — propagated only
+within a single module: a ``with self._lock:`` in gateway/server.py
+that reached a blocking helper in utils/observability.py two modules
+away was invisible (the exact cross-module shape of the PR 12
+ReplicaFanout wedge).  This module resolves calls ACROSS the
+``filodb_tpu`` package so the lock analyses (locks.py, lockorder.py)
+can run their fixpoints over the whole program:
+
+- ``import filodb_tpu.a.b as z`` / ``from filodb_tpu.a import b`` /
+  relative ``from .b import f`` all bind local names to project
+  modules or project functions;
+- ``self.x.m()`` resolves best-effort when ``self.x = SomeClass(...)``
+  in ``__init__`` and ``SomeClass`` is a project class;
+- ``SomeClass(...)`` resolves to ``SomeClass.__init__``.
+
+The graph is built ONCE per run and shared by every rule through
+``Project.shared`` (the per-run engine cache), keeping the full-tree
+run inside the tier-1 10s budget.
+
+Nothing here is a rule; the graph is analysis infrastructure.  A call
+that cannot be resolved contributes no edge — resolution is
+deliberately conservative so downstream rules stay false-positive-free
+rather than complete.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+# (module rel path, class name or "", function name)
+FuncKey = tuple
+
+#: Project.shared key under which the built graph lives for a run.
+CACHE_KEY = "callgraph"
+
+
+def _dotted(rel: str) -> str:
+    """filodb_tpu/utils/observability.py -> filodb_tpu.utils.observability
+    (packages: filodb_tpu/analysis/__init__.py -> filodb_tpu.analysis)."""
+    d = rel[:-3] if rel.endswith(".py") else rel
+    if d.endswith("/__init__"):
+        d = d[: -len("/__init__")]
+    return d.replace("/", ".")
+
+
+class CallGraph:
+    """Function index + resolved call edges over a Project."""
+
+    def __init__(self):
+        self.funcs: dict[FuncKey, ast.AST] = {}
+        self.classes: dict[tuple, ast.ClassDef] = {}   # (rel, name)
+        self.mod_aliases: dict[str, dict[str, str]] = {}    # rel -> {name: rel}
+        self.sym_aliases: dict[str, dict[str, tuple]] = {}  # rel -> {name: (rel, sym)}
+        self.attr_types: dict[tuple, dict[str, tuple]] = {} # (rel, cls) -> {attr: (rel, cls)}
+        self.var_types: dict[tuple, tuple] = {}   # (rel, module var) -> (rel, cls)
+        self.edges: dict[FuncKey, list] = {}   # key -> [(callee key, call node)]
+        self._by_dotted: dict[str, str] = {}
+
+    # -------------------------------------------------------------- resolution
+
+    def resolve_class(self, rel: str, expr) -> Optional[tuple]:
+        """A Name/Attribute that names a project class, or None."""
+        if isinstance(expr, ast.Name):
+            if (rel, expr.id) in self.classes:
+                return (rel, expr.id)
+            tgt = self.sym_aliases.get(rel, {}).get(expr.id)
+            if tgt is not None and tgt in self.classes:
+                return tgt
+        elif isinstance(expr, ast.Attribute) and isinstance(expr.value,
+                                                            ast.Name):
+            mod = self.mod_aliases.get(rel, {}).get(expr.value.id)
+            if mod is not None and (mod, expr.attr) in self.classes:
+                return (mod, expr.attr)
+        return None
+
+    def resolve_call(self, call: ast.Call, rel: str,
+                     cls: str = "") -> Optional[FuncKey]:
+        """Best-effort resolution of a call made from (rel, cls)."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            if (rel, "", f.id) in self.funcs:
+                return (rel, "", f.id)
+            tgt = self.sym_aliases.get(rel, {}).get(f.id)
+            if tgt is not None:
+                trel, tsym = tgt
+                if (trel, "", tsym) in self.funcs:
+                    return (trel, "", tsym)
+            ck = self.resolve_class(rel, f)
+            if ck is not None and (*ck, "__init__") in self.funcs:
+                return (*ck, "__init__")
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        v = f.value
+        if isinstance(v, ast.Name):
+            if v.id == "self" and cls:
+                if (rel, cls, f.attr) in self.funcs:
+                    return (rel, cls, f.attr)
+                return None
+            mod = self.mod_aliases.get(rel, {}).get(v.id)
+            if mod is not None:
+                if (mod, "", f.attr) in self.funcs:
+                    return (mod, "", f.attr)
+                if (mod, f.attr) in self.classes \
+                        and (mod, f.attr, "__init__") in self.funcs:
+                    return (mod, f.attr, "__init__")
+            ck = self.resolve_class(rel, v)   # SomeClass.method(...)
+            if ck is not None and (*ck, f.attr) in self.funcs:
+                return (*ck, f.attr)
+            owner = self.resolve_var(rel, v.id)   # LEDGER.track(...)
+            if owner is not None and (*owner, f.attr) in self.funcs:
+                return (*owner, f.attr)
+            return None
+        if isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name) \
+                and v.value.id == "self" and cls:
+            # self.x.m() where __init__ bound x to a known class
+            owner = self.attr_types.get((rel, cls), {}).get(v.attr)
+            if owner is not None and (*owner, f.attr) in self.funcs:
+                return (*owner, f.attr)
+        return None
+
+    def resolve_var(self, rel: str, name: str) -> Optional[tuple]:
+        """Class of a module-level singleton (``LEDGER = HbmLedger()``),
+        followed through from-imports (``from ..utils.devicewatch
+        import LEDGER``)."""
+        hit = self.var_types.get((rel, name))
+        if hit is not None:
+            return hit
+        tgt = self.sym_aliases.get(rel, {}).get(name)
+        return self.var_types.get(tgt) if tgt is not None else None
+
+    def callees(self, key: FuncKey) -> list:
+        return self.edges.get(key, [])
+
+
+def own_calls(fn) -> list:
+    """Call nodes in ``fn``'s body EXCLUDING nested def/lambda bodies —
+    deferred bodies run later (without locks, off this stack), so they
+    are separate call-graph nodes, not part of this one."""
+    stack = list(fn.body)
+    out = []
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Call):
+            out.append(n)
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            stack.append(c)
+    return out
+
+
+def _index_module(g: CallGraph, m) -> None:
+    rel = m.rel
+    g._by_dotted[_dotted(rel)] = rel
+    if m.tree is None:
+        return
+    for node in m.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            g.funcs[(rel, "", node.name)] = node
+        elif isinstance(node, ast.ClassDef):
+            g.classes[(rel, node.name)] = node
+            for meth in node.body:
+                if isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    g.funcs[(rel, node.name, meth.name)] = meth
+
+
+def _resolve_import_target(g: CallGraph, dotted: str) -> Optional[str]:
+    return g._by_dotted.get(dotted)
+
+
+def _scan_imports(g: CallGraph, m) -> None:
+    rel, tree = m.rel, m.tree
+    mods: dict[str, str] = {}
+    syms: dict[str, tuple] = {}
+    if tree is None:
+        g.mod_aliases[rel], g.sym_aliases[rel] = mods, syms
+        return
+    pkg_parts = _dotted(rel).split(".")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                tgt = _resolve_import_target(g, alias.name)
+                if tgt is None:
+                    continue
+                local = alias.asname or alias.name.split(".")[0]
+                if alias.asname is None and "." in alias.name:
+                    # ``import filodb_tpu.a.b`` binds ``filodb_tpu``;
+                    # chained-attribute call resolution is not attempted
+                    continue
+                mods[local] = tgt
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = pkg_parts[:-node.level]
+                if node.module:
+                    base = base + node.module.split(".")
+                src = ".".join(base)
+            else:
+                src = node.module or ""
+            src_rel = _resolve_import_target(g, src)
+            for alias in node.names:
+                local = alias.asname or alias.name
+                sub = _resolve_import_target(g, f"{src}.{alias.name}")
+                if sub is not None:           # from pkg import module
+                    mods[local] = sub
+                elif src_rel is not None:     # from module import symbol
+                    syms[local] = (src_rel, alias.name)
+    g.mod_aliases[rel], g.sym_aliases[rel] = mods, syms
+
+
+def _scan_attr_types(g: CallGraph, m) -> None:
+    """``self.x = SomeClass(...)`` in ``__init__`` types the attribute;
+    module-level ``LEDGER = HbmLedger()`` types the singleton."""
+    rel = m.rel
+    if m.tree is not None:
+        for st in m.tree.body:
+            if not (isinstance(st, ast.Assign)
+                    and isinstance(st.value, ast.Call)):
+                continue
+            owner = g.resolve_class(rel, st.value.func)
+            if owner is None:
+                continue
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    g.var_types[(rel, t.id)] = owner
+    for (crel, cname), cls in g.classes.items():
+        if crel != rel:
+            continue
+        init = g.funcs.get((rel, cname, "__init__"))
+        if init is None:
+            continue
+        types: dict[str, tuple] = {}
+        for st in ast.walk(init):
+            if not (isinstance(st, ast.Assign)
+                    and isinstance(st.value, ast.Call)):
+                continue
+            owner = g.resolve_class(rel, st.value.func)
+            if owner is None:
+                continue
+            for t in st.targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    types[t.attr] = owner
+        if types:
+            g.attr_types[(rel, cname)] = types
+
+
+def _scan_edges(g: CallGraph) -> None:
+    for key, fn in g.funcs.items():
+        rel, cls, _name = key
+        out = []
+        for call in own_calls(fn):
+            callee = g.resolve_call(call, rel, cls)
+            if callee is not None and callee != key:
+                out.append((callee, call))
+        if out:
+            g.edges[key] = out
+
+
+def build(project) -> CallGraph:
+    """Build (or fetch the per-run cached) whole-program call graph."""
+
+    def _build(p) -> CallGraph:
+        g = CallGraph()
+        for m in p.modules:
+            _index_module(g, m)
+        for m in p.modules:
+            _scan_imports(g, m)
+        for m in p.modules:
+            _scan_attr_types(g, m)
+        _scan_edges(g)
+        return g
+
+    shared = getattr(project, "shared", None)
+    if shared is None:
+        return _build(project)
+    return shared(CACHE_KEY, _build)
+
+

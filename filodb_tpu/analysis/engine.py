@@ -181,7 +181,12 @@ class Module:
 
 
 class Project:
-    """The whole analysis target: modules + cross-file context."""
+    """The whole analysis target: modules + cross-file context.
+
+    ``shared`` is the per-run engine cache: cross-file context that
+    more than one rule needs (the call graph, the jit entry-point
+    table, tests/doc text) is built ONCE per run and shared, so adding
+    a rule family never multiplies I/O or re-derivation."""
 
     def __init__(self, modules: list[Module], root: Optional[pathlib.Path] = None,
                  test_sources: Optional[list[str]] = None,
@@ -190,6 +195,14 @@ class Project:
         self.root = root
         self._test_sources = test_sources
         self._doc_text = doc_text
+        self._shared: dict = {}
+
+    def shared(self, key: str, build: Callable):
+        """Memoized per-run cross-file context: ``build(project)`` runs
+        at most once per key per run."""
+        if key not in self._shared:
+            self._shared[key] = build(self)
+        return self._shared[key]
 
     @property
     def test_sources(self) -> list[str]:
@@ -202,6 +215,7 @@ class Project:
             self._test_sources = out
         return self._test_sources
 
+
     @property
     def doc_text(self) -> str:
         """doc/observability.md (metric-doc drift needs it)."""
@@ -210,6 +224,10 @@ class Project:
             self._doc_text = p.read_text() if p is not None and p.exists() \
                 else ""
         return self._doc_text
+
+    @property
+    def doc_lines(self) -> list[str]:
+        return self.shared("doc_lines", lambda p: p.doc_text.splitlines())
 
 
 def _find_repo_root(path: pathlib.Path) -> pathlib.Path:
@@ -338,6 +356,17 @@ def run_source(src: str, rules: Optional[Iterable[str]] = None,
     """Lint one in-memory source string (rule self-tests)."""
     m = Module(rel, src)
     return run_project(Project([m], None, test_sources or [], doc_text),
+                       rules)
+
+
+def run_sources(srcs: dict, rules: Optional[Iterable[str]] = None,
+                test_sources: Optional[list[str]] = None,
+                doc_text: str = "") -> list[Finding]:
+    """Lint several in-memory modules TOGETHER ({rel: src}) — the
+    whole-program analyses (cross-module blocking, lock order) see the
+    combined project, exactly like a tree run over those files."""
+    modules = [Module(rel, src) for rel, src in srcs.items()]
+    return run_project(Project(modules, None, test_sources or [], doc_text),
                        rules)
 
 

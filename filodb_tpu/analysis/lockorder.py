@@ -1,0 +1,336 @@
+"""Lock-order deadlock detector (ISSUE 10 tentpole pillar 2).
+
+PR 13's lock analyses see missing locks; they cannot see deadlocks.
+This module builds the lock-ACQUISITION-ORDER graph: an edge A -> B
+means some code path acquires B while holding A, either lexically
+(nested ``with`` statements, including ``# holds-lock:`` / ``*_locked``
+entry states) or through the whole-program call graph (holding A and
+calling a function that — possibly transitively — takes B).  Two rules
+report on the graph:
+
+- ``lock-order-cycle``: a cycle A -> B -> ... -> A means two threads
+  walking the edges in different orders can deadlock; every cycle is
+  reported ONCE with the full acquisition chain of each edge.
+- ``lock-order-inversion``: an acquisition edge that contradicts a
+  declared ``# lock-order: <a> < <b>`` annotation (a before b), and
+  declarations that bind to no lock the analysis knows (a typo'd
+  annotation must not silently disarm the detector).
+
+Lock identity is class-qualified — ``memstore.shard.TimeSeriesShard.
+_dirty_lock`` — so same-named locks in different classes never collide.
+Locks taken through receivers the analysis cannot type (``other._lock``)
+contribute no edge: conservative, never false-positive.  The
+``threading.Condition(self._lock)`` alias and the ``*_locked`` naming
+convention are understood exactly as in locks.py.  Self-edges (re-
+acquiring the lock you hold) are out of scope here — that is a
+missing-``holds-lock`` bug, not an ordering bug.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from typing import Optional
+
+from . import callgraph
+from .engine import Finding, rule
+from .locks import (_LockWalker, _class_lock_keys, _lock_aliases,
+                    _method_held)
+
+_LOCK_ORDER_RE = re.compile(r"#\s*lock-order:\s*(.+?)\s*$")
+
+#: longest simple cycle the DFS enumerates; real deadlocks are almost
+#: always 2-cycles, and the bound keeps the tree run inside budget
+_MAX_CYCLE_LEN = 4
+
+
+def _mod_dots(rel: str) -> str:
+    d = rel[:-3] if rel.endswith(".py") else rel
+    if d.endswith("/__init__"):
+        d = d[: -len("/__init__")]
+    if d.startswith("filodb_tpu/"):
+        d = d[len("filodb_tpu/"):]
+    return d.replace("/", ".")
+
+
+class _Edge:
+    """One observed A-held-while-acquiring-B site with its chain."""
+    __slots__ = ("src", "dst", "rel", "line", "desc")
+
+    def __init__(self, src, dst, rel, line, desc):
+        self.src, self.dst = src, dst
+        self.rel, self.line, self.desc = rel, line, desc
+
+
+def _canon(raw: Optional[str], mod: str, cls: str,
+           aliases: dict, class_locks: frozenset) -> Optional[str]:
+    """Canonical project-wide lock key for a raw _lock_key string.
+
+    ``self._x`` -> ``<mod>.<cls>._x``; a bare module-level name ->
+    ``<mod>.<name>``; a bare ``holds-lock`` term naming one of the
+    class's own locks is class-qualified.  Unresolvable receivers
+    (``other._lock``) return None — no edge beats a wrong edge."""
+    if raw is None:
+        return None
+    raw = aliases.get(raw, raw)
+    if raw.startswith("self."):
+        return f"{mod}.{cls}.{raw[5:]}" if cls else None
+    if raw.startswith("?."):
+        return None
+    if "." in raw:          # some other receiver: cannot type it
+        return None
+    if cls and (f"self.{raw}" in class_locks
+                or aliases.get(f"self.{raw}") is not None):
+        return f"{mod}.{cls}.{raw}"
+    return f"{mod}.{raw}"
+
+
+def _decl_matches(decl: str, key: str) -> bool:
+    """Does declaration name ``decl`` (terminal or dotted suffix) name
+    canonical lock ``key``?"""
+    return key == decl or key.endswith("." + decl)
+
+
+def _lock_order_decls(module) -> list:
+    """(line, [names...]) for each ``# lock-order: a < b [< c]`` comment
+    (real COMMENT tokens only, same discipline as suppressions)."""
+    if "lock-order" not in module.src:
+        return []
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(module.src).readline)
+        comments = [(t.start[0], t.string) for t in toks
+                    if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return []
+    out = []
+    for line, text in comments:
+        m = _LOCK_ORDER_RE.search(text)
+        if m is None:
+            continue
+        names = [n.strip() for n in m.group(1).split("<")]
+        out.append((line, names))
+    return out
+
+
+def _build_graph(project) -> tuple:
+    """(edges {(src,dst): _Edge}, all_lock_keys set) over the project."""
+
+    def _build(p):
+        graph = callgraph.build(p)
+        mods = {m.rel: m for m in p.modules}
+
+        # pass 1: per-function direct acquisitions + call sites under
+        # held locks, collected with one _LockWalker walk per method
+        direct: dict = {}        # FuncKey -> {lock: (rel, line)}
+        call_sites: list = []    # (caller key, call node, held canon set)
+        edges: dict = {}         # (src, dst) -> _Edge (first site wins)
+        all_keys: set = set()
+
+        def add_edge(src, dst, rel, line, desc):
+            if src == dst:
+                return
+            all_keys.update((src, dst))
+            if (src, dst) not in edges:
+                edges[(src, dst)] = _Edge(src, dst, rel, line, desc)
+
+        for m in p.modules:
+            if m.tree is None:
+                continue
+            mod = _mod_dots(m.rel)
+            for node in m.tree.body:
+                items = [("", node)] if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)) else \
+                    [(node.name, f) for f in node.body
+                     if isinstance(f, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))] \
+                    if isinstance(node, ast.ClassDef) else []
+                if isinstance(node, ast.ClassDef):
+                    aliases = _lock_aliases(node)
+                    class_locks = _class_lock_keys(node)
+                else:
+                    aliases, class_locks = {}, frozenset()
+                for cls, fn in items:
+                    key = (m.rel, cls, fn.name)
+                    acquired = direct.setdefault(key, {})
+                    held0 = _method_held(fn, m.lines)
+                    if cls and fn.name.endswith("_locked"):
+                        held0 = held0 | class_locks
+
+                    def canon(raw, _c=cls):
+                        return _canon(raw, mod, _c, aliases, class_locks)
+
+                    def on_lock(raw, held_before, method, line,
+                                _key=key, _m=m):
+                        k = canon(raw)
+                        if k is None:
+                            return
+                        acq = direct[_key]
+                        if k not in acq:
+                            acq[k] = (_m.rel, line)
+                        for h_raw in held_before:
+                            h = canon(h_raw)
+                            if h is not None:
+                                add_edge(
+                                    h, k, _m.rel, line,
+                                    f"{_disp_fn(_key)} takes {h} then "
+                                    f"{k} at {_m.rel}:{line}")
+
+                    def on_call(call, held, method, _key=key):
+                        if held:
+                            hs = {c for c in (canon(h) for h in held)
+                                  if c is not None}
+                            if hs:
+                                call_sites.append((_key, call, hs))
+
+                    w = _LockWalker(on_call=on_call, on_lock=on_lock)
+                    w.walk_method(fn, frozenset(held0))
+
+        # pass 2: propagate "locks this function acquires" to a
+        # fixpoint over the whole-program call graph, keeping one
+        # representative chain per (function, lock)
+        summary: dict = {k: {lk: (site, [k])
+                             for lk, site in v.items()}
+                         for k, v in direct.items() if v}
+        changed = True
+        while changed:
+            changed = False
+            for key, callees in graph.edges.items():
+                mine = summary.setdefault(key, {})
+                for callee, _call in callees:
+                    for lk, (site, chain) in summary.get(callee,
+                                                         {}).items():
+                        if lk not in mine:
+                            mine[lk] = (site, [key] + chain)
+                            changed = True
+
+        # pass 3: call sites under held locks inherit the callee's
+        # acquisitions as ordering edges
+        for caller, call, held in call_sites:
+            callee = graph.resolve_call(call, caller[0], caller[1])
+            if callee is None:
+                continue
+            for lk, ((srel, sline), chain) in summary.get(callee,
+                                                          {}).items():
+                for h in held:
+                    add_edge(
+                        h, lk, caller[0], call.lineno,
+                        f"{_disp_fn(caller)} holds {h} and calls "
+                        f"{' -> '.join(_disp_fn(c) for c in chain)} "
+                        f"which takes {lk} at {srel}:{sline}")
+        # every acquired lock is declarable, not just the ones that
+        # appear on ordering edges — a PROACTIVE lock-order declaration
+        # over two never-yet-nested locks must not read as dangling
+        for acq in direct.values():
+            all_keys.update(acq.keys())
+        return edges, all_keys
+
+    shared = getattr(project, "shared", None)
+    return _build(project) if shared is None \
+        else shared("lockorder_graph", _build)
+
+
+def _disp_fn(key) -> str:
+    rel, cls, name = key
+    stem = rel.rsplit("/", 1)[-1]
+    stem = stem[:-3] if stem.endswith(".py") else stem
+    return f"{stem}.{cls}.{name}" if cls else f"{stem}.{name}"
+
+
+def _simple_cycles(edges: dict) -> list:
+    """Simple cycles up to _MAX_CYCLE_LEN, each reported once (the
+    lexicographically smallest lock key is the canonical start)."""
+    adj: dict = {}
+    for (src, dst) in edges:
+        adj.setdefault(src, set()).add(dst)
+    cycles = []
+    for start in sorted(adj):
+        stack = [(start, (start,))]
+        while stack:
+            cur, path = stack.pop()
+            for nxt in sorted(adj.get(cur, ())):
+                if nxt == start and len(path) > 1:
+                    cycles.append(list(path))
+                elif nxt > start and nxt not in path \
+                        and len(path) < _MAX_CYCLE_LEN:
+                    stack.append((nxt, path + (nxt,)))
+    return cycles
+
+
+@rule("lock-order-cycle", scope="project",
+      doc="cyclic lock-acquisition orders (deadlock)")
+def lock_order_cycle(project):
+    edges, _keys = _build_graph(project)
+    inverted = _inverted_edges(project, edges)
+    findings = []
+    for cyc in _simple_cycles(edges):
+        cyc_edges = [edges[(cyc[i], cyc[(i + 1) % len(cyc)])]
+                     for i in range(len(cyc))]
+        if any((e.src, e.dst) in inverted for e in cyc_edges):
+            continue           # the inversion finding already covers it
+        site = min(cyc_edges, key=lambda e: (e.rel, e.line))
+        ring = " -> ".join([*cyc, cyc[0]])
+        chains = "; ".join(f"({i + 1}) {e.desc}"
+                           for i, e in enumerate(cyc_edges))
+        findings.append(Finding(
+            "lock-order-cycle", site.rel, site.line,
+            f"lock-order cycle {ring}: two threads taking these locks "
+            f"in opposite orders deadlock. {chains}. Acquire in ONE "
+            f"order everywhere, or declare the intended order with "
+            f"'# lock-order: {cyc[0]} < {cyc[1]}' and fix the "
+            f"violating side"))
+    return findings
+
+
+def _inverted_edges(project, edges) -> dict:
+    """{(src,dst): (decl_line_info)} for edges contradicting a declared
+    ordering (computed once, shared by both rules)."""
+
+    def _build(p):
+        out = {}
+        for m in p.modules:
+            for line, names in _lock_order_decls(m):
+                for a, b in zip(names, names[1:]):
+                    for (src, dst) in edges:
+                        if _decl_matches(b, src) and _decl_matches(a, dst):
+                            out[(src, dst)] = (m.rel, line, a, b)
+        return out
+
+    shared = getattr(project, "shared", None)
+    return _build(project) if shared is None \
+        else shared("lockorder_inversions", _build)
+
+
+@rule("lock-order-inversion", scope="project",
+      doc="acquisitions contradicting a declared # lock-order:")
+def lock_order_inversion(project):
+    edges, all_keys = _build_graph(project)
+    findings = []
+    for (src, dst), (drel, dline, a, b) in sorted(
+            _inverted_edges(project, edges).items()):
+        e = edges[(src, dst)]
+        findings.append(Finding(
+            "lock-order-inversion", e.rel, e.line,
+            f"acquires {dst} while holding {src}, but {drel}:{dline} "
+            f"declares '# lock-order: {a} < {b}': {e.desc}. Reorder "
+            f"the acquisitions (or fix the declaration)"))
+    # a declaration naming no known lock is itself an error — a typo'd
+    # annotation must not silently disarm the detector
+    for m in project.modules:
+        for line, names in _lock_order_decls(m):
+            if len(names) < 2 or any(not n for n in names):
+                findings.append(Finding(
+                    "lock-order-inversion", m.rel, line,
+                    "unparseable '# lock-order:' — expected "
+                    "'# lock-order: <a> < <b>'"))
+                continue
+            for n in names:
+                if not any(_decl_matches(n, k) for k in all_keys):
+                    findings.append(Finding(
+                        "lock-order-inversion", m.rel, line,
+                        f"'# lock-order:' names {n!r}, which matches no "
+                        f"lock the analysis ever sees acquired — the "
+                        f"declaration binds to nothing and orders "
+                        f"nothing"))
+    return findings

@@ -14,10 +14,12 @@ Two rules built on one held-lock AST walk:
 - ``blocking-under-lock``: no blocking call — network I/O
   (``urlopen``/peer POST), ``Future.result``/``Thread.join`` waits,
   ``sleep``, subprocess spawns, host→device transfers — may execute
-  while a lock is held, directly or through a same-module helper (the
-  call graph is propagated to a fixpoint within the module).  This is
-  the ReplicaFanout wedge lesson: one blocking peer POST under a held
-  lock converted one slow node into a cluster-wide ingest stall.
+  while a lock is held, directly or through ANY reachable helper: the
+  fixpoint runs over the whole-program call graph (callgraph.py), so a
+  ``with self._lock:`` in gateway/server.py that reaches a blocking
+  helper in utils/observability.py two modules away fires too.  This
+  is the ReplicaFanout wedge lesson: one blocking peer POST under a
+  held lock converted one slow node into a cluster-wide ingest stall.
 
 Annotations:
 
@@ -37,6 +39,7 @@ import ast
 import re
 from typing import Optional
 
+from . import callgraph
 from .engine import Finding, rule
 
 _GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
@@ -102,12 +105,16 @@ class _Access:
 
 class _LockWalker:
     """Statement walker threading the set of held lock keys; invokes
-    ``on_call(call, held)`` for every Call and ``on_access`` for every
-    ``self.<attr>`` touch (lock-discipline only sets the latter)."""
+    ``on_call(call, held)`` for every Call, ``on_access`` for every
+    ``self.<attr>`` touch (lock-discipline only sets the latter), and
+    ``on_lock(key, held_before, line)`` whenever a ``with`` statement
+    acquires a lock (lockorder.py builds its acquisition graph from
+    these events)."""
 
-    def __init__(self, on_call=None, on_access=None):
+    def __init__(self, on_call=None, on_access=None, on_lock=None):
         self.on_call = on_call
         self.on_access = on_access
+        self.on_lock = on_lock
         self._method = ""
 
     def walk_method(self, fn, initial_held=frozenset()):
@@ -127,6 +134,9 @@ class _LockWalker:
                 self._expr(item.context_expr, held)
                 k = _lock_key(item.context_expr)
                 if k is not None:
+                    if self.on_lock is not None:
+                        self.on_lock(k, frozenset(new), self._method,
+                                     item.context_expr.lineno)
                     new.add(k)
                 if item.optional_vars is not None:
                     self._writes(item.optional_vars, held)
@@ -444,117 +454,105 @@ def direct_blocking(call) -> Optional[str]:
     return None
 
 
-def _blocking_table(tree) -> dict:
-    """{(class_or_'', fn_name): (reason, chain)} fixpoint over the
-    module's call graph so a lock-holding call into a local helper that
-    blocks two hops down is still caught."""
-    funcs: dict[tuple, ast.AST] = {}
-    for node in tree.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            funcs[("", node.name)] = node
-        elif isinstance(node, ast.ClassDef):
-            for m in node.body:
-                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    funcs[(node.name, m.name)] = m
+def _hop_disp(key, from_rel: str) -> str:
+    """Chain-hop display: bare name within one module, module-qualified
+    (``observability.http_container_push``) when the chain crosses."""
+    rel, _cls, name = key
+    if rel == from_rel:
+        return name
+    stem = rel.rsplit("/", 1)[-1]
+    return f"{stem[:-3] if stem.endswith('.py') else stem}.{name}"
 
-    def own_calls(fn):
-        """Call nodes of fn's body excluding nested function bodies."""
-        stack = list(fn.body)
-        out = []
-        while stack:
-            n = stack.pop()
-            if isinstance(n, ast.Call):
-                out.append(n)
-            for c in ast.iter_child_nodes(n):
-                if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.Lambda)):
-                    continue
-                stack.append(c)
-        return out
 
-    blocking: dict[tuple, tuple] = {}
-    edges: dict[tuple, set] = {k: set() for k in funcs}
-    for (cname, fname), fn in funcs.items():
-        key = (cname, fname)
-        for n in own_calls(fn):
-            if key not in blocking:
-                why = direct_blocking(n)
+def blocking_chains(project) -> dict:
+    """{FuncKey: (reason, [FuncKey chain])} — the blocking fixpoint over
+    the WHOLE-program call graph (callgraph.py), so a ``with`` in one
+    module that reaches a blocking helper two modules away still fires.
+    Chains are kept as key lists and rendered relative to the module
+    where the lock is taken."""
+
+    def _build(p):
+        graph = callgraph.build(p)
+        table: dict = {}
+        for key, fn in graph.funcs.items():
+            for call in callgraph.own_calls(fn):
+                why = direct_blocking(call)
                 if why is not None:
-                    blocking[key] = (why, fname)
-            f = n.func
-            if isinstance(f, ast.Name) and ("", f.id) in funcs:
-                edges[key].add(("", f.id))
-            elif isinstance(f, ast.Attribute) \
-                    and isinstance(f.value, ast.Name) \
-                    and f.value.id == "self" and (cname, f.attr) in funcs:
-                edges[key].add((cname, f.attr))
-
-    changed = True
-    while changed:
-        changed = False
-        for key, callees in edges.items():
-            if key in blocking:
-                continue
-            for c in callees:
-                if c in blocking:
-                    why, chain = blocking[c]
-                    blocking[key] = (why, f"{key[1]} -> {chain}")
-                    changed = True
+                    table[key] = (why, [key])
                     break
-    return blocking
+        changed = True
+        while changed:
+            changed = False
+            for key, callees in graph.edges.items():
+                if key in table:
+                    continue
+                for callee, _call in callees:
+                    if callee in table:
+                        why, chain = table[callee]
+                        table[key] = (why, [key] + chain)
+                        changed = True
+                        break
+        return table
+
+    shared = getattr(project, "shared", None)
+    return _build(project) if shared is None \
+        else shared("blocking_chains", _build)
 
 
-@rule("blocking-under-lock",
+@rule("blocking-under-lock", scope="project",
       doc="blocking calls executed while a lock is held")
-def blocking_under_lock(module):
+def blocking_under_lock(project):
     findings = []
-    table = _blocking_table(module.tree)
-    seen = set()
+    graph = callgraph.build(project)
+    table = blocking_chains(project)
 
-    def check(call, held, method, cls_name):
-        if not held:
-            return
-        why = direct_blocking(call)
-        chain = None
-        if why is None:
-            f = call.func
-            key = None
-            if isinstance(f, ast.Name):
-                key = ("", f.id)
-            elif isinstance(f, ast.Attribute) \
-                    and isinstance(f.value, ast.Name) \
-                    and f.value.id == "self":
-                key = (cls_name, f.attr)
-            if key in table:
-                why, chain = table[key]
-        if why is None:
-            return
-        if call.lineno in seen:
-            return
-        seen.add(call.lineno)
-        locks = ", ".join(sorted(held))
-        via = f" (via {chain})" if chain and chain != method else ""
-        findings.append(Finding(
-            "blocking-under-lock", module.rel, call.lineno,
-            f"{why}{via} while holding {locks} — one slow peer/device "
-            f"turns every thread contending this lock into a convoy "
-            f"(the ReplicaFanout ingest-stall shape); move the call "
-            f"outside the critical section"))
+    def check_module(module):
+        seen = set()
 
-    def walk_container(body, cls_name):
-        for fn in body:
-            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                w = _LockWalker(on_call=lambda c, h, m, _cn=cls_name:
-                                check(c, h, m, _cn))
-                # held starts empty even for # holds-lock / *_locked
-                # methods: blocking is attributed to the statement that
-                # lexically TAKES the lock (the propagated call graph
-                # already reaches these helpers from there), so each
-                # convoy is reported once, not once per call-chain hop
-                w.walk_method(fn, frozenset())
+        def check(call, held, method, cls_name):
+            if not held:
+                return
+            why = direct_blocking(call)
+            chain = None
+            if why is None:
+                key = graph.resolve_call(call, module.rel, cls_name)
+                if key is not None and key in table:
+                    why, keys = table[key]
+                    chain = " -> ".join(_hop_disp(k, module.rel)
+                                        for k in keys)
+            if why is None:
+                return
+            if call.lineno in seen:
+                return
+            seen.add(call.lineno)
+            locks = ", ".join(sorted(held))
+            via = f" (via {chain})" if chain and chain != method else ""
+            findings.append(Finding(
+                "blocking-under-lock", module.rel, call.lineno,
+                f"{why}{via} while holding {locks} — one slow peer/"
+                f"device turns every thread contending this lock into "
+                f"a convoy (the ReplicaFanout ingest-stall shape); "
+                f"move the call outside the critical section"))
 
-    walk_container(module.tree.body, "")
-    for node in module.tree.body:
-        if isinstance(node, ast.ClassDef):
-            walk_container(node.body, node.name)
+        def walk_container(body, cls_name):
+            for fn in body:
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    w = _LockWalker(on_call=lambda c, h, m, _cn=cls_name:
+                                    check(c, h, m, _cn))
+                    # held starts empty even for # holds-lock / *_locked
+                    # methods: blocking is attributed to the statement
+                    # that lexically TAKES the lock (the propagated call
+                    # graph already reaches these helpers from there),
+                    # so each convoy is reported once, not once per
+                    # call-chain hop
+                    w.walk_method(fn, frozenset())
+
+        walk_container(module.tree.body, "")
+        for node in module.tree.body:
+            if isinstance(node, ast.ClassDef):
+                walk_container(node.body, node.name)
+
+    for module in project.modules:
+        if module.tree is not None:
+            check_module(module)
     return findings

@@ -48,6 +48,28 @@ def render_text(findings: Iterable[Finding], files: int = 0,
     return "\n".join(lines)
 
 
+def _gh_escape(s: str) -> str:
+    """GitHub workflow-command data escaping (%, CR, LF)."""
+    return s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+
+
+def render_github(findings: Iterable[Finding], files: int = 0) -> str:
+    """One ``::error`` workflow annotation per unsuppressed finding —
+    CI logs render these inline on the PR diff."""
+    findings = list(findings)
+    lines = []
+    for f in findings:
+        if f.suppressed:
+            continue
+        lines.append(f"::error file={f.path},line={f.line},"
+                     f"title=filolint[{f.rule}]::{_gh_escape(f.message)}")
+    s = summarize(findings, files)
+    lines.append(f"::notice::filolint: {s['findings']} finding(s), "
+                 f"{s['suppressed']} suppressed, {files} file(s), "
+                 f"{s['rules']} rule(s)")
+    return "\n".join(lines)
+
+
 def render_rule_list() -> str:
     lines = []
     for name in sorted(RULES):

@@ -483,10 +483,13 @@ def kernel_entry_points(project) -> list[tuple[str, str, int]]:
       doc="Pallas kernel entry points with no interpret-mode test")
 def interpret_coverage(project):
     findings = []
-    srcs = project.test_sources
+    # per-run shared cache: only test files that run interpret mode at
+    # all are candidates, computed once instead of per entry point
+    srcs = project.shared(
+        "interpret_test_sources",
+        lambda p: [s for s in p.test_sources if "interpret=True" in s])
     for rel, fn, line in kernel_entry_points(project):
-        covered = any(fn + "(" in src and "interpret=True" in src
-                      for src in srcs)
+        covered = any(fn + "(" in src for src in srcs)
         if not covered:
             findings.append(Finding(
                 "interpret-coverage", rel, line,
@@ -542,7 +545,7 @@ def metric_documented(name: str, doc_text: str, doc_lines) -> bool:
       doc="registered filodb_* metrics missing from doc/observability.md")
 def metric_doc(project):
     doc_text = project.doc_text
-    doc_lines = doc_text.splitlines()
+    doc_lines = project.doc_lines   # split once per run (shared cache)
     findings = []
     for name, (rel, line) in sorted(registered_metric_names(project).items()):
         if not metric_documented(name, doc_text, doc_lines):

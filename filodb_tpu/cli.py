@@ -384,12 +384,16 @@ def build_parser() -> argparse.ArgumentParser:
 
     lt = sub.add_parser("lint", add_help=False,
                         help="filolint static analysis: lock-discipline "
-                             "races, blocking-under-lock, resource "
+                             "races, blocking-under-lock, lock-order "
+                             "deadlocks, device discipline, resource "
                              "lifecycle + the sentinel lints")
     lt.add_argument("args", nargs=argparse.REMAINDER,
-                    help="passed through to python -m filodb_tpu.analysis "
-                         "(--json, --rules, --list-rules, "
-                         "--show-suppressed, paths)")
+                    help="passed through VERBATIM to python -m "
+                         "filodb_tpu.analysis (--changed REF, --format, "
+                         "--json, --rules, --list-rules, "
+                         "--show-suppressed, --vmem-budget-mib, paths) "
+                         "— no flags are hand-mirrored here, so new "
+                         "analysis options never silently drop")
     lt.set_defaults(fn=cmd_lint)
 
     pk = sub.add_parser("partkey", help="decode a hex partkey")

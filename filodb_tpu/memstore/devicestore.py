@@ -741,19 +741,19 @@ class DeviceGridCache:
                 garr, plan.phase, q=plan.q, lanes=plan.lane_mult,
                 nrows=plan.nrows, num_groups=num_groups * stride, op=op)
         if self.hist:
-            both = np.asarray(out, dtype=np.float64)    # [2, G*hb, T]
+            both = np.asarray(out, dtype=np.float64)    # [2, G*hb, T]  # host-sync-ok: hist planes [2, G*hb, T] — the one designed readback of the fused reduce
             return hist_state_from_planes(both, num_groups, stride, tops)
         if op in ("sum", "avg", "count", "moments"):
             # ONE host readback of the stacked [2|3, G, T]: each blocked
             # transfer pays the tunnel round-trip
-            both = np.asarray(out, dtype=np.float64)
+            both = np.asarray(out, dtype=np.float64)  # host-sync-ok: ONE blocked readback of the stacked [2|3, G, T] partials (comment above)
             if op == "count":
                 return {"count": both[1]}
             if op == "moments":
                 return {"sum": both[0], "count": both[1],
                         "sumsq": both[2]}
             return {"sum": both[0], "count": both[1]}
-        return {op: np.asarray(out, dtype=np.float64)}
+        return {op: np.asarray(out, dtype=np.float64)}  # host-sync-ok: single designed readback of the [G, T] reduced partial
 
     def mesh_plan(self, part_ids: Sequence[int], func: F, steps0: int,
                   nsteps: int, step_ms: int, window_ms: int,
@@ -851,7 +851,7 @@ class DeviceGridCache:
                 plan.ts_parts, plan.val_parts, plan.row0, plan.steps0_rel,
                 plan.phase, q=plan.q, lanes=plan.lane_mult,
                 nrows=plan.nrows)
-        out_np = np.asarray(stepped)
+        out_np = np.asarray(stepped)  # host-sync-ok: the designed stepped readback — only [T, lanes] crosses the host link
         if self.hist:
             cols = lanes_req[:, None] * self.hb + np.arange(self.hb)[None, :]
             return out_np[:, cols].transpose(1, 0, 2)     # [S_req, T, hb]

@@ -302,6 +302,8 @@ class OnDemandPagingShard(TimeSeriesShard):
                              lambda: paged.max_bytes)
         # serializes page-in / backfill store reads across query threads so
         # concurrent misses for the same partition don't duplicate work
+        # (outermost in the paging hierarchy, enforced by filolint):
+        # lock-order: _odp_lock < _PagedPartitions._lock
         self._odp_lock = threading.Lock()
         # partitions pinned by an in-flight scan on THIS thread: strong
         # references so mid-query LRU eviction cannot drop them from results

@@ -72,7 +72,9 @@ class TimeSeriesPartition:
         self._pending: list[PendingBuffer] = []
         self._lock = threading.Lock()
         # serializes whole drain_pending runs (ingest thread's buffer-full
-        # encode vs a flush-executor encode of the same partition)
+        # encode vs a flush-executor encode of the same partition); taken
+        # OUTSIDE the buffer lock (enforced by filolint):
+        # lock-order: _encode_lock < TimeSeriesPartition._lock
         self._encode_lock = threading.Lock()
         self.out_of_order_dropped = 0
         # shard hook observing chunk freezes (device grid invalidation)

@@ -492,7 +492,7 @@ class MeshEngine:
         prog = _build_program(self._key, range_fn, agg_op, num_groups,
                               window_ms, wmax, extra_args)
         out = prog(d_ts, d_vals, d_ids, d_steps)
-        return np.asarray(out)[:, :T]
+        return np.asarray(out)[:, :T]  # host-sync-ok: end of the SPMD pipeline: the [G, T] aggregate lands on host for serving
 
     def window_aggregate_partials(self, shard_batches, group_ids,
                                   num_groups: int, srange: StepRange,
@@ -536,8 +536,8 @@ class MeshEngine:
                                    window_ms, wmax, extra_args, int(k),
                                    bool(bottom))
         v, si = prog(d_ts, d_vals, d_ids, d_steps)
-        return (np.asarray(v)[..., :T],
-                np.asarray(si).astype(np.int32)[..., :T], layout)
+        return (np.asarray(v)[..., :T],  # host-sync-ok: topk partial values land on host for cross-shard merge
+                np.asarray(si).astype(np.int32)[..., :T], layout)  # host-sync-ok: topk partial indices ride back with the values
 
     def window_quantile_partials(self, shard_batches, group_ids,
                                  num_groups: int, srange: StepRange,
@@ -553,7 +553,7 @@ class MeshEngine:
                                        window_ms, wmax, extra_args,
                                        compression)
         m, w = prog(d_ts, d_vals, d_ids, d_steps)
-        return np.asarray(m)[:, :T], np.asarray(w)[:, :T]
+        return np.asarray(m)[:, :T], np.asarray(w)[:, :T]  # host-sync-ok: t-digest partials (means+weights) land on host for merge
 
     def window_values(self, shard_batches, srange: StepRange,
                       window_ms: int, range_fn=None,
@@ -567,7 +567,7 @@ class MeshEngine:
         prog = _build_values_program(self._key, range_fn, window_ms,
                                      wmax, extra_args)
         out = prog(d_ts, d_vals, d_steps)
-        return np.asarray(out)[:, :T], layout
+        return np.asarray(out)[:, :T], layout  # host-sync-ok: stepped readback — count_values builds its state host-side
 
     def window_hist_partials(self, shard_batches, group_ids,
                              num_groups: int, srange: StepRange,
@@ -592,8 +592,8 @@ class MeshEngine:
         prog = _build_hist_program(self._key, range_fn, num_groups,
                                    window_ms)
         hs, n = prog(d_ts, d_hist, d_ids, d_steps)
-        return ({"hist_sum": np.asarray(hs)[:, :T],
-                 "count": np.asarray(n)[:, :T]},
+        return ({"hist_sum": np.asarray(hs)[:, :T],  # host-sync-ok: hist partial readback for MomentAggregator merge
+                 "count": np.asarray(n)[:, :T]},  # host-sync-ok: hist count plane rides back with the sums
                 np.asarray(tops) if tops is not None else None)
 
 

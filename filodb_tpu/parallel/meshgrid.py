@@ -592,8 +592,8 @@ def serve_grid_mesh(engine, plans: Sequence, num_groups: int,
         pos = {id(p): i for i, p in enumerate(plans)}
         slots = tuple(pos.get(id(lst[kk]), -1) if kk < len(lst) else -1
                       for lst in by_dev for kk in range(ksub))
-        return {"values": np.asarray(v, dtype=np.float64),
-                "sidx": np.asarray(si, dtype=np.int64),
+        return {"values": np.asarray(v, dtype=np.float64),  # host-sync-ok: topk partial values land on host for cross-shard merge
+                "sidx": np.asarray(si, dtype=np.int64),  # host-sync-ok: topk partial indices ride back with the values
                 "_slots": slots, "_lmax": lmax}
     if op == "quantile":
         # same compression as the host QuantileAggregator: mesh and host
@@ -604,8 +604,8 @@ def serve_grid_mesh(engine, plans: Sequence, num_groups: int,
                                            QuantileAggregator.compression)
         m, w = prog(g_ts, g_vals, g_ph, g_s0, g_garr)
         STATS["serves"] += 1
-        return {"td_means": np.asarray(m, dtype=np.float64),
-                "td_weights": np.asarray(w, dtype=np.float64)}
+        return {"td_means": np.asarray(m, dtype=np.float64),  # host-sync-ok: t-digest means partial lands on host for merge
+                "td_weights": np.asarray(w, dtype=np.float64)}  # host-sync-ok: t-digest weights partial lands on host for merge
     if op == "values":
         from filodb_tpu.query.aggregators import count_values_state
         prog = _grid_mesh_values_program(engine._key, q, mode, ksub,
@@ -614,7 +614,7 @@ def serve_grid_mesh(engine, plans: Sequence, num_groups: int,
         STATS["serves"] += 1
         # only the [lanes, T] stepped matrix crosses the host link — the
         # raw [nrows, lanes] residents never re-upload or read back
-        stepped = np.asarray(out, dtype=np.float64)    # [Kp, lmax, T]
+        stepped = np.asarray(out, dtype=np.float64)    # [Kp, lmax, T]  # host-sync-ok: only the [lanes, T] stepped matrix crosses the host link (comment below)
         garr_all = np.full((Kp, lmax), -1, np.int32)
         for d, lst in enumerate(by_dev):
             for kk, p in enumerate(lst):
@@ -631,15 +631,15 @@ def serve_grid_mesh(engine, plans: Sequence, num_groups: int,
     if stride > 1:
         # histogram: [2, G*hb, T] -> the MomentAggregator hist state
         from filodb_tpu.memstore.devicestore import hist_state_from_planes
-        both = np.asarray(out, dtype=np.float64)
+        both = np.asarray(out, dtype=np.float64)  # host-sync-ok: hist planes [2, G*hb, T] — the designed readback for hist state
         return hist_state_from_planes(both, num_groups, stride,
                                       np.asarray(plans[0].bucket_tops))
     if op in ("sum", "avg", "count", "moments"):
-        both = np.asarray(out, dtype=np.float64)       # [2|3, G, T]
+        both = np.asarray(out, dtype=np.float64)       # [2|3, G, T]  # host-sync-ok: ONE readback of the stacked [2|3, G, T] partials
         if op == "count":
             return {"count": both[1]}
         if op == "moments":
             return {"sum": both[0], "count": both[1], "sumsq": both[2]}
         return {"sum": both[0], "count": both[1]}
-    a = np.asarray(out, dtype=np.float64)
+    a = np.asarray(out, dtype=np.float64)  # host-sync-ok: single readback of the [G, T] reduced partial
     return {op: np.where(np.isfinite(a), a, np.nan)}

@@ -128,6 +128,11 @@ class HbmLedger:
     the HBM delta a query caused."""
 
     def __init__(self) -> None:
+        # the ledger sits between the device caches and the metrics
+        # registry in the repo's lock hierarchy (filolint lockorder.py
+        # holds these; a future back-edge is a build failure):
+        # lock-order: DeviceGridCache._lock < HbmLedger._lock
+        # lock-order: HbmLedger._lock < MetricsRegistry._lock
         self._lock = threading.Lock()
         # (owner, fmt) -> live bytes / high watermark / live array count
         self._bytes: dict[tuple, int] = {}

@@ -253,6 +253,122 @@ RULE_CASES = [
      "    def close(self):\n"
      "        registry.gauge('x').remove(shard=1)\n",
      "Gauge.remove", {}),
+    # --- ISSUE 10: lock order + device discipline ---
+    ("lock-order-cycle",
+     # the shard/index AB/BA shape: freeze takes shard->index while
+     # evict takes index->shard — two threads deadlock
+     "class TimeSeriesShard:\n"
+     "    def freeze(self):\n"
+     "        with self._shard_lock:\n"
+     "            with self._index_lock:\n"
+     "                pass\n"
+     "    def evict(self):\n"
+     "        with self._index_lock:\n"
+     "            with self._shard_lock:\n"
+     "                pass\n",
+     "class TimeSeriesShard:\n"
+     "    def freeze(self):\n"
+     "        with self._shard_lock:\n"
+     "            with self._index_lock:\n"
+     "                pass\n"
+     "    def evict(self):\n"
+     "        with self._shard_lock:\n"
+     "            with self._index_lock:\n"
+     "                pass\n",
+     "deadlock", {}),
+    ("lock-order-inversion",
+     "class Part:\n"
+     "    def __init__(self):\n"
+     "        # lock-order: _encode_lock < _buf_lock\n"
+     "        self._buf_lock = mk()\n"
+     "    def bad(self):\n"
+     "        with self._buf_lock:\n"
+     "            with self._encode_lock:\n"
+     "                pass\n",
+     "class Part:\n"
+     "    def __init__(self):\n"
+     "        # lock-order: _encode_lock < _buf_lock\n"
+     "        self._buf_lock = mk()\n"
+     "    def good(self):\n"
+     "        with self._encode_lock:\n"
+     "            with self._buf_lock:\n"
+     "                pass\n",
+     "declares", {}),
+    ("host-sync",
+     "import numpy as np\n"
+     "from filodb_tpu.utils import devicewatch\n"
+     "@devicewatch.jit\n"
+     "def prog(x):\n"
+     "    return x\n"
+     "def serve(x):\n"
+     "    out = prog(x)\n"
+     "    return np.asarray(out)\n",
+     "import numpy as np\n"
+     "from filodb_tpu.utils import devicewatch\n"
+     "@devicewatch.jit\n"
+     "def prog(x):\n"
+     "    return x\n"
+     "def serve(x):\n"
+     "    out = prog(x)\n"
+     "    return np.asarray(out)  # host-sync-ok: the one designed "
+     "readback for serialization\n",
+     "readback", {"rel": "filodb_tpu/query/fake.py"}),
+    ("host-sync-annotation",
+     # an annotation on a line with no detected sync is stale
+     "x = 1  # host-sync-ok: nothing here\n",
+     "import numpy as np\n"
+     "from filodb_tpu.utils import devicewatch\n"
+     "@devicewatch.jit\n"
+     "def prog(x):\n"
+     "    return x\n"
+     "def serve(x):\n"
+     "    out = prog(x)\n"
+     "    return np.asarray(out)  # host-sync-ok: designed readback\n",
+     "stale", {"rel": "filodb_tpu/query/fake.py"}),
+    ("recompile-hazard",
+     # a jit call site keyed on a Python len(...): every distinct
+     # series count traces a fresh program (the PR 9 storm shape)
+     "from filodb_tpu.utils import devicewatch\n"
+     "@devicewatch.jit\n"
+     "def prog(x, nrows):\n"
+     "    return x\n"
+     "def serve(rows, x):\n"
+     "    return prog(x, len(rows))\n",
+     "import functools\n"
+     "from filodb_tpu.utils import devicewatch\n"
+     "@functools.partial(devicewatch.jit, static_argnames=('nrows',))\n"
+     "def prog(x, *, nrows):\n"
+     "    return x\n"
+     "def serve(rows, x):\n"
+     "    return prog(x, nrows=len(rows))\n",
+     "static_argnames", {}),
+    ("vmem-budget",
+     # 2 x 4096x4096 f32 blocks = 128 MiB per grid step
+     "import jax\n"
+     "import jax.numpy as jnp\n"
+     "from jax.experimental import pallas as pl\n"
+     "def kern(x_ref, o_ref):\n"
+     "    o_ref[...] = x_ref[...]\n"
+     "def big(x):\n"
+     "    return pl.pallas_call(\n"
+     "        kern,\n"
+     "        out_shape=jax.ShapeDtypeStruct((4096, 4096), jnp.float32),\n"
+     "        in_specs=[pl.BlockSpec((4096, 4096), lambda i: (0, 0))],\n"
+     "        out_specs=pl.BlockSpec((4096, 4096), lambda i: (0, 0)),\n"
+     "    )(x)\n",
+     "import jax\n"
+     "import jax.numpy as jnp\n"
+     "from jax.experimental import pallas as pl\n"
+     "def kern(x_ref, o_ref):\n"
+     "    o_ref[...] = x_ref[...]\n"
+     "def small(x):\n"
+     "    return pl.pallas_call(\n"
+     "        kern,\n"
+     "        out_shape=jax.ShapeDtypeStruct((4096, 4096), jnp.float32),\n"
+     "        in_specs=[pl.BlockSpec((256, 1024), lambda i: (i, 0))],\n"
+     "        out_specs=pl.BlockSpec((256, 1024), lambda i: (i, 0)),\n"
+     "    )(x)\n",
+     "VMEM", {}),
 ]
 
 
@@ -535,6 +651,342 @@ def test_deleting_any_suppression_makes_it_fail(tree_findings):
         assert any(g.rule == f.rule and g.line == f.line for g in got), \
             f"stripping the suppression at {f.where()} did not re-fire " \
             f"{f.rule}"
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10: whole-program analyses (call graph, lock order, device)
+# ---------------------------------------------------------------------------
+
+_WEDGE_CALLER = (
+    "from filodb_tpu.gateway.lanes import deliver\n"
+    "class ReplicaFanout:\n"
+    "    def publish(self, container):\n"
+    "        with self._lock:\n"
+    "            deliver(container)\n"
+)
+_WEDGE_HELPER = (
+    "from filodb_tpu.utils.observability import http_container_push\n"
+    "def deliver(container):\n"
+    "    http_container_push('http://peer', container, timeout_s=5)\n"
+)
+
+
+def test_cross_module_blocking_requires_whole_program():
+    """Acceptance: the PR 12 ReplicaFanout wedge SPLIT ACROSS TWO
+    MODULES — a ``with self._lock:`` whose blocking peer POST lives in
+    another module — is caught by the whole-program fixpoint and
+    provably NOT caught by a same-module-only run (this regression
+    pins the improvement over PR 13's per-module analysis)."""
+    # same-module-only: each module linted alone is silent — the caller
+    # cannot resolve deliver(), the helper holds no lock
+    assert _fake(_WEDGE_CALLER, ["blocking-under-lock"],
+                 rel="filodb_tpu/gateway/fanout.py") == []
+    assert _fake(_WEDGE_HELPER, ["blocking-under-lock"],
+                 rel="filodb_tpu/gateway/lanes.py") == []
+    # whole-program: the same two sources linted TOGETHER fire at the
+    # lock-taking caller, with the cross-module chain in the message
+    got = A.unsuppressed(A.run_sources(
+        {"filodb_tpu/gateway/fanout.py": _WEDGE_CALLER,
+         "filodb_tpu/gateway/lanes.py": _WEDGE_HELPER},
+        rules=["blocking-under-lock"]))
+    assert len(got) == 1
+    f = got[0]
+    assert f.path == "filodb_tpu/gateway/fanout.py" and f.line == 5
+    assert "http_container_push" in f.message
+    assert "via lanes.deliver" in f.message
+
+
+def test_self_attr_call_resolves_through_init_class():
+    """``self.x.m()`` where __init__ assigned x a known class resolves
+    cross-module (best-effort attribute typing)."""
+    caller = (
+        "from filodb_tpu.coordinator.lanes import PeerLane\n"
+        "class Fanout:\n"
+        "    def __init__(self):\n"
+        "        self._lane = PeerLane()\n"
+        "    def publish(self, c):\n"
+        "        with self._lock:\n"
+        "            self._lane.deliver(c)\n"
+    )
+    helper = (
+        "import time\n"
+        "class PeerLane:\n"
+        "    def deliver(self, c):\n"
+        "        time.sleep(1)\n"
+    )
+    got = A.unsuppressed(A.run_sources(
+        {"filodb_tpu/coordinator/fanout.py": caller,
+         "filodb_tpu/coordinator/lanes.py": helper},
+        rules=["blocking-under-lock"]))
+    assert len(got) == 1 and got[0].line == 7
+    assert "sleep" in got[0].message
+
+
+def test_cross_module_lock_order_cycle():
+    moda = (
+        "import threading\n"
+        "from filodb_tpu.memstore.other import grab_b\n"
+        "_A_LOCK = threading.Lock()\n"
+        "def fwd():\n"
+        "    with _A_LOCK:\n"
+        "        grab_b()\n"
+        "def take_a():\n"
+        "    with _A_LOCK:\n"
+        "        pass\n"
+    )
+    modb = (
+        "import threading\n"
+        "from filodb_tpu.memstore.faker import take_a\n"
+        "_B_LOCK = threading.Lock()\n"
+        "def grab_b():\n"
+        "    with _B_LOCK:\n"
+        "        pass\n"
+        "def rev():\n"
+        "    with _B_LOCK:\n"
+        "        take_a()\n"
+    )
+    got = A.unsuppressed(A.run_sources(
+        {"filodb_tpu/memstore/faker.py": moda,
+         "filodb_tpu/memstore/other.py": modb},
+        rules=["lock-order-cycle"]))
+    assert len(got) == 1
+    assert "_A_LOCK" in got[0].message and "_B_LOCK" in got[0].message
+    # each module alone sees only its own half — no cycle
+    assert _fake(moda, ["lock-order-cycle"],
+                 rel="filodb_tpu/memstore/faker.py") == []
+    assert _fake(modb, ["lock-order-cycle"],
+                 rel="filodb_tpu/memstore/other.py") == []
+
+
+def test_lock_order_proactive_declaration_binds_to_acquired_locks():
+    """A declaration over two locks that are each acquired but never
+    yet nested (the advertised proactive workflow) must NOT read as
+    binding to nothing."""
+    src = (
+        "class A:\n"
+        "    def f(self):\n"
+        "        # lock-order: _a_lock < _b_lock\n"
+        "        with self._a_lock:\n"
+        "            pass\n"
+        "class B:\n"
+        "    def g(self):\n"
+        "        with self._b_lock:\n"
+        "            pass\n"
+    )
+    assert _fake(src, ["lock-order-inversion"]) == []
+
+
+def test_host_sync_ok_in_docstring_is_not_an_annotation():
+    """A docstring QUOTING the annotation syntax is neither a live
+    annotation nor a stale one (comment-token discipline, same as the
+    engine's suppression scanner)."""
+    src = (
+        '"""Declare readbacks with ``# host-sync-ok: <reason>``."""\n'
+        "x = 1\n"
+    )
+    assert _fake(src, ["host-sync-annotation"],
+                 rel="filodb_tpu/query/fake.py") == []
+
+
+def test_same_named_plain_function_is_not_a_jit_entry():
+    """A nested jit closure must not hijack name resolution for an
+    unrelated same-named module-level function."""
+    src = (
+        "from filodb_tpu.utils import devicewatch\n"
+        "def factory():\n"
+        "    @devicewatch.jit\n"
+        "    def kernel(a):\n"
+        "        return a\n"
+        "    return kernel\n"
+        "def kernel(rows, cols):\n"
+        "    return rows * cols\n"
+        "def serve(xs):\n"
+        "    return kernel(len(xs), 4)\n"
+    )
+    assert _fake(src, ["recompile-hazard"]) == []
+
+
+def test_lock_order_dangling_declaration_is_an_error():
+    src = (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        # lock-order: _no_such_lock < _lock\n"
+        "        self._lock = mk()\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+    )
+    got = _fake(src, ["lock-order-inversion"])
+    assert any("binds to nothing" in f.message for f in got)
+
+
+def test_lock_order_declaration_pins_real_partition_edge():
+    """Flipping the in-tree declared encode->buffer order must fire
+    against the REAL acquisition edge in partition.py."""
+    src = (REPO / "filodb_tpu/memstore/partition.py").read_text()
+    decl = "# lock-order: _encode_lock < TimeSeriesPartition._lock"
+    assert decl in src
+    flipped = src.replace(
+        decl, "# lock-order: TimeSeriesPartition._lock < _encode_lock")
+    got = _fake(flipped, ["lock-order-inversion"],
+                rel="filodb_tpu/memstore/partition.py")
+    assert any("_encode_lock" in f.message for f in got)
+    assert _fake(src, ["lock-order-inversion"],
+                 rel="filodb_tpu/memstore/partition.py") == []
+
+
+def test_stripping_any_host_sync_ok_refires():
+    """Every # host-sync-ok annotation this PR seeded covers a live
+    host-sync finding — stripping any one re-fires it (the delete-any-
+    suppression sweep, extended to the device allowlist)."""
+    total = 0
+    for rel in ("filodb_tpu/memstore/devicestore.py",
+                "filodb_tpu/parallel/mesh.py",
+                "filodb_tpu/parallel/meshgrid.py"):
+        src = (REPO / rel).read_text()
+        lines = src.splitlines(keepends=True)
+        marks = [i for i, ln in enumerate(lines) if "# host-sync-ok:" in ln]
+        assert marks, f"{rel}: expected seeded annotations"
+        total += len(marks)
+        for i in marks:
+            stripped = lines[:]
+            stripped[i] = stripped[i][
+                :stripped[i].index("# host-sync-ok:")].rstrip() + "\n"
+            got = _fake("".join(stripped), ["host-sync"], rel=rel)
+            assert any(g.line == i + 1 for g in got), \
+                f"stripping {rel}:{i + 1} did not re-fire host-sync"
+        # and the file as-is is clean (annotations used, none stale)
+        assert _fake(src, ["host-sync", "host-sync-annotation"],
+                     rel=rel) == []
+    assert total >= 19
+
+
+def test_recompile_hazard_via_local_fstring_binding():
+    src = (
+        "from filodb_tpu.utils import devicewatch\n"
+        "@devicewatch.jit\n"
+        "def prog(x, tag):\n"
+        "    return x\n"
+        "def serve(xs, x):\n"
+        "    for i, _ in enumerate(xs):\n"
+        "        key = f'k{i}'\n"
+        "        prog(x, key)\n"
+    )
+    got = _fake(src, ["recompile-hazard"])
+    assert len(got) == 1 and "f-string" in got[0].message
+
+
+def test_vmem_budget_knob_and_scratch(tmp_path, capsys):
+    from filodb_tpu.analysis import device as D
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.experimental import pallas as pl\n"
+        "def kern(x_ref, o_ref):\n"
+        "    o_ref[...] = x_ref[...]\n"
+        "def f(x):\n"
+        "    return pl.pallas_call(\n"
+        "        kern,\n"
+        "        out_shape=jax.ShapeDtypeStruct((256, 1024), jnp.float32),\n"
+        "        in_specs=[pl.BlockSpec((256, 1024), lambda i: (i, 0))],\n"
+        "        out_specs=pl.BlockSpec((256, 1024), lambda i: (i, 0)),\n"
+        "    )(x)\n"
+    )
+    # 2 MiB of blocks: clean at the 16 MiB default, over a 1 MiB budget
+    assert _fake(src, ["vmem-budget"]) == []
+    p = tmp_path / "k.py"
+    p.write_text(src)
+    try:
+        assert lint_main([str(p), "--vmem-budget-mib", "1"]) == 1
+        out = capsys.readouterr().out
+        assert "vmem-budget" in out
+    finally:
+        D.VMEM_BUDGET_BYTES = D.DEFAULT_VMEM_BUDGET_BYTES
+    assert lint_main([str(p)]) == 0
+    capsys.readouterr()
+
+
+def test_unresolvable_dims_do_not_fire():
+    """Variable BlockSpec dims (the real grid.py shape) are skipped —
+    the rule under-counts rather than guessing."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.experimental import pallas as pl\n"
+        "def f(x, nb, lanes, kern):\n"
+        "    return pl.pallas_call(\n"
+        "        kern,\n"
+        "        out_shape=jax.ShapeDtypeStruct((nb, lanes), jnp.float32),\n"
+        "        in_specs=[pl.BlockSpec((nb, lanes), lambda i: (0, i))],\n"
+        "        out_specs=pl.BlockSpec((nb, lanes), lambda i: (0, i)),\n"
+        "    )(x)\n"
+    )
+    assert _fake(src, ["vmem-budget"]) == []
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10 satellites: --changed, --format=github, exit codes
+# ---------------------------------------------------------------------------
+
+
+def test_exit_code_2_on_usage_errors(capsys):
+    assert lint_main(["--rules", "no-such-rule"]) == 2
+    assert lint_main([str(PKG / "analysis"),
+                      "--changed", "not-a-real-ref"]) == 2
+    capsys.readouterr()
+
+
+def test_format_github_annotations(tmp_path, capsys):
+    bad = tmp_path / "wedge.py"
+    bad.write_text(
+        "import urllib.request\n"
+        "class ReplicaFanout:\n"
+        "    def publish(self, c):\n"
+        "        with self._lock:\n"
+        "            urllib.request.urlopen(req, timeout=5)\n")
+    assert lint_main([str(bad), "--format=github"]) == 1
+    out = capsys.readouterr().out
+    assert "::error file=wedge.py,line=5,title=filolint" \
+           "[blocking-under-lock]::" in out
+    assert "::notice::filolint: " in out
+
+
+def test_changed_subset_scopes_report(capsys):
+    """--changed reports ONLY findings in changed files while the
+    analysis still runs whole-program; an untracked violation file is
+    picked up, and nothing else (incl. stale-suppression verdicts for
+    unchanged files) leaks into the report."""
+    probe = PKG / "_filolint_changed_probe.py"
+    probe.write_text(
+        "import urllib.request\n"
+        "class ReplicaFanout:\n"
+        "    def publish(self, c):\n"
+        "        with self._lock:\n"
+        "            urllib.request.urlopen(req, timeout=5)\n")
+    try:
+        rc = lint_main(["--changed", "HEAD", "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        open_findings = [f for f in doc["findings"]
+                         if not f["suppressed"]]
+        assert open_findings, "probe violation not reported"
+        probe_rel = "filodb_tpu/_filolint_changed_probe.py"
+        assert {f["path"] for f in open_findings} <= {probe_rel}
+    finally:
+        probe.unlink()
+    # with the probe gone the changed-subset run is clean again
+    rc = lint_main(["--changed", "HEAD"])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_cli_lint_forwards_changed_and_format(capsys):
+    """cli.py lint must not hand-mirror flags: the new --changed /
+    --format options pass straight through."""
+    from filodb_tpu.cli import main as cli_main
+    rc = cli_main(["lint", "--changed", "HEAD", "--format", "github"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "::notice::filolint:" in out
 
 
 def test_reintroducing_fixed_races_fails_the_build():
