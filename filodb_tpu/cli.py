@@ -122,6 +122,45 @@ def cmd_cardinality_report(args) -> int:
     return 0
 
 
+def cmd_rollup_status(args) -> int:
+    """Tiered-resolution rollup state (ISSUE 11, served by
+    /admin/rollup): per-dataset/tier cursor positions, lag vs the flush
+    watermark, last-pass duration, rows written."""
+    body = _http_get(args.server, "/admin/rollup")
+    if body.get("status") != "success":
+        print(json.dumps(body, indent=2))
+        return 1
+    data = body["data"]
+    if args.json:
+        print(json.dumps(data, indent=2))
+        return 0
+    for ds in data.get("datasets", []):
+        ladder = "/".join(f"{r // 1000}s" for r in ds["resolutions_ms"])
+        print(f"dataset {ds['dataset']}: tiers {ladder}, "
+              f"{ds['passes']} passes ({ds['deferred']} deferred), "
+              f"last pass {ds['last_pass_s'] * 1000:.1f}ms")
+        for res, n in sorted(ds.get("samples_written", {}).items(),
+                             key=lambda kv: int(kv[0])):
+            err = ds.get("tier_errors", {}).get(res)
+            rolled = ds.get("rolled_through_ms", {}).get(res)
+            print(f"  tier {int(res) // 1000}s: {n} rows written, "
+                  f"rolled through {rolled}"
+                  + (f", ERROR: {err}" if err else ""))
+        for sh in ds.get("shards", []):
+            tiers = ", ".join(
+                f"{int(r) // 1000}s@{t['emitted_through_ms']}"
+                f"(lag {t['lag_s']}s)" if t["emitted_through_ms"]
+                is not None else f"{int(r) // 1000}s@-"
+                for r, t in sorted(sh["tiers"].items(),
+                                   key=lambda kv: int(kv[0])))
+            print(f"  shard {sh['shard']}: "
+                  f"{'active' if sh['active'] else 'standby'}, "
+                  f"{sh['buffered_series']} series / "
+                  f"{sh['buffered_samples']} samples buffered, "
+                  f"queue {sh['queue_depth']} | {tiers}")
+    return 0
+
+
 def cmd_shards(args) -> int:
     """Ingest watermark / shard-health tree (served by /admin/shards)."""
     body = _http_get(args.server, "/admin/shards")
@@ -326,6 +365,14 @@ def build_parser() -> argparse.ArgumentParser:
     cd.add_argument("--json", action="store_true",
                     help="raw JSON instead of the text summary")
     cd.set_defaults(fn=cmd_cardinality_report)
+
+    ru = sub.add_parser("rollup-status",
+                        help="per-dataset/tier rollup cursors, lag vs "
+                             "flush watermark, rows written")
+    server_args(ru)
+    ru.add_argument("--json", action="store_true",
+                    help="raw JSON instead of the text summary")
+    ru.set_defaults(fn=cmd_rollup_status)
 
     sh = sub.add_parser("shards",
                         help="ingest watermark chain / lag / shard "

@@ -28,6 +28,26 @@ from filodb_tpu.downsample.chunkdown import (parse_downsampler,
 DEFAULT_RESOLUTIONS_MS = (60_000, 3_600_000)  # 1m / 1h (conf resolutions)
 
 
+def decode_concat_with_keys(schema: Schema, pairs):
+    """Group ``(tags, chunkset)`` pairs by partition, decode once, and
+    concatenate in chunk-id order -> ``[(partkey, tags, ts, cols)]``.
+    The keyed sibling of :meth:`ShardDownsampler._decode_concat` — the
+    live rollup engine buffers decoded rows per PARTKEY across ticks,
+    so it needs the key the flush-path helper drops."""
+    from filodb_tpu.core.chunk import decode_partitions_batch
+    by_pk: dict[bytes, list] = {}
+    for tags, cs in pairs:
+        by_pk.setdefault(cs.partkey, [tags, []])[1].append(cs)
+    groups = []
+    for _pk, (_tags, css) in by_pk.items():
+        css.sort(key=lambda c: c.info.chunk_id)
+        groups.append(css)
+    parts = decode_partitions_batch(schema, groups)
+    return [(pk, tags, ts, cols)
+            for (pk, (tags, _css)), (ts, cols)
+            in zip(by_pk.items(), parts)]
+
+
 class DownsamplePublisher:
     """Collects downsample record containers per resolution (reference:
     DownsamplePublisher -> Kafka downsample topics)."""
@@ -111,18 +131,8 @@ class ShardDownsampler:
         """Group (tags, chunkset) pairs by partition, decode once, and
         concatenate in chunk-id order so a period spanning a mid-flush
         chunk boundary yields ONE record, not conflicting partials."""
-        from filodb_tpu.core.chunk import decode_partitions_batch
-        by_pk: dict[bytes, list] = {}
-        for tags, cs in chunksets:
-            by_pk.setdefault(cs.partkey, [tags, []])[1].append(cs)
-        groups = []
-        for _pk, (_tags, css) in by_pk.items():
-            css.sort(key=lambda c: c.info.chunk_id)
-            groups.append(css)
-        parts = decode_partitions_batch(self.schema, groups)
-        return [(tags, ts, cols)
-                for (_pk, (tags, _css)), (ts, cols)
-                in zip(by_pk.items(), parts)]
+        return [(tags, ts, cols) for _pk, tags, ts, cols
+                in decode_concat_with_keys(self.schema, chunksets)]
 
     def prepare_arrays(self, chunksets):
         """Decode + grid-stage ONCE for use across every resolution
@@ -132,6 +142,19 @@ class ShardDownsampler:
         if not self.enabled or not chunksets:
             return None
         decoded = self._decode_concat(chunksets)
+        return decoded, self._try_stage_grid(decoded)
+
+    def prepare_decoded(self, decoded):
+        """:meth:`prepare_arrays` for callers that already hold decoded
+        per-series arrays ``[(tags, ts, cols)]`` — the live rollup
+        engine's resident buffers skip the chunkset decode but share
+        the grid staging (and its resolution-ladder cascade)."""
+        if not self.enabled or not decoded:
+            return None
+        decoded = [(tags, ts, cols) for tags, ts, cols in decoded
+                   if len(ts)]
+        if not decoded:
+            return None
         return decoded, self._try_stage_grid(decoded)
 
     def downsample_planes(self, prepared, resolution_ms: int):

@@ -162,6 +162,9 @@ def stats_payload(stats, trace_id: str = "") -> dict:
             # (devicewatch: blocks committed minus freed; 0 when warm)
             "hbmResidentDeltaBytes": int(stats.hbm_resident_delta_bytes),
         },
+        # tiered-resolution serving (doc/rollup.md): the coarsest rolled
+        # tier that served (part of) this query; 0 = raw only
+        "resolutionMs": int(getattr(stats, "resolution_ms", 0)),
         "traceId": trace_id,
     }
 

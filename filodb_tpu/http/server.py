@@ -127,6 +127,9 @@ class FiloHttpServer:
     # /api/v1/alerts, and /admin/rules; None = empty payloads (a node
     # with no rules configured still answers the Prometheus API shape)
     rules: Optional[object] = None
+    # the rollup engine (ISSUE 11, filodb_tpu/rollup): backs
+    # /admin/rollup; None = the route 404s (no rollup on this node)
+    rollup: Optional[object] = None
     datasets: dict = field(default_factory=dict)
     _httpd: Optional[ThreadingHTTPServer] = None
     _thread: Optional[threading.Thread] = None
@@ -524,6 +527,9 @@ class FiloHttpServer:
         if len(parts) == 2 and parts[0] == "admin" \
                 and parts[1] == "rules":
             return self._admin_rules()
+        if len(parts) == 2 and parts[0] == "admin" \
+                and parts[1] == "rollup":
+            return self._admin_rollup()
         if len(parts) == 3 and parts[0] == "admin" \
                 and parts[1] == "chunkmeta":
             return self._chunkmeta(parts[2], params)
@@ -584,6 +590,16 @@ class FiloHttpServer:
             return 404, error_response("bad_data",
                                        "no rule engine on this node")
         return 200, {"status": "success", "data": self.rules.admin_state()}
+
+    @_timed("admin_rollup")
+    def _admin_rollup(self) -> tuple[int, dict]:
+        """The rollup engine's live state (doc/rollup.md): per-dataset
+        tier ladder, per-shard cursor positions + lag vs the flush
+        watermark, pass timing, rows written, tier errors."""
+        if self.rollup is None:
+            return 404, error_response("bad_data",
+                                       "no rollup engine on this node")
+        return 200, {"status": "success", "data": self.rollup.admin_state()}
 
     # ------------------------------------------------------ query forensics
 
@@ -960,7 +976,10 @@ class FiloHttpServer:
             priority=str(p.get("priority", "default")),
             allow_partial_results=str(
                 p.get("allow_partial_results", "")).lower()
-            in ("true", "1"))
+            in ("true", "1"),
+            # tiered-resolution serving (doc/rollup.md): let clients
+            # pin raw / a specific tier; default lets the router pick
+            resolution_pref=str(p.get("resolution", "")))
         return wdl.mint(qctx)
 
     def _admit(self, b: DatasetBinding, ep, qctx: QueryContext):
@@ -1029,6 +1048,13 @@ class FiloHttpServer:
                                 # committed/released, on the trace too
                                 sp.tag(hbm_delta_bytes=res.stats
                                        .hbm_resident_delta_bytes)
+                            if qctx.rollup_resolution_ms:
+                                # tiered serving: the tier the router
+                                # chose, on the stats AND the span
+                                res.stats.resolution_ms = \
+                                    qctx.rollup_resolution_ms
+                                sp.tag(resolution_ms=qctx
+                                       .rollup_resolution_ms)
                     res.stats.add_timing("plan", plan_s)
                     # queue = scheduler wait ONLY (t_submit is stamped
                     # right before submission below): planning and

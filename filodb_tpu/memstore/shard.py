@@ -167,6 +167,11 @@ class TimeSeriesShard:
         self.downsample_publisher = None
         self.downsample_resolutions: tuple[int, ...] = ()
         self._downsamplers: dict[int, object] = {}
+        # live rollup subsystem (filodb_tpu/rollup): called after each
+        # successful flush with {schema_hash: [(tags, chunkset)]} + the
+        # flush ingestion time — the incremental chunk feed the
+        # RollupEngine tiers from.  Must never fail the flush.
+        self.rollup_listener = None
         # active-series cardinality quota (workload/quota.py): consulted
         # right before a NEW part id is assigned; an over-quota tenant's
         # new series is rejected (rows dropped + counted) while existing
@@ -495,14 +500,16 @@ class TimeSeriesShard:
                 if fresh:
                     collected.append((part, fresh))
                 chunksets.extend(fresh)
-                if self.downsample_publisher is not None and fresh:
+                if fresh and (self.downsample_publisher is not None
+                              or self.rollup_listener is not None):
                     ds_pairs.setdefault(part.schema.schema_hash, []).extend(
                         (part.tags, cs) for cs in fresh)
             if chunksets:
                 self.store.write_chunks(self.dataset, self.shard_num,
                                         chunksets, task.ingestion_time)
-            for shash, pairs in ds_pairs.items():
-                self._downsampler_for(shash).downsample_chunksets(pairs)
+            if self.downsample_publisher is not None:
+                for shash, pairs in ds_pairs.items():
+                    self._downsampler_for(shash).downsample_chunksets(pairs)
             if task.dirty:
                 recs = [PartKeyRecord(self.index.partkey(pid),
                                       self.index.start_time(pid),
@@ -523,6 +530,16 @@ class TimeSeriesShard:
         # checkpoint only after chunks+partkeys persisted (reference :949-960)
         self.meta.write_checkpoint(self.dataset, self.shard_num, task.group,
                                    task.offset)
+        if self.rollup_listener is not None and ds_pairs:
+            # hand the fresh chunksets to the live rollup engine AFTER
+            # the flush persisted+checkpointed (the engine's restart
+            # catch-up reads the store by ingestion time, so a crash
+            # between persist and handoff replays, never loses)
+            try:
+                self.rollup_listener(ds_pairs, task.ingestion_time)
+            except Exception:  # noqa: BLE001 — rollup must never fail a flush
+                import traceback
+                traceback.print_exc()
         self.group_watermarks[task.group] = max(
             self.group_watermarks[task.group], task.offset)
         self.stats.chunks_flushed += len(chunksets)

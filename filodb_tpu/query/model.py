@@ -46,6 +46,15 @@ class QueryContext:
     tenant: str = ""
     priority: str = "default"
     allow_partial_results: bool = False
+    # tiered-resolution serving (filodb_tpu/rollup):
+    # - resolution_pref: the ?resolution= request knob — "" /"auto"
+    #   lets the router pick, "raw" pins the raw dataset, an explicit
+    #   duration ("1m"/"15m"/"1h") pins that tier
+    # - rollup_resolution_ms: stamped by the router at materialize time
+    #   with the tier it chose (0 = raw); the HTTP layer folds it into
+    #   QueryStats + the query.execute span
+    resolution_pref: str = ""
+    rollup_resolution_ms: int = 0
 
 
 @dataclasses.dataclass
@@ -80,6 +89,11 @@ class QueryStats:
     # because the query set allow_partial_results (ISSUE 5): the result
     # is PARTIAL and the API layers surface a warning + header
     shards_down: int = 0
+    # tiered-resolution serving (filodb_tpu/rollup): the coarsest rolled
+    # tier that served (part of) this query, 0 = raw only.  Stamped by
+    # the HTTP layer from the router's materialize-time choice and
+    # visible under data.stats with stats=true
+    resolution_ms: int = 0
 
     def merge(self, other: "QueryStats") -> None:
         self.samples_scanned += other.samples_scanned
@@ -96,6 +110,9 @@ class QueryStats:
             self.hbm_read_bytes[k] = self.hbm_read_bytes.get(k, 0) + v
         self.hbm_resident_delta_bytes += other.hbm_resident_delta_bytes
         self.shards_down += other.shards_down
+        # coarsest tier wins: a stitched raw+rolled answer reports the
+        # rolled resolution it leaned on
+        self.resolution_ms = max(self.resolution_ms, other.resolution_ms)
 
     def add_timing(self, stage: str, seconds: float) -> None:
         self.timings[stage] = self.timings.get(stage, 0.0) + seconds

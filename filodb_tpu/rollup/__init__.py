@@ -1,0 +1,20 @@
+"""Tiered-resolution serving: continuous on-device rollup inside the
+live server (ROADMAP item 2; reference: the offline spark-jobs
+downsampler + DownsampledTimeSeriesStore pair, run continuously).
+
+- :mod:`filodb_tpu.rollup.config` — the per-dataset rollup ladder
+  (raw -> 1m -> 15m -> 1h by default), tick cadence, routing policy.
+- :mod:`filodb_tpu.rollup.engine` — the RollupEngine: per-shard
+  incremental chunk consumption (only newly-flushed chunks per tick),
+  per-series period closure, tier emission through the dataset's
+  replicated publish path, persisted high-water marks.
+- :mod:`filodb_tpu.rollup.planner` — RollupRouterPlanner: picks the
+  coarsest tier whose resolution fits the query's step/window, stitches
+  raw and rolled results at the tier boundary (LongTimeRangePlanner),
+  and reports the chosen resolution in QueryStats.
+"""
+
+from filodb_tpu.rollup.config import RollupConfig  # noqa: F401
+from filodb_tpu.rollup.engine import (ROLLUP_PRIORITY,  # noqa: F401
+                                      ROLLUP_TENANT, RollupEngine)
+from filodb_tpu.rollup.planner import RollupRouterPlanner  # noqa: F401

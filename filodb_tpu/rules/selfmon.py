@@ -18,7 +18,14 @@ failure classes PRs 6-12 made visible but nothing acted on:
 - **replica publish failures** — the dual-write fanout dropping a
   peer's containers (`filodb_ingest_replica_publish_failures_total`);
 - **integrity quarantines** — corrupt chunks excluded from serving
-  (`filodb_integrity_quarantined_chunks`).
+  (`filodb_integrity_quarantined_chunks`);
+- **rollup lag / stalled tiers** (ISSUE 11) — a resolution tier whose
+  emission stopped advancing (`filodb_rollup_stalled`, a LEVEL gauge
+  for the same reason as `filodb_ingest_stalled`: a counter's label
+  set is born at 1 and never shows an `increase()` edge) or whose lag
+  behind the raw flush watermark grew past the threshold
+  (`filodb_rollup_lag_seconds`) — stale tiers silently serve stale
+  long-range dashboards.
 """
 
 from __future__ import annotations
@@ -27,10 +34,13 @@ GROUP_NAME = "filodb-self-monitoring"
 
 
 def selfmon_pack(interval: str = "15s", for_: str = "30s",
-                 dataset: str = "_system", window: str = "2m") -> dict:
+                 dataset: str = "_system", window: str = "2m",
+                 rollup_lag_s: int = 7200) -> dict:
     """The pack as a rule config dict (``parse_rule_config`` input).
     ``interval``/``for_``/``window`` are tunable so fast test cadences
-    and production defaults share one definition."""
+    and production defaults share one definition; ``rollup_lag_s`` is
+    the lag threshold the FiloRollupLagging alert pages on (default:
+    two hours — two 1h periods behind)."""
     return {"groups": [{
         "name": GROUP_NAME,
         "interval": interval,
@@ -80,6 +90,33 @@ def selfmon_pack(interval: str = "15s", for_: str = "30s",
                  "description": "the dual-write fanout is dropping "
                                 "containers ({{ $value }}); the "
                                 "replica lags until it recovers"}},
+            {"record": "node:rollup_lag_seconds:max",
+             "expr": "max(filodb_rollup_lag_seconds)",
+             "labels": {"source": "selfmon"}},
+            {"alert": "FiloRollupStalled",
+             # the LEVEL gauge (the filodb_ingest_stalled lesson):
+             # counters born at 1 never show increase() edges
+             "expr": "filodb_rollup_stalled > 0",
+             "for": for_,
+             "labels": {"severity": "page", "source": "selfmon"},
+             "annotations": {
+                 "summary": "rollup tier {{ $labels.resolution }}ms "
+                            "stalled on dataset {{ $labels.dataset }}",
+                 "description": "the tier made no emission progress "
+                                "past the stall window; long-range "
+                                "queries serve stale rolled data "
+                                "(see /admin/rollup)"}},
+            {"alert": "FiloRollupLagging",
+             "expr": f"max(filodb_rollup_lag_seconds) > {rollup_lag_s}",
+             "for": for_,
+             "labels": {"severity": "warn", "source": "selfmon"},
+             "annotations": {
+                 "summary": "rollup lag {{ $value }}s behind the "
+                            "flush watermark",
+                 "description": "a resolution tier is falling behind "
+                                "raw ingest; check admission "
+                                "deferrals and tier errors in "
+                                "/admin/rollup"}},
             {"alert": "FiloChunksQuarantined",
              "expr": "filodb_integrity_quarantined_chunks > 0",
              "for": for_,

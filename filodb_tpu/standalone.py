@@ -43,6 +43,14 @@ driven by a JSON config instead of HOCON:
                    "port": 9092, "topic": "prom"},
                                           # omit for the in-proc queue
         "store": {"flush-interval": "1h", "groups-per-shard": 8},
+        "rollup": {                       # ISSUE 11 (doc/rollup.md):
+                                          # continuous raw->1m->15m->1h
+                                          # tiering + resolution-routed
+                                          # queries; omit to disable
+          "resolutions": ["1m", "15m", "1h"],
+          "tick-interval-s": 30,
+          "raw-retention": "0"            # 0 = raw keeps everything
+        },
         "workload": {                     # ISSUE 5 (doc/workload.md);
                                           # every knob has a default —
                                           # the block is optional
@@ -128,7 +136,17 @@ class FiloServer:
         # evaluated through the normal query path (doc/rules.md)
         self.rule_engine = None
         self.rule_notifier = None
+        # rollup engine (ISSUE 11, doc/rollup.md): continuous
+        # raw->1m->15m->1h tiering into <ds>_ds_<res> datasets +
+        # resolution-routed serving; created on the first dataset with
+        # a "rollup" block
+        self.rollup_engine = None
         self.write_publishers: dict[str, ShardingPublisher] = {}
+        # dataset -> raw container publish fn (queue push / broker
+        # produce / ReplicaFanout): the rollup engine emits rolled
+        # containers through the TIER dataset's publish path so they
+        # ride the same replication as any ingest
+        self._publish_fns: dict[str, object] = {}
         self._global_gateway_claimed = False
         # datasets fed by the in-proc queue: the only legal targets of
         # the replica container-push edge (POST /ingest, ISSUE 7)
@@ -277,6 +295,8 @@ class FiloServer:
         self.watermark_sampler.start()
 
         self._setup_rules(ss)
+        if self.rollup_engine is not None:
+            self.rollup_engine.start()
 
         port = self.http.start()
         peers = self.config.get("peers", {})
@@ -469,6 +489,7 @@ class FiloServer:
             self._queue_push_datasets.add(name)
             publish = lambda s, c, _n=name: self.stream_factory.stream_for(  # noqa: E731
                 _n, s).push(c)
+        self._publish_fns[name] = publish
         # Prometheus remote-write edge shares the gateway sharding rules
         # (and doubles as the self-telemetry ingest edge, ISSUE 6)
         wpub = ShardingPublisher(schema, mapper, publish, spread=spread)
@@ -550,6 +571,13 @@ class FiloServer:
             quota.refresh_from_index(
                 *(sh.index for sh in self.memstore.shards(name)))
             wpub.quota = quota
+        # tiered-resolution serving (ISSUE 11, doc/rollup.md): stand up
+        # the <ds>_ds_<res> tier datasets as REAL datasets (replicated,
+        # flushed through the checksummed store, queryable), wire the
+        # rollup engine over this dataset's flush stream, and wrap the
+        # serving planner in the resolution router
+        planner = self._setup_rollup(ds_conf, name, num_shards, spread, rf,
+                                     mapper, schema, planner, admission)
         self.http.bind_dataset(DatasetBinding(name, self.memstore, planner,
                                               write_router=write_router,
                                               scheduler=qsched,
@@ -571,6 +599,76 @@ class FiloServer:
             gw.start()
             self.gateways.append(gw)
 
+    def _setup_rollup(self, ds_conf: dict, name: str, num_shards: int,
+                      spread: int, rf: int, mapper, schema, planner,
+                      admission):
+        """Per-dataset rollup wiring (ISSUE 11).  Returns the serving
+        planner — the resolution router when rollup is enabled, the
+        original planner otherwise.  A broken rollup block refuses
+        startup, like a broken rule config."""
+        ro_conf = ds_conf.get("rollup")
+        if ro_conf is None or ds_conf.get("_rollup_tier") \
+                or not ro_conf.get("enabled", True):
+            return planner
+        from filodb_tpu.rollup.config import (RollupConfig,
+                                              RollupConfigError)
+        if schema.downsample is None:
+            raise RollupConfigError(
+                f"dataset {name!r} (schema {ds_conf.get('schema')!r}) "
+                f"has no downsample schema — rollup cannot tier it")
+        cfg = RollupConfig.from_config(ro_conf)
+        from filodb_tpu.downsample.dsstore import ds_dataset_name
+        tier_planners: dict[int, object] = {}
+        publish_for: dict[int, object] = {}
+        tier_schema = schema.data.downsample_schema \
+            or ds_conf.get("schema", "gauge")
+        for res in cfg.resolutions_ms:
+            tname = ds_dataset_name(name, res)
+            if tname not in self.manager.datasets():
+                # tier datasets never claim the node's global gateway
+                # port (the _system-dataset discipline) and always use
+                # the in-proc queue transport: at rf>1 the generic
+                # queue+peers branch gives them the PR 12 ReplicaFanout
+                # dual-write, broker or not
+                claimed = self._global_gateway_claimed
+                self._global_gateway_claimed = True
+                try:
+                    self._setup_dataset({
+                        "name": tname, "num-shards": num_shards,
+                        "min-num-nodes": int(
+                            ds_conf.get("min-num-nodes", 1)),
+                        "schema": tier_schema, "spread": spread,
+                        "replication-factor": rf,
+                        "store": ro_conf.get("store",
+                                             ds_conf.get("store", {})),
+                        "query": ro_conf.get("query", {"workers": 2}),
+                        "_rollup_tier": True})
+                finally:
+                    self._global_gateway_claimed = claimed
+            tier_planners[res] = self.http.datasets[tname].planner
+            publish_for[res] = self._publish_fns[tname]
+        if self.rollup_engine is None:
+            from filodb_tpu.rollup.engine import RollupEngine
+            self.rollup_engine = RollupEngine(node=self.node)
+            self.http.rollup = self.rollup_engine
+        from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+        self.rollup_engine.watch(
+            name, self.memstore, DEFAULT_SCHEMAS, cfg, publish_for,
+            column_store=self.colstore, meta_store=self.metastore,
+            # only the shard's primary replica rolls it (the raw data
+            # is identical on every replica; the EMITTED containers
+            # replicate through the tier publish path — two emitters
+            # would double-publish every record)
+            owner_fn=(lambda s, _m=mapper, _n=self.node:
+                      _m.coord_for_shard(s) == _n),
+            admission=admission)
+        from filodb_tpu.rollup.planner import RollupRouterPlanner
+        return RollupRouterPlanner(
+            name, planner, tier_planners,
+            rolled_through_fn=(lambda r, _e=self.rollup_engine, _n=name:
+                               _e.rolled_through(_n, r)),
+            raw_retention_ms=cfg.raw_retention_ms)
+
     def flush_all(self) -> int:
         n = 0
         for ds in self.manager.datasets():
@@ -583,6 +681,11 @@ class FiloServer:
             # stops the group loops AND closes the notifier — a dead
             # node must not keep evaluating or POSTing webhooks
             self.rule_engine.stop()
+        if self.rollup_engine is not None:
+            # stops the tier loops and removes the exported lag/stall
+            # gauge rows — a dead node's stalled=1 must not feed the
+            # self-monitoring alerts forever
+            self.rollup_engine.stop()
         if self.watermark_sampler is not None:
             self.watermark_sampler.stop()
         if self.selfscraper is not None:

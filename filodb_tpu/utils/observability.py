@@ -542,6 +542,51 @@ def rule_metrics() -> dict:
     }
 
 
+def rollup_metrics() -> dict:
+    """Canonical rollup-subsystem metrics (filodb_tpu/rollup): tick
+    health, tier lag/stall, emission volume, routing — one place
+    defines the names so the engine, the router, /admin/rollup, and
+    doc/rollup.md can never drift."""
+    return {
+        "passes": REGISTRY.counter(
+            "filodb_rollup_passes_total",
+            "rollup scheduler passes completed, per dataset"),
+        "pass_seconds": REGISTRY.histogram(
+            "filodb_rollup_pass_seconds",
+            "wall time of one rollup pass (consume + reduce + emit)"),
+        "samples": REGISTRY.counter(
+            "filodb_rollup_samples_written_total",
+            "rolled records emitted into the tier datasets, per "
+            "dataset and resolution"),
+        "lag": REGISTRY.gauge(
+            "filodb_rollup_lag_seconds",
+            "newest consumed raw sample time minus the tier's newest "
+            "emitted period stamp, per dataset/shard/resolution"),
+        "errors": REGISTRY.counter(
+            "filodb_rollup_tier_errors_total",
+            "tier emission passes that raised (retried next tick)"),
+        "deferred": REGISTRY.counter(
+            "filodb_rollup_deferred_total",
+            "rollup passes deferred by admission control (the rollup "
+            "class yielded to user traffic)"),
+        "stalled": REGISTRY.gauge(
+            "filodb_rollup_stalled",
+            "1 while a tier makes no progress past the stall window "
+            "with work pending, else 0 — the LEVEL the self-monitoring "
+            "alert rules watch (a counter's label set is born at 1, "
+            "invisible to increase())"),
+        "buffered": REGISTRY.gauge(
+            "filodb_rollup_buffered_samples",
+            "raw samples resident in rollup closure buffers, per "
+            "dataset/shard"),
+        "routed": REGISTRY.counter(
+            "filodb_rollup_queries_routed_total",
+            "queries the resolution router served from a rolled tier, "
+            "per dataset and resolution (resolution=raw counts "
+            "rollup-eligible queries that stayed raw)"),
+    }
+
+
 def odp_metrics() -> dict:
     """Canonical on-demand-paging metrics."""
     return {

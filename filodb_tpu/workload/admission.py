@@ -51,7 +51,13 @@ DEFAULT_PRIORITY_SHARES = {"low": 0.5, "default": 0.8, "high": 1.0,
                            # BELOW "low", so a pathological rule group
                            # saturates at 40% of the budget and can
                            # never starve interactive traffic
-                           "rules": 0.4}
+                           "rules": 0.4,
+                           # the rollup scheduler's class (ISSUE 11):
+                           # below even "rules" — tiering is the most
+                           # deferrable work in the system (a deferred
+                           # tick just retries; closure semantics make
+                           # catch-up lossless)
+                           "rollup": 0.3}
 
 
 class AdmissionRejected(QueryRejected):

@@ -346,21 +346,47 @@ class TestBatchDownsampler:
             assert e1 > e0, "later window did not extend partkey end"
 
 
-def test_batch_decode_rejects_count_mismatch():
-    """A blob whose header count disagrees with the expected row count
-    must error, never serve uninitialized memory."""
-    from filodb_tpu import native
+def _count_mismatch_blobs():
+    """The blobs both halves of the count-mismatch check share: each
+    encodes exactly 5 rows but is handed to a decoder expecting 8."""
     from filodb_tpu.codecs import deltadelta, doublecodec
+    short_ll = deltadelta.encode(np.arange(5, dtype=np.int64))
+    dbl_blobs = (
+        doublecodec.encode(np.random.default_rng(0).normal(0, 1, 5)),
+        doublecodec.encode(np.full(5, 3.5)),
+        doublecodec.encode(np.arange(5, dtype=np.float64)))
+    return short_ll, dbl_blobs
+
+
+def test_batch_decode_count_semantics_pure():
+    """Pure-Python half of the count-mismatch contract, running in
+    tier-1 unconditionally (no native skip): the reference decoders
+    establish the ground truth the native batch decoder must enforce —
+    these blobs really do carry 5 rows, not the 8 the mismatching
+    caller claims, and round-trip losslessly."""
+    from filodb_tpu.codecs import deltadelta, doublecodec
+    short_ll, dbl_blobs = _count_mismatch_blobs()
+    ll = deltadelta.decode(short_ll)
+    assert len(ll) == 5
+    np.testing.assert_array_equal(ll, np.arange(5, dtype=np.int64))
+    for blob in dbl_blobs:
+        assert len(doublecodec.decode(blob)) == 5
+
+
+def test_batch_decode_rejects_count_mismatch_native():
+    """Native half: a blob whose header count disagrees with the
+    expected row count must error, never serve uninitialized memory.
+    Only THIS assertion needs the native library — the pure-Python
+    semantics above run everywhere."""
+    from filodb_tpu import native
 
     if not native.enable():
         pytest.skip("native library unavailable")
     nb = native.batch_decoder()
-    short_ll = deltadelta.encode(np.arange(5, dtype=np.int64))
+    short_ll, dbl_blobs = _count_mismatch_blobs()
     with pytest.raises(ValueError):
         nb.ll_decode_batch([short_ll], [8])
-    for blob in (doublecodec.encode(np.random.default_rng(0).normal(0, 1, 5)),
-                 doublecodec.encode(np.full(5, 3.5)),
-                 doublecodec.encode(np.arange(5, dtype=np.float64))):
+    for blob in dbl_blobs:
         with pytest.raises(ValueError):
             nb.dbl_decode_batch([blob], [8])
 

@@ -1,0 +1,1013 @@
+"""Tiered-resolution rollup subsystem (filodb_tpu/rollup).
+
+Oracle strategy: the OFFLINE downsample path (``downsample/``'s
+ShardDownsampler full pass over every persisted raw chunk) is ground
+truth; the live engine's incrementally-emitted tiers must be BIT-equal
+to it over closed periods, across randomized multi-round ingest/tick
+schedules, counter resets, restarts, and the raw/rolled stitch.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.record import (RecordBuilder, canonical_partkey,
+                                    parse_partkey)
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.downsample.dsstore import ds_dataset_name
+from filodb_tpu.downsample.sharddown import ShardDownsampler
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.parallel.shardmap import ShardMapper
+from filodb_tpu.promql.parser import query_range_to_logical_plan
+from filodb_tpu.query.exec import ExecContext
+from filodb_tpu.query.model import QueryContext
+from filodb_tpu.rollup.config import RollupConfig, RollupConfigError
+from filodb_tpu.rollup.engine import RollupEngine
+from filodb_tpu.rollup.planner import (RollupRouterPlanner,
+                                       parse_resolution_pref,
+                                       resolution_limit_ms)
+from filodb_tpu.store.columnstore import InMemoryColumnStore
+from filodb_tpu.utils.observability import rollup_metrics
+
+BASE = 1_700_000_000_000
+RES = (60_000, 900_000)
+
+
+class Harness:
+    """Raw dataset + tier datasets in ONE memstore, engine wired the
+    way standalone wires it (flush listener + tier publish fns)."""
+
+    def __init__(self, resolutions=RES, store=None, meta=None,
+                 idle_close_s=None, admission=None, stall_after_s=120.0,
+                 schema="gauge"):
+        self.resolutions = tuple(resolutions)
+        self.store = store if store is not None else InMemoryColumnStore()
+        self.ms = TimeSeriesMemStore(self.store, meta)
+        self.shard = self.ms.setup("prom", DEFAULT_SCHEMAS, 0)
+        self.schema = schema
+        self.offsets: dict = {}
+        for r in self.resolutions:
+            self.ms.setup(ds_dataset_name("prom", r), DEFAULT_SCHEMAS, 0)
+        self.publish_for = {r: self._pub(r) for r in self.resolutions}
+        self.engine = RollupEngine(node="test")
+        self.cfg = RollupConfig(resolutions_ms=self.resolutions,
+                                idle_close_s=idle_close_s,
+                                stall_after_s=stall_after_s)
+        self.engine.watch("prom", self.ms, DEFAULT_SCHEMAS, self.cfg,
+                          self.publish_for, column_store=self.store,
+                          meta_store=self.ms.meta, admission=admission)
+        self.offset = 0
+        self.itime = 1000
+        self.raw_planner = SingleClusterPlanner(
+            "prom", ShardMapper(1), DatasetOptions(), spread_default=0)
+        tiers = {r: SingleClusterPlanner(
+            ds_dataset_name("prom", r), ShardMapper(1), DatasetOptions(),
+            spread_default=0) for r in self.resolutions}
+        self.router = RollupRouterPlanner(
+            "prom", self.raw_planner, tiers,
+            rolled_through_fn=lambda r: self.engine.rolled_through(
+                "prom", r))
+
+    def _pub(self, r):
+        name = ds_dataset_name("prom", r)
+
+        def pub(shard, container):
+            off = self.offsets.get((name, shard), -1) + 1
+            self.offsets[(name, shard)] = off
+            self.ms.ingest(name, shard, container, off)
+        return pub
+
+    def ingest(self, series_rows: dict) -> None:
+        """{tags_key: (tags, ts, vals)} appended as one batch."""
+        b = RecordBuilder(DEFAULT_SCHEMAS[self.schema])
+        for tags, ts, vals in series_rows:
+            for t, v in zip(ts, vals):
+                b.add(int(t), [float(v)], tags)
+        for c in b.containers():
+            self.ms.ingest("prom", 0, c, self.offset)
+            self.offset += 1
+
+    def flush_tick(self) -> None:
+        self.itime += 1
+        self.shard.flush_all(ingestion_time=self.itime)
+        self.engine.run_once("prom")
+
+    # ------------------------------------------------------------ oracles
+
+    def oracle_outputs(self, res):
+        """Offline full-pass downsample over EVERY persisted raw chunk
+        — the ground-truth ``downsample/`` path."""
+        pairs = [(parse_partkey(cs.partkey), cs) for _it, cs in
+                 self.store.chunksets_with_ingestion_time(
+                     "prom", 0, 0, 1 << 62)]
+        samp = ShardDownsampler("prom", 0, DEFAULT_SCHEMAS[self.schema],
+                                None, self.resolutions)
+        prepared = samp.prepare_arrays(pairs)
+        return samp.downsample_arrays(prepared, res)
+
+    def assert_tier_matches_oracle(self, res, last_ts_by_pk,
+                                   closed=True) -> int:
+        """Every tier series' persisted+resident rows must be byte-
+        equal to the oracle restricted to closed periods."""
+        tier_sh = self.ms.get_shard(ds_dataset_name("prom", res), 0)
+        checked = 0
+        for tags, pe, cols in self.oracle_outputs(res):
+            pk = canonical_partkey(tags)
+            pe = np.asarray(pe, dtype=np.int64)
+            if closed:
+                bound = ((last_ts_by_pk[pk] - 1) // res) * res
+                m = pe <= bound
+            else:
+                m = np.ones(len(pe), bool)
+            pid = tier_sh.part_set.get(pk)
+            assert pid is not None, (res, tags)
+            part = tier_sh.partitions[pid]
+            got_ts, _ = part.read_range(0, 1 << 62, 1)
+            assert np.asarray(got_ts).tobytes() == pe[m].tobytes(), \
+                (res, tags)
+            for ci in range(1, len(part.schema.data.columns)):
+                _, got = part.read_range(0, 1 << 62, ci)
+                assert np.asarray(got).tobytes() == \
+                    np.asarray(cols[ci - 1])[m].tobytes(), (res, tags, ci)
+            checked += 1
+        assert checked
+        return checked
+
+    def run_query(self, promql, start, step, end, planner=None,
+                  resolution=""):
+        qctx = QueryContext(sample_limit=10 ** 9,
+                            resolution_pref=resolution)
+        plan = query_range_to_logical_plan(promql, start, step, end)
+        ep = (planner or self.router).materialize(plan, qctx)
+        res = ep.execute(ExecContext(self.ms, qctx))
+        out = {}
+        for b in res.batches:
+            vals = b.np_values()
+            for i, tags in enumerate(b.keys):
+                out[tags.get("inst", "")] = (
+                    np.asarray(b.steps.timestamps()), vals[i])
+        return out, res, qctx
+
+
+class TestConfig:
+    def test_ladder_validation(self):
+        RollupConfig()   # defaults valid
+        with pytest.raises(RollupConfigError):
+            RollupConfig(resolutions_ms=())
+        with pytest.raises(RollupConfigError):
+            RollupConfig(resolutions_ms=(900_000, 60_000))
+        with pytest.raises(RollupConfigError):
+            RollupConfig(resolutions_ms=(60_000, 100_000))  # not multiple
+        with pytest.raises(RollupConfigError):
+            RollupConfig(resolutions_ms=(500,))
+        with pytest.raises(RollupConfigError):
+            RollupConfig(tick_interval_s=0)
+
+    def test_from_config_refuses_unknown_keys(self):
+        # a misspelled knob silently applying defaults is the broken-
+        # rule-config failure mode this refuses at startup
+        with pytest.raises(RollupConfigError):
+            RollupConfig.from_config({"tick-interval": 5})
+        with pytest.raises(RollupConfigError):
+            RollupConfig.from_config({"idle_close": "4h"})
+        RollupConfig.from_config({"enabled": True, "store": {},
+                                  "query": {"workers": 2}})
+
+    def test_from_config_idle_close_must_cover_coarsest(self):
+        # an idle window shorter than the coarsest period would force-
+        # close every open coarse period mid-way (partial records the
+        # complete ones could never replace): refused at startup
+        with pytest.raises(RollupConfigError):
+            RollupConfig.from_config({"resolutions": ["1m", "1h"],
+                                      "idle-close": "30m"})
+        RollupConfig.from_config({"resolutions": ["1m", "1h"],
+                                  "idle-close": "2h"})
+
+    def test_from_config_durations(self):
+        cfg = RollupConfig.from_config({
+            "resolutions": ["1m", "15m", "1h"], "tick-interval-s": 5,
+            "raw-retention": "6h", "idle-close": "0"})
+        assert cfg.resolutions_ms == (60_000, 900_000, 3_600_000)
+        assert cfg.raw_retention_ms == 6 * 3_600_000
+        assert cfg.idle_close_s is None
+        with pytest.raises(RollupConfigError):
+            RollupConfig.from_config({"resolutions": ["bogus"]})
+
+    def test_resolution_pref_parsing(self):
+        assert parse_resolution_pref("") is None
+        assert parse_resolution_pref("auto") is None
+        assert parse_resolution_pref("raw") == 0
+        assert parse_resolution_pref("1m") == 60_000
+
+    def test_resolution_limit(self):
+        plan = query_range_to_logical_plan(
+            'sum_over_time(m[5m])', BASE, 3_600_000, BASE + 10 ** 7)
+        assert resolution_limit_ms(plan, 3_600_000) == 300_000
+        plan = query_range_to_logical_plan(
+            'm', BASE, 3_600_000, BASE + 10 ** 7)
+        # instant selector: the staleness lookback bounds the tier
+        assert resolution_limit_ms(plan, 3_600_000) == 300_000
+        plan = query_range_to_logical_plan(
+            'sum_over_time(m[30m])', BASE, 900_000, BASE + 10 ** 7)
+        assert resolution_limit_ms(plan, 900_000) == 900_000
+
+
+def _mk_rows(rng, series_last, n_series, rows, span_ms, counter=False):
+    batch = []
+    for i in range(n_series):
+        lo = series_last.get(i, BASE)
+        ts = lo + np.sort(rng.integers(1, span_ms, rows))
+        ts = np.unique(ts)
+        series_last[i] = int(ts[-1])
+        if counter:
+            vals = np.cumsum(rng.random(len(ts)) * 3)
+            if rng.random() < 0.4:          # occasional reset
+                vals[len(vals) // 2:] -= vals[len(vals) // 2] * 0.95
+        else:
+            vals = rng.normal(10, 3, len(ts))
+        name = "c_total" if counter else "m"
+        tags = {"__name__": name, "inst": f"i{i}", "_ws_": "w",
+                "_ns_": "n"}
+        batch.append((tags, ts, vals))
+    return batch
+
+
+class TestLiveRollupEquivalence:
+    """(a) of the equivalence satellite: warm incremental emission ==
+    the offline downsample oracle, bit-equal over closed periods."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_gauge_generative(self, seed):
+        rng = np.random.default_rng(seed)
+        h = Harness()
+        last: dict = {}
+        for rnd in range(4):
+            h.ingest(_mk_rows(rng, last, n_series=4, rows=150,
+                              span_ms=20 * 60_000))
+            h.flush_tick()
+            if rng.random() < 0.3:
+                h.engine.run_once("prom")   # extra no-op tick
+        last_by_pk = {
+            canonical_partkey({"_metric_": "m", "inst": f"i{i}",
+                               "_ws_": "w", "_ns_": "n"}): ts
+            for i, ts in last.items()}
+        for res in RES:
+            h.assert_tier_matches_oracle(res, last_by_pk)
+        tier_sh = h.ms.get_shard(ds_dataset_name("prom", RES[0]), 0)
+        # per-series closure means no period is ever emitted twice
+        assert tier_sh.stats.out_of_order_dropped == 0
+
+    @pytest.mark.parametrize("seed", [3, 4])
+    def test_counter_with_resets_generative(self, seed):
+        rng = np.random.default_rng(seed)
+        h = Harness(schema="prom-counter")
+        last: dict = {}
+        for rnd in range(4):
+            h.ingest(_mk_rows(rng, last, n_series=3, rows=120,
+                              span_ms=15 * 60_000, counter=True))
+            h.flush_tick()
+        last_by_pk = {
+            canonical_partkey({"_metric_": "c_total", "inst": f"i{i}",
+                               "_ws_": "w", "_ns_": "n"}): ts
+            for i, ts in last.items()}
+        for res in RES:
+            h.assert_tier_matches_oracle(res, last_by_pk)
+
+    def test_idle_close_emits_open_periods(self):
+        h = Harness(idle_close_s=0.0)
+        rng = np.random.default_rng(9)
+        last: dict = {}
+        h.ingest(_mk_rows(rng, last, n_series=2, rows=100,
+                          span_ms=10 * 60_000))
+        h.flush_tick()
+        # second tick: no new data -> every silent series force-closes
+        h.engine.run_once("prom")
+        last_by_pk = {
+            canonical_partkey({"_metric_": "m", "inst": f"i{i}",
+                               "_ws_": "w", "_ns_": "n"}): ts
+            for i, ts in last.items()}
+        for res in RES:
+            h.assert_tier_matches_oracle(res, last_by_pk, closed=False)
+        # state dropped after force-close
+        st = h.engine.admin_state()["datasets"][0]["shards"][0]
+        assert st["buffered_series"] == 0
+
+    def test_resumed_series_never_recollides_with_forced_close(self):
+        """A series resuming INSIDE a force-closed period must not
+        re-emit that period's stamp (the tier's first-copy dedupe
+        would keep the partial record and silently drop the re-emit):
+        the idle-close sweep persists the emitted stamps as restart
+        seeds, so the resumed state picks up where it closed."""
+        h = Harness(idle_close_s=0.0)
+        tags = {"__name__": "m", "inst": "i0", "_ws_": "w", "_ns_": "n"}
+        res = RES[0]
+        p_start = ((BASE // res) + 1) * res          # a period boundary
+        first = p_start + np.arange(1, 20_000, 10_000, dtype=np.int64)
+        h.ingest([(tags, first, np.ones(len(first)))])
+        h.flush_tick()
+        h.engine.run_once("prom")     # idle sweep: period force-closed
+        tier_sh = h.ms.get_shard(ds_dataset_name("prom", res), 0)
+        assert tier_sh.stats.rows_ingested >= 1
+        # resume inside the SAME period, then past it
+        later = p_start + np.arange(30_001, 3 * res, 10_000,
+                                    dtype=np.int64)
+        h.ingest([(tags, later, np.full(len(later), 2.0))])
+        h.flush_tick()
+        pk = canonical_partkey({"_metric_": "m", "inst": "i0",
+                                "_ws_": "w", "_ns_": "n"})
+        part = tier_sh.partitions[tier_sh.part_set[pk]]
+        got_ts, counts = part.read_range(0, 1 << 62, 4)
+        got_ts = np.asarray(got_ts)
+        # stamps strictly increasing, the forced period never re-sent
+        assert (np.diff(got_ts) > 0).all()
+        assert tier_sh.stats.out_of_order_dropped == 0
+        # the force-closed period keeps its (partial) count of 2; the
+        # resumed rows inside it are the documented idle-close loss
+        assert int(np.asarray(counts)[0]) == 2
+
+    def test_resume_in_the_condemning_tick_is_not_force_closed(self):
+        """A series whose resume flush lands in the very tick the idle
+        scan first condemns it must NOT be force-closed: the fresh
+        rows re-arm it, and its periods emit under normal closure."""
+        h = Harness(idle_close_s=0.0)
+        tags = {"__name__": "m", "inst": "i0", "_ws_": "w", "_ns_": "n"}
+        res = RES[0]
+        p_start = ((BASE // res) + 1) * res
+        h.ingest([(tags, p_start + np.arange(1, 20_000, 10_000,
+                                             dtype=np.int64),
+                   np.ones(2))])
+        h.flush_tick()
+        # resume INSIDE the open period, consumed by the same tick the
+        # idle scan would condemn the state
+        h.ingest([(tags, p_start + np.arange(30_001, 60_000, 10_000,
+                                             dtype=np.int64),
+                   np.ones(3))])
+        h.flush_tick()
+        tier_sh = h.ms.get_shard(ds_dataset_name("prom", res), 0)
+        pk = canonical_partkey({"_metric_": "m", "inst": "i0",
+                                "_ws_": "w", "_ns_": "n"})
+        pid = tier_sh.part_set.get(pk)
+        if pid is not None:
+            part = tier_sh.partitions[pid]
+            got_ts, _ = part.read_range(0, 1 << 62, 1)
+            # the open period (end p_start + res) must NOT be emitted
+            assert p_start + res not in set(
+                int(x) for x in np.asarray(got_ts))
+        # close it normally and check the COMPLETE record landed
+        h.ingest([(tags, np.asarray([p_start + res + 1], np.int64),
+                   np.ones(1))])
+        h.flush_tick()
+        part = tier_sh.partitions[tier_sh.part_set[pk]]
+        got_ts, counts = part.read_range(0, 1 << 62, 4)
+        by_stamp = dict(zip((int(x) for x in np.asarray(got_ts)),
+                            np.asarray(counts)))
+        assert by_stamp[p_start + res] == 5.0   # all 2+3 rows counted
+
+    def test_consume_failure_requeues_and_heals(self):
+        """A decode/staging failure mid-consume must not LOSE the
+        drained flush batches: they requeue and the next tick replays
+        them losslessly."""
+        import unittest.mock as mock
+        from filodb_tpu.downsample import sharddown
+        h = Harness()
+        rng = np.random.default_rng(23)
+        last: dict = {}
+        h.ingest(_mk_rows(rng, last, 2, 100, 10 * 60_000))
+        with mock.patch.object(sharddown, "decode_concat_with_keys",
+                               side_effect=RuntimeError("poisoned")):
+            h.flush_tick()
+        sr = h.engine._datasets["prom"].shards[0]
+        assert sr.queue, "failed batches must requeue"
+        assert h.engine._datasets["prom"].tier_errors
+        tier_sh = h.ms.get_shard(ds_dataset_name("prom", RES[0]), 0)
+        assert tier_sh.stats.rows_ingested == 0
+        h.engine.run_once("prom")        # healed: replay the backlog
+        last_by_pk = {
+            canonical_partkey({"_metric_": "m", "inst": f"i{i}",
+                               "_ws_": "w", "_ns_": "n"}): ts
+            for i, ts in last.items()}
+        for res in RES:
+            h.assert_tier_matches_oracle(res, last_by_pk)
+
+    def test_stop_detaches_flush_listeners(self):
+        h = Harness()
+        assert h.shard.rollup_listener is not None
+        h.engine.stop()
+        assert h.shard.rollup_listener is None
+        # a post-stop flush must not accumulate into dead queues
+        rng = np.random.default_rng(24)
+        last: dict = {}
+        h.ingest(_mk_rows(rng, last, 1, 50, 5 * 60_000))
+        h.shard.flush_all(ingestion_time=99)
+        assert not h.engine._datasets["prom"].shards[0].queue
+
+    def test_idle_drop_held_back_by_a_failed_tier_emission(self):
+        """An idle (force-closed) series may only drop once EVERY tier
+        emitted AND delivered — a transient reduce failure on one tier
+        must not discard the rows the retry still needs."""
+        import unittest.mock as mock
+        h = Harness(idle_close_s=0.0)
+        rng = np.random.default_rng(31)
+        last: dict = {}
+        h.ingest(_mk_rows(rng, last, 2, 80, 10 * 60_000))
+        h.flush_tick()
+        orig = ShardDownsampler.downsample_arrays
+
+        def flaky(self, prepared, res):
+            if res == RES[1]:
+                raise RuntimeError("coarse tier reduce down")
+            return orig(self, prepared, res)
+        with mock.patch.object(ShardDownsampler, "downsample_arrays",
+                               flaky):
+            h.engine.run_once("prom")   # idle sweep, coarse tier fails
+        sr = h.engine._datasets["prom"].shards[0]
+        assert sr.series, "idle states dropped despite a failed tier"
+        h.engine.run_once("prom")       # healed: force-close completes
+        last_by_pk = {
+            canonical_partkey({"_metric_": "m", "inst": f"i{i}",
+                               "_ws_": "w", "_ns_": "n"}): ts
+            for i, ts in last.items()}
+        for res in RES:
+            h.assert_tier_matches_oracle(res, last_by_pk, closed=False)
+
+    def test_wedged_shard_trips_stall_despite_healthy_peer(self):
+        """Per-shard stall clocks: one healthy shard must not mask a
+        permanently wedged one."""
+        h2 = Harness(stall_after_s=0.01)
+        # second raw shard alongside the harness's shard 0
+        shard1 = h2.ms.setup("prom", DEFAULT_SCHEMAS, 1)
+        h2.engine.attach_shard("prom", shard1)
+        good = h2.publish_for[RES[0]]
+
+        def shard1_down(shard, container):
+            if shard == 1:
+                raise RuntimeError("shard-1 tier sink down")
+            good(shard, container)
+        h2.engine._datasets["prom"].publish_for[RES[0]] = shard1_down
+        rng = np.random.default_rng(32)
+        last: dict = {}
+        rows = _mk_rows(rng, last, 2, 120, 10 * 60_000)
+        b = RecordBuilder(DEFAULT_SCHEMAS["gauge"])
+        for tags, ts, vals in rows:
+            for t, v in zip(ts, vals):
+                b.add(int(t), [float(v)], tags)
+        for c in b.containers():
+            h2.ms.ingest("prom", 0, c, 0)
+            h2.ms.ingest("prom", 1, c, 0)
+        h2.shard.flush_all(ingestion_time=1)
+        shard1.flush_all(ingestion_time=1)
+        h2.engine.run_once("prom")
+        time.sleep(0.05)
+        h2.engine.run_once("prom")      # shard 0 idle-fine, shard 1 wedged
+        stalled = rollup_metrics()["stalled"]
+        assert stalled.value(dataset="prom",
+                             resolution=str(RES[0])) == 1.0
+        h2.engine.stop()
+
+    def test_failing_schema_not_masked_by_healthy_one(self):
+        """A counter schema wedged on a tier must keep the tier error
+        visible and trip the stall gauge even while the gauge schema
+        keeps emitting happily for the same resolution."""
+        import unittest.mock as mock
+        h = Harness(stall_after_s=0.01)
+        rng = np.random.default_rng(41)
+        glast: dict = {}
+        clast: dict = {}
+        orig = ShardDownsampler.downsample_arrays
+        chash = DEFAULT_SCHEMAS["prom-counter"].schema_hash
+
+        def flaky(self, prepared, res):
+            if self.schema.schema_hash == chash:
+                raise RuntimeError("counter reduce wedged")
+            return orig(self, prepared, res)
+
+        def ingest_both():
+            h.ingest(_mk_rows(rng, glast, 2, 60, 8 * 60_000))
+            b = RecordBuilder(DEFAULT_SCHEMAS["prom-counter"])
+            for tags, ts, vals in _mk_rows(rng, clast, 2, 60,
+                                           8 * 60_000, counter=True):
+                for t, v in zip(ts, vals):
+                    b.add(int(t), [float(v)], tags)
+            for c in b.containers():
+                h.ms.ingest("prom", 0, c, h.offset)
+                h.offset += 1
+        with mock.patch.object(ShardDownsampler, "downsample_arrays",
+                               flaky):
+            ingest_both()
+            h.flush_tick()
+            time.sleep(0.05)
+            ingest_both()
+            h.flush_tick()   # gauge advances again; counter still wedged
+            assert h.engine._datasets["prom"].tier_errors, \
+                "healthy schema cleared the wedged schema's error"
+            stalled = rollup_metrics()["stalled"]
+            assert stalled.value(dataset="prom",
+                                 resolution=str(RES[0])) == 1.0
+        h.engine.stop()
+
+    def test_queue_overflow_recovers_via_store_replay(self):
+        """A flush-queue overflow drops the handoff but flips the shard
+        to the store-replay path: nothing persisted is lost."""
+        import filodb_tpu.rollup.engine as eng_mod
+        h = Harness()
+        rng = np.random.default_rng(33)
+        last: dict = {}
+        h.ingest(_mk_rows(rng, last, 2, 100, 10 * 60_000))
+        h.flush_tick()                   # persists the replay floor
+        old_cap = eng_mod._QUEUE_CAP
+        eng_mod._QUEUE_CAP = 0
+        try:
+            h.ingest(_mk_rows(rng, last, 2, 100, 10 * 60_000))
+            h.itime += 1
+            h.shard.flush_all(ingestion_time=h.itime)   # overflows
+        finally:
+            eng_mod._QUEUE_CAP = old_cap
+        sr = h.engine._datasets["prom"].shards[0]
+        assert sr.lost and not sr.active
+        assert h.engine._datasets["prom"].tier_errors
+        h.engine.run_once("prom")        # restore replays from the store
+        last_by_pk = {
+            canonical_partkey({"_metric_": "m", "inst": f"i{i}",
+                               "_ws_": "w", "_ns_": "n"}): ts
+            for i, ts in last.items()}
+        for res in RES:
+            h.assert_tier_matches_oracle(res, last_by_pk)
+
+    def test_start_after_stop_reattaches_listeners(self):
+        h = Harness()
+        rng = np.random.default_rng(34)
+        last: dict = {}
+        h.ingest(_mk_rows(rng, last, 2, 80, 10 * 60_000))
+        h.flush_tick()
+        h.engine.stop()
+        assert h.shard.rollup_listener is None
+        h.engine.start()
+        assert h.shard.rollup_listener is not None
+        # flushes land again and the stopped gap replays from the store
+        h.ingest(_mk_rows(rng, last, 2, 80, 10 * 60_000))
+        h.itime += 1
+        h.shard.flush_all(ingestion_time=h.itime)
+        h.engine.run_once("prom")
+        last_by_pk = {
+            canonical_partkey({"_metric_": "m", "inst": f"i{i}",
+                               "_ws_": "w", "_ns_": "n"}): ts
+            for i, ts in last.items()}
+        for res in RES:
+            h.assert_tier_matches_oracle(res, last_by_pk)
+        h.engine.stop()
+
+    def test_ownership_loss_removes_shard_gauge_rows(self):
+        """A frozen lag row from before a failover must not keep an
+        alert latched on the OLD owner forever."""
+        h = Harness()
+        rng = np.random.default_rng(35)
+        last: dict = {}
+        h.ingest(_mk_rows(rng, last, 2, 80, 10 * 60_000))
+        h.flush_tick()
+        lag = rollup_metrics()["lag"]
+        assert any('dataset="prom"' in line and 'shard="0"' in line
+                   for line in lag.expose())
+        # the shard fails over: this node no longer owns it
+        h.engine._datasets["prom"].owner_fn = lambda s: False
+        h.engine.run_once("prom")
+        assert not any('dataset="prom"' in line and 'shard="0"' in line
+                       for line in lag.expose())
+        h.engine.stop()
+
+    def test_pure_replica_routes_from_delivered_tier_data(self):
+        """A node that rolls nothing (owner_fn False) still routes from
+        the rolled stamps DELIVERED to its tier replica — and a lagging
+        delivery floors the stitch boundary instead of leaving holes."""
+        h = Harness()
+        h.engine._datasets["prom"].owner_fn = lambda s: False
+        assert h.engine.rolled_through("prom", RES[0]) < 0
+        # simulate the fanout delivering peer-rolled records
+        b = RecordBuilder(DEFAULT_SCHEMAS["ds-gauge"])
+        pe = (((BASE // RES[0]) + 1 + np.arange(5)) * RES[0]).astype(
+            np.int64)
+        b.add_series([int(x) for x in pe],
+                     [[1.0] * 5, [1.0] * 5, [5.0] * 5, [5.0] * 5,
+                      [1.0] * 5],
+                     {"_metric_": "m", "inst": "i0", "_ws_": "w",
+                      "_ns_": "n"})
+        for off, c in enumerate(b.containers()):
+            h.ms.ingest(ds_dataset_name("prom", RES[0]), 0, c, off)
+        h.engine.run_once("prom")
+        assert h.engine.rolled_through("prom", RES[0]) == int(pe[-1])
+
+
+class TestStitchedServing:
+    """(b) of the equivalence satellite: raw/rolled stitching at the
+    tier boundary is continuous — no gap, no double-counted boundary
+    step — across randomized ranges/steps."""
+
+    @pytest.fixture(scope="class")
+    def served(self):
+        rng = np.random.default_rng(7)
+        h = Harness()
+        last: dict = {}
+        for rnd in range(3):
+            h.ingest(_mk_rows(rng, last, n_series=3, rows=200,
+                              span_ms=40 * 60_000))
+            h.flush_tick()
+        return h, last
+
+    @pytest.mark.parametrize("trial", range(6))
+    def test_count_continuity_randomized(self, served, trial):
+        h, last = served
+        rng = np.random.default_rng(100 + trial)
+        step = int(rng.choice([60_000, 120_000, 300_000]))
+        lo = BASE + int(rng.integers(0, 20)) * 60_000
+        hi = max(last.values()) + int(rng.integers(-10, 10)) * 60_000
+        start = (lo // step + 1) * step
+        end = (hi // step) * step
+        q = f'count_over_time(m{{_ws_="w",_ns_="n"}}[{step // 1000}s])'
+        got, res, qctx = h.run_query(q, start, step, end)
+        want, res_raw, _ = h.run_query(q, start, step, end,
+                                       planner=h.raw_planner)
+        assert qctx.rollup_resolution_ms in (0,) + RES
+        assert set(got) == set(want)
+        for inst, (ts_w, vals_w) in want.items():
+            ts_g, vals_g = got[inst]
+            # full step grid answered — no gap at the stitch boundary
+            np.testing.assert_array_equal(ts_g, ts_w)
+            # counts are integers: rolled-region windows (sums of
+            # per-period counts) must equal raw counts EXACTLY — a
+            # double-counted or dropped boundary step cannot hide
+            gw = np.nan_to_num(vals_g, nan=-1.0)
+            ww = np.nan_to_num(vals_w, nan=-1.0)
+            np.testing.assert_array_equal(gw, ww)
+
+    def test_rolled_region_bitequal_to_offline_store(self, served):
+        """A served rolled-tier answer over aligned windows is
+        bit-equal to the same PromQL against a ds store built by the
+        OFFLINE downsample path (the oracle serving arm)."""
+        h, last = served
+        res = RES[0]
+        bound = min(((ts - 1) // res) * res for ts in last.values())
+        step = 300_000
+        start = (BASE // step + 2) * step
+        end = (bound // step) * step
+        q = f'sum_over_time(m{{_ws_="w",_ns_="n"}}[5m])'
+        got, qres, qctx = h.run_query(q, start, step, end)
+        assert qctx.rollup_resolution_ms == res
+        # offline arm: BatchDownsampler-style store from the oracle
+        # outputs, served through the plain tier planner
+        oms = TimeSeriesMemStore()
+        oname = ds_dataset_name("prom", res)
+        osh = oms.setup(oname, DEFAULT_SCHEMAS, 0)
+        b = RecordBuilder(DEFAULT_SCHEMAS["ds-gauge"])
+        for tags, pe, cols in h.oracle_outputs(res):
+            b.add_series([int(x) for x in pe],
+                         [np.asarray(c).tolist() for c in cols], tags)
+        for off, c in enumerate(b.containers()):
+            oms.ingest(oname, 0, c, off)
+        oplanner = SingleClusterPlanner(oname, ShardMapper(1),
+                                        DatasetOptions(), spread_default=0)
+        plan = query_range_to_logical_plan(q, start, step, end)
+        ep = oplanner.materialize(plan, QueryContext(sample_limit=10 ** 9))
+        ores = ep.execute(ExecContext(oms))
+        want = {}
+        for batch in ores.batches:
+            vals = batch.np_values()
+            for i, tags in enumerate(batch.keys):
+                want[tags.get("inst", "")] = vals[i]
+        assert set(got) == set(want)
+        for inst, w in want.items():
+            g = got[inst][1]
+            assert np.asarray(g).tobytes() == np.asarray(w).tobytes(), \
+                inst
+
+    def test_min_scan_profit(self, served):
+        """The acceptance ratio: a long-range rolled query scans >=10x
+        fewer samples than the raw-pinned path."""
+        h, last = served
+        step = 300_000
+        start = (BASE // step + 1) * step
+        end = (max(last.values()) // step) * step
+        q = f'sum_over_time(m{{_ws_="w",_ns_="n"}}[5m])'
+        _, res_rolled, qctx = h.run_query(q, start, step, end)
+        _, res_raw, _ = h.run_query(q, start, step, end,
+                                    resolution="raw")
+        assert qctx.rollup_resolution_ms == RES[0]
+        assert res_rolled.stats.resolution_ms == 0  # stamped by HTTP layer
+        assert res_raw.stats.samples_scanned >= \
+            10 * res_rolled.stats.samples_scanned
+
+
+class TestRouter:
+    @pytest.fixture(scope="class")
+    def harness(self):
+        rng = np.random.default_rng(11)
+        h = Harness(resolutions=(60_000, 900_000))
+        last: dict = {}
+        # regular cadence over ~6h so the 15m tier closes periods
+        b = []
+        for i in range(2):
+            ts = BASE + np.arange(0, 6 * 3_600_000, 30_000) + 1
+            b.append(({"__name__": "m", "inst": f"i{i}", "_ws_": "w",
+                       "_ns_": "n"}, ts, rng.normal(5, 1, len(ts))))
+            last[i] = int(ts[-1])
+        h.ingest(b)
+        h.flush_tick()
+        return h, last
+
+    def _materialize(self, h, q, start, step, end, resolution=""):
+        qctx = QueryContext(sample_limit=10 ** 9,
+                            resolution_pref=resolution)
+        plan = query_range_to_logical_plan(q, start, step, end)
+        h.router.materialize(plan, qctx)
+        return qctx.rollup_resolution_ms
+
+    def test_tier_selection(self, harness):
+        h, last = harness
+        end = (max(last.values()) // 3_600_000) * 3_600_000
+        sel = 'm{_ws_="w",_ns_="n"}'
+        # 15s step: no tier fits -> raw
+        assert self._materialize(h, f'sum_over_time({sel}[15s])',
+                                 BASE, 15_000, end) == 0
+        # 5m window bounds the tier at 60s even at 1h step
+        assert self._materialize(h, f'sum_over_time({sel}[5m])',
+                                 BASE, 3_600_000, end) == 60_000
+        # 30m window + 30m step -> the 15m tier
+        assert self._materialize(h, f'sum_over_time({sel}[30m])',
+                                 BASE, 1_800_000, end) == 900_000
+        # explicit pins
+        assert self._materialize(h, f'sum_over_time({sel}[30m])',
+                                 BASE, 1_800_000, end,
+                                 resolution="raw") == 0
+        assert self._materialize(h, f'sum_over_time({sel}[30m])',
+                                 BASE, 1_800_000, end,
+                                 resolution="1m") == 60_000
+        # an explicit pin OUTSIDE the ladder is a client error (400),
+        # never a silent fall-through to raw
+        with pytest.raises(ValueError):
+            self._materialize(h, f'sum_over_time({sel}[30m])',
+                              BASE, 1_800_000, end, resolution="5m")
+        routed = rollup_metrics()["routed"]
+        assert routed.value(dataset="prom", resolution="60000") >= 1
+        assert routed.value(dataset="prom", resolution="raw") >= 1
+
+    def test_retention_past_rolled_watermark_serves_raw_not_holes(
+            self, harness):
+        """raw-retention is a routing knob, not a deleter: when the
+        tier's rolled watermark trails the retention floor, the raw
+        side serves the gap — fresh steps must never come back empty."""
+        h, last = harness
+        end = (max(last.values()) // 300_000) * 300_000
+        start = end - 3_600_000
+        rolled_hwm = start + 600_000      # tier far behind retention
+        router = RollupRouterPlanner(
+            "prom", h.raw_planner,
+            {60_000: h.router.tiers[60_000]},
+            rolled_through_fn=lambda r: rolled_hwm,
+            raw_retention_ms=1,           # "retention" = now-1ms
+            now_ms_fn=lambda: end)
+        qctx = QueryContext(sample_limit=10 ** 9)
+        plan = query_range_to_logical_plan(
+            'count_over_time(m{_ws_="w",_ns_="n"}[5m])',
+            start, 300_000, end)
+        ep = router.materialize(plan, qctx)
+        res = ep.execute(ExecContext(h.ms, qctx))
+        got = {}
+        for b in res.batches:
+            vals = b.np_values()
+            for i, tags in enumerate(b.keys):
+                got.setdefault(tags["inst"], {}).update(
+                    zip((int(t) for t in b.steps.timestamps()), vals[i]))
+        # raw-pinned twin for comparison
+        plan2 = query_range_to_logical_plan(
+            'count_over_time(m{_ws_="w",_ns_="n"}[5m])',
+            start, 300_000, end)
+        ep2 = h.raw_planner.materialize(plan2,
+                                        QueryContext(sample_limit=10 ** 9))
+        res2 = ep2.execute(ExecContext(h.ms))
+        want = {}
+        for b in res2.batches:
+            vals = b.np_values()
+            for i, tags in enumerate(b.keys):
+                want.setdefault(tags["inst"], {}).update(
+                    zip((int(t) for t in b.steps.timestamps()), vals[i]))
+        assert set(got) == set(want)
+        for inst in want:
+            g = {t: (-1 if np.isnan(v) else v)
+                 for t, v in got[inst].items()}
+            w = {t: (-1 if np.isnan(v) else v)
+                 for t, v in want[inst].items()}
+            assert g == w, inst
+
+    def test_retention_forces_finest_tier(self, harness):
+        h, last = harness
+        end = max(last.values())
+        # raw retention of 1ms: everything is past retention; even a
+        # 15s-step query must route (finest tier, best effort)
+        tiers = {60_000: h.raw_planner}
+        router = RollupRouterPlanner(
+            "prom", h.raw_planner, tiers,
+            rolled_through_fn=lambda r: end + 10 ** 9,
+            raw_retention_ms=1)
+        qctx = QueryContext(sample_limit=10 ** 9)
+        plan = query_range_to_logical_plan(
+            'sum_over_time(m[15s])', BASE, 15_000, end)
+        router.materialize(plan, qctx)
+        assert qctx.rollup_resolution_ms == 60_000
+
+
+class TestOperational:
+    def test_admission_defers_and_recovers(self):
+        from filodb_tpu.workload.admission import AdmissionController
+        from filodb_tpu.workload.cost import CostModel
+        ctrl = AdmissionController(CostModel(), dataset="prom",
+                                   max_inflight_cost=0.1, workers=1)
+        h = Harness(admission=ctrl)
+        rng = np.random.default_rng(5)
+        last: dict = {}
+        h.ingest(_mk_rows(rng, last, 2, 80, 10 * 60_000))
+        before = rollup_metrics()["deferred"].value(dataset="prom")
+        h.flush_tick()      # cost >= 1 > 0.3 * 0.1 ceiling -> shed
+        assert rollup_metrics()["deferred"].value(dataset="prom") \
+            == before + 1
+        tier_sh = h.ms.get_shard(ds_dataset_name("prom", RES[0]), 0)
+        assert tier_sh.stats.rows_ingested == 0
+        # overload clears: the requeued batch is consumed next tick
+        ctrl.configure(max_inflight_cost=1e9)
+        h.engine.run_once("prom")
+        assert tier_sh.stats.rows_ingested > 0
+        ctrl.shutdown()
+
+    def test_publish_failure_stalls_then_recovers(self):
+        h = Harness(stall_after_s=0.01)
+        boom = RuntimeError("tier sink down")
+        good = h.publish_for[RES[0]]
+
+        def bad(shard, container):
+            raise boom
+        h.engine._datasets["prom"].publish_for[RES[0]] = bad
+        rng = np.random.default_rng(6)
+        last: dict = {}
+        h.ingest(_mk_rows(rng, last, 2, 120, 10 * 60_000))
+        errs = rollup_metrics()["errors"]
+        before = errs.value(dataset="prom", resolution=str(RES[0]))
+        h.flush_tick()
+        assert errs.value(dataset="prom",
+                          resolution=str(RES[0])) == before + 1
+        time.sleep(0.05)
+        h.engine.run_once("prom")   # still failing? no new data, but
+        # the stall clock on the broken tier has not advanced
+        stalled = rollup_metrics()["stalled"]
+        assert stalled.value(dataset="prom",
+                             resolution=str(RES[0])) == 1.0
+        # cursors never advanced past the failed publish: healing the
+        # sink re-emits everything, losslessly
+        h.engine._datasets["prom"].publish_for[RES[0]] = good
+        h.ingest(_mk_rows(rng, last, 2, 40, 4 * 60_000))
+        h.flush_tick()
+        assert stalled.value(dataset="prom",
+                             resolution=str(RES[0])) == 0.0
+        last_by_pk = {
+            canonical_partkey({"_metric_": "m", "inst": f"i{i}",
+                               "_ws_": "w", "_ns_": "n"}): ts
+            for i, ts in last.items()}
+        h.assert_tier_matches_oracle(RES[0], last_by_pk)
+
+    def test_admin_endpoint_and_stop_removes_gauges(self):
+        from filodb_tpu.http.server import FiloHttpServer
+        h = Harness()
+        rng = np.random.default_rng(8)
+        last: dict = {}
+        h.ingest(_mk_rows(rng, last, 2, 80, 10 * 60_000))
+        h.flush_tick()
+        srv = FiloHttpServer(rollup=h.engine)
+        code, body = srv._admin_rollup()
+        assert code == 200
+        ds = body["data"]["datasets"][0]
+        assert ds["dataset"] == "prom"
+        assert ds["passes"] >= 1
+        sh0 = ds["shards"][0]
+        assert sh0["buffered_series"] == 2
+        assert sh0["tiers"][str(RES[0])]["emitted_through_ms"] is not None
+        assert int(ds["samples_written"][str(RES[0])]) > 0
+        # CLI text renderer consumes the same payload without raising
+        import io
+        from contextlib import redirect_stdout
+        from filodb_tpu import cli
+        import unittest.mock as mock
+
+        class A:
+            server = "http://x"
+            json = False
+        with mock.patch.object(cli, "_http_get",
+                               return_value={"status": "success",
+                                             "data": body["data"]}):
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                assert cli.cmd_rollup_status(A()) == 0
+            assert "dataset prom" in buf.getvalue()
+        # stop() removes every exported gauge row (Gauge.remove contract)
+        lag = rollup_metrics()["lag"]
+        assert any("filodb_rollup_lag_seconds{" in line
+                   for line in lag.expose())
+        h.engine.stop()
+        rows = [line for line in lag.expose()
+                if 'dataset="prom"' in line]
+        assert not rows
+
+    def test_no_rollup_endpoint_404(self):
+        from filodb_tpu.http.server import FiloHttpServer
+        srv = FiloHttpServer()
+        code, _ = srv._admin_rollup()
+        assert code == 404
+
+
+class TestRestart:
+    def test_resumes_from_persisted_hwm(self, tmp_path):
+        from filodb_tpu.store.persistence import (DiskColumnStore,
+                                                  DiskMetaStore)
+        store = DiskColumnStore(str(tmp_path / "c.db"))
+        meta = DiskMetaStore(str(tmp_path / "m.db"))
+        rng = np.random.default_rng(13)
+        last: dict = {}
+
+        h1 = Harness(store=store, meta=meta)
+        for rnd in range(2):
+            h1.ingest(_mk_rows(rng, last, 3, 120, 15 * 60_000))
+            h1.flush_tick()
+        # persist the TIER datasets too (their chunk stamps are the
+        # restart cursors), then "crash"
+        seeded = {}
+        for r in RES:
+            tsh = h1.ms.get_shard(ds_dataset_name("prom", r), 0)
+            tsh.flush_all(ingestion_time=5000)
+            seeded[r] = {pk: tsh.partitions[pid].latest_timestamp
+                         for pk, pid in tsh.part_set.items()}
+        offset, itime = h1.offset, h1.itime
+        h1.engine.stop()
+        h1.ms.reset()
+
+        h2 = Harness(store=store, meta=meta)
+        h2.offset, h2.itime = offset, itime
+        h2.ingest(_mk_rows(rng, last, 3, 120, 15 * 60_000))
+        h2.flush_tick()
+        last_by_pk = {
+            canonical_partkey({"_metric_": "m", "inst": f"i{i}",
+                               "_ws_": "w", "_ns_": "n"}): ts
+            for i, ts in last.items()}
+        for res in RES:
+            tier_sh = h2.ms.get_shard(ds_dataset_name("prom", res), 0)
+            # the fresh node re-emitted NOTHING the old node persisted
+            for pk, pid in tier_sh.part_set.items():
+                part = tier_sh.partitions.get(pid)
+                if part is None:
+                    continue
+                ts_new, _ = part.read_range(0, 1 << 62, 1)
+                if len(ts_new) and pk in seeded[res]:
+                    assert int(ts_new[0]) > seeded[res][pk], (res, pk)
+            # persisted (pre-crash) + resident (post-restart) rows
+            # together equal the continuous-run oracle
+            samp_pairs = {}
+            for _it, cs in store.chunksets_with_ingestion_time(
+                    ds_dataset_name("prom", res), 0, 0, 1 << 62):
+                from filodb_tpu.core.chunk import decode_chunkset
+                ts_c, cols_c = decode_chunkset(
+                    DEFAULT_SCHEMAS["ds-gauge"], cs)
+                entry = samp_pairs.setdefault(cs.partkey, [])
+                entry.append((np.asarray(ts_c), [np.asarray(c)
+                                                 for c in cols_c]))
+            checked = 0
+            for tags, pe, cols in h2.oracle_outputs(res):
+                pk = canonical_partkey(tags)
+                bound = ((last_by_pk[pk] - 1) // res) * res
+                pe = np.asarray(pe, dtype=np.int64)
+                m = pe <= bound
+                got_ts = []
+                got_cols = [[] for _ in cols]
+                for ts_c, cols_c in samp_pairs.get(pk, []):
+                    got_ts.append(ts_c)
+                    for ci, c in enumerate(cols_c):
+                        got_cols[ci].append(c)
+                pid = tier_sh.part_set.get(pk)
+                if pid is not None and pid in tier_sh.partitions:
+                    part = tier_sh.partitions[pid]
+                    ts_r, _ = part.read_range(0, 1 << 62, 1)
+                    if len(ts_r):
+                        got_ts.append(np.asarray(ts_r))
+                        for ci in range(len(cols)):
+                            _, v = part.read_range(0, 1 << 62, ci + 1)
+                            got_cols[ci].append(np.asarray(v))
+                all_ts = np.concatenate(got_ts) if got_ts else \
+                    np.empty(0, np.int64)
+                order = np.argsort(all_ts, kind="stable")
+                all_ts = all_ts[order]
+                # no duplicates across the restart boundary
+                assert (np.diff(all_ts) > 0).all(), (res, tags)
+                assert all_ts.astype(np.int64).tobytes() == \
+                    pe[m].tobytes(), (res, tags)
+                for ci in range(len(cols)):
+                    v = np.concatenate(got_cols[ci])[order]
+                    assert v.tobytes() == \
+                        np.asarray(cols[ci])[m].tobytes(), (res, tags)
+                checked += 1
+            assert checked == 3
+        h2.engine.stop()
