@@ -6,6 +6,7 @@ a CLI (``python -m filodb_tpu.analysis`` / the ``lint`` CLI verb).
 
 Rule modules register themselves on import:
 
+- caches.py     — bounded-cache (serving-path memos need eviction)
 - locks.py      — lock-discipline, blocking-under-lock (whole-program)
 - lockorder.py  — lock-order-cycle, lock-order-inversion (deadlocks)
 - device.py     — host-sync, host-sync-annotation, recompile-hazard,
@@ -27,7 +28,7 @@ from .engine import (  # noqa: F401
     unsuppressed,
 )
 from . import callgraph  # noqa: F401,E402 — whole-program call graph
-from . import device, lifecycle, lockorder, locks, sentinels  # noqa: F401,E402 — register rules
+from . import caches, device, lifecycle, lockorder, locks, sentinels  # noqa: F401,E402 — register rules
 from .report import (  # noqa: F401
     render_github, render_json, render_rule_list, render_text, summarize,
 )

@@ -1,0 +1,196 @@
+"""bounded-cache: serving-path memo dicts must have an eviction bound.
+
+The PR 11 gateway-memo stampede made structural: a dict used as a memo
+on a serving-path module (guarded read + keyed write — the classic
+``if k not in memo: memo[k] = compute()`` shape) grows with the key
+space, and on a label-flood the memo IS the OOM.  Every such memo must
+show an eviction bound somewhere in its owning scope — a ``pop`` /
+``popitem`` / ``del`` / ``clear``, a ``len(memo)`` comparison driving
+one, or handing the memo to an evict helper.  Justified unbounded maps
+(key space structurally bounded, process-lifetime registries) carry a
+``# filolint: disable=bounded-cache — <reason>`` on the write line.
+
+Detection is deliberately narrow: an attribute/module-global that is
+(a) initialized as a dict/OrderedDict, (b) read through ``.get`` /
+``in`` / subscript AND keyed-written in the SAME function.  Plain
+accumulators, flush queues, and registries that only ever write (or
+only read) never match.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .engine import Finding, rule
+
+_SERVING_PREFIXES = (
+    "filodb_tpu/query/", "filodb_tpu/http/", "filodb_tpu/gateway/",
+    "filodb_tpu/coordinator/", "filodb_tpu/memstore/",
+    "filodb_tpu/parallel/", "filodb_tpu/rollup/", "filodb_tpu/rules/",
+)
+
+_DICT_CTORS = {"dict", "OrderedDict", "defaultdict"}
+_EVICT_METHODS = {"pop", "popitem", "clear"}
+
+
+def _dict_init(value: ast.AST) -> bool:
+    if isinstance(value, ast.Dict) and not value.keys:
+        return True
+    if isinstance(value, ast.Call) and not value.args:
+        f = value.func
+        name = f.id if isinstance(f, ast.Name) else \
+            f.attr if isinstance(f, ast.Attribute) else None
+        return name in _DICT_CTORS
+    return False
+
+
+def _target_name(node: ast.AST) -> Optional[str]:
+    """'self._x' -> '_x' (attribute memo), bare NAME -> 'NAME' (module
+    global); anything else -> None."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _collect_dicts(scope_body: list, in_class: bool) -> dict[str, int]:
+    """Memo candidates initialized as empty dicts: name -> def line."""
+    out: dict[str, int] = {}
+    stmts = list(scope_body)
+    if in_class:
+        stmts = [s for fn in scope_body
+                 if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                 and fn.name == "__init__" for s in ast.walk(fn)]
+    for s in stmts:
+        targets = []
+        if isinstance(s, ast.Assign):
+            targets, value = s.targets, s.value
+        elif isinstance(s, ast.AnnAssign) and s.value is not None:
+            targets, value = [s.target], s.value
+        else:
+            continue
+        if not _dict_init(value):
+            continue
+        for t in targets:
+            name = _target_name(t)
+            if name is not None:
+                out[name] = s.lineno
+    return out
+
+
+def _function_memo_uses(fn: ast.AST, names: set[str]) -> dict[str, int]:
+    """Names both guard-read AND keyed-written inside ``fn`` -> write
+    line (the stampede memo shape)."""
+    reads: set[str] = set()
+    writes: dict[str, int] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute) \
+                and node.func.attr in ("get", "setdefault"):
+            name = _target_name(node.func.value)
+            if name in names:
+                reads.add(name)
+                if node.func.attr == "setdefault":
+                    writes.setdefault(name, node.lineno)
+        elif isinstance(node, ast.Compare) and any(
+                isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+            for cmp in node.comparators:
+                name = _target_name(cmp)
+                if name in names:
+                    reads.add(name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    name = _target_name(t.value)
+                    if name in names:
+                        writes.setdefault(name, node.lineno)
+        elif isinstance(node, ast.Subscript) and isinstance(node.ctx,
+                                                            ast.Load):
+            name = _target_name(node.value)
+            if name in names:
+                reads.add(name)
+    return {n: ln for n, ln in writes.items() if n in reads}
+
+
+def _scope_bounds(scope: ast.AST, names: set[str]) -> set[str]:
+    """Names with an eviction-bound signal anywhere in ``scope``."""
+    bounded: set[str] = set()
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _EVICT_METHODS:
+                name = _target_name(node.func.value)
+                if name in names:
+                    bounded.add(name)
+            # handing the memo to an evict/prune helper counts
+            # (gateway evict_memo_half shape)
+            fname = node.func.attr if isinstance(node.func, ast.Attribute) \
+                else node.func.id if isinstance(node.func, ast.Name) else ""
+            if "evict" in fname or "prune" in fname or "trim" in fname:
+                for a in node.args:
+                    name = _target_name(a)
+                    if name in names:
+                        bounded.add(name)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    name = _target_name(t.value)
+                    if name in names:
+                        bounded.add(name)
+                else:
+                    name = _target_name(t)
+                    if name in names:
+                        bounded.add(name)
+        elif isinstance(node, ast.Compare):
+            # a len(memo) comparison is a bound check driving eviction
+            for side in [node.left] + list(node.comparators):
+                if isinstance(side, ast.Call) \
+                        and isinstance(side.func, ast.Name) \
+                        and side.func.id == "len" and side.args:
+                    name = _target_name(side.args[0])
+                    if name in names:
+                        bounded.add(name)
+    return bounded
+
+
+def _check_scope(module, scope: ast.AST, body: list, in_class: bool,
+                 findings: list) -> None:
+    dicts = _collect_dicts(body, in_class)
+    if not dicts:
+        return
+    names = set(dicts)
+    bounded = _scope_bounds(scope, names)
+    where = f"class {scope.name}" if in_class else "module scope"
+    seen: set[str] = set()
+    fns = [n for n in ast.walk(scope)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in fns:
+        if in_class and fn.name == "__init__":
+            continue
+        for name, line in _function_memo_uses(fn, names).items():
+            if name in bounded or name in seen:
+                continue
+            seen.add(name)
+            findings.append(Finding(
+                "bounded-cache", module.rel, line,
+                f"{where}: {name!r} is a memo (guarded read + keyed "
+                f"write in {fn.name}) with no eviction bound in scope — "
+                f"on a serving path an unbounded memo grows with the "
+                f"key space (the PR 11 gateway-memo stampede); add a "
+                f"pop/clear/len-bound, or annotate the justified map"))
+
+
+@rule("bounded-cache",
+      doc="serving-path memo dicts without an eviction bound")
+def bounded_cache(module):
+    if not module.rel.startswith(_SERVING_PREFIXES) or module.tree is None:
+        return []
+    findings: list = []
+    _check_scope(module, module.tree, module.tree.body, False, findings)
+    for cls in module.nodes:
+        if isinstance(cls, ast.ClassDef):
+            _check_scope(module, cls, cls.body, True, findings)
+    return findings

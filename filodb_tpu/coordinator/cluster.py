@@ -224,7 +224,7 @@ class ShardManager:
                                    num_shards, dataset=name,
                                    replication_factor=replication_factor),
                                replication_factor=replication_factor)
-            self._datasets[name] = info
+            self._datasets[name] = info  # filolint: disable=bounded-cache — keyed by operator-configured dataset names, structurally bounded
             for node in self._nodes:
                 self._assign(node, info)
             self._warn_if_degraded(info)
@@ -352,7 +352,7 @@ class ShardManager:
             node = min(self._nodes,
                        key=lambda n: len(info.mapper.shards_for_node(n)))
             info.mapper.register_node([s], node)
-            self._last_reassign[key] = now_ms
+            self._last_reassign[key] = now_ms  # filolint: disable=bounded-cache — keyed by configured dataset names, structurally bounded
             self._publish(ShardAssignmentStarted(info.name, s, node))
             moved.append(s)
         self._warn_if_degraded(info)
@@ -480,7 +480,8 @@ class StatusPoller:
                  on_assignment_change: Optional[Callable[[], None]] = None,
                  local_running: Optional[Callable[[str], list]] = None,
                  local_watermarks: Optional[
-                     Callable[[str], dict]] = None):
+                     Callable[[str], dict]] = None,
+                 tier_watermarks=None):
         from concurrent.futures import ThreadPoolExecutor
 
         self.manager = manager
@@ -499,6 +500,10 @@ class StatusPoller:
         # into the mapper's replica watermarks each sweep so group_head
         # (the recovery-promotion gate, ISSUE 7) sees this node too
         self.local_watermarks = local_watermarks
+        # rollup tier closure gossip (ROADMAP 2b): peers' /__health
+        # "rollup" payloads fold into this TierWatermarks store so the
+        # resolution router can stitch at the cluster-wide boundary
+        self.tier_watermarks = tier_watermarks
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._pool = ThreadPoolExecutor(
@@ -563,10 +568,18 @@ class StatusPoller:
             if peer == leader and leader != self.local_node:
                 changed |= self._adopt_leader_view(body)
             self._apply_liveness(peer, body)
+            if self.tier_watermarks is not None:
+                for ds, tiers in (body.get("rollup") or {}).items():
+                    self.tier_watermarks.note(peer, ds, tiers)
         down: list[str] = []
         if self.leader == self.local_node:
             # one decider: only the acting leader mutates membership
             down = self.detector.check()
+        if self.tier_watermarks is not None:
+            for peer in down:
+                # a dead owner's frozen closure must not cap the
+                # cluster boundary after its shards reassign
+                self.tier_watermarks.forget(peer)
         if down or changed or self._local_needs_heal():
             self._signal_change()
         return down

@@ -138,6 +138,17 @@ class SingleClusterPlanner(QueryPlanner):
         active = self.mapper.active_shards()
         return active if active else list(range(self.mapper.num_shards))
 
+    def plan_is_local(self, plan: lp.LogicalPlan,
+                      qctx: QueryContext) -> bool:
+        """True when every shard this plan would touch dispatches
+        in-process — the result cache (query/resultcache.py) only
+        memoizes plans whose chunk state it can probe locally."""
+        for filters in lp.raw_series_filters(plan):
+            for s in self.shards_from_filters(list(filters), qctx):
+                if self.dispatcher_for_shard(s) is not IN_PROCESS:
+                    return False
+        return True
+
     # -- materialization ----------------------------------------------------
 
     def materialize(self, plan, qctx=None) -> ExecPlan:

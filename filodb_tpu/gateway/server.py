@@ -70,7 +70,7 @@ class ShardingPublisher:
             shard = self._shard_of(norm)
             builder = self._builders.get(shard)
             if builder is None:
-                builder = self._builders[shard] = RecordBuilder(
+                builder = self._builders[shard] = RecordBuilder(  # filolint: disable=bounded-cache — keyed by shard number, bounded by num_shards
                     self.schema, self.options, self.container_size)
             builder.add(timestamp_ms, [value], norm)
             self.samples_in += 1

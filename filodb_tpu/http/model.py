@@ -165,6 +165,15 @@ def stats_payload(stats, trace_id: str = "") -> dict:
         # tiered-resolution serving (doc/rollup.md): the coarsest rolled
         # tier that served (part of) this query; 0 = raw only
         "resolutionMs": int(getattr(stats, "resolution_ms", 0)),
+        # query-frontend result cache (doc/query-engine.md): result
+        # samples served from memoized immutable-chunk partials vs
+        # samples re-scanned fresh this evaluation
+        "resultCache": {
+            "cachedSamples": int(getattr(
+                stats, "resultcache_cached_samples", 0)),
+            "recomputedSamples": int(getattr(
+                stats, "resultcache_recomputed_samples", 0)),
+        },
         "traceId": trace_id,
     }
 

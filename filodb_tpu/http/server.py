@@ -98,6 +98,10 @@ class DatasetBinding:
     # runtime config; enforcement lives on the shards/gateway)
     admission: Optional[object] = None
     quota: Optional[object] = None
+    # query-frontend result cache (query/resultcache.py): the
+    # ResultCache instance embedded in this dataset's planner wrapper;
+    # None = the dataset serves uncached (admin views + runtime config)
+    resultcache: Optional[object] = None
 
 
 @dataclass
@@ -552,6 +556,9 @@ class FiloHttpServer:
                 and parts[1] == "workload":
             return self._workload()
         if len(parts) == 2 and parts[0] == "admin" \
+                and parts[1] == "resultcache":
+            return self._resultcache(params)
+        if len(parts) == 2 and parts[0] == "admin" \
                 and parts[1] == "cardinality":
             return self._cardinality(params)
         if len(parts) == 2 and parts[0] == "admin" \
@@ -731,6 +738,21 @@ class FiloHttpServer:
                         default_limit=int(p["quota-default-max-series"]))
         if "min-remote-budget-ms" in p:
             self.min_remote_budget_ms = int(p["min-remote-budget-ms"])
+        # result-cache knobs (query/resultcache.py): enable/disable and
+        # resize at runtime across every bound dataset — a cache gone
+        # wrong must be killable without a restart
+        if "result-cache-enabled" in p or "result-cache-max-bytes" in p:
+            enabled = None
+            if "result-cache-enabled" in p:
+                enabled = str(p["result-cache-enabled"]).lower() \
+                    in ("true", "1")
+            max_bytes = p.get("result-cache-max-bytes")
+            for b in self.datasets.values():
+                if b.resultcache is not None:
+                    b.resultcache.configure(
+                        enabled=enabled,
+                        max_bytes=int(max_bytes)
+                        if max_bytes is not None else None)
         # data-plane knob (ISSUE 6): how long a lagging shard's ingested
         # offset may sit still before an ingest.stall event fires
         if "ingest-stall-window-s" in p:
@@ -757,10 +779,16 @@ class FiloHttpServer:
                 row["quota"] = {k: qs[k] for k in (
                     "tenant_label", "default_limit", "overrides")}
             workload[ds] = row
+        rcache: dict = {}
+        for ds, b in self.datasets.items():
+            if b.resultcache is not None:
+                snap = b.resultcache.snapshot()
+                rcache[ds] = {k: snap[k] for k in ("enabled", "max_bytes")}
         return 200, {"status": "success", "data": {
             "datasets": stores,
             "workload": {"min-remote-budget-ms": self.min_remote_budget_ms,
                          "datasets": workload},
+            "result-cache": rcache,
             "dataplane": {
                 "ingest-stall-window-s":
                     self._ensure_watermarks().stall_window_s,
@@ -796,6 +824,31 @@ class FiloHttpServer:
         return 200, {"status": "success", "data": {
             "min_remote_budget_ms": self.min_remote_budget_ms,
             "datasets": out}}
+
+    @_timed("resultcache")
+    def _resultcache(self, p: dict) -> tuple[int, dict]:
+        """The query-frontend result cache's live state
+        (doc/query-engine.md): per-dataset entry/byte residency with
+        the exact-reconciliation proof, hit/miss/eviction/invalidation
+        counters, and the resident instant windows.  ``clear=true``
+        flushes every dataset's cache (operator action)."""
+        clear = str(p.get("clear", "")).lower() in ("true", "1")
+        out: dict = {}
+        for ds, b in self.datasets.items():
+            if b.resultcache is None:
+                continue
+            if clear:
+                b.resultcache.clear()
+            snap = b.resultcache.snapshot()
+            accounted, walked = b.resultcache.reconcile()
+            snap["reconcile"] = {"accounted_bytes": accounted,
+                                 "walked_bytes": walked,
+                                 "exact": accounted == walked}
+            out[ds] = snap
+        if not out:
+            return 404, error_response("bad_data",
+                                       "no result cache on this node")
+        return 200, {"status": "success", "data": {"datasets": out}}
 
     # ------------------------------------------------- data-plane routes
 
@@ -1207,6 +1260,17 @@ class FiloHttpServer:
                 continue
         if wms:
             body["watermarks"] = wms
+        # rollup tier closure watermarks for the shards THIS node rolls
+        # (ROADMAP 2b): peers fold them into their TierWatermarks store
+        # so a multi-node coordinator stitches raw/rolled at the
+        # CLUSTER-wide boundary instead of its local engine's
+        if self.rollup is not None:
+            try:
+                rolled = self.rollup.rolled_snapshot()
+            except Exception:  # noqa: BLE001 — engine mid-shutdown
+                rolled = {}
+            if rolled:
+                body["rollup"] = rolled
         if self.node_name:
             body["node"] = self.node_name
         return (200 if healthy else 503), body

@@ -27,6 +27,11 @@ class QuarantineRegistry:
         self._details: list[dict] = []
         self._max_details = max_details
         self._dropped_details = 0
+        # bumped on every membership change (add OR clear): consumers
+        # that memoize results computed with quarantine exclusions
+        # applied (query/resultcache.py) key their validity on it — a
+        # cached answer must never outlive the exclusion set it saw
+        self._epoch = 0  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def quarantine(self, partkey: bytes, chunk_id: int, *,
@@ -46,6 +51,7 @@ class QuarantineRegistry:
             if chunk_id in ids:
                 return False
             ids[chunk_id] = span
+            self._epoch += 1
             if len(self._details) < self._max_details:
                 self._details.append({
                     "partkey": partkey.hex(), "chunk_id": chunk_id,
@@ -122,9 +128,17 @@ class QuarantineRegistry:
                     "detail_records": len(self._details),
                     "detail_records_dropped": self._dropped_details}
 
+    def epoch(self) -> int:
+        """Monotone membership version: changes whenever the exclusion
+        set changes in either direction."""
+        with self._lock:
+            return self._epoch
+
     def clear(self) -> None:
         """Operator action (and test isolation): forget everything."""
         with self._lock:
+            if self._by_pk:
+                self._epoch += 1
             self._by_pk.clear()
             self._details.clear()
             self._dropped_details = 0

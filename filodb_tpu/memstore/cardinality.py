@@ -167,7 +167,7 @@ def _set_tenant_gauges(dataset: str, merged: dict[str, int]) -> None:
         for tenant, n in merged.items():
             gauge.set(n, dataset=dataset, tenant=tenant)
         stale = _EXPORTED_TENANTS.get(dataset, set()) - set(merged)
-        _EXPORTED_TENANTS[dataset] = set(merged)
+        _EXPORTED_TENANTS[dataset] = set(merged)  # filolint: disable=bounded-cache — keyed by dataset name; per-dataset sets shed drained tenants above
         for tenant in stale:
             gauge.remove(dataset=dataset, tenant=tenant)
 

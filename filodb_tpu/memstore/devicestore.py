@@ -543,6 +543,11 @@ class DeviceGridCache:
         self.blocks: dict[int, _Block] = {}
         self._tails: dict[int, tuple[int, _Block]] = {}  # bi -> (ver, blk)
         self.version = 0               # bumped on invalidating freezes
+        # quarantine epoch the resident blocks were staged under: a
+        # chunk quarantined AFTER staging must stop being served, so a
+        # changed epoch drops every block for a re-stage through the
+        # (exclusion-applying) partition read path
+        self._quarantine_epoch = -1
         self.disabled_until_version = -1
         self._disable_count = 0        # exponential re-try backoff
         self._disk_floor: Optional[tuple[int, int]] = None  # (ver, floor_ms)
@@ -923,6 +928,24 @@ class DeviceGridCache:
             return None
         if len(part_ids) == 0:
             return None
+        from filodb_tpu.integrity import QUARANTINE
+        qepoch = QUARANTINE.epoch()
+        if qepoch != self._quarantine_epoch:
+            # blocks staged before a quarantine still CONTAIN the
+            # quarantined chunk's rows — serving them would defeat the
+            # exclusion the partition read path applies.  Quarantine is
+            # rare; a full re-stage is the correct price.
+            if self._quarantine_epoch >= 0 and (self.blocks or self._tails):
+                LEDGER.note_eviction(self.owner, "integrity_quarantine",
+                                     n=len(self.blocks) + len(self._tails),
+                                     nbytes=self.bytes_resident)
+                self.blocks.clear()
+                self._tails.clear()
+                self._plan_memo.clear()
+                self._phase_memo.clear()
+                self._mesh_stage_memo.clear()
+                self.version += 1
+            self._quarantine_epoch = qepoch
         # ALL eligibility checks run before _prep_for assigns lanes —
         # an ineligible query must not widen the lane count (that would
         # clear every resident block on the next eligible query)
@@ -1148,7 +1171,7 @@ class DeviceGridCache:
         self._plan_memo[pkey] = plan
         return plan
 
-    def _phase_device(self, ph_req, req, ncols: int, key) -> object:
+    def _phase_device(self, ph_req, req, ncols: int, key) -> object:  # holds-lock: _lock
         """Device [ncols] phase vector for the uniform-phase kernels,
         memoized per (block range, cache version) — re-uploading ~4 B/
         lane per query would cost more than it saves on a tunnel link.
@@ -1219,12 +1242,20 @@ class DeviceGridCache:
     def _block_for(self, bi: int, lanes: int,  # holds-lock: _lock
                    frozen_hi: int,
                    need_hi: int):
-        blk = self.blocks.get(bi)
-        if blk is not None and blk.lanes == lanes \
-                and blk.staged_hi >= need_hi:
-            return blk
         b_lo = bi * BLOCK_BUCKETS          # first bucket index of the block
         b_hi = b_lo + BLOCK_BUCKETS - 1
+        blk = self.blocks.get(bi)
+        if blk is not None and blk.lanes == lanes \
+                and blk.staged_hi >= need_hi and b_hi <= frozen_hi:
+            # a cached FROZEN block is only valid while its whole bucket
+            # range stays below the frozen frontier: once write-buffer
+            # rows land inside it (live ingest after the block was
+            # staged), the staged copy is missing them and the dense
+            # proof would read the hole as "no samples" — serving a
+            # silently-partial window.  Such ranges take the per-epoch
+            # tail path below; note_freeze drops the stale copy when
+            # the buffer flushes.
+            return blk
         if b_hi > frozen_hi:
             # tail block: includes mutable write-buffer rows; cache under
             # the shard's ingest epoch so repeat queries skip the rebuild

@@ -187,7 +187,7 @@ class FlushScheduler:
 
                         prev.add_done_callback(
                             lambda p: self._exec.submit(after, p))
-                    self._chains[group] = fut
+                    self._chains[group] = fut  # filolint: disable=bounded-cache — keyed by flush group id, bounded by groups-per-shard
                     self.flushes_submitted += 1
                 except RuntimeError:
                     fut = None  # executor shut down between check and submit

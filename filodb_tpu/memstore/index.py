@@ -138,12 +138,12 @@ class _Label:
     def add(self, v: str, pid: int) -> None:
         p = self.by_val.get(v)
         if p is None:
-            p = self.by_val[v] = _Posting()
-            self.code_of[v] = self.vgen
+            p = self.by_val[v] = _Posting()  # filolint: disable=bounded-cache — the index IS the data; cardinality is bounded by the series-quota subsystem
+            self.code_of[v] = self.vgen  # filolint: disable=bounded-cache — index value-code table, same bound as by_val
             self.vgen += 1
         # inlined _Posting.add: this runs once per (series, label)
         p.pending.append(pid)
-        self.vcount[v] = self.vcount.get(v, 0) + 1
+        self.vcount[v] = self.vcount.get(v, 0) + 1  # filolint: disable=bounded-cache — index refcounts, same bound as by_val
         self.gen += 1
         if pid >= len(self.codes):
             self.ensure(pid + 1)

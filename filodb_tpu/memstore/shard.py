@@ -162,6 +162,12 @@ class TimeSeriesShard:
         # monotone counter observed by the device caches' tail versioning:
         # bumped whenever new rows or chunks could change query results
         self.ingest_epoch = 0
+        # counts chunk FREEZES only (a strict subset of ingest_epoch
+        # bumps): the encoded chunk set changes exactly on freeze or
+        # removal, so the result cache's span table keys on these
+        self.freeze_epoch = 0
+        self._span_table: Optional[tuple] = None
+        self._mutable_floor: Optional[tuple] = None  # (ingest_epoch, ts)
         # flush-time downsampling (reference: ShardDownsampler invoked from
         # doFlushSteps :915-917); set via enable_downsampling()
         self.downsample_publisher = None
@@ -566,7 +572,7 @@ class TimeSeriesShard:
                                   self.schemas.by_hash(schema_hash),
                                   self.downsample_publisher,
                                   self.downsample_resolutions)
-            self._downsamplers[schema_hash] = ds
+            self._downsamplers[schema_hash] = ds  # filolint: disable=bounded-cache — keyed by schema hash, bounded by the configured schema set
         return ds
 
     def flush_all(self, ingestion_time: Optional[int] = None) -> int:
@@ -695,6 +701,58 @@ class TimeSeriesShard:
         return PartLookupResult(self.shard_num, np.asarray(in_mem, dtype=np.int32),
                                 missing, first_schema)
 
+    def chunk_span_table(self):
+        """Flat ``(pid, chunk_id, start_time, end_time)`` int64 arrays
+        over every in-memory partition's encoded chunks — the result
+        cache's immutability digest source (query/resultcache.py).
+        Cached per (freeze_epoch, removal_epoch, index version,
+        partition count): the encoded chunk set changes exactly on
+        freeze/removal, so live per-row ingest never rebuilds it."""
+        key = (self.freeze_epoch, self.removal_epoch, self.index.version,
+               len(self.partitions))
+        tbl = self._span_table
+        if tbl is not None and tbl[0] == key:
+            return tbl[1]
+        pid_l: list = []
+        cid_l: list = []
+        cs_l: list = []
+        ce_l: list = []
+        for pid, part in list(self.partitions.items()):
+            with part._lock:
+                for cs in part.chunks:
+                    pid_l.append(pid)
+                    cid_l.append(cs.info.chunk_id)
+                    cs_l.append(cs.info.start_time)
+                    ce_l.append(cs.info.end_time)
+        arrs = (np.asarray(pid_l, np.int64), np.asarray(cid_l, np.int64),
+                np.asarray(cs_l, np.int64), np.asarray(ce_l, np.int64))
+        self._span_table = (key, arrs)
+        return arrs
+
+    def mutable_floor(self) -> Optional[int]:
+        """Earliest mutable (write-buffer / pending-encode) row
+        timestamp across ALL partitions, or None when everything is
+        encoded — the result cache's closed-segment probe, cached per
+        ingest epoch so a burst of queries between ingest batches pays
+        one partition walk.  Deliberately filter-independent: an
+        unmatched partition's buffer marking a segment open only costs
+        a cache miss, never staleness."""
+        # capture the epoch BEFORE the walk (chunk_span_table does the
+        # same): a row ingested mid-walk bumps the epoch and must force
+        # a recompute — caching the walk under the post-bump epoch
+        # would hide that row until the NEXT ingest
+        epoch = self.ingest_epoch
+        mf = self._mutable_floor
+        if mf is not None and mf[0] == epoch:
+            return mf[1]
+        lo: Optional[int] = None
+        for part in list(self.partitions.values()):
+            mt = part.mutable_floor()
+            if mt is not None and (lo is None or mt < lo):
+                lo = mt
+        self._mutable_floor = (epoch, lo)
+        return lo
+
     def _partition_for_scan(self, part_id: int) -> Optional[TimeSeriesPartition]:
         """Resolve a part id for scanning.  The ODP shard overrides this to
         consult its paged-partition cache as well."""
@@ -713,6 +771,7 @@ class TimeSeriesShard:
 
     def _on_chunk_freeze(self, cs) -> None:
         self.ingest_epoch += 1
+        self.freeze_epoch += 1
         for (shash, _cid), cache in self.device_caches.items():
             if shash == cs.schema_hash or cs.schema_hash == 0:
                 cache.note_freeze(cs)
@@ -725,7 +784,7 @@ class TimeSeriesShard:
             cache = DeviceGridCache(self, schema_hash, column_id,
                                     self.config.device_cache_bytes,
                                     self.config.grid_step_ms, hist=hist)
-            self.device_caches[(schema_hash, column_id)] = cache
+            self.device_caches[(schema_hash, column_id)] = cache  # filolint: disable=bounded-cache — keyed by (schema, column); each cache holds its own byte budget
         return cache
 
     def _grid_cache_for(self, part_ids: Sequence[int],

@@ -288,6 +288,72 @@ class WatermarkLedger:
                 "sampled_at_ms": now_ms, "datasets": datasets}
 
 
+class TierWatermarks:
+    """Cluster-wide rollup tier closure watermarks (ROADMAP 2b).
+
+    Each node's rollup engine knows the closure boundary only for the
+    shards IT rolls; a multi-node coordinator that stitches raw/rolled
+    at its LOCAL engine's boundary is needlessly conservative for
+    shards other nodes roll.  Owners publish their per-dataset/tier
+    ``rolled_through`` in the ``/__health`` payload, the StatusPoller
+    feeds peers' values in here, and the resolution router stitches at
+    :meth:`cluster_rolled_through` — the min across the dataset's
+    shard-owning nodes, i.e. the newest stamp every owner has closed.
+
+    Per-server (not process-wide), like the WatermarkLedger: in-process
+    multi-node tests would otherwise cross-feed each other's rows.
+    """
+
+    def __init__(self, node: str = ""):
+        self.node = node
+        # (peer node, dataset) -> {resolution_ms: rolled_through_ms}
+        self._peers: dict[tuple, dict] = {}  # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    def note(self, peer: str, dataset: str, tiers: dict) -> None:
+        """Fold one peer's gossiped ``{resolution_ms: through_ms}``;
+        values only ever advance (closure is monotone — a stale poll
+        racing a fresh one must not drag the boundary back)."""
+        with self._lock:
+            row = self._peers.setdefault((peer, dataset), {})
+            for res, through in tiers.items():
+                res = int(res)
+                row[res] = max(row.get(res, -(1 << 62)), int(through))
+
+    def peer_value(self, peer: str, dataset: str,
+                   res: int) -> Optional[int]:
+        with self._lock:
+            row = self._peers.get((peer, dataset))
+            return None if row is None else row.get(int(res))
+
+    def forget(self, peer: str) -> None:
+        """Drop a departed node's rows: a dead owner's frozen boundary
+        must not cap the cluster stitch forever (its shards reassign
+        and the new owner republishes)."""
+        with self._lock:
+            for key in [k for k in self._peers if k[0] == peer]:
+                del self._peers[key]
+
+    def cluster_min(self, dataset: str, res: int,
+                    peers) -> Optional[int]:
+        """Min of the given peers' gossiped closure watermarks — the
+        peer half of the cluster-wide stitch boundary.  ``None`` when
+        any peer has not gossiped yet (the caller falls back to the
+        local engine's conservative boundary, never to a guess)."""
+        vals = []
+        for peer in set(peers):
+            v = self.peer_value(peer, dataset, res)
+            if v is None:
+                return None
+            vals.append(v)
+        return min(vals) if vals else None
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {f"{peer}/{ds}": {str(r): v for r, v in row.items()}
+                    for (peer, ds), row in sorted(self._peers.items())}
+
+
 class WatermarkSampler(PeriodicThread):
     """Background driver: ``ledger.sample()`` every ``interval_s`` so
     lag gauges and stall events exist without anyone polling

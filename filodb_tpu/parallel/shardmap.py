@@ -326,6 +326,20 @@ class ShardMapper:
         wms = [r.watermark for r in self._states[shard].replicas]
         return max(wms) if wms else -1
 
+    def routing_token(self) -> int:
+        """Cheap hash of the replica-routing state: membership and
+        per-replica status across every shard.  Any failover-relevant
+        transition (node death, demotion, promotion, reassignment)
+        changes it, so consumers that memoize answers computed under
+        one routing view (query/resultcache.py) can key validity on it
+        without subscribing to shard events.  Watermarks are excluded
+        on purpose — they advance with every ingested row."""
+        acc = []
+        for shard, st in enumerate(self._states):
+            for r in st.replicas:      # copy-swap lists: safe to iterate
+                acc.append((shard, r.node, r.status.value))
+        return hash(tuple(acc))
+
     def unassign(self, shard: int, node: Optional[str] = None) -> None:
         """Drop a replica (``node`` given) or the whole group."""
         st = self._states[shard]

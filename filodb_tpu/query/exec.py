@@ -108,6 +108,30 @@ class ExecContext:
                 # debits frees caused while this query was active
                 c["hbm_delta"] = c.get("hbm_delta", 0) + hbm_delta
 
+    def note_resultcache(self, cached: int = 0, recomputed: int = 0) -> None:
+        """Result-cache accounting (query/resultcache.py): result
+        samples served from memoized partials vs samples re-scanned on
+        the fresh/miss path — surfaced under data.stats.resultCache."""
+        with self._corrupt_lock:
+            c = self._counters
+            if cached:
+                c["rc_cached"] = c.get("rc_cached", 0) + cached
+            if recomputed:
+                c["rc_recomputed"] = c.get("rc_recomputed", 0) + recomputed
+
+    def counter(self, name: str) -> int:
+        with self._corrupt_lock:
+            return self._counters.get(name, 0)
+
+    def absorb_stats_from(self, other: "ExecContext") -> None:
+        """Fold a nested sub-context's accumulated accounting into this
+        one (the result cache runs fresh segments / delta fetches with
+        their own ctx so per-segment volumes are exact)."""
+        st = QueryStats()
+        other.fold_into(st)
+        st.corrupt_chunks_excluded = other.corrupt_excluded()
+        self.absorb_stats(st)
+
     def absorb_stats(self, stats: QueryStats) -> None:
         """Fold a REMOTE child's stats into this query's accounting
         (local children share the ctx and need no absorb)."""
@@ -118,6 +142,8 @@ class ExecContext:
                          hbm_compressed=stats.hbm_read_bytes.get(
                              "compressed", 0),
                          hbm_delta=stats.hbm_resident_delta_bytes)
+        self.note_resultcache(cached=stats.resultcache_cached_samples,
+                              recomputed=stats.resultcache_recomputed_samples)
         if stats.corrupt_chunks_excluded:
             self.note_corrupt_excluded(stats.corrupt_chunks_excluded)
         if stats.shards_down:
@@ -140,6 +166,8 @@ class ExecContext:
                                        ("compressed", "hbm_compressed"))
                 if c.get(ck)}
             stats.hbm_resident_delta_bytes = c.get("hbm_delta", 0)
+            stats.resultcache_cached_samples = c.get("rc_cached", 0)
+            stats.resultcache_recomputed_samples = c.get("rc_recomputed", 0)
             stats.shards_down = self._shards_down
 
 

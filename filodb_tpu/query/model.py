@@ -94,6 +94,12 @@ class QueryStats:
     # the HTTP layer from the router's materialize-time choice and
     # visible under data.stats with stats=true
     resolution_ms: int = 0
+    # query-frontend result cache (query/resultcache.py): result
+    # samples served from memoized immutable-chunk partials vs samples
+    # re-scanned fresh this evaluation — the cached-vs-recomputed split
+    # under data.stats.resultCache with stats=true
+    resultcache_cached_samples: int = 0
+    resultcache_recomputed_samples: int = 0
 
     def merge(self, other: "QueryStats") -> None:
         self.samples_scanned += other.samples_scanned
@@ -113,6 +119,9 @@ class QueryStats:
         # coarsest tier wins: a stitched raw+rolled answer reports the
         # rolled resolution it leaned on
         self.resolution_ms = max(self.resolution_ms, other.resolution_ms)
+        self.resultcache_cached_samples += other.resultcache_cached_samples
+        self.resultcache_recomputed_samples += \
+            other.resultcache_recomputed_samples
 
     def add_timing(self, stage: str, seconds: float) -> None:
         self.timings[stage] = self.timings.get(stage, 0.0) + seconds

@@ -185,6 +185,12 @@ class _DatasetRollup:
         self.tier_errors: dict[int, str] = {}
         self.tier_last_advance: dict[int, float] = {}
         self.rolled_cache: dict[int, int] = {}   # res -> stitch boundary
+        # the two halves the cluster gossip composes separately
+        # (ROADMAP 2b): what THIS node's owned shards have closed (the
+        # value it gossips), and what the local tier replicas have had
+        # delivered (the serve-locally clamp)
+        self.owned_cache: dict[int, int] = {}
+        self.delivered_cache: dict[int, int] = {}
 
 
 class RollupEngine:
@@ -775,6 +781,8 @@ class RollupEngine:
         rolled range.  (Intra-shard series skew on peer shards still
         needs tier-watermark gossip — ROADMAP follow-up.)"""
         out: dict[int, int] = {}
+        owned_out: dict[int, int] = {}
+        delivered_out: dict[int, int] = {}
         with d.lock:
             for res in d.config.resolutions_ms:
                 vals: list[int] = []
@@ -797,6 +805,10 @@ class RollupEngine:
                                                                res))
                              if sh.latest_ingest_ts >= 0]
                 clamp = min(delivered) if delivered else None
+                if local is not None:
+                    owned_out[res] = local
+                if clamp is not None:
+                    delivered_out[res] = clamp
                 if local is not None and clamp is not None:
                     out[res] = min(local, clamp)
                 elif clamp is not None:
@@ -806,6 +818,8 @@ class RollupEngine:
                 elif local is not None:
                     out[res] = local
             d.rolled_cache = out
+            d.owned_cache = owned_out
+            d.delivered_cache = delivered_out
 
     # ---------------------------------------------------------------- views
 
@@ -817,6 +831,37 @@ class RollupEngine:
             return _NEG
         with d.lock:
             return d.rolled_cache.get(res, _NEG)
+
+    def owned_rolled_through(self, dataset: str, res: int) -> Optional[int]:
+        """Closure boundary over the shards THIS node rolls (None when
+        it owns none) — the authoritative value this node gossips."""
+        d = self._datasets.get(dataset)
+        if d is None:
+            return None
+        with d.lock:
+            return d.owned_cache.get(res)
+
+    def delivered_through(self, dataset: str, res: int) -> Optional[int]:
+        """Newest rolled stamp delivered to every local tier replica
+        (None when this node holds no tier data) — the serve-locally
+        clamp the cluster-wide boundary still must respect."""
+        d = self._datasets.get(dataset)
+        if d is None:
+            return None
+        with d.lock:
+            return d.delivered_cache.get(res)
+
+    def rolled_snapshot(self) -> dict:
+        """Per-dataset owned-closure watermarks for the ``/__health``
+        gossip payload (ROADMAP 2b): only shards this node actually
+        rolls — peers compose their own delivered clamps."""
+        out: dict = {}
+        for ds, d in self._datasets.items():
+            with d.lock:
+                tiers = {str(r): v for r, v in d.owned_cache.items()}
+            if tiers:
+                out[ds] = tiers
+        return out
 
     def datasets(self) -> list[str]:
         return list(self._datasets)

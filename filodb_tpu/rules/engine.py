@@ -14,9 +14,13 @@ series) built on this repo's serving fabric:
 - recorded series write back through the dataset's existing
   ``ShardingPublisher``, so they are sharded, replicated (PR 12), and
   queryable like any ingested series;
-- recording rules over bare windowed functions keep incremental window
-  state (:mod:`filodb_tpu.rules.incremental`) — each tick consumes
-  only newly-arrived samples, bit-equal to a cold full-range pass;
+- recording rules over windowed functions keep incremental window
+  state (:mod:`filodb_tpu.query.windowstate`, shared with the query
+  result cache) — each tick consumes only newly-arrived samples,
+  bit-equal to a cold full-range pass.  Both bare ``fn(sel[w])`` and
+  moment aggregations ``agg by (..)(fn(sel[w]))`` are incremental; the
+  aggregated shape merges per-shard partials through the normal
+  ``AggPartialBatch`` reduce;
 - the engine is itself observable: ``filodb_rule_*`` metrics, a span
   tree per group pass, flight events on firing/resolve, and the
   ``/api/v1/rules`` / ``/api/v1/alerts`` / ``/admin/rules`` payloads.
@@ -36,10 +40,12 @@ import numpy as np
 
 from filodb_tpu.promql.parser import query_to_logical_plan
 from filodb_tpu.query.logical import IntervalSelector, RawSeries
-from filodb_tpu.query.model import (PeriodicBatch, QueryContext, QueryError,
-                                    RawBatch)
+from filodb_tpu.query.model import (PeriodicBatch, QueryContext,
+                                    QueryError)
 from filodb_tpu.rules.config import RuleDef, RuleGroup
-from filodb_tpu.rules.incremental import WindowState, window_spec
+from filodb_tpu.rules.incremental import (AggWindowState, WindowState,
+                                          WindowUnsupported,
+                                          agg_window_spec, window_spec)
 from filodb_tpu.utils.observability import (TRACER, PeriodicThread,
                                             rule_metrics)
 from filodb_tpu.workload import deadline as wdl
@@ -147,18 +153,22 @@ class RuleEvaluator:
                    end_ms: int, timeout_ms: int) -> list:
         """Raw samples clamped to ``[start, end]`` -> ``[(tags, ts,
         vals)]`` — the incremental window state's delta fetch."""
+        return [row for bucket in self.raw_series_sharded(
+            filters, start_ms, end_ms, timeout_ms) for row in bucket]
+
+    def raw_series_sharded(self, filters: tuple, start_ms: int,
+                           end_ms: int, timeout_ms: int) -> list:
+        """Raw samples grouped per shard batch, in the scatter-gather
+        child order — the aggregated window state's delta fetch (its
+        per-bucket partials must reduce in the same order the query
+        path's ReduceAggregateExec would).  The unpack lives in the
+        shared window-state module so the result cache's instant path
+        can never drift from it."""
+        from filodb_tpu.query.windowstate import batches_to_buckets
         plan = RawSeries(IntervalSelector(int(start_ms), int(end_ms)),
                          tuple(filters))
         result = self.run_plan(plan, timeout_ms)
-        out = []
-        for b in result.batches:
-            if not isinstance(b, RawBatch) or b.batch is None:
-                continue
-            for i, tags in enumerate(b.keys):
-                n = int(b.batch.row_counts[i])
-                out.append((tags, np.asarray(b.batch.timestamps[i][:n]),
-                            np.asarray(b.batch.values[i][:n])))
-        return out
+        return batches_to_buckets(result.batches)
 
 
 @dataclasses.dataclass
@@ -189,7 +199,8 @@ class _RuleState:
     last_error: str = ""
     last_duration_s: float = 0.0
     last_eval_ms: int = 0
-    incremental: Optional[WindowState] = None
+    # WindowState | AggWindowState | None (full evaluation)
+    incremental: Optional[object] = None
     incr_seen: int = 0              # samples_consumed already counted
     # alerting: key -> AlertInstance (pending/firing, plus resolved
     # instances retained for the API until _RESOLVED_RETENTION_MS)
@@ -252,19 +263,28 @@ class RuleEngine:
                 for rs in gs.rules:
                     if rs.rule.kind != "recording":
                         continue
-                    spec = self._window_spec(rs.rule)
-                    if spec is not None:
-                        rs.incremental = WindowState(spec)
+                    rs.incremental = self._window_state(rs.rule)
             self._groups.append(gs)
 
     @staticmethod
-    def _window_spec(rule: RuleDef):
+    def _window_state(rule: RuleDef):
+        """An incremental window state for the rule's expression shape,
+        or None (full evaluation): bare ``fn(sel[w])`` keeps per-series
+        state, ``agg by (..)(fn(sel[w]))`` — the shape recorded
+        dashboards use most — keeps per-shard aggregation state."""
         from filodb_tpu.promql.parser import ParseError
         try:
             base = 1_700_000_000_000
-            return window_spec(query_to_logical_plan(rule.expr, base))
+            plan = query_to_logical_plan(rule.expr, base)
         except (ParseError, ValueError):
             return None
+        spec = window_spec(plan)
+        if spec is not None:
+            return WindowState(spec)
+        aspec = agg_window_spec(plan)
+        if aspec is not None:
+            return AggWindowState(aspec)
+        return None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -370,20 +390,48 @@ class RuleEngine:
         out.update(rule.labels)
         return out
 
+    def _tick_incremental(self, gs: _GroupState, rs: _RuleState,
+                          eval_ms: int) -> list:
+        """One incremental tick -> ``[(tags, value)]`` for either state
+        shape.  The aggregated shape's PeriodicBatch unpacks through
+        the same NaN-drop the bare shape applies."""
+        if isinstance(rs.incremental, AggWindowState):
+            batch = rs.incremental.tick(
+                eval_ms,
+                lambda filters, s, e: gs.evaluator.raw_series_sharded(
+                    filters, s, e, gs.timeout_ms))
+            if batch is None:
+                return []
+            vals = batch.np_values()
+            return [(batch.keys[i], float(vals[i, 0]))
+                    for i in range(len(batch.keys))
+                    if not np.isnan(vals[i, 0])]
+        return rs.incremental.tick(
+            eval_ms,
+            lambda filters, s, e: gs.evaluator.raw_series(
+                filters, s, e, gs.timeout_ms))
+
     def _eval_recording(self, gs: _GroupState, rs: _RuleState,
                         eval_ms: int) -> None:
         rule = rs.rule
         if rs.incremental is not None:
-            series = rs.incremental.tick(
-                eval_ms,
-                lambda filters, s, e: gs.evaluator.raw_series(
-                    filters, s, e, gs.timeout_ms))
-            self._m["incr_samples"].inc(
-                rs.incremental.samples_consumed - rs.incr_seen,
-                group=gs.group.name)
-            rs.incr_seen = rs.incremental.samples_consumed
-            self._m["incr_series"].set(rs.incremental.resident_series,
-                                       group=gs.group.name)
+            try:
+                series = self._tick_incremental(gs, rs, eval_ms)
+            except WindowUnsupported:
+                # the DATA refused the shape (histogram schema, shard
+                # fan-out past the flat-reduce limit): permanent full
+                # evaluation for this rule — retrying every tick would
+                # re-fetch the window just to fail again
+                rs.incremental = None
+                series = gs.evaluator.instant_vector(rule.expr, eval_ms,
+                                                     gs.timeout_ms)
+            else:
+                self._m["incr_samples"].inc(
+                    rs.incremental.samples_consumed - rs.incr_seen,
+                    group=gs.group.name)
+                rs.incr_seen = rs.incremental.samples_consumed
+                self._m["incr_series"].set(rs.incremental.resident_series,
+                                           group=gs.group.name)
         else:
             series = gs.evaluator.instant_vector(rule.expr, eval_ms,
                                                  gs.timeout_ms)

@@ -1,229 +1,23 @@
-"""Incremental window state for recording rules over windowed functions.
+"""Incremental window state for recording rules — now a shim.
 
-A recording rule like ``rate(m[5m])`` evaluated every 10s re-reads the
-same 5m of raw samples 30 times over the window's lifetime.  This
-module keeps the window RESIDENT instead: per input series, raw samples
-live in blocks keyed on chunk-aligned time boundaries; each tick
-fetches only the slice of raw data that arrived since the previous tick
-(``(fetched_through, eval_ts]`` — O(new samples), the constant-state
-streaming formulation of arXiv:2603.09555 mapped onto
-``rate``/``increase``/``*_over_time`` windows), appends it, evicts
-whole blocks that fell out of the window, and recomputes the window
-function over the buffered samples.
-
-The load-bearing invariant (asserted generatively in
-tests/test_rules.py): the value produced from warm incremental state is
-**bit-equal** to a cold full-range evaluation, which in turn is
-bit-equal to the normal query path's answer for the same expression at
-the same timestamp.  That holds by construction, not by tolerance:
-
-- the raw fetch goes through the SAME planner -> leaf-scan path a
-  full query uses, so sample sets agree;
-- the buffered rows presented to the kernel are exactly the rows a
-  direct query's ``read_range(t - window, t)`` clamp would return
-  (inclusive both ends; the kernel itself applies the Prometheus
-  ``(t - window, t]`` exclusivity);
-- the window value comes from the very same
-  :func:`filodb_tpu.query.rangefns.apply_range_function` kernel the
-  query path dispatches — not a host reimplementation that would drift
-  in float association.
-
-Late-arriving samples (timestamp at or below an already-consumed slice
-boundary) are invisible to warm state until :meth:`WindowState.reset`;
-doc/rules.md documents the invariant.  The engine resets state whenever
-an evaluation fails, so a transient fetch error cannot leave a silent
-gap in the window.
+The window-state core this module introduced (PR 14) moved to
+:mod:`filodb_tpu.query.windowstate` so the query-frontend result cache
+(``filodb_tpu/query/resultcache``) and the rule engine share ONE
+implementation of the constant-state streaming formulation
+(arXiv:2603.09555), including the new aggregation-over-window shapes
+(``sum by (le)(rate(...))``) via :class:`AggWindowState`.  Everything
+documented here before — the bit-equality invariant, the late-arrival
+semantics, the reset-on-failure discipline — lives there now; this
+module re-exports the public names so existing imports keep working.
 """
 
-from __future__ import annotations
+from filodb_tpu.query.windowstate import (  # noqa: F401
+    _ROW_PAD, _SeriesBuffer, AggWindowSpec, AggWindowState,
+    WindowSpec, WindowState, WindowUnsupported, agg_window_spec,
+    window_spec,
+)
 
-import dataclasses
-from typing import Callable, Optional
-
-import numpy as np
-
-from filodb_tpu.core.chunk import build_batch
-from filodb_tpu.ops.windows import StepRange
-from filodb_tpu.query import logical as lp
-from filodb_tpu.query.rangefns import apply_range_function, supported
-
-# row padding for the buffered batches: the same default the shard
-# store config uses, so incremental and cold batches land in the same
-# jit shape buckets (values are padding-independent either way)
-_ROW_PAD = 64
-
-
-@dataclasses.dataclass
-class WindowSpec:
-    """The recognized incremental shape: ``fn(selector[w])``."""
-
-    filters: tuple
-    window_ms: int
-    function: object                # RangeFunctionId
-    args: tuple = ()
-
-
-def window_spec(plan) -> Optional[WindowSpec]:
-    """Return the :class:`WindowSpec` when ``plan`` is a bare windowed
-    range function the incremental path supports; ``None`` falls back
-    to full evaluation (aggregations, joins, offsets, histograms...).
-
-    ``offset`` is excluded on purpose: an offset window reads the past,
-    where "newly-arrived samples" no longer describes the delta.
-    """
-    if not isinstance(plan, lp.PeriodicSeriesWithWindowing):
-        return None
-    if plan.offset_ms:
-        return None
-    if not isinstance(plan.series, lp.RawSeries) or plan.series.columns:
-        return None
-    if not supported(plan.function, hist=False):
-        return None
-    return WindowSpec(tuple(plan.series.filters), int(plan.window_ms),
-                      plan.function, tuple(plan.function_args))
-
-
-class _SeriesBuffer:
-    """One input series' resident window: samples grouped into blocks
-    keyed on chunk-aligned boundaries (``ts // block_ms``), so eviction
-    drops whole immutable blocks instead of scanning sample-by-sample."""
-
-    __slots__ = ("tags", "blocks", "last_ts")
-
-    def __init__(self, tags: dict):
-        self.tags = tags
-        self.blocks: dict[int, list] = {}   # block idx -> [(ts, val)...]
-        self.last_ts = -(1 << 62)           # newest buffered timestamp
-
-    def append(self, ts: np.ndarray, vals: np.ndarray,
-               block_ms: int) -> None:
-        for t, v in zip(ts.tolist(), vals.tolist()):
-            self.blocks.setdefault(int(t) // block_ms, []).append(
-                (int(t), float(v)))
-        if len(ts):
-            self.last_ts = max(self.last_ts, int(ts[-1]))
-
-    def evict_before(self, cutoff_ms: int, block_ms: int) -> None:
-        """Drop blocks wholly below ``cutoff_ms`` (a block containing
-        the cutoff stays; compute-time clamping handles its head)."""
-        dead = [b for b in self.blocks if (b + 1) * block_ms <= cutoff_ms]
-        for b in dead:
-            del self.blocks[b]
-
-    def window_rows(self, start_ms: int,
-                    end_ms: int) -> tuple[np.ndarray, np.ndarray]:
-        """Samples with ``start <= ts <= end`` in timestamp order — the
-        same inclusive clamp a leaf scan's ``read_range`` applies."""
-        ts_out: list[int] = []
-        val_out: list[float] = []
-        for b in sorted(self.blocks):
-            for t, v in self.blocks[b]:
-                if start_ms <= t <= end_ms:
-                    ts_out.append(t)
-                    val_out.append(v)
-        return (np.asarray(ts_out, dtype=np.int64),
-                np.asarray(val_out, dtype=np.float64))
-
-    @property
-    def sample_count(self) -> int:
-        return sum(len(rows) for rows in self.blocks.values())
-
-
-class WindowState:
-    """Incremental evaluator for one recording rule.
-
-    ``fetch`` is the engine's raw-series reader — it issues a
-    ``RawSeries`` plan through the normal planner -> admission ->
-    scheduler path and returns ``[(tags, ts, vals)]`` clamped to the
-    requested interval.
-    """
-
-    def __init__(self, spec: WindowSpec, block_ms: Optional[int] = None):
-        self.spec = spec
-        # chunk-aligned block boundary: the window itself (>= 1s), so a
-        # live window spans at most 2 resident blocks + the open one
-        self.block_ms = int(block_ms or max(spec.window_ms, 1000))
-        self.fetched_through_ms: Optional[int] = None
-        self.series: dict[tuple, _SeriesBuffer] = {}
-        self.samples_consumed = 0      # lifetime, for telemetry
-
-    # --------------------------------------------------------------- state
-
-    def reset(self) -> None:
-        """Forget everything: the next tick re-reads the full window
-        (cold).  Called by the engine after any failed evaluation so a
-        missed slice cannot leave a silent hole in the window."""
-        self.fetched_through_ms = None
-        self.series.clear()
-
-    @property
-    def resident_series(self) -> int:
-        return len(self.series)
-
-    @property
-    def resident_samples(self) -> int:
-        return sum(b.sample_count for b in self.series.values())
-
-    # ---------------------------------------------------------------- tick
-
-    def tick(self, eval_ms: int,
-             fetch: Callable[[tuple, int, int], list]
-             ) -> list[tuple[dict, float]]:
-        """Consume newly-arrived samples and produce ``[(tags, value)]``
-        for every series with a non-NaN window value at ``eval_ms``."""
-        window_start = eval_ms - self.spec.window_ms
-        warm = self.fetched_through_ms is not None \
-            and self.fetched_through_ms <= eval_ms
-        fetch_from = self.fetched_through_ms if warm else window_start
-        new = 0
-        for tags, ts, vals in fetch(self.spec.filters, fetch_from, eval_ms):
-            key = tuple(sorted(tags.items()))
-            buf = self.series.get(key)
-            if buf is not None:
-                # dedupe against THIS series' newest buffered row, not
-                # the global fetch boundary: a sample stamped exactly at
-                # the boundary but ingested after the boundary fetch ran
-                # would otherwise vanish from warm state (and break the
-                # bit-equality invariant vs a cold pass)
-                keep = ts > buf.last_ts
-            else:
-                keep = ts >= (fetch_from if warm else window_start)
-            ts, vals = ts[keep], vals[keep]
-            if not len(ts):
-                continue
-            if buf is None:
-                buf = self.series[key] = _SeriesBuffer(dict(tags))
-            buf.append(ts, vals, self.block_ms)
-            new += len(ts)
-        self.samples_consumed += new
-        self.fetched_through_ms = eval_ms
-        # evict aged blocks; a series whose whole window emptied is
-        # dropped outright — the stale-series discipline (doc/rules.md):
-        # state for a vanished series must not survive it
-        for key in list(self.series):
-            buf = self.series[key]
-            buf.evict_before(window_start, self.block_ms)
-            if not buf.blocks:
-                del self.series[key]
-        if not self.series:
-            return []
-        keys, ts_list, val_list = [], [], []
-        for buf in self.series.values():
-            ts, vals = buf.window_rows(window_start, eval_ms)
-            if not len(ts):
-                continue
-            keys.append(buf.tags)
-            ts_list.append(ts)
-            val_list.append(vals)
-        if not keys:
-            return []
-        batch = build_batch(ts_list, val_list, pad_to=_ROW_PAD)
-        values = np.asarray(apply_range_function(
-            batch, StepRange(eval_ms, eval_ms, 1000),
-            self.spec.window_ms, self.spec.function, self.spec.args))
-        out = []
-        for i, tags in enumerate(keys):
-            v = float(values[i, 0])
-            if not np.isnan(v):
-                out.append((tags, v))
-        return out
+__all__ = [
+    "AggWindowSpec", "AggWindowState", "WindowSpec", "WindowState",
+    "WindowUnsupported", "agg_window_spec", "window_spec",
+]

@@ -17,6 +17,15 @@ driven by a JSON config instead of HOCON:
       "profiler": false,
       "workload": {"min-remote-budget-ms": 5},
                                           # node-wide workload knobs
+      "result-cache": {                   # ISSUE 12 (doc/query-engine.md):
+                                          # chunk-aligned partial
+                                          # memoization + incremental
+                                          # instant windows, every
+                                          # dataset incl. rollup tiers
+        "enabled": true, "max-bytes": 67108864,
+        "segment": "1h",                  # default: the flush interval
+        "instant": true
+      },
       "dataplane": {                      # ISSUE 6 (doc/observability.md)
         "watermark-sample-interval-s": 10,
         "ingest-stall-window-s": 30,
@@ -141,6 +150,15 @@ class FiloServer:
         # resolution-routed serving; created on the first dataset with
         # a "rollup" block
         self.rollup_engine = None
+        # cluster-wide rollup tier closure gossip (ROADMAP 2b): peers'
+        # /__health rollup payloads land here via the StatusPoller so
+        # the resolution router stitches at the CLUSTER boundary
+        from filodb_tpu.memstore.watermarks import TierWatermarks
+        self.tier_watermarks = TierWatermarks(node=self.node)
+        # query-frontend result cache (ISSUE 12, doc/query-engine.md):
+        # one ResultCache per dataset (tiers included), embedded in the
+        # serving planner; the top-level "result-cache" block opts in
+        self.result_caches: dict[str, object] = {}
         self.write_publishers: dict[str, ShardingPublisher] = {}
         # dataset -> raw container publish fn (queue push / broker
         # produce / ReplicaFanout): the rollup engine emits rolled
@@ -319,7 +337,8 @@ class FiloServer:
                     "status-poll-interval-s", 2.0)),
                 on_assignment_change=resync_all,
                 local_running=self._running_shards,
-                local_watermarks=local_watermarks)
+                local_watermarks=local_watermarks,
+                tier_watermarks=self.tier_watermarks)
             self.status_poller.start()
         if self.config.get("profiler"):
             self.profiler = SimpleProfiler()
@@ -462,6 +481,26 @@ class FiloServer:
                                        spread_provider=spread_provider,
                                        dispatcher_for_shard=disp,
                                        mesh_engine_provider=mesh_provider)
+        # query-frontend result cache (ISSUE 12): the wrapper is always
+        # installed (a disabled cache is one boolean per materialize)
+        # so POST /admin/config can enable it at runtime; it sits BELOW
+        # the rollup router on purpose — tier selection stays upstream,
+        # and each tier dataset's own wrapper memoizes its segments
+        rc_conf = self.config.get("result-cache") or {}
+        from filodb_tpu.http.model import parse_duration_ms
+        from filodb_tpu.query.resultcache import (ResultCache,
+                                                  ResultCachingPlanner)
+        cache = ResultCache(
+            name,
+            max_bytes=int(rc_conf.get("max-bytes", 64 * 1024 * 1024)),
+            enabled=bool(rc_conf.get("enabled", False)))
+        seg_ms = parse_duration_ms(rc_conf["segment"]) \
+            if "segment" in rc_conf else store_cfg.flush_interval_ms
+        planner = ResultCachingPlanner(
+            name, planner, self.memstore, cache, segment_ms=seg_ms,
+            routing_token_fn=mapper.routing_token,
+            instant=bool(rc_conf.get("instant", True)))
+        self.result_caches[name] = cache
         schema = DEFAULT_SCHEMAS[ds_conf.get("schema", "gauge")]
         peers_conf = self.config.get("peers", {})
         if broker_producer is not None:
@@ -583,7 +622,8 @@ class FiloServer:
                                               scheduler=qsched,
                                               leaf_scheduler=leaf_sched,
                                               admission=admission,
-                                              quota=quota))
+                                              quota=quota,
+                                              resultcache=cache))
 
         gw_port = ds_conf.get("gateway-port")
         if gw_port is None and not self._global_gateway_claimed:
@@ -663,10 +703,53 @@ class FiloServer:
                       _m.coord_for_shard(s) == _n),
             admission=admission)
         from filodb_tpu.rollup.planner import RollupRouterPlanner
+
+        def cluster_rolled_through(res: int, _e=self.rollup_engine,
+                                   _n=name, _m=mapper,
+                                   _tw=self.tier_watermarks,
+                                   _node=self.node) -> int:
+            """Cluster-wide stitch boundary (ROADMAP 2b): min over the
+            shard owners' GOSSIPED closure watermarks — each owner is
+            authoritative for the shards it rolls, so intra-shard
+            series skew on peer shards can no longer open silent holes
+            the delivered-stamp proxy missed, and a coordinator that
+            owns no primaries can route rolled at all.  Still clamped
+            by what the LOCAL tier replicas have had delivered (a
+            boundary past undelivered data would stitch into a hole);
+            any owner without gossip yet degrades to the local
+            engine's conservative boundary, exactly the pre-gossip
+            behavior."""
+            local = _e.rolled_through(_n, res)
+            owners = {_m.coord_for_shard(s)
+                      for s in range(_m.num_shards)}
+            peer_owners = owners - {_node, None}
+            if not peer_owners:
+                return local
+            peer_min = _tw.cluster_min(_n, res, peer_owners)
+            if peer_min is None:
+                return local
+            owned = _e.owned_rolled_through(_n, res)
+            if _node in owners and owned is None:
+                # this node rolls shards but its engine has not
+                # computed a closure yet (pre-first-pass / restart):
+                # None means "unknown", not "owns nothing" — trusting
+                # peer_min alone would stitch past the local shards'
+                # actual closure
+                return local
+            vals = [peer_min] + ([owned] if owned is not None else [])
+            delivered = _e.delivered_through(_n, res)
+            if delivered is not None:
+                vals.append(delivered)
+            elif self.memstore.shards(ds_dataset_name(_n, res)):
+                # this node HOLDS tier replicas but nothing has been
+                # delivered yet (restart window): a boundary past the
+                # empty local tier data would stitch into a hole
+                return local
+            return min(vals)
+
         return RollupRouterPlanner(
             name, planner, tier_planners,
-            rolled_through_fn=(lambda r, _e=self.rollup_engine, _n=name:
-                               _e.rolled_through(_n, r)),
+            rolled_through_fn=cluster_rolled_through,
             raw_retention_ms=cfg.raw_retention_ms)
 
     def flush_all(self) -> int:

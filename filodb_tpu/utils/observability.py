@@ -587,6 +587,42 @@ def rollup_metrics() -> dict:
     }
 
 
+def resultcache_metrics() -> dict:
+    """Canonical query-frontend result-cache metrics
+    (query/resultcache.py): hit/miss traffic, resident bytes, LRU
+    evictions, and epoch/digest invalidations — one place defines the
+    names so the cache, /admin/resultcache, and doc/observability.md
+    can never drift."""
+    return {
+        "hits": REGISTRY.counter(
+            "filodb_resultcache_hits_total",
+            "queries (or query segments) served from memoized partials, "
+            "per dataset and kind (range segment | instant window)"),
+        "misses": REGISTRY.counter(
+            "filodb_resultcache_misses_total",
+            "cacheable segments/windows that had to be computed fresh, "
+            "per dataset and kind"),
+        "skipped": REGISTRY.counter(
+            "filodb_resultcache_skipped_total",
+            "queries that bypassed the cache, per dataset and reason "
+            "(shape|remote|range|open|instant-*)"),
+        "bytes": REGISTRY.gauge(
+            "filodb_resultcache_bytes",
+            "resident bytes of memoized partials + instant window "
+            "state, per dataset (reconciles exactly with a walk of the "
+            "live entries)"),
+        "evictions": REGISTRY.counter(
+            "filodb_resultcache_evictions_total",
+            "entries dropped to stay under the byte budget, per "
+            "dataset and reason"),
+        "invalidations": REGISTRY.counter(
+            "filodb_resultcache_invalidations_total",
+            "entries discarded / window states reset because their "
+            "validity inputs changed, per dataset and reason "
+            "(chunks|quarantine|routing|series|regressed)"),
+    }
+
+
 def odp_metrics() -> dict:
     """Canonical on-demand-paging metrics."""
     return {

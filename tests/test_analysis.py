@@ -200,6 +200,28 @@ RULE_CASES = [
      "    def dispatch(self, plan, ctx):\n"
      "        return self.replica_set.pick(plan.shard)[0]\n",
      "ReplicaSet.pick", {}),
+    ("bounded-cache",
+     # the PR 11 gateway-memo stampede shape: guarded read + keyed
+     # write, nothing ever evicts
+     "class SeriesMemo:\n"
+     "    def __init__(self):\n"
+     "        self._memo = {}\n"
+     "    def lookup(self, key):\n"
+     "        got = self._memo.get(key)\n"
+     "        if got is None:\n"
+     "            got = self._memo[key] = self._compute(key)\n"
+     "        return got\n",
+     "class SeriesMemo:\n"
+     "    def __init__(self):\n"
+     "        self._memo = {}\n"
+     "    def lookup(self, key):\n"
+     "        got = self._memo.get(key)\n"
+     "        if got is None:\n"
+     "            if len(self._memo) > 1000:\n"
+     "                self._memo.clear()\n"
+     "            got = self._memo[key] = self._compute(key)\n"
+     "        return got\n",
+     "eviction bound", {"rel": "filodb_tpu/gateway/fake.py"}),
     # --- the three NEW analyses, seeded with the PR 11/12 bug shapes ---
     ("lock-discipline",
      # the _set_tenant_gauges shape: rows mutated off the export lock
@@ -411,6 +433,48 @@ def test_guarded_by_annotation_flags_reads_and_writes():
     assert _fake(fixed, ["lock-discipline"]) == []
 
 
+def test_bounded_cache_scoped_to_serving_paths():
+    """The same unbounded memo outside the serving prefixes (analysis
+    tooling, tests, utils) is not a stampede surface and stays silent."""
+    src = ("class M:\n"
+           "    def __init__(self):\n"
+           "        self._memo = {}\n"
+           "    def get(self, k):\n"
+           "        if k not in self._memo:\n"
+           "            self._memo[k] = 1\n"
+           "        return self._memo[k]\n")
+    assert _fake(src, ["bounded-cache"],
+                 rel="filodb_tpu/gateway/fake.py") != []
+    assert _fake(src, ["bounded-cache"],
+                 rel="filodb_tpu/analysis/fake.py") == []
+
+
+def test_bounded_cache_accepts_evict_helper_and_module_memos():
+    """Handing the memo to an evict/prune helper (the gateway
+    evict_memo_half shape) is a bound; module-level memos are checked
+    with the same shape rules."""
+    helper = ("def lookup(self, k):\n"
+              "    got = self._memo.get(k)\n"
+              "    if got is None:\n"
+              "        evict_memo_half(self._memo)\n"
+              "        got = self._memo[k] = compute(k)\n"
+              "    return got\n")
+    src = ("class M:\n"
+           "    def __init__(self):\n"
+           "        self._memo = {}\n" + "    " +
+           helper.replace("\n", "\n    ").rstrip() + "\n")
+    assert _fake(src, ["bounded-cache"],
+                 rel="filodb_tpu/gateway/fake.py") == []
+    mod = ("_MEMO = {}\n"
+           "def lookup(k):\n"
+           "    got = _MEMO.get(k)\n"
+           "    if got is None:\n"
+           "        got = _MEMO[k] = compute(k)\n"
+           "    return got\n")
+    got = _fake(mod, ["bounded-cache"], rel="filodb_tpu/query/fake.py")
+    assert got and "module scope" in got[0].message
+
+
 def test_dangling_guarded_by_annotation_is_an_error():
     """A guarded-by comment that binds to no attribute assignment must
     fail loudly, not silently disarm the race detector."""
@@ -564,8 +628,12 @@ def test_full_tree_zero_unsuppressed_under_budget(tree_findings):
     for f in findings:
         if f.suppressed:
             assert f.suppress_reason.strip()
-    assert elapsed <= 10.0, \
-        f"filolint full-tree run took {elapsed:.1f}s (budget 10s)"
+    # budget raised 10s -> 15s in PR 17: the tree grew to 126+ files
+    # (typical run ~4-5s, vs 2.4s when PR 13 set 10s) and single-core
+    # CI boxes spike 2x under load — the guard still catches any
+    # super-linear regression without flaking on host noise
+    assert elapsed <= 15.0, \
+        f"filolint full-tree run took {elapsed:.1f}s (budget 15s)"
 
 
 def test_cli_json_output_for_ci(capsys):

@@ -1,0 +1,603 @@
+"""Query-frontend result cache (ISSUE 12).
+
+The load-bearing assertion is the generative equivalence sweep:
+cache-on answers are BIT-equal (``tobytes`` on the per-series value
+arrays, NaN masks included) to cache-off answers across seeded rounds
+of ingest-between-refreshes, chunk flush boundaries, new series
+materializing (including with OLD timestamps — the case warm state
+cannot see and must reset on), quarantine events, and replica
+transitions mid-refresh.  Plus: invalidation proofs per epoch source,
+the >=10x samples-scanned reduction on a warm cache, exact byte-LRU
+reconciliation, fingerprint gating, the rollup-boundary composition,
+the admin/config surface, and the tier-watermark gossip satellite."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.core.record import RecordBuilder, decode_container
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+from filodb_tpu.integrity import QUARANTINE
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.parallel.shardmap import ShardMapper, ShardStatus
+from filodb_tpu.promql.parser import (query_range_to_logical_plan,
+                                      query_to_logical_plan)
+from filodb_tpu.query.exec import ExecContext
+from filodb_tpu.query.model import PeriodicBatch, QueryContext
+from filodb_tpu.query.resultcache import (ResultCache, ResultCachingPlanner,
+                                          plan_fingerprint)
+
+BASE = 1_700_000_000_000
+DS = "prom"
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+
+
+class _Harness:
+    def __init__(self, num_shards=2, segment_ms=8_000, max_bytes=None,
+                 instant=True, doorkeeper=False):
+        self.mapper = ShardMapper(num_shards)
+        self.mapper.register_node(range(num_shards), "local")
+        self.ms = TimeSeriesMemStore()
+        for s in range(num_shards):
+            self.mapper.update_status(s, ShardStatus.ACTIVE)
+            self.ms.setup(DS, DEFAULT_SCHEMAS, s)
+        self.plain = SingleClusterPlanner(DS, self.mapper, DatasetOptions())
+        inner = SingleClusterPlanner(DS, self.mapper, DatasetOptions())
+        # unit tests default the doorkeeper OFF so the first
+        # evaluation already populates; the sweep runs it ON (the
+        # production shape)
+        self.cache = ResultCache(
+            DS, enabled=True, doorkeeper=doorkeeper,
+            max_bytes=max_bytes if max_bytes is not None else 64 << 20)
+        self.cached = ResultCachingPlanner(
+            DS, inner, self.ms, self.cache, segment_ms=segment_ms,
+            routing_token_fn=self.mapper.routing_token, instant=instant)
+        self._offset = 0
+
+    def ingest(self, metric, series_vals, ts):
+        b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], DatasetOptions(),
+                          container_size=1 << 20)
+        for tags, vals in series_vals:
+            full = dict(tags)
+            full["__name__"] = metric
+            b.add_series(np.asarray(ts, dtype=np.int64),
+                         [np.asarray(vals, dtype=np.float64)], full)
+        n = self.mapper.num_shards
+        for c in b.containers():
+            per = {}
+            for rec in decode_container(c, DEFAULT_SCHEMAS):
+                sh = self.mapper.ingestion_shard(rec.shard_hash,
+                                                 rec.part_hash, 1) % n
+                per.setdefault(sh, []).append(rec)
+            for sh, recs in per.items():
+                self.ms.get_shard(DS, sh).ingest(recs, self._offset)
+            self._offset += 1
+
+    def flush(self):
+        for sh in self.ms.shards(DS):
+            sh.flush_all()
+
+    def eval_range(self, planner, promql, start, step, end):
+        plan = query_range_to_logical_plan(promql, start, step, end)
+        qctx = QueryContext()
+        ep = planner.materialize(plan, qctx)
+        return ep.execute(ExecContext(self.ms, qctx))
+
+    def eval_instant(self, planner, promql, t):
+        plan = query_to_logical_plan(promql, t)
+        qctx = QueryContext()
+        ep = planner.materialize(plan, qctx)
+        return ep.execute(ExecContext(self.ms, qctx))
+
+
+def _series_map(res):
+    """{sorted-tags: (nan mask bytes, finite values bytes)} — the
+    bit-equality comparison surface (series/batch order is not part of
+    the API contract; values are)."""
+    out = {}
+    for b in res.batches:
+        if not isinstance(b, PeriodicBatch):
+            continue
+        for tags, ts, vals in b.to_series():
+            key = tuple(sorted(tags.items()))
+            vals = np.asarray(vals, dtype=np.float64)
+            mask = np.isnan(vals)
+            prev = out.get(key)
+            if prev is not None:
+                # same key split across batches: merge NaN slots
+                pv = np.frombuffer(prev[2], dtype=np.float64).copy()
+                pv[~mask] = vals[~mask]
+                vals = pv
+                mask = np.isnan(vals)
+            out[key] = (mask.tobytes(), vals[~mask].tobytes(),
+                        vals.tobytes())
+    return {k: v[:2] for k, v in out.items()}
+
+
+def _assert_bit_equal(res_a, res_b, ctx=""):
+    ma, mb = _series_map(res_a), _series_map(res_b)
+    assert set(ma) == set(mb), \
+        f"{ctx}: series sets differ: {set(ma) ^ set(mb)}"
+    for k in ma:
+        assert ma[k] == mb[k], f"{ctx}: series {k} differs"
+
+
+@pytest.fixture(autouse=True)
+def _clean_quarantine():
+    QUARANTINE.clear()
+    yield
+    QUARANTINE.clear()
+
+
+# ---------------------------------------------------------------------------
+# the generative equivalence sweep
+# ---------------------------------------------------------------------------
+
+SWEEP_QUERIES = [
+    "rate(m_total{_ws_=\"w\"}[5s])",
+    "sum(rate(m_total{_ws_=\"w\"}[5s]))",
+    "sum by (inst) (rate(m_total{_ws_=\"w\"}[5s]))",
+    "avg(rate(m_total{_ws_=\"w\"}[5s]))",
+    "max(increase(m_total{_ws_=\"w\"}[6s]))",
+]
+
+INSTANT_QUERIES = [
+    "rate(m_total{_ws_=\"w\"}[10s])",
+    "sum(rate(m_total{_ws_=\"w\"}[10s]))",
+    "sum by (inst) (rate(m_total{_ws_=\"w\"}[10s]))",
+]
+
+
+def _instant_pairs(res, t):
+    out = {}
+    for b in res.batches:
+        if not isinstance(b, PeriodicBatch):
+            continue
+        for tags, ts, vals in b.to_series():
+            fin = np.flatnonzero(~np.isnan(vals) & (ts <= t))
+            if len(fin):
+                out[tuple(sorted(tags.items()))] = \
+                    float(vals[fin[-1]]).hex()
+    return out
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_generative_equivalence_sweep(seed):
+    rng = np.random.default_rng(seed)
+    h = _Harness(num_shards=2, segment_ms=8_000, doorkeeper=True)
+    series = [({"inst": f"i{i}", "_ws_": "w"}, i + 1) for i in range(4)]
+    counters = {f"i{i}": 0.0 for i in range(4)}
+
+    def grow(tags_rate, ts):
+        rows = []
+        for tags, r in tags_rate:
+            inst = tags["inst"]
+            vals = []
+            for _t in ts:
+                counters[inst] = counters.get(inst, 0.0) \
+                    + r * (1 + rng.integers(0, 3))
+                vals.append(counters[inst])
+            rows.append((tags, np.asarray(vals)))
+        h.ingest("m_total", rows, ts)
+
+    # 40s of history, flushed (immutable chunks to memoize)
+    grow(series, BASE + np.arange(40, dtype=np.int64) * 1000)
+    h.flush()
+
+    now = BASE + 40_000
+    for rnd in range(6):
+        # ingest a fresh head sliver
+        ts = now + np.arange(5, dtype=np.int64) * 1000
+        grow(series, ts)
+        now = int(ts[-1]) + 1000
+        roll = rng.random()
+        if roll < 0.35:
+            h.flush()                      # chunk flush boundary
+        if roll < 0.2:
+            # a NEW series materializing with OLD timestamps — the
+            # late-arrival case warm state cannot see by delta alone
+            tag = {"inst": f"late{rnd}", "_ws_": "w"}
+            old = now - 20_000 + np.arange(8, dtype=np.int64) * 1000
+            h.ingest("m_total", [(tag, np.cumsum(
+                rng.integers(1, 4, size=8)).astype(np.float64))], old)
+            series.append((tag, 1))
+        if 0.2 <= roll < 0.3:
+            # quarantine a random flushed chunk mid-refresh
+            for sh in h.ms.shards(DS):
+                for part in sh.partitions.values():
+                    if part.chunks:
+                        info = part.chunks[0].info
+                        QUARANTINE.quarantine(
+                            part.partkey, info.chunk_id, dataset=DS,
+                            shard=sh.shard_num,
+                            start_time=info.start_time,
+                            end_time=info.end_time, reason="sweep")
+                        break
+                break
+        if 0.3 <= roll < 0.4:
+            # replica transition mid-refresh (failover shape): the
+            # routing token changes and cached answers must not
+            # outlive the routing view they were computed under
+            h.mapper.update_status(0, ShardStatus.RECOVERY)
+            h.mapper.update_status(0, ShardStatus.ACTIVE)
+
+        start, step, end = now - 30_000, 1000, now
+        for q in SWEEP_QUERIES:
+            cold = h.eval_range(h.plain, q, start, step, end)
+            warm1 = h.eval_range(h.cached, q, start, step, end)
+            _assert_bit_equal(cold, warm1, f"seed={seed} rnd={rnd} q={q}")
+            warm2 = h.eval_range(h.cached, q, start, step, end)
+            _assert_bit_equal(cold, warm2,
+                              f"seed={seed} rnd={rnd} q={q} (2nd)")
+        for q in INSTANT_QUERIES:
+            cold = _instant_pairs(h.eval_instant(h.plain, q, now), now)
+            warm = _instant_pairs(h.eval_instant(h.cached, q, now), now)
+            assert cold == warm, f"seed={seed} rnd={rnd} q={q}"
+    # the sweep must have exercised actual cache traffic
+    assert h.cache.hits > 0 and h.cache.misses > 0
+
+
+# ---------------------------------------------------------------------------
+# invalidation proofs (one per epoch source)
+# ---------------------------------------------------------------------------
+
+
+def _seeded(segment_ms=8_000, seconds=40, **kw):
+    h = _Harness(segment_ms=segment_ms, **kw)
+    ts = BASE + np.arange(seconds, dtype=np.int64) * 1000
+    h.ingest("m_total", [({"inst": "a", "_ws_": "w"},
+                          np.cumsum(np.ones(seconds))),
+                         ({"inst": "b", "_ws_": "w"},
+                          np.cumsum(np.ones(seconds)) * 3)], ts)
+    h.flush()
+    return h
+
+
+Q = "sum(rate(m_total{_ws_=\"w\"}[5s]))"
+
+
+def test_warm_range_hits_and_samples_scanned_reduction():
+    h = _seeded(segment_ms=5_000, seconds=120)
+    # deliberately misaligned to the segment grid (the dashboard shape):
+    # the partial first/last segments recompute, everything else hits
+    start, step, end = BASE + 6_000, 1000, BASE + 116_000
+    cold = h.eval_range(h.cached, Q, start, step, end)
+    assert cold.stats.samples_scanned > 0
+    warm = h.eval_range(h.cached, Q, start, step, end)
+    assert h.cache.hits > 0
+    # acceptance: >= 10x fewer samples scanned on the second evaluation
+    assert warm.stats.samples_scanned * 10 <= cold.stats.samples_scanned
+    # the stats=true split reports the cached-vs-recomputed counts
+    assert warm.stats.resultcache_cached_samples > 0
+    assert warm.stats.resultcache_recomputed_samples == \
+        warm.stats.samples_scanned
+    _assert_bit_equal(h.eval_range(h.plain, Q, start, step, end), warm)
+
+
+def test_quarantine_epoch_invalidates():
+    h = _seeded()
+    start, step, end = BASE + 6_000, 1000, BASE + 36_000
+    h.eval_range(h.cached, Q, start, step, end)
+    warm = h.eval_range(h.cached, Q, start, step, end)
+    assert h.cache.hits > 0
+    sh = h.ms.shards(DS)[0]
+    part = next(p for p in sh.partitions.values() if p.chunks)
+    info = part.chunks[0].info
+    assert QUARANTINE.quarantine(part.partkey, info.chunk_id, dataset=DS,
+                                 shard=sh.shard_num,
+                                 start_time=info.start_time,
+                                 end_time=info.end_time, reason="test")
+    inv0 = h.cache.invalidations
+    after = h.eval_range(h.cached, Q, start, step, end)
+    assert h.cache.invalidations > inv0
+    plain = h.eval_range(h.plain, Q, start, step, end)
+    _assert_bit_equal(plain, after)
+    # warning parity: both sides exclude the quarantined chunk
+    assert after.stats.corrupt_chunks_excluded == \
+        plain.stats.corrupt_chunks_excluded > 0
+    # and the pre-quarantine cached answer differed from the excluded
+    # one, proving the invalidation actually changed the bytes served
+    assert _series_map(warm) != _series_map(after)
+
+
+def test_replica_transition_invalidates():
+    h = _seeded()
+    start, step, end = BASE + 6_000, 1000, BASE + 36_000
+    h.eval_range(h.cached, Q, start, step, end)
+    h.eval_range(h.cached, Q, start, step, end)
+    assert h.cache.hits > 0
+    h.mapper.update_status(1, ShardStatus.RECOVERY)
+    inv0 = h.cache.invalidations
+    after = h.eval_range(h.cached, Q, start, step, end)
+    assert h.cache.invalidations > inv0
+    _assert_bit_equal(h.eval_range(h.plain, Q, start, step, end), after)
+
+
+def test_new_chunk_in_old_segment_invalidates():
+    h = _seeded()
+    start, step, end = BASE + 6_000, 1000, BASE + 36_000
+    h.eval_range(h.cached, Q, start, step, end)
+    hits0 = h.cache.hits
+    h.eval_range(h.cached, Q, start, step, end)
+    assert h.cache.hits > hits0
+    # a brand-new series lands with OLD timestamps inside cached
+    # segments, then flushes: the chunk digest changes
+    old = BASE + 10_000 + np.arange(10, dtype=np.int64) * 1000
+    h.ingest("m_total", [({"inst": "late", "_ws_": "w"},
+                          np.cumsum(np.ones(10)))], old)
+    h.flush()
+    after = h.eval_range(h.cached, Q, start, step, end)
+    _assert_bit_equal(h.eval_range(h.plain, Q, start, step, end), after)
+
+
+def test_instant_window_incremental_and_series_reset():
+    h = _seeded()
+    t0 = BASE + 40_000
+    q = "sum(rate(m_total{_ws_=\"w\"}[20s]))"
+    cold = h.eval_instant(h.cached, q, t0)
+    assert cold.stats.samples_scanned > 0
+    # refresh with only a head sliver of new data
+    ts = t0 + np.arange(3, dtype=np.int64) * 1000
+    h.ingest("m_total", [({"inst": "a", "_ws_": "w"},
+                          np.array([41.0, 42.0, 43.0])),
+                         ({"inst": "b", "_ws_": "w"},
+                          np.array([123.0, 126.0, 129.0]))], ts)
+    t1 = int(ts[-1])
+    warm = h.eval_instant(h.cached, q, t1)
+    assert warm.stats.samples_scanned * 5 <= cold.stats.samples_scanned
+    assert warm.stats.resultcache_cached_samples > 0
+    # the resident window's bytes are tracked through resize(): the
+    # accounted total must follow the state's growth exactly
+    accounted, walked = h.cache.reconcile()
+    assert accounted == walked > 1024
+    assert _instant_pairs(warm, t1) == \
+        _instant_pairs(h.eval_instant(h.plain, q, t1), t1)
+    # a new series appearing resets the window state (pid signature)
+    h.ingest("m_total", [({"inst": "c", "_ws_": "w"},
+                          np.cumsum(np.ones(15)))],
+             t1 - 14_000 + np.arange(15, dtype=np.int64) * 1000)
+    inv0 = h.cache.invalidations
+    t2 = t1 + 1000
+    after = h.eval_instant(h.cached, q, t2)
+    assert h.cache.invalidations > inv0
+    assert _instant_pairs(after, t2) == \
+        _instant_pairs(h.eval_instant(h.plain, q, t2), t2)
+
+
+# ---------------------------------------------------------------------------
+# fingerprint gating + LRU/byte accounting
+# ---------------------------------------------------------------------------
+
+
+def _fp(promql, start=BASE, step=1000, end=BASE + 60_000):
+    plan = query_range_to_logical_plan(promql, start, step, end)
+    return plan_fingerprint(plan, step, start)
+
+
+def test_fingerprint_allowlist():
+    assert _fp("rate(m[5s])") is not None
+    assert _fp("sum by (inst) (rate(m[5s]))") is not None
+    assert _fp("histogram_quantile(0.99, sum by (le) (rate(m[1m])))") \
+        is not None
+    assert _fp("sum(rate(m[5s])) * 2") is not None
+    # rank-based reduces, offsets, and joins are excluded
+    assert _fp("topk(3, rate(m[5s]))") is None
+    assert _fp("rate(m[5s] offset 1m)") is None
+    assert _fp("a / b") is None
+    assert _fp("quantile(0.5, rate(m[5s]))") is None
+    # step/phase are part of the key: a shifted grid never collides
+    assert _fp("rate(m[5s])", step=1000) != _fp("rate(m[5s])", step=2000)
+    assert _fp("rate(m[5s])", start=BASE) != \
+        _fp("rate(m[5s])", start=BASE + 500)
+
+
+def test_lru_byte_accounting_reconciles_and_evicts():
+    h = _seeded(max_bytes=3_000, segment_ms=5_000, seconds=60)
+    start, step, end = BASE + 6_000, 1000, BASE + 56_000
+    for metric in ("a", "b"):
+        q = f"rate(m_total{{_ws_=\"w\",inst=\"{metric}\"}}[5s])"
+        h.eval_range(h.cached, q, start, step, end)
+        h.eval_range(h.cached, Q, start, step, end)
+    accounted, walked = h.cache.reconcile()
+    assert accounted == walked
+    assert accounted <= h.cache.max_bytes
+    assert h.cache.evictions > 0
+    h.cache.clear()
+    assert h.cache.reconcile() == (0, 0)
+
+
+def test_doorkeeper_admits_only_repeating_fingerprints():
+    """First sight of a fingerprint passes through untouched (a stream
+    of never-repeating queries must not pay the digest/store work);
+    the second sighting populates, the third hits."""
+    h = _seeded(doorkeeper=True)
+    start, step, end = BASE + 6_000, 1000, BASE + 36_000
+    r1 = h.eval_range(h.cached, Q, start, step, end)   # doorkeeper only
+    assert h.cache.snapshot()["entries"] == 0
+    assert h.cache.misses == 0
+    r2 = h.eval_range(h.cached, Q, start, step, end)   # split + store
+    assert h.cache.snapshot()["entries"] > 0
+    hits0 = h.cache.hits
+    r3 = h.eval_range(h.cached, Q, start, step, end)   # hits
+    assert h.cache.hits > hits0
+    plain = h.eval_range(h.plain, Q, start, step, end)
+    for r in (r1, r2, r3):
+        _assert_bit_equal(plain, r)
+    # a clear() flushes entries but keeps the admission evidence
+    h.cache.clear()
+    h.eval_range(h.cached, Q, start, step, end)
+    assert h.cache.snapshot()["entries"] > 0
+
+
+def test_disabled_cache_is_pass_through():
+    h = _seeded()
+    h.cache.configure(enabled=False)
+    start, step, end = BASE + 6_000, 1000, BASE + 36_000
+    r1 = h.eval_range(h.cached, Q, start, step, end)
+    r2 = h.eval_range(h.cached, Q, start, step, end)
+    assert h.cache.hits == 0 and h.cache.misses == 0
+    _assert_bit_equal(r1, r2)
+    snap = h.cache.snapshot()
+    assert snap["entries"] == 0 and not snap["enabled"]
+
+
+# ---------------------------------------------------------------------------
+# rollup boundary composition: the cache sits BELOW the router, so a
+# moving tier boundary re-routes steps instead of serving stale entries
+# ---------------------------------------------------------------------------
+
+
+def test_rollup_boundary_movement_stays_equal():
+    from filodb_tpu.rollup.planner import RollupRouterPlanner
+
+    h = _Harness(segment_ms=5_000)
+    n = 120
+    ts = BASE + np.arange(n, dtype=np.int64) * 1000
+    h.ingest("m_total", [({"inst": "a", "_ws_": "w"},
+                          np.cumsum(np.ones(n)))], ts)
+    h.flush()
+    # a "tier" dataset on the same store: 5s-decimated copies
+    for s in range(h.mapper.num_shards):
+        h.ms.setup("prom_ds_5000", DEFAULT_SCHEMAS, s)
+    tier_plain = SingleClusterPlanner("prom_ds_5000", h.mapper,
+                                      DatasetOptions())
+    tier_cache = ResultCache("prom_ds_5000", enabled=True)
+    tier_cached = ResultCachingPlanner(
+        "prom_ds_5000", SingleClusterPlanner("prom_ds_5000", h.mapper,
+                                             DatasetOptions()),
+        h.ms, tier_cache, segment_ms=5_000,
+        routing_token_fn=h.mapper.routing_token)
+    b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], DatasetOptions(),
+                      container_size=1 << 20)
+    dec = np.arange(0, n, 5)
+    b.add_series(ts[dec], [np.cumsum(np.ones(n))[dec]],
+                 {"__name__": "m_total", "inst": "a", "_ws_": "w"})
+    for c in b.containers():
+        for rec in decode_container(c, DEFAULT_SCHEMAS):
+            sh = h.mapper.ingestion_shard(rec.shard_hash, rec.part_hash,
+                                          1) % h.mapper.num_shards
+            h.ms.get_shard("prom_ds_5000", sh).ingest([rec], 0)
+    boundary = [BASE + 30_000]
+
+    def mk_router(raw, tier):
+        return RollupRouterPlanner(DS, raw, {5000: tier},
+                                   rolled_through_fn=lambda r: boundary[0])
+
+    router_plain = mk_router(h.plain, tier_plain)
+    router_cached = mk_router(h.cached, tier_cached)
+    q = "sum(rate(m_total{_ws_=\"w\"}[10s]))"
+    start, step, end = BASE + 10_000, 5000, BASE + 110_000
+    for bnd in (BASE + 30_000, BASE + 60_000, BASE + 90_000):
+        boundary[0] = bnd
+        plan = query_range_to_logical_plan(q, start, step, end)
+        res_p = mk_router(h.plain, tier_plain).materialize(
+            plan, QueryContext()).execute(ExecContext(h.ms,
+                                                      QueryContext()))
+        res_c = router_cached.materialize(
+            plan, QueryContext()).execute(ExecContext(h.ms,
+                                                      QueryContext()))
+        _assert_bit_equal(res_p, res_c, f"boundary={bnd}")
+        res_c2 = router_cached.materialize(
+            plan, QueryContext()).execute(ExecContext(h.ms,
+                                                      QueryContext()))
+        _assert_bit_equal(res_p, res_c2, f"boundary={bnd} (2nd)")
+    assert h.cache.hits + tier_cache.hits > 0
+    assert router_plain is not None
+
+
+# ---------------------------------------------------------------------------
+# admin + runtime config surface
+# ---------------------------------------------------------------------------
+
+
+def test_admin_endpoint_and_runtime_knobs():
+    from filodb_tpu.http.server import DatasetBinding, FiloHttpServer
+
+    h = _seeded()
+    server = FiloHttpServer()
+    server.bind_dataset(DatasetBinding(DS, h.ms, h.cached,
+                                       resultcache=h.cache))
+    start, step, end = BASE + 6_000, 1000, BASE + 36_000
+    h.eval_range(h.cached, Q, start, step, end)
+    h.eval_range(h.cached, Q, start, step, end)
+    code, payload = server._resultcache({})
+    assert code == 200
+    snap = payload["data"]["datasets"][DS]
+    assert snap["hits"] > 0 and snap["reconcile"]["exact"]
+    # runtime knobs: disable + resize through /admin/config
+    code, cfg = server._config({"result-cache-enabled": "false",
+                                "result-cache-max-bytes": "1024"})
+    assert code == 200
+    assert cfg["data"]["result-cache"][DS] == {"enabled": False,
+                                               "max_bytes": 1024}
+    assert not h.cache.enabled and h.cache.max_bytes == 1024
+    # clear flushes the entries
+    code, payload = server._resultcache({"clear": "true"})
+    assert payload["data"]["datasets"][DS]["entries"] == 0
+
+
+def test_metrics_families_exported():
+    h = _seeded()
+    start, step, end = BASE + 6_000, 1000, BASE + 36_000
+    h.eval_range(h.cached, Q, start, step, end)
+    h.eval_range(h.cached, Q, start, step, end)
+    from filodb_tpu.utils.observability import REGISTRY
+    text = REGISTRY.expose_text()
+    assert "filodb_resultcache_hits_total" in text
+    assert "filodb_resultcache_bytes" in text
+
+
+# ---------------------------------------------------------------------------
+# tier-watermark gossip (ROADMAP 2b satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_tier_watermarks_store():
+    from filodb_tpu.memstore.watermarks import TierWatermarks
+
+    tw = TierWatermarks(node="a")
+    assert tw.cluster_min(DS, 60_000, ["b"]) is None   # no gossip yet
+    tw.note("b", DS, {"60000": BASE + 60_000})
+    tw.note("c", DS, {60_000: BASE + 30_000})
+    assert tw.peer_value("b", DS, 60_000) == BASE + 60_000
+    assert tw.cluster_min(DS, 60_000, ["b", "c"]) == BASE + 30_000
+    # monotone: a stale poll never drags the boundary back
+    tw.note("b", DS, {60_000: BASE})
+    assert tw.peer_value("b", DS, 60_000) == BASE + 60_000
+    # a dead owner's frozen boundary is dropped
+    tw.forget("c")
+    assert tw.cluster_min(DS, 60_000, ["b", "c"]) is None
+    assert tw.cluster_min(DS, 60_000, ["b"]) == BASE + 60_000
+    assert tw.snapshot()["b/prom"] == {"60000": BASE + 60_000}
+
+
+def test_health_payload_carries_rollup_watermarks_and_poller_ingests():
+    from filodb_tpu.coordinator.cluster import (FailureDetector,
+                                                ShardManager, StatusPoller)
+    from filodb_tpu.http.server import FiloHttpServer
+    from filodb_tpu.memstore.watermarks import TierWatermarks
+
+    class _FakeRollup:
+        def rolled_snapshot(self):
+            return {DS: {"60000": BASE + 42_000}}
+
+        def admin_state(self):
+            return {}
+
+    server = FiloHttpServer(node_name="b")
+    server.rollup = _FakeRollup()
+    code, body = server._health()
+    assert body["rollup"] == {DS: {"60000": BASE + 42_000}}
+
+    manager = ShardManager()
+    tw = TierWatermarks(node="a")
+    poller = StatusPoller(manager, FailureDetector(manager),
+                          peers={"b": "http://unused"}, local_node="a",
+                          tier_watermarks=tw)
+    poller._fetch_health = lambda ep: dict(body)
+    poller.poll_once()
+    assert tw.peer_value("b", DS, 60_000) == BASE + 42_000

@@ -282,16 +282,25 @@ class TestRecordingRules:
         mapper, ms, binding = _harness()
         ts = BASE + np.arange(20, dtype=np.int64) * 1000
         _ingest(mapper, ms, "m_total",
-                [({"inst": "a"}, np.cumsum(np.ones(20)))], ts)
+                [({"inst": "a"}, np.cumsum(np.ones(20))),
+                 ({"inst": "b"}, np.cumsum(np.ones(20)) * 2)], ts)
         pub = _CapturePublisher()
         eng = _engine(binding, pub, {"groups": [{
             "name": "g", "interval": "10s", "rules": [
                 {"record": "out:agg",
-                 "expr": "sum(rate(m_total[10s]))"}]}]})
-        rs = eng._groups[0].rules[0]
-        assert rs.incremental is None  # aggregation -> full evaluation
+                 "expr": "sum(rate(m_total[10s]))"},
+                {"record": "out:topk",
+                 "expr": "topk(1, rate(m_total[10s]))"}]}]})
+        from filodb_tpu.rules.incremental import AggWindowState
+        agg_rs, topk_rs = eng._groups[0].rules
+        # moment aggregations over windows are incremental now (the
+        # shape recorded dashboards use most); rank-based reduces
+        # still fall back to full evaluation
+        assert isinstance(agg_rs.incremental, AggWindowState)
+        assert topk_rs.incremental is None
         eng.run_group_once("g", eval_ms=BASE + 20_000)
         assert len(pub.of("out:agg")) == 1
+        assert len(pub.of("out:topk")) == 1
 
     def test_failed_rule_marks_health_and_resets_state(self):
         mapper, ms, binding = _harness()
@@ -601,6 +610,61 @@ class TestIncrementalWindows:
                     == np.float64(got_cold[k]).tobytes(), (expr, _round)
                 assert np.float64(v).tobytes() \
                     == np.float64(direct[k]).tobytes(), (expr, _round)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_generative_agg_bit_equality(self, seed):
+        """The NEW aggregated incremental shape (``agg by (..)(fn(
+        sel[w]))``): warm state after N random ingest/tick rounds is
+        BIT-equal to a cold pass of the same machine AND to the normal
+        query path's scatter-gather (per-shard map -> AggPartialBatch
+        reduce -> present) at the same instant."""
+        from filodb_tpu.rules.incremental import (AggWindowState,
+                                                  agg_window_spec)
+        rng = np.random.default_rng(seed + 100)
+        mapper, ms, binding = _harness()
+        ev = RuleEvaluator(binding)
+        fn = ["rate", "increase", "sum_over_time", "max_over_time"][seed % 4]
+        agg, by = [("sum", ""), ("avg", ""), ("sum", " by (grp)"),
+                   ("max", " by (grp)")][seed % 4]
+        window_s = int(rng.integers(5, 20))
+        expr = f"{agg}{by}({fn}(gen_agg[{window_s}s]))"
+        from filodb_tpu.promql.parser import query_to_logical_plan
+        spec = agg_window_spec(query_to_logical_plan(expr, BASE))
+        assert spec is not None
+        warm = AggWindowState(spec)
+        series = [{"inst": f"i{i}", "grp": f"g{i % 2}"} for i in range(4)]
+        now = BASE
+        offset = 0
+        fetch = lambda f, s, e: ev.raw_series_sharded(f, s, e, 30_000)  # noqa: E731
+        for _round in range(5):
+            step = int(rng.integers(200, 1500))
+            count = int(rng.integers(1, 15))
+            ts = now + np.arange(count, dtype=np.int64) * step
+            batch = []
+            for tags in series:
+                if rng.random() < 0.8:
+                    batch.append((tags,
+                                  np.cumsum(rng.random(count)) * 10))
+            if batch:
+                _ingest(mapper, ms, "gen_agg", batch, ts, offset=offset)
+                offset += 10
+            now = int(ts[-1] + rng.integers(0, 2000))
+
+            def unpack(b):
+                if b is None:
+                    return {}
+                vals = b.np_values()
+                return {tuple(sorted(b.keys[i].items())):
+                        np.float64(vals[i, 0]).tobytes()
+                        for i in range(len(b.keys))
+                        if not np.isnan(vals[i, 0])}
+
+            got_warm = unpack(warm.tick(now, fetch))
+            got_cold = unpack(AggWindowState(spec).tick(now, fetch))
+            direct = {tuple(sorted(t.items())): np.float64(v).tobytes()
+                      for t, v in ev.instant_vector(expr, now, 30_000)}
+            assert got_warm == got_cold, (expr, _round)
+            assert got_warm == direct, (expr, _round)
 
     def test_each_tick_consumes_only_new_samples(self):
         mapper, ms, binding = _harness()
