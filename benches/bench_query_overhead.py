@@ -319,6 +319,55 @@ def main():
             f"the 3% budget")
         return 1
 
+    # elastic-resharding guard (ISSUE 13): the same query loop with a
+    # CATCH-UP SPLIT permanently in flight — children registered as
+    # Recovery replicas, topology generation bumped, every materialize
+    # snapshotting the topology and checking parent exclusions (None
+    # until cutover).  A/B interleave against the plain mapper; the
+    # split must be invisible to serving until it commits.
+    split_mapper = ShardMapper(num_shards)
+    split_mapper.register_node(range(num_shards), "local")
+    for s in range(num_shards):
+        split_mapper.update_status(s, ShardStatus.ACTIVE)
+    split_mapper.begin_split(spread=spread)
+    for parent in range(num_shards):
+        split_mapper.register_split_child(parent + num_shards, ["local"])
+    planner_split = SingleClusterPlanner("prom", split_mapper,
+                                         DatasetOptions(),
+                                         spread_default=spread)
+
+    def once_split():
+        lp = query_range_to_logical_plan(query, start, STEP, end)
+        qctx = QueryContext(submit_time_ms=int(time.time() * 1000))
+        ep = planner_split.materialize(lp, qctx)
+        res = ep.execute(ExecContext(ms, qctx))
+        return to_prom_matrix(res)
+
+    body = once_split()
+    assert body["data"]["result"], "split-in-flight routing lost data"
+    once()
+    lat_plain, lat_split = [], []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        once()
+        lat_plain.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        once_split()
+        lat_split.append(time.perf_counter() - t0)
+    med_plain = statistics.median(lat_plain)
+    med_split = statistics.median(lat_split)
+    sp_overhead = (med_split - med_plain) / med_plain
+    log(f"split-in-flight plain {med_plain * 1e3:.2f} ms  "
+        f"catchup {med_split * 1e3:.2f} ms  "
+        f"overhead {sp_overhead * 100:+.2f}%")
+    emit("split_catchup_overhead_median", sp_overhead * 100, "%",
+         plain_ms=round(med_plain * 1e3, 3),
+         catchup_ms=round(med_split * 1e3, 3))
+    if sp_overhead > 0.03 and (med_split - med_plain) > 5e-4:
+        log(f"FAIL: split-in-flight overhead {sp_overhead * 100:.2f}% "
+            f"exceeds the 3% budget")
+        return 1
+
     # rule-engine guard (ISSUE 9): a LIVE rule group ticking at high
     # frequency (250 ms vs the 15 s production default — 60x) against
     # the same query loop.  The group carries an incremental windowed

@@ -28,7 +28,7 @@ from .engine import (  # noqa: F401
     unsuppressed,
 )
 from . import callgraph  # noqa: F401,E402 — whole-program call graph
-from . import caches, device, lifecycle, lockorder, locks, sentinels  # noqa: F401,E402 — register rules
+from . import caches, device, lifecycle, lockorder, locks, sentinels, topology  # noqa: F401,E402 — register rules
 from .report import (  # noqa: F401
     render_github, render_json, render_rule_list, render_text, summarize,
 )

@@ -1,0 +1,112 @@
+"""topology-generation: shard-keyed serving memos must survive splits.
+
+Elastic resharding (ISSUE 13, coordinator/split.py) doubles a live
+dataset's shard count by swapping the ShardMapper's Topology — and
+every serving-path structure that BAKED shard assignments into a memo
+(the gateway's series->shard memo and replayable group plans, dispatch
+staging memos, result-cache entries keyed on a shard layout) keeps
+routing at the retired topology forever unless it revalidates.  The
+mapper exposes exactly one cheap validity signal for this:
+``topology_generation`` (monotone, bumped on every topology
+transition), also folded into ``routing_token()``.
+
+This rule fires on a class that
+
+  (a) computes shard routing — calls ``.ingestion_shard(...)`` /
+      ``.query_shards(...)`` or reads ``.num_shards`` — AND
+  (b) keeps a memo/plan/cache attribute (name contains ``memo``,
+      ``plan``, or ``cache``) — AND
+  (c) never references ``topology_generation`` / ``topology`` /
+      ``routing_token`` anywhere in its body.
+
+A class that cannot observe a topology bump but caches per-shard
+decisions is exactly the post-split "samples keep publishing to the
+retired parent" regression the ISSUE 13 satellite fixed.  Structurally
+safe caches (rebuilt per batch, keyed by something topology-free) carry
+``# filolint: disable=topology-generation — <reason>`` on the reported
+line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, rule
+
+_SERVING_PREFIXES = (
+    "filodb_tpu/query/", "filodb_tpu/http/", "filodb_tpu/gateway/",
+    "filodb_tpu/coordinator/", "filodb_tpu/memstore/",
+    "filodb_tpu/parallel/", "filodb_tpu/rollup/", "filodb_tpu/ingest/",
+)
+
+_ROUTING_CALLS = {"ingestion_shard", "query_shards"}
+_MEMO_MARKERS = ("memo", "plan", "cache")
+_VALIDATORS = {"topology_generation", "topology", "_topologies",
+               "routing_token", "routing_token_fn"}
+
+
+def _memo_attr_line(cls: ast.ClassDef) -> tuple:
+    """(attr name, line) of the first self.<memo-ish> assignment."""
+    for node in ast.walk(cls):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        for t in targets:
+            # unwrap subscript writes: self._memo[k] = v
+            if isinstance(t, ast.Subscript):
+                t = t.value
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self" \
+                    and any(m in t.attr.lower() for m in _MEMO_MARKERS):
+                return t.attr, node.lineno
+    return None, 0
+
+
+def _routes_shards(cls: ast.ClassDef) -> bool:
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _ROUTING_CALLS:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "num_shards":
+            return True
+    return False
+
+
+def _validates_topology(cls: ast.ClassDef) -> bool:
+    return any(isinstance(n, ast.Attribute) and n.attr in _VALIDATORS
+               for n in ast.walk(cls))
+
+
+@rule("topology-generation",
+      doc="shard-routing classes with memo/plan/cache state that never "
+          "validate against ShardMapper.topology_generation — stale "
+          "after a live shard split")
+def topology_generation(module):
+    if not module.rel.startswith(_SERVING_PREFIXES) \
+            or module.tree is None:
+        return []
+    findings = []
+    for node in module.nodes:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        attr, line = _memo_attr_line(node)
+        if attr is None:
+            continue
+        if not _routes_shards(node):
+            continue
+        if _validates_topology(node):
+            continue
+        findings.append(Finding(
+            "topology-generation", module.rel, line,
+            f"{node.name}.{attr}: caches shard-derived state in a "
+            f"class that computes shard routing but never validates "
+            f"against topology_generation — after a live split commits "
+            f"(ISSUE 13) this memo keeps routing at the retired "
+            f"topology; check mapper.topology_generation (or key on "
+            f"routing_token()) and evict on a bump, or justify with a "
+            f"disable"))
+    return findings

@@ -161,6 +161,84 @@ def cmd_rollup_status(args) -> int:
     return 0
 
 
+def _http_post(server: str, path: str, params: dict | None = None) -> dict:
+    """POST with query params, returning JSON like _http_get."""
+    import urllib.error
+    qs = urllib.parse.urlencode({k: v for k, v in (params or {}).items()
+                                 if v is not None})
+    url = f"{server.rstrip('/')}{path}" + (f"?{qs}" if qs else "")
+    req = urllib.request.Request(url, data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return json.loads(e.read())
+        except Exception:  # non-JSON error body
+            return {"status": "error", "errorType": "http",
+                    "error": f"HTTP {e.code}"}
+    except urllib.error.URLError as e:
+        return {"status": "error", "errorType": "connection",
+                "error": f"cannot reach {server}: {e.reason}"}
+
+
+def _print_split_state(st: dict) -> None:
+    print(f"dataset {st['dataset']}: phase {st['phase']}, "
+          f"{st.get('num_shards')} serving / {st.get('total_shards')} "
+          f"total shards, generation {st.get('generation')}")
+    if st.get("cutover_seconds") is not None:
+        print(f"  cutover took {st['cutover_seconds'] * 1000:.1f}ms")
+    if st.get("grace_remaining_s") is not None:
+        print(f"  retire grace remaining: {st['grace_remaining_s']:.1f}s")
+    if st.get("abort_reason"):
+        print(f"  abort reason: {st['abort_reason']}")
+    for ch in st.get("children_status", []):
+        print(f"  child {ch['shard']} (parent {ch['parent']}) on "
+              f"{','.join(ch['nodes'])}: {ch['status']} "
+              f"{ch.get('progress', 0)}% wm={ch.get('watermark')}"
+              f"/head={ch.get('group_head')} "
+              f"rows={ch.get('rows_replayed', '?')}")
+
+
+def cmd_split(args) -> int:
+    """Trigger a live power-of-two shard split (ISSUE 13, doc/ha.md):
+    children catch up as Recovery replicas, cutover flips routing
+    atomically, the parent's migrated half retires after the grace
+    window.  Lossless abort any time before retire via split-abort."""
+    body = _http_post(args.server, f"/admin/split/{args.dataset}",
+                      {"action": "start", "grace-s": args.grace_s})
+    if body.get("status") != "success":
+        print(json.dumps(body, indent=2))
+        return 1
+    _print_split_state(body["data"])
+    return 0
+
+
+def cmd_split_status(args) -> int:
+    """Phase/progress of a live split (served by /admin/split)."""
+    body = _http_get(args.server, f"/admin/split/{args.dataset}")
+    if body.get("status") != "success":
+        print(json.dumps(body, indent=2))
+        return 1
+    if args.json:
+        print(json.dumps(body["data"], indent=2))
+        return 0
+    _print_split_state(body["data"])
+    return 0
+
+
+def cmd_split_abort(args) -> int:
+    """Lossless split abort: children discarded, the parent topology
+    restored in one generation bump (refused once retire has purged)."""
+    body = _http_post(args.server, f"/admin/split/{args.dataset}",
+                      {"action": "abort", "reason": args.reason})
+    if body.get("status") != "success":
+        print(json.dumps(body, indent=2))
+        return 1
+    _print_split_state(body["data"])
+    return 0
+
+
 def cmd_shards(args) -> int:
     """Ingest watermark / shard-health tree (served by /admin/shards)."""
     body = _http_get(args.server, "/admin/shards")
@@ -379,6 +457,28 @@ def build_parser() -> argparse.ArgumentParser:
                              "health tree")
     server_args(sh)
     sh.set_defaults(fn=cmd_shards)
+
+    sp = sub.add_parser("split",
+                        help="trigger a live power-of-two shard split "
+                             "(N -> 2N, zero downtime)")
+    server_args(sp)
+    sp.add_argument("--grace-s", type=float, default=30.0,
+                    help="seconds between cutover and parent retire — "
+                         "the lossless-abort horizon")
+    sp.set_defaults(fn=cmd_split)
+
+    ss = sub.add_parser("split-status",
+                        help="phase/progress of a live shard split")
+    server_args(ss)
+    ss.add_argument("--json", action="store_true",
+                    help="raw JSON instead of the text summary")
+    ss.set_defaults(fn=cmd_split_status)
+
+    sa = sub.add_parser("split-abort",
+                        help="losslessly abort an in-flight shard split")
+    server_args(sa)
+    sa.add_argument("--reason", default="operator abort")
+    sa.set_defaults(fn=cmd_split_abort)
 
     cm = sub.add_parser("chunkmeta",
                         help="chunk-level metadata for matching series")

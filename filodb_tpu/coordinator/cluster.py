@@ -106,12 +106,16 @@ class DefaultShardAssignmentStrategy(ShardAssignmentStrategy):
         # shards still short of rf live replicas that this node does not
         # already hold a copy of, emptiest groups first (stable by id);
         # one live_replicas snapshot per shard keeps the filter, the
-        # membership check, and the sort key consistent (and O(1) each)
+        # membership check, and the sort key consistent (and O(1) each).
+        # In-flight split CHILDREN (ISSUE 13) are never auto-placed —
+        # a child replica only makes sense on a node that can clone the
+        # parent's local data, which the SplitController arranges.
         live = {s: mapper.live_replicas(s)
                 for s in range(mapper.num_shards)}
         need = sorted(
             (s for s in range(mapper.num_shards)
              if len(live[s]) < rf
+             and mapper.split_parent_of(s) is None
              and all(r.node != node for r in live[s])),
             key=lambda s: (len(live[s]), s))
         return have + need[:quota - len(have)]
@@ -186,8 +190,11 @@ class ShardManager:
             freed: dict[str, list[int]] = {}
             for info in self._datasets.values():
                 # EVERY replica the node holds demotes (Error included —
-                # shards_for_node only lists live copies)
-                shards = [s for s in range(info.num_shards)
+                # shards_for_node only lists live copies).  Sweep the
+                # TOTAL shard space: in-flight split children's dead
+                # copies must demote too or the promotion gate would
+                # wait on a ghost forever (ISSUE 13)
+                shards = [s for s in range(info.mapper.total_shards)
                           if info.mapper.state(s).replica(node)
                           is not None]
                 for s in shards:
@@ -285,6 +292,11 @@ class ShardManager:
         relay)."""
         with self._lock:
             info = self._datasets.get(event.dataset)
+            if info is not None \
+                    and not 0 <= event.shard < info.mapper.total_shards:
+                # a discarded split child's dying consumer reporting
+                # after the abort truncated the shard space (ISSUE 13)
+                info = None
             if info is not None:
                 status = _EVENT_STATUS.get(type(event))
                 node = getattr(event, "node", None)
@@ -340,6 +352,13 @@ class ShardManager:
         now_ms = self._clock() * 1000.0
         moved = []
         for s in shards:
+            if info.mapper.split_parent_of(s) is not None:
+                # a fully-dead in-flight split CHILD is not reassigned:
+                # a fresh node has no parent data to clone from, and an
+                # empty promoted child would silently serve holes.  The
+                # SplitController aborts (losslessly) or waits for the
+                # holder to rejoin instead.
+                continue
             if info.mapper.live_replicas(s):
                 continue  # a surviving replica still covers the shard
             key = (info.name, s)
@@ -363,7 +382,7 @@ class ShardManager:
         the replication factor (rf > live nodes, or groups left short
         after a failure) — a degraded group has less failure headroom
         than the operator configured."""
-        short = [s for s in range(info.num_shards)
+        short = [s for s in range(info.mapper.total_shards)
                  if len(info.mapper.live_replicas(s))
                  < info.replication_factor]
         was = info.degraded
@@ -564,6 +583,11 @@ class StatusPoller:
             if body is None:
                 continue
             self.detector.heartbeat(peer)
+            # topology adoption (ISSUE 13) runs FIRST and from ANY peer:
+            # generations are strictly monotone, so newest-wins is safe
+            # regardless of leadership, and the grown shard space must
+            # exist before this sweep's replica rows can land on it
+            changed |= self._adopt_topology(body)
             leader = self.leader
             if peer == leader and leader != self.local_node:
                 changed |= self._adopt_leader_view(body)
@@ -641,6 +665,23 @@ class StatusPoller:
             except Exception:  # noqa: BLE001 — report, keep gossiping
                 _tb.print_exc()
 
+    def _adopt_topology(self, body: dict) -> bool:
+        """Fold a peer's gossiped per-dataset topology (shard counts,
+        generation, split phase) into the local mappers — the cluster-
+        wide propagation path for live shard splits (ISSUE 13).  The
+        SplitController on the triggering node drives the transitions;
+        everyone else converges here within one poll interval."""
+        changed = False
+        topo = body.get("topology") or {}
+        if not topo:
+            return False
+        with self.manager._lock:
+            for ds, payload in topo.items():
+                if ds not in self.manager.datasets():
+                    continue
+                changed |= self.manager.mapper(ds).adopt_topology(payload)
+        return changed
+
     def _adopt_leader_view(self, body: dict) -> bool:
         """Replace local shard OWNERSHIP (the full replica group) with
         the leader's (reference: every node caches the singleton's
@@ -654,7 +695,9 @@ class StatusPoller:
                 mapper = self.manager.mapper(ds)
                 for st in shards:
                     shard = int(st.get("shard", -1))
-                    if not 0 <= shard < mapper.num_shards:
+                    # total_shards: in-flight split children's replica
+                    # groups gossip like any other (ISSUE 13)
+                    if not 0 <= shard < mapper.total_shards:
                         continue
                     rows = st.get("replicas")
                     if rows is None:
@@ -691,7 +734,7 @@ class StatusPoller:
                 live = {int(s) for s in running[ds]} if ds in running \
                     else None
                 ds_wms = watermarks.get(ds) or {}
-                for shard in range(mapper.num_shards):
+                for shard in range(mapper.total_shards):
                     rep = mapper.state(shard).replica(peer)
                     if rep is None:
                         continue

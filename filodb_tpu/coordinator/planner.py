@@ -94,6 +94,26 @@ class SingleClusterPlanner(QueryPlanner):
         # reduce; remote shards keep HTTP dispatch alongside
         self.mesh_engine_provider = mesh_engine_provider
 
+    # -- topology snapshot (ISSUE 13) ---------------------------------------
+
+    def _topology(self, qctx: QueryContext):
+        """The mapper topology THIS query plans against, captured once
+        per (query, dataset) and reused for every fan-out and leaf
+        decision in the materialize pass.  A live shard split commits by
+        swapping the mapper's topology; a query that read the old
+        num_shards for fan-out must also use the old (no-exclusion)
+        leaf stamps — mixing the two either drops or double-counts the
+        migrated half.  Stored on the qctx (not a wire field) so the
+        rollup router and result cache, which re-enter materialize with
+        the same qctx, stay on one consistent view per dataset."""
+        topos = getattr(qctx, "_topologies", None)
+        if topos is None:
+            topos = qctx._topologies = {}
+        topo = topos.get(self.dataset)
+        if topo is None:
+            topo = topos[self.dataset] = self.mapper.topology
+        return topo
+
     # -- shard pruning (reference :106-136) ---------------------------------
 
     def shards_from_filters(self, filters: Sequence[ColumnFilter],
@@ -105,7 +125,7 @@ class SingleClusterPlanner(QueryPlanner):
             if col == self.options.metric_column:
                 v = v if v is not None else equals_value(filters, "_metric_")
             if v is None:
-                return self._all_shards()
+                return self._all_shards(qctx)
             values[col] = v
         # per-query spread override wins over the provider (reference:
         # QueryActor.scala:70-85 — explicit spreadOverride beats the func)
@@ -115,9 +135,9 @@ class SingleClusterPlanner(QueryPlanner):
         if qctx.spread is not None:
             spread = qctx.spread
         shash = self._shard_key_hash(values)
-        shards = [s % self.mapper.num_shards
-                  for s in self.mapper.query_shards(shash, spread)]
-        active = set(self.mapper.active_shards())
+        topo = self._topology(qctx)
+        shards = topo.query_shards(shash, spread)
+        active = set(self.mapper.active_shards(range(topo.num_shards)))
         if active:
             shards = [s for s in shards if s in active] or shards
         return sorted(set(shards))
@@ -134,9 +154,10 @@ class SingleClusterPlanner(QueryPlanner):
             parts.append(v)
         return stable_hash32("\x00".join(parts).encode())
 
-    def _all_shards(self) -> list[int]:
-        active = self.mapper.active_shards()
-        return active if active else list(range(self.mapper.num_shards))
+    def _all_shards(self, qctx: QueryContext) -> list[int]:
+        topo = self._topology(qctx)
+        active = self.mapper.active_shards(range(topo.num_shards))
+        return active if active else list(range(topo.num_shards))
 
     def plan_is_local(self, plan: lp.LogicalPlan,
                       qctx: QueryContext) -> bool:
@@ -259,7 +280,7 @@ class SingleClusterPlanner(QueryPlanner):
             inner.add_transformer(VectorFunctionMapper())
             return inner
         if isinstance(plan, lp.LabelValues):
-            shards = self._all_shards()
+            shards = self._all_shards(qctx)
             children = [LabelValuesExec(self.dataset, s, plan.label_names,
                                         plan.filters, plan.start_ms,
                                         plan.end_ms, qctx,
@@ -268,9 +289,11 @@ class SingleClusterPlanner(QueryPlanner):
             return LabelValuesDistConcatExec(children, qctx)
         if isinstance(plan, lp.SeriesKeysByFilters):
             shards = self.shards_from_filters(plan.filters, qctx)
+            topo = self._topology(qctx)
             children = [PartKeysExec(self.dataset, s, plan.filters,
                                      plan.start_ms, plan.end_ms, qctx,
-                                     self.dispatcher_for_shard(s))
+                                     self.dispatcher_for_shard(s),
+                                     reshard_to=topo.parent_exclusion(s))
                         for s in shards]
             return PartKeysDistConcatExec(children, qctx)
         if isinstance(plan, lp.RawChunkMeta):
@@ -287,12 +310,14 @@ class SingleClusterPlanner(QueryPlanner):
             # leaf scans with no periodic mapper, concatenated (reference:
             # SelectRawPartitionsExec without transformers)
             shards = self.shards_from_filters(plan.filters, qctx)
+            topo = self._topology(qctx)
             column = plan.columns[0] if plan.columns else None
             children = [MultiSchemaPartitionsExec(
                 self.dataset, s, plan.filters,
                 plan.range_selector.from_ms, plan.range_selector.to_ms,
                 column=column, query_context=qctx,
-                dispatcher=self.dispatcher_for_shard(s))
+                dispatcher=self.dispatcher_for_shard(s),
+                reshard_to=topo.parent_exclusion(s))
                 for s in shards]
             return DistConcatExec(children, qctx)
         raise ValueError(f"cannot materialize {type(plan).__name__}")
@@ -312,6 +337,7 @@ class SingleClusterPlanner(QueryPlanner):
                   shards=None) -> ExecPlan:
         if shards is None:
             shards = self.shards_from_filters(raw.filters, qctx)
+        topo = self._topology(qctx)
         column = raw.columns[0] if raw.columns else None
         children = []
         for s in shards:
@@ -319,7 +345,8 @@ class SingleClusterPlanner(QueryPlanner):
                 self.dataset, s, raw.filters,
                 raw.range_selector.from_ms, raw.range_selector.to_ms,
                 column=column, query_context=qctx,
-                dispatcher=self.dispatcher_for_shard(s))
+                dispatcher=self.dispatcher_for_shard(s),
+                reshard_to=topo.parent_exclusion(s))
             leaf.add_transformer(PeriodicSamplesMapper(
                 start, step, end, window_ms=window, function=function,
                 function_args=args, offset_ms=offset))
@@ -374,6 +401,13 @@ class SingleClusterPlanner(QueryPlanner):
         if not mesh_supported(plan.operator, function, plan.params):
             return None
         shards = self.shards_from_filters(raw.filters, qctx)
+        topo = self._topology(qctx)
+        if any(topo.parent_exclusion(s) for s in shards):
+            # a split parent must slice off its migrated half at scan
+            # time; the fused mesh program stages whole grids and has no
+            # per-series exclusion — fall back to per-shard leaves until
+            # the split retires (perf-only, bounded by the grace window)
+            return None
         local = [s for s in shards
                  if self.dispatcher_for_shard(s) is IN_PROCESS]
         remote = [s for s in shards if s not in local]

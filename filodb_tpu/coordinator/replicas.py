@@ -22,6 +22,13 @@ Ordering, healthiest first:
 3. **latency** — the local node ranks first (no network hop), then
    PR 10's calibrated per-endpoint dispatch latency (observed p50).
 4. node name, for a stable total order.
+
+Elastic resharding (ISSUE 13) needs NO special casing here, by
+construction: split children are ordinary replica groups in the
+mapper's (grown) shard space, invisible to fan-out until the cutover
+flips ``num_shards`` — after which ``pick`` routes them exactly like
+any other shard, including the Recovery-serves-only-without-an-Active-
+peer rule for a child whose in-stream promotion has not fired yet.
 """
 
 from __future__ import annotations

@@ -1,0 +1,962 @@
+"""Elastic resharding: live power-of-two shard splits (ISSUE 13).
+
+The reference (and this port, until now) fixes a dataset's shard count
+at creation — shards bind 1:1 to source partitions at setup, and a hot
+dataset can only grow by offline resharding.  This module doubles a
+live dataset's shard count with zero serving downtime and zero lost or
+double-counted rows, riding the PR 12 replica machinery end to end:
+
+- Because shard assignment is a hash mask, parent shard ``s`` splits
+  into children ``{s, s + N}`` (N = old count) for EVERY spread setting
+  (``shardmap.shard_of_tags``; the generative sweep in
+  tests/test_split.py proves it).  The lower half stays with the parent
+  in place — only the upper half moves, and it moves as a REPLICA
+  RECOVERY, not a data copy protocol of its own.
+
+- Source partitions do not move: the child consumes the PARENT's
+  partition (``shard % base`` at the stream factory), filtered to its
+  half by ``TimeSeriesShard.split_ingest_filter``.  Parent and child
+  offsets therefore live in one domain, so the child is literally a
+  PR 12 recovering replica: it inherits the parent's persisted chunks
+  + checkpoints (cloned under ``split_clone_lock`` so the pair is an
+  at-rest snapshot), replays from the earliest checkpoint with the
+  standard per-group watermark skipping, reports RecoveryInProgress,
+  and is promoted at the replica group head through the existing
+  watermark gate (``ShardMapper.group_head`` folds the parent's head
+  for split children).  Live rows keep flowing to every copy through
+  the unchanged publish paths — the broker partition log, or the
+  ReplicaFanout dual-write lanes on queue transports.
+
+Phase machine (persisted in the metastore KV, gossiped in ``/__health``
+``topology`` payloads, adopted newest-generation-wins by every node):
+
+    catchup   children registered as Recovery replicas on the parent's
+              replica nodes; clones + replay run; queries still route
+              the parent topology (children invisible to fan-out)
+    serving   CUTOVER committed: one atomic Topology swap flips gateway
+              sharding + query fan-out to 2N; parents exclude their
+              migrated half at scan time (plan-time ``reshard_to``
+              stamps — a query straddling the flip stays on the
+              topology it planned against); parents still hold a full
+              superset, so abort stays lossless
+    retire    grace window elapsed: every node purges its parents'
+              migrated partitions + persisted chunks and installs the
+              retain-half ingest filter
+    complete  split bookkeeping dropped (exclusions no longer needed)
+    aborted   children discarded wholesale, topology reverted; the
+              parent never stopped serving the full keyspace
+
+Abort is first-class from any phase up to retire (the grace window IS
+the abort horizon — once parents purge, the children are the only copy
+of the migrated half).  Every phase + cursor persists, so a restarted
+coordinator resumes (or an operator aborts) instead of wedging.
+
+Rollup tier datasets (``<ds>_ds_<res>``) split in LOCKSTEP with their
+source: same phases, children on the tier parents' replica nodes.  Tier
+children REBUILD from their source children's rollup emissions (rolled
+data is derived; the resolution router's conservative cluster boundary
+routes raw until they catch up), so tier cutover needs no clone.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+from filodb_tpu.core.record import parse_partkey
+from filodb_tpu.parallel.shardmap import ShardStatus, shard_of_tags
+
+_METRICS = None
+
+PHASE_CODES = {"": 0, "none": 0, "prepare": 1, "catchup": 2, "serving": 3,
+               "retire": 4, "complete": 5, "aborting": 6, "aborted": 6}
+
+# phases an abort may interrupt: once RETIRE starts purging parents,
+# the children are the only complete copy of the migrated half and a
+# rollback would lose data — the grace window is the abort horizon
+ABORTABLE_PHASES = ("prepare", "catchup", "serving")
+
+
+def _m() -> dict:
+    global _METRICS
+    if _METRICS is None:
+        from filodb_tpu.utils.observability import split_metrics
+        _METRICS = split_metrics()
+    return _METRICS
+
+
+def _record_key(dataset: str) -> str:
+    return f"split::{dataset}"
+
+
+def _clone_key(dataset: str, shard: int) -> str:
+    return f"splitclone::{dataset}::{shard}"
+
+
+def _retire_key(dataset: str) -> str:
+    return f"splitretire::{dataset}"
+
+
+class SplitController:
+    """One per FiloServer.  Doubles as the split PARTICIPANT on every
+    node (clone children, purge parents, clean up aborts — all driven
+    by the gossiped topology) and the split COORDINATOR on the node
+    that triggered it (phase machine + cutover/retire gates).  All
+    mapper mutations go through the ShardManager lock; all phase state
+    persists in the metastore KV before it takes effect, so a crash at
+    any point resumes or aborts losslessly."""
+
+    def __init__(self, node: str, manager, memstore, column_store,
+                 meta_store,
+                 peers: Optional[dict] = None,
+                 resync: Optional[Callable[[], None]] = None,
+                 transport_for: Optional[Callable[[str], str]] = None,
+                 tiers_for: Optional[Callable[[str], list]] = None,
+                 fresh_nodes: Optional[Callable[[], list]] = None,
+                 spread_for: Optional[Callable[[str], int]] = None,
+                 tick_interval_s: float = 0.25,
+                 health_timeout_s: float = 1.5):
+        self.node = node
+        self.manager = manager
+        self.memstore = memstore
+        self.colstore = column_store
+        self.metastore = meta_store
+        self.peers = dict(peers or {})
+        self._resync = resync or (lambda: None)
+        # "broker" (shared partition log: children replay it directly)
+        # or "queue" (ReplicaFanout dual-write; tier datasets)
+        self.transport_for = transport_for or (lambda ds: "queue")
+        self.tiers_for = tiers_for or (lambda ds: [])
+        # liveness view for the quorum gate (standalone wires the
+        # failure detector's fresh_nodes); None = no detector — fetch
+        # every peer rather than treating them all as stale
+        self.fresh_nodes = fresh_nodes
+        self.spread_for = spread_for
+        self.tick_interval_s = tick_interval_s
+        self.health_timeout_s = health_timeout_s
+        self._records: dict[str, dict] = {}   # guarded-by: _lock
+        self._lock = threading.RLock()
+        self._loop = None
+        # chaos hooks (integrity/faultinject.py): a held transition
+        # name stalls the phase machine right before that transition —
+        # deterministic "kill mid-catch-up / partition mid-cutover"
+        self._holds: set = set()              # guarded-by: _lock
+        self._listeners: list = []
+        self._clone_failed: dict = {}         # (ds, shard) -> error str
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        from filodb_tpu.utils.observability import PeriodicThread
+        if self._loop is None:
+            self._loop = PeriodicThread(self._tick, self.tick_interval_s,
+                                        "split-controller")
+            self._loop.start()
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.stop()
+            self._loop = None
+
+    def load_persisted(self) -> None:
+        """Read every persisted split record (before datasets set up)."""
+        try:
+            rows = self.metastore.list_kv("split::")
+        except NotImplementedError:
+            rows = {}
+        with self._lock:
+            for key, blob in rows.items():
+                try:
+                    rec = json.loads(blob)
+                except ValueError:
+                    continue
+                self._records[rec["dataset"]] = rec
+
+    def restore_dataset(self, dataset: str) -> None:
+        """Re-apply a persisted split's topology to a freshly-built
+        mapper (standalone start).  ``dataset`` may be the split root or
+        one of its lockstep tiers; each mapper replays the transitions
+        up to the recorded phase, so a coordinator restart resumes the
+        split exactly where it persisted it."""
+        with self._lock:
+            for rec in self._records.values():
+                if dataset != rec["dataset"] and \
+                        dataset not in rec.get("tiers", ()):
+                    continue
+                phase = rec["phase"]
+                if phase in ("aborted",):
+                    return
+                mapper = self.manager.mapper(dataset)
+                with self.manager._lock:
+                    if mapper.topology.split_phase is not None \
+                            or mapper.total_shards >= rec["total"]:
+                        return  # already applied / adopted
+                    if phase == "aborting":
+                        # abort persisted but not fully acked: the
+                        # mapper simply stays on the parent topology
+                        return
+                    mapper.begin_split(spread=int(rec["spread"]))
+                    for child, nodes in rec["children"].get(
+                            dataset, {}).items():
+                        mapper.register_split_child(int(child), nodes)
+                    if phase in ("serving", "retire", "complete"):
+                        mapper.commit_split()
+                    if phase in ("retire", "complete"):
+                        mapper.retire_split()
+                    if phase == "complete":
+                        mapper.finish_split()
+                return
+
+    # ---------------------------------------------------------- operations
+
+    def trigger(self, dataset: str, grace_s: float = 30.0) -> dict:
+        """Start a live N -> 2N split.  Children are placed on their
+        parent's live replica nodes (the clone is a LOCAL read there;
+        rebalancing is a separate, ordinary placement concern), in
+        Recovery, invisible to query fan-out until cutover."""
+        if dataset not in self.manager.datasets():
+            raise KeyError(dataset)
+        if self.transport_for(dataset) != "broker":
+            raise ValueError(
+                f"dataset {dataset!r} is not broker-sourced: live splits "
+                f"replay the shared partition log for lossless catch-up "
+                f"(queue-transport datasets would lose drained history)")
+        with self._lock:
+            rec = self._records.get(dataset)
+            if rec is not None and rec["phase"] not in ("complete",
+                                                        "aborted"):
+                raise ValueError(
+                    f"dataset {dataset!r} already has a split in flight "
+                    f"(phase {rec['phase']})")
+            for other in self._records.values():
+                if dataset in other.get("tiers", ()) \
+                        and other["phase"] not in ("complete", "aborted"):
+                    raise ValueError(
+                        f"{dataset!r} is a rollup tier of "
+                        f"{other['dataset']!r}; split the source dataset")
+            tiers = [t for t in self.tiers_for(dataset)
+                     if t in self.manager.datasets()]
+            spread = self._spread_of(dataset)
+            children: dict[str, dict] = {}
+            gens: dict[str, int] = {}
+            with self.manager._lock:
+                for ds in [dataset] + tiers:
+                    mapper = self.manager.mapper(ds)
+                    topo = mapper.begin_split(spread=spread)
+                    base = topo.split_base
+                    ch: dict[str, list] = {}
+                    for parent in range(base):
+                        nodes = [r.node for r in
+                                 mapper.live_replicas(parent)] \
+                            or [self.node]
+                        child = parent + base
+                        mapper.register_split_child(child, nodes)
+                        ch[str(child)] = nodes
+                    children[ds] = ch
+                    gens[ds] = mapper.topology_generation
+            rec = {"dataset": dataset, "base": len(children[dataset]),
+                   "total": 2 * len(children[dataset]),
+                   "spread": spread, "phase": "catchup",
+                   "grace_s": float(grace_s), "tiers": tiers,
+                   "children": children, "gens": gens,
+                   "started_ms": int(time.time() * 1000),
+                   "cutover_ms": None, "cutover_seconds": None,
+                   "abort_reason": None, "owner": self.node}
+            self._records[dataset] = rec  # filolint: disable=bounded-cache — keyed by operator-triggered dataset names, structurally bounded
+            self._persist(rec)
+        self._note_phase(dataset, "catchup")
+        self.reconcile()
+        self._resync()
+        return self.status(dataset)
+
+    def abort(self, dataset: str, reason: str = "operator abort") -> dict:
+        """Lossless rollback from any phase before retire: children are
+        discarded wholesale, the topology reverts in one generation
+        bump, and the parents — which held a full superset throughout —
+        just keep serving."""
+        with self._lock:
+            rec = self._records.get(dataset)
+            mapper_split = self.manager.mapper(dataset).topology.split_phase
+            if rec is None and mapper_split is None:
+                raise ValueError(f"no split in flight for {dataset!r}")
+            if rec is not None and rec["phase"] not in ABORTABLE_PHASES:
+                raise ValueError(
+                    f"split for {dataset!r} is in phase {rec['phase']} — "
+                    f"abort is only lossless before retire purges the "
+                    f"parents (tune grace-s for a longer abort horizon)")
+            tiers = rec.get("tiers", []) if rec is not None \
+                else [t for t in self.tiers_for(dataset)
+                      if t in self.manager.datasets()]
+            gens: dict[str, int] = {}
+            with self.manager._lock:
+                for ds in [dataset] + list(tiers):
+                    mapper = self.manager.mapper(ds)
+                    mapper.abort_split()
+                    gens[ds] = mapper.topology_generation
+            if rec is None:
+                rec = {"dataset": dataset, "tiers": tiers, "children": {},
+                       "grace_s": 0.0, "spread": self._spread_of(dataset),
+                       "base": self.manager.mapper(dataset).num_shards,
+                       "total": 0, "started_ms": int(time.time() * 1000),
+                       "cutover_ms": None, "cutover_seconds": None,
+                       "owner": self.node}
+                self._records[dataset] = rec
+            rec["phase"] = "aborting"
+            rec["abort_reason"] = reason
+            rec["gens"] = gens
+            self._persist(rec)
+        _m()["aborts"].inc(dataset=dataset)
+        self._note_phase(dataset, "aborting")
+        self.reconcile()
+        self._resync()
+        return self.status(dataset)
+
+    # ---------------------------------------------------------- chaos hooks
+
+    def hold(self, transition: str) -> None:
+        """Stall the phase machine right before ``transition``
+        ("cutover" | "retire" | "complete") — the deterministic latch
+        the chaos harness uses to kill/partition nodes at an exact
+        phase (integrity/faultinject.py)."""
+        with self._lock:
+            self._holds.add(transition)
+
+    def release(self, transition: str) -> None:
+        with self._lock:
+            self._holds.discard(transition)
+
+    def _held(self, transition: str) -> bool:
+        with self._lock:
+            return transition in self._holds
+
+    def on_transition(self, fn: Callable[[str, str], None]) -> None:
+        """Subscribe to (dataset, phase) transitions (chaos harness)."""
+        self._listeners.append(fn)
+
+    def _note_phase(self, dataset: str, phase: str) -> None:
+        _m()["phase"].set(PHASE_CODES.get(phase, 0), dataset=dataset)
+        try:
+            _m()["generation"].set(
+                self.manager.mapper(dataset).topology_generation,
+                dataset=dataset)
+        except KeyError:
+            pass
+        from filodb_tpu.utils.devicewatch import FLIGHT
+        FLIGHT.record("split.phase", dataset=dataset, phase=phase,
+                      node=self.node)
+        for fn in list(self._listeners):
+            try:
+                fn(dataset, phase)
+            except Exception:  # noqa: BLE001 — listeners never stall phases
+                traceback.print_exc()
+
+    # ------------------------------------------------------------- queries
+
+    def status(self, dataset: str) -> Optional[dict]:
+        with self._lock:
+            rec = self._records.get(dataset)
+            if rec is None:
+                return None
+            out = dict(rec)
+        try:
+            mapper = self.manager.mapper(dataset)
+        except KeyError:
+            return out
+        topo = mapper.topology
+        out["generation"] = topo.generation
+        out["num_shards"] = topo.num_shards
+        out["total_shards"] = mapper.total_shards
+        children = []
+        base = rec["base"]
+        for child_s, nodes in sorted(rec["children"].get(dataset,
+                                                         {}).items(),
+                                     key=lambda kv: int(kv[0])):
+            child = int(child_s)
+            if child >= mapper.total_shards:
+                continue
+            st = mapper.state(child)
+            serving = st.serving_replica()
+            head = mapper.group_head(child)
+            row = {"shard": child, "parent": child - base,
+                   "nodes": nodes, "status": st.best_status.value,
+                   "progress": serving.recovery_progress
+                   if serving is not None else 0,
+                   "watermark": serving.watermark
+                   if serving is not None else -1,
+                   "group_head": head}
+            try:
+                sh = self.memstore.get_shard(dataset, child)
+                row["rows_replayed"] = sh.stats.rows_ingested
+                row["rows_filtered"] = sh.stats.rows_split_filtered
+            except Exception:  # noqa: BLE001 — not set up locally
+                pass
+            err = self._clone_failed.get((dataset, child))
+            if err is not None:
+                # a clone failing every tick stalls the split silently
+                # otherwise — the operator sees the reason here
+                row["clone_error"] = err
+            children.append(row)
+        out["children_status"] = children
+        if rec.get("cutover_ms") and rec["phase"] == "serving":
+            out["grace_remaining_s"] = max(
+                0.0, rec["grace_s"]
+                - (time.time() * 1000 - rec["cutover_ms"]) / 1000.0)
+        return out
+
+    def admin_state(self) -> dict:
+        with self._lock:
+            names = list(self._records)
+        return {"node": self.node,
+                "splits": [self.status(ds) for ds in names]}
+
+    def split_progress(self) -> dict:
+        """This node's participant progress, published in /__health so
+        the coordinator can gate retire/complete on every node having
+        actually purged (clone progress rides the ordinary replica
+        status gossip)."""
+        out: dict = {}
+        for ds in self.manager.datasets():
+            topo = self.manager.mapper(ds).topology
+            if topo.split_phase is None:
+                continue
+            row = {"generation": topo.generation}
+            if topo.split_phase == "retire":
+                row["retired"] = self.metastore.read_kv(
+                    _retire_key(ds)) is not None
+            out[ds] = row
+        return out
+
+    def _marker_done(self, key: str, topo) -> bool:
+        """A KV marker counts only when it was written under THIS split
+        instance (the prepare-generation epoch) — a stale marker from a
+        previous split of the same dataset must never satisfy a later
+        one (it would skip the clone or, worse, the retire purge)."""
+        return self.metastore.read_kv(key) == str(topo.split_epoch)
+
+    def _mark_done(self, key: str, topo) -> None:
+        self.metastore.write_kv(key, str(topo.split_epoch))
+
+    def startable_shards(self, dataset: str, shards: list) -> list:
+        """Gate for resync: a split child must not start consuming until
+        its local clone (chunks + checkpoints) landed — starting earlier
+        would replay from nothing and miss the pre-checkpoint history."""
+        mapper = self.manager.mapper(dataset)
+        topo = mapper.topology
+        if topo.split_phase != "catchup":
+            return list(shards)
+        out = []
+        for s in shards:
+            if mapper.split_parent_of(s) is None:
+                out.append(s)
+            elif self.transport_for(dataset) != "broker" \
+                    or self._marker_done(_clone_key(dataset, s), topo):
+                out.append(s)
+        return out
+
+    # -------------------------------------------------------- shard hooks
+
+    def on_shard_setup(self, dataset: str, shard) -> None:
+        """memstore.shard_setup_hook: installs split filters on shards
+        the moment they are created, BEFORE any ingest can reach them."""
+        self._apply_shard_policy(dataset, shard)
+
+    def _apply_shard_policy(self, dataset: str, shard) -> None:
+        try:
+            mapper = self.manager.mapper(dataset)
+        except KeyError:
+            return
+        topo = mapper.topology
+        if topo.split_phase is None:
+            return
+        total = topo.total_shards
+        spread = topo.split_spread or 0
+        num = shard.shard_num
+        if mapper.split_parent_of(num) is not None:
+            # split child: keep only its half of the replayed parent
+            # partition, from the very first container
+            shard.split_ingest_filter = (
+                lambda tags, _t=total, _sp=spread, _s=num:
+                shard_of_tags(tags, _t, _sp) == _s)
+        elif topo.split_phase == "retire" and num < (topo.split_base or 0):
+            # retired parent: refuse to re-materialize migrated series
+            # (straggler publishers on the old generation)
+            shard.split_ingest_filter = (
+                lambda tags, _t=total, _sp=spread, _s=num:
+                shard_of_tags(tags, _t, _sp) == _s)
+
+    # ------------------------------------------------------------- driving
+
+    def _tick(self) -> None:
+        try:
+            self.reconcile()
+            with self._lock:
+                records = [dict(r) for r in self._records.values()
+                           if r.get("owner") == self.node]
+            for rec in records:
+                self._drive(rec)
+            self._refresh_metrics()
+        except Exception:  # noqa: BLE001 — keep ticking, loudly
+            traceback.print_exc()
+
+    def _refresh_metrics(self) -> None:
+        with self._lock:
+            recs = list(self._records.values())
+        for rec in recs:
+            ds = rec["dataset"]
+            _m()["phase"].set(PHASE_CODES.get(rec["phase"], 0), dataset=ds)
+            try:
+                mapper = self.manager.mapper(ds)
+            except KeyError:
+                continue
+            _m()["generation"].set(mapper.topology_generation, dataset=ds)
+            if rec["phase"] in ("catchup", "serving"):
+                rows = sum(sh.stats.rows_ingested
+                           for sh in self.memstore.shards(ds)
+                           if sh.shard_num >= rec["base"])
+                _m()["replayed_rows"].set(rows, dataset=ds)
+            if rec.get("cutover_seconds") is not None:
+                _m()["cutover_seconds"].set(rec["cutover_seconds"],
+                                            dataset=ds)
+
+    def _drive(self, rec: dict) -> None:
+        phase = rec["phase"]
+        ds = rec["dataset"]
+        if phase in ("catchup", "serving", "retire") \
+                and self._reconcile_record_with_topology(rec):
+            return
+        if phase == "catchup":
+            if self._held("cutover"):
+                return
+            if not self._children_caught_up(rec):
+                return
+            if not self._peers_ready(rec["gens"]):
+                return
+            self._do_cutover(rec)
+        elif phase == "serving":
+            if self._held("retire"):
+                return
+            cut = rec.get("cutover_ms") or 0
+            if time.time() * 1000 - cut < rec["grace_s"] * 1000.0:
+                return
+            if not self._peers_ready(rec["gens"]):
+                return
+            self._do_retire(rec)
+        elif phase == "retire":
+            if self._held("complete"):
+                return
+            if self.metastore.read_kv(_retire_key(ds)) is None:
+                return  # local purge not done yet (reconcile runs it)
+            for t in rec.get("tiers", ()):
+                if self.metastore.read_kv(_retire_key(t)) is None:
+                    return
+            if not self._peers_ready(rec["gens"], require_retired=rec):
+                return
+            self._do_complete(rec)
+        elif phase == "aborting":
+            if not self._peers_ready(rec["gens"]):
+                return
+            with self._lock:
+                rec = self._records.get(ds) or rec
+                if rec["phase"] != "aborting":
+                    return
+                rec["phase"] = "aborted"
+                self._persist(rec)
+            self._note_phase(ds, "aborted")
+
+    def _reconcile_record_with_topology(self, rec: dict) -> bool:
+        """An abort issued on ANOTHER node reaches this (owner) node as
+        an adopted topology with the split gone — the owned record must
+        follow, or it would march its phases against a reverted mapper
+        (vacuously-true gates) and its restart would resurrect the
+        aborted split at generations gossip can never override.
+        Returns True when the record was retired from driving."""
+        ds = rec["dataset"]
+        try:
+            mapper = self.manager.mapper(ds)
+        except KeyError:
+            return True
+        if mapper.topology.split_phase is not None:
+            return False
+        final = "aborted" if mapper.total_shards <= rec["base"] \
+            else "complete"
+        with self._lock:
+            live = self._records.get(ds)
+            if live is None or live["phase"] != rec["phase"]:
+                return True
+            live["phase"] = final
+            if final == "aborted" and not live.get("abort_reason"):
+                live["abort_reason"] = "aborted elsewhere (adopted)"
+            self._persist(live)
+        self._note_phase(ds, final)
+        return True
+
+    def _do_cutover(self, rec: dict) -> None:
+        ds = rec["dataset"]
+        t0 = time.monotonic()
+        gens: dict[str, int] = {}
+        with self._lock:
+            live = self._records.get(ds)
+            if live is None or live["phase"] != "catchup":
+                return
+            with self.manager._lock:
+                for name in [ds] + list(rec.get("tiers", ())):
+                    mapper = self.manager.mapper(name)
+                    if mapper.topology.split_phase == "catchup":
+                        mapper.commit_split()
+                    gens[name] = mapper.topology_generation
+            live["phase"] = "serving"
+            live["gens"] = gens
+            live["cutover_ms"] = int(time.time() * 1000)
+            live["cutover_seconds"] = round(time.monotonic() - t0, 6)
+            self._persist(live)
+        _m()["cutover_seconds"].set(rec["cutover_seconds"]
+                                    if rec.get("cutover_seconds") else
+                                    time.monotonic() - t0, dataset=ds)
+        self._note_phase(ds, "serving")
+        self._resync()
+
+    def _do_retire(self, rec: dict) -> None:
+        ds = rec["dataset"]
+        gens: dict[str, int] = {}
+        with self._lock:
+            live = self._records.get(ds)
+            if live is None or live["phase"] != "serving":
+                return
+            with self.manager._lock:
+                for name in [ds] + list(rec.get("tiers", ())):
+                    mapper = self.manager.mapper(name)
+                    if mapper.topology.split_phase == "serving":
+                        mapper.retire_split()
+                    gens[name] = mapper.topology_generation
+            live["phase"] = "retire"
+            live["gens"] = gens
+            self._persist(live)
+        self._note_phase(ds, "retire")
+        self.reconcile()   # purge locally right away
+
+    def _do_complete(self, rec: dict) -> None:
+        ds = rec["dataset"]
+        with self._lock:
+            live = self._records.get(ds)
+            if live is None or live["phase"] != "retire":
+                return
+            with self.manager._lock:
+                for name in [ds] + list(rec.get("tiers", ())):
+                    mapper = self.manager.mapper(name)
+                    if mapper.topology.split_phase == "retire":
+                        mapper.finish_split()
+            live["phase"] = "complete"
+            self._persist(live)
+        self._note_phase(ds, "complete")
+
+    # --------------------------------------------------------------- gates
+
+    def _children_caught_up(self, rec: dict) -> bool:
+        """Cutover gate: every child group's serving replica passed the
+        PR 12 promotion gate (ACTIVE at the group head) — or sits in
+        RECOVERY with offset evidence it has nothing left to replay (a
+        quiescent partition delivers no element to trip the in-stream
+        promotion, but its offsets don't lie).  Additionally every
+        LOCALLY-held child must have replayed past what its local
+        parent had ingested when the check started (read parent first:
+        monotone, so a pass can never go stale — post-cutover rows keep
+        flowing to both halves of the parent partition)."""
+        for ds in [rec["dataset"]] + list(rec.get("tiers", ())):
+            mapper = self.manager.mapper(ds)
+            topo = mapper.topology
+            if topo.split_phase != "catchup":
+                continue
+            base = topo.split_base or 0
+            # offsets are comparable only on the broker transport (one
+            # shared partition log); tier/queue children number their
+            # own streams and rebuild from rollup emissions — their
+            # readiness is the consumer being up (ACTIVE), with the
+            # resolution router's conservative boundary covering the
+            # rebuild window
+            comparable = self.transport_for(ds) == "broker"
+            for child_s in rec["children"].get(ds, {}):
+                child = int(child_s)
+                if not self._child_ready(ds, mapper, child, child - base,
+                                         comparable):
+                    return False
+        return True
+
+    def _child_ready(self, ds: str, mapper, child: int, parent: int,
+                     offsets_comparable: bool = True) -> bool:
+        def effective_offset(sh) -> int:
+            # a shard that replayed nothing yet still "holds" everything
+            # its (cloned) checkpoints cover — the persisted chunks ARE
+            # that data
+            wms = [w for w in sh.group_watermarks]
+            return max([sh.latest_offset] + wms)
+
+        st = mapper.state(child)
+        best = st.best_status
+        if not offsets_comparable:
+            return best is ShardStatus.ACTIVE
+        local_off = None
+        p_off = None
+        try:
+            p_off = effective_offset(self.memstore.get_shard(ds, parent))
+            local_off = effective_offset(self.memstore.get_shard(ds, child))
+        except Exception:  # noqa: BLE001 — copies not held locally
+            pass
+        if best is ShardStatus.ACTIVE:
+            # promotion gate passed; still require the monotone local
+            # offset check when we can read both shards directly
+            return local_off is None or local_off >= p_off
+        if best is not ShardStatus.RECOVERY:
+            return False
+        if local_off is not None:
+            return local_off >= p_off
+        serving = st.serving_replica()
+        wm = serving.watermark if serving is not None else -1
+        head = mapper.group_head(child)
+        return wm >= 0 and head >= 0 and wm >= head
+
+    def _peers_ready(self, gens: dict, require_retired: Optional[dict]
+                     = None) -> bool:
+        """Phase-advance gate: a MAJORITY of the configured cluster
+        (self included) must be reachable and have adopted at least the
+        given generations (and, for the complete gate, report their
+        parents purged).  A reachable-but-lagging peer stalls outright
+        (it adopts within a gossip sweep); an unreachable peer simply
+        doesn't count toward the quorum — so a killed minority cannot
+        block the split, while a coordinator PARTITIONED from its peers
+        can never advance phases alone (the mid-cutover chaos
+        scenario): serving continues either way, and progress resumes
+        on heal."""
+        nodes = set(self.peers) | {self.node}
+        if len(nodes) <= 1:
+            return True
+        # peers the failure detector already declared stale are not
+        # fetched at all (no ack, no veto): a dead peer must not cost
+        # this gate a connect timeout on every 250ms tick
+        fresh = set(self.fresh_nodes()) if self.fresh_nodes is not None \
+            else None
+        acked = 1   # self, trivially at its own generations
+        for peer, endpoint in self.peers.items():
+            if peer == self.node \
+                    or (fresh is not None and peer not in fresh):
+                continue
+            body = self._fetch_health(endpoint)
+            if body is None:
+                continue   # unreachable: no ack, no veto
+            topo = body.get("topology") or {}
+            for ds, gen in gens.items():
+                peer_gen = int((topo.get(ds) or {}).get("generation", -1))
+                if peer_gen < gen:
+                    return False
+            if require_retired is not None:
+                prog = body.get("split_progress") or {}
+                for ds in [require_retired["dataset"]] \
+                        + list(require_retired.get("tiers", ())):
+                    if not (prog.get(ds) or {}).get("retired"):
+                        return False
+            acked += 1
+        return acked * 2 > len(nodes)
+
+    def _fetch_health(self, endpoint: str) -> Optional[dict]:
+        import urllib.error
+        import urllib.request
+        try:
+            with urllib.request.urlopen(f"{endpoint}/__health",
+                                        timeout=self.health_timeout_s) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return json.loads(e.read())
+            except Exception:  # noqa: BLE001
+                return None
+        except Exception:  # noqa: BLE001 — unreachable
+            return None
+
+    # --------------------------------------------------------- participant
+
+    def reconcile(self) -> None:
+        """Per-node participant duties, driven purely by the (gossiped)
+        mapper topology — idempotent, crash-safe via KV markers."""
+        resync_needed = False
+        for ds in list(self.manager.datasets()):
+            try:
+                mapper = self.manager.mapper(ds)
+            except KeyError:
+                continue
+            topo = mapper.topology
+            if topo.split_phase == "catchup":
+                resync_needed |= self._reconcile_catchup(ds, mapper)
+            elif topo.split_phase in ("serving", "retire"):
+                self._reconcile_parent_filters(ds, mapper)
+                if topo.split_phase == "retire":
+                    self._reconcile_retire(ds, mapper)
+            elif topo.split_phase is None:
+                resync_needed |= self._reconcile_orphans(ds, mapper)
+        if resync_needed:
+            self._resync()
+
+    def _reconcile_catchup(self, ds: str, mapper) -> bool:
+        """Clone parents' persisted state into locally-held children
+        that lack their marker; returns True when a new clone completed
+        (the child consumer can start now)."""
+        started = False
+        topo = mapper.topology
+        base = topo.split_base or 0
+        for child in range(base, mapper.total_shards):
+            if mapper.state(child).replica(self.node) is None:
+                continue
+            # child filter may need retro-install (shard set up before
+            # the topology was adopted on this node)
+            try:
+                sh = self.memstore.get_shard(ds, child)
+                if sh.split_ingest_filter is None:
+                    self._apply_shard_policy(ds, sh)
+            except Exception:  # noqa: BLE001 — not set up yet (hook covers)
+                pass
+            if self.transport_for(ds) != "broker":
+                continue   # tier children rebuild from rollup emissions
+            if self._marker_done(_clone_key(ds, child), topo):
+                continue
+            if self._clone_child(ds, child, base, topo):
+                started = True
+        return started
+
+    def _clone_child(self, ds: str, child: int, base: int, topo) -> bool:
+        """Clone the parent's (persisted chunks, partkeys, checkpoints)
+        into the child, filtered to the child's half, as one at-rest
+        snapshot: ``split_clone_lock`` excludes the flush executor's
+        persist->checkpoint pair, preserving the recovery invariant
+        (checkpoints only cover persisted rows) on the child.  The
+        child then replays the parent's partition from its earliest
+        cloned checkpoint — the standard PR 12 recovery path."""
+        parent = child - base
+        try:
+            parent_sh = self.memstore.get_shard(ds, parent)
+        except Exception:  # noqa: BLE001 — parent not local: cannot clone
+            return False
+        total, spread = topo.total_shards, topo.split_spread or 0
+        keep = (lambda pk, _t=total, _sp=spread, _c=child:
+                shard_of_tags(parse_partkey(pk), _t, _sp) == _c)
+        t0 = time.monotonic()
+        try:
+            with parent_sh.split_clone_lock:
+                n = self.colstore.clone_shard(ds, parent, child, keep)
+                for grp, off in self.metastore.read_checkpoints(
+                        ds, parent).items():
+                    self.metastore.write_checkpoint(ds, child, grp, off)
+        except Exception as e:  # noqa: BLE001 — surface, retry next tick
+            self._clone_failed[(ds, child)] = str(e)
+            traceback.print_exc()
+            return False
+        self._clone_failed.pop((ds, child), None)
+        self._mark_done(_clone_key(ds, child), topo)
+        from filodb_tpu.utils.devicewatch import FLIGHT
+        FLIGHT.record("split.clone", dataset=ds, shard=child,
+                      parent=parent, chunks=n, node=self.node,
+                      seconds=round(time.monotonic() - t0, 6))
+        return True
+
+    def _reconcile_parent_filters(self, ds: str, mapper) -> None:
+        """Post-cutover: nothing to install on parents besides what the
+        planner stamps per query; retired parents additionally get the
+        ingest filter in _reconcile_retire.  Kept as a hook point so a
+        late-setup parent shard re-applies policy."""
+        topo = mapper.topology
+        if topo.split_phase != "retire":
+            return
+        for parent in range(topo.split_base or 0):
+            try:
+                sh = self.memstore.get_shard(ds, parent)
+            except Exception:  # noqa: BLE001 — not local
+                continue
+            if sh.split_ingest_filter is None:
+                self._apply_shard_policy(ds, sh)
+
+    def _reconcile_retire(self, ds: str, mapper) -> None:
+        """Purge local parents' migrated halves once, marker-guarded.
+        The PERSISTED side is swept independently of the in-memory
+        purge result (store partkeys rehashed directly): a retry after
+        a transient store failure must still delete the migrated
+        chunks, or a restart would re-materialize series the child now
+        owns."""
+        topo = mapper.topology
+        if self._marker_done(_retire_key(ds), topo):
+            return
+        total, spread = topo.total_shards, topo.split_spread or 0
+        purged_total = 0
+        for parent in range(topo.split_base or 0):
+            try:
+                sh = self.memstore.get_shard(ds, parent)
+            except Exception:  # noqa: BLE001 — not held locally
+                continue
+            if sh.split_ingest_filter is None:
+                self._apply_shard_policy(ds, sh)
+            purged = sh.purge_resharded(total, spread)
+            purged_total += len(purged)
+            try:
+                migrated = set(purged)
+                migrated.update(
+                    r.partkey
+                    for r in self.colstore.scan_part_keys(ds, parent)
+                    if shard_of_tags(parse_partkey(r.partkey), total,
+                                     spread) != parent)
+                if migrated:
+                    self.colstore.delete_part_keys(ds, parent,
+                                                   list(migrated))
+            except Exception:  # noqa: BLE001 — store failure: NO marker,
+                # retry next tick with the full store sweep intact
+                traceback.print_exc()
+                return
+        self._mark_done(_retire_key(ds), topo)
+        from filodb_tpu.utils.devicewatch import FLIGHT
+        FLIGHT.record("split.retire", dataset=ds, node=self.node,
+                      partitions_purged=purged_total)
+
+    def _reconcile_orphans(self, ds: str, mapper) -> bool:
+        """After an abort (topology shrank), discard local child shards
+        beyond the shard space: stop/drop in-memory state, delete their
+        cloned persisted rows + checkpoints + markers.  The parents were
+        never touched, so this is the whole cleanup."""
+        total = mapper.total_shards
+        orphans = [sh.shard_num for sh in self.memstore.shards(ds)
+                   if sh.shard_num >= total]
+        if not orphans:
+            return False
+        for s in orphans:
+            self.memstore.drop_shard(ds, s)
+            try:
+                self.colstore.delete_shard(ds, s)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+            try:
+                self.metastore.delete_checkpoints(ds, s)
+            except NotImplementedError:
+                pass
+            self.metastore.delete_kv(_clone_key(ds, s))
+            from filodb_tpu.utils.devicewatch import FLIGHT
+            FLIGHT.record("split.discard_child", dataset=ds, shard=s,
+                          node=self.node)
+        self.metastore.delete_kv(_retire_key(ds))
+        return True
+
+    # ------------------------------------------------------------ plumbing
+
+    def _spread_of(self, dataset: str) -> int:
+        """The dataset's INGEST spread — membership in a half is decided
+        with the same bit-splice the gateway routes with."""
+        fn = getattr(self, "spread_for", None)
+        if fn is not None:
+            try:
+                return int(fn(dataset))
+            except Exception:  # noqa: BLE001
+                pass
+        return 1
+
+    def _persist(self, rec: dict) -> None:
+        try:
+            self.metastore.write_kv(_record_key(rec["dataset"]),
+                                    json.dumps(rec))
+        except NotImplementedError:
+            pass

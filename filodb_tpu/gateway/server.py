@@ -51,6 +51,21 @@ class ShardingPublisher:
         self._lock = threading.Lock()
         self.samples_in = 0
         self.parse_errors = 0
+        # elastic resharding (ISSUE 13 satellite): the series memo and
+        # the replayable group plan BAKE shard assignments in — after a
+        # live split commits, replaying them would keep publishing
+        # migrated series to the retired parent forever.  Every batch
+        # entry validates this against ShardMapper.topology_generation
+        # (one int compare) and rehashes on a bump.
+        self._memo_generation = mapper.topology_generation
+
+    def _check_topology_generation(self) -> None:
+        gen = self.mapper.topology_generation
+        if gen != self._memo_generation:
+            self._memo_generation = gen
+            if hasattr(self, "_series_memo"):
+                self._series_memo.clear()
+            self._group_plan = None
 
     def _shard_of(self, tags: Mapping[str, str]) -> int:
         from filodb_tpu.core.record import partition_hash, shard_key_hash
@@ -135,6 +150,9 @@ class ShardingPublisher:
         from filodb_tpu.gateway.influx import (parse_batch_columns,
                                                parse_lines_fast,
                                                to_prom_samples)
+        # a topology-generation bump (live shard split) invalidates the
+        # shard-carrying memos below before any line resolves
+        self._check_topology_generation()
         if not hasattr(self, "_batch_memo"):
             self._batch_memo = {}
         cols = parse_batch_columns(text, self._batch_memo)

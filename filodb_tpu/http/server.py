@@ -134,6 +134,12 @@ class FiloHttpServer:
     # the rollup engine (ISSUE 11, filodb_tpu/rollup): backs
     # /admin/rollup; None = the route 404s (no rollup on this node)
     rollup: Optional[object] = None
+    # the elastic-resharding controller (ISSUE 13, coordinator/split.py):
+    # backs /admin/split/<ds> (trigger / status / abort); None = 404
+    split: Optional[object] = None
+    # callable returning this node's per-dataset split progress (clone /
+    # retire markers) for the /__health gossip the controller gates on
+    split_progress: Optional[object] = None
     datasets: dict = field(default_factory=dict)
     _httpd: Optional[ThreadingHTTPServer] = None
     _thread: Optional[threading.Thread] = None
@@ -564,6 +570,8 @@ class FiloHttpServer:
         if len(parts) == 2 and parts[0] == "admin" \
                 and parts[1] == "shards":
             return self._shards(params)
+        if len(parts) >= 2 and parts[0] == "admin" and parts[1] == "split":
+            return self._split(parts[2:], params)
         if len(parts) == 3 and parts[0] == "admin" and parts[1] == "traces":
             return self._traces(parts[2])
         if len(parts) == 2 and parts[0] == "debug" \
@@ -607,6 +615,46 @@ class FiloHttpServer:
             return 404, error_response("bad_data",
                                        "no rollup engine on this node")
         return 200, {"status": "success", "data": self.rollup.admin_state()}
+
+    @_timed("split")
+    def _split(self, parts: list, p: dict) -> tuple[int, dict]:
+        """Elastic resharding surface (ISSUE 13, doc/ha.md):
+
+        - ``GET  /admin/split``            — every split record's status
+        - ``GET  /admin/split/<ds>``       — one dataset's split status
+        - ``POST /admin/split/<ds>?action=start[&grace-s=]`` — trigger a
+          live power-of-two split (N -> 2N)
+        - ``POST /admin/split/<ds>?action=abort`` — lossless abort back
+          to the parent topology
+        """
+        if self.split is None:
+            return 404, error_response("bad_data",
+                                       "no split controller on this node")
+        if not parts:
+            return 200, {"status": "success",
+                         "data": self.split.admin_state()}
+        ds = parts[0]
+        action = str(p.get("action", "status"))
+        try:
+            if action == "start":
+                state = self.split.trigger(
+                    ds, grace_s=float(p.get("grace-s", 30.0)))
+            elif action == "abort":
+                state = self.split.abort(ds, reason=str(
+                    p.get("reason", "operator abort")))
+            elif action == "status":
+                state = self.split.status(ds)
+                if state is None:
+                    return 404, error_response(
+                        "bad_data", f"no split record for {ds!r}")
+            else:
+                return 400, error_response("bad_data",
+                                           f"unknown action {action!r}")
+        except ValueError as e:
+            return 409, error_response("conflict", str(e))
+        except KeyError:
+            return 404, error_response("bad_data", f"unknown dataset {ds!r}")
+        return 200, {"status": "success", "data": state}
 
     # ------------------------------------------------------ query forensics
 
@@ -1220,6 +1268,7 @@ class FiloHttpServer:
         (ISSUE 7) — the status poller gossips membership, per-replica
         status, and ingest watermarks from this payload."""
         out = {}
+        topology = {}
         if self.shard_manager is not None:
             for ds in self.shard_manager.datasets():
                 m = self.shard_manager.mapper(ds)
@@ -1229,6 +1278,8 @@ class FiloHttpServer:
                 # cluster that serves 100% of the data.  Per-replica
                 # truth rides in the "replicas" rows, which is what the
                 # gossip consumers read on replicated payloads.
+                # total_shards: in-flight split children gossip their
+                # Recovery groups + watermarks here too (ISSUE 13)
                 out[ds] = [
                     {"shard": s, "status": m.best_status(s).value,
                      "node": m.coord_for_shard(s),
@@ -1237,15 +1288,30 @@ class FiloHttpServer:
                           "progress": r.recovery_progress,
                           "watermark": r.watermark}
                          for r in m.replicas(s)]}
-                    for s in range(m.num_shards)]
+                    for s in range(m.total_shards)]
+                if m.total_shards > m.num_shards:
+                    # catching-up split children must not flip the node
+                    # unhealthy (they are not serving yet); the healthy
+                    # flag judges the SERVING shards only
+                    for row in out[ds][m.num_shards:]:
+                        row["in_flight_child"] = True
+                topology[ds] = m.topology.as_payload()
         else:
             for ds, b in self.datasets.items():
                 out[ds] = [{"shard": sh.shard_num, "status": "Active",
                             "node": "local"}
                            for sh in b.memstore.shards(ds)]
         healthy = all(st["status"] in ("Active", "Recovery", "Assigned")
-                      for sts in out.values() for st in sts) if out else True
+                      for sts in out.values() for st in sts
+                      if not st.get("in_flight_child")) if out else True
         body = {"healthy": healthy, "shards": out}
+        if topology:
+            body["topology"] = topology
+        if self.split_progress is not None:
+            try:
+                body["split_progress"] = self.split_progress()
+            except Exception:  # noqa: BLE001 — controller mid-shutdown
+                pass
         if self.running_shards is not None:
             body["running"] = {ds: self.running_shards(ds)
                                for ds in (out or self.datasets)}

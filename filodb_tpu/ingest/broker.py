@@ -464,13 +464,22 @@ class BrokerIngestionStreamFactory(IngestionStreamFactory):
         self.topic = topic
         self.poll_wait_ms = poll_wait_ms
         self.stop_at_end = stop_at_end
+        # elastic resharding (ISSUE 13): the topic's partition count is
+        # fixed at dataset creation, but the SERVING shard count can
+        # double live — shard s and its split child s + N both consume
+        # partition s (the child filters to its half), keeping every
+        # replica's offsets in one comparable domain.  Set by the
+        # standalone wiring; 0 = 1:1 legacy mapping.
+        self.base_partitions = 0
 
     def create(self, dataset: str, shard: int,
                offset: Optional[int] = None) -> BrokerIngestionStream:
         client = BrokerClient(self.host, self.port)
-        return BrokerIngestionStream(client, self.topic or dataset, shard,
-                                     offset or 0, self.poll_wait_ms,
-                                     self.stop_at_end)
+        partition = shard % self.base_partitions if self.base_partitions \
+            else shard
+        return BrokerIngestionStream(client, self.topic or dataset,
+                                     partition, offset or 0,
+                                     self.poll_wait_ms, self.stop_at_end)
 
 
 class BrokerProducer:
@@ -480,11 +489,18 @@ class BrokerProducer:
                  num_shards: Optional[int] = None):
         self._client = client
         self.topic = topic
+        # partition mapping base (ISSUE 13): a post-split publisher
+        # computes shards in the doubled space, but the topic keeps its
+        # creation-time partitions — child s + N folds onto partition s,
+        # which both halves' consumers read with their own filters
+        self.base_partitions = num_shards or 0
         if num_shards is not None:
             client.create_topic(topic, num_shards)
 
     def publish(self, shard: int, container: bytes) -> int:
-        return self._client.produce(self.topic, shard, container)
+        partition = shard % self.base_partitions if self.base_partitions \
+            else shard
+        return self._client.produce(self.topic, partition, container)
 
 
 class BrokerDownsamplePublisher:

@@ -300,6 +300,12 @@ class NodeChaosController:
     def __init__(self):
         self._nodes: dict[str, dict] = {}
         self.events: list[tuple[str, str]] = []  # (action, node), ordered
+        # split-phase chaos (ISSUE 13): SplitControllers registered per
+        # node so scenarios can latch the phase machine at an exact
+        # transition ("kill a child's node mid-catch-up", "partition
+        # the coordinator during cutover") and observe transitions
+        self._split: dict[str, object] = {}
+        self.split_phases: list[tuple[str, str, str]] = []  # (node, ds, phase)
 
     def register(self, name: str, kill_fn=None,
                  proxy: Optional[FlakyTcpProxy] = None,
@@ -393,3 +399,39 @@ class NodeChaosController:
 
     def killed(self, name: str) -> bool:
         return self._nodes[name]["killed"]
+
+    # ---- split-phase hooks (ISSUE 13: elastic-resharding chaos) ----
+
+    def attach_split_controller(self, name: str, controller) -> None:
+        """Track a node's SplitController and record its (dataset,
+        phase) transitions in ``split_phases`` — scenarios assert exact
+        phase interleavings against the fault schedule."""
+        self._split[name] = controller
+        controller.on_transition(
+            lambda ds, phase, _n=name: self.split_phases.append(
+                (_n, ds, phase)))
+
+    def hold_split(self, name: str, transition: str) -> None:
+        """Latch the node's split phase machine right BEFORE
+        ``transition`` ("cutover" | "retire" | "complete") — the
+        deterministic window for killing a child's node mid-catch-up or
+        partitioning the coordinator mid-cutover."""
+        self._split[name].hold(transition)
+        self._note(f"split_hold:{transition}", name)
+
+    def release_split(self, name: str, transition: str) -> None:
+        self._split[name].release(transition)
+        self._note(f"split_release:{transition}", name)
+
+    def wait_split_phase(self, dataset: str, phase: str,
+                         timeout_s: float = 30.0) -> bool:
+        """Block until ANY tracked controller reports the dataset in
+        ``phase`` (poll the recorded transitions; deterministic — the
+        phase either arrives or the scenario fails loudly)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if any(ds == dataset and ph == phase
+                   for _n, ds, ph in self.split_phases):
+                return True
+            time.sleep(0.02)
+        return False

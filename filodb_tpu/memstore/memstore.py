@@ -32,6 +32,12 @@ class TimeSeriesMemStore:
         self.meta = meta_store or InMemoryMetaStore()
         self._datasets: dict[str, dict[int, TimeSeriesShard]] = {}
         self._schemas: dict[str, Schemas] = {}
+        # elastic resharding (ISSUE 13): runs on every new shard BEFORE
+        # any ingest can reach it — the split participant installs the
+        # child half-filter here, so a child shard can never materialize
+        # the parent half even if its consumer starts racing the
+        # controller (standalone.py wires this to SplitController)
+        self.shard_setup_hook = None
 
     # ------------------------------------------------------------------ setup
 
@@ -51,7 +57,19 @@ class TimeSeriesMemStore:
                                     self.store, self.meta)
         shards[shard_num] = shard
         self._schemas[dataset] = schemas
+        if self.shard_setup_hook is not None:
+            self.shard_setup_hook(dataset, shard)
         return shard
+
+    def drop_shard(self, dataset: str, shard_num: int) -> bool:
+        """Remove one shard's in-memory state entirely (split abort
+        discards children; the persisted side is the caller's job).
+        Returns True when a shard was dropped."""
+        shard = self._datasets.get(dataset, {}).pop(shard_num, None)
+        if shard is None:
+            return False
+        shard.close()
+        return True
 
     def has_shard(self, dataset: str, shard_num: int) -> bool:
         return shard_num in self._datasets.get(dataset, ())

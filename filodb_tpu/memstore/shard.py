@@ -40,6 +40,17 @@ from filodb_tpu.store.metastore import InMemoryMetaStore, MetaStore
 from filodb_tpu.utils.bloom import BloomFilter
 from filodb_tpu.workload.quota import SeriesQuotaExceeded
 
+
+class SplitFiltered(Exception):
+    """A record's series belongs to the other half of a shard split
+    (ISSUE 13): the ingest path drops it here, counted — never an
+    error.  Raised only from the NEW-series path, so established series
+    of the retained half pay zero overhead."""
+
+    def __init__(self, n_rows: int = 1):
+        self.n_rows = n_rows
+
+
 _FLUSH_METRICS = None
 
 
@@ -98,6 +109,11 @@ class ShardStats:
     # those rejections dropped
     series_quota_rejected: int = 0
     rows_quota_dropped: int = 0
+    # elastic resharding (ISSUE 13): rows skipped because their series
+    # hashes to the OTHER half of a split — a child replaying its
+    # parent's full partition keeps only its half, and a retired parent
+    # refuses to re-materialize series its child now owns
+    rows_split_filtered: int = 0
 
 
 class TimeSeriesShard:
@@ -193,6 +209,23 @@ class TimeSeriesShard:
         # ingest_stream attach it) so the watermark ledger can surface
         # flush-queue depth/age in /admin/shards
         self.flush_scheduler = None
+        # elastic resharding (ISSUE 13, coordinator/split.py):
+        # - split_ingest_filter: tags -> keep?  Installed on split
+        #   CHILDREN (each keeps its half of the parent's hash space
+        #   while replaying the parent's partition) and on retired
+        #   parents (refuse to re-materialize migrated series).  Checked
+        #   only on the new-series path — established retained series
+        #   never pay it.
+        # - _reshard_memo: pid -> post-split shard, the scan-exclusion
+        #   memo filter_resharded() uses between cutover and retire.
+        self.split_ingest_filter = None
+        self._reshard_memo: dict[int, int] = {}
+        self._reshard_memo_key: Optional[tuple] = None
+        # serializes split clone/backfill against the flush executor so
+        # the (persisted chunks, checkpoints) pair a child inherits is a
+        # consistent at-rest snapshot (chunks persist BEFORE checkpoints
+        # advance; cloning between the two would double or drop rows)
+        self.split_clone_lock = threading.Lock()
 
     def enable_downsampling(self, publisher, resolutions_ms) -> None:
         self.downsample_publisher = publisher
@@ -274,6 +307,12 @@ class TimeSeriesShard:
                 self.series_quota.note_dropped_samples(
                     parse_partkey(dec.partkeys[u]), s1 - s0)
                 continue
+            except SplitFiltered:
+                # the series belongs to the other half of a split: a
+                # child keeps only its half of the replayed parent
+                # partition (ISSUE 13)
+                self.stats.rows_split_filtered += s1 - s0
+                continue
             added, dropped = self._ingest_series_block(
                 part, ts_s[s0:s1], [c[s0:s1] for c in cols_s])
             added_total += added
@@ -352,6 +391,9 @@ class TimeSeriesShard:
                 self.stats.rows_quota_dropped += 1
                 self.series_quota.note_dropped_samples(rec.tags)
                 continue
+            except SplitFiltered:
+                self.stats.rows_split_filtered += 1
+                continue
             if part.ingest(rec.timestamp, rec.values):
                 n += 1
                 self.stats.rows_ingested += 1
@@ -400,6 +442,9 @@ class TimeSeriesShard:
         # start time from the column store lifecycle (reference :1103-1122)
         if tags is None:
             tags = parse_partkey(pk)
+        if self.split_ingest_filter is not None \
+                and not self.split_ingest_filter(tags):
+            raise SplitFiltered()
         if self.series_quota is not None \
                 and not self.series_quota.allow_new_series(
                     tags, shard=self.shard_num):
@@ -497,6 +542,17 @@ class TimeSeriesShard:
         return n
 
     def _run_flush_task(self, task: "FlushTask") -> int:
+        # split_clone_lock scopes the persist->checkpoint pair: a split
+        # clone (coordinator/split.py) holding it sees either none or
+        # all of one flush task, so the child's inherited (chunks,
+        # checkpoints) snapshot keeps the parent's own recovery
+        # invariant (checkpoint only covers persisted rows).  The sqlite
+        # layer serializes writers anyway, so cross-group flush tasks
+        # lose no real concurrency here.
+        with self.split_clone_lock:
+            return self._run_flush_task_locked(task)
+
+    def _run_flush_task_locked(self, task: "FlushTask") -> int:
         collected: list[tuple] = []  # (part, its fresh chunksets)
         try:
             chunksets = []
@@ -641,6 +697,82 @@ class TimeSeriesShard:
             self.stats.partitions_purged += 1
             self.cardinality.note_removed("purge")
         return len(doomed)
+
+    # ------------------------------------------------- elastic resharding
+
+    def _resharded_shard(self, pid: int, total: int, spread: int) -> int:
+        """The shard this part id's series routes to under a
+        ``total``-shard topology, memoized per pid (tags parse + two
+        hashes otherwise repeat on every post-cutover scan)."""
+        key = (total, spread)
+        if self._reshard_memo_key != key:
+            self._reshard_memo = {}
+            self._reshard_memo_key = key
+        got = self._reshard_memo.get(pid)
+        if got is None:
+            from filodb_tpu.parallel.shardmap import shard_of_tags
+            got = self._reshard_memo[pid] = shard_of_tags(  # filolint: disable=bounded-cache — keyed by part id, bounded by this shard's partition registry; dropped whole on (total, spread) change
+                self.index.tags(pid), total, spread)
+        return got
+
+    def filter_resharded(self, lookup: PartLookupResult, total: int,
+                         spread: int) -> PartLookupResult:
+        """Scan-time exclusion for a split PARENT between cutover and
+        retire (ISSUE 13): drop series that now belong to a child shard
+        under the ``total``-shard topology.  The parent keeps a full
+        superset of the data until retire purges it (abort stays
+        lossless), so every post-cutover scan must slice off the
+        migrated half or the child's answers double-count."""
+        from filodb_tpu.parallel.shardmap import shard_of_tags
+        keep = [pid for pid in lookup.part_ids
+                if self._resharded_shard(int(pid), total, spread)
+                == self.shard_num]
+        missing = [pk for pk in lookup.missing_partkeys
+                   if shard_of_tags(parse_partkey(pk), total, spread)
+                   == self.shard_num]
+        if len(keep) == len(lookup.part_ids) \
+                and len(missing) == len(lookup.missing_partkeys):
+            return lookup
+        return PartLookupResult(lookup.shard,
+                                np.asarray(keep, dtype=np.int32), missing,
+                                lookup.first_schema_hash)
+
+    def purge_resharded(self, total: int, spread: int) -> list[bytes]:
+        """RETIRE a split parent's migrated half: drop every in-memory
+        partition (and index entry) whose series now belongs to a child
+        shard.  Returns the purged partkeys so the caller can delete
+        the persisted copies too.  Runs on the control plane AFTER the
+        grace window — the children have been serving this data since
+        cutover."""
+        doomed = []
+        for pid in list(self.partitions):
+            if self._resharded_shard(pid, total, spread) != self.shard_num:
+                doomed.append(pid)
+        from filodb_tpu.parallel.shardmap import shard_of_tags
+        # index-only entries (evicted / recovered, no live partition)
+        # migrate too — their partkeys still feed lookups and ODP
+        for pk, pid in list(self.part_set.items()):
+            if pid not in self.partitions \
+                    and shard_of_tags(parse_partkey(pk), total,
+                                      spread) != self.shard_num:
+                doomed.append(pid)
+        purged: list[bytes] = []
+        for pid in doomed:
+            part = self.partitions.pop(pid, None)
+            pk = part.partkey if part is not None else self.index.partkey(pid)
+            self.bump_removal_epoch()
+            self.part_set.pop(pk, None)
+            self.part_schema_hash.pop(pid, None)
+            self.index.remove([pid])
+            if self.series_quota is not None:
+                tags = part.tags if part is not None else parse_partkey(pk)
+                self.series_quota.note_removed(tags)
+            self.stats.partitions_purged += 1
+            self.cardinality.note_removed("purge")
+            purged.append(pk)
+        if purged:
+            self._lookup_cache.clear()
+        return purged
 
     def mark_stopped_series(self, now_ms: int, stale_ms: int) -> int:
         """Set index end-times for series that stopped ingesting (reference:

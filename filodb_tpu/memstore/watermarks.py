@@ -224,7 +224,20 @@ class WatermarkLedger:
         flush = self._flush_row(sh)
         if flush is not None:
             row["flush"] = flush
-        if watch.mapper is not None:
+        if watch.mapper is not None and \
+                sh.shard_num < watch.mapper.total_shards:
+            topo = watch.mapper.topology
+            if topo.split_phase is not None:
+                # live split (ISSUE 13): label each row's role so the
+                # health tree shows catch-up/cutover progress in place
+                parent = watch.mapper.split_parent_of(sh.shard_num)
+                row["split"] = {
+                    "phase": topo.split_phase,
+                    "role": "child" if parent is not None else "parent",
+                    **({"parent": parent} if parent is not None else
+                       {"child": sh.shard_num + (topo.split_base or 0)}),
+                    "rows_filtered": sh.stats.rows_split_filtered,
+                }
             st = watch.mapper.state(sh.shard_num)
             # the SERVING view, matching what query routing does: a
             # shard with any queryable replica reports that (best)
@@ -284,6 +297,9 @@ class WatermarkLedger:
                                      if r.get("queryable", True)),
                 },
             }
+            if watch.mapper is not None:
+                datasets[ds]["topology"] = \
+                    watch.mapper.topology.as_payload()
         return {"node": self.node, "stall_window_s": self.stall_window_s,
                 "sampled_at_ms": now_ms, "datasets": datasets}
 

@@ -15,6 +15,21 @@ promotion and the failover router's lag ordering).  The legacy
 single-copy surface (``coord_for_shard`` / ``status`` / ``state``)
 reads the shard's PRIMARY (first) replica, so ``replication_factor=1``
 behaves exactly as before.
+
+Elastic resharding (ISSUE 13): because the shard is a hash bit-splice,
+doubling ``num_shards`` sends every series of parent shard ``s`` to
+either ``s`` or ``s + N`` (N = old count) — for EVERY spread setting
+(the new mask bit comes from the shard-key hash when spread <= log2 N,
+and from the modulo fold otherwise; tests/test_split.py sweeps this).
+The mapper therefore carries a :class:`Topology`: the SERVING shard
+count (``num_shards``, the hash-mask base queries and gateways use),
+the TOTAL registered shard states (``total_shards``, which includes
+in-flight split children holding Recovery replica groups), and a
+monotone ``topology_generation`` every serving-path memo keyed on shard
+ids must validate against (gateway series memos, result-cache routing
+tokens — the ``topology-generation`` filolint rule).  All topology
+transitions swap ONE immutable Topology object, so unlocked readers
+always see a consistent (num_shards, generation, split-phase) triple.
 """
 
 from __future__ import annotations
@@ -55,6 +70,83 @@ def _health_m() -> dict:
         from filodb_tpu.utils.observability import shard_health_metrics
         _HEALTH_METRICS = shard_health_metrics()
     return _HEALTH_METRICS
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """One immutable topology view (ISSUE 13).  ``num_shards`` is the
+    SERVING count — the hash-mask base ingestion and query fan-out use;
+    ``total_shards`` additionally counts in-flight split children
+    (Recovery replica groups catching up but not yet routed).  The
+    ``generation`` is monotone across every transition (prepare,
+    cutover, retire-complete, abort) so consumers that memoize per-shard
+    state can validate with one int compare, and gossip adoption is a
+    simple newest-wins."""
+
+    num_shards: int
+    total_shards: int
+    generation: int = 0
+    # split bookkeeping while a split is in flight; phase is one of
+    # "catchup" (children replaying, queries still route the parents),
+    # "serving" (cutover done: 2N-way routing, parents exclude their
+    # migrated half at scan time), "retire" (grace elapsed: parents
+    # purge migrated data).  None = no split in flight.
+    split_phase: Optional[str] = None
+    split_base: Optional[int] = None
+    split_spread: Optional[int] = None
+    # the generation at which THIS split instance was prepared — a
+    # process-wide-unique id for the split (generations are strictly
+    # monotone), so per-node KV markers written during one split can
+    # never satisfy a later split of the same dataset
+    split_epoch: Optional[int] = None
+
+    def query_shards(self, shard_key_hash: int, spread: int) -> list[int]:
+        """All 2^spread shards that can hold one shard key under THIS
+        topology view — the planner computes fan-out from its per-query
+        snapshot, never from the live mapper, so a cutover committing
+        mid-plan cannot mix old fan-out with new exclusions."""
+        n = self.num_shards
+        base = shard_key_hash & ((n - 1) & ~((1 << spread) - 1))
+        return [(base | i) % n for i in range(1 << spread)]
+
+    def parent_exclusion(self, shard: int) -> Optional[tuple[int, int]]:
+        """(total_shards, ingest_spread) when ``shard`` is a split
+        parent whose migrated half must be EXCLUDED from its scans —
+        active from cutover until the split completes (the parent holds
+        a full superset until retire purges it; serving it unfiltered
+        would double-count every migrated series against its child)."""
+        if self.split_phase in ("serving", "retire") \
+                and self.split_base is not None \
+                and shard < self.split_base:
+            return self.total_shards, self.split_spread or 0
+        return None
+
+    def as_payload(self) -> dict:
+        """Wire form for /__health gossip."""
+        out = {"num_shards": self.num_shards,
+               "total_shards": self.total_shards,
+               "generation": self.generation}
+        if self.split_phase is not None:
+            out["split"] = {"phase": self.split_phase,
+                            "base": self.split_base,
+                            "spread": self.split_spread,
+                            "epoch": self.split_epoch}
+        return out
+
+
+def shard_of_tags(tags, total: int, spread: int, options=None) -> int:
+    """The shard a series' tags route to under a ``total``-shard
+    topology — the SAME bit-splice the gateway uses at ingest, so split
+    membership (parent half vs child half) is decided by one pure
+    function everywhere (child ingest filters, parent scan exclusion,
+    retire purge, the generative rehash sweep)."""
+    from filodb_tpu.core.record import partition_hash, shard_key_hash
+    from filodb_tpu.core.schemas import DatasetOptions
+    opts = options or DatasetOptions()
+    shash = shard_key_hash(tags, opts)
+    phash = partition_hash(tags, opts)
+    mask = (total - 1) & ~((1 << spread) - 1)
+    return ((shash & mask) | (phash & ((1 << spread) - 1))) % total
 
 
 @dataclasses.dataclass
@@ -133,13 +225,165 @@ class ShardMapper:
         if replication_factor < 1:
             raise ValueError(
                 f"replication_factor {replication_factor} must be >= 1")
-        self.num_shards = num_shards
         self.replication_factor = replication_factor
         # named mappers (cluster-managed) emit shard-health metrics and
         # flight events on status changes; anonymous ones (benches,
         # ad-hoc tests) stay silent
         self.dataset = dataset
         self._states = [ShardState() for _ in range(num_shards)]
+        # ONE atomically-swapped object carries (serving count, total
+        # count, generation, split phase) — see Topology above.  All
+        # split transitions happen under the ShardManager lock; readers
+        # are unlocked and rely on the swap being atomic.
+        self._topology = Topology(num_shards, num_shards)
+
+    # -- topology (ISSUE 13) ------------------------------------------------
+
+    @property
+    def num_shards(self) -> int:
+        """SERVING shard count — the hash-mask base for ingestion
+        routing and query fan-out.  During a split this stays at the
+        parent count until cutover commits."""
+        return self._topology.num_shards
+
+    @property
+    def total_shards(self) -> int:
+        """Registered shard states including in-flight split children —
+        the range every replica/status/watermark surface (gossip,
+        /__health, ledger) must sweep, or catching-up children would be
+        invisible to the promotion gate."""
+        return len(self._states)
+
+    @property
+    def topology(self) -> Topology:
+        return self._topology
+
+    @property
+    def topology_generation(self) -> int:
+        return self._topology.generation
+
+    def begin_split(self, spread: int = 0) -> Topology:
+        """PREPARE: double the registered shard space.  Child shard
+        ``s + N`` is created UNASSIGNED for every parent ``s``; serving
+        routing (``num_shards``) is untouched, so queries and gateways
+        keep running on the parent topology while children catch up.
+        Bumps the generation (shard-keyed memos revalidate)."""
+        t = self._topology
+        if t.split_phase is not None:
+            raise ValueError(f"dataset {self.dataset!r} already has a "
+                             f"split in flight (phase {t.split_phase})")
+        base = t.num_shards
+        self._states = self._states + [ShardState() for _ in range(base)]
+        self._topology = Topology(base, 2 * base, t.generation + 1,
+                                  split_phase="catchup", split_base=base,
+                                  split_spread=spread,
+                                  split_epoch=t.generation + 1)
+        return self._topology
+
+    def register_split_child(self, shard: int, nodes: Sequence[str]) -> None:
+        """Register a child shard's replica group in RECOVERY — the
+        state the PR 12 promotion gate expects a replaying copy in."""
+        st = self._states[shard]
+        prev = st.status
+        st.replicas = [ReplicaState(n, ShardStatus.RECOVERY) for n in nodes]
+        for n in nodes:
+            self._note_replica(shard, n, ShardStatus.UNASSIGNED,
+                               ShardStatus.RECOVERY, 0)
+        if prev is not st.status:
+            self._note_status(shard, prev, st.status, st.recovery_progress)
+
+    def commit_split(self) -> Topology:
+        """CUTOVER: atomically flip serving to the doubled topology.
+        From this generation on, query fan-out covers the children and
+        parents exclude their migrated half at scan time
+        (``Topology.parent_exclusion``); gateways rehash their memos on
+        the generation bump.  The parents still hold a full superset of
+        the data (retire purges it later), so abort remains lossless."""
+        t = self._topology
+        if t.split_phase != "catchup":
+            raise ValueError(f"cannot commit split from phase "
+                             f"{t.split_phase!r}")
+        self._topology = Topology(t.total_shards, t.total_shards,
+                                  t.generation + 1, split_phase="serving",
+                                  split_base=t.split_base,
+                                  split_spread=t.split_spread,
+                                  split_epoch=t.split_epoch)
+        return self._topology
+
+    def retire_split(self) -> Topology:
+        """RETIRE: the grace window elapsed — participants purge the
+        parents' migrated halves and install the parents' retain-half
+        ingest filters."""
+        t = self._topology
+        if t.split_phase != "serving":
+            raise ValueError(f"cannot retire split from phase "
+                             f"{t.split_phase!r}")
+        self._topology = dataclasses.replace(t, generation=t.generation + 1,
+                                             split_phase="retire")
+        return self._topology
+
+    def finish_split(self) -> Topology:
+        """COMPLETE: every parent purged its migrated half — drop the
+        split bookkeeping (and with it the scan exclusions)."""
+        t = self._topology
+        self._topology = Topology(t.num_shards, len(self._states),
+                                  t.generation + 1)
+        return self._topology
+
+    def abort_split(self) -> Topology:
+        """ABORT, from any in-flight phase: children are dropped,
+        serving flips back to the parent topology, and the parents —
+        which held a full superset throughout — simply keep serving.
+        Lossless by construction."""
+        t = self._topology
+        if t.split_phase is None:
+            return t
+        base = t.split_base or t.num_shards
+        for s in range(base, len(self._states)):
+            for r in self._states[s].replicas:
+                self._note_replica(s, r.node, r.status,
+                                   ShardStatus.UNASSIGNED, 0)
+        self._states = self._states[:base]
+        self._topology = Topology(base, base, t.generation + 1)
+        return self._topology
+
+    def adopt_topology(self, payload: dict) -> bool:
+        """Gossip adoption (newest generation wins, strictly monotone):
+        reconcile the local shard space + topology with a peer's
+        ``Topology.as_payload()``.  Returns True when anything changed.
+        Works from any peer, not just the leader — split phases are
+        driven by the coordinator that owns the split record, and every
+        transition only ever bumps the generation."""
+        gen = int(payload.get("generation", 0))
+        t = self._topology
+        if gen <= t.generation:
+            return False
+        total = int(payload.get("total_shards", t.total_shards))
+        num = int(payload.get("num_shards", t.num_shards))
+        if total > len(self._states):
+            self._states = self._states + [
+                ShardState() for _ in range(total - len(self._states))]
+        elif total < len(self._states):
+            for s in range(total, len(self._states)):
+                for r in self._states[s].replicas:
+                    self._note_replica(s, r.node, r.status,
+                                       ShardStatus.UNASSIGNED, 0)
+            self._states = self._states[:total]
+        sp = payload.get("split") or {}
+        self._topology = Topology(num, total, gen,
+                                  split_phase=sp.get("phase"),
+                                  split_base=sp.get("base"),
+                                  split_spread=sp.get("spread"),
+                                  split_epoch=sp.get("epoch"))
+        return True
+
+    def split_parent_of(self, shard: int) -> Optional[int]:
+        """The parent of an in-flight split child, else None."""
+        t = self._topology
+        if t.split_phase is not None and t.split_base is not None \
+                and shard >= t.split_base:
+            return shard - t.split_base
+        return None
 
     # -- hashing ------------------------------------------------------------
 
@@ -221,6 +465,8 @@ class ShardMapper:
         """Update ONE replica's status: the replica owned by ``node``
         when given (ignored if that node holds no copy), else the
         primary replica (the only one at rf=1)."""
+        if not 0 <= shard < len(self._states):
+            return  # a discarded split child's dying consumer reporting
         st = self._states[shard]
         rep = st.replica(node) if node is not None \
             else (st.replicas[0] if st.replicas else None)
@@ -315,6 +561,8 @@ class ShardMapper:
     def note_watermark(self, shard: int, node: str, offset: int) -> None:
         """Record a replica's gossiped ingested offset (silent: the
         watermark ledger owns the metric surface for offsets)."""
+        if not 0 <= shard < len(self._states):
+            return  # split child gossip racing local topology adoption
         rep = self._states[shard].replica(node)
         if rep is not None:
             rep.watermark = max(rep.watermark, int(offset))
@@ -322,19 +570,39 @@ class ShardMapper:
     def group_head(self, shard: int) -> int:
         """The replica group's ingest head: the max gossiped watermark
         across the group (-1 when nothing is known).  A recovering
-        replica is promoted only once its own offset reaches this."""
-        wms = [r.watermark for r in self._states[shard].replicas]
-        return max(wms) if wms else -1
+        replica is promoted only once its own offset reaches this.
+
+        Split children (ISSUE 13) replay their PARENT's partition, so
+        their offsets live in the parent's domain — the head folds the
+        parent group in, which is exactly the PR 12 promotion gate:
+        a child is promoted only once it has replayed past everything
+        any parent replica has ingested."""
+        if not 0 <= shard < len(self._states):
+            return -1  # post-abort race: discarded child
+        st = self._states[shard]
+        wms = [r.watermark for r in st.replicas]
+        head = max(wms) if wms else -1
+        parent = self.split_parent_of(shard)
+        if parent is not None:
+            pwms = [r.watermark for r in self._states[parent].replicas]
+            if pwms:
+                head = max(head, max(pwms))
+        return head
 
     def routing_token(self) -> int:
         """Cheap hash of the replica-routing state: membership and
-        per-replica status across every shard.  Any failover-relevant
+        per-replica status across every shard, FOLDED with the topology
+        generation (ISSUE 13 satellite) — a completed split doubles the
+        shard layout without necessarily changing any replica row the
+        old token hashed, and a result-cache entry sliced on the retired
+        layout must not survive the cutover.  Any failover-relevant
         transition (node death, demotion, promotion, reassignment)
-        changes it, so consumers that memoize answers computed under
+        changes it too, so consumers that memoize answers computed under
         one routing view (query/resultcache.py) can key validity on it
         without subscribing to shard events.  Watermarks are excluded
         on purpose — they advance with every ingested row."""
-        acc = []
+        t = self._topology
+        acc = [(t.generation, t.num_shards, t.split_phase)]
         for shard, st in enumerate(self._states):
             for r in st.replicas:      # copy-swap lists: safe to iterate
                 acc.append((shard, r.node, r.status.value))
@@ -421,9 +689,14 @@ class ShardMapper:
     def coord_for_shard(self, shard: int) -> Optional[str]:
         return self._states[shard].node
 
+    _EMPTY_STATE = ShardState()
+
     def replicas(self, shard: int) -> list[ReplicaState]:
-        """The shard's replica group (live view; do not mutate)."""
-        return self._states[shard].replicas
+        """The shard's replica group (live view; do not mutate).
+        Out-of-range reads (a query planned pre-abort racing the
+        shard-space truncation) see an empty group, never an error."""
+        states = self._states
+        return states[shard].replicas if 0 <= shard < len(states) else []
 
     def replica_nodes(self, shard: int) -> list[str]:
         return [r.node for r in self._states[shard].replicas]
@@ -442,13 +715,20 @@ class ShardMapper:
 
     def state(self, shard: int) -> ShardState:
         """The full per-shard state row (status + owner + recovery
-        progress + replicas) for health/watermark views."""
-        return self._states[shard]
+        progress + replicas) for health/watermark views.  Out-of-range
+        (post-abort race) returns an empty Unassigned row."""
+        states = self._states
+        return states[shard] if 0 <= shard < len(states) \
+            else self._EMPTY_STATE
 
     def active_shards(self, shards: Optional[Sequence[int]] = None) -> list[int]:
-        """Shards with at least one queryable replica."""
+        """Shards with at least one queryable replica.  A caller's
+        range may briefly exceed the shard space when a split abort
+        truncates it mid-query — those ids are simply not active."""
+        states = self._states
         rng = range(self.num_shards) if shards is None else shards
-        return [s for s in rng if self._states[s].best_status.queryable]
+        return [s for s in rng
+                if 0 <= s < len(states) and states[s].best_status.queryable]
 
     def all_nodes(self) -> set:
         return {r.node for st in self._states for r in st.replicas}

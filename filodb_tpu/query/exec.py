@@ -361,7 +361,8 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
                  filters: Sequence[ColumnFilter], start_ms: int, end_ms: int,
                  column: Optional[str] = None,
                  query_context: Optional[QueryContext] = None,
-                 dispatcher: PlanDispatcher = IN_PROCESS):
+                 dispatcher: PlanDispatcher = IN_PROCESS,
+                 reshard_to: Optional[tuple] = None):
         super().__init__(query_context, dispatcher)
         self.dataset = dataset
         self.shard = shard
@@ -369,6 +370,13 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
         self.start_ms = start_ms
         self.end_ms = end_ms
         self.column = column
+        # elastic resharding (ISSUE 13): (total_shards, ingest_spread)
+        # stamped by the planner when this shard is a split PARENT whose
+        # migrated half must be excluded from the scan (the child serves
+        # it).  Plan-time stamping keeps one query on one topology view
+        # even when the cutover commits mid-flight; travels the wire
+        # with the leaf (query/wire.py).
+        self.reshard_to = tuple(reshard_to) if reshard_to else None
 
     def do_execute(self, ctx: ExecContext) -> list:
         # the leaf owns the "scan" stage bucket; lower layers without a
@@ -381,6 +389,8 @@ class MultiSchemaPartitionsExec(LeafExecPlan):
             shard = ctx.memstore.get_shard(self.dataset, self.shard)
             lookup = shard.lookup_partitions(self.filters, self.start_ms,
                                              self.end_ms)
+            if self.reshard_to is not None:
+                lookup = shard.filter_resharded(lookup, *self.reshard_to)
             try:
                 batches = self._do_scan(ctx, shard, lookup)
                 self._note_batch_counts(ctx, batches)
@@ -703,17 +713,27 @@ class PartKeysExec(LeafExecPlan):
 
     def __init__(self, dataset: str, shard: int,
                  filters: Sequence[ColumnFilter], start_ms: int, end_ms: int,
-                 query_context=None, dispatcher: PlanDispatcher = IN_PROCESS):
+                 query_context=None, dispatcher: PlanDispatcher = IN_PROCESS,
+                 reshard_to: Optional[tuple] = None):
         super().__init__(query_context, dispatcher)
         self.dataset = dataset
         self.shard = shard
         self.filters = list(filters)
         self.start_ms = start_ms
         self.end_ms = end_ms
+        # split-parent exclusion, as on MultiSchemaPartitionsExec — a
+        # migrated series must be listed by its child only
+        self.reshard_to = tuple(reshard_to) if reshard_to else None
 
     def do_execute(self, ctx):
         shard = ctx.memstore.get_shard(self.dataset, self.shard)
-        return [shard.part_keys(self.filters, self.start_ms, self.end_ms)]
+        keys = shard.part_keys(self.filters, self.start_ms, self.end_ms)
+        if self.reshard_to is not None:
+            from filodb_tpu.parallel.shardmap import shard_of_tags
+            total, spread = self.reshard_to
+            keys = [t for t in keys
+                    if shard_of_tags(t, total, spread) == self.shard]
+        return [keys]
 
 
 class SelectChunkInfosExec(LeafExecPlan):

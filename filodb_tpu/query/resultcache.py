@@ -484,6 +484,12 @@ class ResultCachingPlanner(QueryPlanner):
     # ------------------------------------------------------------- helpers
 
     def _routing_token(self) -> int:
+        """Replica-routing validity key (ShardMapper.routing_token).
+        Folds the topology GENERATION (ISSUE 13), so a live shard
+        split's cutover invalidates every entry sliced on the retired
+        shard layout — without it, a warm dashboard would keep serving
+        hits computed against the pre-split fan-out.  This is the
+        topology-generation lint's sanctioned validation path."""
         if self.routing_token_fn is None:
             return 0
         return int(self.routing_token_fn())

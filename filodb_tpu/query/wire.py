@@ -197,6 +197,11 @@ def serialize_plan(plan) -> dict:
         "transformers": [_enc_transformer(t) for t in plan.transformers],
         "qctx": _enc_qctx(plan.query_context),
     }
+    # split-parent scan exclusion (ISSUE 13) travels with the leaf so
+    # the remote owner slices the migrated half exactly as the planner
+    # that stamped it would have locally
+    if getattr(plan, "reshard_to", None):
+        base["reshard_to"] = list(plan.reshard_to)
     if isinstance(plan, MultiSchemaPartitionsExec):
         return {**base, "type": "MultiSchemaPartitionsExec",
                 "column": plan.column}
@@ -214,13 +219,15 @@ def deserialize_plan(d: dict):
         query_id=d.get("query_id", ""),
         sample_limit=d.get("sample_limit", 1_000_000))
     filters = [_dec_filter(f) for f in d["filters"]]
+    reshard = tuple(d["reshard_to"]) if d.get("reshard_to") else None
     if kind == "MultiSchemaPartitionsExec":
         plan = MultiSchemaPartitionsExec(
             d["dataset"], d["shard"], filters, d["start_ms"], d["end_ms"],
-            d.get("column"), qctx)
+            d.get("column"), qctx, reshard_to=reshard)
     elif kind == "PartKeysExec":
         plan = PartKeysExec(d["dataset"], d["shard"], filters,
-                            d["start_ms"], d["end_ms"], qctx)
+                            d["start_ms"], d["end_ms"], qctx,
+                            reshard_to=reshard)
     elif kind == "SelectChunkInfosExec":
         plan = SelectChunkInfosExec(d["dataset"], d["shard"], filters,
                                     d["start_ms"], d["end_ms"], qctx)

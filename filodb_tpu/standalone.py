@@ -172,6 +172,26 @@ class FiloServer:
         # dual-write fanouts, retained so shutdown can stop their peer
         # delivery lanes (a dead node must not keep POSTing to peers)
         self._replica_fanouts: list = []
+        # elastic resharding (ISSUE 13, coordinator/split.py): live
+        # power-of-two shard splits.  Per-dataset transport/spread/tier
+        # maps feed the controller; the memstore setup hook installs the
+        # split half-filters on shards the instant they are created.
+        self._transports: dict[str, str] = {}
+        self._spreads: dict[str, int] = {}
+        self._tiers: dict[str, list] = {}
+        from filodb_tpu.coordinator.split import SplitController
+        self.split_controller = SplitController(
+            self.node, self.manager, self.memstore, self.colstore,
+            self.metastore,
+            peers=self.config.get("peers", {}),
+            resync=self.resync_all,
+            transport_for=lambda ds: self._transports.get(ds, "queue"),
+            tiers_for=lambda ds: list(self._tiers.get(ds, ())),
+            fresh_nodes=self.failure_detector.fresh_nodes,
+            spread_for=lambda ds: self._spreads.get(ds, 1))
+        self.memstore.shard_setup_hook = self._on_shard_setup
+        self.http.split = self.split_controller
+        self.http.split_progress = self.split_controller.split_progress
         # (dataset, shard) -> first legal push offset (above persisted
         # checkpoints), resolved once per shard on first peer push
         self._push_offset_floor: dict = {}
@@ -191,7 +211,9 @@ class FiloServer:
             raise ValueError(
                 f"dataset {dataset!r} does not accept container pushes "
                 f"(broker-sourced or unknown)")
-        num_shards = self.manager.mapper(dataset).num_shards
+        # total_shards: a peer that committed a split before this node
+        # adopted it may already push child-shard containers (ISSUE 13)
+        num_shards = self.manager.mapper(dataset).total_shards
         if not 0 <= shard < num_shards:
             # out-of-range pushes would ACK into a consumerless queue
             # (silent loss + unbounded memory).  A valid shard this
@@ -233,6 +255,30 @@ class FiloServer:
         ic = self.coordinator.ingestion.get(dataset)
         return ic.running_shards() if ic is not None else []
 
+    def _on_shard_setup(self, dataset: str, shard) -> None:
+        """memstore hook: every freshly-created shard picks up its split
+        policy (half filters) before any ingest, and raw-dataset shards
+        born from a split attach to the live rollup engine so their
+        flushes tier exactly like their parents'."""
+        self.split_controller.on_shard_setup(dataset, shard)
+        eng = self.rollup_engine
+        if eng is not None and dataset in eng.datasets() \
+                and shard.rollup_listener is None:
+            try:
+                eng.attach_shard(dataset, shard)
+            except Exception:  # noqa: BLE001 — engine mid-shutdown
+                pass
+
+    def resync_all(self) -> None:
+        """Reconcile every dataset's running shards with the mapper,
+        holding back split children whose local clone has not landed
+        (they would replay from nothing)."""
+        for ds in self.manager.datasets():
+            shards = self.manager.mapper(ds).runnable_shards_for_node(
+                self.node)
+            shards = self.split_controller.startable_shards(ds, shards)
+            self.coordinator.resync(ds, shards)
+
     def start(self) -> int:
         """Bring the node up; returns the HTTP port."""
         broker_conf = self.config.get("broker")
@@ -243,6 +289,11 @@ class FiloServer:
                 data_dir=broker_conf.get("data-dir"))
             self.broker.start()
         self.metastore.initialize()
+        # in-flight split records load BEFORE datasets: each dataset's
+        # mapper replays its persisted split topology at setup, so a
+        # restarted coordinator resumes (or can abort) instead of
+        # wedging mid-split (ISSUE 13)
+        self.split_controller.load_persisted()
         self.failure_detector.heartbeat(self.node)
         up = REGISTRY.gauge("filodb_node_up")
         up.set(1.0, node=self.node)
@@ -317,15 +368,17 @@ class FiloServer:
             self.rollup_engine.start()
 
         port = self.http.start()
+        self.split_controller.start()
         peers = self.config.get("peers", {})
         if peers:
             # cross-node status gossip + automatic failover (reference:
             # StatusActor/ShardMapper snapshots + Akka failure detector)
             def resync_all():
-                for ds in self.manager.datasets():
-                    shards = self.manager.mapper(
-                        ds).runnable_shards_for_node(self.node)
-                    self.coordinator.resync(ds, shards)
+                # split participant duties first: an adopted topology
+                # may need child clones before the resync can start
+                # their consumers (ISSUE 13)
+                self.split_controller.reconcile()
+                self.resync_all()
 
             def local_watermarks(ds: str) -> dict:
                 return {sh.shard_num: sh.latest_offset
@@ -416,6 +469,10 @@ class FiloServer:
                 source_conf.setdefault("port", self.broker.port)
             ds_factory = BrokerIngestionStreamFactory(
                 topic=source_conf.pop("topic", name), **source_conf)
+            # shard -> partition folds modulo the topic's creation-time
+            # partition count: a live split doubles SERVING shards while
+            # child s+N keeps consuming partition s (ISSUE 13)
+            ds_factory.base_partitions = num_shards
             client = BrokerClient(ds_factory.host, ds_factory.port)
             broker_producer = BrokerProducer(client, ds_factory.topic or name,
                                              num_shards)
@@ -431,6 +488,11 @@ class FiloServer:
                                    replication_factor=rf)
         mapper = self.manager.mapper(name)
         source_is_broker = factory_name in ("broker", "kafka")
+        self._transports[name] = "broker" if source_is_broker else "queue"
+        self._spreads[name] = spread
+        # a persisted in-flight split re-applies its topology NOW, so
+        # the resync below already sees children + split policy
+        self.split_controller.restore_dataset(name)
         ic = self.coordinator.setup_dataset(
             name, DEFAULT_SCHEMAS, ds_factory, store_cfg,
             event_sink=self.manager.publish_event,
@@ -445,7 +507,8 @@ class FiloServer:
             # head instead (best-effort transport, doc/ha.md).
             group_head_fn=(lambda shard, _m=mapper: _m.group_head(shard))
             if rf > 1 and source_is_broker else None)
-        shards = mapper.runnable_shards_for_node(self.node)
+        shards = self.split_controller.startable_shards(
+            name, mapper.runnable_shards_for_node(self.node))
         ic.resync(shards)
         # workload management (ISSUE 5): admission + quota + dispatch
         # tuning from the per-dataset "workload" block
@@ -537,9 +600,11 @@ class FiloServer:
         # consumes from a broker, the in-proc queue head otherwise
         if self.watermarks is not None:
             if broker_producer is not None:
-                end_fn = (lambda shard, _c=client,
+                # split children consume their parent's partition, so
+                # their broker head is the parent partition's (ISSUE 13)
+                end_fn = (lambda shard, _c=client, _n=num_shards,
                           _t=ds_factory.topic or name:
-                          _c.end_offset(_t, shard))
+                          _c.end_offset(_t, shard % _n))
             elif ds_factory is self.stream_factory:
                 end_fn = (lambda shard, _n=name:
                           self.stream_factory.stream_for(
@@ -658,6 +723,10 @@ class FiloServer:
                 f"has no downsample schema — rollup cannot tier it")
         cfg = RollupConfig.from_config(ro_conf)
         from filodb_tpu.downsample.dsstore import ds_dataset_name
+        # tier datasets split in LOCKSTEP with their source (ISSUE 13):
+        # the SplitController doubles them in the same phase machine
+        self._tiers[name] = [ds_dataset_name(name, r)
+                             for r in cfg.resolutions_ms]
         tier_planners: dict[int, object] = {}
         publish_for: dict[int, object] = {}
         tier_schema = schema.data.downsample_schema \
@@ -760,6 +829,7 @@ class FiloServer:
         return n
 
     def shutdown(self) -> None:
+        self.split_controller.stop()
         if self.rule_engine is not None:
             # stops the group loops AND closes the notifier — a dead
             # node must not keep evaluating or POSTing webhooks

@@ -137,6 +137,49 @@ class ColumnStore:
         PerShardCardinalityBuster)."""
         raise NotImplementedError
 
+    def clone_shard(self, dataset: str, src_shard: int, dst_shard: int,
+                    keep_pk) -> int:
+        """Copy ``src_shard``'s persisted chunks + partkeys whose
+        partkey passes ``keep_pk`` into ``dst_shard`` (ISSUE 13 split
+        catch-up backfill: the child inherits the parent's persisted
+        history for its half of the hash space).  IDEMPOTENT — keys are
+        upserts on (dataset, shard, partkey[, chunk_id]), so a crashed
+        clone simply reruns.  Returns chunk rows copied."""
+        recs = [r for r in self.scan_part_keys(dataset, src_shard)
+                if keep_pk(r.partkey)]
+        if recs:
+            self.write_part_keys(dataset, dst_shard, [
+                PartKeyRecord(r.partkey, r.start_time, r.end_time,
+                              dst_shard, r.schema_hash) for r in recs])
+        n = 0
+        batch: dict[int, list] = {}
+        for itime, cs in self.chunksets_with_ingestion_time(
+                dataset, src_shard, 0, (1 << 62)):
+            if not keep_pk(cs.partkey):
+                continue
+            batch.setdefault(itime, []).append(cs)
+            n += 1
+        for itime, css in batch.items():
+            self.write_chunks(dataset, dst_shard, css, itime)
+        return n
+
+    def delete_shard(self, dataset: str, shard: int) -> int:
+        """Drop EVERY persisted row of one shard (split abort discards
+        the children's cloned/backfilled data wholesale)."""
+        pks = [r.partkey for r in self.scan_part_keys(dataset, shard)]
+        seen = set(pks)
+        # chunks can exist for partkeys never flushed into the partkeys
+        # table (evicted before their first dirty-key flush) — sweep the
+        # chunk side too so an aborted child leaves nothing behind
+        for _itime, cs in self.chunksets_with_ingestion_time(
+                dataset, shard, 0, (1 << 62)):
+            if cs.partkey not in seen:
+                seen.add(cs.partkey)
+                pks.append(cs.partkey)
+        if pks:
+            self.delete_part_keys(dataset, shard, pks)
+        return len(pks)
+
     def shutdown(self) -> None:
         pass
 

@@ -613,6 +613,9 @@ class DiskMetaStore(_SqliteBase, MetaStore):
         CREATE TABLE IF NOT EXISTS datasets (
             name TEXT PRIMARY KEY, config TEXT NOT NULL
         );
+        CREATE TABLE IF NOT EXISTS kv (
+            key TEXT PRIMARY KEY, value TEXT NOT NULL
+        );
         """)
         conn.commit()
 
@@ -626,6 +629,35 @@ class DiskMetaStore(_SqliteBase, MetaStore):
         return dict(self._conn().execute(
             "SELECT grp, offset FROM checkpoints WHERE dataset=? AND shard=?",
             (dataset, shard)))
+
+    def delete_checkpoints(self, dataset, shard) -> None:
+        conn = self._conn()
+        conn.execute("DELETE FROM checkpoints WHERE dataset=? AND shard=?",
+                     (dataset, shard))
+        conn.commit()
+
+    # durable KV (ISSUE 13: split phase records + clone/retire markers)
+
+    def write_kv(self, key: str, value: str) -> None:
+        conn = self._conn()
+        conn.execute("INSERT OR REPLACE INTO kv VALUES (?,?)", (key, value))
+        conn.commit()
+
+    def read_kv(self, key: str) -> str | None:
+        row = self._conn().execute(
+            "SELECT value FROM kv WHERE key=?", (key,)).fetchone()
+        return row[0] if row else None
+
+    def delete_kv(self, key: str) -> None:
+        conn = self._conn()
+        conn.execute("DELETE FROM kv WHERE key=?", (key,))
+        conn.commit()
+
+    def list_kv(self, prefix: str) -> dict[str, str]:
+        return dict(self._conn().execute(
+            "SELECT key, value FROM kv WHERE key LIKE ? ESCAPE '\\'",
+            (prefix.replace("\\", "\\\\").replace("%", "\\%")
+             .replace("_", "\\_") + "%",)))
 
     def write_dataset(self, name: str, config: str) -> None:
         conn = self._conn()

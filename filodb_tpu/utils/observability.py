@@ -382,6 +382,34 @@ def watermark_metrics() -> dict:
     }
 
 
+def split_metrics() -> dict:
+    """Elastic-resharding metrics (ISSUE 13, coordinator/split.py):
+    phase progression, child replay volume, cutover latency, aborts."""
+    return {
+        "phase": REGISTRY.gauge(
+            "filodb_split_phase",
+            "live shard-split phase as a code: 0=none 1=prepare "
+            "2=catchup 3=serving(cutover done) 4=retire 5=complete "
+            "6=aborted"),
+        "replayed_rows": REGISTRY.gauge(
+            "filodb_split_replayed_rows",
+            "rows the split children have ingested so far (catch-up "
+            "replay + dual-ingested live rows, summed across local "
+            "children)"),
+        "cutover_seconds": REGISTRY.gauge(
+            "filodb_split_cutover_seconds",
+            "wall seconds the last cutover took from gate-pass to the "
+            "committed topology flip"),
+        "aborts": REGISTRY.counter(
+            "filodb_split_aborts_total",
+            "split aborts (lossless rollbacks to the parent topology)"),
+        "generation": REGISTRY.gauge(
+            "filodb_split_generation",
+            "the dataset's current topology generation (bumps on "
+            "prepare / cutover / retire-complete / abort)"),
+    }
+
+
 def shard_health_metrics() -> dict:
     """Canonical shard-status metrics (ISSUE 6): numeric status code,
     recovery progress, and transition counts, emitted by

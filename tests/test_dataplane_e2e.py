@@ -265,11 +265,22 @@ class TestSelfTelemetry:
             assert max(rates) <= avg_increase * 10
             assert max(rates) >= avg_increase / 10
             # the watermark sampler is live too: /admin/shards covers
-            # both datasets, including the synthesized one
-            code, body = _get(port, "/admin/shards")
-            assert code == 200
-            assert set(body["data"]["datasets"]) >= {"prom", "_system"}
-            sys_rows = body["data"]["datasets"]["_system"]["shards"]
-            assert sys_rows[0]["lag"]["rows"] == 0
+            # both datasets, including the synthesized one.  The lag
+            # check POLLS briefly: the dataset is being scraped every
+            # 200ms, so a single snapshot can legitimately catch one
+            # pushed-but-not-yet-consumed row in flight
+            deadline = time.time() + 5
+            lag = None
+            while time.time() < deadline:
+                code, body = _get(port, "/admin/shards")
+                assert code == 200
+                assert set(body["data"]["datasets"]) >= {"prom",
+                                                         "_system"}
+                sys_rows = body["data"]["datasets"]["_system"]["shards"]
+                lag = sys_rows[0]["lag"]["rows"]
+                if lag == 0:
+                    break
+                time.sleep(0.1)
+            assert lag == 0
         finally:
             srv.shutdown()
