@@ -10,7 +10,7 @@ device-resident serving path dispatches to when the layout invariant
 holds; end-to-end served throughput is benchmarked separately in
 benches/.
 
-TWO variants are measured and emitted (ISSUE 3):
+FOUR variants are measured and emitted (ISSUE 3; hist + topK ISSUE 14):
 
 - ``dense``: the decoded-plane kernel (4 B/sample value plane, phase
   mode — no ts plane), the historical north-star number.
@@ -23,11 +23,27 @@ TWO variants are measured and emitted (ISSUE 3):
   the workload's integer counters provably pack as one 16-bit class
   (residuals span <= bit 22 with >= 7 trailing zero bits), so group
   lanes stay contiguous.
+- ``histogram_quantile``: BASELINE config 2 — ``histogram_quantile(
+  0.99, sum(rate(latency_bucket[5m])) by (le))`` over packed HISTOGRAM
+  bucket planes (xorgrid stride packs, ops/grid.py
+  hist_quantile_grid_packed): VMEM decode + per-bucket rate + the
+  banded-MXU bucket reduce + the le-interpolation in ONE program, only
+  the [G, T] quantile plane read back.  Device equivalence vs the
+  decoded-plane phase kernel + XLA bucket reduce + the shared
+  hist_quantile math is asserted before timing.
+- ``gdelt_topk``: BASELINE config 5 — the generic columnar
+  scan->filter->topK program (ops/grid.py event_topk_grid_packed) over
+  a two-column packed event table; equivalence vs the decoded-plane
+  free kernel + XLA group reduce + top_k asserted before timing.
+  Samples count BOTH scanned columns.
 
-The run FAILS (nonzero rc + machine-readable error JSON) if either
-equivalence assertion trips or either variant regresses >20% against
-the committed BASELINE.json floors — a bench regression tripwire, not
-just a report.
+The run FAILS (nonzero rc + machine-readable error JSON) if any
+equivalence assertion trips or a measured variant regresses >20%
+against the committed BASELINE.json floors — a bench regression
+tripwire, not just a report.  A COMPILE/RUN failure of one of the two
+NEW (ISSUE 14) variants is reported in its variants{} entry without
+failing the legacy floors (their serving twin is breaker-guarded the
+same way); a wrong ANSWER still fails loudly.
 
 Protocol (see .claude/skills/verify/SKILL.md gotchas): data is generated
 on-device from a scalar seed; the pipeline runs K statically-known
@@ -83,6 +99,16 @@ CPP_SUB = int(os.environ.get("FILODB_BENCH_CPP_SERIES", 100_000))
 GL = 1_024                                              # lanes per group
 T0 = 600_000
 
+# histogram_quantile variant (BASELINE config 2): G_H le-groups x P_H
+# series x HB cumulative buckets = 1,048,576 stored bucket columns
+HB = int(os.environ.get("FILODB_BENCH_HIST_BUCKETS", 16))
+G_H = int(os.environ.get("FILODB_BENCH_HIST_GROUPS", 1_024))
+P_H = int(os.environ.get("FILODB_BENCH_HIST_PER_GROUP", 64))
+# GDELT topK variant (BASELINE config 5): event lanes, actor groups, k
+E_L = int(os.environ.get("FILODB_BENCH_EVENT_LANES", 262_144))
+E_G = int(os.environ.get("FILODB_BENCH_EVENT_GROUPS", 4_096))
+E_K = int(os.environ.get("FILODB_BENCH_EVENT_K", 10))
+
 
 def _probe_backend(timeout_s: int):
     """Initialize the JAX backend under a watchdog.
@@ -137,13 +163,13 @@ def main():
         # BOTH variants still run end-to-end (tiny shapes, interpret
         # mode) so a broken kernel fails here, not only on the TPU
         _cpu_interpret_smoke()
-        log("no TPU backend: interpret-mode variant smoke passed; "
-            "skipping measurement")
+        log("no TPU backend: interpret-mode variant smoke (all four "
+            "variants) passed; skipping measurement")
         print(json.dumps({
             "metric": "PromQL samples scanned/sec (rate()+sum-by)",
             "value": 0.0, "unit": "samples/sec", "vs_baseline": 0.0,
             "error": "no TPU backend (interpret-mode equivalence smoke "
-                     "of both variants passed)",
+                     "of all four variants passed)",
         }))
         sys.stdout.flush()
         sys.exit(3)
@@ -367,6 +393,12 @@ def main():
     log(f"compressed-resident: {pk_rate:.3e} samples/sec "
         f"({ITERS} queries in {pk_elapsed:.3f}s)")
 
+    # ---- histogram_quantile + GDELT-topK variants (ISSUE 14) --------------
+    hist_var = _guarded_variant("histogram_quantile",
+                                lambda: _bench_hist_quantile(timed))
+    topk_var = _guarded_variant("gdelt_topk",
+                                lambda: _bench_event_topk(timed))
+
     # -- CPU baseline (C++ multithreaded JVM proxy) on a subsample ----------
     from filodb_tpu.native import baseline as cpp_baseline
 
@@ -424,10 +456,14 @@ def main():
             floors = json.load(fh).get("floors", {})
     except Exception as e:  # noqa: BLE001 — a missing floor disables the wire
         log(f"no BASELINE.json floors ({e}); regression tripwire off")
+    measured = [("dense", tpu_rate), ("compressed_resident", pk_rate)]
+    for name, var in (("histogram_quantile", hist_var),
+                      ("gdelt_topk", topk_var)):
+        if "samples_per_sec" in var:
+            measured.append((name, var["samples_per_sec"]))
     regressions = [
         f"{name} {rate:.3e} < 80% of committed floor {floors[name]:.3e}"
-        for name, rate in (("dense", tpu_rate),
-                           ("compressed_resident", pk_rate))
+        for name, rate in measured
         if floors.get(name) and rate < 0.8 * float(floors[name])]
     if regressions:
         fail("bench regression: " + "; ".join(regressions), rc=5)
@@ -449,15 +485,275 @@ def main():
                 "bytes_per_sample": round(pk_bps, 2),
                 "equiv_max_rel_err": pk_rel,
             },
+            "histogram_quantile": hist_var,
+            "gdelt_topk": topk_var,
         },
     }))
 
 
+def _guarded_variant(name: str, run):
+    """Run one NEW (ISSUE 14) variant.  A wrong ANSWER inside `run`
+    calls fail() and exits nonzero like every other assertion; a
+    COMPILE/RUN crash (a backend whose Mosaic build rejects the new
+    kernels) is reported in the variant entry instead of sinking the
+    legacy floors — the serving twin of these kernels is breaker-
+    guarded the same way (memstore/devicestore.py _run_packed)."""
+    try:
+        return run()
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001 — see docstring
+        log(f"{name} variant failed to build/run: {e!r}")
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _c16_jax(key, rows: int, cols: int):
+    """On-device integer-counter plane with the 16-bit-class guarantee
+    (gen_packed's construction: pinned f32 exponent, >=7 trailing zero
+    bits — ONE definition shared by every variant so the pack contract
+    the bench measures can never drift between them)."""
+    import jax
+    import jax.numpy as jnp
+
+    ka, kb = jax.random.split(key)
+    start = (2.0 ** 23) + 128.0 * jax.random.randint(
+        ka, (1, cols), 0, 2 ** 15, jnp.int32).astype(jnp.float32)
+    incr = 128.0 * jax.random.randint(
+        kb, (rows, cols), 1, 8, jnp.int32).astype(jnp.float32)
+    return start + jnp.cumsum(incr, axis=0)
+
+
+def _c16_np(rng, rows: int, cols: int):
+    """Numpy twin of :func:`_c16_jax` for the interpret smoke."""
+    start = (2 ** 23 + 128 * rng.integers(0, 2 ** 15, cols)) \
+        .astype(np.float32)
+    inc = 128 * rng.integers(1, 8, (rows, cols))
+    return (start[None, :] + np.cumsum(inc, axis=0)).astype(np.float32)
+
+
+def _hist_phase_series(rng_key, cols: int, hb: int, rows: int):
+    """Hist bucket-plane gen: one :func:`_c16_jax` counter per bucket
+    column, one constant scrape phase per SERIES (shared by its hb
+    columns)."""
+    import jax
+    import jax.numpy as jnp
+
+    k1, k2 = jax.random.split(rng_key)
+    nser = cols // hb
+    phase = jnp.repeat(
+        jax.random.randint(k1, (nser,), 1, STEP_MS - 1, jnp.int32), hb)
+    return _c16_jax(k2, rows, cols), phase
+
+
+def _bench_hist_quantile(timed):
+    """histogram_quantile(0.99, sum(rate(bucket[5m])) by (le-group))
+    over packed hist residents — fused decode + banded bucket reduce +
+    le-interpolation (ops/grid.py hist_quantile_grid_packed)."""
+    import jax
+    import jax.numpy as jnp
+
+    from filodb_tpu.codecs import xorgrid
+    from filodb_tpu.ops import histogram_ops
+    from filodb_tpu.ops.grid import (GridQuery, hist_quantile_grid_packed,
+                                     rate_grid)
+
+    cols = G_H * P_H * HB
+    group_lanes = P_H * HB
+    K = WINDOW_MS // STEP_MS
+    steps_np = np.arange(T0 + WINDOW_MS, T0 + NB * STEP_MS, STEP_MS,
+                         dtype=np.int32)
+    T = len(steps_np)
+    rows_need = T + K - 1
+    q = GridQuery(nsteps=T, kbuckets=K, gstep_ms=STEP_MS, is_rate=True,
+                  dense=True)
+    tops = np.concatenate([2.0 ** np.arange(HB - 1), [np.inf]])
+    log(f"hist variant: packing {cols} bucket columns "
+        f"({G_H} groups x {P_H} series x {HB} buckets)...")
+    vals, phase = jax.jit(lambda s: _hist_phase_series(
+        jax.random.PRNGKey(s + 11), cols, HB, rows_need))(0)
+    vals_np = np.asarray(jax.device_get(vals))
+    packed = xorgrid.pack_vals(vals_np, phase=np.asarray(phase),
+                               min_width=16, stride=HB)
+    if packed is None or not (
+            packed.planes["p16"].shape[1] == cols
+            and bool((packed.inv == np.arange(cols)).all())):
+        fail("hist workload did not pack as one identity-order class "
+             "plane (stride contract violated?)")
+    chk = xorgrid.unpack_vals(packed)[:, :4096]
+    if not (chk.view(np.uint32) == vals_np[:, :4096].view(np.uint32)).all():
+        fail("xorgrid hist CPU decode not bit-identical")
+    planes_dev = {k: jax.device_put(jnp.asarray(v))
+                  for k, v in packed.planes.items()}
+    bps = sum(int(packed.planes[k].nbytes) for k in ("p16", "m16")) \
+        / (cols * (NB - 1))
+
+    # device equivalence: fused hist program vs decoded-plane phase
+    # kernel + XLA bucket reduce + the SAME hist_quantile math.  The
+    # NaN pattern is compared EXPLICITLY — a bare nanmax would let a
+    # liveness bug (wrong group NaN on one side) pass silently
+    def check(planes):
+        fused = hist_quantile_grid_packed(planes, int(steps_np[0]),
+                                          jnp.asarray(tops), q, 0.99, HB,
+                                          group_lanes=group_lanes)
+        stepped = rate_grid(None, vals, int(steps_np[0]), q, lanes=1024,
+                            phase=phase)                 # [T, cols]
+        st = stepped.reshape(T, G_H, P_H, HB)
+        hist_sum = jnp.nansum(st, axis=2).transpose(1, 0, 2)  # [G,T,HB]
+        ref = histogram_ops.hist_quantile(jnp.asarray(tops), hist_sum,
+                                          0.99)
+        ff, fr = jnp.isfinite(fused), jnp.isfinite(ref)
+        mism = jnp.sum(ff != fr)
+        rel = jnp.where(ff & fr,
+                        jnp.abs(fused - ref)
+                        / jnp.maximum(jnp.abs(ref), 1e-6), 0.0)
+        return jnp.max(rel), mism
+    h_rel, h_mism = jax.jit(check)(planes_dev)
+    h_rel, h_mism = float(h_rel), int(h_mism)
+    log(f"hist fused-vs-XLA max rel err: {h_rel:.2e}; "
+        f"NaN-pattern mismatches: {h_mism}")
+    if not (h_rel < 2e-5 and h_mism == 0):
+        fail(f"fused hist quantile diverged from the XLA decode path "
+             f"(rel={h_rel:.2e}, nan_mismatch={h_mism})")
+
+    def build(iters: int):
+        @jax.jit
+        def f(planes):
+            acc = jnp.float32(0.0)
+            for i in range(iters):
+                out = hist_quantile_grid_packed(
+                    planes, int(steps_np[0]) + i, jnp.asarray(tops), q,
+                    0.99, HB, group_lanes=group_lanes)
+                acc = acc + out[0, 0] + out[G_H // 2, T // 2]
+            return acc
+        return f
+    fb, ff = build(1), build(1 + ITERS)
+    log("compiling hist variants...")
+    _ = float(fb(planes_dev))
+    _ = float(ff(planes_dev))
+    log("timing hist...")
+    el = max(timed(lambda _s: ff(planes_dev))
+             - timed(lambda _s: fb(planes_dev)), 1e-9)
+    samples = cols * (NB - 1)
+    rate = samples * ITERS / el
+    log(f"histogram_quantile: {rate:.3e} samples/sec "
+        f"({ITERS} queries in {el:.3f}s)")
+    return {"samples_per_sec": round(rate, 1),
+            "bytes_per_sample": round(bps, 2),
+            "equiv_max_rel_err": h_rel}
+
+
+def _bench_event_topk(timed):
+    """topk(k, sum_over_time(value[w]) by (actor)) with a last-value
+    filter on a second column — the generic columnar scan-filter-topK
+    program (ops/grid.py event_topk_grid_packed)."""
+    import jax
+    import jax.numpy as jnp
+
+    from filodb_tpu.codecs import xorgrid
+    from filodb_tpu.ops.grid import (GridQuery, event_topk_grid_packed,
+                                     rate_grid)
+
+    K = WINDOW_MS // STEP_MS
+    steps_np = np.arange(T0 + WINDOW_MS, T0 + NB * STEP_MS, STEP_MS,
+                         dtype=np.int32)
+    T = len(steps_np)
+    rows_need = T + K - 1
+    qs = GridQuery(nsteps=T, kbuckets=K, gstep_ms=STEP_MS, op="sum",
+                   is_rate=False, dense=True)
+    ql = GridQuery(nsteps=T, kbuckets=K, gstep_ms=STEP_MS, op="last",
+                   is_rate=False, dense=True)
+    log(f"event variant: packing 2 columns x {E_L} lanes "
+        f"({E_G} groups, k={E_K})...")
+
+    def gen(seed):
+        key = jax.random.PRNGKey(seed + 23)
+        k1, k2 = jax.random.split(key)
+        return (_c16_jax(k1, rows_need, E_L),
+                _c16_jax(k2, rows_need, E_L))
+    vals, fvals = jax.jit(gen)(0)
+    vals_np = np.asarray(jax.device_get(vals))
+    fvals_np = np.asarray(jax.device_get(fvals))
+    pk_v = xorgrid.pack_vals(vals_np, min_width=16)
+    pk_f = xorgrid.pack_vals(fvals_np, min_width=16)
+    if pk_v is None or pk_f is None \
+            or not (pk_v.inv == np.arange(E_L)).all() \
+            or not (pk_f.inv == np.arange(E_L)).all():
+        fail("event workload did not pack as identity-order class planes")
+    dev_v = {k: jax.device_put(jnp.asarray(v))
+             for k, v in pk_v.planes.items()}
+    dev_f = {k: jax.device_put(jnp.asarray(v))
+             for k, v in pk_f.planes.items()}
+    # actor groups are contiguous lane runs: the banded group_width
+    # form reduces with a reshape-sum — no [lanes, G] one-hot operand
+    per = E_L // E_G
+    thresh = float(np.median(fvals_np[-1]))
+    bps = (sum(int(pk_v.planes[k].nbytes) for k in ("p16", "m16"))
+           + sum(int(pk_f.planes[k].nbytes) for k in ("p16", "m16"))) \
+        / (2 * E_L * (NB - 1))
+
+    # NaN pattern compared explicitly, like the hist gate above
+    def check(dv, df):
+        f_vals, f_idx = event_topk_grid_packed(
+            dv, int(steps_np[0]), qs, E_K, None, E_G,
+            filt_packed=df, filt_op="gt", filt_thresh=thresh,
+            filt_q=ql, group_width=per)
+        sv = rate_grid(None, vals, int(steps_np[0]), qs, lanes=1024)
+        sf = rate_grid(None, fvals, int(steps_np[0]), ql, lanes=1024)
+        masked = jnp.where(sf > thresh, sv, jnp.nan)
+        fin = jnp.isfinite(masked)
+        gs = jnp.where(fin, masked, 0.0).reshape(T, E_G, per).sum(2)
+        gc = fin.reshape(T, E_G, per).sum(2)
+        ranked = jnp.where(gc > 0, gs, -jnp.inf)
+        r_vals, _r_idx = jax.lax.top_k(ranked, E_K)
+        r_vals = jnp.where(jnp.isfinite(r_vals), r_vals, jnp.nan)
+        ff_, fr_ = jnp.isfinite(f_vals), jnp.isfinite(r_vals)
+        mism = jnp.sum(ff_ != fr_)
+        rel = jnp.where(ff_ & fr_,
+                        jnp.abs(f_vals - r_vals)
+                        / jnp.maximum(jnp.abs(r_vals), 1e-6), 0.0)
+        return jnp.max(rel), mism
+    t_rel, t_mism = jax.jit(check)(dev_v, dev_f)
+    t_rel, t_mism = float(t_rel), int(t_mism)
+    log(f"event topk fused-vs-XLA max rel err: {t_rel:.2e}; "
+        f"NaN-pattern mismatches: {t_mism}")
+    if not (t_rel < 2e-5 and t_mism == 0):
+        fail(f"fused event topK diverged from the XLA decode path "
+             f"(rel={t_rel:.2e}, nan_mismatch={t_mism})")
+
+    def build(iters: int):
+        @jax.jit
+        def f(dv, df):
+            acc = jnp.float32(0.0)
+            for i in range(iters):
+                tv, ti = event_topk_grid_packed(
+                    dv, int(steps_np[0]) + i, qs, E_K, None, E_G,
+                    filt_packed=df, filt_op="gt", filt_thresh=thresh,
+                    filt_q=ql, group_width=per)
+                acc = acc + tv[0, 0] + ti[T // 2, 0].astype(jnp.float32)
+            return acc
+        return f
+    fb, ff = build(1), build(1 + ITERS)
+    log("compiling event variants...")
+    _ = float(fb(dev_v, dev_f))
+    _ = float(ff(dev_v, dev_f))
+    log("timing event topk...")
+    el = max(timed(lambda _s: ff(dev_v, dev_f))
+             - timed(lambda _s: fb(dev_v, dev_f)), 1e-9)
+    samples = 2 * E_L * (NB - 1)          # both scanned columns count
+    rate = samples * ITERS / el
+    log(f"gdelt_topk: {rate:.3e} samples/sec "
+        f"({ITERS} queries in {el:.3f}s)")
+    return {"samples_per_sec": round(rate, 1),
+            "bytes_per_sample": round(bps, 2),
+            "equiv_max_rel_err": t_rel}
+
+
 def _cpu_interpret_smoke():
-    """Tiny end-to-end run of BOTH north-star variants in Pallas
+    """Tiny end-to-end run of EVERY north-star variant in Pallas
     interpret mode (the hardware-absent CI clause): dense phase kernel
     vs the fused compressed-resident kernel on identical data, grouped
-    partials must agree."""
+    partials must agree; the hist-quantile and event-topK programs run
+    against their XLA decode oracles the same way."""
     import jax
     import jax.numpy as jnp
 
@@ -492,6 +788,88 @@ def _cpu_interpret_smoke():
     if not (rel < 1e-5 and cnt == 0):
         fail(f"interpret-mode variant smoke diverged (rel={rel:.2e}, "
              f"cnt={cnt})")
+    _hist_topk_interpret_smoke(rng, T, K, q)
+
+
+def _hist_topk_interpret_smoke(rng, T, K, q):
+    """Interpret-mode twins of the hist-quantile and event-topK
+    variants: fused programs vs their XLA decode oracles on tiny
+    shapes, so a broken new kernel fails in CPU CI, not only on TPU."""
+    import jax.numpy as jnp
+
+    from filodb_tpu.codecs import xorgrid
+    from filodb_tpu.ops import histogram_ops
+    from filodb_tpu.ops.grid import (GridQuery, event_topk_grid_packed,
+                                     hist_quantile_grid_packed,
+                                     rate_grid_ref)
+
+    rows = 64          # >= T+K-1; 64 amortizes the meta tiles past the
+    #                    packer's >=25% threshold (the kernel decodes
+    #                    the whole block and slices the query rows)
+    used = T + K - 1
+    # hist: 4 groups x 8 series x 4 buckets
+    hb, per, gh = 4, 8, 4
+    cols = gh * per * hb
+    hv = _c16_np(rng, rows, cols)
+    phase = np.repeat(rng.integers(1, STEP_MS, cols // hb), hb) \
+        .astype(np.int32)
+    pk = xorgrid.pack_vals(hv, phase=phase, min_width=16, stride=hb)
+    assert pk is not None and (pk.inv == np.arange(cols)).all(), \
+        "hist smoke failed the stride pack contract"
+    planes = {k: jnp.asarray(v) for k, v in pk.planes.items()}
+    tops = np.concatenate([2.0 ** np.arange(hb - 1), [np.inf]])
+    fused = np.asarray(hist_quantile_grid_packed(
+        planes, 0, jnp.asarray(tops), q, 0.9, hb, group_lanes=per * hb,
+        interpret=True))
+    stepped = np.asarray(rate_grid_ref(None, jnp.asarray(hv[:used]), 0,
+                                       q, phase=phase))
+    hs = stepped.reshape(T, gh, per, hb).sum(2).transpose(1, 0, 2)
+    ref = np.asarray(histogram_ops.hist_quantile(
+        jnp.asarray(tops), jnp.asarray(hs), 0.9))
+    h_rel = float(np.nanmax(np.abs(fused - ref)
+                            / np.maximum(np.abs(ref), 1e-6)))
+    log(f"interpret smoke: hist fused-vs-XLA rel={h_rel:.2e}")
+    if not h_rel < 1e-5:
+        fail(f"interpret-mode hist quantile smoke diverged "
+             f"(rel={h_rel:.2e})")
+    # event topK: 256 lanes, 8 contiguous groups (the banded
+    # group_width form the TPU variant measures), filter column, k=3
+    el, eg, k = 256, 8, 3
+    v = _c16_np(rng, rows, el)
+    fv = _c16_np(rng, rows, el)
+    pv, pf = (xorgrid.pack_vals(x, min_width=16) for x in (v, fv))
+    dv = {kk: jnp.asarray(a) for kk, a in pv.planes.items()}
+    df = {kk: jnp.asarray(a) for kk, a in pf.planes.items()}
+    qs = GridQuery(nsteps=T, kbuckets=K, gstep_ms=STEP_MS, op="sum",
+                   is_rate=False, dense=True)
+    ql = GridQuery(nsteps=T, kbuckets=K, gstep_ms=STEP_MS, op="last",
+                   is_rate=False, dense=True)
+    thr = float(np.median(fv[used - 1]))   # ~half the lanes pass
+    tv, _ti = event_topk_grid_packed(
+        dv, 0, qs, k, None, eg, filt_packed=df,
+        filt_op="gt", filt_thresh=thr, filt_q=ql, interpret=True,
+        group_width=el // eg)
+    sv = np.asarray(rate_grid_ref(None, jnp.asarray(v[:used]), 0, qs))
+    sf = np.asarray(rate_grid_ref(None, jnp.asarray(fv[:used]), 0, ql))
+    masked = np.where(sf > thr, sv, np.nan)
+    fin = np.isfinite(masked)
+    gs = np.where(fin, masked, 0.0).reshape(T, eg, el // eg).sum(2)
+    gc = fin.reshape(T, eg, el // eg).sum(2)
+    ranked = np.where(gc > 0, gs, -np.inf)
+    want = -np.sort(-ranked, axis=1)[:, :k]
+    want = np.where(np.isfinite(want), want, np.nan)
+    got = np.asarray(tv)
+    if (np.isfinite(got) != np.isfinite(want)).any():
+        fail("interpret-mode event topK smoke: NaN-rank pattern "
+             "diverged from the XLA oracle")
+    fin2 = np.isfinite(want)
+    t_rel = float(np.max(np.abs(got[fin2] - want[fin2])
+                         / np.maximum(np.abs(want[fin2]), 1e-6),
+                         initial=0.0))
+    log(f"interpret smoke: event topk fused-vs-XLA rel={t_rel:.2e}")
+    if not t_rel < 1e-5:
+        fail(f"interpret-mode event topK smoke diverged "
+             f"(rel={t_rel:.2e})")
 
 
 def _numpy_rate_sum(ts, vals, ids, steps):

@@ -96,7 +96,7 @@ UNPADDED_MAX = 1024
 
 
 def _align_classes(by_cls: list[list], widths: tuple, itemsize: int,
-                   B: int, lane_block: int) -> list[int]:
+                   B: int, lane_block: int, stride: int = 1) -> list[int]:
     """Enforce guarantee 1: each class's lane count is either a
     multiple of ``lane_block`` or small enough (<= UNPADDED_MAX) to run
     as one whole-plane kernel block.  Misaligned classes take one of:
@@ -106,7 +106,15 @@ def _align_classes(by_cls: list[list], widths: tuple, itemsize: int,
     searched exhaustively for the minimum resident bytes — a one-step
     greedy misjudges cascades (promoting into an empty raw plane would
     force an expensive raw pad).  Mutates ``by_cls`` (last slot = raw);
-    returns per-class pad lane counts."""
+    returns per-class pad lane counts.
+
+    ``stride > 1`` (histogram bucket planes) disables promotion: a
+    promotable excess is rarely a whole number of ``stride``-column
+    series AND congruent to the misalignment, and splitting one
+    series' bucket columns across class planes would break the
+    bucket-contiguity guarantee the hist kernels slice by.  Pads are
+    appended zero lanes (never part of a series), so padding stays
+    legal at any stride."""
     import itertools
 
     nbytes_of = [w // 8 for w in widths] + [itemsize]
@@ -123,8 +131,8 @@ def _align_classes(by_cls: list[list], widths: tuple, itemsize: int,
             pick = choices[i]
             if pick == "asis" and counts[i] > UNPADDED_MAX:
                 pick = "pad"     # too wide to run unaligned
-            if pick == "promote" and i == nc - 1:
-                pick = "pad"     # nothing wider than raw
+            if pick == "promote" and (i == nc - 1 or stride > 1):
+                pick = "pad"     # nothing wider than raw / hist contiguity
             if pick == "promote":
                 counts[i + 1] += rem
                 counts[i] -= rem
@@ -148,7 +156,7 @@ def _align_classes(by_cls: list[list], widths: tuple, itemsize: int,
 
 def pack_vals(vals: np.ndarray, lane_block: int = LANE_BLOCK,
               phase: Optional[np.ndarray] = None,
-              min_width: int = 0) -> Optional[PackedVals]:
+              min_width: int = 0, stride: int = 1) -> Optional[PackedVals]:
     """Pack a ``[B, L]`` f32/f64 value plane into XOR-class form.
 
     Returns None when compression doesn't pay (packed footprint must
@@ -159,10 +167,23 @@ def pack_vals(vals: np.ndarray, lane_block: int = LANE_BLOCK,
     given class — a workload whose residuals provably fit one width
     (e.g. the north-star integer counters) then packs as a SINGLE class
     plane, which preserves lane (and therefore group) order for the
-    fused grouped kernel's contiguity contract."""
+    fused grouped kernel's contiguity contract.
+
+    ``stride`` (histogram bucket planes, devicestore's group-slot
+    layout ``hist_slot_garr``: column ``s*stride + j`` = series s,
+    cumulative bucket j) packs at SERIES granularity: all ``stride``
+    columns of a series classify together (widest bucket column wins)
+    and stay CONTIGUOUS, in bucket order, in the packed layout — the
+    guarantee the fused hist kernels (ops/grid.py
+    ``hist_grid_grouped_packed``) rely on to reduce the bucket
+    dimension with banded matmuls.  ``unpack_vals`` stays bit-exact
+    for every stride."""
     B, L = vals.shape
     if B == 0 or L == 0:
         return None
+    if stride > 1 and L % stride != 0:
+        raise ValueError(f"plane width {L} not a multiple of the "
+                         f"bucket stride {stride}")
     itemsize = vals.dtype.itemsize
     word = np.uint32 if itemsize == 4 else np.uint64
     bits = np.ascontiguousarray(vals).view(word)
@@ -174,6 +195,11 @@ def pack_vals(vals: np.ndarray, lane_block: int = LANE_BLOCK,
     res[0] = 0
     ctz, blen = _ctz_blen(res, word)
     widths = (8, 16, 32) if itemsize == 8 else (8, 16)
+    if stride > 1:
+        # series-granular classification: the widest bucket column of a
+        # series classifies all of its columns, so the series' bucket
+        # columns can never straddle a class boundary
+        blen = np.repeat(blen.reshape(-1, stride).max(axis=1), stride)
     cls = np.full(L, len(widths), np.int64)            # widest = raw
     for i, w in enumerate(reversed(widths)):
         cls[blen <= w] = len(widths) - 1 - i
@@ -182,7 +208,8 @@ def pack_vals(vals: np.ndarray, lane_block: int = LANE_BLOCK,
         cls[cls < floor] = floor
     by_cls = [list(np.flatnonzero(cls == i)) for i in range(len(widths))]
     by_cls.append(list(np.flatnonzero(cls == len(widths))))   # raw
-    pads = _align_classes(by_cls, widths, itemsize, B, lane_block)
+    pads = _align_classes(by_cls, widths, itemsize, B, lane_block,
+                          stride=stride)
     # canonical order: ascending original lane within each class, so a
     # single-class pack is the IDENTITY permutation (the group-aligned
     # contract rate_grid_grouped_packed relies on)

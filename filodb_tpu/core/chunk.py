@@ -155,8 +155,11 @@ def decode_partitions_batch(schema: Schema, groups: Sequence[Sequence[ChunkSet]]
             for ci in range(len(schema.data.columns) - 1):
                 vals = [p[1][ci] for p in parts]
                 if vals and isinstance(vals[0], tuple):
-                    cols.append((vals[0][0],
-                                 np.concatenate([v[1] for v in vals])))
+                    # widening-aware (16 -> 20 buckets mid-partition):
+                    # the widest scheme wins, narrower rows edge-pad
+                    from filodb_tpu.core.histogram import \
+                        concat_hist_parts
+                    cols.append(concat_hist_parts(vals))
                 elif vals and isinstance(vals[0], list):
                     cols.append(sum(vals, []))
                 else:

@@ -336,19 +336,23 @@ def _note_hbm(plan: "_GridPlan") -> None:
     """Account the serving program's HBM reads by resident format:
     the filodb_query_hbm_read_bytes_total counter (format label) and
     the active query's QueryStats.hbm_read_bytes buckets — so the
-    format actually serving traffic is observable (ISSUE 3)."""
-    if not (plan.hbm_dense or plan.hbm_comp):
+    format actually serving traffic is observable (ISSUE 3; the
+    compressed-hist bucket-plane format is ISSUE 14)."""
+    if not (plan.hbm_dense or plan.hbm_comp or plan.hbm_comp_hist):
         return
     m = _hbm_metric()
     if plan.hbm_dense:
         m.inc(plan.hbm_dense, format="dense")
     if plan.hbm_comp:
         m.inc(plan.hbm_comp, format="compressed")
+    if plan.hbm_comp_hist:
+        m.inc(plan.hbm_comp_hist, format="compressed-hist")
     from filodb_tpu.query.exec import active_exec_ctx
     ctx = active_exec_ctx()
     if ctx is not None:
         ctx.note_counts(hbm_dense=plan.hbm_dense,
-                        hbm_compressed=plan.hbm_comp)
+                        hbm_compressed=plan.hbm_comp,
+                        hbm_hist=plan.hbm_comp_hist)
 
 
 class _GridPlan(NamedTuple):
@@ -374,9 +378,13 @@ class _GridPlan(NamedTuple):
     packed_use_phase: bool = False
     packed_inv: object = None      # np [ncols] orig lane -> packed pos
     # logical HBM bytes the serving program reads, by resident format
-    # (QueryStats.hbm_read_bytes; approximate: whole covered planes)
+    # (QueryStats.hbm_read_bytes; approximate: whole covered planes).
+    # Histogram caches account their packed planes under the dedicated
+    # "compressed-hist" format (ISSUE 14) so the bucket-plane substrate
+    # is observable separately from scalar compressed residents.
     hbm_dense: int = 0
     hbm_comp: int = 0
+    hbm_comp_hist: int = 0
 
 
 class MeshShardPlan(NamedTuple):
@@ -840,6 +848,7 @@ class DeviceGridCache:
             return None
         _note_hbm(plan)
         lanes_req = plan.lane_idx
+        used_packed = False
         stepped = None
         if plan.packed is not None:
             stepped = _run_packed(
@@ -849,8 +858,10 @@ class DeviceGridCache:
                     use_phase=plan.packed_use_phase,
                     interpret=_PACKED_INTERPRET))
             if stepped is not None:
-                # packed lane order: compose the request map with inv
-                lanes_req = plan.packed_inv[plan.lane_idx]
+                used_packed = True
+                if not self.hist:
+                    # packed lane order: compose request map with inv
+                    lanes_req = plan.packed_inv[plan.lane_idx]
         if stepped is None:
             stepped = _fused_progs()["series"](
                 plan.ts_parts, plan.val_parts, plan.row0, plan.steps0_rel,
@@ -858,7 +869,13 @@ class DeviceGridCache:
                 nrows=plan.nrows)
         out_np = np.asarray(stepped)  # host-sync-ok: the designed stepped readback — only [T, lanes] crosses the host link
         if self.hist:
-            cols = lanes_req[:, None] * self.hb + np.arange(self.hb)[None, :]
+            # COLUMN-granular indirection: a hist series' device columns
+            # are lane*hb + bucket, so the pack's inv must compose with
+            # the expanded column map, never the lane map alone
+            cols = plan.lane_idx[:, None] * self.hb \
+                + np.arange(self.hb)[None, :]
+            if used_packed:
+                cols = plan.packed_inv[cols]
             return out_np[:, cols].transpose(1, 0, 2)     # [S_req, T, hb]
         out = out_np[:, lanes_req].T                      # [S_req, T]
         if plan.q.op in _REBASE_OPS:
@@ -1126,19 +1143,22 @@ class DeviceGridCache:
         # phase mode and ts-free ops need no ts plane in the program
         ts_parts = () if (phase_dev is not None or op in TS_FREE_OPS) \
             else tuple(b.ts_seg for b in segments)
-        # fused compressed-resident dispatch (ISSUE 3): one compressed
-        # block covering the whole row span serves through the packed
-        # kernels — the XOR-class decode runs inside the grid kernel,
-        # so HBM reads the ~2.5 B/sample planes.  Phase mode reads the
-        # block's own meta phase row (identical to phase_dev on every
-        # requested lane; unrequested lanes are sliced/dropped).
-        # Multi-block spans, ts-streaming ops, f64 (no meta) residents,
-        # and histogram strides keep the XLA decode path.
+        # fused compressed-resident dispatch (ISSUE 3; histograms since
+        # ISSUE 14): one compressed block covering the whole row span
+        # serves through the packed kernels — the XOR-class decode runs
+        # inside the grid kernel, so HBM reads the ~2.5 B/sample planes.
+        # Phase mode reads the block's own meta phase row (identical to
+        # phase_dev on every requested lane; unrequested lanes are
+        # sliced/dropped).  Histogram caches qualify like scalar ones:
+        # each bucket column is an independent packed lane and callers
+        # compose their ``lane*hb + bucket`` indirections through the
+        # pack's ``inv``.  Multi-block spans, ts-streaming ops, and f64
+        # (no meta) residents keep the XLA decode path.
         seg0 = segments[0]
         packed = packed_inv = None
         packed_phase = False
         if (len(segments) == 1 and isinstance(seg0.vals, dict)
-                and seg0.pack_inv is not None and not self.hist
+                and seg0.pack_inv is not None
                 and not _PACKED_BROKEN
                 and (on_tpu_backend() or _PACKED_INTERPRET)
                 and any(k.startswith("m") for k in seg0.vals)):
@@ -1147,15 +1167,23 @@ class DeviceGridCache:
             elif phase_dev is not None and op in PHASE_OPS:
                 packed, packed_inv = seg0.vals, seg0.pack_inv
                 packed_phase = True
-        hbm_dense = hbm_comp = 0
+        hbm_dense = hbm_comp = hbm_hist = 0
         for blk in segments:
             if isinstance(blk.vals, dict):
-                hbm_comp += sum(int(a.nbytes) for a in blk.vals.values())
+                nb_c = sum(int(a.nbytes) for a in blk.vals.values())
+                if self.hist:
+                    hbm_hist += nb_c
+                else:
+                    hbm_comp += nb_c
             else:
                 hbm_dense += int(blk.vals.nbytes)
         for t in ts_parts:
             if isinstance(t, dict):
-                hbm_comp += int(t["phase"].nbytes)
+                nb_c = int(t["phase"].nbytes)
+                if self.hist:
+                    hbm_hist += nb_c
+                else:
+                    hbm_comp += nb_c
             else:
                 hbm_dense += int(t.nbytes)
         plan = _GridPlan(ts_parts,
@@ -1165,7 +1193,8 @@ class DeviceGridCache:
                          packed=packed, packed_row0=row0,
                          packed_use_phase=packed_phase,
                          packed_inv=packed_inv,
-                         hbm_dense=hbm_dense, hbm_comp=hbm_comp)
+                         hbm_dense=hbm_dense, hbm_comp=hbm_comp,
+                         hbm_comp_hist=hbm_hist)
         if len(self._plan_memo) > 8:
             self._plan_memo.clear()
         self._plan_memo[pkey] = plan
@@ -1395,7 +1424,12 @@ class DeviceGridCache:
                                        fmt="dense")
             nbytes += ts_stage.nbytes
         from filodb_tpu.codecs import xorgrid
-        packed = xorgrid.pack_vals(val_stage, phase=phase) \
+        # histogram caches pack at SERIES granularity (stride=hb): a
+        # series' bucket columns classify together and stay contiguous
+        # in bucket order — the layout contract of the fused hist
+        # kernels (ops/grid.py hist_grid_grouped_packed)
+        packed = xorgrid.pack_vals(val_stage, phase=phase,
+                                   stride=stride) \
             if do_compress else None
         pack_inv = None
         if packed is not None:

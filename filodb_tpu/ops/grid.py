@@ -47,7 +47,7 @@ Layout contract (enforced by the caller / device store):
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -1200,6 +1200,285 @@ def rate_grid_grouped_packed(packed: dict, steps0, q: GridQuery,
     if len(sums) == 1:
         return sums[0], cnts[0]
     return jnp.concatenate(sums, axis=0), jnp.concatenate(cnts, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Compressed-resident HISTOGRAM kernels (ISSUE 14): decode bucket planes
+# in VMEM and reduce the bucket dimension with BANDED MXU matmuls.
+#
+# Input layout contract (codecs/xorgrid.py ``pack_vals(stride=hb)`` over
+# the device store's hist group-slot plane, devicestore.hist_slot_garr):
+# column ``s*hb + j`` holds series s's cumulative bucket j, a series'
+# ``hb`` columns classify together and stay contiguous in bucket order.
+# The fused grouped kernel additionally requires the group-aligned
+# single-class identity pack (min_width, no pads) — same contract as
+# :func:`rate_grid_grouped_packed`, with ``group_lanes % hb == 0``.
+#
+# The per-bucket window compute is the SAME code path as the scalar
+# kernels (each bucket column is an independent counter lane, incl. the
+# banded ``_corr_v1_delta_banded`` correction on K-heavy shapes); what
+# is hist-specific is the in-kernel bucket reduce: summing series within
+# a group PER BUCKET is a banded 0/1 matmul ``M[j, c] = (c mod hb == j)``
+# applied to the [T, group_lanes] stepped tile — the
+# ``_corr_v1_delta_banded`` trick (arXiv:2112.09017's reductions-as-
+# banded-matmuls) restated on the bucket axis, so the reduce runs on the
+# MXU instead of a serialized scatter-add.
+# ---------------------------------------------------------------------------
+
+
+def _hb8(hb: int) -> int:
+    """Bucket count padded to the sublane multiple: output blocks are
+    [hb8, T] per group, so dynamic sublane offsets never appear."""
+    return -(-hb // 8) * 8
+
+
+def _hist_grouped_kernel_packed(s0_ref, m_ref, p_ref, sum_ref, cnt_ref, *,
+                                q: GridQuery, row0: int, use_phase: bool,
+                                hb: int):
+    """One group per kernel instance: decode the group's packed
+    [nb, group_lanes] tile, run the windowed op per bucket column, and
+    band-reduce series into [hb8, T] per-bucket (sum, count) planes."""
+    vals = _decode_rows(p_ref, m_ref, q, row0)
+    if use_phase:
+        roll = lambda x, s: pltpu.roll(x, s, axis=0)
+        out, live_row = _phase_block_raw(m_ref[2:3, :], vals, q, roll,
+                                         mxu=True)
+        vz = jnp.where(live_row, out, 0.0)
+        ok = jnp.broadcast_to(live_row, out.shape).astype(jnp.float32)
+    else:
+        r = _rate_block(None, vals, s0_ref[0], q)
+        fin = jnp.isfinite(r)
+        vz = jnp.where(fin, r, 0.0)
+        ok = fin.astype(jnp.float32)
+    gl = vz.shape[1]
+    hb8 = sum_ref.shape[0]
+    j = jax.lax.broadcasted_iota(jnp.int32, (hb8, gl), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (hb8, gl), 1)
+    band = (c % hb == j).astype(jnp.float32)      # [hb8, gl] banded 0/1
+    hp = jax.lax.Precision.HIGHEST
+    dims = (((1,), (1,)), ((), ()))
+    sum_ref[:, :] = jax.lax.dot_general(band, vz, dims, precision=hp,
+                                        preferred_element_type=jnp.float32)
+    cnt_ref[:, :] = jax.lax.dot_general(band, ok, dims, precision=hp,
+                                        preferred_element_type=jnp.float32)
+
+
+@functools.partial(devicewatch.jit,
+                   program="grid.hist_grid_grouped_packed",
+                   static_argnames=("q", "hb", "group_lanes", "row0",
+                                    "interpret", "use_phase"))
+def hist_grid_grouped_packed(packed: dict, steps0, q: GridQuery, hb: int,
+                             group_lanes: int = 1024, row0: int = 0,
+                             interpret: bool = False,
+                             use_phase: bool = True):
+    """Fully fused ``sum by (g)(rate(latency_bucket[w]))`` over packed
+    HISTOGRAM residents: packed bucket planes -> (sum, count)
+    ``[G*hb, T]`` — decode, per-bucket window compute, and the banded-
+    MXU bucket reduce in ONE kernel per class plane.  Output slot
+    ``g*hb + j`` is group g's cumulative bucket j (the
+    ``hist_slot_garr`` layout ``hist_state_from_planes`` consumes).
+
+    Requires the hist group-aligned pack contract: a single-class
+    identity-order pack (``pack_vals(stride=hb, min_width=...)``, no
+    alignment pads), ``group_lanes % hb == 0``, and every group's
+    ``group_lanes`` columns contiguous.  Mixed-class hist packs must
+    use :func:`rate_grid_packed` + a segment reduce instead."""
+    if group_lanes % hb != 0:
+        raise ValueError(f"group_lanes {group_lanes} not a multiple of "
+                         f"the bucket count {hb}")
+    _packed_check(packed, q, row0, use_phase)
+    inv = packed.get("inv")
+    if inv is not None and packed_width(packed) != inv.shape[0]:
+        raise ValueError(
+            "pack carries alignment-pad lanes; the fused hist grouped "
+            "kernel has no group map to drop them — use the identity "
+            "min_width hist pack")
+    if q.stride > 1:
+        s, c = hist_grid_grouped_packed(packed, steps0, _fine_query(q), hb,
+                                        group_lanes, row0, interpret,
+                                        use_phase)
+        return s[:, ::q.stride], c[:, ::q.stride]
+    s0 = jnp.asarray([steps0], jnp.int32)
+    hb8 = _hb8(hb)
+    sums, cnts = [], []
+    for p, m in _packed_planes(packed):
+        nb, n = p.shape
+        ng = n // group_lanes
+        if n % group_lanes != 0 or ng == 0:
+            raise ValueError(
+                f"packed plane width {n} must be a whole number of "
+                f"{group_lanes}-column groups — use the hist "
+                f"group-aligned pack layout")
+        s, c = pl.pallas_call(
+            functools.partial(_hist_grouped_kernel_packed, q=q, row0=row0,
+                              use_phase=use_phase, hb=hb),
+            interpret=interpret,
+            out_shape=(jax.ShapeDtypeStruct((ng * hb8, q.nsteps),
+                                            jnp.float32),
+                       jax.ShapeDtypeStruct((ng * hb8, q.nsteps),
+                                            jnp.float32)),
+            grid=(ng,),
+            in_specs=[_smem(),
+                      pl.BlockSpec((8, group_lanes), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((nb, group_lanes), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=(pl.BlockSpec((hb8, q.nsteps), lambda i: (i, 0),
+                                    memory_space=pltpu.VMEM),
+                       pl.BlockSpec((hb8, q.nsteps), lambda i: (i, 0),
+                                    memory_space=pltpu.VMEM)),
+        )(s0, m, p)
+        sums.append(s)
+        cnts.append(c)
+    s = sums[0] if len(sums) == 1 else jnp.concatenate(sums, axis=0)
+    c = cnts[0] if len(cnts) == 1 else jnp.concatenate(cnts, axis=0)
+    if hb8 != hb:
+        G = s.shape[0] // hb8
+        s = s.reshape(G, hb8, -1)[:, :hb, :].reshape(G * hb, -1)
+        c = c.reshape(G, hb8, -1)[:, :hb, :].reshape(G * hb, -1)
+    return s, c
+
+
+@functools.partial(devicewatch.jit,
+                   program="grid.hist_quantile_grid_packed",
+                   static_argnames=("q", "phi", "hb", "group_lanes",
+                                    "row0", "interpret", "use_phase"))
+def hist_quantile_grid_packed(packed: dict, steps0, tops, q: GridQuery,
+                              phi: float, hb: int,
+                              group_lanes: int = 1024, row0: int = 0,
+                              interpret: bool = False,
+                              use_phase: bool = True):
+    """Fused ``histogram_quantile(phi, sum by (g)(rate(...)))``: the
+    packed hist kernel above feeds the le-interpolation IN THE SAME
+    compiled program, so only the final ``[G, T]`` quantile plane ever
+    leaves the device — no per-bucket partial crosses the host link.
+    ``tops`` is the [hb] cumulative bucket upper bounds (le values)."""
+    from filodb_tpu.ops import histogram_ops
+
+    s, c = hist_grid_grouped_packed(packed, steps0, q, hb, group_lanes,
+                                    row0, interpret, use_phase)
+    T = s.shape[1]
+    G = s.shape[0] // hb
+    hist_sum = s.reshape(G, hb, T).transpose(0, 2, 1)     # [G, T, hb]
+    out = histogram_ops.hist_quantile(jnp.asarray(tops), hist_sum,
+                                      phi)                # [G, T]
+    nlive = c.reshape(G, hb, T)[:, hb - 1, :]             # total bucket
+    return jnp.where(nlive > 0, out, jnp.nan)
+
+
+# ---------------------------------------------------------------------------
+# Generic columnar event scan -> filter -> topK (ISSUE 14): the GDELT
+# shape.  Each event stream is a lane of a (packed) numeric column
+# plane; the fused program decodes the value column in VMEM, runs the
+# windowed aggregate, masks lanes through an optional predicate on a
+# SECOND column (scanned the same fused way), reduces lanes into groups
+# with a one-hot MXU matmul (the banded-reduce family: group lanes are
+# contiguous, so the 0/1 matrix is banded), and ranks groups with
+# top_k — one compiled program, only [T, k] values + indices leave the
+# device.
+# ---------------------------------------------------------------------------
+
+_FILTER_OPS = {
+    "gt": lambda v, t: v > t, "ge": lambda v, t: v >= t,
+    "lt": lambda v, t: v < t, "le": lambda v, t: v <= t,
+    "eq": lambda v, t: v == t, "ne": lambda v, t: v != t,
+}
+
+# one-hot group reduce beyond this many groups costs too much memory
+# (the [lanes, G] operand) — same cap and segment_sum fallback as the
+# devicestore's _grouped_reduce_impl
+_TOPK_ONEHOT_MAX_G = 2048
+
+
+@functools.partial(devicewatch.jit,
+                   program="grid.event_topk_grid_packed",
+                   static_argnames=("q", "k", "num_groups", "filt_op",
+                                    "filt_q", "row0", "interpret",
+                                    "largest", "group_width"))
+def event_topk_grid_packed(packed: dict, steps0, q: GridQuery, k: int,
+                           garr, num_groups: int,
+                           filt_packed: Optional[dict] = None,
+                           filt_op: str = "gt", filt_thresh=0.0,
+                           filt_q: Optional[GridQuery] = None,
+                           filt_pos=None, row0: int = 0,
+                           interpret: bool = False, largest: bool = True,
+                           group_width: int = 0):
+    """``topk(k, agg by (g)(fn(value_col[w])))`` with an optional scan
+    filter on a second column, over packed columnar residents.
+
+    - ``packed``: the value column's XOR-class planes (packed order).
+    - ``garr``: [packed_width] int32 lane -> group slot in PACKED order
+      (``num_groups`` = drop bucket for pad/unrequested lanes).
+    - ``group_width``: when every group is ``group_width`` CONTIGUOUS
+      packed lanes (the banded layout: group g = lanes [g*W, (g+1)*W)),
+      pass it and ``garr=None`` — the reduce becomes a reshape-sum with
+      no [lanes, G] one-hot operand at all (the memory-free banded
+      form; the bench's 256k-lane table would otherwise stream a
+      multi-GiB one-hot).  A general ``garr`` uses the one-hot MXU
+      matmul up to ``_TOPK_ONEHOT_MAX_G`` groups and segment_sum past
+      it (the devicestore ``_grouped_reduce_impl`` policy).
+    - ``filt_packed``/``filt_op``/``filt_thresh``: keep only lanes whose
+      filter-column window value satisfies ``filt_op(v, thresh)``
+      (ops: gt/ge/lt/le/eq/ne); ``filt_q`` defaults to ``q`` with the
+      same window; ``filt_pos`` ([packed_width] int32) maps the VALUE
+      pack's lane order into the FILTER pack's when the two columns
+      packed with different layouts (identity packs need none).
+    - returns ``(vals [T, k], idx [T, k])``: per step the top-k group
+      sums (``largest=False`` ranks smallest) and their group slots;
+      exhausted ranks come back NaN / -1.
+    """
+    if filt_op not in _FILTER_OPS:
+        raise ValueError(f"unknown filter op {filt_op!r} "
+                         f"(have {sorted(_FILTER_OPS)})")
+    if group_width and garr is not None:
+        raise ValueError("pass garr OR group_width, not both")
+    stepped = rate_grid_packed(packed, steps0, q, row0=row0,
+                               interpret=interpret)          # [T, n]
+    if filt_packed is not None:
+        fq = filt_q if filt_q is not None else q
+        fstep = rate_grid_packed(filt_packed, steps0, fq, row0=row0,
+                                 interpret=interpret)
+        if filt_pos is not None:
+            fstep = fstep[:, filt_pos]
+        keep = _FILTER_OPS[filt_op](fstep,
+                                    jnp.asarray(filt_thresh, fstep.dtype))
+        stepped = jnp.where(keep, stepped, jnp.nan)
+    fin = jnp.isfinite(stepped)
+    vz = jnp.where(fin, stepped, 0.0)
+    T, n = stepped.shape
+    if group_width:
+        if n != num_groups * group_width:
+            raise ValueError(
+                f"packed width {n} != num_groups {num_groups} x "
+                f"group_width {group_width}")
+        sums = vz.reshape(T, num_groups, group_width).sum(2).T
+        cnts = fin.reshape(T, num_groups, group_width) \
+            .sum(2).T.astype(stepped.dtype)
+    elif num_groups + 1 <= _TOPK_ONEHOT_MAX_G:
+        garr = jnp.asarray(garr, jnp.int32)
+        onehot = (garr[:, None] ==
+                  jnp.arange(num_groups, dtype=jnp.int32)[None, :]
+                  ).astype(stepped.dtype)                    # [n, G]
+        hp = jax.lax.Precision.HIGHEST
+        sums = jnp.matmul(onehot.T, vz.T, precision=hp)      # [G, T]
+        cnts = jnp.matmul(onehot.T, fin.astype(stepped.dtype).T,
+                          precision=hp)
+    else:
+        garr = jnp.asarray(garr, jnp.int32)
+        sums = jax.ops.segment_sum(vz.T, garr,
+                                   num_groups + 1)[:num_groups]
+        cnts = jax.ops.segment_sum(fin.astype(stepped.dtype).T, garr,
+                                   num_groups + 1)[:num_groups]
+    sentinel = -jnp.inf if largest else jnp.inf
+    ranked = jnp.where(cnts > 0, sums, sentinel).T           # [T, G]
+    if not largest:
+        ranked = -ranked
+    vals, idx = jax.lax.top_k(ranked, k)
+    live = jnp.isfinite(vals)
+    if not largest:
+        vals = -vals
+    return (jnp.where(live, vals, jnp.nan),
+            jnp.where(live, idx, -1))
 
 
 # ---------------------------------------------------------------------------

@@ -87,7 +87,7 @@ class ExecContext:
     def note_counts(self, samples: int = 0, chunks: int = 0,
                     bytes_: int = 0, pages: int = 0,
                     hbm_dense: int = 0, hbm_compressed: int = 0,
-                    hbm_delta: int = 0) -> None:
+                    hbm_delta: int = 0, hbm_hist: int = 0) -> None:
         with self._corrupt_lock:
             c = self._counters
             if samples:
@@ -103,6 +103,9 @@ class ExecContext:
             if hbm_compressed:
                 c["hbm_compressed"] = c.get("hbm_compressed", 0) \
                     + hbm_compressed
+            if hbm_hist:
+                # histogram bucket planes served compressed (ISSUE 14)
+                c["hbm_hist"] = c.get("hbm_hist", 0) + hbm_hist
             if hbm_delta:
                 # signed: the devicewatch ledger credits commits and
                 # debits frees caused while this query was active
@@ -141,6 +144,8 @@ class ExecContext:
                          hbm_dense=stats.hbm_read_bytes.get("dense", 0),
                          hbm_compressed=stats.hbm_read_bytes.get(
                              "compressed", 0),
+                         hbm_hist=stats.hbm_read_bytes.get(
+                             "compressed-hist", 0),
                          hbm_delta=stats.hbm_resident_delta_bytes)
         self.note_resultcache(cached=stats.resultcache_cached_samples,
                               recomputed=stats.resultcache_recomputed_samples)
@@ -163,7 +168,8 @@ class ExecContext:
             stats.pages_in = c.get("pages", 0)
             stats.hbm_read_bytes = {
                 k: c[ck] for k, ck in (("dense", "hbm_dense"),
-                                       ("compressed", "hbm_compressed"))
+                                       ("compressed", "hbm_compressed"),
+                                       ("compressed-hist", "hbm_hist"))
                 if c.get(ck)}
             stats.hbm_resident_delta_bytes = c.get("hbm_delta", 0)
             stats.resultcache_cached_samples = c.get("rc_cached", 0)

@@ -42,7 +42,6 @@ from typing import Callable, Optional
 import numpy as np
 
 from filodb_tpu.core.record import RecordBuilder
-from filodb_tpu.core.schemas import ColumnType
 from filodb_tpu.downsample.dsstore import ds_dataset_name
 from filodb_tpu.downsample.sharddown import ShardDownsampler
 from filodb_tpu.query.model import QueryContext
@@ -70,6 +69,36 @@ def _ck_name(dataset: str) -> str:
     return f"__rollup__:{dataset}"
 
 
+def _cat_col(a, b):
+    """Concatenate two decoded column parts: plain arrays, or histogram
+    ``(buckets, rows)`` tuples (widening-aware, ISSUE 14)."""
+    if isinstance(a, tuple) or isinstance(b, tuple):
+        from filodb_tpu.core.histogram import concat_hist_parts
+        return concat_hist_parts([a, b])
+    return np.concatenate([a, b])
+
+
+def _take_col(c, order, keep):
+    """Row-select a (possibly histogram-tuple) concatenated column."""
+    if isinstance(c, tuple):
+        return c[0], c[1][order][keep]
+    return c[order][keep]
+
+
+def _emit_col(c, mask):
+    """Downsampled output column -> per-row record values.  Histogram
+    downsamplers (hSum/hLast) emit ``(buckets, rows)``; each masked row
+    encodes back to the wire histogram value the tier schema's hist
+    column ingests (same encode as the flush path's per-row emit,
+    downsample/sharddown.py _emit)."""
+    if isinstance(c, tuple):
+        from filodb_tpu.codecs import histcodec
+        buckets, rows = c
+        return [histcodec.encode_hist_value(buckets, r)
+                for r in np.asarray(rows)[mask]]
+    return np.asarray(c)[mask].tolist()
+
+
 class _SeriesState:
     """One raw series' resident tail: rows newer than the oldest tier's
     emitted boundary, plus per-tier emitted stamps."""
@@ -93,7 +122,10 @@ class _SeriesState:
         """Append decoded rows.  Per-series ingest is monotone so new
         chunks normally extend the tail; the defensive merge handles
         restart catch-up re-reading a chunk the live listener already
-        delivered (exact-duplicate timestamps keep the first copy)."""
+        delivered (exact-duplicate timestamps keep the first copy).
+        Histogram columns arrive as ``(buckets, rows)`` tuples and
+        merge bucket-scheme-aware (mid-stream widening edge-pads, see
+        core.histogram.concat_hist_parts)."""
         if self.ts is None or len(self.ts) == 0:
             self.ts = ts
             self.cols = list(cols)
@@ -102,7 +134,7 @@ class _SeriesState:
             return
         if int(ts[0]) > int(self.ts[-1]):
             self.ts = np.concatenate([self.ts, ts])
-            self.cols = [np.concatenate([a, b])
+            self.cols = [_cat_col(a, b)
                          for a, b in zip(self.cols, cols)]
             return
         merged_ts = np.concatenate([self.ts, ts])
@@ -111,7 +143,7 @@ class _SeriesState:
         keep = np.ones(len(merged_ts), bool)
         keep[1:] = merged_ts[1:] != merged_ts[:-1]
         self.ts = merged_ts[keep]
-        self.cols = [np.concatenate([a, b])[order][keep]
+        self.cols = [_take_col(_cat_col(a, b), order, keep)
                      for a, b in zip(self.cols, cols)]
 
     def prune(self, resolutions) -> None:
@@ -128,7 +160,8 @@ class _SeriesState:
         i = int(np.searchsorted(self.ts, floor, side="right"))
         if i > 0:
             self.ts = self.ts[i:]
-            self.cols = [c[i:] for c in self.cols]
+            self.cols = [(c[0], c[1][i:]) if isinstance(c, tuple)
+                         else c[i:] for c in self.cols]
 
     @property
     def buffered(self) -> int:
@@ -610,8 +643,7 @@ class RollupEngine:
                 builder = RecordBuilder(sampler.ds_schema)
             pe_m = pe[mask]
             builder.add_series([int(x) for x in pe_m],
-                               [np.asarray(c)[mask].tolist()
-                                for c in cols], tags)
+                               [_emit_col(c, mask) for c in cols], tags)
             updates.append((st, int(pe_m[-1])))
             n += len(pe_m)
         return n, (builder.containers() if builder is not None else []), \
@@ -628,8 +660,11 @@ class RollupEngine:
 
     def _sampler(self, d, sr, schema_hash: int):
         """ShardDownsampler for one raw schema, memoized; None when the
-        schema can't roll (no downsample schema, or histogram columns —
-        ROADMAP item 4 widens the substrate later)."""
+        schema can't roll (no downsamplers / no downsample schema).
+        Histogram schemas roll through their hSum/hLast period oracles
+        (downsample/chunkdown.py) since ISSUE 14 — the grid staging
+        declines them (griddown.grid_supported), so they reduce on the
+        always-correct per-series host path."""
         if schema_hash in sr.samplers:
             return sr.samplers[schema_hash]
         sampler = None
@@ -637,9 +672,7 @@ class RollupEngine:
             schema = d.schemas.by_hash(schema_hash)
         except KeyError:
             schema = None
-        if schema is not None and not any(
-                c.ctype == ColumnType.HISTOGRAM
-                for c in schema.data.columns):
+        if schema is not None:
             s = ShardDownsampler(d.dataset, sr.shard_num, schema, None,
                                  d.config.resolutions_ms)
             if s.enabled:

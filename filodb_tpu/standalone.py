@@ -717,7 +717,12 @@ class FiloServer:
             return planner
         from filodb_tpu.rollup.config import (RollupConfig,
                                               RollupConfigError)
-        if schema.downsample is None:
+        # self-downsampling schemas (prom-counter / prom-histogram roll
+        # into their own shape, schemas.py) carry downsample=None but a
+        # downsample_schema NAME — they tier since ISSUE 14
+        if schema.downsample is None \
+                and not (schema.data.downsamplers
+                         and schema.data.downsample_schema):
             raise RollupConfigError(
                 f"dataset {name!r} (schema {ds_conf.get('schema')!r}) "
                 f"has no downsample schema — rollup cannot tier it")

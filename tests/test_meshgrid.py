@@ -441,3 +441,53 @@ class TestCompressedResidentMesh:
             assert p.phase is not None
             assert p.ts is None, "phase-mode plan staged a ts plane"
             assert p.vals.shape[0] > 0
+
+    def test_compressed_hist_blocks_serve_through_mesh(self):
+        """ISSUE 14: histogram bucket planes stay PACKED at rest and the
+        grid x mesh path stages (decodes) them on device — the served
+        answer is identical to the per-shard scatter-gather path."""
+        from filodb_tpu.codecs import histcodec
+        from filodb_tpu.core.histogram import GeometricBuckets
+
+        hb = 8
+        ms = TimeSeriesMemStore()
+        opts = DatasetOptions()
+        mapper = ShardMapper(4)
+        for s in range(4):
+            ms.setup("prom", DEFAULT_SCHEMAS, s)
+        rng = np.random.default_rng(29)
+        buckets = GeometricBuckets(2.0, 2.0, hb)
+        for i in range(12):
+            tags = {"_metric_": "hcc", "inst": f"i{i}",
+                    "_ws_": "w", "_ns_": "n"}
+            shard = mapper.ingestion_shard(shard_key_hash(tags, opts),
+                                           partition_hash(tags, opts),
+                                           2) % 4
+            b = RecordBuilder(DEFAULT_SCHEMAS["prom-histogram"], opts,
+                              container_size=1 << 20)
+            ph = int(rng.integers(1, STEP))
+            cum = np.zeros(hb, np.int64)
+            for t in range(N_ROWS):
+                cum += 128 * rng.integers(1, 8, hb)
+                vals = 2 ** 23 + np.cumsum(cum)
+                blob = histcodec.encode_hist_value(buckets, vals)
+                b.add(int(BASE + t * STEP - STEP + ph),
+                      (float(vals[-1]), float(vals[-1]), blob), tags)
+            for off, c in enumerate(b.containers()):
+                ms.get_shard("prom", shard).ingest_container(c, off)
+        for s in range(4):
+            ms.get_shard("prom", s).flush_all()
+        engine = MeshEngine(make_mesh())
+        promql = 'sum(rate(hcc{_ws_="w",_ns_="n"}[2m]))'
+        plain = _run(_planner(mapper), ms, promql, START, END)
+        before = meshgrid.STATS["serves"]
+        fused = _run(_planner(mapper, engine), ms, promql, START, END)
+        assert meshgrid.STATS["serves"] > before, \
+            "compressed hist query fell off the resident mesh path"
+        _assert_equiv(fused, plain)
+        comp = sum(isinstance(blk.vals, dict)
+                   for s in range(4)
+                   for cache in ms.get_shard("prom", s)
+                   .device_caches.values()
+                   for blk in cache.blocks.values())
+        assert comp > 0, "hist bucket planes did not pack at rest"

@@ -4,7 +4,12 @@ bit-identical to the CPU codec decode (codecs/xorgrid.py unpack_vals)
 and agree with the decoded-plane kernels across the layout's edge cases
 — NaN payloads, constant runs, sign flips, partial final tiles, mixed
 classes, promote/pad alignment.  Pallas runs in interpret mode so the
-whole sweep executes in CPU CI (ISSUE 3 satellite)."""
+whole sweep executes in CPU CI (ISSUE 3 satellite).
+
+ISSUE 14 widens the sweep to the histogram bucket-plane substrate
+(stride packs + hist_grid_grouped_packed / hist_quantile_grid_packed),
+the generic columnar scan-filter-topK program, and the devicestore
+mid-stream bucket-widening path (16 -> 20 buckets)."""
 
 import numpy as np
 import pytest
@@ -14,9 +19,12 @@ import jax.numpy as jnp
 
 from filodb_tpu.codecs.xorgrid import (LANE_BLOCK, UNPADDED_MAX, pack_vals,
                                        unpack_vals)
-from filodb_tpu.ops.grid import (GridQuery, packed_width, rate_grid_grouped,
-                                 rate_grid_grouped_packed, rate_grid_packed,
-                                 rate_grid_ref)
+from filodb_tpu.ops import histogram_ops
+from filodb_tpu.ops.grid import (GridQuery, event_topk_grid_packed,
+                                 hist_grid_grouped_packed,
+                                 hist_quantile_grid_packed, packed_width,
+                                 rate_grid_grouped, rate_grid_grouped_packed,
+                                 rate_grid_packed, rate_grid_ref)
 
 STEP = 60_000
 
@@ -233,6 +241,123 @@ class TestFusedKernelEquivalence:
             rate_grid_grouped_packed(dev, 0, q, group_lanes=128,
                                      interpret=True)
 
+    def test_event_topk_matches_ref(self):
+        """Generic columnar scan-filter-topK over a MIXED-class pack:
+        the packed-order contract composes garr through inv, filter
+        column packed with a DIFFERENT layout composed via filt_pos."""
+        rng = np.random.default_rng(21)
+        B, L, G, k = 64, 512, 8, 3
+        v = _edge_plane(rng, B, L)
+        pk, dev = _pack_dev(v)
+        fv = _counters(rng, B, L)
+        pkf, devf = _pack_dev(fv, min_width=16)
+        assert (pkf.inv == np.arange(L)).all()
+        T, K = 12, 4
+        qs = GridQuery(nsteps=T, kbuckets=K, gstep_ms=STEP, op="sum",
+                       is_rate=False, dense=False)
+        ql = GridQuery(nsteps=T, kbuckets=K, gstep_ms=STEP, op="last",
+                      is_rate=False, dense=True)
+        garr_orig = (np.arange(L) % G).astype(np.int32)
+        # garr and filt_pos are in the VALUE pack's lane order
+        npk = packed_width(dev)
+        garr_pk = np.full(npk, G, np.int32)
+        garr_pk[pk.inv] = garr_orig
+        filt_pos = np.zeros(npk, np.int64)
+        filt_pos[pk.inv] = pkf.inv          # value-pos -> filter-pos
+        vals, idx = event_topk_grid_packed(
+            dev, 0, qs, k, jnp.asarray(garr_pk), G,
+            filt_packed=devf, filt_op="gt",
+            filt_thresh=float(np.median(fv[B // 2])), filt_q=ql,
+            filt_pos=jnp.asarray(filt_pos), interpret=True)
+        # oracle: decoded-plane reference + numpy reduce + ranking
+        sv = np.asarray(rate_grid_ref(None, jnp.asarray(v[:T + K - 1]),
+                                      0, qs))
+        sf = np.asarray(rate_grid_ref(None, jnp.asarray(fv[:T + K - 1]),
+                                      0, ql))
+        masked = np.where(sf > float(np.median(fv[B // 2])), sv, np.nan)
+        fin = np.isfinite(masked)
+        gs = np.zeros((G, T))
+        gc = np.zeros((G, T))
+        for c in range(L):
+            g = garr_orig[c]
+            gs[g] += np.where(fin[:, c], masked[:, c], 0.0)
+            gc[g] += fin[:, c]
+        ranked = np.where(gc > 0, gs, -np.inf)
+        got_v, got_i = np.asarray(vals), np.asarray(idx)
+        for t in range(T):
+            order = np.argsort(-ranked[:, t], kind="stable")[:k]
+            want = np.where(np.isfinite(ranked[order, t]),
+                            ranked[order, t], np.nan)
+            np.testing.assert_allclose(got_v[t], want, rtol=1e-5,
+                                       equal_nan=True)
+            live = np.isfinite(want)
+            assert set(got_i[t][live]) == set(order[live])
+            assert (got_i[t][~live] == -1).all()
+
+    def test_event_topk_bottomk_and_bad_filter_op(self):
+        rng = np.random.default_rng(22)
+        B, L, G = 64, 256, 4
+        v = _counters(rng, B, L)
+        _pk, dev = _pack_dev(v, min_width=16)
+        T, K = 8, 4
+        qs = GridQuery(nsteps=T, kbuckets=K, gstep_ms=STEP, op="sum",
+                       is_rate=False, dense=True)
+        garr = (np.arange(L) % G).astype(np.int32)
+        vals, _ = event_topk_grid_packed(dev, 0, qs, 2,
+                                         jnp.asarray(garr), G,
+                                         interpret=True, largest=False)
+        sv = np.asarray(rate_grid_ref(None, jnp.asarray(v[:T + K - 1]),
+                                      0, qs))
+        gs = np.zeros((G, T))
+        for c in range(L):
+            gs[garr[c]] += sv[:, c]
+        want = np.sort(gs, axis=0)[:2].T
+        np.testing.assert_allclose(np.sort(np.asarray(vals), axis=1),
+                                   np.sort(want, axis=1), rtol=1e-5)
+        with pytest.raises(ValueError, match="filter op"):
+            event_topk_grid_packed(dev, 0, qs, 2, jnp.asarray(garr), G,
+                                   filt_packed=dev, filt_op="contains",
+                                   interpret=True)
+
+    def test_event_topk_group_width_and_segment_paths_agree(self):
+        """The three reduce formulations — banded group_width
+        reshape-sum, one-hot MXU matmul, and the >_TOPK_ONEHOT_MAX_G
+        segment_sum fallback (exercised with a genuinely large group
+        space: sparse groups rank NaN) — must rank identically."""
+        rng = np.random.default_rng(23)
+        B, L, G = 64, 256, 8
+        v = _counters(rng, B, L)
+        _pk, dev = _pack_dev(v, min_width=16)
+        T, K = 8, 4
+        qs = GridQuery(nsteps=T, kbuckets=K, gstep_ms=STEP, op="sum",
+                       is_rate=False, dense=True)
+        garr = (np.arange(L, dtype=np.int32) // (L // G))
+        by_onehot = event_topk_grid_packed(
+            dev, 0, qs, 3, jnp.asarray(garr), G, interpret=True)
+        by_width = event_topk_grid_packed(
+            dev, 0, qs, 3, None, G, interpret=True,
+            group_width=L // G)
+        # same lanes scattered into a 4096-group space (> the one-hot
+        # cap -> segment_sum): occupied slots are g*512, so dividing
+        # the winning indices by 512 must reproduce the small ranking
+        by_segment = event_topk_grid_packed(
+            dev, 0, qs, 3, jnp.asarray(garr * 512), 4096,
+            interpret=True)
+        np.testing.assert_allclose(np.asarray(by_width[0]),
+                                   np.asarray(by_onehot[0]), rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(by_width[1]),
+                                      np.asarray(by_onehot[1]))
+        np.testing.assert_allclose(np.asarray(by_segment[0]),
+                                   np.asarray(by_onehot[0]), rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(by_segment[1]) // 512,
+                                      np.asarray(by_onehot[1]))
+        with pytest.raises(ValueError, match="not both"):
+            event_topk_grid_packed(dev, 0, qs, 3, jnp.asarray(garr), G,
+                                   interpret=True, group_width=L // G)
+        with pytest.raises(ValueError, match="group_width"):
+            event_topk_grid_packed(dev, 0, qs, 3, None, G + 1,
+                                   interpret=True, group_width=L // G)
+
     def test_banded_mxu_correction_matches_ref(self):
         """K-heavy phase shape (2T < rows) takes the banded one-matmul
         correction+delta path; the reference (roll-scan) oracle pins
@@ -258,3 +383,261 @@ class TestFusedKernelEquivalence:
         fin = np.isfinite(ref)
         assert (np.isfinite(out) == fin).all()
         np.testing.assert_allclose(out[fin], ref[fin], rtol=2e-5)
+
+
+def _hist_plane(rng, B, n_series, hb, mixed=False):
+    """[B, n_series*hb] bucket plane: column s*hb + j = series s's
+    cumulative bucket j (the devicestore hist group-slot layout), all
+    integer-valued with a pinned f32 exponent.  ``mixed`` adds all-NaN
+    series and a raw-class (incompressible) series."""
+    L = n_series * hb
+    start = (2 ** 23 + 128 * rng.integers(0, 2 ** 15, L)).astype(np.float32)
+    inc = 128 * rng.integers(1, 8, (B, L))
+    v = (start[None, :] + np.cumsum(inc, axis=0)).astype(np.float32)
+    if mixed and n_series >= 4:
+        v[:, 0:hb] = np.nan                          # dead series
+        v[:, hb:2 * hb] = rng.random((B, hb)).astype(np.float32) * 100
+    phase = np.repeat(rng.integers(1, STEP, n_series), hb).astype(np.int32)
+    return v, phase
+
+
+class TestHistStridePack:
+    """codecs/xorgrid.py stride packs: series-granular classification,
+    bucket contiguity, bit-exact roundtrip (ISSUE 14 tentpole 1)."""
+
+    @pytest.mark.parametrize("seed", range(3))
+    @pytest.mark.parametrize("hb", [4, 16, 20])
+    def test_roundtrip_and_series_contiguity(self, seed, hb):
+        rng = np.random.default_rng(seed)
+        nser = 37
+        v, phase = _hist_plane(rng, 64, nser, hb, mixed=True)
+        pk = pack_vals(v, phase=phase, stride=hb)
+        if pk is None:
+            pytest.skip("mix did not pay at this width")
+        np.testing.assert_array_equal(unpack_vals(pk).view(np.uint32),
+                                      v.view(np.uint32))
+        # every series' hb columns are CONTIGUOUS in packed order, in
+        # bucket order — the fused hist kernels' slicing contract
+        for s in range(nser):
+            pos = pk.inv[s * hb:(s + 1) * hb]
+            assert (np.diff(pos) == 1).all(), (s, pos)
+
+    def test_stride_must_divide_width(self):
+        rng = np.random.default_rng(1)
+        v, _ = _hist_plane(rng, 64, 4, 4)
+        with pytest.raises(ValueError, match="stride"):
+            pack_vals(v[:, :-1], stride=4)
+
+    def test_stride_alignment_pads_never_split_series(self):
+        """Misaligned class widths at stride > 1 must pad (zero lanes),
+        never promote a partial series across classes."""
+        rng = np.random.default_rng(2)
+        hb = 20
+        v, phase = _hist_plane(rng, 64, 33, hb, mixed=True)  # 660 cols
+        pk = pack_vals(v, phase=phase, stride=hb)
+        if pk is None:
+            pytest.skip("did not pay")
+        for key in ("p8", "p16", "raw"):
+            p = pk.planes.get(key)
+            if p is None:
+                continue
+            n = p.shape[1]
+            assert n % LANE_BLOCK == 0 or n <= UNPADDED_MAX, (key, n)
+        np.testing.assert_array_equal(unpack_vals(pk).view(np.uint32),
+                                      v.view(np.uint32))
+
+
+class TestHistFusedKernels:
+    """hist_grid_grouped_packed / hist_quantile_grid_packed in
+    interpret mode vs the decoded-plane reference + the shared
+    hist-quantile math (ISSUE 14 tentpole 2)."""
+
+    @pytest.mark.parametrize("hb,row0", [(4, 0), (8, 3), (20, 0)])
+    def test_grouped_matches_ref(self, hb, row0):
+        rng = np.random.default_rng(31)
+        per, gh = 8, 4
+        nser = per * gh
+        v, phase = _hist_plane(rng, 64, nser, hb)
+        v[:, 2 * hb:3 * hb] = np.nan               # one dead series
+        pk = pack_vals(v, phase=phase, min_width=16, stride=hb)
+        assert pk is not None and (pk.inv == np.arange(nser * hb)).all()
+        dev = {k: jnp.asarray(a) for k, a in pk.planes.items()}
+        T, K = 10, 5
+        q = GridQuery(nsteps=T, kbuckets=K, gstep_ms=STEP, is_rate=True,
+                      dense=True)
+        s, c = hist_grid_grouped_packed(dev, 0, q, hb,
+                                        group_lanes=per * hb, row0=row0,
+                                        interpret=True, use_phase=True)
+        s, c = np.asarray(s), np.asarray(c)
+        assert s.shape == (gh * hb, T)
+        ref = np.asarray(rate_grid_ref(
+            None, jnp.asarray(v[row0:row0 + T + K - 1]), 0, q,
+            phase=phase))
+        want = np.zeros((gh * hb, T), np.float32)
+        wcnt = np.zeros((gh * hb, T), np.float32)
+        for col in range(nser * hb):
+            g, j = col // (per * hb), col % hb
+            fin = np.isfinite(ref[:, col])
+            want[g * hb + j] += np.where(fin, ref[:, col], 0.0)
+            wcnt[g * hb + j] += fin
+        np.testing.assert_allclose(s, want, rtol=2e-5)
+        np.testing.assert_array_equal(c, wcnt)
+
+    def test_quantile_matches_shared_math(self):
+        rng = np.random.default_rng(32)
+        hb, per, gh = 8, 16, 4
+        v, phase = _hist_plane(rng, 64, per * gh, hb)
+        pk = pack_vals(v, phase=phase, min_width=16, stride=hb)
+        assert pk is not None
+        dev = {k: jnp.asarray(a) for k, a in pk.planes.items()}
+        T, K = 10, 5
+        q = GridQuery(nsteps=T, kbuckets=K, gstep_ms=STEP, is_rate=True,
+                      dense=True)
+        tops = np.concatenate([2.0 ** np.arange(hb - 1), [np.inf]])
+        out = np.asarray(hist_quantile_grid_packed(
+            dev, 0, jnp.asarray(tops), q, 0.99, hb,
+            group_lanes=per * hb, interpret=True))
+        s, _c = hist_grid_grouped_packed(dev, 0, q, hb,
+                                         group_lanes=per * hb,
+                                         interpret=True, use_phase=True)
+        hist_sum = np.asarray(s).reshape(gh, hb, T).transpose(0, 2, 1)
+        want = np.asarray(histogram_ops.hist_quantile(
+            jnp.asarray(tops), jnp.asarray(hist_sum), 0.99))
+        # the fused program inlines the grouped kernel under one jit;
+        # XLA's reassociation shifts the f32 sums by ~1 ulp vs the
+        # standalone call, which the interpolation divides amplify
+        np.testing.assert_allclose(out, want, rtol=2e-5)
+
+    def test_free_op_sum_over_time_no_phase(self):
+        """TS_FREE hist shape (sum_over_time over buckets) takes the
+        non-phase kernel branch."""
+        rng = np.random.default_rng(33)
+        hb, per, gh = 4, 8, 2
+        v, phase = _hist_plane(rng, 64, per * gh, hb)
+        pk = pack_vals(v, phase=phase, min_width=16, stride=hb)
+        dev = {k: jnp.asarray(a) for k, a in pk.planes.items()}
+        T, K = 10, 4
+        q = GridQuery(nsteps=T, kbuckets=K, gstep_ms=STEP, op="sum",
+                      is_rate=False, dense=True)
+        s, c = hist_grid_grouped_packed(dev, 0, q, hb,
+                                        group_lanes=per * hb,
+                                        interpret=True, use_phase=False)
+        ref = np.asarray(rate_grid_ref(None, jnp.asarray(v[:T + K - 1]),
+                                       0, q))
+        want = np.zeros((gh * hb, T), np.float32)
+        for col in range(per * gh * hb):
+            g, j = col // (per * hb), col % hb
+            want[g * hb + j] += np.where(np.isfinite(ref[:, col]),
+                                         ref[:, col], 0.0)
+        np.testing.assert_allclose(np.asarray(s), want, rtol=2e-5)
+
+    def test_rejects_misaligned_and_padded(self):
+        rng = np.random.default_rng(34)
+        hb = 4
+        v, phase = _hist_plane(rng, 64, 32, hb)
+        pk = pack_vals(v, phase=phase, min_width=16, stride=hb)
+        dev = {k: jnp.asarray(a) for k, a in pk.planes.items()}
+        q = GridQuery(nsteps=8, kbuckets=4, gstep_ms=STEP, dense=True)
+        with pytest.raises(ValueError, match="multiple of"):
+            hist_grid_grouped_packed(dev, 0, q, hb, group_lanes=30,
+                                     interpret=True)
+        padded = dict(pk.planes)
+        padded["p16"] = np.pad(padded["p16"], ((0, 0), (0, 128)))
+        padded["m16"] = np.pad(padded["m16"], ((0, 0), (0, 128)))
+        padded["z16"] = np.pad(padded["z16"], (0, 128))
+        padded["first"] = np.pad(padded["first"], (0, 128))
+        devp = {k: jnp.asarray(a) for k, a in padded.items()}
+        with pytest.raises(ValueError, match="pad lanes"):
+            hist_grid_grouped_packed(devp, 0, q, hb, group_lanes=32,
+                                     interpret=True)
+
+
+class TestHistServingWidening:
+    """Mid-stream bucket-count widening (16 -> 20 buckets) through the
+    REAL serving path (devicestore.py hb re-probe): the cache disables
+    on the widened chunk, re-probes the bucket scheme, and the packed
+    fused path serves the widened layout with narrow rows edge-padded —
+    equal to the host oracle."""
+
+    def test_widening_16_to_20_reprobes_and_serves_packed(self, monkeypatch):
+        from filodb_tpu.codecs import histcodec
+        from filodb_tpu.core.filters import ColumnFilter, Equals
+        from filodb_tpu.core.histogram import GeometricBuckets
+        from filodb_tpu.core.record import RecordBuilder, decode_container
+        from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+        from filodb_tpu.core.storeconfig import StoreConfig
+        from filodb_tpu.memstore import devicestore
+        from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+        from filodb_tpu.ops.windows import StepRange
+        from filodb_tpu.query import rangefns
+        from filodb_tpu.query.logical import RangeFunctionId as F
+
+        monkeypatch.setattr(devicestore, "_PACKED_INTERPRET", True)
+        monkeypatch.setattr(devicestore, "_PACKED_BROKEN", False)
+        monkeypatch.setattr(devicestore.DeviceGridCache, "_val_dtype",
+                            lambda self: np.float32)
+        T0 = 1_600_000_000_000
+        HSTEP = 10_000
+        rng = np.random.default_rng(6)
+        ms = TimeSeriesMemStore()
+        shard = ms.setup("prom", DEFAULT_SCHEMAS, 0, StoreConfig())
+
+        def ingest(t0, rows, nb, off0):
+            buckets = GeometricBuckets(2.0, 2.0, nb)
+            b = RecordBuilder(DEFAULT_SCHEMAS["prom-histogram"],
+                              DatasetOptions())
+            for s in range(3):
+                cum = np.zeros(nb, np.int64)
+                for t in range(rows):
+                    cum += 128 * rng.integers(1, 8, nb)
+                    vals = 2 ** 23 + np.cumsum(cum)
+                    blob = histcodec.encode_hist_value(buckets, vals)
+                    b.add(t0 + t * HSTEP, (float(vals[-1]),
+                                           float(vals[-1]), blob),
+                          {"__name__": "lat", "inst": f"i{s}",
+                           "_ws_": "w", "_ns_": "n"})
+            for off, c in enumerate(b.containers()):
+                shard.ingest(decode_container(c, DEFAULT_SCHEMAS),
+                             off0 + off)
+            shard.flush_all()
+
+        ingest(T0, 48, 16, 0)
+        res = shard.lookup_partitions(
+            [ColumnFilter("_metric_", Equals("lat"))], 0, 2 ** 62)
+        K = 4
+        W = K * HSTEP
+        steps0 = T0 + (K + 1) * HSTEP
+        got = shard.scan_grid(res.part_ids, F.SUM_OVER_TIME, steps0, 20,
+                              HSTEP, W)
+        assert got is not None
+        cache = next(iter(shard.device_caches.values()))
+        assert cache.hb == 16
+        assert next(iter(cache._plan_memo.values())).packed is not None
+        # widen mid-stream: 20-bucket rows arrive
+        ingest(T0 + 48 * HSTEP, 48, 20, 100)
+        # the first query over the widened span hits the 16-bucket probe
+        # and disables (devicestore _build: bucket scheme widened); the
+        # re-probe path must then serve hb=20 once the backoff clears
+        steps1 = T0 + (48 + K + 1) * HSTEP
+        shard.scan_grid(res.part_ids, F.SUM_OVER_TIME, steps1, 20,
+                        HSTEP, W)
+        cache.disabled_until_version = -1          # clear the backoff
+        got2 = shard.scan_grid(res.part_ids, F.SUM_OVER_TIME, steps1, 20,
+                               HSTEP, W)
+        assert got2 is not None
+        assert cache.hb == 20
+        tags, vals, tops = got2
+        assert vals.shape[2] == 20 and len(tops) == 20
+        plan = next(iter(cache._plan_memo.values()))
+        assert plan.packed is not None, "widened hist did not re-pack"
+        assert not devicestore._PACKED_BROKEN
+        # host oracle over the widened span
+        end = steps1 + 19 * HSTEP
+        t2, batch = shard.scan_batch(res.part_ids, steps1 - W, end)
+        sr = StepRange(steps1, end, HSTEP)
+        want = np.asarray(rangefns.apply_range_function(
+            batch, sr, W, F.SUM_OVER_TIME))[:len(tags)]
+        fin = np.isfinite(want)
+        assert (np.isfinite(np.asarray(vals)) == fin).all()
+        np.testing.assert_allclose(np.asarray(vals)[fin], want[fin],
+                                   rtol=1e-5)

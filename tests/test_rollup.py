@@ -89,6 +89,19 @@ class Harness:
             self.ms.ingest("prom", 0, c, self.offset)
             self.offset += 1
 
+    def ingest_hist(self, series_rows) -> None:
+        """[(tags, ts, (buckets, rows [n, hb]))] prom-histogram batches
+        (sum/count columns derived from the total bucket)."""
+        from filodb_tpu.codecs import histcodec
+        b = RecordBuilder(DEFAULT_SCHEMAS["prom-histogram"])
+        for tags, ts, (buckets, rows) in series_rows:
+            for t, r in zip(ts, rows):
+                blob = histcodec.encode_hist_value(buckets, r)
+                b.add(int(t), (float(r[-1]), float(r[-1]), blob), tags)
+        for c in b.containers():
+            self.ms.ingest("prom", 0, c, self.offset)
+            self.offset += 1
+
     def flush_tick(self) -> None:
         self.itime += 1
         self.shard.flush_all(ingestion_time=self.itime)
@@ -129,8 +142,18 @@ class Harness:
                 (res, tags)
             for ci in range(1, len(part.schema.data.columns)):
                 _, got = part.read_range(0, 1 << 62, ci)
-                assert np.asarray(got).tobytes() == \
-                    np.asarray(cols[ci - 1])[m].tobytes(), (res, tags, ci)
+                want = cols[ci - 1]
+                if isinstance(want, tuple):      # histogram column
+                    wb, wr = want
+                    gb, gr = got
+                    assert np.asarray(gb.bucket_tops()).tobytes() == \
+                        np.asarray(wb.bucket_tops()).tobytes(), (res, tags)
+                    assert np.asarray(gr, np.float64).tobytes() == \
+                        np.asarray(wr, np.float64)[m].tobytes(), \
+                        (res, tags, ci)
+                else:
+                    assert np.asarray(got).tobytes() == \
+                        np.asarray(want)[m].tobytes(), (res, tags, ci)
             checked += 1
         assert checked
         return checked
@@ -258,6 +281,49 @@ class TestLiveRollupEquivalence:
         tier_sh = h.ms.get_shard(ds_dataset_name("prom", RES[0]), 0)
         # per-series closure means no period is ever emitted twice
         assert tier_sh.stats.out_of_order_dropped == 0
+
+    @pytest.mark.parametrize("seed", [5, 6])
+    def test_histogram_generative_with_widening(self, seed):
+        """ISSUE 14 satellite (ROADMAP 2 follow-up a): prom-histogram
+        series roll through the hLast period oracle into the tiers,
+        bit-equal to the offline downsample pass — including a
+        MID-STREAM bucket widening (8 -> 12) whose narrow rows edge-pad
+        under the widest scheme on both sides."""
+        from filodb_tpu.core.histogram import GeometricBuckets
+        rng = np.random.default_rng(seed)
+        h = Harness(schema="prom-histogram")
+        last: dict = {}
+        t = BASE
+        step = 10_000
+        cums = {}
+        for rnd in range(4):
+            hb = 8 if rnd < 2 else 12          # widen mid-stream
+            buckets = GeometricBuckets(2.0, 2.0, hb)
+            rows_n = int(rng.integers(30, 90))
+            batch = []
+            for i in range(3):
+                tags = {"_metric_": "lat", "inst": f"i{i}",
+                        "_ws_": "w", "_ns_": "n"}
+                cum = cums.get(i, np.zeros(hb, np.int64))
+                if len(cum) < hb:              # carry totals forward
+                    cum = np.pad(cum, (0, hb - len(cum)), mode="edge")
+                rows = np.empty((rows_n, hb), np.int64)
+                for r in range(rows_n):
+                    cum = cum + rng.integers(0, 5, hb)
+                    rows[r] = np.cumsum(cum)
+                cums[i] = cum
+                ts = t + np.arange(rows_n, dtype=np.int64) * step
+                batch.append((tags, ts, (buckets, rows)))
+                last[i] = int(ts[-1])
+            h.ingest_hist(batch)
+            t += rows_n * step
+            h.flush_tick()
+        last_by_pk = {
+            canonical_partkey({"_metric_": "lat", "inst": f"i{i}",
+                               "_ws_": "w", "_ns_": "n"}): ts
+            for i, ts in last.items()}
+        for res in RES:
+            h.assert_tier_matches_oracle(res, last_by_pk)
 
     @pytest.mark.parametrize("seed", [3, 4])
     def test_counter_with_resets_generative(self, seed):
