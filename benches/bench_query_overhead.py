@@ -131,6 +131,48 @@ def main():
             f"the 3% budget")
         return 1
 
+    # kernel-timer guard (ISSUE 15): sampling at 1-in-1 — the WORST
+    # case, every wrapped launch pays a block_until_ready wait plus the
+    # shape-key + EWMA fold — vs sampling fully off, INTERLEAVED A/B so
+    # host drift hits both arms equally.  The shipped default (1-in-64)
+    # costs ~1/64th of whatever this measures on the sampled launches
+    # and one counter inc on the rest.
+    kt = devicewatch.KERNEL_TIMER
+    old_rate = kt.sample_1_in
+    kt.configure(sample_1_in=0)
+    once()
+    kt.configure(sample_1_in=1)
+    once()
+    lat_kt_off, lat_kt_on = [], []
+    try:
+        for _ in range(ITERS):
+            kt.configure(sample_1_in=0)
+            t0 = time.perf_counter()
+            once()
+            lat_kt_off.append(time.perf_counter() - t0)
+            kt.configure(sample_1_in=1)
+            t0 = time.perf_counter()
+            once()
+            lat_kt_on.append(time.perf_counter() - t0)
+    finally:
+        kt.configure(sample_1_in=old_rate)
+    med_kt_off = statistics.median(lat_kt_off)
+    med_kt_on = statistics.median(lat_kt_on)
+    kt_delta = statistics.median(
+        on - off for on, off in zip(lat_kt_on, lat_kt_off))
+    kt_overhead = kt_delta / med_kt_off
+    log(f"kernel timer off {med_kt_off * 1e3:.2f} ms  "
+        f"1-in-1 {med_kt_on * 1e3:.2f} ms  paired delta "
+        f"{kt_delta * 1e6:+.0f} us ({kt_overhead * 100:+.2f}%)")
+    emit("kernel_timer_overhead_median", kt_overhead * 100, "%",
+         off_ms=round(med_kt_off * 1e3, 3),
+         on_ms=round(med_kt_on * 1e3, 3),
+         paired_delta_us=round(kt_delta * 1e6, 1))
+    if kt_overhead > 0.03 and kt_delta > 5e-4:
+        log(f"FAIL: kernel-timer 1-in-1 overhead "
+            f"{kt_overhead * 100:.2f}% exceeds the 3% budget")
+        return 1
+
     # admission-control guard (ISSUE 5): the same loop routed through
     # the workload front door — deadline mint, index-priced cost
     # estimate, admit permit, calibration observe on release — vs the

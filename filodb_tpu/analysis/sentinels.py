@@ -9,7 +9,7 @@ catch-tests, run through these rules.
 
 Rules: decode-sentinel, timed-handler, interpret-coverage,
 device-put-ledger, admission-routing, deadline-threading, metric-doc,
-replica-routing, evaluator-workload.
+replica-routing, evaluator-workload, kernel-timer-coverage.
 """
 
 from __future__ import annotations
@@ -496,6 +496,90 @@ def interpret_coverage(project):
                 f"{fn} has no interpret-mode test (call it with "
                 f"interpret=True in tests/) — CPU CI never exercises "
                 f"the kernel body"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# kernel-timer-coverage (PR 20, project scope): every devicewatch.jit
+# entry point passes a stable, UNIQUE program= name — the kernel
+# timer's ledger (and the compile table, and the regression sentry's
+# persisted baselines) all key on it; the __name__ fallback silently
+# forks a program's ledger row on any rename, and two entry points
+# sharing one name merge their EWMAs into nonsense
+# ---------------------------------------------------------------------------
+
+KERNEL_TIMER_ALLOWLIST = ("utils/devicewatch.py",)
+
+
+def _devicewatch_jit_sites(module) -> list:
+    """AST nodes wrapping a function with devicewatch.jit: direct
+    calls (``devicewatch.jit(fn, ...)``), partial decorators
+    (``functools.partial(devicewatch.jit, ...)``), and bare
+    ``@devicewatch.jit`` decorators (which can carry no program=)."""
+    def is_dw_jit(n) -> bool:
+        return isinstance(n, ast.Attribute) and n.attr == "jit" \
+            and isinstance(n.value, ast.Name) \
+            and n.value.id == "devicewatch"
+
+    sites = []
+    for node in module.nodes:
+        if isinstance(node, ast.Call):
+            f = node.func
+            if is_dw_jit(f):
+                sites.append(node)
+            elif ((isinstance(f, ast.Attribute) and f.attr == "partial")
+                  or (isinstance(f, ast.Name) and f.id == "partial")) \
+                    and node.args and is_dw_jit(node.args[0]):
+                sites.append(node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.decorator_list:
+                if is_dw_jit(d):
+                    sites.append(d)   # bare @devicewatch.jit decorator
+    return sites
+
+
+@rule("kernel-timer-coverage", scope="project",
+      doc="devicewatch.jit entry points without a stable unique "
+          "program= name")
+def kernel_timer_coverage(project):
+    findings = []
+    seen: dict[str, tuple[str, int]] = {}
+    for m in project.modules:
+        if m.tree is None or m.rel.endswith(KERNEL_TIMER_ALLOWLIST):
+            continue
+        for node in _devicewatch_jit_sites(m):
+            kw = None
+            if isinstance(node, ast.Call):
+                kw = next((k for k in node.keywords
+                           if k.arg == "program"), None)
+            if kw is None:
+                findings.append(Finding(
+                    "kernel-timer-coverage", m.rel, node.lineno,
+                    "devicewatch.jit without program= — the kernel "
+                    "timer ledger, compile table, and persisted sentry "
+                    "baselines key on the program name; the __name__ "
+                    "fallback silently forks the ledger row on any "
+                    "rename"))
+                continue
+            if not (isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)):
+                findings.append(Finding(
+                    "kernel-timer-coverage", m.rel, node.lineno,
+                    "program= must be a string literal — a computed "
+                    "name is not stable across runs, so the sentry's "
+                    "persisted baseline never matches"))
+                continue
+            name = kw.value.value
+            if name in seen:
+                first = seen[name]
+                findings.append(Finding(
+                    "kernel-timer-coverage", m.rel, node.lineno,
+                    f"duplicate program name {name!r} (first at "
+                    f"{first[0]}:{first[1]}) — two entry points "
+                    f"sharing one name merge their device-time ledger "
+                    f"rows into nonsense"))
+            else:
+                seen[name] = (m.rel, node.lineno)
     return findings
 
 

@@ -165,6 +165,13 @@ def stats_payload(stats, trace_id: str = "") -> dict:
         # tiered-resolution serving (doc/rollup.md): the coarsest rolled
         # tier that served (part of) this query; 0 = raw only
         "resolutionMs": int(getattr(stats, "resolution_ms", 0)),
+        # kernel flight deck (ISSUE 15, doc/observability.md): measured
+        # device seconds per wrapped program from the launches SAMPLED
+        # during this query — the per-program split of the
+        # device_compute timing bucket (names the offending kernel)
+        "devicePrograms": {k: round(float(v), 6)
+                           for k, v in sorted(getattr(
+                               stats, "device_programs", {}).items())},
         # query-frontend result cache (doc/query-engine.md): result
         # samples served from memoized immutable-chunk partials vs
         # samples re-scanned fresh this evaluation

@@ -553,6 +553,9 @@ class FiloHttpServer:
                 and parts[1] == "device":
             return self._device()
         if len(parts) == 2 and parts[0] == "admin" \
+                and parts[1] == "kernels":
+            return self._kernels()
+        if len(parts) == 2 and parts[0] == "admin" \
                 and parts[1] == "flightrecorder":
             return self._flightrecorder(params)
         if len(parts) == 2 and parts[0] == "admin" \
@@ -577,6 +580,9 @@ class FiloHttpServer:
         if len(parts) == 2 and parts[0] == "debug" \
                 and parts[1] == "profilez":
             return self._profilez(params)
+        if len(parts) == 2 and parts[0] == "debug" \
+                and parts[1] == "device_profilez":
+            return self._device_profilez(params)
         return 404, error_response("bad_data", f"unknown route {path}")
 
     # ------------------------------------------------------- rule engine
@@ -728,6 +734,35 @@ class FiloHttpServer:
         data["arenas"] = arenas
         return 200, {"status": "success", "data": data}
 
+    @_timed("kernels")
+    def _kernels(self) -> tuple[int, dict]:
+        """The kernel flight deck (ISSUE 15): per-program launches,
+        compiles, sampled EWMA device time, achieved GB/s vs the
+        configured HBM roof, and regression-sentry state — the live
+        counterpart of doc/kernel.md's static roofline table."""
+        from filodb_tpu.utils import devicewatch
+        return 200, {"status": "success",
+                     "data": devicewatch.kernel_summary()}
+
+    @_timed("device_profilez")
+    def _device_profilez(self, p: dict) -> tuple[int, dict]:
+        """On-demand ``jax.profiler`` device trace capture: records for
+        ``seconds`` (bounded) into a server-side directory and returns
+        the path — the hook a training/inference stack points
+        TensorBoard's profile plugin at.  Shares ONE single-flight
+        guard with ``/debug/profilez``: a host stack-sampling run and a
+        device trace interleaving would attribute each other's
+        overhead."""
+        from filodb_tpu.utils import forensics
+        try:
+            data = forensics.device_profile(
+                seconds=float(p.get("seconds", 2.0)))
+        except forensics.ProfilerBusy as e:
+            return 503, error_response("unavailable", str(e))
+        except forensics.DeviceProfilerUnavailable as e:
+            return 501, error_response("unavailable", str(e))
+        return 200, {"status": "success", "data": data}
+
     @_timed("flightrecorder")
     def _flightrecorder(self, p: dict) -> tuple[int, dict]:
         """The black box on demand: recent structured events (ingest
@@ -761,6 +796,15 @@ class FiloHttpServer:
             storm_window_s=p.get("jit-storm-window-s"))
         if "flight-recorder-size" in p:
             devicewatch.FLIGHT.resize(int(p["flight-recorder-size"]))
+        # kernel flight deck (ISSUE 15): sampling rate, HBM roof, and
+        # regression-sentry tuning are runtime-adjustable — raising the
+        # sample rate during an incident must not require a restart
+        devicewatch.KERNEL_TIMER.configure(
+            sample_1_in=p.get("kernel-sample-1-in"),
+            hbm_roof_bytes_per_s=p.get("hbm-roof-bytes-per-s"),
+            regression_factor=p.get("kernel-regression-factor"),
+            regression_window_s=p.get("kernel-regression-window-s"),
+            baseline_min_samples=p.get("kernel-baseline-min-samples"))
         # workload knobs (ISSUE 5): admission budgets + quota limits are
         # runtime-adjustable across every bound dataset — overload
         # response must not require a restart
@@ -849,6 +893,16 @@ class FiloHttpServer:
                     devicewatch.COMPILE_WATCH.storm_window_s,
                 "flight-recorder-size": devicewatch.FLIGHT.capacity,
                 "devicewatch-enabled": devicewatch.enabled(),
+                "kernel-sample-1-in":
+                    devicewatch.KERNEL_TIMER.sample_1_in,
+                "hbm-roof-bytes-per-s":
+                    devicewatch.KERNEL_TIMER.hbm_roof_bytes_per_s,
+                "kernel-regression-factor":
+                    devicewatch.KERNEL_TIMER.regression_factor,
+                "kernel-regression-window-s":
+                    devicewatch.KERNEL_TIMER.regression_window_s,
+                "kernel-baseline-min-samples":
+                    devicewatch.KERNEL_TIMER.baseline_min_samples,
             }}}
 
     @_timed("workload")
@@ -1149,13 +1203,34 @@ class FiloHttpServer:
                                 # committed/released, on the trace too
                                 sp.tag(hbm_delta_bytes=res.stats
                                        .hbm_resident_delta_bytes)
-                            if qctx.rollup_resolution_ms:
-                                # tiered serving: the tier the router
-                                # chose, on the stats AND the span
-                                res.stats.resolution_ms = \
-                                    qctx.rollup_resolution_ms
+                            if res.stats.device_programs:
+                                # kernel flight deck: the per-program
+                                # device-time split, so a slow-query
+                                # trace names the offending kernel
+                                sp.tag(device_programs=";".join(
+                                    f"{k}={v * 1e3:.3f}ms" for k, v in
+                                    sorted(res.stats
+                                           .device_programs.items())))
+                            if qctx.rollup_resolution_ms \
+                                    or qctx.rollup_routed:
+                                # tiered serving: the router's decision
+                                # (0 = it chose raw) on the span; the
+                                # stats keep reporting only real tiers
+                                if qctx.rollup_resolution_ms:
+                                    res.stats.resolution_ms = \
+                                        qctx.rollup_resolution_ms
                                 sp.tag(resolution_ms=qctx
                                        .rollup_resolution_ms)
+                            rc_c = res.stats.resultcache_cached_samples
+                            rc_r = res.stats \
+                                .resultcache_recomputed_samples
+                            if rc_c or rc_r:
+                                # result cache: hit (all from memoized
+                                # partials) / partial / miss, on the
+                                # span so slowlog shows cache behavior
+                                sp.tag(resultcache="hit" if not rc_r
+                                       else ("partial" if rc_c
+                                             else "miss"))
                     res.stats.add_timing("plan", plan_s)
                     # queue = scheduler wait ONLY (t_submit is stamped
                     # right before submission below): planning and

@@ -13,6 +13,12 @@ injector for the dispatch retry/hedge path: a TCP proxy in front of a
 real data node whose per-connection behavior follows an explicit plan
 (refuse / stall / pass), so tests/test_workload.py can prove bounded
 retry-with-backoff and p99-triggered hedging without flaky sleeps.
+
+ISSUE 15 adds :func:`inject_kernel_slowdown` — a deterministic
+device-time fault for the kernel regression sentry: the named program's
+SAMPLED launches sleep the given delay inside the timed region, so the
+sentry's EWMA sees a real sustained slowdown without depending on
+backend scheduling.
 """
 
 from __future__ import annotations
@@ -26,6 +32,23 @@ import time
 from typing import Optional
 
 from filodb_tpu.integrity import chunk_crc
+
+
+def inject_kernel_slowdown(program: str, seconds: float) -> None:
+    """Deterministically slow one program's SAMPLED device timings: the
+    kernel timer sleeps ``seconds`` inside the timed region of every
+    sampled launch of ``program``, so its EWMA device time rises by
+    exactly that much — the injection the regression-sentry chaos test
+    drives (tests/test_devicewatch.py)."""
+    from filodb_tpu.utils.devicewatch import KERNEL_TIMER
+    KERNEL_TIMER.set_fault_delay(program, seconds)
+
+
+def clear_kernel_slowdown(program: str) -> None:
+    """Lift an injected slowdown; the sentry re-arms once the EWMA
+    decays back under the regression factor."""
+    from filodb_tpu.utils.devicewatch import KERNEL_TIMER
+    KERNEL_TIMER.clear_fault_delay(program)
 
 
 class FaultInjector:

@@ -355,6 +355,19 @@ def _note_hbm(plan: "_GridPlan") -> None:
                         hbm_hist=plan.hbm_comp_hist)
 
 
+def _note_kernel_bytes(prog_fn, plan: "_GridPlan") -> None:
+    """Kernel flight deck (ISSUE 15): attribute the plan's HBM reads to
+    the fused program that actually dispatched — the numerator of the
+    per-program live achieved-bytes/s join on /admin/kernels.  The
+    program name comes off the wrapped callable itself
+    (``devicewatch.jit`` stamps ``_program``), so a rename at the jit
+    declaration can never decouple the bytes/launches join."""
+    program = getattr(prog_fn, "_program", None)
+    if program:
+        devicewatch.KERNEL_TIMER.note_bytes(
+            program, plan.hbm_dense + plan.hbm_comp + plan.hbm_comp_hist)
+
+
 class _GridPlan(NamedTuple):
     """Everything needed to dispatch one fused serving program."""
 
@@ -753,6 +766,9 @@ class DeviceGridCache:
                 plan.ts_parts, plan.val_parts, plan.row0, plan.steps0_rel,
                 garr, plan.phase, q=plan.q, lanes=plan.lane_mult,
                 nrows=plan.nrows, num_groups=num_groups * stride, op=op)
+            _note_kernel_bytes(_fused_progs()["grouped"], plan)
+        else:
+            _note_kernel_bytes(_fused_progs()["grouped_packed"], plan)
         if self.hist:
             both = np.asarray(out, dtype=np.float64)    # [2, G*hb, T]  # host-sync-ok: hist planes [2, G*hb, T] — the one designed readback of the fused reduce
             return hist_state_from_planes(both, num_groups, stride, tops)
@@ -867,6 +883,9 @@ class DeviceGridCache:
                 plan.ts_parts, plan.val_parts, plan.row0, plan.steps0_rel,
                 plan.phase, q=plan.q, lanes=plan.lane_mult,
                 nrows=plan.nrows)
+        _note_kernel_bytes(
+            _fused_progs()["series_packed" if used_packed else "series"],
+            plan)
         out_np = np.asarray(stepped)  # host-sync-ok: the designed stepped readback — only [T, lanes] crosses the host link
         if self.hist:
             # COLUMN-granular indirection: a hist series' device columns

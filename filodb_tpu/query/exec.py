@@ -63,6 +63,11 @@ class ExecContext:
     # corrupt_chunks_excluded — the outermost plan returns last)
     _timings: dict = dataclasses.field(default_factory=dict, repr=False)
     _counters: dict = dataclasses.field(default_factory=dict, repr=False)
+    # per-program measured device seconds from launches the kernel
+    # timer SAMPLED while this ctx was active (ISSUE 15): the split of
+    # the device_compute bucket that names the offending kernel
+    _device_programs: dict = dataclasses.field(default_factory=dict,
+                                               repr=False)
 
     # shards degraded to empty results because their dispatch failed and
     # the query allows partial results (ISSUE 5); folds into
@@ -111,6 +116,14 @@ class ExecContext:
                 # debits frees caused while this query was active
                 c["hbm_delta"] = c.get("hbm_delta", 0) + hbm_delta
 
+    def note_device_program(self, program: str, seconds: float) -> None:
+        """Kernel flight deck (utils/devicewatch.KernelTimer): fold a
+        sampled launch's measured device seconds into this query's
+        per-program split (data.stats.devicePrograms)."""
+        with self._corrupt_lock:
+            d = self._device_programs
+            d[program] = d.get(program, 0.0) + seconds
+
     def note_resultcache(self, cached: int = 0, recomputed: int = 0) -> None:
         """Result-cache accounting (query/resultcache.py): result
         samples served from memoized partials vs samples re-scanned on
@@ -155,6 +168,8 @@ class ExecContext:
             self.note_shard_down(stats.shards_down)
         for k, v in stats.timings.items():
             self.note_timing(k, v)
+        for k, v in stats.device_programs.items():
+            self.note_device_program(k, v)
 
     def fold_into(self, stats: QueryStats) -> None:
         """Write the accumulated per-stage totals into an outgoing
@@ -174,6 +189,7 @@ class ExecContext:
             stats.hbm_resident_delta_bytes = c.get("hbm_delta", 0)
             stats.resultcache_cached_samples = c.get("rc_cached", 0)
             stats.resultcache_recomputed_samples = c.get("rc_recomputed", 0)
+            stats.device_programs = dict(self._device_programs)
             stats.shards_down = self._shards_down
 
 

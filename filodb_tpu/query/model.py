@@ -55,6 +55,11 @@ class QueryContext:
     #   QueryStats + the query.execute span
     resolution_pref: str = ""
     rollup_resolution_ms: int = 0
+    # True when a RollupRouterPlanner actually made a resolution
+    # decision for this query (including "raw"): the HTTP layer tags
+    # the query.execute span with the decision only for routed
+    # datasets, so an un-tiered dataset's spans stay clean (ISSUE 15)
+    rollup_routed: bool = False
 
 
 @dataclasses.dataclass
@@ -100,6 +105,13 @@ class QueryStats:
     # under data.stats.resultCache with stats=true
     resultcache_cached_samples: int = 0
     resultcache_recomputed_samples: int = 0
+    # kernel flight deck (ISSUE 15, utils/devicewatch.KernelTimer):
+    # measured device seconds per wrapped program, from the launches
+    # SAMPLED while this query's ExecContext was active — the
+    # per-program split of the device_compute timing bucket, so a slow
+    # query names its offending kernel (data.stats.devicePrograms +
+    # the query.execute span tag + /admin/slowlog)
+    device_programs: dict = dataclasses.field(default_factory=dict)
 
     def merge(self, other: "QueryStats") -> None:
         self.samples_scanned += other.samples_scanned
@@ -122,6 +134,8 @@ class QueryStats:
         self.resultcache_cached_samples += other.resultcache_cached_samples
         self.resultcache_recomputed_samples += \
             other.resultcache_recomputed_samples
+        for k, v in other.device_programs.items():
+            self.device_programs[k] = self.device_programs.get(k, 0.0) + v
 
     def add_timing(self, stage: str, seconds: float) -> None:
         self.timings[stage] = self.timings.get(stage, 0.0) + seconds

@@ -140,6 +140,12 @@ class RollupRouterPlanner(QueryPlanner):
             return self.raw.materialize(plan, qctx)
         pref = parse_resolution_pref(qctx.resolution_pref)
         limit = resolution_limit_ms(plan, step)
+        # the router IS deciding for this query (even when it decides
+        # "raw"): mark the qctx so the HTTP layer tags the
+        # query.execute span with the decision (ISSUE 15 — previously
+        # only stats=true carried it, so slowlog traces of un-routed
+        # raw serving were indistinguishable from un-tiered datasets)
+        qctx.rollup_routed = True
         res = self._pick_tier(limit, start, pref)
         retention_floor = self._earliest_raw_ms()
         if res is None and retention_floor > start and self.tiers:

@@ -25,7 +25,13 @@ failure classes PRs 6-12 made visible but nothing acted on:
   set is born at 1 and never shows an `increase()` edge) or whose lag
   behind the raw flush watermark grew past the threshold
   (`filodb_rollup_lag_seconds`) — stale tiers silently serve stale
-  long-range dashboards.
+  long-range dashboards;
+- **kernel regressions** (ISSUE 15) — a serving program's sampled
+  EWMA device time sustained above its learned baseline
+  (`filodb_kernel_regressed`, a LEVEL gauge for the same
+  counters-born-at-1 reason) — a half-tripped breaker, shape churn, or
+  a bad pack stride silently degrading the roofline position every
+  query pays for (see `/admin/kernels`).
 """
 
 from __future__ import annotations
@@ -78,6 +84,21 @@ def selfmon_pack(interval: str = "15s", for_: str = "30s",
                  "description": "a program compiled enough distinct "
                                 "shapes to wedge serving; check "
                                 "/admin/device"}},
+            {"alert": "FiloKernelRegression",
+             # the LEVEL gauge (the filodb_ingest_stalled lesson):
+             # the regressions_total counter's label set is born at 1
+             "expr": "filodb_kernel_regressed > 0",
+             "for": for_,
+             "labels": {"severity": "page", "source": "selfmon"},
+             "annotations": {
+                 "summary": "kernel {{ $labels.program }} regressed "
+                            "vs its learned device-time baseline",
+                 "description": "the program's sampled EWMA device "
+                                "time is sustained above the learned "
+                                "baseline; check /admin/kernels for "
+                                "the live roofline position and "
+                                "/admin/device for recompile storms "
+                                "or breaker trips"}},
             {"alert": "FiloReplicaPublishFailing",
              "expr": "increase("
                      "filodb_ingest_replica_publish_failures_total"

@@ -311,6 +311,17 @@ class FiloServer:
         from filodb_tpu.utils import devicewatch
         devicewatch.configure(self.config.get("devicewatch"))
         devicewatch.install_crash_hooks()
+        # kernel flight deck (ISSUE 15): regression-sentry baselines
+        # persist in the metastore KV (ratcheted downward only), so a
+        # restart does not relearn a regressed program's slow state as
+        # its baseline — the persisted healthy floor wins the merge
+        _meta = self.metastore
+        devicewatch.KERNEL_TIMER.attach_baseline_store(
+            load_fn=lambda: {
+                k.split(":", 1)[1]: float(v)
+                for k, v in _meta.list_kv("kernel_baseline:").items()},
+            save_fn=lambda program, seconds: _meta.write_kv(
+                f"kernel_baseline:{program}", repr(float(seconds))))
         # node-wide workload knob: the /execplan refusal floor guards
         # ONE HTTP server, so it lives at the config top level (a
         # per-dataset spelling would silently be last-bound-wins)

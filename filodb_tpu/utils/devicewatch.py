@@ -39,6 +39,26 @@ when.  This module is the device-side counterpart, three pillars:
    auto-dumped to the log on integrity failure or unhandled-exception
    shutdown — the black box for postmortems.
 
+4. **Kernel flight deck** (:class:`KernelTimer`, singleton
+   ``KERNEL_TIMER``; ISSUE 15): sampled *device-time* accounting for
+   every :func:`jit`-wrapped program.  Every launch counts (exactly);
+   every Nth launch per program (``kernel-sample-1-in``, default 64)
+   additionally times ``block_until_ready`` on the result and folds the
+   measured seconds into a per-program EWMA + streaming log-histogram.
+   Joined with the per-plan ``hbm_read_bytes`` notes from devicestore,
+   that yields a LIVE achieved-bytes/s per program against the
+   configured HBM roof (``hbm-roof-bytes-per-s``, default 819e9 — the
+   doc/kernel.md roofline, now measured on real traffic instead of
+   derived offline).  A regression sentry compares each program's EWMA
+   against a learned baseline (seeded after a quiet warmup, ratcheted
+   DOWNWARD only, persisted in the metastore KV by the standalone
+   server): sustained >= 1.5x degradation over a window fires ONE
+   ``kernel.regression`` flight event per episode (re-armed on
+   recovery — the recompile-storm episode discipline), counted in
+   ``filodb_kernel_regressions_total{program}`` and levelled in
+   ``filodb_kernel_regressed{program}`` for the self-monitoring alert
+   rules.  ``/admin/kernels`` joins this ledger with the compile table.
+
 Everything is stdlib + jax-optional: with no jax importable the ledger
 wrapper falls back to identity and the compile wrapper to the plain
 function, so host-only deployments lose nothing.
@@ -46,6 +66,7 @@ function, so host-only deployments lose nothing.
 
 from __future__ import annotations
 
+import bisect
 import functools
 import itertools
 import logging
@@ -107,6 +128,28 @@ def device_metrics() -> dict:
                 "filodb_jit_recompile_storms_total",
                 "recompile storms detected (program exceeded the "
                 "distinct-shape threshold within the window)"),
+            "kernel_launches": REGISTRY.counter(
+                "filodb_kernel_launches_total",
+                "wrapped-program launches (every launch counts; "
+                "reconciles exactly with the /admin/kernels table)"),
+            "kernel_seconds": REGISTRY.counter(
+                "filodb_kernel_device_seconds",
+                "measured device seconds of SAMPLED launches "
+                "(block_until_ready wall time, 1-in-N per program)"),
+            "kernel_roofline": REGISTRY.gauge(
+                "filodb_kernel_roofline_fraction",
+                "live achieved HBM bytes/s per program as a fraction "
+                "of the configured roof (hbm-roof-bytes-per-s)"),
+            "kernel_regressions": REGISTRY.counter(
+                "filodb_kernel_regressions_total",
+                "kernel-regression episodes: sustained EWMA device "
+                "time >= factor x learned baseline"),
+            "kernel_regressed": REGISTRY.gauge(
+                "filodb_kernel_regressed",
+                "1 while the program's EWMA device time counts as "
+                "regressed vs its learned baseline, else 0 — the LEVEL "
+                "the self-monitoring alert rules watch (a counter's "
+                "label set is born at 1, invisible to increase())"),
         }
     return _METRICS
 
@@ -448,6 +491,367 @@ class CompileWatch:
 COMPILE_WATCH = CompileWatch()
 
 
+# ---------------------------------------------------------------------------
+# 2b. Kernel flight deck: sampled device-time ledger + regression sentry
+# ---------------------------------------------------------------------------
+
+# streaming-histogram bucket edges (seconds): powers of two from 1us to
+# ~16s — wide enough for a CPU-interpret kernel, fine enough to tell a
+# 2x regression from noise
+_KHIST_EDGES = tuple(2.0 ** i * 1e-6 for i in range(25))
+
+
+class KernelTimer:
+    """Per-program device-time ledger, sampled (ISSUE 15).
+
+    Every wrapped launch counts (``launches`` advances on each call and
+    reconciles exactly with ``filodb_kernel_launches_total``); every Nth
+    launch per program is *sampled*: the wrapper times
+    ``block_until_ready`` on the result and folds the wall seconds —
+    which on an otherwise-idle device IS the dispatch+device time — into
+    an EWMA, a streaming log-histogram, and the active query's
+    per-program ``devicePrograms`` split.  Launches that compiled are
+    never folded (trace+compile wall time is host work; the runtime
+    compile telemetry above already accounts it).
+
+    The **regression sentry**: once a program has ``baseline_min_samples``
+    sampled launches its baseline seeds from the EWMA and thereafter
+    ratchets DOWNWARD only (a program can only ever prove itself
+    faster).  An EWMA sustained >= ``regression_factor`` x baseline for
+    ``regression_window_s`` opens ONE episode: a ``kernel.regression``
+    flight event, ``filodb_kernel_regressions_total{program}``, and the
+    ``filodb_kernel_regressed{program}`` level flips to 1 until the EWMA
+    recovers below the factor (re-armed — the recompile-storm episode
+    discipline).  Baselines persist through an attached store (the
+    standalone server wires the metastore KV) so a restart does not
+    relearn a regressed program's slow state as its baseline: the
+    persisted (healthy) floor wins.
+
+    Deterministic fault hook: ``set_fault_delay(program, s)`` sleeps
+    inside the sampled timing region — the injection point
+    ``integrity/faultinject.py`` drives for the sentry chaos tests.
+    """
+
+    def __init__(self, sample_1_in: int = 64,
+                 hbm_roof_bytes_per_s: float = 819e9,
+                 regression_factor: float = 1.5,
+                 regression_window_s: float = 30.0,
+                 baseline_min_samples: int = 8,
+                 ewma_alpha: float = 0.25):
+        self.sample_1_in = int(sample_1_in)
+        self.hbm_roof_bytes_per_s = float(hbm_roof_bytes_per_s)
+        self.regression_factor = float(regression_factor)
+        self.regression_window_s = float(regression_window_s)
+        self.baseline_min_samples = int(baseline_min_samples)
+        self.ewma_alpha = float(ewma_alpha)
+        self._lock = threading.Lock()
+        self._rows: dict[str, dict] = {}
+        self._fault: dict[str, float] = {}
+        # baseline persistence hooks (standalone wires the metastore KV)
+        self._baseline_save: Optional[Callable[[str, float], None]] = None
+
+    def configure(self, sample_1_in: Optional[int] = None,
+                  hbm_roof_bytes_per_s: Optional[float] = None,
+                  regression_factor: Optional[float] = None,
+                  regression_window_s: Optional[float] = None,
+                  baseline_min_samples: Optional[int] = None) -> None:
+        with self._lock:
+            if sample_1_in is not None:
+                # 0 disables sampling entirely; 1 = time every launch
+                self.sample_1_in = max(0, int(sample_1_in))
+            if hbm_roof_bytes_per_s is not None:
+                self.hbm_roof_bytes_per_s = max(1.0,
+                                                float(hbm_roof_bytes_per_s))
+            if regression_factor is not None:
+                self.regression_factor = max(1.01,
+                                             float(regression_factor))
+            if regression_window_s is not None:
+                self.regression_window_s = max(0.0,
+                                               float(regression_window_s))
+            if baseline_min_samples is not None:
+                self.baseline_min_samples = max(1,
+                                                int(baseline_min_samples))
+
+    def attach_baseline_store(self, load_fn: Optional[Callable] = None,
+                              save_fn: Optional[Callable] = None) -> None:
+        """Wire baseline persistence: ``load_fn() -> {program: seconds}``
+        merged in now (min wins — a persisted healthy floor beats a
+        freshly-relearned slow state), ``save_fn(program, seconds)``
+        called on seed/ratchet (rate-limited to >=5% improvements)."""
+        stored: dict = {}
+        if load_fn is not None:
+            try:
+                stored = {str(k): float(v)
+                          for k, v in (load_fn() or {}).items()}
+            except Exception:  # noqa: BLE001 — a broken store loses
+                stored = {}   # persistence, never serving
+        with self._lock:
+            self._baseline_save = save_fn
+            for program, sec in stored.items():
+                row = self._row_locked(program)
+                if row["baseline"] is None or sec < row["baseline"]:
+                    row["baseline"] = sec
+                row["persisted_baseline"] = sec
+
+    # ------------------------------------------------------- fault hook
+
+    def set_fault_delay(self, program: str, seconds: float) -> None:
+        with self._lock:
+            self._fault[program] = float(seconds)
+
+    def clear_fault_delay(self, program: str) -> None:
+        with self._lock:
+            self._fault.pop(program, None)
+
+    # ----------------------------------------------------------- ledger
+
+    def _row_locked(self, program: str) -> dict:
+        row = self._rows.get(program)
+        if row is None:
+            row = self._rows[program] = {
+                "launches": 0, "sampled": 0, "seconds": 0.0,
+                "ewma": None, "hist": [0] * (len(_KHIST_EDGES) + 1),
+                "last_key": "", "bytes": 0,
+                "baseline": None, "persisted_baseline": None,
+                "over_since": None, "regressed": False, "episodes": 0,
+            }
+        return row
+
+    def tick(self, program: str) -> bool:
+        """Count one launch; True when this launch should be sampled."""
+        n = self.sample_1_in
+        with self._lock:
+            row = self._row_locked(program)
+            row["launches"] += 1
+            launch = row["launches"]
+        device_metrics()["kernel_launches"].inc(program=program)
+        return n > 0 and (launch - 1) % n == 0
+
+    def note_bytes(self, program: str, nbytes: int) -> None:
+        """Attribute the HBM bytes a serving program read (devicestore's
+        per-plan hbm_read_bytes notes) — the numerator of the live
+        achieved-bytes/s join.  Gated on the kill switch like the
+        wrapper: with devicewatch off, launches freeze, and bytes
+        accumulating against a frozen launch count would permanently
+        inflate achieved-bytes/s after a disable/enable cycle."""
+        if not _ENABLED or nbytes <= 0:
+            return
+        with self._lock:
+            self._row_locked(program)["bytes"] += int(nbytes)
+
+    def sample(self, program: str, out, t0: float,
+               args: tuple = (), kwargs: Optional[dict] = None) -> None:
+        """Time a sampled launch: wait for the result on device, fold
+        the wall seconds since ``t0`` (the pre-dispatch stamp).  Runs
+        OUTSIDE the timer lock — the wait can be milliseconds."""
+        with self._lock:
+            delay = self._fault.get(program)
+        if delay:
+            time.sleep(delay)   # deterministic faultinject slowdown
+        try:
+            import jax
+            # first-leaf probe (outputs are uniformly concrete or
+            # uniformly tracers): a wrapped program invoked inside an
+            # OUTER trace returns tracers — trace time, not device time
+            leaf = out
+            while isinstance(leaf, (tuple, list)) and leaf:
+                leaf = leaf[0]
+            if isinstance(leaf, dict) and leaf:
+                leaf = next(iter(leaf.values()))
+            if isinstance(leaf, jax.core.Tracer):
+                return
+            jax.block_until_ready(out)
+        except Exception:  # noqa: BLE001 — accounting never breaks work
+            return
+        dt = time.perf_counter() - t0
+        # the CHEAP key (shapes + scalars, no dtype formatting): this
+        # runs on the serving path per sample, where the full
+        # descriptive _shape_key (compile-only) costs ~100us
+        self._fold(program, dt, _sampled_key(args, kwargs or {}))
+
+    def _fold(self, program: str, dt: float, shape_key: str) -> None:
+        now = time.monotonic()
+        fired = recovered = False
+        persist = None
+        with self._lock:
+            row = self._row_locked(program)
+            row["sampled"] += 1
+            row["seconds"] += dt
+            prev = row["ewma"]
+            ew = dt if prev is None \
+                else prev + self.ewma_alpha * (dt - prev)
+            row["ewma"] = ew
+            row["hist"][bisect.bisect_left(_KHIST_EDGES, dt)] += 1
+            row["last_key"] = shape_key
+            base = row["baseline"]
+            if base is None:
+                if row["sampled"] >= self.baseline_min_samples:
+                    row["baseline"] = base = ew          # seed
+            elif ew < base and row["sampled"] >= self.baseline_min_samples:
+                # ratchet DOWN — but only once the EWMA has warmed: a
+                # restart resets the EWMA, so the FIRST sample (ew = dt
+                # exactly) of a mixed-shape program could otherwise
+                # ratchet a loaded healthy baseline down to one tiny
+                # query's time and page every normal launch thereafter
+                row["baseline"] = base = ew
+            last = row["persisted_baseline"]
+            if base is not None and (last is None or base < last * 0.95):
+                row["persisted_baseline"] = base
+                persist = base
+            if base is not None:
+                if ew >= self.regression_factor * base:
+                    if row["over_since"] is None:
+                        row["over_since"] = now
+                    elif not row["regressed"] and now - row["over_since"] \
+                            >= self.regression_window_s:
+                        row["regressed"] = True
+                        row["episodes"] += 1
+                        fired = True
+                else:
+                    row["over_since"] = None
+                    if row["regressed"]:
+                        row["regressed"] = False
+                        recovered = True
+            bytes_total = row["bytes"]
+            launches = row["launches"]
+            save = self._baseline_save
+        m = device_metrics()
+        m["kernel_seconds"].inc(dt, program=program)
+        if bytes_total and launches and ew > 0:
+            m["kernel_roofline"].set(
+                (bytes_total / launches) / ew / self.hbm_roof_bytes_per_s,
+                program=program)
+        if persist is not None:
+            # seeding also exports the regressed=0 level row so the
+            # alert rules see the healthy state before any episode.
+            # A fire is impossible here: persist only happens on
+            # seed (base = ew) or ratchet-down (ew < base), and both
+            # contradict ew >= factor * base.
+            m["kernel_regressed"].set(0.0, program=program)
+            if save is not None:
+                try:
+                    save(program, persist)
+                except Exception:  # noqa: BLE001 — persistence is
+                    pass           # best-effort, never serving-fatal
+        if fired:
+            m["kernel_regressions"].inc(program=program)
+            m["kernel_regressed"].set(1.0, program=program)
+            FLIGHT.record("kernel.regression", program=program,
+                          ewma_s=round(ew, 6),
+                          baseline_s=round(base, 6),
+                          factor=self.regression_factor)
+            _LOG.warning(
+                "kernel regression: program %r EWMA device time %.6fs "
+                "is >= %.2fx its learned baseline %.6fs (sustained "
+                "%.1fs) — check /admin/kernels for the roofline "
+                "position and /admin/device for recompile storms",
+                program, ew, self.regression_factor, base,
+                self.regression_window_s)
+        if recovered:
+            m["kernel_regressed"].set(0.0, program=program)
+            FLIGHT.record("kernel.recovery", program=program,
+                          ewma_s=round(ew, 6),
+                          baseline_s=round(base, 6))
+        self._note_query_program(program, dt)
+
+    @staticmethod
+    def _note_query_program(program: str, dt: float) -> None:
+        """Attribute a sampled launch's device seconds to the query
+        running on this thread (QueryStats.devicePrograms split)."""
+        try:
+            from filodb_tpu.query.exec import active_exec_ctx
+            ctx = active_exec_ctx()
+            if ctx is not None:
+                ctx.note_device_program(program, dt)
+        except Exception:  # noqa: BLE001 — accounting never breaks work
+            pass
+
+    # ---------------------------------------------------------- reading
+
+    def table(self) -> list[dict]:
+        """The /admin/kernels ledger rows, most-launched first."""
+        roof = self.hbm_roof_bytes_per_s
+        with self._lock:
+            rows = []
+            for program, r in self._rows.items():
+                ew = r["ewma"]
+                achieved = None
+                if r["bytes"] and r["launches"] and ew:
+                    achieved = (r["bytes"] / r["launches"]) / ew
+                rows.append({
+                    "program": program,
+                    "launches": r["launches"],
+                    "sampled": r["sampled"],
+                    "device_seconds": round(r["seconds"], 6),
+                    "ewma_device_s": round(ew, 6) if ew is not None
+                    else None,
+                    "bytes_total": r["bytes"],
+                    "achieved_bytes_per_s": round(achieved, 1)
+                    if achieved is not None else None,
+                    "roofline_fraction": round(achieved / roof, 6)
+                    if achieved is not None else None,
+                    "baseline_s": round(r["baseline"], 6)
+                    if r["baseline"] is not None else None,
+                    "regressed": r["regressed"],
+                    "episodes": r["episodes"],
+                    "last_shape_key": r["last_key"],
+                    "seconds_histogram": {
+                        ("+Inf" if i == len(_KHIST_EDGES)
+                         else repr(_KHIST_EDGES[i])): n
+                        for i, n in enumerate(r["hist"]) if n},
+                })
+        rows.sort(key=lambda r: -r["launches"])
+        return rows
+
+
+KERNEL_TIMER = KernelTimer()
+
+
+def kernel_summary() -> dict:
+    """The /admin/kernels payload: the sampled device-time ledger joined
+    with the compile table (one row per program carries launches,
+    compiles, EWMA device time, achieved GB/s, roofline %, sentry
+    state)."""
+    compiles = {r["program"]: r for r in COMPILE_WATCH.table()}
+    rows = KERNEL_TIMER.table()
+    for row in rows:
+        c = compiles.get(row["program"])
+        row["compiles"] = c["compiles"] if c else 0
+        row["compile_seconds"] = c["compile_seconds"] if c else 0.0
+        row["storms"] = c["storms"] if c else 0
+    return {
+        "enabled": _ENABLED,
+        "sample_1_in": KERNEL_TIMER.sample_1_in,
+        "hbm_roof_bytes_per_s": KERNEL_TIMER.hbm_roof_bytes_per_s,
+        "regression": {
+            "factor": KERNEL_TIMER.regression_factor,
+            "window_s": KERNEL_TIMER.regression_window_s,
+            "baseline_min_samples": KERNEL_TIMER.baseline_min_samples,
+        },
+        "programs": rows,
+    }
+
+
+def _sampled_key(args: tuple, kwargs: dict) -> str:
+    """Cheap shape key for SAMPLED launches: leaf shapes + small
+    scalars, no dtype formatting — runs on the serving path once per
+    sample, so it must stay in the tens of microseconds (the full
+    descriptive :func:`_shape_key` is compile-only)."""
+    try:
+        from jax import tree_util
+        leaves, _ = tree_util.tree_flatten((args, kwargs))
+        parts = []
+        for leaf in leaves[:32]:
+            shape = getattr(leaf, "shape", None)
+            parts.append(str(shape) if shape is not None
+                         else str(leaf)[:16])
+        if len(leaves) > 32:
+            parts.append(f"...+{len(leaves) - 32}")
+        return ";".join(parts)
+    except Exception:  # noqa: BLE001 — key is best-effort description
+        return "?"
+
+
 def _shape_key(args: tuple, kwargs: dict) -> str:
     """Descriptive abstract-shape key, computed ONLY when a compile was
     detected (never on the cached hot path)."""
@@ -496,14 +900,26 @@ def jit(fn=None, *, program: Optional[str] = None, **jit_kwargs):
         if not _ENABLED:
             return jitted(*a, **kw)
         before = cache_size()
+        sampled = KERNEL_TIMER.tick(name)
         t0 = time.perf_counter()
         out = jitted(*a, **kw)
         if cache_size() > before:
             COMPILE_WATCH.note_compile(name, time.perf_counter() - t0,
                                        _shape_key(a, kw))
+        elif sampled:
+            # never fold a compiling launch: its wall time is host
+            # trace+compile work, already on the compile telemetry —
+            # a cold-start sample would poison the device-time EWMA
+            # (and seed the sentry baseline from compile noise)
+            KERNEL_TIMER.sample(name, out, t0, a, kw)
         return out
 
     wrapper._jitted = jitted   # AOT escape hatch (lower/trace)
+    # the ledger key, readable off the callable: consumers that
+    # attribute bytes to a program (devicestore._note_kernel_bytes)
+    # derive the name from HERE instead of repeating the literal, so a
+    # rename cannot decouple the bytes/launches join
+    wrapper._program = name
     return wrapper
 
 
@@ -618,7 +1034,12 @@ def install_crash_hooks() -> None:
 def configure(conf: Optional[dict] = None) -> None:
     """Apply the standalone ``"devicewatch"`` config block:
     ``{"enabled": bool, "flight-recorder-size": int,
-    "jit-storm-shapes": int, "jit-storm-window-s": float}``."""
+    "jit-storm-shapes": int, "jit-storm-window-s": float,
+    "kernel-sample-1-in": int (0 disables sampling),
+    "hbm-roof-bytes-per-s": float,
+    "kernel-regression-factor": float,
+    "kernel-regression-window-s": float,
+    "kernel-baseline-min-samples": int}``."""
     conf = conf or {}
     if "enabled" in conf:
         from filodb_tpu.core.storeconfig import parse_bool
@@ -628,6 +1049,12 @@ def configure(conf: Optional[dict] = None) -> None:
     COMPILE_WATCH.configure(
         storm_shapes=conf.get("jit-storm-shapes"),
         storm_window_s=conf.get("jit-storm-window-s"))
+    KERNEL_TIMER.configure(
+        sample_1_in=conf.get("kernel-sample-1-in"),
+        hbm_roof_bytes_per_s=conf.get("hbm-roof-bytes-per-s"),
+        regression_factor=conf.get("kernel-regression-factor"),
+        regression_window_s=conf.get("kernel-regression-window-s"),
+        baseline_min_samples=conf.get("kernel-baseline-min-samples"))
 
 
 # ---------------------------------------------------------------------------

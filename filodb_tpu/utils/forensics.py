@@ -176,11 +176,19 @@ TRACE_STORE = TraceStore()
 TRACER.add_reporter(TRACE_STORE.report)
 
 
+# ONE single-flight guard for BOTH profile surfaces (/debug/profilez
+# host stack sampling AND /debug/device_profilez jax device traces): a
+# host sampling run and a device trace capture interleaving would
+# attribute each other's overhead to the profiled workload (ISSUE 15)
 _PROFILE_LOCK = threading.Lock()
 
 
 class ProfilerBusy(RuntimeError):
     """A profile run is already in flight (single-flight guard)."""
+
+
+class DeviceProfilerUnavailable(RuntimeError):
+    """jax's profiler cannot run here (no jax / backend refused)."""
 
 
 def profile(seconds: float = 2.0, sample_interval_s: float = 0.005,
@@ -210,3 +218,81 @@ def profile(seconds: float = 2.0, sample_interval_s: float = 0.005,
               for (f, fn), n in sorted(counts.items(),
                                        key=lambda kv: -kv[1])[:top_k]]
     return {"seconds": seconds, "samples": total, "frames": frames}
+
+
+# how many device trace capture dirs to retain under the trace root:
+# XLA traces of a busy device run tens to hundreds of MB and the host
+# profiler's sibling endpoint writes nothing, so an unbounded capture
+# dir would let a polling script fill the server's disk over a long
+# incident — oldest captures are pruned before each new one
+DEVICE_TRACE_RETAIN = 8
+
+
+def device_profile(seconds: float = 2.0,
+                   trace_root: Optional[str] = None) -> dict:
+    """Capture a ``jax.profiler`` device trace for ``seconds`` into a
+    server-side directory and return its path (the
+    ``/debug/device_profilez`` payload; ISSUE 15) — the exact hook a
+    training/inference stack needs to see what the accelerator actually
+    executed (XLA program timeline, per-op device time), where the host
+    profiler above only sees the Python frames waiting on it.
+
+    Single-flight on the SAME ``_PROFILE_LOCK`` as :func:`profile`:
+    the two captures interleaving would attribute each other's
+    overhead.  The sleep inside the held lock is the design — the lock
+    IS the "one profile at a time" contract, acquired non-blocking so
+    contenders get ``ProfilerBusy`` (HTTP 503) instead of queueing."""
+    import os
+    import tempfile
+    if not _PROFILE_LOCK.acquire(blocking=False):
+        raise ProfilerBusy("a profile run is already in progress")
+    try:
+        seconds = max(0.05, min(float(seconds), 60.0))
+        try:
+            import jax
+            profiler = jax.profiler
+        except Exception as e:  # noqa: BLE001 — host-only deployment
+            raise DeviceProfilerUnavailable(
+                f"jax profiler unavailable: {e}") from e
+        root = trace_root or os.path.join(tempfile.gettempdir(),
+                                          "filodb-device-traces")
+        os.makedirs(root, exist_ok=True)
+        _prune_trace_dirs(root, keep=DEVICE_TRACE_RETAIN - 1)
+        path = tempfile.mkdtemp(
+            prefix=time.strftime("trace-%Y%m%d-%H%M%S-"), dir=root)
+        try:
+            profiler.start_trace(path)
+        except Exception as e:  # noqa: BLE001 — backend refused
+            raise DeviceProfilerUnavailable(
+                f"device trace capture failed to start: {e}") from e
+        try:
+            time.sleep(seconds)
+        finally:
+            try:
+                profiler.stop_trace()
+            except Exception:  # noqa: BLE001 — capture dir still useful
+                pass
+        files = sum(len(fs) for _r, _d, fs in os.walk(path))
+        return {"seconds": seconds, "trace_dir": path, "files": files,
+                "retained": DEVICE_TRACE_RETAIN}
+    finally:
+        _PROFILE_LOCK.release()
+
+
+def _prune_trace_dirs(root: str, keep: int) -> None:
+    """Drop the oldest capture dirs so at most ``keep`` remain (the
+    timestamped ``trace-*`` prefix makes lexical order chronological).
+    Runs under the profile lock, so captures never race the sweep."""
+    import os
+    import shutil
+    try:
+        dirs = sorted(e for e in os.listdir(root)
+                      if e.startswith("trace-")
+                      and os.path.isdir(os.path.join(root, e)))
+    except OSError:
+        return
+    for name in dirs[:max(0, len(dirs) - max(0, keep))]:
+        try:
+            shutil.rmtree(os.path.join(root, name))
+        except OSError:  # noqa: PERF203 — an operator mid-copy wins
+            pass

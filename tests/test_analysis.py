@@ -192,6 +192,14 @@ RULE_CASES = [
      "        ep = self.planner.materialize(plan, qctx)\n"
      "        return ep.execute(ctx)\n",
      "priority", {}),
+    ("kernel-timer-coverage",
+     # the kernel-timer ledger keys on program=; the __name__ fallback
+     # forks the ledger row on any rename (ISSUE 15)
+     "from filodb_tpu.utils import devicewatch\n"
+     "staged = devicewatch.jit(fn)\n",
+     "from filodb_tpu.utils import devicewatch\n"
+     "staged = devicewatch.jit(fn, program='m.stage')\n",
+     "program=", {}),
     ("replica-routing",
      "class MyPlanDispatcher:\n"
      "    def dispatch(self, plan, ctx):\n"
@@ -473,6 +481,53 @@ def test_bounded_cache_accepts_evict_helper_and_module_memos():
            "    return got\n")
     got = _fake(mod, ["bounded-cache"], rel="filodb_tpu/query/fake.py")
     assert got and "module scope" in got[0].message
+
+
+def test_kernel_timer_coverage_unique_across_modules():
+    """Two entry points sharing one program name merge their device-time
+    ledger rows — the duplicate check is whole-program (ISSUE 15)."""
+    a = ("from filodb_tpu.utils import devicewatch\n"
+         "f = devicewatch.jit(fn, program='grid.x')\n")
+    b = ("from filodb_tpu.utils import devicewatch\n"
+         "g = devicewatch.jit(fn2, program='grid.x')\n")
+    got = A.unsuppressed(A.run_sources(
+        {"filodb_tpu/ops/a.py": a, "filodb_tpu/ops/b.py": b},
+        rules=["kernel-timer-coverage"]))
+    assert len(got) == 1 and "duplicate" in got[0].message \
+        and "ops/a.py" in got[0].message
+    got = A.unsuppressed(A.run_sources(
+        {"filodb_tpu/ops/a.py": a,
+         "filodb_tpu/ops/b.py": b.replace("'grid.x'", "'grid.y'")},
+        rules=["kernel-timer-coverage"]))
+    assert got == []
+
+
+def test_kernel_timer_coverage_forms():
+    """Bare decorators and partial() decorators without program=, and
+    computed (non-literal) names, all fire; devicewatch.py itself (the
+    wrapper's home, whose docstring/recursion spell jit bare) is
+    exempt."""
+    bare = ("from filodb_tpu.utils import devicewatch\n"
+            "@devicewatch.jit\n"
+            "def prog(x):\n    return x\n")
+    got = _fake(bare, ["kernel-timer-coverage"])
+    assert got and "program=" in got[0].message
+    partial_bad = ("import functools\n"
+                   "from filodb_tpu.utils import devicewatch\n"
+                   "@functools.partial(devicewatch.jit,\n"
+                   "                   static_argnames=('q',))\n"
+                   "def prog(x, *, q):\n    return x\n")
+    assert _fake(partial_bad, ["kernel-timer-coverage"])
+    partial_ok = partial_bad.replace(
+        "static_argnames=('q',)",
+        "program='ops.prog', static_argnames=('q',)")
+    assert _fake(partial_ok, ["kernel-timer-coverage"]) == []
+    computed = ("from filodb_tpu.utils import devicewatch\n"
+                "f = devicewatch.jit(fn, program='pfx.' + name)\n")
+    got = _fake(computed, ["kernel-timer-coverage"])
+    assert got and "string literal" in got[0].message
+    assert _fake(bare, ["kernel-timer-coverage"],
+                 rel="filodb_tpu/utils/devicewatch.py") == []
 
 
 def test_dangling_guarded_by_annotation_is_an_error():

@@ -26,9 +26,10 @@ from filodb_tpu.core.storeconfig import StoreConfig
 from filodb_tpu.memstore.memstore import TimeSeriesMemStore
 from filodb_tpu.query.logical import RangeFunctionId as F
 from filodb_tpu.utils import devicewatch
-from filodb_tpu.utils.devicewatch import (COMPILE_WATCH, FLIGHT, LEDGER,
+from filodb_tpu.utils.devicewatch import (COMPILE_WATCH, FLIGHT,
+                                          KERNEL_TIMER, LEDGER,
                                           CompileWatch, FlightRecorder,
-                                          device_metrics)
+                                          KernelTimer, device_metrics)
 
 STEP = 60_000
 T0 = 1_700_000_040_000
@@ -222,6 +223,219 @@ class TestCompileWatch:
         finally:
             COMPILE_WATCH.configure(storm_shapes=old[0],
                                     storm_window_s=old[1])
+
+
+# ---------------------------------------------------------------------------
+# kernel flight deck: sampled device-time ledger + regression sentry
+# (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def _kt_row(program):
+    rows = [r for r in KERNEL_TIMER.table() if r["program"] == program]
+    return rows[0] if rows else None
+
+
+@pytest.fixture()
+def kt_config():
+    """Snapshot + restore the process-wide KernelTimer knobs so tests
+    can crank the sample rate / sentry windows without leaking."""
+    kt = KERNEL_TIMER
+    old = (kt.sample_1_in, kt.hbm_roof_bytes_per_s, kt.regression_factor,
+           kt.regression_window_s, kt.baseline_min_samples)
+    yield kt
+    kt.configure(sample_1_in=old[0], hbm_roof_bytes_per_s=old[1],
+                 regression_factor=old[2], regression_window_s=old[3],
+                 baseline_min_samples=old[4])
+
+
+class TestKernelTimer:
+    def test_every_launch_counts_and_1_in_n_samples(self, kt_config):
+        kt_config.configure(sample_1_in=4)
+        prog = "test.kt_count"
+        f = devicewatch.jit(lambda x: x + 1, program=prog)
+        for _ in range(9):
+            f(np.ones(4, np.float32))
+        row = _kt_row(prog)
+        assert row["launches"] == 9
+        # sampled launches are 1, 5, 9; launch 1 compiled (a compiling
+        # launch is host trace time, never folded) -> 2 folded samples
+        assert row["sampled"] == 2
+        assert row["ewma_device_s"] is not None
+        assert row["device_seconds"] > 0
+        assert sum(row["seconds_histogram"].values()) == 2
+        assert device_metrics()["kernel_launches"].value(
+            program=prog) == row["launches"]
+        assert device_metrics()["kernel_seconds"].value(
+            program=prog) == pytest.approx(row["device_seconds"],
+                                           abs=1e-6)
+
+    def test_sample_rate_zero_disables_sampling_not_counting(self,
+                                                             kt_config):
+        kt_config.configure(sample_1_in=0)
+        prog = "test.kt_off"
+        f = devicewatch.jit(lambda x: x * 2, program=prog)
+        for _ in range(5):
+            f(np.ones(4, np.float32))
+        row = _kt_row(prog)
+        assert row["launches"] == 5 and row["sampled"] == 0
+        assert device_metrics()["kernel_launches"].value(program=prog) == 5
+
+    def test_disabled_devicewatch_is_passthrough(self, kt_config):
+        kt_config.configure(sample_1_in=1)
+        prog = "test.kt_killswitch"
+        f = devicewatch.jit(lambda x: x - 1, program=prog)
+        f(np.ones(4, np.float32))          # compile while enabled
+        devicewatch.set_enabled(False)
+        try:
+            f(np.ones(4, np.float32))
+            # bytes notes freeze with the switch too — accumulating
+            # against a frozen launch count would permanently inflate
+            # achieved-bytes/s after a disable/enable cycle
+            KERNEL_TIMER.note_bytes(prog, 4096)
+        finally:
+            devicewatch.set_enabled(True)
+        row = _kt_row(prog)
+        assert row["launches"] == 1   # the disabled launch is
+        # invisible everywhere (same contract as the ledger/compile
+        # wrappers): counting resumes with the switch
+        assert row["bytes_total"] == 0
+
+    def test_bytes_join_yields_roofline_fraction(self, kt_config):
+        kt = KernelTimer(sample_1_in=1, hbm_roof_bytes_per_s=1e9,
+                         baseline_min_samples=100)
+        kt.note_bytes("p", 4_000)
+        kt._fold("p", 0.001, "k")          # 4000 B / launch... but
+        # launches=0 until tick(); note_bytes alone must not divide by 0
+        row = [r for r in kt.table() if r["program"] == "p"][0]
+        assert row["roofline_fraction"] is None
+        assert kt.tick("p")
+        kt._fold("p", 0.001, "k")
+        row = [r for r in kt.table() if r["program"] == "p"][0]
+        # 4000 bytes / 1 launch / ewma(0.001 s) / roof(1e9 B/s)
+        assert row["achieved_bytes_per_s"] == pytest.approx(4e6, rel=0.01)
+        assert row["roofline_fraction"] == pytest.approx(4e-3, rel=0.01)
+
+    def test_baseline_store_merge_and_persist(self, kt_config):
+        saved = {}
+        kt = KernelTimer(sample_1_in=1, baseline_min_samples=2)
+        kt.attach_baseline_store(
+            load_fn=lambda: {"p": 0.001},
+            save_fn=lambda prog, s: saved.__setitem__(prog, s))
+        # learned EWMA above the persisted floor: the floor wins
+        kt._fold("p", 0.004, "k")
+        kt._fold("p", 0.004, "k")
+        row = [r for r in kt.table() if r["program"] == "p"][0]
+        assert row["baseline_s"] == pytest.approx(0.001)
+        # a genuine improvement ratchets down AND persists (>=5% better)
+        for _ in range(40):
+            kt._fold("p", 0.0001, "k")
+        row = [r for r in kt.table() if r["program"] == "p"][0]
+        assert row["baseline_s"] < 0.001
+        # persistence is rate-limited to >=5% improvements, so the
+        # stored floor may lag the live baseline by up to that margin
+        assert saved and saved["p"] == pytest.approx(row["baseline_s"],
+                                                     rel=0.06)
+
+    def test_regression_sentry_episode_lifecycle(self, kt_config):
+        """The ISSUE 15 chaos contract: an injected sustained slowdown
+        fires EXACTLY one kernel.regression episode; recovery re-arms;
+        a second slowdown is a second episode."""
+        from filodb_tpu.integrity.faultinject import (
+            clear_kernel_slowdown, inject_kernel_slowdown)
+        kt_config.configure(sample_1_in=1, baseline_min_samples=4,
+                            regression_window_s=0.1,
+                            regression_factor=1.5)
+        prog = "test.kt_sentry"
+        f = devicewatch.jit(lambda x: x * 3, program=prog)
+        arr = np.ones(8, np.float32)
+        for _ in range(8):
+            f(arr)
+        row = _kt_row(prog)
+        assert row["baseline_s"] is not None and not row["regressed"]
+        m = device_metrics()
+        assert m["kernel_regressions"].value(program=prog) == 0
+        assert m["kernel_regressed"].value(program=prog) == 0.0
+
+        def regression_events():
+            return [e for e in FLIGHT.events(kind="kernel.regression")
+                    if e.get("program") == prog]
+
+        inject_kernel_slowdown(prog, 0.02)
+        try:
+            for _ in range(60):
+                f(arr)
+                if _kt_row(prog)["regressed"]:
+                    break
+            row = _kt_row(prog)
+            assert row["regressed"] and row["episodes"] == 1
+            assert len(regression_events()) == 1
+            assert m["kernel_regressions"].value(program=prog) == 1
+            assert m["kernel_regressed"].value(program=prog) == 1.0
+            # sustained slowness does NOT re-fire within the episode
+            for _ in range(10):
+                f(arr)
+            assert len(regression_events()) == 1
+            assert m["kernel_regressions"].value(program=prog) == 1
+        finally:
+            clear_kernel_slowdown(prog)
+        for _ in range(100):
+            f(arr)
+            if not _kt_row(prog)["regressed"]:
+                break
+        assert not _kt_row(prog)["regressed"]
+        assert m["kernel_regressed"].value(program=prog) == 0.0
+        assert any(e.get("program") == prog
+                   for e in FLIGHT.events(kind="kernel.recovery"))
+        # re-armed: a second slowdown opens a SECOND episode
+        inject_kernel_slowdown(prog, 0.02)
+        try:
+            for _ in range(60):
+                f(arr)
+                if _kt_row(prog)["regressed"]:
+                    break
+            assert _kt_row(prog)["episodes"] == 2
+            assert len(regression_events()) == 2
+        finally:
+            clear_kernel_slowdown(prog)
+        for _ in range(100):
+            f(arr)
+            if not _kt_row(prog)["regressed"]:
+                break
+
+    def test_loaded_baseline_survives_a_cold_fast_sample(self,
+                                                         kt_config):
+        """Review fix: a restart resets the EWMA, so the FIRST sample
+        (ew = dt exactly) of a mixed-shape program must not ratchet a
+        loaded healthy baseline down to one tiny query's time — that
+        floor persists min-wins forever and would page every normal
+        launch as a regression."""
+        saved = {}
+        kt = KernelTimer(sample_1_in=1, baseline_min_samples=4,
+                         regression_window_s=1e9)
+        kt.attach_baseline_store(
+            load_fn=lambda: {"p": 0.002},
+            save_fn=lambda prog, s: saved.__setitem__(prog, s))
+        kt._fold("p", 0.0003, "k")         # one cold tiny-shape sample
+        row = [r for r in kt.table() if r["program"] == "p"][0]
+        assert row["baseline_s"] == pytest.approx(0.002)
+        assert not saved
+        # a WARMED sustained improvement still ratchets
+        for _ in range(10):
+            kt._fold("p", 0.0003, "k")
+        row = [r for r in kt.table() if r["program"] == "p"][0]
+        assert row["baseline_s"] < 0.002
+
+    def test_baseline_never_ratchets_up(self, kt_config):
+        kt = KernelTimer(sample_1_in=1, baseline_min_samples=2,
+                         regression_window_s=1e9)
+        kt._fold("p", 0.001, "k")
+        kt._fold("p", 0.001, "k")
+        base = [r for r in kt.table() if r["program"] == "p"][0]
+        for _ in range(20):
+            kt._fold("p", 0.01, "k")       # sustained slow
+        after = [r for r in kt.table() if r["program"] == "p"][0]
+        assert after["baseline_s"] == base["baseline_s"]
 
 
 # ---------------------------------------------------------------------------
@@ -542,3 +756,188 @@ class TestEndpoints:
         code, _body = _get_json(port, "/admin/config",
                                 **{"slow-query-threshold-s": "-1"})
         assert code == 400
+
+
+# ---------------------------------------------------------------------------
+# kernel flight deck over HTTP: /admin/kernels, stats devicePrograms,
+# /debug/device_profilez (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+class TestKernelDeckEndpoints:
+    def _warm(self, port, stats="true"):
+        code, body = _get_json(
+            port, "/promql/dw_http/api/v1/query_range",
+            query='sum(rate(req_total{_ws_="w",_ns_="n"}[5m]))',
+            start=str((T0 + (K - 1) * STEP) // 1000),
+            end=str((T0 + 45 * STEP) // 1000), step="60s", stats=stats)
+        assert code == 200 and body["data"]["result"]
+        return body
+
+    def test_device_programs_reconcile_with_device_compute(
+            self, server, kt_config):
+        """ISSUE 15 acceptance: on a sampled query the per-program
+        devicePrograms seconds sum to (at most, within tolerance) the
+        device_compute stage bucket that wraps the same launches."""
+        port, _ms = server
+        kt_config.configure(sample_1_in=1)
+        self._warm(port)                       # compiles never fold
+        body = self._warm(port)
+        stats = body["data"]["stats"]
+        dp = stats["devicePrograms"]
+        assert dp, "sampled query carried no devicePrograms split"
+        assert all(v >= 0 for v in dp.values())
+        total = sum(dp.values())
+        assert total > 0
+        # the sampled block_until_ready waits run INSIDE the
+        # device_compute wall-time window; tolerance covers the
+        # perf_counter stamps around the wrapper
+        assert total <= stats["timings"]["device_compute"] + 0.005
+
+    def test_admin_kernels_joins_and_reconciles_exactly(self, server,
+                                                        kt_config):
+        port, _ms = server
+        kt_config.configure(sample_1_in=1)
+        self._warm(port)
+        self._warm(port)
+        code, body = _get_json(port, "/admin/kernels")
+        assert code == 200
+        data = body["data"]
+        assert data["sample_1_in"] == 1
+        assert data["hbm_roof_bytes_per_s"] > 0
+        rows = {r["program"]: r for r in data["programs"]}
+        # a devicestore program THIS test's 1-in-1 queries sampled
+        # (earlier tests at the default rate leave bytes-only rows)
+        served = [r for p, r in rows.items()
+                  if p.startswith("devicestore.") and r["bytes_total"]
+                  and r["ewma_device_s"] is not None]
+        assert served, f"no sampled devicestore program: {sorted(rows)}"
+        row = served[0]
+        # the compile-table join and the live roofline position
+        assert row["compiles"] >= 1
+        assert row["ewma_device_s"] is not None
+        assert row["roofline_fraction"] is not None \
+            and row["roofline_fraction"] > 0
+        # launches x sample-rate reconciliation, EXACT: the table's
+        # launch count is counted on every launch, as is the counter
+        m = device_metrics()["kernel_launches"]
+        for program, r in rows.items():
+            assert m.value(program=program) == r["launches"], program
+
+    def test_roofline_degrades_and_row_flags_regression(self, server,
+                                                        kt_config):
+        """ISSUE 15 acceptance: an injected slowdown on the serving
+        program degrades its /admin/kernels roofline fraction and flips
+        the row's sentry state."""
+        from filodb_tpu.integrity.faultinject import (
+            clear_kernel_slowdown, inject_kernel_slowdown)
+        port, _ms = server
+        kt_config.configure(sample_1_in=1, baseline_min_samples=2,
+                            regression_window_s=0.05,
+                            regression_factor=1.5)
+        launches0 = {r["program"]: r["launches"]
+                     for r in KERNEL_TIMER.table()}
+        for _ in range(4):
+            self._warm(port)
+        code, body = _get_json(port, "/admin/kernels")
+        rows = {r["program"]: r for r in body["data"]["programs"]}
+        # the program THIS query actually launches (in a full-suite run
+        # other devicestore programs carry history but never launch
+        # here, so slowing them would never sample)
+        prog, before = next(
+            (p, r) for p, r in rows.items()
+            if p.startswith("devicestore.") and r["roofline_fraction"]
+            and r["launches"] > launches0.get(p, 0))
+        inject_kernel_slowdown(prog, 0.01)
+        try:
+            for _ in range(30):
+                self._warm(port, stats="false")
+                if _kt_row(prog)["regressed"]:
+                    break
+        finally:
+            clear_kernel_slowdown(prog)
+        code, body = _get_json(port, "/admin/kernels")
+        row = {r["program"]: r
+               for r in body["data"]["programs"]}[prog]
+        assert row["regressed"] and row["episodes"] >= 1
+        assert row["roofline_fraction"] < before["roofline_fraction"]
+        # recover so the shared timer leaves the fixture healthy
+        for _ in range(100):
+            self._warm(port, stats="false")
+            if not _kt_row(prog)["regressed"]:
+                break
+        assert not _kt_row(prog)["regressed"]
+
+    def test_device_profilez_captures_and_shares_single_flight(self,
+                                                               server):
+        import os
+        port, _ms = server
+        code, body = _get_json(port, "/debug/device_profilez",
+                               seconds="0.05")
+        assert code == 200, body
+        data = body["data"]
+        assert os.path.isdir(data["trace_dir"])
+        assert data["files"] >= 1, "trace capture produced no files"
+        # ONE single-flight guard across BOTH profile surfaces: with
+        # the lock held, host and device profiling each answer 503
+        from filodb_tpu.utils import forensics
+        assert forensics._PROFILE_LOCK.acquire(blocking=False)
+        try:
+            code, _b = _get_json(port, "/debug/profilez", seconds="0.05")
+            assert code == 503
+            code, _b = _get_json(port, "/debug/device_profilez",
+                                 seconds="0.05")
+            assert code == 503
+        finally:
+            forensics._PROFILE_LOCK.release()
+
+    def test_device_trace_dirs_are_retention_bounded(self, tmp_path):
+        """Review fix: repeated captures must not fill the disk — at
+        most DEVICE_TRACE_RETAIN capture dirs survive, oldest pruned."""
+        import os
+        from filodb_tpu.utils import forensics
+        old = forensics.DEVICE_TRACE_RETAIN
+        forensics.DEVICE_TRACE_RETAIN = 2
+        try:
+            for _ in range(4):
+                got = forensics.device_profile(seconds=0.05,
+                                               trace_root=str(tmp_path))
+            assert got["retained"] == 2
+            dirs = [e for e in os.listdir(tmp_path)
+                    if e.startswith("trace-")]
+            assert len(dirs) == 2, sorted(dirs)
+            # the newest capture always survives its own prune
+            assert os.path.basename(got["trace_dir"]) in dirs
+        finally:
+            forensics.DEVICE_TRACE_RETAIN = old
+
+    def test_admin_config_kernel_knobs(self, server, kt_config):
+        port, _ms = server
+        code, body = _get_json(port, "/admin/config")
+        assert code == 200
+        obs = body["data"]["observability"]
+        assert "kernel-sample-1-in" in obs
+        assert "hbm-roof-bytes-per-s" in obs
+        code, body = _post_json(port, "/admin/config",
+                                **{"kernel-sample-1-in": "8",
+                                   "hbm-roof-bytes-per-s": "1e9",
+                                   "kernel-regression-factor": "2.0",
+                                   "kernel-baseline-min-samples": "5"})
+        assert code == 200
+        obs = body["data"]["observability"]
+        assert obs["kernel-sample-1-in"] == 8
+        assert obs["hbm-roof-bytes-per-s"] == 1e9
+        assert obs["kernel-regression-factor"] == 2.0
+        assert obs["kernel-baseline-min-samples"] == 5
+        assert KERNEL_TIMER.sample_1_in == 8
+
+    def test_metrics_exposition_has_kernel_families(self, server,
+                                                    kt_config):
+        port, _ms = server
+        kt_config.configure(sample_1_in=1)
+        self._warm(port)
+        code, text = _get_text(port, "/metrics")
+        assert code == 200
+        assert "filodb_kernel_launches_total{" in text
+        assert "filodb_kernel_device_seconds{" in text
+        assert "filodb_kernel_roofline_fraction{" in text

@@ -96,6 +96,42 @@ def cluster():
     qsched = QueryScheduler(num_workers=2, name="e2e-query")
     srv_a.bind_dataset(DatasetBinding("prom", stores["node-a"], planner_a,
                                       scheduler=qsched))
+
+    # ISSUE 15 satellite: a LOCAL-only dataset whose planner stack is
+    # result-cache BELOW a (tier-less) rollup router — the standalone
+    # composition — so the query.execute span must carry the router's
+    # resolution decision (raw => "0") and the cache's hit/miss/partial
+    # outcome.  All shards local (remote plans bypass the cache) and
+    # chunks flushed (open segments are never memoized).
+    from filodb_tpu.query.resultcache import (ResultCache,
+                                              ResultCachingPlanner)
+    from filodb_tpu.rollup.planner import RollupRouterPlanner
+    ms_local = TimeSeriesMemStore()
+    mapper_l = ShardMapper(1)
+    mapper_l.register_node([0], "node-a")
+    mapper_l.update_status(0, ShardStatus.ACTIVE)
+    shard_l = ms_local.setup("proml", DEFAULT_SCHEMAS, 0)
+    bl = RecordBuilder(DEFAULT_SCHEMAS["prom-counter"])
+    for i in range(4):
+        tags = {"__name__": "local_total", "instance": f"i{i}",
+                "_ws_": "demo", "_ns_": "App-0"}
+        vals = np.cumsum(rng.random(300))
+        for t, v in zip(BASE + np.arange(300) * STEP, vals):
+            bl.add(int(t), [float(v)], tags)
+    for off, c in enumerate(bl.containers()):
+        shard_l.ingest(decode_container(c, DEFAULT_SCHEMAS), off)
+    shard_l.flush_all()
+    cache_l = ResultCache("proml", enabled=True, max_bytes=32 << 20)
+    planner_l = ResultCachingPlanner(
+        "proml",
+        SingleClusterPlanner("proml", mapper_l, DatasetOptions(),
+                             spread_default=0),
+        ms_local, cache_l, segment_ms=120_000,
+        routing_token_fn=mapper_l.routing_token)
+    planner_l = RollupRouterPlanner("proml", planner_l, {},
+                                    rolled_through_fn=lambda r: 0)
+    srv_a.bind_dataset(DatasetBinding("proml", ms_local, planner_l,
+                                      resultcache=cache_l))
     port_a = srv_a.start()
     yield {"port_a": port_a, "port_b": port_b,
            "remote_shard": shards_b[0], "endpoints": endpoints}
@@ -220,6 +256,64 @@ class TestStitchedTrace:
         # full stats travel on the wire too
         assert "timings" in out["stats"]
         assert out["stats"]["timings"].get("scan", 0) > 0
+
+
+class TestSpanTagSatellites:
+    """ISSUE 15 satellite: PRs 16-17 surfaced the rollup resolution and
+    the result-cache outcome only under stats=true — the query.execute
+    span (and therefore every /admin/slowlog entry) must carry them
+    too."""
+
+    def _local_query(self, cluster, query):
+        return _get(cluster["port_a"], "/promql/proml/api/v1/query_range",
+                    query=query, start=(BASE + 600_000) / 1000,
+                    end=(BASE + 1_800_000) / 1000, step="30s",
+                    stats="true")
+
+    def _exec_tags(self, cluster, trace_id):
+        code, tbody, _ = _get(cluster["port_a"],
+                              f"/admin/traces/{trace_id}")
+        assert code == 200
+        flat = _flatten(tbody["data"]["spans"])
+        ex = [n for n in flat if n["name"] == "query.execute"]
+        assert ex, [n["name"] for n in flat]
+        return ex[0]["tags"]
+
+    def test_resolution_decision_tagged_even_for_raw(self, cluster):
+        code, body, _ = self._local_query(
+            cluster,
+            'sum(rate(local_total{_ws_="demo",_ns_="App-0"}[2m]))')
+        assert code == 200
+        tags = self._exec_tags(cluster, body["data"]["stats"]["traceId"])
+        # the router decided RAW: previously only stats=true could say
+        # so; now the span names the decision (0 = raw)
+        assert tags.get("resolution_ms") == "0", tags
+
+    def test_resultcache_outcome_tagged(self, cluster):
+        q = ('sum(rate(local_total{_ws_="demo",_ns_="App-0",'
+             'instance!="zz"}[2m]))')
+        # sight 1: doorkeeper only — the cache made no hit/miss
+        # decision, so the span stays untagged
+        code, body1, _ = self._local_query(cluster, q)
+        assert code == 200
+        tags1 = self._exec_tags(cluster,
+                                body1["data"]["stats"]["traceId"])
+        assert "resultcache" not in tags1, tags1
+        # sight 2: split + store — everything recomputed => miss
+        code, body2, _ = self._local_query(cluster, q)
+        tags2 = self._exec_tags(cluster,
+                                body2["data"]["stats"]["traceId"])
+        assert tags2.get("resultcache") == "miss", tags2
+        # sight 3: interior segments replay from the cache
+        code, body3, _ = self._local_query(cluster, q)
+        tags3 = self._exec_tags(cluster,
+                                body3["data"]["stats"]["traceId"])
+        assert tags3.get("resultcache") in ("hit", "partial"), tags3
+        # the tag agrees with the stats=true split
+        rc = body3["data"]["stats"]["resultCache"]
+        assert rc["cachedSamples"] > 0
+        if tags3["resultcache"] == "hit":
+            assert rc["recomputedSamples"] == 0
 
 
 class TestForensicsEndpoints:
