@@ -659,6 +659,109 @@ def main():
         log(f"FAIL: warm re-scan {warm_scanned} samples is not >=10x "
             f"below the cold scan {cold_scanned}")
         return 1
+
+    # cold-tier guard (ISSUE 16, filodb_tpu/coldstore): a flushed
+    # dataset is re-opened per iteration (recover_index + ODP page-in
+    # of every chunk) against the bare DiskColumnStore vs the SAME
+    # store wrapped in TieredColumnStore over an EMPTY bucket — the
+    # steady state before anything ages out.  The wrapper's extra
+    # bucket probe + two-tier merge must be free when there are no
+    # cold misses: <=3% / 0.5 ms, interleaved A/B, paired-delta.
+    import tempfile
+    from filodb_tpu.coldstore import (ColdChunkStore, LocalFSBucket,
+                                      TieredColumnStore)
+    from filodb_tpu.core.storeconfig import StoreConfig
+    from filodb_tpu.store.persistence import DiskColumnStore, DiskMetaStore
+
+    tmp = tempfile.mkdtemp(prefix="filodb-bench-cold-")
+    disk = DiskColumnStore(os.path.join(tmp, "chunks.db"))
+    meta_store = DiskMetaStore(os.path.join(tmp, "meta.db"))
+    cms = TimeSeriesMemStore(disk, meta_store)
+    csh = cms.setup("cold", DEFAULT_SCHEMAS, 0, StoreConfig())
+    cb = RecordBuilder(DEFAULT_SCHEMAS["gauge"], DatasetOptions())
+    cts = BASE + np.arange(240, dtype=np.int64) * STEP
+    for i in range(32):
+        cb.add_series(cts, [rng.random(240) + i],
+                      {"_metric_": "cold_g", "inst": f"i{i}",
+                       "_ws_": "demo", "_ns_": "App-0"})
+    for off, c in enumerate(cb.containers()):
+        csh.ingest_container(c, off)
+    csh.flush_all(ingestion_time=1000)
+    tiered = TieredColumnStore(
+        disk, ColdChunkStore(LocalFSBucket(os.path.join(tmp, "bucket"))))
+    cold_mapper = ShardMapper(1)
+    cold_mapper.register_node(range(1), "local")
+    cold_mapper.update_status(0, ShardStatus.ACTIVE)
+    cold_planner = SingleClusterPlanner("cold", cold_mapper,
+                                        DatasetOptions(), spread_default=0)
+    cq = 'cold_g{_ws_="demo",_ns_="App-0"}'
+    c_start, c_end = int(cts[0]), int(cts[-1])
+
+    def once_cold(colstore):
+        fresh = TimeSeriesMemStore(colstore, meta_store)
+        fresh.setup("cold", DEFAULT_SCHEMAS, 0, StoreConfig())
+        fresh.recover_index("cold", 0)
+        lp = query_range_to_logical_plan(cq, c_start, STEP, c_end)
+        qctx = QueryContext(submit_time_ms=int(time.time() * 1000))
+        ep = cold_planner.materialize(lp, qctx)
+        return ep.execute(ExecContext(fresh, qctx))
+
+    assert to_prom_matrix(once_cold(disk))["data"]["result"], \
+        "cold-tier bench query returned nothing"
+    once_cold(tiered)                          # warm sqlite page cache
+    lat_loc, lat_tier = [], []
+    for _ in range(min(ITERS, 30)):
+        t0 = time.perf_counter()
+        once_cold(disk)
+        lat_loc.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        once_cold(tiered)
+        lat_tier.append(time.perf_counter() - t0)
+    med_loc = statistics.median(lat_loc)
+    med_tier = statistics.median(lat_tier)
+    ct_delta = statistics.median(
+        t - l for t, l in zip(lat_tier, lat_loc))
+    ct_overhead = ct_delta / med_loc
+    log(f"cold tier hot path: local {med_loc * 1e3:.2f} ms  "
+        f"tiered {med_tier * 1e3:.2f} ms  paired delta "
+        f"{ct_delta * 1e6:+.0f} us ({ct_overhead * 100:+.2f}%)")
+    emit("coldtier_hot_path_overhead_median", ct_overhead * 100, "%",
+         local_ms=round(med_loc * 1e3, 3),
+         tiered_ms=round(med_tier * 1e3, 3),
+         paired_delta_us=round(ct_delta * 1e6, 1))
+    if ct_overhead > 0.03 and ct_delta > 5e-4:
+        log(f"FAIL: cold-tier hot-path overhead "
+            f"{ct_overhead * 100:.2f}% exceeds the 3% budget")
+        return 1
+
+    # year-long panel (ISSUE 16 acceptance): 1 series x 1y @30s through
+    # the M4 ?downsample=4096 mapper — a 4k panel gets <= 4*4096
+    # pixel-exact points, >=50x fewer samples on the wire; exits
+    # nonzero below the bar.
+    from filodb_tpu.ops.windows import StepRange
+    from filodb_tpu.query.model import PeriodicBatch
+    from filodb_tpu.query.transformers import DownsampleMapper
+    year_t = 365 * 24 * 3600 // 30             # 1,051,200 samples
+    yvals = rng.normal(10, 3, (1, year_t))
+    yvals[:, ::97] = np.nan                    # sprinkle gaps
+    yb = PeriodicBatch([{"inst": "i0"}],
+                       StepRange(BASE, BASE + (year_t - 1) * 30_000,
+                                 30_000), yvals)
+    t0 = time.perf_counter()
+    [yout] = DownsampleMapper(pixels=4096).apply([yb], None)
+    m4_ms = (time.perf_counter() - t0) * 1e3
+    pts_in = int(np.isfinite(yvals).sum())
+    pts_out = int(np.isfinite(yout.np_values()).sum())
+    reduction = pts_in / max(pts_out, 1)
+    log(f"m4 year panel: {pts_in} -> {pts_out} points "
+        f"({reduction:.0f}x) in {m4_ms:.1f} ms")
+    emit("m4_year_panel_reduction", reduction, "x",
+         points_in=pts_in, points_out=pts_out, pixels=4096,
+         mapper_ms=round(m4_ms, 1))
+    if pts_out > 4 * 4096 or reduction < 50:
+        log(f"FAIL: m4 year panel kept {pts_out} points "
+            f"({reduction:.0f}x) — below the 50x bar")
+        return 1
     return 0
 
 

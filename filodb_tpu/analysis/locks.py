@@ -449,6 +449,8 @@ def direct_blocking(call) -> Optional[str]:
         return "blocking queue get()"
     if name == "block_until_ready":
         return "block_until_ready() waits on the device"
+    if name in ("get_object", "put_object"):
+        return f"{name}() does cold-bucket I/O"
     if name == "device_put":
         return "device_put() is a host->device transfer (may compile)"
     return None

@@ -288,6 +288,30 @@ def deadline_threading(module):
             continue
         fname = node.func.attr if isinstance(node.func, ast.Attribute) \
             else node.func.id
+        if fname == "get_object" \
+                and not module.rel.endswith("coldstore/bucket.py"):
+            # cold-bucket fetches (ISSUE 16): every call-site outside
+            # the bucket implementations must bound the fetch with a
+            # timeout derived from the remaining query/admin budget —
+            # an unbounded (or constant) timeout lets one stalled
+            # bucket pin a query worker past its deadline
+            to_kw = next((k for k in node.keywords
+                          if k.arg == "timeout_s"), None)
+            if to_kw is None:
+                findings.append(Finding(
+                    "deadline-threading", module.rel, node.lineno,
+                    "cold-bucket get_object without timeout_s= — a "
+                    "stalled bucket would pin the worker forever "
+                    "(doc/coldstore.md)"))
+                continue
+            refs = {n.lower() for n in names_in(to_kw.value)}
+            if not any(dn in r for dn in _DEADLINE_NAMES for r in refs):
+                findings.append(Finding(
+                    "deadline-threading", module.rel, node.lineno,
+                    "cold-bucket get_object whose timeout_s does not "
+                    "thread the deadline — derive it from the remaining "
+                    "budget (workload/deadline.py budget_timeout_s)"))
+            continue
         if fname != "urlopen":
             continue
         timeout_kw = next((k for k in node.keywords

@@ -297,20 +297,67 @@ def cmd_importcsv(args) -> int:
     return 0
 
 
+def _open_tier_store(args):
+    """The store a tier-aware offline command scans: local sqlite
+    (default) or the cold object bucket (doc/coldstore.md)."""
+    if getattr(args, "tier", "local") == "cold":
+        from filodb_tpu.coldstore import ColdChunkStore, LocalFSBucket
+        bucket_dir = getattr(args, "bucket_dir", None) \
+            or f"{args.data_dir}/coldstore"
+        return ColdChunkStore(LocalFSBucket(bucket_dir))
+    from filodb_tpu.store.persistence import DiskColumnStore
+    return DiskColumnStore(f"{args.data_dir}/chunks.db")
+
+
 def cmd_verify_chunks(args) -> int:
     """Offline integrity scan: recompute every persisted chunk's CRC32C
     against its stored checksum (and with --deep, decode every vector)
-    and report per-shard pass/fail counts (doc/integrity.md).  Exits 1
-    when any chunk fails."""
+    and report per-shard pass/fail counts (doc/integrity.md).  With
+    ``--tier=cold`` the same scan runs over the object bucket — every
+    object fetched and CRC-checked against its key (doc/coldstore.md).
+    Exits 1 when any chunk fails."""
     from filodb_tpu.integrity.scan import verify_chunks
-    from filodb_tpu.store.persistence import DiskColumnStore
 
-    store = DiskColumnStore(f"{args.data_dir}/chunks.db")
+    store = _open_tier_store(args)
     shards = [int(s) for s in args.shards.split(",")] if args.shards \
         else None
     report = verify_chunks(store, args.dataset, shards, deep=args.deep)
     print(json.dumps(report, indent=2))
     return 1 if report["total_failed"] else 0
+
+
+def cmd_age_out(args) -> int:
+    """Offline cold-tier migration pass (doc/coldstore.md): move every
+    local chunk row wholly older than ``--retention`` into the object
+    bucket (upload, read-back CRC verify, then delete locally) and
+    advance the per-shard watermarks.  ``--dry-run`` prints the plan —
+    chunk/byte counts per shard — and moves nothing."""
+    from filodb_tpu.coldstore import (AgeOutManager, ColdChunkStore,
+                                      LocalFSBucket)
+    from filodb_tpu.http.model import parse_duration_ms
+    from filodb_tpu.store.persistence import DiskColumnStore, DiskMetaStore
+
+    local = DiskColumnStore(f"{args.data_dir}/chunks.db")
+    meta = DiskMetaStore(f"{args.data_dir}/meta.db")
+    meta.initialize()
+    bucket_dir = args.bucket_dir or f"{args.data_dir}/coldstore"
+    cold = ColdChunkStore(LocalFSBucket(bucket_dir))
+    mgr = AgeOutManager(local, cold, metastore=meta)
+    retention_ms = parse_duration_ms(args.retention)
+    shards = [int(s) for s in args.shards.split(",")] if args.shards \
+        else None
+    try:
+        if args.dry_run:
+            report = mgr.plan(args.dataset, retention_ms, shards)
+        else:
+            report = mgr.run(args.dataset, retention_ms, shards)
+    finally:
+        local.shutdown()
+        cold.shutdown()
+        meta.shutdown()
+    report["dry_run"] = bool(args.dry_run)
+    print(json.dumps(report, indent=2))
+    return 0
 
 
 def cmd_rules_check(args) -> int:
@@ -527,7 +574,29 @@ def build_parser() -> argparse.ArgumentParser:
                     help="comma-separated shard list (default: all)")
     vc.add_argument("--deep", action="store_true",
                     help="also decode every vector, not just checksums")
+    vc.add_argument("--tier", choices=("local", "cold"), default="local",
+                    help="which storage tier to scan (cold = the "
+                         "object bucket, doc/coldstore.md)")
+    vc.add_argument("--bucket-dir", default=None,
+                    help="cold bucket root (default: "
+                         "{data-dir}/coldstore)")
     vc.set_defaults(fn=cmd_verify_chunks)
+
+    ao = sub.add_parser("age-out",
+                        help="move chunks older than the retention "
+                             "cutoff into the cold object bucket")
+    ao.add_argument("--data-dir", required=True)
+    ao.add_argument("--dataset", required=True)
+    ao.add_argument("--retention", required=True,
+                    help="age cutoff as a duration, e.g. 30d")
+    ao.add_argument("--bucket-dir", default=None,
+                    help="cold bucket root (default: "
+                         "{data-dir}/coldstore)")
+    ao.add_argument("--shards", default=None,
+                    help="comma-separated shard list (default: all)")
+    ao.add_argument("--dry-run", action="store_true",
+                    help="print the migration plan, move nothing")
+    ao.set_defaults(fn=cmd_age_out)
 
     lt = sub.add_parser("lint", add_help=False,
                         help="filolint static analysis: lock-discipline "

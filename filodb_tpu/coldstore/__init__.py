@@ -1,0 +1,32 @@
+"""Cold tier: object-store chunk archive beneath the local column store.
+
+Capability match for the reference's Cassandra ChunkSource layer
+(PAPER.md layer map: months of history served from a distributed store
+beneath the memstore) rebuilt the way modern TSDBs do it — an S3-shaped
+object bucket (get/put/list/delete) holding immutable chunk objects,
+fronted by the existing local DiskColumnStore as the warm tier:
+
+* :mod:`filodb_tpu.coldstore.bucket` — the ``ObjectBucket`` interface
+  and the local-FS implementation (``LocalFSBucket``), plus the fault
+  hooks chaos tests drive (stall injection, byte truncation).
+* :mod:`filodb_tpu.coldstore.store` — ``ColdChunkStore``, a
+  :class:`~filodb_tpu.store.columnstore.ColumnStore` over a bucket
+  (CRC verified on EVERY fetch, quarantine intact, deadline-derived
+  fetch timeouts), and ``TieredColumnStore`` which merges
+  local-then-cold transparently so ODP and the rollup engine never
+  know which tier served a chunk.
+* :mod:`filodb_tpu.coldstore.ageout` — the retention policy: rows past
+  the retention floor move local → bucket (upload, read-back verify,
+  THEN delete), with a persisted per-shard watermark the resolution
+  router reads as the rolled-local/rolled-cold stitch boundary.
+"""
+
+from filodb_tpu.coldstore.bucket import (BucketTimeout, LocalFSBucket,
+                                         ObjectBucket, ObjectMissing)
+from filodb_tpu.coldstore.store import ColdChunkStore, TieredColumnStore
+from filodb_tpu.coldstore.ageout import AgeOutManager
+
+__all__ = [
+    "ObjectBucket", "LocalFSBucket", "BucketTimeout", "ObjectMissing",
+    "ColdChunkStore", "TieredColumnStore", "AgeOutManager",
+]

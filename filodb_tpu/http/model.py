@@ -165,6 +165,22 @@ def stats_payload(stats, trace_id: str = "") -> dict:
         # tiered-resolution serving (doc/rollup.md): the coarsest rolled
         # tier that served (part of) this query; 0 = raw only
         "resolutionMs": int(getattr(stats, "resolution_ms", 0)),
+        # storage tiers the stitched plan actually materialized legs
+        # for, oldest first ("rolled-cold+rolled-local+raw"); '' when
+        # the dataset has no router (doc/coldstore.md)
+        "tiers": str(getattr(stats, "tiers", "")),
+        # cold tier (doc/coldstore.md): chunks/bytes paged back from
+        # the object bucket for this query; 0/0 = cold-miss-free
+        "coldTier": {
+            "chunksPaged": int(getattr(stats, "cold_chunks_paged", 0)),
+            "bytesRead": int(getattr(stats, "cold_bytes_read", 0)),
+        },
+        # ?downsample=<pixels> M4 decimation: finite points entering
+        # the mapper vs pixel-exact points kept (<= ~4x pixels/series)
+        "downsample": {
+            "pointsIn": int(getattr(stats, "downsample_points_in", 0)),
+            "pointsOut": int(getattr(stats, "downsample_points_out", 0)),
+        },
         # kernel flight deck (ISSUE 15, doc/observability.md): measured
         # device seconds per wrapped program from the launches SAMPLED
         # during this query — the per-program split of the

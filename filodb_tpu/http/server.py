@@ -72,6 +72,26 @@ def _timed(endpoint: str):
     return deco
 
 
+def _parse_downsample(v) -> int:
+    """``?downsample=<pixels>`` — target horizontal resolution for the
+    M4 query-time decimator (doc/coldstore.md).  Absent/empty -> 0
+    (off); anything not a positive integer is a client error (400)."""
+    if v is None or str(v).strip() == "":
+        return 0
+    try:
+        px = int(str(v).strip())
+    except ValueError:
+        raise ValueError(f"downsample must be a positive integer pixel "
+                         f"count, got {v!r}") from None
+    if px <= 0:
+        raise ValueError(f"downsample must be > 0, got {px}")
+    if px > 1 << 20:
+        # more pixels than any display: almost certainly a unit error,
+        # and the bin math degenerates to per-sample bins anyway
+        raise ValueError(f"downsample {px} exceeds the 1048576-pixel cap")
+    return px
+
+
 @dataclass
 class DatasetBinding:
     """Everything the HTTP layer needs to serve one dataset."""
@@ -1134,7 +1154,10 @@ class FiloHttpServer:
             in ("true", "1"),
             # tiered-resolution serving (doc/rollup.md): let clients
             # pin raw / a specific tier; default lets the router pick
-            resolution_pref=str(p.get("resolution", "")))
+            resolution_pref=str(p.get("resolution", "")),
+            # ?downsample=<pixels>: visualization-grade M4 decimation
+            # applied query-time at the exec root (doc/coldstore.md)
+            downsample_pixels=_parse_downsample(p.get("downsample")))
         return wdl.mint(qctx)
 
     def _admit(self, b: DatasetBinding, ep, qctx: QueryContext):
@@ -1181,6 +1204,17 @@ class FiloHttpServer:
                 t_plan = _time.perf_counter()
                 with TRACER.span("query.plan"):
                     ep = b.planner.materialize(plan, qctx)
+                if qctx.downsample_pixels:
+                    # ?downsample=<pixels>: M4 decimation at the exec
+                    # ROOT — after aggregation/functions, so the pixel
+                    # budget applies to what the client actually plots
+                    from filodb_tpu.query.transformers import \
+                        DownsampleMapper
+                    from filodb_tpu.utils.observability import \
+                        downsample_metrics
+                    ep.add_transformer(
+                        DownsampleMapper(pixels=qctx.downsample_pixels))
+                    downsample_metrics()["queries"].inc(dataset=b.dataset)
                 plan_s = _time.perf_counter() - t_plan
                 if not qctx.tenant:
                     from filodb_tpu.workload.admission import plan_tenant
@@ -1221,6 +1255,16 @@ class FiloHttpServer:
                                         qctx.rollup_resolution_ms
                                 sp.tag(resolution_ms=qctx
                                        .rollup_resolution_ms)
+                            if qctx.rollup_tiers:
+                                # storage-tier attribution (ISSUE 16):
+                                # which stitched legs actually served —
+                                # raw / rolled-local / rolled-cold —
+                                # in canonical oldest-first order
+                                from filodb_tpu.rollup.planner import \
+                                    canonical_tiers
+                                res.stats.tiers = canonical_tiers(
+                                    qctx.rollup_tiers)
+                                sp.tag(tiers=res.stats.tiers)
                             rc_c = res.stats.resultcache_cached_samples
                             rc_r = res.stats \
                                 .resultcache_recomputed_samples

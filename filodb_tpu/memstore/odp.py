@@ -384,6 +384,36 @@ class OnDemandPagingShard(TimeSeriesShard):
                           shard=self.shard_num, partitions=nparts,
                           chunks=nchunks)
 
+    def _prefetch_cold_for(self, part_ids: Sequence[int], start_time: int,
+                           end_time: int) -> None:
+        """Stage any cold-bucket objects the coming page-in will need,
+        BEFORE _odp_lock is taken: bucket I/O (and bucket stalls) must
+        never run under the lock every query thread serializes on.  A
+        stalled bucket raises BucketTimeout here — aborting this query
+        lock-free while others proceed — and the locked read below
+        consumes the staged bytes without touching the bucket.  The
+        candidate set is computed lock-free and can race concurrent
+        page-ins; a raced-in partition just means a staged blob goes
+        unconsumed (bounded by the store's staging cap)."""
+        prefetch = getattr(self.store, "prefetch_cold", None)
+        if prefetch is None:
+            return
+        pks = []
+        for pid in part_ids:
+            if self.paged.get(pid) is not None:
+                continue
+            try:
+                pks.append(self.index.partkey(pid))
+            except KeyError:
+                continue
+        if not pks:
+            return
+        # mirror the bulk read's full-scan heuristic so the staged set
+        # covers what the locked read will actually ask for
+        full = len(pks) > 256 and 2 * len(pks) >= len(self.part_set)
+        prefetch(self.dataset, self.shard_num, None if full else pks,
+                 start_time, end_time)
+
     def _on_page_evict(self) -> None:
         # called after the page-cache lock is released; concurrent evictions
         # from multiple query threads must not lose an increment (a lost
@@ -472,6 +502,12 @@ class OnDemandPagingShard(TimeSeriesShard):
         key = ("bf", part.part_id)
         older = self.paged.get(key)
         if older is None:
+            # stage cold objects lock-free first (wasted only if another
+            # thread backfills the same partition while we wait)
+            prefetch = getattr(self.store, "prefetch_cold", None)
+            if prefetch is not None:
+                prefetch(self.dataset, self.shard_num, [part.partkey],
+                         idx_start, earliest - 1)
             with self._odp_lock:
                 older = self.paged.get(key)
                 if older is None:
@@ -525,6 +561,7 @@ class OnDemandPagingShard(TimeSeriesShard):
         nb = native.batch_decoder()
         if nb is None:
             return None
+        self._prefetch_cold_for(part_ids, 0, _MAX_TIME)
         with self._odp_lock:
             # a publish deferred by the PREVIOUS lock holder must land
             # before this query classifies hits/misses, or it would
@@ -838,6 +875,9 @@ class OnDemandPagingShard(TimeSeriesShard):
         if got is not None:
             resident.update(got[0])
             return
+        # generic path: re-stage lock-free (no-op for keys the bulk
+        # attempt already staged — the staging dict persists per thread)
+        self._prefetch_cold_for(part_ids, 0, _MAX_TIME)
         with self._odp_lock:
             self._join_materialize()  # filolint: disable=blocking-under-lock — see _page_in_bulk: publishes never take _odp_lock; join-under-lock is the no-duplicate-page-in invariant
             by_pk = {}

@@ -1595,3 +1595,129 @@ def supports_grid(window_ms: int, step_ms: int, gstep_ms: int,
     if not on_tpu_backend():
         return True     # portable reference path: no VMEM tile bound
     return rows <= MAX_GRID_ROWS
+
+
+# ---------------------------------------------------------------------------
+# M4 visualization downsampling (ISSUE 16): per-pixel-bin min/max/
+# first/last selection (the M4 aggregation of Jugel et al., adopted by
+# tsdownsample/MinMaxLTTB, arXiv:2307.05389).  A T-step series split
+# into P pixel bins keeps <= 4 points per bin — everything a width-P
+# panel can render — so a year-long query returns ~4P points instead
+# of millions.  Pure SELECTION, no arithmetic: the kernel output is
+# bit-equal to a NumPy oracle by construction.
+# ---------------------------------------------------------------------------
+
+#: m4 plane order along output axis 1: values then LOCAL row indices
+M4_PLANES = ("vmin", "vmax", "vfirst", "vlast",
+             "imin", "imax", "ifirst", "ilast")
+
+
+def _m4_planes(v, idx, big):
+    """Shared selection math over one bin axis (rows): 8 [S]-planes.
+    Ties on min/max resolve to the FIRST occurrence; empty bins yield
+    NaN values and -1 indices.  Works on [W, S] blocks (kernel) and
+    batched [P, W, S] (reference) alike via ``axis=-2``."""
+    fin = jnp.isfinite(v)
+    vmin = jnp.min(jnp.where(fin, v, jnp.inf), axis=-2)
+    vmax = jnp.max(jnp.where(fin, v, -jnp.inf), axis=-2)
+    ifirst = jnp.min(jnp.where(fin, idx, big), axis=-2)
+    ilast = jnp.max(jnp.where(fin, idx, -1), axis=-2)
+    imin = jnp.min(jnp.where(fin & (v == jnp.expand_dims(vmin, -2)),
+                             idx, big), axis=-2)
+    imax = jnp.min(jnp.where(fin & (v == jnp.expand_dims(vmax, -2)),
+                             idx, big), axis=-2)
+    vfirst = jnp.sum(jnp.where(idx == jnp.expand_dims(ifirst, -2), v, 0.0),
+                     axis=-2)
+    vlast = jnp.sum(jnp.where(idx == jnp.expand_dims(ilast, -2), v, 0.0),
+                    axis=-2)
+    empty = ifirst == big
+    nanv = jnp.float32(jnp.nan)
+    neg1 = jnp.float32(-1)
+    return (jnp.where(empty, nanv, vmin), jnp.where(empty, nanv, vmax),
+            jnp.where(empty, nanv, vfirst), jnp.where(empty, nanv, vlast),
+            jnp.where(empty, neg1, imin.astype(jnp.float32)),
+            jnp.where(empty, neg1, imax.astype(jnp.float32)),
+            jnp.where(empty, neg1, ifirst.astype(jnp.float32)),
+            jnp.where(empty, neg1, ilast.astype(jnp.float32)))
+
+
+def _m4_kernel(v_ref, out_ref):
+    """One (pixel bin, lane block): [wpad, L] -> [1, 8, L].  Rows past
+    the bin's true width are NaN padding and never selected."""
+    v = v_ref[...]
+    idx = jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)
+    planes = _m4_planes(v, idx, jnp.int32(_IBIG))
+    for k in range(8):
+        out_ref[0, k, :] = planes[k]
+
+
+def _m4_bin_shape(nsteps: int, pixels: int) -> tuple[int, int]:
+    """(bin width W, sublane-padded width) for T steps over P bins."""
+    w = -(-nsteps // pixels)
+    return w, -(-w // 8) * 8
+
+
+@functools.partial(devicewatch.jit, program="grid.m4_grid",
+                   static_argnames=("pixels", "lanes", "interpret"))
+def m4_grid(vals, pixels: int, lanes: int = 128,
+            interpret: bool = False):
+    """M4 pixel-bin selection: time-major ``vals [T, S]`` -> planes
+    ``[P, 8, S]`` in :data:`M4_PLANES` order.  Index planes are LOCAL
+    to the bin (global row = ``p * W + local``, ``W = ceil(T/P)``);
+    NaN steps are absent samples, bins with no finite sample come back
+    NaN / -1.  Banded layout: time on sublanes (one bin's rows per
+    block), series on lanes — S must be a multiple of ``lanes`` (pad
+    with NaN columns)."""
+    nsteps, ns = vals.shape
+    if ns % lanes != 0 or ns == 0:
+        raise ValueError(f"series count {ns} must be a non-zero multiple "
+                         f"of lanes={lanes} (pad with NaN columns)")
+    if pixels < 1:
+        raise ValueError(f"pixels must be >= 1, got {pixels}")
+    w, wpad = _m4_bin_shape(nsteps, pixels)
+    v = jnp.asarray(vals, jnp.float32)
+    # host-side (XLA) re-banding: pad T to P*W, split bins, pad each
+    # bin's rows to a sublane multiple, flatten back to 2-D so the
+    # kernel sees one aligned [wpad, lanes] tile per (bin, lane block)
+    v = jnp.pad(v, ((0, pixels * w - nsteps), (0, 0)),
+                constant_values=jnp.nan)
+    v = v.reshape(pixels, w, ns)
+    v = jnp.pad(v, ((0, 0), (0, wpad - w), (0, 0)),
+                constant_values=jnp.nan)
+    v = v.reshape(pixels * wpad, ns)
+    return pl.pallas_call(
+        _m4_kernel,
+        interpret=interpret,
+        out_shape=jax.ShapeDtypeStruct((pixels, 8, ns), jnp.float32),
+        grid=(ns // lanes, pixels),
+        in_specs=[pl.BlockSpec((wpad, lanes), lambda i, p: (p, i),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, 8, lanes), lambda i, p: (p, 0, i),
+                               memory_space=pltpu.VMEM),
+    )(v)
+
+
+def m4_grid_ref(vals, pixels: int):
+    """Same semantics as :func:`m4_grid` in portable jnp (CPU serving
+    path + test oracle's device-side twin).  Selection only — the
+    outputs are bit-identical to the kernel's."""
+    nsteps, ns = vals.shape
+    if ns == 0 or nsteps == 0:
+        raise ValueError(f"empty input {vals.shape}")
+    if pixels < 1:
+        raise ValueError(f"pixels must be >= 1, got {pixels}")
+    w, _wpad = _m4_bin_shape(nsteps, pixels)
+    v = jnp.asarray(vals, jnp.float32)
+    v = jnp.pad(v, ((0, pixels * w - nsteps), (0, 0)),
+                constant_values=jnp.nan)
+    v = v.reshape(pixels, w, ns)
+    idx = jax.lax.broadcasted_iota(jnp.int32, v.shape, 1)
+    return jnp.stack(_m4_planes(v, idx, jnp.int32(_IBIG)), axis=1)
+
+
+def m4_grid_auto(vals, pixels: int, lanes: int = 128):
+    """Pallas on TPU backends (when the series axis tiles), portable
+    reference elsewhere."""
+    if on_tpu_backend() and vals.shape[1] % lanes == 0 and vals.shape[1]:
+        return m4_grid(vals, pixels, lanes)
+    return m4_grid_ref(vals, pixels)

@@ -135,6 +135,26 @@ class ExecContext:
             if recomputed:
                 c["rc_recomputed"] = c.get("rc_recomputed", 0) + recomputed
 
+    def note_cold(self, chunks: int = 0, bytes_: int = 0) -> None:
+        """Cold-tier accounting (filodb_tpu/coldstore): chunks/bytes
+        this query pulled from the object bucket — surfaced under
+        data.stats.coldTier so a slow cold panel is tellable from a
+        warm one."""
+        with self._corrupt_lock:
+            c = self._counters
+            if chunks:
+                c["cold_chunks"] = c.get("cold_chunks", 0) + chunks
+            if bytes_:
+                c["cold_bytes"] = c.get("cold_bytes", 0) + bytes_
+
+    def note_downsample(self, points_in: int = 0, points_out: int = 0) -> None:
+        """?downsample= accounting (query/transformers.DownsampleMapper):
+        finite points entering the M4 kernel vs pixel-exact points kept."""
+        with self._corrupt_lock:
+            c = self._counters
+            c["ds_in"] = c.get("ds_in", 0) + points_in
+            c["ds_out"] = c.get("ds_out", 0) + points_out
+
     def counter(self, name: str) -> int:
         with self._corrupt_lock:
             return self._counters.get(name, 0)
@@ -162,6 +182,12 @@ class ExecContext:
                          hbm_delta=stats.hbm_resident_delta_bytes)
         self.note_resultcache(cached=stats.resultcache_cached_samples,
                               recomputed=stats.resultcache_recomputed_samples)
+        if stats.cold_chunks_paged or stats.cold_bytes_read:
+            self.note_cold(chunks=stats.cold_chunks_paged,
+                           bytes_=stats.cold_bytes_read)
+        if stats.downsample_points_in or stats.downsample_points_out:
+            self.note_downsample(points_in=stats.downsample_points_in,
+                                 points_out=stats.downsample_points_out)
         if stats.corrupt_chunks_excluded:
             self.note_corrupt_excluded(stats.corrupt_chunks_excluded)
         if stats.shards_down:
@@ -189,6 +215,10 @@ class ExecContext:
             stats.hbm_resident_delta_bytes = c.get("hbm_delta", 0)
             stats.resultcache_cached_samples = c.get("rc_cached", 0)
             stats.resultcache_recomputed_samples = c.get("rc_recomputed", 0)
+            stats.cold_chunks_paged = c.get("cold_chunks", 0)
+            stats.cold_bytes_read = c.get("cold_bytes", 0)
+            stats.downsample_points_in = c.get("ds_in", 0)
+            stats.downsample_points_out = c.get("ds_out", 0)
             stats.device_programs = dict(self._device_programs)
             stats.shards_down = self._shards_down
 

@@ -60,6 +60,15 @@ class QueryContext:
     # the query.execute span with the decision only for routed
     # datasets, so an un-tiered dataset's spans stay clean (ISSUE 15)
     rollup_routed: bool = False
+    # storage tiers the router stitched for this query, appended at
+    # materialize time in time order (e.g. ["rolled-cold",
+    # "rolled-local", "raw"]); the HTTP layer folds them into
+    # QueryStats.tiers + the query.execute span (ISSUE 16)
+    rollup_tiers: list = dataclasses.field(default_factory=list)
+    # ?downsample=<pixels>: M4 visualization downsampling target —
+    # <= ~4*pixels pixel-exact points per series come back instead of
+    # every raw step (0 = off; ISSUE 16)
+    downsample_pixels: int = 0
 
 
 @dataclasses.dataclass
@@ -105,6 +114,18 @@ class QueryStats:
     # under data.stats.resultCache with stats=true
     resultcache_cached_samples: int = 0
     resultcache_recomputed_samples: int = 0
+    # cold tier (ISSUE 16, filodb_tpu/coldstore): chunks/bytes this
+    # query pulled from the object bucket — 0 on a bucket-miss-free
+    # query, so dashboards can tell a slow cold panel from a warm one
+    cold_chunks_paged: int = 0
+    cold_bytes_read: int = 0
+    # storage tiers that served (part of) this query, "+"-joined in
+    # time order ("rolled-cold+rolled-local+raw"); "" = un-routed
+    tiers: str = ""
+    # ?downsample= (ISSUE 16): finite points entering the M4
+    # downsampler vs pixel-exact points kept (0/0 = not requested)
+    downsample_points_in: int = 0
+    downsample_points_out: int = 0
     # kernel flight deck (ISSUE 15, utils/devicewatch.KernelTimer):
     # measured device seconds per wrapped program, from the launches
     # SAMPLED while this query's ExecContext was active — the
@@ -134,6 +155,14 @@ class QueryStats:
         self.resultcache_cached_samples += other.resultcache_cached_samples
         self.resultcache_recomputed_samples += \
             other.resultcache_recomputed_samples
+        self.cold_chunks_paged += other.cold_chunks_paged
+        self.cold_bytes_read += other.cold_bytes_read
+        if other.tiers and other.tiers != self.tiers:
+            mine = self.tiers.split("+") if self.tiers else []
+            mine += [t for t in other.tiers.split("+") if t not in mine]
+            self.tiers = "+".join(mine)
+        self.downsample_points_in += other.downsample_points_in
+        self.downsample_points_out += other.downsample_points_out
         for k, v in other.device_programs.items():
             self.device_programs[k] = self.device_programs.get(k, 0.0) + v
 

@@ -501,3 +501,54 @@ class VectorFunctionMapper(RangeVectorTransformer):
             else:
                 out.append(b)
         return out
+
+
+@dataclasses.dataclass
+class DownsampleMapper(RangeVectorTransformer):
+    """?downsample=<pixels> (ISSUE 16): M4 visualization downsampling
+    as the OUTERMOST transformer — per series, per pixel bin, keep only
+    the min/max/first/last samples (<= 4 x pixels points), which is
+    everything a panel that wide can render (arXiv:2307.05389).
+
+    The kept points stay on the original step grid: non-selected steps
+    become NaN, the dense batch shape is unchanged, and the HTTP
+    matrix serializer (which already drops NaN steps) emits only the
+    selected points — the egress reduction costs zero serialization
+    changes.  Selection runs in ops/grid.m4_grid (banded device
+    kernel; portable/interpret path off-TPU), so a year-long panel
+    never round-trips millions of samples through Python."""
+
+    pixels: int
+
+    def apply(self, batches, ctx):
+        from filodb_tpu.ops.grid import m4_grid_auto
+        from filodb_tpu.utils.observability import downsample_metrics
+        out = []
+        for b in batches:
+            if not isinstance(b, PeriodicBatch) or b.hist is not None \
+                    or b.num_series == 0 \
+                    or b.steps.num_steps <= self.pixels:
+                out.append(b)   # already at panel resolution (or not
+                continue        # a plain matrix): nothing to thin
+            vals = np.asarray(b.np_values(), np.float32)  # [S, T]
+            ns, nsteps = vals.shape
+            planes = np.asarray(m4_grid_auto(vals.T, self.pixels))
+            w = -(-nsteps // self.pixels)
+            # local bin indices -> global step indices; -1 marks empty
+            idx = planes[:, 4:8, :].astype(np.int64)      # [P, 4, S]
+            keep = idx >= 0
+            idx = idx + (np.arange(self.pixels) * w)[:, None, None]
+            sel = np.zeros((ns, nsteps), bool)
+            s_ix = np.broadcast_to(np.arange(ns)[None, None, :], idx.shape)
+            sel[s_ix[keep], np.minimum(idx[keep], nsteps - 1)] = True
+            points_in = int(np.isfinite(vals).sum())
+            points_out = int(sel.sum())
+            thinned = np.where(sel, vals, np.nan)
+            if ctx is not None:
+                ctx.note_downsample(points_in=points_in,
+                                    points_out=points_out)
+            m = downsample_metrics()
+            m["points_in"].inc(points_in)
+            m["points_out"].inc(points_out)
+            out.append(PeriodicBatch(b.keys, b.steps, thinned))
+        return out

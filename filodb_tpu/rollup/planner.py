@@ -80,6 +80,42 @@ def parse_resolution_pref(pref: str) -> Optional[object]:
     return parse_duration_ms(pref)
 
 
+#: canonical stats/span ordering for stitched tiers, oldest data first
+TIER_ORDER = ("rolled-cold", "rolled-local", "raw")
+
+
+def canonical_tiers(tiers) -> str:
+    """'+'-joined tier attribution in canonical (oldest-first) order —
+    the legs materialize in planner-internal order, so the raw append
+    sequence is not presentation-stable."""
+    seen = [t for t in TIER_ORDER if t in tiers]
+    seen += [t for t in tiers if t not in seen]
+    return "+".join(seen)
+
+
+class _TierNotePlanner(QueryPlanner):
+    """Wraps a leg planner purely for ATTRIBUTION: when the stitch
+    math materializes this leg, the tier name lands on
+    ``qctx.rollup_tiers`` (folded into QueryStats.tiers + the
+    query.execute span by the HTTP layer) and the per-tier routing
+    counter bumps.  Correctness never depends on it — both rolled legs
+    read the same tier dataset through the TieredColumnStore merge."""
+
+    def __init__(self, inner: QueryPlanner, tier: str, dataset: str,
+                 routed_counter=None):
+        self.inner = inner
+        self.tier = tier
+        self.dataset = dataset
+        self._routed = routed_counter
+
+    def materialize(self, plan, qctx=None):
+        if qctx is not None and self.tier not in qctx.rollup_tiers:
+            qctx.rollup_tiers.append(self.tier)
+            if self._routed is not None:
+                self._routed.inc(dataset=self.dataset, tier=self.tier)
+        return self.inner.materialize(plan, qctx)
+
+
 class RollupRouterPlanner(QueryPlanner):
     """Routes one dataset's queries across its resolution ladder."""
 
@@ -87,15 +123,22 @@ class RollupRouterPlanner(QueryPlanner):
                  tier_planners: dict[int, QueryPlanner],
                  rolled_through_fn: Callable[[int], int],
                  raw_retention_ms: Optional[int] = None,
-                 now_ms_fn: Optional[Callable[[], int]] = None):
+                 now_ms_fn: Optional[Callable[[], int]] = None,
+                 cold_floor_fn: Optional[Callable[[int], int]] = None):
         self.dataset = dataset
         self.raw = raw_planner
         self.tiers = dict(sorted(tier_planners.items()))
         self.rolled_through = rolled_through_fn
         self.raw_retention_ms = raw_retention_ms
         self.now_ms = now_ms_fn or (lambda: int(time.time() * 1000))
+        # cold tier (ISSUE 16): resolution -> age-out floor of that
+        # tier's dataset (epoch ms; 0 = nothing archived yet).  Chunks
+        # ending before the floor live in the object bucket; the router
+        # adds a rolled-local/rolled-cold stitch at it for attribution
+        self.cold_floor = cold_floor_fn
         from filodb_tpu.utils.observability import rollup_metrics
         self._routed = rollup_metrics()["routed"]
+        self._tier_served = rollup_metrics()["tier_served"]
 
     # ------------------------------------------------------------ selection
 
@@ -155,10 +198,14 @@ class RollupRouterPlanner(QueryPlanner):
             res = next(iter(self.tiers))
         if res is None:
             self._routed.inc(dataset=self.dataset, resolution="raw")
+            if "raw" not in qctx.rollup_tiers:
+                qctx.rollup_tiers.append("raw")
             return self.raw.materialize(plan, qctx)
         rolled_hwm = self.rolled_through(res)
         if rolled_hwm <= start:
             self._routed.inc(dataset=self.dataset, resolution="raw")
+            if "raw" not in qctx.rollup_tiers:
+                qctx.rollup_tiers.append("raw")
             return self.raw.materialize(plan, qctx)
         # the boundary raw serving starts at: everything the tier has
         # closed serves rolled, the live tail serves raw.  Unlike the
@@ -182,7 +229,35 @@ class RollupRouterPlanner(QueryPlanner):
         # the reference's raw/downsample split+stitch math, instantiated
         # with THIS query's live boundary (snap to step, lookback-aware)
         ltr = LongTimeRangePlanner(
-            self.raw, self.tiers[res],
+            _TierNotePlanner(self.raw, "raw", self.dataset,
+                             self._tier_served),
+            self._rolled_leg(res, start, look),
             earliest_raw_time_fn=lambda _b=boundary: _b,
             latest_downsample_time_fn=lambda _h=rolled_hwm: _h)
         return ltr.materialize(plan, qctx)
+
+    def _rolled_leg(self, res: int, start_ms: int, look_ms: int):
+        """The rolled side of the stitch — with a THIRD boundary when
+        the tier's age-out floor cuts the query range: data ending
+        before the floor is guaranteed archived (rolled-cold), newer
+        rolled data is still local sqlite (rolled-local).  Both legs
+        read the SAME tier dataset through the TieredColumnStore merge,
+        so the boundary is pure attribution: a stale watermark can
+        mislabel a leg but never change bytes.  A year-long panel thus
+        plans raw -> rolled-local -> rolled-cold and never touches the
+        raw dataset below the profit boundary."""
+        tier = self.tiers[res]
+        local_leg = _TierNotePlanner(tier, "rolled-local", self.dataset,
+                                     self._tier_served)
+        cold_wm = self.cold_floor(res) if self.cold_floor is not None else 0
+        if cold_wm <= start_ms:
+            return local_leg
+        cold_leg = _TierNotePlanner(tier, "rolled-cold", self.dataset,
+                                    self._tier_served)
+        # same gap-avoid offset as the outer stitch: the one step whose
+        # lookback window spans the floor is served by the local leg
+        cold_boundary = cold_wm + 1 - look_ms
+        return LongTimeRangePlanner(
+            local_leg, cold_leg,
+            earliest_raw_time_fn=lambda _b=cold_boundary: _b,
+            latest_downsample_time_fn=lambda _h=cold_wm: _h)

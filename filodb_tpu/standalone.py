@@ -26,6 +26,22 @@ driven by a JSON config instead of HOCON:
         "segment": "1h",                  # default: the flush interval
         "instant": true
       },
+      "coldstore": {                      # ISSUE 16 (doc/coldstore.md):
+                                          # object-store cold tier —
+                                          # flushed/rolled chunks age out
+                                          # of local sqlite into the
+                                          # bucket; queries page them
+                                          # back on demand (CRC-verified)
+        "enabled": true,
+        "bucket-dir": "/var/filodb/coldstore",
+                                          # default: {data-dir}/coldstore
+        "retention": "30d",               # age-out cutoff; omit/0 =
+                                          # manual only (cli age-out)
+        "tick-interval-s": 3600,
+        "fetch-timeout-s": 30,            # offline cap; queries use the
+                                          # tighter deadline budget
+        "datasets": ["prom_ds_3600000"]   # restrict; omit = all
+      },
       "dataplane": {                      # ISSUE 6 (doc/observability.md)
         "watermark-sample-interval-s": 10,
         "ingest-stall-window-s": 30,
@@ -115,6 +131,32 @@ class FiloServer:
             from filodb_tpu.store.metastore import InMemoryMetaStore
             self.colstore = NullColumnStore()
             self.metastore = InMemoryMetaStore()
+        # cold tier (ISSUE 16, doc/coldstore.md): object-bucket chunk
+        # archive behind the local store.  The TieredColumnStore wrap
+        # happens BEFORE the memstore exists, so ODP paging, flushes and
+        # the split controller all see one merged ColumnStore; age-out
+        # itself runs against the unwrapped local store.
+        self.local_colstore = self.colstore
+        self.cold_store = None
+        self.ageout = None
+        self._ageout_stop = threading.Event()
+        self._ageout_thread: Optional[threading.Thread] = None
+        cs_conf = config.get("coldstore") or {}
+        if data_dir and cs_conf.get("enabled"):
+            from filodb_tpu.coldstore import (AgeOutManager, ColdChunkStore,
+                                              LocalFSBucket,
+                                              TieredColumnStore)
+            bucket_dir = cs_conf.get("bucket-dir") \
+                or f"{data_dir}/coldstore"
+            self.cold_store = ColdChunkStore(
+                LocalFSBucket(bucket_dir),
+                fetch_timeout_s=float(cs_conf.get("fetch-timeout-s",
+                                                  30.0)))
+            self.colstore = TieredColumnStore(self.local_colstore,
+                                              self.cold_store)
+            self.ageout = AgeOutManager(self.local_colstore,
+                                        self.cold_store,
+                                        metastore=self.metastore)
         self.memstore = TimeSeriesMemStore(self.colstore, self.metastore)
         self.manager = ShardManager(
             reassignment_min_interval_ms=int(
@@ -378,6 +420,23 @@ class FiloServer:
         if self.rollup_engine is not None:
             self.rollup_engine.start()
 
+        # cold-tier age-out loop (ISSUE 16): periodic retention passes
+        # move closed local chunks into the bucket.  Only when a
+        # retention is configured — without one the tier is read/manual
+        # only (cli.py age-out)
+        cs_conf = self.config.get("coldstore") or {}
+        if self.ageout is not None and cs_conf.get("retention") \
+                and str(cs_conf["retention"]) not in ("0", ""):
+            from filodb_tpu.http.model import parse_duration_ms
+            retention_ms = parse_duration_ms(str(cs_conf["retention"]))
+            if retention_ms > 0:
+                self._ageout_thread = threading.Thread(
+                    target=self._ageout_loop,
+                    args=(retention_ms,
+                          float(cs_conf.get("tick-interval-s", 3600.0))),
+                    name="coldstore-ageout", daemon=True)
+                self._ageout_thread.start()
+
         port = self.http.start()
         self.split_controller.start()
         peers = self.config.get("peers", {})
@@ -409,6 +468,28 @@ class FiloServer:
             self.profiler.start()
         self._started.set()
         return port
+
+    def _ageout_loop(self, retention_ms: int, tick_s: float) -> None:
+        """Background retention passes over every dataset (tier
+        datasets included — each tier dataset gets its OWN age-out
+        watermark, the per-tier retention floor the resolution router
+        stitches at).  A failed pass logs and retries next tick; the
+        failed shard's watermark never advances past unarchived data."""
+        import logging
+        log = logging.getLogger("filodb.coldstore")
+        only = set((self.config.get("coldstore") or {})
+                   .get("datasets") or ())
+        while not self._ageout_stop.wait(tick_s):
+            for ds in list(self.manager.datasets()):
+                if only and ds not in only:
+                    continue
+                if self._ageout_stop.is_set():
+                    return
+                try:
+                    self.ageout.run(ds, retention_ms)
+                except Exception:  # noqa: BLE001 — keep the loop alive
+                    log.exception("cold-tier age-out pass failed for %s "
+                                  "(will retry next tick)", ds)
 
     def _setup_rules(self, selfscrape_conf: dict) -> None:
         """Rule engine (ISSUE 9, doc/rules.md): inline groups + rule
@@ -832,10 +913,20 @@ class FiloServer:
                 return local
             return min(vals)
 
+        cold_floor = None
+        if self.ageout is not None:
+            # rolled-local / rolled-cold stitch boundary (ISSUE 16):
+            # the TIER dataset's age-out floor — 0 until a pass
+            # completes on every shard, so the cold leg only appears
+            # once data is guaranteed archived
+            def cold_floor(res: int, _a=self.ageout, _n=name) -> int:
+                return _a.floor_ms(ds_dataset_name(_n, res))
+
         return RollupRouterPlanner(
             name, planner, tier_planners,
             rolled_through_fn=cluster_rolled_through,
-            raw_retention_ms=cfg.raw_retention_ms)
+            raw_retention_ms=cfg.raw_retention_ms,
+            cold_floor_fn=cold_floor)
 
     def flush_all(self) -> int:
         n = 0
@@ -845,6 +936,11 @@ class FiloServer:
         return n
 
     def shutdown(self) -> None:
+        # stop the age-out loop FIRST: a migration pass mid-flight must
+        # finish its current shard before the stores close under it
+        self._ageout_stop.set()
+        if self._ageout_thread is not None:
+            self._ageout_thread.join(timeout=30)
         self.split_controller.stop()
         if self.rule_engine is not None:
             # stops the group loops AND closes the notifier — a dead

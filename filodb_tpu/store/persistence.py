@@ -599,6 +599,49 @@ class DiskColumnStore(_SqliteBase, ColumnStore):
         conn.commit()
         return cur.rowcount
 
+    # ------------------------------------------------------- cold-tier age-out
+
+    def count_chunks_aged(self, dataset: str, shard: int,
+                          end_before: int) -> tuple[int, int]:
+        """(rows, blob bytes) wholly older than ``end_before`` — the
+        age-out dry-run plan, metadata-only."""
+        row = self._conn().execute(
+            "SELECT COUNT(*), COALESCE(SUM(LENGTH(vectors)),0) "
+            "FROM chunks WHERE dataset=? AND shard=? AND end_time<?",
+            (dataset, shard, end_before)).fetchone()
+        return int(row[0]), int(row[1])
+
+    def scan_chunk_rows_aged(self, dataset: str, shard: int,
+                             end_before: int) -> Iterator[tuple]:
+        """Full VERIFIED rows (partkey, chunk_id, num_rows, start_time,
+        end_time, schema_hash, blob, crc, ingestion_time) whose
+        end_time < ``end_before`` — the age-out migration feed.  Rows
+        failing their checksum are quarantined and SKIPPED: corruption
+        stays local and loud instead of being archived as truth."""
+        cur = self._conn().execute(
+            "SELECT partkey, chunk_id, num_rows, start_time, end_time, "
+            "schema_hash, vectors, crc, ingestion_time FROM chunks "
+            "WHERE dataset=? AND shard=? AND end_time<? "
+            "ORDER BY partkey, chunk_id", (dataset, shard, end_before))
+        while True:
+            got = cur.fetchmany(256)
+            if not got:
+                return
+            yield from self._verify_rows(dataset, shard, got)
+
+    def delete_chunk_rows(self, dataset: str, shard: int,
+                          ids: Sequence[tuple[bytes, int]]) -> int:
+        """Delete specific (partkey, chunk_id) rows — the local half of
+        a verified tier migration.  Part keys are untouched: the series
+        still exists; its old chunks just live in the cold tier now."""
+        conn = self._conn()
+        cur = conn.executemany(
+            "DELETE FROM chunks WHERE dataset=? AND shard=? "
+            "AND partkey=? AND chunk_id=?",
+            [(dataset, shard, pk, cid) for pk, cid in ids])
+        conn.commit()
+        return cur.rowcount
+
 
 class DiskMetaStore(_SqliteBase, MetaStore):
     """MetaStore (checkpoints + dataset metadata) over sqlite."""

@@ -612,6 +612,11 @@ def rollup_metrics() -> dict:
             "queries the resolution router served from a rolled tier, "
             "per dataset and resolution (resolution=raw counts "
             "rollup-eligible queries that stayed raw)"),
+        "tier_served": REGISTRY.counter(
+            "filodb_rollup_tier_legs_total",
+            "stitch legs materialized per storage tier "
+            "(raw | rolled-local | rolled-cold), per dataset — a "
+            "stitched query counts once per tier it actually read"),
     }
 
 
@@ -663,6 +668,62 @@ def odp_metrics() -> dict:
         "chunks": REGISTRY.counter(
             "filodb_odp_chunks_paged_total",
             "chunks read back from the column store"),
+    }
+
+
+def coldstore_metrics() -> dict:
+    """Canonical cold-tier metrics (filodb_tpu/coldstore): bucket fetch
+    traffic + failure classes, age-out volume, and the per-shard
+    watermark level — one place defines the names so the store, the
+    age-out loop, cli verbs, and doc/coldstore.md can never drift."""
+    return {
+        "fetches": REGISTRY.counter(
+            "filodb_coldstore_fetches_total",
+            "objects fetched from the cold bucket (cache-miss reads; "
+            "prefetched objects count once, at prefetch time)"),
+        "fetch_bytes": REGISTRY.counter(
+            "filodb_coldstore_fetch_bytes_total",
+            "object bytes fetched from the cold bucket"),
+        "fetch_corrupt": REGISTRY.counter(
+            "filodb_coldstore_fetch_corrupt_total",
+            "fetched objects failing their key CRC (truncated or "
+            "bit-rotted in the bucket) — quarantined, never served, "
+            "per dataset"),
+        "fetch_timeouts": REGISTRY.counter(
+            "filodb_coldstore_fetch_timeouts_total",
+            "fetches refused because the deadline-derived timeout "
+            "expired (stalled backend or exhausted query budget)"),
+        "fetch_missing": REGISTRY.counter(
+            "filodb_coldstore_fetch_missing_total",
+            "fetches of objects deleted between listing and get "
+            "(served as absent rows, not errors)"),
+        "aged_chunks": REGISTRY.counter(
+            "filodb_coldstore_aged_chunks_total",
+            "chunk rows migrated local -> cold by age-out passes, "
+            "per dataset"),
+        "aged_bytes": REGISTRY.counter(
+            "filodb_coldstore_aged_bytes_total",
+            "blob bytes migrated local -> cold, per dataset"),
+        "watermark": REGISTRY.gauge(
+            "filodb_coldstore_ageout_watermark_ms",
+            "cutoff (epoch ms) of the last completed age-out pass, per "
+            "dataset/shard — chunks ending before it are archived"),
+    }
+
+
+def downsample_metrics() -> dict:
+    """Visualization downsampling (?downsample=<pixels>, ops/grid.py
+    m4_grid): how often panels opt in and the point-volume reduction."""
+    return {
+        "queries": REGISTRY.counter(
+            "filodb_downsample_queries_total",
+            "range queries that requested M4 pixel downsampling"),
+        "points_in": REGISTRY.counter(
+            "filodb_downsample_points_in_total",
+            "finite samples entering the downsampler"),
+        "points_out": REGISTRY.counter(
+            "filodb_downsample_points_out_total",
+            "pixel-exact samples kept (<= 4 per pixel bin per series)"),
     }
 
 

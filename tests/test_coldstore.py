@@ -1,0 +1,553 @@
+"""Cold tier (ISSUE 16, doc/coldstore.md): bucket, age-out, chaos, stitch.
+
+Oracle strategy: the all-resident local store is ground truth — after
+any sequence of age-out passes, every read (store-level merge, ODP
+page-in, stitched router query) must be BIT-equal to what the fully
+local store served before migration.  Chaos (truncated / corrupt /
+stalled bucket objects) must degrade LOUDLY — quarantine + partial-
+results accounting or a deadline refusal — never into silent wrong
+answers.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coldstore import (AgeOutManager, BucketTimeout,
+                                  ColdChunkStore, LocalFSBucket,
+                                  ObjectMissing, TieredColumnStore)
+from filodb_tpu.coldstore.store import object_key, parse_object_key
+from filodb_tpu.core.chunk import ChunkSet, ChunkSetInfo
+from filodb_tpu.core.filters import ColumnFilter, Equals
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+from filodb_tpu.core.storeconfig import StoreConfig
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.downsample.dsstore import ds_dataset_name
+from filodb_tpu.integrity import QUARANTINE, chunk_crc
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.parallel.shardmap import ShardMapper
+from filodb_tpu.promql.parser import query_range_to_logical_plan
+from filodb_tpu.query.exec import ExecContext
+from filodb_tpu.query.model import QueryContext
+from filodb_tpu.rollup.config import RollupConfig
+from filodb_tpu.rollup.engine import RollupEngine
+from filodb_tpu.rollup.planner import (RollupRouterPlanner,
+                                       canonical_tiers)
+from filodb_tpu.store.persistence import DiskColumnStore, DiskMetaStore
+from filodb_tpu.utils.observability import coldstore_metrics
+
+T0 = 1_700_000_000_000
+STEP = 10_000
+N_SERIES = 5
+N_ROWS = 40
+FILTERS = [ColumnFilter("_metric_", Equals("cm"))]
+
+
+@pytest.fixture(autouse=True)
+def _clean_quarantine():
+    QUARANTINE.clear()
+    yield
+    QUARANTINE.clear()
+
+
+def _counters() -> dict:
+    return {k: m.total() for k, m in coldstore_metrics().items()}
+
+
+def _mk_chunkset(cid: int, base: int, payload: bytes) -> ChunkSet:
+    return ChunkSet(ChunkSetInfo(chunk_id=cid, num_rows=10,
+                                 start_time=base, end_time=base + 9_000),
+                    partkey=b"pk0", vectors=[payload, payload[::-1]])
+
+
+def _build_persisted(tmp_path, n_series=N_SERIES, n_rows=N_ROWS,
+                     store=None):
+    """Ingest + flush a small gauge dataset into a disk store."""
+    disk = store if store is not None \
+        else DiskColumnStore(str(tmp_path / "chunks.db"))
+    meta = DiskMetaStore(str(tmp_path / "meta.db"))
+    ms = TimeSeriesMemStore(disk, meta)
+    sh = ms.setup("prom", DEFAULT_SCHEMAS, 0, StoreConfig())
+    b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], DatasetOptions())
+    ts = T0 + np.arange(n_rows, dtype=np.int64) * STEP
+    rng = np.random.default_rng(7)
+    for i in range(n_series):
+        b.add_series(ts, [rng.random(n_rows) + i],
+                     {"_metric_": "cm", "inst": f"i{i}",
+                      "_ws_": "w", "_ns_": "n"})
+    for off, c in enumerate(b.containers()):
+        sh.ingest_container(c, off)
+    sh.flush_all(ingestion_time=1000)
+    return disk, meta, ms, sh
+
+
+def _tiered(tmp_path):
+    local = DiskColumnStore(str(tmp_path / "chunks.db"))
+    bucket = LocalFSBucket(str(tmp_path / "bucket"))
+    cold = ColdChunkStore(bucket, fetch_timeout_s=10.0)
+    return TieredColumnStore(local, cold), local, cold, bucket
+
+
+def _scan(shard):
+    res = shard.lookup_partitions(FILTERS, 0, 2 ** 62)
+    return shard.scan_batch(res.part_ids, 0, 2 ** 62)
+
+
+def _snapshot(shard) -> dict:
+    """{inst: (ts list, vals list)} — the bit-equality unit."""
+    tags, batch = _scan(shard)
+    out = {}
+    for i, t in enumerate(tags):
+        n = int(batch.row_counts[i])
+        out[t["inst"]] = (batch.timestamps[i, :n].tolist(),
+                          batch.values[i, :n].tolist())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Bucket + key codec
+# ---------------------------------------------------------------------------
+
+
+class TestBucket:
+    def test_roundtrip_list_delete(self, tmp_path):
+        b = LocalFSBucket(str(tmp_path / "b"))
+        b.put_object("chunks/a/1", b"one")
+        b.put_object("chunks/a/2", b"twotwo")
+        assert b.get_object("chunks/a/1", timeout_s=5) == b"one"
+        assert b.list_objects("chunks/a/") == [("chunks/a/1", 3),
+                                               ("chunks/a/2", 6)]
+        assert b.delete_object("chunks/a/1") is True
+        assert b.delete_object("chunks/a/1") is False
+        with pytest.raises(ObjectMissing):
+            b.get_object("chunks/a/1", timeout_s=5)
+
+    def test_bad_keys_rejected(self, tmp_path):
+        b = LocalFSBucket(str(tmp_path / "b"))
+        for bad in ("", "/abs", "a/../b"):
+            with pytest.raises(ValueError):
+                b.put_object(bad, b"x")
+
+    def test_exhausted_budget_refuses_without_io(self, tmp_path):
+        b = LocalFSBucket(str(tmp_path / "b"))
+        b.put_object("chunks/k", b"v")
+        with pytest.raises(BucketTimeout):
+            b.get_object("chunks/k", timeout_s=0)
+        with pytest.raises(BucketTimeout):
+            b.get_object("chunks/k", timeout_s=-1)
+
+    def test_stall_bounded_by_timeout(self, tmp_path):
+        """A stalled backend delays at most timeout_s, then refuses —
+        the caller is late, never wedged."""
+        b = LocalFSBucket(str(tmp_path / "b"))
+        b.put_object("chunks/k", b"v")
+        b.stall_s = 60.0
+        t0 = time.monotonic()
+        with pytest.raises(BucketTimeout):
+            b.get_object("chunks/k", timeout_s=0.05)
+        assert time.monotonic() - t0 < 5.0
+
+    def test_object_key_roundtrip(self):
+        key = object_key("prom", 3, b"\x01pk", 42, 100, T0, T0 + 9_000,
+                         7, 1234, 0xDEADBEEF)
+        meta = parse_object_key(key, size=10)
+        assert meta is not None
+        assert (meta.partkey, meta.chunk_id, meta.num_rows,
+                meta.start_time, meta.end_time, meta.schema_hash,
+                meta.ingestion_time, meta.crc, meta.size) == \
+            (b"\x01pk", 42, 100, T0, T0 + 9_000, 7, 1234, 0xDEADBEEF, 10)
+        assert parse_object_key("chunks/x/not-a-chunk", 1) is None
+
+
+# ---------------------------------------------------------------------------
+# ColdChunkStore + TieredColumnStore merge
+# ---------------------------------------------------------------------------
+
+
+class TestTieredMerge:
+    def test_rows_identical_before_and_after_ageout(self, tmp_path):
+        tiered, local, cold, _bucket = _tiered(tmp_path)
+        css = [_mk_chunkset(cid, T0 + cid * 10_000, b"PAY%d" % cid * 30)
+               for cid in range(6)]
+        local.initialize("prom", 1)
+        local.write_chunks("prom", 0, css, ingestion_time=999)
+        before = tiered.read_raw_rows("prom", 0, [b"pk0"], 0, 2 ** 62)
+        mgr = AgeOutManager(local, cold,
+                            now_ms_fn=lambda: T0 + 6 * 10_000 + 10)
+        # retention 25s: chunks ending before T0+35s age out (first 3)
+        rep = mgr.run("prom", 25_000 + 10)
+        assert rep["total_chunks"] == 3
+        assert local.num_chunks("prom", 0) == 3
+        after = tiered.read_raw_rows("prom", 0, [b"pk0"], 0, 2 ** 62)
+        assert after == before  # bit-equal merge, cold rows included
+        # partition-shaped reads merge and order by chunk_id too
+        parts = dict(tiered.read_raw_partitions("prom", 0, [b"pk0"],
+                                                0, 2 ** 62))
+        assert [cs.info.chunk_id for cs in parts[b"pk0"]] == list(range(6))
+
+    def test_local_wins_overlap_and_reupload_idempotent(self, tmp_path):
+        """Crash window: a row uploaded but not yet deleted locally is
+        served once (local copy) and re-aged without error."""
+        tiered, local, cold, _bucket = _tiered(tmp_path)
+        cs = _mk_chunkset(1, T0, b"OVERLAP" * 20)
+        local.initialize("prom", 1)
+        local.write_chunks("prom", 0, [cs], ingestion_time=5)
+        blob_rows = local.read_raw_rows("prom", 0, [b"pk0"], 0, 2 ** 62)
+        (pk, cid, nr, st, et, sch, blob, crc) = blob_rows[0][:8]
+        cold.put_chunk_row("prom", 0, pk, cid, nr, st, et, sch, 5,
+                           bytes(blob), crc, verify=True)
+        rows = tiered.read_raw_rows("prom", 0, [b"pk0"], 0, 2 ** 62)
+        assert len(rows) == 1  # deduped, not doubled
+        mgr = AgeOutManager(local, cold, now_ms_fn=lambda: et + 10)
+        rep = mgr.run("prom", 1)   # re-uploads the same key, then deletes
+        assert rep["total_chunks"] == 1
+        assert local.num_chunks("prom", 0) == 0
+        rows2 = tiered.read_raw_rows("prom", 0, [b"pk0"], 0, 2 ** 62)
+        assert rows2 == rows
+
+    def test_sqlite_admin_surface_delegates(self, tmp_path):
+        tiered, local, _cold, _bucket = _tiered(tmp_path)
+        local.initialize("prom", 1)
+        # fault injection + verify-chunks reach sqlite through the wrap
+        assert tiered._conn() is local._conn()
+        assert tiered.list_shards("prom") == []
+
+
+# ---------------------------------------------------------------------------
+# Age-out machinery
+# ---------------------------------------------------------------------------
+
+
+class TestAgeOut:
+    def test_plan_is_dry(self, tmp_path):
+        _tiered_, local, cold, _bucket = _tiered(tmp_path)
+        local.initialize("prom", 1)
+        local.write_chunks("prom", 0, [_mk_chunkset(1, T0, b"X" * 50)],
+                           ingestion_time=1)
+        mgr = AgeOutManager(local, cold, now_ms_fn=lambda: T0 + 10 ** 9)
+        plan = mgr.plan("prom", 1)
+        assert plan["total_chunks"] == 1 and plan["total_bytes"] > 0
+        assert local.num_chunks("prom", 0) == 1      # nothing moved
+        assert cold.num_chunks("prom", 0) == 0
+        assert mgr.floor_ms("prom") == 0             # no watermark yet
+
+    def test_watermark_persists_and_floors(self, tmp_path):
+        _t, local, cold, _bucket = _tiered(tmp_path)
+        meta = DiskMetaStore(str(tmp_path / "meta.db"))
+        meta.initialize()
+        local.initialize("prom", 2)
+        for sh in (0, 1):
+            local.write_chunks("prom", sh,
+                               [_mk_chunkset(1, T0, b"W" * 40)],
+                               ingestion_time=1)
+        now = T0 + 100_000
+        mgr = AgeOutManager(local, cold, metastore=meta,
+                            now_ms_fn=lambda: now)
+        mgr.run("prom", 1_000, shards=[0])
+        assert mgr.watermark_ms("prom", 0) == now - 1_000
+        assert mgr.watermark_ms("prom", 1) == 0   # never completed a pass
+        mgr.run("prom", 2_000, shards=[1])
+        assert mgr.floor_ms("prom") == now - 2_000   # min across shards
+        # a FRESH manager reloads the watermarks from the metastore KV
+        mgr2 = AgeOutManager(local, cold, metastore=meta,
+                             now_ms_fn=lambda: now)
+        assert mgr2.watermark_ms("prom", 0) == now - 1_000
+        assert mgr2.floor_ms("prom") == now - 2_000
+
+    def test_idempotent_second_pass(self, tmp_path):
+        _t, local, cold, _bucket = _tiered(tmp_path)
+        local.initialize("prom", 1)
+        local.write_chunks("prom", 0, [_mk_chunkset(1, T0, b"I" * 40)],
+                           ingestion_time=1)
+        mgr = AgeOutManager(local, cold, now_ms_fn=lambda: T0 + 10 ** 9)
+        assert mgr.run("prom", 1)["total_chunks"] == 1
+        assert mgr.run("prom", 1)["total_chunks"] == 0
+        assert cold.num_chunks("prom", 0) == 1
+
+    def test_corrupt_local_row_never_archived(self, tmp_path):
+        """The verified scan quarantines + skips a corrupt local row —
+        corruption is never uploaded as truth, and the pass still
+        completes for the healthy rows."""
+        from filodb_tpu.integrity.faultinject import FaultInjector
+        _t, local, cold, bucket = _tiered(tmp_path)
+        local.initialize("prom", 1)
+        css = [_mk_chunkset(cid, T0 + cid * 10_000, b"C%d" % cid * 40)
+               for cid in range(3)]
+        local.write_chunks("prom", 0, css, ingestion_time=1)
+        pk, cid = FaultInjector(3).corrupt_stored_chunk(local, "prom", 0,
+                                                        mode="flip")
+        mgr = AgeOutManager(local, cold, now_ms_fn=lambda: T0 + 10 ** 9)
+        rep = mgr.run("prom", 1)
+        assert rep["total_chunks"] == 2
+        assert QUARANTINE.is_quarantined(pk, cid)
+        archived = {m.chunk_id for m in
+                    cold._select("prom", 0, None, 0, 2 ** 62)}
+        assert cid not in archived and len(archived) == 2
+
+
+# ---------------------------------------------------------------------------
+# ODP paging through the cold tier + chaos
+# ---------------------------------------------------------------------------
+
+
+def _age_out_everything(local, cold, meta=None):
+    mgr = AgeOutManager(local, cold, metastore=meta,
+                        now_ms_fn=lambda: T0 + 10 ** 10)
+    return mgr.run("prom", 1)
+
+
+class TestColdPaging:
+    def test_paged_scan_bitequal_to_resident(self, tmp_path):
+        tiered, local, cold, _bucket = _tiered(tmp_path)
+        disk, meta, ms, sh = _build_persisted(tmp_path, store=tiered)
+        want = _snapshot(sh)
+        rep = _age_out_everything(local, cold)
+        assert rep["total_chunks"] > 0
+        assert local.num_chunks("prom", 0) == 0
+        before = _counters()
+        fresh = TimeSeriesMemStore(tiered, meta)
+        fresh.setup("prom", DEFAULT_SCHEMAS, 0, StoreConfig())
+        assert fresh.recover_index("prom", 0) == N_SERIES
+        got = _snapshot(fresh.get_shard("prom", 0))
+        assert got == want  # every sample paged back from the bucket
+        after = _counters()
+        assert after["fetches"] - before["fetches"] >= rep["total_chunks"]
+        assert after["fetch_bytes"] > before["fetch_bytes"]
+        assert cold.cold_page_bytes("prom", 0) > 0
+        # the fetched bytes get their own fmt=cold-page HBM-ledger row
+        from filodb_tpu.utils.devicewatch import LEDGER
+        assert LEDGER.pools().get("coldstore:prom/0", {}).get("bytes", 0) \
+            == cold.cold_page_bytes("prom", 0)
+        cold.shutdown()
+        assert "coldstore:prom/0" not in LEDGER.pools()
+
+    @pytest.mark.parametrize("mode", ["flip", "truncate"])
+    def test_corrupt_object_quarantined_not_served(self, tmp_path, mode):
+        """A damaged bucket object (bit flip / truncation) is dropped at
+        CRC-on-fetch: the scan serves the surviving series, the chunk is
+        quarantined, the corrupt-fetch counter bumps — the bad bytes are
+        NEVER decoded into results."""
+        tiered, local, cold, bucket = _tiered(tmp_path)
+        disk, meta, ms, sh = _build_persisted(tmp_path, store=tiered)
+        _age_out_everything(local, cold)
+        victim = bucket.object_keys()[0]
+        bucket.corrupt_object(victim, mode=mode)
+        meta_v = parse_object_key(victim,
+                                  size=len(bucket.get_object(
+                                      victim, timeout_s=5)))
+        before = _counters()
+        fresh = TimeSeriesMemStore(tiered, meta)
+        fresh.setup("prom", DEFAULT_SCHEMAS, 0, StoreConfig())
+        fresh.recover_index("prom", 0)
+        tags, _batch = _scan(fresh.get_shard("prom", 0))
+        assert len(tags) == N_SERIES - 1
+        assert QUARANTINE.is_quarantined(meta_v.partkey, meta_v.chunk_id)
+        after = _counters()
+        assert after["fetch_corrupt"] - before["fetch_corrupt"] == 1
+
+    def test_stalled_bucket_is_deadline_refusal_not_wedge(self, tmp_path):
+        tiered, local, cold, bucket = _tiered(tmp_path)
+        disk, meta, ms, sh = _build_persisted(tmp_path, store=tiered)
+        _age_out_everything(local, cold)
+        bucket.stall_s = 60.0
+        cold.fetch_timeout_s = 0.2
+        before = _counters()
+        fresh = TimeSeriesMemStore(tiered, meta)
+        fresh.setup("prom", DEFAULT_SCHEMAS, 0, StoreConfig())
+        fresh.recover_index("prom", 0)
+        shard = fresh.get_shard("prom", 0)
+        done = threading.Event()
+        err: list = []
+
+        def run():
+            try:
+                _scan(shard)
+            except BucketTimeout as e:
+                err.append(e)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=run, daemon=True)
+        t0 = time.monotonic()
+        t.start()
+        assert done.wait(30.0), "scan wedged on a stalled bucket"
+        assert err, "stalled fetch must refuse loudly, not serve"
+        assert time.monotonic() - t0 < 30.0
+        after = _counters()
+        assert after["fetch_timeouts"] > before["fetch_timeouts"]
+        # nothing was quarantined — a stall is an availability event,
+        # not data corruption
+        assert not QUARANTINE.is_quarantined(b"", 0)
+
+    def test_byte_cap_enforced_before_any_fetch(self, tmp_path):
+        from filodb_tpu.store.columnstore import ScanBytesExceeded
+        tiered, local, cold, bucket = _tiered(tmp_path)
+        disk, meta, ms, sh = _build_persisted(tmp_path, store=tiered)
+        _age_out_everything(local, cold)
+        before = _counters()
+        with pytest.raises(ScanBytesExceeded):
+            cold.read_raw_rows("prom", 0, None, 0, 2 ** 62, byte_cap=1)
+        after = _counters()
+        # the refusal came from key metadata alone — zero objects read
+        assert after["fetches"] == before["fetches"]
+
+
+# ---------------------------------------------------------------------------
+# Three-tier stitch: raw -> rolled-local -> rolled-cold
+# ---------------------------------------------------------------------------
+
+
+class StitchHarness:
+    """Raw + one rolled tier over a TieredColumnStore, router wired the
+    way standalone wires it (cold_floor_fn from the AgeOutManager)."""
+
+    RES = 60_000
+
+    def __init__(self, tmp_path):
+        self.tiered, self.local, self.cold, self.bucket = _tiered(tmp_path)
+        self.meta = DiskMetaStore(str(tmp_path / "meta.db"))
+        self.meta.initialize()
+        self.ms = TimeSeriesMemStore(self.tiered, self.meta)
+        self.ms.setup("prom", DEFAULT_SCHEMAS, 0)
+        self.tier_ds = ds_dataset_name("prom", self.RES)
+        self.ms.setup(self.tier_ds, DEFAULT_SCHEMAS, 0)
+        self.offsets: dict = {}
+        self.engine = RollupEngine(node="test")
+        self.engine.watch("prom", self.ms, DEFAULT_SCHEMAS,
+                          RollupConfig(resolutions_ms=(self.RES,)),
+                          {self.RES: self._pub()},
+                          column_store=self.tiered,
+                          meta_store=self.meta)
+        self.mgr = AgeOutManager(self.local, self.cold,
+                                 metastore=self.meta)
+        self.raw_planner = SingleClusterPlanner(
+            "prom", ShardMapper(1), DatasetOptions(), spread_default=0)
+        self.tier_planner = SingleClusterPlanner(
+            self.tier_ds, ShardMapper(1), DatasetOptions(),
+            spread_default=0)
+
+    def _pub(self):
+        def pub(shard, container):
+            off = self.offsets.get(shard, -1) + 1
+            self.offsets[shard] = off
+            self.ms.ingest(self.tier_ds, shard, container, off)
+        return pub
+
+    def router(self, cold_floor=None):
+        return RollupRouterPlanner(
+            "prom", self.raw_planner, {self.RES: self.tier_planner},
+            rolled_through_fn=lambda r: self.engine.rolled_through(
+                "prom", r),
+            cold_floor_fn=cold_floor)
+
+    def run_query(self, promql, start, step, end, ms=None,
+                  cold_floor=None):
+        qctx = QueryContext(sample_limit=10 ** 9)
+        plan = query_range_to_logical_plan(promql, start, step, end)
+        ep = self.router(cold_floor).materialize(plan, qctx)
+        res = ep.execute(ExecContext(ms or self.ms, qctx))
+        out = {}
+        for b in res.batches:
+            vals = b.np_values()
+            for i, tags in enumerate(b.keys):
+                out[tags.get("inst", "")] = (
+                    np.asarray(b.steps.timestamps()).tolist(),
+                    [(-1.0 if np.isnan(v) else float(v))
+                     for v in vals[i]])
+        return out, res, qctx
+
+
+@pytest.fixture()
+def stitch(tmp_path):
+    h = StitchHarness(tmp_path)
+    rng = np.random.default_rng(23)
+    b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], DatasetOptions())
+    # 6h at 30s cadence, 2 series
+    ts = T0 + np.arange(0, 6 * 3_600_000, 30_000, dtype=np.int64) + 1
+    for i in range(2):
+        b.add_series(ts, [rng.normal(5, 1, len(ts))],
+                     {"_metric_": "m", "inst": f"i{i}",
+                      "_ws_": "w", "_ns_": "n"})
+    off = 0
+    for c in b.containers():
+        h.ms.ingest("prom", 0, c, off)
+        off += 1
+    h.ms.get_shard("prom", 0).flush_all(ingestion_time=1_000)
+    h.engine.run_once("prom")
+    h.ms.get_shard(h.tier_ds, 0).flush_all(ingestion_time=2_000)
+    return h, int(ts[-1])
+
+
+class TestThreeTierStitch:
+    Q = 'count_over_time(m{_ws_="w",_ns_="n"}[5m])'
+    STEP = 300_000
+
+    def test_stitched_bitequal_and_attributed(self, stitch):
+        h, last = stitch
+        # end one step past the last raw sample: past the tier's closure
+        # watermark, so the stitched plan must include a raw leg
+        start = T0 + 1_800_000
+        end = (last // self.STEP) * self.STEP + self.STEP
+        # oracle: everything resident/local, no cold floor
+        want, _res, _q = h.run_query(self.Q, start, self.STEP, end)
+        # archive rolled rows older than T0+3h, then query through a
+        # FRESH memstore so the cold leg truly pages from the bucket
+        cutoff = T0 + 3 * 3_600_000
+        h.mgr.run(h.tier_ds, int(time.time() * 1000) - cutoff)
+        assert h.mgr.floor_ms(h.tier_ds) >= cutoff - 1
+        floor = h.mgr.floor_ms
+        fresh = TimeSeriesMemStore(h.tiered, h.meta)
+        fresh.setup("prom", DEFAULT_SCHEMAS, 0)
+        fresh.setup(h.tier_ds, DEFAULT_SCHEMAS, 0)
+        fresh.recover_index("prom", 0)
+        fresh.recover_index(h.tier_ds, 0)
+        got, res, qctx = h.run_query(
+            self.Q, start, self.STEP, end, ms=fresh,
+            cold_floor=lambda r: floor(ds_dataset_name("prom", r)))
+        assert got == want
+        assert set(qctx.rollup_tiers) == {"rolled-cold", "rolled-local",
+                                          "raw"}
+        assert canonical_tiers(qctx.rollup_tiers) == \
+            "rolled-cold+rolled-local+raw"
+
+    def test_cold_only_range_never_scans_raw(self, stitch):
+        """A query wholly below the cold floor plans ONE rolled-cold
+        leg and reads ZERO raw-dataset rows — the never-scans-raw
+        acceptance gate, pinned on the tiered store's read counters."""
+        h, last = stitch
+        h.mgr.run(h.tier_ds, int(time.time() * 1000) - (last + 1))
+        floor = h.mgr.floor_ms
+        fresh = TimeSeriesMemStore(h.tiered, h.meta)
+        fresh.setup("prom", DEFAULT_SCHEMAS, 0)
+        fresh.setup(h.tier_ds, DEFAULT_SCHEMAS, 0)
+        fresh.recover_index("prom", 0)
+        fresh.recover_index(h.tier_ds, 0)
+        h.tiered.rows_read_by_dataset.clear()
+        start = T0 + 1_800_000
+        end = T0 + 2 * 3_600_000
+        got, res, qctx = h.run_query(
+            self.Q, start, self.STEP, end, ms=fresh,
+            cold_floor=lambda r: floor(ds_dataset_name("prom", r)))
+        assert got  # the archived region still serves
+        assert qctx.rollup_tiers == ["rolled-cold"]
+        assert h.tiered.rows_read_by_dataset.get("prom", 0) == 0
+        assert h.tiered.rows_read_by_dataset.get(h.tier_ds, 0) > 0
+
+    def test_stats_carry_cold_attribution(self, stitch):
+        h, last = stitch
+        h.mgr.run(h.tier_ds, int(time.time() * 1000) - (last + 1))
+        floor = h.mgr.floor_ms
+        fresh = TimeSeriesMemStore(h.tiered, h.meta)
+        fresh.setup("prom", DEFAULT_SCHEMAS, 0)
+        fresh.setup(h.tier_ds, DEFAULT_SCHEMAS, 0)
+        fresh.recover_index("prom", 0)
+        fresh.recover_index(h.tier_ds, 0)
+        _got, res, _qctx = h.run_query(
+            self.Q, T0 + 1_800_000, self.STEP, T0 + 2 * 3_600_000,
+            ms=fresh,
+            cold_floor=lambda r: floor(ds_dataset_name("prom", r)))
+        assert res.stats.cold_chunks_paged > 0
+        assert res.stats.cold_bytes_read > 0

@@ -1,0 +1,257 @@
+"""M4 visualization downsampling (ISSUE 16, doc/coldstore.md).
+
+Oracle strategy: the device kernel is SELECTION-only — per pixel bin
+it picks min/max/first/last values and their indices, never computing
+new values — so the interpret-mode kernel, the portable jnp reference
+and a pure-NumPy loop oracle must all be BIT-equal (float32), across
+NaN gaps, constant runs (ties break to the FIRST occurrence), all-NaN
+bins and partial tiles.  The DownsampleMapper keeps <= 4*pixels points
+per series and only ever re-emits original samples; the HTTP
+``?downsample=`` edge wires it in and carries the points-in/out stats.
+"""
+
+import json
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.cluster import ShardManager
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+from filodb_tpu.http.server import DatasetBinding, FiloHttpServer
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.ops.grid import (M4_PLANES, m4_grid, m4_grid_auto,
+                                 m4_grid_ref)
+from filodb_tpu.parallel.shardmap import ShardMapper, ShardStatus
+from filodb_tpu.query.model import PeriodicBatch, StepRange
+from filodb_tpu.query.transformers import DownsampleMapper
+
+BASE = 1_700_000_000_000
+
+
+# ---------------------------------------------------------------------------
+# NumPy loop oracle
+# ---------------------------------------------------------------------------
+
+
+def _m4_oracle(vals: np.ndarray, pixels: int) -> np.ndarray:
+    """Per (bin, series): [vmin vmax vfirst vlast imin imax ifirst
+    ilast], indices LOCAL to the bin, -1 / NaN for empty bins — the
+    M4_PLANES contract, written as the obvious double loop."""
+    vals = np.asarray(vals, np.float32)
+    t, s = vals.shape
+    w = -(-t // pixels)
+    pad = np.full((pixels * w - t, s), np.nan, np.float32)
+    v = np.concatenate([vals, pad], axis=0).reshape(pixels, w, s)
+    out = np.empty((pixels, 8, s), np.float32)
+    for p in range(pixels):
+        for j in range(s):
+            col = v[p, :, j]
+            idxs = np.flatnonzero(np.isfinite(col))
+            if len(idxs) == 0:
+                out[p, :4, j] = np.nan
+                out[p, 4:, j] = -1.0
+                continue
+            imin = idxs[np.argmin(col[idxs])]   # first occurrence wins
+            imax = idxs[np.argmax(col[idxs])]
+            ifirst, ilast = idxs[0], idxs[-1]
+            out[p, :, j] = (col[imin], col[imax], col[ifirst],
+                            col[ilast], imin, imax, ifirst, ilast)
+    return out
+
+
+def _bit_equal(a, b) -> bool:
+    return np.array_equal(np.asarray(a), np.asarray(b), equal_nan=True)
+
+
+def _cases():
+    rng = np.random.default_rng(5)
+    t, s = 103, 8
+    gappy = rng.normal(0, 10, (t, s)).astype(np.float32)
+    gappy[rng.random((t, s)) < 0.3] = np.nan     # NaN gaps
+    const = np.ones((t, s), np.float32) * 7.5    # constant runs (ties)
+    allnan = gappy.copy()
+    allnan[:, 3] = np.nan                        # one all-NaN series
+    allnan[40:80, :] = np.nan                    # empty bins mid-range
+    exact = rng.normal(0, 1, (100, s)).astype(np.float32)  # t % P == 0
+    return [("gappy", gappy, 10), ("const", const, 10),
+            ("allnan", allnan, 10), ("exact", exact, 10),
+            ("partial-tail", gappy, 9),          # w*P > T: padded tile
+            ("one-per-bin", exact, 100)]         # w == 1
+
+
+class TestM4Kernel:
+    @pytest.mark.parametrize("name,vals,pixels",
+                             _cases(), ids=[c[0] for c in _cases()])
+    def test_interpret_kernel_bitequal_to_oracle(self, name, vals,
+                                                 pixels):
+        """CPU CI drives the real pallas kernel body in interpret mode:
+        it must match the NumPy loop oracle BIT for bit."""
+        want = _m4_oracle(vals, pixels)
+        got = np.asarray(m4_grid(vals, pixels, lanes=8, interpret=True))
+        assert got.shape == (pixels, 8, vals.shape[1])
+        for k, plane in enumerate(M4_PLANES):
+            assert _bit_equal(got[:, k, :], want[:, k, :]), (name, plane)
+
+    @pytest.mark.parametrize("name,vals,pixels",
+                             _cases(), ids=[c[0] for c in _cases()])
+    def test_portable_ref_bitequal_to_oracle(self, name, vals, pixels):
+        assert _bit_equal(m4_grid_ref(vals, pixels),
+                          _m4_oracle(vals, pixels)), name
+
+    def test_ties_break_to_first_occurrence(self):
+        # [5, 1, 1, 5, 5] in one bin: min at LOCAL index 1, max at 0
+        vals = np.array([[5], [1], [1], [5], [5]], np.float32)
+        got = np.asarray(m4_grid_ref(vals, 1))[0, :, 0]
+        assert got[4] == 1.0 and got[5] == 0.0    # imin, imax
+        assert got[6] == 0.0 and got[7] == 4.0    # ifirst, ilast
+
+    def test_auto_dispatch_matches_ref(self):
+        rng = np.random.default_rng(9)
+        vals = rng.normal(0, 1, (77, 16)).astype(np.float32)
+        assert _bit_equal(m4_grid_auto(vals, 7), m4_grid_ref(vals, 7))
+
+
+# ---------------------------------------------------------------------------
+# DownsampleMapper
+# ---------------------------------------------------------------------------
+
+
+def _batch(vals: np.ndarray, step=30_000) -> PeriodicBatch:
+    s, t = vals.shape
+    return PeriodicBatch([{"inst": f"i{i}"} for i in range(s)],
+                         StepRange(BASE, BASE + (t - 1) * step, step), vals)
+
+
+class TestDownsampleMapper:
+    def test_keeps_at_most_4x_pixels_only_original_samples(self):
+        rng = np.random.default_rng(3)
+        t, s, px = 10_000, 3, 100
+        vals = rng.normal(0, 5, (s, t))
+        vals[rng.random((s, t)) < 0.1] = np.nan
+        [out] = DownsampleMapper(pixels=px).apply([_batch(vals)], None)
+        thinned = out.np_values()
+        f32 = vals.astype(np.float32)
+        for i in range(s):
+            kept = np.isfinite(thinned[i])
+            assert kept.sum() <= 4 * px
+            # every kept point is the original sample at that step
+            assert np.array_equal(thinned[i][kept], f32[i][kept])
+        # pixel-exactness: per bin, min and max survive the thinning
+        w = -(-t // px)
+        for i in range(s):
+            for p in range(0, px, 17):
+                seg, out_seg = f32[i, p * w:(p + 1) * w], \
+                    thinned[i, p * w:(p + 1) * w]
+                if np.isfinite(seg).any():
+                    assert np.nanmin(seg) in out_seg[np.isfinite(out_seg)]
+                    assert np.nanmax(seg) in out_seg[np.isfinite(out_seg)]
+
+    def test_passthrough_when_already_small(self):
+        vals = np.arange(12, dtype=np.float64).reshape(2, 6)
+        b = _batch(vals)
+        [out] = DownsampleMapper(pixels=6).apply([b], None)
+        assert out is b    # num_steps <= pixels: untouched
+        [out2] = DownsampleMapper(pixels=1000).apply([b], None)
+        assert out2 is b
+
+    def test_stats_count_points(self):
+        from filodb_tpu.query.exec import ExecContext
+        from filodb_tpu.query.model import QueryStats
+        rng = np.random.default_rng(4)
+        vals = rng.normal(0, 1, (2, 5_000))
+        ctx = ExecContext(None)
+        DownsampleMapper(pixels=50).apply([_batch(vals)], ctx)
+        qs = QueryStats()
+        ctx.fold_into(qs)
+        assert qs.downsample_points_in == 10_000
+        assert 0 < qs.downsample_points_out <= 2 * 4 * 50
+
+
+# ---------------------------------------------------------------------------
+# HTTP ?downsample=
+# ---------------------------------------------------------------------------
+
+
+def _get(port, path, **params):
+    qs = urllib.parse.urlencode(params)
+    url = f"http://127.0.0.1:{port}{path}" + (f"?{qs}" if qs else "")
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture(scope="class")
+def server():
+    mapper = ShardMapper(1)
+    mapper.register_node(range(1), "local")
+    mapper.update_status(0, ShardStatus.ACTIVE)
+    ms = TimeSeriesMemStore()
+    ms.setup("prom", DEFAULT_SCHEMAS, 0)
+    rng = np.random.default_rng(0)
+    b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], DatasetOptions())
+    ts = BASE + np.arange(4_000, dtype=np.int64) * 15_000
+    for i in range(2):
+        b.add_series(ts, [rng.normal(3, 1, len(ts))],
+                     {"_metric_": "g", "inst": f"i{i}",
+                      "_ws_": "w", "_ns_": "n"})
+    for off, c in enumerate(b.containers()):
+        ms.get_shard("prom", 0).ingest_container(c, off)
+    mgr = ShardManager()
+    mgr.setup_dataset("prom", 1, min_num_nodes=1)
+    mgr.add_node("local")
+    planner = SingleClusterPlanner("prom", mapper, DatasetOptions(),
+                                   spread_default=0)
+    srv = FiloHttpServer(shard_manager=mgr)
+    srv.bind_dataset(DatasetBinding("prom", ms, planner))
+    port = srv.start()
+    yield port
+    srv.shutdown()
+
+
+class TestHttpDownsample:
+    Q = 'g{_ws_="w",_ns_="n"}'
+    END = BASE + 4_000 * 15_000
+
+    def _points(self, body):
+        return {r["metric"]["inst"]: r["values"]
+                for r in body["data"]["result"]}
+
+    def test_egress_reduction_and_stats(self, server):
+        code, full = _get(server, "/promql/prom/api/v1/query_range",
+                          query=self.Q, start=BASE / 1000,
+                          end=self.END / 1000, step="15s", stats="true")
+        assert code == 200
+        code, thin = _get(server, "/promql/prom/api/v1/query_range",
+                          query=self.Q, start=BASE / 1000,
+                          end=self.END / 1000, step="15s", stats="true",
+                          downsample="64")
+        assert code == 200
+        fullp, thinp = self._points(full), self._points(thin)
+        assert set(fullp) == set(thinp)
+        for inst in fullp:
+            n_full, n_thin = len(fullp[inst]), len(thinp[inst])
+            assert n_thin <= 4 * 64
+            assert n_full / n_thin >= 10   # real egress reduction
+            # pixel-exact: every served point is an original sample
+            orig = {t: np.float32(float(v)) for t, v in fullp[inst]}
+            for t, v in thinp[inst]:
+                assert t in orig and np.float32(float(v)) == orig[t]
+        st = thin["data"]["stats"]["downsample"]
+        assert st["pointsIn"] >= 2 * 4_000
+        assert 0 < st["pointsOut"] <= 2 * 4 * 64
+        assert full["data"]["stats"]["downsample"]["pointsOut"] == 0
+
+    def test_invalid_downsample_is_client_error(self, server):
+        for bad in ("abc", "-4", "0", "2000000"):
+            code, body = _get(server, "/promql/prom/api/v1/query_range",
+                              query=self.Q, start=BASE / 1000,
+                              end=(BASE + 600_000) / 1000, step="15s",
+                              downsample=bad)
+            assert code == 400, bad
+            assert body["errorType"] == "bad_data"

@@ -253,6 +253,40 @@ def test_deadline_lint_catches_fixed_timeout():
     assert _fake(plain_ok, ["deadline-threading"]) == []
 
 
+def test_deadline_lint_covers_cold_bucket_fetches():
+    """ISSUE 16: every cold-bucket ``get_object`` call-site outside the
+    bucket implementations must carry a ``timeout_s`` derived from the
+    remaining deadline/admin budget — a stalled bucket must become a
+    deadline refusal, never a wedged worker."""
+    missing = (
+        "def fetch(self, key):\n"
+        "    return self.bucket.get_object(key)\n"
+    )
+    got = _fake(missing, ["deadline-threading"])
+    assert len(got) == 1 and "without timeout_s" in got[0].message
+    fixed = (
+        "def fetch(self, key):\n"
+        "    return self.bucket.get_object(key, timeout_s=30.0)\n"
+    )
+    got = _fake(fixed, ["deadline-threading"])
+    assert len(got) == 1 and "thread the deadline" in got[0].message
+    ok = (
+        "def fetch(self, key):\n"
+        "    deadline_timeout_s = self._fetch_timeout_s()\n"
+        "    return self.bucket.get_object(key,\n"
+        "                                  timeout_s=deadline_timeout_s)\n"
+    )
+    assert _fake(ok, ["deadline-threading"]) == []
+    # the bucket IMPLEMENTATION defines get_object and may call through
+    # to a wrapped delegate without re-deriving the budget
+    impl = (
+        "def get_object(self, key, *, timeout_s):\n"
+        "    return self.inner.get_object(key, timeout_s=timeout_s)\n"
+    )
+    assert _fake(impl, ["deadline-threading"],
+                 rel="filodb_tpu/coldstore/bucket.py") == []
+
+
 # ---------------------------------------------------------------------------
 # metric-doc (ISSUE 6)
 # ---------------------------------------------------------------------------
