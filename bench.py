@@ -36,6 +36,12 @@ FOUR variants are measured and emitted (ISSUE 3; hist + topK ISSUE 14):
   a two-column packed event table; equivalence vs the decoded-plane
   free kernel + XLA group reduce + top_k asserted before timing.
   Samples count BOTH scanned columns.
+- ``mesh_fabric`` (ISSUE 18): the END-TO-END SPMD mesh query fabric —
+  promql -> planner -> MeshReduceExec -> ONE shard_map program over
+  N device-resident shards with the cross-shard psum on device.  Owns
+  launches/query (must be exactly 1.0 warm, kernel-launch ledger at
+  1-in-1 sampling) and achieved scan bytes/s; answers are asserted
+  BIT-equal to the scatter-gather oracle before timing.
 
 The run FAILS (nonzero rc + machine-readable error JSON) if any
 equivalence assertion trips or a measured variant regresses >20%
@@ -108,6 +114,15 @@ P_H = int(os.environ.get("FILODB_BENCH_HIST_PER_GROUP", 64))
 E_L = int(os.environ.get("FILODB_BENCH_EVENT_LANES", 262_144))
 E_G = int(os.environ.get("FILODB_BENCH_EVENT_GROUPS", 4_096))
 E_K = int(os.environ.get("FILODB_BENCH_EVENT_K", 10))
+# mesh fabric variant (ISSUE 18): the END-TO-END fused serving path —
+# planner -> MeshReduceExec -> ONE shard_map program over N resident
+# shards.  Small by design: it measures launches/query and per-query
+# overhead of the real fabric, not raw kernel FLOPs (those are the four
+# variants above).
+M_SHARDS = int(os.environ.get("FILODB_BENCH_MESH_SHARDS", 8))
+M_SERIES = int(os.environ.get("FILODB_BENCH_MESH_SERIES", 192))
+M_ROWS = int(os.environ.get("FILODB_BENCH_MESH_ROWS", 240))
+M_ITERS = int(os.environ.get("FILODB_BENCH_MESH_ITERS", 12))
 
 
 def _probe_backend(timeout_s: int):
@@ -163,8 +178,12 @@ def main():
         # BOTH variants still run end-to-end (tiny shapes, interpret
         # mode) so a broken kernel fails here, not only on the TPU
         _cpu_interpret_smoke()
+        # the fabric variant is backend-agnostic: run its bit-equality
+        # and one-launch gates end-to-end even without hardware
+        _bench_mesh_fabric()
         log("no TPU backend: interpret-mode variant smoke (all four "
-            "variants) passed; skipping measurement")
+            "kernel variants) + mesh-fabric equivalence passed; "
+            "skipping measurement")
         print(json.dumps({
             "metric": "PromQL samples scanned/sec (rate()+sum-by)",
             "value": 0.0, "unit": "samples/sec", "vs_baseline": 0.0,
@@ -398,6 +417,7 @@ def main():
                                 lambda: _bench_hist_quantile(timed))
     topk_var = _guarded_variant("gdelt_topk",
                                 lambda: _bench_event_topk(timed))
+    mesh_var = _guarded_variant("mesh_fabric", _bench_mesh_fabric)
 
     # -- CPU baseline (C++ multithreaded JVM proxy) on a subsample ----------
     from filodb_tpu.native import baseline as cpp_baseline
@@ -487,6 +507,7 @@ def main():
             },
             "histogram_quantile": hist_var,
             "gdelt_topk": topk_var,
+            "mesh_fabric": mesh_var,
         },
     }))
 
@@ -746,6 +767,120 @@ def _bench_event_topk(timed):
     return {"samples_per_sec": round(rate, 1),
             "bytes_per_sample": round(bps, 2),
             "equiv_max_rel_err": t_rel}
+
+
+def _bench_mesh_fabric():
+    """SPMD mesh query fabric (ISSUE 18): ``sum by (grp)(metric)`` over
+    M_SHARDS device-resident shards served END-TO-END — promql parse ->
+    planner -> MeshReduceExec -> ONE compiled shard_map program with the
+    cross-shard psum on device and a single [G, T] readback.  Unlike the
+    kernel variants above this runs the real serving stack, so the
+    numbers it owns are launches/query (from the kernel-launch ledger at
+    1-in-1 sampling — MUST be exactly 1.0 warm) and achieved scan
+    bytes/s.  Device equivalence vs the scatter-gather oracle is
+    asserted BIT-exact before timing: the workload is dyadic (integer
+    multiples of 1/8, group sums < 2^24 eighths) so every sum is exact
+    in BOTH f32 (TPU grid planes) and f64 (host oracle) at any
+    summation order."""
+    from filodb_tpu.coordinator.planner import SingleClusterPlanner
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.parallel import meshgrid
+    from filodb_tpu.parallel.mesh import MeshEngine, make_mesh
+    from filodb_tpu.parallel.shardmap import ShardMapper, shard_of_tags
+    from filodb_tpu.promql.parser import query_range_to_logical_plan
+    from filodb_tpu.query.exec import ExecContext
+    from filodb_tpu.query.model import QueryContext
+    from filodb_tpu.utils.devicewatch import KERNEL_TIMER, device_metrics
+
+    base, gstep = 1_700_000_000_000, 10_000
+    spread = max(M_SHARDS.bit_length() - 1, 0)
+    start = base + 300_000                  # 5m lookback stays in-span
+    end = base + (M_ROWS - 1) * gstep
+    log(f"mesh fabric: {M_SERIES} series over {M_SHARDS} shards x "
+        f"{M_ROWS} rows...")
+    ms = TimeSeriesMemStore()
+    opts = DatasetOptions()
+    mapper = ShardMapper(M_SHARDS)
+    for s in range(M_SHARDS):
+        ms.setup("prom", DEFAULT_SCHEMAS, s)
+    rng = np.random.default_rng(101)
+    for i in range(M_SERIES):
+        tags = {"_metric_": "mf", "inst": f"i{i}", "grp": f"g{i % 16}",
+                "_ws_": "w", "_ns_": "n"}
+        shard = shard_of_tags(tags, M_SHARDS, spread, opts)
+        b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], opts,
+                          container_size=1 << 20)
+        ts = base + np.arange(M_ROWS) * gstep
+        dyadic = rng.integers(1, 1 << 15, M_ROWS).astype(np.float64) / 8.0
+        b.add_series(ts.tolist(), [dyadic.tolist()], tags)
+        for off, c in enumerate(b.containers()):
+            ms.get_shard("prom", shard).ingest_container(c, off)
+
+    def planner(mesh: bool):
+        provider = None
+        if mesh:
+            engine = MeshEngine(make_mesh())
+            provider = lambda: engine  # noqa: E731
+        return SingleClusterPlanner("prom", mapper, DatasetOptions(),
+                                    spread_default=spread,
+                                    mesh_engine_provider=provider)
+
+    lp = query_range_to_logical_plan(
+        'sum by (grp)(mf{_ws_="w",_ns_="n"})', start, 30_000, end)
+
+    def run(pl):
+        res = pl.materialize(lp, QueryContext()) \
+            .execute(ExecContext(ms, QueryContext()))
+        out = {}
+        for bt in res.batches:
+            for tg, tss, vs in bt.to_series():
+                out[tuple(sorted(tg.items()))] = (np.asarray(tss),
+                                                  np.asarray(vs))
+        return out
+
+    fused_pl, oracle_pl = planner(True), planner(False)
+    got, want = run(fused_pl), run(oracle_pl)
+    if set(got) != set(want) or not want:
+        fail("mesh fabric answered a different series set than the "
+             "scatter-gather oracle")
+    for k in want:
+        ga = np.asarray(got[k][1], dtype=np.float64)
+        wa = np.asarray(want[k][1], dtype=np.float64)
+        if not (np.array_equal(np.isnan(ga), np.isnan(wa))
+                and ga.tobytes() == wa.tobytes()):
+            fail(f"mesh fabric NOT bit-equal to scatter-gather for {k}")
+    serves0 = meshgrid.STATS["fused_serves"]
+    prev = KERNEL_TIMER.sample_1_in
+    KERNEL_TIMER.configure(sample_1_in=1)
+    try:
+        run(fused_pl)                       # warm under 1-in-1 sampling
+        c = device_metrics()["kernel_launches"]
+        before = c.total()
+        a = time.perf_counter()
+        for _ in range(M_ITERS):
+            run(fused_pl)
+        el = max(time.perf_counter() - a, 1e-9)
+        launches = (c.total() - before) / M_ITERS
+    finally:
+        KERNEL_TIMER.configure(sample_1_in=prev)
+    if meshgrid.STATS["fused_serves"] <= serves0:
+        fail("mesh fabric never took the fused rung (fallback served "
+             "the bench workload)")
+    if launches != 1.0:
+        fail(f"warm mesh-fabric query is not ONE compiled launch "
+             f"(measured {launches:.2f}/query)")
+    # every step scans its 5m lookback window from the f32 grid plane
+    nsteps = (end - start) // 30_000 + 1
+    samples = M_SERIES * nsteps * (300_000 // gstep)
+    rate = samples * M_ITERS / el
+    log(f"mesh_fabric: {launches:.1f} launch/query, {rate:.3e} "
+        f"samples/sec ({M_ITERS} queries in {el:.3f}s)")
+    return {"launches_per_query": launches,
+            "samples_per_sec": round(rate, 1),
+            "bytes_per_sec": round(rate * 4, 1),   # f32 resident plane
+            "equiv": "bitwise"}
 
 
 def _cpu_interpret_smoke():

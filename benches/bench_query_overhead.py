@@ -173,6 +173,62 @@ def main():
             f"{kt_overhead * 100:.2f}% exceeds the 3% budget")
         return 1
 
+    # mesh-fabric guard (ISSUE 18): the same loop planned through the
+    # SPMD mesh fabric — MeshReduceExec root, ONE compiled shard_map
+    # launch, a single [G, T] readback — interleaved A/B against the
+    # scatter-gather planner.  On a one-host bench the fabric's win is
+    # launches and readbacks, not wall-clock, so the guard is that its
+    # host-side orchestration (placement lookup, staging memo, fused
+    # dispatch, presented-batch assembly) stays within <=3% / 0.5 ms
+    # of the path it replaces.
+    from filodb_tpu.parallel import meshgrid
+    from filodb_tpu.parallel.mesh import MeshEngine, make_mesh
+    mesh_engine = MeshEngine(make_mesh())
+    planner_mesh = SingleClusterPlanner(
+        "prom", mapper, DatasetOptions(), spread_default=spread,
+        mesh_engine_provider=lambda: mesh_engine)
+
+    def once_mesh():
+        lp = query_range_to_logical_plan(query, start, STEP, end)
+        qctx = QueryContext(submit_time_ms=int(time.time() * 1000))
+        ep = planner_mesh.materialize(lp, qctx)
+        res = ep.execute(ExecContext(ms, qctx))
+        return to_prom_matrix(res)
+
+    body = once_mesh()
+    assert body["data"]["result"], "mesh fabric leg returned nothing"
+    serves0 = meshgrid.STATS["fused_serves"]
+    once_mesh()                            # warm the fused program
+    if meshgrid.STATS["fused_serves"] <= serves0:
+        log("FAIL: mesh-fabric leg fell back to scatter-gather — the "
+            "bench would time the wrong path")
+        return 1
+    once()
+    lat_sg, lat_mesh = [], []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        once()
+        lat_sg.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        once_mesh()
+        lat_mesh.append(time.perf_counter() - t0)
+    med_sg = statistics.median(lat_sg)
+    med_mesh = statistics.median(lat_mesh)
+    mesh_delta = statistics.median(
+        m - s for m, s in zip(lat_mesh, lat_sg))
+    mesh_overhead = mesh_delta / med_sg
+    log(f"mesh fabric scatter-gather {med_sg * 1e3:.2f} ms  "
+        f"fused {med_mesh * 1e3:.2f} ms  paired delta "
+        f"{mesh_delta * 1e6:+.0f} us ({mesh_overhead * 100:+.2f}%)")
+    emit("mesh_fabric_overhead_median", mesh_overhead * 100, "%",
+         scatter_ms=round(med_sg * 1e3, 3),
+         fused_ms=round(med_mesh * 1e3, 3),
+         paired_delta_us=round(mesh_delta * 1e6, 1))
+    if mesh_overhead > 0.03 and mesh_delta > 5e-4:
+        log(f"FAIL: mesh-fabric overhead {mesh_overhead * 100:.2f}% "
+            f"exceeds the 3% budget")
+        return 1
+
     # admission-control guard (ISSUE 5): the same loop routed through
     # the workload front door — deadline mint, index-priced cost
     # estimate, admit permit, calibration observe on release — vs the

@@ -434,7 +434,11 @@ def device_put_ledger(module):
 # ---------------------------------------------------------------------------
 
 _REPLICA_ENUMERATORS = {"replicas", "replica_nodes", "live_replicas"}
-_ROUTING_FN_HINTS = ("failover", "retarget", "hedge_alternate")
+# "mesh_feed" (ISSUE 18): which resident copy feeds the fused mesh
+# fabric is a replica choice like any other — it must route through
+# ReplicaSet.pick, never enumerate replicas or hardcode the local node
+_ROUTING_FN_HINTS = ("failover", "retarget", "hedge_alternate",
+                     "mesh_feed")
 _ROUTING_HELPERS = {"pick", "alternate"}
 
 
@@ -562,6 +566,31 @@ def _devicewatch_jit_sites(module) -> list:
     return sites
 
 
+# fabric modules (ISSUE 18): every compiled program in the mesh query
+# fabric must wear a devicewatch.jit program= so the flight deck
+# attributes its launches — a bare jax.jit there is an invisible launch
+_FABRIC_MODULES = ("parallel/mesh.py", "parallel/meshgrid.py",
+                   "parallel/meshexec.py")
+
+
+def _bare_jit_sites(module) -> list:
+    """``jax.jit(...)`` / ``@jax.jit`` / bare ``jit(...)`` call sites —
+    compiled programs that bypass the devicewatch kernel timer."""
+    def is_bare_jit(n) -> bool:
+        if isinstance(n, ast.Attribute):
+            return n.attr == "jit" and isinstance(n.value, ast.Name) \
+                and n.value.id == "jax"
+        return isinstance(n, ast.Name) and n.id == "jit"
+
+    sites = []
+    for node in module.nodes:
+        if isinstance(node, ast.Call) and is_bare_jit(node.func):
+            sites.append(node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sites.extend(d for d in node.decorator_list if is_bare_jit(d))
+    return sites
+
+
 @rule("kernel-timer-coverage", scope="project",
       doc="devicewatch.jit entry points without a stable unique "
           "program= name")
@@ -571,6 +600,15 @@ def kernel_timer_coverage(project):
     for m in project.modules:
         if m.tree is None or m.rel.endswith(KERNEL_TIMER_ALLOWLIST):
             continue
+        if m.rel.endswith(_FABRIC_MODULES):
+            for node in _bare_jit_sites(m):
+                findings.append(Finding(
+                    "kernel-timer-coverage", m.rel, node.lineno,
+                    "bare jax.jit in a mesh-fabric module — every "
+                    "fused fabric program must compile through "
+                    "devicewatch.jit(program=...) so the flight deck "
+                    "attributes its launches, bytes, and roofline "
+                    "fraction"))
         for node in _devicewatch_jit_sites(m):
             kw = None
             if isinstance(node, ast.Call):

@@ -533,6 +533,16 @@ def dispatcher_factory(mapper, endpoints: dict[str, str],
             return _UnroutableDispatcher(shard, node)
         return d
 
+    def mesh_feed(shard: int) -> bool:
+        """True when THIS node's resident copy feeds the mesh fabric for
+        ``shard`` (ISSUE 18): the replica choice routes through
+        ``ReplicaSet.pick`` — the local copy serves the fused program
+        iff it is the healthiest candidate, so a recovering or lagging
+        local replica never silently feeds stale device grids."""
+        order = replica_set.pick(shard)
+        return bool(order) and order[0] == local_node
+
+    for_shard.mesh_feed = mesh_feed
     return for_shard
 
 

@@ -76,7 +76,8 @@ class SingleClusterPlanner(QueryPlanner):
                  hierarchical_reduce_at: int = 16,
                  min_time_range_for_split_ms: Optional[int] = None,
                  split_size_ms: Optional[int] = None,
-                 mesh_engine_provider: Optional[Callable[[], object]] = None):
+                 mesh_engine_provider: Optional[Callable[[], object]] = None,
+                 mesh_fused: bool = True):
         self.dataset = dataset
         self.mapper = shard_mapper
         self.options = options or DatasetOptions()
@@ -93,6 +94,12 @@ class SingleClusterPlanner(QueryPlanner):
         # (parallel/meshexec.py) instead of per-shard children + host
         # reduce; remote shards keep HTTP dispatch alongside
         self.mesh_engine_provider = mesh_engine_provider
+        # mesh query fabric (ISSUE 18): when every child shard of an
+        # aggregation is mesh-resident on this host, emit MeshReduceExec
+        # as the plan ROOT — ONE compiled launch incl. the cross-shard
+        # psum and present, one [G, T] readback.  Off => the PR 17 form
+        # (MeshAggregateExec partials under a host ReduceAggregateExec)
+        self.mesh_fused = mesh_fused
 
     # -- topology snapshot (ISSUE 13) ---------------------------------------
 
@@ -234,6 +241,9 @@ class SingleClusterPlanner(QueryPlanner):
                 plan.bool_mode))
             return inner
         if isinstance(plan, lp.ApplyInstantFunction):
+            fused = self._maybe_mesh_hist_quantile(plan, qctx)
+            if fused is not None:
+                return fused
             inner = self._walk(plan.vectors, qctx)
             args = tuple(self._scalar_operand(a, qctx)
                          if isinstance(a, lp.LogicalPlan) else a
@@ -386,6 +396,7 @@ class SingleClusterPlanner(QueryPlanner):
         if self.mesh_engine_provider is None:
             return None
         from filodb_tpu.parallel.meshexec import (MeshAggregateExec,
+                                                  MeshReduceExec,
                                                   mesh_supported)
         inner = plan.vectors
         if isinstance(inner, lp.PeriodicSeriesWithWindowing):
@@ -408,20 +419,46 @@ class SingleClusterPlanner(QueryPlanner):
             # per-series exclusion — fall back to per-shard leaves until
             # the split retires (perf-only, bounded by the grace window)
             return None
+        # which resident copy feeds the mesh: shards whose dispatcher is
+        # IN_PROCESS always qualify.  Replicated shards (rf>1 routes
+        # through ReplicaDispatcher, never IN_PROCESS) may join ONLY
+        # when that makes EVERY child shard local and the fused root
+        # eligible: the dispatcher factory's ``mesh_feed`` hook says the
+        # local copy is the ``ReplicaSet.pick`` primary, so the
+        # all-local fused serve IS the pick routing for every leg and
+        # the reduce tree stays whole on every node that fuses.  A
+        # partial mix of mesh legs and dispatched legs is deliberately
+        # never built from feed shards — each replica-holding node would
+        # regroup the float reduce differently and cross-node answers
+        # would drift by summation order mid-failover
+        # (tests/test_split_e2e.py's bit-equality contract).
         local = [s for s in shards
                  if self.dispatcher_for_shard(s) is IN_PROCESS]
+        if self.mesh_fused and len(local) < len(shards):
+            feed = getattr(self.dispatcher_for_shard, "mesh_feed", None)
+            if feed is not None:
+                fed = [s for s in shards if s in set(local) or feed(s)]
+                if len(fed) == len(shards):
+                    local = fed
         remote = [s for s in shards if s not in local]
         if len(local) < 2:
             return None   # nothing to fuse; per-shard path is simpler
         engine = self.mesh_engine_provider()
-        mesh_child = MeshAggregateExec(
+        # every child shard mesh-resident here + fabric on => the fused
+        # root IS the whole plan (it returns PRESENTED batches)
+        fuse_root = self.mesh_fused and not remote
+        node_cls = MeshReduceExec if fuse_root else MeshAggregateExec
+        mesh_child = node_cls(
             self.dataset, local, raw.filters,
             raw.range_selector.from_ms, raw.range_selector.to_ms,
             inner.start_ms, inner.step_ms, inner.end_ms, plan.operator,
             window_ms=window, function=function, function_args=args,
             offset_ms=inner.offset_ms or 0, by=plan.by,
             without=plan.without, params=plan.params, query_context=qctx,
-            engine=engine)
+            engine=engine, mapper=self.mapper,
+            planned_generation=topo.generation)
+        if fuse_root:
+            return mesh_child
         # remote shards: the ordinary per-shard construction (_periodic
         # builds leaf+mapper exactly as the non-mesh path would)
         mapred = AggregateMapReduce(plan.operator, plan.params, plan.by,
@@ -443,6 +480,36 @@ class SingleClusterPlanner(QueryPlanner):
         root = ReduceAggregateExec([mesh_child] + remote_children,
                                    plan.operator, plan.params, qctx)
         root.add_transformer(AggregatePresenter(plan.operator, plan.params))
+        return root
+
+    def _maybe_mesh_hist_quantile(self, plan: lp.ApplyInstantFunction,
+                                  qctx) -> Optional[ExecPlan]:
+        """``histogram_quantile(phi, sum(..h..))`` with a static phi over
+        an all-mesh-resident sum folds the quantile into the fused root:
+        the cross-shard merge stays PRE-quantile (on-device bucket psum)
+        and the interpolation runs inside the same device program —
+        quantile-of-summed-buckets is the only cluster-wide-legal order,
+        so the phi epilogue must ride the fused program, not a host
+        mapper over per-shard quantiles."""
+        if plan.function != lp.InstantFunctionId.HISTOGRAM_QUANTILE:
+            return None
+        if len(plan.function_args) != 1:
+            return None
+        phi = plan.function_args[0]
+        if isinstance(phi, lp.ScalarFixedDoublePlan):
+            phi = phi.scalar
+        if not isinstance(phi, (int, float)):
+            return None      # runtime-scalar phi: host mapper path
+        inner = plan.vectors
+        if not isinstance(inner, lp.Aggregate) \
+                or inner.operator is not lp.AggregationOperator.SUM \
+                or inner.params:
+            return None
+        root = self._maybe_mesh_aggregate(inner, qctx)
+        from filodb_tpu.parallel.meshexec import MeshReduceExec
+        if not isinstance(root, MeshReduceExec):
+            return None      # not fully fusable; plain walk re-plans it
+        root.hist_phi = float(phi)
         return root
 
     def _hierarchical_reduce(self, children, plan, qctx):

@@ -165,14 +165,25 @@ def hist_slot_garr(garr: np.ndarray, lane_idx: np.ndarray,
     garr[cols] = gid_arr[:, None] * hb + np.arange(hb)
 
 
+def hist_planes_split(both, num_groups: int, hb: int):
+    """[2, G*hb, T] sum+count planes -> ``(hist_sum [G, T, hb],
+    count [G, T])`` (count from the +Inf total bucket).  np/jnp
+    agnostic — ONE definition shared by the host present path below and
+    the fused mesh histq program (parallel/meshgrid.py), so the
+    on-device cluster-wide quantile and the scatter-gather oracle read
+    bucket state through the same reshape."""
+    G, T = num_groups, both.shape[-1]
+    hist_sum = both[0].reshape(G, hb, T).transpose(0, 2, 1)
+    count = both[1].reshape(G, hb, T)[:, -1, :]
+    return hist_sum, count
+
+
 def hist_state_from_planes(both: np.ndarray, num_groups: int, hb: int,
                            tops) -> dict:
     """[2, G*hb, T] sum+count planes -> the MomentAggregator hist state
     ({"hist_sum": [G, T, hb], "count": [G, T] from the total bucket},
     plus bucket_tops).  Shared by the single-device and mesh paths."""
-    G, T = num_groups, both.shape[-1]
-    hist_sum = both[0].reshape(G, hb, T).transpose(0, 2, 1)
-    count = both[1].reshape(G, hb, T)[:, -1, :]
+    hist_sum, count = hist_planes_split(both, num_groups, hb)
     return {"hist_sum": hist_sum, "count": count, "bucket_tops": tops}
 
 

@@ -22,6 +22,7 @@ ships a 1-row dummy; see parallel/meshgrid.py and doc/kernel.md §2).
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence
 
 import numpy as np
@@ -61,6 +62,44 @@ def mesh_supported(operator: AggregationOperator,
     return ok and rangefns.supported(function, hist=False)
 
 
+# Fabric breaker (tentpole): tripped the first time a FUSED program
+# fails to build or dispatch on this backend — every later query runs
+# the always-correct scatter-gather fallback instead of re-discovering
+# the failure at serve time.  The fused path is an optimization, never
+# a correctness dependency (same contract as devicestore._PACKED_BROKEN).
+FABRIC_BREAKER = {"open": False, "trips": 0}
+
+
+def trip_fabric_breaker(exc: Exception) -> None:
+    from filodb_tpu.parallel import meshgrid
+    from filodb_tpu.utils.devicewatch import FLIGHT
+    FABRIC_BREAKER["open"] = True
+    FABRIC_BREAKER["trips"] += 1
+    meshgrid._mm()["breaker"].set(1.0)
+    FLIGHT.record("mesh.breaker_trip", error=str(exc)[:200])
+
+
+def reset_fabric_breaker() -> None:
+    """Admin/test reset (e.g. after a backend or driver change)."""
+    from filodb_tpu.parallel import meshgrid
+    FABRIC_BREAKER["open"] = False
+    meshgrid._mm()["breaker"].set(0.0)
+
+
+@functools.lru_cache(maxsize=16)
+def mesh_placement(generation: int, num_devices: int):
+    """shard -> mesh-device slot, keyed on
+    ``ShardMapper.topology_generation``: a live split commits by bumping
+    the generation, so the first post-cutover query atomically computes
+    placement under the NEW shard space (children land on their own
+    slots) while in-flight queries planned pre-cutover keep the old
+    placement — they detect the bump via ``_topology_stale`` and serve
+    per-shard instead of pinning residents to slots about to move."""
+    def place(shard_num: int) -> int:
+        return shard_num % num_devices
+    return place
+
+
 class MeshAggregateExec(ExecPlan):
     """All local shards of one windowed aggregate as one mesh program."""
 
@@ -74,7 +113,8 @@ class MeshAggregateExec(ExecPlan):
                  by: tuple = (), without: tuple = (),
                  params: tuple = (), stale_ms: int = 300_000,
                  query_context: Optional[QueryContext] = None,
-                 engine=None):
+                 engine=None, mapper=None,
+                 planned_generation: Optional[int] = None):
         super().__init__(query_context)
         self.dataset = dataset
         self.shards = list(shards)
@@ -94,6 +134,49 @@ class MeshAggregateExec(ExecPlan):
         self.params = tuple(params)
         self.stale_ms = stale_ms
         self._engine = engine
+        # topology threading (satellite: generation-keyed placement) —
+        # the planner stamps its snapshot's generation so execute-time
+        # can detect a split cutover racing this query
+        self.mapper = mapper
+        self.planned_generation = planned_generation
+
+    def _topology_stale(self) -> Optional[str]:
+        """Reason the mesh path must stand down for this query, or None.
+        A query planned pre-cutover ("generation") or overlapping a
+        live reshard exclusion window ("exclusion") serves per-shard
+        under its PLANNED topology view — the mesh placement/assembly
+        would mix topologies mid-flight."""
+        if self.mapper is None:
+            return None
+        if self.planned_generation is not None \
+                and self.mapper.topology_generation != self.planned_generation:
+            return "generation"
+        live = self.mapper.topology
+        if any(live.parent_exclusion(s) is not None for s in self.shards):
+            return "exclusion"
+        return None
+
+    def _per_shard_fallback(self, ctx: ExecContext) -> list:
+        """The always-correct scatter-gather form of this node: every
+        shard runs the plain per-shard host pipeline, partials merge
+        downstream.
+
+        No reshard exclusions are stamped here, deliberately: the
+        planner only emits a mesh node when its topology SNAPSHOT had
+        no exclusions, so ``self.shards`` is a pre-cutover fan-out (the
+        split parents).  A query planned pre-cutover must keep that
+        snapshot's (no-exclusion) leaf stamps even when a cutover lands
+        mid-flight — the parents hold a full superset until retirement
+        purges them, so unfiltered parent scans stay exactly correct,
+        while stamping the LIVE exclusions onto the OLD fan-out would
+        drop every migrated series (their children are not among
+        ``self.shards``).  Mixing topology views is the one thing the
+        per-query snapshot contract forbids (planner._topology)."""
+        out: list = []
+        for shard_num in self.shards:
+            out.extend(self._host_shard_partial(ctx, shard_num,
+                                                reshard_to=None))
+        return out
 
     def _args_str(self):
         return (f"dataset={self.dataset}, shards={self.shards}, "
@@ -114,7 +197,20 @@ class MeshAggregateExec(ExecPlan):
         out: list = []
         devices = list(engine.mesh.devices.flat)
 
+        stale = self._topology_stale()
+        if stale is not None:
+            # planned against a topology that moved (split cutover /
+            # active reshard exclusion): the mesh placement would mix
+            # topologies mid-flight — serve per-shard under the planned
+            # snapshot instead (always-correct scatter-gather)
+            meshgrid._fallback(stale)
+            from filodb_tpu.utils.devicewatch import FLIGHT
+            FLIGHT.record("mesh.fallback", dataset=self.dataset,
+                          reason=stale, shards=len(self.shards))
+            return self._per_shard_fallback(ctx)
+
         grid_eligible = self.operator in meshgrid.GRID_MESH_ALL_OPS
+        place = mesh_placement(self.planned_generation or 0, len(devices))
         entries = []                       # (shard, shard_num, lookup)
         for shard_num in self.shards:
             shard = ctx.memstore.get_shard(self.dataset, shard_num)
@@ -123,7 +219,7 @@ class MeshAggregateExec(ExecPlan):
                 # on the device the SPMD program reads them from.  Only
                 # grid-capable queries pin — a host-path query must not
                 # invalidate resident state it will never use.
-                shard.pin_grid_device(devices[shard_num % len(devices)])
+                shard.pin_grid_device(devices[place(shard_num)])
             lookup = shard.lookup_partitions(self.filters,
                                              self.scan_start_ms,
                                              self.scan_end_ms)
@@ -378,16 +474,20 @@ class MeshAggregateExec(ExecPlan):
             gids[i] = union.setdefault(key, len(union))
         return gids
 
-    def _host_shard_partial(self, ctx: ExecContext, shard_num: int) -> list:
+    def _host_shard_partial(self, ctx: ExecContext, shard_num: int,
+                            reshard_to: Optional[tuple] = None) -> list:
         """Per-shard host pipeline for data the mesh program can't take
-        (histogram value columns): leaf scan + PeriodicSamplesMapper +
-        AggregateMapReduce, exactly the non-mesh plan shape."""
+        (histogram value columns) and for topology/breaker fallbacks:
+        leaf scan + PeriodicSamplesMapper + AggregateMapReduce, exactly
+        the non-mesh plan shape.  ``reshard_to`` stamps the live
+        topology's split-parent exclusion on the leaf (query/exec.py)."""
         from filodb_tpu.query.exec import MultiSchemaPartitionsExec
         from filodb_tpu.query.transformers import (AggregateMapReduce,
                                                    PeriodicSamplesMapper)
         leaf = MultiSchemaPartitionsExec(
             self.dataset, shard_num, self.filters, self.scan_start_ms,
-            self.scan_end_ms, query_context=self.query_context)
+            self.scan_end_ms, query_context=self.query_context,
+            reshard_to=reshard_to)
         leaf.add_transformer(PeriodicSamplesMapper(
             self.start_ms, self.step_ms, self.end_ms,
             window_ms=self.window_ms, function=self.function,
@@ -395,3 +495,277 @@ class MeshAggregateExec(ExecPlan):
         leaf.add_transformer(AggregateMapReduce(
             self.operator, self.params, self.by, self.without))
         return list(leaf.execute(ctx).batches)
+
+    def _collect_plans(self, ctx: ExecContext):
+        """Stage EVERY shard's resident MeshShardPlan — the
+        all-or-nothing contract of the fused single-dispatch programs
+        (one non-resident shard breaks the one-program story; the
+        partial tier handles mixed residency instead).  Returns
+        (engine, plans, union, report) or None when any shard with data
+        cannot stage."""
+        from filodb_tpu.parallel import mesh as meshmod
+        from filodb_tpu.parallel import meshgrid
+        from filodb_tpu.query.transformers import effective_window_ms
+
+        engine = self._engine or meshmod.default_engine()
+        steps = StepRange(self.start_ms - self.offset_ms,
+                          self.end_ms - self.offset_ms, self.step_ms)
+        window = effective_window_ms(self.window_ms, self.stale_ms)
+        report = StepRange(self.start_ms, self.end_ms, self.step_ms)
+        devices = list(engine.mesh.devices.flat)
+        place = mesh_placement(self.planned_generation or 0, len(devices))
+        limit = ctx.query_context.group_by_cardinality_limit
+        union: dict[tuple, int] = {}
+        plans = []
+        for shard_num in self.shards:
+            shard = ctx.memstore.get_shard(self.dataset, shard_num)
+            shard.pin_grid_device(devices[place(shard_num)])
+            lookup = shard.lookup_partitions(self.filters,
+                                             self.scan_start_ms,
+                                             self.scan_end_ms)
+            if len(lookup.part_ids) == 0:
+                continue
+            gids = self._grid_group_ids(shard, lookup.part_ids, union)
+            if len(union) > limit:
+                self._cardinality_error(ctx, len(union))
+            plan = None
+            if gids is not None:
+                plan = shard.mesh_grid_plan(
+                    lookup.part_ids, self.function, steps.start,
+                    steps.num_steps, steps.step, window, gids,
+                    fargs=self.function_args)
+            if plan is None:
+                meshgrid._fallback("shape")
+                return None
+            plans.append(plan)
+        return engine, plans, union, report
+
+
+class MeshReduceExec(MeshAggregateExec):
+    """The tentpole node: when EVERY child shard of an aggregation is
+    mesh-resident on this host, the planner emits this node as the plan
+    ROOT — leaf-scan -> window -> aggregate -> cross-shard reduce ->
+    present compile into ONE device program (meshgrid.fused /
+    meshgrid.fused_histq) and the only readback is the final [G, T]
+    answer; N per-shard dispatches and the host reduce disappear.
+
+    Serving ladder, every rung answer-equal: fused single dispatch ->
+    partial mesh program + host reduce/present (non-fusable op or mixed
+    residency) -> per-shard scatter-gather (breaker trip, topology
+    moved mid-flight).  Unlike MeshAggregateExec this node returns
+    PRESENTED batches — it IS the reduce+present, so the planner emits
+    it with no ReduceAggregateExec / AggregatePresenter above it."""
+
+    def __init__(self, *args, hist_phi: Optional[float] = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        # histogram_quantile fusion: the planner folds the mapper's
+        # static phi into the node so the quantile interpolation runs
+        # inside the same device program as the bucket psum
+        self.hist_phi = hist_phi
+
+    def _args_str(self):
+        phi = f", phi={self.hist_phi}" if self.hist_phi is not None else ""
+        return super()._args_str() + phi
+
+    def do_execute(self, ctx: ExecContext) -> list:
+        from filodb_tpu.parallel import meshgrid
+        from filodb_tpu.utils.devicewatch import FLIGHT
+
+        stale = self._topology_stale()
+        if stale is not None:
+            meshgrid._fallback(stale)
+            FLIGHT.record("mesh.fallback", dataset=self.dataset,
+                          reason=stale, shards=len(self.shards))
+            return self._present_host(self._per_shard_fallback(ctx))
+        if FABRIC_BREAKER["open"]:
+            meshgrid._fallback("breaker")
+            FLIGHT.record("mesh.fallback", dataset=self.dataset,
+                          reason="breaker", shards=len(self.shards))
+            return self._present_host(self._per_shard_fallback(ctx))
+        if self.operator in meshgrid._PRESENT_AGGS and not self.params:
+            try:
+                fused = self._fused(ctx)
+            except Exception as e:
+                # the fused program is an optimization, never a
+                # correctness dependency: trip the breaker and serve
+                # this (and every later) query scatter-gather
+                trip_fabric_breaker(e)
+                return self._present_host(self._per_shard_fallback(ctx))
+            if fused is not None:
+                return fused
+        # partial-tier rung: the mesh partial program(s) + host
+        # reduce/present — exactly what ReduceAggregateExec +
+        # AggregatePresenter compose over a MeshAggregateExec child
+        return self._present_host(super().do_execute(ctx))
+
+    def _fused(self, ctx: ExecContext) -> Optional[list]:
+        """The single-dispatch rung; None demotes to the partial tier."""
+        from filodb_tpu.parallel import meshgrid
+        from filodb_tpu.query.model import PeriodicBatch
+        from filodb_tpu.utils.devicewatch import FLIGHT
+
+        got = self._collect_plans(ctx)
+        if got is None:
+            return None
+        engine, plans, union, report = got
+        if not plans:
+            return []                  # nothing matched on any shard
+        vals = meshgrid.serve_grid_mesh_presented(
+            engine, plans, len(union), self.operator,
+            params=self.params, hist_phi=self.hist_phi)
+        FLIGHT.record("mesh.fused", dataset=self.dataset,
+                      shards=len(plans), groups=len(union),
+                      served=vals is not None)
+        if vals is None:
+            return None
+        keys = [dict(k) for k in union]
+        return [PeriodicBatch(keys, report, vals)]
+
+    def _present_host(self, batches: list) -> list:
+        """Host reduce+present for the lower rungs — the same
+        aggregator_for(...).reduce/present composition the
+        scatter-gather plan runs (ReduceAggregateExec.compose +
+        AggregatePresenter), inlined so this node ALWAYS returns
+        presented batches whatever rung served."""
+        from filodb_tpu.query.aggregators import aggregator_for
+        parts = [b for b in batches if isinstance(b, AggPartialBatch)]
+        out = [b for b in batches if not isinstance(b, AggPartialBatch)]
+        if parts:
+            agg = aggregator_for(self.operator)
+            out.append(self._apply_phi(agg.present(agg.reduce(parts))))
+        return out
+
+    def _apply_phi(self, pb):
+        """The host form of the fused quantile epilogue: identical math
+        to InstantVectorFunctionMapper's HISTOGRAM_QUANTILE branch, so
+        the fallback rungs stay bit-equal to the fused answer."""
+        if self.hist_phi is None or getattr(pb, "hist", None) is None:
+            return pb
+        import jax.numpy as jnp
+
+        from filodb_tpu.ops import histogram_ops
+        from filodb_tpu.query.model import PeriodicBatch
+        vals = np.asarray(histogram_ops.hist_quantile(
+            jnp.asarray(pb.bucket_tops), jnp.asarray(pb.hist),
+            self.hist_phi))
+        return PeriodicBatch(pb.keys, pb.steps, vals)
+
+
+class EventTopKExec(MeshAggregateExec):
+    """ExecPlan surface for the event-topK family (the PR 19
+    ``event_topk_grid_packed`` exec follow-up): the k hottest GROUPS
+    per step, ranked by their aggregated (summed) event value — unlike
+    topk(), which selects series WITHIN each group.
+
+    Fused path: meshgrid.serve_event_topk — grouped sums are additive,
+    so the cross-shard merge psums the group planes over the mesh FIRST
+    and ONE on-device lax.top_k then selects per step (exact, where a
+    merge of per-shard topK lists is not), one dispatch and one [T, k]
+    readback.  Fallback (breaker / stale topology / non-resident
+    shapes): per-shard scatter-gather sum partials reduce host-side and
+    the same selection runs in numpy with matching tie semantics
+    (stable descending argsort = lax.top_k's lower-index-first)."""
+
+    def __init__(self, dataset: str, shards: Sequence[int],
+                 filters: Sequence[ColumnFilter], scan_start_ms: int,
+                 scan_end_ms: int, start_ms: int, step_ms: int,
+                 end_ms: int, k: int, window_ms: Optional[int] = None,
+                 function: Optional[RangeFunctionId] = None,
+                 function_args: tuple = (), offset_ms: int = 0,
+                 by: tuple = (), without: tuple = (),
+                 largest: bool = True, stale_ms: int = 300_000,
+                 query_context: Optional[QueryContext] = None,
+                 engine=None, mapper=None,
+                 planned_generation: Optional[int] = None):
+        super().__init__(dataset, shards, filters, scan_start_ms,
+                         scan_end_ms, start_ms, step_ms, end_ms,
+                         AggregationOperator.SUM, window_ms=window_ms,
+                         function=function, function_args=function_args,
+                         offset_ms=offset_ms, by=by, without=without,
+                         params=(), stale_ms=stale_ms,
+                         query_context=query_context, engine=engine,
+                         mapper=mapper,
+                         planned_generation=planned_generation)
+        self.k = int(k)
+        self.largest = bool(largest)
+
+    def _args_str(self):
+        return (super()._args_str()
+                + f", k={self.k}, largest={self.largest}")
+
+    def do_execute(self, ctx: ExecContext) -> list:
+        from filodb_tpu.parallel import meshgrid
+        from filodb_tpu.utils.devicewatch import FLIGHT
+
+        stale = self._topology_stale()
+        if stale is None and not FABRIC_BREAKER["open"]:
+            try:
+                got = self._fused_topk(ctx)
+            except Exception as e:
+                trip_fabric_breaker(e)
+                got = None
+            if got is not None:
+                return got
+        else:
+            reason = stale or "breaker"
+            meshgrid._fallback(reason)
+            FLIGHT.record("mesh.fallback", dataset=self.dataset,
+                          reason=reason, shards=len(self.shards))
+        return self._select_host(self._per_shard_fallback(ctx))
+
+    def _fused_topk(self, ctx: ExecContext) -> Optional[list]:
+        from filodb_tpu.parallel import meshgrid
+        from filodb_tpu.query.model import PeriodicBatch
+        from filodb_tpu.utils.devicewatch import FLIGHT
+
+        got = self._collect_plans(ctx)
+        if got is None:
+            return None
+        engine, plans, union, report = got
+        if not plans:
+            return []
+        served = meshgrid.serve_event_topk(engine, plans, len(union),
+                                           self.k, largest=self.largest)
+        FLIGHT.record("mesh.event_topk", dataset=self.dataset,
+                      shards=len(plans), groups=len(union), k=self.k,
+                      served=served is not None)
+        if served is None:
+            return None
+        vals, gidx = served                       # [T, k] each
+        keys = [dict(key) for key in union]
+        out = np.full((len(keys), report.num_steps), np.nan)
+        tt = np.repeat(np.arange(gidx.shape[0]), gidx.shape[1])
+        gg, vv = gidx.ravel(), vals.ravel()
+        m = gg >= 0
+        out[gg[m], tt[m]] = vv[m]
+        # every group keeps its row (NaN where never selected): stable
+        # result shape whatever the per-step winners are
+        return [PeriodicBatch(keys, report, out)]
+
+    def _select_host(self, batches: list) -> list:
+        """Scatter-gather rung: reduce per-shard sum partials, then the
+        numpy twin of the on-device selection."""
+        from filodb_tpu.query.aggregators import aggregator_for
+        from filodb_tpu.query.model import PeriodicBatch
+        parts = [b for b in batches if isinstance(b, AggPartialBatch)]
+        if not parts:
+            return [b for b in batches
+                    if not isinstance(b, AggPartialBatch)]
+        agg = aggregator_for(AggregationOperator.SUM)
+        p = agg.reduce(parts)
+        s = np.asarray(p.state["sum"], dtype=np.float64)
+        n = np.asarray(p.state["count"], dtype=np.float64)
+        sign = 1.0 if self.largest else -1.0
+        work = np.where(n > 0, s * sign, -np.inf)          # [G, T]
+        kk = min(self.k, work.shape[0])
+        if kk < 1:
+            return []
+        # stable descending argsort ranks ties lower-index-first —
+        # the same order lax.top_k resolves them in the fused program
+        order = np.argsort(-work, axis=0, kind="stable")[:kk]   # [k, T]
+        vals = np.take_along_axis(work, order, axis=0)          # [k, T]
+        out = np.full_like(work, np.nan)
+        tt = np.tile(np.arange(work.shape[1]), (kk, 1))
+        m = np.isfinite(vals)
+        out[order[m], tt[m]] = vals[m] * sign
+        return [PeriodicBatch(list(p.group_keys), p.steps, out)]

@@ -26,7 +26,7 @@ on the already-assembled residents.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Sequence
+from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
 
@@ -62,8 +62,40 @@ _AXES = ("shard", "step")
 
 # observability: wiring tests and the multichip dryrun assert the
 # resident path actually ran (serves), that repeat queries skipped
-# assembly (memo_hits), and how often composition fell back
-STATS = {"serves": 0, "assembles": 0, "memo_hits": 0, "fallbacks": 0}
+# assembly (memo_hits), how often composition fell back, and how many
+# serves ran the fully-fused (present-on-device) fabric form
+STATS = {"serves": 0, "assembles": 0, "memo_hits": 0, "fallbacks": 0,
+         "fused_serves": 0}
+
+_METRICS = None
+
+
+def _mm():
+    """The filodb_mesh_* metric family, registered lazily so importing
+    this module never touches the registry before standalone wires it."""
+    global _METRICS
+    if _METRICS is None:
+        from filodb_tpu.utils.observability import REGISTRY
+        _METRICS = {
+            "fused_serves": REGISTRY.counter(
+                "filodb_mesh_fused_serves_total",
+                "fully-fused single-dispatch fabric serves, by program"),
+            "fallbacks": REGISTRY.counter(
+                "filodb_mesh_fallbacks_total",
+                "mesh fabric fallbacks to a slower serving tier, by "
+                "reason"),
+            "breaker": REGISTRY.gauge(
+                "filodb_mesh_breaker_open",
+                "1 while the fabric breaker forces scatter-gather"),
+        }
+    return _METRICS
+
+
+def _fallback(reason: str) -> None:
+    """One fabric downgrade: bump the wiring-test STATS counter and the
+    exported filodb_mesh_fallbacks_total{reason=} family together."""
+    STATS["fallbacks"] += 1
+    _mm()["fallbacks"].inc(reason=reason)
 
 # (mesh, layout, garr) -> assembled global arrays; holds the plan arrays
 # so the id()-keys stay unambiguous while an entry lives.  LRU with BOTH
@@ -104,30 +136,22 @@ def _stage_put(arr, dev):
                              fmt="mesh-staged")
 
 
-@functools.lru_cache(maxsize=64)
-def _grid_mesh_program(mesh_key, q, mode: str, ksub: int, nrows: int,
-                       lmax: int, num_groups: int, op: str):
-    """The SPMD serving program for one (mesh, query, layout) signature.
-
-    Local body: for each of the device's ``ksub`` resident shard slices,
-    run the grid kernel ([nrows, lmax] -> [T, lmax]) and segment-reduce
-    lanes into [G(+drop), T] partials; accumulate across local shards;
-    then one collective over the mesh replaces the reference's
-    cross-node reduce tree.
-    """
-    import jax
+def _grouped_local(q, mode: str, ksub: int, lanes: int, num_groups: int,
+                   op: str):
+    """Shared local body of every grouped fabric program: for each of
+    the device's ``ksub`` resident shard slices, run the grid kernel
+    ([nrows, lmax] -> [T, lmax]) and segment-reduce lanes into
+    [G(+drop), T] partials; accumulate across local shards; then one
+    collective over the mesh replaces the reference's cross-node reduce
+    tree.  Returns (local_fn, psum_planes); the partial and fused
+    programs MUST build their bodies here so their reduce arithmetic
+    can never drift (bit-equality across serving tiers rests on it)."""
     import jax.numpy as jnp
     from jax import lax
-    from jax.sharding import PartitionSpec as P
 
     from filodb_tpu.memstore.devicestore import _grouped_reduce_impl
     from filodb_tpu.ops.grid import rate_grid_auto
 
-    from filodb_tpu.parallel.mesh import _MESHES
-    mesh = _MESHES[mesh_key]
-    # same VMEM-footprint rule as the single-device fused path
-    # (devicestore._plan_locked): tall strided slices narrow the tile
-    lanes = 1024 if (lmax % 1024 == 0 and nrows <= 256) else _LANE_PAD
     G = num_groups
     psum_planes = op in ("sum", "avg", "count", "moments")
 
@@ -154,6 +178,17 @@ def _grid_mesh_program(mesh_key, q, mode: str, ksub: int, nrows: int,
             return lax.pmin(acc, _AXES)
         return lax.pmax(acc, _AXES)
 
+    return local, psum_planes
+
+
+def _grouped_inner(mesh, q, mode: str, ksub: int, nrows: int, lmax: int,
+                   num_groups: int, op: str):
+    """shard_map-wrapped grouped body at the shared lane width rule
+    (devicestore._plan_locked: tall strided slices narrow the tile)."""
+    from jax.sharding import PartitionSpec as P
+    lanes = 1024 if (lmax % 1024 == 0 and nrows <= 256) else _LANE_PAD
+    local, psum_planes = _grouped_local(q, mode, ksub, lanes, num_groups,
+                                        op)
     in_specs = (P(_AXES, None, None), P(_AXES, None, None),
                 P(_AXES, None), P(_AXES), P(_AXES, None))
     kw = dict(mesh=mesh, in_specs=in_specs,
@@ -162,8 +197,124 @@ def _grid_mesh_program(mesh_key, q, mode: str, ksub: int, nrows: int,
     # Pallas kernels' ShapeDtypeStruct outputs carry no vma; the newer
     # shard_map's varying-across-mesh check rejects them — route through
     # the version-spelling-aware unchecked wrapper
-    fn = _shard_map_unchecked(local, **kw)
+    return _shard_map_unchecked(local, **kw), psum_planes
+
+
+@functools.lru_cache(maxsize=64)
+def _grid_mesh_program(mesh_key, q, mode: str, ksub: int, nrows: int,
+                       lmax: int, num_groups: int, op: str):
+    """The SPMD PARTIAL program for one (mesh, query, layout) signature:
+    the mergeable [2|3, G, T] planes (or the [G, T] min/max surface)
+    read back for a host-side reduce with remote/host-batch partials."""
+    from filodb_tpu.parallel.mesh import _MESHES
+    fn, _ = _grouped_inner(_MESHES[mesh_key], q, mode, ksub, nrows, lmax,
+                           num_groups, op)
     return devicewatch.jit(fn, program="meshgrid.grouped")
+
+
+# AggregationOperator -> the fused present epilogue it rides; mirrors
+# MomentAggregator.present case by case (query/aggregators.py)
+_PRESENT_AGGS = {Agg.SUM: "sum", Agg.COUNT: "count", Agg.AVG: "avg",
+                 Agg.MIN: "min", Agg.MAX: "max", Agg.GROUP: "group",
+                 Agg.STDDEV: "stddev", Agg.STDVAR: "stdvar"}
+
+
+@functools.lru_cache(maxsize=64)
+def _grid_mesh_present_program(mesh_key, q, mode: str, ksub: int,
+                               nrows: int, lmax: int, num_groups: int,
+                               op: str, agg: str):
+    """The tentpole fabric program: leaf-scan -> window -> group-reduce
+    -> cross-shard psum/pmin/pmax -> PRESENT, all one compiled dispatch
+    returning the final [G, T] answer — the partial planes never reach
+    the host.  The present epilogue mirrors MomentAggregator.present
+    expression by expression in f64, so the fused answer is bit-equal
+    to the scatter-gather path's on identical partials."""
+    import jax.numpy as jnp
+
+    from filodb_tpu.parallel.mesh import _MESHES
+    inner, psum_planes = _grouped_inner(_MESHES[mesh_key], q, mode, ksub,
+                                        nrows, lmax, num_groups, op)
+
+    def fn(ts, vals, phase, s0, garr):
+        out = inner(ts, vals, phase, s0, garr)
+        if not psum_planes:                         # min / max
+            return jnp.where(jnp.isfinite(out), out, jnp.nan)
+        s, n = out[0], out[1]
+        if agg == "sum":
+            return jnp.where(n > 0, s, jnp.nan)
+        if agg == "count":
+            return jnp.where(n > 0, n, jnp.nan)
+        if agg == "group":
+            return jnp.where(n > 0, 1.0, jnp.nan)
+        if agg == "avg":
+            return jnp.where(n > 0, s / jnp.maximum(n, 1.0), jnp.nan)
+        nsafe = jnp.maximum(n, 1.0)                 # stddev / stdvar
+        mean = s / nsafe
+        var = jnp.maximum(out[2] / nsafe - mean * mean, 0.0)
+        if agg == "stddev":
+            var = jnp.sqrt(var)
+        return jnp.where(n > 0, var, jnp.nan)
+
+    return devicewatch.jit(fn, program="meshgrid.fused")
+
+
+@functools.lru_cache(maxsize=64)
+def _grid_mesh_histq_program(mesh_key, q, mode: str, ksub: int,
+                             nrows: int, lmax: int, num_groups: int,
+                             hb: int, phi: float):
+    """histogram_quantile over the fabric as ONE dispatch.  The cross-
+    shard merge stays PRE-quantile — per-bucket sum/count planes psum
+    over the mesh, because quantiles of sums are not sums of quantiles
+    — and the interpolation then runs on the merged planes inside the
+    same program, so only the final [G, T] quantile surface reads back.
+    The epilogue mirrors hist_state_from_planes +
+    MomentAggregator.present + InstantVectorFunctionMapper's
+    hist_quantile call, expression by expression in f64."""
+    import jax.numpy as jnp
+
+    from filodb_tpu.memstore.devicestore import hist_planes_split
+    from filodb_tpu.ops.histogram_ops import hist_quantile
+    from filodb_tpu.parallel.mesh import _MESHES
+    inner, _ = _grouped_inner(_MESHES[mesh_key], q, mode, ksub, nrows,
+                              lmax, num_groups * hb, "sum")
+
+    def fn(ts, vals, phase, s0, garr, tops):
+        both = inner(ts, vals, phase, s0, garr)     # [2, G*hb, T]
+        hist, n = hist_planes_split(both, num_groups, hb)
+        hist = jnp.where(n[..., None] > 0, hist, jnp.nan)
+        return hist_quantile(tops, hist, phi)       # [G, T]
+
+    return devicewatch.jit(fn, program="meshgrid.fused_histq")
+
+
+@functools.lru_cache(maxsize=64)
+def _grid_mesh_event_topk_program(mesh_key, q, mode: str, ksub: int,
+                                  nrows: int, lmax: int, num_groups: int,
+                                  k: int, largest: bool):
+    """Distributed event-topK merge (the PR 19 event_topk exec
+    follow-up): grouped event sums are additive, so the cross-shard
+    merge psums the [2, G, T] planes over the mesh FIRST and one
+    on-device lax.top_k then selects the k hottest groups per step —
+    exact, unlike merging per-shard topK lists, and still one dispatch
+    with a [T, k] readback."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from filodb_tpu.parallel.mesh import _MESHES
+    inner, _ = _grouped_inner(_MESHES[mesh_key], q, mode, ksub, nrows,
+                              lmax, num_groups, "sum")
+    sign = 1.0 if largest else -1.0
+
+    def fn(ts, vals, phase, s0, garr):
+        both = inner(ts, vals, phase, s0, garr)     # [2, G, T]
+        s, n = both[0], both[1]
+        work = jnp.where(n > 0, s * sign, -jnp.inf)
+        topv, topg = lax.top_k(work.T, k)           # [T, k]
+        found = jnp.isfinite(topv)
+        return (jnp.where(found, topv * sign, jnp.nan),
+                jnp.where(found, topg, -1))
+
+    return devicewatch.jit(fn, program="meshgrid.event_topk")
 
 
 def _shard_map_unchecked(local, **kw):
@@ -416,23 +567,36 @@ def _assign_devices(plans: Sequence, devices: list,
     return by_dev
 
 
-def serve_grid_mesh(engine, plans: Sequence, num_groups: int,
-                    operator: Agg, params: tuple = ()) -> Optional[dict]:
-    """Run one fused grid-mesh query over per-shard resident plans.
+class _Prepared(NamedTuple):
+    """One composed-and-assembled fabric serving context: everything the
+    per-op programs need, independent of WHICH program then dispatches
+    (partial planes, fused present, fused quantile, event topk)."""
+    q: object
+    mode: str
+    op: str
+    stride: int            # hb bucket lanes per series slot (1 = scalar)
+    groups_total: int      # num_groups * stride segments in the reduce
+    ksub: int
+    nrows: int
+    lmax: int
+    Kp: int
+    by_dev: list
+    arrays: tuple          # (g_ts, g_vals, g_ph, g_s0, g_garr)
 
-    Returns the mergeable partial state dict — moment planes
-    ({"sum","count"[,"sumsq"]} / {"min"} / {"max"}), k-slots
-    ({"values","sidx"} plus the private "_slots"/"_lmax" lane-resolution
-    keys the caller maps to series tags), t-digests
-    ({"td_means","td_weights"}), or value counts
-    ({"cv_vals","cv_counts"}) — or None when the plans cannot compose
-    (mixed query shapes, unsupported op)."""
+
+def _prepare(engine, plans: Sequence, num_groups: int,
+             operator: Agg) -> Optional[_Prepared]:
+    """Compose + place + assemble one fabric query: validates the plans
+    share one program signature, groups them by resident device, and
+    assembles (or memo-recalls) the global input arrays.  Returns None
+    to fall back; shared by the partial and fully-fused serve paths so
+    an op switch on the same residents re-uses the assembly."""
     jax, jnp = _jax()
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     composed = _compose(plans, operator)
     if composed is None:
-        STATS["fallbacks"] += 1
+        _fallback("compose")
         return None
     q, mode = composed
     op = GRID_MESH_ALL_OPS[operator]
@@ -463,14 +627,14 @@ def serve_grid_mesh(engine, plans: Sequence, num_groups: int,
         # lane->series references a remote process cannot resolve to
         # tags — the host-batch path + coordinator wire merge handles
         # both across nodes
-        STATS["fallbacks"] += 1
+        _fallback("multiproc_lane_result")
         return None
     local = {d for d in devices if d.process_index == proc} \
         if multiproc else None
     if multiproc and not local:
         # this process owns none of the mesh's devices: it cannot stage
         # resident pieces — graceful fallback, not a crash
-        STATS["fallbacks"] += 1
+        _fallback("multiproc_no_local")
         return None
     by_dev = _assign_devices(plans, devices, local)
     ksub = max(1, max(len(lst) for lst in by_dev))
@@ -582,6 +746,30 @@ def serve_grid_mesh(engine, plans: Sequence, num_groups: int,
                      (g_ts, g_vals, g_ph, g_s0, g_garr, tuple(plans)),
                      nbytes)
 
+    return _Prepared(q, mode, op, stride, groups_total, ksub, nrows,
+                     lmax, Kp, by_dev, (g_ts, g_vals, g_ph, g_s0, g_garr))
+
+
+def serve_grid_mesh(engine, plans: Sequence, num_groups: int,
+                    operator: Agg, params: tuple = ()) -> Optional[dict]:
+    """Run one fused grid-mesh query over per-shard resident plans.
+
+    Returns the mergeable partial state dict — moment planes
+    ({"sum","count"[,"sumsq"]} / {"min"} / {"max"}), k-slots
+    ({"values","sidx"} plus the private "_slots"/"_lmax" lane-resolution
+    keys the caller maps to series tags), t-digests
+    ({"td_means","td_weights"}), or value counts
+    ({"cv_vals","cv_counts"}) — or None when the plans cannot compose
+    (mixed query shapes, unsupported op)."""
+    prep = _prepare(engine, plans, num_groups, operator)
+    if prep is None:
+        return None
+    q, mode, op = prep.q, prep.mode, prep.op
+    stride, groups_total = prep.stride, prep.groups_total
+    ksub, nrows, lmax, Kp = prep.ksub, prep.nrows, prep.lmax, prep.Kp
+    by_dev = prep.by_dev
+    g_ts, g_vals, g_ph, g_s0, g_garr = prep.arrays
+
     if op in ("topk", "bottomk"):
         k = int(float(params[0]))
         prog = _grid_mesh_topk_program(engine._key, q, mode, ksub, nrows,
@@ -643,3 +831,74 @@ def serve_grid_mesh(engine, plans: Sequence, num_groups: int,
         return {"sum": both[0], "count": both[1]}
     a = np.asarray(out, dtype=np.float64)  # host-sync-ok: single readback of the [G, T] reduced partial
     return {op: np.where(np.isfinite(a), a, np.nan)}
+
+
+def serve_grid_mesh_presented(engine, plans: Sequence, num_groups: int,
+                              operator: Agg, params: tuple = (),
+                              hist_phi: Optional[float] = None
+                              ) -> Optional[np.ndarray]:
+    """The tentpole entry: ONE compiled dispatch and ONE [G, T] readback
+    of the PRESENTED answer — no partial state, no host reduce.  Serves
+    the moment family (sum/count/avg/min/max/group/stddev/stdvar) and,
+    with ``hist_phi`` set over histogram plans, the fused
+    histogram_quantile (cross-shard merge pre-quantile via bucket psum).
+    Returns the presented np.float64 [G, T] (NaN where a group is
+    empty), or None when this op/shape has no fused-present form — the
+    caller then serves the partial path, which shares this assembly."""
+    agg = _PRESENT_AGGS.get(operator)
+    if agg is None:
+        return None
+    prep = _prepare(engine, plans, num_groups, operator)
+    if prep is None:
+        return None
+    g_ts, g_vals, g_ph, g_s0, g_garr = prep.arrays
+    if prep.stride > 1:
+        if hist_phi is None:
+            return None    # hist sum presents host-side (hist batch out)
+        prog = _grid_mesh_histq_program(
+            engine._key, prep.q, prep.mode, prep.ksub, prep.nrows,
+            prep.lmax, num_groups, prep.stride, float(hist_phi))
+        _, jnp = _jax()
+        tops = jnp.asarray(np.asarray(plans[0].bucket_tops))
+        out = prog(g_ts, g_vals, g_ph, g_s0, g_garr, tops)
+        program = "meshgrid.fused_histq"
+    else:
+        if hist_phi is not None:
+            return None    # phi over scalar series: the mapper's problem
+        prog = _grid_mesh_present_program(
+            engine._key, prep.q, prep.mode, prep.ksub, prep.nrows,
+            prep.lmax, num_groups, prep.op, agg)
+        out = prog(g_ts, g_vals, g_ph, g_s0, g_garr)
+        program = "meshgrid.fused"
+    STATS["serves"] += 1
+    STATS["fused_serves"] += 1
+    _mm()["fused_serves"].inc(program=program)
+    return np.asarray(out, dtype=np.float64)  # host-sync-ok: THE single [G, T] readback of the fused fabric answer
+
+
+def serve_event_topk(engine, plans: Sequence, num_groups: int, k: int,
+                     largest: bool = True):
+    """Distributed event-topK over resident plans: grouped sums psum
+    over the mesh and one on-device top_k selects the k hottest groups
+    per step — one dispatch, one [T, k] readback pair.  Returns
+    (values [T, k] f64, group_idx [T, k] i64) with NaN/-1 in unfilled
+    slots, or None when the plans cannot compose or are histograms."""
+    prep = _prepare(engine, plans, num_groups, Agg.SUM)
+    if prep is None:
+        return None
+    if prep.stride > 1:
+        _fallback("event_topk_hist")
+        return None
+    kk = min(int(k), num_groups)
+    if kk < 1:
+        return None
+    prog = _grid_mesh_event_topk_program(
+        engine._key, prep.q, prep.mode, prep.ksub, prep.nrows, prep.lmax,
+        num_groups, kk, bool(largest))
+    g_ts, g_vals, g_ph, g_s0, g_garr = prep.arrays
+    v, gi = prog(g_ts, g_vals, g_ph, g_s0, g_garr)
+    STATS["serves"] += 1
+    STATS["fused_serves"] += 1
+    _mm()["fused_serves"].inc(program="meshgrid.event_topk")
+    return (np.asarray(v, dtype=np.float64),  # host-sync-ok: [T, k] selected event-group values, the designed readback
+            np.asarray(gi, dtype=np.int64))  # host-sync-ok: [T, k] selected group ids ride back with the values

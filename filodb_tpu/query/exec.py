@@ -910,10 +910,15 @@ class ReduceAggregateExec(NonLeafExecPlan):
     def compose(self, results, ctx):
         partials = [b for r in results for b in r.batches
                     if isinstance(b, AggPartialBatch)]
+        # already-presented batches (a fused MeshReduceExec child does
+        # its reduce+present on device) pass through untouched instead
+        # of being silently dropped by the partial filter
+        presented = [b for r in results for b in r.batches
+                     if not isinstance(b, AggPartialBatch)]
         if not partials:
-            return []
+            return presented
         agg = aggregator_for(self.operator)
-        return [agg.reduce(partials)]
+        return [agg.reduce(partials)] + presented
 
     def _args_str(self):
         return f"operator={self.operator.name}"

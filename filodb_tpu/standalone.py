@@ -622,6 +622,12 @@ class FiloServer:
         if mesh_conf or (mesh_conf is None and self._device_count() > 1):
             from filodb_tpu.parallel.mesh import default_engine
             mesh_provider = default_engine
+        # mesh query fabric (ISSUE 18): when every child shard of an
+        # aggregate is mesh-resident here, the plan root is ONE fused
+        # device program (scan -> window -> aggregate -> cross-shard
+        # psum -> present).  "mesh-fused": false pins the PR 17 shape
+        # (mesh partials + host reduce) without turning the mesh off.
+        mesh_fused = bool(ds_conf.get("mesh-fused", True))
         # per-shard-key spread overrides (reference: filodb-defaults
         # `spread-assignment`): "spread-assignment":
         #   [{"keys": {"_ws_": "demo", "_ns_": "App-0"}, "spread": 3}]
@@ -635,7 +641,8 @@ class FiloServer:
                                        spread_default=spread,
                                        spread_provider=spread_provider,
                                        dispatcher_for_shard=disp,
-                                       mesh_engine_provider=mesh_provider)
+                                       mesh_engine_provider=mesh_provider,
+                                       mesh_fused=mesh_fused)
         # query-frontend result cache (ISSUE 12): the wrapper is always
         # installed (a disabled cache is one boolean per materialize)
         # so POST /admin/config can enable it at runtime; it sits BELOW

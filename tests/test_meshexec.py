@@ -118,7 +118,8 @@ class TestMeshPathEquivalence:
             'sum(rate(mm{_ws_="w",_ns_="n"}[2m]))',
             BASE + 300_000, 30_000, BASE + 900_000)
         tree = planner.materialize(plan, QueryContext()).print_tree()
-        assert "MeshAggregateExec" in tree
+        # all shards mesh-resident here => the fused ROOT (ISSUE 18)
+        assert "MeshReduceExec" in tree
         assert "MultiSchemaPartitionsExec" not in tree  # all shards local
 
     @pytest.mark.parametrize("promql", [
@@ -193,7 +194,7 @@ class TestMeshPathEquivalence:
             plan = query_range_to_logical_plan(
                 promql, BASE + 300_000, 30_000, BASE + 900_000)
             tree = planner.materialize(plan, QueryContext()).print_tree()
-            assert "MeshAggregateExec" in tree, promql
+            assert "MeshReduceExec" in tree, promql
 
     def test_quantile_digest_close_to_exact(self, loaded):
         """The mesh quantile partial is a t-digest sketch; the per-shard

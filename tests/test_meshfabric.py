@@ -1,0 +1,467 @@
+"""Mesh query fabric (ISSUE 18): the fused single-launch path must be
+BIT-equal to the scatter-gather oracle, and every fallback rung
+(breaker trip, stale topology, mixed residency, live 4->8 split) must
+answer byte-for-byte the same.
+
+Every scalar dataset here is DYADIC-exact — integers scaled by 2^-3 —
+so every f64 sum is exact at ANY summation order and the fused
+cross-shard psum, the partial-mesh host reduce, and the per-shard
+oracle all produce identical bits (histograms use integer cumulative
+bucket counts for the same reason).  Comparisons are tobytes + an
+explicit NaN-mask check, not allclose.
+
+Runs on the 8-device virtual CPU mesh from tests/conftest.py.
+"""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.parallel import meshexec, meshgrid
+from filodb_tpu.parallel.mesh import MeshEngine, make_mesh
+from filodb_tpu.parallel.shardmap import ShardMapper, shard_of_tags
+from filodb_tpu.promql.parser import query_range_to_logical_plan
+from filodb_tpu.query.exec import ExecContext, IN_PROCESS
+from filodb_tpu.query.model import QueryContext
+from filodb_tpu.utils.devicewatch import KERNEL_TIMER, device_metrics
+
+BASE = 1_700_000_000_000
+STEP = 10_000
+N_ROWS = 90
+START, END = BASE + 300_000, BASE + 800_000
+
+
+def _dyadic_series(rng, n_rows):
+    """Multiples of 1/8 below 2^37: sums of thousands of these stay
+    exact integers*2^-3 < 2^53, so f64 addition is order-independent."""
+    return rng.integers(1, 1 << 40, n_rows).astype(np.float64) / 8.0
+
+
+def _mk_store(num_shards, spread, n_series=24, seed=7):
+    ms = TimeSeriesMemStore()
+    opts = DatasetOptions()
+    mapper = ShardMapper(num_shards)
+    for s in range(num_shards):
+        ms.setup("prom", DEFAULT_SCHEMAS, s)
+    rng = np.random.default_rng(seed)
+    for i in range(n_series):
+        tags = {"_metric_": "fm", "inst": f"i{i}", "grp": f"g{i % 3}",
+                "_ws_": "w", "_ns_": "n"}
+        shard = shard_of_tags(tags, num_shards, spread, opts)
+        b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], opts,
+                          container_size=1 << 20)
+        ts = BASE + np.arange(N_ROWS) * STEP
+        b.add_series(ts.tolist(), [_dyadic_series(rng, N_ROWS).tolist()],
+                     tags)
+        for off, c in enumerate(b.containers()):
+            ms.get_shard("prom", shard).ingest_container(c, off)
+    return ms, mapper
+
+
+def _planner(mapper, spread, mesh=False, dispatcher_for_shard=None,
+             mesh_fused=True):
+    provider = None
+    if mesh:
+        engine = MeshEngine(make_mesh())
+        provider = lambda: engine  # noqa: E731
+    return SingleClusterPlanner("prom", mapper, DatasetOptions(),
+                                spread_default=spread,
+                                dispatcher_for_shard=dispatcher_for_shard,
+                                mesh_engine_provider=provider,
+                                mesh_fused=mesh_fused)
+
+
+def _run(planner, ms, promql, start=START, end=END, step=30_000):
+    plan = query_range_to_logical_plan(promql, start, step, end)
+    ep = planner.materialize(plan, QueryContext())
+    result = ep.execute(ExecContext(ms, QueryContext()))
+    out = {}
+    for b in result.batches:
+        for tags, ts, vals in b.to_series():
+            out[tuple(sorted(tags.items()))] = (np.asarray(ts),
+                                                np.asarray(vals))
+    return out
+
+
+def _assert_biteq(fused, plain, msg=""):
+    """tobytes equality + the NaN pattern compared explicitly."""
+    assert set(fused) == set(plain) and plain, msg
+    for k in plain:
+        np.testing.assert_array_equal(fused[k][0], plain[k][0],
+                                      err_msg=f"{msg} {k} (timestamps)")
+        a = np.asarray(fused[k][1], dtype=np.float64)
+        b = np.asarray(plain[k][1], dtype=np.float64)
+        assert np.array_equal(np.isnan(a), np.isnan(b)), \
+            f"{msg} {k}: NaN pattern differs"
+        assert a.tobytes() == b.tobytes(), \
+            f"{msg} {k}: answers not bit-equal"
+
+
+SWEEP_QUERIES = [
+    'sum by (grp)(fm{_ws_="w",_ns_="n"})',
+    'sum(fm{_ws_="w",_ns_="n"})',
+    'count(fm{_ws_="w",_ns_="n"})',
+    'avg by (grp)(fm{_ws_="w",_ns_="n"})',
+    'min(fm{_ws_="w",_ns_="n"})',
+    'max by (grp)(fm{_ws_="w",_ns_="n"})',
+    'group by (grp)(fm{_ws_="w",_ns_="n"})',
+    'topk(2, fm{_ws_="w",_ns_="n"})',
+]
+
+# (num_shards, spread, seed): randomized shard counts for the sweep
+SHAPES = [(2, 1, 17), (4, 2, 23), (8, 3, 31)]
+
+
+@pytest.fixture(scope="module", params=SHAPES,
+                ids=[f"{n}shards" for n, _, _ in SHAPES])
+def sweep_store(request):
+    n, spread, seed = request.param
+    ms, mapper = _mk_store(n, spread, seed=seed)
+    return ms, mapper, spread
+
+
+@pytest.fixture(scope="module")
+def hist_store():
+    from tests.data import histogram_containers
+    ms = TimeSeriesMemStore()
+    mapper = ShardMapper(4)
+    for s in range(4):
+        ms.setup("prom", DEFAULT_SCHEMAS, s)
+    for shard_num in (0, 1, 2):
+        for off, c in enumerate(histogram_containers(
+                n_series=2, n_samples=40, metric="hq", seed=shard_num)):
+            ms.get_shard("prom", shard_num).ingest_container(c, off)
+    return ms, mapper
+
+
+class TestFusedBitEquality:
+    @pytest.mark.parametrize("promql", SWEEP_QUERIES)
+    def test_sweep_matches_oracle_bitwise(self, sweep_store, promql):
+        ms, mapper, spread = sweep_store
+        plain = _run(_planner(mapper, spread), ms, promql)
+        fused = _run(_planner(mapper, spread, mesh=True), ms, promql)
+        _assert_biteq(fused, plain, promql)
+
+    def test_plan_root_is_fused_node(self, sweep_store):
+        ms, mapper, spread = sweep_store
+        planner = _planner(mapper, spread, mesh=True)
+        plan = query_range_to_logical_plan(
+            'sum by (grp)(fm{_ws_="w",_ns_="n"})', START, 30_000, END)
+        tree = planner.materialize(plan, QueryContext()).print_tree()
+        assert "MeshReduceExec" in tree
+        assert "ReduceAggregateExec" not in tree
+
+    def test_mesh_fused_knob_pins_partial_shape(self, sweep_store):
+        ms, mapper, spread = sweep_store
+        planner = _planner(mapper, spread, mesh=True, mesh_fused=False)
+        promql = 'sum by (grp)(fm{_ws_="w",_ns_="n"})'
+        plan = query_range_to_logical_plan(promql, START, 30_000, END)
+        tree = planner.materialize(plan, QueryContext()).print_tree()
+        assert "MeshReduceExec" not in tree
+        assert "MeshAggregateExec" in tree
+        _assert_biteq(_run(planner, ms, promql),
+                      _run(_planner(mapper, spread), ms, promql), promql)
+
+
+class TestHistogramQuantileFusion:
+    PHI_Q = 'histogram_quantile(0.9, sum(hq{_ws_="demo",_ns_="App-0"}))'
+    SUM_Q = 'sum(hq{_ws_="demo",_ns_="App-0"})'
+    # first step at +300_000 so the 5m lookback window stays inside the
+    # ingested span — a window reaching before epoch0 demotes the grid
+    HSTART = 1_600_000_000_000 + 300_000
+    HEND = 1_600_000_000_000 + 390_000
+
+    def test_phi_folds_into_fused_root(self, hist_store):
+        ms, mapper = hist_store
+        planner = _planner(mapper, 2, mesh=True)
+        plan = query_range_to_logical_plan(self.PHI_Q, self.HSTART,
+                                           30_000, self.HEND)
+        tree = planner.materialize(plan, QueryContext()).print_tree()
+        assert "MeshReduceExec" in tree and "phi=0.9" in tree
+
+    @pytest.mark.parametrize("promql", [SUM_Q, PHI_Q])
+    def test_hist_bitequal(self, hist_store, promql):
+        ms, mapper = hist_store
+        plain = _run(_planner(mapper, 2), ms, promql,
+                     start=self.HSTART, end=self.HEND)
+        fused = _run(_planner(mapper, 2, mesh=True), ms, promql,
+                     start=self.HSTART, end=self.HEND)
+        _assert_biteq(fused, plain, promql)
+
+
+class TestSingleLaunch:
+    """Acceptance: a warm mesh-resident N-shard aggregation is ONE
+    compiled launch — filodb_kernel_launches_total advances by exactly
+    one, on exactly the fused program, at 1-in-1 sampling."""
+
+    def _one_launch(self, ms, mapper, spread, promql, program,
+                    start=START, end=END):
+        planner = _planner(mapper, spread, mesh=True)
+        prev = KERNEL_TIMER.sample_1_in
+        KERNEL_TIMER.configure(sample_1_in=1)
+        try:
+            _run(planner, ms, promql, start=start, end=end)  # warm/compile
+            c = device_metrics()["kernel_launches"]
+            before_prog = c.value(program=program)
+            before_total = c.total()
+            _run(planner, ms, promql, start=start, end=end)
+            assert c.value(program=program) - before_prog == 1.0
+            assert c.total() - before_total == 1.0, \
+                "warm fused query launched more than the ONE program"
+        finally:
+            KERNEL_TIMER.configure(sample_1_in=prev)
+
+    def test_sum_by_is_one_launch(self, sweep_store):
+        ms, mapper, spread = sweep_store
+        self._one_launch(ms, mapper, spread,
+                         'sum by (grp)(fm{_ws_="w",_ns_="n"})',
+                         "meshgrid.fused")
+
+    def test_hist_quantile_is_one_launch(self, hist_store):
+        ms, mapper = hist_store
+        self._one_launch(
+            ms, mapper, 2, TestHistogramQuantileFusion.PHI_Q,
+            "meshgrid.fused_histq",
+            start=TestHistogramQuantileFusion.HSTART,
+            end=TestHistogramQuantileFusion.HEND)
+
+
+class TestFallbackLadder:
+    def test_breaker_trip_serves_scatter_gather_bitequal(
+            self, sweep_store, monkeypatch):
+        ms, mapper, spread = sweep_store
+        promql = 'sum by (grp)(fm{_ws_="w",_ns_="n"})'
+        plain = _run(_planner(mapper, spread), ms, promql)
+        meshexec.reset_fabric_breaker()
+        trips0 = meshexec.FABRIC_BREAKER["trips"]
+
+        def boom(*a, **k):
+            raise RuntimeError("injected fabric fault")
+
+        monkeypatch.setattr(meshgrid, "serve_grid_mesh_presented", boom)
+        try:
+            got = _run(_planner(mapper, spread, mesh=True), ms, promql)
+            _assert_biteq(got, plain, "breaker-trip answer")
+            assert meshexec.FABRIC_BREAKER["open"]
+            assert meshexec.FABRIC_BREAKER["trips"] == trips0 + 1
+            monkeypatch.undo()
+            # breaker still open: later queries keep scatter-gather
+            # without touching the fused program
+            falls0 = meshgrid.STATS["fallbacks"]
+            got = _run(_planner(mapper, spread, mesh=True), ms, promql)
+            _assert_biteq(got, plain, "breaker-open answer")
+            assert meshgrid.STATS["fallbacks"] > falls0
+        finally:
+            meshexec.reset_fabric_breaker()
+        # closed again: the fused rung serves
+        serves0 = meshgrid.STATS["fused_serves"]
+        _assert_biteq(_run(_planner(mapper, spread, mesh=True), ms, promql),
+                      plain, "post-reset answer")
+        assert meshgrid.STATS["fused_serves"] == serves0 + 1
+
+    def test_mixed_residency_degrades_bitequal(self, sweep_store):
+        """A shard behind a non-in-process dispatcher keeps the partial
+        shape (mesh child + per-shard child + host reduce) — same
+        bytes."""
+        ms, mapper, spread = sweep_store
+
+        class LoopbackDispatcher:
+            def dispatch(self, plan, ctx):
+                return plan.execute(ctx)
+
+        lb = LoopbackDispatcher()
+        last = mapper.num_shards - 1
+
+        def disp(shard):
+            return lb if shard == last else IN_PROCESS
+
+        promql = 'sum by (grp)(fm{_ws_="w",_ns_="n"})'
+        plain = _run(_planner(mapper, spread), ms, promql)
+        planner = _planner(mapper, spread, mesh=True,
+                           dispatcher_for_shard=disp)
+        plan = query_range_to_logical_plan(promql, START, 30_000, END)
+        tree = planner.materialize(plan, QueryContext()).print_tree()
+        assert "MeshReduceExec" not in tree      # not fully resident
+        if mapper.num_shards > 2:
+            assert "MeshAggregateExec" in tree   # local majority fused
+        assert "MultiSchemaPartitionsExec" in tree
+        _assert_biteq(_run(planner, ms, promql), plain, "mixed residency")
+
+    def test_feed_shards_fuse_only_when_everything_is_local(self,
+                                                            sweep_store):
+        """Replicated shards qualify through the dispatcher's
+        ``mesh_feed`` hook (this node's copy is the ReplicaSet.pick
+        primary) ONLY when that makes every child shard local — the
+        fully-fused root.  They must never ride the partial-mesh shape:
+        a per-node mix of mesh and dispatched legs would regroup the
+        float reduce differently on every replica-holding node
+        (test_split_e2e.py's cross-node bit-equality contract)."""
+        ms, mapper, spread = sweep_store
+
+        class LoopbackDispatcher:
+            def dispatch(self, plan, ctx):
+                return plan.execute(ctx)
+
+        lb = LoopbackDispatcher()
+        promql = 'sum by (grp)(fm{_ws_="w",_ns_="n"})'
+        plain = _run(_planner(mapper, spread), ms, promql)
+        plan = query_range_to_logical_plan(promql, START, 30_000, END)
+
+        # every shard replicated (never IN_PROCESS), every copy primary
+        # here -> the fused root engages through mesh_feed alone
+        def disp_all(shard):
+            return lb
+        disp_all.mesh_feed = lambda shard: True
+        planner = _planner(mapper, spread, mesh=True,
+                           dispatcher_for_shard=disp_all)
+        tree = planner.materialize(plan, QueryContext()).print_tree()
+        assert "MeshReduceExec" in tree, tree
+        _assert_biteq(_run(planner, ms, promql), plain, "all-feed fused")
+
+        # one shard NOT primary here -> feed shards must not enlarge the
+        # partial shape: no mesh node at all (no shard is IN_PROCESS),
+        # plain scatter-gather, same bytes
+        last = mapper.num_shards - 1
+
+        def disp_partial(shard):
+            return lb
+        disp_partial.mesh_feed = lambda shard: shard != last
+        planner = _planner(mapper, spread, mesh=True,
+                           dispatcher_for_shard=disp_partial)
+        tree = planner.materialize(plan, QueryContext()).print_tree()
+        assert "MeshReduceExec" not in tree, tree
+        assert "MeshAggregateExec" not in tree, tree
+        _assert_biteq(_run(planner, ms, promql), plain, "partial feed")
+
+        # mesh-fused off -> feed is ignored outright (the PR 17 shape
+        # only ever builds from IN_PROCESS shards)
+        planner = _planner(mapper, spread, mesh=True,
+                           dispatcher_for_shard=disp_all,
+                           mesh_fused=False)
+        tree = planner.materialize(plan, QueryContext()).print_tree()
+        assert "MeshReduceExec" not in tree, tree
+        assert "MeshAggregateExec" not in tree, tree
+        _assert_biteq(_run(planner, ms, promql), plain,
+                      "feed with fusion off")
+
+
+class TestEventTopK:
+    def test_fused_matches_host_selection_bitequal(self, sweep_store):
+        ms, mapper, spread = sweep_store
+        plan = query_range_to_logical_plan(
+            'sum by (grp)(fm{_ws_="w",_ns_="n"})', START, 30_000, END)
+        raw = plan.vectors.raw_series
+        engine = MeshEngine(make_mesh())
+
+        def node():
+            return meshexec.EventTopKExec(
+                "prom", list(range(mapper.num_shards)), raw.filters,
+                raw.range_selector.from_ms, raw.range_selector.to_ms,
+                START, 30_000, END, k=2, by=("grp",),
+                query_context=QueryContext(), engine=engine,
+                mapper=mapper,
+                planned_generation=mapper.topology_generation)
+
+        def collect(result):
+            out = {}
+            for b in result.batches:
+                for tags, ts, vals in b.to_series():
+                    out[tuple(sorted(tags.items()))] = (np.asarray(ts),
+                                                        np.asarray(vals))
+            return out
+
+        fused = collect(node().execute(ExecContext(ms, QueryContext())))
+        meshexec.FABRIC_BREAKER["open"] = True
+        try:
+            host = collect(node().execute(ExecContext(ms, QueryContext())))
+        finally:
+            meshexec.reset_fabric_breaker()
+        _assert_biteq(fused, host, "event-topk fused vs host selection")
+        # the winners' rows carry values; losers' rows stay all-NaN
+        assert any(np.isfinite(v).any() for _, v in fused.values())
+
+
+class TestSplitChaos:
+    """Satellite: answers stay bit-equal through a live 4->8 split under
+    the fabric — fused pre-split, per-shard while the cutover/exclusion
+    window is active (including a query PLANNED pre-cutover and executed
+    after), and fused again over 8 shards once the split retires."""
+
+    N_SERIES = 48
+    SPREAD = 2
+    Q = 'sum by (grp)(fm)'      # no shard-key filter: full fan-out
+
+    def _mk_split_store(self):
+        ms = TimeSeriesMemStore()
+        opts = DatasetOptions()
+        mapper = ShardMapper(4)
+        for s in range(8):                 # children pre-provisioned
+            ms.setup("prom", DEFAULT_SCHEMAS, s)
+        rng = np.random.default_rng(41)
+        for i in range(self.N_SERIES):
+            tags = {"_metric_": "fm", "inst": f"i{i}",
+                    "grp": f"g{i % 3}", "_ws_": f"w{i % 5}",
+                    "_ns_": f"n{i % 2}"}
+            parent = shard_of_tags(tags, 4, self.SPREAD, opts)
+            child = shard_of_tags(tags, 8, self.SPREAD, opts)
+            b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], opts,
+                              container_size=1 << 20)
+            ts = BASE + np.arange(N_ROWS) * STEP
+            b.add_series(ts.tolist(),
+                         [_dyadic_series(rng, N_ROWS).tolist()], tags)
+            targets = {parent, child}      # parent superset + caught-up
+            for off, c in enumerate(b.containers()):
+                for t in targets:
+                    ms.get_shard("prom", t).ingest_container(c, off)
+        return ms, mapper
+
+    def test_bitequal_through_4_to_8_split(self):
+        ms, mapper = self._mk_split_store()
+        oracle = _run(_planner(mapper, self.SPREAD), ms, self.Q)
+        fused_planner = _planner(mapper, self.SPREAD, mesh=True)
+
+        # pre-split: fused root, bit-equal
+        plan = query_range_to_logical_plan(self.Q, START, 30_000, END)
+        ep_pre = fused_planner.materialize(plan, QueryContext())
+        assert "MeshReduceExec" in ep_pre.print_tree()
+        _assert_biteq(_run(fused_planner, ms, self.Q), oracle, "pre-split")
+
+        # catch-up phase: generation bumped — the PRE-planned program
+        # must stand down per-shard (its placement is stale) while a
+        # freshly planned query still fuses over the 4 parents
+        mapper.begin_split(self.SPREAD)
+        falls0 = meshgrid.STATS["fallbacks"]
+        got = {}
+        res = ep_pre.execute(ExecContext(ms, QueryContext()))
+        for b in res.batches:
+            for tags, ts, vals in b.to_series():
+                got[tuple(sorted(tags.items()))] = (np.asarray(ts),
+                                                    np.asarray(vals))
+        _assert_biteq(got, oracle, "stale-generation fallback")
+        assert meshgrid.STATS["fallbacks"] > falls0
+        _assert_biteq(_run(fused_planner, ms, self.Q), oracle, "catchup")
+
+        # cutover: reshard exclusions active — planner refuses to fuse,
+        # per-shard leaves slice the migrated half, bytes unchanged
+        mapper.commit_split()
+        plan2 = query_range_to_logical_plan(self.Q, START, 30_000, END)
+        tree2 = fused_planner.materialize(plan2,
+                                          QueryContext()).print_tree()
+        assert "MeshReduceExec" not in tree2
+        _assert_biteq(_run(fused_planner, ms, self.Q), oracle, "serving")
+
+        # retire + finish: parents purge their migrated half and the
+        # fabric fuses the full 8-shard topology in one program again
+        mapper.retire_split()
+        for p in range(4):
+            ms.get_shard("prom", p).purge_resharded(8, self.SPREAD)
+        mapper.finish_split()
+        plan3 = query_range_to_logical_plan(self.Q, START, 30_000, END)
+        tree3 = fused_planner.materialize(plan3,
+                                          QueryContext()).print_tree()
+        assert "MeshReduceExec" in tree3
+        _assert_biteq(_run(fused_planner, ms, self.Q), oracle,
+                      "post-split fused")
