@@ -818,6 +818,64 @@ def main():
         log(f"FAIL: m4 year panel kept {pts_out} points "
             f"({reduction:.0f}x) — below the 50x bar")
         return 1
+
+    # fleet-insights guard (ISSUE 19): the same loop with the full
+    # per-query insights accounting the server does in _exec /
+    # _note_insight — plan_keys (canonical fingerprint + batch key),
+    # co-arrival note, the ledger fold, and an SLO tracker observe —
+    # vs the bare loop, interleaved A/B under the same <=3% / 0.5 ms
+    # budget.  Workload analytics must be free at serving cadence.
+    from filodb_tpu.insights.ledger import WorkloadLedger, plan_keys
+    from filodb_tpu.insights.slo import SloObjective, SloTracker
+    ins = WorkloadLedger(node="bench")
+    slo = SloTracker([SloObjective(name="bench", latency_threshold_s=1.0,
+                                   target=0.999)], node="bench")
+
+    def once_insighted():
+        t_in = time.perf_counter()
+        lp = query_range_to_logical_plan(query, start, STEP, end)
+        qctx = QueryContext(submit_time_ms=int(time.time() * 1000))
+        fp, bk = plan_keys("prom", lp, query)
+        ins.note_arrival(bk)
+        ep = planner.materialize(lp, qctx)
+        res = ep.execute(ExecContext(ms, qctx))
+        out = to_prom_matrix(res)
+        took = time.perf_counter() - t_in
+        ins.note(fp, query=query, dataset="prom", tenant="bench",
+                 latency_s=took, samples=res.stats.samples_scanned,
+                 resultcache="miss", batch_key=bk)
+        slo.observe("bench", "default", took)
+        return out
+
+    try:
+        once()
+        once_insighted()
+        lat_bare, lat_ins = [], []
+        for _ in range(ITERS):
+            t0 = time.perf_counter()
+            once()
+            lat_bare.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            once_insighted()
+            lat_ins.append(time.perf_counter() - t0)
+    finally:
+        slo.close()
+    med_bare = statistics.median(lat_bare)
+    med_ins = statistics.median(lat_ins)
+    ins_delta = statistics.median(
+        i - b for i, b in zip(lat_ins, lat_bare))
+    ins_overhead = ins_delta / med_bare
+    log(f"insights off {med_bare * 1e3:.2f} ms  "
+        f"on {med_ins * 1e3:.2f} ms  paired delta "
+        f"{ins_delta * 1e6:+.0f} us ({ins_overhead * 100:+.2f}%)")
+    emit("insights_overhead_median", ins_overhead * 100, "%",
+         off_ms=round(med_bare * 1e3, 3), on_ms=round(med_ins * 1e3, 3),
+         paired_delta_us=round(ins_delta * 1e6, 1),
+         fingerprints=ins.fingerprints())
+    if ins_overhead > 0.03 and ins_delta > 5e-4:
+        log(f"FAIL: insights/SLO accounting overhead "
+            f"{ins_overhead * 100:.2f}% exceeds the 3% budget")
+        return 1
     return 0
 
 

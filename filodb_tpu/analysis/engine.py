@@ -190,11 +190,13 @@ class Project:
 
     def __init__(self, modules: list[Module], root: Optional[pathlib.Path] = None,
                  test_sources: Optional[list[str]] = None,
-                 doc_text: Optional[str] = None):
+                 doc_text: Optional[str] = None,
+                 api_doc_text: Optional[str] = None):
         self.modules = modules
         self.root = root
         self._test_sources = test_sources
         self._doc_text = doc_text
+        self._api_doc_text = api_doc_text
         self._shared: dict = {}
 
     def shared(self, key: str, build: Callable):
@@ -228,6 +230,15 @@ class Project:
     @property
     def doc_lines(self) -> list[str]:
         return self.shared("doc_lines", lambda p: p.doc_text.splitlines())
+
+    @property
+    def api_doc_text(self) -> str:
+        """doc/http_api.md (admin-endpoint drift needs it)."""
+        if self._api_doc_text is None:
+            p = (self.root / "doc" / "http_api.md") if self.root else None
+            self._api_doc_text = p.read_text() \
+                if p is not None and p.exists() else ""
+        return self._api_doc_text
 
 
 def _find_repo_root(path: pathlib.Path) -> pathlib.Path:
@@ -343,30 +354,36 @@ def run_project(project: Project,
 def run_paths(paths: Iterable[pathlib.Path | str],
               rules: Optional[Iterable[str]] = None,
               test_sources: Optional[list[str]] = None,
-              doc_text: Optional[str] = None) -> list[Finding]:
+              doc_text: Optional[str] = None,
+              api_doc_text: Optional[str] = None) -> list[Finding]:
     modules, root = load_modules(paths)
-    return run_project(Project(modules, root, test_sources, doc_text),
+    return run_project(Project(modules, root, test_sources, doc_text,
+                               api_doc_text),
                        rules)
 
 
 def run_source(src: str, rules: Optional[Iterable[str]] = None,
                rel: str = "fake.py",
                test_sources: Optional[list[str]] = None,
-               doc_text: str = "") -> list[Finding]:
+               doc_text: str = "",
+               api_doc_text: str = "") -> list[Finding]:
     """Lint one in-memory source string (rule self-tests)."""
     m = Module(rel, src)
-    return run_project(Project([m], None, test_sources or [], doc_text),
+    return run_project(Project([m], None, test_sources or [], doc_text,
+                               api_doc_text),
                        rules)
 
 
 def run_sources(srcs: dict, rules: Optional[Iterable[str]] = None,
                 test_sources: Optional[list[str]] = None,
-                doc_text: str = "") -> list[Finding]:
+                doc_text: str = "",
+                api_doc_text: str = "") -> list[Finding]:
     """Lint several in-memory modules TOGETHER ({rel: src}) — the
     whole-program analyses (cross-module blocking, lock order) see the
     combined project, exactly like a tree run over those files."""
     modules = [Module(rel, src) for rel, src in srcs.items()]
-    return run_project(Project(modules, None, test_sources or [], doc_text),
+    return run_project(Project(modules, None, test_sources or [], doc_text,
+                               api_doc_text),
                        rules)
 
 

@@ -701,3 +701,66 @@ def metric_doc(project):
                 f"add the full name, or list its suffix on a "
                 f"`filodb_<family>_*` row"))
     return findings
+
+
+# ---------------------------------------------------------------------------
+# admin-endpoint-documented (ISSUE 19, project scope): every /admin/...
+# route the HTTP server dispatches must appear in doc/http_api.md — the
+# metric-doc discipline applied to the operational API surface.  The
+# router matches path segments with AST compares (parts[0] == "admin"
+# and parts[1] == "<name>"), never "/admin/..." string literals, so the
+# rule reads the same compares instead of grepping for slashes.
+# ---------------------------------------------------------------------------
+
+_ROUTER_REL = "filodb_tpu/http/server.py"
+
+
+def routed_admin_endpoints(project) -> dict[str, tuple[str, int]]:
+    """{"/admin/<name>": (rel, line)} for every admin dispatch arm in
+    the HTTP server's router."""
+    routes: dict[str, tuple[str, int]] = {}
+    for m in project.modules:
+        if m.tree is None or not m.rel.endswith(_ROUTER_REL.rsplit(
+                "/", 1)[-1]) or "http" not in m.rel:
+            continue
+        for node in m.nodes:
+            if not isinstance(node, ast.BoolOp) \
+                    or not isinstance(node.op, ast.And):
+                continue
+            segs: dict[int, str] = {}
+            for cmp_ in node.values:
+                if not (isinstance(cmp_, ast.Compare)
+                        and len(cmp_.ops) == 1
+                        and isinstance(cmp_.ops[0], ast.Eq)
+                        and isinstance(cmp_.left, ast.Subscript)
+                        and isinstance(cmp_.left.value, ast.Name)
+                        and cmp_.left.value.id == "parts"
+                        and isinstance(cmp_.left.slice, ast.Constant)
+                        and isinstance(cmp_.left.slice.value, int)
+                        and len(cmp_.comparators) == 1
+                        and isinstance(cmp_.comparators[0], ast.Constant)
+                        and isinstance(cmp_.comparators[0].value, str)):
+                    continue
+                segs[cmp_.left.slice.value] = cmp_.comparators[0].value
+            if segs.get(0) == "admin" and 1 in segs:
+                route = f"/admin/{segs[1]}"
+                if route not in routes:
+                    routes[route] = (m.rel, node.lineno)
+    return routes
+
+
+@rule("admin-endpoint-documented", scope="project",
+      doc="/admin/... routes the HTTP server dispatches but "
+          "doc/http_api.md does not describe")
+def admin_endpoint_documented(project):
+    api_doc = project.api_doc_text
+    findings = []
+    for route, (rel, line) in sorted(routed_admin_endpoints(project).items()):
+        if route not in api_doc:
+            findings.append(Finding(
+                "admin-endpoint-documented", rel, line,
+                f"{route}: dispatched here but absent from "
+                f"doc/http_api.md — document the endpoint (operators "
+                f"discover the admin surface from that table, not "
+                f"from the router)"))
+    return findings

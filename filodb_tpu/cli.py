@@ -246,6 +246,61 @@ def cmd_shards(args) -> int:
     return 0 if body.get("status") == "success" else 1
 
 
+def cmd_insights(args) -> int:
+    """Fleet workload insights (ISSUE 19): top-k query fingerprints by
+    cost/latency/QPS with per-tenant rollup and batching headroom
+    (served by /admin/insights), or — with ``--fleet`` — the merged
+    whole-cluster view (served by /admin/fleet)."""
+    if args.fleet:
+        body = _http_get(args.server, "/admin/fleet",
+                         {"refresh": "true" if args.refresh else None})
+        print(json.dumps(body.get("data", body), indent=2))
+        return 0 if body.get("status") == "success" else 1
+    body = _http_get(args.server, "/admin/insights",
+                     {"top": args.top, "sort": args.sort,
+                      "raw": "true" if args.raw else None})
+    if body.get("status") != "success":
+        print(json.dumps(body, indent=2))
+        return 1
+    data = body["data"]
+    if args.raw or args.json:
+        print(json.dumps(data, indent=2))
+        return 0
+    print(f"nodes {','.join(data.get('nodes') or ['?'])}: "
+          f"{data['fingerprints']} fingerprints "
+          f"({data['dropped']} evicted), window {data['window_s']}s, "
+          f"sort {data['sort']}")
+    for row in data.get("top", []):
+        rc = row["resultcache"]
+        print(f"  {row['query'] or row['fingerprint']!r} "
+              f"[{row['dataset']}]")
+        print(f"    count {row['count']} ({row['errors']} errors, "
+              f"{row['qps']}/s)  p50 {row['p50_ms']}ms "
+              f"p95 {row['p95_ms']}ms p99 {row['p99_ms']}ms")
+        print(f"    samples {row['samples']}  device "
+              f"{row['device_ms']}ms/{row['device_programs']} launches  "
+              f"hbm {row['hbm_bytes']}B  cache "
+              f"{rc['hit']}/{rc['partial']}/{rc['miss']} h/p/m"
+              + (f"  sheds {row['sheds']}" if row["sheds"] else ""))
+    bat = data.get("batching") or {}
+    print(f"batching headroom: {bat.get('headroom', 0)} "
+          f"co-arriving shape-identical queries at peak")
+    for row in bat.get("keys", [])[:args.top]:
+        print(f"  {row['batch_key']}: peak {row['peak']}, "
+              f"{row['co_arrived']}/{row['arrivals']} co-arrived")
+    for tenant, t in sorted((data.get("tenants") or {}).items()):
+        avg = t["latency_us"] / 1000.0 / t["count"] if t["count"] else 0
+        print(f"tenant {tenant or '(untagged)'}: {t['count']} queries, "
+              f"{t['errors']} errors, avg {avg:.3f}ms, "
+              f"{t['samples']} samples")
+    for row in data.get("slo") or []:
+        print(f"slo {row['objective']} tenant {row['tenant']}: "
+              f"fast burn {row['fast_burn']}x, slow burn "
+              f"{row['slow_burn']}x ({row['bad']}/{row['total']} bad, "
+              f"budget {1 - row['target']:.4g})")
+    return 0
+
+
 def cmd_status(args) -> int:
     body = _http_get(args.server, f"/api/v1/cluster/{args.dataset}/status")
     if body.get("status") != "success":
@@ -381,8 +436,9 @@ def cmd_rules_check(args) -> int:
             print(f"{path}: FAILED: {e}")
             failed = True
     if args.builtin:
-        from filodb_tpu.rules.selfmon import selfmon_pack
+        from filodb_tpu.rules.selfmon import selfmon_pack, slo_pack
         targets.append(("builtin:self-monitoring", selfmon_pack()))
+        targets.append(("builtin:slo-burn", slo_pack()))
     if not targets and not failed:
         print("rules-check: no rule files given (pass paths and/or "
               "--builtin)", file=sys.stderr)
@@ -498,6 +554,25 @@ def build_parser() -> argparse.ArgumentParser:
     ru.add_argument("--json", action="store_true",
                     help="raw JSON instead of the text summary")
     ru.set_defaults(fn=cmd_rollup_status)
+
+    iw = sub.add_parser("insights",
+                        help="fleet workload insights: top query "
+                             "fingerprints, tenant SLO burn, batching "
+                             "headroom (/admin/insights, /admin/fleet)")
+    iw.add_argument("--server", default="http://localhost:8080")
+    iw.add_argument("--top", type=int, default=20)
+    iw.add_argument("--sort", default="cost",
+                    choices=["cost", "latency", "count", "qps", "errors"])
+    iw.add_argument("--raw", action="store_true",
+                    help="print the raw mergeable snapshot bundle")
+    iw.add_argument("--json", action="store_true",
+                    help="print the view as JSON instead of text")
+    iw.add_argument("--fleet", action="store_true",
+                    help="print the merged whole-cluster /admin/fleet "
+                         "tree instead of this node's view")
+    iw.add_argument("--refresh", action="store_true",
+                    help="with --fleet: force a synchronous peer poll")
+    iw.set_defaults(fn=cmd_insights)
 
     sh = sub.add_parser("shards",
                         help="ingest watermark chain / lag / shard "

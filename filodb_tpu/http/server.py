@@ -35,7 +35,8 @@ from filodb_tpu.promql.parser import (ParseError,
 from filodb_tpu.query.exec import ExecContext
 from filodb_tpu.query.model import (QueryContext, QueryError,
                                     ShardUnavailable)
-from filodb_tpu.utils.observability import (TRACER, query_metrics,
+from filodb_tpu.utils.observability import (TRACER, insights_metrics,
+                                            query_metrics,
                                             workload_metrics)
 from filodb_tpu.workload import deadline as wdl
 
@@ -46,6 +47,7 @@ _MAX_REMOTE_UNCOMPRESSED = 128 * 1024 * 1024
 
 _METRICS = query_metrics()
 _WORKLOAD_M = workload_metrics()
+_INSIGHTS_M = insights_metrics()
 
 
 def _timed(endpoint: str):
@@ -160,10 +162,24 @@ class FiloHttpServer:
     # callable returning this node's per-dataset split progress (clone /
     # retire markers) for the /__health gossip the controller gates on
     split_progress: Optional[object] = None
+    # fleet workload insights (ISSUE 19, filodb_tpu/insights): the
+    # per-fingerprint workload ledger behind /admin/insights.  PER
+    # SERVER, not process-wide (the WatermarkLedger lesson: in-process
+    # multi-node tests must not share one table); the standalone server
+    # installs a configured one, bare servers get a lazy default
+    insights: Optional[object] = None
+    # tenant SLO tracker (insights/slo.py); None = no objectives
+    # configured (queries are not matched, /admin/insights omits SLO)
+    slo: Optional[object] = None
+    # fleet aggregator (insights/fleet.py) behind /admin/fleet; a
+    # peerless default is created lazily so single-node /admin/fleet
+    # still serves the merged-local view
+    fleet: Optional[object] = None
     datasets: dict = field(default_factory=dict)
     _httpd: Optional[ThreadingHTTPServer] = None
     _thread: Optional[threading.Thread] = None
     _wm_lock: threading.Lock = field(default_factory=threading.Lock)
+    _ins_lock: threading.Lock = field(default_factory=threading.Lock)
 
     def bind_dataset(self, binding: DatasetBinding) -> None:
         self.datasets[binding.dataset] = binding
@@ -593,6 +609,12 @@ class FiloHttpServer:
         if len(parts) == 2 and parts[0] == "admin" \
                 and parts[1] == "shards":
             return self._shards(params)
+        if len(parts) == 2 and parts[0] == "admin" \
+                and parts[1] == "insights":
+            return self._insights(params)
+        if len(parts) == 2 and parts[0] == "admin" \
+                and parts[1] == "fleet":
+            return self._fleet(params)
         if len(parts) >= 2 and parts[0] == "admin" and parts[1] == "split":
             return self._split(parts[2:], params)
         if len(parts) == 3 and parts[0] == "admin" and parts[1] == "traces":
@@ -811,6 +833,27 @@ class FiloHttpServer:
                 return 400, error_response(
                     "bad_data", "slow-query-threshold-s must be > 0")
             TRACE_STORE.slow_threshold_s = thr
+        # trace head-sampling (ISSUE 19): fraction of NORMAL
+        # (sub-threshold) traces retained in /admin/traces — raising it
+        # during an investigation must not require a restart
+        if "trace-sample-rate" in p:
+            rate = float(p["trace-sample-rate"])
+            if not 0.0 <= rate <= 1.0:
+                return 400, error_response(
+                    "bad_data", "trace-sample-rate must be in [0, 1]")
+            TRACE_STORE.sample_rate = rate
+        # workload-insights knobs (ISSUE 19): the ledger is killable
+        # and the co-arrival window tunable without a restart
+        if "insights-enabled" in p:
+            self._ensure_insights().enabled = \
+                str(p["insights-enabled"]).lower() in ("true", "1")
+        if "insights-co-arrival-window-ms" in p:
+            window = float(p["insights-co-arrival-window-ms"])
+            if window <= 0:
+                return 400, error_response(
+                    "bad_data",
+                    "insights-co-arrival-window-ms must be > 0")
+            self._ensure_insights().co_window_ms = window
         devicewatch.COMPILE_WATCH.configure(
             storm_shapes=p.get("jit-storm-shapes"),
             storm_window_s=p.get("jit-storm-window-s"))
@@ -905,8 +948,16 @@ class FiloHttpServer:
                 "ingest-stall-window-s":
                     self._ensure_watermarks().stall_window_s,
             },
+            "insights": {
+                "enabled": self._ensure_insights().enabled,
+                "max-entries": self._ensure_insights().max_entries,
+                "co-arrival-window-ms":
+                    self._ensure_insights().co_window_ms,
+                "fingerprints": self._ensure_insights().fingerprints(),
+            },
             "observability": {
                 "slow-query-threshold-s": TRACE_STORE.slow_threshold_s,
+                "trace-sample-rate": TRACE_STORE.sample_rate,
                 "jit-storm-shapes":
                     devicewatch.COMPILE_WATCH.storm_shapes,
                 "jit-storm-window-s":
@@ -1034,6 +1085,124 @@ class FiloHttpServer:
                         mapper = None
                 wm.watch(ds, b.memstore, mapper=mapper)
             return wm
+
+    # -------------------------------------------- fleet workload insights
+
+    def _ensure_insights(self):
+        """Lazy default workload ledger (bare servers in tests); the
+        standalone server installs a configured one before start().
+        Same double-create discipline as :meth:`_ensure_watermarks`."""
+        ins = self.insights
+        if ins is not None:
+            return ins
+        with self._ins_lock:
+            if self.insights is None:
+                from filodb_tpu.insights.ledger import WorkloadLedger
+                self.insights = WorkloadLedger(node=self.node_name or "")
+            return self.insights
+
+    def _insights_raw(self) -> dict:
+        """The raw MERGEABLE bundle behind ``/admin/insights?raw=true``
+        — also what FleetAggregator peers fetch.  Every section is
+        either exactly mergeable (insights, slo: fixed bucket bounds,
+        int counters) or summable/per-node (watermarks, replicas,
+        kernels); nothing here derives from the wall clock, so two
+        snapshots of a quiesced node are bit-identical (the fleet-merge
+        e2e contract)."""
+        ins = self._ensure_insights()
+        bundle: dict = {"node": self.node_name or "",
+                        "insights": ins.snapshot(),
+                        "slo": self.slo.snapshot()
+                        if self.slo is not None else None}
+        try:
+            wm = self._ensure_watermarks().sample()
+            bundle["watermarks"] = {
+                ds: dict(d.get("totals") or {})
+                for ds, d in (wm.get("datasets") or {}).items()}
+        except Exception:  # noqa: BLE001 — store mid-shutdown
+            bundle["watermarks"] = {}
+        replicas: dict = {}
+        if self.shard_manager is not None:
+            for ds in self.shard_manager.datasets():
+                try:
+                    m = self.shard_manager.mapper(ds)
+                except KeyError:
+                    continue
+                statuses = [m.best_status(s).value
+                            for s in range(m.num_shards)]
+                replicas[ds] = {
+                    "shards": m.num_shards,
+                    "active": sum(1 for s in statuses if s == "Active"),
+                    "down": sum(1 for s in statuses
+                                if s not in ("Active", "Recovery",
+                                             "Assigned"))}
+        else:
+            for ds, b in self.datasets.items():
+                n = len(b.memstore.shards(ds))
+                replicas[ds] = {"shards": n, "active": n, "down": 0}
+        bundle["replicas"] = replicas
+        try:
+            from filodb_tpu.utils import devicewatch
+            ks = devicewatch.kernel_summary()
+            rows = ks.get("programs") or []
+            bundle["kernels"] = {
+                "enabled": bool(ks.get("enabled")),
+                "programs": len(rows),
+                "launches": sum(int(r.get("launches") or 0)
+                                for r in rows),
+                "regressed": sum(1 for r in rows if r.get("regressed"))}
+        except Exception:  # noqa: BLE001 — devicewatch unavailable
+            bundle["kernels"] = {"enabled": False, "programs": 0,
+                                 "launches": 0, "regressed": 0}
+        return bundle
+
+    @_timed("insights")
+    def _insights(self, p: dict) -> tuple[int, dict]:
+        """Per-fingerprint workload analytics (ISSUE 19 pillar 1).
+        Default: the human view — top-k fingerprints by ``sort``
+        (cost|latency|count|qps|errors), per-tenant rollup, batching
+        headroom, SLO rows.  ``raw=true``: the mergeable bundle the
+        fleet console aggregates."""
+        if str(p.get("raw", "")).lower() in ("true", "1", "yes"):
+            return 200, {"status": "success", "data": self._insights_raw()}
+        from filodb_tpu.insights import ledger as _il
+        try:
+            top = int(p.get("top", 20))
+            if top <= 0:
+                raise ValueError
+        except (TypeError, ValueError):
+            return 400, error_response("bad_data",
+                                       "top must be a positive integer")
+        sort = str(p.get("sort", "cost"))
+        if sort not in ("cost", "latency", "count", "qps", "errors"):
+            return 400, error_response(
+                "bad_data", f"unknown sort {sort!r} (want cost|latency"
+                            f"|count|qps|errors)")
+        ins = self._ensure_insights()
+        data = _il.view(ins.snapshot(), top=top, sort=sort)
+        data["node"] = self.node_name or ""
+        data["enabled"] = ins.enabled
+        if self.slo is not None:
+            data["slo"] = self.slo.rows()
+        return 200, {"status": "success", "data": data}
+
+    @_timed("fleet")
+    def _fleet(self, p: dict) -> tuple[int, dict]:
+        """The one-pane cluster console (ISSUE 19 pillar 3): the merged
+        fleet tree from this node's aggregator.  ``refresh=true`` forces
+        a synchronous peer poll first.  A node without peers serves the
+        merged-local view through the same shape."""
+        if self.fleet is None:
+            # peerless aggregator: single-node deployments and bare
+            # test servers still get the /admin/fleet tree shape
+            from filodb_tpu.insights.fleet import FleetAggregator
+            with self._ins_lock:
+                if self.fleet is None:
+                    self.fleet = FleetAggregator(
+                        self.node_name or "", {}, self._insights_raw)
+        refresh = str(p.get("refresh", "")).lower() in ("true", "1", "yes")
+        return 200, {"status": "success",
+                     "data": self.fleet.tree(refresh=refresh)}
 
     @_timed("integrity")
     def _integrity(self) -> tuple[int, dict]:
@@ -1192,6 +1361,20 @@ class FiloHttpServer:
         qctx = self._query_context(params or {})
         t0 = _time.perf_counter()
 
+        # workload insights (ISSUE 19): key the query ONCE on the entry
+        # thread — (fingerprint, batch key) are pure functions of the
+        # plan, and the co-arrival window must see arrivals, not
+        # completions
+        ins = self._ensure_insights()
+        ins_keys = None
+        if ins.enabled:
+            try:
+                from filodb_tpu.insights.ledger import plan_keys
+                ins_keys = plan_keys(b.dataset, plan, query)
+                ins.note_arrival(ins_keys[1])
+            except Exception:  # noqa: BLE001 — insights never fail a query
+                ins_keys = None
+
         from filodb_tpu.utils.devicewatch import FLIGHT
         FLIGHT.record("query.start", trace_id=qctx.trace_id,
                       dataset=b.dataset, query=query[:200])
@@ -1292,13 +1475,15 @@ class FiloHttpServer:
                     else:
                         result = run()
         except BaseException as e:
+            fail_s = _time.perf_counter() - t0
             FLIGHT.record("query.end", trace_id=qctx.trace_id,
                           dataset=b.dataset, error=repr(e)[:200],
-                          seconds=round(_time.perf_counter() - t0, 6))
-            TRACE_STORE.note_complete(qctx.trace_id,
-                                      _time.perf_counter() - t0,
+                          seconds=round(fail_s, 6))
+            TRACE_STORE.note_complete(qctx.trace_id, fail_s,
                                       query=query, dataset=b.dataset,
                                       error=repr(e))
+            self._note_insight(b, ins, ins_keys, qctx, query, fail_s,
+                               error=e)
             raise
         total_s = _time.perf_counter() - t0
         result.stats.timings.setdefault("total", total_s)
@@ -1306,7 +1491,59 @@ class FiloHttpServer:
                       dataset=b.dataset, seconds=round(total_s, 6))
         TRACE_STORE.note_complete(qctx.trace_id, total_s, query=query,
                                   dataset=b.dataset)
+        self._note_insight(b, ins, ins_keys, qctx, query, total_s,
+                           stats=result.stats)
         return result, qctx.trace_id
+
+    def _note_insight(self, b: DatasetBinding, ins, keys, qctx,
+                      query: str, total_s: float, stats=None,
+                      error=None) -> None:
+        """Fold one finished query into the workload ledger + SLO
+        tracker.  Sheds (admission refusals, expired deadlines) are
+        classified by reason; everything here is best-effort and never
+        fails the query."""
+        if keys is None or not ins.enabled:
+            return
+        try:
+            shed = ""
+            outcome = "ok"
+            if error is not None:
+                outcome = "error"
+                from filodb_tpu.workload.admission import AdmissionRejected
+                if isinstance(error, AdmissionRejected):
+                    shed = getattr(error, "reason", "") or "overload"
+                    outcome = "shed"
+                elif isinstance(error, wdl.DeadlineExceeded):
+                    shed = "deadline_exceeded"
+                    outcome = "shed"
+            rc = ""
+            samples = dev_n = hbm = 0
+            dev_s = 0.0
+            if stats is not None:
+                samples = int(stats.samples_scanned)
+                hbm = sum(stats.hbm_read_bytes.values())
+                dev_n = len(stats.device_programs)
+                dev_s = sum(stats.device_programs.values())
+                rc_c = stats.resultcache_cached_samples
+                rc_r = stats.resultcache_recomputed_samples
+                if rc_c or rc_r:
+                    rc = "hit" if not rc_r else ("partial" if rc_c
+                                                 else "miss")
+            dropped = ins.note(
+                keys[0], query=query, dataset=b.dataset,
+                tenant=qctx.tenant or "", latency_s=total_s,
+                error=error is not None, samples=samples,
+                resultcache=rc, device_programs=dev_n, device_s=dev_s,
+                hbm_bytes=hbm, shed_reason=shed, batch_key=keys[1])
+            _INSIGHTS_M["noted"].inc(dataset=b.dataset, outcome=outcome)
+            if dropped:
+                _INSIGHTS_M["dropped"].inc(dropped,
+                                           node=self.node_name or "")
+            if self.slo is not None:
+                self.slo.observe(qctx.tenant or "", qctx.priority,
+                                 total_s, error=error is not None)
+        except Exception:  # noqa: BLE001 — insights never fail a query
+            pass
 
     # ------------------------------------------------------- metadata routes
 

@@ -1,0 +1,29 @@
+"""Fleet workload insights (ISSUE 19, doc/observability.md).
+
+Three pillars over the existing serving path:
+
+- ``ledger``: the per-coordinator workload ledger — a bounded table
+  keyed by the canonical-PromQL plan fingerprint (query/resultcache.py)
+  accumulating per-query observations the exec path already carries,
+  plus the batch-compatibility co-arrival window that measures the
+  empirical vmap-batching headroom (ROADMAP item 2);
+- ``slo``: declarative per-tenant/priority SLO objectives tracked as
+  multi-window burn rates, exported as ``filodb_slo_*`` level gauges
+  the self-monitoring rule pack alerts on;
+- ``fleet``: the FleetAggregator polling cluster peers' raw snapshots
+  into one merged ``/admin/fleet`` tree.
+
+Every snapshot here is MERGEABLE: integer accumulators and fixed
+module-constant histogram bounds, so merging per-node snapshots is
+exact (commutative, associative, partition-invariant — the PR 9
+ledger-reconciliation discipline, proven by tests/test_insights.py).
+"""
+
+from filodb_tpu.insights.fleet import FleetAggregator
+from filodb_tpu.insights.ledger import (LATENCY_BUCKETS_MS, WorkloadLedger,
+                                        merge_snapshots, plan_keys)
+from filodb_tpu.insights.slo import SloObjective, SloTracker, merge_slo
+
+__all__ = ["FleetAggregator", "LATENCY_BUCKETS_MS", "SloObjective",
+           "SloTracker", "WorkloadLedger", "merge_slo", "merge_snapshots",
+           "plan_keys"]

@@ -1,0 +1,159 @@
+"""Fleet console aggregator (ISSUE 19 pillar 3).
+
+A FleetAggregator on every coordinator polls its cluster peers' raw
+mergeable snapshots (``/admin/insights?raw=true``, the same membership
+view the StatusPoller gossips over) and serves one merged
+``/admin/fleet`` tree: the fleet workload ledger, SLO counters,
+watermark-lag totals, per-node replica health, and the kernel
+flight-deck summaries — the one-pane view that previously required
+curl-ing N nodes and merging JSON by hand.
+
+Unreachable peers never fail the view: their row is marked with the
+snapshot age (staleness) and the error, and their LAST known snapshot
+keeps contributing until it expires.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import threading
+import time
+import urllib.request
+
+from filodb_tpu.insights import ledger as _ledger
+from filodb_tpu.insights import slo as _slo
+from filodb_tpu.utils.observability import (PeriodicThread,
+                                            insights_metrics)
+
+
+class FleetAggregator:
+    """Poll peers' raw bundles; merge on read (tree()).
+
+    ``interval_s > 0`` enables BACKGROUND polling (opt-in: a console
+    must never add steady cross-node chatter to a cluster nobody is
+    looking at — chaos/partition tests especially must not see extra
+    peer traffic they didn't script).  ``interval_s <= 0`` is the
+    on-demand mode: no thread, every ``tree()`` read does one
+    synchronous poll round, so /admin/fleet is always fresh and a
+    quiet cluster sees zero fleet traffic."""
+
+    def __init__(self, node: str, peers: dict, local_fn,
+                 interval_s: float = 0.0, timeout_s: float = 2.0,
+                 stale_after_s: float = 60.0):
+        self.node = node
+        self.peers = {n: ep for n, ep in (peers or {}).items()
+                      if n != node}
+        self.local_fn = local_fn
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self.stale_after_s = float(stale_after_s)
+        # _lock covers the per-peer result cache ONLY; peer fetches
+        # always run outside it (a wedged peer must not block
+        # /admin/fleet readers or the next poll round)
+        self._lock = threading.Lock()
+        self._cache: dict[str, dict] = {}  # guarded-by: _lock
+        self._m = insights_metrics()
+        self._thread = None
+        if self.interval_s > 0:
+            self._thread = PeriodicThread(self.poll, self.interval_s,
+                                          name=f"fleet-{node}")
+
+    def start(self) -> None:
+        if self._thread is not None and self.peers:
+            self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._thread.stop()
+
+    # -------------------------------------------------------------- polling
+
+    def _fetch(self, endpoint: str) -> dict:
+        url = f"{endpoint}/admin/insights?raw=true"
+        with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+            body = json.loads(resp.read())
+        data = body.get("data")
+        if not isinstance(data, dict):
+            raise ValueError(f"malformed insights payload from {url}")
+        return data
+
+    def poll(self) -> None:
+        """One synchronous poll round over every peer (also the
+        ``?refresh=true`` path).  Fetches run concurrently and OUTSIDE
+        the cache lock; results land under it."""
+        if not self.peers:
+            return
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(len(self.peers), 8),
+                thread_name_prefix=f"fleet-{self.node}") as pool:
+            futs = {pool.submit(self._fetch, ep): peer
+                    for peer, ep in self.peers.items()}
+            for fut in concurrent.futures.as_completed(futs):
+                peer = futs[fut]
+                try:
+                    bundle = fut.result()
+                except Exception as e:  # noqa: BLE001 — peer down/slow
+                    self._m["fleet_polls"].inc(peer=peer,
+                                               outcome="error")
+                    with self._lock:
+                        row = self._cache.setdefault(peer, {})
+                        row["error"] = repr(e)[:200]
+                    continue
+                self._m["fleet_polls"].inc(peer=peer, outcome="ok")
+                with self._lock:
+                    self._cache[peer] = {"bundle": bundle,
+                                         "fetched_s": time.time(),
+                                         "error": None}
+
+    # ---------------------------------------------------------------- reads
+
+    def tree(self, refresh: bool = False) -> dict:
+        """The merged fleet view.  ``refresh=True`` forces a
+        synchronous poll round first (tests + operator curl); in
+        on-demand mode (no background thread) every read polls, so the
+        console is never staler than the last curl."""
+        if refresh or self._thread is None:
+            self.poll()
+        now = time.time()
+        local = self.local_fn()
+        bundles = [local]
+        nodes = {self.node: {"ok": True, "stale_s": 0.0, "error": None,
+                             "local": True}}
+        with self._lock:
+            cache = {p: dict(r) for p, r in self._cache.items()}
+        for peer in sorted(self.peers):
+            row = cache.get(peer)
+            if row is None or "bundle" not in row:
+                nodes[peer] = {"ok": False, "stale_s": None,
+                               "error": (row or {}).get("error")
+                               or "not yet polled", "local": False}
+                continue
+            age = now - row["fetched_s"]
+            ok = row.get("error") is None and age <= self.stale_after_s
+            nodes[peer] = {"ok": ok, "stale_s": round(age, 3),
+                           "error": row.get("error"), "local": False}
+            if age <= self.stale_after_s:
+                bundles.append(row["bundle"])
+        insights = _ledger.merge_snapshots(
+            [b.get("insights") for b in bundles])
+        slo = _slo.merge_slo([b["slo"] for b in bundles
+                              if b.get("slo")])
+        watermarks: dict = {}
+        for b in bundles:
+            for ds, tot in (b.get("watermarks") or {}).items():
+                row = watermarks.get(ds)
+                if row is None:
+                    watermarks[ds] = dict(tot)
+                else:
+                    for k, v in tot.items():
+                        if isinstance(v, (int, float)):
+                            row[k] = row.get(k, 0) + v
+        replicas = {b.get("node", "?"): b.get("replicas")
+                    for b in bundles if b.get("replicas") is not None}
+        kernels = {b.get("node", "?"): b.get("kernels")
+                   for b in bundles if b.get("kernels") is not None}
+        return {"node": self.node, "nodes": nodes,
+                "insights": insights, "slo": slo,
+                "watermarks": watermarks, "replicas": replicas,
+                "kernels": kernels}

@@ -1,0 +1,391 @@
+"""Per-fingerprint workload ledger (ISSUE 19 pillar 1).
+
+A bounded per-coordinator table keyed by the canonical-PromQL plan
+fingerprint (query/resultcache.plan_fingerprint), accumulating the
+per-query observations the serving path already carries on
+QueryStats/ExecContext: count, a mergeable fixed-bucket latency
+histogram, samples scanned, result-cache hit/partial/miss, sampled
+device programs + HBM bytes, admission sheds and deadline refusals.
+
+Each fingerprint also carries a **batch-compatibility key**
+``dataset|plan-family|resolution|grid-steps``: queries sharing one key
+could have run as ONE vmapped launch (the DrJAX vmap-over-clients
+idiom, arXiv:2403.07128).  A sliding co-arrival window per batch key
+measures how many queries actually arrive close enough together to
+batch — the empirical headroom number ROADMAP item 2 (fleet-scale
+multi-query batching) needs before anyone writes the batching tier.
+
+Merge algebra: every accumulator is an integer (latency sums are
+microseconds, never float seconds) and the histogram bounds are the
+module constant below, so merging node snapshots is EXACT — sums of
+ints and max of peaks are commutative, associative, and invariant to
+how the query stream was partitioned across nodes
+(tests/test_insights.py proves all three generatively).
+"""
+
+from __future__ import annotations
+
+import bisect
+import collections
+import threading
+import time
+
+# Fixed latency bucket bounds (milliseconds).  A MODULE CONSTANT on
+# purpose: every node buckets with the same bounds, so elementwise
+# summing per-node bucket counts is an exact histogram merge.  Changing
+# these invalidates cross-version fleet merges — bump with care.
+LATENCY_BUCKETS_MS = (1, 2, 5, 10, 25, 50, 100, 250, 500,
+                      1000, 2500, 5000, 10000, 30000)
+
+# co-arrival window entries kept per batch key (newest win); bounds the
+# deque a hot key can grow even if the window knob is cranked up
+_MAX_ARRIVALS = 4096
+
+
+def plan_keys(dataset: str, plan, query: str) -> tuple[str, str]:
+    """(fingerprint, batch_key) for one query's logical plan.
+
+    The fingerprint is the result cache's canonical rendering when the
+    shape supports one; non-fingerprintable shapes fall back to the raw
+    query text + step so they are still attributed (prefixed ``q:`` to
+    keep the namespaces disjoint).  The batch key folds what a vmapped
+    multi-query launch must share: dataset, plan family (root logical
+    op), resolution, and the step-grid size.
+    """
+    from filodb_tpu.query import logical as lp
+    from filodb_tpu.query.resultcache import plan_fingerprint
+    try:
+        start, step, end = lp.time_range(plan)
+    except (ValueError, TypeError):
+        family = type(plan).__name__
+        return (f"q:{family}:{query[:200]}",
+                f"{dataset}|{family}|res=0|steps=0")
+    fp = None
+    try:
+        # instant queries carry step=0; the fingerprint's phase term
+        # divides by step, so treat them as non-cacheable shapes
+        fp = plan_fingerprint(plan, step, start) if step > 0 else None
+    except (ValueError, TypeError, ZeroDivisionError):
+        fp = None
+    if fp is None:
+        fp = f"q:{query[:200]}|step={step}"
+    steps = (end - start) // step + 1 if step > 0 else 1
+    family = type(plan).__name__
+    return fp, f"{dataset}|{family}|res={step}|steps={steps}"
+
+
+def _new_entry(query: str, dataset: str, batch_key: str) -> dict:
+    return {"query": query, "dataset": dataset, "batch_key": batch_key,
+            "count": 0, "errors": 0, "latency_us": 0,
+            "lat_buckets": [0] * (len(LATENCY_BUCKETS_MS) + 1),
+            "samples": 0, "rc_hit": 0, "rc_partial": 0, "rc_miss": 0,
+            "device_programs": 0, "device_us": 0, "hbm_bytes": 0,
+            "sheds": {}, "tenants": {}}
+
+
+class WorkloadLedger:
+    """One node's bounded fingerprint table + co-arrival tracker."""
+
+    def __init__(self, node: str = "", max_entries: int = 512,
+                 co_window_ms: float = 250.0, enabled: bool = True):
+        self.node = node
+        self.max_entries = int(max_entries)
+        self.co_window_ms = float(co_window_ms)
+        self.enabled = enabled
+        self.started_at_ms = int(time.time() * 1000)
+        # the whole table lives under _lock: note() does pure dict
+        # arithmetic under it, never I/O or metric callbacks
+        self._fps = collections.OrderedDict()  # guarded-by: _lock
+        self._batch: dict[str, dict] = {}  # guarded-by: _lock
+        self._arrivals: dict[str, collections.deque] = {}  # guarded-by: _lock
+        self._tenants: dict[str, dict] = {}  # guarded-by: _lock
+        self._dropped = 0  # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    # -------------------------------------------------------------- writes
+
+    def note_arrival(self, batch_key: str) -> int:
+        """Record one query arriving for ``batch_key``; returns how many
+        same-key queries (this one included) arrived within the sliding
+        co-arrival window — the size of the vmapped launch they could
+        have shared.  Called at materialize time, before execution."""
+        if not self.enabled:
+            return 1
+        now = time.monotonic()
+        horizon = now - self.co_window_ms / 1000.0
+        with self._lock:
+            dq = self._arrivals.get(batch_key)
+            if dq is None:
+                dq = self._arrivals[batch_key] = collections.deque(
+                    maxlen=_MAX_ARRIVALS)
+                # bound the arrival-tracker key space like the table
+                while len(self._arrivals) > self.max_entries:
+                    self._arrivals.pop(next(iter(self._arrivals)))
+            while dq and dq[0] < horizon:
+                dq.popleft()
+            dq.append(now)
+            co = len(dq)
+            row = self._batch.get(batch_key)
+            if row is None:
+                row = self._batch[batch_key] = {"arrivals": 0,
+                                                "co_arrived": 0, "peak": 1}
+                while len(self._batch) > self.max_entries:
+                    self._batch.pop(next(iter(self._batch)))
+            row["arrivals"] += 1
+            if co > 1:
+                row["co_arrived"] += 1
+            if co > row["peak"]:
+                row["peak"] = co
+            return co
+
+    def note(self, fingerprint: str, *, query: str = "", dataset: str = "",
+             tenant: str = "", latency_s: float = 0.0, error: bool = False,
+             samples: int = 0, resultcache: str = "",
+             device_programs: int = 0, device_s: float = 0.0,
+             hbm_bytes: int = 0, shed_reason: str = "",
+             batch_key: str = "") -> int:
+        """Fold one completed (or shed/failed) query into the table.
+        Returns how many LRU entries this call evicted (the caller
+        feeds the ``filodb_insights_dropped_total`` counter)."""
+        if not self.enabled or not fingerprint:
+            return 0
+        lat_ms = latency_s * 1000.0
+        bucket = bisect.bisect_left(LATENCY_BUCKETS_MS, lat_ms)
+        lat_us = int(round(latency_s * 1e6))
+        dev_us = int(round(device_s * 1e6))
+        evicted = 0
+        with self._lock:
+            e = self._fps.get(fingerprint)
+            if e is None:
+                e = self._fps[fingerprint] = _new_entry(query, dataset,
+                                                       batch_key)
+                while len(self._fps) > self.max_entries:
+                    self._fps.popitem(last=False)
+                    self._dropped += 1
+                    evicted += 1
+            else:
+                self._fps.move_to_end(fingerprint)
+                # witness fields fold by max() — the SAME algebra
+                # merge_snapshots uses, so one ledger accumulating the
+                # whole stream equals any partitioned merge exactly
+                for k, v in (("query", query), ("dataset", dataset),
+                             ("batch_key", batch_key)):
+                    if v > e[k]:
+                        e[k] = v
+            e["count"] += 1
+            e["lat_buckets"][bucket] += 1
+            e["latency_us"] += lat_us
+            e["samples"] += int(samples)
+            if error:
+                e["errors"] += 1
+            if resultcache:
+                e[f"rc_{resultcache}"] = e.get(f"rc_{resultcache}", 0) + 1
+            e["device_programs"] += int(device_programs)
+            e["device_us"] += dev_us
+            e["hbm_bytes"] += int(hbm_bytes)
+            if shed_reason:
+                e["sheds"][shed_reason] = \
+                    e["sheds"].get(shed_reason, 0) + 1
+            if tenant:
+                e["tenants"][tenant] = e["tenants"].get(tenant, 0) + 1
+            t = self._tenants.get(tenant or "")
+            if t is None:
+                t = self._tenants[tenant or ""] = {
+                    "count": 0, "errors": 0, "latency_us": 0, "samples": 0}
+            t["count"] += 1
+            t["latency_us"] += lat_us
+            t["samples"] += int(samples)
+            if error:
+                t["errors"] += 1
+        return evicted
+
+    # --------------------------------------------------------------- reads
+
+    def snapshot(self) -> dict:
+        """The mergeable per-node snapshot: integers + fixed bounds
+        only, no wall-clock-derived values (repeated snapshots of a
+        quiesced ledger are bit-identical, which the fleet-merge
+        exactness test depends on)."""
+        with self._lock:
+            return {
+                "node": self.node,
+                "bounds_ms": list(LATENCY_BUCKETS_MS),
+                "started_at_ms": self.started_at_ms,
+                "dropped": self._dropped,
+                "fingerprints": {
+                    k: {**v, "lat_buckets": list(v["lat_buckets"]),
+                        "sheds": dict(v["sheds"]),
+                        "tenants": dict(v["tenants"])}
+                    for k, v in self._fps.items()},
+                "batch": {k: dict(v) for k, v in self._batch.items()},
+                "tenants": {k: dict(v) for k, v in self._tenants.items()},
+            }
+
+    def fingerprints(self) -> int:
+        with self._lock:
+            return len(self._fps)
+
+
+# ---------------------------------------------------------------------------
+# merge algebra
+# ---------------------------------------------------------------------------
+
+
+def _merge_entry(a: dict, b: dict) -> dict:
+    out = dict(a)
+    # string witnesses merge by max(): deterministic, commutative, and
+    # associative even if two nodes saw different example renderings
+    for k in ("query", "dataset", "batch_key"):
+        out[k] = max(a.get(k, ""), b.get(k, ""))
+    for k in ("count", "errors", "latency_us", "samples", "rc_hit",
+              "rc_partial", "rc_miss", "device_programs", "device_us",
+              "hbm_bytes"):
+        out[k] = a.get(k, 0) + b.get(k, 0)
+    out["lat_buckets"] = [x + y for x, y in zip(a["lat_buckets"],
+                                                b["lat_buckets"])]
+    out["sheds"] = dict(a.get("sheds", {}))
+    for k, v in b.get("sheds", {}).items():
+        out["sheds"][k] = out["sheds"].get(k, 0) + v
+    out["tenants"] = dict(a.get("tenants", {}))
+    for k, v in b.get("tenants", {}).items():
+        out["tenants"][k] = out["tenants"].get(k, 0) + v
+    return out
+
+
+def merge_snapshots(snaps: list[dict]) -> dict:
+    """Exact merge of per-node ledger snapshots into one fleet view.
+    Commutative + associative + partition-invariant; bucket bounds must
+    match (they are a module constant, so a mismatch means mixed
+    software versions — refused rather than silently mis-merged)."""
+    snaps = [s for s in snaps if s]
+    if not snaps:
+        return {"nodes": [], "bounds_ms": list(LATENCY_BUCKETS_MS),
+                "started_at_ms": 0, "dropped": 0, "fingerprints": {},
+                "batch": {}, "tenants": {}}
+    bounds = snaps[0].get("bounds_ms", list(LATENCY_BUCKETS_MS))
+    for s in snaps[1:]:
+        if s.get("bounds_ms", bounds) != bounds:
+            raise ValueError("cannot merge snapshots with different "
+                             "latency bucket bounds (mixed versions?)")
+    nodes: list[str] = []
+    fps: dict[str, dict] = {}
+    batch: dict[str, dict] = {}
+    tenants: dict[str, dict] = {}
+    dropped = 0
+    started = []
+    for s in snaps:
+        nodes.extend(s.get("nodes") or
+                     ([s["node"]] if s.get("node") else []))
+        dropped += int(s.get("dropped", 0))
+        if s.get("started_at_ms"):
+            started.append(int(s["started_at_ms"]))
+        for k, v in s.get("fingerprints", {}).items():
+            fps[k] = _merge_entry(fps[k], v) if k in fps else \
+                {**v, "lat_buckets": list(v["lat_buckets"]),
+                 "sheds": dict(v.get("sheds", {})),
+                 "tenants": dict(v.get("tenants", {}))}
+        for k, v in s.get("batch", {}).items():
+            row = batch.get(k)
+            if row is None:
+                batch[k] = dict(v)
+            else:
+                row["arrivals"] += v.get("arrivals", 0)
+                row["co_arrived"] += v.get("co_arrived", 0)
+                row["peak"] = max(row["peak"], v.get("peak", 1))
+        for k, v in s.get("tenants", {}).items():
+            row = tenants.get(k)
+            if row is None:
+                tenants[k] = dict(v)
+            else:
+                for f in ("count", "errors", "latency_us", "samples"):
+                    row[f] += v.get(f, 0)
+    return {"nodes": sorted(set(nodes)), "bounds_ms": list(bounds),
+            "started_at_ms": min(started) if started else 0,
+            "dropped": dropped,
+            "fingerprints": {k: fps[k] for k in sorted(fps)},
+            "batch": {k: batch[k] for k in sorted(batch)},
+            "tenants": {k: tenants[k] for k in sorted(tenants)}}
+
+
+# ---------------------------------------------------------------------------
+# derived views (/admin/insights, /admin/fleet, cli insights)
+# ---------------------------------------------------------------------------
+
+
+def _quantile_ms(entry: dict, q: float) -> float:
+    """Bucket-interpolated latency quantile (ms) from the fixed-bound
+    histogram — the usual Prometheus histogram_quantile estimate."""
+    total = entry["count"]
+    if total <= 0:
+        return 0.0
+    target = q * total
+    cum = 0
+    lo = 0.0
+    for i, hi in enumerate(LATENCY_BUCKETS_MS):
+        n = entry["lat_buckets"][i]
+        if cum + n >= target and n > 0:
+            return lo + (hi - lo) * (target - cum) / n
+        cum += n
+        lo = float(hi)
+    return float(LATENCY_BUCKETS_MS[-1])
+
+
+def _cost(entry: dict) -> int:
+    """One scalar "cost" rank: scan volume + device time + HBM traffic
+    (unit-less; only used to order the top-k view)."""
+    return (entry["samples"] + entry["device_us"]
+            + entry["hbm_bytes"] // 1024)
+
+
+def view(snapshot: dict, top: int = 20, sort: str = "cost") -> dict:
+    """The human-facing rollup of a (per-node or merged) snapshot:
+    top-k fingerprints by cost/latency/qps, the per-tenant rollup, and
+    the batching-headroom table."""
+    fps = snapshot.get("fingerprints", {})
+    window_s = 0.0
+    if snapshot.get("started_at_ms"):
+        window_s = max(time.time() - snapshot["started_at_ms"] / 1000.0,
+                       1e-3)
+    keyfns = {
+        "cost": _cost,
+        "latency": lambda e: e["latency_us"],
+        "count": lambda e: e["count"],
+        "qps": lambda e: e["count"],
+        "errors": lambda e: e["errors"],
+    }
+    keyfn = keyfns.get(sort, _cost)
+    rows = []
+    for fp, e in sorted(fps.items(), key=lambda kv: (-keyfn(kv[1]),
+                                                     kv[0]))[:top]:
+        rows.append({
+            "fingerprint": fp, "query": e["query"],
+            "dataset": e["dataset"], "batch_key": e["batch_key"],
+            "count": e["count"], "errors": e["errors"],
+            "qps": round(e["count"] / window_s, 4) if window_s else 0.0,
+            "avg_ms": round(e["latency_us"] / 1000.0 / e["count"], 3)
+            if e["count"] else 0.0,
+            "p50_ms": round(_quantile_ms(e, 0.50), 3),
+            "p95_ms": round(_quantile_ms(e, 0.95), 3),
+            "p99_ms": round(_quantile_ms(e, 0.99), 3),
+            "samples": e["samples"],
+            "resultcache": {"hit": e["rc_hit"], "partial": e["rc_partial"],
+                            "miss": e["rc_miss"]},
+            "device_programs": e["device_programs"],
+            "device_ms": round(e["device_us"] / 1000.0, 3),
+            "hbm_bytes": e["hbm_bytes"], "sheds": dict(e["sheds"]),
+            "tenants": dict(e["tenants"])})
+    batch_rows = []
+    for k, v in sorted(snapshot.get("batch", {}).items(),
+                       key=lambda kv: (-kv[1]["peak"], kv[0]))[:top]:
+        batch_rows.append({"batch_key": k, **v})
+    headroom = max((v["peak"] for v in
+                    snapshot.get("batch", {}).values()), default=0)
+    return {"nodes": snapshot.get("nodes") or
+            ([snapshot["node"]] if snapshot.get("node") else []),
+            "window_s": round(window_s, 3),
+            "fingerprints": len(fps),
+            "dropped": snapshot.get("dropped", 0),
+            "sort": sort if sort in keyfns else "cost",
+            "top": rows,
+            "tenants": snapshot.get("tenants", {}),
+            "batching": {"headroom": headroom, "keys": batch_rows}}

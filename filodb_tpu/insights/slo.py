@@ -1,0 +1,252 @@
+"""Tenant SLO burn-rate tracker (ISSUE 19 pillar 2).
+
+Declarative objectives — per tenant / priority class, a latency
+threshold plus an availability target — tracked as multi-window
+multi-burn-rate counters (the SRE-workbook shape: a fast window that
+pages on budget-torching incidents, a slow window that warns on
+sustained leaks).
+
+Exported as ``filodb_slo_*`` families.  The burn rates are LEVEL
+gauges on purpose (the ``filodb_ingest_stalled`` lesson: a counter's
+label set is born at 1, so a rules-engine ``increase()`` never sees
+the 0->1 edge); the self-monitoring rule pack's SLO extension
+(rules/selfmon.slo_pack) alerts on ``filodb_slo_fast_burn`` /
+``filodb_slo_slow_burn`` through the normal inactive -> pending ->
+firing machine.
+
+Snapshots are mergeable like the workload ledger's: integer totals per
+objective (thresholds echoed as ints — ms and ppm — so config echoes
+compare exactly across nodes).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+
+from filodb_tpu.utils.observability import slo_metrics
+
+
+@dataclasses.dataclass(frozen=True)
+class SloObjective:
+    """One declarative objective.  ``tenant``/``priority`` are exact
+    matches with ``*`` as the wildcard; ``target`` is the availability
+    fraction (0.999 = 0.1% error budget); a request is GOOD when it
+    neither errored nor exceeded ``latency_threshold_s``."""
+
+    name: str
+    tenant: str = "*"
+    priority: str = "*"
+    latency_threshold_s: float = 1.0
+    target: float = 0.999
+
+    @staticmethod
+    def from_config(conf: dict, index: int = 0) -> "SloObjective":
+        return SloObjective(
+            name=str(conf.get("name", f"slo-{index}")),
+            tenant=str(conf.get("tenant", "*")),
+            priority=str(conf.get("priority", "*")),
+            latency_threshold_s=float(
+                conf.get("latency-threshold-s", 1.0)),
+            target=float(conf.get("availability-target", 0.999)))
+
+    def matches(self, tenant: str, priority: str) -> bool:
+        return (self.tenant in ("*", tenant)
+                and self.priority in ("*", priority))
+
+    def budget(self) -> float:
+        """Error budget = 1 - target, floored so target=1.0 does not
+        divide by zero (burn saturates instead)."""
+        return max(1.0 - self.target, 1e-9)
+
+
+class _Window:
+    """One objective's per-second ring of (total, bad) counts; burn
+    rates read the last N seconds.  Bounded by the slow window size."""
+
+    def __init__(self, max_age_s: float):
+        self.max_age_s = float(max_age_s)
+        self._ring: collections.deque = collections.deque()
+
+    def observe(self, bad: bool, now_s: float) -> None:
+        sec = int(now_s)
+        if self._ring and self._ring[-1][0] == sec:
+            t, tot, b = self._ring[-1]
+            self._ring[-1] = (t, tot + 1, b + (1 if bad else 0))
+        else:
+            self._ring.append((sec, 1, 1 if bad else 0))
+        horizon = now_s - self.max_age_s
+        while self._ring and self._ring[0][0] < horizon:
+            self._ring.popleft()
+
+    def counts(self, window_s: float, now_s: float) -> tuple[int, int]:
+        horizon = now_s - window_s
+        tot = bad = 0
+        for sec, t, b in self._ring:
+            if sec >= horizon:
+                tot += t
+                bad += b
+        return tot, bad
+
+
+class SloTracker:
+    """Per-node tracker: observe every query outcome, export level
+    burn-rate gauges, answer mergeable snapshots."""
+
+    def __init__(self, objectives: list[SloObjective], node: str = "",
+                 fast_window_s: float = 300.0,
+                 slow_window_s: float = 3600.0):
+        self.node = node
+        self.objectives = list(objectives)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        # totals + rings live under _lock; gauge set_fn callbacks
+        # re-take it briefly at scrape time (never under a metric lock)
+        self._lock = threading.Lock()
+        self._state: dict[str, dict] = {  # guarded-by: _lock
+            o.name: {"total": 0, "bad": 0,
+                     "window": _Window(max(slow_window_s, fast_window_s))}
+            for o in self.objectives}
+        self._m = slo_metrics()
+        for o in self.objectives:
+            labels = {"objective": o.name, "tenant": o.tenant,
+                      "node": self.node}
+            # LEVEL gauges registered up front: the row exists at 0
+            # before the first breach, so the rules engine sees the
+            # full 0 -> burning edge (counters-born-at-1 lesson)
+            self._m["fast_burn"].set_fn(
+                (lambda _o=o: self.burn(_o.name, self.fast_window_s)),
+                **labels)
+            self._m["slow_burn"].set_fn(
+                (lambda _o=o: self.burn(_o.name, self.slow_window_s)),
+                **labels)
+            self._m["budget"].set(o.budget(), **labels)
+
+    # -------------------------------------------------------------- writes
+
+    def observe(self, tenant: str, priority: str, latency_s: float,
+                error: bool = False) -> None:
+        now_s = time.time()
+        for o in self.objectives:
+            if not o.matches(tenant, priority):
+                continue
+            bad = error or latency_s > o.latency_threshold_s
+            labels = {"objective": o.name, "tenant": o.tenant,
+                      "node": self.node}
+            with self._lock:
+                st = self._state[o.name]
+                st["total"] += 1
+                if bad:
+                    st["bad"] += 1
+                st["window"].observe(bad, now_s)
+            self._m["requests"].inc(**labels)
+            if bad:
+                self._m["breaches"].inc(**labels)
+
+    # --------------------------------------------------------------- reads
+
+    def burn(self, objective: str, window_s: float) -> float:
+        """Burn rate over the window: (bad fraction) / (error budget).
+        1.0 = exactly consuming budget at the sustainable rate; the
+        fast-burn page threshold is conventionally 14.4 (2% of a 30-day
+        budget in one hour)."""
+        obj = next((o for o in self.objectives if o.name == objective),
+                   None)
+        if obj is None:
+            return 0.0
+        now_s = time.time()
+        with self._lock:
+            tot, bad = self._state[objective]["window"].counts(window_s,
+                                                               now_s)
+        if tot == 0:
+            return 0.0
+        return (bad / tot) / obj.budget()
+
+    def snapshot(self) -> dict:
+        """Mergeable per-node snapshot: integer totals per objective +
+        the objective config echoed as ints (ms / ppm) so identical
+        configs compare exactly across nodes."""
+        now_s = time.time()
+        out: dict = {"node": self.node,
+                     "fast_window_s": self.fast_window_s,
+                     "slow_window_s": self.slow_window_s,
+                     "objectives": {}}
+        with self._lock:
+            for o in self.objectives:
+                st = self._state[o.name]
+                ftot, fbad = st["window"].counts(self.fast_window_s,
+                                                 now_s)
+                stot, sbad = st["window"].counts(self.slow_window_s,
+                                                 now_s)
+                out["objectives"][o.name] = {
+                    "tenant": o.tenant, "priority": o.priority,
+                    "latency_threshold_ms":
+                        int(round(o.latency_threshold_s * 1000)),
+                    "target_ppm": int(round(o.target * 1_000_000)),
+                    "total": st["total"], "bad": st["bad"],
+                    "fast": {"total": ftot, "bad": fbad},
+                    "slow": {"total": stot, "bad": sbad}}
+        return out
+
+    def rows(self) -> list[dict]:
+        """The human-facing per-objective rollup for /admin/insights."""
+        snap = self.snapshot()
+        rows = []
+        for name, st in sorted(snap["objectives"].items()):
+            rows.append({
+                "objective": name, "tenant": st["tenant"],
+                "priority": st["priority"],
+                "latency_threshold_ms": st["latency_threshold_ms"],
+                "target": st["target_ppm"] / 1e6,
+                "total": st["total"], "bad": st["bad"],
+                "fast_burn": round(self.burn(name, self.fast_window_s),
+                                   4),
+                "slow_burn": round(self.burn(name, self.slow_window_s),
+                                   4)})
+        return rows
+
+    def close(self) -> None:
+        """Drop this node's exported gauge rows (the Gauge.remove
+        contract): a dead node's burn rates must not feed the
+        self-monitoring alerts forever."""
+        for o in self.objectives:
+            labels = {"objective": o.name, "tenant": o.tenant,
+                      "node": self.node}
+            self._m["fast_burn"].remove(**labels)
+            self._m["slow_burn"].remove(**labels)
+            self._m["budget"].remove(**labels)
+
+
+def merge_slo(snaps: list[dict]) -> dict:
+    """Exact merge of per-node SLO snapshots: integer totals sum;
+    objective configs must agree (they come from one cluster config —
+    a mismatch is surfaced, not averaged away)."""
+    snaps = [s for s in snaps if s]
+    if not snaps:
+        return {"nodes": [], "objectives": {}}
+    out: dict = {"nodes": [], "objectives": {}}
+    for s in snaps:
+        out["nodes"].extend(s.get("nodes") or
+                            ([s["node"]] if s.get("node") else []))
+        for name, st in s.get("objectives", {}).items():
+            cur = out["objectives"].get(name)
+            if cur is None:
+                out["objectives"][name] = {
+                    **st, "fast": dict(st["fast"]),
+                    "slow": dict(st["slow"])}
+                continue
+            for k in ("tenant", "priority", "latency_threshold_ms",
+                      "target_ppm"):
+                if cur[k] != st[k]:
+                    cur[f"{k}_mismatch"] = True
+            cur["total"] += st["total"]
+            cur["bad"] += st["bad"]
+            for w in ("fast", "slow"):
+                cur[w]["total"] += st[w]["total"]
+                cur[w]["bad"] += st[w]["bad"]
+    out["nodes"] = sorted(set(out["nodes"]))
+    out["objectives"] = {k: out["objectives"][k]
+                         for k in sorted(out["objectives"])}
+    return out

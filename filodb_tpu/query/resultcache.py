@@ -505,6 +505,13 @@ class ResultCachingPlanner(QueryPlanner):
         qctx = qctx or QueryContext()
         cache = self.cache
         if not cache.enabled or not isinstance(plan, lp.PeriodicSeriesPlan):
+            # bypass observability (ISSUE 19): only cache-SHAPED plans
+            # count as "disabled" bypasses — metadata/raw plans never
+            # were cache traffic and would drown the signal
+            if not cache.enabled \
+                    and isinstance(plan, lp.PeriodicSeriesPlan):
+                _m()["bypass"].inc(dataset=self.dataset,
+                                   reason="disabled")
             return self.inner.materialize(plan, qctx)
         try:
             start, step, end = lp.time_range(plan)
@@ -513,9 +520,14 @@ class ResultCachingPlanner(QueryPlanner):
         fp = plan_fingerprint(plan, step, start)
         if fp is None:
             cache.note_skip("shape")
+            _m()["bypass"].inc(dataset=self.dataset,
+                               reason="unfingerprintable")
             return self.inner.materialize(plan, qctx)
         if not self._plan_local(plan, qctx):
+            # remote-shard plans bypass the cache silently (the known
+            # federation coherence gap) — now measurable (ISSUE 19)
             cache.note_skip("remote")
+            _m()["bypass"].inc(dataset=self.dataset, reason="remote")
             return self.inner.materialize(plan, qctx)
         if not cache.admit(fp):
             cache.note_skip("first-sight")

@@ -148,3 +148,46 @@ def selfmon_pack(interval: str = "15s", for_: str = "30s",
                                 "partial; see /admin/integrity"}},
         ],
     }]}
+
+
+SLO_GROUP_NAME = "filodb-slo-burn"
+
+
+def slo_pack(interval: str = "15s", for_: str = "30s",
+             dataset: str = "_system", fast_burn: float = 14.4,
+             slow_burn: float = 6.0) -> dict:
+    """Tenant SLO burn-rate alerts (ISSUE 19) over the ``filodb_slo_*``
+    families the SLO tracker exports — the standard multi-window
+    multi-burn-rate policy: the FAST window pages (budget gone in
+    hours), the SLOW window warns (budget gone in days).  Both exprs
+    read LEVEL gauges the tracker registers up-front (the
+    filodb_ingest_stalled lesson: rules must see the 0 -> burning
+    edge, which a counter label set born at 1 never shows)."""
+    return {"groups": [{
+        "name": SLO_GROUP_NAME,
+        "interval": interval,
+        "dataset": dataset,
+        "rules": [
+            {"alert": "FiloTenantSLOFastBurn",
+             "expr": f"filodb_slo_fast_burn > {fast_burn}",
+             "for": for_,
+             "labels": {"severity": "page", "source": "selfmon"},
+             "annotations": {
+                 "summary": "SLO {{ $labels.objective }} fast-burning "
+                            "for tenant {{ $labels.tenant }}",
+                 "description": "error budget burning at {{ $value }}x "
+                                "over the fast window — at this rate "
+                                "the whole budget is gone within "
+                                "hours; see /admin/insights"}},
+            {"alert": "FiloTenantSLOSlowBurn",
+             "expr": f"filodb_slo_slow_burn > {slow_burn}",
+             "for": for_,
+             "labels": {"severity": "warn", "source": "selfmon"},
+             "annotations": {
+                 "summary": "SLO {{ $labels.objective }} slow-burning "
+                            "for tenant {{ $labels.tenant }}",
+                 "description": "sustained burn at {{ $value }}x over "
+                                "the slow window eats the budget in "
+                                "days; see /admin/insights"}},
+        ],
+    }]}

@@ -183,6 +183,11 @@ class FiloServer:
         self.watermarks = None
         self.watermark_sampler = None
         self.selfscraper = None
+        # fleet workload insights (ISSUE 19): per-fingerprint ledger +
+        # tenant SLO tracker + fleet aggregator behind /admin/insights
+        # and /admin/fleet; wired in _setup_insights()
+        self.slo_tracker = None
+        self.insights_fleet = None
         # rule engine (ISSUE 9): continuous recording/alerting rules
         # evaluated through the normal query path (doc/rules.md)
         self.rule_engine = None
@@ -416,6 +421,7 @@ class FiloServer:
             interval_s=float(dp.get("watermark-sample-interval-s", 10.0)))
         self.watermark_sampler.start()
 
+        self._setup_insights()
         self._setup_rules(ss)
         if self.rollup_engine is not None:
             self.rollup_engine.start()
@@ -463,6 +469,10 @@ class FiloServer:
                 local_watermarks=local_watermarks,
                 tier_watermarks=self.tier_watermarks)
             self.status_poller.start()
+        if self.insights_fleet is not None:
+            # AFTER http.start(): peers answer /admin/insights only
+            # once their server is up, and start() no-ops peerless
+            self.insights_fleet.start()
         if self.config.get("profiler"):
             self.profiler = SimpleProfiler()
             self.profiler.start()
@@ -491,6 +501,51 @@ class FiloServer:
                     log.exception("cold-tier age-out pass failed for %s "
                                   "(will retry next tick)", ds)
 
+    def _setup_insights(self) -> None:
+        """Fleet workload insights (ISSUE 19, doc/observability.md):
+        the per-fingerprint workload ledger, the declarative tenant SLO
+        tracker, and the fleet aggregator that merges peers' raw
+        snapshots into /admin/fleet.  Always on (the ledger is a few
+        hundred KB of ints); ``insights.enabled: false`` or the runtime
+        knob turns the per-query accounting off."""
+        conf = self.config.get("insights") or {}
+        from filodb_tpu.insights.ledger import WorkloadLedger
+        from filodb_tpu.utils.observability import insights_metrics
+        ledger = WorkloadLedger(
+            node=self.node,
+            max_entries=int(conf.get("max-entries", 512)),
+            co_window_ms=float(conf.get("co-arrival-window-ms", 250.0)),
+            enabled=bool(conf.get("enabled", True)))
+        self.http.insights = ledger
+        # resident-fingerprint gauge as a set_fn: the row exists (at 0)
+        # from startup, so dashboards and rules see the ramp, not a
+        # label set born mid-incident
+        insights_metrics()["fingerprints"].set_fn(ledger.fingerprints,
+                                                  node=self.node)
+        slo_conf = conf.get("slo") or {}
+        objectives = []
+        from filodb_tpu.insights.slo import SloObjective, SloTracker
+        for i, obj in enumerate(slo_conf.get("objectives") or []):
+            objectives.append(SloObjective.from_config(obj, i))
+        if objectives:
+            self.slo_tracker = SloTracker(
+                objectives, node=self.node,
+                fast_window_s=float(slo_conf.get("fast-window-s", 300.0)),
+                slow_window_s=float(slo_conf.get("slow-window-s",
+                                                 3600.0)))
+            self.http.slo = self.slo_tracker
+        from filodb_tpu.insights.fleet import FleetAggregator
+        # fleet-poll-interval-s <= 0 (the default) = on-demand: no
+        # background peer chatter; each /admin/fleet read polls.  Set
+        # it > 0 to keep the console cache warm between reads.
+        self.insights_fleet = FleetAggregator(
+            self.node, self.config.get("peers", {}),
+            self.http._insights_raw,
+            interval_s=float(conf.get("fleet-poll-interval-s", 0.0)),
+            timeout_s=float(conf.get("fleet-poll-timeout-s", 2.0)),
+            stale_after_s=float(conf.get("fleet-stale-after-s", 60.0)))
+        self.http.fleet = self.insights_fleet
+
     def _setup_rules(self, selfscrape_conf: dict) -> None:
         """Rule engine (ISSUE 9, doc/rules.md): inline groups + rule
         files + the shipped self-monitoring pack (on whenever
@@ -516,6 +571,20 @@ class FiloServer:
                     dataset=selfscrape_conf.get("dataset", "_system"),
                     window=str(sm.get("window", "2m"))),
                 source="builtin:self-monitoring"))
+        # tenant SLO burn alerts (ISSUE 19): shipped whenever SLO
+        # objectives are configured AND self-scrape feeds filodb_slo_*
+        # into a queryable dataset (the burn gauges ride the same
+        # exposition the selfmon pack evaluates against)
+        slo_rules = rules_conf.get("slo-burn") or {}
+        if selfscrape_conf.get("enabled") and self.http.slo is not None \
+                and slo_rules.get("enabled", True):
+            from filodb_tpu.rules.selfmon import slo_pack
+            groups.extend(load_rule_config(
+                slo_pack(
+                    interval=str(slo_rules.get("interval", "15s")),
+                    for_=str(slo_rules.get("for", "30s")),
+                    dataset=selfscrape_conf.get("dataset", "_system")),
+                source="builtin:slo-burn"))
         if not groups:
             return
         nconf = rules_conf.get("notifier") or {}
@@ -960,6 +1029,8 @@ class FiloServer:
             self.rollup_engine.stop()
         if self.watermark_sampler is not None:
             self.watermark_sampler.stop()
+        if self.insights_fleet is not None:
+            self.insights_fleet.stop()
         if self.selfscraper is not None:
             self.selfscraper.stop()
         if self.status_poller is not None:
@@ -977,6 +1048,13 @@ class FiloServer:
             # request would otherwise re-watch the emptied ledger and
             # resurrect the just-removed rows permanently
             self.watermarks.close()
+        # same discipline for the insights/SLO gauge rows: AFTER
+        # http.shutdown(), so no late query can re-register them
+        if self.slo_tracker is not None:
+            self.slo_tracker.close()
+        if self.http.insights is not None:
+            from filodb_tpu.utils.observability import insights_metrics
+            insights_metrics()["fingerprints"].remove(node=self.node)
         for qs in self.query_schedulers.values():
             qs.shutdown()
         for ac in self.admission_controllers.values():

@@ -12,6 +12,7 @@ span records; trees are assembled at read time (/admin endpoints)."""
 from __future__ import annotations
 
 import collections
+import random
 import threading
 import time
 from typing import Optional
@@ -49,10 +50,17 @@ class TraceStore:
 
     def __init__(self, max_traces: int = 256, max_spans_per_trace: int = 512,
                  slowlog_size: int = 128,
-                 slow_threshold_s: float = 1.0):
+                 slow_threshold_s: float = 1.0,
+                 sample_rate: float = 0.0):
         self.max_traces = max_traces
         self.max_spans_per_trace = max_spans_per_trace
         self.slow_threshold_s = slow_threshold_s
+        # head-sampling (ISSUE 19): retain this fraction of NORMAL
+        # (sub-threshold) traces too, flagged sampled=true, so the
+        # retained set is fleet-representative instead of slow-only.
+        # Default 0 (off); runtime-adjustable via POST /admin/config
+        # trace-sample-rate.
+        self.sample_rate = float(sample_rate)
         self._traces: collections.OrderedDict[str, list[SpanRecord]] = \
             collections.OrderedDict()
         self._slowlog: collections.deque = collections.deque(
@@ -106,16 +114,26 @@ class TraceStore:
                       query: str = "", dataset: str = "",
                       error: Optional[str] = None) -> None:
         """Called once per finished query at the entry point; slow ones
-        keep their whole span tree in the slow-query ring."""
-        if not trace_id or duration_s < self.slow_threshold_s:
+        keep their whole span tree in the slow-query ring.  Fast ones
+        are head-sampled at ``sample_rate`` (flagged sampled=true) so a
+        low always-on fraction of NORMAL traces is retained too."""
+        if not trace_id:
             return
-        try:
-            query_metrics()["slow_queries"].inc(dataset=dataset)
-        except Exception:  # noqa: BLE001 — forensics never fails a query
-            pass
+        sampled = False
+        if duration_s < self.slow_threshold_s:
+            rate = self.sample_rate
+            if rate <= 0.0 or random.random() >= rate:
+                return
+            sampled = True
+        else:
+            try:
+                query_metrics()["slow_queries"].inc(dataset=dataset)
+            except Exception:  # noqa: BLE001 — forensics never fails a query
+                pass
         entry = {"trace_id": trace_id, "query": query, "dataset": dataset,
                  "duration_s": duration_s, "when_s": time.time(),
-                 "error": error, "tree": self.tree(trace_id)}
+                 "error": error, "sampled": sampled,
+                 "tree": self.tree(trace_id)}
         try:
             # a slow query DURING a recompile storm is usually slow
             # BECAUSE of it: flag the programs so the operator reading

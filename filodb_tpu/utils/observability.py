@@ -653,6 +653,13 @@ def resultcache_metrics() -> dict:
             "entries discarded / window states reset because their "
             "validity inputs changed, per dataset and reason "
             "(chunks|quarantine|routing|series|regressed)"),
+        "bypass": REGISTRY.counter(
+            "filodb_result_cache_bypass_total",
+            "range/instant queries that bypassed the result cache "
+            "entirely, per dataset and reason (remote = plan spans "
+            "non-local shards, the known federation coherence gap; "
+            "disabled = cache switched off; unfingerprintable = shape "
+            "has no canonical fingerprint)"),
     }
 
 
@@ -724,6 +731,63 @@ def downsample_metrics() -> dict:
         "points_out": REGISTRY.counter(
             "filodb_downsample_points_out_total",
             "pixel-exact samples kept (<= 4 per pixel bin per series)"),
+    }
+
+
+def insights_metrics() -> dict:
+    """Canonical workload-insights metrics (ISSUE 19,
+    filodb_tpu/insights): ledger volume + the fleet aggregator's poll
+    health — one place defines the names so the ledger,
+    /admin/insights, /admin/fleet, and doc/observability.md can never
+    drift."""
+    return {
+        "noted": REGISTRY.counter(
+            "filodb_insights_queries_total",
+            "query completions folded into the workload ledger, per "
+            "dataset and outcome (ok | error | shed)"),
+        "fingerprints": REGISTRY.gauge(
+            "filodb_insights_fingerprints",
+            "distinct plan fingerprints resident in the ledger, per "
+            "node (bounded; evictions show in *_dropped_total)"),
+        "dropped": REGISTRY.counter(
+            "filodb_insights_dropped_total",
+            "least-recently-updated fingerprint entries evicted to "
+            "stay under the ledger bound, per node"),
+        "fleet_polls": REGISTRY.counter(
+            "filodb_insights_fleet_polls_total",
+            "fleet-aggregator snapshot fetches, per peer and outcome "
+            "(ok | error)"),
+    }
+
+
+def slo_metrics() -> dict:
+    """Canonical tenant-SLO metrics (ISSUE 19, insights/slo.py).  The
+    burn rates are LEVEL gauges on purpose — the filodb_ingest_stalled
+    lesson: a counter's label set is born at 1, invisible to a rules
+    ``increase()``, while a pre-registered gauge row shows the full
+    0 -> burning edge to the self-monitoring alert rules."""
+    return {
+        "requests": REGISTRY.counter(
+            "filodb_slo_requests_total",
+            "queries matched against an SLO objective, per "
+            "objective/tenant/node"),
+        "breaches": REGISTRY.counter(
+            "filodb_slo_breaches_total",
+            "matched queries that were BAD (errored or exceeded the "
+            "objective's latency threshold)"),
+        "fast_burn": REGISTRY.gauge(
+            "filodb_slo_fast_burn",
+            "error-budget burn rate over the fast window (bad fraction "
+            "/ budget); the SLO rule pack pages above 14.4"),
+        "slow_burn": REGISTRY.gauge(
+            "filodb_slo_slow_burn",
+            "error-budget burn rate over the slow window; the SLO "
+            "rule pack warns above 6"),
+        "budget": REGISTRY.gauge(
+            "filodb_slo_error_budget",
+            "configured error budget (1 - availability target) per "
+            "objective — a constant level, exported so dashboards can "
+            "plot burn against it"),
     }
 
 

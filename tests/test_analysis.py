@@ -176,6 +176,28 @@ RULE_CASES = [
      "observability.md",
      {"good_kw": {"doc_text": "| `filodb_query_*` | `request_seconds` |"},
       "bad_kw": {"doc_text": "| `filodb_query_*` | `request_seconds` |"}}),
+    ("admin-endpoint-documented",
+     # same dispatch arm both ways; only the doc table differs — the
+     # rule reads the router's parts[i] == "..." compares, never
+     # "/admin/..." string literals (the router has none)
+     "class FiloHttpServer:\n"
+     "    def _route(self, path, params):\n"
+     "        parts = path.split('/')\n"
+     "        if len(parts) == 2 and parts[0] == 'admin' \\\n"
+     "                and parts[1] == 'darkroute':\n"
+     "            return self._dark(params)\n",
+     "class FiloHttpServer:\n"
+     "    def _route(self, path, params):\n"
+     "        parts = path.split('/')\n"
+     "        if len(parts) == 2 and parts[0] == 'admin' \\\n"
+     "                and parts[1] == 'darkroute':\n"
+     "            return self._dark(params)\n",
+     "http_api.md",
+     {"rel": "filodb_tpu/http/server.py",
+      "good_kw": {"api_doc_text":
+                  "| `GET /admin/darkroute` | dark corner |"},
+      "bad_kw": {"api_doc_text":
+                 "| `GET /admin/insights` | documented elsewhere |"}}),
     ("evaluator-workload",
      # a background evaluator minting query identity without a
      # workload class or deadline — invisible ambient-priority load

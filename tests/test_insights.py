@@ -1,0 +1,479 @@
+"""Fleet workload insights (ISSUE 19).
+
+The load-bearing assertions are the generative merge-algebra sweeps:
+``merge_snapshots`` / ``merge_slo`` are commutative, associative, and
+invariant to HOW one query stream was partitioned across nodes — any
+split of the same events merges to the bit-identical fleet view.  Plus
+ledger/SLO unit behavior, ``plan_keys`` fallbacks, the result-cache
+bypass counter per reason label, and trace head-sampling."""
+
+import random
+
+import pytest
+
+from filodb_tpu.insights import ledger as il
+from filodb_tpu.insights.ledger import (LATENCY_BUCKETS_MS, WorkloadLedger,
+                                        merge_snapshots, plan_keys)
+from filodb_tpu.insights.slo import (SloObjective, SloTracker, merge_slo)
+from filodb_tpu.promql.parser import (query_range_to_logical_plan,
+                                      query_to_logical_plan)
+from filodb_tpu.utils.forensics import TraceStore
+from filodb_tpu.utils.observability import resultcache_metrics, slo_metrics
+
+BASE = 1_700_000_000_000
+
+
+# ---------------------------------------------------------------------------
+# workload ledger
+# ---------------------------------------------------------------------------
+
+
+def _mk_ledger(**kw):
+    led = WorkloadLedger(node=kw.pop("node", "n0"), **kw)
+    led.started_at_ms = BASE     # deterministic snapshots across ledgers
+    return led
+
+
+class TestWorkloadLedger:
+    def test_note_accumulates_integer_fields(self):
+        led = _mk_ledger()
+        led.note("fp1", query="up", dataset="prom", tenant="acme",
+                 latency_s=0.012, samples=100, resultcache="hit",
+                 device_programs=2, device_s=0.003, hbm_bytes=4096,
+                 batch_key="bk")
+        led.note("fp1", query="up", dataset="prom", tenant="acme",
+                 latency_s=0.050, error=True, samples=50,
+                 resultcache="miss", batch_key="bk")
+        snap = led.snapshot()
+        e = snap["fingerprints"]["fp1"]
+        assert e["count"] == 2 and e["errors"] == 1
+        assert e["latency_us"] == 12000 + 50000
+        assert e["samples"] == 150
+        assert e["rc_hit"] == 1 and e["rc_miss"] == 1
+        assert e["device_programs"] == 2 and e["device_us"] == 3000
+        assert e["hbm_bytes"] == 4096
+        assert e["tenants"] == {"acme": 2}
+        assert sum(e["lat_buckets"]) == 2
+        assert snap["tenants"]["acme"]["count"] == 2
+        assert snap["tenants"]["acme"]["errors"] == 1
+        # every accumulator is an int — the merge-exactness contract
+        for k, v in e.items():
+            if isinstance(v, (dict, list, str)):
+                continue
+            assert isinstance(v, int), (k, v)
+
+    def test_shed_reasons_fold(self):
+        led = _mk_ledger()
+        led.note("fp", shed_reason="overload")
+        led.note("fp", shed_reason="overload")
+        led.note("fp", shed_reason="deadline_exceeded")
+        e = led.snapshot()["fingerprints"]["fp"]
+        assert e["sheds"] == {"overload": 2, "deadline_exceeded": 1}
+
+    def test_lru_eviction_reports_dropped(self):
+        led = _mk_ledger(max_entries=2)
+        assert led.note("a") == 0
+        assert led.note("b") == 0
+        assert led.note("c") == 1          # evicts "a"
+        snap = led.snapshot()
+        assert set(snap["fingerprints"]) == {"b", "c"}
+        assert snap["dropped"] == 1
+        # touching "b" refreshes recency: "c" is the next victim
+        led.note("b")
+        led.note("d")
+        assert set(led.snapshot()["fingerprints"]) == {"b", "d"}
+
+    def test_disabled_ledger_is_inert(self):
+        led = _mk_ledger(enabled=False)
+        assert led.note("fp") == 0
+        assert led.note_arrival("bk") == 1
+        assert led.snapshot()["fingerprints"] == {}
+
+    def test_co_arrival_window(self):
+        led = _mk_ledger(co_window_ms=10_000)
+        assert led.note_arrival("bk") == 1
+        assert led.note_arrival("bk") == 2
+        assert led.note_arrival("other") == 1
+        row = led.snapshot()["batch"]["bk"]
+        assert row["arrivals"] == 2
+        assert row["co_arrived"] == 1      # only the 2nd saw company
+        assert row["peak"] == 2
+
+    def test_co_arrival_window_expires(self):
+        led = _mk_ledger(co_window_ms=0.0)
+        assert led.note_arrival("bk") == 1
+        assert led.note_arrival("bk") == 1  # horizon == now: alone again
+
+    def test_snapshot_is_deep_copied(self):
+        led = _mk_ledger()
+        led.note("fp", tenant="t")
+        s1 = led.snapshot()
+        s1["fingerprints"]["fp"]["count"] = 999
+        s1["fingerprints"]["fp"]["tenants"]["t"] = 999
+        assert led.snapshot()["fingerprints"]["fp"]["count"] == 1
+        assert led.snapshot()["fingerprints"]["fp"]["tenants"]["t"] == 1
+
+    def test_quiesced_snapshots_bit_identical(self):
+        led = _mk_ledger()
+        for i in range(10):
+            led.note(f"fp{i % 3}", latency_s=0.001 * i, samples=i)
+            led.note_arrival("bk")
+        assert led.snapshot() == led.snapshot()
+
+    def test_quantiles_land_in_bucket(self):
+        led = _mk_ledger()
+        for _ in range(100):
+            led.note("fp", latency_s=0.007)   # 7ms -> (5, 10] bucket
+        e = led.snapshot()["fingerprints"]["fp"]
+        for q in (0.5, 0.95, 0.99):
+            assert 5.0 < il._quantile_ms(e, q) <= 10.0
+
+    def test_view_top_k_and_sort(self):
+        led = _mk_ledger()
+        for _ in range(5):
+            led.note("hot", query="hot_q", samples=10)
+        led.note("cold", query="cold_q", samples=1_000_000)
+        v = il.view(led.snapshot(), top=1, sort="count")
+        assert v["fingerprints"] == 2
+        assert len(v["top"]) == 1
+        assert v["top"][0]["fingerprint"] == "hot"
+        v = il.view(led.snapshot(), top=1, sort="cost")
+        assert v["top"][0]["fingerprint"] == "cold"
+        # unknown sort falls back to cost rather than exploding
+        assert il.view(led.snapshot(), sort="nope")["sort"] == "cost"
+
+    def test_view_batching_headroom(self):
+        led = _mk_ledger(co_window_ms=10_000)
+        for _ in range(3):
+            led.note_arrival("bk")
+        v = il.view(led.snapshot())
+        assert v["batching"]["headroom"] == 3
+        assert v["batching"]["keys"][0]["batch_key"] == "bk"
+
+
+# ---------------------------------------------------------------------------
+# plan_keys
+# ---------------------------------------------------------------------------
+
+
+class TestPlanKeys:
+    def test_range_query_uses_cache_fingerprint(self):
+        plan = query_range_to_logical_plan(
+            "rate(http_requests_total[1m])", BASE, 15_000, BASE + 300_000)
+        fp, bk = plan_keys("prom", plan, "rate(http_requests_total[1m])")
+        assert not fp.startswith("q:")
+        assert bk.startswith("prom|")
+        assert "res=15000" in bk and "steps=21" in bk
+
+    def test_instant_query_keys(self):
+        plan = query_to_logical_plan("up", BASE)
+        fp, bk = plan_keys("prom", plan, "up")
+        assert fp and "steps=1" in bk       # instant = one grid step
+
+    def test_non_periodic_plan_falls_back(self):
+        fp, bk = plan_keys("prom", object(), "whatever")
+        assert fp == "q:object:whatever"
+        assert bk == "prom|object|res=0|steps=0"
+
+    def test_unfingerprintable_shape_falls_back(self):
+        q = "up offset 5m"
+        plan = query_range_to_logical_plan(q, BASE, 15_000, BASE + 60_000)
+        fp, _ = plan_keys("prom", plan, q)
+        assert fp.startswith("q:")
+
+    def test_same_shape_same_batch_key(self):
+        q1 = 'up{job="a"}'
+        q2 = 'up{job="b"}'
+        p1 = query_range_to_logical_plan(q1, BASE, 15_000, BASE + 300_000)
+        p2 = query_range_to_logical_plan(q2, BASE, 15_000, BASE + 300_000)
+        fp1, bk1 = plan_keys("prom", p1, q1)
+        fp2, bk2 = plan_keys("prom", p2, q2)
+        assert fp1 != fp2                   # different queries
+        assert bk1 == bk2                   # but batchable together
+
+
+# ---------------------------------------------------------------------------
+# merge algebra (generative)
+# ---------------------------------------------------------------------------
+
+
+def _random_events(rng, n):
+    tenants = ["", "acme", "globex"]
+    rcs = ["", "hit", "partial", "miss"]
+    sheds = ["", "overload", "deadline_exceeded"]
+    out = []
+    for _ in range(n):
+        out.append(dict(
+            fingerprint=f"fp{rng.randrange(6)}",
+            query=f"q{rng.randrange(6)}", dataset="prom",
+            tenant=rng.choice(tenants),
+            latency_s=rng.random() * 2.0,
+            error=rng.random() < 0.1,
+            samples=rng.randrange(10_000),
+            resultcache=rng.choice(rcs),
+            device_programs=rng.randrange(4),
+            device_s=rng.random() * 0.01,
+            hbm_bytes=rng.randrange(1 << 20),
+            shed_reason=rng.choice(sheds),
+            batch_key=f"bk{rng.randrange(3)}"))
+    return out
+
+
+def _ledger_for(events, node="n"):
+    led = _mk_ledger(node=node)
+    for ev in events:
+        led.note(ev["fingerprint"], **{k: v for k, v in ev.items()
+                                       if k != "fingerprint"})
+    return led
+
+
+def _canon(merged):
+    """Strip the partition-dependent identity fields; everything else
+    must be bit-identical across partitionings."""
+    out = dict(merged)
+    out.pop("nodes", None)
+    out.pop("node", None)
+    out.pop("started_at_ms", None)
+    return out
+
+
+class TestMergeAlgebra:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_partition_invariant(self, seed):
+        rng = random.Random(seed)
+        events = _random_events(rng, 200)
+        whole = _ledger_for(events, node="solo").snapshot()
+        nparts = rng.randrange(2, 5)
+        parts = [[] for _ in range(nparts)]
+        for ev in events:
+            parts[rng.randrange(nparts)].append(ev)
+        snaps = [_ledger_for(p, node=f"n{i}").snapshot()
+                 for i, p in enumerate(parts)]
+        merged = merge_snapshots(snaps)
+        assert _canon(merged) == _canon(merge_snapshots([whole]))
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_commutative(self, seed):
+        rng = random.Random(1000 + seed)
+        snaps = [_ledger_for(_random_events(rng, 60), node=f"n{i}")
+                 .snapshot() for i in range(3)]
+        ref = merge_snapshots(snaps)
+        perm = list(snaps)
+        rng.shuffle(perm)
+        assert merge_snapshots(perm) == ref
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_associative(self, seed):
+        rng = random.Random(2000 + seed)
+        a, b, c = (_ledger_for(_random_events(rng, 60), node=f"n{i}")
+                   .snapshot() for i in range(3))
+        left = merge_snapshots([merge_snapshots([a, b]), c])
+        right = merge_snapshots([a, merge_snapshots([b, c])])
+        assert left == right == merge_snapshots([a, b, c])
+
+    def test_mixed_bucket_bounds_refused(self):
+        a = _mk_ledger(node="a").snapshot()
+        b = _mk_ledger(node="b").snapshot()
+        b["bounds_ms"] = [1, 2, 3]
+        with pytest.raises(ValueError, match="bucket bounds"):
+            merge_snapshots([a, b])
+
+    def test_empty_merge(self):
+        m = merge_snapshots([])
+        assert m["fingerprints"] == {} and m["nodes"] == []
+        assert m["bounds_ms"] == list(LATENCY_BUCKETS_MS)
+
+
+# ---------------------------------------------------------------------------
+# SLO tracker
+# ---------------------------------------------------------------------------
+
+
+def _obj(**kw):
+    kw.setdefault("name", "api")
+    return SloObjective(**kw)
+
+
+class TestSloTracker:
+    def test_objective_matching(self):
+        o = _obj(tenant="acme", priority="*")
+        assert o.matches("acme", "interactive")
+        assert not o.matches("globex", "interactive")
+        assert _obj().matches("anyone", "anything")
+
+    def test_from_config(self):
+        o = SloObjective.from_config(
+            {"name": "gold", "tenant": "acme",
+             "latency-threshold-s": 0.25, "availability-target": 0.99}, 3)
+        assert o.name == "gold" and o.tenant == "acme"
+        assert o.latency_threshold_s == 0.25
+        assert o.budget() == pytest.approx(0.01)
+        assert SloObjective.from_config({}, 7).name == "slo-7"
+
+    def test_budget_floor(self):
+        assert _obj(target=1.0).budget() == pytest.approx(1e-9)
+
+    def test_observe_and_burn(self):
+        t = SloTracker([_obj(latency_threshold_s=0.1, target=0.9)],
+                       node="n0", fast_window_s=60, slow_window_s=120)
+        try:
+            for _ in range(8):
+                t.observe("acme", "interactive", 0.01)        # good
+            t.observe("acme", "interactive", 0.5)             # slow: bad
+            t.observe("acme", "interactive", 0.01, error=True)  # bad
+            snap = t.snapshot()["objectives"]["api"]
+            assert snap["total"] == 10 and snap["bad"] == 2
+            # burn = (2/10) / 0.1 budget = 2.0, via the exported gauge
+            g = slo_metrics()["fast_burn"].value(
+                objective="api", tenant="*", node="n0")
+            assert g == pytest.approx(2.0)
+            assert t.burn("api", 60) == pytest.approx(2.0)
+            assert t.burn("missing", 60) == 0.0
+        finally:
+            t.close()
+
+    def test_no_traffic_burns_zero(self):
+        t = SloTracker([_obj()], node="n1")
+        try:
+            assert t.burn("api", 300) == 0.0
+        finally:
+            t.close()
+
+    def test_close_removes_gauge_rows(self):
+        t = SloTracker([_obj()], node="n2")
+        t.observe("x", "y", 10.0)
+        assert slo_metrics()["fast_burn"].value(
+            objective="api", tenant="*", node="n2") > 0
+        t.close()
+        assert slo_metrics()["fast_burn"].value(
+            objective="api", tenant="*", node="n2") == 0.0
+
+    def test_merge_slo_sums_and_flags_mismatch(self):
+        a = {"node": "a", "objectives": {"api": {
+            "tenant": "*", "priority": "*", "latency_threshold_ms": 100,
+            "target_ppm": 999000, "total": 10, "bad": 2,
+            "fast": {"total": 4, "bad": 1},
+            "slow": {"total": 10, "bad": 2}}}}
+        b = {"node": "b", "objectives": {"api": {
+            "tenant": "*", "priority": "*", "latency_threshold_ms": 100,
+            "target_ppm": 999000, "total": 5, "bad": 1,
+            "fast": {"total": 2, "bad": 0},
+            "slow": {"total": 5, "bad": 1}}}}
+        m = merge_slo([a, b])
+        o = m["objectives"]["api"]
+        assert m["nodes"] == ["a", "b"]
+        assert o["total"] == 15 and o["bad"] == 3
+        assert o["fast"] == {"total": 6, "bad": 1}
+        assert "latency_threshold_ms_mismatch" not in o
+        # re-mergeable (associativity) + config drift surfaces
+        c = {"node": "c", "objectives": {"api": {
+            **b["objectives"]["api"], "latency_threshold_ms": 250,
+            "fast": dict(b["objectives"]["api"]["fast"]),
+            "slow": dict(b["objectives"]["api"]["slow"])}}}
+        m2 = merge_slo([m, c])
+        assert m2["nodes"] == ["a", "b", "c"]
+        assert m2["objectives"]["api"]["total"] == 20
+        assert m2["objectives"]["api"]["latency_threshold_ms_mismatch"]
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_merge_slo_partition_invariant(self, seed):
+        rng = random.Random(seed)
+        obj = _obj(latency_threshold_s=0.1)
+        events = [(rng.random() * 0.3, rng.random() < 0.05)
+                  for _ in range(100)]
+        trackers = [SloTracker([obj], node=f"n{i}") for i in range(3)]
+        solo = SloTracker([obj], node="solo")
+        try:
+            for lat, err in events:
+                solo.observe("t", "p", lat, error=err)
+                trackers[rng.randrange(3)].observe("t", "p", lat,
+                                                   error=err)
+            merged = merge_slo([t.snapshot() for t in trackers])
+            want = merge_slo([solo.snapshot()])
+            merged.pop("nodes"), want.pop("nodes")
+            assert merged == want
+        finally:
+            solo.close()
+            for t in trackers:
+                t.close()
+
+
+# ---------------------------------------------------------------------------
+# result-cache bypass counter (satellite: one test per reason label)
+# ---------------------------------------------------------------------------
+
+
+def _bypass(reason):
+    return resultcache_metrics()["bypass"].value(dataset="prom",
+                                                 reason=reason)
+
+
+@pytest.fixture
+def rc_harness():
+    from tests.test_resultcache import _Harness
+    h = _Harness()
+    h.ingest("up", [({"job": "a"}, [1.0] * 30)],
+             [BASE + i * 10_000 for i in range(30)])
+    return h
+
+
+class TestBypassCounter:
+    def test_disabled(self, rc_harness):
+        h = rc_harness
+        h.cache.enabled = False
+        before = _bypass("disabled")
+        h.eval_range(h.cached, "up", BASE, 10_000, BASE + 100_000)
+        assert _bypass("disabled") == before + 1
+        # metadata/raw plans are NOT cache traffic: no extra count
+        h.eval_instant(h.cached, "up", BASE + 100_000)
+        assert _bypass("disabled") == before + 2
+
+    def test_unfingerprintable(self, rc_harness):
+        h = rc_harness
+        before = _bypass("unfingerprintable")
+        h.eval_range(h.cached, "up offset 5m",
+                     BASE + 400_000, 10_000, BASE + 500_000)
+        assert _bypass("unfingerprintable") == before + 1
+
+    def test_remote(self, rc_harness):
+        h = rc_harness
+        h.cached.inner.plan_is_local = lambda plan, qctx: False
+        before = _bypass("remote")
+        h.eval_range(h.cached, "up", BASE, 10_000, BASE + 100_000)
+        assert _bypass("remote") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# trace head-sampling (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestTraceHeadSampling:
+    def test_rate_zero_drops_normal_traces(self):
+        ts = TraceStore(slow_threshold_s=1.0, sample_rate=0.0)
+        ts.note_complete("t1", 0.01, query="up", dataset="prom")
+        assert ts.slowlog() == []
+
+    def test_rate_one_retains_flagged(self):
+        ts = TraceStore(slow_threshold_s=1.0, sample_rate=1.0)
+        ts.note_complete("t1", 0.01, query="up", dataset="prom")
+        log = ts.slowlog()
+        assert len(log) == 1
+        assert log[0]["sampled"] is True
+        assert log[0]["trace_id"] == "t1"
+
+    def test_slow_traces_retained_regardless(self):
+        ts = TraceStore(slow_threshold_s=0.001, sample_rate=0.0)
+        ts.note_complete("t2", 5.0, query="up", dataset="prom")
+        log = ts.slowlog()
+        assert len(log) == 1
+        assert log[0]["sampled"] is False
+
+    def test_fractional_rate_statistics(self):
+        random.seed(42)
+        ts = TraceStore(slow_threshold_s=1.0, slowlog_size=2048,
+                        sample_rate=0.5)
+        for i in range(400):
+            ts.note_complete(f"t{i}", 0.001)
+        kept = len(ts.slowlog())
+        assert 120 < kept < 280          # ~200 expected, wide tolerance

@@ -1,0 +1,278 @@
+"""Fleet workload insights e2e (ISSUE 19 acceptance criteria).
+
+1. A partitioned query stream across a 3-node in-process cluster:
+   ``/admin/fleet`` (from ANY vantage node) equals the EXACT
+   ``merge_snapshots`` of the three ``/admin/insights?raw=true``
+   snapshots — bit-identical integers, no tolerance.
+2. A shape-identical concurrent burst measures batching headroom > 1
+   (the empirical number ROADMAP item 2 needs).
+3. An unreachable peer is marked stale/error in the fleet view; the
+   view itself still serves.
+4. An injected latency fault (every query breaching a tiny SLO latency
+   threshold) drives the ``FiloTenantSLOFastBurn`` alert through
+   inactive -> pending -> firing via the normal self-scrape + rules
+   machinery, with the burn visible in the ``filodb_slo_*`` families.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.core.record import RecordBuilder, decode_container
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetOptions
+from filodb_tpu.http.server import DatasetBinding, FiloHttpServer
+from filodb_tpu.insights.fleet import FleetAggregator
+from filodb_tpu.insights.ledger import WorkloadLedger, merge_snapshots
+from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.parallel.shardmap import ShardMapper, ShardStatus
+from filodb_tpu.standalone import FiloServer
+
+BASE = 1_700_000_000_000
+STEP = 10_000
+NODES = ("fi-a", "fi-b", "fi-c")
+WINDOW = (BASE + 60_000, BASE + 600_000)
+
+
+def _get(port, path, timeout=30, **params):
+    qs = urllib.parse.urlencode(params)
+    url = f"http://127.0.0.1:{port}{path}" + (f"?{qs}" if qs else "")
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get_text(port, path, timeout=30):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def _query(port, promql):
+    return _get(port, "/promql/prom/api/v1/query_range", query=promql,
+                start=WINDOW[0] / 1000, end=WINDOW[1] / 1000, step="30s")
+
+
+def _wait(predicate, timeout_s, what, interval=0.03):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        got = predicate()
+        if got:
+            return got
+        time.sleep(interval)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+@pytest.fixture(scope="module")
+def fleet_cluster():
+    """Three bare HTTP servers, each a one-shard coordinator over its
+    own memstore + ledger — the in-process stand-in for three
+    standalone nodes (the WatermarkLedger lesson: per-server state)."""
+    servers, ports = {}, {}
+    rng = np.random.default_rng(11)
+    for name in NODES:
+        mapper = ShardMapper(1)
+        mapper.register_node([0], name)
+        mapper.update_status(0, ShardStatus.ACTIVE)
+        ms = TimeSeriesMemStore()
+        shard = ms.setup("prom", DEFAULT_SCHEMAS, 0)
+        b = RecordBuilder(DEFAULT_SCHEMAS["gauge"], container_size=1 << 16)
+        for i in range(4):
+            tags = {"__name__": "fi_total", "instance": f"i{i}",
+                    "_ws_": "w", "_ns_": "n"}
+            vals = np.cumsum(rng.random(120))
+            for k in range(120):
+                b.add(BASE + k * 5_000, [float(vals[k])], tags)
+        for off, c in enumerate(b.containers()):
+            shard.ingest(decode_container(c, DEFAULT_SCHEMAS), off)
+        planner = SingleClusterPlanner("prom", mapper, DatasetOptions(),
+                                       spread_default=0)
+        srv = FiloHttpServer(node_name=name)
+        # wide co-arrival window so the burst test is not timing-flaky
+        srv.insights = WorkloadLedger(node=name, co_window_ms=5_000.0)
+        srv.bind_dataset(DatasetBinding("prom", ms, planner))
+        ports[name] = srv.start()
+        servers[name] = srv
+    eps = {n: f"http://127.0.0.1:{p}" for n, p in ports.items()}
+    for name in NODES:
+        servers[name].fleet = FleetAggregator(
+            name, eps, servers[name]._insights_raw, stale_after_s=300.0)
+    yield {"servers": servers, "ports": ports, "eps": eps}
+    for srv in servers.values():
+        srv.shutdown()
+
+
+class TestFleetConsole:
+    """Method order matters (module-scoped cluster): the exact-merge
+    proof runs on the quiesced stream BEFORE the burst adds traffic."""
+
+    def test_1_fleet_equals_exact_merge_of_raw_snapshots(self,
+                                                         fleet_cluster):
+        ports = fleet_cluster["ports"]
+        # a partitioned stream: 18 queries, round-robin across nodes,
+        # mixing fingerprints and tenants-of-one-shape
+        queries = []
+        for i in range(18):
+            inst = f"i{i % 4}"
+            q = (f'sum(rate(fi_total{{instance="{inst}"}}[1m]))'
+                 if i % 3 else f'fi_total{{instance="{inst}"}}')
+            queries.append(q)
+        for i, q in enumerate(queries):
+            node = NODES[i % len(NODES)]
+            code, body = _query(ports[node], q)
+            assert code == 200, body
+        raws = {}
+        for n in NODES:
+            code, body = _get(ports[n], "/admin/insights", raw="true")
+            assert code == 200
+            raws[n] = body["data"]
+            assert raws[n]["node"] == n
+        expected = merge_snapshots([raws[n]["insights"] for n in NODES])
+        # every issued query is attributed exactly once, fleet-wide
+        assert sum(e["count"]
+                   for e in expected["fingerprints"].values()) == 18
+        assert expected["nodes"] == sorted(NODES)
+        for vantage in NODES:
+            code, fleet = _get(ports[vantage], "/admin/fleet",
+                               refresh="true")
+            assert code == 200
+            data = fleet["data"]
+            # THE acceptance assertion: the one-pane console is the
+            # EXACT merge — same ints, same keys, no tolerance
+            assert data["insights"] == expected
+            assert data["node"] == vantage
+            for n in NODES:
+                assert data["nodes"][n]["ok"] is True
+            assert set(data["replicas"]) == set(NODES)
+            for n in NODES:
+                assert data["replicas"][n]["prom"]["shards"] == 1
+
+    def test_2_batching_headroom_on_shape_identical_burst(self,
+                                                          fleet_cluster):
+        ports = fleet_cluster["ports"]
+        # shape-identical burst: same range/step/family, different
+        # label filters -> same batch key, distinct fingerprints
+        errs = []
+
+        def fire(i):
+            code, body = _query(ports["fi-a"],
+                                f'fi_total{{instance="i{i % 4}"}}')
+            if code != 200:
+                errs.append(body)
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        code, body = _get(ports["fi-a"], "/admin/insights")
+        assert code == 200
+        data = body["data"]
+        assert data["batching"]["headroom"] > 1
+        peak_keys = [r for r in data["batching"]["keys"] if r["peak"] > 1]
+        assert peak_keys, data["batching"]
+        assert peak_keys[0]["batch_key"].startswith("prom|")
+
+    def test_3_unreachable_peer_marked_stale_not_fatal(self,
+                                                       fleet_cluster):
+        srv = fleet_cluster["servers"]["fi-a"]
+        agg = FleetAggregator(
+            "fi-a", {"ghost": "http://127.0.0.1:9"},  # nothing listens
+            srv._insights_raw, timeout_s=0.5)
+        tree = agg.tree(refresh=True)
+        assert tree["nodes"]["ghost"]["ok"] is False
+        assert tree["nodes"]["ghost"]["error"]
+        # the view itself still serves, from the local bundle
+        assert tree["nodes"]["fi-a"]["ok"] is True
+        assert tree["insights"]["nodes"] == ["fi-a"]
+
+
+class TestSloBurnAlertLifecycle:
+    def test_latency_fault_drives_fast_burn_inactive_pending_firing(
+            self, tmp_path):
+        # the "injected latency fault": a 1us latency threshold every
+        # real query breaches, against a 99.9% availability target —
+        # burn = (1.0 bad fraction) / 0.001 budget = 1000x >> 14.4
+        config = {
+            "node": "slo-node",
+            "data-dir": str(tmp_path),
+            "datasets": [{"name": "prom", "num-shards": 1,
+                          "min-num-nodes": 1, "schema": "gauge",
+                          "spread": 0}],
+            "dataplane": {
+                "watermark-sample-interval-s": 3600,
+                "self-scrape": {"enabled": True, "interval-s": 0.15,
+                                "dataset": "_system"},
+            },
+            "insights": {
+                "slo": {"objectives": [
+                    {"name": "gold", "tenant": "*",
+                     "latency-threshold-s": 0.000001,
+                     "availability-target": 0.999}],
+                    "fast-window-s": 60, "slow-window-s": 120},
+            },
+            "rules": {
+                "self-monitoring": {"enabled": False},
+                "slo-burn": {"interval": "200ms", "for": "600ms"},
+            },
+        }
+        srv = FiloServer(config)
+        port = srv.start()
+        try:
+            # the slo-burn pack loaded; alert starts inactive
+            code, body = _get(port, "/api/v1/rules")
+            assert code == 200
+            groups = {g["name"]: g for g in body["data"]["groups"]}
+            assert "filodb-slo-burn" in groups
+            fast = next(r for r in groups["filodb-slo-burn"]["rules"]
+                        if r["name"] == "FiloTenantSLOFastBurn")
+            assert fast["state"] == "inactive"
+            code, body = _get(port, "/api/v1/alerts")
+            assert body["data"]["alerts"] == []
+
+            # breach traffic: every query exceeds the 1us threshold
+            for _ in range(10):
+                code, _b = _query(port, "up")
+                assert code == 200
+
+            # burn is live in the exported filodb_slo_* families
+            code, body = _get(port, "/admin/insights")
+            (row,) = body["data"]["slo"]
+            assert row["objective"] == "gold"
+            assert row["fast_burn"] > 14.4
+            metrics = _get_text(port, "/metrics")
+            line = next(
+                ln for ln in metrics.splitlines()
+                if ln.startswith("filodb_slo_fast_burn")
+                and 'objective="gold"' in ln)
+            assert float(line.rsplit(" ", 1)[1]) > 14.4
+
+            # lifecycle: inactive -> pending -> firing, observed
+            # through the same /api/v1/alerts surface operators use
+            states = set()
+
+            def burn_states(want):
+                code, body = _get(port, "/api/v1/alerts")
+                for a in body["data"]["alerts"]:
+                    if a["labels"].get("alertname") == \
+                            "FiloTenantSLOFastBurn":
+                        states.add(a["state"])
+                return want in states
+
+            _wait(lambda: burn_states("pending"), 20,
+                  "fast-burn alert pending")
+            _wait(lambda: burn_states("firing"), 20,
+                  "fast-burn alert firing")
+            assert {"pending", "firing"} <= states
+        finally:
+            srv.shutdown()
