@@ -42,6 +42,12 @@ FOUR variants are measured and emitted (ISSUE 3; hist + topK ISSUE 14):
   launches/query (must be exactly 1.0 warm, kernel-launch ledger at
   1-in-1 sampling) and achieved scan bytes/s; answers are asserted
   BIT-equal to the scatter-gather oracle before timing.
+- ``query_batching`` (ISSUE 20): the fleet batching tier — K
+  shape-identical concurrent queries rendezvoused by the QueryBatcher
+  and executed as ONE vmapped device program.  Owns launches/query for
+  a warm co-arrival fleet (must be <= ceil(K/max_batch)/K, kernel
+  ledger at 1-in-1 sampling); every member's slice is asserted
+  BIT-equal to its solo launch before timing.
 
 The run FAILS (nonzero rc + machine-readable error JSON) if any
 equivalence assertion trips or a measured variant regresses >20%
@@ -123,6 +129,13 @@ M_SHARDS = int(os.environ.get("FILODB_BENCH_MESH_SHARDS", 8))
 M_SERIES = int(os.environ.get("FILODB_BENCH_MESH_SERIES", 192))
 M_ROWS = int(os.environ.get("FILODB_BENCH_MESH_ROWS", 240))
 M_ITERS = int(os.environ.get("FILODB_BENCH_MESH_ITERS", 12))
+# fleet batching variant (ISSUE 20): K shape-identical concurrent
+# queries through the QueryBatcher — a warm co-arrival group must cost
+# ceil(K/max_batch) vmapped launches, bit-equal to solo execution
+QB_FLEET = int(os.environ.get("FILODB_BENCH_BATCH_FLEET", 8))
+QB_SERIES = int(os.environ.get("FILODB_BENCH_BATCH_SERIES", 64))
+QB_ROWS = int(os.environ.get("FILODB_BENCH_BATCH_ROWS", 120))
+QB_ITERS = int(os.environ.get("FILODB_BENCH_BATCH_ITERS", 6))
 
 
 def _probe_backend(timeout_s: int):
@@ -178,12 +191,14 @@ def main():
         # BOTH variants still run end-to-end (tiny shapes, interpret
         # mode) so a broken kernel fails here, not only on the TPU
         _cpu_interpret_smoke()
-        # the fabric variant is backend-agnostic: run its bit-equality
-        # and one-launch gates end-to-end even without hardware
+        # the fabric + batching variants are backend-agnostic: run
+        # their bit-equality and launch-count gates end-to-end even
+        # without hardware
         _bench_mesh_fabric()
+        _bench_query_batching()
         log("no TPU backend: interpret-mode variant smoke (all four "
-            "kernel variants) + mesh-fabric equivalence passed; "
-            "skipping measurement")
+            "kernel variants) + mesh-fabric + fleet-batching "
+            "equivalence passed; skipping measurement")
         print(json.dumps({
             "metric": "PromQL samples scanned/sec (rate()+sum-by)",
             "value": 0.0, "unit": "samples/sec", "vs_baseline": 0.0,
@@ -418,6 +433,7 @@ def main():
     topk_var = _guarded_variant("gdelt_topk",
                                 lambda: _bench_event_topk(timed))
     mesh_var = _guarded_variant("mesh_fabric", _bench_mesh_fabric)
+    batch_var = _guarded_variant("query_batching", _bench_query_batching)
 
     # -- CPU baseline (C++ multithreaded JVM proxy) on a subsample ----------
     from filodb_tpu.native import baseline as cpp_baseline
@@ -508,6 +524,7 @@ def main():
             "histogram_quantile": hist_var,
             "gdelt_topk": topk_var,
             "mesh_fabric": mesh_var,
+            "query_batching": batch_var,
         },
     }))
 
@@ -880,6 +897,130 @@ def _bench_mesh_fabric():
     return {"launches_per_query": launches,
             "samples_per_sec": round(rate, 1),
             "bytes_per_sec": round(rate * 4, 1),   # f32 resident plane
+            "equiv": "bitwise"}
+
+
+def _bench_query_batching():
+    """Fleet batching tier (ISSUE 20): QB_FLEET shape-identical
+    concurrent ``rate()`` range queries (same resident planes, same
+    grid shape, starts shifted by i*step) dispatched through the
+    ``QueryBatcher`` from barrier-released threads.  A warm co-arrival
+    fleet must cost ceil(K/max_batch) vmapped device launches — ONE
+    stacked program + ONE readback for the whole group, counted by the
+    kernel-launch ledger at 1-in-1 sampling — and every member's slice
+    is asserted BIT-equal to its solo (batcher-less) launch before
+    anything is timed.  Backend-agnostic: the gates run on CPU CI too."""
+    import threading
+
+    from filodb_tpu.batching import QueryBatcher, reset_batch_breaker
+    from filodb_tpu.core.filters import ColumnFilter, Equals
+    from filodb_tpu.core.record import RecordBuilder, decode_container
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+    from filodb_tpu.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.query.logical import RangeFunctionId as F
+    from filodb_tpu.utils.devicewatch import KERNEL_TIMER, device_metrics
+
+    base, step, window = 1_700_000_040_000, 60_000, 300_000
+    kbuckets = window // step
+    fleet = QB_FLEET
+    log(f"query batching: fleet of {fleet} over {QB_SERIES} series x "
+        f"{QB_ROWS} rows...")
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("prom", DEFAULT_SCHEMAS, 0)
+    rng = np.random.default_rng(7)
+    b = RecordBuilder(DEFAULT_SCHEMAS["prom-counter"])
+    for i in range(QB_SERIES):
+        tags = {"__name__": "fleet_total", "instance": f"i{i}",
+                "_ws_": "w", "_ns_": "n"}
+        ts = (base + np.arange(QB_ROWS, dtype=np.int64) * step - step + 1
+              + rng.integers(0, 30_000, size=QB_ROWS))
+        vals = np.cumsum(rng.random(QB_ROWS) * 5)
+        for t, v in zip(ts, vals):
+            b.add(int(t), [float(v)], tags)
+    for off, c in enumerate(b.containers()):
+        shard.ingest(decode_container(c, DEFAULT_SCHEMAS), off)
+    shard.flush_all()
+    pids = shard.lookup_partitions(
+        [ColumnFilter("_metric_", Equals("fleet_total"))], 0,
+        2**62).part_ids
+    steps0 = base + (kbuckets - 1) * step
+    nsteps = QB_ROWS - kbuckets - fleet - 1
+    starts = [steps0 + i * step for i in range(fleet)]
+
+    # solo oracle: the per-query chain with no batcher attached
+    solos = []
+    for s0 in starts:
+        got = shard.scan_grid(pids, F.RATE, s0, nsteps, step, window)
+        if got is None:
+            fail("fleet-batching bench workload declined the grid path")
+        solos.append(np.asarray(got[1]))
+
+    reset_batch_breaker()
+    bat = QueryBatcher(enabled=True, window_ms=1_000.0, max_batch=fleet,
+                       hot_ttl_s=60.0, dataset="prom")
+    shard.query_batcher = bat
+
+    def fleet_round():
+        barrier = threading.Barrier(fleet)
+        outs = [None] * fleet
+
+        def worker(i, s0):
+            barrier.wait()
+            got = shard.scan_grid(pids, F.RATE, s0, nsteps, step,
+                                  window)
+            outs[i] = None if got is None else np.asarray(got[1])
+
+        ths = [threading.Thread(target=worker, args=(i, s0))
+               for i, s0 in enumerate(starts)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        return outs
+
+    try:
+        # bootstrap: a cold key only groups off a detected overlap, so
+        # round until the key is hot (also warms the padded-B compile)
+        for _ in range(10):
+            fleet_round()
+            if bat.snapshot()["realized_peak"] >= 2:
+                break
+        if bat.snapshot()["realized_peak"] < 2:
+            fail("fleet-batching bench never formed a co-arrival group")
+        fleet_round()        # one hot round: warm the full-B compile
+        prev = KERNEL_TIMER.sample_1_in
+        KERNEL_TIMER.configure(sample_1_in=1)
+        try:
+            c = device_metrics()["kernel_launches"]
+            before = c.total()
+            a = time.perf_counter()
+            rounds = []
+            for _ in range(QB_ITERS):
+                rounds.append(fleet_round())
+            el = max(time.perf_counter() - a, 1e-9)
+            launches = (c.total() - before) / (QB_ITERS * fleet)
+        finally:
+            KERNEL_TIMER.configure(sample_1_in=prev)
+        for outs in rounds:
+            for i, out in enumerate(outs):
+                if out is None or out.tobytes() != solos[i].tobytes():
+                    fail(f"fleet-batching member {i} is NOT bit-equal "
+                         f"to its solo launch")
+    finally:
+        shard.query_batcher = None
+    budget = -(-fleet // bat.max_batch) / fleet       # ceil(K/max)/K
+    if launches > budget:
+        fail(f"warm fleet of {fleet} cost {launches:.3f} launches/query "
+             f"(> {budget:.3f} = ceil(K/max_batch)/K): the co-arrival "
+             f"group is not ONE stacked launch")
+    samples = QB_SERIES * nsteps * kbuckets
+    rate = samples * QB_ITERS * fleet / el
+    realized = bat.snapshot()["realized_peak"]
+    log(f"query_batching: {launches:.3f} launches/query (fleet={fleet}, "
+        f"peak group={realized}), {rate:.3e} samples/sec")
+    return {"launches_per_query": round(launches, 4),
+            "fleet": fleet, "peak_group": realized,
+            "samples_per_sec": round(rate, 1),
             "equiv": "bitwise"}
 
 

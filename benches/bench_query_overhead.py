@@ -819,6 +819,57 @@ def main():
             f"({reduction:.0f}x) — below the 50x bar")
         return 1
 
+    # fleet-batching guard (ISSUE 20): the same loop with a live
+    # QueryBatcher attached to every shard.  The bench is SEQUENTIAL —
+    # one query in flight at a time — so every dispatch is a cold-key
+    # passthrough: the batcher's whole cost at concurrency=1 is one
+    # lock round-trip + inflight bookkeeping per device dispatch, and
+    # a lone query must never wait out a co-arrival window.  A/B
+    # interleaved under the same <=3% / 0.5 ms budget.
+    from filodb_tpu.batching import QueryBatcher, reset_batch_breaker
+    reset_batch_breaker()
+    bat = QueryBatcher(enabled=True, window_ms=3.0, max_batch=8,
+                       dataset="prom")
+    bat_shards = list(ms.shards("prom"))
+    try:
+        for sh in bat_shards:
+            sh.query_batcher = bat
+        once()
+        lat_nobat, lat_bat = [], []
+        for _ in range(ITERS):
+            for sh in bat_shards:
+                sh.query_batcher = None
+            t0 = time.perf_counter()
+            once()
+            lat_nobat.append(time.perf_counter() - t0)
+            for sh in bat_shards:
+                sh.query_batcher = bat
+            t0 = time.perf_counter()
+            once()
+            lat_bat.append(time.perf_counter() - t0)
+    finally:
+        for sh in bat_shards:
+            sh.query_batcher = None
+    med_nobat = statistics.median(lat_nobat)
+    med_bat = statistics.median(lat_bat)
+    bat_delta = statistics.median(
+        b - n for b, n in zip(lat_bat, lat_nobat))
+    bat_overhead = bat_delta / med_nobat
+    log(f"batching off {med_nobat * 1e3:.2f} ms  "
+        f"on {med_bat * 1e3:.2f} ms  paired delta "
+        f"{bat_delta * 1e6:+.0f} us ({bat_overhead * 100:+.2f}%)")
+    emit("batching_overhead_median", bat_overhead * 100, "%",
+         off_ms=round(med_nobat * 1e3, 3), on_ms=round(med_bat * 1e3, 3),
+         paired_delta_us=round(bat_delta * 1e6, 1))
+    if bat_overhead > 0.03 and bat_delta > 5e-4:
+        log(f"FAIL: query-batching single-stream overhead "
+            f"{bat_overhead * 100:.2f}% exceeds the 3% budget")
+        return 1
+    if bat.snapshot()["realized_peak"] > 0:
+        log("FAIL: sequential bench formed a batch group — the "
+            "co-arrival gate is waiting on lone queries")
+        return 1
+
     # fleet-insights guard (ISSUE 19): the same loop with the full
     # per-query insights accounting the server does in _exec /
     # _note_insight — plan_keys (canonical fingerprint + batch key),

@@ -9,7 +9,8 @@ catch-tests, run through these rules.
 
 Rules: decode-sentinel, timed-handler, interpret-coverage,
 device-put-ledger, admission-routing, deadline-threading, metric-doc,
-replica-routing, evaluator-workload, kernel-timer-coverage.
+replica-routing, evaluator-workload, kernel-timer-coverage,
+batch-admission-discipline.
 """
 
 from __future__ import annotations
@@ -763,4 +764,70 @@ def admin_endpoint_documented(project):
                 f"doc/http_api.md — document the endpoint (operators "
                 f"discover the admin surface from that table, not "
                 f"from the router)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# batch-admission-discipline (ISSUE 20): any function that stacks and
+# executes a query GROUP (the fleet batching tier's leader) must
+# reference each member's admission permit and deadline-derived budget
+# — no batched execution path may bypass the per-query admission
+# window or the deadline tripwires.  Heuristic: a function that walks
+# ``members`` AND invokes a batched launch (a call whose name contains
+# "batch") is a group executor.
+# ---------------------------------------------------------------------------
+
+_BATCH_BUDGET_NAMES = ("remaining_ms", "deadline_ms")
+_BATCH_EXEC_HINTS = ("launch", "exec", "run", "dispatch")
+
+
+@rule("batch-admission-discipline",
+      doc="batched group execution bypassing per-member admission "
+          "permits or deadline budgets")
+def batch_admission_discipline(module):
+    findings = []
+    for node in module.nodes:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        refs = set()
+        calls = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name):
+                refs.add(n.id)
+            elif isinstance(n, ast.Attribute):
+                refs.add(n.attr)
+            if isinstance(n, ast.Call):
+                f = n.func
+                if isinstance(f, ast.Attribute):
+                    calls.add(f.attr)
+                elif isinstance(f, ast.Name):
+                    calls.add(f.id)
+                # getattr(x, "admission_permit", ...) IS a reference
+                if isinstance(f, ast.Name) and f.id == "getattr" \
+                        and len(n.args) >= 2 \
+                        and isinstance(n.args[1], ast.Constant) \
+                        and isinstance(n.args[1].value, str):
+                    refs.add(n.args[1].value)
+        # a group executor walks ``members`` AND invokes a batched
+        # launch (e.g. batch_launch / run_batched) — bookkeeping like
+        # ledger.note_batch() does not count as execution
+        if "members" not in refs or not any(
+                "batch" in c and any(h in c for h in _BATCH_EXEC_HINTS)
+                for c in calls):
+            continue           # not a group executor
+        if "admission_permit" not in refs:
+            findings.append(Finding(
+                "batch-admission-discipline", module.rel, node.lineno,
+                f"{node.name} stacks/executes a query group without "
+                f"referencing each member's admission_permit — a "
+                f"batched member must never execute outside its own "
+                f"admission window (doc/batching.md)"))
+        if not any(b in refs for b in _BATCH_BUDGET_NAMES):
+            findings.append(Finding(
+                "batch-admission-discipline", module.rel, node.lineno,
+                f"{node.name} stacks/executes a query group without "
+                f"consulting the members' deadline budgets "
+                f"(remaining_ms/deadline_ms) — an expired member must "
+                f"be dropped from the stack, not launched "
+                f"(doc/batching.md)"))
     return findings

@@ -1,0 +1,24 @@
+"""Fleet batching tier (ISSUE 20, ROADMAP item 2).
+
+Concurrent shape-compatible queries — the thousands of dashboard
+panels refreshing against the same hot dataset — rendezvous at the
+device-dispatch boundary and execute as ONE vmapped device program
+over the shared resident planes (the DrJAX vmap-over-clients idiom,
+arXiv:2403.07128), instead of paying N serving launches for N queries
+whose plans differ only in their start step.
+
+``QueryBatcher`` is the rendezvous: the device store offers every
+eligible dispatch (batch key + the member's ``(row0, steps0)`` stack
+axis + a batched launch closure); the batcher groups co-arrivals
+inside a short bounded window, a leader launches the stacked program,
+and every member gets its own slice of the single readback.  Any
+failure demotes the whole group through a bit-identical per-query
+fallback (breaker + ``filodb_batch_fallbacks_total{reason=}``).
+
+See doc/batching.md for the batch-key contract, knobs, and the
+fallback ladder.
+"""
+
+from .batcher import QueryBatcher, batching_broken, reset_batch_breaker
+
+__all__ = ["QueryBatcher", "batching_broken", "reset_batch_breaker"]

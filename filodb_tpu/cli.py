@@ -284,10 +284,16 @@ def cmd_insights(args) -> int:
               + (f"  sheds {row['sheds']}" if row["sheds"] else ""))
     bat = data.get("batching") or {}
     print(f"batching headroom: {bat.get('headroom', 0)} "
-          f"co-arriving shape-identical queries at peak")
+          f"co-arriving shape-identical queries at peak; realized "
+          f"{bat.get('realized_peak', 0)} at peak "
+          f"({bat.get('realized_members', 0)} queries in "
+          f"{bat.get('realized_groups', 0)} vmapped launches)")
     for row in bat.get("keys", [])[:args.top]:
         print(f"  {row['batch_key']}: peak {row['peak']}, "
-              f"{row['co_arrived']}/{row['arrivals']} co-arrived")
+              f"{row['co_arrived']}/{row['arrivals']} co-arrived; "
+              f"realized peak {row.get('realized_peak', 0)}, "
+              f"{row.get('batched_members', 0)} batched in "
+              f"{row.get('batched_groups', 0)} launches")
     for tenant, t in sorted((data.get("tenants") or {}).items()):
         avg = t["latency_us"] / 1000.0 / t["count"] if t["count"] else 0
         print(f"tenant {tenant or '(untagged)'}: {t['count']} queries, "

@@ -124,6 +124,10 @@ class DatasetBinding:
     # ResultCache instance embedded in this dataset's planner wrapper;
     # None = the dataset serves uncached (admin views + runtime config)
     resultcache: Optional[object] = None
+    # fleet batching tier (ISSUE 20, filodb_tpu/batching): the
+    # QueryBatcher this dataset's shards rendezvous in; None = every
+    # dispatch runs the per-query chain (admin views + runtime config)
+    batcher: Optional[object] = None
 
 
 @dataclass
@@ -908,6 +912,33 @@ class FiloHttpServer:
                         enabled=enabled,
                         max_bytes=int(max_bytes)
                         if max_bytes is not None else None)
+        # fleet-batching knobs (ISSUE 20, filodb_tpu/batching): the
+        # co-arrival window, group-size cap, and the tier itself are
+        # runtime-adjustable across every bound dataset — a batcher
+        # gone wrong must be killable without a restart
+        if any(k in p for k in ("batch-enabled", "batch-window-ms",
+                                "batch-max-size", "batch-hot-ttl-s")):
+            enabled = None
+            if "batch-enabled" in p:
+                enabled = str(p["batch-enabled"]).lower() in ("true", "1")
+            window_ms = None
+            if "batch-window-ms" in p:
+                window_ms = float(p["batch-window-ms"])
+                if window_ms <= 0:
+                    return 400, error_response(
+                        "bad_data", "batch-window-ms must be > 0")
+            max_batch = None
+            if "batch-max-size" in p:
+                max_batch = int(p["batch-max-size"])
+                if max_batch < 1:
+                    return 400, error_response(
+                        "bad_data", "batch-max-size must be >= 1")
+            for b in self.datasets.values():
+                if b.batcher is not None:
+                    b.batcher.configure(
+                        enabled=enabled, window_ms=window_ms,
+                        max_batch=max_batch,
+                        hot_ttl_s=p.get("batch-hot-ttl-s"))
         # data-plane knob (ISSUE 6): how long a lagging shard's ingested
         # offset may sit still before an ingest.stall event fires
         if "ingest-stall-window-s" in p:
@@ -939,11 +970,16 @@ class FiloHttpServer:
             if b.resultcache is not None:
                 snap = b.resultcache.snapshot()
                 rcache[ds] = {k: snap[k] for k in ("enabled", "max_bytes")}
+        batching: dict = {}
+        for ds, b in self.datasets.items():
+            if b.batcher is not None:
+                batching[ds] = b.batcher.snapshot()
         return 200, {"status": "success", "data": {
             "datasets": stores,
             "workload": {"min-remote-budget-ms": self.min_remote_budget_ms,
                          "datasets": workload},
             "result-cache": rcache,
+            "batching": batching,
             "dataplane": {
                 "ingest-stall-window-s":
                     self._ensure_watermarks().stall_window_s,
@@ -1372,6 +1408,10 @@ class FiloHttpServer:
                 from filodb_tpu.insights.ledger import plan_keys
                 ins_keys = plan_keys(b.dataset, plan, query)
                 ins.note_arrival(ins_keys[1])
+                # fleet batching (ISSUE 20): carry the batch key on the
+                # query context so the batcher's realized group sizes
+                # land next to this key's co-arrival headroom estimate
+                qctx.batch_key = ins_keys[1]
             except Exception:  # noqa: BLE001 — insights never fail a query
                 ins_keys = None
 

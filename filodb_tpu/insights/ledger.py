@@ -74,6 +74,16 @@ def plan_keys(dataset: str, plan, query: str) -> tuple[str, str]:
     return fp, f"{dataset}|{family}|res={step}|steps={steps}"
 
 
+def _new_batch_row() -> dict:
+    """One batch-key row: the co-arrival headroom estimate (arrivals /
+    co_arrived / peak, fed by note_arrival) next to the REALIZED
+    batching achieved by the fleet batching tier (batched_groups /
+    batched_members / realized_peak, fed by note_batch)."""
+    return {"arrivals": 0, "co_arrived": 0, "peak": 1,
+            "batched_groups": 0, "batched_members": 0,
+            "realized_peak": 0}
+
+
 def _new_entry(query: str, dataset: str, batch_key: str) -> dict:
     return {"query": query, "dataset": dataset, "batch_key": batch_key,
             "count": 0, "errors": 0, "latency_us": 0,
@@ -127,8 +137,7 @@ class WorkloadLedger:
             co = len(dq)
             row = self._batch.get(batch_key)
             if row is None:
-                row = self._batch[batch_key] = {"arrivals": 0,
-                                                "co_arrived": 0, "peak": 1}
+                row = self._batch[batch_key] = _new_batch_row()
                 while len(self._batch) > self.max_entries:
                     self._batch.pop(next(iter(self._batch)))
             row["arrivals"] += 1
@@ -137,6 +146,25 @@ class WorkloadLedger:
             if co > row["peak"]:
                 row["peak"] = co
             return co
+
+    def note_batch(self, batch_key: str, size: int) -> None:
+        """Record one REALIZED vmapped batch of ``size`` members for
+        ``batch_key`` (ISSUE 20: the batching tier closes the headroom
+        loop — achieved group sizes land next to the co-arrival
+        estimate, so operators see predicted vs realized batching per
+        key)."""
+        if not self.enabled or not batch_key or size <= 0:
+            return
+        with self._lock:
+            row = self._batch.get(batch_key)
+            if row is None:
+                row = self._batch[batch_key] = _new_batch_row()
+                while len(self._batch) > self.max_entries:
+                    self._batch.pop(next(iter(self._batch)))
+            row["batched_groups"] += 1
+            row["batched_members"] += int(size)
+            if size > row["realized_peak"]:
+                row["realized_peak"] = int(size)
 
     def note(self, fingerprint: str, *, query: str = "", dataset: str = "",
              tenant: str = "", latency_s: float = 0.0, error: bool = False,
@@ -287,11 +315,18 @@ def merge_snapshots(snaps: list[dict]) -> dict:
         for k, v in s.get("batch", {}).items():
             row = batch.get(k)
             if row is None:
-                batch[k] = dict(v)
+                # normalize through the full row shape so realized
+                # fields merged from OLD snapshots (pre-ISSUE 20)
+                # default to 0 and the algebra stays exact
+                batch[k] = {**_new_batch_row(), **v}
             else:
                 row["arrivals"] += v.get("arrivals", 0)
                 row["co_arrived"] += v.get("co_arrived", 0)
                 row["peak"] = max(row["peak"], v.get("peak", 1))
+                row["batched_groups"] += v.get("batched_groups", 0)
+                row["batched_members"] += v.get("batched_members", 0)
+                row["realized_peak"] = max(row["realized_peak"],
+                                           v.get("realized_peak", 0))
         for k, v in s.get("tenants", {}).items():
             row = tenants.get(k)
             if row is None:
@@ -377,9 +412,15 @@ def view(snapshot: dict, top: int = 20, sort: str = "cost") -> dict:
     batch_rows = []
     for k, v in sorted(snapshot.get("batch", {}).items(),
                        key=lambda kv: (-kv[1]["peak"], kv[0]))[:top]:
-        batch_rows.append({"batch_key": k, **v})
-    headroom = max((v["peak"] for v in
-                    snapshot.get("batch", {}).values()), default=0)
+        batch_rows.append({"batch_key": k, **_new_batch_row(), **v})
+    batch_vals = snapshot.get("batch", {}).values()
+    headroom = max((v["peak"] for v in batch_vals), default=0)
+    realized_peak = max((v.get("realized_peak", 0)
+                         for v in batch_vals), default=0)
+    realized_groups = sum(v.get("batched_groups", 0)
+                          for v in batch_vals)
+    realized_members = sum(v.get("batched_members", 0)
+                           for v in batch_vals)
     return {"nodes": snapshot.get("nodes") or
             ([snapshot["node"]] if snapshot.get("node") else []),
             "window_s": round(window_s, 3),
@@ -388,4 +429,8 @@ def view(snapshot: dict, top: int = 20, sort: str = "cost") -> dict:
             "sort": sort if sort in keyfns else "cost",
             "top": rows,
             "tenants": snapshot.get("tenants", {}),
-            "batching": {"headroom": headroom, "keys": batch_rows}}
+            "batching": {"headroom": headroom,
+                         "realized_peak": realized_peak,
+                         "realized_groups": realized_groups,
+                         "realized_members": realized_members,
+                         "keys": batch_rows}}

@@ -244,17 +244,24 @@ def _fused_progs():
         return _FUSED_PROGS
     import functools
 
+    import jax
     import jax.numpy as jnp
     from jax import lax
 
-    from filodb_tpu.ops.grid import rate_grid_auto, rate_grid_packed
+    from filodb_tpu.ops.grid import (rate_grid_auto, rate_grid_batch_impl,
+                                     rate_grid_packed)
 
-    def _sliced(parts, row0, nrows, decode):
+    def _concat(parts, decode):
         if not parts:
             return None    # phase mode: no ts plane in the program
         segs = [decode(s) for s in parts]
-        all_ = segs[0] if len(segs) == 1 \
+        return segs[0] if len(segs) == 1 \
             else jnp.concatenate(segs, axis=0)
+
+    def _sliced(parts, row0, nrows, decode):
+        all_ = _concat(parts, decode)
+        if all_ is None:
+            return None
         return lax.dynamic_slice_in_dim(all_, row0, nrows, axis=0)
 
     @functools.partial(devicewatch.jit, program="devicestore.series",
@@ -301,10 +308,52 @@ def _fused_progs():
                                    use_phase=use_phase)
         return _grouped_reduce_impl(stepped, garr, num_groups, op)
 
+    # fleet-batched programs (ISSUE 20): B shape-compatible queries
+    # against the SAME resident planes — decode + concat happen ONCE,
+    # then the per-member row slice and grid kernel run vmapped over
+    # the leading member axis, so a whole co-arrival group costs one
+    # launch and one stacked readback instead of B of each.
+    @functools.partial(devicewatch.jit,
+                       program="devicestore.series_batch",
+                       static_argnames=("q", "lanes", "nrows"))
+    def series_batch_prog(ts_parts, val_parts, row0s, steps0s,
+                          phase=None, *, q, lanes, nrows):
+        ts_all = _concat(ts_parts, _seg_ts_device)
+        val_all = _concat(val_parts, _seg_vals_device)
+        ts_b = None if ts_all is None else jax.vmap(
+            lambda r: lax.dynamic_slice_in_dim(ts_all, r, nrows,
+                                               axis=0))(row0s)
+        val_b = jax.vmap(
+            lambda r: lax.dynamic_slice_in_dim(val_all, r, nrows,
+                                               axis=0))(row0s)
+        return rate_grid_batch_impl(ts_b, val_b, steps0s, q, lanes,
+                                    phase=phase)
+
+    @functools.partial(devicewatch.jit,
+                       program="devicestore.grouped_batch",
+                       static_argnames=("q", "lanes", "nrows",
+                                        "num_groups", "op"))
+    def grouped_batch_prog(ts_parts, val_parts, row0s, steps0s, garr,
+                           phase=None, *, q, lanes, nrows, num_groups,
+                           op):
+        ts_all = _concat(ts_parts, _seg_ts_device)
+        val_all = _concat(val_parts, _seg_vals_device)
+
+        def one(r, s):
+            ts_sl = None if ts_all is None else \
+                lax.dynamic_slice_in_dim(ts_all, r, nrows, axis=0)
+            val_sl = lax.dynamic_slice_in_dim(val_all, r, nrows, axis=0)
+            stepped = rate_grid_auto(ts_sl, val_sl, s, q, lanes,
+                                     phase=phase)
+            return _grouped_reduce_impl(stepped, garr, num_groups, op)
+        return jax.vmap(one)(row0s, steps0s)
+
     _FUSED_PROGS["series"] = series_prog
     _FUSED_PROGS["grouped"] = grouped_prog
     _FUSED_PROGS["series_packed"] = series_prog_packed
     _FUSED_PROGS["grouped_packed"] = grouped_prog_packed
+    _FUSED_PROGS["series_batch"] = series_batch_prog
+    _FUSED_PROGS["grouped_batch"] = grouped_batch_prog
     return _FUSED_PROGS
 
 
@@ -713,12 +762,19 @@ class DeviceGridCache:
         if len(fargs) != _ARG_OPS.get(_GRID_OPS[func], 0):
             return None        # unexpected / missing function argument
         with self._lock:
-            vals = self._scan_rate_locked(  # filolint: disable=blocking-under-lock — staging under the grid lock is the design: one query stages the block, contenders reuse it instead of duplicating the HBM upload; the breaker bounds pathological re-staging
+            plan = self._plan_locked(  # filolint: disable=blocking-under-lock — staging under the grid lock is the design: one query stages the block, contenders reuse it instead of duplicating the HBM upload; the breaker bounds pathological re-staging
                 part_ids, func, steps0, nsteps,
                 step_ms, window_ms, fargs)
-            if vals is None:
+            if plan is None:
                 return None
+            _note_hbm(plan)
             tops = np.asarray(self.bucket_tops) if self.hist else None
+        # dispatch + readback run OUTSIDE the grid lock (the
+        # scan_rate_grouped structure): the plan tuple holds live refs
+        # to its device arrays, so a concurrent eviction cannot free
+        # them mid-dispatch — and concurrent shape-compatible queries
+        # can now rendezvous in the fleet batching tier
+        vals = self._dispatch_series(plan)
         return vals, tops
 
     def scan_rate_grouped(self, part_ids: Sequence[int], func: F,
@@ -758,7 +814,17 @@ class DeviceGridCache:
             else:
                 hist_slot_garr(garr, lane_idx, gid_arr, stride)
             _note_hbm(plan)
-        out = None
+        def grouped_solo():
+            # today's per-query fused reduce: also the batching tier's
+            # bit-identical fallback (it IS the same dispatch)
+            o = _fused_progs()["grouped"](
+                plan.ts_parts, plan.val_parts, plan.row0, plan.steps0_rel,
+                garr, plan.phase, q=plan.q, lanes=plan.lane_mult,
+                nrows=plan.nrows, num_groups=num_groups * stride, op=op)
+            _note_kernel_bytes(_fused_progs()["grouped"], plan)
+            return np.asarray(o, dtype=np.float64)  # host-sync-ok: ONE blocked readback of the reduced partials — each blocked transfer pays the tunnel round-trip
+
+        both = None
         if plan.packed is not None and not _PACKED_BROKEN:
             # packed lane order: scatter the group map through inv;
             # pack pad lanes keep the drop bucket
@@ -772,28 +838,55 @@ class DeviceGridCache:
                     use_phase=plan.packed_use_phase,
                     num_groups=num_groups * stride, op=op,
                     interpret=_PACKED_INTERPRET))
-        if out is None:
-            out = _fused_progs()["grouped"](
-                plan.ts_parts, plan.val_parts, plan.row0, plan.steps0_rel,
-                garr, plan.phase, q=plan.q, lanes=plan.lane_mult,
-                nrows=plan.nrows, num_groups=num_groups * stride, op=op)
-            _note_kernel_bytes(_fused_progs()["grouped"], plan)
-        else:
-            _note_kernel_bytes(_fused_progs()["grouped_packed"], plan)
+            if out is not None:
+                _note_kernel_bytes(_fused_progs()["grouped_packed"], plan)
+                both = np.asarray(out, dtype=np.float64)  # host-sync-ok: the one designed readback of the fused reduce
+        if both is None and not self.hist:
+            both = self._batched_grouped(plan, garr,
+                                         num_groups * stride, op,
+                                         grouped_solo)
+        if both is None:
+            both = grouped_solo()
         if self.hist:
-            both = np.asarray(out, dtype=np.float64)    # [2, G*hb, T]  # host-sync-ok: hist planes [2, G*hb, T] — the one designed readback of the fused reduce
+            # both: [2, G*hb, T] hist planes
             return hist_state_from_planes(both, num_groups, stride, tops)
         if op in ("sum", "avg", "count", "moments"):
-            # ONE host readback of the stacked [2|3, G, T]: each blocked
-            # transfer pays the tunnel round-trip
-            both = np.asarray(out, dtype=np.float64)  # host-sync-ok: ONE blocked readback of the stacked [2|3, G, T] partials (comment above)
             if op == "count":
                 return {"count": both[1]}
             if op == "moments":
                 return {"sum": both[0], "count": both[1],
                         "sumsq": both[2]}
             return {"sum": both[0], "count": both[1]}
-        return {op: np.asarray(out, dtype=np.float64)}  # host-sync-ok: single designed readback of the [G, T] reduced partial
+        return {op: both}
+
+    def _batched_grouped(self, plan, garr, num_groups, op, grouped_solo):
+        """Offer a fused grouped reduce to the fleet batching tier.
+        Members must share the group map exactly (``garr`` bytes are
+        part of the key): the stacked program reduces every member
+        with the one shared map.  Returns the member's float64
+        partial-planes slice, or None for the solo fallback."""
+        batcher = getattr(self._shard, "query_batcher", None)
+        if batcher is None or not batcher.enabled:
+            return None
+        from filodb_tpu.query.exec import active_exec_ctx
+        ctx = active_exec_ctx()
+        qctx = ctx.query_context if ctx is not None else None
+        key = ("grouped", tuple(id(p) for p in plan.ts_parts),
+               tuple(id(p) for p in plan.val_parts), id(plan.phase),
+               plan.q, plan.lane_mult, plan.nrows, num_groups, op,
+               garr.tobytes())
+        prog = _fused_progs()["grouped_batch"]
+
+        def batch_launch(row0s, steps0s):
+            out = _fused_progs()["grouped_batch"](
+                plan.ts_parts, plan.val_parts, row0s, steps0s, garr,
+                plan.phase, q=plan.q, lanes=plan.lane_mult,
+                nrows=plan.nrows, num_groups=num_groups, op=op)
+            _note_kernel_bytes(prog, plan)
+            return np.asarray(out, dtype=np.float64)  # host-sync-ok: ONE stacked readback of the group's reduced partials
+
+        return batcher.dispatch(key, plan.row0, plan.steps0_rel, qctx,
+                                batch_launch, grouped_solo)
 
     def mesh_plan(self, part_ids: Sequence[int], func: F, steps0: int,
                   nsteps: int, step_ms: int, window_ms: int,
@@ -867,16 +960,55 @@ class DeviceGridCache:
                                  self._shard.grid_device, hb=hb,
                                  bucket_tops=tops, col_pids=col_pids)
 
-    def _scan_rate_locked(self, part_ids, func, steps0, nsteps, step_ms,
-                          window_ms, fargs=()):
-        plan = self._plan_locked(part_ids, func, steps0, nsteps, step_ms,
-                                 window_ms, fargs)
-        if plan is None:
+    def _series_solo(self, plan):
+        """Today's per-query series launch + readback: the unchanged
+        chain every batching fallback demotes to (bit-identical by
+        construction — it IS the same dispatch)."""
+        stepped = _fused_progs()["series"](
+            plan.ts_parts, plan.val_parts, plan.row0, plan.steps0_rel,
+            plan.phase, q=plan.q, lanes=plan.lane_mult,
+            nrows=plan.nrows)
+        _note_kernel_bytes(_fused_progs()["series"], plan)
+        return np.asarray(stepped)  # host-sync-ok: the designed stepped readback — only [T, lanes] crosses the host link
+
+    def _batched_series(self, plan):
+        """Offer this dispatch to the fleet batching tier (ISSUE 20).
+        Returns the member's ``[T, lanes]`` readback slice, or None
+        when the batcher declined (absent, disabled, breaker open,
+        deadline too short, group demoted) — the caller then runs the
+        unchanged solo chain."""
+        batcher = getattr(self._shard, "query_batcher", None)
+        if batcher is None or not batcher.enabled or self.hist:
             return None
-        _note_hbm(plan)
+        from filodb_tpu.query.exec import active_exec_ctx
+        ctx = active_exec_ctx()
+        qctx = ctx.query_context if ctx is not None else None
+        # batch-compatibility at the device boundary: the SAME resident
+        # planes (segment identity), the same static kernel signature,
+        # and the same grid shape — members differ only in the traced
+        # (row0, steps0) stack axis.  lane_idx may differ per member:
+        # the series program computes every lane, request slicing is
+        # host-side on the member's own slice.
+        key = ("series", tuple(id(p) for p in plan.ts_parts),
+               tuple(id(p) for p in plan.val_parts), id(plan.phase),
+               plan.q, plan.lane_mult, plan.nrows)
+        prog = _fused_progs()["series_batch"]
+
+        def batch_launch(row0s, steps0s):
+            out = _fused_progs()["series_batch"](
+                plan.ts_parts, plan.val_parts, row0s, steps0s,
+                plan.phase, q=plan.q, lanes=plan.lane_mult,
+                nrows=plan.nrows)
+            _note_kernel_bytes(prog, plan)
+            return np.asarray(out)  # host-sync-ok: ONE stacked [B, T, lanes] readback serves the whole co-arrival group
+
+        return batcher.dispatch(key, plan.row0, plan.steps0_rel, qctx,
+                                batch_launch, lambda: self._series_solo(plan))
+
+    def _dispatch_series(self, plan):
         lanes_req = plan.lane_idx
         used_packed = False
-        stepped = None
+        out_np = None
         if plan.packed is not None:
             stepped = _run_packed(
                 lambda: _fused_progs()["series_packed"](
@@ -889,15 +1021,12 @@ class DeviceGridCache:
                 if not self.hist:
                     # packed lane order: compose request map with inv
                     lanes_req = plan.packed_inv[plan.lane_idx]
-        if stepped is None:
-            stepped = _fused_progs()["series"](
-                plan.ts_parts, plan.val_parts, plan.row0, plan.steps0_rel,
-                plan.phase, q=plan.q, lanes=plan.lane_mult,
-                nrows=plan.nrows)
-        _note_kernel_bytes(
-            _fused_progs()["series_packed" if used_packed else "series"],
-            plan)
-        out_np = np.asarray(stepped)  # host-sync-ok: the designed stepped readback — only [T, lanes] crosses the host link
+                _note_kernel_bytes(_fused_progs()["series_packed"], plan)
+                out_np = np.asarray(stepped)  # host-sync-ok: the designed stepped readback — only [T, lanes] crosses the host link
+        if out_np is None:
+            out_np = self._batched_series(plan)
+        if out_np is None:
+            out_np = self._series_solo(plan)
         if self.hist:
             # COLUMN-granular indirection: a hist series' device columns
             # are lane*hb + bucket, so the pack's inv must compose with

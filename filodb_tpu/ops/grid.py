@@ -1536,6 +1536,38 @@ def rate_grid_auto(ts, vals, steps0, q: GridQuery, lanes: int = 1024,
     return rate_grid_ref(ts, vals, steps0, q, phase=phase)
 
 
+def rate_grid_batch_impl(ts_b, vals_b, steps0s, q: GridQuery,
+                         lanes: int = 1024, phase=None):
+    """Fleet-batched grid kernel (ISSUE 20): vmap of
+    :func:`rate_grid_auto` over a leading MEMBER axis — B shape-
+    compatible queries against B pre-sliced views of the same resident
+    planes, one device program instead of B (the DrJAX vmap-over-
+    clients idiom).  ``ts_b``/``vals_b`` are ``[B, rows, cols]``
+    (``ts_b`` None in phase mode), ``steps0s`` is the ``[B]`` vector
+    of per-member first window ends; ``phase`` is shared and
+    broadcast.  Plain function: the serving path fuses it into its own
+    jitted program (memstore/devicestore.py ``series_batch``/
+    ``grouped_batch``) so slicing + kernel + readback stay ONE
+    dispatch."""
+    if ts_b is None:
+        return jax.vmap(lambda v, s: rate_grid_auto(
+            None, v, s, q, lanes, phase=phase))(vals_b, steps0s)
+    return jax.vmap(lambda t, v, s: rate_grid_auto(
+        t, v, s, q, lanes, phase=phase))(ts_b, vals_b, steps0s)
+
+
+@functools.partial(devicewatch.jit, program="grid.rate_grid_batch",
+                   static_argnames=("q", "lanes"))
+def rate_grid_batch(ts_b, vals_b, steps0s, q: GridQuery,
+                    lanes: int = 1024, phase=None):
+    """Standalone jitted batched entry over already-materialized
+    planes (tests, direct grid users).  The serving path does NOT call
+    this — it inlines :func:`rate_grid_batch_impl` into the fused
+    device-store programs to avoid a second dispatch."""
+    return rate_grid_batch_impl(ts_b, vals_b, steps0s, q, lanes,
+                                phase=phase)
+
+
 MAX_K_BUCKETS = 64   # K-unrolled kernel passes; caps the compile cost
 MAX_GRID_ROWS = 1024  # input rows per query: VMEM tile height bound (TPU)
 # any backend: bounds blocks staged/assembled per query (a coarse step

@@ -69,6 +69,17 @@ class QueryContext:
     # <= ~4*pixels pixel-exact points per series come back instead of
     # every raw step (0 = off; ISSUE 16)
     downsample_pixels: int = 0
+    # fleet batching tier (ISSUE 20, filodb_tpu/batching):
+    # - admission_permit: the live _Permit while this query executes
+    #   inside its admission window (stamped by AdmissionController's
+    #   permit context manager, cleared on release) — the batch leader
+    #   re-checks it at stack time, so no batched member ever executes
+    #   outside its own admission window
+    # - batch_key: the insights ledger's batch-compatibility key,
+    #   stamped by _exec so realized group sizes land next to the
+    #   co-arrival headroom estimate for the same key
+    admission_permit: object = None
+    batch_key: str = ""
 
 
 @dataclasses.dataclass

@@ -249,6 +249,26 @@ class SegmentEntry:
     result_samples: int
 
 
+@dataclasses.dataclass
+class HeadEntry:
+    """The memoized STABLE PREFIX of a range query's open head segment
+    (ISSUE 20, PR 17 follow-up): steps whose inputs all end below the
+    dataset's mutable floor never change without the prefix digest
+    changing too, so a warm dashboard's refresh replays them and
+    recomputes only the true sliver ``(stable_hi, head.hi]`` — the
+    mutable tail — instead of the whole head segment."""
+
+    batches: list
+    nbytes: int
+    digest: str          # over the prefix input range [lo - look, stable_hi]
+    stable_hi: int       # last step covered by the memoized prefix
+    lo: int              # first step of the head segment's grid
+    step: int
+    quarantine_epoch: int
+    routing_token: int
+    result_samples: int
+
+
 class InstantEntry:
     """One fingerprint's resident instant window state."""
 
@@ -430,6 +450,12 @@ class ResultCache:
         with self._lock:
             entries = len(self._entries)
             nbytes = self._bytes
+            heads = [
+                {"fingerprint": k[0][:160], "segment": k[1],
+                 "stable_hi": e.stable_hi,
+                 "samples": e.result_samples}
+                for k, e in self._entries.items()
+                if isinstance(e, HeadEntry)]
             instants = [
                 {"fingerprint": k[0][:160],
                  "series": e.state.resident_series,
@@ -443,6 +469,7 @@ class ResultCache:
                 "hits": self.hits, "misses": self.misses,
                 "skips": self.skips, "evictions": self.evictions,
                 "invalidations": self.invalidations,
+                "head_windows": heads,
                 "instant_windows": instants}
 
 
@@ -569,8 +596,38 @@ class ResultCachingPlanner(QueryPlanner):
                              full=(lo == full_lo and hi == full_hi)))
         if not segs:
             return self.inner.materialize(plan, qctx)
+        # head-segment prefix (ISSUE 20, PR 17 follow-up): the open
+        # head segment's steps below the mutable floor are stable —
+        # probe/extend a memoized prefix so a warm refresh recomputes
+        # only the true sliver.  Both the probe of the resident entry
+        # and the digest for the new prefix ride the SAME
+        # _segment_states pass as synthetic rows (one partition walk).
+        head = segs[-1]
+        head_key = (fp, head.k, seg_ms, "head")
+        head_entry = self.cache.get(head_key)
+        if not isinstance(head_entry, HeadEntry):
+            head_entry = None
+        mut_min = None
+        for sh in self.memstore.shards(self.dataset):
+            f = sh.mutable_floor()
+            if f is not None:
+                mut_min = f if mut_min is None else min(mut_min, f)
+        if mut_min is None:
+            stable_hi_now: Optional[int] = head.hi
+        elif mut_min > head.lo:
+            stable_hi_now = head.lo + ((min(head.hi, mut_min - 1)
+                                        - head.lo) // step) * step
+        else:
+            stable_hi_now = None
+        probe: list[_Seg] = []
+        _PROBE_K, _STORE_K = ("head", "probe"), ("head", "store")
+        if head_entry is not None and head.lo <= head_entry.stable_hi \
+                <= head.hi:
+            probe.append(_Seg(_PROBE_K, head.lo, head_entry.stable_hi))
+        if stable_hi_now is not None:
+            probe.append(_Seg(_STORE_K, head.lo, stable_hi_now))
         states = _segment_states(self.memstore, self.dataset, filters,
-                                 segs, look)
+                                 segs + probe, look)
         hits: dict[int, SegmentEntry] = {}
         for seg in segs:
             seg.key = (fp, seg.k, seg_ms)
@@ -589,7 +646,29 @@ class ResultCachingPlanner(QueryPlanner):
                 cache.discard(seg.key, "routing")
             else:
                 hits[seg.k] = entry
-        if not hits and not any(s.storable for s in segs):
+        head_hit: Optional[HeadEntry] = None
+        head_store: Optional[tuple] = None
+        if not head.storable:
+            if head_entry is not None and states.get(_PROBE_K):
+                digest, closed = states[_PROBE_K]
+                if head_entry.lo != head.lo or head_entry.step != step \
+                        or not head.lo <= head_entry.stable_hi <= head.hi:
+                    pass             # different grid: plain miss
+                elif head_entry.digest != digest or not closed:
+                    cache.discard(head_key, "chunks")
+                elif head_entry.quarantine_epoch != qepoch:
+                    cache.discard(head_key, "quarantine")
+                elif head_entry.routing_token != rtok:
+                    cache.discard(head_key, "routing")
+                else:
+                    head_hit = head_entry
+            if head_hit is None and stable_hi_now is not None:
+                digest, closed = states[_STORE_K]
+                if closed:           # the floor did not move mid-pass
+                    head_store = (head_key, head, stable_hi_now, digest,
+                                  step)
+        if not hits and head_hit is None and head_store is None \
+                and not any(s.storable for s in segs):
             # nothing cached and nothing cacheable (all-open range):
             # serve the unsplit plan — zero overhead on the miss path
             cache.note_skip("open")
@@ -612,10 +691,21 @@ class ResultCachingPlanner(QueryPlanner):
             if seg.k in hits:
                 flush_run()
                 items.append(("hit", hits[seg.k], seg))
+            elif seg is head and head_hit is not None:
+                # replay the stable prefix; recompute only the sliver
+                flush_run()
+                items.append(("head", head_hit, seg))
+                sliver_lo = head_hit.stable_hi + step
+                if sliver_lo <= seg.hi:
+                    sub = copy_with_time_range(plan, sliver_lo, seg.hi)
+                    items.append(("run", self.inner.materialize(sub,
+                                                                qctx),
+                                  []))
             else:
                 run.append(seg)
         flush_run()
-        return CachedRangeExec(self, items, qepoch, rtok, qctx)
+        return CachedRangeExec(self, items, qepoch, rtok, qctx,
+                               head_store=head_store)
 
     # ------------------------------------------------------------ instant
 
@@ -656,12 +746,16 @@ class CachedRangeExec(ExecPlan):
 
     def __init__(self, planner: ResultCachingPlanner, items: list,
                  quarantine_epoch: int, routing_token: int,
-                 query_context: Optional[QueryContext] = None):
+                 query_context: Optional[QueryContext] = None,
+                 head_store: Optional[tuple] = None):
         super().__init__(query_context)
         self._planner = planner
         self._items = items
         self._qepoch = quarantine_epoch
         self._rtok = routing_token
+        # (key, head _Seg, stable_hi, digest) when the head segment's
+        # stable prefix should be memoized off this execution
+        self._head_store = head_store
 
     @property
     def children(self):
@@ -688,11 +782,11 @@ class CachedRangeExec(ExecPlan):
         batches: list = []
         cached_samples = recomputed = 0
         for item in self._items:
-            if item[0] == "hit":
+            if item[0] in ("hit", "head"):
                 _kind, entry, _seg = item
                 batches.extend(entry.batches)
                 cached_samples += entry.result_samples
-                cache.note_hit("range")
+                cache.note_hit("range" if item[0] == "hit" else "head")
                 continue
             _kind, child, seg_metas = item
             sub_ctx = ExecContext(ctx.memstore, ctx.query_context,
@@ -748,6 +842,37 @@ class CachedRangeExec(ExecPlan):
             cache.put(seg.key, SegmentEntry(
                 stored, nbytes, seg.digest, self._qepoch, self._rtok,
                 samples))
+        if self._head_store is not None \
+                and any(s is self._head_store[1] for s in seg_metas):
+            self._store_head(res)
+
+    def _store_head(self, res) -> None:
+        """Memoize the head segment's stable prefix off a fresh run
+        (same slicing discipline as the closed segments — the guards in
+        :meth:`_store` already vetoed partial/hist results)."""
+        key, seg, stable_hi, digest, step = self._head_store
+        cache = self._planner.cache
+        stored: list = []
+        for b in res.batches:
+            st = b.steps
+            if st.step != step or (seg.lo - st.start) % st.step \
+                    or seg.lo < st.start or stable_hi > st.end:
+                return
+            i0 = (seg.lo - st.start) // st.step
+            i1 = (stable_hi - st.start) // st.step + 1
+            vals = np.ascontiguousarray(b.np_values()[:, i0:i1])
+            vals.setflags(write=False)
+            stored.append(PeriodicBatch(
+                list(b.keys), StepRange(seg.lo, stable_hi, st.step),
+                vals))
+        # an empty ``stored`` (no series matched) is still worth
+        # memoizing: the refresh skips the stable steps, and the digest
+        # guards late series births
+        nbytes, samples = _entry_bytes(stored)
+        cache.put(key, HeadEntry(stored, nbytes, digest, stable_hi,
+                                 seg.lo, step, self._qepoch, self._rtok,
+                                 samples))
+        cache.note_miss("head")
 
 
 class InstantWindowExec(LeafExecPlan):

@@ -161,6 +161,10 @@ def _enc_qctx(qctx: QueryContext) -> dict:
     SHRINK at every hop (ISSUE 5 deadline propagation)."""
     d = {f.name: getattr(qctx, f.name)
          for f in dataclasses.fields(QueryContext)}
+    # the live admission permit is node-local: the remote owner admits
+    # the leaf under ITS OWN controller, so a permit handle never
+    # crosses the wire (and a _Permit is not JSON-serializable anyway)
+    d.pop("admission_permit", None)
     if qctx.deadline_ms:
         import time as _time
         d["budget_ms"] = max(

@@ -843,6 +843,25 @@ class FiloServer:
             quota.refresh_from_index(
                 *(sh.index for sh in self.memstore.shards(name)))
             wpub.quota = quota
+        # fleet batching tier (ISSUE 20, filodb_tpu/batching): one
+        # QueryBatcher per dataset, attached to every local shard —
+        # the device stores offer eligible dispatches to it, so
+        # concurrent shape-compatible queries share ONE vmapped launch.
+        # On by default ("batching": {"enabled": false} opts out); the
+        # ledger resolves lazily because _setup_insights runs after
+        # datasets bind.
+        bat_conf = dict(ds_conf.get("batching",
+                                    self.config.get("batching", {})))
+        from filodb_tpu.batching import QueryBatcher
+        batcher = QueryBatcher(
+            enabled=bool(bat_conf.get("enabled", True)),
+            window_ms=float(bat_conf.get("window-ms", 3.0)),
+            max_batch=int(bat_conf.get("max-batch", 8)),
+            hot_ttl_s=float(bat_conf.get("hot-ttl-s", 10.0)),
+            dataset=name,
+            ledger=lambda: self.http.insights)
+        for sh in self.memstore.shards(name):
+            sh.query_batcher = batcher
         # tiered-resolution serving (ISSUE 11, doc/rollup.md): stand up
         # the <ds>_ds_<res> tier datasets as REAL datasets (replicated,
         # flushed through the checksummed store, queryable), wire the
@@ -856,7 +875,8 @@ class FiloServer:
                                               leaf_scheduler=leaf_sched,
                                               admission=admission,
                                               quota=quota,
-                                              resultcache=cache))
+                                              resultcache=cache,
+                                              batcher=batcher))
 
         gw_port = ds_conf.get("gateway-port")
         if gw_port is None and not self._global_gateway_claimed:

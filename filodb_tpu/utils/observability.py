@@ -760,6 +760,33 @@ def insights_metrics() -> dict:
     }
 
 
+def batch_metrics() -> dict:
+    """Canonical fleet-batching metrics (ISSUE 20,
+    filodb_tpu/batching): realized vmapped group sizes next to the
+    ledger's co-arrival headroom estimate, plus the fallback ladder —
+    one place defines the names so the batcher, /admin/insights,
+    doc/observability.md, and the bench gates can never drift."""
+    return {
+        "groups": REGISTRY.counter(
+            "filodb_batch_groups_total",
+            "batched (vmapped) device launches serving >= 2 queries, "
+            "per dataset"),
+        "members": REGISTRY.counter(
+            "filodb_batch_members_total",
+            "queries served from a batched launch, per dataset "
+            "(members/groups = realized mean batch size)"),
+        "fallbacks": REGISTRY.counter(
+            "filodb_batch_fallbacks_total",
+            "dispatches demoted to the per-query chain, per dataset "
+            "and reason (breaker | deadline | solo-window | "
+            "member-expired | timeout | error)"),
+        "peak": REGISTRY.gauge(
+            "filodb_batch_realized_peak",
+            "largest realized batch size since start, per dataset "
+            "(compare against the insights ledger's co-arrival peak)"),
+    }
+
+
 def slo_metrics() -> dict:
     """Canonical tenant-SLO metrics (ISSUE 19, insights/slo.py).  The
     burn rates are LEVEL gauges on purpose — the filodb_ingest_stalled

@@ -193,7 +193,7 @@ class AdmissionController:
             self._tenant_running[tenant] = \
                 self._tenant_running.get(tenant, 0) + 1
         self._m_admitted.inc(dataset=self.dataset, priority=priority)
-        return _Permit(self, tenant, cost)
+        return _Permit(self, tenant, cost, qctx)
 
     def _reject(self, qctx, tenant, priority, reason, retry_after_s,
                 detail) -> None:
@@ -241,19 +241,34 @@ class AdmissionController:
 
 class _Permit:
     """Releases admitted budget on exit and calibrates the cost model
-    with the measured wall time."""
+    with the measured wall time.
 
-    def __init__(self, ctrl: AdmissionController, tenant: str, cost: float):
+    While held, the permit is stamped onto the query's
+    ``QueryContext.admission_permit`` (fleet batching tier, ISSUE 20):
+    a batch leader re-checks ``released`` at stack time, so a query
+    whose admission window closed mid-batch is dropped from the stack
+    instead of executing outside it."""
+
+    def __init__(self, ctrl: AdmissionController, tenant: str, cost: float,
+                 qctx: Optional[QueryContext] = None):
         self._ctrl = ctrl
         self._tenant = tenant
         self.cost = cost
         self._t0 = 0.0
+        self._qctx = qctx
+        self.released = False
 
     def __enter__(self):
         self._t0 = time.perf_counter()
+        if self._qctx is not None:
+            self._qctx.admission_permit = self
         return self
 
     def __exit__(self, exc_type, exc, tb):
+        self.released = True
+        if self._qctx is not None \
+                and self._qctx.admission_permit is self:
+            self._qctx.admission_permit = None
         self._ctrl._release(self._tenant, self.cost,
                             time.perf_counter() - self._t0)
         return False

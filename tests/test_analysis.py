@@ -421,6 +421,18 @@ RULE_CASES = [
      "        out_specs=pl.BlockSpec((256, 1024), lambda i: (i, 0)),\n"
      "    )(x)\n",
      "VMEM", {}),
+    ("batch-admission-discipline",
+     # a group executor stacking members and launching the vmapped
+     # program without consulting permits or deadline budgets
+     "def launch_group(self, g, batch_launch):\n"
+     "    row0s = [m.row0 for m in g.members]\n"
+     "    return batch_launch(row0s)\n",
+     "def launch_group(self, g, batch_launch):\n"
+     "    live = [m for m in g.members\n"
+     "            if not m.qctx.admission_permit.released\n"
+     "            and remaining_ms(m.qctx) > 0]\n"
+     "    return batch_launch([m.row0 for m in live])\n",
+     "admission_permit", {}),
 ]
 
 

@@ -85,6 +85,23 @@ class TestWireSerde:
         np.testing.assert_array_equal(np.asarray(b2.row_counts),
                                       np.asarray(batch.row_counts))
 
+    def test_live_admission_permit_stays_node_local(self):
+        # a leaf carrying a live (non-JSON) admission permit must still
+        # serialize — the permit is node-local; the remote owner admits
+        # the leaf under its own controller (ISSUE 20 regression)
+        class _FakePermit:
+            released = False
+        qctx = QueryContext(query_id="qp", admission_permit=_FakePermit(),
+                            batch_key="prom|grid|k")
+        plan = MultiSchemaPartitionsExec(
+            "prom", 1, [ColumnFilter("_metric_", Equals("m"))],
+            BASE, BASE + 600_000, query_context=qctx)
+        import json
+        d = json.loads(json.dumps(wire.serialize_plan(plan)))
+        plan2 = wire.deserialize_plan(d)
+        assert plan2.query_context.admission_permit is None
+        assert plan2.query_context.batch_key == "prom|grid|k"
+
     def test_unserializable_plan_raises(self):
         from filodb_tpu.query.exec import EmptyResultExec
         with pytest.raises(wire.WireError):

@@ -446,6 +446,92 @@ def test_disabled_cache_is_pass_through():
 
 
 # ---------------------------------------------------------------------------
+# head-segment stable prefix (ISSUE 20, PR 17 follow-up): a warm
+# dashboard's OPEN head segment replays its stable prefix and
+# recomputes only the mutable sliver
+# ---------------------------------------------------------------------------
+
+
+def _seeded_open_head(segment_ms=8_000, flushed=35, buffered=8):
+    """Flushed history + an UNFLUSHED (write-buffer) tail starting
+    INSIDE the head segment (seg [32s, 40s), floor at 35s): the head
+    segment stays open with a non-empty stable prefix below the
+    mutable floor."""
+    h = _Harness(segment_ms=segment_ms)
+    ts = BASE + np.arange(flushed, dtype=np.int64) * 1000
+    h.ingest("m_total", [({"inst": "a", "_ws_": "w"},
+                          np.cumsum(np.ones(flushed))),
+                         ({"inst": "b", "_ws_": "w"},
+                          np.cumsum(np.ones(flushed)) * 3)], ts)
+    h.flush()
+    ts2 = BASE + (flushed + np.arange(buffered, dtype=np.int64)) * 1000
+    h.ingest("m_total", [({"inst": "a", "_ws_": "w"},
+                          flushed + np.cumsum(np.ones(buffered))),
+                         ({"inst": "b", "_ws_": "w"},
+                          (flushed + np.cumsum(np.ones(buffered))) * 3)],
+             ts2)
+    return h
+
+
+def test_open_head_segment_serves_stable_prefix():
+    h = _seeded_open_head()
+    # end inside the OPEN head segment [32s, 40s): the mutable floor
+    # (35s) splits it into a stable prefix and the true sliver
+    start, step, end = BASE + 2_000, 1000, BASE + 38_000
+    cold = h.eval_range(h.cached, Q, start, step, end)
+    assert h.cache.snapshot()["head_windows"], \
+        "cold evaluation should memoize the head segment's stable prefix"
+    hits0 = h.cache.hits
+    warm = h.eval_range(h.cached, Q, start, step, end)
+    assert h.cache.hits > hits0
+    _assert_bit_equal(h.eval_range(h.plain, Q, start, step, end), warm)
+    # only the sliver above the stable prefix recomputes
+    assert warm.stats.samples_scanned < cold.stats.samples_scanned
+    from filodb_tpu.query.resultcache import _m
+    assert _m()["hits"].total() > 0
+
+
+def test_open_head_stays_equal_as_tail_mutates():
+    # short buffered tail (samples at 35s, 36s): the sliver is hot
+    h = _seeded_open_head(buffered=2)
+    start, step, end = BASE + 2_000, 1000, BASE + 38_000
+    h.eval_range(h.cached, Q, start, step, end)
+    assert h.cache.snapshot()["head_windows"]
+    # fresh samples land in the mutable sliver between refreshes (the
+    # dashboard shape); the replayed prefix + recomputed sliver must
+    # serve the new rows bit-equal to the uncached answer
+    ts3 = BASE + (37 + np.arange(4, dtype=np.int64)) * 1000
+    h.ingest("m_total", [({"inst": "a", "_ws_": "w"},
+                          37 + np.cumsum(np.ones(4))),
+                         ({"inst": "b", "_ws_": "w"},
+                          (37 + np.cumsum(np.ones(4))) * 3)], ts3)
+    hits0 = h.cache.hits
+    after = h.eval_range(h.cached, Q, start, step, end)
+    assert h.cache.hits > hits0, "the stable prefix should still replay"
+    _assert_bit_equal(h.eval_range(h.plain, Q, start, step, end), after)
+
+
+def test_open_head_prefix_invalidates_on_old_timestamps():
+    h = _seeded_open_head()
+    start, step, end = BASE + 2_000, 1000, BASE + 38_000
+    h.eval_range(h.cached, Q, start, step, end)
+    warm = h.eval_range(h.cached, Q, start, step, end)
+    assert h.cache.snapshot()["head_windows"]
+    # a late series flushes chunks with OLD timestamps reaching into
+    # the prefix input range: the digest changes, the stale prefix
+    # must be discarded — never replayed
+    old = BASE + np.arange(43, dtype=np.int64) * 1000
+    h.ingest("m_total", [({"inst": "late", "_ws_": "w"},
+                          np.cumsum(np.ones(43)) * 11)], old)
+    h.flush()
+    after = h.eval_range(h.cached, Q, start, step, end)
+    plain = h.eval_range(h.plain, Q, start, step, end)
+    _assert_bit_equal(plain, after)
+    # the invalidation changed the bytes served in the prefix steps
+    assert _series_map(warm) != _series_map(after)
+
+
+# ---------------------------------------------------------------------------
 # rollup boundary composition: the cache sits BELOW the router, so a
 # moving tier boundary re-routes steps instead of serving stale entries
 # ---------------------------------------------------------------------------
